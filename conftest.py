@@ -1,0 +1,18 @@
+"""Root pytest config: run the suite on a virtual 8-device CPU mesh.
+
+Must run before jax is imported anywhere: forces the CPU platform with 8
+virtual devices so the multi-chip sharding paths (veles/simd_tpu/parallel)
+compile and execute without TPU hardware, mirroring how the driver validates
+``__graft_entry__.dryrun_multichip``.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))

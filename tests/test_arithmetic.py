@@ -1,0 +1,187 @@
+"""Tests for veles.simd_tpu.ops.arithmetic.
+
+Port of the reference's test strategy for ``tests/arithmetic.cc``
+(SURVEY.md §4): XLA-vs-oracle cross-validation (the reference's
+SIMD-vs-``_na`` pattern, ``tests/arithmetic.cc:223-239``), float16
+golden values incl. inf/nan/subnormals/signed zero
+(``tests/arithmetic.cc:335-415``), and contract-violation checks.
+"""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu.ops import arithmetic as ar
+
+RNG = np.random.RandomState(1234)
+
+
+def assert_xla_matches_oracle(fn, *args, **kw):
+    got = np.asarray(fn(*args, simd=True, **kw))
+    want = fn(*args, simd=False, **kw)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    assert got.dtype == want.dtype
+
+
+@pytest.mark.parametrize("length", [1, 3, 8, 509, 4096])
+def test_int16_to_float(length):
+    data = RNG.randint(-32768, 32768, size=length).astype(np.int16)
+    assert_xla_matches_oracle(ar.int16_to_float, data)
+
+
+@pytest.mark.parametrize("length", [1, 3, 8, 509, 4096])
+def test_float_to_int16_truncates(length):
+    data = (RNG.rand(length).astype(np.float32) - 0.5) * 65000
+    assert_xla_matches_oracle(ar.float_to_int16, data)
+    # truncation-not-rounding semantics (arithmetic.h:53-55)
+    vals = np.array([1.9, -1.9, 0.5, -0.5, 32767.9, -32768.9], np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(ar.float_to_int16(vals, simd=True)),
+        np.array([1, -1, 0, 0, 32767, -32768], np.int16))
+
+
+def test_float_to_int16_saturates():
+    vals = np.array([1e9, -1e9, 40000.0, -40000.0], np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(ar.float_to_int16(vals, simd=True)),
+        np.array([32767, -32768, 32767, -32768], np.int16))
+
+
+@pytest.mark.parametrize("length", [1, 3, 509])
+def test_int32_roundtrips(length):
+    i32 = RNG.randint(-(2**24), 2**24, size=length).astype(np.int32)
+    assert_xla_matches_oracle(ar.int32_to_float, i32)
+    f32 = (RNG.rand(length).astype(np.float32) - 0.5) * 1e6
+    assert_xla_matches_oracle(ar.float_to_int32, f32)
+
+
+@pytest.mark.parametrize("length", [1, 3, 509])
+def test_int16_int32_widen_narrow(length):
+    i16 = RNG.randint(-32768, 32768, size=length).astype(np.int16)
+    assert_xla_matches_oracle(ar.int16_to_int32, i16)
+    i32 = RNG.randint(-32768, 32768, size=length).astype(np.int32)
+    assert_xla_matches_oracle(ar.int32_to_int16, i32)
+
+
+def test_int32_to_int16_saturates():
+    # vector-path saturating semantics (_mm_packs_epi32, arithmetic.h:334)
+    vals = np.array([2**20, -(2**20), 32768, -32769, 5], np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(ar.int32_to_int16(vals, simd=True)),
+        np.array([32767, -32768, 32767, -32768, 5], np.int16))
+
+
+class TestFloat16:
+    """Golden float16 cases from tests/arithmetic.cc:335-415."""
+
+    def check(self, bits, expected):
+        bits = np.asarray(bits, np.uint16)
+        got = np.asarray(ar.float16_to_float(bits, simd=True))
+        want = ar.float16_to_float(bits, simd=False)
+        np.testing.assert_array_equal(got, want)
+        if expected is not None:
+            np.testing.assert_array_equal(got, np.asarray(expected, np.float32))
+
+    def test_normals(self):
+        self.check([0x3C00, 0xC000, 0x4248], [1.0, -2.0, 3.140625])
+
+    def test_signed_zero(self):
+        got = np.asarray(ar.float16_to_float(
+            np.array([0x0000, 0x8000], np.uint16), simd=True))
+        np.testing.assert_array_equal(got, [0.0, -0.0])
+        assert np.signbit(got[1]) and not np.signbit(got[0])
+
+    def test_inf_nan(self):
+        got = np.asarray(ar.float16_to_float(
+            np.array([0x7C00, 0xFC00, 0x7E00], np.uint16), simd=True))
+        assert got[0] == np.inf and got[1] == -np.inf and np.isnan(got[2])
+
+    def test_subnormals(self):
+        # smallest subnormal 2^-24, largest subnormal (1023/1024)*2^-14
+        self.check([0x0001, 0x03FF, 0x8001],
+                   [2.0 ** -24, (1023 / 1024) * 2.0 ** -14, -(2.0 ** -24)])
+
+    def test_random_all_finite(self):
+        bits = RNG.randint(0, 0x7C00, size=2048).astype(np.uint16)
+        self.check(bits, None)
+
+    def test_accepts_float16_array(self):
+        x = np.array([1.5, -0.25], np.float16)
+        np.testing.assert_array_equal(
+            np.asarray(ar.float16_to_float(x, simd=True)), [1.5, -0.25])
+
+
+@pytest.mark.parametrize("length", [4, 510])
+def test_int16_multiply_widens(length):
+    a = RNG.randint(-32768, 32768, size=length).astype(np.int16)
+    b = RNG.randint(-32768, 32768, size=length).astype(np.int16)
+    got = np.asarray(ar.int16_multiply(a, b, simd=True))
+    assert got.dtype == np.int32
+    np.testing.assert_array_equal(got, ar.int16_multiply(a, b, simd=False))
+    # would overflow int16: check widening really happened
+    big = np.array([-32768], np.int16)
+    assert ar.int16_multiply(big, big, simd=True)[0] == 2 ** 30
+
+
+@pytest.mark.parametrize("length", [2, 8, 512])
+def test_real_multiply(length):
+    a = RNG.rand(length).astype(np.float32)
+    b = RNG.rand(length).astype(np.float32)
+    assert_xla_matches_oracle(ar.real_multiply, a, b)
+
+
+def test_real_multiply_scalar():
+    a = RNG.rand(333).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(ar.real_multiply_scalar(a, 2.5, simd=True)),
+        ar.real_multiply_scalar(a, 2.5, simd=False), rtol=1e-7)
+
+
+@pytest.mark.parametrize("n_complex", [1, 4, 256])
+def test_complex_multiply(n_complex):
+    a = RNG.randn(2 * n_complex).astype(np.float32)
+    b = RNG.randn(2 * n_complex).astype(np.float32)
+    assert_xla_matches_oracle(ar.complex_multiply, a, b)
+    # against numpy complex arithmetic
+    za = ar.deinterleave_complex(a)
+    zb = ar.deinterleave_complex(b)
+    np.testing.assert_allclose(
+        np.asarray(ar.complex_multiply(a, b, simd=True)),
+        ar.interleave_complex(za * zb), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("n_complex", [1, 4, 256])
+def test_complex_multiply_conjugate(n_complex):
+    a = RNG.randn(2 * n_complex).astype(np.float32)
+    b = RNG.randn(2 * n_complex).astype(np.float32)
+    assert_xla_matches_oracle(ar.complex_multiply_conjugate, a, b)
+    za = ar.deinterleave_complex(a)
+    zb = ar.deinterleave_complex(b)
+    np.testing.assert_allclose(
+        np.asarray(ar.complex_multiply_conjugate(a, b, simd=True)),
+        ar.interleave_complex(za * np.conj(zb)), rtol=1e-5, atol=1e-5)
+
+
+def test_complex_conjugate():
+    a = RNG.randn(64).astype(np.float32)
+    assert_xla_matches_oracle(ar.complex_conjugate, a)
+
+
+@pytest.mark.parametrize("length", [1, 7, 4096])
+def test_sum_elements(length):
+    data = RNG.rand(length).astype(np.float32)
+    got = float(ar.sum_elements(data, simd=True))
+    want = float(ar.sum_elements(data, simd=False))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_add_to_all():
+    data = RNG.rand(100).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(ar.add_to_all(data, 3.25, simd=True)),
+        ar.add_to_all(data, 3.25, simd=False), rtol=1e-7)
+
+
+def test_interleave_roundtrip():
+    z = (RNG.randn(32) + 1j * RNG.randn(32)).astype(np.complex64)
+    np.testing.assert_allclose(
+        ar.deinterleave_complex(ar.interleave_complex(z)), z, rtol=1e-6)

@@ -1,0 +1,60 @@
+"""Tests for veles.simd_tpu.ops.mathfun.
+
+Port of ``tests/mathfun.cc:59-84``: libm (NumPy) is the oracle; parameterized
+over sizes {1, 3, 64, 199} × functions, non-finite inputs excluded for log
+(the reference skips them at ``tests/mathfun.cc:69``).
+"""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu.ops import mathfun as mf
+
+RNG = np.random.RandomState(42)
+SIZES = [1, 3, 64, 199, 100003]
+
+
+@pytest.mark.parametrize("length", SIZES)
+@pytest.mark.parametrize("name,fn", [("sin", mf.sin_psv), ("cos", mf.cos_psv)])
+def test_trig(name, fn, length):
+    data = (RNG.rand(length).astype(np.float32) - 0.5) * 20.0
+    np.testing.assert_allclose(np.asarray(fn(data, simd=True)),
+                               fn(data, simd=False), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("length", SIZES)
+def test_exp(length):
+    data = (RNG.rand(length).astype(np.float32) - 0.5) * 20.0
+    np.testing.assert_allclose(np.asarray(mf.exp_psv(data, simd=True)),
+                               mf.exp_psv(data, simd=False), rtol=1e-5)
+
+
+@pytest.mark.parametrize("length", SIZES)
+def test_log(length):
+    data = RNG.rand(length).astype(np.float32) * 1000.0 + 1e-6
+    # XLA's f32 log is a few ulp off libm; absolute tolerance on the output
+    np.testing.assert_allclose(np.asarray(mf.log_psv(data, simd=True)),
+                               mf.log_psv(data, simd=False),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_pow_sqrt():
+    base = RNG.rand(512).astype(np.float32) * 10.0 + 0.1
+    exponent = (RNG.rand(512).astype(np.float32) - 0.5) * 4.0
+    np.testing.assert_allclose(
+        np.asarray(mf.pow_psv(base, exponent, simd=True)),
+        mf.pow_psv(base, exponent, simd=False), rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(mf.sqrt_psv(base, simd=True)),
+                               mf.sqrt_psv(base, simd=False), rtol=1e-6)
+
+
+def test_golden_values():
+    np.testing.assert_allclose(
+        np.asarray(mf.sin_psv(np.array([0.0, np.pi / 2], np.float32))),
+        [0.0, 1.0], atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(mf.exp_psv(np.array([0.0, 1.0], np.float32))),
+        [1.0, np.e], rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(mf.log_psv(np.array([1.0, np.e], np.float32))),
+        [0.0, 1.0], atol=2e-5)

@@ -1,0 +1,105 @@
+"""Tests for veles.simd_tpu.ops.matrix.
+
+Port of ``tests/matrix.cc``: XLA-vs-oracle cross-validation with the
+reference's tolerance (ASSERT_NEAR 0.1, ``tests/matrix.cc:94-98``),
+golden small-matrix GEMM (``tests/matrix.cc:100-157``), and the
+parameterized size sweep (``tests/matrix.cc:159-204``).
+"""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu.ops import matrix as mx
+
+RNG = np.random.RandomState(7)
+
+# (w1, h1, w2) with h2 = w1 — from the reference sweep plus MXU-shaped sizes
+SWEEP = [
+    (1, 1, 1),
+    (3, 3, 3),
+    (99, 99, 99),
+    (125, 299, 64),
+    (128, 300, 1000),
+    (256, 300, 1000),
+    (512, 512, 512),
+]
+
+
+@pytest.mark.parametrize("w,h", [(1, 1), (3, 7), (128, 64), (299, 125)])
+@pytest.mark.parametrize("op", [mx.matrix_add, mx.matrix_sub])
+def test_add_sub(op, w, h):
+    m1 = RNG.randn(h, w).astype(np.float32)
+    m2 = RNG.randn(h, w).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(op(m1, m2, simd=True)),
+                               op(m1, m2, simd=False), rtol=1e-6)
+
+
+@pytest.mark.parametrize("w1,h1,w2", SWEEP)
+def test_multiply_vs_oracle(w1, h1, w2):
+    m1 = RNG.randn(h1, w1).astype(np.float32)
+    m2 = RNG.randn(w1, w2).astype(np.float32)
+    got = np.asarray(mx.matrix_multiply(m1, m2, simd=True))
+    want = mx.matrix_multiply(m1, m2, simd=False)
+    assert got.shape == (h1, w2)
+    np.testing.assert_allclose(got, want, atol=0.1)  # tests/matrix.cc:98
+
+
+@pytest.mark.parametrize("w1,h1,w2", SWEEP)
+def test_multiply_transposed_vs_oracle(w1, h1, w2):
+    m1 = RNG.randn(h1, w1).astype(np.float32)
+    m2t = RNG.randn(w2, w1).astype(np.float32)  # B stored transposed
+    got = np.asarray(mx.matrix_multiply_transposed(m1, m2t, simd=True))
+    want = mx.matrix_multiply_transposed(m1, m2t, simd=False)
+    assert got.shape == (h1, w2)
+    np.testing.assert_allclose(got, want, atol=0.1)
+
+
+def test_transposed_agrees_with_straight():
+    m1 = RNG.randn(33, 65).astype(np.float32)
+    m2 = RNG.randn(65, 17).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(mx.matrix_multiply_transposed(m1, m2.T.copy(), simd=True)),
+        np.asarray(mx.matrix_multiply(m1, m2, simd=True)), atol=1e-4)
+
+
+def test_golden_small_gemm():
+    """Small-matrix golden values (tests/matrix.cc:100-157 pattern)."""
+    m1 = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    m2 = np.array([[5.0, 6.0], [7.0, 8.0]], np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(mx.matrix_multiply(m1, m2, simd=True)),
+        np.array([[19.0, 22.0], [43.0, 50.0]], np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(mx.matrix_add(m1, m2, simd=True)),
+        np.array([[6.0, 8.0], [10.0, 12.0]], np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(mx.matrix_sub(m2, m1, simd=True)),
+        np.array([[4.0, 4.0], [4.0, 4.0]], np.float32))
+
+
+def test_shape_contract_violation():
+    """The reference asserts on w1 != h2 (src/matrix.c:257-261); we raise."""
+    m1 = np.zeros((4, 5), np.float32)
+    m2 = np.zeros((4, 5), np.float32)
+    with pytest.raises(ValueError):
+        mx.matrix_multiply(m1, m2, simd=True)
+    with pytest.raises(ValueError):
+        mx.matrix_multiply_transposed(m1, np.zeros((3, 4), np.float32))
+
+
+def test_gemv():
+    m = RNG.randn(300, 256).astype(np.float32)
+    v = RNG.randn(256).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(mx.matrix_vector_multiply(m, v, simd=True)),
+        mx.matrix_vector_multiply(m, v, simd=False), atol=0.1)
+
+
+def test_fast_bf16_path_close():
+    """bf16 MXU path stays within loose tolerance of f32."""
+    m1 = RNG.randn(128, 256).astype(np.float32)
+    m2 = RNG.randn(256, 64).astype(np.float32)
+    got = np.asarray(mx.matrix_multiply(m1, m2, simd=True, fast=True))
+    want = mx.matrix_multiply_novec(m1, m2)
+    # bf16 has ~3 decimal digits; relative error scales with sqrt(K)
+    np.testing.assert_allclose(got, want, rtol=0.05, atol=0.5)

@@ -1,0 +1,84 @@
+"""Vectorized transcendental functions: sin / cos / log / exp (+ pow, sqrt).
+
+TPU-native rebuild of ``/root/reference/inc/simd/mathfun.h`` (dispatchers at
+``:142-204``) and the vendored cephes-style polynomial kernels it wraps
+(``avx_mathfun.h:161-729``, ``neon_mathfun.h:57-336``).  Those hand-rolled
+range-reduction + polynomial evaluations are exactly what XLA's elementwise
+lowering emits for the TPU VPU, so the entire L2 vendored layer is subsumed by
+``jnp.sin/cos/log/exp`` (SURVEY.md §2 "⊘" components) — and fuses into
+adjacent ops for free.
+
+Naming keeps the reference's ``*_psv`` suffix ("packed single vector").
+Oracle twins use NumPy's libm-backed ufuncs, matching the reference tests'
+use of libm as the oracle (``tests/mathfun.cc:59-84``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles.simd_tpu.utils.config import resolve_simd
+
+__all__ = ["sin_psv", "cos_psv", "log_psv", "exp_psv", "pow_psv", "sqrt_psv"]
+
+
+_XLA = {
+    "sin": jax.jit(jnp.sin),
+    "cos": jax.jit(jnp.cos),
+    "log": jax.jit(jnp.log),
+    "exp": jax.jit(jnp.exp),
+    "sqrt": jax.jit(jnp.sqrt),
+}
+_POW = jax.jit(jnp.power)
+
+_NA = {"sin": np.sin, "cos": np.cos, "log": np.log, "exp": np.exp,
+       "sqrt": np.sqrt}
+
+
+def _psv(name, data, simd):
+    if resolve_simd(simd):
+        return _XLA[name](jnp.asarray(data, dtype=jnp.float32))
+    return _NA[name](np.asarray(data, dtype=np.float32))
+
+
+def sin_psv(data, simd=None):
+    """``mathfun.h:142-156``."""
+    return _psv("sin", data, simd)
+
+
+def cos_psv(data, simd=None):
+    """``mathfun.h:158-172``."""
+    return _psv("cos", data, simd)
+
+
+def log_psv(data, simd=None):
+    """``mathfun.h:174-188``."""
+    return _psv("log", data, simd)
+
+
+def exp_psv(data, simd=None):
+    """``mathfun.h:190-204``."""
+    return _psv("exp", data, simd)
+
+
+def pow_psv(base, exponent, simd=None):
+    """``avx_mathfun.h:720`` / ``neon_mathfun.h:307`` pow_ps."""
+    if resolve_simd(simd):
+        return _POW(jnp.asarray(base, dtype=jnp.float32),
+                    jnp.asarray(exponent, dtype=jnp.float32))
+    return np.power(np.asarray(base, np.float32),
+                    np.asarray(exponent, np.float32))
+
+
+def sqrt_psv(data, simd=None):
+    """``neon_mathfun.h:314`` sqrt_ps."""
+    return _psv("sqrt", data, simd)
+
+
+# reference-compatible aliases (mathfun.h public names)
+sin_psv_na = np.sin
+cos_psv_na = np.cos
+log_psv_na = np.log
+exp_psv_na = np.exp
