@@ -1,0 +1,128 @@
+"""Tests for veles.simd_tpu.ops.convolve.
+
+Port of ``tests/convolve.cc``: golden-value convolutions of known arrays
+(``tests/convolve.cc:53-71``), cross-validation of every algorithm against
+the direct-form oracle (``:139-166``), and the algorithm-crossover size
+sweep the reference benchmarks cover (``:168-401``).
+"""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu.ops import convolve as cv
+
+RNG = np.random.RandomState(11)
+
+ALGOS = [cv.ConvolutionAlgorithm.BRUTE_FORCE,
+         cv.ConvolutionAlgorithm.FFT,
+         cv.ConvolutionAlgorithm.OVERLAP_SAVE]
+
+
+def _ref_full(x, h):
+    return np.convolve(np.asarray(x, np.float64),
+                       np.asarray(h, np.float64)).astype(np.float32)
+
+
+def test_golden_small():
+    """Known-array golden values (tests/convolve.cc:53-71 pattern)."""
+    x = np.array([1.0, 2.0, 3.0], np.float32)
+    h = np.array([4.0, 5.0], np.float32)
+    want = np.array([4.0, 13.0, 22.0, 15.0], np.float32)
+    np.testing.assert_allclose(np.asarray(cv.convolve_simd(x, h, simd=True)),
+                               want, atol=1e-5)
+    np.testing.assert_allclose(cv.convolve_na(x, h), want, atol=1e-6)
+
+
+def test_golden_identity_kernel():
+    x = RNG.randn(64).astype(np.float32)
+    h = np.array([1.0], np.float32)
+    np.testing.assert_allclose(np.asarray(cv.convolve_simd(x, h, simd=True)),
+                               x, atol=1e-6)
+
+
+@pytest.mark.parametrize("xlen,hlen", [
+    (16, 4), (50, 50), (100, 10), (256, 256), (350, 21), (1000, 50),
+    (2000, 950), (4096, 63),
+])
+def test_algorithms_cross_validate(xlen, hlen):
+    """Every algorithm × every backend agrees with the float64 direct form
+    (tests/convolve.cc:139-166)."""
+    x = RNG.randn(xlen).astype(np.float32)
+    h = RNG.randn(hlen).astype(np.float32)
+    want = _ref_full(x, h)
+    tol = 1e-3 * max(1.0, np.abs(want).max())
+    for algo in ALGOS:
+        if algo is cv.ConvolutionAlgorithm.OVERLAP_SAVE and \
+                not hlen < xlen / 2:
+            continue
+        handle = cv.convolve_initialize(xlen, hlen, algo)
+        for simd in (True, False):
+            got = np.asarray(cv.convolve(handle, x, h, simd=simd))
+            assert got.shape == (xlen + hlen - 1,)
+            np.testing.assert_allclose(got, want, atol=tol,
+                                       err_msg=f"{algo} simd={simd}")
+
+
+def test_overlap_save_long_signal():
+    """The long-signal path (BASELINE.md config 4 shape, scaled down)."""
+    x = RNG.randn(1 << 16).astype(np.float32)
+    h = RNG.randn(127).astype(np.float32)
+    handle = cv.convolve_overlap_save_initialize(x.size, h.size)
+    got = np.asarray(cv.convolve_overlap_save(handle, x, h, simd=True))
+    want = _ref_full(x, h)
+    np.testing.assert_allclose(got, want, atol=1e-2)
+
+
+def test_batched_leading_dims():
+    x = RNG.randn(4, 128).astype(np.float32)
+    h = RNG.randn(9).astype(np.float32)
+    got = np.asarray(cv.convolve_simd(x, h, simd=True))
+    assert got.shape == (4, 136)
+    for i in range(4):
+        np.testing.assert_allclose(got[i], _ref_full(x[i], h), atol=1e-4)
+
+
+def test_block_length_matches_reference():
+    """L = 2^(⌊log2 h⌋+2) (src/convolve.c:115-121)."""
+    assert cv.overlap_save_block_length(50) == 128
+    assert cv.overlap_save_block_length(64) == 256
+    assert cv.overlap_save_block_length(1) == 4
+    assert cv.overlap_save_block_length(950) == 2048
+
+
+def test_fft_length():
+    h = cv.convolve_fft_initialize(100, 29)
+    assert h.fft_length == 128
+    h = cv.convolve_fft_initialize(100, 28)   # 127 → 128
+    assert h.fft_length == 128
+    h = cv.convolve_fft_initialize(65, 64)    # 128 exactly stays 128
+    assert h.fft_length == 128
+
+
+def test_contract_violations():
+    """Reference asserts (src/convolve.c:44-48,105); we raise."""
+    with pytest.raises(ValueError):
+        cv.convolve_initialize(0, 5)
+    with pytest.raises(ValueError):
+        cv.convolve_overlap_save_initialize(10, 6)  # h >= x/2
+    handle = cv.convolve_initialize(8, 3)
+    with pytest.raises(ValueError):
+        cv.convolve(handle, np.zeros(9, np.float32),
+                    np.zeros(3, np.float32), simd=True)
+
+
+def test_auto_select_shape():
+    """Heuristic has the reference's shape: long+thin → overlap-save,
+    big balanced → FFT, small → direct (src/convolve.c:328-364)."""
+    assert cv.select_algorithm(1 << 20, 64) is \
+        cv.ConvolutionAlgorithm.OVERLAP_SAVE
+    assert cv.select_algorithm(4096, 4096) is cv.ConvolutionAlgorithm.FFT
+    assert cv.select_algorithm(128, 16) is \
+        cv.ConvolutionAlgorithm.BRUTE_FORCE
+
+
+def test_convenience_form():
+    x = RNG.randn(100).astype(np.float32)
+    h = RNG.randn(7).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(cv.convolve(x, h)),
+                               _ref_full(x, h), atol=1e-4)

@@ -1,0 +1,79 @@
+"""Tests for veles.simd_tpu.ops.correlate.
+
+Port of ``tests/correlate.cc``: golden values (``:53-71``) and
+cross-validation of the FFT / overlap-save paths against the direct form
+(``:130-152``).
+"""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu.ops import convolve as cv
+from veles.simd_tpu.ops import correlate as cr
+
+RNG = np.random.RandomState(13)
+
+
+def _ref_xcorr(x, h):
+    """result[j] = Σ_m x[m] h[m + hLen-1-j]  == convolve(x, rev(h))."""
+    return np.convolve(np.asarray(x, np.float64),
+                       np.asarray(h, np.float64)[::-1]).astype(np.float32)
+
+
+def test_golden_small():
+    x = np.array([1.0, 2.0, 3.0], np.float32)
+    h = np.array([4.0, 5.0], np.float32)
+    # np.correlate(x, h, "full") reversed-index convention:
+    want = _ref_xcorr(x, h)
+    np.testing.assert_allclose(
+        np.asarray(cr.cross_correlate_simd(x, h, simd=True)), want, atol=1e-5)
+    np.testing.assert_allclose(cr.cross_correlate_na(x, h), want, atol=1e-6)
+
+
+def test_autocorrelation_peak_centered():
+    """Autocorrelation of a random signal peaks at zero lag."""
+    x = RNG.randn(257).astype(np.float32)
+    out = np.asarray(cr.cross_correlate_simd(x, x, simd=True))
+    assert out.shape == (513,)
+    assert int(np.argmax(out)) == 256
+
+
+@pytest.mark.parametrize("xlen,hlen", [(60, 60), (100, 10), (1000, 50),
+                                       (2000, 950), (4096, 63)])
+def test_algorithms_cross_validate(xlen, hlen):
+    x = RNG.randn(xlen).astype(np.float32)
+    h = RNG.randn(hlen).astype(np.float32)
+    want = _ref_xcorr(x, h)
+    tol = 1e-3 * max(1.0, np.abs(want).max())
+
+    for make, run in [
+        (cr.cross_correlate_fft_initialize, cr.cross_correlate_fft),
+        (cr.cross_correlate_overlap_save_initialize,
+         cr.cross_correlate_overlap_save),
+    ]:
+        if make is cr.cross_correlate_overlap_save_initialize and \
+                not hlen < xlen / 2:
+            continue
+        handle = make(xlen, hlen)
+        assert handle.reverse
+        for simd in (True, False):
+            got = np.asarray(run(handle, x, h, simd=simd))
+            np.testing.assert_allclose(got, want, atol=tol,
+                                       err_msg=f"{make.__name__} {simd}")
+
+
+def test_auto_handle_sets_reverse():
+    handle = cr.cross_correlate_initialize(1 << 15, 64)
+    assert handle.reverse
+    assert handle.algorithm is cv.ConvolutionAlgorithm.OVERLAP_SAVE
+    x = RNG.randn(1 << 15).astype(np.float32)
+    h = RNG.randn(64).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(cr.cross_correlate(handle, x, h)),
+                               _ref_xcorr(x, h), atol=1e-2)
+
+
+def test_convenience_form():
+    x = RNG.randn(128).astype(np.float32)
+    h = RNG.randn(16).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(cr.cross_correlate(x, h)),
+                               _ref_xcorr(x, h), atol=1e-4)

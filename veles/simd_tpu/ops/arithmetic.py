@@ -291,16 +291,26 @@ def real_multiply_scalar(data, value, simd=None):
     return real_multiply_scalar_na(data, value)
 
 
+def _check_interleaved(*arrays):
+    for a in arrays:
+        if np.shape(a)[-1] % 2:
+            raise ValueError(
+                "interleaved complex array must have even last-dim length")
+
+
 def complex_multiply(a, b, simd=None):
+    _check_interleaved(a, b)
     return _dispatch(simd, _complex_multiply, complex_multiply_na, a, b)
 
 
 def complex_multiply_conjugate(a, b, simd=None):
+    _check_interleaved(a, b)
     return _dispatch(simd, _complex_multiply_conjugate,
                      complex_multiply_conjugate_na, a, b)
 
 
 def complex_conjugate(data, simd=None):
+    _check_interleaved(data)
     return _dispatch(simd, _complex_conjugate, complex_conjugate_na, data)
 
 

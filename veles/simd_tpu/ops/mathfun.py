@@ -77,8 +77,19 @@ def sqrt_psv(data, simd=None):
     return _psv("sqrt", data, simd)
 
 
-# reference-compatible aliases (mathfun.h public names)
-sin_psv_na = np.sin
-cos_psv_na = np.cos
-log_psv_na = np.log
-exp_psv_na = np.exp
+# reference-compatible oracle names (mathfun.h PsvStdFunc scalar path,
+# mathfun.h:42-65) — f32 in/out like the dispatched oracle branch
+def sin_psv_na(data):
+    return np.sin(np.asarray(data, np.float32))
+
+
+def cos_psv_na(data):
+    return np.cos(np.asarray(data, np.float32))
+
+
+def log_psv_na(data):
+    return np.log(np.asarray(data, np.float32))
+
+
+def exp_psv_na(data):
+    return np.exp(np.asarray(data, np.float32))

@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from veles.simd_tpu.utils.config import resolve_simd
+from veles.simd_tpu.utils.config import get_config, resolve_simd
 
 __all__ = [
     "matrix_add", "matrix_sub", "matrix_multiply",
@@ -53,19 +53,20 @@ def _sub(a, b):
 @functools.partial(jax.jit, static_argnames=("fast",))
 def _matmul(a, b, fast=False):
     if fast:
-        return jnp.dot(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
-                       preferred_element_type=jnp.float32)
-    return jnp.dot(a, b, precision=jax.lax.Precision.HIGHEST)
+        return jnp.matmul(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32)
+    return jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
 
 
 @functools.partial(jax.jit, static_argnames=("fast",))
 def _matmul_t(a, bt, fast=False):
+    # batched "[..., h1, w] @ [..., h2, w]^T" — contract the last dims
     if fast:
-        return jax.lax.dot_general(
-            a.astype(jnp.bfloat16), bt.astype(jnp.bfloat16),
-            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-    return jax.lax.dot_general(a, bt, (((1,), (1,)), ((), ())),
-                               precision=jax.lax.Precision.HIGHEST)
+        return jnp.einsum("...ij,...kj->...ik",
+                          a.astype(jnp.bfloat16), bt.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32)
+    return jnp.einsum("...ij,...kj->...ik", a, bt,
+                      precision=jax.lax.Precision.HIGHEST)
 
 
 @jax.jit
@@ -87,12 +88,13 @@ def matrix_sub_novec(m1, m2):
 
 def matrix_multiply_novec(m1, m2):
     """``src/matrix.c:53-65`` triple loop, f32 accumulate."""
-    return np.asarray(m1, np.float32) @ np.asarray(m2, np.float32)
+    return np.matmul(np.asarray(m1, np.float32), np.asarray(m2, np.float32))
 
 
 def matrix_multiply_transposed_novec(m1, m2t):
     """``src/matrix.c:67-80``."""
-    return np.asarray(m1, np.float32) @ np.asarray(m2t, np.float32).T
+    return np.einsum("...ij,...kj->...ik", np.asarray(m1, np.float32),
+                     np.asarray(m2t, np.float32))
 
 
 def matrix_vector_multiply_novec(m, v):
@@ -102,6 +104,8 @@ def matrix_vector_multiply_novec(m, v):
 # ---- public dispatching API ----------------------------------------------
 
 def _check_2d(name, *ms):
+    if not get_config().check_arguments:
+        return
     for m in ms:
         if m.ndim < 2:
             raise ValueError(f"{name}: expected >=2D matrices, got {m.ndim}D")

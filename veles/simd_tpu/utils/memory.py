@@ -64,13 +64,15 @@ def zeropadding(data, new_length: int | None = None):
 
 
 def zeropadding_ex(data, additional_length: int):
-    """Like :func:`zeropadding` with extra zero tail
-    (``src/memory.c:131-146``)."""
+    """Like :func:`zeropadding` with extra zero tail beyond the reported
+    length (``src/memory.c:129-142``: the C version allocates
+    ``nl + additionalLength`` floats but writes ``*newLength = nl``, so the
+    returned length excludes the extra tail — preserved here)."""
     xp = _ns(data)
     n = data.shape[-1]
     nl = zeropadding_length(n)
     pad = [(0, 0)] * (data.ndim - 1) + [(0, nl + int(additional_length) - n)]
-    return xp.pad(data, pad), nl + int(additional_length)
+    return xp.pad(data, pad), nl
 
 
 def rmemcpyf(data):
