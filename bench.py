@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Benchmark harness: reference workloads on the TPU backend.
+
+Measures the five BASELINE.md configs (the reference's benchmark workloads,
+``tests/benchmark.inc`` pattern) on the default JAX device and prints ONE
+JSON line for the headline metric — the 1M-point convolution in
+Msamples/s (BASELINE.json configs[3], the flagship long-signal path) —
+with ``vs_baseline`` = speedup over the single-threaded CPU oracle
+(NumPy, the reference's ``*_na`` twin) measured in the same process.
+
+Full per-config results go to BENCH_DETAILS.json.
+
+Usage:  python bench.py           # one JSON line on stdout
+        python bench.py --all     # pretty table of every config
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _time(fn, *, warmup=2, repeats=5):
+    """Best-of-N wall time of fn() (fn must block until done)."""
+    for _ in range(warmup):
+        fn()
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_elementwise(rng):
+    """Config 1: f32 add/mul + int16->float, N=4096 (batched to fill the
+    chip: 4096 signals of 4096 — per-op timing at N=4096 alone measures
+    dispatch, not the VPU)."""
+    import jax
+    import jax.numpy as jnp
+
+    from veles.simd_tpu.ops import arithmetic as ar
+
+    n = 4096
+    batch = 4096
+    a_np = rng.randn(batch, n).astype(np.float32)
+    b_np = rng.randn(batch, n).astype(np.float32)
+    i16 = rng.randint(-3000, 3000, (batch, n)).astype(np.int16)
+    a, b = jnp.asarray(a_np), jnp.asarray(b_np)
+    i16j = jnp.asarray(i16)
+
+    fused = jax.jit(lambda a, b, i: (a + b) * ar._int16_to_float(i))
+    t = _time(lambda: fused(a, b, i16j).block_until_ready())
+    elems = batch * n
+    t_base = _time(
+        lambda: (a_np + b_np) * i16.astype(np.float32), repeats=3)
+    return {"metric": "elementwise add*mul*convert", "unit": "Melem/s",
+            "value": elems / t / 1e6, "baseline": elems / t_base / 1e6}
+
+
+def bench_mathfun(rng):
+    """Config 2: sin/cos/log/exp on 1M floats."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 1 << 20
+    x_np = np.abs(rng.randn(n).astype(np.float32)) + 0.1
+    x = jnp.asarray(x_np)
+    fused = jax.jit(
+        lambda v: jnp.sin(v) + jnp.cos(v) + jnp.log(v) + jnp.exp(-v))
+    t = _time(lambda: fused(x).block_until_ready())
+    t_base = _time(
+        lambda: np.sin(x_np) + np.cos(x_np) + np.log(x_np) + np.exp(-x_np),
+        repeats=3)
+    # 4 transcendentals per element
+    return {"metric": "sin+cos+log+exp 1M floats", "unit": "Msamples/s",
+            "value": 4 * n / t / 1e6, "baseline": 4 * n / t_base / 1e6}
+
+
+def bench_sgemm(rng):
+    """Config 3: sgemm 512x512 (+ a gemv) in GFLOP/s."""
+    import jax.numpy as jnp
+
+    from veles.simd_tpu.ops import matrix as mx
+
+    n = 512
+    a_np = rng.randn(n, n).astype(np.float32)
+    b_np = rng.randn(n, n).astype(np.float32)
+    a, b = jnp.asarray(a_np), jnp.asarray(b_np)
+    t = _time(lambda: mx._matmul(a, b).block_until_ready())
+    flops = 2 * n ** 3
+    t_base = _time(lambda: mx.matrix_multiply_novec(a_np, b_np), repeats=3)
+    return {"metric": "sgemm 512", "unit": "GFLOP/s",
+            "value": flops / t / 1e9, "baseline": flops / t_base / 1e9}
+
+
+def bench_convolve_1m(rng):
+    """Config 4 (headline): 1M-point convolution, 2047-tap filter,
+    overlap-save vs the NumPy-FFT oracle (the strongest CPU formulation
+    available — np.convolve direct form would be ~100x slower still)."""
+    import jax.numpy as jnp
+
+    from veles.simd_tpu.ops import convolve as cv
+
+    n, k = 1 << 20, 2047
+    x = rng.randn(n).astype(np.float32)
+    h = rng.randn(k).astype(np.float32)
+    handle = cv.convolve_overlap_save_initialize(n, k)
+    xd, hd = jnp.asarray(x), jnp.asarray(h)  # device-resident: measure the
+    t = _time(lambda: cv.convolve_overlap_save(  # chip, not the PCIe/tunnel
+        handle, xd, hd, simd=True).block_until_ready())
+    t_base = _time(lambda: cv._conv_overlap_save_na(
+        x, h, handle.block_length), repeats=2)
+    return {"metric": "convolve 1M x 2047 overlap-save",
+            "unit": "Msamples/s",
+            "value": n / t / 1e6, "baseline": n / t_base / 1e6}
+
+
+def bench_dwt(rng):
+    """Config 5: DWT daub8 + SWT sym8, batch of 512 x 4096 signals."""
+    from veles.simd_tpu.ops import wavelet as wv
+    from veles.simd_tpu.ops.wavelet_coeffs import WaveletType
+
+    import jax.numpy as jnp
+
+    batch, n = 512, 4096
+    x = rng.randn(batch, n).astype(np.float32)
+    xd = jnp.asarray(x)
+    run = lambda: wv.wavelet_apply(
+        WaveletType.DAUBECHIES, 8, wv.ExtensionType.PERIODIC, xd,
+        simd=True)[0].block_until_ready()
+    t = _time(run)
+    t_base = _time(lambda: wv.wavelet_apply_na(
+        WaveletType.DAUBECHIES, 8, wv.ExtensionType.PERIODIC, x),
+        repeats=2)
+    samples = batch * n
+    return {"metric": "DWT daub8 512x4096", "unit": "Msamples/s",
+            "value": samples / t / 1e6, "baseline": samples / t_base / 1e6}
+
+
+def main():
+    import jax
+
+    rng = np.random.RandomState(0)
+    configs = [bench_elementwise, bench_mathfun, bench_sgemm,
+               bench_convolve_1m, bench_dwt]
+    results = []
+    for fn in configs:
+        r = fn(rng)
+        r["vs_baseline"] = r["value"] / r["baseline"]
+        r["device"] = str(jax.devices()[0])
+        results.append(r)
+        if "--all" in sys.argv:
+            print(f"{r['metric']:36s} {r['value']:12.1f} {r['unit']:11s} "
+                  f"(cpu-oracle {r['baseline']:10.1f}, "
+                  f"x{r['vs_baseline']:.1f})", file=sys.stderr)
+
+    with open("BENCH_DETAILS.json", "w") as f:
+        json.dump(results, f, indent=2)
+
+    head = next(r for r in results
+                if r["metric"].startswith("convolve 1M"))
+    print(json.dumps({
+        "metric": head["metric"],
+        "value": round(head["value"], 2),
+        "unit": head["unit"],
+        "vs_baseline": round(head["vs_baseline"], 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

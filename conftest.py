@@ -9,10 +9,21 @@ compile and execute without TPU hardware, mirroring how the driver validates
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU even when the environment pins another platform (e.g. the
+# axon TPU tunnel sets JAX_PLATFORMS=axon globally): the suite needs the
+# 8-device virtual mesh, and per-op TPU validation happens in bench.py /
+# verification drives instead.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# The axon TPU plugin (registered by a sitecustomize on PYTHONPATH) pins
+# the platform before conftest runs; the env var alone doesn't win. Force
+# the config too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,241 @@
+/* test_veles_simd.c — C test suite for the native ABI.
+ *
+ * Drives libveles_simd.so exactly the way a C user of the reference
+ * library would (the reference's gtest suites are the model;
+ * a dependency-free assert harness stands in for gtest).  Run via
+ * `make -C csrc check` or tests/test_cshim.py.
+ */
+
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "veles_simd.h"
+
+static int g_failures = 0;
+static int g_checks = 0;
+
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    g_checks++;                                                           \
+    if (!(cond)) {                                                        \
+      g_failures++;                                                       \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);     \
+    }                                                                     \
+  } while (0)
+
+#define CHECK_NEAR(a, b, tol)                                             \
+  do {                                                                    \
+    g_checks++;                                                           \
+    double _a = (a), _b = (b);                                            \
+    if (fabs(_a - _b) > (tol)) {                                          \
+      g_failures++;                                                       \
+      fprintf(stderr, "FAIL %s:%d: |%g - %g| > %g\n", __FILE__, __LINE__, \
+              _a, _b, (double)(tol));                                     \
+    }                                                                     \
+  } while (0)
+
+static void test_memory(void) {
+  float *buf = mallocf(16);
+  CHECK(buf != NULL);
+  CHECK(((uintptr_t)buf % 64) == 0);
+  memsetf(buf, 2.5f, 16);
+  CHECK(buf[15] == 2.5f);
+  CHECK(align_complement_f32(buf) == 0);
+  free(buf);
+
+  CHECK(next_highest_power_of_2(100) == 128);
+  CHECK(next_highest_power_of_2(128) == 128);
+  CHECK(next_highest_power_of_2(1) == 1);
+
+  float data[5] = {1, 2, 3, 4, 5};
+  size_t nl = 0;
+  float *padded = zeropadding(data, 5, &nl);
+  CHECK(nl == 16); /* 2 * next pow2 > 5 */
+  CHECK(padded[4] == 5.f && padded[5] == 0.f);
+  free(padded);
+
+  float rev[5];
+  rmemcpyf(rev, data, 5);
+  CHECK(rev[0] == 5.f && rev[4] == 1.f);
+
+  float cdata[6] = {1, 2, 3, 4, 5, 6}; /* 3 complex samples */
+  float crev[6];
+  crmemcpyf(crev, cdata, 6);
+  CHECK(crev[0] == 5.f && crev[1] == 6.f && crev[4] == 1.f && crev[5] == 2.f);
+}
+
+static void test_matrix(void) {
+  const float m1[4] = {1, 2, 3, 4};         /* 2x2 row-major */
+  const float m2[4] = {5, 6, 7, 8};
+  float res[4] = {0};
+
+  CHECK(matrix_multiply(1, m1, m2, 2, 2, 2, 2, res) == 0);
+  CHECK_NEAR(res[0], 19.f, 1e-4);
+  CHECK_NEAR(res[3], 50.f, 1e-4);
+
+  /* oracle path must agree */
+  float res_na[4] = {0};
+  CHECK(matrix_multiply(0, m1, m2, 2, 2, 2, 2, res_na) == 0);
+  for (int i = 0; i < 4; i++) {
+    CHECK_NEAR(res[i], res_na[i], 1e-4);
+  }
+
+  CHECK(matrix_add(1, m1, m2, 2, 2, res) == 0);
+  CHECK_NEAR(res[2], 10.f, 1e-6);
+
+  /* transposed-B variant: res = m1 . m2t^T, here m2t == m2 (2x2) */
+  CHECK(matrix_multiply_transposed(1, m1, m2, 2, 2, 2, 2, res) == 0);
+  CHECK_NEAR(res[0], 1 * 5 + 2 * 6, 1e-4);
+
+  /* contract violation surfaces as an error, not a crash */
+  CHECK(matrix_multiply(1, m1, m2, 3, 2, 2, 2, res) != 0);
+  CHECK(strlen(veles_simd_last_error()) > 0);
+}
+
+static void test_convolve(void) {
+  const float x[3] = {1, 2, 3};
+  const float h[2] = {4, 5};
+  float res[4] = {0};
+  CHECK(convolve_simd(1, x, 3, h, 2, res) == 0);
+  CHECK_NEAR(res[0], 4.f, 1e-5);
+  CHECK_NEAR(res[1], 13.f, 1e-5);
+  CHECK_NEAR(res[2], 22.f, 1e-5);
+  CHECK_NEAR(res[3], 15.f, 1e-5);
+
+  /* handle API, auto-select */
+  size_t n = 1000, k = 31;
+  float *xs = mallocf(n), *hs = mallocf(k), *out = mallocf(n + k - 1),
+        *want = mallocf(n + k - 1);
+  for (size_t i = 0; i < n; i++) xs[i] = sinf(i * 0.01f);
+  for (size_t i = 0; i < k; i++) hs[i] = 1.f / (float)k;
+  VelesConvolutionHandle *handle = convolve_initialize(n, k, 0);
+  CHECK(handle != NULL);
+  CHECK(convolve(handle, xs, hs, out) == 0);
+  convolve_finalize(handle);
+  CHECK(convolve_simd(0, xs, n, hs, k, want) == 0); /* oracle */
+  for (size_t i = 0; i < n + k - 1; i += 97) {
+    CHECK_NEAR(out[i], want[i], 1e-3);
+  }
+
+  /* cross-correlation of x with itself peaks at zero lag */
+  float xc[5] = {0};
+  const float sig[3] = {1, 2, 3};
+  CHECK(cross_correlate_simd(1, sig, 3, sig, 3, xc) == 0);
+  CHECK_NEAR(xc[2], 14.f, 1e-5); /* 1+4+9 */
+  free(xs); free(hs); free(out); free(want);
+}
+
+static void test_wavelet(void) {
+  CHECK(wavelet_validate_order(WAVELET_TYPE_DAUBECHIES, 8) == 1);
+  CHECK(wavelet_validate_order(WAVELET_TYPE_DAUBECHIES, 7) == 0);
+  CHECK(wavelet_validate_order(WAVELET_TYPE_COIFLET, 12) == 1);
+
+  /* Haar on [1,2,3,4]: lo = {3/sqrt2, 7/sqrt2} */
+  const float src[4] = {1, 2, 3, 4};
+  float hi[2], lo[2];
+  CHECK(wavelet_apply(1, WAVELET_TYPE_DAUBECHIES, 2, EXTENSION_TYPE_PERIODIC,
+                      src, 4, hi, lo) == 0);
+  CHECK_NEAR(lo[0], 3.0 / sqrt(2.0), 1e-5);
+  CHECK_NEAR(lo[1], 7.0 / sqrt(2.0), 1e-5);
+
+  /* XLA-vs-oracle on daub8 */
+  float sig[64], hi8[32], lo8[32], hi8_na[32], lo8_na[32];
+  for (int i = 0; i < 64; i++) sig[i] = cosf(i * 0.3f);
+  CHECK(wavelet_apply(1, WAVELET_TYPE_DAUBECHIES, 8, EXTENSION_TYPE_MIRROR,
+                      sig, 64, hi8, lo8) == 0);
+  CHECK(wavelet_apply(0, WAVELET_TYPE_DAUBECHIES, 8, EXTENSION_TYPE_MIRROR,
+                      sig, 64, hi8_na, lo8_na) == 0);
+  for (int i = 0; i < 32; i++) {
+    CHECK_NEAR(hi8[i], hi8_na[i], 5e-4);
+    CHECK_NEAR(lo8[i], lo8_na[i], 5e-4);
+  }
+
+  /* SWT keeps length */
+  float shi[64], slo[64];
+  CHECK(stationary_wavelet_apply(1, WAVELET_TYPE_SYMLET, 8, 2,
+                                 EXTENSION_TYPE_PERIODIC, sig, 64, shi,
+                                 slo) == 0);
+}
+
+static void test_mathfun(void) {
+  float src[128], res[128];
+  for (int i = 0; i < 128; i++) src[i] = (float)i * 0.1f - 5.f;
+  CHECK(sin_psv(1, src, 128, res) == 0);
+  for (int i = 0; i < 128; i += 17) {
+    CHECK_NEAR(res[i], sinf(src[i]), 1e-5);
+  }
+  CHECK(exp_psv(1, src, 128, res) == 0);
+  CHECK_NEAR(res[50], expf(src[50]), 1e-4);
+}
+
+static void test_normalize(void) {
+  uint8_t plane[16] = {0, 255, 128, 64, 1, 2, 3, 4,
+                       5, 6, 7, 8, 9, 10, 11, 12};
+  float out[16];
+  CHECK(normalize2D(1, plane, 4, 4, 4, out, 4) == 0);
+  CHECK_NEAR(out[0], -1.f, 1e-5);
+  CHECK_NEAR(out[1], 1.f, 1e-5);
+
+  uint8_t mn, mx;
+  CHECK(minmax2D(1, plane, 4, 4, 4, &mn, &mx) == 0);
+  CHECK(mn == 0 && mx == 255);
+
+  float fdata[5] = {3.f, -1.f, 7.f, 0.f, 2.f};
+  float fmn, fmx;
+  CHECK(minmax1D(1, fdata, 5, &fmn, &fmx) == 0);
+  CHECK_NEAR(fmn, -1.f, 1e-6);
+  CHECK_NEAR(fmx, 7.f, 1e-6);
+}
+
+static void test_detect_peaks(void) {
+  float sig[9] = {0, 2, 0, -3, 0, 5, 4, 6, 1};
+  ExtremumPoint *pts = NULL;
+  size_t n = 0;
+  CHECK(detect_peaks(1, sig, 9, kExtremumTypeBoth, &pts, &n) == 0);
+  CHECK(n == 5);
+  CHECK(pts != NULL && pts[0].position == 1 && pts[0].value == 2.f);
+  CHECK(pts[1].position == 3 && pts[1].value == -3.f);
+  free(pts);
+
+  /* flat signal: no peaks, NULL out */
+  float flat[8] = {0};
+  CHECK(detect_peaks(1, flat, 8, kExtremumTypeBoth, &pts, &n) == 0);
+  CHECK(n == 0 && pts == NULL);
+}
+
+static void test_conversions(void) {
+  int16_t i16[4] = {-32768, -1, 0, 32767};
+  float f[4];
+  CHECK(int16_to_float(1, i16, 4, f) == 0);
+  CHECK(f[0] == -32768.f && f[3] == 32767.f);
+
+  float fin[4] = {-1.9f, 0.5f, 70000.f, -70000.f};
+  int16_t i16out[4];
+  CHECK(float_to_int16(1, fin, 4, i16out) == 0);
+  CHECK(i16out[0] == -1);      /* trunc toward zero */
+  CHECK(i16out[2] == 32767);   /* saturate */
+  CHECK(i16out[3] == -32768);
+}
+
+int main(void) {
+  if (veles_simd_init(NULL) != 0) {
+    fprintf(stderr, "init failed: %s\n", veles_simd_last_error());
+    return 2;
+  }
+  printf("backend: %s\n", veles_simd_backend());
+
+  test_memory();
+  test_matrix();
+  test_convolve();
+  test_wavelet();
+  test_mathfun();
+  test_normalize();
+  test_detect_peaks();
+  test_conversions();
+
+  printf("%d checks, %d failures\n", g_checks, g_failures);
+  veles_simd_shutdown();
+  return g_failures == 0 ? 0 : 1;
+}

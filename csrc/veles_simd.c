@@ -1,0 +1,436 @@
+/* veles_simd.c — embedded-CPython bridge to the veles.simd_tpu XLA core.
+ *
+ * Architecture (SURVEY.md §7): the TPU compute path lives in Python/JAX;
+ * this translation unit provides the reference-compatible C ABI
+ * (/root/reference/inc/simd/*.h) by embedding an interpreter and calling
+ * veles/simd_tpu/cshim.py with raw pointers.  Works both as a standalone
+ * embedder (C program links libveles_simd.so) and when loaded inside an
+ * existing Python process (dlopen from ctypes): PyGILState handles both.
+ */
+
+#include "veles_simd.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <Python.h>
+
+static PyObject *g_mod = NULL;        /* veles.simd_tpu.cshim */
+static int g_we_initialized = 0;
+static char g_last_error[4096] = "";
+static char g_backend[64] = "uninitialized";
+
+const char *veles_simd_last_error(void) { return g_last_error; }
+
+static void set_error_from_python(void) {
+  PyObject *type = NULL, *value = NULL, *tb = NULL;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  if (value != NULL) {
+    PyObject *s = PyObject_Str(value);
+    if (s != NULL) {
+      const char *msg = PyUnicode_AsUTF8(s);
+      if (msg != NULL) {
+        snprintf(g_last_error, sizeof(g_last_error), "%s", msg);
+      }
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+int veles_simd_init(const char *repo_root) {
+  if (g_mod != NULL) {
+    return 0;
+  }
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = 1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  const char *root = repo_root;
+  if (root == NULL) {
+    root = getenv("VELES_SIMD_PYROOT");
+  }
+  if (root != NULL) {
+    PyObject *sys_path = PySys_GetObject("path"); /* borrowed */
+    PyObject *p = sys_path ? PyUnicode_FromString(root) : NULL;
+    if (p != NULL) {
+      PyList_Insert(sys_path, 0, p);
+      Py_DECREF(p);
+    }
+  }
+  g_mod = PyImport_ImportModule("veles.simd_tpu.cshim");
+  if (g_mod == NULL) {
+    set_error_from_python();
+    goto done;
+  }
+  {
+    PyObject *desc = PyObject_CallMethod(g_mod, "backend_description", NULL);
+    if (desc != NULL) {
+      const char *s = PyUnicode_AsUTF8(desc);
+      if (s != NULL) {
+        snprintf(g_backend, sizeof(g_backend), "%s", s);
+      }
+      Py_DECREF(desc);
+    } else {
+      PyErr_Clear();
+    }
+  }
+  rc = 0;
+done:
+  PyGILState_Release(gil);
+  return rc;
+}
+
+void veles_simd_shutdown(void) {
+  if (g_mod != NULL) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    Py_CLEAR(g_mod);
+    PyGILState_Release(gil);
+  }
+  if (g_we_initialized && Py_IsInitialized()) {
+    Py_Finalize();
+    g_we_initialized = 0;
+  }
+}
+
+const char *veles_simd_backend(void) { return g_backend; }
+
+/* Call cshim.<method>(<args per format>) -> PyObject* (new ref), or NULL. */
+static PyObject *shim_call(const char *method, const char *format, ...) {
+  if (g_mod == NULL && veles_simd_init(NULL) != 0) {
+    return NULL;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject *result = NULL;
+  va_list va;
+  va_start(va, format);
+  PyObject *args = Py_VaBuildValue(format, va);
+  va_end(va);
+  if (args != NULL) {
+    PyObject *fn = PyObject_GetAttrString(g_mod, method);
+    if (fn != NULL) {
+      result = PyObject_CallObject(fn, args);
+      Py_DECREF(fn);
+    }
+    Py_DECREF(args);
+  }
+  if (result == NULL) {
+    set_error_from_python();
+  }
+  PyGILState_Release(gil);
+  return result;
+}
+
+/* Run a void-ish shim method; 0 on success. */
+static int shim_run(const char *method, const char *format, ...) {
+  if (g_mod == NULL && veles_simd_init(NULL) != 0) {
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  va_list va;
+  va_start(va, format);
+  PyObject *args = Py_VaBuildValue(format, va);
+  va_end(va);
+  if (args != NULL) {
+    PyObject *fn = PyObject_GetAttrString(g_mod, method);
+    if (fn != NULL) {
+      PyObject *result = PyObject_CallObject(fn, args);
+      if (result != NULL) {
+        rc = 0;
+        Py_DECREF(result);
+      }
+      Py_DECREF(fn);
+    }
+    Py_DECREF(args);
+  }
+  if (rc != 0) {
+    set_error_from_python();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+#define PTR(p) ((unsigned long long)(uintptr_t)(p))
+
+/* ---- matrix ----------------------------------------------------------- */
+
+int matrix_add(int simd, const float *m1, const float *m2,
+               size_t w, size_t h, float *res) {
+  return shim_run("matrix_add", "(iKKKkk)", simd, PTR(m1), PTR(m2), PTR(res),
+                  (unsigned long)w, (unsigned long)h);
+}
+
+int matrix_sub(int simd, const float *m1, const float *m2,
+               size_t w, size_t h, float *res) {
+  return shim_run("matrix_sub", "(iKKKkk)", simd, PTR(m1), PTR(m2), PTR(res),
+                  (unsigned long)w, (unsigned long)h);
+}
+
+int matrix_multiply(int simd, const float *m1, const float *m2,
+                    size_t w1, size_t h1, size_t w2, size_t h2, float *res) {
+  return shim_run("matrix_multiply", "(iKKKkkkk)", simd, PTR(m1), PTR(m2),
+                  PTR(res), (unsigned long)w1, (unsigned long)h1,
+                  (unsigned long)w2, (unsigned long)h2);
+}
+
+int matrix_multiply_transposed(int simd, const float *m1, const float *m2,
+                               size_t w1, size_t h1, size_t w2, size_t h2,
+                               float *res) {
+  return shim_run("matrix_multiply_transposed", "(iKKKkkkk)", simd, PTR(m1),
+                  PTR(m2), PTR(res), (unsigned long)w1, (unsigned long)h1,
+                  (unsigned long)w2, (unsigned long)h2);
+}
+
+/* ---- convolve / correlate --------------------------------------------- */
+
+struct VelesConvolutionHandle {
+  long id;
+  size_t x_length;
+  size_t h_length;
+};
+
+static VelesConvolutionHandle *conv_init(size_t x_length, size_t h_length,
+                                         int algorithm, int reverse) {
+  PyObject *r = shim_call("convolve_initialize", "(kkii)",
+                          (unsigned long)x_length, (unsigned long)h_length,
+                          algorithm, reverse);
+  if (r == NULL) {
+    return NULL;
+  }
+  long id = PyLong_AsLong(r);
+  Py_DECREF(r);
+  if (id <= 0) {
+    return NULL;
+  }
+  VelesConvolutionHandle *handle = malloc(sizeof(*handle));
+  if (handle == NULL) {
+    return NULL;
+  }
+  handle->id = id;
+  handle->x_length = x_length;
+  handle->h_length = h_length;
+  return handle;
+}
+
+VelesConvolutionHandle *convolve_initialize(size_t x_length, size_t h_length,
+                                            int algorithm) {
+  return conv_init(x_length, h_length, algorithm, 0);
+}
+
+VelesConvolutionHandle *cross_correlate_initialize(size_t x_length,
+                                                   size_t h_length,
+                                                   int algorithm) {
+  return conv_init(x_length, h_length, algorithm, 1);
+}
+
+int convolve(VelesConvolutionHandle *handle, const float *x, const float *h,
+             float *result) {
+  if (handle == NULL) {
+    return -1;
+  }
+  return shim_run("convolve_run", "(lKKK)", handle->id, PTR(x), PTR(h),
+                  PTR(result));
+}
+
+int cross_correlate(VelesConvolutionHandle *handle, const float *x,
+                    const float *h, float *result) {
+  return convolve(handle, x, h, result);
+}
+
+void convolve_finalize(VelesConvolutionHandle *handle) {
+  if (handle != NULL) {
+    shim_run("convolve_finalize", "(l)", handle->id);
+    free(handle);
+  }
+}
+
+void cross_correlate_finalize(VelesConvolutionHandle *handle) {
+  convolve_finalize(handle);
+}
+
+int convolve_simd(int simd, const float *x, size_t x_length,
+                  const float *h, size_t h_length, float *result) {
+  return shim_run("convolve_simd", "(iKkKkK)", simd, PTR(x),
+                  (unsigned long)x_length, PTR(h), (unsigned long)h_length,
+                  PTR(result));
+}
+
+int cross_correlate_simd(int simd, const float *x, size_t x_length,
+                         const float *h, size_t h_length, float *result) {
+  return shim_run("cross_correlate_simd", "(iKkKkK)", simd, PTR(x),
+                  (unsigned long)x_length, PTR(h), (unsigned long)h_length,
+                  PTR(result));
+}
+
+/* ---- wavelet ---------------------------------------------------------- */
+
+int wavelet_validate_order(WaveletType type, int order) {
+  PyObject *r = shim_call("wavelet_validate_order", "(ii)", (int)type, order);
+  if (r == NULL) {
+    return 0;
+  }
+  int valid = PyObject_IsTrue(r);
+  Py_DECREF(r);
+  return valid == 1;
+}
+
+int wavelet_apply(int simd, WaveletType type, int order, ExtensionType ext,
+                  const float *src, size_t length,
+                  float *desthi, float *destlo) {
+  return shim_run("wavelet_apply", "(iiiiKkKK)", simd, (int)type, order,
+                  (int)ext, PTR(src), (unsigned long)length, PTR(desthi),
+                  PTR(destlo));
+}
+
+int stationary_wavelet_apply(int simd, WaveletType type, int order, int level,
+                             ExtensionType ext, const float *src,
+                             size_t length, float *desthi, float *destlo) {
+  return shim_run("stationary_wavelet_apply", "(iiiiiKkKK)", simd, (int)type,
+                  order, level, (int)ext, PTR(src), (unsigned long)length,
+                  PTR(desthi), PTR(destlo));
+}
+
+/* ---- mathfun ---------------------------------------------------------- */
+
+static int psv(const char *name, int simd, const float *src, size_t length,
+               float *res) {
+  return shim_run("mathfun", "(siKkK)", name, simd, PTR(src),
+                  (unsigned long)length, PTR(res));
+}
+
+int sin_psv(int simd, const float *src, size_t length, float *res) {
+  return psv("sin", simd, src, length, res);
+}
+int cos_psv(int simd, const float *src, size_t length, float *res) {
+  return psv("cos", simd, src, length, res);
+}
+int log_psv(int simd, const float *src, size_t length, float *res) {
+  return psv("log", simd, src, length, res);
+}
+int exp_psv(int simd, const float *src, size_t length, float *res) {
+  return psv("exp", simd, src, length, res);
+}
+
+/* ---- normalize -------------------------------------------------------- */
+
+int normalize2D(int simd, const uint8_t *src, size_t src_stride,
+                size_t width, size_t height, float *dst, size_t dst_stride) {
+  return shim_run("normalize2D", "(iKkkkKk)", simd, PTR(src),
+                  (unsigned long)src_stride, (unsigned long)width,
+                  (unsigned long)height, PTR(dst),
+                  (unsigned long)dst_stride);
+}
+
+int minmax2D(int simd, const uint8_t *src, size_t src_stride,
+             size_t width, size_t height, uint8_t *min, uint8_t *max) {
+  PyObject *r = shim_call("minmax2D", "(iKkkk)", simd, PTR(src),
+                          (unsigned long)src_stride, (unsigned long)width,
+                          (unsigned long)height);
+  if (r == NULL) {
+    return -1;
+  }
+  long mn, mx;
+  if (!PyArg_ParseTuple(r, "ll", &mn, &mx)) {
+    set_error_from_python();
+    Py_DECREF(r);
+    return -1;
+  }
+  Py_DECREF(r);
+  if (min != NULL) {
+    *min = (uint8_t)mn;
+  }
+  if (max != NULL) {
+    *max = (uint8_t)mx;
+  }
+  return 0;
+}
+
+int minmax1D(int simd, const float *src, size_t length,
+             float *min, float *max) {
+  PyObject *r = shim_call("minmax1D", "(iKk)", simd, PTR(src),
+                          (unsigned long)length);
+  if (r == NULL) {
+    return -1;
+  }
+  double mn, mx;
+  if (!PyArg_ParseTuple(r, "dd", &mn, &mx)) {
+    set_error_from_python();
+    Py_DECREF(r);
+    return -1;
+  }
+  Py_DECREF(r);
+  if (min != NULL) {
+    *min = (float)mn;
+  }
+  if (max != NULL) {
+    *max = (float)mx;
+  }
+  return 0;
+}
+
+/* ---- detect_peaks ----------------------------------------------------- */
+
+int detect_peaks(int simd, const float *data, size_t size, ExtremumType type,
+                 ExtremumPoint **results, size_t *results_length) {
+  if (results == NULL || results_length == NULL) {
+    return -1;
+  }
+  *results = NULL;
+  *results_length = 0;
+  PyObject *r = shim_call("detect_peaks", "(iKki)", simd, PTR(data),
+                          (unsigned long)size, (int)type);
+  if (r == NULL) {
+    return -1;
+  }
+  PyObject *pos = NULL, *vals = NULL;
+  int rc = -1;
+  if (PyArg_ParseTuple(r, "OO", &pos, &vals)) {
+    Py_ssize_t n = PyList_Size(pos);
+    if (n > 0) {
+      ExtremumPoint *pts = malloc((size_t)n * sizeof(*pts));
+      if (pts != NULL) {
+        for (Py_ssize_t i = 0; i < n; i++) {
+          pts[i].position = (int)PyLong_AsLong(PyList_GetItem(pos, i));
+          pts[i].value = (float)PyFloat_AsDouble(PyList_GetItem(vals, i));
+        }
+        *results = pts;
+        *results_length = (size_t)n;
+        rc = 0;
+      }
+    } else {
+      rc = 0; /* no peaks: NULL + 0, reference behavior */
+    }
+  } else {
+    set_error_from_python();
+  }
+  Py_DECREF(r);
+  return rc;
+}
+
+/* ---- conversions ------------------------------------------------------ */
+
+static int convert(const char *name, int simd, const void *src, size_t length,
+                   void *dst) {
+  return shim_run("convert", "(siKkK)", name, simd, PTR(src),
+                  (unsigned long)length, PTR(dst));
+}
+
+int int16_to_float(int simd, const int16_t *src, size_t length, float *dst) {
+  return convert("int16_to_float", simd, src, length, dst);
+}
+int float_to_int16(int simd, const float *src, size_t length, int16_t *dst) {
+  return convert("float_to_int16", simd, src, length, dst);
+}
+int int32_to_float(int simd, const int32_t *src, size_t length, float *dst) {
+  return convert("int32_to_float", simd, src, length, dst);
+}
+int float_to_int32(int simd, const float *src, size_t length, int32_t *dst) {
+  return convert("float_to_int32", simd, src, length, dst);
+}

@@ -1,0 +1,159 @@
+/* veles_simd.h — C API of the TPU-native veles.simd rebuild.
+ *
+ * Mirrors the reference's public header surface
+ * (/root/reference/inc/simd/{matrix,convolve,correlate,wavelet,normalize,
+ * detect_peaks,mathfun,memory}.h) so C callers of the original library can
+ * relink against the TPU backend: the compute path dispatches through an
+ * embedded CPython interpreter into veles.simd_tpu (JAX/XLA), per the
+ * SURVEY.md §7 target architecture.  Pure-host helpers (aligned alloc,
+ * zero padding, reversed copies) are implemented natively in C with no
+ * Python involvement.
+ *
+ * Every compute entry point keeps the reference's `int simd` flag:
+ * nonzero -> the XLA backend (TPU when available), zero -> the NumPy
+ * oracle twin.  All functions return 0 on success, nonzero on error
+ * (the reference used assert(); a linkable library wants error codes).
+ */
+
+#ifndef VELES_SIMD_H_
+#define VELES_SIMD_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- runtime ---------------------------------------------------------- */
+
+/* Initialize the embedded interpreter + backend. Optional: every compute
+ * call bootstraps lazily. `repo_root` may be NULL (auto-detect from
+ * VELES_SIMD_PYROOT or the shared object's location). */
+int veles_simd_init(const char *repo_root);
+void veles_simd_shutdown(void);
+/* Human-readable description of the active backend ("xla:tpu", "xla:cpu"). */
+const char *veles_simd_backend(void);
+/* Last error message (thread-unsafe convenience, like dlerror()). */
+const char *veles_simd_last_error(void);
+
+/* ---- matrix (inc/simd/matrix.h:40-89) --------------------------------- */
+
+int matrix_add(int simd, const float *m1, const float *m2,
+               size_t w, size_t h, float *res);
+int matrix_sub(int simd, const float *m1, const float *m2,
+               size_t w, size_t h, float *res);
+int matrix_multiply(int simd, const float *m1, const float *m2,
+                    size_t w1, size_t h1, size_t w2, size_t h2, float *res);
+int matrix_multiply_transposed(int simd, const float *m1, const float *m2,
+                               size_t w1, size_t h1, size_t w2, size_t h2,
+                               float *res);
+
+/* ---- convolve / correlate (inc/simd/convolve.h, correlate.h) ---------- */
+
+typedef struct VelesConvolutionHandle VelesConvolutionHandle;
+
+/* algorithm: 0 = auto (reference convolve_initialize heuristic re-derived
+ * for TPU), 1 = brute force, 2 = FFT, 3 = overlap-save. */
+VelesConvolutionHandle *convolve_initialize(size_t x_length, size_t h_length,
+                                            int algorithm);
+int convolve(VelesConvolutionHandle *handle, const float *x, const float *h,
+             float *result);
+void convolve_finalize(VelesConvolutionHandle *handle);
+int convolve_simd(int simd, const float *x, size_t x_length,
+                  const float *h, size_t h_length, float *result);
+
+VelesConvolutionHandle *cross_correlate_initialize(size_t x_length,
+                                                   size_t h_length,
+                                                   int algorithm);
+int cross_correlate(VelesConvolutionHandle *handle, const float *x,
+                    const float *h, float *result);
+void cross_correlate_finalize(VelesConvolutionHandle *handle);
+int cross_correlate_simd(int simd, const float *x, size_t x_length,
+                         const float *h, size_t h_length, float *result);
+
+/* ---- wavelet (inc/simd/wavelet.h) ------------------------------------- */
+
+typedef enum {
+  WAVELET_TYPE_DAUBECHIES = 0,
+  WAVELET_TYPE_COIFLET = 1,
+  WAVELET_TYPE_SYMLET = 2
+} WaveletType;
+
+typedef enum {
+  EXTENSION_TYPE_PERIODIC = 0,
+  EXTENSION_TYPE_MIRROR = 1,
+  EXTENSION_TYPE_CONSTANT = 2,
+  EXTENSION_TYPE_ZERO = 3
+} ExtensionType;
+
+int wavelet_validate_order(WaveletType type, int order);
+/* desthi/destlo must hold length/2 floats (decimated DWT). */
+int wavelet_apply(int simd, WaveletType type, int order, ExtensionType ext,
+                  const float *src, size_t length,
+                  float *desthi, float *destlo);
+/* desthi/destlo must hold `length` floats (stationary/undecimated). */
+int stationary_wavelet_apply(int simd, WaveletType type, int order, int level,
+                             ExtensionType ext, const float *src,
+                             size_t length, float *desthi, float *destlo);
+
+/* ---- mathfun (inc/simd/mathfun.h:142-204) ----------------------------- */
+
+int sin_psv(int simd, const float *src, size_t length, float *res);
+int cos_psv(int simd, const float *src, size_t length, float *res);
+int log_psv(int simd, const float *src, size_t length, float *res);
+int exp_psv(int simd, const float *src, size_t length, float *res);
+
+/* ---- normalize (inc/simd/normalize.h:48-90) --------------------------- */
+
+int normalize2D(int simd, const uint8_t *src, size_t src_stride,
+                size_t width, size_t height, float *dst, size_t dst_stride);
+int minmax2D(int simd, const uint8_t *src, size_t src_stride,
+             size_t width, size_t height, uint8_t *min, uint8_t *max);
+int minmax1D(int simd, const float *src, size_t length,
+             float *min, float *max);
+
+/* ---- detect_peaks (inc/simd/detect_peaks.h:38-63) --------------------- */
+
+typedef enum {
+  kExtremumTypeMaximum = 1,
+  kExtremumTypeMinimum = 2,
+  kExtremumTypeBoth = 3
+} ExtremumType;
+
+typedef struct {
+  int position;
+  float value;
+} ExtremumPoint;
+
+/* *results is malloc()ed (free() it); NULL when no peaks found. */
+int detect_peaks(int simd, const float *data, size_t size, ExtremumType type,
+                 ExtremumPoint **results, size_t *results_length);
+
+/* ---- arithmetic conversions (inc/simd/arithmetic.h) ------------------- */
+
+int int16_to_float(int simd, const int16_t *src, size_t length, float *dst);
+int float_to_int16(int simd, const float *src, size_t length, int16_t *dst);
+int int32_to_float(int simd, const int32_t *src, size_t length, float *dst);
+int float_to_int32(int simd, const float *src, size_t length, int32_t *dst);
+
+/* ---- memory (inc/simd/memory.h:40-179) — pure C, no Python ------------ */
+
+void *malloc_aligned(size_t size);
+void *malloc_aligned_offset(size_t size, int offset);
+float *mallocf(size_t length);
+void memsetf(float *ptr, float value, size_t length);
+/* Returns a newly mallocf()ed buffer of *new_length floats. */
+float *zeropadding(const float *data, size_t length, size_t *new_length);
+float *zeropaddingex(const float *data, size_t length, size_t *new_length,
+                     size_t additional_length);
+float *rmemcpyf(float *dest, const float *src, size_t length);
+float *crmemcpyf(float *dest, const float *src, size_t length);
+int next_highest_power_of_2(int value);
+int align_complement_f32(const float *ptr);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* VELES_SIMD_H_ */

@@ -1,0 +1,108 @@
+/* veles_simd_memory.c — native memory/layout helpers.
+ *
+ * Rebuild of /root/reference/src/memory.c semantics in pure C (no Python):
+ * 64-byte aligned allocation, float fill, FFT zero-padding sizes, reversed
+ * (complex-pairwise) copies, power-of-2 helper.  On the device side XLA
+ * owns layout, so align_complement_f32 is always 0; these helpers serve
+ * host-side staging buffers for the C ABI.
+ */
+
+#include "veles_simd.h"
+
+#include <stdlib.h>
+#include <string.h>
+
+#define VELES_ALIGNMENT 64
+
+void *malloc_aligned(size_t size) {
+  void *ptr = NULL;
+  if (posix_memalign(&ptr, VELES_ALIGNMENT, size) != 0) {
+    return NULL;
+  }
+  return ptr;
+}
+
+void *malloc_aligned_offset(size_t size, int offset) {
+  /* reference semantics (src/memory.c:71-75): aligned base, returned
+   * pointer shifted by offset; caller frees (ptr - offset). */
+  char *base = malloc_aligned(size + (size_t)offset);
+  if (base == NULL) {
+    return NULL;
+  }
+  return base + offset;
+}
+
+float *mallocf(size_t length) {
+  return malloc_aligned(length * sizeof(float));
+}
+
+void memsetf(float *ptr, float value, size_t length) {
+  for (size_t i = 0; i < length; i++) {
+    ptr[i] = value;
+  }
+}
+
+int next_highest_power_of_2(int value) {
+  /* inc/simd/arithmetic.h:1227-1235 bit-smear */
+  if (value <= 1) {
+    return 1;
+  }
+  value--;
+  value |= value >> 1;
+  value |= value >> 2;
+  value |= value >> 4;
+  value |= value >> 8;
+  value |= value >> 16;
+  return value + 1;
+}
+
+static size_t zeropadding_length(size_t length) {
+  /* src/memory.c:131-137: 2 x the next power of 2 > length */
+  size_t nl = length;
+  int log = 2;
+  while (nl) {
+    nl >>= 1;
+    log++;
+  }
+  return (size_t)1 << (log - 1);
+}
+
+float *zeropadding(const float *data, size_t length, size_t *new_length) {
+  return zeropaddingex(data, length, new_length, 0);
+}
+
+float *zeropaddingex(const float *data, size_t length, size_t *new_length,
+                     size_t additional_length) {
+  size_t nl = zeropadding_length(length);
+  float *res = mallocf(nl + additional_length);
+  if (res == NULL) {
+    return NULL;
+  }
+  memcpy(res, data, length * sizeof(float));
+  memsetf(res + length, 0.f, nl + additional_length - length);
+  *new_length = nl;
+  return res;
+}
+
+float *rmemcpyf(float *dest, const float *src, size_t length) {
+  for (size_t i = 0; i < length; i++) {
+    dest[i] = src[length - i - 1];
+  }
+  return dest;
+}
+
+float *crmemcpyf(float *dest, const float *src, size_t length) {
+  /* complex-pairwise reverse: flip sample order, keep (re, im) intact
+   * (src/memory.c:178-183); length counts floats, must be even. */
+  size_t pairs = length / 2;
+  for (size_t i = 0; i < pairs; i++) {
+    dest[2 * i] = src[2 * (pairs - i - 1)];
+    dest[2 * i + 1] = src[2 * (pairs - i - 1) + 1];
+  }
+  return dest;
+}
+
+int align_complement_f32(const float *ptr) {
+  (void)ptr;
+  return 0; /* XLA owns device layout; host buffers are 64B-aligned */
+}

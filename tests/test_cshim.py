@@ -1,0 +1,36 @@
+"""Build + run the native C shim test suite.
+
+The reference is consumed as a C library (``Simd.pc.in`` pkg-config,
+SURVEY.md §1 L0); this test proves the TPU rebuild offers the same C ABI:
+it compiles ``csrc/`` and runs the C test binary, which embeds CPython and
+drives every op family through ``libveles_simd.so``.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CSRC = os.path.join(REPO, "csrc")
+
+
+@pytest.mark.skipif(shutil.which("gcc") is None or
+                    shutil.which("python3-config") is None,
+                    reason="native toolchain unavailable")
+def test_build_and_run_c_suite():
+    build = subprocess.run(["make", "-C", CSRC, "all"],
+                           capture_output=True, text=True)
+    assert build.returncode == 0, build.stderr[-3000:]
+
+    env = dict(os.environ)
+    env["VELES_SIMD_PYROOT"] = REPO
+    # fast deterministic backend for CI (JAX_PLATFORMS alone loses to the
+    # axon sitecustomize; cshim honors this explicit override)
+    env["VELES_SIMD_PLATFORM"] = "cpu"
+    run = subprocess.run(
+        [os.path.join(CSRC, "build", "test_veles_simd")],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert run.returncode == 0, (run.stdout[-2000:], run.stderr[-3000:])
+    assert "0 failures" in run.stdout

@@ -1,0 +1,117 @@
+"""Tests for veles.simd_tpu.ops.detect_peaks.
+
+Port of ``tests/detect_peaks.cc``: analytic sin() peak positions
+(``:43-75``), adversarial flat-signal cases (``:77-100``), XLA-vs-oracle
+over the simd flag (``:102``).
+"""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu.ops import detect_peaks as dp
+
+RNG = np.random.RandomState(41)
+
+
+def test_sin_peaks_analytic():
+    """Peaks of sin() land at π/2 + 2πk (tests/detect_peaks.cc:43-75)."""
+    t = np.arange(0, 4 * np.pi, 0.01, dtype=np.float32)
+    x = np.sin(t)
+    pos, vals = dp.detect_peaks(x, dp.ExtremumType.MAXIMUM, simd=True)
+    expected = [np.pi / 2, np.pi / 2 + 2 * np.pi]
+    assert len(pos) == 2
+    for p, e in zip(pos, expected):
+        assert abs(t[p] - e) < 0.02
+        assert abs(vals[list(pos).index(p)] - 1.0) < 1e-3
+
+
+def test_min_and_both():
+    t = np.arange(0, 4 * np.pi, 0.01, dtype=np.float32)
+    x = np.sin(t)
+    pos_min, _ = dp.detect_peaks(x, dp.ExtremumType.MINIMUM, simd=True)
+    assert len(pos_min) == 2
+    pos_both, _ = dp.detect_peaks(x, dp.ExtremumType.BOTH, simd=True)
+    assert len(pos_both) == 4
+
+
+def test_flat_signal_no_peaks():
+    """Plateaus are not peaks — strict inequality
+    (tests/detect_peaks.cc:77-100)."""
+    x = np.zeros(64, np.float32)
+    pos, vals = dp.detect_peaks(x, dp.ExtremumType.BOTH, simd=True)
+    assert len(pos) == 0
+    x2 = np.array([0, 1, 1, 0], np.float32)  # flat-topped: no strict peak
+    pos2, _ = dp.detect_peaks(x2, dp.ExtremumType.BOTH, simd=True)
+    assert len(pos2) == 0
+
+
+def test_endpoints_never_peaks():
+    x = np.array([5.0, 1.0, 4.0], np.float32)
+    pos, vals = dp.detect_peaks(x, dp.ExtremumType.BOTH, simd=True)
+    np.testing.assert_array_equal(pos, [1])
+    np.testing.assert_allclose(vals, [1.0])
+
+
+@pytest.mark.parametrize("type", [dp.ExtremumType.MAXIMUM,
+                                  dp.ExtremumType.MINIMUM,
+                                  dp.ExtremumType.BOTH])
+def test_xla_vs_oracle(type):
+    x = RNG.randn(997).astype(np.float32)
+    pos_x, val_x = dp.detect_peaks(x, type, simd=True)
+    pos_na, val_na = dp.detect_peaks_na(x, type)
+    np.testing.assert_array_equal(pos_x, pos_na)
+    np.testing.assert_allclose(val_x, val_na)
+
+
+def test_fixed_shape_variant():
+    """The jit-composable (positions, values, count) form."""
+    x = np.array([0, 2, 0, -3, 0, 5, 4, 6, 1], np.float32)
+    pos, vals, count = dp.detect_peaks_fixed(x, dp.ExtremumType.BOTH,
+                                             max_peaks=6)
+    pos, vals = np.asarray(pos), np.asarray(vals)
+    assert int(count) == 5
+    np.testing.assert_array_equal(pos[:5], [1, 3, 5, 6, 7])
+    np.testing.assert_allclose(vals[:5], [2, -3, 5, 4, 6])
+    np.testing.assert_array_equal(pos[5:], [-1])
+
+
+def test_fixed_shape_batched():
+    x = RNG.randn(4, 257).astype(np.float32)
+    pos, vals, count = dp.detect_peaks_fixed(x, dp.ExtremumType.MAXIMUM)
+    assert pos.shape == vals.shape == (4, 255)  # worst case n-2
+    for b in range(4):
+        pos_na, val_na = dp.detect_peaks_na(x[b], dp.ExtremumType.MAXIMUM)
+        c = int(count[b])
+        assert c == len(pos_na)
+        np.testing.assert_array_equal(np.asarray(pos)[b, :c], pos_na)
+
+
+def test_fixed_truncation():
+    """More peaks than max_peaks: first max_peaks kept, count reports all."""
+    x = np.tile(np.array([0.0, 1.0], np.float32), 20)  # alternating
+    pos, vals, count = dp.detect_peaks_fixed(x, dp.ExtremumType.BOTH,
+                                             max_peaks=4)
+    assert int(count) == 38
+    np.testing.assert_array_equal(np.asarray(pos), [1, 2, 3, 4])
+
+
+def test_fixed_default_capacity_holds_alternating():
+    """Default max_peaks must fit the alternating worst case (n-2)."""
+    x = np.tile(np.array([0.0, 1.0], np.float32), 20)
+    pos, vals, count = dp.detect_peaks_fixed(x, dp.ExtremumType.BOTH)
+    assert int(count) == 38
+    assert int((np.asarray(pos) >= 0).sum()) == 38
+
+
+def test_fixed_overlarge_max_peaks_clamped():
+    x = np.array([0, 2, 0], np.float32)
+    pos, vals, count = dp.detect_peaks_fixed(x, dp.ExtremumType.BOTH,
+                                             max_peaks=50)
+    assert pos.shape == (1,) and int(count) == 1
+
+
+def test_contract_violation():
+    with pytest.raises(ValueError):
+        dp.detect_peaks(np.zeros(2, np.float32), simd=True)
+    with pytest.raises(ValueError):
+        dp.detect_peaks_na(np.zeros(1, np.float32))

@@ -1,0 +1,79 @@
+"""Tests for veles.simd_tpu.ops.normalize.
+
+Port of ``tests/normalize.cc``: XLA-vs-oracle over the simd flag
+(``tests/normalize.cc:83``), plus golden edge cases (flat plane, full-range
+plane).
+"""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu.ops import normalize as nz
+
+RNG = np.random.RandomState(31)
+
+
+@pytest.mark.parametrize("w,h", [(3, 3), (16, 16), (99, 127), (640, 480)])
+def test_normalize2d_vs_oracle(w, h):
+    src = RNG.randint(0, 256, (h, w), np.uint8)
+    got = np.asarray(nz.normalize2D(src, simd=True))
+    want = nz.normalize2D(src, simd=False)
+    assert got.dtype == np.float32
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    assert got.min() >= -1.0 - 1e-6 and got.max() <= 1.0 + 1e-6
+
+
+def test_normalize2d_full_range():
+    src = np.array([[0, 255], [128, 64]], np.uint8)
+    got = np.asarray(nz.normalize2D(src, simd=True))
+    # XLA lowers the divide to reciprocal-multiply: 1 ulp off exact
+    np.testing.assert_allclose(got[0, 0], -1.0, atol=1e-6)
+    np.testing.assert_allclose(got[0, 1], 1.0, atol=1e-6)
+
+
+def test_normalize2d_flat_plane_is_zero():
+    """max == min → all zeros (src/normalize.c:386-392)."""
+    src = np.full((8, 8), 42, np.uint8)
+    np.testing.assert_array_equal(np.asarray(nz.normalize2D(src, simd=True)),
+                                  np.zeros((8, 8), np.float32))
+    np.testing.assert_array_equal(nz.normalize2D(src, simd=False),
+                                  np.zeros((8, 8), np.float32))
+
+
+def test_normalize2d_minmax_precomputed():
+    src = RNG.randint(10, 200, (32, 32), np.uint8)
+    got = np.asarray(nz.normalize2D_minmax(10, 200, src, simd=True))
+    want = nz.normalize2D_minmax_novec(10, 200, src)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("simd", [True, False])
+def test_minmax2d(simd):
+    src = RNG.randint(0, 256, (64, 64), np.uint8)
+    mn, mx = nz.minmax2D(src, simd=simd)
+    assert int(mn) == src.min() and int(mx) == src.max()
+
+
+@pytest.mark.parametrize("simd", [True, False])
+def test_minmax1d(simd):
+    src = RNG.randn(1001).astype(np.float32)
+    mn, mx = nz.minmax1D(src, simd=simd)
+    np.testing.assert_allclose(float(mn), src.min(), rtol=1e-6)
+    np.testing.assert_allclose(float(mx), src.max(), rtol=1e-6)
+
+
+def test_batched_normalize():
+    """Leading batch dims reduce per-plane — on both backends."""
+    src = RNG.randint(0, 256, (4, 16, 16), np.uint8)
+    src[2] = 7  # one flat plane in the batch
+    got = np.asarray(nz.normalize2D(src, simd=True))
+    got_na = nz.normalize2D(src, simd=False)
+    for b in range(4):
+        want = nz.normalize2D_novec(src[b])
+        np.testing.assert_allclose(got[b], want, atol=1e-5)
+        np.testing.assert_allclose(got_na[b], want, atol=1e-6)
+
+
+def test_contract_violation():
+    with pytest.raises(ValueError):
+        nz.normalize2D(np.zeros(8, np.uint8), simd=True)

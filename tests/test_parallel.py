@@ -1,0 +1,112 @@
+"""Tests for veles.simd_tpu.parallel on the virtual 8-device CPU mesh.
+
+The reference has no distributed layer (SURVEY.md §2 checklist) — these
+tests validate the new TPU capability: sharded results must be bitwise-
+close to the single-device ops they decompose.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from veles.simd_tpu import parallel as par
+from veles.simd_tpu.ops import convolve as cv
+
+RNG = np.random.RandomState(51)
+
+
+def test_virtual_mesh_has_8_devices():
+    assert len(jax.devices()) == 8  # conftest.py forces this
+
+
+def test_make_mesh_shapes():
+    m = par.make_mesh({"dp": 2, "sp": 4})
+    assert m.shape == {"dp": 2, "sp": 4}
+    m2 = par.make_mesh({"dp": 2, "tp": -1})
+    assert m2.shape["tp"] == 4
+    with pytest.raises(ValueError):
+        par.make_mesh({"dp": 3})
+
+
+@pytest.mark.parametrize("n,k", [(1 << 12, 65), (1000, 17), (8192, 129)])
+def test_sharded_convolve_matches_single_device(n, k):
+    """Sequence-parallel conv == the single-chip op (halo correctness)."""
+    mesh = par.make_mesh({"sp": 8})
+    x = RNG.randn(n).astype(np.float32)
+    h = RNG.randn(k).astype(np.float32)
+    got = np.asarray(par.sharded_convolve(x, h, mesh))
+    want = np.asarray(cv.convolve_simd(x, h, simd=True))
+    assert got.shape == (n + k - 1,)
+    np.testing.assert_allclose(got, want, atol=1e-3 * max(1, np.abs(want).max()))
+
+
+def test_sharded_convolve_2d_mesh_axis():
+    """Works on a named axis of a 2D mesh."""
+    mesh = par.make_mesh({"dp": 2, "sp": 4})
+    x = RNG.randn(4096).astype(np.float32)
+    h = RNG.randn(33).astype(np.float32)
+    got = np.asarray(par.sharded_convolve(x, h, mesh, axis="sp"))
+    want = np.convolve(x.astype(np.float64), h.astype(np.float64))
+    np.testing.assert_allclose(got, want.astype(np.float32), atol=1e-2)
+
+
+def test_sharded_matmul_matches_dot():
+    mesh = par.make_mesh({"tp": 8})
+    a = RNG.randn(64, 256).astype(np.float32)
+    b = RNG.randn(256, 48).astype(np.float32)
+    got = np.asarray(par.sharded_matmul(a, b, mesh))
+    want = a.astype(np.float64) @ b.astype(np.float64)
+    np.testing.assert_allclose(got, want.astype(np.float32), atol=1e-3)
+
+
+def test_sharded_matmul_contract_violations():
+    mesh = par.make_mesh({"tp": 8})
+    with pytest.raises(ValueError):
+        par.sharded_matmul(np.zeros((4, 5), np.float32),
+                           np.zeros((6, 4), np.float32), mesh)
+    with pytest.raises(ValueError):  # K=12 not divisible by 8
+        par.sharded_matmul(np.zeros((4, 12), np.float32),
+                           np.zeros((12, 4), np.float32), mesh)
+
+
+def test_data_parallel_batched_op():
+    from veles.simd_tpu.ops import wavelet as wv
+    from veles.simd_tpu.ops.wavelet_coeffs import WaveletType
+
+    mesh = par.make_mesh({"dp": 8})
+    x = RNG.randn(16, 256).astype(np.float32)
+    dwt = par.data_parallel(
+        lambda b: wv.wavelet_apply(WaveletType.DAUBECHIES, 8,
+                                   wv.ExtensionType.PERIODIC, b, simd=True),
+        mesh)
+    hi, lo = dwt(x)
+    hi_1, lo_1 = wv.wavelet_apply(WaveletType.DAUBECHIES, 8,
+                                  wv.ExtensionType.PERIODIC, x, simd=True)
+    np.testing.assert_allclose(np.asarray(hi), np.asarray(hi_1), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lo), np.asarray(lo_1), atol=1e-5)
+
+
+def test_sharded_convolve_rejects_batch():
+    mesh = par.make_mesh({"sp": 8})
+    with pytest.raises(ValueError):
+        par.sharded_convolve(np.zeros((2, 64), np.float32),
+                             np.zeros(5, np.float32), mesh)
+
+
+def test_sharded_convolve_length1_kernel():
+    """halo_len=0 edge: a length-1 kernel is a pure scale."""
+    mesh = par.make_mesh({"sp": 8})
+    x = RNG.randn(512).astype(np.float32)
+    h = np.array([2.5], np.float32)
+    got = np.asarray(par.sharded_convolve(x, h, mesh))
+    np.testing.assert_allclose(got, 2.5 * x, atol=1e-5)
+
+
+def test_sharded_convolve_halo_too_large():
+    """Filters longer than a shard raise a clear error, not a broadcast
+    failure inside shard_map."""
+    mesh = par.make_mesh({"sp": 8})
+    with pytest.raises(ValueError, match="halo"):
+        par.sharded_convolve(np.zeros(256, np.float32),
+                             np.zeros(40, np.float32), mesh)

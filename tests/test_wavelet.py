@@ -1,0 +1,227 @@
+"""Tests for veles.simd_tpu.ops.wavelet + wavelet_coeffs.
+
+Port of ``tests/wavelet.cc``: XLA-vs-oracle cross-validation with the
+reference tolerance (ε=0.0005, ``tests/wavelet.cc:84-86``), golden
+Daubechies-8 properties (``:88-167``), the parameterized
+{family}×{order}×{extension}×{level} sweep (``:252-288``), and structural
+tests of the layout helpers (``:44-74``).
+"""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu.ops import wavelet as wv
+from veles.simd_tpu.ops import wavelet_coeffs as wc
+
+RNG = np.random.RandomState(21)
+EPS = 5e-4  # tests/wavelet.cc:84-86
+
+EXTS = list(wv.ExtensionType)
+TYPES_ORDERS = (
+    [(wc.WaveletType.DAUBECHIES, o) for o in (2, 4, 6, 8, 12, 16)]
+    + [(wc.WaveletType.SYMLET, o) for o in (2, 4, 6, 8, 12, 16)]
+    + [(wc.WaveletType.COIFLET, o) for o in (6, 12)]
+)  # tests/wavelet.cc:252-288 instantiation
+
+
+# ---- coefficient generation ------------------------------------------------
+
+def test_daubechies_known_values():
+    """db2 is the textbook filter (front-loaded, Σ=√2)."""
+    h = wc.daubechies(4)
+    want = np.array([0.48296291314453414, 0.8365163037378079,
+                     0.22414386804201338, -0.12940952255126037])
+    np.testing.assert_allclose(h, want, atol=1e-12)
+
+
+def test_haar_rows():
+    np.testing.assert_allclose(wc.daubechies(2), [2 ** -0.5] * 2, atol=1e-14)
+    np.testing.assert_allclose(wc.symlet(2), [0.5, 0.5], atol=1e-14)
+
+
+def test_symlet4_reference_values():
+    """sym4 row of the reference table (sum=1 convention),
+    src/symlets.c:53-61."""
+    h = wc.symlet(8)
+    want = np.array([2.278517294800000e-02, -8.912350720850001e-03,
+                     -7.015881208950001e-02, 2.106172671020000e-01,
+                     5.683291217050001e-01, 3.518695343280000e-01,
+                     -2.095548256255000e-02, -5.357445070900000e-02])
+    np.testing.assert_allclose(h, want, atol=1e-9)
+
+
+def test_coiflet6_reference_values():
+    """coif1 row of the reference table (sum=1), src/coiflets.c:36-41."""
+    h = wc.coiflet(6)
+    want = np.array([-5.14297284710e-02, 2.38929728471e-01, 6.02859456942e-01,
+                     2.72140543058e-01, -5.14297284710e-02, -1.10702715290e-02])
+    np.testing.assert_allclose(h, want, atol=1e-9)
+
+
+@pytest.mark.parametrize("wtype,order", [
+    (wc.WaveletType.DAUBECHIES, 8), (wc.WaveletType.DAUBECHIES, 76),
+    (wc.WaveletType.SYMLET, 8), (wc.WaveletType.SYMLET, 40),
+    (wc.WaveletType.COIFLET, 18), (wc.WaveletType.COIFLET, 30),
+])
+def test_orthonormality(wtype, order):
+    """Every generated filter is an orthonormal QMF (after undoing the
+    per-family normalization)."""
+    h = wc.scaling_coefficients(wtype, order)
+    h = h * np.sqrt(2) / h.sum()
+    for k in range(order // 2):
+        want = 1.0 if k == 0 else 0.0
+        assert abs(np.dot(h[: order - 2 * k], h[2 * k:]) - want) < 1e-9
+
+
+@pytest.mark.parametrize("wtype,order,p", [
+    (wc.WaveletType.DAUBECHIES, 8, 4), (wc.WaveletType.SYMLET, 12, 6),
+    (wc.WaveletType.COIFLET, 12, 4),
+])
+def test_vanishing_moments(wtype, order, p):
+    """Highpass kills polynomials up to degree p-1."""
+    lo = wc.scaling_coefficients(wtype, order)
+    hi = wc.qmf_highpass(lo.astype(np.float64))
+    n = np.arange(order, dtype=np.float64)
+    for j in range(p):
+        assert abs(np.dot(n ** j, hi)) < 1e-7, j
+
+
+def test_validate_order():
+    assert wv.wavelet_validate_order(wc.WaveletType.DAUBECHIES, 8)
+    assert not wv.wavelet_validate_order(wc.WaveletType.DAUBECHIES, 7)
+    assert not wv.wavelet_validate_order(wc.WaveletType.DAUBECHIES, 78)
+    assert wv.wavelet_validate_order(wc.WaveletType.COIFLET, 24)
+    assert not wv.wavelet_validate_order(wc.WaveletType.COIFLET, 8)
+
+
+# ---- DWT / SWT transforms --------------------------------------------------
+
+@pytest.mark.parametrize("ext", EXTS)
+@pytest.mark.parametrize("wtype,order", TYPES_ORDERS)
+def test_dwt_xla_vs_oracle(wtype, order, ext):
+    """tests/wavelet.cc:224-250 cross-validation, ε=0.0005."""
+    x = RNG.randn(512).astype(np.float32)
+    hi, lo = wv.wavelet_apply(wtype, order, ext, x, simd=True)
+    hi_na, lo_na = wv.wavelet_apply_na(wtype, order, ext, x)
+    assert hi.shape == lo.shape == (256,)
+    np.testing.assert_allclose(np.asarray(hi), hi_na, atol=EPS)
+    np.testing.assert_allclose(np.asarray(lo), lo_na, atol=EPS)
+
+
+@pytest.mark.parametrize("level", [1, 2, 3, 4])
+@pytest.mark.parametrize("ext", [wv.ExtensionType.PERIODIC,
+                                 wv.ExtensionType.ZERO])
+def test_swt_xla_vs_oracle(level, ext):
+    x = RNG.randn(256).astype(np.float32)
+    hi, lo = wv.stationary_wavelet_apply(
+        wc.WaveletType.DAUBECHIES, 8, level, ext, x, simd=True)
+    hi_na, lo_na = wv.stationary_wavelet_apply_na(
+        wc.WaveletType.DAUBECHIES, 8, level, ext, x)
+    assert hi.shape == lo.shape == (256,)
+    np.testing.assert_allclose(np.asarray(hi), hi_na, atol=EPS)
+    np.testing.assert_allclose(np.asarray(lo), lo_na, atol=EPS)
+
+
+def test_dwt_haar_golden():
+    """Haar DWT has a closed form: (x0±x1)/√2 pairs."""
+    x = np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0], np.float32)
+    hi, lo = wv.wavelet_apply(wc.WaveletType.DAUBECHIES, 2,
+                              wv.ExtensionType.PERIODIC, x, simd=True)
+    r2 = np.sqrt(2.0, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(lo), [3 / r2, 7 / r2, 11 / r2],
+                               atol=1e-5)
+    # reference QMF: hp = [C0, -C0] (src/wavelet.c:187-209 sign pattern),
+    # so hi = (x[2i] - x[2i+1])/sqrt(2)
+    np.testing.assert_allclose(np.asarray(hi), [-1 / r2, -1 / r2, -1 / r2],
+                               atol=1e-5)
+
+
+def test_dwt_energy_preservation():
+    """Orthonormal DWT preserves energy (periodic extension)."""
+    x = RNG.randn(1024).astype(np.float32)
+    hi, lo = wv.wavelet_apply(wc.WaveletType.DAUBECHIES, 8,
+                              wv.ExtensionType.PERIODIC, x, simd=True)
+    e_in = float(np.sum(x.astype(np.float64) ** 2))
+    e_out = float(np.sum(np.asarray(hi, np.float64) ** 2)
+                  + np.sum(np.asarray(lo, np.float64) ** 2))
+    assert abs(e_in - e_out) / e_in < 1e-5
+
+
+def test_dwt_constant_signal():
+    """Lowpass of a constant is the constant × Σlo; highpass is ~0."""
+    x = np.full(128, 3.0, np.float32)
+    hi, lo = wv.wavelet_apply(wc.WaveletType.DAUBECHIES, 8,
+                              wv.ExtensionType.CONSTANT, x, simd=True)
+    np.testing.assert_allclose(np.asarray(hi), 0.0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lo), 3.0 * np.sqrt(2), atol=1e-4)
+
+
+def test_swt_level1_equals_undecimated_dwt():
+    """SWT level 1 at even offsets equals the DWT (same filters, no
+    decimation)."""
+    x = RNG.randn(128).astype(np.float32)
+    hi_s, lo_s = wv.stationary_wavelet_apply(
+        wc.WaveletType.DAUBECHIES, 8, 1, wv.ExtensionType.PERIODIC, x,
+        simd=True)
+    hi_d, lo_d = wv.wavelet_apply(
+        wc.WaveletType.DAUBECHIES, 8, wv.ExtensionType.PERIODIC, x,
+        simd=True)
+    np.testing.assert_allclose(np.asarray(hi_s)[::2], np.asarray(hi_d),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lo_s)[::2], np.asarray(lo_d),
+                               atol=1e-5)
+
+
+def test_multi_level_cascade():
+    x = RNG.randn(512).astype(np.float32)
+    coeffs = wv.wavelet_transform(wc.WaveletType.SYMLET, 8,
+                                  wv.ExtensionType.PERIODIC, x, 3, simd=True)
+    assert [c.shape[-1] for c in coeffs] == [256, 128, 64, 64]
+    coeffs_na = wv.wavelet_transform(wc.WaveletType.SYMLET, 8,
+                                     wv.ExtensionType.PERIODIC, x, 3,
+                                     simd=False)
+    for a, b in zip(coeffs, coeffs_na):
+        np.testing.assert_allclose(np.asarray(a), b, atol=2e-3)
+
+
+def test_batched_dwt():
+    x = RNG.randn(8, 256).astype(np.float32)
+    hi, lo = wv.wavelet_apply(wc.WaveletType.DAUBECHIES, 8,
+                              wv.ExtensionType.MIRROR, x, simd=True)
+    assert hi.shape == (8, 128)
+    for b in range(8):
+        hb, lb = wv.wavelet_apply_na(wc.WaveletType.DAUBECHIES, 8,
+                                     wv.ExtensionType.MIRROR, x[b])
+        np.testing.assert_allclose(np.asarray(hi)[b], hb, atol=EPS)
+        np.testing.assert_allclose(np.asarray(lo)[b], lb, atol=EPS)
+
+
+# ---- contract violations & shims ------------------------------------------
+
+def test_contract_violations():
+    x = RNG.randn(33).astype(np.float32)  # odd length
+    with pytest.raises(ValueError):
+        wv.wavelet_apply(wc.WaveletType.DAUBECHIES, 8,
+                         wv.ExtensionType.PERIODIC, x, simd=True)
+    with pytest.raises(ValueError):
+        wv.wavelet_apply(wc.WaveletType.DAUBECHIES, 7,
+                         wv.ExtensionType.PERIODIC, RNG.randn(64), simd=True)
+    with pytest.raises(ValueError):
+        wv.stationary_wavelet_apply(wc.WaveletType.DAUBECHIES, 8, 0,
+                                    wv.ExtensionType.PERIODIC,
+                                    RNG.randn(64).astype(np.float32))
+
+
+def test_layout_shims():
+    """tests/wavelet.cc:44-74 structural checks, XLA-era semantics."""
+    x = RNG.randn(64).astype(np.float32)
+    prep = wv.wavelet_prepare_array(8, x, 64)
+    np.testing.assert_array_equal(prep, x)
+    dest = wv.wavelet_allocate_destination(8, 64)
+    assert dest.shape == (32,) and dest.dtype == np.float32
+    quarters = wv.wavelet_recycle_source(8, np.arange(64, dtype=np.float32))
+    assert all(q.shape == (16,) for q in quarters)
+    np.testing.assert_array_equal(quarters[1], np.arange(16, 32))
+    assert wv.wavelet_recycle_source(8, np.arange(6)) == (None,) * 4
+    with pytest.raises(ValueError):
+        wv.wavelet_allocate_destination(8, 66)

@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Generate and cache the wavelet coefficient tables.
+
+Derives every supported (family, order) filter from its mathematical
+definition (see ``veles/simd_tpu/ops/wavelet_coeffs.py``) and stores the
+result in ``_wavelet_tables.npz`` next to that module, so library imports
+don't pay the generation cost (the order-76 symlet search alone is a few
+seconds).  Re-run after changing the generator:
+
+    python tools/gen_wavelet_tables.py
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from veles.simd_tpu.ops import wavelet_coeffs as wc
+
+
+def main():
+    tables = {}
+    for wtype in wc.WaveletType:
+        for order in wc.supported_orders(wtype):
+            t0 = time.time()
+            key = f"{wtype.value}{order}"
+            # bypass the npz cache: generate from scratch
+            if wtype is wc.WaveletType.DAUBECHIES:
+                h = wc._gen_daubechies(order)
+            elif wtype is wc.WaveletType.SYMLET:
+                h = wc._gen_symlet(order) / np.sqrt(2)
+            else:
+                h = wc._gen_coiflet(order) / np.sqrt(2)
+            tables[key] = h
+            target = 1.0 if wtype is not wc.WaveletType.DAUBECHIES \
+                else np.sqrt(2)
+            orth = max(
+                abs(np.dot(h[: len(h) - 2 * k], h[2 * k:]) * 2 / target ** 2
+                    - (1.0 if k == 0 else 0.0))
+                for k in range(len(h) // 2))
+            print(f"{key:8s} len={len(h):3d} sum_err={abs(h.sum()-target):.1e}"
+                  f" orth_err={orth:.1e}  ({time.time()-t0:.1f}s)")
+            assert abs(h.sum() - target) < 1e-12, key
+            assert orth < 1e-10, key
+    np.savez(wc._TABLE_PATH, **tables)
+    print(f"wrote {len(tables)} tables -> {wc._TABLE_PATH}")
+
+
+if __name__ == "__main__":
+    main()

@@ -342,10 +342,12 @@ def convolve_overlap_save_finalize(handle):
 
 # ---- auto-select ----------------------------------------------------------
 
-def convolve_initialize(x_length, h_length, algorithm=None):
+def convolve_initialize(x_length, h_length, algorithm=None, *,
+                        reverse=False):
     """``inc/simd/convolve.h:98-115`` — picks the algorithm via
-    :func:`select_algorithm` unless forced."""
-    return _make_handle(x_length, h_length, algorithm, reverse=False)
+    :func:`select_algorithm` unless forced.  ``reverse=True`` makes the
+    handle cross-correlate (``src/correlate.c:128-143``)."""
+    return _make_handle(x_length, h_length, algorithm, reverse=reverse)
 
 
 def convolve(handle_or_x, x_or_h, h=None, simd=None):

@@ -79,11 +79,9 @@ def cross_correlate_overlap_save_finalize(handle):
 
 
 def cross_correlate_initialize(x_length, h_length, algorithm=None):
-    """``src/correlate.c:128-143`` — auto-select, then set reverse."""
-    base = _conv.convolve_initialize(x_length, h_length, algorithm)
-    import dataclasses
-
-    return dataclasses.replace(base, reverse=True)
+    """``src/correlate.c:128-143`` — auto-select with reverse set."""
+    return _conv.convolve_initialize(x_length, h_length, algorithm,
+                                     reverse=True)
 
 
 def cross_correlate(handle_or_x, x_or_h, h=None, simd=None):

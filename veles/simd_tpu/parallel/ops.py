@@ -1,0 +1,137 @@
+"""Sharded ops: sequence-parallel convolution, TP GEMM, DP batching.
+
+The distributed re-expression of the reference's hot paths (SURVEY.md §5
+"long-context" analog): overlap-save block filtering
+(``/root/reference/src/convolve.c:103-229``) becomes ``shard_map`` over a
+sequence axis with a ``ppermute`` halo exchange; the GEMM column loop
+(``src/matrix.c:200-226``) becomes a contracting-dim-sharded
+``dot_general`` + ``psum``.  Everything here is pure SPMD: one jitted
+program, XLA inserts the collectives, ICI carries them.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["sharded_convolve", "sharded_matmul", "data_parallel",
+           "halo_exchange_left"]
+
+
+def halo_exchange_left(x_local, halo_len: int, axis_name: str):
+    """Bring the last ``halo_len`` samples of the left neighbour's shard.
+
+    The first shard receives zeros (``ppermute`` drops absent sources) —
+    exactly the zero history the overlap-save formulation wants
+    (``src/convolve.c:194-196`` zero-pads the first block).
+    """
+    n_shards = jax.lax.axis_size(axis_name)
+    block = x_local.shape[-1]
+    tail = x_local[..., block - halo_len:]  # empty when halo_len == 0
+    perm = [(i, i + 1) for i in range(n_shards - 1)]
+    return jax.lax.ppermute(tail, axis_name, perm)
+
+
+def _local_full_conv(x_ext, h):
+    """VALID cross-correlation-with-flipped-h of the halo-extended block:
+    yields exactly the block's span of the global full convolution."""
+    k = h.shape[-1]
+    lhs = x_ext.reshape((1, 1, x_ext.shape[-1]))
+    rhs = jnp.flip(h, -1).reshape((1, 1, k))
+    out = jax.lax.conv_general_dilated(
+        lhs, rhs, window_strides=(1,), padding="VALID",
+        precision=jax.lax.Precision.HIGHEST)
+    return out.reshape(x_ext.shape[:-1] + (out.shape[-1],))
+
+
+def sharded_convolve(x, h, mesh: Mesh, axis: str = "sp"):
+    """Sequence-parallel full linear convolution over ``mesh[axis]``.
+
+    The signal is sharded along its length; each device convolves its
+    block after a one-hop left-halo exchange of ``h−1`` samples.  Returns
+    the full ``n + h - 1`` result (same semantics as
+    :func:`veles.simd_tpu.ops.convolve.convolve`).
+
+    This is the distributed overlap-save: reference blocks-with-overlap
+    (``src/convolve.c:181-228``) → shards-with-halo; the intra-block FFT
+    pipeline stays whatever XLA picks locally.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    h = jnp.asarray(h, jnp.float32)
+    if x.ndim != 1:
+        raise ValueError("sharded_convolve shards a single 1D signal; "
+                         "use data_parallel for batches")
+    n, k = x.shape[-1], h.shape[-1]
+    n_shards = mesh.shape[axis]
+    out_len = n + k - 1
+    pad_to = -(-out_len // n_shards) * n_shards
+    if k - 1 > pad_to // n_shards:
+        raise ValueError(
+            f"filter halo h_length-1={k - 1} exceeds the per-shard block "
+            f"({pad_to // n_shards}); the one-hop halo exchange needs "
+            f"h_length-1 <= signal_length/{n_shards} — use fewer shards or "
+            f"the single-chip convolve")
+    x_pad = jnp.pad(x, (0, pad_to - n))
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(axis), P()), out_specs=P(axis))
+    def _run(x_local, h_full):
+        halo = halo_exchange_left(x_local, k - 1, axis)
+        x_ext = jnp.concatenate([halo, x_local], axis=-1)
+        return _local_full_conv(x_ext, h_full)
+
+    return _run(x_pad, h)[..., :out_len]
+
+
+def sharded_matmul(a, b, mesh: Mesh, axis: str = "tp"):
+    """Tensor-parallel GEMM: contracting dim sharded, ``psum`` over ICI.
+
+    ``a [m, K] @ b [K, n]`` with K split across ``mesh[axis]``; each chip
+    computes a partial ``[m, n]`` on its MXU and the partials are
+    all-reduced.  (K must be divisible by the axis size.)
+    """
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    if a.shape[-1] != b.shape[-2]:
+        raise ValueError(f"contracting dims differ: {a.shape} @ {b.shape}")
+    if a.shape[-1] % mesh.shape[axis]:
+        raise ValueError(
+            f"K={a.shape[-1]} not divisible by mesh axis {axis} "
+            f"({mesh.shape[axis]})")
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(None, axis), P(axis, None)), out_specs=P(None, None))
+    def _run(a_local, b_local):
+        partial = jnp.dot(a_local, b_local,
+                          precision=jax.lax.Precision.HIGHEST)
+        return jax.lax.psum(partial, axis)
+
+    return _run(a, b)
+
+
+def data_parallel(fn, mesh: Mesh, axis: str = "dp"):
+    """Wrap a batched op so its leading batch axis is sharded over
+    ``mesh[axis]`` — jit + sharding constraint, XLA partitions the rest.
+
+    >>> dwt = data_parallel(lambda x: wavelet_apply(DAUB, 8, PERIODIC, x),
+    ...                     mesh)
+    >>> hi, lo = dwt(batch_of_signals)   # batch split across chips
+    """
+    jfn = jax.jit(fn)
+
+    def wrapper(batch, *args, **kwargs):
+        batch = jnp.asarray(batch)
+        spec = P(axis, *([None] * (batch.ndim - 1)))
+        batch = jax.device_put(batch, NamedSharding(mesh, spec))
+        with mesh:
+            return jfn(batch, *args, **kwargs)
+
+    return wrapper
