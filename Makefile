@@ -1,0 +1,32 @@
+# Top-level driver — parity with the reference's autotools targets
+# (/root/reference/Makefile.am:30-43): `make tests` runs every suite with
+# a timeout + peak-RSS + log + failure gate, `make benchmarks` prints the
+# relative-speedup lines, `make cshim` builds the native C ABI.
+
+PYTHON ?= python
+
+.PHONY: all tests benchmarks bench cshim cshim-check clean
+
+all: cshim
+
+tests:
+	$(PYTHON) tools/run_tests.py
+
+benchmarks:
+	$(PYTHON) tools/benchmark_suite.py
+
+bench:
+	$(PYTHON) bench.py --all
+
+cshim:
+	$(MAKE) -C csrc all
+
+cshim-check:
+	$(MAKE) -C csrc check
+
+wavelet-tables:
+	$(PYTHON) tools/gen_wavelet_tables.py
+
+clean:
+	$(MAKE) -C csrc clean
+	rm -f tests.log test_results_*.xml

@@ -16,21 +16,10 @@ Usage:  python bench.py           # one JSON line on stdout
 
 import json
 import sys
-import time
 
 import numpy as np
 
-
-def _time(fn, *, warmup=2, repeats=5):
-    """Best-of-N wall time of fn() (fn must block until done)."""
-    for _ in range(warmup):
-        fn()
-    best = float("inf")
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        fn()
-        best = min(best, time.perf_counter() - t0)
-    return best
+from veles.simd_tpu.utils.benchmark import device_time, host_time
 
 
 def bench_elementwise(rng):
@@ -51,10 +40,10 @@ def bench_elementwise(rng):
     i16j = jnp.asarray(i16)
 
     fused = jax.jit(lambda a, b, i: (a + b) * ar._int16_to_float(i))
-    t = _time(lambda: fused(a, b, i16j).block_until_ready())
+    t = device_time(lambda: fused(a, b, i16j))
     elems = batch * n
-    t_base = _time(
-        lambda: (a_np + b_np) * i16.astype(np.float32), repeats=3)
+    t_base = host_time(
+        lambda: (a_np + b_np) * i16.astype(np.float32))
     return {"metric": "elementwise add*mul*convert", "unit": "Melem/s",
             "value": elems / t / 1e6, "baseline": elems / t_base / 1e6}
 
@@ -69,10 +58,9 @@ def bench_mathfun(rng):
     x = jnp.asarray(x_np)
     fused = jax.jit(
         lambda v: jnp.sin(v) + jnp.cos(v) + jnp.log(v) + jnp.exp(-v))
-    t = _time(lambda: fused(x).block_until_ready())
-    t_base = _time(
-        lambda: np.sin(x_np) + np.cos(x_np) + np.log(x_np) + np.exp(-x_np),
-        repeats=3)
+    t = device_time(lambda: fused(x))
+    t_base = host_time(
+        lambda: np.sin(x_np) + np.cos(x_np) + np.log(x_np) + np.exp(-x_np))
     # 4 transcendentals per element
     return {"metric": "sin+cos+log+exp 1M floats", "unit": "Msamples/s",
             "value": 4 * n / t / 1e6, "baseline": 4 * n / t_base / 1e6}
@@ -88,9 +76,9 @@ def bench_sgemm(rng):
     a_np = rng.randn(n, n).astype(np.float32)
     b_np = rng.randn(n, n).astype(np.float32)
     a, b = jnp.asarray(a_np), jnp.asarray(b_np)
-    t = _time(lambda: mx._matmul(a, b).block_until_ready())
+    t = device_time(lambda: mx._matmul(a, b), burst=16)
     flops = 2 * n ** 3
-    t_base = _time(lambda: mx.matrix_multiply_novec(a_np, b_np), repeats=3)
+    t_base = host_time(lambda: mx.matrix_multiply_novec(a_np, b_np))
     return {"metric": "sgemm 512", "unit": "GFLOP/s",
             "value": flops / t / 1e9, "baseline": flops / t_base / 1e9}
 
@@ -108,9 +96,9 @@ def bench_convolve_1m(rng):
     h = rng.randn(k).astype(np.float32)
     handle = cv.convolve_overlap_save_initialize(n, k)
     xd, hd = jnp.asarray(x), jnp.asarray(h)  # device-resident: measure the
-    t = _time(lambda: cv.convolve_overlap_save(  # chip, not the PCIe/tunnel
-        handle, xd, hd, simd=True).block_until_ready())
-    t_base = _time(lambda: cv._conv_overlap_save_na(
+    t = device_time(lambda: cv.convolve_overlap_save(  # chip, not the tunnel
+        handle, xd, hd, simd=True))
+    t_base = host_time(lambda: cv._conv_overlap_save_na(
         x, h, handle.block_length), repeats=2)
     return {"metric": "convolve 1M x 2047 overlap-save",
             "unit": "Msamples/s",
@@ -129,9 +117,9 @@ def bench_dwt(rng):
     xd = jnp.asarray(x)
     run = lambda: wv.wavelet_apply(
         WaveletType.DAUBECHIES, 8, wv.ExtensionType.PERIODIC, xd,
-        simd=True)[0].block_until_ready()
-    t = _time(run)
-    t_base = _time(lambda: wv.wavelet_apply_na(
+        simd=True)[0]
+    t = device_time(run)
+    t_base = host_time(lambda: wv.wavelet_apply_na(
         WaveletType.DAUBECHIES, 8, wv.ExtensionType.PERIODIC, x),
         repeats=2)
     samples = batch * n

@@ -47,6 +47,18 @@ def test_normalize2d_minmax_precomputed():
     np.testing.assert_allclose(got, want, atol=1e-6)
 
 
+def test_normalize2d_minmax_batched_roundtrip():
+    """minmax2D -> normalize2D_minmax composes for batched planes on both
+    backends."""
+    src = RNG.randint(0, 256, (4, 16, 16), np.uint8)
+    for simd in (True, False):
+        mn, mx = nz.minmax2D(src, simd=simd)
+        got = np.asarray(nz.normalize2D_minmax(mn, mx, src, simd=simd))
+        np.testing.assert_allclose(got, np.asarray(nz.normalize2D(src,
+                                                                  simd=simd)),
+                                   atol=1e-6)
+
+
 @pytest.mark.parametrize("simd", [True, False])
 def test_minmax2d(simd):
     src = RNG.randint(0, 256, (64, 64), np.uint8)

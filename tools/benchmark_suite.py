@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Relative benchmark generator — parity with ``tests/benchmark.inc``.
+
+The reference compiles macro-generated benchmark TESTs (under
+``--enable-benchmarks``) that time `iter_count` SIMD calls against the
+scalar baseline and print
+``SIMD version took X% of the original time. Speedup is Y% (Z times)``
+(``/root/reference/tests/benchmark.inc:74-113``).  This module is the same
+generator, parameterized in Python: each instantiation times the XLA path
+against the NumPy oracle and prints the reference's line format plus
+absolute throughput (SURVEY.md §5 asks for absolute numbers, not just
+ratios).
+
+Instantiations mirror the reference's:
+
+* convolve brute/FFT/overlap-save crossovers over sizes
+  (``tests/convolve.cc:168-401``),
+* GEMM straight vs transposed (``tests/matrix.cc:206-288``),
+* DWT per-order speedup loop (``tests/wavelet.cc:290-336``),
+* elementwise + mathfun sweeps (``tests/arithmetic.cc`` pattern).
+
+Run:  python tools/benchmark_suite.py [--quick]
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def benchmark(name, peak_fn, baseline_fn, *, iter_count=10, samples=None):
+    """The benchmark.inc pattern: time iter_count× peak vs baseline."""
+    peak_fn()          # warmup / compile
+    baseline_fn()
+    t0 = time.perf_counter()
+    for _ in range(iter_count):
+        peak_fn()
+    t_peak = (time.perf_counter() - t0) / iter_count
+    t0 = time.perf_counter()
+    for _ in range(max(1, iter_count // 5)):
+        baseline_fn()
+    t_base = (time.perf_counter() - t0) / max(1, iter_count // 5)
+    pct = 100.0 * t_peak / t_base
+    times = t_base / t_peak
+    line = (f"[{name}] XLA version took {pct:.1f}% of the original time. "
+            f"Speedup is {100 - pct:.0f}% ({times:.1f} times)")
+    if samples:
+        line += f" | {samples / t_peak / 1e6:.0f} Msamples/s"
+    print(line)
+    return times
+
+
+def main():
+    quick = "--quick" in sys.argv
+    import jax.numpy as jnp
+
+    from veles.simd_tpu.ops import convolve as cv
+    from veles.simd_tpu.ops import matrix as mx
+    from veles.simd_tpu.ops import wavelet as wv
+    from veles.simd_tpu.ops.mathfun import sin_psv
+    from veles.simd_tpu.ops.wavelet_coeffs import WaveletType
+
+    rng = np.random.RandomState(0)
+
+    # --- convolve crossovers (tests/convolve.cc:168-401) ---
+    sizes = [(50, 50), (256, 256), (350, 21), (1000, 50), (2000, 950)]
+    if not quick:
+        sizes += [(1 << 17, 127), (1 << 20, 2047)]
+    for xlen, hlen in sizes:
+        x = rng.randn(xlen).astype(np.float32)
+        h = rng.randn(hlen).astype(np.float32)
+        xd, hd = jnp.asarray(x), jnp.asarray(h)
+        handle = cv.convolve_initialize(xlen, hlen)
+        benchmark(
+            f"convolve {xlen}x{hlen} [{handle.algorithm.value}]",
+            lambda: cv.convolve(handle, xd, hd, simd=True)
+            .block_until_ready(),
+            lambda: cv.convolve(handle, x, h, simd=False),
+            iter_count=5 if xlen >= 1 << 17 else 10, samples=xlen)
+
+    # --- GEMM straight vs transposed (tests/matrix.cc:206-288) ---
+    a = rng.randn(300, 256).astype(np.float32)
+    b = rng.randn(256, 1000).astype(np.float32)
+    ad, bd = jnp.asarray(a), jnp.asarray(b)
+    btd = jnp.asarray(b.T.copy())
+    benchmark("gemm 300x256x1000",
+              lambda: mx._matmul(ad, bd).block_until_ready(),
+              lambda: mx.matrix_multiply_novec(a, b),
+              iter_count=20)
+    benchmark("gemm 300x256x1000 transposed-B",
+              lambda: mx._matmul_t(ad, btd).block_until_ready(),
+              lambda: mx.matrix_multiply_transposed_novec(a, b.T), iter_count=20)
+
+    # --- DWT per order (tests/wavelet.cc:290-336) ---
+    sig = rng.randn(64, 512).astype(np.float32)
+    sigd = jnp.asarray(sig)
+    for order in (4, 6, 8, 12, 16):
+        benchmark(
+            f"dwt daub{order} 64x512",
+            lambda: wv.wavelet_apply(
+                WaveletType.DAUBECHIES, order, wv.ExtensionType.PERIODIC,
+                sigd, simd=True)[0].block_until_ready(),
+            lambda: wv.wavelet_apply_na(
+                WaveletType.DAUBECHIES, order, wv.ExtensionType.PERIODIC,
+                sig),
+            iter_count=10, samples=sig.size)
+
+    # --- mathfun (tests/mathfun.cc pattern) ---
+    v = rng.randn(1 << 20).astype(np.float32)
+    vd = jnp.asarray(v)
+    benchmark("sin 1M",
+              lambda: sin_psv(vd, simd=True).block_until_ready(),
+              lambda: sin_psv(v, simd=False), iter_count=10,
+              samples=v.size)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Test runner — parity with the reference's ``make tests`` harness.
+
+The reference's runner (``/root/reference/tests/Tests.make:62-94`` +
+``Makefile.am:37-43``) runs each gtest binary under ``timeout 60`` and
+``/usr/bin/time -f "peak memory %M Kb"``, appends to ``tests.log``, emits
+gtest XML, and fails the build if the log contains ``[FAILED]``.
+
+This runner does the same per test *module*: per-suite timeout, peak-RSS
+report, junit XML, accumulated ``tests.log``, and a failure gate.
+
+Run:  python tools/run_tests.py [--timeout 120]
+"""
+
+import argparse
+import glob
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--timeout", type=int, default=300,
+                    help="per-suite timeout in seconds (Tests.make used 60)")
+    ap.add_argument("--log", default=os.path.join(REPO, "tests.log"))
+    args = ap.parse_args()
+
+    suites = sorted(glob.glob(os.path.join(REPO, "tests", "test_*.py")))
+    failures = []
+    with open(args.log, "w") as log:
+        for suite in suites:
+            name = os.path.basename(suite)
+            xml = os.path.join(REPO, f"test_results_{name[:-3]}.xml")
+            pytest_args = [suite, "-q", f"--junitxml={xml}"]
+            if _has_pytest_timeout():
+                pytest_args.append(f"--timeout={args.timeout}")
+            # per-suite peak RSS, like the reference's `/usr/bin/time -f
+            # "peak memory %M Kb"` (Tests.make:87); GNU time isn't in the
+            # image and RUSAGE_CHILDREN.ru_maxrss is a monotonic max over
+            # ALL children, so the child reports its own ru_maxrss at exit
+            wrapper = (
+                "import atexit, resource, runpy, sys; "
+                "atexit.register(lambda: print("
+                "f'__peak_rss_kb={resource.getrusage("
+                "resource.RUSAGE_SELF).ru_maxrss}', file=sys.stderr)); "
+                f"sys.argv = ['pytest'] + {pytest_args!r}; "
+                "runpy.run_module('pytest', run_name='__main__')")
+            cmd = [sys.executable, "-c", wrapper]
+            try:
+                proc = subprocess.run(cmd, cwd=REPO,
+                                      capture_output=True, text=True,
+                                      timeout=args.timeout)
+                out = proc.stdout + proc.stderr
+                ok = proc.returncode == 0
+            except subprocess.TimeoutExpired as e:
+                out = (e.stdout or "") + (e.stderr or "") + "\n[TIMEOUT]"
+                ok = False
+            peak_kb = "?"
+            for tok in out.splitlines():
+                if tok.startswith("__peak_rss_kb="):
+                    peak_kb = tok.split("=", 1)[1]
+            status = "OK" if ok else "[FAILED]"
+            line = f"=== {name}: {status} (peak memory {peak_kb} Kb)"
+            print(line)
+            log.write(line + "\n" + out + "\n")
+            if not ok:
+                failures.append(name)
+
+    # the reference greps tests.log for [FAILED] to gate the build
+    if failures:
+        print(f"\n{len(failures)} suite(s) FAILED: {', '.join(failures)}")
+        return 1
+    print(f"\nall {len(suites)} suites passed; log at {args.log}")
+    return 0
+
+
+def _has_pytest_timeout():
+    try:
+        import pytest_timeout  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+if __name__ == "__main__":
+    sys.exit(main())

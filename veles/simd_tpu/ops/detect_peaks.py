@@ -85,7 +85,8 @@ def detect_peaks_fixed(data, type=ExtremumType.BOTH, max_peaks=None):
 
     Returns ``(positions[int32, ..., max_peaks], values[..., max_peaks],
     count[...])``; unused slots hold position -1 / value 0.  ``max_peaks``
-    defaults to the static worst case ``(n - 1) // 2``.
+    defaults to (and is clamped to) the static worst case ``n - 2``
+    (an alternating signal makes every interior point an extremum).
     """
     data = jnp.asarray(data)
     n = data.shape[-1]

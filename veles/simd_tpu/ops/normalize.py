@@ -49,6 +49,9 @@ def _normalize2d_minmax(mn, mx, src):
     v = src.astype(jnp.float32)
     mn = jnp.asarray(mn, jnp.float32)
     mx = jnp.asarray(mx, jnp.float32)
+    if mn.ndim:  # per-plane values from a batched minmax2D
+        mn = mn[..., None, None]
+        mx = mx[..., None, None]
     diff = (mx - mn) / 2.0
     out = (v - mn) / diff - 1.0
     return jnp.where(mx == mn, jnp.zeros_like(out), out)

@@ -1,0 +1,69 @@
+"""Device timing utilities (the framework's profiling layer).
+
+The reference's only profiling is ``std::chrono`` around synchronous CPU
+calls (``/root/reference/tests/benchmark.inc:74-107``).  On an
+asynchronous accelerator runtime that pattern silently measures dispatch,
+not compute — ``block_until_ready`` is not reliable through remote-relay
+PJRT transports either (observed on the axon tunnel: a 3-second
+convolution "completed" in 40µs).
+
+:func:`device_time` therefore uses **pipelined burst timing**: issue the
+op once vs K times back-to-back (single-stream TPU execution serializes
+them), force completion with a scalar fetch, and report
+``(t_K - t_1) / (K - 1)`` — the marginal per-op device time, with
+dispatch latency and the fetch round-trip subtracted out.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+__all__ = ["device_time", "host_time"]
+
+
+def _sync(out):
+    """Force completion of `out` (any jax array / pytree leaf)."""
+    import jax
+
+    leaves = jax.tree.leaves(out)
+    last = leaves[-1]
+    np.asarray(last.ravel()[-1:] if hasattr(last, "ravel") else last)
+
+
+def device_time(fn, *, burst: int = 8, repeats: int = 3,
+                warmup: int = 2) -> float:
+    """Marginal per-call device time of ``fn`` (which must return a jax
+    array or pytree of them)."""
+    for _ in range(warmup):
+        _sync(fn())
+
+    def burst_time(k):
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(k):
+                out = fn()
+            _sync(out)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1 = burst_time(1)
+    tk = burst_time(burst)
+    per_op = (tk - t1) / (burst - 1)
+    # degenerate case (dispatch-dominated tiny op): fall back to t1
+    return max(per_op, 1e-9) if per_op > 0 else t1
+
+
+def host_time(fn, *, repeats: int = 3, warmup: int = 1) -> float:
+    """Best-of-N wall time for a synchronous host function."""
+    for _ in range(warmup):
+        fn()
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
