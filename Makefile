@@ -5,7 +5,7 @@
 
 PYTHON ?= python
 
-.PHONY: all tests benchmarks bench cshim cshim-check clean
+.PHONY: all tests benchmarks bench cshim cshim-check wavelet-tables clean
 
 all: cshim
 

@@ -90,6 +90,15 @@ def test_block_length_matches_reference():
     assert cv.overlap_save_block_length(950) == 2048
 
 
+def test_tpu_block_length():
+    """8x the reference rule, capped by the padded problem size."""
+    assert cv.tpu_block_length(2047, 1 << 20) == 8 * 4096
+    assert cv.tpu_block_length(50, 1 << 20) == 8 * 128
+    # small signal: cap kicks in but never below the reference length
+    assert cv.tpu_block_length(50, 300) == 512
+    assert cv.tpu_block_length(50, 120) == 256
+
+
 def test_fft_length():
     h = cv.convolve_fft_initialize(100, 29)
     assert h.fft_length == 128

@@ -35,8 +35,11 @@ def main():
             name = os.path.basename(suite)
             xml = os.path.join(REPO, f"test_results_{name[:-3]}.xml")
             pytest_args = [suite, "-q", f"--junitxml={xml}"]
+            # per-test timeout well below the suite budget so a hung test
+            # gets a named traceback from pytest-timeout before the outer
+            # SIGKILL (which loses the XML and the test name)
             if _has_pytest_timeout():
-                pytest_args.append(f"--timeout={args.timeout}")
+                pytest_args.append(f"--timeout={max(30, args.timeout // 2)}")
             # per-suite peak RSS, like the reference's `/usr/bin/time -f
             # "peak memory %M Kb"` (Tests.make:87); GNU time isn't in the
             # image and RUSAGE_CHILDREN.ru_maxrss is a monotonic max over
@@ -52,7 +55,7 @@ def main():
             try:
                 proc = subprocess.run(cmd, cwd=REPO,
                                       capture_output=True, text=True,
-                                      timeout=args.timeout)
+                                      timeout=args.timeout + 60)
                 out = proc.stdout + proc.stderr
                 ok = proc.returncode == 0
             except subprocess.TimeoutExpired as e:
