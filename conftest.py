@@ -1,29 +1,21 @@
 """Root pytest config: run the suite on a virtual 8-device CPU mesh.
 
-Must run before jax is imported anywhere: forces the CPU platform with 8
-virtual devices so the multi-chip sharding paths (veles/simd_tpu/parallel)
-compile and execute without TPU hardware, mirroring how the driver validates
-``__graft_entry__.dryrun_multichip``.
+Must run before any jax backend is initialized: forces the CPU platform
+with 8 virtual devices so the multi-chip sharding paths
+(veles/simd_tpu/parallel) compile and execute without TPU hardware,
+mirroring how the driver validates ``__graft_entry__.dryrun_multichip``.
+The axon TPU plugin (registered by a sitecustomize on PYTHONPATH) pins
+the platform before env vars are consulted, so the pin goes through
+jax.config — see ``veles.simd_tpu.utils.platform``, the single home for
+that logic.  Per-op TPU validation happens in ``bench.py --check`` on the
+real chip instead.
 """
 
 import os
 import sys
 
-# force CPU even when the environment pins another platform (e.g. the
-# axon TPU tunnel sets JAX_PLATFORMS=axon globally): the suite needs the
-# 8-device virtual mesh, and per-op TPU validation happens in bench.py /
-# verification drives instead.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
-
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# The axon TPU plugin (registered by a sitecustomize on PYTHONPATH) pins
-# the platform before conftest runs; the env var alone doesn't win. Force
-# the config too.
-import jax  # noqa: E402
+from veles.simd_tpu.utils.platform import pin_cpu  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+pin_cpu(8)
