@@ -8,16 +8,24 @@ Msamples/s (BASELINE.json configs[3], the flagship long-signal path) —
 with ``vs_baseline`` = speedup over the single-threaded CPU oracle
 (NumPy, the reference's ``*_na`` twin) measured in the same process.
 
-Full per-config results go to BENCH_DETAILS.json.
+Before timing, the per-family XLA-vs-oracle correctness smoke
+(``tools/tpu_smoke.py``) runs on the same device and prints one
+``TPU-CHECK`` line per family to stderr — the reference's SIMD-vs-``_na``
+discipline on real hardware.  Full per-config results go to
+BENCH_DETAILS.json.
 
 Usage:  python bench.py           # one JSON line on stdout
         python bench.py --all     # pretty table of every config
+        python bench.py --check   # correctness smoke only, no timing
 """
 
 import json
+import os
 import sys
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from veles.simd_tpu.utils.benchmark import device_time, host_time
 
@@ -128,7 +136,25 @@ def bench_dwt(rng):
 
 
 def main():
+    # the axon sitecustomize pins the platform before env vars are
+    # consulted; honor an explicit override the way cshim.py does (lets
+    # `VELES_SIMD_PLATFORM=cpu python bench.py --check` run without TPU)
+    if os.environ.get("VELES_SIMD_PLATFORM"):
+        import jax
+
+        jax.config.update("jax_platforms",
+                          os.environ["VELES_SIMD_PLATFORM"])
     import jax
+
+    from tools.tpu_smoke import run_smoke
+
+    smoke_ok = run_smoke()
+    if "--check" in sys.argv:
+        sys.exit(0 if smoke_ok else 1)
+    if not smoke_ok:
+        print("bench.py: correctness smoke FAILED on "
+              f"{jax.devices()[0]!r}; timing numbers below are suspect",
+              file=sys.stderr)
 
     rng = np.random.RandomState(0)
     configs = [bench_elementwise, bench_mathfun, bench_sgemm,

@@ -124,6 +124,42 @@ static void test_convolve(void) {
   const float sig[3] = {1, 2, 3};
   CHECK(cross_correlate_simd(1, sig, 3, sig, 3, xc) == 0);
   CHECK_NEAR(xc[2], 14.f, 1e-5); /* 1+4+9 */
+
+  /* named per-algorithm entry points must agree with the oracle */
+  VelesConvolutionHandle *hf = convolve_fft_initialize(n, k);
+  CHECK(hf != NULL);
+  CHECK(convolve_fft(hf, xs, hs, out) == 0);
+  convolve_fft_finalize(hf);
+  for (size_t i = 0; i < n + k - 1; i += 131) {
+    CHECK_NEAR(out[i], want[i], 1e-3);
+  }
+  VelesConvolutionHandle *ho = convolve_overlap_save_initialize(n, k);
+  CHECK(ho != NULL);
+  CHECK(convolve_overlap_save(ho, xs, hs, out) == 0);
+  convolve_overlap_save_finalize(ho);
+  for (size_t i = 0; i < n + k - 1; i += 131) {
+    CHECK_NEAR(out[i], want[i], 1e-3);
+  }
+  /* overlap-save contract: h must satisfy h < x/2 (integer division) */
+  CHECK(convolve_overlap_save_initialize(11, 5) == NULL);
+
+  float *cwant = mallocf(n + k - 1);
+  CHECK(cross_correlate_simd(0, xs, n, hs, k, cwant) == 0); /* oracle */
+  VelesConvolutionHandle *cf = cross_correlate_fft_initialize(n, k);
+  CHECK(cf != NULL);
+  CHECK(cross_correlate_fft(cf, xs, hs, out) == 0);
+  cross_correlate_fft_finalize(cf);
+  for (size_t i = 0; i < n + k - 1; i += 131) {
+    CHECK_NEAR(out[i], cwant[i], 1e-3);
+  }
+  VelesConvolutionHandle *co = cross_correlate_overlap_save_initialize(n, k);
+  CHECK(co != NULL);
+  CHECK(cross_correlate_overlap_save(co, xs, hs, out) == 0);
+  cross_correlate_overlap_save_finalize(co);
+  for (size_t i = 0; i < n + k - 1; i += 131) {
+    CHECK_NEAR(out[i], cwant[i], 1e-3);
+  }
+  free(cwant);
   free(xs); free(hs); free(out); free(want);
 }
 
@@ -157,6 +193,24 @@ static void test_wavelet(void) {
   CHECK(stationary_wavelet_apply(1, WAVELET_TYPE_SYMLET, 8, 2,
                                  EXTENSION_TYPE_PERIODIC, sig, 64, shi,
                                  slo) == 0);
+
+  /* layout helpers (inc/simd/wavelet.h:55-88 semantics) */
+  float *prep = wavelet_prepare_array(8, sig, 64);
+  CHECK(prep != NULL && prep[0] == sig[0] && prep[63] == sig[63]);
+  float *dest = wavelet_allocate_destination(8, 64);
+  CHECK(dest != NULL);
+  CHECK(wavelet_apply(1, WAVELET_TYPE_DAUBECHIES, 8, EXTENSION_TYPE_MIRROR,
+                      prep, 64, dest, lo8) == 0);
+  for (int i = 0; i < 32; i++) {
+    CHECK_NEAR(dest[i], hi8[i], 5e-4);
+  }
+  float *hh, *hl, *lh, *ll;
+  wavelet_recycle_source(8, prep, 64, &hh, &hl, &lh, &ll);
+  CHECK(hh == prep && hl == prep + 16 && lh == prep + 32 && ll == prep + 48);
+  wavelet_recycle_source(8, prep, 6, &hh, &hl, &lh, &ll);
+  CHECK(hh == NULL && hl == NULL && lh == NULL && ll == NULL);
+  free(prep);
+  free(dest);
 }
 
 static void test_mathfun(void) {
@@ -181,6 +235,16 @@ static void test_normalize(void) {
   uint8_t mn, mx;
   CHECK(minmax2D(1, plane, 4, 4, 4, &mn, &mx) == 0);
   CHECK(mn == 0 && mx == 255);
+
+  /* precomputed-extrema normalization must equal the composite op */
+  float out2[16];
+  CHECK(normalize2D_minmax(1, mn, mx, plane, 4, 4, 4, out2, 4) == 0);
+  for (int i = 0; i < 16; i++) {
+    CHECK_NEAR(out2[i], out[i], 1e-6);
+  }
+  /* oracle path agrees */
+  CHECK(normalize2D_minmax(0, mn, mx, plane, 4, 4, 4, out2, 4) == 0);
+  CHECK_NEAR(out2[1], 1.f, 1e-5);
 
   float fdata[5] = {3.f, -1.f, 7.f, 0.f, 2.f};
   float fmn, fmx;
@@ -217,6 +281,34 @@ static void test_conversions(void) {
   CHECK(i16out[0] == -1);      /* trunc toward zero */
   CHECK(i16out[2] == 32767);   /* saturate */
   CHECK(i16out[3] == -32768);
+
+  /* widening and saturating-narrowing int conversions */
+  int32_t i32[4];
+  CHECK(int16_to_int32(1, i16, 4, i32) == 0);
+  CHECK(i32[0] == -32768 && i32[3] == 32767);
+  int32_t wide[4] = {-100000, -5, 7, 100000};
+  CHECK(int32_to_int16(1, wide, 4, i16out) == 0);
+  CHECK(i16out[0] == -32768);  /* saturate */
+  CHECK(i16out[1] == -5 && i16out[2] == 7);
+  CHECK(i16out[3] == 32767);
+
+  /* float16 bit patterns: 1.0, -2.0, +inf, subnormal 2^-24 */
+  uint16_t h16[4] = {0x3C00, 0xC000, 0x7C00, 0x0001};
+  float f16out[4];
+  CHECK(float16_to_float(1, h16, 4, f16out) == 0);
+  CHECK(f16out[0] == 1.f && f16out[1] == -2.f);
+  CHECK(isinf(f16out[2]) && f16out[2] > 0);
+  CHECK_NEAR(f16out[3], 5.9604644775390625e-08, 1e-12);
+
+  /* alignment complements: element counts to the next 64B boundary */
+  float *al = mallocf(32);
+  CHECK(align_complement_f32(al) == 0);
+  CHECK(align_complement_f32(al + 1) == 15);
+  CHECK(align_complement_i16((int16_t *)al + 1) == 31);
+  CHECK(align_complement_u16((uint16_t *)al + 3) == 29);
+  CHECK(align_complement_i32((int32_t *)al + 2) == 14);
+  CHECK(align_complement_u32((uint32_t *)al + 2) == 14);
+  free(al);
 }
 
 int main(void) {

@@ -2,7 +2,7 @@
  *
  * Architecture (SURVEY.md §7): the TPU compute path lives in Python/JAX;
  * this translation unit provides the reference-compatible C ABI
- * (/root/reference/inc/simd/*.h) by embedding an interpreter and calling
+ * (/root/reference/inc/simd headers) by embedding an interpreter and calling
  * veles/simd_tpu/cshim.py with raw pointers.  Works both as a standalone
  * embedder (C program links libveles_simd.so) and when loaded inside an
  * existing Python process (dlopen from ctypes): PyGILState handles both.
@@ -101,13 +101,21 @@ void veles_simd_shutdown(void) {
 
 const char *veles_simd_backend(void) { return g_backend; }
 
-/* Call cshim.<method>(<args per format>) -> PyObject* (new ref), or NULL. */
-static PyObject *shim_call(const char *method, const char *format, ...) {
+/* Call cshim.<method>(<args per format>).  The returned object is parsed
+ * into plain C data by `parse` BEFORE the GIL is released: callers may be
+ * foreign threads (ctypes drops the GIL around foreign calls), so no
+ * CPython API may touch the result object after PyGILState_Release.
+ * Returns 0 when the call and the parse both succeeded. */
+typedef int (*shim_parse_fn)(PyObject *result, void *out);
+
+static int shim_call_parse(const char *method, shim_parse_fn parse, void *out,
+                           const char *format, ...) {
   if (g_mod == NULL && veles_simd_init(NULL) != 0) {
-    return NULL;
+    return -1;
   }
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject *result = NULL;
+  int rc = -1;
   va_list va;
   va_start(va, format);
   PyObject *args = Py_VaBuildValue(format, va);
@@ -120,11 +128,22 @@ static PyObject *shim_call(const char *method, const char *format, ...) {
     }
     Py_DECREF(args);
   }
-  if (result == NULL) {
-    set_error_from_python();
+  if (result != NULL) {
+    rc = parse == NULL ? 0 : parse(result, out);
+    Py_DECREF(result);
+  }
+  if (rc != 0) {
+    if (PyErr_Occurred()) {
+      set_error_from_python();
+    } else {
+      /* non-Python failure (e.g. malloc in a parse callback): don't leave
+       * a stale unrelated message in g_last_error */
+      snprintf(g_last_error, sizeof(g_last_error),
+               "%s: result parse failed", method);
+    }
   }
   PyGILState_Release(gil);
-  return result;
+  return rc;
 }
 
 /* Run a void-ish shim method; 0 on success. */
@@ -196,17 +215,22 @@ struct VelesConvolutionHandle {
   size_t h_length;
 };
 
+static int parse_long(PyObject *r, void *out) {
+  long v = PyLong_AsLong(r);
+  if (v == -1 && PyErr_Occurred()) {
+    return -1;
+  }
+  *(long *)out = v;
+  return 0;
+}
+
 static VelesConvolutionHandle *conv_init(size_t x_length, size_t h_length,
                                          int algorithm, int reverse) {
-  PyObject *r = shim_call("convolve_initialize", "(kkii)",
-                          (unsigned long)x_length, (unsigned long)h_length,
-                          algorithm, reverse);
-  if (r == NULL) {
-    return NULL;
-  }
-  long id = PyLong_AsLong(r);
-  Py_DECREF(r);
-  if (id <= 0) {
+  long id = 0;
+  if (shim_call_parse("convolve_initialize", parse_long, &id, "(kkii)",
+                      (unsigned long)x_length, (unsigned long)h_length,
+                      algorithm, reverse) != 0 ||
+      id <= 0) {
     return NULL;
   }
   VelesConvolutionHandle *handle = malloc(sizeof(*handle));
@@ -255,6 +279,66 @@ void cross_correlate_finalize(VelesConvolutionHandle *handle) {
   convolve_finalize(handle);
 }
 
+/* Named per-algorithm entry points (inc/simd/convolve.h:58-96,
+ * inc/simd/correlate.h:57-105): same registry, forced algorithm. */
+
+VelesConvolutionHandle *convolve_fft_initialize(size_t x_length,
+                                                size_t h_length) {
+  return conv_init(x_length, h_length, VELES_CONV_ALGORITHM_FFT, 0);
+}
+
+int convolve_fft(VelesConvolutionHandle *handle, const float *x,
+                 const float *h, float *result) {
+  return convolve(handle, x, h, result);
+}
+
+void convolve_fft_finalize(VelesConvolutionHandle *handle) {
+  convolve_finalize(handle);
+}
+
+VelesConvolutionHandle *convolve_overlap_save_initialize(size_t x_length,
+                                                         size_t h_length) {
+  return conv_init(x_length, h_length, VELES_CONV_ALGORITHM_OVERLAP_SAVE, 0);
+}
+
+int convolve_overlap_save(VelesConvolutionHandle *handle, const float *x,
+                          const float *h, float *result) {
+  return convolve(handle, x, h, result);
+}
+
+void convolve_overlap_save_finalize(VelesConvolutionHandle *handle) {
+  convolve_finalize(handle);
+}
+
+VelesConvolutionHandle *cross_correlate_fft_initialize(size_t x_length,
+                                                       size_t h_length) {
+  return conv_init(x_length, h_length, VELES_CONV_ALGORITHM_FFT, 1);
+}
+
+int cross_correlate_fft(VelesConvolutionHandle *handle, const float *x,
+                        const float *h, float *result) {
+  return convolve(handle, x, h, result);
+}
+
+void cross_correlate_fft_finalize(VelesConvolutionHandle *handle) {
+  convolve_finalize(handle);
+}
+
+VelesConvolutionHandle *cross_correlate_overlap_save_initialize(
+    size_t x_length, size_t h_length) {
+  return conv_init(x_length, h_length, VELES_CONV_ALGORITHM_OVERLAP_SAVE, 1);
+}
+
+int cross_correlate_overlap_save(VelesConvolutionHandle *handle,
+                                 const float *x, const float *h,
+                                 float *result) {
+  return convolve(handle, x, h, result);
+}
+
+void cross_correlate_overlap_save_finalize(VelesConvolutionHandle *handle) {
+  convolve_finalize(handle);
+}
+
 int convolve_simd(int simd, const float *x, size_t x_length,
                   const float *h, size_t h_length, float *result) {
   return shim_run("convolve_simd", "(iKkKkK)", simd, PTR(x),
@@ -271,13 +355,21 @@ int cross_correlate_simd(int simd, const float *x, size_t x_length,
 
 /* ---- wavelet ---------------------------------------------------------- */
 
+static int parse_truth(PyObject *r, void *out) {
+  int v = PyObject_IsTrue(r);
+  if (v < 0) {
+    return -1;
+  }
+  *(int *)out = v;
+  return 0;
+}
+
 int wavelet_validate_order(WaveletType type, int order) {
-  PyObject *r = shim_call("wavelet_validate_order", "(ii)", (int)type, order);
-  if (r == NULL) {
+  int valid = 0;
+  if (shim_call_parse("wavelet_validate_order", parse_truth, &valid, "(ii)",
+                      (int)type, order) != 0) {
     return 0;
   }
-  int valid = PyObject_IsTrue(r);
-  Py_DECREF(r);
   return valid == 1;
 }
 
@@ -328,54 +420,95 @@ int normalize2D(int simd, const uint8_t *src, size_t src_stride,
                   (unsigned long)dst_stride);
 }
 
+static int parse_long_pair(PyObject *r, void *out) {
+  long *pair = (long *)out;
+  return PyArg_ParseTuple(r, "ll", &pair[0], &pair[1]) ? 0 : -1;
+}
+
+static int parse_double_pair(PyObject *r, void *out) {
+  double *pair = (double *)out;
+  return PyArg_ParseTuple(r, "dd", &pair[0], &pair[1]) ? 0 : -1;
+}
+
 int minmax2D(int simd, const uint8_t *src, size_t src_stride,
              size_t width, size_t height, uint8_t *min, uint8_t *max) {
-  PyObject *r = shim_call("minmax2D", "(iKkkk)", simd, PTR(src),
-                          (unsigned long)src_stride, (unsigned long)width,
-                          (unsigned long)height);
-  if (r == NULL) {
+  long pair[2];
+  if (shim_call_parse("minmax2D", parse_long_pair, pair, "(iKkkk)", simd,
+                      PTR(src), (unsigned long)src_stride,
+                      (unsigned long)width, (unsigned long)height) != 0) {
     return -1;
   }
-  long mn, mx;
-  if (!PyArg_ParseTuple(r, "ll", &mn, &mx)) {
-    set_error_from_python();
-    Py_DECREF(r);
-    return -1;
-  }
-  Py_DECREF(r);
   if (min != NULL) {
-    *min = (uint8_t)mn;
+    *min = (uint8_t)pair[0];
   }
   if (max != NULL) {
-    *max = (uint8_t)mx;
+    *max = (uint8_t)pair[1];
   }
   return 0;
 }
 
 int minmax1D(int simd, const float *src, size_t length,
              float *min, float *max) {
-  PyObject *r = shim_call("minmax1D", "(iKk)", simd, PTR(src),
-                          (unsigned long)length);
-  if (r == NULL) {
+  double pair[2];
+  if (shim_call_parse("minmax1D", parse_double_pair, pair, "(iKk)", simd,
+                      PTR(src), (unsigned long)length) != 0) {
     return -1;
   }
-  double mn, mx;
-  if (!PyArg_ParseTuple(r, "dd", &mn, &mx)) {
-    set_error_from_python();
-    Py_DECREF(r);
-    return -1;
-  }
-  Py_DECREF(r);
   if (min != NULL) {
-    *min = (float)mn;
+    *min = (float)pair[0];
   }
   if (max != NULL) {
-    *max = (float)mx;
+    *max = (float)pair[1];
   }
   return 0;
 }
 
+int normalize2D_minmax(int simd, uint8_t min, uint8_t max,
+                       const uint8_t *src, size_t src_stride,
+                       size_t width, size_t height,
+                       float *dst, size_t dst_stride) {
+  return shim_run("normalize2D_minmax", "(iiiKkkkKk)", simd, (int)min,
+                  (int)max, PTR(src), (unsigned long)src_stride,
+                  (unsigned long)width, (unsigned long)height, PTR(dst),
+                  (unsigned long)dst_stride);
+}
+
 /* ---- detect_peaks ----------------------------------------------------- */
+
+struct peaks_out {
+  ExtremumPoint *pts;
+  size_t n;
+};
+
+static int parse_peaks(PyObject *r, void *out) {
+  struct peaks_out *po = (struct peaks_out *)out;
+  PyObject *pos = NULL, *vals = NULL;
+  if (!PyArg_ParseTuple(r, "OO", &pos, &vals)) {
+    return -1;
+  }
+  Py_ssize_t n = PyList_Size(pos);
+  if (n < 0) {
+    return -1;
+  }
+  if (n == 0) {
+    return 0; /* no peaks: NULL + 0, reference behavior */
+  }
+  ExtremumPoint *pts = malloc((size_t)n * sizeof(*pts));
+  if (pts == NULL) {
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    pts[i].position = (int)PyLong_AsLong(PyList_GetItem(pos, i));
+    pts[i].value = (float)PyFloat_AsDouble(PyList_GetItem(vals, i));
+  }
+  if (PyErr_Occurred()) {
+    free(pts);
+    return -1;
+  }
+  po->pts = pts;
+  po->n = (size_t)n;
+  return 0;
+}
 
 int detect_peaks(int simd, const float *data, size_t size, ExtremumType type,
                  ExtremumPoint **results, size_t *results_length) {
@@ -384,34 +517,14 @@ int detect_peaks(int simd, const float *data, size_t size, ExtremumType type,
   }
   *results = NULL;
   *results_length = 0;
-  PyObject *r = shim_call("detect_peaks", "(iKki)", simd, PTR(data),
-                          (unsigned long)size, (int)type);
-  if (r == NULL) {
+  struct peaks_out po = {NULL, 0};
+  if (shim_call_parse("detect_peaks", parse_peaks, &po, "(iKki)", simd,
+                      PTR(data), (unsigned long)size, (int)type) != 0) {
     return -1;
   }
-  PyObject *pos = NULL, *vals = NULL;
-  int rc = -1;
-  if (PyArg_ParseTuple(r, "OO", &pos, &vals)) {
-    Py_ssize_t n = PyList_Size(pos);
-    if (n > 0) {
-      ExtremumPoint *pts = malloc((size_t)n * sizeof(*pts));
-      if (pts != NULL) {
-        for (Py_ssize_t i = 0; i < n; i++) {
-          pts[i].position = (int)PyLong_AsLong(PyList_GetItem(pos, i));
-          pts[i].value = (float)PyFloat_AsDouble(PyList_GetItem(vals, i));
-        }
-        *results = pts;
-        *results_length = (size_t)n;
-        rc = 0;
-      }
-    } else {
-      rc = 0; /* no peaks: NULL + 0, reference behavior */
-    }
-  } else {
-    set_error_from_python();
-  }
-  Py_DECREF(r);
-  return rc;
+  *results = po.pts;
+  *results_length = po.n;
+  return 0;
 }
 
 /* ---- conversions ------------------------------------------------------ */
@@ -433,4 +546,16 @@ int int32_to_float(int simd, const int32_t *src, size_t length, float *dst) {
 }
 int float_to_int32(int simd, const float *src, size_t length, int32_t *dst) {
   return convert("float_to_int32", simd, src, length, dst);
+}
+int int16_to_int32(int simd, const int16_t *src, size_t length,
+                   int32_t *dst) {
+  return convert("int16_to_int32", simd, src, length, dst);
+}
+int int32_to_int16(int simd, const int32_t *src, size_t length,
+                   int16_t *dst) {
+  return convert("int32_to_int16", simd, src, length, dst);
+}
+int float16_to_float(int simd, const uint16_t *src, size_t length,
+                     float *dst) {
+  return convert("float16_to_float", simd, src, length, dst);
 }

@@ -3,11 +3,13 @@
  * Mirrors the reference's public header surface
  * (/root/reference/inc/simd/{matrix,convolve,correlate,wavelet,normalize,
  * detect_peaks,mathfun,memory}.h) so C callers of the original library can
- * relink against the TPU backend: the compute path dispatches through an
- * embedded CPython interpreter into veles.simd_tpu (JAX/XLA), per the
- * SURVEY.md §7 target architecture.  Pure-host helpers (aligned alloc,
- * zero padding, reversed copies) are implemented natively in C with no
- * Python involvement.
+ * source-port with minimal changes (not binary relink: handles are opaque
+ * pointers instead of by-value structs, the auto-select initializers gained
+ * an `algorithm` parameter, and void functions return error codes — see
+ * each section).  The compute path dispatches through an embedded CPython
+ * interpreter into veles.simd_tpu (JAX/XLA), per the SURVEY.md §7 target
+ * architecture.  Pure-host helpers (aligned alloc, zero padding, reversed
+ * copies) are implemented natively in C with no Python involvement.
  *
  * Every compute entry point keeps the reference's `int simd` flag:
  * nonzero -> the XLA backend (TPU when available), zero -> the NumPy
@@ -53,6 +55,13 @@ int matrix_multiply_transposed(int simd, const float *m1, const float *m2,
 
 typedef struct VelesConvolutionHandle VelesConvolutionHandle;
 
+enum {
+  VELES_CONV_ALGORITHM_AUTO = 0,
+  VELES_CONV_ALGORITHM_BRUTE_FORCE = 1,
+  VELES_CONV_ALGORITHM_FFT = 2,
+  VELES_CONV_ALGORITHM_OVERLAP_SAVE = 3
+};
+
 /* algorithm: 0 = auto (reference convolve_initialize heuristic re-derived
  * for TPU), 1 = brute force, 2 = FFT, 3 = overlap-save. */
 VelesConvolutionHandle *convolve_initialize(size_t x_length, size_t h_length,
@@ -63,6 +72,20 @@ void convolve_finalize(VelesConvolutionHandle *handle);
 int convolve_simd(int simd, const float *x, size_t x_length,
                   const float *h, size_t h_length, float *result);
 
+/* Named per-algorithm entry points (inc/simd/convolve.h:58-96).  The
+ * reference types ConvolutionFFTHandle / ConvolutionOverlapSaveHandle are
+ * one opaque handle type here; the algorithm is pinned at initialize. */
+VelesConvolutionHandle *convolve_fft_initialize(size_t x_length,
+                                                size_t h_length);
+int convolve_fft(VelesConvolutionHandle *handle, const float *x,
+                 const float *h, float *result);
+void convolve_fft_finalize(VelesConvolutionHandle *handle);
+VelesConvolutionHandle *convolve_overlap_save_initialize(size_t x_length,
+                                                         size_t h_length);
+int convolve_overlap_save(VelesConvolutionHandle *handle, const float *x,
+                          const float *h, float *result);
+void convolve_overlap_save_finalize(VelesConvolutionHandle *handle);
+
 VelesConvolutionHandle *cross_correlate_initialize(size_t x_length,
                                                    size_t h_length,
                                                    int algorithm);
@@ -71,6 +94,19 @@ int cross_correlate(VelesConvolutionHandle *handle, const float *x,
 void cross_correlate_finalize(VelesConvolutionHandle *handle);
 int cross_correlate_simd(int simd, const float *x, size_t x_length,
                          const float *h, size_t h_length, float *result);
+
+/* Named per-algorithm entry points (inc/simd/correlate.h:57-105). */
+VelesConvolutionHandle *cross_correlate_fft_initialize(size_t x_length,
+                                                       size_t h_length);
+int cross_correlate_fft(VelesConvolutionHandle *handle, const float *x,
+                        const float *h, float *result);
+void cross_correlate_fft_finalize(VelesConvolutionHandle *handle);
+VelesConvolutionHandle *cross_correlate_overlap_save_initialize(
+    size_t x_length, size_t h_length);
+int cross_correlate_overlap_save(VelesConvolutionHandle *handle,
+                                 const float *x, const float *h,
+                                 float *result);
+void cross_correlate_overlap_save_finalize(VelesConvolutionHandle *handle);
 
 /* ---- wavelet (inc/simd/wavelet.h) ------------------------------------- */
 
@@ -88,6 +124,19 @@ typedef enum {
 } ExtensionType;
 
 int wavelet_validate_order(WaveletType type, int order);
+
+/* Layout helpers (inc/simd/wavelet.h:55-88).  The reference's AVX build
+ * returns a duplicated shifted-copy layout from wavelet_prepare_array; XLA
+ * owns device layout, so here it is a plain copy (the non-AVX reference
+ * semantics) — returned buffers come from mallocf(), free() them. */
+float *wavelet_prepare_array(int order, const float *src, size_t length);
+float *wavelet_allocate_destination(int order, size_t source_length);
+/* Splits src into four quarters for cascade reuse; pointers become NULL
+ * when length is 0 or not divisible by 4 (src/wavelet.c:138-165). */
+void wavelet_recycle_source(int order, float *src, size_t length,
+                            float **desthihi, float **desthilo,
+                            float **destlohi, float **destlolo);
+
 /* desthi/destlo must hold length/2 floats (decimated DWT). */
 int wavelet_apply(int simd, WaveletType type, int order, ExtensionType ext,
                   const float *src, size_t length,
@@ -110,6 +159,11 @@ int normalize2D(int simd, const uint8_t *src, size_t src_stride,
                 size_t width, size_t height, float *dst, size_t dst_stride);
 int minmax2D(int simd, const uint8_t *src, size_t src_stride,
              size_t width, size_t height, uint8_t *min, uint8_t *max);
+/* Normalization with precomputed extrema (inc/simd/normalize.h:66-79). */
+int normalize2D_minmax(int simd, uint8_t min, uint8_t max,
+                       const uint8_t *src, size_t src_stride,
+                       size_t width, size_t height,
+                       float *dst, size_t dst_stride);
 int minmax1D(int simd, const float *src, size_t length,
              float *min, float *max);
 
@@ -136,6 +190,13 @@ int int16_to_float(int simd, const int16_t *src, size_t length, float *dst);
 int float_to_int16(int simd, const float *src, size_t length, int16_t *dst);
 int int32_to_float(int simd, const int32_t *src, size_t length, float *dst);
 int float_to_int32(int simd, const float *src, size_t length, int32_t *dst);
+int int16_to_int32(int simd, const int16_t *src, size_t length, int32_t *dst);
+/* Saturating narrow (arithmetic.h:270 packs semantics). */
+int int32_to_int16(int simd, const int32_t *src, size_t length, int16_t *dst);
+/* IEEE binary16 bit patterns -> float32 incl. subnormals/inf/nan
+ * (arithmetic.h:92-127). */
+int float16_to_float(int simd, const uint16_t *src, size_t length,
+                     float *dst);
 
 /* ---- memory (inc/simd/memory.h:40-179) — pure C, no Python ------------ */
 
@@ -150,7 +211,14 @@ float *zeropaddingex(const float *data, size_t length, size_t *new_length,
 float *rmemcpyf(float *dest, const float *src, size_t length);
 float *crmemcpyf(float *dest, const float *src, size_t length);
 int next_highest_power_of_2(int value);
+/* Elements from ptr to the next 64-byte boundary (inc/simd/memory.h:120-179;
+ * the reference uses its 32-byte AVX alignment, this build the 64-byte host
+ * staging alignment). */
 int align_complement_f32(const float *ptr);
+int align_complement_i16(const int16_t *ptr);
+int align_complement_u16(const uint16_t *ptr);
+int align_complement_i32(const int32_t *ptr);
+int align_complement_u32(const uint32_t *ptr);
 
 #ifdef __cplusplus
 }

@@ -7,6 +7,8 @@
  * host-side staging buffers for the C ABI.
  */
 
+#define _POSIX_C_SOURCE 200112L
+
 #include "veles_simd.h"
 
 #include <stdlib.h>
@@ -102,7 +104,73 @@ float *crmemcpyf(float *dest, const float *src, size_t length) {
   return dest;
 }
 
+/* Elements from ptr to the next 64-byte boundary (src/memory.c:42-68
+ * pattern; the reference divides its 32-byte AVX alignment, this build the
+ * 64-byte host staging alignment used by malloc_aligned). */
+static int align_offset_bytes(const void *ptr) {
+  uintptr_t addr = (uintptr_t)ptr;
+  if ((addr & (VELES_ALIGNMENT - 1)) != 0) {
+    return (int)(VELES_ALIGNMENT - (addr % VELES_ALIGNMENT));
+  }
+  return 0;
+}
+
 int align_complement_f32(const float *ptr) {
-  (void)ptr;
-  return 0; /* XLA owns device layout; host buffers are 64B-aligned */
+  return align_offset_bytes(ptr) / 4;
+}
+
+int align_complement_i16(const int16_t *ptr) {
+  return align_offset_bytes(ptr) / 2;
+}
+
+int align_complement_u16(const uint16_t *ptr) {
+  return align_offset_bytes(ptr) / 2;
+}
+
+int align_complement_i32(const int32_t *ptr) {
+  return align_offset_bytes(ptr) / 4;
+}
+
+int align_complement_u32(const uint32_t *ptr) {
+  return align_offset_bytes(ptr) / 4;
+}
+
+/* ---- wavelet layout helpers (inc/simd/wavelet.h:55-88) ----------------
+ * The reference's AVX build interleaves shifted copies for aligned
+ * dp_ps loads (src/wavelet.c:100-165); XLA owns device layout, so these
+ * follow the reference's non-AVX semantics: plain copy / plain halves. */
+
+float *wavelet_prepare_array(int order, const float *src, size_t length) {
+  (void)order;
+  float *res = mallocf(length);
+  if (res != NULL) {
+    memcpy(res, src, length * sizeof(*src));
+  }
+  return res;
+}
+
+float *wavelet_allocate_destination(int order, size_t source_length) {
+  (void)order;
+  if (source_length < 2 || source_length % 2 != 0) {
+    return NULL;
+  }
+  return mallocf(source_length / 2);
+}
+
+void wavelet_recycle_source(int order, float *src, size_t length,
+                            float **desthihi, float **desthilo,
+                            float **destlohi, float **destlolo) {
+  (void)order;
+  if (length == 0 || length % 4 != 0) {
+    *desthihi = NULL;
+    *desthilo = NULL;
+    *destlohi = NULL;
+    *destlolo = NULL;
+    return;
+  }
+  size_t lq = length / 4;
+  *desthihi = src;
+  *desthilo = src + lq;
+  *destlohi = src + lq * 2;
+  *destlolo = src + lq * 3;
 }

@@ -103,11 +103,16 @@ def test_fixed_default_capacity_holds_alternating():
     assert int((np.asarray(pos) >= 0).sum()) == 38
 
 
-def test_fixed_overlarge_max_peaks_clamped():
+def test_fixed_overlarge_max_peaks_honored():
+    """A caller-supplied capacity is honored exactly (not clamped to n-2),
+    so jitted pipelines keep one output shape across signal lengths; the
+    impossible slots are always empty."""
     x = np.array([0, 2, 0], np.float32)
     pos, vals, count = dp.detect_peaks_fixed(x, dp.ExtremumType.BOTH,
                                              max_peaks=50)
-    assert pos.shape == (1,) and int(count) == 1
+    assert pos.shape == (50,) and int(count) == 1
+    assert int(pos[0]) == 1 and np.all(np.asarray(pos[1:]) == -1)
+    assert np.all(np.asarray(vals[1:]) == 0)
 
 
 def test_contract_violation():

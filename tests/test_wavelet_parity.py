@@ -1,0 +1,101 @@
+"""Coefficient-table parity against the reference-published values.
+
+The reference tables (``/root/reference/src/{daubechies,symlets,coiflets}.c``)
+are the spec (VERDICT round-1 item 3): every (family, order) this framework
+exposes must agree with the published double rows.  Symlets are stored
+verbatim from the published table (it is the drop-in parity contract);
+Daubechies and Coiflets are derived numerically and must land on the
+published values to their printed precision.
+
+A second layer cross-checks *provenance*: the symlet root selections
+recovered in ``wavelet_coeffs._SYMLET_SELECTIONS`` rebuild each published
+row in exact arithmetic to within the published table's own generation
+error (``tools/gen_wavelet_tables.published_drift_bound``), demonstrating
+the stored rows are the least-asymmetric family members they claim to be.
+
+Skipped wholesale when the reference checkout isn't mounted.
+"""
+
+import os
+import re
+import sys
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu.ops import wavelet_coeffs as wc
+
+REFERENCE = os.environ.get("VELES_SIMD_REFERENCE", "/root/reference")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(os.path.join(REFERENCE, "src", "symlets.c")),
+    reason="reference tables not mounted")
+
+
+def _parse_table(filename, symbol):
+    src = open(os.path.join(REFERENCE, "src", filename)).read()
+    body = src[src.index(symbol):]
+    body = body[:body.index("};\n")]
+    rows = re.findall(r"\{([^{}]*)\}", body)
+    return [np.array([float(v) for v in re.findall(r"[-+0-9.eE]+", r)])
+            for r in rows]
+
+
+@pytest.fixture(scope="module")
+def ref_tables():
+    return {
+        wc.WaveletType.DAUBECHIES: _parse_table("daubechies.c",
+                                                "kDaubechiesD"),
+        wc.WaveletType.SYMLET: _parse_table("symlets.c", "kSymletsD"),
+        wc.WaveletType.COIFLET: _parse_table("coiflets.c", "kCoifletsD"),
+    }
+
+
+def _ref_row(ref_tables, wtype, order):
+    if wtype is wc.WaveletType.COIFLET:
+        row = ref_tables[wtype][order // 6 - 1]
+    else:
+        row = ref_tables[wtype][order // 2 - 1]
+    assert len(row) == order, (wtype, order, len(row))
+    return row
+
+
+@pytest.mark.parametrize("wtype", list(wc.WaveletType))
+def test_every_order_matches_published(wtype, ref_tables):
+    """VERDICT item 3: all 38 daub + 38 sym + 5 coif orders vs published."""
+    for order in wc.supported_orders(wtype):
+        ref = _ref_row(ref_tables, wtype, order)
+        ours = wc.scaling_coefficients(wtype, order)
+        if wtype is wc.WaveletType.DAUBECHIES:
+            # derived; must land on the published values to their printed
+            # precision (~13 significant digits)
+            np.testing.assert_allclose(
+                ours, ref, atol=1e-11, rtol=0,
+                err_msg=f"{wtype.value}{order}")
+        else:
+            # symlets/coiflets are stored verbatim from the published
+            # tables (their high orders carry the reference's own
+            # generation error — see tools/gen_wavelet_tables.py)
+            np.testing.assert_array_equal(
+                ours, ref, err_msg=f"{wtype.value}{order}")
+
+
+@pytest.mark.parametrize("order", [8, 16, 34, 40, 50])
+def test_symlet_selection_rebuilds_published(order, ref_tables):
+    """Provenance: the recovered root selection reproduces the published row
+    in exact arithmetic (fast orders only; the full 38-order sweep runs in
+    tools/gen_wavelet_tables.py)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "tools"))
+    from gen_wavelet_tables import published_drift_bound
+
+    ref = _ref_row(ref_tables, wc.WaveletType.SYMLET, order)
+    mirror, bits = wc._SYMLET_SELECTIONS[order]
+    h = wc._symlet_from_selection(order, mirror, bits) / np.sqrt(2)
+    drift = float(np.max(np.abs(h - ref)))
+    assert drift < published_drift_bound(order), (order, drift)
+
+
+def test_symlet_selections_cover_all_orders():
+    orders = set(wc.supported_orders(wc.WaveletType.SYMLET))
+    assert set(wc._SYMLET_SELECTIONS) == orders - {2}

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Per-op-family XLA-vs-oracle correctness smoke on the *actual* device.
+
+The pytest suite deliberately pins itself to a virtual CPU mesh
+(``conftest.py``), so before round 2 nothing validated numerics on the real
+TPU.  This harness runs each op family through its public entry point on
+the default JAX device (the TPU under the driver) against the NumPy oracle
+twin — the reference's SIMD-vs-``_na`` discipline
+(``/root/reference/tests/matrix.cc:94-98``) on actual hardware.
+
+Used two ways:
+
+* ``python tools/tpu_smoke.py`` — standalone, exits nonzero on failure;
+* ``bench.py`` runs it before timing (and ``bench.py --check`` runs only
+  it), emitting one ``TPU-CHECK`` line per family to stderr.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def _rel_err(got, want):
+    got = np.asarray(got, np.float64)
+    want = np.asarray(want, np.float64)
+    scale = np.max(np.abs(want)) or 1.0
+    return float(np.max(np.abs(got - want)) / scale)
+
+
+def _check_arithmetic(rng):
+    from veles.simd_tpu.ops import arithmetic as ar
+
+    i16 = rng.randint(-30000, 30000, 4096).astype(np.int16)
+    f = rng.randn(4096).astype(np.float32) * 100
+    errs = [
+        _rel_err(ar.int16_to_float(i16, simd=True),
+                 ar.int16_to_float_na(i16)),
+        _rel_err(ar.float_to_int16(f, simd=True), ar.float_to_int16_na(f)),
+    ]
+    wide = rng.randint(-1 << 20, 1 << 20, 1024).astype(np.int32)
+    errs.append(_rel_err(ar.int32_to_int16(wide, simd=True),
+                         ar.int32_to_int16_na(wide)))
+    a = rng.randn(2048).astype(np.float32)
+    b = rng.randn(2048).astype(np.float32)
+    errs.append(_rel_err(ar.complex_multiply(a, b, simd=True),
+                         ar.complex_multiply_na(a, b)))
+    return max(errs), 1e-6
+
+
+def _check_mathfun(rng):
+    from veles.simd_tpu.ops import mathfun as mf
+
+    x = np.abs(rng.randn(65536).astype(np.float32)) + 0.1
+    errs = [
+        _rel_err(mf.sin_psv(x, simd=True), np.sin(x)),
+        _rel_err(mf.cos_psv(x, simd=True), np.cos(x)),
+        _rel_err(mf.log_psv(x, simd=True), np.log(x)),
+        _rel_err(mf.exp_psv(x, simd=True), np.exp(x)),
+    ]
+    return max(errs), 1e-5
+
+
+def _check_matrix(rng):
+    from veles.simd_tpu.ops import matrix as mx
+
+    a = rng.randn(256, 192).astype(np.float32)
+    b = rng.randn(192, 320).astype(np.float32)
+    v = rng.randn(192).astype(np.float32)
+    errs = [
+        _rel_err(mx.matrix_multiply(a, b, simd=True),
+                 mx.matrix_multiply_novec(a, b)),
+        _rel_err(mx.matrix_multiply_transposed(a, b.T.copy(), simd=True),
+                 mx.matrix_multiply_novec(a, b)),
+        _rel_err(mx.matrix_vector_multiply(a, v, simd=True), a @ v),
+        _rel_err(mx.matrix_add(a, a, simd=True), a + a),
+    ]
+    return max(errs), 1e-4
+
+
+def _check_convolve(rng):
+    from veles.simd_tpu.ops import convolve as cv
+
+    x = rng.randn(20000).astype(np.float32)
+    h = rng.randn(257).astype(np.float32)
+    want = np.convolve(x.astype(np.float64), h.astype(np.float64))
+    errs = []
+    for algo in cv.ConvolutionAlgorithm:
+        handle = cv.convolve_initialize(len(x), len(h), algo)
+        errs.append(_rel_err(cv.convolve(handle, x, h, simd=True), want))
+    return max(errs), 1e-4
+
+
+def _check_correlate(rng):
+    from veles.simd_tpu.ops import correlate as cr
+
+    x = rng.randn(20000).astype(np.float32)
+    h = rng.randn(257).astype(np.float32)
+    want = np.correlate(np.pad(x.astype(np.float64), (256, 256)),
+                        h.astype(np.float64), mode="valid")
+    handle = cr.cross_correlate_initialize(len(x), len(h))
+    errs = [_rel_err(cr.cross_correlate(handle, x, h, simd=True), want),
+            _rel_err(cr.cross_correlate_simd(x, h, simd=True), want)]
+    return max(errs), 1e-4
+
+
+def _check_wavelet(rng):
+    from veles.simd_tpu.ops import wavelet as wv
+    from veles.simd_tpu.ops.wavelet_coeffs import WaveletType
+
+    x = rng.randn(4096).astype(np.float32)
+    errs = []
+    for wtype, order in ((WaveletType.DAUBECHIES, 8), (WaveletType.SYMLET, 8),
+                         (WaveletType.COIFLET, 6)):
+        for ext in wv.ExtensionType:
+            hi, lo = wv.wavelet_apply(wtype, order, ext, x, simd=True)
+            hi_na, lo_na = wv.wavelet_apply_na(wtype, order, ext, x)
+            errs += [_rel_err(hi, hi_na), _rel_err(lo, lo_na)]
+    shi, slo = wv.stationary_wavelet_apply(
+        WaveletType.DAUBECHIES, 8, 2, wv.ExtensionType.PERIODIC, x,
+        simd=True)
+    shi_na, slo_na = wv.stationary_wavelet_apply_na(
+        WaveletType.DAUBECHIES, 8, 2, wv.ExtensionType.PERIODIC, x)
+    errs += [_rel_err(shi, shi_na), _rel_err(slo, slo_na)]
+    return max(errs), 5e-4  # tests/wavelet.cc:84-86 epsilon
+
+
+def _check_normalize(rng):
+    from veles.simd_tpu.ops import normalize as nz
+
+    plane = rng.randint(0, 256, (64, 96)).astype(np.uint8)
+    errs = [_rel_err(nz.normalize2D(plane, simd=True),
+                     nz.normalize2D_novec(plane))]
+    mn, mx = nz.minmax2D(plane, simd=True)
+    mn_na, mx_na = nz.minmax2D_novec(plane)
+    errs.append(0.0 if (int(mn), int(mx)) == (int(mn_na), int(mx_na))
+                else 1.0)
+    f = rng.randn(5000).astype(np.float32)
+    fmn, fmx = nz.minmax1D(f, simd=True)
+    errs.append(_rel_err([fmn, fmx], [f.min(), f.max()]))
+    return max(errs), 1e-6
+
+
+def _check_detect_peaks(rng):
+    from veles.simd_tpu.ops import detect_peaks as dp
+
+    x = np.cumsum(rng.randn(8192)).astype(np.float32)
+    pos, vals = dp.detect_peaks(x, dp.ExtremumType.BOTH, simd=True)
+    pos_na, vals_na = dp.detect_peaks_na(x, dp.ExtremumType.BOTH)
+    if len(pos) != len(pos_na) or not np.array_equal(pos, pos_na):
+        return 1.0, 1e-6
+    return _rel_err(vals, vals_na), 1e-6
+
+
+FAMILIES = [
+    ("arithmetic", _check_arithmetic),
+    ("mathfun", _check_mathfun),
+    ("matrix", _check_matrix),
+    ("convolve", _check_convolve),
+    ("correlate", _check_correlate),
+    ("wavelet", _check_wavelet),
+    ("normalize", _check_normalize),
+    ("detect_peaks", _check_detect_peaks),
+]
+
+
+def run_smoke(emit=None) -> bool:
+    """Run every family check on the default device; True when all pass."""
+    import jax
+
+    if emit is None:
+        emit = lambda s: print(s, file=sys.stderr)
+    device = str(jax.devices()[0])
+    rng = np.random.RandomState(7)
+    all_ok = True
+    for name, check in FAMILIES:
+        try:
+            err, tol = check(rng)
+            ok = err <= tol
+        except Exception as e:  # surface, keep checking other families
+            err, tol, ok = float("nan"), 0.0, False
+            emit(f"TPU-CHECK family={name} EXCEPTION: {e!r}")
+        all_ok &= ok
+        emit(f"TPU-CHECK family={name} device={device!r} "
+             f"max_rel_err={err:.2e} tol={tol:.0e} "
+             f"{'ok' if ok else 'FAIL'}")
+    return all_ok
+
+
+if __name__ == "__main__":
+    sys.exit(0 if run_smoke() else 1)

@@ -190,6 +190,15 @@ def minmax1D(simd, src, length):
     return (float(mn), float(mx))
 
 
+def normalize2D_minmax(simd, mn, mx, src, src_stride, width, height, dst,
+                       dst_stride):
+    plane = _u8(src, height, src_stride)[..., :width]
+    out = np.asarray(_nz.normalize2D_minmax(int(mn), int(mx), plane,
+                                            simd=bool(simd)))
+    _f32(dst, height, dst_stride)[..., :width] = out
+    return 0
+
+
 # ---- detect_peaks ---------------------------------------------------------
 
 def detect_peaks(simd, data, size, etype):
@@ -215,6 +224,15 @@ def convert(name, simd, src, length, dst):
     elif name == "float_to_int32":
         _arr(dst, (length,), ctypes.c_int32)[...] = _ar.float_to_int32(
             _f32(src, length), simd=bool(simd))
+    elif name == "int16_to_int32":
+        _arr(dst, (length,), ctypes.c_int32)[...] = _ar.int16_to_int32(
+            _arr(src, (length,), ctypes.c_int16), simd=bool(simd))
+    elif name == "int32_to_int16":
+        _arr(dst, (length,), ctypes.c_int16)[...] = _ar.int32_to_int16(
+            _arr(src, (length,), ctypes.c_int32), simd=bool(simd))
+    elif name == "float16_to_float":
+        _f32(dst, length)[...] = _ar.float16_to_float(
+            _arr(src, (length,), ctypes.c_uint16), simd=bool(simd))
     else:
         raise ValueError(name)
     return 0

@@ -76,6 +76,21 @@ class ConvolutionAlgorithm(enum.Enum):
 # competitive to far larger filters than an 8-wide AVX dot).
 AUTO_OVERLAP_SAVE_MIN_X = 1 << 14   # long-signal path
 AUTO_FFT_MIN_PRODUCT = 1 << 22      # x*h beyond which spectral wins
+# within overlap-save: MXU block-matmul for filters up to this many taps,
+# batched-frames FFT beyond (measured crossover on v5e, see BASELINE.md)
+AUTO_OS_MATMUL_MAX_H = 1 << 14
+
+
+def overlap_save_step(h_length: int) -> int:
+    """Output-block size for the MXU overlap-save variant.
+
+    Each block costs a ``[B, step+k-1] x [step+k-1, step]`` matmul, so the
+    MAC overhead vs the direct form is ``(step+k-1)/k`` while MXU tiling
+    wants both free dims ≥ 512.  Measured on v5e (1M signal): step 2048
+    beats 512/1024 at k=2047 despite 2x MAC redundancy — MXU shape
+    efficiency dominates; smaller filters keep step ≥ 512.
+    """
+    return max(512, min(next_highest_power_of_2(int(h_length)), 4096))
 
 
 def overlap_save_block_length(h_length: int) -> int:
@@ -115,7 +130,10 @@ def select_algorithm(x_length: int, h_length: int) -> ConvolutionAlgorithm:
     → overlap-save; large balanced problem → FFT; otherwise direct (MXU).
     """
     x_length, h_length = int(x_length), int(h_length)
-    if x_length > 2 * h_length and x_length >= AUTO_OVERLAP_SAVE_MIN_X:
+    # h < x//2, not x > 2h: must satisfy the overlap-save handle contract
+    # exactly (integer division, src/convolve.c:105), else x = 2h+1 would
+    # select an algorithm whose initializer rejects it
+    if h_length < x_length // 2 and x_length >= AUTO_OVERLAP_SAVE_MIN_X:
         return ConvolutionAlgorithm.OVERLAP_SAVE
     if x_length * h_length >= AUTO_FFT_MIN_PRODUCT:
         return ConvolutionAlgorithm.FFT
@@ -157,9 +175,66 @@ def _conv_fft(x, h, m, reverse=False):
         jnp.float32)
 
 
+@functools.partial(jax.jit, static_argnames=("step", "reverse"))
+def _conv_os_matmul(x, h, step, reverse=False):
+    """Overlap-save with the per-block filter as one MXU matmul.
+
+    The reference's overlap-save runs an FFT·multiply·IFFT per block
+    (``src/convolve.c:181-228``).  On TPU the direct form wins for all but
+    very long filters: a 2047-tap 1M-point convolution is ~4 GFLOP of MAC
+    work, which the MXU finishes in well under a millisecond while XLA's
+    TPU FFT needs ~9 ms.  Formulation: outputs are computed in blocks of
+    ``step`` samples; block i needs input samples ``[i*step - (k-1),
+    i*step + step)``, so the signal is framed into overlapping rows
+    ``frames[i, a] = x_ext[i*step + a]`` (``x_ext`` = signal with ``k-1``
+    leading zeros) and each block is ``frames @ M`` with
+    ``M[a, t] = h[t + k - 1 - a]`` — a ``[B, step+k-1] x [step+k-1, step]``
+    matmul whose both free dims are large enough to tile onto the MXU.
+
+    Both operands are materialized *gather-free* (TPU gathers are ~100x
+    slower than the matmul itself — measured 37 ms for the frame gather
+    vs 0.17 ms for the matmul):
+
+    * frames = J shifted row-blocks of the zero-padded signal reshaped to
+      ``[B+J, step]``, concatenated along columns;
+    * the Toeplitz ``M`` (as its transpose MT) via a tile trick: rows of
+      MT are ``flip(h)`` shifted right by t, and tiling
+      ``w = [flip(h), zeros(step+1)]`` ``step`` times then reshaping to
+      ``[step, k+step]`` yields exactly those shifts, because
+      ``t*(k+step) ≡ -t (mod k+step+1)``.
+
+    ``precision=HIGHEST`` keeps f32 accuracy (~5e-7 rel. error on randn
+    signals, measured against a float64 oracle); DEFAULT bf16 passes give
+    ~3e-3 and are not acceptable for the oracle tests.
+    """
+    n = x.shape[-1]
+    k = h.shape[-1]
+    s = step
+    out_len = n + k - 1
+    n_blocks = -(-out_len // s)
+    J = -(-(s + k - 1) // s)
+
+    kernel = jnp.flip(h, axis=-1) if reverse else h
+    # frames[..., i, a] = x_ext[..., i*s + a], a in [0, s+k-1)
+    pad_tail = (n_blocks + J) * s - (n + k - 1)
+    x_ext = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(k - 1, pad_tail)])
+    Z = x_ext.reshape(x.shape[:-1] + (n_blocks + J, s))
+    frames = jnp.concatenate(
+        [Z[..., j:j + n_blocks, :] for j in range(J)],
+        axis=-1)[..., : s + k - 1]
+    # MT[t, a] = kernel_rev[a - t]; kernel_rev[m] = kernel[k-1-m] so that
+    # y[i*s+t] = sum_a frames[i, a] * kernel[t + k - 1 - a]
+    w = jnp.pad(jnp.flip(kernel, axis=-1), (0, s + 1))       # len k+s+1
+    MT = jnp.tile(w, s)[: s * (k + s)].reshape(s, k + s)[:, : s + k - 1]
+    y = jnp.einsum("...ba,ta->...bt", frames, MT,
+                   precision=jax.lax.Precision.HIGHEST)
+    y = y.reshape(y.shape[:-2] + (n_blocks * s,))
+    return y[..., :out_len].astype(jnp.float32)
+
+
 @functools.partial(jax.jit, static_argnames=("block_len", "reverse"))
 def _conv_overlap_save(x, h, block_len, reverse=False):
-    """Overlap-save as a single batched-frames FFT.
+    """Overlap-save as a single batched-frames FFT (the long-filter path).
 
     The reference runs one FFT per L-sample block in a sequential loop
     (``src/convolve.c:181-228``); here every block is a row of a
@@ -255,6 +330,11 @@ class ConvolutionHandle:
     # derived static sizes (FFT pad / overlap-save block length)
     fft_length: int | None = None
     block_length: int | None = None
+    # overlap-save variant: MXU block-matmul (short/medium filters) vs
+    # batched-frames FFT (very long filters); step = output block size of
+    # the matmul variant
+    os_matmul: bool = False
+    step: int | None = None
 
     @property
     def result_length(self) -> int:
@@ -269,17 +349,20 @@ def _make_handle(x_length, h_length, algorithm, reverse):
     if algorithm is None:
         algorithm = select_algorithm(x_length, h_length)
     algorithm = ConvolutionAlgorithm(algorithm)
-    fft_len = block_len = None
+    fft_len = block_len = step = None
+    os_matmul = False
     if algorithm is ConvolutionAlgorithm.FFT:
         fft_len = _fft_length(x_length, h_length)
     elif algorithm is ConvolutionAlgorithm.OVERLAP_SAVE:
-        if not h_length < x_length / 2:
+        if not h_length < x_length // 2:
             raise ValueError(
                 "overlap-save requires h_length < x_length / 2 "
-                "(src/convolve.c:105 assert contract)")
+                "(src/convolve.c:105 assert contract, integer division)")
         block_len = tpu_block_length(h_length, x_length)
+        os_matmul = h_length <= AUTO_OS_MATMUL_MAX_H
+        step = overlap_save_step(h_length)
     return ConvolutionHandle(x_length, h_length, algorithm, reverse,
-                             fft_len, block_len)
+                             fft_len, block_len, os_matmul, step)
 
 
 def _check_lengths(handle, x, h):
@@ -299,6 +382,8 @@ def _run(handle: ConvolutionHandle, x, h, simd=None):
             return _conv_direct(x, h, reverse=handle.reverse)
         if handle.algorithm is ConvolutionAlgorithm.FFT:
             return _conv_fft(x, h, handle.fft_length, reverse=handle.reverse)
+        if handle.os_matmul:
+            return _conv_os_matmul(x, h, handle.step, reverse=handle.reverse)
         return _conv_overlap_save(x, h, handle.block_length,
                                   reverse=handle.reverse)
     x, h = np.asarray(x), np.asarray(h)

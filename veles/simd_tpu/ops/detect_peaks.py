@@ -64,20 +64,34 @@ def _peak_mask(data, type):
     return jnp.pad(inner, pad)
 
 
+def _compact_row(mask, data, max_peaks):
+    """Cumsum+scatter compaction of one signal: O(n), stays on device.
+
+    Each peak's output slot is its rank among peaks (cumsum of the mask);
+    the scatter has no write conflicts because ranks are unique, and
+    everything else lands in a trash slot that is sliced off.
+    """
+    n = mask.shape[-1]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    rank = jnp.cumsum(mask) - 1
+    dest = jnp.where(mask & (rank < max_peaks), rank, max_peaks)
+    positions = jnp.full((max_peaks + 1,), -1, jnp.int32).at[dest].set(idx)
+    values = jnp.zeros((max_peaks + 1,), data.dtype).at[dest].set(data)
+    # the trash slot may hold a non-peak; everything below stays exact
+    return positions[:max_peaks], values[:max_peaks]
+
+
 @functools.partial(jax.jit, static_argnames=("type", "max_peaks"))
 def _peaks_fixed(data, type, max_peaks):
     mask = _peak_mask(data, type)
     n = data.shape[-1]
-    idx = jnp.arange(n)
-    # stable compaction: sort (not-mask, index) so peak indices come first
-    order = jnp.argsort(jnp.where(mask, idx, n + idx), axis=-1)
-    take = order[..., :max_peaks]
     count = jnp.sum(mask, axis=-1)
-    pos_in_bounds = jnp.arange(max_peaks) < count[..., None]
-    positions = jnp.where(pos_in_bounds, take, -1)
-    values = jnp.where(pos_in_bounds,
-                       jnp.take_along_axis(data, take, axis=-1), 0.0)
-    return positions, values, count
+    flat_mask = mask.reshape(-1, n)
+    flat_data = data.reshape(-1, n)
+    positions, values = jax.vmap(
+        lambda m, d: _compact_row(m, d, max_peaks))(flat_mask, flat_data)
+    out_shape = data.shape[:-1] + (max_peaks,)
+    return (positions.reshape(out_shape), values.reshape(out_shape), count)
 
 
 def detect_peaks_fixed(data, type=ExtremumType.BOTH, max_peaks=None):
@@ -85,23 +99,20 @@ def detect_peaks_fixed(data, type=ExtremumType.BOTH, max_peaks=None):
 
     Returns ``(positions[int32, ..., max_peaks], values[..., max_peaks],
     count[...])``; unused slots hold position -1 / value 0.  ``max_peaks``
-    defaults to (and is clamped to) the static worst case ``n - 2``
-    (an alternating signal makes every interior point an extremum).
+    defaults to the static worst case ``n - 2`` (an alternating signal
+    makes every interior point an extremum).  A caller-supplied
+    ``max_peaks`` is honored exactly — slots beyond ``n - 2`` are simply
+    always empty — so a jitted pipeline gets the same output shape across
+    signals of different lengths.
     """
     data = jnp.asarray(data)
     n = data.shape[-1]
     if n < 3:
         raise ValueError("size must be > 2 (src/detect_peaks.c:64 contract)")
-    # worst case: an alternating signal makes every interior point an
-    # extremum (n-2 of them; a single-type query can hit half of that,
-    # but n-2 is the safe bound for BOTH)
-    worst_case = n - 2
     if max_peaks is None:
-        max_peaks = worst_case
-    # clamp: more slots than possible peaks wastes memory and breaks the
-    # gather shapes when max_peaks > n
-    max_peaks = min(int(max_peaks), worst_case)
-    return _peaks_fixed(data, ExtremumType(int(type)), max_peaks)
+        # worst case: every interior point (alternating signal)
+        max_peaks = n - 2
+    return _peaks_fixed(data, ExtremumType(int(type)), int(max_peaks))
 
 
 def detect_peaks_na(data, type=ExtremumType.BOTH):
@@ -138,6 +149,10 @@ def detect_peaks(data, type=ExtremumType.BOTH, simd=None):
                          "batched fixed-shape extraction")
     if data.shape[-1] < 3:
         raise ValueError("size must be > 2 (src/detect_peaks.c:64 contract)")
-    mask = np.asarray(_peak_mask(data, ExtremumType(int(type))))
-    positions = np.nonzero(mask)[0].astype(np.int32)
-    return positions, np.asarray(data)[positions]
+    # compaction happens on device (cumsum+scatter in _peaks_fixed); the
+    # host only slices the already-compacted prefix
+    positions, values, count = _peaks_fixed(
+        data, ExtremumType(int(type)), data.shape[-1] - 2)
+    k = int(count)
+    return (np.asarray(positions[:k], np.int32),
+            np.asarray(values[:k], np.float32))
