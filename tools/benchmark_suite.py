@@ -11,11 +11,19 @@ against the NumPy oracle and prints the reference's line format plus
 absolute throughput (SURVEY.md §5 asks for absolute numbers, not just
 ratios).
 
+Device timing goes through ``utils.benchmark.device_time`` (pipelined
+burst timing) — ``block_until_ready`` does not reliably block through the
+axon remote relay, so wall-clocking it measures dispatch, not compute
+(VERDICT round-1 item 6).
+
 Instantiations mirror the reference's:
 
 * convolve brute/FFT/overlap-save crossovers over sizes
   (``tests/convolve.cc:168-401``),
-* GEMM straight vs transposed (``tests/matrix.cc:206-288``),
+* GEMM straight vs transposed (``tests/matrix.cc:206-288``), plus a TPU
+  size sweep 512→4096 with the bf16 ``fast`` path and a batched GEMM —
+  MFU is meaningless at one small latency-bound matmul,
+* gemv (BASELINE.md config 3),
 * DWT per-order speedup loop (``tests/wavelet.cc:290-336``),
 * elementwise + mathfun sweeps (``tests/arithmetic.cc`` pattern).
 
@@ -24,32 +32,32 @@ Run:  python tools/benchmark_suite.py [--quick]
 
 import os
 import sys
-import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 
+from veles.simd_tpu.utils.benchmark import device_time, host_time  # noqa: E402
 
-def benchmark(name, peak_fn, baseline_fn, *, iter_count=10, samples=None):
-    """The benchmark.inc pattern: time iter_count× peak vs baseline."""
-    peak_fn()          # warmup / compile
-    baseline_fn()
-    t0 = time.perf_counter()
-    for _ in range(iter_count):
-        peak_fn()
-    t_peak = (time.perf_counter() - t0) / iter_count
-    t0 = time.perf_counter()
-    for _ in range(max(1, iter_count // 5)):
-        baseline_fn()
-    t_base = (time.perf_counter() - t0) / max(1, iter_count // 5)
+
+def benchmark(name, peak_fn, baseline_fn, *, samples=None, flops=None,
+              baseline_repeats=3):
+    """The benchmark.inc pattern: device-time peak vs host-time baseline.
+
+    ``peak_fn`` must return a jax array (completion is forced by the
+    timer); ``baseline_fn`` is synchronous host code.
+    """
+    t_peak = device_time(peak_fn)
+    t_base = host_time(baseline_fn, repeats=baseline_repeats)
     pct = 100.0 * t_peak / t_base
     times = t_base / t_peak
-    line = (f"[{name}] XLA version took {pct:.1f}% of the original time. "
+    line = (f"[{name}] XLA version took {pct:.2f}% of the original time. "
             f"Speedup is {100 - pct:.0f}% ({times:.1f} times)")
     if samples:
         line += f" | {samples / t_peak / 1e6:.0f} Msamples/s"
-    print(line)
+    if flops:
+        line += f" | {flops / t_peak / 1e9:.0f} GFLOP/s"
+    print(line, flush=True)
     return times
 
 
@@ -76,23 +84,59 @@ def main():
         handle = cv.convolve_initialize(xlen, hlen)
         benchmark(
             f"convolve {xlen}x{hlen} [{handle.algorithm.value}]",
-            lambda: cv.convolve(handle, xd, hd, simd=True)
-            .block_until_ready(),
+            lambda: cv.convolve(handle, xd, hd, simd=True),
             lambda: cv.convolve(handle, x, h, simd=False),
-            iter_count=5 if xlen >= 1 << 17 else 10, samples=xlen)
+            samples=xlen,
+            baseline_repeats=1 if xlen >= 1 << 17 else 3)
 
     # --- GEMM straight vs transposed (tests/matrix.cc:206-288) ---
     a = rng.randn(300, 256).astype(np.float32)
     b = rng.randn(256, 1000).astype(np.float32)
     ad, bd = jnp.asarray(a), jnp.asarray(b)
     btd = jnp.asarray(b.T.copy())
+    flops_ref = 2 * 300 * 256 * 1000
     benchmark("gemm 300x256x1000",
-              lambda: mx._matmul(ad, bd).block_until_ready(),
-              lambda: mx.matrix_multiply_novec(a, b),
-              iter_count=20)
+              lambda: mx._matmul(ad, bd),
+              lambda: mx.matrix_multiply_novec(a, b), flops=flops_ref)
     benchmark("gemm 300x256x1000 transposed-B",
-              lambda: mx._matmul_t(ad, btd).block_until_ready(),
-              lambda: mx.matrix_multiply_transposed_novec(a, b.T), iter_count=20)
+              lambda: mx._matmul_t(ad, btd),
+              lambda: mx.matrix_multiply_transposed_novec(a, b.T),
+              flops=flops_ref)
+
+    # --- GEMM TPU size sweep, f32 HIGHEST vs bf16 fast path ---
+    # (one 512x512 matmul is latency-bound; the sweep + batch shows what
+    # the MXU actually sustains)
+    gemm_sizes = (512, 1024, 2048) if quick else (512, 1024, 2048, 4096)
+    for n in gemm_sizes:
+        an = rng.randn(n, n).astype(np.float32)
+        bn = rng.randn(n, n).astype(np.float32)
+        and_, bnd = jnp.asarray(an), jnp.asarray(bn)
+        flops = 2 * n ** 3
+        base = lambda: mx.matrix_multiply_novec(an[:256], bn)  # scaled below
+        t_base = host_time(base, repeats=1) * (n / 256)
+        t32 = device_time(lambda: mx._matmul(and_, bnd))
+        tf = device_time(lambda: mx._matmul(and_, bnd, fast=True))
+        print(f"[gemm {n} f32/HIGHEST] {flops / t32 / 1e9:.0f} GFLOP/s | "
+              f"[bf16 fast] {flops / tf / 1e9:.0f} GFLOP/s | "
+              f"cpu-oracle ~{flops / t_base / 1e9:.0f} GFLOP/s", flush=True)
+    # batched GEMM: 64 x (512^3) — amortizes dispatch, fills the chip
+    ab = rng.randn(64, 512, 512).astype(np.float32)
+    bb = rng.randn(64, 512, 512).astype(np.float32)
+    abd, bbd = jnp.asarray(ab), jnp.asarray(bb)
+    bflops = 2 * 64 * 512 ** 3
+    tb = device_time(lambda: mx._matmul(abd, bbd))
+    tbf = device_time(lambda: mx._matmul(abd, bbd, fast=True))
+    print(f"[gemm batched 64x512^3 f32] {bflops / tb / 1e9:.0f} GFLOP/s | "
+          f"[bf16 fast] {bflops / tbf / 1e9:.0f} GFLOP/s", flush=True)
+
+    # --- gemv (BASELINE.md config 3; tests/matrix.cc gemv pattern) ---
+    n = 4096
+    am = rng.randn(n, n).astype(np.float32)
+    v = rng.randn(n).astype(np.float32)
+    amd, vd = jnp.asarray(am), jnp.asarray(v)
+    benchmark(f"gemv {n}x{n}",
+              lambda: mx.matrix_vector_multiply(amd, vd, simd=True),
+              lambda: am @ v, flops=2 * n * n)
 
     # --- DWT per order (tests/wavelet.cc:290-336) ---
     sig = rng.randn(64, 512).astype(np.float32)
@@ -102,18 +146,18 @@ def main():
             f"dwt daub{order} 64x512",
             lambda: wv.wavelet_apply(
                 WaveletType.DAUBECHIES, order, wv.ExtensionType.PERIODIC,
-                sigd, simd=True)[0].block_until_ready(),
+                sigd, simd=True)[0],
             lambda: wv.wavelet_apply_na(
                 WaveletType.DAUBECHIES, order, wv.ExtensionType.PERIODIC,
                 sig),
-            iter_count=10, samples=sig.size)
+            samples=sig.size)
 
     # --- mathfun (tests/mathfun.cc pattern) ---
     v = rng.randn(1 << 20).astype(np.float32)
     vd = jnp.asarray(v)
     benchmark("sin 1M",
-              lambda: sin_psv(vd, simd=True).block_until_ready(),
-              lambda: sin_psv(v, simd=False), iter_count=10,
+              lambda: sin_psv(vd, simd=True),
+              lambda: sin_psv(v, simd=False),
               samples=v.size)
 
 

@@ -14,8 +14,13 @@ scale across ICI — XLA collectives instead of any message-passing runtime.
   samples it needs from its left neighbour — the distributed form of
   overlap-save, where the reference's in-core block overlap becomes the
   inter-chip halo.
+* :func:`sharded_convolve_batch` — **dp×sp** convolution over a 2D mesh
+  tile: batch over one axis, sequence (with halo) over the other.
+* :func:`sharded_swt` — sequence-parallel **stationary wavelet cascade**
+  with ring halo exchange (periodic extension = the last→first hop).
 * :func:`sharded_matmul` — **tensor-parallel** GEMM: contracting dimension
-  sharded, partial products combined with ``psum`` over ICI.
+  sharded (zero-padded to the axis size), partials combined with ``psum``
+  over ICI.
 * :func:`data_parallel` — batch-dimension sharding for any batched op
   (DWT/normalize/mathfun pipelines).
 
@@ -27,7 +32,9 @@ identical code lays the collectives onto ICI.
 
 from veles.simd_tpu.parallel.mesh import default_mesh, make_mesh
 from veles.simd_tpu.parallel.ops import (
-    data_parallel, sharded_convolve, sharded_matmul)
+    data_parallel, halo_exchange_left, halo_exchange_right,
+    sharded_convolve, sharded_convolve_batch, sharded_matmul, sharded_swt)
 
 __all__ = ["make_mesh", "default_mesh", "sharded_convolve",
-           "sharded_matmul", "data_parallel"]
+           "sharded_convolve_batch", "sharded_swt", "sharded_matmul",
+           "data_parallel", "halo_exchange_left", "halo_exchange_right"]
