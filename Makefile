@@ -5,7 +5,8 @@
 
 PYTHON ?= python
 
-.PHONY: all tests benchmarks bench cshim cshim-check wavelet-tables clean
+.PHONY: all tests benchmarks bench cshim cshim-check wavelet-tables lint \
+        install clean
 
 all: cshim
 
@@ -26,6 +27,14 @@ cshim-check:
 
 wavelet-tables:
 	$(PYTHON) tools/gen_wavelet_tables.py
+
+lint:
+	$(PYTHON) tools/lint.py
+
+# pip-installs the Python/XLA core, then the C ABI (PREFIX=/usr/local)
+install:
+	$(PYTHON) -m pip install .
+	$(MAKE) -C csrc install
 
 clean:
 	$(MAKE) -C csrc clean

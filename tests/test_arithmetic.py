@@ -79,7 +79,8 @@ class TestFloat16:
         want = ar.float16_to_float(bits, simd=False)
         np.testing.assert_array_equal(got, want)
         if expected is not None:
-            np.testing.assert_array_equal(got, np.asarray(expected, np.float32))
+            np.testing.assert_array_equal(
+                got, np.asarray(expected, np.float32))
 
     def test_normals(self):
         self.check([0x3C00, 0xC000, 0x4248], [1.0, -2.0, 3.140625])

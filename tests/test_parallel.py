@@ -38,7 +38,8 @@ def test_sharded_convolve_matches_single_device(n, k):
     got = np.asarray(par.sharded_convolve(x, h, mesh))
     want = np.asarray(cv.convolve_simd(x, h, simd=True))
     assert got.shape == (n + k - 1,)
-    np.testing.assert_allclose(got, want, atol=1e-3 * max(1, np.abs(want).max()))
+    np.testing.assert_allclose(
+        got, want, atol=1e-3 * max(1, np.abs(want).max()))
 
 
 def test_sharded_convolve_2d_mesh_axis():

@@ -131,7 +131,8 @@ def main():
                 h = ref
             tables[key] = h
             print(f"{key:8s} len={len(h):3d} sum_err={abs(h.sum()-target):.1e}"
-                  f" orth_err={orth_err(h):.1e}{note}  ({time.time()-t0:.1f}s)")
+                  f" orth_err={orth_err(h):.1e}{note}"
+                  f"  ({time.time()-t0:.1f}s)")
     np.savez(wc._TABLE_PATH, **tables)
     print(f"wrote {len(tables)} tables -> {wc._TABLE_PATH}")
 

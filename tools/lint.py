@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Static-analysis driver — parity with the reference's lint harness
+(``cpplint.py`` + ``fullcheck_xml.sh``).
+
+Uses ruff (configured in ``pyproject.toml``) when it is installed; in
+hermetic environments without it, falls back to a dependency-free pass:
+``py_compile`` on every source plus an AST scan for unused imports,
+over-long lines, and trailing whitespace.  Exit status is the gate, like
+the reference's ``make lint``.
+
+Run:  python tools/lint.py [paths...]
+"""
+
+from __future__ import annotations
+
+import ast
+import py_compile
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+MAX_LINE = 79
+# dunder/side-effect imports the AST pass must not flag
+_SIDE_EFFECT_IMPORTS = {"__future__"}
+
+
+def python_sources(paths):
+    if paths:
+        for p in paths:
+            p = Path(p)
+            yield from (p.rglob("*.py") if p.is_dir() else [p])
+        return
+    for pat in ("veles/**/*.py", "tests/*.py", "tools/*.py", "*.py"):
+        yield from ROOT.glob(pat)
+
+
+def try_ruff(files) -> int | None:
+    probe = subprocess.run([sys.executable, "-m", "ruff", "--version"],
+                           capture_output=True)
+    if probe.returncode != 0:
+        return None
+    proc = subprocess.run(
+        [sys.executable, "-m", "ruff", "check", *map(str, files)], cwd=ROOT)
+    return proc.returncode
+
+
+class _ImportScan(ast.NodeVisitor):
+    def __init__(self):
+        self.imported: dict[str, int] = {}
+        self.used: set[str] = set()
+
+    def visit_Import(self, node):
+        for a in node.names:
+            name = (a.asname or a.name).split(".")[0]
+            self.imported.setdefault(name, node.lineno)
+
+    def visit_ImportFrom(self, node):
+        if node.module in _SIDE_EFFECT_IMPORTS:
+            return
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.imported.setdefault(a.asname or a.name, node.lineno)
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+
+def fallback_lint(files) -> int:
+    failures = 0
+    for f in files:
+        src = f.read_text()
+        try:
+            py_compile.compile(str(f), doraise=True)
+        except py_compile.PyCompileError as e:
+            print(f"{f}: compile error: {e.msg}")
+            failures += 1
+            continue
+        tree = ast.parse(src, str(f))
+        scan = _ImportScan()
+        scan.visit(tree)
+        src_lines = src.splitlines()
+        for name, lineno in sorted(scan.imported.items(),
+                                   key=lambda kv: kv[1]):
+            if name not in scan.used and f"{name}." not in src:
+                # __all__ strings count as use (re-exports); honor noqa
+                if f'"{name}"' in src or f"'{name}'" in src:
+                    continue
+                if "noqa" in src_lines[lineno - 1]:
+                    continue
+                print(f"{f}:{lineno}: unused import '{name}'")
+                failures += 1
+        for i, line in enumerate(src.splitlines(), 1):
+            if len(line) > MAX_LINE:
+                print(f"{f}:{i}: line too long ({len(line)} > {MAX_LINE})")
+                failures += 1
+            if line != line.rstrip():
+                print(f"{f}:{i}: trailing whitespace")
+                failures += 1
+    return 1 if failures else 0
+
+
+def main():
+    files = sorted(set(python_sources(sys.argv[1:])))
+    rc = try_ruff(files)
+    if rc is None:
+        print(f"lint: ruff unavailable, dependency-free fallback over "
+              f"{len(files)} files")
+        rc = fallback_lint(files)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

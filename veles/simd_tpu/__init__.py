@@ -14,7 +14,8 @@ SSE/AVX/NEON SIMD library; see /root/reference) designed TPU-first:
   exchange (``veles.simd_tpu.parallel``) instead of the reference's
   single-thread overlap-save loop (``/root/reference/src/convolve.c:181-228``).
 
-Public API (mirrors the reference's header surface, ``/root/reference/inc/simd/``):
+Public API (mirrors the reference's header surface,
+``/root/reference/inc/simd/``):
 
 ======================  =====================================================
 reference header        this package

@@ -5,7 +5,8 @@ Each module mirrors one reference header (SURVEY.md §2):
 * :mod:`.arithmetic`   — conversions, complex/real multiply, reductions
 * :mod:`.mathfun`      — vectorized sin/cos/log/exp
 * :mod:`.matrix`       — BLAS L1/L2/L3 subset on the MXU
-* :mod:`.convolve`     — 1D convolution (brute / FFT / overlap-save, auto-select)
+* :mod:`.convolve`     — 1D convolution (brute / FFT / overlap-save,
+  auto-select)
 * :mod:`.correlate`    — 1D cross-correlation (reversed-h reuse of convolve)
 * :mod:`.wavelet`      — 1D DWT / stationary SWT filter banks
 * :mod:`.wavelet_coeffs` — generated Daubechies / Symlet / Coiflet tables

@@ -170,7 +170,8 @@ def int32_to_int16_na(x):
     (``:334``).  The oracle follows the vector (saturating) contract so both
     backends agree — as do the reference's tests, which only use in-range
     values (``tests/arithmetic.cc:241-257``)."""
-    return np.clip(np.asarray(x, np.int32), _I16_MIN, _I16_MAX).astype(np.int16)
+    return np.clip(np.asarray(x, np.int32), _I16_MIN,
+                   _I16_MAX).astype(np.int16)
 
 
 def float16_to_float_na(bits):

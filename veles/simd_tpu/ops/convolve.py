@@ -124,7 +124,8 @@ def _fft_length(x_length: int, h_length: int) -> int:
 
 
 def select_algorithm(x_length: int, h_length: int) -> ConvolutionAlgorithm:
-    """TPU re-derivation of the reference heuristic (``src/convolve.c:328-364``).
+    """TPU re-derivation of the reference heuristic
+    (``src/convolve.c:328-364``).
 
     Shape matches the reference: long signal with comparatively short filter
     → overlap-save; large balanced problem → FFT; otherwise direct (MXU).

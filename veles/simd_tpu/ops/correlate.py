@@ -21,17 +21,21 @@ from __future__ import annotations
 import numpy as np
 
 from veles.simd_tpu.ops import convolve as _conv
+# re-exported: the reference's correlate.h pulls in convolve_structs.h, so
+# both types are reachable through either header
 from veles.simd_tpu.ops.convolve import (
     ConvolutionAlgorithm, ConvolutionHandle)
 from veles.simd_tpu.utils.config import resolve_simd
 
 __all__ = [
+    "ConvolutionAlgorithm", "ConvolutionHandle",
     "cross_correlate_simd", "cross_correlate_na",
     "cross_correlate_fft", "cross_correlate_fft_initialize",
     "cross_correlate_fft_finalize",
     "cross_correlate_overlap_save", "cross_correlate_overlap_save_initialize",
     "cross_correlate_overlap_save_finalize",
-    "cross_correlate", "cross_correlate_initialize", "cross_correlate_finalize",
+    "cross_correlate", "cross_correlate_initialize",
+    "cross_correlate_finalize",
 ]
 
 

@@ -13,7 +13,8 @@ Semantics preserved:
   (``src/normalize.c:382-400``).
 * ``minmax2D`` (u8) / ``minmax1D`` (f32) return (min, max)
   (``src/normalize.c:402-443``).
-* ``normalize2D`` = minmax2D + normalize2D_minmax (``src/normalize.c:445-451``).
+* ``normalize2D`` = minmax2D + normalize2D_minmax
+  (``src/normalize.c:445-451``).
 
 All ops accept leading batch dimensions (the reduction is over the trailing
 2 axes for 2D ops, trailing 1 for 1D).

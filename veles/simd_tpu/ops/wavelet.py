@@ -157,7 +157,7 @@ def _filter_bank_na(x, hi, lo, ext, stride, dilation, out_len):
     taps = np.arange(order) * dilation
     starts = np.arange(out_len) * stride
     idx = starts[:, None] + taps[None, :]                  # [out_len, order]
-    windows = np.take(x_ext, idx, axis=-1)                 # [..., out_len, order]
+    windows = np.take(x_ext, idx, axis=-1)             # [..., out_len, order]
     reshi = np.einsum("...ij,j->...i", windows.astype(np.float64),
                       hi.astype(np.float64))
     reslo = np.einsum("...ij,j->...i", windows.astype(np.float64),

@@ -1,7 +1,8 @@
 """Platform layer: configuration, dtypes, and buffer/memory helpers.
 
 Replaces the reference's L0/L1 layers (``configure.ac``, ``inc/simd/common.h``,
-``inc/simd/attributes.h``, ``inc/simd/instruction_set.h``, ``inc/simd/memory.h``)
+``inc/simd/attributes.h``, ``inc/simd/instruction_set.h``,
+``inc/simd/memory.h``)
 — see SURVEY.md §2 "L1 Platform".
 """
 
