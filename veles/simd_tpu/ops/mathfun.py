@@ -24,10 +24,46 @@ from veles.simd_tpu.utils.config import resolve_simd
 __all__ = ["sin_psv", "cos_psv", "log_psv", "exp_psv", "pow_psv", "sqrt_psv"]
 
 
+def _log_f32(x):
+    """Range-reduced f32 natural log, ~2 ulp on TPU.
+
+    XLA's TPU ``log`` lowering loses ~350 ulp near 1 (measured 4.6e-5
+    max-relative on U[0.1, 5]); this reimplements the cephes scheme the
+    reference vendors (``avx_mathfun.h:161-245``): split x = m·2^e with
+    m ∈ [√½, √2), evaluate log(m) = 2·atanh((m−1)/(m+1)) as an odd
+    polynomial in s², and recombine with a two-part (Cody-Waite) ln2 so
+    e·ln2_hi is exact in f32.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    tiny = x < jnp.float32(np.finfo(np.float32).tiny)
+    xs = jnp.where(tiny, x * jnp.float32(2.0**23), x)
+    bits = jax.lax.bitcast_convert_type(xs, jnp.int32)
+    e = ((bits >> 23) & 0xFF) - 126  # m in [0.5, 1)
+    m = jax.lax.bitcast_convert_type(
+        (bits & jnp.int32(0x007FFFFF)) | jnp.int32(0x3F000000), jnp.float32)
+    low = m < jnp.float32(0.7071067811865476)
+    m = jnp.where(low, m * 2, m)
+    e = (e - low.astype(jnp.int32)
+         - jnp.where(tiny, 23, 0)).astype(jnp.float32)
+    s = (m - 1) / (m + 1)
+    z = s * s
+    poly = jnp.float32(1.0 / 9.0)
+    for c in (1.0 / 7.0, 1.0 / 5.0, 1.0 / 3.0, 1.0):
+        poly = poly * z + jnp.float32(c)
+    logm = 2 * s * poly
+    ln2_hi = jnp.float32(0.693359375)  # 0x3F318000: 10 significand bits
+    ln2_lo = jnp.float32(-2.12194440e-4)
+    r = e * ln2_hi + (logm + e * ln2_lo)
+    r = jnp.where(x == 0, -jnp.inf, r)
+    r = jnp.where(jnp.isinf(x) & (x > 0), jnp.inf, r)
+    r = jnp.where((x < 0) | jnp.isnan(x), jnp.nan, r)
+    return r
+
+
 _XLA = {
     "sin": jax.jit(jnp.sin),
     "cos": jax.jit(jnp.cos),
-    "log": jax.jit(jnp.log),
+    "log": jax.jit(_log_f32),
     "exp": jax.jit(jnp.exp),
     "sqrt": jax.jit(jnp.sqrt),
 }
