@@ -27,14 +27,14 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from veles.simd_tpu.utils.benchmark import device_time, host_time
+from veles.simd_tpu.utils.benchmark import (
+    device_time_chained, host_time, rms_normalize)
 
 
 def bench_elementwise(rng):
     """Config 1: f32 add/mul + int16->float, N=4096 (batched to fill the
     chip: 4096 signals of 4096 — per-op timing at N=4096 alone measures
     dispatch, not the VPU)."""
-    import jax
     import jax.numpy as jnp
 
     from veles.simd_tpu.ops import arithmetic as ar
@@ -44,11 +44,18 @@ def bench_elementwise(rng):
     a_np = rng.randn(batch, n).astype(np.float32)
     b_np = rng.randn(batch, n).astype(np.float32)
     i16 = rng.randint(-3000, 3000, (batch, n)).astype(np.int16)
-    a, b = jnp.asarray(a_np), jnp.asarray(b_np)
+    b = jnp.asarray(b_np)
     i16j = jnp.asarray(i16)
 
-    fused = jax.jit(lambda a, b, i: (a + b) * ar._int16_to_float(i))
-    t = device_time(lambda: fused(a, b, i16j))
+    def step(v):
+        # int16 carry: both conversions run every iteration (nothing is
+        # loop-invariant or affine — the trunc-saturate cast is nonlinear,
+        # so XLA can neither hoist the converts nor reduce the loop).
+        # Values stay in the +-3000 range the saturating cast allows.
+        f = ar._int16_to_float(v)                  # convert i16 -> f32
+        return ar._float_to_int16((f * 1e-4 + b) * 300.0)  # mul, add, back
+
+    t = device_time_chained(step, i16j)
     elems = batch * n
     t_base = host_time(
         lambda: (a_np + b_np) * i16.astype(np.float32))
@@ -58,15 +65,17 @@ def bench_elementwise(rng):
 
 def bench_mathfun(rng):
     """Config 2: sin/cos/log/exp on 1M floats."""
-    import jax
     import jax.numpy as jnp
 
     n = 1 << 20
     x_np = np.abs(rng.randn(n).astype(np.float32)) + 0.1
     x = jnp.asarray(x_np)
-    fused = jax.jit(
-        lambda v: jnp.sin(v) + jnp.cos(v) + jnp.log(v) + jnp.exp(-v))
-    t = device_time(lambda: fused(x))
+
+    def step(v):  # 4 transcendentals; output stays in [0.1, ~4.7]
+        return jnp.abs(jnp.sin(v) + jnp.cos(v) + jnp.log(v)
+                       + jnp.exp(-v)) + 0.1
+
+    t = device_time_chained(step, x)
     t_base = host_time(
         lambda: np.sin(x_np) + np.cos(x_np) + np.log(x_np) + np.exp(-x_np))
     # 4 transcendentals per element
@@ -84,7 +93,11 @@ def bench_sgemm(rng):
     a_np = rng.randn(n, n).astype(np.float32)
     b_np = rng.randn(n, n).astype(np.float32)
     a, b = jnp.asarray(a_np), jnp.asarray(b_np)
-    t = device_time(lambda: mx._matmul(a, b), burst=16)
+
+    def step(v):  # rms-normalized so 256 chained GEMMs don't blow up
+        return rms_normalize(mx._matmul(v, b))
+
+    t = device_time_chained(step, a)
     flops = 2 * n ** 3
     t_base = host_time(lambda: mx.matrix_multiply_novec(a_np, b_np))
     return {"metric": "sgemm 512", "unit": "GFLOP/s",
@@ -104,8 +117,13 @@ def bench_convolve_1m(rng):
     h = rng.randn(k).astype(np.float32)
     handle = cv.convolve_overlap_save_initialize(n, k)
     xd, hd = jnp.asarray(x), jnp.asarray(h)  # device-resident: measure the
-    t = device_time(lambda: cv.convolve_overlap_save(  # chip, not the tunnel
-        handle, xd, hd, simd=True))
+    # chip, not the tunnel
+
+    def step(v):  # 1e-30 * y forces the conv without perturbing v
+        y = cv.convolve_overlap_save(handle, v, hd, simd=True)
+        return v + 1e-30 * y[..., :n]
+
+    t = device_time_chained(step, xd)
     t_base = host_time(lambda: cv._conv_overlap_save_na(
         x, h, handle.block_length), repeats=2)
     return {"metric": "convolve 1M x 2047 overlap-save",
@@ -123,10 +141,14 @@ def bench_dwt(rng):
     batch, n = 512, 4096
     x = rng.randn(batch, n).astype(np.float32)
     xd = jnp.asarray(x)
-    run = lambda: wv.wavelet_apply(
-        WaveletType.DAUBECHIES, 8, wv.ExtensionType.PERIODIC, xd,
-        simd=True)[0]
-    t = device_time(run)
+
+    def step(v):  # [B, n] -> hi, lo each [B, n/2] -> concat back to [B, n]
+        hi, lo = wv.wavelet_apply(
+            WaveletType.DAUBECHIES, 8, wv.ExtensionType.PERIODIC, v,
+            simd=True)
+        return jnp.concatenate([hi, lo], axis=-1)
+
+    t = device_time_chained(step, xd)
     t_base = host_time(lambda: wv.wavelet_apply_na(
         WaveletType.DAUBECHIES, 8, wv.ExtensionType.PERIODIC, x),
         repeats=2)

@@ -11,10 +11,12 @@ against the NumPy oracle and prints the reference's line format plus
 absolute throughput (SURVEY.md §5 asks for absolute numbers, not just
 ratios).
 
-Device timing goes through ``utils.benchmark.device_time`` (pipelined
-burst timing) — ``block_until_ready`` does not reliably block through the
-axon remote relay, so wall-clocking it measures dispatch, not compute
-(VERDICT round-1 item 6).
+Device timing goes through ``utils.benchmark.device_time_chained``: each
+workload is expressed as an ``x -> x`` step run hundreds of times inside
+one ``lax.fori_loop`` dispatch, and the marginal time between two trip
+counts cancels the relay round-trip (~66 ms with ~2.6 ms jitter — any
+host-side scheme, including ``block_until_ready`` and burst marginals,
+is noise below that floor; VERDICT round-1 item 6).
 
 Instantiations mirror the reference's:
 
@@ -37,17 +39,18 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 
-from veles.simd_tpu.utils.benchmark import device_time, host_time  # noqa: E402
+from veles.simd_tpu.utils.benchmark import (  # noqa: E402
+    device_time_chained, host_time, rms_normalize as _rms_normalize)
 
 
-def benchmark(name, peak_fn, baseline_fn, *, samples=None, flops=None,
-              baseline_repeats=3):
+def benchmark(name, step, x0, baseline_fn, *, samples=None, flops=None,
+              baseline_repeats=3, iters=256):
     """The benchmark.inc pattern: device-time peak vs host-time baseline.
 
-    ``peak_fn`` must return a jax array (completion is forced by the
-    timer); ``baseline_fn`` is synchronous host code.
+    ``step`` is the workload as an ``x -> x`` function (chained on device
+    by the timer); ``baseline_fn`` is synchronous host code.
     """
-    t_peak = device_time(peak_fn)
+    t_peak = device_time_chained(step, x0, iters=iters)
     t_base = host_time(baseline_fn, repeats=baseline_repeats)
     pct = 100.0 * t_peak / t_base
     times = t_base / t_peak
@@ -82,24 +85,37 @@ def main():
         h = rng.randn(hlen).astype(np.float32)
         xd, hd = jnp.asarray(x), jnp.asarray(h)
         handle = cv.convolve_initialize(xlen, hlen)
+
+        def conv_step(v, handle=handle, hd=hd, xlen=xlen):
+            y = cv.convolve(handle, v, hd, simd=True)
+            return v + 1e-30 * y[..., :xlen]
+
         benchmark(
             f"convolve {xlen}x{hlen} [{handle.algorithm.value}]",
-            lambda: cv.convolve(handle, xd, hd, simd=True),
+            conv_step, xd,
             lambda: cv.convolve(handle, x, h, simd=False),
             samples=xlen,
             baseline_repeats=1 if xlen >= 1 << 17 else 3)
 
     # --- GEMM straight vs transposed (tests/matrix.cc:206-288) ---
+    # the step folds the [300, 1000] product back to the [300, 256] input
+    # shape as a sum of overlapping column slices; every output column is
+    # consumed (so XLA cannot narrow the dot) at elementwise-add cost.
     a = rng.randn(300, 256).astype(np.float32)
     b = rng.randn(256, 1000).astype(np.float32)
     ad, bd = jnp.asarray(a), jnp.asarray(b)
     btd = jnp.asarray(b.T.copy())
     flops_ref = 2 * 300 * 256 * 1000
+
+    def _fold(y):  # [300, 1000] -> [300, 256], all columns used
+        return _rms_normalize(sum(y[:, s:s + 256]
+                                  for s in (0, 248, 496, 744)))
+
     benchmark("gemm 300x256x1000",
-              lambda: mx._matmul(ad, bd),
+              lambda v: _fold(mx._matmul(v, bd)), ad,
               lambda: mx.matrix_multiply_novec(a, b), flops=flops_ref)
     benchmark("gemm 300x256x1000 transposed-B",
-              lambda: mx._matmul_t(ad, btd),
+              lambda v: _fold(mx._matmul_t(v, btd)), ad,
               lambda: mx.matrix_multiply_transposed_novec(a, b.T),
               flops=flops_ref)
 
@@ -112,10 +128,15 @@ def main():
         bn = rng.randn(n, n).astype(np.float32)
         and_, bnd = jnp.asarray(an), jnp.asarray(bn)
         flops = 2 * n ** 3
-        base = lambda: mx.matrix_multiply_novec(an[:256], bn)  # scaled below
-        t_base = host_time(base, repeats=1) * (n / 256)
-        t32 = device_time(lambda: mx._matmul(and_, bnd))
-        tf = device_time(lambda: mx._matmul(and_, bnd, fast=True))
+        t_base = host_time(
+            lambda: mx.matrix_multiply_novec(an[:256], bn),
+            repeats=1) * (n / 256)
+        iters = 64 if n >= 2048 else 256
+        t32 = device_time_chained(
+            lambda v: _rms_normalize(mx._matmul(v, bnd)), and_, iters=iters)
+        tf = device_time_chained(
+            lambda v: _rms_normalize(mx._matmul(v, bnd, fast=True)), and_,
+            iters=iters)
         print(f"[gemm {n} f32/HIGHEST] {flops / t32 / 1e9:.0f} GFLOP/s | "
               f"[bf16 fast] {flops / tf / 1e9:.0f} GFLOP/s | "
               f"cpu-oracle ~{flops / t_base / 1e9:.0f} GFLOP/s", flush=True)
@@ -124,8 +145,11 @@ def main():
     bb = rng.randn(64, 512, 512).astype(np.float32)
     abd, bbd = jnp.asarray(ab), jnp.asarray(bb)
     bflops = 2 * 64 * 512 ** 3
-    tb = device_time(lambda: mx._matmul(abd, bbd))
-    tbf = device_time(lambda: mx._matmul(abd, bbd, fast=True))
+    tb = device_time_chained(
+        lambda v: _rms_normalize(mx._matmul(v, bbd)), abd, iters=64)
+    tbf = device_time_chained(
+        lambda v: _rms_normalize(mx._matmul(v, bbd, fast=True)), abd,
+        iters=64)
     print(f"[gemm batched 64x512^3 f32] {bflops / tb / 1e9:.0f} GFLOP/s | "
           f"[bf16 fast] {bflops / tbf / 1e9:.0f} GFLOP/s", flush=True)
 
@@ -135,18 +159,24 @@ def main():
     v = rng.randn(n).astype(np.float32)
     amd, vd = jnp.asarray(am), jnp.asarray(v)
     benchmark(f"gemv {n}x{n}",
-              lambda: mx.matrix_vector_multiply(amd, vd, simd=True),
+              lambda w: _rms_normalize(
+                  mx.matrix_vector_multiply(amd, w, simd=True)), vd,
               lambda: am @ v, flops=2 * n * n)
 
     # --- DWT per order (tests/wavelet.cc:290-336) ---
     sig = rng.randn(64, 512).astype(np.float32)
     sigd = jnp.asarray(sig)
     for order in (4, 6, 8, 12, 16):
+
+        def dwt_step(v, order=order):
+            hi, lo = wv.wavelet_apply(
+                WaveletType.DAUBECHIES, order, wv.ExtensionType.PERIODIC,
+                v, simd=True)
+            return jnp.concatenate([hi, lo], axis=-1)
+
         benchmark(
             f"dwt daub{order} 64x512",
-            lambda: wv.wavelet_apply(
-                WaveletType.DAUBECHIES, order, wv.ExtensionType.PERIODIC,
-                sigd, simd=True)[0],
+            dwt_step, sigd,
             lambda: wv.wavelet_apply_na(
                 WaveletType.DAUBECHIES, order, wv.ExtensionType.PERIODIC,
                 sig),
@@ -156,7 +186,7 @@ def main():
     v = rng.randn(1 << 20).astype(np.float32)
     vd = jnp.asarray(v)
     benchmark("sin 1M",
-              lambda: sin_psv(vd, simd=True),
+              lambda w: sin_psv(w, simd=True) + 0.5, vd,
               lambda: sin_psv(v, simd=False),
               samples=v.size)
 

@@ -32,9 +32,10 @@ reference's 1D API is the ``ndim == 1`` case.
 
 Algorithm-selection thresholds are re-derived for TPU (the reference's
 constants at ``src/convolve.c:328-364`` are ISA-specific — AVX picks FFT
-above x>350, NEON above x>50).  On TPU the MXU makes the direct form cheap
-up to much larger filters; see ``AUTO_*`` constants below, re-tuned by the
-benchmark harness.
+above x>350, NEON above x>50).  On TPU the single-signal direct form never
+tiles well onto the MXU, so the auto-select prefers overlap-save/FFT much
+earlier than the reference; the measured crossover sweep is recorded at
+the ``AUTO_*`` constants below.
 """
 
 from __future__ import annotations
@@ -72,10 +73,27 @@ class ConvolutionAlgorithm(enum.Enum):
 
 
 # TPU-tuned auto-select thresholds (reference's AVX/NEON constants at
-# src/convolve.c:328-364 do not transfer: the MXU direct form stays
-# competitive to far larger filters than an 8-wide AVX dot).
-AUTO_OVERLAP_SAVE_MIN_X = 1 << 14   # long-signal path
-AUTO_FFT_MIN_PRODUCT = 1 << 22      # x*h beyond which spectral wins
+# src/convolve.c:328-364 do not transfer).  Re-derived from a chained
+# on-device crossover sweep on v5e (us/op, device_time_chained):
+#
+#        x      h |   direct     fft      os
+#      256    256 |    298.2    10.0       -
+#     1000     50 |     63.2     9.6     5.7
+#     2000    950 |   9549.5    10.7    30.6
+#     4096    512 |   3212.8    13.2     6.3
+#     8192   1024 |  12284.7    18.0    25.0
+#    16384   2047 |  49133.8   170.3    90.0
+#    65536    511 |  46437.3   793.1     9.4
+#
+# The single-signal direct form ([1,1,n] x [1,1,k] conv) never tiles well
+# onto the MXU and loses everywhere except the latency floor (~10 us), so
+# the policy is: overlap-save when the halo is amortized (x >= 8h — the
+# only loss in the sweep is 8192x1024 at 1.4x, while 4096x512 and
+# 16384x2047 at the same ratio win), FFT for balanced problems above the
+# latency floor, brute force only below it where every algorithm costs
+# the same ~10 us dispatch.
+AUTO_OVERLAP_SAVE_MIN_RATIO = 8     # x >= ratio*h -> overlap-save
+AUTO_FFT_MIN_PRODUCT = 1 << 13      # x*h beyond which spectral wins
 # within overlap-save: MXU block-matmul for filters up to this many taps,
 # batched-frames FFT beyond (measured crossover on v5e, see BASELINE.md)
 AUTO_OS_MATMUL_MAX_H = 1 << 14
@@ -131,14 +149,14 @@ def select_algorithm(x_length: int, h_length: int) -> ConvolutionAlgorithm:
     → overlap-save; large balanced problem → FFT; otherwise direct (MXU).
     """
     x_length, h_length = int(x_length), int(h_length)
-    # h < x//2, not x > 2h: must satisfy the overlap-save handle contract
-    # exactly (integer division, src/convolve.c:105), else x = 2h+1 would
-    # select an algorithm whose initializer rejects it
-    if h_length < x_length // 2 and x_length >= AUTO_OVERLAP_SAVE_MIN_X:
+    if x_length * h_length < AUTO_FFT_MIN_PRODUCT:
+        return ConvolutionAlgorithm.BRUTE_FORCE  # latency floor: all tie
+    # x >= 8h implies h < x//2, the overlap-save handle contract (integer
+    # division, src/convolve.c:105), so the selected algorithm's
+    # initializer always accepts the lengths
+    if x_length >= AUTO_OVERLAP_SAVE_MIN_RATIO * h_length:
         return ConvolutionAlgorithm.OVERLAP_SAVE
-    if x_length * h_length >= AUTO_FFT_MIN_PRODUCT:
-        return ConvolutionAlgorithm.FFT
-    return ConvolutionAlgorithm.BRUTE_FORCE
+    return ConvolutionAlgorithm.FFT
 
 
 # --------------------------------------------------------------------------
