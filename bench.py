@@ -158,14 +158,9 @@ def bench_dwt(rng):
 
 
 def main():
-    # the axon sitecustomize pins the platform before env vars are
-    # consulted; honor an explicit override the way cshim.py does (lets
-    # `VELES_SIMD_PLATFORM=cpu python bench.py --check` run without TPU)
-    if os.environ.get("VELES_SIMD_PLATFORM"):
-        import jax
+    from veles.simd_tpu.utils.platform import maybe_override_platform
 
-        jax.config.update("jax_platforms",
-                          os.environ["VELES_SIMD_PLATFORM"])
+    maybe_override_platform()  # VELES_SIMD_PLATFORM=cpu runs without TPU
     import jax
 
     from tools.tpu_smoke import run_smoke

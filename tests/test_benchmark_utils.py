@@ -1,0 +1,78 @@
+"""Tests for the profiling layer (``utils/benchmark.py``).
+
+The reference's timing harness is compile-time generated C++
+(``tests/benchmark.inc``); its correctness was "it compiles".  The chained
+device timer here has real logic — adaptive trip counts, marginal
+subtraction, degeneracy warnings — worth pinning down on the CPU backend.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from veles.simd_tpu.utils.benchmark import (
+    device_time, device_time_chained, host_time, rms_normalize)
+
+
+def test_chained_returns_positive_time():
+    x = jnp.zeros((256, 256), jnp.float32)
+    t = device_time_chained(lambda v: jnp.sin(v) + 0.5, x,
+                            iters=32, min_window=1e-4)
+    assert t > 0
+
+
+def test_chained_step_actually_runs():
+    """The timer's loop must execute the step: a heavy step must report
+    far more per-op time than a trivial one THROUGH device_time_chained
+    itself (if the loop dropped the step, both would time an empty loop
+    and tie)."""
+    rng = np.random.RandomState(0)
+    big = jnp.asarray(rng.randn(1024, 1024).astype(np.float32))
+    t_heavy = device_time_chained(
+        lambda v: rms_normalize(v @ big), big, iters=16, min_window=1e-3)
+    tiny = jnp.zeros((8,), jnp.float32)
+    t_tiny = device_time_chained(
+        lambda v: jnp.sin(v) + 0.5, tiny, iters=16, min_window=1e-3)
+    # a 1024^3 matmul (2.1 GFLOP) vs an 8-element sin: orders apart
+    assert t_heavy > 20 * t_tiny, (t_heavy, t_tiny)
+
+
+def test_chained_warns_when_window_unreachable():
+    x = jnp.zeros((4,), jnp.float32)
+    with pytest.warns(RuntimeWarning, match="marginal window"):
+        # a 4-element op can't fill a 10-second window within 64 iters
+        device_time_chained(lambda v: jnp.sin(v) + 0.5, x,
+                            iters=16, min_window=10.0, max_iters=64)
+
+
+def test_rms_normalize_bounds_chained_gemm():
+    rng = np.random.RandomState(0)
+    b = jnp.asarray(rng.randn(64, 64).astype(np.float32))
+    v = jnp.asarray(rng.randn(64, 64).astype(np.float32))
+    for _ in range(50):
+        v = rms_normalize(v @ b)
+    out = np.asarray(v)
+    assert np.all(np.isfinite(out))
+    assert abs(float(np.sqrt(np.mean(out ** 2))) - 1.0) < 1e-3
+
+
+def test_rms_normalize_zero_input_stays_finite():
+    out = np.asarray(rms_normalize(jnp.zeros((8,), jnp.float32)))
+    assert np.all(np.isfinite(out))
+
+
+def test_host_time_measures_wall():
+    t = host_time(lambda: sum(range(10000)), repeats=2)
+    assert t > 0
+
+
+def test_burst_device_time_still_works():
+    # legacy path (documented as jitter-limited, still exported)
+    x = jnp.zeros((128,), jnp.float32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        t = device_time(lambda: jnp.sin(x), burst=4, repeats=1, warmup=1)
+    assert t > 0

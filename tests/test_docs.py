@@ -1,0 +1,34 @@
+"""Docs freshness gate: committed docs/ must match a regeneration.
+
+The reference's doc build runs at `make` time (Doxygen, `common.ac:149-183`)
+so it can't go stale; ours is committed output, so this test is the
+staleness guard the build system would otherwise be.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import gen_docs  # noqa: E402
+
+
+@pytest.mark.parametrize("modname", gen_docs.MODULES)
+def test_committed_docs_are_fresh(modname):
+    fname = modname.replace(".", "_") + ".md"
+    committed = REPO / "docs" / fname
+    assert committed.exists(), f"docs/{fname} missing — run tools/gen_docs.py"
+    assert committed.read_text() == gen_docs.render_module(modname), (
+        f"docs/{fname} is stale — run tools/gen_docs.py")
+
+
+def test_no_orphaned_docs():
+    expected = {m.replace(".", "_") + ".md" for m in gen_docs.MODULES}
+    expected.add("README.md")
+    actual = {p.name for p in (REPO / "docs").glob("*.md")}
+    assert actual == expected, (
+        f"orphaned docs: {actual - expected}, missing: {expected - actual}")

@@ -66,6 +66,9 @@ def benchmark(name, step, x0, baseline_fn, *, samples=None, flops=None,
 
 def main():
     quick = "--quick" in sys.argv
+    from veles.simd_tpu.utils.platform import maybe_override_platform
+
+    maybe_override_platform()  # VELES_SIMD_PLATFORM=cpu runs without TPU
     import jax.numpy as jnp
 
     from veles.simd_tpu.ops import convolve as cv
@@ -181,6 +184,35 @@ def main():
                 WaveletType.DAUBECHIES, order, wv.ExtensionType.PERIODIC,
                 sig),
             samples=sig.size)
+
+    # --- DWT other families + stationary SWT (BASELINE config 5 names
+    # daub-8 / coiflet-3 (order 18) / symlet-4 (order 8), DWT + SWT) ---
+    for wtype, order in ((WaveletType.COIFLET, 18), (WaveletType.SYMLET, 8)):
+
+        def dwt_fam_step(v, wtype=wtype, order=order):
+            hi, lo = wv.wavelet_apply(
+                wtype, order, wv.ExtensionType.PERIODIC, v, simd=True)
+            return jnp.concatenate([hi, lo], axis=-1)
+
+        benchmark(
+            f"dwt {wtype.name.lower()}{order} 64x512",
+            dwt_fam_step, sigd,
+            lambda: wv.wavelet_apply_na(
+                wtype, order, wv.ExtensionType.PERIODIC, sig),
+            samples=sig.size)
+
+    def swt_step(v):
+        hi, lo = wv.stationary_wavelet_apply(
+            WaveletType.DAUBECHIES, 8, 2, wv.ExtensionType.PERIODIC, v,
+            simd=True)
+        return _rms_normalize(hi + lo)
+
+    benchmark(
+        "swt daub8 level2 64x512",
+        swt_step, sigd,
+        lambda: wv.stationary_wavelet_apply_na(
+            WaveletType.DAUBECHIES, 8, 2, wv.ExtensionType.PERIODIC, sig),
+        samples=sig.size)
 
     # --- mathfun (tests/mathfun.cc pattern) ---
     v = rng.randn(1 << 20).astype(np.float32)

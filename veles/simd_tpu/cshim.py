@@ -23,10 +23,9 @@ import numpy as np
 
 # The axon TPU plugin pins the JAX platform from sitecustomize before env
 # vars are consulted; give C hosts an explicit override.
-if os.environ.get("VELES_SIMD_PLATFORM"):
-    import jax
+from veles.simd_tpu.utils.platform import maybe_override_platform
 
-    jax.config.update("jax_platforms", os.environ["VELES_SIMD_PLATFORM"])
+maybe_override_platform()
 
 from veles.simd_tpu.ops import arithmetic as _ar
 from veles.simd_tpu.ops import convolve as _cv

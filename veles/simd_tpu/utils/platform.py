@@ -18,7 +18,23 @@ from __future__ import annotations
 import contextlib
 import os
 
-__all__ = ["set_cpu_env", "pin_cpu", "cpu_devices"]
+__all__ = ["set_cpu_env", "pin_cpu", "cpu_devices",
+           "maybe_override_platform"]
+
+
+def maybe_override_platform(env_var: str = "VELES_SIMD_PLATFORM") -> None:
+    """Honor an explicit platform override from ``env_var``.
+
+    The axon sitecustomize stomps ``JAX_PLATFORMS`` before user code runs,
+    so only a ``jax.config``-level pin works; this is the one shared home
+    for that override (used by ``bench.py``, ``tools/benchmark_suite.py``
+    and the C-shim bridge).  Must be called before any backend init.
+    """
+    value = os.environ.get(env_var)
+    if value:
+        import jax
+
+        jax.config.update("jax_platforms", value)
 
 _COUNT_FLAG = "xla_force_host_platform_device_count"
 
