@@ -1,0 +1,86 @@
+"""Worker for tests/test_distributed.py — one simulated host.
+
+Run as ``python distributed_worker.py <pid> <nproc> <port>``.  Provisions
+4 virtual CPU devices (one simulated host's chips), joins the distributed
+runtime, builds a hybrid dp(DCN)×sp(ICI) mesh, and checks real
+cross-process semantics:
+
+* a ``psum`` spanning both axes (the all-reduce crossing the DCN analog),
+* ``sharded_convolve_batch`` with the batch over hosts and each signal's
+  length over the host-local axis — halo ``ppermute`` hops stay
+  intra-host, exactly the layout rule ``hybrid_mesh`` exists to enforce.
+
+Exits nonzero on any mismatch; the parent test asserts both workers pass.
+"""
+
+import functools
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from veles.simd_tpu.utils.platform import set_cpu_env
+
+set_cpu_env(4)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import shard_map  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+
+def main(pid: int, nproc: int, port: str) -> None:
+    from veles.simd_tpu.parallel import distributed, sharded_convolve_batch
+
+    distributed.initialize(f"127.0.0.1:{port}", num_processes=nproc,
+                           process_id=pid)
+    assert distributed.process_count() == nproc
+    assert distributed.process_index() == pid
+    assert jax.local_device_count() == 4
+    assert jax.device_count() == 4 * nproc
+
+    mesh = distributed.hybrid_mesh(dcn={"dp": nproc}, ici={"sp": 4})
+    assert mesh.axis_names == ("dp", "sp")
+    assert mesh.shape == {"dp": nproc, "sp": 4}
+    # DCN axis outermost: each mesh row must be one process's devices
+    for row in np.asarray(mesh.devices):
+        assert len({d.process_index for d in row}) == 1, row
+
+    # all-reduce across both axes (crosses the process boundary)
+    @functools.partial(shard_map, mesh=mesh, in_specs=P("dp", "sp"),
+                       out_specs=P())
+    def total(x):
+        return jax.lax.psum(jnp.sum(x), ("dp", "sp"))
+
+    x = jnp.arange(nproc * 32, dtype=jnp.float32).reshape(nproc, 32)
+    got = float(total(x))
+    want = float(np.arange(nproc * 32).sum())
+    assert got == want, (got, want)
+
+    # batch-over-hosts, sequence-over-local-chips convolution; the result
+    # spans non-addressable devices, so allgather it (one more collective
+    # crossing the process boundary) before checking
+    from jax.experimental import multihost_utils
+
+    rng = np.random.RandomState(0)
+    xb = rng.randn(2 * nproc, 256).astype(np.float32)
+    ker = rng.randn(9).astype(np.float32)
+    out_global = sharded_convolve_batch(
+        jnp.asarray(xb), jnp.asarray(ker), mesh,
+        batch_axis="dp", seq_axis="sp")
+    out = np.asarray(multihost_utils.process_allgather(
+        out_global, tiled=True))
+    for i in range(len(xb)):
+        np.testing.assert_allclose(out[i], np.convolve(xb[i], ker),
+                                   atol=1e-3)
+
+    distributed.shutdown()
+    print(f"worker {pid}/{nproc} ok", flush=True)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]), int(sys.argv[2]), sys.argv[3])

@@ -17,7 +17,6 @@ Handle-based convolution keeps a registry keyed by an integer id, the C
 from __future__ import annotations
 
 import ctypes
-import os
 
 import numpy as np
 

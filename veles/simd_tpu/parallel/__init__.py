@@ -23,13 +23,18 @@ scale across ICI — XLA collectives instead of any message-passing runtime.
   over ICI.
 * :func:`data_parallel` — batch-dimension sharding for any batched op
   (DWT/normalize/mathfun pipelines).
+* :mod:`~veles.simd_tpu.parallel.distributed` — **multi-host** bootstrap:
+  ``jax.distributed`` runtime + hybrid ICI/DCN meshes (DCN axes
+  outermost so halo/psum hops stay on-slice).
 
 All of these compile and run on any mesh size — the test-suite uses a
-virtual 8-device CPU mesh (see ``conftest.py``), the driver's
+virtual 8-device CPU mesh (see ``conftest.py``) plus real multi-process
+workers (``tests/test_distributed.py``), the driver's
 ``dryrun_multichip`` does the same, and on real multi-chip hardware the
 identical code lays the collectives onto ICI.
 """
 
+from veles.simd_tpu.parallel import distributed
 from veles.simd_tpu.parallel.mesh import default_mesh, make_mesh
 from veles.simd_tpu.parallel.ops import (
     data_parallel, halo_exchange_left, halo_exchange_right,
@@ -37,4 +42,5 @@ from veles.simd_tpu.parallel.ops import (
 
 __all__ = ["make_mesh", "default_mesh", "sharded_convolve",
            "sharded_convolve_batch", "sharded_swt", "sharded_matmul",
-           "data_parallel", "halo_exchange_left", "halo_exchange_right"]
+           "data_parallel", "halo_exchange_left", "halo_exchange_right",
+           "distributed"]

@@ -131,11 +131,18 @@ def cpu_devices(n_devices: int):
     snap = _snapshot()
     provisioned = False
     try:
-        try:
+        if _backend_live():
+            # a live backend can't hang on re-query; count in-process and
+            # avoid subprocess device-lock contention with ourselves
+            try:
+                count = len(jax.devices())
+            except Exception:
+                count = 0
+        else:
+            count = _probe_real_device_count()
+        if count >= n_devices:
             devices = jax.devices()
-        except Exception:
-            devices = []
-        if len(devices) < n_devices:
+        else:
             provisioned = True
             _clear_backends()
             pin_cpu(n_devices)
@@ -148,3 +155,46 @@ def cpu_devices(n_devices: int):
     finally:
         if provisioned:
             _restore(snap)
+
+
+def _backend_live() -> bool:
+    """True when this process already initialized a jax backend."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
+
+
+def _probe_real_device_count(timeout: float = 90.0) -> int:
+    """Count the parent's *effective* platform's devices in a subprocess.
+
+    Backend init can hang indefinitely when a remote-relay platform (the
+    axon tunnel) is wedged; an in-process ``jax.devices()`` probe would
+    then hang the caller with no recourse.  A subprocess is killable: on
+    timeout or error the count is reported as 0 and the caller provisions
+    the virtual CPU mesh instead.  A config-level platform pin in the
+    parent (``maybe_override_platform`` / ``pin_cpu``) is replicated into
+    the probe, since subprocesses inherit env vars but not ``jax.config``
+    — and the sitecustomize stomps the env ones.  Bonus: a successful
+    probe leaves the calling process's jax still uninitialized, so a
+    subsequent CPU pin needs no backend teardown.
+    """
+    import subprocess
+    import sys
+
+    import jax
+
+    code = "import jax\n"
+    platforms = getattr(jax.config, "jax_platforms", None)
+    if platforms:
+        code += f"jax.config.update('jax_platforms', {platforms!r})\n"
+    code += "print(len(jax.devices()))"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout)
+        return int(proc.stdout.strip().splitlines()[-1])
+    except Exception:
+        return 0
