@@ -135,3 +135,35 @@ def test_convenience_form():
     h = RNG.randn(7).astype(np.float32)
     np.testing.assert_allclose(np.asarray(cv.convolve(x, h)),
                                _ref_full(x, h), atol=1e-4)
+
+
+def test_conv_precision_config_plumbing():
+    """Config.conv_precision reaches the block matmul as its precision
+    (numerically a no-op on CPU, which always computes full f32 — the
+    check is that every setting produces the correct result and the
+    config round-trips)."""
+    from veles.simd_tpu.utils.config import get_config, set_config
+
+    x = RNG.randn(4096).astype(np.float32)
+    h = RNG.randn(63).astype(np.float32)
+    want = _ref_full(x, h)
+    prev = get_config().conv_precision
+    try:
+        for prec in ("highest", "high"):
+            set_config(conv_precision=prec)
+            assert cv.os_precision() == prec
+            handle = cv.convolve_overlap_save_initialize(len(x), len(h))
+            np.testing.assert_allclose(
+                np.asarray(cv.convolve_overlap_save(handle, x, h, simd=True)),
+                want, atol=1e-3)
+    finally:
+        set_config(conv_precision=prev)
+
+
+def test_conv_precision_config_validated():
+    from veles.simd_tpu.utils.config import set_config
+
+    with pytest.raises(ValueError, match="conv_precision"):
+        set_config(conv_precision="default")  # 1-pass bf16: explicit only
+    with pytest.raises(ValueError, match="conv_precision"):
+        set_config(conv_precision="hihg")

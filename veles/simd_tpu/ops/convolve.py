@@ -60,6 +60,7 @@ __all__ = [
     "convolve_overlap_save_finalize",
     "convolve", "convolve_initialize", "convolve_finalize",
     "overlap_save_block_length", "tpu_block_length", "select_algorithm",
+    "os_precision",
 ]
 
 
@@ -194,8 +195,15 @@ def _conv_fft(x, h, m, reverse=False):
         jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("step", "reverse"))
-def _conv_os_matmul(x, h, step, reverse=False):
+def os_precision() -> str:
+    """The MXU precision the overlap-save block matmul will use
+    (``Config.conv_precision``)."""
+    return get_config().conv_precision
+
+
+@functools.partial(jax.jit, static_argnames=("step", "reverse",
+                                             "precision"))
+def _conv_os_matmul(x, h, step, reverse=False, precision=None):
     """Overlap-save with the per-block filter as one MXU matmul.
 
     The reference's overlap-save runs an FFT·multiply·IFFT per block
@@ -222,9 +230,17 @@ def _conv_os_matmul(x, h, step, reverse=False):
       ``[step, k+step]`` yields exactly those shifts, because
       ``t*(k+step) ≡ -t (mod k+step+1)``.
 
-    ``precision=HIGHEST`` keeps f32 accuracy (~5e-7 rel. error on randn
-    signals, measured against a float64 oracle); DEFAULT bf16 passes give
-    ~3e-3 and are not acceptable for the oracle tests.
+    ``precision`` (default from ``Config.conv_precision``) trades MXU
+    passes for accuracy — measured on v5e against a float64 oracle
+    (1M x 2047, randn):
+
+    * HIGHEST (6-pass bf16 = full f32): ~4.8e-7 rel., 3.08 GSamples/s
+      at step 2048, 4.33 at step 1024;
+    * HIGH (3-pass): ~1.3e-5 rel. — inside every correctness gate
+      (1e-4 TPU smoke, reference test epsilons) — 7.57 GSamples/s at
+      step 1024;
+    * DEFAULT (1-pass bf16): ~2.6e-3, NOT acceptable for the oracle
+      tests; available only by passing it explicitly.
     """
     n = x.shape[-1]
     k = h.shape[-1]
@@ -245,8 +261,10 @@ def _conv_os_matmul(x, h, step, reverse=False):
     # y[i*s+t] = sum_a frames[i, a] * kernel[t + k - 1 - a]
     w = jnp.pad(jnp.flip(kernel, axis=-1), (0, s + 1))       # len k+s+1
     MT = jnp.tile(w, s)[: s * (k + s)].reshape(s, k + s)[:, : s + k - 1]
+    # None is resolved by callers (os_precision()) BEFORE the jit cache
+    # key forms — resolving config in here would bake a stale value
     y = jnp.einsum("...ba,ta->...bt", frames, MT,
-                   precision=jax.lax.Precision.HIGHEST)
+                   precision=precision or "highest")
     y = y.reshape(y.shape[:-2] + (n_blocks * s,))
     return y[..., :out_len].astype(jnp.float32)
 
@@ -402,7 +420,8 @@ def _run(handle: ConvolutionHandle, x, h, simd=None):
         if handle.algorithm is ConvolutionAlgorithm.FFT:
             return _conv_fft(x, h, handle.fft_length, reverse=handle.reverse)
         if handle.os_matmul:
-            return _conv_os_matmul(x, h, handle.step, reverse=handle.reverse)
+            return _conv_os_matmul(x, h, handle.step, reverse=handle.reverse,
+                                   precision=os_precision())
         return _conv_overlap_save(x, h, handle.block_length,
                                   reverse=handle.reverse)
     x, h = np.asarray(x), np.asarray(h)

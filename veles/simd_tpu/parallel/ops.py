@@ -70,7 +70,8 @@ def _local_block_conv(x_ext, h):
     k = h.shape[-1]
     n_local = x_ext.shape[-1] - (k - 1)
     if k <= cv.AUTO_OS_MATMUL_MAX_H:
-        full = cv._conv_os_matmul(x_ext, h, cv.overlap_save_step(k))
+        full = cv._conv_os_matmul(x_ext, h, cv.overlap_save_step(k),
+                                  precision=cv.os_precision())
     else:
         full = cv._conv_overlap_save(
             x_ext, h, cv.tpu_block_length(k, x_ext.shape[-1]))
@@ -258,6 +259,10 @@ def data_parallel(fn, mesh: Mesh, axis: str = "dp"):
     >>> dwt = data_parallel(lambda x: wavelet_apply(DAUB, 8, PERIODIC, x),
     ...                     mesh)
     >>> hi, lo = dwt(batch_of_signals)   # batch split across chips
+
+    The wrapper holds a persistent ``jax.jit``: config read at trace time
+    (e.g. ``Config.conv_precision``) is baked into the cached executable —
+    later ``set_config`` changes do not retrace existing wrappers.
     """
     jfn = jax.jit(fn)
 

@@ -70,6 +70,24 @@ class Config:
     # Default float dtype for compute. f32 keeps exact parity with the
     # reference; bf16 unlocks full MXU throughput where tolerances allow.
     dtype: str = "float32"
+    # MXU precision for the overlap-save block matmul ("highest" = 6-pass
+    # bf16 emulation of f32, ~5e-7 rel. error; "high" = 3-pass, ~1.3e-5,
+    # ~1.8x faster — both inside every correctness gate incl. the 1e-4
+    # TPU smoke tolerance and the reference's own test epsilons; measured
+    # sweep in ops/convolve.py). No effect on CPU, which always computes
+    # full f32. 1-pass bf16 ("default", ~2.6e-3) fails the oracle gates
+    # and is deliberately NOT accepted here — pass it explicitly to
+    # _conv_os_matmul if you want it. NOTE: the value is read at trace
+    # time; ops already traced under an *enclosing* jit (e.g. a
+    # data_parallel wrapper) keep the precision they were traced with.
+    conv_precision: str = "highest"
+
+    def __post_init__(self):
+        allowed = ("highest", "high")
+        if self.conv_precision not in allowed:
+            raise ValueError(
+                f"conv_precision must be one of {allowed}, got "
+                f"{self.conv_precision!r}")
 
 
 _config = Config()
