@@ -6,7 +6,7 @@
 PYTHON ?= python
 
 .PHONY: all tests benchmarks bench cshim cshim-check wavelet-tables lint \
-        install clean
+        docs install clean
 
 all: cshim
 
@@ -30,6 +30,9 @@ wavelet-tables:
 
 lint:
 	$(PYTHON) tools/lint.py
+
+docs:
+	$(PYTHON) tools/gen_docs.py
 
 # pip-installs the Python/XLA core, then the C ABI (PREFIX=/usr/local)
 install:

@@ -188,11 +188,28 @@ static void test_wavelet(void) {
     CHECK_NEAR(lo8[i], lo8_na[i], 5e-4);
   }
 
+  /* published _na symbols must equal the simd=0 path exactly */
+  float hi_na2[32], lo_na2[32];
+  CHECK(wavelet_apply_na(WAVELET_TYPE_DAUBECHIES, 8, EXTENSION_TYPE_MIRROR,
+                         sig, 64, hi_na2, lo_na2) == 0);
+  for (int i = 0; i < 32; i++) {
+    CHECK(hi_na2[i] == hi8_na[i]);
+    CHECK(lo_na2[i] == lo8_na[i]);
+  }
+
   /* SWT keeps length */
   float shi[64], slo[64];
   CHECK(stationary_wavelet_apply(1, WAVELET_TYPE_SYMLET, 8, 2,
                                  EXTENSION_TYPE_PERIODIC, sig, 64, shi,
                                  slo) == 0);
+  float shi_na[64], slo_na[64];
+  CHECK(stationary_wavelet_apply_na(WAVELET_TYPE_SYMLET, 8, 2,
+                                    EXTENSION_TYPE_PERIODIC, sig, 64,
+                                    shi_na, slo_na) == 0);
+  for (int i = 0; i < 64; i++) {
+    CHECK_NEAR(shi[i], shi_na[i], 5e-4);
+    CHECK_NEAR(slo[i], slo_na[i], 5e-4);
+  }
 
   /* layout helpers (inc/simd/wavelet.h:55-88 semantics) */
   float *prep = wavelet_prepare_array(8, sig, 64);

@@ -389,6 +389,20 @@ int stationary_wavelet_apply(int simd, WaveletType type, int order, int level,
                   PTR(desthi), PTR(destlo));
 }
 
+int wavelet_apply_na(WaveletType type, int order, ExtensionType ext,
+                     const float *src, size_t length,
+                     float *desthi, float *destlo) {
+  return wavelet_apply(0, type, order, ext, src, length, desthi, destlo);
+}
+
+int stationary_wavelet_apply_na(WaveletType type, int order, int level,
+                                ExtensionType ext, const float *src,
+                                size_t length, float *desthi,
+                                float *destlo) {
+  return stationary_wavelet_apply(0, type, order, level, ext, src, length,
+                                  desthi, destlo);
+}
+
 /* ---- mathfun ---------------------------------------------------------- */
 
 static int psv(const char *name, int simd, const float *src, size_t length,

@@ -145,6 +145,14 @@ int wavelet_apply(int simd, WaveletType type, int order, ExtensionType ext,
 int stationary_wavelet_apply(int simd, WaveletType type, int order, int level,
                              ExtensionType ext, const float *src,
                              size_t length, float *desthi, float *destlo);
+/* Oracle twins, published as separate symbols like the reference's
+ * (inc/simd/wavelet.h:45-162) — identical to passing simd=0 above. */
+int wavelet_apply_na(WaveletType type, int order, ExtensionType ext,
+                     const float *src, size_t length,
+                     float *desthi, float *destlo);
+int stationary_wavelet_apply_na(WaveletType type, int order, int level,
+                                ExtensionType ext, const float *src,
+                                size_t length, float *desthi, float *destlo);
 
 /* ---- mathfun (inc/simd/mathfun.h:142-204) ----------------------------- */
 

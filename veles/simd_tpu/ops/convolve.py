@@ -230,8 +230,9 @@ def _conv_os_matmul(x, h, step, reverse=False, precision=None):
       ``[step, k+step]`` yields exactly those shifts, because
       ``t*(k+step) ≡ -t (mod k+step+1)``.
 
-    ``precision`` (default from ``Config.conv_precision``) trades MXU
-    passes for accuracy — measured on v5e against a float64 oracle
+    ``precision`` trades MXU passes for accuracy (``None`` → "highest";
+    the handle/public paths pass ``Config.conv_precision`` explicitly via
+    :func:`os_precision`) — measured on v5e against a float64 oracle
     (1M x 2047, randn):
 
     * HIGHEST (6-pass bf16 = full f32): ~4.8e-7 rel., 3.08 GSamples/s
@@ -261,8 +262,9 @@ def _conv_os_matmul(x, h, step, reverse=False, precision=None):
     # y[i*s+t] = sum_a frames[i, a] * kernel[t + k - 1 - a]
     w = jnp.pad(jnp.flip(kernel, axis=-1), (0, s + 1))       # len k+s+1
     MT = jnp.tile(w, s)[: s * (k + s)].reshape(s, k + s)[:, : s + k - 1]
-    # None is resolved by callers (os_precision()) BEFORE the jit cache
-    # key forms — resolving config in here would bake a stale value
+    # public callers resolve Config.conv_precision via os_precision()
+    # before the jit cache key forms (reading config here would bake a
+    # stale value); a direct call omitting precision gets plain "highest"
     y = jnp.einsum("...ba,ta->...bt", frames, MT,
                    precision=precision or "highest")
     y = y.reshape(y.shape[:-2] + (n_blocks * s,))
