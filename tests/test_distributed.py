@@ -51,3 +51,34 @@ def test_multiprocess_collectives(nproc):
         assert p.returncode == 0, (
             f"worker {pid} rc={p.returncode}\n{out[-3000:]}")
         assert f"worker {pid}/{nproc} ok" in out
+
+
+# ---- in-process hybrid_mesh unit tests (single process: process_count=1,
+# 8 virtual local devices from conftest's pin) ----------------------------
+
+def test_hybrid_mesh_single_process():
+    import jax
+    from veles.simd_tpu.parallel import distributed
+
+    mesh = distributed.hybrid_mesh(dcn={"dp": 1}, ici={"sp": 2, "tp": 4})
+    assert mesh.axis_names == ("dp", "sp", "tp")
+    assert mesh.shape == {"dp": 1, "sp": 2, "tp": 4}
+    assert mesh.devices.size == jax.local_device_count()
+
+
+def test_hybrid_mesh_ici_only():
+    from veles.simd_tpu.parallel import distributed
+
+    mesh = distributed.hybrid_mesh(ici={"tp": 8})
+    assert mesh.shape == {"tp": 8}
+
+
+def test_hybrid_mesh_validates_sizes():
+    from veles.simd_tpu.parallel import distributed
+
+    with pytest.raises(ValueError, match="dcn"):
+        distributed.hybrid_mesh(dcn={"dp": 3}, ici={"sp": 8})
+    with pytest.raises(ValueError, match="ici"):
+        distributed.hybrid_mesh(dcn={"dp": 1}, ici={"sp": 3})
+    with pytest.raises(ValueError, match="at least one"):
+        distributed.hybrid_mesh()

@@ -82,6 +82,8 @@ def hybrid_mesh(dcn: dict[str, int] | None = None,
     """
     dcn = dict(dcn or {})
     ici = dict(ici or {})
+    if not dcn and not ici:
+        raise ValueError("at least one dcn or ici axis is required")
     n_proc = jax.process_count()
     n_local = jax.local_device_count()
     dcn_sizes = [int(s) for s in dcn.values()]
@@ -93,8 +95,6 @@ def hybrid_mesh(dcn: dict[str, int] | None = None,
         raise ValueError(f"ici axes {ici} must multiply to "
                          f"local_device_count()={n_local}")
     names = tuple(dcn) + tuple(ici)
-    if not names:
-        raise ValueError("at least one dcn or ici axis is required")
     shape = dcn_sizes + ici_sizes
     # per-dimension shapes for create_hybrid_device_mesh: DCN dims are 1
     # in the ICI shape and vice versa
