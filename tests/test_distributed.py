@@ -5,7 +5,8 @@ Spawns N ``distributed_worker.py`` processes that join one
 localhost standing in for DCN), not a virtual mesh in one process.  This
 is the closest a single box gets to multi-host: separate backends,
 separate address spaces, a coordinator, and an all-reduce that crosses
-them.  Single-process sharding coverage lives in ``test_parallel.py``.
+them.  In-process ``hybrid_mesh`` unit tests live at the bottom of this
+file; sharded-op coverage lives in ``test_parallel.py``.
 """
 
 import os

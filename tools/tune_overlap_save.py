@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Measure the overlap-save block-matmul step-size sweep on the device.
+
+The reference's algorithm thresholds are hardcoded from offline
+measurement (``/root/reference/src/convolve.c:328-364``); this is the
+measurement tool for ours.  For each filter length it times the MXU
+block-matmul overlap-save (``_conv_os_matmul``) across output-block
+sizes and both precisions with chained on-device loops, checks accuracy
+against a float64 oracle, and prints the winning step per (k, precision)
+— the data behind ``ops/convolve.py``'s ``overlap_save_step`` and
+``AUTO_*`` constants.  Rerun on new hardware generations.
+
+Run:  python tools/tune_overlap_save.py [--quick] [--n 1048576]
+      VELES_SIMD_PLATFORM=cpu ... works but only validates plumbing —
+      step size is an MXU tiling decision, so tune on the real chip.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from veles.simd_tpu.utils.platform import maybe_override_platform  # noqa: E402
+
+# steps whose rel. error exceeds this never become winners — matches the
+# TPU smoke gate for convolve (tools/tpu_smoke.py)
+ERR_GATE = 1e-4
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--n", type=int, default=1 << 20)
+    args = parser.parse_args()
+    maybe_override_platform()
+    quick = args.quick
+    n = args.n
+
+    import jax
+    import jax.numpy as jnp
+
+    from veles.simd_tpu.ops import convolve as cv
+    from veles.simd_tpu.utils.benchmark import device_time_chained
+
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(n).astype(np.float32)
+    x = jnp.asarray(x_np)
+    print(f"device: {jax.devices()[0]}  signal: {n}", flush=True)
+
+    ks = (127, 2047) if quick else (127, 511, 2047, 8191)
+    steps = (256, 512, 1024, 2048)
+    precisions = ("highest", "high")
+    winners = {}
+    for k in ks:
+        h_np = rng.randn(k).astype(np.float32)
+        h = jnp.asarray(h_np)
+        want = np.convolve(x_np.astype(np.float64), h_np.astype(np.float64))
+        scale = np.max(np.abs(want))
+        for prec in precisions:
+            best = (float("inf"), None)
+            for step in steps:
+                got = np.asarray(
+                    cv._conv_os_matmul(x, h, step, precision=prec),
+                    np.float64)
+                err = float(np.max(np.abs(got - want)) / scale)
+
+                def stp(v, step=step, prec=prec, h=h):
+                    y = cv._conv_os_matmul(v, h, step, precision=prec)
+                    return v + 1e-30 * y[..., :n]
+
+                t = device_time_chained(stp, x, iters=64, repeats=2)
+                gated = " (fails accuracy gate)" if err > ERR_GATE else ""
+                print(f"k={k:5d} prec={prec:8s} step={step:5d}: "
+                      f"{t * 1e3:7.3f} ms  {n / t / 1e6:7.0f} Ms/s  "
+                      f"rel_err={err:.1e}{gated}", flush=True)
+                if err <= ERR_GATE and t < best[0]:
+                    best = (t, step)
+            winners[(k, prec)] = best[1]
+            cur = cv.overlap_save_step(k)
+            print(f"  -> k={k} {prec}: best step {best[1]} "
+                  f"(overlap_save_step gives {cur})", flush=True)
+    print("winners:", winners)
+
+
+if __name__ == "__main__":
+    main()
