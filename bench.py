@@ -158,9 +158,11 @@ def bench_dwt(rng):
 
 
 def main():
-    from veles.simd_tpu.utils.platform import maybe_override_platform
+    from veles.simd_tpu.utils.platform import (
+        maybe_override_platform, require_reachable_device)
 
     maybe_override_platform()  # VELES_SIMD_PLATFORM=cpu runs without TPU
+    require_reachable_device()  # fail fast on a wedged relay, don't hang
     import jax
 
     from tools.tpu_smoke import run_smoke

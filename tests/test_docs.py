@@ -5,7 +5,6 @@ so it can't go stale; ours is committed output, so this test is the
 staleness guard the build system would otherwise be.
 """
 
-import os
 import sys
 from pathlib import Path
 

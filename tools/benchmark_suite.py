@@ -66,9 +66,11 @@ def benchmark(name, step, x0, baseline_fn, *, samples=None, flops=None,
 
 def main():
     quick = "--quick" in sys.argv
-    from veles.simd_tpu.utils.platform import maybe_override_platform
+    from veles.simd_tpu.utils.platform import (
+        maybe_override_platform, require_reachable_device)
 
     maybe_override_platform()  # VELES_SIMD_PLATFORM=cpu runs without TPU
+    require_reachable_device()  # fail fast on a wedged relay, don't hang
     import jax.numpy as jnp
 
     from veles.simd_tpu.ops import convolve as cv

@@ -19,7 +19,8 @@ import contextlib
 import os
 
 __all__ = ["set_cpu_env", "pin_cpu", "cpu_devices",
-           "maybe_override_platform"]
+           "maybe_override_platform", "probe_device_count",
+           "require_reachable_device"]
 
 
 def maybe_override_platform(env_var: str = "VELES_SIMD_PLATFORM") -> None:
@@ -139,7 +140,7 @@ def cpu_devices(n_devices: int):
             except Exception:
                 count = 0
         else:
-            count = _probe_real_device_count()
+            count = probe_device_count()
         if count >= n_devices:
             devices = jax.devices()
         else:
@@ -167,7 +168,7 @@ def _backend_live() -> bool:
         return False
 
 
-def _probe_real_device_count(timeout: float = 90.0) -> int:
+def probe_device_count(timeout: float = 90.0) -> int:
     """Count the parent's *effective* platform's devices in a subprocess.
 
     Backend init can hang indefinitely when a remote-relay platform (the
@@ -181,6 +182,27 @@ def _probe_real_device_count(timeout: float = 90.0) -> int:
     probe leaves the calling process's jax still uninitialized, so a
     subsequent CPU pin needs no backend teardown.
     """
+    return _probe_subprocess(timeout)[0]
+
+
+def require_reachable_device(timeout: float = 120.0) -> None:
+    """Fail fast (SystemExit 2) when backend init would hang or crash.
+
+    For benchmark/CLI entry points: a wedged remote relay blocks backend
+    init forever (observed live), eating the caller's whole timeout with
+    no diagnostics.  The probe subprocess surfaces the actual cause —
+    timeout vs a child crash — instead of hanging.
+    """
+    import sys
+
+    count, detail = _probe_subprocess(timeout)
+    if count < 1:
+        print(f"device platform unreachable: {detail}", file=sys.stderr)
+        raise SystemExit(2)
+
+
+def _probe_subprocess(timeout: float) -> tuple[int, str]:
+    """(device count, failure detail) from a killable probe subprocess."""
     import subprocess
     import sys
 
@@ -195,6 +217,13 @@ def _probe_real_device_count(timeout: float = 90.0) -> int:
         proc = subprocess.run(
             [sys.executable, "-c", code],
             capture_output=True, text=True, timeout=timeout)
-        return int(proc.stdout.strip().splitlines()[-1])
+        return int(proc.stdout.strip().splitlines()[-1]), ""
+    except subprocess.TimeoutExpired:
+        return 0, f"backend init probe timed out after {timeout:.0f}s"
     except Exception:
-        return 0
+        tail = ""
+        try:
+            tail = (proc.stderr or proc.stdout or "").strip()[-500:]
+        except NameError:
+            pass
+        return 0, f"backend init probe failed: {tail or 'no output'}"
