@@ -1,0 +1,28 @@
+"""Examples must keep running — each is executed as a subprocess.
+
+The scripts self-verify (they assert and print 'ok'); this gate just
+keeps them from rotting as the API evolves.  CPU-pinned via
+VELES_SIMD_PLATFORM so no device is needed.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+EXAMPLES = os.path.join(HERE, os.pardir, "examples")
+
+
+@pytest.mark.parametrize("script", sorted(
+    f for f in os.listdir(EXAMPLES) if f.endswith(".py")))
+def test_example_runs(script):
+    env = dict(os.environ)
+    env["VELES_SIMD_PLATFORM"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # examples provision their own devices
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script)],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, (
+        f"{script} rc={proc.returncode}\n{(proc.stderr or '')[-3000:]}")

@@ -154,6 +154,24 @@ def _check_detect_peaks(rng):
     return _rel_err(vals, vals_na), 1e-6
 
 
+def _check_parallel(rng):
+    """shard_map/collective lowering on the actual device (a 1-chip mesh
+    still exercises ppermute/psum code paths through the TPU compiler)."""
+    from veles.simd_tpu.parallel import (
+        default_mesh, sharded_convolve, sharded_matmul)
+
+    x = rng.randn(4096).astype(np.float32)
+    h = rng.randn(33).astype(np.float32)
+    want = np.convolve(x.astype(np.float64), h.astype(np.float64))
+    errs = [_rel_err(sharded_convolve(x, h, default_mesh("sp"), axis="sp"),
+                     want)]
+    a = rng.randn(64, 96).astype(np.float32)
+    b = rng.randn(96, 48).astype(np.float32)
+    errs.append(_rel_err(sharded_matmul(a, b, default_mesh("tp"), axis="tp"),
+                         a.astype(np.float64) @ b.astype(np.float64)))
+    return max(errs), 1e-4
+
+
 FAMILIES = [
     ("arithmetic", _check_arithmetic),
     ("mathfun", _check_mathfun),
@@ -163,6 +181,7 @@ FAMILIES = [
     ("wavelet", _check_wavelet),
     ("normalize", _check_normalize),
     ("detect_peaks", _check_detect_peaks),
+    ("parallel", _check_parallel),
 ]
 
 
@@ -190,4 +209,9 @@ def run_smoke(emit=None) -> bool:
 
 
 if __name__ == "__main__":
+    from veles.simd_tpu.utils.platform import (
+        maybe_override_platform, require_reachable_device)
+
+    maybe_override_platform()
+    require_reachable_device()  # fail fast on a wedged relay, don't hang
     sys.exit(0 if run_smoke() else 1)

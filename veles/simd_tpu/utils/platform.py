@@ -213,17 +213,27 @@ def _probe_subprocess(timeout: float) -> tuple[int, str]:
     if platforms:
         code += f"jax.config.update('jax_platforms', {platforms!r})\n"
     code += "print(len(jax.devices()))"
+    def _tail(*chunks) -> str:
+        for c in chunks:
+            if isinstance(c, bytes):
+                c = c.decode(errors="replace")
+            if c and c.strip():
+                return c.strip()[-500:]
+        return ""
+
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code],
             capture_output=True, text=True, timeout=timeout)
         return int(proc.stdout.strip().splitlines()[-1]), ""
-    except subprocess.TimeoutExpired:
-        return 0, f"backend init probe timed out after {timeout:.0f}s"
+    except subprocess.TimeoutExpired as e:
+        detail = _tail(e.stderr, e.stdout)
+        return 0, (f"backend init probe timed out after {timeout:.0f}s"
+                   + (f"; child output: {detail}" if detail else ""))
     except Exception:
         tail = ""
         try:
-            tail = (proc.stderr or proc.stdout or "").strip()[-500:]
+            tail = _tail(proc.stderr, proc.stdout)
         except NameError:
             pass
         return 0, f"backend init probe failed: {tail or 'no output'}"
