@@ -32,7 +32,7 @@ def main():
         from veles.simd_tpu.parallel import (
             make_mesh, sharded_convolve, sharded_convolve_batch)
 
-        mesh = make_mesh({"sp": len(devices)})
+        mesh = make_mesh({"sp": len(devices)}, devices=devices)
         rng = np.random.RandomState(0)
         n, k = 1 << 22, 255
         x = rng.randn(n).astype(np.float32)
@@ -52,7 +52,7 @@ def main():
         print("spot-check vs oracle: ok")
 
         # dp x sp: a batch of signals over a 2D mesh tile
-        mesh2 = make_mesh({"dp": 2, "sp": 4})
+        mesh2 = make_mesh({"dp": 2, "sp": 4}, devices=devices)
         xb = rng.randn(4, 1 << 16).astype(np.float32)
         yb = np.asarray(sharded_convolve_batch(jnp.asarray(xb),
                                                jnp.asarray(h), mesh2))

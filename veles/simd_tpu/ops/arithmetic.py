@@ -37,7 +37,8 @@ from veles.simd_tpu.utils.config import resolve_simd
 __all__ = [
     "int16_to_float", "float_to_int16", "int32_to_float", "float_to_int32",
     "int16_to_int32", "int32_to_int16", "float16_to_float", "int16_multiply",
-    "real_multiply", "real_multiply_scalar", "complex_multiply",
+    "real_multiply", "real_multiply_array", "real_multiply_scalar",
+    "complex_multiply",
     "complex_multiply_conjugate", "complex_conjugate", "sum_elements",
     "add_to_all", "interleave_complex", "deinterleave_complex",
 ]
@@ -284,6 +285,11 @@ def int16_multiply(a, b, simd=None):
 def real_multiply(a, b, simd=None):
     """Elementwise f32 multiply (``real_multiply_array``)."""
     return _dispatch(simd, _real_multiply, real_multiply_array_na, a, b)
+
+
+# the reference publishes both spellings (inc/simd/arithmetic.h:170-176);
+# they are the same elementwise product here
+real_multiply_array = real_multiply
 
 
 def real_multiply_scalar(data, value, simd=None):

@@ -103,6 +103,13 @@ def malloc_aligned(size: int) -> np.ndarray:
     return np.zeros(int(size), dtype=np.uint8)
 
 
+def malloc_aligned_offset(size: int, offset: int) -> np.ndarray:
+    """Compatibility stub for ``inc/simd/memory.h:100`` (alloc whose
+    ``ptr + offset`` is aligned): a view at ``offset`` into a fresh
+    buffer — XLA owns real layout, so only the length contract matters."""
+    return np.zeros(int(size) + int(offset), dtype=np.uint8)[int(offset):]
+
+
 def mallocf(length: int) -> np.ndarray:
     """Compatibility stub for ``src/memory.c:89-91``."""
     return np.zeros(int(length), dtype=np.float32)
