@@ -1,0 +1,1 @@
+"""veles — namespace root for the TPU-native rebuild of veles.simd."""

@@ -1,0 +1,39 @@
+"""veles.simd_tpu — a TPU-native signal-processing / linear-algebra framework.
+
+A from-scratch rebuild of the capability surface of ``veles.simd`` (a C99
+SSE/AVX/NEON SIMD library; see /root/reference) designed TPU-first:
+
+* every op is a pure, jittable JAX function lowered to XLA (MXU for the
+  matmul/conv FLOPs, VPU for elementwise, batched FFT for the spectral paths),
+* every op keeps a NumPy *oracle* twin (the reference's ``*_na`` scalar
+  implementations pattern, e.g. ``/root/reference/src/matrix.c:37-80``) driven
+  through the same public entry point via the ``simd`` flag — preserving the
+  reference's SIMD-vs-scalar cross-validation test discipline
+  (``/root/reference/tests/matrix.cc:94-98``),
+* long signals scale across chips via ``shard_map`` over an ICI mesh with halo
+  exchange (``veles.simd_tpu.parallel``) instead of the reference's
+  single-thread overlap-save loop (``/root/reference/src/convolve.c:181-228``).
+
+Public API (mirrors the reference's header surface,
+``/root/reference/inc/simd/``):
+
+======================  =====================================================
+reference header        this package
+======================  =====================================================
+arithmetic.h            :mod:`veles.simd_tpu.ops.arithmetic`
+mathfun.h               :mod:`veles.simd_tpu.ops.mathfun`
+matrix.h                :mod:`veles.simd_tpu.ops.matrix`
+convolve.h              :mod:`veles.simd_tpu.ops.convolve`
+correlate.h             :mod:`veles.simd_tpu.ops.correlate`
+wavelet.h               :mod:`veles.simd_tpu.ops.wavelet`
+normalize.h             :mod:`veles.simd_tpu.ops.normalize`
+detect_peaks.h          :mod:`veles.simd_tpu.ops.detect_peaks`
+memory.h                :mod:`veles.simd_tpu.utils.memory`
+======================  =====================================================
+"""
+
+from veles.simd_tpu.utils.config import Backend, get_backend, set_backend
+
+__version__ = "0.1.0"
+
+__all__ = ["Backend", "get_backend", "set_backend", "__version__"]

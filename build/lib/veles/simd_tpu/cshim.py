@@ -1,0 +1,236 @@
+"""Python side of the native C shim (``csrc/veles_simd.c``).
+
+The C library embeds CPython and calls the functions in this module with
+raw pointers (as integers) + geometry; here they are wrapped zero-copy
+with ``np.ctypeslib``, dispatched through the normal
+:mod:`veles.simd_tpu` ops (XLA or oracle per the ``simd`` flag), and the
+results are written back into the caller's output buffer.  This preserves
+the reference's C ABI workflow (compute into caller-allocated arrays —
+e.g. ``/root/reference/inc/simd/matrix.h:47-89``) while the math runs on
+the TPU.
+
+Handle-based convolution keeps a registry keyed by an integer id, the C
+``VelesConvolutionHandle`` payload — the ABI analog of
+``ConvolutionHandle`` (``/root/reference/inc/simd/convolve_structs.h``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+# The axon TPU plugin pins the JAX platform from sitecustomize before env
+# vars are consulted; give C hosts an explicit override.
+from veles.simd_tpu.utils.platform import maybe_override_platform
+
+maybe_override_platform()
+
+from veles.simd_tpu.ops import arithmetic as _ar
+from veles.simd_tpu.ops import convolve as _cv
+from veles.simd_tpu.ops import correlate as _cr
+from veles.simd_tpu.ops import detect_peaks as _dp
+from veles.simd_tpu.ops import mathfun as _mf
+from veles.simd_tpu.ops import matrix as _mx
+from veles.simd_tpu.ops import normalize as _nz
+from veles.simd_tpu.ops import wavelet as _wv
+from veles.simd_tpu.ops.wavelet_coeffs import WaveletType as _WT
+
+_C_WAVELET_TYPES = {0: _WT.DAUBECHIES, 1: _WT.COIFLET, 2: _WT.SYMLET}
+_C_EXTENSIONS = {0: _wv.ExtensionType.PERIODIC, 1: _wv.ExtensionType.MIRROR,
+                 2: _wv.ExtensionType.CONSTANT, 3: _wv.ExtensionType.ZERO}
+_C_ALGORITHMS = {0: None, 1: _cv.ConvolutionAlgorithm.BRUTE_FORCE,
+                 2: _cv.ConvolutionAlgorithm.FFT,
+                 3: _cv.ConvolutionAlgorithm.OVERLAP_SAVE}
+
+
+def _arr(ptr, shape, ctype):
+    return np.ctypeslib.as_array(
+        ctypes.cast(ptr, ctypes.POINTER(ctype)),
+        shape=tuple(int(s) for s in shape))
+
+
+def _f32(ptr, *shape):
+    return _arr(ptr, shape, ctypes.c_float)
+
+
+def backend_description() -> str:
+    import jax
+
+    return f"xla:{jax.default_backend()}"
+
+
+# ---- matrix ---------------------------------------------------------------
+
+def matrix_add(simd, m1, m2, res, w, h):
+    _f32(res, h, w)[...] = _mx.matrix_add(
+        _f32(m1, h, w), _f32(m2, h, w), simd=bool(simd))
+    return 0
+
+
+def matrix_sub(simd, m1, m2, res, w, h):
+    _f32(res, h, w)[...] = _mx.matrix_sub(
+        _f32(m1, h, w), _f32(m2, h, w), simd=bool(simd))
+    return 0
+
+
+def matrix_multiply(simd, m1, m2, res, w1, h1, w2, h2):
+    _f32(res, h1, w2)[...] = _mx.matrix_multiply(
+        _f32(m1, h1, w1), _f32(m2, h2, w2), simd=bool(simd))
+    return 0
+
+
+def matrix_multiply_transposed(simd, m1, m2, res, w1, h1, w2, h2):
+    _f32(res, h1, h2)[...] = _mx.matrix_multiply_transposed(
+        _f32(m1, h1, w1), _f32(m2, h2, w2), simd=bool(simd))
+    return 0
+
+
+# ---- convolve / correlate -------------------------------------------------
+
+_handles: dict[int, _cv.ConvolutionHandle] = {}
+_next_handle = [1]
+
+
+def convolve_initialize(x_length, h_length, algorithm, reverse):
+    handle = _cv.convolve_initialize(x_length, h_length,
+                                     _C_ALGORITHMS[int(algorithm)],
+                                     reverse=bool(reverse))
+    hid = _next_handle[0]
+    _next_handle[0] += 1
+    _handles[hid] = handle
+    return hid
+
+
+def convolve_run(hid, x, h, result):
+    handle = _handles[int(hid)]
+    out = _cv._run(handle, _f32(x, handle.x_length),
+                   _f32(h, handle.h_length), simd=True)
+    _f32(result, handle.result_length)[...] = np.asarray(out)
+    return 0
+
+
+def convolve_finalize(hid):
+    _handles.pop(int(hid), None)
+    return 0
+
+
+def convolve_simd(simd, x, xlen, h, hlen, result):
+    out = _cv.convolve_simd(_f32(x, xlen), _f32(h, hlen), simd=bool(simd))
+    _f32(result, xlen + hlen - 1)[...] = np.asarray(out)
+    return 0
+
+
+def cross_correlate_simd(simd, x, xlen, h, hlen, result):
+    out = _cr.cross_correlate_simd(_f32(x, xlen), _f32(h, hlen),
+                                   simd=bool(simd))
+    _f32(result, xlen + hlen - 1)[...] = np.asarray(out)
+    return 0
+
+
+# ---- wavelet --------------------------------------------------------------
+
+def wavelet_validate_order(wtype, order):
+    return int(_wv.wavelet_validate_order(_C_WAVELET_TYPES[int(wtype)],
+                                          int(order)))
+
+
+def wavelet_apply(simd, wtype, order, ext, src, length, desthi, destlo):
+    hi, lo = _wv.wavelet_apply(
+        _C_WAVELET_TYPES[int(wtype)], int(order), _C_EXTENSIONS[int(ext)],
+        _f32(src, length), simd=bool(simd))
+    _f32(desthi, length // 2)[...] = np.asarray(hi)
+    _f32(destlo, length // 2)[...] = np.asarray(lo)
+    return 0
+
+
+def stationary_wavelet_apply(simd, wtype, order, level, ext, src, length,
+                             desthi, destlo):
+    hi, lo = _wv.stationary_wavelet_apply(
+        _C_WAVELET_TYPES[int(wtype)], int(order), int(level),
+        _C_EXTENSIONS[int(ext)], _f32(src, length), simd=bool(simd))
+    _f32(desthi, length)[...] = np.asarray(hi)
+    _f32(destlo, length)[...] = np.asarray(lo)
+    return 0
+
+
+# ---- mathfun --------------------------------------------------------------
+
+def mathfun(name, simd, src, length, res):
+    fn = {"sin": _mf.sin_psv, "cos": _mf.cos_psv, "log": _mf.log_psv,
+          "exp": _mf.exp_psv}[name]
+    _f32(res, length)[...] = np.asarray(fn(_f32(src, length),
+                                           simd=bool(simd)))
+    return 0
+
+
+# ---- normalize ------------------------------------------------------------
+
+def _u8(ptr, *shape):
+    return _arr(ptr, shape, ctypes.c_uint8)
+
+
+def normalize2D(simd, src, src_stride, width, height, dst, dst_stride):
+    plane = _u8(src, height, src_stride)[..., :width]
+    out = np.asarray(_nz.normalize2D(plane, simd=bool(simd)))
+    _f32(dst, height, dst_stride)[..., :width] = out
+    return 0
+
+
+def minmax2D(simd, src, src_stride, width, height):
+    plane = _u8(src, height, src_stride)[..., :width]
+    mn, mx = _nz.minmax2D(plane, simd=bool(simd))
+    return (int(mn), int(mx))
+
+
+def minmax1D(simd, src, length):
+    mn, mx = _nz.minmax1D(_f32(src, length), simd=bool(simd))
+    return (float(mn), float(mx))
+
+
+def normalize2D_minmax(simd, mn, mx, src, src_stride, width, height, dst,
+                       dst_stride):
+    plane = _u8(src, height, src_stride)[..., :width]
+    out = np.asarray(_nz.normalize2D_minmax(int(mn), int(mx), plane,
+                                            simd=bool(simd)))
+    _f32(dst, height, dst_stride)[..., :width] = out
+    return 0
+
+
+# ---- detect_peaks ---------------------------------------------------------
+
+def detect_peaks(simd, data, size, etype):
+    pos, vals = _dp.detect_peaks(_f32(data, size),
+                                 _dp.ExtremumType(int(etype)),
+                                 simd=bool(simd))
+    return (np.asarray(pos, np.int64).tolist(),
+            np.asarray(vals, np.float64).tolist())
+
+
+# ---- conversions ----------------------------------------------------------
+
+def convert(name, simd, src, length, dst):
+    if name == "int16_to_float":
+        _f32(dst, length)[...] = _ar.int16_to_float(
+            _arr(src, (length,), ctypes.c_int16), simd=bool(simd))
+    elif name == "float_to_int16":
+        _arr(dst, (length,), ctypes.c_int16)[...] = _ar.float_to_int16(
+            _f32(src, length), simd=bool(simd))
+    elif name == "int32_to_float":
+        _f32(dst, length)[...] = _ar.int32_to_float(
+            _arr(src, (length,), ctypes.c_int32), simd=bool(simd))
+    elif name == "float_to_int32":
+        _arr(dst, (length,), ctypes.c_int32)[...] = _ar.float_to_int32(
+            _f32(src, length), simd=bool(simd))
+    elif name == "int16_to_int32":
+        _arr(dst, (length,), ctypes.c_int32)[...] = _ar.int16_to_int32(
+            _arr(src, (length,), ctypes.c_int16), simd=bool(simd))
+    elif name == "int32_to_int16":
+        _arr(dst, (length,), ctypes.c_int16)[...] = _ar.int32_to_int16(
+            _arr(src, (length,), ctypes.c_int32), simd=bool(simd))
+    elif name == "float16_to_float":
+        _f32(dst, length)[...] = _ar.float16_to_float(
+            _arr(src, (length,), ctypes.c_uint16), simd=bool(simd))
+    else:
+        raise ValueError(name)
+    return 0

@@ -1,0 +1,20 @@
+"""TPU-lowered op library (replaces the reference's L3/L4 layers).
+
+Each module mirrors one reference header (SURVEY.md §2):
+
+* :mod:`.arithmetic`   — conversions, complex/real multiply, reductions
+* :mod:`.mathfun`      — vectorized sin/cos/log/exp
+* :mod:`.matrix`       — BLAS L1/L2/L3 subset on the MXU
+* :mod:`.convolve`     — 1D convolution (brute / FFT / overlap-save,
+  auto-select)
+* :mod:`.correlate`    — 1D cross-correlation (reversed-h reuse of convolve)
+* :mod:`.wavelet`      — 1D DWT / stationary SWT filter banks
+* :mod:`.wavelet_coeffs` — generated Daubechies / Symlet / Coiflet tables
+* :mod:`.normalize`    — 1D/2D min-max normalization
+* :mod:`.detect_peaks` — 1D local-extrema detection
+
+Every public op takes the reference-compatible ``simd=`` flag: truthy (the
+default) runs the jitted XLA path; falsy runs the NumPy oracle twin, keeping
+the reference's cross-validation discipline
+(``/root/reference/tests/matrix.cc:94-98``).
+"""

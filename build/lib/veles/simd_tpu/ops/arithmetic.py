@@ -1,0 +1,351 @@
+"""Conversions, complex/real multiplies, and reductions.
+
+TPU-native rebuild of the reference's header-only inline kernel layer
+``/root/reference/inc/simd/arithmetic.h`` (scalar ``*_na`` at ``:43-191``,
+AVX2 ``:199-365``, SSE ``:367-613``, NEON ``:832-1201``).  On TPU all of these
+are single XLA elementwise / reduce HLOs that fuse into neighbouring ops — the
+per-ISA variants and the alignment-complement asserts (``:235,260``) disappear
+because XLA owns layout.
+
+Semantics preserved from the reference:
+
+* ``float_to_int16`` / ``float_to_int32`` **truncate** toward zero, not round
+  (``arithmetic.h:53-55``), and saturate on overflow like the AVX
+  ``packs_epi32`` path (``:270``) — the scalar C cast is UB there, so the
+  saturating behaviour is the defined superset.
+* ``int32_to_int16`` saturates (AVX ``_mm_packs_epi32``, ``:334``; note the
+  scalar ``_na`` truncates instead — we follow the vector path and expose
+  ``int32_to_int16_na`` with C-cast wrap-around for oracle parity).
+* ``float16_to_float`` covers subnormals / inf / nan / signed zero exactly
+  (``arithmetic.h:92-127``) — a bitcast-convert on TPU.
+* complex arrays are **interleaved** re/im float pairs (``:142-168``), the
+  FFTF layout the convolution engine uses.
+
+Oracle twins (NumPy) carry the reference's ``*_na`` names.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles.simd_tpu.utils.config import resolve_simd
+
+__all__ = [
+    "int16_to_float", "float_to_int16", "int32_to_float", "float_to_int32",
+    "int16_to_int32", "int32_to_int16", "float16_to_float", "int16_multiply",
+    "real_multiply", "real_multiply_array", "real_multiply_scalar",
+    "complex_multiply",
+    "complex_multiply_conjugate", "complex_conjugate", "sum_elements",
+    "add_to_all", "interleave_complex", "deinterleave_complex",
+]
+
+_I16_MIN, _I16_MAX = -32768, 32767
+_I32_MIN, _I32_MAX = -(2 ** 31), 2 ** 31 - 1
+
+
+# --------------------------------------------------------------------------
+# jitted XLA kernels (module-level so jax.jit caches by shape/dtype)
+# --------------------------------------------------------------------------
+
+@jax.jit
+def _int16_to_float(x):
+    return x.astype(jnp.float32)
+
+
+@jax.jit
+def _float_to_int16(x):
+    # trunc-toward-zero + saturate: mirrors cvttps+packs (arithmetic.h:262-270)
+    return jnp.clip(jnp.trunc(x), _I16_MIN, _I16_MAX).astype(jnp.int16)
+
+
+@jax.jit
+def _int32_to_float(x):
+    return x.astype(jnp.float32)
+
+
+@jax.jit
+def _float_to_int32(x):
+    return jnp.clip(jnp.trunc(x), _I32_MIN, _I32_MAX).astype(jnp.int32)
+
+
+@jax.jit
+def _int16_to_int32(x):
+    return x.astype(jnp.int32)
+
+
+@jax.jit
+def _int32_to_int16(x):
+    return jnp.clip(x, _I16_MIN, _I16_MAX).astype(jnp.int16)
+
+
+@jax.jit
+def _float16_to_float(bits):
+    return jax.lax.bitcast_convert_type(bits, jnp.float16).astype(jnp.float32)
+
+
+@jax.jit
+def _int16_multiply(a, b):
+    return a.astype(jnp.int32) * b.astype(jnp.int32)
+
+
+@jax.jit
+def _real_multiply(a, b):
+    return a * b
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _real_multiply_scalar(x, value):
+    return x * value
+
+
+@jax.jit
+def _complex_multiply(a, b):
+    ar, ai = a[..., 0::2], a[..., 1::2]
+    br, bi = b[..., 0::2], b[..., 1::2]
+    return _interleave(ar * br - ai * bi, ar * bi + br * ai)
+
+
+@jax.jit
+def _complex_multiply_conjugate(a, b):
+    ar, ai = a[..., 0::2], a[..., 1::2]
+    br, bi = b[..., 0::2], -b[..., 1::2]
+    return _interleave(ar * br - ai * bi, ar * bi + br * ai)
+
+
+@jax.jit
+def _complex_conjugate(a):
+    return _interleave(a[..., 0::2], -a[..., 1::2])
+
+
+@jax.jit
+def _sum_elements(x):
+    return jnp.sum(x, axis=-1)
+
+
+@jax.jit
+def _add_to_all(x, value):
+    return x + value
+
+
+def _interleave(re, im):
+    return jnp.stack([re, im], axis=-1).reshape(*re.shape[:-1], -1)
+
+
+# --------------------------------------------------------------------------
+# NumPy oracle twins (reference *_na semantics)
+# --------------------------------------------------------------------------
+
+def int16_to_float_na(x):
+    """``arithmetic.h:43-48``."""
+    return np.asarray(x, np.int16).astype(np.float32)
+
+
+def float_to_int16_na(x):
+    """``arithmetic.h:51-57`` — C truncation; saturate instead of UB."""
+    return np.clip(np.trunc(np.asarray(x, np.float32)),
+                   _I16_MIN, _I16_MAX).astype(np.int16)
+
+
+def int32_to_float_na(x):
+    """``arithmetic.h:59-64``."""
+    return np.asarray(x, np.int32).astype(np.float32)
+
+
+def float_to_int32_na(x):
+    """``arithmetic.h:66-71``."""
+    return np.clip(np.trunc(np.asarray(x, np.float64)),
+                   _I32_MIN, _I32_MAX).astype(np.int32)
+
+
+def int16_to_int32_na(x):
+    """``arithmetic.h:80-85``."""
+    return np.asarray(x, np.int16).astype(np.int32)
+
+
+def int32_to_int16_na(x):
+    """``arithmetic.h:73-78`` is a wrapping C cast; the vector path saturates
+    (``:334``).  The oracle follows the vector (saturating) contract so both
+    backends agree — as do the reference's tests, which only use in-range
+    values (``tests/arithmetic.cc:241-257``)."""
+    return np.clip(np.asarray(x, np.int32), _I16_MIN,
+                   _I16_MAX).astype(np.int16)
+
+
+def float16_to_float_na(bits):
+    """``arithmetic.h:92-127`` — IEEE binary16 → binary32 incl. subnormals,
+    inf, nan, signed zero.  NumPy's float16 implements exactly this."""
+    return np.asarray(bits, np.uint16).view(np.float16).astype(np.float32)
+
+
+def int16_multiply_na(a, b):
+    """Widening i16×i16→i32 (``arithmetic.h:211-221`` AVX2 path)."""
+    return np.asarray(a, np.int16).astype(np.int32) * \
+        np.asarray(b, np.int16).astype(np.int32)
+
+
+def real_multiply_array_na(a, b):
+    """``arithmetic.h:135-140``."""
+    return np.asarray(a, np.float32) * np.asarray(b, np.float32)
+
+
+def real_multiply_scalar_na(x, value):
+    """``arithmetic.h:170-176``."""
+    return np.asarray(x, np.float32) * np.float32(value)
+
+
+def complex_multiply_na(a, b):
+    """``arithmetic.h:142-152`` on whole interleaved arrays."""
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    ar, ai = a[..., 0::2], a[..., 1::2]
+    br, bi = b[..., 0::2], b[..., 1::2]
+    out = np.empty_like(a)
+    out[..., 0::2] = ar * br - ai * bi
+    out[..., 1::2] = ar * bi + br * ai
+    return out
+
+
+def complex_multiply_conjugate_na(a, b):
+    """``arithmetic.h:154-163``: a × conj(b)."""
+    b = np.asarray(b, np.float32).copy()
+    b[..., 1::2] = -b[..., 1::2]
+    return complex_multiply_na(a, b)
+
+
+def complex_conjugate_na(a):
+    """``arithmetic.h:165-168``."""
+    out = np.asarray(a, np.float32).copy()
+    out[..., 1::2] = -out[..., 1::2]
+    return out
+
+
+def sum_elements_na(x):
+    """``arithmetic.h:178-184``."""
+    return np.float32(np.sum(np.asarray(x, np.float32), axis=-1,
+                             dtype=np.float32))
+
+
+def add_to_all_na(x, value):
+    """``arithmetic.h:186-191``.  (The reference's NEON variant has a
+    store-offset bug at ``:1196``; semantics here follow the scalar/AVX
+    versions.)"""
+    return np.asarray(x, np.float32) + np.float32(value)
+
+
+# --------------------------------------------------------------------------
+# public dispatching API
+# --------------------------------------------------------------------------
+
+def _dispatch(simd, xla_fn, na_fn, *args):
+    if resolve_simd(simd):
+        return xla_fn(*[jnp.asarray(a) for a in args])
+    return na_fn(*[np.asarray(a) for a in args])
+
+
+def int16_to_float(data, simd=None):
+    return _dispatch(simd, _int16_to_float, int16_to_float_na, data)
+
+
+def float_to_int16(data, simd=None):
+    return _dispatch(simd, _float_to_int16, float_to_int16_na, data)
+
+
+def int32_to_float(data, simd=None):
+    return _dispatch(simd, _int32_to_float, int32_to_float_na, data)
+
+
+def float_to_int32(data, simd=None):
+    return _dispatch(simd, _float_to_int32, float_to_int32_na, data)
+
+
+def int16_to_int32(data, simd=None):
+    return _dispatch(simd, _int16_to_int32, int16_to_int32_na, data)
+
+
+def int32_to_int16(data, simd=None):
+    return _dispatch(simd, _int32_to_int16, int32_to_int16_na, data)
+
+
+def float16_to_float(bits, simd=None):
+    """Convert raw IEEE binary16 bit patterns (uint16) to float32."""
+    bits = np.asarray(bits)
+    if bits.dtype == np.float16:
+        bits = bits.view(np.uint16)
+    return _dispatch(simd, _float16_to_float, float16_to_float_na, bits)
+
+
+def int16_multiply(a, b, simd=None):
+    return _dispatch(simd, _int16_multiply, int16_multiply_na, a, b)
+
+
+def real_multiply(a, b, simd=None):
+    """Elementwise f32 multiply (``real_multiply_array``)."""
+    return _dispatch(simd, _real_multiply, real_multiply_array_na, a, b)
+
+
+# the reference publishes both spellings (inc/simd/arithmetic.h:170-176);
+# they are the same elementwise product here
+real_multiply_array = real_multiply
+
+
+def real_multiply_scalar(data, value, simd=None):
+    if resolve_simd(simd):
+        return _real_multiply_scalar(jnp.asarray(data), float(value))
+    return real_multiply_scalar_na(data, value)
+
+
+def _check_interleaved(*arrays):
+    for a in arrays:
+        if np.shape(a)[-1] % 2:
+            raise ValueError(
+                "interleaved complex array must have even last-dim length")
+
+
+def complex_multiply(a, b, simd=None):
+    _check_interleaved(a, b)
+    return _dispatch(simd, _complex_multiply, complex_multiply_na, a, b)
+
+
+def complex_multiply_conjugate(a, b, simd=None):
+    _check_interleaved(a, b)
+    return _dispatch(simd, _complex_multiply_conjugate,
+                     complex_multiply_conjugate_na, a, b)
+
+
+def complex_conjugate(data, simd=None):
+    _check_interleaved(data)
+    return _dispatch(simd, _complex_conjugate, complex_conjugate_na, data)
+
+
+def sum_elements(data, simd=None):
+    return _dispatch(simd, _sum_elements, sum_elements_na, data)
+
+
+def add_to_all(data, value, simd=None):
+    if resolve_simd(simd):
+        return _add_to_all(jnp.asarray(data), float(value))
+    return add_to_all_na(data, value)
+
+
+# --------------------------------------------------------------------------
+# interleaved-complex layout helpers
+# --------------------------------------------------------------------------
+
+def interleave_complex(z):
+    """complex64 array → interleaved re/im float32 (FFTF layout)."""
+    z = jnp.asarray(z) if not isinstance(z, np.ndarray) else z
+    xp = np if isinstance(z, np.ndarray) else jnp
+    out = xp.stack([z.real, z.imag], axis=-1)
+    return out.reshape(*z.shape[:-1], -1).astype(xp.float32)
+
+
+def deinterleave_complex(data):
+    """Interleaved re/im float32 → complex64."""
+    xp = np if isinstance(data, np.ndarray) else jnp
+    re = data[..., 0::2]
+    im = data[..., 1::2]
+    return (re + 1j * im).astype(xp.complex64)

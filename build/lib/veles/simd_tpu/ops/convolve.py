@@ -1,0 +1,510 @@
+"""1D linear convolution: direct / FFT / overlap-save with auto-selection.
+
+TPU-native rebuild of ``/root/reference/src/convolve.c`` +
+``/root/reference/inc/simd/convolve.h``.  The reference ships three
+algorithms behind a handle-based auto-select API
+(``src/convolve.c:328-366``):
+
+* brute-force direct form (``src/convolve.c:40-101``),
+* full-signal FFT — pad to pow2 ≥ x+h−1, forward FFT of X and H, complex
+  multiply, inverse, scale 1/M (``src/convolve.c:231-326``),
+* overlap-save — block filtering with L = 2^(⌊log2 h⌋+2), step L−(h−1),
+  one forward FFT / complex-mul / inverse FFT **per block, sequentially**
+  (``src/convolve.c:103-229``, deliberately not parallel ``:179-180``).
+
+The TPU formulation keeps the same three algorithms and the same handle API
+but maps each to what the hardware actually wants:
+
+* direct form → ``lax.conv_general_dilated``: the sliding window becomes an
+  im2col-style matmul tiled onto the MXU, not a per-output-sample dot loop.
+* FFT → ``jnp.fft.rfft``/``irfft`` (real FFTs, replacing FFTF entirely —
+  SURVEY.md §7 step 4).
+* overlap-save → **batched-frames FFT**: all blocks are gathered into a
+  ``[n_blocks, L]`` array and transformed in a single batched real FFT, so
+  the reference's sequential hot loop (``src/convolve.c:181-228``) becomes
+  one fused FFT·multiply·IFFT over a batch dimension.  The same frame
+  decomposition is what shards across chips in
+  :mod:`veles.simd_tpu.parallel` (halo = the M−1 overlap).
+
+Result length is always ``x_length + h_length - 1`` (full linear
+convolution).  All entry points accept leading batch dimensions; the
+reference's 1D API is the ``ndim == 1`` case.
+
+Algorithm-selection thresholds are re-derived for TPU (the reference's
+constants at ``src/convolve.c:328-364`` are ISA-specific — AVX picks FFT
+above x>350, NEON above x>50).  On TPU the single-signal direct form never
+tiles well onto the MXU, so the auto-select prefers overlap-save/FFT much
+earlier than the reference; the measured crossover sweep is recorded at
+the ``AUTO_*`` constants below.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles.simd_tpu.utils.config import get_config, resolve_simd
+from veles.simd_tpu.utils.memory import (
+    next_highest_power_of_2, zeropadding_length)
+
+__all__ = [
+    "ConvolutionAlgorithm", "ConvolutionHandle",
+    "convolve_simd", "convolve_na",
+    "convolve_fft", "convolve_fft_initialize", "convolve_fft_finalize",
+    "convolve_overlap_save", "convolve_overlap_save_initialize",
+    "convolve_overlap_save_finalize",
+    "convolve", "convolve_initialize", "convolve_finalize",
+    "overlap_save_block_length", "tpu_block_length", "select_algorithm",
+    "os_precision",
+]
+
+
+class ConvolutionAlgorithm(enum.Enum):
+    """Mirrors ``ConvolutionAlgorithm`` at
+    ``/root/reference/inc/simd/convolve_structs.h:39-46``."""
+
+    BRUTE_FORCE = "brute_force"
+    FFT = "fft"
+    OVERLAP_SAVE = "overlap_save"
+
+
+# TPU-tuned auto-select thresholds (reference's AVX/NEON constants at
+# src/convolve.c:328-364 do not transfer).  Re-derived from a chained
+# on-device crossover sweep on v5e (us/op, device_time_chained):
+#
+#        x      h |   direct     fft      os
+#      256    256 |    298.2    10.0       -
+#     1000     50 |     63.2     9.6     5.7
+#     2000    950 |   9549.5    10.7    30.6
+#     4096    512 |   3212.8    13.2     6.3
+#     8192   1024 |  12284.7    18.0    25.0
+#    16384   2047 |  49133.8   170.3    90.0
+#    65536    511 |  46437.3   793.1     9.4
+#
+# The single-signal direct form ([1,1,n] x [1,1,k] conv) never tiles well
+# onto the MXU and loses everywhere except the latency floor (~10 us), so
+# the policy is: overlap-save when the halo is amortized (x >= 8h — the
+# only loss in the sweep is 8192x1024 at 1.4x, while 4096x512 and
+# 16384x2047 at the same ratio win), FFT for balanced problems above the
+# latency floor, brute force only below it where every algorithm costs
+# the same ~10 us dispatch.
+AUTO_OVERLAP_SAVE_MIN_RATIO = 8     # x >= ratio*h -> overlap-save
+AUTO_FFT_MIN_PRODUCT = 1 << 13      # x*h beyond which spectral wins
+# within overlap-save: MXU block-matmul for filters up to this many taps,
+# batched-frames FFT beyond (measured crossover on v5e, see BASELINE.md)
+AUTO_OS_MATMUL_MAX_H = 1 << 14
+
+
+def overlap_save_step(h_length: int) -> int:
+    """Output-block size for the MXU overlap-save variant.
+
+    Each block costs a ``[B, step+k-1] x [step+k-1, step]`` matmul, so the
+    MAC overhead vs the direct form is ``(step+k-1)/k`` while MXU tiling
+    wants both free dims ≥ 512.  Measured on v5e (1M signal): step 2048
+    beats 512/1024 at k=2047 despite 2x MAC redundancy — MXU shape
+    efficiency dominates; smaller filters keep step ≥ 512.
+    """
+    return max(512, min(next_highest_power_of_2(int(h_length)), 4096))
+
+
+def overlap_save_block_length(h_length: int) -> int:
+    """Reference block size: L = 2^(⌊log2 h⌋ + 2) — the same bit-count loop
+    as the FFT padding helper (``src/convolve.c:115-121`` vs
+    ``src/memory.c:131-137``)."""
+    h_length = int(h_length)
+    if h_length < 1:
+        raise ValueError("h_length must be positive")
+    return zeropadding_length(h_length)
+
+
+def tpu_block_length(h_length: int, x_length: int) -> int:
+    """TPU-tuned overlap-save block size.
+
+    The reference's L = 2·nextpow2(h) means every block is ~50% halo —
+    fine when the per-block FFT dominates on a CPU, but on TPU the batched
+    FFT is cheap and the halo redundancy is pure waste.  Measured on v5e
+    (1M-point signal, h ∈ {127..32767}): multipliers 8-32× beat the
+    reference rule ~2× in throughput, flat within noise; 8× the reference
+    length is used, capped so a block never exceeds the whole problem."""
+    base = overlap_save_block_length(h_length)
+    cap = next_highest_power_of_2(x_length + h_length - 1)
+    return max(base, min(base * 8, cap))
+
+
+def _fft_length(x_length: int, h_length: int) -> int:
+    """Pad target for the full-FFT method: next pow2 ≥ x+h−1, keeping exact
+    powers of two (``src/convolve.c:237-244``)."""
+    return next_highest_power_of_2(x_length + h_length - 1)
+
+
+def select_algorithm(x_length: int, h_length: int) -> ConvolutionAlgorithm:
+    """TPU re-derivation of the reference heuristic
+    (``src/convolve.c:328-364``).
+
+    Shape matches the reference: long signal with comparatively short filter
+    → overlap-save; large balanced problem → FFT; otherwise direct (MXU).
+    """
+    x_length, h_length = int(x_length), int(h_length)
+    if x_length * h_length < AUTO_FFT_MIN_PRODUCT:
+        return ConvolutionAlgorithm.BRUTE_FORCE  # latency floor: all tie
+    # x >= 8h implies h < x//2, the overlap-save handle contract (integer
+    # division, src/convolve.c:105), so the selected algorithm's
+    # initializer always accepts the lengths
+    if x_length >= AUTO_OVERLAP_SAVE_MIN_RATIO * h_length:
+        return ConvolutionAlgorithm.OVERLAP_SAVE
+    return ConvolutionAlgorithm.FFT
+
+
+# --------------------------------------------------------------------------
+# jitted XLA kernels (cached by (shapes, static lengths))
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("reverse",))
+def _conv_direct(x, h, reverse=False):
+    """Direct-form full convolution on the MXU.
+
+    ``lax.conv_general_dilated`` computes cross-correlation, so convolution
+    flips ``h`` — and cross-correlation (``reverse=True``) uses ``h``
+    unflipped, the same flip-reuse trick as ``src/correlate.c:37-72``.
+    """
+    batch_shape = x.shape[:-1]
+    n = x.shape[-1]
+    k = h.shape[-1]
+    lhs = x.reshape((-1, 1, n)).astype(jnp.float32)          # [N, C=1, W]
+    kernel = h if reverse else jnp.flip(h, axis=-1)
+    rhs = kernel.reshape((1, 1, k)).astype(jnp.float32)      # [O=1, I=1, W]
+    out = jax.lax.conv_general_dilated(
+        lhs, rhs, window_strides=(1,), padding=[(k - 1, k - 1)],
+        precision=jax.lax.Precision.HIGHEST)
+    return out.reshape(batch_shape + (n + k - 1,))
+
+
+@functools.partial(jax.jit, static_argnames=("m", "reverse"))
+def _conv_fft(x, h, m, reverse=False):
+    """Full-FFT method (``src/convolve.c:289-326``) with real FFTs."""
+    n = x.shape[-1]
+    k = h.shape[-1]
+    kernel = jnp.flip(h, axis=-1) if reverse else h
+    spec = jnp.fft.rfft(x, m, axis=-1) * jnp.fft.rfft(kernel, m, axis=-1)
+    return jnp.fft.irfft(spec, m, axis=-1)[..., : n + k - 1].astype(
+        jnp.float32)
+
+
+def os_precision() -> str:
+    """The MXU precision the overlap-save block matmul will use
+    (``Config.conv_precision``)."""
+    return get_config().conv_precision
+
+
+@functools.partial(jax.jit, static_argnames=("step", "reverse",
+                                             "precision"))
+def _conv_os_matmul(x, h, step, reverse=False, precision=None):
+    """Overlap-save with the per-block filter as one MXU matmul.
+
+    The reference's overlap-save runs an FFT·multiply·IFFT per block
+    (``src/convolve.c:181-228``).  On TPU the direct form wins for all but
+    very long filters: a 2047-tap 1M-point convolution is ~4 GFLOP of MAC
+    work, which the MXU finishes in well under a millisecond while XLA's
+    TPU FFT needs ~9 ms.  Formulation: outputs are computed in blocks of
+    ``step`` samples; block i needs input samples ``[i*step - (k-1),
+    i*step + step)``, so the signal is framed into overlapping rows
+    ``frames[i, a] = x_ext[i*step + a]`` (``x_ext`` = signal with ``k-1``
+    leading zeros) and each block is ``frames @ M`` with
+    ``M[a, t] = h[t + k - 1 - a]`` — a ``[B, step+k-1] x [step+k-1, step]``
+    matmul whose both free dims are large enough to tile onto the MXU.
+
+    Both operands are materialized *gather-free* (TPU gathers are ~100x
+    slower than the matmul itself — measured 37 ms for the frame gather
+    vs 0.17 ms for the matmul):
+
+    * frames = J shifted row-blocks of the zero-padded signal reshaped to
+      ``[B+J, step]``, concatenated along columns;
+    * the Toeplitz ``M`` (as its transpose MT) via a tile trick: rows of
+      MT are ``flip(h)`` shifted right by t, and tiling
+      ``w = [flip(h), zeros(step+1)]`` ``step`` times then reshaping to
+      ``[step, k+step]`` yields exactly those shifts, because
+      ``t*(k+step) ≡ -t (mod k+step+1)``.
+
+    ``precision`` trades MXU passes for accuracy (``None`` → "highest";
+    the handle/public paths pass ``Config.conv_precision`` explicitly via
+    :func:`os_precision`) — measured on v5e against a float64 oracle
+    (1M x 2047, randn):
+
+    * HIGHEST (6-pass bf16 = full f32): ~4.8e-7 rel., 3.08 GSamples/s
+      at step 2048, 4.33 at step 1024;
+    * HIGH (3-pass): ~1.3e-5 rel. — inside every correctness gate
+      (1e-4 TPU smoke, reference test epsilons) — 7.57 GSamples/s at
+      step 1024;
+    * DEFAULT (1-pass bf16): ~2.6e-3, NOT acceptable for the oracle
+      tests; available only by passing it explicitly.
+    """
+    n = x.shape[-1]
+    k = h.shape[-1]
+    s = step
+    out_len = n + k - 1
+    n_blocks = -(-out_len // s)
+    J = -(-(s + k - 1) // s)
+
+    kernel = jnp.flip(h, axis=-1) if reverse else h
+    # frames[..., i, a] = x_ext[..., i*s + a], a in [0, s+k-1)
+    pad_tail = (n_blocks + J) * s - (n + k - 1)
+    x_ext = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(k - 1, pad_tail)])
+    Z = x_ext.reshape(x.shape[:-1] + (n_blocks + J, s))
+    frames = jnp.concatenate(
+        [Z[..., j:j + n_blocks, :] for j in range(J)],
+        axis=-1)[..., : s + k - 1]
+    # MT[t, a] = kernel_rev[a - t]; kernel_rev[m] = kernel[k-1-m] so that
+    # y[i*s+t] = sum_a frames[i, a] * kernel[t + k - 1 - a]
+    w = jnp.pad(jnp.flip(kernel, axis=-1), (0, s + 1))       # len k+s+1
+    MT = jnp.tile(w, s)[: s * (k + s)].reshape(s, k + s)[:, : s + k - 1]
+    # public callers resolve Config.conv_precision via os_precision()
+    # before the jit cache key forms (reading config here would bake a
+    # stale value); a direct call omitting precision gets plain "highest"
+    y = jnp.einsum("...ba,ta->...bt", frames, MT,
+                   precision=precision or "highest")
+    y = y.reshape(y.shape[:-2] + (n_blocks * s,))
+    return y[..., :out_len].astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("block_len", "reverse"))
+def _conv_overlap_save(x, h, block_len, reverse=False):
+    """Overlap-save as a single batched-frames FFT (the long-filter path).
+
+    The reference runs one FFT per L-sample block in a sequential loop
+    (``src/convolve.c:181-228``); here every block is a row of a
+    ``[n_blocks, L]`` array and one batched rfft/irfft covers them all —
+    the frame gather is the only data movement XLA can't fuse away.
+    """
+    n = x.shape[-1]
+    k = h.shape[-1]
+    L = block_len
+    step = L - (k - 1)
+    out_len = n + k - 1
+    n_blocks = -(-out_len // step)  # ceil
+
+    kernel = jnp.flip(h, axis=-1) if reverse else h
+    H = jnp.fft.rfft(kernel, L, axis=-1)
+
+    # X_ext = [zeros(k-1), x, zeros(tail)]; frame i = X_ext[i*step : i*step+L]
+    pad_tail = (n_blocks - 1) * step + L - (k - 1) - n
+    x_ext = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(k - 1, pad_tail)])
+    idx = jnp.arange(n_blocks)[:, None] * step + jnp.arange(L)[None, :]
+    frames = jnp.take(x_ext, idx, axis=-1)                   # [..., B, L]
+
+    spec = jnp.fft.rfft(frames, L, axis=-1) * H[..., None, :]
+    blocks = jnp.fft.irfft(spec, L, axis=-1)[..., k - 1:]    # [..., B, step]
+    flat = blocks.reshape(blocks.shape[:-2] + (n_blocks * step,))
+    return flat[..., :out_len].astype(jnp.float32)
+
+
+# --------------------------------------------------------------------------
+# NumPy oracles (reference scalar semantics)
+# --------------------------------------------------------------------------
+
+def convolve_na(x, h):
+    """Direct-form oracle (``src/convolve.c:49-100`` scalar branch)."""
+    x = np.asarray(x, np.float32)
+    h = np.asarray(h, np.float32)
+    if x.ndim == 1:
+        return np.convolve(x, h, mode="full").astype(np.float32)
+    flat = x.reshape(-1, x.shape[-1])
+    out = np.stack([np.convolve(row, h, mode="full") for row in flat])
+    return out.reshape(x.shape[:-1] + (x.shape[-1] + h.shape[-1] - 1,)
+                       ).astype(np.float32)
+
+
+def _conv_fft_na(x, h, m, reverse=False):
+    x = np.asarray(x, np.float32)
+    h = np.asarray(h, np.float32)
+    if reverse:
+        h = h[..., ::-1]
+    n, k = x.shape[-1], h.shape[-1]
+    spec = np.fft.rfft(x, m, axis=-1) * np.fft.rfft(h, m, axis=-1)
+    return np.fft.irfft(spec, m, axis=-1)[..., : n + k - 1].astype(np.float32)
+
+
+def _conv_overlap_save_na(x, h, block_len, reverse=False):
+    x = np.asarray(x, np.float32)
+    h = np.asarray(h, np.float32)
+    if reverse:
+        h = h[..., ::-1]
+    n, k = x.shape[-1], h.shape[-1]
+    L = block_len
+    step = L - (k - 1)
+    out_len = n + k - 1
+    n_blocks = -(-out_len // step)
+    H = np.fft.rfft(h, L, axis=-1)
+    pad_tail = (n_blocks - 1) * step + L - (k - 1) - n
+    x_ext = np.pad(x, [(0, 0)] * (x.ndim - 1) + [(k - 1, pad_tail)])
+    idx = np.arange(n_blocks)[:, None] * step + np.arange(L)[None, :]
+    frames = np.take(x_ext, idx, axis=-1)
+    blocks = np.fft.irfft(np.fft.rfft(frames, L, axis=-1) * H[..., None, :],
+                          L, axis=-1)[..., k - 1:]
+    flat = blocks.reshape(blocks.shape[:-2] + (n_blocks * step,))
+    return flat[..., :out_len].astype(np.float32)
+
+
+# --------------------------------------------------------------------------
+# handle API (parity with inc/simd/convolve.h:41-126)
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ConvolutionHandle:
+    """Compiled-plan handle (``inc/simd/convolve_structs.h:39-74``).
+
+    The reference caches FFTF plans + scratch buffers; here the "plan" is
+    the jitted XLA executable cached by (shape, static lengths), so the
+    handle only pins the problem geometry and chosen algorithm.
+    """
+
+    x_length: int
+    h_length: int
+    algorithm: ConvolutionAlgorithm
+    reverse: bool = False
+    # derived static sizes (FFT pad / overlap-save block length)
+    fft_length: int | None = None
+    block_length: int | None = None
+    # overlap-save variant: MXU block-matmul (short/medium filters) vs
+    # batched-frames FFT (very long filters); step = output block size of
+    # the matmul variant
+    os_matmul: bool = False
+    step: int | None = None
+
+    @property
+    def result_length(self) -> int:
+        return self.x_length + self.h_length - 1
+
+
+def _make_handle(x_length, h_length, algorithm, reverse):
+    x_length, h_length = int(x_length), int(h_length)
+    if x_length < 1 or h_length < 1:
+        raise ValueError("convolve: lengths must be positive "
+                         "(src/convolve.c:44-48 assert contract)")
+    if algorithm is None:
+        algorithm = select_algorithm(x_length, h_length)
+    algorithm = ConvolutionAlgorithm(algorithm)
+    fft_len = block_len = step = None
+    os_matmul = False
+    if algorithm is ConvolutionAlgorithm.FFT:
+        fft_len = _fft_length(x_length, h_length)
+    elif algorithm is ConvolutionAlgorithm.OVERLAP_SAVE:
+        if not h_length < x_length // 2:
+            raise ValueError(
+                "overlap-save requires h_length < x_length / 2 "
+                "(src/convolve.c:105 assert contract, integer division)")
+        block_len = tpu_block_length(h_length, x_length)
+        os_matmul = h_length <= AUTO_OS_MATMUL_MAX_H
+        step = overlap_save_step(h_length)
+    return ConvolutionHandle(x_length, h_length, algorithm, reverse,
+                             fft_len, block_len, os_matmul, step)
+
+
+def _check_lengths(handle, x, h):
+    if not get_config().check_arguments:
+        return
+    if x.shape[-1] != handle.x_length or h.shape[-1] != handle.h_length:
+        raise ValueError(
+            f"handle is for x_length={handle.x_length}, "
+            f"h_length={handle.h_length}; got {x.shape[-1]}, {h.shape[-1]}")
+
+
+def _run(handle: ConvolutionHandle, x, h, simd=None):
+    if resolve_simd(simd):
+        x, h = jnp.asarray(x), jnp.asarray(h)
+        _check_lengths(handle, x, h)
+        if handle.algorithm is ConvolutionAlgorithm.BRUTE_FORCE:
+            return _conv_direct(x, h, reverse=handle.reverse)
+        if handle.algorithm is ConvolutionAlgorithm.FFT:
+            return _conv_fft(x, h, handle.fft_length, reverse=handle.reverse)
+        if handle.os_matmul:
+            return _conv_os_matmul(x, h, handle.step, reverse=handle.reverse,
+                                   precision=os_precision())
+        return _conv_overlap_save(x, h, handle.block_length,
+                                  reverse=handle.reverse)
+    x, h = np.asarray(x), np.asarray(h)
+    _check_lengths(handle, x, h)
+    if handle.reverse:
+        h = h[..., ::-1]
+    if handle.algorithm is ConvolutionAlgorithm.BRUTE_FORCE:
+        return convolve_na(x, h)
+    if handle.algorithm is ConvolutionAlgorithm.FFT:
+        return _conv_fft_na(x, h, handle.fft_length)
+    return _conv_overlap_save_na(x, h, handle.block_length)
+
+
+# ---- brute force ----------------------------------------------------------
+
+def convolve_simd(x, h, simd=None):
+    """Direct-form full convolution (``convolve_simd``,
+    ``inc/simd/convolve.h:41-56``)."""
+    if resolve_simd(simd):
+        return _conv_direct(jnp.asarray(x), jnp.asarray(h))
+    return convolve_na(x, h)
+
+
+# ---- FFT method -----------------------------------------------------------
+
+def convolve_fft_initialize(x_length, h_length, *, reverse=False):
+    """``inc/simd/convolve.h:58-76`` — plan handle for the full-FFT method."""
+    return _make_handle(x_length, h_length, ConvolutionAlgorithm.FFT, reverse)
+
+
+def convolve_fft(handle, x, h, simd=None):
+    return _run(handle, x, h, simd)
+
+
+def convolve_fft_finalize(handle):
+    """No-op: XLA executables are cached/collected by the runtime
+    (``convolve_fft_finalize``, ``src/convolve.c:280-287``)."""
+
+
+# ---- overlap-save ---------------------------------------------------------
+
+def convolve_overlap_save_initialize(x_length, h_length, *, reverse=False):
+    """``inc/simd/convolve.h:78-96``."""
+    return _make_handle(x_length, h_length,
+                        ConvolutionAlgorithm.OVERLAP_SAVE, reverse)
+
+
+def convolve_overlap_save(handle, x, h, simd=None):
+    return _run(handle, x, h, simd)
+
+
+def convolve_overlap_save_finalize(handle):
+    """No-op (``src/convolve.c:148-154``)."""
+
+
+# ---- auto-select ----------------------------------------------------------
+
+def convolve_initialize(x_length, h_length, algorithm=None, *,
+                        reverse=False):
+    """``inc/simd/convolve.h:98-115`` — picks the algorithm via
+    :func:`select_algorithm` unless forced.  ``reverse=True`` makes the
+    handle cross-correlate (``src/correlate.c:128-143``)."""
+    return _make_handle(x_length, h_length, algorithm, reverse=reverse)
+
+
+def convolve(handle_or_x, x_or_h, h=None, simd=None):
+    """Full linear convolution.
+
+    Two call forms, mirroring the reference's two entry styles:
+
+    * ``convolve(handle, x, h)`` — handle API (``inc/simd/convolve.h:117-126``)
+    * ``convolve(x, h)`` — convenience: auto-select per call
+    """
+    if isinstance(handle_or_x, ConvolutionHandle):
+        return _run(handle_or_x, x_or_h, h, simd)
+    x, h_ = handle_or_x, x_or_h
+    if h is not None:       # convolve(x, h, simd) positional form
+        simd = h
+    handle = convolve_initialize(np.shape(x)[-1], np.shape(h_)[-1])
+    return _run(handle, x, h_, simd)
+
+
+def convolve_finalize(handle):
+    """No-op (``src/convolve.c:368-379``)."""

@@ -1,0 +1,104 @@
+"""1D cross-correlation — convolution with a reversed kernel.
+
+TPU-native rebuild of ``/root/reference/src/correlate.c`` +
+``/root/reference/inc/simd/correlate.h``.  The reference implements
+cross-correlation by reusing every convolution engine with a ``reverse``
+flag that flips ``h`` before the FFT (``src/correlate.c:37-72``, consumed
+at ``src/convolve.c:167-171,302-306``), plus a direct SIMD form
+(``src/correlate.c:74-126``).  Semantics: with ``j`` indexing the
+``x_length + h_length - 1`` output,
+
+    result[j] = Σ_m x[m] · h[m + h_length - 1 - j]
+
+which is exactly ``convolve(x, reverse(h))`` — the identity this module is
+built on.  The same three algorithms and handle API as
+:mod:`veles.simd_tpu.ops.convolve` apply; ``reverse=True`` folds the flip
+into the already-jitted kernels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from veles.simd_tpu.ops import convolve as _conv
+# re-exported: the reference's correlate.h pulls in convolve_structs.h, so
+# both types are reachable through either header
+from veles.simd_tpu.ops.convolve import (
+    ConvolutionAlgorithm, ConvolutionHandle)
+from veles.simd_tpu.utils.config import resolve_simd
+
+__all__ = [
+    "ConvolutionAlgorithm", "ConvolutionHandle",
+    "cross_correlate_simd", "cross_correlate_na",
+    "cross_correlate_fft", "cross_correlate_fft_initialize",
+    "cross_correlate_fft_finalize",
+    "cross_correlate_overlap_save", "cross_correlate_overlap_save_initialize",
+    "cross_correlate_overlap_save_finalize",
+    "cross_correlate", "cross_correlate_initialize",
+    "cross_correlate_finalize",
+]
+
+
+def cross_correlate_na(x, h):
+    """Direct-form oracle (``src/correlate.c:118-124`` scalar branch)."""
+    h = np.asarray(h, np.float32)
+    return _conv.convolve_na(x, h[..., ::-1])
+
+
+def cross_correlate_simd(x, h, simd=None):
+    """Direct form (``inc/simd/correlate.h:41-56``)."""
+    if resolve_simd(simd):
+        import jax.numpy as jnp
+
+        return _conv._conv_direct(jnp.asarray(x), jnp.asarray(h),
+                                  reverse=True)
+    return cross_correlate_na(x, h)
+
+
+def cross_correlate_fft_initialize(x_length, h_length):
+    """``src/correlate.c:37-43`` — FFT plan with ``reverse=1``."""
+    return _conv.convolve_fft_initialize(x_length, h_length, reverse=True)
+
+
+def cross_correlate_fft(handle, x, h, simd=None):
+    return _conv.convolve_fft(handle, x, h, simd)
+
+
+def cross_correlate_fft_finalize(handle):
+    """No-op (``src/correlate.c:50-52``)."""
+
+
+def cross_correlate_overlap_save_initialize(x_length, h_length):
+    """``src/correlate.c:54-60``."""
+    return _conv.convolve_overlap_save_initialize(x_length, h_length,
+                                                  reverse=True)
+
+
+def cross_correlate_overlap_save(handle, x, h, simd=None):
+    return _conv.convolve_overlap_save(handle, x, h, simd)
+
+
+def cross_correlate_overlap_save_finalize(handle):
+    """No-op (``src/correlate.c:69-72``)."""
+
+
+def cross_correlate_initialize(x_length, h_length, algorithm=None):
+    """``src/correlate.c:128-143`` — auto-select with reverse set."""
+    return _conv.convolve_initialize(x_length, h_length, algorithm,
+                                     reverse=True)
+
+
+def cross_correlate(handle_or_x, x_or_h, h=None, simd=None):
+    """``src/correlate.c:145-159``; also accepts the convenience
+    ``cross_correlate(x, h)`` form like :func:`convolve`."""
+    if isinstance(handle_or_x, ConvolutionHandle):
+        return _conv._run(handle_or_x, x_or_h, h, simd)
+    x, h_ = handle_or_x, x_or_h
+    if h is not None:
+        simd = h
+    handle = cross_correlate_initialize(np.shape(x)[-1], np.shape(h_)[-1])
+    return _conv._run(handle, x, h_, simd)
+
+
+def cross_correlate_finalize(handle):
+    """No-op (``src/correlate.c:159-161``)."""

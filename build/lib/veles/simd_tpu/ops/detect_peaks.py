@@ -1,0 +1,158 @@
+"""1D local-extrema extraction.
+
+TPU-native rebuild of ``/root/reference/src/detect_peaks.c`` +
+``inc/simd/detect_peaks.h``.  Semantics preserved exactly from
+``check_peak`` (``src/detect_peaks.c:41-56``): an interior sample ``c`` at
+index ``i ∈ [1, size-2]`` is an extremum iff ``(c - prev)·(c - next) > 0``
+(strict — plateaus are never peaks), reported as a maximum when
+``c > prev`` and a minimum when ``c < prev``, filtered by the
+``ExtremumType`` bitmask (MAXIMUM=1, MINIMUM=2, BOTH=3,
+``inc/simd/detect_peaks.h:41-45``).
+
+The reference returns a realloc-grown array of ``ExtremumPoint``
+(``src/detect_peaks.c:19-39``).  XLA cannot return data-dependent shapes
+(SURVEY.md §7 step 6), so there are two entry points:
+
+* :func:`detect_peaks` — the user-facing API: jitted fixed-shape mask +
+  values on device, host-side compaction; returns ``(positions, values)``
+  variable-length arrays exactly like the C API.
+* :func:`detect_peaks_fixed` — the jit-composable TPU-native form:
+  returns ``(positions, values, count)`` with a static ``max_peaks``
+  bound, positions beyond ``count`` filled with -1.  This is the version
+  used inside larger jitted pipelines.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles.simd_tpu.utils.config import resolve_simd
+
+__all__ = ["ExtremumType", "detect_peaks", "detect_peaks_na",
+           "detect_peaks_fixed"]
+
+
+class ExtremumType(enum.IntFlag):
+    """``ExtremumType`` (``inc/simd/detect_peaks.h:41-45``)."""
+
+    MAXIMUM = 1
+    MINIMUM = 2
+    BOTH = 3
+
+
+@functools.partial(jax.jit, static_argnames=("type",))
+def _peak_mask(data, type):
+    """Boolean mask over the full signal (interior-only can be True)."""
+    prev = data[..., :-2]
+    curr = data[..., 1:-1]
+    nxt = data[..., 2:]
+    d1 = curr - prev
+    d2 = curr - nxt
+    is_ext = d1 * d2 > 0
+    want = jnp.zeros_like(is_ext)
+    if type & ExtremumType.MAXIMUM:
+        want = want | (d1 > 0)
+    if type & ExtremumType.MINIMUM:
+        want = want | (d1 < 0)
+    inner = is_ext & want
+    pad = [(0, 0)] * (data.ndim - 1) + [(1, 1)]
+    return jnp.pad(inner, pad)
+
+
+def _compact_row(mask, data, max_peaks):
+    """Cumsum+scatter compaction of one signal: O(n), stays on device.
+
+    Each peak's output slot is its rank among peaks (cumsum of the mask);
+    the scatter has no write conflicts because ranks are unique, and
+    everything else lands in a trash slot that is sliced off.
+    """
+    n = mask.shape[-1]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    rank = jnp.cumsum(mask) - 1
+    dest = jnp.where(mask & (rank < max_peaks), rank, max_peaks)
+    positions = jnp.full((max_peaks + 1,), -1, jnp.int32).at[dest].set(idx)
+    values = jnp.zeros((max_peaks + 1,), data.dtype).at[dest].set(data)
+    # the trash slot may hold a non-peak; everything below stays exact
+    return positions[:max_peaks], values[:max_peaks]
+
+
+@functools.partial(jax.jit, static_argnames=("type", "max_peaks"))
+def _peaks_fixed(data, type, max_peaks):
+    mask = _peak_mask(data, type)
+    n = data.shape[-1]
+    count = jnp.sum(mask, axis=-1)
+    flat_mask = mask.reshape(-1, n)
+    flat_data = data.reshape(-1, n)
+    positions, values = jax.vmap(
+        lambda m, d: _compact_row(m, d, max_peaks))(flat_mask, flat_data)
+    out_shape = data.shape[:-1] + (max_peaks,)
+    return (positions.reshape(out_shape), values.reshape(out_shape), count)
+
+
+def detect_peaks_fixed(data, type=ExtremumType.BOTH, max_peaks=None):
+    """Jit-composable fixed-capacity peak extraction.
+
+    Returns ``(positions[int32, ..., max_peaks], values[..., max_peaks],
+    count[...])``; unused slots hold position -1 / value 0.  ``max_peaks``
+    defaults to the static worst case ``n - 2`` (an alternating signal
+    makes every interior point an extremum).  A caller-supplied
+    ``max_peaks`` is honored exactly — slots beyond ``n - 2`` are simply
+    always empty — so a jitted pipeline gets the same output shape across
+    signals of different lengths.
+    """
+    data = jnp.asarray(data)
+    n = data.shape[-1]
+    if n < 3:
+        raise ValueError("size must be > 2 (src/detect_peaks.c:64 contract)")
+    if max_peaks is None:
+        # worst case: every interior point (alternating signal)
+        max_peaks = n - 2
+    return _peaks_fixed(data, ExtremumType(int(type)), int(max_peaks))
+
+
+def detect_peaks_na(data, type=ExtremumType.BOTH):
+    """NumPy oracle (``src/detect_peaks.c:128-139`` scalar loop).
+
+    Returns ``(positions, values)`` 1D arrays (1D input only, like the C
+    API)."""
+    data = np.asarray(data, np.float32)
+    if data.ndim != 1:
+        raise ValueError("oracle path is 1D like the C API")
+    if data.shape[-1] < 3:
+        raise ValueError("size must be > 2 (src/detect_peaks.c:64 contract)")
+    positions, values = [], []
+    t = ExtremumType(int(type))
+    for i in range(1, len(data) - 1):
+        d1 = data[i] - data[i - 1]
+        d2 = data[i] - data[i + 1]
+        if d1 * d2 > 0:
+            if (d1 > 0 and t & ExtremumType.MAXIMUM) or \
+                    (d1 < 0 and t & ExtremumType.MINIMUM):
+                positions.append(i)
+                values.append(data[i])
+    return (np.asarray(positions, np.int32), np.asarray(values, np.float32))
+
+
+def detect_peaks(data, type=ExtremumType.BOTH, simd=None):
+    """User-facing API (``detect_peaks``, ``inc/simd/detect_peaks.h:47-60``):
+    returns variable-length ``(positions, values)``."""
+    if not resolve_simd(simd):
+        return detect_peaks_na(data, type)
+    data = jnp.asarray(data)
+    if data.ndim != 1:
+        raise ValueError("detect_peaks is 1D; use detect_peaks_fixed for "
+                         "batched fixed-shape extraction")
+    if data.shape[-1] < 3:
+        raise ValueError("size must be > 2 (src/detect_peaks.c:64 contract)")
+    # compaction happens on device (cumsum+scatter in _peaks_fixed); the
+    # host only slices the already-compacted prefix
+    positions, values, count = _peaks_fixed(
+        data, ExtremumType(int(type)), data.shape[-1] - 2)
+    k = int(count)
+    return (np.asarray(positions[:k], np.int32),
+            np.asarray(values[:k], np.float32))

@@ -1,0 +1,133 @@
+"""Vectorized transcendental functions: sin / cos / log / exp (+ pow, sqrt).
+
+TPU-native rebuild of ``/root/reference/inc/simd/mathfun.h`` (dispatchers at
+``:142-204``) and the vendored cephes-style polynomial kernels it wraps
+(``avx_mathfun.h:161-729``, ``neon_mathfun.h:57-336``).  Those hand-rolled
+range-reduction + polynomial evaluations are exactly what XLA's elementwise
+lowering emits for the TPU VPU, so the entire L2 vendored layer is subsumed by
+``jnp.sin/cos/log/exp`` (SURVEY.md §2 "⊘" components) — and fuses into
+adjacent ops for free.
+
+Naming keeps the reference's ``*_psv`` suffix ("packed single vector").
+Oracle twins use NumPy's libm-backed ufuncs, matching the reference tests'
+use of libm as the oracle (``tests/mathfun.cc:59-84``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles.simd_tpu.utils.config import resolve_simd
+
+__all__ = ["sin_psv", "cos_psv", "log_psv", "exp_psv", "pow_psv", "sqrt_psv"]
+
+
+def _log_f32(x):
+    """Range-reduced f32 natural log, ~2 ulp on TPU.
+
+    XLA's TPU ``log`` lowering loses ~350 ulp near 1 (measured 4.6e-5
+    max-relative on U[0.1, 5]); this reimplements the cephes scheme the
+    reference vendors (``avx_mathfun.h:161-245``): split x = m·2^e with
+    m ∈ [√½, √2), evaluate log(m) = 2·atanh((m−1)/(m+1)) as an odd
+    polynomial in s², and recombine with a two-part (Cody-Waite) ln2 so
+    e·ln2_hi is exact in f32.
+
+    Subnormal inputs return -inf: XLA flushes subnormals to zero on both
+    the TPU and CPU backends (verified: ``x * 2**23`` is 0 and ``x == 0``
+    is true for x = 1e-40 on both), matching ``jnp.log``'s own platform
+    semantics, so no upscaling branch is attempted.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    bits = jax.lax.bitcast_convert_type(x, jnp.int32)
+    e = ((bits >> 23) & 0xFF) - 126  # m in [0.5, 1)
+    m = jax.lax.bitcast_convert_type(
+        (bits & jnp.int32(0x007FFFFF)) | jnp.int32(0x3F000000), jnp.float32)
+    low = m < jnp.float32(0.7071067811865476)
+    m = jnp.where(low, m * 2, m)
+    e = (e - low.astype(jnp.int32)).astype(jnp.float32)
+    s = (m - 1) / (m + 1)
+    z = s * s
+    poly = jnp.float32(1.0 / 9.0)
+    for c in (1.0 / 7.0, 1.0 / 5.0, 1.0 / 3.0, 1.0):
+        poly = poly * z + jnp.float32(c)
+    logm = 2 * s * poly
+    ln2_hi = jnp.float32(0.693359375)  # 0x3F318000: 10 significand bits
+    ln2_lo = jnp.float32(-2.12194440e-4)
+    r = e * ln2_hi + (logm + e * ln2_lo)
+    r = jnp.where(x == 0, -jnp.inf, r)
+    r = jnp.where(jnp.isinf(x) & (x > 0), jnp.inf, r)
+    r = jnp.where((x < 0) | jnp.isnan(x), jnp.nan, r)
+    return r
+
+
+_XLA = {
+    "sin": jax.jit(jnp.sin),
+    "cos": jax.jit(jnp.cos),
+    "log": jax.jit(_log_f32),
+    "exp": jax.jit(jnp.exp),
+    "sqrt": jax.jit(jnp.sqrt),
+}
+_POW = jax.jit(jnp.power)
+
+_NA = {"sin": np.sin, "cos": np.cos, "log": np.log, "exp": np.exp,
+       "sqrt": np.sqrt}
+
+
+def _psv(name, data, simd):
+    if resolve_simd(simd):
+        return _XLA[name](jnp.asarray(data, dtype=jnp.float32))
+    return _NA[name](np.asarray(data, dtype=np.float32))
+
+
+def sin_psv(data, simd=None):
+    """``mathfun.h:142-156``."""
+    return _psv("sin", data, simd)
+
+
+def cos_psv(data, simd=None):
+    """``mathfun.h:158-172``."""
+    return _psv("cos", data, simd)
+
+
+def log_psv(data, simd=None):
+    """``mathfun.h:174-188``."""
+    return _psv("log", data, simd)
+
+
+def exp_psv(data, simd=None):
+    """``mathfun.h:190-204``."""
+    return _psv("exp", data, simd)
+
+
+def pow_psv(base, exponent, simd=None):
+    """``avx_mathfun.h:720`` / ``neon_mathfun.h:307`` pow_ps."""
+    if resolve_simd(simd):
+        return _POW(jnp.asarray(base, dtype=jnp.float32),
+                    jnp.asarray(exponent, dtype=jnp.float32))
+    return np.power(np.asarray(base, np.float32),
+                    np.asarray(exponent, np.float32))
+
+
+def sqrt_psv(data, simd=None):
+    """``neon_mathfun.h:314`` sqrt_ps."""
+    return _psv("sqrt", data, simd)
+
+
+# reference-compatible oracle names (mathfun.h PsvStdFunc scalar path,
+# mathfun.h:42-65) — f32 in/out like the dispatched oracle branch
+def sin_psv_na(data):
+    return np.sin(np.asarray(data, np.float32))
+
+
+def cos_psv_na(data):
+    return np.cos(np.asarray(data, np.float32))
+
+
+def log_psv_na(data):
+    return np.log(np.asarray(data, np.float32))
+
+
+def exp_psv_na(data):
+    return np.exp(np.asarray(data, np.float32))

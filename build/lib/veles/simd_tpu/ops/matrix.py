@@ -1,0 +1,159 @@
+"""BLAS L1/L2/L3 subset on the MXU.
+
+TPU-native rebuild of ``/root/reference/inc/simd/matrix.h`` +
+``/root/reference/src/matrix.c``.  The reference's AVX GEMM copies each B
+column into an aligned stack buffer and runs an 8-wide dot per output element
+(``src/matrix.c:200-226``); on TPU that whole cache-blocking design collapses
+into a single ``dot_general`` tiled onto the 128×128 systolic array — the
+idiomatic formulation, not a translation (SURVEY.md §3.3).
+
+API parity (matrices are row-major 2D arrays, shapes carry the w/h metadata
+the C API passed explicitly):
+
+* ``matrix_add(m1, m2)`` / ``matrix_sub(m1, m2)``      (``matrix.h:40-59``)
+* ``matrix_multiply(m1, m2)``: ``[h1,w1] @ [h2=w1,w2] → [h1,w2]``
+  (``matrix.h:60-72``, oracle ``src/matrix.c:53-65``)
+* ``matrix_multiply_transposed(m1, m2t)``: B supplied transposed,
+  ``[h1,w1] @ [h2,w1]^T → [h1,h2]`` (``matrix.h:74-89``, oracle
+  ``src/matrix.c:67-80``) — on the MXU this is the same ``dot_general`` with
+  swapped contracting dims, not a 10%-faster special case.
+* ``matrix_vector_multiply(m, v)`` — BLAS-L2 gemv (BASELINE.md config 3).
+
+Precision: f32 inputs contract with ``precision='highest'`` by default so the
+oracle cross-validation tolerance (``tests/matrix.cc:94-98`` ASSERT_NEAR 0.1)
+holds; pass ``fast=True`` to run bf16-in/f32-accumulate at full MXU rate.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles.simd_tpu.utils.config import get_config, resolve_simd
+
+__all__ = [
+    "matrix_add", "matrix_sub", "matrix_multiply",
+    "matrix_multiply_transposed", "matrix_vector_multiply",
+]
+
+
+@jax.jit
+def _add(a, b):
+    return a + b
+
+
+@jax.jit
+def _sub(a, b):
+    return a - b
+
+
+@functools.partial(jax.jit, static_argnames=("fast",))
+def _matmul(a, b, fast=False):
+    if fast:
+        return jnp.matmul(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32)
+    return jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
+
+
+@functools.partial(jax.jit, static_argnames=("fast",))
+def _matmul_t(a, bt, fast=False):
+    # batched "[..., h1, w] @ [..., h2, w]^T" — contract the last dims
+    if fast:
+        return jnp.einsum("...ij,...kj->...ik",
+                          a.astype(jnp.bfloat16), bt.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32)
+    return jnp.einsum("...ij,...kj->...ik", a, bt,
+                      precision=jax.lax.Precision.HIGHEST)
+
+
+@jax.jit
+def _matvec(m, v):
+    return jnp.dot(m, v, precision=jax.lax.Precision.HIGHEST)
+
+
+# ---- NumPy oracle twins (reference *_novec, src/matrix.c:37-80) ----------
+
+def matrix_add_novec(m1, m2):
+    """``src/matrix.c:37-43``."""
+    return np.asarray(m1, np.float32) + np.asarray(m2, np.float32)
+
+
+def matrix_sub_novec(m1, m2):
+    """``src/matrix.c:45-51``."""
+    return np.asarray(m1, np.float32) - np.asarray(m2, np.float32)
+
+
+def matrix_multiply_novec(m1, m2):
+    """``src/matrix.c:53-65`` triple loop, f32 accumulate."""
+    return np.matmul(np.asarray(m1, np.float32), np.asarray(m2, np.float32))
+
+
+def matrix_multiply_transposed_novec(m1, m2t):
+    """``src/matrix.c:67-80``."""
+    return np.einsum("...ij,...kj->...ik", np.asarray(m1, np.float32),
+                     np.asarray(m2t, np.float32))
+
+
+def matrix_vector_multiply_novec(m, v):
+    return np.asarray(m, np.float32) @ np.asarray(v, np.float32)
+
+
+# ---- public dispatching API ----------------------------------------------
+
+def _check_2d(name, *ms):
+    if not get_config().check_arguments:
+        return
+    for m in ms:
+        if m.ndim < 2:
+            raise ValueError(f"{name}: expected >=2D matrices, got {m.ndim}D")
+
+
+def matrix_add(m1, m2, simd=None):
+    if resolve_simd(simd):
+        return _add(jnp.asarray(m1), jnp.asarray(m2))
+    return matrix_add_novec(m1, m2)
+
+
+def matrix_sub(m1, m2, simd=None):
+    if resolve_simd(simd):
+        return _sub(jnp.asarray(m1), jnp.asarray(m2))
+    return matrix_sub_novec(m1, m2)
+
+
+def matrix_multiply(m1, m2, simd=None, fast=False):
+    """``res[h1, w2] = m1[h1, w1] · m2[h2, w2]``, requires ``w1 == h2``
+    (``matrix.h:71`` precondition, asserted at ``src/matrix.c:257-261``)."""
+    m1 = jnp.asarray(m1) if resolve_simd(simd) else np.asarray(m1)
+    m2 = jnp.asarray(m2) if resolve_simd(simd) else np.asarray(m2)
+    _check_2d("matrix_multiply", m1, m2)
+    if m1.shape[-1] != m2.shape[-2]:
+        raise ValueError(
+            f"matrix_multiply: w1 ({m1.shape[-1]}) != h2 ({m2.shape[-2]})")
+    if resolve_simd(simd):
+        return _matmul(m1, m2, fast=fast)
+    return matrix_multiply_novec(m1, m2)
+
+
+def matrix_multiply_transposed(m1, m2t, simd=None, fast=False):
+    """``res[h1, h2] = m1[h1, w1] · m2t[h2, w2=w1]^T``, requires ``w1 == w2``
+    (``matrix.h:87`` precondition)."""
+    m1 = jnp.asarray(m1) if resolve_simd(simd) else np.asarray(m1)
+    m2t = jnp.asarray(m2t) if resolve_simd(simd) else np.asarray(m2t)
+    _check_2d("matrix_multiply_transposed", m1, m2t)
+    if m1.shape[-1] != m2t.shape[-1]:
+        raise ValueError(
+            f"matrix_multiply_transposed: w1 ({m1.shape[-1]}) != "
+            f"w2 ({m2t.shape[-1]})")
+    if resolve_simd(simd):
+        return _matmul_t(m1, m2t, fast=fast)
+    return matrix_multiply_transposed_novec(m1, m2t)
+
+
+def matrix_vector_multiply(m, v, simd=None):
+    """BLAS-L2 gemv: ``res[h] = m[h, w] · v[w]``."""
+    if resolve_simd(simd):
+        return _matvec(jnp.asarray(m), jnp.asarray(v))
+    return matrix_vector_multiply_novec(m, v)

@@ -1,0 +1,138 @@
+"""2D plane / 1D array normalization and min-max scans.
+
+TPU-native rebuild of ``/root/reference/src/normalize.c`` +
+``inc/simd/normalize.h``.  The reference's unpack/convert/scale SIMD
+kernels (``src/normalize.c:40-153``) are one fused XLA
+reduce + elementwise; strides disappear because the array carries its own
+layout.
+
+Semantics preserved:
+
+* ``normalize2D_minmax``: u8 plane → f32 via ``(v - min)/((max - min)/2) - 1``
+  mapping [min, max] → [-1, 1]; **all zeros when max == min**
+  (``src/normalize.c:382-400``).
+* ``minmax2D`` (u8) / ``minmax1D`` (f32) return (min, max)
+  (``src/normalize.c:402-443``).
+* ``normalize2D`` = minmax2D + normalize2D_minmax
+  (``src/normalize.c:445-451``).
+
+All ops accept leading batch dimensions (the reduction is over the trailing
+2 axes for 2D ops, trailing 1 for 1D).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles.simd_tpu.utils.config import resolve_simd
+
+__all__ = [
+    "normalize2D", "normalize2D_minmax", "minmax2D", "minmax1D",
+    "normalize2D_novec", "normalize2D_minmax_novec", "minmax2D_novec",
+    "minmax1D_novec",
+]
+
+
+@jax.jit
+def _normalize2d(src):
+    v = src.astype(jnp.float32)
+    mn = jnp.min(v, axis=(-2, -1), keepdims=True)
+    mx = jnp.max(v, axis=(-2, -1), keepdims=True)
+    diff = (mx - mn) / 2.0
+    out = (v - mn) / diff - 1.0
+    return jnp.where(mx == mn, jnp.zeros_like(out), out)
+
+
+@jax.jit
+def _normalize2d_minmax(mn, mx, src):
+    v = src.astype(jnp.float32)
+    mn = jnp.asarray(mn, jnp.float32)
+    mx = jnp.asarray(mx, jnp.float32)
+    if mn.ndim:  # per-plane values from a batched minmax2D
+        mn = mn[..., None, None]
+        mx = mx[..., None, None]
+    diff = (mx - mn) / 2.0
+    out = (v - mn) / diff - 1.0
+    return jnp.where(mx == mn, jnp.zeros_like(out), out)
+
+
+@jax.jit
+def _minmax2d(src):
+    return (jnp.min(src, axis=(-2, -1)), jnp.max(src, axis=(-2, -1)))
+
+
+@jax.jit
+def _minmax1d(src):
+    return (jnp.min(src, axis=-1), jnp.max(src, axis=-1))
+
+
+# ---- NumPy oracles (reference *_novec, src/normalize.c:382-443) ----------
+
+def normalize2D_minmax_novec(mn, mx, src):
+    src = np.asarray(src)
+    # mn/mx may be scalars or per-plane arrays (batched input)
+    mn = np.asarray(mn, np.float32)
+    mx = np.asarray(mx, np.float32)
+    if mn.ndim:
+        mn = mn[..., None, None]
+        mx = mx[..., None, None]
+    diff = (mx - mn) / np.float32(2.0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        out = (src.astype(np.float32) - mn) / diff - 1.0
+    return np.where(mx == mn, np.float32(0), out).astype(np.float32)
+
+
+def minmax2D_novec(src):
+    src = np.asarray(src)
+    return (src.min(axis=(-2, -1)), src.max(axis=(-2, -1)))
+
+
+def minmax1D_novec(src):
+    src = np.asarray(src, np.float32)
+    return (src.min(axis=-1), src.max(axis=-1))
+
+
+def normalize2D_novec(src):
+    mn, mx = minmax2D_novec(src)
+    return normalize2D_minmax_novec(mn, mx, src)
+
+
+# ---- public dispatching API ----------------------------------------------
+
+def _check_2d(src):
+    if np.ndim(src) < 2:
+        raise ValueError("normalize2D/minmax2D expect a >=2D plane")
+
+
+def normalize2D(src, simd=None):
+    """u8 (or any numeric) plane → f32 in [-1, 1]
+    (``inc/simd/normalize.h:48-57``)."""
+    _check_2d(src)
+    if resolve_simd(simd):
+        return _normalize2d(jnp.asarray(src))
+    return normalize2D_novec(np.asarray(src))
+
+
+def normalize2D_minmax(mn, mx, src, simd=None):
+    """Normalization with precomputed min/max
+    (``inc/simd/normalize.h:66-79``)."""
+    if resolve_simd(simd):
+        return _normalize2d_minmax(mn, mx, jnp.asarray(src))
+    return normalize2D_minmax_novec(mn, mx, np.asarray(src))
+
+
+def minmax2D(src, simd=None):
+    """(min, max) of a plane (``inc/simd/normalize.h:59-64``)."""
+    _check_2d(src)
+    if resolve_simd(simd):
+        return _minmax2d(jnp.asarray(src))
+    return minmax2D_novec(np.asarray(src))
+
+
+def minmax1D(src, simd=None):
+    """(min, max) of a float array (``inc/simd/normalize.h:81-90``)."""
+    if resolve_simd(simd):
+        return _minmax1d(jnp.asarray(src))
+    return minmax1D_novec(np.asarray(src))

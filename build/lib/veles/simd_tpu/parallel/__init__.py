@@ -1,0 +1,46 @@
+"""Multi-chip scale-out over a TPU mesh.
+
+The reference is a single-thread library: its only "parallelism" is 8-wide
+AVX lanes, and its long-signal story is the sequential overlap-save block
+loop (``/root/reference/src/convolve.c:181-228``, SURVEY.md §2 checklist).
+This package is the genuinely new TPU capability: the same decompositions,
+expressed as ``shard_map`` programs over a ``jax.sharding.Mesh`` so they
+scale across ICI — XLA collectives instead of any message-passing runtime.
+
+* :func:`make_mesh` / :func:`default_mesh` — mesh construction helpers.
+* :func:`sharded_convolve` — **sequence-parallel** long-signal convolution:
+  the signal is sharded along its length, each chip convolves its block
+  after a one-hop **halo exchange** (``ppermute``) brings in the h−1
+  samples it needs from its left neighbour — the distributed form of
+  overlap-save, where the reference's in-core block overlap becomes the
+  inter-chip halo.
+* :func:`sharded_convolve_batch` — **dp×sp** convolution over a 2D mesh
+  tile: batch over one axis, sequence (with halo) over the other.
+* :func:`sharded_swt` — sequence-parallel **stationary wavelet cascade**
+  with ring halo exchange (periodic extension = the last→first hop).
+* :func:`sharded_matmul` — **tensor-parallel** GEMM: contracting dimension
+  sharded (zero-padded to the axis size), partials combined with ``psum``
+  over ICI.
+* :func:`data_parallel` — batch-dimension sharding for any batched op
+  (DWT/normalize/mathfun pipelines).
+* :mod:`~veles.simd_tpu.parallel.distributed` — **multi-host** bootstrap:
+  ``jax.distributed`` runtime + hybrid ICI/DCN meshes (DCN axes
+  outermost so halo/psum hops stay on-slice).
+
+All of these compile and run on any mesh size — the test-suite uses a
+virtual 8-device CPU mesh (see ``conftest.py``) plus real multi-process
+workers (``tests/test_distributed.py``), the driver's
+``dryrun_multichip`` does the same, and on real multi-chip hardware the
+identical code lays the collectives onto ICI.
+"""
+
+from veles.simd_tpu.parallel import distributed
+from veles.simd_tpu.parallel.mesh import default_mesh, make_mesh
+from veles.simd_tpu.parallel.ops import (
+    data_parallel, halo_exchange_left, halo_exchange_right,
+    sharded_convolve, sharded_convolve_batch, sharded_matmul, sharded_swt)
+
+__all__ = ["make_mesh", "default_mesh", "sharded_convolve",
+           "sharded_convolve_batch", "sharded_swt", "sharded_matmul",
+           "data_parallel", "halo_exchange_left", "halo_exchange_right",
+           "distributed"]

@@ -1,0 +1,55 @@
+"""Mesh construction helpers.
+
+Thin wrappers over ``jax.sharding.Mesh`` with the axis-name conventions
+used throughout :mod:`veles.simd_tpu.parallel`:
+
+* ``"dp"`` — data parallel (batch of independent signals/planes),
+* ``"sp"`` — sequence parallel (a single long signal sharded along its
+  length, the distributed overlap-save axis),
+* ``"tp"`` — tensor parallel (GEMM contracting dimension).
+
+On a real pod slice the mesh should be built from
+``jax.experimental.mesh_utils.create_device_mesh`` so axes ride ICI
+neighbours; on CPU test meshes the plain reshape is fine.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "default_mesh"]
+
+
+def make_mesh(axis_sizes: dict[str, int] | None = None,
+              devices=None) -> Mesh:
+    """Build a mesh from ``{axis_name: size}`` (sizes must multiply to the
+    device count; a single ``-1`` size is inferred)."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if not axis_sizes:
+        axis_sizes = {"dp": n}
+    names = list(axis_sizes)
+    sizes = [int(s) for s in axis_sizes.values()]
+    if sizes.count(-1) == 1:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(
+            f"axis sizes {dict(zip(names, sizes))} != {n} devices")
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(sizes, devices=devices)
+    except Exception:
+        dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def default_mesh(axis: str = "dp") -> Mesh:
+    """All devices on a single named axis."""
+    return make_mesh({axis: len(jax.devices())})

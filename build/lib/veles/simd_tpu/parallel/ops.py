@@ -1,0 +1,276 @@
+"""Sharded ops: sequence-parallel convolution, TP GEMM, DP batching.
+
+The distributed re-expression of the reference's hot paths (SURVEY.md §5
+"long-context" analog): overlap-save block filtering
+(``/root/reference/src/convolve.c:103-229``) becomes ``shard_map`` over a
+sequence axis with a ``ppermute`` halo exchange; the GEMM column loop
+(``src/matrix.c:200-226``) becomes a contracting-dim-sharded
+``dot_general`` + ``psum``.  Everything here is pure SPMD: one jitted
+program, XLA inserts the collectives, ICI carries them.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["sharded_convolve", "sharded_convolve_batch", "sharded_matmul",
+           "sharded_swt", "data_parallel",
+           "halo_exchange_left", "halo_exchange_right"]
+
+
+def halo_exchange_left(x_local, halo_len: int, axis_name: str):
+    """Bring the last ``halo_len`` samples of the left neighbour's shard.
+
+    The first shard receives zeros (``ppermute`` drops absent sources) —
+    exactly the zero history the overlap-save formulation wants
+    (``src/convolve.c:194-196`` zero-pads the first block).
+    """
+    n_shards = jax.lax.axis_size(axis_name)
+    block = x_local.shape[-1]
+    tail = x_local[..., block - halo_len:]  # empty when halo_len == 0
+    perm = [(i, i + 1) for i in range(n_shards - 1)]
+    return jax.lax.ppermute(tail, axis_name, perm)
+
+
+def halo_exchange_right(x_local, halo_len: int, axis_name: str,
+                        periodic: bool = False):
+    """Bring the first ``halo_len`` samples of the right neighbour's shard.
+
+    With ``periodic=True`` the last shard receives the first shard's head
+    (a ring over ICI) — the sharded form of the reference's PERIODIC
+    boundary extension (``src/wavelet.c:248-269``); otherwise the last
+    shard receives zeros.
+    """
+    n_shards = jax.lax.axis_size(axis_name)
+    head = x_local[..., :halo_len]
+    perm = [(i, i - 1) for i in range(1, n_shards)]
+    if periodic:
+        perm.append((0, n_shards - 1))
+    return jax.lax.ppermute(head, axis_name, perm)
+
+
+def _local_block_conv(x_ext, h):
+    """The local overlap-save block step: FULL convolution of the
+    halo-extended block, sliced to the block's span of the global result.
+
+    Reuses the single-chip overlap-save kernels — the MXU block-matmul
+    form for short/medium filters, batched-frames FFT for long ones
+    (:mod:`veles.simd_tpu.ops.convolve` auto-select) — so each shard runs
+    the same code the single-chip path does on its block.
+    """
+    from veles.simd_tpu.ops import convolve as cv
+
+    k = h.shape[-1]
+    n_local = x_ext.shape[-1] - (k - 1)
+    if k <= cv.AUTO_OS_MATMUL_MAX_H:
+        full = cv._conv_os_matmul(x_ext, h, cv.overlap_save_step(k),
+                                  precision=cv.os_precision())
+    else:
+        full = cv._conv_overlap_save(
+            x_ext, h, cv.tpu_block_length(k, x_ext.shape[-1]))
+    # y_local[j] = full[j + k - 1]: the VALID span of this block
+    return jax.lax.slice_in_dim(full, k - 1, k - 1 + n_local, axis=-1)
+
+
+def sharded_convolve(x, h, mesh: Mesh, axis: str = "sp"):
+    """Sequence-parallel full linear convolution over ``mesh[axis]``.
+
+    The signal is sharded along its length; each device convolves its
+    block after a one-hop left-halo exchange of ``h−1`` samples.  Returns
+    the full ``n + h - 1`` result (same semantics as
+    :func:`veles.simd_tpu.ops.convolve.convolve`).
+
+    This is the distributed overlap-save: reference blocks-with-overlap
+    (``src/convolve.c:181-228``) → shards-with-halo; the intra-block FFT
+    pipeline stays whatever XLA picks locally.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    h = jnp.asarray(h, jnp.float32)
+    if x.ndim != 1:
+        raise ValueError("sharded_convolve shards a single 1D signal; "
+                         "use data_parallel for batches")
+    n, k = x.shape[-1], h.shape[-1]
+    n_shards = mesh.shape[axis]
+    out_len = n + k - 1
+    pad_to = -(-out_len // n_shards) * n_shards
+    if k - 1 > pad_to // n_shards:
+        raise ValueError(
+            f"filter halo h_length-1={k - 1} exceeds the per-shard block "
+            f"({pad_to // n_shards}); the one-hop halo exchange needs "
+            f"h_length-1 <= signal_length/{n_shards} — use fewer shards or "
+            f"the single-chip convolve")
+    x_pad = jnp.pad(x, (0, pad_to - n))
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(axis), P()), out_specs=P(axis))
+    def _run(x_local, h_full):
+        halo = halo_exchange_left(x_local, k - 1, axis)
+        x_ext = jnp.concatenate([halo, x_local], axis=-1)
+        return _local_block_conv(x_ext, h_full)
+
+    return _run(x_pad, h)[..., :out_len]
+
+
+def sharded_convolve_batch(x, h, mesh: Mesh, batch_axis: str = "dp",
+                           seq_axis: str = "sp"):
+    """dp×sp convolution: a batch of signals sharded over ``batch_axis``
+    *and* each signal's length over ``seq_axis``.
+
+    The 2D-mesh form of the reference's block pipeline: every (dp, sp)
+    device holds a [batch/dp, n/sp] tile, halo-exchanges ``h−1`` samples
+    along sp, and convolves its tile with the shared filter.  Returns the
+    full ``[batch, n + h - 1]`` result.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    h = jnp.asarray(h, jnp.float32)
+    if x.ndim != 2:
+        raise ValueError("sharded_convolve_batch expects [batch, n]")
+    batch, n = x.shape
+    k = h.shape[-1]
+    dp = mesh.shape[batch_axis]
+    sp = mesh.shape[seq_axis]
+    out_len = n + k - 1
+    if batch % dp:
+        raise ValueError(f"batch={batch} not divisible by {batch_axis}={dp}")
+    pad_to = -(-out_len // sp) * sp
+    if k - 1 > pad_to // sp:
+        raise ValueError(
+            f"filter halo {k - 1} exceeds the per-shard block "
+            f"({pad_to // sp}); use fewer {seq_axis} shards")
+    x_pad = jnp.pad(x, ((0, 0), (0, pad_to - n)))
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(batch_axis, seq_axis), P()),
+        out_specs=P(batch_axis, seq_axis))
+    def _run(x_local, h_full):
+        halo = halo_exchange_left(x_local, k - 1, seq_axis)
+        x_ext = jnp.concatenate([halo, x_local], axis=-1)
+        return _local_block_conv(x_ext, h_full)
+
+    return _run(x_pad, h)[..., :out_len]
+
+
+def sharded_swt(type, order, levels, x, mesh: Mesh, axis: str = "sp"):
+    """Sequence-parallel stationary-wavelet cascade (periodic extension).
+
+    The à-trous cascade (``src/wavelet.c:211-246``) sharded along the
+    signal: level ℓ needs a right halo of ``(order-1)·2^(ℓ-1)`` samples,
+    fetched with a ring ``ppermute`` (periodic extension wraps the global
+    signal, which on a ring mesh is exactly the last→first hop).  All
+    ``levels`` levels run inside ONE shard_map, so XLA overlaps each
+    level's halo transfer with compute.  Returns
+    ``[hi_1, ..., hi_levels, lo_levels]``, every band of the input length
+    — matching :func:`stationary_wavelet_transform` with PERIODIC.
+    """
+    from veles.simd_tpu.ops import wavelet as wv
+
+    x = jnp.asarray(x, jnp.float32)
+    if x.ndim != 1:
+        raise ValueError("sharded_swt shards a single 1D signal")
+    n = x.shape[-1]
+    order = int(order)
+    levels = int(levels)
+    if levels < 1:
+        raise ValueError("levels must be >= 1")
+    n_shards = mesh.shape[axis]
+    if n % n_shards:
+        raise ValueError(f"signal length {n} not divisible by {axis}="
+                         f"{n_shards} (SWT keeps length; pad first)")
+    max_halo = order * (1 << (levels - 1))
+    if max_halo > n // n_shards:
+        raise ValueError(
+            f"level-{levels} halo {max_halo} exceeds the per-shard block "
+            f"({n // n_shards}); fewer shards or fewer levels")
+    hi_f, lo_f = wv._filters(type, order)
+    hi_f, lo_f = jnp.asarray(hi_f), jnp.asarray(lo_f)
+
+    def _level(cur, dilation):
+        # reference right-extension is order*dilation; VALID windows only
+        # reach (order-1)*dilation past the last start, but keep the full
+        # ext for bit-parity with the single-chip kernel's slice
+        halo_len = order * dilation
+        halo = halo_exchange_right(cur, halo_len, axis, periodic=True)
+        cur_ext = jnp.concatenate([cur, halo], axis=-1)
+        lhs = cur_ext.reshape((1, 1, cur_ext.shape[-1]))
+        rhs = jnp.stack([hi_f, lo_f]).reshape((2, 1, order))
+        out = jax.lax.conv_general_dilated(
+            lhs, rhs, window_strides=(1,), padding="VALID",
+            rhs_dilation=(dilation,),
+            precision=jax.lax.Precision.HIGHEST)[0]
+        return out[0, :cur.shape[-1]], out[1, :cur.shape[-1]]
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=P(axis), out_specs=P(axis))
+    def _run(x_local):
+        outs = []
+        cur = x_local
+        for lvl in range(1, levels + 1):
+            hi, cur = _level(cur, 1 << (lvl - 1))
+            outs.append(hi)
+        return tuple(outs) + (cur,)
+
+    return list(_run(x))
+
+
+def sharded_matmul(a, b, mesh: Mesh, axis: str = "tp"):
+    """Tensor-parallel GEMM: contracting dim sharded, ``psum`` over ICI.
+
+    ``a [m, K] @ b [K, n]`` with K split across ``mesh[axis]``; each chip
+    computes a partial ``[m, n]`` on its MXU and the partials are
+    all-reduced.  K is zero-padded up to a multiple of the axis size
+    (zeros contribute nothing to the contraction).
+    """
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    if a.shape[-1] != b.shape[-2]:
+        raise ValueError(f"contracting dims differ: {a.shape} @ {b.shape}")
+    shards = mesh.shape[axis]
+    rem = a.shape[-1] % shards
+    if rem:
+        pad = shards - rem
+        a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, pad)])
+        b = jnp.pad(b, [(0, 0)] * (b.ndim - 2) + [(0, pad), (0, 0)])
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(None, axis), P(axis, None)), out_specs=P(None, None))
+    def _run(a_local, b_local):
+        partial = jnp.dot(a_local, b_local,
+                          precision=jax.lax.Precision.HIGHEST)
+        return jax.lax.psum(partial, axis)
+
+    return _run(a, b)
+
+
+def data_parallel(fn, mesh: Mesh, axis: str = "dp"):
+    """Wrap a batched op so its leading batch axis is sharded over
+    ``mesh[axis]`` — jit + sharding constraint, XLA partitions the rest.
+
+    >>> dwt = data_parallel(lambda x: wavelet_apply(DAUB, 8, PERIODIC, x),
+    ...                     mesh)
+    >>> hi, lo = dwt(batch_of_signals)   # batch split across chips
+
+    The wrapper holds a persistent ``jax.jit``: config read at trace time
+    (e.g. ``Config.conv_precision``) is baked into the cached executable —
+    later ``set_config`` changes do not retrace existing wrappers.
+    """
+    jfn = jax.jit(fn)
+
+    def wrapper(batch, *args, **kwargs):
+        batch = jnp.asarray(batch)
+        spec = P(axis, *([None] * (batch.ndim - 1)))
+        batch = jax.device_put(batch, NamedSharding(mesh, spec))
+        with mesh:
+            return jfn(batch, *args, **kwargs)
+
+    return wrapper

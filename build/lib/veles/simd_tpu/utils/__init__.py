@@ -1,0 +1,33 @@
+"""Platform layer: configuration, dtypes, and buffer/memory helpers.
+
+Replaces the reference's L0/L1 layers (``configure.ac``, ``inc/simd/common.h``,
+``inc/simd/attributes.h``, ``inc/simd/instruction_set.h``,
+``inc/simd/memory.h``)
+— see SURVEY.md §2 "L1 Platform".
+"""
+
+from veles.simd_tpu.utils.config import Backend, get_backend, set_backend
+from veles.simd_tpu.utils.memory import (
+    next_highest_power_of_2,
+    zeropadding,
+    zeropadding_ex,
+    rmemcpyf,
+    crmemcpyf,
+    align_complement,
+    malloc_aligned,
+    mallocf,
+)
+
+__all__ = [
+    "Backend",
+    "get_backend",
+    "set_backend",
+    "next_highest_power_of_2",
+    "zeropadding",
+    "zeropadding_ex",
+    "rmemcpyf",
+    "crmemcpyf",
+    "align_complement",
+    "malloc_aligned",
+    "mallocf",
+]

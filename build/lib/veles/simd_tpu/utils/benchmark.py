@@ -1,0 +1,153 @@
+"""Device timing utilities (the framework's profiling layer).
+
+The reference's only profiling is ``std::chrono`` around synchronous CPU
+calls (``/root/reference/tests/benchmark.inc:74-107``).  On an
+asynchronous accelerator runtime that pattern silently measures dispatch,
+not compute — ``block_until_ready`` is not reliable through remote-relay
+PJRT transports either (observed on the axon tunnel: a 3-second
+convolution "completed" in 40µs).
+
+The primary method is :func:`device_time_chained` — the workload as an
+``x -> x`` step run K times inside one ``lax.fori_loop`` dispatch, per-op
+time taken as the marginal between two trip counts.  It is the only
+scheme that resolves sub-millisecond ops through a relay with ~66 ms
+round-trip and ~2.6 ms jitter.
+
+:func:`device_time` (pipelined host-side bursts) remains for ops that
+cannot be expressed as a shape-preserving step, but is only trustworthy
+when the per-op time comfortably exceeds the transport jitter — for
+microsecond-scale ops its marginal is noise.
+"""
+
+from __future__ import annotations
+
+import time
+import warnings
+
+import numpy as np
+
+__all__ = ["device_time", "device_time_chained", "host_time",
+           "rms_normalize"]
+
+
+def rms_normalize(p, eps: float = 1e-30):
+    """RMS-normalize a jax array — the standard way to keep a chained
+    GEMM/gemv step bounded over hundreds of iterations (the reduction is
+    negligible next to the matmul it stabilizes)."""
+    import jax.numpy as jnp
+
+    return p / (jnp.sqrt(jnp.mean(p * p)) + eps)
+
+
+def _sync(out):
+    """Force completion of `out` (any jax array / pytree leaf)."""
+    import jax
+
+    leaves = jax.tree.leaves(out)
+    last = leaves[-1]
+    np.asarray(last.ravel()[-1:] if hasattr(last, "ravel") else last)
+
+
+def device_time(fn, *, burst: int = 8, repeats: int = 3,
+                warmup: int = 2) -> float:
+    """Marginal per-call device time of ``fn`` (which must return a jax
+    array or pytree of them)."""
+    for _ in range(warmup):
+        _sync(fn())
+
+    def burst_time(k):
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(k):
+                out = fn()
+            _sync(out)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1 = burst_time(1)
+    tk = burst_time(burst)
+    per_op = (tk - t1) / (burst - 1)
+    # degenerate case (dispatch-dominated tiny op): fall back to t1
+    return max(per_op, 1e-9) if per_op > 0 else t1
+
+
+def device_time_chained(step, x0, *, iters: int = 256, base: int = 8,
+                        repeats: int = 3, min_window: float = 0.04,
+                        max_iters: int = 1 << 15) -> float:
+    """Per-iteration device time of ``step`` (an ``x -> x`` function),
+    measured by running it inside a single-dispatch ``lax.fori_loop``.
+
+    Host-burst timing (:func:`device_time`) degenerates when the per-op
+    time is below the relay's round-trip jitter (~2.6 ms observed): up to
+    ~8 dispatched ops hide entirely inside the ~66 ms fixed RTT, so the
+    marginal estimate is noise.  Chaining the op on-device removes host
+    dispatch from the measurement entirely: one jit call runs the loop
+    ``k`` times with a data dependency between iterations (single-stream
+    TPU execution serializes them), and the marginal time between two
+    trip counts cancels the RTT, transfer, and loop-setup overhead:
+
+        per_op = (T(k) - T(base)) / (k - base)
+
+    ``k`` starts at ``iters`` and quadruples until the marginal window
+    ``T(k) - T(base)`` clears ``min_window`` (default 40 ms ≈ 15x the
+    observed RTT jitter), so microsecond-scale ops get the trip count
+    they need automatically.  The trip count is a traced scalar, so every
+    measurement shares one compiled executable.
+
+    Two caveats, deliberate:
+
+    * ``step`` must not be an affine map with constant coefficients
+      (e.g. ``v + 1``) — XLA reduces such loops and the timing reflects
+      the reduced program;
+    * loop-invariant operands that fit in VMEM stay resident across
+      iterations, so bandwidth-bound steps report *steady-state* rates
+      that can exceed cold HBM bandwidth.  This is real, reproducible
+      device behavior, not a timing artifact.
+
+    ``step`` must preserve shape/dtype and keep values bounded (it is
+    applied up to ``max_iters`` times).
+    """
+    import jax
+    from jax import lax
+
+    @jax.jit
+    def runk(x, k):
+        return lax.fori_loop(0, k, lambda i, v: step(v), x)
+
+    def timed(k):
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            _sync(runk(x0, k))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    _sync(runk(x0, base))  # compile + warm
+    tb = timed(base)
+    k = max(iters, base * 2)
+    while True:
+        tk = timed(k)
+        if tk - tb >= min_window or k >= max_iters:
+            if tk - tb < min_window:
+                warnings.warn(
+                    f"device_time_chained: marginal window {tk - tb:.4f}s "
+                    f"below {min_window}s at max_iters={max_iters}; the "
+                    "estimate is transport-jitter noise (step too fast, "
+                    "or reduced by XLA — see docstring caveats)",
+                    RuntimeWarning, stacklevel=2)
+            return max((tk - tb) / (k - base), 1e-9)
+        k = min(k * 4, max_iters)
+
+
+def host_time(fn, *, repeats: int = 3, warmup: int = 1) -> float:
+    """Best-of-N wall time for a synchronous host function."""
+    for _ in range(warmup):
+        fn()
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best

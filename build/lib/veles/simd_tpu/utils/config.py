@@ -1,0 +1,115 @@
+"""Backend selection & typed configuration.
+
+The reference exposes a per-call ``int simd`` flag on every public entry point
+(e.g. ``/root/reference/inc/simd/matrix.h:41-47``) choosing between the
+vectorized kernel and the scalar ``*_na`` oracle, plus compile-time autotools
+switches (``NO_FFTF``, ``BENCHMARK``, ISA ``-march`` — SURVEY.md §5 "Config").
+
+Here the same dispatch is a ``Backend`` enum: ``Backend.XLA`` runs the jitted
+TPU/XLA path; ``Backend.ORACLE`` runs the NumPy reference twin.  Every public
+op accepts the reference-compatible boolean ``simd=`` keyword (truthy → XLA)
+so the oracle-testing pattern survives unchanged, and a process-wide default
+can be set with :func:`set_backend` (used by the test-suite to cross-validate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import threading
+
+
+class Backend(enum.Enum):
+    """Which implementation services an op call."""
+
+    XLA = "xla"        # jitted JAX → XLA (TPU on real hardware, CPU in tests)
+    ORACLE = "oracle"  # NumPy reference twin (the reference's *_na path)
+
+
+_state = threading.local()
+
+
+def get_backend() -> Backend:
+    """Current default backend (thread-local, default ``Backend.XLA``)."""
+    return getattr(_state, "backend", Backend.XLA)
+
+
+def set_backend(backend: Backend) -> Backend:
+    """Set the thread-local default backend; returns the previous one."""
+    prev = get_backend()
+    _state.backend = Backend(backend)
+    return prev
+
+
+def resolve_simd(simd) -> bool:
+    """Resolve the reference-style ``simd`` flag to "use the XLA path?".
+
+    ``None`` defers to the process default; any other value is truthiness,
+    matching the reference's ``int simd`` C flag semantics.
+    """
+    if simd is None:
+        return get_backend() is Backend.XLA
+    return bool(simd)
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Typed run-time configuration (replaces the reference's CPP defines).
+
+    ``/root/reference/configure.ac:32-58`` wires ``NO_FFTF`` / ``BENCHMARK`` /
+    ``DEBUG`` at compile time; on TPU these become runtime fields.
+    """
+
+    # Interpret complex arrays as interleaved re/im float pairs (the
+    # reference's FFTF layout, /root/reference/inc/simd/arithmetic.h:142-168).
+    interleaved_complex: bool = True
+    # Validate op arguments eagerly (the reference's assert() contract,
+    # /root/reference/src/matrix.c:257-261). Disabled inside jit traces.
+    check_arguments: bool = True
+    # Default float dtype for compute. f32 keeps exact parity with the
+    # reference; bf16 unlocks full MXU throughput where tolerances allow.
+    dtype: str = "float32"
+    # MXU precision for the overlap-save block matmul ("highest" = 6-pass
+    # bf16 emulation of f32, ~5e-7 rel. error; "high" = 3-pass, ~1.3e-5,
+    # ~1.8x faster — both inside every correctness gate incl. the 1e-4
+    # TPU smoke tolerance and the reference's own test epsilons; measured
+    # sweep in ops/convolve.py). No effect on CPU, which always computes
+    # full f32. 1-pass bf16 ("default", ~2.6e-3) fails the oracle gates
+    # and is deliberately NOT accepted here — pass it explicitly to
+    # _conv_os_matmul if you want it. NOTE: the value is read at trace
+    # time; ops already traced under an *enclosing* jit (e.g. a
+    # data_parallel wrapper) keep the precision they were traced with.
+    conv_precision: str = "highest"
+
+    def __post_init__(self):
+        allowed = ("highest", "high")
+        if self.conv_precision not in allowed:
+            raise ValueError(
+                f"conv_precision must be one of {allowed}, got "
+                f"{self.conv_precision!r}")
+
+
+_config = Config()
+
+
+def get_config() -> Config:
+    return _config
+
+
+def set_config(**updates) -> Config:
+    global _config
+    _config = dataclasses.replace(_config, **updates)
+    return _config
+
+
+def on_tpu() -> bool:
+    """True when the default JAX backend is a TPU-like accelerator."""
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return False
+    import jax
+
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except RuntimeError:
+        return False

@@ -1,0 +1,130 @@
+"""Buffer & layout helpers (replaces ``inc/simd/memory.h`` + ``src/memory.c``).
+
+On TPU, XLA owns buffer layout and alignment: the reference's 64-byte aligned
+allocators (``/root/reference/src/memory.c:71-91``) become device arrays in
+HBM, and the alignment-complement queries (``src/memory.c:41-69``) are
+meaningless (kept as 0-returning compatibility stubs).  What *does* survive is
+the arithmetic the rest of the library builds on:
+
+* ``next_highest_power_of_2``   (``inc/simd/arithmetic.h:1227-1235``)
+* ``zeropadding`` / ``zeropadding_ex`` — pad to 2 × next-pow-2, the FFT-size
+  helper (``src/memory.c:126-146``); XLA likes these shapes too.
+* ``rmemcpyf`` / ``crmemcpyf`` — reversed (complex-pairwise) copies used by
+  correlation's flip-h trick (``src/memory.c:148-183``,
+  ``src/correlate.c:37-72``).
+
+All helpers accept NumPy or JAX arrays and stay in that domain (NumPy in,
+NumPy out), so they are usable both from the oracle path and inside traced
+code.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def next_highest_power_of_2(value: int) -> int:
+    """Smallest power of two >= ``value``.
+
+    Semantics of ``next_highest_power_of_2`` at
+    ``/root/reference/inc/simd/arithmetic.h:1227-1235`` (bit-smearing trick).
+    """
+    value = int(value)
+    if value <= 1:
+        return 1
+    return 1 << (value - 1).bit_length()
+
+
+def zeropadding_length(length: int) -> int:
+    """The reference's FFT padding size: 2 × (next power of 2 > length).
+
+    Matches the loop at ``/root/reference/src/memory.c:131-137``: e.g.
+    100 → 256, 128 → 512, 1 → 4.
+    """
+    length = int(length)
+    nl = length
+    log = 2
+    while nl:
+        nl >>= 1
+        log += 1
+    return 1 << (log - 1)
+
+
+def zeropadding(data, new_length: int | None = None):
+    """Zero-pad ``data`` to :func:`zeropadding_length` (or ``new_length``).
+
+    Returns ``(padded, new_length)`` like ``src/memory.c:126-129`` returns the
+    buffer and writes ``*newLength``.
+    """
+    xp = _ns(data)
+    n = data.shape[-1]
+    nl = zeropadding_length(n) if new_length is None else int(new_length)
+    pad = [(0, 0)] * (data.ndim - 1) + [(0, nl - n)]
+    return xp.pad(data, pad), nl
+
+
+def zeropadding_ex(data, additional_length: int):
+    """Like :func:`zeropadding` with extra zero tail beyond the reported
+    length (``src/memory.c:129-142``: the C version allocates
+    ``nl + additionalLength`` floats but writes ``*newLength = nl``, so the
+    returned length excludes the extra tail — preserved here)."""
+    xp = _ns(data)
+    n = data.shape[-1]
+    nl = zeropadding_length(n)
+    pad = [(0, 0)] * (data.ndim - 1) + [(0, nl + int(additional_length) - n)]
+    return xp.pad(data, pad), nl
+
+
+def rmemcpyf(data):
+    """Reversed copy: ``out[i] = in[n-1-i]`` (``src/memory.c:148-176``)."""
+    return data[..., ::-1]
+
+
+def crmemcpyf(data):
+    """Complex-pairwise reversed copy of an interleaved re/im array:
+    reverses the complex samples but keeps each (re, im) pair in order
+    (``src/memory.c:178-183``)."""
+    n = data.shape[-1]
+    if n % 2:
+        raise ValueError("interleaved complex array must have even length")
+    xp = _ns(data)
+    pairs = data.reshape(data.shape[:-1] + (n // 2, 2))
+    return xp.flip(pairs, axis=-2).reshape(data.shape)
+
+
+def memsetf(shape, value, dtype=np.float32):
+    """Filled array (``src/memory.c:93-124``); XLA fuses broadcasts anyway."""
+    return np.full(shape, value, dtype=dtype)
+
+
+def malloc_aligned(size: int) -> np.ndarray:
+    """Compatibility stub for ``src/memory.c:77-87``: returns a zeroed host
+    byte buffer.  Device allocations live in HBM and are managed by XLA."""
+    return np.zeros(int(size), dtype=np.uint8)
+
+
+def malloc_aligned_offset(size: int, offset: int) -> np.ndarray:
+    """Compatibility stub for ``inc/simd/memory.h:100`` (alloc whose
+    ``ptr + offset`` is aligned): a view at ``offset`` into a fresh
+    buffer — XLA owns real layout, so only the length contract matters."""
+    return np.zeros(int(size) + int(offset), dtype=np.uint8)[int(offset):]
+
+
+def mallocf(length: int) -> np.ndarray:
+    """Compatibility stub for ``src/memory.c:89-91``."""
+    return np.zeros(int(length), dtype=np.float32)
+
+
+def align_complement(ptr_or_array, dtype=np.float32) -> int:
+    """Alignment-complement stub (``src/memory.c:41-69``): XLA owns layout,
+    every device buffer is "aligned", so the complement is always 0."""
+    return 0
+
+
+def _ns(data):
+    """NumPy-or-jnp namespace for ``data`` without importing jax eagerly."""
+    if isinstance(data, np.ndarray) or np.isscalar(data):
+        return np
+    import jax.numpy as jnp
+
+    return jnp

@@ -1,0 +1,239 @@
+"""CPU-platform pinning for multi-device tests and dry runs.
+
+The axon TPU plugin registers itself from a ``sitecustomize`` and pins
+``JAX_PLATFORMS=axon`` before user code runs, so an env-var override from
+outside the process loses.  Multi-chip code paths (``veles.simd_tpu.parallel``)
+are validated on a *virtual* CPU device mesh instead
+(``--xla_force_host_platform_device_count``), which needs the platform beaten
+back to CPU through ``jax.config``.  This module is the single home for that
+knowledge — used by ``conftest.py`` (import-time pin for the test suite) and
+``__graft_entry__.dryrun_multichip`` (runtime provision + restore).
+
+The reference library's analog is ``inc/simd/instruction_set.h`` — the one
+place that decides which backend the whole build talks to.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+__all__ = ["set_cpu_env", "pin_cpu", "cpu_devices",
+           "maybe_override_platform", "probe_device_count",
+           "require_reachable_device"]
+
+
+def maybe_override_platform(env_var: str = "VELES_SIMD_PLATFORM") -> None:
+    """Honor an explicit platform override from ``env_var``.
+
+    The axon sitecustomize stomps ``JAX_PLATFORMS`` before user code runs,
+    so only a ``jax.config``-level pin works; this is the one shared home
+    for that override (used by ``bench.py``, ``tools/benchmark_suite.py``
+    and the C-shim bridge).  Must be called before any backend init.
+    """
+    value = os.environ.get(env_var)
+    if value:
+        import jax
+
+        jax.config.update("jax_platforms", value)
+
+_COUNT_FLAG = "xla_force_host_platform_device_count"
+
+
+def set_cpu_env(n_devices: int) -> None:
+    """Env-var half of the pin; safe before ``import jax``."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    parts = [f for f in flags.split() if _COUNT_FLAG not in f]
+    parts.append(f"--{_COUNT_FLAG}={n_devices}")
+    os.environ["XLA_FLAGS"] = " ".join(parts)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def pin_cpu(n_devices: int) -> None:
+    """Pin jax to a CPU platform with ``n_devices`` virtual devices.
+
+    Must run before any backend is initialized (jax refuses the
+    ``jax_num_cpu_devices`` update afterwards); call
+    :func:`_clear_backends` first when one might be live.  Verifies the
+    outcome and raises if the pin did not take (e.g. something initialized
+    a backend earlier in the process), rather than letting the suite run
+    silently on the wrong platform.
+    """
+    set_cpu_env(n_devices)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if hasattr(jax.config, "jax_num_cpu_devices"):
+        # present since jax 0.4.34; if the update itself fails (backend
+        # already live) that error should propagate, not be swallowed
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    devices = jax.devices()
+    if len(devices) < n_devices or devices[0].platform != "cpu":
+        raise RuntimeError(
+            f"pin_cpu({n_devices}) did not take: devices are "
+            f"{[str(d) for d in devices]} — a jax backend was likely "
+            f"initialized before the pin")
+
+
+def _clear_backends() -> None:
+    try:
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+    except Exception:
+        pass
+
+
+def _snapshot() -> dict:
+    import jax
+
+    return {
+        "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS"),
+        "XLA_FLAGS": os.environ.get("XLA_FLAGS"),
+        "jax_platforms": getattr(jax.config, "jax_platforms", None),
+        "jax_num_cpu_devices": getattr(jax.config, "jax_num_cpu_devices",
+                                       None),
+    }
+
+
+def _restore(snap: dict) -> None:
+    """Put env + config back and drop the provisioned backends so the next
+    device use re-initializes on the original platform (e.g. the real
+    TPU)."""
+    import jax
+
+    for key in ("JAX_PLATFORMS", "XLA_FLAGS"):
+        if snap[key] is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = snap[key]
+    _clear_backends()
+    jax.config.update("jax_platforms", snap["jax_platforms"])
+    if snap["jax_num_cpu_devices"] is not None:
+        try:
+            jax.config.update("jax_num_cpu_devices",
+                              snap["jax_num_cpu_devices"])
+        except Exception:
+            pass
+
+
+@contextlib.contextmanager
+def cpu_devices(n_devices: int):
+    """Context manager yielding ≥ ``n_devices`` jax devices.
+
+    Provisions a virtual CPU mesh when fewer real devices exist and
+    restores the original platform on exit — including when provisioning
+    itself fails partway.  NOTE: provisioning (and restoring) destroys the
+    live backend, so jax arrays created *before* entering the context do
+    not survive it; treat the context as a device-state barrier.
+    """
+    import jax
+
+    snap = _snapshot()
+    provisioned = False
+    try:
+        if _backend_live():
+            # a live backend can't hang on re-query; count in-process and
+            # avoid subprocess device-lock contention with ourselves
+            try:
+                count = len(jax.devices())
+            except Exception:
+                count = 0
+        else:
+            count = probe_device_count()
+        if count >= n_devices:
+            devices = jax.devices()
+        else:
+            provisioned = True
+            _clear_backends()
+            pin_cpu(n_devices)
+            devices = jax.devices()
+        if len(devices) < n_devices:
+            raise RuntimeError(
+                f"need {n_devices} devices, have {len(devices)} "
+                f"({[str(d) for d in devices]})")
+        yield list(devices[:n_devices])
+    finally:
+        if provisioned:
+            _restore(snap)
+
+
+def _backend_live() -> bool:
+    """True when this process already initialized a jax backend."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
+
+
+def probe_device_count(timeout: float = 90.0) -> int:
+    """Count the parent's *effective* platform's devices in a subprocess.
+
+    Backend init can hang indefinitely when a remote-relay platform (the
+    axon tunnel) is wedged; an in-process ``jax.devices()`` probe would
+    then hang the caller with no recourse.  A subprocess is killable: on
+    timeout or error the count is reported as 0 and the caller provisions
+    the virtual CPU mesh instead.  A config-level platform pin in the
+    parent (``maybe_override_platform`` / ``pin_cpu``) is replicated into
+    the probe, since subprocesses inherit env vars but not ``jax.config``
+    — and the sitecustomize stomps the env ones.  Bonus: a successful
+    probe leaves the calling process's jax still uninitialized, so a
+    subsequent CPU pin needs no backend teardown.
+    """
+    return _probe_subprocess(timeout)[0]
+
+
+def require_reachable_device(timeout: float = 120.0) -> None:
+    """Fail fast (SystemExit 2) when backend init would hang or crash.
+
+    For benchmark/CLI entry points: a wedged remote relay blocks backend
+    init forever (observed live), eating the caller's whole timeout with
+    no diagnostics.  The probe subprocess surfaces the actual cause —
+    timeout vs a child crash — instead of hanging.
+    """
+    import sys
+
+    count, detail = _probe_subprocess(timeout)
+    if count < 1:
+        print(f"device platform unreachable: {detail}", file=sys.stderr)
+        raise SystemExit(2)
+
+
+def _probe_subprocess(timeout: float) -> tuple[int, str]:
+    """(device count, failure detail) from a killable probe subprocess."""
+    import subprocess
+    import sys
+
+    import jax
+
+    code = "import jax\n"
+    platforms = getattr(jax.config, "jax_platforms", None)
+    if platforms:
+        code += f"jax.config.update('jax_platforms', {platforms!r})\n"
+    code += "print(len(jax.devices()))"
+    def _tail(*chunks) -> str:
+        for c in chunks:
+            if isinstance(c, bytes):
+                c = c.decode(errors="replace")
+            if c and c.strip():
+                return c.strip()[-500:]
+        return ""
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout)
+        return int(proc.stdout.strip().splitlines()[-1]), ""
+    except subprocess.TimeoutExpired as e:
+        detail = _tail(e.stderr, e.stdout)
+        return 0, (f"backend init probe timed out after {timeout:.0f}s"
+                   + (f"; child output: {detail}" if detail else ""))
+    except Exception:
+        tail = ""
+        try:
+            tail = _tail(proc.stderr, proc.stdout)
+        except NameError:
+            pass
+        return 0, f"backend init probe failed: {tail or 'no output'}"

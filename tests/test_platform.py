@@ -1,0 +1,61 @@
+"""Tests for the platform layer (``utils/platform.py``).
+
+The backend-selection knowledge concentrated here (config-level pins
+that beat the sitecustomize, subprocess probes that can't hang, restore
+semantics) is what every entry point leans on — worth direct coverage.
+"""
+
+import os
+
+import pytest
+
+from veles.simd_tpu.utils import platform as plat
+
+
+def test_set_cpu_env_replaces_count_flag():
+    old = os.environ.get("XLA_FLAGS")
+    try:
+        os.environ["XLA_FLAGS"] = (
+            "--xla_force_host_platform_device_count=3 --other_flag=1")
+        plat.set_cpu_env(5)
+        flags = os.environ["XLA_FLAGS"].split()
+        assert "--xla_force_host_platform_device_count=5" in flags
+        assert "--other_flag=1" in flags
+        assert sum("device_count" in f for f in flags) == 1
+    finally:
+        if old is None:
+            os.environ.pop("XLA_FLAGS", None)
+        else:
+            os.environ["XLA_FLAGS"] = old
+
+
+def test_probe_device_count_sees_pinned_cpu():
+    # conftest pinned this process to an 8-device CPU platform via
+    # jax.config; the probe must replicate that pin into its subprocess
+    # (env alone would be stomped by the sitecustomize)
+    assert plat.probe_device_count(timeout=120.0) >= 1
+
+
+def test_require_reachable_device_passes_here():
+    plat.require_reachable_device(timeout=120.0)  # must not raise
+
+
+def test_backend_live_is_true_under_pytest():
+    # conftest initialized the CPU backend at session start
+    assert plat._backend_live()
+
+
+def test_probe_subprocess_failure_detail():
+    # unreasonably small timeout forces the TimeoutExpired branch
+    count, detail = plat._probe_subprocess(timeout=0.01)
+    assert count == 0
+    assert "timed out" in detail
+
+
+def test_cpu_devices_uses_live_backend_without_teardown():
+    import jax
+
+    before = jax.devices()
+    with plat.cpu_devices(4) as devices:
+        assert len(devices) == 4
+    assert jax.devices() == before  # no provisioning, no restore
