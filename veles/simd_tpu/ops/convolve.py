@@ -103,13 +103,17 @@ AUTO_OS_MATMUL_MAX_H = 1 << 14
 def overlap_save_step(h_length: int) -> int:
     """Output-block size for the MXU overlap-save variant.
 
-    Each block costs a ``[B, step+k-1] x [step+k-1, step]`` matmul, so the
-    MAC overhead vs the direct form is ``(step+k-1)/k`` while MXU tiling
-    wants both free dims ≥ 512.  Measured on v5e (1M signal): step 2048
-    beats 512/1024 at k=2047 despite 2x MAC redundancy — MXU shape
-    efficiency dominates; smaller filters keep step ≥ 512.
+    Each output sample's dot spans ``step+k-1`` frame columns, so total
+    MACs = ``out_len * (step+k-1)`` — *larger* steps mean more redundant
+    work, while MXU tiling wants the step dimension >= ~512 lanes.
+    Measured on v5e (1M signal, k=2047, chained device timing):
+    step 1024 -> 4333 Msamples/s vs 2048 -> 3076 and 4096 -> 798 at
+    HIGHEST (7570 vs 2958 at HIGH), monotone toward smaller steps until
+    lane-width effects bite.  Rule: half the filter's padded length,
+    clamped to [512, 2048].  ``tools/tune_overlap_save.py`` reruns the
+    sweep on new hardware.
     """
-    return max(512, min(next_highest_power_of_2(int(h_length)), 4096))
+    return max(512, min(next_highest_power_of_2(int(h_length)) // 2, 2048))
 
 
 def overlap_save_block_length(h_length: int) -> int:

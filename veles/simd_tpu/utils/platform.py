@@ -212,6 +212,9 @@ def _probe_subprocess(timeout: float) -> tuple[int, str]:
     platforms = getattr(jax.config, "jax_platforms", None)
     if platforms:
         code += f"jax.config.update('jax_platforms', {platforms!r})\n"
+    n_cpu = getattr(jax.config, "jax_num_cpu_devices", None)
+    if n_cpu and n_cpu > 0:
+        code += f"jax.config.update('jax_num_cpu_devices', {int(n_cpu)})\n"
     code += "print(len(jax.devices()))"
     def _tail(*chunks) -> str:
         for c in chunks:
