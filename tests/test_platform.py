@@ -7,7 +7,6 @@ semantics) is what every entry point leans on — worth direct coverage.
 
 import os
 
-import pytest
 
 from veles.simd_tpu.utils import platform as plat
 

@@ -1,0 +1,115 @@
+"""Property-based invariants (hypothesis) across op families.
+
+The reference's tests check oracle agreement at sampled sizes; these pin
+down *algebraic* contracts that hold for every input — linearity,
+adjointness between convolve and correlate, filter-bank energy
+conservation, normalization range — so a regression that preserves
+oracle parity but breaks structure (e.g. a flipped kernel) still fails.
+"""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from veles.simd_tpu.ops import arithmetic as ar
+from veles.simd_tpu.ops import convolve as cv
+from veles.simd_tpu.ops import correlate as cr
+from veles.simd_tpu.ops import normalize as nz
+from veles.simd_tpu.ops import wavelet as wv
+from veles.simd_tpu.ops.wavelet_coeffs import WaveletType, scaling_coefficients
+
+SETTINGS = dict(max_examples=20, deadline=None)
+
+
+def _signal(min_size=8, max_size=300):
+    return st.lists(
+        st.floats(-100, 100, width=32), min_size=min_size,
+        max_size=max_size).map(lambda v: np.asarray(v, np.float32))
+
+
+@settings(**SETTINGS)
+@given(_signal(), st.integers(1, 7), st.floats(-5, 5, width=32))
+def test_convolution_is_linear(x, klen, alpha):
+    h = np.linspace(-1, 1, klen).astype(np.float32)
+    lhs = np.asarray(cv.convolve_simd((alpha * x).astype(np.float32), h))
+    rhs = alpha * np.asarray(cv.convolve_simd(x, h))
+    np.testing.assert_allclose(lhs, rhs, atol=2e-2)
+
+
+@settings(**SETTINGS)
+@given(_signal(min_size=16), st.integers(2, 8))
+def test_correlate_is_convolve_with_reversed_kernel(x, klen):
+    h = (np.arange(klen) - klen / 3).astype(np.float32)
+    corr = np.asarray(cr.cross_correlate_simd(x, h))
+    conv = np.asarray(cv.convolve_simd(x, h[::-1].copy()))
+    np.testing.assert_allclose(corr, conv, atol=1e-3)
+
+
+@settings(**SETTINGS)
+@given(_signal(min_size=32, max_size=256).filter(lambda v: len(v) % 2 == 0),
+       st.sampled_from([2, 4, 8, 12]))
+def test_dwt_periodic_conserves_energy(x, order):
+    """Orthonormal filter bank + periodic extension: Parseval."""
+    hi, lo = wv.wavelet_apply(WaveletType.DAUBECHIES, order,
+                              wv.ExtensionType.PERIODIC, x)
+    e_in = float(np.sum(np.asarray(x, np.float64) ** 2))
+    e_out = float(np.sum(np.asarray(hi, np.float64) ** 2)
+                  + np.sum(np.asarray(lo, np.float64) ** 2))
+    assert e_out == pytest.approx(e_in, rel=1e-3, abs=1e-3)
+
+
+@settings(**SETTINGS)
+@given(st.sampled_from(["daub", "sym", "coif"]), st.data())
+def test_filters_are_orthonormal_qmf(family, data):
+    wtype = {"daub": WaveletType.DAUBECHIES, "sym": WaveletType.SYMLET,
+             "coif": WaveletType.COIFLET}[family]
+    from veles.simd_tpu.ops.wavelet_coeffs import supported_orders
+
+    order = data.draw(st.sampled_from(sorted(supported_orders(wtype))))
+    c = np.asarray(scaling_coefficients(wtype, order), np.float64)
+    # the reference's tables mix conventions and ours mirror them
+    # exactly: Daubechies rows sum to sqrt(2) (orthonormal, energy 1),
+    # Symlets/Coiflets to 1 (DC gain 1, energy 1/2) — verified against
+    # src/{daubechies,symlets,coiflets}.c row sums.  The filter bank
+    # rescales internally so the transform is orthonormal either way.
+    # Tolerances follow provenance: derived Daubechies/Coiflets are
+    # near machine-exact; Symlets are stored verbatim from the published
+    # table, whose own generation error reaches ~2e-5 at high orders
+    # (measured: energy drift 2.2e-5, orthogonality 9.1e-6 at order 68).
+    if wtype is WaveletType.DAUBECHIES:
+        want_sum, want_energy = np.sqrt(2.0), 1.0
+    else:
+        want_sum, want_energy = 1.0, 0.5
+    tol = 5e-5 if wtype is WaveletType.SYMLET else 1e-8
+    assert np.sum(c) == pytest.approx(want_sum, abs=tol)
+    assert np.sum(c * c) == pytest.approx(want_energy, abs=tol)
+    # double-shift orthogonality survives any scaling
+    for shift in range(2, len(c), 2):
+        assert np.dot(c[:-shift], c[shift:]) == pytest.approx(
+            0.0, abs=max(tol / 2, 1e-8))
+
+
+@settings(**SETTINGS)
+@given(_signal(min_size=3).filter(lambda v: v.max() > v.min()))
+def test_minmax1d_brackets_every_sample(x):
+    mn, mx = nz.minmax1D(x)
+    assert float(mn) == pytest.approx(float(x.min()), abs=1e-6)
+    assert float(mx) == pytest.approx(float(x.max()), abs=1e-6)
+
+
+@settings(**SETTINGS)
+@given(st.integers(1, 1 << 30))
+def test_next_pow2_is_tight(n):
+    from veles.simd_tpu.utils.memory import next_highest_power_of_2
+
+    p = next_highest_power_of_2(n)
+    assert p >= n and p & (p - 1) == 0
+    assert p == 1 or p // 2 < n
+
+
+@settings(**SETTINGS)
+@given(st.lists(st.integers(-32768, 32767), min_size=1, max_size=200))
+def test_int16_float_roundtrip_exact(vals):
+    i16 = np.asarray(vals, np.int16)
+    back = np.asarray(ar.float_to_int16(ar.int16_to_float(i16)))
+    np.testing.assert_array_equal(back, i16)
