@@ -1,0 +1,122 @@
+"""Pallas filter-bank kernel: interpreter-mode cross-validation.
+
+The compiled Mosaic path runs only on real TPU hardware (exercised by
+``bench.py --check``); here the same kernel runs under the Pallas
+interpreter on the CPU test platform and is cross-validated against the
+NumPy oracles — the SIMD-vs-``_na`` discipline of the reference test
+suite (``/root/reference/tests/wavelet.cc:224-250``) applied to the
+hand-written kernel layer.
+"""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu.ops import wavelet as wv
+from veles.simd_tpu.ops.pallas_kernels import filter_bank_pallas
+
+rng = np.random.RandomState(42)
+
+
+def _oracle(x_ext, filters, stride, dilation, n_out):
+    outs = []
+    for ch in filters:
+        o = np.zeros(x_ext.shape[:-1] + (n_out,), np.float32)
+        for i in range(n_out):
+            for j, w in enumerate(ch):
+                o[..., i] += w * x_ext[..., i * stride + j * dilation]
+        outs.append(o)
+    return outs
+
+
+@pytest.mark.parametrize("stride,dilation", [(1, 1), (2, 1), (1, 4)])
+@pytest.mark.parametrize("order", [2, 7, 8])
+def test_filter_bank_matches_oracle(stride, dilation, order):
+    n_out = 32
+    need = (n_out - 1) * stride + (order - 1) * dilation + 1
+    x_ext = rng.randn(3, need + 5).astype(np.float32)
+    filters = rng.randn(2, order).astype(np.float32)
+    got = filter_bank_pallas(x_ext, filters, stride, dilation, n_out,
+                             interpret=True)
+    want = _oracle(x_ext, filters, stride, dilation, n_out)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), w, atol=1e-4)
+
+
+def test_single_channel_direct_conv_shape():
+    # C=1 is the direct-convolution use: y = correlate(x_ext, h)
+    x = rng.randn(4, 50).astype(np.float32)
+    h = rng.randn(1, 9).astype(np.float32)
+    x_ext = np.pad(x, [(0, 0), (8, 8)])
+    (y,) = filter_bank_pallas(x_ext, h, 1, 1, 58, interpret=True)
+    want = np.stack([np.convolve(row, h[0][::-1], mode="full") for row in x])
+    np.testing.assert_allclose(np.asarray(y), want, atol=1e-4)
+
+
+def test_batch_not_multiple_of_tile():
+    # 12 rows -> tile 8, pad 4: exercises _fb_call's pad-and-slice branch
+    # (_tile_rows keeps full 8-sublane tiles, so rows < 9 never pad)
+    from veles.simd_tpu.ops import pallas_kernels as pk
+    x_ext = rng.randn(12, 40).astype(np.float32)
+    f = rng.randn(2, 4).astype(np.float32)
+    assert pk._tile_rows(12, 40 + 2 * 37) == 8   # guard the premise
+    got = filter_bank_pallas(x_ext, f, 1, 1, 37, interpret=True)
+    want = _oracle(x_ext, f, 1, 1, 37)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), w, atol=1e-4)
+
+
+def test_leading_batch_dims_flattened():
+    x_ext = rng.randn(2, 3, 40).astype(np.float32)
+    f = rng.randn(2, 4).astype(np.float32)
+    got = filter_bank_pallas(x_ext, f, 2, 1, 18, interpret=True)
+    assert got[0].shape == (2, 3, 18)
+    want = _oracle(x_ext, f, 2, 1, 18)
+    np.testing.assert_allclose(np.asarray(got[1]), want[1], atol=1e-4)
+
+
+def test_too_short_input_raises():
+    x_ext = rng.randn(3, 10).astype(np.float32)
+    f = rng.randn(2, 8).astype(np.float32)
+    with pytest.raises(ValueError, match="too short"):
+        filter_bank_pallas(x_ext, f, 2, 1, 32, interpret=True)
+
+
+def test_bad_filters_shape_raises():
+    x_ext = rng.randn(3, 64).astype(np.float32)
+    with pytest.raises(ValueError, match="channels"):
+        filter_bank_pallas(x_ext, np.zeros(8, np.float32), 1, 1, 32,
+                           interpret=True)
+
+
+# --------------------------------------------------------------------------
+# integrated wavelet path (gate monkeypatched open; interpret auto-selects
+# the CPU interpreter)
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("ext", list(wv.ExtensionType))
+@pytest.mark.parametrize("type,order", [("daub", 8), ("sym", 6),
+                                        ("coif", 12)])
+def test_wavelet_apply_pallas_vs_oracle(monkeypatch, ext, type, order):
+    monkeypatch.setattr(wv, "_use_pallas", lambda shape: True)
+    src = rng.randn(4, 64).astype(np.float32)
+    hi, lo = wv.wavelet_apply(type, order, ext, src, simd=True)
+    want_hi, want_lo = wv.wavelet_apply_na(type, order, ext, src)
+    np.testing.assert_allclose(np.asarray(hi), want_hi, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(lo), want_lo, atol=5e-4)
+
+
+@pytest.mark.parametrize("level", [1, 2, 3])
+def test_swt_pallas_vs_oracle(monkeypatch, level):
+    monkeypatch.setattr(wv, "_use_pallas", lambda shape: True)
+    src = rng.randn(3, 64).astype(np.float32)
+    hi, lo = wv.stationary_wavelet_apply(
+        "daub", 4, level, wv.ExtensionType.PERIODIC, src, simd=True)
+    want_hi, want_lo = wv.stationary_wavelet_apply_na(
+        "daub", 4, level, wv.ExtensionType.PERIODIC, src)
+    np.testing.assert_allclose(np.asarray(hi), want_hi, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(lo), want_lo, atol=5e-4)
+
+
+def test_pallas_gate_off_on_cpu():
+    # on the CPU test platform the gate must be closed by default
+    assert not wv._use_pallas((512, 4096))

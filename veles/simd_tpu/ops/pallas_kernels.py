@@ -1,0 +1,199 @@
+"""Pallas TPU kernels for the hot VPU ops.
+
+The reference's hand-written per-order AVX/NEON wavelet kernels
+(``/root/reference/src/wavelet.c:384-1941``) exist because the compiler
+could not be trusted with the inner loop; the TPU analog of that layer is
+a hand-written Mosaic kernel where XLA's generic lowering leaves
+bandwidth on the table.  The one place that happens here is the small-FIR
+filter bank: ``lax.conv_general_dilated`` with a 2..76-tap filter lowers
+to an im2col matmul that moves each input sample ``order`` times, while
+the arithmetic is trivially VPU-bound — a shifted-MAC kernel reads each
+sample once from HBM and keeps every intermediate in VMEM.
+
+One kernel family serves all the FIR-shaped ops:
+
+* DWT  — C=2 channels (hi, lo), stride 2, dilation 1
+* SWT  — C=2 channels, stride 1, dilation 2^(level-1)
+* direct convolution / correlation — C=1, stride 1, dilation 1
+  (caller pre-pads and pre-flips, exactly like the XLA path)
+
+The kernel computes, per output channel c::
+
+    out[c][b, i] = sum_j f[c][j] * x_ext[b, i*stride + j*dilation]
+
+with the filter taps baked in as compile-time scalar constants (the VPU
+multiplies a vector register by a scalar immediate — the Pallas analog of
+the reference's unrolled ``_mm256_dp_ps`` loops).
+
+Mosaic does not lower strided vector slices, so decimation never happens
+inside the kernel: for stride s > 1 the input is deinterleaved into s
+phase arrays *outside* (XLA strided slice), the taps are split by parity
+(``f[j]`` lands on phase ``j % s`` at offset ``j // s``), and the kernel
+emits already-decimated outputs — every in-kernel slice is unit-stride.
+
+Boundary extension stays in XLA (``ops/wavelet._extend``): it is a cheap
+concat that XLA fuses into the surrounding program, and keeping it out of
+the kernel keeps the kernel oblivious to the four extension modes.
+
+CPU fallback: ``pallas_call(interpret=True)`` runs the same kernel in the
+interpreter, which is how the unit tests (pinned to the CPU platform by
+``conftest.py``) cross-validate it against the NumPy oracle; the
+compiled Mosaic path is exercised on real hardware by ``bench.py
+--check`` (the TPU smoke gate).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from veles.simd_tpu.utils.config import on_tpu
+
+__all__ = ["filter_bank_pallas", "pallas_available", "PALLAS_MIN_ROWS"]
+
+# the kernel wins when the batch tile fills VPU sublanes; below this the
+# dispatch/layout overhead dominates and the XLA conv path is used
+PALLAS_MIN_ROWS = 8
+# batch rows per grid step: Pallas double-buffers every in/out block, so
+# the steady-state VMEM footprint is ~2*(inputs + outputs) per row plus
+# accumulator temps; budget well under the 16 MB/core limit
+_MAX_ROWS_PER_TILE = 256
+_VMEM_BUDGET_BYTES = 10 << 20   # for 2*(in+out) + temps
+
+
+def pallas_available() -> bool:
+    """Compiled Mosaic path available (real TPU backend)?"""
+    return on_tpu()
+
+
+def _tile_rows(n_rows: int, row_elems: int) -> int:
+    """Rows per grid step given total f32 elements per row (in + out)."""
+    budget_rows = _VMEM_BUDGET_BYTES // (3 * 4 * row_elems)
+    rows = min(n_rows, _MAX_ROWS_PER_TILE, max(1, budget_rows))
+    if rows > 8:
+        rows &= ~7          # keep full 8-sublane tiles
+    return max(rows, 1)
+
+
+def _fb_kernel(*refs, phase_taps, dilation, n_out):
+    """Shifted-MAC filter bank over VMEM tiles, one ref per input phase.
+
+    ``phase_taps[p][c]`` = tap tuple for channel c on phase p
+    (compile-time floats).  ``out[c] = sum_p sum_m phase_taps[p][c][m] *
+    phase_p[:, m*dilation : m*dilation + n_out]`` — all unit-stride.
+    """
+    n_phases = len(phase_taps)
+    in_refs, out_refs = refs[:n_phases], refs[n_phases:]
+    phases = [r[...] for r in in_refs]
+    for c, ref in enumerate(out_refs):
+        acc = None
+        for p, xv in enumerate(phases):
+            for m, w in enumerate(phase_taps[p][c]):
+                t = jax.lax.slice_in_dim(
+                    xv, m * dilation, m * dilation + n_out, axis=1)
+                term = np.float32(w) * t
+                acc = term if acc is None else acc + term
+        ref[...] = acc
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("phase_taps", "dilation", "n_out", "interpret"))
+def _fb_call(phases, phase_taps, dilation, n_out, interpret):
+    n_rows = phases[0].shape[0]
+    n_ch = len(phase_taps[0])
+    row_elems = sum(p.shape[1] for p in phases) + n_ch * n_out
+    rows = _tile_rows(n_rows, row_elems)
+    pad_rows = (-n_rows) % rows
+    if pad_rows:
+        phases = [jnp.pad(p, ((0, pad_rows), (0, 0))) for p in phases]
+    grid = (phases[0].shape[0] // rows,)
+    kernel = functools.partial(_fb_kernel, phase_taps=phase_taps,
+                               dilation=dilation, n_out=n_out)
+    order = sum(len(phase_taps[p][0]) for p in range(len(phase_taps)))
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((rows, p.shape[1]), lambda i: (i, 0))
+                  for p in phases],
+        out_specs=[pl.BlockSpec((rows, n_out), lambda i: (i, 0))] * n_ch,
+        out_shape=[jax.ShapeDtypeStruct((phases[0].shape[0], n_out),
+                                        jnp.float32)] * n_ch,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n_ch * order * phases[0].shape[0] * n_out,
+            bytes_accessed=4 * phases[0].shape[0] * row_elems,
+            transcendentals=0),
+        interpret=interpret,
+    )(*[p.astype(jnp.float32) for p in phases])
+    if pad_rows:
+        outs = [o[:n_rows] for o in outs]
+    return tuple(outs)
+
+
+def _split_phases(filters, stride, dilation, n_out):
+    """Static plan: (phase tap tables, per-phase slice lengths).
+
+    Phase p holds ``x_ext[p::stride]``; tap j of any channel lands on
+    phase ``j % stride`` at offset ``j // stride`` (requires dilation 1
+    when stride > 1 — the DWT case; SWT/direct use stride 1).
+    """
+    order = filters.shape[1]
+    if stride == 1:
+        need = (n_out - 1) + (order - 1) * dilation + 1
+        return (tuple(tuple(float(w) for w in ch) for ch in filters),), \
+            [need], dilation
+    if dilation != 1:
+        raise ValueError("stride > 1 requires dilation == 1")
+    phase_taps = []
+    lengths = []
+    for p in range(stride):
+        taps_p = tuple(tuple(float(w) for w in ch[p::stride])
+                       for ch in filters)
+        n_taps = len(taps_p[0])
+        if n_taps == 0:
+            continue
+        phase_taps.append(taps_p)
+        lengths.append((n_out - 1) + (n_taps - 1) + 1)
+    return tuple(phase_taps), lengths, 1
+
+
+def filter_bank_pallas(x_ext, filters, stride, dilation, n_out,
+                       interpret=None):
+    """Multi-channel FIR filter bank as one Pallas kernel.
+
+    ``x_ext``: [..., n_ext] pre-extended signal (boundary handling is the
+    caller's).  ``filters``: [C, order] static (NumPy) tap matrix.
+    Returns a tuple of C arrays shaped [..., n_out] where
+    ``out[c][..., i] = sum_j filters[c, j] * x_ext[..., i*stride +
+    j*dilation]``.
+
+    ``interpret=None`` auto-selects: compiled Mosaic on TPU, interpreter
+    elsewhere (the CPU test path).
+    """
+    filters = np.asarray(filters, np.float32)
+    if filters.ndim != 2:
+        raise ValueError("filters must be [channels, order]")
+    need = (n_out - 1) * stride + (filters.shape[1] - 1) * dilation + 1
+    if x_ext.shape[-1] < need:
+        raise ValueError(
+            f"x_ext too short: {x_ext.shape[-1]} < {need} for "
+            f"n_out={n_out}, stride={stride}, dilation={dilation}")
+    if interpret is None:
+        interpret = not pallas_available()
+    stride, dilation, n_out = int(stride), int(dilation), int(n_out)
+    batch_shape = x_ext.shape[:-1]
+    x2d = jnp.asarray(x_ext).reshape((-1, x_ext.shape[-1]))
+    phase_taps, lengths, kern_dilation = _split_phases(
+        filters, stride, dilation, n_out)
+    if stride == 1:
+        phases = [x2d[:, :lengths[0]]]
+    else:
+        phases = [x2d[:, p::stride][:, :ln]
+                  for p, ln in zip(range(stride), lengths)]
+    outs = _fb_call(phases, phase_taps, kern_dilation, n_out,
+                    bool(interpret))
+    return tuple(o.reshape(batch_shape + (n_out,)) for o in outs)
