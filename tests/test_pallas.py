@@ -97,7 +97,7 @@ def test_bad_filters_shape_raises():
 @pytest.mark.parametrize("type,order", [("daub", 8), ("sym", 6),
                                         ("coif", 12)])
 def test_wavelet_apply_pallas_vs_oracle(monkeypatch, ext, type, order):
-    monkeypatch.setattr(wv, "_use_pallas", lambda shape: True)
+    monkeypatch.setattr(wv, "_use_pallas", lambda *a: True)
     src = rng.randn(4, 64).astype(np.float32)
     hi, lo = wv.wavelet_apply(type, order, ext, src, simd=True)
     want_hi, want_lo = wv.wavelet_apply_na(type, order, ext, src)
@@ -107,7 +107,7 @@ def test_wavelet_apply_pallas_vs_oracle(monkeypatch, ext, type, order):
 
 @pytest.mark.parametrize("level", [1, 2, 3])
 def test_swt_pallas_vs_oracle(monkeypatch, level):
-    monkeypatch.setattr(wv, "_use_pallas", lambda shape: True)
+    monkeypatch.setattr(wv, "_use_pallas", lambda *a: True)
     src = rng.randn(3, 64).astype(np.float32)
     hi, lo = wv.stationary_wavelet_apply(
         "daub", 4, level, wv.ExtensionType.PERIODIC, src, simd=True)
@@ -119,4 +119,74 @@ def test_swt_pallas_vs_oracle(monkeypatch, level):
 
 def test_pallas_gate_off_on_cpu():
     # on the CPU test platform the gate must be closed by default
-    assert not wv._use_pallas((512, 4096))
+    assert not wv._use_pallas((512, 4096), 8, 1, 2)
+
+
+def test_vmem_gate_rejects_extreme_rows(monkeypatch):
+    # a row too long for a 1-row VMEM tile must stay on the XLA path
+    # (pallas_available forced open to isolate the fits_vmem term)
+    from veles.simd_tpu.ops import convolve as cv
+    from veles.simd_tpu.ops import pallas_kernels as pk
+    monkeypatch.setattr(pk, "pallas_available", lambda: True)
+    assert cv._use_pallas_direct((8, 4096), 65)
+    assert not cv._use_pallas_direct((8, 2_000_000), 65)
+    assert wv._use_pallas((512, 4096), 8, 1, 2)
+    assert not wv._use_pallas((8, 4_000_000), 8, 1, 2)
+
+
+def test_runtime_taps_do_not_bake():
+    # same shapes, different tap values must give different results from
+    # the same compiled kernel (taps are SMEM data, not constants)
+    x_ext = rng.randn(3, 40).astype(np.float32)
+    f1 = rng.randn(1, 4).astype(np.float32)
+    f2 = f1 + 1.0
+    (y1,) = filter_bank_pallas(x_ext, f1, 1, 1, 37, interpret=True)
+    (y2,) = filter_bank_pallas(x_ext, f2, 1, 1, 37, interpret=True)
+    np.testing.assert_allclose(np.asarray(y1), _oracle(x_ext, f1, 1, 1, 37)[0],
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(y2), _oracle(x_ext, f2, 1, 1, 37)[0],
+                               atol=1e-4)
+
+
+# --------------------------------------------------------------------------
+# integrated direct-convolution path (gate monkeypatched open)
+# --------------------------------------------------------------------------
+
+def test_convolve_direct_pallas_vs_oracle(monkeypatch):
+    from veles.simd_tpu.ops import convolve as cv
+    monkeypatch.setattr(cv, "_use_pallas_direct", lambda *a: True)
+    x = rng.randn(4, 100).astype(np.float32)
+    h = rng.randn(17).astype(np.float32)
+    got = np.asarray(cv.convolve_simd(x, h, simd=True))
+    want = cv.convolve_na(x, h)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_correlate_direct_pallas_vs_oracle(monkeypatch):
+    from veles.simd_tpu.ops import convolve as cv
+    from veles.simd_tpu.ops import correlate as cr
+    monkeypatch.setattr(cv, "_use_pallas_direct", lambda *a: True)
+    x = rng.randn(4, 100).astype(np.float32)
+    h = rng.randn(17).astype(np.float32)
+    got = np.asarray(cr.cross_correlate_simd(x, h, simd=True))
+    want = cr.cross_correlate_na(x, h)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_brute_force_handle_routes_pallas(monkeypatch):
+    from veles.simd_tpu.ops import convolve as cv
+    calls = []
+    orig = cv._conv_direct_pallas
+
+    def spy(x, h, reverse=False):
+        calls.append(x.shape)
+        return orig(x, h, reverse=reverse)
+
+    monkeypatch.setattr(cv, "_use_pallas_direct", lambda *a: True)
+    monkeypatch.setattr(cv, "_conv_direct_pallas", spy)
+    x = rng.randn(4, 64).astype(np.float32)
+    h = rng.randn(9).astype(np.float32)
+    handle = cv.convolve_initialize(64, 9, cv.ConvolutionAlgorithm.BRUTE_FORCE)
+    got = np.asarray(cv.convolve(handle, x, h, simd=True))
+    assert calls, "handle BRUTE_FORCE path did not route through pallas"
+    np.testing.assert_allclose(got, cv.convolve_na(x, h), atol=1e-4)

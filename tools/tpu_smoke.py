@@ -185,6 +185,13 @@ def _check_pallas(rng):
                                 simd=True)
     whi, wlo = wv.wavelet_apply_na("daub", 8, wv.ExtensionType.MIRROR, x)
     errs += [_rel_err(bhi, whi), _rel_err(blo, wlo)]
+    # batched direct convolution routes through the C=1 kernel
+    # (convolve._use_pallas_direct) on TPU
+    from veles.simd_tpu.ops import convolve as cv
+
+    hh = rng.randn(65).astype(np.float32)
+    errs.append(_rel_err(cv.convolve_simd(x, hh, simd=True),
+                         cv.convolve_na(x, hh)))
     return max(errs), 5e-4
 
 

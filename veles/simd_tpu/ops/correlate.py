@@ -50,8 +50,7 @@ def cross_correlate_simd(x, h, simd=None):
     if resolve_simd(simd):
         import jax.numpy as jnp
 
-        return _conv._conv_direct(jnp.asarray(x), jnp.asarray(h),
-                                  reverse=True)
+        return _conv._direct(jnp.asarray(x), jnp.asarray(h), reverse=True)
     return cross_correlate_na(x, h)
 
 

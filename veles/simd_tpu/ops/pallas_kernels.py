@@ -4,11 +4,13 @@ The reference's hand-written per-order AVX/NEON wavelet kernels
 (``/root/reference/src/wavelet.c:384-1941``) exist because the compiler
 could not be trusted with the inner loop; the TPU analog of that layer is
 a hand-written Mosaic kernel where XLA's generic lowering leaves
-bandwidth on the table.  The one place that happens here is the small-FIR
-filter bank: ``lax.conv_general_dilated`` with a 2..76-tap filter lowers
+bandwidth on the table.  The place that happens here is the small-FIR
+filter bank: ``lax.conv_general_dilated`` with a 2..256-tap filter lowers
 to an im2col matmul that moves each input sample ``order`` times, while
 the arithmetic is trivially VPU-bound — a shifted-MAC kernel reads each
-sample once from HBM and keeps every intermediate in VMEM.
+sample once from HBM and keeps every intermediate in VMEM.  Measured on
+v5e: 3.0-3.6x on the DWT benchmark workload (512x4096 daub8), 5.6-9.3x
+on batched direct convolution (vs the XLA conv lowering).
 
 One kernel family serves all the FIR-shaped ops:
 
@@ -21,15 +23,20 @@ The kernel computes, per output channel c::
 
     out[c][b, i] = sum_j f[c][j] * x_ext[b, i*stride + j*dilation]
 
-with the filter taps baked in as compile-time scalar constants (the VPU
-multiplies a vector register by a scalar immediate — the Pallas analog of
-the reference's unrolled ``_mm256_dp_ps`` loops).
+The tap *values* live in SMEM (runtime data — a new filter does not
+recompile, matching the library contract that ``h`` is an argument); the
+tap *count* is static and the loop fully unrolled, each step a
+scalar*vector MAC — the Pallas analog of the reference's unrolled
+``_mm256_dp_ps`` loops.  Accumulation goes statement-by-statement into
+the output ref: a single summed expression keeps every tap slice live at
+once and overflows the Mosaic stack for large orders (observed at 33).
 
-Mosaic does not lower strided vector slices, so decimation never happens
-inside the kernel: for stride s > 1 the input is deinterleaved into s
-phase arrays *outside* (XLA strided slice), the taps are split by parity
-(``f[j]`` lands on phase ``j % s`` at offset ``j // s``), and the kernel
-emits already-decimated outputs — every in-kernel slice is unit-stride.
+Mosaic lowers neither strided vector slices nor unaligned dynamic lane
+offsets, so decimation never happens inside the kernel: for stride s > 1
+the input is deinterleaved into s phase arrays *outside* (XLA strided
+slice), the taps are split by parity (``f[j]`` lands on phase ``j % s``
+at offset ``j // s``), and the kernel emits already-decimated outputs —
+every in-kernel slice is unit-stride at a static offset.
 
 Boundary extension stays in XLA (``ops/wavelet._extend``): it is a cheap
 concat that XLA fuses into the surrounding program, and keeping it out of
@@ -37,7 +44,7 @@ the kernel keeps the kernel oblivious to the four extension modes.
 
 CPU fallback: ``pallas_call(interpret=True)`` runs the same kernel in the
 interpreter, which is how the unit tests (pinned to the CPU platform by
-``conftest.py``) cross-validate it against the NumPy oracle; the
+``conftest.py``) cross-validate it against the NumPy oracles; the
 compiled Mosaic path is exercised on real hardware by ``bench.py
 --check`` (the TPU smoke gate).
 """
@@ -50,14 +57,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from veles.simd_tpu.utils.config import on_tpu
 
-__all__ = ["filter_bank_pallas", "pallas_available", "PALLAS_MIN_ROWS"]
+__all__ = ["filter_bank_pallas", "pallas_available", "PALLAS_MIN_ROWS",
+           "PALLAS_DIRECT_MAX_H"]
 
 # the kernel wins when the batch tile fills VPU sublanes; below this the
 # dispatch/layout overhead dominates and the XLA conv path is used
 PALLAS_MIN_ROWS = 8
+# direct-convolution routing bound: the unrolled VPU kernel does k MACs
+# per sample, so very long filters belong to the MXU/FFT algorithms (and
+# unrolled compile time grows with k); measured wins up to k=129 on v5e
+# (5.6-9.3x), bound set with margin
+PALLAS_DIRECT_MAX_H = 256
 # batch rows per grid step: Pallas double-buffers every in/out block, so
 # the steady-state VMEM footprint is ~2*(inputs + outputs) per row plus
 # accumulator temps; budget well under the 16 MB/core limit
@@ -79,47 +93,74 @@ def _tile_rows(n_rows: int, row_elems: int) -> int:
     return max(rows, 1)
 
 
-def _fb_kernel(*refs, phase_taps, dilation, n_out):
-    """Shifted-MAC filter bank over VMEM tiles, one ref per input phase.
+def fits_vmem(row_elems: int) -> bool:
+    """Can a single batch row of ``row_elems`` f32 (inputs + outputs)
+    fit the kernel's VMEM budget?  A row too large for even a 1-row tile
+    would fail Mosaic compilation; :func:`filter_bank_pallas` rejects
+    such shapes at the API boundary and routing gates pre-check via
+    :func:`should_route` to keep them on the XLA path."""
+    return 3 * 4 * row_elems <= _VMEM_BUDGET_BYTES
 
-    ``phase_taps[p][c]`` = tap tuple for channel c on phase p
-    (compile-time floats).  ``out[c] = sum_p sum_m phase_taps[p][c][m] *
-    phase_p[:, m*dilation : m*dilation + n_out]`` — all unit-stride.
+
+def should_route(rows: int, row_elems: int) -> bool:
+    """Single home for the compiled-path routing policy: Mosaic backend
+    available, enough batch rows to fill VPU sublanes, and one row's
+    inputs+outputs (``row_elems`` f32) within the VMEM tile budget.
+    Callers (``wavelet._use_pallas``, ``convolve._use_pallas_direct``)
+    add op-specific terms on top."""
+    return (pallas_available() and rows >= PALLAS_MIN_ROWS
+            and fits_vmem(row_elems))
+
+
+def _fb_kernel(*refs, tap_counts, dilation, n_out):
+    """Shifted-MAC filter bank over VMEM tiles.
+
+    ``refs`` = per-phase SMEM tap refs ([C, n_taps_p]), then per-phase
+    VMEM input tiles, then C output tiles.  ``out[c] = sum_p sum_m
+    taps_p[c, m] * phase_p[:, m*dilation : m*dilation + n_out]`` — all
+    slices unit-stride at static offsets; tap values are runtime SMEM
+    scalars.
     """
-    n_phases = len(phase_taps)
-    in_refs, out_refs = refs[:n_phases], refs[n_phases:]
+    n_phases = len(tap_counts)
+    tap_refs = refs[:n_phases]
+    in_refs = refs[n_phases:2 * n_phases]
+    out_refs = refs[2 * n_phases:]
     phases = [r[...] for r in in_refs]
     for c, ref in enumerate(out_refs):
-        acc = None
+        first = True
         for p, xv in enumerate(phases):
-            for m, w in enumerate(phase_taps[p][c]):
+            for m in range(tap_counts[p]):
                 t = jax.lax.slice_in_dim(
                     xv, m * dilation, m * dilation + n_out, axis=1)
-                term = np.float32(w) * t
-                acc = term if acc is None else acc + term
-        ref[...] = acc
+                term = tap_refs[p][c, m] * t
+                # statement-by-statement accumulation bounds Mosaic
+                # stack temporaries (see module docstring)
+                ref[...] = term if first else ref[...] + term
+                first = False
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("phase_taps", "dilation", "n_out", "interpret"))
-def _fb_call(phases, phase_taps, dilation, n_out, interpret):
+    static_argnames=("tap_counts", "dilation", "n_out", "interpret"))
+def _fb_call(phases, taps, tap_counts, dilation, n_out, interpret):
     n_rows = phases[0].shape[0]
-    n_ch = len(phase_taps[0])
+    n_ch = taps[0].shape[0]
     row_elems = sum(p.shape[1] for p in phases) + n_ch * n_out
     rows = _tile_rows(n_rows, row_elems)
     pad_rows = (-n_rows) % rows
     if pad_rows:
         phases = [jnp.pad(p, ((0, pad_rows), (0, 0))) for p in phases]
     grid = (phases[0].shape[0] // rows,)
-    kernel = functools.partial(_fb_kernel, phase_taps=phase_taps,
+    kernel = functools.partial(_fb_kernel, tap_counts=tap_counts,
                                dilation=dilation, n_out=n_out)
-    order = sum(len(phase_taps[p][0]) for p in range(len(phase_taps)))
+    order = sum(tap_counts)
     outs = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[pl.BlockSpec((rows, p.shape[1]), lambda i: (i, 0))
-                  for p in phases],
+        in_specs=(
+            [pl.BlockSpec(memory_space=pltpu.SMEM)] * len(phases)
+            + [pl.BlockSpec((rows, p.shape[1]), lambda i: (i, 0))
+               for p in phases]),
         out_specs=[pl.BlockSpec((rows, n_out), lambda i: (i, 0))] * n_ch,
         out_shape=[jax.ShapeDtypeStruct((phases[0].shape[0], n_out),
                                         jnp.float32)] * n_ch,
@@ -128,37 +169,34 @@ def _fb_call(phases, phase_taps, dilation, n_out, interpret):
             bytes_accessed=4 * phases[0].shape[0] * row_elems,
             transcendentals=0),
         interpret=interpret,
-    )(*[p.astype(jnp.float32) for p in phases])
+    )(*[t.astype(jnp.float32) for t in taps],
+      *[p.astype(jnp.float32) for p in phases])
     if pad_rows:
         outs = [o[:n_rows] for o in outs]
     return tuple(outs)
 
 
-def _split_phases(filters, stride, dilation, n_out):
-    """Static plan: (phase tap tables, per-phase slice lengths).
+def _phase_plan(order, stride, dilation, n_out):
+    """Static plan: per-phase tap counts + input slice lengths.
 
     Phase p holds ``x_ext[p::stride]``; tap j of any channel lands on
     phase ``j % stride`` at offset ``j // stride`` (requires dilation 1
-    when stride > 1 — the DWT case; SWT/direct use stride 1).
+    when stride > 1 — the DWT case; SWT/direct use stride 1).  Non-empty
+    phases always form a prefix of ``range(stride)`` because tap indices
+    are contiguous from 0.
     """
-    order = filters.shape[1]
     if stride == 1:
-        need = (n_out - 1) + (order - 1) * dilation + 1
-        return (tuple(tuple(float(w) for w in ch) for ch in filters),), \
-            [need], dilation
+        return (order,), [(n_out - 1) + (order - 1) * dilation + 1], dilation
     if dilation != 1:
         raise ValueError("stride > 1 requires dilation == 1")
-    phase_taps = []
-    lengths = []
+    counts, lengths = [], []
     for p in range(stride):
-        taps_p = tuple(tuple(float(w) for w in ch[p::stride])
-                       for ch in filters)
-        n_taps = len(taps_p[0])
+        n_taps = len(range(p, order, stride))
         if n_taps == 0:
-            continue
-        phase_taps.append(taps_p)
-        lengths.append((n_out - 1) + (n_taps - 1) + 1)
-    return tuple(phase_taps), lengths, 1
+            break
+        counts.append(n_taps)
+        lengths.append((n_out - 1) + n_taps)
+    return tuple(counts), lengths, 1
 
 
 def filter_bank_pallas(x_ext, filters, stride, dilation, n_out,
@@ -166,18 +204,19 @@ def filter_bank_pallas(x_ext, filters, stride, dilation, n_out,
     """Multi-channel FIR filter bank as one Pallas kernel.
 
     ``x_ext``: [..., n_ext] pre-extended signal (boundary handling is the
-    caller's).  ``filters``: [C, order] static (NumPy) tap matrix.
-    Returns a tuple of C arrays shaped [..., n_out] where
-    ``out[c][..., i] = sum_j filters[c, j] * x_ext[..., i*stride +
-    j*dilation]``.
+    caller's).  ``filters``: [C, order] tap matrix (runtime data — only
+    its *shape* keys the compile cache).  Returns a tuple of C arrays
+    shaped [..., n_out] where ``out[c][..., i] = sum_j filters[c, j] *
+    x_ext[..., i*stride + j*dilation]``.
 
     ``interpret=None`` auto-selects: compiled Mosaic on TPU, interpreter
     elsewhere (the CPU test path).
     """
-    filters = np.asarray(filters, np.float32)
+    filters = jnp.asarray(filters, jnp.float32)
     if filters.ndim != 2:
         raise ValueError("filters must be [channels, order]")
-    need = (n_out - 1) * stride + (filters.shape[1] - 1) * dilation + 1
+    order = filters.shape[1]
+    need = (n_out - 1) * stride + (order - 1) * dilation + 1
     if x_ext.shape[-1] < need:
         raise ValueError(
             f"x_ext too short: {x_ext.shape[-1]} < {need} for "
@@ -187,13 +226,21 @@ def filter_bank_pallas(x_ext, filters, stride, dilation, n_out,
     stride, dilation, n_out = int(stride), int(dilation), int(n_out)
     batch_shape = x_ext.shape[:-1]
     x2d = jnp.asarray(x_ext).reshape((-1, x_ext.shape[-1]))
-    phase_taps, lengths, kern_dilation = _split_phases(
-        filters, stride, dilation, n_out)
+    tap_counts, lengths, kern_dilation = _phase_plan(
+        order, stride, dilation, n_out)
+    n_ch = filters.shape[0]
+    if not interpret and not fits_vmem(sum(lengths) + n_ch * n_out):
+        raise ValueError(
+            f"row of {sum(lengths) + n_ch * n_out} f32 elements exceeds "
+            "the kernel VMEM tile budget even at 1 row/tile; keep this "
+            "shape on the XLA path (see should_route)")
     if stride == 1:
         phases = [x2d[:, :lengths[0]]]
+        taps = [filters]
     else:
         phases = [x2d[:, p::stride][:, :ln]
                   for p, ln in zip(range(stride), lengths)]
-    outs = _fb_call(phases, phase_taps, kern_dilation, n_out,
+        taps = [filters[:, p::stride] for p in range(len(tap_counts))]
+    outs = _fb_call(phases, taps, tap_counts, kern_dilation, n_out,
                     bool(interpret))
     return tuple(o.reshape(batch_shape + (n_out,)) for o in outs)

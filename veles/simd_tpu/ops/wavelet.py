@@ -146,27 +146,31 @@ def _filter_bank(x, hi, lo, ext, stride, dilation, out_len):
     return out[..., 0, :], out[..., 1, :]
 
 
-def _use_pallas(src_shape) -> bool:
+def _use_pallas(src_shape, order, dilation, stride) -> bool:
     """Route batched transforms through the hand-written Mosaic kernel.
 
     The Pallas shifted-MAC kernel (:mod:`ops.pallas_kernels`) reads each
     sample once where the XLA conv lowering reads it ``order`` times —
     measured 3.6x on the BASELINE config-5 workload (512x4096 daub8,
     12.1 -> 43.2 GSamples/s on v5e).  It needs enough batch rows to fill
-    VPU sublanes; single-signal calls stay on the XLA conv path.
-    Tests monkeypatch this gate to exercise the kernel in interpret mode
-    on CPU.
+    VPU sublanes and a signal short enough that one row fits the kernel's
+    VMEM tile budget; single-signal and extreme-length calls stay on the
+    XLA conv path.  Tests monkeypatch this gate to exercise the kernel
+    in interpret mode on CPU.
     """
     rows = int(np.prod(src_shape[:-1])) if len(src_shape) > 1 else 1
-    return _pk.pallas_available() and rows >= _pk.PALLAS_MIN_ROWS
+    n = src_shape[-1]
+    row_elems = (n + order * dilation) + 2 * (n // stride)  # x_ext + hi+lo
+    return _pk.should_route(rows, row_elems)
 
 
 @functools.partial(jax.jit, static_argnames=("type", "order", "ext",
                                              "stride", "dilation",
                                              "out_len"))
 def _filter_bank_pallas(x, type, order, ext, stride, dilation, out_len):
-    """DWT/SWT via the Pallas shifted-MAC kernel (taps are compile-time
-    constants, so (type, order) is part of the jit cache key)."""
+    """DWT/SWT via the Pallas shifted-MAC kernel.  Tap values are runtime
+    SMEM data; (type, order) is static here only because the coefficient
+    lookup and the extension length depend on it."""
     hi, lo = _filters(type, order)
     x_ext = _extend(x.astype(jnp.float32), ext, order * dilation, jnp)
     return _pk.filter_bank_pallas(x_ext, np.stack([hi, lo]), stride,
@@ -230,7 +234,7 @@ def wavelet_apply(type, order, ext, src, simd=None):
         return wavelet_apply_na(type, order, ext, src)
     src = jnp.asarray(src)
     _check_apply_args(type, order, src.shape[-1])
-    if _use_pallas(src.shape):
+    if _use_pallas(src.shape, int(order), 1, 2):
         return _filter_bank_pallas(src, WaveletType(type), int(order),
                                    ExtensionType(ext), 2, 1,
                                    src.shape[-1] // 2)
@@ -249,7 +253,7 @@ def stationary_wavelet_apply(type, order, level, ext, src, simd=None):
     _check_apply_args(type, order, src.shape[-1])
     if level < 1:
         raise ValueError("level must be >= 1")
-    if _use_pallas(src.shape):
+    if _use_pallas(src.shape, int(order), 1 << (level - 1), 1):
         return _filter_bank_pallas(src, WaveletType(type), int(order),
                                    ExtensionType(ext), 1, 1 << (level - 1),
                                    src.shape[-1])
