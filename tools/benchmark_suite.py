@@ -102,6 +102,45 @@ def main():
             samples=xlen,
             baseline_repeats=1 if xlen >= 1 << 17 else 3)
 
+    # --- 1M conv at conv_precision="high" (3-pass MXU; ~1.3e-5 rel err,
+    # inside every correctness gate — the documented fast knob) ---
+    if not quick:
+        from veles.simd_tpu.utils.config import get_config, set_config
+
+        xlen, hlen = 1 << 20, 2047
+        x = rng.randn(xlen).astype(np.float32)
+        h = rng.randn(hlen).astype(np.float32)
+        xd, hd = jnp.asarray(x), jnp.asarray(h)
+        handle = cv.convolve_initialize(xlen, hlen)
+        prev = get_config().conv_precision
+        set_config(conv_precision="high")
+        try:
+            def conv_hi_step(v, handle=handle, hd=hd, xlen=xlen):
+                y = cv.convolve(handle, v, hd, simd=True)
+                return v + 1e-30 * y[..., :xlen]
+
+            benchmark(
+                f"convolve {xlen}x{hlen} [overlap_save, precision=high]",
+                conv_hi_step, xd,
+                lambda: cv.convolve(handle, x, h, simd=False),
+                samples=xlen, baseline_repeats=1)
+        finally:
+            set_config(conv_precision=prev)
+
+    # --- batched direct convolution (Pallas shifted-MAC path on TPU,
+    # XLA conv lowering elsewhere; tests/convolve.cc brute-force form) ---
+    xb = rng.randn(256, 4096).astype(np.float32)
+    hb = rng.randn(129).astype(np.float32)
+    xbd, hbd = jnp.asarray(xb), jnp.asarray(hb)
+
+    def bconv_step(v):
+        y = cv.convolve_simd(v, hbd, simd=True)
+        return v + 1e-30 * y[..., :4096]
+
+    benchmark("convolve batched 256x4096x129 [direct]",
+              bconv_step, xbd, lambda: cv.convolve_na(xb, hb),
+              samples=xb.size, baseline_repeats=1)
+
     # --- GEMM straight vs transposed (tests/matrix.cc:206-288) ---
     # the step folds the [300, 1000] product back to the [300, 256] input
     # shape as a sum of overlapping column slices; every output column is
