@@ -120,3 +120,37 @@ def test_contract_violation():
         dp.detect_peaks(np.zeros(2, np.float32), simd=True)
     with pytest.raises(ValueError):
         dp.detect_peaks_na(np.zeros(1, np.float32))
+
+
+def test_compaction_routes_agree():
+    """The top_k route (max_peaks <= n/4) and the rank-scatter route must
+    produce identical outputs for the same capacity."""
+    import jax.numpy as jnp
+
+    x = RNG.randn(3, 512).astype(np.float32)
+    for t in (dp.ExtremumType.BOTH, dp.ExtremumType.MAXIMUM):
+        mask = np.asarray(dp._peak_mask(jnp.asarray(x), t))
+        cap = 64  # 64*4 <= 512 -> the fixed path takes top_k
+        pos_tk, val_tk, cnt_tk = dp._compact_topk(
+            jnp.asarray(mask), jnp.asarray(x), cap)
+        pos_sc = np.stack([np.asarray(dp._compact_row(
+            jnp.asarray(mask[b]), jnp.asarray(x[b]), cap)[0])
+            for b in range(3)])
+        val_sc = np.stack([np.asarray(dp._compact_row(
+            jnp.asarray(mask[b]), jnp.asarray(x[b]), cap)[1])
+            for b in range(3)])
+        np.testing.assert_array_equal(np.asarray(pos_tk), pos_sc)
+        np.testing.assert_allclose(np.asarray(val_tk), val_sc)
+        np.testing.assert_array_equal(np.asarray(cnt_tk),
+                                      mask.sum(axis=-1))
+
+
+def test_topk_route_vs_oracle_large():
+    """End-to-end top_k route (small cap, long signal) vs the oracle."""
+    x = np.cumsum(RNG.randn(4096)).astype(np.float32)
+    pos, vals, count = dp.detect_peaks_fixed(x, dp.ExtremumType.BOTH,
+                                             max_peaks=128)
+    pos_na, val_na = dp.detect_peaks_na(x, dp.ExtremumType.BOTH)
+    assert int(count) == len(pos_na)
+    np.testing.assert_array_equal(np.asarray(pos), pos_na[:128])
+    np.testing.assert_allclose(np.asarray(vals), val_na[:128])

@@ -81,10 +81,39 @@ def _compact_row(mask, data, max_peaks):
     return positions[:max_peaks], values[:max_peaks]
 
 
+# compaction-route crossover: top_k wins while max_peaks <= n/4, the
+# rank-scatter wins at larger capacities (measured on v5e, 1M signal:
+# top_k 1.1-3.0 ms vs scatter's flat ~5.2 ms up to n/4; 8.6 vs 5.2 ms at
+# full capacity)
+_TOPK_CAP_FRACTION = 4
+
+
+def _compact_topk(mask, data, max_peaks):
+    """Small-capacity compaction via ``lax.top_k`` (TPU-optimized sort
+    network): peak indices are the top ``max_peaks`` of ``n - idx`` over
+    peaks only, which yields them in ascending order.  O(n log k) but
+    wins over the O(n) rank-scatter because XLA's TPU scatter is serial.
+    """
+    n = mask.shape[-1]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    keys = jnp.where(mask, idx, n)              # non-peaks sort last
+    vals_k, _ = jax.lax.top_k(n - keys, max_peaks)
+    pos = n - vals_k                            # ascending peak indices
+    count = jnp.sum(mask, axis=-1)
+    valid = jnp.arange(max_peaks) < count[..., None]
+    positions = jnp.where(valid, pos, -1).astype(jnp.int32)
+    values = jnp.where(
+        valid, jnp.take_along_axis(data, pos.clip(0, n - 1), axis=-1),
+        jnp.zeros((), data.dtype))
+    return positions, values, count
+
+
 @functools.partial(jax.jit, static_argnames=("type", "max_peaks"))
 def _peaks_fixed(data, type, max_peaks):
     mask = _peak_mask(data, type)
     n = data.shape[-1]
+    if max_peaks * _TOPK_CAP_FRACTION <= n:
+        return _compact_topk(mask, data, max_peaks)
     count = jnp.sum(mask, axis=-1)
     flat_mask = mask.reshape(-1, n)
     flat_data = data.reshape(-1, n)
