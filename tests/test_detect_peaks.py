@@ -133,12 +133,10 @@ def test_compaction_routes_agree():
         cap = 64  # 64*4 <= 512 -> the fixed path takes top_k
         pos_tk, val_tk, cnt_tk = dp._compact_topk(
             jnp.asarray(mask), jnp.asarray(x), cap)
-        pos_sc = np.stack([np.asarray(dp._compact_row(
-            jnp.asarray(mask[b]), jnp.asarray(x[b]), cap)[0])
-            for b in range(3)])
-        val_sc = np.stack([np.asarray(dp._compact_row(
-            jnp.asarray(mask[b]), jnp.asarray(x[b]), cap)[1])
-            for b in range(3)])
+        rows = [dp._compact_row(jnp.asarray(mask[b]), jnp.asarray(x[b]), cap)
+                for b in range(3)]
+        pos_sc = np.stack([np.asarray(r[0]) for r in rows])
+        val_sc = np.stack([np.asarray(r[1]) for r in rows])
         np.testing.assert_array_equal(np.asarray(pos_tk), pos_sc)
         np.testing.assert_allclose(np.asarray(val_tk), val_sc)
         np.testing.assert_array_equal(np.asarray(cnt_tk),

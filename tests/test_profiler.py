@@ -1,0 +1,59 @@
+"""Profiler + persistent compilation cache (utils/profiler.py)."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu.utils import profiler
+
+
+def test_trace_writes_artifacts(tmp_path):
+    import jax.numpy as jnp
+
+    log_dir = str(tmp_path / "trace")
+    with profiler.trace(log_dir):
+        with profiler.annotate("veles-test-span"):
+            (jnp.arange(128.0) * 2).block_until_ready()
+    hits = glob.glob(os.path.join(log_dir, "**", "*.xplane.pb"),
+                     recursive=True)
+    assert hits, f"no xplane artifacts under {log_dir}"
+
+
+def test_annotate_outside_trace_is_noop():
+    with profiler.annotate("orphan"):
+        pass
+
+
+@pytest.fixture
+def _restore_cache_config():
+    """Snapshot/restore every jax config knob enable_compilation_cache
+    mutates, so tests stay order-independent."""
+    import jax
+
+    keys = ("jax_compilation_cache_dir",
+            "jax_persistent_cache_min_entry_size_bytes",
+            "jax_persistent_cache_min_compile_time_secs")
+    saved = {k: getattr(jax.config, k) for k in keys}
+    yield
+    for k, v in saved.items():
+        jax.config.update(k, v)
+
+
+def test_enable_compilation_cache_populates(tmp_path, _restore_cache_config):
+    import jax
+    import jax.numpy as jnp
+
+    cache_dir = profiler.enable_compilation_cache(str(tmp_path / "cache"))
+    # a shape unlikely to be compiled elsewhere in the suite
+    x = jnp.asarray(np.random.randn(7, 131).astype(np.float32))
+    jax.jit(lambda v: jnp.tanh(v) @ v.T)(x).block_until_ready()
+    entries = os.listdir(cache_dir)
+    assert entries, "compilation cache stayed empty"
+
+
+def test_cache_dir_env_default(tmp_path, monkeypatch, _restore_cache_config):
+    monkeypatch.setenv("VELES_SIMD_CACHE_DIR", str(tmp_path / "envcache"))
+    assert profiler.enable_compilation_cache() == str(tmp_path / "envcache")
+    assert os.path.isdir(str(tmp_path / "envcache"))

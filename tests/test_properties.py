@@ -113,3 +113,73 @@ def test_int16_float_roundtrip_exact(vals):
     i16 = np.asarray(vals, np.int16)
     back = np.asarray(ar.float_to_int16(ar.int16_to_float(i16)))
     np.testing.assert_array_equal(back, i16)
+
+
+# --------------------------------------------------------------------------
+# Pallas filter-bank kernel invariants (interpret mode on CPU)
+# --------------------------------------------------------------------------
+
+def _fb(x_ext, filters, stride, dilation, n_out):
+    from veles.simd_tpu.ops.pallas_kernels import filter_bank_pallas
+
+    return [np.asarray(o) for o in filter_bank_pallas(
+        x_ext, filters, stride, dilation, n_out, interpret=True)]
+
+
+@settings(max_examples=10, deadline=None)
+@given(st.integers(0, 2 ** 31 - 1), st.integers(2, 9),
+       st.sampled_from([1, 2]), st.floats(-3, 3, width=32))
+def test_pallas_filter_bank_is_linear(seed, order, stride, alpha):
+    rng = np.random.RandomState(seed)
+    n_out = 24
+    need = (n_out - 1) * stride + order
+    x_ext = rng.randn(3, need).astype(np.float32)
+    f = rng.randn(2, order).astype(np.float32)
+    base = _fb(x_ext, f, stride, 1, n_out)
+    scaled = _fb((alpha * x_ext).astype(np.float32), f, stride, 1, n_out)
+    for b, s in zip(base, scaled):
+        np.testing.assert_allclose(s, alpha * b, atol=5e-3)
+
+
+@settings(max_examples=10, deadline=None)
+@given(st.integers(0, 2 ** 31 - 1), st.integers(2, 9))
+def test_pallas_superposition_over_channels(seed, order):
+    """A 2-channel call equals two 1-channel calls (channels independent)."""
+    rng = np.random.RandomState(seed)
+    n_out = 24
+    x_ext = rng.randn(2, n_out + order).astype(np.float32)
+    f = rng.randn(2, order).astype(np.float32)
+    both = _fb(x_ext, f, 1, 1, n_out)
+    solo0 = _fb(x_ext, f[:1], 1, 1, n_out)[0]
+    solo1 = _fb(x_ext, f[1:], 1, 1, n_out)[0]
+    np.testing.assert_allclose(both[0], solo0, atol=1e-5)
+    np.testing.assert_allclose(both[1], solo1, atol=1e-5)
+
+
+@settings(max_examples=10, deadline=None)
+@given(st.integers(0, 2 ** 31 - 1), st.integers(1, 8))
+def test_pallas_shift_equivariance(seed, shift):
+    """Shifting the input by `shift` shifts a stride-1 output by `shift`."""
+    rng = np.random.RandomState(seed)
+    order, n_out = 5, 32
+    x_ext = rng.randn(2, n_out + order + shift).astype(np.float32)
+    f = rng.randn(1, order).astype(np.float32)
+    (full,) = _fb(x_ext, f, 1, 1, n_out + shift)
+    (shifted,) = _fb(x_ext[:, shift:], f, 1, 1, n_out)
+    np.testing.assert_allclose(shifted, full[:, shift:], atol=1e-5)
+
+
+@settings(max_examples=8, deadline=None)
+@given(st.integers(0, 2 ** 31 - 1), st.integers(2, 6), st.integers(1, 3))
+def test_pallas_dilation_equals_upsampled_taps(seed, order, dilation):
+    """Dilated taps == zero-upsampled taps at dilation 1 (a-trous identity,
+    src/wavelet.c:211-246)."""
+    rng = np.random.RandomState(seed)
+    n_out = 16
+    x_ext = rng.randn(2, n_out + order * dilation + 2).astype(np.float32)
+    f = rng.randn(1, order).astype(np.float32)
+    up = np.zeros((1, (order - 1) * dilation + 1), np.float32)
+    up[0, ::dilation] = f[0]
+    (dil,) = _fb(x_ext, f, 1, dilation, n_out)
+    (ups,) = _fb(x_ext, up, 1, 1, n_out)
+    np.testing.assert_allclose(dil, ups, atol=1e-5)
