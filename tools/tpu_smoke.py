@@ -124,6 +124,12 @@ def _check_wavelet(rng):
     shi_na, slo_na = wv.stationary_wavelet_apply_na(
         WaveletType.DAUBECHIES, 8, 2, wv.ExtensionType.PERIODIC, x)
     errs += [_rel_err(shi, shi_na), _rel_err(slo, slo_na)]
+    # synthesis: perfect reconstruction on-device (periodic adjoint)
+    phi, plo = wv.wavelet_apply(
+        WaveletType.DAUBECHIES, 8, wv.ExtensionType.PERIODIC, x, simd=True)
+    rec = wv.wavelet_reconstruct(WaveletType.DAUBECHIES, 8, phi, plo,
+                                 simd=True)
+    errs.append(_rel_err(rec, x))
     return max(errs), 5e-4  # tests/wavelet.cc:84-86 epsilon
 
 
