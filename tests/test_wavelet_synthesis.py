@@ -94,3 +94,23 @@ def test_contract_violations():
         wv.stationary_wavelet_reconstruct("daub", 8, 0, hi, hi)
     with pytest.raises(ValueError, match="hi_1"):
         wv.wavelet_inverse_transform("daub", 8, [hi])
+
+
+@pytest.mark.parametrize("simd", [True, False])
+def test_minimum_signal_round_trip(simd):
+    # length-2 signal -> length-1 bands: the degenerate lhs-dilated conv
+    # used to NaN on the TPU lowering (clamped to dilation 1 now)
+    x = np.float32([1, 2])
+    hi, lo = wv.wavelet_apply("daub", 2, EXT, x, simd=simd)
+    rec = wv.wavelet_reconstruct("daub", 2, hi, lo, simd=simd)
+    np.testing.assert_allclose(np.asarray(rec), x, atol=1e-5)
+
+
+def test_cascade_to_length_one_lowpass():
+    # 512 samples, 9 levels: the deepest reconstruct runs on length-1
+    # bands (the degenerate case the dilation clamp guards)
+    x = RNG.randn(512).astype(np.float32)
+    coeffs = wv.wavelet_transform("daub", 2, EXT, x, 9, simd=True)
+    assert coeffs[-1].shape == (1,)
+    rec = wv.wavelet_inverse_transform("daub", 2, coeffs, simd=True)
+    np.testing.assert_allclose(np.asarray(rec), x, atol=2e-3)

@@ -313,6 +313,12 @@ def stationary_wavelet_transform(type, order, ext, src, levels, simd=None):
 # AᵀA = 2c²·I, hence the extra ½.
 
 
+def _c2(lo_f) -> np.float32:
+    """Filter energy Σ lowpass² — the analysis operator's scale² (single
+    home for the normalization used by every synthesis path)."""
+    return np.float32(np.sum(np.asarray(lo_f, np.float64) ** 2))
+
+
 def _synth_conv(hi_band, lo_band, fh, fl, lhs_dil, rhs_dil, out_len, xp):
     """Shared synthesis kernel: y = conv(up_{lhs_dil}(hi), dil_{rhs_dil}(fh))
     + (same for lo), tail folded mod ``out_len`` (periodic adjoint)."""
@@ -320,6 +326,11 @@ def _synth_conv(hi_band, lo_band, fh, fl, lhs_dil, rhs_dil, out_len, xp):
     pad = (order - 1) * rhs_dil
     batch_shape = hi_band.shape[:-1]
     m = hi_band.shape[-1]
+    if m == 1:
+        # dilating a singleton is the identity; the degenerate
+        # lhs-dilated conv miscompiles on the TPU lowering (NaNs), so
+        # clamp it away — output length is unchanged
+        lhs_dil = 1
     if xp is np:
         def up(a):
             if lhs_dil == 1:
@@ -365,19 +376,17 @@ def _synth_conv(hi_band, lo_band, fh, fl, lhs_dil, rhs_dil, out_len, xp):
 @functools.partial(jax.jit, static_argnames=("type", "order"))
 def _dwt_synth(hi_band, lo_band, type, order):
     hi_f, lo_f = _filters(type, order)
-    c2 = np.float32(np.sum(np.asarray(lo_f, np.float64) ** 2))
     out = _synth_conv(hi_band, lo_band, jnp.asarray(hi_f), jnp.asarray(lo_f),
                       2, 1, 2 * hi_band.shape[-1], jnp)
-    return (out / c2).astype(jnp.float32)
+    return (out / _c2(lo_f)).astype(jnp.float32)
 
 
 @functools.partial(jax.jit, static_argnames=("type", "order", "level"))
 def _swt_synth(hi_band, lo_band, type, order, level):
     hi_f, lo_f = _filters(type, order)
-    c2 = np.float32(np.sum(np.asarray(lo_f, np.float64) ** 2))
     out = _synth_conv(hi_band, lo_band, jnp.asarray(hi_f), jnp.asarray(lo_f),
                       1, 1 << (level - 1), hi_band.shape[-1], jnp)
-    return (out / (2 * c2)).astype(jnp.float32)
+    return (out / (2 * _c2(lo_f))).astype(jnp.float32)
 
 
 def _check_synth_args(type, order, hi_band, lo_band):
@@ -411,7 +420,7 @@ def wavelet_reconstruct_na(type, order, desthi, destlo):
     destlo = np.asarray(destlo, np.float32)
     _check_synth_args(type, order, desthi, destlo)
     hi_f, lo_f = _filters(type, order)
-    c2 = np.sum(np.asarray(lo_f, np.float64) ** 2)
+    c2 = _c2(lo_f)
     out = _synth_conv(desthi, destlo, hi_f, lo_f, 2, 1,
                       2 * desthi.shape[-1], np)
     return (out / c2).astype(np.float32)
@@ -441,7 +450,7 @@ def stationary_wavelet_reconstruct_na(type, order, level, desthi, destlo):
     if level < 1:
         raise ValueError("level must be >= 1")
     hi_f, lo_f = _filters(type, order)
-    c2 = np.sum(np.asarray(lo_f, np.float64) ** 2)
+    c2 = _c2(lo_f)
     out = _synth_conv(desthi, destlo, hi_f, lo_f, 1, 1 << (level - 1),
                       desthi.shape[-1], np)
     return (out / (2 * c2)).astype(np.float32)
