@@ -1,0 +1,84 @@
+"""StreamingConvolution: chunked output must equal one-shot convolve."""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu.ops import convolve as cv
+
+RNG = np.random.RandomState(5)
+
+
+def _stream(x, h, chunk, **kw):
+    sc = cv.StreamingConvolution(h, chunk, **kw)
+    n = x.shape[-1]
+    assert n % chunk == 0
+    parts = [np.asarray(sc.process(x[..., i:i + chunk]))
+             for i in range(0, n, chunk)]
+    parts.append(np.asarray(sc.flush()))
+    return np.concatenate(parts, axis=-1)
+
+
+@pytest.mark.parametrize("k", [1, 2, 17, 63, 129])  # 129 > chunk 64:
+# the carry is longer than a whole chunk (hardest state-carry regime)
+@pytest.mark.parametrize("chunk", [64, 256])
+def test_matches_one_shot(k, chunk):
+    x = RNG.randn(512).astype(np.float32)
+    h = RNG.randn(k).astype(np.float32)
+    got = _stream(x, h, chunk)
+    want = cv.convolve_na(x, h)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_batched_stream():
+    x = RNG.randn(4, 256).astype(np.float32)
+    h = RNG.randn(9).astype(np.float32)
+    got = _stream(x, h, 64)
+    want = cv.convolve_na(x, h)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_reverse_streams_correlation():
+    from veles.simd_tpu.ops import correlate as cr
+
+    x = RNG.randn(256).astype(np.float32)
+    h = RNG.randn(17).astype(np.float32)
+    got = _stream(x, h, 64, reverse=True)
+    want = cr.cross_correlate_na(x, h)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_oracle_backend_stream():
+    x = RNG.randn(256).astype(np.float32)
+    h = RNG.randn(17).astype(np.float32)
+    got = _stream(x, h, 64, simd=False)
+    np.testing.assert_allclose(got, cv.convolve_na(x, h), atol=1e-5)
+
+
+def test_chunk_length_contract():
+    sc = cv.StreamingConvolution(np.ones(4, np.float32), 32)
+    with pytest.raises(ValueError, match="chunk length"):
+        sc.process(np.zeros(16, np.float32))
+
+
+def test_flush_twice_raises():
+    sc = cv.StreamingConvolution(np.ones(4, np.float32), 8)
+    sc.process(np.zeros(8, np.float32))
+    sc.flush()
+    with pytest.raises(ValueError, match="flushed"):
+        sc.flush()
+    with pytest.raises(ValueError, match="flushed"):
+        sc.process(np.zeros(8, np.float32))
+
+
+def test_batch_shape_change_raises():
+    sc = cv.StreamingConvolution(np.ones(4, np.float32), 8)
+    sc.process(np.zeros((2, 8), np.float32))
+    with pytest.raises(ValueError, match="batch shape"):
+        sc.process(np.zeros((3, 8), np.float32))
+
+
+def test_empty_stream_flush():
+    sc = cv.StreamingConvolution(np.ones(4, np.float32), 8)
+    out = np.asarray(sc.flush())
+    assert out.shape == (0,)

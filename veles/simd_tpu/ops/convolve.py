@@ -61,7 +61,7 @@ __all__ = [
     "convolve_overlap_save_finalize",
     "convolve", "convolve_initialize", "convolve_finalize",
     "overlap_save_block_length", "tpu_block_length", "select_algorithm",
-    "os_precision",
+    "os_precision", "StreamingConvolution",
 ]
 
 
@@ -548,3 +548,106 @@ def convolve(handle_or_x, x_or_h, h=None, simd=None):
 
 def convolve_finalize(handle):
     """No-op (``src/convolve.c:368-379``)."""
+
+
+# --------------------------------------------------------------------------
+# streaming convolution — NEW capability beyond the reference
+# --------------------------------------------------------------------------
+
+class StreamingConvolution:
+    """Chunked streaming convolution with carried overlap state.
+
+    The reference's overlap-save decomposes one in-memory signal into
+    blocks (``src/convolve.c:181-228``); streaming is the same
+    decomposition over *time* — chunks arrive one at a time, the state
+    between calls is the last ``h_length - 1`` input samples, and the
+    concatenated outputs equal the one-shot full convolution exactly::
+
+        sc = StreamingConvolution(h, chunk_length=4096)
+        ys = [sc.process(c) for c in chunks]   # len(c) == chunk_length
+        ys.append(sc.flush())                  # final h_length-1 samples
+        # jnp.concatenate(ys) == convolve(x, h)
+
+    Every ``process`` call reuses one compiled executable (fixed chunk
+    length ⇒ one jit cache entry); chunks may carry leading batch dims,
+    fixed across calls.  ``reverse=True`` streams cross-correlation,
+    mirroring ``src/correlate.c:37-72``.
+    """
+
+    def __init__(self, h, chunk_length: int, *, reverse: bool = False,
+                 simd=None):
+        self._h = np.asarray(h, np.float32)
+        if self._h.ndim != 1:
+            raise ValueError("h must be 1D")
+        self._k = int(self._h.shape[-1])
+        self._chunk_length = int(chunk_length)
+        if self._chunk_length < 1:
+            raise ValueError("chunk_length must be positive")
+        self._reverse = bool(reverse)
+        # backend resolved ONCE at construction (a stateful stream must
+        # not switch backends mid-flight); the oracle path then stays
+        # pure NumPy — no jax import/backend init at all
+        self._use_xla = resolve_simd(simd)
+        self._xp = jnp if self._use_xla else np
+        # per-chunk plan through the module's auto-select (overlap-save /
+        # FFT / direct all reuse one compiled executable per shape)
+        k = self._k
+        self._chunk_handle = convolve_initialize(
+            self._chunk_length + k - 1, k, reverse=reverse) \
+            if k > 1 else convolve_initialize(self._chunk_length, k,
+                                              reverse=reverse)
+        self._flush_handle = convolve_initialize(k - 1, k, reverse=reverse) \
+            if k > 1 else None
+        self._carry = None          # [..., k-1] trailing input samples
+        self._done = False
+
+    @property
+    def h_length(self) -> int:
+        return self._k
+
+    @property
+    def chunk_length(self) -> int:
+        return self._chunk_length
+
+    def process(self, chunk):
+        """Feed the next ``chunk_length`` samples; returns the same count
+        of output samples (the convolution is causal: output t depends on
+        inputs ≤ t)."""
+        if self._done:
+            raise ValueError("stream already flushed")
+        xp = self._xp
+        chunk = xp.asarray(chunk, xp.float32)
+        if chunk.shape[-1] != self._chunk_length:
+            raise ValueError(
+                f"chunk length {chunk.shape[-1]} != {self._chunk_length} "
+                "(fixed so every call reuses one compiled executable)")
+        k = self._k
+        if self._carry is None:
+            self._carry = xp.zeros(chunk.shape[:-1] + (k - 1,), xp.float32)
+        if self._carry.shape[:-1] != chunk.shape[:-1]:
+            raise ValueError(
+                f"batch shape changed mid-stream: {chunk.shape[:-1]} vs "
+                f"{self._carry.shape[:-1]}")
+        if k == 1:
+            return _run(self._chunk_handle, chunk, self._h,
+                        simd=self._use_xla)
+        x_ext = xp.concatenate([self._carry, chunk], axis=-1)
+        full = _run(self._chunk_handle, x_ext, self._h, simd=self._use_xla)
+        self._carry = x_ext[..., -(k - 1):]
+        return full[..., k - 1:k - 1 + self._chunk_length]
+
+    def flush(self):
+        """Emit the final ``h_length - 1`` output samples (the tail that
+        depends only on already-seen inputs).  The stream cannot be used
+        afterwards."""
+        if self._done:
+            raise ValueError("stream already flushed")
+        self._done = True
+        k = self._k
+        if self._carry is None or k == 1:
+            shape = ((0,) if self._carry is None
+                     else self._carry.shape[:-1] + (0,))
+            return self._xp.zeros(shape, self._xp.float32)
+        full = _run(self._flush_handle, self._carry, self._h,
+                    simd=self._use_xla)
+        return full[..., k - 1:]
