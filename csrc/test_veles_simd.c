@@ -160,6 +160,24 @@ static void test_convolve(void) {
     CHECK_NEAR(out[i], cwant[i], 1e-3);
   }
   free(cwant);
+
+  /* streaming: chunked outputs + tail must equal the one-shot result */
+  size_t chunk = 250;
+  VelesStreamingConvolution *sc =
+      streaming_convolve_initialize(hs, k, chunk, 0, 1);
+  CHECK(sc != NULL);
+  float *sout = mallocf(n + k - 1);
+  for (size_t i = 0; i < n; i += chunk) {
+    CHECK(streaming_convolve_process(sc, xs + i, sout + i) == 0);
+  }
+  CHECK(streaming_convolve_flush(sc, sout + n) == 0);
+  for (size_t i = 0; i < n + k - 1; i += 37) {
+    CHECK_NEAR(sout[i], want[i], 1e-3);
+  }
+  /* stream is consumed after flush */
+  CHECK(streaming_convolve_process(sc, xs, sout) != 0);
+  streaming_convolve_finalize(sc);
+  free(sout);
   free(xs); free(hs); free(out); free(want);
 }
 

@@ -279,6 +279,57 @@ void cross_correlate_finalize(VelesConvolutionHandle *handle) {
   convolve_finalize(handle);
 }
 
+struct VelesStreamingConvolution {
+  long id;
+  size_t h_length;
+  size_t chunk_length;
+};
+
+VelesStreamingConvolution *streaming_convolve_initialize(
+    const float *h, size_t h_length, size_t chunk_length, int reverse,
+    int simd) {
+  long id = 0;
+  if (shim_call_parse("streaming_convolve_initialize", parse_long, &id,
+                      "(Kkkii)", PTR(h), (unsigned long)h_length,
+                      (unsigned long)chunk_length, reverse, simd) != 0 ||
+      id <= 0) {
+    return NULL;
+  }
+  VelesStreamingConvolution *stream = malloc(sizeof(*stream));
+  if (stream == NULL) {
+    return NULL;
+  }
+  stream->id = id;
+  stream->h_length = h_length;
+  stream->chunk_length = chunk_length;
+  return stream;
+}
+
+int streaming_convolve_process(VelesStreamingConvolution *stream,
+                               const float *chunk, float *result) {
+  if (stream == NULL) {
+    return -1;
+  }
+  return shim_run("streaming_convolve_process", "(lKK)", stream->id,
+                  PTR(chunk), PTR(result));
+}
+
+int streaming_convolve_flush(VelesStreamingConvolution *stream,
+                             float *tail) {
+  if (stream == NULL) {
+    return -1;
+  }
+  return shim_run("streaming_convolve_flush", "(lK)", stream->id,
+                  PTR(tail));
+}
+
+void streaming_convolve_finalize(VelesStreamingConvolution *stream) {
+  if (stream != NULL) {
+    shim_run("streaming_convolve_finalize", "(l)", stream->id);
+    free(stream);
+  }
+}
+
 /* Named per-algorithm entry points (inc/simd/convolve.h:58-96,
  * inc/simd/correlate.h:57-105): same registry, forced algorithm. */
 

@@ -95,6 +95,22 @@ void cross_correlate_finalize(VelesConvolutionHandle *handle);
 int cross_correlate_simd(int simd, const float *x, size_t x_length,
                          const float *h, size_t h_length, float *result);
 
+/* Streaming convolution — no reference analog (the reference's handles
+ * are one-shot).  Chunks of fixed chunk_length arrive one at a time;
+ * state is the trailing h_length-1 inputs; the concatenation of every
+ * process() output plus the flush() tail equals the one-shot full
+ * convolution.  reverse=1 streams cross-correlation.  result must hold
+ * chunk_length floats; tail must hold h_length-1 floats.  process/flush
+ * return nonzero after flush (stream is consumed). */
+typedef struct VelesStreamingConvolution VelesStreamingConvolution;
+VelesStreamingConvolution *streaming_convolve_initialize(
+    const float *h, size_t h_length, size_t chunk_length, int reverse,
+    int simd);
+int streaming_convolve_process(VelesStreamingConvolution *stream,
+                               const float *chunk, float *result);
+int streaming_convolve_flush(VelesStreamingConvolution *stream, float *tail);
+void streaming_convolve_finalize(VelesStreamingConvolution *stream);
+
 /* Named per-algorithm entry points (inc/simd/correlate.h:57-105). */
 VelesConvolutionHandle *cross_correlate_fft_initialize(size_t x_length,
                                                        size_t h_length);

@@ -115,6 +115,41 @@ def convolve_finalize(hid):
     return 0
 
 
+_streams: dict[int, "_cv.StreamingConvolution"] = {}
+
+
+def streaming_convolve_initialize(h, h_length, chunk_length, reverse, simd):
+    stream = _cv.StreamingConvolution(
+        _f32(h, h_length).copy(), int(chunk_length),
+        reverse=bool(reverse), simd=bool(simd))
+    sid = _next_handle[0]
+    _next_handle[0] += 1
+    _streams[sid] = stream
+    return sid
+
+
+def streaming_convolve_process(sid, chunk, result):
+    stream = _streams[int(sid)]
+    out = stream.process(_f32(chunk, stream.chunk_length))
+    _f32(result, stream.chunk_length)[...] = np.asarray(out)
+    return 0
+
+
+def streaming_convolve_flush(sid, tail):
+    stream = _streams[int(sid)]
+    out = np.asarray(stream.flush())
+    if stream.h_length > 1:
+        buf = _f32(tail, stream.h_length - 1)
+        # an un-fed stream flushes empty: the C tail is all zeros
+        buf[...] = 0.0 if out.shape[-1] == 0 else out
+    return 0
+
+
+def streaming_convolve_finalize(sid):
+    _streams.pop(int(sid), None)
+    return 0
+
+
 def convolve_simd(simd, x, xlen, h, hlen, result):
     out = _cv.convolve_simd(_f32(x, xlen), _f32(h, hlen), simd=bool(simd))
     _f32(result, xlen + hlen - 1)[...] = np.asarray(out)

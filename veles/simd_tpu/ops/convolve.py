@@ -593,9 +593,7 @@ class StreamingConvolution:
         # FFT / direct all reuse one compiled executable per shape)
         k = self._k
         self._chunk_handle = convolve_initialize(
-            self._chunk_length + k - 1, k, reverse=reverse) \
-            if k > 1 else convolve_initialize(self._chunk_length, k,
-                                              reverse=reverse)
+            self._chunk_length + k - 1, k, reverse=reverse)
         self._flush_handle = convolve_initialize(k - 1, k, reverse=reverse) \
             if k > 1 else None
         self._carry = None          # [..., k-1] trailing input samples
@@ -639,7 +637,10 @@ class StreamingConvolution:
     def flush(self):
         """Emit the final ``h_length - 1`` output samples (the tail that
         depends only on already-seen inputs).  The stream cannot be used
-        afterwards."""
+        afterwards.  Degenerate cases return an empty array: a stream
+        that never saw a chunk, or ``h_length == 1`` (a one-tap filter
+        has no tail).  The C binding zero-fills its fixed-size tail
+        buffer in those cases instead."""
         if self._done:
             raise ValueError("stream already flushed")
         self._done = True
