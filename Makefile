@@ -34,9 +34,11 @@ lint:
 docs:
 	$(PYTHON) tools/gen_docs.py
 
-# pip-installs the Python/XLA core, then the C ABI (PREFIX=/usr/local)
+# pip-installs the Python/XLA core, then the C ABI (PREFIX=/usr/local).
+# --no-build-isolation: build with the environment's setuptools so the
+# install works air-gapped (pip's isolated build env needs network).
 install:
-	$(PYTHON) -m pip install .
+	$(PYTHON) -m pip install --no-build-isolation .
 	$(MAKE) -C csrc install
 
 clean:
