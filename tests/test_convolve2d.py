@@ -1,0 +1,99 @@
+"""2D convolution/correlation vs oracle + structural invariants."""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu.ops import convolve as cv1
+from veles.simd_tpu.ops import convolve2d as cv2
+
+RNG = np.random.RandomState(9)
+
+
+def _direct_oracle(x, h):
+    """Quadruple-loop reference for small shapes (float64)."""
+    n0, n1 = x.shape
+    k0, k1 = h.shape
+    out = np.zeros((n0 + k0 - 1, n1 + k1 - 1))
+    for i in range(n0):
+        for j in range(n1):
+            out[i:i + k0, j:j + k1] += x[i, j] * h.astype(np.float64)
+    return out.astype(np.float32)
+
+
+@pytest.mark.parametrize("algorithm", ["direct", "fft", None])
+def test_matches_quadruple_loop(algorithm):
+    x = RNG.randn(7, 9).astype(np.float32)
+    h = RNG.randn(3, 4).astype(np.float32)
+    got = np.asarray(cv2.convolve2d(x, h, algorithm=algorithm, simd=True))
+    np.testing.assert_allclose(got, _direct_oracle(x, h), atol=1e-4)
+
+
+def test_oracle_matches_quadruple_loop():
+    x = RNG.randn(6, 5).astype(np.float32)
+    h = RNG.randn(2, 3).astype(np.float32)
+    np.testing.assert_allclose(cv2.convolve2d_na(x, h),
+                               _direct_oracle(x, h), atol=1e-4)
+
+
+def test_direct_and_fft_agree_large():
+    x = RNG.randn(64, 48).astype(np.float32)
+    h = RNG.randn(17, 11).astype(np.float32)
+    a = np.asarray(cv2.convolve2d(x, h, algorithm="direct", simd=True))
+    b = np.asarray(cv2.convolve2d(x, h, algorithm="fft", simd=True))
+    np.testing.assert_allclose(a, b, atol=1e-3)
+
+
+def test_separable_kernel_equals_1d_passes():
+    """conv2d with an outer-product kernel == row conv then column conv."""
+    x = RNG.randn(20, 30).astype(np.float32)
+    hr = RNG.randn(5).astype(np.float32)
+    hc = RNG.randn(7).astype(np.float32)
+    h = np.outer(hc, hr).astype(np.float32)
+    got = np.asarray(cv2.convolve2d(x, h, simd=True))
+    rows = cv1.convolve_na(x, hr)                       # along axis -1
+    want = cv1.convolve_na(np.ascontiguousarray(rows.T), hc).T
+    np.testing.assert_allclose(got, want, atol=1e-3)
+
+
+def test_batched():
+    x = RNG.randn(3, 10, 12).astype(np.float32)
+    h = RNG.randn(4, 4).astype(np.float32)
+    got = np.asarray(cv2.convolve2d(x, h, simd=True))
+    assert got.shape == (3, 13, 15)
+    np.testing.assert_allclose(got[1], _direct_oracle(x[1], h), atol=1e-4)
+
+
+def test_correlation_is_reversed_convolution():
+    x = RNG.randn(12, 12).astype(np.float32)
+    h = RNG.randn(3, 5).astype(np.float32)
+    a = np.asarray(cv2.cross_correlate2d(x, h, simd=True))
+    b = np.asarray(cv2.convolve2d(x, h[::-1, ::-1].copy(), simd=True))
+    np.testing.assert_allclose(a, b, atol=1e-4)
+    np.testing.assert_allclose(cv2.cross_correlate2d_na(x, h), b, atol=1e-3)
+
+
+def test_matched_filter_peak_2d():
+    """Planting a template and correlating finds it at (pos + k - 1)."""
+    x = np.zeros((64, 64), np.float32)
+    h = RNG.randn(8, 8).astype(np.float32)
+    x[20:28, 33:41] = h
+    out = np.asarray(cv2.cross_correlate2d(x, h, simd=True))
+    peak = np.unravel_index(np.argmax(out), out.shape)
+    assert peak == (27, 40), peak
+    # oracle backend agrees
+    out0 = cv2.cross_correlate2d(x, h, simd=False)
+    assert np.unravel_index(np.argmax(out0), out0.shape) == (27, 40)
+
+
+def test_auto_select_boundary():
+    assert cv2.select_algorithm2d(31, 31) == "direct"
+    assert cv2.select_algorithm2d(32, 32) == "fft"
+
+
+def test_contract_violations():
+    with pytest.raises(ValueError, match="h\\[k0, k1\\]"):
+        cv2.convolve2d(np.zeros((4, 4), np.float32),
+                       np.zeros(4, np.float32))
+    with pytest.raises(ValueError, match="algorithm"):
+        cv2.convolve2d(np.zeros((4, 4), np.float32),
+                       np.zeros((2, 2), np.float32), algorithm="nope")

@@ -90,6 +90,21 @@ def _check_convolve(rng):
     for algo in cv.ConvolutionAlgorithm:
         handle = cv.convolve_initialize(len(x), len(h), algo)
         errs.append(_rel_err(cv.convolve(handle, x, h, simd=True), want))
+    # 2D: both algorithms vs the float64 oracle
+    from veles.simd_tpu.ops import convolve2d as cv2
+
+    x2 = rng.randn(96, 80).astype(np.float32)
+    h2 = rng.randn(9, 13).astype(np.float32)
+    want2 = cv2.convolve2d_na(x2, h2)
+    for algo in ("direct", "fft"):
+        errs.append(_rel_err(cv2.convolve2d(x2, h2, algorithm=algo,
+                                            simd=True), want2))
+    # streaming == one-shot
+    sc = cv.StreamingConvolution(h, chunk_length=5000)
+    parts = [np.asarray(sc.process(x[i:i + 5000]))
+             for i in range(0, len(x), 5000)]
+    parts.append(np.asarray(sc.flush()))
+    errs.append(_rel_err(np.concatenate(parts), want))
     return max(errs), 1e-4
 
 

@@ -1,0 +1,142 @@
+"""2D linear convolution & cross-correlation.
+
+NEW capability beyond the reference: ``/root/reference`` is 1D-only for
+filtering (its only 2D op is plane normalization,
+``src/normalize.c``), but image/plane filtering is the natural next ask
+of a signal-processing library, and the TPU formulation is the same two
+ideas as the 1D family (``ops/convolve.py``):
+
+* **direct** — one ``lax.conv_general_dilated`` with full padding: XLA
+  im2cols the window onto the MXU;
+* **fft** — pad both axes to pow2 ≥ n+k−1, one batched
+  ``rfft2 · multiply · irfft2`` (the 2D analog of
+  ``src/convolve.c:231-326``).
+
+Auto-selection mirrors the 1D heuristic shape: spectral wins once the
+kernel area is large (the provisional crossover constant below is from
+the 1D sweep's structure, to be re-derived on hardware with
+``tools/tune_overlap_save.py``'s methodology).
+
+Result is always the full linear convolution
+``[..., n0 + k0 - 1, n1 + k1 - 1]``; leading batch dimensions pass
+through.  Cross-correlation reuses convolution with a doubly-reversed
+kernel, exactly like ``src/correlate.c:37-72`` in 1D.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles.simd_tpu.utils.config import resolve_simd
+from veles.simd_tpu.utils.memory import next_highest_power_of_2
+
+__all__ = ["convolve2d", "convolve2d_na",
+           "cross_correlate2d", "cross_correlate2d_na",
+           "select_algorithm2d"]
+
+# provisional spectral crossover: kernel area beyond which the batched
+# 2D FFT beats the im2col conv (structure mirrors AUTO_FFT_MIN_PRODUCT
+# in ops/convolve.py; re-derive on hardware)
+AUTO_FFT2_MIN_KERNEL_AREA = 1 << 10
+
+
+def select_algorithm2d(k0: int, k1: int) -> str:
+    """'direct' for small kernels (MXU im2col), 'fft' for large."""
+    return "fft" if k0 * k1 >= AUTO_FFT2_MIN_KERNEL_AREA else "direct"
+
+
+@functools.partial(jax.jit, static_argnames=("reverse",))
+def _conv2d_direct(x, h, reverse=False):
+    n0, n1 = x.shape[-2:]
+    k0, k1 = h.shape[-2:]
+    kernel = h if reverse else jnp.flip(h, axis=(-2, -1))
+    lhs = x.reshape((-1, 1, n0, n1)).astype(jnp.float32)
+    rhs = kernel.reshape((1, 1, k0, k1)).astype(jnp.float32)
+    out = jax.lax.conv_general_dilated(
+        lhs, rhs, window_strides=(1, 1),
+        padding=[(k0 - 1, k0 - 1), (k1 - 1, k1 - 1)],
+        precision=jax.lax.Precision.HIGHEST)
+    return out.reshape(x.shape[:-2] + (n0 + k0 - 1, n1 + k1 - 1))
+
+
+@functools.partial(jax.jit, static_argnames=("m0", "m1", "reverse"))
+def _conv2d_fft(x, h, m0, m1, reverse=False):
+    n0, n1 = x.shape[-2:]
+    k0, k1 = h.shape[-2:]
+    kernel = jnp.flip(h, axis=(-2, -1)) if reverse else h
+    spec = (jnp.fft.rfft2(x.astype(jnp.float32), (m0, m1))
+            * jnp.fft.rfft2(kernel.astype(jnp.float32), (m0, m1)))
+    full = jnp.fft.irfft2(spec, (m0, m1))
+    return full[..., : n0 + k0 - 1, : n1 + k1 - 1].astype(jnp.float32)
+
+
+def _check2d(x, h):
+    # np.ndim/np.shape are tracer-safe: convolve2d composes under jit
+    if np.ndim(x) < 2 or np.ndim(h) != 2:
+        raise ValueError(
+            f"need x[..., n0, n1] and h[k0, k1]; got {np.shape(x)} and "
+            f"{np.shape(h)}")
+
+
+def _run2d(x, h, reverse, algorithm, simd):
+    _check2d(x, h)
+    k0, k1 = np.shape(h)[-2:]
+    if algorithm is None:
+        algorithm = select_algorithm2d(k0, k1)
+    if algorithm not in ("direct", "fft"):
+        raise ValueError(f"algorithm must be 'direct' or 'fft', "
+                         f"got {algorithm!r}")
+    if resolve_simd(simd):
+        x, h = jnp.asarray(x), jnp.asarray(h)
+        if algorithm == "direct":
+            return _conv2d_direct(x, h, reverse=reverse)
+        m0 = next_highest_power_of_2(x.shape[-2] + k0 - 1)
+        m1 = next_highest_power_of_2(x.shape[-1] + k1 - 1)
+        return _conv2d_fft(x, h, m0, m1, reverse=reverse)
+    x = np.asarray(x, np.float32)
+    h = np.asarray(h, np.float32)
+    if reverse:
+        h = h[::-1, ::-1]
+    return convolve2d_na(x, h)
+
+
+def convolve2d(x, h, algorithm=None, simd=None):
+    """Full 2D linear convolution: ``y[..., i, j] = Σ x[..., i-p, j-q]
+    h[p, q]``, output ``[..., n0+k0-1, n1+k1-1]``."""
+    return _run2d(x, h, False, algorithm, simd)
+
+
+def cross_correlate2d(x, h, algorithm=None, simd=None):
+    """Full 2D cross-correlation (convolution with ``h`` reversed along
+    both axes — the 2D form of ``src/correlate.c:37-72``)."""
+    return _run2d(x, h, True, algorithm, simd)
+
+
+def convolve2d_na(x, h):
+    """NumPy oracle: float64 spectral convolution (exact to f32
+    round-off), same padding semantics as the XLA paths.  The oracle is
+    deliberately algorithm-independent — exact in float64, it is the
+    single reference both the direct and fft device paths validate
+    against (``simd=False`` ignores ``algorithm`` for this reason; the
+    independent direct-form check lives in
+    ``tests/test_convolve2d.py::_direct_oracle``)."""
+    x = np.asarray(x, np.float32)
+    h = np.asarray(h, np.float32)
+    _check2d(x, h)
+    n0, n1 = x.shape[-2:]
+    k0, k1 = h.shape[-2:]
+    m0, m1 = n0 + k0 - 1, n1 + k1 - 1
+    spec = (np.fft.rfft2(x.astype(np.float64), (m0, m1))
+            * np.fft.rfft2(h.astype(np.float64), (m0, m1)))
+    return np.fft.irfft2(spec, (m0, m1)).astype(np.float32)
+
+
+def cross_correlate2d_na(x, h):
+    """NumPy oracle twin of :func:`cross_correlate2d`."""
+    h = np.asarray(h, np.float32)
+    _check2d(np.asarray(x, np.float32), h)
+    return convolve2d_na(x, h[::-1, ::-1])
