@@ -190,3 +190,67 @@ def test_brute_force_handle_routes_pallas(monkeypatch):
     got = np.asarray(cv.convolve(handle, x, h, simd=True))
     assert calls, "handle BRUTE_FORCE path did not route through pallas"
     np.testing.assert_allclose(got, cv.convolve_na(x, h), atol=1e-4)
+
+
+# --------------------------------------------------------------------------
+# 2D kernel (interpret mode)
+# --------------------------------------------------------------------------
+
+def test_filter_2d_matches_oracle():
+    from veles.simd_tpu.ops.pallas_kernels import filter_2d_pallas
+    x_ext = rng.randn(2, 12, 14).astype(np.float32)
+    k = rng.randn(3, 4).astype(np.float32)
+    got = np.asarray(filter_2d_pallas(x_ext, k, 10, 11, interpret=True))
+    want = np.zeros((2, 10, 11), np.float32)
+    for p in range(3):
+        for q in range(4):
+            want += k[p, q] * x_ext[:, p:p + 10, q:q + 11]
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_filter_2d_single_image():
+    from veles.simd_tpu.ops import pallas_kernels as pk
+    x_ext = rng.randn(6, 8).astype(np.float32)
+    k = rng.randn(2, 2).astype(np.float32)
+    got = np.asarray(pk.filter_2d_pallas(x_ext, k, 5, 7, interpret=True))
+    want = sum(k[p, q] * x_ext[p:p + 5, q:q + 7]
+               for p in range(2) for q in range(2))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_filter_2d_batch_pads_to_tile():
+    from veles.simd_tpu.ops import pallas_kernels as pk
+    # 20 images with a tile of 16 -> pad 12: exercises _f2d_call's
+    # pad-and-unpad branch (guard the premise first)
+    x_ext = rng.randn(20, 10, 12).astype(np.float32)
+    k = rng.randn(3, 3).astype(np.float32)
+    imgs = pk._tile_rows(20, 10 * 12 + 8 * 10)
+    assert 20 % imgs != 0, imgs
+    got = np.asarray(pk.filter_2d_pallas(x_ext, k, 8, 10, interpret=True))
+    assert got.shape == (20, 8, 10)
+    want = sum(k[p, q] * x_ext[:, p:p + 8, q:q + 10]
+               for p in range(3) for q in range(3))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_filter_2d_contracts():
+    from veles.simd_tpu.ops.pallas_kernels import filter_2d_pallas
+    with pytest.raises(ValueError, match="kernel2d"):
+        filter_2d_pallas(np.zeros((4, 4), np.float32),
+                         np.zeros(3, np.float32), 2, 2, interpret=True)
+    with pytest.raises(ValueError, match="too short"):
+        filter_2d_pallas(np.zeros((4, 4), np.float32),
+                         np.zeros((3, 3), np.float32), 4, 4, interpret=True)
+
+
+def test_convolve2d_pallas_route_vs_oracle(monkeypatch):
+    from veles.simd_tpu.ops import convolve2d as cv2
+    monkeypatch.setattr(cv2, "_use_pallas_direct2d", lambda *a: True)
+    x = rng.randn(3, 16, 20).astype(np.float32)
+    h = rng.randn(4, 3).astype(np.float32)
+    got = np.asarray(cv2.convolve2d(x, h, algorithm="direct", simd=True))
+    np.testing.assert_allclose(got, cv2.convolve2d_na(x, h), atol=1e-3)
+    got = np.asarray(cv2.cross_correlate2d(x, h, algorithm="direct",
+                                           simd=True))
+    np.testing.assert_allclose(got, cv2.cross_correlate2d_na(x, h),
+                               atol=1e-3)

@@ -206,6 +206,14 @@ def _check_pallas(rng):
                                 simd=True)
     whi, wlo = wv.wavelet_apply_na("daub", 8, wv.ExtensionType.MIRROR, x)
     errs += [_rel_err(bhi, whi), _rel_err(blo, wlo)]
+    # 2D shifted-MAC kernel (convolve2d direct route on TPU)
+    from veles.simd_tpu.ops import convolve2d as cv2
+
+    img = rng.randn(4, 64, 48).astype(np.float32)
+    k2 = rng.randn(5, 7).astype(np.float32)
+    errs.append(_rel_err(cv2.convolve2d(img, k2, algorithm="direct",
+                                        simd=True),
+                         cv2.convolve2d_na(img, k2)))
     # batched direct convolution routes through the C=1 kernel
     # (convolve._use_pallas_direct) on TPU
     from veles.simd_tpu.ops import convolve as cv

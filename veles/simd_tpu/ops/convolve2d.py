@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from veles.simd_tpu.ops import pallas_kernels as _pk
 from veles.simd_tpu.utils.config import resolve_simd
 from veles.simd_tpu.utils.memory import next_highest_power_of_2
 
@@ -47,6 +48,29 @@ AUTO_FFT2_MIN_KERNEL_AREA = 1 << 10
 def select_algorithm2d(k0: int, k1: int) -> str:
     """'direct' for small kernels (MXU im2col), 'fft' for large."""
     return "fft" if k0 * k1 >= AUTO_FFT2_MIN_KERNEL_AREA else "direct"
+
+
+def _use_pallas_direct2d(x_shape, k0: int, k1: int) -> bool:
+    """Route the direct form through the 2D Pallas shifted-MAC kernel:
+    small-area kernels on TPU, image + output within the VMEM tile
+    budget.  No minimum batch (one image fills the VPU tile).  Tests
+    monkeypatch this gate to exercise the kernel on CPU."""
+    n0, n1 = x_shape[-2:]
+    n0e, n1e = n0 + 2 * (k0 - 1), n1 + 2 * (k1 - 1)
+    out_elems = (n0 + k0 - 1) * (n1 + k1 - 1)
+    return (_pk.pallas_available()
+            and k0 * k1 <= _pk.PALLAS_2D_MAX_KERNEL_AREA
+            and _pk.fits_vmem(n0e * n1e + out_elems))
+
+
+@functools.partial(jax.jit, static_argnames=("reverse",))
+def _conv2d_direct_pallas(x, h, reverse=False):
+    n0, n1 = x.shape[-2:]
+    k0, k1 = h.shape[-2:]
+    kernel = h if reverse else jnp.flip(h, axis=(-2, -1))
+    x_ext = jnp.pad(x, [(0, 0)] * (x.ndim - 2)
+                    + [(k0 - 1, k0 - 1), (k1 - 1, k1 - 1)])
+    return _pk.filter_2d_pallas(x_ext, kernel, n0 + k0 - 1, n1 + k1 - 1)
 
 
 @functools.partial(jax.jit, static_argnames=("reverse",))
@@ -93,6 +117,8 @@ def _run2d(x, h, reverse, algorithm, simd):
     if resolve_simd(simd):
         x, h = jnp.asarray(x), jnp.asarray(h)
         if algorithm == "direct":
+            if _use_pallas_direct2d(x.shape, k0, k1):
+                return _conv2d_direct_pallas(x, h, reverse=reverse)
             return _conv2d_direct(x, h, reverse=reverse)
         m0 = next_highest_power_of_2(x.shape[-2] + k0 - 1)
         m1 = next_highest_power_of_2(x.shape[-1] + k1 - 1)

@@ -61,8 +61,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 from veles.simd_tpu.utils.config import on_tpu
 
-__all__ = ["filter_bank_pallas", "pallas_available", "PALLAS_MIN_ROWS",
-           "PALLAS_DIRECT_MAX_H"]
+__all__ = ["filter_bank_pallas", "filter_2d_pallas", "pallas_available",
+           "PALLAS_MIN_ROWS", "PALLAS_DIRECT_MAX_H",
+           "PALLAS_2D_MAX_KERNEL_AREA"]
 
 # the kernel wins when the batch tile fills VPU sublanes; below this the
 # dispatch/layout overhead dominates and the XLA conv path is used
@@ -72,6 +73,8 @@ PALLAS_MIN_ROWS = 8
 # unrolled compile time grows with k); measured wins up to k=129 on v5e
 # (5.6-9.3x), bound set with margin
 PALLAS_DIRECT_MAX_H = 256
+# 2D analog: kernel area cap for the unrolled taps (16x16)
+PALLAS_2D_MAX_KERNEL_AREA = 256
 # batch rows per grid step: Pallas double-buffers every in/out block, so
 # the steady-state VMEM footprint is ~2*(inputs + outputs) per row plus
 # accumulator temps; budget well under the 16 MB/core limit
@@ -197,6 +200,84 @@ def _phase_plan(order, stride, dilation, n_out):
         counts.append(n_taps)
         lengths.append((n_out - 1) + n_taps)
     return tuple(counts), lengths, 1
+
+
+def _f2d_kernel(h_ref, x_ref, o_ref, *, k0, k1, n_out0, n_out1):
+    """2D shifted-MAC: ``out[b, i, j] = Σ_{p,q} h[p,q] ·
+    x_ext[b, i+p, j+q]`` — k0·k1 statically-unrolled scalar*plane MACs
+    (taps in SMEM), every slice unit-stride at a static offset."""
+    xv = x_ref[...]
+    first = True
+    for p in range(k0):
+        for q in range(k1):
+            t = jax.lax.slice(
+                xv, (0, p, q),
+                (xv.shape[0], p + n_out0, q + n_out1))
+            term = h_ref[p, q] * t
+            o_ref[...] = term if first else o_ref[...] + term
+            first = False
+
+
+@functools.partial(jax.jit, static_argnames=("n_out0", "n_out1",
+                                             "interpret"))
+def _f2d_call(x3d, kernel2d, n_out0, n_out1, interpret):
+    n_imgs, n0e, n1e = x3d.shape
+    k0, k1 = kernel2d.shape
+    # one image per row of the budget formula: a 2D tile already fills
+    # sublanes x lanes, so images (not batch rows) are the grid unit
+    imgs = _tile_rows(n_imgs, n0e * n1e + n_out0 * n_out1)
+    pad = (-n_imgs) % imgs
+    if pad:
+        x3d = jnp.pad(x3d, ((0, pad), (0, 0), (0, 0)))
+    out = pl.pallas_call(
+        functools.partial(_f2d_kernel, k0=k0, k1=k1, n_out0=n_out0,
+                          n_out1=n_out1),
+        grid=(x3d.shape[0] // imgs,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec((imgs, n0e, n1e), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((imgs, n_out0, n_out1),
+                               lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((x3d.shape[0], n_out0, n_out1),
+                                       jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * k0 * k1 * x3d.shape[0] * n_out0 * n_out1,
+            bytes_accessed=4 * x3d.shape[0] * (n0e * n1e
+                                               + n_out0 * n_out1),
+            transcendentals=0),
+        interpret=interpret,
+    )(kernel2d.astype(jnp.float32), x3d.astype(jnp.float32))
+    return out[:n_imgs] if pad else out
+
+
+def filter_2d_pallas(x_ext, kernel2d, n_out0, n_out1, interpret=None):
+    """2D FIR correlation as one Pallas kernel (the image analog of
+    :func:`filter_bank_pallas`): ``out[..., i, j] = Σ_{p,q}
+    kernel2d[p, q] · x_ext[..., i+p, j+q]``.  ``x_ext`` carries the
+    caller's boundary handling; tap values are runtime SMEM data.
+    Unlike the 1D kernel, no minimum batch applies — one image already
+    fills the VPU's sublane x lane tile."""
+    kernel2d = jnp.asarray(kernel2d, jnp.float32)
+    if kernel2d.ndim != 2:
+        raise ValueError("kernel2d must be [k0, k1]")
+    k0, k1 = kernel2d.shape
+    if x_ext.ndim < 2:
+        raise ValueError("x_ext must be [..., n0_ext, n1_ext]")
+    if (x_ext.shape[-2] < n_out0 + k0 - 1
+            or x_ext.shape[-1] < n_out1 + k1 - 1):
+        raise ValueError(
+            f"x_ext too short: {x_ext.shape[-2:]} < "
+            f"{(n_out0 + k0 - 1, n_out1 + k1 - 1)}")
+    if interpret is None:
+        interpret = not pallas_available()
+    if not interpret and not fits_vmem(
+            x_ext.shape[-2] * x_ext.shape[-1] + n_out0 * n_out1):
+        raise ValueError("image exceeds the kernel VMEM tile budget; "
+                         "keep this shape on the XLA path")
+    batch_shape = x_ext.shape[:-2]
+    x3d = jnp.asarray(x_ext).reshape((-1,) + x_ext.shape[-2:])
+    out = _f2d_call(x3d, kernel2d, int(n_out0), int(n_out1),
+                    bool(interpret))
+    return out.reshape(batch_shape + (n_out0, n_out1))
 
 
 def filter_bank_pallas(x_ext, filters, stride, dilation, n_out,
