@@ -161,6 +161,29 @@ static void test_convolve(void) {
   }
   free(cwant);
 
+  /* 2D: separable kernel == two 1D passes (spot values) */
+  {
+    float img[4 * 6], k2[2 * 3], out2[5 * 8], want2[5 * 8];
+    for (int i = 0; i < 24; i++) img[i] = sinf(i * 0.7f);
+    for (int i = 0; i < 6; i++) k2[i] = 0.5f - 0.1f * (float)i;
+    CHECK(convolve2d(1, img, 4, 6, k2, 2, 3, out2) == 0);
+    CHECK(convolve2d(0, img, 4, 6, k2, 2, 3, want2) == 0); /* oracle */
+    for (int i = 0; i < 40; i++) {
+      CHECK_NEAR(out2[i], want2[i], 1e-3);
+    }
+    float xc2[5 * 8];
+    CHECK(cross_correlate2d(1, img, 4, 6, k2, 2, 3, xc2) == 0);
+    /* correlation == convolution with doubly-reversed kernel */
+    float k2r[2 * 3];
+    for (int p = 0; p < 2; p++)
+      for (int q = 0; q < 3; q++) k2r[p * 3 + q] = k2[(1 - p) * 3 + (2 - q)];
+    float want2r[5 * 8];
+    CHECK(convolve2d(1, img, 4, 6, k2r, 2, 3, want2r) == 0);
+    for (int i = 0; i < 40; i++) {
+      CHECK_NEAR(xc2[i], want2r[i], 1e-3);
+    }
+  }
+
   /* streaming: chunked outputs + tail must equal the one-shot result */
   size_t chunk = 250;
   VelesStreamingConvolution *sc =

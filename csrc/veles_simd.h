@@ -95,6 +95,14 @@ void cross_correlate_finalize(VelesConvolutionHandle *handle);
 int cross_correlate_simd(int simd, const float *x, size_t x_length,
                          const float *h, size_t h_length, float *result);
 
+/* 2D convolution / cross-correlation — no reference analog (the
+ * reference filters 1D only).  result must hold
+ * (n0 + k0 - 1) * (n1 + k1 - 1) floats, row-major. */
+int convolve2d(int simd, const float *x, size_t n0, size_t n1,
+               const float *h, size_t k0, size_t k1, float *result);
+int cross_correlate2d(int simd, const float *x, size_t n0, size_t n1,
+                      const float *h, size_t k0, size_t k1, float *result);
+
 /* Streaming convolution — no reference analog (the reference's handles
  * are one-shot).  Chunks of fixed chunk_length arrive one at a time;
  * state is the trailing h_length-1 inputs; the concatenation of every

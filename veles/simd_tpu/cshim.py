@@ -150,6 +150,17 @@ def streaming_convolve_finalize(sid):
     return 0
 
 
+def convolve2d(simd, reverse, x, n0, n1, h, k0, k1, result):
+    from veles.simd_tpu.ops import convolve2d as _cv2
+
+    fn = _cv2.cross_correlate2d if reverse else _cv2.convolve2d
+    out = fn(_arr(x, (n0, n1), ctypes.c_float),
+             _arr(h, (k0, k1), ctypes.c_float), simd=bool(simd))
+    _arr(result, (n0 + k0 - 1, n1 + k1 - 1), ctypes.c_float)[...] = \
+        np.asarray(out)
+    return 0
+
+
 def convolve_simd(simd, x, xlen, h, hlen, result):
     out = _cv.convolve_simd(_f32(x, xlen), _f32(h, hlen), simd=bool(simd))
     _f32(result, xlen + hlen - 1)[...] = np.asarray(out)
