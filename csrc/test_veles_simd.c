@@ -161,7 +161,7 @@ static void test_convolve(void) {
   }
   free(cwant);
 
-  /* 2D: separable kernel == two 1D passes (spot values) */
+  /* 2D: SIMD path vs oracle + correlation/convolution reversal identity */
   {
     float img[4 * 6], k2[2 * 3], out2[5 * 8], want2[5 * 8];
     for (int i = 0; i < 24; i++) img[i] = sinf(i * 0.7f);

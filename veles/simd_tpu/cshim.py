@@ -28,6 +28,7 @@ maybe_override_platform()
 
 from veles.simd_tpu.ops import arithmetic as _ar
 from veles.simd_tpu.ops import convolve as _cv
+from veles.simd_tpu.ops import convolve2d as _cv2
 from veles.simd_tpu.ops import correlate as _cr
 from veles.simd_tpu.ops import detect_peaks as _dp
 from veles.simd_tpu.ops import mathfun as _mf
@@ -151,8 +152,6 @@ def streaming_convolve_finalize(sid):
 
 
 def convolve2d(simd, reverse, x, n0, n1, h, k0, k1, result):
-    from veles.simd_tpu.ops import convolve2d as _cv2
-
     fn = _cv2.cross_correlate2d if reverse else _cv2.convolve2d
     out = fn(_arr(x, (n0, n1), ctypes.c_float),
              _arr(h, (k0, k1), ctypes.c_float), simd=bool(simd))
