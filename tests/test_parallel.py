@@ -172,3 +172,47 @@ def test_sharded_convolve_halo_too_large():
     with pytest.raises(ValueError, match="halo"):
         par.sharded_convolve(np.zeros(256, np.float32),
                              np.zeros(40, np.float32), mesh)
+
+
+class TestSharded2D:
+    def test_matches_oracle_2x2(self):
+        from veles.simd_tpu.ops import convolve2d as cv2
+        from veles.simd_tpu.parallel import make_mesh, sharded_convolve2d
+
+        rng = np.random.RandomState(21)
+        mesh = make_mesh({"dp": 4, "sp": 2})
+        x = rng.randn(30, 26).astype(np.float32)
+        h = rng.randn(4, 5).astype(np.float32)
+        got = np.asarray(sharded_convolve2d(x, h, mesh))
+        np.testing.assert_allclose(got, cv2.convolve2d_na(x, h), atol=1e-3)
+
+    def test_matches_oracle_2x4_uneven(self):
+        from veles.simd_tpu.ops import convolve2d as cv2
+        from veles.simd_tpu.parallel import make_mesh, sharded_convolve2d
+
+        rng = np.random.RandomState(22)
+        mesh = make_mesh({"dp": 2, "sp": 4})
+        x = rng.randn(17, 53).astype(np.float32)   # needs output padding
+        h = rng.randn(3, 3).astype(np.float32)
+        got = np.asarray(sharded_convolve2d(x, h, mesh))
+        np.testing.assert_allclose(got, cv2.convolve2d_na(x, h), atol=1e-3)
+
+    def test_halo_too_large_raises(self):
+        from veles.simd_tpu.parallel import make_mesh, sharded_convolve2d
+
+        mesh = make_mesh({"dp": 2, "sp": 4})
+        with pytest.raises(ValueError, match="halo"):
+            sharded_convolve2d(np.zeros((8, 8), np.float32),
+                               np.zeros((2, 7), np.float32), mesh)
+
+    def test_large_kernel_takes_fft_tile_path(self):
+        from veles.simd_tpu.ops import convolve2d as cv2
+        from veles.simd_tpu.parallel import make_mesh, sharded_convolve2d
+
+        rng = np.random.RandomState(23)
+        mesh = make_mesh({"dp": 2, "sp": 4})
+        x = rng.randn(80, 160).astype(np.float32)
+        h = rng.randn(33, 33).astype(np.float32)  # area >= fft crossover
+        assert cv2.select_algorithm2d(33, 33) == "fft"
+        got = np.asarray(sharded_convolve2d(x, h, mesh))
+        np.testing.assert_allclose(got, cv2.convolve2d_na(x, h), atol=2e-3)

@@ -38,9 +38,11 @@ from veles.simd_tpu.parallel import distributed
 from veles.simd_tpu.parallel.mesh import default_mesh, make_mesh
 from veles.simd_tpu.parallel.ops import (
     data_parallel, halo_exchange_left, halo_exchange_right,
-    sharded_convolve, sharded_convolve_batch, sharded_matmul, sharded_swt)
+    sharded_convolve, sharded_convolve2d, sharded_convolve_batch,
+    sharded_matmul, sharded_swt)
 
 __all__ = ["make_mesh", "default_mesh", "sharded_convolve",
-           "sharded_convolve_batch", "sharded_swt", "sharded_matmul",
+           "sharded_convolve_batch", "sharded_convolve2d",
+           "sharded_swt", "sharded_matmul",
            "data_parallel", "halo_exchange_left", "halo_exchange_right",
            "distributed"]
