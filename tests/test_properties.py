@@ -183,3 +183,62 @@ def test_pallas_dilation_equals_upsampled_taps(seed, order, dilation):
     (dil,) = _fb(x_ext, f, 1, dilation, n_out)
     (ups,) = _fb(x_ext, up, 1, 1, n_out)
     np.testing.assert_allclose(dil, ups, atol=1e-5)
+
+
+# --------------------------------------------------------------------------
+# 2D convolution + wavelet synthesis invariants
+# --------------------------------------------------------------------------
+
+from veles.simd_tpu.ops import convolve2d as cv2
+
+
+@settings(max_examples=10, deadline=None)
+@given(st.integers(0, 2 ** 31 - 1), st.floats(-3, 3, width=32))
+def test_conv2d_is_linear(seed, alpha):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(9, 11).astype(np.float32)
+    h = rng.randn(3, 2).astype(np.float32)
+    lhs = np.asarray(cv2.convolve2d((alpha * x).astype(np.float32), h))
+    rhs = alpha * np.asarray(cv2.convolve2d(x, h))
+    np.testing.assert_allclose(lhs, rhs, atol=1e-2)
+
+
+@settings(max_examples=10, deadline=None)
+@given(st.integers(0, 2 ** 31 - 1))
+def test_conv2d_commutes_with_transpose(seed):
+    """conv2d(x.T, h.T) == conv2d(x, h).T — axis symmetry."""
+    rng = np.random.RandomState(seed)
+    x = rng.randn(8, 13).astype(np.float32)
+    h = rng.randn(4, 3).astype(np.float32)
+    a = np.asarray(cv2.convolve2d(np.ascontiguousarray(x.T),
+                                  np.ascontiguousarray(h.T)))
+    b = np.asarray(cv2.convolve2d(x, h)).T
+    np.testing.assert_allclose(a, b, atol=1e-4)
+
+
+@settings(max_examples=10, deadline=None)
+@given(st.integers(0, 2 ** 31 - 1), st.sampled_from([2, 6, 8, 12]),
+       st.sampled_from([16, 32, 64]))
+def test_dwt_synthesis_inverts_analysis(seed, order, n):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n).astype(np.float32)
+    hi, lo = wv.wavelet_apply("daub", order, wv.ExtensionType.PERIODIC, x)
+    rec = wv.wavelet_reconstruct("daub", order, hi, lo)
+    np.testing.assert_allclose(np.asarray(rec), x, atol=2e-4)
+
+
+@settings(max_examples=10, deadline=None)
+@given(st.integers(0, 2 ** 31 - 1))
+def test_streaming_equals_one_shot(seed):
+    rng = np.random.RandomState(seed)
+    chunk = int(rng.randint(8, 40))
+    n_chunks = int(rng.randint(1, 5))
+    k = int(rng.randint(1, 3 * chunk))      # carry can exceed a chunk
+    x = rng.randn(chunk * n_chunks).astype(np.float32)
+    h = rng.randn(k).astype(np.float32)
+    sc = cv.StreamingConvolution(h, chunk)
+    parts = [np.asarray(sc.process(x[i:i + chunk]))
+             for i in range(0, x.size, chunk)]
+    parts.append(np.asarray(sc.flush()))
+    np.testing.assert_allclose(np.concatenate(parts), cv.convolve_na(x, h),
+                               atol=1e-3)
