@@ -64,13 +64,14 @@ def main():
     assert peak == (55, 215), peak
     print(f"template found at {peak} (== planted pos + k - 1)")
 
-    # distributed agreement on a virtual mesh (when devices allow)
-    import jax
+    # distributed agreement: provision a virtual 8-device mesh (the
+    # sharded_longsignal.py pattern) so the check runs everywhere
+    from veles.simd_tpu.utils.platform import cpu_devices
 
-    if len(jax.devices()) >= 8:
+    with cpu_devices(8) as devices:
         from veles.simd_tpu.parallel import make_mesh, sharded_convolve2d
 
-        mesh = make_mesh({"dp": 2, "sp": 4})
+        mesh = make_mesh({"dp": 2, "sp": 4}, devices=devices)
         got = np.asarray(sharded_convolve2d(img, gaussian2d(9, 2.0), mesh))
         assert np.abs(got - blur).max() < 1e-3
         print("sharded 2x4 grid agrees with single-device blur")

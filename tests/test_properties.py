@@ -13,6 +13,7 @@ from hypothesis import given, settings, strategies as st
 
 from veles.simd_tpu.ops import arithmetic as ar
 from veles.simd_tpu.ops import convolve as cv
+from veles.simd_tpu.ops import convolve2d as cv2
 from veles.simd_tpu.ops import correlate as cr
 from veles.simd_tpu.ops import normalize as nz
 from veles.simd_tpu.ops import wavelet as wv
@@ -188,8 +189,6 @@ def test_pallas_dilation_equals_upsampled_taps(seed, order, dilation):
 # --------------------------------------------------------------------------
 # 2D convolution + wavelet synthesis invariants
 # --------------------------------------------------------------------------
-
-from veles.simd_tpu.ops import convolve2d as cv2
 
 
 @settings(max_examples=10, deadline=None)

@@ -255,6 +255,41 @@ def main():
             WaveletType.DAUBECHIES, 8, 2, wv.ExtensionType.PERIODIC, sig),
         samples=sig.size)
 
+    # --- wavelet synthesis (analysis + exact inverse per iteration) ---
+    def synth_step(v):
+        hi, lo = wv.wavelet_apply(
+            WaveletType.DAUBECHIES, 8, wv.ExtensionType.PERIODIC, v,
+            simd=True)
+        return wv.wavelet_reconstruct(WaveletType.DAUBECHIES, 8, hi, lo,
+                                      simd=True)
+
+    benchmark(
+        "dwt+idwt round trip daub8 64x512",
+        synth_step, sigd,
+        lambda: wv.wavelet_reconstruct_na(
+            WaveletType.DAUBECHIES, 8,
+            *wv.wavelet_apply_na(WaveletType.DAUBECHIES, 8,
+                                 wv.ExtensionType.PERIODIC, sig)),
+        samples=sig.size)
+
+    # --- 2D convolution (Pallas small-kernel + FFT large-kernel) ---
+    from veles.simd_tpu.ops import convolve2d as cv2d
+
+    img = rng.randn(8, 512, 512).astype(np.float32)
+    imgd = jnp.asarray(img)
+    for klen, algo in ((9, "direct"), (63, "fft")):
+        k2 = rng.randn(klen, klen).astype(np.float32)
+        k2d = jnp.asarray(k2)
+
+        def conv2d_step(v, k2d=k2d, algo=algo):
+            y = cv2d.convolve2d(v, k2d, algorithm=algo, simd=True)
+            return v + 1e-30 * y[..., :512, :512]
+
+        benchmark(f"conv2d 8x512x512 k={klen} [{algo}]",
+                  conv2d_step, imgd,
+                  lambda k2=k2: cv2d.convolve2d_na(img, k2),
+                  samples=img.size, baseline_repeats=1)
+
     # --- mathfun (tests/mathfun.cc pattern) ---
     v = rng.randn(1 << 20).astype(np.float32)
     vd = jnp.asarray(v)
