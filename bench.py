@@ -162,7 +162,10 @@ def main():
         maybe_override_platform, require_reachable_device)
 
     maybe_override_platform()  # VELES_SIMD_PLATFORM=cpu runs without TPU
-    require_reachable_device()  # fail fast on a wedged relay, don't hang
+    # fail fast on a wedged relay rather than hanging, but give it a
+    # 10-min recovery window first (wedges have been observed to clear);
+    # $VELES_SIMD_DEVICE_WAIT overrides (0 restores pure fail-fast)
+    require_reachable_device(wait=600.0)
     import jax
 
     from tools.tpu_smoke import run_smoke

@@ -7,6 +7,7 @@ semantics) is what every entry point leans on — worth direct coverage.
 
 import os
 
+import pytest
 
 from veles.simd_tpu.utils import platform as plat
 
@@ -59,3 +60,54 @@ def test_cpu_devices_uses_live_backend_without_teardown():
     with plat.cpu_devices(4) as devices:
         assert len(devices) == 4
     assert jax.devices() == before  # no provisioning, no restore
+
+
+def test_require_reachable_device_wait_retries(monkeypatch, capsys):
+    """The wait budget keeps re-probing and returns as soon as a device
+    appears; with no budget it exits immediately."""
+    from veles.simd_tpu.utils import platform as plat
+
+    calls = []
+
+    def fake_probe(timeout):
+        calls.append(timeout)
+        return (0, "wedged") if len(calls) < 3 else (1, "")
+
+    monkeypatch.setattr(plat, "_probe_subprocess", fake_probe)
+    import time as _time
+    monkeypatch.setattr(_time, "sleep", lambda s: None)
+    plat.require_reachable_device(wait=3600.0)
+    assert len(calls) == 3
+    assert "retrying" in capsys.readouterr().err
+
+    calls.clear()
+
+    def always_down(timeout):
+        calls.append(timeout)
+        return (0, "wedged")
+
+    monkeypatch.setattr(plat, "_probe_subprocess", always_down)
+    with pytest.raises(SystemExit):
+        plat.require_reachable_device(wait=0.0)
+    assert len(calls) == 1
+
+
+def test_device_wait_env_overrides_and_malformed_warns(monkeypatch, capsys):
+    from veles.simd_tpu.utils import platform as plat
+
+    calls = []
+    monkeypatch.setattr(plat, "_probe_subprocess",
+                        lambda t: (calls.append(t), (0, "down"))[1])
+    # env=0 overrides a caller wait -> single probe, fail fast
+    monkeypatch.setenv("VELES_SIMD_DEVICE_WAIT", "0")
+    with pytest.raises(SystemExit):
+        plat.require_reachable_device(wait=3600.0)
+    assert len(calls) == 1
+
+    # malformed env warns and keeps the caller's budget (0 here)
+    calls.clear()
+    monkeypatch.setenv("VELES_SIMD_DEVICE_WAIT", "10m")
+    with pytest.raises(SystemExit):
+        plat.require_reachable_device(wait=0.0)
+    assert "malformed" in capsys.readouterr().err
+    assert len(calls) == 1

@@ -185,20 +185,56 @@ def probe_device_count(timeout: float = 90.0) -> int:
     return _probe_subprocess(timeout)[0]
 
 
-def require_reachable_device(timeout: float = 120.0) -> None:
+def require_reachable_device(timeout: float = 120.0,
+                             wait: float | None = None) -> None:
     """Fail fast (SystemExit 2) when backend init would hang or crash.
 
     For benchmark/CLI entry points: a wedged remote relay blocks backend
     init forever (observed live), eating the caller's whole timeout with
     no diagnostics.  The probe subprocess surfaces the actual cause —
     timeout vs a child crash — instead of hanging.
+
+    ``wait`` seconds keeps re-probing until the device appears or the
+    budget runs out — relay wedges have been observed to clear on their
+    own, and a benchmark artifact beats a fast failure when a few
+    minutes of patience recovers the device.  ``$VELES_SIMD_DEVICE_WAIT``
+    overrides the caller's ``wait`` (so an operator can restore
+    fail-fast with 0, or extend the window); a malformed value warns and
+    keeps the caller's budget.
     """
     import sys
+    import time
 
-    count, detail = _probe_subprocess(timeout)
-    if count < 1:
-        print(f"device platform unreachable: {detail}", file=sys.stderr)
-        raise SystemExit(2)
+    env = os.environ.get("VELES_SIMD_DEVICE_WAIT", "").strip()
+    if env:
+        try:
+            wait = float(env)
+        except ValueError:
+            print(f"ignoring malformed VELES_SIMD_DEVICE_WAIT={env!r} "
+                  "(want seconds)", file=sys.stderr)
+    if wait is None:
+        wait = 0.0
+    deadline = time.monotonic() + max(wait, 0.0)
+    attempt = 0
+    while True:
+        attempt += 1
+        # the first probe always gets the full timeout (the wait=0
+        # fail-fast contract); retries are clamped to the remaining
+        # window so the budget is never overshot by more than a floor
+        remaining = deadline - time.monotonic()
+        probe_timeout = timeout if attempt == 1 \
+            else min(timeout, max(remaining, 15.0))
+        count, detail = _probe_subprocess(probe_timeout)
+        if count >= 1:
+            return
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            print(f"device platform unreachable: {detail}",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        print(f"device unreachable (attempt {attempt}: {detail}); "
+              f"retrying for another {remaining:.0f}s", file=sys.stderr)
+        time.sleep(min(30.0, remaining))
 
 
 def _probe_subprocess(timeout: float) -> tuple[int, str]:
