@@ -41,6 +41,12 @@ orthonormal filter bank — energy is preserved), but its Symlet and Coiflet
 tables sum to **1**, so those transforms scale output energy by 1/2 per
 level.  This module reproduces that behavior exactly for parity; multiply
 outputs by √2 per level for orthonormal scaling.
+
+Beyond the reference (which is analysis-only, 1D-only): exact synthesis
+(:func:`wavelet_reconstruct`, :func:`stationary_wavelet_reconstruct`,
+the cascade inverses) for the PERIODIC extension, and the separable
+single-level image transform (:func:`wavelet_apply2d` /
+:func:`wavelet_reconstruct2d`).
 """
 
 from __future__ import annotations
