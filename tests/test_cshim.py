@@ -34,3 +34,10 @@ def test_build_and_run_c_suite():
         capture_output=True, text=True, env=env, timeout=600)
     assert run.returncode == 0, (run.stdout[-2000:], run.stderr[-3000:])
     assert "0 failures" in run.stdout
+
+    # the standalone C example must keep running too (make -C csrc demo)
+    demo = subprocess.run(["make", "-C", CSRC, "demo"],
+                          capture_output=True, text=True, env=env,
+                          timeout=600)
+    assert demo.returncode == 0, (demo.stdout[-2000:], demo.stderr[-3000:])
+    assert "oracle peak agrees: yes" in demo.stdout
