@@ -165,3 +165,34 @@ def test_2d_batched():
 def test_2d_needs_two_dims():
     with pytest.raises(ValueError, match="n0, n1"):
         wv.wavelet_apply2d("daub", 8, EXT, np.zeros(16, np.float32))
+
+
+@pytest.mark.parametrize("levels", [1, 2, 3])
+def test_2d_pyramid_round_trip(levels):
+    img = RNG.randn(64, 64).astype(np.float32)
+    coeffs = wv.wavelet_transform2d("daub", 4, EXT, img, levels, simd=True)
+    assert len(coeffs) == levels + 1
+    assert np.shape(coeffs[-1]) == (64 >> levels, 64 >> levels)
+    rec = wv.wavelet_inverse_transform2d("daub", 4, coeffs, simd=True)
+    np.testing.assert_allclose(np.asarray(rec), img, atol=1e-3)
+
+
+def test_2d_pyramid_zeroing_error_equals_dropped_energy():
+    """Daubechies 2D pyramid is orthonormal (PERIODIC): zeroing a band
+    produces exactly that band's energy as squared reconstruction error
+    — the compression-use-case identity."""
+    img = RNG.randn(64, 64).astype(np.float32)
+    coeffs = wv.wavelet_transform2d("daub", 8, EXT, img, 2, simd=True)
+    dropped = sum(float(np.sum(np.asarray(b, np.float64) ** 2))
+                  for b in coeffs[0])
+    zeroed = [tuple(np.zeros_like(np.asarray(b)) for b in coeffs[0])] \
+        + coeffs[1:]
+    rec = np.asarray(wv.wavelet_inverse_transform2d("daub", 8, zeroed,
+                                                    simd=True))
+    err_energy = float(np.sum((rec.astype(np.float64) - img) ** 2))
+    np.testing.assert_allclose(err_energy, dropped, rtol=1e-4)
+
+
+def test_2d_pyramid_contract():
+    with pytest.raises(ValueError, match="ll_L"):
+        wv.wavelet_inverse_transform2d("daub", 4, [np.zeros((4, 4))])

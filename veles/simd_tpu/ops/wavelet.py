@@ -73,6 +73,7 @@ __all__ = [
     "stationary_wavelet_reconstruct", "stationary_wavelet_reconstruct_na",
     "wavelet_inverse_transform", "stationary_wavelet_inverse_transform",
     "wavelet_apply2d", "wavelet_reconstruct2d",
+    "wavelet_transform2d", "wavelet_inverse_transform2d",
     "wavelet_prepare_array", "wavelet_allocate_destination",
     "wavelet_recycle_source", "wavelet_validate_order",
     "supported_orders",
@@ -532,6 +533,34 @@ def wavelet_reconstruct2d(type, order, ll, lh, hl, hh, simd=None):
     rec = wavelet_reconstruct(type, order, hi_b, lo_b,
                               simd=simd).swapaxes(-1, -2)
     return wavelet_reconstruct(type, order, rec[0], rec[1], simd=simd)
+
+
+def wavelet_transform2d(type, order, ext, src, levels, simd=None):
+    """Multi-level 2D DWT pyramid: recursively split the LL band.
+
+    Returns ``[(lh_1, hl_1, hh_1), ..., (lh_L, hl_L, hh_L), ll_L]`` —
+    the standard image-compression layout (detail triples coarse-ward,
+    final approximation last)."""
+    coeffs = []
+    cur = src
+    for _ in range(int(levels)):
+        ll, lh, hl, hh = wavelet_apply2d(type, order, ext, cur, simd=simd)
+        coeffs.append((lh, hl, hh))
+        cur = ll
+    coeffs.append(cur)
+    return coeffs
+
+
+def wavelet_inverse_transform2d(type, order, coeffs, simd=None):
+    """Invert :func:`wavelet_transform2d` (PERIODIC cascade)."""
+    coeffs = list(coeffs)
+    if len(coeffs) < 2:
+        raise ValueError("need [(lh_1, hl_1, hh_1), ..., ll_L] with L >= 1")
+    cur = coeffs[-1]
+    for lh, hl, hh in reversed(coeffs[:-1]):
+        cur = wavelet_reconstruct2d(type, order, cur, lh, hl, hh,
+                                    simd=simd)
+    return cur
 
 
 # --------------------------------------------------------------------------
