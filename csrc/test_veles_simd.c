@@ -402,6 +402,125 @@ static void test_conversions(void) {
   free(al);
 }
 
+static void test_arithmetic_family(void) {
+  /* block primitive vs array form vs hand values
+   * (the reference's SIMD-vs-_na cross-check, tests/arithmetic.cc) */
+  float a[10], b[10], blk[8], arr[10];
+  for (int i = 0; i < 10; i++) {
+    a[i] = (float)(i + 1);
+    b[i] = (float)(10 - i) * 0.5f;
+  }
+  real_multiply(a, b, blk); /* exactly VELES_SIMD_FLOAT_STEP elements */
+  real_multiply_array(a, b, 10, arr);
+  for (int i = 0; i < 8; i++) {
+    CHECK_NEAR(blk[i], a[i] * b[i], 1e-6);
+    CHECK_NEAR(blk[i], arr[i], 0.f);
+  }
+  CHECK_NEAR(arr[9], 10.f * 0.5f, 1e-6);
+
+  float one = 0;
+  real_multiply_na(a + 3, b + 3, &one);
+  CHECK_NEAR(one, a[3] * b[3], 1e-6);
+
+  float arr_na[10];
+  real_multiply_array_na(a, b, 10, arr_na);
+  CHECK(memcmp(arr, arr_na, sizeof(arr)) == 0);
+
+  /* complex: (1+2i)(3+4i) = -5+10i; conjugate: (1+2i)(3-4i) = 11+2i */
+  float ca[8] = {1, 2, 1, 2, 1, 2, 1, 2};
+  float cb[8] = {3, 4, 3, 4, 3, 4, 3, 4};
+  float cr[8];
+  complex_multiply(ca, cb, cr);
+  for (int i = 0; i < 8; i += 2) {
+    CHECK_NEAR(cr[i], -5.f, 1e-6);
+    CHECK_NEAR(cr[i + 1], 10.f, 1e-6);
+  }
+  float cna[2];
+  complex_multiply_na(ca, cb, cna);
+  CHECK_NEAR(cna[0], -5.f, 1e-6);
+  CHECK_NEAR(cna[1], 10.f, 1e-6);
+  complex_multiply_conjugate(ca, cb, cr);
+  for (int i = 0; i < 8; i += 2) {
+    CHECK_NEAR(cr[i], 11.f, 1e-6);
+    CHECK_NEAR(cr[i + 1], 2.f, 1e-6);
+  }
+  complex_multiply_conjugate_na(ca, cb, cna);
+  CHECK_NEAR(cna[0], 11.f, 1e-6);
+  CHECK_NEAR(cna[1], 2.f, 1e-6);
+
+  /* conjugate an interleaved array, even and odd lengths */
+  float conj[8], conj_na[8];
+  complex_conjugate(cb, 8, conj);
+  complex_conjugate_na(cb, 8, conj_na);
+  CHECK(memcmp(conj, conj_na, sizeof(conj)) == 0);
+  CHECK_NEAR(conj[0], 3.f, 0.f);
+  CHECK_NEAR(conj[1], -4.f, 0.f);
+  complex_conjugate(cb, 7, conj); /* trailing unpaired float copies through */
+  CHECK_NEAR(conj[5], -4.f, 0.f);
+  CHECK_NEAR(conj[6], 3.f, 0.f);
+
+  /* scalar scale, sum, broadcast add */
+  float scaled[10];
+  real_multiply_scalar(a, 10, 0.25f, scaled);
+  CHECK_NEAR(scaled[7], 2.f, 1e-6);
+  real_multiply_scalar_na(a, 10, 0.25f, arr_na);
+  CHECK(memcmp(scaled, arr_na, sizeof(scaled)) == 0);
+
+  CHECK_NEAR(sum_elements(a, 10), 55.f, 1e-5);
+  CHECK_NEAR(sum_elements_na(a, 10), 55.f, 1e-5);
+
+  float added[10];
+  add_to_all(a, 10, -1.5f, added);
+  CHECK_NEAR(added[0], -0.5f, 1e-6);
+  CHECK_NEAR(added[9], 8.5f, 1e-6);
+  add_to_all_na(a, 10, -1.5f, arr_na);
+  CHECK(memcmp(added, arr_na, sizeof(added)) == 0);
+
+  /* widening int16 multiply: products that overflow int16 must survive */
+  int16_t ia[16], ib[16];
+  int32_t ires[16];
+  for (int i = 0; i < 16; i++) {
+    ia[i] = (int16_t)(300 + i);
+    ib[i] = (int16_t)(i % 2 ? -400 : 400);
+  }
+  int16_multiply(ia, ib, ires);
+  CHECK(ires[0] == 300 * 400);
+  CHECK(ires[1] == 301 * -400);
+  CHECK(ires[15] == 315 * -400);
+}
+
+static void test_legacy_aliases(void) {
+  /* the doc-comment names must resolve and behave like the _save twins
+   * (inc/simd/convolve.h:123-124, correlate.h:132-134) */
+  const float x[6] = {1, 2, 3, 4, 5, 6};
+  const float h[2] = {1, 1};
+  float want[7], got[7];
+
+  VelesConvolutionHandle *c = convolve_overlap_save_initialize(6, 2);
+  CHECK(c != NULL);
+  CHECK(convolve(c, x, h, want) == 0);
+  convolve_finalize(c);
+  c = convolve_overlap_initialize(6, 2);
+  CHECK(c != NULL);
+  CHECK(convolve(c, x, h, got) == 0);
+  convolve_finalize(c);
+  for (int i = 0; i < 7; i++) {
+    CHECK_NEAR(got[i], want[i], 1e-5);
+  }
+
+  c = cross_correlate_overlap_initialize(6, 2);
+  CHECK(c != NULL);
+  CHECK(cross_correlate(c, x, h, got) == 0);
+  convolve_finalize(c);
+  VelesConvolutionHandle *r = cross_correlate_overlap_save_initialize(6, 2);
+  CHECK(r != NULL);
+  CHECK(cross_correlate(r, x, h, want) == 0);
+  convolve_finalize(r);
+  for (int i = 0; i < 7; i++) {
+    CHECK_NEAR(got[i], want[i], 1e-5);
+  }
+}
+
 int main(void) {
   if (veles_simd_init(NULL) != 0) {
     fprintf(stderr, "init failed: %s\n", veles_simd_last_error());
@@ -417,6 +536,8 @@ int main(void) {
   test_normalize();
   test_detect_peaks();
   test_conversions();
+  test_arithmetic_family();
+  test_legacy_aliases();
 
   printf("%d checks, %d failures\n", g_checks, g_failures);
   veles_simd_shutdown();

@@ -379,6 +379,12 @@ void convolve_overlap_save_finalize(VelesConvolutionHandle *handle) {
   convolve_finalize(handle);
 }
 
+/* Legacy doc-comment name (inc/simd/convolve.h:123-124). */
+VelesConvolutionHandle *convolve_overlap_initialize(size_t x_length,
+                                                    size_t h_length) {
+  return convolve_overlap_save_initialize(x_length, h_length);
+}
+
 VelesConvolutionHandle *cross_correlate_fft_initialize(size_t x_length,
                                                        size_t h_length) {
   return conv_init(x_length, h_length, VELES_CONV_ALGORITHM_FFT, 1);
@@ -406,6 +412,12 @@ int cross_correlate_overlap_save(VelesConvolutionHandle *handle,
 
 void cross_correlate_overlap_save_finalize(VelesConvolutionHandle *handle) {
   convolve_finalize(handle);
+}
+
+/* Legacy doc-comment name (inc/simd/correlate.h:132-134). */
+VelesConvolutionHandle *cross_correlate_overlap_initialize(size_t x_length,
+                                                           size_t h_length) {
+  return cross_correlate_overlap_save_initialize(x_length, h_length);
 }
 
 int convolve_simd(int simd, const float *x, size_t x_length,

@@ -85,6 +85,10 @@ VelesConvolutionHandle *convolve_overlap_save_initialize(size_t x_length,
 int convolve_overlap_save(VelesConvolutionHandle *handle, const float *x,
                           const float *h, float *result);
 void convolve_overlap_save_finalize(VelesConvolutionHandle *handle);
+/* Legacy alias used by the reference's doc comments
+ * (inc/simd/convolve.h:123-124); same as convolve_overlap_save_initialize. */
+VelesConvolutionHandle *convolve_overlap_initialize(size_t x_length,
+                                                    size_t h_length);
 
 VelesConvolutionHandle *cross_correlate_initialize(size_t x_length,
                                                    size_t h_length,
@@ -131,6 +135,11 @@ int cross_correlate_overlap_save(VelesConvolutionHandle *handle,
                                  const float *x, const float *h,
                                  float *result);
 void cross_correlate_overlap_save_finalize(VelesConvolutionHandle *handle);
+/* Legacy alias used by the reference's doc comments
+ * (inc/simd/correlate.h:132-134); same as
+ * cross_correlate_overlap_save_initialize. */
+VelesConvolutionHandle *cross_correlate_overlap_initialize(size_t x_length,
+                                                           size_t h_length);
 
 /* ---- wavelet (inc/simd/wavelet.h) ------------------------------------- */
 
@@ -241,6 +250,54 @@ int int32_to_int16(int simd, const int32_t *src, size_t length, int16_t *dst);
  * (arithmetic.h:92-127). */
 int float16_to_float(int simd, const uint16_t *src, size_t length,
                      float *dst);
+
+/* ---- arithmetic multiply/reduce family (inc/simd/arithmetic.h) -------- */
+
+/* The reference publishes these as header-only inline primitives; here they
+ * are linkable host-side C symbols with the same names and semantics so the
+ * reference's FFT-multiply pipelines (src/convolve.c:202-219) source-port
+ * directly.  Fixed-width block ops use the reference's AVX widths; `_na`
+ * twins keep the reference's scalar semantics (single element / pair for
+ * the block primitives — arithmetic.h:129-160).  Pure C, no Python. */
+
+#define VELES_SIMD_FLOAT_STEP 8     /* floats per block op (AVX width)     */
+#define VELES_SIMD_INT16MUL_STEP 16 /* int16 lanes per int16_multiply      */
+
+/* res[i] = a[i] * b[i], i = 0..7 (arithmetic.h:624-630). */
+void real_multiply(const float *a, const float *b, float *res);
+/* Single element: *res = *a * *b (arithmetic.h:129-132). */
+void real_multiply_na(const float *a, const float *b, float *res);
+/* res[j] = a[j] * b[j] over the whole array (arithmetic.h:638-651). */
+void real_multiply_array(const float *a, const float *b, size_t length,
+                         float *res);
+void real_multiply_array_na(const float *a, const float *b, size_t length,
+                            float *res);
+/* res[i] = array[i] * value (arithmetic.h:747-785). */
+void real_multiply_scalar(const float *array, size_t length, float value,
+                          float *res);
+void real_multiply_scalar_na(const float *array, size_t length, float value,
+                             float *res);
+/* 4 interleaved complex products per call (arithmetic.h:653-672). */
+void complex_multiply(const float *a, const float *b, float *res);
+/* One complex product (arithmetic.h:142-150). */
+void complex_multiply_na(const float *a, const float *b, float *res);
+/* Conjugate-b variants (arithmetic.h:674-693, :152-160). */
+void complex_multiply_conjugate(const float *a, const float *b, float *res);
+void complex_multiply_conjugate_na(const float *a, const float *b,
+                                   float *res);
+/* Negate imaginary lanes of an interleaved array (arithmetic.h:695-740). */
+void complex_conjugate(const float *array, size_t length, float *res);
+void complex_conjugate_na(const float *array, size_t length, float *res);
+/* Widening i16*i16 -> i32, 16 lanes (arithmetic.h:211-221). */
+void int16_multiply(const int16_t *a, const int16_t *b, int32_t *res);
+/* Horizontal sum (arithmetic.h:791-808). */
+float sum_elements(const float *input, size_t length);
+float sum_elements_na(const float *input, size_t length);
+/* output[j] = input[j] + value (arithmetic.h:815-830). */
+void add_to_all(const float *input, size_t length, float value,
+                float *output);
+void add_to_all_na(const float *input, size_t length, float value,
+                   float *output);
 
 /* ---- memory (inc/simd/memory.h:40-179) — pure C, no Python ------------ */
 

@@ -187,9 +187,10 @@ def _root_orbits(zr):
 # Daubechies half-band polynomial (a real root or a conjugate pair), the bit
 # says whether the published filter keeps the min-phase root (0) or its
 # reciprocal (1); ``mirror`` flips the finished filter.  Recovery method
-# (tools/check_wavelet_parity.py): evaluate the published row's z-transform
-# at both candidate roots with scale-normalized residuals to classify each
-# orbit, brute-force any ambiguous ones, accept on reconstruction match.
+# (tools/check_wavelet_parity.py — runnable): evaluate the published row's
+# z-transform at both candidate roots with scale-normalized residuals to
+# classify each orbit, brute-force any ambiguous ones, accept on
+# reconstruction match.
 # Rebuilding from these selections in exact arithmetic reproduces the
 # published rows to 5e-10 at orders ≤ 50; beyond that the published table's
 # own double-precision generation error grows smoothly (1e-8 at 62 up to
