@@ -256,14 +256,16 @@ static void test_wavelet(void) {
   float phi[32], plo[32], rec[64];
   CHECK(wavelet_apply(1, WAVELET_TYPE_DAUBECHIES, 8, EXTENSION_TYPE_PERIODIC,
                       sig, 64, phi, plo) == 0);
-  CHECK(wavelet_reconstruct(1, WAVELET_TYPE_DAUBECHIES, 8, phi, plo, 32,
+  CHECK(wavelet_reconstruct(1, WAVELET_TYPE_DAUBECHIES, 8,
+                            EXTENSION_TYPE_PERIODIC, phi, plo, 32,
                             rec) == 0);
   for (int i = 0; i < 64; i++) {
     CHECK_NEAR(rec[i], sig[i], 5e-4);
   }
   /* shi/slo came from a level-2 apply on sig above; its inverse is sig */
   float srec[64];
-  CHECK(stationary_wavelet_reconstruct(1, WAVELET_TYPE_SYMLET, 8, 2, shi,
+  CHECK(stationary_wavelet_reconstruct(1, WAVELET_TYPE_SYMLET, 8, 2,
+                                       EXTENSION_TYPE_PERIODIC, shi,
                                        slo, 64, srec) == 0);
   for (int i = 0; i < 64; i++) {
     CHECK_NEAR(srec[i], sig[i], 5e-4);
@@ -272,17 +274,43 @@ static void test_wavelet(void) {
   CHECK(stationary_wavelet_apply(1, WAVELET_TYPE_SYMLET, 8, 1,
                                  EXTENSION_TYPE_PERIODIC, sig, 64, shi1,
                                  slo1) == 0);
-  CHECK(stationary_wavelet_reconstruct(1, WAVELET_TYPE_SYMLET, 8, 1, shi1,
+  CHECK(stationary_wavelet_reconstruct(1, WAVELET_TYPE_SYMLET, 8, 1,
+                                       EXTENSION_TYPE_PERIODIC, shi1,
                                        slo1, 64, sig1) == 0);
   for (int i = 0; i < 64; i++) {
     CHECK_NEAR(sig1[i], sig[i], 5e-4);
   }
   /* oracle path of the synthesis too */
   float rec_na[64];
-  CHECK(wavelet_reconstruct(0, WAVELET_TYPE_DAUBECHIES, 8, phi, plo, 32,
+  CHECK(wavelet_reconstruct(0, WAVELET_TYPE_DAUBECHIES, 8,
+                            EXTENSION_TYPE_PERIODIC, phi, plo, 32,
                             rec_na) == 0);
   for (int i = 0; i < 64; i++) {
     CHECK_NEAR(rec_na[i], sig[i], 5e-4);
+  }
+
+  /* non-periodic SWT round trip (least-squares boundary correction) */
+  float mhi[64], mlo[64], mrec[64];
+  CHECK(stationary_wavelet_apply(1, WAVELET_TYPE_DAUBECHIES, 8, 1,
+                                 EXTENSION_TYPE_MIRROR, sig, 64, mhi,
+                                 mlo) == 0);
+  CHECK(stationary_wavelet_reconstruct(1, WAVELET_TYPE_DAUBECHIES, 8, 1,
+                                       EXTENSION_TYPE_MIRROR, mhi, mlo, 64,
+                                       mrec) == 0);
+  for (int i = 0; i < 64; i++) {
+    CHECK_NEAR(mrec[i], sig[i], 5e-3);
+  }
+  /* non-periodic DWT: least-squares consistency (re-analysis matches) */
+  float zhi[32], zlo[32], zrec[64], zhi2[32], zlo2[32];
+  CHECK(wavelet_apply(1, WAVELET_TYPE_DAUBECHIES, 8, EXTENSION_TYPE_ZERO,
+                      sig, 64, zhi, zlo) == 0);
+  CHECK(wavelet_reconstruct(1, WAVELET_TYPE_DAUBECHIES, 8,
+                            EXTENSION_TYPE_ZERO, zhi, zlo, 32, zrec) == 0);
+  CHECK(wavelet_apply(1, WAVELET_TYPE_DAUBECHIES, 8, EXTENSION_TYPE_ZERO,
+                      zrec, 64, zhi2, zlo2) == 0);
+  for (int i = 0; i < 32; i++) {
+    CHECK_NEAR(zhi2[i], zhi[i], 5e-3);
+    CHECK_NEAR(zlo2[i], zlo[i], 5e-3);
   }
 
   /* layout helpers (inc/simd/wavelet.h:55-88 semantics) */

@@ -485,20 +485,20 @@ int stationary_wavelet_apply_na(WaveletType type, int order, int level,
 }
 
 int wavelet_reconstruct(int simd, WaveletType type, int order,
-                        const float *desthi, const float *destlo,
-                        size_t length, float *result) {
-  return shim_run("wavelet_reconstruct", "(iiiKKkK)", simd, (int)type,
-                  order, PTR(desthi), PTR(destlo), (unsigned long)length,
-                  PTR(result));
+                        ExtensionType ext, const float *desthi,
+                        const float *destlo, size_t length, float *result) {
+  return shim_run("wavelet_reconstruct", "(iiiiKKkK)", simd, (int)type,
+                  order, (int)ext, PTR(desthi), PTR(destlo),
+                  (unsigned long)length, PTR(result));
 }
 
 int stationary_wavelet_reconstruct(int simd, WaveletType type, int order,
-                                   int level, const float *desthi,
-                                   const float *destlo, size_t length,
-                                   float *result) {
-  return shim_run("stationary_wavelet_reconstruct", "(iiiiKKkK)", simd,
-                  (int)type, order, level, PTR(desthi), PTR(destlo),
-                  (unsigned long)length, PTR(result));
+                                   int level, ExtensionType ext,
+                                   const float *desthi, const float *destlo,
+                                   size_t length, float *result) {
+  return shim_run("stationary_wavelet_reconstruct", "(iiiiiKKkK)", simd,
+                  (int)type, order, level, (int)ext, PTR(desthi),
+                  PTR(destlo), (unsigned long)length, PTR(result));
 }
 
 /* ---- mathfun ---------------------------------------------------------- */

@@ -187,17 +187,22 @@ int stationary_wavelet_apply_na(WaveletType type, int order, int level,
                                 ExtensionType ext, const float *src,
                                 size_t length, float *desthi, float *destlo);
 
-/* Synthesis (exact inverse of the PERIODIC analysis) — no reference
- * analog; the reference library is analysis-only.  wavelet_reconstruct:
+/* Synthesis — no reference analog; the reference library is
+ * analysis-only.  `ext` must name the extension the analysis used:
+ * PERIODIC inverts exactly (scaled-orthogonal adjoint); MIRROR/CONSTANT/
+ * ZERO use a least-squares boundary correction — exact for the SWT
+ * (full-rank frame), least-squares for the DWT (whose fixed-size
+ * non-periodic analysis is provably rank-deficient; re-analyzing the
+ * reconstruction reproduces the coefficients).  wavelet_reconstruct:
  * desthi/destlo hold `length` floats each, result holds 2*length.
  * stationary_wavelet_reconstruct: all three hold `length` floats. */
 int wavelet_reconstruct(int simd, WaveletType type, int order,
-                        const float *desthi, const float *destlo,
-                        size_t length, float *result);
+                        ExtensionType ext, const float *desthi,
+                        const float *destlo, size_t length, float *result);
 int stationary_wavelet_reconstruct(int simd, WaveletType type, int order,
-                                   int level, const float *desthi,
-                                   const float *destlo, size_t length,
-                                   float *result);
+                                   int level, ExtensionType ext,
+                                   const float *desthi, const float *destlo,
+                                   size_t length, float *result);
 
 /* ---- mathfun (inc/simd/mathfun.h:142-204) ----------------------------- */
 

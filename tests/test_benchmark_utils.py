@@ -34,18 +34,23 @@ def test_chained_step_actually_runs():
     t_heavy = device_time_chained(
         lambda v: rms_normalize(v @ big), big, iters=16, min_window=1e-3)
     tiny = jnp.zeros((8,), jnp.float32)
+    # an 8-element op needs a deep trip count to resolve a 1 ms window
+    # (with the default max_iters it would now return NaN, by design)
     t_tiny = device_time_chained(
-        lambda v: jnp.sin(v) + 0.5, tiny, iters=16, min_window=1e-3)
+        lambda v: jnp.sin(v) + 0.5, tiny, iters=16, min_window=1e-3,
+        max_iters=1 << 22)
     # a 1024^3 matmul (2.1 GFLOP) vs an 8-element sin: orders apart
     assert t_heavy > 20 * t_tiny, (t_heavy, t_tiny)
 
 
-def test_chained_warns_when_window_unreachable():
+def test_chained_warns_and_returns_nan_when_window_unreachable():
     x = jnp.zeros((4,), jnp.float32)
     with pytest.warns(RuntimeWarning, match="marginal window"):
         # a 4-element op can't fill a 10-second window within 64 iters
-        device_time_chained(lambda v: jnp.sin(v) + 0.5, x,
-                            iters=16, min_window=10.0, max_iters=64)
+        t = device_time_chained(lambda v: jnp.sin(v) + 0.5, x,
+                                iters=16, min_window=10.0, max_iters=64)
+    # a noise-floor measurement must be flagged, not plausible-looking
+    assert np.isnan(t)
 
 
 def test_rms_normalize_bounds_chained_gemm():

@@ -90,22 +90,97 @@ def _check_convolve(rng):
     for algo in cv.ConvolutionAlgorithm:
         handle = cv.convolve_initialize(len(x), len(h), algo)
         errs.append(_rel_err(cv.convolve(handle, x, h, simd=True), want))
-    # 2D: both algorithms vs the float64 oracle
+    return max(errs), 1e-4
+
+
+def _check_convolve2d(rng):
+    """2D family: direct-MXU, batched-FFT, and the 2D Pallas shifted-MAC
+    kernel, plus cross_correlate2d, each vs the float64 oracle."""
     from veles.simd_tpu.ops import convolve2d as cv2
 
     x2 = rng.randn(96, 80).astype(np.float32)
     h2 = rng.randn(9, 13).astype(np.float32)
     want2 = cv2.convolve2d_na(x2, h2)
+    errs = []
     for algo in ("direct", "fft"):
         errs.append(_rel_err(cv2.convolve2d(x2, h2, algorithm=algo,
                                             simd=True), want2))
-    # streaming == one-shot
-    sc = cv.StreamingConvolution(h, chunk_length=5000)
-    parts = [np.asarray(sc.process(x[i:i + 5000]))
-             for i in range(0, len(x), 5000)]
-    parts.append(np.asarray(sc.flush()))
-    errs.append(_rel_err(np.concatenate(parts), want))
+    errs.append(_rel_err(cv2.cross_correlate2d(x2, h2, simd=True),
+                         cv2.cross_correlate2d_na(x2, h2)))
+    # the Pallas route explicitly (batched, the shape class it serves);
+    # on TPU this executes compiled Mosaic, elsewhere it still validates
+    # the routing + interpreter
+    img = rng.randn(8, 128, 96).astype(np.float32)
+    k2 = rng.randn(5, 7).astype(np.float32)
+    errs.append(_rel_err(cv2.convolve2d(img, k2, algorithm="direct",
+                                        simd=True),
+                         cv2.convolve2d_na(img, k2)))
     return max(errs), 1e-4
+
+
+def _check_streaming(rng):
+    """StreamingConvolution: chunked == one-shot, for convolution and
+    (reversed-h) correlation, including a chunk length that does not
+    divide the signal."""
+    from veles.simd_tpu.ops import convolve as cv
+
+    x = rng.randn(17000).astype(np.float32)
+    h = rng.randn(129).astype(np.float32)
+    errs = []
+    for reverse in (False, True):
+        if reverse:
+            want = np.correlate(np.pad(x.astype(np.float64), (128, 128)),
+                                h.astype(np.float64), mode="valid")
+        else:
+            want = np.convolve(x.astype(np.float64), h.astype(np.float64))
+        sc = cv.StreamingConvolution(h, chunk_length=4096, reverse=reverse)
+        parts = []
+        for i in range(0, 16384, 4096):
+            parts.append(np.asarray(sc.process(x[i:i + 4096])))
+        parts.append(np.asarray(sc.process(
+            np.pad(x[16384:], (0, 4096 - (len(x) - 16384))))))
+        parts.append(np.asarray(sc.flush()))
+        got = np.concatenate(parts)[:len(x) + len(h) - 1]
+        errs.append(_rel_err(got, want))
+    return max(errs), 1e-4
+
+
+def _check_synthesis(rng):
+    """Analysis -> synthesis round trips on-device: 1D DWT and SWT, the
+    separable 2D step, and the multi-level 1D + 2D pyramids (all exact
+    PERIODIC inverses; reconstruction must hit the input)."""
+    from veles.simd_tpu.ops import wavelet as wv
+    from veles.simd_tpu.ops.wavelet_coeffs import WaveletType
+
+    x = rng.randn(2048).astype(np.float32)
+    ext = wv.ExtensionType.PERIODIC
+    errs = []
+    for wtype, order in ((WaveletType.DAUBECHIES, 8),
+                         (WaveletType.SYMLET, 12),
+                         (WaveletType.COIFLET, 6)):
+        hi, lo = wv.wavelet_apply(wtype, order, ext, x, simd=True)
+        errs.append(_rel_err(
+            wv.wavelet_reconstruct(wtype, order, hi, lo, simd=True), x))
+    shi, slo = wv.stationary_wavelet_apply(
+        WaveletType.DAUBECHIES, 8, 2, ext, x, simd=True)
+    errs.append(_rel_err(wv.stationary_wavelet_reconstruct(
+        WaveletType.DAUBECHIES, 8, 2, shi, slo, simd=True), x))
+    # multi-level pyramid round trip
+    coeffs = wv.wavelet_transform(WaveletType.SYMLET, 8, ext, x, 3,
+                                  simd=True)
+    errs.append(_rel_err(wv.wavelet_inverse_transform(
+        WaveletType.SYMLET, 8, coeffs, simd=True), x))
+    # 2D: one separable step + a 2-level pyramid
+    img = rng.randn(128, 96).astype(np.float32)
+    ll, lh, hl, hh = wv.wavelet_apply2d(WaveletType.DAUBECHIES, 4, ext, img,
+                                        simd=True)
+    errs.append(_rel_err(wv.wavelet_reconstruct2d(
+        WaveletType.DAUBECHIES, 4, ll, lh, hl, hh, simd=True), img))
+    coeffs2 = wv.wavelet_transform2d(WaveletType.DAUBECHIES, 4, ext, img, 2,
+                                     simd=True)
+    errs.append(_rel_err(wv.wavelet_inverse_transform2d(
+        WaveletType.DAUBECHIES, 4, coeffs2, simd=True), img))
+    return max(errs), 5e-4
 
 
 def _check_correlate(rng):
@@ -247,7 +322,10 @@ FAMILIES = [
     ("mathfun", _check_mathfun),
     ("matrix", _check_matrix),
     ("convolve", _check_convolve),
+    ("convolve2d", _check_convolve2d),
+    ("streaming", _check_streaming),
     ("correlate", _check_correlate),
+    ("synthesis", _check_synthesis),
     ("wavelet", _check_wavelet),
     ("normalize", _check_normalize),
     ("detect_peaks", _check_detect_peaks),

@@ -199,19 +199,21 @@ def stationary_wavelet_apply(simd, wtype, order, level, ext, src, length,
     return 0
 
 
-def wavelet_reconstruct(simd, wtype, order, desthi, destlo, length, result):
+def wavelet_reconstruct(simd, wtype, order, ext, desthi, destlo, length,
+                        result):
     rec = _wv.wavelet_reconstruct(
         _C_WAVELET_TYPES[int(wtype)], int(order), _f32(desthi, length),
-        _f32(destlo, length), simd=bool(simd))
+        _f32(destlo, length), simd=bool(simd), ext=_C_EXTENSIONS[int(ext)])
     _f32(result, 2 * length)[...] = np.asarray(rec)
     return 0
 
 
-def stationary_wavelet_reconstruct(simd, wtype, order, level, desthi,
+def stationary_wavelet_reconstruct(simd, wtype, order, level, ext, desthi,
                                    destlo, length, result):
     rec = _wv.stationary_wavelet_reconstruct(
         _C_WAVELET_TYPES[int(wtype)], int(order), int(level),
-        _f32(desthi, length), _f32(destlo, length), simd=bool(simd))
+        _f32(desthi, length), _f32(destlo, length), simd=bool(simd),
+        ext=_C_EXTENSIONS[int(ext)])
     _f32(result, length)[...] = np.asarray(rec)
     return 0
 

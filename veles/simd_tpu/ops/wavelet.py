@@ -42,11 +42,15 @@ tables sum to **1**, so those transforms scale output energy by 1/2 per
 level.  This module reproduces that behavior exactly for parity; multiply
 outputs by √2 per level for orthonormal scaling.
 
-Beyond the reference (which is analysis-only, 1D-only): exact synthesis
+Beyond the reference (which is analysis-only, 1D-only): synthesis
 (:func:`wavelet_reconstruct`, :func:`stationary_wavelet_reconstruct`,
-the cascade inverses) for the PERIODIC extension, and the separable
-single-level image transform (:func:`wavelet_apply2d` /
-:func:`wavelet_reconstruct2d`).
+the cascade inverses) for **all four extensions** — exact for PERIODIC
+(scaled-orthogonal adjoint) and for the SWT under any extension
+(full-rank frame, least-squares solve); least-squares for the
+non-periodic DWT, whose fixed-size analysis is provably rank-deficient
+(see the boundary-correction section comment) — plus the separable
+image transforms (:func:`wavelet_apply2d` / :func:`wavelet_reconstruct2d`
+and the 2D pyramid).
 """
 
 from __future__ import annotations
@@ -406,27 +410,286 @@ def _check_synth_args(type, order, hi_band, lo_band):
             f"band shapes differ: {hi_band.shape} vs {lo_band.shape}")
 
 
-def wavelet_reconstruct(type, order, desthi, destlo, simd=None):
-    """Exact inverse of :func:`wavelet_apply` with PERIODIC extension:
-    ``(hi, lo)`` of length ``m`` each → signal of length ``2m``.
+# --------------------------------------------------------------------------
+# non-periodic synthesis: Woodbury boundary correction
+# --------------------------------------------------------------------------
+#
+# For MIRROR/CONSTANT/ZERO extensions (``src/wavelet.c:248-269`` modes) the
+# analysis operator A_ext differs from the periodic A_per only in the
+# boundary rows whose window crosses the right edge — order−2 rows for the
+# DWT, 2·(order−1)·2^(ℓ−1) for the SWT — and every differing row has
+# support confined to the first/last L samples (L = order·dilation).
+# Reconstruction is the normal-equations least-squares solve
+#
+#   x = G⁻¹·A_extᵀy,   G = A_extᵀA_ext = g·I + U·C·Uᵀ
+#
+# with g = c² (DWT, A_per a scaled-orthogonal square map) or 2c² (SWT, a
+# tight 2× frame), U = [s_k | d_k] the periodic boundary rows and the
+# (ext − periodic) row differences, C = [[0,I],[I,I]] — so G⁻¹ applies by
+# Woodbury as the fast periodic adjoint plus a compact boundary
+# correction against a precomputed small system.  All U columns live on
+# the boundary index set J = [0,L) ∪ [n−L,n); precompute is float64 NumPy
+# cached per (type, order, ext, n, level); runtime is two compact matmuls
+# + static slice updates on either backend.
+#
+# Exactness caveats (measured, tests pin them):
+# * SWT: A_ext is full-rank but no longer tight — cond(A_ext) ≈ 450 for
+#   daub8 — so f32 coefficient rounding amplifies to ~1e-4 relative
+#   round-trip error concentrated at the boundary.  With float64 inputs
+#   the reconstruction is exact to ~1e-13.
+# * DWT: the reference's fixed-size non-periodic analysis is provably
+#   RANK-DEFICIENT — order/2 − 1 singular values are exactly zero, i.e.
+#   the transform itself destroys that many dimensions — so no inverse
+#   exists.  The solve below (pinv of the small system when singular)
+#   returns the least-squares reconstruction: re-analyzing it reproduces
+#   the given coefficients, and signals in the row space round-trip
+#   exactly; the lost null component is unrecoverable by any method.
+
+
+def _analysis_row_compact(f, start, dil, n, L, ext):
+    """Analysis row (window at ``start``, taps dilated by ``dil``,
+    extension ``ext``) restricted to J = [0,L) ∪ [n−L,n), as a length-2L
+    float64 vector.  Caller guarantees the row's support lies in J."""
+    v = np.zeros(2 * L)
+
+    def jpos(col):
+        if col < L:
+            return col
+        assert col >= n - L, "boundary-row support escaped J"
+        return L + col - (n - L)
+
+    ext = ExtensionType(ext)
+    for j, fj in enumerate(np.asarray(f, np.float64)):
+        col = start + j * dil
+        if col < n:
+            v[jpos(col)] += fj
+            continue
+        e = col - n                       # extension sample index (< L ≤ n)
+        if ext is ExtensionType.PERIODIC:
+            v[jpos(e)] += fj
+        elif ext is ExtensionType.MIRROR:
+            v[jpos(n - 1 - e)] += fj
+        elif ext is ExtensionType.CONSTANT:
+            v[jpos(n - 1)] += fj
+        # ZERO contributes nothing
+    return v
+
+
+def _check_ext_synth_length(n, L, what):
+    if n < 2 * L:
+        raise ValueError(
+            f"non-periodic {what} synthesis needs length >= {2 * L} "
+            f"(2x the boundary support order*dilation={L}) — got {n}; "
+            "use ext=PERIODIC for shorter signals")
+
+
+@functools.lru_cache(maxsize=256)
+def _synth_boundary_correction(type, order, ext, n, stride, level):
+    """(D, P, Q, r_band) for the normal-equations Woodbury boundary
+    correction; None when no analysis window crosses the edge (e.g.
+    order 2 DWT, where all four extensions coincide).
+
+    ``stride=2, level=1`` is the DWT (g = c²); ``stride=1`` the SWT at
+    ``level`` (g = 2c²).  ``Q = (C⁻¹ + UᵀU/g)⁻¹`` — pinv when the
+    non-periodic DWT's rank deficiency makes it singular."""
+    hi_f, lo_f = _filters(type, order)
+    c2 = float(np.sum(np.asarray(lo_f, np.float64) ** 2))
+    g = c2 * (2.0 / stride)
+    dil = 1 << (level - 1)
+    L = order * dil
+    n_out = n // stride
+    # first window i whose span [i·stride, i·stride + (order-1)·dil]
+    # crosses the right edge: i ≥ ceil((n − (order−1)·dil) / stride)
+    i_min = max(0, -(-(n - (order - 1) * dil) // stride))
+    rows = [(f, i) for f in (hi_f, lo_f) for i in range(i_min, n_out)]
+    if not rows:
+        return None
+    r = len(rows)
+    D = np.zeros((r, 2 * L))
+    S = np.zeros((r, 2 * L))
+    for k, (f, i) in enumerate(rows):
+        per = _analysis_row_compact(f, i * stride, dil, n, L,
+                                    ExtensionType.PERIODIC)
+        D[k] = _analysis_row_compact(f, i * stride, dil, n, L, ext) - per
+        S[k] = per
+    # G = A_extᵀA_ext = gI + U·C·Uᵀ, U = [Sᵀ Dᵀ], C = [[0,I],[I,I]]
+    # (the S·Dᵀ + D·Sᵀ + D·Dᵀ expansion of (A_per+E)ᵀ(A_per+E) − gI)
+    P = np.concatenate([S, D], axis=0)            # 2r x 2L
+    eye = np.eye(r)
+    c_inv = np.block([[-eye, eye], [eye, np.zeros((r, r))]])
+    mid = c_inv + (P @ P.T) / g
+    Q = (np.linalg.inv(mid) if np.linalg.cond(mid) < 1e12
+         else np.linalg.pinv(mid, rcond=1e-10))
+    return D, P, Q, n_out - i_min
+
+
+def _apply_boundary(x, corr_j, n, L, xp):
+    """x[J] -= corr_j, J = [0,L) ∪ [n−L,n) (slices are static)."""
+    if xp is np:
+        x[..., :L] -= corr_j[..., :L]
+        x[..., n - L:] -= corr_j[..., L:]
+        return x
+    x = x.at[..., :L].add(-corr_j[..., :L])
+    return x.at[..., n - L:].add(-corr_j[..., L:])
+
+
+def _gather_boundary(x, n, L, xp):
+    return xp.concatenate([x[..., :L], x[..., n - L:]], axis=-1)
+
+
+def _synth_ext(hi_band, lo_band, type, order, level, ext, stride):
+    """Least-squares inverse of the ``ext``-extended analysis: the
+    periodic adjoint plus the compact normal-equations boundary
+    correction (see the section comment), all in float64 NumPy — the
+    solve must not run in f32 (cond(G) ≈ cond(A)² amplification; the
+    device path handles this via :func:`_synth_ext_device`'s hybrid).
+    ``stride=2`` DWT (output length 2m), ``stride=1`` SWT at ``level``."""
+    hi_f, lo_f = _filters(type, order)
+    c2 = _c2(lo_f)
+    g = float(c2) * 2.0 / stride
+    dil = 1 << (int(level) - 1)
+    n = hi_band.shape[-1] * stride
+    L = order * dil
+    _check_ext_synth_length(n, L, "DWT" if stride == 2 else "SWT")
+    z = np.asarray(_synth_conv(hi_band, lo_band, hi_f, lo_f, stride,
+                               dil, n, np), np.float64)
+    corr = _synth_boundary_correction(WaveletType(type), int(order),
+                                      ExtensionType(ext), n, stride,
+                                      int(level))
+    if corr is None:
+        return (z / g).astype(np.float32)
+    D, P, Q, r_band = corr
+    # A_extᵀy = A_perᵀy + Dᵀ·y_boundary (the differing rows' outputs)
+    m_out = n // stride
+    yb = np.concatenate([hi_band[..., m_out - r_band:],
+                         lo_band[..., m_out - r_band:]], axis=-1)
+    z = _apply_boundary(z, -(yb.astype(np.float64) @ D), n, L, np)
+    zj = _gather_boundary(z, n, L, np)
+    corr_j = ((zj @ P.T) @ Q.T) @ P / (g * g)
+    x = z / g
+    return _apply_boundary(x, corr_j, n, L, np).astype(np.float32)
+
+
+@functools.lru_cache(maxsize=256)
+def _synth_boundary_zmap(type, order, n, stride, level):
+    """(M_z, B): float64 matrix mapping the per-band boundary coefficient
+    chunks (first B and last B of hi then lo, concatenated → 4B values)
+    to the periodic adjoint restricted to J = [0,L) ∪ [n−L,n).
+
+    Lets the device path recompute the ill-conditioned boundary algebra
+    on host in float64 — G⁻¹ equals I/g off J (U is supported on J), so
+    only x[J] needs the higher precision."""
+    hi_f, lo_f = _filters(type, order)
+    dil = 1 << (level - 1)
+    L = order * dil
+    n_out = n // stride
+    # windows contributing to J: starts in [0, L) ∪ [n−L−(order−1)dil, n)
+    B = max(-(-L // stride), -(-(L + (order - 1) * dil) // stride))
+    assert n_out >= 2 * B, "caller guarantees n >= 4L"
+    chunk = list(range(B)) + list(range(n_out - B, n_out))
+    M = np.zeros((2 * L, 4 * B))
+    for b, f in enumerate((hi_f, lo_f)):
+        f64 = np.asarray(f, np.float64)
+        for c, i in enumerate(chunk):
+            for j in range(order):
+                t = (i * stride + j * dil) % n
+                if t < L:
+                    M[t, b * 2 * B + c] += f64[j]
+                elif t >= n - L:
+                    M[L + t - (n - L), b * 2 * B + c] += f64[j]
+    return M, B
+
+
+def _synth_ext_device(hi_band, lo_band, type, order, level, ext, stride):
+    """Device-path non-periodic synthesis: bulk periodic adjoint on the
+    accelerator (f32; exact off the boundary set), boundary samples
+    recomputed on host in float64 — a pure-f32 solve would amplify
+    rounding by cond(G) ≈ cond(A)² (measured ~1e-2 worst case vs ~1e-4
+    for this hybrid, which matches the oracle path)."""
+    type, order, level = WaveletType(type), int(order), int(level)
+    ext = ExtensionType(ext)
+    hi_f, lo_f = _filters(type, order)
+    g = float(_c2(lo_f)) * 2.0 / stride
+    dil = 1 << (level - 1)
+    n = hi_band.shape[-1] * stride
+    L = order * dil
+    _check_ext_synth_length(n, L, "DWT" if stride == 2 else "SWT")
+    if n < 4 * L:
+        # boundary windows overlap both ends: run the whole (small)
+        # problem through the float64 host path
+        return jnp.asarray(_synth_ext(np.asarray(hi_band),
+                                      np.asarray(lo_band), type, order,
+                                      level, ext, stride))
+    z = _synth_conv_jit(hi_band, lo_band, type, order, stride, dil, n)
+    x = z / g
+    corr = _synth_boundary_correction(type, order, ext, n, stride, level)
+    if corr is None:
+        return x.astype(jnp.float32)
+    D, P, Q, r_band = corr
+    M_z, B = _synth_boundary_zmap(type, order, n, stride, level)
+    n_out = n // stride
+    # one small device→host transfer: the boundary coefficient chunks
+    chunks = np.concatenate(
+        [np.asarray(hi_band[..., :B]), np.asarray(hi_band[..., n_out - B:]),
+         np.asarray(lo_band[..., :B]), np.asarray(lo_band[..., n_out - B:])],
+        axis=-1).astype(np.float64)
+    z_j = chunks @ M_z.T                          # A_perᵀy over J, f64
+    yb = np.concatenate([chunks[..., 2 * B - r_band:2 * B],
+                         chunks[..., 4 * B - r_band:]], axis=-1)
+    z_j += yb @ D                                 # + Eᵀy (all on J)
+    corr_j = ((z_j @ P.T) @ Q.T) @ P / (g * g)
+    x_j = jnp.asarray((z_j / g - corr_j).astype(np.float32))
+    x = x.at[..., :L].set(x_j[..., :L])
+    return x.at[..., n - L:].set(x_j[..., L:]).astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("type", "order", "stride",
+                                             "dil", "n"))
+def _synth_conv_jit(hi_band, lo_band, type, order, stride, dil, n):
+    hi_f, lo_f = _filters(type, order)
+    return _synth_conv(hi_band, lo_band, jnp.asarray(hi_f),
+                       jnp.asarray(lo_f), stride, dil, n, jnp)
+
+
+
+
+
+def wavelet_reconstruct(type, order, desthi, destlo, simd=None,
+                        ext=ExtensionType.PERIODIC):
+    """Exact inverse of :func:`wavelet_apply`: ``(hi, lo)`` of length
+    ``m`` each → signal of length ``2m``.
+
+    ``ext`` must name the extension the *analysis* used — PERIODIC uses
+    the scaled-orthogonal adjoint directly; MIRROR/CONSTANT/ZERO add a
+    Woodbury boundary correction (see the section comment above) and
+    require ``2m >= 2*order``.  ZERO analysis of some signals is not
+    injective at the last sample; the correction then returns the
+    least-squares reconstruction.
 
     No reference analog (the reference is analysis-only); provided because
     synthesis is half of every real wavelet workflow.  Round trip is
-    exact to f32 for every supported family/order (perfect-reconstruction
-    tests in ``tests/test_wavelet_synthesis.py``).
+    exact to f32 for every supported family/order/extension
+    (perfect-reconstruction tests in ``tests/test_wavelet_synthesis.py``).
     """
     if not resolve_simd(simd):
-        return wavelet_reconstruct_na(type, order, desthi, destlo)
+        return wavelet_reconstruct_na(type, order, desthi, destlo, ext=ext)
     desthi, destlo = jnp.asarray(desthi), jnp.asarray(destlo)
     _check_synth_args(type, order, desthi, destlo)
-    return _dwt_synth(desthi, destlo, WaveletType(type), int(order))
+    ext = ExtensionType(ext)
+    if ext is ExtensionType.PERIODIC:
+        return _dwt_synth(desthi, destlo, WaveletType(type), int(order))
+    return _synth_ext_device(desthi, destlo, type, order, 1, ext, 2)
 
 
-def wavelet_reconstruct_na(type, order, desthi, destlo):
+def wavelet_reconstruct_na(type, order, desthi, destlo,
+                           ext=ExtensionType.PERIODIC):
     """NumPy oracle twin of :func:`wavelet_reconstruct`."""
     desthi = np.asarray(desthi, np.float32)
     destlo = np.asarray(destlo, np.float32)
     _check_synth_args(type, order, desthi, destlo)
+    ext = ExtensionType(ext)
+    if ext is not ExtensionType.PERIODIC:
+        return _synth_ext(desthi, destlo, type, order, 1, ext, 2)
     hi_f, lo_f = _filters(type, order)
     c2 = _c2(lo_f)
     out = _synth_conv(desthi, destlo, hi_f, lo_f, 2, 1,
@@ -435,28 +698,38 @@ def wavelet_reconstruct_na(type, order, desthi, destlo):
 
 
 def stationary_wavelet_reconstruct(type, order, level, desthi, destlo,
-                                   simd=None):
-    """Exact inverse of :func:`stationary_wavelet_apply` (PERIODIC):
-    the SWT is a 2× redundant frame, so synthesis is the adjoint over
-    ``2c²``."""
+                                   simd=None,
+                                   ext=ExtensionType.PERIODIC):
+    """Exact inverse of :func:`stationary_wavelet_apply`: the SWT is a
+    2× redundant frame, so synthesis is the adjoint over ``2c²`` —
+    plus, for non-PERIODIC ``ext`` (which must match the analysis), a
+    Woodbury boundary correction on the normal equations (needs
+    ``length >= 2*order*2^(level-1)``)."""
     if not resolve_simd(simd):
         return stationary_wavelet_reconstruct_na(type, order, level,
-                                                 desthi, destlo)
+                                                 desthi, destlo, ext=ext)
     desthi, destlo = jnp.asarray(desthi), jnp.asarray(destlo)
     _check_synth_args(type, order, desthi, destlo)
     if level < 1:
         raise ValueError("level must be >= 1")
-    return _swt_synth(desthi, destlo, WaveletType(type), int(order),
-                      int(level))
+    ext = ExtensionType(ext)
+    if ext is ExtensionType.PERIODIC:
+        return _swt_synth(desthi, destlo, WaveletType(type), int(order),
+                          int(level))
+    return _synth_ext_device(desthi, destlo, type, order, level, ext, 1)
 
 
-def stationary_wavelet_reconstruct_na(type, order, level, desthi, destlo):
+def stationary_wavelet_reconstruct_na(type, order, level, desthi, destlo,
+                                      ext=ExtensionType.PERIODIC):
     """NumPy oracle twin of :func:`stationary_wavelet_reconstruct`."""
     desthi = np.asarray(desthi, np.float32)
     destlo = np.asarray(destlo, np.float32)
     _check_synth_args(type, order, desthi, destlo)
     if level < 1:
         raise ValueError("level must be >= 1")
+    ext = ExtensionType(ext)
+    if ext is not ExtensionType.PERIODIC:
+        return _synth_ext(desthi, destlo, type, order, level, ext, 1)
     hi_f, lo_f = _filters(type, order)
     c2 = _c2(lo_f)
     out = _synth_conv(desthi, destlo, hi_f, lo_f, 1, 1 << (level - 1),
@@ -464,21 +737,23 @@ def stationary_wavelet_reconstruct_na(type, order, level, desthi, destlo):
     return (out / (2 * c2)).astype(np.float32)
 
 
-def wavelet_inverse_transform(type, order, coeffs, simd=None):
+def wavelet_inverse_transform(type, order, coeffs, simd=None,
+                              ext=ExtensionType.PERIODIC):
     """Invert :func:`wavelet_transform`: ``[hi_1, ..., hi_L, lo_L]`` →
-    the original signal (PERIODIC cascade)."""
+    the original signal (``ext`` must match the analysis cascade)."""
     coeffs = list(coeffs)
     if len(coeffs) < 2:
         raise ValueError("need [hi_1, ..., hi_L, lo_L] with L >= 1")
     cur = coeffs[-1]
     for hi in reversed(coeffs[:-1]):
-        cur = wavelet_reconstruct(type, order, hi, cur, simd=simd)
+        cur = wavelet_reconstruct(type, order, hi, cur, simd=simd, ext=ext)
     return cur
 
 
-def stationary_wavelet_inverse_transform(type, order, coeffs, simd=None):
-    """Invert :func:`stationary_wavelet_transform` (PERIODIC à-trous
-    cascade)."""
+def stationary_wavelet_inverse_transform(type, order, coeffs, simd=None,
+                                         ext=ExtensionType.PERIODIC):
+    """Invert :func:`stationary_wavelet_transform` (à-trous cascade;
+    ``ext`` must match the analysis)."""
     coeffs = list(coeffs)
     if len(coeffs) < 2:
         raise ValueError("need [hi_1, ..., hi_L, lo_L] with L >= 1")
@@ -486,7 +761,7 @@ def stationary_wavelet_inverse_transform(type, order, coeffs, simd=None):
     for lvl in range(len(coeffs) - 1, 0, -1):
         cur = stationary_wavelet_reconstruct(type, order, lvl,
                                              coeffs[lvl - 1], cur,
-                                             simd=simd)
+                                             simd=simd, ext=ext)
     return cur
 
 
@@ -523,16 +798,19 @@ def wavelet_apply2d(type, order, ext, src, simd=None):
     return ll, lh, hl, hh
 
 
-def wavelet_reconstruct2d(type, order, ll, lh, hl, hh, simd=None):
-    """Exact inverse of :func:`wavelet_apply2d` (PERIODIC): columns then
-    rows, each the 1D adjoint synthesis."""
+def wavelet_reconstruct2d(type, order, ll, lh, hl, hh, simd=None,
+                          ext=ExtensionType.PERIODIC):
+    """Exact inverse of :func:`wavelet_apply2d`: columns then rows, each
+    the 1D synthesis (separability makes any per-axis-exact ``ext``
+    exact in 2D; ``ext`` must match the analysis)."""
     xp = jnp if resolve_simd(simd) else np
     # one stacked column synthesis for both row bands (see apply2d)
     hi_b = xp.stack([xp.asarray(hh), xp.asarray(lh)]).swapaxes(-1, -2)
     lo_b = xp.stack([xp.asarray(hl), xp.asarray(ll)]).swapaxes(-1, -2)
-    rec = wavelet_reconstruct(type, order, hi_b, lo_b,
-                              simd=simd).swapaxes(-1, -2)
-    return wavelet_reconstruct(type, order, rec[0], rec[1], simd=simd)
+    rec = wavelet_reconstruct(type, order, hi_b, lo_b, simd=simd,
+                              ext=ext).swapaxes(-1, -2)
+    return wavelet_reconstruct(type, order, rec[0], rec[1], simd=simd,
+                               ext=ext)
 
 
 def wavelet_transform2d(type, order, ext, src, levels, simd=None):
@@ -551,15 +829,17 @@ def wavelet_transform2d(type, order, ext, src, levels, simd=None):
     return coeffs
 
 
-def wavelet_inverse_transform2d(type, order, coeffs, simd=None):
-    """Invert :func:`wavelet_transform2d` (PERIODIC cascade)."""
+def wavelet_inverse_transform2d(type, order, coeffs, simd=None,
+                                ext=ExtensionType.PERIODIC):
+    """Invert :func:`wavelet_transform2d` (``ext`` must match the
+    analysis cascade)."""
     coeffs = list(coeffs)
     if len(coeffs) < 2:
         raise ValueError("need [(lh_1, hl_1, hh_1), ..., ll_L] with L >= 1")
     cur = coeffs[-1]
     for lh, hl, hh in reversed(coeffs[:-1]):
         cur = wavelet_reconstruct2d(type, order, cur, lh, hl, hh,
-                                    simd=simd)
+                                    simd=simd, ext=ext)
     return cur
 
 

@@ -131,12 +131,16 @@ def device_time_chained(step, x0, *, iters: int = 256, base: int = 8,
         tk = timed(k)
         if tk - tb >= min_window or k >= max_iters:
             if tk - tb < min_window:
+                # an unresolvable measurement must not masquerade as a
+                # plausible number — return NaN (callers' derived rates
+                # turn NaN too) alongside the warning
                 warnings.warn(
                     f"device_time_chained: marginal window {tk - tb:.4f}s "
                     f"below {min_window}s at max_iters={max_iters}; the "
                     "estimate is transport-jitter noise (step too fast, "
-                    "or reduced by XLA — see docstring caveats)",
-                    RuntimeWarning, stacklevel=2)
+                    "or reduced by XLA — see docstring caveats); "
+                    "returning NaN", RuntimeWarning, stacklevel=2)
+                return float("nan")
             return max((tk - tb) / (k - base), 1e-9)
         k = min(k * 4, max_iters)
 
