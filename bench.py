@@ -231,9 +231,12 @@ def main():
         with open("BENCH_DETAILS.json", "w") as f:
             json.dump(results, f, indent=2, allow_nan=False)
         if "--all" in sys.argv:
-            print(f"{r['metric']:36s} {r['value']:12.1f} {r['unit']:11s} "
-                  f"(cpu-oracle {r['baseline']:10.1f}, "
-                  f"x{r['vs_baseline']:.1f})", file=sys.stderr)
+            def fmt(v, spec):
+                return format(v, spec) if v is not None else "  (flagged)"
+            print(f"{r['metric']:36s} {fmt(r['value'], '12.1f')} "
+                  f"{r['unit']:11s} "
+                  f"(cpu-oracle {fmt(r['baseline'], '10.1f')}, "
+                  f"x{fmt(r['vs_baseline'], '.1f')})", file=sys.stderr)
         return r
 
     # headline first: warm clocks, measure, print the parseable line NOW —

@@ -95,9 +95,10 @@ def test_sharded_convolve_batch_dpxsp():
 
 def test_sharded_convolve_batch_contract():
     mesh = par.make_mesh({"dp": 2, "sp": 4})
-    with pytest.raises(ValueError):  # batch not divisible by dp
-        par.sharded_convolve_batch(np.zeros((3, 512), np.float32),
-                                   np.zeros(9, np.float32), mesh)
+    # batch not divisible by dp pads-and-slices (r2 generalization)
+    out = par.sharded_convolve_batch(np.zeros((3, 512), np.float32),
+                                     np.zeros(9, np.float32), mesh)
+    assert np.asarray(out).shape == (3, 520)
     with pytest.raises(ValueError):  # 1D input
         par.sharded_convolve_batch(np.zeros(512, np.float32),
                                    np.zeros(9, np.float32), mesh)
@@ -149,11 +150,12 @@ def test_data_parallel_batched_op():
     np.testing.assert_allclose(np.asarray(lo), np.asarray(lo_1), atol=1e-5)
 
 
-def test_sharded_convolve_rejects_batch():
+def test_sharded_convolve_accepts_batch():
+    """Leading batch dims ride along replicated (r2 generalization)."""
     mesh = par.make_mesh({"sp": 8})
-    with pytest.raises(ValueError):
-        par.sharded_convolve(np.zeros((2, 64), np.float32),
-                             np.zeros(5, np.float32), mesh)
+    out = par.sharded_convolve(np.zeros((2, 64), np.float32),
+                               np.zeros(5, np.float32), mesh)
+    assert np.asarray(out).shape == (2, 68)
 
 
 def test_sharded_convolve_length1_kernel():
@@ -216,3 +218,87 @@ class TestSharded2D:
         assert cv2.select_algorithm2d(33, 33) == "fft"
         got = np.asarray(sharded_convolve2d(x, h, mesh))
         np.testing.assert_allclose(got, cv2.convolve2d_na(x, h), atol=2e-3)
+
+
+class TestShardedSynthesis:
+    """Distributed analysis -> synthesis round trips (VERDICT r2 item 5:
+    the sharded layer must cover the full round trip, not just analysis)."""
+
+    def test_dwt_reconstruct_matches_input(self):
+        from veles.simd_tpu.ops import wavelet as wv
+        from veles.simd_tpu.parallel import (
+            make_mesh, sharded_wavelet_reconstruct)
+
+        rng = np.random.RandomState(31)
+        mesh = make_mesh({"sp": 8})
+        x = rng.randn(512).astype(np.float32)
+        hi, lo = wv.wavelet_apply_na("daub", 8, wv.ExtensionType.PERIODIC, x)
+        rec = np.asarray(sharded_wavelet_reconstruct("daub", 8, hi, lo,
+                                                     mesh))
+        np.testing.assert_allclose(rec, x, atol=2e-4)
+
+    def test_swt_cascade_round_trip(self):
+        from veles.simd_tpu.parallel import (
+            make_mesh, sharded_swt, sharded_swt_reconstruct)
+
+        rng = np.random.RandomState(32)
+        mesh = make_mesh({"dp": 2, "sp": 4})
+        x = rng.randn(512).astype(np.float32)
+        bands = sharded_swt("sym", 8, 3, x, mesh)
+        rec = np.asarray(sharded_swt_reconstruct("sym", 8, 3, bands, mesh))
+        np.testing.assert_allclose(rec, x, atol=2e-4)
+
+    def test_swt_batched(self):
+        from veles.simd_tpu.ops import wavelet as wv
+        from veles.simd_tpu.parallel import (
+            make_mesh, sharded_swt, sharded_swt_reconstruct)
+
+        rng = np.random.RandomState(33)
+        mesh = make_mesh({"dp": 2, "sp": 4})
+        xb = rng.randn(3, 256).astype(np.float32)
+        bands = sharded_swt("daub", 8, 2, xb, mesh)
+        want = wv.stationary_wavelet_transform(
+            "daub", 8, wv.ExtensionType.PERIODIC, xb, 2, simd=False)
+        for b, w in zip(bands, want):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(w),
+                                       atol=5e-4)
+        rec = np.asarray(sharded_swt_reconstruct("daub", 8, 2, bands, mesh))
+        np.testing.assert_allclose(rec, xb, atol=2e-4)
+
+    def test_synthesis_halo_too_large_raises(self):
+        from veles.simd_tpu.parallel import (
+            make_mesh, sharded_swt_reconstruct)
+
+        mesh = make_mesh({"sp": 8})
+        bands = [np.zeros(64, np.float32)] * 4
+        with pytest.raises(ValueError, match="halo"):
+            sharded_swt_reconstruct("daub", 8, 3, bands, mesh)
+
+
+class TestShardedGeneralization:
+    def test_batched_sharded_convolve(self):
+        from veles.simd_tpu.parallel import make_mesh, sharded_convolve
+
+        rng = np.random.RandomState(34)
+        mesh = make_mesh({"sp": 8})
+        xb = rng.randn(3, 256).astype(np.float32)
+        h = rng.randn(17).astype(np.float32)
+        got = np.asarray(sharded_convolve(xb, h, mesh))
+        for i in range(3):
+            np.testing.assert_allclose(got[i], np.convolve(xb[i], h),
+                                       atol=1e-3)
+
+    def test_batch_pad_and_slice(self):
+        """batch % dp != 0 pads instead of raising (VERDICT r2 weak 4)."""
+        from veles.simd_tpu.parallel import (
+            make_mesh, sharded_convolve_batch)
+
+        rng = np.random.RandomState(35)
+        mesh = make_mesh({"dp": 4, "sp": 2})
+        x = rng.randn(5, 128).astype(np.float32)   # 5 % 4 != 0
+        h = rng.randn(9).astype(np.float32)
+        got = np.asarray(sharded_convolve_batch(x, h, mesh))
+        assert got.shape == (5, 128 + 8)
+        for i in range(5):
+            np.testing.assert_allclose(got[i], np.convolve(x[i], h),
+                                       atol=1e-3)

@@ -667,9 +667,11 @@ def wavelet_reconstruct(type, order, desthi, destlo, simd=None,
     least-squares reconstruction.
 
     No reference analog (the reference is analysis-only); provided because
-    synthesis is half of every real wavelet workflow.  Round trip is
-    exact to f32 for every supported family/order/extension
-    (perfect-reconstruction tests in ``tests/test_wavelet_synthesis.py``).
+    synthesis is half of every real wavelet workflow.  The PERIODIC round
+    trip is exact to f32 for every supported family/order; non-periodic
+    reconstructions are least-squares (re-analysis consistency is exact;
+    the round trip cannot be — the analysis is rank-deficient).  Tests in
+    ``tests/test_wavelet_synthesis.py`` pin both guarantees.
     """
     if not resolve_simd(simd):
         return wavelet_reconstruct_na(type, order, desthi, destlo, ext=ext)

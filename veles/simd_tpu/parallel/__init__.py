@@ -18,6 +18,10 @@ scale across ICI — XLA collectives instead of any message-passing runtime.
   tile: batch over one axis, sequence (with halo) over the other.
 * :func:`sharded_swt` — sequence-parallel **stationary wavelet cascade**
   with ring halo exchange (periodic extension = the last→first hop).
+* :func:`sharded_swt_reconstruct` / :func:`sharded_wavelet_reconstruct` —
+  the **sharded synthesis** inverses: the adjoint's windows reach left,
+  so each level is a left-halo ring ``ppermute`` + local dilated
+  convolution, closing the distributed analysis→synthesis round trip.
 * :func:`sharded_matmul` — **tensor-parallel** GEMM: contracting dimension
   sharded (zero-padded to the axis size), partials combined with ``psum``
   over ICI.
@@ -39,10 +43,12 @@ from veles.simd_tpu.parallel.mesh import default_mesh, make_mesh
 from veles.simd_tpu.parallel.ops import (
     data_parallel, halo_exchange_left, halo_exchange_right,
     sharded_convolve, sharded_convolve2d, sharded_convolve_batch,
-    sharded_matmul, sharded_swt)
+    sharded_matmul, sharded_swt, sharded_swt_reconstruct,
+    sharded_wavelet_reconstruct)
 
 __all__ = ["make_mesh", "default_mesh", "sharded_convolve",
            "sharded_convolve_batch", "sharded_convolve2d",
-           "sharded_swt", "sharded_matmul",
+           "sharded_swt", "sharded_swt_reconstruct",
+           "sharded_wavelet_reconstruct", "sharded_matmul",
            "data_parallel", "halo_exchange_left", "halo_exchange_right",
            "distributed"]

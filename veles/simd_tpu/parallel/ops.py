@@ -22,21 +22,28 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["sharded_convolve", "sharded_convolve_batch",
            "sharded_convolve2d", "sharded_matmul",
-           "sharded_swt", "data_parallel",
+           "sharded_swt", "sharded_swt_reconstruct",
+           "sharded_wavelet_reconstruct", "data_parallel",
            "halo_exchange_left", "halo_exchange_right"]
 
 
-def halo_exchange_left(x_local, halo_len: int, axis_name: str):
+def halo_exchange_left(x_local, halo_len: int, axis_name: str,
+                       periodic: bool = False):
     """Bring the last ``halo_len`` samples of the left neighbour's shard.
 
-    The first shard receives zeros (``ppermute`` drops absent sources) —
-    exactly the zero history the overlap-save formulation wants
-    (``src/convolve.c:194-196`` zero-pads the first block).
+    By default the first shard receives zeros (``ppermute`` drops absent
+    sources) — exactly the zero history the overlap-save formulation
+    wants (``src/convolve.c:194-196`` zero-pads the first block).  With
+    ``periodic=True`` the first shard receives the LAST shard's tail (a
+    ring over ICI) — the synthesis-side mirror of
+    ``halo_exchange_right(..., periodic=True)``.
     """
     n_shards = jax.lax.axis_size(axis_name)
     block = x_local.shape[-1]
     tail = x_local[..., block - halo_len:]  # empty when halo_len == 0
     perm = [(i, i + 1) for i in range(n_shards - 1)]
+    if periodic:
+        perm.append((n_shards - 1, 0))
     return jax.lax.ppermute(tail, axis_name, perm)
 
 
@@ -94,9 +101,8 @@ def sharded_convolve(x, h, mesh: Mesh, axis: str = "sp"):
     """
     x = jnp.asarray(x, jnp.float32)
     h = jnp.asarray(h, jnp.float32)
-    if x.ndim != 1:
-        raise ValueError("sharded_convolve shards a single 1D signal; "
-                         "use data_parallel for batches")
+    if x.ndim < 1:
+        raise ValueError("sharded_convolve needs [..., n]")
     n, k = x.shape[-1], h.shape[-1]
     n_shards = mesh.shape[axis]
     out_len = n + k - 1
@@ -107,11 +113,13 @@ def sharded_convolve(x, h, mesh: Mesh, axis: str = "sp"):
             f"({pad_to // n_shards}); the one-hop halo exchange needs "
             f"h_length-1 <= signal_length/{n_shards} — use fewer shards or "
             f"the single-chip convolve")
-    x_pad = jnp.pad(x, (0, pad_to - n))
+    x_pad = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad_to - n)])
+    # leading batch dims (if any) stay replicated; shard the length
+    spec = P(*([None] * (x.ndim - 1) + [axis]))
 
     @functools.partial(
         shard_map, mesh=mesh,
-        in_specs=(P(axis), P()), out_specs=P(axis))
+        in_specs=(spec, P()), out_specs=spec)
     def _run(x_local, h_full):
         halo = halo_exchange_left(x_local, k - 1, axis)
         x_ext = jnp.concatenate([halo, x_local], axis=-1)
@@ -139,14 +147,15 @@ def sharded_convolve_batch(x, h, mesh: Mesh, batch_axis: str = "dp",
     dp = mesh.shape[batch_axis]
     sp = mesh.shape[seq_axis]
     out_len = n + k - 1
-    if batch % dp:
-        raise ValueError(f"batch={batch} not divisible by {batch_axis}={dp}")
+    # pad-and-slice an indivisible batch (zero rows convolve to zeros),
+    # like the TP GEMM pads its contracting dim
+    batch_pad = (-batch) % dp
     pad_to = -(-out_len // sp) * sp
     if k - 1 > pad_to // sp:
         raise ValueError(
             f"filter halo {k - 1} exceeds the per-shard block "
             f"({pad_to // sp}); use fewer {seq_axis} shards")
-    x_pad = jnp.pad(x, ((0, 0), (0, pad_to - n)))
+    x_pad = jnp.pad(x, ((0, batch_pad), (0, pad_to - n)))
 
     @functools.partial(
         shard_map, mesh=mesh,
@@ -157,7 +166,7 @@ def sharded_convolve_batch(x, h, mesh: Mesh, batch_axis: str = "dp",
         x_ext = jnp.concatenate([halo, x_local], axis=-1)
         return _local_block_conv(x_ext, h_full)
 
-    return _run(x_pad, h)[..., :out_len]
+    return _run(x_pad, h)[:batch, :out_len]
 
 
 def sharded_convolve2d(x, h, mesh: Mesh, axes=("dp", "sp")):
@@ -244,8 +253,8 @@ def sharded_swt(type, order, levels, x, mesh: Mesh, axis: str = "sp"):
     from veles.simd_tpu.ops import wavelet as wv
 
     x = jnp.asarray(x, jnp.float32)
-    if x.ndim != 1:
-        raise ValueError("sharded_swt shards a single 1D signal")
+    if x.ndim < 1:
+        raise ValueError("sharded_swt needs [..., n]")
     n = x.shape[-1]
     order = int(order)
     levels = int(levels)
@@ -262,6 +271,7 @@ def sharded_swt(type, order, levels, x, mesh: Mesh, axis: str = "sp"):
             f"({n // n_shards}); fewer shards or fewer levels")
     hi_f, lo_f = wv._filters(type, order)
     hi_f, lo_f = jnp.asarray(hi_f), jnp.asarray(lo_f)
+    spec = P(*([None] * (x.ndim - 1) + [axis]))
 
     def _level(cur, dilation):
         # reference right-extension is order*dilation; VALID windows only
@@ -270,17 +280,20 @@ def sharded_swt(type, order, levels, x, mesh: Mesh, axis: str = "sp"):
         halo_len = order * dilation
         halo = halo_exchange_right(cur, halo_len, axis, periodic=True)
         cur_ext = jnp.concatenate([cur, halo], axis=-1)
-        lhs = cur_ext.reshape((1, 1, cur_ext.shape[-1]))
+        batch_shape = cur.shape[:-1]
+        lhs = cur_ext.reshape((-1, 1, cur_ext.shape[-1]))
         rhs = jnp.stack([hi_f, lo_f]).reshape((2, 1, order))
         out = jax.lax.conv_general_dilated(
             lhs, rhs, window_strides=(1,), padding="VALID",
             rhs_dilation=(dilation,),
-            precision=jax.lax.Precision.HIGHEST)[0]
-        return out[0, :cur.shape[-1]], out[1, :cur.shape[-1]]
+            precision=jax.lax.Precision.HIGHEST)
+        out = out[..., :cur.shape[-1]].reshape(
+            batch_shape + (2, cur.shape[-1]))
+        return out[..., 0, :], out[..., 1, :]
 
     @functools.partial(
         shard_map, mesh=mesh,
-        in_specs=P(axis), out_specs=P(axis))
+        in_specs=spec, out_specs=spec)
     def _run(x_local):
         outs = []
         cur = x_local
@@ -290,6 +303,136 @@ def sharded_swt(type, order, levels, x, mesh: Mesh, axis: str = "sp"):
         return tuple(outs) + (cur,)
 
     return list(_run(x))
+
+
+def sharded_swt_reconstruct(type, order, levels, coeffs, mesh: Mesh,
+                            axis: str = "sp"):
+    """Sequence-parallel inverse of :func:`sharded_swt` (PERIODIC).
+
+    Synthesis is the frame adjoint over ``2c²``: a dilated *convolution*
+    with the unflipped filters, whose windows reach ``(order−1)·2^(ℓ−1)``
+    samples to the **left** — so each level does one left-halo ring
+    ``ppermute`` (the mirror image of the analysis' right halo) and a
+    local ``conv_general_dilated``.  All levels run inside one
+    ``shard_map``.  Accepts the ``[hi_1, ..., hi_L, lo_L]`` list that
+    :func:`sharded_swt` (or the single-chip transform) returns, every
+    band ``[..., n]``; returns the reconstructed ``[..., n]`` signal
+    matching :func:`stationary_wavelet_inverse_transform`.
+    """
+    from veles.simd_tpu.ops import wavelet as wv
+
+    coeffs = [jnp.asarray(c, jnp.float32) for c in coeffs]
+    levels = int(levels)
+    order = int(order)
+    if levels < 1 or len(coeffs) != levels + 1:
+        raise ValueError("need [hi_1, ..., hi_L, lo_L] matching levels")
+    n = coeffs[0].shape[-1]
+    n_shards = mesh.shape[axis]
+    if n % n_shards:
+        raise ValueError(f"band length {n} not divisible by {axis}="
+                         f"{n_shards}")
+    max_halo = (order - 1) * (1 << (levels - 1))
+    if max_halo > n // n_shards:
+        raise ValueError(
+            f"level-{levels} synthesis halo {max_halo} exceeds the "
+            f"per-shard block ({n // n_shards}); fewer shards or levels")
+    hi_f, lo_f = wv._filters(type, order)
+    c2 = float(wv._c2(lo_f))
+    # convolution = correlation with flipped taps
+    rhs = jnp.stack([jnp.asarray(hi_f[::-1].copy()),
+                     jnp.asarray(lo_f[::-1].copy())]).reshape(1, 2, order)
+    nd = coeffs[0].ndim
+    spec = P(*([None] * (nd - 1) + [axis]))
+
+    def _inv_level(hi_b, lo_b, dilation):
+        halo_len = (order - 1) * dilation
+        # left halo: x[t] sums y[(t − j·dil) mod n] — periodic ring
+        h_hi = halo_exchange_left(hi_b, halo_len, axis, periodic=True)
+        h_lo = halo_exchange_left(lo_b, halo_len, axis, periodic=True)
+        ext = jnp.stack(
+            [jnp.concatenate([h_hi, hi_b], axis=-1),
+             jnp.concatenate([h_lo, lo_b], axis=-1)], axis=-2)
+        batch_shape = hi_b.shape[:-1]
+        lhs = ext.reshape((-1, 2, ext.shape[-1]))
+        out = jax.lax.conv_general_dilated(
+            lhs, rhs.astype(jnp.float32),
+            window_strides=(1,), padding="VALID",
+            rhs_dilation=(dilation,),
+            precision=jax.lax.Precision.HIGHEST)[:, 0]
+        return (out / (2.0 * c2)).reshape(batch_shape + (hi_b.shape[-1],))
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=tuple([spec] * (levels + 1)), out_specs=spec)
+    def _run(*bands):
+        cur = bands[-1]
+        for lvl in range(levels, 0, -1):
+            cur = _inv_level(bands[lvl - 1], cur, 1 << (lvl - 1))
+        return cur
+
+    return _run(*coeffs)
+
+
+def sharded_wavelet_reconstruct(type, order, desthi, destlo, mesh: Mesh,
+                                axis: str = "sp"):
+    """Sequence-parallel exact inverse of the PERIODIC DWT analysis:
+    bands ``[..., m]`` sharded along length → signal ``[..., 2m]``.
+
+    The adjoint upsamples by 2 and convolves: output sample ``t`` sums
+    band samples down to ``⌈(t−order+1)/2⌉``, i.e. a left halo of
+    ``order/2`` band samples per shard (ring ``ppermute``), then a local
+    ``lhs_dilation=2`` convolution sliced to the shard's span — the
+    distributed form of :func:`veles.simd_tpu.ops.wavelet._synth_conv`.
+    """
+    from veles.simd_tpu.ops import wavelet as wv
+
+    desthi = jnp.asarray(desthi, jnp.float32)
+    destlo = jnp.asarray(destlo, jnp.float32)
+    if desthi.shape != destlo.shape:
+        raise ValueError(
+            f"band shapes differ: {desthi.shape} vs {destlo.shape}")
+    order = int(order)
+    m = desthi.shape[-1]
+    n_shards = mesh.shape[axis]
+    if m % n_shards:
+        raise ValueError(f"band length {m} not divisible by {axis}="
+                         f"{n_shards}")
+    halo = order // 2
+    if halo > m // n_shards:
+        raise ValueError(
+            f"synthesis halo {halo} exceeds the per-shard band block "
+            f"({m // n_shards}); fewer shards")
+    hi_f, lo_f = wv._filters(type, order)
+    c2 = float(wv._c2(lo_f))
+    rhs = jnp.stack([jnp.asarray(hi_f[::-1].copy()),
+                     jnp.asarray(lo_f[::-1].copy())]).reshape(1, 2, order)
+    nd = desthi.ndim
+    spec = P(*([None] * (nd - 1) + [axis]))
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(spec, spec), out_specs=spec)
+    def _run(hi_b, lo_b):
+        h_hi = halo_exchange_left(hi_b, halo, axis, periodic=True)
+        h_lo = halo_exchange_left(lo_b, halo, axis, periodic=True)
+        ext = jnp.stack(
+            [jnp.concatenate([h_hi, hi_b], axis=-1),
+             jnp.concatenate([h_lo, lo_b], axis=-1)], axis=-2)
+        batch_shape = hi_b.shape[:-1]
+        m_loc = hi_b.shape[-1]
+        lhs = ext.reshape((-1, 2, ext.shape[-1]))
+        # full conv of the 2-upsampled ext; pad so every needed index
+        # exists, then take the shard's span: out_local[τ] = full[τ + 2H]
+        pad = order - 1
+        full = jax.lax.conv_general_dilated(
+            lhs, rhs.astype(jnp.float32), window_strides=(1,),
+            padding=[(pad, pad)], lhs_dilation=(2,),
+            precision=jax.lax.Precision.HIGHEST)[:, 0]
+        out = jax.lax.slice_in_dim(full, 2 * halo, 2 * halo + 2 * m_loc,
+                                   axis=-1)
+        return (out / c2).reshape(batch_shape + (2 * m_loc,))
+
+    return _run(desthi, destlo)
 
 
 def sharded_matmul(a, b, mesh: Mesh, axis: str = "tp"):
