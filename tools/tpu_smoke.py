@@ -180,6 +180,19 @@ def _check_synthesis(rng):
                                      simd=True)
     errs.append(_rel_err(wv.wavelet_inverse_transform2d(
         WaveletType.DAUBECHIES, 4, coeffs2, simd=True), img))
+    # non-periodic synthesis: device path (bulk adjoint on-device +
+    # host-f64 boundary correction) vs the all-NumPy oracle twin — the
+    # smoke's device-vs-oracle discipline; round-trip conditioning is
+    # pinned separately in tests/test_wavelet_synthesis.py
+    mhi, mlo = wv.stationary_wavelet_apply(
+        WaveletType.DAUBECHIES, 8, 1, wv.ExtensionType.MIRROR, x, simd=True)
+    rec_m = wv.stationary_wavelet_reconstruct(
+        WaveletType.DAUBECHIES, 8, 1, mhi, mlo, simd=True,
+        ext=wv.ExtensionType.MIRROR)
+    rec_m_na = wv.stationary_wavelet_reconstruct_na(
+        WaveletType.DAUBECHIES, 8, 1, np.asarray(mhi), np.asarray(mlo),
+        ext=wv.ExtensionType.MIRROR)
+    errs.append(_rel_err(rec_m, rec_m_na))
     return max(errs), 5e-4
 
 
