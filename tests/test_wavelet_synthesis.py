@@ -307,3 +307,38 @@ def test_nonperiodic_too_short_raises():
         wv.stationary_wavelet_reconstruct_na(
             "daub", 8, 3, np.zeros(32, np.float32),
             np.zeros(32, np.float32), ext=wv.ExtensionType.ZERO)
+
+
+@pytest.mark.parametrize("type,order,ext", [
+    ("daub", 76, wv.ExtensionType.MIRROR),
+    ("coif", 30, wv.ExtensionType.ZERO),
+    ("sym", 40, wv.ExtensionType.CONSTANT)])
+def test_high_order_nonperiodic_consistency(type, order, ext):
+    """The Woodbury precompute scales to the largest table orders
+    (r = order-2 boundary rows)."""
+    x = RNG.randn(512).astype(np.float32)
+    hi, lo = wv.wavelet_apply_na(type, order, ext, x)
+    rec = wv.wavelet_reconstruct_na(type, order, hi, lo, ext=ext)
+    hi2, _ = wv.wavelet_apply_na(type, order, ext, rec)
+    scale = float(np.max(np.abs(hi))) + 1e-3
+    assert float(np.max(np.abs(hi2 - hi))) < 1e-4 * scale
+
+
+def test_deep_level_swt_nonperiodic_roundtrip():
+    x = RNG.randn(512).astype(np.float32)
+    hi, lo = wv.stationary_wavelet_apply_na(
+        "daub", 8, 4, wv.ExtensionType.CONSTANT, x)
+    rec = wv.stationary_wavelet_reconstruct_na(
+        "daub", 8, 4, hi, lo, ext=wv.ExtensionType.CONSTANT)
+    np.testing.assert_allclose(rec, x, atol=5e-3)
+
+
+def test_nonperiodic_under_jit_raises_clearly():
+    """The hybrid host-f64 correction cannot trace; the error must name
+    the restriction instead of surfacing a TracerArrayConversionError."""
+    import jax
+
+    b = np.zeros(64, np.float32)
+    with pytest.raises(ValueError, match="outside jit|PERIODIC"):
+        jax.jit(lambda a, c: wv.wavelet_reconstruct(
+            "daub", 8, a, c, ext=wv.ExtensionType.MIRROR))(b, b)

@@ -608,6 +608,15 @@ def _synth_ext_device(hi_band, lo_band, type, order, level, ext, stride):
     for this hybrid, which matches the oracle path)."""
     type, order, level = WaveletType(type), int(order), int(level)
     ext = ExtensionType(ext)
+    if isinstance(hi_band, jax.core.Tracer) or isinstance(
+            lo_band, jax.core.Tracer):
+        raise ValueError(
+            "non-PERIODIC reconstruction cannot run inside jit: its "
+            "boundary correction is computed on host in float64 (a pure "
+            "in-graph f32 solve would amplify rounding by the boundary "
+            "subsystem's squared condition number — see the "
+            "boundary-correction section comment).  Call it outside jit, "
+            "or use ext=PERIODIC (exact, fully jittable)")
     hi_f, lo_f = _filters(type, order)
     g = float(_c2(lo_f)) * 2.0 / stride
     dil = 1 << (level - 1)
