@@ -167,13 +167,16 @@ def test_sharded_convolve_length1_kernel():
     np.testing.assert_allclose(got, 2.5 * x, atol=1e-5)
 
 
-def test_sharded_convolve_halo_too_large():
-    """Filters longer than a shard raise a clear error, not a broadcast
-    failure inside shard_map."""
+def test_sharded_convolve_halo_too_large_auto_rings():
+    """Filters longer than a shard block auto-select the multi-hop ring
+    pipeline (round 2 raised here)."""
     mesh = par.make_mesh({"sp": 8})
-    with pytest.raises(ValueError, match="halo"):
-        par.sharded_convolve(np.zeros(256, np.float32),
-                             np.zeros(40, np.float32), mesh)
+    x = RNG.randn(256).astype(np.float32)
+    h = RNG.randn(40).astype(np.float32)   # halo 39 > ceil(295/8)=37
+    got = np.asarray(par.sharded_convolve(x, h, mesh))
+    want = np.convolve(x.astype(np.float64), h.astype(np.float64))
+    np.testing.assert_allclose(got, want.astype(np.float32),
+                               atol=1e-3 * float(np.max(np.abs(want))))
 
 
 class TestSharded2D:
@@ -302,3 +305,93 @@ class TestShardedGeneralization:
         for i in range(5):
             np.testing.assert_allclose(got[i], np.convolve(x[i], h),
                                        atol=1e-3)
+
+
+class TestRingConvolve:
+    """Multi-hop ring pipeline for filters longer than a shard block —
+    the ring-attention communication pattern applied to convolution."""
+
+    @pytest.mark.parametrize("n,k", [(1024, 300), (2048, 1500),
+                                     (1024, 1024), (1000, 999)])
+    def test_matches_oracle(self, n, k):
+        mesh = par.make_mesh({"sp": 8})
+        rng = np.random.RandomState(41)
+        x = rng.randn(n).astype(np.float32)
+        h = rng.randn(k).astype(np.float32)
+        got = np.asarray(par.sharded_convolve_ring(x, h, mesh))
+        want = np.convolve(x.astype(np.float64), h.astype(np.float64))
+        assert got.shape == want.shape
+        rel = np.max(np.abs(got - want)) / np.max(np.abs(want))
+        assert rel < 1e-4, rel
+
+    def test_auto_selected_by_sharded_convolve(self):
+        """The one-hop entry point falls back to the ring instead of
+        raising when the halo exceeds a block (r2: it raised)."""
+        mesh = par.make_mesh({"sp": 8})
+        rng = np.random.RandomState(42)
+        x = rng.randn(512).astype(np.float32)
+        h = rng.randn(400).astype(np.float32)   # halo 399 > 512/8
+        got = np.asarray(par.sharded_convolve(x, h, mesh))
+        want = np.convolve(x.astype(np.float64), h.astype(np.float64))
+        rel = np.max(np.abs(got - want)) / np.max(np.abs(want))
+        assert rel < 1e-4, rel
+
+    def test_batched(self):
+        mesh = par.make_mesh({"sp": 8})
+        rng = np.random.RandomState(43)
+        xb = rng.randn(3, 512).astype(np.float32)
+        h = rng.randn(450).astype(np.float32)
+        got = np.asarray(par.sharded_convolve_ring(xb, h, mesh))
+        for i in range(3):
+            want = np.convolve(xb[i].astype(np.float64),
+                               h.astype(np.float64))
+            rel = np.max(np.abs(got[i] - want)) / np.max(np.abs(want))
+            assert rel < 1e-4, rel
+
+    def test_h_longer_than_x_raises(self):
+        mesh = par.make_mesh({"sp": 8})
+        with pytest.raises(ValueError, match="h_length"):
+            par.sharded_convolve_ring(np.zeros(64, np.float32),
+                                      np.zeros(65, np.float32), mesh)
+
+
+class TestRingConvolveBatched:
+    def test_batch_axis_dpxsp(self):
+        """Ring with the batch sharded over dp — the dp×sp long-filter
+        form sharded_convolve_batch falls back to."""
+        mesh = par.make_mesh({"dp": 2, "sp": 4})
+        rng = np.random.RandomState(44)
+        xb = rng.randn(5, 512).astype(np.float32)   # 5 % 2 != 0 too
+        h = rng.randn(400).astype(np.float32)
+        got = np.asarray(par.sharded_convolve_ring(
+            xb, h, mesh, axis="sp", batch_axis="dp"))
+        assert got.shape == (5, 911)
+        for i in range(5):
+            want = np.convolve(xb[i].astype(np.float64),
+                               h.astype(np.float64))
+            rel = np.max(np.abs(got[i] - want)) / np.max(np.abs(want))
+            assert rel < 1e-4, (i, rel)
+
+    def test_batch_entry_falls_back_to_ring(self):
+        mesh = par.make_mesh({"dp": 2, "sp": 4})
+        rng = np.random.RandomState(45)
+        xb = rng.randn(4, 256).astype(np.float32)
+        h = rng.randn(250).astype(np.float32)   # halo 249 > block
+        got = np.asarray(par.sharded_convolve_batch(xb, h, mesh))
+        for i in range(4):
+            want = np.convolve(xb[i].astype(np.float64),
+                               h.astype(np.float64))
+            rel = np.max(np.abs(got[i] - want)) / np.max(np.abs(want))
+            assert rel < 1e-4, (i, rel)
+
+    def test_fft_hop_path(self):
+        """Blocks big enough to cross AUTO_FFT_MIN_PRODUCT take the
+        spectral per-hop form."""
+        mesh = par.make_mesh({"sp": 8})
+        rng = np.random.RandomState(46)
+        x = rng.randn(1 << 15).astype(np.float32)
+        h = rng.randn(1 << 14).astype(np.float32)
+        got = np.asarray(par.sharded_convolve_ring(x, h, mesh))
+        want = np.convolve(x.astype(np.float64), h.astype(np.float64))
+        rel = np.max(np.abs(got - want)) / np.max(np.abs(want))
+        assert rel < 1e-4, rel

@@ -14,6 +14,11 @@ scale across ICI — XLA collectives instead of any message-passing runtime.
   samples it needs from its left neighbour — the distributed form of
   overlap-save, where the reference's in-core block overlap becomes the
   inter-chip halo.
+* :func:`sharded_convolve_ring` — filters **longer than a shard
+  block**: x blocks stream around the ring (the ring-attention
+  communication pattern) while each shard accumulates against its
+  static filter segments; `sharded_convolve` auto-selects it when the
+  one-hop halo cannot fit.
 * :func:`sharded_convolve_batch` — **dp×sp** convolution over a 2D mesh
   tile: batch over one axis, sequence (with halo) over the other.
 * :func:`sharded_swt` — sequence-parallel **stationary wavelet cascade**
@@ -43,10 +48,11 @@ from veles.simd_tpu.parallel.mesh import default_mesh, make_mesh
 from veles.simd_tpu.parallel.ops import (
     data_parallel, halo_exchange_left, halo_exchange_right,
     sharded_convolve, sharded_convolve2d, sharded_convolve_batch,
-    sharded_matmul, sharded_swt, sharded_swt_reconstruct,
-    sharded_wavelet_reconstruct)
+    sharded_convolve_ring, sharded_matmul, sharded_swt,
+    sharded_swt_reconstruct, sharded_wavelet_reconstruct)
 
 __all__ = ["make_mesh", "default_mesh", "sharded_convolve",
+           "sharded_convolve_ring",
            "sharded_convolve_batch", "sharded_convolve2d",
            "sharded_swt", "sharded_swt_reconstruct",
            "sharded_wavelet_reconstruct", "sharded_matmul",

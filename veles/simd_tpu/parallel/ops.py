@@ -20,7 +20,8 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["sharded_convolve", "sharded_convolve_batch",
+__all__ = ["sharded_convolve", "sharded_convolve_ring",
+           "sharded_convolve_batch",
            "sharded_convolve2d", "sharded_matmul",
            "sharded_swt", "sharded_swt_reconstruct",
            "sharded_wavelet_reconstruct", "data_parallel",
@@ -108,11 +109,10 @@ def sharded_convolve(x, h, mesh: Mesh, axis: str = "sp"):
     out_len = n + k - 1
     pad_to = -(-out_len // n_shards) * n_shards
     if k - 1 > pad_to // n_shards:
-        raise ValueError(
-            f"filter halo h_length-1={k - 1} exceeds the per-shard block "
-            f"({pad_to // n_shards}); the one-hop halo exchange needs "
-            f"h_length-1 <= signal_length/{n_shards} — use fewer shards or "
-            f"the single-chip convolve")
+        # filter halo exceeds one block: auto-select the multi-hop ring
+        # pipeline, the same spirit as convolve_initialize's algorithm
+        # auto-select (src/convolve.c:328-366)
+        return sharded_convolve_ring(x, h, mesh, axis=axis)
     x_pad = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad_to - n)])
     # leading batch dims (if any) stay replicated; shard the length
     spec = P(*([None] * (x.ndim - 1) + [axis]))
@@ -126,6 +126,115 @@ def sharded_convolve(x, h, mesh: Mesh, axis: str = "sp"):
         return _local_block_conv(x_ext, h_full)
 
     return _run(x_pad, h)[..., :out_len]
+
+
+def sharded_convolve_ring(x, h, mesh: Mesh, axis: str = "sp",
+                          batch_axis: str | None = None):
+    """Sequence-parallel convolution for filters LONGER than a shard
+    block — the ring-attention communication pattern applied to
+    convolution.
+
+    :func:`sharded_convolve`'s one-hop halo needs ``h_length-1`` to fit
+    in one block.  Here instead, x blocks stream around the ring
+    (``ppermute``, one block per hop) while every shard accumulates each
+    arriving block against the static segment of the (replicated) filter
+    that lands in its output window:
+
+        y_s[j] = Σ_m Σ_i B_{s-m}[i] · h[m·blk + j - i]
+
+    — ``M = min(ceil((k-1)/blk), S-1)`` hops, total compute ≈ 2× the
+    one-shot conv, per-shard memory O(blk + k).  Convolution is causal,
+    so blocks from shards right of ``s`` never contribute to ``s``'s
+    window; ring-wrapped arrivals are masked by ``axis_index``.  Works
+    for any ``h_length <= x_length``; for short filters prefer
+    :func:`sharded_convolve` (single hop, half the compute).  With
+    ``batch_axis`` set, a leading ``[batch, n]`` dimension is sharded
+    over that mesh axis too (the dp×sp form).
+    """
+    x = jnp.asarray(x, jnp.float32)
+    h = jnp.asarray(h, jnp.float32)
+    if x.ndim < 1:
+        raise ValueError("sharded_convolve_ring needs [..., n]")
+    n, k = x.shape[-1], h.shape[-1]
+    if k > n:
+        raise ValueError(
+            f"h_length {k} > x_length {n}: h must be the shorter signal "
+            "(inc/simd/convolve.h convolve contract) — swap the "
+            "arguments (convolution commutes)")
+    if batch_axis is not None and x.ndim != 2:
+        raise ValueError("batch_axis needs x of shape [batch, n]")
+    n_shards = mesh.shape[axis]
+    out_len = n + k - 1
+    blk = -(-out_len // n_shards)
+    pad_to = blk * n_shards
+    pads = [(0, 0)] * x.ndim
+    pads[-1] = (0, pad_to - n)
+    batch_pad = 0
+    if batch_axis is not None:
+        batch_pad = (-x.shape[0]) % mesh.shape[batch_axis]
+        pads[0] = (0, batch_pad)
+    x_pad = jnp.pad(x, pads)
+    hops = min(-(-(k - 1) // blk), n_shards - 1)
+    # h segments: seg_m = h_pp[m·blk : m·blk + 2·blk - 1] with h_pp
+    # left-padded blk-1 and right-padded so the last slice is in range
+    h_pp = jnp.pad(h, (blk - 1, (hops + 2) * blk))
+    lead = ([batch_axis] + [None] * (x.ndim - 2) if batch_axis is not None
+            else [None] * (x.ndim - 1))
+    spec = P(*(lead + [axis]))
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(spec, P()), out_specs=spec)
+    def _run(x_local, h_padded):
+        s = jax.lax.axis_index(axis)
+        y = jnp.zeros_like(x_local)
+        block = x_local
+        for m in range(hops + 1):
+            seg = jax.lax.slice_in_dim(h_padded, m * blk,
+                                       m * blk + 2 * blk - 1, axis=-1)
+            contrib = _ring_block_conv(block, seg)
+            # blocks that ring-wrapped (from shards right of s) are
+            # acausal for this window — mask them out
+            keep = (s - m >= 0).astype(contrib.dtype)
+            y = y + keep * contrib
+            if m < hops:
+                perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+                block = jax.lax.ppermute(block, axis, perm)
+        return y
+
+    out = _run(x_pad, h_pp)[..., :out_len]
+    if batch_pad:
+        out = out[:x.shape[0]]
+    return out
+
+
+def _ring_block_conv(block, seg):
+    """Samples ``[blk-1, 2·blk-1)`` of the full linear convolution of a
+    [..., blk] block with a [2·blk-1] filter segment — exactly the
+    shard's output window for one ring hop.  Direct MXU form for small
+    products (padding sized so only the needed blk outputs are
+    computed), spectral beyond the measured 1D crossover
+    (ops/convolve.py AUTO_FFT_MIN_PRODUCT — direct cost per hop is
+    O(blk²), which would regress long shards by orders of magnitude)."""
+    from veles.simd_tpu.ops import convolve as cv
+    from veles.simd_tpu.utils.memory import next_highest_power_of_2
+
+    blk = block.shape[-1]
+    ks = seg.shape[-1]
+    if blk * ks < cv.AUTO_FFT_MIN_PRODUCT:
+        lhs = block.reshape((-1, 1, blk))
+        rhs = jnp.flip(seg, -1).reshape((1, 1, ks))
+        # pad (blk-1, blk-1): output index o == full-conv index o + blk-1,
+        # so the blk outputs are precisely the shard's window
+        out = jax.lax.conv_general_dilated(
+            lhs, rhs, window_strides=(1,),
+            padding=[(blk - 1, blk - 1)],
+            precision=jax.lax.Precision.HIGHEST)
+        return out.reshape(block.shape[:-1] + (blk,))
+    m = next_highest_power_of_2(blk + ks - 1)
+    spec = jnp.fft.rfft(block, m) * jnp.fft.rfft(seg, m)
+    return jnp.fft.irfft(spec, m)[..., blk - 1:2 * blk - 1].astype(
+        block.dtype)
 
 
 def sharded_convolve_batch(x, h, mesh: Mesh, batch_axis: str = "dp",
@@ -152,9 +261,10 @@ def sharded_convolve_batch(x, h, mesh: Mesh, batch_axis: str = "dp",
     batch_pad = (-batch) % dp
     pad_to = -(-out_len // sp) * sp
     if k - 1 > pad_to // sp:
-        raise ValueError(
-            f"filter halo {k - 1} exceeds the per-shard block "
-            f"({pad_to // sp}); use fewer {seq_axis} shards")
+        # same auto-select as sharded_convolve: the multi-hop ring
+        # handles filters longer than a shard block, dp×sp intact
+        return sharded_convolve_ring(x, h, mesh, axis=seq_axis,
+                                     batch_axis=batch_axis)
     x_pad = jnp.pad(x, ((0, batch_pad), (0, pad_to - n)))
 
     @functools.partial(
