@@ -202,13 +202,18 @@ class TestSharded2D:
         got = np.asarray(sharded_convolve2d(x, h, mesh))
         np.testing.assert_allclose(got, cv2.convolve2d_na(x, h), atol=1e-3)
 
-    def test_halo_too_large_raises(self):
+    def test_halo_too_large_auto_rings(self):
+        """Kernels whose halo exceeds a tile auto-select the 2D ring
+        (round 2 raised here)."""
+        from veles.simd_tpu.ops import convolve2d as cv2
         from veles.simd_tpu.parallel import make_mesh, sharded_convolve2d
 
         mesh = make_mesh({"dp": 2, "sp": 4})
-        with pytest.raises(ValueError, match="halo"):
-            sharded_convolve2d(np.zeros((8, 8), np.float32),
-                               np.zeros((2, 7), np.float32), mesh)
+        rng = np.random.RandomState(24)
+        x = rng.randn(8, 8).astype(np.float32)
+        h = rng.randn(2, 7).astype(np.float32)
+        got = np.asarray(sharded_convolve2d(x, h, mesh))
+        np.testing.assert_allclose(got, cv2.convolve2d_na(x, h), atol=1e-3)
 
     def test_large_kernel_takes_fft_tile_path(self):
         from veles.simd_tpu.ops import convolve2d as cv2
@@ -348,11 +353,17 @@ class TestRingConvolve:
             rel = np.max(np.abs(got[i] - want)) / np.max(np.abs(want))
             assert rel < 1e-4, rel
 
-    def test_h_longer_than_x_raises(self):
+    def test_h_longer_than_x_works(self):
+        """The ring has no operand-size restriction: the hop count
+        clamps at S-1, covering every causal block pair."""
         mesh = par.make_mesh({"sp": 8})
-        with pytest.raises(ValueError, match="h_length"):
-            par.sharded_convolve_ring(np.zeros(64, np.float32),
-                                      np.zeros(65, np.float32), mesh)
+        rng = np.random.RandomState(49)
+        x = rng.randn(64).astype(np.float32)
+        h = rng.randn(200).astype(np.float32)
+        got = np.asarray(par.sharded_convolve_ring(x, h, mesh))
+        want = np.convolve(x.astype(np.float64), h.astype(np.float64))
+        rel = np.max(np.abs(got - want)) / np.max(np.abs(want))
+        assert rel < 1e-4, rel
 
 
 class TestRingConvolveBatched:
@@ -393,5 +404,53 @@ class TestRingConvolveBatched:
         h = rng.randn(1 << 14).astype(np.float32)
         got = np.asarray(par.sharded_convolve_ring(x, h, mesh))
         want = np.convolve(x.astype(np.float64), h.astype(np.float64))
+        rel = np.max(np.abs(got - want)) / np.max(np.abs(want))
+        assert rel < 1e-4, rel
+
+
+class TestRingConvolve2D:
+    """2D ring pipeline: kernels larger than a shard tile."""
+
+    @pytest.mark.parametrize("img,ker", [
+        ((64, 64), (40, 30)),     # halo exceeds both tile dims
+        ((48, 96), (48, 96)),     # kernel == image
+        ((64, 64), (5, 60)),      # one axis rings, the other fits
+        ((33, 57), (20, 41))])    # uneven sizes + padding
+    def test_matches_oracle(self, img, ker):
+        from veles.simd_tpu.ops import convolve2d as cv2
+
+        mesh = par.make_mesh({"dp": 2, "sp": 4})
+        rng = np.random.RandomState(47)
+        x = rng.randn(*img).astype(np.float32)
+        h = rng.randn(*ker).astype(np.float32)
+        got = np.asarray(par.sharded_convolve2d_ring(x, h, mesh))
+        want = cv2.convolve2d_na(x, h)
+        rel = np.max(np.abs(got - want)) / np.max(np.abs(want))
+        assert rel < 1e-4, rel
+
+    def test_auto_selected_by_sharded_convolve2d(self):
+        from veles.simd_tpu.ops import convolve2d as cv2
+
+        mesh = par.make_mesh({"dp": 2, "sp": 4})
+        rng = np.random.RandomState(48)
+        x = rng.randn(40, 40).astype(np.float32)
+        h = rng.randn(30, 30).astype(np.float32)  # halo > tile
+        got = np.asarray(par.sharded_convolve2d(x, h, mesh))
+        want = cv2.convolve2d_na(x, h)
+        rel = np.max(np.abs(got - want)) / np.max(np.abs(want))
+        assert rel < 1e-4, rel
+
+    @pytest.mark.parametrize("ker", [(3, 12), (12, 3), (20, 20)])
+    def test_kernel_larger_than_image_works(self, ker):
+        """Mixed-aspect and strictly-larger kernels all work — the
+        per-axis hop clamp covers every causal tile pair."""
+        from veles.simd_tpu.ops import convolve2d as cv2
+
+        mesh = par.make_mesh({"dp": 2, "sp": 4})
+        rng = np.random.RandomState(50)
+        x = rng.randn(8, 8).astype(np.float32)
+        h = rng.randn(*ker).astype(np.float32)
+        got = np.asarray(par.sharded_convolve2d_ring(x, h, mesh))
+        want = cv2.convolve2d_na(x, h)
         rel = np.max(np.abs(got - want)) / np.max(np.abs(want))
         assert rel < 1e-4, rel

@@ -21,6 +21,10 @@ scale across ICI — XLA collectives instead of any message-passing runtime.
   one-hop halo cannot fit.
 * :func:`sharded_convolve_batch` — **dp×sp** convolution over a 2D mesh
   tile: batch over one axis, sequence (with halo) over the other.
+* :func:`sharded_convolve2d_ring` — 2D kernels **larger than a shard
+  tile**: the ring generalizes per axis (inner ring along one mesh
+  axis inside an outer ring along the other); `sharded_convolve2d`
+  auto-selects it.
 * :func:`sharded_swt` — sequence-parallel **stationary wavelet cascade**
   with ring halo exchange (periodic extension = the last→first hop).
 * :func:`sharded_swt_reconstruct` / :func:`sharded_wavelet_reconstruct` —
@@ -47,13 +51,14 @@ from veles.simd_tpu.parallel import distributed
 from veles.simd_tpu.parallel.mesh import default_mesh, make_mesh
 from veles.simd_tpu.parallel.ops import (
     data_parallel, halo_exchange_left, halo_exchange_right,
-    sharded_convolve, sharded_convolve2d, sharded_convolve_batch,
-    sharded_convolve_ring, sharded_matmul, sharded_swt,
-    sharded_swt_reconstruct, sharded_wavelet_reconstruct)
+    sharded_convolve, sharded_convolve2d, sharded_convolve2d_ring,
+    sharded_convolve_batch, sharded_convolve_ring, sharded_matmul,
+    sharded_swt, sharded_swt_reconstruct, sharded_wavelet_reconstruct)
 
 __all__ = ["make_mesh", "default_mesh", "sharded_convolve",
            "sharded_convolve_ring",
            "sharded_convolve_batch", "sharded_convolve2d",
+           "sharded_convolve2d_ring",
            "sharded_swt", "sharded_swt_reconstruct",
            "sharded_wavelet_reconstruct", "sharded_matmul",
            "data_parallel", "halo_exchange_left", "halo_exchange_right",

@@ -22,7 +22,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["sharded_convolve", "sharded_convolve_ring",
            "sharded_convolve_batch",
-           "sharded_convolve2d", "sharded_matmul",
+           "sharded_convolve2d", "sharded_convolve2d_ring",
+           "sharded_matmul",
            "sharded_swt", "sharded_swt_reconstruct",
            "sharded_wavelet_reconstruct", "data_parallel",
            "halo_exchange_left", "halo_exchange_right"]
@@ -146,8 +147,10 @@ def sharded_convolve_ring(x, h, mesh: Mesh, axis: str = "sp",
     one-shot conv, per-shard memory O(blk + k).  Convolution is causal,
     so blocks from shards right of ``s`` never contribute to ``s``'s
     window; ring-wrapped arrivals are masked by ``axis_index``.  Works
-    for any ``h_length <= x_length``; for short filters prefer
-    :func:`sharded_convolve` (single hop, half the compute).  With
+    for ANY filter length — even ``h`` longer than ``x`` (the hop count
+    clamps at S−1, which covers every causal block pair); for short
+    filters prefer :func:`sharded_convolve` (single hop, half the
+    compute).  With
     ``batch_axis`` set, a leading ``[batch, n]`` dimension is sharded
     over that mesh axis too (the dp×sp form).
     """
@@ -156,11 +159,6 @@ def sharded_convolve_ring(x, h, mesh: Mesh, axis: str = "sp",
     if x.ndim < 1:
         raise ValueError("sharded_convolve_ring needs [..., n]")
     n, k = x.shape[-1], h.shape[-1]
-    if k > n:
-        raise ValueError(
-            f"h_length {k} > x_length {n}: h must be the shorter signal "
-            "(inc/simd/convolve.h convolve contract) — swap the "
-            "arguments (convolution commutes)")
     if batch_axis is not None and x.ndim != 2:
         raise ValueError("batch_axis needs x of shape [batch, n]")
     n_shards = mesh.shape[axis]
@@ -308,9 +306,9 @@ def sharded_convolve2d(x, h, mesh: Mesh, axes=("dp", "sp")):
     pad0 = -(-out0 // s0) * s0
     pad1 = -(-out1 // s1) * s1
     if k0 - 1 > pad0 // s0 or k1 - 1 > pad1 // s1:
-        raise ValueError(
-            f"kernel halo ({k0 - 1}, {k1 - 1}) exceeds the per-tile block "
-            f"({pad0 // s0}, {pad1 // s1}); use fewer shards")
+        # kernel halo exceeds one tile: auto-select the 2D ring pipeline
+        # (multi-hop streaming along both mesh axes)
+        return sharded_convolve2d_ring(x, h, mesh, axes=axes)
     x_pad = jnp.pad(x, ((0, pad0 - n0), (0, pad1 - n1)))
 
     @functools.partial(
@@ -346,6 +344,99 @@ def sharded_convolve2d(x, h, mesh: Mesh, axes=("dp", "sp")):
             (k0 - 1 + x_local.shape[-2], k1 - 1 + x_local.shape[-1]))
 
     return _run(x_pad, h)[:out0, :out1]
+
+
+def sharded_convolve2d_ring(x, h, mesh: Mesh, axes=("dp", "sp")):
+    """2D convolution for kernels LARGER than a shard tile: the 2D ring
+    pipeline.
+
+    The 1D ring's index algebra separates per axis, so the tile for
+    device (s0, s1) accumulates
+
+        y[j0, j1] = Σ_{m0, m1} Σ_{i0, i1}
+            B_{s0-m0, s1-m1}[i0, i1] · h[m0·blk0 + j0 - i0,
+                                         m1·blk1 + j1 - i1]
+
+    with tiles streaming along ``axes[1]`` (inner ring) inside a stream
+    along ``axes[0]`` (outer ring) — ``(M0+1)·(M1+1)`` local convs and
+    ``M0 + (M0+1)·M1`` ``ppermute`` hops, causality-masked per axis.
+    Works for ANY kernel size, even larger than the image on either
+    axis (hop counts clamp at the mesh axis size − 1, covering every
+    causal tile pair); for kernels whose halo fits one tile prefer
+    :func:`sharded_convolve2d` (two hops).
+    """
+    x = jnp.asarray(x, jnp.float32)
+    h = jnp.asarray(h, jnp.float32)
+    if x.ndim != 2 or h.ndim != 2:
+        raise ValueError("sharded_convolve2d_ring shards one [n0, n1] "
+                         "image with an [k0, k1] kernel")
+    n0, n1 = x.shape
+    k0, k1 = h.shape
+    a0, a1 = axes
+    s0, s1 = mesh.shape[a0], mesh.shape[a1]
+    out0, out1 = n0 + k0 - 1, n1 + k1 - 1
+    blk0, blk1 = -(-out0 // s0), -(-out1 // s1)
+    x_pad = jnp.pad(x, ((0, blk0 * s0 - n0), (0, blk1 * s1 - n1)))
+    hops0 = min(-(-(k0 - 1) // blk0), s0 - 1)
+    hops1 = min(-(-(k1 - 1) // blk1), s1 - 1)
+    h_pp = jnp.pad(h, ((blk0 - 1, (hops0 + 2) * blk0),
+                       (blk1 - 1, (hops1 + 2) * blk1)))
+
+    perm0 = [(i, (i + 1) % s0) for i in range(s0)]
+    perm1 = [(i, (i + 1) % s1) for i in range(s1)]
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(a0, a1), P()), out_specs=P(a0, a1))
+    def _run(x_local, h_padded):
+        i0 = jax.lax.axis_index(a0)
+        i1 = jax.lax.axis_index(a1)
+        y = jnp.zeros_like(x_local)
+        row = x_local
+        for m0 in range(hops0 + 1):
+            tile = row
+            for m1 in range(hops1 + 1):
+                seg = jax.lax.slice(
+                    h_padded, (m0 * blk0, m1 * blk1),
+                    (m0 * blk0 + 2 * blk0 - 1,
+                     m1 * blk1 + 2 * blk1 - 1))
+                contrib = _ring_tile_conv2d(tile, seg)
+                keep = jnp.logical_and(i0 - m0 >= 0,
+                                       i1 - m1 >= 0).astype(contrib.dtype)
+                y = y + keep * contrib
+                if m1 < hops1:
+                    tile = jax.lax.ppermute(tile, a1, perm1)
+            if m0 < hops0:
+                row = jax.lax.ppermute(row, a0, perm0)
+        return y
+
+    return _run(x_pad, h_pp)[:out0, :out1]
+
+
+def _ring_tile_conv2d(tile, seg):
+    """The [blk0-1, 2·blk0-1) × [blk1-1, 2·blk1-1) window of the full 2D
+    convolution of a [blk0, blk1] tile with a [2·blk0-1, 2·blk1-1]
+    kernel segment — one 2D ring hop's contribution.  Direct MXU form
+    below the (1D-measured) spectral crossover, rFFT2 above."""
+    from veles.simd_tpu.ops import convolve as cv
+    from veles.simd_tpu.utils.memory import next_highest_power_of_2
+
+    b0, b1 = tile.shape[-2:]
+    g0, g1 = seg.shape[-2:]
+    if b0 * b1 * g0 * g1 < cv.AUTO_FFT_MIN_PRODUCT ** 2:
+        lhs = tile.reshape((1, 1, b0, b1))
+        rhs = jnp.flip(seg, axis=(-2, -1)).reshape((1, 1, g0, g1))
+        out = jax.lax.conv_general_dilated(
+            lhs, rhs, window_strides=(1, 1),
+            padding=[(b0 - 1, b0 - 1), (b1 - 1, b1 - 1)],
+            precision=jax.lax.Precision.HIGHEST)
+        return out.reshape(tile.shape[:-2] + (b0, b1))
+    m0 = next_highest_power_of_2(b0 + g0 - 1)
+    m1 = next_highest_power_of_2(b1 + g1 - 1)
+    spec = (jnp.fft.rfft2(tile, (m0, m1)) * jnp.fft.rfft2(seg, (m0, m1)))
+    full = jnp.fft.irfft2(spec, (m0, m1))
+    return full[..., b0 - 1:2 * b0 - 1, b1 - 1:2 * b1 - 1].astype(
+        tile.dtype)
 
 
 def sharded_swt(type, order, levels, x, mesh: Mesh, axis: str = "sp"):

@@ -232,8 +232,11 @@ def require_reachable_device(timeout: float = 120.0,
             print(f"device platform unreachable: {detail}",
                   file=sys.stderr)
             raise SystemExit(2)
+        hint = (" (VELES_SIMD_DEVICE_WAIT=0 restores fail-fast)"
+                if attempt == 1 and not env else "")
         print(f"device unreachable (attempt {attempt}: {detail}); "
-              f"retrying for another {remaining:.0f}s", file=sys.stderr)
+              f"retrying for another {remaining:.0f}s{hint}",
+              file=sys.stderr)
         time.sleep(min(30.0, remaining))
 
 
