@@ -342,3 +342,54 @@ def test_nonperiodic_under_jit_raises_clearly():
     with pytest.raises(ValueError, match="outside jit|PERIODIC"):
         jax.jit(lambda a, c: wv.wavelet_reconstruct(
             "daub", 8, a, c, ext=wv.ExtensionType.MIRROR))(b, b)
+
+
+# --------------------------------------------------------------------------
+# wavelet packets (full binary tree)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("levels", [1, 2, 3])
+@pytest.mark.parametrize("simd", [True, False])
+def test_packet_round_trip(levels, simd):
+    x = RNG.randn(256).astype(np.float32)
+    leaves = wv.wavelet_packet_transform("daub", 8, EXT, x, levels,
+                                         simd=simd)
+    assert len(leaves) == 2 ** levels
+    assert all(np.asarray(b).shape == (256 // 2 ** levels,)
+               for b in leaves)
+    rec = wv.wavelet_packet_inverse_transform("daub", 8, leaves,
+                                              simd=simd)
+    np.testing.assert_allclose(np.asarray(rec), x, atol=2e-4)
+
+
+def test_packet_two_levels_match_manual_quarters():
+    """Level-2 leaves equal the manual hihi/hilo/lohi/lolo construction —
+    the layout wavelet_recycle_source (src/wavelet.c:138-165) quarters
+    buffers for."""
+    x = RNG.randn(128).astype(np.float32)
+    hi, lo = wv.wavelet_apply_na("daub", 8, EXT, x)
+    want = (wv.wavelet_apply_na("daub", 8, EXT, hi)
+            + wv.wavelet_apply_na("daub", 8, EXT, lo))
+    got = wv.wavelet_packet_transform("daub", 8, EXT, x, 2, simd=False)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, atol=1e-6)
+
+
+def test_packet_conserves_energy_daub():
+    """The daub table is orthonormal (sums to sqrt(2)); the full packet
+    tree is an orthogonal map, so leaf energy equals signal energy."""
+    x = RNG.randn(256).astype(np.float32)
+    leaves = wv.wavelet_packet_transform("daub", 8, EXT, x, 3, simd=False)
+    e = sum(float(np.sum(np.asarray(b).astype(np.float64) ** 2))
+            for b in leaves)
+    assert abs(e - float(np.sum(x.astype(np.float64) ** 2))) < 1e-3 * e
+
+
+def test_packet_contracts():
+    with pytest.raises(ValueError, match="2\\^levels"):
+        wv.wavelet_packet_inverse_transform(
+            "daub", 8, [np.zeros(8, np.float32)] * 3)
+    with pytest.raises(ValueError, match="levels"):
+        wv.wavelet_packet_transform("daub", 8, EXT,
+                                    np.zeros(64, np.float32), 0)

@@ -50,7 +50,8 @@ the cascade inverses) for **all four extensions** — exact for PERIODIC
 non-periodic DWT, whose fixed-size analysis is provably rank-deficient
 (see the boundary-correction section comment) — plus the separable
 image transforms (:func:`wavelet_apply2d` / :func:`wavelet_reconstruct2d`
-and the 2D pyramid).
+and the 2D pyramid) and the full binary wavelet-packet tree
+(:func:`wavelet_packet_transform` and its inverse).
 """
 
 from __future__ import annotations
@@ -73,6 +74,7 @@ __all__ = [
     "wavelet_apply", "wavelet_apply_na",
     "stationary_wavelet_apply", "stationary_wavelet_apply_na",
     "wavelet_transform", "stationary_wavelet_transform",
+    "wavelet_packet_transform", "wavelet_packet_inverse_transform",
     "wavelet_reconstruct", "wavelet_reconstruct_na",
     "stationary_wavelet_reconstruct", "stationary_wavelet_reconstruct_na",
     "wavelet_inverse_transform", "stationary_wavelet_inverse_transform",
@@ -774,6 +776,59 @@ def stationary_wavelet_inverse_transform(type, order, coeffs, simd=None,
                                              coeffs[lvl - 1], cur,
                                              simd=simd, ext=ext)
     return cur
+
+
+# --------------------------------------------------------------------------
+# wavelet packet transform — NEW capability beyond the reference
+# --------------------------------------------------------------------------
+#
+# The full binary filter-bank tree: unlike the DWT cascade (which only
+# re-splits the lowpass), every band is split at every level, giving
+# 2^levels uniform-bandwidth leaves.  The reference's own
+# wavelet_recycle_source API (src/wavelet.c:138-165: a buffer quartered
+# into desthihi/hilo/lohi/lolo) is shaped for exactly this two-level
+# pattern, but the reference never ships the transform; here it is, with
+# its inverse.
+
+
+def wavelet_packet_transform(type, order, ext, src, levels, simd=None):
+    """Full wavelet-packet decomposition: ``2^levels`` leaf bands, each
+    ``[..., n / 2^levels]``, in natural (filter-bank) order — leaf ``i``'s
+    bit ``b`` (MSB = level 1) says whether the hi (0) or lo (1) branch
+    was taken at level ``b+1`` (hi comes first at every split, so leaf 0
+    is the all-hi band).
+
+    The two-level leaf layout matches the reference's
+    ``wavelet_recycle_source`` quartering (``src/wavelet.c:138-165``):
+    ``[hihi, hilo, lohi, lolo]``.
+    """
+    levels = int(levels)
+    if levels < 1:
+        raise ValueError("levels must be >= 1")
+    bands = [src]
+    for _ in range(levels):
+        nxt = []
+        for band in bands:
+            hi, lo = wavelet_apply(type, order, ext, band, simd=simd)
+            nxt += [hi, lo]
+        bands = nxt
+    return bands
+
+
+def wavelet_packet_inverse_transform(type, order, coeffs, simd=None,
+                                     ext=ExtensionType.PERIODIC):
+    """Invert :func:`wavelet_packet_transform` (``ext`` must match the
+    analysis; PERIODIC is exact, like :func:`wavelet_reconstruct`)."""
+    bands = list(coeffs)
+    n = len(bands)
+    if n < 2 or n & (n - 1):
+        raise ValueError(
+            f"need 2^levels leaf bands, got {n}")
+    while len(bands) > 1:
+        bands = [wavelet_reconstruct(type, order, bands[i], bands[i + 1],
+                                     simd=simd, ext=ext)
+                 for i in range(0, len(bands), 2)]
+    return bands[0]
 
 
 # --------------------------------------------------------------------------
