@@ -313,6 +313,26 @@ static void test_wavelet(void) {
     CHECK_NEAR(zlo2[i], zlo[i], 5e-3);
   }
 
+  /* wavelet packets: 2-level tree round trip; leaves quarter the buffer
+   * exactly like wavelet_recycle_source's hihi/hilo/lohi/lolo layout */
+  float leaves[64], prec2[64];
+  CHECK(wavelet_packet_transform(1, WAVELET_TYPE_DAUBECHIES, 8,
+                                 EXTENSION_TYPE_PERIODIC, sig, 64, 2,
+                                 leaves) == 0);
+  /* leaf 0 (hihi) must equal analyzing the hi band again */
+  float phh[16], plh[16];
+  CHECK(wavelet_apply(1, WAVELET_TYPE_DAUBECHIES, 8,
+                      EXTENSION_TYPE_PERIODIC, phi, 32, phh, plh) == 0);
+  for (int i = 0; i < 16; i++) {
+    CHECK_NEAR(leaves[i], phh[i], 5e-4);
+  }
+  CHECK(wavelet_packet_inverse_transform(1, WAVELET_TYPE_DAUBECHIES, 8,
+                                         EXTENSION_TYPE_PERIODIC, leaves,
+                                         64, 2, prec2) == 0);
+  for (int i = 0; i < 64; i++) {
+    CHECK_NEAR(prec2[i], sig[i], 5e-4);
+  }
+
   /* layout helpers (inc/simd/wavelet.h:55-88 semantics) */
   float *prep = wavelet_prepare_array(8, sig, 64);
   CHECK(prep != NULL && prep[0] == sig[0] && prep[63] == sig[63]);

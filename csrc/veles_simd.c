@@ -501,6 +501,23 @@ int stationary_wavelet_reconstruct(int simd, WaveletType type, int order,
                   PTR(destlo), (unsigned long)length, PTR(result));
 }
 
+int wavelet_packet_transform(int simd, WaveletType type, int order,
+                             ExtensionType ext, const float *src,
+                             size_t length, int levels, float *leaves) {
+  return shim_run("wavelet_packet_transform", "(iiiiKkiK)", simd,
+                  (int)type, order, (int)ext, PTR(src),
+                  (unsigned long)length, levels, PTR(leaves));
+}
+
+int wavelet_packet_inverse_transform(int simd, WaveletType type, int order,
+                                     ExtensionType ext, const float *leaves,
+                                     size_t length, int levels,
+                                     float *result) {
+  return shim_run("wavelet_packet_inverse_transform", "(iiiiKkiK)", simd,
+                  (int)type, order, (int)ext, PTR(leaves),
+                  (unsigned long)length, levels, PTR(result));
+}
+
 /* ---- mathfun ---------------------------------------------------------- */
 
 static int psv(const char *name, int simd, const float *src, size_t length,

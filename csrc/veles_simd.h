@@ -204,6 +204,19 @@ int stationary_wavelet_reconstruct(int simd, WaveletType type, int order,
                                    const float *desthi, const float *destlo,
                                    size_t length, float *result);
 
+/* Wavelet packets — full binary filter-bank tree (no reference analog;
+ * the layout its wavelet_recycle_source quartering anticipates).  The
+ * 2^levels leaves (hi-first natural order, each length/2^levels floats)
+ * are written/read concatenated in `leaves`, which holds exactly
+ * `length` floats.  length must be divisible by 2^levels. */
+int wavelet_packet_transform(int simd, WaveletType type, int order,
+                             ExtensionType ext, const float *src,
+                             size_t length, int levels, float *leaves);
+int wavelet_packet_inverse_transform(int simd, WaveletType type, int order,
+                                     ExtensionType ext, const float *leaves,
+                                     size_t length, int levels,
+                                     float *result);
+
 /* ---- mathfun (inc/simd/mathfun.h:142-204) ----------------------------- */
 
 int sin_psv(int simd, const float *src, size_t length, float *res);

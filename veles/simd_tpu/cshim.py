@@ -218,6 +218,33 @@ def stationary_wavelet_reconstruct(simd, wtype, order, level, ext, desthi,
     return 0
 
 
+def wavelet_packet_transform(simd, wtype, order, ext, src, length, levels,
+                             leaves):
+    bands = _wv.wavelet_packet_transform(
+        _C_WAVELET_TYPES[int(wtype)], int(order), _C_EXTENSIONS[int(ext)],
+        _f32(src, length), int(levels), simd=bool(simd))
+    _f32(leaves, length)[...] = np.concatenate(
+        [np.asarray(b) for b in bands])
+    return 0
+
+
+def wavelet_packet_inverse_transform(simd, wtype, order, ext, leaves,
+                                     length, levels, result):
+    n_leaves = 1 << int(levels)
+    if int(length) % n_leaves:
+        raise ValueError(
+            f"length {length} not divisible by 2^levels = {n_leaves}")
+    flat = _f32(leaves, length)
+    leaf_len = int(length) // n_leaves
+    bands = [flat[i * leaf_len:(i + 1) * leaf_len]
+             for i in range(n_leaves)]
+    rec = _wv.wavelet_packet_inverse_transform(
+        _C_WAVELET_TYPES[int(wtype)], int(order), bands, simd=bool(simd),
+        ext=_C_EXTENSIONS[int(ext)])
+    _f32(result, length)[...] = np.asarray(rec)
+    return 0
+
+
 # ---- mathfun --------------------------------------------------------------
 
 def mathfun(name, simd, src, length, res):

@@ -805,14 +805,19 @@ def wavelet_packet_transform(type, order, ext, src, levels, simd=None):
     levels = int(levels)
     if levels < 1:
         raise ValueError("levels must be >= 1")
-    bands = [src]
+    xp = jnp if resolve_simd(simd) else np
+    # one stacked dispatch per level (all bands at a level share a
+    # length), as wavelet_apply2d does for its column pass — 2^l
+    # sequential calls would waste dispatches and shrink the batch the
+    # Pallas routing gate sees
+    stack = xp.asarray(src)[None]                    # [m=1, ..., n]
     for _ in range(levels):
-        nxt = []
-        for band in bands:
-            hi, lo = wavelet_apply(type, order, ext, band, simd=simd)
-            nxt += [hi, lo]
-        bands = nxt
-    return bands
+        hi, lo = wavelet_apply(type, order, ext, stack, simd=simd)
+        # interleave so band index doubles as 2i (hi) / 2i+1 (lo):
+        # natural hi-first order at every level
+        stack = xp.stack([hi, lo], axis=1).reshape(
+            (2 * stack.shape[0],) + hi.shape[1:])
+    return [stack[i] for i in range(stack.shape[0])]
 
 
 def wavelet_packet_inverse_transform(type, order, coeffs, simd=None,
@@ -824,11 +829,13 @@ def wavelet_packet_inverse_transform(type, order, coeffs, simd=None,
     if n < 2 or n & (n - 1):
         raise ValueError(
             f"need 2^levels leaf bands, got {n}")
-    while len(bands) > 1:
-        bands = [wavelet_reconstruct(type, order, bands[i], bands[i + 1],
-                                     simd=simd, ext=ext)
-                 for i in range(0, len(bands), 2)]
-    return bands[0]
+    xp = jnp if resolve_simd(simd) else np
+    stack = xp.stack([xp.asarray(b) for b in bands])   # [2m, ..., len]
+    while stack.shape[0] > 1:
+        pairs = stack.reshape((stack.shape[0] // 2, 2) + stack.shape[1:])
+        stack = wavelet_reconstruct(type, order, pairs[:, 0], pairs[:, 1],
+                                    simd=simd, ext=ext)
+    return stack[0]
 
 
 # --------------------------------------------------------------------------
