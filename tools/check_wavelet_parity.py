@@ -132,8 +132,9 @@ def main(argv=None):
         amb = f" ambiguous={n_amb}" if n_amb else ""
         print(f"sym{order:<3d} mirror={mirror} bits={bits:<20s} "
               f"max|Δ|={err:.2e}{amb} "
-              f"{'== _SYMLET_SELECTIONS' if agree else '!= ' + repr(checked_in)}"
-              f"  [{status}]")
+              + ("== _SYMLET_SELECTIONS" if agree
+                 else "!= " + repr(checked_in))
+              + f"  [{status}]")
     if bad:
         print(f"{bad} order(s) failed", file=sys.stderr)
     return 1 if bad else 0

@@ -1,0 +1,40 @@
+#!/bin/sh
+# One-shot hardware validation session: run every device-pending item in
+# priority order the moment the axon relay is reachable.  Each step is
+# independently logged and failure-isolated; the bench headline (the
+# driver's BENCH_r03 artifact input) goes first so a short device window
+# still captures it.
+#
+#   sh tools/hw_session.sh [outdir]        # default /tmp/hw_session
+#
+# Steps:
+#   1. bench.py            -> headline JSON + BENCH_DETAILS.json + smoke
+#   2. tools/tpu_smoke.py  -> per-family TPU-CHECK lines (13 families)
+#   3. tools/tune_conv2d.py --quick   -> 2D crossover measurement
+#   4. tools/tune_overlap_save.py --quick  -> 1D step-size re-check
+set -u
+OUT=${1:-/tmp/hw_session}
+mkdir -p "$OUT"
+OUT=$(cd "$OUT" && pwd)   # absolutize before the repo-root cd below
+cd "$(dirname "$0")/.."
+
+echo "== hw_session $(date -u +%FT%TZ) -> $OUT"
+
+run() {
+  name=$1; shift
+  echo "== $name: $*"
+  start=$(date +%s)
+  "$@" >"$OUT/$name.out" 2>"$OUT/$name.err"
+  rc=$?
+  echo "== $name: rc=$rc (${name}.out/.err, $(($(date +%s) - start))s)"
+  return 0
+}
+
+run bench        python bench.py --all
+run smoke        python tools/tpu_smoke.py
+run tune_conv2d  python tools/tune_conv2d.py --quick
+run tune_os      python tools/tune_overlap_save.py --quick
+
+echo "== headline:"
+head -1 "$OUT/bench.out" 2>/dev/null
+echo "== done $(date -u +%FT%TZ)"

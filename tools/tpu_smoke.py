@@ -327,6 +327,21 @@ def _check_parallel(rng):
     b = rng.randn(96, 48).astype(np.float32)
     errs.append(_rel_err(sharded_matmul(a, b, default_mesh("tp"), axis="tp"),
                          a.astype(np.float64) @ b.astype(np.float64)))
+    # ring pipelines (multi-hop ppermute streaming) on the real device
+    from veles.simd_tpu.ops import convolve2d as cv2
+    from veles.simd_tpu.parallel import (
+        make_mesh, sharded_convolve2d_ring, sharded_convolve_ring)
+
+    xr = rng.randn(2048).astype(np.float32)
+    hr = rng.randn(1500).astype(np.float32)   # longer than any block
+    errs.append(_rel_err(
+        sharded_convolve_ring(xr, hr, default_mesh("sp"), axis="sp"),
+        np.convolve(xr.astype(np.float64), hr.astype(np.float64))))
+    img = rng.randn(64, 64).astype(np.float32)
+    k2 = rng.randn(40, 30).astype(np.float32)
+    mesh2d = make_mesh({"dp": 1, "sp": -1})   # works on any device count
+    errs.append(_rel_err(sharded_convolve2d_ring(img, k2, mesh2d),
+                         cv2.convolve2d_na(img, k2)))
     return max(errs), 1e-4
 
 
