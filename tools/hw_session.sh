@@ -31,6 +31,7 @@ run() {
 }
 
 run bench        python bench.py --all
+cp -f BENCH_DETAILS.json "$OUT/" 2>/dev/null || true
 run smoke        python tools/tpu_smoke.py
 run tune_conv2d  python tools/tune_conv2d.py --quick
 run tune_os      python tools/tune_overlap_save.py --quick

@@ -332,8 +332,15 @@ def _check_parallel(rng):
     from veles.simd_tpu.parallel import (
         make_mesh, sharded_convolve2d_ring, sharded_convolve_ring)
 
+    import jax
+
+    # size the filter so the halo exceeds one block whenever >= 2
+    # devices exist (on a single chip the ring degenerates to one local
+    # conv — inter-device ppermute needs a real second device)
+    n_dev = len(jax.devices())
     xr = rng.randn(2048).astype(np.float32)
-    hr = rng.randn(1500).astype(np.float32)   # longer than any block
+    kr = 1500 if n_dev == 1 else (2048 // n_dev) + 600
+    hr = rng.randn(kr).astype(np.float32)
     errs.append(_rel_err(
         sharded_convolve_ring(xr, hr, default_mesh("sp"), axis="sp"),
         np.convolve(xr.astype(np.float64), hr.astype(np.float64))))

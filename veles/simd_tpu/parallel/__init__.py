@@ -31,6 +31,11 @@ scale across ICI — XLA collectives instead of any message-passing runtime.
   the **sharded synthesis** inverses: the adjoint's windows reach left,
   so each level is a left-halo ring ``ppermute`` + local dilated
   convolution, closing the distributed analysis→synthesis round trip.
+* :func:`sharded_wavelet_apply2d` / :func:`sharded_wavelet_reconstruct2d`
+  — the **all-to-all** (Ulysses-style) pattern: rows transform locally,
+  an ``all_to_all`` transpose re-shards to columns, columns transform
+  locally.  Every pass sees complete rows/columns, so all four boundary
+  extensions are exact.
 * :func:`sharded_matmul` — **tensor-parallel** GEMM: contracting dimension
   sharded (zero-padded to the axis size), partials combined with ``psum``
   over ICI.
@@ -53,13 +58,15 @@ from veles.simd_tpu.parallel.ops import (
     data_parallel, halo_exchange_left, halo_exchange_right,
     sharded_convolve, sharded_convolve2d, sharded_convolve2d_ring,
     sharded_convolve_batch, sharded_convolve_ring, sharded_matmul,
-    sharded_swt, sharded_swt_reconstruct, sharded_wavelet_reconstruct)
+    sharded_swt, sharded_swt_reconstruct, sharded_wavelet_apply2d,
+    sharded_wavelet_reconstruct, sharded_wavelet_reconstruct2d)
 
 __all__ = ["make_mesh", "default_mesh", "sharded_convolve",
            "sharded_convolve_ring",
            "sharded_convolve_batch", "sharded_convolve2d",
            "sharded_convolve2d_ring",
            "sharded_swt", "sharded_swt_reconstruct",
-           "sharded_wavelet_reconstruct", "sharded_matmul",
+           "sharded_wavelet_reconstruct", "sharded_wavelet_apply2d",
+           "sharded_wavelet_reconstruct2d", "sharded_matmul",
            "data_parallel", "halo_exchange_left", "halo_exchange_right",
            "distributed"]

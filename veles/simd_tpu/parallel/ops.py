@@ -25,7 +25,8 @@ __all__ = ["sharded_convolve", "sharded_convolve_ring",
            "sharded_convolve2d", "sharded_convolve2d_ring",
            "sharded_matmul",
            "sharded_swt", "sharded_swt_reconstruct",
-           "sharded_wavelet_reconstruct", "data_parallel",
+           "sharded_wavelet_reconstruct", "sharded_wavelet_apply2d",
+           "sharded_wavelet_reconstruct2d", "data_parallel",
            "halo_exchange_left", "halo_exchange_right"]
 
 
@@ -437,6 +438,111 @@ def _ring_tile_conv2d(tile, seg):
     full = jnp.fft.irfft2(spec, (m0, m1))
     return full[..., b0 - 1:2 * b0 - 1, b1 - 1:2 * b1 - 1].astype(
         tile.dtype)
+
+
+def sharded_wavelet_apply2d(type, order, ext, img, mesh: Mesh,
+                            axis: str = "sp"):
+    """Separable 2D DWT of one image with rows sharded over
+    ``mesh[axis]`` — the **all-to-all** (Ulysses-style) layout pattern,
+    complementing the halo/ring family.
+
+    Each device transforms its complete rows locally, an
+    ``all_to_all`` re-shards from row-split to column-split (the
+    distributed-transpose step of 2D FFTs), the column pass runs
+    locally on complete columns, and a second ``all_to_all`` restores
+    the row sharding.  Because every 1D pass sees whole rows/columns,
+    **all four boundary extensions are exact** — no halo approximation
+    anywhere.  Returns ``(ll, lh, hl, hh)``, each ``[n0/2, n1/2]``
+    sharded on the first dim, matching
+    :func:`veles.simd_tpu.ops.wavelet.wavelet_apply2d`.
+
+    Requires ``n0 % (2·S) == 0`` and ``n1 % (2·S) == 0`` (both passes
+    halve a dimension that must then re-split S ways).
+    """
+    from veles.simd_tpu.ops import wavelet as wv
+
+    img = jnp.asarray(img, jnp.float32)
+    if img.ndim != 2:
+        raise ValueError("sharded_wavelet_apply2d shards one [n0, n1] "
+                         "image")
+    n0, n1 = img.shape
+    s = mesh.shape[axis]
+    if n0 % (2 * s) or n1 % (2 * s):
+        raise ValueError(
+            f"image {img.shape} must have both dims divisible by "
+            f"2*{axis}={2 * s} (each pass halves a dim that re-splits "
+            f"{s} ways)")
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=P(axis, None),
+        out_specs=(P(axis, None),) * 4)
+    def _run(x_local):
+        # row pass: complete rows live locally
+        hi_r, lo_r = wv.wavelet_apply(type, order, ext, x_local,
+                                      simd=True)
+        both = jnp.stack([hi_r, lo_r])              # [2, n0/S, n1/2]
+        # all-to-all transpose: row-split -> column-split
+        cols = jax.lax.all_to_all(both, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)       # [2, n0, n1/(2S)]
+        # column pass on complete columns
+        bands, lows = wv._apply_last(
+            lambda v: wv.wavelet_apply(type, order, ext, v, simd=True),
+            cols)                                   # each [2, n0/2, n1/(2S)]
+        quad = jnp.stack([bands, lows])             # [2, 2, n0/2, n1/(2S)]
+        # transpose back: column-split -> row-split
+        quad = jax.lax.all_to_all(quad, axis, split_axis=2, concat_axis=3,
+                                  tiled=True)       # [2, 2, n0/(2S), n1/2]
+        (hh, lh), (hl, ll) = quad[0], quad[1]
+        return ll, lh, hl, hh
+
+    return _run(img)
+
+
+def sharded_wavelet_reconstruct2d(type, order, ll, lh, hl, hh, mesh: Mesh,
+                                  axis: str = "sp"):
+    """Exact inverse of :func:`sharded_wavelet_apply2d` for the PERIODIC
+    extension: the same all-to-all choreography in reverse (column
+    synthesis on complete columns, transpose, row synthesis).
+
+    Non-PERIODIC synthesis needs the host-float64 boundary solve
+    (:mod:`veles.simd_tpu.ops.wavelet`), which cannot run inside
+    ``shard_map`` — gather the bands and use the single-chip
+    :func:`wavelet_reconstruct2d` for those.
+    """
+    from veles.simd_tpu.ops import wavelet as wv
+
+    bands = [jnp.asarray(b, jnp.float32) for b in (ll, lh, hl, hh)]
+    if any(b.shape != bands[0].shape or b.ndim != 2 for b in bands):
+        raise ValueError("need four equal [m0, m1] bands")
+    m0, m1 = bands[0].shape
+    s = mesh.shape[axis]
+    if m0 % s or m1 % s:
+        raise ValueError(
+            f"band dims {bands[0].shape} must be divisible by {axis}={s}")
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(axis, None),) * 4, out_specs=P(axis, None))
+    def _run(ll_b, lh_b, hl_b, hh_b):
+        quad = jnp.stack([jnp.stack([hh_b, lh_b]),
+                          jnp.stack([hl_b, ll_b])])  # [2, 2, m0/S, m1]
+        # row-split -> column-split
+        quad = jax.lax.all_to_all(quad, axis, split_axis=3, concat_axis=2,
+                                  tiled=True)        # [2, 2, m0, m1/S]
+        # column synthesis on complete columns
+        rec = wv.wavelet_reconstruct(
+            type, order, quad[0].swapaxes(-1, -2),
+            quad[1].swapaxes(-1, -2), simd=True)     # [2, m1/S, 2*m0]
+        rec = rec.swapaxes(-1, -2)                   # [2, 2*m0, m1/S]
+        # column-split -> row-split
+        rec = jax.lax.all_to_all(rec, axis, split_axis=1, concat_axis=2,
+                                 tiled=True)         # [2, 2*m0/S, m1]
+        # row synthesis on complete rows
+        return wv.wavelet_reconstruct(type, order, rec[0], rec[1],
+                                      simd=True)     # [2*m0/S, 2*m1]
+
+    return _run(*bands)
 
 
 def sharded_swt(type, order, levels, x, mesh: Mesh, axis: str = "sp"):
