@@ -92,6 +92,20 @@ def main(pid: int, nproc: int, port: str) -> None:
                                                        tiled=True))
     np.testing.assert_allclose(rec, xs, atol=1e-3)
 
+    # all-to-all 2D wavelet with the transform axis over dp — the one
+    # collective (all_to_all) actually crossing the process boundary
+    from veles.simd_tpu.ops import wavelet as wvo
+    from veles.simd_tpu.parallel import sharded_wavelet_apply2d
+
+    img = rng.randn(8 * nproc, 32).astype(np.float32)
+    got = sharded_wavelet_apply2d("daub", 4, wvo.ExtensionType.MIRROR,
+                                  jnp.asarray(img), mesh, axis="dp")
+    want = wvo.wavelet_apply2d("daub", 4, wvo.ExtensionType.MIRROR, img,
+                               simd=False)
+    for g, w in zip(got, want):
+        gg = np.asarray(multihost_utils.process_allgather(g, tiled=True))
+        np.testing.assert_allclose(gg, np.asarray(w), atol=1e-3)
+
     distributed.shutdown()
     print(f"worker {pid}/{nproc} ok", flush=True)
 
