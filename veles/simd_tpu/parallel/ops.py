@@ -25,7 +25,8 @@ __all__ = ["sharded_convolve", "sharded_convolve_ring",
            "sharded_convolve2d", "sharded_convolve2d_ring",
            "sharded_matmul",
            "sharded_swt", "sharded_swt_reconstruct",
-           "sharded_wavelet_reconstruct", "sharded_wavelet_apply2d",
+           "sharded_wavelet_apply", "sharded_wavelet_reconstruct",
+           "sharded_wavelet_apply2d",
            "sharded_wavelet_reconstruct2d", "data_parallel",
            "halo_exchange_left", "halo_exchange_right"]
 
@@ -678,6 +679,56 @@ def sharded_swt_reconstruct(type, order, levels, coeffs, mesh: Mesh,
         return cur
 
     return _run(*coeffs)
+
+
+def sharded_wavelet_apply(type, order, x, mesh: Mesh, axis: str = "sp"):
+    """Sequence-parallel single-level DWT analysis (PERIODIC): signal
+    ``[..., n]`` sharded along length → ``(hi, lo)`` bands ``[..., n/2]``
+    sharded the same way.
+
+    Each shard's stride-2 windows reach ``order − 2`` samples past its
+    block, so one right-halo ring ``ppermute`` (periodic wrap) feeds a
+    local strided conv — the analysis-side mirror of
+    :func:`sharded_wavelet_reconstruct`, closing the sharded DWT round
+    trip.
+    """
+    from veles.simd_tpu.ops import wavelet as wv
+
+    x = jnp.asarray(x, jnp.float32)
+    order = int(order)
+    n = x.shape[-1]
+    n_shards = mesh.shape[axis]
+    if n % (2 * n_shards):
+        raise ValueError(f"signal length {n} must be divisible by "
+                         f"2*{axis}={2 * n_shards}")
+    # stride-2 windows reach (order-2) samples past the block: the last
+    # window starts at block-2 and spans order taps
+    halo = order - 2
+    if halo > n // n_shards:
+        raise ValueError(
+            f"analysis halo {halo} exceeds the per-shard block "
+            f"({n // n_shards}); fewer shards")
+    hi_f, lo_f = wv._filters(type, order)
+    rhs = jnp.stack([jnp.asarray(hi_f),
+                     jnp.asarray(lo_f)]).reshape(2, 1, order)
+    spec = P(*([None] * (x.ndim - 1) + [axis]))
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=spec, out_specs=(spec, spec))
+    def _run(x_local):
+        h = halo_exchange_right(x_local, halo, axis, periodic=True)
+        ext = jnp.concatenate([x_local, h], axis=-1)
+        batch_shape = x_local.shape[:-1]
+        m_loc = x_local.shape[-1] // 2
+        lhs = ext.reshape((-1, 1, ext.shape[-1]))
+        out = jax.lax.conv_general_dilated(
+            lhs, rhs.astype(jnp.float32), window_strides=(2,),
+            padding="VALID", precision=jax.lax.Precision.HIGHEST)
+        out = out[..., :m_loc].reshape(batch_shape + (2, m_loc))
+        return out[..., 0, :], out[..., 1, :]
+
+    return _run(x)
 
 
 def sharded_wavelet_reconstruct(type, order, desthi, destlo, mesh: Mesh,
