@@ -540,3 +540,21 @@ class TestShardedDWTAnalysis:
         with pytest.raises(ValueError, match="halo"):
             par.sharded_wavelet_apply("daub", 76,
                                       np.zeros(512, np.float32), mesh)
+
+    def test_multi_level_cascade_round_trip(self):
+        from veles.simd_tpu.ops import wavelet as wv
+
+        mesh = par.make_mesh({"sp": 4, "dp": 2})
+        rng = np.random.RandomState(57)
+        x = rng.randn(1024).astype(np.float32)
+        coeffs = par.sharded_wavelet_transform("daub", 8, x, 3, mesh,
+                                               axis="sp")
+        want = wv.wavelet_transform("daub", 8, wv.ExtensionType.PERIODIC,
+                                    x, 3, simd=False)
+        assert len(coeffs) == 4
+        for c, w in zip(coeffs, want):
+            np.testing.assert_allclose(np.asarray(c), np.asarray(w),
+                                       atol=5e-4)
+        rec = par.sharded_wavelet_inverse_transform("daub", 8, coeffs,
+                                                    mesh, axis="sp")
+        np.testing.assert_allclose(np.asarray(rec), x, atol=5e-4)

@@ -59,15 +59,18 @@ from veles.simd_tpu.parallel.ops import (
     sharded_convolve, sharded_convolve2d, sharded_convolve2d_ring,
     sharded_convolve_batch, sharded_convolve_ring, sharded_matmul,
     sharded_swt, sharded_swt_reconstruct, sharded_wavelet_apply,
-    sharded_wavelet_apply2d, sharded_wavelet_reconstruct,
-    sharded_wavelet_reconstruct2d)
+    sharded_wavelet_apply2d, sharded_wavelet_inverse_transform,
+    sharded_wavelet_reconstruct, sharded_wavelet_reconstruct2d,
+    sharded_wavelet_transform)
 
 __all__ = ["make_mesh", "default_mesh", "sharded_convolve",
            "sharded_convolve_ring",
            "sharded_convolve_batch", "sharded_convolve2d",
            "sharded_convolve2d_ring",
            "sharded_swt", "sharded_swt_reconstruct",
-           "sharded_wavelet_apply", "sharded_wavelet_reconstruct",
+           "sharded_wavelet_apply", "sharded_wavelet_transform",
+           "sharded_wavelet_inverse_transform",
+           "sharded_wavelet_reconstruct",
            "sharded_wavelet_apply2d",
            "sharded_wavelet_reconstruct2d", "sharded_matmul",
            "data_parallel", "halo_exchange_left", "halo_exchange_right",

@@ -25,7 +25,9 @@ __all__ = ["sharded_convolve", "sharded_convolve_ring",
            "sharded_convolve2d", "sharded_convolve2d_ring",
            "sharded_matmul",
            "sharded_swt", "sharded_swt_reconstruct",
-           "sharded_wavelet_apply", "sharded_wavelet_reconstruct",
+           "sharded_wavelet_apply", "sharded_wavelet_transform",
+           "sharded_wavelet_inverse_transform",
+           "sharded_wavelet_reconstruct",
            "sharded_wavelet_apply2d",
            "sharded_wavelet_reconstruct2d", "data_parallel",
            "halo_exchange_left", "halo_exchange_right"]
@@ -729,6 +731,36 @@ def sharded_wavelet_apply(type, order, x, mesh: Mesh, axis: str = "sp"):
         return out[..., 0, :], out[..., 1, :]
 
     return _run(x)
+
+
+def sharded_wavelet_transform(type, order, x, levels, mesh: Mesh,
+                              axis: str = "sp"):
+    """Multi-level sequence-parallel DWT cascade (PERIODIC): repeatedly
+    split the length-sharded lowpass band.  Returns
+    ``[hi_1, ..., hi_L, lo_L]`` like the single-chip
+    :func:`veles.simd_tpu.ops.wavelet.wavelet_transform`, every band
+    sharded over ``mesh[axis]``.  The per-shard block halves each level,
+    so depth is bounded by ``n / (S · 2^(L-1)) >= order - 2``."""
+    coeffs = []
+    cur = x
+    for _ in range(int(levels)):
+        hi, cur = sharded_wavelet_apply(type, order, cur, mesh, axis=axis)
+        coeffs.append(hi)
+    coeffs.append(cur)
+    return coeffs
+
+
+def sharded_wavelet_inverse_transform(type, order, coeffs, mesh: Mesh,
+                                      axis: str = "sp"):
+    """Invert :func:`sharded_wavelet_transform` on the mesh (PERIODIC)."""
+    coeffs = list(coeffs)
+    if len(coeffs) < 2:
+        raise ValueError("need [hi_1, ..., hi_L, lo_L] with L >= 1")
+    cur = coeffs[-1]
+    for hi in reversed(coeffs[:-1]):
+        cur = sharded_wavelet_reconstruct(type, order, hi, cur, mesh,
+                                          axis=axis)
+    return cur
 
 
 def sharded_wavelet_reconstruct(type, order, desthi, destlo, mesh: Mesh,
