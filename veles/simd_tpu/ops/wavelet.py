@@ -49,8 +49,9 @@ the cascade inverses) for **all four extensions** — exact for PERIODIC
 (full-rank frame, least-squares solve); least-squares for the
 non-periodic DWT, whose fixed-size analysis is provably rank-deficient
 (see the boundary-correction section comment) — plus the separable
-image transforms (:func:`wavelet_apply2d` / :func:`wavelet_reconstruct2d`
-and the 2D pyramid) and the full binary wavelet-packet tree
+image transforms (:func:`wavelet_apply2d` / :func:`wavelet_reconstruct2d`,
+the 2D pyramid, and the undecimated :func:`stationary_wavelet_apply2d`)
+and the full binary wavelet-packet tree
 (:func:`wavelet_packet_transform` and its inverse).
 """
 
@@ -79,6 +80,7 @@ __all__ = [
     "stationary_wavelet_reconstruct", "stationary_wavelet_reconstruct_na",
     "wavelet_inverse_transform", "stationary_wavelet_inverse_transform",
     "wavelet_apply2d", "wavelet_reconstruct2d",
+    "stationary_wavelet_apply2d", "stationary_wavelet_reconstruct2d",
     "wavelet_transform2d", "wavelet_inverse_transform2d",
     "wavelet_prepare_array", "wavelet_allocate_destination",
     "wavelet_recycle_source", "wavelet_validate_order",
@@ -849,26 +851,40 @@ def _apply_last(fn, x):
     return tuple(o.swapaxes(-1, -2) for o in fn(x.swapaxes(-1, -2)))
 
 
+def _separable_apply2d(rows, src, simd, what):
+    """Shared separable-2D analysis plumbing: one row pass, then ONE
+    stacked column pass (doubles the batch the Pallas routing gate sees
+    and halves the dispatches vs transforming hi_r/lo_r apart).
+    Returns ``(ll, lh, hl, hh)``."""
+    if np.ndim(src) < 2:
+        raise ValueError(f"{what} needs [..., n0, n1]")
+    xp = jnp if resolve_simd(simd) else np
+    hi_r, lo_r = rows(xp.asarray(src))                # along n1
+    bands, lows = _apply_last(rows, xp.stack([hi_r, lo_r]))
+    hh, lh = bands[0], bands[1]
+    hl, ll = lows[0], lows[1]
+    return ll, lh, hl, hh
+
+
+def _separable_reconstruct2d(synth, ll, lh, hl, hh, simd):
+    """Shared separable-2D synthesis plumbing: one stacked column
+    synthesis for both row bands, then the row synthesis."""
+    xp = jnp if resolve_simd(simd) else np
+    hi_b = xp.stack([xp.asarray(hh), xp.asarray(lh)]).swapaxes(-1, -2)
+    lo_b = xp.stack([xp.asarray(hl), xp.asarray(ll)]).swapaxes(-1, -2)
+    rec = synth(hi_b, lo_b).swapaxes(-1, -2)
+    return synth(rec[0], rec[1])
+
+
 def wavelet_apply2d(type, order, ext, src, simd=None):
     """Separable single-level 2D DWT of ``[..., n0, n1]``: rows then
     columns.  Returns ``(LL, LH, HL, HH)``, each ``[..., n0/2, n1/2]``
     — the standard image-compression quad (first letter = row band,
     second = column band; L = lowpass).  No reference analog (the
     reference transforms 1D signals only)."""
-    if np.ndim(src) < 2:
-        raise ValueError("wavelet_apply2d needs [..., n0, n1]")
-    xp = jnp if resolve_simd(simd) else np
-
-    def rows(v):
-        return wavelet_apply(type, order, ext, v, simd=simd)
-
-    hi_r, lo_r = rows(xp.asarray(src))                # along n1
-    # one stacked column pass: doubles the batch the Pallas routing gate
-    # sees and halves the dispatches vs transforming hi_r/lo_r apart
-    bands, lows = _apply_last(rows, xp.stack([hi_r, lo_r]))
-    hh, lh = bands[0], bands[1]
-    hl, ll = lows[0], lows[1]
-    return ll, lh, hl, hh
+    return _separable_apply2d(
+        lambda v: wavelet_apply(type, order, ext, v, simd=simd),
+        src, simd, "wavelet_apply2d")
 
 
 def wavelet_reconstruct2d(type, order, ll, lh, hl, hh, simd=None,
@@ -876,14 +892,36 @@ def wavelet_reconstruct2d(type, order, ll, lh, hl, hh, simd=None,
     """Exact inverse of :func:`wavelet_apply2d`: columns then rows, each
     the 1D synthesis (separability makes any per-axis-exact ``ext``
     exact in 2D; ``ext`` must match the analysis)."""
-    xp = jnp if resolve_simd(simd) else np
-    # one stacked column synthesis for both row bands (see apply2d)
-    hi_b = xp.stack([xp.asarray(hh), xp.asarray(lh)]).swapaxes(-1, -2)
-    lo_b = xp.stack([xp.asarray(hl), xp.asarray(ll)]).swapaxes(-1, -2)
-    rec = wavelet_reconstruct(type, order, hi_b, lo_b, simd=simd,
-                              ext=ext).swapaxes(-1, -2)
-    return wavelet_reconstruct(type, order, rec[0], rec[1], simd=simd,
-                               ext=ext)
+    return _separable_reconstruct2d(
+        lambda a, b: wavelet_reconstruct(type, order, a, b, simd=simd,
+                                         ext=ext),
+        ll, lh, hl, hh, simd)
+
+
+def stationary_wavelet_apply2d(type, order, level, ext, src, simd=None):
+    """Separable single-level 2D SWT (à-trous, undecimated) of
+    ``[..., n0, n1]``: rows then columns at the same dilation.  Returns
+    ``(LL, LH, HL, HH)``, each full ``[..., n0, n1]`` size — the
+    shift-invariant quad image denoising wants (no decimation, so
+    thresholding artifacts don't alias).  No reference analog."""
+    return _separable_apply2d(
+        lambda v: stationary_wavelet_apply(type, order, level, ext, v,
+                                           simd=simd),
+        src, simd, "stationary_wavelet_apply2d")
+
+
+def stationary_wavelet_reconstruct2d(type, order, level, ll, lh, hl, hh,
+                                     simd=None,
+                                     ext=ExtensionType.PERIODIC):
+    """Exact inverse of :func:`stationary_wavelet_apply2d`: columns then
+    rows, each the 1D SWT least-squares synthesis (exact for PERIODIC;
+    every extension round-trips within the boundary conditioning since
+    the SWT frame is full-rank per axis)."""
+    return _separable_reconstruct2d(
+        lambda a, b: stationary_wavelet_reconstruct(type, order, level,
+                                                    a, b, simd=simd,
+                                                    ext=ext),
+        ll, lh, hl, hh, simd)
 
 
 def wavelet_transform2d(type, order, ext, src, levels, simd=None):
