@@ -501,6 +501,48 @@ int stationary_wavelet_reconstruct(int simd, WaveletType type, int order,
                   PTR(destlo), (unsigned long)length, PTR(result));
 }
 
+int wavelet_apply2d(int simd, WaveletType type, int order,
+                    ExtensionType ext, const float *src, size_t n0,
+                    size_t n1, float *ll, float *lh, float *hl,
+                    float *hh) {
+  return shim_run("wavelet_apply2d", "(iiiiKkkKKKK)", simd, (int)type,
+                  order, (int)ext, PTR(src), (unsigned long)n0,
+                  (unsigned long)n1, PTR(ll), PTR(lh), PTR(hl), PTR(hh));
+}
+
+int wavelet_reconstruct2d(int simd, WaveletType type, int order,
+                          ExtensionType ext, const float *ll,
+                          const float *lh, const float *hl,
+                          const float *hh, size_t m0, size_t m1,
+                          float *result) {
+  return shim_run("wavelet_reconstruct2d", "(iiiiKKKKkkK)", simd,
+                  (int)type, order, (int)ext, PTR(ll), PTR(lh), PTR(hl),
+                  PTR(hh), (unsigned long)m0, (unsigned long)m1,
+                  PTR(result));
+}
+
+int stationary_wavelet_apply2d(int simd, WaveletType type, int order,
+                               int level, ExtensionType ext,
+                               const float *src, size_t n0, size_t n1,
+                               float *ll, float *lh, float *hl,
+                               float *hh) {
+  return shim_run("stationary_wavelet_apply2d", "(iiiiiKkkKKKK)", simd,
+                  (int)type, order, level, (int)ext, PTR(src),
+                  (unsigned long)n0, (unsigned long)n1, PTR(ll), PTR(lh),
+                  PTR(hl), PTR(hh));
+}
+
+int stationary_wavelet_reconstruct2d(int simd, WaveletType type, int order,
+                                     int level, ExtensionType ext,
+                                     const float *ll, const float *lh,
+                                     const float *hl, const float *hh,
+                                     size_t m0, size_t m1, float *result) {
+  return shim_run("stationary_wavelet_reconstruct2d", "(iiiiiKKKKkkK)",
+                  simd, (int)type, order, level, (int)ext, PTR(ll),
+                  PTR(lh), PTR(hl), PTR(hh), (unsigned long)m0,
+                  (unsigned long)m1, PTR(result));
+}
+
 int wavelet_packet_transform(int simd, WaveletType type, int order,
                              ExtensionType ext, const float *src,
                              size_t length, int levels, float *leaves) {

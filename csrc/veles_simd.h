@@ -204,6 +204,29 @@ int stationary_wavelet_reconstruct(int simd, WaveletType type, int order,
                                    const float *desthi, const float *destlo,
                                    size_t length, float *result);
 
+/* Separable 2D wavelet transforms — no reference analog (1D only).
+ * wavelet_apply2d: src is [n0, n1] row-major; the four bands are each
+ * [n0/2, n1/2] (DWT) or [n0, n1] (stationary).  reconstruct2d inverts
+ * with band dims [m0, m1] -> result [2*m0, 2*m1] (DWT) / [m0, m1]
+ * (stationary).  `ext` must match the analysis (PERIODIC exact). */
+int wavelet_apply2d(int simd, WaveletType type, int order,
+                    ExtensionType ext, const float *src, size_t n0,
+                    size_t n1, float *ll, float *lh, float *hl, float *hh);
+int wavelet_reconstruct2d(int simd, WaveletType type, int order,
+                          ExtensionType ext, const float *ll,
+                          const float *lh, const float *hl,
+                          const float *hh, size_t m0, size_t m1,
+                          float *result);
+int stationary_wavelet_apply2d(int simd, WaveletType type, int order,
+                               int level, ExtensionType ext,
+                               const float *src, size_t n0, size_t n1,
+                               float *ll, float *lh, float *hl, float *hh);
+int stationary_wavelet_reconstruct2d(int simd, WaveletType type, int order,
+                                     int level, ExtensionType ext,
+                                     const float *ll, const float *lh,
+                                     const float *hl, const float *hh,
+                                     size_t m0, size_t m1, float *result);
+
 /* Wavelet packets — full binary filter-bank tree (no reference analog;
  * the layout its wavelet_recycle_source quartering anticipates).  The
  * 2^levels leaves (hi-first natural order, each length/2^levels floats)

@@ -218,6 +218,45 @@ def stationary_wavelet_reconstruct(simd, wtype, order, level, ext, desthi,
     return 0
 
 
+def wavelet_apply2d(simd, wtype, order, ext, src, n0, n1, ll, lh, hl, hh):
+    bands = _wv.wavelet_apply2d(
+        _C_WAVELET_TYPES[int(wtype)], int(order), _C_EXTENSIONS[int(ext)],
+        _f32(src, n0, n1), simd=bool(simd))
+    for ptr, band in zip((ll, lh, hl, hh), bands):
+        _f32(ptr, n0 // 2, n1 // 2)[...] = np.asarray(band)
+    return 0
+
+
+def wavelet_reconstruct2d(simd, wtype, order, ext, ll, lh, hl, hh, m0, m1,
+                          result):
+    rec = _wv.wavelet_reconstruct2d(
+        _C_WAVELET_TYPES[int(wtype)], int(order),
+        _f32(ll, m0, m1), _f32(lh, m0, m1), _f32(hl, m0, m1),
+        _f32(hh, m0, m1), simd=bool(simd), ext=_C_EXTENSIONS[int(ext)])
+    _f32(result, 2 * m0, 2 * m1)[...] = np.asarray(rec)
+    return 0
+
+
+def stationary_wavelet_apply2d(simd, wtype, order, level, ext, src, n0, n1,
+                               ll, lh, hl, hh):
+    bands = _wv.stationary_wavelet_apply2d(
+        _C_WAVELET_TYPES[int(wtype)], int(order), int(level),
+        _C_EXTENSIONS[int(ext)], _f32(src, n0, n1), simd=bool(simd))
+    for ptr, band in zip((ll, lh, hl, hh), bands):
+        _f32(ptr, n0, n1)[...] = np.asarray(band)
+    return 0
+
+
+def stationary_wavelet_reconstruct2d(simd, wtype, order, level, ext, ll,
+                                     lh, hl, hh, m0, m1, result):
+    rec = _wv.stationary_wavelet_reconstruct2d(
+        _C_WAVELET_TYPES[int(wtype)], int(order), int(level),
+        _f32(ll, m0, m1), _f32(lh, m0, m1), _f32(hl, m0, m1),
+        _f32(hh, m0, m1), simd=bool(simd), ext=_C_EXTENSIONS[int(ext)])
+    _f32(result, m0, m1)[...] = np.asarray(rec)
+    return 0
+
+
 def wavelet_packet_transform(simd, wtype, order, ext, src, length, levels,
                              leaves):
     bands = _wv.wavelet_packet_transform(
