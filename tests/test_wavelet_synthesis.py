@@ -436,3 +436,35 @@ def test_swt2d_nonperiodic_round_trip(ext):
     rec = wv.stationary_wavelet_reconstruct2d("daub", 6, 1, ll, lh, hl,
                                               hh, simd=False, ext=ext)
     np.testing.assert_allclose(np.asarray(rec), img, atol=2e-2)
+
+
+@pytest.mark.parametrize("levels", [1, 2])
+@pytest.mark.parametrize("simd", [True, False])
+def test_packet2d_round_trip(levels, simd):
+    img = RNG.randn(64, 32).astype(np.float32)
+    leaves = wv.wavelet_packet_transform2d("daub", 4, EXT, img, levels,
+                                           simd=simd)
+    assert len(leaves) == 4 ** levels
+    assert all(np.asarray(b).shape ==
+               (64 // 2 ** levels, 32 // 2 ** levels) for b in leaves)
+    rec = wv.wavelet_packet_inverse_transform2d("daub", 4, leaves,
+                                                simd=simd)
+    np.testing.assert_allclose(np.asarray(rec), img, atol=5e-4)
+
+
+def test_packet2d_leaf0_is_llll():
+    """Natural order: leaf 0 at 2 levels is LL-of-LL."""
+    img = RNG.randn(32, 32).astype(np.float32)
+    leaves = wv.wavelet_packet_transform2d("daub", 4, EXT, img, 2,
+                                           simd=False)
+    ll1 = wv.wavelet_apply2d("daub", 4, EXT, img, simd=False)[0]
+    llll = wv.wavelet_apply2d("daub", 4, EXT, np.asarray(ll1),
+                              simd=False)[0]
+    np.testing.assert_allclose(np.asarray(leaves[0]), np.asarray(llll),
+                               atol=1e-6)
+
+
+def test_packet2d_contracts():
+    with pytest.raises(ValueError, match="4\\^levels"):
+        wv.wavelet_packet_inverse_transform2d(
+            "daub", 4, [np.zeros((4, 4), np.float32)] * 3)

@@ -76,6 +76,7 @@ __all__ = [
     "stationary_wavelet_apply", "stationary_wavelet_apply_na",
     "wavelet_transform", "stationary_wavelet_transform",
     "wavelet_packet_transform", "wavelet_packet_inverse_transform",
+    "wavelet_packet_transform2d", "wavelet_packet_inverse_transform2d",
     "wavelet_reconstruct", "wavelet_reconstruct_na",
     "stationary_wavelet_reconstruct", "stationary_wavelet_reconstruct_na",
     "wavelet_inverse_transform", "stationary_wavelet_inverse_transform",
@@ -922,6 +923,51 @@ def stationary_wavelet_reconstruct2d(type, order, level, ll, lh, hl, hh,
                                                     a, b, simd=simd,
                                                     ext=ext),
         ll, lh, hl, hh, simd)
+
+
+def wavelet_packet_transform2d(type, order, ext, src, levels, simd=None):
+    """Full 2D wavelet-packet (quad-tree) decomposition: every band is
+    re-split at every level, giving ``4^levels`` uniform leaves, each
+    ``[..., n0/2^levels, n1/2^levels]``, in natural order — leaf index
+    interleaves the per-level quad choice ``(ll, lh, hl, hh)`` =
+    ``(0, 1, 2, 3)``, MSB pair = level 1 — so leaf 0 is the all-LL
+    (approximation) band.  NOTE this is LL-first, the reverse of the 1D
+    :func:`wavelet_packet_transform`'s hi-first order (leaf 0 there is
+    the all-hi band); 2D follows the ``(ll, lh, hl, hh)`` quad
+    convention of :func:`wavelet_apply2d`.  No reference analog."""
+    levels = int(levels)
+    if levels < 1:
+        raise ValueError("levels must be >= 1")
+    xp = jnp if resolve_simd(simd) else np
+    stack = xp.asarray(src)[None]               # [m=1, ..., n0, n1]
+    for _ in range(levels):
+        quad = wavelet_apply2d(type, order, ext, stack, simd=simd)
+        # [m, 4, ..., n0/2, n1/2] -> [4m, ...]: leaf index grows a
+        # base-4 digit per level, natural (ll, lh, hl, hh) order
+        stack = xp.stack(quad, axis=1).reshape(
+            (4 * stack.shape[0],) + quad[0].shape[1:])
+    return [stack[i] for i in range(stack.shape[0])]
+
+
+def wavelet_packet_inverse_transform2d(type, order, coeffs, simd=None,
+                                       ext=ExtensionType.PERIODIC):
+    """Invert :func:`wavelet_packet_transform2d` (``ext`` must match the
+    analysis; PERIODIC is exact)."""
+    bands = list(coeffs)
+    n = len(bands)
+    levels = 0
+    while 4 ** levels < n:
+        levels += 1
+    if n < 4 or 4 ** levels != n:
+        raise ValueError(f"need 4^levels leaf bands, got {n}")
+    xp = jnp if resolve_simd(simd) else np
+    stack = xp.stack([xp.asarray(b) for b in bands])
+    while stack.shape[0] > 1:
+        quads = stack.reshape((stack.shape[0] // 4, 4) + stack.shape[1:])
+        stack = wavelet_reconstruct2d(
+            type, order, quads[:, 0], quads[:, 1], quads[:, 2],
+            quads[:, 3], simd=simd, ext=ext)
+    return stack[0]
 
 
 def wavelet_transform2d(type, order, ext, src, levels, simd=None):
