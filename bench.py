@@ -13,10 +13,21 @@ must still yield the headline): the headline config runs FIRST — after a
 short clock-ramp warm-up and an inline device-vs-oracle value check — and
 its JSON line is printed and flushed immediately.  Every config (headline
 included) is appended to BENCH_DETAILS.json as it completes, so however
-short the device window, whatever ran is on disk.  The per-family
-XLA-vs-oracle correctness smoke (``tools/tpu_smoke.py``, the reference's
-SIMD-vs-``_na`` discipline on real hardware) runs after the headline is
-captured and prints one ``TPU-CHECK`` line per family to stderr.
+short the device window, whatever ran is on disk.  The remaining timed
+configs run next; the per-family XLA-vs-oracle correctness smoke
+(``tools/tpu_smoke.py``, the reference's SIMD-vs-``_na`` discipline on
+real hardware) runs LAST and prints one ``TPU-CHECK`` line per family to
+stderr — measured live (2026-07-31): the relay wedged mid-smoke, so the
+smoke must never be able to shadow a timing config.
+
+Wedge watchdog: the axon relay has twice been observed to wedge
+*mid-run* — an in-flight device call then blocks forever, unkillable
+from Python.  A daemon thread therefore tracks per-stage progress; if a
+stage stalls past $VELES_SIMD_STAGE_TIMEOUT (default 300 s; compiles
+take ~20-40 s; 0 disables), it prints which stage wedged and hard-exits:
+rc=0 once
+the headline line is out (whatever completed is on disk), rc=2 before
+that (the driver's no-data signal, same as ``require_reachable_device``).
 
 Usage:  python bench.py           # one JSON line on stdout (first!)
         python bench.py --all     # pretty table of every config
@@ -26,6 +37,8 @@ Usage:  python bench.py           # one JSON line on stdout (first!)
 import json
 import os
 import sys
+import threading
+import time
 
 import numpy as np
 
@@ -197,6 +210,45 @@ def _warm_device(seconds: float = 1.0):
         np.asarray(runk(a, 1024).ravel()[-1:])
 
 
+class _StageWatchdog:
+    """Hard-exit the process when a device stage stalls (wedged relay).
+
+    A wedged in-flight device call blocks in native code and cannot be
+    interrupted from Python, so the only safe recovery is process exit —
+    acceptable here because every completed result is already flushed to
+    stdout/BENCH_DETAILS.json before the next stage starts.
+    """
+
+    def __init__(self, timeout_s: float):
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._stage = "(startup)"
+        self._t0 = time.monotonic()
+        self.headline_out = False
+        if timeout_s > 0:  # 0 disables, matching $VELES_SIMD_DEVICE_WAIT=0
+            threading.Thread(target=self._watch, daemon=True).start()
+
+    def stage(self, name: str) -> None:
+        with self._lock:
+            self._stage = name
+            self._t0 = time.monotonic()
+
+    def _watch(self) -> None:
+        while True:
+            time.sleep(5.0)
+            with self._lock:
+                stalled = time.monotonic() - self._t0
+                stage = self._stage
+            if stalled > self.timeout_s:
+                print(f"bench.py: stage {stage!r} stalled for "
+                      f"{stalled:.0f}s (> {self.timeout_s:.0f}s) — relay "
+                      "wedge; exiting with the results captured so far",
+                      file=sys.stderr)
+                sys.stderr.flush()
+                sys.stdout.flush()
+                os._exit(0 if self.headline_out else 2)
+
+
 def main():
     from veles.simd_tpu.utils.platform import (
         maybe_override_platform, require_reachable_device)
@@ -210,8 +262,14 @@ def main():
 
     from tools.tpu_smoke import run_smoke
 
+    dog = _StageWatchdog(
+        float(os.environ.get("VELES_SIMD_STAGE_TIMEOUT", "300")))
+
     if "--check" in sys.argv:
-        sys.exit(0 if run_smoke() else 1)
+        # smoke-only mode: a wedge exits 2 (incomplete — the per-family
+        # lines already printed still stand), pass/fail exits 0/1
+        sys.exit(0 if run_smoke(on_start=lambda n: dog.stage(f"smoke:{n}"))
+                 else 1)
 
     device = str(jax.devices()[0])
     rng = np.random.RandomState(0)
@@ -241,7 +299,9 @@ def main():
 
     # headline first: warm clocks, measure, print the parseable line NOW —
     # everything after this point is gravy if the device window closes
+    dog.stage("warmup")
     _warm_device()
+    dog.stage("headline:convolve_1m")
     head = flush(bench_convolve_1m(rng))
     print(json.dumps({
         "metric": head["metric"],
@@ -250,21 +310,25 @@ def main():
         "vs_baseline": (None if head["vs_baseline"] is None
                         else round(head["vs_baseline"], 2)),
     }, allow_nan=False), flush=True)
+    dog.headline_out = True  # a wedge from here on still exits 0
 
     # after the headline has been captured, a failure must not turn the
-    # artifact red or skip independent configs — log and keep going
-    try:
-        if not run_smoke():
-            print("bench.py: correctness smoke FAILED on "
-                  f"{device!r}; timing numbers are suspect", file=sys.stderr)
-    except Exception as e:  # noqa: BLE001 — headline already on stdout
-        print(f"bench.py: smoke crashed ({e!r})", file=sys.stderr)
+    # artifact red or skip independent configs — log and keep going.
+    # Timed configs BEFORE the smoke: the 2026-07-31 window wedged inside
+    # the smoke, which under the old ordering cost configs 1/2/3/5.
     for fn in (bench_elementwise, bench_mathfun, bench_sgemm, bench_dwt):
+        dog.stage(f"config:{fn.__name__}")
         try:
             flush(fn(rng))
         except Exception as e:  # noqa: BLE001
             print(f"bench.py: config {fn.__name__} failed ({e!r}); "
                   "continuing", file=sys.stderr)
+    try:
+        if not run_smoke(on_start=lambda n: dog.stage(f"smoke:{n}")):
+            print("bench.py: correctness smoke FAILED on "
+                  f"{device!r}; timing numbers are suspect", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — headline already on stdout
+        print(f"bench.py: smoke crashed ({e!r})", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -8,8 +8,12 @@
 #   sh tools/hw_session.sh [outdir]        # default /tmp/hw_session
 #
 # Steps:
-#   1. bench.py            -> headline JSON + BENCH_DETAILS.json + smoke
-#   2. tools/tpu_smoke.py  -> per-family TPU-CHECK lines (13 families)
+#   1. bench.py            -> headline JSON + BENCH_DETAILS.json + the
+#                             full 14-family smoke (runs last inside it)
+#   2. tools/tpu_smoke.py  -> retry ONLY the families still lacking a
+#                             green hardware run (pallas1d/parallel/
+#                             pallas2d as of 2026-07-31), in case the
+#                             bench-embedded smoke got cut
 #   3. tools/tune_conv2d.py --quick   -> 2D crossover measurement
 #   4. tools/tune_overlap_save.py --quick  -> 1D step-size re-check
 set -u
@@ -30,11 +34,18 @@ run() {
   return 0
 }
 
-run bench        python bench.py --all
+# every step under a hard `timeout -k` (TERM then KILL — an in-flight
+# device call on a wedged relay blocks forever in native code, observed
+# 2026-07-31, and only process death clears it).  bench.py also
+# self-watchdogs per stage.  The smoke retry covers only the families
+# without a green hardware run yet — a wedge-prone family must not be
+# able to burn the window twice (update the list as families go green).
+run bench        timeout -k 60 3000 python bench.py --all
 cp -f BENCH_DETAILS.json "$OUT/" 2>/dev/null || true
-run smoke        python tools/tpu_smoke.py
-run tune_conv2d  python tools/tune_conv2d.py --quick
-run tune_os      python tools/tune_overlap_save.py --quick
+run smoke        timeout -k 60 900 python tools/tpu_smoke.py \
+                   --family=pallas1d --family=parallel --family=pallas2d
+run tune_conv2d  timeout -k 60 1800 python tools/tune_conv2d.py --quick
+run tune_os      timeout -k 60 1800 python tools/tune_overlap_save.py --quick
 
 echo "== headline:"
 head -1 "$OUT/bench.out" 2>/dev/null

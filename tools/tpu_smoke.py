@@ -263,9 +263,10 @@ def _check_detect_peaks(rng):
     return _rel_err(vals, vals_na), 1e-6
 
 
-def _check_pallas(rng):
-    """Compiled Mosaic filter-bank kernel vs oracle on the real chip (the
-    CPU suite only exercises the interpreter — tests/test_pallas.py)."""
+def _check_pallas1d(rng):
+    """Compiled 1D Mosaic filter-bank kernel vs oracle on the real chip
+    (the CPU suite only exercises the interpreter — tests/test_pallas.py).
+    Ran green on hardware in round 2."""
     from veles.simd_tpu.ops import wavelet as wv
     from veles.simd_tpu.ops.pallas_kernels import (
         filter_bank_pallas, pallas_available)
@@ -294,14 +295,6 @@ def _check_pallas(rng):
                                 simd=True)
     whi, wlo = wv.wavelet_apply_na("daub", 8, wv.ExtensionType.MIRROR, x)
     errs += [_rel_err(bhi, whi), _rel_err(blo, wlo)]
-    # 2D shifted-MAC kernel (convolve2d direct route on TPU)
-    from veles.simd_tpu.ops import convolve2d as cv2
-
-    img = rng.randn(4, 64, 48).astype(np.float32)
-    k2 = rng.randn(5, 7).astype(np.float32)
-    errs.append(_rel_err(cv2.convolve2d(img, k2, algorithm="direct",
-                                        simd=True),
-                         cv2.convolve2d_na(img, k2)))
     # batched direct convolution routes through the C=1 kernel
     # (convolve._use_pallas_direct) on TPU
     from veles.simd_tpu.ops import convolve as cv
@@ -310,6 +303,21 @@ def _check_pallas(rng):
     errs.append(_rel_err(cv.convolve_simd(x, hh, simd=True),
                          cv.convolve_na(x, hh)))
     return max(errs), 5e-4
+
+
+def _check_pallas2d(rng):
+    """The 2D shifted-MAC Mosaic kernel (convolve2d direct route on TPU).
+
+    Kept LAST in the family order: its first-ever hardware execution
+    (2026-07-31 00:59Z window) coincided with the axon relay wedging, so
+    until it has a green hardware run on record it is the prime suspect —
+    last place means a wedge here cannot shadow any other family."""
+    from veles.simd_tpu.ops import convolve2d as cv2
+
+    img = rng.randn(4, 64, 48).astype(np.float32)
+    k2 = rng.randn(5, 7).astype(np.float32)
+    return _rel_err(cv2.convolve2d(img, k2, algorithm="direct", simd=True),
+                    cv2.convolve2d_na(img, k2)), 5e-4
 
 
 def _check_parallel(rng):
@@ -364,13 +372,20 @@ FAMILIES = [
     ("wavelet", _check_wavelet),
     ("normalize", _check_normalize),
     ("detect_peaks", _check_detect_peaks),
-    ("pallas", _check_pallas),
+    ("pallas1d", _check_pallas1d),
     ("parallel", _check_parallel),
+    ("pallas2d", _check_pallas2d),  # wedge suspect: keep last (see check)
 ]
 
 
-def run_smoke(emit=None) -> bool:
-    """Run every family check on the default device; True when all pass."""
+def run_smoke(emit=None, families=None, on_start=None) -> bool:
+    """Run every family check on the default device; True when all pass.
+
+    ``families`` restricts to the named subset (order preserved);
+    ``on_start(name)`` fires before each family begins — bench.py's
+    watchdog uses it to attribute a relay wedge to the family that was
+    in flight when progress stopped.
+    """
     import jax
 
     if emit is None:
@@ -379,6 +394,10 @@ def run_smoke(emit=None) -> bool:
     rng = np.random.RandomState(7)
     all_ok = True
     for name, check in FAMILIES:
+        if families is not None and name not in families:
+            continue
+        if on_start is not None:
+            on_start(name)
         try:
             err, tol = check(rng)
             ok = err <= tol
@@ -398,4 +417,9 @@ if __name__ == "__main__":
 
     maybe_override_platform()
     require_reachable_device()  # fail fast on a wedged relay, don't hang
-    sys.exit(0 if run_smoke() else 1)
+    names = [a.split("=", 1)[1] for a in sys.argv[1:]
+             if a.startswith("--family=")]
+    known = {n for n, _ in FAMILIES}
+    if any(n not in known for n in names):
+        sys.exit(f"unknown --family; known: {sorted(known)}")
+    sys.exit(0 if run_smoke(families=names or None) else 1)

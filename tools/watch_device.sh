@@ -1,0 +1,30 @@
+#!/bin/sh
+# Poll the axon relay and fire the full hardware session the moment a
+# window opens.  Relay windows have been observed to be short (~3 min on
+# 2026-07-31) and rare (hours-long wedges either side), so an unattended
+# trigger beats a human noticing.
+#
+#   sh tools/watch_device.sh [outdir] [interval_s]   # defaults: /tmp/hw_session 480
+#
+# Probes via veles.simd_tpu.utils.platform.probe_device_count (a killable
+# subprocess probe — an in-process jax.devices() on a wedged relay hangs
+# unrecoverably).  One line per probe goes to stdout; on success it execs
+# tools/hw_session.sh and exits with its status.
+set -u
+OUT=${1:-/tmp/hw_session}
+INTERVAL=${2:-480}
+mkdir -p "$OUT"
+OUT=$(cd "$OUT" && pwd)   # absolutize before the repo-root cd below
+cd "$(dirname "$0")/.."
+
+while :; do
+  n=$(timeout 120 python -c "
+from veles.simd_tpu.utils.platform import probe_device_count
+print(probe_device_count(timeout=90.0))" 2>/dev/null || echo 0)
+  echo "$(date -u +%FT%TZ) devices=$n"
+  if [ "${n:-0}" -gt 0 ] 2>/dev/null; then
+    echo "$(date -u +%FT%TZ) window open -> hw_session"
+    exec sh tools/hw_session.sh "$OUT"
+  fi
+  sleep "$INTERVAL"
+done
