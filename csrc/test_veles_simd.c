@@ -8,6 +8,10 @@
 
 #include <math.h>
 #include <stdio.h>
+
+#ifndef M_PI /* strict C99 math.h omits it */
+#define M_PI 3.14159265358979323846
+#endif
 #include <stdlib.h>
 #include <string.h>
 
@@ -390,6 +394,79 @@ static void test_mathfun(void) {
   CHECK_NEAR(res[50], expf(src[50]), 1e-4);
 }
 
+static void test_spectral(void) {
+  /* pure tone at bin 5 of a 64-sample frame: STFT energy concentrates
+   * there (Hann peak = frame/4) */
+  enum { N = 256, FRAME = 64, HOP = 32, BINS = FRAME / 2 + 1 };
+  size_t frames = stft_frame_count(N, FRAME, HOP);
+  CHECK(frames == 1 + (N - FRAME) / HOP);
+  CHECK(stft_frame_count(FRAME - 1, FRAME, HOP) == 0);
+
+  float x[N];
+  for (int i = 0; i < N; i++) {
+    x[i] = cosf(2.f * (float)M_PI * 5.f * (float)i / FRAME);
+  }
+  float *spec = mallocf(frames * BINS * 2);
+  CHECK(stft(1, x, N, FRAME, HOP, NULL, spec) == 0);
+  for (size_t f = 0; f < frames; f++) {
+    const float *re = spec + (f * BINS + 5) * 2;
+    double mag = sqrt((double)re[0] * re[0] + (double)re[1] * re[1]);
+    CHECK_NEAR(mag, FRAME / 4.0, 0.05);
+  }
+  /* XLA-vs-oracle cross-validation */
+  float *spec_na = mallocf(frames * BINS * 2);
+  CHECK(stft(0, x, N, FRAME, HOP, NULL, spec_na) == 0);
+  for (size_t i = 0; i < frames * BINS * 2; i += 7) {
+    CHECK_NEAR(spec[i], spec_na[i], 1e-4);
+  }
+  /* ISTFT round trip: interior samples reconstruct exactly */
+  float rec[N];
+  CHECK(istft(1, spec, N, FRAME, HOP, NULL, rec) == 0);
+  for (int i = FRAME; i < N - FRAME; i++) {
+    CHECK_NEAR(rec[i], x[i], 1e-3);
+  }
+  /* spectrogram = |STFT|^2 */
+  float *pow_ = mallocf(frames * BINS);
+  CHECK(spectrogram(1, x, N, FRAME, HOP, NULL, pow_) == 0);
+  CHECK_NEAR(pow_[5], (double)spec[10] * spec[10] +
+             (double)spec[11] * spec[11], 1e-1);
+  free(spec);
+  free(spec_na);
+  free(pow_);
+
+  /* analytic signal of cos is exp(i w t): envelope == 1 */
+  float analytic[2 * N], env[N];
+  for (int i = 0; i < N; i++) {
+    x[i] = cosf(2.f * (float)M_PI * 20.f * (float)i / N);
+  }
+  CHECK(hilbert(1, x, N, analytic) == 0);
+  CHECK_NEAR(analytic[40], x[20], 1e-4);               /* real part */
+  CHECK_NEAR(analytic[41], sinf(2.f * (float)M_PI * 20.f * 20.f / N),
+             1e-4);                                    /* imag = H[cos] */
+  CHECK(envelope(1, x, N, env) == 0);
+  for (int i = 0; i < N; i += 13) {
+    CHECK_NEAR(env[i], 1.0, 1e-3);
+  }
+
+  /* CWT of the same tone: magnitude at the matched scale dominates a
+   * far-off scale (w0/(2 pi f) with f = 20/N) */
+  double scales[2] = {6.0 * N / (2.0 * M_PI * 20.0), 2.0};
+  float *cwt = mallocf(2 * N * 2);
+  CHECK(morlet_cwt(1, x, N, scales, 2, 6.0, cwt) == 0);
+  double on = 0, off = 0;
+  for (int i = N / 4; i < 3 * N / 4; i++) {
+    on += sqrt((double)cwt[2 * i] * cwt[2 * i] +
+               (double)cwt[2 * i + 1] * cwt[2 * i + 1]);
+    off += sqrt((double)cwt[2 * (N + i)] * cwt[2 * (N + i)] +
+                (double)cwt[2 * (N + i) + 1] * cwt[2 * (N + i) + 1]);
+  }
+  CHECK(on > 10 * off);
+
+  /* contract violation surfaces as an error, not a crash */
+  CHECK(stft(1, x, FRAME - 1, FRAME, HOP, NULL, analytic) != 0);
+  CHECK(strlen(veles_simd_last_error()) > 0);
+}
+
 static void test_normalize(void) {
   uint8_t plane[16] = {0, 255, 128, 64, 1, 2, 3, 4,
                        5, 6, 7, 8, 9, 10, 11, 12};
@@ -608,6 +685,7 @@ int main(void) {
   test_convolve();
   test_wavelet();
   test_mathfun();
+  test_spectral();
   test_normalize();
   test_detect_peaks();
   test_conversions();

@@ -581,6 +581,55 @@ int exp_psv(int simd, const float *src, size_t length, float *res) {
   return psv("exp", simd, src, length, res);
 }
 
+/* ---- spectral --------------------------------------------------------- */
+
+size_t stft_frame_count(size_t length, size_t frame_length, size_t hop) {
+  if (frame_length == 0 || hop == 0 || length < frame_length) {
+    return 0;
+  }
+  return 1 + (length - frame_length) / hop;
+}
+
+int stft(int simd, const float *x, size_t length, size_t frame_length,
+         size_t hop, const float *window, float *spec) {
+  return shim_run("stft", "(iKkkkKK)", simd, PTR(x), (unsigned long)length,
+                  (unsigned long)frame_length, (unsigned long)hop,
+                  PTR(window), PTR(spec));
+}
+
+int istft(int simd, const float *spec, size_t length, size_t frame_length,
+          size_t hop, const float *window, float *result) {
+  return shim_run("istft", "(iKkkkKK)", simd, PTR(spec),
+                  (unsigned long)length, (unsigned long)frame_length,
+                  (unsigned long)hop, PTR(window), PTR(result));
+}
+
+int spectrogram(int simd, const float *x, size_t length,
+                size_t frame_length, size_t hop, const float *window,
+                float *power) {
+  return shim_run("spectrogram", "(iKkkkKK)", simd, PTR(x),
+                  (unsigned long)length, (unsigned long)frame_length,
+                  (unsigned long)hop, PTR(window), PTR(power));
+}
+
+int hilbert(int simd, const float *x, size_t length, float *analytic) {
+  return shim_run("hilbert", "(iKkK)", simd, PTR(x), (unsigned long)length,
+                  PTR(analytic));
+}
+
+int envelope(int simd, const float *x, size_t length, float *env) {
+  return shim_run("envelope", "(iKkK)", simd, PTR(x), (unsigned long)length,
+                  PTR(env));
+}
+
+int morlet_cwt(int simd, const float *x, size_t length,
+               const double *scales, size_t n_scales, double w0,
+               float *result) {
+  return shim_run("morlet_cwt", "(iKkKkdK)", simd, PTR(x),
+                  (unsigned long)length, PTR(scales),
+                  (unsigned long)n_scales, w0, PTR(result));
+}
+
 /* ---- normalize -------------------------------------------------------- */
 
 int normalize2D(int simd, const uint8_t *src, size_t src_stride,

@@ -247,6 +247,35 @@ int cos_psv(int simd, const float *src, size_t length, float *res);
 int log_psv(int simd, const float *src, size_t length, float *res);
 int exp_psv(int simd, const float *src, size_t length, float *res);
 
+/* ---- spectral — no reference analog (time-frequency analysis over the
+ * same batched-FFT machinery as the convolve FFT path).  Complex outputs
+ * are interleaved (re, im) float pairs, row-major. ----------------------- */
+
+/* Frames a length-`length` signal yields: 0 when length < frame_length,
+ * else 1 + (length - frame_length) / hop (no padding).  Pure C. */
+size_t stft_frame_count(size_t length, size_t frame_length, size_t hop);
+/* window: frame_length floats, or NULL for the periodic Hann window.
+ * spec must hold frames * (frame_length/2 + 1) * 2 floats. */
+int stft(int simd, const float *x, size_t length, size_t frame_length,
+         size_t hop, const float *window, float *spec);
+/* Windowed overlap-add inverse with COLA normalization; `length` is the
+ * output signal length the STFT was taken over.  result: length floats. */
+int istft(int simd, const float *spec, size_t length, size_t frame_length,
+          size_t hop, const float *window, float *result);
+/* |STFT|^2: power must hold frames * (frame_length/2 + 1) floats. */
+int spectrogram(int simd, const float *x, size_t length,
+                size_t frame_length, size_t hop, const float *window,
+                float *power);
+/* Analytic signal x + i*H[x]: analytic holds length * 2 floats. */
+int hilbert(int simd, const float *x, size_t length, float *analytic);
+/* Instantaneous amplitude |analytic(x)|: env holds length floats. */
+int envelope(int simd, const float *x, size_t length, float *env);
+/* Morlet continuous wavelet transform (center frequency w0, scales in
+ * samples): result holds n_scales * length * 2 floats. */
+int morlet_cwt(int simd, const float *x, size_t length,
+               const double *scales, size_t n_scales, double w0,
+               float *result);
+
 /* ---- normalize (inc/simd/normalize.h:48-90) --------------------------- */
 
 int normalize2D(int simd, const uint8_t *src, size_t src_stride,

@@ -24,8 +24,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 
 
 def _rel_err(got, want):
-    got = np.asarray(got, np.float64)
-    want = np.asarray(want, np.float64)
+    # complex128 keeps imaginary parts intact (spectral family); for real
+    # data it is equivalent to the float64 comparison
+    got = np.asarray(got, np.complex128)
+    want = np.asarray(want, np.complex128)
     scale = np.max(np.abs(want)) or 1.0
     return float(np.max(np.abs(got - want)) / scale)
 
@@ -236,6 +238,23 @@ def _check_wavelet(rng):
     return max(errs), 5e-4  # tests/wavelet.cc:84-86 epsilon
 
 
+def _check_spectral(rng):
+    """STFT round trip + Hilbert + CWT vs their float64 oracles."""
+    from veles.simd_tpu.ops import spectral as sp
+
+    x = rng.randn(4, 2048).astype(np.float32)
+    errs = [_rel_err(sp.stft(x, 256, 64, simd=True),
+                     sp.stft_na(x, 256, 64))]
+    spec = sp.stft(x, 256, 64, simd=True)
+    rec = np.asarray(sp.istft(spec, 2048, 256, 64, simd=True))
+    errs.append(_rel_err(rec[:, 256:-256], x[:, 256:-256]))
+    errs.append(_rel_err(sp.hilbert(x, simd=True), sp.hilbert_na(x)))
+    errs.append(_rel_err(
+        sp.morlet_cwt(x, [4.0, 16.0, 64.0], simd=True),
+        sp.morlet_cwt_na(x, [4.0, 16.0, 64.0])))
+    return max(errs), 1e-4
+
+
 def _check_normalize(rng):
     from veles.simd_tpu.ops import normalize as nz
 
@@ -370,6 +389,7 @@ FAMILIES = [
     ("correlate", _check_correlate),
     ("synthesis", _check_synthesis),
     ("wavelet", _check_wavelet),
+    ("spectral", _check_spectral),
     ("normalize", _check_normalize),
     ("detect_peaks", _check_detect_peaks),
     ("pallas1d", _check_pallas1d),

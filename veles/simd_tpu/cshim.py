@@ -34,6 +34,7 @@ from veles.simd_tpu.ops import detect_peaks as _dp
 from veles.simd_tpu.ops import mathfun as _mf
 from veles.simd_tpu.ops import matrix as _mx
 from veles.simd_tpu.ops import normalize as _nz
+from veles.simd_tpu.ops import spectral as _sp
 from veles.simd_tpu.ops import wavelet as _wv
 from veles.simd_tpu.ops.wavelet_coeffs import WaveletType as _WT
 
@@ -291,6 +292,67 @@ def mathfun(name, simd, src, length, res):
           "exp": _mf.exp_psv}[name]
     _f32(res, length)[...] = np.asarray(fn(_f32(src, length),
                                            simd=bool(simd)))
+    return 0
+
+
+# ---- spectral -------------------------------------------------------------
+
+def _cplx_out(ptr, out, *shape):
+    """Write a complex result into an interleaved (re, im) f32 buffer."""
+    out = np.ascontiguousarray(np.asarray(out, np.complex64))
+    _f32(ptr, *shape, 2)[...] = out.view(np.float32).reshape(*shape, 2)
+
+
+def _window_arg(ptr, frame_length):
+    return None if int(ptr) == 0 else _f32(ptr, frame_length)
+
+
+def stft(simd, x, length, frame_length, hop, window, spec):
+    out = _sp.stft(_f32(x, length), int(frame_length), int(hop),
+                   window=_window_arg(window, frame_length),
+                   simd=bool(simd))
+    frames = _sp.frame_count(int(length), int(frame_length), int(hop))
+    _cplx_out(spec, out, frames, int(frame_length) // 2 + 1)
+    return 0
+
+
+def istft(simd, spec, length, frame_length, hop, window, result):
+    frames = _sp.frame_count(int(length), int(frame_length), int(hop))
+    bins = int(frame_length) // 2 + 1
+    spec_c = _f32(spec, frames, bins, 2).view(np.complex64)[..., 0]
+    out = _sp.istft(spec_c, int(length), int(frame_length), int(hop),
+                    window=_window_arg(window, frame_length),
+                    simd=bool(simd))
+    _f32(result, length)[...] = np.asarray(out)
+    return 0
+
+
+def spectrogram(simd, x, length, frame_length, hop, window, power):
+    out = _sp.spectrogram(_f32(x, length), int(frame_length), int(hop),
+                          window=_window_arg(window, frame_length),
+                          simd=bool(simd))
+    frames = _sp.frame_count(int(length), int(frame_length), int(hop))
+    _f32(power, frames, int(frame_length) // 2 + 1)[...] = np.asarray(out)
+    return 0
+
+
+def hilbert(simd, x, length, analytic):
+    out = _sp.hilbert(_f32(x, length), simd=bool(simd))
+    _cplx_out(analytic, out, int(length))
+    return 0
+
+
+def envelope(simd, x, length, env):
+    _f32(env, length)[...] = np.asarray(
+        _sp.envelope(_f32(x, length), simd=bool(simd)))
+    return 0
+
+
+def morlet_cwt(simd, x, length, scales, n_scales, w0, result):
+    sc = _arr(scales, (n_scales,), ctypes.c_double)
+    out = _sp.morlet_cwt(_f32(x, length), sc, w0=float(w0),
+                         simd=bool(simd))
+    _cplx_out(result, out, int(n_scales), int(length))
     return 0
 
 

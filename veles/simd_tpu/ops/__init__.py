@@ -11,6 +11,8 @@ Each module mirrors one reference header (SURVEY.md §2):
 * :mod:`.wavelet`      — 1D DWT / stationary SWT filter banks
 * :mod:`.wavelet_coeffs` — generated Daubechies / Symlet / Coiflet tables
 * :mod:`.normalize`    — 1D/2D min-max normalization
+* :mod:`.spectral`     — STFT/ISTFT, spectrogram, Hilbert envelope,
+  Morlet CWT (beyond-reference: batched-FFT time-frequency analysis)
 * :mod:`.detect_peaks` — 1D local-extrema detection
 
 Every public op takes the reference-compatible ``simd=`` flag: truthy (the
