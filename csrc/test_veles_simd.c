@@ -467,6 +467,42 @@ static void test_spectral(void) {
   CHECK(strlen(veles_simd_last_error()) > 0);
 }
 
+static void test_resample(void) {
+  enum { N = 400 };
+  CHECK(resample_length(100, 2, 1) == 200);
+  CHECK(resample_length(100, 1, 3) == 34);
+  CHECK(resample_length(147, 160, 147) == 160);
+
+  /* upsampling a slow tone reproduces the dense samples */
+  float x[N];
+  for (int i = 0; i < N; i++) {
+    x[i] = cosf(2.f * (float)M_PI * 7.f * (float)i / N);
+  }
+  size_t out_len = resample_length(N, 2, 1);
+  float *y = mallocf(out_len);
+  CHECK(resample_poly(1, x, N, 2, 1, NULL, 0, y) == 0);
+  for (int i = 80; i < (int)out_len - 80; i += 11) {
+    CHECK_NEAR(y[i], cos(2.0 * M_PI * 7.0 * (i / 2.0) / N), 5e-3);
+  }
+  /* XLA-vs-oracle */
+  float *y_na = mallocf(out_len);
+  CHECK(resample_poly(0, x, N, 2, 1, NULL, 0, y_na) == 0);
+  for (size_t i = 0; i < out_len; i += 13) {
+    CHECK_NEAR(y[i], y_na[i], 1e-4);
+  }
+  free(y);
+  free(y_na);
+
+  /* Fourier resampling of a bandlimited periodic tone is exact */
+  float z[2 * N];
+  CHECK(resample_fourier(1, x, N, 2 * N, z) == 0);
+  for (int i = 0; i < 2 * N; i += 17) {
+    CHECK_NEAR(z[i], cos(2.0 * M_PI * 7.0 * (i / 2.0) / N), 1e-4);
+  }
+  /* error surfaces for bad rates */
+  CHECK(resample_poly(1, x, N, 0, 1, NULL, 0, z) != 0);
+}
+
 static void test_normalize(void) {
   uint8_t plane[16] = {0, 255, 128, 64, 1, 2, 3, 4,
                        5, 6, 7, 8, 9, 10, 11, 12};
@@ -686,6 +722,7 @@ int main(void) {
   test_wavelet();
   test_mathfun();
   test_spectral();
+  test_resample();
   test_normalize();
   test_detect_peaks();
   test_conversions();

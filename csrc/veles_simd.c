@@ -630,6 +630,30 @@ int morlet_cwt(int simd, const float *x, size_t length,
                   (unsigned long)n_scales, w0, PTR(result));
 }
 
+/* ---- resample --------------------------------------------------------- */
+
+size_t resample_length(size_t length, size_t up, size_t down) {
+  if (up == 0 || down == 0) {
+    return 0;
+  }
+  return (length * up + down - 1) / down;
+}
+
+int resample_poly(int simd, const float *x, size_t length, size_t up,
+                  size_t down, const float *taps, size_t num_taps,
+                  float *result) {
+  return shim_run("resample_poly", "(iKkkkKkK)", simd, PTR(x),
+                  (unsigned long)length, (unsigned long)up,
+                  (unsigned long)down, PTR(taps), (unsigned long)num_taps,
+                  PTR(result));
+}
+
+int resample_fourier(int simd, const float *x, size_t length, size_t num,
+                     float *result) {
+  return shim_run("resample_fourier", "(iKkkK)", simd, PTR(x),
+                  (unsigned long)length, (unsigned long)num, PTR(result));
+}
+
 /* ---- normalize -------------------------------------------------------- */
 
 int normalize2D(int simd, const uint8_t *src, size_t src_stride,

@@ -276,6 +276,23 @@ int morlet_cwt(int simd, const float *x, size_t length,
                const double *scales, size_t n_scales, double w0,
                float *result);
 
+/* ---- resample — no reference analog (rate conversion over the same
+ * conv machinery as src/convolve.c; the polyphase cascade runs as one
+ * dilated/strided XLA conv). ------------------------------------------- */
+
+/* Output length of resample_poly: ceil(length * up / down).  Pure C. */
+size_t resample_length(size_t length, size_t up, size_t down);
+/* Rational-rate polyphase resampling.  taps: odd-length anti-aliasing
+ * FIR with DC gain `up`, or NULL (num_taps ignored) for the default
+ * windowed-sinc design.  result: resample_length(...) floats. */
+int resample_poly(int simd, const float *x, size_t length, size_t up,
+                  size_t down, const float *taps, size_t num_taps,
+                  float *result);
+/* Fourier-domain resampling to exactly `num` samples (periodic
+ * assumption).  result: num floats. */
+int resample_fourier(int simd, const float *x, size_t length, size_t num,
+                     float *result);
+
 /* ---- normalize (inc/simd/normalize.h:48-90) --------------------------- */
 
 int normalize2D(int simd, const uint8_t *src, size_t src_stride,

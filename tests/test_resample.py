@@ -1,0 +1,168 @@
+"""Resample family: polyphase rational resampling + Fourier method.
+
+Patterns per SURVEY.md §4: XLA-vs-oracle cross-validation (the XLA path
+is a dilated conv, the oracle an explicit zero-stuff + convolve — two
+genuinely different algorithms), analytic goldens, sweeps, contracts.
+"""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu.ops import resample as rs
+
+RNG = np.random.RandomState(23)
+
+
+def _rel(got, want):
+    got, want = np.asarray(got, np.float64), np.asarray(want, np.float64)
+    scale = np.max(np.abs(want)) or 1.0
+    return np.max(np.abs(got - want)) / scale
+
+
+# ---------------------------------------------------------------- oracle
+
+
+@pytest.mark.parametrize("up,down", [
+    (1, 2), (2, 1), (3, 2), (2, 3), (4, 1), (1, 4), (5, 3), (160, 147),
+])
+def test_poly_vs_oracle(up, down):
+    x = RNG.randn(730).astype(np.float32)
+    got = np.asarray(rs.resample_poly(x, up, down, simd=True))
+    want = rs.resample_poly_na(x, up, down)
+    assert got.shape == want.shape
+    assert got.shape[-1] == rs.resample_length(730, up, down)
+    assert _rel(got, want) < 1e-4
+
+
+def test_poly_batched():
+    x = RNG.randn(3, 4, 256).astype(np.float32)
+    got = np.asarray(rs.resample_poly(x, 3, 4, simd=True))
+    want = rs.resample_poly_na(x, 3, 4)
+    assert got.shape == want.shape == (3, 4, 192)
+    assert _rel(got, want) < 1e-4
+
+
+@pytest.mark.parametrize("num", [100, 128, 333, 512, 1024])
+def test_fourier_vs_oracle(num):
+    x = RNG.randn(2, 512).astype(np.float32)
+    got = np.asarray(rs.resample_fourier(x, num, simd=True))
+    want = rs.resample_fourier_na(x, num)
+    assert got.shape == want.shape == (2, num)
+    assert _rel(got, want) < 1e-4
+
+
+# ---------------------------------------------------------------- golden
+
+
+def test_dc_gain():
+    """Resampling a constant stays that constant (interior)."""
+    x = np.full(400, 3.5, np.float32)
+    for up, down in ((2, 1), (1, 2), (3, 2)):
+        y = np.asarray(rs.resample_poly(x, up, down, simd=True))
+        core = y[40:-40]
+        # ~1.2e-3 ripple is the windowed-sinc polyphase-branch imbalance
+        # (same order as scipy.signal.resample_poly's default filter)
+        np.testing.assert_allclose(core, 3.5, rtol=3e-3)
+
+
+def test_tone_upsample_golden():
+    """Upsampling a bandlimited tone reproduces the dense samples."""
+    n, up = 512, 4
+    f = 11 / n  # cycles per (input) sample, far below Nyquist
+    t_in = np.arange(n)
+    x = np.cos(2 * np.pi * f * t_in).astype(np.float32)
+    y = np.asarray(rs.upsample(x, up, simd=True))
+    t_out = np.arange(n * up) / up
+    want = np.cos(2 * np.pi * f * t_out)
+    sl = slice(20 * up, -20 * up)  # skip filter edge transients
+    np.testing.assert_allclose(y[sl], want[sl], atol=5e-3)
+
+
+def test_tone_decimate_golden():
+    """Anti-aliased decimation of a slow tone keeps the tone."""
+    n, down = 2048, 4
+    f = 5 / n
+    x = np.cos(2 * np.pi * f * np.arange(n)).astype(np.float32)
+    y = np.asarray(rs.decimate(x, down, simd=True))
+    want = np.cos(2 * np.pi * f * down * np.arange(n // down))
+    sl = slice(40, -40)
+    np.testing.assert_allclose(y[sl], want[sl], atol=5e-3)
+
+
+def test_fourier_bandlimited_exact():
+    """Fourier upsampling of a bandlimited periodic signal is exact."""
+    n, num = 256, 1024
+    t = np.arange(n)
+    x = (np.cos(2 * np.pi * 7 * t / n)
+         + 0.3 * np.sin(2 * np.pi * 19 * t / n)).astype(np.float32)
+    y = np.asarray(rs.resample_fourier(x, num, simd=True))
+    tt = np.arange(num) * n / num
+    want = np.cos(2 * np.pi * 7 * tt / n) + 0.3 * np.sin(2 * np.pi * 19
+                                                         * tt / n)
+    np.testing.assert_allclose(y, want, atol=1e-4)
+
+
+def test_fourier_downsample_inverts_upsample():
+    x = RNG.randn(256).astype(np.float32)
+    up = np.asarray(rs.resample_fourier(x, 1024, simd=True))
+    back = np.asarray(rs.resample_fourier(up, 256, simd=True))
+    np.testing.assert_allclose(back, x, atol=1e-4)
+
+
+def test_gcd_reduction():
+    """up/down reduce by their gcd: 4/2 == 2/1."""
+    x = RNG.randn(300).astype(np.float32)
+    a = np.asarray(rs.resample_poly(x, 4, 2, simd=True))
+    b = np.asarray(rs.resample_poly(x, 2, 1, simd=True))
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_identity():
+    x = RNG.randn(100).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(rs.resample_poly(x, 3, 3, simd=True)), x, atol=0)
+
+
+# ------------------------------------------------------------ filter/api
+
+
+def test_design_lowpass_response():
+    """Windowed-sinc: unit DC gain, strong stopband rejection."""
+    h = rs.design_lowpass(161, 0.25)
+    w = np.fft.rfftfreq(4096) * 2  # in Nyquist units
+    H = np.abs(np.fft.rfft(h, 4096))
+    assert abs(H[0] - 1.0) < 1e-12
+    passband = H[w < 0.15]
+    stopband = H[w > 0.35]
+    assert passband.min() > 0.99
+    assert stopband.max() < 1e-3
+
+
+def test_custom_taps():
+    x = RNG.randn(200).astype(np.float32)
+    taps = 2 * rs.design_lowpass(31, 0.5)
+    got = np.asarray(rs.resample_poly(x, 2, 1, taps=taps, simd=True))
+    want = rs.resample_poly_na(x, 2, 1, taps=taps)
+    assert _rel(got, want) < 1e-4
+
+
+def test_contract_violations():
+    x = np.zeros(64, np.float32)
+    with pytest.raises(ValueError):
+        rs.resample_poly(x, 0, 1)
+    with pytest.raises(ValueError):
+        rs.resample_poly(x, 2, 1, taps=np.ones(4))  # even-length taps
+    with pytest.raises(ValueError):
+        rs.resample_fourier(x, 0)
+    with pytest.raises(ValueError):
+        rs.design_lowpass(0, 0.5)
+    with pytest.raises(ValueError):
+        rs.design_lowpass(11, 1.5)
+    with pytest.raises(ValueError):
+        rs.resample_poly(np.zeros(0, np.float32), 2, 1)
+
+
+def test_resample_length():
+    assert rs.resample_length(100, 2, 1) == 200
+    assert rs.resample_length(100, 1, 3) == 34   # ceil
+    assert rs.resample_length(147, 160, 147) == 160

@@ -255,6 +255,22 @@ def _check_spectral(rng):
     return max(errs), 1e-4
 
 
+def _check_resample(rng):
+    """Polyphase (dilated conv) + Fourier resampling vs their oracles."""
+    from veles.simd_tpu.ops import resample as rs
+
+    x = rng.randn(4, 730).astype(np.float32)
+    errs = []
+    for up, down in ((2, 1), (1, 2), (3, 2), (160, 147)):
+        errs.append(_rel_err(rs.resample_poly(x, up, down, simd=True),
+                             rs.resample_poly_na(x, up, down)))
+    errs.append(_rel_err(rs.resample_fourier(x, 333, simd=True),
+                         rs.resample_fourier_na(x, 333)))
+    errs.append(_rel_err(rs.resample_fourier(x, 1460, simd=True),
+                         rs.resample_fourier_na(x, 1460)))
+    return max(errs), 1e-4
+
+
 def _check_normalize(rng):
     from veles.simd_tpu.ops import normalize as nz
 
@@ -390,6 +406,7 @@ FAMILIES = [
     ("synthesis", _check_synthesis),
     ("wavelet", _check_wavelet),
     ("spectral", _check_spectral),
+    ("resample", _check_resample),
     ("normalize", _check_normalize),
     ("detect_peaks", _check_detect_peaks),
     ("pallas1d", _check_pallas1d),

@@ -34,6 +34,7 @@ from veles.simd_tpu.ops import detect_peaks as _dp
 from veles.simd_tpu.ops import mathfun as _mf
 from veles.simd_tpu.ops import matrix as _mx
 from veles.simd_tpu.ops import normalize as _nz
+from veles.simd_tpu.ops import resample as _rs
 from veles.simd_tpu.ops import spectral as _sp
 from veles.simd_tpu.ops import wavelet as _wv
 from veles.simd_tpu.ops.wavelet_coeffs import WaveletType as _WT
@@ -353,6 +354,23 @@ def morlet_cwt(simd, x, length, scales, n_scales, w0, result):
     out = _sp.morlet_cwt(_f32(x, length), sc, w0=float(w0),
                          simd=bool(simd))
     _cplx_out(result, out, int(n_scales), int(length))
+    return 0
+
+
+# ---- resample -------------------------------------------------------------
+
+def resample_poly(simd, x, length, up, down, taps, num_taps, result):
+    t = None if int(taps) == 0 else _f32(taps, num_taps)
+    out = _rs.resample_poly(_f32(x, length), int(up), int(down), taps=t,
+                            simd=bool(simd))
+    _f32(result, _rs.resample_length(int(length), int(up),
+                                     int(down)))[...] = np.asarray(out)
+    return 0
+
+
+def resample_fourier(simd, x, length, num, result):
+    out = _rs.resample_fourier(_f32(x, length), int(num), simd=bool(simd))
+    _f32(result, num)[...] = np.asarray(out)
     return 0
 
 

@@ -13,6 +13,8 @@ Each module mirrors one reference header (SURVEY.md §2):
 * :mod:`.normalize`    — 1D/2D min-max normalization
 * :mod:`.spectral`     — STFT/ISTFT, spectrogram, Hilbert envelope,
   Morlet CWT (beyond-reference: batched-FFT time-frequency analysis)
+* :mod:`.resample`     — polyphase rational-rate conversion as one
+  dilated/strided conv + Fourier resampling (beyond-reference)
 * :mod:`.detect_peaks` — 1D local-extrema detection
 
 Every public op takes the reference-compatible ``simd=`` flag: truthy (the
