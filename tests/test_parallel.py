@@ -558,3 +558,81 @@ class TestShardedDWTAnalysis:
         rec = par.sharded_wavelet_inverse_transform("daub", 8, coeffs,
                                                     mesh, axis="sp")
         np.testing.assert_allclose(np.asarray(rec), x, atol=5e-4)
+
+
+class TestShardedSTFT:
+    """Sequence-parallel STFT/ISTFT vs the single-chip spectral ops."""
+
+    def test_matches_single_chip(self):
+        from veles.simd_tpu.ops import spectral as sp
+
+        mesh = par.make_mesh({"sp": 8})
+        rng = np.random.RandomState(58)
+        n, fl, hop = 4096, 256, 64
+        x = rng.randn(n).astype(np.float32)
+        got = np.asarray(par.sharded_stft(x, fl, hop, mesh))
+        want = np.asarray(sp.stft(x, fl, hop, simd=True))
+        assert got.shape == want.shape == (sp.frame_count(n, fl, hop),
+                                           fl // 2 + 1)
+        np.testing.assert_allclose(got, want, atol=1e-3)
+
+    def test_round_trip(self):
+        mesh = par.make_mesh({"sp": 8})
+        rng = np.random.RandomState(59)
+        n, fl, hop = 2048, 128, 32
+        x = rng.randn(n).astype(np.float32)
+        spec = par.sharded_stft(x, fl, hop, mesh)
+        rec = np.asarray(par.sharded_istft(spec, n, fl, hop, mesh))
+        # interior exact; boundary frames normalized by partial envelope
+        np.testing.assert_allclose(rec[fl:n - fl], x[fl:n - fl], atol=1e-3)
+
+    def test_istft_matches_single_chip(self):
+        from veles.simd_tpu.ops import spectral as sp
+
+        mesh = par.make_mesh({"dp": 2, "sp": 4})
+        rng = np.random.RandomState(60)
+        n, fl, hop = 1024, 128, 64
+        x = rng.randn(n).astype(np.float32)
+        spec = np.asarray(sp.stft(x, fl, hop, simd=True))
+        got = np.asarray(par.sharded_istft(spec, n, fl, hop, mesh,
+                                           axis="sp"))
+        want = np.asarray(sp.istft(spec, n, fl, hop, simd=True))
+        np.testing.assert_allclose(got, want, atol=1e-3)
+
+    def test_hop_equals_frame_length(self):
+        """Zero overlap: the halo path degenerates to empty exchanges."""
+        from veles.simd_tpu.ops import spectral as sp
+
+        mesh = par.make_mesh({"dp": 2, "sp": 4})
+        rng = np.random.RandomState(61)
+        n, fl = 1024, 64
+        x = rng.randn(n).astype(np.float32)
+        got = np.asarray(par.sharded_stft(x, fl, fl, mesh))
+        want = np.asarray(sp.stft(x, fl, fl, simd=True))
+        np.testing.assert_allclose(got, want, atol=1e-3)
+        rec = np.asarray(par.sharded_istft(got, n, fl, fl, mesh))
+        wrec = np.asarray(sp.istft(want, n, fl, fl, simd=True))
+        np.testing.assert_allclose(rec, wrec, atol=1e-3)
+
+    def test_batched(self):
+        from veles.simd_tpu.ops import spectral as sp
+
+        mesh = par.make_mesh({"sp": 8})
+        rng = np.random.RandomState(62)
+        xb = rng.randn(3, 2048).astype(np.float32)
+        got = np.asarray(par.sharded_stft(xb, 128, 32, mesh))
+        want = np.asarray(sp.stft(xb, 128, 32, simd=True))
+        np.testing.assert_allclose(got, want, atol=1e-3)
+
+    def test_contracts(self):
+        mesh = par.make_mesh({"sp": 8})
+        x = np.zeros(4096, np.float32)
+        with pytest.raises(ValueError, match="divisible"):
+            par.sharded_stft(np.zeros(4095, np.float32), 256, 64, mesh)
+        with pytest.raises(ValueError, match="hop"):
+            par.sharded_stft(x, 256, 96, mesh)  # 512 % 96 != 0
+        with pytest.raises(ValueError, match="overlap"):
+            par.sharded_stft(x, 1024, 64, mesh)  # halo 960 > block 512
+        with pytest.raises(ValueError, match="inconsistent"):
+            par.sharded_istft(np.zeros((3, 129), np.complex64), 4096,
+                              256, 64, mesh)

@@ -392,6 +392,21 @@ def _check_parallel(rng):
     mesh2d = make_mesh({"dp": 1, "sp": -1})   # works on any device count
     errs.append(_rel_err(sharded_convolve2d_ring(img, k2, mesh2d),
                          cv2.convolve2d_na(img, k2)))
+    # sequence-parallel STFT round trip (frame-halo ppermute + adjoint);
+    # sized from the device count so halo <= block on any sp mesh
+    from veles.simd_tpu.ops import spectral as sp
+    from veles.simd_tpu.parallel import sharded_istft, sharded_stft
+
+    fl, hop = 128, 32
+    # a multiple of n_dev * fl near 2048: block = k * fl, so block is
+    # always a hop multiple and >= fl > halo on any device count
+    ns = n_dev * fl * max(1, 2048 // (n_dev * fl))
+    xs = rng.randn(ns).astype(np.float32)
+    spec = sharded_stft(xs, fl, hop, default_mesh("sp"), axis="sp")
+    errs.append(_rel_err(spec, sp.stft_na(xs, fl, hop)))
+    rec = sharded_istft(spec, ns, fl, hop, default_mesh("sp"), axis="sp")
+    errs.append(_rel_err(np.asarray(rec)[fl:-fl],
+                         np.asarray(xs, np.float64)[fl:-fl]))
     return max(errs), 1e-4
 
 

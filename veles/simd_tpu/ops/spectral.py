@@ -128,6 +128,14 @@ def _ola_envelope(n, frame_length, hop, window):
     return env
 
 
+def _env_inv(n, frame_length, hop, window):
+    """Pseudo-inverse of the COLA envelope (float64): zero where the
+    window overlap vanishes, 1/env elsewhere.  The single definition the
+    device ISTFT, the oracle, and the sharded ISTFT all share."""
+    env = _ola_envelope(n, frame_length, hop, window)
+    return np.where(env > 1e-8, 1.0 / np.maximum(env, 1e-8), 0.0)
+
+
 @functools.partial(jax.jit, static_argnames=("n", "frame_length", "hop"))
 def _istft_xla(spec, window, env_inv, n, frame_length, hop):
     frames = jnp.fft.irfft(spec, frame_length, axis=-1) * window
@@ -152,9 +160,7 @@ def istft(spec, n: int, frame_length: int, hop: int, window=None,
     if window is None:
         window = hann_window(frame_length)
     window = np.asarray(window, np.float32)
-    env = _ola_envelope(n, frame_length, hop, window)
-    env_inv = np.where(env > 1e-8, 1.0 / np.maximum(env, 1e-8),
-                       0.0).astype(np.float32)
+    env_inv = _env_inv(n, frame_length, hop, window).astype(np.float32)
     frames = frame_count(n, frame_length, hop)
     spec_np = spec if hasattr(spec, "shape") else np.asarray(spec)
     if spec_np.shape[-2:] != (frames, frame_length // 2 + 1):
@@ -182,8 +188,7 @@ def istft_na(spec, n: int, frame_length: int, hop: int, window=None):
     # np.add.at over the leading batch dims one frame-row at a time
     for f in range(idx.shape[0]):
         out[..., idx[f]] += frames[..., f, :]
-    env = _ola_envelope(n, frame_length, hop, window)
-    return out * np.where(env > 1e-8, 1.0 / np.maximum(env, 1e-8), 0.0)
+    return out * _env_inv(n, frame_length, hop, window)
 
 
 def spectrogram(x, frame_length: int, hop: int, window=None, simd=None):

@@ -36,6 +36,12 @@ scale across ICI — XLA collectives instead of any message-passing runtime.
   an ``all_to_all`` transpose re-shards to columns, columns transform
   locally.  Every pass sees complete rows/columns, so all four boundary
   extensions are exact.
+* :func:`sharded_stft` / :func:`sharded_istft` — sequence-parallel
+  **time-frequency analysis**: frame ownership follows sample ownership
+  (one right-halo ``ppermute`` of the ``frame_length - hop`` overlap),
+  so a long-signal spectrogram pipeline never gathers the signal; the
+  inverse overlap-adds locally and ships each shard's overhang to its
+  right neighbour.
 * :func:`sharded_matmul` — **tensor-parallel** GEMM: contracting dimension
   sharded (zero-padded to the axis size), partials combined with ``psum``
   over ICI.
@@ -57,7 +63,8 @@ from veles.simd_tpu.parallel.mesh import default_mesh, make_mesh
 from veles.simd_tpu.parallel.ops import (
     data_parallel, halo_exchange_left, halo_exchange_right,
     sharded_convolve, sharded_convolve2d, sharded_convolve2d_ring,
-    sharded_convolve_batch, sharded_convolve_ring, sharded_matmul,
+    sharded_convolve_batch, sharded_convolve_ring, sharded_istft,
+    sharded_matmul, sharded_stft,
     sharded_swt, sharded_swt_reconstruct, sharded_wavelet_apply,
     sharded_wavelet_apply2d, sharded_wavelet_inverse_transform,
     sharded_wavelet_reconstruct, sharded_wavelet_reconstruct2d,
@@ -73,5 +80,6 @@ __all__ = ["make_mesh", "default_mesh", "sharded_convolve",
            "sharded_wavelet_reconstruct",
            "sharded_wavelet_apply2d",
            "sharded_wavelet_reconstruct2d", "sharded_matmul",
+           "sharded_stft", "sharded_istft",
            "data_parallel", "halo_exchange_left", "halo_exchange_right",
            "distributed"]

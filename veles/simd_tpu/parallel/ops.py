@@ -29,7 +29,8 @@ __all__ = ["sharded_convolve", "sharded_convolve_ring",
            "sharded_wavelet_inverse_transform",
            "sharded_wavelet_reconstruct",
            "sharded_wavelet_apply2d",
-           "sharded_wavelet_reconstruct2d", "data_parallel",
+           "sharded_wavelet_reconstruct2d",
+           "sharded_stft", "sharded_istft", "data_parallel",
            "halo_exchange_left", "halo_exchange_right"]
 
 
@@ -853,6 +854,133 @@ def sharded_matmul(a, b, mesh: Mesh, axis: str = "tp"):
         return jax.lax.psum(partial, axis)
 
     return _run(a, b)
+
+
+def _check_stft_sharding(n, frame_length, hop, n_shards):
+    from veles.simd_tpu.ops import spectral as sp
+
+    sp._check_stft_args(n, frame_length, hop)
+    if n % n_shards:
+        raise ValueError(f"signal length {n} not divisible into "
+                         f"{n_shards} shards (pad first)")
+    block = n // n_shards
+    if block % hop:
+        raise ValueError(
+            f"per-shard block {block} not a multiple of hop {hop} — "
+            "frame starts would straddle shard ownership; choose a hop "
+            "that divides the block (or fewer shards)")
+    halo = frame_length - hop
+    if halo > block:
+        raise ValueError(
+            f"frame overlap {halo} (frame_length - hop) exceeds the "
+            f"per-shard block {block}; fewer shards or a larger hop")
+    return block, halo
+
+
+def sharded_stft(x, frame_length: int, hop: int, mesh: Mesh,
+                 axis: str = "sp", window=None):
+    """Sequence-parallel STFT: the signal sharded along time, one
+    ``ppermute`` right-halo of ``frame_length - hop`` samples per shard.
+
+    Frame ownership follows sample ownership: a frame belongs to the
+    shard its start sample lives on (``block % hop == 0`` keeps that
+    uniform at ``block // hop`` frames per shard), so the output's frame
+    axis comes back sharded over the SAME mesh axis — a long-signal
+    spectrogram pipeline never gathers the signal.  Matches the
+    single-chip :func:`veles.simd_tpu.ops.spectral.stft` exactly: the
+    per-shard frame count includes up to ``(frame_length - hop) / hop``
+    trailing frames that overhang the global signal end (computed
+    against the zero halo ``ppermute`` feeds the last shard), and those
+    are sliced off the sharded result before returning.
+    """
+    from veles.simd_tpu.ops import spectral as sp
+
+    x = jnp.asarray(x, jnp.float32)
+    n = x.shape[-1]
+    n_shards = mesh.shape[axis]
+    block, halo = _check_stft_sharding(n, frame_length, hop, n_shards)
+    if window is None:
+        window = sp.hann_window(frame_length)
+    window = jnp.asarray(np.asarray(window, np.float32))
+    if window.shape != (frame_length,):
+        raise ValueError(f"window shape {window.shape} != "
+                         f"({frame_length},)")
+    # per-shard framing layout == the single-chip layout on block + halo
+    # samples (frame_count(block + halo, fl, hop) == block // hop)
+    idx = jnp.asarray(sp._frame_indices(block + halo, frame_length, hop))
+    in_spec = P(*([None] * (x.ndim - 1) + [axis]))
+    out_spec = P(*([None] * (x.ndim - 1) + [axis, None]))
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=in_spec, out_specs=out_spec)
+    def _run(x_local):
+        halo_part = halo_exchange_right(x_local, halo, axis)
+        x_ext = jnp.concatenate([x_local, halo_part], axis=-1)
+        frames = jnp.take(x_ext, idx, axis=-1) * window
+        return jnp.fft.rfft(frames, axis=-1)
+
+    out = _run(x)
+    return out[..., :sp.frame_count(n, frame_length, hop), :]
+
+
+def sharded_istft(spec, n: int, frame_length: int, hop: int, mesh: Mesh,
+                  axis: str = "sp", window=None):
+    """Sequence-parallel inverse STFT (windowed overlap-add).
+
+    The adjoint of :func:`sharded_stft`'s halo pattern: each shard
+    overlap-adds its own frames into a ``block + (frame_length - hop)``
+    local buffer, and the overhang — the samples its last frames wrote
+    into the RIGHT neighbour's territory — rides one ``ppermute`` to be
+    added onto that neighbour's head.  COLA normalization (division by
+    the global window-overlap envelope) happens outside the shard_map as
+    a plain sharded elementwise multiply.  Matches the single-chip
+    :func:`veles.simd_tpu.ops.spectral.istft`.
+    """
+    from veles.simd_tpu.ops import spectral as sp
+
+    n_shards = mesh.shape[axis]
+    block, halo = _check_stft_sharding(n, frame_length, hop, n_shards)
+    if window is None:
+        window = sp.hann_window(frame_length)
+    window_np = np.asarray(window, np.float32)
+    spec = jnp.asarray(spec, jnp.complex64)
+    frames_total = sp.frame_count(n, frame_length, hop)
+    if spec.shape[-2:] != (frames_total, frame_length // 2 + 1):
+        raise ValueError(
+            f"spec shape {spec.shape[-2:]} inconsistent with n={n}, "
+            f"frame_length={frame_length}, hop={hop} (expect "
+            f"{(frames_total, frame_length // 2 + 1)})")
+    # pad the frame axis back out to the uniform n // hop per-shard count
+    # (the overhang frames sharded_stft sliced off) with zero frames —
+    # zeros contribute nothing to the overlap-add
+    pad_frames = n // hop - frames_total
+    if pad_frames:
+        spec = jnp.pad(spec, [(0, 0)] * (spec.ndim - 2)
+                       + [(0, pad_frames), (0, 0)])
+    window_j = jnp.asarray(window_np)
+    idx = jnp.asarray(sp._frame_indices(block + halo, frame_length, hop))
+    in_spec = P(*([None] * (spec.ndim - 2) + [axis, None]))
+    out_spec = P(*([None] * (spec.ndim - 2) + [axis]))
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=in_spec, out_specs=out_spec)
+    def _run(spec_local):
+        frames = jnp.fft.irfft(spec_local, frame_length,
+                               axis=-1) * window_j
+        buf = jnp.zeros(spec_local.shape[:-2] + (block + halo,),
+                        jnp.float32)
+        buf = buf.at[..., idx].add(frames)
+        overflow = buf[..., block:]  # [..., halo] — right neighbour's head
+        n_sh = jax.lax.axis_size(axis)
+        recv = jax.lax.ppermute(overflow, axis,
+                                [(i, i + 1) for i in range(n_sh - 1)])
+        head = buf[..., :halo] + recv
+        return jnp.concatenate([head, buf[..., halo:block]], axis=-1)
+
+    out = _run(spec)
+    env_inv = jnp.asarray(
+        sp._env_inv(n, frame_length, hop, window_np).astype(np.float32))
+    return out * env_inv
 
 
 def data_parallel(fn, mesh: Mesh, axis: str = "dp"):
