@@ -503,6 +503,75 @@ static void test_resample(void) {
   CHECK(resample_poly(1, x, N, 0, 1, NULL, 0, z) != 0);
 }
 
+static void test_iir(void) {
+  enum { N = 300 };
+  /* design: section counts (ceil(poles/2)) and SOS normalization */
+  int ns = iir_butterworth(4, 0.25, 0.0, VELES_IIR_LOWPASS, NULL);
+  CHECK(ns == 2);
+  CHECK(iir_butterworth(3, 0.2, 0.5, VELES_IIR_BANDPASS, NULL) == 3);
+  double sos[2][6];
+  CHECK(iir_butterworth(4, 0.25, 0.0, VELES_IIR_LOWPASS, &sos[0][0]) == 2);
+  CHECK_NEAR(sos[0][3], 1.0, 1e-12);
+  CHECK_NEAR(sos[1][3], 1.0, 1e-12);
+  /* bad design parameters surface as errors */
+  CHECK(iir_butterworth(0, 0.25, 0.0, VELES_IIR_LOWPASS, NULL) < 0);
+  CHECK(iir_butterworth(2, 1.5, 0.0, VELES_IIR_LOWPASS, NULL) < 0);
+
+  /* lowpass DC: constant input -> same constant out (after settling) */
+  float x[N], y[N], y_na[N];
+  for (int i = 0; i < N; i++) {
+    x[i] = 1.0f;
+  }
+  CHECK(iir_sosfilt(1, &sos[0][0], 2, x, N, NULL, y) == 0);
+  CHECK_NEAR(y[N - 1], 1.0, 1e-3);
+  /* XLA-vs-oracle on noise-ish data */
+  for (int i = 0; i < N; i++) {
+    x[i] = sinf(0.37f * (float)i) + 0.5f * cosf(1.1f * (float)i);
+  }
+  CHECK(iir_sosfilt(1, &sos[0][0], 2, x, N, NULL, y) == 0);
+  CHECK(iir_sosfilt(0, &sos[0][0], 2, x, N, NULL, y_na) == 0);
+  for (int i = 0; i < N; i += 7) {
+    CHECK_NEAR(y[i], y_na[i], 1e-4);
+  }
+
+  /* settled zi: constant input is steady from sample 0 */
+  double zi[2][2];
+  CHECK(iir_sosfilt_zi(&sos[0][0], 2, &zi[0][0]) == 0);
+  for (int i = 0; i < N; i++) {
+    x[i] = 2.5f;
+  }
+  for (int s = 0; s < 2; s++) {
+    zi[s][0] *= 2.5;
+    zi[s][1] *= 2.5;
+  }
+  CHECK(iir_sosfilt(1, &sos[0][0], 2, x, N, &zi[0][0], y) == 0);
+  CHECK_NEAR(y[0], 2.5, 1e-3);
+  CHECK_NEAR(y[N / 2], 2.5, 1e-3);
+
+  /* zero-phase filtfilt: band-interior tone passes unshifted */
+  for (int i = 0; i < N; i++) {
+    x[i] = sinf(0.1f * (float)M_PI * (float)i);
+  }
+  CHECK(iir_sosfiltfilt(1, &sos[0][0], 2, x, N, -1, y) == 0);
+  for (int i = 40; i < N - 40; i += 9) {
+    CHECK_NEAR(y[i], x[i], 5e-3);
+  }
+  CHECK(iir_sosfiltfilt(1, &sos[0][0], 2, x, N, (long)N, y) != 0);
+
+  /* lfilter matches its oracle; FIR-only denominator works */
+  double b[3] = {0.2, 0.3, 0.1};
+  double a[3] = {1.0, -0.4, 0.1};
+  CHECK(iir_lfilter(1, b, 3, a, 3, x, N, y) == 0);
+  CHECK(iir_lfilter(0, b, 3, a, 3, x, N, y_na) == 0);
+  for (int i = 0; i < N; i += 7) {
+    CHECK_NEAR(y[i], y_na[i], 1e-4);
+  }
+  double one = 1.0;
+  CHECK(iir_lfilter(1, b, 3, &one, 1, x, N, y) == 0);
+  double azero[2] = {0.0, 1.0};
+  CHECK(iir_lfilter(1, b, 3, azero, 2, x, N, y) != 0);
+}
+
 static void test_normalize(void) {
   uint8_t plane[16] = {0, 255, 128, 64, 1, 2, 3, 4,
                        5, 6, 7, 8, 9, 10, 11, 12};
@@ -723,6 +792,7 @@ int main(void) {
   test_mathfun();
   test_spectral();
   test_resample();
+  test_iir();
   test_normalize();
   test_detect_peaks();
   test_conversions();

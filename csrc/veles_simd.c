@@ -654,6 +654,47 @@ int resample_fourier(int simd, const float *x, size_t length, size_t num,
                   (unsigned long)length, (unsigned long)num, PTR(result));
 }
 
+/* ---- iir -------------------------------------------------------------- */
+
+int iir_butterworth(size_t order, double low, double high,
+                    VelesIirBandType btype, double *sos) {
+  long sections = -1;
+  if (shim_call_parse("iir_butterworth", parse_long, &sections, "(kddiK)",
+                      (unsigned long)order, low, high, (int)btype,
+                      PTR(sos)) != 0) {
+    return -1;
+  }
+  return (int)sections;
+}
+
+int iir_sosfilt(int simd, const double *sos, size_t n_sections,
+                const float *x, size_t length, const double *zi,
+                float *result) {
+  return shim_run("iir_sosfilt", "(iKkKkKK)", simd, PTR(sos),
+                  (unsigned long)n_sections, PTR(x),
+                  (unsigned long)length, PTR(zi), PTR(result));
+}
+
+int iir_sosfiltfilt(int simd, const double *sos, size_t n_sections,
+                    const float *x, size_t length, long padlen,
+                    float *result) {
+  return shim_run("iir_sosfiltfilt", "(iKkKklK)", simd, PTR(sos),
+                  (unsigned long)n_sections, PTR(x),
+                  (unsigned long)length, padlen, PTR(result));
+}
+
+int iir_sosfilt_zi(const double *sos, size_t n_sections, double *zi_out) {
+  return shim_run("iir_sosfilt_zi", "(KkK)", PTR(sos),
+                  (unsigned long)n_sections, PTR(zi_out));
+}
+
+int iir_lfilter(int simd, const double *b, size_t nb, const double *a,
+                size_t na, const float *x, size_t length, float *result) {
+  return shim_run("iir_lfilter", "(iKkKkKkK)", simd, PTR(b),
+                  (unsigned long)nb, PTR(a), (unsigned long)na, PTR(x),
+                  (unsigned long)length, PTR(result));
+}
+
 /* ---- normalize -------------------------------------------------------- */
 
 int normalize2D(int simd, const uint8_t *src, size_t src_stride,

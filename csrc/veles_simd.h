@@ -293,6 +293,42 @@ int resample_poly(int simd, const float *x, size_t length, size_t up,
 int resample_fourier(int simd, const float *x, size_t length, size_t num,
                      float *result);
 
+/* ---- iir — no reference analog (recursive filtering; the recurrence
+ * runs as an O(log n) associative scan on device).  SOS rows are
+ * [b0 b1 b2 1 a1 a2] float64, the scipy convention. ------------------- */
+
+typedef enum {
+  VELES_IIR_LOWPASS = 0,
+  VELES_IIR_HIGHPASS = 1,
+  VELES_IIR_BANDPASS = 2,
+  VELES_IIR_BANDSTOP = 3,
+} VelesIirBandType;
+
+/* Digital Butterworth design; cutoffs as fractions of Nyquist in (0, 1)
+ * (`high` ignored for low/highpass).  Writes [n_sections][6] float64
+ * rows into sos when non-NULL and returns the section count (call with
+ * sos = NULL first to size the buffer); negative on error. */
+int iir_butterworth(size_t order, double low, double high,
+                    VelesIirBandType btype, double *sos);
+/* Second-order-section cascade filter.  zi: per-section DF2T initial
+ * states [n_sections][2] float64, or NULL for zero.  result: length
+ * floats (in-place x == result is NOT supported). */
+int iir_sosfilt(int simd, const double *sos, size_t n_sections,
+                const float *x, size_t length, const double *zi,
+                float *result);
+/* Zero-phase forward-backward filtering (odd-extension padding;
+ * padlen < 0 selects the scipy default).  result: length floats. */
+int iir_sosfiltfilt(int simd, const double *sos, size_t n_sections,
+                    const float *x, size_t length, long padlen,
+                    float *result);
+/* Settled step-response states (scipy sosfilt_zi): zi_out holds
+ * n_sections * 2 float64. */
+int iir_sosfilt_zi(const double *sos, size_t n_sections, double *zi_out);
+/* Direct transfer-function filter y = (b/a) * x, denominator order
+ * (na - 1) <= 32; use sosfilt beyond.  result: length floats. */
+int iir_lfilter(int simd, const double *b, size_t nb, const double *a,
+                size_t na, const float *x, size_t length, float *result);
+
 /* ---- normalize (inc/simd/normalize.h:48-90) --------------------------- */
 
 int normalize2D(int simd, const uint8_t *src, size_t src_stride,

@@ -298,6 +298,50 @@ def main():
               lambda: sin_psv(v, simd=False),
               samples=v.size)
 
+    # --- spectral: STFT over a long signal (batched-FFT framing) ---
+    from veles.simd_tpu.ops import spectral as sp
+
+    ns = 1 << 17 if quick else 1 << 20
+    xs = rng.randn(ns).astype(np.float32)
+    xsd = jnp.asarray(xs)
+
+    def stft_step(v):
+        s = sp.stft(v, 1024, 256, simd=True)
+        return v + 1e-30 * jnp.abs(s[..., 0, 0])
+
+    benchmark(f"stft {ns >> 10}k fl=1024 hop=256", stft_step, xsd,
+              lambda: sp.stft_na(xs, 1024, 256), samples=xs.size,
+              baseline_repeats=1)
+
+    # --- resample: polyphase 48k->44.1k ---
+    from veles.simd_tpu.ops import resample as rs
+
+    def rsp_step(v):
+        y = rs.resample_poly(v, 160, 147, simd=True)
+        return v + 1e-30 * y[..., : v.shape[-1]]
+
+    benchmark(f"resample_poly {ns >> 10}k 160/147", rsp_step, xsd,
+              lambda: rs.resample_poly_na(xs, 160, 147), samples=xs.size,
+              baseline_repeats=1)
+
+    # --- iir: order-4 biquad cascade as an associative scan, vs the
+    # sequential float64 oracle (the honest CPU formulation — the
+    # recurrence has no vectorized NumPy form) ---
+    from veles.simd_tpu.ops import iir
+
+    sos = iir.butterworth(4, 0.25, "lowpass")
+    bi, ni = (8, 1 << 12) if quick else (64, 1 << 14)
+    xi = rng.randn(bi, ni).astype(np.float32)
+    xid = jnp.asarray(xi)
+
+    def iir_step(v):
+        y = iir.sosfilt(sos, v, simd=True)
+        return v + 1e-30 * y
+
+    benchmark(f"sosfilt order4 {bi}x{ni >> 10}k", iir_step, xid,
+              lambda: iir.sosfilt_na(sos, xi), samples=xi.size,
+              baseline_repeats=1)
+
 
 if __name__ == "__main__":
     main()

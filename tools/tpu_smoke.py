@@ -271,6 +271,26 @@ def _check_resample(rng):
     return max(errs), 1e-4
 
 
+def _check_iir(rng):
+    """Associative-scan IIR vs the sequential DF2T oracle (FFT-free —
+    runs even on relay sessions whose backend lacks fft)."""
+    from veles.simd_tpu.ops import iir
+
+    x = rng.randn(4, 2048).astype(np.float32)
+    errs = []
+    sos = iir.butterworth(4, 0.25, "lowpass")
+    errs.append(_rel_err(iir.sosfilt(sos, x, simd=True),
+                         iir.sosfilt_na(sos, x)))
+    sos_bp = iir.butterworth(3, (0.2, 0.6), "bandpass")
+    errs.append(_rel_err(iir.sosfiltfilt(sos_bp, x, simd=True),
+                         iir.sosfiltfilt_na(sos_bp, x)))
+    b = np.array([0.2, 0.3, 0.1])
+    a = np.array([1.0, -0.5, 0.2, -0.05])
+    errs.append(_rel_err(iir.lfilter(b, a, x, simd=True),
+                         iir.lfilter_na(b, a, x)))
+    return max(errs), 1e-3
+
+
 def _check_normalize(rng):
     from veles.simd_tpu.ops import normalize as nz
 
@@ -422,6 +442,7 @@ FAMILIES = [
     ("wavelet", _check_wavelet),
     ("spectral", _check_spectral),
     ("resample", _check_resample),
+    ("iir", _check_iir),
     ("normalize", _check_normalize),
     ("detect_peaks", _check_detect_peaks),
     ("pallas1d", _check_pallas1d),
@@ -454,6 +475,16 @@ def run_smoke(emit=None, families=None, on_start=None) -> bool:
             err, tol = check(rng)
             ok = err <= tol
         except Exception as e:  # surface, keep checking other families
+            # A backend capability gap is not a numerical failure: some
+            # relay sessions ship a TPU backend with whole op families
+            # missing (observed 2026-07-31: every jnp.fft.* raised
+            # UNIMPLEMENTED while matmul/conv ran fine).  Report it
+            # loudly but distinctly — the op never executed, so there is
+            # no wrong number to flag.
+            if "UNIMPLEMENTED" in str(e):
+                emit(f"TPU-CHECK family={name} device={device!r} "
+                     f"UNSUPPORTED-BY-BACKEND ({e!s:.120})")
+                continue
             err, tol, ok = float("nan"), 0.0, False
             emit(f"TPU-CHECK family={name} EXCEPTION: {e!r}")
         all_ok &= ok

@@ -31,6 +31,7 @@ from veles.simd_tpu.ops import convolve as _cv
 from veles.simd_tpu.ops import convolve2d as _cv2
 from veles.simd_tpu.ops import correlate as _cr
 from veles.simd_tpu.ops import detect_peaks as _dp
+from veles.simd_tpu.ops import iir as _iir
 from veles.simd_tpu.ops import mathfun as _mf
 from veles.simd_tpu.ops import matrix as _mx
 from veles.simd_tpu.ops import normalize as _nz
@@ -371,6 +372,58 @@ def resample_poly(simd, x, length, up, down, taps, num_taps, result):
 def resample_fourier(simd, x, length, num, result):
     out = _rs.resample_fourier(_f32(x, length), int(num), simd=bool(simd))
     _f32(result, num)[...] = np.asarray(out)
+    return 0
+
+
+# ---- iir ------------------------------------------------------------------
+
+_C_BTYPES = {0: "lowpass", 1: "highpass", 2: "bandpass", 3: "bandstop"}
+
+
+def _f64(ptr, *shape):
+    return _arr(ptr, shape, ctypes.c_double)
+
+
+def iir_butterworth(order, low, high, btype, sos_out):
+    """Returns the section count; writes [n_sections, 6] float64 rows
+    into ``sos_out`` when it is non-NULL (call once with NULL to size
+    the buffer, then again to fill it)."""
+    bt = _C_BTYPES[int(btype)]
+    cutoff = float(low) if bt in ("lowpass", "highpass") \
+        else (float(low), float(high))
+    sos = _iir.butterworth(int(order), cutoff, bt)
+    if int(sos_out) != 0:
+        _f64(sos_out, len(sos), 6)[...] = sos
+    return len(sos)
+
+
+def iir_sosfilt(simd, sos, n_sections, x, length, zi, result):
+    s = _f64(sos, n_sections, 6)
+    z = None if int(zi) == 0 else _f64(zi, n_sections, 2)
+    out = _iir.sosfilt(s, _f32(x, length), zi=z, simd=bool(simd))
+    _f32(result, length)[...] = np.asarray(out)
+    return 0
+
+
+def iir_sosfiltfilt(simd, sos, n_sections, x, length, padlen, result):
+    s = _f64(sos, n_sections, 6)
+    pl = None if int(padlen) < 0 else int(padlen)
+    out = _iir.sosfiltfilt(s, _f32(x, length), padlen=pl,
+                           simd=bool(simd))
+    _f32(result, length)[...] = np.asarray(out)
+    return 0
+
+
+def iir_sosfilt_zi(sos, n_sections, zi_out):
+    _f64(zi_out, n_sections, 2)[...] = _iir.sosfilt_zi(
+        _f64(sos, n_sections, 6))
+    return 0
+
+
+def iir_lfilter(simd, b, nb, a, na, x, length, result):
+    out = _iir.lfilter(_f64(b, nb), _f64(a, na), _f32(x, length),
+                       simd=bool(simd))
+    _f32(result, length)[...] = np.asarray(out)
     return 0
 
 

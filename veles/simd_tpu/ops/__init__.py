@@ -15,6 +15,8 @@ Each module mirrors one reference header (SURVEY.md §2):
   Morlet CWT (beyond-reference: batched-FFT time-frequency analysis)
 * :mod:`.resample`     — polyphase rational-rate conversion as one
   dilated/strided conv + Fourier resampling (beyond-reference)
+* :mod:`.iir`          — Butterworth design + IIR cascades as O(log n)
+  associative-scan recurrences, zero-phase filtfilt (beyond-reference)
 * :mod:`.detect_peaks` — 1D local-extrema detection
 
 Every public op takes the reference-compatible ``simd=`` flag: truthy (the
