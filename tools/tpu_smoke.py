@@ -427,6 +427,14 @@ def _check_parallel(rng):
     rec = sharded_istft(spec, ns, fl, hop, default_mesh("sp"), axis="sp")
     errs.append(_rel_err(np.asarray(rec)[fl:-fl],
                          np.asarray(xs, np.float64)[fl:-fl]))
+    # sequence-parallel IIR (two-level scan state handoff)
+    from veles.simd_tpu.ops import iir as iir_mod
+    from veles.simd_tpu.parallel import sharded_sosfilt
+
+    sos = iir_mod.butterworth(3, 0.2, "lowpass")
+    xq = rng.randn(n_dev * 256).astype(np.float32)
+    errs.append(_rel_err(sharded_sosfilt(sos, xq, default_mesh("sp")),
+                         iir_mod.sosfilt_na(sos, xq)))
     return max(errs), 1e-4
 
 

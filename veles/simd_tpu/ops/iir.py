@@ -280,6 +280,23 @@ def _affine_combine(e1, e2):
             jnp.einsum("...ij,...j->...i", a2, b1) + b2)
 
 
+def _biquad_affine_scan(a1, a2, drive):
+    """Associative scan of ``s[t] = A s[t-1] + d[t]`` for the biquad
+    companion matrix ``A = [[-a1, -a2], [1, 0]]``.
+
+    ``drive`` is ``[..., n, 2]``.  Returns ``(cum_a, states)`` — the
+    cumulative transition products ``cum_a[t] = A^(t+1)`` come free from
+    the same scan and let callers apply an incoming state as
+    ``states + cum_a @ s_in`` without a second pass (used by
+    ``parallel.sharded_sosfilt``).
+    """
+    a_mat = jnp.broadcast_to(
+        jnp.asarray([[-a1, -a2], [1.0, 0.0]], drive.dtype),
+        drive.shape[:-1] + (2, 2))
+    return jax.lax.associative_scan(_affine_combine, (a_mat, drive),
+                                    axis=-3)
+
+
 def _biquad_apply(x, b0, b1, b2, a1, a2, s_in=None):
     """One biquad over ``x[..., n]`` via associative scan.
 
@@ -305,12 +322,8 @@ def _biquad_apply(x, b0, b1, b2, a1, a2, s_in=None):
         corr = jnp.concatenate(
             [s_in[..., :1], s_in[..., 1:2], zpad], axis=-1)
         u = u + corr[..., :n]
-    a_mat = jnp.broadcast_to(
-        jnp.asarray([[-a1, -a2], [1.0, 0.0]], x.dtype),
-        x.shape[:-1] + (n, 2, 2))
     drive = jnp.stack([u, jnp.zeros_like(u)], axis=-1)
-    _, states = jax.lax.associative_scan(_affine_combine, (a_mat, drive),
-                                         axis=-3)
+    _, states = _biquad_affine_scan(a1, a2, drive)
     return states[..., 0]
 
 
