@@ -572,6 +572,77 @@ static void test_iir(void) {
   CHECK(iir_lfilter(1, b, 3, azero, 2, x, N, y) != 0);
 }
 
+static void test_filters(void) {
+  enum { N = 120 };
+  float x[N], y[N], y_na[N];
+  for (int i = 0; i < N; i++) {
+    x[i] = sinf(0.21f * (float)i);
+  }
+  /* an isolated spike vanishes entirely under the median */
+  x[40] = 50.f;
+  CHECK(filt_medfilt(1, x, N, 5, y) == 0);
+  CHECK(fabsf(y[40]) < 1.5f);
+  CHECK(filt_medfilt(0, x, N, 5, y_na) == 0);
+  for (int i = 0; i < N; i += 7) {
+    CHECK_NEAR(y[i], y_na[i], 1e-5);
+  }
+  /* rank 0 erodes: output never exceeds the input */
+  CHECK(filt_order_filter(1, x, N, 0, 3, y) == 0);
+  for (int i = 0; i < N; i++) {
+    CHECK(y[i] <= x[i] + 1e-5f);
+  }
+  CHECK(filt_medfilt(1, x, N, 4, y) != 0); /* even kernel rejected */
+
+  /* 2D median cleans a salt spike */
+  enum { H = 12, W = 16 };
+  float img[H * W], out[H * W];
+  for (int i = 0; i < H * W; i++) {
+    img[i] = 0.1f * (float)(i % 7);
+  }
+  img[5 * W + 8] = 99.f;
+  CHECK(filt_medfilt2d(1, img, H, W, 3, 3, out) == 0);
+  CHECK(fabsf(out[5 * W + 8]) < 1.f);
+
+  /* Savitzky-Golay reproduces a quadratic exactly (interp edges) */
+  float q[N], sg[N];
+  for (int i = 0; i < N; i++) {
+    float t = (float)i / N - 0.5f;
+    q[i] = 1.f + 2.f * t - 3.f * t * t;
+  }
+  CHECK(filt_savgol(1, q, N, 11, 3, 0, 1.0, VELES_SAVGOL_INTERP, sg)
+        == 0);
+  for (int i = 0; i < N; i += 5) {
+    CHECK_NEAR(sg[i], q[i], 1e-4);
+  }
+  /* deriv of a ramp is its slope */
+  for (int i = 0; i < N; i++) {
+    q[i] = 0.5f * (float)i;
+  }
+  CHECK(filt_savgol(1, q, N, 9, 2, 1, 1.0, VELES_SAVGOL_INTERP, sg)
+        == 0);
+  CHECK_NEAR(sg[N / 2], 0.5, 1e-4);
+  CHECK(filt_savgol(1, q, N, 9, 9, 0, 1.0, VELES_SAVGOL_INTERP, sg)
+        != 0); /* polyorder >= window rejected */
+
+  /* SG taps sum to 1 (deriv 0); firwin lowpass has unit DC gain */
+  double taps[33];
+  CHECK(filt_savgol_coeffs(11, 3, 0, 1.0, taps) == 0);
+  double s = 0.0;
+  for (int i = 0; i < 11; i++) {
+    s += taps[i];
+  }
+  CHECK_NEAR(s, 1.0, 1e-12);
+  double fc = 0.4;
+  CHECK(filt_firwin(33, &fc, 1, 1, 0, taps) == 0);
+  s = 0.0;
+  for (int i = 0; i < 33; i++) {
+    s += taps[i];
+  }
+  CHECK_NEAR(s, 1.0, 1e-12);
+  double bad = 1.5;
+  CHECK(filt_firwin(33, &bad, 1, 1, 0, taps) != 0);
+}
+
 static void test_normalize(void) {
   uint8_t plane[16] = {0, 255, 128, 64, 1, 2, 3, 4,
                        5, 6, 7, 8, 9, 10, 11, 12};
@@ -793,6 +864,7 @@ int main(void) {
   test_spectral();
   test_resample();
   test_iir();
+  test_filters();
   test_normalize();
   test_detect_peaks();
   test_conversions();

@@ -695,6 +695,53 @@ int iir_lfilter(int simd, const double *b, size_t nb, const double *a,
                   (unsigned long)length, PTR(result));
 }
 
+/* ---- filters ---------------------------------------------------------- */
+
+int filt_medfilt(int simd, const float *x, size_t length,
+                 size_t kernel_size, float *result) {
+  return shim_run("filt_medfilt", "(iKkkK)", simd, PTR(x),
+                  (unsigned long)length, (unsigned long)kernel_size,
+                  PTR(result));
+}
+
+int filt_order_filter(int simd, const float *x, size_t length,
+                      size_t rank, size_t kernel_size, float *result) {
+  return shim_run("filt_order_filter", "(iKkkkK)", simd, PTR(x),
+                  (unsigned long)length, (unsigned long)rank,
+                  (unsigned long)kernel_size, PTR(result));
+}
+
+int filt_medfilt2d(int simd, const float *img, size_t height,
+                   size_t width, size_t kh, size_t kw, float *result) {
+  return shim_run("filt_medfilt2d", "(iKkkkkK)", simd, PTR(img),
+                  (unsigned long)height, (unsigned long)width,
+                  (unsigned long)kh, (unsigned long)kw, PTR(result));
+}
+
+int filt_savgol(int simd, const float *x, size_t length,
+                size_t window_length, size_t polyorder, size_t deriv,
+                double delta, VelesSavgolMode mode, float *result) {
+  return shim_run("filt_savgol", "(iKkkkkdiK)", simd, PTR(x),
+                  (unsigned long)length, (unsigned long)window_length,
+                  (unsigned long)polyorder, (unsigned long)deriv, delta,
+                  (int)mode, PTR(result));
+}
+
+int filt_savgol_coeffs(size_t window_length, size_t polyorder,
+                       size_t deriv, double delta, double *taps) {
+  return shim_run("filt_savgol_coeffs", "(kkkdK)",
+                  (unsigned long)window_length,
+                  (unsigned long)polyorder, (unsigned long)deriv, delta,
+                  PTR(taps));
+}
+
+int filt_firwin(size_t numtaps, const double *cutoffs, size_t n_cutoffs,
+                int pass_zero, int window, double *taps) {
+  return shim_run("filt_firwin", "(kKkiiK)", (unsigned long)numtaps,
+                  PTR(cutoffs), (unsigned long)n_cutoffs, pass_zero,
+                  window, PTR(taps));
+}
+
 /* ---- normalize -------------------------------------------------------- */
 
 int normalize2D(int simd, const uint8_t *src, size_t src_stride,

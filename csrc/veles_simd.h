@@ -329,6 +329,44 @@ int iir_sosfilt_zi(const double *sos, size_t n_sections, double *zi_out);
 int iir_lfilter(int simd, const double *b, size_t nb, const double *a,
                 size_t na, const float *x, size_t length, float *result);
 
+/* ---- filters — no reference analog (nonlinear/smoothing toolkit:
+ * median/rank selection runs as a static gather + lane sort on
+ * device; Savitzky-Golay and firwin taps are float64 host designs). - */
+
+/* Median filter, scipy medfilt semantics (zero-padded edges, odd
+ * kernel_size).  result: length floats. */
+int filt_medfilt(int simd, const float *x, size_t length,
+                 size_t kernel_size, float *result);
+/* Rank-order filter: rank-th smallest of each window (rank k/2 is the
+ * median; 0 erodes, k-1 dilates). */
+int filt_order_filter(int simd, const float *x, size_t length,
+                      size_t rank, size_t kernel_size, float *result);
+/* 2D median filter over a row-major [height][width] image, odd window
+ * kh x kw.  result: height * width floats. */
+int filt_medfilt2d(int simd, const float *img, size_t height,
+                   size_t width, size_t kh, size_t kw, float *result);
+
+typedef enum {
+  VELES_SAVGOL_INTERP = 0,   /* polynomial edge fits (scipy default) */
+  VELES_SAVGOL_CONSTANT = 1, /* zero-padded edges */
+  VELES_SAVGOL_NEAREST = 2,  /* edge-replicated */
+} VelesSavgolMode;
+
+/* Savitzky-Golay smoothing / differentiation (scipy conventions).
+ * result: length floats. */
+int filt_savgol(int simd, const float *x, size_t length,
+                size_t window_length, size_t polyorder, size_t deriv,
+                double delta, VelesSavgolMode mode, float *result);
+/* The SG taps themselves (np.convolve orientation, scipy
+ * savgol_coeffs): taps holds window_length float64. */
+int filt_savgol_coeffs(size_t window_length, size_t polyorder,
+                       size_t deriv, double delta, double *taps);
+/* Window-method FIR design (scipy firwin): cutoffs ascending in (0,1)
+ * as Nyquist fractions; window 0 = Hamming, 1 = Hann.  taps: numtaps
+ * float64. */
+int filt_firwin(size_t numtaps, const double *cutoffs, size_t n_cutoffs,
+                int pass_zero, int window, double *taps);
+
 /* ---- normalize (inc/simd/normalize.h:48-90) --------------------------- */
 
 int normalize2D(int simd, const uint8_t *src, size_t src_stride,

@@ -1,0 +1,185 @@
+"""Nonlinear/smoothing filter family vs scipy and the oracle twins.
+
+The reference has no nonlinear filtering (its toolkit is linear
+convolution, ``/root/reference/src/convolve.c``) — this family is a new
+capability.  scipy.signal is the external ground truth; the ``*_na``
+twins cross-validate the XLA gather/sort and conv paths (the
+reference's two-implementations discipline,
+``/root/reference/tests/matrix.cc:94-98``).
+"""
+
+import numpy as np
+import pytest
+
+from scipy import signal as ss
+
+from veles.simd_tpu.ops import filters as fl
+
+RNG = np.random.RandomState(81)
+
+
+class TestMedianRank:
+    @pytest.mark.parametrize("k", [3, 5, 9, 15])
+    def test_medfilt_matches_scipy(self, k):
+        x = RNG.randn(301)
+        got = np.asarray(fl.medfilt(x.astype(np.float32), k, simd=True))
+        np.testing.assert_allclose(got, ss.medfilt(x, k), atol=1e-6)
+
+    def test_medfilt_oracle(self):
+        x = RNG.randn(2, 128)
+        np.testing.assert_allclose(fl.medfilt_na(x, 7),
+                                   np.stack([ss.medfilt(r, 7) for r in x]),
+                                   atol=1e-12)
+
+    def test_impulse_rejection(self):
+        """The defining property: isolated spikes vanish entirely —
+        no linear filter does this."""
+        x = np.zeros(100, np.float32)
+        x[30] = 100.0
+        y = np.asarray(fl.medfilt(x, 5, simd=True))
+        assert np.max(np.abs(y)) == 0.0
+
+    def test_order_filter_matches_scipy(self):
+        x = RNG.randn(200)
+        for rank in (0, 2, 6):
+            got = np.asarray(fl.order_filter(x.astype(np.float32), rank,
+                                             7, simd=True))
+            want = ss.order_filter(x, np.ones(7), rank)
+            np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_order_filter_min_max(self):
+        """rank 0 is a running min, rank k-1 a running max (erosion /
+        dilation)."""
+        x = RNG.randn(64).astype(np.float32)
+        lo = np.asarray(fl.order_filter(x, 0, 3, simd=True))
+        hi = np.asarray(fl.order_filter(x, 2, 3, simd=True))
+        assert np.all(lo <= x + 1e-6)
+        assert np.all(hi >= x - 1e-6)
+
+    @pytest.mark.parametrize("ksize", [3, 5, (3, 7), (5, 3)])
+    def test_medfilt2d_matches_scipy(self, ksize):
+        img = RNG.randn(24, 37)
+        got = np.asarray(fl.medfilt2d(img.astype(np.float32), ksize,
+                                      simd=True))
+        np.testing.assert_allclose(got, ss.medfilt2d(img, ksize),
+                                   atol=1e-6)
+
+    def test_medfilt2d_batched(self):
+        imgs = RNG.randn(3, 16, 20)
+        got = np.asarray(fl.medfilt2d(imgs.astype(np.float32), 3,
+                                      simd=True))
+        want = np.stack([ss.medfilt2d(i, 3) for i in imgs])
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_contracts(self):
+        with pytest.raises(ValueError, match="odd"):
+            fl.medfilt(np.zeros(8, np.float32), 4)
+        with pytest.raises(ValueError, match="rank"):
+            fl.order_filter(np.zeros(8, np.float32), 7, 7)
+        with pytest.raises(ValueError, match="H, W"):
+            fl.medfilt2d(np.zeros(8, np.float32), 3)
+
+
+class TestSavgol:
+    CASES = [(11, 3, 0), (9, 2, 1), (15, 4, 2), (5, 4, 0)]
+
+    @pytest.mark.parametrize("wl,po,deriv", CASES)
+    def test_coeffs_match_scipy(self, wl, po, deriv):
+        np.testing.assert_allclose(
+            fl.savgol_coeffs(wl, po, deriv),
+            ss.savgol_coeffs(wl, po, deriv=deriv), atol=1e-12)
+
+    @pytest.mark.parametrize("mode", ["interp", "constant", "nearest"])
+    @pytest.mark.parametrize("wl,po,deriv", CASES[:3])
+    def test_filter_matches_scipy(self, wl, po, deriv, mode):
+        x = RNG.randn(2, 180).astype(np.float32)
+        got = np.asarray(fl.savgol_filter(x, wl, po, deriv=deriv,
+                                          mode=mode, simd=True))
+        want = ss.savgol_filter(x.astype(np.float64), wl, po,
+                                deriv=deriv, mode=mode, axis=-1)
+        np.testing.assert_allclose(got, want, atol=2e-5)
+
+    def test_oracle_matches_scipy(self):
+        x = RNG.randn(150)
+        np.testing.assert_allclose(
+            fl.savgol_filter_na(x, 11, 3),
+            ss.savgol_filter(x, 11, 3), atol=1e-10)
+
+    def test_polynomial_passthrough(self):
+        """A degree-<=polyorder polynomial is reproduced exactly
+        (including the interp edges) — the SG defining property."""
+        t = np.linspace(-1, 1, 101)
+        x = (0.3 + 1.7 * t - 2.0 * t ** 2 + 0.5 * t ** 3)
+        y = np.asarray(fl.savgol_filter(x.astype(np.float32), 13, 3,
+                                        simd=True))
+        np.testing.assert_allclose(y, x, atol=1e-4)
+
+    def test_derivative_of_ramp(self):
+        """d/dt of a ramp is its slope everywhere."""
+        x = 0.25 * np.arange(80, dtype=np.float32)
+        d = np.asarray(fl.savgol_filter(x, 9, 2, deriv=1, simd=True))
+        np.testing.assert_allclose(d, 0.25, atol=1e-4)
+
+    def test_contracts(self):
+        x = np.zeros(20, np.float32)
+        with pytest.raises(ValueError, match="polyorder"):
+            fl.savgol_filter(x, 5, 5)
+        with pytest.raises(ValueError, match="interp"):
+            fl.savgol_filter(x, 21, 2)
+        with pytest.raises(ValueError, match="mode"):
+            fl.savgol_filter(x, 5, 2, mode="wrap")
+
+
+class TestFirwin:
+    CASES = [
+        ((33, 0.4), {}),
+        ((32, 0.25), {}),
+        ((33, 0.3), {"pass_zero": False}),
+        ((41, [0.2, 0.5]), {"pass_zero": False}),
+        ((41, [0.2, 0.5]), {"pass_zero": True}),
+        ((21, 0.6), {"window": "hann"}),
+        ((55, [0.1, 0.3, 0.6]), {}),
+        ((33, 0.3), {"pass_zero": "highpass"}),
+        ((33, 0.4), {"pass_zero": "lowpass"}),
+        ((41, [0.2, 0.5]), {"pass_zero": "bandpass"}),
+        ((41, [0.2, 0.5]), {"pass_zero": "bandstop"}),
+        ((32, [0.2, 0.5]), {"pass_zero": False}),  # even-tap bandpass
+    ]
+
+    @pytest.mark.parametrize("args,kw", CASES)
+    def test_matches_scipy(self, args, kw):
+        np.testing.assert_allclose(fl.firwin(*args, **kw),
+                                   ss.firwin(*args, **kw), atol=1e-12)
+
+    def test_lowpass_dc_gain(self):
+        h = fl.firwin(51, 0.35)
+        assert abs(np.sum(h) - 1.0) < 1e-12
+
+    def test_contracts(self):
+        with pytest.raises(ValueError, match="odd"):
+            fl.firwin(32, 0.3, pass_zero=False)   # highpass, even
+        with pytest.raises(ValueError, match="odd"):
+            fl.firwin(32, [0.2, 0.5], pass_zero=True)  # bandstop, even
+        with pytest.raises(ValueError, match="increasing"):
+            fl.firwin(31, [0.5, 0.2])
+        with pytest.raises(ValueError, match="window"):
+            fl.firwin(31, 0.3, window="kaiser")
+        with pytest.raises(ValueError, match="pass_zero"):
+            fl.firwin(31, 0.3, pass_zero="notch")
+        with pytest.raises(ValueError, match="cutoff"):
+            fl.firwin(31, [0.2, 0.5], pass_zero="highpass")
+
+    def test_usable_with_lfilter(self):
+        """Design → filter end-to-end: firwin taps through the IIR
+        module's FIR path attenuate an out-of-band tone."""
+        from veles.simd_tpu.ops import iir
+
+        t = np.arange(2048)
+        x = (np.sin(0.1 * np.pi * t) + np.sin(0.8 * np.pi * t)) \
+            .astype(np.float32)
+        h = fl.firwin(101, 0.4)
+        y = np.asarray(iir.lfilter(h, [1.0], x, simd=True))
+        # steady state: low tone passes, high tone gone
+        core = y[200:]
+        hi_resid = core - np.sin(0.1 * np.pi * t[200:] - 0.1 * np.pi * 50)
+        assert np.sqrt(np.mean(hi_resid ** 2)) < 0.02

@@ -291,6 +291,27 @@ def _check_iir(rng):
     return max(errs), 1e-3
 
 
+def _check_filters(rng):
+    """Median/rank (gather + sort), Savitzky-Golay (FIR) vs oracles —
+    FFT-free family."""
+    from veles.simd_tpu.ops import filters as fl
+
+    x = rng.randn(4, 1024).astype(np.float32)
+    img = rng.randn(64, 80).astype(np.float32)
+    errs = [
+        _rel_err(fl.medfilt(x, 7, simd=True), fl.medfilt_na(x, 7)),
+        _rel_err(fl.order_filter(x, 1, 5, simd=True),
+                 fl.order_filter_na(x, 1, 5)),
+        _rel_err(fl.medfilt2d(img, (3, 5), simd=True),
+                 fl.medfilt2d_na(img, (3, 5))),
+        _rel_err(fl.savgol_filter(x, 11, 3, simd=True),
+                 fl.savgol_filter_na(x, 11, 3)),
+        _rel_err(fl.savgol_filter(x, 9, 2, deriv=1, simd=True),
+                 fl.savgol_filter_na(x, 9, 2, deriv=1)),
+    ]
+    return max(errs), 1e-3
+
+
 def _check_normalize(rng):
     from veles.simd_tpu.ops import normalize as nz
 
@@ -451,6 +472,7 @@ FAMILIES = [
     ("spectral", _check_spectral),
     ("resample", _check_resample),
     ("iir", _check_iir),
+    ("filters", _check_filters),
     ("normalize", _check_normalize),
     ("detect_peaks", _check_detect_peaks),
     ("pallas1d", _check_pallas1d),

@@ -31,6 +31,7 @@ from veles.simd_tpu.ops import convolve as _cv
 from veles.simd_tpu.ops import convolve2d as _cv2
 from veles.simd_tpu.ops import correlate as _cr
 from veles.simd_tpu.ops import detect_peaks as _dp
+from veles.simd_tpu.ops import filters as _fl
 from veles.simd_tpu.ops import iir as _iir
 from veles.simd_tpu.ops import mathfun as _mf
 from veles.simd_tpu.ops import matrix as _mx
@@ -424,6 +425,56 @@ def iir_lfilter(simd, b, nb, a, na, x, length, result):
     out = _iir.lfilter(_f64(b, nb), _f64(a, na), _f32(x, length),
                        simd=bool(simd))
     _f32(result, length)[...] = np.asarray(out)
+    return 0
+
+
+# ---- filters --------------------------------------------------------------
+
+def filt_medfilt(simd, x, length, kernel_size, result):
+    _f32(result, length)[...] = np.asarray(
+        _fl.medfilt(_f32(x, length), int(kernel_size), simd=bool(simd)))
+    return 0
+
+
+def filt_order_filter(simd, x, length, rank, kernel_size, result):
+    _f32(result, length)[...] = np.asarray(
+        _fl.order_filter(_f32(x, length), int(rank), int(kernel_size),
+                         simd=bool(simd)))
+    return 0
+
+
+def filt_medfilt2d(simd, img, height, width, kh, kw, result):
+    _f32(result, height, width)[...] = np.asarray(
+        _fl.medfilt2d(_f32(img, height, width), (int(kh), int(kw)),
+                      simd=bool(simd)))
+    return 0
+
+
+_C_SG_MODES = {0: "interp", 1: "constant", 2: "nearest"}
+
+
+def filt_savgol(simd, x, length, window_length, polyorder, deriv, delta,
+                mode, result):
+    _f32(result, length)[...] = np.asarray(
+        _fl.savgol_filter(_f32(x, length), int(window_length),
+                          int(polyorder), deriv=int(deriv),
+                          delta=float(delta), mode=_C_SG_MODES[int(mode)],
+                          simd=bool(simd)))
+    return 0
+
+
+def filt_savgol_coeffs(window_length, polyorder, deriv, delta, taps):
+    _f64(taps, window_length)[...] = _fl.savgol_coeffs(
+        int(window_length), int(polyorder), int(deriv), float(delta))
+    return 0
+
+
+def filt_firwin(numtaps, cutoffs, n_cutoffs, pass_zero, window, taps):
+    c = _f64(cutoffs, n_cutoffs)
+    cut = float(c[0]) if int(n_cutoffs) == 1 else list(map(float, c))
+    _f64(taps, numtaps)[...] = _fl.firwin(
+        int(numtaps), cut, pass_zero=bool(pass_zero),
+        window={0: "hamming", 1: "hann"}[int(window)])
     return 0
 
 
