@@ -503,6 +503,63 @@ static void test_resample(void) {
   CHECK(resample_poly(1, x, N, 0, 1, NULL, 0, z) != 0);
 }
 
+static void test_psd(void) {
+  enum { N = 4096, SEG = 256 };
+  /* a 2-tone signal on a linear ramp: detrend kills the ramp, welch
+   * finds both tones */
+  static float x[N], y[N], det[N];
+  for (int i = 0; i < N; i++) {
+    float t = (float)i;
+    x[i] = sinf(0.2f * (float)M_PI * t) + 0.001f * t + 3.f;
+    y[i] = sinf(0.2f * (float)M_PI * t + 0.7f); /* same tone, shifted */
+  }
+  CHECK(spectral_detrend(1, x, N, 0, det) == 0);
+  float mean = 0.f;
+  for (int i = 0; i < N; i++) {
+    mean += det[i];
+  }
+  CHECK(fabsf(mean / N) < 1e-3f);
+
+  size_t bins = welch_bins(N, SEG);
+  CHECK(bins == SEG / 2 + 1);
+  double freqs[SEG / 2 + 1];
+  float psd[SEG / 2 + 1], psd_na[SEG / 2 + 1];
+  CHECK(spectral_welch(1, x, N, 2.0, SEG, -1, freqs, psd) == 0);
+  /* tone at normalized 0.1 of fs=2 -> f = 0.2; peak bin near there */
+  int argmax = 0;
+  for (int i = 1; i < (int)bins; i++) {
+    if (psd[i] > psd[argmax]) {
+      argmax = i;
+    }
+  }
+  CHECK(fabs(freqs[argmax] - 0.2) < 2.0 / SEG + 1e-9);
+  /* XLA-vs-oracle */
+  CHECK(spectral_welch(0, x, N, 2.0, SEG, -1, freqs, psd_na) == 0);
+  for (int i = 0; i < (int)bins; i += 5) {
+    CHECK_NEAR(psd[i], psd_na[i], 1e-3 * psd_na[argmax]);
+  }
+  /* coherence of two versions of the same tone is ~1 at the tone */
+  float coh[SEG / 2 + 1];
+  CHECK(spectral_coherence(1, x, y, N, 2.0, SEG, freqs, coh) == 0);
+  CHECK(coh[argmax] > 0.99f);
+  /* csd peak magnitude matches the welch peak for identical inputs */
+  float pxy[2 * (SEG / 2 + 1)];
+  CHECK(spectral_csd(1, x, x, N, 2.0, SEG, -1, freqs, pxy) == 0);
+  CHECK_NEAR(pxy[2 * argmax], psd[argmax], 1e-2 * psd[argmax]);
+  /* single-segment periodogram on the linearly-detrended signal (the
+   * raw ramp's 1/f^2 leakage would dominate a boxcar window) */
+  static double pfreqs[N / 2 + 1];
+  static float ppsd[N / 2 + 1];
+  CHECK(spectral_periodogram(1, det, N, 2.0, pfreqs, ppsd) == 0);
+  int pmax = 0;
+  for (int i = 1; i < N / 2 + 1; i++) {
+    if (ppsd[i] > ppsd[pmax]) {
+      pmax = i;
+    }
+  }
+  CHECK(fabs(pfreqs[pmax] - 0.2) < 2.0 / N + 1e-9);
+}
+
 static void test_iir(void) {
   enum { N = 300 };
   /* design: section counts (ceil(poles/2)) and SOS normalization */
@@ -863,6 +920,7 @@ int main(void) {
   test_mathfun();
   test_spectral();
   test_resample();
+  test_psd();
   test_iir();
   test_filters();
   test_normalize();

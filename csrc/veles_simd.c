@@ -639,6 +639,47 @@ size_t resample_length(size_t length, size_t up, size_t down) {
   return (length * up + down - 1) / down;
 }
 
+size_t welch_bins(size_t length, size_t nperseg) {
+  size_t seg = nperseg < length ? nperseg : length;
+  return seg / 2 + 1;
+}
+
+int spectral_detrend(int simd, const float *x, size_t length, int kind,
+                     float *result) {
+  return shim_run("spectral_detrend", "(iKkiK)", simd, PTR(x),
+                  (unsigned long)length, kind, PTR(result));
+}
+
+int spectral_welch(int simd, const float *x, size_t length, double fs,
+                   size_t nperseg, long noverlap, double *freqs,
+                   float *psd) {
+  return shim_run("spectral_welch", "(iKkdklKK)", simd, PTR(x),
+                  (unsigned long)length, fs, (unsigned long)nperseg,
+                  noverlap, PTR(freqs), PTR(psd));
+}
+
+int spectral_periodogram(int simd, const float *x, size_t length,
+                         double fs, double *freqs, float *psd) {
+  return shim_run("spectral_periodogram", "(iKkdKK)", simd, PTR(x),
+                  (unsigned long)length, fs, PTR(freqs), PTR(psd));
+}
+
+int spectral_csd(int simd, const float *x, const float *y, size_t length,
+                 double fs, size_t nperseg, long noverlap, double *freqs,
+                 float *pxy) {
+  return shim_run("spectral_csd", "(iKKkdklKK)", simd, PTR(x), PTR(y),
+                  (unsigned long)length, fs, (unsigned long)nperseg,
+                  noverlap, PTR(freqs), PTR(pxy));
+}
+
+int spectral_coherence(int simd, const float *x, const float *y,
+                       size_t length, double fs, size_t nperseg,
+                       double *freqs, float *coh) {
+  return shim_run("spectral_coherence", "(iKKkdkKK)", simd, PTR(x),
+                  PTR(y), (unsigned long)length, fs,
+                  (unsigned long)nperseg, PTR(freqs), PTR(coh));
+}
+
 int resample_poly(int simd, const float *x, size_t length, size_t up,
                   size_t down, const float *taps, size_t num_taps,
                   float *result) {

@@ -276,6 +276,27 @@ int morlet_cwt(int simd, const float *x, size_t length,
                const double *scales, size_t n_scales, double w0,
                float *result);
 
+/* PSD estimation layer (scipy welch/periodogram/csd/coherence
+ * conventions; Hann window, constant detrend).  freqs buffers are
+ * float64 of (min(nperseg, length) / 2 + 1) entries — use
+ * welch_bins().  noverlap < 0 selects the nperseg/2 default. */
+size_t welch_bins(size_t length, size_t nperseg);
+/* Remove a linear (kind 0) or constant (kind 1) trend. */
+int spectral_detrend(int simd, const float *x, size_t length, int kind,
+                     float *result);
+int spectral_welch(int simd, const float *x, size_t length, double fs,
+                   size_t nperseg, long noverlap, double *freqs,
+                   float *psd);
+int spectral_periodogram(int simd, const float *x, size_t length,
+                         double fs, double *freqs, float *psd);
+/* pxy: interleaved (re, im) float pairs, welch_bins() entries. */
+int spectral_csd(int simd, const float *x, const float *y, size_t length,
+                 double fs, size_t nperseg, long noverlap, double *freqs,
+                 float *pxy);
+int spectral_coherence(int simd, const float *x, const float *y,
+                       size_t length, double fs, size_t nperseg,
+                       double *freqs, float *coh);
+
 /* ---- resample — no reference analog (rate conversion over the same
  * conv machinery as src/convolve.c; the polyphase cascade runs as one
  * dilated/strided XLA conv). ------------------------------------------- */

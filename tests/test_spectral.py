@@ -9,6 +9,8 @@ sweeps, and contract-violation checks.
 import numpy as np
 import pytest
 
+from scipy import signal as ss
+
 from veles.simd_tpu.ops import spectral as sp
 
 RNG = np.random.RandomState(17)
@@ -202,3 +204,93 @@ def test_frame_count():
     assert sp.frame_count(1024, 256, 128) == 7
     assert sp.frame_count(255, 256, 128) == 0
     assert sp.frame_count(256, 256, 128) == 1
+
+
+class TestSpectralEstimation:
+    """periodogram/Welch/CSD/coherence/detrend vs scipy + oracles."""
+
+    def test_detrend_matches_scipy(self):
+        x = RNG.randn(3, 500)
+        for t in ("linear", "constant"):
+            got = np.asarray(sp.detrend(x.astype(np.float32), t,
+                                        simd=True))
+            want = ss.detrend(x, type=t, axis=-1)
+            np.testing.assert_allclose(got, want, atol=2e-5)
+            np.testing.assert_allclose(sp.detrend_na(x, t), want,
+                                       atol=1e-10)
+        with pytest.raises(ValueError, match="type"):
+            sp.detrend(x.astype(np.float32), "quadratic")
+
+    def test_welch_matches_scipy(self):
+        x = RNG.randn(4096)
+        for kw in ({}, {"noverlap": 0}, {"scaling": "spectrum"},
+                   {"nperseg": 500}, {"fs": 48000.0}):
+            f1, p1 = sp.welch(x.astype(np.float32), simd=True, **kw)
+            f2, p2 = ss.welch(x, **kw)
+            np.testing.assert_allclose(f1, f2, atol=1e-9)
+            np.testing.assert_allclose(np.asarray(p1), p2,
+                                       atol=1e-5 * p2.max())
+
+    def test_welch_oracle_exact(self):
+        x = RNG.randn(2048)
+        f1, p1 = sp.welch_na(x, nperseg=256)
+        f2, p2 = ss.welch(x, nperseg=256)
+        np.testing.assert_allclose(p1, p2, rtol=1e-12)
+
+    def test_welch_tone_peak(self):
+        """A pure tone's PSD peaks at its frequency bin and the peak
+        carries (almost) all the power."""
+        fs, f0, n = 1000.0, 125.0, 8192
+        t = np.arange(n) / fs
+        x = np.sin(2 * np.pi * f0 * t).astype(np.float32)
+        f, p = sp.welch(x, fs=fs, nperseg=512, simd=True)
+        p = np.asarray(p)
+        assert abs(f[np.argmax(p)] - f0) < fs / 512
+        assert p.max() / np.median(p) > 1e4
+
+    def test_periodogram_matches_scipy(self):
+        x = RNG.randn(1024)
+        f1, p1 = sp.periodogram(x.astype(np.float32), fs=2.0, simd=True)
+        f2, p2 = ss.periodogram(x, fs=2.0)
+        np.testing.assert_allclose(np.asarray(p1), p2,
+                                   atol=1e-5 * p2.max())
+        f1, p1 = sp.periodogram_na(x, fs=2.0)
+        # atol floors the detrended DC bin (~1e-31 here vs scipy's 0)
+        np.testing.assert_allclose(p1, p2, rtol=1e-10, atol=1e-20)
+
+    def test_csd_matches_scipy(self):
+        x, y = RNG.randn(2, 4096)
+        f1, p1 = sp.csd(x.astype(np.float32), y.astype(np.float32),
+                        nperseg=256, simd=True)
+        f2, p2 = ss.csd(x, y, nperseg=256)
+        np.testing.assert_allclose(np.asarray(p1), p2,
+                                   atol=1e-5 * np.abs(p2).max())
+        # csd(x, x) == welch(x)
+        _, pxx = sp.csd(x.astype(np.float32), x.astype(np.float32),
+                        nperseg=256, simd=True)
+        _, pw = sp.welch(x.astype(np.float32), nperseg=256, simd=True)
+        np.testing.assert_allclose(np.real(np.asarray(pxx)),
+                                   np.asarray(pw), atol=1e-6)
+
+    def test_coherence_properties(self):
+        """Coherence of y = filtered(x) + noise: ~1 in the passband of
+        the relation, < 1 where noise dominates; always in [0, 1]."""
+        x = RNG.randn(1 << 14)
+        y = np.convolve(x, np.ones(5) / 5, mode="same") \
+            + 0.01 * RNG.randn(len(x))
+        f, c = sp.coherence(x.astype(np.float32), y.astype(np.float32),
+                            nperseg=256, simd=True)
+        c = np.asarray(c)
+        assert np.all(c >= 0) and np.all(c <= 1 + 1e-5)
+        assert c[1:20].min() > 0.99          # linearly related band
+        f2, c2 = ss.coherence(x, y, nperseg=256)
+        np.testing.assert_allclose(c, c2, atol=1e-4)
+
+    def test_contracts(self):
+        x = np.zeros(512, np.float32)
+        with pytest.raises(ValueError, match="noverlap"):
+            sp.welch(x, nperseg=128, noverlap=128)
+        with pytest.raises(ValueError, match="scaling"):
+            sp.welch(x, nperseg=128, scaling="power")
+        with pytest.raises(ValueError, match="lengths"):
+            sp.csd(x, np.zeros(100, np.float32))

@@ -252,6 +252,14 @@ def _check_spectral(rng):
     errs.append(_rel_err(
         sp.morlet_cwt(x, [4.0, 16.0, 64.0], simd=True),
         sp.morlet_cwt_na(x, [4.0, 16.0, 64.0])))
+    # PSD estimation layer (Welch / CSD / coherence / detrend)
+    errs.append(_rel_err(sp.detrend(x, "linear", simd=True),
+                         sp.detrend_na(x, "linear")))
+    errs.append(_rel_err(sp.welch(x, nperseg=256, simd=True)[1],
+                         sp.welch_na(x, nperseg=256)[1]))
+    errs.append(_rel_err(
+        sp.csd(x, x[::-1], nperseg=256, simd=True)[1],
+        sp.csd_na(x, x[::-1], nperseg=256)[1]))
     return max(errs), 1e-4
 
 

@@ -359,6 +359,48 @@ def morlet_cwt(simd, x, length, scales, n_scales, w0, result):
     return 0
 
 
+def spectral_detrend(simd, x, length, kind, result):
+    _f32(result, length)[...] = np.asarray(
+        _sp.detrend(_f32(x, length), {0: "linear", 1: "constant"}[int(kind)],
+                    simd=bool(simd)))
+    return 0
+
+
+def spectral_welch(simd, x, length, fs, nperseg, noverlap, freqs, psd):
+    nov = None if int(noverlap) < 0 else int(noverlap)
+    f, p = _sp.welch(_f32(x, length), fs=float(fs),
+                     nperseg=int(nperseg), noverlap=nov,
+                     simd=bool(simd))
+    _f64(freqs, len(f))[...] = f
+    _f32(psd, len(f))[...] = np.asarray(p)
+    return 0
+
+
+def spectral_periodogram(simd, x, length, fs, freqs, psd):
+    f, p = _sp.periodogram(_f32(x, length), fs=float(fs),
+                           simd=bool(simd))
+    _f64(freqs, len(f))[...] = f
+    _f32(psd, len(f))[...] = np.asarray(p)
+    return 0
+
+
+def spectral_csd(simd, x, y, length, fs, nperseg, noverlap, freqs, pxy):
+    nov = None if int(noverlap) < 0 else int(noverlap)
+    f, p = _sp.csd(_f32(x, length), _f32(y, length), fs=float(fs),
+                   nperseg=int(nperseg), noverlap=nov, simd=bool(simd))
+    _f64(freqs, len(f))[...] = f
+    _cplx_out(pxy, p, len(f))
+    return 0
+
+
+def spectral_coherence(simd, x, y, length, fs, nperseg, freqs, coh):
+    f, c = _sp.coherence(_f32(x, length), _f32(y, length), fs=float(fs),
+                         nperseg=int(nperseg), simd=bool(simd))
+    _f64(freqs, len(f))[...] = f
+    _f32(coh, len(f))[...] = np.asarray(c)
+    return 0
+
+
 # ---- resample -------------------------------------------------------------
 
 def resample_poly(simd, x, length, up, down, taps, num_taps, result):

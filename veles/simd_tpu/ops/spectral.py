@@ -43,6 +43,8 @@ __all__ = [
     "stft", "stft_na", "istft", "istft_na", "spectrogram",
     "spectrogram_na", "hilbert", "hilbert_na", "envelope", "envelope_na",
     "morlet_cwt", "morlet_cwt_na", "hann_window", "frame_count",
+    "detrend", "detrend_na", "welch", "welch_na", "periodogram",
+    "periodogram_na", "csd", "csd_na", "coherence", "coherence_na",
 ]
 
 
@@ -304,3 +306,226 @@ def morlet_cwt_na(x, scales, w0: float = 6.0):
     hat = _morlet_hat(scales, x.shape[-1], w0)
     spec = np.fft.fft(x, axis=-1)
     return np.fft.ifft(spec[..., None, :] * hat, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# spectral estimation (periodogram / Welch / CSD / coherence)
+# ---------------------------------------------------------------------------
+
+
+def detrend(x, type: str = "linear", simd=None):  # noqa: A002
+    """Remove a constant or least-squares linear trend along the last
+    axis (scipy's ``detrend``).  The linear projection is a host-side
+    closed form (2-column Vandermonde pseudo-inverse), applied as one
+    matmul on device."""
+    if type not in ("linear", "constant"):
+        raise ValueError(f"type must be 'linear' or 'constant', "
+                         f"got {type!r}")
+    n = np.shape(x)[-1]
+    if resolve_simd(simd):
+        xj = jnp.asarray(x, jnp.float32)
+        if type == "constant":
+            return xj - jnp.mean(xj, axis=-1, keepdims=True)
+        # rank-2 LSQ fit: O(n) via the [2, n] pseudo-inverse, never the
+        # [n, n] projector (a 1M-point signal would need 4 TB for it)
+        a = np.c_[np.arange(n, dtype=np.float64), np.ones(n)]
+        pinva = jnp.asarray(np.linalg.pinv(a), jnp.float32)   # [2, n]
+        aj = jnp.asarray(a, jnp.float32)                       # [n, 2]
+        coef = jnp.einsum("cn,...n->...c", pinva, xj,
+                          precision=jax.lax.Precision.HIGHEST)
+        return xj - jnp.einsum("nc,...c->...n", aj, coef,
+                               precision=jax.lax.Precision.HIGHEST)
+    return detrend_na(x, type).astype(np.float32)
+
+
+def detrend_na(x, type: str = "linear"):  # noqa: A002
+    """NumPy float64 oracle twin of :func:`detrend`."""
+    x = np.asarray(x, np.float64)
+    if type == "constant":
+        return x - x.mean(axis=-1, keepdims=True)
+    if type != "linear":
+        raise ValueError(f"type must be 'linear' or 'constant', "
+                         f"got {type!r}")
+    n = x.shape[-1]
+    a = np.c_[np.arange(n, dtype=np.float64), np.ones(n)]
+    coef = np.einsum("ck,...k->...c", np.linalg.pinv(a), x)
+    return x - np.einsum("nc,...c->...n", a, coef)
+
+
+def _welch_args(n, nperseg, noverlap, window):
+    nperseg = int(min(nperseg, n))
+    if noverlap is None:
+        noverlap = nperseg // 2
+    noverlap = int(noverlap)
+    if not 0 <= noverlap < nperseg:
+        raise ValueError(f"noverlap {noverlap} must be in [0, nperseg "
+                         f"= {nperseg})")
+    if window is None:
+        window = hann_window(nperseg, np.float64)
+    window = np.asarray(window, np.float64)
+    if window.shape != (nperseg,):
+        raise ValueError(f"window shape {window.shape} != ({nperseg},)")
+    return nperseg, nperseg - noverlap, window
+
+
+def _segment_ffts(x, y, fs, nperseg, noverlap, window, detrend_type,
+                  scaling, simd):
+    """Segment + detrend + window + rfft both inputs ONCE; returns
+    ``(freqs, fx, fy, scale_mult)`` with ``fy is fx`` when ``y is x``
+    and ``scale_mult`` the combined density/one-sided factor per bin."""
+    n = np.shape(x)[-1]
+    if np.shape(y)[-1] != n:
+        raise ValueError("x and y lengths differ")
+    nperseg, hop, window = _welch_args(n, nperseg, noverlap, window)
+    if scaling == "density":
+        scale = 1.0 / (fs * np.sum(window ** 2))
+    elif scaling == "spectrum":
+        scale = 1.0 / np.sum(window) ** 2
+    else:
+        raise ValueError(f"scaling must be 'density' or 'spectrum', "
+                         f"got {scaling!r}")
+    freqs = np.fft.rfftfreq(nperseg, 1.0 / fs)
+    # one-sided doubling (real input): every bin except DC (and Nyquist
+    # when nperseg is even)
+    mult = np.full(nperseg // 2 + 1, 2.0)
+    mult[0] = 1.0
+    if nperseg % 2 == 0:
+        mult[-1] = 1.0
+    scale_mult = mult * scale
+
+    def segments(v, xp):
+        idx = _frame_indices(n, nperseg, hop)
+        segs = (jnp.take(v, jnp.asarray(idx), axis=-1) if xp is jnp
+                else v[..., idx])
+        if detrend_type is not None:
+            segs = (detrend(segs, detrend_type, simd=True) if xp is jnp
+                    else detrend_na(segs, detrend_type))
+        return segs * (xp.asarray(window, jnp.float32) if xp is jnp
+                       else window)
+
+    if simd:
+        fx = jnp.fft.rfft(segments(jnp.asarray(x, jnp.float32), jnp),
+                          axis=-1)
+        fy = fx if y is x else jnp.fft.rfft(
+            segments(jnp.asarray(y, jnp.float32), jnp), axis=-1)
+        return freqs, fx, fy, jnp.asarray(scale_mult, jnp.float32)
+    fx = np.fft.rfft(segments(np.asarray(x, np.float64), np), axis=-1)
+    fy = fx if y is x else np.fft.rfft(
+        segments(np.asarray(y, np.float64), np), axis=-1)
+    return freqs, fx, fy, scale_mult
+
+
+def _spectral_helper(x, y, fs, nperseg, noverlap, window, detrend_type,
+                     scaling, simd):
+    """Shared segment-average machinery for welch/csd (scipy's
+    ``_spectral_helper`` shape, rebuilt on the framing gather)."""
+    freqs, fx, fy, scale_mult = _segment_ffts(
+        x, y, fs, nperseg, noverlap, window, detrend_type, scaling, simd)
+    xp = jnp if simd else np
+    return freqs, xp.mean(xp.conj(fx) * fy, axis=-2) * scale_mult
+
+
+def welch(x, fs: float = 1.0, nperseg: int = 256, noverlap=None,
+          window=None, detrend_type: str = "constant",
+          scaling: str = "density", simd=None):
+    """Welch power-spectral-density estimate (scipy's ``welch``).
+
+    Segment (Hann window, 50% overlap by default), detrend each
+    segment, average one-sided periodograms.  Returns ``(freqs, Pxx)``
+    with ``Pxx`` real f32 ``[..., nperseg // 2 + 1]``; ``freqs`` is a
+    host-side float64 array.  The segment pipeline is the same framing
+    gather + batched rfft as :func:`stft`.
+    """
+    use = resolve_simd(simd)
+    f, p = _spectral_helper(x, x, float(fs), nperseg, noverlap, window,
+                            detrend_type, scaling, use)
+    if use:
+        return f, jnp.real(p).astype(jnp.float32)
+    return f, np.real(p)
+
+
+def welch_na(x, fs: float = 1.0, nperseg: int = 256, noverlap=None,
+             window=None, detrend_type: str = "constant",
+             scaling: str = "density"):
+    """NumPy float64 oracle twin of :func:`welch`."""
+    f, p = _spectral_helper(x, x, float(fs), nperseg, noverlap, window,
+                            detrend_type, scaling, False)
+    return f, np.real(p)
+
+
+def periodogram(x, fs: float = 1.0, window=None, scaling: str = "density",
+                detrend_type: str = "constant", simd=None):
+    """Single-segment PSD (scipy's ``periodogram``: boxcar window,
+    constant detrend by default).  Pass ``detrend_type=None`` to keep
+    the raw DC bin."""
+    n = np.shape(x)[-1]
+    if window is None:
+        window = np.ones(n, np.float64)
+    use = resolve_simd(simd)
+    f, p = _spectral_helper(x, x, float(fs), n, 0, window, detrend_type,
+                            scaling, use)
+    if use:
+        return f, jnp.real(p).astype(jnp.float32)
+    return f, np.real(p)
+
+
+def periodogram_na(x, fs: float = 1.0, window=None,
+                   scaling: str = "density",
+                   detrend_type: str = "constant"):
+    n = np.shape(x)[-1]
+    if window is None:
+        window = np.ones(n, np.float64)
+    f, p = _spectral_helper(x, x, float(fs), n, 0, window, detrend_type,
+                            scaling, False)
+    return f, np.real(p)
+
+
+def csd(x, y, fs: float = 1.0, nperseg: int = 256, noverlap=None,
+        window=None, detrend_type: str = "constant",
+        scaling: str = "density", simd=None):
+    """Cross-spectral density ``Pxy`` (scipy's ``csd``): complex64
+    ``[..., bins]``."""
+    use = resolve_simd(simd)
+    f, p = _spectral_helper(x, y, float(fs), nperseg, noverlap, window,
+                            detrend_type, scaling, use)
+    if use:
+        return f, p.astype(jnp.complex64)
+    return f, p
+
+
+def csd_na(x, y, fs: float = 1.0, nperseg: int = 256, noverlap=None,
+           window=None, detrend_type: str = "constant",
+           scaling: str = "density"):
+    f, p = _spectral_helper(x, y, float(fs), nperseg, noverlap, window,
+                            detrend_type, scaling, False)
+    return f, p
+
+
+def _coherence_impl(x, y, fs, nperseg, noverlap, window, simd):
+    """Pxx/Pyy/Pxy from ONE segmentation+rfft of each input (the naive
+    csd+welch+welch composition would run every FFT pipeline twice);
+    the scale factors cancel in the ratio but are kept for clarity."""
+    freqs, fx, fy, scale_mult = _segment_ffts(
+        x, y, float(fs), nperseg, noverlap, window, "constant",
+        "density", simd)
+    xp = jnp if simd else np
+    pxx = xp.mean(xp.abs(fx) ** 2, axis=-2) * scale_mult
+    pyy = xp.mean(xp.abs(fy) ** 2, axis=-2) * scale_mult
+    pxy = xp.mean(xp.conj(fx) * fy, axis=-2) * scale_mult
+    return freqs, xp.abs(pxy) ** 2 / (pxx * pyy)
+
+
+def coherence(x, y, fs: float = 1.0, nperseg: int = 256, noverlap=None,
+              window=None, simd=None):
+    """Magnitude-squared coherence ``|Pxy|^2 / (Pxx Pyy)`` in [0, 1]
+    (scipy's ``coherence``)."""
+    use = resolve_simd(simd)
+    f, coh = _coherence_impl(x, y, fs, nperseg, noverlap, window, use)
+    if use:
+        return f, coh.astype(jnp.float32)
+    return f, coh
+
+
+def coherence_na(x, y, fs: float = 1.0, nperseg: int = 256,
+                 noverlap=None, window=None):
+    return _coherence_impl(x, y, fs, nperseg, noverlap, window, False)
