@@ -540,7 +540,7 @@ static void test_psd(void) {
   }
   /* coherence of two versions of the same tone is ~1 at the tone */
   float coh[SEG / 2 + 1];
-  CHECK(spectral_coherence(1, x, y, N, 2.0, SEG, freqs, coh) == 0);
+  CHECK(spectral_coherence(1, x, y, N, 2.0, SEG, -1, freqs, coh) == 0);
   CHECK(coh[argmax] > 0.99f);
   /* csd peak magnitude matches the welch peak for identical inputs */
   float pxy[2 * (SEG / 2 + 1)];

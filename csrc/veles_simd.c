@@ -674,10 +674,11 @@ int spectral_csd(int simd, const float *x, const float *y, size_t length,
 
 int spectral_coherence(int simd, const float *x, const float *y,
                        size_t length, double fs, size_t nperseg,
-                       double *freqs, float *coh) {
-  return shim_run("spectral_coherence", "(iKKkdkKK)", simd, PTR(x),
+                       long noverlap, double *freqs, float *coh) {
+  return shim_run("spectral_coherence", "(iKKkdklKK)", simd, PTR(x),
                   PTR(y), (unsigned long)length, fs,
-                  (unsigned long)nperseg, PTR(freqs), PTR(coh));
+                  (unsigned long)nperseg, noverlap, PTR(freqs),
+                  PTR(coh));
 }
 
 int resample_poly(int simd, const float *x, size_t length, size_t up,

@@ -295,7 +295,7 @@ int spectral_csd(int simd, const float *x, const float *y, size_t length,
                  float *pxy);
 int spectral_coherence(int simd, const float *x, const float *y,
                        size_t length, double fs, size_t nperseg,
-                       double *freqs, float *coh);
+                       long noverlap, double *freqs, float *coh);
 
 /* ---- resample — no reference analog (rate conversion over the same
  * conv machinery as src/convolve.c; the polyphase cascade runs as one

@@ -393,9 +393,12 @@ def spectral_csd(simd, x, y, length, fs, nperseg, noverlap, freqs, pxy):
     return 0
 
 
-def spectral_coherence(simd, x, y, length, fs, nperseg, freqs, coh):
+def spectral_coherence(simd, x, y, length, fs, nperseg, noverlap, freqs,
+                       coh):
+    nov = None if int(noverlap) < 0 else int(noverlap)
     f, c = _sp.coherence(_f32(x, length), _f32(y, length), fs=float(fs),
-                         nperseg=int(nperseg), simd=bool(simd))
+                         nperseg=int(nperseg), noverlap=nov,
+                         simd=bool(simd))
     _f64(freqs, len(f))[...] = f
     _f32(coh, len(f))[...] = np.asarray(c)
     return 0

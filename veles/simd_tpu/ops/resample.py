@@ -155,7 +155,8 @@ def upsample(x, factor: int, taps=None, simd=None):
 
 
 def decimate(x, factor: int, taps=None, simd=None):
-    """Integer-rate anti-aliased decimation: ``resample_poly(x, 1, factor)``."""
+    """Integer-rate anti-aliased decimation:
+    ``resample_poly(x, 1, factor)``."""
     return resample_poly(x, 1, factor, taps=taps, simd=simd)
 
 

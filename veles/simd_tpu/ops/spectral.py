@@ -422,6 +422,8 @@ def _spectral_helper(x, y, fs, nperseg, noverlap, window, detrend_type,
     freqs, fx, fy, scale_mult = _segment_ffts(
         x, y, fs, nperseg, noverlap, window, detrend_type, scaling, simd)
     xp = jnp if simd else np
+    if fy is fx:  # auto-spectrum: |fx|^2, skip the complex multiply
+        return freqs, xp.mean(xp.abs(fx) ** 2, axis=-2) * scale_mult
     return freqs, xp.mean(xp.conj(fx) * fy, axis=-2) * scale_mult
 
 
@@ -432,16 +434,17 @@ def welch(x, fs: float = 1.0, nperseg: int = 256, noverlap=None,
 
     Segment (Hann window, 50% overlap by default), detrend each
     segment, average one-sided periodograms.  Returns ``(freqs, Pxx)``
-    with ``Pxx`` real f32 ``[..., nperseg // 2 + 1]``; ``freqs`` is a
-    host-side float64 array.  The segment pipeline is the same framing
-    gather + batched rfft as :func:`stft`.
+    with ``Pxx`` real f32 ``[..., min(nperseg, n) // 2 + 1]``
+    (``nperseg`` is clamped to the signal length, scipy-style);
+    ``freqs`` is a host-side float64 array.  The segment pipeline is
+    the same framing gather + batched rfft as :func:`stft`.
     """
     use = resolve_simd(simd)
     f, p = _spectral_helper(x, x, float(fs), nperseg, noverlap, window,
                             detrend_type, scaling, use)
     if use:
         return f, jnp.real(p).astype(jnp.float32)
-    return f, np.real(p)
+    return f, np.real(p).astype(np.float32)
 
 
 def welch_na(x, fs: float = 1.0, nperseg: int = 256, noverlap=None,
@@ -466,7 +469,7 @@ def periodogram(x, fs: float = 1.0, window=None, scaling: str = "density",
                             scaling, use)
     if use:
         return f, jnp.real(p).astype(jnp.float32)
-    return f, np.real(p)
+    return f, np.real(p).astype(np.float32)
 
 
 def periodogram_na(x, fs: float = 1.0, window=None,
@@ -490,7 +493,7 @@ def csd(x, y, fs: float = 1.0, nperseg: int = 256, noverlap=None,
                             detrend_type, scaling, use)
     if use:
         return f, p.astype(jnp.complex64)
-    return f, p
+    return f, p.astype(np.complex64)
 
 
 def csd_na(x, y, fs: float = 1.0, nperseg: int = 256, noverlap=None,
@@ -523,7 +526,7 @@ def coherence(x, y, fs: float = 1.0, nperseg: int = 256, noverlap=None,
     f, coh = _coherence_impl(x, y, fs, nperseg, noverlap, window, use)
     if use:
         return f, coh.astype(jnp.float32)
-    return f, coh
+    return f, coh.astype(np.float32)
 
 
 def coherence_na(x, y, fs: float = 1.0, nperseg: int = 256,
