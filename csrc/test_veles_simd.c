@@ -615,6 +615,34 @@ static void test_iir(void) {
   }
   CHECK(iir_sosfiltfilt(1, &sos[0][0], 2, x, N, (long)N, y) != 0);
 
+  /* Chebyshev designs: section counts + a lowpass actually passes DC */
+  CHECK(iir_cheby1(4, 1.0, 0.25, 0.0, VELES_IIR_LOWPASS, NULL) == 2);
+  CHECK(iir_cheby2(3, 30.0, 0.2, 0.5, VELES_IIR_BANDPASS, NULL) == 3);
+  double csos[2][6];
+  CHECK(iir_cheby2(4, 35.0, 0.3, 0.0, VELES_IIR_LOWPASS, &csos[0][0])
+        == 2);
+  for (int i = 0; i < N; i++) {
+    x[i] = 1.0f;
+  }
+  CHECK(iir_sosfilt(1, &csos[0][0], 2, x, N, NULL, y) == 0);
+  CHECK_NEAR(y[N - 1], 1.0, 1e-3);
+  CHECK(iir_cheby1(3, 0.0, 0.25, 0.0, VELES_IIR_LOWPASS, NULL) < 0);
+
+  /* streaming: two blocks == one shot */
+  for (int i = 0; i < N; i++) {
+    x[i] = sinf(0.37f * (float)i);
+  }
+  CHECK(iir_sosfilt(1, &sos[0][0], 2, x, N, NULL, y) == 0);
+  double zst[2][2] = {{0, 0}, {0, 0}};
+  float ystream[N];
+  CHECK(iir_sosfilt_stream(1, &sos[0][0], 2, x, N / 2, &zst[0][0],
+                           ystream) == 0);
+  CHECK(iir_sosfilt_stream(1, &sos[0][0], 2, x + N / 2, N / 2,
+                           &zst[0][0], ystream + N / 2) == 0);
+  for (int i = 0; i < N; i += 11) {
+    CHECK_NEAR(ystream[i], y[i], 1e-4);
+  }
+
   /* lfilter matches its oracle; FIR-only denominator works */
   double b[3] = {0.2, 0.3, 0.1};
   double a[3] = {1.0, -0.4, 0.1};

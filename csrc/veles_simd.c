@@ -709,6 +709,36 @@ int iir_butterworth(size_t order, double low, double high,
   return (int)sections;
 }
 
+int iir_cheby1(size_t order, double rp, double low, double high,
+               VelesIirBandType btype, double *sos) {
+  long sections = -1;
+  if (shim_call_parse("iir_cheby1", parse_long, &sections, "(kdddiK)",
+                      (unsigned long)order, rp, low, high, (int)btype,
+                      PTR(sos)) != 0) {
+    return -1;
+  }
+  return (int)sections;
+}
+
+int iir_cheby2(size_t order, double rs, double low, double high,
+               VelesIirBandType btype, double *sos) {
+  long sections = -1;
+  if (shim_call_parse("iir_cheby2", parse_long, &sections, "(kdddiK)",
+                      (unsigned long)order, rs, low, high, (int)btype,
+                      PTR(sos)) != 0) {
+    return -1;
+  }
+  return (int)sections;
+}
+
+int iir_sosfilt_stream(int simd, const double *sos, size_t n_sections,
+                       const float *x, size_t length, double *zi_inout,
+                       float *result) {
+  return shim_run("iir_sosfilt_stream", "(iKkKkKK)", simd, PTR(sos),
+                  (unsigned long)n_sections, PTR(x),
+                  (unsigned long)length, PTR(zi_inout), PTR(result));
+}
+
 int iir_sosfilt(int simd, const double *sos, size_t n_sections,
                 const float *x, size_t length, const double *zi,
                 float *result) {

@@ -331,6 +331,19 @@ typedef enum {
  * sos = NULL first to size the buffer); negative on error. */
 int iir_butterworth(size_t order, double low, double high,
                     VelesIirBandType btype, double *sos);
+/* Chebyshev type-I (rp dB passband ripple) / type-II (rs dB stopband
+ * attenuation) designs; same calling convention as iir_butterworth. */
+int iir_cheby1(size_t order, double rp, double low, double high,
+               VelesIirBandType btype, double *sos);
+int iir_cheby2(size_t order, double rs, double low, double high,
+               VelesIirBandType btype, double *sos);
+/* Streaming block filter: zi_inout ([n_sections][2] float64 DF2T
+ * states, zeros to start) is read as the incoming state and
+ * overwritten with the exit state, so consecutive calls concatenate
+ * to the one-shot result within f32 round-off (length >= 2). */
+int iir_sosfilt_stream(int simd, const double *sos, size_t n_sections,
+                       const float *x, size_t length, double *zi_inout,
+                       float *result);
 /* Second-order-section cascade filter.  zi: per-section DF2T initial
  * states [n_sections][2] float64, or NULL for zero.  result: length
  * floats (in-place x == result is NOT supported). */

@@ -222,3 +222,100 @@ class TestLongSignalEquivalence:
         want = ss.sosfilt(sos, x.astype(np.float64))
         scale = np.max(np.abs(want))
         np.testing.assert_allclose(got, want, atol=5e-5 * scale)
+
+
+class TestChebyshev:
+    C1 = [(2, 1.0, 0.3, "lowpass"), (4, 0.5, 0.25, "lowpass"),
+          (5, 3.0, 0.4, "highpass"), (3, 1.0, (0.2, 0.5), "bandpass"),
+          (4, 2.0, (0.3, 0.6), "bandstop"), (1, 1.0, 0.3, "lowpass")]
+    C2 = [(2, 30.0, 0.3, "lowpass"), (4, 40.0, 0.25, "lowpass"),
+          (5, 35.0, 0.4, "highpass"), (3, 30.0, (0.2, 0.5), "bandpass"),
+          (4, 45.0, (0.3, 0.6), "bandstop"), (1, 20.0, 0.3, "lowpass")]
+
+    @pytest.mark.parametrize("order,rp,wn,bt", C1)
+    def test_cheby1_matches_scipy(self, order, rp, wn, bt):
+        _, h1 = iir.sos_frequency_response(iir.cheby1(order, rp, wn, bt),
+                                           128)
+        _, h2 = ss.sosfreqz(ss.cheby1(order, rp, wn, bt, output="sos"),
+                            worN=128)
+        np.testing.assert_allclose(h1, h2, atol=1e-10)
+
+    @pytest.mark.parametrize("order,rs,wn,bt", C2)
+    def test_cheby2_matches_scipy(self, order, rs, wn, bt):
+        _, h1 = iir.sos_frequency_response(iir.cheby2(order, rs, wn, bt),
+                                           128)
+        _, h2 = ss.sosfreqz(ss.cheby2(order, rs, wn, bt, output="sos"),
+                            worN=128)
+        np.testing.assert_allclose(h1, h2, atol=1e-10)
+
+    def test_ripple_properties(self):
+        """cheby1 passband ripple stays within rp dB; cheby2 stopband
+        stays rs dB down."""
+        sos = iir.cheby1(5, 1.0, 0.5)
+        w, h = iir.sos_frequency_response(sos, 4096)
+        pb = np.abs(h[w < 0.49])
+        assert pb.max() < 1.0 + 1e-6
+        assert pb.min() > 10 ** (-1.0 / 20) - 1e-6
+        sos2 = iir.cheby2(5, 40.0, 0.5)
+        _, h2 = iir.sos_frequency_response(sos2, 4096)
+        sb = np.abs(h2[w > 0.51])
+        assert sb.max() < 10 ** (-40.0 / 20) + 1e-4
+
+    def test_runs_through_sosfilt(self):
+        x = RNG.randn(2, 300).astype(np.float32)
+        for sos in (iir.cheby1(4, 1.0, 0.3),
+                    iir.cheby2(4, 35.0, 0.3)):
+            got = np.asarray(iir.sosfilt(sos, x, simd=True))
+            want = ss.sosfilt(sos, x.astype(np.float64), axis=-1)
+            np.testing.assert_allclose(got, want, atol=2e-5)
+
+    def test_contracts(self):
+        with pytest.raises(ValueError, match="rp"):
+            iir.cheby1(3, 0.0, 0.3)
+        with pytest.raises(ValueError, match="rs"):
+            iir.cheby2(3, -5.0, 0.3)
+
+
+class TestStreaming:
+    def test_concatenated_chunks_equal_one_shot(self):
+        sos = iir.butterworth(4, 0.2, "lowpass")
+        x = RNG.randn(1024).astype(np.float32)
+        st = iir.StreamingSosfilt(sos)
+        ys = [np.asarray(st.process(c)) for c in x.reshape(8, 128)]
+        got = np.concatenate(ys)
+        want = np.asarray(iir.sosfilt(sos, x, simd=True))
+        np.testing.assert_allclose(got, want, atol=2e-5)
+
+    def test_ragged_chunks_and_reset(self):
+        sos = iir.cheby1(3, 1.0, 0.35)
+        x = RNG.randn(500).astype(np.float32)
+        st = iir.StreamingSosfilt(sos)
+        cuts = [0, 100, 150, 400, 500]
+        got = np.concatenate([
+            np.asarray(st.process(x[a:b]))
+            for a, b in zip(cuts[:-1], cuts[1:])])
+        want = ss.sosfilt(sos, x.astype(np.float64))
+        np.testing.assert_allclose(got, want, atol=2e-5)
+        st.reset()
+        again = np.asarray(st.process(x[:100]))
+        np.testing.assert_allclose(again, want[:100], atol=2e-5)
+
+    def test_zf_matches_scipy(self):
+        """return_zf's exit state equals scipy's sosfilt zf."""
+        sos = iir.butterworth(3, 0.3, "lowpass")
+        x = RNG.randn(64)
+        zi = RNG.randn(len(sos), 2)
+        want_y, want_zf = ss.sosfilt(sos, x, zi=zi)
+        got_y, got_zf = iir.sosfilt(sos, x.astype(np.float32),
+                                    zi=zi.astype(np.float32),
+                                    simd=True, return_zf=True)
+        np.testing.assert_allclose(np.asarray(got_y), want_y, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(got_zf), want_zf,
+                                   atol=2e-5)
+        ony, onzf = iir.sosfilt_na(sos, x, zi=zi, return_zf=True)
+        np.testing.assert_allclose(onzf, want_zf, atol=1e-12)
+
+    def test_short_block_contract(self):
+        sos = iir.butterworth(2, 0.3)
+        with pytest.raises(ValueError, match="2 samples"):
+            iir.sosfilt(sos, np.zeros(1, np.float32), return_zf=True)

@@ -443,6 +443,39 @@ def iir_butterworth(order, low, high, btype, sos_out):
     return len(sos)
 
 
+def iir_cheby1(order, rp, low, high, btype, sos_out):
+    bt = _C_BTYPES[int(btype)]
+    cutoff = float(low) if bt in ("lowpass", "highpass") \
+        else (float(low), float(high))
+    sos = _iir.cheby1(int(order), float(rp), cutoff, bt)
+    if int(sos_out) != 0:
+        _f64(sos_out, len(sos), 6)[...] = sos
+    return len(sos)
+
+
+def iir_cheby2(order, rs, low, high, btype, sos_out):
+    bt = _C_BTYPES[int(btype)]
+    cutoff = float(low) if bt in ("lowpass", "highpass") \
+        else (float(low), float(high))
+    sos = _iir.cheby2(int(order), float(rs), cutoff, bt)
+    if int(sos_out) != 0:
+        _f64(sos_out, len(sos), 6)[...] = sos
+    return len(sos)
+
+
+def iir_sosfilt_stream(simd, sos, n_sections, x, length, zi_inout,
+                       result):
+    """One streaming block: filters with the caller's state and writes
+    the exit state back into the same buffer."""
+    s = _f64(sos, n_sections, 6)
+    z = _f64(zi_inout, n_sections, 2)
+    out, zf = _iir.sosfilt(s, _f32(x, length), zi=z.copy(),
+                           simd=bool(simd), return_zf=True)
+    _f32(result, length)[...] = np.asarray(out)
+    z[...] = np.asarray(zf, np.float64)
+    return 0
+
+
 def iir_sosfilt(simd, sos, n_sections, x, length, zi, result):
     s = _f64(sos, n_sections, 6)
     z = None if int(zi) == 0 else _f64(zi, n_sections, 2)

@@ -47,9 +47,10 @@ import numpy as np
 from veles.simd_tpu.utils.config import resolve_simd
 
 __all__ = [
-    "butterworth", "sosfilt", "sosfilt_na", "sosfiltfilt",
-    "sosfiltfilt_na", "lfilter", "lfilter_na", "sos_frequency_response",
-    "frequency_response", "sosfilt_zi",
+    "butterworth", "cheby1", "cheby2", "sosfilt", "sosfilt_na",
+    "sosfiltfilt", "sosfiltfilt_na", "lfilter", "lfilter_na",
+    "sos_frequency_response", "frequency_response", "sosfilt_zi",
+    "StreamingSosfilt",
 ]
 
 
@@ -150,25 +151,43 @@ def butterworth(order: int, cutoff, btype: str = "lowpass") -> np.ndarray:
     :func:`sosfilt`.  Matches ``scipy.signal.butter(..., output='sos')``
     up to section pairing (same transfer function).
     """
+    p = _butter_analog_poles(_check_order(order))
+    k = float(np.real(np.prod(-p)))  # unit DC gain prototype (= 1 here)
+    return _prototype_to_digital_sos(np.array([], complex), p, k, cutoff,
+                                     btype)
+
+
+def _check_order(order) -> int:
     order = int(order)
     if order < 1:
         raise ValueError("order must be >= 1")
+    return order
+
+
+def _prototype_to_digital_sos(z, p, k, cutoff, btype) -> np.ndarray:
+    """Analog lowpass prototype (zpk, edge at 1 rad/s) -> digital SOS:
+    pre-warp, general lp2lp/hp/bp/bs zpk transform (finite zeros
+    supported — Chebyshev II needs them), bilinear, pair."""
     btype = btype.lower()
     fs = 2.0  # Nyquist = 1, scipy's normalized convention
-    p = _butter_analog_poles(order)
-    z = np.array([], complex)
+    z = np.asarray(z, complex)
+    p = np.asarray(p, complex)
+    degree = len(p) - len(z)
+    if degree < 0:
+        raise ValueError("prototype has more zeros than poles")
     if btype in ("lowpass", "highpass"):
         wn = float(np.squeeze(cutoff))
         if not 0.0 < wn < 1.0:
             raise ValueError(f"cutoff {wn} must be in (0, 1)")
-        warped = 2 * fs * math.tan(math.pi * wn / fs)
-        if btype == "lowpass":
-            p = warped * p
-            k = warped ** order
-        else:  # lp2hp: s -> warped / s
-            p = warped / p
-            k = 1.0  # prototype gain relocates to the zeros at 0
-            z = np.zeros(order, complex)
+        wo = 2 * fs * math.tan(math.pi * wn / fs)
+        if btype == "lowpass":      # s -> s / wo
+            z, p = z * wo, p * wo
+            k = k * wo ** degree
+        else:                        # lp2hp: s -> wo / s
+            zp, pp = z, p            # (prod of an empty array is 1.0)
+            z = np.append(wo / zp, np.zeros(degree))
+            p = wo / pp
+            k = k * np.real(np.prod(-zp) / np.prod(-pp))
     elif btype in ("bandpass", "bandstop"):
         lo, hi = (float(c) for c in np.ravel(cutoff))
         if not 0.0 < lo < hi < 1.0:
@@ -176,24 +195,80 @@ def butterworth(order: int, cutoff, btype: str = "lowpass") -> np.ndarray:
                              "0 < low < high < 1")
         w1 = 2 * fs * math.tan(math.pi * lo / fs)
         w2 = 2 * fs * math.tan(math.pi * hi / fs)
-        bw, w0 = w2 - w1, math.sqrt(w1 * w2)
-        if btype == "bandpass":   # lp2bp: s -> (s^2 + w0^2) / (bw s)
-            ps = p * bw / 2
-            p = np.concatenate([ps + np.sqrt(ps ** 2 - w0 ** 2),
-                                ps - np.sqrt(ps ** 2 - w0 ** 2)])
-            z = np.zeros(order, complex)
-            k = bw ** order
-        else:                      # lp2bs: s -> (bw s) / (s^2 + w0^2)
-            ps = (bw / 2) / p
-            p = np.concatenate([ps + np.sqrt(ps ** 2 - w0 ** 2),
-                                ps - np.sqrt(ps ** 2 - w0 ** 2)])
-            z = np.concatenate([1j * w0 * np.ones(order),
-                                -1j * w0 * np.ones(order)])
-            k = 1.0
+        bw, wo = w2 - w1, math.sqrt(w1 * w2)
+
+        def _split(r, scale_first):
+            rs = (r * bw / 2) if scale_first else ((bw / 2) / r)
+            return np.concatenate([rs + np.sqrt(rs ** 2 - wo ** 2),
+                                   rs - np.sqrt(rs ** 2 - wo ** 2)])
+
+        if btype == "bandpass":     # s -> (s^2 + wo^2) / (bw s)
+            z = np.append(_split(z, True), np.zeros(degree))
+            p = _split(p, True)
+            k = k * bw ** degree
+        else:                        # lp2bs: s -> (bw s) / (s^2 + wo^2)
+            zp, pp = z, p
+            z = np.append(_split(zp, False),
+                          np.concatenate([1j * wo * np.ones(degree),
+                                          -1j * wo * np.ones(degree)]))
+            p = _split(pp, False)
+            k = k * np.real(np.prod(-zp) / np.prod(-pp))
     else:
         raise ValueError(f"unknown btype {btype!r}")
     zd, pd, kd = _bilinear_zpk(z, p, k, fs)
     return _zpk_to_sos(zd, pd, kd)
+
+
+def cheby1(order: int, rp: float, cutoff,
+           btype: str = "lowpass") -> np.ndarray:
+    """Chebyshev type-I digital filter as second-order sections
+    (scipy's ``cheby1(..., output='sos')``): equiripple passband
+    (``rp`` dB of ripple), monotone stopband, sharper rolloff than
+    Butterworth at the same order.  ``cutoff`` marks the END of the
+    ripple band (scipy convention), as a fraction of Nyquist.
+    """
+    order = _check_order(order)
+    rp = float(rp)
+    if rp <= 0:
+        raise ValueError("rp (passband ripple, dB) must be > 0")
+    eps = math.sqrt(10.0 ** (rp / 10.0) - 1.0)
+    mu = math.asinh(1.0 / eps) / order
+    kk = np.arange(1, order + 1)
+    theta = math.pi * (2 * kk - 1) / (2 * order)
+    p = -math.sinh(mu) * np.sin(theta) + 1j * math.cosh(mu) * np.cos(theta)
+    k = np.real(np.prod(-p))
+    if order % 2 == 0:  # even orders dip: DC gain is -rp dB
+        k /= math.sqrt(1.0 + eps ** 2)
+    return _prototype_to_digital_sos(np.array([], complex), p, k, cutoff,
+                                     btype)
+
+
+def cheby2(order: int, rs: float, cutoff,
+           btype: str = "lowpass") -> np.ndarray:
+    """Chebyshev type-II (inverse Chebyshev) digital filter as SOS
+    (scipy's ``cheby2(..., output='sos')``): monotone passband,
+    equiripple stopband ``rs`` dB down.  ``cutoff`` marks the START of
+    the stopband (scipy convention), as a fraction of Nyquist.
+    """
+    order = _check_order(order)
+    rs = float(rs)
+    if rs <= 0:
+        raise ValueError("rs (stopband attenuation, dB) must be > 0")
+    eps = 1.0 / math.sqrt(10.0 ** (rs / 10.0) - 1.0)
+    mu = math.asinh(1.0 / eps) / order
+    kk = np.arange(1, order + 1)
+    theta = math.pi * (2 * kk - 1) / (2 * order)
+    # type-I poles, then invert to move the ripple to the stopband
+    p1 = -math.sinh(mu) * np.sin(theta) \
+        + 1j * math.cosh(mu) * np.cos(theta)
+    p = 1.0 / p1
+    # zeros on the imaginary axis at the ripple frequencies (the odd
+    # order's cos(pi/2) = 0 zero-at-infinity is dropped)
+    ct = np.cos(theta)
+    ct = ct[np.abs(ct) > 1e-12]
+    z = 1j / ct
+    k = np.real(np.prod(-p) / np.prod(-z))
+    return _prototype_to_digital_sos(z, p, k, cutoff, btype)
 
 
 def _check_sos(sos) -> np.ndarray:
@@ -327,23 +402,40 @@ def _biquad_apply(x, b0, b1, b2, a1, a2, s_in=None):
     return states[..., 0]
 
 
-def _sos_scan(x, sos_rows, zi_rows=None):
+def _section_exit_state(b1, b2, a1, a2, x_sec, y_sec, xp):
+    """DF2T exit state of one section from its last 2 in/out samples:
+    ``z2 = b2 x[-1] - a2 y[-1]``,
+    ``z1 = b1 x[-1] - a1 y[-1] + b2 x[-2] - a2 y[-2]``
+    (valid for n >= 2 regardless of the incoming state)."""
+    z2 = b2 * x_sec[..., -1] - a2 * y_sec[..., -1]
+    z1 = (b1 * x_sec[..., -1] - a1 * y_sec[..., -1]
+          + b2 * x_sec[..., -2] - a2 * y_sec[..., -2])
+    return xp.stack([z1, z2], axis=-1)
+
+
+def _sos_scan(x, sos_rows, zi_rows=None, want_zf=False):
+    zf = []
     for i, (b0, b1, b2, _, a1, a2) in enumerate(sos_rows):
         s_in = None if zi_rows is None else zi_rows[i]
-        x = _biquad_apply(x, b0, b1, b2, a1, a2, s_in=s_in)
+        y = _biquad_apply(x, b0, b1, b2, a1, a2, s_in=s_in)
+        if want_zf:
+            zf.append(_section_exit_state(b1, b2, a1, a2, x, y, jnp))
+        x = y
+    if want_zf:
+        return x, jnp.stack(zf, axis=-2)
     return x
 
 
-@functools.partial(jax.jit, static_argnames=("sos_key",))
-def _sosfilt_xla(x, sos_key, zi):
+@functools.partial(jax.jit, static_argnames=("sos_key", "want_zf"))
+def _sosfilt_xla(x, sos_key, zi, want_zf=False):
     sos_rows = np.asarray(sos_key, np.float32)
     # zi may carry leading batch dims: [..., n_sections, 2]
     zi_rows = (None if zi is None
                else [zi[..., i, :] for i in range(len(sos_rows))])
-    return _sos_scan(x, sos_rows, zi_rows)
+    return _sos_scan(x, sos_rows, zi_rows, want_zf)
 
 
-def sosfilt(sos, x, zi=None, simd=None):
+def sosfilt(sos, x, zi=None, simd=None, return_zf=False):
     """Filter ``x[..., n]`` through a cascade of second-order sections.
 
     ``sos`` is ``[n_sections, 6]`` (``[b0 b1 b2 1 a1 a2]`` rows, e.g.
@@ -353,21 +445,33 @@ def sosfilt(sos, x, zi=None, simd=None):
     recurrence runs as an
     O(log n)-depth ``associative_scan`` of 2x2 affine maps — a parallel
     formulation of the serial textbook loop the oracle implements.
+
+    With ``return_zf=True`` also returns the exit states
+    ``[..., n_sections, 2]`` (same DF2T convention) — feed them as the
+    next block's ``zi`` to stream block-by-block (needs ``n >= 2``;
+    see :class:`StreamingSosfilt`).
     """
     sos = _check_sos(sos)
+    if return_zf and np.shape(x)[-1] < 2:
+        raise ValueError("return_zf needs at least 2 samples per block")
     if resolve_simd(simd):
         sos_key = tuple(tuple(float(v) for v in row) for row in sos)
         zi_j = None if zi is None else jnp.asarray(zi, jnp.float32)
-        return _sosfilt_xla(jnp.asarray(x, jnp.float32), sos_key, zi_j)
+        return _sosfilt_xla(jnp.asarray(x, jnp.float32), sos_key, zi_j,
+                            return_zf)
+    if return_zf:
+        y, zf = sosfilt_na(sos, x, zi=zi, return_zf=True)
+        return y.astype(np.float32), zf.astype(np.float32)
     return sosfilt_na(sos, x, zi=zi).astype(np.float32)
 
 
-def sosfilt_na(sos, x, zi=None):
+def sosfilt_na(sos, x, zi=None, return_zf=False):
     """NumPy float64 oracle twin of :func:`sosfilt`: the sequential
     direct-form recurrence, one sample at a time."""
     sos = _check_sos(sos)
     x = np.asarray(x, np.float64)
     y = x.copy()
+    zf = np.zeros(x.shape[:-1] + (len(sos), 2))
     for i, (b0, b1, b2, _, a1, a2) in enumerate(sos):
         xs = y
         ys = np.zeros_like(xs)
@@ -383,8 +487,46 @@ def sosfilt_na(sos, x, zi=None):
             z1 = b1 * xt - a1 * yt + z2
             z2 = b2 * xt - a2 * yt
             ys[..., t] = yt
+        zf[..., i, 0] = z1
+        zf[..., i, 1] = z2
         y = ys
+    if return_zf:
+        return y, zf
     return y
+
+
+class StreamingSosfilt:
+    """Chunked streaming IIR with carried DF2T state.
+
+    The IIR analog of :class:`~veles.simd_tpu.ops.convolve.\
+StreamingConvolution`: chunks arrive one at a time, each section's
+    ``(z1, z2)`` state is carried between calls, and the concatenated
+    outputs match the one-shot cascade to f32 round-off (~1e-7 — the
+    chunk boundary changes the scan's reduction order; no flush needed,
+    an IIR has no lookahead)::
+
+        st = StreamingSosfilt(butterworth(4, 0.25))
+        ys = [st.process(c) for c in chunks]     # len(c) >= 2
+        # np.concatenate(ys) == sosfilt(sos, x)
+
+    Each distinct chunk length compiles once; leading batch dims are
+    allowed and fixed across calls.
+    """
+
+    def __init__(self, sos, zi=None, simd=None):
+        self._sos = _check_sos(sos)
+        self._simd = resolve_simd(simd)
+        self.reset(zi)
+
+    def process(self, chunk):
+        y, zf = sosfilt(self._sos, chunk, zi=self._zi, simd=self._simd,
+                        return_zf=True)
+        self._zi = zf
+        return y
+
+    def reset(self, zi=None):
+        self._zi = (np.zeros((len(self._sos), 2), np.float32)
+                    if zi is None else np.asarray(zi, np.float32))
 
 
 def _odd_ext(x, padlen: int, xp):
