@@ -320,6 +320,40 @@ def _check_filters(rng):
     return max(errs), 1e-3
 
 
+def _check_waveforms(rng):
+    """Generators vs float64 twins (elementwise closed forms)."""
+    from veles.simd_tpu.ops import waveforms as wf
+
+    t = np.linspace(0, 1, 8192)
+    ph = np.linspace(0, 40, 8192)
+    errs = [
+        _rel_err(wf.chirp(t, 20, 1.0, 400, simd=True),
+                 wf.chirp_na(t, 20, 1.0, 400)),
+        _rel_err(wf.gausspulse(t - 0.5, 100, 0.5, simd=True),
+                 wf.gausspulse_na(t - 0.5, 100, 0.5)),
+        # square/sawtooth: f32 phase wrap flips samples that land within
+        # rounding of a cycle boundary — mask those out explicitly and
+        # compare the rest directly
+        _rel_err(np.asarray(wf.square(ph, 0.3, simd=True))[
+                     _away_from_edges(ph, (0.0, 0.3, 1.0))],
+                 wf.square_na(ph, 0.3)[
+                     _away_from_edges(ph, (0.0, 0.3, 1.0))]),
+        _rel_err(np.asarray(wf.sawtooth(ph, 0.5, simd=True))[
+                     _away_from_edges(ph, (0.0, 0.5, 1.0))],
+                 wf.sawtooth_na(ph, 0.5)[
+                     _away_from_edges(ph, (0.0, 0.5, 1.0))]),
+    ]
+    return max(errs), 1e-3
+
+
+def _away_from_edges(ph, edges, eps=1e-3):
+    """Mask of phase samples whose cycle fraction is at least ``eps``
+    away from every discontinuity in ``edges``."""
+    frac = np.mod(np.asarray(ph, np.float64), 2 * np.pi) / (2 * np.pi)
+    dist = np.min([np.abs(frac - e) for e in edges], axis=0)
+    return dist > eps
+
+
 def _check_normalize(rng):
     from veles.simd_tpu.ops import normalize as nz
 
@@ -481,6 +515,7 @@ FAMILIES = [
     ("resample", _check_resample),
     ("iir", _check_iir),
     ("filters", _check_filters),
+    ("waveforms", _check_waveforms),
     ("normalize", _check_normalize),
     ("detect_peaks", _check_detect_peaks),
     ("pallas1d", _check_pallas1d),

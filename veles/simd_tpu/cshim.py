@@ -430,37 +430,34 @@ def _f64(ptr, *shape):
     return _arr(ptr, shape, ctypes.c_double)
 
 
-def iir_butterworth(order, low, high, btype, sos_out):
-    """Returns the section count; writes [n_sections, 6] float64 rows
-    into ``sos_out`` when it is non-NULL (call once with NULL to size
-    the buffer, then again to fill it)."""
+def _iir_design(design, low, high, btype, sos_out):
+    """Shared design shim: returns the section count; writes
+    [n_sections, 6] float64 rows into ``sos_out`` when non-NULL (call
+    once with NULL to size the buffer, then again to fill it)."""
     bt = _C_BTYPES[int(btype)]
     cutoff = float(low) if bt in ("lowpass", "highpass") \
         else (float(low), float(high))
-    sos = _iir.butterworth(int(order), cutoff, bt)
+    sos = design(cutoff, bt)
     if int(sos_out) != 0:
         _f64(sos_out, len(sos), 6)[...] = sos
     return len(sos)
+
+
+def iir_butterworth(order, low, high, btype, sos_out):
+    return _iir_design(lambda c, bt: _iir.butterworth(int(order), c, bt),
+                       low, high, btype, sos_out)
 
 
 def iir_cheby1(order, rp, low, high, btype, sos_out):
-    bt = _C_BTYPES[int(btype)]
-    cutoff = float(low) if bt in ("lowpass", "highpass") \
-        else (float(low), float(high))
-    sos = _iir.cheby1(int(order), float(rp), cutoff, bt)
-    if int(sos_out) != 0:
-        _f64(sos_out, len(sos), 6)[...] = sos
-    return len(sos)
+    return _iir_design(
+        lambda c, bt: _iir.cheby1(int(order), float(rp), c, bt),
+        low, high, btype, sos_out)
 
 
 def iir_cheby2(order, rs, low, high, btype, sos_out):
-    bt = _C_BTYPES[int(btype)]
-    cutoff = float(low) if bt in ("lowpass", "highpass") \
-        else (float(low), float(high))
-    sos = _iir.cheby2(int(order), float(rs), cutoff, bt)
-    if int(sos_out) != 0:
-        _f64(sos_out, len(sos), 6)[...] = sos
-    return len(sos)
+    return _iir_design(
+        lambda c, bt: _iir.cheby2(int(order), float(rs), c, bt),
+        low, high, btype, sos_out)
 
 
 def iir_sosfilt_stream(simd, sos, n_sections, x, length, zi_inout,

@@ -20,6 +20,8 @@ Each module mirrors one reference header (SURVEY.md §2):
 * :mod:`.filters`      — median/rank filtering (gather + lane sort),
   Savitzky-Golay smoothing/derivatives, window-method FIR design
   (beyond-reference)
+* :mod:`.waveforms`    — chirps, square/sawtooth, Gaussian pulses as
+  fused elementwise generators (beyond-reference)
 * :mod:`.detect_peaks` — 1D local-extrema detection
 
 Every public op takes the reference-compatible ``simd=`` flag: truthy (the

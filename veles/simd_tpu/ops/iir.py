@@ -515,12 +515,24 @@ StreamingConvolution`: chunks arrive one at a time, each section's
 
     def __init__(self, sos, zi=None, simd=None):
         self._sos = _check_sos(sos)
+        # validate once; per-chunk calls reuse the cached static key
+        self._sos_key = tuple(tuple(float(v) for v in row)
+                              for row in self._sos)
         self._simd = resolve_simd(simd)
         self.reset(zi)
 
     def process(self, chunk):
-        y, zf = sosfilt(self._sos, chunk, zi=self._zi, simd=self._simd,
-                        return_zf=True)
+        if np.shape(chunk)[-1] < 2:
+            raise ValueError("chunks need at least 2 samples")
+        if self._simd:
+            y, zf = _sosfilt_xla(jnp.asarray(chunk, jnp.float32),
+                                 self._sos_key,
+                                 jnp.asarray(self._zi, jnp.float32),
+                                 True)
+        else:
+            y, zf = sosfilt_na(self._sos, chunk, zi=self._zi,
+                               return_zf=True)
+            y = y.astype(np.float32)
         self._zi = zf
         return y
 

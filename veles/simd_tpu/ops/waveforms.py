@@ -1,0 +1,177 @@
+"""Test-signal generation: chirps, rectangular/sawtooth waves, pulses.
+
+NEW capability beyond the reference: every benchmark and example in
+``/root/reference/tests`` hand-rolls its stimulus loops; this module is
+the standard generator set (scipy.signal conventions — ``chirp``,
+``square``, ``sawtooth``, ``gausspulse``, ``unit_impulse``) so
+pipelines can synthesize stimuli on device.
+
+TPU notes: all generators are elementwise closed forms over a time
+array — one fused XLA kernel each, no host round-trip when handed a
+device array.  Phase accumulations are exact polynomial/log forms (not
+cumulative sums), so long sweeps don't drift.  Oracle twins compute the
+same definitions in float64 (``/root/reference/tests/matrix.cc:94-98``
+discipline).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from veles.simd_tpu.utils.config import resolve_simd
+
+__all__ = [
+    "chirp", "chirp_na", "square", "square_na", "sawtooth",
+    "sawtooth_na", "gausspulse", "gausspulse_na", "unit_impulse",
+]
+
+
+def _chirp_phase(t, f0, t1, f1, method, xp):
+    f0, t1, f1 = float(f0), float(t1), float(f1)
+    if t1 <= 0:
+        raise ValueError("t1 must be > 0")
+    if method == "linear":
+        beta = (f1 - f0) / t1
+        return 2 * math.pi * (f0 * t + beta / 2 * t * t)
+    if method == "quadratic":
+        beta = (f1 - f0) / (t1 * t1)
+        return 2 * math.pi * (f0 * t + beta * t ** 3 / 3)
+    if method == "logarithmic":
+        if f0 <= 0 or f1 <= 0:
+            raise ValueError("logarithmic sweep needs f0, f1 > 0")
+        if f0 == f1:
+            return 2 * math.pi * f0 * t
+        ratio = f1 / f0
+        return (2 * math.pi * f0 * t1 / math.log(ratio)
+                * (ratio ** (t / t1) - 1.0))
+    if method == "hyperbolic":
+        if f0 == 0 or f1 == 0:
+            raise ValueError("hyperbolic sweep needs nonzero f0, f1")
+        if f0 == f1:
+            return 2 * math.pi * f0 * t
+        # phase = 2*pi*f0*f1*t1/(f0-f1) * ln(((f0-f1)t + f1*t1)/(f1*t1))
+        sing = -f1 * t1 / (f0 - f1)
+        return (2 * math.pi * f0 * f1 * t1 / (f0 - f1)
+                * xp.log(xp.abs(1.0 - t / sing)))
+    raise ValueError(f"unknown chirp method {method!r}")
+
+
+def chirp(t, f0, t1, f1, method: str = "linear", phi: float = 0.0,
+          simd=None):
+    """Frequency-swept cosine (scipy's ``chirp``): instantaneous
+    frequency runs from ``f0`` at t=0 to ``f1`` at ``t1`` along a
+    linear / quadratic / logarithmic / hyperbolic law.  ``phi`` is the
+    initial phase in degrees (scipy convention)."""
+    if resolve_simd(simd):
+        tj = jnp.asarray(t, jnp.float32)
+        phase = _chirp_phase(tj, f0, t1, f1, method, jnp)
+        return jnp.cos(phase + math.radians(float(phi)))
+    return chirp_na(t, f0, t1, f1, method, phi).astype(np.float32)
+
+
+def chirp_na(t, f0, t1, f1, method: str = "linear", phi: float = 0.0):
+    """NumPy float64 oracle twin of :func:`chirp`."""
+    t = np.asarray(t, np.float64)
+    phase = _chirp_phase(t, f0, t1, f1, method, np)
+    return np.cos(phase + math.radians(float(phi)))
+
+
+def square(t, duty: float = 0.5, simd=None):
+    """Square wave of period ``2*pi`` over phase array ``t`` — +1 for
+    the first ``duty`` fraction of each cycle, -1 after (scipy's
+    ``square``)."""
+    duty = float(duty)
+    if not 0.0 <= duty <= 1.0:
+        raise ValueError(f"duty {duty} must be in [0, 1]")
+    if resolve_simd(simd):
+        tj = jnp.asarray(t, jnp.float32)
+        frac = jnp.mod(tj, 2 * math.pi) / (2 * math.pi)
+        return jnp.where(frac < duty, 1.0, -1.0).astype(jnp.float32)
+    return square_na(t, duty).astype(np.float32)
+
+
+def square_na(t, duty: float = 0.5):
+    duty = float(duty)
+    if not 0.0 <= duty <= 1.0:
+        raise ValueError(f"duty {duty} must be in [0, 1]")
+    t = np.asarray(t, np.float64)
+    frac = np.mod(t, 2 * np.pi) / (2 * np.pi)
+    return np.where(frac < duty, 1.0, -1.0)
+
+
+def sawtooth(t, width: float = 1.0, simd=None):
+    """Sawtooth/triangle of period ``2*pi`` (scipy's ``sawtooth``):
+    rises -1→1 over the first ``width`` fraction of the cycle, falls
+    back over the rest (``width=0.5`` is a symmetric triangle)."""
+    width = float(width)
+    if not 0.0 <= width <= 1.0:
+        raise ValueError(f"width {width} must be in [0, 1]")
+    if resolve_simd(simd):
+        tj = jnp.asarray(t, jnp.float32)
+        frac = jnp.mod(tj, 2 * math.pi) / (2 * math.pi)
+        up = 2.0 * frac / max(width, 1e-30) - 1.0
+        down = 1.0 - 2.0 * (frac - width) / max(1.0 - width, 1e-30)
+        return jnp.where(frac < width, up, down).astype(jnp.float32)
+    return sawtooth_na(t, width).astype(np.float32)
+
+
+def sawtooth_na(t, width: float = 1.0):
+    width = float(width)
+    if not 0.0 <= width <= 1.0:
+        raise ValueError(f"width {width} must be in [0, 1]")
+    t = np.asarray(t, np.float64)
+    frac = np.mod(t, 2 * np.pi) / (2 * np.pi)
+    up = 2.0 * frac / max(width, 1e-30) - 1.0
+    down = 1.0 - 2.0 * (frac - width) / max(1.0 - width, 1e-30)
+    return np.where(frac < width, up, down)
+
+
+def _gauss_a(fc, bw, bwr):
+    fc, bw, bwr = float(fc), float(bw), float(bwr)
+    if fc <= 0:
+        raise ValueError("center frequency fc must be > 0")
+    if bw <= 0:
+        raise ValueError("fractional bandwidth bw must be > 0")
+    if bwr >= 0:
+        raise ValueError("bwr must be < 0 dB")
+    ref = 10.0 ** (bwr / 20.0)
+    return -(math.pi * fc * bw) ** 2 / (4.0 * math.log(ref))
+
+
+def gausspulse(t, fc: float = 1000.0, bw: float = 0.5,
+               bwr: float = -6.0, simd=None):
+    """Gaussian-modulated sinusoid (scipy's ``gausspulse`` real part):
+    carrier ``fc`` Hz, fractional bandwidth ``bw`` measured ``bwr`` dB
+    down the spectral envelope."""
+    a = _gauss_a(fc, bw, bwr)
+    if resolve_simd(simd):
+        tj = jnp.asarray(t, jnp.float32)
+        return (jnp.exp(-a * tj * tj)
+                * jnp.cos(2 * math.pi * float(fc) * tj))
+    return gausspulse_na(t, fc, bw, bwr).astype(np.float32)
+
+
+def gausspulse_na(t, fc: float = 1000.0, bw: float = 0.5,
+                  bwr: float = -6.0):
+    t = np.asarray(t, np.float64)
+    a = _gauss_a(fc, bw, bwr)
+    return np.exp(-a * t * t) * np.cos(2 * np.pi * float(fc) * t)
+
+
+def unit_impulse(n: int, idx: int = 0, simd=None):
+    """Length-``n`` impulse with a 1 at ``idx`` (scipy's
+    ``unit_impulse``; ``idx='mid'`` centers it)."""
+    n = int(n)
+    if n < 1:
+        raise ValueError("n must be >= 1")
+    if idx == "mid":
+        idx = n // 2
+    idx = int(idx)
+    if not 0 <= idx < n:
+        raise ValueError(f"idx {idx} outside [0, {n})")
+    out = np.zeros(n, np.float32)
+    out[idx] = 1.0
+    return jnp.asarray(out) if resolve_simd(simd) else out
