@@ -498,6 +498,12 @@ def _check_parallel(rng):
     xq = rng.randn(n_dev * 256).astype(np.float32)
     errs.append(_rel_err(sharded_sosfilt(sos, xq, default_mesh("sp")),
                          iir_mod.sosfilt_na(sos, xq)))
+    # sequence-parallel Welch PSD (per-shard segment FFTs + one psum)
+    from veles.simd_tpu.parallel import sharded_welch
+
+    _, pw = sharded_welch(xs, default_mesh("sp"), nperseg=fl)
+    _, pw_na = sp.welch_na(np.asarray(xs), nperseg=fl)
+    errs.append(_rel_err(pw, pw_na))
     return max(errs), 1e-4
 
 

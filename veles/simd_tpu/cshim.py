@@ -444,6 +444,10 @@ def _iir_design(design, low, high, btype, sos_out):
 
 
 def iir_butterworth(order, low, high, btype, sos_out):
+    """Returns the section count; writes [n_sections, 6] float64 rows
+    into ``sos_out`` when it is non-NULL (call once with NULL to size
+    the buffer, then again to fill it).  Same contract for the cheby
+    variants."""
     return _iir_design(lambda c, bt: _iir.butterworth(int(order), c, bt),
                        low, high, btype, sos_out)
 

@@ -368,6 +368,25 @@ def _welch_args(n, nperseg, noverlap, window):
     return nperseg, nperseg - noverlap, window
 
 
+def _onesided_scale(nperseg, fs, window, scaling) -> np.ndarray:
+    """Per-bin factor for a one-sided PSD of real input: the
+    density/spectrum normalization times the doubling of every bin
+    except DC (and Nyquist when ``nperseg`` is even).  The single
+    definition the single-chip estimators and ``sharded_welch`` share."""
+    if scaling == "density":
+        scale = 1.0 / (fs * np.sum(window ** 2))
+    elif scaling == "spectrum":
+        scale = 1.0 / np.sum(window) ** 2
+    else:
+        raise ValueError(f"scaling must be 'density' or 'spectrum', "
+                         f"got {scaling!r}")
+    mult = np.full(nperseg // 2 + 1, 2.0)
+    mult[0] = 1.0
+    if nperseg % 2 == 0:
+        mult[-1] = 1.0
+    return mult * scale
+
+
 def _segment_ffts(x, y, fs, nperseg, noverlap, window, detrend_type,
                   scaling, simd):
     """Segment + detrend + window + rfft both inputs ONCE; returns
@@ -377,21 +396,8 @@ def _segment_ffts(x, y, fs, nperseg, noverlap, window, detrend_type,
     if np.shape(y)[-1] != n:
         raise ValueError("x and y lengths differ")
     nperseg, hop, window = _welch_args(n, nperseg, noverlap, window)
-    if scaling == "density":
-        scale = 1.0 / (fs * np.sum(window ** 2))
-    elif scaling == "spectrum":
-        scale = 1.0 / np.sum(window) ** 2
-    else:
-        raise ValueError(f"scaling must be 'density' or 'spectrum', "
-                         f"got {scaling!r}")
     freqs = np.fft.rfftfreq(nperseg, 1.0 / fs)
-    # one-sided doubling (real input): every bin except DC (and Nyquist
-    # when nperseg is even)
-    mult = np.full(nperseg // 2 + 1, 2.0)
-    mult[0] = 1.0
-    if nperseg % 2 == 0:
-        mult[-1] = 1.0
-    scale_mult = mult * scale
+    scale_mult = _onesided_scale(nperseg, fs, window, scaling)
 
     def segments(v, xp):
         idx = _frame_indices(n, nperseg, hop)

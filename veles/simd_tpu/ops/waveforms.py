@@ -79,54 +79,55 @@ def chirp_na(t, f0, t1, f1, method: str = "linear", phi: float = 0.0):
     return np.cos(phase + math.radians(float(phi)))
 
 
+def _check_frac(value, name) -> float:
+    value = float(value)
+    if not 0.0 <= value <= 1.0:
+        raise ValueError(f"{name} {value} must be in [0, 1]")
+    return value
+
+
+def _square_core(t, duty, xp):
+    frac = xp.mod(t, 2 * math.pi) / (2 * math.pi)
+    return xp.where(frac < duty, 1.0, -1.0)
+
+
+def _sawtooth_core(t, width, xp):
+    frac = xp.mod(t, 2 * math.pi) / (2 * math.pi)
+    up = 2.0 * frac / max(width, 1e-30) - 1.0
+    down = 1.0 - 2.0 * (frac - width) / max(1.0 - width, 1e-30)
+    return xp.where(frac < width, up, down)
+
+
 def square(t, duty: float = 0.5, simd=None):
     """Square wave of period ``2*pi`` over phase array ``t`` — +1 for
     the first ``duty`` fraction of each cycle, -1 after (scipy's
     ``square``)."""
-    duty = float(duty)
-    if not 0.0 <= duty <= 1.0:
-        raise ValueError(f"duty {duty} must be in [0, 1]")
+    duty = _check_frac(duty, "duty")
     if resolve_simd(simd):
-        tj = jnp.asarray(t, jnp.float32)
-        frac = jnp.mod(tj, 2 * math.pi) / (2 * math.pi)
-        return jnp.where(frac < duty, 1.0, -1.0).astype(jnp.float32)
+        return _square_core(jnp.asarray(t, jnp.float32), duty,
+                            jnp).astype(jnp.float32)
     return square_na(t, duty).astype(np.float32)
 
 
 def square_na(t, duty: float = 0.5):
-    duty = float(duty)
-    if not 0.0 <= duty <= 1.0:
-        raise ValueError(f"duty {duty} must be in [0, 1]")
-    t = np.asarray(t, np.float64)
-    frac = np.mod(t, 2 * np.pi) / (2 * np.pi)
-    return np.where(frac < duty, 1.0, -1.0)
+    duty = _check_frac(duty, "duty")
+    return _square_core(np.asarray(t, np.float64), duty, np)
 
 
 def sawtooth(t, width: float = 1.0, simd=None):
     """Sawtooth/triangle of period ``2*pi`` (scipy's ``sawtooth``):
     rises -1→1 over the first ``width`` fraction of the cycle, falls
     back over the rest (``width=0.5`` is a symmetric triangle)."""
-    width = float(width)
-    if not 0.0 <= width <= 1.0:
-        raise ValueError(f"width {width} must be in [0, 1]")
+    width = _check_frac(width, "width")
     if resolve_simd(simd):
-        tj = jnp.asarray(t, jnp.float32)
-        frac = jnp.mod(tj, 2 * math.pi) / (2 * math.pi)
-        up = 2.0 * frac / max(width, 1e-30) - 1.0
-        down = 1.0 - 2.0 * (frac - width) / max(1.0 - width, 1e-30)
-        return jnp.where(frac < width, up, down).astype(jnp.float32)
+        return _sawtooth_core(jnp.asarray(t, jnp.float32), width,
+                              jnp).astype(jnp.float32)
     return sawtooth_na(t, width).astype(np.float32)
 
 
 def sawtooth_na(t, width: float = 1.0):
-    width = float(width)
-    if not 0.0 <= width <= 1.0:
-        raise ValueError(f"width {width} must be in [0, 1]")
-    t = np.asarray(t, np.float64)
-    frac = np.mod(t, 2 * np.pi) / (2 * np.pi)
-    up = 2.0 * frac / max(width, 1e-30) - 1.0
-    down = 1.0 - 2.0 * (frac - width) / max(1.0 - width, 1e-30)
-    return np.where(frac < width, up, down)
+    width = _check_frac(width, "width")
+    return _sawtooth_core(np.asarray(t, np.float64), width, np)
 
 
 def _gauss_a(fc, bw, bwr):

@@ -31,7 +31,7 @@ __all__ = ["sharded_convolve", "sharded_convolve_ring",
            "sharded_wavelet_apply2d",
            "sharded_wavelet_reconstruct2d",
            "sharded_stft", "sharded_istft", "sharded_sosfilt",
-           "data_parallel",
+           "sharded_welch", "data_parallel",
            "halo_exchange_left", "halo_exchange_right"]
 
 
@@ -1068,6 +1068,57 @@ def sharded_sosfilt(sos, x, mesh: Mesh, axis: str = "sp"):
         return cur
 
     return _run(x)
+
+
+def sharded_welch(x, mesh: Mesh, axis: str = "sp", fs: float = 1.0,
+                  nperseg: int = 256, noverlap=None, window=None):
+    """Sequence-parallel Welch PSD: segments are framed per shard with
+    the :func:`sharded_stft` halo pattern, each shard accumulates its
+    own masked ``|fft|^2`` sum, and ONE ``psum`` of a ``[bins]`` vector
+    per shard produces the global average — the signal is never
+    gathered, and the collective payload is independent of its length.
+
+    Matches the single-chip :func:`veles.simd_tpu.ops.spectral.welch`
+    (Hann window, constant per-segment detrend, density scaling).
+    Returns ``(freqs, Pxx)`` with ``Pxx`` replicated over the mesh.
+    """
+    from veles.simd_tpu.ops import spectral as sp
+
+    x = jnp.asarray(x, jnp.float32)
+    n = x.shape[-1]
+    n_shards = mesh.shape[axis]
+    nperseg_c, hop, window_np = sp._welch_args(n, nperseg, noverlap,
+                                               window)
+    block, halo = _check_stft_sharding(n, nperseg_c, hop, n_shards)
+    frames_total = sp.frame_count(n, nperseg_c, hop)
+    frames_per_shard = block // hop
+    scale_mult = jnp.asarray(
+        sp._onesided_scale(nperseg_c, fs, window_np, "density"),
+        jnp.float32)
+    freqs = np.fft.rfftfreq(nperseg_c, 1.0 / fs)
+    window_j = jnp.asarray(window_np, jnp.float32)
+    idx = jnp.asarray(sp._frame_indices(block + halo, nperseg_c, hop))
+    in_spec = P(*([None] * (x.ndim - 1) + [axis]))
+    out_spec = P(*([None] * (x.ndim - 1) + [None]))
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=in_spec, out_specs=out_spec)
+    def _run(x_local):
+        halo_part = halo_exchange_right(x_local, halo, axis)
+        x_ext = jnp.concatenate([x_local, halo_part], axis=-1)
+        segs = jnp.take(x_ext, idx, axis=-1)
+        segs = segs - jnp.mean(segs, axis=-1, keepdims=True)
+        fx = jnp.fft.rfft(segs * window_j, axis=-1)
+        # mask the trailing frames that overhang the global signal end
+        # (they exist only so every shard has a uniform frame count)
+        gidx = (jax.lax.axis_index(axis) * frames_per_shard
+                + jnp.arange(frames_per_shard))
+        mask = (gidx < frames_total).astype(jnp.float32)
+        local = jnp.sum((jnp.abs(fx) ** 2) * mask[..., :, None],
+                        axis=-2)
+        return jax.lax.psum(local, axis) / frames_total
+
+    return freqs, _run(x) * scale_mult
 
 
 def data_parallel(fn, mesh: Mesh, axis: str = "dp"):
