@@ -378,7 +378,12 @@ def _check_detect_peaks(rng):
     pos_na, vals_na = dp.detect_peaks_na(x, dp.ExtremumType.BOTH)
     if len(pos) != len(pos_na) or not np.array_equal(pos, pos_na):
         return 1.0, 1e-6
-    return _rel_err(vals, vals_na), 1e-6
+    errs = [_rel_err(vals, vals_na)]
+    # sparse-table prominence vs the sequential saddle-walk oracle
+    peaks, _ = dp.find_peaks(x)
+    errs.append(_rel_err(dp.peak_prominences(x, peaks, simd=True),
+                         dp.peak_prominences_na(x, peaks)))
+    return max(errs), 1e-6
 
 
 def _check_pallas1d(rng):
