@@ -250,3 +250,43 @@ class TestFindPeaks:
         np.testing.assert_array_equal(got, got2)
         with pytest.raises(ValueError, match="per-peak"):
             dp.find_peaks(self.X, height=np.zeros(3))
+
+    def test_widths_match_scipy(self):
+        from scipy import signal as ss
+
+        peaks, _ = dp.find_peaks(self.X)
+        for rh in (0.3, 0.5, 0.75, 0.95):
+            got = [np.asarray(a) for a in
+                   dp.peak_widths(self.X, peaks, rel_height=rh,
+                                  simd=True)]
+            want = ss.peak_widths(self.X.astype(np.float64), peaks,
+                                  rel_height=rh)
+            for g, w, tol in zip(got, want, (2e-3, 1e-5, 1e-3, 1e-3)):
+                np.testing.assert_allclose(g, w, atol=tol)
+
+    def test_widths_oracle_exact(self):
+        from scipy import signal as ss
+
+        peaks, _ = dp.find_peaks(self.X)
+        got = dp.peak_widths_na(self.X, peaks, 0.5)
+        want = ss.peak_widths(self.X.astype(np.float64), peaks,
+                              rel_height=0.5)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, atol=1e-10)
+
+    def test_widths_textbook_case(self):
+        """A symmetric triangle peak of height 4 and half-width 4 has
+        FWHM 4 at rel_height 0.5."""
+        x = np.r_[np.linspace(0, 4, 5), np.linspace(4, 0, 5)[1:]] \
+            .astype(np.float32)
+        w, h, li, ri = (np.asarray(a) for a in
+                        dp.peak_widths(x, [4], rel_height=0.5,
+                                       simd=True))
+        np.testing.assert_allclose(w, [4.0], atol=1e-5)
+        np.testing.assert_allclose(h, [2.0], atol=1e-6)
+
+    def test_widths_contracts(self):
+        with pytest.raises(ValueError, match="rel_height"):
+            dp.peak_widths(self.X, [10], rel_height=1.0)
+        with pytest.raises(ValueError, match="range"):
+            dp.peak_widths(self.X, [len(self.X)])

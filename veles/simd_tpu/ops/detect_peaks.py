@@ -35,7 +35,7 @@ from veles.simd_tpu.utils.config import resolve_simd
 
 __all__ = ["ExtremumType", "detect_peaks", "detect_peaks_na",
            "detect_peaks_fixed", "find_peaks", "peak_prominences",
-           "peak_prominences_na"]
+           "peak_prominences_na", "peak_widths", "peak_widths_na"]
 
 
 class ExtremumType(enum.IntFlag):
@@ -215,27 +215,37 @@ def _build_sparse_tables(x):
     return maxes, mins
 
 
-def _nearest_greater(x, maxes, side):
-    """For every i, the distance to the nearest strictly-greater sample
-    on ``side`` ('left'/'right'), or a distance reaching the signal edge
-    when none exists.  Vectorized binary descent over the max tables."""
-    n = x.shape[-1]
+def _scan_while(tables, thresh, side, op):
+    """For every i, the length of the maximal run adjacent to i on
+    ``side`` whose windowed aggregate satisfies ``op(agg, thresh[i])``.
+    Vectorized binary descent over the doubling tables: the sequential
+    walk every CPU implementation uses becomes log2(n) gather passes.
+
+    ``op(window_max, x[i]) = max <= x[i]`` finds the nearest strictly
+    greater sample (prominence); ``op(window_min, h[i]) = min > h[i]``
+    finds the nearest sample at-or-below an evaluation height (widths).
+    """
+    n = tables[0].shape[-1]
     idx = jnp.arange(n)
-    # pos = number of samples in the still-not-containing-greater span
     span = jnp.zeros(n, jnp.int32)
-    for k in range(len(maxes) - 1, -1, -1):
+    for k in range(len(tables) - 1, -1, -1):
         width = 1 << k
         if side == "left":
             start = idx - span - width
             ok = start >= 0
-            win_max = maxes[k][jnp.clip(start, 0, n - 1)]
         else:
             start = idx + span + 1
             ok = start + width <= n
-            win_max = maxes[k][jnp.clip(start, 0, n - 1)]
-        grow = ok & (win_max <= x)
+        agg = tables[k][jnp.clip(start, 0, n - 1)]
+        grow = ok & op(agg, thresh)
         span = span + jnp.where(grow, width, 0)
-    return span  # nearest greater at distance span+1 (or edge)
+    return span  # first violating sample at distance span+1 (or edge)
+
+
+def _nearest_greater(x, maxes, side):
+    """Distance to the nearest strictly-greater sample on ``side`` (or
+    to the signal edge when none exists)."""
+    return _scan_while(maxes, x, side, lambda agg, t: agg <= t)
 
 
 def _range_min_pos(x, mins, a, b):
@@ -253,10 +263,11 @@ def _range_min_pos(x, mins, a, b):
     return jnp.minimum(left, right)
 
 
-@jax.jit
-def _prominences_xla(x):
-    """Prominence of EVERY index treated as a peak (garbage at
-    non-peaks — callers gather at real peak positions)."""
+def _prom_core(x):
+    """Shared saddle search: ``(mins, lspan, rspan, prom)`` for EVERY
+    index treated as a peak (garbage at non-peaks — callers gather at
+    real peak positions).  The single definition behind both
+    ``peak_prominences`` and ``peak_widths``."""
     n = x.shape[-1]
     idx = jnp.arange(n)
     maxes, mins = _build_sparse_tables(x)
@@ -266,7 +277,12 @@ def _prominences_xla(x):
     # neighbour (clamped at the signal edges)
     lmin = _range_min_pos(x, mins, idx - lspan, idx)
     rmin = _range_min_pos(x, mins, idx + 1, idx + rspan + 1)
-    return x - jnp.maximum(lmin, rmin)
+    return mins, lspan, rspan, x - jnp.maximum(lmin, rmin)
+
+
+@jax.jit
+def _prominences_xla(x):
+    return _prom_core(x)[3]
 
 
 def peak_prominences(x, peaks, simd=None):
@@ -314,6 +330,106 @@ def peak_prominences_na(x, peaks):
             rmin = x[p + 1:].min()
         out[j] = v - max(lmin, rmin)
     return out
+
+
+@functools.partial(jax.jit, static_argnames=("rel_height",))
+def _widths_xla(x, rel_height):
+    """(widths, h_eval, left_ip, right_ip) for EVERY index treated as a
+    peak (garbage at non-peaks — callers gather at peak positions)."""
+    n = x.shape[-1]
+    idx = jnp.arange(n)
+    mins, lspan, rspan, prom = _prom_core(x)
+    h_eval = x - np.float32(rel_height) * prom
+    # nearest sample at-or-below h_eval on each side (the run of
+    # strictly-above samples ends there); rel_height <= 1 keeps it
+    # inside the peak's own prominence interval
+    # clamp to the prominence span: the crossing provably lies inside
+    # it for rel_height < 1, and the clamp bounds the damage if f32
+    # rounding ever pushes h_eval below the saddle value
+    lrun = jnp.minimum(
+        _scan_while(mins, h_eval, "left", lambda agg, t: agg > t), lspan)
+    rrun = jnp.minimum(
+        _scan_while(mins, h_eval, "right", lambda agg, t: agg > t),
+        rspan)
+    li = jnp.clip(idx - lrun - 1, 0, n - 1)   # x[li] <= h_eval
+    ri = jnp.clip(idx + rrun + 1, 0, n - 1)
+    xl, xl1 = x[li], x[jnp.clip(li + 1, 0, n - 1)]
+    xr, xr1 = x[ri], x[jnp.clip(ri - 1, 0, n - 1)]
+    # linear interpolation of the crossing (scipy's formula); guarded
+    # where the stop sample already sits exactly at h_eval or the run
+    # hit the signal edge
+    lfrac = jnp.where(xl1 != xl, (h_eval - xl) / (xl1 - xl), 0.0)
+    rfrac = jnp.where(xr1 != xr, (h_eval - xr) / (xr1 - xr), 0.0)
+    hit_edge_l = (idx - lrun) <= 0
+    hit_edge_r = (idx + rrun) >= n - 1
+    crossed_l = (xl < h_eval) & ~hit_edge_l
+    crossed_r = (xr < h_eval) & ~hit_edge_r
+    left_ip = jnp.where(crossed_l, li + lfrac,
+                        jnp.where(hit_edge_l, 0.0, li.astype(x.dtype)))
+    right_ip = jnp.where(crossed_r, ri - rfrac,
+                         jnp.where(hit_edge_r, float(n - 1),
+                                   ri.astype(x.dtype)))
+    return right_ip - left_ip, h_eval, left_ip, right_ip
+
+
+def peak_widths(x, peaks, rel_height: float = 0.5, simd=None):
+    """Width of each peak at ``rel_height`` of its prominence (scipy's
+    ``peak_widths`` with wlen=None): the distance between the linearly
+    interpolated crossings of ``x[peak] - rel_height * prominence`` on
+    either side.  Returns ``(widths, width_heights, left_ips,
+    right_ips)``.  ``rel_height`` must be in [0, 1) — strictly below 1,
+    so the crossings provably lie inside the peak's prominence interval
+    and the search runs as parallel table descents instead of scipy's
+    base-bounded sequential walk (``rel_height=1``, width at the base,
+    sits at exact float equality with the saddle and is ill-conditioned
+    there; scipy values above 1 are likewise unsupported).
+    """
+    rel_height = float(rel_height)
+    if not 0.0 <= rel_height < 1.0:
+        raise ValueError("rel_height must be in [0, 1) "
+                         "(1.0 and above are not supported)")
+    peaks = np.asarray(peaks, np.int64)
+    n = np.shape(x)[-1]
+    if peaks.size and (peaks.min() < 0 or peaks.max() >= n):
+        raise ValueError("peak index out of range")
+    if resolve_simd(simd):
+        w, h, li, ri = _widths_xla(jnp.asarray(x, jnp.float32),
+                                   rel_height)
+        pk = jnp.asarray(peaks)
+        return (jnp.take(w, pk), jnp.take(h, pk), jnp.take(li, pk),
+                jnp.take(ri, pk))
+    return tuple(a.astype(np.float32)
+                 for a in peak_widths_na(x, peaks, rel_height))
+
+
+def peak_widths_na(x, peaks, rel_height: float = 0.5):
+    """NumPy float64 oracle twin (sequential crossing walk).  The same
+    ``rel_height`` in [0, 1) contract as the device path — an unbounded
+    walk is only correct inside the prominence interval."""
+    rel_height = float(rel_height)
+    if not 0.0 <= rel_height < 1.0:
+        raise ValueError("rel_height must be in [0, 1) "
+                         "(1.0 and above are not supported)")
+    x = np.asarray(x, np.float64)
+    n = len(x)
+    prom = peak_prominences_na(x, peaks)
+    out = np.zeros((4, len(peaks)))
+    for j, p in enumerate(np.asarray(peaks, np.int64)):
+        h = x[p] - float(rel_height) * prom[j]
+        i = p
+        while i > 0 and x[i] > h:
+            i -= 1
+        lip = float(i)
+        if x[i] < h:
+            lip += (h - x[i]) / (x[i + 1] - x[i])
+        i = p
+        while i < n - 1 and x[i] > h:
+            i += 1
+        rip = float(i)
+        if x[i] < h:
+            rip -= (h - x[i]) / (x[i - 1] - x[i])
+        out[:, j] = (rip - lip, h, lip, rip)
+    return tuple(out)
 
 
 def find_peaks(x, height=None, threshold=None, distance=None,
@@ -388,15 +504,18 @@ def find_peaks(x, height=None, threshold=None, distance=None,
             raise ValueError("distance must be >= 1")
         # scipy's greedy: highest peaks claim their neighbourhood
         # first, equal heights resolved LATER-index-first (scipy walks
-        # its ascending argsort from the back)
+        # its ascending argsort from the back).  peaks are position-
+        # sorted, so each suppression is one searchsorted window —
+        # O(k log k), not a full distance scan per peak.
         order = np.argsort(x_np[peaks], kind="stable")[::-1]
         keep = np.ones(len(peaks), bool)
         for j in order:
             if not keep[j]:
                 continue
-            d = np.abs(peaks - peaks[j])
-            near = (d < distance) & (d > 0)
-            keep[near] = False
+            lo = np.searchsorted(peaks, peaks[j] - distance + 1)
+            hi = np.searchsorted(peaks, peaks[j] + distance)
+            keep[lo:hi] = False
+            keep[j] = True
         peaks = peaks[keep]
         for k in props:
             props[k] = props[k][keep]
