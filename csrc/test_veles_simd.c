@@ -771,6 +771,35 @@ static void test_detect_peaks(void) {
   float flat[8] = {0};
   CHECK(detect_peaks(1, flat, 8, kExtremumTypeBoth, &pts, &n) == 0);
   CHECK(n == 0 && pts == NULL);
+
+  /* scipy-style analysis: terrain with a hand-checkable side summit */
+  float terr[6] = {0, 5, 2, 8, 1, 0};
+  int64_t pk[2] = {1, 3};
+  float prom[2];
+  CHECK(peak_prominences(1, terr, 6, pk, 2, prom) == 0);
+  CHECK_NEAR(prom[0], 3.0, 1e-5);  /* saddle at 2 under the 5-summit */
+  CHECK_NEAR(prom[1], 8.0, 1e-5);
+
+  /* symmetric triangle: FWHM = half-base at rel_height 0.5 */
+  float tri[9] = {0, 1, 2, 3, 4, 3, 2, 1, 0};
+  int64_t tpk[1] = {4};
+  float w[1], wh[1], li[1], ri[1];
+  CHECK(peak_widths(1, tri, 9, tpk, 1, 0.5, w, wh, li, ri) == 0);
+  CHECK_NEAR(w[0], 4.0, 1e-5);
+  CHECK_NEAR(wh[0], 2.0, 1e-6);
+  CHECK(peak_widths(1, tri, 9, tpk, 1, 1.0, w, wh, li, ri) != 0);
+
+  /* filtered search: only the tall summit survives the filters */
+  int64_t found[8];
+  long cnt = find_peaks(1, terr, 6, 4.0, NAN, NAN, NAN, 0, 5.0, NAN,
+                        found, 8);
+  CHECK(cnt == 1 && found[0] == 3);
+  cnt = find_peaks(1, terr, 6, NAN, NAN, NAN, NAN, 0, NAN, NAN,
+                   found, 8);
+  CHECK(cnt == 2);
+  cnt = find_peaks(1, terr, 6, NAN, NAN, NAN, NAN, 4, NAN, NAN,
+                   found, 1);  /* distance suppresses; max_out clips */
+  CHECK(cnt == 1 && found[0] == 3);
 }
 
 static void test_conversions(void) {

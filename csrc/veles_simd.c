@@ -931,6 +931,40 @@ int detect_peaks(int simd, const float *data, size_t size, ExtremumType type,
   return 0;
 }
 
+int peak_prominences(int simd, const float *x, size_t length,
+                     const int64_t *peaks, size_t n_peaks,
+                     float *prom_out) {
+  return shim_run("peak_prominences", "(iKkKkK)", simd, PTR(x),
+                  (unsigned long)length, PTR(peaks),
+                  (unsigned long)n_peaks, PTR(prom_out));
+}
+
+int peak_widths(int simd, const float *x, size_t length,
+                const int64_t *peaks, size_t n_peaks, double rel_height,
+                float *widths, float *width_heights, float *left_ips,
+                float *right_ips) {
+  return shim_run("peak_widths", "(iKkKkdKKKK)", simd, PTR(x),
+                  (unsigned long)length, PTR(peaks),
+                  (unsigned long)n_peaks, rel_height, PTR(widths),
+                  PTR(width_heights), PTR(left_ips), PTR(right_ips));
+}
+
+long find_peaks(int simd, const float *x, size_t length,
+                double height_min, double height_max,
+                double threshold_min, double threshold_max,
+                size_t distance, double prom_min, double prom_max,
+                int64_t *peaks_out, size_t max_out) {
+  long count = -1;
+  if (shim_call_parse("find_peaks", parse_long, &count, "(iKkddddkddKk)",
+                      simd, PTR(x), (unsigned long)length, height_min,
+                      height_max, threshold_min, threshold_max,
+                      (unsigned long)distance, prom_min, prom_max,
+                      PTR(peaks_out), (unsigned long)max_out) != 0) {
+    return -1;
+  }
+  return count;
+}
+
 /* ---- conversions ------------------------------------------------------ */
 
 static int convert(const char *name, int simd, const void *src, size_t length,

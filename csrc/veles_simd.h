@@ -432,6 +432,30 @@ typedef struct {
 int detect_peaks(int simd, const float *data, size_t size, ExtremumType type,
                  ExtremumPoint **results, size_t *results_length);
 
+/* scipy-style peak analysis — no reference analog.  peaks: int64
+ * indices (e.g. from find_peaks or the detect_peaks output). */
+
+/* Prominence of each peak: prom_out holds n_peaks floats. */
+int peak_prominences(int simd, const float *x, size_t length,
+                     const int64_t *peaks, size_t n_peaks,
+                     float *prom_out);
+/* Width at rel_height (in [0, 1)) of each peak's prominence; all four
+ * output arrays hold n_peaks floats. */
+int peak_widths(int simd, const float *x, size_t length,
+                const int64_t *peaks, size_t n_peaks, double rel_height,
+                float *widths, float *width_heights, float *left_ips,
+                float *right_ips);
+/* Filtered local-maxima search (scipy find_peaks for the height /
+ * threshold / distance / prominence conditions).  NaN bounds are
+ * "unset"; distance 0 disables that filter.  Writes at most max_out
+ * int64 indices and returns the TOTAL count (negative on error) —
+ * call again with a bigger buffer if it exceeds max_out. */
+long find_peaks(int simd, const float *x, size_t length,
+                double height_min, double height_max,
+                double threshold_min, double threshold_max,
+                size_t distance, double prom_min, double prom_max,
+                int64_t *peaks_out, size_t max_out);
+
 /* ---- arithmetic conversions (inc/simd/arithmetic.h) ------------------- */
 
 int int16_to_float(int simd, const int16_t *src, size_t length, float *dst);

@@ -600,6 +600,53 @@ def detect_peaks(simd, data, size, etype):
             np.asarray(vals, np.float64).tolist())
 
 
+def _i64(ptr, *shape):
+    return _arr(ptr, shape, ctypes.c_int64)
+
+
+def peak_prominences(simd, x, length, peaks, n_peaks, prom_out):
+    pk = _i64(peaks, n_peaks)
+    _f32(prom_out, n_peaks)[...] = np.asarray(
+        _dp.peak_prominences(_f32(x, length), pk, simd=bool(simd)))
+    return 0
+
+
+def peak_widths(simd, x, length, peaks, n_peaks, rel_height, widths,
+                width_heights, left_ips, right_ips):
+    pk = _i64(peaks, n_peaks)
+    w, h, li, ri = _dp.peak_widths(_f32(x, length), pk,
+                                   rel_height=float(rel_height),
+                                   simd=bool(simd))
+    _f32(widths, n_peaks)[...] = np.asarray(w)
+    _f32(width_heights, n_peaks)[...] = np.asarray(h)
+    _f32(left_ips, n_peaks)[...] = np.asarray(li)
+    _f32(right_ips, n_peaks)[...] = np.asarray(ri)
+    return 0
+
+
+def find_peaks(simd, x, length, height_min, height_max, threshold_min,
+               threshold_max, distance, prom_min, prom_max, peaks_out,
+               max_out):
+    """NaN bounds mean "unset"; distance 0 means no distance filter.
+    Returns the total peak count; at most max_out indices are written."""
+    def _iv(lo, hi):
+        lo = None if np.isnan(lo) else float(lo)
+        hi = None if np.isnan(hi) else float(hi)
+        if lo is None and hi is None:
+            return None
+        return (lo, hi)
+
+    peaks, _ = _dp.find_peaks(
+        _f32(x, length), height=_iv(height_min, height_max),
+        threshold=_iv(threshold_min, threshold_max),
+        distance=None if int(distance) == 0 else int(distance),
+        prominence=_iv(prom_min, prom_max), simd=bool(simd))
+    n_write = min(len(peaks), int(max_out))
+    if n_write:
+        _i64(peaks_out, n_write)[...] = peaks[:n_write]
+    return len(peaks)
+
+
 # ---- conversions ----------------------------------------------------------
 
 def convert(name, simd, src, length, dst):

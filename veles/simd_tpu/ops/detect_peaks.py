@@ -341,7 +341,7 @@ def _widths_xla(x, rel_height):
     mins, lspan, rspan, prom = _prom_core(x)
     h_eval = x - np.float32(rel_height) * prom
     # nearest sample at-or-below h_eval on each side (the run of
-    # strictly-above samples ends there); rel_height <= 1 keeps it
+    # strictly-above samples ends there); rel_height < 1 keeps it
     # inside the peak's own prominence interval
     # clamp to the prominence span: the crossing provably lies inside
     # it for rel_height < 1, and the clamp bounds the damage if f32
