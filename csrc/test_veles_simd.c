@@ -709,6 +709,18 @@ static void test_filters(void) {
   CHECK(filt_savgol(1, q, N, 9, 9, 0, 1.0, VELES_SAVGOL_INTERP, sg)
         != 0); /* polyorder >= window rejected */
 
+  /* Wiener: a spike inside a flat region is pulled to the local mean */
+  float wx[N], wy[N];
+  for (int i = 0; i < N; i++) {
+    wx[i] = 1.f;
+  }
+  wx[N / 2] = 4.f;
+  CHECK(filt_wiener(1, wx, N, 5, 0.5, wy) == 0);
+  CHECK(fabsf(wy[10] - 1.f) < 1e-3f);        /* flat region untouched */
+  CHECK(wy[N / 2] < wx[N / 2]);              /* spike shrunk */
+  CHECK(filt_wiener(1, wx, N, 5, NAN, wy) == 0);  /* estimated noise */
+  CHECK(filt_wiener(1, wx, N, 4, 0.5, wy) != 0);  /* even size */
+
   /* SG taps sum to 1 (deriv 0); firwin lowpass has unit DC gain */
   double taps[33];
   CHECK(filt_savgol_coeffs(11, 3, 0, 1.0, taps) == 0);

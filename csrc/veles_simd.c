@@ -799,6 +799,13 @@ int filt_savgol(int simd, const float *x, size_t length,
                   (int)mode, PTR(result));
 }
 
+int filt_wiener(int simd, const float *x, size_t length, size_t mysize,
+                double noise, float *result) {
+  return shim_run("filt_wiener", "(iKkkdK)", simd, PTR(x),
+                  (unsigned long)length, (unsigned long)mysize, noise,
+                  PTR(result));
+}
+
 int filt_savgol_coeffs(size_t window_length, size_t polyorder,
                        size_t deriv, double delta, double *taps) {
   return shim_run("filt_savgol_coeffs", "(kkkdK)",

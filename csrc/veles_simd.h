@@ -391,6 +391,10 @@ typedef enum {
 int filt_savgol(int simd, const float *x, size_t length,
                 size_t window_length, size_t polyorder, size_t deriv,
                 double delta, VelesSavgolMode mode, float *result);
+/* Adaptive Wiener denoise (scipy wiener): noise NAN selects the
+ * mean-local-variance estimate.  result: length floats. */
+int filt_wiener(int simd, const float *x, size_t length, size_t mysize,
+                double noise, float *result);
 /* The SG taps themselves (np.convolve orientation, scipy
  * savgol_coeffs): taps holds window_length float64. */
 int filt_savgol_coeffs(size_t window_length, size_t polyorder,

@@ -290,3 +290,26 @@ class TestFindPeaks:
             dp.peak_widths(self.X, [10], rel_height=1.0)
         with pytest.raises(ValueError, match="range"):
             dp.peak_widths(self.X, [len(self.X)])
+
+    def test_width_condition_matches_scipy(self):
+        from scipy import signal as ss
+
+        for kw in ({"width": 2.0}, {"width": (1.5, 4.0)},
+                   {"width": 2.0, "rel_height": 0.7},
+                   {"prominence": 0.5, "width": 1.0}):
+            got, gp = dp.find_peaks(self.X, **kw)
+            want, wp = ss.find_peaks(self.X.astype(np.float64), **kw)
+            np.testing.assert_array_equal(got, want)
+            np.testing.assert_allclose(gp["widths"], wp["widths"],
+                                       atol=2e-3)
+            np.testing.assert_allclose(gp["left_ips"], wp["left_ips"],
+                                       atol=2e-3)
+
+    def test_width_attaches_prominences(self):
+        from scipy import signal as ss
+
+        got, gp = dp.find_peaks(self.X, width=2.0)
+        want, wp = ss.find_peaks(self.X.astype(np.float64), width=2.0)
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_allclose(gp["prominences"], wp["prominences"],
+                                   atol=1e-5)

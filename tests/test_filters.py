@@ -183,3 +183,57 @@ class TestFirwin:
         core = y[200:]
         hi_resid = core - np.sin(0.1 * np.pi * t[200:] - 0.1 * np.pi * 50)
         assert np.sqrt(np.mean(hi_resid ** 2)) < 0.02
+
+
+class TestWiener:
+    def test_matches_scipy(self):
+        x = RNG.randn(500)
+        for k in (3, 7, 11):
+            got = np.asarray(fl.wiener(x.astype(np.float32), k,
+                                       simd=True))
+            np.testing.assert_allclose(got, ss.wiener(x, k), atol=1e-4)
+
+    def test_fixed_noise_oracle_exact(self):
+        x = RNG.randn(300)
+        np.testing.assert_allclose(fl.wiener_na(x, 5, noise=0.5),
+                                   ss.wiener(x, 5, noise=0.5),
+                                   atol=1e-12)
+
+    def test_adaptive_behaviour(self):
+        """Flat regions are smoothed toward the mean; a strong edge is
+        preserved far better than a boxcar of the same size."""
+        n = 400
+        step = np.r_[np.zeros(n // 2), np.ones(n // 2)]
+        x = (step + 0.05 * RNG.randn(n)).astype(np.float32)
+        y = np.asarray(fl.wiener(x, 11))
+        flat_rms = np.sqrt(np.mean((y[50:150] - 0.0) ** 2))
+        assert flat_rms < 0.02          # noise crushed on the flat
+        assert y[n // 2 + 6] > 0.9      # edge still sharp shortly after
+
+    def test_batched(self):
+        x = RNG.randn(3, 200).astype(np.float32)
+        got = np.asarray(fl.wiener(x, 7, simd=True))
+        want = np.stack([ss.wiener(r.astype(np.float64), 7) for r in x])
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_contracts(self):
+        with pytest.raises(ValueError, match="mysize"):
+            fl.wiener(np.zeros(8, np.float32), 4)
+
+    def test_dc_offset_precision(self):
+        """f32 E[x^2]-mean^2 would catastrophically cancel at a 1e3 DC
+        offset; the windowed-demeaned form must not (review regression,
+        including an XLA-refusion variant that broke a decomposed
+        formulation under jit)."""
+        x = 1000.0 + 0.1 * RNG.randn(2000)
+        got = np.asarray(fl.wiener(x.astype(np.float32), 11,
+                                   noise=0.01, simd=True))
+        want = ss.wiener(x, 11, noise=0.01)
+        assert np.max(np.abs(got - want)) < 5e-3
+
+    def test_long_signal_precision(self):
+        """No global-accumulator error growth on a 1M-sample signal."""
+        x = RNG.randn(1 << 20)
+        got = np.asarray(fl.wiener(x.astype(np.float32), 9, simd=True))
+        want = ss.wiener(x, 9)
+        assert np.max(np.abs(got[100:-100] - want[100:-100])) < 1e-4

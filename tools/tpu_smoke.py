@@ -316,6 +316,7 @@ def _check_filters(rng):
                  fl.savgol_filter_na(x, 11, 3)),
         _rel_err(fl.savgol_filter(x, 9, 2, deriv=1, simd=True),
                  fl.savgol_filter_na(x, 9, 2, deriv=1)),
+        _rel_err(fl.wiener(x, 7, simd=True), fl.wiener_na(x, 7)),
     ]
     return max(errs), 1e-3
 

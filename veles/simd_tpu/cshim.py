@@ -542,6 +542,14 @@ def filt_savgol(simd, x, length, window_length, polyorder, deriv, delta,
     return 0
 
 
+def filt_wiener(simd, x, length, mysize, noise, result):
+    nz = None if not np.isfinite(noise) else float(noise)
+    _f32(result, length)[...] = np.asarray(
+        _fl.wiener(_f32(x, length), int(mysize), noise=nz,
+                   simd=bool(simd)))
+    return 0
+
+
 def filt_savgol_coeffs(window_length, polyorder, deriv, delta, taps):
     _f64(taps, window_length)[...] = _fl.savgol_coeffs(
         int(window_length), int(polyorder), int(deriv), float(delta))

@@ -369,7 +369,9 @@ def _widths_xla(x, rel_height):
     right_ip = jnp.where(crossed_r, ri - rfrac,
                          jnp.where(hit_edge_r, float(n - 1),
                                    ri.astype(x.dtype)))
-    return right_ip - left_ip, h_eval, left_ip, right_ip
+    # prom rides along: find_peaks with both prominence and width
+    # conditions then needs only this one device pass
+    return right_ip - left_ip, h_eval, left_ip, right_ip, prom
 
 
 def peak_widths(x, peaks, rel_height: float = 0.5, simd=None):
@@ -393,8 +395,8 @@ def peak_widths(x, peaks, rel_height: float = 0.5, simd=None):
     if peaks.size and (peaks.min() < 0 or peaks.max() >= n):
         raise ValueError("peak index out of range")
     if resolve_simd(simd):
-        w, h, li, ri = _widths_xla(jnp.asarray(x, jnp.float32),
-                                   rel_height)
+        w, h, li, ri, _ = _widths_xla(jnp.asarray(x, jnp.float32),
+                                      rel_height)
         pk = jnp.asarray(peaks)
         return (jnp.take(w, pk), jnp.take(h, pk), jnp.take(li, pk),
                 jnp.take(ri, pk))
@@ -433,16 +435,21 @@ def peak_widths_na(x, peaks, rel_height: float = 0.5):
 
 
 def find_peaks(x, height=None, threshold=None, distance=None,
-               prominence=None, simd=None):
+               prominence=None, width=None, rel_height: float = 0.5,
+               simd=None):
     """Local maxima filtered by properties (scipy's ``find_peaks`` for
     the height/threshold/distance/prominence conditions).
 
     Returns ``(peaks, properties)`` — ``peaks`` a host int array of
     indices, ``properties`` holding ``peak_heights`` /
     ``left_thresholds`` / ``right_thresholds`` / ``prominences`` for
-    whichever filters were requested.  Deviations from scipy: plateau
+    whichever filters were requested (``width`` adds ``widths`` /
+    ``width_heights`` / ``left_ips`` / ``right_ips``, measured at
+    ``rel_height`` of the prominence; ``prominences`` is attached
+    whenever either the prominence or width condition is given, as in
+    scipy).  Deviations from scipy: plateau
     peaks are excluded (the reference's strict ``check_peak`` rule,
-    ``src/detect_peaks.c:41-56``); ``wlen``/``width`` and per-peak
+    ``src/detect_peaks.c:41-56``); ``wlen`` and per-peak
     condition arrays are not offered (a length-2 array/tuple is a
     ``(min, max)`` interval).  The peak mask and the prominence pass
     run on device; the cheap per-peak bookkeeping (heights, threshold
@@ -519,16 +526,48 @@ def find_peaks(x, height=None, threshold=None, distance=None,
         peaks = peaks[keep]
         for k in props:
             props[k] = props[k][keep]
-    if prominence is not None:
-        lo, hi = _minmax(prominence)
-        prom = np.asarray(peak_prominences(x_np, peaks, simd=simd))
-        keep = np.ones(len(peaks), bool)
-        if lo is not None:
-            keep &= prom >= lo
-        if hi is not None:
-            keep &= prom <= hi
-        peaks = peaks[keep]
-        for k in props:
-            props[k] = props[k][keep]
-        props["prominences"] = prom[keep]
+    if prominence is not None or width is not None:
+        # one device pass covers both conditions: _widths_xla already
+        # computes the prominences it evaluates widths against (and
+        # scipy likewise always attaches prominences when width is
+        # requested)
+        use = resolve_simd(simd)
+        if width is not None:
+            if use:
+                out = _widths_xla(jnp.asarray(x_np), float(rel_height))
+                w, wh, li, ri, prom = (
+                    np.asarray(jnp.take(a, jnp.asarray(peaks)))
+                    for a in out)
+            else:
+                w, wh, li, ri = (np.asarray(a) for a in
+                                 peak_widths_na(x_np, peaks, rel_height))
+                prom = peak_prominences_na(x_np, peaks)
+        else:
+            prom = np.asarray(peak_prominences(x_np, peaks, simd=simd))
+        if prominence is not None:
+            lo, hi = _minmax(prominence)
+            keep = np.ones(len(peaks), bool)
+            if lo is not None:
+                keep &= prom >= lo
+            if hi is not None:
+                keep &= prom <= hi
+            peaks = peaks[keep]
+            prom = prom[keep]
+            for k in props:
+                props[k] = props[k][keep]
+            if width is not None:
+                w, wh, li, ri = w[keep], wh[keep], li[keep], ri[keep]
+        props["prominences"] = prom
+        if width is not None:
+            lo, hi = _minmax(width)
+            keep = np.ones(len(peaks), bool)
+            if lo is not None:
+                keep &= w >= lo
+            if hi is not None:
+                keep &= w <= hi
+            peaks = peaks[keep]
+            for k in props:
+                props[k] = props[k][keep]
+            props.update(widths=w[keep], width_heights=wh[keep],
+                         left_ips=li[keep], right_ips=ri[keep])
     return peaks, props

@@ -45,7 +45,7 @@ from veles.simd_tpu.utils.config import resolve_simd
 __all__ = [
     "medfilt", "medfilt_na", "medfilt2d", "medfilt2d_na", "order_filter",
     "order_filter_na", "savgol_coeffs", "savgol_filter",
-    "savgol_filter_na", "firwin",
+    "savgol_filter_na", "firwin", "wiener", "wiener_na",
 ]
 
 
@@ -372,3 +372,61 @@ def firwin(numtaps: int, cutoff, pass_zero=True,
         gain = np.abs(np.sum(h * np.exp(-1j * np.pi * fc_mid * m)))
         h /= gain
     return h
+
+
+# ---------------------------------------------------------------------------
+# Wiener (adaptive local-statistics) filter
+# ---------------------------------------------------------------------------
+
+
+def _wiener_core(x, k, noise, xp):
+    # Local statistics in the locally-demeaned windowed form
+    # mean((x_w - mean_w)^2): algebraically identical to scipy's
+    # E[x^2] - mean^2 over the zero-padded window, but every quantity
+    # squared is ALREADY small, so there is no catastrophic f32
+    # cancellation for DC-offset signals (x ~ 1e3 puts x*x at ulp ~0.06
+    # while the variance of interest may be 0.01) — and, unlike an
+    # algebraically pre-cancelled sum of terms, nothing here degrades
+    # if the XLA simplifier reassociates (observed: a decomposed
+    # centered-cumsum formulation was re-fused into the cancelling form
+    # under jit on the CPU backend).
+    win = _window_view_1d(x, k, xp)
+    mean = xp.mean(win, axis=-1)
+    var = xp.mean((win - mean[..., None]) ** 2, axis=-1)
+    if noise is None:
+        noise = xp.mean(var, axis=-1, keepdims=True)
+    excess = xp.maximum(var - noise, 0.0)
+    denom = xp.maximum(var, noise)
+    # scipy: mean + (1 - noise/var)+ * (x - mean), var clipped below at
+    # the noise floor (where the local variance is all noise, output
+    # the local mean)
+    return mean + excess / xp.maximum(denom, 1e-30) * (x - mean)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _wiener_xla(x, k, noise):
+    return _wiener_core(x, k, noise, jnp)
+
+
+def wiener(x, mysize: int = 3, noise=None, simd=None):
+    """Adaptive Wiener denoise (scipy's 1D ``wiener``): each sample is
+    pulled toward its local mean by the fraction of the local variance
+    the noise explains — flat regions are smoothed hard, busy regions
+    are left alone.  ``noise`` defaults to the mean of the local
+    variances (scipy's estimate).  The local statistics are two
+    cumsum-differenced box sums on globally-centered data, one jitted
+    XLA program.
+    """
+    mysize = _check_kernel(mysize, "mysize")
+    if resolve_simd(simd):
+        xj = jnp.asarray(x, jnp.float32)
+        nz = None if noise is None else jnp.float32(noise)
+        return _wiener_xla(xj, mysize, nz)
+    return wiener_na(x, mysize, noise).astype(np.float32)
+
+
+def wiener_na(x, mysize: int = 3, noise=None):
+    """NumPy float64 oracle twin of :func:`wiener`."""
+    mysize = _check_kernel(mysize, "mysize")
+    x = np.asarray(x, np.float64)
+    return _wiener_core(x, mysize, noise, np)
