@@ -11,8 +11,11 @@
 #   1. bench.py            -> headline JSON + BENCH_DETAILS.json + the
 #                             full 14-family smoke (runs last inside it)
 #   2. tools/tpu_smoke.py  -> retry ONLY the families still lacking a
-#                             green hardware run (pallas1d/parallel/
-#                             pallas2d as of 2026-07-31), in case the
+#                             green hardware run (as of late 2026-07-31:
+#                             pallas1d/parallel/pallas2d plus everything
+#                             added this round — iir, filters,
+#                             waveforms, detect_peaks' new analysis, the
+#                             spectral estimation layer), in case the
 #                             bench-embedded smoke got cut
 #   3. tools/tune_conv2d.py --quick   -> 2D crossover measurement
 #   4. tools/tune_overlap_save.py --quick  -> 1D step-size re-check
@@ -42,7 +45,10 @@ run() {
 # able to burn the window twice (update the list as families go green).
 run bench        timeout -k 60 3000 python bench.py --all
 cp -f BENCH_DETAILS.json "$OUT/" 2>/dev/null || true
-run smoke        timeout -k 60 900 python tools/tpu_smoke.py \
+run smoke        timeout -k 60 1500 python tools/tpu_smoke.py \
+                   --family=iir --family=filters --family=waveforms \
+                   --family=spectral --family=resample \
+                   --family=detect_peaks \
                    --family=pallas1d --family=parallel --family=pallas2d
 run tune_conv2d  timeout -k 60 1800 python tools/tune_conv2d.py --quick
 run tune_os      timeout -k 60 1800 python tools/tune_overlap_save.py --quick

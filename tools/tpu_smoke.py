@@ -260,6 +260,9 @@ def _check_spectral(rng):
     errs.append(_rel_err(
         sp.csd(x, x[::-1], nperseg=256, simd=True)[1],
         sp.csd_na(x, x[::-1], nperseg=256)[1]))
+    # Bluestein chirp-Z vs the direct O(nm) z-transform sum
+    errs.append(_rel_err(sp.czt(x[0], 100, simd=True),
+                         sp.czt_na(x[0], 100)))
     return max(errs), 1e-4
 
 
