@@ -167,3 +167,52 @@ def test_conv_precision_config_validated():
         set_config(conv_precision="default")  # 1-pass bf16: explicit only
     with pytest.raises(ValueError, match="conv_precision"):
         set_config(conv_precision="hihg")
+
+
+class TestModes:
+    """numpy/scipy mode slicing on the convenience forms."""
+
+    @pytest.mark.parametrize("n,k", [(100, 17), (17, 100), (64, 64)])
+    @pytest.mark.parametrize("mode", ["full", "same", "valid"])
+    def test_convolve_modes_match_numpy(self, n, k, mode):
+        rng = np.random.RandomState(42)
+        x = rng.randn(n).astype(np.float32)
+        h = rng.randn(k).astype(np.float32)
+        got = np.asarray(cv.convolve(x, h, mode=mode))
+        want = np.convolve(x.astype(np.float64), h.astype(np.float64),
+                           mode=mode)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want,
+                                   atol=1e-3 * max(1, np.abs(want).max()))
+
+    def test_handle_form_mode(self):
+        rng = np.random.RandomState(43)
+        x = rng.randn(256).astype(np.float32)
+        h = rng.randn(31).astype(np.float32)
+        handle = cv.convolve_initialize(256, 31)
+        got = np.asarray(cv.convolve(handle, x, h, mode="same"))
+        want = np.convolve(x.astype(np.float64), h.astype(np.float64),
+                           mode="same")
+        np.testing.assert_allclose(got, want, atol=1e-3)
+
+    @pytest.mark.parametrize("n,k", [(200, 21), (21, 200), (4, 10),
+                                     (10, 4), (64, 64)])
+    def test_correlate_modes(self, n, k):
+        """Both length orderings, including the swap-and-reverse case
+        where numpy's 'same' window shifts by one (review regression)."""
+        from veles.simd_tpu.ops import correlate as cr
+
+        rng = np.random.RandomState(44)
+        x = rng.randn(n).astype(np.float32)
+        h = rng.randn(k).astype(np.float32)
+        for mode in ("full", "same", "valid"):
+            got = np.asarray(cr.cross_correlate(x, h, mode=mode))
+            want = np.correlate(x.astype(np.float64),
+                                h.astype(np.float64), mode=mode)
+            assert got.shape == want.shape, mode
+            np.testing.assert_allclose(got, want, atol=1e-3, err_msg=mode)
+
+    def test_bad_mode(self):
+        with pytest.raises(ValueError, match="mode"):
+            cv.convolve(np.zeros(8, np.float32), np.zeros(3, np.float32),
+                        mode="circular")

@@ -529,21 +529,52 @@ def convolve_initialize(x_length, h_length, algorithm=None, *,
     return _make_handle(x_length, h_length, algorithm, reverse=reverse)
 
 
-def convolve(handle_or_x, x_or_h, h=None, simd=None):
-    """Full linear convolution.
+def _check_mode(mode):
+    if mode not in ("full", "same", "valid"):
+        raise ValueError(f"mode must be 'full', 'same' or 'valid', "
+                         f"got {mode!r}")
+    return mode
+
+
+def _mode_slice(out, n, k, mode, correlate=False):
+    """Slice a FULL conv/correlation result to numpy's ``mode``.
+
+    numpy's 'same' window for ``correlate(x, h)`` with ``len(x) <
+    len(h)`` comes from its swap-and-reverse evaluation, landing one
+    sample later than convolution's centered slice — hence the
+    ``correlate`` flag."""
+    if mode == "full":
+        return out
+    lo, hi = min(n, k), max(n, k)
+    if mode == "same":
+        start = lo // 2 if (correlate and n < k) else (lo - 1) // 2
+        return out[..., start:start + hi]
+    return out[..., lo - 1: hi]  # valid
+
+
+def convolve(handle_or_x, x_or_h, h=None, simd=None, *, mode="full"):
+    """Linear convolution.
 
     Two call forms, mirroring the reference's two entry styles:
 
     * ``convolve(handle, x, h)`` — handle API (``inc/simd/convolve.h:117-126``)
     * ``convolve(x, h)`` — convenience: auto-select per call
+
+    ``mode`` ('full' default, 'same', 'valid' — the numpy/scipy
+    convention) slices the full result; the reference API itself is
+    full-only.
     """
+    _check_mode(mode)
     if isinstance(handle_or_x, ConvolutionHandle):
-        return _run(handle_or_x, x_or_h, h, simd)
+        out = _run(handle_or_x, x_or_h, h, simd)
+        return _mode_slice(out, handle_or_x.x_length,
+                           handle_or_x.h_length, mode)
     x, h_ = handle_or_x, x_or_h
     if h is not None:       # convolve(x, h, simd) positional form
         simd = h
     handle = convolve_initialize(np.shape(x)[-1], np.shape(h_)[-1])
-    return _run(handle, x, h_, simd)
+    return _mode_slice(_run(handle, x, h_, simd),
+                       np.shape(x)[-1], np.shape(h_)[-1], mode)
 
 
 def convolve_finalize(handle):

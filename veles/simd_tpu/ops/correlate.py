@@ -87,16 +87,24 @@ def cross_correlate_initialize(x_length, h_length, algorithm=None):
                                      reverse=True)
 
 
-def cross_correlate(handle_or_x, x_or_h, h=None, simd=None):
+def cross_correlate(handle_or_x, x_or_h, h=None, simd=None, *,
+                    mode="full"):
     """``src/correlate.c:145-159``; also accepts the convenience
-    ``cross_correlate(x, h)`` form like :func:`convolve`."""
+    ``cross_correlate(x, h)`` form like :func:`convolve`, and numpy's
+    ``mode`` ('full'/'same'/'valid') slicing of the full result."""
+    _conv._check_mode(mode)
     if isinstance(handle_or_x, ConvolutionHandle):
-        return _conv._run(handle_or_x, x_or_h, h, simd)
+        out = _conv._run(handle_or_x, x_or_h, h, simd)
+        return _conv._mode_slice(out, handle_or_x.x_length,
+                                 handle_or_x.h_length, mode,
+                                 correlate=True)
     x, h_ = handle_or_x, x_or_h
     if h is not None:
         simd = h
     handle = cross_correlate_initialize(np.shape(x)[-1], np.shape(h_)[-1])
-    return _conv._run(handle, x, h_, simd)
+    return _conv._mode_slice(_conv._run(handle, x, h_, simd),
+                             np.shape(x)[-1], np.shape(h_)[-1], mode,
+                             correlate=True)
 
 
 def cross_correlate_finalize(handle):
