@@ -216,3 +216,16 @@ class TestModes:
         with pytest.raises(ValueError, match="mode"):
             cv.convolve(np.zeros(8, np.float32), np.zeros(3, np.float32),
                         mode="circular")
+
+    def test_reverse_handle_through_convolve_entry(self):
+        """A reverse=True handle computes correlation even when called
+        through convolve(); its 'same' slice must follow the correlate
+        convention (review regression)."""
+        rng = np.random.RandomState(45)
+        x = rng.randn(4).astype(np.float32)
+        v = rng.randn(10).astype(np.float32)
+        handle = cv.convolve_initialize(4, 10, reverse=True)
+        got = np.asarray(cv.convolve(handle, x, v, mode="same"))
+        want = np.correlate(x.astype(np.float64), v.astype(np.float64),
+                            mode="same")
+        np.testing.assert_allclose(got, want, atol=1e-4)

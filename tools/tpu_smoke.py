@@ -263,6 +263,12 @@ def _check_spectral(rng):
     # Bluestein chirp-Z vs the direct O(nm) z-transform sum
     errs.append(_rel_err(sp.czt(x[0], 100, simd=True),
                          sp.czt_na(x[0], 100)))
+    # Lomb-Scargle on uneven samples (dense trig grid, FFT-free)
+    tu = np.sort(rng.uniform(0, 50, 400))
+    xu = np.sin(1.3 * tu).astype(np.float32)
+    fr = np.linspace(0.5, 3.0, 128)
+    errs.append(_rel_err(sp.lombscargle(tu, xu, fr, simd=True),
+                         sp.lombscargle_na(tu, xu, fr)))
     return max(errs), 1e-4
 
 

@@ -567,8 +567,11 @@ def convolve(handle_or_x, x_or_h, h=None, simd=None, *, mode="full"):
     _check_mode(mode)
     if isinstance(handle_or_x, ConvolutionHandle):
         out = _run(handle_or_x, x_or_h, h, simd)
+        # a reverse=True handle computes correlation, whose 'same'
+        # window differs — key off the handle, not the wrapper called
         return _mode_slice(out, handle_or_x.x_length,
-                           handle_or_x.h_length, mode)
+                           handle_or_x.h_length, mode,
+                           correlate=handle_or_x.reverse)
     x, h_ = handle_or_x, x_or_h
     if h is not None:       # convolve(x, h, simd) positional form
         simd = h

@@ -97,7 +97,7 @@ def cross_correlate(handle_or_x, x_or_h, h=None, simd=None, *,
         out = _conv._run(handle_or_x, x_or_h, h, simd)
         return _conv._mode_slice(out, handle_or_x.x_length,
                                  handle_or_x.h_length, mode,
-                                 correlate=True)
+                                 correlate=handle_or_x.reverse)
     x, h_ = handle_or_x, x_or_h
     if h is not None:
         simd = h

@@ -45,7 +45,8 @@ __all__ = [
     "morlet_cwt", "morlet_cwt_na", "hann_window", "frame_count",
     "detrend", "detrend_na", "welch", "welch_na", "periodogram",
     "periodogram_na", "csd", "csd_na", "coherence", "coherence_na",
-    "czt", "czt_na", "zoom_fft",
+    "czt", "czt_na", "zoom_fft", "lombscargle",
+    "lombscargle_na",
 ]
 
 
@@ -662,3 +663,72 @@ def zoom_fft(x, fn, m=None, fs: float = 2.0, simd=None):
     w = np.exp(-2j * np.pi * step / fs)
     a = np.exp(2j * np.pi * f1 / fs)
     return freqs, czt(x, m, w, a, simd=simd)
+
+
+# ---------------------------------------------------------------------------
+# Lomb-Scargle (unevenly-sampled periodogram)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _lombscargle_xla(t, x, freqs):
+    # [m, n] phase grids: the whole periodogram is a handful of
+    # elementwise trig ops + reductions over the sample axis — dense
+    # MXU/VPU work with no FFT and no uniform-sampling requirement
+    wt = freqs[:, None] * t[None, :]
+    # Scargle's tau makes the estimate phase-invariant
+    tau = jnp.arctan2(jnp.sum(jnp.sin(2 * wt), axis=-1),
+                      jnp.sum(jnp.cos(2 * wt), axis=-1)) / 2.0
+    arg = wt - tau[:, None]
+    c, s = jnp.cos(arg), jnp.sin(arg)
+    xc = jnp.sum(x[None, :] * c, axis=-1)
+    xs = jnp.sum(x[None, :] * s, axis=-1)
+    cc = jnp.sum(c * c, axis=-1)
+    ss = jnp.sum(s * s, axis=-1)
+    return 0.5 * (xc * xc / cc + xs * xs / ss)
+
+
+def lombscargle(t, x, freqs, simd=None):
+    """Lomb-Scargle periodogram for UNEVENLY sampled data (scipy's
+    ``lombscargle`` with its default normalization): power of the
+    least-squares sinusoid fit at each angular frequency in ``freqs``.
+
+    No FFT and no resampling: the [m, n] trig evaluation is exactly the
+    dense-compute shape the TPU wants.  ``t``/``freqs`` in reciprocal
+    units (``freqs`` are ANGULAR frequencies, scipy convention).
+    """
+    t = np.asarray(t, np.float64)
+    x_np = np.asarray(x, np.float64)
+    freqs = np.asarray(freqs, np.float64)
+    if t.ndim != 1 or x_np.ndim != 1 or len(t) != len(x_np):
+        raise ValueError("t and x must be 1D of equal length")
+    if freqs.ndim != 1 or len(freqs) == 0:
+        raise ValueError("freqs must be a non-empty 1D array")
+    if np.any(freqs <= 0):
+        raise ValueError("freqs must be positive (angular) frequencies")
+    if resolve_simd(simd):
+        # center the time base in float64 BEFORE the f32 cast: Scargle's
+        # tau makes the estimate exactly time-shift invariant, and raw
+        # offset timestamps (e.g. Julian dates ~2.45e6) would otherwise
+        # push the phase grid to values where f32 spacing exceeds a
+        # radian
+        t = t - t.mean()
+        return _lombscargle_xla(jnp.asarray(t, jnp.float32),
+                                jnp.asarray(x_np, jnp.float32),
+                                jnp.asarray(freqs, jnp.float32))
+    return lombscargle_na(t, x_np, freqs).astype(np.float32)
+
+
+def lombscargle_na(t, x, freqs):
+    """NumPy float64 oracle twin (per-frequency loop, the textbook
+    Scargle formula)."""
+    t = np.asarray(t, np.float64)
+    x = np.asarray(x, np.float64)
+    out = np.empty(len(freqs))
+    for i, w in enumerate(np.asarray(freqs, np.float64)):
+        tau = np.arctan2(np.sum(np.sin(2 * w * t)),
+                         np.sum(np.cos(2 * w * t))) / (2.0)
+        arg = w * t - tau
+        c, s = np.cos(arg), np.sin(arg)
+        out[i] = 0.5 * ((x @ c) ** 2 / (c @ c) + (x @ s) ** 2 / (s @ s))
+    return out
