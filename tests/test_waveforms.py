@@ -126,3 +126,73 @@ class TestPulses:
             wf.gausspulse_na(np.zeros(4), fc=-1)
         with pytest.raises(ValueError, match="bwr"):
             wf.gausspulse_na(np.zeros(4), bwr=3.0)
+
+
+class TestMLSAndWindows:
+    def test_mls_bit_exact_vs_scipy(self):
+        for nb in (2, 3, 5, 8, 12, 15):
+            got, st = wf.max_len_seq(nb)
+            want, wst = ss.max_len_seq(nb)
+            np.testing.assert_array_equal(got, want)
+            np.testing.assert_array_equal(st, wst)
+
+    def test_mls_resume_and_state(self):
+        g1, s1 = wf.max_len_seq(8, length=100)
+        g2, _ = wf.max_len_seq(8, state=s1, length=155)
+        full, _ = ss.max_len_seq(8)
+        np.testing.assert_array_equal(np.r_[g1, g2], full)
+
+    def test_mls_autocorrelation_is_delta(self):
+        """The defining property: the ±1-mapped MLS has circular
+        autocorrelation N at lag 0 and -1 everywhere else."""
+        seq, _ = wf.max_len_seq(10)
+        s = 2.0 * seq - 1.0
+        ac = np.fft.irfft(np.abs(np.fft.rfft(s)) ** 2, len(s))
+        assert abs(ac[0] - len(s)) < 1e-6
+        np.testing.assert_allclose(ac[1:], -1.0, atol=1e-6)
+
+    def test_mls_contracts(self):
+        with pytest.raises(ValueError, match="nbits"):
+            wf.max_len_seq(1)
+        with pytest.raises(ValueError, match="all zero"):
+            wf.max_len_seq(4, state=np.zeros(4))
+
+    def test_windows_match_scipy(self):
+        for name, arg, kw in [("hann", "hann", {}),
+                              ("hamming", "hamming", {}),
+                              ("blackman", "blackman", {}),
+                              ("bartlett", "bartlett", {}),
+                              ("kaiser", ("kaiser", 8.6),
+                               {"beta": 8.6})]:
+            got = wf.get_window(name, 64, **kw)
+            want = ss.get_window(arg, 64, fftbins=False)
+            np.testing.assert_allclose(got, want, atol=1e-12)
+
+    def test_window_feeds_welch(self):
+        """get_window output plugs into the spectral estimators."""
+        from veles.simd_tpu.ops import spectral as sp
+
+        x = np.random.RandomState(13).randn(4096).astype(np.float32)
+        w = wf.get_window("blackman", 256)
+        f1, p1 = sp.welch(x, nperseg=256, window=w, simd=True)
+        # (our get_window is symmetric while scipy's default is
+        # periodic, so feed scipy the identical array)
+        f3, p3 = ss.welch(x.astype(np.float64), nperseg=256, window=w)
+        np.testing.assert_allclose(np.asarray(p1), p3,
+                                   atol=1e-5 * p3.max())
+
+    def test_window_contracts(self):
+        with pytest.raises(ValueError, match="kaiser"):
+            wf.get_window("kaiser", 32)
+        with pytest.raises(ValueError, match="window"):
+            wf.get_window("tukey", 32)
+
+    def test_mls_length_cap(self):
+        with pytest.raises(ValueError, match="2\\^22"):
+            wf.max_len_seq(32)          # full period would be 4e9 bits
+        seq, _ = wf.max_len_seq(32, length=1000)   # explicit length ok
+        assert len(seq) == 1000
+
+    def test_window_stray_kwargs(self):
+        with pytest.raises(ValueError, match="unexpected"):
+            wf.get_window("hann", 32, beta=8.6)

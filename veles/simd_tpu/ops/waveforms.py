@@ -26,6 +26,7 @@ from veles.simd_tpu.utils.config import resolve_simd
 __all__ = [
     "chirp", "chirp_na", "square", "square_na", "sawtooth",
     "sawtooth_na", "gausspulse", "gausspulse_na", "unit_impulse",
+    "max_len_seq", "get_window",
 ]
 
 
@@ -176,3 +177,90 @@ def unit_impulse(n: int, idx: int = 0, simd=None):
     out = np.zeros(n, np.float32)
     out[idx] = 1.0
     return jnp.asarray(out) if resolve_simd(simd) else out
+
+
+# the standard primitive-polynomial tap table (scipy's _mls_taps)
+_MLS_TAPS = {2: [1], 3: [2], 4: [3], 5: [3], 6: [5], 7: [6], 8: [7, 6, 1],
+             9: [5], 10: [7], 11: [9], 12: [11, 10, 4], 13: [12, 11, 8],
+             14: [13, 12, 2], 15: [14], 16: [15, 13, 4], 17: [14],
+             18: [11], 19: [18, 17, 14], 20: [17], 21: [19], 22: [21],
+             23: [18], 24: [23, 22, 17], 25: [22], 26: [25, 24, 20],
+             27: [26, 25, 22], 28: [25], 29: [27], 30: [29, 28, 7],
+             31: [28], 32: [31, 30, 10]}
+
+
+def max_len_seq(nbits: int, state=None, length=None):
+    """Maximum-length sequence (scipy's ``max_len_seq``): the
+    ``2^nbits - 1``-periodic pseudo-random binary sequence from a
+    Fibonacci LFSR — the classic broadband excitation for impulse-
+    response measurement (its circular autocorrelation is a delta).
+
+    Returns ``(seq, final_state)`` with ``seq`` uint8 in {0, 1}.
+    Host-side (a sequential register by definition); map to ±1 and hand
+    the result to the device pipeline.  Generation is a per-bit Python
+    loop (the scipy tap tables leave a dependency distance of 1, so
+    block vectorization doesn't apply); lengths are capped at 2^22 —
+    large ``nbits`` stay usable by passing an explicit ``length`` and
+    resuming via ``state``.
+    """
+    nbits = int(nbits)
+    if nbits not in _MLS_TAPS:
+        raise ValueError(f"nbits must be in [2, 32], got {nbits}")
+    period = (1 << nbits) - 1
+    length = period if length is None else int(length)
+    if length < 0:
+        raise ValueError("length must be >= 0")
+    if length > 1 << 22:
+        raise ValueError(
+            f"length {length} > 2^22: the per-bit host loop would take "
+            "minutes+; generate in <= 4M-sample pieces (resume with the "
+            "returned state) or reduce nbits")
+    if state is None:
+        reg = np.ones(nbits, np.int8)
+    else:
+        reg = (np.asarray(state) != 0).astype(np.int8)
+        if reg.shape != (nbits,) or not reg.any():
+            raise ValueError(f"state must be {nbits} bits, not all zero")
+    taps = _MLS_TAPS[nbits]
+    out = np.empty(length, np.uint8)
+    # scipy's register convention: emit reg[0], feedback from the
+    # absolute tap positions, shift left, feedback enters at the tail
+    for i in range(length):
+        fb = reg[0]
+        out[i] = fb
+        for t in taps:
+            fb ^= reg[t]
+        reg[:-1] = reg[1:]
+        reg[-1] = fb
+    return out, reg
+
+
+def get_window(name, n: int, **kwargs) -> np.ndarray:
+    """Symmetric analysis windows by name (a small ``scipy.signal.
+    get_window`` subset): 'hann', 'hamming', 'blackman', 'bartlett',
+    'boxcar', or 'kaiser' (needs ``beta=``).  Float64 host-side — pass
+    the result to :func:`~veles.simd_tpu.ops.spectral.stft`/``welch``
+    or use as FIR taps weighting."""
+    n = int(n)
+    if n < 1:
+        raise ValueError("n must be >= 1")
+    name = str(name).lower()
+    stray = set(kwargs) - ({"beta"} if name == "kaiser" else set())
+    if stray:
+        raise ValueError(f"unexpected arguments {sorted(stray)} for "
+                         f"window {name!r}")
+    if name in ("hann", "hanning"):
+        return np.hanning(n)
+    if name == "hamming":
+        return np.hamming(n)
+    if name == "blackman":
+        return np.blackman(n)
+    if name == "bartlett":
+        return np.bartlett(n)
+    if name in ("boxcar", "rect", "rectangular"):
+        return np.ones(n)
+    if name == "kaiser":
+        if "beta" not in kwargs:
+            raise ValueError("kaiser window needs beta=")
+        return np.kaiser(n, float(kwargs["beta"]))
+    raise ValueError(f"unknown window {name!r}")
