@@ -560,6 +560,63 @@ static void test_psd(void) {
   CHECK(fabs(pfreqs[pmax] - 0.2) < 2.0 / N + 1e-9);
 }
 
+static void test_czt_ls(void) {
+  enum { N = 256, M = 128 };
+  float x[N], spec[2 * M];
+  for (int i = 0; i < N; i++) {
+    x[i] = cosf(2.f * (float)M_PI * 25.f * (float)i / N);
+  }
+  /* default czt on m=N == the DFT: the tone lands at bin 25 */
+  static float full[2 * N];
+  CHECK(spectral_czt(1, x, N, N, 0.0, 0.0, 1.0, 0.0, full) == 0);
+  int best = 0;
+  double bm = 0.0;
+  for (int k = 1; k < N / 2; k++) {
+    double mag = hypot(full[2 * k], full[2 * k + 1]);
+    if (mag > bm) {
+      bm = mag;
+      best = k;
+    }
+  }
+  CHECK(best == 25);
+  /* zoomed band around the tone: peak frequency within one zoom bin */
+  double freqs[M];
+  CHECK(spectral_zoom_fft(1, x, N, 0.15, 0.25, M, 2.0, freqs, spec)
+        == 0);
+  best = 0;
+  bm = 0.0;
+  for (int k = 0; k < M; k++) {
+    double mag = hypot(spec[2 * k], spec[2 * k + 1]);
+    if (mag > bm) {
+      bm = mag;
+      best = k;
+    }
+  }
+  CHECK(fabs(freqs[best] - 2.0 * 25.0 / N) < 0.1 / M + 1e-9);
+
+  /* Lomb-Scargle on irregular samples finds the angular frequency */
+  enum { NU = 300, NF = 200 };
+  static double tu[NU], lsf[NF];
+  static float xu[NU], power[NF];
+  double tcur = 0.0;
+  for (int i = 0; i < NU; i++) {
+    tcur += 0.05 + 0.13 * ((i * 2654435761u >> 8) % 100) / 100.0;
+    tu[i] = tcur;
+    xu[i] = (float)sin(1.7 * tcur);
+  }
+  for (int i = 0; i < NF; i++) {
+    lsf[i] = 0.5 + 2.5 * i / (NF - 1);
+  }
+  CHECK(spectral_lombscargle(1, tu, xu, NU, lsf, NF, power) == 0);
+  best = 0;
+  for (int i = 1; i < NF; i++) {
+    if (power[i] > power[best]) {
+      best = i;
+    }
+  }
+  CHECK(fabs(lsf[best] - 1.7) < 0.05);
+}
+
 static void test_iir(void) {
   enum { N = 300 };
   /* design: section counts (ceil(poles/2)) and SOS normalization */
@@ -990,6 +1047,7 @@ int main(void) {
   test_spectral();
   test_resample();
   test_psd();
+  test_czt_ls();
   test_iir();
   test_filters();
   test_normalize();

@@ -639,6 +639,30 @@ size_t resample_length(size_t length, size_t up, size_t down) {
   return (length * up + down - 1) / down;
 }
 
+int spectral_czt(int simd, const float *x, size_t length, size_t m,
+                 double w_re, double w_im, double a_re, double a_im,
+                 float *result) {
+  return shim_run("spectral_czt", "(iKkkddddK)", simd, PTR(x),
+                  (unsigned long)length, (unsigned long)m, w_re, w_im,
+                  a_re, a_im, PTR(result));
+}
+
+int spectral_zoom_fft(int simd, const float *x, size_t length, double f1,
+                      double f2, size_t m, double fs, double *freqs,
+                      float *result) {
+  return shim_run("spectral_zoom_fft", "(iKkddkdKK)", simd, PTR(x),
+                  (unsigned long)length, f1, f2, (unsigned long)m, fs,
+                  PTR(freqs), PTR(result));
+}
+
+int spectral_lombscargle(int simd, const double *t, const float *x,
+                         size_t length, const double *freqs,
+                         size_t n_freqs, float *power) {
+  return shim_run("spectral_lombscargle", "(iKKkKkK)", simd, PTR(t),
+                  PTR(x), (unsigned long)length, PTR(freqs),
+                  (unsigned long)n_freqs, PTR(power));
+}
+
 size_t welch_bins(size_t length, size_t nperseg) {
   size_t seg = nperseg < length ? nperseg : length;
   return seg / 2 + 1;

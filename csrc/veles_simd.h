@@ -297,6 +297,24 @@ int spectral_coherence(int simd, const float *x, const float *y,
                        size_t length, double fs, size_t nperseg,
                        long noverlap, double *freqs, float *coh);
 
+/* Chirp-Z transform (Bluestein): m z-transform samples along the
+ * spiral z = a * w^-k; w = 0+0i selects the DFT default
+ * exp(-2 pi i / m).  result: m interleaved (re, im) float pairs. */
+int spectral_czt(int simd, const float *x, size_t length, size_t m,
+                 double w_re, double w_im, double a_re, double a_im,
+                 float *result);
+/* Zoomed DFT over [f1, f2) at sample rate fs (endpoint-exclusive grid,
+ * scipy zoom_fft): freqs holds m float64, result m (re, im) pairs. */
+int spectral_zoom_fft(int simd, const float *x, size_t length, double f1,
+                      double f2, size_t m, double fs, double *freqs,
+                      float *result);
+/* Lomb-Scargle periodogram for UNEVENLY sampled data: t float64
+ * timestamps, freqs float64 positive ANGULAR frequencies; power holds
+ * n_freqs floats. */
+int spectral_lombscargle(int simd, const double *t, const float *x,
+                         size_t length, const double *freqs,
+                         size_t n_freqs, float *power);
+
 /* ---- resample — no reference analog (rate conversion over the same
  * conv machinery as src/convolve.c; the polyphase cascade runs as one
  * dilated/strided XLA conv). ------------------------------------------- */

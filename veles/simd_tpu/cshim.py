@@ -404,6 +404,30 @@ def spectral_coherence(simd, x, y, length, fs, nperseg, noverlap, freqs,
     return 0
 
 
+def spectral_czt(simd, x, length, m, w_re, w_im, a_re, a_im, result):
+    w = None if (w_re == 0.0 and w_im == 0.0) else complex(w_re, w_im)
+    out = _sp.czt(_f32(x, length), int(m), w, complex(a_re, a_im),
+                  simd=bool(simd))
+    _cplx_out(result, out, int(m))
+    return 0
+
+
+def spectral_zoom_fft(simd, x, length, f1, f2, m, fs, freqs, result):
+    f, out = _sp.zoom_fft(_f32(x, length), [float(f1), float(f2)],
+                          int(m), fs=float(fs), simd=bool(simd))
+    _f64(freqs, int(m))[...] = f
+    _cplx_out(result, out, int(m))
+    return 0
+
+
+def spectral_lombscargle(simd, t, x, length, freqs, n_freqs, power):
+    f = _f64(freqs, n_freqs)
+    out = _sp.lombscargle(_f64(t, length), _f32(x, length), f,
+                          simd=bool(simd))
+    _f32(power, n_freqs)[...] = np.asarray(out)
+    return 0
+
+
 # ---- resample -------------------------------------------------------------
 
 def resample_poly(simd, x, length, up, down, taps, num_taps, result):
