@@ -237,3 +237,64 @@ class TestWiener:
         got = np.asarray(fl.wiener(x.astype(np.float32), 9, simd=True))
         want = ss.wiener(x, 9)
         assert np.max(np.abs(got[100:-100] - want[100:-100])) < 1e-4
+
+
+class TestFirwin2Deconvolve:
+    @pytest.mark.parametrize("args", [
+        (65, [0, 0.3, 0.3, 1], [1, 1, 0, 0]),      # brick-wall lowpass
+        (64, [0, 0.5, 1], [1, 1, 0]),              # even taps, 0 at Nyq
+        (33, [0, 0.2, 0.5, 1], [0, 1, 0.5, 0]),    # shaped response
+    ])
+    def test_firwin2_matches_scipy(self, args):
+        np.testing.assert_allclose(fl.firwin2(*args), ss.firwin2(*args),
+                                   atol=1e-12)
+
+    def test_firwin2_response_tracks_breakpoints(self):
+        h = fl.firwin2(101, [0, 0.4, 0.5, 1], [1, 1, 0, 0])
+        from veles.simd_tpu.ops import iir
+
+        _, resp = iir.frequency_response(h, [1.0], 512)
+        w = np.linspace(0, 1, 512, endpoint=False)
+        assert np.abs(resp[w < 0.35]).min() > 0.98
+        assert np.abs(resp[w > 0.6]).max() < 0.01
+
+    def test_firwin2_contracts(self):
+        with pytest.raises(ValueError, match="start at 0"):
+            fl.firwin2(33, [0.1, 1], [1, 0])
+        with pytest.raises(ValueError, match="Type II"):
+            fl.firwin2(32, [0, 1], [1, 1])
+        with pytest.raises(ValueError, match="nondecreasing"):
+            fl.firwin2(33, [0, 0.5, 0.3, 1], [1, 1, 0, 0])
+
+    def test_deconvolve_matches_scipy(self):
+        num = np.convolve([1, 2, 3, 4, 5.0], [1, 0.5, 0.25])
+        num = num + np.r_[np.zeros(5), [1e-2, -2e-2]]
+        gq, gr = fl.deconvolve(num, [1, 0.5, 0.25])
+        wq, wr = ss.deconvolve(num, [1, 0.5, 0.25])
+        np.testing.assert_allclose(gq, wq, atol=1e-12)
+        np.testing.assert_allclose(gr, wr, atol=1e-12)
+
+    def test_deconvolve_round_trip(self):
+        rng = np.random.RandomState(14)
+        q = rng.randn(20)
+        d = np.r_[1.0, rng.randn(4) * 0.3]
+        sig = np.convolve(d, q)
+        gq, gr = fl.deconvolve(sig, d)
+        np.testing.assert_allclose(gq, q, atol=1e-10)
+        np.testing.assert_allclose(gr, 0.0, atol=1e-10)
+
+    def test_deconvolve_contracts(self):
+        with pytest.raises(ValueError, match="nonzero"):
+            fl.deconvolve([1.0, 2.0], [0.0, 1.0])
+        q, r = fl.deconvolve([1.0], [1.0, 2.0, 3.0])
+        assert len(q) == 0 and np.array_equal(r, [1.0])  # scipy: empty
+        with pytest.raises(ValueError, match="1D"):
+            fl.deconvolve(np.ones((2, 3)), [1.0])
+
+    def test_firwin2_grid_aligned_breakpoint(self):
+        """A brick wall landing exactly on an interpolation grid point
+        must sample the jump midpoint like scipy (review regression:
+        the symmetric eps nudge)."""
+        args = (65, [0, 0.25, 0.25, 1], [1, 1, 0, 0])
+        np.testing.assert_allclose(fl.firwin2(*args), ss.firwin2(*args),
+                                   atol=1e-12)

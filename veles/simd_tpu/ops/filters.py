@@ -45,7 +45,8 @@ from veles.simd_tpu.utils.config import resolve_simd
 __all__ = [
     "medfilt", "medfilt_na", "medfilt2d", "medfilt2d_na", "order_filter",
     "order_filter_na", "savgol_coeffs", "savgol_filter",
-    "savgol_filter_na", "firwin", "wiener", "wiener_na",
+    "savgol_filter_na", "firwin", "firwin2", "wiener",
+    "wiener_na", "deconvolve",
 ]
 
 
@@ -430,3 +431,78 @@ def wiener_na(x, mysize: int = 3, noise=None):
     mysize = _check_kernel(mysize, "mysize")
     x = np.asarray(x, np.float64)
     return _wiener_core(x, mysize, noise, np)
+
+
+def firwin2(numtaps: int, freq, gain, nfreqs=None,
+            window: str = "hamming") -> np.ndarray:
+    """Frequency-sampling FIR design (scipy's ``firwin2`` for Type I/II
+    filters): taps whose magnitude response linearly interpolates the
+    ``(freq, gain)`` breakpoints (``freq`` ascending in [0, 1], Nyquist
+    = 1).  Float64 host-side.
+    """
+    numtaps = int(numtaps)
+    if numtaps < 3:
+        raise ValueError("numtaps must be >= 3")
+    freq = np.asarray(freq, np.float64)
+    gain = np.asarray(gain, np.float64)
+    if freq.shape != gain.shape or freq.ndim != 1 or len(freq) < 2:
+        raise ValueError("freq and gain must be equal-length 1D with "
+                         ">= 2 points")
+    if freq[0] != 0.0 or freq[-1] != 1.0:
+        raise ValueError("freq must start at 0 and end at 1")
+    if np.any(np.diff(freq) < 0):
+        raise ValueError("freq must be nondecreasing")
+    if numtaps % 2 == 0 and gain[-1] != 0.0:
+        raise ValueError("even numtaps (Type II) forces zero gain at "
+                         "Nyquist; set gain[-1] = 0")
+    if nfreqs is None:
+        nfreqs = 1 + (1 << int(np.ceil(np.log2(numtaps))))
+    nfreqs = int(nfreqs)
+    if nfreqs < numtaps:
+        raise ValueError("nfreqs must be >= numtaps")
+    # scipy's SYMMETRIC eps nudge: each duplicated breakpoint (brick
+    # wall) moves eps*nfreqs to either side, so a grid point landing
+    # exactly on the discontinuity samples the jump midpoint like scipy
+    f = freq.copy()
+    d = np.diff(f)
+    if (d == 0).any():
+        eps = np.finfo(np.float64).eps * nfreqs
+        for k in np.nonzero(d == 0)[0]:
+            f[k] -= eps
+            f[k + 1] += eps
+    grid = np.linspace(0.0, 1.0, nfreqs)
+    mag = np.interp(grid, f, gain)
+    # linear phase: delay (numtaps-1)/2, then one irfft
+    shift = np.exp(-(numtaps - 1) / 2.0 * 1j * np.pi * grid)
+    h = np.fft.irfft(mag * shift, 2 * (nfreqs - 1))[:numtaps]
+    from veles.simd_tpu.ops.waveforms import get_window
+
+    win = np.ones(numtaps) if window is None \
+        else get_window(window, numtaps)
+    return h * win
+
+
+def deconvolve(signal, divisor):
+    """Polynomial long division (scipy's ``deconvolve``): the
+    ``(quotient, remainder)`` with ``signal = convolve(divisor,
+    quotient) + remainder``.  An inherently sequential recurrence on
+    tiny operands — float64 host-side by design (use :mod:`.iir`'s
+    ``lfilter`` machinery for long-signal inverse filtering instead).
+    """
+    num = np.atleast_1d(np.asarray(signal, np.float64))
+    den = np.atleast_1d(np.asarray(divisor, np.float64))
+    if num.ndim != 1 or den.ndim != 1:
+        raise ValueError("signal and divisor must be 1D")
+    if den[0] == 0.0:
+        raise ValueError("divisor[0] must be nonzero")
+    if len(num) < len(den):
+        # scipy convention: empty quotient (the zero polynomial)
+        return np.zeros(0), num.copy()
+    n_out = len(num) - len(den) + 1
+    quot = np.zeros(n_out)
+    rem = num.copy()
+    for i in range(n_out):
+        q = rem[i] / den[0]
+        quot[i] = q
+        rem[i:i + len(den)] -= q * den
+    return quot, rem
