@@ -685,6 +685,16 @@ static void test_iir(void) {
   CHECK_NEAR(y[N - 1], 1.0, 1e-3);
   CHECK(iir_cheby1(3, 0.0, 0.25, 0.0, VELES_IIR_LOWPASS, NULL) < 0);
 
+  /* Bessel: sections count + DC passthrough */
+  double bsos[3][6];
+  CHECK(iir_bessel(5, 0.2, 0.0, VELES_IIR_LOWPASS, NULL) == 3);
+  CHECK(iir_bessel(5, 0.2, 0.0, VELES_IIR_LOWPASS, &bsos[0][0]) == 3);
+  for (int i = 0; i < N; i++) {
+    x[i] = 1.0f;
+  }
+  CHECK(iir_sosfilt(1, &bsos[0][0], 3, x, N, NULL, y) == 0);
+  CHECK_NEAR(y[N - 1], 1.0, 1e-3);
+
   /* streaming: two blocks == one shot */
   for (int i = 0; i < N; i++) {
     x[i] = sinf(0.37f * (float)i);

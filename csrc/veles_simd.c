@@ -733,6 +733,17 @@ int iir_butterworth(size_t order, double low, double high,
   return (int)sections;
 }
 
+int iir_bessel(size_t order, double low, double high,
+               VelesIirBandType btype, double *sos) {
+  long sections = -1;
+  if (shim_call_parse("iir_bessel", parse_long, &sections, "(kddiK)",
+                      (unsigned long)order, low, high, (int)btype,
+                      PTR(sos)) != 0) {
+    return -1;
+  }
+  return (int)sections;
+}
+
 int iir_cheby1(size_t order, double rp, double low, double high,
                VelesIirBandType btype, double *sos) {
   long sections = -1;

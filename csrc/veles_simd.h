@@ -349,8 +349,11 @@ typedef enum {
  * sos = NULL first to size the buffer); negative on error. */
 int iir_butterworth(size_t order, double low, double high,
                     VelesIirBandType btype, double *sos);
-/* Chebyshev type-I (rp dB passband ripple) / type-II (rs dB stopband
+/* Bessel/Thomson (maximally-flat group delay, phase norm) and
+ * Chebyshev type-I (rp dB passband ripple) / type-II (rs dB stopband
  * attenuation) designs; same calling convention as iir_butterworth. */
+int iir_bessel(size_t order, double low, double high,
+               VelesIirBandType btype, double *sos);
 int iir_cheby1(size_t order, double rp, double low, double high,
                VelesIirBandType btype, double *sos);
 int iir_cheby2(size_t order, double rs, double low, double high,

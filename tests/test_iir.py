@@ -319,3 +319,53 @@ class TestStreaming:
         sos = iir.butterworth(2, 0.3)
         with pytest.raises(ValueError, match="2 samples"):
             iir.sosfilt(sos, np.zeros(1, np.float32), return_zf=True)
+
+
+class TestBessel:
+    CASES = [(2, 0.3, "lowpass"), (4, 0.25, "lowpass"),
+             (5, 0.4, "highpass"), (3, (0.2, 0.5), "bandpass"),
+             (4, (0.3, 0.6), "bandstop"), (1, 0.3, "lowpass"),
+             (8, 0.2, "lowpass")]
+
+    @pytest.mark.parametrize("order,wn,bt", CASES)
+    def test_matches_scipy(self, order, wn, bt):
+        _, h1 = iir.sos_frequency_response(iir.bessel(order, wn, bt),
+                                           128)
+        _, h2 = ss.sosfreqz(ss.bessel(order, wn, bt, norm="phase",
+                                      output="sos"), worN=128)
+        np.testing.assert_allclose(h1, h2, atol=1e-10)
+
+    def test_group_delay_flatness(self):
+        """The defining property: in-band group delay is flat — far
+        flatter than a Butterworth of the same order.  Low cutoff: the
+        bilinear transform's phase warp erodes the analog property as
+        the cutoff approaches Nyquist (0.15 gives ~14x here; at 0.4 the
+        advantage shrinks to ~2x)."""
+        w, hb = iir.sos_frequency_response(iir.bessel(5, 0.15), 2048)
+        _, hw = iir.sos_frequency_response(iir.butterworth(5, 0.15),
+                                           2048)
+
+        def gd(h):
+            ph = np.unwrap(np.angle(h))
+            return -np.diff(ph) / (np.pi * np.diff(w))
+
+        band = (w[:-1] > 0.01) & (w[:-1] < 0.1)
+        spread_b = np.ptp(gd(hb)[band])
+        spread_w = np.ptp(gd(hw)[band])
+        assert spread_b < 0.15 * spread_w
+
+    def test_pulse_shape_preserved(self):
+        """A Gaussian pulse through a Bessel lowpass keeps its shape
+        (no ringing) — the reason this design exists."""
+        t = (np.arange(2048) - 1024) / 8000.0
+        x = np.exp(-(t * 400) ** 2).astype(np.float32)
+        y = np.asarray(iir.sosfilt(iir.bessel(4, 0.5), x, simd=True))
+        # no overshoot below the baseline beyond 1% of the peak
+        assert y.min() > -0.01 * y.max()
+
+    def test_runs_through_sosfilt(self):
+        x = RNG.randn(2, 300).astype(np.float32)
+        sos = iir.bessel(4, 0.3)
+        got = np.asarray(iir.sosfilt(sos, x, simd=True))
+        want = ss.sosfilt(sos, x.astype(np.float64), axis=-1)
+        np.testing.assert_allclose(got, want, atol=2e-5)

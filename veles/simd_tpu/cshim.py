@@ -476,6 +476,11 @@ def iir_butterworth(order, low, high, btype, sos_out):
                        low, high, btype, sos_out)
 
 
+def iir_bessel(order, low, high, btype, sos_out):
+    return _iir_design(lambda c, bt: _iir.bessel(int(order), c, bt),
+                       low, high, btype, sos_out)
+
+
 def iir_cheby1(order, rp, low, high, btype, sos_out):
     return _iir_design(
         lambda c, bt: _iir.cheby1(int(order), float(rp), c, bt),

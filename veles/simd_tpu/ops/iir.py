@@ -47,7 +47,8 @@ import numpy as np
 from veles.simd_tpu.utils.config import resolve_simd
 
 __all__ = [
-    "butterworth", "cheby1", "cheby2", "sosfilt", "sosfilt_na",
+    "butterworth", "cheby1", "cheby2", "bessel", "sosfilt",
+    "sosfilt_na",
     "sosfiltfilt", "sosfiltfilt_na", "lfilter", "lfilter_na",
     "sos_frequency_response", "frequency_response", "sosfilt_zi",
     "StreamingSosfilt",
@@ -217,6 +218,29 @@ def _prototype_to_digital_sos(z, p, k, cutoff, btype) -> np.ndarray:
         raise ValueError(f"unknown btype {btype!r}")
     zd, pd, kd = _bilinear_zpk(z, p, k, fs)
     return _zpk_to_sos(zd, pd, kd)
+
+
+def bessel(order: int, cutoff, btype: str = "lowpass") -> np.ndarray:
+    """Bessel/Thomson digital filter as SOS (scipy's ``bessel(...,
+    norm='phase', output='sos')``): maximally-flat GROUP DELAY — the
+    design for pulse shapes that must not ring.  ``cutoff`` marks the
+    phase-normalized characteristic frequency (scipy's default norm),
+    as a fraction of Nyquist.
+
+    The analog prototype's poles are the roots of the reverse Bessel
+    polynomial ``theta_n(s) = sum_k (2n-k)! / (2^(n-k) k! (n-k)!) s^k``
+    scaled by ``a_0^(-1/n)`` (the phase normalization), all host-side
+    float64.
+    """
+    order = _check_order(order)
+    coeffs = [math.factorial(2 * order - k)
+              / (2 ** (order - k) * math.factorial(k)
+                 * math.factorial(order - k))
+              for k in range(order + 1)]
+    p = np.roots(coeffs[::-1]) / coeffs[0] ** (1.0 / order)
+    k = float(np.real(np.prod(-p)))  # == 1 by the normalization
+    return _prototype_to_digital_sos(np.array([], complex), p, k, cutoff,
+                                     btype)
 
 
 def cheby1(order: int, rp: float, cutoff,
