@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Sub-bin frequency analysis: zoom FFT and Lomb-Scargle side by side.
+
+Two ways past the FFT-bin resolution wall, on one problem — a 0.5 Hz
+doppler pair at 400 Hz that an ordinary periodogram bin grid cannot
+separate at this capture length:
+
+1. ``spectral.zoom_fft``   — uniform samples: Bluestein chirp-Z zooms a
+                             5 Hz band onto a millihertz grid.
+2. ``spectral.lombscargle`` — the same physics when 35 % of the samples
+                             are MISSING (dropouts): least-squares
+                             sinusoid fits need no uniform grid at all.
+
+Run:  python examples/spectral_zoom.py
+      VELES_SIMD_PLATFORM=cpu python examples/spectral_zoom.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from veles.simd_tpu.utils.platform import maybe_override_platform
+
+maybe_override_platform()
+
+from veles.simd_tpu.ops import spectral as sp  # noqa: E402
+
+
+def two_peaks(freq_axis, mag):
+    i1 = int(np.argmax(mag))
+    m2 = mag.copy()
+    lo = max(0, i1 - len(mag) // 20)
+    m2[lo: i1 + len(mag) // 20] = 0
+    i2 = int(np.argmax(m2))
+    return sorted((float(freq_axis[i1]), float(freq_axis[i2])))
+
+
+def main():
+    fs, n = 2000.0, 1 << 14
+    f_a, f_b = 400.0, 400.5          # 0.5 Hz apart; FFT bin = 0.12 Hz
+    rng = np.random.RandomState(0)
+    t = np.arange(n) / fs
+    clean = (np.sin(2 * np.pi * f_a * t)
+             + 0.5 * np.sin(2 * np.pi * f_b * t))
+    x = (clean + 0.3 * rng.randn(n)).astype(np.float32)
+
+    # 1. uniform capture: zoom a 5 Hz band to 1.2 mHz resolution
+    f, z = sp.zoom_fft(x, [398.0, 403.0], m=4096, fs=fs)
+    pair = two_peaks(f, np.abs(np.asarray(z)))
+    print(f"zoom_fft     : {pair[0]:8.3f} / {pair[1]:8.3f} Hz "
+          f"(true {f_a} / {f_b})")
+    ok1 = abs(pair[0] - f_a) < 0.05 and abs(pair[1] - f_b) < 0.05
+
+    # 2. the same signal with 35% dropouts: Lomb-Scargle on what's left
+    keep = np.sort(rng.choice(n, int(0.65 * n), replace=False))
+    w = 2 * np.pi * np.linspace(398.0, 403.0, 4096)
+    p = np.asarray(sp.lombscargle(t[keep], x[keep] - x[keep].mean(), w))
+    pair2 = two_peaks(w / (2 * np.pi), p)
+    print(f"lombscargle  : {pair2[0]:8.3f} / {pair2[1]:8.3f} Hz "
+          f"(35% of samples missing)")
+    ok2 = abs(pair2[0] - f_a) < 0.05 and abs(pair2[1] - f_b) < 0.05
+
+    print("OK" if ok1 and ok2 else "FAILED")
+    return 0 if ok1 and ok2 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -342,6 +342,42 @@ def main():
               lambda: iir.sosfilt_na(sos, xi), samples=xi.size,
               baseline_repeats=1)
 
+    # --- filters: median (gather + lane sort) on batched signals ---
+    from veles.simd_tpu.ops import filters as flt
+
+    def med_step(v):
+        return flt.medfilt(v, 7, simd=True)
+
+    benchmark(f"medfilt k=7 {bi}x{ni >> 10}k", med_step, xid,
+              lambda: flt.medfilt_na(xi, 7), samples=xi.size,
+              baseline_repeats=1)
+
+    # --- czt: Bluestein zoom on a long capture ---
+    def czt_step(v):
+        z = sp.czt(v, 1024, simd=True)
+        return v + 1e-30 * jnp.abs(z[..., 0])
+
+    # baseline = the host Bluestein fallback at FULL size (the direct
+    # O(n*m) oracle would need a 16 GB matrix at 1M samples)
+    benchmark(f"czt {ns >> 10}k -> 1024 bins", czt_step, xsd,
+              lambda: sp.czt(xs, 1024, simd=False), samples=xs.size,
+              baseline_repeats=1)
+
+    # --- lombscargle: dense [freqs, samples] trig grid on the MXU ---
+    tu = np.sort(rng.uniform(0, 100, 1 << 14))
+    xu = np.sin(1.7 * tu).astype(np.float32)
+    fr = np.linspace(0.5, 3.0, 1024)
+    tud = jnp.asarray(tu, jnp.float32)
+    xud, frd = jnp.asarray(xu), jnp.asarray(fr, jnp.float32)
+
+    def ls_step(v):
+        p = sp._lombscargle_xla(tud, v, frd)
+        return v + 1e-30 * p[..., 0]
+
+    benchmark("lombscargle 16k x 1024", ls_step, xud,
+              lambda: sp.lombscargle_na(tu, xu, fr),
+              samples=len(tu) * len(fr), baseline_repeats=1)
+
 
 if __name__ == "__main__":
     main()
