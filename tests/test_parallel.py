@@ -736,3 +736,58 @@ class TestShardedWelch:
         mesh = par.make_mesh({"sp": 8})
         with pytest.raises(ValueError, match="divisible"):
             par.sharded_welch(np.zeros(4095, np.float32), mesh)
+
+
+class TestShardedResample:
+    @pytest.mark.parametrize("n,up,down", [
+        (2048, 2, 1), (2048, 1, 4), (2352, 160, 147), (4096, 3, 2)])
+    def test_matches_single_chip(self, n, up, down):
+        from veles.simd_tpu.ops import resample as rs
+
+        mesh = par.make_mesh({"sp": 8})
+        rng = np.random.RandomState(67)
+        x = rng.randn(n).astype(np.float32)
+        got = np.asarray(par.sharded_resample_poly(x, up, down, mesh))
+        want = np.asarray(rs.resample_poly(x, up, down, simd=True))
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_batched_and_2d_mesh(self):
+        from veles.simd_tpu.ops import resample as rs
+
+        mesh = par.make_mesh({"dp": 2, "sp": 4})
+        rng = np.random.RandomState(68)
+        xb = rng.randn(3, 1024).astype(np.float32)
+        got = np.asarray(par.sharded_resample_poly(xb, 2, 1, mesh,
+                                                   axis="sp"))
+        want = np.asarray(rs.resample_poly(xb, 2, 1, simd=True))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_tone_preserved(self):
+        """48k -> 44.1k of a tone keeps its frequency (physics check
+        across the shard boundaries)."""
+        mesh = par.make_mesh({"sp": 8})
+        fs = 48000.0
+        n = 2352 * 4
+        t = np.arange(n) / fs
+        x = np.sin(2 * np.pi * 997.0 * t).astype(np.float32)
+        y = np.asarray(par.sharded_resample_poly(x, 160, 147, mesh))
+        t2 = np.arange(len(y)) * 147 / (160 * fs)
+        core = slice(400, -400)
+        np.testing.assert_allclose(
+            y[core], np.sin(2 * np.pi * 997.0 * t2)[core], atol=5e-3)
+
+    def test_contracts(self):
+        mesh = par.make_mesh({"sp": 8})
+        with pytest.raises(ValueError, match="divisible into"):
+            par.sharded_resample_poly(np.zeros(1001, np.float32), 2, 1,
+                                      mesh)
+        with pytest.raises(ValueError, match="ownership"):
+            par.sharded_resample_poly(np.zeros(2048, np.float32), 160,
+                                      147, mesh)  # 256*160 % 147 != 0
+
+    def test_empty_signal(self):
+        mesh = par.make_mesh({"sp": 8})
+        with pytest.raises(ValueError, match="empty"):
+            par.sharded_resample_poly(np.zeros(0, np.float32), 2, 1,
+                                      mesh)

@@ -519,6 +519,14 @@ def _check_parallel(rng):
     _, pw = sharded_welch(xs, default_mesh("sp"), nperseg=fl)
     _, pw_na = sp.welch_na(np.asarray(xs), nperseg=fl)
     errs.append(_rel_err(pw, pw_na))
+    # sequence-parallel polyphase resampling (dilated-conv halo blocks)
+    from veles.simd_tpu.ops import resample as rs_mod
+    from veles.simd_tpu.parallel import sharded_resample_poly
+
+    xr2 = rng.randn(n_dev * 294).astype(np.float32)  # 294*160 % 147 == 0
+    errs.append(_rel_err(
+        sharded_resample_poly(xr2, 160, 147, default_mesh("sp")),
+        rs_mod.resample_poly_na(xr2, 160, 147)))
     return max(errs), 1e-4
 
 

@@ -74,18 +74,27 @@ def _resample_taps(up: int, down: int, num_taps) -> np.ndarray:
     return up * design_lowpass(num_taps, 1.0 / q)
 
 
-@functools.partial(jax.jit, static_argnames=("up", "down", "out_len"))
-def _resample_conv(x, taps, up, down, out_len):
+@functools.partial(jax.jit,
+                   static_argnames=("up", "down", "out_len", "pad"))
+def _resample_conv(x, taps, up, down, out_len, pad=None):
+    """The polyphase core: ONE dilated/strided correlation.
+
+    ``pad`` overrides the (left, right) dilated-domain padding — the
+    sharded path (``parallel.sharded_resample_poly``) uses a negative
+    left pad to crop its halo-extended block into global alignment
+    while running this exact same kernel.
+    """
     k = taps.shape[0]
-    pad_l = (k - 1) // 2  # group delay of the centered odd-length filter
-    # right padding sized so the final stride window (output index
-    # out_len - 1, input offset (out_len-1)*down .. +k-1) stays in bounds
-    dilated = (x.shape[-1] - 1) * up + 1
-    pad_r = max(0, (out_len - 1) * down + k - pad_l - dilated)
+    if pad is None:
+        pad_l = (k - 1) // 2  # group delay of the centered odd filter
+        # right padding sized so the final stride window (output index
+        # out_len - 1, offset (out_len-1)*down .. +k-1) stays in bounds
+        dilated = (x.shape[-1] - 1) * up + 1
+        pad = (pad_l, max(0, (out_len - 1) * down + k - pad_l - dilated))
     lhs = x.reshape((-1, 1, x.shape[-1]))
     rhs = taps[::-1].reshape((1, 1, k))
     out = jax.lax.conv_general_dilated(
-        lhs, rhs, window_strides=(down,), padding=[(pad_l, pad_r)],
+        lhs, rhs, window_strides=(down,), padding=[pad],
         lhs_dilation=(up,), precision=jax.lax.Precision.HIGHEST)
     return out.reshape(x.shape[:-1] + (out.shape[-1],))[..., :out_len]
 

@@ -51,6 +51,9 @@ scale across ICI — XLA collectives instead of any message-passing runtime.
   shard frames and FFTs its own segments, one ``psum`` of a ``[bins]``
   vector yields the global Welch average — collective payload
   independent of the signal length.
+* :func:`sharded_resample_poly` — sequence-parallel **rate conversion**:
+  each shard runs the single-chip dilated/strided polyphase conv on its
+  halo-extended block; output ownership follows input ownership.
 * :func:`sharded_matmul` — **tensor-parallel** GEMM: contracting dimension
   sharded (zero-padded to the axis size), partials combined with ``psum``
   over ICI.
@@ -73,7 +76,8 @@ from veles.simd_tpu.parallel.ops import (
     data_parallel, halo_exchange_left, halo_exchange_right,
     sharded_convolve, sharded_convolve2d, sharded_convolve2d_ring,
     sharded_convolve_batch, sharded_convolve_ring, sharded_istft,
-    sharded_matmul, sharded_sosfilt, sharded_stft, sharded_welch,
+    sharded_matmul, sharded_resample_poly, sharded_sosfilt,
+    sharded_stft, sharded_welch,
     sharded_swt, sharded_swt_reconstruct, sharded_wavelet_apply,
     sharded_wavelet_apply2d, sharded_wavelet_inverse_transform,
     sharded_wavelet_reconstruct, sharded_wavelet_reconstruct2d,
@@ -90,6 +94,6 @@ __all__ = ["make_mesh", "default_mesh", "sharded_convolve",
            "sharded_wavelet_apply2d",
            "sharded_wavelet_reconstruct2d", "sharded_matmul",
            "sharded_stft", "sharded_istft", "sharded_sosfilt",
-           "sharded_welch",
+           "sharded_welch", "sharded_resample_poly",
            "data_parallel", "halo_exchange_left", "halo_exchange_right",
            "distributed"]

@@ -31,7 +31,7 @@ __all__ = ["sharded_convolve", "sharded_convolve_ring",
            "sharded_wavelet_apply2d",
            "sharded_wavelet_reconstruct2d",
            "sharded_stft", "sharded_istft", "sharded_sosfilt",
-           "sharded_welch", "data_parallel",
+           "sharded_welch", "sharded_resample_poly", "data_parallel",
            "halo_exchange_left", "halo_exchange_right"]
 
 
@@ -1119,6 +1119,87 @@ def sharded_welch(x, mesh: Mesh, axis: str = "sp", fs: float = 1.0,
         return jax.lax.psum(local, axis) / frames_total
 
     return freqs, _run(x) * scale_mult
+
+
+def sharded_resample_poly(x, up: int, down: int, mesh: Mesh,
+                          axis: str = "sp", taps=None):
+    """Sequence-parallel rational-rate resampling: each shard runs the
+    SAME dilated/strided polyphase conv the single chip runs
+    (``ops.resample._resample_conv``) on its halo-extended block.
+
+    Output ownership follows input ownership: with ``block * up``
+    divisible by ``down``, every shard produces exactly
+    ``block * up / down`` output samples, so the result comes back
+    sharded over the same axis.  Halos are the filter's group-delay
+    reach divided by the upsampling factor (left ``ceil(pad_l / up)``,
+    right ``ceil((k - 1 - pad_l) / up)``); a negative conv padding
+    crops the local window start into alignment, so edge shards
+    reproduce the single-chip zero-padding exactly.  Matches
+    :func:`veles.simd_tpu.ops.resample.resample_poly`.
+    """
+    import math as _math
+
+    from veles.simd_tpu.ops import resample as _rs
+
+    up, down = int(up), int(down)
+    if up < 1 or down < 1:
+        raise ValueError(f"up and down must be >= 1, got {up}, {down}")
+    g = _math.gcd(up, down)
+    up, down = up // g, down // g
+    x = jnp.asarray(x, jnp.float32)
+    n = x.shape[-1]
+    if n == 0:
+        raise ValueError("empty signal")
+    n_shards = mesh.shape[axis]
+    if n % n_shards:
+        raise ValueError(f"signal length {n} not divisible into "
+                         f"{n_shards} shards (pad first)")
+    block = n // n_shards
+    if (block * up) % down:
+        raise ValueError(
+            f"block {block} * up {up} not divisible by down {down} — "
+            "output ownership would straddle shards; choose a length "
+            "whose per-shard block * up is a multiple of down")
+    if up == 1 and down == 1:
+        return x
+    if taps is None:
+        taps = _rs._resample_taps(up, down, None)
+    taps = np.asarray(taps, np.float64)
+    if taps.ndim != 1 or len(taps) % 2 == 0:
+        raise ValueError(f"taps must be a 1D odd-length filter, got "
+                         f"shape {taps.shape}")
+    k = len(taps)
+    pad_l = (k - 1) // 2
+    hl = -(-pad_l // up)
+    hr = -(-max(k - 1 - pad_l, 0) // up)
+    if max(hl, hr) > block:
+        raise ValueError(
+            f"filter halo ({hl} left / {hr} right input samples) "
+            f"exceeds the per-shard block ({block}); fewer shards or "
+            "shorter taps")
+    out_block = block * up // down
+    taps_j = jnp.asarray(taps, jnp.float32)
+    spec = P(*([None] * (x.ndim - 1) + [axis]))
+
+    # negative left padding crops hl*up - pad_l dilated positions,
+    # aligning local output 0 with global output s * out_block
+    p_lo = pad_l - hl * up
+    ext_len = block + hl + hr
+    dil = (ext_len - 1) * up + 1
+    p_hi = max(0, (out_block - 1) * down + k - (dil + p_lo))
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=spec,
+                       out_specs=spec)
+    def _run(x_local):
+        left = halo_exchange_left(x_local, hl, axis)
+        right = halo_exchange_right(x_local, hr, axis)
+        x_ext = jnp.concatenate([left, x_local, right], axis=-1)
+        # the single-chip polyphase kernel, padding overridden to the
+        # halo-cropping alignment
+        return _rs._resample_conv(x_ext, taps_j, up, down, out_block,
+                                  pad=(p_lo, p_hi))
+
+    return _run(x)
 
 
 def data_parallel(fn, mesh: Mesh, axis: str = "dp"):
