@@ -77,3 +77,35 @@ def test_convenience_form():
     h = RNG.randn(16).astype(np.float32)
     np.testing.assert_allclose(np.asarray(cr.cross_correlate(x, h)),
                                _ref_xcorr(x, h), atol=1e-4)
+
+
+class TestCorrelationLags:
+    def test_matches_scipy_when_conventions_agree(self):
+        from scipy import signal as ss
+
+        for n, m in [(10, 4), (7, 7), (64, 33)]:
+            for mode in ("full", "same", "valid"):
+                np.testing.assert_array_equal(
+                    cr.correlation_lags(n, m, mode),
+                    ss.correlation_lags(n, m, mode))
+
+    @pytest.mark.parametrize("n,m", [(64, 4), (4, 10), (7, 7)])
+    @pytest.mark.parametrize("mode", ["full", "same", "valid"])
+    def test_aligns_with_our_output(self, n, m, mode):
+        """lags length == our cross_correlate output length, and the
+        peak lag names the planted template offset."""
+        lags = cr.correlation_lags(n, m, mode)
+        x = np.zeros(n, np.float32)
+        h = np.arange(1, m + 1, dtype=np.float32)
+        pos = min(2, n - m) if n >= m else 0
+        x[pos:pos + min(m, n)] = h[: min(m, n)]
+        y = np.asarray(cr.cross_correlate(x, h, mode=mode))
+        assert len(lags) == len(y)
+        if n >= m:
+            assert lags[np.argmax(y)] == pos
+
+    def test_contracts(self):
+        with pytest.raises(ValueError, match="lengths"):
+            cr.correlation_lags(0, 4)
+        with pytest.raises(ValueError, match="mode"):
+            cr.correlation_lags(4, 4, "circular")

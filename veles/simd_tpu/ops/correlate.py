@@ -35,7 +35,7 @@ __all__ = [
     "cross_correlate_overlap_save", "cross_correlate_overlap_save_initialize",
     "cross_correlate_overlap_save_finalize",
     "cross_correlate", "cross_correlate_initialize",
-    "cross_correlate_finalize",
+    "cross_correlate_finalize", "correlation_lags",
 ]
 
 
@@ -109,3 +109,23 @@ def cross_correlate(handle_or_x, x_or_h, h=None, simd=None, *,
 
 def cross_correlate_finalize(handle):
     """No-op (``src/correlate.c:159-161``)."""
+
+
+def correlation_lags(in_len: int, in2_len: int, mode: str = "full"):
+    """Lag axis for :func:`cross_correlate` output: entry ``i`` of the
+    correlation corresponds to displacement ``lags[i]`` of the second
+    input relative to the first.  Host-side int array.
+
+    Follows THIS module's (numpy.correlate) mode convention — 'same'
+    returns ``max(in_len, in2_len)`` lags; scipy.signal's
+    ``correlation_lags`` differs when ``in_len < in2_len`` because its
+    ``correlate(..., 'same')`` keeps ``len(in1)`` instead.
+    """
+    in_len, in2_len = int(in_len), int(in2_len)
+    if in_len < 1 or in2_len < 1:
+        raise ValueError("lengths must be >= 1")
+    _conv._check_mode(mode)
+    # slice the full lag axis with the SAME windowing the correlation
+    # output goes through — alignment holds by construction
+    return _conv._mode_slice(np.arange(-(in2_len - 1), in_len),
+                             in_len, in2_len, mode, correlate=True)

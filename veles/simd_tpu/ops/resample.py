@@ -74,6 +74,29 @@ def _resample_taps(up: int, down: int, num_taps) -> np.ndarray:
     return up * design_lowpass(num_taps, 1.0 / q)
 
 
+def _normalize_resample_args(n, up, down, taps):
+    """Shared argument pipeline for the single-chip and sharded paths:
+    gcd-reduce the rate, validate, resolve default taps.  Returns
+    ``(up, down, taps_float64)`` (taps is None only for the 1/1 rate).
+    """
+    up, down = int(up), int(down)
+    if up < 1 or down < 1:
+        raise ValueError(f"up and down must be >= 1, got {up}, {down}")
+    g = math.gcd(up, down)
+    up, down = up // g, down // g
+    if n == 0:
+        raise ValueError("empty signal")
+    if up == 1 and down == 1:
+        return up, down, None
+    if taps is None:
+        taps = _resample_taps(up, down, None)
+    taps = np.asarray(taps, np.float64)
+    if taps.ndim != 1 or len(taps) % 2 == 0:
+        raise ValueError(
+            f"taps must be a 1D odd-length filter, got shape {taps.shape}")
+    return up, down, taps
+
+
 @functools.partial(jax.jit,
                    static_argnames=("up", "down", "out_len", "pad"))
 def _resample_conv(x, taps, up, down, out_len, pad=None):
@@ -106,23 +129,12 @@ def resample_poly(x, up: int, down: int, taps=None, simd=None):
     default windowed-sinc anti-aliasing filter (pass a host array with
     DC gain ``up`` and odd length for transparent substitution).
     """
-    up, down = int(up), int(down)
-    if up < 1 or down < 1:
-        raise ValueError(f"up and down must be >= 1, got {up}, {down}")
-    g = math.gcd(up, down)
-    up, down = up // g, down // g
-    n = np.shape(x)[-1]
-    if n == 0:
-        raise ValueError("empty signal")
+    up, down, taps = _normalize_resample_args(np.shape(x)[-1], up, down,
+                                              taps)
     if up == 1 and down == 1:
         return jnp.asarray(x, jnp.float32) if resolve_simd(simd) \
             else np.asarray(x, np.float32)
-    if taps is None:
-        taps = _resample_taps(up, down, None)
-    taps = np.asarray(taps, np.float64)
-    if taps.ndim != 1 or len(taps) % 2 == 0:
-        raise ValueError(
-            f"taps must be a 1D odd-length filter, got shape {taps.shape}")
+    n = np.shape(x)[-1]
     out_len = resample_length(n, up, down)
     if resolve_simd(simd):
         return _resample_conv(jnp.asarray(x, jnp.float32),

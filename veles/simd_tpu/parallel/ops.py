@@ -1137,19 +1137,11 @@ def sharded_resample_poly(x, up: int, down: int, mesh: Mesh,
     reproduce the single-chip zero-padding exactly.  Matches
     :func:`veles.simd_tpu.ops.resample.resample_poly`.
     """
-    import math as _math
-
     from veles.simd_tpu.ops import resample as _rs
 
-    up, down = int(up), int(down)
-    if up < 1 or down < 1:
-        raise ValueError(f"up and down must be >= 1, got {up}, {down}")
-    g = _math.gcd(up, down)
-    up, down = up // g, down // g
     x = jnp.asarray(x, jnp.float32)
     n = x.shape[-1]
-    if n == 0:
-        raise ValueError("empty signal")
+    up, down, taps = _rs._normalize_resample_args(n, up, down, taps)
     n_shards = mesh.shape[axis]
     if n % n_shards:
         raise ValueError(f"signal length {n} not divisible into "
@@ -1162,12 +1154,6 @@ def sharded_resample_poly(x, up: int, down: int, mesh: Mesh,
             "whose per-shard block * up is a multiple of down")
     if up == 1 and down == 1:
         return x
-    if taps is None:
-        taps = _rs._resample_taps(up, down, None)
-    taps = np.asarray(taps, np.float64)
-    if taps.ndim != 1 or len(taps) % 2 == 0:
-        raise ValueError(f"taps must be a 1D odd-length filter, got "
-                         f"shape {taps.shape}")
     k = len(taps)
     pad_l = (k - 1) // 2
     hl = -(-pad_l // up)
