@@ -171,6 +171,13 @@ class TestFindPeaks:
         {"threshold": (0.1, 2.0)}, {"distance": 20},
         {"prominence": 1.0}, {"prominence": (0.5, 3.0)},
         {"height": 0.2, "distance": 10, "prominence": 0.8},
+        # height+threshold combinations: the threshold branch must
+        # refilter peak_heights too (round-3 advisor finding — it kept
+        # the pre-threshold length, and adding distance then crashed)
+        {"height": 0.5, "threshold": 0.2},
+        {"height": 0.5, "threshold": 0.2, "distance": 15},
+        {"height": (0.2, 2.5), "threshold": (0.05, 3.0), "distance": 8,
+         "prominence": 0.3},
     ])
     def test_filters_match_scipy(self, kw):
         from scipy import signal as ss
@@ -178,12 +185,30 @@ class TestFindPeaks:
         got, gp = dp.find_peaks(self.X, **kw)
         want, wp = ss.find_peaks(self.X.astype(np.float64), **kw)
         np.testing.assert_array_equal(got, want)
-        if "peak_heights" in wp:
-            np.testing.assert_allclose(gp["peak_heights"],
-                                       wp["peak_heights"], atol=1e-6)
+        for key in ("peak_heights", "left_thresholds",
+                    "right_thresholds", "left_bases", "right_bases"):
+            if key in wp:
+                assert len(gp[key]) == len(got)
+                np.testing.assert_allclose(gp[key], wp[key], atol=1e-6)
         if "prominences" in wp:
+            assert len(gp["prominences"]) == len(got)
             np.testing.assert_allclose(gp["prominences"],
                                        wp["prominences"], atol=1e-5)
+
+    @pytest.mark.parametrize("use_simd", [True, False])
+    def test_bases_match_scipy(self, use_simd):
+        """left/right_bases (attached with prominences, as scipy does)
+        match scipy's outward-walk tie semantics on both paths."""
+        from scipy import signal as ss
+
+        got, gp = dp.find_peaks(self.X, prominence=0.5, simd=use_simd)
+        want, wp = ss.find_peaks(self.X.astype(np.float64),
+                                 prominence=0.5)
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_array_equal(gp["left_bases"],
+                                      wp["left_bases"])
+        np.testing.assert_array_equal(gp["right_bases"],
+                                      wp["right_bases"])
 
     def test_prominence_device_vs_scipy(self):
         from scipy import signal as ss

@@ -285,6 +285,61 @@ def _prominences_xla(x):
     return _prom_core(x)[3]
 
 
+@jax.jit
+def _prom_spans_xla(x):
+    """(prom, lspan, rspan) for every index — spans bound the saddle
+    intervals so the host can recover scipy's base positions."""
+    _, lspan, rspan, prom = _prom_core(x)
+    return prom, lspan, rspan
+
+
+def _bases_from_spans(x_np, peaks, lspan, rspan):
+    """scipy's ``left_bases``/``right_bases`` from the device-computed
+    saddle spans: the min of each side interval, ties resolved to the
+    position NEAREST the peak (scipy walks outward updating on strict
+    ``<``, so the closest occurrence of the minimum wins)."""
+    lb = np.empty(len(peaks), np.int64)
+    rb = np.empty(len(peaks), np.int64)
+    for j, p in enumerate(np.asarray(peaks, np.int64)):
+        a = p - int(lspan[j])
+        if a < p:
+            seg = x_np[a:p]
+            lb[j] = a + (len(seg) - 1 - int(np.argmin(seg[::-1])))
+        else:
+            lb[j] = p
+        b = p + int(rspan[j])
+        if b > p:
+            rb[j] = p + 1 + int(np.argmin(x_np[p + 1:b + 1]))
+        else:
+            rb[j] = p
+    return lb, rb
+
+
+def _prominences_bases_na(x, peaks):
+    """Float64 oracle: (prominences, left_bases, right_bases) with
+    scipy's outward-walk tie semantics (closest minimum wins)."""
+    x = np.asarray(x, np.float64)
+    n = len(x)
+    prom = np.empty(len(peaks))
+    lb = np.empty(len(peaks), np.int64)
+    rb = np.empty(len(peaks), np.int64)
+    for j, p in enumerate(np.asarray(peaks, np.int64)):
+        v = x[p]
+        i, lmin, lbase = p - 1, v, p
+        while i >= 0 and x[i] <= v:
+            if x[i] < lmin:
+                lmin, lbase = x[i], i
+            i -= 1
+        i, rmin, rbase = p + 1, v, p
+        while i < n and x[i] <= v:
+            if x[i] < rmin:
+                rmin, rbase = x[i], i
+            i += 1
+        prom[j] = v - max(lmin, rmin)
+        lb[j], rb[j] = lbase, rbase
+    return prom, lb, rb
+
+
 def peak_prominences(x, peaks, simd=None):
     """Prominence of each peak (scipy's ``peak_prominences`` wlen=None
     semantics): height above the higher of the two key saddles — the
@@ -306,36 +361,20 @@ def peak_prominences(x, peaks, simd=None):
 
 
 def peak_prominences_na(x, peaks):
-    """NumPy float64 oracle twin (textbook per-peak saddle walk)."""
-    x = np.asarray(x, np.float64)
-    out = np.empty(len(peaks))
-    for j, p in enumerate(np.asarray(peaks, np.int64)):
-        v = x[p]
-        # start saddles at v: an empty walk (the neighbour is already
-        # higher) leaves the saddle at the "peak" itself -> prominence 0,
-        # matching scipy and the device path for non-peak indices
-        i = p - 1
-        lmin = v
-        while i >= 0 and x[i] <= v:
-            lmin = min(lmin, x[i])
-            i -= 1
-        if i < 0 and p:
-            lmin = x[: p].min()
-        i = p + 1
-        rmin = v
-        while i < len(x) and x[i] <= v:
-            rmin = min(rmin, x[i])
-            i += 1
-        if i >= len(x) and p + 1 < len(x):
-            rmin = x[p + 1:].min()
-        out[j] = v - max(lmin, rmin)
-    return out
+    """NumPy float64 oracle twin (textbook per-peak saddle walk).
+
+    Saddles start at the peak's own value: an empty walk (the
+    neighbour is already higher) gives prominence 0, matching scipy
+    and the device path for non-peak indices.
+    """
+    return _prominences_bases_na(x, peaks)[0]
 
 
 @functools.partial(jax.jit, static_argnames=("rel_height",))
 def _widths_xla(x, rel_height):
-    """(widths, h_eval, left_ip, right_ip) for EVERY index treated as a
-    peak (garbage at non-peaks — callers gather at peak positions)."""
+    """(widths, h_eval, left_ip, right_ip, prom, lspan, rspan) for
+    EVERY index treated as a peak (garbage at non-peaks — callers
+    gather at peak positions)."""
     n = x.shape[-1]
     idx = jnp.arange(n)
     mins, lspan, rspan, prom = _prom_core(x)
@@ -369,9 +408,11 @@ def _widths_xla(x, rel_height):
     right_ip = jnp.where(crossed_r, ri - rfrac,
                          jnp.where(hit_edge_r, float(n - 1),
                                    ri.astype(x.dtype)))
-    # prom rides along: find_peaks with both prominence and width
-    # conditions then needs only this one device pass
-    return right_ip - left_ip, h_eval, left_ip, right_ip, prom
+    # prom + spans ride along: find_peaks with prominence and width
+    # conditions then needs only this one device pass (the spans feed
+    # the host-side left/right base recovery)
+    return (right_ip - left_ip, h_eval, left_ip, right_ip, prom,
+            lspan, rspan)
 
 
 def peak_widths(x, peaks, rel_height: float = 0.5, simd=None):
@@ -395,8 +436,8 @@ def peak_widths(x, peaks, rel_height: float = 0.5, simd=None):
     if peaks.size and (peaks.min() < 0 or peaks.max() >= n):
         raise ValueError("peak index out of range")
     if resolve_simd(simd):
-        w, h, li, ri, _ = _widths_xla(jnp.asarray(x, jnp.float32),
-                                      rel_height)
+        w, h, li, ri = _widths_xla(jnp.asarray(x, jnp.float32),
+                                   rel_height)[:4]
         pk = jnp.asarray(peaks)
         return (jnp.take(w, pk), jnp.take(h, pk), jnp.take(li, pk),
                 jnp.take(ri, pk))
@@ -404,17 +445,20 @@ def peak_widths(x, peaks, rel_height: float = 0.5, simd=None):
                  for a in peak_widths_na(x, peaks, rel_height))
 
 
-def peak_widths_na(x, peaks, rel_height: float = 0.5):
+def peak_widths_na(x, peaks, rel_height: float = 0.5, prom=None):
     """NumPy float64 oracle twin (sequential crossing walk).  The same
     ``rel_height`` in [0, 1) contract as the device path — an unbounded
-    walk is only correct inside the prominence interval."""
+    walk is only correct inside the prominence interval.  ``prom``
+    accepts already-computed prominences so callers that did the
+    saddle walk themselves (find_peaks) don't repeat it."""
     rel_height = float(rel_height)
     if not 0.0 <= rel_height < 1.0:
         raise ValueError("rel_height must be in [0, 1) "
                          "(1.0 and above are not supported)")
     x = np.asarray(x, np.float64)
     n = len(x)
-    prom = peak_prominences_na(x, peaks)
+    if prom is None:
+        prom = peak_prominences_na(x, peaks)
     out = np.zeros((4, len(peaks)))
     for j, p in enumerate(np.asarray(peaks, np.int64)):
         h = x[p] - float(rel_height) * prom[j]
@@ -442,7 +486,8 @@ def find_peaks(x, height=None, threshold=None, distance=None,
 
     Returns ``(peaks, properties)`` — ``peaks`` a host int array of
     indices, ``properties`` holding ``peak_heights`` /
-    ``left_thresholds`` / ``right_thresholds`` / ``prominences`` for
+    ``left_thresholds`` / ``right_thresholds`` / ``prominences`` /
+    ``left_bases`` / ``right_bases`` for
     whichever filters were requested (``width`` adds ``widths`` /
     ``width_heights`` / ``left_ips`` / ``right_ips``, measured at
     ``rel_height`` of the prominence; ``prominences`` is attached
@@ -503,6 +548,12 @@ def find_peaks(x, height=None, threshold=None, distance=None,
         if hi is not None:
             keep &= np.maximum(lt, rt) <= hi
         peaks, heights = peaks[keep], heights[keep]
+        # refilter properties attached by earlier conditions (scipy
+        # refilters every existing property at each condition; without
+        # this, height+threshold leaves peak_heights at its pre-filter
+        # length, silently misaligned with the returned peaks)
+        for k in props:
+            props[k] = props[k][keep]
         props["left_thresholds"] = lt[keep]
         props["right_thresholds"] = rt[keep]
     if distance is not None:
@@ -532,18 +583,23 @@ def find_peaks(x, height=None, threshold=None, distance=None,
         # scipy likewise always attaches prominences when width is
         # requested)
         use = resolve_simd(simd)
-        if width is not None:
-            if use:
+        if use:
+            pk = jnp.asarray(peaks)
+            if width is not None:
                 out = _widths_xla(jnp.asarray(x_np), float(rel_height))
-                w, wh, li, ri, prom = (
-                    np.asarray(jnp.take(a, jnp.asarray(peaks)))
-                    for a in out)
+                w, wh, li, ri, prom, lsp, rsp = (
+                    np.asarray(jnp.take(a, pk)) for a in out)
             else:
-                w, wh, li, ri = (np.asarray(a) for a in
-                                 peak_widths_na(x_np, peaks, rel_height))
-                prom = peak_prominences_na(x_np, peaks)
+                prom, lsp, rsp = (np.asarray(jnp.take(a, pk)) for a in
+                                  _prom_spans_xla(jnp.asarray(x_np)))
+            lbase, rbase = _bases_from_spans(x_np, peaks, lsp, rsp)
         else:
-            prom = np.asarray(peak_prominences(x_np, peaks, simd=simd))
+            prom, lbase, rbase = _prominences_bases_na(x_np, peaks)
+            prom = prom.astype(np.float32)
+            if width is not None:
+                w, wh, li, ri = (np.asarray(a) for a in
+                                 peak_widths_na(x_np, peaks, rel_height,
+                                                prom=prom))
         if prominence is not None:
             lo, hi = _minmax(prominence)
             keep = np.ones(len(peaks), bool)
@@ -552,12 +608,14 @@ def find_peaks(x, height=None, threshold=None, distance=None,
             if hi is not None:
                 keep &= prom <= hi
             peaks = peaks[keep]
-            prom = prom[keep]
+            prom, lbase, rbase = prom[keep], lbase[keep], rbase[keep]
             for k in props:
                 props[k] = props[k][keep]
             if width is not None:
                 w, wh, li, ri = w[keep], wh[keep], li[keep], ri[keep]
         props["prominences"] = prom
+        props["left_bases"] = lbase
+        props["right_bases"] = rbase
         if width is not None:
             lo, hi = _minmax(width)
             keep = np.ones(len(peaks), bool)
