@@ -1,0 +1,179 @@
+"""Minimal repro / bisect harness for the pallas2d relay wedge.
+
+The compiled 2D Mosaic kernel (`ops/pallas_kernels.py::_f2d_kernel`) has
+never completed a hardware run: its first-ever execution (2026-07-31
+00:59Z window) coincided with the axon relay wedging for the rest of the
+day, and a wedged relay blocks forever inside native code.  This tool
+localizes the hang without risking the caller:
+
+* every stage runs in its OWN subprocess under a hard timeout — a hang
+  kills the child, never the harness;
+* stages are ordered from "known-good 1D kernel" through progressively
+  larger 2D shapes, so the first ``TIMEOUT`` row names the smallest
+  wedging configuration;
+* each stage's verdict is flushed to the JSON artifact *before* the next
+  stage starts — a relay that wedges mid-run (and takes the harness's
+  own probe with it) still leaves a complete ledger of everything that
+  ran before it.
+
+Usage (on a live relay; an expendable session — the wedge, if it fires,
+takes the relay with it)::
+
+    python tools/repro_pallas2d.py [--out repro_pallas2d.json]
+                                   [--timeout 240]
+
+Each stage validates against the float64 oracle, so a clean run of all
+stages is exactly the "green hardware pass" that flips the
+``VELES_SIMD_ENABLE_PALLAS2D`` routing guard default
+(`ops/pallas_kernels.py::pallas2d_compiled_allowed`).
+
+The stage grid bisects three axes independently, smallest first:
+image area (one VPU tile -> multi-tile), kernel area (1x1 -> the 16x16
+routing cap), and grid steps (1 -> multi-step, where Pallas
+double-buffering and DMA overlap kick in).  The 1D kernel and the XLA
+conv of the same shape run first as controls: if THEY wedge, the fault
+is the relay/session, not the 2D kernel.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+
+# (name, python body) — each body runs in a fresh interpreter that dies
+# on completion; assert-based value checks keep a wrong-result from
+# passing silently.  Shapes deliberately tiny: the round-3 wedge fired
+# on a 4x64x48 image with a 5x7 kernel, so small shapes are sufficient
+# and keep each stage's compile+run under the timeout.
+_PRELUDE = """
+import numpy as np, jax, jax.numpy as jnp
+assert jax.devices(), "no device"
+rng = np.random.RandomState(7)
+from veles.simd_tpu.ops import pallas_kernels as pk
+from veles.simd_tpu.ops import convolve2d as cv2
+def oracle2d(x, h):
+    return cv2.convolve2d_na(x, h)
+def check(got, want, tol=5e-4):
+    got = np.asarray(got, np.float64); want = np.asarray(want, np.float64)
+    err = np.max(np.abs(got - want)) / max(np.max(np.abs(want)), 1e-30)
+    assert err <= tol, f"rel err {err:.3e} > {tol}"
+    print(f"rel_err={err:.3e}")
+"""
+
+_STAGES = [
+    ("control_xla_conv2d", """
+x = rng.randn(4, 64, 48).astype(np.float32); h = rng.randn(5, 7).astype(np.float32)
+got = cv2._conv2d_direct(jnp.asarray(x), jnp.asarray(h))
+check(got, oracle2d(x, h))
+"""),
+    ("control_pallas1d", """
+from veles.simd_tpu.ops import wavelet as wv
+x = rng.randn(16, 1024).astype(np.float32)
+x_ext = np.concatenate([x, x[:, :8]], axis=1)
+hi_f, lo_f = wv._filters("daub", 8)
+hi, lo = pk.filter_bank_pallas(x_ext, np.stack([hi_f, lo_f]), 2, 1, 512,
+                               interpret=False)
+want_hi, want_lo = wv.wavelet_apply_na("daub", 8,
+                                       wv.ExtensionType.PERIODIC, x)
+check(hi, want_hi); check(lo, want_lo)
+"""),
+    # -- 2D kernel, one grid step, minimal everything ------------------
+    ("k1x1_img8x128_1img", """
+x = rng.randn(1, 8, 128).astype(np.float32); h = np.ones((1, 1), np.float32)
+got = pk.filter_2d_pallas(x, h, 8, 128, interpret=False)
+check(got, x)
+"""),
+    ("k3x3_img8x128_1img", """
+x = rng.randn(1, 10, 130).astype(np.float32); h = rng.randn(3, 3).astype(np.float32)
+got = pk.filter_2d_pallas(x, h, 8, 128, interpret=False)
+want = oracle2d(x, h[::-1, ::-1])[:, 2:10, 2:130]
+check(got, want)
+"""),
+    # unaligned second-minor/minor extents (the round-3 wedge shape had
+    # 48 lanes — not a multiple of 128; Mosaic must mask edge lanes)
+    ("k5x7_img64x48_1img", """
+x = rng.randn(1, 68, 54).astype(np.float32); h = rng.randn(5, 7).astype(np.float32)
+got = pk.filter_2d_pallas(x, h, 64, 48, interpret=False)
+want = oracle2d(x, h[::-1, ::-1])[:, 4:68, 6:54]
+check(got, want)
+"""),
+    # batched single grid step (the wedge config, via the public route)
+    ("wedge_shape_4img", """
+import os; os.environ[pk._PALLAS2D_ENV] = "1"
+x = rng.randn(4, 64, 48).astype(np.float32); h = rng.randn(5, 7).astype(np.float32)
+assert cv2._use_pallas_direct2d(x.shape, 5, 7)
+got = cv2.convolve2d(x, h, algorithm="direct", simd=True)
+check(got, oracle2d(x, h))
+"""),
+    # multiple grid steps: double-buffered DMA pipeline engages
+    ("k5x7_img128x128_64img_multistep", """
+x = rng.randn(64, 132, 134).astype(np.float32); h = rng.randn(5, 7).astype(np.float32)
+got = pk.filter_2d_pallas(x, h, 128, 128, interpret=False)
+want = oracle2d(x, h[::-1, ::-1])[:, 4:132, 6:134]
+check(got, want)
+"""),
+    # kernel-area cap: 256 unrolled MACs (compile-time stressor)
+    ("k16x16_img64x128_8img", """
+x = rng.randn(8, 94, 158).astype(np.float32); h = rng.randn(16, 16).astype(np.float32)
+got = pk.filter_2d_pallas(x, h, 64, 128, interpret=False)
+want = oracle2d(x, h[::-1, ::-1])[:, 15:79, 15:143]
+check(got, want)
+"""),
+]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="repro_pallas2d.json")
+    ap.add_argument("--timeout", type=float, default=240.0,
+                    help="per-stage wall clock (first compile ~20-40s)")
+    ap.add_argument("--stage", action="append",
+                    help="run only the named stage(s)")
+    args = ap.parse_args(argv)
+
+    ledger = {"started": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime()),
+              "timeout_s": args.timeout, "stages": []}
+
+    def flush():
+        with open(args.out, "w") as f:
+            json.dump(ledger, f, indent=1)
+
+    stages = [(n, b) for n, b in _STAGES
+              if not args.stage or n in args.stage]
+    for name, body in stages:
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _PRELUDE + body],
+                capture_output=True, text=True, timeout=args.timeout)
+            verdict = "OK" if proc.returncode == 0 else "FAIL"
+            detail = (proc.stdout.strip().splitlines() or [""])[-1] \
+                if verdict == "OK" else proc.stderr.strip()[-800:]
+        except subprocess.TimeoutExpired:
+            verdict, detail = "TIMEOUT", ""
+        dt = time.time() - t0
+        ledger["stages"].append({"name": name, "verdict": verdict,
+                                 "seconds": round(dt, 1),
+                                 "detail": detail})
+        flush()
+        print(f"{name:36s} {verdict:8s} {dt:6.1f}s  {detail}",
+              flush=True)
+        if verdict == "TIMEOUT":
+            # a wedge survives the child's death; further stages would
+            # each eat a full timeout against a dead relay
+            print("first TIMEOUT — relay presumed wedged, stopping "
+                  "(smallest wedging config is this stage)")
+            break
+    ok = all(s["verdict"] == "OK" for s in ledger["stages"])
+    ledger["all_ok"] = ok and len(ledger["stages"]) == len(stages)
+    flush()
+    print(f"ledger -> {args.out}  all_ok={ledger['all_ok']}")
+    return 0 if ledger["all_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

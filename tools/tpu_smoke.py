@@ -444,13 +444,31 @@ def _check_pallas2d(rng):
     Kept LAST in the family order: its first-ever hardware execution
     (2026-07-31 00:59Z window) coincided with the axon relay wedging, so
     until it has a green hardware run on record it is the prime suspect —
-    last place means a wedge here cannot shadow any other family."""
+    last place means a wedge here cannot shadow any other family.  The
+    compiled kernel is env-gated off for implicit routing (round-4
+    guard); the smoke opts in explicitly — it IS the hardware validation
+    path.  ``tools/repro_pallas2d.py`` is the stage-by-stage bisect."""
+    import os
+
     from veles.simd_tpu.ops import convolve2d as cv2
+    from veles.simd_tpu.ops import pallas_kernels as _pk
 
     img = rng.randn(4, 64, 48).astype(np.float32)
     k2 = rng.randn(5, 7).astype(np.float32)
-    return _rel_err(cv2.convolve2d(img, k2, algorithm="direct", simd=True),
-                    cv2.convolve2d_na(img, k2)), 5e-4
+    prev = os.environ.get(_pk._PALLAS2D_ENV)
+    os.environ[_pk._PALLAS2D_ENV] = "1"
+    try:
+        assert cv2._use_pallas_direct2d(img.shape, 5, 7) or \
+            not _pk.pallas_available()   # CPU standalone run
+        err = _rel_err(
+            cv2.convolve2d(img, k2, algorithm="direct", simd=True),
+            cv2.convolve2d_na(img, k2))
+    finally:
+        if prev is None:
+            os.environ.pop(_pk._PALLAS2D_ENV, None)
+        else:
+            os.environ[_pk._PALLAS2D_ENV] = prev
+    return err, 5e-4
 
 
 def _check_parallel(rng):

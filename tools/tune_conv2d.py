@@ -50,6 +50,17 @@ def main():
     rng = np.random.RandomState(0)
     print(f"device: {jax.devices()[0]}", flush=True)
 
+    from veles.simd_tpu.ops import pallas_kernels as _pk
+
+    if _pk.pallas_available() and not _pk.pallas2d_compiled_allowed():
+        # the wedge-suspect guard (ops/pallas_kernels.py) silently
+        # drops the pallas candidate otherwise — a tuning run should
+        # either include it knowingly or say why it didn't
+        print(f"NOTE: compiled pallas2d gated off — the sweep covers "
+              f"direct/fft only; set {_pk._PALLAS2D_ENV}=1 to include "
+              "the pallas candidate (run tools/repro_pallas2d.py "
+              "first)", flush=True)
+
     if args.quick:
         images = ((128, 128), (512, 512))
         kernels = ((3, 3), (15, 15), (33, 33), (65, 65))

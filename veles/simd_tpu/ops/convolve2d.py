@@ -54,11 +54,17 @@ def _use_pallas_direct2d(x_shape, k0: int, k1: int) -> bool:
     """Route the direct form through the 2D Pallas shifted-MAC kernel:
     small-area kernels on TPU, image + output within the VMEM tile
     budget.  No minimum batch (one image fills the VPU tile).  Tests
-    monkeypatch this gate to exercise the kernel on CPU."""
+    monkeypatch this gate to exercise the kernel on CPU.
+
+    Gated behind ``pallas2d_compiled_allowed`` (opt-in env flag): the
+    compiled kernel is the prime suspect for the round-3 relay wedge
+    and must not be reachable from user-facing ops until it has a green
+    hardware pass (see ``tools/repro_pallas2d.py``)."""
     n0, n1 = x_shape[-2:]
     n0e, n1e = n0 + 2 * (k0 - 1), n1 + 2 * (k1 - 1)
     out_elems = (n0 + k0 - 1) * (n1 + k1 - 1)
     return (_pk.pallas_available()
+            and _pk.pallas2d_compiled_allowed()
             and k0 * k1 <= _pk.PALLAS_2D_MAX_KERNEL_AREA
             and _pk.fits_vmem(n0e * n1e + out_elems))
 

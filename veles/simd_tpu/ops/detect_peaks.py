@@ -547,7 +547,7 @@ def find_peaks(x, height=None, threshold=None, distance=None,
             keep &= np.minimum(lt, rt) >= lo
         if hi is not None:
             keep &= np.maximum(lt, rt) <= hi
-        peaks, heights = peaks[keep], heights[keep]
+        peaks = peaks[keep]
         # refilter properties attached by earlier conditions (scipy
         # refilters every existing property at each condition; without
         # this, height+threshold leaves peak_heights at its pre-filter

@@ -52,6 +52,7 @@ compiled Mosaic path is exercised on real hardware by ``bench.py
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -62,6 +63,7 @@ from jax.experimental.pallas import tpu as pltpu
 from veles.simd_tpu.utils.config import on_tpu
 
 __all__ = ["filter_bank_pallas", "filter_2d_pallas", "pallas_available",
+           "pallas2d_compiled_allowed",
            "PALLAS_MIN_ROWS", "PALLAS_DIRECT_MAX_H",
            "PALLAS_2D_MAX_KERNEL_AREA"]
 
@@ -85,6 +87,24 @@ _VMEM_BUDGET_BYTES = 10 << 20   # for 2*(in+out) + temps
 def pallas_available() -> bool:
     """Compiled Mosaic path available (real TPU backend)?"""
     return on_tpu()
+
+
+# The compiled 2D Mosaic kernel's first-ever hardware execution
+# (2026-07-31 00:59Z window) coincided with the axon relay wedging, and
+# a wedged relay blocks forever in native code — one bad kernel cost a
+# whole measurement window.  Until the kernel has a green hardware pass
+# on record, the compiled path is OPT-IN: implicit routing
+# (convolve2d._use_pallas_direct2d) falls back to the XLA conv lowering,
+# while the hardware smoke/repro tools opt in explicitly.  Interpret
+# mode (the CPU test path) is unaffected.  Flip the default once
+# tools/repro_pallas2d.py records a clean compiled run.
+_PALLAS2D_ENV = "VELES_SIMD_ENABLE_PALLAS2D"
+
+
+def pallas2d_compiled_allowed() -> bool:
+    """May implicit routing use the *compiled* 2D Mosaic kernel?"""
+    return os.environ.get(_PALLAS2D_ENV, "0").strip().lower() in (
+        "1", "true", "yes", "on")
 
 
 def _tile_rows(n_rows: int, row_elems: int) -> int:
