@@ -7,18 +7,22 @@
 #
 #   sh tools/hw_session.sh [outdir]        # default /tmp/hw_session
 #
-# Steps:
+# Steps (pallas2d — the round-3 wedge suspect — is excluded from every
+# smoke stage via VELES_SIMD_SMOKE_SKIP and runs ONLY in the final
+# bisect step, so a wedge there cannot cost anything else):
 #   1. bench.py            -> headline JSON + BENCH_DETAILS.json + the
-#                             full 14-family smoke (runs last inside it)
+#                             embedded smoke (minus pallas2d)
 #   2. tools/tpu_smoke.py  -> retry ONLY the families still lacking a
 #                             green hardware run (as of late 2026-07-31:
-#                             pallas1d/parallel/pallas2d plus everything
-#                             added this round — iir, filters,
-#                             waveforms, detect_peaks' new analysis, the
-#                             spectral estimation layer), in case the
+#                             pallas1d/parallel plus everything added in
+#                             round 3 — iir, filters, waveforms,
+#                             detect_peaks' new analysis, the spectral
+#                             estimation layer), in case the
 #                             bench-embedded smoke got cut
 #   3. tools/tune_conv2d.py --quick   -> 2D crossover measurement
 #   4. tools/tune_overlap_save.py --quick  -> 1D step-size re-check
+#   5. tools/repro_pallas2d.py  -> the pallas2d bisect, DEAD LAST; its
+#                             JSON ledger survives even if it wedges
 set -u
 OUT=${1:-/tmp/hw_session}
 mkdir -p "$OUT"
@@ -43,15 +47,23 @@ run() {
 # self-watchdogs per stage.  The smoke retry covers only the families
 # without a green hardware run yet — a wedge-prone family must not be
 # able to burn the window twice (update the list as families go green).
+#
+# pallas2d (the round-3 wedge suspect) is held out of EVERY stage via
+# VELES_SIMD_SMOKE_SKIP and runs dead last through the bisect harness:
+# if it wedges the relay again, everything else was already captured.
+export VELES_SIMD_SMOKE_SKIP=pallas2d
 run bench        timeout -k 60 3000 python bench.py --all
 cp -f BENCH_DETAILS.json "$OUT/" 2>/dev/null || true
 run smoke        timeout -k 60 1500 python tools/tpu_smoke.py \
                    --family=iir --family=filters --family=waveforms \
                    --family=spectral --family=resample \
                    --family=detect_peaks \
-                   --family=pallas1d --family=parallel --family=pallas2d
+                   --family=pallas1d --family=parallel
 run tune_conv2d  timeout -k 60 1800 python tools/tune_conv2d.py --quick
 run tune_os      timeout -k 60 1800 python tools/tune_overlap_save.py --quick
+run repro_p2d    timeout -k 60 2400 python tools/repro_pallas2d.py \
+                   --out "$OUT/repro_pallas2d.json"
+cp -f "$OUT/repro_pallas2d.json" . 2>/dev/null || true
 
 echo "== headline:"
 head -1 "$OUT/bench.out" 2>/dev/null

@@ -578,16 +578,35 @@ def run_smoke(emit=None, families=None, on_start=None) -> bool:
     ``on_start(name)`` fires before each family begins — bench.py's
     watchdog uses it to attribute a relay wedge to the family that was
     in flight when progress stopped.
+
+    ``$VELES_SIMD_SMOKE_SKIP`` (comma-separated family names) excludes
+    families even when explicitly requested — the hardware session
+    script uses it to hold the wedge-suspect ``pallas2d`` family out of
+    bench.py's embedded smoke so a wedge there cannot cost the tuner
+    stages that follow; the suspect then runs dead last via
+    ``tools/repro_pallas2d.py``.
     """
     import jax
 
     if emit is None:
         emit = lambda s: print(s, file=sys.stderr)
+    skip = {s.strip() for s in
+            os.environ.get("VELES_SIMD_SMOKE_SKIP", "").split(",")
+            if s.strip()}
+    known = {n for n, _ in FAMILIES}
+    for bad in sorted(skip - known):
+        # a typo here would silently re-enable the wedge suspect
+        emit(f"TPU-CHECK WARNING: unknown family {bad!r} in "
+             f"VELES_SIMD_SMOKE_SKIP (known: {sorted(known)})")
     device = str(jax.devices()[0])
     rng = np.random.RandomState(7)
     all_ok = True
     for name, check in FAMILIES:
         if families is not None and name not in families:
+            continue
+        if name in skip:
+            emit(f"TPU-CHECK family={name} SKIPPED "
+                 "(VELES_SIMD_SMOKE_SKIP)")
             continue
         if on_start is not None:
             on_start(name)
