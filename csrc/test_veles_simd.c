@@ -129,6 +129,25 @@ static void test_convolve(void) {
   CHECK(cross_correlate_simd(1, sig, 3, sig, 3, xc) == 0);
   CHECK_NEAR(xc[2], 14.f, 1e-5); /* 1+4+9 */
 
+  /* lag axis: full autocorrelation of length 3 spans -2..2, and the
+   * peak above sits at lag 0 */
+  CHECK(correlation_lags_length(3, 3, VELES_MODE_FULL) == 5);
+  CHECK(correlation_lags_length(5, 3, VELES_MODE_SAME) == 5);
+  CHECK(correlation_lags_length(5, 3, VELES_MODE_VALID) == 3);
+  long lags[5];
+  CHECK(correlation_lags(3, 3, VELES_MODE_FULL, lags) == 0);
+  CHECK(lags[0] == -2 && lags[2] == 0 && lags[4] == 2);
+
+  /* deconvolve recovers the quotient: signal = divisor * q exactly */
+  const double dsig[5] = {4., 13., 28., 27., 18.};  /* (4,5,6)*(1,2,3) */
+  const double ddiv[3] = {4., 5., 6.};
+  double quot[3], rem[5];
+  CHECK(deconvolve(dsig, 5, ddiv, 3, quot, rem) == 0);
+  CHECK_NEAR(quot[0], 1., 1e-12);
+  CHECK_NEAR(quot[1], 2., 1e-12);
+  CHECK_NEAR(quot[2], 3., 1e-12);
+  for (int i = 0; i < 5; i++) CHECK_NEAR(rem[i], 0., 1e-10);
+
   /* named per-algorithm entry points must agree with the oracle */
   VelesConvolutionHandle *hf = convolve_fft_initialize(n, k);
   CHECK(hf != NULL);
@@ -364,6 +383,29 @@ static void test_wavelet(void) {
     CHECK_NEAR(srec2d[i], img2[i], 5e-4);
   }
 
+  /* 2D quad-tree packets: 1-level leaves ARE the (ll, lh, hl, hh)
+   * bands of wavelet_apply2d, and the tree round-trips */
+  float leaves2d[64], prec2d[64];
+  CHECK(wavelet_packet_transform2d(1, WAVELET_TYPE_DAUBECHIES, 4,
+                                   EXTENSION_TYPE_PERIODIC, img2, 8, 8,
+                                   1, leaves2d) == 0);
+  for (int i = 0; i < 16; i++) {
+    CHECK_NEAR(leaves2d[i], b_ll[i], 5e-4);        /* leaf 0 = LL */
+    CHECK_NEAR(leaves2d[48 + i], b_hh[i], 5e-4);   /* leaf 3 = HH */
+  }
+  CHECK(wavelet_packet_inverse_transform2d(1, WAVELET_TYPE_DAUBECHIES, 4,
+                                           EXTENSION_TYPE_PERIODIC,
+                                           leaves2d, 8, 8, 1,
+                                           prec2d) == 0);
+  for (int i = 0; i < 64; i++) {
+    CHECK_NEAR(prec2d[i], img2[i], 5e-4);
+  }
+  /* dims not divisible by 2^levels are a contract violation
+   * (6 % 2^2 != 0; only 6*8 floats of img2 are read) */
+  CHECK(wavelet_packet_transform2d(1, WAVELET_TYPE_DAUBECHIES, 4,
+                                   EXTENSION_TYPE_PERIODIC, img2, 6, 8,
+                                   2, leaves2d) != 0);
+
   /* layout helpers (inc/simd/wavelet.h:55-88 semantics) */
   float *prep = wavelet_prepare_array(8, sig, 64);
   CHECK(prep != NULL && prep[0] == sig[0] && prep[63] == sig[63]);
@@ -392,6 +434,28 @@ static void test_mathfun(void) {
   }
   CHECK(exp_psv(1, src, 128, res) == 0);
   CHECK_NEAR(res[50], expf(src[50]), 1e-4);
+
+  /* sqrt/pow (the NEON header's extras, neon_mathfun.h:307,314) */
+  float pos[64], expo[64];
+  for (int i = 0; i < 64; i++) {
+    pos[i] = 0.5f + 0.25f * (float)i;
+    expo[i] = -1.5f + 0.1f * (float)i;
+  }
+  CHECK(sqrt_psv(1, pos, 64, res) == 0);
+  for (int i = 0; i < 64; i += 9) {
+    CHECK_NEAR(res[i], sqrtf(pos[i]), 1e-5);
+  }
+  CHECK(pow_psv(1, pos, expo, 64, res) == 0);
+  for (int i = 0; i < 64; i += 9) {
+    CHECK_NEAR(res[i], powf(pos[i], expo[i]),
+               2e-4 * (1. + fabs(powf(pos[i], expo[i]))));
+  }
+  /* oracle twin agreement */
+  float res_na[64];
+  CHECK(pow_psv(0, pos, expo, 64, res_na) == 0);
+  for (int i = 0; i < 64; i += 9) {
+    CHECK_NEAR(res[i], res_na[i], 2e-4 * (1. + fabs(res_na[i])));
+  }
 }
 
 static void test_spectral(void) {
@@ -805,6 +869,98 @@ static void test_filters(void) {
   CHECK_NEAR(s, 1.0, 1e-12);
   double bad = 1.5;
   CHECK(filt_firwin(33, &bad, 1, 1, 0, taps) != 0);
+
+  /* firwin2: a lowpass breakpoint profile has unit DC gain and kills
+   * Nyquist; non-ascending freq is a contract violation */
+  const double f2[4] = {0.0, 0.3, 0.5, 1.0};
+  const double g2[4] = {1.0, 1.0, 0.0, 0.0};
+  CHECK(filt_firwin2(33, f2, g2, 4, 0, 0, taps) == 0);
+  s = 0.0;
+  double nyq = 0.0;
+  for (int i = 0; i < 33; i++) {
+    s += taps[i];
+    nyq += (i % 2 == 0) ? taps[i] : -taps[i];
+  }
+  CHECK_NEAR(s, 1.0, 5e-3);
+  CHECK_NEAR(nyq, 0.0, 5e-3);
+  const double fbad[4] = {0.0, 0.5, 0.3, 1.0};
+  CHECK(filt_firwin2(33, fbad, g2, 4, 0, 0, taps) != 0);
+}
+
+static void test_waveforms(void) {
+  enum { N = 256 };
+  float t[N], y[N], y_na[N];
+  for (int i = 0; i < N; i++) {
+    t[i] = (float)i / (float)N;          /* one second at N Hz */
+  }
+
+  /* linear chirp starts at cos(phi); XLA-vs-oracle agreement */
+  CHECK(wave_chirp(1, t, N, 2.0, 1.0, 30.0, VELES_CHIRP_LINEAR, 0.0,
+                   y) == 0);
+  CHECK_NEAR(y[0], 1.f, 1e-5);
+  CHECK(wave_chirp(0, t, N, 2.0, 1.0, 30.0, VELES_CHIRP_LINEAR, 0.0,
+                   y_na) == 0);
+  for (int i = 0; i < N; i += 31) {
+    CHECK_NEAR(y[i], y_na[i], 2e-3);
+  }
+  /* hyperbolic law too (different phase integral) */
+  CHECK(wave_chirp(1, t, N, 20.0, 1.0, 4.0, VELES_CHIRP_HYPERBOLIC, 90.0,
+                   y) == 0);
+  CHECK_NEAR(y[0], 0.f, 1e-4);           /* phi=90 degrees -> cos(pi/2) */
+
+  /* square/sawtooth hit their defining values */
+  float ph[4] = {0.1f, 2.0f, 4.0f, 6.0f};  /* phases within one cycle */
+  float sq[4];
+  CHECK(wave_square(1, ph, 4, 0.5, sq) == 0);
+  CHECK_NEAR(sq[0], 1.f, 1e-6);          /* first half: +1 */
+  CHECK_NEAR(sq[2], -1.f, 1e-6);         /* second half: -1 */
+  CHECK(wave_square(1, ph, 4, 1.5, sq) != 0);   /* duty out of range */
+  float sw[2] = {0.f, (float)M_PI};
+  float sws[2];
+  CHECK(wave_sawtooth(1, sw, 2, 1.0, sws) == 0);
+  CHECK_NEAR(sws[0], -1.f, 1e-5);        /* ramp starts at -1 */
+  CHECK_NEAR(sws[1], 0.f, 1e-5);         /* mid-cycle: 0 */
+
+  /* gausspulse peaks at t=0 with unit amplitude and decays */
+  float tg[3] = {-0.01f, 0.f, 0.01f};
+  float gp[3];
+  CHECK(wave_gausspulse(1, tg, 3, 100.0, 0.5, -6.0, gp) == 0);
+  CHECK_NEAR(gp[1], 1.f, 1e-5);
+  CHECK(fabsf(gp[0]) < 1.f && fabsf(gp[2]) < 1.f);
+  CHECK(wave_gausspulse(1, tg, 3, -1.0, 0.5, -6.0, gp) != 0);
+
+  /* unit impulse */
+  float imp[8];
+  CHECK(wave_unit_impulse(1, 8, 3, imp) == 0);
+  for (int i = 0; i < 8; i++) {
+    CHECK_NEAR(imp[i], i == 3 ? 1.f : 0.f, 1e-7);
+  }
+
+  /* MLS: nbits=5 has period 31 with 16 ones, and the default start
+   * (NULL state) matches an explicit all-ones register; the register
+   * resumes: two length-16+15 pieces equal the one-shot sequence */
+  uint8_t seq[31], seq2[31], state[5] = {1, 1, 1, 1, 1};
+  CHECK(wave_max_len_seq(5, NULL, 31, seq) == 0);
+  int ones = 0;
+  for (int i = 0; i < 31; i++) ones += seq[i];
+  CHECK(ones == 16);
+  CHECK(wave_max_len_seq(5, state, 16, seq2) == 0);
+  CHECK(wave_max_len_seq(5, state, 15, seq2 + 16) == 0);
+  for (int i = 0; i < 31; i++) {
+    CHECK(seq[i] == seq2[i]);
+  }
+  CHECK(wave_max_len_seq(33, NULL, 4, seq) != 0);  /* nbits range */
+
+  /* windows: hann endpoints are 0, boxcar is all-ones, kaiser needs
+   * beta (beta=0 degenerates to boxcar) */
+  double w[16];
+  CHECK(wave_get_window(VELES_WINDOW_HANN, 16, 0.0, w) == 0);
+  CHECK_NEAR(w[0], 0.0, 1e-12);
+  CHECK_NEAR(w[15], 0.0, 1e-12);
+  CHECK(wave_get_window(VELES_WINDOW_BOXCAR, 16, 0.0, w) == 0);
+  CHECK_NEAR(w[7], 1.0, 1e-12);
+  CHECK(wave_get_window(VELES_WINDOW_KAISER, 16, 0.0, w) == 0);
+  CHECK_NEAR(w[7], 1.0, 1e-6);
 }
 
 static void test_normalize(void) {
@@ -1042,29 +1198,63 @@ static void test_legacy_aliases(void) {
   }
 }
 
-int main(void) {
+/* Family table: `./test_veles_simd [family...]` runs the named subset
+ * (unknown names are a usage error), no arguments runs everything.
+ * The Python gate (tests/test_cshim.py) uses this to run the suite in
+ * independently-timed chunks, so one wedged family cannot eat the
+ * whole C gate's timeout budget. */
+static const struct {
+  const char *name;
+  void (*fn)(void);
+} g_families[] = {
+  {"memory", test_memory},
+  {"matrix", test_matrix},
+  {"convolve", test_convolve},
+  {"wavelet", test_wavelet},
+  {"mathfun", test_mathfun},
+  {"spectral", test_spectral},
+  {"resample", test_resample},
+  {"psd", test_psd},
+  {"czt_ls", test_czt_ls},
+  {"iir", test_iir},
+  {"filters", test_filters},
+  {"waveforms", test_waveforms},
+  {"normalize", test_normalize},
+  {"detect_peaks", test_detect_peaks},
+  {"conversions", test_conversions},
+  {"arithmetic_family", test_arithmetic_family},
+  {"legacy_aliases", test_legacy_aliases},
+};
+
+int main(int argc, char **argv) {
+  size_t n_families = sizeof(g_families) / sizeof(g_families[0]);
+  size_t i;
+  int a;
+  /* validate names before paying for backend init */
+  for (a = 1; a < argc; ++a) {
+    int known = 0;
+    for (i = 0; i < n_families; ++i)
+      if (strcmp(argv[a], g_families[i].name) == 0) known = 1;
+    if (!known) {
+      fprintf(stderr, "unknown family '%s'; known:", argv[a]);
+      for (i = 0; i < n_families; ++i)
+        fprintf(stderr, " %s", g_families[i].name);
+      fprintf(stderr, "\n");
+      return 2;
+    }
+  }
   if (veles_simd_init(NULL) != 0) {
     fprintf(stderr, "init failed: %s\n", veles_simd_last_error());
     return 2;
   }
   printf("backend: %s\n", veles_simd_backend());
 
-  test_memory();
-  test_matrix();
-  test_convolve();
-  test_wavelet();
-  test_mathfun();
-  test_spectral();
-  test_resample();
-  test_psd();
-  test_czt_ls();
-  test_iir();
-  test_filters();
-  test_normalize();
-  test_detect_peaks();
-  test_conversions();
-  test_arithmetic_family();
-  test_legacy_aliases();
+  for (i = 0; i < n_families; ++i) {
+    int wanted = (argc <= 1);
+    for (a = 1; a < argc; ++a)
+      if (strcmp(argv[a], g_families[i].name) == 0) wanted = 1;
+    if (wanted) g_families[i].fn();
+  }
 
   printf("%d checks, %d failures\n", g_checks, g_failures);
   veles_simd_shutdown();

@@ -560,6 +560,27 @@ int wavelet_packet_inverse_transform(int simd, WaveletType type, int order,
                   (unsigned long)length, levels, PTR(result));
 }
 
+int wavelet_packet_transform2d(int simd, WaveletType type, int order,
+                               ExtensionType ext, const float *src,
+                               size_t m0, size_t m1, int levels,
+                               float *leaves) {
+  return shim_run("wavelet_packet_transform2d", "(iiiiKkkiK)", simd,
+                  (int)type, order, (int)ext, PTR(src),
+                  (unsigned long)m0, (unsigned long)m1, levels,
+                  PTR(leaves));
+}
+
+int wavelet_packet_inverse_transform2d(int simd, WaveletType type,
+                                       int order, ExtensionType ext,
+                                       const float *leaves, size_t m0,
+                                       size_t m1, int levels,
+                                       float *result) {
+  return shim_run("wavelet_packet_inverse_transform2d", "(iiiiKkkiK)",
+                  simd, (int)type, order, (int)ext, PTR(leaves),
+                  (unsigned long)m0, (unsigned long)m1, levels,
+                  PTR(result));
+}
+
 /* ---- mathfun ---------------------------------------------------------- */
 
 static int psv(const char *name, int simd, const float *src, size_t length,
@@ -579,6 +600,89 @@ int log_psv(int simd, const float *src, size_t length, float *res) {
 }
 int exp_psv(int simd, const float *src, size_t length, float *res) {
   return psv("exp", simd, src, length, res);
+}
+int sqrt_psv(int simd, const float *src, size_t length, float *res) {
+  return psv("sqrt", simd, src, length, res);
+}
+
+int pow_psv(int simd, const float *base, const float *exponent,
+            size_t length, float *res) {
+  return shim_run("pow_psv", "(iKKkK)", simd, PTR(base), PTR(exponent),
+                  (unsigned long)length, PTR(res));
+}
+
+/* ---- correlate extras ------------------------------------------------- */
+
+size_t correlation_lags_length(size_t in_len, size_t in2_len,
+                               VelesCorrMode mode) {
+  size_t lo = in_len < in2_len ? in_len : in2_len;
+  size_t hi = in_len < in2_len ? in2_len : in_len;
+  if (lo == 0) return 0; /* empty input: no lags (avoids 0+0-1 wrap) */
+  switch (mode) {
+    case VELES_MODE_FULL: return in_len + in2_len - 1;
+    case VELES_MODE_SAME: return hi;
+    case VELES_MODE_VALID: return hi - lo + 1;
+  }
+  return 0;
+}
+
+int correlation_lags(size_t in_len, size_t in2_len, VelesCorrMode mode,
+                     long *lags) {
+  return shim_run("correlation_lags", "(kkiK)", (unsigned long)in_len,
+                  (unsigned long)in2_len, (int)mode, PTR(lags));
+}
+
+int deconvolve(const double *signal, size_t sig_len,
+               const double *divisor, size_t div_len,
+               double *quotient, double *remainder) {
+  return shim_run("deconvolve", "(KkKkKK)", PTR(signal),
+                  (unsigned long)sig_len, PTR(divisor),
+                  (unsigned long)div_len, PTR(quotient), PTR(remainder));
+}
+
+/* ---- waveforms -------------------------------------------------------- */
+
+int wave_chirp(int simd, const float *t, size_t length, double f0,
+               double t1, double f1, VelesChirpMethod method, double phi,
+               float *result) {
+  return shim_run("wave_chirp", "(iKkdddidK)", simd, PTR(t),
+                  (unsigned long)length, f0, t1, f1, (int)method, phi,
+                  PTR(result));
+}
+
+int wave_square(int simd, const float *t, size_t length, double duty,
+                float *result) {
+  return shim_run("wave_square", "(iKkdK)", simd, PTR(t),
+                  (unsigned long)length, duty, PTR(result));
+}
+
+int wave_sawtooth(int simd, const float *t, size_t length, double width,
+                  float *result) {
+  return shim_run("wave_sawtooth", "(iKkdK)", simd, PTR(t),
+                  (unsigned long)length, width, PTR(result));
+}
+
+int wave_gausspulse(int simd, const float *t, size_t length, double fc,
+                    double bw, double bwr, float *result) {
+  return shim_run("wave_gausspulse", "(iKkdddK)", simd, PTR(t),
+                  (unsigned long)length, fc, bw, bwr, PTR(result));
+}
+
+int wave_unit_impulse(int simd, size_t n, size_t idx, float *result) {
+  return shim_run("wave_unit_impulse", "(ikkK)", simd, (unsigned long)n,
+                  (unsigned long)idx, PTR(result));
+}
+
+int wave_max_len_seq(int nbits, uint8_t *state_io, size_t length,
+                     uint8_t *seq) {
+  return shim_run("wave_max_len_seq", "(iKkK)", nbits, PTR(state_io),
+                  (unsigned long)length, PTR(seq));
+}
+
+int wave_get_window(VelesWindowKind window, size_t n, double beta,
+                    double *result) {
+  return shim_run("wave_get_window", "(ikdK)", (int)window,
+                  (unsigned long)n, beta, PTR(result));
 }
 
 /* ---- spectral --------------------------------------------------------- */
@@ -854,6 +958,13 @@ int filt_firwin(size_t numtaps, const double *cutoffs, size_t n_cutoffs,
   return shim_run("filt_firwin", "(kKkiiK)", (unsigned long)numtaps,
                   PTR(cutoffs), (unsigned long)n_cutoffs, pass_zero,
                   window, PTR(taps));
+}
+
+int filt_firwin2(size_t numtaps, const double *freq, const double *gain,
+                 size_t n_freq, size_t nfreqs, int window, double *taps) {
+  return shim_run("filt_firwin2", "(kKKkkiK)", (unsigned long)numtaps,
+                  PTR(freq), PTR(gain), (unsigned long)n_freq,
+                  (unsigned long)nfreqs, window, PTR(taps));
 }
 
 /* ---- normalize -------------------------------------------------------- */

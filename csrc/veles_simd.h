@@ -141,6 +141,32 @@ void cross_correlate_overlap_save_finalize(VelesConvolutionHandle *handle);
 VelesConvolutionHandle *cross_correlate_overlap_initialize(size_t x_length,
                                                            size_t h_length);
 
+/* numpy-style output windows for conv/correlation results. */
+typedef enum {
+  VELES_MODE_FULL = 0,
+  VELES_MODE_SAME = 1,  /* max(in_len, in2_len) outputs (numpy.correlate
+                           convention — differs from scipy.signal when
+                           in_len < in2_len) */
+  VELES_MODE_VALID = 2,
+} VelesCorrMode;
+
+/* Entries of correlation_lags(in_len, in2_len, mode): pure C. */
+size_t correlation_lags_length(size_t in_len, size_t in2_len,
+                               VelesCorrMode mode);
+/* Lag axis for the cross-correlation output: entry i of the correlation
+ * corresponds to displacement lags[i] of the second input relative to
+ * the first.  lags: correlation_lags_length() entries. */
+int correlation_lags(size_t in_len, size_t in2_len, VelesCorrMode mode,
+                     long *lags);
+/* Polynomial long division (scipy deconvolve):
+ * signal = convolve(divisor, quotient) + remainder.  Float64 host-side
+ * (an inherently sequential recurrence on tiny operands).  quotient:
+ * sig_len - div_len + 1 entries (requires sig_len >= div_len and
+ * divisor[0] != 0); remainder: sig_len entries. */
+int deconvolve(const double *signal, size_t sig_len,
+               const double *divisor, size_t div_len,
+               double *quotient, double *remainder);
+
 /* ---- wavelet (inc/simd/wavelet.h) ------------------------------------- */
 
 typedef enum {
@@ -239,6 +265,20 @@ int wavelet_packet_inverse_transform(int simd, WaveletType type, int order,
                                      ExtensionType ext, const float *leaves,
                                      size_t length, int levels,
                                      float *result);
+/* 2D quad-tree packets: the 4^levels leaf bands (natural
+ * (ll, lh, hl, hh) order, leaf 0 = all-LL — NOTE the reverse of the 1D
+ * hi-first order), each [m0/2^levels, m1/2^levels] row-major, are
+ * written/read concatenated in `leaves` (exactly m0*m1 floats).  Both
+ * image dims must be divisible by 2^levels. */
+int wavelet_packet_transform2d(int simd, WaveletType type, int order,
+                               ExtensionType ext, const float *src,
+                               size_t m0, size_t m1, int levels,
+                               float *leaves);
+int wavelet_packet_inverse_transform2d(int simd, WaveletType type,
+                                       int order, ExtensionType ext,
+                                       const float *leaves, size_t m0,
+                                       size_t m1, int levels,
+                                       float *result);
 
 /* ---- mathfun (inc/simd/mathfun.h:142-204) ----------------------------- */
 
@@ -246,6 +286,11 @@ int sin_psv(int simd, const float *src, size_t length, float *res);
 int cos_psv(int simd, const float *src, size_t length, float *res);
 int log_psv(int simd, const float *src, size_t length, float *res);
 int exp_psv(int simd, const float *src, size_t length, float *res);
+/* Beyond the reference's four (neon_mathfun.h:307,314 have these; the
+ * AVX header only pow): elementwise base^exponent and sqrt. */
+int pow_psv(int simd, const float *base, const float *exponent,
+            size_t length, float *res);
+int sqrt_psv(int simd, const float *src, size_t length, float *res);
 
 /* ---- spectral — no reference analog (time-frequency analysis over the
  * same batched-FFT machinery as the convolve FFT path).  Complex outputs
@@ -425,6 +470,69 @@ int filt_savgol_coeffs(size_t window_length, size_t polyorder,
  * float64. */
 int filt_firwin(size_t numtaps, const double *cutoffs, size_t n_cutoffs,
                 int pass_zero, int window, double *taps);
+/* Frequency-sampling FIR design (scipy firwin2, Type I/II): taps whose
+ * magnitude response linearly interpolates the (freq, gain)
+ * breakpoints, freq ascending in [0, 1] with Nyquist = 1.  nfreqs 0
+ * selects the default interpolation grid; window takes VelesWindowKind
+ * codes 0-4 (kaiser needs beta and is rejected here).
+ * taps: numtaps float64. */
+int filt_firwin2(size_t numtaps, const double *freq, const double *gain,
+                 size_t n_freq, size_t nfreqs, int window, double *taps);
+
+/* ---- waveforms — no reference analog (scipy-convention signal
+ * generators; the classic test/excitation signals a DSP library's
+ * users synthesize before filtering).  Elementwise generators take the
+ * time/phase array `t` and write `length` floats. ---------------------- */
+
+typedef enum {
+  VELES_CHIRP_LINEAR = 0,
+  VELES_CHIRP_QUADRATIC = 1,
+  VELES_CHIRP_LOGARITHMIC = 2,
+  VELES_CHIRP_HYPERBOLIC = 3,
+} VelesChirpMethod;
+
+/* Frequency-swept cosine: instantaneous frequency runs f0 -> f1 over
+ * [0, t1] along `method`'s law; phi is the initial phase in DEGREES
+ * (scipy convention). */
+int wave_chirp(int simd, const float *t, size_t length, double f0,
+               double t1, double f1, VelesChirpMethod method, double phi,
+               float *result);
+/* Square wave of period 2*pi over phase array t: +1 for the first
+ * `duty` fraction of each cycle, -1 after (0 <= duty <= 1 inclusive;
+ * the degenerate endpoints give a constant signal). */
+int wave_square(int simd, const float *t, size_t length, double duty,
+                float *result);
+/* Sawtooth/triangle of period 2*pi: rises -1 -> 1 over the first
+ * `width` fraction, falls back over the rest (width=0.5 triangle). */
+int wave_sawtooth(int simd, const float *t, size_t length, double width,
+                  float *result);
+/* Gaussian-modulated sinusoid (real part): carrier fc Hz, fractional
+ * bandwidth bw measured bwr dB down the spectral envelope (bwr < 0). */
+int wave_gausspulse(int simd, const float *t, size_t length, double fc,
+                    double bw, double bwr, float *result);
+/* Discrete delta: n zeros with a 1 at idx. */
+int wave_unit_impulse(int simd, size_t n, size_t idx, float *result);
+/* Maximum-length sequence (Fibonacci LFSR, scipy max_len_seq):
+ * `length` bits in {0,1} into seq.  state_io: nbits bytes, the shift
+ * register — all-ones start when NULL (the scipy default; final state
+ * then discarded), else read and overwritten with the final state so a
+ * long sequence can be generated in resumable pieces.  nbits in
+ * [2, 32]; length capped at 2^22 per call (resume via state_io). */
+int wave_max_len_seq(int nbits, uint8_t *state_io, size_t length,
+                     uint8_t *seq);
+
+typedef enum {
+  VELES_WINDOW_HAMMING = 0,  /* same codes as filt_firwin's window */
+  VELES_WINDOW_HANN = 1,
+  VELES_WINDOW_BLACKMAN = 2,
+  VELES_WINDOW_BARTLETT = 3,
+  VELES_WINDOW_BOXCAR = 4,
+  VELES_WINDOW_KAISER = 5,   /* needs beta; others ignore it */
+} VelesWindowKind;
+
+/* Symmetric analysis window by kind: n float64 into result. */
+int wave_get_window(VelesWindowKind window, size_t n, double beta,
+                    double *result);
 
 /* ---- normalize (inc/simd/normalize.h:48-90) --------------------------- */
 

