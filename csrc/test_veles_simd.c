@@ -759,6 +759,37 @@ static void test_iir(void) {
   CHECK(iir_sosfilt(1, &bsos[0][0], 3, x, N, NULL, y) == 0);
   CHECK_NEAR(y[N - 1], 1.0, 1e-3);
 
+  /* elliptic: section counts, DC passthrough within the rp ripple,
+   * and rs must exceed rp */
+  double esos[2][6];
+  CHECK(iir_ellip(4, 1.0, 40.0, 0.3, 0.0, VELES_IIR_LOWPASS, NULL) == 2);
+  CHECK(iir_ellip(3, 1.0, 45.0, 0.2, 0.5, VELES_IIR_BANDPASS, NULL)
+        == 3);
+  CHECK(iir_ellip(4, 1.0, 40.0, 0.3, 0.0, VELES_IIR_LOWPASS,
+                  &esos[0][0]) == 2);
+  CHECK(iir_sosfilt(1, &esos[0][0], 2, x, N, NULL, y) == 0);
+  CHECK(fabsf(y[N - 1]) > 0.88f && fabsf(y[N - 1]) <= 1.001f);
+  CHECK(iir_ellip(4, 1.0, 0.5, 0.3, 0.0, VELES_IIR_LOWPASS, NULL) < 0);
+
+  /* notch: a steady tone at w0 is annihilated, DC passes */
+  double nsos[1][6];
+  CHECK(iir_notch(0.25, 30.0, &nsos[0][0]) == 1);
+  for (int i = 0; i < N; i++) {
+    x[i] = sinf((float)M_PI * 0.25f * (float)i);   /* w0 tone */
+  }
+  CHECK(iir_sosfilt(1, &nsos[0][0], 1, x, N, NULL, y) == 0);
+  CHECK(fabsf(y[N - 1]) < 0.05f);
+  CHECK(iir_notch(1.5, 30.0, NULL) < 0);
+  CHECK(iir_peak(0.25, 30.0, &nsos[0][0]) == 1);
+  CHECK(iir_sosfilt(1, &nsos[0][0], 1, x, N, NULL, y) == 0);
+  /* peak passes its center tone: the steady-state tail still swings
+   * with ~unit amplitude (envelope over the last cycle) */
+  float peak_amp = 0.f;
+  for (int i = N - 8; i < N; i++) {
+    if (fabsf(y[i]) > peak_amp) peak_amp = fabsf(y[i]);
+  }
+  CHECK(peak_amp > 0.7f);
+
   /* streaming: two blocks == one shot */
   for (int i = 0; i < N; i++) {
     x[i] = sinf(0.37f * (float)i);

@@ -870,6 +870,35 @@ int iir_cheby2(size_t order, double rs, double low, double high,
   return (int)sections;
 }
 
+int iir_ellip(size_t order, double rp, double rs, double low, double high,
+              VelesIirBandType btype, double *sos) {
+  long sections = -1;
+  if (shim_call_parse("iir_ellip", parse_long, &sections, "(kddddiK)",
+                      (unsigned long)order, rp, rs, low, high, (int)btype,
+                      PTR(sos)) != 0) {
+    return -1;
+  }
+  return (int)sections;
+}
+
+int iir_notch(double w0, double q, double *sos) {
+  long sections = -1;
+  if (shim_call_parse("iir_notch", parse_long, &sections, "(ddK)", w0, q,
+                      PTR(sos)) != 0) {
+    return -1;
+  }
+  return (int)sections;
+}
+
+int iir_peak(double w0, double q, double *sos) {
+  long sections = -1;
+  if (shim_call_parse("iir_peak", parse_long, &sections, "(ddK)", w0, q,
+                      PTR(sos)) != 0) {
+    return -1;
+  }
+  return (int)sections;
+}
+
 int iir_sosfilt_stream(int simd, const double *sos, size_t n_sections,
                        const float *x, size_t length, double *zi_inout,
                        float *result) {

@@ -403,6 +403,14 @@ int iir_cheby1(size_t order, double rp, double low, double high,
                VelesIirBandType btype, double *sos);
 int iir_cheby2(size_t order, double rs, double low, double high,
                VelesIirBandType btype, double *sos);
+/* Elliptic (Cauer): rp dB passband ripple AND rs dB stopband
+ * attenuation — the steepest rolloff per order. */
+int iir_ellip(size_t order, double rp, double rs, double low, double high,
+              VelesIirBandType btype, double *sos);
+/* Single-biquad notch / peak at w0 (fraction of Nyquist), -3 dB
+ * bandwidth w0/Q.  sos: 1 row of 6 float64; returns 1 or negative. */
+int iir_notch(double w0, double q, double *sos);
+int iir_peak(double w0, double q, double *sos);
 /* Streaming block filter: zi_inout ([n_sections][2] float64 DF2T
  * states, zeros to start) is read as the incoming state and
  * overwritten with the exit state, so consecutive calls concatenate

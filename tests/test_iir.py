@@ -321,6 +321,99 @@ class TestStreaming:
             iir.sosfilt(sos, np.zeros(1, np.float32), return_zf=True)
 
 
+class TestElliptic:
+    CASES = [(2, 1.0, 20.0, 0.3, "lowpass"),
+             (4, 1.0, 40.0, 0.25, "lowpass"),
+             (5, 0.5, 60.0, 0.4, "highpass"),
+             (3, 1.0, 45.0, (0.2, 0.5), "bandpass"),
+             (4, 2.0, 50.0, (0.3, 0.6), "bandstop"),
+             (1, 1.0, 40.0, 0.3, "lowpass"),
+             (8, 0.1, 100.0, 0.45, "lowpass"),
+             (7, 3.0, 80.0, 0.2, "lowpass")]
+
+    @pytest.mark.parametrize("order,rp,rs,wn,bt", CASES)
+    def test_matches_scipy(self, order, rp, rs, wn, bt):
+        _, h1 = iir.sos_frequency_response(
+            iir.ellip(order, rp, rs, wn, bt), 128)
+        _, h2 = ss.sosfreqz(ss.ellip(order, rp, rs, wn, bt,
+                                     output="sos"), worN=128)
+        np.testing.assert_allclose(h1, h2, atol=1e-10)
+
+    def test_equiripple_both_bands(self):
+        """The defining property: passband within rp dB, stopband at
+        least rs dB down, transition steeper than cheby1 at the same
+        order."""
+        sos = iir.ellip(5, 1.0, 50.0, 0.4)
+        w, h = iir.sos_frequency_response(sos, 8192)
+        pb = 20 * np.log10(np.abs(h[w < 0.399]) + 1e-300)
+        assert pb.max() < 1e-6 and pb.min() > -1.0 - 1e-3
+        # stopband starts where attenuation first reaches rs (measured
+        # 0.507 for this design); beyond it the equiripple response
+        # never comes back up
+        sb = 20 * np.log10(np.abs(h[w > 0.51]) + 1e-300)
+        assert sb.max() < -50.0 + 1e-3
+        ch = iir.cheby1(5, 1.0, 0.4)
+        _, hc = iir.sos_frequency_response(ch, 8192)
+        sbc = 20 * np.log10(np.abs(hc[w > 0.51]) + 1e-300)
+        assert sb.max() < sbc.max()  # steeper than cheby1
+
+    def test_oracle_filter_agrees(self):
+        """An ellip bandpass run through sosfilt: scan vs oracle."""
+        sos = iir.ellip(4, 1.0, 40.0, (0.2, 0.6), "bandpass")
+        x = np.random.RandomState(3).randn(4096).astype(np.float32)
+        got = np.asarray(iir.sosfilt(sos, x, simd=True))
+        want = iir.sosfilt_na(sos, x)
+        np.testing.assert_allclose(got, want, atol=2e-4)
+
+    def test_contracts(self):
+        with pytest.raises(ValueError, match="rp"):
+            iir.ellip(4, 0.0, 40.0, 0.3)
+        with pytest.raises(ValueError, match="rs"):
+            iir.ellip(4, 1.0, 0.5, 0.3)
+        with pytest.raises(ValueError, match="order"):
+            iir.ellip(0, 1.0, 40.0, 0.3)
+
+
+class TestNotchPeak:
+    @pytest.mark.parametrize("w0,Q", [(0.3, 30.0), (0.1, 5.0),
+                                      (0.7, 50.0), (0.5, 10.0)])
+    def test_notch_matches_scipy(self, w0, Q):
+        _, h1 = iir.sos_frequency_response(iir.iirnotch(w0, Q), 256)
+        b, a = ss.iirnotch(w0, Q, fs=2.0)
+        _, h2 = ss.freqz(b, a, worN=256)
+        np.testing.assert_allclose(h1, h2, atol=1e-12)
+
+    @pytest.mark.parametrize("w0,Q", [(0.3, 30.0), (0.1, 5.0),
+                                      (0.7, 50.0)])
+    def test_peak_matches_scipy(self, w0, Q):
+        _, h1 = iir.sos_frequency_response(iir.iirpeak(w0, Q), 256)
+        b, a = ss.iirpeak(w0, Q, fs=2.0)
+        _, h2 = ss.freqz(b, a, worN=256)
+        np.testing.assert_allclose(h1, h2, atol=1e-12)
+
+    def test_notch_kills_hum(self):
+        """The use case: a 50 Hz hum (w0 = 50/500 at fs=1000) vanishes
+        while the rest of the signal survives."""
+        fs = 1000.0
+        t = np.arange(4096) / fs
+        clean = np.sin(2 * np.pi * 123.0 * t).astype(np.float32)
+        hum = 0.5 * np.sin(2 * np.pi * 50.0 * t)
+        sos = iir.iirnotch(50.0 / (fs / 2), 30.0)
+        out = np.asarray(iir.sosfilt(sos, (clean + hum).astype(np.float32),
+                                     simd=True))
+        # steady state: hum suppressed > 20x, signal intact
+        tail = slice(1024, None)
+        resid = out[tail] - clean[tail]
+        assert np.sqrt(np.mean(resid ** 2)) < 0.05
+        assert np.corrcoef(out[tail], clean[tail])[0, 1] > 0.99
+
+    def test_contracts(self):
+        with pytest.raises(ValueError, match="w0"):
+            iir.iirnotch(1.2, 30.0)
+        with pytest.raises(ValueError, match="Q"):
+            iir.iirpeak(0.3, 0.0)
+
+
 class TestBessel:
     CASES = [(2, 0.3, "lowpass"), (4, 0.25, "lowpass"),
              (5, 0.4, "highpass"), (3, (0.2, 0.5), "bandpass"),

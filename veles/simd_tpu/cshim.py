@@ -546,6 +546,26 @@ def iir_cheby2(order, rs, low, high, btype, sos_out):
         low, high, btype, sos_out)
 
 
+def iir_ellip(order, rp, rs, low, high, btype, sos_out):
+    return _iir_design(
+        lambda c, bt: _iir.ellip(int(order), float(rp), float(rs), c, bt),
+        low, high, btype, sos_out)
+
+
+def _single_biquad(sos, sos_out):
+    if int(sos_out) != 0:
+        _f64(sos_out, 1, 6)[...] = sos
+    return 1
+
+
+def iir_notch(w0, q, sos_out):
+    return _single_biquad(_iir.iirnotch(float(w0), float(q)), sos_out)
+
+
+def iir_peak(w0, q, sos_out):
+    return _single_biquad(_iir.iirpeak(float(w0), float(q)), sos_out)
+
+
 def iir_sosfilt_stream(simd, sos, n_sections, x, length, zi_inout,
                        result):
     """One streaming block: filters with the caller's state and writes

@@ -47,7 +47,8 @@ import numpy as np
 from veles.simd_tpu.utils.config import resolve_simd
 
 __all__ = [
-    "butterworth", "cheby1", "cheby2", "bessel", "sosfilt",
+    "butterworth", "cheby1", "cheby2", "bessel", "ellip", "iirnotch",
+    "iirpeak", "sosfilt",
     "sosfilt_na",
     "sosfiltfilt", "sosfiltfilt_na", "lfilter", "lfilter_na",
     "sos_frequency_response", "frequency_response", "sosfilt_zi",
@@ -293,6 +294,200 @@ def cheby2(order: int, rs: float, cutoff,
     z = 1j / ct
     k = np.real(np.prod(-p) / np.prod(-z))
     return _prototype_to_digital_sos(z, p, k, cutoff, btype)
+
+
+# -- elliptic (Cauer) design machinery: complete elliptic integrals via
+#    the AGM, Jacobi sn/cn/dn via the descending Landen/Gauss
+#    transformation (Abramowitz & Stegun 16.4 / 16.12), and scalar
+#    bisection for the two transcendental solves.  All host-side
+#    float64, a few dozen scalars per design.
+
+
+def _agm(a: float, b: float) -> float:
+    # tolerance must sit above 1 ulp (2.2e-16 relative) or the loop
+    # never exits; quadratic convergence makes the last step exact
+    while abs(a - b) > 4e-16 * a:
+        a, b = 0.5 * (a + b), math.sqrt(a * b)
+    return a
+
+
+def _ellipk(m: float) -> float:
+    """Complete elliptic integral K(m) (PARAMETER m = modulus^2, scipy
+    convention): pi / (2 agm(1, sqrt(1-m)))."""
+    if not 0.0 <= m < 1.0:
+        raise ValueError(f"parameter m={m} must be in [0, 1)")
+    return math.pi / (2.0 * _agm(1.0, math.sqrt(1.0 - m)))
+
+
+def _ellipkp(m: float) -> float:
+    """Complementary integral K'(m) = K(1-m), computed from ``m``
+    directly so tiny moduli don't round 1-m to 1.0."""
+    if not 0.0 < m <= 1.0:
+        raise ValueError(f"parameter m={m} must be in (0, 1]")
+    return math.pi / (2.0 * _agm(1.0, math.sqrt(m)))
+
+
+def _ellipj(u, m: float, mc: float | None = None):
+    """Jacobi elliptic (sn, cn, dn)(u | m), vectorized over ``u``.
+
+    Descending Landen ladder: run the AGM down to the circular case,
+    evaluate sin/cos there, then climb back up with the Gauss ascending
+    recurrence (A&S 16.12.2-4).  ``mc`` optionally supplies the
+    complementary parameter 1-m exactly (the inverse-sc solve needs
+    parameter 1-m1 with m1 tiny, where forming 1-m loses it).
+    """
+    u = np.asarray(u, np.float64)
+    if mc is None:
+        mc = 1.0 - m
+    if m == 0.0:
+        return np.sin(u), np.cos(u), np.ones_like(u)
+    if mc <= 0.0:
+        sech = 1.0 / np.cosh(u)
+        return np.tanh(u), sech, sech
+    # AGM ladder a_{k+1} = (a_k+b_k)/2, c_{k+1} = (a_k-b_k)/2; keep the
+    # ratios c_k/a_k for k = 1..N that the descent needs
+    a, b = 1.0, math.sqrt(mc)
+    ratios = []
+    while True:
+        a_next, b_next = 0.5 * (a + b), math.sqrt(a * b)
+        c_next = 0.5 * (a - b)
+        ratios.append(c_next / a_next)
+        a, b = a_next, b_next
+        if c_next <= 1e-15 * a_next:
+            break
+    phi = (2.0 ** len(ratios)) * a * u
+    for ra in reversed(ratios):
+        # A&S 16.12.2: sin(2 phi_{k-1} - phi_k) = (c_k/a_k) sin(phi_k)
+        phi = 0.5 * (phi + np.arcsin(
+            np.clip(ra * np.sin(phi), -1.0, 1.0)))
+    sn = np.sin(phi)
+    cn = np.cos(phi)
+    dn = np.sqrt(np.maximum(1.0 - (1.0 - mc) * sn * sn, 0.0))
+    return sn, cn, dn
+
+
+def _bisect(f, lo: float, hi: float, iters: int = 200) -> float:
+    """Plain bisection for a monotone-bracketed root (float64-exact
+    after ~60 halvings; extra iterations are free at design time)."""
+    flo = f(lo)
+    for _ in range(iters):
+        mid = 0.5 * (lo + hi)
+        if mid == lo or mid == hi:
+            break
+        if (f(mid) > 0) == (flo > 0):
+            lo = mid
+        else:
+            hi = mid
+    return 0.5 * (lo + hi)
+
+
+def _ellip_analog_zpk(order: int, rp: float, rs: float):
+    """Analog elliptic lowpass prototype (passband edge 1 rad/s):
+    equiripple in BOTH bands.  The construction scipy's ``ellipap``
+    uses — degree equation for the transition modulus, Jacobi-function
+    pole/zero placement on the elliptic grid."""
+    eps_sq = 10.0 ** (0.1 * rp) - 1.0
+    eps = math.sqrt(eps_sq)
+    # ripple modulus m1 = (eps_p / eps_s)^2
+    m1 = eps_sq / (10.0 ** (0.1 * rs) - 1.0)
+    if m1 <= 0.0 or m1 >= 1.0:
+        raise ValueError("need rs > rp (stopband deeper than passband "
+                         "ripple)")
+    k_m1 = _ellipk(m1)
+    kp_m1 = _ellipkp(m1)
+    krat = order * k_m1 / kp_m1
+    # degree equation: find m with K(m)/K'(m) = krat (monotone in m)
+    m = _bisect(
+        lambda mm: _ellipk(mm) / _ellipkp(mm) - krat,
+        1e-300, 1.0 - 1e-16)
+    capk = _ellipk(m)
+    j = np.arange(1 - order % 2, order, 2, dtype=np.float64)
+    s, c, d = _ellipj(j * capk / order, m)
+    # zeros at +-j / (sqrt(m) sn(j K / N)); drop the odd order's
+    # sn(0) = 0 zero-at-infinity
+    snz = s[np.abs(s) > 1e-14]
+    z = 1j / (math.sqrt(m) * snz)
+    z = np.concatenate([z, np.conj(z)])
+    # v0 from the inverse sc with COMPLEMENTARY modulus (scipy's
+    # _arc_jac_sc1, from sn(i z | m1) = i sc(z | 1-m1)):
+    # solve sc(r | 1-m1) = 1/eps, r in (0, K(1-m1)) where sc is
+    # monotone 0 -> inf
+    r = _bisect(
+        lambda u: (lambda sn_, cn_, _:
+                   sn_ / cn_ - 1.0 / eps)(
+                       *_ellipj(u, 1.0 - m1, mc=m1)),
+        1e-300, kp_m1 * (1.0 - 1e-14))
+    v0 = capk * r / (order * k_m1)
+    sv, cv, dv = _ellipj(v0, 1.0 - m)
+    p = -(c * d * sv * cv + 1j * s * dv) / (1.0 - (d * sv) ** 2)
+    if order % 2:
+        # the j=0 pole is real; the rest pair with their conjugates
+        real_mask = np.abs(p.imag) <= 1e-14 * np.abs(p)
+        p = np.concatenate([p, np.conj(p[~real_mask])])
+    else:
+        p = np.concatenate([p, np.conj(p)])
+    k = np.real(np.prod(-p) / np.prod(-z))
+    if order % 2 == 0:
+        k /= math.sqrt(1.0 + eps_sq)
+    return z, p, float(k)
+
+
+def ellip(order: int, rp: float, rs: float, cutoff,
+          btype: str = "lowpass") -> np.ndarray:
+    """Elliptic (Cauer) digital filter as second-order sections
+    (scipy's ``ellip(..., output='sos')``): equiripple in BOTH bands —
+    ``rp`` dB of passband ripple, stopband at least ``rs`` dB down —
+    the steepest possible rolloff for a given order.  ``cutoff`` marks
+    the end of the passband ripple (scipy convention), as a fraction
+    of Nyquist.
+    """
+    order = _check_order(order)
+    rp, rs = float(rp), float(rs)
+    if rp <= 0:
+        raise ValueError("rp (passband ripple, dB) must be > 0")
+    if rs <= rp:
+        raise ValueError("rs (stopband attenuation, dB) must exceed rp")
+    if order == 1:
+        # degenerate: no finite zeros; scipy reduces to Chebyshev I
+        return cheby1(1, rp, cutoff, btype)
+    z, p, k = _ellip_analog_zpk(order, rp, rs)
+    return _prototype_to_digital_sos(z, p, k, cutoff, btype)
+
+
+def _notch_peak_sos(w0: float, Q: float, peak: bool) -> np.ndarray:
+    """Single-biquad notch/peak at ``w0`` (fraction of Nyquist) with
+    quality factor ``Q`` (scipy ``iirnotch``/``iirpeak``): -3 dB
+    bandwidth ``w0/Q``, unit gain away from (notch) or at (peak) the
+    center frequency."""
+    w0 = float(w0)
+    Q = float(Q)
+    if not 0.0 < w0 < 1.0:
+        raise ValueError(f"w0 {w0} must be in (0, 1) (Nyquist = 1)")
+    if Q <= 0:
+        raise ValueError("Q must be > 0")
+    wr = w0 * math.pi
+    beta = math.tan(w0 * math.pi / (2.0 * Q))  # GB = 1/sqrt(2)
+    gain = 1.0 / (1.0 + beta)
+    if peak:
+        b = (1.0 - gain) * np.array([1.0, 0.0, -1.0])
+    else:
+        b = gain * np.array([1.0, -2.0 * math.cos(wr), 1.0])
+    a1 = -2.0 * gain * math.cos(wr)
+    a2 = 2.0 * gain - 1.0
+    return np.array([[b[0], b[1], b[2], 1.0, a1, a2]], np.float64)
+
+
+def iirnotch(w0: float, Q: float) -> np.ndarray:
+    """Narrow band-reject biquad (scipy's ``iirnotch``) as a 1-section
+    SOS: unit gain everywhere except a -3 dB-bandwidth ``w0/Q`` null at
+    ``w0`` (fraction of Nyquist) — the classic mains-hum remover."""
+    return _notch_peak_sos(w0, Q, peak=False)
+
+
+def iirpeak(w0: float, Q: float) -> np.ndarray:
+    """Narrow band-pass biquad (scipy's ``iirpeak``) as a 1-section
+    SOS: unit gain only in the -3 dB band ``w0/Q`` around ``w0``."""
+    return _notch_peak_sos(w0, Q, peak=True)
 
 
 def _check_sos(sos) -> np.ndarray:
