@@ -5,13 +5,19 @@
 
 PYTHON ?= python
 
-.PHONY: all tests benchmarks bench cshim cshim-check wavelet-tables lint \
+.PHONY: all tests tests-quick benchmarks bench cshim cshim-check wavelet-tables lint \
         docs install clean
 
 all: cshim
 
 tests:
 	$(PYTHON) tools/run_tests.py
+
+# inner-loop signal in ~3 min: everything except the @pytest.mark.slow
+# suites (sharded-mesh sweeps, multi-process gates, examples, the C
+# suite).  The full gate (`make tests`) stays the CI/judging bar.
+tests-quick:
+	VELES_SIMD_PLATFORM=cpu $(PYTHON) -m pytest tests/ -q -m "not slow"
 
 benchmarks:
 	$(PYTHON) tools/benchmark_suite.py

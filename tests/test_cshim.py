@@ -19,6 +19,9 @@ import subprocess
 
 import pytest
 
+# slow tier: builds and runs the native C suite — excluded from `make tests-quick`
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CSRC = os.path.join(REPO, "csrc")
 

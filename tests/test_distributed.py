@@ -16,6 +16,9 @@ import sys
 
 import pytest
 
+# slow tier: spawns real multi-process Gloo runtimes — excluded from `make tests-quick`
+pytestmark = pytest.mark.slow
+
 HERE = os.path.dirname(os.path.abspath(__file__))
 WORKER = os.path.join(HERE, "distributed_worker.py")
 

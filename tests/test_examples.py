@@ -11,6 +11,9 @@ import sys
 
 import pytest
 
+# slow tier: each example is a fresh subprocess + jit compile — excluded from `make tests-quick`
+pytestmark = pytest.mark.slow
+
 HERE = os.path.dirname(os.path.abspath(__file__))
 EXAMPLES = os.path.join(HERE, os.pardir, "examples")
 

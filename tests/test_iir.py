@@ -213,6 +213,7 @@ class TestLfilter:
 
 
 class TestLongSignalEquivalence:
+    @pytest.mark.slow
     def test_long_signal_scan_accuracy(self):
         """The O(log n) scan stays accurate over 2^17 samples (error
         does not accumulate the way naive recomputation would)."""
@@ -277,6 +278,7 @@ class TestChebyshev:
 
 
 class TestStreaming:
+    @pytest.mark.slow
     def test_concatenated_chunks_equal_one_shot(self):
         sos = iir.butterworth(4, 0.2, "lowpass")
         x = RNG.randn(1024).astype(np.float32)
@@ -286,6 +288,7 @@ class TestStreaming:
         want = np.asarray(iir.sosfilt(sos, x, simd=True))
         np.testing.assert_allclose(got, want, atol=2e-5)
 
+    @pytest.mark.slow
     def test_ragged_chunks_and_reset(self):
         sos = iir.cheby1(3, 1.0, 0.35)
         x = RNG.randn(500).astype(np.float32)

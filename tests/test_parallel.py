@@ -13,6 +13,9 @@ import jax
 from veles.simd_tpu import parallel as par
 from veles.simd_tpu.ops import convolve as cv
 
+# slow tier: multi-stage sharded sweeps on the 8-device mesh (~6 min) — excluded from `make tests-quick`
+pytestmark = pytest.mark.slow
+
 RNG = np.random.RandomState(51)
 
 

@@ -19,6 +19,9 @@ from veles.simd_tpu.ops import normalize as nz
 from veles.simd_tpu.ops import wavelet as wv
 from veles.simd_tpu.ops.wavelet_coeffs import WaveletType, scaling_coefficients
 
+# slow tier: hypothesis sweeps — excluded from `make tests-quick`
+pytestmark = pytest.mark.slow
+
 SETTINGS = dict(max_examples=20, deadline=None)
 
 

@@ -12,6 +12,9 @@ import pytest
 
 from veles.simd_tpu.ops import wavelet as wv
 
+# slow tier: full extension x order x level sweeps — excluded from `make tests-quick`
+pytestmark = pytest.mark.slow
+
 RNG = np.random.RandomState(11)
 EXT = wv.ExtensionType.PERIODIC
 
