@@ -128,6 +128,17 @@ def resample_poly(x, up: int, down: int, taps=None, simd=None):
     ``x[..., n] -> [..., ceil(n * up / down)]``.  ``taps`` overrides the
     default windowed-sinc anti-aliasing filter (pass a host array with
     DC gain ``up`` and odd length for transparent substitution).
+
+    Edge semantics: the signal is ZERO-EXTENDED beyond its support —
+    output samples within half a filter length of either end see zeros
+    outside the signal, so they roll off toward the edges (identical on
+    the XLA path, the oracle, and the sharded path, which all extend
+    the same way; pinned by ``tests/test_resample.py``'s full-range
+    edge test).  This matches ``scipy.signal.resample_poly``'s default
+    zero-padding; the remaining difference from scipy is the
+    anti-aliasing filter design (windowed-sinc Hamming here vs scipy's
+    Kaiser), which shifts interior values by ~1e-3 — pass scipy's taps
+    via ``taps=`` for exact scipy parity everywhere.
     """
     up, down, taps = _normalize_resample_args(np.shape(x)[-1], up, down,
                                               taps)
