@@ -794,3 +794,168 @@ class TestShardedResample:
         with pytest.raises(ValueError, match="empty"):
             par.sharded_resample_poly(np.zeros(0, np.float32), 2, 1,
                                       mesh)
+
+
+class TestSharded2DSWT:
+    """Undecimated 2D SWT via the all-to-all transpose: complete
+    rows/columns per pass, so every extension is exact."""
+
+    @pytest.mark.parametrize("ext_name", ["periodic", "mirror",
+                                          "constant", "zero"])
+    def test_matches_single_chip_every_ext(self, ext_name):
+        from veles.simd_tpu.ops import wavelet as wv
+
+        mesh = par.make_mesh({"sp": 8})
+        ext = wv.ExtensionType(ext_name)
+        rng = np.random.RandomState(61)
+        img = rng.randn(64, 48).astype(np.float32)
+        got = par.sharded_swt_apply2d("daub", 8, 2, ext, img, mesh)
+        want = wv.stationary_wavelet_apply2d("daub", 8, 2, ext, img,
+                                             simd=False)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       atol=5e-4)
+
+    def test_contracts(self):
+        mesh = par.make_mesh({"sp": 8})
+        from veles.simd_tpu.ops import wavelet as wv
+
+        with pytest.raises(ValueError, match="divisible"):
+            par.sharded_swt_apply2d("daub", 8, 1,
+                                    wv.ExtensionType.PERIODIC,
+                                    np.zeros((60, 48), np.float32), mesh)
+
+
+class TestSharded2DPackets:
+    def test_leaves_match_single_chip(self):
+        from veles.simd_tpu.ops import wavelet as wv
+
+        mesh = par.make_mesh({"sp": 4, "dp": 2})
+        rng = np.random.RandomState(62)
+        img = rng.randn(64, 64).astype(np.float32)
+        got = par.sharded_wavelet_packet_transform2d(
+            "daub", 4, wv.ExtensionType.PERIODIC, img, 2, mesh,
+            axis="sp")
+        want = wv.wavelet_packet_transform2d(
+            "daub", 4, wv.ExtensionType.PERIODIC, img, 2, simd=False)
+        assert len(got) == len(want) == 16
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       atol=5e-4)
+
+    def test_contracts(self):
+        from veles.simd_tpu.ops import wavelet as wv
+
+        mesh = par.make_mesh({"sp": 8})
+        with pytest.raises(ValueError, match="divisible"):
+            par.sharded_wavelet_packet_transform2d(
+                "daub", 4, wv.ExtensionType.PERIODIC,
+                np.zeros((48, 64), np.float32), 2, mesh)  # 48 % 32 != 0
+        with pytest.raises(ValueError, match="levels"):
+            par.sharded_wavelet_packet_transform2d(
+                "daub", 4, wv.ExtensionType.PERIODIC,
+                np.zeros((64, 64), np.float32), 0, mesh)
+
+
+class TestShardedRankFilters:
+    """Halo-exchange median/rank filters: the open ppermute edge IS the
+    single-chip zero padding, so parity is exact."""
+
+    @pytest.mark.parametrize("k", [3, 9, 15])
+    def test_medfilt_exact(self, k):
+        from veles.simd_tpu.ops import filters as fl
+
+        mesh = par.make_mesh({"sp": 8})
+        rng = np.random.RandomState(63)
+        x = rng.randn(2048).astype(np.float32)
+        got = np.asarray(par.sharded_medfilt(x, k, mesh))
+        want = fl.medfilt_na(x, k)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_order_filter_erode(self):
+        from veles.simd_tpu.ops import filters as fl
+
+        mesh = par.make_mesh({"sp": 8})
+        rng = np.random.RandomState(64)
+        x = rng.randn(1024).astype(np.float32)
+        got = np.asarray(par.sharded_order_filter(x, 0, 7, mesh))
+        want = fl.order_filter_na(x, 0, 7)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_contracts(self):
+        mesh = par.make_mesh({"sp": 8})
+        with pytest.raises(ValueError, match="halo"):
+            par.sharded_medfilt(np.zeros(64, np.float32), 31, mesh)
+        with pytest.raises(ValueError, match="rank"):
+            par.sharded_order_filter(np.zeros(64, np.float32), 9, 9,
+                                     mesh)
+
+
+class TestShardedSavgol:
+    @pytest.mark.parametrize("mode", ["interp", "constant", "nearest"])
+    @pytest.mark.parametrize("deriv", [0, 1])
+    def test_matches_single_chip(self, mode, deriv):
+        from veles.simd_tpu.ops import filters as fl
+
+        mesh = par.make_mesh({"sp": 8})
+        rng = np.random.RandomState(65)
+        x = rng.randn(1024).astype(np.float32)
+        got = np.asarray(par.sharded_savgol_filter(
+            x, 11, 3, mesh, deriv=deriv, delta=0.5, mode=mode))
+        want = fl.savgol_filter(x, 11, 3, deriv=deriv, delta=0.5,
+                                mode=mode, simd=False)
+        scale = max(1.0, np.max(np.abs(want)))
+        np.testing.assert_allclose(got, want, atol=5e-4 * scale)
+
+    def test_quadratic_reproduced_interp(self):
+        """SG with polyorder >= 2 reproduces a quadratic exactly,
+        including the interp edges — across shard boundaries."""
+        mesh = par.make_mesh({"sp": 8})
+        t = np.linspace(-1, 1, 512)
+        x = (3 * t * t - 0.5 * t + 1).astype(np.float32)
+        got = np.asarray(par.sharded_savgol_filter(x, 9, 2, mesh))
+        np.testing.assert_allclose(got, x, atol=1e-4)
+
+    def test_contracts(self):
+        mesh = par.make_mesh({"sp": 8})
+        with pytest.raises(ValueError, match="mode"):
+            par.sharded_savgol_filter(np.zeros(512, np.float32), 9, 2,
+                                      mesh, mode="wrap")
+        with pytest.raises(ValueError, match="reach"):
+            par.sharded_savgol_filter(np.zeros(64, np.float32), 15, 2,
+                                      mesh, mode="interp")
+
+
+class TestShardedLombScargle:
+    def test_matches_oracle(self):
+        from veles.simd_tpu.ops import spectral as sp
+
+        mesh = par.make_mesh({"sp": 8})
+        rng = np.random.RandomState(66)
+        t = np.sort(rng.rand(1024)) * 100.0
+        x = (np.sin(1.3 * t) + 0.4 * rng.randn(1024)).astype(np.float32)
+        freqs = np.linspace(0.5, 3.0, 64)
+        got = np.asarray(par.sharded_lombscargle(t, x, freqs, mesh))
+        want = sp.lombscargle_na(t, x, freqs)
+        np.testing.assert_allclose(got, want,
+                                   atol=1e-3 * np.max(want))
+
+    def test_finds_planted_tone(self):
+        mesh = par.make_mesh({"sp": 8})
+        rng = np.random.RandomState(67)
+        t = np.sort(rng.rand(2048)) * 200.0
+        x = np.cos(2.1 * t).astype(np.float32)
+        freqs = np.linspace(0.5, 4.0, 128)
+        p = np.asarray(par.sharded_lombscargle(t, x, freqs, mesh))
+        assert abs(freqs[np.argmax(p)] - 2.1) < 0.05
+
+    def test_contracts(self):
+        mesh = par.make_mesh({"sp": 8})
+        with pytest.raises(ValueError, match="positive"):
+            par.sharded_lombscargle(np.arange(64.0),
+                                    np.zeros(64, np.float32),
+                                    np.array([-1.0]), mesh)
+        with pytest.raises(ValueError, match="divisible"):
+            par.sharded_lombscargle(np.arange(65.0),
+                                    np.zeros(65, np.float32),
+                                    np.array([1.0]), mesh)

@@ -486,6 +486,21 @@ def _check_parallel(rng):
     b = rng.randn(96, 48).astype(np.float32)
     errs.append(_rel_err(sharded_matmul(a, b, default_mesh("tp"), axis="tp"),
                          a.astype(np.float64) @ b.astype(np.float64)))
+    # round-4 sharded families: halo-exchange rank filter + psum
+    # Lomb-Scargle through the device compiler
+    from veles.simd_tpu.ops import filters as fl
+    from veles.simd_tpu.ops import spectral as sp
+    from veles.simd_tpu.parallel import (sharded_lombscargle,
+                                         sharded_medfilt)
+
+    errs.append(_rel_err(sharded_medfilt(x, 9, default_mesh("sp")),
+                         fl.medfilt_na(x, 9)))
+    t_ls = np.sort(rng.rand(1024)) * 50.0
+    x_ls = np.sin(1.7 * t_ls).astype(np.float32)
+    f_ls = np.linspace(0.5, 3.0, 32)
+    errs.append(_rel_err(
+        sharded_lombscargle(t_ls, x_ls, f_ls, default_mesh("sp")),
+        sp.lombscargle_na(t_ls, x_ls, f_ls)))
     # ring pipelines (multi-hop ppermute streaming) on the real device
     from veles.simd_tpu.ops import convolve2d as cv2
     from veles.simd_tpu.parallel import (

@@ -197,29 +197,38 @@ def savgol_coeffs(window_length: int, polyorder: int,
                              delta)[::-1]
 
 
-def _savgol_edge_fits(x_np, window_length, polyorder, deriv, delta):
-    """Polynomial edge values for mode='interp' (scipy semantics): fit
-    one polyorder polynomial to the first/last window and evaluate its
-    deriv-th derivative at the edge positions.  Host-side float64."""
+def _savgol_edge_mats(window_length, polyorder, deriv, delta):
+    """mode='interp' edge fix-up as LINEAR MAPS, host-side float64:
+    ``head = head_mat @ x[:w]`` and ``tail = tail_mat @ x[-w:]`` give
+    the deriv-th derivative of the polynomial fitted to the first/last
+    full window, evaluated at the edge positions.  The matrix form is
+    what the sharded path (``parallel.sharded_savgol_filter``) applies
+    on-device inside ``shard_map``."""
     half = window_length // 2
     pos = np.arange(window_length, dtype=np.float64)
     a_mat = pos[:, None] ** np.arange(polyorder + 1)[None, :]
     pinv = np.linalg.pinv(a_mat)
 
-    def eval_deriv(coef, at):
-        out = np.zeros(coef.shape[:-1] + at.shape)
+    def mat(at):
+        m = np.zeros((len(at), window_length))
         for j in range(deriv, polyorder + 1):
             fac = math.factorial(j) / math.factorial(j - deriv)
-            out += coef[..., j, None] * fac * at ** (j - deriv)
-        return out / float(delta) ** deriv
+            m += fac * (at[:, None] ** (j - deriv)) * pinv[j][None, :]
+        return m / float(delta) ** deriv
 
-    head_coef = np.einsum("ck,...k->...c", pinv,
-                          x_np[..., :window_length])
-    tail_coef = np.einsum("ck,...k->...c", pinv,
-                          x_np[..., -window_length:])
     at = np.arange(half, dtype=np.float64)
-    head = eval_deriv(head_coef, at)
-    tail = eval_deriv(tail_coef, at + (window_length - half))
+    return mat(at), mat(at + (window_length - half))
+
+
+def _savgol_edge_fits(x_np, window_length, polyorder, deriv, delta):
+    """Polynomial edge values for mode='interp' (scipy semantics): the
+    :func:`_savgol_edge_mats` maps applied to the end windows."""
+    head_mat, tail_mat = _savgol_edge_mats(window_length, polyorder,
+                                           deriv, delta)
+    head = np.einsum("hw,...w->...h", head_mat,
+                     x_np[..., :window_length])
+    tail = np.einsum("hw,...w->...h", tail_mat,
+                     x_np[..., -window_length:])
     return head, tail
 
 

@@ -670,6 +670,21 @@ def zoom_fft(x, fn, m=None, fs: float = 2.0, simd=None):
 # ---------------------------------------------------------------------------
 
 
+def _check_lombscargle_args(t, x, freqs):
+    """Shared validation for the single-chip and sharded Lomb-Scargle
+    paths: float64 views of (t, x, freqs) or ValueError."""
+    t = np.asarray(t, np.float64)
+    x = np.asarray(x, np.float64)
+    freqs = np.asarray(freqs, np.float64)
+    if t.ndim != 1 or x.ndim != 1 or len(t) != len(x):
+        raise ValueError("t and x must be 1D of equal length")
+    if freqs.ndim != 1 or len(freqs) == 0:
+        raise ValueError("freqs must be a non-empty 1D array")
+    if np.any(freqs <= 0):
+        raise ValueError("freqs must be positive (angular) frequencies")
+    return t, x, freqs
+
+
 @jax.jit
 def _lombscargle_xla(t, x, freqs):
     # [m, n] phase grids: the whole periodogram is a handful of
@@ -697,15 +712,7 @@ def lombscargle(t, x, freqs, simd=None):
     dense-compute shape the TPU wants.  ``t``/``freqs`` in reciprocal
     units (``freqs`` are ANGULAR frequencies, scipy convention).
     """
-    t = np.asarray(t, np.float64)
-    x_np = np.asarray(x, np.float64)
-    freqs = np.asarray(freqs, np.float64)
-    if t.ndim != 1 or x_np.ndim != 1 or len(t) != len(x_np):
-        raise ValueError("t and x must be 1D of equal length")
-    if freqs.ndim != 1 or len(freqs) == 0:
-        raise ValueError("freqs must be a non-empty 1D array")
-    if np.any(freqs <= 0):
-        raise ValueError("freqs must be positive (angular) frequencies")
+    t, x_np, freqs = _check_lombscargle_args(t, x, freqs)
     if resolve_simd(simd):
         # center the time base in float64 BEFORE the f32 cast: Scargle's
         # tau makes the estimate exactly time-shift invariant, and raw

@@ -54,6 +54,18 @@ scale across ICI — XLA collectives instead of any message-passing runtime.
 * :func:`sharded_resample_poly` — sequence-parallel **rate conversion**:
   each shard runs the single-chip dilated/strided polyphase conv on its
   halo-extended block; output ownership follows input ownership.
+* :func:`sharded_medfilt` / :func:`sharded_order_filter` /
+  :func:`sharded_savgol_filter` — sequence-parallel **nonlinear and
+  smoothing filters**: pure halo exchange (the open ``ppermute`` edge
+  IS the single-chip zero padding); Savitzky-Golay's ``interp`` edge
+  polynomial runs as a precomputed matrix on the edge-owning shards.
+* :func:`sharded_lombscargle` — sequence-parallel **irregular-sampling
+  spectral estimation**: the sample axis is sharded, two ``psum``
+  rounds of ``[n_freqs]`` vectors (tau sums, then projections)
+  replace any gather of the samples.
+* :func:`sharded_swt_apply2d` / :func:`sharded_wavelet_packet_transform2d`
+  — the all-to-all transpose family extended to the undecimated 2D SWT
+  and the 2D quad-tree packets (device-resident end to end).
 * :func:`sharded_matmul` — **tensor-parallel** GEMM: contracting dimension
   sharded (zero-padded to the axis size), partials combined with ``psum``
   over ICI.
@@ -76,10 +88,13 @@ from veles.simd_tpu.parallel.ops import (
     data_parallel, halo_exchange_left, halo_exchange_right,
     sharded_convolve, sharded_convolve2d, sharded_convolve2d_ring,
     sharded_convolve_batch, sharded_convolve_ring, sharded_istft,
-    sharded_matmul, sharded_resample_poly, sharded_sosfilt,
-    sharded_stft, sharded_welch,
-    sharded_swt, sharded_swt_reconstruct, sharded_wavelet_apply,
+    sharded_lombscargle, sharded_matmul, sharded_medfilt,
+    sharded_order_filter, sharded_resample_poly, sharded_savgol_filter,
+    sharded_sosfilt, sharded_stft, sharded_welch,
+    sharded_swt, sharded_swt_apply2d, sharded_swt_reconstruct,
+    sharded_wavelet_apply,
     sharded_wavelet_apply2d, sharded_wavelet_inverse_transform,
+    sharded_wavelet_packet_transform2d,
     sharded_wavelet_reconstruct, sharded_wavelet_reconstruct2d,
     sharded_wavelet_transform)
 
@@ -92,7 +107,11 @@ __all__ = ["make_mesh", "default_mesh", "sharded_convolve",
            "sharded_wavelet_inverse_transform",
            "sharded_wavelet_reconstruct",
            "sharded_wavelet_apply2d",
-           "sharded_wavelet_reconstruct2d", "sharded_matmul",
+           "sharded_wavelet_reconstruct2d",
+           "sharded_swt_apply2d", "sharded_wavelet_packet_transform2d",
+           "sharded_order_filter", "sharded_medfilt",
+           "sharded_savgol_filter", "sharded_lombscargle",
+           "sharded_matmul",
            "sharded_stft", "sharded_istft", "sharded_sosfilt",
            "sharded_welch", "sharded_resample_poly",
            "data_parallel", "halo_exchange_left", "halo_exchange_right",

@@ -30,6 +30,10 @@ __all__ = ["sharded_convolve", "sharded_convolve_ring",
            "sharded_wavelet_reconstruct",
            "sharded_wavelet_apply2d",
            "sharded_wavelet_reconstruct2d",
+           "sharded_swt_apply2d",
+           "sharded_wavelet_packet_transform2d",
+           "sharded_order_filter", "sharded_medfilt",
+           "sharded_savgol_filter", "sharded_lombscargle",
            "sharded_stft", "sharded_istft", "sharded_sosfilt",
            "sharded_welch", "sharded_resample_poly", "data_parallel",
            "halo_exchange_left", "halo_exchange_right"]
@@ -445,6 +449,36 @@ def _ring_tile_conv2d(tile, seg):
         tile.dtype)
 
 
+def _a2a_quad2d(row_fn, imgs, mesh: Mesh, axis: str):
+    """Shared all-to-all separable-2D choreography for a BATCH of
+    row-sharded images ``[m, n0, n1]``: row pass on complete local
+    rows, tiled ``all_to_all`` to column-split, column pass, transpose
+    back.  ``row_fn(x) -> (hi, lo)`` is the 1D analysis along the last
+    axis (decimating or not).  Returns ``(ll, lh, hl, hh)``, each
+    ``[m, n0', n1']`` row-sharded.  One shard_map / two collective
+    rounds regardless of ``m`` — callers batch bands instead of
+    looping."""
+    from veles.simd_tpu.ops import wavelet as wv
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=P(None, axis, None),
+        out_specs=(P(None, axis, None),) * 4)
+    def _run(x_local):
+        hi_r, lo_r = row_fn(x_local)                 # [m, n0/S, n1']
+        both = jnp.stack([hi_r, lo_r])               # [2, m, n0/S, n1']
+        cols = jax.lax.all_to_all(both, axis, split_axis=3,
+                                  concat_axis=2, tiled=True)
+        bands, lows = wv._apply_last(row_fn, cols)   # [2, m, n0', n1'/S]
+        quad = jnp.stack([bands, lows])              # [2, 2, m, ...]
+        quad = jax.lax.all_to_all(quad, axis, split_axis=3,
+                                  concat_axis=4, tiled=True)
+        (hh, lh), (hl, ll) = quad[0], quad[1]
+        return ll, lh, hl, hh
+
+    return _run(imgs)
+
+
 def sharded_wavelet_apply2d(type, order, ext, img, mesh: Mesh,
                             axis: str = "sp"):
     """Separable 2D DWT of one image with rows sharded over
@@ -478,30 +512,10 @@ def sharded_wavelet_apply2d(type, order, ext, img, mesh: Mesh,
             f"2*{axis}={2 * s} (each pass halves a dim that re-splits "
             f"{s} ways)")
 
-    @functools.partial(
-        shard_map, mesh=mesh,
-        in_specs=P(axis, None),
-        out_specs=(P(axis, None),) * 4)
-    def _run(x_local):
-        # row pass: complete rows live locally
-        hi_r, lo_r = wv.wavelet_apply(type, order, ext, x_local,
-                                      simd=True)
-        both = jnp.stack([hi_r, lo_r])              # [2, n0/S, n1/2]
-        # all-to-all transpose: row-split -> column-split
-        cols = jax.lax.all_to_all(both, axis, split_axis=2, concat_axis=1,
-                                  tiled=True)       # [2, n0, n1/(2S)]
-        # column pass on complete columns
-        bands, lows = wv._apply_last(
-            lambda v: wv.wavelet_apply(type, order, ext, v, simd=True),
-            cols)                                   # each [2, n0/2, n1/(2S)]
-        quad = jnp.stack([bands, lows])             # [2, 2, n0/2, n1/(2S)]
-        # transpose back: column-split -> row-split
-        quad = jax.lax.all_to_all(quad, axis, split_axis=2, concat_axis=3,
-                                  tiled=True)       # [2, 2, n0/(2S), n1/2]
-        (hh, lh), (hl, ll) = quad[0], quad[1]
-        return ll, lh, hl, hh
-
-    return _run(img)
+    quad = _a2a_quad2d(
+        lambda v: wv.wavelet_apply(type, order, ext, v, simd=True),
+        img[None], mesh, axis)
+    return tuple(b[0] for b in quad)
 
 
 def sharded_wavelet_reconstruct2d(type, order, ll, lh, hl, hh, mesh: Mesh,
@@ -1186,6 +1200,254 @@ def sharded_resample_poly(x, up: int, down: int, mesh: Mesh,
                                   pad=(p_lo, p_hi))
 
     return _run(x)
+
+
+def sharded_swt_apply2d(type, order, level, ext, img, mesh: Mesh,
+                        axis: str = "sp"):
+    """Undecimated 2D SWT of one row-sharded image — the same
+    all-to-all (distributed-transpose) choreography as
+    :func:`sharded_wavelet_apply2d`, without the decimation: the row
+    pass runs on complete local rows, an ``all_to_all`` re-shards to
+    column-split, the column pass runs on complete columns, and a
+    second ``all_to_all`` restores row sharding.  All four extensions
+    are exact (every 1D pass sees whole rows/columns).  Returns
+    ``(ll, lh, hl, hh)``, each full ``[n0, n1]``, row-sharded —
+    matching :func:`veles.simd_tpu.ops.wavelet.
+    stationary_wavelet_apply2d`.
+
+    Requires both dims divisible by ``S`` (no halving here, so no
+    factor 2).
+    """
+    from veles.simd_tpu.ops import wavelet as wv
+
+    img = jnp.asarray(img, jnp.float32)
+    if img.ndim != 2:
+        raise ValueError("sharded_swt_apply2d shards one [n0, n1] image")
+    n0, n1 = img.shape
+    s = mesh.shape[axis]
+    if n0 % s or n1 % s:
+        raise ValueError(f"image {img.shape} must have both dims "
+                         f"divisible by {axis}={s}")
+
+    quad = _a2a_quad2d(
+        lambda v: wv.stationary_wavelet_apply(type, order, level, ext,
+                                              v, simd=True),
+        img[None], mesh, axis)
+    return tuple(b[0] for b in quad)
+
+
+def sharded_wavelet_packet_transform2d(type, order, ext, img, levels,
+                                       mesh: Mesh, axis: str = "sp"):
+    """2D quad-tree wavelet packets of a row-sharded image: every band
+    re-split at every level via :func:`sharded_wavelet_apply2d` (each
+    level is one all-to-all round trip per band — the tree stays
+    device-resident end to end).  Returns the ``4^levels`` leaves in
+    the same natural ``(ll, lh, hl, hh)`` order as
+    :func:`veles.simd_tpu.ops.wavelet.wavelet_packet_transform2d`,
+    each ``[n0/2^levels, n1/2^levels]`` row-sharded.
+
+    Requires both dims divisible by ``2^levels * S`` (every level
+    halves dims that must still split S ways).
+    """
+    from veles.simd_tpu.ops import wavelet as wv
+
+    levels = int(levels)
+    if levels < 1:
+        raise ValueError("levels must be >= 1")
+    img = jnp.asarray(img, jnp.float32)
+    if img.ndim != 2:
+        raise ValueError("sharded_wavelet_packet_transform2d shards one "
+                         "[n0, n1] image")
+    s = mesh.shape[axis]
+    n0, n1 = img.shape
+    need = (1 << levels) * s
+    if n0 % need or n1 % need:
+        raise ValueError(
+            f"image {img.shape} must have both dims divisible by "
+            f"2^levels * S = {need}")
+    row_fn = lambda v: wv.wavelet_apply(type, order, ext, v, simd=True)
+    stack = img[None]                                # [m, n0, n1]
+    for _ in range(levels):
+        ll, lh, hl, hh = _a2a_quad2d(row_fn, stack, mesh, axis)
+        # leaf index grows a base-4 digit per level, natural
+        # (ll, lh, hl, hh) order — ONE shard_map per level regardless
+        # of the band count
+        stack = jnp.stack([ll, lh, hl, hh], axis=1).reshape(
+            (4 * stack.shape[0],) + ll.shape[1:])
+    return [stack[i] for i in range(stack.shape[0])]
+
+
+def sharded_order_filter(x, rank: int, kernel_size: int, mesh: Mesh,
+                         axis: str = "sp"):
+    """Sequence-parallel rank-order filter: pure halo exchange — each
+    shard fetches ``k // 2`` neighbour samples per side and runs the
+    single-chip gather+sort kernel on its extended block.  Global edge
+    shards receive zeros from the open ``ppermute``, which is exactly
+    the single-chip zero-padding, so the result is bitwise the
+    single-chip :func:`veles.simd_tpu.ops.filters.order_filter`.
+    """
+    from veles.simd_tpu.ops import filters as fl
+
+    k = fl._check_kernel(kernel_size)
+    rank = int(rank)
+    if not 0 <= rank < k:
+        raise ValueError(f"rank {rank} outside [0, {k})")
+    x = jnp.asarray(x, jnp.float32)
+    n = x.shape[-1]
+    n_shards = mesh.shape[axis]
+    if n % n_shards:
+        raise ValueError(f"signal length {n} not divisible into "
+                         f"{n_shards} shards (pad first)")
+    block = n // n_shards
+    half = k // 2
+    if half > block:
+        raise ValueError(f"kernel halo {half} exceeds the per-shard "
+                         f"block {block}")
+    spec = P(*([None] * (x.ndim - 1) + [axis]))
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=spec,
+                       out_specs=spec)
+    def _run(x_local):
+        left = halo_exchange_left(x_local, half, axis)
+        right = halo_exchange_right(x_local, half, axis)
+        x_ext = jnp.concatenate([left, x_local, right], axis=-1)
+        y = fl._rank_filter_xla(x_ext, k, rank)
+        return jax.lax.slice_in_dim(y, half, half + block, axis=-1)
+
+    return _run(x)
+
+
+def sharded_medfilt(x, kernel_size: int, mesh: Mesh, axis: str = "sp"):
+    """Sequence-parallel median filter (scipy ``medfilt`` semantics) —
+    :func:`sharded_order_filter` at the median rank."""
+    from veles.simd_tpu.ops import filters as fl
+
+    k = fl._check_kernel(kernel_size)
+    return sharded_order_filter(x, k // 2, k, mesh, axis)
+
+
+def sharded_savgol_filter(x, window_length: int, polyorder: int,
+                          mesh: Mesh, deriv: int = 0, delta: float = 1.0,
+                          mode: str = "interp", axis: str = "sp"):
+    """Sequence-parallel Savitzky-Golay: the smoothing itself is one
+    halo exchange + the local FIR correlation; the edge semantics run
+    on the shards that own the edges — ``'constant'`` needs nothing
+    (the open halo IS zero padding), ``'nearest'`` substitutes the
+    edge shards' halos with replicated end samples, ``'interp'``
+    replaces each edge half-window with the polynomial edge fit as a
+    precomputed ``[half, window]`` matrix applied to the local end
+    window (masked by ``axis_index``, so the fix-up costs one tiny
+    matmul on every shard).  Matches the single-chip
+    :func:`veles.simd_tpu.ops.filters.savgol_filter`.
+    """
+    from veles.simd_tpu.ops import filters as fl
+
+    w = fl._check_kernel(window_length, "window_length")
+    if mode not in ("interp", "constant", "nearest"):
+        raise ValueError(f"unknown mode {mode!r}")
+    x = jnp.asarray(x, jnp.float32)
+    n = x.shape[-1]
+    n_shards = mesh.shape[axis]
+    if n % n_shards:
+        raise ValueError(f"signal length {n} not divisible into "
+                         f"{n_shards} shards (pad first)")
+    block = n // n_shards
+    half = w // 2
+    need = w if mode == "interp" else half
+    if need > block:
+        raise ValueError(f"window reach {need} exceeds the per-shard "
+                         f"block {block}; fewer shards or a shorter "
+                         "window")
+    taps = jnp.asarray(
+        fl._savgol_corr_taps(w, polyorder, deriv, delta), jnp.float32)
+    if mode == "interp":
+        head_mat, tail_mat = (jnp.asarray(m, jnp.float32) for m in
+                              fl._savgol_edge_mats(w, polyorder,
+                                                   int(deriv),
+                                                   float(delta)))
+    spec = P(*([None] * (x.ndim - 1) + [axis]))
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=spec,
+                       out_specs=spec)
+    def _run(x_local):
+        idx = jax.lax.axis_index(axis)
+        left = halo_exchange_left(x_local, half, axis)
+        right = halo_exchange_right(x_local, half, axis)
+        if mode == "nearest":
+            rep_l = jnp.repeat(x_local[..., :1], half, axis=-1)
+            rep_r = jnp.repeat(x_local[..., -1:], half, axis=-1)
+            left = jnp.where(idx == 0, rep_l, left)
+            right = jnp.where(idx == n_shards - 1, rep_r, right)
+        x_ext = jnp.concatenate([left, x_local, right], axis=-1)
+        lhs = x_ext.reshape((-1, 1, x_ext.shape[-1]))
+        rhs = taps[None, None, :]
+        y = jax.lax.conv_general_dilated(
+            lhs, rhs, window_strides=(1,), padding="VALID",
+            precision=jax.lax.Precision.HIGHEST)
+        y = y.reshape(x_local.shape[:-1] + (block,))
+        if mode == "interp":
+            head = jnp.einsum("hw,...w->...h", head_mat,
+                              x_local[..., :w])
+            tail = jnp.einsum("hw,...w->...h", tail_mat,
+                              x_local[..., -w:])
+            is_first = (idx == 0)
+            is_last = (idx == n_shards - 1)
+            y = jnp.concatenate(
+                [jnp.where(is_first, head, y[..., :half]),
+                 y[..., half:block - half],
+                 jnp.where(is_last, tail, y[..., block - half:])],
+                axis=-1)
+        return y
+
+    return _run(x)
+
+
+def sharded_lombscargle(t, x, freqs, mesh: Mesh, axis: str = "sp"):
+    """Sequence-parallel Lomb-Scargle periodogram: the sample axis (the
+    long one — irregular timestamps can be millions of points) is
+    sharded; each device evaluates its trig grid slab and TWO ``psum``
+    rounds of ``[m]``-vectors produce the global sums (first the tau
+    phase sums, then the four projection sums), so the samples are
+    never gathered and the collective payload is independent of the
+    signal length.  Power comes back replicated, matching the
+    single-chip :func:`veles.simd_tpu.ops.spectral.lombscargle`.
+    """
+    from veles.simd_tpu.ops.spectral import _check_lombscargle_args
+
+    t, x_np, freqs_np = _check_lombscargle_args(t, x, freqs)
+    n_shards = mesh.shape[axis]
+    if len(t) % n_shards:
+        raise ValueError(
+            f"sample count {len(t)} not divisible into {n_shards} "
+            "shards — crop to a divisible length (padding would bias "
+            "the tau and projection sums; there is no weights channel "
+            "to neutralize padded samples)")
+    # center in float64 before the f32 cast (same reasoning as the
+    # single-chip path: tau makes the estimate shift-invariant)
+    t = t - t.mean()
+    tj = jnp.asarray(t, jnp.float32)
+    xj = jnp.asarray(x_np, jnp.float32)
+    fj = jnp.asarray(freqs_np, jnp.float32)
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(P(axis), P(axis), P()),
+                       out_specs=P())
+    def _run(t_local, x_local, w):
+        wt = w[:, None] * t_local[None, :]
+        sin2 = jax.lax.psum(jnp.sum(jnp.sin(2 * wt), axis=-1), axis)
+        cos2 = jax.lax.psum(jnp.sum(jnp.cos(2 * wt), axis=-1), axis)
+        tau = jnp.arctan2(sin2, cos2) / 2.0
+        arg = wt - tau[:, None]
+        c, s = jnp.cos(arg), jnp.sin(arg)
+        sums = jnp.stack([
+            jnp.sum(x_local[None, :] * c, axis=-1),
+            jnp.sum(x_local[None, :] * s, axis=-1),
+            jnp.sum(c * c, axis=-1),
+            jnp.sum(s * s, axis=-1)])
+        xc, xs, cc, ss = jax.lax.psum(sums, axis)
+        return 0.5 * (xc * xc / cc + xs * xs / ss)
+
+    return _run(tj, xj, fj)
 
 
 def data_parallel(fn, mesh: Mesh, axis: str = "dp"):
