@@ -254,3 +254,67 @@ def test_convolve2d_pallas_route_vs_oracle(monkeypatch):
                                            simd=True))
     np.testing.assert_allclose(got, cv2.cross_correlate2d_na(x, h),
                                atol=1e-3)
+
+
+# --------------------------------------------------------------------------
+# fused multi-level cascade (gate monkeypatched open; one Pallas pass
+# computes every level)
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("type,order,levels,n", [
+    ("daub", 8, 2, 256), ("daub", 8, 3, 512), ("sym", 8, 2, 256),
+    ("daub", 4, 4, 1024), ("coif", 12, 2, 512)])
+def test_fused_cascade_vs_level_loop(monkeypatch, type, order, levels, n):
+    from veles.simd_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setattr(pk, "should_route", lambda *a: True)
+    x = rng.randn(8, n).astype(np.float32)
+    assert wv._use_fused_cascade(x.shape, order,
+                                 wv.ExtensionType.PERIODIC, levels)
+    got = wv.wavelet_transform(type, order, wv.ExtensionType.PERIODIC,
+                               x, levels, simd=True)
+    want, cur = [], x
+    for _ in range(levels):
+        hi, lo = wv.wavelet_apply_na(type, order,
+                                     wv.ExtensionType.PERIODIC, cur)
+        want.append(hi)
+        cur = lo
+    want.append(cur)
+    assert len(got) == levels + 1
+    for g, w in zip(got, want):
+        scale = max(1.0, float(np.max(np.abs(w))))
+        np.testing.assert_allclose(np.asarray(g), w,
+                                   atol=5e-4 * scale)
+
+
+def test_fused_cascade_gate_terms(monkeypatch):
+    from veles.simd_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setattr(pk, "should_route", lambda *a: True)
+    P = wv.ExtensionType.PERIODIC
+    assert wv._use_fused_cascade((8, 256), 8, P, 2)
+    # non-periodic extensions keep the level loop (filtering does not
+    # commute with their extension)
+    assert not wv._use_fused_cascade((8, 256), 8,
+                                     wv.ExtensionType.MIRROR, 2)
+    assert not wv._use_fused_cascade((8, 256), 8, P, 1)   # single level
+    assert not wv._use_fused_cascade((8, 250), 8, P, 2)   # n % 2^L
+    assert not wv._use_fused_cascade((8, 64), 8, P, 4)    # reach >= n
+    # MAC budget: deep sym16 cascade exceeds the unroll cap
+    assert not wv._use_fused_cascade((8, 4096), 16, P, 4)
+
+
+def test_composed_filters_match_direct_cascade():
+    """The a-trous composition identity in float64: filtering with the
+    composed filters equals the explicit two-level cascade."""
+    gs, g_lo = wv._composed_cascade_filters("daub", 8, 2)
+    hi, lo = (f.astype(np.float64) for f in wv._filters("daub", 8))
+    rng_ = np.random.RandomState(9)
+    x = rng_.randn(512)
+    xe = np.concatenate([x, x[:64]])
+    lo1 = np.array([lo @ xe[2 * i:2 * i + 8] for i in range(256)])
+    lo1e = np.concatenate([lo1, lo1[:32]])
+    want_hi2 = np.array([hi @ lo1e[2 * i:2 * i + 8] for i in range(128)])
+    got_hi2 = np.array([gs[1] @ xe[4 * i:4 * i + len(gs[1])]
+                        for i in range(128)])
+    np.testing.assert_allclose(got_hi2, want_hi2, atol=1e-10)

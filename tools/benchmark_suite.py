@@ -242,6 +242,41 @@ def main():
                 wtype, order, wv.ExtensionType.PERIODIC, sig),
             samples=sig.size)
 
+    # --- fused multi-level cascade vs the level loop (round 4: one
+    # Pallas pass reads the signal once for all levels) ---
+    big = rng.randn(512, 4096).astype(np.float32)
+    bigd = jnp.asarray(big)
+
+    def cascade_fused_step(v):
+        coeffs = wv.wavelet_transform(
+            WaveletType.DAUBECHIES, 8, wv.ExtensionType.PERIODIC, v, 3,
+            simd=True)
+        return jnp.concatenate([c for c in coeffs], axis=-1)
+
+    def cascade_loop_step(v):
+        cur, outs = v, []
+        for _ in range(3):
+            hi, cur = wv.wavelet_apply(
+                WaveletType.DAUBECHIES, 8, wv.ExtensionType.PERIODIC,
+                cur, simd=True)
+            outs.append(hi)
+        return jnp.concatenate(outs + [cur], axis=-1)
+
+    benchmark(
+        "dwt cascade L3 fused 512x4096",
+        cascade_fused_step, bigd,
+        lambda: wv.wavelet_transform(
+            WaveletType.DAUBECHIES, 8, wv.ExtensionType.PERIODIC,
+            big, 3, simd=False),
+        samples=big.size, baseline_repeats=1)
+    benchmark(
+        "dwt cascade L3 level-loop 512x4096",
+        cascade_loop_step, bigd,
+        lambda: wv.wavelet_transform(
+            WaveletType.DAUBECHIES, 8, wv.ExtensionType.PERIODIC,
+            big, 3, simd=False),
+        samples=big.size, baseline_repeats=1)
+
     def swt_step(v):
         hi, lo = wv.stationary_wavelet_apply(
             WaveletType.DAUBECHIES, 8, 2, wv.ExtensionType.PERIODIC, v,

@@ -435,6 +435,18 @@ def _check_pallas1d(rng):
     hh = rng.randn(65).astype(np.float32)
     errs.append(_rel_err(cv.convolve_simd(x, hh, simd=True),
                          cv.convolve_na(x, hh)))
+    # fused multi-level cascade (round 4): on TPU wavelet_transform
+    # with PERIODIC routes through the one-pass cascade kernel
+    # (wv._use_fused_cascade); value-check all four bands
+    got = wv.wavelet_transform("daub", 8, wv.ExtensionType.PERIODIC, x,
+                               3, simd=True)
+    cur, want = x, []
+    for _ in range(3):
+        w_hi, cur = wv.wavelet_apply_na("daub", 8,
+                                        wv.ExtensionType.PERIODIC, cur)
+        want.append(w_hi)
+    want.append(cur)
+    errs += [_rel_err(g, w) for g, w in zip(got, want)]
     return max(errs), 5e-4
 
 

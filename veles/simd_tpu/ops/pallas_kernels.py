@@ -62,7 +62,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from veles.simd_tpu.utils.config import on_tpu
 
-__all__ = ["filter_bank_pallas", "filter_2d_pallas", "pallas_available",
+__all__ = ["filter_bank_pallas", "filter_2d_pallas",
+           "cascade_bank_pallas", "pallas_available",
            "pallas2d_compiled_allowed",
            "PALLAS_MIN_ROWS", "PALLAS_DIRECT_MAX_H",
            "PALLAS_2D_MAX_KERNEL_AREA"]
@@ -197,6 +198,118 @@ def _fb_call(phases, taps, tap_counts, dilation, n_out, interpret):
     if pad_rows:
         outs = [o[:n_rows] for o in outs]
     return tuple(outs)
+
+
+def _cb_kernel(*refs, plans, n_phases, n_out):
+    """Multi-channel cascade bank: each output channel accumulates
+    ``taps_c[slot] * phase[p][:, off : off + n_out]`` over its static
+    ``plan`` of (phase, offset) slots.  The generalization of
+    :func:`_fb_kernel` to per-channel tap assignments — what lets a
+    whole multi-level DWT cascade read its input once (every slice
+    unit-stride at a static offset, tap values runtime SMEM data)."""
+    n_ch = len(plans)
+    tap_refs = refs[:n_ch]
+    in_refs = refs[n_ch:n_ch + n_phases]
+    out_refs = refs[n_ch + n_phases:]
+    phases = [r[...] for r in in_refs]
+    for c, (ref, plan) in enumerate(zip(out_refs, plans)):
+        first = True
+        for slot, (p, off) in enumerate(plan):
+            t = jax.lax.slice_in_dim(phases[p], off, off + n_out,
+                                     axis=1)
+            term = tap_refs[c][slot] * t
+            ref[...] = term if first else ref[...] + term
+            first = False
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("plans", "n_out", "interpret"))
+def _cb_call(phases, taps, plans, n_out, interpret):
+    n_rows = phases[0].shape[0]
+    n_ch = len(plans)
+    row_elems = sum(p.shape[1] for p in phases) + n_ch * n_out
+    rows = _tile_rows(n_rows, row_elems)
+    pad_rows = (-n_rows) % rows
+    if pad_rows:
+        phases = [jnp.pad(p, ((0, pad_rows), (0, 0))) for p in phases]
+    grid = (phases[0].shape[0] // rows,)
+    kernel = functools.partial(_cb_kernel, plans=plans,
+                               n_phases=len(phases), n_out=n_out)
+    n_macs = sum(len(pl) for pl in plans)
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=(
+            [pl.BlockSpec(memory_space=pltpu.SMEM)] * n_ch
+            + [pl.BlockSpec((rows, p.shape[1]), lambda i: (i, 0))
+               for p in phases]),
+        out_specs=[pl.BlockSpec((rows, n_out), lambda i: (i, 0))] * n_ch,
+        out_shape=[jax.ShapeDtypeStruct((phases[0].shape[0], n_out),
+                                        jnp.float32)] * n_ch,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n_macs * phases[0].shape[0] * n_out,
+            bytes_accessed=4 * phases[0].shape[0] * row_elems,
+            transcendentals=0),
+        interpret=interpret,
+    )(*[t.astype(jnp.float32) for t in taps],
+      *[p.astype(jnp.float32) for p in phases])
+    if pad_rows:
+        outs = [o[:n_rows] for o in outs]
+    return tuple(outs)
+
+
+def cascade_bank_pallas(x_ext, taps_list, plans, n_split, n_out,
+                        interpret=None):
+    """Run a static multi-channel plan over the ``n_split``-phase
+    deinterleave of ``x_ext``: channel c computes ``out_c[..., i] =
+    sum_slot taps_c[slot] * x_ext[..., i * n_split + off * n_split +
+    p]`` for its plan slots ``(p, off)`` — i.e. arbitrary FIR channels
+    at stride ``n_split``, all from ONE pass over the input.  The
+    multi-level DWT cascade maps onto this with composed per-level
+    filters (see ``ops.wavelet._fused_cascade``).
+
+    ``plans`` must be a tuple of tuples of (phase, offset) pairs;
+    ``taps_list`` the per-channel tap vectors in plan-slot order.
+    """
+    plans = tuple(tuple((int(p), int(o)) for p, o in plan)
+                  for plan in plans)
+    if len(taps_list) != len(plans):
+        raise ValueError("one tap vector per plan channel")
+    for t, plan in zip(taps_list, plans):
+        if len(plan) == 0:
+            # an empty channel would return uninitialized VMEM
+            raise ValueError("every plan channel needs >= 1 slot")
+        if np.shape(t) != (len(plan),):
+            raise ValueError("tap vector length must equal its plan's "
+                             "slot count")
+        for p, o in plan:
+            if not 0 <= p < n_split or o < 0:
+                raise ValueError(
+                    f"plan slot (phase={p}, offset={o}) outside "
+                    f"[0, {n_split}) x [0, inf)")
+    if interpret is None:
+        interpret = not pallas_available()
+    batch_shape = x_ext.shape[:-1]
+    x2d = jnp.asarray(x_ext).reshape((-1, x_ext.shape[-1]))
+    max_off = {p: 0 for p in range(n_split)}
+    for plan in plans:
+        for p, o in plan:
+            max_off[p] = max(max_off[p], o)
+    lengths = [n_out + max_off[p] for p in range(n_split)]
+    need = max((p + (ln - 1) * n_split + 1)
+               for p, ln in enumerate(lengths))
+    if x_ext.shape[-1] < need:
+        raise ValueError(f"x_ext too short: {x_ext.shape[-1]} < {need}")
+    phases = [x2d[:, p::n_split][:, :ln]
+              for p, ln in zip(range(n_split), lengths)]
+    row_elems = sum(lengths) + len(plans) * n_out
+    if not interpret and not fits_vmem(row_elems):
+        raise ValueError(
+            f"row of {row_elems} f32 elements exceeds the kernel VMEM "
+            "tile budget; keep this shape on the XLA path")
+    outs = _cb_call(phases, [jnp.asarray(t) for t in taps_list], plans,
+                    int(n_out), bool(interpret))
+    return tuple(o.reshape(batch_shape + (n_out,)) for o in outs)
 
 
 def _phase_plan(order, stride, dilation, n_out):

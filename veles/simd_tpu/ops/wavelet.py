@@ -283,16 +283,147 @@ def stationary_wavelet_apply(type, order, level, ext, src, simd=None):
                         src.shape[-1])
 
 
+# -- fused multi-level cascade --------------------------------------------
+#
+# The level loop below reads the running lowpass from HBM once per
+# level.  For the PERIODIC extension, filtering commutes with the
+# extension, so the whole cascade collapses into L independent
+# decimated FIR banks on the ORIGINAL signal with composed filters
+# (the "algorithme a trous" identity):
+#
+#   hi_l = (h upsampled by 2^(l-1)) * L_{l-1},   L_l = (l ^ 2^(l-1)) * L_{l-1}
+#
+# Phase-decomposing every level's stride-2^l output over ONE
+# 2^L-phase deinterleave of the input makes every kernel access
+# unit-stride at a static offset (the Mosaic constraint), so all
+# levels run in a single Pallas pass: each input sample is read from
+# HBM once for the entire cascade instead of once per level.
+# Non-PERIODIC extensions do NOT commute with filtering (the cascade
+# re-extends each computed lowpass), so they keep the level loop.
+
+# unrolled-MAC budget for the fused kernel: compile time grows with the
+# statement count; 3 levels of daub8 is ~176, sym16/3 levels ~368
+_FUSED_MAX_MACS = 512
+_FUSED_MAX_LEVELS = 4
+
+
+def _composed_cascade_filters(type, order, levels):
+    """Per-level equivalent filters of the PERIODIC DWT cascade,
+    float64 host-side: ``[g_hi_1 .. g_hi_L]`` and the final composed
+    lowpass ``L_L`` (correlation orientation, matching
+    :func:`_filter_bank`)."""
+    hi, lo = _filters(type, order)
+    h = hi.astype(np.float64)
+    low = lo.astype(np.float64)
+
+    def up(f, s):
+        out = np.zeros((len(f) - 1) * s + 1)
+        out[::s] = f
+        return out
+
+    gs, l_prev = [], np.array([1.0])
+    for lvl in range(1, int(levels) + 1):
+        gs.append(np.convolve(up(h, 1 << (lvl - 1)), l_prev))
+        l_prev = np.convolve(up(low, 1 << (lvl - 1)), l_prev)
+    return gs, l_prev
+
+
+def _cascade_plan(gs, g_lo, levels):
+    """Static (plans, taps) for :func:`_pk.cascade_bank_pallas`: one
+    channel per output phase of each level's highpass (phase r of
+    ``hi_l`` is a unit-stride bank over the 2^L input phases: sample
+    ``2^l j + m`` lands on phase ``(2^l r + m) % 2^L`` at offset
+    ``(2^l r + m) // 2^L``), plus the final composed lowpass."""
+    n_split = 1 << levels
+    plans, taps, chans = [], [], []
+    for lvl, g in enumerate(gs, start=1):
+        for r in range(1 << (levels - lvl)):
+            base = (1 << lvl) * r
+            plans.append(tuple(((base + m) % n_split,
+                                (base + m) // n_split)
+                               for m in range(len(g))))
+            taps.append(np.asarray(g, np.float32))
+            chans.append((lvl, r))
+    plans.append(tuple((m % n_split, m // n_split)
+                       for m in range(len(g_lo))))
+    taps.append(np.asarray(g_lo, np.float32))
+    chans.append((levels + 1, 0))
+    return tuple(plans), taps, chans
+
+
+def _use_fused_cascade(src_shape, order, ext, levels) -> bool:
+    levels = int(levels)
+    if (ExtensionType(ext) is not ExtensionType.PERIODIC
+            or not 2 <= levels <= _FUSED_MAX_LEVELS):
+        return False
+    n = src_shape[-1]
+    if n % (1 << levels):
+        return False
+    reach = (order - 1) * ((1 << levels) - 1)
+    if reach >= n:       # composed filter wraps more than once
+        return False
+    n_macs = sum((1 << (levels - lvl))
+                 * ((order - 1) * ((1 << lvl) - 1) + 1)
+                 for lvl in range(1, levels + 1))
+    n_macs += (order - 1) * ((1 << levels) - 1) + 1
+    if n_macs > _FUSED_MAX_MACS:
+        return False
+    rows = int(np.prod(src_shape[:-1])) if len(src_shape) > 1 else 1
+    row_elems = (n + reach + (1 << levels)) + 2 * n
+    return _pk.should_route(rows, row_elems)
+
+
+@functools.partial(jax.jit, static_argnames=("type", "order", "levels"))
+def _fused_cascade(src, type, order, levels):
+    """The whole PERIODIC DWT cascade in one Pallas pass (see the
+    routing note above): returns ``(hi_1, ..., hi_L, lo_L)``."""
+    gs, g_lo = _composed_cascade_filters(type, order, levels)
+    plans, taps, chans = _cascade_plan(gs, g_lo, levels)
+    n = src.shape[-1]
+    n_split = 1 << levels
+    reach = len(g_lo) - 1
+    x_ext = _extend(src.astype(jnp.float32), ExtensionType.PERIODIC,
+                    reach + n_split, jnp)
+    outs = _pk.cascade_bank_pallas(x_ext, taps, plans, n_split,
+                                   n // n_split)
+    # re-interleave each level's output phases back to natural order
+    coeffs = []
+    for lvl in range(1, levels + 1):
+        phases = [o for o, (lv, _) in zip(outs, chans) if lv == lvl]
+        if len(phases) == 1:
+            coeffs.append(phases[0])
+        else:
+            stacked = jnp.stack(phases, axis=-1)
+            coeffs.append(stacked.reshape(
+                stacked.shape[:-2] + (n >> lvl,)))
+    coeffs.append(outs[-1])
+    return tuple(coeffs)
+
+
 def wavelet_transform(type, order, ext, src, levels, simd=None):
     """Multi-level DWT cascade: repeatedly split the lowpass band.
 
     The reference drives this manually via ``wavelet_recycle_source``
     (``tests/wavelet.cc`` cascade pattern); returns
     ``[hi_1, hi_2, ..., hi_levels, lo_levels]`` like the usual pyramid.
+
+    On TPU with the PERIODIC extension the whole cascade runs as ONE
+    Pallas pass over the signal (composed per-level filters on a
+    2^levels-phase deinterleave — each sample is read from HBM once
+    for all levels, not once per level); other extensions and
+    non-routable shapes use the level loop.
     """
+    levels = int(levels)
+    if resolve_simd(simd):
+        src_j = jnp.asarray(src)
+        _check_apply_args(type, order, src_j.shape[-1])
+        if _use_fused_cascade(src_j.shape, int(order), ext, levels):
+            return list(_fused_cascade(src_j, WaveletType(type),
+                                       int(order), levels))
+        src = src_j
     coeffs = []
     cur = src
-    for _ in range(int(levels)):
+    for _ in range(levels):
         hi, lo = wavelet_apply(type, order, ext, cur, simd=simd)
         coeffs.append(hi)
         cur = lo

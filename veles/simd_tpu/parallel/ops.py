@@ -1239,9 +1239,9 @@ def sharded_swt_apply2d(type, order, level, ext, img, mesh: Mesh,
 def sharded_wavelet_packet_transform2d(type, order, ext, img, levels,
                                        mesh: Mesh, axis: str = "sp"):
     """2D quad-tree wavelet packets of a row-sharded image: every band
-    re-split at every level via :func:`sharded_wavelet_apply2d` (each
-    level is one all-to-all round trip per band — the tree stays
-    device-resident end to end).  Returns the ``4^levels`` leaves in
+    re-split at every level, all bands batched through ONE shard_map
+    (two all-to-all rounds) per LEVEL — the tree stays device-resident
+    end to end.  Returns the ``4^levels`` leaves in
     the same natural ``(ll, lh, hl, hh)`` order as
     :func:`veles.simd_tpu.ops.wavelet.wavelet_packet_transform2d`,
     each ``[n0/2^levels, n1/2^levels]`` row-sharded.
