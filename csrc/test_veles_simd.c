@@ -771,6 +771,23 @@ static void test_iir(void) {
   CHECK(fabsf(y[N - 1]) > 0.88f && fabsf(y[N - 1]) <= 1.001f);
   CHECK(iir_ellip(4, 1.0, 0.5, 0.3, 0.0, VELES_IIR_LOWPASS, NULL) < 0);
 
+  /* order estimation: (ord, wn) feeds the matching design and the
+   * result meets the spec (DC loss within gpass for a lowpass) */
+  {
+    double wp = 0.25, ws = 0.35, wn;
+    int bo = iir_buttord(&wp, &ws, 1, 1.0, 40.0, &wn);
+    CHECK(bo > 0 && wn > wp && wn < ws);
+    CHECK(iir_cheb1ord(&wp, &ws, 1, 1.0, 40.0, &wn) > 0);
+    CHECK_NEAR(wn, wp, 1e-12);          /* cheby1 wn = passband edge */
+    CHECK(iir_ellipord(&wp, &ws, 1, 1.0, 40.0, &wn)
+          <= iir_cheb1ord(&wp, &ws, 1, 1.0, 40.0, &wn));
+    double wp2[2] = {0.2, 0.5}, ws2[2] = {0.1, 0.6}, wn2[2];
+    CHECK(iir_cheb2ord(wp2, ws2, 2, 1.0, 40.0, wn2) > 0);
+    CHECK(wn2[0] < wn2[1]);
+    double bad = 1.5;
+    CHECK(iir_buttord(&bad, &ws, 1, 1.0, 40.0, &wn) < 0);
+  }
+
   /* notch: a steady tone at w0 is annihilated, DC passes */
   double nsos[1][6];
   CHECK(iir_notch(0.25, 30.0, &nsos[0][0]) == 1);

@@ -881,6 +881,38 @@ int iir_ellip(size_t order, double rp, double rs, double low, double high,
   return (int)sections;
 }
 
+static int iir_ord(const char *method, const double *wp, const double *ws,
+                   size_t n_edges, double gpass, double gstop,
+                   double *wn_out) {
+  long order = -1;
+  if (shim_call_parse("iir_ord", parse_long, &order, "(sKKkddK)", method,
+                      PTR(wp), PTR(ws), (unsigned long)n_edges, gpass,
+                      gstop, PTR(wn_out)) != 0) {
+    return -1;
+  }
+  return (int)order;
+}
+
+int iir_buttord(const double *wp, const double *ws, size_t n_edges,
+                double gpass, double gstop, double *wn_out) {
+  return iir_ord("buttord", wp, ws, n_edges, gpass, gstop, wn_out);
+}
+
+int iir_cheb1ord(const double *wp, const double *ws, size_t n_edges,
+                 double gpass, double gstop, double *wn_out) {
+  return iir_ord("cheb1ord", wp, ws, n_edges, gpass, gstop, wn_out);
+}
+
+int iir_cheb2ord(const double *wp, const double *ws, size_t n_edges,
+                 double gpass, double gstop, double *wn_out) {
+  return iir_ord("cheb2ord", wp, ws, n_edges, gpass, gstop, wn_out);
+}
+
+int iir_ellipord(const double *wp, const double *ws, size_t n_edges,
+                 double gpass, double gstop, double *wn_out) {
+  return iir_ord("ellipord", wp, ws, n_edges, gpass, gstop, wn_out);
+}
+
 int iir_notch(double w0, double q, double *sos) {
   long sections = -1;
   if (shim_call_parse("iir_notch", parse_long, &sections, "(ddK)", w0, q,

@@ -411,6 +411,19 @@ int iir_ellip(size_t order, double rp, double rs, double low, double high,
  * bandwidth w0/Q.  sos: 1 row of 6 float64; returns 1 or negative. */
 int iir_notch(double w0, double q, double *sos);
 int iir_peak(double w0, double q, double *sos);
+/* Minimum order meeting (gpass dB passband loss, gstop dB stopband
+ * attenuation): wp/ws hold n_edges (1 or 2) band edges as Nyquist
+ * fractions (pair order decides band type, scipy convention); wn_out
+ * receives n_edges natural frequencies for the matching design
+ * function.  Returns the order, negative on error. */
+int iir_buttord(const double *wp, const double *ws, size_t n_edges,
+                double gpass, double gstop, double *wn_out);
+int iir_cheb1ord(const double *wp, const double *ws, size_t n_edges,
+                 double gpass, double gstop, double *wn_out);
+int iir_cheb2ord(const double *wp, const double *ws, size_t n_edges,
+                 double gpass, double gstop, double *wn_out);
+int iir_ellipord(const double *wp, const double *ws, size_t n_edges,
+                 double gpass, double gstop, double *wn_out);
 /* Streaming block filter: zi_inout ([n_sections][2] float64 DF2T
  * states, zeros to start) is read as the incoming state and
  * overwritten with the exit state, so consecutive calls concatenate

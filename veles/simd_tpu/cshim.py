@@ -552,6 +552,22 @@ def iir_ellip(order, rp, rs, low, high, btype, sos_out):
         low, high, btype, sos_out)
 
 
+def iir_ord(method, wp, ws, n_edges, gpass, gstop, wn_out):
+    n = int(n_edges)
+    if n not in (1, 2):
+        raise ValueError("n_edges must be 1 or 2")
+    fn = {"buttord": _iir.buttord, "cheb1ord": _iir.cheb1ord,
+          "cheb2ord": _iir.cheb2ord, "ellipord": _iir.ellipord}[method]
+    wp_v = _f64(wp, n)
+    ws_v = _f64(ws, n)
+    order, wn = fn(wp_v if n > 1 else float(wp_v[0]),
+                   ws_v if n > 1 else float(ws_v[0]),
+                   float(gpass), float(gstop))
+    if int(wn_out) != 0:
+        _f64(wn_out, n)[...] = wn
+    return int(order)
+
+
 def _single_biquad(sos, sos_out):
     if int(sos_out) != 0:
         _f64(sos_out, 1, 6)[...] = sos
