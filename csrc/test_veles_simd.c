@@ -933,6 +933,21 @@ static void test_filters(void) {
   CHECK_NEAR(nyq, 0.0, 5e-3);
   const double fbad[4] = {0.0, 0.5, 0.3, 1.0};
   CHECK(filt_firwin2(33, fbad, g2, 4, 0, 0, taps) != 0);
+
+  /* remez: equiripple lowpass has unit DC gain within its ripple and
+   * symmetric (linear-phase) taps; bad band layout is rejected */
+  const double rb[4] = {0.0, 0.18, 0.25, 0.5};
+  const double rd[2] = {1.0, 0.0};
+  double rtaps[33];
+  CHECK(filt_remez(33, rb, 2, rd, NULL, 1.0, rtaps) == 0);
+  s = 0.0;
+  for (int i = 0; i < 33; i++) {
+    s += rtaps[i];
+    CHECK_NEAR(rtaps[i], rtaps[32 - i], 1e-12);
+  }
+  CHECK_NEAR(s, 1.0, 2e-2);
+  const double rbbad[4] = {0.0, 0.3, 0.2, 0.5};
+  CHECK(filt_remez(33, rbbad, 2, rd, NULL, 1.0, rtaps) != 0);
 }
 
 static void test_waveforms(void) {

@@ -1028,6 +1028,14 @@ int filt_firwin2(size_t numtaps, const double *freq, const double *gain,
                   (unsigned long)nfreqs, window, PTR(taps));
 }
 
+int filt_remez(size_t numtaps, const double *bands, size_t n_bands,
+               const double *desired, const double *weight, double fs,
+               double *taps) {
+  return shim_run("filt_remez", "(kKkKKdK)", (unsigned long)numtaps,
+                  PTR(bands), (unsigned long)n_bands, PTR(desired),
+                  PTR(weight), fs, PTR(taps));
+}
+
 /* ---- normalize -------------------------------------------------------- */
 
 int normalize2D(int simd, const uint8_t *src, size_t src_stride,

@@ -499,6 +499,13 @@ int filt_firwin(size_t numtaps, const double *cutoffs, size_t n_cutoffs,
  * taps: numtaps float64. */
 int filt_firwin2(size_t numtaps, const double *freq, const double *gain,
                  size_t n_freq, size_t nfreqs, int window, double *taps);
+/* Parks-McClellan optimal equiripple FIR (scipy remez, bandpass type):
+ * bands holds 2*n_bands ascending edges in [0, fs/2], desired one gain
+ * per band, weight one positive weight per band or NULL for all-ones.
+ * taps: numtaps float64. */
+int filt_remez(size_t numtaps, const double *bands, size_t n_bands,
+               const double *desired, const double *weight, double fs,
+               double *taps);
 
 /* ---- waveforms — no reference analog (scipy-convention signal
  * generators; the classic test/excitation signals a DSP library's

@@ -298,3 +298,77 @@ class TestFirwin2Deconvolve:
         args = (65, [0, 0.25, 0.25, 1], [1, 1, 0, 0])
         np.testing.assert_allclose(fl.firwin2(*args), ss.firwin2(*args),
                                    atol=1e-12)
+
+
+class TestRemez:
+    """Parks-McClellan equiripple design vs scipy: the achieved
+    weighted minimax ripple must match (the optimum is unique; tap
+    differences are just each implementation's convergence noise)."""
+
+    CASES = [
+        (65, [0, 0.18, 0.22, 0.5], [1, 0], None),
+        (64, [0, 0.18, 0.22, 0.5], [1, 0], None),
+        (101, [0, 0.1, 0.15, 0.35, 0.4, 0.5], [0, 1, 0], [1, 1, 1]),
+        (33, [0, 0.2, 0.3, 0.5], [1, 0], [1, 10]),
+        (75, [0.05, 0.12, 0.18, 0.3, 0.36, 0.45], [1, 0, 1], [1, 5, 1]),
+        (17, [0, 0.1, 0.2, 0.5], [1, 0], None),
+        (48, [0, 0.15, 0.25, 0.35, 0.42, 0.5], [1, 0.5, 0], None),
+    ]
+
+    @staticmethod
+    def _ripple(taps, bands, desired, weight):
+        from scipy import signal as ss
+
+        w, h = ss.freqz(taps, worN=8192, fs=1.0)
+        h = np.abs(h)
+        rr = 0.0
+        for b, d in enumerate(desired):
+            m = (w >= bands[2 * b]) & (w <= bands[2 * b + 1])
+            wt = 1.0 if weight is None else weight[b]
+            rr = max(rr, wt * float(np.max(np.abs(h[m] - d))))
+        return rr
+
+    @pytest.mark.parametrize("numtaps,bands,desired,weight", CASES)
+    def test_achieves_scipy_ripple(self, numtaps, bands, desired,
+                                   weight):
+        from scipy import signal as ss
+
+        mine = fl.remez(numtaps, bands, desired, weight=weight)
+        sp = ss.remez(numtaps, bands, desired, weight=weight, fs=1.0)
+        rm = self._ripple(mine, bands, desired, weight)
+        rs = self._ripple(sp, bands, desired, weight)
+        assert len(mine) == numtaps
+        assert rm <= rs * 1.02 + 1e-12
+
+    def test_linear_phase_symmetry(self):
+        taps = fl.remez(51, [0, 0.2, 0.3, 0.5], [1, 0])
+        np.testing.assert_allclose(taps, taps[::-1], atol=1e-12)
+        taps2 = fl.remez(50, [0, 0.2, 0.3, 0.5], [1, 0])
+        np.testing.assert_allclose(taps2, taps2[::-1], atol=1e-12)
+
+    def test_fs_scaling(self):
+        a = fl.remez(41, [0, 180, 220, 500], [1, 0], fs=1000.0)
+        b = fl.remez(41, [0, 0.18, 0.22, 0.5], [1, 0])
+        np.testing.assert_allclose(a, b, atol=1e-12)
+
+    def test_usable_with_lfilter(self):
+        """Design -> filter: a stopband tone is crushed."""
+        from veles.simd_tpu.ops import iir
+
+        taps = fl.remez(65, [0, 0.18, 0.25, 0.5], [1, 0])
+        t = np.arange(2048)
+        tone = np.cos(2 * np.pi * 0.4 * t).astype(np.float32)
+        out = np.asarray(iir.lfilter(taps, [1.0], tone, simd=True))
+        assert np.max(np.abs(out[200:])) < 1e-2
+
+    def test_contracts(self):
+        with pytest.raises(ValueError, match="even number"):
+            fl.remez(33, [0, 0.2, 0.3], [1, 0])
+        with pytest.raises(ValueError, match="increase"):
+            fl.remez(33, [0, 0.3, 0.2, 0.5], [1, 0])
+        with pytest.raises(ValueError, match="desired"):
+            fl.remez(33, [0, 0.2, 0.3, 0.5], [1, 0, 1])
+        with pytest.raises(ValueError, match="weight"):
+            fl.remez(33, [0, 0.2, 0.3, 0.5], [1, 0], weight=[1, -1])
+        with pytest.raises(ValueError, match="Nyquist|zero gain"):
+            fl.remez(32, [0, 0.2, 0.3, 0.5], [1, 1])

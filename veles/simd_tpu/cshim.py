@@ -694,6 +694,15 @@ def filt_firwin2(numtaps, freq, gain, n_freq, nfreqs, window, taps):
     return 0
 
 
+def filt_remez(numtaps, bands, n_bands, desired, weight, fs, taps):
+    n = int(n_bands)
+    w = None if int(weight) == 0 else _f64(weight, n)
+    _f64(taps, numtaps)[...] = _fl.remez(
+        int(numtaps), _f64(bands, 2 * n), _f64(desired, n), weight=w,
+        fs=float(fs))
+    return 0
+
+
 _C_CORR_MODES = {0: "full", 1: "same", 2: "valid"}
 
 
