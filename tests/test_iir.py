@@ -520,3 +520,89 @@ class TestOrderEstimation:
             iir.buttord((0.1, 0.4), (0.2, 0.5), 1.0, 40.0)
         with pytest.raises(ValueError, match="bandpass"):
             iir.ellipord((0.2, 0.7), (0.1, 0.6), 1.0, 40.0)
+
+
+class TestConversions:
+    """ba <-> zpk <-> sos plumbing + group_delay vs scipy."""
+
+    def test_tf_zpk_round_trip(self):
+        b, a = ss.butter(5, 0.3)
+        z, p, k = iir.tf2zpk(b, a)
+        zs, ps, ks = ss.tf2zpk(b, a)
+        np.testing.assert_allclose(np.sort_complex(z),
+                                   np.sort_complex(zs), atol=1e-10)
+        np.testing.assert_allclose(np.sort_complex(p),
+                                   np.sort_complex(ps), atol=1e-10)
+        assert abs(k - ks) < 1e-12
+        b2, a2 = iir.zpk2tf(z, p, k)
+        np.testing.assert_allclose(b2, b, atol=1e-10)
+        np.testing.assert_allclose(a2, a, atol=1e-10)
+
+    @pytest.mark.parametrize("order,wn", [(3, 0.2), (6, 0.45), (1, 0.3)])
+    def test_tf2sos_same_response(self, order, wn):
+        b, a = ss.butter(order, wn)
+        sos = iir.tf2sos(b, a)
+        _, h1 = iir.sos_frequency_response(sos, 128)
+        _, h2 = ss.freqz(b, a, worN=128)
+        np.testing.assert_allclose(h1, h2, atol=1e-9)
+
+    def test_sos2tf_matches_scipy(self):
+        sos = iir.cheby1(4, 1.0, 0.3)
+        b1, a1 = iir.sos2tf(sos)
+        b2, a2 = ss.sos2tf(sos)
+        np.testing.assert_allclose(b1, b2, atol=1e-12)
+        np.testing.assert_allclose(a1, a2, atol=1e-12)
+
+    def test_zpk2sos_runs_through_sosfilt(self):
+        z, p, k = ss.ellip(4, 1.0, 40.0, 0.3, output="zpk")
+        sos = iir.zpk2sos(z, p, k)
+        x = RNG.randn(300).astype(np.float32)
+        got = np.asarray(iir.sosfilt(sos, x, simd=True))
+        want = ss.sosfilt(ss.zpk2sos(z, p, k), x.astype(np.float64))
+        np.testing.assert_allclose(got, want, atol=5e-5)
+
+    def test_pure_delay_numerator(self):
+        z, p, k = iir.tf2zpk([0.0, 0.0, 1.0], [1.0, -0.5])
+        zs, ps, ks = ss.tf2zpk([0.0, 0.0, 1.0], [1.0, -0.5])
+        assert len(z) == len(zs) and abs(k - ks) < 1e-12
+        np.testing.assert_allclose(p, ps, atol=1e-12)
+
+    def test_fir_and_unequal_degrees(self):
+        """FIR (no poles) and fewer-zeros-than-poles inputs must match
+        scipy exactly — no spurious delay (round-4 review finding)."""
+        sos = iir.tf2sos([1.0, 2.0, 1.0], [1.0])
+        np.testing.assert_allclose(sos, ss.tf2sos([1, 2, 1], [1]),
+                                   atol=1e-12)
+        sos2 = iir.zpk2sos([], [0.5], 1.0)
+        np.testing.assert_allclose(sos2, ss.zpk2sos([], [0.5], 1.0),
+                                   atol=1e-12)
+        # impulse responses line up sample-for-sample
+        b, a = ss.butter(3, 0.4)
+        imp = np.zeros(32, np.float32)
+        imp[0] = 1.0
+        got = np.asarray(iir.sosfilt(iir.tf2sos(b[:2], a), imp,
+                                     simd=False))
+        want = ss.lfilter(b[:2], a, imp.astype(np.float64))
+        np.testing.assert_allclose(got, want, atol=1e-10)
+
+    def test_group_delay_singular_warns(self):
+        """A numerator zero ON the unit circle at a grid frequency is
+        flagged, not silently garbage."""
+        # zero exactly at w = 0.5 (z = exp(j pi/2)): b = [1, 0, 1]
+        with pytest.warns(RuntimeWarning, match="singular"):
+            _, gd = iir.group_delay(([1.0, 0.0, 1.0], [1.0]), 4)
+        assert np.all(np.isfinite(gd))
+
+    def test_group_delay_matches_scipy(self):
+        b, a = ss.cheby1(5, 1.0, 0.35)
+        w, gd = iir.group_delay((b, a), 256)
+        ws, gds = ss.group_delay((b, a), w=w * np.pi)
+        np.testing.assert_allclose(gd, gds, atol=1e-8)
+
+    def test_group_delay_linear_phase_fir(self):
+        """A symmetric FIR's group delay is exactly (n-1)/2 samples."""
+        from veles.simd_tpu.ops import filters as fl
+
+        taps = fl.firwin(31, 0.4)
+        _, gd = iir.group_delay((taps, [1.0]), 64)
+        np.testing.assert_allclose(gd, 15.0, atol=1e-8)

@@ -48,7 +48,9 @@ from veles.simd_tpu.utils.config import resolve_simd
 
 __all__ = [
     "butterworth", "cheby1", "cheby2", "bessel", "ellip", "iirnotch",
-    "iirpeak", "buttord", "cheb1ord", "cheb2ord", "ellipord", "sosfilt",
+    "iirpeak", "buttord", "cheb1ord", "cheb2ord", "ellipord",
+    "tf2zpk", "zpk2tf", "zpk2sos", "tf2sos", "sos2tf", "group_delay",
+    "sosfilt",
     "sosfilt_na",
     "sosfiltfilt", "sosfiltfilt_na", "lfilter", "lfilter_na",
     "sos_frequency_response", "frequency_response", "sosfilt_zi",
@@ -118,12 +120,15 @@ def _zpk_to_sos(z, p, k) -> np.ndarray:
                 pairs.append((r, mate))
         return pairs
 
+    # degree-match with roots at the ORIGIN (scipy's convention: an
+    # origin zero/pole is b or a = [1, 0], a pure coefficient shift the
+    # shared roll below cancels) — this makes FIR inputs (no poles) and
+    # fewer-zeros-than-poles inputs exact, with no spurious delay
+    z = np.concatenate([np.asarray(z, complex),
+                        np.zeros(max(0, len(p) - len(z)), complex)])
+    p = np.concatenate([np.asarray(p, complex),
+                        np.zeros(max(0, len(z) - len(p)), complex)])
     zp, pp = _pair(z), _pair(p)
-    # every pole pair needs a zero pair; pad zeros with (None, None)
-    while len(zp) < len(pp):
-        zp.append((None, None))
-    if len(zp) > len(pp):
-        raise ValueError("more zeros than poles")
     sos = []
     for (z1, z2), (p1, p2) in zip(zp, pp):
         def _poly(r1, r2):
@@ -475,6 +480,107 @@ def _notch_peak_sos(w0: float, Q: float, peak: bool) -> np.ndarray:
     a1 = -2.0 * gain * math.cos(wr)
     a2 = 2.0 * gain - 1.0
     return np.array([[b[0], b[1], b[2], 1.0, a1, a2]], np.float64)
+
+
+# -- representation conversions (scipy's tf2zpk/zpk2tf/tf2sos/sos2tf/
+#    zpk2sos family + group_delay): the plumbing a user porting a
+#    scipy.signal pipeline needs to move between the ba / zpk / sos
+#    forms this module's designers and runners use.  Host-side float64.
+
+
+def tf2zpk(b, a):
+    """Transfer-function numerator/denominator to (zeros, poles, gain)
+    — scipy's ``tf2zpk``: leading coefficients normalized out into the
+    gain, roots via the companion eigenvalues (``np.roots``)."""
+    b = np.atleast_1d(np.asarray(b, np.float64))
+    a = np.atleast_1d(np.asarray(a, np.float64))
+    b, a = _normalize_ba(b, a)
+    b = np.trim_zeros(b, "f")   # leading zeros shift degree, like scipy
+    p = np.roots(a) if len(a) > 1 else np.array([], complex)
+    if len(b) == 0:
+        return np.array([], complex), p, 0.0
+    k = b[0]
+    z = np.roots(b / k) if len(b) > 1 else np.array([], complex)
+    return z, p, float(k)
+
+
+def zpk2tf(z, p, k):
+    """(zeros, poles, gain) to ``(b, a)`` polynomial coefficients
+    (scipy's ``zpk2tf``): real outputs when roots pair conjugately."""
+    b = float(k) * np.poly(np.asarray(z, complex))
+    a = np.poly(np.asarray(p, complex))
+    if np.allclose(b.imag, 0, atol=1e-12):
+        b = b.real
+    if np.allclose(a.imag, 0, atol=1e-12):
+        a = a.real
+    return np.atleast_1d(b), np.atleast_1d(a)
+
+
+def zpk2sos(z, p, k) -> np.ndarray:
+    """(zeros, poles, gain) to ``[n_sections, 6]`` second-order
+    sections for :func:`sosfilt`.  Same transfer function as scipy's
+    ``zpk2sos`` up to section pairing/ordering (this module pairs
+    conjugates simply; scipy's 'nearest' pairing optimizes fixed-point
+    scaling, which float execution does not need — the frequency-
+    response tests pin the equivalence)."""
+    return _zpk_to_sos(np.asarray(z, complex), np.asarray(p, complex),
+                       float(k))
+
+
+def tf2sos(b, a) -> np.ndarray:
+    """``(b, a)`` to second-order sections (via zpk)."""
+    return zpk2sos(*tf2zpk(b, a))
+
+
+def sos2tf(sos):
+    """Second-order sections to a single ``(b, a)`` pair (scipy's
+    ``sos2tf``): polynomial products of the section numerators and
+    denominators."""
+    sos = _check_sos(sos)
+    b = np.array([1.0])
+    a = np.array([1.0])
+    for row in sos:
+        b = np.convolve(b, row[:3])
+        a = np.convolve(a, row[3:])
+    return b, a
+
+
+def group_delay(system, n_points: int = 512):
+    """Group delay ``-d(phase)/d(omega)`` of a digital filter in
+    samples (scipy's ``group_delay``): ``system`` is a ``(b, a)``
+    pair.  Returns ``(w, gd)`` on the same ``linspace(0, 1, n,
+    endpoint=False)`` Nyquist-fraction axis as
+    :func:`sos_frequency_response` (also scipy's default grid scaled
+    by pi), so the two overlay point-for-point.
+
+    Uses the Fourier-differentiation identity on ``c = b * reversed(a)``:
+    gd = Re[(sum k c_k z^-k)/(sum c_k z^-k)] - (len(a) - 1), which
+    avoids numerical phase unwrapping entirely.  At frequencies where
+    the response is singular (a zero ON the unit circle at a grid
+    point) the group delay is undefined — set to 0 with a warning,
+    matching scipy.
+    """
+    b, a = system
+    b = np.atleast_1d(np.asarray(b, np.float64))
+    a = np.atleast_1d(np.asarray(a, np.float64))
+    c = np.convolve(b, a[::-1])
+    cr = c * np.arange(len(c))
+    w = np.linspace(0.0, 1.0, int(n_points), endpoint=False)
+    zm = np.exp(-1j * np.pi * w)
+    num = np.polyval(cr[::-1], zm)
+    den = np.polyval(c[::-1], zm)
+    singular = np.abs(den) < 10 * np.finfo(np.float64).eps * max(
+        1.0, float(np.sum(np.abs(c))))
+    if np.any(singular):
+        import warnings
+
+        warnings.warn("group_delay is singular at some frequencies "
+                      "(response zero on the unit circle); setting "
+                      "those points to 0", RuntimeWarning,
+                      stacklevel=2)
+    gd = np.real(num / np.where(singular, 1.0, den)) - (len(a) - 1)
+    gd[singular] = 0.0
+    return w, gd
 
 
 # -- order estimation (scipy's buttord/cheb1ord/cheb2ord/ellipord):
