@@ -542,6 +542,23 @@ static void test_resample(void) {
   for (int i = 0; i < N; i++) {
     x[i] = cosf(2.f * (float)M_PI * 7.f * (float)i / N);
   }
+  /* upfirdn: identity filter passes through; length helper matches */
+  CHECK(upfirdn_length(100, 1, 1, 1) == 100);
+  CHECK(upfirdn_length(100, 7, 3, 2) == 152);
+  {
+    const double hid[1] = {1.0};
+    float ux[8] = {1, 2, 3, 4, 5, 6, 7, 8}, uy[8];
+    CHECK(upfirdn(1, hid, 1, ux, 8, 1, 1, uy) == 0);
+    for (int i = 0; i < 8; i++) CHECK_NEAR(uy[i], ux[i], 1e-6);
+    /* zero-stuff by 2 with identity: even samples are x, odd are 0 */
+    float uy2[16];
+    CHECK(upfirdn_length(8, 1, 2, 1) == 15);
+    CHECK(upfirdn(1, hid, 1, ux, 8, 2, 1, uy2) == 0);
+    CHECK_NEAR(uy2[0], 1.f, 1e-6);
+    CHECK_NEAR(uy2[1], 0.f, 1e-6);
+    CHECK_NEAR(uy2[2], 2.f, 1e-6);
+  }
+
   size_t out_len = resample_length(N, 2, 1);
   float *y = mallocf(out_len);
   CHECK(resample_poly(1, x, N, 2, 1, NULL, 0, y) == 0);

@@ -743,6 +743,21 @@ size_t resample_length(size_t length, size_t up, size_t down) {
   return (length * up + down - 1) / down;
 }
 
+size_t upfirdn_length(size_t length, size_t h_len, size_t up,
+                      size_t down) {
+  if (length == 0 || h_len == 0 || up == 0 || down == 0) {
+    return 0;
+  }
+  return ((length - 1) * up + h_len - 1) / down + 1;
+}
+
+int upfirdn(int simd, const double *h, size_t h_len, const float *x,
+            size_t length, size_t up, size_t down, float *result) {
+  return shim_run("upfirdn", "(iKkKkkkK)", simd, PTR(h),
+                  (unsigned long)h_len, PTR(x), (unsigned long)length,
+                  (unsigned long)up, (unsigned long)down, PTR(result));
+}
+
 int spectral_czt(int simd, const float *x, size_t length, size_t m,
                  double w_re, double w_im, double a_re, double a_im,
                  float *result) {

@@ -376,6 +376,13 @@ int resample_poly(int simd, const float *x, size_t length, size_t up,
  * assumption).  result: num floats. */
 int resample_fourier(int simd, const float *x, size_t length, size_t num,
                      float *result);
+/* The raw polyphase primitive (scipy upfirdn): zero-stuff by up, FIR
+ * with h (h_len float64 taps), stride by down — no group-delay
+ * centering.  Pure-C length helper; result: upfirdn_length floats. */
+size_t upfirdn_length(size_t length, size_t h_len, size_t up,
+                      size_t down);
+int upfirdn(int simd, const double *h, size_t h_len, const float *x,
+            size_t length, size_t up, size_t down, float *result);
 
 /* ---- iir — no reference analog (recursive filtering; the recurrence
  * runs as an O(log n) associative scan on device).  SOS rows are

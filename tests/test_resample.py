@@ -202,3 +202,46 @@ def test_edge_semantics_match_scipy_same_filter(up, down):
     got = rs.resample_poly_na(x, up, down)
     assert got.shape == want.shape
     np.testing.assert_allclose(got, want, atol=1e-12)
+
+
+class TestUpfirdn:
+    """The raw polyphase primitive vs scipy.signal.upfirdn."""
+
+    @pytest.mark.parametrize("up,down,k", [(1, 1, 7), (3, 1, 11),
+                                           (1, 4, 9), (7, 3, 21),
+                                           (2, 5, 32)])
+    def test_matches_scipy(self, up, down, k):
+        from scipy import signal as ss
+
+        x = RNG.randn(200).astype(np.float32)
+        h = RNG.randn(k)
+        got = np.asarray(rs.upfirdn(h, x, up, down, simd=True))
+        want = ss.upfirdn(h, x.astype(np.float64), up, down)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, atol=1e-4)
+        np.testing.assert_allclose(rs.upfirdn_na(h, x, up, down), want,
+                                   atol=1e-12)
+
+    def test_batched(self):
+        from scipy import signal as ss
+
+        x = RNG.randn(3, 100).astype(np.float32)
+        h = RNG.randn(15)
+        got = np.asarray(rs.upfirdn(h, x, 2, 3, simd=True))
+        for i in range(3):
+            np.testing.assert_allclose(
+                got[i], ss.upfirdn(h, x[i].astype(np.float64), 2, 3),
+                atol=1e-4)
+
+    def test_identity(self):
+        x = RNG.randn(64).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(rs.upfirdn([1.0], x)), x, atol=0)
+
+    def test_contracts(self):
+        with pytest.raises(ValueError, match="up and down"):
+            rs.upfirdn([1.0], np.zeros(8, np.float32), 0, 1)
+        with pytest.raises(ValueError, match="1D filter"):
+            rs.upfirdn(np.zeros((2, 2)), np.zeros(8, np.float32))
+        with pytest.raises(ValueError, match="empty"):
+            rs.upfirdn([1.0], np.zeros(0, np.float32))

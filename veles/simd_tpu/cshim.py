@@ -483,6 +483,13 @@ def spectral_lombscargle(simd, t, x, length, freqs, n_freqs, power):
 
 # ---- resample -------------------------------------------------------------
 
+def upfirdn(simd, h, h_len, x, length, up, down, result):
+    out = _rs.upfirdn(_f64(h, h_len), _f32(x, length), int(up),
+                      int(down), simd=bool(simd))
+    _f32(result, out.shape[-1])[...] = np.asarray(out)
+    return 0
+
+
 def resample_poly(simd, x, length, up, down, taps, num_taps, result):
     t = None if int(taps) == 0 else _f32(taps, num_taps)
     out = _rs.resample_poly(_f32(x, length), int(up), int(down), taps=t,

@@ -132,8 +132,8 @@ def _zpk_to_sos(z, p, k) -> np.ndarray:
     sos = []
     for (z1, z2), (p1, p2) in zip(zp, pp):
         def _poly(r1, r2):
-            if r1 is None:
-                return np.array([0.0, 0.0, 1.0])
+            # degree matching guarantees r1 exists for every pair
+            assert r1 is not None
             if r2 is None:
                 return np.array([0.0, 1.0, -r1.real])
             c = np.poly([r1, r2])

@@ -35,7 +35,8 @@ import numpy as np
 from veles.simd_tpu.utils.config import resolve_simd
 
 __all__ = [
-    "design_lowpass", "resample_poly", "resample_poly_na", "upsample",
+    "design_lowpass", "resample_poly", "resample_poly_na", "upfirdn",
+    "upfirdn_na", "upsample",
     "decimate", "resample_fourier", "resample_fourier_na",
     "resample_length",
 ]
@@ -168,17 +169,67 @@ def resample_poly_na(x, up: int, down: int, taps=None):
     taps = np.asarray(taps, np.float64)
     pad = (len(taps) - 1) // 2
     out_len = resample_length(n, up, down)
-    stuffed = np.zeros(x.shape[:-1] + ((n - 1) * up + 1,), np.float64)
-    stuffed[..., ::up] = x
-    flat = stuffed.reshape(-1, stuffed.shape[-1])
-    full = np.stack([np.convolve(row, taps) for row in flat])
-    full = full.reshape(x.shape[:-1] + (full.shape[-1],))
+    full = _zero_stuff_convolve(x, taps, up)
     # centered: drop the group delay, then stride
     y = full[..., pad:][..., ::down]
     out = np.zeros(x.shape[:-1] + (out_len,), np.float64)
     m = min(out_len, y.shape[-1])
     out[..., :m] = y[..., :m]
     return out
+
+
+def upfirdn(h, x, up: int = 1, down: int = 1, simd=None):
+    """The raw polyphase primitive (scipy's ``upfirdn``): upsample by
+    ``up`` (zero-stuffing), FIR filter with ``h``, downsample by
+    ``down`` — WITHOUT :func:`resample_poly`'s group-delay centering
+    or gcd reduction.  Output length ``ceil(((n-1)*up + len(h)) /
+    down)`` (the full convolution span, strided), exactly scipy's.
+
+    Runs as the same single dilated/strided device correlation as
+    :func:`resample_poly` with the padding overridden to the
+    uncentered full span.
+    """
+    up, down = int(up), int(down)
+    if up < 1 or down < 1:
+        raise ValueError(f"up and down must be >= 1, got {up}, {down}")
+    h = np.asarray(h, np.float64)
+    if h.ndim != 1 or len(h) == 0:
+        raise ValueError("h must be a non-empty 1D filter")
+    n = np.shape(x)[-1]
+    if n == 0:
+        raise ValueError("empty signal")
+    k = len(h)
+    dilated = (n - 1) * up + 1
+    out_len = -(-(dilated + k - 1) // down)
+    if resolve_simd(simd):
+        # full span: left pad k-1 (conv start), right pad to cover the
+        # last strided window
+        pad = (k - 1, max(0, (out_len - 1) * down + k
+                          - (k - 1) - dilated))
+        return _resample_conv(jnp.asarray(x, jnp.float32),
+                              jnp.asarray(h, jnp.float32), up, down,
+                              out_len, pad=pad)
+    return upfirdn_na(h, x, up, down).astype(np.float32)
+
+
+def _zero_stuff_convolve(x, h, up: int):
+    """Shared float64 oracle core: zero-stuff ``x`` by ``up`` and FULL
+    convolve each row with ``h`` (both the centered resample oracle and
+    the raw upfirdn oracle stride this)."""
+    x = np.asarray(x, np.float64)
+    n = x.shape[-1]
+    stuffed = np.zeros(x.shape[:-1] + ((n - 1) * up + 1,), np.float64)
+    stuffed[..., ::up] = x
+    flat = stuffed.reshape(-1, stuffed.shape[-1])
+    full = np.stack([np.convolve(row, h) for row in flat])
+    return full.reshape(x.shape[:-1] + (full.shape[-1],))
+
+
+def upfirdn_na(h, x, up: int = 1, down: int = 1):
+    """Float64 oracle twin of :func:`upfirdn` (explicit zero-stuff,
+    full convolve, stride)."""
+    h = np.asarray(h, np.float64)
+    return _zero_stuff_convolve(x, h, int(up))[..., ::int(down)]
 
 
 def upsample(x, factor: int, taps=None, simd=None):
