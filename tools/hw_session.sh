@@ -19,9 +19,12 @@
 #                             detect_peaks' new analysis, the spectral
 #                             estimation layer), in case the
 #                             bench-embedded smoke got cut
-#   3. tools/tune_conv2d.py --quick   -> 2D crossover measurement
-#   4. tools/tune_overlap_save.py --quick  -> 1D step-size re-check
-#   5. tools/repro_pallas2d.py  -> the pallas2d bisect, DEAD LAST; its
+#   3. tools/benchmark_suite.py --quick -> per-family timed entries
+#                             (IIR/filters/spectral/resample/waveforms/
+#                             peaks/fused-cascade vs level-loop)
+#   4. tools/tune_conv2d.py --quick   -> 2D crossover measurement
+#   5. tools/tune_overlap_save.py --quick  -> 1D step-size re-check
+#   6. tools/repro_pallas2d.py  -> the pallas2d bisect, DEAD LAST; its
 #                             JSON ledger survives even if it wedges
 set -u
 OUT=${1:-/tmp/hw_session}
@@ -59,6 +62,10 @@ run smoke        timeout -k 60 1500 python tools/tpu_smoke.py \
                    --family=spectral --family=resample \
                    --family=detect_peaks \
                    --family=pallas1d --family=parallel
+# per-family timed entries (IIR, filters, spectral, resample,
+# waveforms, peaks, cascade fused-vs-loop, ...) — the table VERDICT r3
+# item 1 asks for; --quick keeps it inside a short window
+run suite        timeout -k 60 2400 python tools/benchmark_suite.py --quick
 run tune_conv2d  timeout -k 60 1800 python tools/tune_conv2d.py --quick
 run tune_os      timeout -k 60 1800 python tools/tune_overlap_save.py --quick
 run repro_p2d    timeout -k 60 2400 python tools/repro_pallas2d.py \
