@@ -606,3 +606,22 @@ class TestConversions:
         taps = fl.firwin(31, 0.4)
         _, gd = iir.group_delay((taps, [1.0]), 64)
         np.testing.assert_allclose(gd, 15.0, atol=1e-8)
+
+
+class TestFiltfiltBa:
+    def test_matches_scipy(self):
+        b, a = ss.butter(4, 0.3)
+        x = RNG.randn(2, 500).astype(np.float32)
+        got = np.asarray(iir.filtfilt(b, a, x, simd=True))
+        want = ss.filtfilt(b, a, x.astype(np.float64), axis=-1)
+        np.testing.assert_allclose(got, want, atol=2e-4)
+
+    def test_explicit_padlen_and_fir(self):
+        from veles.simd_tpu.ops import filters as fl
+
+        taps = fl.firwin(21, 0.4)
+        x = RNG.randn(300).astype(np.float32)
+        got = np.asarray(iir.filtfilt(taps, [1.0], x, padlen=50,
+                                      simd=True))
+        want = ss.filtfilt(taps, [1.0], x.astype(np.float64), padlen=50)
+        np.testing.assert_allclose(got, want, atol=2e-4)

@@ -245,3 +245,30 @@ class TestUpfirdn:
             rs.upfirdn(np.zeros((2, 2)), np.zeros(8, np.float32))
         with pytest.raises(ValueError, match="empty"):
             rs.upfirdn([1.0], np.zeros(0, np.float32))
+
+
+class TestDecimateIIR:
+    def test_matches_scipy_default(self):
+        from scipy import signal as ss
+
+        x = RNG.randn(800).astype(np.float32)
+        got = np.asarray(rs.decimate(x, 4, ftype="iir", simd=True))
+        want = ss.decimate(x.astype(np.float64), 4)  # scipy's default
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, atol=5e-4)
+
+    def test_causal(self):
+        from scipy import signal as ss
+
+        x = RNG.randn(600).astype(np.float32)
+        got = np.asarray(rs.decimate(x, 3, ftype="iir",
+                                     zero_phase=False, simd=True))
+        want = ss.decimate(x.astype(np.float64), 3, zero_phase=False)
+        np.testing.assert_allclose(got, want, atol=5e-4)
+
+    def test_contracts(self):
+        with pytest.raises(ValueError, match="ftype"):
+            rs.decimate(np.zeros(64, np.float32), 2, ftype="butter")
+        with pytest.raises(ValueError, match="taps"):
+            rs.decimate(np.zeros(64, np.float32), 2, ftype="iir",
+                        taps=np.ones(5))

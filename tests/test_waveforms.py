@@ -196,3 +196,32 @@ class TestMLSAndWindows:
     def test_window_stray_kwargs(self):
         with pytest.raises(ValueError, match="unexpected"):
             wf.get_window("hann", 32, beta=8.6)
+
+
+class TestMoreWindows:
+    """Round-4 window additions vs scipy's symmetric forms."""
+
+    @pytest.mark.parametrize("name,kw,spec", [
+        ("blackmanharris", {}, "blackmanharris"),
+        ("nuttall", {}, "nuttall"),
+        ("flattop", {}, "flattop"),
+        ("cosine", {}, "cosine"),
+        ("tukey", {"alpha": 0.3}, ("tukey", 0.3)),
+        ("tukey", {}, ("tukey", 0.5)),
+        ("gaussian", {"std": 7.0}, ("gaussian", 7.0)),
+    ])
+    def test_matches_scipy_symmetric(self, name, kw, spec):
+        from scipy import signal as ss
+
+        for n in (1, 2, 16, 51):
+            mine = wf.get_window(name, n, **kw)
+            want = ss.get_window(spec, n, fftbins=False)
+            np.testing.assert_allclose(mine, want, atol=1e-12)
+
+    def test_contracts(self):
+        with pytest.raises(ValueError, match="std"):
+            wf.get_window("gaussian", 16)
+        with pytest.raises(ValueError, match="alpha"):
+            wf.get_window("tukey", 16, alpha=1.5)
+        with pytest.raises(ValueError, match="unexpected"):
+            wf.get_window("hann", 16, alpha=0.5)

@@ -50,7 +50,7 @@ __all__ = [
     "butterworth", "cheby1", "cheby2", "bessel", "ellip", "iirnotch",
     "iirpeak", "buttord", "cheb1ord", "cheb2ord", "ellipord",
     "tf2zpk", "zpk2tf", "zpk2sos", "tf2sos", "sos2tf", "group_delay",
-    "sosfilt",
+    "filtfilt", "sosfilt",
     "sosfilt_na",
     "sosfiltfilt", "sosfiltfilt_na", "lfilter", "lfilter_na",
     "sos_frequency_response", "frequency_response", "sosfilt_zi",
@@ -1119,6 +1119,22 @@ def sosfiltfilt(sos, x, padlen=None, simd=None):
         out = bwd[..., ::-1]
         return out[..., padlen:padlen + n]
     return sosfiltfilt_na(sos, x, padlen=padlen).astype(np.float32)
+
+
+def filtfilt(b, a, x, padlen=None, simd=None):
+    """Zero-phase forward-backward filtering in ``(b, a)`` form
+    (scipy's ``filtfilt`` with its ``method='pad'`` default): routed
+    through :func:`tf2sos` + :func:`sosfiltfilt` with scipy's
+    ``3 * max(len(a), len(b))`` default padding — the same settled-
+    state odd-extension construction, so results match scipy to float
+    tolerance (the section pairing only reorders rounding).
+    """
+    b_arr = np.atleast_1d(np.asarray(b, np.float64))
+    a_arr = np.atleast_1d(np.asarray(a, np.float64))
+    if padlen is None:
+        padlen = 3 * max(len(a_arr), len(b_arr))
+    return sosfiltfilt(tf2sos(b_arr, a_arr), x, padlen=padlen,
+                       simd=simd)
 
 
 def sosfiltfilt_na(sos, x, padlen=None):

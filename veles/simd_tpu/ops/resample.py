@@ -237,10 +237,38 @@ def upsample(x, factor: int, taps=None, simd=None):
     return resample_poly(x, factor, 1, taps=taps, simd=simd)
 
 
-def decimate(x, factor: int, taps=None, simd=None):
-    """Integer-rate anti-aliased decimation:
-    ``resample_poly(x, 1, factor)``."""
-    return resample_poly(x, 1, factor, taps=taps, simd=simd)
+def decimate(x, factor: int, taps=None, ftype: str = "fir",
+             zero_phase: bool = True, simd=None):
+    """Integer-rate anti-aliased decimation.
+
+    ``ftype='fir'`` (default here): polyphase
+    ``resample_poly(x, 1, factor)`` — one strided device conv, the
+    TPU-native formulation (``zero_phase`` has no effect; the centered
+    linear-phase FIR already has none).  ``ftype='iir'``: scipy
+    ``decimate``'s default path — an order-8 Chebyshev-I (0.05 dB)
+    lowpass at ``0.8/factor`` Nyquist, applied zero-phase
+    (``sosfiltfilt``) or causally (``sosfilt``), then sliced
+    ``[..., ::factor]``.  NOTE scipy defaults to 'iir'; the default
+    differs here because the polyphase form does the anti-aliasing
+    work at the DECIMATED rate.
+    """
+    factor = int(factor)
+    if ftype == "fir":
+        return resample_poly(x, 1, factor, taps=taps, simd=simd)
+    if ftype != "iir":
+        raise ValueError(f"ftype must be 'fir' or 'iir', got {ftype!r}")
+    if factor < 1:
+        raise ValueError("factor must be >= 1")
+    if taps is not None:
+        raise ValueError("taps only applies to ftype='fir'")
+    from veles.simd_tpu.ops import iir as _iir
+
+    sos = _iir.cheby1(8, 0.05, 0.8 / factor)
+    if zero_phase:
+        y = _iir.sosfiltfilt(sos, x, simd=simd)
+    else:
+        y = _iir.sosfilt(sos, x, simd=simd)
+    return y[..., ::factor]
 
 
 @functools.partial(jax.jit, static_argnames=("num",))

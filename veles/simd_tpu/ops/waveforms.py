@@ -235,17 +235,35 @@ def max_len_seq(nbits: int, state=None, length=None):
     return out, reg
 
 
+def _cosine_sum_window(n: int, coeffs) -> np.ndarray:
+    """Symmetric generalized cosine-sum window
+    ``sum_k (-1)^k a_k cos(2 pi k t / (n-1))``."""
+    if n == 1:
+        return np.ones(1)
+    t = np.arange(n, dtype=np.float64)
+    w = np.zeros(n)
+    for k, a in enumerate(coeffs):
+        w += ((-1.0) ** k) * a * np.cos(2 * np.pi * k * t / (n - 1))
+    return w
+
+
 def get_window(name, n: int, **kwargs) -> np.ndarray:
-    """Symmetric analysis windows by name (a small ``scipy.signal.
-    get_window`` subset): 'hann', 'hamming', 'blackman', 'bartlett',
-    'boxcar', or 'kaiser' (needs ``beta=``).  Float64 host-side — pass
-    the result to :func:`~veles.simd_tpu.ops.spectral.stft`/``welch``
-    or use as FIR taps weighting."""
+    """SYMMETRIC analysis windows by name (the common
+    ``scipy.signal.get_window`` names with ``fftbins=False`` — note
+    scipy's own default is the periodic form): 'hann', 'hamming',
+    'blackman', 'blackmanharris', 'nuttall', 'flattop', 'bartlett',
+    'cosine', 'boxcar', 'tukey' (``alpha=``, default 0.5), 'gaussian'
+    (needs ``std=``), or 'kaiser' (needs ``beta=``).  Float64
+    host-side — pass the result to
+    :func:`~veles.simd_tpu.ops.spectral.stft`/``welch`` or use as FIR
+    taps weighting."""
     n = int(n)
     if n < 1:
         raise ValueError("n must be >= 1")
     name = str(name).lower()
-    stray = set(kwargs) - ({"beta"} if name == "kaiser" else set())
+    allowed = {"kaiser": {"beta"}, "gaussian": {"std"},
+               "tukey": {"alpha"}}.get(name, set())
+    stray = set(kwargs) - allowed
     if stray:
         raise ValueError(f"unexpected arguments {sorted(stray)} for "
                          f"window {name!r}")
@@ -255,10 +273,41 @@ def get_window(name, n: int, **kwargs) -> np.ndarray:
         return np.hamming(n)
     if name == "blackman":
         return np.blackman(n)
+    if name == "blackmanharris":
+        return _cosine_sum_window(n, (0.35875, 0.48829, 0.14128,
+                                      0.01168))
+    if name == "nuttall":
+        return _cosine_sum_window(n, (0.3635819, 0.4891775, 0.1365995,
+                                      0.0106411))
+    if name == "flattop":
+        return _cosine_sum_window(
+            n, (0.21557895, 0.41663158, 0.277263158, 0.083578947,
+                0.006947368))
     if name == "bartlett":
         return np.bartlett(n)
+    if name == "cosine":
+        return np.sin(np.pi * (np.arange(n) + 0.5) / n)
     if name in ("boxcar", "rect", "rectangular"):
         return np.ones(n)
+    if name == "tukey":
+        alpha = float(kwargs.get("alpha", 0.5))
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("tukey alpha must be in [0, 1]")
+        if alpha == 0.0 or n == 1:
+            return np.ones(n)
+        t = np.arange(n, dtype=np.float64) / (n - 1)
+        w = np.ones(n)
+        edge = t < alpha / 2
+        w[edge] = 0.5 * (1 + np.cos(np.pi * (2 * t[edge] / alpha - 1)))
+        edge = t >= 1 - alpha / 2
+        w[edge] = 0.5 * (1 + np.cos(np.pi * (2 * t[edge] / alpha
+                                             - 2 / alpha + 1)))
+        return w
+    if name == "gaussian":
+        if "std" not in kwargs:
+            raise ValueError("gaussian window needs std=")
+        t = np.arange(n, dtype=np.float64) - (n - 1) / 2.0
+        return np.exp(-0.5 * (t / float(kwargs["std"])) ** 2)
     if name == "kaiser":
         if "beta" not in kwargs:
             raise ValueError("kaiser window needs beta=")
