@@ -6,7 +6,7 @@
 PYTHON ?= python
 
 .PHONY: all tests tests-quick benchmarks bench cshim cshim-check wavelet-tables lint \
-        docs install clean
+        docs install install-hooks clean
 
 all: cshim
 
@@ -39,6 +39,12 @@ lint:
 
 docs:
 	$(PYTHON) tools/gen_docs.py
+
+# Installs the commit gate: `make tests-quick` must be green before any
+# code commit (round-4 postmortem: snapshot 8182983 landed red at HEAD).
+install-hooks:
+	install -m 755 tools/git-hooks/pre-commit "$$(git rev-parse --git-path hooks)/pre-commit"
+	@echo "pre-commit quick-gate hook installed"
 
 # pip-installs the Python/XLA core, then the C ABI (PREFIX=/usr/local).
 # --no-build-isolation: build with the environment's setuptools so the

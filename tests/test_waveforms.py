@@ -185,7 +185,10 @@ class TestMLSAndWindows:
         with pytest.raises(ValueError, match="kaiser"):
             wf.get_window("kaiser", 32)
         with pytest.raises(ValueError, match="window"):
-            wf.get_window("tukey", 32)
+            wf.get_window("bohman", 32)
+        # tukey gained a default alpha=0.5 in round 4 — it must NOT raise
+        w = wf.get_window("tukey", 32)
+        assert len(w) == 32
 
     def test_mls_length_cap(self):
         with pytest.raises(ValueError, match="2\\^22"):
