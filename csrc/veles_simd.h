@@ -31,7 +31,16 @@ extern "C" {
 
 /* Initialize the embedded interpreter + backend. Optional: every compute
  * call bootstraps lazily. `repo_root` may be NULL (auto-detect from
- * VELES_SIMD_PYROOT or the shared object's location). */
+ * VELES_SIMD_PYROOT or the shared object's location).
+ *
+ * Backend-init watchdog: if the XLA backend takes longer than
+ * VELES_SIMD_INIT_DEADLINE seconds to come up (default 180), the
+ * process hard-exits with a diagnosis instead of hanging forever — the
+ * failure mode of a wedged remote-relay transport, where the first
+ * device probe blocks indefinitely in native code.  Embedded hosts that
+ * prefer to own that policy (slow-but-healthy cold init, custom
+ * recovery) set VELES_SIMD_INIT_DEADLINE=0 in the environment to
+ * disable the watchdog, or a larger value to extend it. */
 int veles_simd_init(const char *repo_root);
 void veles_simd_shutdown(void);
 /* Human-readable description of the active backend ("xla:tpu", "xla:cpu"). */

@@ -398,6 +398,29 @@ class TestLombScargle:
         with pytest.raises(ValueError, match="non-empty"):
             sp.lombscargle(np.zeros(5), np.zeros(5), np.zeros(0))
 
+    def test_weights_channel(self):
+        """Zero weights exclude samples exactly; unit weights reproduce
+        the unweighted periodogram (both XLA and oracle paths)."""
+        rng = np.random.RandomState(12)
+        t = np.sort(rng.uniform(0, 100, 500))
+        x = np.sin(1.7 * t) + 0.4 * rng.randn(500)
+        freqs = np.linspace(0.3, 4.0, 200)
+        base = np.asarray(sp.lombscargle(t, x, freqs, simd=True))
+        ones = np.asarray(sp.lombscargle(t, x, freqs, simd=True,
+                                         weights=np.ones(500)))
+        np.testing.assert_allclose(ones, base, rtol=1e-6)
+        w = np.ones(500)
+        w[50:150] = 0.0
+        got = np.asarray(sp.lombscargle(t, x, freqs, simd=True,
+                                        weights=w))
+        want = ss.lombscargle(np.delete(t, np.s_[50:150]),
+                              np.delete(x, np.s_[50:150]), freqs)
+        np.testing.assert_allclose(got, want, atol=2e-4 * want.max())
+        with pytest.raises(ValueError, match="non-negative"):
+            sp.lombscargle(t, x, freqs, weights=-w)
+        with pytest.raises(ValueError, match="weights shape"):
+            sp.lombscargle(t, x, freqs, weights=np.ones(3))
+
     def test_offset_time_base(self):
         """Julian-date-style timestamps (offset ~2.45e6) must not wreck
         the f32 phase grid (review regression: t is centered before the

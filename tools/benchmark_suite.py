@@ -404,9 +404,10 @@ def main():
     fr = np.linspace(0.5, 3.0, 1024)
     tud = jnp.asarray(tu, jnp.float32)
     xud, frd = jnp.asarray(xu), jnp.asarray(fr, jnp.float32)
+    wud = jnp.ones_like(tud)   # unit weights channel (round-5 signature)
 
     def ls_step(v):
-        p = sp._lombscargle_xla(tud, v, frd)
+        p = sp._lombscargle_xla(tud, v, frd, wud)
         return v + 1e-30 * p[..., 0]
 
     benchmark("lombscargle 16k x 1024", ls_step, xud,

@@ -25,8 +25,14 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 
 def _rel_err(got, want):
     # complex128 keeps imaginary parts intact (spectral family); for real
-    # data it is equivalent to the float64 comparison
-    got = np.asarray(got, np.complex128)
+    # data it is equivalent to the float64 comparison.  to_host, NOT
+    # np.asarray: complex device fetches are UNIMPLEMENTED through the
+    # axon relay and one attempt poisons the process (the round-4/5
+    # "9 families UNSUPPORTED-BY-BACKEND" collateral) — see
+    # veles.simd_tpu.utils.platform.to_host.
+    from veles.simd_tpu.utils.platform import to_host
+
+    got = to_host(got).astype(np.complex128)
     want = np.asarray(want, np.complex128)
     scale = np.max(np.abs(want)) or 1.0
     return float(np.max(np.abs(got - want)) / scale)

@@ -354,8 +354,14 @@ def pow_psv(simd, base, exponent, length, res):
 # ---- spectral -------------------------------------------------------------
 
 def _cplx_out(ptr, out, *shape):
-    """Write a complex result into an interleaved (re, im) f32 buffer."""
-    out = np.ascontiguousarray(np.asarray(out, np.complex64))
+    """Write a complex result into an interleaved (re, im) f32 buffer.
+
+    ``to_host`` (not ``np.asarray``): complex device→host transfers are
+    UNIMPLEMENTED through the axon relay and one attempt poisons the
+    whole process — see ``utils/platform.py::to_host``."""
+    from veles.simd_tpu.utils.platform import to_host
+
+    out = np.ascontiguousarray(to_host(out).astype(np.complex64))
     _f32(ptr, *shape, 2)[...] = out.view(np.float32).reshape(*shape, 2)
 
 

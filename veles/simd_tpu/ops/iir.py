@@ -880,11 +880,18 @@ def _delay(x, k: int):
 
 
 def _affine_combine(e1, e2):
-    """Compose affine maps s -> A s + b (elementwise over leading dims)."""
+    """Compose affine maps s -> A s + b (elementwise over leading dims).
+
+    Precision.HIGHEST is load-bearing: TPU einsum defaults to bf16 MXU
+    passes, and the scan tree composes O(log n) of these 2x2 products —
+    bf16 rounding compounds to ~1e-2 rel err on the device (measured
+    round 5: iir smoke 8.5e-3 vs tol 1e-3 before the pin, 1e-7 after).
+    """
     a1, b1 = e1
     a2, b2 = e2
-    return (jnp.einsum("...ij,...jk->...ik", a2, a1),
-            jnp.einsum("...ij,...j->...i", a2, b1) + b2)
+    hi = jax.lax.Precision.HIGHEST
+    return (jnp.einsum("...ij,...jk->...ik", a2, a1, precision=hi),
+            jnp.einsum("...ij,...j->...i", a2, b1, precision=hi) + b2)
 
 
 def _biquad_affine_scan(a1, a2, drive):

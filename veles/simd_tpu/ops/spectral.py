@@ -38,6 +38,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from veles.simd_tpu.utils.config import resolve_simd
+# complex host<->device moves MUST go through to_device/to_host: the
+# axon relay cannot transfer complex buffers in either direction and one
+# attempt poisons the process (utils/platform.py docstrings).
+from veles.simd_tpu.utils.platform import to_device
 
 __all__ = [
     "stft", "stft_na", "istft", "istft_na", "spectrogram",
@@ -173,7 +177,7 @@ def istft(spec, n: int, frame_length: int, hop: int, window=None,
             f"frame_length={frame_length}, hop={hop} (expect "
             f"{(frames, frame_length // 2 + 1)})")
     if resolve_simd(simd):
-        return _istft_xla(jnp.asarray(spec, jnp.complex64),
+        return _istft_xla(to_device(spec, jnp.complex64),
                           jnp.asarray(window), jnp.asarray(env_inv),
                           n, frame_length, hop)
     return istft_na(spec, n, frame_length, hop, window).astype(np.float32)
@@ -297,7 +301,7 @@ def morlet_cwt(x, scales, w0: float = 6.0, simd=None):
     hat = _morlet_hat(scales, n, w0)
     if resolve_simd(simd):
         return _cwt_xla(jnp.asarray(x, jnp.float32),
-                        jnp.asarray(hat, jnp.complex64))
+                        to_device(hat, jnp.complex64))
     return morlet_cwt_na(x, scales, w0).astype(np.complex64)
 
 
@@ -597,8 +601,8 @@ def czt(x, m=None, w=None, a=1.0, simd=None):
         w = np.exp(-2j * np.pi / m)
     pre, kern_f, post, nfft = _czt_constants(n, m, w, a)
     if resolve_simd(simd):
-        return _czt_xla(jnp.asarray(x), jnp.asarray(pre),
-                        jnp.asarray(kern_f), jnp.asarray(post), m, nfft)
+        return _czt_xla(to_device(x), to_device(pre),
+                        to_device(kern_f), to_device(post), m, nfft)
     # host fallback: the SAME Bluestein convolution in float64 numpy —
     # NOT the O(n*m) direct-sum oracle, which would materialize an
     # [m, n] matrix (33 GB for zoom_fft of a 1M-sample signal)
@@ -670,9 +674,11 @@ def zoom_fft(x, fn, m=None, fs: float = 2.0, simd=None):
 # ---------------------------------------------------------------------------
 
 
-def _check_lombscargle_args(t, x, freqs):
+def _check_lombscargle_args(t, x, freqs, weights=None):
     """Shared validation for the single-chip and sharded Lomb-Scargle
-    paths: float64 views of (t, x, freqs) or ValueError."""
+    paths: float64 views of (t, x, freqs, weights) or ValueError.
+    ``weights`` defaults to all-ones; zero weights exclude samples
+    exactly (the padding channel the sharded path uses)."""
     t = np.asarray(t, np.float64)
     x = np.asarray(x, np.float64)
     freqs = np.asarray(freqs, np.float64)
@@ -682,28 +688,41 @@ def _check_lombscargle_args(t, x, freqs):
         raise ValueError("freqs must be a non-empty 1D array")
     if np.any(freqs <= 0):
         raise ValueError("freqs must be positive (angular) frequencies")
-    return t, x, freqs
+    if weights is None:
+        weights = np.ones_like(t)
+    else:
+        weights = np.asarray(weights, np.float64)
+        if weights.shape != t.shape:
+            raise ValueError(
+                f"weights shape {weights.shape} != t shape {t.shape}")
+        if np.any(weights < 0):
+            raise ValueError("weights must be non-negative")
+        if not np.any(weights > 0):
+            raise ValueError("at least one weight must be positive")
+    return t, x, freqs, weights
 
 
 @jax.jit
-def _lombscargle_xla(t, x, freqs):
+def _lombscargle_xla(t, x, freqs, w):
     # [m, n] phase grids: the whole periodogram is a handful of
     # elementwise trig ops + reductions over the sample axis — dense
-    # MXU/VPU work with no FFT and no uniform-sampling requirement
+    # MXU/VPU work with no FFT and no uniform-sampling requirement.
+    # Every sum carries the weights channel; w==1 reproduces the
+    # textbook formula, w==0 removes a sample exactly.
     wt = freqs[:, None] * t[None, :]
     # Scargle's tau makes the estimate phase-invariant
-    tau = jnp.arctan2(jnp.sum(jnp.sin(2 * wt), axis=-1),
-                      jnp.sum(jnp.cos(2 * wt), axis=-1)) / 2.0
+    tau = jnp.arctan2(jnp.sum(w * jnp.sin(2 * wt), axis=-1),
+                      jnp.sum(w * jnp.cos(2 * wt), axis=-1)) / 2.0
     arg = wt - tau[:, None]
     c, s = jnp.cos(arg), jnp.sin(arg)
-    xc = jnp.sum(x[None, :] * c, axis=-1)
-    xs = jnp.sum(x[None, :] * s, axis=-1)
-    cc = jnp.sum(c * c, axis=-1)
-    ss = jnp.sum(s * s, axis=-1)
+    xc = jnp.sum((w * x)[None, :] * c, axis=-1)
+    xs = jnp.sum((w * x)[None, :] * s, axis=-1)
+    cc = jnp.sum(w * c * c, axis=-1)
+    ss = jnp.sum(w * s * s, axis=-1)
     return 0.5 * (xc * xc / cc + xs * xs / ss)
 
 
-def lombscargle(t, x, freqs, simd=None):
+def lombscargle(t, x, freqs, simd=None, weights=None):
     """Lomb-Scargle periodogram for UNEVENLY sampled data (scipy's
     ``lombscargle`` with its default normalization): power of the
     least-squares sinusoid fit at each angular frequency in ``freqs``.
@@ -711,31 +730,41 @@ def lombscargle(t, x, freqs, simd=None):
     No FFT and no resampling: the [m, n] trig evaluation is exactly the
     dense-compute shape the TPU wants.  ``t``/``freqs`` in reciprocal
     units (``freqs`` are ANGULAR frequencies, scipy convention).
+
+    ``weights`` (optional, non-negative, same shape as ``t``) scales
+    every sample's contribution to all five Scargle sums; a zero weight
+    excludes the sample exactly.  Beyond the reference/scipy surface —
+    it exists so padded samples can be neutralized (the sharded path
+    uses it for arbitrary lengths) and for per-sample confidence.
     """
-    t, x_np, freqs = _check_lombscargle_args(t, x, freqs)
+    t, x_np, freqs, w_np = _check_lombscargle_args(t, x, freqs, weights)
     if resolve_simd(simd):
         # center the time base in float64 BEFORE the f32 cast: Scargle's
         # tau makes the estimate exactly time-shift invariant, and raw
         # offset timestamps (e.g. Julian dates ~2.45e6) would otherwise
         # push the phase grid to values where f32 spacing exceeds a
-        # radian
-        t = t - t.mean()
+        # radian.  Weighted mean so zero-weight padding can't shift it.
+        t = t - (w_np @ t) / w_np.sum()
         return _lombscargle_xla(jnp.asarray(t, jnp.float32),
                                 jnp.asarray(x_np, jnp.float32),
-                                jnp.asarray(freqs, jnp.float32))
-    return lombscargle_na(t, x_np, freqs).astype(np.float32)
+                                jnp.asarray(freqs, jnp.float32),
+                                jnp.asarray(w_np, jnp.float32))
+    return lombscargle_na(t, x_np, freqs, w_np).astype(np.float32)
 
 
-def lombscargle_na(t, x, freqs):
+def lombscargle_na(t, x, freqs, weights=None):
     """NumPy float64 oracle twin (per-frequency loop, the textbook
-    Scargle formula)."""
+    Scargle formula, optional weights channel)."""
     t = np.asarray(t, np.float64)
     x = np.asarray(x, np.float64)
+    wts = (np.ones_like(t) if weights is None
+           else np.asarray(weights, np.float64))
     out = np.empty(len(freqs))
     for i, w in enumerate(np.asarray(freqs, np.float64)):
-        tau = np.arctan2(np.sum(np.sin(2 * w * t)),
-                         np.sum(np.cos(2 * w * t))) / (2.0)
+        tau = np.arctan2(np.sum(wts * np.sin(2 * w * t)),
+                         np.sum(wts * np.cos(2 * w * t))) / (2.0)
         arg = w * t - tau
         c, s = np.cos(arg), np.sin(arg)
-        out[i] = 0.5 * ((x @ c) ** 2 / (c @ c) + (x @ s) ** 2 / (s @ s))
+        out[i] = 0.5 * (((wts * x) @ c) ** 2 / ((wts * c) @ c)
+                        + ((wts * x) @ s) ** 2 / ((wts * s) @ s))
     return out

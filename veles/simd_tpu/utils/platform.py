@@ -20,7 +20,78 @@ import os
 
 __all__ = ["set_cpu_env", "pin_cpu", "cpu_devices",
            "maybe_override_platform", "probe_device_count",
-           "require_reachable_device", "init_deadline"]
+           "require_reachable_device", "init_deadline", "to_host"]
+
+
+def to_host(x):
+    """Materialize a device array on the host — including complex ones
+    through transports that cannot move complex buffers.
+
+    Measured on the axon relay (2026-07-31, round 5): ``jnp.fft.rfft``
+    COMPUTES fine on the device, but fetching a complex64/128 array
+    raises ``UNIMPLEMENTED: TPU backend error``, and that one failed
+    transfer poisons the process — every subsequent device call fails
+    the same way.  Nine smoke families went UNSUPPORTED-BY-BACKEND as
+    collateral of the first complex fetch before this helper existed.
+
+    The fix is structural, not backend-sniffing: complex arrays are
+    ALWAYS materialized as two real transfers (``real``/``imag`` are
+    device-side ops, f32/f64 moves always work) and recombined on the
+    host.  For real dtypes this is a plain ``np.asarray``.  Cost for
+    complex: two transfers of the same total payload — noise next to
+    the relay round-trip this exists to survive.
+
+    Use this (not ``np.asarray``) anywhere framework code fetches a
+    possibly-complex result: the C shim, the smoke harness, benchmark
+    tooling.
+    """
+    import numpy as np
+
+    if isinstance(x, np.ndarray):
+        return x
+    dtype = getattr(x, "dtype", None)
+    if dtype is not None and np.issubdtype(dtype, np.complexfloating):
+        import jax.numpy as jnp
+
+        re = np.asarray(jnp.real(x))
+        im = np.asarray(jnp.imag(x))
+        return (re + 1j * im).astype(dtype)
+    return np.asarray(x)
+
+
+def to_device(x, dtype=None):
+    """Upload twin of :func:`to_host`: put a possibly-complex host array
+    on the device through transports that cannot move complex buffers.
+
+    The axon relay gap is symmetric (measured 2026-07-31): a complex64
+    ``jnp.asarray`` UPLOAD raises the same ``UNIMPLEMENTED`` as the
+    fetch — and poisons the process the same way.  Complex host arrays
+    are uploaded as two real arrays and recombined device-side with
+    ``lax.complex`` (a device op, so the wire only ever carries reals).
+    Device-resident arrays and real dtypes pass straight through to
+    ``jnp.asarray``.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(x, jax.Array) and dtype is None:
+        return x
+    x_np = x if isinstance(x, np.ndarray) else None
+    tgt = np.dtype(dtype) if dtype is not None else None
+    if x_np is None and not isinstance(x, jax.Array):
+        x_np = np.asarray(x)
+    if x_np is not None and (
+            np.issubdtype(x_np.dtype, np.complexfloating)
+            or (tgt is not None
+                and np.issubdtype(tgt, np.complexfloating))):
+        ctype = tgt or np.dtype(np.complex64)
+        ftype = jnp.float64 if ctype == np.complex128 else jnp.float32
+        re = jnp.asarray(np.ascontiguousarray(x_np.real), ftype)
+        im = jnp.asarray(np.ascontiguousarray(x_np.imag), ftype)
+        return jax.lax.complex(re, im)
+    return jnp.asarray(x, dtype)
 
 
 def maybe_override_platform(env_var: str = "VELES_SIMD_PLATFORM") -> None:
