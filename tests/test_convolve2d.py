@@ -85,9 +85,25 @@ def test_matched_filter_peak_2d():
     assert np.unravel_index(np.argmax(out0), out0.shape) == (27, 40)
 
 
-def test_auto_select_boundary():
-    assert cv2.select_algorithm2d(31, 31) == "direct"
+def test_auto_select_boundary(monkeypatch):
+    from veles.simd_tpu.ops import pallas_kernels as pk
+
+    # without Mosaic (this CPU suite) the measured rule is fft always —
+    # XLA's im2col conv never won a round-5 tuner cell
+    assert cv2.select_algorithm2d(3, 3) == "fft"
     assert cv2.select_algorithm2d(32, 32) == "fft"
+    # with the Pallas route available, small kernels go direct up to
+    # the kernel-area cap (the measured pallas-win region)
+    monkeypatch.setattr(pk, "pallas_available", lambda: True)
+    assert cv2.select_algorithm2d(3, 3) == "direct"
+    assert cv2.select_algorithm2d(16, 16) == "direct"   # area == cap
+    assert cv2.select_algorithm2d(17, 17) == "fft"
+    # exact shape-aware form consults the VMEM gate
+    assert cv2.select_algorithm2d(3, 3, (8, 64, 64)) == "direct"
+    assert cv2.select_algorithm2d(3, 3, (1, 1 << 14, 1 << 14)) == "fft"
+    # opt-out env restores fft routing
+    monkeypatch.setenv(pk._PALLAS2D_ENV, "1")
+    assert cv2.select_algorithm2d(3, 3) == "fft"
 
 
 def test_contract_violations():

@@ -22,9 +22,10 @@ takes the relay with it)::
     python tools/repro_pallas2d.py [--out repro_pallas2d.json]
                                    [--timeout 240]
 
-Each stage validates against the float64 oracle, so a clean run of all
-stages is exactly the "green hardware pass" that flips the
-``VELES_SIMD_ENABLE_PALLAS2D`` routing guard default
+Each stage validates against the float64 oracle.  A clean run of all
+stages is the "green hardware pass" that flipped the routing default to
+ON in round 5 (2026-07-31 ledger in repo-root ``repro_pallas2d.json``);
+``VELES_SIMD_DISABLE_PALLAS2D=1`` is the remaining opt-out
 (`ops/pallas_kernels.py::pallas2d_compiled_allowed`).
 
 The stage grid bisects three axes independently, smallest first:
@@ -102,7 +103,7 @@ check(got, want)
 """),
     # batched single grid step (the wedge config, via the public route)
     ("wedge_shape_4img", """
-import os; os.environ[pk._PALLAS2D_ENV] = "1"
+import os; os.environ.pop(pk._PALLAS2D_ENV, None)  # ensure not opted out
 x = rng.randn(4, 64, 48).astype(np.float32); h = rng.randn(5, 7).astype(np.float32)
 assert cv2._use_pallas_direct2d(x.shape, 5, 7)
 got = cv2.convolve2d(x, h, algorithm="direct", simd=True)

@@ -441,9 +441,8 @@ def _check_pallas1d(rng):
     hh = rng.randn(65).astype(np.float32)
     errs.append(_rel_err(cv.convolve_simd(x, hh, simd=True),
                          cv.convolve_na(x, hh)))
-    # fused multi-level cascade (round 4): on TPU wavelet_transform
-    # with PERIODIC routes through the one-pass cascade kernel
-    # (wv._use_fused_cascade); value-check all four bands
+    # multi-level cascade: the level loop since round 5 (the fused
+    # kernel measured slower and is opt-in); value-check all four bands
     got = wv.wavelet_transform("daub", 8, wv.ExtensionType.PERIODIC, x,
                                3, simd=True)
     cur, want = x, []
@@ -459,13 +458,14 @@ def _check_pallas1d(rng):
 def _check_pallas2d(rng):
     """The 2D shifted-MAC Mosaic kernel (convolve2d direct route on TPU).
 
-    Kept LAST in the family order: its first-ever hardware execution
-    (2026-07-31 00:59Z window) coincided with the axon relay wedging, so
-    until it has a green hardware run on record it is the prime suspect —
-    last place means a wedge here cannot shadow any other family.  The
-    compiled kernel is env-gated off for implicit routing (round-4
-    guard); the smoke opts in explicitly — it IS the hardware validation
-    path.  ``tools/repro_pallas2d.py`` is the stage-by-stage bisect."""
+    Kept LAST in the family order as a historical precaution: its
+    first-ever hardware execution (2026-07-31 00:59Z window) coincided
+    with the relay wedging.  Round 5 cleared it — the full bisect
+    passed (``tools/repro_pallas2d.py``, 8/8 stages) and the wedge was
+    re-attributed to XLA's im2col direct conv at large kernels — so the
+    compiled kernel is now default-ON for implicit routing
+    (``VELES_SIMD_DISABLE_PALLAS2D=1`` opts out, in which case the
+    assert below is expected to fire on a Mosaic-capable backend)."""
     import os
 
     from veles.simd_tpu.ops import convolve2d as cv2
@@ -473,19 +473,13 @@ def _check_pallas2d(rng):
 
     img = rng.randn(4, 64, 48).astype(np.float32)
     k2 = rng.randn(5, 7).astype(np.float32)
-    prev = os.environ.get(_pk._PALLAS2D_ENV)
-    os.environ[_pk._PALLAS2D_ENV] = "1"
-    try:
-        assert cv2._use_pallas_direct2d(img.shape, 5, 7) or \
-            not _pk.pallas_available()   # CPU standalone run
-        err = _rel_err(
-            cv2.convolve2d(img, k2, algorithm="direct", simd=True),
-            cv2.convolve2d_na(img, k2))
-    finally:
-        if prev is None:
-            os.environ.pop(_pk._PALLAS2D_ENV, None)
-        else:
-            os.environ[_pk._PALLAS2D_ENV] = prev
+    # compiled pallas2d is default-on since round 5 (green bisect +
+    # measured wins); this family exercises the implicit routing as-is
+    assert cv2._use_pallas_direct2d(img.shape, 5, 7) or \
+        not _pk.pallas_available()   # CPU standalone / opt-out run
+    err = _rel_err(
+        cv2.convolve2d(img, k2, algorithm="direct", simd=True),
+        cv2.convolve2d_na(img, k2))
     return err, 5e-4
 
 

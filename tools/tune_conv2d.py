@@ -56,10 +56,9 @@ def main():
         # the wedge-suspect guard (ops/pallas_kernels.py) silently
         # drops the pallas candidate otherwise — a tuning run should
         # either include it knowingly or say why it didn't
-        print(f"NOTE: compiled pallas2d gated off — the sweep covers "
-              f"direct/fft only; set {_pk._PALLAS2D_ENV}=1 to include "
-              "the pallas candidate (run tools/repro_pallas2d.py "
-              "first)", flush=True)
+        print(f"NOTE: compiled pallas2d opted out — the sweep covers "
+              f"direct/fft only; unset {_pk._PALLAS2D_ENV} to include "
+              "the pallas candidate", flush=True)
 
     if args.quick:
         images = ((128, 128), (512, 512))

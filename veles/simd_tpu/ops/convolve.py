@@ -106,15 +106,21 @@ def overlap_save_step(h_length: int) -> int:
 
     Each output sample's dot spans ``step+k-1`` frame columns, so total
     MACs = ``out_len * (step+k-1)`` — *larger* steps mean more redundant
-    work, while MXU tiling wants the step dimension >= ~512 lanes.
-    Measured on v5e (1M signal, k=2047, chained device timing):
-    step 1024 -> 4333 Msamples/s vs 2048 -> 3076 and 4096 -> 798 at
-    HIGHEST (7570 vs 2958 at HIGH), monotone toward smaller steps until
-    lane-width effects bite.  Rule: half the filter's padded length,
-    clamped to [512, 2048].  ``tools/tune_overlap_save.py`` reruns the
-    sweep on new hardware.
+    work, while MXU tiling wants the step dimension near the 256-lane
+    sweet spot.  Round-5 hardware sweep (v5e, 1M signal, chained device
+    timing, ``tools/tune_overlap_save.py`` 2026-07-31):
+
+        k=127   HIGHEST: 256 -> 22980 Ms/s  512 -> 18446  1024 -> 10563
+        k=127   high:    256 -> 35345       512 -> 28997  1024 -> 17315
+        k=2047  HIGHEST: 256 ->  5542       512 ->  5397  1024 ->  1027
+        k=2047  high:    256 ->  8778       512 ->  9571  1024 ->  7641
+
+    Winners: step 256 everywhere except k=2047/high where 512 leads by
+    9%.  Rule: a quarter of the filter's padded length, clamped to
+    [256, 512] (the earlier [512, 2048] rule cost 1.2-5x depending on
+    config).  Rerun the sweep on new hardware generations.
     """
-    return max(512, min(next_highest_power_of_2(int(h_length)) // 2, 2048))
+    return max(256, min(next_highest_power_of_2(int(h_length)) // 4, 512))
 
 
 def overlap_save_block_length(h_length: int) -> int:

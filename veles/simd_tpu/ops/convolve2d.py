@@ -12,10 +12,10 @@ ideas as the 1D family (``ops/convolve.py``):
   ``rfft2 · multiply · irfft2`` (the 2D analog of
   ``src/convolve.c:231-326``).
 
-Auto-selection mirrors the 1D heuristic shape: spectral wins once the
-kernel area is large (the provisional crossover constant below is from
-the 1D sweep's structure, to be re-derived on hardware with
-``tools/tune_overlap_save.py``'s methodology).
+Auto-selection is hardware-measured (round 5): the Pallas shifted-MAC
+kernel when its VMEM gate admits the shape, else FFT — XLA's im2col
+conv never won a cell of the tuner sweep (table at
+:func:`select_algorithm2d`).
 
 Result is always the full linear convolution
 ``[..., n0 + k0 - 1, n1 + k1 - 1]``; leading batch dimensions pass
@@ -39,15 +39,53 @@ __all__ = ["convolve2d", "convolve2d_na",
            "cross_correlate2d", "cross_correlate2d_na",
            "select_algorithm2d"]
 
-# provisional spectral crossover: kernel area beyond which the batched
-# 2D FFT beats the im2col conv (structure mirrors AUTO_FFT_MIN_PRODUCT
-# in ops/convolve.py; re-derive on hardware)
-AUTO_FFT2_MIN_KERNEL_AREA = 1 << 10
+# Spectral crossover, measured on TPU v5e (tools/tune_conv2d.py, live
+# window 2026-07-31).  The XLA im2col conv lost EVERY cell of the sweep
+# to the batched rfft2 — by 5x at 3x3/128^2 and by 80-16000x at larger
+# kernels — and twice CRASHED the TPU worker outright at very large
+# direct cells (suite entry 8x512x512 k=9 direct; tuner cell 512^2
+# k=65x65 direct), so auto-routing must never choose it:
+#
+#   img 128^2  k 3x3   direct  0.254ms   fft 0.048ms   -> fft
+#   img 128^2  k 15^2  direct  6.667ms   fft 0.370ms   -> fft
+#   img 128^2  k 33^2  direct  140.5ms   fft 1.690ms   -> fft
+#   img 128^2  k 65^2  direct  772.8ms   fft 0.047ms   -> fft
+#   img 512^2  k 3x3   direct  3.912ms   fft 2.061ms   -> fft
+#   img 512^2  k 15^2  direct  89.64ms   fft 1.784ms   -> fft
+#   img 512^2  k 33^2  direct  436.9ms   fft 1.902ms   -> fft
+#
+# The direct FORM still wins when it rides the Pallas shifted-MAC
+# kernel instead of XLA's conv (same window, compiled kernel, its VMEM
+# gate admitting the shape; speedup vs the FFT route):
+#
+#   1x128x128  k 3x3   pallas 0.001ms    fft 0.046ms   (35x)
+#   8x128x128  k 5x5   pallas 0.012ms    fft 0.121ms   (10x)
+#   64x128x128 k 5x7   pallas 0.130ms    fft 0.884ms   (6.8x)
+#   8x256x256  k 3x3   pallas 0.016ms    fft 0.901ms   (56x)
+#   16x256x256 k 7x7   pallas 0.191ms    fft 1.528ms   (8.0x)
+#
+# So: 'direct' is selected exactly when the Pallas route will take it
+# (area <= PALLAS_2D_MAX_KERNEL_AREA, row fits VMEM, backend has
+# Mosaic); everything else is 'fft'.  AUTO_FFT2_MIN_KERNEL_AREA remains
+# as the documented area bound of the measured pallas-win region.
+AUTO_FFT2_MIN_KERNEL_AREA = _pk.PALLAS_2D_MAX_KERNEL_AREA
 
 
-def select_algorithm2d(k0: int, k1: int) -> str:
-    """'direct' for small kernels (MXU im2col), 'fft' for large."""
-    return "fft" if k0 * k1 >= AUTO_FFT2_MIN_KERNEL_AREA else "direct"
+def select_algorithm2d(k0: int, k1: int, x_shape=None) -> str:
+    """'direct' when the Pallas 2D kernel will take the shape (measured
+    winner on its whole gated domain), else 'fft' (measured winner
+    everywhere else — XLA's im2col conv never won a tuner cell and can
+    crash the TPU worker at large kernels; table above).
+
+    ``x_shape`` (optional) enables the exact VMEM-gate check; without
+    it the decision falls back to the kernel-area bound alone.
+    """
+    if x_shape is not None:
+        return "direct" if _use_pallas_direct2d(x_shape, k0, k1) else "fft"
+    return ("direct" if (_pk.pallas_available()
+                         and _pk.pallas2d_compiled_allowed()
+                         and k0 * k1 <= AUTO_FFT2_MIN_KERNEL_AREA)
+            else "fft")
 
 
 def _use_pallas_direct2d(x_shape, k0: int, k1: int) -> bool:
@@ -56,10 +94,11 @@ def _use_pallas_direct2d(x_shape, k0: int, k1: int) -> bool:
     budget.  No minimum batch (one image fills the VPU tile).  Tests
     monkeypatch this gate to exercise the kernel on CPU.
 
-    Gated behind ``pallas2d_compiled_allowed`` (opt-in env flag): the
-    compiled kernel is the prime suspect for the round-3 relay wedge
-    and must not be reachable from user-facing ops until it has a green
-    hardware pass (see ``tools/repro_pallas2d.py``)."""
+    Default-ON since round 5: the compiled kernel passed its full
+    hardware bisect (``tools/repro_pallas2d.py``, ledger in repo-root
+    ``repro_pallas2d.json``) and measured 7-56x over the FFT route on
+    this gated domain (table at :func:`select_algorithm2d`);
+    ``VELES_SIMD_DISABLE_PALLAS2D=1`` is the opt-out."""
     n0, n1 = x_shape[-2:]
     n0e, n1e = n0 + 2 * (k0 - 1), n1 + 2 * (k1 - 1)
     out_elems = (n0 + k0 - 1) * (n1 + k1 - 1)
@@ -116,7 +155,7 @@ def _run2d(x, h, reverse, algorithm, simd):
     _check2d(x, h)
     k0, k1 = np.shape(h)[-2:]
     if algorithm is None:
-        algorithm = select_algorithm2d(k0, k1)
+        algorithm = select_algorithm2d(k0, k1, np.shape(x))
     if algorithm not in ("direct", "fft"):
         raise ValueError(f"algorithm must be 'direct' or 'fft', "
                          f"got {algorithm!r}")

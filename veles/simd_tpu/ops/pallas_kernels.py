@@ -92,19 +92,22 @@ def pallas_available() -> bool:
 
 # The compiled 2D Mosaic kernel's first-ever hardware execution
 # (2026-07-31 00:59Z window) coincided with the axon relay wedging, and
-# a wedged relay blocks forever in native code — one bad kernel cost a
-# whole measurement window.  Until the kernel has a green hardware pass
-# on record, the compiled path is OPT-IN: implicit routing
-# (convolve2d._use_pallas_direct2d) falls back to the XLA conv lowering,
-# while the hardware smoke/repro tools opt in explicitly.  Interpret
-# mode (the CPU test path) is unaffected.  Flip the default once
-# tools/repro_pallas2d.py records a clean compiled run.
-_PALLAS2D_ENV = "VELES_SIMD_ENABLE_PALLAS2D"
+# Default ON since round 5: tools/repro_pallas2d.py recorded a clean
+# compiled hardware pass (2026-07-31, all 8 stages OK incl. the round-3
+# wedge shape, ledger in repro_pallas2d.json) — and the same live window
+# showed the round-3 wedge reproduces with the plain XLA direct conv2d
+# at large kernels instead (TPU worker crash at 512x512 k=65 direct),
+# exonerating this kernel.  Measured on the gated domain the compiled
+# kernel then beat the XLA conv route 10-400x and the FFT route 7-56x
+# (table at convolve2d.select_algorithm2d).  VELES_SIMD_DISABLE_PALLAS2D=1
+# restores the XLA fallback if a future backend misbehaves.
+_PALLAS2D_ENV = "VELES_SIMD_DISABLE_PALLAS2D"
 
 
 def pallas2d_compiled_allowed() -> bool:
-    """May implicit routing use the *compiled* 2D Mosaic kernel?"""
-    return os.environ.get(_PALLAS2D_ENV, "0").strip().lower() in (
+    """May implicit routing use the *compiled* 2D Mosaic kernel?
+    True unless explicitly disabled (see the env note above)."""
+    return os.environ.get(_PALLAS2D_ENV, "0").strip().lower() not in (
         "1", "true", "yes", "on")
 
 

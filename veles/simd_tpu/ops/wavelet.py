@@ -59,6 +59,7 @@ from __future__ import annotations
 
 import enum
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -352,6 +353,19 @@ def _cascade_plan(gs, g_lo, levels):
 
 
 def _use_fused_cascade(src_shape, order, ext, levels) -> bool:
+    # DEMOTED round 5 (measured): on TPU v5e hardware the fused pass
+    # LOSES to the level loop — 14,765 vs 17,384 Msamples/s (daub8 L3,
+    # 512x4096, idle-host chained timing, 2026-07-31; reproduced twice).
+    # The one-HBM-read premise undercounts the composed filters' extra
+    # MACs: level-l taps grow to (order-1)(2^l - 1)+1, so the cascade
+    # trades bandwidth it wasn't actually bound by for ~2x the FLOPs.
+    # The kernel stays (tests exercise it; VELES_SIMD_FORCE_FUSED_CASCADE
+    # opts in) as the measured record of a hypothesis that didn't pay —
+    # per the 1D-kernel standard, a fused route must WIN to route.
+    if os.environ.get("VELES_SIMD_FORCE_FUSED_CASCADE",
+                      "0").strip().lower() not in ("1", "true", "yes",
+                                                   "on"):
+        return False
     levels = int(levels)
     if (ExtensionType(ext) is not ExtensionType.PERIODIC
             or not 2 <= levels <= _FUSED_MAX_LEVELS):

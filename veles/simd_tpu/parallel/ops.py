@@ -334,18 +334,19 @@ def sharded_convolve2d(x, h, mesh: Mesh, axes=("dp", "sp")):
         # axes[1] — carries the diagonal corner for free
         left = halo_exchange_left(ext0, k1 - 1, a1)
         ext = jnp.concatenate([left, ext0], axis=-1)
-        # local tile step reuses the single-chip auto-select (direct
-        # im2col vs batched FFT), mirroring 1D _local_block_conv; the
-        # Pallas route is skipped inside shard_map deliberately — the
-        # XLA paths are the ones validated under SPMD
-        if cv2.select_algorithm2d(k1=k1, k0=k0) == "fft":
-            from veles.simd_tpu.utils.memory import (
-                next_highest_power_of_2 as _np2)
-            full = cv2._conv2d_fft(
-                ext, h_full, _np2(ext.shape[-2] + k0 - 1),
-                _np2(ext.shape[-1] + k1 - 1))
-        else:
-            full = cv2._conv2d_direct(ext, h_full)
+        # local tile step is ALWAYS the batched-FFT form: the Pallas
+        # route is skipped inside shard_map deliberately (the XLA paths
+        # are the ones validated under SPMD), and without Pallas the
+        # round-5 hardware sweep found XLA's im2col direct conv losing
+        # every cell to the FFT — and crashing the TPU worker at large
+        # kernels (crossover table at cv2.select_algorithm2d).  Note
+        # select_algorithm2d's 'direct' now means "Pallas will take
+        # it", so it must not be consulted for an XLA-only tile step.
+        from veles.simd_tpu.utils.memory import (
+            next_highest_power_of_2 as _np2)
+        full = cv2._conv2d_fft(
+            ext, h_full, _np2(ext.shape[-2] + k0 - 1),
+            _np2(ext.shape[-1] + k1 - 1))
         # VALID span of this tile in the global result: the halo shifts
         # the tile origin by (k0-1, k1-1), exactly as the 1D form
         # (full[j + k - 1] in _local_block_conv)

@@ -86,7 +86,19 @@ def to_device(x, dtype=None):
             np.issubdtype(x_np.dtype, np.complexfloating)
             or (tgt is not None
                 and np.issubdtype(tgt, np.complexfloating))):
-        ctype = tgt or np.dtype(np.complex64)
+        if tgt is not None and not np.issubdtype(tgt,
+                                                 np.complexfloating):
+            raise TypeError(
+                f"to_device: complex input cannot target real dtype "
+                f"{tgt} (take .real/.imag/abs explicitly)")
+        if tgt is not None:
+            ctype = tgt
+        else:
+            # mirror jnp.asarray's dtype policy: complex128 survives
+            # only under jax_enable_x64, else canonicalizes to c64
+            ctype = (np.dtype(x_np.dtype)
+                     if jax.config.jax_enable_x64
+                     else np.dtype(np.complex64))
         ftype = jnp.float64 if ctype == np.complex128 else jnp.float32
         re = jnp.asarray(np.ascontiguousarray(x_np.real), ftype)
         im = jnp.asarray(np.ascontiguousarray(x_np.imag), ftype)
