@@ -88,8 +88,11 @@ def test_matched_filter_peak_2d():
 def test_auto_select_boundary(monkeypatch):
     from veles.simd_tpu.ops import pallas_kernels as pk
 
-    # without Mosaic (this CPU suite) the measured rule is fft always —
-    # XLA's im2col conv never won a round-5 tuner cell
+    # hermetic against the operator's opt-out env
+    monkeypatch.delenv(pk._PALLAS2D_ENV, raising=False)
+    # without Mosaic the measured rule is fft always — XLA's im2col
+    # conv never won a round-5 tuner cell
+    monkeypatch.setattr(pk, "pallas_available", lambda: False)
     assert cv2.select_algorithm2d(3, 3) == "fft"
     assert cv2.select_algorithm2d(32, 32) == "fft"
     # with the Pallas route available, small kernels go direct up to

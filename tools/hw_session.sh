@@ -7,25 +7,19 @@
 #
 #   sh tools/hw_session.sh [outdir]        # default /tmp/hw_session
 #
-# Steps (pallas2d — the round-3 wedge suspect — is excluded from every
-# smoke stage via VELES_SIMD_SMOKE_SKIP and runs ONLY in the final
-# bisect step, so a wedge there cannot cost anything else):
+# Steps (ordering kept headline-first so a short window still captures
+# the driver artifact; the pallas2d bisect stays last as a diagnostic):
 #   1. bench.py            -> headline JSON + BENCH_DETAILS.json + the
-#                             embedded smoke (minus pallas2d)
-#   2. tools/tpu_smoke.py  -> retry ONLY the families still lacking a
-#                             green hardware run (as of late 2026-07-31:
-#                             pallas1d/parallel plus everything added in
-#                             round 3 — iir, filters, waveforms,
-#                             detect_peaks' new analysis, the spectral
-#                             estimation layer), in case the
-#                             bench-embedded smoke got cut
+#                             embedded smoke
+#   2. tools/tpu_smoke.py  -> the full family smoke (all families have
+#                             a green round-5 hardware run on record)
 #   3. tools/benchmark_suite.py --quick -> per-family timed entries
 #                             (IIR/filters/spectral/resample/waveforms/
 #                             peaks/fused-cascade vs level-loop)
-#   4. tools/tune_conv2d.py --quick   -> 2D crossover measurement
+#   4. tools/tune_conv2d.py --quick   -> 2D crossover re-check
 #   5. tools/tune_overlap_save.py --quick  -> 1D step-size re-check
-#   6. tools/repro_pallas2d.py  -> the pallas2d bisect, DEAD LAST; its
-#                             JSON ledger survives even if it wedges
+#   6. tools/repro_pallas2d.py  -> stage-by-stage bisect, kept last as
+#                             the fallback diagnostic for regressions
 set -u
 OUT=${1:-/tmp/hw_session}
 mkdir -p "$OUT"
@@ -47,21 +41,20 @@ run() {
 # every step under a hard `timeout -k` (TERM then KILL — an in-flight
 # device call on a wedged relay blocks forever in native code, observed
 # 2026-07-31, and only process death clears it).  bench.py also
-# self-watchdogs per stage.  The smoke retry covers only the families
-# without a green hardware run yet — a wedge-prone family must not be
-# able to burn the window twice (update the list as families go green).
+# self-watchdogs per stage.
 #
-# pallas2d (the round-3 wedge suspect) is held out of EVERY stage via
-# VELES_SIMD_SMOKE_SKIP and runs dead last through the bisect harness:
-# if it wedges the relay again, everything else was already captured.
-export VELES_SIMD_SMOKE_SKIP=pallas2d
+# Round-5 state: EVERY family has a green hardware run (pallas2d
+# included — bisect 8/8 + measured wins; the historical wedge was
+# XLA's large-kernel direct conv2d, which auto-routing now avoids).
+# The full smoke runs as one stage; the bisect harness stays last as
+# the fallback diagnostic if a future backend regresses.
+#
+# HYGIENE (learned round 5): keep the HOST idle for the whole session —
+# a concurrent pytest/compile inflates device_time_chained marginals
+# ~30x (fingerprint: CPU-oracle baselines drop by the same factor).
 run bench        timeout -k 60 3000 python bench.py --all
 cp -f BENCH_DETAILS.json "$OUT/" 2>/dev/null || true
-run smoke        timeout -k 60 1500 python tools/tpu_smoke.py \
-                   --family=iir --family=filters --family=waveforms \
-                   --family=spectral --family=resample \
-                   --family=detect_peaks \
-                   --family=pallas1d --family=parallel
+run smoke        timeout -k 60 1800 python tools/tpu_smoke.py
 # per-family timed entries (IIR, filters, spectral, resample,
 # waveforms, peaks, cascade fused-vs-loop, ...) — the table VERDICT r3
 # item 1 asks for; --quick keeps it inside a short window

@@ -475,8 +475,9 @@ def _check_pallas2d(rng):
     k2 = rng.randn(5, 7).astype(np.float32)
     # compiled pallas2d is default-on since round 5 (green bisect +
     # measured wins); this family exercises the implicit routing as-is
-    assert cv2._use_pallas_direct2d(img.shape, 5, 7) or \
-        not _pk.pallas_available()   # CPU standalone / opt-out run
+    assert cv2._use_pallas_direct2d(img.shape, 5, 7) or not (
+        _pk.pallas_available()
+        and _pk.pallas2d_compiled_allowed())  # CPU / opt-out run
     err = _rel_err(
         cv2.convolve2d(img, k2, algorithm="direct", simd=True),
         cv2.convolve2d_na(img, k2))

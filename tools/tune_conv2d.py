@@ -1,20 +1,21 @@
 #!/usr/bin/env python
 """Measure the 2D convolution algorithm crossover on the device.
 
-``ops/convolve2d.py``'s ``AUTO_FFT2_MIN_KERNEL_AREA`` (and the 2D Pallas
-routing bound) were provisional — structure copied from the measured 1D
-heuristic, flagged "re-derive on hardware" (VERDICT r2 weak 3 /
-ADVICE low 3).  This is the measurement tool, the 2D analog of
-``tools/tune_overlap_save.py`` and of the reference's offline-measured
-thresholds (``/root/reference/src/convolve.c:328-364``).
+The round-5 sweep (2026-07-31, live v5e) settled the 2D routing:
+XLA's im2col direct conv lost every cell to the batched rFFT2 (and
+crashed the TPU worker at very large direct cells), while the Pallas
+shifted-MAC kernel won its whole VMEM-gated domain — so
+``select_algorithm2d`` is now "pallas when eligible, else fft" with the
+measured tables recorded in ``ops/convolve2d.py``.  This tool remains
+the re-measurement harness for new hardware generations.
 
 For each (image size, kernel size) cell it times direct-MXU im2col,
 batched rFFT2, and (when within its VMEM/area gate) the 2D Pallas
 shifted-MAC kernel with chained on-device loops, accuracy-gates every
 candidate against the float64 oracle, prints a winner table, and
 recommends the kernel-area crossover that best separates direct-vs-FFT
-wins.  Rerun on new hardware generations and paste the numbers into the
-``AUTO_FFT2_MIN_KERNEL_AREA`` docstring + BASELINE.md.
+wins.  Paste fresh numbers into the ``ops/convolve2d.py`` tables +
+BASELINE.md when rerun.
 
 Run:  python tools/tune_conv2d.py [--quick]
       VELES_SIMD_PLATFORM=cpu ... validates plumbing only — the
@@ -133,9 +134,11 @@ def main():
             if (a >= cut) != (win == "fft"))
         if miss < best_miss:
             best_miss, best_cut = miss, cut
-    print(f"\nrecommended AUTO_FFT2_MIN_KERNEL_AREA = {best_cut} "
+    print(f"\nbest direct-vs-fft area cut = {best_cut} "
           f"({best_miss} misclassified cells of {len(results)}; "
-          f"current constant {cv2.AUTO_FFT2_MIN_KERNEL_AREA})")
+          "routing note: auto is pallas-when-eligible else fft — "
+          "a nonzero direct-win region here would argue for "
+          "reintroducing an area cut)")
 
 
 if __name__ == "__main__":

@@ -65,10 +65,11 @@ __all__ = ["convolve2d", "convolve2d_na",
 #   16x256x256 k 7x7   pallas 0.191ms    fft 1.528ms   (8.0x)
 #
 # So: 'direct' is selected exactly when the Pallas route will take it
-# (area <= PALLAS_2D_MAX_KERNEL_AREA, row fits VMEM, backend has
-# Mosaic); everything else is 'fft'.  AUTO_FFT2_MIN_KERNEL_AREA remains
-# as the documented area bound of the measured pallas-win region.
-AUTO_FFT2_MIN_KERNEL_AREA = _pk.PALLAS_2D_MAX_KERNEL_AREA
+# (area <= _pk.PALLAS_2D_MAX_KERNEL_AREA, row fits VMEM, backend has
+# Mosaic); everything else is 'fft'.  (The pre-round-5
+# AUTO_FFT2_MIN_KERNEL_AREA constant is gone: its name described the
+# old direct-vs-fft area cut, which the measurements dissolved — the
+# only remaining area bound is the Pallas kernel cap itself.)
 
 
 def select_algorithm2d(k0: int, k1: int, x_shape=None) -> str:
@@ -84,7 +85,7 @@ def select_algorithm2d(k0: int, k1: int, x_shape=None) -> str:
         return "direct" if _use_pallas_direct2d(x_shape, k0, k1) else "fft"
     return ("direct" if (_pk.pallas_available()
                          and _pk.pallas2d_compiled_allowed()
-                         and k0 * k1 <= AUTO_FFT2_MIN_KERNEL_AREA)
+                         and k0 * k1 <= _pk.PALLAS_2D_MAX_KERNEL_AREA)
             else "fft")
 
 

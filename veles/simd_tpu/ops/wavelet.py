@@ -421,11 +421,12 @@ def wavelet_transform(type, order, ext, src, levels, simd=None):
     (``tests/wavelet.cc`` cascade pattern); returns
     ``[hi_1, hi_2, ..., hi_levels, lo_levels]`` like the usual pyramid.
 
-    On TPU with the PERIODIC extension the whole cascade runs as ONE
-    Pallas pass over the signal (composed per-level filters on a
-    2^levels-phase deinterleave — each sample is read from HBM once
-    for all levels, not once per level); other extensions and
-    non-routable shapes use the level loop.
+    Runs as the level loop (one filter-bank pass per level).  A fused
+    one-HBM-pass Pallas cascade exists for PERIODIC but measured SLOWER
+    on v5e hardware (17,384 vs 14,765 Ms/s — composed-filter MACs
+    outweigh the saved reads), so it is opt-in:
+    ``VELES_SIMD_FORCE_FUSED_CASCADE=1`` (gate note at
+    :func:`_use_fused_cascade`).
     """
     levels = int(levels)
     if resolve_simd(simd):

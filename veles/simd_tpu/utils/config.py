@@ -72,14 +72,21 @@ class Config:
     dtype: str = "float32"
     # MXU precision for the overlap-save block matmul ("highest" = 6-pass
     # bf16 emulation of f32, ~5e-7 rel. error; "high" = 3-pass, ~1.3e-5,
-    # ~1.8x faster — both inside every correctness gate incl. the 1e-4
-    # TPU smoke tolerance and the reference's own test epsilons; measured
-    # sweep in ops/convolve.py). No effect on CPU, which always computes
-    # full f32. 1-pass bf16 ("default", ~2.6e-3) fails the oracle gates
-    # and is deliberately NOT accepted here — pass it explicitly to
-    # _conv_os_matmul if you want it. NOTE: the value is read at trace
-    # time; ops already traced under an *enclosing* jit (e.g. a
-    # data_parallel wrapper) keep the precision they were traced with.
+    # ~1.7x faster — both inside every correctness gate incl. the 1e-4
+    # TPU smoke tolerance and the reference's own test epsilons).
+    # Round-5 hardware numbers at the tuned step (1M x 2047, v5e,
+    # 2026-07-31): "highest" 5,547 Msamples/s @ 4.8e-7, "high" 9,571
+    # @ 1.2e-5 (tools/tune_overlap_save.py sweep).  "highest" stays the
+    # default — parity with the f32 reference is the library's contract
+    # and 4.8e-7 matches the reference's own test epsilons with margin;
+    # flip to "high" when 1.3e-5 is inside your tolerance and conv
+    # throughput is the bottleneck.  No effect on CPU, which always
+    # computes full f32. 1-pass bf16 ("default", ~2.6e-3) fails the
+    # oracle gates and is deliberately NOT accepted here — pass it
+    # explicitly to _conv_os_matmul if you want it. NOTE: the value is
+    # read at trace time; ops already traced under an *enclosing* jit
+    # (e.g. a data_parallel wrapper) keep the precision they were
+    # traced with.
     conv_precision: str = "highest"
 
     def __post_init__(self):
