@@ -464,8 +464,9 @@ def _check_pallas2d(rng):
     passed (``tools/repro_pallas2d.py``, 8/8 stages) and the wedge was
     re-attributed to XLA's im2col direct conv at large kernels — so the
     compiled kernel is now default-ON for implicit routing
-    (``VELES_SIMD_DISABLE_PALLAS2D=1`` opts out, in which case the
-    assert below is expected to fire on a Mosaic-capable backend)."""
+    (``VELES_SIMD_DISABLE_PALLAS2D=1`` opts out, in which case this
+    family validates the XLA direct route instead — the assert below
+    admits the opt-out explicitly)."""
     import os
 
     from veles.simd_tpu.ops import convolve2d as cv2

@@ -139,11 +139,13 @@ def should_route(rows: int, row_elems: int) -> bool:
             and fits_vmem(row_elems))
 
 
-def _fb_kernel(*refs, tap_counts, dilation, n_out):
+def _fb_kernel(*refs, tap_counts, dilation, n_out, stacked=False):
     """Shifted-MAC filter bank over VMEM tiles.
 
     ``refs`` = per-phase SMEM tap refs ([C, n_taps_p]), then per-phase
-    VMEM input tiles, then C output tiles.  ``out[c] = sum_p sum_m
+    VMEM input tiles, then C output tiles (or ONE [rows, C*n_out] tile
+    when ``stacked`` — channel c at lane offset c*n_out, which the
+    caller guarantees is 128-lane aligned).  ``out[c] = sum_p sum_m
     taps_p[c, m] * phase_p[:, m*dilation : m*dilation + n_out]`` — all
     slices unit-stride at static offsets; tap values are runtime SMEM
     scalars.
@@ -153,6 +155,11 @@ def _fb_kernel(*refs, tap_counts, dilation, n_out):
     in_refs = refs[n_phases:2 * n_phases]
     out_refs = refs[2 * n_phases:]
     phases = [r[...] for r in in_refs]
+    if stacked:
+        n_ch = tap_refs[0].shape[0]
+        ref0 = out_refs[0]
+        out_refs = [ref0.at[:, c * n_out:(c + 1) * n_out]
+                    for c in range(n_ch)]
     for c, ref in enumerate(out_refs):
         first = True
         for p, xv in enumerate(phases):
@@ -161,7 +168,14 @@ def _fb_kernel(*refs, tap_counts, dilation, n_out):
                     xv, m * dilation, m * dilation + n_out, axis=1)
                 term = tap_refs[p][c, m] * t
                 # statement-by-statement accumulation bounds Mosaic
-                # stack temporaries (see module docstring)
+                # stack temporaries (see module docstring).  Round-5
+                # A/B on hardware re-confirmed this design: sequential
+                # REGISTER accumulation (acc = acc + term, one final
+                # store) measured SLOWER at order 8 (31.3 vs 33.8
+                # GS/s) and failed to compile at order 129 with 160 MB
+                # of register-allocator spill slots — Mosaic keeps the
+                # in-flight accumulator live across all unrolled slots,
+                # while the through-ref chain lets it recycle.
                 ref[...] = term if first else ref[...] + term
                 first = False
 
@@ -178,9 +192,29 @@ def _fb_call(phases, taps, tap_counts, dilation, n_out, interpret):
     if pad_rows:
         phases = [jnp.pad(p, ((0, pad_rows), (0, 0))) for p in phases]
     grid = (phases[0].shape[0] // rows,)
+    # Stacked single-buffer output when every channel's lane offset is
+    # 128-aligned: the bands come back as adjacent slices of ONE
+    # [rows, C*n_out] buffer, so a downstream concat of the bands in
+    # order can fold to identity instead of a second full copy of the
+    # outputs through HBM.  Round-5 hardware A/B: neutral-to-positive
+    # (config-5 within the relay's ±15% run noise; daub16 512x4096
+    # measured 22-38 GS/s across runs vs 20 before) — kept for the
+    # structural win at zero measured cost.
+    stacked = n_ch > 1 and n_out % 128 == 0
     kernel = functools.partial(_fb_kernel, tap_counts=tap_counts,
-                               dilation=dilation, n_out=n_out)
+                               dilation=dilation, n_out=n_out,
+                               stacked=stacked)
     order = sum(tap_counts)
+    if stacked:
+        out_specs = [pl.BlockSpec((rows, n_ch * n_out),
+                                  lambda i: (i, 0))]
+        out_shape = [jax.ShapeDtypeStruct(
+            (phases[0].shape[0], n_ch * n_out), jnp.float32)]
+    else:
+        out_specs = [pl.BlockSpec((rows, n_out),
+                                  lambda i: (i, 0))] * n_ch
+        out_shape = [jax.ShapeDtypeStruct(
+            (phases[0].shape[0], n_out), jnp.float32)] * n_ch
     outs = pl.pallas_call(
         kernel,
         grid=grid,
@@ -188,9 +222,8 @@ def _fb_call(phases, taps, tap_counts, dilation, n_out, interpret):
             [pl.BlockSpec(memory_space=pltpu.SMEM)] * len(phases)
             + [pl.BlockSpec((rows, p.shape[1]), lambda i: (i, 0))
                for p in phases]),
-        out_specs=[pl.BlockSpec((rows, n_out), lambda i: (i, 0))] * n_ch,
-        out_shape=[jax.ShapeDtypeStruct((phases[0].shape[0], n_out),
-                                        jnp.float32)] * n_ch,
+        out_specs=out_specs,
+        out_shape=out_shape,
         cost_estimate=pl.CostEstimate(
             flops=2 * n_ch * order * phases[0].shape[0] * n_out,
             bytes_accessed=4 * phases[0].shape[0] * row_elems,
@@ -198,6 +231,9 @@ def _fb_call(phases, taps, tap_counts, dilation, n_out, interpret):
         interpret=interpret,
     )(*[t.astype(jnp.float32) for t in taps],
       *[p.astype(jnp.float32) for p in phases])
+    if stacked:
+        outs = [outs[0][:, c * n_out:(c + 1) * n_out]
+                for c in range(n_ch)]
     if pad_rows:
         outs = [o[:n_rows] for o in outs]
     return tuple(outs)

@@ -423,8 +423,8 @@ def wavelet_transform(type, order, ext, src, levels, simd=None):
 
     Runs as the level loop (one filter-bank pass per level).  A fused
     one-HBM-pass Pallas cascade exists for PERIODIC but measured SLOWER
-    on v5e hardware (17,384 vs 14,765 Ms/s — composed-filter MACs
-    outweigh the saved reads), so it is opt-in:
+    on v5e hardware (fused 14,765 vs level-loop 17,384 Ms/s —
+    composed-filter MACs outweigh the saved reads), so it is opt-in:
     ``VELES_SIMD_FORCE_FUSED_CASCADE=1`` (gate note at
     :func:`_use_fused_cascade`).
     """
