@@ -325,3 +325,25 @@ def test_composed_filters_match_direct_cascade():
     got_hi2 = np.array([gs[1] @ xe[4 * i:4 * i + len(gs[1])]
                         for i in range(128)])
     np.testing.assert_allclose(got_hi2, want_hi2, atol=1e-10)
+
+
+def test_filter_bank_stacked_output_path():
+    """The stacked single-buffer output branch (n_ch > 1, n_out % 128
+    == 0): channel slicing of the fused [rows, C*n_out] buffer must
+    match the per-channel path bit-for-bit (interpret mode)."""
+    from veles.simd_tpu.ops import pallas_kernels as pk
+
+    order, n_out, stride = 8, 128, 2
+    n_ext = (n_out - 1) * stride + order
+    x = rng.randn(4, n_ext).astype(np.float32)
+    f = rng.randn(2, order).astype(np.float32)
+    hi, lo = pk.filter_bank_pallas(x, f, stride, 1, n_out,
+                                   interpret=True)
+    assert hi.shape == lo.shape == (4, n_out)
+    want = np.zeros((2, 4, n_out), np.float64)
+    for c in range(2):
+        for i in range(n_out):
+            want[c, :, i] = (x[:, i * stride:i * stride + order].astype(
+                np.float64) @ f[c].astype(np.float64))
+    np.testing.assert_allclose(np.asarray(hi), want[0], atol=1e-4)
+    np.testing.assert_allclose(np.asarray(lo), want[1], atol=1e-4)

@@ -20,7 +20,8 @@ import os
 
 __all__ = ["set_cpu_env", "pin_cpu", "cpu_devices",
            "maybe_override_platform", "probe_device_count",
-           "require_reachable_device", "init_deadline", "to_host"]
+           "require_reachable_device", "init_deadline", "to_host",
+           "to_device"]
 
 
 def to_host(x):
