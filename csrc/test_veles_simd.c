@@ -935,6 +935,32 @@ static void test_filters(void) {
   double bad = 1.5;
   CHECK(filt_firwin(33, &bad, 1, 1, 0, taps) != 0);
 
+  /* the kaiser design flow: kaiserord sizes the filter, firwin_w
+   * designs it; the lowpass keeps unit DC gain, and the estimate
+   * must be monotone in the transition width */
+  size_t kn = 0;
+  double kbeta = 0.0;
+  CHECK(filt_kaiserord(65.0, 0.08, &kn, &kbeta) == 0);
+  CHECK(kn >= 90 && kn <= 110);   /* (65-7.95)/(2.285*pi*0.08)+1 ~ 101 */
+  CHECK(kbeta > 5.0 && kbeta < 8.0);
+  {
+    double *ktaps = (double *)malloc(kn * sizeof(double));
+    CHECK(ktaps != NULL);
+    CHECK(filt_firwin_w(kn, &fc, 1, 1, VELES_WINDOW_KAISER, kbeta,
+                        ktaps) == 0);
+    double ks = 0.0;
+    for (size_t i = 0; i < kn; i++) {
+      ks += ktaps[i];
+    }
+    CHECK_NEAR(ks, 1.0, 1e-12);
+    free(ktaps);
+    size_t kn2 = 0;
+    double kbeta2 = 0.0;
+    CHECK(filt_kaiserord(65.0, 0.04, &kn2, &kbeta2) == 0);
+    CHECK(kn2 > kn);                  /* narrower transition, more taps */
+    CHECK(filt_kaiserord(5.0, 0.1, &kn2, &kbeta2) != 0);  /* too small */
+  }
+
   /* firwin2: a lowpass breakpoint profile has unit DC gain and kills
    * Nyquist; non-ascending freq is a contract violation */
   const double f2[4] = {0.0, 0.3, 0.5, 1.0};

@@ -1036,6 +1036,20 @@ int filt_firwin(size_t numtaps, const double *cutoffs, size_t n_cutoffs,
                   window, PTR(taps));
 }
 
+int filt_firwin_w(size_t numtaps, const double *cutoffs,
+                  size_t n_cutoffs, int pass_zero, int window,
+                  double beta, double *taps) {
+  return shim_run("filt_firwin_w", "(kKkiidK)", (unsigned long)numtaps,
+                  PTR(cutoffs), (unsigned long)n_cutoffs, pass_zero,
+                  window, beta, PTR(taps));
+}
+
+int filt_kaiserord(double ripple, double width, size_t *numtaps,
+                   double *beta) {
+  return shim_run("filt_kaiserord", "(ddKK)", ripple, width,
+                  PTR(numtaps), PTR(beta));
+}
+
 int filt_firwin2(size_t numtaps, const double *freq, const double *gain,
                  size_t n_freq, size_t nfreqs, int window, double *taps) {
   return shim_run("filt_firwin2", "(kKKkkiK)", (unsigned long)numtaps,

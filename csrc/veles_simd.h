@@ -507,6 +507,16 @@ int filt_savgol_coeffs(size_t window_length, size_t polyorder,
  * float64. */
 int filt_firwin(size_t numtaps, const double *cutoffs, size_t n_cutoffs,
                 int pass_zero, int window, double *taps);
+/* firwin with the full VelesWindowKind range: beta feeds
+ * VELES_WINDOW_KAISER and is ignored by the fixed windows. */
+int filt_firwin_w(size_t numtaps, const double *cutoffs,
+                  size_t n_cutoffs, int pass_zero, int window,
+                  double beta, double *taps);
+/* Kaiser FIR order estimate (scipy kaiserord): smallest numtaps (and
+ * its beta) meeting `ripple` dB of attenuation with transition width
+ * `width` as a fraction of Nyquist.  Pair with filt_firwin_w. */
+int filt_kaiserord(double ripple, double width, size_t *numtaps,
+                   double *beta);
 /* Frequency-sampling FIR design (scipy firwin2, Type I/II): taps whose
  * magnitude response linearly interpolates the (freq, gain)
  * breakpoints, freq ascending in [0, 1] with Nyquist = 1.  nfreqs 0

@@ -169,6 +169,45 @@ class TestFirwin:
         with pytest.raises(ValueError, match="cutoff"):
             fl.firwin(31, [0.2, 0.5], pass_zero="highpass")
 
+    @pytest.mark.parametrize("window", [
+        ("kaiser", 8.6), ("kaiser", 2.0), ("tukey", 0.3),
+        "blackman", "flattop", "bartlett"])
+    def test_general_windows_match_scipy(self, window):
+        np.testing.assert_allclose(
+            fl.firwin(41, 0.35, window=window),
+            ss.firwin(41, 0.35, window=window), atol=1e-12)
+
+    def test_window_array_and_bad_shape(self):
+        win = np.hamming(31)
+        np.testing.assert_allclose(fl.firwin(31, 0.4, window=win),
+                                   ss.firwin(31, 0.4), atol=1e-12)
+        with pytest.raises(ValueError, match="shape"):
+            fl.firwin(31, 0.4, window=np.ones(30))
+        with pytest.raises(ValueError, match="no parameter"):
+            fl.firwin(31, 0.4, window=("hamming", 1.0))
+
+    def test_kaiserord_design_flow(self):
+        """The classic attenuation-driven flow: kaiserord -> firwin
+        with a kaiser window, parity with scipy at every step."""
+        numtaps, beta = fl.kaiserord(65.0, 0.08)
+        nt_s, beta_s = ss.kaiserord(65.0, 0.08)
+        assert (numtaps, beta) == (nt_s, beta_s)
+        got = fl.firwin(numtaps, 0.4, window=("kaiser", beta))
+        want = ss.firwin(nt_s, 0.4, window=("kaiser", beta_s))
+        np.testing.assert_allclose(got, want, atol=1e-12)
+        assert fl.kaiser_beta(65.0) == ss.kaiser_beta(65.0)
+        assert np.isclose(fl.kaiser_atten(numtaps, 0.08),
+                          ss.kaiser_atten(nt_s, 0.08))
+        with pytest.raises(ValueError, match="too small"):
+            fl.kaiserord(5.0, 0.1)
+
+    def test_firwin2_kaiser_window(self):
+        got = fl.firwin2(65, [0.0, 0.3, 0.5, 1.0], [1.0, 1.0, 0.0, 0.0],
+                         window=("kaiser", 6.0))
+        want = ss.firwin2(65, [0.0, 0.3, 0.5, 1.0],
+                          [1.0, 1.0, 0.0, 0.0], window=("kaiser", 6.0))
+        np.testing.assert_allclose(got, want, atol=1e-7)
+
     def test_usable_with_lfilter(self):
         """Design → filter end-to-end: firwin taps through the IIR
         module's FIR path attenuate an out-of-band tone."""

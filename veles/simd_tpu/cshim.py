@@ -688,11 +688,30 @@ def filt_savgol_coeffs(window_length, polyorder, deriv, delta, taps):
 
 
 def filt_firwin(numtaps, cutoffs, n_cutoffs, pass_zero, window, taps):
+    # legacy 2-code window surface; codes 0/1 coincide with
+    # _C_WINDOW_KINDS, beta is ignored by the fixed windows
+    if int(window) not in (0, 1):
+        raise ValueError("filt_firwin takes window 0 (hamming) or 1 "
+                         "(hann); use filt_firwin_w for the full range")
+    return filt_firwin_w(numtaps, cutoffs, n_cutoffs, pass_zero,
+                         window, 0.0, taps)
+
+
+def filt_firwin_w(numtaps, cutoffs, n_cutoffs, pass_zero, window, beta,
+                  taps):
     c = _f64(cutoffs, n_cutoffs)
     cut = float(c[0]) if int(n_cutoffs) == 1 else list(map(float, c))
+    kind = _C_WINDOW_KINDS[int(window)]
+    win = (kind, float(beta)) if kind == "kaiser" else kind
     _f64(taps, numtaps)[...] = _fl.firwin(
-        int(numtaps), cut, pass_zero=bool(pass_zero),
-        window={0: "hamming", 1: "hann"}[int(window)])
+        int(numtaps), cut, pass_zero=bool(pass_zero), window=win)
+    return 0
+
+
+def filt_kaiserord(ripple, width, numtaps_out, beta_out):
+    numtaps, beta = _fl.kaiserord(float(ripple), float(width))
+    _arr(numtaps_out, (1,), ctypes.c_size_t)[0] = numtaps
+    _f64(beta_out, 1)[0] = beta
     return 0
 
 

@@ -46,7 +46,8 @@ __all__ = [
     "medfilt", "medfilt_na", "medfilt2d", "medfilt2d_na", "order_filter",
     "order_filter_na", "savgol_coeffs", "savgol_filter",
     "savgol_filter_na", "firwin", "firwin2", "remez", "wiener",
-    "wiener_na", "deconvolve",
+    "wiener_na", "deconvolve", "kaiserord", "kaiser_beta",
+    "kaiser_atten",
 ]
 
 
@@ -315,8 +316,59 @@ _FIRWIN_PASS_ZERO = {"lowpass": (True, 1), "bandstop": (True, 2),
                      "highpass": (False, 1), "bandpass": (False, 2)}
 
 
+def _design_window(window, numtaps: int) -> np.ndarray:
+    """Resolve a firwin/firwin2 ``window`` argument to taps-length
+    float64 samples: a :func:`waveforms.get_window` name or
+    ``(name, param)`` tuple (scipy convention — ``("kaiser", beta)``,
+    ``("gaussian", std)``, ``("tukey", alpha)`` — handled by
+    ``get_window`` itself), or an explicit array of ``numtaps``
+    samples."""
+    from veles.simd_tpu.ops import waveforms as wf
+
+    if isinstance(window, (str, tuple, list)):
+        return wf.get_window(window, numtaps)
+    win = np.asarray(window, np.float64)
+    if win.shape != (numtaps,):
+        raise ValueError(f"window array must have shape ({numtaps},), "
+                         f"got {win.shape}")
+    return win
+
+
+def kaiser_beta(a: float) -> float:
+    """Kaiser's beta for ``a`` dB of stopband attenuation (scipy's
+    ``kaiser_beta``; Kaiser 1974 empirical fit)."""
+    a = float(a)
+    if a > 50.0:
+        return 0.1102 * (a - 8.7)
+    if a > 21.0:
+        return 0.5842 * (a - 21.0) ** 0.4 + 0.07886 * (a - 21.0)
+    return 0.0
+
+
+def kaiser_atten(numtaps: int, width: float) -> float:
+    """Attenuation (dB) of a ``numtaps``-tap Kaiser FIR with transition
+    width ``width`` (fraction of Nyquist) — scipy's ``kaiser_atten``."""
+    return 2.285 * (int(numtaps) - 1) * np.pi * float(width) + 7.95
+
+
+def kaiserord(ripple: float, width: float):
+    """``(numtaps, beta)`` for a Kaiser-window FIR meeting ``ripple``
+    dB of stopband attenuation with transition width ``width`` (fraction
+    of Nyquist) — scipy's ``kaiserord``.  Feed the result to
+    ``firwin(numtaps, cutoff, window=("kaiser", beta))``.
+    """
+    ripple = abs(float(ripple))
+    if ripple < 8:
+        raise ValueError(
+            "ripple attenuation too small for the Kaiser formula "
+            "(need >= 8 dB)")
+    beta = kaiser_beta(ripple)
+    numtaps = (ripple - 7.95) / (2.285 * np.pi * float(width)) + 1
+    return int(np.ceil(numtaps)), beta
+
+
 def firwin(numtaps: int, cutoff, pass_zero=True,
-           window: str = "hamming") -> np.ndarray:
+           window="hamming") -> np.ndarray:
     """Window-method linear-phase FIR design (scipy's ``firwin``).
 
     ``cutoff``: scalar or ``(low, high)`` as fractions of Nyquist.
@@ -324,8 +376,10 @@ def firwin(numtaps: int, cutoff, pass_zero=True,
     (highpass / bandpass), or one of the scipy strings ``'lowpass'`` /
     ``'highpass'`` / ``'bandpass'`` / ``'bandstop'``.  A response that
     passes Nyquist needs odd ``numtaps`` (a Type II filter has a forced
-    Nyquist zero).  Hamming or Hann window.  Float64 host-side; unit
-    passband gain.
+    Nyquist zero).  ``window``: any :func:`waveforms.get_window` name,
+    a ``("kaiser", beta)``-style tuple, or an explicit taps-length
+    array (pair with :func:`kaiserord` for the classic attenuation-
+    driven design).  Float64 host-side; unit passband gain.
     """
     numtaps = int(numtaps)
     if numtaps < 1:
@@ -352,12 +406,7 @@ def firwin(numtaps: int, cutoff, pass_zero=True,
         raise ValueError("a response that passes Nyquist needs odd "
                          "numtaps (Type II filters have a Nyquist zero)")
     m = np.arange(numtaps, dtype=np.float64) - (numtaps - 1) / 2.0
-    if window == "hamming":
-        win = np.hamming(numtaps)
-    elif window in ("hann", "hanning"):
-        win = np.hanning(numtaps)
-    else:
-        raise ValueError(f"unknown window {window!r}")
+    win = _design_window(window, numtaps)
 
     def sinc_lp(fc):  # ideal lowpass impulse response at cutoff fc
         return fc * np.sinc(fc * m)
@@ -443,11 +492,12 @@ def wiener_na(x, mysize: int = 3, noise=None):
 
 
 def firwin2(numtaps: int, freq, gain, nfreqs=None,
-            window: str = "hamming") -> np.ndarray:
+            window="hamming") -> np.ndarray:
     """Frequency-sampling FIR design (scipy's ``firwin2`` for Type I/II
     filters): taps whose magnitude response linearly interpolates the
     ``(freq, gain)`` breakpoints (``freq`` ascending in [0, 1], Nyquist
-    = 1).  Float64 host-side.
+    = 1).  ``window`` as in :func:`firwin` (name, ``(name, param)``
+    tuple, array, or None for rectangular).  Float64 host-side.
     """
     numtaps = int(numtaps)
     if numtaps < 3:
@@ -484,10 +534,8 @@ def firwin2(numtaps: int, freq, gain, nfreqs=None,
     # linear phase: delay (numtaps-1)/2, then one irfft
     shift = np.exp(-(numtaps - 1) / 2.0 * 1j * np.pi * grid)
     h = np.fft.irfft(mag * shift, 2 * (nfreqs - 1))[:numtaps]
-    from veles.simd_tpu.ops.waveforms import get_window
-
     win = np.ones(numtaps) if window is None \
-        else get_window(window, numtaps)
+        else _design_window(window, numtaps)
     return h * win
 
 

@@ -253,16 +253,29 @@ def get_window(name, n: int, **kwargs) -> np.ndarray:
     scipy's own default is the periodic form): 'hann', 'hamming',
     'blackman', 'blackmanharris', 'nuttall', 'flattop', 'bartlett',
     'cosine', 'boxcar', 'tukey' (``alpha=``, default 0.5), 'gaussian'
-    (needs ``std=``), or 'kaiser' (needs ``beta=``).  Float64
+    (needs ``std=``), or 'kaiser' (needs ``beta=``).  scipy's
+    ``(name, param)`` tuple convention is accepted for the
+    parameterized windows — ``("kaiser", beta)``, ``("gaussian",
+    std)``, ``("tukey", alpha)``.  Float64
     host-side — pass the result to
     :func:`~veles.simd_tpu.ops.spectral.stft`/``welch`` or use as FIR
     taps weighting."""
     n = int(n)
     if n < 1:
         raise ValueError("n must be >= 1")
+    _PARAM_KEY = {"kaiser": "beta", "gaussian": "std", "tukey": "alpha"}
+    if isinstance(name, (tuple, list)):
+        # scipy's ("kaiser", beta) tuple convention
+        if len(name) != 2 or not isinstance(name[0], str):
+            raise ValueError(f"window tuple must be (name, param), "
+                             f"got {name!r}")
+        key = _PARAM_KEY.get(str(name[0]).lower())
+        if key is None:
+            raise ValueError(f"window {name[0]!r} takes no parameter; "
+                             "pass the bare name")
+        return get_window(name[0], n, **{key: float(name[1])})
     name = str(name).lower()
-    allowed = {"kaiser": {"beta"}, "gaussian": {"std"},
-               "tukey": {"alpha"}}.get(name, set())
+    allowed = ({_PARAM_KEY[name]} if name in _PARAM_KEY else set())
     stray = set(kwargs) - allowed
     if stray:
         raise ValueError(f"unexpected arguments {sorted(stray)} for "
