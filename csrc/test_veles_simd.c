@@ -205,6 +205,21 @@ static void test_convolve(void) {
     for (int i = 0; i < 40; i++) {
       CHECK_NEAR(xc2[i], want2r[i], 1e-3);
     }
+    /* mode/boundary surface: 'same' output is input-sized and equals
+     * the centered window of the full result ((k-1)/2 offset); 'symm'
+     * boundary changes border values but not interior ones */
+    float same2[4 * 6];
+    CHECK(convolve2d_mb(1, 0, img, 4, 6, k2, 2, 3, 1, 0, 0.0f,
+                        same2) == 0);
+    for (int i = 0; i < 4; i++)
+      for (int j = 0; j < 6; j++)
+        CHECK_NEAR(same2[i * 6 + j], want2[i * 8 + j + 1], 1e-3);
+    float symm2[4 * 6];
+    CHECK(convolve2d_mb(1, 0, img, 4, 6, k2, 2, 3, 1, 2, 0.0f,
+                        symm2) == 0);
+    CHECK_NEAR(symm2[2 * 6 + 3], same2[2 * 6 + 3], 1e-3); /* interior */
+    CHECK(convolve2d_mb(1, 0, img, 4, 6, k2, 2, 3, 9, 0, 0.0f,
+                        symm2) != 0);                     /* bad mode */
   }
 
   /* streaming: chunked outputs + tail must equal the one-shot result */

@@ -292,6 +292,16 @@ int convolve2d(int simd, const float *x, size_t n0, size_t n1,
   return conv2d_run(simd, 0, x, n0, n1, h, k0, k1, result);
 }
 
+int convolve2d_mb(int simd, int reverse, const float *x, size_t n0,
+                  size_t n1, const float *h, size_t k0, size_t k1,
+                  int mode, int boundary, float fillvalue,
+                  float *result) {
+  return shim_run("convolve2d_mb", "(iiKkkKkkiifK)", simd, reverse,
+                  PTR(x), (unsigned long)n0, (unsigned long)n1, PTR(h),
+                  (unsigned long)k0, (unsigned long)k1, mode, boundary,
+                  (double)fillvalue, PTR(result));
+}
+
 int cross_correlate2d(int simd, const float *x, size_t n0, size_t n1,
                       const float *h, size_t k0, size_t k1, float *result) {
   return conv2d_run(simd, 1, x, n0, n1, h, k0, k1, result);

@@ -115,6 +115,14 @@ int convolve2d(int simd, const float *x, size_t n0, size_t n1,
                const float *h, size_t k0, size_t k1, float *result);
 int cross_correlate2d(int simd, const float *x, size_t n0, size_t n1,
                       const float *h, size_t k0, size_t k1, float *result);
+/* scipy convolve2d/correlate2d mode/boundary semantics.  mode: 0 full,
+ * 1 same, 2 valid; boundary: 0 fill (with fillvalue), 1 wrap, 2 symm.
+ * result sizes, per axis (m = n, k of that axis): full m+k-1, same m,
+ * valid max(m,k)-min(m,k)+1.  reverse nonzero = correlation. */
+int convolve2d_mb(int simd, int reverse, const float *x, size_t n0,
+                  size_t n1, const float *h, size_t k0, size_t k1,
+                  int mode, int boundary, float fillvalue,
+                  float *result);
 
 /* Streaming convolution — no reference analog (the reference's handles
  * are one-shot).  Chunks of fixed chunk_length arrive one at a time;

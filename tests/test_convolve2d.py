@@ -116,3 +116,100 @@ def test_contract_violations():
     with pytest.raises(ValueError, match="algorithm"):
         cv2.convolve2d(np.zeros((4, 4), np.float32),
                        np.zeros((2, 2), np.float32), algorithm="nope")
+
+
+class TestModeBoundary:
+    """scipy.signal.convolve2d/correlate2d mode= and boundary= parity
+    (round 5): the boundary rule extends the input, mode slices the
+    full result per axis."""
+
+    CASES = [
+        ("full", "fill", 0.0), ("same", "fill", 0.0),
+        ("valid", "fill", 0.0), ("full", "wrap", 0.0),
+        ("same", "wrap", 0.0), ("full", "symm", 0.0),
+        ("same", "symm", 0.0), ("valid", "symm", 0.0),
+        ("same", "fill", 2.5),
+    ]
+
+    @pytest.mark.parametrize("mode,boundary,fillvalue", CASES)
+    def test_convolve2d_matches_scipy(self, mode, boundary, fillvalue):
+        import scipy.signal as ss
+
+        rng = np.random.RandomState(77)
+        x = rng.randn(23, 31).astype(np.float32)
+        h = rng.randn(5, 7).astype(np.float32)
+        got = np.asarray(cv2.convolve2d(
+            x, h, simd=True, mode=mode, boundary=boundary,
+            fillvalue=fillvalue))
+        want = ss.convolve2d(x.astype(np.float64), h.astype(np.float64),
+                             mode=mode, boundary=boundary,
+                             fillvalue=fillvalue)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, atol=1e-4)
+        # oracle path agrees too
+        got0 = np.asarray(cv2.convolve2d(
+            x, h, simd=False, mode=mode, boundary=boundary,
+            fillvalue=fillvalue))
+        np.testing.assert_allclose(got0, want, atol=1e-4)
+
+    @pytest.mark.parametrize("mode,boundary", [
+        ("same", "fill"), ("valid", "fill"), ("same", "symm")])
+    def test_correlate2d_matches_scipy(self, mode, boundary):
+        import scipy.signal as ss
+
+        rng = np.random.RandomState(78)
+        x = rng.randn(20, 24).astype(np.float32)
+        h = rng.randn(6, 5).astype(np.float32)
+        got = np.asarray(cv2.cross_correlate2d(
+            x, h, simd=True, mode=mode, boundary=boundary))
+        want = ss.correlate2d(x.astype(np.float64),
+                              h.astype(np.float64), mode=mode,
+                              boundary=boundary)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_contracts(self):
+        x = np.zeros((8, 8), np.float32)
+        h = np.zeros((3, 3), np.float32)
+        with pytest.raises(ValueError, match="mode"):
+            cv2.convolve2d(x, h, mode="nope")
+        with pytest.raises(ValueError, match="boundary"):
+            cv2.convolve2d(x, h, boundary="reflect")
+        with pytest.raises(ValueError, match="every dimension"):
+            cv2.convolve2d(np.zeros((3, 8), np.float32),
+                           np.zeros((5, 4), np.float32), mode="valid")
+
+    @pytest.mark.parametrize("boundary", ["fill", "symm", "wrap"])
+    def test_valid_kernel_larger_than_input(self, boundary):
+        """scipy swaps operands in 'valid' when the kernel contains the
+        input, so the boundary rule extends the LARGER array (review
+        finding: the unswapped form diverged); correlation flips."""
+        import scipy.signal as ss
+
+        rng = np.random.RandomState(79)
+        x = rng.randn(3, 4).astype(np.float32)
+        h = rng.randn(7, 6).astype(np.float32)
+        got = np.asarray(cv2.convolve2d(x, h, simd=True, mode="valid",
+                                        boundary=boundary))
+        want = ss.convolve2d(x.astype(np.float64), h.astype(np.float64),
+                             mode="valid", boundary=boundary)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, atol=1e-4)
+        gotc = np.asarray(cv2.cross_correlate2d(
+            x, h, simd=True, mode="valid", boundary=boundary))
+        wantc = ss.correlate2d(x.astype(np.float64),
+                               h.astype(np.float64), mode="valid",
+                               boundary=boundary)
+        np.testing.assert_allclose(gotc, wantc, atol=1e-4)
+
+    def test_valid_boundary_skips_extension(self):
+        """'valid' with n >= k never sees the boundary: symm/wrap must
+        equal plain fill exactly (and take the unpadded fast path)."""
+        rng = np.random.RandomState(80)
+        x = rng.randn(16, 17).astype(np.float32)
+        h = rng.randn(4, 5).astype(np.float32)
+        base = np.asarray(cv2.convolve2d(x, h, simd=True, mode="valid"))
+        for boundary in ("symm", "wrap"):
+            np.testing.assert_array_equal(
+                np.asarray(cv2.convolve2d(x, h, simd=True, mode="valid",
+                                          boundary=boundary)), base)

@@ -199,6 +199,12 @@ class TestMLSAndWindows:
     def test_window_stray_kwargs(self):
         with pytest.raises(ValueError, match="unexpected"):
             wf.get_window("hann", 32, beta=8.6)
+        # the tuple form carries its own parameter — a conflicting
+        # keyword must not be silently dropped
+        with pytest.raises(ValueError, match="unexpected"):
+            wf.get_window(("kaiser", 8.6), 32, beta=2.0)
+        np.testing.assert_allclose(wf.get_window(("kaiser", 8.6), 32),
+                                   wf.get_window("kaiser", 32, beta=8.6))
 
 
 class TestMoreWindows:

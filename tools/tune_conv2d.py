@@ -89,6 +89,13 @@ def main():
             want = cv2.convolve2d_na(x_np, h_np)  # f64 internally
             scale = np.max(np.abs(want))
             cands = ["direct", "fft"]
+            # CRASH GUARD (round-5 windows, twice-observed): the XLA
+            # im2col direct conv at img >= 512^2 with kernel area >=
+            # 1089 CRASHED the TPU worker ("kernel fault"), killing the
+            # whole session.  Auto-routing never goes there; the tuner
+            # must not either — the cell is recorded as fft-by-default.
+            if n0 * n1 >= 512 * 512 and k0 * k1 >= 33 * 33:
+                cands.remove("direct")
             if cv2._use_pallas_direct2d(x.shape, k0, k1):
                 cands.append("pallas")
             best = (float("inf"), None)

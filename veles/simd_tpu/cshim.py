@@ -176,6 +176,23 @@ def convolve2d(simd, reverse, x, n0, n1, h, k0, k1, result):
     return 0
 
 
+_C_CONV2D_MODES = {0: "full", 1: "same", 2: "valid"}
+_C_CONV2D_BOUNDARIES = {0: "fill", 1: "wrap", 2: "symm"}
+
+
+def convolve2d_mb(simd, reverse, x, n0, n1, h, k0, k1, mode, boundary,
+                  fillvalue, result):
+    fn = _cv2.cross_correlate2d if reverse else _cv2.convolve2d
+    out = np.asarray(fn(
+        _arr(x, (n0, n1), ctypes.c_float),
+        _arr(h, (k0, k1), ctypes.c_float), simd=bool(simd),
+        mode=_C_CONV2D_MODES[int(mode)],
+        boundary=_C_CONV2D_BOUNDARIES[int(boundary)],
+        fillvalue=float(fillvalue)))
+    _arr(result, out.shape, ctypes.c_float)[...] = out
+    return 0
+
+
 def convolve_simd(simd, x, xlen, h, hlen, result):
     out = _cv.convolve_simd(_f32(x, xlen), _f32(h, hlen), simd=bool(simd))
     _f32(result, xlen + hlen - 1)[...] = np.asarray(out)

@@ -106,7 +106,7 @@ def _use_pallas_direct2d(x_shape, k0: int, k1: int) -> bool:
     return (_pk.pallas_available()
             and _pk.pallas2d_compiled_allowed()
             and k0 * k1 <= _pk.PALLAS_2D_MAX_KERNEL_AREA
-            and _pk.fits_vmem(n0e * n1e + out_elems))
+            and _pk.fits_vmem2d(n0e * n1e, out_elems, k0 * k1))
 
 
 @functools.partial(jax.jit, static_argnames=("reverse",))
@@ -176,16 +176,111 @@ def _run2d(x, h, reverse, algorithm, simd):
     return convolve2d_na(x, h)
 
 
-def convolve2d(x, h, algorithm=None, simd=None):
-    """Full 2D linear convolution: ``y[..., i, j] = Σ x[..., i-p, j-q]
-    h[p, q]``, output ``[..., n0+k0-1, n1+k1-1]``."""
-    return _run2d(x, h, False, algorithm, simd)
+_BOUNDARY_PAD = {"fill": "constant", "wrap": "wrap", "symm": "symmetric"}
 
 
-def cross_correlate2d(x, h, algorithm=None, simd=None):
-    """Full 2D cross-correlation (convolution with ``h`` reversed along
-    both axes — the 2D form of ``src/correlate.c:37-72``)."""
-    return _run2d(x, h, True, algorithm, simd)
+def _mode_boundary_2d(x, h, reverse, algorithm, simd, mode, boundary,
+                      fillvalue):
+    """scipy ``convolve2d``/``correlate2d`` semantics on top of the
+    full-output core: ``boundary`` extends the input by ``k-1`` per
+    side (``wrap``/``symm``/constant ``fillvalue``) before the full
+    convolution, and ``mode`` slices the result per axis (scipy's 2D
+    windows: ``correlate2d``'s 'same' starts at ``k//2`` where
+    ``convolve2d``'s starts at ``(k-1)//2``; 'valid' is orientation-
+    independent)."""
+    from veles.simd_tpu.ops.convolve import _check_mode
+
+    _check_mode(mode)
+    if boundary not in _BOUNDARY_PAD:
+        raise ValueError(f"boundary must be one of "
+                         f"{sorted(_BOUNDARY_PAD)}, got {boundary!r}")
+    _check2d(x, h)
+    k0, k1 = np.shape(h)[-2:]
+    n0, n1 = np.shape(x)[-2:]
+    swapped = False
+    if mode == "valid":
+        # scipy's 'valid' contract: one operand must contain the other
+        # in every dimension; when the kernel is the larger one the
+        # operands swap (so the boundary rule extends the larger
+        # array), and a swapped correlation flips the result
+        if (k0 > n0) != (k1 > n1):
+            raise ValueError(
+                "for mode='valid' one input must be at least as large "
+                f"as the other in every dimension; got {(n0, n1)} vs "
+                f"{(k0, k1)}")
+        if k0 > n0:
+            if np.ndim(x) != 2:
+                raise ValueError(
+                    "mode='valid' with a kernel larger than the input "
+                    "supports unbatched [n0, n1] inputs only (the "
+                    "operand swap would move the batch axes)")
+            x, h = h, x
+            n0, n1, k0, k1 = k0, k1, n0, n1
+            swapped = True
+        # the fully-overlapped region never sees the boundary: skip the
+        # extension entirely (identical values, smaller compute)
+        boundary, fillvalue = "fill", 0.0
+    plain = boundary == "fill" and fillvalue == 0.0
+    if not plain:
+        xp = jnp if resolve_simd(simd) else np
+        pad = [(0, 0)] * (np.ndim(x) - 2) + [(k0 - 1, k0 - 1),
+                                             (k1 - 1, k1 - 1)]
+        kw = ({"constant_values": fillvalue}
+              if boundary == "fill" else {})
+        x = xp.pad(xp.asarray(x), pad, mode=_BOUNDARY_PAD[boundary],
+                   **kw)
+    out = _run2d(x, h, reverse, algorithm, simd)
+    if not plain:
+        # the extended full result; the original full window sits at
+        # offset k-1 per axis
+        out = out[..., k0 - 1:k0 - 1 + n0 + k0 - 1,
+                  k1 - 1:k1 - 1 + n1 + k1 - 1]
+    if mode == "full":
+        return out
+
+    def span(n, k):
+        # scipy.signal 2D windows into the full result: 'same' centers
+        # on the input (correlate2d starts one later for even kernels:
+        # k//2 vs convolve2d's (k-1)//2); 'valid' is the fully-overlapped
+        # region, identical for both orientations
+        if mode == "same":
+            start = k // 2 if reverse else (k - 1) // 2
+            return start, n
+        lo, hi = min(n, k), max(n, k)
+        return lo - 1, hi - lo + 1
+    s0, l0 = span(n0, k0)
+    s1, l1 = span(n1, k1)
+    out = out[..., s0:s0 + l0, s1:s1 + l1]
+    if swapped and reverse:
+        # correlation does not commute: the swapped-operand result is
+        # the doubly-reversed one (scipy's swapped_inputs flip)
+        out = out[..., ::-1, ::-1]
+    return out
+
+
+def convolve2d(x, h, algorithm=None, simd=None, *, mode="full",
+               boundary="fill", fillvalue=0.0):
+    """2D linear convolution: ``y[..., i, j] = Σ x[..., i-p, j-q]
+    h[p, q]``.
+
+    ``mode`` ('full' default, 'same', 'valid') and ``boundary``
+    ('fill' with ``fillvalue``, 'wrap', 'symm') follow
+    ``scipy.signal.convolve2d``: the boundary rule extends the input by
+    ``k-1`` samples per side before convolving, and ``mode`` picks the
+    output window per axis.  'full' output is
+    ``[..., n0+k0-1, n1+k1-1]``."""
+    return _mode_boundary_2d(x, h, False, algorithm, simd, mode,
+                             boundary, fillvalue)
+
+
+def cross_correlate2d(x, h, algorithm=None, simd=None, *, mode="full",
+                      boundary="fill", fillvalue=0.0):
+    """2D cross-correlation (convolution with ``h`` reversed along
+    both axes — the 2D form of ``src/correlate.c:37-72``).  ``mode`` /
+    ``boundary`` / ``fillvalue`` as in :func:`convolve2d`
+    (scipy's ``correlate2d``)."""
+    return _mode_boundary_2d(x, h, True, algorithm, simd, mode,
+                             boundary, fillvalue)
 
 
 def convolve2d_na(x, h):

@@ -83,6 +83,16 @@ PALLAS_2D_MAX_KERNEL_AREA = 256
 # accumulator temps; budget well under the 16 MB/core limit
 _MAX_ROWS_PER_TILE = 256
 _VMEM_BUDGET_BYTES = 10 << 20   # for 2*(in+out) + temps
+# Mosaic's scoped-vmem stack cap for one kernel invocation: the 2D
+# kernel's unrolled MAC chain makes the compiler materialize
+# ~kernel_area output-tile temporaries on the scoped stack, so the
+# admissible shapes are bounded by area * out_tile_bytes, not just the
+# in+out residency.  Measured round 5 (live v5e): 128^2 img, k=15x15
+# (area 225) FAILS with "scoped allocation 22.34M > 16.00M limit";
+# 16x256x256 k=7x7 (49 * 274KB = 13.4M) compiles and WINS 8x — so the
+# cut sits between those measured points: 14M admits every proven
+# winner and rejects both observed compile failures.
+_VMEM_SCOPED_BUDGET_BYTES = 14 << 20
 
 
 def pallas_available() -> bool:
@@ -118,6 +128,16 @@ def _tile_rows(n_rows: int, row_elems: int) -> int:
     if rows > 8:
         rows &= ~7          # keep full 8-sublane tiles
     return max(rows, 1)
+
+
+def fits_vmem2d(in_elems: int, out_elems: int, kernel_area: int) -> bool:
+    """2D admission: residency (in + out) within the tile budget AND
+    the unroll's scoped stack — approximately ``kernel_area`` output
+    tiles of temporaries — under the measured Mosaic cap (constant
+    note at ``_VMEM_SCOPED_BUDGET_BYTES``)."""
+    return (fits_vmem(in_elems + out_elems)
+            and kernel_area * out_elems * 4
+            <= _VMEM_SCOPED_BUDGET_BYTES)
 
 
 def fits_vmem(row_elems: int) -> bool:
@@ -441,9 +461,10 @@ def filter_2d_pallas(x_ext, kernel2d, n_out0, n_out1, interpret=None):
             f"{(n_out0 + k0 - 1, n_out1 + k1 - 1)}")
     if interpret is None:
         interpret = not pallas_available()
-    if not interpret and not fits_vmem(
-            x_ext.shape[-2] * x_ext.shape[-1] + n_out0 * n_out1):
-        raise ValueError("image exceeds the kernel VMEM tile budget; "
+    if not interpret and not fits_vmem2d(
+            x_ext.shape[-2] * x_ext.shape[-1], n_out0 * n_out1, k0 * k1):
+        raise ValueError("image exceeds the kernel VMEM tile budget "
+                         "(residency or the area-scaled scoped stack); "
                          "keep this shape on the XLA path")
     batch_shape = x_ext.shape[:-2]
     x3d = jnp.asarray(x_ext).reshape((-1,) + x_ext.shape[-2:])

@@ -269,6 +269,10 @@ def get_window(name, n: int, **kwargs) -> np.ndarray:
         if len(name) != 2 or not isinstance(name[0], str):
             raise ValueError(f"window tuple must be (name, param), "
                              f"got {name!r}")
+        if kwargs:
+            raise ValueError(
+                f"unexpected arguments {sorted(kwargs)}: the tuple "
+                "form already carries the window parameter")
         key = _PARAM_KEY.get(str(name[0]).lower())
         if key is None:
             raise ValueError(f"window {name[0]!r} takes no parameter; "
