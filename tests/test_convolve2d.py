@@ -202,6 +202,25 @@ class TestModeBoundary:
                                boundary=boundary)
         np.testing.assert_allclose(gotc, wantc, atol=1e-4)
 
+    def test_valid_equal_dimension_containment(self):
+        """Ties count as containment (scipy's _inputs_swap_needed uses
+        >=): a (3,5) input with a (7,5) kernel is valid and swaps."""
+        import scipy.signal as ss
+
+        rng = np.random.RandomState(81)
+        x = rng.randn(3, 5).astype(np.float32)
+        h = rng.randn(7, 5).astype(np.float32)
+        got = np.asarray(cv2.convolve2d(x, h, simd=True, mode="valid"))
+        want = ss.convolve2d(x.astype(np.float64),
+                             h.astype(np.float64), mode="valid")
+        assert got.shape == want.shape == (5, 1)
+        np.testing.assert_allclose(got, want, atol=1e-4)
+        gotc = np.asarray(cv2.cross_correlate2d(x, h, simd=True,
+                                                mode="valid"))
+        wantc = ss.correlate2d(x.astype(np.float64),
+                               h.astype(np.float64), mode="valid")
+        np.testing.assert_allclose(gotc, wantc, atol=1e-4)
+
     def test_valid_boundary_skips_extension(self):
         """'valid' with n >= k never sees the boundary: symm/wrap must
         equal plain fill exactly (and take the unpadded fast path)."""

@@ -89,12 +89,14 @@ def main():
             want = cv2.convolve2d_na(x_np, h_np)  # f64 internally
             scale = np.max(np.abs(want))
             cands = ["direct", "fft"]
-            # CRASH GUARD (round-5 windows, twice-observed): the XLA
-            # im2col direct conv at img >= 512^2 with kernel area >=
-            # 1089 CRASHED the TPU worker ("kernel fault"), killing the
-            # whole session.  Auto-routing never goes there; the tuner
-            # must not either — the cell is recorded as fft-by-default.
-            if n0 * n1 >= 512 * 512 and k0 * k1 >= 33 * 33:
+            # CRASH GUARD (round-5 windows, thrice-observed): the XLA
+            # im2col direct conv CRASHES the TPU worker ("kernel
+            # fault") at large MAC volumes — measured crash cells
+            # (512^2 img, 65^2 ker) = 1.4e9 and (128^2 img, 97^2 ker)
+            # = 4.7e8 out_elems*area MACs; largest safe cell 3.2e8.
+            # Auto-routing never picks XLA-direct; the tuner must not
+            # either above the measured safe volume.
+            if ((n0 + k0 - 1) * (n1 + k1 - 1) * k0 * k1 > 350_000_000):
                 cands.remove("direct")
             if cv2._use_pallas_direct2d(x.shape, k0, k1):
                 cands.append("pallas")
@@ -122,7 +124,7 @@ def main():
                       + "  -> NO VALID CANDIDATE", flush=True)
                 continue
             results[(n0 * n1, k0 * k1)] = best[1]
-            cur = cv2.select_algorithm2d(k0, k1)
+            cur = cv2.select_algorithm2d(k0, k1, x.shape)
             mark = "" if best[1] in (cur, "pallas") else "  << heuristic "\
                 f"picks {cur}"
             print(f"img {n0:4d}x{n1:<4d} ker {k0:3d}x{k1:<3d} "

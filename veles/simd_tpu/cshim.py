@@ -182,6 +182,12 @@ _C_CONV2D_BOUNDARIES = {0: "fill", 1: "wrap", 2: "symm"}
 
 def convolve2d_mb(simd, reverse, x, n0, n1, h, k0, k1, mode, boundary,
                   fillvalue, result):
+    if int(mode) not in _C_CONV2D_MODES:
+        raise ValueError(f"mode code {int(mode)} invalid: 0 full, "
+                         "1 same, 2 valid")
+    if int(boundary) not in _C_CONV2D_BOUNDARIES:
+        raise ValueError(f"boundary code {int(boundary)} invalid: "
+                         "0 fill, 1 wrap, 2 symm")
     fn = _cv2.cross_correlate2d if reverse else _cv2.convolve2d
     out = np.asarray(fn(
         _arr(x, (n0, n1), ctypes.c_float),

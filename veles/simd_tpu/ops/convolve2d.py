@@ -70,6 +70,14 @@ __all__ = ["convolve2d", "convolve2d_na",
 # AUTO_FFT2_MIN_KERNEL_AREA constant is gone: its name described the
 # old direct-vs-fft area cut, which the measurements dissolved — the
 # only remaining area bound is the Pallas kernel cap itself.)
+#
+# The FULL 36-cell sweep (img {128,256,512,1024}^2 x ker 3x3..97x97,
+# tools/tune_conv2d.py, second live window 2026-07-31) completed with
+# this routing in place: pallas won every cell its gate admits (e.g.
+# 512^2 k3x3: pallas 0.005ms vs fft 0.576 vs direct 3.512), fft won
+# every other cell, XLA-direct won none and is excluded by the tuner's
+# MAC-volume crash guard above 3.5e8 out_elems*area (worker crashes
+# measured at 4.7e8 and 1.4e9).
 
 
 def select_algorithm2d(k0: int, k1: int, x_shape=None) -> str:
@@ -199,16 +207,19 @@ def _mode_boundary_2d(x, h, reverse, algorithm, simd, mode, boundary,
     n0, n1 = np.shape(x)[-2:]
     swapped = False
     if mode == "valid":
-        # scipy's 'valid' contract: one operand must contain the other
-        # in every dimension; when the kernel is the larger one the
-        # operands swap (so the boundary rule extends the larger
-        # array), and a swapped correlation flips the result
-        if (k0 > n0) != (k1 > n1):
+        # scipy's 'valid' contract (its _inputs_swap_needed): one
+        # operand must contain the other in EVERY dimension (ties
+        # count as containment); when only the kernel contains the
+        # input the operands swap (so any boundary rule would extend
+        # the larger array), and a swapped correlation flips the result
+        x_holds = n0 >= k0 and n1 >= k1
+        h_holds = k0 >= n0 and k1 >= n1
+        if not (x_holds or h_holds):
             raise ValueError(
                 "for mode='valid' one input must be at least as large "
                 f"as the other in every dimension; got {(n0, n1)} vs "
                 f"{(k0, k1)}")
-        if k0 > n0:
+        if h_holds and not x_holds:
             if np.ndim(x) != 2:
                 raise ValueError(
                     "mode='valid' with a kernel larger than the input "
@@ -221,20 +232,24 @@ def _mode_boundary_2d(x, h, reverse, algorithm, simd, mode, boundary,
         # extension entirely (identical values, smaller compute)
         boundary, fillvalue = "fill", 0.0
     plain = boundary == "fill" and fillvalue == 0.0
+    # boundary extension per side: 'full' border outputs reach k-1
+    # extension samples; 'same' border outputs only reach k//2 (which
+    # also covers convolve's (k-1)//2) — padding more just computes
+    # throwaway columns (and can bump the FFT pow2 size)
+    p0, p1 = (k0 - 1, k1 - 1) if mode == "full" else (k0 // 2, k1 // 2)
     if not plain:
         xp = jnp if resolve_simd(simd) else np
-        pad = [(0, 0)] * (np.ndim(x) - 2) + [(k0 - 1, k0 - 1),
-                                             (k1 - 1, k1 - 1)]
+        pad = [(0, 0)] * (np.ndim(x) - 2) + [(p0, p0), (p1, p1)]
         kw = ({"constant_values": fillvalue}
               if boundary == "fill" else {})
         x = xp.pad(xp.asarray(x), pad, mode=_BOUNDARY_PAD[boundary],
                    **kw)
     out = _run2d(x, h, reverse, algorithm, simd)
     if not plain:
-        # the extended full result; the original full window sits at
-        # offset k-1 per axis
-        out = out[..., k0 - 1:k0 - 1 + n0 + k0 - 1,
-                  k1 - 1:k1 - 1 + n1 + k1 - 1]
+        # the padded full result; the unpadded full window sits at
+        # offset p per axis (possibly cropped for mode='same', whose
+        # slice below stays inside the computed span by construction)
+        out = out[..., p0:p0 + n0 + k0 - 1, p1:p1 + n1 + k1 - 1]
     if mode == "full":
         return out
 
@@ -268,7 +283,14 @@ def convolve2d(x, h, algorithm=None, simd=None, *, mode="full",
     ``scipy.signal.convolve2d``: the boundary rule extends the input by
     ``k-1`` samples per side before convolving, and ``mode`` picks the
     output window per axis.  'full' output is
-    ``[..., n0+k0-1, n1+k1-1]``."""
+    ``[..., n0+k0-1, n1+k1-1]``.
+
+    CAUTION on ``algorithm="direct"`` with very large kernels: XLA's
+    im2col conv crashed the TPU worker outright at high MAC volumes
+    (measured round 5: ``out_elems * kernel_area`` >= ~4.7e8, e.g.
+    512x512 images with 65x65 kernels).  Auto-selection never routes
+    there (the crossover tables above); only an explicit ``"direct"``
+    request can reach it."""
     return _mode_boundary_2d(x, h, False, algorithm, simd, mode,
                              boundary, fillvalue)
 
