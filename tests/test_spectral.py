@@ -12,6 +12,7 @@ import pytest
 from scipy import signal as ss
 
 from veles.simd_tpu.ops import spectral as sp
+from veles.simd_tpu.ops import waveforms as wf
 
 RNG = np.random.RandomState(17)
 
@@ -432,3 +433,61 @@ class TestLombScargle:
         got = np.asarray(sp.lombscargle(t, x, freqs, simd=True))
         want = ss.lombscargle(t, x, freqs)
         np.testing.assert_allclose(got, want, atol=2e-4 * want.max())
+
+
+class TestWindowByName:
+    """Spectral window args accept get_window names / (name, param)
+    tuples (round 5) — scipy's convention, symmetric-window caveat in
+    PORTING.md."""
+
+    def test_stft_istft_name_roundtrip(self):
+        rng = np.random.RandomState(14)
+        x = rng.randn(2048).astype(np.float32)
+        w = wf.get_window("hamming", 256)
+        by_name = np.asarray(sp.stft(x, 256, 64, window="hamming",
+                                     simd=True))
+        by_array = np.asarray(sp.stft(x, 256, 64, window=w, simd=True))
+        np.testing.assert_array_equal(by_name, by_array)
+        rec = np.asarray(sp.istft(sp.stft(x, 256, 64, window="hamming",
+                                          simd=True),
+                                  2048, 256, 64, window="hamming",
+                                  simd=True))
+        np.testing.assert_allclose(rec[256:-256], x[256:-256], atol=1e-4)
+
+    def test_welch_tuple_window(self):
+        rng = np.random.RandomState(15)
+        x = rng.randn(4096).astype(np.float32)
+        w = wf.get_window(("kaiser", 7.0), 256)
+        f1, p1 = sp.welch(x, nperseg=256, window=("kaiser", 7.0),
+                          simd=True)
+        f2, p2 = sp.welch(x, nperseg=256, window=w, simd=True)
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p2))
+        # scipy agrees when fed the identical window array
+        f3, p3 = ss.welch(x.astype(np.float64), nperseg=256, window=w)
+        np.testing.assert_allclose(np.asarray(p1), p3,
+                                   atol=1e-5 * p3.max())
+
+    def test_periodogram_name(self):
+        rng = np.random.RandomState(16)
+        x = rng.randn(1024).astype(np.float32)
+        f1, p1 = sp.periodogram(x, window="hann", simd=True)
+        f3, p3 = ss.periodogram(x.astype(np.float64),
+                                window=wf.get_window("hann", 1024))
+        np.testing.assert_allclose(np.asarray(p1), p3,
+                                   atol=1e-5 * p3.max())
+
+    def test_numeric_list_window_still_works(self):
+        """A plain numeric list is window SAMPLES, not a spec (review
+        regression: the spec check must not swallow lists)."""
+        rng = np.random.RandomState(18)
+        x = rng.randn(512).astype(np.float32)
+        w = [1.0] * 64
+        by_list = np.asarray(sp.stft(x, 64, 32, window=w, simd=True))
+        by_arr = np.asarray(sp.stft(x, 64, 32,
+                                    window=np.ones(64, np.float32),
+                                    simd=True))
+        np.testing.assert_array_equal(by_list, by_arr)
+        f1, p1 = sp.welch(x, nperseg=64, window=w, simd=True)
+        f2, p2 = sp.welch(x, nperseg=64,
+                          window=np.ones(64, np.float64), simd=True)
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p2))

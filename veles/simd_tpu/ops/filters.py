@@ -325,7 +325,9 @@ def _design_window(window, numtaps: int) -> np.ndarray:
     samples."""
     from veles.simd_tpu.ops import waveforms as wf
 
-    if isinstance(window, (str, tuple, list)):
+    # only str/tuple are window SPECS (scipy's convention) — a numeric
+    # list is window samples and falls through to the array path
+    if isinstance(window, (str, tuple)):
         return wf.get_window(window, numtaps)
     win = np.asarray(window, np.float64)
     if win.shape != (numtaps,):

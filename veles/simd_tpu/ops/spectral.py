@@ -88,6 +88,26 @@ def _frame_indices(n, frame_length, hop):
             + np.arange(frame_length)[None, :])
 
 
+def _resolve_window(window, length: int, dtype=np.float32) -> np.ndarray:
+    """Window argument -> ``length`` samples: None = periodic Hann,
+    a :func:`waveforms.get_window` name or ``(name, param)`` tuple
+    (scipy convention; NOTE get_window is symmetric where scipy's
+    spectral default is periodic — PORTING.md divergence table), or an
+    explicit array."""
+    if window is None:
+        return hann_window(length, dtype)
+    # only str/tuple are window SPECS (scipy's convention) — a numeric
+    # list is window samples and falls through to the array path
+    if isinstance(window, (str, tuple)):
+        from veles.simd_tpu.ops.waveforms import get_window
+
+        return get_window(window, length).astype(dtype)
+    window = np.asarray(window, dtype)
+    if window.shape != (length,):
+        raise ValueError(f"window shape {window.shape} != ({length},)")
+    return window
+
+
 @functools.partial(jax.jit, static_argnames=("frame_length", "hop"))
 def _stft_xla(x, window, frame_length, hop):
     idx = jnp.asarray(_frame_indices(x.shape[-1], frame_length, hop))
@@ -105,12 +125,7 @@ def stft(x, frame_length: int, hop: int, window=None, simd=None):
     """
     x_np = np.asarray(x) if not hasattr(x, "shape") else x
     _check_stft_args(x_np.shape[-1], frame_length, hop)
-    if window is None:
-        window = hann_window(frame_length)
-    window = np.asarray(window, np.float32)
-    if window.shape != (frame_length,):
-        raise ValueError(f"window shape {window.shape} != "
-                         f"({frame_length},)")
+    window = _resolve_window(window, frame_length)
     if resolve_simd(simd):
         return _stft_xla(jnp.asarray(x, jnp.float32), jnp.asarray(window),
                          frame_length, hop)
@@ -121,10 +136,9 @@ def stft_na(x, frame_length: int, hop: int, window=None):
     """NumPy float64 oracle twin of :func:`stft` (complex128 out)."""
     x = np.asarray(x, np.float64)
     _check_stft_args(x.shape[-1], frame_length, hop)
-    if window is None:
-        window = hann_window(frame_length)
+    window = _resolve_window(window, frame_length, np.float64)
     idx = _frame_indices(x.shape[-1], frame_length, hop)
-    frames = x[..., idx] * np.asarray(window, np.float64)
+    frames = x[..., idx] * window
     return np.fft.rfft(frames, axis=-1)
 
 
@@ -165,9 +179,7 @@ def istft(spec, n: int, frame_length: int, hop: int, window=None,
     still returned, normalized by the partial envelope).
     """
     _check_stft_args(n, frame_length, hop)
-    if window is None:
-        window = hann_window(frame_length)
-    window = np.asarray(window, np.float32)
+    window = _resolve_window(window, frame_length)
     env_inv = _env_inv(n, frame_length, hop, window).astype(np.float32)
     frames = frame_count(n, frame_length, hop)
     spec_np = spec if hasattr(spec, "shape") else np.asarray(spec)
@@ -186,9 +198,7 @@ def istft(spec, n: int, frame_length: int, hop: int, window=None,
 def istft_na(spec, n: int, frame_length: int, hop: int, window=None):
     """NumPy float64 oracle twin of :func:`istft`."""
     _check_stft_args(n, frame_length, hop)
-    if window is None:
-        window = hann_window(frame_length)
-    window = np.asarray(window, np.float64)
+    window = _resolve_window(window, frame_length, np.float64)
     spec = np.asarray(spec)
     frames = np.fft.irfft(spec, frame_length, axis=-1) * window
     idx = _frame_indices(n, frame_length, hop)
@@ -366,11 +376,7 @@ def _welch_args(n, nperseg, noverlap, window):
     if not 0 <= noverlap < nperseg:
         raise ValueError(f"noverlap {noverlap} must be in [0, nperseg "
                          f"= {nperseg})")
-    if window is None:
-        window = hann_window(nperseg, np.float64)
-    window = np.asarray(window, np.float64)
-    if window.shape != (nperseg,):
-        raise ValueError(f"window shape {window.shape} != ({nperseg},)")
+    window = _resolve_window(window, nperseg, np.float64)
     return nperseg, nperseg - noverlap, window
 
 
@@ -474,8 +480,8 @@ def periodogram(x, fs: float = 1.0, window=None, scaling: str = "density",
     constant detrend by default).  Pass ``detrend_type=None`` to keep
     the raw DC bin."""
     n = np.shape(x)[-1]
-    if window is None:
-        window = np.ones(n, np.float64)
+    window = (np.ones(n, np.float64) if window is None
+              else _resolve_window(window, n, np.float64))
     use = resolve_simd(simd)
     f, p = _spectral_helper(x, x, float(fs), n, 0, window, detrend_type,
                             scaling, use)
@@ -488,8 +494,8 @@ def periodogram_na(x, fs: float = 1.0, window=None,
                    scaling: str = "density",
                    detrend_type: str = "constant"):
     n = np.shape(x)[-1]
-    if window is None:
-        window = np.ones(n, np.float64)
+    window = (np.ones(n, np.float64) if window is None
+              else _resolve_window(window, n, np.float64))
     f, p = _spectral_helper(x, x, float(fs), n, 0, window, detrend_type,
                             scaling, False)
     return f, np.real(p)

@@ -915,12 +915,7 @@ def sharded_stft(x, frame_length: int, hop: int, mesh: Mesh,
     n = x.shape[-1]
     n_shards = mesh.shape[axis]
     block, halo = _check_stft_sharding(n, frame_length, hop, n_shards)
-    if window is None:
-        window = sp.hann_window(frame_length)
-    window = jnp.asarray(np.asarray(window, np.float32))
-    if window.shape != (frame_length,):
-        raise ValueError(f"window shape {window.shape} != "
-                         f"({frame_length},)")
+    window = jnp.asarray(sp._resolve_window(window, frame_length))
     # per-shard framing layout == the single-chip layout on block + halo
     # samples (frame_count(block + halo, fl, hop) == block // hop)
     idx = jnp.asarray(sp._frame_indices(block + halo, frame_length, hop))
@@ -956,9 +951,7 @@ def sharded_istft(spec, n: int, frame_length: int, hop: int, mesh: Mesh,
 
     n_shards = mesh.shape[axis]
     block, halo = _check_stft_sharding(n, frame_length, hop, n_shards)
-    if window is None:
-        window = sp.hann_window(frame_length)
-    window_np = np.asarray(window, np.float32)
+    window_np = sp._resolve_window(window, frame_length)
     spec = jnp.asarray(spec, jnp.complex64)
     frames_total = sp.frame_count(n, frame_length, hop)
     if spec.shape[-2:] != (frames_total, frame_length // 2 + 1):
