@@ -992,3 +992,49 @@ class TestShardedLombScargle:
         np.testing.assert_allclose(got, want, atol=1e-3 * np.max(want))
         np.testing.assert_allclose(sp.lombscargle_na(t, x, freqs, w),
                                    want, atol=1e-10 * np.max(want))
+
+
+class TestShardedNormalize2d:
+    def test_matches_single_chip(self):
+        from veles.simd_tpu.ops import normalize as nm
+
+        mesh = par.make_mesh({"sp": 8})
+        rng = np.random.RandomState(90)
+        img = rng.randint(0, 256, (64, 48)).astype(np.uint8)
+        got = np.asarray(par.sharded_normalize2d(img, mesh))
+        want = np.asarray(nm.normalize2D(img, simd=True))
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_indivisible_rows_and_flat_plane(self):
+        from veles.simd_tpu.ops import normalize as nm
+
+        mesh = par.make_mesh({"sp": 8})
+        rng = np.random.RandomState(91)
+        img = rng.randint(0, 256, (61, 33)).astype(np.uint8)  # 61 % 8 != 0
+        got = np.asarray(par.sharded_normalize2d(img, mesh))
+        assert got.shape == (61, 33)
+        want = np.asarray(nm.normalize2D(img, simd=True))
+        np.testing.assert_allclose(got, want, atol=1e-6)
+        # max == min -> all zeros (the reference's rule)
+        flat = np.full((16, 8), 7, np.uint8)
+        np.testing.assert_array_equal(
+            np.asarray(par.sharded_normalize2d(flat, mesh)),
+            np.zeros((16, 8), np.float32))
+
+    def test_fewer_rows_than_shards_and_float_dtype(self):
+        """pad > h (wrap-padding must cover it) and a non-u8 plane
+        (the single-chip op accepts any numeric dtype — review
+        finding: the forced u8 cast wrecked float planes)."""
+        from veles.simd_tpu.ops import normalize as nm
+
+        mesh = par.make_mesh({"sp": 8})
+        rng = np.random.RandomState(92)
+        tiny = rng.randint(0, 256, (3, 12)).astype(np.uint8)  # 3 < 8
+        got = np.asarray(par.sharded_normalize2d(tiny, mesh))
+        want = np.asarray(nm.normalize2D(tiny, simd=True))
+        assert got.shape == (3, 12)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+        fimg = rng.randn(19, 7).astype(np.float32)
+        got = np.asarray(par.sharded_normalize2d(fimg, mesh))
+        want = np.asarray(nm.normalize2D(fimg, simd=True))
+        np.testing.assert_allclose(got, want, atol=1e-6)

@@ -58,6 +58,15 @@ def test_a2a_wavelet2d_smoke():
                                    atol=2e-4)
 
 
+def test_psum_normalize_smoke():
+    from veles.simd_tpu.ops import normalize as nm
+
+    img = (RNG.rand(32, 24) * 255).astype(np.uint8)
+    got = np.asarray(par.sharded_normalize2d(img, MESH))
+    want = np.asarray(nm.normalize2D(img, simd=True))
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
 def test_scan_sosfilt_smoke():
     sos = iir.butterworth(4, 0.2)
     x = RNG.randn(1024).astype(np.float32)

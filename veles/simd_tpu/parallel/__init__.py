@@ -89,6 +89,7 @@ from veles.simd_tpu.parallel.ops import (
     sharded_convolve, sharded_convolve2d, sharded_convolve2d_ring,
     sharded_convolve_batch, sharded_convolve_ring, sharded_istft,
     sharded_lombscargle, sharded_matmul, sharded_medfilt,
+    sharded_normalize2d,
     sharded_order_filter, sharded_resample_poly, sharded_savgol_filter,
     sharded_sosfilt, sharded_stft, sharded_welch,
     sharded_swt, sharded_swt_apply2d, sharded_swt_reconstruct,
@@ -114,5 +115,6 @@ __all__ = ["make_mesh", "default_mesh", "sharded_convolve",
            "sharded_matmul",
            "sharded_stft", "sharded_istft", "sharded_sosfilt",
            "sharded_welch", "sharded_resample_poly",
+           "sharded_normalize2d",
            "data_parallel", "halo_exchange_left", "halo_exchange_right",
            "distributed"]

@@ -1462,6 +1462,43 @@ def sharded_lombscargle(t, x, freqs, mesh: Mesh, axis: str = "sp",
     return _run(tj, xj, fj, wj)
 
 
+def sharded_normalize2d(src, mesh: Mesh, axis: str = "sp"):
+    """Row-sharded u8 plane → f32 [-1, 1] normalization — the
+    distributed form of the reference's ``normalize2D``
+    (``/root/reference/src/normalize.c:445-451``), closing the last
+    reference L4 component without a sharded twin.
+
+    Each shard reduces its row block, ONE ``pmin``/``pmax`` pair of
+    scalars rides the collective, and the normalize stays local —
+    collective payload is 2 floats regardless of the image size.
+    Preserves the reference's max==min → all-zeros rule, and (like the
+    single-chip op) accepts any numeric dtype, not just u8.  Rows are
+    padded to a shard multiple internally with wrapped copies of real
+    rows, which cannot perturb the global min/max — wrap also covers
+    fewer rows than shards.
+    """
+    src = np.asarray(src) if not hasattr(src, "dtype") else src
+    if src.ndim != 2:
+        raise ValueError("sharded_normalize2d shards one [h, w] plane")
+    h, w = src.shape
+    n_shards = mesh.shape[axis]
+    pad = (-h) % n_shards
+    srcj = jnp.asarray(src)
+    if pad:
+        srcj = jnp.pad(srcj, ((0, pad), (0, 0)), mode="wrap")
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=P(axis, None),
+                       out_specs=P(axis, None))
+    def _run(block):
+        v = block.astype(jnp.float32)
+        mn = jax.lax.pmin(jnp.min(v), axis)
+        mx = jax.lax.pmax(jnp.max(v), axis)
+        out = (v - mn) / ((mx - mn) / 2.0) - 1.0
+        return jnp.where(mx == mn, jnp.zeros_like(out), out)
+
+    return _run(srcj)[:h]
+
+
 def data_parallel(fn, mesh: Mesh, axis: str = "dp"):
     """Wrap a batched op so its leading batch axis is sharded over
     ``mesh[axis]`` — jit + sharding constraint, XLA partitions the rest.
