@@ -229,3 +229,69 @@ class TestModes:
         want = np.correlate(x.astype(np.float64), v.astype(np.float64),
                             mode="same")
         np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+class TestScipyNameAliases:
+    """fftconvolve / oaconvolve by their scipy names (round 5)."""
+
+    @pytest.mark.parametrize("mode", ["full", "same", "valid"])
+    def test_fftconvolve_matches_scipy(self, mode):
+        import scipy.signal as ss
+
+        rng = np.random.RandomState(95)
+        x = rng.randn(500).astype(np.float32)
+        h = rng.randn(37).astype(np.float32)
+        got = np.asarray(cv.fftconvolve(x, h, mode=mode, simd=True))
+        want = ss.fftconvolve(x.astype(np.float64),
+                              h.astype(np.float64), mode=mode)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want,
+                                   atol=1e-4 * np.abs(want).max())
+
+    def test_oaconvolve_long_signal(self):
+        import scipy.signal as ss
+
+        rng = np.random.RandomState(96)
+        x = rng.randn(1 << 14).astype(np.float32)
+        h = rng.randn(255).astype(np.float32)
+        got = np.asarray(cv.oaconvolve(x, h, mode="same", simd=True))
+        want = ss.oaconvolve(x.astype(np.float64),
+                             h.astype(np.float64), mode="same")
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want,
+                                   atol=1e-4 * np.abs(want).max())
+
+    def test_2d_kernel_routes_to_conv2d(self):
+        import scipy.signal as ss
+
+        rng = np.random.RandomState(97)
+        x = rng.randn(32, 40).astype(np.float32)
+        h = rng.randn(5, 7).astype(np.float32)
+        got = np.asarray(cv.fftconvolve(x, h, mode="same", simd=True))
+        want = ss.fftconvolve(x.astype(np.float64),
+                              h.astype(np.float64), mode="same")
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want,
+                                   atol=1e-4 * np.abs(want).max())
+
+    def test_oaconvolve_short_signal_falls_back(self):
+        """Sizes outside the overlap-save contract fall back to the
+        spectral path (scipy handles them; review finding)."""
+        import scipy.signal as ss
+
+        rng = np.random.RandomState(98)
+        x = rng.randn(100).astype(np.float32)
+        h = rng.randn(60).astype(np.float32)
+        got = np.asarray(cv.oaconvolve(x, h, simd=True))
+        want = ss.oaconvolve(x.astype(np.float64), h.astype(np.float64))
+        assert got.shape == want.shape == (159,)
+        np.testing.assert_allclose(got, want,
+                                   atol=1e-4 * np.abs(want).max())
+
+    def test_nd_kernel_rejected(self):
+        with pytest.raises(ValueError, match="rank 3"):
+            cv.fftconvolve(np.zeros((4, 5, 16), np.float32),
+                           np.zeros((4, 5, 3), np.float32))
+        with pytest.raises(ValueError, match="rank 3"):
+            cv.oaconvolve(np.zeros((4, 5, 16), np.float32),
+                          np.zeros((4, 5, 3), np.float32))

@@ -625,3 +625,28 @@ class TestFiltfiltBa:
                                       simd=True))
         want = ss.filtfilt(taps, [1.0], x.astype(np.float64), padlen=50)
         np.testing.assert_allclose(got, want, atol=2e-4)
+
+
+class TestLfilterZi:
+    def test_matches_scipy(self):
+        for b, a in (ss.butter(4, 0.2), ss.cheby1(3, 1, 0.3),
+                     ss.ellip(5, 0.5, 40, 0.25)):
+            np.testing.assert_allclose(iir.lfilter_zi(b, a),
+                                       ss.lfilter_zi(b, a), atol=1e-12)
+
+    def test_settled_step_response(self):
+        """lfilter seeded by zi*x[0] has no start-up transient — the
+        property the function exists for (host check via the oracle)."""
+        b, a = ss.butter(3, 0.1)
+        zi = iir.lfilter_zi(b, a)
+        y, _ = ss.lfilter(b, a, np.ones(100), zi=zi * 1.0)
+        np.testing.assert_allclose(y, np.ones(100), atol=1e-9)
+
+    def test_fir_only(self):
+        np.testing.assert_allclose(
+            iir.lfilter_zi([1.0, 0.5, 0.25], [1.0]),
+            ss.lfilter_zi([1.0, 0.5, 0.25], [1.0]), atol=1e-12)
+
+    def test_integrator_pole_raises(self):
+        with pytest.raises(ValueError, match="pole at z=1"):
+            iir.lfilter_zi([1.0], [1.0, -1.0])

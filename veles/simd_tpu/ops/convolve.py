@@ -60,6 +60,7 @@ __all__ = [
     "convolve_overlap_save", "convolve_overlap_save_initialize",
     "convolve_overlap_save_finalize",
     "convolve", "convolve_initialize", "convolve_finalize",
+    "fftconvolve", "oaconvolve",
     "overlap_save_block_length", "tpu_block_length", "select_algorithm",
     "os_precision", "StreamingConvolution",
 ]
@@ -588,6 +589,49 @@ def convolve(handle_or_x, x_or_h, h=None, simd=None, *, mode="full"):
 
 def convolve_finalize(handle):
     """No-op (``src/convolve.c:368-379``)."""
+
+
+def fftconvolve(x, h, mode: str = "full", simd=None):
+    """scipy's ``fftconvolve`` by name: convolution via the spectral
+    method.  1D taps (``h[k]``, leading batch dims on ``x`` ride
+    along) use the padded-rfft path; a 2D kernel routes to
+    :func:`veles.simd_tpu.ops.convolve2d.convolve2d` with the fft
+    algorithm; higher-rank kernels are rejected (scipy computes true
+    N-d convolution there — silently convolving one axis would be a
+    wrong answer, not a subset).  ``mode`` as in :func:`convolve`."""
+    if np.ndim(h) > 2:
+        raise ValueError(
+            f"kernels of rank {np.ndim(h)} are not supported (1D taps "
+            "or a 2D kernel; scipy's N-d fftconvolve has no equivalent "
+            "here)")
+    if np.ndim(h) == 2:
+        from veles.simd_tpu.ops import convolve2d as cv2
+
+        return cv2.convolve2d(x, h, algorithm="fft", simd=simd,
+                              mode=mode)
+    handle = convolve_fft_initialize(np.shape(x)[-1], np.shape(h)[-1])
+    return convolve(handle, x, h, simd=simd, mode=mode)
+
+
+def oaconvolve(x, h, mode: str = "full", simd=None):
+    """scipy's ``oaconvolve`` by name: block-overlap convolution for
+    long signals.  Runs the overlap-SAVE formulation (identical
+    results to scipy's overlap-add; this library's blocked method is
+    the MXU block-matmul / batched-frame-FFT overlap-save,
+    ``tools/tune_overlap_save.py``-tuned); a 2D kernel routes to the
+    2D fft path like :func:`fftconvolve`.  Sizes outside the blocked
+    method's contract (short signals / long kernels, where blocking
+    buys nothing) fall back to :func:`fftconvolve`, as scipy's
+    oaconvolve does internally."""
+    if np.ndim(h) == 1:
+        try:
+            handle = convolve_overlap_save_initialize(
+                np.shape(x)[-1], np.shape(h)[-1])
+        except ValueError:
+            return fftconvolve(x, h, mode=mode, simd=simd)
+        return convolve(handle, x, h, simd=simd, mode=mode)
+    # 2D routes to the spectral 2D path; rank > 2 is rejected there
+    return fftconvolve(x, h, mode=mode, simd=simd)
 
 
 # --------------------------------------------------------------------------

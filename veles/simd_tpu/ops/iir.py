@@ -54,7 +54,7 @@ __all__ = [
     "sosfilt_na",
     "sosfiltfilt", "sosfiltfilt_na", "lfilter", "lfilter_na",
     "sos_frequency_response", "frequency_response", "sosfilt_zi",
-    "StreamingSosfilt",
+    "lfilter_zi", "StreamingSosfilt",
 ]
 
 
@@ -1171,6 +1171,36 @@ def _normalize_ba(b, a):
     if a[0] == 0.0:
         raise ValueError("a[0] must be nonzero")
     return b / a[0], a / a[0]
+
+
+def lfilter_zi(b, a) -> np.ndarray:
+    """Steady-state DF2T state for a unit step input (scipy's
+    ``lfilter_zi``): scale by the signal's edge value to start
+    ``lfilter`` "already settled".  Host-side float64 closed form —
+    the transposed-direct-form state recurrence at steady state
+    ``z = A z + B`` solved as ``(I - A) z = B``, exactly scipy's
+    companion-matrix construction.
+    """
+    b, a = _normalize_ba(b, a)
+    n = max(len(a), len(b))
+    a = np.concatenate([a, np.zeros(n - len(a))])
+    b = np.concatenate([b, np.zeros(n - len(b))])
+    if n == 1:
+        return np.zeros(0)
+    # DF2T state update for constant input x=1, output y:
+    #   z_i = b_{i+1} - a_{i+1} y + z_{i+1}   (z_n = 0)
+    # with steady y = sum(b)/sum(a); solve directly by back-substitution
+    if a.sum() == 0.0:
+        raise ValueError(
+            "filter has a pole at z=1 (sum(a) == 0): no steady state "
+            "exists for lfilter_zi (scipy raises LinAlgError here)")
+    y = b.sum() / a.sum()
+    zi = np.zeros(n - 1)
+    acc = 0.0
+    for i in range(n - 2, -1, -1):
+        acc += b[i + 1] - a[i + 1] * y
+        zi[i] = acc
+    return zi
 
 
 @functools.partial(jax.jit, static_argnames=("b_key", "a_key"))
