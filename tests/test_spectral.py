@@ -491,3 +491,16 @@ class TestWindowByName:
         f2, p2 = sp.welch(x, nperseg=64,
                           window=np.ones(64, np.float64), simd=True)
         np.testing.assert_allclose(np.asarray(p1), np.asarray(p2))
+
+
+def test_detrend_axis_parameter():
+    """axis= moves the detrend off the last axis (scipy parity)."""
+    rng = np.random.RandomState(19)
+    x = rng.randn(6, 500).astype(np.float32)
+    got = np.asarray(sp.detrend(x.T.copy(), "linear", simd=True, axis=0))
+    want = ss.detrend(x.T.astype(np.float64), type="linear", axis=0)
+    np.testing.assert_allclose(got, want, atol=2e-5)
+    got = np.asarray(sp.detrend(x.T.copy(), "constant", simd=False,
+                                axis=0))
+    want = ss.detrend(x.T.astype(np.float64), type="constant", axis=0)
+    np.testing.assert_allclose(got, want, atol=1e-6)

@@ -329,14 +329,19 @@ def morlet_cwt_na(x, scales, w0: float = 6.0):
 # ---------------------------------------------------------------------------
 
 
-def detrend(x, type: str = "linear", simd=None):  # noqa: A002
-    """Remove a constant or least-squares linear trend along the last
-    axis (scipy's ``detrend``).  The linear projection is a host-side
-    closed form (2-column Vandermonde pseudo-inverse), applied as one
-    matmul on device."""
+def detrend(x, type: str = "linear", simd=None,  # noqa: A002
+            axis: int = -1):
+    """Remove a constant or least-squares linear trend along ``axis``
+    (scipy's ``detrend``; default last axis).  The linear projection is
+    a host-side closed form (2-column Vandermonde pseudo-inverse),
+    applied as one matmul on device."""
     if type not in ("linear", "constant"):
         raise ValueError(f"type must be 'linear' or 'constant', "
                          f"got {type!r}")
+    if axis not in (-1, np.ndim(x) - 1):
+        xp = jnp if resolve_simd(simd) else np
+        moved = xp.moveaxis(xp.asarray(x), axis, -1)
+        return xp.moveaxis(detrend(moved, type, simd=simd), -1, axis)
     n = np.shape(x)[-1]
     if resolve_simd(simd):
         xj = jnp.asarray(x, jnp.float32)
