@@ -411,3 +411,50 @@ class TestRemez:
             fl.remez(33, [0, 0.2, 0.3, 0.5], [1, 0], weight=[1, -1])
         with pytest.raises(ValueError, match="Nyquist|zero gain"):
             fl.remez(32, [0, 0.2, 0.3, 0.5], [1, 1])
+
+
+class TestRankNetwork:
+    """The Batcher compare-exchange path must agree with the sort path
+    and scipy across ranks and window sizes (round-5 fast path)."""
+
+    @pytest.mark.parametrize("k", [3, 5, 7, 9, 15, 21, 31])
+    def test_every_rank_matches_sort(self, k):
+        rng = np.random.RandomState(k)
+        x = rng.randn(4, 257).astype(np.float32)
+        for rank in (0, k // 2, k - 1):
+            got = np.asarray(fl.order_filter(x, rank, k, simd=True))
+            want = fl.order_filter_na(x, rank, k)
+            np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_large_k_uses_sort_path(self):
+        rng = np.random.RandomState(99)
+        x = rng.randn(300).astype(np.float32)
+        got = np.asarray(fl.medfilt(x, 35, simd=True))   # 35 > 32
+        np.testing.assert_allclose(got, fl.medfilt_na(x, 35), atol=1e-6)
+
+    def test_medfilt2d_network_vs_scipy(self):
+        import scipy.signal as ss
+
+        rng = np.random.RandomState(100)
+        img = rng.randn(31, 45).astype(np.float32)
+        for k in (3, 5):
+            got = np.asarray(fl.medfilt2d(img, k, simd=True))
+            want = ss.medfilt2d(img, k)
+            np.testing.assert_allclose(got, want, atol=1e-6)
+        got = np.asarray(fl.medfilt2d(img, 7, simd=True))  # 49 > 32
+        np.testing.assert_allclose(got, ss.medfilt2d(img, 7), atol=1e-6)
+
+    def test_nan_semantics_match_sort_path(self):
+        """NaNs order last (jnp.sort semantics) on the network path too
+        — review finding: raw min/max smeared NaN across the window."""
+        x = np.array([1, np.nan, 2, 3, 4], np.float32)
+        got = np.asarray(fl.medfilt(x, 3, simd=True))
+        win = fl._window_view_1d(x, 3, np)
+        want = np.sort(win, axis=-1)[..., 1].astype(np.float32)
+        np.testing.assert_array_equal(got, want)
+        # all-NaN window -> NaN out (rank beyond the non-NaN count)
+        xa = np.array([np.nan, np.nan, np.nan, 1.0], np.float32)
+        got = np.asarray(fl.medfilt(xa, 3, simd=True))
+        wina = fl._window_view_1d(xa, 3, np)
+        wanta = np.sort(wina, axis=-1)[..., 1].astype(np.float32)
+        np.testing.assert_array_equal(got, wanta)
