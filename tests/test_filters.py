@@ -458,3 +458,15 @@ class TestRankNetwork:
         wina = fl._window_view_1d(xa, 3, np)
         wanta = np.sort(wina, axis=-1)[..., 1].astype(np.float32)
         np.testing.assert_array_equal(got, wanta)
+
+    def test_wiener_large_window_fallback(self):
+        """mysize > the lane cap takes the window-matrix path; parity
+        with scipy must hold on both."""
+        import scipy.signal as ss
+
+        rng = np.random.RandomState(101)
+        x = rng.randn(400).astype(np.float32)
+        for k in (31, 35):          # straddle _RANK_NETWORK_MAX_K
+            got = np.asarray(fl.wiener(x, k, simd=True))
+            want = ss.wiener(x.astype(np.float64), k)
+            np.testing.assert_allclose(got, want, atol=1e-4)

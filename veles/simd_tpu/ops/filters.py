@@ -67,6 +67,19 @@ def _check_kernel(kernel_size: int, what: str = "kernel_size") -> int:
     return kernel_size
 
 
+def _shifted_lanes_1d(x, k):
+    """k shifted full-signal views of the zero-padded input — the
+    lane form of :func:`_window_view_1d` (lane j at sample i equals
+    window element [i, j]).  The single home for the pad-and-slice
+    construction the rank and Wiener fast paths share."""
+    half = k // 2
+    pad = [(0, 0)] * (x.ndim - 1) + [(half, half)]
+    xpad = jnp.pad(x, pad)
+    n = x.shape[-1]
+    return [jax.lax.slice_in_dim(xpad, j, j + n, axis=-1)
+            for j in range(k)]
+
+
 def _window_view_1d(x, k, xp):
     """Zero-padded sliding windows ``[..., n, k]`` (scipy medfilt pads
     with zeros on both sides)."""
@@ -145,13 +158,7 @@ def _rank_filter_xla(x, k, rank):
     # k shifted full-signal slices; run the sorting network on the
     # slice LIST (k vectors), then take the rank-th — everything is
     # elementwise min/max on [..., n] vectors, XLA fuses the lot
-    half = k // 2
-    pad = [(0, 0)] * (x.ndim - 1) + [(half, half)]
-    xpad = jnp.pad(x, pad)
-    n = x.shape[-1]
-    lanes = [jax.lax.slice_in_dim(xpad, j, j + n, axis=-1)
-             for j in range(k)]
-    return _apply_rank_network(lanes, rank)
+    return _apply_rank_network(_shifted_lanes_1d(x, k), rank)
 
 
 def order_filter(x, rank: int, kernel_size: int, simd=None):
@@ -537,9 +544,20 @@ def _wiener_core(x, k, noise, xp):
     # if the XLA simplifier reassociates (observed: a decomposed
     # centered-cumsum formulation was re-fused into the cancelling form
     # under jit on the CPU backend).
-    win = _window_view_1d(x, k, xp)
-    mean = xp.mean(win, axis=-1)
-    var = xp.mean((win - mean[..., None]) ** 2, axis=-1)
+    if xp is jnp and k <= _RANK_NETWORK_MAX_K:
+        # k shifted full-signal slices (the medfilt trick): the local
+        # mean/variance are k fused adds each — no [..., n, k] window
+        # matrix through HBM.  Same demeaned arithmetic as the gather
+        # form below, term for term.  Same size cap as the rank
+        # network: beyond it the unrolled program and the serial f32
+        # accumulation both grow with k, so the window matrix wins.
+        lanes = _shifted_lanes_1d(x, k)
+        mean = sum(lanes) / k
+        var = sum((ln - mean) ** 2 for ln in lanes) / k
+    else:
+        win = _window_view_1d(x, k, xp)
+        mean = xp.mean(win, axis=-1)
+        var = xp.mean((win - mean[..., None]) ** 2, axis=-1)
     if noise is None:
         noise = xp.mean(var, axis=-1, keepdims=True)
     excess = xp.maximum(var - noise, 0.0)
@@ -560,9 +578,10 @@ def wiener(x, mysize: int = 3, noise=None, simd=None):
     pulled toward its local mean by the fraction of the local variance
     the noise explains — flat regions are smoothed hard, busy regions
     are left alone.  ``noise`` defaults to the mean of the local
-    variances (scipy's estimate).  The local statistics are two
-    cumsum-differenced box sums on globally-centered data, one jitted
-    XLA program.
+    variances (scipy's estimate).  The local statistics are windowed
+    demeaned sums — shifted-slice lanes for ``mysize`` <=
+    ``_RANK_NETWORK_MAX_K``, the gathered window matrix beyond — in
+    one jitted XLA program (formulation rationale in ``_wiener_core``).
     """
     mysize = _check_kernel(mysize, "mysize")
     if resolve_simd(simd):

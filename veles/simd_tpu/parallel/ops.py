@@ -1280,10 +1280,12 @@ def sharded_order_filter(x, rank: int, kernel_size: int, mesh: Mesh,
                          axis: str = "sp"):
     """Sequence-parallel rank-order filter: pure halo exchange — each
     shard fetches ``k // 2`` neighbour samples per side and runs the
-    single-chip gather+sort kernel on its extended block.  Global edge
-    shards receive zeros from the open ``ppermute``, which is exactly
-    the single-chip zero-padding, so the result is bitwise the
-    single-chip :func:`veles.simd_tpu.ops.filters.order_filter`.
+    single-chip rank kernel on its extended block (the Batcher
+    compare-exchange network for ``k`` <= 32, gather+sort beyond).
+    Global edge shards receive zeros from the open ``ppermute``, which
+    is exactly the single-chip zero-padding, so the result is bitwise
+    the single-chip :func:`veles.simd_tpu.ops.filters.order_filter`
+    (both sides run the identical kernel).
     """
     from veles.simd_tpu.ops import filters as fl
 
