@@ -114,6 +114,7 @@ def _use_pallas_direct2d(x_shape, k0: int, k1: int) -> bool:
     return (_pk.pallas_available()
             and _pk.pallas2d_compiled_allowed()
             and k0 * k1 <= _pk.PALLAS_2D_MAX_KERNEL_AREA
+            and _oom_key(x_shape, k0, k1) not in _PALLAS2D_OOM_REJECTED
             and _pk.fits_vmem2d(n0e * n1e, out_elems, k0 * k1))
 
 
@@ -160,10 +161,51 @@ def _check2d(x, h):
             f"{np.shape(h)}")
 
 
+# Shape classes the compiled 2D kernel failed to compile for (Mosaic
+# scoped-vmem OOM — unpredictable from shape arithmetic, see
+# pallas_kernels.fits_vmem2d).  Keyed on (batch_rows, n0, n1, k0, k1):
+# the OOM outcome depends on the per-tile row count, so batch variants
+# of an image/kernel shape are cached independently.  Consulted by
+# _use_pallas_direct2d so a shape only pays the failed compile once.
+_PALLAS2D_OOM_REJECTED = set()
+
+# Scoped-stack model used ONLY for calls traced under an outer jit,
+# where the Mosaic compile error surfaces at the OUTER compile and the
+# empirical try/except below cannot catch it.  The observed compile
+# outcomes (live v5e, 2026-07-31) separate on per-tile output SIZE,
+# not total volume: 1x128^2 k15 (out tile 80KB, 225 * 80KB = 18M)
+# FAILS — small tiles get one fully-materialized temp per unrolled MAC
+# — while 8x512^2 k9 (out tile 1.08MB, 87M by the same product)
+# COMPILES and wins 6.5x, consistent with Mosaic windowing large
+# tiles internally.  So the traced rejection fires only in the
+# small-tile regime: out_tile <= _TRACED_SMALL_TILE_BYTES AND
+# area * out_tile > _TRACED_SCOPED_BUDGET_BYTES.  Eager calls skip
+# this model entirely and rely on the catchable-OOM fallback.
+_TRACED_SCOPED_BUDGET_BYTES = 14 << 20
+_TRACED_SMALL_TILE_BYTES = 512 << 10
+
+
+def _oom_key(x_shape, k0, k1):
+    rows = int(np.prod(x_shape[:-2])) if len(x_shape) > 2 else 1
+    return (rows, x_shape[-2], x_shape[-1], k0, k1)
+
+
+def _is_mosaic_vmem_oom(e: Exception) -> bool:
+    """Match Mosaic's scoped-vmem compile failures, e.g. (observed live
+    2026-07-31): "Ran out of memory in memory space vmem while
+    allocating on stack for %_f2d_call... Scoped allocation with size
+    22.34M and limit 16.00M" / "Ran out of memory in memory space
+    vmem. Used 160.14M of 128.00M" — pinned by a unit test."""
+    msg = str(e).lower()
+    return "vmem" in msg and ("ran out of memory" in msg
+                              or "scoped" in msg)
+
+
 def _run2d(x, h, reverse, algorithm, simd):
     _check2d(x, h)
     k0, k1 = np.shape(h)[-2:]
-    if algorithm is None:
+    auto = algorithm is None
+    if auto:
         algorithm = select_algorithm2d(k0, k1, np.shape(x))
     if algorithm not in ("direct", "fft"):
         raise ValueError(f"algorithm must be 'direct' or 'fft', "
@@ -171,9 +213,31 @@ def _run2d(x, h, reverse, algorithm, simd):
     if resolve_simd(simd):
         x, h = jnp.asarray(x), jnp.asarray(h)
         if algorithm == "direct":
-            if _use_pallas_direct2d(x.shape, k0, k1):
-                return _conv2d_direct_pallas(x, h, reverse=reverse)
-            return _conv2d_direct(x, h, reverse=reverse)
+            use_pallas = _use_pallas_direct2d(x.shape, k0, k1)
+            if use_pallas and isinstance(x, jax.core.Tracer):
+                # under an outer jit the Mosaic compile error surfaces
+                # at the OUTER compile — uncatchable here — so traced
+                # calls get the static small-tile model instead of
+                # the empirical fallback (constant note above)
+                out_tile = (x.shape[-2] + k0 - 1) * (x.shape[-1]
+                                                     + k1 - 1) * 4
+                use_pallas = not (
+                    out_tile <= _TRACED_SMALL_TILE_BYTES
+                    and k0 * k1 * out_tile
+                    > _TRACED_SCOPED_BUDGET_BYTES)
+                if not use_pallas and auto:
+                    algorithm = "fft"
+            if use_pallas:
+                try:
+                    return _conv2d_direct_pallas(x, h, reverse=reverse)
+                except Exception as e:  # Mosaic scoped-vmem OOM only
+                    if not _is_mosaic_vmem_oom(e):
+                        raise
+                    _PALLAS2D_OOM_REJECTED.add(_oom_key(x.shape, k0, k1))
+                    if auto:      # re-route as the gate would have
+                        algorithm = "fft"
+            if algorithm == "direct":
+                return _conv2d_direct(x, h, reverse=reverse)
         m0 = next_highest_power_of_2(x.shape[-2] + k0 - 1)
         m1 = next_highest_power_of_2(x.shape[-1] + k1 - 1)
         return _conv2d_fft(x, h, m0, m1, reverse=reverse)
