@@ -504,3 +504,17 @@ def test_detrend_axis_parameter():
                                 axis=0))
     want = ss.detrend(x.T.astype(np.float64), type="constant", axis=0)
     np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_take_frames_paths_agree():
+    """The reshape fast path, its r-bound gather fallback, and the
+    non-dividing gather must produce identical frame matrices."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(21)
+    x = rng.randn(3, 700).astype(np.float32)
+    for fl, hop in ((64, 16), (64, 64), (60, 20), (64, 1),  # r=1024>16
+                    (65, 13), (64, 48)):                    # non-dividing
+        got = np.asarray(sp._take_frames(jnp.asarray(x), fl, hop))
+        idx = sp._frame_indices(700, fl, hop)
+        np.testing.assert_array_equal(got, x[..., idx])

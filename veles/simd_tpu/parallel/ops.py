@@ -927,7 +927,11 @@ def sharded_stft(x, frame_length: int, hop: int, mesh: Mesh,
     def _run(x_local):
         halo_part = halo_exchange_right(x_local, halo, axis)
         x_ext = jnp.concatenate([x_local, halo_part], axis=-1)
-        frames = jnp.take(x_ext, idx, axis=-1) * window
+        # the reshape-interleave framing (99x over the row gather on
+        # dividing hops, sp._take_frames); slice to the uniform
+        # per-shard frame count the layout math above established
+        frames = sp._take_frames(x_ext, frame_length, hop)
+        frames = frames[..., :idx.shape[0], :] * window
         return jnp.fft.rfft(frames, axis=-1)
 
     out = _run(x)
@@ -1119,7 +1123,8 @@ def sharded_welch(x, mesh: Mesh, axis: str = "sp", fs: float = 1.0,
     def _run(x_local):
         halo_part = halo_exchange_right(x_local, halo, axis)
         x_ext = jnp.concatenate([x_local, halo_part], axis=-1)
-        segs = jnp.take(x_ext, idx, axis=-1)
+        segs = sp._take_frames(x_ext, nperseg_c,
+                               hop)[..., :idx.shape[0], :]
         segs = segs - jnp.mean(segs, axis=-1, keepdims=True)
         fx = jnp.fft.rfft(segs * window_j, axis=-1)
         # mask the trailing frames that overhang the global signal end
