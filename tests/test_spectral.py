@@ -513,8 +513,10 @@ def test_take_frames_paths_agree():
 
     rng = np.random.RandomState(21)
     x = rng.randn(3, 700).astype(np.float32)
-    for fl, hop in ((64, 16), (64, 64), (60, 20), (64, 1),  # r=1024>16
-                    (65, 13), (64, 48)):                    # non-dividing
+    for fl, hop in ((64, 16), (64, 64), (60, 20),
+                    (64, 1),     # dividing but r=64 > 16 -> gather
+                    (65, 13),    # dividing, r=5 fast path, odd fl
+                    (64, 48)):   # non-dividing -> gather
         got = np.asarray(sp._take_frames(jnp.asarray(x), fl, hop))
         idx = sp._frame_indices(700, fl, hop)
         np.testing.assert_array_equal(got, x[..., idx])

@@ -192,13 +192,37 @@ def _env_inv(n, frame_length, hop, window):
     return np.where(env > 1e-8, 1.0 / np.maximum(env, 1e-8), 0.0)
 
 
+def _overlap_add(frames, n, frame_length, hop):
+    """``[..., F, frame_length] -> [..., n]`` overlap-add — the adjoint
+    of :func:`_take_frames`, with the same decomposition: for dividing
+    hops, frames of one residue class mod ``r`` tile WITHOUT overlap,
+    so each class is a reshape placed at its offset and the scatter
+    becomes ``r`` full-length adds (the ``.at[].add`` scatter was the
+    whole ISTFT cost on v5e: 4,758 of 4,800 us at 128k/1024/256).
+    Other hops keep the scatter."""
+    F = frames.shape[-2]
+    r = frame_length // hop if frame_length % hop == 0 else 0
+    if not 1 <= r <= 16:
+        idx = jnp.asarray(_frame_indices(n, frame_length, hop))
+        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        return out.at[..., idx].add(frames)
+    total = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+    for o in range(r):
+        c_o = max(0, -(-(F - o) // r))
+        if c_o == 0:
+            continue
+        g = frames[..., o::r, :][..., :c_o, :]
+        seg = g.reshape(frames.shape[:-2] + (c_o * frame_length,))
+        padw = ([(0, 0)] * (seg.ndim - 1)
+                + [(o * hop, n - o * hop - c_o * frame_length)])
+        total = total + jnp.pad(seg, padw)
+    return total
+
+
 @functools.partial(jax.jit, static_argnames=("n", "frame_length", "hop"))
 def _istft_xla(spec, window, env_inv, n, frame_length, hop):
     frames = jnp.fft.irfft(spec, frame_length, axis=-1) * window
-    idx = jnp.asarray(_frame_indices(n, frame_length, hop))
-    out = jnp.zeros(spec.shape[:-2] + (n,), jnp.float32)
-    out = out.at[..., idx].add(frames)
-    return out * env_inv
+    return _overlap_add(frames, n, frame_length, hop) * env_inv
 
 
 def istft(spec, n: int, frame_length: int, hop: int, window=None,

@@ -918,7 +918,7 @@ def sharded_stft(x, frame_length: int, hop: int, mesh: Mesh,
     window = jnp.asarray(sp._resolve_window(window, frame_length))
     # per-shard framing layout == the single-chip layout on block + halo
     # samples (frame_count(block + halo, fl, hop) == block // hop)
-    idx = jnp.asarray(sp._frame_indices(block + halo, frame_length, hop))
+    frames_local = sp.frame_count(block + halo, frame_length, hop)
     in_spec = P(*([None] * (x.ndim - 1) + [axis]))
     out_spec = P(*([None] * (x.ndim - 1) + [axis, None]))
 
@@ -931,7 +931,7 @@ def sharded_stft(x, frame_length: int, hop: int, mesh: Mesh,
         # dividing hops, sp._take_frames); slice to the uniform
         # per-shard frame count the layout math above established
         frames = sp._take_frames(x_ext, frame_length, hop)
-        frames = frames[..., :idx.shape[0], :] * window
+        frames = frames[..., :frames_local, :] * window
         return jnp.fft.rfft(frames, axis=-1)
 
     out = _run(x)
@@ -971,7 +971,6 @@ def sharded_istft(spec, n: int, frame_length: int, hop: int, mesh: Mesh,
         spec = jnp.pad(spec, [(0, 0)] * (spec.ndim - 2)
                        + [(0, pad_frames), (0, 0)])
     window_j = jnp.asarray(window_np)
-    idx = jnp.asarray(sp._frame_indices(block + halo, frame_length, hop))
     in_spec = P(*([None] * (spec.ndim - 2) + [axis, None]))
     out_spec = P(*([None] * (spec.ndim - 2) + [axis]))
 
@@ -980,9 +979,9 @@ def sharded_istft(spec, n: int, frame_length: int, hop: int, mesh: Mesh,
     def _run(spec_local):
         frames = jnp.fft.irfft(spec_local, frame_length,
                                axis=-1) * window_j
-        buf = jnp.zeros(spec_local.shape[:-2] + (block + halo,),
-                        jnp.float32)
-        buf = buf.at[..., idx].add(frames)
+        # the decomposed overlap-add (sp._overlap_add, 52x over the
+        # .at[].add scatter on dividing hops) on the local block+halo
+        buf = sp._overlap_add(frames, block + halo, frame_length, hop)
         overflow = buf[..., block:]  # [..., halo] — right neighbour's head
         n_sh = jax.lax.axis_size(axis)
         recv = jax.lax.ppermute(overflow, axis,
@@ -1114,7 +1113,6 @@ def sharded_welch(x, mesh: Mesh, axis: str = "sp", fs: float = 1.0,
         jnp.float32)
     freqs = np.fft.rfftfreq(nperseg_c, 1.0 / fs)
     window_j = jnp.asarray(window_np, jnp.float32)
-    idx = jnp.asarray(sp._frame_indices(block + halo, nperseg_c, hop))
     in_spec = P(*([None] * (x.ndim - 1) + [axis]))
     out_spec = P(*([None] * (x.ndim - 1) + [None]))
 
@@ -1124,7 +1122,7 @@ def sharded_welch(x, mesh: Mesh, axis: str = "sp", fs: float = 1.0,
         halo_part = halo_exchange_right(x_local, halo, axis)
         x_ext = jnp.concatenate([x_local, halo_part], axis=-1)
         segs = sp._take_frames(x_ext, nperseg_c,
-                               hop)[..., :idx.shape[0], :]
+                               hop)[..., :frames_per_shard, :]
         segs = segs - jnp.mean(segs, axis=-1, keepdims=True)
         fx = jnp.fft.rfft(segs * window_j, axis=-1)
         # mask the trailing frames that overhang the global signal end
