@@ -6,7 +6,7 @@
 PYTHON ?= python
 
 .PHONY: all tests tests-quick benchmarks bench cshim cshim-check wavelet-tables lint \
-        docs install install-hooks clean
+        docs obs-report install install-hooks clean
 
 all: cshim
 
@@ -39,6 +39,12 @@ lint:
 
 docs:
 	$(PYTHON) tools/gen_docs.py
+
+# pretty-print a saved telemetry snapshot (obs.save(...) output or a
+# bench.py BENCH_DETAILS.json); override with SNAPSHOT=path
+SNAPSHOT ?= BENCH_DETAILS.json
+obs-report:
+	$(PYTHON) tools/obs_report.py $(SNAPSHOT)
 
 # Installs the commit gate: `make tests-quick` must be green before any
 # code commit (round-4 postmortem: snapshot 8182983 landed red at HEAD).

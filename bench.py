@@ -44,8 +44,29 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+from veles.simd_tpu import obs
 from veles.simd_tpu.utils.benchmark import (
     device_time_chained, host_time, rms_normalize)
+
+
+def _telemetry_entry():
+    """Compact per-config telemetry for BENCH_DETAILS.json: which
+    algorithms were picked, how many compiles ran, whether the
+    persistent cache served them — the attribution record that turns a
+    bench regression from "slower" into "took a different path"."""
+    from veles.simd_tpu.obs.export import flatten_counters
+
+    snap = obs.snapshot()
+    decisions = [{k: v for k, v in e.items() if v is not None}
+                 for e in snap["events"]]
+    return {
+        "decisions": decisions[-16:],
+        "counters": flatten_counters(snap),
+        "compiles": obs.counter_value("compile.backend_compile"),
+        "cache_hits": obs.counter_value("compile.cache_hits"),
+        "cache_misses": obs.counter_value("compile.cache_misses"),
+        "events_dropped": snap["events_dropped"],
+    }
 
 
 def bench_elementwise(rng):
@@ -272,12 +293,20 @@ def main():
                  else 1)
 
     device = str(jax.devices()[0])
+    # telemetry ON for the whole run: every BENCH_DETAILS.json entry
+    # carries the algorithm decisions / compile counts behind its number
+    obs.enable()
+    obs.reset()
     rng = np.random.RandomState(0)
     results = []
 
     def flush(r):
         r["vs_baseline"] = r["value"] / r["baseline"]
         r["device"] = device
+        # per-config telemetry (reset right after, so each entry's
+        # decisions/compiles are attributable to that config alone)
+        r["telemetry"] = _telemetry_entry()
+        obs.reset()
         # device_time_chained returns NaN for unresolvable measurements;
         # NaN is not valid strict JSON, so flag it and null the numbers
         if not all(np.isfinite(r[k]) for k in ("value", "baseline",
@@ -301,6 +330,7 @@ def main():
     # everything after this point is gravy if the device window closes
     dog.stage("warmup")
     _warm_device()
+    obs.reset()  # warmup compiles are not the headline's to report
     dog.stage("headline:convolve_1m")
     head = flush(bench_convolve_1m(rng))
     print(json.dumps({
@@ -318,6 +348,9 @@ def main():
     # the smoke, which under the old ordering cost configs 1/2/3/5.
     for fn in (bench_elementwise, bench_mathfun, bench_sgemm, bench_dwt):
         dog.stage(f"config:{fn.__name__}")
+        # a FAILED config never reaches flush()'s reset — drop its
+        # events here so they can't masquerade as the next config's
+        obs.reset()
         try:
             flush(fn(rng))
         except Exception as e:  # noqa: BLE001

@@ -29,7 +29,10 @@ jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
-from jax import shard_map  # noqa: E402
+try:
+    from jax import shard_map  # noqa: E402
+except ImportError:  # jax < 0.5 keeps shard_map in experimental
+    from jax.experimental.shard_map import shard_map  # noqa: E402
 from jax.sharding import PartitionSpec as P  # noqa: E402
 
 

@@ -74,6 +74,22 @@ def test_host_time_measures_wall():
     assert t > 0
 
 
+def test_sync_handles_empty_and_non_array_pytrees():
+    from veles.simd_tpu.utils.benchmark import _sync
+
+    # empty pytrees: nothing to wait on, must return cleanly (was an
+    # IndexError on leaves[-1])
+    for empty in (None, {}, [], ()):
+        assert _sync(empty) is None
+    # non-array leaves (host metadata riding in a result dict) are
+    # skipped; the sync still lands on the last ARRAY leaf
+    out = {"meta": "label", "n": 3, "y": jnp.arange(4.0)}
+    assert _sync(out) is None
+    assert _sync({"only": "host", "values": 7}) is None
+    # 0-sized array leaves must not IndexError either
+    assert _sync(jnp.zeros((0,), jnp.float32)) is None
+
+
 def test_burst_device_time_still_works():
     # legacy path (documented as jitter-limited, still exported)
     x = jnp.zeros((128,), jnp.float32)

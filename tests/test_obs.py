@@ -1,0 +1,346 @@
+"""Tests for the runtime telemetry subsystem (``veles.simd_tpu.obs``).
+
+Four contracts pinned here:
+
+* the registry is thread-safe and the event log is bounded;
+* both export formats (JSON, Prometheus text) round-trip;
+* every ``select_algorithm`` threshold boundary records a decision
+  event naming the algorithm actually selected;
+* telemetry on or off, traced programs are byte-identical — the whole
+  layer lives strictly at the Python dispatch layer.
+"""
+
+import concurrent.futures
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from veles.simd_tpu import obs
+from veles.simd_tpu.obs import export as obs_export
+from veles.simd_tpu.obs.events import DEFAULT_MAX_EVENTS, EventLog
+from veles.simd_tpu.obs.registry import MetricsRegistry
+from veles.simd_tpu.ops import convolve as cv
+from veles.simd_tpu.ops import spectral as sp
+from veles.simd_tpu.ops import wavelet as wv
+from veles.simd_tpu.ops.wavelet_coeffs import WaveletType
+
+RNG = np.random.RandomState(0)
+
+
+@pytest.fixture
+def telemetry():
+    """Telemetry ON (with the jax.monitoring bridge), clean slate, and a
+    guaranteed return to the disabled default afterwards."""
+    obs.enable()
+    obs.reset()
+    yield obs
+    obs.disable()
+    obs.reset()
+    obs.configure(max_events=DEFAULT_MAX_EVENTS)
+
+
+# --------------------------------------------------------------------------
+# registry / event log primitives
+# --------------------------------------------------------------------------
+
+
+def test_registry_thread_safety_under_concurrent_increments():
+    reg = MetricsRegistry()
+    threads, per_thread = 8, 2000
+
+    def worker(_):
+        for _ in range(per_thread):
+            reg.count("hammered", op="x")
+        return True
+
+    with concurrent.futures.ThreadPoolExecutor(threads) as ex:
+        assert all(ex.map(worker, range(threads)))
+    assert reg.counter_value("hammered", op="x") == threads * per_thread
+
+
+def test_obs_facade_thread_safety(telemetry):
+    threads, per_thread = 8, 1000
+    obs.configure(max_events=threads * per_thread)
+
+    def worker(i):
+        for _ in range(per_thread):
+            obs.count("facade.hammered")
+            obs.record_decision("op", "path", worker=i)
+        return True
+
+    with concurrent.futures.ThreadPoolExecutor(threads) as ex:
+        assert all(ex.map(worker, range(threads)))
+    assert obs.counter_value("facade.hammered") == threads * per_thread
+    # every recorded event survived into the (large enough) ring intact
+    evs = obs.events()
+    assert len(evs) == threads * per_thread
+    assert sorted(e["seq"] for e in evs) == list(range(len(evs)))
+
+
+def test_event_log_bounding():
+    log = EventLog(max_events=32)
+    for i in range(100):
+        log.record("op", "decision", i=i)
+    evs = log.events()
+    assert len(evs) == 32
+    assert log.dropped == 68
+    # ring keeps the NEWEST events, oldest-first
+    assert [e["i"] for e in evs] == list(range(68, 100))
+    assert [e["seq"] for e in evs] == list(range(68, 100))
+
+
+def test_event_log_bounding_through_facade(telemetry):
+    obs.configure(max_events=16)
+    for i in range(50):
+        obs.record_decision("op", "d", i=i)
+    snap = obs.snapshot()
+    assert len(snap["events"]) == 16
+    assert snap["events_dropped"] == 34
+    # aggregates survive the wraparound
+    assert obs.counter_value("decisions", op="op", decision="d") == 50
+
+
+def test_disabled_records_nothing():
+    obs.disable()
+    obs.reset()
+    obs.count("should.not.exist")
+    obs.record_decision("op", "d")
+    obs.observe("hist", 0.5)
+    obs.gauge("g", 1.0)
+    snap = obs.snapshot()
+    assert snap["counters"] == []
+    assert snap["events"] == []
+    assert snap["histograms"] == []
+    assert snap["gauges"] == []
+    assert snap["enabled"] is False
+
+
+# --------------------------------------------------------------------------
+# exporters
+# --------------------------------------------------------------------------
+
+
+def _populated_snapshot():
+    obs.count("dispatch", 3, op="convolve", backend="xla")
+    obs.count("dispatch", op="convolve", backend="oracle")
+    obs.gauge("mesh.devices", 8.0)
+    obs.observe("compile.backend_compile_secs", 0.025)
+    obs.observe("compile.backend_compile_secs", 2.5)
+    obs.record_decision("convolve", "overlap_save",
+                        x_length=1 << 20, h_length=2047)
+    return obs.snapshot()
+
+
+def test_json_export_round_trip(telemetry):
+    snap = _populated_snapshot()
+    assert obs_export.from_json(obs.to_json(snap)) == snap
+    # strict JSON (bench artifacts use allow_nan=False)
+    json.loads(obs.to_json(snap))
+
+
+def test_json_save_load_round_trip(telemetry, tmp_path):
+    snap = _populated_snapshot()
+    path = obs.save(str(tmp_path / "snap.json"), snap)
+    assert obs.load(path) == snap
+
+
+def test_prometheus_export_round_trip(telemetry):
+    snap = _populated_snapshot()
+    text = obs.to_prometheus(snap)
+    parsed = obs_export.parse_prometheus(text)
+    # every counter and gauge sample is recoverable with its value
+    for c in snap["counters"]:
+        key = (obs_export.PROMETHEUS_PREFIX
+               + c["name"].replace(".", "_") + "_total",
+               tuple(sorted(c["labels"].items())))
+        assert parsed[key] == c["value"], key
+    for g in snap["gauges"]:
+        key = (obs_export.PROMETHEUS_PREFIX
+               + g["name"].replace(".", "_"),
+               tuple(sorted(g["labels"].items())))
+        assert parsed[key] == g["value"]
+    # histogram series: cumulative buckets, sum and count
+    hist = snap["histograms"][0]
+    hname = (obs_export.PROMETHEUS_PREFIX
+             + hist["name"].replace(".", "_"))
+    assert parsed[(hname + "_count", ())] == hist["count"] == 2
+    assert parsed[(hname + "_sum", ())] == pytest.approx(hist["sum"])
+    assert parsed[(hname + "_bucket", (("le", "+Inf"),))] == 2
+
+
+def test_report_renders(telemetry):
+    snap = _populated_snapshot()
+    text = obs.report(snap)
+    assert "overlap_save" in text
+    assert "dispatch{backend=xla,op=convolve}" in text
+
+
+# --------------------------------------------------------------------------
+# decision events at the select_algorithm threshold boundaries
+# --------------------------------------------------------------------------
+
+BF = cv.ConvolutionAlgorithm.BRUTE_FORCE
+FFT = cv.ConvolutionAlgorithm.FFT
+OS = cv.ConvolutionAlgorithm.OVERLAP_SAVE
+
+# (x_length, h_length) straddling both thresholds:
+# product boundary x*h = AUTO_FFT_MIN_PRODUCT (8192) and
+# ratio boundary x = AUTO_OVERLAP_SAVE_MIN_RATIO * h (8h)
+BOUNDARY_CASES = [
+    (127, 64, BF),       # 8128 < 8192: latency floor
+    (128, 64, FFT),      # 8192 hits the product threshold, ratio 2
+    (8191, 1, BF),       # one under the product threshold
+    (8192, 1, OS),       # at threshold AND ratio 8192 >= 8
+    (1023, 128, FFT),    # ratio just under 8
+    (1024, 128, OS),     # ratio exactly 8
+    (1025, 128, OS),     # ratio just over 8
+    (4096, 4096, FFT),   # large balanced problem
+]
+
+
+@pytest.mark.parametrize("x_len,h_len,expect", BOUNDARY_CASES)
+def test_decision_event_at_threshold_boundary(telemetry, x_len, h_len,
+                                              expect):
+    assert cv.select_algorithm(x_len, h_len) is expect
+    handle = cv.convolve_initialize(x_len, h_len)
+    assert handle.algorithm is expect
+    ev = obs.events()[-1]
+    assert ev["op"] == "convolve"
+    assert ev["decision"] == expect.value
+    assert ev["x_length"] == x_len and ev["h_length"] == h_len
+    assert ev["forced"] is False
+    if expect is OS:
+        assert ev["block_length"] == handle.block_length
+        assert ev["step"] == handle.step
+    if expect is FFT:
+        assert ev["fft_length"] == handle.fft_length
+
+
+def test_forced_algorithm_flagged(telemetry):
+    cv.convolve_initialize(100, 50, cv.ConvolutionAlgorithm.FFT)
+    ev = obs.events()[-1]
+    assert ev["decision"] == "fft" and ev["forced"] is True
+
+
+# --------------------------------------------------------------------------
+# dispatch-surface wiring
+# --------------------------------------------------------------------------
+
+
+def test_dispatch_counters_per_backend(telemetry):
+    x, h = RNG.randn(64).astype(np.float32), np.ones(4, np.float32)
+    cv.convolve(x, h, simd=True)
+    cv.convolve(x, h, simd=False)
+    assert obs.counter_value("dispatch", op="convolve",
+                             backend="xla") == 1
+    assert obs.counter_value("dispatch", op="convolve",
+                             backend="oracle") == 1
+
+
+def test_stft_istft_framing_decisions(telemetry):
+    x = RNG.randn(2048).astype(np.float32)
+    sp.stft(x, 256, 64, simd=True)           # 256 % 64 == 0, r=4
+    assert obs.events()[-1]["op"] == "stft"
+    assert obs.events()[-1]["decision"] == "reshape_interleave"
+    sp.stft(x, 256, 96, simd=True)           # non-dividing hop
+    assert obs.events()[-1]["decision"] == "gather"
+    spec = sp.stft(x, 256, 64, simd=True)
+    sp.istft(spec, 2048, 256, 64, simd=True)
+    assert obs.events()[-1]["op"] == "istft"
+    assert obs.events()[-1]["decision"] == "reshape_overlap_add"
+
+
+def test_wavelet_decisions(telemetry):
+    x = RNG.randn(4, 256).astype(np.float32)
+    wv.wavelet_apply(WaveletType.DAUBECHIES, 8, wv.ExtensionType.PERIODIC,
+                     x, simd=True)
+    ev = obs.events()[-1]
+    assert ev["op"] == "wavelet_apply"
+    assert ev["decision"] in ("pallas", "xla_conv")
+    assert ev["family"] == "daub" and ev["order"] == 8
+    wv.wavelet_transform(WaveletType.DAUBECHIES, 4,
+                         wv.ExtensionType.PERIODIC, x, 2, simd=True)
+    evs = [e for e in obs.events() if e["op"] == "wavelet_transform"]
+    assert evs[-1]["decision"] in ("level_loop", "fused_cascade")
+    assert evs[-1]["levels"] == 2
+
+
+def test_sharded_convolve_geometry_event(telemetry):
+    from veles.simd_tpu.parallel import mesh as pm
+    from veles.simd_tpu.parallel import ops as pops
+
+    mesh = pm.default_mesh("sp")
+    x = RNG.randn(1024).astype(np.float32)
+    h = RNG.randn(17).astype(np.float32)
+    pops.sharded_convolve(x, h, mesh, axis="sp")
+    evs = [e for e in obs.events() if e["op"] == "sharded_convolve"]
+    assert evs[-1]["decision"] == "one_hop_halo"
+    assert evs[-1]["n_shards"] == mesh.shape["sp"]
+    assert evs[-1]["halo"] == 16
+
+
+# --------------------------------------------------------------------------
+# the traced-program contract: telemetry must be invisible to XLA
+# --------------------------------------------------------------------------
+
+
+def _convolve_jaxpr():
+    x = jnp.zeros(300, jnp.float32)
+    h = jnp.zeros(30, jnp.float32)
+    return str(jax.make_jaxpr(lambda a, b: cv.convolve(a, b))(x, h))
+
+
+def _stft_jaxpr():
+    x = jnp.zeros(1024, jnp.float32)
+    return str(jax.make_jaxpr(
+        lambda a: sp.stft(a, 128, 32, simd=True))(x))
+
+
+@pytest.mark.parametrize("build", [_convolve_jaxpr, _stft_jaxpr],
+                         ids=["convolve", "stft"])
+def test_jaxpr_identical_with_telemetry_on_and_off(build):
+    obs.disable()
+    obs.reset()
+    jaxpr_off = build()
+    obs.enable()
+    try:
+        jaxpr_on = build()
+        assert obs.events(), "telemetry was on but recorded nothing"
+    finally:
+        obs.disable()
+        obs.reset()
+    assert jaxpr_off == jaxpr_on
+
+
+# --------------------------------------------------------------------------
+# acceptance: a 1M-point convolve under telemetry tells the whole story
+# --------------------------------------------------------------------------
+
+
+def test_1m_convolve_snapshot_names_algorithm_and_compiles(telemetry):
+    n, k = 1 << 20, 2049
+    x = RNG.randn(n).astype(np.float32)
+    h = RNG.randn(k).astype(np.float32)
+    y = cv.convolve(x, h, simd=True)
+    np.asarray(y[-1:])  # force execution
+    snap = obs.snapshot()
+    ev = [e for e in snap["events"] if e["op"] == "convolve"][-1]
+    assert ev["decision"] == "overlap_save"       # x >= 8h
+    assert ev["x_length"] == n and ev["h_length"] == k
+    assert obs.counter_value("dispatch", op="convolve",
+                             backend="xla") >= 1
+    # the jax.monitoring bridge saw the backend compile
+    assert obs.counter_value("compile.backend_compile") >= 1
+    hists = {h_["name"] for h_ in snap["histograms"]}
+    assert "compile.backend_compile_secs" in hists
+    # exportable both ways, naming the selected algorithm
+    as_json = obs.to_json(snap)
+    assert "overlap_save" in as_json
+    parsed = obs_export.parse_prometheus(obs.to_prometheus(snap))
+    assert parsed[("veles_simd_decisions_total",
+                   (("decision", "overlap_save"),
+                    ("op", "convolve")))] >= 1

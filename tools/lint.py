@@ -8,6 +8,14 @@ hermetic environments without it, falls back to a dependency-free pass:
 over-long lines, and trailing whitespace.  Exit status is the gate, like
 the reference's ``make lint``.
 
+One project-specific rule always runs (ruff or not): compute modules
+(``veles/simd_tpu/ops/``, ``veles/simd_tpu/parallel/``) may touch the
+telemetry layer ONLY through the approved Python-dispatch helpers
+``obs.record_decision`` / ``obs.count`` — never registry internals, and
+never anything that could smuggle instrumentation into traced/jitted
+code (the obs package's contract is that jaxprs are byte-identical with
+telemetry on or off).
+
 Run:  python tools/lint.py [paths...]
 """
 
@@ -104,14 +112,79 @@ def fallback_lint(files) -> int:
     return 1 if failures else 0
 
 
+# --- telemetry-usage rule (always on, ruff can't express it) ---------------
+
+# the only obs entry points compute modules may call — both are pure
+# Python-dispatch helpers that cannot appear in a traced program
+_OBS_APPROVED = {"record_decision", "count"}
+_OBS_PKG = "veles.simd_tpu.obs"
+# directories holding traced compute code the rule polices
+_OBS_RULE_DIRS = ("veles/simd_tpu/ops", "veles/simd_tpu/parallel")
+
+
+def obs_usage_lint(files) -> int:
+    """Flag ops/parallel modules reaching past the approved telemetry
+    helpers (keeps instrumentation out of traced code)."""
+    failures = 0
+    for f in files:
+        try:
+            rel = f.resolve().relative_to(ROOT).as_posix()
+        except ValueError:
+            continue
+        if not rel.startswith(_OBS_RULE_DIRS):
+            continue
+        try:
+            tree = ast.parse(f.read_text(), str(f))
+        except SyntaxError as e:
+            # report like fallback_lint's compile check instead of
+            # crashing the whole lint run with a raw traceback
+            print(f"{f}:{e.lineno}: syntax error: {e.msg}")
+            failures += 1
+            continue
+        aliases = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == _OBS_PKG or \
+                            a.name.startswith(_OBS_PKG + "."):
+                        print(f"{f}:{node.lineno}: import telemetry via "
+                              f"'from veles.simd_tpu import obs', not "
+                              f"'import {a.name}'")
+                        failures += 1
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "veles.simd_tpu":
+                    for a in node.names:
+                        if a.name == "obs":
+                            aliases.add(a.asname or "obs")
+                elif node.module and (
+                        node.module == _OBS_PKG
+                        or node.module.startswith(_OBS_PKG + ".")):
+                    print(f"{f}:{node.lineno}: ops/parallel modules must "
+                          f"not import telemetry internals "
+                          f"({node.module}); use obs.record_decision / "
+                          f"obs.count")
+                    failures += 1
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in aliases
+                    and node.attr not in _OBS_APPROVED):
+                print(f"{f}:{node.lineno}: obs.{node.attr} is not an "
+                      f"approved telemetry helper for compute modules "
+                      f"(allowed: {', '.join(sorted(_OBS_APPROVED))})")
+                failures += 1
+    return 1 if failures else 0
+
+
 def main():
     files = sorted(set(python_sources(sys.argv[1:])))
+    obs_rc = obs_usage_lint(files)
     rc = try_ruff(files)
     if rc is None:
         print(f"lint: ruff unavailable, dependency-free fallback over "
               f"{len(files)} files")
         rc = fallback_lint(files)
-    sys.exit(rc)
+    sys.exit(rc or obs_rc)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Pretty-print a saved telemetry snapshot (``veles.simd_tpu.obs``).
+
+Reads a JSON snapshot — either one written by ``obs.save(path)`` or a
+``BENCH_DETAILS.json`` produced by ``bench.py`` (whose entries embed a
+compact per-config telemetry dict) — and renders the human table the
+live ``obs.report()`` call would print.  ``--prometheus`` converts a
+full snapshot to the Prometheus text exposition format instead, so a
+file captured on a TPU host can be pushed through a gateway later.
+
+Usage:  python tools/obs_report.py SNAPSHOT.json
+        python tools/obs_report.py --prometheus SNAPSHOT.json
+        python tools/obs_report.py BENCH_DETAILS.json
+        make obs-report SNAPSHOT=telemetry.json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+from veles.simd_tpu.obs import export  # noqa: E402
+
+
+def _render_bench_details(entries) -> str:
+    """BENCH_DETAILS.json mode: one telemetry block per bench config."""
+    lines = []
+    for e in entries:
+        tel = e.get("telemetry")
+        lines.append("=== %s ===" % e.get("metric", "(unnamed config)"))
+        if tel is None:
+            lines.append("  (no telemetry recorded)")
+            continue
+        lines.append("  compiles=%s cache_hits=%s cache_misses=%s "
+                     "events_dropped=%s" % (
+                         tel.get("compiles"), tel.get("cache_hits"),
+                         tel.get("cache_misses"),
+                         tel.get("events_dropped")))
+        for k, v in sorted(tel.get("counters", {}).items()):
+            lines.append("  %-60s %8d" % (k, v))
+        for d in tel.get("decisions", []):
+            extras = ", ".join(
+                "%s=%s" % (k, v) for k, v in d.items()
+                if k not in ("seq", "op", "decision"))
+            lines.append("  decision: %-24s -> %-18s %s"
+                         % (d.get("op"), d.get("decision"), extras))
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    prometheus = "--prometheus" in argv
+    argv = [a for a in argv if a != "--prometheus"]
+    if len(argv) != 1:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    path = argv[0]
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, list):  # BENCH_DETAILS.json
+        if prometheus:
+            print("--prometheus needs a full obs snapshot, not "
+                  "BENCH_DETAILS.json", file=sys.stderr)
+            return 2
+        sys.stdout.write(_render_bench_details(data))
+        return 0
+    if prometheus:
+        sys.stdout.write(export.to_prometheus(data))
+        return 0
+    sys.stdout.write(export.report(data, max_events=50))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

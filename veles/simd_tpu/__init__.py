@@ -12,7 +12,11 @@ SSE/AVX/NEON SIMD library; see /root/reference) designed TPU-first:
   (``/root/reference/tests/matrix.cc:94-98``),
 * long signals scale across chips via ``shard_map`` over an ICI mesh with halo
   exchange (``veles.simd_tpu.parallel``) instead of the reference's
-  single-thread overlap-save loop (``/root/reference/src/convolve.c:181-228``).
+  single-thread overlap-save loop (``/root/reference/src/convolve.c:181-228``),
+* every dispatch-time decision (algorithm selection, XLA-vs-oracle routing,
+  compiles/cache traffic) is observable through the opt-in runtime telemetry
+  package :mod:`veles.simd_tpu.obs` (``obs.enable()`` or
+  ``VELES_SIMD_TELEMETRY=1``), with zero effect on traced programs.
 
 Public API (mirrors the reference's header surface,
 ``/root/reference/inc/simd/``):

@@ -1,0 +1,214 @@
+"""veles.simd_tpu.obs — runtime telemetry: *what was decided*, counted.
+
+The framework's headline feature is automatic best-algorithm selection
+(``ops/convolve.py`` re-derives ``src/convolve.c:328-364`` for TPU), but
+selection you cannot observe is selection you cannot tune.  This package
+is the accounting layer for every dispatch-time decision:
+
+* **counters / gauges / timing histograms** —
+  :class:`~veles.simd_tpu.obs.registry.MetricsRegistry`: XLA-vs-oracle
+  dispatches per op, compile counts, cache hits;
+* **a bounded structured event log** —
+  :class:`~veles.simd_tpu.obs.events.EventLog`: one event per algorithm
+  decision (convolution algorithm + geometry, STFT framing path, wavelet
+  kernel route, shard geometry);
+* **compile tracking** — :mod:`~veles.simd_tpu.obs.compile` bridges
+  ``jax.monitoring`` into the registry, so backend compiles and
+  persistent-cache hit/miss traffic finally show up in numbers;
+* **exporters** — :mod:`~veles.simd_tpu.obs.export`: lossless JSON
+  snapshot, Prometheus text format, and a human ``report()`` table.
+
+Contract with the compute layer (enforced by ``tools/lint.py``):
+
+* ops modules touch telemetry ONLY through :func:`record_decision` and
+  :func:`count`, and ONLY at the Python dispatch layer — never inside
+  traced/jitted code.  Telemetry on or off, jaxprs and compiled
+  artifacts are byte-identical (``tests/test_obs.py`` pins this).
+* Off by default.  Enable with ``VELES_SIMD_TELEMETRY=1`` in the
+  environment or :func:`enable` at runtime; when disabled every helper
+  is a single attribute check, and when enabled the cost is one locked
+  dict increment per public call.
+
+Usage::
+
+    from veles.simd_tpu import obs
+    obs.enable()
+    convolve(x, h)                      # decisions recorded as they run
+    print(obs.report())                 # human table
+    obs.save("telemetry.json")          # snapshot for tools/obs_report.py
+    text = obs.to_prometheus()          # scrape endpoint body
+
+Scope note: this module answers *what was decided and how often*;
+:mod:`veles.simd_tpu.utils.profiler` (XLA traces) answers *where the
+time goes* inside a step.  They are deliberately separate layers.
+"""
+
+from __future__ import annotations
+
+import os
+
+from veles.simd_tpu.obs import compile as _compile
+from veles.simd_tpu.obs import export as _export
+from veles.simd_tpu.obs.events import EventLog
+from veles.simd_tpu.obs.registry import MetricsRegistry
+
+__all__ = [
+    "enable", "disable", "enabled", "configure",
+    "count", "gauge", "observe", "record_decision",
+    "counter_value", "events", "snapshot", "reset",
+    "to_json", "to_prometheus", "report", "save", "load",
+    "install_compile_listeners",
+    "MetricsRegistry", "EventLog",
+]
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+_registry = MetricsRegistry()
+_events = EventLog()
+_enabled = os.environ.get("VELES_SIMD_TELEMETRY",
+                          "0").strip().lower() in _TRUTHY
+if _enabled:
+    # the env var is documented as equivalent to enable(): compile/cache
+    # metrics must flow too.  Tolerate jax-free processes (the rest of
+    # the telemetry layer works without an accelerator runtime).
+    try:
+        _compile.install()
+    except ImportError:
+        pass
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+def enabled() -> bool:
+    """Is telemetry currently recording?"""
+    return _enabled
+
+
+def enable(compile_listeners: bool = True) -> None:
+    """Turn telemetry on (idempotent).
+
+    ``compile_listeners=True`` (default) also bridges ``jax.monitoring``
+    compile/cache events into the registry — a one-time, irreversible
+    process-level registration (the callbacks themselves stay gated on
+    :func:`enabled`, so :func:`disable` still silences them).  Pass
+    False in jax-free processes.
+    """
+    global _enabled
+    _enabled = True
+    if compile_listeners:
+        _compile.install()
+
+
+def disable() -> None:
+    """Stop recording.  Existing metrics/events are kept (snapshot still
+    works); use :func:`reset` to clear them."""
+    global _enabled
+    _enabled = False
+
+
+def configure(max_events: int | None = None) -> None:
+    """Adjust telemetry limits.  ``max_events`` replaces the decision
+    log with a fresh bound (history is cleared — resizing a ring buffer
+    in place would silently reorder it)."""
+    global _events
+    if max_events is not None:
+        _events = EventLog(max_events)
+
+
+def install_compile_listeners() -> bool:
+    """Explicitly install the ``jax.monitoring`` bridge (normally done
+    by :func:`enable`).  Returns True on first installation."""
+    return _compile.install()
+
+
+# -- recording helpers (the ONLY entry points ops modules may call) ----------
+
+def count(name: str, n: int = 1, **labels) -> None:
+    """Increment counter ``name`` (no-op while disabled)."""
+    if not _enabled:
+        return
+    _registry.count(name, n, **labels)
+
+
+def gauge(name: str, value: float, **labels) -> None:
+    """Set gauge ``name`` to ``value`` (no-op while disabled)."""
+    if not _enabled:
+        return
+    _registry.gauge(name, value, **labels)
+
+
+def observe(name: str, value: float, **labels) -> None:
+    """Record one timing-histogram sample (no-op while disabled)."""
+    if not _enabled:
+        return
+    _registry.observe(name, value, **labels)
+
+
+def record_decision(op: str, decision: str, **fields) -> None:
+    """Log one dispatch decision (no-op while disabled).
+
+    ``op`` is the public entry point ("convolve", "stft", ...),
+    ``decision`` the chosen algorithm/path, ``fields`` the JSON-native
+    geometry that explains it (lengths, block sizes, shard counts).
+    Also bumps the ``decisions`` counter labeled by (op, decision) so
+    aggregates survive event-log wraparound.
+    """
+    if not _enabled:
+        return
+    _events.record(op, decision, **fields)
+    _registry.count("decisions", op=op, decision=decision)
+
+
+# -- reads / exports ---------------------------------------------------------
+
+def counter_value(name: str, **labels) -> int:
+    """Current value of one counter (0 if never incremented)."""
+    return _registry.counter_value(name, **labels)
+
+
+def events() -> list:
+    """Oldest-first copy of the retained decision events."""
+    return _events.events()
+
+
+def snapshot() -> dict:
+    """One JSON-native dict of everything: counters, gauges, histograms,
+    events, drop count, and the enabled flag."""
+    snap = _registry.snapshot()
+    snap["events"] = _events.events()
+    snap["events_dropped"] = _events.dropped
+    snap["enabled"] = _enabled
+    return snap
+
+
+def reset() -> None:
+    """Clear all metrics and events; the enabled flag is untouched."""
+    _registry.reset()
+    _events.reset()
+
+
+def to_json(snap: dict | None = None, indent: int | None = 2) -> str:
+    return _export.to_json(snapshot() if snap is None else snap, indent)
+
+
+def to_prometheus(snap: dict | None = None) -> str:
+    return _export.to_prometheus(snapshot() if snap is None else snap)
+
+
+def report(snap: dict | None = None, max_events: int = 20) -> str:
+    return _export.report(snapshot() if snap is None else snap,
+                          max_events)
+
+
+def save(path: str, snap: dict | None = None) -> str:
+    """Write a JSON snapshot to ``path`` (read back with :func:`load`
+    or pretty-printed by ``tools/obs_report.py``); returns ``path``."""
+    with open(path, "w") as f:
+        f.write(to_json(snap))
+    return path
+
+
+def load(path: str) -> dict:
+    """Read a snapshot written by :func:`save`."""
+    with open(path) as f:
+        return _export.from_json(f.read())

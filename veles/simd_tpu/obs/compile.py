@@ -1,0 +1,90 @@
+"""Compile & compilation-cache tracking via ``jax.monitoring``.
+
+jax already announces every backend compile and every persistent-cache
+hit/miss through its monitoring hooks (``jax._src.compiler`` records
+``/jax/compilation_cache/cache_hits``/``cache_misses``; ``pxla`` wraps
+each backend compile in ``/jax/core/compile/backend_compile_duration``).
+Nothing in the stock runtime *listens* — so
+:func:`veles.simd_tpu.utils.profiler.enable_compilation_cache` could
+never report how often the cache actually paid off.  This module bridges
+those hooks into the telemetry registry: compiles become counters plus a
+timing histogram, cache traffic becomes hit/miss counters.
+
+Listeners are installed once per process (jax offers no public
+unregister) and stay registered; each callback first checks
+``obs.enabled()``, so ``obs.disable()`` silences them with the same
+one-branch cost as every other telemetry helper.  jax is imported only
+inside :func:`install` — the obs package itself stays importable without
+an accelerator runtime.
+"""
+
+from __future__ import annotations
+
+__all__ = ["install", "installed", "EVENT_COUNTERS", "DURATION_METRICS"]
+
+# jax.monitoring event name -> telemetry counter name
+EVENT_COUNTERS = {
+    "/jax/compilation_cache/cache_hits": "compile.cache_hits",
+    "/jax/compilation_cache/cache_misses": "compile.cache_misses",
+    "/jax/compilation_cache/tasks_using_cache":
+        "compile.tasks_using_cache",
+    "/jax/compilation_cache/task_disabled_cache":
+        "compile.task_disabled_cache",
+    "/jax/compilation_cache/compile_requests_use_cache":
+        "compile.requests_use_cache",
+}
+
+# jax.monitoring duration event -> (counter name or None, histogram name)
+DURATION_METRICS = {
+    "/jax/core/compile/backend_compile_duration":
+        ("compile.backend_compile", "compile.backend_compile_secs"),
+    "/jax/core/compile/jaxpr_trace_duration":
+        (None, "compile.jaxpr_trace_secs"),
+    "/jax/core/compile/jaxpr_to_mlir_module_duration":
+        (None, "compile.lowering_secs"),
+    "/jax/compilation_cache/cache_retrieval_time_sec":
+        (None, "compile.cache_retrieval_secs"),
+    "/jax/compilation_cache/compile_time_saved_sec":
+        (None, "compile.cache_time_saved_secs"),
+}
+
+_installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def install() -> bool:
+    """Register the monitoring listeners (idempotent).
+
+    Returns True when this call did the registration, False when they
+    were already installed.  Callbacks route through the gated
+    :func:`veles.simd_tpu.obs.count`/``observe`` helpers, so they are
+    inert whenever telemetry is disabled.
+    """
+    global _installed
+    if _installed:
+        return False
+    import jax.monitoring
+
+    from veles.simd_tpu import obs
+
+    def _on_event(event, **kwargs):
+        name = EVENT_COUNTERS.get(event)
+        if name is not None:
+            obs.count(name)
+
+    def _on_duration(event, duration_secs, **kwargs):
+        names = DURATION_METRICS.get(event)
+        if names is None:
+            return
+        counter, hist = names
+        if counter is not None:
+            obs.count(counter)
+        obs.observe(hist, duration_secs)
+
+    jax.monitoring.register_event_listener(_on_event)
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    _installed = True
+    return True

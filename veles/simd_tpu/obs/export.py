@@ -1,0 +1,172 @@
+"""Exporters for telemetry snapshots: JSON, Prometheus text, human table.
+
+All three render the JSON-native snapshot dict produced by
+:func:`veles.simd_tpu.obs.snapshot` — exporters never touch live
+registry state, so a snapshot taken under load serializes consistently.
+
+* :func:`to_json` / :func:`from_json` — lossless round trip (the CI
+  artifact format; ``bench.py`` embeds these in BENCH_DETAILS.json and
+  ``tools/obs_report.py`` pretty-prints them back).
+* :func:`to_prometheus` / :func:`parse_prometheus` — the Prometheus text
+  exposition format (`metric{label="v"} value`), for scraping a serving
+  process.  Counter samples get the conventional ``_total`` suffix;
+  histograms emit ``_bucket``/``_sum``/``_count`` series.
+* :func:`report` — a terminal table for humans.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+__all__ = ["to_json", "from_json", "to_prometheus", "parse_prometheus",
+           "report", "flatten_counters", "PROMETHEUS_PREFIX"]
+
+PROMETHEUS_PREFIX = "veles_simd_"
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+_LINE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$")
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def _prom_name(name: str) -> str:
+    return PROMETHEUS_PREFIX + _NAME_RE.sub("_", name)
+
+
+def _prom_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        '%s="%s"' % (_NAME_RE.sub("_", k),
+                     str(v).replace("\\", r"\\").replace('"', r"\""))
+        for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def to_json(snapshot: dict, indent: int | None = 2) -> str:
+    """Serialize a snapshot losslessly (strict JSON, no NaN)."""
+    return json.dumps(snapshot, indent=indent, allow_nan=False,
+                      sort_keys=False)
+
+
+def from_json(text: str) -> dict:
+    """Inverse of :func:`to_json`."""
+    return json.loads(text)
+
+
+def to_prometheus(snapshot: dict) -> str:
+    """Render counters/gauges/histograms in the Prometheus text format.
+
+    Events are *not* exported here (Prometheus is for aggregates; the
+    event log travels in the JSON snapshot).
+    """
+    lines = []
+    for c in snapshot.get("counters", []):
+        name = _prom_name(c["name"]) + "_total"
+        lines.append("# TYPE %s counter" % name)
+        lines.append("%s%s %d" % (name, _prom_labels(c["labels"]),
+                                  c["value"]))
+    for g in snapshot.get("gauges", []):
+        name = _prom_name(g["name"])
+        lines.append("# TYPE %s gauge" % name)
+        lines.append("%s%s %s" % (name, _prom_labels(g["labels"]),
+                                  repr(float(g["value"]))))
+    for h in snapshot.get("histograms", []):
+        name = _prom_name(h["name"])
+        lines.append("# TYPE %s histogram" % name)
+        acc = 0
+        for le, cnt in h["buckets"].items():
+            acc += cnt
+            lines.append("%s_bucket%s %d" % (
+                name, _prom_labels({**h["labels"], "le": le}), acc))
+        lines.append("%s_sum%s %s" % (name, _prom_labels(h["labels"]),
+                                      repr(float(h["sum"]))))
+        lines.append("%s_count%s %d" % (name, _prom_labels(h["labels"]),
+                                        h["count"]))
+    de = snapshot.get("events_dropped")
+    if de is not None:
+        name = _prom_name("events_dropped") + "_total"
+        lines.append("# TYPE %s counter" % name)
+        lines.append("%s %d" % (name, de))
+    return "\n".join(lines) + "\n"
+
+
+def parse_prometheus(text: str) -> dict:
+    """Parse Prometheus text back to ``{(name, ((k, v), ...)): float}``.
+
+    Covers the subset :func:`to_prometheus` emits — enough for the
+    round-trip test and for ``tools/obs_report.py`` to diff two scrapes.
+    """
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _LINE_RE.match(line)
+        if not m:
+            raise ValueError("unparseable exposition line: %r" % line)
+        labels = tuple(
+            (k, v.replace(r"\"", '"').replace(r"\\", "\\"))
+            for k, v in _LABEL_RE.findall(m.group("labels") or ""))
+        out[(m.group("name"), labels)] = float(m.group("value"))
+    return out
+
+
+def flatten_counters(snapshot: dict) -> dict:
+    """Counters as one flat ``{"name{k=v,...}": value}`` dict — the
+    compact form ``bench.py`` embeds per config and :func:`report`
+    tabulates."""
+    flat = {}
+    for c in snapshot.get("counters", []):
+        key = c["name"]
+        if c["labels"]:
+            key += "{" + ",".join("%s=%s" % kv
+                                  for kv in sorted(c["labels"].items())) \
+                + "}"
+        flat[key] = c["value"]
+    return flat
+
+
+def report(snapshot: dict, max_events: int = 20) -> str:
+    """Human-readable table of a snapshot (newest events last)."""
+    lines = ["== veles.simd_tpu telemetry =="]
+    flat = flatten_counters(snapshot)
+    if flat:
+        lines.append("")
+        lines.append("counters:")
+        width = max(len(k) for k in flat)
+        for k, v in sorted(flat.items()):
+            lines.append("  %-*s %12d" % (width, k, v))
+    if snapshot.get("gauges"):
+        lines.append("")
+        lines.append("gauges:")
+        for g in snapshot["gauges"]:
+            lines.append("  %s%s = %g" % (
+                g["name"],
+                _prom_labels(g["labels"]).replace('"', ""), g["value"]))
+    if snapshot.get("histograms"):
+        lines.append("")
+        lines.append("histograms (seconds):")
+        for h in snapshot["histograms"]:
+            mean = h["sum"] / h["count"] if h["count"] else 0.0
+            lines.append("  %-40s n=%-8d mean=%.3e" % (
+                h["name"] + _prom_labels(h["labels"]).replace('"', ""),
+                h["count"], mean))
+    events = snapshot.get("events", [])
+    if events:
+        lines.append("")
+        lines.append("decision events (last %d of %d retained, %d "
+                     "dropped):" % (min(max_events, len(events)),
+                                    len(events),
+                                    snapshot.get("events_dropped", 0)))
+        for e in events[-max_events:]:
+            extras = ", ".join(
+                "%s=%s" % (k, v) for k, v in e.items()
+                if k not in ("seq", "op", "decision") and v is not None)
+            lines.append("  #%-6d %-24s -> %-18s %s" % (
+                e["seq"], e["op"], e["decision"], extras))
+    if len(lines) == 1:
+        lines.append("(empty)")
+    return "\n".join(lines) + "\n"

@@ -241,7 +241,7 @@ def add_to_all_na(x, value):
 # --------------------------------------------------------------------------
 
 def _dispatch(simd, xla_fn, na_fn, *args):
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="arithmetic"):
         return xla_fn(*[jnp.asarray(a) for a in args])
     return na_fn(*[np.asarray(a) for a in args])
 
@@ -293,7 +293,7 @@ real_multiply_array = real_multiply
 
 
 def real_multiply_scalar(data, value, simd=None):
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="arithmetic"):
         return _real_multiply_scalar(jnp.asarray(data), float(value))
     return real_multiply_scalar_na(data, value)
 
@@ -326,7 +326,7 @@ def sum_elements(data, simd=None):
 
 
 def add_to_all(data, value, simd=None):
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="arithmetic"):
         return _add_to_all(jnp.asarray(data), float(value))
     return add_to_all_na(data, value)
 

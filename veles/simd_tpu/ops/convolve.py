@@ -48,6 +48,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from veles.simd_tpu import obs
 from veles.simd_tpu.ops import pallas_kernels as _pk
 from veles.simd_tpu.utils.config import get_config, resolve_simd
 from veles.simd_tpu.utils.memory import (
@@ -432,6 +433,7 @@ def _make_handle(x_length, h_length, algorithm, reverse):
     if x_length < 1 or h_length < 1:
         raise ValueError("convolve: lengths must be positive "
                          "(src/convolve.c:44-48 assert contract)")
+    forced = algorithm is not None
     if algorithm is None:
         algorithm = select_algorithm(x_length, h_length)
     algorithm = ConvolutionAlgorithm(algorithm)
@@ -447,6 +449,11 @@ def _make_handle(x_length, h_length, algorithm, reverse):
         block_len = tpu_block_length(h_length, x_length)
         os_matmul = h_length <= AUTO_OS_MATMUL_MAX_H
         step = overlap_save_step(h_length)
+    obs.record_decision(
+        "convolve", algorithm.value, x_length=x_length,
+        h_length=h_length, forced=forced, fft_length=fft_len,
+        block_length=block_len, os_matmul=os_matmul, step=step,
+        reverse=bool(reverse))
     return ConvolutionHandle(x_length, h_length, algorithm, reverse,
                              fft_len, block_len, os_matmul, step)
 
@@ -461,7 +468,7 @@ def _check_lengths(handle, x, h):
 
 
 def _run(handle: ConvolutionHandle, x, h, simd=None):
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="convolve"):
         x, h = jnp.asarray(x), jnp.asarray(h)
         _check_lengths(handle, x, h)
         if handle.algorithm is ConvolutionAlgorithm.BRUTE_FORCE:
@@ -489,7 +496,7 @@ def _run(handle: ConvolutionHandle, x, h, simd=None):
 def convolve_simd(x, h, simd=None):
     """Direct-form full convolution (``convolve_simd``,
     ``inc/simd/convolve.h:41-56``)."""
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="convolve_simd"):
         return _direct(jnp.asarray(x), jnp.asarray(h))
     return convolve_na(x, h)
 
@@ -671,7 +678,7 @@ class StreamingConvolution:
         # backend resolved ONCE at construction (a stateful stream must
         # not switch backends mid-flight); the oracle path then stays
         # pure NumPy — no jax import/backend init at all
-        self._use_xla = resolve_simd(simd)
+        self._use_xla = resolve_simd(simd, op="streaming_convolve")
         self._xp = jnp if self._use_xla else np
         # per-chunk plan through the module's auto-select (overlap-save /
         # FFT / direct all reuse one compiled executable per shape)

@@ -210,7 +210,7 @@ def _run2d(x, h, reverse, algorithm, simd):
     if algorithm not in ("direct", "fft"):
         raise ValueError(f"algorithm must be 'direct' or 'fft', "
                          f"got {algorithm!r}")
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="convolve2d"):
         x, h = jnp.asarray(x), jnp.asarray(h)
         if algorithm == "direct":
             use_pallas = _use_pallas_direct2d(x.shape, k0, k1)
@@ -302,7 +302,7 @@ def _mode_boundary_2d(x, h, reverse, algorithm, simd, mode, boundary,
     # throwaway columns (and can bump the FFT pow2 size)
     p0, p1 = (k0 - 1, k1 - 1) if mode == "full" else (k0 // 2, k1 // 2)
     if not plain:
-        xp = jnp if resolve_simd(simd) else np
+        xp = jnp if resolve_simd(simd, op="convolve2d") else np
         pad = [(0, 0)] * (np.ndim(x) - 2) + [(p0, p0), (p1, p1)]
         kw = ({"constant_values": fillvalue}
               if boundary == "fill" else {})

@@ -47,7 +47,7 @@ def cross_correlate_na(x, h):
 
 def cross_correlate_simd(x, h, simd=None):
     """Direct form (``inc/simd/correlate.h:41-56``)."""
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="correlate"):
         import jax.numpy as jnp
 
         return _conv._direct(jnp.asarray(x), jnp.asarray(h), reverse=True)

@@ -171,7 +171,7 @@ def detect_peaks_na(data, type=ExtremumType.BOTH):
 def detect_peaks(data, type=ExtremumType.BOTH, simd=None):
     """User-facing API (``detect_peaks``, ``inc/simd/detect_peaks.h:47-60``):
     returns variable-length ``(positions, values)``."""
-    if not resolve_simd(simd):
+    if not resolve_simd(simd, op="detect_peaks"):
         return detect_peaks_na(data, type)
     data = jnp.asarray(data)
     if data.ndim != 1:
@@ -354,7 +354,7 @@ def peak_prominences(x, peaks, simd=None):
     n = np.shape(x)[-1]
     if peaks.size and (peaks.min() < 0 or peaks.max() >= n):
         raise ValueError("peak index out of range")
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="detect_peaks"):
         prom = _prominences_xla(jnp.asarray(x, jnp.float32))
         return jnp.take(prom, jnp.asarray(peaks), axis=-1)
     return peak_prominences_na(x, peaks).astype(np.float32)
@@ -435,7 +435,7 @@ def peak_widths(x, peaks, rel_height: float = 0.5, simd=None):
     n = np.shape(x)[-1]
     if peaks.size and (peaks.min() < 0 or peaks.max() >= n):
         raise ValueError("peak index out of range")
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="detect_peaks"):
         w, h, li, ri = _widths_xla(jnp.asarray(x, jnp.float32),
                                    rel_height)[:4]
         pk = jnp.asarray(peaks)
@@ -504,7 +504,7 @@ def find_peaks(x, height=None, threshold=None, distance=None,
     x_np = np.asarray(x, np.float32)
     if x_np.ndim != 1:
         raise ValueError("find_peaks needs a 1D signal")
-    use = resolve_simd(simd)
+    use = resolve_simd(simd, op="detect_peaks")
     if use:
         # _peak_mask is already full-length (borders padded False)
         mask = np.asarray(_peak_mask(jnp.asarray(x_np),
@@ -582,7 +582,7 @@ def find_peaks(x, height=None, threshold=None, distance=None,
         # computes the prominences it evaluates widths against (and
         # scipy likewise always attaches prominences when width is
         # requested)
-        use = resolve_simd(simd)
+        use = resolve_simd(simd, op="detect_peaks")
         if use:
             pk = jnp.asarray(peaks)
             if width is not None:

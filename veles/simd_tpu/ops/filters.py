@@ -168,7 +168,7 @@ def order_filter(x, rank: int, kernel_size: int, simd=None):
     rank = int(rank)
     if not 0 <= rank < k:
         raise ValueError(f"rank {rank} outside [0, {k})")
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="filters"):
         return _rank_filter_xla(jnp.asarray(x, jnp.float32), k, rank)
     return order_filter_na(x, rank, k).astype(np.float32)
 
@@ -241,7 +241,7 @@ def medfilt2d(img, kernel_size=3, simd=None):
     img_np = img if hasattr(img, "ndim") else np.asarray(img)
     if img_np.ndim < 2:
         raise ValueError("medfilt2d needs [..., H, W]")
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="filters"):
         return _medfilt2d_xla(jnp.asarray(img, jnp.float32), kh, kw)
     return medfilt2d_na(img, (kh, kw)).astype(np.float32)
 
@@ -343,7 +343,7 @@ def savgol_filter(x, window_length: int, polyorder: int, deriv: int = 0,
         raise ValueError(f"unknown mode {mode!r}")
     taps = _savgol_corr_taps(window_length, polyorder, deriv, delta)
     half = window_length // 2
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="filters"):
         xj = jnp.asarray(x, jnp.float32)
         if mode == "nearest":
             xe = jnp.concatenate(
@@ -584,7 +584,7 @@ def wiener(x, mysize: int = 3, noise=None, simd=None):
     one jitted XLA program (formulation rationale in ``_wiener_core``).
     """
     mysize = _check_kernel(mysize, "mysize")
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="filters"):
         xj = jnp.asarray(x, jnp.float32)
         nz = None if noise is None else jnp.float32(noise)
         return _wiener_xla(xj, mysize, nz)

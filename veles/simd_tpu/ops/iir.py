@@ -993,7 +993,7 @@ def sosfilt(sos, x, zi=None, simd=None, return_zf=False):
     sos = _check_sos(sos)
     if return_zf and np.shape(x)[-1] < 2:
         raise ValueError("return_zf needs at least 2 samples per block")
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="iir"):
         sos_key = tuple(tuple(float(v) for v in row) for row in sos)
         zi_j = None if zi is None else jnp.asarray(zi, jnp.float32)
         return _sosfilt_xla(jnp.asarray(x, jnp.float32), sos_key, zi_j,
@@ -1057,7 +1057,7 @@ StreamingConvolution`: chunks arrive one at a time, each section's
         # validate once; per-chunk calls reuse the cached static key
         self._sos_key = tuple(tuple(float(v) for v in row)
                               for row in self._sos)
-        self._simd = resolve_simd(simd)
+        self._simd = resolve_simd(simd, op="iir")
         self.reset(zi)
 
     def process(self, chunk):
@@ -1116,7 +1116,7 @@ def sosfiltfilt(sos, x, padlen=None, simd=None):
     zi = sosfilt_zi(sos)
     n = np.shape(x)[-1]
     padlen = _filtfilt_padlen(sos, n, padlen)
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="iir"):
         xj = jnp.asarray(x, jnp.float32)
         ext = _odd_ext(xj, padlen, jnp)
         zi_j = jnp.asarray(zi, jnp.float32)
@@ -1242,7 +1242,7 @@ def lfilter(b, a, x, simd=None):
         raise ValueError(
             f"denominator order {p} > {_LFILTER_MAX_ORDER}: use sosfilt "
             "(cascaded second-order sections) for high-order filters")
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="iir"):
         if p == 0:
             # pure FIR: no recurrence, just the drive
             a = np.concatenate([a, [0.0]])

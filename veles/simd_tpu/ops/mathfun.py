@@ -76,7 +76,7 @@ _NA = {"sin": np.sin, "cos": np.cos, "log": np.log, "exp": np.exp,
 
 
 def _psv(name, data, simd):
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="mathfun"):
         return _XLA[name](jnp.asarray(data, dtype=jnp.float32))
     return _NA[name](np.asarray(data, dtype=np.float32))
 
@@ -103,7 +103,7 @@ def exp_psv(data, simd=None):
 
 def pow_psv(base, exponent, simd=None):
     """``avx_mathfun.h:720`` / ``neon_mathfun.h:307`` pow_ps."""
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="mathfun"):
         return _POW(jnp.asarray(base, dtype=jnp.float32),
                     jnp.asarray(exponent, dtype=jnp.float32))
     return np.power(np.asarray(base, np.float32),

@@ -112,13 +112,13 @@ def _check_2d(name, *ms):
 
 
 def matrix_add(m1, m2, simd=None):
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="matrix"):
         return _add(jnp.asarray(m1), jnp.asarray(m2))
     return matrix_add_novec(m1, m2)
 
 
 def matrix_sub(m1, m2, simd=None):
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="matrix"):
         return _sub(jnp.asarray(m1), jnp.asarray(m2))
     return matrix_sub_novec(m1, m2)
 
@@ -126,13 +126,13 @@ def matrix_sub(m1, m2, simd=None):
 def matrix_multiply(m1, m2, simd=None, fast=False):
     """``res[h1, w2] = m1[h1, w1] · m2[h2, w2]``, requires ``w1 == h2``
     (``matrix.h:71`` precondition, asserted at ``src/matrix.c:257-261``)."""
-    m1 = jnp.asarray(m1) if resolve_simd(simd) else np.asarray(m1)
-    m2 = jnp.asarray(m2) if resolve_simd(simd) else np.asarray(m2)
+    m1 = jnp.asarray(m1) if resolve_simd(simd, op="matrix") else np.asarray(m1)
+    m2 = jnp.asarray(m2) if resolve_simd(simd, op="matrix") else np.asarray(m2)
     _check_2d("matrix_multiply", m1, m2)
     if m1.shape[-1] != m2.shape[-2]:
         raise ValueError(
             f"matrix_multiply: w1 ({m1.shape[-1]}) != h2 ({m2.shape[-2]})")
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="matrix"):
         return _matmul(m1, m2, fast=fast)
     return matrix_multiply_novec(m1, m2)
 
@@ -140,20 +140,21 @@ def matrix_multiply(m1, m2, simd=None, fast=False):
 def matrix_multiply_transposed(m1, m2t, simd=None, fast=False):
     """``res[h1, h2] = m1[h1, w1] · m2t[h2, w2=w1]^T``, requires ``w1 == w2``
     (``matrix.h:87`` precondition)."""
-    m1 = jnp.asarray(m1) if resolve_simd(simd) else np.asarray(m1)
-    m2t = jnp.asarray(m2t) if resolve_simd(simd) else np.asarray(m2t)
+    use = resolve_simd(simd, op="matrix")
+    m1 = jnp.asarray(m1) if use else np.asarray(m1)
+    m2t = jnp.asarray(m2t) if use else np.asarray(m2t)
     _check_2d("matrix_multiply_transposed", m1, m2t)
     if m1.shape[-1] != m2t.shape[-1]:
         raise ValueError(
             f"matrix_multiply_transposed: w1 ({m1.shape[-1]}) != "
             f"w2 ({m2t.shape[-1]})")
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="matrix"):
         return _matmul_t(m1, m2t, fast=fast)
     return matrix_multiply_transposed_novec(m1, m2t)
 
 
 def matrix_vector_multiply(m, v, simd=None):
     """BLAS-L2 gemv: ``res[h] = m[h, w] · v[w]``."""
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="matrix"):
         return _matvec(jnp.asarray(m), jnp.asarray(v))
     return matrix_vector_multiply_novec(m, v)

@@ -110,7 +110,7 @@ def normalize2D(src, simd=None):
     """u8 (or any numeric) plane → f32 in [-1, 1]
     (``inc/simd/normalize.h:48-57``)."""
     _check_2d(src)
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="normalize"):
         return _normalize2d(jnp.asarray(src))
     return normalize2D_novec(np.asarray(src))
 
@@ -118,7 +118,7 @@ def normalize2D(src, simd=None):
 def normalize2D_minmax(mn, mx, src, simd=None):
     """Normalization with precomputed min/max
     (``inc/simd/normalize.h:66-79``)."""
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="normalize"):
         return _normalize2d_minmax(mn, mx, jnp.asarray(src))
     return normalize2D_minmax_novec(mn, mx, np.asarray(src))
 
@@ -126,13 +126,13 @@ def normalize2D_minmax(mn, mx, src, simd=None):
 def minmax2D(src, simd=None):
     """(min, max) of a plane (``inc/simd/normalize.h:59-64``)."""
     _check_2d(src)
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="normalize"):
         return _minmax2d(jnp.asarray(src))
     return minmax2D_novec(np.asarray(src))
 
 
 def minmax1D(src, simd=None):
     """(min, max) of a float array (``inc/simd/normalize.h:81-90``)."""
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="normalize"):
         return _minmax1d(jnp.asarray(src))
     return minmax1D_novec(np.asarray(src))

@@ -144,11 +144,12 @@ def resample_poly(x, up: int, down: int, taps=None, simd=None):
     up, down, taps = _normalize_resample_args(np.shape(x)[-1], up, down,
                                               taps)
     if up == 1 and down == 1:
-        return jnp.asarray(x, jnp.float32) if resolve_simd(simd) \
-            else np.asarray(x, np.float32)
+        return (jnp.asarray(x, jnp.float32)
+                if resolve_simd(simd, op="resample")
+                else np.asarray(x, np.float32))
     n = np.shape(x)[-1]
     out_len = resample_length(n, up, down)
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="resample"):
         return _resample_conv(jnp.asarray(x, jnp.float32),
                               jnp.asarray(taps, jnp.float32),
                               up, down, out_len)
@@ -201,7 +202,7 @@ def upfirdn(h, x, up: int = 1, down: int = 1, simd=None):
     k = len(h)
     dilated = (n - 1) * up + 1
     out_len = -(-(dilated + k - 1) // down)
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="resample"):
         # full span: left pad k-1 (conv start), right pad to cover the
         # last strided window
         pad = (k - 1, max(0, (out_len - 1) * down + k
@@ -308,7 +309,7 @@ def resample_fourier(x, num: int, simd=None):
         raise ValueError(f"num must be >= 1, got {num}")
     if np.shape(x)[-1] == 0:
         raise ValueError("empty signal")
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="resample"):
         return _resample_fourier_xla(jnp.asarray(x, jnp.float32), num)
     return resample_fourier_na(x, num).astype(np.float32)
 

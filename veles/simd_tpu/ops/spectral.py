@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from veles.simd_tpu import obs
 from veles.simd_tpu.utils.config import resolve_simd
 # complex host<->device moves MUST go through to_device/to_host: the
 # axon relay cannot transfer complex buffers in either direction and one
@@ -108,6 +109,27 @@ def _resolve_window(window, length: int, dtype=np.float32) -> np.ndarray:
     return window
 
 
+def _framing_r(frame_length: int, hop: int) -> int:
+    """Reshape-decomposition order for the device framing paths: ``r =
+    frame_length // hop`` when that path applies, else 0 (gather).
+
+    The SINGLE home of the decision :func:`_take_frames`, its adjoint
+    :func:`_overlap_add`, and the telemetry layer all share — r bounds
+    the unroll (r slices + an r-operand stack); past ~16 the op-count
+    cost eats the gather win (measured win was at r=4).  Retune here,
+    nowhere else."""
+    r = frame_length // hop if frame_length % hop == 0 else 0
+    return r if 1 <= r <= 16 else 0
+
+
+def _framing_path(frame_length: int, hop: int) -> str:
+    """Telemetry name for the framing decision (the 99x STFT PR),
+    computed OUTSIDE traced code so the public entry points can record
+    it per call."""
+    return ("reshape_interleave" if _framing_r(frame_length, hop)
+            else "gather")
+
+
 def _take_frames(x, frame_length, hop):
     """``[..., n] -> [..., frames, frame_length]`` on device.
 
@@ -118,13 +140,11 @@ def _take_frames(x, frame_length, hop):
     row gather.  Measured on v5e (128k signal, fl=1024, hop=256): the
     ``jnp.take`` gather was 91% of STFT time (3,730 of 4,092 us); this
     form cut the whole STFT to 40 us — 33 -> 3,262 Msamples/s (99x).
-    Other hops keep the gather."""
+    Other hops keep the gather (routing lives in :func:`_framing_r`)."""
     n = x.shape[-1]
     frames = frame_count(n, frame_length, hop)
-    r = frame_length // hop if frame_length % hop == 0 else 0
-    # r bounds the unroll (r slices + an r-operand stack); past ~16
-    # the op-count cost eats the gather win (measured win was at r=4)
-    if not 1 <= r <= 16:
+    r = _framing_r(frame_length, hop)
+    if r == 0:
         idx = jnp.asarray(_frame_indices(n, frame_length, hop))
         return jnp.take(x, idx, axis=-1)
     c_max = -(-frames // r)
@@ -160,7 +180,11 @@ def stft(x, frame_length: int, hop: int, window=None, simd=None):
     x_np = np.asarray(x) if not hasattr(x, "shape") else x
     _check_stft_args(x_np.shape[-1], frame_length, hop)
     window = _resolve_window(window, frame_length)
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="stft"):
+        obs.record_decision(
+            "stft", _framing_path(frame_length, hop),
+            n=int(x_np.shape[-1]), frame_length=int(frame_length),
+            hop=int(hop))
         return _stft_xla(jnp.asarray(x, jnp.float32), jnp.asarray(window),
                          frame_length, hop)
     return stft_na(x, frame_length, hop, window).astype(np.complex64)
@@ -199,10 +223,10 @@ def _overlap_add(frames, n, frame_length, hop):
     so each class is a reshape placed at its offset and the scatter
     becomes ``r`` full-length adds (the ``.at[].add`` scatter was the
     whole ISTFT cost on v5e: 4,758 of 4,800 us at 128k/1024/256).
-    Other hops keep the scatter."""
+    Other hops keep the scatter (routing lives in :func:`_framing_r`)."""
     F = frames.shape[-2]
-    r = frame_length // hop if frame_length % hop == 0 else 0
-    if not 1 <= r <= 16:
+    r = _framing_r(frame_length, hop)
+    if r == 0:
         idx = jnp.asarray(_frame_indices(n, frame_length, hop))
         out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
         return out.at[..., idx].add(frames)
@@ -246,7 +270,14 @@ def istft(spec, n: int, frame_length: int, hop: int, window=None,
             f"spec shape {spec_np.shape[-2:]} inconsistent with n={n}, "
             f"frame_length={frame_length}, hop={hop} (expect "
             f"{(frames, frame_length // 2 + 1)})")
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="istft"):
+        # the adjoint decomposition: framing gather <-> overlap-add
+        # scatter, framing reshape <-> per-phase reshape adds
+        path = ("scatter" if _framing_path(frame_length, hop) == "gather"
+                else "reshape_overlap_add")
+        obs.record_decision(
+            "istft", path, n=int(n), frame_length=int(frame_length),
+            hop=int(hop))
         return _istft_xla(to_device(spec, jnp.complex64),
                           jnp.asarray(window), jnp.asarray(env_inv),
                           n, frame_length, hop)
@@ -270,7 +301,7 @@ def istft_na(spec, n: int, frame_length: int, hop: int, window=None):
 def spectrogram(x, frame_length: int, hop: int, window=None, simd=None):
     """Power spectrogram ``|STFT|^2`` -> f32 [..., frames, bins]."""
     s = stft(x, frame_length, hop, window, simd=simd)
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="spectrogram"):
         return (s.real ** 2 + s.imag ** 2).astype(jnp.float32)
     return (np.abs(s) ** 2).astype(np.float32)
 
@@ -310,7 +341,7 @@ def hilbert(x, simd=None):
     if n == 0:
         raise ValueError("empty signal")
     mult = _analytic_multiplier(n)
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="hilbert"):
         return _hilbert_xla(jnp.asarray(x, jnp.float32), jnp.asarray(mult))
     return hilbert_na(x).astype(np.complex64)
 
@@ -326,7 +357,7 @@ def envelope(x, simd=None):
     """Instantaneous amplitude ``|analytic(x)|`` (f32 [..., n]) — the
     classic matched-filter post-processing step."""
     a = hilbert(x, simd=simd)
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="envelope"):
         return jnp.abs(a).astype(jnp.float32)
     return np.abs(a).astype(np.float32)
 
@@ -367,7 +398,7 @@ def morlet_cwt(x, scales, w0: float = 6.0, simd=None):
                          f"got {scales!r}")
     n = np.shape(x)[-1]
     hat = _morlet_hat(scales, n, w0)
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="morlet_cwt"):
         return _cwt_xla(jnp.asarray(x, jnp.float32),
                         to_device(hat, jnp.complex64))
     return morlet_cwt_na(x, scales, w0).astype(np.complex64)
@@ -397,11 +428,11 @@ def detrend(x, type: str = "linear", simd=None,  # noqa: A002
         raise ValueError(f"type must be 'linear' or 'constant', "
                          f"got {type!r}")
     if axis not in (-1, np.ndim(x) - 1):
-        xp = jnp if resolve_simd(simd) else np
+        xp = jnp if resolve_simd(simd, op="detrend") else np
         moved = xp.moveaxis(xp.asarray(x), axis, -1)
         return xp.moveaxis(detrend(moved, type, simd=simd), -1, axis)
     n = np.shape(x)[-1]
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="detrend"):
         xj = jnp.asarray(x, jnp.float32)
         if type == "constant":
             return xj - jnp.mean(xj, axis=-1, keepdims=True)
@@ -521,7 +552,7 @@ def welch(x, fs: float = 1.0, nperseg: int = 256, noverlap=None,
     ``freqs`` is a host-side float64 array.  The segment pipeline is
     the same framing gather + batched rfft as :func:`stft`.
     """
-    use = resolve_simd(simd)
+    use = resolve_simd(simd, op="welch")
     f, p = _spectral_helper(x, x, float(fs), nperseg, noverlap, window,
                             detrend_type, scaling, use)
     if use:
@@ -546,7 +577,7 @@ def periodogram(x, fs: float = 1.0, window=None, scaling: str = "density",
     n = np.shape(x)[-1]
     window = (np.ones(n, np.float64) if window is None
               else _resolve_window(window, n, np.float64))
-    use = resolve_simd(simd)
+    use = resolve_simd(simd, op="periodogram")
     f, p = _spectral_helper(x, x, float(fs), n, 0, window, detrend_type,
                             scaling, use)
     if use:
@@ -570,7 +601,7 @@ def csd(x, y, fs: float = 1.0, nperseg: int = 256, noverlap=None,
         scaling: str = "density", simd=None):
     """Cross-spectral density ``Pxy`` (scipy's ``csd``): complex64
     ``[..., bins]``."""
-    use = resolve_simd(simd)
+    use = resolve_simd(simd, op="csd")
     f, p = _spectral_helper(x, y, float(fs), nperseg, noverlap, window,
                             detrend_type, scaling, use)
     if use:
@@ -604,7 +635,7 @@ def coherence(x, y, fs: float = 1.0, nperseg: int = 256, noverlap=None,
               window=None, simd=None):
     """Magnitude-squared coherence ``|Pxy|^2 / (Pxx Pyy)`` in [0, 1]
     (scipy's ``coherence``)."""
-    use = resolve_simd(simd)
+    use = resolve_simd(simd, op="coherence")
     f, coh = _coherence_impl(x, y, fs, nperseg, noverlap, window, use)
     if use:
         return f, coh.astype(jnp.float32)
@@ -670,7 +701,7 @@ def czt(x, m=None, w=None, a=1.0, simd=None):
     if w is None:
         w = np.exp(-2j * np.pi / m)
     pre, kern_f, post, nfft = _czt_constants(n, m, w, a)
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="czt"):
         return _czt_xla(to_device(x), to_device(pre),
                         to_device(kern_f), to_device(post), m, nfft)
     # host fallback: the SAME Bluestein convolution in float64 numpy —
@@ -808,7 +839,7 @@ def lombscargle(t, x, freqs, simd=None, weights=None):
     uses it for arbitrary lengths) and for per-sample confidence.
     """
     t, x_np, freqs, w_np = _check_lombscargle_args(t, x, freqs, weights)
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="lombscargle"):
         # center the time base in float64 BEFORE the f32 cast: Scargle's
         # tau makes the estimate exactly time-shift invariant, and raw
         # offset timestamps (e.g. Julian dates ~2.45e6) would otherwise

@@ -66,7 +66,7 @@ def chirp(t, f0, t1, f1, method: str = "linear", phi: float = 0.0,
     frequency runs from ``f0`` at t=0 to ``f1`` at ``t1`` along a
     linear / quadratic / logarithmic / hyperbolic law.  ``phi`` is the
     initial phase in degrees (scipy convention)."""
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="waveforms"):
         tj = jnp.asarray(t, jnp.float32)
         phase = _chirp_phase(tj, f0, t1, f1, method, jnp)
         return jnp.cos(phase + math.radians(float(phi)))
@@ -104,7 +104,7 @@ def square(t, duty: float = 0.5, simd=None):
     the first ``duty`` fraction of each cycle, -1 after (scipy's
     ``square``)."""
     duty = _check_frac(duty, "duty")
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="waveforms"):
         return _square_core(jnp.asarray(t, jnp.float32), duty,
                             jnp).astype(jnp.float32)
     return square_na(t, duty).astype(np.float32)
@@ -120,7 +120,7 @@ def sawtooth(t, width: float = 1.0, simd=None):
     rises -1→1 over the first ``width`` fraction of the cycle, falls
     back over the rest (``width=0.5`` is a symmetric triangle)."""
     width = _check_frac(width, "width")
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="waveforms"):
         return _sawtooth_core(jnp.asarray(t, jnp.float32), width,
                               jnp).astype(jnp.float32)
     return sawtooth_na(t, width).astype(np.float32)
@@ -149,7 +149,7 @@ def gausspulse(t, fc: float = 1000.0, bw: float = 0.5,
     carrier ``fc`` Hz, fractional bandwidth ``bw`` measured ``bwr`` dB
     down the spectral envelope."""
     a = _gauss_a(fc, bw, bwr)
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="waveforms"):
         tj = jnp.asarray(t, jnp.float32)
         return (jnp.exp(-a * tj * tj)
                 * jnp.cos(2 * math.pi * float(fc) * tj))
@@ -176,7 +176,7 @@ def unit_impulse(n: int, idx: int = 0, simd=None):
         raise ValueError(f"idx {idx} outside [0, {n})")
     out = np.zeros(n, np.float32)
     out[idx] = 1.0
-    return jnp.asarray(out) if resolve_simd(simd) else out
+    return jnp.asarray(out) if resolve_simd(simd, op="waveforms") else out
 
 
 # the standard primitive-polynomial tap table (scipy's _mls_taps)

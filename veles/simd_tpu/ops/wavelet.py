@@ -65,6 +65,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from veles.simd_tpu import obs
 from veles.simd_tpu.ops import pallas_kernels as _pk
 from veles.simd_tpu.ops.wavelet_coeffs import (
     WaveletType, qmf_highpass, scaling_coefficients, supported_orders,
@@ -251,11 +252,16 @@ def wavelet_apply(type, order, ext, src, simd=None):
     """Single DWT analysis step (``wavelet_apply``,
     ``inc/simd/wavelet.h:80-97``): returns ``(desthi, destlo)`` of length
     ``length/2`` each."""
-    if not resolve_simd(simd):
+    if not resolve_simd(simd, op="wavelet_apply"):
         return wavelet_apply_na(type, order, ext, src)
     src = jnp.asarray(src)
     _check_apply_args(type, order, src.shape[-1])
-    if _use_pallas(src.shape, int(order), 1, 2):
+    use_pk = _use_pallas(src.shape, int(order), 1, 2)
+    obs.record_decision(
+        "wavelet_apply", "pallas" if use_pk else "xla_conv",
+        family=WaveletType(type).value, order=int(order),
+        ext=ExtensionType(ext).value, length=int(src.shape[-1]))
+    if use_pk:
         return _filter_bank_pallas(src, WaveletType(type), int(order),
                                    ExtensionType(ext), 2, 1,
                                    src.shape[-1] // 2)
@@ -268,13 +274,19 @@ def stationary_wavelet_apply(type, order, level, ext, src, simd=None):
     """Single SWT (à-trous) step at ``level`` ≥ 1
     (``stationary_wavelet_apply``, ``inc/simd/wavelet.h:119-139``):
     returns ``(desthi, destlo)`` of length ``length`` each."""
-    if not resolve_simd(simd):
+    if not resolve_simd(simd, op="stationary_wavelet_apply"):
         return stationary_wavelet_apply_na(type, order, level, ext, src)
     src = jnp.asarray(src)
     _check_apply_args(type, order, src.shape[-1])
     if level < 1:
         raise ValueError("level must be >= 1")
-    if _use_pallas(src.shape, int(order), 1 << (level - 1), 1):
+    use_pk = _use_pallas(src.shape, int(order), 1 << (level - 1), 1)
+    obs.record_decision(
+        "stationary_wavelet_apply", "pallas" if use_pk else "xla_conv",
+        family=WaveletType(type).value, order=int(order),
+        level=int(level), ext=ExtensionType(ext).value,
+        length=int(src.shape[-1]))
+    if use_pk:
         return _filter_bank_pallas(src, WaveletType(type), int(order),
                                    ExtensionType(ext), 1, 1 << (level - 1),
                                    src.shape[-1])
@@ -429,10 +441,17 @@ def wavelet_transform(type, order, ext, src, levels, simd=None):
     :func:`_use_fused_cascade`).
     """
     levels = int(levels)
-    if resolve_simd(simd):
+    if resolve_simd(simd, op="wavelet_transform"):
         src_j = jnp.asarray(src)
         _check_apply_args(type, order, src_j.shape[-1])
-        if _use_fused_cascade(src_j.shape, int(order), ext, levels):
+        fused = _use_fused_cascade(src_j.shape, int(order), ext, levels)
+        obs.record_decision(
+            "wavelet_transform",
+            "fused_cascade" if fused else "level_loop",
+            family=WaveletType(type).value, order=int(order),
+            levels=levels, ext=ExtensionType(ext).value,
+            length=int(src_j.shape[-1]))
+        if fused:
             return list(_fused_cascade(src_j, WaveletType(type),
                                        int(order), levels))
         src = src_j
@@ -833,7 +852,7 @@ def wavelet_reconstruct(type, order, desthi, destlo, simd=None,
     the round trip cannot be — the analysis is rank-deficient).  Tests in
     ``tests/test_wavelet_synthesis.py`` pin both guarantees.
     """
-    if not resolve_simd(simd):
+    if not resolve_simd(simd, op="wavelet"):
         return wavelet_reconstruct_na(type, order, desthi, destlo, ext=ext)
     desthi, destlo = jnp.asarray(desthi), jnp.asarray(destlo)
     _check_synth_args(type, order, desthi, destlo)
@@ -867,7 +886,7 @@ def stationary_wavelet_reconstruct(type, order, level, desthi, destlo,
     plus, for non-PERIODIC ``ext`` (which must match the analysis), a
     Woodbury boundary correction on the normal equations (needs
     ``length >= 2*order*2^(level-1)``)."""
-    if not resolve_simd(simd):
+    if not resolve_simd(simd, op="wavelet"):
         return stationary_wavelet_reconstruct_na(type, order, level,
                                                  desthi, destlo, ext=ext)
     desthi, destlo = jnp.asarray(desthi), jnp.asarray(destlo)
@@ -954,7 +973,7 @@ def wavelet_packet_transform(type, order, ext, src, levels, simd=None):
     levels = int(levels)
     if levels < 1:
         raise ValueError("levels must be >= 1")
-    xp = jnp if resolve_simd(simd) else np
+    xp = jnp if resolve_simd(simd, op="wavelet") else np
     # one stacked dispatch per level (all bands at a level share a
     # length), as wavelet_apply2d does for its column pass — 2^l
     # sequential calls would waste dispatches and shrink the batch the
@@ -978,7 +997,7 @@ def wavelet_packet_inverse_transform(type, order, coeffs, simd=None,
     if n < 2 or n & (n - 1):
         raise ValueError(
             f"need 2^levels leaf bands, got {n}")
-    xp = jnp if resolve_simd(simd) else np
+    xp = jnp if resolve_simd(simd, op="wavelet") else np
     stack = xp.stack([xp.asarray(b) for b in bands])   # [2m, ..., len]
     while stack.shape[0] > 1:
         pairs = stack.reshape((stack.shape[0] // 2, 2) + stack.shape[1:])
@@ -1005,7 +1024,7 @@ def _separable_apply2d(rows, src, simd, what):
     Returns ``(ll, lh, hl, hh)``."""
     if np.ndim(src) < 2:
         raise ValueError(f"{what} needs [..., n0, n1]")
-    xp = jnp if resolve_simd(simd) else np
+    xp = jnp if resolve_simd(simd, op="wavelet") else np
     hi_r, lo_r = rows(xp.asarray(src))                # along n1
     bands, lows = _apply_last(rows, xp.stack([hi_r, lo_r]))
     hh, lh = bands[0], bands[1]
@@ -1016,7 +1035,7 @@ def _separable_apply2d(rows, src, simd, what):
 def _separable_reconstruct2d(synth, ll, lh, hl, hh, simd):
     """Shared separable-2D synthesis plumbing: one stacked column
     synthesis for both row bands, then the row synthesis."""
-    xp = jnp if resolve_simd(simd) else np
+    xp = jnp if resolve_simd(simd, op="wavelet") else np
     hi_b = xp.stack([xp.asarray(hh), xp.asarray(lh)]).swapaxes(-1, -2)
     lo_b = xp.stack([xp.asarray(hl), xp.asarray(ll)]).swapaxes(-1, -2)
     rec = synth(hi_b, lo_b).swapaxes(-1, -2)
@@ -1084,7 +1103,7 @@ def wavelet_packet_transform2d(type, order, ext, src, levels, simd=None):
     levels = int(levels)
     if levels < 1:
         raise ValueError("levels must be >= 1")
-    xp = jnp if resolve_simd(simd) else np
+    xp = jnp if resolve_simd(simd, op="wavelet") else np
     stack = xp.asarray(src)[None]               # [m=1, ..., n0, n1]
     for _ in range(levels):
         quad = wavelet_apply2d(type, order, ext, stack, simd=simd)
@@ -1106,7 +1125,7 @@ def wavelet_packet_inverse_transform2d(type, order, coeffs, simd=None,
         levels += 1
     if n < 4 or 4 ** levels != n:
         raise ValueError(f"need 4^levels leaf bands, got {n}")
-    xp = jnp if resolve_simd(simd) else np
+    xp = jnp if resolve_simd(simd, op="wavelet") else np
     stack = xp.stack([xp.asarray(b) for b in bands])
     while stack.shape[0] > 1:
         quads = stack.reshape((stack.shape[0] // 4, 4) + stack.shape[1:])

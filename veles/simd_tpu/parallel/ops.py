@@ -17,8 +17,24 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5 keeps shard_map in experimental
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from veles.simd_tpu import obs
+
+
+def _axis_size(axis_name) -> int:
+    """Static size of a named mesh axis, from inside ``shard_map``.
+
+    ``jax.lax.axis_size`` only exists on newer jax; older releases get
+    the same Python int from the constant-folded ``psum(1, axis)``."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
 
 __all__ = ["sharded_convolve", "sharded_convolve_ring",
            "sharded_convolve_batch",
@@ -50,7 +66,7 @@ def halo_exchange_left(x_local, halo_len: int, axis_name: str,
     ring over ICI) — the synthesis-side mirror of
     ``halo_exchange_right(..., periodic=True)``.
     """
-    n_shards = jax.lax.axis_size(axis_name)
+    n_shards = _axis_size(axis_name)
     block = x_local.shape[-1]
     tail = x_local[..., block - halo_len:]  # empty when halo_len == 0
     perm = [(i, i + 1) for i in range(n_shards - 1)]
@@ -68,7 +84,7 @@ def halo_exchange_right(x_local, halo_len: int, axis_name: str,
     boundary extension (``src/wavelet.c:248-269``); otherwise the last
     shard receives zeros.
     """
-    n_shards = jax.lax.axis_size(axis_name)
+    n_shards = _axis_size(axis_name)
     head = x_local[..., :halo_len]
     perm = [(i, i - 1) for i in range(1, n_shards)]
     if periodic:
@@ -124,6 +140,10 @@ def sharded_convolve(x, h, mesh: Mesh, axis: str = "sp"):
         # pipeline, the same spirit as convolve_initialize's algorithm
         # auto-select (src/convolve.c:328-366)
         return sharded_convolve_ring(x, h, mesh, axis=axis)
+    obs.record_decision(
+        "sharded_convolve", "one_hop_halo", n_shards=int(n_shards),
+        axis=axis, x_length=int(n), h_length=int(k),
+        block=int(pad_to // n_shards), halo=int(k - 1))
     x_pad = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad_to - n)])
     # leading batch dims (if any) stay replicated; shard the length
     spec = P(*([None] * (x.ndim - 1) + [axis]))
@@ -183,6 +203,10 @@ def sharded_convolve_ring(x, h, mesh: Mesh, axis: str = "sp",
         pads[0] = (0, batch_pad)
     x_pad = jnp.pad(x, pads)
     hops = min(-(-(k - 1) // blk), n_shards - 1)
+    obs.record_decision(
+        "sharded_convolve", "ring", n_shards=int(n_shards), axis=axis,
+        x_length=int(n), h_length=int(k), block=int(blk),
+        hops=int(hops))
     # h segments: seg_m = h_pp[m·blk : m·blk + 2·blk - 1] with h_pp
     # left-padded blk-1 and right-padded so the last slice is in range
     h_pp = jnp.pad(h, (blk - 1, (hops + 2) * blk))
@@ -855,6 +879,10 @@ def sharded_matmul(a, b, mesh: Mesh, axis: str = "tp"):
     if a.shape[-1] != b.shape[-2]:
         raise ValueError(f"contracting dims differ: {a.shape} @ {b.shape}")
     shards = mesh.shape[axis]
+    obs.record_decision(
+        "sharded_matmul", "contracting_dim", n_shards=int(shards),
+        axis=axis, m=int(a.shape[-2]), k=int(a.shape[-1]),
+        n=int(b.shape[-1]))
     rem = a.shape[-1] % shards
     if rem:
         pad = shards - rem
@@ -915,6 +943,10 @@ def sharded_stft(x, frame_length: int, hop: int, mesh: Mesh,
     n = x.shape[-1]
     n_shards = mesh.shape[axis]
     block, halo = _check_stft_sharding(n, frame_length, hop, n_shards)
+    obs.record_decision(
+        "sharded_stft", "right_halo", n_shards=int(n_shards), axis=axis,
+        n=int(n), frame_length=int(frame_length), hop=int(hop),
+        block=int(block), halo=int(halo))
     window = jnp.asarray(sp._resolve_window(window, frame_length))
     # per-shard framing layout == the single-chip layout on block + halo
     # samples (frame_count(block + halo, fl, hop) == block // hop)
@@ -983,7 +1015,7 @@ def sharded_istft(spec, n: int, frame_length: int, hop: int, mesh: Mesh,
         # .at[].add scatter on dividing hops) on the local block+halo
         buf = sp._overlap_add(frames, block + halo, frame_length, hop)
         overflow = buf[..., block:]  # [..., halo] — right neighbour's head
-        n_sh = jax.lax.axis_size(axis)
+        n_sh = _axis_size(axis)
         recv = jax.lax.ppermute(overflow, axis,
                                 [(i, i + 1) for i in range(n_sh - 1)])
         head = buf[..., :halo] + recv

@@ -40,12 +40,20 @@ def rms_normalize(p, eps: float = 1e-30):
 
 
 def _sync(out):
-    """Force completion of `out` (any jax array / pytree leaf)."""
+    """Force completion of `out` (any jax array / pytree of them).
+
+    Empty pytrees (``None``, ``{}``, ``[]``) and non-array leaves
+    (Python scalars, strings, host metadata riding along in a result
+    dict) have nothing to wait on — they are skipped rather than
+    crashing the timer; the sync targets the LAST array leaf, which on
+    a single-stream device orders after everything before it."""
     import jax
 
-    leaves = jax.tree.leaves(out)
-    last = leaves[-1]
-    np.asarray(last.ravel()[-1:] if hasattr(last, "ravel") else last)
+    leaves = [leaf for leaf in jax.tree.leaves(out)
+              if hasattr(leaf, "ravel")]
+    if not leaves:
+        return
+    np.asarray(leaves[-1].ravel()[-1:])
 
 
 def device_time(fn, *, burst: int = 8, repeats: int = 3,

@@ -10,6 +10,11 @@ TPU/XLA path; ``Backend.ORACLE`` runs the NumPy reference twin.  Every public
 op accepts the reference-compatible boolean ``simd=`` keyword (truthy → XLA)
 so the oracle-testing pattern survives unchanged, and a process-wide default
 can be set with :func:`set_backend` (used by the test-suite to cross-validate).
+
+Dispatch accounting: :func:`resolve_simd` is the single gate every public
+op passes through, so it doubles as the XLA-vs-ORACLE tally point — call
+sites that pass ``op=`` get one ``dispatch{op=..., backend=...}`` counter
+bump in :mod:`veles.simd_tpu.obs` (a no-op unless telemetry is enabled).
 """
 
 from __future__ import annotations
@@ -18,6 +23,8 @@ import dataclasses
 import enum
 import os
 import threading
+
+from veles.simd_tpu import obs as _obs
 
 
 class Backend(enum.Enum):
@@ -42,15 +49,23 @@ def set_backend(backend: Backend) -> Backend:
     return prev
 
 
-def resolve_simd(simd) -> bool:
+def resolve_simd(simd, op: str | None = None) -> bool:
     """Resolve the reference-style ``simd`` flag to "use the XLA path?".
 
     ``None`` defers to the process default; any other value is truthiness,
     matching the reference's ``int simd`` C flag semantics.
+
+    ``op`` (optional) names the public entry point for telemetry: when
+    given, the resolved backend is counted under
+    ``dispatch{op=..., backend=xla|oracle}`` — one dict increment when
+    telemetry is on, one branch when it is off.  The count happens at
+    the Python dispatch layer, never inside traced code.
     """
-    if simd is None:
-        return get_backend() is Backend.XLA
-    return bool(simd)
+    use = get_backend() is Backend.XLA if simd is None else bool(simd)
+    if op is not None:
+        _obs.count("dispatch", op=op,
+                   backend="xla" if use else "oracle")
+    return use
 
 
 @dataclasses.dataclass(frozen=True)

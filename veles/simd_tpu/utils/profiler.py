@@ -1,4 +1,12 @@
-"""Profiling & plan-cache persistence (the framework's observability layer).
+"""Tracing & plan-cache persistence (the framework's *tracing* layer).
+
+Scope: this module answers **where the time goes** inside a step — XLA
+profiler timelines and persistent compile caching.  It is NOT the
+observability layer as a whole: **what was decided** (algorithm
+selections, XLA-vs-oracle dispatch tallies, compile/cache hit counts)
+lives in :mod:`veles.simd_tpu.obs`, the runtime telemetry package.  Use
+both together: telemetry tells you *which* path served your traffic,
+a trace tells you *why* that path cost what it did.
 
 The reference's entire profiling story is ``std::chrono`` around
 synchronous calls (``/root/reference/tests/benchmark.inc:74-107``) and
@@ -13,11 +21,14 @@ its only persistent state is in-memory FFT plans
   process re-loads compiled XLA/Mosaic binaries from disk instead of
   recompiling (first compiles cost 10-40 s through a remote-relay
   backend, so this is the difference between instant and minute-scale
-  warmup for repeat workloads).
+  warmup for repeat workloads).  With telemetry enabled
+  (``obs.enable()``), cache hit/miss counts and retrieval times show up
+  in the ``compile.*`` metrics via the ``jax.monitoring`` bridge
+  (:mod:`veles.simd_tpu.obs.compile`).
 
 Wall-clock timing belongs to :mod:`veles.simd_tpu.utils.benchmark`
 (``device_time_chained``); this module is for *where the time goes*, not
-how much there is.
+how much there is nor what was decided.
 """
 
 from __future__ import annotations
