@@ -20,14 +20,22 @@ real hardware) runs LAST and prints one ``TPU-CHECK`` line per family to
 stderr — measured live (2026-07-31): the relay wedged mid-smoke, so the
 smoke must never be able to shadow a timing config.
 
-Wedge watchdog: the axon relay has twice been observed to wedge
+Wedge containment: the axon relay has twice been observed to wedge
 *mid-run* — an in-flight device call then blocks forever, unkillable
-from Python.  A daemon thread therefore tracks per-stage progress; if a
-stage stalls past $VELES_SIMD_STAGE_TIMEOUT (default 300 s; compiles
-take ~20-40 s; 0 disables), it prints which stage wedged and hard-exits:
-rc=0 once
-the headline line is out (whatever completed is on disk), rc=2 before
-that (the driver's no-data signal, same as ``require_reachable_device``).
+from Python.  Every stage (headline, timed configs, each smoke family)
+therefore runs in a supervised worker thread with a
+$VELES_SIMD_STAGE_TIMEOUT budget (default 300 s; compiles take
+~20-40 s; 0 disables supervision): a stage that stalls past its budget
+is SKIPPED — its thread is abandoned (daemon, blocked in native code),
+the skip is recorded in BENCH_DETAILS.json's tail entry
+(``{"skipped_stages": [...]}``), and the run continues with the
+remaining stages (round 5 lost the iir/filters/waveforms/peaks/pallas/
+parallel rows to a single ``smoke:resample`` wedge under the old
+hard-exit design).  A last-resort watchdog still hard-exits if the
+skip machinery itself stops making progress (3x the stage budget):
+rc=0 once the headline line is out, rc=2 before that (the driver's
+no-data signal, same as ``require_reachable_device``); a skipped
+headline also exits rc=2 after the remaining stages have run.
 
 Usage:  python bench.py           # one JSON line on stdout (first!)
         python bench.py --all     # pretty table of every config
@@ -46,7 +54,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from veles.simd_tpu import obs
 from veles.simd_tpu.utils.benchmark import (
-    device_time_chained, host_time, rms_normalize)
+    conv_roofline, device_time_chained, host_time, rms_normalize)
 
 
 def _telemetry_entry():
@@ -177,9 +185,23 @@ def bench_convolve_1m(rng):
     t = device_time_chained(step, xd)
     t_base = host_time(lambda: cv._conv_overlap_save_na(
         x, h, handle.block_length), repeats=2)
-    return {"metric": "convolve 1M x 2047 overlap-save",
-            "unit": "Msamples/s",
-            "value": n / t / 1e6, "baseline": n / t_base / 1e6}
+    out = {"metric": "convolve 1M x 2047 overlap-save",
+           "unit": "Msamples/s",
+           "value": n / t / 1e6, "baseline": n / t_base / 1e6}
+    # roofline attribution: effective TFLOP/s (2k useful FLOPs per
+    # output sample) against the f32 MXU bound at the active precision
+    # knob — the driver-captured form of BASELINE.md's 69% accounting
+    # (omitted when the timer could not resolve: NaN in the JSON tail
+    # would break strict parsers)
+    if np.isfinite(t):
+        roof = conv_roofline(n / t, k, cv.os_precision())
+        print(f"CONV-ROOFLINE 1Mx2047: {roof['tflops_effective']:.1f} "
+              f"TFLOP/s effective = {roof['pct_of_roofline']:.0f}% of "
+              f"the f32-{roof['precision'].upper()} MXU bound "
+              f"({roof['roofline_bound_tflops']:.1f} TFLOP/s)",
+              file=sys.stderr)
+        out["roofline"] = roof
+    return out
 
 
 def bench_dwt(rng):
@@ -232,12 +254,17 @@ def _warm_device(seconds: float = 1.0):
 
 
 class _StageWatchdog:
-    """Hard-exit the process when a device stage stalls (wedged relay).
+    """LAST-RESORT hard exit when the skip machinery itself stops
+    making progress (wedged relay blocking the MAIN thread, e.g. in
+    between-stage device work that no per-stage budget covers).
 
     A wedged in-flight device call blocks in native code and cannot be
     interrupted from Python, so the only safe recovery is process exit —
     acceptable here because every completed result is already flushed to
-    stdout/BENCH_DETAILS.json before the next stage starts.
+    stdout/BENCH_DETAILS.json before the next stage starts.  Per-stage
+    wedges are handled one level up by :class:`_StageRunner` (skip and
+    continue); this watchdog's threshold is a multiple of the stage
+    budget so it only fires when that layer is itself stuck.
     """
 
     def __init__(self, timeout_s: float):
@@ -245,6 +272,7 @@ class _StageWatchdog:
         self._lock = threading.Lock()
         self._stage = "(startup)"
         self._t0 = time.monotonic()
+        self._stopped = False
         self.headline_out = False
         if timeout_s > 0:  # 0 disables, matching $VELES_SIMD_DEVICE_WAIT=0
             threading.Thread(target=self._watch, daemon=True).start()
@@ -254,20 +282,102 @@ class _StageWatchdog:
             self._stage = name
             self._t0 = time.monotonic()
 
+    def stop(self) -> None:
+        """Disarm on normal completion — the run is over, nothing left
+        to guard (and an in-process caller, e.g. the test-suite, must
+        not be hard-exited by a leftover daemon minutes later)."""
+        with self._lock:
+            self._stopped = True
+
     def _watch(self) -> None:
         while True:
             time.sleep(5.0)
             with self._lock:
+                if self._stopped:
+                    return
                 stalled = time.monotonic() - self._t0
                 stage = self._stage
             if stalled > self.timeout_s:
                 print(f"bench.py: stage {stage!r} stalled for "
-                      f"{stalled:.0f}s (> {self.timeout_s:.0f}s) — relay "
-                      "wedge; exiting with the results captured so far",
+                      f"{stalled:.0f}s (> {self.timeout_s:.0f}s) past "
+                      "the per-stage skip layer — relay wedge; exiting "
+                      "with the results captured so far",
                       file=sys.stderr)
                 sys.stderr.flush()
                 sys.stdout.flush()
                 os._exit(0 if self.headline_out else 2)
+
+
+class _StageRunner:
+    """Run each bench stage in a supervised worker thread; skip the
+    stage (and keep going) when it stalls past the budget.
+
+    A wedged device call cannot be cancelled, so the stalled worker is
+    simply abandoned — it is a daemon thread blocked in native code and
+    dies with the process.  The runner records every skip (and every
+    stage that raised) so the bench JSON tail can say exactly which
+    rows are missing and why, instead of the round-5 behavior where one
+    ``smoke:resample`` wedge hard-exited the process and silently cost
+    every remaining family row.
+
+    KNOWN TRADE-OFF: a stage that was merely SLOW (not truly wedged)
+    may resume after being skipped and run concurrently with later
+    stages — its device work and obs events then bleed into the next
+    config's telemetry/timings.  Stage-private RandomStates keep the
+    data draws race-free; the telemetry bleed is accepted (each config
+    still obs.reset()s first, and a truly wedged thread never wakes).
+    Size VELES_SIMD_STAGE_TIMEOUT above the slowest honest stage.
+
+    ``timeout_s <= 0`` disables supervision (stages run inline on the
+    main thread — the debugging mode).
+    """
+
+    _WEDGED = object()
+
+    def __init__(self, timeout_s: float, watchdog: _StageWatchdog):
+        self.timeout_s = timeout_s
+        self._watchdog = watchdog
+        self.skipped = []          # [{"stage": ..., "reason": ...}]
+
+    def run(self, name: str, fn):
+        """Execute ``fn()`` under the stage budget.  Returns ``(ok,
+        result)``; ``ok`` is False when the stage wedged (skip recorded)
+        or raised (error recorded) — the caller just moves on."""
+        self._watchdog.stage(name)
+        if self.timeout_s <= 0:
+            try:
+                return True, fn()
+            except Exception as e:  # noqa: BLE001 — record, keep going
+                return self._failed(name, e)
+        box = {}
+
+        def work():
+            try:
+                box["result"] = fn()
+            except Exception as e:  # noqa: BLE001
+                box["error"] = e
+
+        t = threading.Thread(target=work, daemon=True,
+                             name=f"bench-stage-{name}")
+        t.start()
+        t.join(self.timeout_s)
+        if t.is_alive():
+            print(f"bench.py: stage {name!r} stalled past "
+                  f"{self.timeout_s:.0f}s — relay wedge; skipping it "
+                  "and continuing with the remaining stages",
+                  file=sys.stderr)
+            self.skipped.append({"stage": name, "reason":
+                                 f"wedged (> {self.timeout_s:.0f}s)"})
+            return False, self._WEDGED
+        if "error" in box:
+            return self._failed(name, box["error"])
+        return True, box.get("result")
+
+    def _failed(self, name, e):
+        print(f"bench.py: stage {name!r} failed ({e!r}); continuing",
+              file=sys.stderr)
+        self.skipped.append({"stage": name, "reason": f"error: {e!r}"})
+        return False, e
 
 
 def main():
@@ -281,87 +391,141 @@ def main():
     require_reachable_device(wait=600.0)
     import jax
 
-    from tools.tpu_smoke import run_smoke
+    from tools.tpu_smoke import FAMILIES, run_smoke
 
-    dog = _StageWatchdog(
-        float(os.environ.get("VELES_SIMD_STAGE_TIMEOUT", "300")))
+    stage_timeout = float(os.environ.get("VELES_SIMD_STAGE_TIMEOUT",
+                                         "300"))
+    # the watchdog is the backstop for the skip layer itself: 3x the
+    # per-stage budget (a stage that wedges is skipped long before)
+    dog = _StageWatchdog(3 * stage_timeout)
+    runner = _StageRunner(stage_timeout, dog)
 
-    if "--check" in sys.argv:
-        # smoke-only mode: a wedge exits 2 (incomplete — the per-family
-        # lines already printed still stand), pass/fail exits 0/1
-        sys.exit(0 if run_smoke(on_start=lambda n: dog.stage(f"smoke:{n}"))
-                 else 1)
-
-    device = str(jax.devices()[0])
-    # telemetry ON for the whole run: every BENCH_DETAILS.json entry
-    # carries the algorithm decisions / compile counts behind its number
-    obs.enable()
-    obs.reset()
-    rng = np.random.RandomState(0)
-    results = []
-
-    def flush(r):
-        r["vs_baseline"] = r["value"] / r["baseline"]
-        r["device"] = device
-        # per-config telemetry (reset right after, so each entry's
-        # decisions/compiles are attributable to that config alone)
-        r["telemetry"] = _telemetry_entry()
-        obs.reset()
-        # device_time_chained returns NaN for unresolvable measurements;
-        # NaN is not valid strict JSON, so flag it and null the numbers
-        if not all(np.isfinite(r[k]) for k in ("value", "baseline",
-                                               "vs_baseline")):
-            r["flagged"] = "unresolved measurement (timer returned NaN)"
-            r = {k: (None if isinstance(v, float) and not np.isfinite(v)
-                     else v) for k, v in r.items()}
-        results.append(r)
-        with open("BENCH_DETAILS.json", "w") as f:
-            json.dump(results, f, indent=2, allow_nan=False)
-        if "--all" in sys.argv:
-            def fmt(v, spec):
-                return format(v, spec) if v is not None else "  (flagged)"
-            print(f"{r['metric']:36s} {fmt(r['value'], '12.1f')} "
-                  f"{r['unit']:11s} "
-                  f"(cpu-oracle {fmt(r['baseline'], '10.1f')}, "
-                  f"x{fmt(r['vs_baseline'], '.1f')})", file=sys.stderr)
-        return r
-
-    # headline first: warm clocks, measure, print the parseable line NOW —
-    # everything after this point is gravy if the device window closes
-    dog.stage("warmup")
-    _warm_device()
-    obs.reset()  # warmup compiles are not the headline's to report
-    dog.stage("headline:convolve_1m")
-    head = flush(bench_convolve_1m(rng))
-    print(json.dumps({
-        "metric": head["metric"],
-        "value": None if head["value"] is None else round(head["value"], 2),
-        "unit": head["unit"],
-        "vs_baseline": (None if head["vs_baseline"] is None
-                        else round(head["vs_baseline"], 2)),
-    }, allow_nan=False), flush=True)
-    dog.headline_out = True  # a wedge from here on still exits 0
-
-    # after the headline has been captured, a failure must not turn the
-    # artifact red or skip independent configs — log and keep going.
-    # Timed configs BEFORE the smoke: the 2026-07-31 window wedged inside
-    # the smoke, which under the old ordering cost configs 1/2/3/5.
-    for fn in (bench_elementwise, bench_mathfun, bench_sgemm, bench_dwt):
-        dog.stage(f"config:{fn.__name__}")
-        # a FAILED config never reaches flush()'s reset — drop its
-        # events here so they can't masquerade as the next config's
-        obs.reset()
-        try:
-            flush(fn(rng))
-        except Exception as e:  # noqa: BLE001
-            print(f"bench.py: config {fn.__name__} failed ({e!r}); "
-                  "continuing", file=sys.stderr)
     try:
-        if not run_smoke(on_start=lambda n: dog.stage(f"smoke:{n}")):
-            print("bench.py: correctness smoke FAILED on "
+        if "--check" in sys.argv:
+            # smoke-only mode, each family under its own stage budget so one
+            # wedge cannot cost the remaining families.  rc: 0 all pass,
+            # 1 numerical failure, 2 incomplete (a family wedged)
+            all_ok = True
+            for fam, _ in FAMILIES:
+                ok, res = runner.run(f"smoke:{fam}",
+                                     lambda fam=fam: run_smoke(families=[fam]))
+                all_ok &= ok and bool(res)
+            if runner.skipped:
+                print(f"bench.py: smoke incomplete — skipped "
+                      f"{[s['stage'] for s in runner.skipped]}",
+                      file=sys.stderr)
+                sys.exit(2)
+            sys.exit(0 if all_ok else 1)
+
+        device = str(jax.devices()[0])
+        # telemetry ON for the whole run: every BENCH_DETAILS.json entry
+        # carries the algorithm decisions / compile counts behind its number
+        obs.enable()
+        obs.reset()
+        # PER-STAGE RandomState: an abandoned (slow-but-not-wedged)
+        # stage thread may resume later; a shared rng would then race
+        # the live stage's draws.  Derived obs/telemetry pollution from
+        # such a zombie is accepted (documented at _StageRunner).
+        rng = np.random.RandomState(0)
+        results = []
+
+        def write_details():
+            # the tail entry records which stages were skipped/failed, so a
+            # partial run is distinguishable from a complete one in the
+            # artifact itself (not just in stderr)
+            tail = ([{"skipped_stages": runner.skipped}]
+                    if runner.skipped else [])
+            with open("BENCH_DETAILS.json", "w") as f:
+                json.dump(results + tail, f, indent=2, allow_nan=False)
+
+        def flush(r):
+            r["vs_baseline"] = r["value"] / r["baseline"]
+            r["device"] = device
+            # per-config telemetry (reset right after, so each entry's
+            # decisions/compiles are attributable to that config alone)
+            r["telemetry"] = _telemetry_entry()
+            obs.reset()
+            # device_time_chained returns NaN for unresolvable measurements;
+            # NaN is not valid strict JSON, so flag it and null the numbers
+            if not all(np.isfinite(r[k]) for k in ("value", "baseline",
+                                                   "vs_baseline")):
+                r["flagged"] = "unresolved measurement (timer returned NaN)"
+                r = {k: (None if isinstance(v, float) and not np.isfinite(v)
+                         else v) for k, v in r.items()}
+            results.append(r)
+            write_details()
+            if "--all" in sys.argv:
+                def fmt(v, spec):
+                    return format(v, spec) if v is not None else "  (flagged)"
+                print(f"{r['metric']:36s} {fmt(r['value'], '12.1f')} "
+                      f"{r['unit']:11s} "
+                      f"(cpu-oracle {fmt(r['baseline'], '10.1f')}, "
+                      f"x{fmt(r['vs_baseline'], '.1f')})", file=sys.stderr)
+            return r
+
+        # headline first: warm clocks, measure, print the parseable line NOW —
+        # everything after this point is gravy if the device window closes
+        runner.run("warmup", _warm_device)
+        obs.reset()  # warmup compiles are not the headline's to report
+        ok, res = runner.run("headline:convolve_1m",
+                             lambda: bench_convolve_1m(rng))
+        if ok:
+            head = flush(res)
+            print(json.dumps({
+                "metric": head["metric"],
+                "value": (None if head["value"] is None
+                          else round(head["value"], 2)),
+                "unit": head["unit"],
+                "vs_baseline": (None if head["vs_baseline"] is None
+                                else round(head["vs_baseline"], 2)),
+            }, allow_nan=False), flush=True)
+            dog.headline_out = True  # a wedge from here on still exits 0
+        else:
+            # the headline could not be measured; say so in the parseable
+            # slot (nulls, never a fabricated number) and keep capturing
+            # the remaining stages — rc=2 at the end marks the run partial
+            write_details()
+            print(json.dumps({
+                "metric": "convolve 1M x 2047 overlap-save", "value": None,
+                "unit": "Msamples/s", "vs_baseline": None,
+                "skipped": runner.skipped[-1]["reason"]
+                if runner.skipped else "stage failed"}), flush=True)
+
+        # after the headline attempt, a failure/wedge must not turn the
+        # artifact red or cost independent configs — skip and keep going.
+        # Timed configs BEFORE the smoke: the 2026-07-31 window wedged inside
+        # the smoke, which under the old ordering cost configs 1/2/3/5.
+        configs = (bench_elementwise, bench_mathfun, bench_sgemm,
+                   bench_dwt)
+        for i, fn in enumerate(configs):
+            # a failed/skipped config never reaches flush()'s reset — drop
+            # its events here so they can't masquerade as the next config's
+            obs.reset()
+            cfg_rng = np.random.RandomState(i + 1)  # stage-private
+            cfg_ok, cfg_res = runner.run(f"config:{fn.__name__}",
+                                         lambda fn=fn, r=cfg_rng: fn(r))
+            if cfg_ok:
+                flush(cfg_res)
+            else:
+                write_details()
+        # per-family smoke, each under its own budget: one wedged family
+        # costs one TPU-CHECK line, not every family after it (the round-5
+        # failure mode this runner exists for)
+        smoke_ok = True
+        for fam, _ in FAMILIES:
+            fam_ok, fam_res = runner.run(
+                f"smoke:{fam}", lambda fam=fam: run_smoke(families=[fam]))
+            smoke_ok &= fam_ok and bool(fam_res)
+            if not fam_ok:
+                write_details()
+        if not smoke_ok:
+            print(f"bench.py: correctness smoke incomplete or FAILED on "
                   f"{device!r}; timing numbers are suspect", file=sys.stderr)
-    except Exception as e:  # noqa: BLE001 — headline already on stdout
-        print(f"bench.py: smoke crashed ({e!r})", file=sys.stderr)
+        if not dog.headline_out:
+            sys.exit(2)  # partial run: no headline measurement was captured
+
+    finally:
+        dog.stop()   # disarm: never hard-exit a finished run
 
 
 if __name__ == "__main__":

@@ -1,0 +1,148 @@
+"""Batched-throughput layer: parity with the single-signal ops + the
+LRU handle-cache contract (one executable per geometry, bounded)."""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu.ops import batched, iir, resample as rs
+
+rng = np.random.RandomState(5)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    batched.clear_handle_cache()
+    yield
+    batched.clear_handle_cache()
+
+
+class TestParity:
+    @pytest.mark.parametrize("up,down", [(2, 1), (1, 2), (3, 2),
+                                         (160, 147)])
+    def test_resample_matches_single_signal(self, up, down):
+        x = rng.randn(6, 730).astype(np.float32)
+        got = np.asarray(batched.batched_resample_poly(x, up, down,
+                                                       simd=True))
+        want = np.asarray(rs.resample_poly(x, up, down, simd=True))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    def test_resample_identity_rate(self):
+        x = rng.randn(3, 64).astype(np.float32)
+        got = np.asarray(batched.batched_resample_poly(x, 7, 7,
+                                                       simd=True))
+        np.testing.assert_array_equal(got, x)
+
+    def test_resample_oracle_path(self):
+        x = rng.randn(4, 300).astype(np.float32)
+        got = batched.batched_resample_poly(x, 3, 2, simd=False)
+        want = rs.resample_poly_na(x, 3, 2).astype(np.float32)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_sosfilt_matches_single_signal(self):
+        sos = iir.butterworth(4, 0.25, "lowpass")
+        x = rng.randn(8, 512).astype(np.float32)
+        got = np.asarray(batched.batched_sosfilt(sos, x, simd=True))
+        want = np.asarray(iir.sosfilt(sos, x, simd=True))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_sosfilt_oracle_path(self):
+        sos = iir.butterworth(2, 0.3, "highpass")
+        x = rng.randn(3, 256).astype(np.float32)
+        got = batched.batched_sosfilt(sos, x, simd=False)
+        want = iir.sosfilt_na(sos, x).astype(np.float32)
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    def test_lfilter_matches_single_signal(self):
+        b = np.array([0.2, 0.3, 0.1])
+        a = np.array([1.0, -0.5, 0.2, -0.05])
+        x = rng.randn(5, 400).astype(np.float32)
+        got = np.asarray(batched.batched_lfilter(b, a, x, simd=True))
+        want = np.asarray(iir.lfilter(b, a, x, simd=True))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_lfilter_pure_fir(self):
+        b = np.array([0.5, 0.25, 0.125])
+        x = rng.randn(4, 128).astype(np.float32)
+        got = np.asarray(batched.batched_lfilter(b, [1.0], x, simd=True))
+        want = iir.lfilter_na(b, [1.0], x)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_leading_dims_ride_along(self):
+        sos = iir.butterworth(2, 0.2, "lowpass")
+        x = rng.randn(2, 3, 128).astype(np.float32)
+        got = np.asarray(batched.batched_sosfilt(sos, x, simd=True))
+        assert got.shape == x.shape
+        want = np.asarray(iir.sosfilt(sos, x, simd=True))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+class TestHandleCache:
+    def test_same_geometry_hits(self):
+        x = rng.randn(4, 256).astype(np.float32)
+        batched.batched_resample_poly(x, 3, 2, simd=True)
+        batched.batched_resample_poly(x, 3, 2, simd=True)
+        info = batched.handle_cache_info()
+        assert info["misses"] == 1 and info["hits"] == 1
+        assert info["size"] == 1
+
+    def test_new_taps_do_not_recompile_resample(self):
+        # taps are runtime data: a different filter of the SAME length
+        # must reuse the compiled handle
+        x = rng.randn(4, 256).astype(np.float32)
+        t1 = rs._resample_taps(3, 2, 41)
+        t2 = np.asarray(rs._resample_taps(3, 2, 41))[::-1].copy()
+        batched.batched_resample_poly(x, 3, 2, taps=t1, simd=True)
+        batched.batched_resample_poly(x, 3, 2, taps=t2, simd=True)
+        info = batched.handle_cache_info()
+        assert info["misses"] == 1 and info["hits"] == 1
+
+    def test_new_geometry_misses(self):
+        sos = iir.butterworth(2, 0.2, "lowpass")
+        batched.batched_sosfilt(sos, rng.randn(4, 128), simd=True)
+        batched.batched_sosfilt(sos, rng.randn(8, 128), simd=True)
+        batched.batched_sosfilt(sos, rng.randn(4, 256), simd=True)
+        assert batched.handle_cache_info()["misses"] == 3
+
+    def test_lru_bound_evicts_oldest(self, monkeypatch):
+        monkeypatch.setattr(batched, "BATCHED_CACHE_MAXSIZE", 2)
+        sos = iir.butterworth(1, 0.2, "lowpass")
+        for n in (64, 96, 128):
+            batched.batched_sosfilt(sos, rng.randn(2, n), simd=True)
+        info = batched.handle_cache_info()
+        assert info["size"] == 2 and info["evictions"] == 1
+        # the first geometry was evicted: calling it again recompiles
+        batched.batched_sosfilt(sos, rng.randn(2, 64), simd=True)
+        assert batched.handle_cache_info()["misses"] == 4
+
+
+class TestErrors:
+    def test_rejects_1d_input(self):
+        with pytest.raises(ValueError, match="single-signal"):
+            batched.batched_sosfilt(iir.butterworth(2, 0.2),
+                                    np.ones(64, np.float32))
+
+    def test_lfilter_order_bound(self):
+        b = [1.0]
+        a = np.ones(iir._LFILTER_MAX_ORDER + 2)
+        with pytest.raises(ValueError, match="batched_sosfilt"):
+            batched.batched_lfilter(b, a, np.ones((2, 64), np.float32))
+
+
+def test_donate_is_optin_and_keys_the_handle():
+    # donate=True on CPU is a no-op for the executable (donation only
+    # applies on TPU) but must still compile a DISTINCT handle, and the
+    # caller's array must stay valid on the CPU path
+    sos = iir.butterworth(2, 0.2, "lowpass")
+    x = rng.randn(4, 128).astype(np.float32)
+    import jax.numpy as jnp
+
+    xj = jnp.asarray(x)
+    y1 = np.asarray(batched.batched_sosfilt(sos, xj, simd=True))
+    _ = np.asarray(xj)                       # input still alive
+    y2 = np.asarray(batched.batched_sosfilt(sos, xj, simd=True,
+                                            donate=True))
+    np.testing.assert_allclose(y1, y2, rtol=1e-6, atol=1e-6)
+    info = batched.handle_cache_info()
+    # on CPU _donate_argnums(True) == (): same key, one handle; on a
+    # TPU run the donation tuple differs and a second handle appears
+    assert info["size"] in (1, 2)

@@ -1,0 +1,141 @@
+"""bench.py stage supervision: a wedged stage is skipped and recorded,
+the remaining stages still run (the round-5 ``smoke:resample`` wedge
+cost every following family under the old hard-exit design)."""
+
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import bench  # noqa: E402
+
+
+def _runner(timeout):
+    dog = bench._StageWatchdog(0)      # backstop disabled in tests
+    return bench._StageRunner(timeout, dog)
+
+
+def test_ok_stage_returns_result():
+    r = _runner(5.0)
+    ok, res = r.run("fine", lambda: 42)
+    assert ok and res == 42 and r.skipped == []
+
+
+def test_wedged_stage_is_skipped_and_rest_continue():
+    r = _runner(0.2)
+    release = threading.Event()
+    ok, res = r.run("wedge", release.wait)        # blocks past budget
+    assert not ok and res is bench._StageRunner._WEDGED
+    # the run continues: later stages still execute and succeed
+    ok2, res2 = r.run("after", lambda: "ran")
+    assert ok2 and res2 == "ran"
+    assert [s["stage"] for s in r.skipped] == ["wedge"]
+    assert "wedged" in r.skipped[0]["reason"]
+    release.set()                                  # unblock the zombie
+
+
+def test_raising_stage_is_recorded_not_fatal():
+    r = _runner(5.0)
+
+    def boom():
+        raise RuntimeError("kaput")
+
+    ok, err = r.run("boom", boom)
+    assert not ok and isinstance(err, RuntimeError)
+    assert r.skipped[0]["stage"] == "boom"
+    assert "kaput" in r.skipped[0]["reason"]
+
+
+def test_unsupervised_mode_runs_inline():
+    r = _runner(0)                         # timeout 0 = inline
+    main_thread = threading.current_thread()
+    seen = {}
+
+    def probe():
+        seen["thread"] = threading.current_thread()
+        return 7
+
+    ok, res = r.run("inline", probe)
+    assert ok and res == 7 and seen["thread"] is main_thread
+
+
+def test_slow_but_within_budget_is_not_skipped():
+    r = _runner(2.0)
+    ok, res = r.run("slowish", lambda: (time.sleep(0.05), "done")[1])
+    assert ok and res == "done" and r.skipped == []
+
+
+def test_main_records_skips_in_json_tail(monkeypatch, tmp_path, capsys):
+    """End-to-end through bench.main() with stubbed stages: a wedged
+    headline is skipped (null JSON line, rc=2), the remaining configs
+    and smoke families still produce rows, and the skip lands in
+    BENCH_DETAILS.json's tail entry."""
+    import json
+
+    import numpy as np
+
+    import tools.tpu_smoke as smoke
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("VELES_SIMD_STAGE_TIMEOUT", "1")
+    monkeypatch.setenv("VELES_SIMD_DEVICE_WAIT", "0")
+    monkeypatch.setattr(bench, "_warm_device", lambda *a, **k: None)
+
+    release = threading.Event()
+    monkeypatch.setattr(
+        bench, "bench_convolve_1m",
+        lambda rng: (release.wait(), None)[1])        # wedges
+
+    def quick(rng, name):
+        return {"metric": name, "unit": "u", "value": 2.0,
+                "baseline": 1.0}
+
+    monkeypatch.setattr(bench, "bench_elementwise",
+                        lambda rng: quick(rng, "elementwise"))
+    monkeypatch.setattr(bench, "bench_mathfun",
+                        lambda rng: quick(rng, "mathfun"))
+    monkeypatch.setattr(bench, "bench_sgemm",
+                        lambda rng: quick(rng, "sgemm"))
+
+    def boom(rng):
+        raise RuntimeError("config kaput")
+
+    boom.__name__ = "bench_dwt"          # the stage label uses __name__
+    monkeypatch.setattr(bench, "bench_dwt", boom)
+    monkeypatch.setattr(smoke, "FAMILIES",
+                        [("fam_ok", lambda rng: (0.0, 1.0))])
+
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    try:
+        with np.errstate(all="ignore"):
+            try:
+                bench.main()
+                rc = 0
+            except SystemExit as e:
+                rc = e.code
+    finally:
+        release.set()
+        # main() enables process-wide telemetry; later tests expect it
+        # back in the default (disabled, empty) state
+        bench.obs.reset()
+        bench.obs.disable()
+    assert rc == 2                      # headline missing -> partial run
+
+    out = capsys.readouterr().out
+    line = json.loads(out.strip().splitlines()[0])
+    assert line["value"] is None and "skipped" in line
+
+    details = json.loads((tmp_path / "BENCH_DETAILS.json").read_text())
+    metrics = [d.get("metric") for d in details if "metric" in d]
+    assert metrics == ["elementwise", "mathfun", "sgemm"]
+    tail = details[-1]
+    assert "skipped_stages" in tail
+    stages = [s["stage"] for s in tail["skipped_stages"]]
+    assert "headline:convolve_1m" in stages
+    assert "config:bench_dwt" in stages
+    reasons = {s["stage"]: s["reason"] for s in tail["skipped_stages"]}
+    assert "wedged" in reasons["headline:convolve_1m"]
+    assert "kaput" in reasons["config:bench_dwt"]

@@ -1,0 +1,72 @@
+"""Config.check_arguments: the knob the suite's argument-contract
+tests implicitly depend on (185 ``pytest.raises`` sites assume the
+default ON), exercised directly in both positions."""
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu.ops import convolve as cv
+from veles.simd_tpu.utils.config import get_config, set_config
+
+rng = np.random.RandomState(21)
+
+
+@pytest.fixture
+def handle():
+    # brute force: the result is well-defined for ANY actual length,
+    # so the toggle's pass-through branch has a meaningful output
+    return cv.convolve_initialize(
+        100, 9, cv.ConvolutionAlgorithm.BRUTE_FORCE)
+
+
+def _restore(prev):
+    set_config(check_arguments=prev)
+
+
+def test_default_is_on():
+    assert get_config().check_arguments
+
+
+@pytest.mark.parametrize("simd", [True, False])
+def test_on_raises_on_length_mismatch(handle, simd):
+    x = rng.randn(80).astype(np.float32)   # != handle.x_length
+    h = rng.randn(9).astype(np.float32)
+    prev = get_config().check_arguments
+    set_config(check_arguments=True)
+    try:
+        with pytest.raises(ValueError, match="handle is for"):
+            cv.convolve(handle, x, h, simd=simd)
+    finally:
+        _restore(prev)
+
+
+@pytest.mark.parametrize("simd", [True, False])
+def test_off_passes_mismatch_through(handle, simd):
+    # the reference's assert() contract compiled out (NDEBUG): the op
+    # runs on the actual shapes instead of validating the plan's
+    x = rng.randn(80).astype(np.float32)
+    h = rng.randn(9).astype(np.float32)
+    prev = get_config().check_arguments
+    set_config(check_arguments=False)
+    try:
+        out = np.asarray(cv.convolve(handle, x, h, simd=simd))
+        assert out.shape == (80 + 9 - 1,)
+        want = np.convolve(x.astype(np.float64), h.astype(np.float64))
+        np.testing.assert_allclose(out, want, atol=1e-4)
+    finally:
+        _restore(prev)
+
+
+def test_toggle_restores(handle):
+    # matched lengths pass in BOTH positions (the knob only gates the
+    # validation, never the math)
+    x = rng.randn(100).astype(np.float32)
+    h = rng.randn(9).astype(np.float32)
+    prev = get_config().check_arguments
+    try:
+        for flag in (False, True):
+            set_config(check_arguments=flag)
+            out = np.asarray(cv.convolve(handle, x, h, simd=True))
+            assert out.shape == (108,)
+    finally:
+        _restore(prev)

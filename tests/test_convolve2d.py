@@ -315,3 +315,50 @@ class TestPallasOomFallback:
 
         np.asarray(g(x))
         assert calls            # small unroll still takes pallas
+
+
+class TestOomRejectionBound:
+    def test_lru_set_caps_and_refreshes(self):
+        s = cv2._LRUSet(3)
+        for key in ("a", "b", "c"):
+            s.add(key)
+        assert "a" in s            # membership hit refreshes "a"
+        s.add("d")                 # evicts the oldest untouched: "b"
+        assert len(s) == 3
+        assert "b" not in s
+        assert "a" in s and "c" in s and "d" in s
+
+    def test_module_rejection_cache_is_bounded(self):
+        assert isinstance(cv2._PALLAS2D_OOM_REJECTED, cv2._LRUSet)
+        assert (cv2._PALLAS2D_OOM_REJECTED.maxsize
+                == cv2._PALLAS2D_OOM_MAXSIZE)
+
+    def test_traced_demotion_is_counted(self, monkeypatch):
+        """The traced-path small-tile model demoting a shape to fft
+        must leave an obs trace (ISSUE 2 satellite)."""
+        import jax
+
+        from veles.simd_tpu import obs
+        from veles.simd_tpu.ops import pallas_kernels as pk
+
+        monkeypatch.setattr(pk, "pallas_available", lambda: True)
+        obs.enable()
+        obs.reset()
+        try:
+            # the documented live-failure shape class: out tile
+            # 142x142x4 = 80KB (small), 225 * 80KB = 18M > 14M budget
+            # -> the static model must demote at trace time
+            x = RNG.randn(128, 128).astype(np.float32)
+            h = RNG.randn(15, 15).astype(np.float32)
+
+            @jax.jit
+            def run(xj):
+                return cv2.convolve2d(xj, h, simd=True)
+
+            run(x)
+            assert obs.counter_value(
+                "pallas2d_demotion",
+                reason="traced_small_tile_model") >= 1
+        finally:
+            obs.reset()
+            obs.disable()

@@ -89,3 +89,21 @@ def test_batched_normalize():
 def test_contract_violation():
     with pytest.raises(ValueError):
         nz.normalize2D(np.zeros(8, np.uint8), simd=True)
+
+
+def test_flat_plane_produces_no_nan_under_debug_nans():
+    """The mx == mn denominator is guarded BEFORE the division: under
+    jax_debug_nans the old divide-then-mask form raised on the
+    intermediate inf/nan even though the masked result was clean."""
+    import jax
+
+    flat = np.full((8, 8), 7, np.uint8)
+    jax.config.update("jax_debug_nans", True)
+    try:
+        out = np.asarray(nz.normalize2D(flat, simd=True))
+        np.testing.assert_array_equal(out, np.zeros((8, 8), np.float32))
+        out2 = np.asarray(nz.normalize2D_minmax(7, 7, flat, simd=True))
+        np.testing.assert_array_equal(out2,
+                                      np.zeros((8, 8), np.float32))
+    finally:
+        jax.config.update("jax_debug_nans", False)

@@ -347,3 +347,139 @@ def test_filter_bank_stacked_output_path():
                 np.float64) @ f[c].astype(np.float64))
     np.testing.assert_allclose(np.asarray(hi), want[0], atol=1e-4)
     np.testing.assert_allclose(np.asarray(lo), want[1], atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# fused overlap-save kernel
+# ---------------------------------------------------------------------------
+
+
+class TestOverlapSavePallas:
+    """Interpreter-mode cross-validation of the fused overlap-save
+    kernel (carried-halo MXU block matmul) against the float64 oracle,
+    plus the convolve routing that serves it on TPU."""
+
+    @pytest.mark.parametrize("n,k,step", [
+        (5000, 257, 256),     # headline shape class (k-1 not step mult)
+        (4096, 511, 256),     # jb = 2
+        (2048, 300, 256),     # k-1 > step, partial last shift
+        (1000, 129, 128),     # small step
+        (1537, 513, 512),     # step 512, partial tail tile
+        (900, 2, 256),        # minimal halo (jb = 1, single-tap shift)
+    ])
+    def test_matches_oracle(self, n, k, step):
+        from veles.simd_tpu.ops.pallas_kernels import overlap_save_pallas
+
+        r = np.random.RandomState(n + k)
+        x = r.randn(n).astype(np.float32)
+        h = r.randn(k).astype(np.float32)
+        got = np.asarray(overlap_save_pallas(x, h, step=step,
+                                             interpret=True))
+        want = np.convolve(x.astype(np.float64), h.astype(np.float64))
+        assert got.shape == want.shape
+        rel = np.max(np.abs(got - want)) / np.max(np.abs(want))
+        assert rel < 1e-5
+
+    def test_batched_carry_restarts_per_row(self):
+        # each batch row must see zero history, not the previous row's
+        # tail — the t == 0 carry reset in the kernel
+        from veles.simd_tpu.ops.pallas_kernels import overlap_save_pallas
+
+        r = np.random.RandomState(3)
+        x = r.randn(3, 4000).astype(np.float32)
+        h = r.randn(301).astype(np.float32)
+        got = np.asarray(overlap_save_pallas(x, h, interpret=True))
+        want = np.stack([np.convolve(row.astype(np.float64),
+                                     h.astype(np.float64)) for row in x])
+        rel = np.max(np.abs(got - want)) / np.max(np.abs(want))
+        assert rel < 1e-5
+
+    def test_rejects_bad_inputs(self):
+        from veles.simd_tpu.ops import pallas_kernels as pk
+
+        with pytest.raises(ValueError, match=">= 2 taps"):
+            pk.overlap_save_pallas(np.ones(100, np.float32),
+                                   np.ones(1, np.float32), interpret=True)
+        with pytest.raises(ValueError, match="128-lane"):
+            pk.overlap_save_pallas(np.ones(100, np.float32),
+                                   np.ones(9, np.float32), step=100,
+                                   interpret=True)
+        with pytest.raises(ValueError, match="taps must be 1D"):
+            pk.overlap_save_pallas(np.ones(100, np.float32),
+                                   np.ones((2, 9), np.float32),
+                                   interpret=True)
+
+    def test_convolve_routes_through_fused_kernel(self, monkeypatch):
+        # force the TPU-only gate on; on the CPU platform the kernel
+        # then runs under the interpreter (interpret auto-select)
+        from veles.simd_tpu.ops import convolve as cv
+
+        monkeypatch.setattr(cv, "_use_pallas_os", lambda k: True)
+        r = np.random.RandomState(11)
+        x = r.randn(9000).astype(np.float32)
+        h = r.randn(741).astype(np.float32)
+        handle = cv.convolve_overlap_save_initialize(len(x), len(h))
+        assert handle.os_matmul
+        got = np.asarray(cv.convolve_overlap_save(handle, x, h, simd=True))
+        want = np.convolve(x.astype(np.float64), h.astype(np.float64))
+        rel = np.max(np.abs(got - want)) / np.max(np.abs(want))
+        assert rel < 1e-5
+
+    def test_reverse_handle_correlates(self, monkeypatch):
+        from veles.simd_tpu.ops import convolve as cv
+
+        monkeypatch.setattr(cv, "_use_pallas_os", lambda k: True)
+        r = np.random.RandomState(12)
+        x = r.randn(6000).astype(np.float32)
+        h = r.randn(401).astype(np.float32)
+        handle = cv.convolve_overlap_save_initialize(len(x), len(h),
+                                                     reverse=True)
+        got = np.asarray(cv.convolve_overlap_save(handle, x, h, simd=True))
+        want = np.convolve(x.astype(np.float64),
+                           h.astype(np.float64)[::-1])
+        rel = np.max(np.abs(got - want)) / np.max(np.abs(want))
+        assert rel < 1e-5
+
+    def test_gate_respects_env_optout(self, monkeypatch):
+        from veles.simd_tpu.ops import convolve as cv
+        from veles.simd_tpu.ops import pallas_kernels as pk
+
+        monkeypatch.setattr(pk, "pallas_available", lambda: True)
+        monkeypatch.setenv(pk._PALLAS_OS_ENV, "1")
+        assert not cv._use_pallas_os(2047)
+        monkeypatch.delenv(pk._PALLAS_OS_ENV)
+        assert cv._use_pallas_os(2047)
+        assert not cv._use_pallas_os(64)          # below PALLAS_OS_MIN_H
+        assert not cv._use_pallas_os(1 << 16)     # factors exceed VMEM
+
+    def test_mosaic_oom_demotes_to_xla_matmul(self, monkeypatch):
+        # a scoped-vmem compile failure falls back to the XLA block
+        # matmul and caches the rejection; other errors propagate
+        from veles.simd_tpu.ops import convolve as cv
+
+        monkeypatch.setattr(cv, "_use_pallas_os", lambda k: True)
+        monkeypatch.setattr(cv, "_PALLAS_OS_REJECTED", set())
+
+        def boom(x, h, reverse=False, precision=None):
+            raise RuntimeError(
+                "Ran out of memory in memory space vmem while "
+                "allocating on stack: scoped allocation 22M > 16M")
+
+        monkeypatch.setattr(cv, "_conv_os_pallas", boom)
+        r = np.random.RandomState(13)
+        x = r.randn(5000).astype(np.float32)
+        h = r.randn(441).astype(np.float32)
+        handle = cv.convolve_overlap_save_initialize(len(x), len(h))
+        got = np.asarray(cv.convolve_overlap_save(handle, x, h,
+                                                  simd=True))
+        want = np.convolve(x.astype(np.float64), h.astype(np.float64))
+        rel = np.max(np.abs(got - want)) / np.max(np.abs(want))
+        assert rel < 1e-5
+        assert 441 in cv._PALLAS_OS_REJECTED
+        # non-OOM failures are not swallowed
+        monkeypatch.setattr(cv, "_PALLAS_OS_REJECTED", set())
+        monkeypatch.setattr(
+            cv, "_conv_os_pallas",
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
+        with pytest.raises(RuntimeError, match="boom"):
+            cv.convolve_overlap_save(handle, x, h, simd=True)

@@ -1021,6 +1021,22 @@ class TestShardedNormalize2d:
             np.asarray(par.sharded_normalize2d(flat, mesh)),
             np.zeros((16, 8), np.float32))
 
+    def test_flat_plane_clean_under_debug_nans(self):
+        """The guarded denominator must not manufacture inf/nan on a
+        flat plane — jax_debug_nans sees intermediates the final
+        where() masks out of the result."""
+        import jax
+
+        mesh = par.make_mesh({"sp": 8})
+        flat = np.full((16, 8), 3, np.uint8)
+        jax.config.update("jax_debug_nans", True)
+        try:
+            got = np.asarray(par.sharded_normalize2d(flat, mesh))
+        finally:
+            jax.config.update("jax_debug_nans", False)
+        np.testing.assert_array_equal(got,
+                                      np.zeros((16, 8), np.float32))
+
     def test_fewer_rows_than_shards_and_float_dtype(self):
         """pad > h (wrap-padding must cover it) and a non-u8 plane
         (the single-chip op accepts any numeric dtype — review

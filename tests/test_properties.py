@@ -9,7 +9,11 @@ oracle parity but breaks structure (e.g. a flipped kernel) still fails.
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+
+# environments without hypothesis skip the module cleanly instead of
+# erroring at collection (the driver image does not ship it)
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from veles.simd_tpu.ops import arithmetic as ar
 from veles.simd_tpu.ops import convolve as cv

@@ -40,7 +40,8 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 
 from veles.simd_tpu.utils.benchmark import (  # noqa: E402
-    device_time_chained, host_time, rms_normalize as _rms_normalize)
+    conv_roofline, device_time_chained, host_time,
+    rms_normalize as _rms_normalize)
 
 
 def benchmark(name, step, x0, baseline_fn, *, samples=None, flops=None,
@@ -52,6 +53,10 @@ def benchmark(name, step, x0, baseline_fn, *, samples=None, flops=None,
     ``baseline_samples`` scales the baseline time up to the device
     workload size when the oracle runs on a subset (linear-cost ops
     only — keeps slow oracles from dominating the wall clock).
+
+    Returns ``{"times": speedup, "t_peak": s/iter, "samples_per_s"}``
+    so derived rows (rooflines, batched-vs-single ratios) reuse the
+    measurement instead of re-timing.
     """
     t_peak = device_time_chained(step, x0, iters=iters)
     t_base = host_time(baseline_fn, repeats=baseline_repeats)
@@ -66,7 +71,8 @@ def benchmark(name, step, x0, baseline_fn, *, samples=None, flops=None,
     if flops:
         line += f" | {flops / t_peak / 1e9:.0f} GFLOP/s"
     print(line, flush=True)
-    return times
+    return {"times": times, "t_peak": t_peak,
+            "samples_per_s": (samples / t_peak) if samples else None}
 
 
 def main():
@@ -100,12 +106,28 @@ def main():
             y = cv.convolve(handle, v, hd, simd=True)
             return v + 1e-30 * y[..., :xlen]
 
-        benchmark(
+        res = benchmark(
             f"convolve {xlen}x{hlen} [{handle.algorithm.value}]",
             conv_step, xd,
             lambda: cv.convolve(handle, x, h, simd=False),
             samples=xlen,
             baseline_repeats=1 if xlen >= 1 << 17 else 3)
+        if (handle.os_matmul and xlen >= 1 << 17
+                and res["samples_per_s"]
+                and np.isfinite(res["samples_per_s"])):
+            # roofline attribution of the MXU overlap-save entries:
+            # effective TFLOP/s (2h useful FLOPs per output sample)
+            # against the f32 MXU bound at the active precision knob
+            roof = conv_roofline(res["samples_per_s"], hlen,
+                                 cv.os_precision())
+            route = ("pallas_fused" if cv._use_pallas_os(hlen)
+                     else "xla_matmul")
+            print(f"[conv-roofline {xlen}x{hlen} {route}] "
+                  f"{roof['tflops_effective']:.1f} TFLOP/s effective = "
+                  f"{roof['pct_of_roofline']:.0f}% of the "
+                  f"f32-{roof['precision'].upper()} MXU bound "
+                  f"({roof['roofline_bound_tflops']:.1f} TFLOP/s)",
+                  flush=True)
 
     # --- 1M conv at conv_precision="high" (3-pass MXU; ~1.3e-5 rel err,
     # inside every correctness gate — the documented fast knob) ---
@@ -124,11 +146,20 @@ def main():
                 y = cv.convolve(handle, v, hd, simd=True)
                 return v + 1e-30 * y[..., :xlen]
 
-            benchmark(
+            res = benchmark(
                 f"convolve {xlen}x{hlen} [overlap_save, precision=high]",
                 conv_hi_step, xd,
                 lambda: cv.convolve(handle, x, h, simd=False),
                 samples=xlen, baseline_repeats=1)
+            if res["samples_per_s"] and np.isfinite(
+                    res["samples_per_s"]):
+                roof = conv_roofline(res["samples_per_s"], hlen, "high")
+                print(f"[conv-roofline {xlen}x{hlen} precision=high] "
+                      f"{roof['tflops_effective']:.1f} TFLOP/s "
+                      f"effective = {roof['pct_of_roofline']:.0f}% of "
+                      f"the 3-pass MXU bound "
+                      f"({roof['roofline_bound_tflops']:.1f} TFLOP/s)",
+                      flush=True)
         finally:
             set_config(conv_precision=prev)
 
@@ -405,6 +436,78 @@ def main():
               lambda: iir.sosfilt_na(sos, xi), samples=xi.size,
               baseline_repeats=1)
 
+    # --- batched-throughput layer (ops/batched): the round-5 baseline
+    # claimed "resample_poly/sosfilt are dispatch-bound by design — the
+    # throughput paths are the batched forms" with no batched entry to
+    # back it.  These rows ARE that entry: the same per-signal length
+    # measured single-signal and as one batched dispatch, ratio printed.
+    from veles.simd_tpu.ops import batched as bt
+
+    nb, per = (64, 4096) if quick else (256, 4096)
+    x1 = rng.randn(per).astype(np.float32)
+    xbm = rng.randn(nb, per).astype(np.float32)
+    x1d, xbmd = jnp.asarray(x1), jnp.asarray(xbm)
+
+    def rsp_single_step(v):
+        y = rs.resample_poly(v, 160, 147, simd=True)
+        return v + 1e-30 * y[..., :per]
+
+    def rsp_batched_step(v):
+        y = bt.batched_resample_poly(v, 160, 147, simd=True)
+        return v + 1e-30 * y[..., :per]
+
+    r1 = benchmark(f"resample_poly single 1x{per} 160/147",
+                   rsp_single_step, x1d,
+                   lambda: rs.resample_poly_na(x1, 160, 147),
+                   samples=per, baseline_repeats=1)
+    rb = benchmark(f"resample_poly batched {nb}x{per} 160/147",
+                   rsp_batched_step, xbmd,
+                   lambda: rs.resample_poly_na(xbm[:8], 160, 147),
+                   samples=nb * per, baseline_samples=8 * per,
+                   baseline_repeats=1)
+    if all(v and np.isfinite(v) for v in (r1["samples_per_s"],
+                                          rb["samples_per_s"])):
+        print(f"[batched/single resample_poly @ {per}] "
+              f"{rb['samples_per_s'] / r1['samples_per_s']:.1f}x",
+              flush=True)
+
+    xi1 = rng.randn(ni).astype(np.float32)
+    xib = rng.randn(bi, ni).astype(np.float32)
+    xi1d, xibd = jnp.asarray(xi1), jnp.asarray(xib)
+
+    def sos_single_step(v):
+        return v + 1e-30 * iir.sosfilt(sos, v, simd=True)
+
+    def sos_batched_step(v):
+        return v + 1e-30 * bt.batched_sosfilt(sos, v, simd=True)
+
+    s1 = benchmark(f"sosfilt single 1x{ni >> 10}k order4",
+                   sos_single_step, xi1d,
+                   lambda: iir.sosfilt_na(sos, xi1), samples=ni,
+                   baseline_repeats=1)
+    sb = benchmark(f"sosfilt batched {bi}x{ni >> 10}k order4",
+                   sos_batched_step, xibd,
+                   lambda: iir.sosfilt_na(sos, xib[:8]),
+                   samples=bi * ni, baseline_samples=8 * ni,
+                   baseline_repeats=1)
+    if all(v and np.isfinite(v) for v in (s1["samples_per_s"],
+                                          sb["samples_per_s"])):
+        print(f"[batched/single sosfilt @ {ni >> 10}k] "
+              f"{sb['samples_per_s'] / s1['samples_per_s']:.1f}x",
+              flush=True)
+
+    bco = np.array([0.2, 0.3, 0.1])
+    aco = np.array([1.0, -0.5, 0.2, -0.05])
+
+    def lf_batched_step(v):
+        return v + 1e-30 * bt.batched_lfilter(bco, aco, v, simd=True)
+
+    benchmark(f"lfilter batched {bi}x{ni >> 10}k order3",
+              lf_batched_step, xibd,
+              lambda: iir.lfilter_na(bco, aco, xib[:8]),
+              samples=bi * ni, baseline_samples=8 * ni,
+              baseline_repeats=1)
+
     # --- filters: median (Batcher compare-exchange network since
     # round 5) — bigger shape than the IIR entry: the network made the
     # 8x4k form too fast for the chained-timing resolution (NaN)
@@ -447,6 +550,41 @@ def main():
     benchmark("lombscargle 16k x 1024", ls_step, xud,
               lambda: sp.lombscargle_na(tu, xu, fr),
               samples=len(tu) * len(fr), baseline_repeats=1)
+
+    # --- normalize: the reference's u8-plane min-max family
+    # (src/normalize.c:445-451) — last L4 family with no absolute-
+    # throughput row.  f32 plane (a shape/dtype-preserving step);
+    # repeated normalization is a fixpoint, not a loop XLA can reduce.
+    from veles.simd_tpu.ops import normalize as nz
+
+    npl = rng.randn(2048, 2048).astype(np.float32) * 100 + 50
+    npld = jnp.asarray(npl)
+
+    def norm_step(v):
+        return nz.normalize2D(v, simd=True)
+
+    benchmark("normalize2D 2048x2048 f32", norm_step, npld,
+              lambda: nz.normalize2D_novec(npl), samples=npl.size,
+              baseline_repeats=1)
+
+    # --- detect_peaks: the other no-evidence L4 family.  The jit-
+    # composable fixed-capacity form keeps the step shape-preserving;
+    # the oracle (sequential Python scan) runs one row and scales.
+    from veles.simd_tpu.ops import detect_peaks as dp
+
+    bp, npk = 64, 1 << 16
+    xp_sig = np.cumsum(rng.randn(bp, npk), axis=-1).astype(np.float32)
+    xpd = jnp.asarray(xp_sig)
+
+    def peaks_step(v):
+        _, vals, _ = dp.detect_peaks_fixed(v, dp.ExtremumType.BOTH,
+                                           max_peaks=1024)
+        return v + 1e-30 * vals[..., :1]
+
+    benchmark(f"detect_peaks {bp}x{npk >> 10}k cap=1024", peaks_step,
+              xpd, lambda: dp.detect_peaks_na(xp_sig[0]),
+              samples=bp * npk, baseline_samples=npk,
+              baseline_repeats=1)
 
 
 if __name__ == "__main__":

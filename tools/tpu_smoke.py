@@ -441,6 +441,18 @@ def _check_pallas1d(rng):
     hh = rng.randn(65).astype(np.float32)
     errs.append(_rel_err(cv.convolve_simd(x, hh, simd=True),
                          cv.convolve_na(x, hh)))
+    # fused overlap-save kernel at the headline filter length, sized
+    # for multiple grid steps so the VMEM halo CARRY is exercised on
+    # the compiled path (4 tiles at the default 256-row tiling); on
+    # TPU the handle route picks it automatically, here it is called
+    # directly so the smoke pins the kernel, not the gate
+    from veles.simd_tpu.ops.pallas_kernels import overlap_save_pallas
+
+    xos = rng.randn(200000).astype(np.float32)
+    hos = rng.randn(2047).astype(np.float32)
+    errs.append(_rel_err(
+        overlap_save_pallas(xos, hos, interpret=interp),
+        np.convolve(xos.astype(np.float64), hos.astype(np.float64))))
     # multi-level cascade: the level loop since round 5 (the fused
     # kernel measured slower and is opt-in); value-check all four bands
     got = wv.wavelet_transform("daub", 8, wv.ExtensionType.PERIODIC, x,

@@ -17,6 +17,9 @@ Each module mirrors one reference header (SURVEY.md §2):
   dilated/strided conv + Fourier resampling (beyond-reference)
 * :mod:`.iir`          — Butterworth design + IIR cascades as O(log n)
   associative-scan recurrences, zero-phase filtfilt (beyond-reference)
+* :mod:`.batched`      — batched-throughput entry points (many short
+  signals, one dispatch): LRU-cached compiled handles with donated
+  buffers for resample_poly / sosfilt / lfilter (beyond-reference)
 * :mod:`.filters`      — median/rank filtering (gather + lane sort),
   Savitzky-Golay smoothing/derivatives, window-method FIR design
   (beyond-reference)

@@ -1,0 +1,292 @@
+"""Batched-throughput layer: many short signals, one dispatch.
+
+BASELINE.md's round-5 suite measured single-signal ``resample_poly`` /
+``sosfilt`` at 4-128k samples in the single-digit Msamples/s — those
+entry points are dispatch-bound BY DESIGN at such sizes (one ~66 us
+relay round trip per call dwarfs the math).  The throughput form of
+every short-signal workload on a TPU is the batched one: stack the
+signals on a leading axis, compile ONE program for that ``(batch,
+length)`` geometry, and reuse it call after call — the reformulation
+both "Large-Scale Discrete Fourier Transform on TPUs" (arxiv
+2002.03260) and "TINA" (arxiv 2408.16551) use to keep signal loops
+MXU/VPU-resident.
+
+This module is that entry surface, the compiled-handle analog of the
+reference's plan-handle API (``inc/simd/convolve.h:58-76``):
+
+* **One executable per geometry, LRU-bounded.**  ``jax.jit`` keeps an
+  unbounded per-function cache; a service cycling through shapes leaks
+  executables.  Handles here live in an explicit LRU
+  (:data:`BATCHED_CACHE_MAXSIZE`, default 64) with hit/miss telemetry
+  under ``obs`` — evicting a handle frees nothing until XLA drops the
+  executable, but bounds the *live* set a long-running server touches.
+* **Opt-in donated input buffers.**  With ``donate=True`` the signal
+  batch is donated to the executable (``donate_argnums``) on TPU, so
+  the output can reuse the input's HBM allocation instead of doubling
+  resident memory per call — the difference between fitting 2N and N
+  signals on-chip mid-pipeline.  Donation INVALIDATES the caller's
+  array (standard jax donation semantics: a device-resident input is
+  deleted once the executable consumes it), which is why it is opt-in
+  rather than implicit.  (Donation is skipped on CPU, where the
+  backend cannot honor it and jax would warn.)
+* **Same numerics as the single-signal ops.**  Each handle wraps the
+  exact jitted core the public op dispatches to
+  (:func:`~veles.simd_tpu.ops.resample._resample_conv`, the
+  ``iir`` associative scans), so the oracle-parity tests transfer.
+
+Usage::
+
+    from veles.simd_tpu.ops import batched
+
+    ys = batched.batched_resample_poly(xs, 160, 147)   # xs: [B, n]
+    ys = batched.batched_sosfilt(sos, xs)              # one dispatch
+    ys = batched.batched_lfilter(b, a, xs)
+
+The ``simd=`` flag works as everywhere else (falsy runs the NumPy
+oracle twin, batched trivially).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veles.simd_tpu import obs
+from veles.simd_tpu.utils.config import on_tpu, resolve_simd
+
+__all__ = [
+    "BatchedHandle", "batched_resample_poly", "batched_sosfilt",
+    "batched_lfilter", "handle_cache_info", "clear_handle_cache",
+    "BATCHED_CACHE_MAXSIZE",
+]
+
+# live compiled-handle bound: a handle is ~a closure + a jit cache
+# entry; 64 distinct (op, batch, length, params) geometries covers a
+# service's steady state while keeping eviction observable in tests
+BATCHED_CACHE_MAXSIZE = 64
+
+_cache: "collections.OrderedDict[tuple, BatchedHandle]" = \
+    collections.OrderedDict()
+_cache_lock = threading.Lock()
+_cache_stats = {"hits": 0, "misses": 0, "evictions": 0}
+
+
+class BatchedHandle:
+    """One compiled program pinned to a batched-op geometry.
+
+    ``key`` is the full cache key (op name + batch rows + length +
+    op-static parameters); ``fn`` the jitted callable.  Handles are
+    created by :func:`_get_handle` and shared — treat as immutable.
+    """
+
+    __slots__ = ("key", "fn")
+
+    def __init__(self, key, fn):
+        self.key = key
+        self.fn = fn
+
+    def __call__(self, *args):
+        return self.fn(*args)
+
+    def __repr__(self):  # pragma: no cover - debugging nicety
+        return f"BatchedHandle{self.key!r}"
+
+
+def _get_handle(key, builder) -> BatchedHandle:
+    """LRU lookup of the compiled handle for ``key``; ``builder()``
+    makes the jitted callable on a miss.  Hits/misses/evictions are
+    counted under ``batched_handle_cache`` and a decision event is
+    recorded per compile (so a shape-churning caller shows up in the
+    obs report as a stream of misses, not silence)."""
+    with _cache_lock:
+        handle = _cache.get(key)
+        if handle is not None:
+            _cache.move_to_end(key)
+            _cache_stats["hits"] += 1
+            obs.count("batched_handle_cache", op=key[0], event="hit")
+            return handle
+        _cache_stats["misses"] += 1
+    # build outside the lock (tracing can be slow); worst case two
+    # threads race the same key and one handle wins the insert
+    fn = builder()
+    handle = BatchedHandle(key, fn)
+    obs.count("batched_handle_cache", op=key[0], event="miss")
+    obs.record_decision("batched", key[0], key=repr(key[1:]))
+    with _cache_lock:
+        existing = _cache.get(key)
+        if existing is not None:
+            return existing
+        _cache[key] = handle
+        while len(_cache) > BATCHED_CACHE_MAXSIZE:
+            _cache.popitem(last=False)
+            _cache_stats["evictions"] += 1
+            obs.count("batched_handle_cache", op=key[0],
+                      event="eviction")
+    return handle
+
+
+def handle_cache_info() -> dict:
+    """Snapshot of the handle cache: size, capacity, hits/misses/
+    evictions, and the resident keys oldest-first."""
+    with _cache_lock:
+        return {"size": len(_cache), "maxsize": BATCHED_CACHE_MAXSIZE,
+                **_cache_stats, "keys": list(_cache)}
+
+
+def clear_handle_cache() -> None:
+    """Drop every cached handle and zero the stats (tests; a server
+    rolling new geometry sets can also use it as a coarse reset)."""
+    with _cache_lock:
+        _cache.clear()
+        for k in _cache_stats:
+            _cache_stats[k] = 0
+
+
+def _donate_argnums(donate: bool) -> tuple:
+    """Donation argnums for the signal batch: only when the caller
+    opted in (donation deletes a device-resident input array) AND on
+    TPU (the CPU backend cannot honor donation and jax warns per
+    call)."""
+    return (0,) if (donate and on_tpu()) else ()
+
+
+def _as_batch2d(x):
+    """Validate a leading-batch-dim signal array and flatten to
+    ``[rows, n]``; returns ``(x2d_host_or_device, batch_shape, n)``."""
+    shape = np.shape(x)
+    if len(shape) < 2:
+        raise ValueError(
+            f"batched ops take [..., batch, n] signals, got shape "
+            f"{shape} — use the single-signal op for 1D input")
+    n = shape[-1]
+    if n == 0:
+        raise ValueError("empty signal")
+    return shape[:-1], n
+
+
+# ---------------------------------------------------------------------------
+# resample
+# ---------------------------------------------------------------------------
+
+
+def batched_resample_poly(x, up: int, down: int, taps=None, simd=None,
+                          donate: bool = False):
+    """Rational-rate resampling of a BATCH of equal-length signals in
+    one dispatch: ``x[..., batch, n] -> [..., batch, ceil(n*up/down)]``.
+
+    Same numerics/conventions as
+    :func:`~veles.simd_tpu.ops.resample.resample_poly` (the handle
+    wraps the same dilated-conv core); the anti-aliasing taps stay
+    runtime data, so switching filters does NOT recompile — only a new
+    ``(batch, n, up, down, len(taps))`` geometry does.  ``donate=True``
+    donates the signal batch to the executable on TPU — the caller's
+    ``x`` becomes invalid afterwards (see the module note).
+    """
+    from veles.simd_tpu.ops import resample as rs
+
+    batch_shape, n = _as_batch2d(x)
+    up, down, taps = rs._normalize_resample_args(n, up, down, taps)
+    if not resolve_simd(simd, op="batched_resample_poly"):
+        return rs.resample_poly_na(x, up, down, taps).astype(np.float32)
+    if up == 1 and down == 1:
+        return jnp.asarray(x, jnp.float32)
+    out_len = rs.resample_length(n, up, down)
+    rows = int(np.prod(batch_shape))
+    donation = _donate_argnums(donate)
+    key = ("resample_poly", rows, n, up, down, len(taps), donation)
+
+    def build():
+        def run(xb, tapsj):
+            return rs._resample_conv(xb, tapsj, up, down, out_len)
+
+        return jax.jit(run, donate_argnums=donation)
+
+    handle = _get_handle(key, build)
+    x2d = jnp.asarray(x, jnp.float32).reshape(rows, n)
+    out = handle(x2d, jnp.asarray(taps, jnp.float32))
+    return out.reshape(batch_shape + (out_len,))
+
+
+# ---------------------------------------------------------------------------
+# IIR cascades / transfer functions
+# ---------------------------------------------------------------------------
+
+
+def batched_sosfilt(sos, x, simd=None, donate: bool = False):
+    """Second-order-section cascade over a BATCH of equal-length
+    signals in one dispatch: ``x[..., batch, n] -> same shape``.
+
+    Same associative-scan numerics as
+    :func:`~veles.simd_tpu.ops.iir.sosfilt` (zero initial state — the
+    streaming/zi form stays on the single-signal API).  The section
+    coefficients are part of the compiled program (they parameterize
+    the scan's companion matrices), so the handle key includes them:
+    one executable per (filter, batch, length).  ``donate=True``
+    donates the signal batch on TPU (invalidates the caller's ``x`` —
+    module note).
+    """
+    from veles.simd_tpu.ops import iir
+
+    sos = iir._check_sos(sos)
+    batch_shape, n = _as_batch2d(x)
+    if not resolve_simd(simd, op="batched_sosfilt"):
+        return iir.sosfilt_na(sos, x).astype(np.float32)
+    sos_key = tuple(tuple(float(v) for v in row) for row in sos)
+    rows = int(np.prod(batch_shape))
+    donation = _donate_argnums(donate)
+    key = ("sosfilt", rows, n, sos_key, donation)
+
+    def build():
+        sos_rows = np.asarray(sos_key, np.float32)
+
+        def run(xb):
+            return iir._sos_scan(xb, sos_rows)
+
+        return jax.jit(run, donate_argnums=donation)
+
+    handle = _get_handle(key, build)
+    out = handle(jnp.asarray(x, jnp.float32).reshape(rows, n))
+    return out.reshape(batch_shape + (n,))
+
+
+def batched_lfilter(b, a, x, simd=None, donate: bool = False):
+    """Direct-form transfer-function filter over a BATCH of
+    equal-length signals in one dispatch (the batched form of
+    :func:`~veles.simd_tpu.ops.iir.lfilter`, same companion-matrix
+    scan and order bound).  Coefficients key the compiled program,
+    like :func:`batched_sosfilt`; ``donate=True`` donates the signal
+    batch on TPU (invalidates the caller's ``x`` — module note).
+    """
+    from veles.simd_tpu.ops import iir
+
+    b, a = iir._normalize_ba(b, a)
+    p = len(a) - 1
+    if p > iir._LFILTER_MAX_ORDER:
+        raise ValueError(
+            f"denominator order {p} > {iir._LFILTER_MAX_ORDER}: use "
+            "batched_sosfilt (cascaded second-order sections) for "
+            "high-order filters")
+    batch_shape, n = _as_batch2d(x)
+    if not resolve_simd(simd, op="batched_lfilter"):
+        return iir.lfilter_na(b, a, x).astype(np.float32)
+    if p == 0:
+        a = np.concatenate([a, [0.0]])  # pure FIR: drive only
+    b_key = tuple(float(v) for v in b)
+    a_key = tuple(float(v) for v in a)
+    rows = int(np.prod(batch_shape))
+    donation = _donate_argnums(donate)
+    key = ("lfilter", rows, n, b_key, a_key, donation)
+
+    def build():
+        def run(xb):
+            return iir._lfilter_xla(xb, b_key, a_key)
+
+        return jax.jit(run, donate_argnums=donation)
+
+    handle = _get_handle(key, build)
+    out = handle(jnp.asarray(x, jnp.float32).reshape(rows, n))
+    return out.reshape(batch_shape + (n,))

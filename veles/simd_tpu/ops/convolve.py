@@ -24,7 +24,12 @@ but maps each to what the hardware actually wants:
   the reference's sequential hot loop (``src/convolve.c:181-228``) becomes
   one fused FFT·multiply·IFFT over a batch dimension.  The same frame
   decomposition is what shards across chips in
-  :mod:`veles.simd_tpu.parallel` (halo = the M−1 overlap).
+  :mod:`veles.simd_tpu.parallel` (halo = the M−1 overlap).  For
+  short/medium filters the spectral form is replaced by an MXU block
+  matmul (``os_matmul``), served on TPU by a fused Pallas kernel that
+  streams x through VMEM once with the M−1 halo carried between grid
+  steps (:func:`_use_pallas_os`; XLA frames-matmul fallback behind the
+  same auto-select).
 
 Result length is always ``x_length + h_length - 1`` (full linear
 convolution).  All entry points accept leading batch dimensions; the
@@ -247,6 +252,40 @@ def os_precision() -> str:
     """The MXU precision the overlap-save block matmul will use
     (``Config.conv_precision``)."""
     return get_config().conv_precision
+
+
+# filter lengths whose fused overlap-save compile OOMed Mosaic's
+# scoped-vmem stack (consulted by _run's route; a process sees a
+# handful of distinct filter lengths, so a plain set suffices — the
+# shape-class LRU discipline lives in convolve2d where keys are 5-dim)
+_PALLAS_OS_REJECTED = set()
+
+
+def _use_pallas_os(h_length: int) -> bool:
+    """Route the overlap-save block matmul through the fused Pallas
+    kernel (:func:`~veles.simd_tpu.ops.pallas_kernels.\
+overlap_save_pallas`): the XLA formulation materializes its frames
+    operand as J ~ 1 + h/step shifted copies of the signal through HBM,
+    while the fused kernel streams each x block through VMEM once with
+    the h-1 halo carried between grid steps.  Long filters only (short
+    ones are barely duplicated and already compute-bound on the XLA
+    path), resident factors within the VMEM budget, opt-out via
+    ``VELES_SIMD_DISABLE_PALLAS_OS``.  Tests monkeypatch this gate to
+    exercise the kernel on CPU."""
+    return (_pk.pallas_available() and _pk.pallas_os_allowed()
+            and h_length >= _pk.PALLAS_OS_MIN_H
+            and _pk.fits_vmem_os(h_length))
+
+
+@functools.partial(jax.jit, static_argnames=("reverse", "precision"))
+def _conv_os_pallas(x, h, reverse=False, precision=None):
+    """Overlap-save as the fused Pallas kernel (same contract as
+    :func:`_conv_os_matmul`; the step is the kernel's own
+    ``PALLAS_OS_STEP`` — its redundancy/tiling trade-off differs from
+    the XLA path's, see the constant's note)."""
+    kernel = jnp.flip(h, axis=-1) if reverse else h
+    return _pk.overlap_save_pallas(x, kernel,
+                                   precision=precision or "highest")
 
 
 @functools.partial(jax.jit, static_argnames=("step", "reverse",
@@ -476,6 +515,41 @@ def _run(handle: ConvolutionHandle, x, h, simd=None):
         if handle.algorithm is ConvolutionAlgorithm.FFT:
             return _conv_fft(x, h, handle.fft_length, reverse=handle.reverse)
         if handle.os_matmul:
+            if (_use_pallas_os(handle.h_length)
+                    and handle.h_length not in _PALLAS_OS_REJECTED):
+                try:
+                    out = _conv_os_pallas(x, h, reverse=handle.reverse,
+                                          precision=os_precision())
+                except Exception as e:
+                    # Mosaic's scoped-vmem cap is not predictable from
+                    # shape arithmetic (convolve2d learned this on
+                    # hardware): demote the filter length to the XLA
+                    # path on the specific vmem-OOM compile error and
+                    # remember it.  Under an OUTER jit the compile
+                    # error surfaces uncatchably at the outer compile —
+                    # traced callers rely on fits_vmem_os's margin and
+                    # the VELES_SIMD_DISABLE_PALLAS_OS escape hatch;
+                    # eager callers (bench, handle API) get this
+                    # fallback.
+                    from veles.simd_tpu.ops.convolve2d import (
+                        _is_mosaic_vmem_oom)
+                    if not _is_mosaic_vmem_oom(e):
+                        raise
+                    _PALLAS_OS_REJECTED.add(handle.h_length)
+                    obs.count("pallas_os_demotion", reason="compile_oom")
+                else:
+                    # recorded AFTER the attempt resolves, so a
+                    # demotion never misattributes the executed route
+                    obs.record_decision(
+                        "convolve_os_route", "pallas_fused",
+                        x_length=handle.x_length,
+                        h_length=handle.h_length,
+                        step=_pk.PALLAS_OS_STEP)
+                    return out
+            obs.record_decision(
+                "convolve_os_route", "xla_matmul",
+                x_length=handle.x_length, h_length=handle.h_length,
+                step=handle.step)
             return _conv_os_matmul(x, h, handle.step, reverse=handle.reverse,
                                    precision=os_precision())
         return _conv_overlap_save(x, h, handle.block_length,

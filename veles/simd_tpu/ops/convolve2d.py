@@ -25,12 +25,15 @@ kernel, exactly like ``src/correlate.c:37-72`` in 1D.
 
 from __future__ import annotations
 
+import collections
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from veles.simd_tpu import obs
 from veles.simd_tpu.ops import pallas_kernels as _pk
 from veles.simd_tpu.utils.config import resolve_simd
 from veles.simd_tpu.utils.memory import next_highest_power_of_2
@@ -161,13 +164,53 @@ def _check2d(x, h):
             f"{np.shape(h)}")
 
 
+class _LRUSet:
+    """Bounded membership cache with least-recently-used eviction —
+    set-compatible surface (``add`` / ``in`` / ``len``) so tests can
+    substitute a plain ``set``.  A membership HIT refreshes the entry:
+    shapes a workload keeps asking about stay resident while one-off
+    geometry churn ages out.  Locked: unlike the plain set it
+    replaces, ``move_to_end``/``popitem`` are not GIL-atomic as a
+    pair, and the motivating caller is a concurrent service.  (The
+    batched-op handle cache in :mod:`.batched` keeps its own
+    OrderedDict because it stores values + hit/miss stats; if a third
+    LRU appears, extract a shared utility.)"""
+
+    def __init__(self, maxsize: int):
+        self.maxsize = int(maxsize)
+        self._entries = collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return True
+            return False
+
+    def add(self, key) -> None:
+        with self._lock:
+            self._entries[key] = None
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.maxsize:
+                self._entries.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
 # Shape classes the compiled 2D kernel failed to compile for (Mosaic
 # scoped-vmem OOM — unpredictable from shape arithmetic, see
 # pallas_kernels.fits_vmem2d).  Keyed on (batch_rows, n0, n1, k0, k1):
 # the OOM outcome depends on the per-tile row count, so batch variants
 # of an image/kernel shape are cached independently.  Consulted by
 # _use_pallas_direct2d so a shape only pays the failed compile once.
-_PALLAS2D_OOM_REJECTED = set()
+# LRU-bounded: a long-running service cycling arbitrary geometries must
+# not grow an unbounded rejection set (each evicted shape simply pays
+# one more failed compile if it ever comes back).
+_PALLAS2D_OOM_MAXSIZE = 256
+_PALLAS2D_OOM_REJECTED = _LRUSet(_PALLAS2D_OOM_MAXSIZE)
 
 # Scoped-stack model used ONLY for calls traced under an outer jit,
 # where the Mosaic compile error surfaces at the OUTER compile and the
@@ -225,8 +268,13 @@ def _run2d(x, h, reverse, algorithm, simd):
                     out_tile <= _TRACED_SMALL_TILE_BYTES
                     and k0 * k1 * out_tile
                     > _TRACED_SCOPED_BUDGET_BYTES)
-                if not use_pallas and auto:
-                    algorithm = "fft"
+                if not use_pallas:
+                    # fires once per trace, at the Python dispatch
+                    # layer — the jaxpr is untouched
+                    obs.count("pallas2d_demotion",
+                              reason="traced_small_tile_model")
+                    if auto:
+                        algorithm = "fft"
             if use_pallas:
                 try:
                     return _conv2d_direct_pallas(x, h, reverse=reverse)
@@ -234,6 +282,7 @@ def _run2d(x, h, reverse, algorithm, simd):
                     if not _is_mosaic_vmem_oom(e):
                         raise
                     _PALLAS2D_OOM_REJECTED.add(_oom_key(x.shape, k0, k1))
+                    obs.count("pallas2d_demotion", reason="compile_oom")
                     if auto:      # re-route as the gate would have
                         algorithm = "fft"
             if algorithm == "direct":

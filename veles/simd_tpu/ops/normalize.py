@@ -40,7 +40,10 @@ def _normalize2d(src):
     v = src.astype(jnp.float32)
     mn = jnp.min(v, axis=(-2, -1), keepdims=True)
     mx = jnp.max(v, axis=(-2, -1), keepdims=True)
-    diff = (mx - mn) / 2.0
+    # guard the denominator BEFORE dividing: the max==min plane must
+    # not manufacture an inf/nan the final where() hides from the
+    # result but not from jax_debug_nans
+    diff = jnp.where(mx == mn, 1.0, (mx - mn) / 2.0)
     out = (v - mn) / diff - 1.0
     return jnp.where(mx == mn, jnp.zeros_like(out), out)
 
@@ -53,7 +56,7 @@ def _normalize2d_minmax(mn, mx, src):
     if mn.ndim:  # per-plane values from a batched minmax2D
         mn = mn[..., None, None]
         mx = mx[..., None, None]
-    diff = (mx - mn) / 2.0
+    diff = jnp.where(mx == mn, 1.0, (mx - mn) / 2.0)  # see _normalize2d
     out = (v - mn) / diff - 1.0
     return jnp.where(mx == mn, jnp.zeros_like(out), out)
 

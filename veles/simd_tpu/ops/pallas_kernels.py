@@ -63,10 +63,13 @@ from jax.experimental.pallas import tpu as pltpu
 from veles.simd_tpu.utils.config import on_tpu
 
 __all__ = ["filter_bank_pallas", "filter_2d_pallas",
-           "cascade_bank_pallas", "pallas_available",
-           "pallas2d_compiled_allowed",
+           "cascade_bank_pallas", "overlap_save_pallas",
+           "pallas_available",
+           "pallas2d_compiled_allowed", "pallas_os_allowed",
+           "fits_vmem_os",
            "PALLAS_MIN_ROWS", "PALLAS_DIRECT_MAX_H",
-           "PALLAS_2D_MAX_KERNEL_AREA"]
+           "PALLAS_2D_MAX_KERNEL_AREA",
+           "PALLAS_OS_STEP", "PALLAS_OS_ROWS", "PALLAS_OS_MIN_H"]
 
 # the kernel wins when the batch tile fills VPU sublanes; below this the
 # dispatch/layout overhead dominates and the XLA conv path is used
@@ -98,6 +101,49 @@ _VMEM_BUDGET_BYTES = 10 << 20   # for 2*(in+out) + temps
 def pallas_available() -> bool:
     """Compiled Mosaic path available (real TPU backend)?"""
     return on_tpu()
+
+
+# ---- fused overlap-save (MXU) routing constants ---------------------------
+# output-block width of the fused overlap-save kernel: the per-shift
+# factors are [step, step] matmuls, so step must be a lane multiple;
+# 256 keeps the Toeplitz redundancy (k + step MACs per output sample
+# vs k useful) low for the long filters this path serves — at k=2047
+# the ceiling is k/(k+step) = 89% of the useful-FLOPs roofline, vs 80%
+# at step 512
+PALLAS_OS_STEP = 256
+# signal rows (output blocks) per grid step: [rows, step] x-tiles feed
+# [rows, step] @ [step, step] MXU dots; 256 amortizes the resident
+# Toeplitz factors over a full MXU-height operand
+PALLAS_OS_ROWS = 256
+# below this many taps the frames duplication the fused kernel removes
+# is <= 2x and the XLA block-matmul path is already compute-bound;
+# keep the compiled-kernel routing surface to the shapes it was built
+# for (the reference's long-filter overlap-save domain)
+PALLAS_OS_MIN_H = 256
+_PALLAS_OS_ENV = "VELES_SIMD_DISABLE_PALLAS_OS"
+
+
+def pallas_os_allowed() -> bool:
+    """May implicit routing use the compiled fused overlap-save kernel?
+    True unless explicitly disabled (mirrors the 2D kernel's
+    ``VELES_SIMD_DISABLE_PALLAS2D`` opt-out)."""
+    return os.environ.get(_PALLAS_OS_ENV, "0").strip().lower() not in (
+        "1", "true", "yes", "on")
+
+
+def fits_vmem_os(h_length: int, step: int = PALLAS_OS_STEP,
+                 rows: int = PALLAS_OS_ROWS) -> bool:
+    """Does the fused overlap-save kernel's resident state fit VMEM?
+
+    Residency: the ``[n_j, step, step]`` Toeplitz factors (constant
+    across grid steps), the ``[jb + rows, step]`` window scratch + the
+    ``[jb, step]`` carry, and the double-buffered in/out tiles."""
+    jb = -(-(int(h_length) - 1) // int(step))
+    n_j = jb + 1
+    mb_bytes = n_j * step * step * 4
+    scratch_bytes = (jb + rows + jb) * step * 4
+    tile_bytes = 2 * 2 * rows * step * 4     # in + out, double-buffered
+    return mb_bytes + scratch_bytes + tile_bytes <= _VMEM_BUDGET_BYTES
 
 
 # The compiled 2D Mosaic kernel's first-ever hardware execution
@@ -519,3 +565,141 @@ def filter_bank_pallas(x_ext, filters, stride, dilation, n_out,
     outs = _fb_call(phases, taps, tap_counts, kern_dilation, n_out,
                     bool(interpret))
     return tuple(o.reshape(batch_shape + (n_out,)) for o in outs)
+
+
+# ---------------------------------------------------------------------------
+# fused overlap-save convolution (MXU block matmul, halo carried in VMEM)
+# ---------------------------------------------------------------------------
+
+
+def _os_kernel(mbt_ref, x_ref, o_ref, w_ref, carry_ref, *, n_j, rows,
+               precision):
+    """One overlap-save tile: ``rows`` output blocks of ``step`` samples.
+
+    The Toeplitz matmul ``y[r, t] = sum_a frames[r, a] * M[a, t]`` is
+    evaluated WITHOUT materializing frames: split the frame column
+    ``a = j*step + b`` and each shift j becomes a ``[rows, step] @
+    [step, step]`` MXU dot against a row-block of the window
+
+        y[r, t] = sum_j sum_b W[jb - j + r, b] * taps[j*step + t - b]
+
+    where ``W = [carry; x_tile]`` is the tile's input rows prefixed by
+    the last ``jb = n_j - 1`` rows of the PREVIOUS tile — the M-1 halo,
+    carried across grid steps in a VMEM scratch instead of re-read
+    (grid steps run sequentially on a TPU core, so the carry written by
+    step t is exactly what step t+1 reads).  ``mbt_ref[j][t, b] =
+    taps[j*step + t - b]`` are the per-shift Toeplitz factors, VMEM-
+    resident and shared by every grid step.  Every slice is unit-stride
+    at a static offset; accumulation goes statement-by-statement into
+    the output ref (the module's Mosaic-stack discipline).
+    """
+    jb = n_j - 1
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _():
+        # first tile of each batch row: zero history (src/convolve.c:
+        # 194-196 zero-pads the first block the same way)
+        carry_ref[...] = jnp.zeros(carry_ref.shape, carry_ref.dtype)
+
+    w_ref[0:jb, :] = carry_ref[...]
+    w_ref[jb:, :] = x_ref[0]
+    for j in range(n_j):
+        lhs = w_ref[jb - j:jb - j + rows, :]
+        term = jax.lax.dot_general(
+            lhs, mbt_ref[j],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            precision=precision, preferred_element_type=jnp.float32)
+        o_ref[0] = term if j == 0 else o_ref[0] + term
+    carry_ref[...] = x_ref[0, rows - jb:, :]
+
+
+@functools.partial(jax.jit, static_argnames=("n_j", "rows", "precision",
+                                             "interpret"))
+def _os_call(x3d, taps, n_j, rows, precision, interpret):
+    B, n_rows_pad, s = x3d.shape
+    k = taps.shape[-1]
+    # MT[t, a] = taps[t + k - 1 - a] via the gather-free tile trick
+    # (ops/convolve._conv_os_matmul documents why: t*(k+s) = -t mod
+    # k+s+1), then front-pad and reshape the frame columns into the
+    # per-shift [step, step] factors mbt[j][t, b] = taps[j*s + t - b]
+    w = jnp.pad(jnp.flip(taps, axis=-1), (0, s + 1))
+    mt = jnp.tile(w, s)[: s * (k + s)].reshape(s, k + s)[:, : s + k - 1]
+    mtp = jnp.pad(mt, [(0, 0), (n_j * s - (s + k - 1), 0)])
+    mbt = jnp.moveaxis(jnp.flip(mtp.reshape(s, n_j, s), axis=1), 1, 0)
+    kernel = functools.partial(_os_kernel, n_j=n_j, rows=rows,
+                               precision=jax.lax.Precision(precision))
+    return pl.pallas_call(
+        kernel,
+        grid=(B, n_rows_pad // rows),
+        in_specs=[pl.BlockSpec((n_j, s, s), lambda b, t: (0, 0, 0)),
+                  pl.BlockSpec((1, rows, s), lambda b, t: (b, t, 0))],
+        out_specs=pl.BlockSpec((1, rows, s), lambda b, t: (b, t, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, n_rows_pad, s), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((n_j - 1 + rows, s), jnp.float32),
+                        pltpu.VMEM((n_j - 1, s), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * B * n_rows_pad * s * n_j * s,
+            bytes_accessed=4 * (2 * B * n_rows_pad * s + n_j * s * s),
+            transcendentals=0),
+        interpret=interpret,
+    )(mbt, x3d)
+
+
+def overlap_save_pallas(x, taps, step: int = PALLAS_OS_STEP,
+                        rows: int = PALLAS_OS_ROWS, precision="highest",
+                        interpret=None):
+    """Full linear convolution ``y[..., n+k-1] = x * taps`` as a fused
+    overlap-save Pallas kernel.
+
+    The XLA block-matmul formulation
+    (:func:`veles.simd_tpu.ops.convolve._conv_os_matmul`) materializes
+    its frames operand as J concatenated shifted copies of the signal —
+    J ~ 1 + k/step passes of x through HBM before the matmul reads it.
+    This kernel streams x through VMEM exactly once: each grid step
+    loads ``rows`` signal blocks, keeps the k-1-sample halo from the
+    previous step in a VMEM carry, and runs the same Toeplitz matmul as
+    ``n_j`` per-shift ``[rows, step] @ [step, step]`` MXU dots over
+    row-blocks of the in-VMEM window (derivation at :func:`_os_kernel`).
+
+    ``taps`` must be 1D in CONVOLUTION orientation (callers flip for
+    correlation, like the other kernels here) with at least 2 taps
+    (a 1-tap filter has no halo — use the direct path).  ``precision``
+    is the MXU pass count (``"highest"`` = 6-pass bf16 = full f32).
+    Leading batch dims on ``x`` ride along (each batch row restarts the
+    carry).  ``interpret=None`` auto-selects: compiled Mosaic on TPU,
+    interpreter elsewhere (the CPU test path).
+    """
+    taps = jnp.asarray(taps, jnp.float32)
+    if taps.ndim != 1:
+        raise ValueError("taps must be 1D")
+    k = taps.shape[-1]
+    if k < 2:
+        raise ValueError("overlap-save needs >= 2 taps (no halo to "
+                         "carry at k=1; use the direct path)")
+    s = int(step)
+    if s % 128 != 0:
+        raise ValueError(f"step {s} must be a 128-lane multiple")
+    n = x.shape[-1]
+    out_len = n + k - 1
+    jb = -(-(k - 1) // s)
+    n_j = jb + 1
+    if interpret is None:
+        interpret = not pallas_available()
+    n_rows = -(-out_len // s)
+    # shrink the row tile for short signals (8-sublane multiples), but
+    # never below the halo row count the carry update slices
+    r = min(int(rows), max(8, ((n_rows + 7) // 8) * 8))
+    r = max(r, ((jb + 7) // 8) * 8)
+    if not interpret and not fits_vmem_os(k, s, r):
+        raise ValueError(
+            f"overlap-save factors for k={k}, step={s} exceed the "
+            "kernel VMEM budget; keep this shape on the XLA path")
+    rows_pad = -(-n_rows // r) * r
+    batch_shape = x.shape[:-1]
+    x2d = jnp.asarray(x, jnp.float32).reshape(-1, n)
+    x3d = jnp.pad(x2d, [(0, 0), (0, rows_pad * s - n)]).reshape(
+        -1, rows_pad, s)
+    out = _os_call(x3d, taps, n_j, r, str(precision), bool(interpret))
+    return out.reshape(x2d.shape[0], rows_pad * s)[
+        :, :out_len].reshape(batch_shape + (out_len,))

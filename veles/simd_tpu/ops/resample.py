@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from veles.simd_tpu.utils.config import resolve_simd
+from veles.simd_tpu.utils.config import on_tpu, resolve_simd
 
 __all__ = [
     "design_lowpass", "resample_poly", "resample_poly_na", "upfirdn",
@@ -109,17 +109,37 @@ def _resample_conv(x, taps, up, down, out_len, pad=None):
     while running this exact same kernel.
     """
     k = taps.shape[0]
+    n = x.shape[-1]
+    dilated = (n - 1) * up + 1
     if pad is None:
         pad_l = (k - 1) // 2  # group delay of the centered odd filter
         # right padding sized so the final stride window (output index
         # out_len - 1, offset (out_len-1)*down .. +k-1) stays in bounds
-        dilated = (x.shape[-1] - 1) * up + 1
         pad = (pad_l, max(0, (out_len - 1) * down + k - pad_l - dilated))
-    lhs = x.reshape((-1, 1, x.shape[-1]))
     rhs = taps[::-1].reshape((1, 1, k))
+    if up > 1 and down > 1 and not on_tpu():
+        # XLA's CPU lowering miscompiles lhs_dilation combined with
+        # window_strides > 1 for small filters (observed jax 0.4.37:
+        # k <= ~256 returns the UNSTRIDED output's prefix — strided
+        # result != stride-1 result [::down] from the SAME call, an
+        # internal inconsistency; k >= ~481 takes a different, correct
+        # path).  Off-TPU, zero-stuff explicitly (concat/reshape — no
+        # scatter, see iir._delay) and stride a plain conv: identical
+        # MAC count per output, one extra n*up buffer.  TPU keeps the
+        # fused form — every resample smoke is green on real hardware
+        # (BASELINE.md round 5) and it never materializes the stuffed
+        # signal.
+        stuffed = jnp.concatenate(
+            [x[..., None], jnp.zeros(x.shape + (up - 1,), x.dtype)],
+            axis=-1).reshape(x.shape[:-1] + (n * up,))[..., :dilated]
+        lhs = stuffed.reshape((-1, 1, dilated))
+        lhs_dil = (1,)
+    else:
+        lhs = x.reshape((-1, 1, n))
+        lhs_dil = (up,)
     out = jax.lax.conv_general_dilated(
         lhs, rhs, window_strides=(down,), padding=[pad],
-        lhs_dilation=(up,), precision=jax.lax.Precision.HIGHEST)
+        lhs_dilation=lhs_dil, precision=jax.lax.Precision.HIGHEST)
     return out.reshape(x.shape[:-1] + (out.shape[-1],))[..., :out_len]
 
 

@@ -1530,7 +1530,12 @@ def sharded_normalize2d(src, mesh: Mesh, axis: str = "sp"):
         v = block.astype(jnp.float32)
         mn = jax.lax.pmin(jnp.min(v), axis)
         mx = jax.lax.pmax(jnp.max(v), axis)
-        out = (v - mn) / ((mx - mn) / 2.0) - 1.0
+        # guard the denominator BEFORE dividing: a flat plane would
+        # otherwise manufacture inf/nan that the final where() hides
+        # from the result but not from jax_debug_nans (matches the
+        # single-chip ops/normalize.py guard)
+        diff = jnp.where(mx == mn, 1.0, (mx - mn) / 2.0)
+        out = (v - mn) / diff - 1.0
         return jnp.where(mx == mn, jnp.zeros_like(out), out)
 
     return _run(srcj)[:h]

@@ -21,13 +21,62 @@ microsecond-scale ops its marginal is noise.
 
 from __future__ import annotations
 
+import os
 import time
 import warnings
 
 import numpy as np
 
 __all__ = ["device_time", "device_time_chained", "host_time",
-           "rms_normalize"]
+           "rms_normalize", "mxu_peak_tflops", "mxu_f32_bound_tflops",
+           "conv_roofline", "MXU_PEAK_TFLOPS_BF16", "MXU_F32_PASSES"]
+
+
+# ---------------------------------------------------------------------------
+# MXU roofline accounting (the denominators BASELINE.md's % figures use)
+# ---------------------------------------------------------------------------
+
+# public TPU v5e ceiling; override with $VELES_SIMD_MXU_PEAK_TFLOPS on
+# other hardware generations (the % -of-bound figures in the bench rows
+# all key off this one constant)
+MXU_PEAK_TFLOPS_BF16 = 197.0
+# f32 emulation pass counts per MXU precision knob: "highest" = 6-pass
+# bf16 (full f32), "high" = 3-pass (~1.3e-5 rel err on the conv oracle)
+MXU_F32_PASSES = {"highest": 6, "high": 3}
+
+
+def mxu_peak_tflops() -> float:
+    """bf16 MXU peak in TFLOP/s (env-overridable hardware constant)."""
+    return float(os.environ.get("VELES_SIMD_MXU_PEAK_TFLOPS",
+                                MXU_PEAK_TFLOPS_BF16))
+
+
+def mxu_f32_bound_tflops(precision: str = "highest") -> float:
+    """The f32 MXU roofline at an emulation precision: bf16 peak divided
+    by the pass count (32.8 TFLOP/s for 6-pass ``highest`` at the v5e
+    default peak — the denominator of BASELINE.md's 69% conv figure)."""
+    try:
+        passes = MXU_F32_PASSES[precision]
+    except KeyError:
+        raise ValueError(
+            f"precision must be one of {sorted(MXU_F32_PASSES)}, got "
+            f"{precision!r}") from None
+    return mxu_peak_tflops() / passes
+
+
+def conv_roofline(samples_per_s: float, h_length: int,
+                  precision: str = "highest") -> dict:
+    """Roofline attribution of a 1D-convolution rate: effective
+    TFLOP/s (2·h useful FLOPs per output sample — the convolution's
+    own work, NOT the blocked algorithm's redundant MACs) and the % of
+    the f32 MXU bound at the given precision knob.  Returns a dict so
+    bench rows can embed it verbatim."""
+    bound = mxu_f32_bound_tflops(precision)
+    eff = 2.0 * int(h_length) * samples_per_s / 1e12
+    return {"tflops_effective": eff,
+            "roofline_bound_tflops": bound,
+            "pct_of_roofline": 100.0 * eff / bound,
+            "precision": precision}
 
 
 def rms_normalize(p, eps: float = 1e-30):

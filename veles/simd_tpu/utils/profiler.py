@@ -89,4 +89,21 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str:
     # library's dispatch surface
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    try:
+        # without this the CPU backend (the test platform) never writes
+        # entries at all — the cache silently stays empty
+        jax.config.update("jax_persistent_cache_enable_xla_caches",
+                          "all")
+    except AttributeError:  # older jax without the knob
+        pass
+    try:
+        # jax pins its cache object at the FIRST compile: a process
+        # that already jitted anything (observed: one profiler.trace
+        # session) silently ignores a later cache-dir config unless
+        # the cache is re-initialized.  Private API, so best-effort.
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 — enabling later compiles still
+        pass           # works on jax versions without reset_cache
     return cache_dir
