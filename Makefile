@@ -5,8 +5,8 @@
 
 PYTHON ?= python
 
-.PHONY: all tests tests-quick benchmarks bench cshim cshim-check wavelet-tables lint \
-        docs obs-report install install-hooks clean
+.PHONY: all tests tests-quick benchmarks bench bench-regress cshim cshim-check \
+        wavelet-tables lint docs obs-report install install-hooks clean
 
 all: cshim
 
@@ -24,6 +24,12 @@ benchmarks:
 
 bench:
 	$(PYTHON) bench.py --all
+
+# fold the latest bench run into BENCH_HISTORY.jsonl and fail (rc=1) on
+# a headline/suite regression vs the trailing-median baseline — the CI
+# gate after `make bench`.  Knobs: tools/bench_regress.py --help
+bench-regress:
+	$(PYTHON) tools/bench_regress.py
 
 cshim:
 	$(MAKE) -C csrc all

@@ -59,10 +59,11 @@ from veles.simd_tpu.utils.benchmark import (
 
 def _telemetry_entry():
     """Compact per-config telemetry for BENCH_DETAILS.json: which
-    algorithms were picked, how many compiles ran, whether the
-    persistent cache served them — the attribution record that turns a
-    bench regression from "slower" into "took a different path"."""
-    from veles.simd_tpu.obs.export import flatten_counters
+    algorithms were picked, how long their host dispatch took, how many
+    compiles ran, whether the persistent cache served them — the
+    attribution record that turns a bench regression from "slower"
+    into "took a different path"."""
+    from veles.simd_tpu.obs.export import flatten_counters, span_summary
 
     snap = obs.snapshot()
     decisions = [{k: v for k, v in e.items() if v is not None}
@@ -70,10 +71,12 @@ def _telemetry_entry():
     return {
         "decisions": decisions[-16:],
         "counters": flatten_counters(snap),
+        "spans": span_summary(snap),
         "compiles": obs.counter_value("compile.backend_compile"),
         "cache_hits": obs.counter_value("compile.cache_hits"),
         "cache_misses": obs.counter_value("compile.cache_misses"),
         "events_dropped": snap["events_dropped"],
+        "spans_dropped": snap["spans_dropped"],
     }
 
 

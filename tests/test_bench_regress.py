@@ -1,0 +1,195 @@
+"""Tests for the bench-regression gate (``tools/bench_regress.py``).
+
+Pure synthetic fixtures — no device, no timing: a fake
+``BENCH_DETAILS.json`` run plus a fake ``BENCH_HISTORY.jsonl``
+trajectory, asserting the exit-code contract (0 within-noise/improved,
+1 regression, 2 no data), the per-row noise overrides, and that every
+invocation appends exactly one record to the history.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "bench_regress",
+    os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "bench_regress.py"))
+bench_regress = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(bench_regress)
+
+HEADLINE = "convolve 1M x 2047 overlap-save"
+SUITE = "DWT daub8 512x4096"
+
+
+def _write_details(path, headline_value, suite_value=500.0):
+    rows = [
+        {"metric": HEADLINE, "unit": "Msamples/s",
+         "value": headline_value, "baseline": 10.0,
+         "vs_baseline": (None if headline_value is None
+                         else headline_value / 10.0),
+         "device": "FakeDevice(id=0)"},
+        {"metric": SUITE, "unit": "Msamples/s", "value": suite_value,
+         "baseline": 25.0, "vs_baseline": suite_value / 25.0,
+         "device": "FakeDevice(id=0)"},
+        {"skipped_stages": []},   # tail entry must be ignored
+    ]
+    with open(path, "w") as f:
+        json.dump(rows, f)
+    return path
+
+
+def _write_history(path, headline_values, suite_value=500.0):
+    with open(path, "w") as f:
+        for v in headline_values:
+            f.write(json.dumps({
+                "ts": 0.0, "source": "BENCH_DETAILS.json",
+                "device": "FakeDevice(id=0)",
+                "rows": {
+                    HEADLINE: {"value": v, "unit": "Msamples/s",
+                               "vs_baseline": v / 10.0},
+                    SUITE: {"value": suite_value,
+                            "unit": "Msamples/s",
+                            "vs_baseline": suite_value / 25.0},
+                }}) + "\n")
+    return path
+
+
+def _history_len(path):
+    with open(path) as f:
+        return sum(1 for line in f if line.strip())
+
+
+def _run(tmp_path, headline_value, history_values, extra_args=()):
+    details = _write_details(str(tmp_path / "DETAILS.json"),
+                             headline_value)
+    history = _write_history(str(tmp_path / "HISTORY.jsonl"),
+                             history_values)
+    before = _history_len(history)
+    rc = bench_regress.main(["--details", details,
+                             "--history", history, *extra_args])
+    return rc, history, before
+
+
+def test_within_noise_passes_and_appends_one_record(tmp_path, capsys):
+    rc, history, before = _run(tmp_path, 980.0, [1000.0] * 4)
+    assert rc == 0
+    assert _history_len(history) == before + 1
+    assert "within noise" in capsys.readouterr().out
+
+
+def test_improvement_passes(tmp_path, capsys):
+    rc, history, before = _run(tmp_path, 2000.0, [1000.0] * 4)
+    assert rc == 0
+    assert _history_len(history) == before + 1
+    assert "improved" in capsys.readouterr().out
+
+
+def test_regression_fails(tmp_path, capsys):
+    rc, history, before = _run(tmp_path, 500.0, [1000.0] * 4)
+    assert rc == 1
+    # the failed run is STILL recorded: the trajectory must show the
+    # regression, not pretend the run never happened
+    assert _history_len(history) == before + 1
+    out = capsys.readouterr()
+    assert "REGRESSION" in out.out
+    assert HEADLINE in out.err
+
+
+def test_baseline_is_trailing_median_not_latest(tmp_path):
+    # one outlier record must not drag the baseline: median of
+    # [1000, 1000, 1000, 100] is 1000, so 950 stays within 10%
+    rc, _, _ = _run(tmp_path, 950.0, [1000.0, 1000.0, 1000.0, 100.0])
+    assert rc == 0
+
+
+def test_window_bounds_the_baseline(tmp_path):
+    # window=2 sees only the newest two records (the decayed ones), so
+    # 450 is within noise of median(500, 500) even though older
+    # records say 1000
+    rc, _, _ = _run(tmp_path, 480.0, [1000.0, 1000.0, 500.0, 500.0],
+                    extra_args=["--window", "2"])
+    assert rc == 0
+
+
+def test_per_row_noise_override(tmp_path):
+    # -8% trips the default 10%? no — but a tightened per-row 5%
+    # threshold for the headline catches it
+    rc, _, _ = _run(tmp_path, 920.0, [1000.0] * 4)
+    assert rc == 0
+    rc, _, _ = _run(tmp_path, 920.0, [1000.0] * 4,
+                    extra_args=["--noise", "convolve 1M=0.05"])
+    assert rc == 1
+
+
+def test_regressed_runs_never_become_baseline(tmp_path):
+    # a red gate re-run with no fix must stay red: the regressed
+    # records are appended (trajectory) but excluded from the median
+    details = _write_details(str(tmp_path / "DETAILS.json"), 500.0)
+    history = _write_history(str(tmp_path / "HISTORY.jsonl"),
+                             [1000.0] * 3)
+    for i in range(3):     # three consecutive red runs
+        rc = bench_regress.main(["--details", details,
+                                 "--history", history])
+        assert rc == 1, f"run {i} laundered the regression"
+        assert _history_len(history) == 3 + i + 1
+    # a recovered run against the unpolluted baseline passes again
+    details = _write_details(str(tmp_path / "DETAILS.json"), 980.0)
+    assert bench_regress.main(["--details", details,
+                               "--history", history]) == 0
+
+
+def test_no_baseline_yet_passes(tmp_path):
+    rc, history, before = _run(tmp_path, 1000.0, [])
+    assert rc == 0
+    assert _history_len(history) == before + 1
+
+
+def test_null_value_not_gated(tmp_path, capsys):
+    # bench flagged an unresolved measurement: reported, never failed
+    rc, _, _ = _run(tmp_path, None, [1000.0] * 4)
+    assert rc == 0
+    assert "UNRESOLVED" in capsys.readouterr().out
+
+
+def test_no_append_compares_without_recording(tmp_path):
+    rc, history, before = _run(tmp_path, 500.0, [1000.0] * 4,
+                               extra_args=["--no-append"])
+    assert rc == 1
+    assert _history_len(history) == before
+
+
+def test_missing_details_exits_2(tmp_path):
+    rc = bench_regress.main(
+        ["--details", str(tmp_path / "nope.json"),
+         "--history", str(tmp_path / "HISTORY.jsonl")])
+    assert rc == 2
+
+
+def test_empty_details_exits_2(tmp_path):
+    details = tmp_path / "DETAILS.json"
+    details.write_text("[]")
+    rc = bench_regress.main(
+        ["--details", str(details),
+         "--history", str(tmp_path / "HISTORY.jsonl")])
+    assert rc == 2
+
+
+def test_torn_history_line_skipped(tmp_path, capsys):
+    details = _write_details(str(tmp_path / "DETAILS.json"), 980.0)
+    history = _write_history(str(tmp_path / "HISTORY.jsonl"),
+                             [1000.0] * 3)
+    with open(history, "a") as f:
+        f.write('{"ts": 1.0, "rows": {"conv')   # crashed writer
+    rc = bench_regress.main(["--details", details,
+                             "--history", history])
+    assert rc == 0
+    assert "unparseable" in capsys.readouterr().err
+
+
+@pytest.mark.parametrize("spec", ["no-equals", "x=1.5", "x=notnum"])
+def test_bad_noise_spec_rejected(spec):
+    with pytest.raises(SystemExit):
+        bench_regress.main(["--noise", spec])

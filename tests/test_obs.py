@@ -1,17 +1,27 @@
 """Tests for the runtime telemetry subsystem (``veles.simd_tpu.obs``).
 
-Four contracts pinned here:
+Six contracts pinned here:
 
 * the registry is thread-safe and the event log is bounded;
-* both export formats (JSON, Prometheus text) round-trip;
+* both export formats (JSON, Prometheus text) round-trip, with correct
+  exposition escaping/sanitization and histogram
+  ``_bucket``/``_sum``/``_count`` wire format;
 * every ``select_algorithm`` threshold boundary records a decision
   event naming the algorithm actually selected;
+* spans (the time axis) feed warmup/steady latency histograms, nest,
+  export as Perfetto-loadable Chrome trace JSON, and cost ≤5µs per
+  dispatch while telemetry is off;
+* ``obs.save``/``obs.save_trace`` are atomic — a failed write never
+  truncates an existing snapshot;
 * telemetry on or off, traced programs are byte-identical — the whole
   layer lives strictly at the Python dispatch layer.
 """
 
 import concurrent.futures
 import json
+import os
+import re
+import time
 
 import numpy as np
 import pytest
@@ -21,6 +31,7 @@ import jax.numpy as jnp
 
 from veles.simd_tpu import obs
 from veles.simd_tpu.obs import export as obs_export
+from veles.simd_tpu.obs import spans as spans_mod
 from veles.simd_tpu.obs.events import DEFAULT_MAX_EVENTS, EventLog
 from veles.simd_tpu.obs.registry import MetricsRegistry
 from veles.simd_tpu.ops import convolve as cv
@@ -40,7 +51,8 @@ def telemetry():
     yield obs
     obs.disable()
     obs.reset()
-    obs.configure(max_events=DEFAULT_MAX_EVENTS)
+    obs.configure(max_events=DEFAULT_MAX_EVENTS,
+                  max_spans=spans_mod.DEFAULT_MAX_SPANS)
 
 
 # --------------------------------------------------------------------------
@@ -111,12 +123,16 @@ def test_disabled_records_nothing():
     obs.record_decision("op", "d")
     obs.observe("hist", 0.5)
     obs.gauge("g", 1.0)
+    with obs.span("should.not.exist.either"):
+        pass
     snap = obs.snapshot()
     assert snap["counters"] == []
     assert snap["events"] == []
     assert snap["histograms"] == []
     assert snap["gauges"] == []
     assert snap["enabled"] is False
+    # no span trace events either (only the process-name metadata row)
+    assert all(e["ph"] == "M" for e in obs.trace_events())
 
 
 # --------------------------------------------------------------------------
@@ -177,6 +193,273 @@ def test_report_renders(telemetry):
     text = obs.report(snap)
     assert "overlap_save" in text
     assert "dispatch{backend=xla,op=convolve}" in text
+
+
+# --------------------------------------------------------------------------
+# spans: the time axis
+# --------------------------------------------------------------------------
+
+
+def test_span_feeds_histogram_with_warmup_then_steady(telemetry):
+    for _ in range(3):
+        with obs.span("unit.op", algo="fft"):
+            pass
+    hists = {(h["name"], h["labels"].get("phase")): h
+             for h in obs.snapshot()["histograms"]}
+    assert hists[("span.unit.op", "warmup")]["count"] == 1
+    assert hists[("span.unit.op", "steady")]["count"] == 2
+    # attrs travel into trace args ONLY — never histogram labels
+    for h in hists.values():
+        assert set(h["labels"]) == {"phase"}
+
+
+def test_span_warmup_is_per_attr_class(telemetry):
+    # a NEW route through the same span name compiles its own
+    # executable — it gets its own warmup mark, not a steady mislabel
+    with obs.span("routed.op", route="a"):
+        pass
+    with obs.span("routed.op", route="b"):
+        pass
+    with obs.span("routed.op", route="a"):
+        pass
+    phases = [e["args"]["phase"] for e in obs.trace_events()
+              if e["ph"] == "X"]
+    assert phases == ["warmup", "warmup", "steady"]
+
+
+def test_span_reset_restores_warmup(telemetry):
+    with obs.span("unit.reset"):
+        pass
+    obs.reset()
+    with obs.span("unit.reset"):
+        pass
+    hists = {(h["name"], h["labels"].get("phase")): h["count"]
+             for h in obs.snapshot()["histograms"]}
+    assert hists == {("span.unit.reset", "warmup"): 1}
+
+
+def test_span_nesting_records_parent(telemetry):
+    with obs.span("outer.op"):
+        with obs.span("inner.op"):
+            pass
+    by_name = {e["name"]: e for e in obs.trace_events()
+               if e["ph"] == "X"}
+    assert by_name["inner.op"]["args"]["parent"] == "outer.op"
+    assert "parent" not in by_name["outer.op"]["args"]
+    # the child completes inside the parent's interval
+    outer, inner = by_name["outer.op"], by_name["inner.op"]
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] \
+        + 1e-3
+
+
+def test_span_trace_buffer_bounded(telemetry):
+    obs.configure(max_spans=8)
+    for i in range(20):
+        with obs.span("bounded.op", i=i):
+            pass
+    events = [e for e in obs.trace_events() if e["ph"] == "X"]
+    assert len(events) == 8
+    assert [e["args"]["i"] for e in events] == list(range(12, 20))
+    assert obs.snapshot()["spans_dropped"] == 12
+    # the drop signal reaches both exporters, not just the raw snapshot
+    assert "veles_simd_spans_dropped_total 12" in obs.to_prometheus()
+    assert "spans dropped" in obs.report()
+    obs.configure(max_spans=32768)
+
+
+def test_span_reserved_args_not_clobbered_by_attrs(telemetry):
+    with obs.span("clobber.op", phase="forward", parent="fake"):
+        pass
+    ev = [e for e in obs.trace_events() if e["ph"] == "X"][-1]
+    assert ev["args"]["phase"] == "warmup"       # tag wins over attr
+    assert "parent" not in ev["args"]            # top-level span
+
+
+def test_save_trace_is_perfetto_loadable_structurally(telemetry,
+                                                      tmp_path):
+    with obs.span("a.op", algo="x"):
+        with obs.span("b.op"):
+            pass
+    with obs.span("a.op", algo="x"):
+        pass
+    path = obs.save_trace(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        doc = json.load(f)          # strict JSON
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events
+    xs = [e for e in events if e["ph"] == "X"]
+    assert len(xs) == 3
+    # only complete X events and metadata M events; every X carries
+    # ts/dur/pid/tid and ts is monotonic within the file
+    assert {e["ph"] for e in events} <= {"X", "M"}
+    for e in xs:
+        assert e["dur"] >= 0
+        assert e["pid"] == os.getpid()
+        assert isinstance(e["tid"], int)
+        assert e["args"]["phase"] in ("warmup", "steady")
+    ts = [e["ts"] for e in xs]
+    assert ts == sorted(ts)
+    assert all(t >= 0 for t in ts)
+
+
+def test_span_disabled_overhead_under_5us():
+    obs.disable()
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with obs.span("overhead.probe", algo="fft"):
+            pass
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 5e-6, f"disabled span cost {per_call:.2e}s"
+    # and the disabled path returns one shared object (no allocation)
+    assert obs.span("x") is obs.span("y")
+
+
+def test_span_exception_still_recorded(telemetry):
+    with pytest.raises(RuntimeError):
+        with obs.span("exc.op"):
+            raise RuntimeError("boom")
+    hists = {h["name"] for h in obs.snapshot()["histograms"]}
+    assert "span.exc.op" in hists
+
+
+def test_span_xla_trace_bridge_flag():
+    from veles.simd_tpu.obs import spans as spans_mod
+
+    assert spans_mod.xla_trace_active() is False
+    try:
+        spans_mod.set_xla_trace_active(True)
+        assert spans_mod.xla_trace_active() is True
+        obs.enable()
+        obs.reset()
+        # TraceAnnotation outside a live XLA trace session is a no-op
+        # scope — the span must still complete and record
+        with obs.span("bridged.op"):
+            pass
+        assert any(e["name"] == "bridged.op"
+                   for e in obs.trace_events())
+    finally:
+        spans_mod.set_xla_trace_active(False)
+        obs.disable()
+        obs.reset()
+
+
+def test_wired_dispatch_records_spans(telemetry):
+    x = RNG.randn(4096).astype(np.float32)
+    h = RNG.randn(64).astype(np.float32)
+    cv.convolve(x, h, simd=True)
+    names = {h_["name"] for h_ in obs.snapshot()["histograms"]}
+    assert "span.convolve.dispatch" in names
+    assert "span.convolve.os_route" in names
+    sp.stft(RNG.randn(2048).astype(np.float32), 256, 64, simd=True)
+    names = {h_["name"] for h_ in obs.snapshot()["histograms"]}
+    assert "span.stft.dispatch" in names
+
+
+# --------------------------------------------------------------------------
+# atomic snapshot/trace writes
+# --------------------------------------------------------------------------
+
+
+def test_save_is_atomic_on_serialization_failure(telemetry, tmp_path):
+    path = str(tmp_path / "snap.json")
+    obs.count("keep.me")
+    obs.save(path)
+    good = open(path).read()
+    with pytest.raises(TypeError):
+        obs.save(path, {"unserializable": object()})
+    assert open(path).read() == good       # old snapshot intact
+    assert os.listdir(tmp_path) == ["snap.json"]  # no tmp litter
+
+
+def test_save_trace_leaves_no_tmp_files(telemetry, tmp_path):
+    with obs.span("t.op"):
+        pass
+    obs.save_trace(str(tmp_path / "trace.json"))
+    obs.save_trace(str(tmp_path / "trace.json"))  # overwrite path too
+    assert os.listdir(tmp_path) == ["trace.json"]
+
+
+# --------------------------------------------------------------------------
+# Prometheus exposition correctness
+# --------------------------------------------------------------------------
+
+
+def test_prometheus_label_value_escaping(telemetry):
+    # incl. the order-of-unescape trap: a literal backslash followed
+    # by a literal 'n' must NOT come back as a newline
+    nasty = 'he said "hi"\\path\nnext C:\\nasty'
+    obs.count("escaped", who=nasty)
+    text = obs.to_prometheus()
+    # exposition line stays one physical line
+    line = [ln for ln in text.splitlines()
+            if ln.startswith("veles_simd_escaped_total")]
+    assert len(line) == 1
+    assert r"\"hi\"" in line[0] and r"\n" in line[0]
+    parsed = obs_export.parse_prometheus(text)
+    assert parsed[("veles_simd_escaped_total",
+                   (("who", nasty),))] == 1
+
+
+def test_prometheus_metric_name_sanitization(telemetry):
+    obs.count("span.weird-name 1")
+    obs.gauge("mesh.devices/total", 4)
+    text = obs.to_prometheus()
+    assert "veles_simd_span_weird_name_1_total 1" in text
+    assert "veles_simd_mesh_devices_total 4.0" in text
+    # every emitted sample name is exposition-legal
+    for (name, _labels) in obs_export.parse_prometheus(text):
+        assert re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", name), name
+
+
+def test_prometheus_histogram_wire_format(telemetry):
+    from veles.simd_tpu.obs.registry import DEFAULT_BUCKETS
+
+    samples = [5e-7, 2e-6, 2e-6, 0.5, 100.0]
+    for s in samples:
+        obs.observe("lat", s, op="x")
+    text = obs.to_prometheus()
+    lines = [ln for ln in text.splitlines()
+             if ln.startswith("veles_simd_lat")]
+    # TYPE comment filtered out above; series = buckets + Inf + sum/count
+    bucket_lines = [ln for ln in lines if "_bucket" in ln]
+    assert len(bucket_lines) == len(DEFAULT_BUCKETS) + 1
+    parsed = obs_export.parse_prometheus(text)
+
+    def bucket(le):
+        return parsed[("veles_simd_lat_bucket",
+                       (("le", le), ("op", "x")))]
+
+    # cumulative counts at the interesting boundaries
+    assert bucket(repr(1e-6)) == 1        # the 5e-7 sample
+    assert bucket(repr(3e-6)) == 3        # + two 2e-6 samples
+    assert bucket(repr(1.0)) == 4         # + the 0.5 sample
+    assert bucket("+Inf") == 5            # + the out-of-range 100.0
+    assert parsed[("veles_simd_lat_count", (("op", "x"),))] == 5
+    assert parsed[("veles_simd_lat_sum", (("op", "x"),))] == \
+        pytest.approx(sum(samples))
+    # cumulative monotonicity across the whole bucket ladder
+    les = [repr(b) for b in DEFAULT_BUCKETS] + ["+Inf"]
+    counts = [bucket(le) for le in les]
+    assert counts == sorted(counts)
+
+
+def test_histogram_quantiles_interpolate(telemetry):
+    for _ in range(90):
+        obs.observe("q", 2e-6)            # (1e-6, 3e-6] bucket
+    for _ in range(10):
+        obs.observe("q", 2e-3)            # (1e-3, 3e-3] bucket
+    h = [h_ for h_ in obs.snapshot()["histograms"]
+         if h_["name"] == "q"][0]
+    qs = obs_export.histogram_quantiles(h)
+    assert 1e-6 <= qs["p50"] <= 3e-6
+    assert 1e-3 <= qs["p99"] <= 3e-3
+    # p95 sits exactly at the bucket boundary rank: 95th of 100 lands
+    # mid-ladder, still inside the second bucket's bounds
+    assert 1e-6 <= qs["p95"] <= 3e-3
+    assert obs_export.histogram_quantile({"count": 0, "buckets": {}},
+                                         0.5) is None
 
 
 # --------------------------------------------------------------------------
@@ -310,6 +593,10 @@ def test_jaxpr_identical_with_telemetry_on_and_off(build):
     try:
         jaxpr_on = build()
         assert obs.events(), "telemetry was on but recorded nothing"
+        # spans fired at the dispatch layer during tracing — and still
+        # left the jaxpr untouched (asserted below)
+        assert any(e["ph"] == "X" for e in obs.trace_events()), \
+            "telemetry was on but no span completed"
     finally:
         obs.disable()
         obs.reset()

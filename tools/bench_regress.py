@@ -1,0 +1,261 @@
+#!/usr/bin/env python
+"""Bench-regression gate: fold runs into BENCH_HISTORY.jsonl and fail
+on a headline/suite slowdown.
+
+The bench trajectory used to be write-only — ``bench.py`` emitted
+``BENCH_DETAILS.json`` per run and nothing ever looked back, so a PR
+that regressed the 1M-convolve headline was only caught by a human
+rereading numbers.  This tool closes the loop:
+
+1. **Fold**: read the newest run's rows (metric, value, unit,
+   vs_baseline) from ``BENCH_DETAILS.json`` and append them as exactly
+   ONE JSONL record to the append-only ``BENCH_HISTORY.jsonl``.  A run
+   that fails the gate is still recorded (the trajectory must show the
+   regression, not pretend the run never happened) but its regressed
+   rows are marked and **excluded from future baselines** — re-running
+   a red gate can never launder a regression into the new normal; only
+   a row that passes rejoins the median.
+2. **Compare**: for every row, form a trailing baseline — the median of
+   that metric's values over the previous ``--window`` records that
+   contain it — and flag a regression when the new value falls below
+   ``baseline * (1 - threshold)``.  All rows here are throughput
+   (higher is better).  The threshold is per-row: ``--noise
+   METRIC_SUBSTRING=FRAC`` overrides the ``--threshold`` default for
+   rows whose metric name contains the substring (device-time rows are
+   noisier than host-time rows; the headline deserves a tighter gate
+   than the smoke-sized configs).
+3. **Gate**: exit 0 when every row is within noise or improved (or has
+   no baseline yet), 1 when any row regressed, 2 when there was
+   nothing to compare (missing/empty details file).  ``make
+   bench-regress`` wires this as the CI gate after ``make bench``.
+
+Rows whose value is null (bench flagged an unresolved measurement) are
+reported but never counted as regressions — a wedged relay is
+``bench.py``'s rc=2 story, not a performance signal.
+
+Usage:  python tools/bench_regress.py
+        python tools/bench_regress.py --details BENCH_DETAILS.json \\
+            --history BENCH_HISTORY.jsonl --window 5 --threshold 0.10 \\
+            --noise "convolve 1M=0.08" --noise "elementwise=0.25"
+        python tools/bench_regress.py --no-append   # compare only
+        make bench-regress
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+DEFAULT_DETAILS = "BENCH_DETAILS.json"
+DEFAULT_HISTORY = "BENCH_HISTORY.jsonl"
+DEFAULT_WINDOW = 5
+DEFAULT_THRESHOLD = 0.10
+
+
+def load_rows(details_path: str) -> list:
+    """The comparable rows of one bench run: every BENCH_DETAILS.json
+    entry with a ``metric`` key (the tail ``skipped_stages`` entry and
+    other non-row records are ignored)."""
+    with open(details_path) as f:
+        entries = json.load(f)
+    if not isinstance(entries, list):
+        raise ValueError(f"{details_path}: expected a list of configs")
+    return [e for e in entries if isinstance(e, dict) and "metric" in e]
+
+
+def rows_to_record(rows: list, source: str,
+                   regressed: list = ()) -> dict:
+    """One append-only history record for this run.  ``regressed``
+    names the rows that failed the gate this run — recorded for the
+    trajectory, skipped by :func:`trailing_baseline` so a red run
+    cannot drag the future baseline down."""
+    return {
+        "ts": time.time(),
+        "source": source,
+        "device": next((r.get("device") for r in rows
+                        if r.get("device")), None),
+        "regressed": sorted(regressed),
+        "rows": {
+            r["metric"]: {
+                "value": r.get("value"),
+                "unit": r.get("unit"),
+                "vs_baseline": r.get("vs_baseline"),
+            } for r in rows
+        },
+    }
+
+
+def read_history(history_path: str) -> list:
+    """All prior records, oldest first.  Unparseable lines (a crashed
+    writer predating atomic appends, manual edits) are skipped with a
+    warning rather than poisoning the gate forever."""
+    records = []
+    if not os.path.exists(history_path):
+        return records
+    with open(history_path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                print(f"bench_regress: {history_path}:{lineno}: "
+                      f"skipping unparseable record", file=sys.stderr)
+    return records
+
+
+def append_history(history_path: str, record: dict) -> None:
+    """Append exactly one JSONL record (single write + flush; JSONL
+    appends are atomic at sane record sizes, and a torn tail line is
+    skipped by :func:`read_history`)."""
+    with open(history_path, "a") as f:
+        f.write(json.dumps(record, allow_nan=False) + "\n")
+
+
+def trailing_baseline(history: list, metric: str, window: int):
+    """Median of the metric's values over the newest ``window`` prior
+    records that measured it (None values, absent rows, and rows that
+    were REGRESSED when recorded are skipped — a red run never becomes
+    baseline).  Returns (baseline, n_samples); baseline None when
+    unmeasured."""
+    values = []
+    for rec in reversed(history):
+        if metric in rec.get("regressed", ()):
+            continue
+        row = rec.get("rows", {}).get(metric)
+        if row and isinstance(row.get("value"), (int, float)):
+            values.append(float(row["value"]))
+            if len(values) == window:
+                break
+    if not values:
+        return None, 0
+    return statistics.median(values), len(values)
+
+
+def row_threshold(metric: str, default: float, overrides: list) -> float:
+    """Per-row noise threshold: the last ``--noise substring=frac``
+    whose substring appears in the metric name wins; the global
+    ``--threshold`` otherwise."""
+    thr = default
+    for substr, frac in overrides:
+        if substr in metric:
+            thr = frac
+    return thr
+
+
+def compare(rows: list, history: list, window: int, default_thr: float,
+            overrides: list) -> tuple:
+    """Judge every row against its trailing baseline.
+
+    Returns ``(regressions, report_lines)`` where ``regressions`` is
+    the list of regressed metric names."""
+    regressions = []
+    lines = []
+    for r in rows:
+        metric = r["metric"]
+        value = r.get("value")
+        unit = r.get("unit", "")
+        baseline, n = trailing_baseline(history, metric, window)
+        thr = row_threshold(metric, default_thr, overrides)
+        if value is None:
+            verdict = "UNRESOLVED (null value; not gated)"
+        elif baseline is None:
+            verdict = "no baseline yet"
+        else:
+            delta = (value - baseline) / baseline
+            floor = baseline * (1.0 - thr)
+            if value < floor:
+                verdict = (f"REGRESSION {delta:+.1%} vs median of "
+                           f"{n} (threshold -{thr:.0%})")
+                regressions.append(metric)
+            elif delta > thr:
+                verdict = f"improved {delta:+.1%} vs median of {n}"
+            else:
+                verdict = (f"within noise {delta:+.1%} "
+                           f"(threshold -{thr:.0%})")
+        val_s = "null" if value is None else f"{value:.1f}"
+        base_s = "-" if baseline is None else f"{baseline:.1f}"
+        lines.append(f"  {metric:40s} {val_s:>10s} {unit:11s} "
+                     f"baseline {base_s:>10s}  {verdict}")
+    return regressions, lines
+
+
+def parse_noise(spec: str) -> tuple:
+    if "=" not in spec:
+        raise argparse.ArgumentTypeError(
+            f"--noise wants METRIC_SUBSTRING=FRACTION, got {spec!r}")
+    substr, _, frac = spec.rpartition("=")
+    try:
+        frac_f = float(frac)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"--noise fraction {frac!r} is not a number")
+    if not 0 <= frac_f < 1:
+        raise argparse.ArgumentTypeError(
+            f"--noise fraction {frac_f} must be in [0, 1)")
+    return substr, frac_f
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Gate on bench regressions vs BENCH_HISTORY.jsonl")
+    ap.add_argument("--details", default=DEFAULT_DETAILS,
+                    help="bench.py output to fold in (default: "
+                         f"{DEFAULT_DETAILS})")
+    ap.add_argument("--history", default=DEFAULT_HISTORY,
+                    help="append-only JSONL trajectory (default: "
+                         f"{DEFAULT_HISTORY})")
+    ap.add_argument("--window", type=int, default=DEFAULT_WINDOW,
+                    help="trailing records forming the baseline median "
+                         f"(default: {DEFAULT_WINDOW})")
+    ap.add_argument("--threshold", type=float,
+                    default=DEFAULT_THRESHOLD,
+                    help="default per-row noise fraction (default: "
+                         f"{DEFAULT_THRESHOLD})")
+    ap.add_argument("--noise", action="append", default=[],
+                    type=parse_noise, metavar="SUBSTRING=FRAC",
+                    help="per-row threshold override (repeatable; "
+                         "last matching substring wins)")
+    ap.add_argument("--no-append", action="store_true",
+                    help="compare only; do not record this run")
+    args = ap.parse_args(argv)
+
+    try:
+        rows = load_rows(args.details)
+    except (OSError, ValueError) as e:
+        print(f"bench_regress: cannot read run rows: {e}",
+              file=sys.stderr)
+        return 2
+    if not rows:
+        print(f"bench_regress: {args.details} holds no metric rows "
+              "(bench captured nothing)", file=sys.stderr)
+        return 2
+
+    history = read_history(args.history)
+    regressions, lines = compare(rows, history, args.window,
+                                 args.threshold, args.noise)
+    if not args.no_append:
+        append_history(args.history,
+                       rows_to_record(rows, args.details,
+                                      regressed=regressions))
+
+    print(f"bench_regress: {len(rows)} rows vs {len(history)} prior "
+          f"records in {args.history}"
+          + (" (not recorded)" if args.no_append else ""))
+    for line in lines:
+        print(line)
+    if regressions:
+        print(f"bench_regress: REGRESSION in {len(regressions)} "
+              f"row(s): {', '.join(regressions)}", file=sys.stderr)
+        return 1
+    print("bench_regress: no regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,13 +8,20 @@ hermetic environments without it, falls back to a dependency-free pass:
 over-long lines, and trailing whitespace.  Exit status is the gate, like
 the reference's ``make lint``.
 
-One project-specific rule always runs (ruff or not): compute modules
-(``veles/simd_tpu/ops/``, ``veles/simd_tpu/parallel/``) may touch the
-telemetry layer ONLY through the approved Python-dispatch helpers
-``obs.record_decision`` / ``obs.count`` — never registry internals, and
-never anything that could smuggle instrumentation into traced/jitted
-code (the obs package's contract is that jaxprs are byte-identical with
-telemetry on or off).
+Two project-specific rules always run (ruff or not):
+
+* compute modules (``veles/simd_tpu/ops/``, ``veles/simd_tpu/parallel/``)
+  may touch the telemetry layer ONLY through the approved
+  Python-dispatch helpers ``obs.record_decision`` / ``obs.count`` /
+  ``obs.span`` — never registry internals, and never anything that
+  could smuggle instrumentation into traced/jitted code (the obs
+  package's contract is that jaxprs are byte-identical with telemetry
+  on or off);
+* the same modules must not hand-roll wall-clock timing
+  (``time.time()`` / ``time.monotonic()`` / ``time.perf_counter()``):
+  dispatch latency belongs to ``obs.span`` (histograms + Chrome trace)
+  and measurement belongs to ``utils/benchmark.py`` (which is outside
+  the policed directories and keeps its ``perf_counter`` loops).
 
 Run:  python tools/lint.py [paths...]
 """
@@ -114,17 +121,28 @@ def fallback_lint(files) -> int:
 
 # --- telemetry-usage rule (always on, ruff can't express it) ---------------
 
-# the only obs entry points compute modules may call — both are pure
+# the only obs entry points compute modules may call — all pure
 # Python-dispatch helpers that cannot appear in a traced program
-_OBS_APPROVED = {"record_decision", "count"}
+# (span's context manager issues no jax ops)
+_OBS_APPROVED = {"record_decision", "count", "span"}
 _OBS_PKG = "veles.simd_tpu.obs"
 # directories holding traced compute code the rule polices
 _OBS_RULE_DIRS = ("veles/simd_tpu/ops", "veles/simd_tpu/parallel")
 
 
-def obs_usage_lint(files) -> int:
-    """Flag ops/parallel modules reaching past the approved telemetry
-    helpers (keeps instrumentation out of traced code)."""
+# wall-clock reads compute modules must not hand-roll: dispatch latency
+# is obs.span's job (histograms + trace events, warmup/steady tagging),
+# and benchmarking lives in utils/benchmark.py — which sits outside
+# _OBS_RULE_DIRS, so this rule never fires on it
+_TIME_FORBIDDEN = {"time", "monotonic", "perf_counter",
+                   "perf_counter_ns", "monotonic_ns"}
+
+
+def compute_module_lint(files) -> int:
+    """The ops/parallel project rules, one parse per file: telemetry
+    only through the approved helpers (keeps instrumentation out of
+    traced code), and no hand-rolled wall-clock timing (use
+    ``obs.span``; ``utils/benchmark.py`` owns measurement)."""
     failures = 0
     for f in files:
         try:
@@ -142,6 +160,7 @@ def obs_usage_lint(files) -> int:
             failures += 1
             continue
         aliases = set()
+        time_aliases = set()
         for node in ast.walk(tree):
             if isinstance(node, ast.Import):
                 for a in node.names:
@@ -151,6 +170,10 @@ def obs_usage_lint(files) -> int:
                               f"'from veles.simd_tpu import obs', not "
                               f"'import {a.name}'")
                         failures += 1
+                    elif a.name == "time":
+                        # track the bound name so 'import time as _t'
+                        # cannot dodge the wall-clock rule below
+                        time_aliases.add(a.asname or "time")
             elif isinstance(node, ast.ImportFrom):
                 if node.module == "veles.simd_tpu":
                     for a in node.names:
@@ -166,25 +189,43 @@ def obs_usage_lint(files) -> int:
                     failures += 1
         for node in ast.walk(tree):
             if (isinstance(node, ast.Attribute)
-                    and isinstance(node.value, ast.Name)
-                    and node.value.id in aliases
-                    and node.attr not in _OBS_APPROVED):
-                print(f"{f}:{node.lineno}: obs.{node.attr} is not an "
-                      f"approved telemetry helper for compute modules "
-                      f"(allowed: {', '.join(sorted(_OBS_APPROVED))})")
-                failures += 1
+                    and isinstance(node.value, ast.Name)):
+                if (node.value.id in aliases
+                        and node.attr not in _OBS_APPROVED):
+                    print(f"{f}:{node.lineno}: obs.{node.attr} is not "
+                          f"an approved telemetry helper for compute "
+                          f"modules (allowed: "
+                          f"{', '.join(sorted(_OBS_APPROVED))})")
+                    failures += 1
+                elif (node.value.id in time_aliases
+                        and node.attr in _TIME_FORBIDDEN):
+                    print(f"{f}:{node.lineno}: raw "
+                          f"{node.value.id}.{node.attr} in a compute "
+                          f"module — use obs.span for dispatch "
+                          f"latency (utils/benchmark.py owns "
+                          f"measurement)")
+                    failures += 1
+            elif (isinstance(node, ast.ImportFrom)
+                    and node.module == "time"):
+                names = [a.name for a in node.names
+                         if a.name in _TIME_FORBIDDEN]
+                if names:
+                    print(f"{f}:{node.lineno}: importing "
+                          f"{', '.join(names)} from time in a compute "
+                          f"module — use obs.span for dispatch latency")
+                    failures += 1
     return 1 if failures else 0
 
 
 def main():
     files = sorted(set(python_sources(sys.argv[1:])))
-    obs_rc = obs_usage_lint(files)
+    project_rc = compute_module_lint(files)
     rc = try_ruff(files)
     if rc is None:
         print(f"lint: ruff unavailable, dependency-free fallback over "
               f"{len(files)} files")
         rc = fallback_lint(files)
-    sys.exit(rc or obs_rc)
+    sys.exit(rc or project_rc)
 
 
 if __name__ == "__main__":

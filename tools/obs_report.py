@@ -4,9 +4,12 @@
 Reads a JSON snapshot — either one written by ``obs.save(path)`` or a
 ``BENCH_DETAILS.json`` produced by ``bench.py`` (whose entries embed a
 compact per-config telemetry dict) — and renders the human table the
-live ``obs.report()`` call would print.  ``--prometheus`` converts a
-full snapshot to the Prometheus text exposition format instead, so a
-file captured on a TPU host can be pushed through a gateway later.
+live ``obs.report()`` call would print, followed by a dispatch-latency
+section: per-op p50/p95/p99 from the ``span.*`` histograms, warmup
+(first call, incl. trace+compile) separated from steady-state.
+``--prometheus`` converts a full snapshot to the Prometheus text
+exposition format instead, so a file captured on a TPU host can be
+pushed through a gateway later.
 
 Usage:  python tools/obs_report.py SNAPSHOT.json
         python tools/obs_report.py --prometheus SNAPSHOT.json
@@ -26,10 +29,43 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 from veles.simd_tpu.obs import export  # noqa: E402
 
 
+def _fmt_s(v) -> str:
+    return "-" if v is None else "%.1e" % v
+
+
+def _render_span_summary(spans, indent="  ") -> list:
+    """Lines for a bench-style span summary dict
+    (``{name: {phase: {count, total_s, p50_s, p95_s, p99_s}}}``)."""
+    lines = []
+    for name in sorted(spans):
+        for phase in sorted(spans[name]):
+            s = spans[name][phase]
+            lines.append(
+                "%s%-32s %-7s n=%-6d p50=%s p95=%s p99=%s total=%s"
+                % (indent, name, phase, s.get("count", 0),
+                   _fmt_s(s.get("p50_s")), _fmt_s(s.get("p95_s")),
+                   _fmt_s(s.get("p99_s")), _fmt_s(s.get("total_s"))))
+    return lines
+
+
+def _latency_section(snap) -> str:
+    """Per-op host-dispatch latency from a full snapshot's ``span.*``
+    histograms: p50/p95/p99 seconds, warmup vs. steady-state."""
+    spans = export.span_summary(snap)
+    if not spans:
+        return ""
+    lines = ["", "dispatch latency (seconds; warmup = first call, "
+             "incl. trace+compile):"]
+    lines += _render_span_summary(spans)
+    return "\n".join(lines) + "\n"
+
+
 def _render_bench_details(entries) -> str:
     """BENCH_DETAILS.json mode: one telemetry block per bench config."""
     lines = []
     for e in entries:
+        if "metric" not in e and "telemetry" not in e:
+            continue        # tail entry (skipped_stages bookkeeping)
         tel = e.get("telemetry")
         lines.append("=== %s ===" % e.get("metric", "(unnamed config)"))
         if tel is None:
@@ -48,6 +84,10 @@ def _render_bench_details(entries) -> str:
                 if k not in ("seq", "op", "decision"))
             lines.append("  decision: %-24s -> %-18s %s"
                          % (d.get("op"), d.get("decision"), extras))
+        spans = tel.get("spans") or {}
+        if spans:
+            lines.append("  dispatch latency (s):")
+            lines += _render_span_summary(spans, indent="    ")
     return "\n".join(lines) + "\n"
 
 
@@ -72,6 +112,7 @@ def main(argv=None) -> int:
         sys.stdout.write(export.to_prometheus(data))
         return 0
     sys.stdout.write(export.report(data, max_events=50))
+    sys.stdout.write(_latency_section(data))
     return 0
 
 

@@ -15,15 +15,25 @@ is the accounting layer for every dispatch-time decision:
 * **compile tracking** — :mod:`~veles.simd_tpu.obs.compile` bridges
   ``jax.monitoring`` into the registry, so backend compiles and
   persistent-cache hit/miss traffic finally show up in numbers;
+* **host-side spans — the time axis** —
+  :mod:`~veles.simd_tpu.obs.spans`: nested, thread-local
+  ``obs.span("convolve.dispatch", algo=...)`` scopes that feed the
+  registry's latency histograms (warmup vs. steady-state tagged
+  separately), bridge to ``jax.profiler.TraceAnnotation`` while an XLA
+  trace is active, and export as Perfetto-loadable Chrome trace-event
+  JSON via :func:`save_trace`;
 * **exporters** — :mod:`~veles.simd_tpu.obs.export`: lossless JSON
-  snapshot, Prometheus text format, and a human ``report()`` table.
+  snapshot, Prometheus text format (histograms as proper
+  ``_bucket``/``_sum``/``_count`` series), and a human ``report()``
+  table with p50/p95/p99 latency columns.
 
 Contract with the compute layer (enforced by ``tools/lint.py``):
 
-* ops modules touch telemetry ONLY through :func:`record_decision` and
-  :func:`count`, and ONLY at the Python dispatch layer — never inside
-  traced/jitted code.  Telemetry on or off, jaxprs and compiled
-  artifacts are byte-identical (``tests/test_obs.py`` pins this).
+* ops modules touch telemetry ONLY through :func:`record_decision`,
+  :func:`count`, and :func:`span`, and ONLY at the Python dispatch
+  layer — never inside traced/jitted code.  Telemetry on or off,
+  jaxprs and compiled artifacts are byte-identical
+  (``tests/test_obs.py`` pins this).
 * Off by default.  Enable with ``VELES_SIMD_TELEMETRY=1`` in the
   environment or :func:`enable` at runtime; when disabled every helper
   is a single attribute check, and when enabled the cost is one locked
@@ -34,37 +44,46 @@ Usage::
     from veles.simd_tpu import obs
     obs.enable()
     convolve(x, h)                      # decisions recorded as they run
-    print(obs.report())                 # human table
+    print(obs.report())                 # human table, p50/p95/p99
     obs.save("telemetry.json")          # snapshot for tools/obs_report.py
+    obs.save_trace("trace.json")        # open in Perfetto
     text = obs.to_prometheus()          # scrape endpoint body
 
-Scope note: this module answers *what was decided and how often*;
-:mod:`veles.simd_tpu.utils.profiler` (XLA traces) answers *where the
-time goes* inside a step.  They are deliberately separate layers.
+Scope note: this module answers *what was decided, how often, and how
+long the host-side dispatch took*; :mod:`veles.simd_tpu.utils.profiler`
+(XLA traces) answers *where the device time goes* inside a step.  The
+two meet at :func:`span`'s TraceAnnotation bridge, but they remain
+separate layers.
 """
 
 from __future__ import annotations
 
+import itertools
 import os
+import threading
 
 from veles.simd_tpu.obs import compile as _compile
 from veles.simd_tpu.obs import export as _export
+from veles.simd_tpu.obs import spans as _spans_mod
 from veles.simd_tpu.obs.events import EventLog
 from veles.simd_tpu.obs.registry import MetricsRegistry
+from veles.simd_tpu.obs.spans import SpanTracer
 
 __all__ = [
     "enable", "disable", "enabled", "configure",
-    "count", "gauge", "observe", "record_decision",
+    "count", "gauge", "observe", "record_decision", "span",
     "counter_value", "events", "snapshot", "reset",
     "to_json", "to_prometheus", "report", "save", "load",
+    "save_trace", "trace_events",
     "install_compile_listeners",
-    "MetricsRegistry", "EventLog",
+    "MetricsRegistry", "EventLog", "SpanTracer",
 ]
 
 _TRUTHY = ("1", "true", "yes", "on")
 
 _registry = MetricsRegistry()
 _events = EventLog()
+_spans = SpanTracer(_registry.observe)
 _enabled = os.environ.get("VELES_SIMD_TELEMETRY",
                           "0").strip().lower() in _TRUTHY
 if _enabled:
@@ -106,13 +125,17 @@ def disable() -> None:
     _enabled = False
 
 
-def configure(max_events: int | None = None) -> None:
+def configure(max_events: int | None = None,
+              max_spans: int | None = None) -> None:
     """Adjust telemetry limits.  ``max_events`` replaces the decision
     log with a fresh bound (history is cleared — resizing a ring buffer
-    in place would silently reorder it)."""
-    global _events
+    in place would silently reorder it); ``max_spans`` does the same
+    for the span trace buffer."""
+    global _events, _spans
     if max_events is not None:
         _events = EventLog(max_events)
+    if max_spans is not None:
+        _spans = SpanTracer(_registry.observe, max_spans)
 
 
 def install_compile_listeners() -> bool:
@@ -144,6 +167,26 @@ def observe(name: str, value: float, **labels) -> None:
     _registry.observe(name, value, **labels)
 
 
+def span(name: str, **attrs):
+    """Time a host-side dispatch scope (context manager).
+
+    While telemetry is off this returns a shared no-op context manager
+    after a single flag check — the advertised disabled cost.  While
+    on, the completed span feeds the ``span.<name>`` latency histogram
+    (first completion per (name, attrs) class tagged
+    ``phase="warmup"`` — where tracing and compiles land — the rest
+    ``"steady"``), lands in the Chrome-trace buffer behind
+    :func:`save_trace`, and bridges to
+    ``jax.profiler.TraceAnnotation`` while an XLA trace is active.
+    ``attrs`` (JSON-native scalars) travel only into the trace event's
+    ``args`` — never into histogram labels.  Spans nest; use them at
+    the Python dispatch layer only, never inside traced/jitted code.
+    """
+    if not _enabled:
+        return _spans_mod.NULL_SPAN
+    return _spans.span(name, **attrs)
+
+
 def record_decision(op: str, decision: str, **fields) -> None:
     """Log one dispatch decision (no-op while disabled).
 
@@ -172,19 +215,24 @@ def events() -> list:
 
 
 def snapshot() -> dict:
-    """One JSON-native dict of everything: counters, gauges, histograms,
-    events, drop count, and the enabled flag."""
+    """One JSON-native dict of everything: counters, gauges, histograms
+    (including the ``span.*`` latency distributions), events, drop
+    counts, and the enabled flag.  The span *trace* (per-span start/
+    duration records) is exported separately by :func:`save_trace`."""
     snap = _registry.snapshot()
     snap["events"] = _events.events()
     snap["events_dropped"] = _events.dropped
+    snap["spans_dropped"] = _spans.dropped
     snap["enabled"] = _enabled
     return snap
 
 
 def reset() -> None:
-    """Clear all metrics and events; the enabled flag is untouched."""
+    """Clear all metrics, events, and spans; the enabled flag is
+    untouched."""
     _registry.reset()
     _events.reset()
+    _spans.reset()
 
 
 def to_json(snap: dict | None = None, indent: int | None = 2) -> str:
@@ -200,12 +248,52 @@ def report(snap: dict | None = None, max_events: int = 20) -> str:
                           max_events)
 
 
-def save(path: str, snap: dict | None = None) -> str:
-    """Write a JSON snapshot to ``path`` (read back with :func:`load`
-    or pretty-printed by ``tools/obs_report.py``); returns ``path``."""
-    with open(path, "w") as f:
-        f.write(to_json(snap))
+_TMP_SEQ = itertools.count()
+
+
+def _atomic_write(path: str, text: str) -> str:
+    """Write-temp-then-``os.replace`` so a crash mid-write (a wedged
+    bench run, an OOM-killed server) can never leave a truncated file
+    where ``tools/obs_report.py`` expects a snapshot.  The temp name is
+    unique per write (pid + thread + sequence), so concurrent saves to
+    the same path from different threads cannot collide on — or unlink
+    — each other's temp file; last ``os.replace`` wins."""
+    tmp = "%s.%d.%d.%d.tmp" % (path, os.getpid(),
+                               threading.get_ident(), next(_TMP_SEQ))
+    try:
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):  # serialization failed mid-write
+            os.unlink(tmp)
     return path
+
+
+def save(path: str, snap: dict | None = None) -> str:
+    """Atomically write a JSON snapshot to ``path`` (read back with
+    :func:`load` or pretty-printed by ``tools/obs_report.py``);
+    returns ``path``."""
+    return _atomic_write(path, to_json(snap if snap is not None
+                                       else snapshot()))
+
+
+def save_trace(path: str) -> str:
+    """Atomically write the retained spans as Chrome trace-event JSON.
+
+    The file loads directly in Perfetto (https://ui.perfetto.dev) or
+    ``chrome://tracing``: one complete ("X") event per span, per-thread
+    tracks, warmup/steady phase and the span's attributes under
+    ``args``.  Returns ``path``."""
+    return _atomic_write(
+        path, _export.to_json(_spans.to_chrome_trace(), indent=None))
+
+
+def trace_events() -> list:
+    """The retained spans as Chrome trace events (the ``traceEvents``
+    list :func:`save_trace` writes) — for tests and in-process
+    consumers."""
+    return _spans.to_chrome_trace()["traceEvents"]
 
 
 def load(path: str) -> dict:

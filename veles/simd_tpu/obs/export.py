@@ -20,11 +20,13 @@ import json
 import re
 
 __all__ = ["to_json", "from_json", "to_prometheus", "parse_prometheus",
-           "report", "flatten_counters", "PROMETHEUS_PREFIX"]
+           "report", "flatten_counters", "histogram_quantile",
+           "histogram_quantiles", "span_summary", "PROMETHEUS_PREFIX"]
 
 PROMETHEUS_PREFIX = "veles_simd_"
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+_UNESCAPE_RE = re.compile(r"\\(.)")
 _LINE_RE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
     r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$")
@@ -35,12 +37,19 @@ def _prom_name(name: str) -> str:
     return PROMETHEUS_PREFIX + _NAME_RE.sub("_", name)
 
 
+def _escape_label_value(v) -> str:
+    """Prometheus exposition escaping for label values: backslash
+    FIRST (or the other escapes' backslashes double-escape), then
+    quote and newline."""
+    return (str(v).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
 def _prom_labels(labels: dict) -> str:
     if not labels:
         return ""
     inner = ",".join(
-        '%s="%s"' % (_NAME_RE.sub("_", k),
-                     str(v).replace("\\", r"\\").replace('"', r"\""))
+        '%s="%s"' % (_NAME_RE.sub("_", k), _escape_label_value(v))
         for k, v in sorted(labels.items()))
     return "{" + inner + "}"
 
@@ -85,11 +94,12 @@ def to_prometheus(snapshot: dict) -> str:
                                       repr(float(h["sum"]))))
         lines.append("%s_count%s %d" % (name, _prom_labels(h["labels"]),
                                         h["count"]))
-    de = snapshot.get("events_dropped")
-    if de is not None:
-        name = _prom_name("events_dropped") + "_total"
-        lines.append("# TYPE %s counter" % name)
-        lines.append("%s %d" % (name, de))
+    for drop_key in ("events_dropped", "spans_dropped"):
+        dv = snapshot.get(drop_key)
+        if dv is not None:
+            name = _prom_name(drop_key) + "_total"
+            lines.append("# TYPE %s counter" % name)
+            lines.append("%s %d" % (name, dv))
     return "\n".join(lines) + "\n"
 
 
@@ -107,10 +117,68 @@ def parse_prometheus(text: str) -> dict:
         m = _LINE_RE.match(line)
         if not m:
             raise ValueError("unparseable exposition line: %r" % line)
+        # single left-to-right pass: chained str.replace would misread
+        # the tail of an escaped backslash followed by 'n' as a newline
         labels = tuple(
-            (k, v.replace(r"\"", '"').replace(r"\\", "\\"))
+            (k, _UNESCAPE_RE.sub(
+                lambda esc: "\n" if esc.group(1) == "n"
+                else esc.group(1), v))
             for k, v in _LABEL_RE.findall(m.group("labels") or ""))
         out[(m.group("name"), labels)] = float(m.group("value"))
+    return out
+
+
+def histogram_quantile(hist: dict, q: float) -> float | None:
+    """Estimate the ``q``-quantile (0..1) of one snapshot histogram.
+
+    Prometheus ``histogram_quantile`` semantics: find the bucket the
+    target rank falls in and interpolate linearly between its bounds
+    (the lower bound of the first bucket is 0).  A rank landing in the
+    ``+Inf`` bucket returns the highest finite bound — the honest
+    answer for a fixed-bucket histogram.  Returns None for an empty
+    histogram.
+    """
+    total = hist.get("count", 0)
+    if not total:
+        return None
+    target = q * total
+    cum = 0
+    prev_le = 0.0
+    for le_str, cnt in hist["buckets"].items():
+        finite = le_str != "+Inf"
+        le = float(le_str) if finite else float("inf")
+        if cum + cnt >= target and cnt:
+            if not finite:
+                return prev_le
+            return prev_le + (le - prev_le) * (target - cum) / cnt
+        cum += cnt
+        if finite:
+            prev_le = le
+    return prev_le
+
+
+def histogram_quantiles(hist: dict, qs=(0.5, 0.95, 0.99)) -> dict:
+    """``{"p50": ..., "p95": ..., "p99": ...}`` for one snapshot
+    histogram (None values for an empty one)."""
+    return {"p%g" % (q * 100): histogram_quantile(hist, q) for q in qs}
+
+
+def span_summary(snapshot: dict) -> dict:
+    """Latency summary of the ``span.*`` histograms in a snapshot:
+    ``{name: {phase: {count, total_s, p50_s, p95_s, p99_s}}}`` — the
+    shared shape ``bench.py`` embeds per config and
+    ``tools/obs_report.py`` renders as its latency section."""
+    out = {}
+    for h in snapshot.get("histograms", []):
+        if not h["name"].startswith("span."):
+            continue
+        qs = histogram_quantiles(h)
+        phase = h["labels"].get("phase", "all")
+        out.setdefault(h["name"][len("span."):], {})[phase] = {
+            "count": h["count"], "total_s": h["sum"],
+            "p50_s": qs["p50"], "p95_s": qs["p95"],
+            "p99_s": qs["p99"],
+        }
     return out
 
 
@@ -146,14 +214,23 @@ def report(snapshot: dict, max_events: int = 20) -> str:
             lines.append("  %s%s = %g" % (
                 g["name"],
                 _prom_labels(g["labels"]).replace('"', ""), g["value"]))
+    if snapshot.get("spans_dropped"):
+        lines.append("")
+        lines.append("spans dropped (trace ring overflow): %d"
+                     % snapshot["spans_dropped"])
     if snapshot.get("histograms"):
         lines.append("")
         lines.append("histograms (seconds):")
         for h in snapshot["histograms"]:
             mean = h["sum"] / h["count"] if h["count"] else 0.0
-            lines.append("  %-40s n=%-8d mean=%.3e" % (
-                h["name"] + _prom_labels(h["labels"]).replace('"', ""),
-                h["count"], mean))
+            qs = histogram_quantiles(h)
+            lines.append(
+                "  %-40s n=%-8d mean=%.3e p50=%.1e p95=%.1e "
+                "p99=%.1e" % (
+                    h["name"]
+                    + _prom_labels(h["labels"]).replace('"', ""),
+                    h["count"], mean, qs["p50"] or 0.0,
+                    qs["p95"] or 0.0, qs["p99"] or 0.0))
     events = snapshot.get("events", [])
     if events:
         lines.append("")

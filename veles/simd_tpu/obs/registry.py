@@ -20,8 +20,13 @@ import threading
 
 __all__ = ["MetricsRegistry", "DEFAULT_BUCKETS", "labels_key"]
 
-# log-spaced seconds: 1us dispatch .. 30s+ remote-relay compiles
-DEFAULT_BUCKETS = (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0, 30.0)
+# log-spaced seconds (half-decade steps): 1us dispatch .. 30s+
+# remote-relay compiles.  Half-decade resolution keeps the p50/p95/p99
+# estimates the exporters interpolate out of these buckets within ~3x
+# of the true quantile — decade-wide buckets were too coarse for the
+# microsecond dispatch spans that dominate this library's histograms.
+DEFAULT_BUCKETS = (1e-6, 3e-6, 1e-5, 3e-5, 1e-4, 3e-4, 1e-3, 3e-3,
+                   1e-2, 3e-2, 1e-1, 3e-1, 1.0, 3.0, 10.0, 30.0)
 
 
 def labels_key(labels: dict) -> tuple:

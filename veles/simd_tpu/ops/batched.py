@@ -205,9 +205,10 @@ def batched_resample_poly(x, up: int, down: int, taps=None, simd=None,
 
         return jax.jit(run, donate_argnums=donation)
 
-    handle = _get_handle(key, build)
-    x2d = jnp.asarray(x, jnp.float32).reshape(rows, n)
-    out = handle(x2d, jnp.asarray(taps, jnp.float32))
+    with obs.span("batched.resample_poly.dispatch"):
+        handle = _get_handle(key, build)
+        x2d = jnp.asarray(x, jnp.float32).reshape(rows, n)
+        out = handle(x2d, jnp.asarray(taps, jnp.float32))
     return out.reshape(batch_shape + (out_len,))
 
 
@@ -248,8 +249,9 @@ def batched_sosfilt(sos, x, simd=None, donate: bool = False):
 
         return jax.jit(run, donate_argnums=donation)
 
-    handle = _get_handle(key, build)
-    out = handle(jnp.asarray(x, jnp.float32).reshape(rows, n))
+    with obs.span("batched.sosfilt.dispatch"):
+        handle = _get_handle(key, build)
+        out = handle(jnp.asarray(x, jnp.float32).reshape(rows, n))
     return out.reshape(batch_shape + (n,))
 
 
@@ -287,6 +289,7 @@ def batched_lfilter(b, a, x, simd=None, donate: bool = False):
 
         return jax.jit(run, donate_argnums=donation)
 
-    handle = _get_handle(key, build)
-    out = handle(jnp.asarray(x, jnp.float32).reshape(rows, n))
+    with obs.span("batched.lfilter.dispatch"):
+        handle = _get_handle(key, build)
+        out = handle(jnp.asarray(x, jnp.float32).reshape(rows, n))
     return out.reshape(batch_shape + (n,))

@@ -508,52 +508,13 @@ def _check_lengths(handle, x, h):
 
 def _run(handle: ConvolutionHandle, x, h, simd=None):
     if resolve_simd(simd, op="convolve"):
-        x, h = jnp.asarray(x), jnp.asarray(h)
-        _check_lengths(handle, x, h)
-        if handle.algorithm is ConvolutionAlgorithm.BRUTE_FORCE:
-            return _direct(x, h, reverse=handle.reverse)
-        if handle.algorithm is ConvolutionAlgorithm.FFT:
-            return _conv_fft(x, h, handle.fft_length, reverse=handle.reverse)
-        if handle.os_matmul:
-            if (_use_pallas_os(handle.h_length)
-                    and handle.h_length not in _PALLAS_OS_REJECTED):
-                try:
-                    out = _conv_os_pallas(x, h, reverse=handle.reverse,
-                                          precision=os_precision())
-                except Exception as e:
-                    # Mosaic's scoped-vmem cap is not predictable from
-                    # shape arithmetic (convolve2d learned this on
-                    # hardware): demote the filter length to the XLA
-                    # path on the specific vmem-OOM compile error and
-                    # remember it.  Under an OUTER jit the compile
-                    # error surfaces uncatchably at the outer compile —
-                    # traced callers rely on fits_vmem_os's margin and
-                    # the VELES_SIMD_DISABLE_PALLAS_OS escape hatch;
-                    # eager callers (bench, handle API) get this
-                    # fallback.
-                    from veles.simd_tpu.ops.convolve2d import (
-                        _is_mosaic_vmem_oom)
-                    if not _is_mosaic_vmem_oom(e):
-                        raise
-                    _PALLAS_OS_REJECTED.add(handle.h_length)
-                    obs.count("pallas_os_demotion", reason="compile_oom")
-                else:
-                    # recorded AFTER the attempt resolves, so a
-                    # demotion never misattributes the executed route
-                    obs.record_decision(
-                        "convolve_os_route", "pallas_fused",
-                        x_length=handle.x_length,
-                        h_length=handle.h_length,
-                        step=_pk.PALLAS_OS_STEP)
-                    return out
-            obs.record_decision(
-                "convolve_os_route", "xla_matmul",
-                x_length=handle.x_length, h_length=handle.h_length,
-                step=handle.step)
-            return _conv_os_matmul(x, h, handle.step, reverse=handle.reverse,
-                                   precision=os_precision())
-        return _conv_overlap_save(x, h, handle.block_length,
-                                  reverse=handle.reverse)
+        # host-side span around the whole XLA dispatch: route choice +
+        # executable call.  Python-only (no jax ops), so the traced
+        # program is untouched — test_obs.py pins jaxpr identity.
+        with obs.span("convolve.dispatch",
+                      algo=handle.algorithm.value,
+                      os_matmul=handle.os_matmul):
+            return _run_xla(handle, x, h)
     x, h = np.asarray(x), np.asarray(h)
     _check_lengths(handle, x, h)
     if handle.reverse:
@@ -563,6 +524,60 @@ def _run(handle: ConvolutionHandle, x, h, simd=None):
     if handle.algorithm is ConvolutionAlgorithm.FFT:
         return _conv_fft_na(x, h, handle.fft_length)
     return _conv_overlap_save_na(x, h, handle.block_length)
+
+
+def _run_xla(handle: ConvolutionHandle, x, h):
+    """The XLA side of :func:`_run` (factored out so the dispatch span
+    wraps route selection and the executable call in one scope)."""
+    x, h = jnp.asarray(x), jnp.asarray(h)
+    _check_lengths(handle, x, h)
+    if handle.algorithm is ConvolutionAlgorithm.BRUTE_FORCE:
+        return _direct(x, h, reverse=handle.reverse)
+    if handle.algorithm is ConvolutionAlgorithm.FFT:
+        return _conv_fft(x, h, handle.fft_length, reverse=handle.reverse)
+    if handle.os_matmul:
+        if (_use_pallas_os(handle.h_length)
+                and handle.h_length not in _PALLAS_OS_REJECTED):
+            try:
+                with obs.span("convolve.os_route", route="pallas_fused"):
+                    out = _conv_os_pallas(x, h, reverse=handle.reverse,
+                                          precision=os_precision())
+            except Exception as e:
+                # Mosaic's scoped-vmem cap is not predictable from
+                # shape arithmetic (convolve2d learned this on
+                # hardware): demote the filter length to the XLA
+                # path on the specific vmem-OOM compile error and
+                # remember it.  Under an OUTER jit the compile
+                # error surfaces uncatchably at the outer compile —
+                # traced callers rely on fits_vmem_os's margin and
+                # the VELES_SIMD_DISABLE_PALLAS_OS escape hatch;
+                # eager callers (bench, handle API) get this
+                # fallback.
+                from veles.simd_tpu.ops.convolve2d import (
+                    _is_mosaic_vmem_oom)
+                if not _is_mosaic_vmem_oom(e):
+                    raise
+                _PALLAS_OS_REJECTED.add(handle.h_length)
+                obs.count("pallas_os_demotion", reason="compile_oom")
+            else:
+                # recorded AFTER the attempt resolves, so a
+                # demotion never misattributes the executed route
+                obs.record_decision(
+                    "convolve_os_route", "pallas_fused",
+                    x_length=handle.x_length,
+                    h_length=handle.h_length,
+                    step=_pk.PALLAS_OS_STEP)
+                return out
+        obs.record_decision(
+            "convolve_os_route", "xla_matmul",
+            x_length=handle.x_length, h_length=handle.h_length,
+            step=handle.step)
+        with obs.span("convolve.os_route", route="xla_matmul"):
+            return _conv_os_matmul(x, h, handle.step,
+                                   reverse=handle.reverse,
+                                   precision=os_precision())
+    return _conv_overlap_save(x, h, handle.block_length,
+                              reverse=handle.reverse)
 
 
 # ---- brute force ----------------------------------------------------------

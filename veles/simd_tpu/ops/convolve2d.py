@@ -254,47 +254,56 @@ def _run2d(x, h, reverse, algorithm, simd):
         raise ValueError(f"algorithm must be 'direct' or 'fft', "
                          f"got {algorithm!r}")
     if resolve_simd(simd, op="convolve2d"):
-        x, h = jnp.asarray(x), jnp.asarray(h)
-        if algorithm == "direct":
-            use_pallas = _use_pallas_direct2d(x.shape, k0, k1)
-            if use_pallas and isinstance(x, jax.core.Tracer):
-                # under an outer jit the Mosaic compile error surfaces
-                # at the OUTER compile — uncatchable here — so traced
-                # calls get the static small-tile model instead of
-                # the empirical fallback (constant note above)
-                out_tile = (x.shape[-2] + k0 - 1) * (x.shape[-1]
-                                                     + k1 - 1) * 4
-                use_pallas = not (
-                    out_tile <= _TRACED_SMALL_TILE_BYTES
-                    and k0 * k1 * out_tile
-                    > _TRACED_SCOPED_BUDGET_BYTES)
-                if not use_pallas:
-                    # fires once per trace, at the Python dispatch
-                    # layer — the jaxpr is untouched
-                    obs.count("pallas2d_demotion",
-                              reason="traced_small_tile_model")
-                    if auto:
-                        algorithm = "fft"
-            if use_pallas:
-                try:
-                    return _conv2d_direct_pallas(x, h, reverse=reverse)
-                except Exception as e:  # Mosaic scoped-vmem OOM only
-                    if not _is_mosaic_vmem_oom(e):
-                        raise
-                    _PALLAS2D_OOM_REJECTED.add(_oom_key(x.shape, k0, k1))
-                    obs.count("pallas2d_demotion", reason="compile_oom")
-                    if auto:      # re-route as the gate would have
-                        algorithm = "fft"
-            if algorithm == "direct":
-                return _conv2d_direct(x, h, reverse=reverse)
-        m0 = next_highest_power_of_2(x.shape[-2] + k0 - 1)
-        m1 = next_highest_power_of_2(x.shape[-1] + k1 - 1)
-        return _conv2d_fft(x, h, m0, m1, reverse=reverse)
+        with obs.span("convolve2d.dispatch", algo=algorithm,
+                      auto=auto):
+            return _run2d_xla(x, h, reverse, algorithm, auto)
     x = np.asarray(x, np.float32)
     h = np.asarray(h, np.float32)
     if reverse:
         h = h[::-1, ::-1]
     return convolve2d_na(x, h)
+
+
+def _run2d_xla(x, h, reverse, algorithm, auto):
+    """XLA side of :func:`_run2d` (factored out so the dispatch span
+    covers route selection, demotion, and the executable call)."""
+    k0, k1 = np.shape(h)[-2:]
+    x, h = jnp.asarray(x), jnp.asarray(h)
+    if algorithm == "direct":
+        use_pallas = _use_pallas_direct2d(x.shape, k0, k1)
+        if use_pallas and isinstance(x, jax.core.Tracer):
+            # under an outer jit the Mosaic compile error surfaces
+            # at the OUTER compile — uncatchable here — so traced
+            # calls get the static small-tile model instead of
+            # the empirical fallback (constant note above)
+            out_tile = (x.shape[-2] + k0 - 1) * (x.shape[-1]
+                                                 + k1 - 1) * 4
+            use_pallas = not (
+                out_tile <= _TRACED_SMALL_TILE_BYTES
+                and k0 * k1 * out_tile
+                > _TRACED_SCOPED_BUDGET_BYTES)
+            if not use_pallas:
+                # fires once per trace, at the Python dispatch
+                # layer — the jaxpr is untouched
+                obs.count("pallas2d_demotion",
+                          reason="traced_small_tile_model")
+                if auto:
+                    algorithm = "fft"
+        if use_pallas:
+            try:
+                return _conv2d_direct_pallas(x, h, reverse=reverse)
+            except Exception as e:  # Mosaic scoped-vmem OOM only
+                if not _is_mosaic_vmem_oom(e):
+                    raise
+                _PALLAS2D_OOM_REJECTED.add(_oom_key(x.shape, k0, k1))
+                obs.count("pallas2d_demotion", reason="compile_oom")
+                if auto:      # re-route as the gate would have
+                    algorithm = "fft"
+        if algorithm == "direct":
+            return _conv2d_direct(x, h, reverse=reverse)
+    m0 = next_highest_power_of_2(x.shape[-2] + k0 - 1)
+    m1 = next_highest_power_of_2(x.shape[-1] + k1 - 1)
+    return _conv2d_fft(x, h, m0, m1, reverse=reverse)
 
 
 _BOUNDARY_PAD = {"fill": "constant", "wrap": "wrap", "symm": "symmetric"}

@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from veles.simd_tpu import obs
 from veles.simd_tpu.utils.config import resolve_simd
 
 __all__ = [
@@ -996,8 +997,9 @@ def sosfilt(sos, x, zi=None, simd=None, return_zf=False):
     if resolve_simd(simd, op="iir"):
         sos_key = tuple(tuple(float(v) for v in row) for row in sos)
         zi_j = None if zi is None else jnp.asarray(zi, jnp.float32)
-        return _sosfilt_xla(jnp.asarray(x, jnp.float32), sos_key, zi_j,
-                            return_zf)
+        with obs.span("sosfilt.dispatch", sections=len(sos)):
+            return _sosfilt_xla(jnp.asarray(x, jnp.float32), sos_key,
+                                zi_j, return_zf)
     if return_zf:
         y, zf = sosfilt_na(sos, x, zi=zi, return_zf=True)
         return y.astype(np.float32), zf.astype(np.float32)
@@ -1117,14 +1119,17 @@ def sosfiltfilt(sos, x, padlen=None, simd=None):
     n = np.shape(x)[-1]
     padlen = _filtfilt_padlen(sos, n, padlen)
     if resolve_simd(simd, op="iir"):
-        xj = jnp.asarray(x, jnp.float32)
-        ext = _odd_ext(xj, padlen, jnp)
-        zi_j = jnp.asarray(zi, jnp.float32)
-        fwd = sosfilt(sos, ext, zi=zi_j * ext[..., :1, None], simd=True)
-        bwd = sosfilt(sos, fwd[..., ::-1],
-                      zi=zi_j * fwd[..., -1:, None], simd=True)
-        out = bwd[..., ::-1]
-        return out[..., padlen:padlen + n]
+        # outer span; the two sosfilt calls below nest their own
+        with obs.span("sosfiltfilt.dispatch", sections=len(sos)):
+            xj = jnp.asarray(x, jnp.float32)
+            ext = _odd_ext(xj, padlen, jnp)
+            zi_j = jnp.asarray(zi, jnp.float32)
+            fwd = sosfilt(sos, ext, zi=zi_j * ext[..., :1, None],
+                          simd=True)
+            bwd = sosfilt(sos, fwd[..., ::-1],
+                          zi=zi_j * fwd[..., -1:, None], simd=True)
+            out = bwd[..., ::-1]
+            return out[..., padlen:padlen + n]
     return sosfiltfilt_na(sos, x, padlen=padlen).astype(np.float32)
 
 
@@ -1246,9 +1251,10 @@ def lfilter(b, a, x, simd=None):
         if p == 0:
             # pure FIR: no recurrence, just the drive
             a = np.concatenate([a, [0.0]])
-        return _lfilter_xla(jnp.asarray(x, jnp.float32),
-                            tuple(float(v) for v in b),
-                            tuple(float(v) for v in a))
+        with obs.span("lfilter.dispatch", order=p):
+            return _lfilter_xla(jnp.asarray(x, jnp.float32),
+                                tuple(float(v) for v in b),
+                                tuple(float(v) for v in a))
     return lfilter_na(b, a, x).astype(np.float32)
 
 

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from veles.simd_tpu import obs
 from veles.simd_tpu.utils.config import resolve_simd
 
 __all__ = [
@@ -114,7 +115,8 @@ def normalize2D(src, simd=None):
     (``inc/simd/normalize.h:48-57``)."""
     _check_2d(src)
     if resolve_simd(simd, op="normalize"):
-        return _normalize2d(jnp.asarray(src))
+        with obs.span("normalize2d.dispatch"):
+            return _normalize2d(jnp.asarray(src))
     return normalize2D_novec(np.asarray(src))
 
 
@@ -122,7 +124,8 @@ def normalize2D_minmax(mn, mx, src, simd=None):
     """Normalization with precomputed min/max
     (``inc/simd/normalize.h:66-79``)."""
     if resolve_simd(simd, op="normalize"):
-        return _normalize2d_minmax(mn, mx, jnp.asarray(src))
+        with obs.span("normalize2d_minmax.dispatch"):
+            return _normalize2d_minmax(mn, mx, jnp.asarray(src))
     return normalize2D_minmax_novec(mn, mx, np.asarray(src))
 
 

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from veles.simd_tpu import obs
 from veles.simd_tpu.utils.config import on_tpu, resolve_simd
 
 __all__ = [
@@ -170,9 +171,10 @@ def resample_poly(x, up: int, down: int, taps=None, simd=None):
     n = np.shape(x)[-1]
     out_len = resample_length(n, up, down)
     if resolve_simd(simd, op="resample"):
-        return _resample_conv(jnp.asarray(x, jnp.float32),
-                              jnp.asarray(taps, jnp.float32),
-                              up, down, out_len)
+        with obs.span("resample_poly.dispatch", up=up, down=down):
+            return _resample_conv(jnp.asarray(x, jnp.float32),
+                                  jnp.asarray(taps, jnp.float32),
+                                  up, down, out_len)
     return resample_poly_na(x, up, down, taps).astype(np.float32)
 
 
@@ -223,13 +225,14 @@ def upfirdn(h, x, up: int = 1, down: int = 1, simd=None):
     dilated = (n - 1) * up + 1
     out_len = -(-(dilated + k - 1) // down)
     if resolve_simd(simd, op="resample"):
-        # full span: left pad k-1 (conv start), right pad to cover the
-        # last strided window
+        # full output span: left pad k-1 (conv start), right pad to
+        # cover the last strided window
         pad = (k - 1, max(0, (out_len - 1) * down + k
                           - (k - 1) - dilated))
-        return _resample_conv(jnp.asarray(x, jnp.float32),
-                              jnp.asarray(h, jnp.float32), up, down,
-                              out_len, pad=pad)
+        with obs.span("upfirdn.dispatch", up=up, down=down):
+            return _resample_conv(jnp.asarray(x, jnp.float32),
+                                  jnp.asarray(h, jnp.float32), up, down,
+                                  out_len, pad=pad)
     return upfirdn_na(h, x, up, down).astype(np.float32)
 
 

@@ -181,12 +181,14 @@ def stft(x, frame_length: int, hop: int, window=None, simd=None):
     _check_stft_args(x_np.shape[-1], frame_length, hop)
     window = _resolve_window(window, frame_length)
     if resolve_simd(simd, op="stft"):
+        path = _framing_path(frame_length, hop)
         obs.record_decision(
-            "stft", _framing_path(frame_length, hop),
+            "stft", path,
             n=int(x_np.shape[-1]), frame_length=int(frame_length),
             hop=int(hop))
-        return _stft_xla(jnp.asarray(x, jnp.float32), jnp.asarray(window),
-                         frame_length, hop)
+        with obs.span("stft.dispatch", path=path):
+            return _stft_xla(jnp.asarray(x, jnp.float32),
+                             jnp.asarray(window), frame_length, hop)
     return stft_na(x, frame_length, hop, window).astype(np.complex64)
 
 
@@ -278,9 +280,10 @@ def istft(spec, n: int, frame_length: int, hop: int, window=None,
         obs.record_decision(
             "istft", path, n=int(n), frame_length=int(frame_length),
             hop=int(hop))
-        return _istft_xla(to_device(spec, jnp.complex64),
-                          jnp.asarray(window), jnp.asarray(env_inv),
-                          n, frame_length, hop)
+        with obs.span("istft.dispatch", path=path):
+            return _istft_xla(to_device(spec, jnp.complex64),
+                              jnp.asarray(window), jnp.asarray(env_inv),
+                              n, frame_length, hop)
     return istft_na(spec, n, frame_length, hop, window).astype(np.float32)
 
 

@@ -261,13 +261,16 @@ def wavelet_apply(type, order, ext, src, simd=None):
         "wavelet_apply", "pallas" if use_pk else "xla_conv",
         family=WaveletType(type).value, order=int(order),
         ext=ExtensionType(ext).value, length=int(src.shape[-1]))
-    if use_pk:
-        return _filter_bank_pallas(src, WaveletType(type), int(order),
-                                   ExtensionType(ext), 2, 1,
-                                   src.shape[-1] // 2)
-    hi, lo = _filters(type, order)
-    return _filter_bank(src, jnp.asarray(hi), jnp.asarray(lo),
-                        ExtensionType(ext), 2, 1, src.shape[-1] // 2)
+    with obs.span("wavelet_apply.dispatch",
+                  route="pallas" if use_pk else "xla_conv"):
+        if use_pk:
+            return _filter_bank_pallas(src, WaveletType(type),
+                                       int(order), ExtensionType(ext),
+                                       2, 1, src.shape[-1] // 2)
+        hi, lo = _filters(type, order)
+        return _filter_bank(src, jnp.asarray(hi), jnp.asarray(lo),
+                            ExtensionType(ext), 2, 1,
+                            src.shape[-1] // 2)
 
 
 def stationary_wavelet_apply(type, order, level, ext, src, simd=None):
@@ -452,8 +455,10 @@ def wavelet_transform(type, order, ext, src, levels, simd=None):
             levels=levels, ext=ExtensionType(ext).value,
             length=int(src_j.shape[-1]))
         if fused:
-            return list(_fused_cascade(src_j, WaveletType(type),
-                                       int(order), levels))
+            with obs.span("wavelet_transform.dispatch",
+                          route="fused_cascade", levels=levels):
+                return list(_fused_cascade(src_j, WaveletType(type),
+                                           int(order), levels))
         src = src_j
     coeffs = []
     cur = src

@@ -144,19 +144,21 @@ def sharded_convolve(x, h, mesh: Mesh, axis: str = "sp"):
         "sharded_convolve", "one_hop_halo", n_shards=int(n_shards),
         axis=axis, x_length=int(n), h_length=int(k),
         block=int(pad_to // n_shards), halo=int(k - 1))
-    x_pad = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad_to - n)])
-    # leading batch dims (if any) stay replicated; shard the length
-    spec = P(*([None] * (x.ndim - 1) + [axis]))
+    with obs.span("sharded_convolve.dispatch", route="one_hop_halo",
+                  n_shards=int(n_shards)):
+        x_pad = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad_to - n)])
+        # leading batch dims (if any) stay replicated; shard the length
+        spec = P(*([None] * (x.ndim - 1) + [axis]))
 
-    @functools.partial(
-        shard_map, mesh=mesh,
-        in_specs=(spec, P()), out_specs=spec)
-    def _run(x_local, h_full):
-        halo = halo_exchange_left(x_local, k - 1, axis)
-        x_ext = jnp.concatenate([halo, x_local], axis=-1)
-        return _local_block_conv(x_ext, h_full)
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(spec, P()), out_specs=spec)
+        def _run(x_local, h_full):
+            halo = halo_exchange_left(x_local, k - 1, axis)
+            x_ext = jnp.concatenate([halo, x_local], axis=-1)
+            return _local_block_conv(x_ext, h_full)
 
-    return _run(x_pad, h)[..., :out_len]
+        return _run(x_pad, h)[..., :out_len]
 
 
 def sharded_convolve_ring(x, h, mesh: Mesh, axis: str = "sp",
@@ -883,21 +885,24 @@ def sharded_matmul(a, b, mesh: Mesh, axis: str = "tp"):
         "sharded_matmul", "contracting_dim", n_shards=int(shards),
         axis=axis, m=int(a.shape[-2]), k=int(a.shape[-1]),
         n=int(b.shape[-1]))
-    rem = a.shape[-1] % shards
-    if rem:
-        pad = shards - rem
-        a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, pad)])
-        b = jnp.pad(b, [(0, 0)] * (b.ndim - 2) + [(0, pad), (0, 0)])
+    with obs.span("sharded_matmul.dispatch", n_shards=int(shards)):
+        rem = a.shape[-1] % shards
+        if rem:
+            pad = shards - rem
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, pad)])
+            b = jnp.pad(b, [(0, 0)] * (b.ndim - 2)
+                        + [(0, pad), (0, 0)])
 
-    @functools.partial(
-        shard_map, mesh=mesh,
-        in_specs=(P(None, axis), P(axis, None)), out_specs=P(None, None))
-    def _run(a_local, b_local):
-        partial = jnp.dot(a_local, b_local,
-                          precision=jax.lax.Precision.HIGHEST)
-        return jax.lax.psum(partial, axis)
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P(None, axis), P(axis, None)),
+            out_specs=P(None, None))
+        def _run(a_local, b_local):
+            partial = jnp.dot(a_local, b_local,
+                              precision=jax.lax.Precision.HIGHEST)
+            return jax.lax.psum(partial, axis)
 
-    return _run(a, b)
+        return _run(a, b)
 
 
 def _check_stft_sharding(n, frame_length, hop, n_shards):
@@ -966,7 +971,8 @@ def sharded_stft(x, frame_length: int, hop: int, mesh: Mesh,
         frames = frames[..., :frames_local, :] * window
         return jnp.fft.rfft(frames, axis=-1)
 
-    out = _run(x)
+    with obs.span("sharded_stft.dispatch", n_shards=int(n_shards)):
+        out = _run(x)
     return out[..., :sp.frame_count(n, frame_length, hop), :]
 
 

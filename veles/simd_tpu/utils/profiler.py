@@ -1,12 +1,21 @@
 """Tracing & plan-cache persistence (the framework's *tracing* layer).
 
-Scope: this module answers **where the time goes** inside a step — XLA
-profiler timelines and persistent compile caching.  It is NOT the
-observability layer as a whole: **what was decided** (algorithm
+Scope: this module answers **where the device time goes** inside a
+step — XLA profiler timelines and persistent compile caching.  It is
+NOT the observability layer as a whole: **what was decided** (algorithm
 selections, XLA-vs-oracle dispatch tallies, compile/cache hit counts)
-lives in :mod:`veles.simd_tpu.obs`, the runtime telemetry package.  Use
-both together: telemetry tells you *which* path served your traffic,
-a trace tells you *why* that path cost what it did.
+and **how long the host-side dispatch took** (``obs.span`` latency
+histograms, warmup vs. steady-state, Chrome-trace export via
+``obs.save_trace``) live in :mod:`veles.simd_tpu.obs`, the runtime
+telemetry package.  The split: spans time the *Python dispatch layer*
+with ~µs granularity and zero device involvement; this module's
+:func:`trace` captures the *device* timeline with XLA's profiler.  The
+two meet in the middle — while a :func:`trace` capture is live, every
+``obs.span`` also opens a ``jax.profiler.TraceAnnotation``, so the
+host-side span names appear inside the XLA timeline.  Use all three
+together: telemetry tells you *which* path served your traffic, spans
+tell you *what it cost at the dispatch layer*, a trace tells you *why
+the device work cost what it did*.
 
 The reference's entire profiling story is ``std::chrono`` around
 synchronous calls (``/root/reference/tests/benchmark.inc:74-107``) and
@@ -49,14 +58,21 @@ def trace(log_dir: str):
             convolve(handle, x, h)
 
     View with TensorBoard (``tensorboard --logdir /tmp/veles-trace``) or
-    Perfetto.  Nested :func:`annotate` scopes appear as named spans.
+    Perfetto.  Nested :func:`annotate` scopes appear as named spans, and
+    while the capture is live every enabled ``obs.span`` bridges to a
+    ``jax.profiler.TraceAnnotation`` too (the host dispatch names land
+    in the device timeline).
     """
     import jax
 
+    from veles.simd_tpu.obs import spans as _obs_spans
+
     jax.profiler.start_trace(log_dir, create_perfetto_link=False)
+    _obs_spans.set_xla_trace_active(True)
     try:
         yield log_dir
     finally:
+        _obs_spans.set_xla_trace_active(False)
         jax.profiler.stop_trace()
 
 
