@@ -359,6 +359,22 @@ class TestOomRejectionBound:
             assert obs.counter_value(
                 "pallas2d_demotion",
                 reason="traced_small_tile_model") >= 1
+            # the demotion also records a decision EVENT carrying the
+            # budget-model geometry (obs v3 satellite: the signal a
+            # future hardware recalibration of
+            # _TRACED_SCOPED_BUDGET_BYTES mines)
+            evs = [e for e in obs.events()
+                   if e["op"] == "convolve2d"
+                   and e["decision"] == "traced_fft_demotion"]
+            assert evs, "no traced_fft_demotion decision event"
+            ev = evs[-1]
+            assert ev["n0"] == 128 and ev["n1"] == 128
+            assert ev["k0"] == 15 and ev["k1"] == 15
+            assert ev["out_tile_bytes"] == 142 * 142 * 4
+            assert ev["scoped_bytes"] == 225 * ev["out_tile_bytes"]
+            assert ev["budget_bytes"] == \
+                cv2._TRACED_SCOPED_BUDGET_BYTES
+            assert ev["scoped_bytes"] > ev["budget_bytes"]
         finally:
             obs.reset()
             obs.disable()

@@ -631,3 +631,427 @@ def test_1m_convolve_snapshot_names_algorithm_and_compiles(telemetry):
     assert parsed[("veles_simd_decisions_total",
                    (("decision", "overlap_save"),
                     ("op", "convolve")))] >= 1
+
+
+# --------------------------------------------------------------------------
+# the resource axis: instrumented compile sites
+# --------------------------------------------------------------------------
+
+
+def _probe_fn(a, b):
+    return a @ b + 1.0
+
+
+def test_instrumented_jit_passthrough_when_disabled():
+    obs.disable()
+    obs.reset()
+    fn = obs.instrumented_jit(_probe_fn, op="probe", route="r")
+    x = jnp.ones((32, 32), jnp.float32)
+    np.testing.assert_allclose(np.asarray(fn(x, x)),
+                               np.asarray(x @ x + 1.0), rtol=1e-6)
+    assert obs.resources() == []        # nothing harvested while off
+
+
+def test_instrumented_jit_harvests_cost_and_memory(telemetry):
+    fn = obs.instrumented_jit(_probe_fn, op="probe", route="matmul")
+    x = jnp.ones((32, 32), jnp.float32)
+    fn(x, x)
+    entries = [e for e in obs.resources() if e["op"] == "probe"]
+    assert len(entries) == 1
+    e = entries[0]
+    assert e["route"] == "matmul"
+    assert e["flops"] and e["flops"] > 0
+    assert e["bytes_accessed"] and e["bytes_accessed"] > 0
+    assert e["arith_intensity"] == pytest.approx(
+        e["flops"] / e["bytes_accessed"])
+    # CPU backend reports full memory stats; the breakdown keys are
+    # always present (None when a backend cannot report them)
+    for key in ("argument_bytes", "output_bytes", "temp_bytes",
+                "generated_code_bytes", "peak_bytes"):
+        assert key in e
+    assert e["argument_bytes"] == 2 * 32 * 32 * 4
+    assert e["output_bytes"] == 32 * 32 * 4
+    assert "float32[32,32]" in e["shapes"]
+    assert e["analyses"] == 1
+
+
+def test_instrumented_jit_memoizes_per_geometry(telemetry):
+    fn = obs.instrumented_jit(_probe_fn, op="probe2", route="r")
+    x = jnp.ones((16, 16), jnp.float32)
+    fn(x, x)
+    fn(x, x)                            # same geometry: memo hit
+    e = [e for e in obs.resources() if e["op"] == "probe2"][0]
+    assert e["analyses"] == 1
+    y = jnp.ones((8, 8), jnp.float32)
+    fn(y, y)                            # new geometry: re-harvested
+    e = [e for e in obs.resources() if e["op"] == "probe2"][0]
+    assert e["analyses"] == 2
+    assert "float32[8,8]" in e["shapes"]    # latest geometry wins
+    memo = obs.caches()["obs_analysis_memo"]
+    assert memo["hits"] >= 1 and memo["misses"] >= 2
+
+
+def test_instrumented_jit_skips_harvest_under_outer_trace(telemetry):
+    fn = obs.instrumented_jit(_probe_fn, op="traced_probe", route="r")
+
+    @jax.jit
+    def outer(v):
+        return fn(v, v)
+
+    outer(jnp.ones((8, 8), jnp.float32))
+    # tracer args cannot be lowered eagerly: no harvest, no crash
+    assert not any(e["op"] == "traced_probe" for e in obs.resources())
+
+
+def test_instrumented_jit_static_argnames_and_decorator(telemetry):
+    import functools
+
+    @functools.partial(obs.instrumented_jit, op="probe3",
+                       static_argnames=("k",))
+    def scaled(a, k):
+        return a * k
+
+    out = scaled(jnp.ones(128, jnp.float32), k=3)
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+    assert any(e["op"] == "probe3" for e in obs.resources())
+
+
+def test_convolve_routes_land_in_resources(telemetry):
+    x = RNG.randn(1 << 14).astype(np.float32)
+    h = RNG.randn(255).astype(np.float32)
+    handle = cv.convolve_overlap_save_initialize(len(x), len(h))
+    np.asarray(cv.convolve_overlap_save(handle, x, h, simd=True)[:1])
+    routes = {(e["op"], e["route"]) for e in obs.resources()}
+    assert ("convolve", "os_matmul") in routes
+    e = [e for e in obs.resources()
+         if (e["op"], e["route"]) == ("convolve", "os_matmul")][0]
+    # the blocked matmul must account at least the useful MAC volume
+    assert e["flops"] >= 2 * len(h) * len(x)
+
+
+def test_resources_round_trip_and_prometheus(telemetry):
+    fn = obs.instrumented_jit(_probe_fn, op="probe4", route="r")
+    x = jnp.ones((16, 16), jnp.float32)
+    fn(x, x)
+    snap = obs.snapshot()
+    assert snap["resources"]
+    assert obs_export.from_json(obs.to_json(snap)) == snap
+    text = obs.to_prometheus(snap)
+    parsed = obs_export.parse_prometheus(text)
+    key = ("veles_simd_resource_flops", (("op", "probe4"),
+                                         ("route", "r")))
+    assert parsed[key] > 0
+    assert ("veles_simd_cache_size",
+            (("cache", "obs_analysis_memo"),)) in parsed
+    rep = obs.report(snap)
+    assert "compiled-program resources" in rep
+    assert "probe4/r" in rep
+    assert "compile caches:" in rep
+
+
+def test_reset_clears_resources(telemetry):
+    fn = obs.instrumented_jit(_probe_fn, op="probe5", route="r")
+    x = jnp.ones((8, 8), jnp.float32)
+    fn(x, x)
+    assert obs.resources()
+    obs.reset()
+    assert obs.resources() == []
+    memo = obs.caches()["obs_analysis_memo"]
+    assert memo["size"] == 0 and memo["misses"] == 0
+
+
+# --------------------------------------------------------------------------
+# unified cache introspection
+# --------------------------------------------------------------------------
+
+
+def test_caches_unified_snapshot(telemetry):
+    from veles.simd_tpu.ops import batched
+    from veles.simd_tpu.ops import convolve2d  # noqa: F401 — its
+    # import registers the pallas2d OOM cache provider
+
+    batched.clear_handle_cache()
+    sos = np.array([[0.2, 0.1, 0.0, 1.0, -0.3, 0.0]], np.float32)
+    xs = RNG.randn(4, 256).astype(np.float32)
+    batched.batched_sosfilt(sos, xs)        # miss (compile)
+    batched.batched_sosfilt(sos, xs)        # hit
+    caches = obs.caches()
+    lru = caches["batched_handle_lru"]
+    assert lru["size"] == 1
+    assert lru["capacity"] == batched.BATCHED_CACHE_MAXSIZE
+    assert lru["hits"] >= 1 and lru["misses"] >= 1
+    assert "pallas2d_oom_rejected" in caches
+    assert caches["pallas2d_oom_rejected"]["capacity"] == 256
+    assert "pallas_os_rejected" in caches
+    assert "obs_analysis_memo" in caches
+    # JSON-native all the way down (tuples would break round trips)
+    json.dumps(caches, allow_nan=False)
+    batched.clear_handle_cache()
+
+
+def test_cache_provider_error_is_contained(telemetry):
+    import sys
+
+    # NB: the obs facade function `obs.resources` shadows the
+    # submodule on from-import; go through sys.modules for the module
+    res_mod = sys.modules["veles.simd_tpu.obs.resources"]
+
+    def bad():
+        raise RuntimeError("provider exploded")
+
+    obs.register_cache("exploding", bad)
+    try:
+        caches = obs.caches()
+        assert "provider exploded" in caches["exploding"]["error"]
+    finally:
+        with res_mod._cache_lock:
+            res_mod._cache_providers.pop("exploding", None)
+
+
+# --------------------------------------------------------------------------
+# flight recorder
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture
+def flight(telemetry, tmp_path):
+    """Telemetry on + flight dir pointed at tmp + a re-armed auto
+    budget; restores the env lookup afterwards."""
+    from veles.simd_tpu.obs import flightrec
+
+    obs.configure(flight_dir=str(tmp_path))
+    flightrec._reset_auto_count()
+    yield tmp_path
+    obs.configure(flight_dir="")
+    flightrec._reset_auto_count()
+
+
+def test_dump_debug_bundle_explicit_path(telemetry, tmp_path):
+    obs.count("bundle.probe")
+    with obs.span("bundle.span"):
+        pass
+    path = obs.dump_debug_bundle(str(tmp_path / "b.json"),
+                                 reason="unit")
+    with open(path) as f:
+        doc = json.load(f)          # strict JSON
+    assert doc["schema"] == "veles-simd-flight-v1"
+    assert doc["reason"] == "unit"
+    assert doc["exception"] is None
+    assert doc["platform"]["pid"] == os.getpid()
+    assert "conv_precision" in doc["config"]
+    names = [c["name"] for c in doc["snapshot"]["counters"]]
+    assert "bundle.probe" in names
+    assert any(e.get("name") == "bundle.span"
+               for e in doc["trace_events"])
+    assert "caches" in doc["snapshot"]
+    assert "resources" in doc["snapshot"]
+    assert os.listdir(tmp_path) == ["b.json"]   # atomic, no litter
+
+
+def test_dump_debug_bundle_default_dir(flight):
+    path = obs.dump_debug_bundle(reason="default_dir")
+    assert os.path.dirname(path) == str(flight)
+    assert os.path.basename(path).startswith("flight-")
+    json.load(open(path))
+
+
+def test_crash_in_top_level_span_writes_bundle(flight):
+    with pytest.raises(RuntimeError):
+        with obs.span("crash.outer"):
+            with obs.span("crash.inner"):
+                raise RuntimeError("dispatch exploded")
+    bundles = [f for f in os.listdir(flight)
+               if f.startswith("flight-")]
+    assert len(bundles) == 1        # inner span (nested) didn't double
+    doc = json.load(open(os.path.join(flight, bundles[0])))
+    assert doc["reason"] == "span_crash"
+    assert doc["exception"]["type"] == "RuntimeError"
+    assert "dispatch exploded" in doc["exception"]["message"]
+    assert any("dispatch exploded" in line
+               for line in doc["exception"]["traceback"])
+
+
+def test_crash_bundles_rate_limited(flight):
+    from veles.simd_tpu.obs import flightrec
+
+    for i in range(flightrec.MAX_AUTO_BUNDLES + 2):
+        with pytest.raises(ValueError):
+            with obs.span("crash.repeat", i=i):
+                raise ValueError("again")
+    bundles = [f for f in os.listdir(flight)
+               if f.startswith("flight-")]
+    assert len(bundles) == flightrec.MAX_AUTO_BUNDLES
+    assert flightrec.auto_bundles_written() == \
+        flightrec.MAX_AUTO_BUNDLES
+
+
+def test_crash_without_flight_dir_writes_nothing(telemetry, tmp_path,
+                                                 monkeypatch):
+    from veles.simd_tpu.obs import flightrec
+
+    monkeypatch.delenv(flightrec.FLIGHT_DIR_ENV, raising=False)
+    obs.configure(flight_dir="")    # env lookup, which is unset
+    flightrec._reset_auto_count()
+    with pytest.raises(RuntimeError):
+        with obs.span("crash.unarmed"):
+            raise RuntimeError("no dir")
+    assert flightrec.auto_bundles_written() == 0
+    assert os.listdir(tmp_path) == []
+
+
+def test_flight_dir_env_arming(telemetry, tmp_path, monkeypatch):
+    from veles.simd_tpu.obs import flightrec
+
+    monkeypatch.setenv(flightrec.FLIGHT_DIR_ENV, str(tmp_path))
+    obs.configure(flight_dir="")    # defer to the env var
+    flightrec._reset_auto_count()
+    try:
+        with pytest.raises(RuntimeError):
+            with obs.span("crash.env"):
+                raise RuntimeError("env armed")
+        assert len(os.listdir(tmp_path)) == 1
+    finally:
+        flightrec._reset_auto_count()
+
+
+# --------------------------------------------------------------------------
+# the jax.monitoring duration/counter bridge (obs/compile.py)
+# --------------------------------------------------------------------------
+
+
+def test_monitoring_event_counter_bridge(telemetry):
+    import jax.monitoring
+
+    from veles.simd_tpu.obs import compile as obs_compile
+
+    obs.install_compile_listeners()
+    before = obs.counter_value("compile.cache_hits")
+    jax.monitoring.record_event("/jax/compilation_cache/cache_hits")
+    assert obs.counter_value("compile.cache_hits") == before + 1
+    # unknown events fall through without counting anything
+    jax.monitoring.record_event("/jax/unrelated/event")
+    snap_names = {c["name"] for c in obs.snapshot()["counters"]}
+    assert not any("unrelated" in n for n in snap_names)
+    # every mapped event name is wired
+    for event, counter in obs_compile.EVENT_COUNTERS.items():
+        base = obs.counter_value(counter)
+        jax.monitoring.record_event(event)
+        assert obs.counter_value(counter) == base + 1
+
+
+def test_monitoring_duration_bridge(telemetry):
+    import jax.monitoring
+
+    from veles.simd_tpu.obs import compile as obs_compile
+
+    obs.install_compile_listeners()
+    obs.reset()
+    jax.monitoring.record_event_duration_secs(
+        "/jax/core/compile/backend_compile_duration", 0.125)
+    jax.monitoring.record_event_duration_secs(
+        "/jax/core/compile/jaxpr_trace_duration", 0.25)
+    assert obs.counter_value("compile.backend_compile") == 1
+    hists = {h["name"]: h for h in obs.snapshot()["histograms"]}
+    bc = hists["compile.backend_compile_secs"]
+    assert bc["count"] == 1
+    assert bc["sum"] == pytest.approx(0.125)
+    # counter-less duration metrics feed ONLY their histogram
+    tr = hists["compile.jaxpr_trace_secs"]
+    assert tr["count"] == 1 and tr["sum"] == pytest.approx(0.25)
+    assert obs.counter_value("compile.jaxpr_trace") == 0
+    # every mapped duration metric lands in its histogram
+    for event, (_c, hist) in obs_compile.DURATION_METRICS.items():
+        jax.monitoring.record_event_duration_secs(event, 1e-3)
+    hists = {h["name"]: h for h in obs.snapshot()["histograms"]}
+    for _event, (_c, hist) in obs_compile.DURATION_METRICS.items():
+        assert hists[hist]["count"] >= 1
+
+
+def test_disabled_monitoring_bridge_is_silent():
+    import jax.monitoring
+
+    obs.install_compile_listeners()
+    obs.disable()
+    obs.reset()
+    jax.monitoring.record_event("/jax/compilation_cache/cache_hits")
+    jax.monitoring.record_event_duration_secs(
+        "/jax/core/compile/backend_compile_duration", 0.5)
+    assert obs.counter_value("compile.cache_hits") == 0
+    assert obs.snapshot()["histograms"] == []
+
+
+def test_instrumented_jit_scalar_sweep_analyzes_once(telemetry):
+    # a wrapper WITHOUT statics treats Python scalars as dynamic
+    # weak-typed operands (one executable per TYPE), so a value sweep
+    # must not re-run the AOT harvest per value
+    fn = obs.instrumented_jit(lambda a, g: a * g, op="probe_scalar")
+    x = jnp.ones(64, jnp.float32)
+    for gain in (0.5, 0.6, 0.7, 0.8):
+        fn(x, gain)
+    e = [e for e in obs.resources() if e["op"] == "probe_scalar"][0]
+    assert e["analyses"] == 1
+    # ...while a wrapper WITH statics keys per static value, matching
+    # jax.jit's own compile behavior
+    import functools
+
+    @functools.partial(obs.instrumented_jit, op="probe_static",
+                       static_argnames=("k",))
+    def scaled(a, k):
+        return a * k
+
+    scaled(x, k=2)
+    scaled(x, k=3)
+    e = [e for e in obs.resources() if e["op"] == "probe_static"][0]
+    assert e["analyses"] == 2
+
+
+def test_instrumented_jit_distinct_closures_both_harvested(telemetry):
+    # two wrappers sharing (op, route) but baking different constants
+    # into their closures compile different programs: the per-instance
+    # memo token must keep both harvests (regression: a shared
+    # (op, route, shapes) key let the second closure's program hide)
+    def build(n_iters):
+        def run(a):
+            for _ in range(n_iters):
+                a = jnp.tanh(a) + a     # not foldable: work scales
+            return a
+        return obs.instrumented_jit(run, op="probe_closure",
+                                    route="batched")
+
+    x = jnp.ones(32, jnp.float32)
+    build(1)(x)
+    e = [e for e in obs.resources() if e["op"] == "probe_closure"][0]
+    first = (e["flops"], e["transcendentals"])
+    build(8)(x)         # same shapes, different program
+    e = [e for e in obs.resources() if e["op"] == "probe_closure"][0]
+    assert e["analyses"] == 2
+    assert (e["flops"], e["transcendentals"]) != first
+
+
+def test_crash_bundle_write_failure_releases_budget(telemetry,
+                                                    tmp_path):
+    from veles.simd_tpu.obs import flightrec
+
+    # a FILE where the flight dir should be: makedirs fails, the
+    # bundle cannot be written — the reserved budget slot must be
+    # released so the recorder stays armed once the path is fixed
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("x")
+    obs.configure(flight_dir=str(blocker))
+    flightrec._reset_auto_count()
+    try:
+        with pytest.raises(RuntimeError):
+            with obs.span("crash.badfs"):
+                raise RuntimeError("boom")
+        assert flightrec.auto_bundles_written() == 0
+        # point at a real dir: the very next crash records normally
+        obs.configure(flight_dir=str(tmp_path))
+        with pytest.raises(RuntimeError):
+            with obs.span("crash.goodfs"):
+                raise RuntimeError("boom2")
+        assert flightrec.auto_bundles_written() == 1
+        assert [f for f in os.listdir(tmp_path)
+                if f.startswith("flight-")]
+    finally:
+        obs.configure(flight_dir="")
+        flightrec._reset_auto_count()

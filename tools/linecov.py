@@ -1,0 +1,156 @@
+"""Dependency-free line coverage for the test harness.
+
+The container ships neither ``coverage`` nor ``pytest-cov``, so
+``tools/run_tests.py`` collects line coverage with a stdlib
+``sys.settrace`` hook instead: the global trace function prunes every
+frame whose code lives outside the repo's ``veles/`` tree (returning
+``None`` disables local tracing for that frame, so numpy/jax/pytest
+internals only pay the per-call event), and repo frames record their
+executed line numbers into one set.
+
+Two halves:
+
+* **collector** (runs inside the per-suite child): :func:`start`
+  installs the tracer (both ``sys.settrace`` and ``threading.settrace``
+  — bench-harness tests spawn worker threads) and registers an atexit
+  dump of ``{filename: [lines]}`` JSON.
+* **reporter** (runs in the parent): :func:`merge` folds the per-suite
+  dumps, :func:`executable_lines` computes each module's denominator
+  from the *compiled* code objects (``co_lines`` over the nested code
+  tree — exactly the set a tracer could ever report, so docstrings and
+  blank lines never count against coverage), and :func:`table` renders
+  the per-module report ``run_tests.py`` appends to ``tests.log`` and
+  gates the ``veles/simd_tpu/obs/`` floor on.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+
+__all__ = ["start", "merge", "executable_lines", "table",
+           "aggregate_pct"]
+
+
+def start(prefix: str, out_path: str) -> None:
+    """Install the tracer for files under ``prefix`` and dump counts
+    to ``out_path`` at interpreter exit (atomic rename, so a killed
+    suite leaves no torn JSON)."""
+    import atexit
+
+    prefix = os.path.abspath(prefix) + os.sep
+    hits: dict = {}
+
+    def _global(frame, event, arg):
+        if event != "call":
+            return None
+        fname = frame.f_code.co_filename
+        if not fname.startswith(prefix):
+            return None     # foreign frame: no local line tracing
+        target = hits.setdefault(fname, set())
+        target.add(frame.f_lineno)
+
+        def local(frame, event, arg):
+            if event == "line":
+                target.add(frame.f_lineno)
+            return local
+        return local
+
+    def _dump():
+        sys.settrace(None)
+        threading.settrace(None)
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({k: sorted(v) for k, v in hits.items()}, f)
+        os.replace(tmp, out_path)
+
+    atexit.register(_dump)
+    threading.settrace(_global)
+    sys.settrace(_global)
+
+
+def merge(paths) -> dict:
+    """Union the per-suite dumps into ``{filename: set(lines)}``."""
+    merged: dict = {}
+    for p in paths:
+        try:
+            with open(p) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue        # skipped/killed suite: no dump, not fatal
+        for fname, lines in data.items():
+            merged.setdefault(fname, set()).update(lines)
+    return merged
+
+
+def executable_lines(path: str) -> set:
+    """Line numbers the compiled module could ever report: the union
+    of ``co_lines()`` over the module's nested code objects."""
+    with open(path) as f:
+        src = f.read()
+    try:
+        code = compile(src, path, "exec")
+    except SyntaxError:
+        return set()
+    lines: set = set()
+    stack = [code]
+    while stack:
+        co = stack.pop()
+        for _, _, line in co.co_lines():
+            if line is not None:
+                lines.add(line)
+        for const in co.co_consts:
+            if hasattr(const, "co_lines"):
+                stack.append(const)
+    return lines
+
+
+def _module_rows(merged: dict, repo: str, scope: str):
+    scope_abs = os.path.join(os.path.abspath(repo), scope)
+    rows = []
+    for root, _dirs, files in os.walk(scope_abs):
+        if "__pycache__" in root:
+            continue
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            exe = executable_lines(path)
+            if not exe:
+                continue
+            hit = merged.get(path, set()) & exe
+            rel = os.path.relpath(path, repo)
+            rows.append((rel, len(hit), len(exe)))
+    return rows
+
+
+def table(merged: dict, repo: str, scope: str = "veles") -> str:
+    """Per-module coverage table over ``scope`` (repo-relative dir)."""
+    rows = _module_rows(merged, repo, scope)
+    if not rows:
+        return "(no coverage data)\n"
+    width = max(len(r[0]) for r in rows)
+    lines = ["%-*s %8s %8s %6s" % (width, "module", "covered",
+                                   "lines", "pct")]
+    tot_hit = tot_exe = 0
+    for rel, hit, exe in rows:
+        tot_hit += hit
+        tot_exe += exe
+        lines.append("%-*s %8d %8d %5.1f%%"
+                     % (width, rel, hit, exe, 100.0 * hit / exe))
+    lines.append("%-*s %8d %8d %5.1f%%"
+                 % (width, "TOTAL", tot_hit, tot_exe,
+                    100.0 * tot_hit / max(tot_exe, 1)))
+    return "\n".join(lines) + "\n"
+
+
+def aggregate_pct(merged: dict, repo: str, scope: str) -> float:
+    """Aggregate line-coverage % over one repo-relative directory —
+    the number ``run_tests.py`` gates (the ``veles/simd_tpu/obs/``
+    floor)."""
+    rows = _module_rows(merged, repo, scope)
+    hit = sum(r[1] for r in rows)
+    exe = sum(r[2] for r in rows)
+    return 100.0 * hit / exe if exe else 0.0

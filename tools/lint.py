@@ -123,8 +123,10 @@ def fallback_lint(files) -> int:
 
 # the only obs entry points compute modules may call — all pure
 # Python-dispatch helpers that cannot appear in a traced program
-# (span's context manager issues no jax ops)
-_OBS_APPROVED = {"record_decision", "count", "span"}
+# (span's context manager issues no jax ops; instrumented_jit wraps
+# jax.jit transparently and register_cache only stores a callable)
+_OBS_APPROVED = {"record_decision", "count", "span", "instrumented_jit",
+                 "register_cache", "LRUSet"}
 _OBS_PKG = "veles.simd_tpu.obs"
 # directories holding traced compute code the rule polices
 _OBS_RULE_DIRS = ("veles/simd_tpu/ops", "veles/simd_tpu/parallel")
@@ -136,6 +138,13 @@ _OBS_RULE_DIRS = ("veles/simd_tpu/ops", "veles/simd_tpu/parallel")
 # _OBS_RULE_DIRS, so this rule never fires on it
 _TIME_FORBIDDEN = {"time", "monotonic", "perf_counter",
                    "perf_counter_ns", "monotonic_ns"}
+
+# compile-site constructors compute modules must not call directly: a
+# compile that bypasses obs.instrumented_jit is a compile the resource
+# axis (per-route FLOPs/bytes/memory analytics) cannot see.  Same
+# alias-tracking style as the time.* rule; jax.jit stays available to
+# utils/, tools/, tests/, and the obs package itself.
+_JIT_FORBIDDEN = {"jit", "pjit"}
 
 
 def compute_module_lint(files) -> int:
@@ -161,6 +170,8 @@ def compute_module_lint(files) -> int:
             continue
         aliases = set()
         time_aliases = set()
+        jax_aliases = set()
+        jit_names = set()
         for node in ast.walk(tree):
             if isinstance(node, ast.Import):
                 for a in node.names:
@@ -174,6 +185,8 @@ def compute_module_lint(files) -> int:
                         # track the bound name so 'import time as _t'
                         # cannot dodge the wall-clock rule below
                         time_aliases.add(a.asname or "time")
+                    elif a.name == "jax":
+                        jax_aliases.add(a.asname or "jax")
             elif isinstance(node, ast.ImportFrom):
                 if node.module == "veles.simd_tpu":
                     for a in node.names:
@@ -187,6 +200,12 @@ def compute_module_lint(files) -> int:
                           f"({node.module}); use obs.record_decision / "
                           f"obs.count")
                     failures += 1
+                elif node.module == "jax":
+                    # 'from jax import jit as _j' cannot dodge the
+                    # compile-site rule either
+                    for a in node.names:
+                        if a.name in _JIT_FORBIDDEN:
+                            jit_names.add(a.asname or a.name)
         for node in ast.walk(tree):
             if (isinstance(node, ast.Attribute)
                     and isinstance(node.value, ast.Name)):
@@ -205,6 +224,31 @@ def compute_module_lint(files) -> int:
                           f"latency (utils/benchmark.py owns "
                           f"measurement)")
                     failures += 1
+                elif (node.value.id in jax_aliases
+                        and node.attr in _JIT_FORBIDDEN):
+                    print(f"{f}:{node.lineno}: direct "
+                          f"{node.value.id}.{node.attr} compile site "
+                          f"in a compute module — compile through "
+                          f"obs.instrumented_jit so the resource axis "
+                          f"sees it")
+                    failures += 1
+            elif (isinstance(node, ast.Name)
+                    and node.id in jit_names
+                    and isinstance(node.ctx, ast.Load)):
+                print(f"{f}:{node.lineno}: direct {node.id}(...) "
+                      f"compile site in a compute module — compile "
+                      f"through obs.instrumented_jit")
+                failures += 1
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "compile"
+                    and isinstance(node.func.value, ast.Call)
+                    and isinstance(node.func.value.func, ast.Attribute)
+                    and node.func.value.func.attr == "lower"):
+                print(f"{f}:{node.lineno}: direct .lower().compile() "
+                      f"in a compute module — compile through "
+                      f"obs.instrumented_jit")
+                failures += 1
             elif (isinstance(node, ast.ImportFrom)
                     and node.module == "time"):
                 names = [a.name for a in node.names

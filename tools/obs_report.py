@@ -60,6 +60,26 @@ def _latency_section(snap) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _roofline_lines(roof, indent="  ") -> list:
+    """Measured vs analytical roofline % for one bench entry."""
+    if not roof:
+        return []
+    lines = ["%sroofline: measured %.0f%% of the f32-%s bound "
+             "(%.1f TFLOP/s eff)"
+             % (indent, roof.get("pct_of_roofline", 0.0),
+                roof.get("precision", "?").upper(),
+                roof.get("tflops_effective", 0.0))]
+    ana = roof.get("analytical_pct_of_roofline")
+    if ana is not None:
+        lines.append(
+            "%sanalytical (%s, XLA flops=%.3g): %.0f%% — "
+            "disagreement %.0f%%"
+            % (indent, roof.get("analytical_route", "?"),
+               roof.get("xla_flops", 0.0), ana,
+               roof.get("disagreement_pct", 0.0)))
+    return lines
+
+
 def _render_bench_details(entries) -> str:
     """BENCH_DETAILS.json mode: one telemetry block per bench config."""
     lines = []
@@ -68,6 +88,7 @@ def _render_bench_details(entries) -> str:
             continue        # tail entry (skipped_stages bookkeeping)
         tel = e.get("telemetry")
         lines.append("=== %s ===" % e.get("metric", "(unnamed config)"))
+        lines += _roofline_lines(e.get("roofline"))
         if tel is None:
             lines.append("  (no telemetry recorded)")
             continue
@@ -84,6 +105,15 @@ def _render_bench_details(entries) -> str:
                 if k not in ("seq", "op", "decision"))
             lines.append("  decision: %-24s -> %-18s %s"
                          % (d.get("op"), d.get("decision"), extras))
+        if tel.get("resources"):
+            lines.append("  compiled-program resources:")
+            lines += export.render_resources(tel["resources"],
+                                             indent="    ")
+        caches = tel.get("caches") or {}
+        if any(isinstance(s, dict) and s.get("size")
+               for s in caches.values()):
+            lines.append("  compile caches:")
+            lines += export.render_caches(caches, indent="    ")
         spans = tel.get("spans") or {}
         if spans:
             lines.append("  dispatch latency (s):")

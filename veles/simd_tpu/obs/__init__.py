@@ -22,6 +22,20 @@ is the accounting layer for every dispatch-time decision:
   separately), bridge to ``jax.profiler.TraceAnnotation`` while an XLA
   trace is active, and export as Perfetto-loadable Chrome trace-event
   JSON via :func:`save_trace`;
+* **compiled-program resources — the resource axis** —
+  :mod:`~veles.simd_tpu.obs.resources`: every compile site in
+  ``ops/``/``parallel/`` goes through :func:`instrumented_jit`, which
+  harvests XLA's own ``cost_analysis()`` (FLOPs, bytes accessed) and
+  ``memory_analysis()`` (argument/output/temp/generated-code bytes)
+  per ``(op, route)``, derives arithmetic intensity and an attainable
+  roofline %, and snapshots every memoized compile cache through
+  :func:`caches`;
+* **a crash flight recorder** — :mod:`~veles.simd_tpu.obs.flightrec`:
+  an exception escaping a top-level dispatch span (or an explicit
+  :func:`dump_debug_bundle` call) atomically writes config, platform,
+  decision events, span ring, cache stats, and resource snapshots to
+  ``$VELES_SIMD_FLIGHT_DIR`` — the post-mortem that survives the
+  process;
 * **exporters** — :mod:`~veles.simd_tpu.obs.export`: lossless JSON
   snapshot, Prometheus text format (histograms as proper
   ``_bucket``/``_sum``/``_count`` series), and a human ``report()``
@@ -30,14 +44,20 @@ is the accounting layer for every dispatch-time decision:
 Contract with the compute layer (enforced by ``tools/lint.py``):
 
 * ops modules touch telemetry ONLY through :func:`record_decision`,
-  :func:`count`, and :func:`span`, and ONLY at the Python dispatch
-  layer — never inside traced/jitted code.  Telemetry on or off,
-  jaxprs and compiled artifacts are byte-identical
-  (``tests/test_obs.py`` pins this).
+  :func:`count`, :func:`span`, :func:`instrumented_jit`, and
+  :func:`register_cache`, and ONLY at the Python dispatch layer —
+  never inside traced/jitted code.  Telemetry on or off, jaxprs and
+  compiled artifacts are byte-identical (``tests/test_obs.py`` pins
+  this).  Raw ``jax.jit`` / ``.lower().compile()`` compile sites in
+  ``ops/``/``parallel/`` are a lint failure: compiles that bypass
+  :func:`instrumented_jit` are compiles the resource axis cannot see.
 * Off by default.  Enable with ``VELES_SIMD_TELEMETRY=1`` in the
   environment or :func:`enable` at runtime; when disabled every helper
   is a single attribute check, and when enabled the cost is one locked
-  dict increment per public call.
+  dict increment per public call — except :func:`instrumented_jit`
+  call sites, which additionally build an argument-geometry key and
+  probe the analysis memo per call while enabled (microseconds,
+  against dispatch work that costs tens).
 
 Usage::
 
@@ -48,6 +68,9 @@ Usage::
     obs.save("telemetry.json")          # snapshot for tools/obs_report.py
     obs.save_trace("trace.json")        # open in Perfetto
     text = obs.to_prometheus()          # scrape endpoint body
+    obs.resources()                     # per-(op, route) FLOPs/bytes/mem
+    obs.caches()                        # every compile cache, one view
+    obs.dump_debug_bundle()             # flight-recorder bundle on demand
 
 Scope note: this module answers *what was decided, how often, and how
 long the host-side dispatch took*; :mod:`veles.simd_tpu.utils.profiler`
@@ -58,15 +81,20 @@ separate layers.
 
 from __future__ import annotations
 
-import itertools
 import os
-import threading
 
 from veles.simd_tpu.obs import compile as _compile
 from veles.simd_tpu.obs import export as _export
+from veles.simd_tpu.obs import flightrec as _flightrec
+from veles.simd_tpu.obs import resources as _resources
 from veles.simd_tpu.obs import spans as _spans_mod
+from veles.simd_tpu.obs.atomic import atomic_write_text as _atomic_write
 from veles.simd_tpu.obs.events import EventLog
+from veles.simd_tpu.obs.lru import LRUSet
 from veles.simd_tpu.obs.registry import MetricsRegistry
+from veles.simd_tpu.obs.resources import (InstrumentedJit,
+                                          instrumented_jit,
+                                          register_cache)
 from veles.simd_tpu.obs.spans import SpanTracer
 
 __all__ = [
@@ -76,7 +104,10 @@ __all__ = [
     "to_json", "to_prometheus", "report", "save", "load",
     "save_trace", "trace_events",
     "install_compile_listeners",
-    "MetricsRegistry", "EventLog", "SpanTracer",
+    "instrumented_jit", "resources", "caches", "register_cache",
+    "dump_debug_bundle",
+    "MetricsRegistry", "EventLog", "SpanTracer", "InstrumentedJit",
+    "LRUSet",
 ]
 
 _TRUTHY = ("1", "true", "yes", "on")
@@ -84,9 +115,11 @@ _TRUTHY = ("1", "true", "yes", "on")
 _registry = MetricsRegistry()
 _events = EventLog()
 _spans = SpanTracer(_registry.observe)
+_spans.on_crash = _flightrec.maybe_record_crash
 _enabled = os.environ.get("VELES_SIMD_TELEMETRY",
                           "0").strip().lower() in _TRUTHY
 if _enabled:
+    _resources.set_active(True)
     # the env var is documented as equivalent to enable(): compile/cache
     # metrics must flow too.  Tolerate jax-free processes (the rest of
     # the telemetry layer works without an accelerator runtime).
@@ -114,6 +147,7 @@ def enable(compile_listeners: bool = True) -> None:
     """
     global _enabled
     _enabled = True
+    _resources.set_active(True)
     if compile_listeners:
         _compile.install()
 
@@ -123,19 +157,26 @@ def disable() -> None:
     works); use :func:`reset` to clear them."""
     global _enabled
     _enabled = False
+    _resources.set_active(False)
 
 
 def configure(max_events: int | None = None,
-              max_spans: int | None = None) -> None:
+              max_spans: int | None = None,
+              flight_dir: str | None = None) -> None:
     """Adjust telemetry limits.  ``max_events`` replaces the decision
     log with a fresh bound (history is cleared — resizing a ring buffer
     in place would silently reorder it); ``max_spans`` does the same
-    for the span trace buffer."""
+    for the span trace buffer.  ``flight_dir`` overrides
+    ``$VELES_SIMD_FLIGHT_DIR`` as the crash-bundle destination (pass
+    ``""`` to restore the environment lookup)."""
     global _events, _spans
     if max_events is not None:
         _events = EventLog(max_events)
     if max_spans is not None:
         _spans = SpanTracer(_registry.observe, max_spans)
+        _spans.on_crash = _flightrec.maybe_record_crash
+    if flight_dir is not None:
+        _flightrec.configure_flight_dir(flight_dir or None)
 
 
 def install_compile_listeners() -> bool:
@@ -217,22 +258,59 @@ def events() -> list:
 def snapshot() -> dict:
     """One JSON-native dict of everything: counters, gauges, histograms
     (including the ``span.*`` latency distributions), events, drop
-    counts, and the enabled flag.  The span *trace* (per-span start/
+    counts, per-``(op, route)`` compiled-program resources, cache
+    stats, and the enabled flag.  The span *trace* (per-span start/
     duration records) is exported separately by :func:`save_trace`."""
     snap = _registry.snapshot()
     snap["events"] = _events.events()
     snap["events_dropped"] = _events.dropped
     snap["spans_dropped"] = _spans.dropped
+    snap["resources"] = _resources.resources_snapshot()
+    snap["caches"] = _resources.caches_snapshot()
     snap["enabled"] = _enabled
     return snap
 
 
+def resources() -> list:
+    """Per-``(op, route)`` compiled-program analytics harvested by
+    :func:`instrumented_jit`: FLOPs, bytes accessed, arithmetic
+    intensity, attainable roofline %, and the argument/output/temp/
+    generated-code memory breakdown (see
+    :mod:`veles.simd_tpu.obs.resources`).
+
+    NB: this facade function shadows the ``obs.resources`` SUBMODULE
+    as a package attribute (deliberately — it completes the
+    ``events()``/``caches()``/``resources()`` snapshot family), and
+    the shadowing wins for from-imports AND dotted access after a
+    plain import alike; reach the module itself only via
+    ``sys.modules["veles.simd_tpu.obs.resources"]``."""
+    return _resources.resources_snapshot()
+
+
+def caches() -> dict:
+    """Unified snapshot of every registered memoized compile cache:
+    ``{name: {size, capacity, hits, misses, evictions, ...}}``.
+    Caches self-register via :func:`register_cache` (the batched
+    handle LRU, the pallas2d OOM-rejection LRU, the resource-analysis
+    memo, ...)."""
+    return _resources.caches_snapshot()
+
+
+def dump_debug_bundle(path: str | None = None,
+                      reason: str = "explicit",
+                      exc: BaseException | None = None) -> str:
+    """Atomically write a flight-recorder debug bundle NOW; returns the
+    written path (see :mod:`veles.simd_tpu.obs.flightrec`)."""
+    return _flightrec.dump_debug_bundle(path, reason, exc)
+
+
 def reset() -> None:
-    """Clear all metrics, events, and spans; the enabled flag is
-    untouched."""
+    """Clear all metrics, events, spans, and harvested resources; the
+    enabled flag is untouched."""
     _registry.reset()
     _events.reset()
     _spans.reset()
+    _resources.reset()
 
 
 def to_json(snap: dict | None = None, indent: int | None = 2) -> str:
@@ -248,32 +326,12 @@ def report(snap: dict | None = None, max_events: int = 20) -> str:
                           max_events)
 
 
-_TMP_SEQ = itertools.count()
-
-
-def _atomic_write(path: str, text: str) -> str:
-    """Write-temp-then-``os.replace`` so a crash mid-write (a wedged
-    bench run, an OOM-killed server) can never leave a truncated file
-    where ``tools/obs_report.py`` expects a snapshot.  The temp name is
-    unique per write (pid + thread + sequence), so concurrent saves to
-    the same path from different threads cannot collide on — or unlink
-    — each other's temp file; last ``os.replace`` wins."""
-    tmp = "%s.%d.%d.%d.tmp" % (path, os.getpid(),
-                               threading.get_ident(), next(_TMP_SEQ))
-    try:
-        with open(tmp, "w") as f:
-            f.write(text)
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):  # serialization failed mid-write
-            os.unlink(tmp)
-    return path
-
-
 def save(path: str, snap: dict | None = None) -> str:
     """Atomically write a JSON snapshot to ``path`` (read back with
     :func:`load` or pretty-printed by ``tools/obs_report.py``);
-    returns ``path``."""
+    returns ``path``.  Uses the shared temp+``os.replace`` writer
+    (:mod:`veles.simd_tpu.obs.atomic`) so a crash mid-write never
+    truncates an existing snapshot."""
     return _atomic_write(path, to_json(snap if snap is not None
                                        else snapshot()))
 

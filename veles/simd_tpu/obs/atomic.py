@@ -1,0 +1,43 @@
+"""Shared atomic file writer for every obs exporter.
+
+``obs.save`` (JSON snapshots), ``obs.save_trace`` (Chrome trace JSON)
+and the flight recorder (:mod:`veles.simd_tpu.obs.flightrec`) all write
+artifacts that other tools parse later — a crash mid-write (a wedged
+bench run, an OOM-killed server, the very exception a flight bundle is
+documenting) must never leave a truncated file where a consumer expects
+a complete one.  This module is the single home for the
+write-temp-then-``os.replace`` discipline the exporters used to
+duplicate per call site.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+
+__all__ = ["atomic_write_text"]
+
+_TMP_SEQ = itertools.count()
+
+
+def atomic_write_text(path: str, text: str) -> str:
+    """Write ``text`` to ``path`` atomically; returns ``path``.
+
+    The temp name is unique per write (pid + thread + sequence), so
+    concurrent saves to the same path from different threads cannot
+    collide on — or unlink — each other's temp file; last
+    ``os.replace`` wins.  If serialization already happened (``text``
+    is a complete string) the only failure modes left are filesystem
+    ones, and those leave the previous file intact.
+    """
+    tmp = "%s.%d.%d.%d.tmp" % (path, os.getpid(),
+                               threading.get_ident(), next(_TMP_SEQ))
+    try:
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):  # the write itself failed mid-flight
+            os.unlink(tmp)
+    return path

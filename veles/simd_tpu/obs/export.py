@@ -21,7 +21,8 @@ import re
 
 __all__ = ["to_json", "from_json", "to_prometheus", "parse_prometheus",
            "report", "flatten_counters", "histogram_quantile",
-           "histogram_quantiles", "span_summary", "PROMETHEUS_PREFIX"]
+           "histogram_quantiles", "span_summary", "render_resources",
+           "render_caches", "PROMETHEUS_PREFIX"]
 
 PROMETHEUS_PREFIX = "veles_simd_"
 
@@ -100,7 +101,51 @@ def to_prometheus(snapshot: dict) -> str:
             name = _prom_name(drop_key) + "_total"
             lines.append("# TYPE %s counter" % name)
             lines.append("%s %d" % (name, dv))
+    lines += _prometheus_resources(snapshot.get("resources", []))
+    lines += _prometheus_caches(snapshot.get("caches", {}))
     return "\n".join(lines) + "\n"
+
+
+# per-(op, route) resource fields exported as gauges (the latest
+# harvested geometry's numbers — Prometheus is for the current state,
+# history lives in the JSON snapshots bench.py archives)
+_RESOURCE_GAUGES = ("flops", "bytes_accessed", "arith_intensity",
+                    "attainable_pct_of_roofline", "peak_bytes",
+                    "argument_bytes", "output_bytes", "temp_bytes",
+                    "generated_code_bytes")
+_CACHE_GAUGES = ("size", "capacity", "hits", "misses", "evictions")
+
+
+def _prometheus_resources(entries) -> list:
+    lines = []
+    for field in _RESOURCE_GAUGES:
+        rows = [(e, e.get(field)) for e in entries
+                if isinstance(e.get(field), (int, float))]
+        if not rows:
+            continue
+        name = _prom_name("resource." + field)
+        lines.append("# TYPE %s gauge" % name)
+        for e, v in rows:
+            lines.append("%s%s %s" % (
+                name, _prom_labels({"op": e["op"], "route": e["route"]}),
+                repr(float(v))))
+    return lines
+
+
+def _prometheus_caches(caches: dict) -> list:
+    lines = []
+    for field in _CACHE_GAUGES:
+        rows = [(n, s.get(field)) for n, s in sorted(caches.items())
+                if isinstance(s, dict)
+                and isinstance(s.get(field), (int, float))]
+        if not rows:
+            continue
+        name = _prom_name("cache." + field)
+        lines.append("# TYPE %s gauge" % name)
+        for n, v in rows:
+            lines.append("%s%s %s" % (name, _prom_labels({"cache": n}),
+                                      repr(float(v))))
+    return lines
 
 
 def parse_prometheus(text: str) -> dict:
@@ -197,6 +242,62 @@ def flatten_counters(snapshot: dict) -> dict:
     return flat
 
 
+def _fmt_qty(v) -> str:
+    """Compact engineering format for FLOP/byte counts."""
+    if v is None:
+        return "-"
+    v = float(v)
+    for scale, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"),
+                          (1e3, "k")):
+        if abs(v) >= scale:
+            return "%.2f%s" % (v / scale, suffix)
+    return "%g" % v
+
+
+def render_resources(entries, indent="  ") -> list:
+    """Lines for a snapshot's per-(op, route) resource entries — the
+    shared renderer for :func:`report`, ``tools/obs_report.py``, and
+    bench-details mode."""
+    lines = []
+    for e in entries:
+        ai = e.get("arith_intensity")
+        pct = e.get("attainable_pct_of_roofline")
+        lines.append(
+            "%s%-28s flops=%-8s bytes=%-8s AI=%-7s%s" % (
+                indent, "%s/%s" % (e.get("op"), e.get("route")),
+                _fmt_qty(e.get("flops")),
+                _fmt_qty(e.get("bytes_accessed")),
+                "-" if ai is None else "%.1f" % ai,
+                "" if pct is None
+                else " roofline<=%.0f%%" % pct))
+        mem = [(k, e.get(k)) for k in ("argument_bytes", "output_bytes",
+                                       "temp_bytes",
+                                       "generated_code_bytes")]
+        if any(v is not None for _, v in mem):
+            lines.append("%s  mem: %s peak=%s" % (
+                indent,
+                " ".join("%s=%s" % (k.replace("_bytes", ""),
+                                    _fmt_qty(v)) for k, v in mem),
+                _fmt_qty(e.get("peak_bytes"))))
+    return lines
+
+
+def render_caches(caches: dict, indent="  ") -> list:
+    """Lines for a snapshot's unified cache view (shared renderer)."""
+    lines = []
+    for name, s in sorted(caches.items()):
+        if not isinstance(s, dict):
+            continue
+        cap = s.get("capacity")
+        lines.append(
+            "%s%-28s size=%s%s hits=%s misses=%s evictions=%s" % (
+                indent, name, s.get("size", "-"),
+                "" if cap is None else "/%s" % cap,
+                s.get("hits", "-"), s.get("misses", "-"),
+                s.get("evictions", "-")))
+    return lines
+
+
 def report(snapshot: dict, max_events: int = 20) -> str:
     """Human-readable table of a snapshot (newest events last)."""
     lines = ["== veles.simd_tpu telemetry =="]
@@ -231,6 +332,19 @@ def report(snapshot: dict, max_events: int = 20) -> str:
                     + _prom_labels(h["labels"]).replace('"', ""),
                     h["count"], mean, qs["p50"] or 0.0,
                     qs["p95"] or 0.0, qs["p99"] or 0.0))
+    if snapshot.get("resources"):
+        lines.append("")
+        lines.append("compiled-program resources (latest geometry per "
+                     "op/route; roofline<= is the attainable share "
+                     "of the MXU bound at this arithmetic "
+                     "intensity):")
+        lines += render_resources(snapshot["resources"])
+    caches = snapshot.get("caches") or {}
+    if any(isinstance(s, dict) and s.get("size") for s in
+           caches.values()):
+        lines.append("")
+        lines.append("compile caches:")
+        lines += render_caches(caches)
     events = snapshot.get("events", [])
     if events:
         lines.append("")

@@ -1,0 +1,196 @@
+"""Crash flight recorder: one atomic debug bundle when dispatch dies.
+
+A production signal service that throws deep inside a dispatch layer
+usually leaves nothing behind but a stack trace — the decision events,
+span timeline, cache state, and compiled-program resource numbers that
+would explain *why* are gone with the process.  This module freezes all
+of it to disk as one JSON bundle:
+
+* **on crash** — when an exception escapes a *top-level* ``obs.span``
+  (the dispatch layers are exactly the spans), the span exit hook calls
+  :func:`maybe_record_crash`, which writes a bundle if
+  ``$VELES_SIMD_FLIGHT_DIR`` (or ``obs.configure(flight_dir=...)``)
+  points somewhere.  Auto-capture is rate-limited
+  (:data:`MAX_AUTO_BUNDLES` per process) so an exception storm cannot
+  fill a disk, and the whole path is exception-proof — the recorder
+  must never replace the original error with its own.
+* **on demand** — :func:`dump_debug_bundle` writes the same bundle any
+  time (a health endpoint, a stuck-state investigation).
+
+The bundle carries: schema/reason/exception, library config, platform
+and device info, environment knobs, the full telemetry snapshot
+(counters, gauges, histograms, decision events, per-route resources,
+cache stats, compile metrics) and the span trace ring.  Writes go
+through the shared atomic writer (:mod:`veles.simd_tpu.obs.atomic`), so
+a bundle is either complete or absent — never torn.
+
+Cost discipline: with telemetry off, spans are the shared no-op and the
+recorder never runs; with telemetry on and no flight dir configured,
+the crash hook is one string check.  jax is only touched lazily for
+platform info, and its absence is tolerated (bundles work in jax-free
+processes).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+
+from veles.simd_tpu.obs.atomic import atomic_write_text
+
+__all__ = ["dump_debug_bundle", "maybe_record_crash", "flight_dir",
+           "configure_flight_dir", "auto_bundles_written",
+           "SCHEMA", "MAX_AUTO_BUNDLES", "FLIGHT_DIR_ENV"]
+
+SCHEMA = "veles-simd-flight-v1"
+FLIGHT_DIR_ENV = "VELES_SIMD_FLIGHT_DIR"
+# crash-triggered bundles per process: enough to catch a repeating
+# failure's first occurrences, bounded so a tight retry loop cannot
+# turn the recorder into a disk-filling amplifier
+MAX_AUTO_BUNDLES = 3
+
+_lock = threading.Lock()
+_configured_dir: str | None = None
+_auto_bundles = 0
+_seq = 0
+
+
+def configure_flight_dir(path: str | None) -> None:
+    """Runtime override of ``$VELES_SIMD_FLIGHT_DIR`` (None restores
+    the environment lookup).  Wired to ``obs.configure``."""
+    global _configured_dir
+    with _lock:
+        _configured_dir = str(path) if path is not None else None
+
+
+def flight_dir() -> str | None:
+    """Where crash bundles go: the configured dir, else the env var,
+    else None (auto-capture disarmed)."""
+    with _lock:
+        if _configured_dir is not None:
+            return _configured_dir
+    env = os.environ.get(FLIGHT_DIR_ENV, "").strip()
+    return env or None
+
+
+def auto_bundles_written() -> int:
+    with _lock:
+        return _auto_bundles
+
+
+def _reset_auto_count() -> None:
+    """Testing hook: re-arm the per-process auto-capture budget."""
+    global _auto_bundles
+    with _lock:
+        _auto_bundles = 0
+
+
+def _platform_info() -> dict:
+    info = {"python": sys.version.split()[0],
+            "pid": os.getpid(),
+            "argv": list(sys.argv)}
+    jax = sys.modules.get("jax")
+    if jax is None:
+        info["jax"] = None      # jax-free process: nothing to probe
+        return info
+    info["jax"] = getattr(jax, "__version__", "unknown")
+    try:
+        info["default_backend"] = jax.default_backend()
+        info["devices"] = [str(d) for d in jax.devices()]
+    except Exception as e:  # noqa: BLE001 — a wedged backend still dumps
+        info["devices_error"] = repr(e)
+    return info
+
+
+def _config_info() -> dict:
+    try:
+        import dataclasses
+
+        from veles.simd_tpu.utils.config import get_backend, get_config
+
+        return {"backend": get_backend().value,
+                **dataclasses.asdict(get_config())}
+    except Exception as e:  # noqa: BLE001
+        return {"error": repr(e)}
+
+
+def _env_info() -> dict:
+    return {k: v for k, v in sorted(os.environ.items())
+            if k.startswith("VELES_SIMD_")
+            or k in ("JAX_PLATFORMS", "XLA_FLAGS")}
+
+
+def build_bundle(reason: str, exc: BaseException | None = None) -> dict:
+    """Assemble the bundle dict (separated from writing for tests and
+    in-process consumers)."""
+    from veles.simd_tpu import obs
+
+    bundle = {
+        "schema": SCHEMA,
+        "reason": str(reason),
+        "written_unix": time.time(),
+        "exception": None,
+        "config": _config_info(),
+        "platform": _platform_info(),
+        "env": _env_info(),
+        "snapshot": obs.snapshot(),
+        "trace_events": obs.trace_events(),
+    }
+    if exc is not None:
+        bundle["exception"] = {
+            "type": type(exc).__name__,
+            "message": str(exc),
+            "traceback": traceback.format_exception(
+                type(exc), exc, exc.__traceback__),
+        }
+    return bundle
+
+
+def dump_debug_bundle(path: str | None = None, reason: str = "explicit",
+                      exc: BaseException | None = None) -> str:
+    """Atomically write a debug bundle; returns the written path.
+
+    ``path=None`` writes ``flight-<pid>-<seq>.json`` under
+    :func:`flight_dir` (falling back to the current directory when no
+    dir is configured — an explicit request always produces a file).
+    """
+    global _seq
+    if path is None:
+        base = flight_dir() or "."
+        with _lock:
+            _seq += 1
+            n = _seq
+        os.makedirs(base, exist_ok=True)
+        path = os.path.join(base, "flight-%d-%d.json" % (os.getpid(), n))
+    from veles.simd_tpu.obs import export
+
+    text = export.to_json(build_bundle(reason, exc))
+    return atomic_write_text(path, text)
+
+
+def maybe_record_crash(exc_type, exc) -> str | None:
+    """Span-exit crash hook: write a bundle when armed and under the
+    per-process budget; otherwise do nothing.  Never raises — the
+    original exception is already unwinding and must win."""
+    global _auto_bundles
+    try:
+        if flight_dir() is None:
+            return None
+        with _lock:
+            if _auto_bundles >= MAX_AUTO_BUNDLES:
+                return None
+            _auto_bundles += 1      # reserve a slot (concurrent crashes)
+        try:
+            return dump_debug_bundle(reason="span_crash", exc=exc)
+        except Exception:  # noqa: BLE001
+            # a failed WRITE (read-only dir, disk full) must not burn
+            # budget: release the slot so the recorder stays armed for
+            # when the filesystem recovers
+            with _lock:
+                _auto_bundles -= 1
+            return None
+    except Exception:  # noqa: BLE001
+        return None

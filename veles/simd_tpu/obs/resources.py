@@ -1,0 +1,420 @@
+"""The *resource axis* of obs: what the compiled programs cost.
+
+obs v1 counted *what was decided*, obs v2 measured *where the host time
+goes*; this module answers the third question TPU sizing actually runs
+on — per-route FLOPs, bytes moved, and peak-memory footprint — the
+numbers "Large Scale Distributed Linear Algebra With Tensor Processing
+Units" (arXiv:2112.09017) and the XLA-compilation pipeline papers treat
+as first-class when deciding whether a workload is compute- or
+bandwidth-bound.  Two pieces:
+
+* :func:`instrumented_jit` — the ONE compile helper compute modules use
+  instead of raw ``jax.jit`` (``tools/lint.py`` forbids the raw form in
+  ``ops/``/``parallel/``).  It behaves exactly like ``jax.jit`` — same
+  tracing, same executable, byte-identical jaxprs (the obs contract) —
+  but, while telemetry is enabled, the first eager call per argument
+  geometry also lowers the function ahead-of-time and harvests
+  ``compiled.cost_analysis()`` (flops, bytes accessed) and
+  ``compiled.memory_analysis()`` (argument/output/temp/generated-code
+  bytes) into the resource registry keyed by ``(op, route)``.  Calls
+  made under an outer trace skip the harvest (a tracer has no concrete
+  buffers to lower against); telemetry off costs one flag check.
+
+* a **cache-introspection registry** — every memoized compile cache in
+  the library (the batched handle LRU, the pallas2d OOM-rejection
+  LRU, this module's own analysis memo, ...) registers a snapshot
+  provider under a name, and :func:`caches_snapshot` returns one
+  unified ``{name: {size, capacity, hits, misses, evictions}}`` view,
+  exported through ``obs.snapshot()`` / Prometheus / ``report()``.
+
+Derived metrics per ``(op, route)``: arithmetic intensity (flops per
+byte accessed) and an *attainable* roofline % — the time-free fraction
+of the MXU bound the roofline model says this program could reach at
+that intensity (``min(1, AI · HBM_BW / bound)``).  (Distinct from the
+*achieved* analytical % ``bench.py`` derives from XLA flops over
+measured time and prints next to the hand-constant measured % — >15%
+disagreement there warns, the drift detector for
+``utils/benchmark.py``'s constants.)
+
+Like the rest of the obs storage layer this module imports neither jax
+nor numpy at module scope; jax is reached only inside the instrumented
+wrapper (whose callers imported it long before) and the roofline
+constants are read lazily from :mod:`veles.simd_tpu.utils.benchmark`.
+
+NB: the facade function ``obs.resources()`` shadows this module as a
+package attribute, and BOTH from-imports and dotted attribute access
+after a plain import resolve to the function (Python binds the package
+attribute first).  The only reliable handle on the module itself is
+``sys.modules["veles.simd_tpu.obs.resources"]``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+
+from veles.simd_tpu.obs.lru import LRUSet
+
+__all__ = [
+    "InstrumentedJit", "instrumented_jit", "ResourceRegistry",
+    "RESOURCES", "record_resources", "resources_snapshot",
+    "register_cache", "caches_snapshot", "set_active", "active",
+    "jsonify", "ANALYSIS_MEMO_MAXSIZE",
+]
+
+# toggled by obs.enable()/disable() (and the VELES_SIMD_TELEMETRY env
+# default) so the disabled wrapper path is one module-global check —
+# the same discipline as every other obs helper
+_ACTIVE = False
+
+# geometries already analyzed, keyed (op, route, abstract signature).
+# Bounded: a shape-churning service must not grow an unbounded memo —
+# an evicted geometry simply pays one more AOT lowering if it returns.
+ANALYSIS_MEMO_MAXSIZE = 1024
+
+
+def set_active(on: bool) -> None:
+    """Arm/disarm resource capture (wired to obs.enable/disable)."""
+    global _ACTIVE
+    _ACTIVE = bool(on)
+
+
+def active() -> bool:
+    return _ACTIVE
+
+
+def jsonify(value):
+    """Deep-convert ``value`` to JSON-native structures (tuples become
+    lists, dict keys become strings, exotic leaves become ``repr``) so
+    snapshots survive a JSON round trip *equal*, not merely similar."""
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    if isinstance(value, dict):
+        return {str(k): jsonify(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple, set, frozenset)):
+        seq = sorted(value, key=repr) if isinstance(
+            value, (set, frozenset)) else value
+        return [jsonify(v) for v in seq]
+    return repr(value)
+
+
+# the analysis memo: the shared bounded LRU membership set (also a
+# registered cache, so it shows up in :func:`caches_snapshot` like
+# every other compile cache)
+_ANALYZED = LRUSet(ANALYSIS_MEMO_MAXSIZE)
+
+# monotonic wrapper ids keying the memo (see InstrumentedJit._token)
+_INSTANCE_SEQ = itertools.count()
+
+
+class ResourceRegistry:
+    """Latest compiled-program analytics per ``(op, route)``.
+
+    One locked dict like :class:`~veles.simd_tpu.obs.registry.\
+MetricsRegistry`; an entry is replaced wholesale on each harvest (the
+    *latest* geometry's numbers are the ones a dashboard wants next to
+    the latest timings) while ``compiles`` accumulates.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict[tuple, dict] = {}
+
+    def record(self, op: str, route: str, entry: dict) -> None:
+        key = (str(op), str(route))
+        with self._lock:
+            prev = self._entries.get(key)
+            entry["analyses"] = (prev["analyses"] + 1) if prev else 1
+            self._entries[key] = entry
+
+    def snapshot(self) -> list:
+        """JSON-native list of entries sorted by (op, route)."""
+        with self._lock:
+            return [dict(e, op=op, route=route)
+                    for (op, route), e in sorted(self._entries.items())]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def __repr__(self):
+        # stable (no memory address, no live entry count): this
+        # singleton's repr lands in generated docs, which are
+        # committed and freshness-gated in a shared test process
+        return "ResourceRegistry()"
+
+
+RESOURCES = ResourceRegistry()
+
+
+def _roofline_attainable_pct(flops, bytes_accessed):
+    """Analytical roofline: the % of the f32 MXU bound attainable at
+    this program's arithmetic intensity, per the classic model
+    ``min(peak, AI * BW)``.  Constants come from
+    :mod:`veles.simd_tpu.utils.benchmark` (env-overridable per
+    hardware generation); returns None when they are unavailable or
+    the byte count is degenerate."""
+    if not flops or not bytes_accessed:
+        return None
+    try:
+        from veles.simd_tpu.utils.benchmark import (
+            hbm_bw_gbps, mxu_f32_bound_tflops)
+        from veles.simd_tpu.utils.config import get_config
+
+        bound = mxu_f32_bound_tflops(get_config().conv_precision)
+        bw = hbm_bw_gbps() * 1e9
+    except Exception:  # noqa: BLE001 — analytics must never raise
+        return None
+    ai = flops / bytes_accessed
+    attainable_tflops = min(bound, ai * bw / 1e12)
+    return 100.0 * attainable_tflops / bound
+
+
+def record_resources(op: str, route: str, shapes: str, cost,
+                     mem) -> None:
+    """Fold one AOT harvest into :data:`RESOURCES`.
+
+    ``cost`` is the (first module's) ``cost_analysis()`` dict or None;
+    ``mem`` the ``memory_analysis()`` stats object or None.  Missing
+    pieces record as None — a CPU backend without memory stats still
+    contributes its FLOP count.
+    """
+    flops = bytes_accessed = None
+    if cost:
+        flops = cost.get("flops")
+        bytes_accessed = cost.get("bytes accessed")
+    entry = {
+        "shapes": shapes,
+        "flops": flops,
+        "bytes_accessed": bytes_accessed,
+        "arith_intensity": (flops / bytes_accessed
+                            if flops and bytes_accessed else None),
+        "attainable_pct_of_roofline": _roofline_attainable_pct(
+            flops, bytes_accessed),
+        "transcendentals": cost.get("transcendentals") if cost else None,
+    }
+    peak = None
+    for attr, name in (("argument_size_in_bytes", "argument_bytes"),
+                       ("output_size_in_bytes", "output_bytes"),
+                       ("temp_size_in_bytes", "temp_bytes"),
+                       ("alias_size_in_bytes", "alias_bytes"),
+                       ("generated_code_size_in_bytes",
+                        "generated_code_bytes")):
+        v = getattr(mem, attr, None)
+        entry[name] = v
+        if v is not None and name != "alias_bytes":
+            peak = (peak or 0) + int(v)
+    if peak is not None and entry["alias_bytes"]:
+        # aliased (donated) buffers are counted in BOTH argument and
+        # output sizes; subtract one copy so peak reflects real
+        # residency (argument + output + temp + codegen - alias)
+        peak -= int(entry["alias_bytes"])
+    entry["peak_bytes"] = peak
+    RESOURCES.record(op, route, entry)
+
+
+def resources_snapshot() -> list:
+    return RESOURCES.snapshot()
+
+
+def reset() -> None:
+    """Clear harvested analytics and the analysis memo (obs.reset)."""
+    RESOURCES.reset()
+    _ANALYZED.reset()
+
+
+# ---------------------------------------------------------------------------
+# cache introspection
+# ---------------------------------------------------------------------------
+
+_cache_lock = threading.Lock()
+_cache_providers: dict[str, object] = {}
+
+
+def register_cache(name: str, provider) -> None:
+    """Register (or replace) a compile-cache snapshot provider.
+
+    ``provider`` is a zero-arg callable returning a JSON-native-ish
+    dict — conventionally ``{size, capacity, hits, misses,
+    evictions}``; extra keys ride along.  Used by every memoized
+    compile cache in the library so ``obs.caches()`` is the one place
+    to see them all.
+    """
+    with _cache_lock:
+        _cache_providers[str(name)] = provider
+
+
+def caches_snapshot() -> dict:
+    """``{name: stats}`` for every registered cache, JSON-native.  A
+    provider that raises reports its error instead of killing the
+    snapshot (introspection must never take the service down)."""
+    with _cache_lock:
+        providers = dict(_cache_providers)
+    out = {}
+    for name, provider in sorted(providers.items()):
+        try:
+            stats = dict(provider())
+        except Exception as e:  # noqa: BLE001
+            stats = {"error": repr(e)}
+        if "capacity" not in stats and "maxsize" in stats:
+            stats["capacity"] = stats["maxsize"]
+        out[name] = jsonify(stats)
+    return out
+
+
+register_cache("obs_analysis_memo", _ANALYZED.info)
+
+
+# ---------------------------------------------------------------------------
+# the instrumented compile helper
+# ---------------------------------------------------------------------------
+
+_tracer_cls = None            # jax.core.Tracer, resolved lazily once
+
+
+def _abstract_key(args, kwargs, statics_by_value: bool):
+    """Hashable geometry signature of a call, or None when the call is
+    not analyzable eagerly (tracer args, unhashable statics).
+
+    ``statics_by_value`` mirrors how ``jax.jit`` will key this call's
+    non-array leaves: a wrapper WITH ``static_argnames``/``argnums``
+    compiles per static VALUE, so values key the memo too; a wrapper
+    WITHOUT statics treats Python scalars as dynamic weak-typed
+    operands — one executable per TYPE — and keying the memo by value
+    there would re-run the AOT harvest (a full compile) for every
+    distinct gain/offset a caller sweeps through."""
+    global _tracer_cls
+    if _tracer_cls is None:
+        import jax
+
+        _tracer_cls = jax.core.Tracer
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, dict(kwargs)))
+    descr = []
+    for leaf in leaves:
+        if isinstance(leaf, _tracer_cls):
+            return None
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            descr.append(("a", tuple(shape), str(dtype)))
+        elif statics_by_value:
+            try:
+                hash(leaf)
+            except TypeError:
+                return None
+            descr.append(("s", leaf))
+        else:
+            descr.append(("t", type(leaf).__name__))
+    return (str(treedef), tuple(descr))
+
+
+class InstrumentedJit:
+    """``jax.jit`` plus first-call-per-geometry resource harvesting.
+
+    Transparent to execution: every call delegates to the wrapped
+    jitted function, so jaxprs and executables are byte-identical with
+    telemetry on or off (``tests/test_obs.py`` pins this).  The AOT
+    lowering used for analytics shares the function and abstract
+    signature with the real call — it observes, it does not reroute.
+    """
+
+    __slots__ = ("_jfn", "fn", "op", "route", "_statics_by_value",
+                 "_token", "__dict__")
+
+    def __init__(self, fn, op=None, route=None, **jit_kwargs):
+        import functools
+
+        import jax
+
+        self.fn = fn
+        self.op = str(op) if op is not None else getattr(
+            fn, "__name__", "anonymous").lstrip("_")
+        self.route = str(route) if route is not None else "default"
+        self._statics_by_value = bool(
+            jit_kwargs.get("static_argnames")
+            or jit_kwargs.get("static_argnums"))
+        # per-instance memo token: two wrappers sharing (op, route) —
+        # e.g. batched builder closures baking different up/down into
+        # the SAME-shaped call, or data_parallel around two user fns —
+        # compile different programs and must not collide in the memo
+        # (module-level decorated functions are singletons, so their
+        # token is stable for the process)
+        self._token = next(_INSTANCE_SEQ)
+        self._jfn = jax.jit(fn, **jit_kwargs)
+        try:
+            functools.update_wrapper(self, fn)
+        except Exception:  # noqa: BLE001 — builtins without __name__
+            pass
+
+    def __call__(self, *args, **kwargs):
+        if not _ACTIVE:
+            return self._jfn(*args, **kwargs)
+        key = _abstract_key(args, kwargs, self._statics_by_value)
+        if key is not None and not _ANALYZED.check_and_add(
+                (self._token, key)):
+            self._analyze(args, kwargs, key)
+        return self._jfn(*args, **kwargs)
+
+    def lower(self, *args, **kwargs):
+        """AOT lowering passthrough (``jax.jit(fn).lower``)."""
+        return self._jfn.lower(*args, **kwargs)
+
+    def _analyze(self, args, kwargs, key) -> None:
+        """Harvest cost/memory analytics for this geometry.  Best
+        effort by contract: a backend that cannot lower eagerly or
+        report stats must never break dispatch."""
+        try:
+            compiled = self._jfn.lower(*args, **kwargs).compile()
+        except Exception:  # noqa: BLE001
+            record_resources(self.op, self.route, _shapes_str(key),
+                             None, None)
+            return
+        cost = mem = None
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else None
+            cost = ca
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            mem = compiled.memory_analysis()
+        except Exception:  # noqa: BLE001
+            pass
+        record_resources(self.op, self.route, _shapes_str(key), cost,
+                         mem)
+
+    def __repr__(self):
+        return (f"InstrumentedJit({self.op!r}, route={self.route!r}, "
+                f"fn={getattr(self.fn, '__name__', self.fn)!r})")
+
+
+def _shapes_str(key) -> str:
+    """Compact human form of an abstract signature for snapshots."""
+    parts = []
+    for d in key[1]:
+        if d[0] == "a":
+            parts.append("%s[%s]" % (d[2],
+                                     ",".join(str(s) for s in d[1])))
+        else:
+            parts.append(repr(d[1]))
+    return " ".join(parts)
+
+
+def instrumented_jit(fn=None, *, op=None, route=None, **jit_kwargs):
+    """The library's compile site: ``jax.jit`` with resource capture.
+
+    Usable exactly like ``jax.jit`` — bare decorator, decorator
+    factory via ``functools.partial``, or direct call — plus ``op=`` /
+    ``route=`` labels that key the resource registry (defaults: the
+    function's name, route "default").  All other keyword arguments
+    (``static_argnames``, ``donate_argnums``, ...) pass through to
+    ``jax.jit`` untouched.
+    """
+    if fn is None:
+        def deco(f):
+            return InstrumentedJit(f, op=op, route=route, **jit_kwargs)
+        return deco
+    return InstrumentedJit(fn, op=op, route=route, **jit_kwargs)

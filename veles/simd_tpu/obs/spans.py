@@ -147,6 +147,16 @@ class Span:
         self._tracer._finish(self.name, self._start_ns, end_ns,
                              threading.get_ident(), self._parent,
                              self.attrs)
+        if exc_type is not None and self._parent is None:
+            # an exception escaping a TOP-LEVEL dispatch span is the
+            # flight recorder's trigger (obs/flightrec.py); the hook is
+            # best-effort and must never mask the unwinding exception
+            hook = self._tracer.on_crash
+            if hook is not None:
+                try:
+                    hook(exc_type, exc)
+                except Exception:  # noqa: BLE001
+                    pass
         return False
 
 
@@ -166,6 +176,10 @@ class SpanTracer:
             raise ValueError("max_spans must be >= 1")
         self.max_spans = max_spans
         self._observe = observe
+        # called as on_crash(exc_type, exc) when an exception escapes a
+        # top-level span; armed by the obs facade with the flight
+        # recorder's hook (None = one attribute check per crash)
+        self.on_crash = None
         self._lock = threading.Lock()
         # (name, start_ns, dur_ns, tid, phase, parent, attrs)
         self._spans = collections.deque(maxlen=max_spans)

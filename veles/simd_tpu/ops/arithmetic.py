@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from veles.simd_tpu import obs
 from veles.simd_tpu.utils.config import resolve_simd
 
 __all__ = [
@@ -51,82 +52,82 @@ _I32_MIN, _I32_MAX = -(2 ** 31), 2 ** 31 - 1
 # jitted XLA kernels (module-level so jax.jit caches by shape/dtype)
 # --------------------------------------------------------------------------
 
-@jax.jit
+@obs.instrumented_jit
 def _int16_to_float(x):
     return x.astype(jnp.float32)
 
 
-@jax.jit
+@obs.instrumented_jit
 def _float_to_int16(x):
     # trunc-toward-zero + saturate: mirrors cvttps+packs (arithmetic.h:262-270)
     return jnp.clip(jnp.trunc(x), _I16_MIN, _I16_MAX).astype(jnp.int16)
 
 
-@jax.jit
+@obs.instrumented_jit
 def _int32_to_float(x):
     return x.astype(jnp.float32)
 
 
-@jax.jit
+@obs.instrumented_jit
 def _float_to_int32(x):
     return jnp.clip(jnp.trunc(x), _I32_MIN, _I32_MAX).astype(jnp.int32)
 
 
-@jax.jit
+@obs.instrumented_jit
 def _int16_to_int32(x):
     return x.astype(jnp.int32)
 
 
-@jax.jit
+@obs.instrumented_jit
 def _int32_to_int16(x):
     return jnp.clip(x, _I16_MIN, _I16_MAX).astype(jnp.int16)
 
 
-@jax.jit
+@obs.instrumented_jit
 def _float16_to_float(bits):
     return jax.lax.bitcast_convert_type(bits, jnp.float16).astype(jnp.float32)
 
 
-@jax.jit
+@obs.instrumented_jit
 def _int16_multiply(a, b):
     return a.astype(jnp.int32) * b.astype(jnp.int32)
 
 
-@jax.jit
+@obs.instrumented_jit
 def _real_multiply(a, b):
     return a * b
 
 
-@functools.partial(jax.jit, static_argnames=())
+@functools.partial(obs.instrumented_jit, static_argnames=())
 def _real_multiply_scalar(x, value):
     return x * value
 
 
-@jax.jit
+@obs.instrumented_jit
 def _complex_multiply(a, b):
     ar, ai = a[..., 0::2], a[..., 1::2]
     br, bi = b[..., 0::2], b[..., 1::2]
     return _interleave(ar * br - ai * bi, ar * bi + br * ai)
 
 
-@jax.jit
+@obs.instrumented_jit
 def _complex_multiply_conjugate(a, b):
     ar, ai = a[..., 0::2], a[..., 1::2]
     br, bi = b[..., 0::2], -b[..., 1::2]
     return _interleave(ar * br - ai * bi, ar * bi + br * ai)
 
 
-@jax.jit
+@obs.instrumented_jit
 def _complex_conjugate(a):
     return _interleave(a[..., 0::2], -a[..., 1::2])
 
 
-@jax.jit
+@obs.instrumented_jit
 def _sum_elements(x):
     return jnp.sum(x, axis=-1)
 
 
-@jax.jit
+@obs.instrumented_jit
 def _add_to_all(x, value):
     return x + value
 

@@ -73,6 +73,10 @@ _cache: "collections.OrderedDict[tuple, BatchedHandle]" = \
     collections.OrderedDict()
 _cache_lock = threading.Lock()
 _cache_stats = {"hits": 0, "misses": 0, "evictions": 0}
+# unified cache introspection: handle_cache_info already has the
+# size/capacity/hits/misses/evictions shape obs.caches() wants
+obs.register_cache("batched_handle_lru",
+                   lambda: handle_cache_info())
 
 
 class BatchedHandle:
@@ -203,7 +207,9 @@ def batched_resample_poly(x, up: int, down: int, taps=None, simd=None,
         def run(xb, tapsj):
             return rs._resample_conv(xb, tapsj, up, down, out_len)
 
-        return jax.jit(run, donate_argnums=donation)
+        return obs.instrumented_jit(run, op="batched_resample_poly",
+                                    route="batched",
+                                    donate_argnums=donation)
 
     with obs.span("batched.resample_poly.dispatch"):
         handle = _get_handle(key, build)
@@ -247,7 +253,9 @@ def batched_sosfilt(sos, x, simd=None, donate: bool = False):
         def run(xb):
             return iir._sos_scan(xb, sos_rows)
 
-        return jax.jit(run, donate_argnums=donation)
+        return obs.instrumented_jit(run, op="batched_sosfilt",
+                                    route="batched",
+                                    donate_argnums=donation)
 
     with obs.span("batched.sosfilt.dispatch"):
         handle = _get_handle(key, build)
@@ -287,7 +295,9 @@ def batched_lfilter(b, a, x, simd=None, donate: bool = False):
         def run(xb):
             return iir._lfilter_xla(xb, b_key, a_key)
 
-        return jax.jit(run, donate_argnums=donation)
+        return obs.instrumented_jit(run, op="batched_lfilter",
+                                    route="batched",
+                                    donate_argnums=donation)
 
     with obs.span("batched.lfilter.dispatch"):
         handle = _get_handle(key, build)

@@ -195,7 +195,9 @@ def _use_pallas_direct(x_shape, k: int) -> bool:
     return k <= _pk.PALLAS_DIRECT_MAX_H and _pk.should_route(rows, row_elems)
 
 
-@functools.partial(jax.jit, static_argnames=("reverse",))
+@functools.partial(obs.instrumented_jit, op="convolve",
+                   route="direct_pallas",
+                   static_argnames=("reverse",))
 def _conv_direct_pallas(x, h, reverse=False):
     """Direct-form full convolution as a VPU shifted-MAC Pallas kernel
     (C=1 instance of the DWT/SWT filter-bank kernel)."""
@@ -217,7 +219,9 @@ def _direct(x, h, reverse=False):
     return _conv_direct(x, h, reverse=reverse)
 
 
-@functools.partial(jax.jit, static_argnames=("reverse",))
+@functools.partial(obs.instrumented_jit, op="convolve",
+                   route="direct_mxu",
+                   static_argnames=("reverse",))
 def _conv_direct(x, h, reverse=False):
     """Direct-form full convolution on the MXU.
 
@@ -237,7 +241,8 @@ def _conv_direct(x, h, reverse=False):
     return out.reshape(batch_shape + (n + k - 1,))
 
 
-@functools.partial(jax.jit, static_argnames=("m", "reverse"))
+@functools.partial(obs.instrumented_jit, op="convolve", route="fft",
+                   static_argnames=("m", "reverse"))
 def _conv_fft(x, h, m, reverse=False):
     """Full-FFT method (``src/convolve.c:289-326``) with real FFTs."""
     n = x.shape[-1]
@@ -259,6 +264,10 @@ def os_precision() -> str:
 # handful of distinct filter lengths, so a plain set suffices — the
 # shape-class LRU discipline lives in convolve2d where keys are 5-dim)
 _PALLAS_OS_REJECTED = set()
+obs.register_cache(
+    "pallas_os_rejected",
+    lambda: {"size": len(_PALLAS_OS_REJECTED), "capacity": None,
+             "keys": sorted(_PALLAS_OS_REJECTED)})
 
 
 def _use_pallas_os(h_length: int) -> bool:
@@ -277,7 +286,9 @@ overlap_save_pallas`): the XLA formulation materializes its frames
             and _pk.fits_vmem_os(h_length))
 
 
-@functools.partial(jax.jit, static_argnames=("reverse", "precision"))
+@functools.partial(obs.instrumented_jit, op="convolve",
+                   route="os_pallas",
+                   static_argnames=("reverse", "precision"))
 def _conv_os_pallas(x, h, reverse=False, precision=None):
     """Overlap-save as the fused Pallas kernel (same contract as
     :func:`_conv_os_matmul`; the step is the kernel's own
@@ -288,8 +299,9 @@ def _conv_os_pallas(x, h, reverse=False, precision=None):
                                    precision=precision or "highest")
 
 
-@functools.partial(jax.jit, static_argnames=("step", "reverse",
-                                             "precision"))
+@functools.partial(obs.instrumented_jit, op="convolve",
+                   route="os_matmul",
+                   static_argnames=("step", "reverse", "precision"))
 def _conv_os_matmul(x, h, step, reverse=False, precision=None):
     """Overlap-save with the per-block filter as one MXU matmul.
 
@@ -358,7 +370,9 @@ def _conv_os_matmul(x, h, step, reverse=False, precision=None):
     return y[..., :out_len].astype(jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("block_len", "reverse"))
+@functools.partial(obs.instrumented_jit, op="convolve",
+                   route="os_fft",
+                   static_argnames=("block_len", "reverse"))
 def _conv_overlap_save(x, h, block_len, reverse=False):
     """Overlap-save as a single batched-frames FFT (the long-filter path).
 

@@ -25,9 +25,7 @@ kernel, exactly like ``src/correlate.c:37-72`` in 1D.
 
 from __future__ import annotations
 
-import collections
 import functools
-import threading
 
 import jax
 import jax.numpy as jnp
@@ -121,7 +119,9 @@ def _use_pallas_direct2d(x_shape, k0: int, k1: int) -> bool:
             and _pk.fits_vmem2d(n0e * n1e, out_elems, k0 * k1))
 
 
-@functools.partial(jax.jit, static_argnames=("reverse",))
+@functools.partial(obs.instrumented_jit, op="convolve2d",
+                   route="direct_pallas",
+                   static_argnames=("reverse",))
 def _conv2d_direct_pallas(x, h, reverse=False):
     n0, n1 = x.shape[-2:]
     k0, k1 = h.shape[-2:]
@@ -131,7 +131,9 @@ def _conv2d_direct_pallas(x, h, reverse=False):
     return _pk.filter_2d_pallas(x_ext, kernel, n0 + k0 - 1, n1 + k1 - 1)
 
 
-@functools.partial(jax.jit, static_argnames=("reverse",))
+@functools.partial(obs.instrumented_jit, op="convolve2d",
+                   route="direct_mxu",
+                   static_argnames=("reverse",))
 def _conv2d_direct(x, h, reverse=False):
     n0, n1 = x.shape[-2:]
     k0, k1 = h.shape[-2:]
@@ -145,7 +147,9 @@ def _conv2d_direct(x, h, reverse=False):
     return out.reshape(x.shape[:-2] + (n0 + k0 - 1, n1 + k1 - 1))
 
 
-@functools.partial(jax.jit, static_argnames=("m0", "m1", "reverse"))
+@functools.partial(obs.instrumented_jit, op="convolve2d",
+                   route="fft",
+                   static_argnames=("m0", "m1", "reverse"))
 def _conv2d_fft(x, h, m0, m1, reverse=False):
     n0, n1 = x.shape[-2:]
     k0, k1 = h.shape[-2:]
@@ -164,40 +168,11 @@ def _check2d(x, h):
             f"{np.shape(h)}")
 
 
-class _LRUSet:
-    """Bounded membership cache with least-recently-used eviction —
-    set-compatible surface (``add`` / ``in`` / ``len``) so tests can
-    substitute a plain ``set``.  A membership HIT refreshes the entry:
-    shapes a workload keeps asking about stay resident while one-off
-    geometry churn ages out.  Locked: unlike the plain set it
-    replaces, ``move_to_end``/``popitem`` are not GIL-atomic as a
-    pair, and the motivating caller is a concurrent service.  (The
-    batched-op handle cache in :mod:`.batched` keeps its own
-    OrderedDict because it stores values + hit/miss stats; if a third
-    LRU appears, extract a shared utility.)"""
-
-    def __init__(self, maxsize: int):
-        self.maxsize = int(maxsize)
-        self._entries = collections.OrderedDict()
-        self._lock = threading.Lock()
-
-    def __contains__(self, key) -> bool:
-        with self._lock:
-            if key in self._entries:
-                self._entries.move_to_end(key)
-                return True
-            return False
-
-    def add(self, key) -> None:
-        with self._lock:
-            self._entries[key] = None
-            self._entries.move_to_end(key)
-            while len(self._entries) > self.maxsize:
-                self._entries.popitem(last=False)
-
-    def __len__(self) -> int:
-        with self._lock:
-            return len(self._entries)
+# the shared bounded LRU membership set (obs.lru.LRUSet, re-exported
+# through the facade so compute modules need no internals import):
+# locked, recency-refreshed, hit/miss/eviction-counted.  Kept under
+# the historical local name — tests substitute plain sets through it.
+_LRUSet = obs.LRUSet
 
 
 # Shape classes the compiled 2D kernel failed to compile for (Mosaic
@@ -211,6 +186,14 @@ class _LRUSet:
 # one more failed compile if it ever comes back).
 _PALLAS2D_OOM_MAXSIZE = 256
 _PALLAS2D_OOM_REJECTED = _LRUSet(_PALLAS2D_OOM_MAXSIZE)
+# tests may substitute a plain set for _PALLAS2D_OOM_REJECTED; the
+# provider snapshots whatever is bound at call time
+obs.register_cache(
+    "pallas2d_oom_rejected",
+    lambda: (_PALLAS2D_OOM_REJECTED.info()
+             if hasattr(_PALLAS2D_OOM_REJECTED, "info")
+             else {"size": len(_PALLAS2D_OOM_REJECTED),
+                   "capacity": _PALLAS2D_OOM_MAXSIZE}))
 
 # Scoped-stack model used ONLY for calls traced under an outer jit,
 # where the Mosaic compile error surfaces at the OUTER compile and the
@@ -284,9 +267,23 @@ def _run2d_xla(x, h, reverse, algorithm, auto):
                 > _TRACED_SCOPED_BUDGET_BYTES)
             if not use_pallas:
                 # fires once per trace, at the Python dispatch
-                # layer — the jaxpr is untouched
+                # layer — the jaxpr is untouched.  The decision
+                # event carries the budget-model geometry so a
+                # future hardware recalibration of
+                # _TRACED_SCOPED_BUDGET_BYTES has a signal to mine
+                # (ADVICE.md round-5 item 4)
                 obs.count("pallas2d_demotion",
                           reason="traced_small_tile_model")
+                obs.record_decision(
+                    "convolve2d", "traced_fft_demotion",
+                    rows=int(np.prod(x.shape[:-2]))
+                    if x.ndim > 2 else 1,
+                    n0=int(x.shape[-2]), n1=int(x.shape[-1]),
+                    k0=int(k0), k1=int(k1),
+                    out_tile_bytes=int(out_tile),
+                    scoped_bytes=int(k0 * k1 * out_tile),
+                    budget_bytes=_TRACED_SCOPED_BUDGET_BYTES,
+                    auto=bool(auto))
                 if auto:
                     algorithm = "fft"
         if use_pallas:

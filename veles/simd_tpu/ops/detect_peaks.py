@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from veles.simd_tpu import obs
 from veles.simd_tpu.utils.config import resolve_simd
 
 __all__ = ["ExtremumType", "detect_peaks", "detect_peaks_na",
@@ -46,7 +47,7 @@ class ExtremumType(enum.IntFlag):
     BOTH = 3
 
 
-@functools.partial(jax.jit, static_argnames=("type",))
+@functools.partial(obs.instrumented_jit, static_argnames=("type",))
 def _peak_mask(data, type):
     """Boolean mask over the full signal (interior-only can be True)."""
     prev = data[..., :-2]
@@ -109,7 +110,7 @@ def _compact_topk(mask, data, max_peaks):
     return positions, values, count
 
 
-@functools.partial(jax.jit, static_argnames=("type", "max_peaks"))
+@functools.partial(obs.instrumented_jit, static_argnames=("type", "max_peaks"))
 def _peaks_fixed(data, type, max_peaks):
     mask = _peak_mask(data, type)
     n = data.shape[-1]
@@ -280,12 +281,12 @@ def _prom_core(x):
     return mins, lspan, rspan, x - jnp.maximum(lmin, rmin)
 
 
-@jax.jit
+@obs.instrumented_jit
 def _prominences_xla(x):
     return _prom_core(x)[3]
 
 
-@jax.jit
+@obs.instrumented_jit
 def _prom_spans_xla(x):
     """(prom, lspan, rspan) for every index — spans bound the saddle
     intervals so the host can recover scipy's base positions."""
@@ -370,7 +371,7 @@ def peak_prominences_na(x, peaks):
     return _prominences_bases_na(x, peaks)[0]
 
 
-@functools.partial(jax.jit, static_argnames=("rel_height",))
+@functools.partial(obs.instrumented_jit, static_argnames=("rel_height",))
 def _widths_xla(x, rel_height):
     """(widths, h_eval, left_ip, right_ip, prom, lspan, rspan) for
     EVERY index treated as a peak (garbage at non-peaks — callers

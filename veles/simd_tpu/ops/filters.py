@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from veles.simd_tpu import obs
 from veles.simd_tpu.utils.config import resolve_simd
 
 __all__ = [
@@ -150,7 +151,7 @@ def _apply_rank_network(lanes, rank):
     return jnp.where(rank < n_nonnan, lanes[rank], jnp.nan)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "rank"))
+@functools.partial(obs.instrumented_jit, static_argnames=("k", "rank"))
 def _rank_filter_xla(x, k, rank):
     if k > _RANK_NETWORK_MAX_K:
         win = _window_view_1d(x, k, jnp)
@@ -209,7 +210,7 @@ def _window_view_2d(img, kh, kw, xp):
     return win.reshape(win.shape[:-2] + (kh * kw,))
 
 
-@functools.partial(jax.jit, static_argnames=("kh", "kw"))
+@functools.partial(obs.instrumented_jit, static_argnames=("kh", "kw"))
 def _medfilt2d_xla(img, kh, kw):
     k = kh * kw
     if k > _RANK_NETWORK_MAX_K:
@@ -568,7 +569,7 @@ def _wiener_core(x, k, noise, xp):
     return mean + excess / xp.maximum(denom, 1e-30) * (x - mean)
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
+@functools.partial(obs.instrumented_jit, static_argnames=("k",))
 def _wiener_xla(x, k, noise):
     return _wiener_core(x, k, noise, jnp)
 

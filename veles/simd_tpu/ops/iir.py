@@ -966,7 +966,8 @@ def _sos_scan(x, sos_rows, zi_rows=None, want_zf=False):
     return x
 
 
-@functools.partial(jax.jit, static_argnames=("sos_key", "want_zf"))
+@functools.partial(obs.instrumented_jit,
+                   static_argnames=("sos_key", "want_zf"))
 def _sosfilt_xla(x, sos_key, zi, want_zf=False):
     sos_rows = np.asarray(sos_key, np.float32)
     # zi may carry leading batch dims: [..., n_sections, 2]
@@ -1208,7 +1209,7 @@ def lfilter_zi(b, a) -> np.ndarray:
     return zi
 
 
-@functools.partial(jax.jit, static_argnames=("b_key", "a_key"))
+@functools.partial(obs.instrumented_jit, static_argnames=("b_key", "a_key"))
 def _lfilter_xla(x, b_key, a_key):
     b = np.asarray(b_key, np.float32)
     a = np.asarray(a_key, np.float32)

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from veles.simd_tpu import obs
 from veles.simd_tpu.utils.config import resolve_simd
 
 __all__ = ["sin_psv", "cos_psv", "log_psv", "exp_psv", "pow_psv", "sqrt_psv"]
@@ -63,13 +64,14 @@ def _log_f32(x):
 
 
 _XLA = {
-    "sin": jax.jit(jnp.sin),
-    "cos": jax.jit(jnp.cos),
-    "log": jax.jit(_log_f32),
-    "exp": jax.jit(jnp.exp),
-    "sqrt": jax.jit(jnp.sqrt),
+    "sin": obs.instrumented_jit(jnp.sin, op="mathfun", route="sin"),
+    "cos": obs.instrumented_jit(jnp.cos, op="mathfun", route="cos"),
+    "log": obs.instrumented_jit(_log_f32, op="mathfun", route="log"),
+    "exp": obs.instrumented_jit(jnp.exp, op="mathfun", route="exp"),
+    "sqrt": obs.instrumented_jit(jnp.sqrt, op="mathfun",
+                                 route="sqrt"),
 }
-_POW = jax.jit(jnp.power)
+_POW = obs.instrumented_jit(jnp.power, op="mathfun", route="pow")
 
 _NA = {"sin": np.sin, "cos": np.cos, "log": np.log, "exp": np.exp,
        "sqrt": np.sqrt}

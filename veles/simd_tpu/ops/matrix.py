@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from veles.simd_tpu import obs
 from veles.simd_tpu.utils.config import get_config, resolve_simd
 
 __all__ = [
@@ -40,17 +41,17 @@ __all__ = [
 ]
 
 
-@jax.jit
+@obs.instrumented_jit
 def _add(a, b):
     return a + b
 
 
-@jax.jit
+@obs.instrumented_jit
 def _sub(a, b):
     return a - b
 
 
-@functools.partial(jax.jit, static_argnames=("fast",))
+@functools.partial(obs.instrumented_jit, static_argnames=("fast",))
 def _matmul(a, b, fast=False):
     if fast:
         return jnp.matmul(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
@@ -58,7 +59,7 @@ def _matmul(a, b, fast=False):
     return jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
 
 
-@functools.partial(jax.jit, static_argnames=("fast",))
+@functools.partial(obs.instrumented_jit, static_argnames=("fast",))
 def _matmul_t(a, bt, fast=False):
     # batched "[..., h1, w] @ [..., h2, w]^T" — contract the last dims
     if fast:
@@ -69,7 +70,7 @@ def _matmul_t(a, bt, fast=False):
                       precision=jax.lax.Precision.HIGHEST)
 
 
-@jax.jit
+@obs.instrumented_jit
 def _matvec(m, v):
     return jnp.dot(m, v, precision=jax.lax.Precision.HIGHEST)
 

@@ -36,7 +36,7 @@ __all__ = [
 ]
 
 
-@jax.jit
+@obs.instrumented_jit
 def _normalize2d(src):
     v = src.astype(jnp.float32)
     mn = jnp.min(v, axis=(-2, -1), keepdims=True)
@@ -49,7 +49,7 @@ def _normalize2d(src):
     return jnp.where(mx == mn, jnp.zeros_like(out), out)
 
 
-@jax.jit
+@obs.instrumented_jit
 def _normalize2d_minmax(mn, mx, src):
     v = src.astype(jnp.float32)
     mn = jnp.asarray(mn, jnp.float32)
@@ -62,12 +62,12 @@ def _normalize2d_minmax(mn, mx, src):
     return jnp.where(mx == mn, jnp.zeros_like(out), out)
 
 
-@jax.jit
+@obs.instrumented_jit
 def _minmax2d(src):
     return (jnp.min(src, axis=(-2, -1)), jnp.max(src, axis=(-2, -1)))
 
 
-@jax.jit
+@obs.instrumented_jit
 def _minmax1d(src):
     return (jnp.min(src, axis=-1), jnp.max(src, axis=-1))
 

@@ -60,6 +60,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from veles.simd_tpu import obs
 from veles.simd_tpu.utils.config import on_tpu
 
 __all__ = ["filter_bank_pallas", "filter_2d_pallas",
@@ -247,7 +248,7 @@ def _fb_kernel(*refs, tap_counts, dilation, n_out, stacked=False):
 
 
 @functools.partial(
-    jax.jit,
+    obs.instrumented_jit, op="pallas", route="filter_bank",
     static_argnames=("tap_counts", "dilation", "n_out", "interpret"))
 def _fb_call(phases, taps, tap_counts, dilation, n_out, interpret):
     n_rows = phases[0].shape[0]
@@ -327,7 +328,8 @@ def _cb_kernel(*refs, plans, n_phases, n_out):
             first = False
 
 
-@functools.partial(jax.jit,
+@functools.partial(obs.instrumented_jit, op="pallas",
+                   route="cascade_bank",
                    static_argnames=("plans", "n_out", "interpret"))
 def _cb_call(phases, taps, plans, n_out, interpret):
     n_rows = phases[0].shape[0]
@@ -456,8 +458,10 @@ def _f2d_kernel(h_ref, x_ref, o_ref, *, k0, k1, n_out0, n_out1):
             first = False
 
 
-@functools.partial(jax.jit, static_argnames=("n_out0", "n_out1",
-                                             "interpret"))
+@functools.partial(obs.instrumented_jit, op="pallas",
+                   route="filter_2d",
+                   static_argnames=("n_out0", "n_out1",
+                                    "interpret"))
 def _f2d_call(x3d, kernel2d, n_out0, n_out1, interpret):
     n_imgs, n0e, n1e = x3d.shape
     k0, k1 = kernel2d.shape
@@ -614,8 +618,10 @@ def _os_kernel(mbt_ref, x_ref, o_ref, w_ref, carry_ref, *, n_j, rows,
     carry_ref[...] = x_ref[0, rows - jb:, :]
 
 
-@functools.partial(jax.jit, static_argnames=("n_j", "rows", "precision",
-                                             "interpret"))
+@functools.partial(obs.instrumented_jit, op="pallas",
+                   route="overlap_save",
+                   static_argnames=("n_j", "rows", "precision",
+                                    "interpret"))
 def _os_call(x3d, taps, n_j, rows, precision, interpret):
     B, n_rows_pad, s = x3d.shape
     k = taps.shape[-1]

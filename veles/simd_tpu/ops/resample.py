@@ -99,7 +99,7 @@ def _normalize_resample_args(n, up, down, taps):
     return up, down, taps
 
 
-@functools.partial(jax.jit,
+@functools.partial(obs.instrumented_jit,
                    static_argnames=("up", "down", "out_len", "pad"))
 def _resample_conv(x, taps, up, down, out_len, pad=None):
     """The polyphase core: ONE dilated/strided correlation.
@@ -295,7 +295,7 @@ def decimate(x, factor: int, taps=None, ftype: str = "fir",
     return y[..., ::factor]
 
 
-@functools.partial(jax.jit, static_argnames=("num",))
+@functools.partial(obs.instrumented_jit, static_argnames=("num",))
 def _resample_fourier_xla(x, num):
     n = x.shape[-1]
     spec = jnp.fft.rfft(x, axis=-1)

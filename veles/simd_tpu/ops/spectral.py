@@ -163,7 +163,8 @@ def _take_frames(x, frame_length, hop):
     return jax.lax.slice_in_dim(inter, 0, frames, axis=-2)
 
 
-@functools.partial(jax.jit, static_argnames=("frame_length", "hop"))
+@functools.partial(obs.instrumented_jit,
+                   static_argnames=("frame_length", "hop"))
 def _stft_xla(x, window, frame_length, hop):
     frames = _take_frames(x, frame_length, hop)
     return jnp.fft.rfft(frames * window, axis=-1)
@@ -245,7 +246,8 @@ def _overlap_add(frames, n, frame_length, hop):
     return total
 
 
-@functools.partial(jax.jit, static_argnames=("n", "frame_length", "hop"))
+@functools.partial(obs.instrumented_jit,
+                   static_argnames=("n", "frame_length", "hop"))
 def _istft_xla(spec, window, env_inv, n, frame_length, hop):
     frames = jnp.fft.irfft(spec, frame_length, axis=-1) * window
     return _overlap_add(frames, n, frame_length, hop) * env_inv
@@ -328,7 +330,7 @@ def _analytic_multiplier(n: int) -> np.ndarray:
     return h
 
 
-@jax.jit
+@obs.instrumented_jit
 def _hilbert_xla(x, mult):
     return jnp.fft.ifft(jnp.fft.fft(x, axis=-1) * mult, axis=-1)
 
@@ -381,7 +383,7 @@ def _morlet_hat(scales, n, w0):
     return hat  # [S, n] float64
 
 
-@jax.jit
+@obs.instrumented_jit
 def _cwt_xla(x, hat):
     spec = jnp.fft.fft(x, axis=-1)
     return jnp.fft.ifft(spec[..., None, :] * hat, axis=-1)
@@ -677,7 +679,7 @@ def _czt_constants(n, m, w, a):
             post.astype(np.complex64), nfft)
 
 
-@functools.partial(jax.jit, static_argnames=("m", "nfft"))
+@functools.partial(obs.instrumented_jit, static_argnames=("m", "nfft"))
 def _czt_xla(x, pre, kern_f, post, m, nfft):
     n = x.shape[-1]
     y = x.astype(jnp.complex64) * pre
@@ -806,7 +808,7 @@ def _check_lombscargle_args(t, x, freqs, weights=None):
     return t, x, freqs, weights
 
 
-@jax.jit
+@obs.instrumented_jit
 def _lombscargle_xla(t, x, freqs, w):
     # [m, n] phase grids: the whole periodogram is a handful of
     # elementwise trig ops + reductions over the sample axis — dense

@@ -148,8 +148,9 @@ def _extend(x, ext, ext_len, xp):
 # jitted XLA kernels
 # --------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("ext", "stride", "dilation",
-                                             "out_len"))
+@functools.partial(obs.instrumented_jit,
+                   static_argnames=("ext", "stride", "dilation",
+                                    "out_len"))
 def _filter_bank(x, hi, lo, ext, stride, dilation, out_len):
     """Shared DWT/SWT kernel: extend, then 2-channel strided/dilated
     cross-correlation.  DWT: stride=2, dilation=1.  SWT: stride=1,
@@ -186,9 +187,9 @@ def _use_pallas(src_shape, order, dilation, stride) -> bool:
     return _pk.should_route(rows, row_elems)
 
 
-@functools.partial(jax.jit, static_argnames=("type", "order", "ext",
-                                             "stride", "dilation",
-                                             "out_len"))
+@functools.partial(obs.instrumented_jit,
+                   static_argnames=("type", "order", "ext",
+                                    "stride", "dilation", "out_len"))
 def _filter_bank_pallas(x, type, order, ext, stride, dilation, out_len):
     """DWT/SWT via the Pallas shifted-MAC kernel.  Tap values are runtime
     SMEM data; (type, order) is static here only because the coefficient
@@ -402,7 +403,8 @@ def _use_fused_cascade(src_shape, order, ext, levels) -> bool:
     return _pk.should_route(rows, row_elems)
 
 
-@functools.partial(jax.jit, static_argnames=("type", "order", "levels"))
+@functools.partial(obs.instrumented_jit,
+                   static_argnames=("type", "order", "levels"))
 def _fused_cascade(src, type, order, levels):
     """The whole PERIODIC DWT cascade in one Pallas pass (see the
     routing note above): returns ``(hi_1, ..., hi_L, lo_L)``."""
@@ -560,7 +562,7 @@ def _synth_conv(hi_band, lo_band, fh, fl, lhs_dil, rhs_dil, out_len, xp):
     return out.reshape(batch_shape + (out_len,))
 
 
-@functools.partial(jax.jit, static_argnames=("type", "order"))
+@functools.partial(obs.instrumented_jit, static_argnames=("type", "order"))
 def _dwt_synth(hi_band, lo_band, type, order):
     hi_f, lo_f = _filters(type, order)
     out = _synth_conv(hi_band, lo_band, jnp.asarray(hi_f), jnp.asarray(lo_f),
@@ -568,7 +570,8 @@ def _dwt_synth(hi_band, lo_band, type, order):
     return (out / _c2(lo_f)).astype(jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("type", "order", "level"))
+@functools.partial(obs.instrumented_jit,
+                   static_argnames=("type", "order", "level"))
 def _swt_synth(hi_band, lo_band, type, order, level):
     hi_f, lo_f = _filters(type, order)
     out = _synth_conv(hi_band, lo_band, jnp.asarray(hi_f), jnp.asarray(lo_f),
@@ -827,8 +830,9 @@ def _synth_ext_device(hi_band, lo_band, type, order, level, ext, stride):
     return x.at[..., n - L:].set(x_j[..., L:]).astype(jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("type", "order", "stride",
-                                             "dil", "n"))
+@functools.partial(obs.instrumented_jit,
+                   static_argnames=("type", "order", "stride",
+                                    "dil", "n"))
 def _synth_conv_jit(hi_band, lo_band, type, order, stride, dil, n):
     hi_f, lo_f = _filters(type, order)
     return _synth_conv(hi_band, lo_band, jnp.asarray(hi_f),

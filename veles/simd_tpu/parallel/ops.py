@@ -55,6 +55,27 @@ __all__ = ["sharded_convolve", "sharded_convolve_ring",
            "halo_exchange_left", "halo_exchange_right"]
 
 
+
+def _instrumented(op: str, run_fn):
+    """Route one shard_map program through the instrumented compile
+    helper (:func:`veles.simd_tpu.obs.instrumented_jit`) so sharded
+    executables land in the resource axis — per-(op, route) FLOPs,
+    bytes moved, and memory breakdown — like every single-chip compile
+    site.  The wrapper is transparent (jit of a shard_map program is
+    the standard SPMD form); with telemetry off it costs one flag
+    check per call.
+
+    KNOWN COST, inherited not introduced: every sharded_* entry point
+    builds its ``_run`` closure per call, so jax's identity-keyed
+    caches retrace per call — measured on the 8-device CPU mesh the
+    eager shard_map form paid ~640 ms/call and this jitted form
+    ~140 ms/call (the jit path dispatches cheaper after tracing).
+    The real fix is a geometry-keyed compiled-handle LRU like
+    ``ops/batched.py`` — a structural refactor of every closure's
+    captures, deliberately left for its own PR."""
+    return obs.instrumented_jit(run_fn, op=op, route="shard_map")
+
+
 def halo_exchange_left(x_local, halo_len: int, axis_name: str,
                        periodic: bool = False):
     """Bring the last ``halo_len`` samples of the left neighbour's shard.
@@ -158,7 +179,8 @@ def sharded_convolve(x, h, mesh: Mesh, axis: str = "sp"):
             x_ext = jnp.concatenate([halo, x_local], axis=-1)
             return _local_block_conv(x_ext, h_full)
 
-        return _run(x_pad, h)[..., :out_len]
+        return _instrumented("sharded_convolve",
+                             _run)(x_pad, h)[..., :out_len]
 
 
 def sharded_convolve_ring(x, h, mesh: Mesh, axis: str = "sp",
@@ -236,7 +258,8 @@ def sharded_convolve_ring(x, h, mesh: Mesh, axis: str = "sp",
                 block = jax.lax.ppermute(block, axis, perm)
         return y
 
-    out = _run(x_pad, h_pp)[..., :out_len]
+    out = _instrumented("sharded_convolve_ring",
+                        _run)(x_pad, h_pp)[..., :out_len]
     if batch_pad:
         out = out[:x.shape[0]]
     return out
@@ -310,7 +333,8 @@ def sharded_convolve_batch(x, h, mesh: Mesh, batch_axis: str = "dp",
         x_ext = jnp.concatenate([halo, x_local], axis=-1)
         return _local_block_conv(x_ext, h_full)
 
-    return _run(x_pad, h)[:batch, :out_len]
+    return _instrumented("sharded_convolve_batch",
+                         _run)(x_pad, h)[:batch, :out_len]
 
 
 def sharded_convolve2d(x, h, mesh: Mesh, axes=("dp", "sp")):
@@ -380,7 +404,8 @@ def sharded_convolve2d(x, h, mesh: Mesh, axes=("dp", "sp")):
             full, (k0 - 1, k1 - 1),
             (k0 - 1 + x_local.shape[-2], k1 - 1 + x_local.shape[-1]))
 
-    return _run(x_pad, h)[:out0, :out1]
+    return _instrumented("sharded_convolve2d",
+                         _run)(x_pad, h)[:out0, :out1]
 
 
 def sharded_convolve2d_ring(x, h, mesh: Mesh, axes=("dp", "sp")):
@@ -447,7 +472,8 @@ def sharded_convolve2d_ring(x, h, mesh: Mesh, axes=("dp", "sp")):
                 row = jax.lax.ppermute(row, a0, perm0)
         return y
 
-    return _run(x_pad, h_pp)[:out0, :out1]
+    return _instrumented("sharded_convolve2d_ring",
+                         _run)(x_pad, h_pp)[:out0, :out1]
 
 
 def _ring_tile_conv2d(tile, seg):
@@ -902,7 +928,7 @@ def sharded_matmul(a, b, mesh: Mesh, axis: str = "tp"):
                               precision=jax.lax.Precision.HIGHEST)
             return jax.lax.psum(partial, axis)
 
-        return _run(a, b)
+        return _instrumented("sharded_matmul", _run)(a, b)
 
 
 def _check_stft_sharding(n, frame_length, hop, n_shards):
@@ -972,7 +998,7 @@ def sharded_stft(x, frame_length: int, hop: int, mesh: Mesh,
         return jnp.fft.rfft(frames, axis=-1)
 
     with obs.span("sharded_stft.dispatch", n_shards=int(n_shards)):
-        out = _run(x)
+        out = _instrumented("sharded_stft", _run)(x)
     return out[..., :sp.frame_count(n, frame_length, hop), :]
 
 
@@ -1027,7 +1053,7 @@ def sharded_istft(spec, n: int, frame_length: int, hop: int, mesh: Mesh,
         head = buf[..., :halo] + recv
         return jnp.concatenate([head, buf[..., halo:block]], axis=-1)
 
-    out = _run(spec)
+    out = _instrumented("sharded_istft", _run)(spec)
     env_inv = jnp.asarray(
         sp._env_inv(n, frame_length, hop, window_np).astype(np.float32))
     return out * env_inv
@@ -1121,7 +1147,7 @@ def sharded_sosfilt(sos, x, mesh: Mesh, axis: str = "sp"):
             cur = _section(cur, sec)
         return cur
 
-    return _run(x)
+    return _instrumented("sharded_sosfilt", _run)(x)
 
 
 def sharded_welch(x, mesh: Mesh, axis: str = "sp", fs: float = 1.0,
@@ -1172,7 +1198,8 @@ def sharded_welch(x, mesh: Mesh, axis: str = "sp", fs: float = 1.0,
                         axis=-2)
         return jax.lax.psum(local, axis) / frames_total
 
-    return freqs, _run(x) * scale_mult
+    return freqs, _instrumented("sharded_welch",
+                                _run)(x) * scale_mult
 
 
 def sharded_resample_poly(x, up: int, down: int, mesh: Mesh,
@@ -1239,7 +1266,7 @@ def sharded_resample_poly(x, up: int, down: int, mesh: Mesh,
         return _rs._resample_conv(x_ext, taps_j, up, down, out_block,
                                   pad=(p_lo, p_hi))
 
-    return _run(x)
+    return _instrumented("sharded_resample_poly", _run)(x)
 
 
 def sharded_swt_apply2d(type, order, level, ext, img, mesh: Mesh,
@@ -1544,7 +1571,7 @@ def sharded_normalize2d(src, mesh: Mesh, axis: str = "sp"):
         out = (v - mn) / diff - 1.0
         return jnp.where(mx == mn, jnp.zeros_like(out), out)
 
-    return _run(srcj)[:h]
+    return _instrumented("sharded_normalize2d", _run)(srcj)[:h]
 
 
 def data_parallel(fn, mesh: Mesh, axis: str = "dp"):
@@ -1559,7 +1586,8 @@ def data_parallel(fn, mesh: Mesh, axis: str = "dp"):
     (e.g. ``Config.conv_precision``) is baked into the cached executable —
     later ``set_config`` changes do not retrace existing wrappers.
     """
-    jfn = jax.jit(fn)
+    jfn = obs.instrumented_jit(fn, op="data_parallel",
+                               route="jit")
 
     def wrapper(batch, *args, **kwargs):
         batch = jnp.asarray(batch)

@@ -29,7 +29,10 @@ import numpy as np
 
 __all__ = ["device_time", "device_time_chained", "host_time",
            "rms_normalize", "mxu_peak_tflops", "mxu_f32_bound_tflops",
-           "conv_roofline", "MXU_PEAK_TFLOPS_BF16", "MXU_F32_PASSES"]
+           "conv_roofline", "analytical_roofline",
+           "roofline_disagreement_pct", "hbm_bw_gbps",
+           "MXU_PEAK_TFLOPS_BF16", "MXU_F32_PASSES", "HBM_BW_GBPS",
+           "ROOFLINE_DISAGREEMENT_WARN_PCT"]
 
 
 # ---------------------------------------------------------------------------
@@ -43,6 +46,10 @@ MXU_PEAK_TFLOPS_BF16 = 197.0
 # f32 emulation pass counts per MXU precision knob: "highest" = 6-pass
 # bf16 (full f32), "high" = 3-pass (~1.3e-5 rel err on the conv oracle)
 MXU_F32_PASSES = {"highest": 6, "high": 3}
+# public TPU v5e HBM bandwidth ceiling (GB/s); override with
+# $VELES_SIMD_HBM_BW_GBPS on other hardware.  Denominator of the
+# analytical-roofline attainable-% figures (obs resource axis).
+HBM_BW_GBPS = 819.0
 
 
 def mxu_peak_tflops() -> float:
@@ -62,6 +69,48 @@ def mxu_f32_bound_tflops(precision: str = "highest") -> float:
             f"precision must be one of {sorted(MXU_F32_PASSES)}, got "
             f"{precision!r}") from None
     return mxu_peak_tflops() / passes
+
+
+def hbm_bw_gbps() -> float:
+    """HBM bandwidth in GB/s (env-overridable hardware constant)."""
+    return float(os.environ.get("VELES_SIMD_HBM_BW_GBPS", HBM_BW_GBPS))
+
+
+def analytical_roofline(flops: float, t_seconds: float,
+                        precision: str = "highest") -> dict:
+    """Roofline attribution from XLA's OWN cost model: effective
+    TFLOP/s of ``flops`` (``compiled.cost_analysis()['flops']`` — the
+    compiled program's count, redundant MACs included) executed in
+    ``t_seconds``, against the f32 MXU bound at ``precision``.
+
+    The *analytical* twin of :func:`conv_roofline` (whose FLOP count
+    is the hand-maintained useful-work constant): printing the two
+    side by side, with a warning when they disagree by more than
+    ``ROOFLINE_DISAGREEMENT_WARN_PCT``, is the drift detector for the
+    hand-coded constants — the obs-v3 acceptance contract.
+    """
+    bound = mxu_f32_bound_tflops(precision)
+    eff = float(flops) / float(t_seconds) / 1e12
+    return {"tflops_analytical": eff,
+            "roofline_bound_tflops": bound,
+            "analytical_pct_of_roofline": 100.0 * eff / bound,
+            "xla_flops": float(flops),
+            "precision": precision}
+
+
+# analytical-vs-measured disagreement above this % is worth a warning:
+# the hand-coded FLOP constants (or the route attribution) drifted
+ROOFLINE_DISAGREEMENT_WARN_PCT = 15.0
+
+
+def roofline_disagreement_pct(measured_pct: float,
+                              analytical_pct: float) -> float:
+    """Relative disagreement (%) between the measured and analytical
+    roofline figures, normalized by the measured one."""
+    if not measured_pct:
+        return float("inf") if analytical_pct else 0.0
+    return 100.0 * abs(analytical_pct - measured_pct) / abs(
+        measured_pct)
 
 
 def conv_roofline(samples_per_s: float, h_length: int,

@@ -337,8 +337,8 @@ def init_deadline(seconds: float | None = None,
     judge (a bare ``JAX_PLATFORMS=cpu`` is stomped by the axon
     sitecustomize, then the process sits in relay init with no message).
     The only reliable recourse is a watchdog thread that hard-exits
-    (``os._exit``) the whole process, loudly.  Wrap the *first device touch* (e.g. an
-    eager ``jax.devices()``) — not long-running work.
+    (``os._exit``) the whole process, loudly.  Wrap the *first device
+    touch* (e.g. an eager ``jax.devices()``) — not long-running work.
 
     ``$VELES_SIMD_INIT_DEADLINE`` overrides ``seconds``; 0 disables.
     Default 180 s (relay init on a healthy session is < 10 s; first
