@@ -99,6 +99,10 @@ def test_main_records_skips_in_json_tail(monkeypatch, tmp_path, capsys):
                         lambda rng: quick(rng, "mathfun"))
     monkeypatch.setattr(bench, "bench_sgemm",
                         lambda rng: quick(rng, "sgemm"))
+    for name in ("bench_stft", "bench_istft_roundtrip",
+                 "bench_spectrogram", "bench_batched_stft"):
+        monkeypatch.setattr(bench, name,
+                            lambda rng, name=name: quick(rng, name))
 
     def boom(rng):
         raise RuntimeError("config kaput")
@@ -130,7 +134,9 @@ def test_main_records_skips_in_json_tail(monkeypatch, tmp_path, capsys):
 
     details = json.loads((tmp_path / "BENCH_DETAILS.json").read_text())
     metrics = [d.get("metric") for d in details if "metric" in d]
-    assert metrics == ["elementwise", "mathfun", "sgemm"]
+    assert metrics == ["elementwise", "mathfun", "sgemm",
+                       "bench_stft", "bench_istft_roundtrip",
+                       "bench_spectrogram", "bench_batched_stft"]
     tail = details[-1]
     assert "skipped_stages" in tail
     stages = [s["stage"] for s in tail["skipped_stages"]]
@@ -139,3 +145,74 @@ def test_main_records_skips_in_json_tail(monkeypatch, tmp_path, capsys):
     reasons = {s["stage"]: s["reason"] for s in tail["skipped_stages"]}
     assert "wedged" in reasons["headline:convolve_1m"]
     assert "kaput" in reasons["config:bench_dwt"]
+
+
+def _run_main_with_headline(monkeypatch, tmp_path, vs_baseline):
+    """Drive bench.main() with every stage stubbed and the headline
+    returning the requested vs_baseline multiple."""
+    import numpy as np
+
+    import tools.tpu_smoke as smoke
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("VELES_SIMD_STAGE_TIMEOUT", "5")
+    monkeypatch.setenv("VELES_SIMD_DEVICE_WAIT", "0")
+    monkeypatch.setattr(bench, "_warm_device", lambda *a, **k: None)
+    monkeypatch.setattr(
+        bench, "bench_convolve_1m",
+        lambda rng: {"metric": "convolve 1M x 2047 overlap-save",
+                     "unit": "Msamples/s",
+                     "value": float(vs_baseline), "baseline": 1.0})
+    for name in ("bench_elementwise", "bench_mathfun", "bench_sgemm",
+                 "bench_dwt", "bench_stft", "bench_istft_roundtrip",
+                 "bench_spectrogram", "bench_batched_stft"):
+        def mk(name):
+            def cfg(rng):
+                return {"metric": name, "unit": "u", "value": 2.0,
+                        "baseline": 1.0}
+            cfg.__name__ = name
+            return cfg
+        monkeypatch.setattr(bench, name, mk(name))
+    monkeypatch.setattr(smoke, "FAMILIES",
+                        [("fam_ok", lambda rng: (0.0, 1.0))])
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    try:
+        with np.errstate(all="ignore"):
+            try:
+                bench.main()
+                return 0
+            except SystemExit as e:
+                return e.code
+    finally:
+        bench.obs.reset()
+        bench.obs.disable()
+
+
+def test_headline_below_floor_warns_and_flags(monkeypatch, tmp_path,
+                                              capsys):
+    """vs_baseline under the floor: BENCH-WARN printed, entry flagged
+    headline_regressed in BENCH_DETAILS.json (the r05 88.37 story)."""
+    import json
+
+    rc = _run_main_with_headline(monkeypatch, tmp_path,
+                                 bench.HEADLINE_VS_BASELINE_FLOOR - 10)
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "BENCH-WARN" in err
+    details = json.loads((tmp_path / "BENCH_DETAILS.json").read_text())
+    head = [d for d in details
+            if d.get("metric") == "convolve 1M x 2047 overlap-save"]
+    assert head and head[0].get("headline_regressed") is True
+
+
+def test_headline_at_floor_not_flagged(monkeypatch, tmp_path, capsys):
+    import json
+
+    rc = _run_main_with_headline(monkeypatch, tmp_path,
+                                 bench.HEADLINE_VS_BASELINE_FLOOR + 10)
+    assert rc == 0
+    assert "BENCH-WARN" not in capsys.readouterr().err
+    details = json.loads((tmp_path / "BENCH_DETAILS.json").read_text())
+    head = [d for d in details
+            if d.get("metric") == "convolve 1M x 2047 overlap-save"]
+    assert head and "headline_regressed" not in head[0]

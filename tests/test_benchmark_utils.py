@@ -97,3 +97,25 @@ def test_burst_device_time_still_works():
         warnings.simplefilter("ignore")
         t = device_time(lambda: jnp.sin(x), burst=4, repeats=1, warmup=1)
     assert t > 0
+
+
+def test_stft_roofline_per_route_constants():
+    from veles.simd_tpu.utils.benchmark import (
+        mxu_f32_bound_tflops, rfft_flops, stft_roofline)
+
+    fl = 512
+    frames_per_s = 1e6
+    mm = stft_roofline(frames_per_s, fl, route="rdft_matmul")
+    pf = stft_roofline(frames_per_s, fl, route="pallas_fused")
+    ff = stft_roofline(frames_per_s, fl, route="xla_fft")
+    # matmul-DFT useful work: 4 * L * bins per frame, both matmul routes
+    assert mm["flops_per_frame"] == 4 * fl * (fl // 2 + 1)
+    assert pf["flops_per_frame"] == mm["flops_per_frame"]
+    # FFT route: the split-radix estimate
+    assert ff["flops_per_frame"] == rfft_flops(fl) == 2.5 * fl * 9
+    for roof in (mm, ff):
+        expect = (roof["flops_per_frame"] * frames_per_s / 1e12
+                  / mxu_f32_bound_tflops("highest") * 100.0)
+        assert roof["pct_of_roofline"] == pytest.approx(expect)
+    with pytest.raises(ValueError, match="route"):
+        stft_roofline(frames_per_s, fl, route="bogus")

@@ -1,0 +1,110 @@
+"""The spectral route-dispatch lint rule (PR 5): every *_ROUTES table
+entry must reach an instrumented_jit core, and public dispatchers must
+index the table inside a ``with obs.span(...)`` scope."""
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import lint  # noqa: E402
+
+GOOD = '''
+import functools
+from veles.simd_tpu import obs
+from veles.simd_tpu.ops import pallas_kernels as _pk
+
+
+@functools.partial(obs.instrumented_jit, op="stft", route="xla_fft")
+def _core_xla(x):
+    return x
+
+
+def _run_xla(x):
+    return _core_xla(x)
+
+
+def _run_pallas(x):
+    return _pk.stft_pallas(x, 256, 128)
+
+
+_STFT_ROUTES = {"xla_fft": _run_xla, "pallas_fused": _run_pallas}
+
+
+def stft(x, route):
+    with obs.span("stft.dispatch", route=route):
+        return _STFT_ROUTES[route](x)
+'''
+
+UNINSTRUMENTED = '''
+from veles.simd_tpu import obs
+
+
+def _run_raw(x):
+    return x + 1
+
+
+_STFT_ROUTES = {"raw": _run_raw}
+
+
+def stft(x, route):
+    with obs.span("stft.dispatch"):
+        return _STFT_ROUTES[route](x)
+'''
+
+UNSPANNED = '''
+import functools
+from veles.simd_tpu import obs
+
+
+@functools.partial(obs.instrumented_jit, op="stft", route="xla_fft")
+def _core(x):
+    return x
+
+
+def _run(x):
+    return _core(x)
+
+
+_STFT_ROUTES = {"xla_fft": _run}
+
+
+def stft(x, route):
+    return _STFT_ROUTES[route](x)
+'''
+
+NO_TABLES = '''
+def stft(x):
+    return x
+'''
+
+
+def _errors(src):
+    return lint.spectral_dispatch_errors(ast.parse(src), "spectral.py")
+
+
+def test_good_module_passes():
+    assert _errors(GOOD) == []
+
+
+def test_uninstrumented_runner_flagged():
+    errs = _errors(UNINSTRUMENTED)
+    assert any("instrumented_jit" in e for e in errs)
+
+
+def test_unspanned_dispatch_flagged():
+    errs = _errors(UNSPANNED)
+    assert any("obs.span" in e for e in errs)
+
+
+def test_missing_tables_flagged():
+    errs = _errors(NO_TABLES)
+    assert any("_ROUTES" in e for e in errs)
+
+
+def test_real_spectral_module_is_clean():
+    src = (REPO / "veles/simd_tpu/ops/spectral.py").read_text()
+    assert lint.spectral_dispatch_errors(
+        ast.parse(src), "veles/simd_tpu/ops/spectral.py") == []

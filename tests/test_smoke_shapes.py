@@ -1,0 +1,66 @@
+"""smoke:resample wedge guard (PR 5).
+
+BENCH_r05: the ``smoke:resample`` stage stalled 301 s on the relay and
+got skipped — the (160, 147) case with DEFAULT taps compiles a
+3201-tap dilated+strided conv.  The smoke now pins an explicit short
+filter; these tests hold that line: every geometry the stage runs must
+compile EAGERLY (``.lower().compile()`` on the exact shapes, no
+deferred surprises on hardware), the filter budget must stay
+smoke-sized, and the whole stage must pass on the CPU backend.
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools import tpu_smoke  # noqa: E402
+
+from veles.simd_tpu.ops import resample as rs  # noqa: E402
+
+# every resample_poly filter the smoke compiles must stay well under
+# the default 20*max(up,down)+1 design that wedged r05 (3201 taps)
+SMOKE_TAPS_BUDGET = 1024
+
+
+def _smoke_geometries():
+    """The exact (x2d, taps, up, down, out_len) set the smoke stage
+    dispatches, reconstructed from its shared constants."""
+    rows, n = tpu_smoke.RESAMPLE_SMOKE_SHAPE
+    for up, down in tpu_smoke.RESAMPLE_SMOKE_RATES:
+        taps = tpu_smoke._resample_smoke_taps(rs, up, down)
+        up_r, down_r, taps_r = rs._normalize_resample_args(
+            n, up, down, taps)
+        out_len = rs.resample_length(n, up_r, down_r)
+        yield rows, n, up_r, down_r, taps_r, out_len
+
+
+def test_smoke_filter_stays_inside_budget():
+    for rows, n, up, down, taps, out_len in _smoke_geometries():
+        assert len(taps) <= SMOKE_TAPS_BUDGET, (
+            f"({up}, {down}) smoke filter re-fattened to {len(taps)} "
+            f"taps (> {SMOKE_TAPS_BUDGET}) — the r05 wedge class")
+
+
+def test_smoke_shapes_compile_eagerly():
+    """AOT-compile each geometry the stage will dispatch: the compile
+    (the wedge-prone step) happens HERE, inside the test budget, on the
+    exact shapes — never first on the relay."""
+    import jax.numpy as jnp
+
+    for rows, n, up, down, taps, out_len in _smoke_geometries():
+        x = jnp.zeros((rows, n), jnp.float32)
+        t = jnp.asarray(taps, jnp.float32)
+        compiled = rs._resample_conv.lower(
+            x, t, up, down, out_len).compile()
+        assert compiled is not None, (up, down)
+
+
+def test_resample_smoke_stage_passes_on_cpu():
+    """The whole stage, as bench.py runs it (reproduces the r05 wedge
+    scenario under JAX_PLATFORMS=cpu: it must finish and pass)."""
+    err, tol = tpu_smoke._check_resample(np.random.RandomState(7))
+    assert err <= tol

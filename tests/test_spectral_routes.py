@@ -1,0 +1,401 @@
+"""Spectral route selection (PR 5): rdft_matmul / pallas_fused /
+xla_fft parity, selectors, env opt-outs, the Mosaic demote-and-remember
+fallback, the host-constant LRU, and the hilbert/cwt matmul routes.
+
+The route-parity discipline mirrors the convolve family's: every route
+is held to the SAME float64 oracle (``*_na``), across even/odd frame
+lengths, the standard hop family (frame/4, frame/2, frame), and
+hann/rect/custom windows, plus an istft(stft(x)) round-trip tolerance
+gate per route.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu import obs
+from veles.simd_tpu.ops import batched
+from veles.simd_tpu.ops import pallas_kernels as pk
+from veles.simd_tpu.ops import spectral as sp
+
+RNG = np.random.RandomState(23)
+N = 2048
+
+
+def _rel(got, want):
+    got = np.asarray(got, np.complex128)
+    want = np.asarray(want, np.complex128)
+    scale = np.max(np.abs(want)) or 1.0
+    return np.max(np.abs(got - want)) / scale
+
+
+def _window(kind, frame):
+    if kind == "hann":
+        return None                       # the default periodic Hann
+    if kind == "rect":
+        return np.ones(frame, np.float32)
+    return (0.5 + 0.5 * np.random.RandomState(frame)
+            .rand(frame)).astype(np.float32)
+
+
+FRAMES_HOPS = [(fl, hop)
+               for fl in (64, 65)          # even and odd frame lengths
+               for hop in (fl // 4, fl // 2, fl)]
+
+
+class TestRouteParity:
+    """rdft_matmul vs xla_fft vs the float64 oracle — the 1e-4 rel-err
+    acceptance gate, per window kind."""
+
+    @pytest.mark.parametrize("frame,hop", FRAMES_HOPS)
+    @pytest.mark.parametrize("wkind", ["hann", "rect", "custom"])
+    def test_stft_routes_match_oracle(self, frame, hop, wkind):
+        x = RNG.randn(3, N).astype(np.float32)
+        w = _window(wkind, frame)
+        want = sp.stft_na(x, frame, hop, w)
+        for route in ("rdft_matmul", "xla_fft"):
+            got = sp.stft(x, frame, hop, window=w, simd=True,
+                          route=route)
+            assert got.shape == want.shape
+            assert _rel(got, want) < 1e-4, (route, frame, hop, wkind)
+
+    @pytest.mark.parametrize("frame,hop", FRAMES_HOPS)
+    def test_istft_routes_match_oracle(self, frame, hop):
+        # hop == frame with a Hann window is ill-conditioned (the COLA
+        # envelope is w^2, near-zero at frame edges, and 1/env
+        # amplifies rounding in EVERY route including the oracle), so
+        # the no-overlap case runs rectangular — the window a real
+        # no-overlap caller would use
+        w = (np.ones(frame, np.float32) if hop == frame else None)
+        x = RNG.randn(2, N).astype(np.float32)
+        spec = sp.stft_na(x, frame, hop, w)
+        want = sp.istft_na(spec, N, frame, hop, w)
+        core = slice(frame, N - frame)
+        for route in ("rdft_matmul", "xla_fft"):
+            got = np.asarray(sp.istft(spec.astype(np.complex64), N,
+                                      frame, hop, window=w, simd=True,
+                                      route=route))
+            assert _rel(got[..., core], want[..., core]) < 1e-4, \
+                (route, frame, hop)
+
+    @pytest.mark.parametrize("wkind", ["hann", "rect", "custom"])
+    @pytest.mark.parametrize("route", ["rdft_matmul", "xla_fft"])
+    def test_round_trip_gate_per_route(self, wkind, route):
+        """istft(stft(x)) reconstructs the interior per route — the
+        acceptance's round-trip tolerance gate."""
+        frame, hop = 128, 32
+        w = _window(wkind, frame)
+        x = RNG.randn(N).astype(np.float32)
+        spec = sp.stft(x, frame, hop, window=w, simd=True, route=route)
+        rec = np.asarray(sp.istft(spec, N, frame, hop, window=w,
+                                  simd=True, route=route))
+        core = slice(frame, N - frame)
+        np.testing.assert_allclose(rec[core], x[core], atol=1e-4)
+
+    def test_pallas_route_matches_oracle(self):
+        """The fused kernel route end-to-end through stft(route=...)
+        (interpret mode on CPU), including a multi-tile signal so the
+        overlap carry crosses grid steps."""
+        x = RNG.randn(2, 40960).astype(np.float32)
+        want = sp.stft_na(x, 512, 128)
+        got = sp.stft(x, 512, 128, simd=True, route="pallas_fused")
+        assert got.shape == want.shape
+        assert _rel(got, want) < 1e-4
+
+    def test_pallas_kernel_contract_violations(self):
+        x = RNG.randn(1024).astype(np.float32)
+        with pytest.raises(ValueError, match="hop"):
+            pk.stft_pallas(x, 256, 96)        # non-dividing hop
+        with pytest.raises(ValueError, match="128-lane"):
+            pk.stft_pallas(x, 256, 64)        # sub-lane hop
+        with pytest.raises(ValueError, match="frame_length > hop"):
+            pk.stft_pallas(x, 128, 128)       # no overlap to carry
+        with pytest.raises(ValueError, match="route"):
+            sp.stft(x, 256, 64, simd=True, route="nope")
+        with pytest.raises(ValueError, match="route"):
+            sp.istft(np.zeros((15, 65), np.complex64), 1024, 128, 64,
+                     simd=True, route="nope")
+
+
+class TestSelectors:
+    def test_matmul_bound(self):
+        assert sp._use_matmul_dft(512)
+        assert sp._use_matmul_dft(sp.AUTO_DFT_MATMUL_MAX_FRAME)
+        assert not sp._use_matmul_dft(sp.AUTO_DFT_MATMUL_MAX_FRAME * 2)
+
+    def test_matmul_env_opt_out(self, monkeypatch):
+        monkeypatch.setenv("VELES_SIMD_DISABLE_DFT_MATMUL", "1")
+        assert not sp.dft_matmul_allowed()
+        assert sp._select_stft_route(512, 128, 1000) == "xla_fft"
+        monkeypatch.setenv("VELES_SIMD_DISABLE_DFT_MATMUL", "0")
+        assert sp.dft_matmul_allowed()
+
+    def test_pallas_gate_terms(self, monkeypatch):
+        # CPU: pallas_available() is False, so the gate is closed...
+        assert not sp._use_pallas_stft(512, 128, 1000)
+        # ...and with availability forced the shape terms take over
+        monkeypatch.setattr(pk, "pallas_available", lambda: True)
+        assert sp._use_pallas_stft(512, 128, 1000)
+        assert sp._select_stft_route(512, 128, 1000) == "pallas_fused"
+        assert not sp._use_pallas_stft(512, 96, 1000)   # non-dividing
+        assert not sp._use_pallas_stft(512, 64, 1000)   # sub-lane hop
+        assert not sp._use_pallas_stft(512, 512, 1000)  # no overlap
+        assert not sp._use_pallas_stft(
+            512, 128, pk.PALLAS_STFT_MIN_FRAMES - 1)    # too few frames
+        monkeypatch.setenv("VELES_SIMD_DISABLE_STFT_PALLAS", "1")
+        assert not pk.stft_pallas_allowed()
+        assert not sp._use_pallas_stft(512, 128, 1000)
+
+    def test_selected_route_priority(self, monkeypatch):
+        assert sp._select_stft_route(512, 128, 1000) == "rdft_matmul"
+        assert sp._select_stft_route(
+            sp.AUTO_DFT_MATMUL_MAX_FRAME * 2, 128, 1000) == "xla_fft"
+
+    def test_fits_vmem_stft(self):
+        assert pk.fits_vmem_stft(512, 128)
+        # a deliberately absurd geometry cannot fit
+        assert not pk.fits_vmem_stft(16384, 128)
+
+    def test_mosaic_oom_demotes_and_remembers(self, monkeypatch):
+        """The fused route's compile-OOM fallback on the AUTO path:
+        the (frame, hop) class lands in the rejection set, the call
+        still answers via the matmul route, and the demotion is
+        counted."""
+        from veles.simd_tpu.ops.convolve2d import _is_mosaic_vmem_oom
+
+        def boom(*a, **k):
+            raise RuntimeError(
+                "Ran out of memory in memory space vmem: scoped "
+                "allocation with size 22.34M and limit 16.00M")
+
+        assert _is_mosaic_vmem_oom(RuntimeError(
+            "ran out of memory in memory space vmem"))
+        monkeypatch.setattr(pk, "stft_pallas", boom)
+        # open the gate so the SELECTOR (not route=) picks the kernel
+        monkeypatch.setattr(pk, "pallas_available", lambda: True)
+        sp._STFT_PALLAS_REJECTED.discard((256, 128))
+        obs.enable()
+        obs.reset()
+        try:
+            x = RNG.randn(16384).astype(np.float32)
+            assert sp._select_stft_route(
+                256, 128, sp.frame_count(16384, 256, 128)) \
+                == "pallas_fused"
+            got = sp.stft(x, 256, 128, simd=True)
+            assert _rel(got, sp.stft_na(x, 256, 128)) < 1e-4
+            assert (256, 128) in sp._STFT_PALLAS_REJECTED
+            assert obs.counter_value("stft_pallas_demotion",
+                                     reason="compile_oom") == 1
+            ev = [e for e in obs.events() if e["op"] == "stft_route"]
+            assert ev[-1]["decision"] == "rdft_matmul"
+            assert ev[-1]["demoted_from"] == "pallas_fused"
+            # remembered: the gate now refuses the class outright
+            assert not sp._use_pallas_stft(256, 128, 1000)
+        finally:
+            obs.disable()
+            obs.reset()
+            sp._STFT_PALLAS_REJECTED.discard((256, 128))
+
+    def test_forced_pallas_oom_raises(self, monkeypatch):
+        """A FORCED pallas route never silently answers via another
+        route: the OOM is remembered AND re-raised."""
+        def boom(*a, **k):
+            raise RuntimeError(
+                "Ran out of memory in memory space vmem: scoped "
+                "allocation with size 22.34M and limit 16.00M")
+
+        monkeypatch.setattr(pk, "stft_pallas", boom)
+        sp._STFT_PALLAS_REJECTED.discard((256, 128))
+        try:
+            x = RNG.randn(4096).astype(np.float32)
+            with pytest.raises(RuntimeError, match="vmem"):
+                sp.stft(x, 256, 128, simd=True, route="pallas_fused")
+            assert (256, 128) in sp._STFT_PALLAS_REJECTED
+        finally:
+            sp._STFT_PALLAS_REJECTED.discard((256, 128))
+
+    def test_non_oom_errors_propagate(self, monkeypatch):
+        def boom(*a, **k):
+            raise RuntimeError("some unrelated kernel failure")
+
+        monkeypatch.setattr(pk, "stft_pallas", boom)
+        x = RNG.randn(4096).astype(np.float32)
+        with pytest.raises(RuntimeError, match="unrelated"):
+            sp.stft(x, 256, 128, simd=True, route="pallas_fused")
+
+
+class TestDecisions:
+    def test_stft_route_events(self):
+        obs.enable()
+        obs.reset()
+        try:
+            x = RNG.randn(N).astype(np.float32)
+            sp.stft(x, 256, 64, simd=True)
+            ev = [e for e in obs.events() if e["op"] == "stft_route"]
+            assert ev[-1]["decision"] == "rdft_matmul"
+            assert ev[-1]["forced"] is False
+            # the framing-path event is still the LAST one (the 99x
+            # telemetry contract test_obs.py pins)
+            assert obs.events()[-1]["op"] == "stft"
+            spec = sp.stft_na(x, 256, 64).astype(np.complex64)
+            sp.istft(spec, N, 256, 64, simd=True)
+            ev = [e for e in obs.events() if e["op"] == "istft_route"]
+            assert ev[-1]["decision"] == "rdft_matmul"
+            sp.hilbert(x[:512], simd=True)
+            ev = [e for e in obs.events() if e["op"] == "hilbert_route"]
+            assert ev[-1]["decision"] == "matmul_dft"
+            sp.morlet_cwt(x[:512], [4.0, 8.0], simd=True)
+            ev = [e for e in obs.events()
+                  if e["op"] == "morlet_cwt_route"]
+            assert ev[-1]["decision"] == "matmul_dft"
+        finally:
+            obs.disable()
+            obs.reset()
+
+
+class TestHilbertCwtRoutes:
+    @pytest.mark.parametrize("n", [511, 512, 1000, 1024])
+    def test_hilbert_matmul_matches_oracle(self, n):
+        x = RNG.randn(n).astype(np.float32)
+        want = sp.hilbert_na(x)
+        for route in ("matmul_dft", "xla_fft"):
+            assert _rel(sp.hilbert(x, simd=True, route=route),
+                        want) < 1e-4, (n, route)
+
+    def test_hilbert_auto_routes_by_size(self):
+        # <= bound -> matmul, above -> fft; both match the oracle
+        short = RNG.randn(sp.HILBERT_MATMUL_MAX_N).astype(np.float32)
+        long = RNG.randn(sp.HILBERT_MATMUL_MAX_N * 2).astype(np.float32)
+        assert _rel(sp.hilbert(short, simd=True),
+                    sp.hilbert_na(short)) < 1e-4
+        assert _rel(sp.hilbert(long, simd=True),
+                    sp.hilbert_na(long)) < 1e-4
+
+    @pytest.mark.parametrize("n", [511, 1000, 1024])
+    def test_cwt_matmul_matches_oracle(self, n):
+        x = RNG.randn(2, n).astype(np.float32)
+        scales = np.array([2.0, 4.0, 8.0, 16.0])
+        want = sp.morlet_cwt_na(x, scales)
+        for route in ("matmul_dft", "xla_fft"):
+            got = sp.morlet_cwt(x, scales, simd=True, route=route)
+            assert got.shape == want.shape
+            assert _rel(got, want) < 1e-4, (n, route)
+
+    def test_route_contract(self):
+        x = RNG.randn(256).astype(np.float32)
+        with pytest.raises(ValueError, match="route"):
+            sp.hilbert(x, simd=True, route="bogus")
+        with pytest.raises(ValueError, match="route"):
+            sp.morlet_cwt(x, [4.0], simd=True, route="bogus")
+
+
+class TestHostCache:
+    def test_constants_are_cached(self):
+        """_analytic_multiplier / _morlet_hat / the DFT bases come out
+        of the registered LRU: a second identical call is a hit and
+        returns the same object."""
+        before = sp._host_cache_info()
+        m1 = sp._analytic_multiplier(777)
+        m2 = sp._analytic_multiplier(777)
+        assert m1 is m2
+        h1 = sp._morlet_hat(np.array([2.0, 4.0]), 777, 6.0)
+        h2 = sp._morlet_hat(np.array([2.0, 4.0]), 777, 6.0)
+        assert h1 is h2
+        w = sp.hann_window(64)
+        b1 = sp._rdft_basis(64, w)
+        b2 = sp._rdft_basis(64, w)
+        assert b1 is b2
+        after = sp._host_cache_info()
+        assert after["hits"] >= before["hits"] + 3
+        assert "spectral_host_lru" in obs.caches()
+
+    def test_cache_is_bounded(self):
+        start = sp._host_cache_info()["evictions"]
+        for n in range(100, 100 + sp._HOST_CACHE_MAXSIZE + 8):
+            sp._analytic_multiplier(n)
+        assert sp._host_cache_info()["size"] <= sp._HOST_CACHE_MAXSIZE
+        assert sp._host_cache_info()["evictions"] > start
+
+    def test_stft_pallas_rejected_registered(self):
+        assert "stft_pallas_rejected" in obs.caches()
+
+    def test_device_cache_dedupes_uploads(self):
+        """The device LRU returns the SAME uploaded buffer for a
+        repeated geometry — without it every call re-transfers the
+        multi-MB basis (review finding)."""
+        w = sp.hann_window(128)
+        b1 = sp._device_basis("rdft_fwd", 128, w,
+                              lambda: sp._rdft_basis(128, w))
+        before = sp._device_cache_info()
+        b2 = sp._device_basis("rdft_fwd", 128, w,
+                              lambda: sp._rdft_basis(128, w))
+        assert b1 is b2
+        assert sp._device_cache_info()["hits"] == before["hits"] + 1
+        assert "spectral_device_lru" in obs.caches()
+
+
+def test_stft_accepts_shapeless_input_on_every_route():
+    """Lists/tuples are supported stft inputs on EVERY route (review
+    finding: the pallas runner used to see the raw list)."""
+    xl = [float(v) for v in RNG.randn(4096)]
+    want = sp.stft_na(np.asarray(xl, np.float32), 256, 128)
+    for route in ("rdft_matmul", "xla_fft", "pallas_fused"):
+        got = sp.stft(xl, 256, 128, simd=True, route=route)
+        assert _rel(got, want) < 1e-4, route
+
+
+class TestBatchedStft:
+    @pytest.fixture(autouse=True)
+    def _fresh_cache(self):
+        batched.clear_handle_cache()
+        yield
+        batched.clear_handle_cache()
+
+    def test_matches_oracle_and_caches(self):
+        x = RNG.randn(6, 1024).astype(np.float32)
+        got = np.asarray(batched.batched_stft(x, 256, 64))
+        want = sp.stft_na(x, 256, 64)
+        assert got.shape == want.shape
+        assert _rel(got, want) < 1e-4
+        info0 = batched.handle_cache_info()
+        batched.batched_stft(x, 256, 64)
+        info1 = batched.handle_cache_info()
+        assert info1["hits"] == info0["hits"] + 1
+        assert any(k[0] == "stft" for k in info1["keys"])
+
+    def test_window_change_does_not_recompile(self):
+        x = RNG.randn(4, 512).astype(np.float32)
+        batched.batched_stft(x, 128, 64)
+        info0 = batched.handle_cache_info()
+        w = np.ones(128, np.float32)
+        got = np.asarray(batched.batched_stft(x, 128, 64, window=w))
+        info1 = batched.handle_cache_info()
+        assert info1["misses"] == info0["misses"]   # same executable
+        assert _rel(got, sp.stft_na(x, 128, 64, w)) < 1e-4
+
+    def test_xla_route_via_env(self, monkeypatch):
+        monkeypatch.setenv("VELES_SIMD_DISABLE_DFT_MATMUL", "1")
+        x = RNG.randn(4, 512).astype(np.float32)
+        got = np.asarray(batched.batched_stft(x, 128, 32))
+        assert _rel(got, sp.stft_na(x, 128, 32)) < 1e-4
+        assert any(k[-1] == "xla_fft"
+                   for k in batched.handle_cache_info()["keys"])
+
+    def test_oracle_path(self):
+        x = RNG.randn(3, 512).astype(np.float32)
+        got = batched.batched_stft(x, 128, 64, simd=False)
+        want = sp.stft_na(x, 128, 64).astype(np.complex64)
+        assert _rel(got, want) < 1e-5
+
+    def test_rejects_1d(self):
+        with pytest.raises(ValueError, match="batched"):
+            batched.batched_stft(np.zeros(64, np.float32), 32, 16)
+
+
+def test_env_knobs_documented():
+    """The two new env vars must appear in the GUIDE's knob table."""
+    guide = open(os.path.join(os.path.dirname(__file__), os.pardir,
+                              "docs", "GUIDE.md")).read()
+    assert "VELES_SIMD_DISABLE_STFT_PALLAS" in guide
+    assert "VELES_SIMD_DISABLE_DFT_MATMUL" in guide

@@ -23,7 +23,9 @@ rereading numbers.  This tool closes the loop:
    METRIC_SUBSTRING=FRAC`` overrides the ``--threshold`` default for
    rows whose metric name contains the substring (device-time rows are
    noisier than host-time rows; the headline deserves a tighter gate
-   than the smoke-sized configs).
+   than the smoke-sized configs).  The spectral rows ship built-in
+   defaults (``DEFAULT_NOISE``); CLI overrides apply after them, so
+   the last matching substring still wins.
 3. **Gate**: exit 0 when every row is within noise or improved (or has
    no baseline yet), 1 when any row regressed, 2 when there was
    nothing to compare (missing/empty details file).  ``make
@@ -54,6 +56,17 @@ DEFAULT_DETAILS = "BENCH_DETAILS.json"
 DEFAULT_HISTORY = "BENCH_HISTORY.jsonl"
 DEFAULT_WINDOW = 5
 DEFAULT_THRESHOLD = 0.10
+# built-in per-row noise thresholds, applied BEFORE the CLI --noise
+# overrides (later matches win, so the CLI always has the last word).
+# The spectral rows are device-time rows at smaller work totals than
+# the 1M headline, so their chained-timer jitter is wider; the batched
+# ratio row divides two measurements and is the noisiest of all.
+DEFAULT_NOISE = [
+    ("stft", 0.15),
+    ("istft round-trip", 0.15),
+    ("spectrogram", 0.15),
+    ("batched stft", 0.25),
+]
 
 
 def load_rows(details_path: str) -> list:
@@ -237,8 +250,9 @@ def main(argv=None) -> int:
         return 2
 
     history = read_history(args.history)
+    overrides = DEFAULT_NOISE + list(args.noise)
     regressions, lines = compare(rows, history, args.window,
-                                 args.threshold, args.noise)
+                                 args.threshold, overrides)
     if not args.no_append:
         append_history(args.history,
                        rows_to_record(rows, args.details,

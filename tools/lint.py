@@ -147,6 +147,111 @@ _TIME_FORBIDDEN = {"time", "monotonic", "perf_counter",
 _JIT_FORBIDDEN = {"jit", "pjit"}
 
 
+# --- spectral route-dispatch rule ------------------------------------------
+# ops/spectral.py's route tables (``_STFT_ROUTES`` / ``_ISTFT_ROUTES``)
+# are the template the next routed op family copies.  Two structural
+# invariants the obs layer depends on are pinned here: every
+# route-table entry resolves to a module-level runner whose body
+# reaches an ``obs.instrumented_jit``-compiled core (directly, or via
+# the pallas kernel module whose cores are instrumented in place) —
+# a route compiled any other way is invisible to the resource axis —
+# and every public dispatcher that indexes a route table does so
+# inside a ``with obs.span(...)`` scope, so the time axis sees it.
+
+_SPECTRAL_RULE_FILE = "veles/simd_tpu/ops/spectral.py"
+
+
+def _is_instrumented_decorator(dec) -> bool:
+    """``@obs.instrumented_jit`` or ``@functools.partial(
+    obs.instrumented_jit, ...)`` (either spelling of the helper)."""
+    def is_helper(node):
+        return ((isinstance(node, ast.Attribute)
+                 and node.attr == "instrumented_jit")
+                or (isinstance(node, ast.Name)
+                    and node.id == "instrumented_jit"))
+
+    if is_helper(dec):
+        return True
+    return (isinstance(dec, ast.Call) and dec.args
+            and is_helper(dec.args[0]))
+
+
+def spectral_dispatch_errors(tree, fname) -> list:
+    """The rule body, on a parsed module (separated so tests can feed
+    synthetic sources).  Returns human-readable error strings."""
+    errors = []
+    funcs = {}
+    instrumented = set()
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            funcs[node.name] = node
+            if any(_is_instrumented_decorator(d)
+                   for d in node.decorator_list):
+                instrumented.add(node.name)
+    tables = {
+        node.targets[0].id: node
+        for node in tree.body
+        if isinstance(node, ast.Assign) and len(node.targets) == 1
+        and isinstance(node.targets[0], ast.Name)
+        and node.targets[0].id.endswith("_ROUTES")
+        and isinstance(node.value, ast.Dict)}
+    if not tables:
+        errors.append(f"{fname}: no *_ROUTES dispatch tables found "
+                      "(the spectral route rule expects them)")
+        return errors
+    for tname, node in tables.items():
+        for v in node.value.values:
+            if not isinstance(v, ast.Name) or v.id not in funcs:
+                errors.append(
+                    f"{fname}:{node.lineno}: {tname} values must be "
+                    "module-level route runner functions")
+                continue
+            runner = funcs[v.id]
+            names = {n.id for n in ast.walk(runner)
+                     if isinstance(n, ast.Name)}
+            # a runner may delegate to the pallas kernel module, whose
+            # public kernels are instrumented_jit-compiled in place
+            uses_pallas = any(
+                isinstance(a, ast.Attribute)
+                and isinstance(a.value, ast.Name)
+                and a.value.id == "_pk"
+                for a in ast.walk(runner))
+            if not (names & instrumented or uses_pallas):
+                errors.append(
+                    f"{fname}:{runner.lineno}: route runner "
+                    f"{v.id} reaches no obs.instrumented_jit core — "
+                    "the resource axis cannot see this route's "
+                    "compiles")
+    for fn in funcs.values():
+        if fn.name.startswith("_"):
+            # runners may consult a table for the demotion fallback;
+            # only the public dispatchers owe the span scope
+            continue
+        loads = [n for n in ast.walk(fn)
+                 if isinstance(n, ast.Subscript)
+                 and isinstance(n.value, ast.Name)
+                 and n.value.id in tables]
+        if not loads:
+            continue
+        inside_span = set()
+        for w in ast.walk(fn):
+            if isinstance(w, ast.With) and any(
+                    isinstance(it.context_expr, ast.Call)
+                    and isinstance(it.context_expr.func, ast.Attribute)
+                    and it.context_expr.func.attr == "span"
+                    for it in w.items):
+                for body_node in w.body:
+                    inside_span.update(
+                        id(x) for x in ast.walk(body_node))
+        for load in loads:
+            if id(load) not in inside_span:
+                errors.append(
+                    f"{fname}:{load.lineno}: {fn.name} dispatches "
+                    f"{load.value.id} outside a 'with obs.span(...)' "
+                    "scope — the time axis cannot see this route")
+    return errors
+
+
 def compute_module_lint(files) -> int:
     """The ops/parallel project rules, one parse per file: telemetry
     only through the approved helpers (keeps instrumentation out of
@@ -168,6 +273,10 @@ def compute_module_lint(files) -> int:
             print(f"{f}:{e.lineno}: syntax error: {e.msg}")
             failures += 1
             continue
+        if rel == _SPECTRAL_RULE_FILE:
+            for msg in spectral_dispatch_errors(tree, str(f)):
+                print(msg)
+                failures += 1
         aliases = set()
         time_aliases = set()
         jax_aliases = set()

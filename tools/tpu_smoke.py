@@ -254,6 +254,20 @@ def _check_spectral(rng):
     spec = sp.stft(x, 256, 64, simd=True)
     rec = np.asarray(sp.istft(spec, 2048, 256, 64, simd=True))
     errs.append(_rel_err(rec[:, 256:-256], x[:, 256:-256]))
+    # forced spectral routes (PR 5): the matmul-DFT analysis basis and
+    # the inverse-basis synthesis, each vs the same float64 oracle the
+    # auto-selected route is held to
+    errs.append(_rel_err(sp.stft(x, 256, 64, simd=True,
+                                 route="rdft_matmul"),
+                         sp.stft_na(x, 256, 64)))
+    errs.append(_rel_err(sp.stft(x, 256, 64, simd=True,
+                                 route="xla_fft"),
+                         sp.stft_na(x, 256, 64)))
+    spec64 = sp.stft_na(x, 512, 128)
+    want_i = sp.istft_na(spec64, 2048, 512, 128)[:, 512:-512]
+    rec_m = np.asarray(sp.istft(spec64.astype(np.complex64), 2048, 512,
+                                128, simd=True, route="rdft_matmul"))
+    errs.append(_rel_err(rec_m[:, 512:-512], want_i))
     errs.append(_rel_err(sp.hilbert(x, simd=True), sp.hilbert_na(x)))
     errs.append(_rel_err(
         sp.morlet_cwt(x, [4.0, 16.0, 64.0], simd=True),
@@ -278,15 +292,40 @@ def _check_spectral(rng):
     return max(errs), 1e-4
 
 
+# The resample smoke's exact device geometries — shared with
+# tests/test_smoke_shapes.py, which pins that every shape's executable
+# compiles eagerly and the filter stays smoke-sized.  The BENCH_r05
+# wedge: the (160, 147) case with DEFAULT taps compiles a 3201-tap
+# dilated+strided conv, and that compile stalled the relay for 301 s,
+# relay-skipping the whole smoke:resample stage (and, under the old
+# hard-exit design, every family after it).  The rate pair stays — it
+# is the classic 48k->44.1k conversion and covers the up>1 && down>1
+# CPU zero-stuff path — but with an explicit short filter: the smoke
+# gates PARITY (device vs the same-taps oracle), not filter quality.
+RESAMPLE_SMOKE_NTAPS = 641
+RESAMPLE_SMOKE_RATES = ((2, 1), (1, 2), (3, 2), (160, 147))
+RESAMPLE_SMOKE_SHAPE = (4, 730)
+
+
+def _resample_smoke_taps(rs, up, down):
+    """Explicit taps for the big-rate smoke cases (None keeps the
+    default design for the small ones, whose filters are tiny)."""
+    if max(up, down) <= 4:
+        return None
+    return rs._resample_taps(up, down, RESAMPLE_SMOKE_NTAPS)
+
+
 def _check_resample(rng):
     """Polyphase (dilated conv) + Fourier resampling vs their oracles."""
     from veles.simd_tpu.ops import resample as rs
 
-    x = rng.randn(4, 730).astype(np.float32)
+    x = rng.randn(*RESAMPLE_SMOKE_SHAPE).astype(np.float32)
     errs = []
-    for up, down in ((2, 1), (1, 2), (3, 2), (160, 147)):
-        errs.append(_rel_err(rs.resample_poly(x, up, down, simd=True),
-                             rs.resample_poly_na(x, up, down)))
+    for up, down in RESAMPLE_SMOKE_RATES:
+        taps = _resample_smoke_taps(rs, up, down)
+        errs.append(_rel_err(
+            rs.resample_poly(x, up, down, taps=taps, simd=True),
+            rs.resample_poly_na(x, up, down, taps)))
     errs.append(_rel_err(rs.resample_fourier(x, 333, simd=True),
                          rs.resample_fourier_na(x, 333)))
     errs.append(_rel_err(rs.resample_fourier(x, 1460, simd=True),
@@ -453,6 +492,15 @@ def _check_pallas1d(rng):
     errs.append(_rel_err(
         overlap_save_pallas(xos, hos, interpret=interp),
         np.convolve(xos.astype(np.float64), hos.astype(np.float64))))
+    # fused STFT kernel at the TPU shape (512/128: r=4, so the
+    # frame-overlap CARRY crosses grid steps on the compiled path);
+    # direct call pins the kernel, not the routing gate
+    from veles.simd_tpu.ops import spectral as spl
+    from veles.simd_tpu.ops.pallas_kernels import stft_pallas
+
+    xst = rng.randn(2, 40960).astype(np.float32)
+    errs.append(_rel_err(stft_pallas(xst, 512, 128, interpret=interp),
+                         spl.stft_na(xst, 512, 128)))
     # multi-level cascade: the level loop since round 5 (the fused
     # kernel measured slower and is opt-in); value-check all four bands
     got = wv.wavelet_transform("daub", 8, wv.ExtensionType.PERIODIC, x,

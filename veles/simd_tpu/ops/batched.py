@@ -60,8 +60,8 @@ from veles.simd_tpu.utils.config import on_tpu, resolve_simd
 
 __all__ = [
     "BatchedHandle", "batched_resample_poly", "batched_sosfilt",
-    "batched_lfilter", "handle_cache_info", "clear_handle_cache",
-    "BATCHED_CACHE_MAXSIZE",
+    "batched_lfilter", "batched_stft", "handle_cache_info",
+    "clear_handle_cache", "BATCHED_CACHE_MAXSIZE",
 ]
 
 # live compiled-handle bound: a handle is ~a closure + a jit cache
@@ -303,3 +303,70 @@ def batched_lfilter(b, a, x, simd=None, donate: bool = False):
         handle = _get_handle(key, build)
         out = handle(jnp.asarray(x, jnp.float32).reshape(rows, n))
     return out.reshape(batch_shape + (n,))
+
+
+# ---------------------------------------------------------------------------
+# spectral
+# ---------------------------------------------------------------------------
+
+
+def batched_stft(x, frame_length: int, hop: int, window=None,
+                 simd=None):
+    """STFT of a BATCH of equal-length signals in one dispatch:
+    ``x[..., batch, n] -> complex64 [..., batch, frames, bins]``.
+
+    Same numerics/route family as
+    :func:`~veles.simd_tpu.ops.spectral.stft`: the route comes from
+    ``spectral._select_stft_route`` and the ``rdft_matmul`` /
+    ``xla_fft`` routes compile through the handle LRU keyed ``(rows,
+    n, frame_length, hop, route)`` — the DFT basis and the window are
+    runtime operands, so switching windows does NOT recompile, only a
+    new geometry does.  A ``pallas_fused`` selection delegates to
+    ``spectral.stft`` (the fused kernel is already one dispatch per
+    batch and holds its own compile cache; the handle LRU would add
+    nothing).  No ``donate=``: the complex output cannot alias the f32
+    input buffer, so donation would be a no-op warning.
+    """
+    from veles.simd_tpu.ops import spectral as sp
+
+    frame_length, hop = int(frame_length), int(hop)
+    batch_shape, n = _as_batch2d(x)
+    sp._check_stft_args(n, frame_length, hop)
+    window = sp._resolve_window(window, frame_length)
+    if not resolve_simd(simd, op="batched_stft"):
+        return sp.stft_na(x, frame_length, hop,
+                          window).astype(np.complex64)
+    rows = int(np.prod(batch_shape))
+    frames = sp.frame_count(n, frame_length, hop)
+    route = sp._select_stft_route(frame_length, hop, frames)
+    if route == "pallas_fused":
+        return sp.stft(x, frame_length, hop, window=window, simd=True)
+    bins = frame_length // 2 + 1
+    key = ("stft", rows, n, frame_length, hop, route)
+
+    def build():
+        if route == "rdft_matmul":
+            def run(xb, basis):
+                fr = sp._take_frames(xb, frame_length, hop)
+                out = jnp.einsum(
+                    "...fl,lb->...fb", fr, basis,
+                    precision=jax.lax.Precision.HIGHEST)
+                return jax.lax.complex(out[..., :bins],
+                                       out[..., bins:])
+        else:
+            def run(xb, w):
+                fr = sp._take_frames(xb, frame_length, hop)
+                return jnp.fft.rfft(fr * w, axis=-1)
+
+        return obs.instrumented_jit(run, op="batched_stft",
+                                    route=route)
+
+    with obs.span("batched.stft.dispatch"):
+        handle = _get_handle(key, build)
+        x2d = jnp.asarray(x, jnp.float32).reshape(rows, n)
+        operand = (sp._device_basis(
+            "rdft_fwd", frame_length, window,
+            lambda: sp._rdft_basis(frame_length, window))
+            if route == "rdft_matmul" else jnp.asarray(window))
+        out = handle(x2d, operand)
+    return out.reshape(batch_shape + (frames, bins))

@@ -65,12 +65,15 @@ from veles.simd_tpu.utils.config import on_tpu
 
 __all__ = ["filter_bank_pallas", "filter_2d_pallas",
            "cascade_bank_pallas", "overlap_save_pallas",
+           "stft_pallas",
            "pallas_available",
            "pallas2d_compiled_allowed", "pallas_os_allowed",
-           "fits_vmem_os",
+           "stft_pallas_allowed",
+           "fits_vmem_os", "fits_vmem_stft",
            "PALLAS_MIN_ROWS", "PALLAS_DIRECT_MAX_H",
            "PALLAS_2D_MAX_KERNEL_AREA",
-           "PALLAS_OS_STEP", "PALLAS_OS_ROWS", "PALLAS_OS_MIN_H"]
+           "PALLAS_OS_STEP", "PALLAS_OS_ROWS", "PALLAS_OS_MIN_H",
+           "PALLAS_STFT_ROWS", "PALLAS_STFT_MIN_FRAMES"]
 
 # the kernel wins when the batch tile fills VPU sublanes; below this the
 # dispatch/layout overhead dominates and the XLA conv path is used
@@ -145,6 +148,44 @@ def fits_vmem_os(h_length: int, step: int = PALLAS_OS_STEP,
     scratch_bytes = (jb + rows + jb) * step * 4
     tile_bytes = 2 * 2 * rows * step * 4     # in + out, double-buffered
     return mb_bytes + scratch_bytes + tile_bytes <= _VMEM_BUDGET_BYTES
+
+
+# ---- fused STFT (MXU matmul-DFT) routing constants ------------------------
+# frame rows per grid step of the fused STFT kernel: each output row is
+# one frame's [1, hop] x [hop, 2*bins_pad] shift-dots, so 256 rows feed
+# full MXU-height operands exactly like the overlap-save kernel
+PALLAS_STFT_ROWS = 256
+# below this many frames the fused kernel's dispatch/layout overhead
+# dominates and the rdft-matmul (XLA frames @ basis) route is already
+# compute-bound; the kernel's win is removing the materialized frames
+# tensor, which only matters once frames*frame_length is real traffic
+PALLAS_STFT_MIN_FRAMES = 64
+_PALLAS_STFT_ENV = "VELES_SIMD_DISABLE_STFT_PALLAS"
+
+
+def stft_pallas_allowed() -> bool:
+    """May implicit routing use the compiled fused STFT kernel?  True
+    unless explicitly disabled (mirrors ``VELES_SIMD_DISABLE_PALLAS_OS``
+    for the fused overlap-save kernel)."""
+    return os.environ.get(_PALLAS_STFT_ENV, "0").strip().lower() not in (
+        "1", "true", "yes", "on")
+
+
+def fits_vmem_stft(frame_length: int, hop: int,
+                   rows: int = PALLAS_STFT_ROWS) -> bool:
+    """Does the fused STFT kernel's resident state fit VMEM?
+
+    Residency: the ``[r, hop, 2*bins_pad]`` windowed DFT basis blocks
+    (constant across grid steps), the ``[r-1 + rows, hop]`` window
+    scratch + ``[r-1, hop]`` overlap carry, and the double-buffered
+    in/out tiles (``r = frame_length // hop``)."""
+    L, s = int(frame_length), int(hop)
+    r = L // s
+    bins_pad = -(-(L // 2 + 1) // 128) * 128
+    basis_bytes = r * s * 2 * bins_pad * 4
+    scratch_bytes = (2 * (r - 1) + rows) * s * 4
+    tile_bytes = 2 * rows * (s + 2 * bins_pad) * 4
+    return basis_bytes + scratch_bytes + tile_bytes <= _VMEM_BUDGET_BYTES
 
 
 # The compiled 2D Mosaic kernel's first-ever hardware execution
@@ -709,3 +750,169 @@ def overlap_save_pallas(x, taps, step: int = PALLAS_OS_STEP,
     out = _os_call(x3d, taps, n_j, r, str(precision), bool(interpret))
     return out.reshape(x2d.shape[0], rows_pad * s)[
         :, :out_len].reshape(batch_shape + (out_len,))
+
+
+# ---------------------------------------------------------------------------
+# fused STFT (matmul DFT on the MXU, frame overlap carried in VMEM)
+# ---------------------------------------------------------------------------
+
+
+def _stft_basis_blocks(frame_length: int, hop: int,
+                       window) -> np.ndarray:
+    """``[r, hop, 2*bins_pad]`` windowed real-DFT basis blocks.
+
+    Shift ``j`` holds rows ``j*hop .. (j+1)*hop`` of the ``[L, 2*bp]``
+    basis whose columns ``[0:bins]`` accumulate ``Re X[k] = sum_n
+    w[n] x[n] cos(2 pi n k / L)`` and columns ``[bins_pad:bins_pad +
+    bins]`` accumulate ``Im X[k] = -sum_n w[n] x[n] sin(...)`` — the
+    window is folded in, and ``bins`` is padded to the 128-lane
+    boundary so every shift-dot is a full-lane MXU operand."""
+    L, s = int(frame_length), int(hop)
+    bins = L // 2 + 1
+    bins_pad = -(-bins // 128) * 128
+    n = np.arange(L)[:, None]
+    k = np.arange(bins)[None, :]
+    ang = 2.0 * np.pi * n * k / L
+    w = np.asarray(window, np.float64)[:, None]
+    full = np.zeros((L, 2 * bins_pad), np.float32)
+    full[:, :bins] = (w * np.cos(ang)).astype(np.float32)
+    full[:, bins_pad:bins_pad + bins] = (
+        -w * np.sin(ang)).astype(np.float32)
+    return full.reshape(L // s, s, 2 * bins_pad)
+
+
+def _stft_kernel(basis_ref, x_ref, o_ref, w_ref, carry_ref, *, r, rows,
+                 precision):
+    """One STFT tile: ``rows`` frames of ``2*bins_pad`` DFT lanes.
+
+    Frame f covers hop-blocks ``[f, f + r)``; with ``W = [carry;
+    x_tile]`` (the previous tile's last ``r - 1`` blocks prefixed, the
+    overlap carried across grid steps in VMEM exactly like
+    :func:`_os_kernel`'s halo), output row i is frame ``t*rows - (r-1)
+    + i`` and decomposes into r shift-dots
+
+        out[i] = sum_j W[i + j] @ basis[j]
+
+    each a ``[rows, hop] x [hop, 2*bins_pad]`` MXU dot against the
+    VMEM-resident windowed basis block — the window multiply and the
+    DFT happen inside the same dots, and the frames tensor the XLA
+    routes materialize never exists.  The first ``r - 1`` output rows
+    of each batch row read zero carry (leading frames that start
+    before the signal) and are sliced off by the caller."""
+    jb = r - 1
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _():
+        # first tile of each batch row: zero overlap history
+        carry_ref[...] = jnp.zeros(carry_ref.shape, carry_ref.dtype)
+
+    w_ref[0:jb, :] = carry_ref[...]
+    w_ref[jb:, :] = x_ref[0]
+    for j in range(r):
+        lhs = w_ref[j:j + rows, :]
+        term = jax.lax.dot_general(
+            lhs, basis_ref[j],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            precision=precision, preferred_element_type=jnp.float32)
+        o_ref[0] = term if j == 0 else o_ref[0] + term
+    carry_ref[...] = x_ref[0, rows - jb:, :]
+
+
+@functools.partial(obs.instrumented_jit, op="stft",
+                   route="pallas_fused",
+                   static_argnames=("r", "rows", "bins", "precision",
+                                    "interpret"))
+def _stft_call(x3d, basis, r, rows, bins, precision, interpret):
+    B, blocks_pad, s = x3d.shape
+    bp2 = basis.shape[-1]
+    kernel = functools.partial(_stft_kernel, r=r, rows=rows,
+                               precision=jax.lax.Precision(precision))
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, blocks_pad // rows),
+        in_specs=[pl.BlockSpec((r, s, bp2), lambda b, t: (0, 0, 0)),
+                  pl.BlockSpec((1, rows, s), lambda b, t: (b, t, 0))],
+        out_specs=pl.BlockSpec((1, rows, bp2), lambda b, t: (b, t, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, blocks_pad, bp2),
+                                       jnp.float32),
+        scratch_shapes=[pltpu.VMEM((r - 1 + rows, s), jnp.float32),
+                        pltpu.VMEM((r - 1, s), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * B * blocks_pad * r * s * bp2,
+            bytes_accessed=4 * (B * blocks_pad * (s + bp2)
+                                + r * s * bp2),
+            transcendentals=0),
+        interpret=interpret,
+    )(basis, x3d)
+    half = bp2 // 2
+    return jax.lax.complex(out[..., :bins], out[..., half:half + bins])
+
+
+def stft_pallas(x, frame_length: int, hop: int, window=None,
+                rows: int = PALLAS_STFT_ROWS, precision="highest",
+                interpret=None, basis=None):
+    """Short-time Fourier transform as one fused Pallas kernel:
+    ``x[..., n] -> complex64 [..., frames, frame_length // 2 + 1]``
+    with ``frames = 1 + (n - frame_length) // hop`` (the
+    :func:`veles.simd_tpu.ops.spectral.stft` contract).
+
+    The XLA routes materialize a ``[frames, frame_length]`` tensor —
+    ``frame_length / hop`` copies of the signal through HBM before the
+    window multiply and transform read it.  This kernel streams x
+    through VMEM exactly once: each grid step loads ``rows`` hop-blocks,
+    keeps the ``frame_length - hop`` sample overlap from the previous
+    step in a VMEM carry, and evaluates window-multiply + real-DFT as
+    ``r = frame_length // hop`` per-shift ``[rows, hop] @ [hop,
+    2*bins_pad]`` MXU dots against the resident windowed basis
+    (derivation at :func:`_stft_kernel`).
+
+    Contract: ``hop`` must divide ``frame_length`` (the standard STFT
+    overlap family — the carry is whole hop-blocks), be a 128-lane
+    multiple, and ``frame_length > hop`` (no overlap means no carry —
+    use the rdft-matmul route).  ``window`` is resolved like
+    :func:`~veles.simd_tpu.ops.spectral.stft` (None = periodic Hann);
+    ``basis`` overrides the windowed basis blocks (the spectral
+    dispatch layer passes its LRU-cached copy).  ``precision`` is the
+    MXU pass count; ``interpret=None`` auto-selects compiled Mosaic on
+    TPU, interpreter elsewhere (the CPU test path)."""
+    L, s = int(frame_length), int(hop)
+    if L % s != 0:
+        raise ValueError(
+            f"fused STFT needs hop | frame_length, got {s}, {L} "
+            "(use the rdft_matmul route for non-dividing hops)")
+    if s % 128 != 0:
+        raise ValueError(f"hop {s} must be a 128-lane multiple")
+    r = L // s
+    if r < 2:
+        raise ValueError("fused STFT needs frame_length > hop (no "
+                         "overlap to carry; use the rdft_matmul route)")
+    n = x.shape[-1]
+    if n < L:
+        raise ValueError(f"signal length {n} < frame_length {L}")
+    frames = 1 + (n - L) // s
+    jb = r - 1
+    if interpret is None:
+        interpret = not pallas_available()
+    blocks = -(-n // s)
+    # shrink the row tile for short signals (8-sublane multiples), but
+    # never below the carry's block count
+    r_tile = min(int(rows), max(8, -(-blocks // 8) * 8))
+    r_tile = max(r_tile, -(-jb // 8) * 8)
+    if not interpret and not fits_vmem_stft(L, s, r_tile):
+        raise ValueError(
+            f"fused STFT basis for frame_length={L}, hop={s} exceeds "
+            "the kernel VMEM budget; keep this shape on the XLA path")
+    blocks_pad = -(-blocks // r_tile) * r_tile
+    batch_shape = x.shape[:-1]
+    x2d = jnp.asarray(x, jnp.float32).reshape(-1, n)
+    x3d = jnp.pad(x2d, [(0, 0), (0, blocks_pad * s - n)]).reshape(
+        -1, blocks_pad, s)
+    if basis is None:
+        from veles.simd_tpu.ops.spectral import _resolve_window
+
+        basis = _stft_basis_blocks(L, s, _resolve_window(window, L))
+    out = _stft_call(x3d, jnp.asarray(basis), r, r_tile, L // 2 + 1,
+                     str(precision), bool(interpret))
+    out = out[:, jb:jb + frames, :]
+    return out.reshape(batch_shape + (frames, L // 2 + 1))

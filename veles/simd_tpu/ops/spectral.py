@@ -11,6 +11,22 @@ XLA, no host round-trips.
 
 Design notes (TPU-first):
 
+* **Route selection** (the paper's best-algorithm-per-op mechanism,
+  extended from the convolve family to spectral): ``stft``/``istft``
+  pick between ``rdft_matmul`` — precomputed real-DFT basis matrices
+  (window folded in, LRU-cached per geometry) so the transform is a
+  dense ``frames @ W`` MXU matmul, the formulation "Large-Scale
+  Discrete Fourier Transform on TPUs" (arXiv:2002.03260) and TINA
+  (arXiv:2408.16551) show these accelerators want at STFT frame
+  sizes — ``pallas_fused`` (the fused framing+window+DFT Mosaic
+  kernel, :func:`~veles.simd_tpu.ops.pallas_kernels.stft_pallas`),
+  and ``xla_fft`` (XLA's FFT lowering, the long-frame fallback).
+  ``hilbert``/``morlet_cwt`` gain the same ``matmul_dft`` route for
+  short signals.  Every route is labeled through
+  ``obs.instrumented_jit`` and recorded as a ``*_route`` decision
+  event; selectors live in :func:`_use_matmul_dft` /
+  :func:`_use_pallas_stft`, opt-outs in ``VELES_SIMD_DISABLE_DFT_MATMUL``
+  and ``VELES_SIMD_DISABLE_STFT_PALLAS``.
 * **Framing** is a static gather: the ``[frames, frame_length]`` index
   matrix is built host-side at trace time, so XLA sees one fused
   ``gather → window-multiply → rfft`` program with static shapes.
@@ -31,13 +47,17 @@ discipline (``/root/reference/tests/matrix.cc:94-98``).
 
 from __future__ import annotations
 
+import collections
 import functools
+import os
+import threading
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from veles.simd_tpu import obs
+from veles.simd_tpu.ops import pallas_kernels as _pk
 from veles.simd_tpu.utils.config import resolve_simd
 # complex host<->device moves MUST go through to_device/to_host: the
 # axon relay cannot transfer complex buffers in either direction and one
@@ -53,6 +73,133 @@ __all__ = [
     "czt", "czt_na", "zoom_fft", "lombscargle",
     "lombscargle_na",
 ]
+
+
+# ---------------------------------------------------------------------------
+# host-side constant cache (DFT bases, analytic multipliers, wavelet
+# banks) + route-selection constants
+# ---------------------------------------------------------------------------
+
+# matmul-DFT routing bound: the [L, 2*bins] basis holds L*(L+2) ~ L^2
+# f32 = ~4*L^2 bytes resident in HBM (67 MB at L=4096) and the
+# per-frame MAC count grows as L^2 vs the FFT's L log L — but at STFT
+# frame sizes the MXU's throughput advantage over XLA's TPU FFT dwarfs
+# the op-count gap (arXiv:2002.03260 measures matmul-DFT at this
+# regime; XLA's 1D FFT leaves the MXU idle)
+AUTO_DFT_MATMUL_MAX_FRAME = 4096
+# hilbert's circulant analytic-signal operator is a dense [n, n] pair —
+# 8 MB at n=1024; beyond that the FFT's O(n log n) wins outright
+HILBERT_MATMUL_MAX_N = 1024
+# same residency math for the CWT's positive-frequency basis pair
+CWT_MATMUL_MAX_N = 1024
+_DFT_MATMUL_ENV = "VELES_SIMD_DISABLE_DFT_MATMUL"
+
+
+def dft_matmul_allowed() -> bool:
+    """May implicit routing use the matmul-DFT routes (stft/istft
+    ``rdft_matmul``, hilbert/cwt ``matmul_dft``)?  True unless
+    explicitly disabled — the family-wide escape hatch mirroring
+    ``VELES_SIMD_DISABLE_PALLAS_OS`` for the fused conv kernel."""
+    return os.environ.get(_DFT_MATMUL_ENV, "0").strip().lower() not in (
+        "1", "true", "yes", "on")
+
+
+# Host-side constants used to be rebuilt per call (the analytic
+# multiplier, the Morlet bank) — harmless for one-shot scripts, pure
+# waste for a service hitting the same geometry per request.  One
+# bounded LRU holds them all: DFT bases keyed by (kind, geometry,
+# window bytes), multipliers/banks by (kind, geometry).  64 entries
+# covers a steady state while keeping eviction observable.
+_HOST_CACHE_MAXSIZE = 64
+_host_cache: "collections.OrderedDict[tuple, object]" = \
+    collections.OrderedDict()
+_host_lock = threading.Lock()
+_host_stats = {"hits": 0, "misses": 0, "evictions": 0}
+
+
+def _cached_host(key, build):
+    """LRU lookup of a host-side constant; ``build()`` makes it on a
+    miss (outside the lock — basis construction can be milliseconds;
+    worst case two threads race the same key and one value wins)."""
+    with _host_lock:
+        hit = _host_cache.get(key)
+        if hit is not None:
+            _host_cache.move_to_end(key)
+            _host_stats["hits"] += 1
+            return hit
+        _host_stats["misses"] += 1
+    value = build()
+    with _host_lock:
+        existing = _host_cache.get(key)
+        if existing is not None:
+            return existing
+        _host_cache[key] = value
+        while len(_host_cache) > _HOST_CACHE_MAXSIZE:
+            _host_cache.popitem(last=False)
+            _host_stats["evictions"] += 1
+    return value
+
+
+def _host_cache_info() -> dict:
+    with _host_lock:
+        return {"size": len(_host_cache),
+                "capacity": _HOST_CACHE_MAXSIZE, **_host_stats,
+                "keys": [k[0] for k in _host_cache]}
+
+
+obs.register_cache("spectral_host_lru", _host_cache_info)
+
+# Device-resident twin: the host LRU dedupes CONSTRUCTION of a basis,
+# this one dedupes the UPLOAD — ``jnp.asarray`` on a cached numpy
+# array still transfers a fresh device buffer every call (~67 MB per
+# stft at L=4096).  Smaller bound than the host cache because entries
+# pin HBM; eviction just means one re-upload if the geometry returns.
+_DEVICE_CACHE_MAXSIZE = 16
+_device_cache: "collections.OrderedDict[tuple, object]" = \
+    collections.OrderedDict()
+_device_lock = threading.Lock()
+_device_stats = {"hits": 0, "misses": 0, "evictions": 0}
+
+
+def _cached_device(key, build_device):
+    """LRU lookup of a device-resident constant; ``build_device()``
+    uploads (and may first host-build via :func:`_cached_host`) on a
+    miss.  Same race discipline as the host cache.
+
+    Under an ACTIVE trace ``jnp.asarray`` yields a tracer, not a
+    buffer — caching it would leak the tracer into later eager calls
+    (UnexpectedTracerError), so traced uploads are returned uncached;
+    the first eager call for the geometry populates the cache."""
+    with _device_lock:
+        hit = _device_cache.get(key)
+        if hit is not None:
+            _device_cache.move_to_end(key)
+            _device_stats["hits"] += 1
+            return hit
+        _device_stats["misses"] += 1
+    value = build_device()
+    leaves = value if isinstance(value, tuple) else (value,)
+    if any(isinstance(leaf, jax.core.Tracer) for leaf in leaves):
+        return value
+    with _device_lock:
+        existing = _device_cache.get(key)
+        if existing is not None:
+            return existing
+        _device_cache[key] = value
+        while len(_device_cache) > _DEVICE_CACHE_MAXSIZE:
+            _device_cache.popitem(last=False)
+            _device_stats["evictions"] += 1
+    return value
+
+
+def _device_cache_info() -> dict:
+    with _device_lock:
+        return {"size": len(_device_cache),
+                "capacity": _DEVICE_CACHE_MAXSIZE, **_device_stats,
+                "keys": [k[0] for k in _device_cache]}
+
+
+obs.register_cache("spectral_device_lru", _device_cache_info)
 
 
 def hann_window(frame_length: int, dtype=np.float32) -> np.ndarray:
@@ -163,33 +310,234 @@ def _take_frames(x, frame_length, hop):
     return jax.lax.slice_in_dim(inter, 0, frames, axis=-2)
 
 
-@functools.partial(obs.instrumented_jit,
+def _rdft_basis(frame_length: int, window) -> np.ndarray:
+    """``[frame_length, 2*bins]`` real-DFT analysis basis with the
+    window folded in: ``frames @ basis`` gives ``[Re X | Im X]``
+    (``Re X[k] = sum_n w[n] f[n] cos(2 pi n k / L)``, ``Im X[k] =
+    -sum_n w[n] f[n] sin(...)``).  LRU-cached per (frame_length,
+    window) — the ``rdft_matmul`` route's whole point is that this
+    matrix is built once and the transform is a dense MXU matmul."""
+    L = int(frame_length)
+    window = np.asarray(window, np.float32)
+    key = ("rdft_fwd", L, window.tobytes())
+
+    def build():
+        bins = L // 2 + 1
+        n = np.arange(L)[:, None]
+        k = np.arange(bins)[None, :]
+        ang = 2.0 * np.pi * n * k / L
+        w = np.asarray(window, np.float64)[:, None]
+        return np.concatenate([w * np.cos(ang), -w * np.sin(ang)],
+                              axis=1).astype(np.float32)
+
+    return _cached_host(key, build)
+
+
+def _rdft_inv_basis(frame_length: int, window) -> np.ndarray:
+    """``[2*bins, frame_length]`` real-DFT synthesis basis with the
+    window folded in: ``[Re X | Im X] @ inv_basis`` gives the
+    window-multiplied time frame ``w[n] * (1/L) [X[0] + 2 sum_k (Re
+    cos - Im sin) + X[Nyq] (-1)^n]`` — the irfft as one matmul,
+    feeding the existing overlap-add."""
+    L = int(frame_length)
+    window = np.asarray(window, np.float32)
+    key = ("rdft_inv", L, window.tobytes())
+
+    def build():
+        bins = L // 2 + 1
+        alpha = np.full(bins, 2.0)
+        alpha[0] = 1.0
+        if L % 2 == 0:
+            alpha[-1] = 1.0
+        k = np.arange(bins)[:, None]
+        n = np.arange(L)[None, :]
+        ang = 2.0 * np.pi * k * n / L
+        w = np.asarray(window, np.float64)[None, :]
+        scale = (alpha / L)[:, None]
+        return np.concatenate([scale * np.cos(ang) * w,
+                               -scale * np.sin(ang) * w],
+                              axis=0).astype(np.float32)
+
+    return _cached_host(key, build)
+
+
+@functools.partial(obs.instrumented_jit, op="stft", route="xla_fft",
                    static_argnames=("frame_length", "hop"))
 def _stft_xla(x, window, frame_length, hop):
     frames = _take_frames(x, frame_length, hop)
     return jnp.fft.rfft(frames * window, axis=-1)
 
 
-def stft(x, frame_length: int, hop: int, window=None, simd=None):
+@functools.partial(obs.instrumented_jit, op="stft",
+                   route="rdft_matmul",
+                   static_argnames=("frame_length", "hop"))
+def _stft_rdft(x, basis, frame_length, hop):
+    frames = _take_frames(x, frame_length, hop)
+    out = jnp.einsum("...fl,lb->...fb", frames, basis,
+                     precision=jax.lax.Precision.HIGHEST)
+    bins = frame_length // 2 + 1
+    return jax.lax.complex(out[..., :bins], out[..., bins:])
+
+
+# (frame_length, hop) classes whose fused-STFT compile OOMed Mosaic's
+# scoped-vmem stack — the demote-and-remember discipline the conv
+# routes learned on hardware (convolve2d._PALLAS2D_OOM_REJECTED)
+_STFT_PALLAS_REJECTED = set()
+obs.register_cache(
+    "stft_pallas_rejected",
+    lambda: {"size": len(_STFT_PALLAS_REJECTED), "capacity": None,
+             "keys": sorted(_STFT_PALLAS_REJECTED)})
+
+
+def _use_matmul_dft(frame_length: int) -> bool:
+    """Route a spectral transform through the precomputed real-DFT
+    basis matmul: the MXU-native formulation for the frame sizes STFT
+    actually uses (XLA's TPU FFT leaves the MXU idle; arXiv:2002.03260
+    and TINA both compute the DFT as dense matmul there).  Long frames
+    stay on the FFT — past :data:`AUTO_DFT_MATMUL_MAX_FRAME` the
+    basis residency and the L^2 MAC growth lose to L log L.  Opt out
+    family-wide with ``VELES_SIMD_DISABLE_DFT_MATMUL``."""
+    return (dft_matmul_allowed()
+            and int(frame_length) <= AUTO_DFT_MATMUL_MAX_FRAME)
+
+
+def _use_pallas_stft(frame_length: int, hop: int, frames: int) -> bool:
+    """Route STFT through the fused Pallas kernel
+    (:func:`~veles.simd_tpu.ops.pallas_kernels.stft_pallas`): the
+    rdft-matmul route still materializes its ``[frames, frame_length]``
+    operand — ``frame_length/hop`` copies of x through HBM — while the
+    fused kernel streams x through VMEM once with the overlap carried
+    between grid steps.  Compiled Mosaic only (the interpreter would be
+    a slowdown), dividing 128-multiple hops (the kernel's block
+    contract), enough frames to amortize dispatch, resident basis
+    within the VMEM budget, opt-out via
+    ``VELES_SIMD_DISABLE_STFT_PALLAS``, and never a (frame, hop) class
+    that already OOMed Mosaic's scoped stack.  Tests monkeypatch this
+    gate to exercise the kernel on CPU."""
+    L, s = int(frame_length), int(hop)
+    return (_pk.pallas_available() and _pk.stft_pallas_allowed()
+            and L % s == 0 and s % 128 == 0 and L // s >= 2
+            and int(frames) >= _pk.PALLAS_STFT_MIN_FRAMES
+            and _pk.fits_vmem_stft(L, s)
+            and (L, s) not in _STFT_PALLAS_REJECTED)
+
+
+def _select_stft_route(frame_length: int, hop: int, frames: int) -> str:
+    """The stft route decision, in priority order (single home — the
+    public entry point, ``batched.batched_stft``, and bench all ask
+    here)."""
+    if _use_pallas_stft(frame_length, hop, frames):
+        return "pallas_fused"
+    if _use_matmul_dft(frame_length):
+        return "rdft_matmul"
+    return "xla_fft"
+
+
+def _device_basis(kind, length, window, build_host):
+    """Device-cached windowed basis: construction deduped by the host
+    LRU (inside ``build_host``), upload deduped here."""
+    window = np.asarray(window, np.float32)
+    key = (kind, int(length), window.tobytes())
+    return _cached_device(key, lambda: jnp.asarray(build_host()))
+
+
+def _run_stft_xla(x, window, frame_length, hop, forced=False):
+    del forced
+    return _stft_xla(jnp.asarray(x, jnp.float32), jnp.asarray(window),
+                     frame_length, hop)
+
+
+def _run_stft_rdft(x, window, frame_length, hop, forced=False):
+    del forced
+    basis = _device_basis("rdft_fwd", frame_length, window,
+                          lambda: _rdft_basis(frame_length, window))
+    return _stft_rdft(jnp.asarray(x, jnp.float32), basis,
+                      frame_length, hop)
+
+
+def _stft_pallas_basis(frame_length, hop, window):
+    window = np.asarray(window, np.float32)
+    key = ("stft_pallas", int(frame_length), int(hop), window.tobytes())
+    host = _cached_host(key, lambda: _pk._stft_basis_blocks(
+        frame_length, hop, window))
+    return _cached_device(key, lambda: jnp.asarray(host))
+
+
+def _run_stft_pallas(x, window, frame_length, hop, forced=False):
+    """The fused-kernel route, with the Mosaic vmem-OOM
+    demote-and-remember fallback the conv routes use: the scoped-stack
+    cap is not predictable from shape arithmetic, so the specific
+    compile error demotes this (frame, hop) class to the matmul/FFT
+    route and records the demotion (decision event + counter) so the
+    executed route is never misattributed.  A FORCED pallas route
+    still remembers the rejection but re-raises — a caller who pinned
+    the kernel (benchmark, bisect) must never silently get another
+    route's numbers."""
+    basis = _stft_pallas_basis(frame_length, hop, window)
+    try:
+        return _pk.stft_pallas(x, frame_length, hop, basis=basis)
+    except Exception as e:
+        from veles.simd_tpu.ops.convolve2d import _is_mosaic_vmem_oom
+
+        if not _is_mosaic_vmem_oom(e):
+            raise
+        _STFT_PALLAS_REJECTED.add((int(frame_length), int(hop)))
+        obs.count("stft_pallas_demotion", reason="compile_oom")
+        if forced:
+            raise
+        fallback = ("rdft_matmul" if _use_matmul_dft(frame_length)
+                    else "xla_fft")
+        obs.record_decision(
+            "stft_route", fallback, frame_length=int(frame_length),
+            hop=int(hop), demoted_from="pallas_fused")
+        return _STFT_ROUTES[fallback](x, window, frame_length, hop)
+
+
+_STFT_ROUTES = {"xla_fft": _run_stft_xla,
+                "rdft_matmul": _run_stft_rdft,
+                "pallas_fused": _run_stft_pallas}
+
+
+def stft(x, frame_length: int, hop: int, window=None, simd=None,
+         route=None):
     """Short-time Fourier transform.
 
     ``x[..., n] -> complex64 [..., frames, frame_length // 2 + 1]`` with
     ``frames = 1 + (n - frame_length) // hop`` (no padding — trailing
     samples short of a full frame are dropped, symmetric with
     :func:`istft`).  ``window`` defaults to the periodic Hann window.
+
+    ``route`` forces one of ``rdft_matmul`` / ``pallas_fused`` /
+    ``xla_fft`` (None auto-selects via :func:`_select_stft_route`);
+    the chosen route is recorded as a ``stft_route`` decision event.
     """
     x_np = np.asarray(x) if not hasattr(x, "shape") else x
     _check_stft_args(x_np.shape[-1], frame_length, hop)
     window = _resolve_window(window, frame_length)
     if resolve_simd(simd, op="stft"):
+        n = int(x_np.shape[-1])
+        frames = frame_count(n, frame_length, hop)
+        forced = route is not None
+        if forced and route not in _STFT_ROUTES:
+            raise ValueError(
+                f"route must be one of {sorted(_STFT_ROUTES)}, "
+                f"got {route!r}")
+        chosen = route if forced else _select_stft_route(
+            frame_length, hop, frames)
         path = _framing_path(frame_length, hop)
         obs.record_decision(
-            "stft", path,
-            n=int(x_np.shape[-1]), frame_length=int(frame_length),
+            "stft_route", chosen, n=n, frame_length=int(frame_length),
+            hop=int(hop), frames=int(frames), forced=forced)
+        # the framing-path decision stays the LAST event (the 99x-STFT
+        # telemetry contract, pinned by test_obs.py)
+        obs.record_decision(
+            "stft", path, n=n, frame_length=int(frame_length),
             hop=int(hop))
-        with obs.span("stft.dispatch", path=path):
-            return _stft_xla(jnp.asarray(x, jnp.float32),
-                             jnp.asarray(window), frame_length, hop)
+        with obs.span("stft.dispatch", route=chosen, path=path):
+            # x_np, not x: every runner needs .shape (lists/tuples are
+            # supported inputs, same as the pre-route code)
+            return _STFT_ROUTES[chosen](x_np, window, frame_length,
+                                        hop, forced=forced)
     return stft_na(x, frame_length, hop, window).astype(np.complex64)
 
 
@@ -246,15 +594,48 @@ def _overlap_add(frames, n, frame_length, hop):
     return total
 
 
-@functools.partial(obs.instrumented_jit,
+@functools.partial(obs.instrumented_jit, op="istft", route="xla_fft",
                    static_argnames=("n", "frame_length", "hop"))
 def _istft_xla(spec, window, env_inv, n, frame_length, hop):
     frames = jnp.fft.irfft(spec, frame_length, axis=-1) * window
     return _overlap_add(frames, n, frame_length, hop) * env_inv
 
 
+@functools.partial(obs.instrumented_jit, op="istft",
+                   route="rdft_matmul",
+                   static_argnames=("n", "frame_length", "hop"))
+def _istft_rdft(spec, inv_basis, env_inv, n, frame_length, hop):
+    parts = jnp.concatenate([jnp.real(spec), jnp.imag(spec)], axis=-1)
+    frames = jnp.einsum("...fb,bl->...fl", parts, inv_basis,
+                        precision=jax.lax.Precision.HIGHEST)
+    return _overlap_add(frames, n, frame_length, hop) * env_inv
+
+
+def _run_istft_xla(spec, window, env_inv, n, frame_length, hop,
+                   forced=False):
+    del forced
+    return _istft_xla(to_device(spec, jnp.complex64),
+                      jnp.asarray(window), jnp.asarray(env_inv),
+                      n, frame_length, hop)
+
+
+def _run_istft_rdft(spec, window, env_inv, n, frame_length, hop,
+                    forced=False):
+    del forced
+    inv_basis = _device_basis(
+        "rdft_inv", frame_length, window,
+        lambda: _rdft_inv_basis(frame_length, window))
+    return _istft_rdft(to_device(spec, jnp.complex64),
+                       inv_basis, jnp.asarray(env_inv),
+                       n, frame_length, hop)
+
+
+_ISTFT_ROUTES = {"xla_fft": _run_istft_xla,
+                 "rdft_matmul": _run_istft_rdft}
+
+
 def istft(spec, n: int, frame_length: int, hop: int, window=None,
-          simd=None):
+          simd=None, route=None):
     """Inverse STFT by windowed overlap-add with COLA normalization.
 
     Reconstructs the length-``n`` signal from ``stft(x, ...)`` output.
@@ -263,6 +644,10 @@ def istft(spec, n: int, frame_length: int, hop: int, window=None,
     that is every sample except the first/last ``frame_length - hop``
     (where fewer windows overlap — there the least-squares estimate is
     still returned, normalized by the partial envelope).
+
+    ``route`` forces ``rdft_matmul`` (inverse-basis matmul feeding the
+    overlap-add) or ``xla_fft`` (None auto-selects; the chosen route is
+    recorded as an ``istft_route`` decision event).
     """
     _check_stft_args(n, frame_length, hop)
     window = _resolve_window(window, frame_length)
@@ -275,17 +660,31 @@ def istft(spec, n: int, frame_length: int, hop: int, window=None,
             f"frame_length={frame_length}, hop={hop} (expect "
             f"{(frames, frame_length // 2 + 1)})")
     if resolve_simd(simd, op="istft"):
+        forced = route is not None
+        if forced and route not in _ISTFT_ROUTES:
+            raise ValueError(
+                f"route must be one of {sorted(_ISTFT_ROUTES)}, "
+                f"got {route!r}")
+        chosen = route if forced else (
+            "rdft_matmul" if _use_matmul_dft(frame_length)
+            else "xla_fft")
         # the adjoint decomposition: framing gather <-> overlap-add
         # scatter, framing reshape <-> per-phase reshape adds
         path = ("scatter" if _framing_path(frame_length, hop) == "gather"
                 else "reshape_overlap_add")
         obs.record_decision(
+            "istft_route", chosen, n=int(n),
+            frame_length=int(frame_length), hop=int(hop),
+            forced=forced)
+        # the overlap-add path decision stays the LAST event (the
+        # telemetry contract test_obs.py pins)
+        obs.record_decision(
             "istft", path, n=int(n), frame_length=int(frame_length),
             hop=int(hop))
-        with obs.span("istft.dispatch", path=path):
-            return _istft_xla(to_device(spec, jnp.complex64),
-                              jnp.asarray(window), jnp.asarray(env_inv),
-                              n, frame_length, hop)
+        with obs.span("istft.dispatch", route=chosen, path=path):
+            return _ISTFT_ROUTES[chosen](spec, window, env_inv, n,
+                                         frame_length, hop,
+                                         forced=forced)
     return istft_na(spec, n, frame_length, hop, window).astype(np.float32)
 
 
@@ -303,9 +702,11 @@ def istft_na(spec, n: int, frame_length: int, hop: int, window=None):
     return out * _env_inv(n, frame_length, hop, window)
 
 
-def spectrogram(x, frame_length: int, hop: int, window=None, simd=None):
-    """Power spectrogram ``|STFT|^2`` -> f32 [..., frames, bins]."""
-    s = stft(x, frame_length, hop, window, simd=simd)
+def spectrogram(x, frame_length: int, hop: int, window=None, simd=None,
+                route=None):
+    """Power spectrogram ``|STFT|^2`` -> f32 [..., frames, bins].
+    ``route`` passes through to :func:`stft`."""
+    s = stft(x, frame_length, hop, window, simd=simd, route=route)
     if resolve_simd(simd, op="spectrogram"):
         return (s.real ** 2 + s.imag ** 2).astype(jnp.float32)
     return (np.abs(s) ** 2).astype(np.float32)
@@ -319,35 +720,84 @@ def spectrogram_na(x, frame_length: int, hop: int, window=None):
 def _analytic_multiplier(n: int) -> np.ndarray:
     """Frequency-domain step for the analytic signal: keep DC (and
     Nyquist when n is even) at 1, double positive frequencies, zero the
-    negatives."""
-    h = np.zeros(n, np.float32)
-    h[0] = 1.0
-    if n % 2 == 0:
-        h[n // 2] = 1.0
-        h[1:n // 2] = 2.0
-    else:
-        h[1:(n + 1) // 2] = 2.0
-    return h
+    negatives.  Cached per length (was rebuilt every call)."""
+    def build():
+        h = np.zeros(n, np.float32)
+        h[0] = 1.0
+        if n % 2 == 0:
+            h[n // 2] = 1.0
+            h[1:n // 2] = 2.0
+        else:
+            h[1:(n + 1) // 2] = 2.0
+        return h
+
+    return _cached_host(("analytic_mult", int(n)), build)
 
 
-@obs.instrumented_jit
+def _hilbert_basis(n: int) -> np.ndarray:
+    """``[2, n, n]`` real/imag circulant of the analytic-signal
+    operator ``ifft(diag(mult) fft)``: row a, column b holds
+    ``ifft(mult)[(b - a) mod n]``, so the whole transform is two dense
+    [n, n] MXU matmuls — and, unlike the FFT route, moves no complex
+    buffers (the axon relay cannot transfer complex either way)."""
+    def build():
+        m = np.fft.ifft(np.asarray(_analytic_multiplier(n), np.float64))
+        idx = (np.arange(n)[None, :] - np.arange(n)[:, None]) % n
+        circ = m[idx]
+        return np.stack([circ.real, circ.imag]).astype(np.float32)
+
+    return _cached_host(("hilbert_matmul", int(n)), build)
+
+
+@functools.partial(obs.instrumented_jit, op="hilbert", route="xla_fft")
 def _hilbert_xla(x, mult):
     return jnp.fft.ifft(jnp.fft.fft(x, axis=-1) * mult, axis=-1)
 
 
-def hilbert(x, simd=None):
+@functools.partial(obs.instrumented_jit, op="hilbert",
+                   route="matmul_dft")
+def _hilbert_matmul(x, basis):
+    re = jnp.einsum("...n,nm->...m", x, basis[0],
+                    precision=jax.lax.Precision.HIGHEST)
+    im = jnp.einsum("...n,nm->...m", x, basis[1],
+                    precision=jax.lax.Precision.HIGHEST)
+    return jax.lax.complex(re, im)
+
+
+def hilbert(x, simd=None, route=None):
     """Analytic signal ``x + i * H[x]`` (complex64 [..., n]).
 
     The imaginary part is the Hilbert transform; :func:`envelope` is its
     magnitude.  Frequency-domain construction (zero negative
-    frequencies), the standard DFT definition.
+    frequencies), the standard DFT definition.  Short signals
+    (``n <= HILBERT_MATMUL_MAX_N``) route through the dense circulant
+    operator on the MXU (``matmul_dft``); ``route`` forces either path.
     """
     n = np.shape(x)[-1]
     if n == 0:
         raise ValueError("empty signal")
-    mult = _analytic_multiplier(n)
     if resolve_simd(simd, op="hilbert"):
-        return _hilbert_xla(jnp.asarray(x, jnp.float32), jnp.asarray(mult))
+        forced = route is not None
+        if forced and route not in ("matmul_dft", "xla_fft"):
+            raise ValueError(
+                f"route must be 'matmul_dft' or 'xla_fft', got "
+                f"{route!r}")
+        chosen = route if forced else (
+            "matmul_dft" if dft_matmul_allowed()
+            and n <= HILBERT_MATMUL_MAX_N else "xla_fft")
+        obs.record_decision("hilbert_route", chosen, n=int(n),
+                            forced=forced)
+        with obs.span("hilbert.dispatch", route=chosen):
+            if chosen == "matmul_dft":
+                basis = _cached_device(
+                    ("hilbert_matmul", int(n)),
+                    lambda: jnp.asarray(_hilbert_basis(n)))
+                return _hilbert_matmul(jnp.asarray(x, jnp.float32),
+                                       basis)
+            mult = _cached_device(
+                ("analytic_mult", int(n)),
+                lambda: jnp.asarray(_analytic_multiplier(n)))
+            return _hilbert_xla(jnp.asarray(x, jnp.float32), mult)
     return hilbert_na(x).astype(np.complex64)
 
 
@@ -374,28 +824,75 @@ def envelope_na(x):
 def _morlet_hat(scales, n, w0):
     """Frequency response of the (analytic) Morlet wavelet at each scale:
     ``pi^-1/4 * exp(-(s*omega - w0)^2 / 2)`` for positive omega, with the
-    L2 normalization ``sqrt(2 pi s / dt)`` (dt = 1)."""
-    omega = 2 * np.pi * np.fft.fftfreq(n)  # [n]
-    s = np.asarray(scales, np.float64)[:, None]  # [S, 1]
-    hat = (np.pi ** -0.25) * np.exp(-0.5 * (s * omega - w0) ** 2)
-    hat *= (omega > 0)  # analytic: positive frequencies only
-    hat *= np.sqrt(2 * np.pi * s)
-    return hat  # [S, n] float64
+    L2 normalization ``sqrt(2 pi s / dt)`` (dt = 1).  Cached per
+    (scales, n, w0) — was rebuilt every call."""
+    scales = np.asarray(scales, np.float64)
+    key = ("morlet_hat", scales.tobytes(), int(n), float(w0))
+
+    def build():
+        omega = 2 * np.pi * np.fft.fftfreq(n)  # [n]
+        s = scales[:, None]  # [S, 1]
+        hat = (np.pi ** -0.25) * np.exp(-0.5 * (s * omega - w0) ** 2)
+        hat *= (omega > 0)  # analytic: positive frequencies only
+        hat *= np.sqrt(2 * np.pi * s)
+        return hat  # [S, n] float64
+
+    return _cached_host(key, build)
 
 
-@obs.instrumented_jit
+def _cwt_basis(n: int):
+    """Positive-frequency DFT basis pair for the short-signal matmul
+    CWT: ``fwd`` [n, 2K] maps x to ``[Re X | Im X]`` at the K strictly
+    positive frequencies (the only ones the analytic Morlet bank keeps
+    — ``_morlet_hat`` zeroes omega <= 0), ``ic``/``is_`` [K, n] are the
+    cos/sin inverse-DFT factors with the 1/n fold.  Cached per n."""
+    def build():
+        kpos = np.arange(1, (n + 1) // 2)
+        m = np.arange(n)
+        ang = 2.0 * np.pi * m[:, None] * kpos[None, :] / n
+        fwd = np.concatenate([np.cos(ang), -np.sin(ang)],
+                             axis=1).astype(np.float32)
+        angi = 2.0 * np.pi * kpos[:, None] * m[None, :] / n
+        ic = (np.cos(angi) / n).astype(np.float32)
+        is_ = (np.sin(angi) / n).astype(np.float32)
+        return fwd, ic, is_
+
+    return _cached_host(("cwt_matmul", int(n)), build)
+
+
+@functools.partial(obs.instrumented_jit, op="morlet_cwt",
+                   route="xla_fft")
 def _cwt_xla(x, hat):
     spec = jnp.fft.fft(x, axis=-1)
     return jnp.fft.ifft(spec[..., None, :] * hat, axis=-1)
 
 
-def morlet_cwt(x, scales, w0: float = 6.0, simd=None):
+@functools.partial(obs.instrumented_jit, op="morlet_cwt",
+                   route="matmul_dft")
+def _cwt_matmul(x, fwd, hat, ic, is_):
+    hi = jax.lax.Precision.HIGHEST
+    K = hat.shape[-1]
+    xf = jnp.einsum("...n,nk->...k", x, fwd, precision=hi)
+    a = xf[..., None, :K] * hat          # [..., S, K] Re X * hat
+    b = xf[..., None, K:] * hat          # [..., S, K] Im X * hat
+    out_re = (jnp.einsum("...sk,km->...sm", a, ic, precision=hi)
+              - jnp.einsum("...sk,km->...sm", b, is_, precision=hi))
+    out_im = (jnp.einsum("...sk,km->...sm", a, is_, precision=hi)
+              + jnp.einsum("...sk,km->...sm", b, ic, precision=hi))
+    return jax.lax.complex(out_re, out_im)
+
+
+def morlet_cwt(x, scales, w0: float = 6.0, simd=None, route=None):
     """Continuous wavelet transform with the analytic Morlet wavelet.
 
     ``x[..., n] -> complex64 [..., scales, n]``.  ``scales`` are in
     samples (pseudo-frequency ≈ ``w0 / (2 pi s)`` cycles/sample).  The
     whole scale bank is one batched ``fft -> multiply -> ifft``; the
-    ``[S, n]`` wavelet bank is a host-side constant.
+    ``[S, n]`` wavelet bank is a host-side constant.  Short signals
+    (``n <= CWT_MATMUL_MAX_N``) route through the positive-frequency
+    DFT basis pair as dense MXU matmuls (``matmul_dft``) — which also
+    moves no complex buffers through the relay; ``route`` forces
+    either path.
     """
     scales = np.atleast_1d(np.asarray(scales, np.float64))
     if scales.ndim != 1 or len(scales) == 0 or np.any(scales <= 0):
@@ -404,8 +901,29 @@ def morlet_cwt(x, scales, w0: float = 6.0, simd=None):
     n = np.shape(x)[-1]
     hat = _morlet_hat(scales, n, w0)
     if resolve_simd(simd, op="morlet_cwt"):
-        return _cwt_xla(jnp.asarray(x, jnp.float32),
-                        to_device(hat, jnp.complex64))
+        forced = route is not None
+        if forced and route not in ("matmul_dft", "xla_fft"):
+            raise ValueError(
+                f"route must be 'matmul_dft' or 'xla_fft', got "
+                f"{route!r}")
+        chosen = route if forced else (
+            "matmul_dft" if dft_matmul_allowed()
+            and n <= CWT_MATMUL_MAX_N else "xla_fft")
+        obs.record_decision("morlet_cwt_route", chosen, n=int(n),
+                            scales=len(scales), forced=forced)
+        with obs.span("morlet_cwt.dispatch", route=chosen):
+            if chosen == "matmul_dft":
+                fwd, ic, is_ = _cached_device(
+                    ("cwt_matmul", int(n)),
+                    lambda: tuple(jnp.asarray(a)
+                                  for a in _cwt_basis(n)))
+                K = ic.shape[0]
+                hatp = np.ascontiguousarray(
+                    hat[:, 1:1 + K]).astype(np.float32)
+                return _cwt_matmul(jnp.asarray(x, jnp.float32),
+                                   fwd, jnp.asarray(hatp), ic, is_)
+            return _cwt_xla(jnp.asarray(x, jnp.float32),
+                            to_device(hat, jnp.complex64))
     return morlet_cwt_na(x, scales, w0).astype(np.complex64)
 
 

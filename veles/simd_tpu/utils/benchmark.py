@@ -29,7 +29,8 @@ import numpy as np
 
 __all__ = ["device_time", "device_time_chained", "host_time",
            "rms_normalize", "mxu_peak_tflops", "mxu_f32_bound_tflops",
-           "conv_roofline", "analytical_roofline",
+           "conv_roofline", "stft_roofline", "rfft_flops",
+           "analytical_roofline",
            "roofline_disagreement_pct", "hbm_bw_gbps",
            "MXU_PEAK_TFLOPS_BF16", "MXU_F32_PASSES", "HBM_BW_GBPS",
            "ROOFLINE_DISAGREEMENT_WARN_PCT"]
@@ -125,6 +126,51 @@ def conv_roofline(samples_per_s: float, h_length: int,
     return {"tflops_effective": eff,
             "roofline_bound_tflops": bound,
             "pct_of_roofline": 100.0 * eff / bound,
+            "precision": precision}
+
+
+def rfft_flops(n: int) -> float:
+    """Split-radix real-FFT op-count estimate ``2.5 n log2 n`` — the
+    ``xla_fft`` spectral route's useful-work constant, the measured-%
+    denominator next to the matmul-DFT route's dense count below."""
+    import math
+
+    n = int(n)
+    return 2.5 * n * math.log2(n)
+
+
+def stft_roofline(frames_per_s: float, frame_length: int,
+                  precision: str = "highest",
+                  route: str = "rdft_matmul") -> dict:
+    """Roofline attribution of an STFT frame rate.
+
+    The useful-FLOP constant is per route — the drift-detector
+    contract (``analytical_roofline`` vs these hand constants) only
+    means something when the constant matches the formulation actually
+    run:
+
+    * matmul-DFT routes (``rdft_matmul`` / ``pallas_fused``): the two
+      dense ``[*, L] x [L, bins]`` cos/sin dots, ``4 * L * bins``
+      FLOPs per frame (basis-padding lanes excluded);
+    * ``xla_fft``: the split-radix real-FFT estimate
+      :func:`rfft_flops` (window multiply is noise next to it).
+
+    Returns the same dict shape as :func:`conv_roofline` so bench rows
+    embed it verbatim."""
+    L = int(frame_length)
+    if route in ("rdft_matmul", "pallas_fused"):
+        flops_per_frame = 4.0 * L * (L // 2 + 1)
+    elif route == "xla_fft":
+        flops_per_frame = rfft_flops(L)
+    else:
+        raise ValueError(f"unknown stft route {route!r}")
+    bound = mxu_f32_bound_tflops(precision)
+    eff = flops_per_frame * frames_per_s / 1e12
+    return {"tflops_effective": eff,
+            "roofline_bound_tflops": bound,
+            "pct_of_roofline": 100.0 * eff / bound,
+            "flops_per_frame": flops_per_frame,
+            "route": route,
             "precision": precision}
 
 
