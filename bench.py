@@ -53,6 +53,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from veles.simd_tpu import obs
+from veles.simd_tpu.runtime import faults
 from veles.simd_tpu.utils.benchmark import (
     ROOFLINE_DISAGREEMENT_WARN_PCT, analytical_roofline, conv_roofline,
     device_time, device_time_chained, host_time, rms_normalize,
@@ -573,16 +574,33 @@ class _StageWatchdog:
 
 
 class _StageRunner:
-    """Run each bench stage in a supervised worker thread; skip the
-    stage (and keep going) when it stalls past the budget.
+    """Run each bench stage in a supervised worker thread; retry the
+    stage on transient device faults, then skip it (and keep going)
+    when it stays wedged or broken.
 
-    A wedged device call cannot be cancelled, so the stalled worker is
+    A wedged device call cannot be cancelled, so a stalled worker is
     simply abandoned — it is a daemon thread blocked in native code and
-    dies with the process.  The runner records every skip (and every
-    stage that raised) so the bench JSON tail can say exactly which
-    rows are missing and why, instead of the round-5 behavior where one
-    ``smoke:resample`` wedge hard-exited the process and silently cost
-    every remaining family row.
+    dies with the process.  Fault policy (shared with the dispatch
+    layer, ``runtime/faults.py``): a stage that *wedges* or raises a
+    transient fault (device-lost / timeout per ``faults.is_transient``)
+    is retried up to ``$VELES_SIMD_STAGE_RETRIES`` times (default 1,
+    with the engine's jittered backoff) before being skipped — runs
+    r02-r04 were lost outright to one-shot device-unreachable hangs
+    this retry now absorbs.  Every fault is recorded in ``self.faults``
+    (landing in BENCH_DETAILS.json's tail) and counted
+    (``fault_stage_retry``/``fault_stage_exhausted`` —
+    ``veles_simd_fault_*`` in Prometheus), so a fault-degraded run is
+    distinguishable from a
+    clean one in the artifact itself.  Non-transient errors are NOT
+    retried — a deterministic bug does not deserve a second 300 s
+    budget — and deterministic *wedges* are the accepted cost of the
+    retry: a stage that wedges every time now burns
+    ``(retries+1) * budget`` before the skip (bounded by the default
+    retries=1; the watchdog threshold is 3x the budget, so the skip
+    layer still wins), and a merely-SLOW first attempt may still be
+    running when its retry starts — the same zombie-contention
+    trade-off the skip path below already documents, now also flagged
+    in the artifact by the stage's fault record.
 
     KNOWN TRADE-OFF: a stage that was merely SLOW (not truly wedged)
     may resume after being skipped and run concurrently with later
@@ -598,21 +616,69 @@ class _StageRunner:
 
     _WEDGED = object()
 
-    def __init__(self, timeout_s: float, watchdog: _StageWatchdog):
+    def __init__(self, timeout_s: float, watchdog: _StageWatchdog,
+                 retries: int | None = None):
         self.timeout_s = timeout_s
         self._watchdog = watchdog
         self.skipped = []          # [{"stage": ..., "reason": ...}]
+        self.faults = []           # transient-fault records (tail)
+        if retries is None:
+            try:
+                retries = int(os.environ.get(
+                    "VELES_SIMD_STAGE_RETRIES", "1"))
+            except ValueError:
+                retries = 1
+        self.retries = max(0, retries)
 
     def run(self, name: str, fn):
-        """Execute ``fn()`` under the stage budget.  Returns ``(ok,
-        result)``; ``ok`` is False when the stage wedged (skip recorded)
-        or raised (error recorded) — the caller just moves on."""
-        self._watchdog.stage(name)
+        """Execute ``fn()`` under the stage budget and fault policy.
+        Returns ``(ok, result)``; ``ok`` is False when the stage
+        wedged past its retries (skip recorded) or raised (error
+        recorded) — the caller just moves on."""
+        for attempt in range(self.retries + 1):
+            self._watchdog.stage(name)   # fresh clock per attempt
+            outcome, payload = self._attempt(name, fn)
+            if outcome == "ok":
+                return True, payload
+            transient = (outcome == "wedged"
+                         or faults.is_transient(payload))
+            if transient:
+                kind = ("wedged" if outcome == "wedged" else
+                        "timeout" if faults.is_timeout(payload)
+                        else "device_lost")
+                self.faults.append({
+                    "stage": name, "attempt": attempt, "kind": kind,
+                    "detail": (f"> {self.timeout_s:.0f}s"
+                               if outcome == "wedged"
+                               else repr(payload)[:300])})
+                if attempt < self.retries:
+                    obs.count("fault_stage_retry", stage=name)
+                    print(f"bench.py: stage {name!r} hit a transient "
+                          f"fault ({kind}); retry "
+                          f"{attempt + 1}/{self.retries}",
+                          file=sys.stderr)
+                    time.sleep(faults.backoff_delay(attempt))
+                    continue
+                obs.count("fault_stage_exhausted", stage=name)
+            if outcome == "wedged":
+                print(f"bench.py: stage {name!r} stalled past "
+                      f"{self.timeout_s:.0f}s — relay wedge; skipping "
+                      "it and continuing with the remaining stages",
+                      file=sys.stderr)
+                self.skipped.append(
+                    {"stage": name,
+                     "reason": f"wedged (> {self.timeout_s:.0f}s)"})
+                return False, self._WEDGED
+            return self._failed(name, payload)
+
+    def _attempt(self, name: str, fn):
+        """One supervised execution: ('ok', result) / ('error', exc) /
+        ('wedged', None)."""
         if self.timeout_s <= 0:
             try:
-                return True, fn()
+                return "ok", fn()
             except Exception as e:  # noqa: BLE001 — record, keep going
-                return self._failed(name, e)
+                return "error", e
         box = {}
 
         def work():
@@ -626,16 +692,10 @@ class _StageRunner:
         t.start()
         t.join(self.timeout_s)
         if t.is_alive():
-            print(f"bench.py: stage {name!r} stalled past "
-                  f"{self.timeout_s:.0f}s — relay wedge; skipping it "
-                  "and continuing with the remaining stages",
-                  file=sys.stderr)
-            self.skipped.append({"stage": name, "reason":
-                                 f"wedged (> {self.timeout_s:.0f}s)"})
-            return False, self._WEDGED
+            return "wedged", None
         if "error" in box:
-            return self._failed(name, box["error"])
-        return True, box.get("result")
+            return "error", box["error"]
+        return "ok", box.get("result")
 
     def _failed(self, name, e):
         print(f"bench.py: stage {name!r} failed ({e!r}); continuing",
@@ -694,11 +754,24 @@ def main():
         results = []
 
         def write_details():
-            # the tail entry records which stages were skipped/failed, so a
-            # partial run is distinguishable from a complete one in the
-            # artifact itself (not just in stderr)
-            tail = ([{"skipped_stages": runner.skipped}]
-                    if runner.skipped else [])
+            # the tail entry records which stages were skipped/failed,
+            # every transient stage fault the retry policy absorbed,
+            # and the device-probe history — so a partial or
+            # fault-degraded run is distinguishable from a clean one
+            # in the artifact itself (not just in stderr), and
+            # tools/bench_regress.py can treat fault-degraded rows as
+            # reported-not-gated
+            from veles.simd_tpu.utils.platform import probe_history
+
+            tail_info = {}
+            if runner.skipped:
+                tail_info["skipped_stages"] = runner.skipped
+            if runner.faults:
+                tail_info["stage_faults"] = runner.faults
+            probes = probe_history()
+            if any(not p["ok"] for p in probes):
+                tail_info["device_probes"] = probes
+            tail = [tail_info] if tail_info else []
             with open("BENCH_DETAILS.json", "w") as f:
                 json.dump(results + tail, f, indent=2, allow_nan=False)
 

@@ -193,3 +193,101 @@ def test_torn_history_line_skipped(tmp_path, capsys):
 def test_bad_noise_spec_rejected(spec):
     with pytest.raises(SystemExit):
         bench_regress.main(["--noise", spec])
+
+
+# --------------------------------------------------------------------------
+# fault-aware gating (PR 6): a row below its floor under recorded
+# transient faults is reported-not-gated and excluded from future
+# baselines — the r05 host-contention story, without laundering
+# --------------------------------------------------------------------------
+
+def _write_faulty_details(path, headline_value, *, row_faults=0,
+                          stage_faults=0, failed_probes=0):
+    rows = [
+        {"metric": HEADLINE, "unit": "Msamples/s",
+         "value": headline_value, "baseline": 10.0,
+         "vs_baseline": headline_value / 10.0,
+         "device": "FakeDevice(id=0)",
+         **({"telemetry": {"counters": {
+             "fault_retry{site=convolve.dispatch}": row_faults}}}
+            if row_faults else {})},
+        {"metric": SUITE, "unit": "Msamples/s", "value": 500.0,
+         "baseline": 25.0, "vs_baseline": 20.0,
+         "device": "FakeDevice(id=0)"},
+    ]
+    tail = {}
+    if stage_faults:
+        tail["stage_faults"] = [
+            {"stage": "headline:convolve_1m", "attempt": i,
+             "kind": "device_lost", "detail": "injected"}
+            for i in range(stage_faults)]
+    if failed_probes:
+        tail["device_probes"] = [
+            {"attempt": 1, "ok": False, "devices": 0,
+             "detail": "probe timed out"},
+            {"attempt": 2, "ok": True, "devices": 1, "detail": ""}]
+    if tail:
+        rows.append(tail)
+    with open(path, "w") as f:
+        json.dump(rows, f)
+    return path
+
+
+@pytest.mark.parametrize("kwargs", [
+    {"row_faults": 3},
+    {"stage_faults": 2},
+    {"failed_probes": 1},
+])
+def test_degraded_under_faults_is_reported_not_gated(tmp_path, capsys,
+                                                     kwargs):
+    details = _write_faulty_details(str(tmp_path / "DETAILS.json"),
+                                    500.0, **kwargs)
+    history = _write_history(str(tmp_path / "HISTORY.jsonl"),
+                             [1000.0] * 4)
+    rc = bench_regress.main(["--details", details,
+                             "--history", history])
+    assert rc == 0                       # reported, not gated
+    out = capsys.readouterr()
+    assert "DEGRADED" in out.out
+    assert "reported, not gated" in out.err
+    # the record carries the fault_degraded marker
+    with open(history) as f:
+        last = json.loads(f.read().strip().splitlines()[-1])
+    assert last["fault_degraded"] == [HEADLINE]
+
+
+def test_fault_degraded_rows_never_become_baseline(tmp_path):
+    history = _write_history(str(tmp_path / "HISTORY.jsonl"),
+                             [1000.0] * 4)
+    # three consecutive fault-degraded runs at half throughput...
+    for _ in range(3):
+        details = _write_faulty_details(
+            str(tmp_path / "DETAILS.json"), 500.0, stage_faults=1)
+        assert bench_regress.main(["--details", details,
+                                   "--history", history]) == 0
+    # ...must not drag the median: a clean run at 500 is still a
+    # regression against the unpolluted 1000 baseline
+    details = _write_details(str(tmp_path / "DETAILS.json"), 500.0)
+    assert bench_regress.main(["--details", details,
+                               "--history", history]) == 1
+
+
+def test_faults_without_slowdown_change_nothing(tmp_path):
+    # a run that recorded faults but stayed within noise is a plain
+    # pass and keeps contributing to the baseline
+    details = _write_faulty_details(str(tmp_path / "DETAILS.json"),
+                                    980.0, row_faults=2)
+    history = _write_history(str(tmp_path / "HISTORY.jsonl"),
+                             [1000.0] * 4)
+    assert bench_regress.main(["--details", details,
+                               "--history", history]) == 0
+    with open(history) as f:
+        last = json.loads(f.read().strip().splitlines()[-1])
+    assert last["fault_degraded"] == []
+    assert last["rows"][HEADLINE]["faults"] == 2
+
+
+def test_clean_regression_still_gates(tmp_path):
+    # no faults anywhere: the gate is as strict as ever
+    rc, _, _ = _run(tmp_path, 500.0, [1000.0] * 4)
+    assert rc == 1

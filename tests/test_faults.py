@@ -1,0 +1,693 @@
+"""The fault-policy engine (``veles/simd_tpu/runtime/faults.py``).
+
+Injection-driven coverage of the three demotion paths (convolve
+overlap-save, convolve2d direct, fused STFT) — each demotes, remembers
+(the second call skips the doomed route without re-raising), records
+the decision — plus the guarded-dispatch retry/backoff policy (env
+knobs, degradation parity vs the oracle, flight-recorder bundle on
+exhaustion), the bench stage-retry wiring, the smoke-family retry, and
+the device-probe telemetry.  Everything runs on CPU: the injection
+harness raises synthetic faults whose messages satisfy the production
+classifiers, so no hardware and no monkeypatched kernels are needed.
+"""
+
+import json
+import sys
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from veles.simd_tpu import obs  # noqa: E402
+from veles.simd_tpu.obs.lru import LRUSet  # noqa: E402
+from veles.simd_tpu.runtime import faults  # noqa: E402
+
+RNG = np.random.RandomState(1234)
+
+
+@pytest.fixture
+def telemetry(monkeypatch):
+    """Telemetry on, zero backoff (deterministic, fast), clean state
+    before and after."""
+    monkeypatch.setenv("VELES_SIMD_FAULT_BACKOFF", "0")
+    obs.enable(compile_listeners=False)
+    obs.reset()
+    faults.reset_fault_history()
+    yield
+    obs.disable()
+    obs.reset()
+    faults.reset_fault_history()
+    faults.set_fault_plan(None)
+
+
+def _rel(got, want):
+    got = np.asarray(got)
+    want = np.asarray(want)
+    scale = np.max(np.abs(want)) or 1.0
+    return float(np.max(np.abs(got - want)) / scale)
+
+
+# --------------------------------------------------------------------------
+# classifiers
+# --------------------------------------------------------------------------
+
+class TestClassifiers:
+    def test_mosaic_vmem_oom_matches_observed_messages(self):
+        m1 = ("AOT PJRT error: Ran out of memory in memory space vmem "
+              "while allocating on stack for %_f2d_call.1 ... Scoped "
+              "allocation with size 22.34M and limit 16.00M")
+        m2 = ("XLA:TPU compile permanent error. Ran out of memory in "
+              "memory space vmem. Used 160.14M of 128.00M vmem.")
+        assert faults.is_mosaic_vmem_oom(RuntimeError(m1))
+        assert faults.is_mosaic_vmem_oom(RuntimeError(m2))
+        assert not faults.is_mosaic_vmem_oom(RuntimeError("div by 0"))
+        assert not faults.is_mosaic_vmem_oom(
+            RuntimeError("Ran out of memory in memory space hbm"))
+
+    def test_convolve2d_alias_is_the_engine(self):
+        from veles.simd_tpu.ops import convolve2d as cv2
+
+        assert cv2._is_mosaic_vmem_oom is faults.is_mosaic_vmem_oom
+
+    def test_device_lost(self):
+        assert faults.is_device_lost(
+            RuntimeError("UNAVAILABLE: Socket closed"))
+        assert faults.is_device_lost(
+            RuntimeError("device unreachable: probe timed out"))
+        assert not faults.is_device_lost(RuntimeError("bad shape"))
+        # a backend capability gap is NOT a device loss (the smoke's
+        # UNSUPPORTED-BY-BACKEND story must not be retried/degraded)
+        assert not faults.is_device_lost(
+            RuntimeError("UNIMPLEMENTED: TPU backend error"))
+
+    def test_timeout_and_transient(self):
+        assert faults.is_timeout(RuntimeError("DEADLINE_EXCEEDED: x"))
+        assert faults.is_timeout(faults.FaultTimeout("overran"))
+        assert faults.is_transient(faults.make_fault("device_lost"))
+        assert faults.is_transient(faults.make_fault("timeout"))
+        assert not faults.is_transient(faults.make_fault("vmem_oom"))
+        assert faults.is_mosaic_vmem_oom(faults.make_fault("vmem_oom"))
+
+
+# --------------------------------------------------------------------------
+# the injection plan
+# --------------------------------------------------------------------------
+
+class TestPlan:
+    def test_env_plan_counts_down(self, telemetry, monkeypatch):
+        monkeypatch.setenv(faults.FAULT_PLAN_ENV,
+                           "a.site:device_lost:2, b.site:vmem_oom")
+        assert faults.armed("a.site")
+        assert faults.armed("a.site", kind="device_lost")
+        assert not faults.armed("a.site", kind="timeout")
+        assert faults.armed("b.site")           # count defaults to 1
+        assert not faults.armed("c.site")
+        snap = faults.plan_snapshot()
+        assert snap["a.site"] == {"kind": "device_lost", "remaining": 2}
+        with pytest.raises(faults.InjectedFault):
+            faults.inject("a.site")
+        with pytest.raises(faults.InjectedFault):
+            faults.inject("a.site")
+        faults.inject("a.site")                 # exhausted: no-op
+        assert not faults.armed("a.site")
+        assert obs.counter_value("fault_injected", site="a.site",
+                                 kind="device_lost") == 2
+
+    def test_programmatic_plan_overrides_env(self, monkeypatch):
+        monkeypatch.setenv(faults.FAULT_PLAN_ENV, "env.site:timeout:1")
+        with faults.fault_plan("prog.site:device_lost:1"):
+            assert faults.armed("prog.site")
+            assert not faults.armed("env.site")
+        assert faults.armed("env.site")
+
+    def test_malformed_plan_raises(self):
+        with pytest.raises(ValueError, match="site:kind"):
+            faults.set_fault_plan("too:many:parts:here")
+        with pytest.raises(ValueError, match="unknown kind"):
+            faults.set_fault_plan("site:not_a_kind:1")
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            faults.make_fault("bogus")
+
+    def test_no_plan_is_free(self):
+        faults.set_fault_plan(None)
+        faults.inject("anything")               # no-op, no raise
+        assert not faults.armed("anything")
+
+
+# --------------------------------------------------------------------------
+# demote-and-remember through each migrated family (injection-driven,
+# no monkeypatching)
+# --------------------------------------------------------------------------
+
+class TestConvolveDemotion:
+    def test_injected_oom_demotes_remembers_and_answers(self,
+                                                        telemetry):
+        from veles.simd_tpu.ops import convolve as cv
+
+        x = RNG.randn(5000).astype(np.float32)
+        h = RNG.randn(443).astype(np.float32)
+        want = np.convolve(x.astype(np.float64), h.astype(np.float64))
+        handle = cv.convolve_overlap_save_initialize(len(x), len(h))
+        try:
+            with faults.fault_plan("convolve.os_pallas:vmem_oom:5"):
+                got = np.asarray(cv.convolve_overlap_save(
+                    handle, x, h, simd=True))
+                assert _rel(got, want) < 1e-5       # parity gate
+                assert 443 in cv._PALLAS_OS_REJECTED
+                # remembered: with injections still armed, the second
+                # call skips the doomed route without re-raising
+                got2 = np.asarray(cv.convolve_overlap_save(
+                    handle, x, h, simd=True))
+                assert _rel(got2, want) < 1e-5
+            assert obs.counter_value("pallas_os_demotion",
+                                     reason="compile_oom") == 1
+            assert obs.counter_value(
+                "fault_demotion", site="convolve.os_pallas") == 1
+            ev = [e for e in obs.events()
+                  if e["op"] == "fault_policy"
+                  and e["decision"] == "demote"]
+            assert ev and ev[-1]["site"] == "convolve.os_pallas"
+            assert ev[-1]["route"] == "pallas_fused"
+            assert ev[-1]["fallback"] == "xla_matmul"
+            # the executed route was recorded as the fallback, never
+            # misattributed to the demoted kernel
+            routes = [e for e in obs.events()
+                      if e["op"] == "convolve_os_route"]
+            assert all(e["decision"] == "xla_matmul" for e in routes)
+        finally:
+            cv._PALLAS_OS_REJECTED.discard(443)
+
+    def test_rejection_cache_is_bounded_lru(self):
+        from veles.simd_tpu.ops import convolve as cv
+
+        assert isinstance(cv._PALLAS_OS_REJECTED, LRUSet)
+        assert cv._PALLAS_OS_REJECTED.maxsize == cv._PALLAS_OS_MAXSIZE
+        info = obs.caches()["pallas_os_rejected"]
+        assert info["capacity"] == cv._PALLAS_OS_MAXSIZE
+        assert {"hits", "misses", "evictions"} <= set(info)
+
+
+class TestConvolve2dDemotion:
+    def test_injected_oom_demotes_remembers_and_answers(self,
+                                                        telemetry):
+        from veles.simd_tpu.ops import convolve2d as cv2
+
+        x = RNG.randn(24, 20).astype(np.float32)
+        h = RNG.randn(3, 5).astype(np.float32)
+        key = (1, 24, 20, 3, 5)
+        want = cv2.convolve2d_na(x, h)
+        try:
+            with faults.fault_plan(
+                    "convolve2d.direct_pallas:vmem_oom:5"):
+                # the armed plan opens the gate even on CPU
+                assert cv2._use_pallas_direct2d(x.shape, 3, 5)
+                got = np.asarray(cv2.convolve2d(x, h, simd=True))
+                assert _rel(got, want) < 1e-4
+                assert key in cv2._PALLAS2D_OOM_REJECTED
+                # remembered beats armed: gate refuses, no re-raise
+                assert not cv2._use_pallas_direct2d(x.shape, 3, 5)
+                got2 = np.asarray(cv2.convolve2d(x, h, simd=True))
+                assert _rel(got2, want) < 1e-4
+            assert obs.counter_value("pallas2d_demotion",
+                                     reason="compile_oom") == 1
+        finally:
+            cv2._PALLAS2D_OOM_REJECTED.discard(key)
+
+    def test_explicit_direct_demotes_to_xla_direct(self, telemetry):
+        from veles.simd_tpu.ops import convolve2d as cv2
+
+        x = RNG.randn(16, 16).astype(np.float32)
+        h = RNG.randn(3, 3).astype(np.float32)
+        key = (1, 16, 16, 3, 3)
+        try:
+            with faults.fault_plan(
+                    "convolve2d.direct_pallas:vmem_oom:1"):
+                got = np.asarray(cv2.convolve2d(
+                    x, h, algorithm="direct", simd=True))
+            assert _rel(got, cv2.convolve2d_na(x, h)) < 1e-4
+            ev = [e for e in obs.events()
+                  if e["op"] == "fault_policy"
+                  and e["decision"] == "demote"]
+            assert ev[-1]["fallback"] == "direct_mxu"
+        finally:
+            cv2._PALLAS2D_OOM_REJECTED.discard(key)
+
+
+class TestStftDemotion:
+    def test_injected_oom_demotes_remembers_and_answers(self,
+                                                        telemetry):
+        from veles.simd_tpu.ops import spectral as sp
+
+        x = RNG.randn(16384).astype(np.float32)
+        want = sp.stft_na(x, 256, 128)
+        try:
+            with faults.fault_plan("spectral.stft_pallas:vmem_oom:5"):
+                # the armed plan makes the SELECTOR pick the kernel
+                assert sp._select_stft_route(
+                    256, 128, sp.frame_count(16384, 256, 128)) \
+                    == "pallas_fused"
+                got = sp.stft(x, 256, 128, simd=True)
+                assert _rel(got, want) < 1e-4
+                assert (256, 128) in sp._STFT_PALLAS_REJECTED
+                # remembered: gate refuses the class, second call
+                # answers without re-raising
+                assert not sp._use_pallas_stft(256, 128, 1000)
+                got2 = sp.stft(x, 256, 128, simd=True)
+                assert _rel(got2, want) < 1e-4
+            assert obs.counter_value("stft_pallas_demotion",
+                                     reason="compile_oom") == 1
+            ev = [e for e in obs.events() if e["op"] == "stft_route"]
+            demoted = [e for e in ev
+                       if e.get("demoted_from") == "pallas_fused"]
+            assert demoted and demoted[-1]["decision"] == "rdft_matmul"
+        finally:
+            sp._STFT_PALLAS_REJECTED.discard((256, 128))
+
+    def test_forced_route_remembers_but_reraises(self, telemetry):
+        from veles.simd_tpu.ops import spectral as sp
+
+        x = RNG.randn(4096).astype(np.float32)
+        try:
+            with faults.fault_plan("spectral.stft_pallas:vmem_oom:1"):
+                with pytest.raises(RuntimeError, match="vmem"):
+                    sp.stft(x, 256, 128, simd=True,
+                            route="pallas_fused")
+            assert (256, 128) in sp._STFT_PALLAS_REJECTED
+        finally:
+            sp._STFT_PALLAS_REJECTED.discard((256, 128))
+
+    def test_rejection_cache_is_bounded_lru(self):
+        from veles.simd_tpu.ops import spectral as sp
+
+        assert isinstance(sp._STFT_PALLAS_REJECTED, LRUSet)
+        info = obs.caches()["stft_pallas_rejected"]
+        assert info["capacity"] == sp._STFT_PALLAS_MAXSIZE
+
+
+# --------------------------------------------------------------------------
+# the guarded-dispatch policy: retry, env knobs, degradation, flightrec
+# --------------------------------------------------------------------------
+
+class TestGuarded:
+    def test_transient_fault_retries_then_succeeds(self, telemetry):
+        from veles.simd_tpu.ops import convolve as cv
+
+        x = RNG.randn(3000).astype(np.float32)
+        h = RNG.randn(31).astype(np.float32)
+        want = np.convolve(x.astype(np.float64), h.astype(np.float64))
+        with faults.fault_plan("convolve.dispatch:device_lost:1"):
+            got = np.asarray(cv.convolve(x, h, simd=True))
+        assert _rel(got, want) < 1e-5
+        assert obs.counter_value("fault_retry",
+                                 site="convolve.dispatch") == 1
+        assert obs.counter_value("fault_exhausted",
+                                 site="convolve.dispatch",
+                                 kind="device_lost") == 0
+
+    def test_exhaustion_degrades_to_oracle_with_parity(self,
+                                                       telemetry,
+                                                       tmp_path):
+        from veles.simd_tpu.obs import flightrec
+        from veles.simd_tpu.ops import convolve as cv
+
+        flightrec._reset_auto_count()       # budget is process-global
+        obs.configure(flight_dir=str(tmp_path))
+        try:
+            x = RNG.randn(3000).astype(np.float32)
+            h = RNG.randn(31).astype(np.float32)
+            want = np.convolve(x.astype(np.float64),
+                               h.astype(np.float64))
+            # more injections than attempts (1 + default 2 retries)
+            with faults.fault_plan("convolve.dispatch:device_lost:9"):
+                got = np.asarray(cv.convolve(x, h, simd=True))
+            assert _rel(got, want) < 1e-5       # degraded parity gate
+            assert obs.counter_value("fault_retry",
+                                     site="convolve.dispatch") == 2
+            assert obs.counter_value("fault_exhausted",
+                                     site="convolve.dispatch",
+                                     kind="device_lost") == 1
+            assert obs.counter_value("fault_degraded",
+                                     site="convolve.dispatch",
+                                     to="oracle") == 1
+            # the veles_simd_fault_* Prometheus counters exist
+            prom = obs.to_prometheus()
+            assert "veles_simd_fault_retry_total" in prom
+            assert "veles_simd_fault_degraded_total" in prom
+            assert "veles_simd_fault_injected_total" in prom
+            # a flight-recorder bundle landed, carrying fault history
+            bundles = list(tmp_path.glob("flight-*.json"))
+            assert len(bundles) == 1
+            bundle = json.loads(bundles[0].read_text())
+            assert bundle["reason"] == \
+                "fault_exhausted:convolve.dispatch"
+            history = bundle["fault_history"]
+            assert [r["action"] for r in history] == \
+                ["retry", "retry", "exhausted"]
+            assert all(r["site"] == "convolve.dispatch"
+                       for r in history)
+        finally:
+            obs.configure(flight_dir="")
+
+    def test_retries_env_knob(self, telemetry, monkeypatch):
+        # guarded injects at the site itself, once per attempt, so the
+        # thunk only runs on an attempt whose injection budget is spent
+        monkeypatch.setenv(faults.FAULT_RETRIES_ENV, "0")
+        calls = []
+
+        def thunk():
+            calls.append(1)
+            return "ran"
+
+        with faults.fault_plan("knob.site:device_lost:9"):
+            out = faults.guarded("knob.site", thunk,
+                                 fallback=lambda: "degraded")
+        assert out == "degraded"
+        assert calls == []                      # zero retries honored
+        assert obs.counter_value("fault_retry", site="knob.site") == 0
+        assert obs.counter_value("fault_injected", site="knob.site",
+                                 kind="device_lost") == 1
+
+        monkeypatch.setenv(faults.FAULT_RETRIES_ENV, "4")
+        with faults.fault_plan("knob.site:device_lost:3"):
+            out = faults.guarded("knob.site", thunk,
+                                 fallback=lambda: "degraded")
+        assert out == "ran"                     # 3 faults < 5 attempts
+        assert calls == [1]
+        assert obs.counter_value("fault_retry", site="knob.site") == 3
+
+    def test_backoff_env_knob_and_jitter(self, monkeypatch):
+        monkeypatch.setenv(faults.FAULT_BACKOFF_ENV, "0")
+        assert faults.backoff_delay(0) == 0.0
+        monkeypatch.setenv(faults.FAULT_BACKOFF_ENV, "0.08")
+        for attempt in (0, 1, 2):
+            d = faults.backoff_delay(attempt)
+            lo = 0.08 * (2 ** attempt) * 0.5
+            hi = 0.08 * (2 ** attempt)
+            assert lo <= d <= hi
+
+    def test_no_fallback_reraises_after_exhaustion(self, telemetry):
+        with faults.fault_plan("nofb.site:device_lost:9"):
+            with pytest.raises(faults.InjectedFault,
+                               match="device unreachable"):
+                faults.guarded("nofb.site", lambda: "never",
+                               retries=1)
+        assert obs.counter_value("fault_exhausted", site="nofb.site",
+                                 kind="device_lost") == 1
+
+    def test_non_transient_errors_propagate_immediately(self,
+                                                        telemetry):
+        calls = []
+
+        def thunk():
+            calls.append(1)
+            raise ValueError("a plain bug")
+
+        with pytest.raises(ValueError, match="plain bug"):
+            faults.guarded("bug.site", thunk, fallback=lambda: "no")
+        assert len(calls) == 1
+        assert obs.counter_value("fault_retry", site="bug.site") == 0
+
+    def test_deadline_watchdog_times_out(self, telemetry):
+        release = threading.Event()
+
+        def wedged():
+            release.wait(5.0)
+            return "late"
+
+        with pytest.raises(faults.FaultTimeout, match="overran"):
+            faults.guarded("slow.site", wedged, retries=0,
+                           backoff=0, deadline=0.05)
+        release.set()
+        assert obs.counter_value("fault_exhausted", site="slow.site",
+                                 kind="timeout") == 1
+
+    def test_deadline_watchdog_degrades(self):
+        release = threading.Event()
+        try:
+            out = faults.guarded("slow2.site",
+                                 lambda: release.wait(5.0),
+                                 fallback=lambda: "oracle",
+                                 retries=0, backoff=0, deadline=0.05)
+            assert out == "oracle"
+        finally:
+            release.set()
+
+    def test_exhaustion_bundles_respect_auto_budget(self, telemetry,
+                                                    tmp_path):
+        """The retry-exhaustion arm goes through the flight recorder's
+        MAX_AUTO_BUNDLES budget: a service that permanently lost its
+        device and degrades on every call must not write one bundle
+        per dispatch."""
+        from veles.simd_tpu.obs import flightrec
+
+        flightrec._reset_auto_count()
+        obs.configure(flight_dir=str(tmp_path))
+        try:
+            with faults.fault_plan("budget.site:device_lost:99"):
+                for _ in range(flightrec.MAX_AUTO_BUNDLES + 3):
+                    out = faults.guarded("budget.site",
+                                         lambda: "never",
+                                         fallback=lambda: "oracle",
+                                         retries=0, backoff=0)
+                    assert out == "oracle"
+            bundles = list(tmp_path.glob("flight-*.json"))
+            assert len(bundles) == flightrec.MAX_AUTO_BUNDLES
+        finally:
+            obs.configure(flight_dir="")
+            flightrec._reset_auto_count()
+
+    def test_forced_stft_route_never_degrades(self, telemetry):
+        """A pinned route= call retries but must re-raise on
+        exhaustion — bench's per-route rows must never silently record
+        the oracle's numbers as the forced route's."""
+        from veles.simd_tpu.ops import spectral as sp
+
+        x = RNG.randn(4096).astype(np.float32)
+        with faults.fault_plan("stft.dispatch:device_lost:9"):
+            with pytest.raises(RuntimeError, match="device"):
+                sp.stft(x, 256, 64, simd=True, route="xla_fft")
+        assert obs.counter_value("fault_degraded",
+                                 site="stft.dispatch",
+                                 to="oracle") == 0
+
+    def test_stft_dispatch_degrades_with_parity(self, telemetry):
+        from veles.simd_tpu.ops import spectral as sp
+
+        x = RNG.randn(4096).astype(np.float32)
+        want = sp.stft_na(x, 256, 64)
+        with faults.fault_plan("stft.dispatch:device_lost:9"):
+            got = sp.stft(x, 256, 64, simd=True)
+        assert _rel(got, want) < 1e-4
+        assert np.asarray(got).dtype == np.complex64
+        assert obs.counter_value("fault_degraded",
+                                 site="stft.dispatch",
+                                 to="oracle") == 1
+
+    def test_convolve2d_dispatch_degrades_with_parity(self,
+                                                      telemetry):
+        from veles.simd_tpu.ops import convolve2d as cv2
+
+        x = RNG.randn(20, 24).astype(np.float32)
+        h = RNG.randn(5, 3).astype(np.float32)
+        with faults.fault_plan("convolve2d.dispatch:device_lost:9"):
+            got = np.asarray(cv2.convolve2d(x, h, simd=True))
+        assert _rel(got, cv2.convolve2d_na(x, h)) < 1e-4
+
+
+# --------------------------------------------------------------------------
+# LRUSet.discard (set-compatible surface for the rejection caches)
+# --------------------------------------------------------------------------
+
+def test_lru_set_discard():
+    s = LRUSet(4)
+    s.add("a")
+    s.discard("a")
+    s.discard("never-there")        # silent, like set.discard
+    assert "a" not in s
+    assert len(s) == 0
+
+
+# --------------------------------------------------------------------------
+# bench stage supervision on the fault policy
+# --------------------------------------------------------------------------
+
+class TestBenchStageRetry:
+    def _runner(self, timeout=5.0, retries=None):
+        import bench
+
+        dog = bench._StageWatchdog(0)
+        return bench._StageRunner(timeout, dog, retries=retries)
+
+    def test_transient_stage_fault_is_retried(self, telemetry):
+        r = self._runner(retries=2)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) == 1:
+                raise faults.make_fault("device_lost", "stage")
+            return "recovered"
+
+        ok, res = r.run("flaky", flaky)
+        assert ok and res == "recovered"
+        assert r.skipped == []
+        assert [f["kind"] for f in r.faults] == ["device_lost"]
+        assert r.faults[0]["stage"] == "flaky"
+        assert obs.counter_value("fault_stage_retry",
+                                 stage="flaky") == 1
+
+    def test_exhausted_transient_stage_is_recorded(self, telemetry):
+        r = self._runner(retries=1)
+
+        def always_lost():
+            raise faults.make_fault("device_lost", "stage")
+
+        ok, res = r.run("lost", always_lost)
+        assert not ok
+        assert [s["stage"] for s in r.skipped] == ["lost"]
+        assert len(r.faults) == 2               # attempt 0 and 1
+        assert obs.counter_value("fault_stage_exhausted",
+                                 stage="lost") == 1
+
+    def test_non_transient_stage_error_is_not_retried(self):
+        r = self._runner(retries=3)
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise RuntimeError("kaput")
+
+        ok, err = r.run("boom", boom)
+        assert not ok and len(calls) == 1
+        assert r.faults == []
+        assert "kaput" in r.skipped[0]["reason"]
+
+    def test_wedged_stage_retries_then_skips(self):
+        r = self._runner(timeout=0.2, retries=1)
+        release = threading.Event()
+        ok, res = r.run("wedge", release.wait)
+        import bench
+
+        assert not ok and res is bench._StageRunner._WEDGED
+        assert [s["stage"] for s in r.skipped] == ["wedge"]
+        assert [f["kind"] for f in r.faults] == ["wedged", "wedged"]
+        release.set()
+
+    def test_bench_main_survives_injected_stage_fault(
+            self, telemetry, monkeypatch, tmp_path, capsys):
+        """Acceptance: an injected stage fault is retried, the fault
+        is recorded in BENCH_DETAILS.json, the run completes rc=0."""
+        import bench
+        import tools.tpu_smoke as smoke
+
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.setenv("VELES_SIMD_STAGE_TIMEOUT", "5")
+        monkeypatch.setenv("VELES_SIMD_DEVICE_WAIT", "0")
+        monkeypatch.setattr(bench, "_warm_device", lambda *a, **k: None)
+        monkeypatch.setattr(
+            bench, "bench_convolve_1m",
+            lambda rng: {"metric": "convolve 1M x 2047 overlap-save",
+                         "unit": "Msamples/s", "value": 200.0,
+                         "baseline": 1.0})
+        flaky_calls = []
+
+        def flaky_cfg(rng):
+            flaky_calls.append(1)
+            if len(flaky_calls) == 1:
+                raise faults.make_fault("device_lost",
+                                        "config:elementwise")
+            return {"metric": "elementwise", "unit": "u",
+                    "value": 2.0, "baseline": 1.0}
+
+        flaky_cfg.__name__ = "bench_elementwise"
+        monkeypatch.setattr(bench, "bench_elementwise", flaky_cfg)
+        for name in ("bench_mathfun", "bench_sgemm", "bench_dwt",
+                     "bench_stft", "bench_istft_roundtrip",
+                     "bench_spectrogram", "bench_batched_stft"):
+            def mk(name):
+                def cfg(rng):
+                    return {"metric": name, "unit": "u", "value": 2.0,
+                            "baseline": 1.0}
+                cfg.__name__ = name
+                return cfg
+            monkeypatch.setattr(bench, name, mk(name))
+        monkeypatch.setattr(smoke, "FAMILIES",
+                            [("fam_ok", lambda rng: (0.0, 1.0))])
+        monkeypatch.setattr(sys, "argv", ["bench.py"])
+        try:
+            with np.errstate(all="ignore"):
+                try:
+                    bench.main()
+                    rc = 0
+                except SystemExit as e:
+                    rc = e.code
+        finally:
+            bench.obs.reset()
+            bench.obs.disable()
+        assert rc == 0                           # run completed
+        details = json.loads(
+            (tmp_path / "BENCH_DETAILS.json").read_text())
+        metrics = [d.get("metric") for d in details if "metric" in d]
+        assert "elementwise" in metrics          # the stage recovered
+        tail = details[-1]
+        assert "stage_faults" in tail
+        fault = tail["stage_faults"][0]
+        assert fault["stage"] == "config:bench_elementwise"
+        assert fault["kind"] == "device_lost"
+        assert "skipped_stages" not in tail      # nothing was lost
+
+
+# --------------------------------------------------------------------------
+# smoke families on the fault policy
+# --------------------------------------------------------------------------
+
+def test_smoke_family_retries_transient_fault(telemetry):
+    import tools.tpu_smoke as smoke
+
+    lines = []
+    with faults.fault_plan("smoke.arithmetic:device_lost:1"):
+        ok = smoke.run_smoke(emit=lines.append,
+                             families=["arithmetic"])
+    assert ok
+    assert any("family=arithmetic" in ln and " ok" in ln
+               for ln in lines)
+    assert obs.counter_value("fault_retry",
+                             site="smoke.arithmetic") == 1
+
+
+# --------------------------------------------------------------------------
+# device-probe telemetry (utils/platform satellite)
+# --------------------------------------------------------------------------
+
+def test_require_reachable_device_records_probes(telemetry,
+                                                 monkeypatch,
+                                                 capsys):
+    from veles.simd_tpu.utils import platform
+
+    platform.reset_probe_history()
+    outcomes = iter([(0, "probe timed out"), (1, "")])
+    monkeypatch.setattr(platform, "_probe_subprocess",
+                        lambda timeout: next(outcomes))
+    monkeypatch.delenv("VELES_SIMD_DEVICE_WAIT", raising=False)
+    # the retry loop sleeps up to 30 s between probes — not in a test
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    platform.require_reachable_device(timeout=1.0, wait=60.0)
+    hist = platform.probe_history()
+    assert [h["ok"] for h in hist] == [False, True]
+    assert hist[0]["detail"] == "probe timed out"
+    assert hist[0]["attempt"] == 1 and hist[1]["attempt"] == 2
+    assert obs.counter_value("device_probe",
+                             outcome="unreachable") == 1
+    assert obs.counter_value("device_probe", outcome="ok") == 1
+    ev = [e for e in obs.events() if e["op"] == "device_probe"]
+    assert [e["decision"] for e in ev] == ["unreachable", "ok"]
+    # the flight recorder embeds the same history
+    from veles.simd_tpu.obs import flightrec
+
+    bundle = flightrec.build_bundle("test")
+    assert [p["ok"] for p in bundle["device_probes"]] == [False, True]
+    platform.reset_probe_history()

@@ -108,3 +108,120 @@ def test_real_spectral_module_is_clean():
     src = (REPO / "veles/simd_tpu/ops/spectral.py").read_text()
     assert lint.spectral_dispatch_errors(
         ast.parse(src), "veles/simd_tpu/ops/spectral.py") == []
+
+
+# --------------------------------------------------------------------------
+# the fault-policy rule (PR 6): no raw `except Exception` around
+# pallas/compile call sites in ops//parallel — failure policy lives in
+# runtime/faults.py
+# --------------------------------------------------------------------------
+
+FAULT_BAD_PALLAS = '''
+from veles.simd_tpu.ops import pallas_kernels as _pk
+
+
+def run(x):
+    try:
+        return _pk.stft_pallas(x, 256, 128)
+    except Exception:
+        return None
+'''
+
+FAULT_BAD_PALLAS_ALIAS = '''
+import veles.simd_tpu.ops.pallas_kernels as pkmod
+
+
+def run(x):
+    try:
+        return pkmod.overlap_save_pallas(x, x)
+    except Exception as e:
+        raise
+'''
+
+FAULT_BAD_INSTRUMENTED = '''
+import functools
+from veles.simd_tpu import obs
+
+
+@functools.partial(obs.instrumented_jit, op="conv", route="pallas")
+def _core(x):
+    return x
+
+
+def run(x):
+    try:
+        return _core(x)
+    except Exception:
+        return None
+'''
+
+FAULT_BAD_BARE_EXCEPT = '''
+from veles.simd_tpu.ops import pallas_kernels as _pk
+
+
+def run(x):
+    try:
+        return _pk.filter_2d_pallas(x, x, 4, 4)
+    except:  # noqa: E722
+        return None
+'''
+
+FAULT_OK_NARROW = '''
+from veles.simd_tpu.ops import pallas_kernels as _pk
+
+
+def run(x):
+    try:
+        return _pk.stft_pallas(x, 256, 128)
+    except ValueError:
+        return None
+'''
+
+FAULT_OK_NO_COMPILE_SITE = '''
+def load():
+    try:
+        return open("table.npz").read()
+    except Exception:
+        return None
+'''
+
+
+def _fault_errors(src):
+    return lint.fault_handler_errors(ast.parse(src), "mod.py")
+
+
+def test_fault_rule_flags_pallas_except():
+    assert any("fault-policy" in e for e in _fault_errors(
+        FAULT_BAD_PALLAS))
+
+
+def test_fault_rule_tracks_import_alias():
+    assert _fault_errors(FAULT_BAD_PALLAS_ALIAS)
+
+
+def test_fault_rule_flags_instrumented_call():
+    assert _fault_errors(FAULT_BAD_INSTRUMENTED)
+
+
+def test_fault_rule_flags_bare_except():
+    assert _fault_errors(FAULT_BAD_BARE_EXCEPT)
+
+
+def test_fault_rule_allows_narrow_handler():
+    assert _fault_errors(FAULT_OK_NARROW) == []
+
+
+def test_fault_rule_ignores_non_compile_sites():
+    assert _fault_errors(FAULT_OK_NO_COMPILE_SITE) == []
+
+
+def test_real_compute_modules_have_no_inline_fault_handlers():
+    """Acceptance gate: zero hand-rolled demote try/except blocks
+    remain anywhere in ops/ or parallel/ — all three demotion paths
+    (convolve os, convolve2d, stft) went through runtime/faults.py."""
+    for sub in ("ops", "parallel"):
+        for path in sorted((REPO / "veles/simd_tpu" / sub).glob("*.py")):
+            rel = path.relative_to(REPO).as_posix()
+            errs = lint.fault_handler_errors(
+                ast.parse(path.read_text()), rel)
+            assert errs == [], errs

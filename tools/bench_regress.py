@@ -35,6 +35,16 @@ Rows whose value is null (bench flagged an unresolved measurement) are
 reported but never counted as regressions — a wedged relay is
 ``bench.py``'s rc=2 story, not a performance signal.
 
+Fault-aware gating (PR 6): every history row records its fault count
+(the ``fault_*`` counters the fault-policy engine embedded in the
+row's telemetry), and a row that falls below its floor while the run
+carries recorded transient faults — row counters, stage-fault records,
+or failed device probes in the details tail — is DEGRADED-not-gated:
+reported loudly, excluded from future baselines (like regressed rows),
+but not an rc=1.  The r05 lesson both ways: host contention must not
+fail the gate as a code regression, and a fault-degraded median must
+not become the new normal.
+
 Usage:  python tools/bench_regress.py
         python tools/bench_regress.py --details BENCH_DETAILS.json \\
             --history BENCH_HISTORY.jsonl --window 5 --threshold 0.10 \\
@@ -69,34 +79,69 @@ DEFAULT_NOISE = [
 ]
 
 
+def row_fault_count(row: dict) -> int:
+    """Transient/injected faults recorded in one row's embedded
+    telemetry: the sum of every ``fault_*`` counter (retries,
+    demotions, degradations, injections) the fault-policy engine
+    bumped while that config ran."""
+    counters = (row.get("telemetry") or {}).get("counters") or {}
+    return sum(int(v) for k, v in counters.items()
+               if k.startswith("fault_"))
+
+
 def load_rows(details_path: str) -> list:
     """The comparable rows of one bench run: every BENCH_DETAILS.json
     entry with a ``metric`` key (the tail ``skipped_stages`` entry and
     other non-row records are ignored)."""
+    return load_run(details_path)[0]
+
+
+def load_run(details_path: str) -> tuple:
+    """``(rows, run_faults)`` for one bench run.  ``run_faults`` is
+    the run-level transient-fault evidence from the tail entry:
+    stage-fault records the retry policy absorbed plus failed
+    device-reachability probes — the r05 story, where host/relay
+    trouble (not code) degraded the headline."""
     with open(details_path) as f:
         entries = json.load(f)
     if not isinstance(entries, list):
         raise ValueError(f"{details_path}: expected a list of configs")
-    return [e for e in entries if isinstance(e, dict) and "metric" in e]
+    rows = [e for e in entries if isinstance(e, dict) and "metric" in e]
+    run_faults = 0
+    for e in entries:
+        if not isinstance(e, dict) or "metric" in e:
+            continue
+        run_faults += len(e.get("stage_faults") or ())
+        run_faults += sum(1 for p in e.get("device_probes") or ()
+                          if not p.get("ok", True))
+    return rows, run_faults
 
 
-def rows_to_record(rows: list, source: str,
-                   regressed: list = ()) -> dict:
+def rows_to_record(rows: list, source: str, regressed: list = (),
+                   fault_degraded: list = (),
+                   run_faults: int = 0) -> dict:
     """One append-only history record for this run.  ``regressed``
     names the rows that failed the gate this run — recorded for the
     trajectory, skipped by :func:`trailing_baseline` so a red run
-    cannot drag the future baseline down."""
+    cannot drag the future baseline down.  ``fault_degraded`` names
+    rows that fell below their floor *under recorded faults* —
+    reported, not gated, and equally excluded from future baselines
+    so a transient-fault run cannot launder the median either way."""
     return {
         "ts": time.time(),
         "source": source,
         "device": next((r.get("device") for r in rows
                         if r.get("device")), None),
         "regressed": sorted(regressed),
+        "fault_degraded": sorted(fault_degraded),
+        "run_faults": int(run_faults),
         "rows": {
             r["metric"]: {
                 "value": r.get("value"),
                 "unit": r.get("unit"),
                 "vs_baseline": r.get("vs_baseline"),
+                **({"faults": row_fault_count(r)}
+                   if row_fault_count(r) else {}),
             } for r in rows
         },
     }
@@ -140,6 +185,8 @@ def trailing_baseline(history: list, metric: str, window: int):
     for rec in reversed(history):
         if metric in rec.get("regressed", ()):
             continue
+        if metric in rec.get("fault_degraded", ()):
+            continue
         row = rec.get("rows", {}).get(metric)
         if row and isinstance(row.get("value"), (int, float)):
             values.append(float(row["value"]))
@@ -162,12 +209,19 @@ def row_threshold(metric: str, default: float, overrides: list) -> float:
 
 
 def compare(rows: list, history: list, window: int, default_thr: float,
-            overrides: list) -> tuple:
+            overrides: list, run_faults: int = 0) -> tuple:
     """Judge every row against its trailing baseline.
 
-    Returns ``(regressions, report_lines)`` where ``regressions`` is
-    the list of regressed metric names."""
+    Returns ``(regressions, fault_degraded, report_lines)``.
+    ``regressions`` gates (rc=1); ``fault_degraded`` names rows that
+    fell below their floor while the run carried recorded transient
+    faults (row-embedded ``fault_*`` counters or run-level
+    stage-fault/probe records) — those are REPORTED but not gated
+    (the r05 host-contention story: a relay hiccup is not a code
+    regression), and :func:`trailing_baseline` excludes them from
+    future medians so a degraded run cannot launder the baseline."""
     regressions = []
+    fault_degraded = []
     lines = []
     for r in rows:
         metric = r["metric"]
@@ -175,6 +229,7 @@ def compare(rows: list, history: list, window: int, default_thr: float,
         unit = r.get("unit", "")
         baseline, n = trailing_baseline(history, metric, window)
         thr = row_threshold(metric, default_thr, overrides)
+        faults_n = row_fault_count(r) + run_faults
         if value is None:
             verdict = "UNRESOLVED (null value; not gated)"
         elif baseline is None:
@@ -182,7 +237,12 @@ def compare(rows: list, history: list, window: int, default_thr: float,
         else:
             delta = (value - baseline) / baseline
             floor = baseline * (1.0 - thr)
-            if value < floor:
+            if value < floor and faults_n:
+                verdict = (f"DEGRADED {delta:+.1%} under {faults_n} "
+                           f"recorded fault(s) — reported, not gated; "
+                           f"excluded from future baselines")
+                fault_degraded.append(metric)
+            elif value < floor:
                 verdict = (f"REGRESSION {delta:+.1%} vs median of "
                            f"{n} (threshold -{thr:.0%})")
                 regressions.append(metric)
@@ -195,7 +255,7 @@ def compare(rows: list, history: list, window: int, default_thr: float,
         base_s = "-" if baseline is None else f"{baseline:.1f}"
         lines.append(f"  {metric:40s} {val_s:>10s} {unit:11s} "
                      f"baseline {base_s:>10s}  {verdict}")
-    return regressions, lines
+    return regressions, fault_degraded, lines
 
 
 def parse_noise(spec: str) -> tuple:
@@ -239,7 +299,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     try:
-        rows = load_rows(args.details)
+        rows, run_faults = load_run(args.details)
     except (OSError, ValueError) as e:
         print(f"bench_regress: cannot read run rows: {e}",
               file=sys.stderr)
@@ -251,18 +311,27 @@ def main(argv=None) -> int:
 
     history = read_history(args.history)
     overrides = DEFAULT_NOISE + list(args.noise)
-    regressions, lines = compare(rows, history, args.window,
-                                 args.threshold, overrides)
+    regressions, fault_degraded, lines = compare(
+        rows, history, args.window, args.threshold, overrides,
+        run_faults=run_faults)
     if not args.no_append:
         append_history(args.history,
                        rows_to_record(rows, args.details,
-                                      regressed=regressions))
+                                      regressed=regressions,
+                                      fault_degraded=fault_degraded,
+                                      run_faults=run_faults))
 
     print(f"bench_regress: {len(rows)} rows vs {len(history)} prior "
           f"records in {args.history}"
+          + (f" ({run_faults} run-level fault record(s))"
+             if run_faults else "")
           + (" (not recorded)" if args.no_append else ""))
     for line in lines:
         print(line)
+    if fault_degraded:
+        print(f"bench_regress: {len(fault_degraded)} row(s) degraded "
+              f"under recorded faults (reported, not gated): "
+              f"{', '.join(fault_degraded)}", file=sys.stderr)
     if regressions:
         print(f"bench_regress: REGRESSION in {len(regressions)} "
               f"row(s): {', '.join(regressions)}", file=sys.stderr)

@@ -147,6 +147,87 @@ _TIME_FORBIDDEN = {"time", "monotonic", "perf_counter",
 _JIT_FORBIDDEN = {"jit", "pjit"}
 
 
+# --- fault-policy rule ------------------------------------------------------
+# PR 2/5 grew three hand-copied demote try/except blocks around pallas
+# compile sites; PR 6 moved them into the ONE fault-policy engine
+# (veles/simd_tpu/runtime/faults.py).  This rule keeps a fourth copy
+# from reappearing: in ops//parallel, a broad exception handler
+# (``except Exception`` / bare ``except``) whose try body reaches a
+# pallas-kernels call or an ``obs.instrumented_jit``-compiled function
+# is a lint failure — failure policy belongs to
+# ``faults.demote_and_remember`` / ``faults.guarded``, never inline.
+# Alias-tracked like the instrumented_jit rule (``import ... as _pk``
+# cannot dodge it).
+
+_BROAD_EXC_NAMES = {"Exception", "BaseException"}
+
+
+def _pallas_aliases(tree) -> set:
+    """Names the module binds to the pallas_kernels module."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "pallas_kernels":
+                    names.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.endswith("pallas_kernels") and a.asname:
+                    names.add(a.asname)
+    return names
+
+
+def _broad_handler(handler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD_EXC_NAMES
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD_EXC_NAMES
+                   for e in t.elts)
+    return False
+
+
+def fault_handler_errors(tree, fname) -> list:
+    """The rule body on a parsed module (separated so tests can feed
+    synthetic sources).  Returns human-readable error strings."""
+    errors = []
+    aliases = _pallas_aliases(tree)
+    instrumented = {
+        node.name for node in tree.body
+        if isinstance(node, ast.FunctionDef)
+        and any(_is_instrumented_decorator(d)
+                for d in node.decorator_list)}
+
+    def touches_compile_site(body) -> bool:
+        for n in body:
+            for w in ast.walk(n):
+                if (isinstance(w, ast.Attribute)
+                        and isinstance(w.value, ast.Name)
+                        and w.value.id in aliases):
+                    return True
+                if (isinstance(w, ast.Call)
+                        and isinstance(w.func, ast.Name)
+                        and w.func.id in instrumented):
+                    return True
+        return False
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        if not any(_broad_handler(h) for h in node.handlers):
+            continue
+        if touches_compile_site(node.body):
+            errors.append(
+                f"{fname}:{node.lineno}: raw 'except Exception' "
+                "around a pallas/compile call site in a compute "
+                "module — route the failure through the fault-policy "
+                "engine (runtime/faults.demote_and_remember or "
+                "faults.guarded)")
+    return errors
+
+
 # --- spectral route-dispatch rule ------------------------------------------
 # ops/spectral.py's route tables (``_STFT_ROUTES`` / ``_ISTFT_ROUTES``)
 # are the template the next routed op family copies.  Two structural
@@ -277,6 +358,9 @@ def compute_module_lint(files) -> int:
             for msg in spectral_dispatch_errors(tree, str(f)):
                 print(msg)
                 failures += 1
+        for msg in fault_handler_errors(tree, str(f)):
+            print(msg)
+            failures += 1
         aliases = set()
         time_aliases = set()
         jax_aliases = set()

@@ -700,7 +700,17 @@ def run_smoke(emit=None, families=None, on_start=None) -> bool:
         if on_start is not None:
             on_start(name)
         try:
-            err, tol = check(rng)
+            # the shared transient-fault policy (runtime/faults.py):
+            # a device-lost/timeout mid-family gets bounded
+            # retry-with-backoff before the family is reported failed
+            # — the r02-r04 one-shot relay drops.  No fallback: a
+            # smoke family that cannot run on the device has nothing
+            # honest to report, so exhaustion re-raises into the
+            # except arm below.
+            from veles.simd_tpu.runtime import faults
+
+            err, tol = faults.guarded(f"smoke.{name}",
+                                      lambda: check(rng))
             ok = err <= tol
         except Exception as e:  # surface, keep checking other families
             # A backend capability gap is not a numerical failure: some

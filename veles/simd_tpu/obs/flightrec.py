@@ -41,8 +41,9 @@ import traceback
 
 from veles.simd_tpu.obs.atomic import atomic_write_text
 
-__all__ = ["dump_debug_bundle", "maybe_record_crash", "flight_dir",
-           "configure_flight_dir", "auto_bundles_written",
+__all__ = ["dump_debug_bundle", "maybe_record_crash", "maybe_record",
+           "flight_dir", "configure_flight_dir",
+           "auto_bundles_written",
            "SCHEMA", "MAX_AUTO_BUNDLES", "FLIGHT_DIR_ENV"]
 
 SCHEMA = "veles-simd-flight-v1"
@@ -123,6 +124,29 @@ def _env_info() -> dict:
             or k in ("JAX_PLATFORMS", "XLA_FLAGS")}
 
 
+def _fault_info() -> list:
+    """The fault-policy engine's retained fault records (injections,
+    retries, demotions, exhaustions) — the history that explains a
+    degraded run.  Lazy + exception-proof like every other section."""
+    try:
+        from veles.simd_tpu.runtime import faults
+
+        return faults.fault_history()
+    except Exception:  # noqa: BLE001
+        return []
+
+
+def _probe_info() -> list:
+    """Device-reachability probe history (utils/platform) — the
+    flaky-relay record that used to exist only on stderr."""
+    try:
+        from veles.simd_tpu.utils import platform
+
+        return platform.probe_history()
+    except Exception:  # noqa: BLE001
+        return []
+
+
 def build_bundle(reason: str, exc: BaseException | None = None) -> dict:
     """Assemble the bundle dict (separated from writing for tests and
     in-process consumers)."""
@@ -138,6 +162,8 @@ def build_bundle(reason: str, exc: BaseException | None = None) -> dict:
         "env": _env_info(),
         "snapshot": obs.snapshot(),
         "trace_events": obs.trace_events(),
+        "fault_history": _fault_info(),
+        "device_probes": _probe_info(),
     }
     if exc is not None:
         bundle["exception"] = {
@@ -175,6 +201,16 @@ def maybe_record_crash(exc_type, exc) -> str | None:
     """Span-exit crash hook: write a bundle when armed and under the
     per-process budget; otherwise do nothing.  Never raises — the
     original exception is already unwinding and must win."""
+    return maybe_record("span_crash", exc)
+
+
+def maybe_record(reason: str, exc: BaseException | None) -> str | None:
+    """Budgeted automatic capture: write a bundle when armed and under
+    the shared :data:`MAX_AUTO_BUNDLES` budget; otherwise do nothing.
+    Both auto triggers — the span-exit crash hook and the fault-policy
+    engine's retry-exhaustion arm — go through this one gate, so a
+    service that keeps degrading (and never crashes) still cannot turn
+    the recorder into a disk-filling amplifier.  Never raises."""
     global _auto_bundles
     try:
         if flight_dir() is None:
@@ -184,7 +220,7 @@ def maybe_record_crash(exc_type, exc) -> str | None:
                 return None
             _auto_bundles += 1      # reserve a slot (concurrent crashes)
         try:
-            return dump_debug_bundle(reason="span_crash", exc=exc)
+            return dump_debug_bundle(reason=reason, exc=exc)
         except Exception:  # noqa: BLE001
             # a failed WRITE (read-only dir, disk full) must not burn
             # budget: release the slot so the recorder stays armed for

@@ -72,6 +72,12 @@ class LRUSet:
                 self._evictions += 1
             return False
 
+    def discard(self, key) -> None:
+        """Remove ``key`` if present (set-compatible; no traffic
+        counted — tests use this to un-remember a rejection)."""
+        with self._lock:
+            self._entries.pop(key, None)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)

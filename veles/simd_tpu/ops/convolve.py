@@ -55,6 +55,7 @@ import numpy as np
 
 from veles.simd_tpu import obs
 from veles.simd_tpu.ops import pallas_kernels as _pk
+from veles.simd_tpu.runtime import faults
 from veles.simd_tpu.utils.config import get_config, resolve_simd
 from veles.simd_tpu.utils.memory import (
     next_highest_power_of_2, zeropadding_length)
@@ -260,14 +261,17 @@ def os_precision() -> str:
 
 
 # filter lengths whose fused overlap-save compile OOMed Mosaic's
-# scoped-vmem stack (consulted by _run's route; a process sees a
-# handful of distinct filter lengths, so a plain set suffices — the
-# shape-class LRU discipline lives in convolve2d where keys are 5-dim)
-_PALLAS_OS_REJECTED = set()
-obs.register_cache(
-    "pallas_os_rejected",
-    lambda: {"size": len(_PALLAS_OS_REJECTED), "capacity": None,
-             "keys": sorted(_PALLAS_OS_REJECTED)})
+# scoped-vmem stack (consulted by _run_xla's route).  Bounded LRU like
+# every rejection cache (a long-running service cycling filter designs
+# must not grow an unbounded set; an evicted length just pays one more
+# failed compile if it returns), snapshot in obs.caches() with
+# hit/miss/eviction counters.  Tests may substitute a plain set — the
+# provider re-reads the module global per snapshot.
+_PALLAS_OS_MAXSIZE = 64
+_PALLAS_OS_REJECTED = obs.LRUSet(_PALLAS_OS_MAXSIZE)
+faults.register_rejection_cache(
+    "pallas_os_rejected", lambda: _PALLAS_OS_REJECTED,
+    _PALLAS_OS_MAXSIZE)
 
 
 def _use_pallas_os(h_length: int) -> bool:
@@ -525,10 +529,22 @@ def _run(handle: ConvolutionHandle, x, h, simd=None):
         # host-side span around the whole XLA dispatch: route choice +
         # executable call.  Python-only (no jax ops), so the traced
         # program is untouched — test_obs.py pins jaxpr identity.
+        # faults.guarded applies the transient-fault policy (bounded
+        # retry on device-lost/timeout, then graceful degradation to
+        # the NumPy oracle) around the whole XLA side.
         with obs.span("convolve.dispatch",
                       algo=handle.algorithm.value,
                       os_matmul=handle.os_matmul):
-            return _run_xla(handle, x, h)
+            return faults.guarded(
+                "convolve.dispatch",
+                lambda: _run_xla(handle, x, h),
+                fallback=lambda: _run_oracle(handle, x, h))
+    return _run_oracle(handle, x, h)
+
+
+def _run_oracle(handle: ConvolutionHandle, x, h):
+    """The NumPy-oracle side of :func:`_run` — also the fault policy's
+    degradation target when the device path exhausts its retries."""
     x, h = np.asarray(x), np.asarray(h)
     _check_lengths(handle, x, h)
     if handle.reverse:
@@ -550,46 +566,48 @@ def _run_xla(handle: ConvolutionHandle, x, h):
     if handle.algorithm is ConvolutionAlgorithm.FFT:
         return _conv_fft(x, h, handle.fft_length, reverse=handle.reverse)
     if handle.os_matmul:
-        if (_use_pallas_os(handle.h_length)
+        def _os_matmul():
+            obs.record_decision(
+                "convolve_os_route", "xla_matmul",
+                x_length=handle.x_length, h_length=handle.h_length,
+                step=handle.step)
+            with obs.span("convolve.os_route", route="xla_matmul"):
+                return _conv_os_matmul(x, h, handle.step,
+                                       reverse=handle.reverse,
+                                       precision=os_precision())
+
+        if ((_use_pallas_os(handle.h_length)
+                or faults.armed("convolve.os_pallas"))
                 and handle.h_length not in _PALLAS_OS_REJECTED):
-            try:
-                with obs.span("convolve.os_route", route="pallas_fused"):
+            def _os_pallas():
+                with obs.span("convolve.os_route",
+                              route="pallas_fused"):
                     out = _conv_os_pallas(x, h, reverse=handle.reverse,
                                           precision=os_precision())
-            except Exception as e:
-                # Mosaic's scoped-vmem cap is not predictable from
-                # shape arithmetic (convolve2d learned this on
-                # hardware): demote the filter length to the XLA
-                # path on the specific vmem-OOM compile error and
-                # remember it.  Under an OUTER jit the compile
-                # error surfaces uncatchably at the outer compile —
-                # traced callers rely on fits_vmem_os's margin and
-                # the VELES_SIMD_DISABLE_PALLAS_OS escape hatch;
-                # eager callers (bench, handle API) get this
-                # fallback.
-                from veles.simd_tpu.ops.convolve2d import (
-                    _is_mosaic_vmem_oom)
-                if not _is_mosaic_vmem_oom(e):
-                    raise
-                _PALLAS_OS_REJECTED.add(handle.h_length)
-                obs.count("pallas_os_demotion", reason="compile_oom")
-            else:
-                # recorded AFTER the attempt resolves, so a
-                # demotion never misattributes the executed route
+                # recorded AFTER the attempt resolves, so a demotion
+                # never misattributes the executed route
                 obs.record_decision(
                     "convolve_os_route", "pallas_fused",
                     x_length=handle.x_length,
                     h_length=handle.h_length,
                     step=_pk.PALLAS_OS_STEP)
                 return out
-        obs.record_decision(
-            "convolve_os_route", "xla_matmul",
-            x_length=handle.x_length, h_length=handle.h_length,
-            step=handle.step)
-        with obs.span("convolve.os_route", route="xla_matmul"):
-            return _conv_os_matmul(x, h, handle.step,
-                                   reverse=handle.reverse,
-                                   precision=os_precision())
+
+            # Mosaic's scoped-vmem cap is not predictable from shape
+            # arithmetic (convolve2d learned this on hardware): the
+            # shared engine demotes the filter length to the XLA path
+            # on the specific vmem-OOM compile error and remembers it.
+            # Under an OUTER jit the compile error surfaces
+            # uncatchably at the outer compile — traced callers rely
+            # on fits_vmem_os's margin and the
+            # VELES_SIMD_DISABLE_PALLAS_OS escape hatch; eager callers
+            # (bench, handle API) get this fallback.
+            return faults.demote_and_remember(
+                "convolve.os_pallas", _os_pallas, _os_matmul,
+                cache=_PALLAS_OS_REJECTED, key=handle.h_length,
+                route="pallas_fused", fallback_route="xla_matmul",
+                counter="pallas_os_demotion")
+        return _os_matmul()
     return _conv_overlap_save(x, h, handle.block_length,
                               reverse=handle.reverse)
 

@@ -33,6 +33,7 @@ import numpy as np
 
 from veles.simd_tpu import obs
 from veles.simd_tpu.ops import pallas_kernels as _pk
+from veles.simd_tpu.runtime import faults
 from veles.simd_tpu.utils.config import resolve_simd
 from veles.simd_tpu.utils.memory import next_highest_power_of_2
 
@@ -112,10 +113,18 @@ def _use_pallas_direct2d(x_shape, k0: int, k1: int) -> bool:
     n0, n1 = x_shape[-2:]
     n0e, n1e = n0 + 2 * (k0 - 1), n1 + 2 * (k1 - 1)
     out_elems = (n0 + k0 - 1) * (n1 + k1 - 1)
+    # the rejection memory outranks everything — including an armed
+    # fault plan, so a demoted shape's second call skips the doomed
+    # route without re-raising (the remember half of the policy)
+    if _oom_key(x_shape, k0, k1) in _PALLAS2D_OOM_REJECTED:
+        return False
+    if faults.armed("convolve2d.direct_pallas"):
+        # a planned injection at this site opens the gate so the full
+        # demote path runs on CPU CI (runtime/faults.py harness)
+        return True
     return (_pk.pallas_available()
             and _pk.pallas2d_compiled_allowed()
             and k0 * k1 <= _pk.PALLAS_2D_MAX_KERNEL_AREA
-            and _oom_key(x_shape, k0, k1) not in _PALLAS2D_OOM_REJECTED
             and _pk.fits_vmem2d(n0e * n1e, out_elems, k0 * k1))
 
 
@@ -187,13 +196,10 @@ _LRUSet = obs.LRUSet
 _PALLAS2D_OOM_MAXSIZE = 256
 _PALLAS2D_OOM_REJECTED = _LRUSet(_PALLAS2D_OOM_MAXSIZE)
 # tests may substitute a plain set for _PALLAS2D_OOM_REJECTED; the
-# provider snapshots whatever is bound at call time
-obs.register_cache(
-    "pallas2d_oom_rejected",
-    lambda: (_PALLAS2D_OOM_REJECTED.info()
-             if hasattr(_PALLAS2D_OOM_REJECTED, "info")
-             else {"size": len(_PALLAS2D_OOM_REJECTED),
-                   "capacity": _PALLAS2D_OOM_MAXSIZE}))
+# shared provider re-reads whatever is bound at call time
+faults.register_rejection_cache(
+    "pallas2d_oom_rejected", lambda: _PALLAS2D_OOM_REJECTED,
+    _PALLAS2D_OOM_MAXSIZE)
 
 # Scoped-stack model used ONLY for calls traced under an outer jit,
 # where the Mosaic compile error surfaces at the OUTER compile and the
@@ -216,15 +222,11 @@ def _oom_key(x_shape, k0, k1):
     return (rows, x_shape[-2], x_shape[-1], k0, k1)
 
 
-def _is_mosaic_vmem_oom(e: Exception) -> bool:
-    """Match Mosaic's scoped-vmem compile failures, e.g. (observed live
-    2026-07-31): "Ran out of memory in memory space vmem while
-    allocating on stack for %_f2d_call... Scoped allocation with size
-    22.34M and limit 16.00M" / "Ran out of memory in memory space
-    vmem. Used 160.14M of 128.00M" — pinned by a unit test."""
-    msg = str(e).lower()
-    return "vmem" in msg and ("ran out of memory" in msg
-                              or "scoped" in msg)
+# the Mosaic scoped-vmem classifier moved to the shared fault-policy
+# engine (runtime/faults.py) — this alias keeps the historical import
+# path (spectral/conv tests and older call sites) pointing at the one
+# implementation
+_is_mosaic_vmem_oom = faults.is_mosaic_vmem_oom
 
 
 def _run2d(x, h, reverse, algorithm, simd):
@@ -239,7 +241,19 @@ def _run2d(x, h, reverse, algorithm, simd):
     if resolve_simd(simd, op="convolve2d"):
         with obs.span("convolve2d.dispatch", algo=algorithm,
                       auto=auto):
-            return _run2d_xla(x, h, reverse, algorithm, auto)
+            # transient device faults (device-lost/timeout): bounded
+            # retry, then degrade to the float64 oracle — the shared
+            # fault policy (runtime/faults.py)
+            return faults.guarded(
+                "convolve2d.dispatch",
+                lambda: _run2d_xla(x, h, reverse, algorithm, auto),
+                fallback=lambda: _run2d_oracle(x, h, reverse))
+    return _run2d_oracle(x, h, reverse)
+
+
+def _run2d_oracle(x, h, reverse):
+    """NumPy-oracle side of :func:`_run2d` (also the fault policy's
+    degradation target)."""
     x = np.asarray(x, np.float32)
     h = np.asarray(h, np.float32)
     if reverse:
@@ -287,15 +301,28 @@ def _run2d_xla(x, h, reverse, algorithm, auto):
                 if auto:
                     algorithm = "fft"
         if use_pallas:
-            try:
-                return _conv2d_direct_pallas(x, h, reverse=reverse)
-            except Exception as e:  # Mosaic scoped-vmem OOM only
-                if not _is_mosaic_vmem_oom(e):
-                    raise
-                _PALLAS2D_OOM_REJECTED.add(_oom_key(x.shape, k0, k1))
-                obs.count("pallas2d_demotion", reason="compile_oom")
-                if auto:      # re-route as the gate would have
-                    algorithm = "fft"
+            def _demoted():
+                # re-route as the gate would have: auto falls to the
+                # measured-winner fft, an explicit "direct" request
+                # stays direct (the XLA conv the caller asked for)
+                if auto:
+                    m0 = next_highest_power_of_2(x.shape[-2] + k0 - 1)
+                    m1 = next_highest_power_of_2(x.shape[-1] + k1 - 1)
+                    return _conv2d_fft(x, h, m0, m1, reverse=reverse)
+                return _conv2d_direct(x, h, reverse=reverse)
+
+            # Mosaic scoped-vmem OOM only — the shared engine
+            # remembers the shape class and falls back; any other
+            # error propagates (runtime/faults.py)
+            return faults.demote_and_remember(
+                "convolve2d.direct_pallas",
+                lambda: _conv2d_direct_pallas(x, h, reverse=reverse),
+                _demoted,
+                cache=_PALLAS2D_OOM_REJECTED,
+                key=_oom_key(x.shape, k0, k1),
+                route="direct_pallas",
+                fallback_route="fft" if auto else "direct_mxu",
+                counter="pallas2d_demotion")
         if algorithm == "direct":
             return _conv2d_direct(x, h, reverse=reverse)
     m0 = next_highest_power_of_2(x.shape[-2] + k0 - 1)

@@ -58,6 +58,7 @@ import numpy as np
 
 from veles.simd_tpu import obs
 from veles.simd_tpu.ops import pallas_kernels as _pk
+from veles.simd_tpu.runtime import faults
 from veles.simd_tpu.utils.config import resolve_simd
 # complex host<->device moves MUST go through to_device/to_host: the
 # axon relay cannot transfer complex buffers in either direction and one
@@ -380,13 +381,15 @@ def _stft_rdft(x, basis, frame_length, hop):
 
 
 # (frame_length, hop) classes whose fused-STFT compile OOMed Mosaic's
-# scoped-vmem stack — the demote-and-remember discipline the conv
-# routes learned on hardware (convolve2d._PALLAS2D_OOM_REJECTED)
-_STFT_PALLAS_REJECTED = set()
-obs.register_cache(
-    "stft_pallas_rejected",
-    lambda: {"size": len(_STFT_PALLAS_REJECTED), "capacity": None,
-             "keys": sorted(_STFT_PALLAS_REJECTED)})
+# scoped-vmem stack — the demote-and-remember discipline shared with
+# the conv routes, now through the one engine (runtime/faults.py).
+# Bounded LRU with hit/miss/eviction counters in obs.caches(); an
+# evicted class just pays one more failed compile if it returns.
+_STFT_PALLAS_MAXSIZE = 256
+_STFT_PALLAS_REJECTED = obs.LRUSet(_STFT_PALLAS_MAXSIZE)
+faults.register_rejection_cache(
+    "stft_pallas_rejected", lambda: _STFT_PALLAS_REJECTED,
+    _STFT_PALLAS_MAXSIZE)
 
 
 def _use_matmul_dft(frame_length: int) -> bool:
@@ -415,11 +418,19 @@ def _use_pallas_stft(frame_length: int, hop: int, frames: int) -> bool:
     that already OOMed Mosaic's scoped stack.  Tests monkeypatch this
     gate to exercise the kernel on CPU."""
     L, s = int(frame_length), int(hop)
+    # rejection memory outranks everything — including an armed fault
+    # plan, so a demoted class's next call skips the doomed route
+    # without re-raising
+    if (L, s) in _STFT_PALLAS_REJECTED:
+        return False
+    if faults.armed("spectral.stft_pallas"):
+        # a planned injection opens the gate so the selector really
+        # picks the kernel and the demote path runs on CPU CI
+        return True
     return (_pk.pallas_available() and _pk.stft_pallas_allowed()
             and L % s == 0 and s % 128 == 0 and L // s >= 2
             and int(frames) >= _pk.PALLAS_STFT_MIN_FRAMES
-            and _pk.fits_vmem_stft(L, s)
-            and (L, s) not in _STFT_PALLAS_REJECTED)
+            and _pk.fits_vmem_stft(L, s))
 
 
 def _select_stft_route(frame_length: int, hop: int, frames: int) -> str:
@@ -464,9 +475,9 @@ def _stft_pallas_basis(frame_length, hop, window):
 
 
 def _run_stft_pallas(x, window, frame_length, hop, forced=False):
-    """The fused-kernel route, with the Mosaic vmem-OOM
-    demote-and-remember fallback the conv routes use: the scoped-stack
-    cap is not predictable from shape arithmetic, so the specific
+    """The fused-kernel route, through the shared demote-and-remember
+    engine (runtime/faults.py): the scoped-stack cap is not
+    predictable from shape arithmetic, so the specific Mosaic vmem-OOM
     compile error demotes this (frame, hop) class to the matmul/FFT
     route and records the demotion (decision event + counter) so the
     executed route is never misattributed.  A FORCED pallas route
@@ -474,23 +485,23 @@ def _run_stft_pallas(x, window, frame_length, hop, forced=False):
     the kernel (benchmark, bisect) must never silently get another
     route's numbers."""
     basis = _stft_pallas_basis(frame_length, hop, window)
-    try:
-        return _pk.stft_pallas(x, frame_length, hop, basis=basis)
-    except Exception as e:
-        from veles.simd_tpu.ops.convolve2d import _is_mosaic_vmem_oom
+    fb_route = ("rdft_matmul" if _use_matmul_dft(frame_length)
+                else "xla_fft")
 
-        if not _is_mosaic_vmem_oom(e):
-            raise
-        _STFT_PALLAS_REJECTED.add((int(frame_length), int(hop)))
-        obs.count("stft_pallas_demotion", reason="compile_oom")
-        if forced:
-            raise
-        fallback = ("rdft_matmul" if _use_matmul_dft(frame_length)
-                    else "xla_fft")
+    def _demoted():
         obs.record_decision(
-            "stft_route", fallback, frame_length=int(frame_length),
+            "stft_route", fb_route, frame_length=int(frame_length),
             hop=int(hop), demoted_from="pallas_fused")
-        return _STFT_ROUTES[fallback](x, window, frame_length, hop)
+        return _STFT_ROUTES[fb_route](x, window, frame_length, hop)
+
+    return faults.demote_and_remember(
+        "spectral.stft_pallas",
+        lambda: _pk.stft_pallas(x, frame_length, hop, basis=basis),
+        _demoted,
+        cache=_STFT_PALLAS_REJECTED,
+        key=(int(frame_length), int(hop)),
+        route="pallas_fused", fallback_route=fb_route,
+        counter="stft_pallas_demotion", forced=forced)
 
 
 _STFT_ROUTES = {"xla_fft": _run_stft_xla,
@@ -535,9 +546,21 @@ def stft(x, frame_length: int, hop: int, window=None, simd=None,
             hop=int(hop))
         with obs.span("stft.dispatch", route=chosen, path=path):
             # x_np, not x: every runner needs .shape (lists/tuples are
-            # supported inputs, same as the pre-route code)
-            return _STFT_ROUTES[chosen](x_np, window, frame_length,
-                                        hop, forced=forced)
+            # supported inputs, same as the pre-route code).  The
+            # transient-fault policy (bounded retry on device-lost/
+            # timeout, then graceful degradation to the float64
+            # oracle) wraps the whole route call.  A FORCED route gets
+            # the retries but never the oracle fallback — a caller who
+            # pinned a route (bench per-route rows) must never
+            # silently get another implementation's numbers.
+            return faults.guarded(
+                "stft.dispatch",
+                lambda: _STFT_ROUTES[chosen](x_np, window,
+                                             frame_length, hop,
+                                             forced=forced),
+                fallback=None if forced else lambda: stft_na(
+                    x_np, frame_length, hop,
+                    window).astype(np.complex64))
     return stft_na(x, frame_length, hop, window).astype(np.complex64)
 
 
@@ -682,9 +705,15 @@ def istft(spec, n: int, frame_length: int, hop: int, window=None,
             "istft", path, n=int(n), frame_length=int(frame_length),
             hop=int(hop))
         with obs.span("istft.dispatch", route=chosen, path=path):
-            return _ISTFT_ROUTES[chosen](spec, window, env_inv, n,
-                                         frame_length, hop,
-                                         forced=forced)
+            # forced routes retry but never degrade (see stft)
+            return faults.guarded(
+                "istft.dispatch",
+                lambda: _ISTFT_ROUTES[chosen](spec, window, env_inv,
+                                              n, frame_length, hop,
+                                              forced=forced),
+                fallback=None if forced else lambda: istft_na(
+                    spec_np, n, frame_length, hop,
+                    window).astype(np.float32))
     return istft_na(spec, n, frame_length, hop, window).astype(np.float32)
 
 

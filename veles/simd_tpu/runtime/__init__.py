@@ -1,0 +1,14 @@
+"""veles.simd_tpu.runtime — cross-op runtime policies.
+
+The ops layer owns *what* to compute (route tables, selectors,
+oracles); this package owns the runtime policies every op family
+shares.  First resident: :mod:`~veles.simd_tpu.runtime.faults`, the
+fault-policy engine — one demote-and-remember implementation for
+Mosaic compile rejections, bounded retry-with-backoff for transient
+device faults, and the deterministic fault-injection harness that
+exercises both on CPU CI.
+"""
+
+from veles.simd_tpu.runtime import faults
+
+__all__ = ["faults"]

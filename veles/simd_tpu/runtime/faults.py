@@ -1,0 +1,549 @@
+"""Fault-policy engine: demote-and-remember, retry/backoff, injection.
+
+The paper's core mechanism is per-op best-algorithm selection; the
+*fallback* path when the selected algorithm fails on hardware is what
+keeps that mechanism correctness-preserving.  Before this module each
+routed op family hand-rolled its own copy of the pattern (convolve's
+fused overlap-save, convolve2d's shifted-MAC kernel, spectral's fused
+STFT — three near-identical try/except blocks, two of them remembering
+rejections in unbounded ``set()``s), and the only failures CI could
+exercise were monkeypatched ones.  Meanwhile whole bench runs were
+lost to device-unreachable hangs the runtime had no story for.  This
+module is the one shared layer, three pieces:
+
+* **demote-and-remember** (:func:`demote_and_remember`) — the compile-
+  rejection policy.  A Mosaic scoped-vmem OOM is *permanent for the
+  geometry* (the same shape will OOM again): classify it
+  (:func:`is_mosaic_vmem_oom`), remember the geometry key in a bounded
+  rejection cache (:func:`register_rejection_cache` puts every such
+  cache in ``obs.caches()``), count and record the demotion, and
+  invoke the caller's fallback route.  A *forced* route re-raises
+  after remembering — a caller who pinned a kernel must never silently
+  get another route's numbers.
+
+* **guarded dispatch** (:func:`guarded`) — the transient-fault policy,
+  composed around the ``obs.instrumented_jit``-compiled cores at the
+  Python dispatch layer (inside the dispatch ``obs.span``, outside the
+  traced program).  Device-unreachable / device-lost errors and
+  watchdog deadline overruns (:func:`is_transient`) get bounded
+  jittered-exponential retry (``VELES_SIMD_FAULT_RETRIES`` /
+  ``VELES_SIMD_FAULT_BACKOFF``); on exhaustion the op degrades
+  gracefully to its fallback route (the NumPy oracle twin — correct
+  output beats no output) and the crash flight recorder is armed with
+  the accumulated fault history.  Every step is a ``fault_*`` counter
+  (``veles_simd_fault_*`` in the Prometheus export) and a
+  ``fault_policy`` decision event.
+
+* **deterministic fault injection** (:func:`inject` /
+  ``VELES_SIMD_FAULT_PLAN``) — ``site:kind:count,...`` raises
+  synthetic faults (``vmem_oom`` / ``device_lost`` / ``timeout``)
+  whose messages match the real classifiers at named engine sites, so
+  every demotion and retry path runs on CPU CI without hardware or
+  monkeypatching.  :func:`armed` lets route *gates* open for a
+  planned site, so the doomed route is actually selected and the
+  whole demote path executes end to end.
+
+``bench.py`` stage supervision and ``tools/tpu_smoke.py`` ride the
+same classifiers (per-stage retry + fault record instead of
+skip-on-first-failure); ``tools/lint.py`` forbids raw ``except
+Exception`` around pallas/compile call sites in ``ops/``/``parallel/``
+so a fourth hand-rolled copy cannot reappear.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import random
+import threading
+import time
+
+from veles.simd_tpu import obs
+
+__all__ = [
+    "is_mosaic_vmem_oom", "is_device_lost", "is_timeout", "is_transient",
+    "InjectedFault", "FaultTimeout", "make_fault",
+    "inject", "armed", "set_fault_plan", "fault_plan", "plan_snapshot",
+    "demote_and_remember", "guarded", "register_rejection_cache",
+    "fault_retries", "fault_backoff", "fault_deadline", "backoff_delay",
+    "fault_history", "reset_fault_history",
+    "FAULT_PLAN_ENV", "FAULT_RETRIES_ENV", "FAULT_BACKOFF_ENV",
+    "FAULT_DEADLINE_ENV", "DEFAULT_RETRIES", "DEFAULT_BACKOFF_S",
+    "FAULT_KINDS", "FAULT_HISTORY_MAXLEN",
+]
+
+FAULT_PLAN_ENV = "VELES_SIMD_FAULT_PLAN"
+FAULT_RETRIES_ENV = "VELES_SIMD_FAULT_RETRIES"
+FAULT_BACKOFF_ENV = "VELES_SIMD_FAULT_BACKOFF"
+FAULT_DEADLINE_ENV = "VELES_SIMD_FAULT_DEADLINE"
+
+# transient-fault retry budget per dispatch (attempts = retries + 1)
+# and the base backoff delay; both env-tunable.  The defaults are
+# sized for a relay hiccup (sub-second), not a wedged relay — a truly
+# wedged in-flight call is the stage watchdog's job (bench.py).
+DEFAULT_RETRIES = 2
+DEFAULT_BACKOFF_S = 0.05
+
+# retained fault records for the flight recorder (per process)
+FAULT_HISTORY_MAXLEN = 64
+
+
+# ---------------------------------------------------------------------------
+# exception classifiers
+# ---------------------------------------------------------------------------
+
+def is_mosaic_vmem_oom(e: BaseException) -> bool:
+    """Match Mosaic's scoped-vmem compile failures, e.g. (observed live
+    2026-07-31): "Ran out of memory in memory space vmem while
+    allocating on stack for %_f2d_call... Scoped allocation with size
+    22.34M and limit 16.00M" / "Ran out of memory in memory space
+    vmem. Used 160.14M of 128.00M" — pinned by unit tests.  Permanent
+    for the geometry: the demote-and-remember policy, never retry."""
+    msg = str(e).lower()
+    return "vmem" in msg and ("ran out of memory" in msg
+                              or "scoped" in msg)
+
+
+# device-lost / unreachable markers (lowercase substrings), from the
+# r02-r04 bench post-mortems (axon relay drops) plus the gRPC status
+# vocabulary jax surfaces for a dead backend
+_DEVICE_LOST_MARKERS = (
+    "device unreachable", "device lost", "unavailable:",
+    "socket closed", "connection reset", "failed to connect",
+    "data_loss", "device or resource busy",
+)
+# NB: "UNIMPLEMENTED: TPU backend error" (a relay capability gap) is
+# deliberately NOT here — it is permanent, and the smoke harness
+# reports it distinctly as UNSUPPORTED-BY-BACKEND; retrying or quietly
+# degrading would hide the gap.
+
+_TIMEOUT_MARKERS = (
+    "deadline exceeded", "deadline_exceeded", "timed out", "timeout",
+)
+
+
+def is_device_lost(e: BaseException) -> bool:
+    """A device/transport loss: the call never computed, the backend
+    may come back — the retry-then-degrade policy."""
+    msg = str(e).lower()
+    return any(m in msg for m in _DEVICE_LOST_MARKERS)
+
+
+def is_timeout(e: BaseException) -> bool:
+    """A deadline overrun (including :class:`FaultTimeout` from the
+    watchdog): same retry-then-degrade policy as device loss."""
+    if isinstance(e, FaultTimeout):
+        return True
+    msg = str(e).lower()
+    return any(m in msg for m in _TIMEOUT_MARKERS)
+
+
+def is_transient(e: BaseException) -> bool:
+    """Worth retrying?  Device losses and timeouts are; compile
+    rejections (:func:`is_mosaic_vmem_oom`) and ordinary bugs are
+    not."""
+    return is_device_lost(e) or is_timeout(e)
+
+
+def _fault_kind(e: BaseException) -> str:
+    return "timeout" if is_timeout(e) else "device_lost"
+
+
+# ---------------------------------------------------------------------------
+# synthetic faults + the deterministic injection plan
+# ---------------------------------------------------------------------------
+
+class InjectedFault(RuntimeError):
+    """A synthetic fault raised by :func:`inject`.  Its *message* is
+    crafted to satisfy the same string classifier as the real error it
+    imitates, so injection exercises the production classification
+    path, not a bypass."""
+
+
+class FaultTimeout(RuntimeError):
+    """Raised by :func:`guarded`'s watchdog when a dispatch overruns
+    its deadline (classified transient by :func:`is_timeout`)."""
+
+
+FAULT_KINDS = ("vmem_oom", "device_lost", "timeout")
+
+_FAULT_MESSAGES = {
+    "vmem_oom": ("Ran out of memory in memory space vmem while "
+                 "allocating on stack: scoped allocation (injected "
+                 "at %s)"),
+    "device_lost": "UNAVAILABLE: device unreachable (injected at %s)",
+    "timeout": ("DEADLINE_EXCEEDED: dispatch deadline overrun "
+                "(injected at %s)"),
+}
+
+
+def make_fault(kind: str, site: str = "synthetic") -> InjectedFault:
+    """A synthetic fault instance of ``kind`` (for tests and the bench
+    harness; :func:`inject` raises these per the active plan)."""
+    if kind not in _FAULT_MESSAGES:
+        raise ValueError(f"unknown fault kind {kind!r} "
+                         f"(known: {sorted(_FAULT_MESSAGES)})")
+    return InjectedFault(_FAULT_MESSAGES[kind] % site)
+
+
+_plan_lock = threading.Lock()
+_plan_override: str | None = None       # set_fault_plan() programmatic
+_plan_src: str | None = None            # spec the cache was parsed from
+_plan_cache: dict | None = None         # {site: [kind, remaining]}
+
+
+def _parse_plan(spec: str) -> dict:
+    """``site:kind:count,...`` -> ``{site: [kind, remaining]}``.
+    ``count`` defaults to 1; a malformed entry raises (a typo'd plan
+    silently injecting nothing would defeat the harness)."""
+    plan = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) == 2:
+            site, kind, count = parts[0], parts[1], "1"
+        elif len(parts) == 3:
+            site, kind, count = parts
+        else:
+            raise ValueError(
+                f"fault-plan entry {entry!r} is not site:kind[:count]")
+        if kind not in _FAULT_MESSAGES:
+            raise ValueError(
+                f"fault-plan entry {entry!r}: unknown kind {kind!r} "
+                f"(known: {sorted(_FAULT_MESSAGES)})")
+        plan[site.strip()] = [kind.strip(), int(count)]
+    return plan
+
+
+def _active_plan() -> dict | None:
+    """The live plan (reparsed when the env var or override changed;
+    None when no plan is set — the zero-cost steady state)."""
+    global _plan_src, _plan_cache
+    spec = _plan_override
+    if spec is None:
+        spec = os.environ.get(FAULT_PLAN_ENV, "") or None
+    with _plan_lock:
+        if spec != _plan_src:
+            _plan_src = spec
+            _plan_cache = _parse_plan(spec) if spec else None
+        return _plan_cache
+
+
+def set_fault_plan(spec: str | None) -> None:
+    """Programmatic plan override (None restores the env lookup).
+    Validates eagerly so a bad spec fails at the set, not mid-run."""
+    global _plan_override, _plan_src, _plan_cache
+    if spec is not None:
+        _parse_plan(spec)
+    with _plan_lock:
+        _plan_override = spec
+        _plan_src = None        # force reparse on next lookup
+        _plan_cache = None
+
+
+@contextlib.contextmanager
+def fault_plan(spec: str):
+    """Scoped :func:`set_fault_plan` — the test-suite idiom."""
+    prev = _plan_override
+    set_fault_plan(spec)
+    try:
+        yield
+    finally:
+        set_fault_plan(prev)
+
+
+def armed(site: str, kind: str | None = None) -> bool:
+    """Does the active plan still hold injections for ``site``?  Route
+    *gates* consult this so a planned site's route is actually
+    selected on CPU (where the hardware gates would refuse it) and the
+    full demote/retry path runs — deterministic, no monkeypatching."""
+    plan = _active_plan()
+    if plan is None:
+        return False
+    with _plan_lock:
+        entry = plan.get(site)
+        return bool(entry and entry[1] > 0
+                    and (kind is None or entry[0] == kind))
+
+
+def inject(site: str) -> None:
+    """Raise the planned synthetic fault for ``site``, if any remain
+    (decrementing the plan's count); no-op otherwise.  Called by the
+    engine at every policy site, so a plan drives the production
+    paths themselves."""
+    plan = _active_plan()
+    if plan is None:
+        return
+    with _plan_lock:
+        entry = plan.get(site)
+        if not entry or entry[1] <= 0:
+            return
+        entry[1] -= 1
+        kind = entry[0]
+    obs.count("fault_injected", site=site, kind=kind)
+    raise make_fault(kind, site)
+
+
+def plan_snapshot() -> dict:
+    """JSON-native view of the remaining plan (for bundles/tests)."""
+    plan = _active_plan()
+    if plan is None:
+        return {}
+    with _plan_lock:
+        return {site: {"kind": kind, "remaining": n}
+                for site, (kind, n) in sorted(plan.items())}
+
+
+# ---------------------------------------------------------------------------
+# fault history (what the flight recorder carries)
+# ---------------------------------------------------------------------------
+
+_history_lock = threading.Lock()
+_FAULT_HISTORY: collections.deque = collections.deque(
+    maxlen=FAULT_HISTORY_MAXLEN)
+
+
+def _note_fault(site: str, kind: str, action: str, attempt: int,
+                error: BaseException) -> dict:
+    rec = {"site": site, "kind": kind, "action": action,
+           "attempt": attempt, "error": str(error)[:300],
+           "unix": time.time()}
+    with _history_lock:
+        _FAULT_HISTORY.append(rec)
+    return rec
+
+
+def fault_history() -> list:
+    """Oldest-first copy of the retained fault records (embedded in
+    every flight-recorder bundle)."""
+    with _history_lock:
+        return [dict(r) for r in _FAULT_HISTORY]
+
+
+def reset_fault_history() -> None:
+    with _history_lock:
+        _FAULT_HISTORY.clear()
+
+
+def _arm_flightrec(site: str, exc: BaseException) -> str | None:
+    """Write a flight-recorder bundle on retry exhaustion, when a
+    flight dir is armed — through the recorder's shared
+    ``MAX_AUTO_BUNDLES`` budget, so a service that permanently lost
+    its device and keeps degrading per call cannot fill the disk with
+    one bundle per dispatch.  Never raises — the policy's answer
+    (degrade or re-raise) must win over recorder trouble."""
+    try:
+        from veles.simd_tpu.obs import flightrec
+
+        return flightrec.maybe_record(f"fault_exhausted:{site}", exc)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+# ---------------------------------------------------------------------------
+# env knobs
+# ---------------------------------------------------------------------------
+
+def _env_number(name: str, default, cast):
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        value = cast(raw)
+    except ValueError:
+        return default
+    return value if value >= 0 else default
+
+
+def fault_retries() -> int:
+    """Transient-fault retries per dispatch
+    (``$VELES_SIMD_FAULT_RETRIES``, default 2)."""
+    return _env_number(FAULT_RETRIES_ENV, DEFAULT_RETRIES, int)
+
+
+def fault_backoff() -> float:
+    """Base backoff seconds (``$VELES_SIMD_FAULT_BACKOFF``, default
+    0.05; 0 disables sleeping — the deterministic-test setting)."""
+    return _env_number(FAULT_BACKOFF_ENV, DEFAULT_BACKOFF_S, float)
+
+
+def fault_deadline() -> float:
+    """Watchdog deadline seconds for :func:`guarded` dispatches
+    (``$VELES_SIMD_FAULT_DEADLINE``, default 0 = no watchdog)."""
+    return _env_number(FAULT_DEADLINE_ENV, 0.0, float)
+
+
+def backoff_delay(attempt: int, base: float | None = None) -> float:
+    """Jittered exponential backoff: ``base * 2^attempt`` scaled by a
+    uniform [0.5, 1.0) jitter so retry storms decorrelate."""
+    if base is None:
+        base = fault_backoff()
+    if base <= 0:
+        return 0.0
+    return base * (2 ** attempt) * (0.5 + random.random() / 2)
+
+
+# ---------------------------------------------------------------------------
+# the demote-and-remember policy (permanent compile rejections)
+# ---------------------------------------------------------------------------
+
+def register_rejection_cache(name: str, getter, capacity: int) -> None:
+    """Put a rejection cache in ``obs.caches()`` under ``name``.
+
+    ``getter`` is a zero-arg callable returning the cache *currently
+    bound* in the owning module (tests substitute plain ``set``s
+    through the module global, so the provider must re-read it per
+    snapshot).  An :class:`~veles.simd_tpu.obs.lru.LRUSet` reports its
+    own hit/miss/eviction counters; a plain set reports size against
+    the intended capacity."""
+    def provider():
+        cache = getter()
+        if hasattr(cache, "info"):
+            return cache.info()
+        return {"size": len(cache), "capacity": capacity}
+    obs.register_cache(name, provider)
+
+
+def demote_and_remember(site: str, run, fallback=None, *, cache, key,
+                        route: str, fallback_route: str, counter: str,
+                        forced: bool = False, reason: str = "compile_oom",
+                        classify=None):
+    """THE demote-and-remember implementation (one home, three users).
+
+    Runs ``run()`` (the doomed-candidate route) after giving the
+    injection plan its shot at ``site``.  An exception ``classify``
+    accepts — by default a Mosaic scoped-vmem compile OOM, which is
+    permanent for the geometry — adds ``key`` to ``cache`` (the
+    bounded rejection set the route's *gate* consults, so the next
+    call skips the route without re-raising), bumps ``counter`` (the
+    family's historical demotion counter) plus the engine's
+    ``fault_demotion`` counter, records a ``fault_policy`` decision
+    event, and answers via ``fallback()``.  ``forced=True`` (a caller
+    who pinned the route) still remembers but re-raises; any other
+    exception propagates untouched.  ``classify`` defaults to
+    :func:`is_mosaic_vmem_oom` (None keeps the default — a live
+    callable here would bake a memory address into the generated
+    docs).
+    """
+    if classify is None:
+        classify = is_mosaic_vmem_oom
+    try:
+        inject(site)
+        return run()
+    except Exception as e:
+        if not classify(e):
+            raise
+        cache.add(key)
+        kind = "vmem_oom" if classify is is_mosaic_vmem_oom \
+            else _fault_kind(e)
+        _note_fault(site, kind, "demote", 0, e)
+        obs.count(counter, reason=reason)
+        obs.count("fault_demotion", site=site)
+        obs.record_decision(
+            "fault_policy", "demote", site=site, route=route,
+            fallback=fallback_route, reason=reason, key=repr(key),
+            forced=bool(forced))
+        if forced or fallback is None:
+            raise
+        return fallback()
+
+
+# ---------------------------------------------------------------------------
+# the guarded-dispatch policy (transient device faults)
+# ---------------------------------------------------------------------------
+
+def _call_with_deadline(thunk, deadline: float, site: str):
+    """Run ``thunk`` under a watchdog: past ``deadline`` seconds the
+    worker is abandoned (daemon thread — a wedged in-flight device
+    call blocks in native code and cannot be cancelled; the bench
+    stage supervisor uses the same containment) and a
+    :class:`FaultTimeout` is raised for the retry policy to handle."""
+    if not deadline or deadline <= 0:
+        return thunk()
+    box = {}
+    done = threading.Event()
+
+    def work():
+        try:
+            box["result"] = thunk()
+        except BaseException as e:  # noqa: BLE001 — relayed below
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=work, daemon=True,
+                         name=f"veles-fault-deadline-{site}")
+    t.start()
+    if not done.wait(deadline):
+        raise FaultTimeout(
+            f"DEADLINE_EXCEEDED: dispatch at {site} overran the "
+            f"{deadline:.3f}s fault-policy watchdog")
+    if "error" in box:
+        raise box["error"]
+    return box.get("result")
+
+
+def guarded(site: str, thunk, *, fallback=None, retries: int | None = None,
+            backoff: float | None = None, deadline: float | None = None,
+            fallback_name: str = "oracle"):
+    """Dispatch ``thunk()`` under the transient-fault policy.
+
+    Composes *around* the ``obs.instrumented_jit``-compiled cores at
+    the Python dispatch layer (inside the dispatch span, outside the
+    traced program — jaxprs are untouched).  Per attempt the injection
+    plan fires first (:func:`inject` at ``site``), then the call runs
+    under the optional watchdog ``deadline``.  A transient fault
+    (:func:`is_transient`) is retried up to ``retries`` times with
+    jittered exponential ``backoff``; on exhaustion the flight
+    recorder is armed with the fault history and the call degrades to
+    ``fallback()`` (typically the op's NumPy oracle twin — correct
+    output beats no output) or re-raises when no fallback exists.
+    Non-transient exceptions propagate immediately.
+
+    ``retries`` / ``backoff`` / ``deadline`` default to the env knobs
+    (``VELES_SIMD_FAULT_RETRIES`` / ``_BACKOFF`` / ``_DEADLINE``).
+    """
+    if retries is None:
+        retries = fault_retries()
+    if backoff is None:
+        backoff = fault_backoff()
+    if deadline is None:
+        deadline = fault_deadline()
+    attempt = 0
+    while True:
+        try:
+            inject(site)
+            return _call_with_deadline(thunk, deadline, site)
+        except Exception as e:
+            if not is_transient(e):
+                raise
+            kind = _fault_kind(e)
+            obs.count("fault_transient", site=site, kind=kind)
+            if attempt < retries:
+                _note_fault(site, kind, "retry", attempt + 1, e)
+                obs.count("fault_retry", site=site)
+                obs.record_decision(
+                    "fault_policy", "retry", site=site, kind=kind,
+                    attempt=attempt + 1, retries=retries)
+                delay = backoff_delay(attempt, backoff)
+                if delay > 0:
+                    time.sleep(delay)
+                attempt += 1
+                continue
+            _note_fault(site, kind, "exhausted", attempt, e)
+            obs.count("fault_exhausted", site=site, kind=kind)
+            bundle = _arm_flightrec(site, e)
+            obs.record_decision(
+                "fault_policy",
+                "degrade" if fallback is not None else "exhausted",
+                site=site, kind=kind, retries=retries,
+                flight_bundle=bundle,
+                fallback=fallback_name if fallback is not None
+                else None)
+            if fallback is None:
+                raise
+            obs.count("fault_degraded", site=site, to=fallback_name)
+            return fallback()

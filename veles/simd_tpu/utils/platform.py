@@ -21,7 +21,50 @@ import os
 __all__ = ["set_cpu_env", "pin_cpu", "cpu_devices",
            "maybe_override_platform", "probe_device_count",
            "require_reachable_device", "init_deadline", "to_host",
-           "to_device"]
+           "to_device", "probe_history", "reset_probe_history"]
+
+# Device-reachability probe records (require_reachable_device's retry
+# loop).  Until PR 6 each attempt only printed to stderr, so a flaky
+# relay's history died with the terminal; now every attempt is counted
+# (obs ``device_probe`` counter + decision event when telemetry is on)
+# and retained here for BENCH_DETAILS.json's tail and the flight
+# recorder — regardless of telemetry state.
+_PROBE_HISTORY_MAXLEN = 64
+_PROBE_HISTORY: list = []
+
+
+def probe_history() -> list:
+    """Oldest-first copy of the retained device-probe records."""
+    return [dict(r) for r in _PROBE_HISTORY]
+
+
+def reset_probe_history() -> None:
+    del _PROBE_HISTORY[:]
+
+
+def _note_probe(attempt: int, count: int, detail: str,
+                waited_s: float) -> None:
+    """Record one reachability probe (history + obs, never raises)."""
+    import time
+
+    rec = {"attempt": int(attempt), "ok": count >= 1,
+           "devices": int(count), "detail": str(detail)[:300],
+           "waited_s": round(float(waited_s), 3),
+           "unix": time.time()}
+    _PROBE_HISTORY.append(rec)
+    del _PROBE_HISTORY[:-_PROBE_HISTORY_MAXLEN]
+    try:
+        from veles.simd_tpu import obs
+
+        outcome = "ok" if rec["ok"] else "unreachable"
+        obs.count("device_probe", outcome=outcome)
+        obs.record_decision("device_probe", outcome,
+                            attempt=rec["attempt"],
+                            devices=rec["devices"],
+                            detail=rec["detail"] or None,
+                            waited_s=rec["waited_s"])
+    except Exception:  # noqa: BLE001 — telemetry must not break probing
+        pass
 
 
 def to_host(x):
@@ -298,7 +341,8 @@ def require_reachable_device(timeout: float = 120.0,
                   "(want seconds)", file=sys.stderr)
     if wait is None:
         wait = 0.0
-    deadline = time.monotonic() + max(wait, 0.0)
+    t0 = time.monotonic()
+    deadline = t0 + max(wait, 0.0)
     attempt = 0
     while True:
         attempt += 1
@@ -309,6 +353,10 @@ def require_reachable_device(timeout: float = 120.0,
         probe_timeout = timeout if attempt == 1 \
             else min(timeout, max(remaining, 15.0))
         count, detail = _probe_subprocess(probe_timeout)
+        # each attempt leaves a record (obs counter/decision + the
+        # retained history bench.py and the flight recorder embed) —
+        # flaky-device history must survive past stderr
+        _note_probe(attempt, count, detail, time.monotonic() - t0)
         if count >= 1:
             return
         remaining = deadline - time.monotonic()
