@@ -6,7 +6,8 @@
 PYTHON ?= python
 
 .PHONY: all tests tests-quick benchmarks bench bench-regress cshim cshim-check \
-        wavelet-tables lint docs obs-report install install-hooks clean
+        wavelet-tables lint docs obs-report autotune-pack install \
+        install-hooks clean
 
 all: cshim
 
@@ -51,6 +52,14 @@ docs:
 SNAPSHOT ?= BENCH_DETAILS.json
 obs-report:
 	$(PYTHON) tools/obs_report.py $(SNAPSHOT)
+
+# build the pre-warmed autotune pack: measure every routed family's
+# candidates on THIS device and persist the winners so production
+# processes (VELES_SIMD_AUTOTUNE=readonly + _AUTOTUNE_CACHE=pack)
+# never pay route exploration.  Override with PACK=path.
+PACK ?= autotune_pack.json
+autotune-pack:
+	$(PYTHON) tools/autotune_pack.py --out $(PACK)
 
 # Installs the commit gate: `make tests-quick` must be green before any
 # code commit (round-4 postmortem: snapshot 8182983 landed red at HEAD).

@@ -607,7 +607,8 @@ class TestBenchStageRetry:
         monkeypatch.setattr(bench, "bench_elementwise", flaky_cfg)
         for name in ("bench_mathfun", "bench_sgemm", "bench_dwt",
                      "bench_stft", "bench_istft_roundtrip",
-                     "bench_spectrogram", "bench_batched_stft"):
+                     "bench_spectrogram", "bench_batched_stft",
+                     "bench_autotuned_headline"):
             def mk(name):
                 def cfg(rng):
                     return {"metric": name, "unit": "u", "value": 2.0,

@@ -215,6 +215,163 @@ def test_fault_rule_ignores_non_compile_sites():
     assert _fault_errors(FAULT_OK_NO_COMPILE_SITE) == []
 
 
+# --------------------------------------------------------------------------
+# the routing rule (PR 7): selector predicates and route tables in
+# ops//parallel must go through runtime/routing.py's candidate tables
+# --------------------------------------------------------------------------
+
+ROUTING_GOOD = '''
+from veles.simd_tpu.runtime import routing
+
+_FAMILY = routing.family("demo", (
+    routing.Route("fast", predicate=lambda n, **_: n <= 4),
+    routing.Route("slow"),
+))
+
+
+def _use_fast(n):
+    return _FAMILY.gate("fast", n=n)
+
+
+def _select_demo_route(n):
+    return _FAMILY.static_select(n=n)
+
+
+def _run_fast(x):
+    return x
+
+
+_DEMO_ROUTES = {"fast": _run_fast}
+'''
+
+ROUTING_GOOD_ALIASED = '''
+import veles.simd_tpu.runtime.routing as rt
+
+_FAMILY = rt.family("demo", (rt.Route("only"),))
+
+
+def _use_only(n):
+    return _FAMILY.gate("only", n=n)
+'''
+
+ROUTING_BAD_SELECTOR = '''
+def _use_pallas_thing(n, k):
+    return k <= 2047 and n >= 8 * k
+'''
+
+ROUTING_BAD_SELECT = '''
+def _select_thing_route(n):
+    return "fast" if n < 64 else "slow"
+'''
+
+ROUTING_BAD_TABLE = '''
+def _run_fast(x):
+    return x
+
+
+_THING_ROUTES = {"fast": _run_fast}
+'''
+
+
+def _routing_errors(src):
+    return lint.routing_selector_errors(ast.parse(src), "mod.py")
+
+
+def test_routing_rule_passes_table_backed_selectors():
+    assert _routing_errors(ROUTING_GOOD) == []
+
+
+def test_routing_rule_tracks_module_alias():
+    assert _routing_errors(ROUTING_GOOD_ALIASED) == []
+
+
+def test_routing_rule_flags_hand_rolled_use_gate():
+    errs = _routing_errors(ROUTING_BAD_SELECTOR)
+    assert any("runtime.routing" in e for e in errs)
+
+
+def test_routing_rule_flags_hand_rolled_select():
+    assert _routing_errors(ROUTING_BAD_SELECT)
+
+
+def test_routing_rule_flags_routes_table_without_family():
+    errs = _routing_errors(ROUTING_BAD_TABLE)
+    assert any("routing.family" in e for e in errs)
+
+
+ROUTING_BAD_DECOY_IMPORT = '''
+from veles.simd_tpu.runtime.routing import tune_key_str
+
+_K = tune_key_str("f", {})
+
+
+def _run_fast(x):
+    return x
+
+
+_FOO_ROUTES = {"fast": _run_fast}
+
+
+def _use_bar(n):
+    return n < 64 and bool(_K)
+'''
+
+
+def test_routing_rule_not_satisfied_by_decoy_import():
+    """Importing some OTHER routing symbol and calling it must not
+    count as declaring a candidate table (review finding: only the
+    `family` factory mints tables)."""
+    errs = _routing_errors(ROUTING_BAD_DECOY_IMPORT)
+    assert any("routing.family" in e for e in errs)          # table half
+    assert any("_use_bar" in e for e in errs)                # selector half
+
+
+ROUTING_BAD_MODULE_ALIAS_DECOY = '''
+from veles.simd_tpu.runtime import routing
+
+_FAMILY = routing.family("demo", (routing.Route("only"),))
+
+
+def _use_newkernel(n):
+    return n <= 4096 and routing.pow2_bucket(n) >= 64
+'''
+
+
+def test_routing_rule_not_satisfied_by_module_alias_decoy():
+    """A hand-rolled selector that merely CALLS an unrelated helper
+    off the routing module alias (pow2_bucket) is not delegating to
+    the engine — only a family-bound table, the family factory, or
+    <alias>.family/get_family count (review finding)."""
+    errs = _routing_errors(ROUTING_BAD_MODULE_ALIAS_DECOY)
+    assert any("_use_newkernel" in e for e in errs)
+
+
+ROUTING_GOOD_FAMILY_FN = '''
+from veles.simd_tpu.runtime.routing import Route, family
+
+_FAMILY = family("demo", (Route("only"),))
+
+
+def _use_only(n):
+    return _FAMILY.gate("only", n=n)
+'''
+
+
+def test_routing_rule_accepts_family_fn_import():
+    assert _routing_errors(ROUTING_GOOD_FAMILY_FN) == []
+
+
+def test_real_compute_modules_pass_routing_rule():
+    """Acceptance gate: zero hand-rolled selectors left in ops/ —
+    every route constant lives in a runtime.routing candidate table."""
+    for sub in ("ops", "parallel"):
+        for path in sorted((REPO / "veles/simd_tpu" / sub).glob("*.py")):
+            rel = path.relative_to(REPO).as_posix()
+            errs = lint.routing_selector_errors(
+                ast.parse(path.read_text()), rel)
+            assert errs == [], errs
+
+
 def test_real_compute_modules_have_no_inline_fault_handlers():
     """Acceptance gate: zero hand-rolled demote try/except blocks
     remain anywhere in ops/ or parallel/ — all three demotion paths

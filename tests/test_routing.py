@@ -1,0 +1,1108 @@
+"""The unified routing engine (PR 7): candidate tables, selection
+parity with the pre-engine hand-rolled selectors, the measured
+autotuner with a deterministic injected timer, and the persistent
+tune cache (round-trip / corrupt file / version mismatch / readonly).
+
+The parity suite pins the acceptance criterion: for the geometries the
+route suites exercise (test_convolve / test_spectral_routes /
+test_wavelet parity shapes), the engine's static selection equals the
+pre-migration hand-written ladders, re-implemented inline here as the
+frozen spec.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from veles.simd_tpu import obs
+from veles.simd_tpu.ops import convolve as cv
+from veles.simd_tpu.ops import convolve2d as cv2
+from veles.simd_tpu.ops import pallas_kernels as pk
+from veles.simd_tpu.ops import spectral as sp
+from veles.simd_tpu.ops import wavelet as wv
+from veles.simd_tpu.runtime import faults, routing
+
+RNG = np.random.RandomState(71)
+
+
+@pytest.fixture
+def fresh_cache(tmp_path, monkeypatch):
+    """A tune cache bound to a temp file, torn down after the test."""
+    path = str(tmp_path / "tune.json")
+    monkeypatch.setenv(routing.AUTOTUNE_CACHE_ENV, path)
+    routing.set_cache_path(None)     # rebuild from env on next lookup
+    yield path
+    routing.set_cache_path(None)
+
+
+@pytest.fixture
+def autotune_on(monkeypatch):
+    monkeypatch.setenv(routing.AUTOTUNE_ENV, "on")
+    yield
+    routing.set_cache_path(None)
+
+
+def _fake_timer(table):
+    """Deterministic probe timer: seconds per route from ``table``;
+    routes absent from the table raise (probe-failure path)."""
+    def timer(thunk, name):
+        thunk()
+        if name not in table:
+            raise RuntimeError(f"no timing for {name}")
+        return table[name]
+    return timer
+
+
+# ---------------------------------------------------------------------------
+# engine basics
+# ---------------------------------------------------------------------------
+
+class TestEngine:
+    def _family(self, **kw):
+        return routing.Family("t", (
+            routing.Route("fast",
+                          predicate=lambda n, **_: n <= 64,
+                          disable_env="VELES_TEST_DISABLE_FAST",
+                          **kw),
+            routing.Route("slow"),
+        ))
+
+    def test_table_order_is_priority(self):
+        fam = self._family()
+        assert fam.static_select(n=16) == "fast"
+        assert fam.static_select(n=1000) == "slow"
+        assert fam.eligible(n=16) == ["fast", "slow"]
+
+    def test_env_opt_out(self, monkeypatch):
+        fam = self._family()
+        monkeypatch.setenv("VELES_TEST_DISABLE_FAST", "1")
+        assert not fam.gate("fast", n=16)
+        assert fam.static_select(n=16) == "slow"
+
+    def test_terminal_fallback_when_all_gated(self, monkeypatch):
+        fam = routing.Family("t2", (
+            routing.Route("only", predicate=lambda n, **_: False),))
+        assert fam.eligible(n=1) == ["only"]
+        assert fam.static_select(n=1) == "only"
+
+    def test_unknown_route_raises(self):
+        fam = self._family()
+        with pytest.raises(ValueError, match="route"):
+            fam.route("bogus")
+
+    def test_rejection_cache_outranks_armed_plan(self):
+        rejected = set()
+        fam = routing.Family("t3", (
+            routing.Route("fast",
+                          predicate=lambda n, **_: True,
+                          fault_site="t3.fast",
+                          rejection_cache=lambda: rejected,
+                          rejection_key=lambda n, **_: n),
+            routing.Route("slow"),
+        ))
+        assert fam.route_allowed("fast", n=5)
+        rejected.add(5)
+        assert not fam.route_allowed("fast", n=5)
+        # an armed plan opens the gate — but never past the rejection
+        with faults.fault_plan("t3.fast:vmem_oom:1"):
+            assert not fam.route_allowed("fast", n=5)
+            assert fam.route_allowed("fast", n=6)
+
+    def test_armed_plan_opens_closed_gate(self):
+        fam = routing.Family("t4", (
+            routing.Route("fast", predicate=lambda n, **_: False,
+                          fault_site="t4.fast"),
+            routing.Route("slow"),
+        ))
+        assert fam.static_select(n=1) == "slow"
+        with faults.fault_plan("t4.fast:vmem_oom:1"):
+            assert fam.static_select(n=1) == "fast"
+
+    def test_armed_plan_outranks_cached_winner(self, fresh_cache,
+                                               monkeypatch):
+        """An armed injection plan must really dispatch the doomed
+        route — a tune-cache winner consulted first would bypass the
+        gate the plan opened and leave the demote-and-remember path
+        unexercised by CI (review finding)."""
+        fam = routing.Family("t4b", (
+            routing.Route("doomed", predicate=lambda n, **_: True,
+                          fault_site="t4b.doomed"),
+            routing.Route("safe"),
+        ))
+        routing.tune_cache().store("t4b", {"n": 1}, "safe")
+        monkeypatch.setenv(routing.AUTOTUNE_ENV, "readonly")
+        assert fam.select(n=1) == "safe"          # cache honored...
+        with faults.fault_plan("t4b.doomed:vmem_oom:1"):
+            assert fam.select(n=1) == "doomed"    # ...never over a plan
+
+
+    def test_family_registry(self):
+        fam = routing.family("t5", (routing.Route("only"),))
+        assert routing.get_family("t5") is fam
+        assert "t5" in routing.families()
+        with pytest.raises(ValueError, match="unknown route family"):
+            routing.get_family("nope")
+
+    def test_describe_is_json_native(self):
+        fam = self._family()
+        d = fam.describe()
+        json.dumps(d)
+        assert [r["name"] for r in d["routes"]] == ["fast", "slow"]
+
+    def test_mode_override_is_thread_local(self, monkeypatch):
+        """The supervised-worker idiom: an override set in a worker
+        thread (even one abandoned mid-scope) never flips routing for
+        other threads — bench stages must not poison the process."""
+        import threading
+
+        monkeypatch.delenv(routing.AUTOTUNE_ENV, raising=False)
+        seen = {}
+
+        def worker():
+            with routing.autotune_mode_override("on"):
+                seen["worker"] = routing.autotune_mode()
+                # simulate abandonment: main thread reads while the
+                # override is still active in this thread
+                seen["main_during"] = None
+
+        t = threading.Thread(target=worker)
+        with routing.autotune_mode_override("readonly"):
+            assert routing.autotune_mode() == "readonly"
+        assert routing.autotune_mode() == "off"
+        t.start()
+        t.join()
+        assert seen["worker"] == "on"
+        assert routing.autotune_mode() == "off"
+        with pytest.raises(ValueError, match="mode"):
+            with routing.autotune_mode_override("bogus"):
+                pass
+
+    def test_autotune_mode_env(self, monkeypatch):
+        monkeypatch.delenv(routing.AUTOTUNE_ENV, raising=False)
+        assert routing.autotune_mode() == "off"
+        monkeypatch.setenv(routing.AUTOTUNE_ENV, "on")
+        assert routing.autotune_mode() == "on"
+        monkeypatch.setenv(routing.AUTOTUNE_ENV, "READONLY")
+        assert routing.autotune_mode() == "readonly"
+        monkeypatch.setenv(routing.AUTOTUNE_ENV, "typo")
+        assert routing.autotune_mode() == "off"
+
+
+# ---------------------------------------------------------------------------
+# measured autotune (deterministic injected timer)
+# ---------------------------------------------------------------------------
+
+class TestMeasuredAutotune:
+    def _family(self):
+        return routing.Family("probe_fam", (
+            routing.Route("a", predicate=lambda n, **_: True),
+            routing.Route("b"),
+        ))
+
+    def test_measured_winner_beats_static_prior(self, fresh_cache,
+                                                autotune_on):
+        fam = self._family()
+        calls = []
+        runners = {"a": lambda: calls.append("a"),
+                   "b": lambda: calls.append("b")}
+        obs.enable()
+        obs.reset()
+        try:
+            with routing.probe_timer(_fake_timer({"a": 9.0, "b": 2.0})):
+                assert fam.select(runners=runners, n=8) == "b"
+            # both candidates were actually probed (forced uniformly)
+            assert set(calls) == {"a", "b"}
+            ev = [e for e in obs.events() if e["op"] == "autotune"]
+            assert ev and ev[-1]["decision"] == "b"
+            assert ev[-1]["static"] == "a"
+            assert "a=" in ev[-1]["timings"]
+            assert obs.counter_value("autotune_measured",
+                                     family="probe_fam") == 1
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def test_winner_persists_and_reloads_across_processes(
+            self, fresh_cache, autotune_on):
+        fam = self._family()
+        with routing.probe_timer(_fake_timer({"a": 9.0, "b": 2.0})):
+            assert fam.select(runners={"a": lambda: 1,
+                                       "b": lambda: 1}, n=8) == "b"
+        # the decision landed on disk, version-stamped
+        data = json.load(open(fresh_cache))
+        assert data["version"] == routing.TUNE_CACHE_VERSION
+        (key, entry), = data["entries"].items()
+        assert key == "probe_fam|n=8" and entry["route"] == "b"
+        # a NEW cache object (≈ a new process) serves the winner with
+        # no probing — the timer would fail loudly if consulted
+        routing.set_cache_path(None)
+        with routing.probe_timer(_fake_timer({})):
+            assert fam.select(runners={"a": lambda: 1,
+                                       "b": lambda: 1}, n=8) == "b"
+        assert routing.tune_cache().info()["hits"] >= 1
+
+    def test_readonly_consults_but_never_probes(self, fresh_cache,
+                                                monkeypatch):
+        fam = self._family()
+        cache = routing.TuneCache(fresh_cache)
+        cache.store("probe_fam", {"n": 8}, "b", source="sweep")
+        routing.set_cache_path(None)
+        monkeypatch.setenv(routing.AUTOTUNE_ENV, "readonly")
+
+        def never(thunk, name):
+            raise AssertionError("readonly mode must not probe")
+
+        with routing.probe_timer(never):
+            assert fam.select(runners={"a": lambda: 1,
+                                       "b": lambda: 1}, n=8) == "b"
+            # unseen geometry: the static prior, still no probe
+            assert fam.select(runners={"a": lambda: 1,
+                                       "b": lambda: 1}, n=9) == "a"
+
+    def test_cached_winner_no_longer_eligible_is_ignored(
+            self, fresh_cache, autotune_on):
+        rejected = set()
+        fam = routing.Family("probe_fam2", (
+            routing.Route("a", predicate=lambda n, **_: True,
+                          rejection_cache=lambda: rejected,
+                          rejection_key=lambda n, **_: n),
+            routing.Route("b"),
+        ))
+        routing.TuneCache(fresh_cache).store("probe_fam2", {"n": 8},
+                                             "a")
+        routing.set_cache_path(None)
+        rejected.add(8)      # 'a' was demoted since the cache was cut
+        # eligible is now just ['b'] -> single candidate, no probing
+        assert fam.select(runners={"b": lambda: 1}, n=8) == "b"
+
+    def test_probe_failure_skips_candidate(self, fresh_cache,
+                                           autotune_on):
+        fam = self._family()
+
+        def boom():
+            raise RuntimeError("candidate cannot run here")
+
+        with routing.probe_timer(_fake_timer({"b": 1.0})):
+            # 'a' raises inside the injected timer; 'b' wins
+            assert fam.select(runners={"a": boom, "b": lambda: 1},
+                              n=8) == "b"
+        entry = routing.tune_cache().entry("probe_fam", {"n": 8})
+        assert entry["route"] == "b"
+        assert entry["timings_us"]["a"] is None
+
+    def test_probe_vmem_oom_feeds_rejection_cache(self, fresh_cache,
+                                                  autotune_on):
+        rejected = set()
+        fam = routing.Family("probe_fam3", (
+            routing.Route("a", predicate=lambda n, **_: True,
+                          rejection_cache=lambda: rejected,
+                          rejection_key=lambda n, **_: n),
+            routing.Route("b"),
+        ))
+
+        def oom():
+            raise RuntimeError(
+                "Ran out of memory in memory space vmem: scoped "
+                "allocation with size 22.34M and limit 16.00M")
+
+        def timer(thunk, name):
+            thunk()
+            return 1.0
+
+        with routing.probe_timer(timer):
+            assert fam.select(runners={"a": oom, "b": lambda: 1},
+                              n=8) == "b"
+        assert 8 in rejected     # demote-and-remember from the probe
+
+    def test_all_probes_fail_returns_static(self, fresh_cache,
+                                            autotune_on):
+        fam = self._family()
+
+        def boom():
+            raise RuntimeError("nope")
+
+        with routing.probe_timer(_fake_timer({})):
+            assert fam.select(runners={"a": boom, "b": boom},
+                              n=8) == "a"
+        assert routing.tune_cache().entry("probe_fam", {"n": 8}) is None
+
+    def test_transient_probe_failure_is_inconclusive(
+            self, fresh_cache, autotune_on, monkeypatch):
+        """One device hiccup during a probe must not launder the
+        surviving candidate into a persisted 'measured' winner a
+        readonly pack then obeys forever (review finding): the probe
+        gets the same bounded retry dispatch gets, and if the fault
+        persists the round is abandoned — nothing stored, the static
+        prior dispatches, the next encounter re-probes."""
+        monkeypatch.setenv(faults.FAULT_RETRIES_ENV, "1")
+        monkeypatch.setenv(faults.FAULT_BACKOFF_ENV, "0")
+        fam = self._family()
+        calls = []
+
+        def lost():
+            calls.append("a")
+            raise RuntimeError("UNAVAILABLE: socket closed")
+
+        obs.enable()
+        obs.reset()
+        try:
+            with routing.probe_timer(_fake_timer({"b": 1.0})):
+                assert fam.select(runners={"a": lost,
+                                           "b": lambda: 1},
+                                  n=8) == "a"       # the static prior
+            # retried once (the bounded budget), then abandoned
+            assert len(calls) == 2
+            assert routing.tune_cache().entry(
+                "probe_fam", {"n": 8}) is None
+            assert obs.counter_value("autotune_probe_transient",
+                                     family="probe_fam",
+                                     route="a") == 1
+            assert not [e for e in obs.events()
+                        if e["op"] == "autotune"]
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def test_transient_probe_retry_then_success_persists(
+            self, fresh_cache, autotune_on, monkeypatch):
+        """A hiccup that clears within the retry budget still yields a
+        measured, persisted winner."""
+        monkeypatch.setenv(faults.FAULT_RETRIES_ENV, "2")
+        monkeypatch.setenv(faults.FAULT_BACKOFF_ENV, "0")
+        fam = self._family()
+        failures = iter([True, False])
+
+        def flaky():
+            if next(failures):
+                raise RuntimeError("deadline exceeded")
+
+        with routing.probe_timer(_fake_timer({"a": 9.0, "b": 2.0})):
+            assert fam.select(runners={"a": flaky, "b": lambda: 1},
+                              n=8) == "b"
+        entry = routing.tune_cache().entry("probe_fam", {"n": 8})
+        assert entry["route"] == "b"
+        assert entry["timings_us"]["a"] is not None
+
+    def test_stale_cached_winner_never_overwritten(self, fresh_cache,
+                                                   autotune_on):
+        """A cached winner whose route is TEMPORARILY ineligible
+        (env opt-out, demotion) must not be replaced by a re-probe of
+        only the surviving candidates — one debug session's opt-out
+        would permanently poison the operator's pack (review
+        finding).  The static prior dispatches, the entry survives,
+        and the cached winner serves again once its route returns."""
+        routing.TuneCache(fresh_cache).store("probe_fam5", {"n": 8},
+                                             "a")
+        routing.set_cache_path(None)
+        rejected = {8}
+        fam = routing.Family("probe_fam5", (
+            routing.Route("a", predicate=lambda n, **_: True,
+                          rejection_cache=lambda: rejected,
+                          rejection_key=lambda n, **_: n),
+            routing.Route("b"),
+            routing.Route("c"),
+        ))
+
+        def never(thunk, name):
+            raise AssertionError("a stale entry must not re-probe")
+
+        runners = {"a": lambda: 1, "b": lambda: 1, "c": lambda: 1}
+        with routing.probe_timer(never):
+            # 'a' demoted: >=2 candidates remain, but no probe fires
+            # and the pack entry is untouched
+            assert fam.select(runners=runners, n=8) == "b"
+        assert routing.TuneCache(fresh_cache).lookup(
+            "probe_fam5", {"n": 8}) == "a"
+        rejected.clear()                 # the route comes back...
+        with routing.probe_timer(never):
+            assert fam.select(runners=runners, n=8) == "a"
+
+    def test_off_mode_never_touches_cache(self, fresh_cache,
+                                          monkeypatch):
+        monkeypatch.setenv(routing.AUTOTUNE_ENV, "off")
+        fam = self._family()
+        assert fam.select(runners={"a": lambda: 1, "b": lambda: 1},
+                          n=8) == "a"
+        assert not os.path.exists(fresh_cache)
+
+
+# ---------------------------------------------------------------------------
+# the tune cache itself
+# ---------------------------------------------------------------------------
+
+class TestTuneCache:
+    def test_round_trip(self, tmp_path):
+        path = str(tmp_path / "c.json")
+        c1 = routing.TuneCache(path)
+        c1.store("fam", {"n": 4, "k": 2}, "fast",
+                 timings_us={"fast": 10.0, "slow": 20.0})
+        c2 = routing.TuneCache(path)
+        assert c2.lookup("fam", {"k": 2, "n": 4}) == "fast"  # key order
+        entry = c2.entry("fam", {"n": 4, "k": 2})
+        assert entry["timings_us"] == {"fast": 10.0, "slow": 20.0}
+        assert entry["source"] == "measured"
+
+    def test_corrupt_file_degrades_to_empty(self, tmp_path):
+        path = str(tmp_path / "c.json")
+        with open(path, "w") as f:
+            f.write("{not json")
+        c = routing.TuneCache(path)
+        assert c.lookup("fam", {"n": 4}) is None
+        assert c.info()["load_errors"] == 1
+
+    def test_version_mismatch_ignored_and_counted(self, tmp_path):
+        path = str(tmp_path / "c.json")
+        with open(path, "w") as f:
+            json.dump({"version": routing.TUNE_CACHE_VERSION + 1,
+                       "entries": {"fam|n=4": {"route": "fast"}}}, f)
+        c = routing.TuneCache(path)
+        assert c.lookup("fam", {"n": 4}) is None
+        assert c.info()["version_mismatch"] == 1
+
+    def test_malformed_entries_are_skipped(self, tmp_path):
+        path = str(tmp_path / "c.json")
+        with open(path, "w") as f:
+            json.dump({"version": routing.TUNE_CACHE_VERSION,
+                       "entries": {"fam|n=4": {"route": "ok"},
+                                   "fam|n=5": "not a dict",
+                                   "fam|n=6": {"no_route": 1}}}, f)
+        c = routing.TuneCache(path)
+        assert c.lookup("fam", {"n": 4}) == "ok"
+        assert c.lookup("fam", {"n": 5}) is None
+        assert c.lookup("fam", {"n": 6}) is None
+
+    def test_missing_file_is_empty(self, tmp_path):
+        c = routing.TuneCache(str(tmp_path / "absent.json"))
+        assert c.lookup("fam", {"n": 4}) is None
+        assert c.info()["load_errors"] == 0
+
+    def test_device_mismatch_ignored_and_counted(self, tmp_path):
+        """A pack measured on a different accelerator must degrade to
+        empty — winners are device-specific (review finding).  A pack
+        WITHOUT a stamp (hand-authored) is accepted."""
+        path = str(tmp_path / "c.json")
+        with open(path, "w") as f:
+            json.dump({"version": routing.TUNE_CACHE_VERSION,
+                       "device": "TPU v9 imaginary",
+                       "entries": {"fam|n=4": {"route": "fast"}}}, f)
+        c = routing.TuneCache(path)
+        assert c.lookup("fam", {"n": 4}) is None
+        assert c.info()["device_mismatch"] == 1
+        # unstamped pack: accepted
+        with open(path, "w") as f:
+            json.dump({"version": routing.TUNE_CACHE_VERSION,
+                       "entries": {"fam|n=4": {"route": "fast"}}}, f)
+        assert routing.TuneCache(path).lookup("fam", {"n": 4}) == "fast"
+
+    def test_save_stamps_this_device(self, tmp_path):
+        path = str(tmp_path / "c.json")
+        routing.TuneCache(path).store("fam", {"n": 1}, "r")
+        data = json.load(open(path))
+        assert data["device"] == routing.device_kind()
+
+    def test_save_refuses_to_destroy_foreign_pack(self, tmp_path):
+        """A valid pack stamped for another device (or schema
+        version) degrades to empty on LOAD — but a store() must not
+        then overwrite the file with this process's private view: a
+        CPU plumbing run pointed at an operator's TPU pack would
+        permanently destroy the measured winners (review finding)."""
+        path = str(tmp_path / "c.json")
+        foreign = {"version": routing.TUNE_CACHE_VERSION,
+                   "device": "TPU v9 imaginary",
+                   "entries": {"fam|n=4": {"route": "fast"}}}
+        with open(path, "w") as f:
+            json.dump(foreign, f)
+        c = routing.TuneCache(path)
+        c.store("fam", {"n": 8}, "mine")
+        assert json.load(open(path)) == foreign      # untouched
+        assert c.info()["save_refused"] >= 1
+        assert c.lookup("fam", {"n": 8}) == "mine"   # in-memory only
+        # version mismatch: same refusal
+        with open(path, "w") as f:
+            json.dump({"version": routing.TUNE_CACHE_VERSION + 1,
+                       "entries": {"fam|n=4": {"route": "fast"}}}, f)
+        c2 = routing.TuneCache(path)
+        c2.store("fam", {"n": 8}, "mine")
+        assert json.load(open(path))["version"] == \
+            routing.TUNE_CACHE_VERSION + 1
+        # a MISSING or corrupt file is still written (fresh cache)
+        path3 = str(tmp_path / "fresh.json")
+        routing.TuneCache(path3).store("fam", {"n": 1}, "r")
+        assert json.load(open(path3))["entries"]
+
+    def test_transient_unknown_device_does_not_pin_rejection(
+            self, tmp_path, monkeypatch):
+        """A device-stamped pack touched while the backend is still
+        initializing (device_kind transiently "unknown") must load on
+        a LATER touch — a one-shot rejection would silently run static
+        routes for the process lifetime (review finding)."""
+        path = str(tmp_path / "c.json")
+        with open(path, "w") as f:
+            json.dump({"version": routing.TUNE_CACHE_VERSION,
+                       "device": routing.device_kind(),
+                       "entries": {"fam|n=4": {"route": "fast"}}}, f)
+        c = routing.TuneCache(path)
+        monkeypatch.setattr(routing, "device_kind", lambda: "unknown")
+        assert c.lookup("fam", {"n": 4}) is None   # backend down
+        # deferred is NOT a rejection: no device_mismatch counted,
+        # and touches inside the retry interval don't re-read
+        assert c.lookup("fam", {"n": 4}) is None
+        assert c.info()["device_mismatch"] == 0
+        monkeypatch.undo()
+        c._next_load_retry = 0.0                   # interval elapsed
+        assert c.lookup("fam", {"n": 4}) == "fast"  # retried, loaded
+        assert c.info()["device_mismatch"] == 0    # accepted: stays 0
+
+    def test_eviction_drops_oldest_stamp_not_alphabetical(
+            self, tmp_path, monkeypatch):
+        """Eviction follows the per-entry measurement timestamp, not
+        dict order — a save/reload cycle serializes sorted, which
+        would otherwise make eviction alphabetical and evict the
+        hottest class (review finding)."""
+        entries = {"a_newest": {"route": "r", "unix": 300.0},
+                   "b_oldest": {"route": "r", "unix": 100.0},
+                   "c_mid": {"route": "r", "unix": 200.0}}
+        monkeypatch.setattr(routing, "TUNE_CACHE_MAX_ENTRIES", 2)
+        routing._evict_oldest(entries)
+        assert set(entries) == {"a_newest", "c_mid"}
+        # end to end across a reload: the alphabetically-FIRST key is
+        # the newest and must survive the third store
+        path = str(tmp_path / "c.json")
+        c = routing.TuneCache(path)
+        c.store("fam", {"n": 1}, "r1")
+        c.store("fam", {"n": 2}, "r2")
+        c2 = routing.TuneCache(path)         # sorted serialization
+        c2.store("fam", {"n": 0}, "r0")      # sorts first, is newest
+        assert c2.entry("fam", {"n": 1}) is None      # oldest evicted
+        assert c2.entry("fam", {"n": 0})["route"] == "r0"
+        assert c2.entry("fam", {"n": 2})["route"] == "r2"
+        assert c2.info()["evictions"] == 1
+
+    def test_device_kind_failure_not_cached(self, monkeypatch):
+        """A transient jax.devices() failure must not pin "unknown"
+        for the process lifetime — that would reject every
+        device-stamped pack as a device_mismatch forever (review
+        finding)."""
+        real = routing._device_kind_cached
+        monkeypatch.setattr(routing, "_device_kind_cached", None)
+        import jax
+
+        def boom():
+            raise RuntimeError("backend not initialized")
+
+        monkeypatch.setattr(jax, "devices", boom)
+        assert routing.device_kind() == "unknown"
+        assert routing._device_kind_cached is None  # NOT pinned
+        monkeypatch.undo()
+        monkeypatch.setattr(routing, "_device_kind_cached", None)
+        assert routing.device_kind() == str(
+            jax.devices()[0].device_kind)
+        routing._device_kind_cached = real
+
+    def test_concurrent_writers_merge_not_clobber(self, tmp_path):
+        """Two caches sharing one path: each store merges the disk
+        state instead of overwriting it with a private snapshot
+        (review finding: lost updates in the exploration deployment)."""
+        path = str(tmp_path / "c.json")
+        a = routing.TuneCache(path)
+        b = routing.TuneCache(path)   # loads (empty) before a stores
+        b.lookup("famb", {"n": 1})    # force the (empty) load
+        a.store("fama", {"n": 1}, "ra")
+        b.store("famb", {"n": 1}, "rb")
+        merged = routing.TuneCache(path)
+        assert merged.lookup("fama", {"n": 1}) == "ra"
+        assert merged.lookup("famb", {"n": 1}) == "rb"
+
+    def test_memory_only_without_path(self):
+        c = routing.TuneCache(None)
+        c.store("fam", {"n": 1}, "r")
+        assert c.lookup("fam", {"n": 1}) == "r"
+        assert c.save() is None
+
+    def test_registered_in_obs_caches(self):
+        assert "autotune_cache" in obs.caches()
+
+    def test_key_format_is_shared(self):
+        assert routing.tune_key_str("stft", {"hop": 128,
+                                             "frame_length": 512}) \
+            == "stft|frame_length=512,hop=128"
+
+
+# ---------------------------------------------------------------------------
+# parity: engine selection == the pre-migration hand-rolled ladders
+# ---------------------------------------------------------------------------
+
+class TestSelectorParity:
+    def test_convolve_algorithm_parity(self):
+        """select_algorithm vs the frozen pre-engine ladder, across
+        the geometries test_convolve pins plus a boundary sweep."""
+        def frozen(x_len, h_len):
+            if x_len * h_len < cv.AUTO_FFT_MIN_PRODUCT:
+                return cv.ConvolutionAlgorithm.BRUTE_FORCE
+            if x_len >= cv.AUTO_OVERLAP_SAVE_MIN_RATIO * h_len:
+                return cv.ConvolutionAlgorithm.OVERLAP_SAVE
+            return cv.ConvolutionAlgorithm.FFT
+
+        geoms = [(16, 4), (50, 50), (100, 10), (256, 256), (350, 21),
+                 (1000, 50), (2000, 950), (4096, 63), (1 << 20, 64),
+                 (4096, 4096), (128, 16), (1 << 20, 2047),
+                 # threshold boundaries
+                 (1 << 13, 1), ((1 << 13) - 1, 1), (8 * 97, 97),
+                 (8 * 97 - 1, 97)]
+        for x_len, h_len in geoms:
+            assert cv.select_algorithm(x_len, h_len) is \
+                frozen(x_len, h_len), (x_len, h_len)
+
+    def test_stft_selection_parity(self, monkeypatch):
+        """_select_stft_route vs the frozen priority ladder, with the
+        pallas gate both closed (CPU) and forced open."""
+        def frozen(fl, hop, frames, pallas_ok):
+            if (fl, hop) not in sp._STFT_PALLAS_REJECTED and (
+                    pallas_ok and fl % hop == 0 and hop % 128 == 0
+                    and fl // hop >= 2
+                    and frames >= pk.PALLAS_STFT_MIN_FRAMES
+                    and pk.fits_vmem_stft(fl, hop)):
+                return "pallas_fused"
+            if fl <= sp.AUTO_DFT_MATMUL_MAX_FRAME:
+                return "rdft_matmul"
+            return "xla_fft"
+
+        geoms = [(64, 16, 500), (64, 32, 500), (64, 64, 500),
+                 (65, 16, 500), (512, 128, 1000), (512, 128, 10),
+                 (512, 96, 1000), (512, 64, 1000), (4096, 1024, 100),
+                 (8192, 1024, 100), (16384, 2048, 100)]
+        for pallas_ok in (False, True):
+            if pallas_ok:
+                monkeypatch.setattr(pk, "pallas_available",
+                                    lambda: True)
+            for fl, hop, frames in geoms:
+                assert sp._select_stft_route(fl, hop, frames) == \
+                    frozen(fl, hop, frames,
+                           pallas_ok and pk.stft_pallas_allowed()), \
+                    (fl, hop, frames, pallas_ok)
+
+    def test_wavelet_gate_parity(self):
+        """_use_pallas vs the frozen row/VMEM formula on the parity
+        suite's shapes."""
+        shapes = [((512, 4096), 8, 1, 2), ((8, 4_000_000), 8, 1, 2),
+                  ((4, 256), 8, 1, 2), ((64, 4096), 16, 4, 1),
+                  ((256,), 8, 1, 2)]
+        for src_shape, order, dil, stride in shapes:
+            rows = (int(np.prod(src_shape[:-1]))
+                    if len(src_shape) > 1 else 1)
+            n = src_shape[-1]
+            want = pk.should_route(
+                rows, (n + order * dil) + 2 * (n // stride))
+            assert wv._use_pallas(src_shape, order, dil, stride) == \
+                want, (src_shape, order, dil, stride)
+
+    def test_conv2d_selection_parity(self):
+        """select_algorithm2d (no-shape form) vs the frozen area
+        ladder on CPU (pallas unavailable -> always fft) — the
+        shape-aware form is pinned by test_convolve2d."""
+        for k0, k1 in ((3, 3), (16, 16), (17, 17), (33, 33)):
+            want = ("direct" if (pk.pallas_available()
+                                 and pk.pallas2d_compiled_allowed()
+                                 and k0 * k1
+                                 <= pk.PALLAS_2D_MAX_KERNEL_AREA)
+                    else "fft")
+            assert cv2.select_algorithm2d(k0, k1) == want
+
+    def test_every_family_is_registered(self):
+        fams = routing.families()
+        for name in ("convolve", "convolve.direct", "convolve.os",
+                     "convolve2d", "wavelet", "wavelet.cascade",
+                     "stft", "istft", "hilbert", "morlet_cwt"):
+            assert name in fams, name
+
+
+# ---------------------------------------------------------------------------
+# wavelet route parity satellite: env opt-out + forced routes
+# ---------------------------------------------------------------------------
+
+class TestWaveletRouteParity:
+    def test_disable_env_closes_the_gate(self, monkeypatch):
+        src_shape, order = (512, 4096), 8
+        monkeypatch.setattr(pk, "should_route", lambda *a: True)
+        assert wv._use_pallas(src_shape, order, 1, 2)
+        monkeypatch.setenv("VELES_SIMD_DISABLE_PALLAS_WAVELET", "1")
+        assert not wv._use_pallas(src_shape, order, 1, 2)
+
+    def test_forced_routes_match_oracle(self):
+        x = RNG.randn(8, 256).astype(np.float32)
+        want_hi, want_lo = wv.wavelet_apply_na(
+            wv.WaveletType.DAUBECHIES, 8, wv.ExtensionType.PERIODIC, x)
+        for route in ("pallas", "xla_conv"):
+            hi, lo = wv.wavelet_apply(
+                wv.WaveletType.DAUBECHIES, 8,
+                wv.ExtensionType.PERIODIC, x, simd=True, route=route)
+            np.testing.assert_allclose(np.asarray(hi), want_hi,
+                                       atol=1e-4, err_msg=route)
+            np.testing.assert_allclose(np.asarray(lo), want_lo,
+                                       atol=1e-4, err_msg=route)
+
+    def test_forced_swt_routes_match_oracle(self):
+        x = RNG.randn(8, 256).astype(np.float32)
+        want_hi, want_lo = wv.stationary_wavelet_apply_na(
+            wv.WaveletType.DAUBECHIES, 8, 2,
+            wv.ExtensionType.PERIODIC, x)
+        for route in ("pallas", "xla_conv"):
+            hi, lo = wv.stationary_wavelet_apply(
+                wv.WaveletType.DAUBECHIES, 8, 2,
+                wv.ExtensionType.PERIODIC, x, simd=True, route=route)
+            np.testing.assert_allclose(np.asarray(hi), want_hi,
+                                       atol=1e-4, err_msg=route)
+
+    def test_bad_route_rejected(self):
+        x = RNG.randn(4, 64).astype(np.float32)
+        with pytest.raises(ValueError, match="route"):
+            wv.wavelet_apply(wv.WaveletType.DAUBECHIES, 8,
+                             wv.ExtensionType.PERIODIC, x, simd=True,
+                             route="bogus")
+        with pytest.raises(ValueError, match="route"):
+            wv.stationary_wavelet_apply(
+                wv.WaveletType.DAUBECHIES, 8, 1,
+                wv.ExtensionType.PERIODIC, x, simd=True, route="bogus")
+
+    def test_forced_route_reraises_never_degrades(self, monkeypatch):
+        """A pinned route must never silently answer via the other
+        implementation (the faults.guarded forced semantics)."""
+        def boom(*a, **k):
+            raise RuntimeError("kernel exploded")
+
+        monkeypatch.setattr(wv, "_filter_bank_pallas", boom)
+        x = RNG.randn(4, 64).astype(np.float32)
+        with pytest.raises(RuntimeError, match="exploded"):
+            wv.wavelet_apply(wv.WaveletType.DAUBECHIES, 8,
+                             wv.ExtensionType.PERIODIC, x, simd=True,
+                             route="pallas")
+        # the un-forced path is untouched (the gate refuses pallas on
+        # CPU, so the boom is never reached)
+        wv.wavelet_apply(wv.WaveletType.DAUBECHIES, 8,
+                         wv.ExtensionType.PERIODIC, x, simd=True)
+
+    def test_forced_route_recorded(self):
+        obs.enable()
+        obs.reset()
+        try:
+            x = RNG.randn(4, 64).astype(np.float32)
+            wv.wavelet_apply(wv.WaveletType.DAUBECHIES, 8,
+                             wv.ExtensionType.PERIODIC, x, simd=True,
+                             route="xla_conv")
+            ev = [e for e in obs.events()
+                  if e["op"] == "wavelet_apply"][-1]
+            assert ev["decision"] == "xla_conv"
+            assert ev["forced"] is True
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def test_env_documented(self):
+        guide = open(os.path.join(os.path.dirname(__file__), os.pardir,
+                                  "docs", "GUIDE.md")).read()
+        assert "VELES_SIMD_DISABLE_PALLAS_WAVELET" in guide
+        assert "VELES_SIMD_AUTOTUNE" in guide
+        assert "VELES_SIMD_AUTOTUNE_CACHE" in guide
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the measured winner steers a real op and survives a
+# "process restart" (fresh cache object, same file)
+# ---------------------------------------------------------------------------
+
+class TestAutotunedDispatch:
+    def test_stft_measured_winner_selected_persisted_reloaded(
+            self, fresh_cache, autotune_on):
+        """Acceptance: with VELES_SIMD_AUTOTUNE=on the measured winner
+        is selected, persisted, and reloaded across processes —
+        decision events + cache introspection prove it."""
+        x = RNG.randn(4096).astype(np.float32)
+        # static prior for frame 256 is rdft_matmul; the injected
+        # timer makes xla_fft the measured winner
+        timer = _fake_timer({"rdft_matmul": 5.0, "xla_fft": 1.0,
+                             "pallas_fused": 9.0})
+        obs.enable()
+        obs.reset()
+        try:
+            with routing.probe_timer(timer):
+                sp.stft(x, 256, 128, simd=True)
+            route_ev = [e for e in obs.events()
+                        if e["op"] == "stft_route"][-1]
+            assert route_ev["decision"] == "xla_fft"
+            tune_ev = [e for e in obs.events()
+                       if e["op"] == "autotune"][-1]
+            assert tune_ev["decision"] == "xla_fft"
+            assert tune_ev["family"] == "stft"
+            assert tune_ev["static"] == "rdft_matmul"
+            # persisted...
+            data = json.load(open(fresh_cache))
+            keys = [k for k in data["entries"] if k.startswith("stft|")]
+            assert keys and data["entries"][keys[0]]["route"] == \
+                "xla_fft"
+            # ...and reloaded by a fresh cache object (= new process):
+            # the winner dispatches with NO probing
+            routing.set_cache_path(None)
+            obs.reset()
+            with routing.probe_timer(_fake_timer({})):
+                sp.stft(x, 256, 128, simd=True)
+            route_ev = [e for e in obs.events()
+                        if e["op"] == "stft_route"][-1]
+            assert route_ev["decision"] == "xla_fft"
+            assert not [e for e in obs.events()
+                        if e["op"] == "autotune"]
+            assert obs.counter_value("autotune_cache_hit",
+                                     family="stft") >= 1
+            info = obs.caches()["autotune_cache"]
+            assert info["hits"] >= 1 and info["path"] == fresh_cache
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def test_stft_geometry_classes_are_finite(self, fresh_cache,
+                                              autotune_on):
+        """Variable-length signals at one (frame, hop) share ONE tune
+        entry (frames bucketed at the pallas gate threshold) — a
+        length-churning service must not probe per length or grow the
+        cache without bound (review finding)."""
+        timer = _fake_timer({"rdft_matmul": 1.0, "xla_fft": 5.0,
+                             "pallas_fused": 9.0})
+        probes = []
+
+        def counting(thunk, name):
+            probes.append(name)
+            return timer(thunk, name)
+
+        with routing.probe_timer(counting):
+            sp.stft(RNG.randn(4096).astype(np.float32), 256, 128,
+                    simd=True)
+            first = len(probes)
+            assert first > 0
+            # different signal length, same (frame, hop) class: the
+            # cached winner serves it, no new probes, no new entry
+            sp.stft(RNG.randn(8192).astype(np.float32), 256, 128,
+                    simd=True)
+            assert len(probes) == first
+        stft_keys = [k for k in routing.tune_cache().entries()
+                     if k.startswith("stft|")]
+        assert len(stft_keys) == 1
+        assert "frames_class=" in stft_keys[0]
+
+    def test_private_tune_cache_shields_the_bound_pack(
+            self, fresh_cache, autotune_on):
+        """A measuring scope must neither consult nor overwrite the
+        operator's $VELES_SIMD_AUTOTUNE_CACHE pack (review finding:
+        bench's autotuned stage vs a production pack)."""
+        # the bound pack has a (stale) winner...
+        routing.TuneCache(fresh_cache).store("probe_pf", {"n": 1},
+                                             "stale")
+        routing.set_cache_path(None)
+        fam = routing.Family("probe_pf", (
+            routing.Route("a", predicate=lambda n, **_: True),
+            routing.Route("stale"),
+        ))
+        with routing.probe_timer(_fake_timer({"a": 1.0,
+                                              "stale": 9.0})):
+            with routing.private_tune_cache() as private:
+                # ...which the private scope does NOT see: it probes
+                # fresh and stores locally
+                assert fam.select(runners={"a": lambda: 1,
+                                           "stale": lambda: 1},
+                                  n=1) == "a"
+                assert private.entry("probe_pf", {"n": 1})["route"] \
+                    == "a"
+        # and the pack on disk still holds the original entry
+        assert routing.TuneCache(fresh_cache).lookup(
+            "probe_pf", {"n": 1}) == "stale"
+
+    def test_tune_geom_decouples_class_from_rejection_key(
+            self, fresh_cache, autotune_on):
+        """convolve2d's shape (review finding): the tune CLASS buckets
+        churning dims while the rejection key stays exact.  One probe
+        round serves every exact shape in the bucket, and a probe
+        vmem-OOM feeds the rejection cache under the EXACT geom."""
+        from veles.simd_tpu import obs
+        rejected = obs.LRUSet(8)
+        fam = routing.Family("probe_tg", (
+            routing.Route("a", predicate=lambda n, **_: True,
+                          rejection_cache=lambda: rejected,
+                          rejection_key=lambda n, **_: n),
+            routing.Route("b"),
+        ))
+        runners = {"a": lambda: 1, "b": lambda: 1}
+        with routing.probe_timer(_fake_timer({"a": 1.0, "b": 9.0})):
+            assert fam.select(runners=runners,
+                              tune_geom={"n": 128}, n=100) == "a"
+        # stored under the BUCKETED class, not the exact dims
+        cache = routing.tune_cache()
+        assert cache.lookup("probe_tg", {"n": 128}) == "a"
+        assert cache.lookup("probe_tg", {"n": 100}) is None
+        # a different exact shape in the same bucket: cache hit, no
+        # second probe round (a probing timer would raise on "b")
+        with routing.probe_timer(_fake_timer({})):
+            assert fam.select(runners=runners,
+                              tune_geom={"n": 128}, n=97) == "a"
+
+        # probe OOM remembers the EXACT geom in the rejection cache
+        def _oom():
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: Ran out of memory in memory "
+                "space vmem while allocating scoped")
+        with routing.probe_timer(_fake_timer({"b": 1.0})):
+            assert fam.select(
+                runners={"a": _oom, "b": lambda: 1},
+                tune_geom={"n": 256}, n=200) == "b"
+        assert 200 in rejected
+        assert 256 not in rejected
+
+    def test_pow2_bucket(self):
+        assert routing.pow2_bucket(0) == 0
+        assert routing.pow2_bucket(1) == 1
+        assert routing.pow2_bucket(2) == 2
+        assert routing.pow2_bucket(3) == 4
+        assert routing.pow2_bucket(1 << 20) == 1 << 20
+        assert routing.pow2_bucket((1 << 20) + 1) == 1 << 21
+
+    def test_runner_factory_only_invoked_when_probing(
+            self, fresh_cache, monkeypatch):
+        """The factory form: never called in off/readonly mode or for
+        single-candidate dispatches (the 9 per-site mode ladders this
+        replaced)."""
+        fam = routing.Family("probe_fam4", (
+            routing.Route("a", predicate=lambda n, **_: True),
+            routing.Route("b"),
+        ))
+
+        def factory():
+            raise AssertionError("factory must not be invoked")
+
+        monkeypatch.setenv(routing.AUTOTUNE_ENV, "off")
+        assert fam.select(runners=factory, n=1) == "a"
+        monkeypatch.setenv(routing.AUTOTUNE_ENV, "readonly")
+        assert fam.select(runners=factory, n=1) == "a"
+        monkeypatch.setenv(routing.AUTOTUNE_ENV, "on")
+        assert fam.select(eligible=["b"], runners=factory, n=1) == "b"
+        # and in the probing case it IS consulted
+        with routing.probe_timer(_fake_timer({"a": 2.0, "b": 1.0})):
+            assert fam.select(
+                runners=lambda: {"a": lambda: 1, "b": lambda: 1},
+                n=1) == "b"
+
+    def test_probe_refused_under_trace(self, fresh_cache, autotune_on):
+        """probe_operand tracer check: selection under an outer jit
+        returns the static prior and persists nothing."""
+        import jax
+
+        fam = routing.Family("probe_fam5", (
+            routing.Route("a", predicate=lambda n, **_: True),
+            routing.Route("b"),
+        ))
+        picked = []
+
+        def f(v):
+            picked.append(fam.select(
+                runners=lambda: {"a": lambda: v, "b": lambda: v},
+                probe_operand=v, n=7))
+            return v
+
+        jax.jit(f)(np.float32(1.0))
+        assert picked == ["a"]
+        assert routing.tune_cache().entry("probe_fam5",
+                                          {"n": 7}) is None
+
+    def test_tune_cache_is_bounded(self):
+        c = routing.TuneCache(None)
+        for i in range(routing.TUNE_CACHE_MAX_ENTRIES + 5):
+            c.store("fam", {"n": i}, "r")
+        info = c.info()
+        assert info["size"] == routing.TUNE_CACHE_MAX_ENTRIES
+        assert info["evictions"] == 5
+        assert c.lookup("fam", {"n": 0}) is None      # oldest evicted
+
+    def test_wavelet_measured_winner(self, fresh_cache, autotune_on,
+                                     monkeypatch):
+        """The wavelet family really probes under the measured mode
+        (review finding: runners were never wired)."""
+        monkeypatch.setattr(pk, "should_route", lambda *a: True)
+        x = RNG.randn(8, 256).astype(np.float32)
+        with routing.probe_timer(_fake_timer({"pallas": 9.0,
+                                              "xla_conv": 1.0})):
+            wv.wavelet_apply(wv.WaveletType.DAUBECHIES, 8,
+                             wv.ExtensionType.PERIODIC, x, simd=True)
+        entry = routing.tune_cache().entry(
+            "wavelet", {"rows": 8, "n": 256, "order": 8,
+                        "dilation": 1, "stride": 2})
+        assert entry is not None and entry["route"] == "xla_conv"
+
+    def test_off_mode_is_bit_identical_static(self, monkeypatch):
+        """The default mode must reproduce the static prior exactly
+        (the parity acceptance: env opt-outs and selector decisions
+        are unchanged pre/post engine migration)."""
+        monkeypatch.delenv(routing.AUTOTUNE_ENV, raising=False)
+        obs.enable()
+        obs.reset()
+        try:
+            x = RNG.randn(4096).astype(np.float32)
+            sp.stft(x, 256, 128, simd=True)
+            ev = [e for e in obs.events()
+                  if e["op"] == "stft_route"][-1]
+            assert ev["decision"] == sp._select_stft_route(
+                256, 128, sp.frame_count(4096, 256, 128))
+            assert not [e for e in obs.events()
+                        if e["op"] == "autotune"]
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def test_hilbert_autotune_probe_runs_real_candidates(
+            self, fresh_cache, autotune_on):
+        """The probe thunks run the REAL route runners (device calls),
+        so a winner is always a route that actually worked here."""
+        x = RNG.randn(300).astype(np.float32)
+        with routing.probe_timer(_fake_timer({"matmul_dft": 2.0,
+                                              "xla_fft": 1.0})):
+            got = sp.hilbert(x, simd=True)
+        np.testing.assert_allclose(np.asarray(got),
+                                   sp.hilbert_na(x).astype(
+                                       np.complex64).real
+                                   + 1j * sp.hilbert_na(x).astype(
+                                       np.complex64).imag,
+                                   atol=1e-3)
+        # stored under the pow2-bucketed CLASS (n=300 -> 512), not
+        # the exact length — length churn shares finite entries
+        entry = routing.tune_cache().entry(
+            "hilbert", {"n": routing.pow2_bucket(300), "rows": 1})
+        assert entry["route"] == "xla_fft"
+        # another length in the same bucket: cache hit, no re-probe
+        # (a probing timer would raise on the empty table)
+        with routing.probe_timer(_fake_timer({})):
+            sp.hilbert(RNG.randn(400).astype(np.float32), simd=True)
+        keys = [k for k in routing.tune_cache().entries()
+                if k.startswith("hilbert|")]
+        assert len(keys) == 1
+
+    def test_batched_stft_honors_pack_winner(self, fresh_cache,
+                                             monkeypatch):
+        """batched_stft routes through the SAME engine selection as
+        stft, so a pack winner steers both entry points (review
+        finding: the batched path used the static prior only)."""
+        from veles.simd_tpu.ops import batched as bt
+        frames = sp.frame_count(4096, 512, 128)
+        static = sp._select_stft_route(512, 128, frames)
+        assert static == "rdft_matmul"
+        routing.tune_cache().store(
+            "stft", sp._stft_tune_class(512, 128, frames, rows=4),
+            "xla_fft")
+        monkeypatch.setenv(routing.AUTOTUNE_ENV, "readonly")
+        assert sp._stft_route_for(512, 128, frames, 4) == "xla_fft"
+        x = RNG.randn(4, 4096).astype(np.float32)
+        before = routing.tune_cache().info()["hits"]
+        got = bt.batched_stft(x, 512, 128)
+        assert routing.tune_cache().info()["hits"] > before
+        np.testing.assert_allclose(
+            np.asarray(got), sp.stft_na(x, 512, 128), atol=1e-3)
+        # off mode: back to the static prior
+        monkeypatch.setenv(routing.AUTOTUNE_ENV, "off")
+        assert sp._stft_route_for(512, 128, frames, 4) == \
+            "rdft_matmul"

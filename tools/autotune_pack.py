@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Build a pre-warmed autotune pack: measure, persist, ship.
+
+Production processes should never pay route exploration: this tool
+runs the measured autotuner (``VELES_SIMD_AUTOTUNE=on``,
+``runtime/routing.py``) across a representative geometry sweep for
+every routed family — convolve overlap-save/direct, convolve2d, the
+spectral family (stft/istft/hilbert/cwt), wavelet — and writes the
+winners into one version-stamped tune-cache file.  Ship that file and
+point services at it with::
+
+    VELES_SIMD_AUTOTUNE=readonly \\
+    VELES_SIMD_AUTOTUNE_CACHE=/etc/veles/autotune_pack.json serve.py
+
+The hand-sweep tools (``tools/tune_overlap_save.py``,
+``tools/tune_conv2d.py``) emit entries in the SAME format (their
+``--cache`` flag), so a manual sweep and the online tuner build one
+artifact.
+
+Run:  python tools/autotune_pack.py [--out autotune_pack.json]
+      [--quick]   (or ``make autotune-pack``)
+      VELES_SIMD_PLATFORM=cpu ... validates plumbing; measure winners
+      on the real chip before shipping a pack.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from veles.simd_tpu.utils.platform import maybe_override_platform  # noqa: E402
+
+
+def _drive(quick: bool) -> None:
+    """One call per geometry class: the engine's measured mode does
+    the probing/persisting as a side effect of normal dispatch."""
+    import jax.numpy as jnp
+
+    from veles.simd_tpu.ops import convolve as cv
+    from veles.simd_tpu.ops import convolve2d as cv2
+    from veles.simd_tpu.ops import spectral as sp
+    from veles.simd_tpu.ops import wavelet as wv
+
+    rng = np.random.RandomState(7)
+
+    # convolve overlap-save: the headline geometry first, then the
+    # medium-filter classes the suite exercises
+    os_geoms = [(1 << 20, 2047)] if quick else [
+        (1 << 20, 2047), (1 << 20, 511), (1 << 18, 1023),
+        (1 << 16, 127)]
+    for n, k in os_geoms:
+        x = jnp.asarray(rng.randn(n).astype(np.float32))
+        h = jnp.asarray(rng.randn(k).astype(np.float32))
+        handle = cv.convolve_overlap_save_initialize(n, k)
+        np.asarray(cv.convolve_overlap_save(handle, x, h, simd=True))
+        print(f"  convolve.os {n}x{k}: done", flush=True)
+
+    # batched direct form (Pallas shifted-MAC vs MXU conv)
+    for rows, n, k in ([(64, 4096, 65)] if quick
+                       else [(64, 4096, 65), (512, 4096, 9)]):
+        x = jnp.asarray(rng.randn(rows, n).astype(np.float32))
+        h = jnp.asarray(rng.randn(k).astype(np.float32))
+        np.asarray(cv.convolve_simd(x, h, simd=True))
+        print(f"  convolve.direct {rows}x{n} k={k}: done", flush=True)
+
+    # convolve2d auto cells inside the Pallas gate
+    for n0, k0 in ([(128, 3)] if quick else [(128, 3), (256, 5)]):
+        x = rng.randn(8, n0, n0).astype(np.float32)
+        h = rng.randn(k0, k0).astype(np.float32)
+        np.asarray(cv2.convolve2d(x, h, simd=True))
+        print(f"  convolve2d 8x{n0}^2 k={k0}: done", flush=True)
+
+    # spectral: stft/istft per (frame, hop) class + hilbert/cwt sizes
+    stft_geoms = [(16384, 512, 128)] if quick else [
+        (16384, 512, 128), (16384, 512, 64), (65536, 1024, 256)]
+    for n, fl, hop in stft_geoms:
+        x = rng.randn(n).astype(np.float32)
+        spec = sp.stft(x, fl, hop, simd=True)
+        np.asarray(sp.istft(np.asarray(spec), n, fl, hop, simd=True))
+        print(f"  stft/istft {n}/{fl}/{hop}: done", flush=True)
+    xs = rng.randn(512).astype(np.float32)
+    np.asarray(sp.hilbert(xs, simd=True))
+    np.asarray(sp.morlet_cwt(xs, [2.0, 4.0, 8.0], simd=True))
+    print("  hilbert/morlet_cwt 512: done", flush=True)
+
+    # wavelet filter bank (pallas vs xla_conv)
+    xw = rng.randn(64, 4096).astype(np.float32)
+    wv.wavelet_apply(wv.WaveletType.DAUBECHIES, 8,
+                     wv.ExtensionType.PERIODIC, xw, simd=True)
+    print("  wavelet 64x4096 daub8: done", flush=True)
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", default="autotune_pack.json",
+                        help="tune-cache file to build (default "
+                             "autotune_pack.json)")
+    parser.add_argument("--quick", action="store_true",
+                        help="headline geometries only")
+    args = parser.parse_args()
+    os.environ["VELES_SIMD_AUTOTUNE"] = "on"
+    maybe_override_platform()
+
+    from veles.simd_tpu import obs
+    from veles.simd_tpu.runtime import routing
+
+    routing.set_cache_path(args.out)
+    obs.enable()
+    try:
+        import jax
+
+        print(f"device: {jax.devices()[0]}  pack: {args.out}",
+              flush=True)
+        _drive(args.quick)
+    finally:
+        cache = routing.tune_cache()
+        cache.save()
+        entries = cache.entries()
+        print(f"\npack {args.out}: {len(entries)} entries "
+              f"(version {routing.TUNE_CACHE_VERSION})")
+        for key, entry in sorted(entries.items()):
+            print(f"  {key} -> {entry['route']} "
+                  f"[{entry.get('source', '?')}]")
+        autotune_events = [e for e in obs.events()
+                           if e["op"] == "autotune"]
+        if autotune_events:
+            print(f"{len(autotune_events)} autotune decision events "
+                  "recorded; timings embedded in the pack")
+        routing.set_cache_path(None)
+        print(json.dumps(cache.info(), indent=1, sort_keys=True))
+
+
+if __name__ == "__main__":
+    main()

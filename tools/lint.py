@@ -228,6 +228,115 @@ def fault_handler_errors(tree, fname) -> list:
     return errors
 
 
+# --- routing-engine rule ----------------------------------------------------
+# PR 7 moved every hand-rolled route selector (convolve._use_pallas_os,
+# wavelet._use_pallas, spectral._use_matmul_dft, ...) into declarative
+# candidate tables in veles/simd_tpu/runtime/routing.py.  This rule
+# keeps a new hand-written copy from reappearing in ops//parallel: a
+# module-level selector function (``_use_*`` / ``_select_*`` /
+# ``select_algorithm*``) must reference the routing engine — the
+# module's routing alias or a name bound from a ``routing.family(...)``
+# call — and a module that declares a ``*_ROUTES`` runner table must
+# declare its candidate table through ``routing.family`` too.
+# Alias-tracked like the instrumented_jit and fault-handler rules
+# (``import ... as rt`` cannot dodge it).
+
+_ROUTING_MOD = "veles.simd_tpu.runtime.routing"
+_SELECTOR_PREFIXES = ("_use_", "_select_", "select_algorithm")
+
+
+def _routing_aliases(tree) -> tuple:
+    """``(module_aliases, family_fns)``: names bound to the routing
+    engine MODULE, and names bound to its ``family`` FACTORY
+    specifically — only the latter may mint candidate tables via a
+    bare-name call (``from ...routing import tune_key_str`` must not
+    satisfy the table half of the rule)."""
+    modules, family_fns = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "veles.simd_tpu.runtime":
+                for a in node.names:
+                    if a.name == "routing":
+                        modules.add(a.asname or a.name)
+            elif node.module == _ROUTING_MOD:
+                for a in node.names:
+                    if a.name == "family":
+                        family_fns.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == _ROUTING_MOD and a.asname:
+                    modules.add(a.asname)
+    return modules, family_fns
+
+
+def _family_table_names(tree, modules, family_fns) -> set:
+    """Module-level names assigned from ``<alias>.family(...)`` /
+    ``family(...)`` calls (the candidate tables selectors delegate
+    into)."""
+    names = set()
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            continue
+        func = node.value.func
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id in modules
+                and func.attr == "family") or (
+                isinstance(func, ast.Name) and func.id in family_fns):
+            names.add(node.targets[0].id)
+    return names
+
+
+def routing_selector_errors(tree, fname) -> list:
+    """The rule body on a parsed module (separated so tests can feed
+    synthetic sources).  Returns human-readable error strings."""
+    errors = []
+    modules, family_fns = _routing_aliases(tree)
+    families = _family_table_names(tree, modules, family_fns)
+    # a selector delegates to the ENGINE only through a family-bound
+    # table, the family factory, or <alias>.family/get_family — a
+    # bare reference to the module alias (routing.pow2_bucket in an
+    # otherwise hand-rolled ladder) is a decoy, not a delegation
+    table_names = family_fns | families
+
+    def references_engine(fn) -> bool:
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Name) and n.id in table_names:
+                return True
+            if (isinstance(n, ast.Attribute)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id in modules
+                    and n.attr in ("family", "get_family")):
+                return True
+        return False
+
+    has_routes_table = any(
+        isinstance(node, ast.Assign) and len(node.targets) == 1
+        and isinstance(node.targets[0], ast.Name)
+        and node.targets[0].id.endswith("_ROUTES")
+        for node in tree.body)
+    if has_routes_table and not families:
+        errors.append(
+            f"{fname}: a *_ROUTES runner table without a "
+            "routing.family(...) candidate table — declare the "
+            "family's routes through veles.simd_tpu.runtime.routing")
+    for node in tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if not node.name.startswith(_SELECTOR_PREFIXES):
+            continue
+        if not references_engine(node):
+            errors.append(
+                f"{fname}:{node.lineno}: selector {node.name} does "
+                "not consult the routing engine — route predicates "
+                "and selection belong in a runtime.routing candidate "
+                "table (routing.family), with the selector a thin "
+                "delegate")
+    return errors
+
+
 # --- spectral route-dispatch rule ------------------------------------------
 # ops/spectral.py's route tables (``_STFT_ROUTES`` / ``_ISTFT_ROUTES``)
 # are the template the next routed op family copies.  Two structural
@@ -359,6 +468,9 @@ def compute_module_lint(files) -> int:
                 print(msg)
                 failures += 1
         for msg in fault_handler_errors(tree, str(f)):
+            print(msg)
+            failures += 1
+        for msg in routing_selector_errors(tree, str(f)):
             print(msg)
             failures += 1
         aliases = set()

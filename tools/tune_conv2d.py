@@ -17,12 +17,22 @@ recommends the kernel-area crossover that best separates direct-vs-FFT
 wins.  Paste fresh numbers into the ``ops/convolve2d.py`` tables +
 BASELINE.md when rerun.
 
+Since PR 7 the sweep also emits TUNE-CACHE ENTRIES (the shared
+autotune format, ``runtime/routing.py``): each cell whose winner is an
+auto route — ``direct`` (the Pallas kernel) or ``fft`` — is stored
+under the ``convolve2d`` family's geometry key with
+``source="sweep"``, so a hand sweep and the online tuner build one
+artifact.  XLA-direct wins (never observed) are printed but not
+emitted: auto-routing must never select the crash-prone im2col path.
+
 Run:  python tools/tune_conv2d.py [--quick]
+          [--cache autotune_pack.json]
       VELES_SIMD_PLATFORM=cpu ... validates plumbing only — the
       crossover is an MXU-vs-FFT decision, measure on the real chip.
 """
 
 import argparse
+import itertools
 import os
 import sys
 
@@ -38,6 +48,17 @@ ERR_GATE = 1e-4  # matches tools/tpu_smoke.py convolve2d tolerance
 def main():
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--quick", action="store_true")
+    parser.add_argument(
+        "--cache",
+        default=os.environ.get("VELES_SIMD_AUTOTUNE_CACHE") or None,
+        help="tune-cache file to emit route winners into (default: "
+             "$VELES_SIMD_AUTOTUNE_CACHE; omit to print tables only)")
+    parser.add_argument(
+        "--rows", default="1,8",
+        help="comma-separated batch sizes to sweep.  Dispatch "
+             "pow2-buckets the batch (and image dims) into the tune "
+             "class, so a pack serves every batch in a swept bucket "
+             "— sweep the buckets production runs land in")
     args = parser.parse_args()
     maybe_override_platform()
 
@@ -45,8 +66,11 @@ def main():
     import jax.numpy as jnp
 
     from veles.simd_tpu.ops import convolve2d as cv2
+    from veles.simd_tpu.runtime import routing
     from veles.simd_tpu.utils.benchmark import device_time_chained
     from veles.simd_tpu.utils.memory import next_highest_power_of_2 as np2
+
+    cache = routing.TuneCache(args.cache) if args.cache else None
 
     rng = np.random.RandomState(0)
     print(f"device: {jax.devices()[0]}", flush=True)
@@ -79,9 +103,12 @@ def main():
         m1 = np2(x.shape[-1] + k1 - 1)
         return cv2._conv2d_fft(x, h, m0, m1)
 
+    rows_list = [int(r) for r in args.rows.split(",") if r.strip()]
+
     results = {}
-    for n0, n1 in images:
-        x_np = rng.randn(n0, n1).astype(np.float32)
+    for rows, (n0, n1) in itertools.product(rows_list, images):
+        shape = (rows, n0, n1) if rows > 1 else (n0, n1)
+        x_np = rng.randn(*shape).astype(np.float32)
         x = jnp.asarray(x_np)
         for k0, k1 in kernels:
             h_np = rng.randn(k0, k1).astype(np.float32)
@@ -96,12 +123,14 @@ def main():
             # = 4.7e8 out_elems*area MACs; largest safe cell 3.2e8.
             # Auto-routing never picks XLA-direct; the tuner must not
             # either above the measured safe volume.
-            if ((n0 + k0 - 1) * (n1 + k1 - 1) * k0 * k1 > 350_000_000):
+            if (rows * (n0 + k0 - 1) * (n1 + k1 - 1) * k0 * k1
+                    > 350_000_000):
                 cands.remove("direct")
             if cv2._use_pallas_direct2d(x.shape, k0, k1):
                 cands.append("pallas")
             best = (float("inf"), None)
             row = []
+            cell_times = {}
             for kind in cands:
                 try:
                     got = np.asarray(run(kind, x, h), np.float64)
@@ -119,32 +148,58 @@ def main():
                 ok = err <= ERR_GATE and np.isfinite(t)
                 row.append(f"{kind}={t * 1e3:7.3f}ms"
                            + ("" if ok else "(ERR)"))
+                if ok:
+                    cell_times[kind] = t
                 if ok and t < best[0]:
                     best = (t, kind)
             if best[1] is None:
                 # every candidate failed the gate or timed as NaN — report
                 # and exclude the cell from the crossover fit
-                print(f"img {n0:4d}x{n1:<4d} ker {k0:3d}x{k1:<3d} "
+                print(f"img {rows}x{n0:4d}x{n1:<4d} ker {k0:3d}x{k1:<3d} "
                       f"(area {k0 * k1:5d}): " + "  ".join(row)
                       + "  -> NO VALID CANDIDATE", flush=True)
                 continue
-            results[(n0 * n1, k0 * k1)] = best[1]
+            results[(rows, n0 * n1, k0 * k1)] = best[1]
             cur = cv2.select_algorithm2d(k0, k1, x.shape)
             mark = "" if best[1] in (cur, "pallas") else "  << heuristic "\
                 f"picks {cur}"
-            print(f"img {n0:4d}x{n1:<4d} ker {k0:3d}x{k1:<3d} "
+            print(f"img {rows}x{n0:4d}x{n1:<4d} ker {k0:3d}x{k1:<3d} "
                   f"(area {k0 * k1:5d}): " + "  ".join(row)
                   + f"  -> {best[1]}{mark}", flush=True)
+            # sweep winner -> tune-cache entry (only the auto routes:
+            # 'pallas' is the family's 'direct', fft is fft; an
+            # XLA-direct win never emits — auto must not route there)
+            route_of = {"pallas": "direct", "fft": "fft"}
+            if cache is not None and best[1] in route_of:
+                timings_us = {route_of[kind]: t * 1e6
+                              for kind, t in cell_times.items()
+                              if kind in route_of}
+                # key format must match dispatch's tune class
+                # (convolve2d._run2d_xla): rows/image dims pow2-
+                # bucketed, kernel dims exact
+                key = cache.store(
+                    "convolve2d",
+                    {"rows": routing.pow2_bucket(rows),
+                     "n0": routing.pow2_bucket(n0),
+                     "n1": routing.pow2_bucket(n1),
+                     "k0": k0, "k1": k1},
+                    route_of[best[1]], timings_us=timings_us,
+                    source="sweep")
+                print(f"    cache entry {key} = "
+                      f"{route_of[best[1]]}", flush=True)
 
+    if cache is not None:
+        print(f"\ntune cache {args.cache}: "
+              f"{len(cache.entries())} entries")
     # recommend the kernel-area crossover separating direct/pallas vs fft
     if not results:
         print("\nno valid cells; nothing to recommend")
         return
-    areas = sorted({a for (_, a) in results})
+    areas = sorted({a for (_, _, a) in results})
     best_cut, best_miss = None, 1 << 30
     for cut in areas + [areas[-1] + 1]:
         miss = sum(
-            1 for (_, a), win in results.items()
+            1 for (_, _, a), win in results.items()
             if (a >= cut) != (win == "fft"))
         if miss < best_miss:
             best_miss, best_cut = miss, cut

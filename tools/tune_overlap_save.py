@@ -10,7 +10,19 @@ against a float64 oracle, and prints the winning step per (k, precision)
 — the data behind ``ops/convolve.py``'s ``overlap_save_step`` and
 ``AUTO_*`` constants.  Rerun on new hardware generations.
 
+Since PR 7 the sweep also emits TUNE-CACHE ENTRIES (the same
+version-stamped format the online autotuner persists,
+``runtime/routing.py``): per filter length it times the engine's two
+``convolve.os`` candidates — the fused Pallas kernel when its gate
+admits the length, and the XLA block matmul at the engine's step —
+and stores the accuracy-gated winner under the engine's geometry key
+with ``source="sweep"``.  A hand sweep and the online tuner build one
+artifact; point ``--cache`` at the same file ``tools/autotune_pack.py``
+writes (default: ``$VELES_SIMD_AUTOTUNE_CACHE`` when set, else no
+emission).
+
 Run:  python tools/tune_overlap_save.py [--quick] [--n 1048576]
+          [--cache autotune_pack.json]
       VELES_SIMD_PLATFORM=cpu ... works but only validates plumbing —
       step size is an MXU tiling decision, so tune on the real chip.
 """
@@ -34,6 +46,11 @@ def main():
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--quick", action="store_true")
     parser.add_argument("--n", type=int, default=1 << 20)
+    parser.add_argument(
+        "--cache",
+        default=os.environ.get("VELES_SIMD_AUTOTUNE_CACHE") or None,
+        help="tune-cache file to emit route winners into (default: "
+             "$VELES_SIMD_AUTOTUNE_CACHE; omit to print tables only)")
     args = parser.parse_args()
     maybe_override_platform()
     quick = args.quick
@@ -43,7 +60,10 @@ def main():
     import jax.numpy as jnp
 
     from veles.simd_tpu.ops import convolve as cv
+    from veles.simd_tpu.runtime import routing
     from veles.simd_tpu.utils.benchmark import device_time_chained
+
+    cache = routing.TuneCache(args.cache) if args.cache else None
 
     rng = np.random.RandomState(0)
     x_np = rng.randn(n).astype(np.float32)
@@ -82,7 +102,61 @@ def main():
             cur = cv.overlap_save_step(k)
             print(f"  -> k={k} {prec}: best step {best[1]} "
                   f"(overlap_save_step gives {cur})", flush=True)
+
+        # route-level sweep -> tune-cache entry: time the engine's
+        # convolve.os candidates at the engine's own step and store
+        # the accuracy-gated winner in the shared autotune format
+        if cache is None:
+            continue
+        step = cv.overlap_save_step(k)
+        timings_us = {}
+
+        def probe(run, want=want, scale=scale):
+            got = np.asarray(run(x), np.float64)
+            if float(np.max(np.abs(got - want)) / scale) > ERR_GATE:
+                return None
+
+            def stp(v):
+                return v + 1e-30 * run(v)[..., :n]
+
+            t = device_time_chained(stp, x, iters=64, repeats=2)
+            # device_time_chained returns NaN for unresolvable
+            # measurements; NaN must never become a winner (every
+            # min() comparison against it is False) nor a JSON token
+            return t * 1e6 if np.isfinite(t) else None
+
+        timings_us["xla_matmul"] = probe(
+            lambda v: cv._conv_os_matmul(v, h, step,
+                                         precision="highest"))
+        if cv._use_pallas_os(k):
+            try:
+                timings_us["pallas_fused"] = probe(
+                    lambda v: cv._conv_os_pallas(v, h,
+                                                 precision="highest"))
+            except Exception as e:  # noqa: BLE001 — sweep explores
+                print(f"  pallas_fused probe failed: "
+                      f"{str(e)[:60]}", flush=True)
+                timings_us["pallas_fused"] = None
+        measured = {r: t for r, t in timings_us.items()
+                    if t is not None}
+        if measured:
+            winner = min(measured, key=measured.get)
+            # keys match dispatch exactly: rows=1 (the sweep times
+            # single signals — batched classes need an online probe),
+            # x_length pow2-bucketed, and precision="highest" since
+            # the probes above pin it — a conv_precision='high'
+            # service never consults a 'highest'-measured winner
+            key = cache.store(
+                "convolve.os",
+                {"rows": 1, "x_length": routing.pow2_bucket(n),
+                 "h_length": k, "step": step,
+                 "precision": "highest"},
+                winner, timings_us=timings_us, source="sweep")
+            print(f"  -> cache entry {key} = {winner}", flush=True)
     print("winners:", winners)
+    if cache is not None:
+        print(f"tune cache {args.cache}: "
+              f"{len(cache.entries())} entries")
 
 
 if __name__ == "__main__":

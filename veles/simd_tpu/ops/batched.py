@@ -317,7 +317,9 @@ def batched_stft(x, frame_length: int, hop: int, window=None,
 
     Same numerics/route family as
     :func:`~veles.simd_tpu.ops.spectral.stft`: the route comes from
-    ``spectral._select_stft_route`` and the ``rdft_matmul`` /
+    ``spectral._stft_route_for`` (the same engine selection ``stft``
+    uses, so a tune-cache winner steers both entry points — this path
+    consults the pack but never probes) and the ``rdft_matmul`` /
     ``xla_fft`` routes compile through the handle LRU keyed ``(rows,
     n, frame_length, hop, route)`` — the DFT basis and the window are
     runtime operands, so switching windows does NOT recompile, only a
@@ -338,7 +340,7 @@ def batched_stft(x, frame_length: int, hop: int, window=None,
                           window).astype(np.complex64)
     rows = int(np.prod(batch_shape))
     frames = sp.frame_count(n, frame_length, hop)
-    route = sp._select_stft_route(frame_length, hop, frames)
+    route = sp._stft_route_for(frame_length, hop, frames, rows)
     if route == "pallas_fused":
         return sp.stft(x, frame_length, hop, window=window, simd=True)
     bins = frame_length // 2 + 1

@@ -55,7 +55,7 @@ import numpy as np
 
 from veles.simd_tpu import obs
 from veles.simd_tpu.ops import pallas_kernels as _pk
-from veles.simd_tpu.runtime import faults
+from veles.simd_tpu.runtime import faults, routing
 from veles.simd_tpu.utils.config import get_config, resolve_simd
 from veles.simd_tpu.utils.memory import (
     next_highest_power_of_2, zeropadding_length)
@@ -161,39 +161,70 @@ def _fft_length(x_length: int, h_length: int) -> int:
     return next_highest_power_of_2(x_length + h_length - 1)
 
 
+# Algorithm-level candidate table (the unified routing engine,
+# runtime/routing.py): the TPU re-derivation of the reference
+# heuristic src/convolve.c:328-364, as priority-ordered predicates.
+# Note x >= 8h implies h < x//2, the overlap-save handle contract
+# (integer division, src/convolve.c:105), so the selected algorithm's
+# initializer always accepts the lengths.
+_ALGO_FAMILY = routing.family("convolve", (
+    routing.Route(
+        "brute_force",
+        predicate=lambda x_length, h_length, **_:
+            x_length * h_length < AUTO_FFT_MIN_PRODUCT,
+        doc="latency floor: every algorithm costs the same ~10us "
+            "dispatch"),
+    routing.Route(
+        "overlap_save",
+        predicate=lambda x_length, h_length, **_:
+            x_length >= AUTO_OVERLAP_SAVE_MIN_RATIO * h_length,
+        doc="long signal, comparatively short filter: halo amortized"),
+    routing.Route(
+        "fft",
+        doc="large balanced problems above the latency floor"),
+))
+
+
 def select_algorithm(x_length: int, h_length: int) -> ConvolutionAlgorithm:
     """TPU re-derivation of the reference heuristic
-    (``src/convolve.c:328-364``).
-
-    Shape matches the reference: long signal with comparatively short filter
-    → overlap-save; large balanced problem → FFT; otherwise direct (MXU).
-    """
-    x_length, h_length = int(x_length), int(h_length)
-    if x_length * h_length < AUTO_FFT_MIN_PRODUCT:
-        return ConvolutionAlgorithm.BRUTE_FORCE  # latency floor: all tie
-    # x >= 8h implies h < x//2, the overlap-save handle contract (integer
-    # division, src/convolve.c:105), so the selected algorithm's
-    # initializer always accepts the lengths
-    if x_length >= AUTO_OVERLAP_SAVE_MIN_RATIO * h_length:
-        return ConvolutionAlgorithm.OVERLAP_SAVE
-    return ConvolutionAlgorithm.FFT
+    (``src/convolve.c:328-364``), served from the ``convolve``
+    candidate table: long signal with comparatively short filter →
+    overlap-save; large balanced problem → FFT; otherwise direct
+    (MXU)."""
+    return ConvolutionAlgorithm(_ALGO_FAMILY.static_select(
+        x_length=int(x_length), h_length=int(h_length)))
 
 
 # --------------------------------------------------------------------------
 # jitted XLA kernels (cached by (shapes, static lengths))
 # --------------------------------------------------------------------------
 
+# Direct-form candidate table: the Pallas shifted-MAC kernel measured
+# 5.6-9.3x over the XLA conv lowering on v5e for batched signals with
+# <=256-tap filters; single-signal calls, long filters, and rows too
+# long for a 1-row VMEM tile stay on the XLA/MXU path.
+_DIRECT_FAMILY = routing.family("convolve.direct", (
+    routing.Route(
+        "direct_pallas",
+        predicate=lambda rows, n, k, **_: (
+            k <= _pk.PALLAS_DIRECT_MAX_H
+            and _pk.should_route(rows, (n + 2 * (k - 1))
+                                 + (n + k - 1))),
+        doc="VPU shifted-MAC Pallas kernel (batched, short filters)"),
+    routing.Route("direct_mxu",
+                  doc="lax.conv_general_dilated im2col on the MXU"),
+))
+
+
 def _use_pallas_direct(x_shape, k: int) -> bool:
     """Route batched direct convolution through the Pallas shifted-MAC
-    kernel (:mod:`ops.pallas_kernels`): measured 5.6-9.3x over the XLA
-    conv lowering on v5e for batched signals with <=256-tap filters.
-    Single-signal calls, long filters, and rows too long for a 1-row
-    VMEM tile stay on the XLA/MXU path.
-    Tests monkeypatch this gate to exercise the kernel on CPU."""
+    kernel — thin delegate into the ``convolve.direct`` candidate
+    table (runtime/routing.py), where the tap bound and VMEM-tile gate
+    live.  Tests monkeypatch this gate to exercise the kernel on
+    CPU."""
     rows = int(np.prod(x_shape[:-1])) if len(x_shape) > 1 else 1
-    n = x_shape[-1]
-    row_elems = (n + 2 * (k - 1)) + (n + k - 1)   # x_ext + output
-    return k <= _pk.PALLAS_DIRECT_MAX_H and _pk.should_route(rows, row_elems)
+    return _DIRECT_FAMILY.gate("direct_pallas", rows=rows,
+                               n=int(x_shape[-1]), k=int(k))
 
 
 @functools.partial(obs.instrumented_jit, op="convolve",
@@ -210,14 +241,38 @@ def _conv_direct_pallas(x, h, reverse=False):
     return y
 
 
+def _direct_runners(x, h, reverse):
+    """Route name -> zero-arg core call, the ONE home of the
+    direct-form candidate call expressions: dispatch runs
+    ``runners[chosen]()`` and the measured autotuner probes the same
+    thunks, so a probe can never measure a different computation than
+    dispatch executes."""
+    return {
+        "direct_pallas":
+            lambda: _conv_direct_pallas(x, h, reverse=reverse),
+        "direct_mxu": lambda: _conv_direct(x, h, reverse=reverse),
+    }
+
+
 def _direct(x, h, reverse=False):
     """Direct-form dispatch: Pallas shifted-MAC when the gate admits the
     shape, XLA/MXU conv otherwise (single home for the routing — used by
     ``convolve_simd``, the BRUTE_FORCE handle path, and
-    ``correlate.cross_correlate_simd``)."""
-    if _use_pallas_direct(x.shape, h.shape[-1]):
-        return _conv_direct_pallas(x, h, reverse=reverse)
-    return _conv_direct(x, h, reverse=reverse)
+    ``correlate.cross_correlate_simd``).  Under
+    ``VELES_SIMD_AUTOTUNE=on`` the engine probes both candidates once
+    per geometry class and the measured winner persists."""
+    n, k = int(x.shape[-1]), int(h.shape[-1])
+    rows = int(np.prod(x.shape[:-1])) if x.ndim > 1 else 1
+    eligible = (["direct_pallas", "direct_mxu"]
+                if _use_pallas_direct(x.shape, h.shape[-1])
+                else ["direct_mxu"])
+    runners = _direct_runners(x, h, reverse)
+    # rows/n are pow2-bucketed so a length-churning service shares a
+    # finite set of tune classes; k (the filter design) keys exactly
+    chosen = _DIRECT_FAMILY.select(
+        eligible=eligible, runners=runners, probe_operand=x,
+        rows=routing.pow2_bucket(rows), n=routing.pow2_bucket(n), k=k)
+    return runners[chosen]()
 
 
 @functools.partial(obs.instrumented_jit, op="convolve",
@@ -274,20 +329,45 @@ faults.register_rejection_cache(
     _PALLAS_OS_MAXSIZE)
 
 
+# Overlap-save candidate table: the fused Pallas kernel vs the XLA
+# block-matmul.  The XLA formulation materializes its frames operand
+# as J ~ 1 + h/step shifted copies of the signal through HBM, while
+# the fused kernel streams each x block through VMEM once with the
+# h-1 halo carried between grid steps; long filters only (short ones
+# are barely duplicated and already compute-bound on the XLA path).
+# The rejection cache + injection site ride the table so the
+# demote-and-remember policy and the fault harness share one source
+# of truth with the selector.
+_OS_FAMILY = routing.family("convolve.os", (
+    routing.Route(
+        "pallas_fused",
+        predicate=lambda h_length, **_: (
+            _pk.pallas_available() and _pk.pallas_os_allowed()
+            and h_length >= _pk.PALLAS_OS_MIN_H
+            and _pk.fits_vmem_os(h_length)),
+        fault_site="convolve.os_pallas",
+        rejection_cache=lambda: _PALLAS_OS_REJECTED,
+        rejection_key=lambda h_length, **_: h_length,
+        roofline={"kind": "conv"},
+        doc="fused Pallas overlap-save: x streamed through VMEM once, "
+            "h-1 halo carried between grid steps "
+            "(VELES_SIMD_DISABLE_PALLAS_OS opts out)"),
+    routing.Route(
+        "xla_matmul",
+        roofline={"kind": "conv"},
+        doc="MXU block matmul over gather-free shifted frames"),
+))
+
+
 def _use_pallas_os(h_length: int) -> bool:
     """Route the overlap-save block matmul through the fused Pallas
     kernel (:func:`~veles.simd_tpu.ops.pallas_kernels.\
-overlap_save_pallas`): the XLA formulation materializes its frames
-    operand as J ~ 1 + h/step shifted copies of the signal through HBM,
-    while the fused kernel streams each x block through VMEM once with
-    the h-1 halo carried between grid steps.  Long filters only (short
-    ones are barely duplicated and already compute-bound on the XLA
-    path), resident factors within the VMEM budget, opt-out via
-    ``VELES_SIMD_DISABLE_PALLAS_OS``.  Tests monkeypatch this gate to
-    exercise the kernel on CPU."""
-    return (_pk.pallas_available() and _pk.pallas_os_allowed()
-            and h_length >= _pk.PALLAS_OS_MIN_H
-            and _pk.fits_vmem_os(h_length))
+overlap_save_pallas`) — thin delegate into the ``convolve.os``
+    candidate table (runtime/routing.py), where the filter-length and
+    VMEM-residency gates and the ``VELES_SIMD_DISABLE_PALLAS_OS``
+    opt-out live.  Tests monkeypatch this gate to exercise the kernel
+    on CPU."""
+    return _OS_FAMILY.gate("pallas_fused", h_length=int(h_length))
 
 
 @functools.partial(obs.instrumented_jit, op="convolve",
@@ -566,24 +646,54 @@ def _run_xla(handle: ConvolutionHandle, x, h):
     if handle.algorithm is ConvolutionAlgorithm.FFT:
         return _conv_fft(x, h, handle.fft_length, reverse=handle.reverse)
     if handle.os_matmul:
+        # the ONE home of the overlap-save candidate call expressions:
+        # dispatch and the autotune probes run the same thunks (the
+        # bare instrumented cores — no spans or decision events,
+        # forcing routes uniformly as the engine contract asks), so a
+        # probe can never measure a different computation than
+        # dispatch executes
+        runners = {
+            "pallas_fused": lambda: _conv_os_pallas(
+                x, h, reverse=handle.reverse,
+                precision=os_precision()),
+            "xla_matmul": lambda: _conv_os_matmul(
+                x, h, handle.step, reverse=handle.reverse,
+                precision=os_precision()),
+        }
+
         def _os_matmul():
             obs.record_decision(
                 "convolve_os_route", "xla_matmul",
                 x_length=handle.x_length, h_length=handle.h_length,
                 step=handle.step)
             with obs.span("convolve.os_route", route="xla_matmul"):
-                return _conv_os_matmul(x, h, handle.step,
-                                       reverse=handle.reverse,
-                                       precision=os_precision())
+                return runners["xla_matmul"]()
 
-        if ((_use_pallas_os(handle.h_length)
-                or faults.armed("convolve.os_pallas"))
-                and handle.h_length not in _PALLAS_OS_REJECTED):
+        pallas_ok = ((_use_pallas_os(handle.h_length)
+                      or faults.armed("convolve.os_pallas"))
+                     and handle.h_length not in _PALLAS_OS_REJECTED)
+        eligible = (["pallas_fused", "xla_matmul"] if pallas_ok
+                    else ["xla_matmul"])
+        # rows/x_length are pow2-bucketed (finite tune classes under
+        # batch/length churn; rows matters — the pallas-vs-matmul
+        # crossover shifts with batch: per-row VMEM halo vs
+        # rows-scaled HBM frame duplication); h_length/step — the
+        # gate dimensions and the rejection-cache key — stay exact.
+        # precision keys the class too: both runners read
+        # Config.conv_precision, and a winner measured at 'highest'
+        # (multi-pass matmul) must not steer 'high' dispatches.
+        os_rows = int(np.prod(x.shape[:-1])) if x.ndim > 1 else 1
+        chosen = _OS_FAMILY.select(
+            eligible=eligible, runners=runners, probe_operand=x,
+            rows=routing.pow2_bucket(os_rows),
+            x_length=routing.pow2_bucket(handle.x_length),
+            h_length=handle.h_length, step=handle.step,
+            precision=os_precision())
+        if chosen == "pallas_fused":
             def _os_pallas():
                 with obs.span("convolve.os_route",
                               route="pallas_fused"):
-                    out = _conv_os_pallas(x, h, reverse=handle.reverse,
-                                          precision=os_precision())
+                    out = runners["pallas_fused"]()
                 # recorded AFTER the attempt resolves, so a demotion
                 # never misattributes the executed route
                 obs.record_decision(

@@ -33,7 +33,7 @@ import numpy as np
 
 from veles.simd_tpu import obs
 from veles.simd_tpu.ops import pallas_kernels as _pk
-from veles.simd_tpu.runtime import faults
+from veles.simd_tpu.runtime import faults, routing
 from veles.simd_tpu.utils.config import resolve_simd
 from veles.simd_tpu.utils.memory import next_highest_power_of_2
 
@@ -82,50 +82,83 @@ __all__ = ["convolve2d", "convolve2d_na",
 # measured at 4.7e8 and 1.4e9).
 
 
+def _direct2d_gate(k0, k1, rows=1, n0=None, n1=None, **_):
+    """The 'direct' (Pallas shifted-MAC) geometry gate — the single
+    home of the 2D route constants.  Without image dims the decision
+    falls back to the kernel-area bound alone (the handle-free
+    :func:`select_algorithm2d` form); ``rows`` rides along only to key
+    the rejection cache."""
+    if not (_pk.pallas_available() and _pk.pallas2d_compiled_allowed()
+            and k0 * k1 <= _pk.PALLAS_2D_MAX_KERNEL_AREA):
+        return False
+    if n0 is None:
+        return True
+    n0e, n1e = n0 + 2 * (k0 - 1), n1 + 2 * (k1 - 1)
+    out_elems = (n0 + k0 - 1) * (n1 + k1 - 1)
+    return _pk.fits_vmem2d(n0e * n1e, out_elems, k0 * k1)
+
+
+# The 2D candidate table (runtime/routing.py): 'direct' is selected
+# exactly when the Pallas route will take it — measured winner on its
+# whole gated domain (7-56x over fft, round-5 sweep above) — else
+# 'fft'; XLA's im2col conv never won a tuner cell and can crash the
+# worker at large kernels, so only an explicit algorithm="direct"
+# request reaches it.  The rejection cache + injection site ride the
+# table (the demote-and-remember policy's remember half).
+_CONV2D_FAMILY = routing.family("convolve2d", (
+    routing.Route(
+        "direct",
+        predicate=_direct2d_gate,
+        fault_site="convolve2d.direct_pallas",
+        rejection_cache=lambda: _PALLAS2D_OOM_REJECTED,
+        rejection_key=lambda rows, n0, n1, k0, k1, **_:
+            (rows, n0, n1, k0, k1),
+        doc="2D Pallas shifted-MAC kernel "
+            "(VELES_SIMD_DISABLE_PALLAS2D opts out)"),
+    routing.Route(
+        "fft",
+        doc="batched rfft2 . multiply . irfft2 — the measured winner "
+            "everywhere the Pallas gate refuses"),
+))
+
+
 def select_algorithm2d(k0: int, k1: int, x_shape=None) -> str:
     """'direct' when the Pallas 2D kernel will take the shape (measured
     winner on its whole gated domain), else 'fft' (measured winner
     everywhere else — XLA's im2col conv never won a tuner cell and can
-    crash the TPU worker at large kernels; table above).
+    crash the TPU worker at large kernels; table above).  Both forms
+    answer from the ``convolve2d`` candidate table
+    (runtime/routing.py).
 
     ``x_shape`` (optional) enables the exact VMEM-gate check; without
     it the decision falls back to the kernel-area bound alone.
     """
     if x_shape is not None:
         return "direct" if _use_pallas_direct2d(x_shape, k0, k1) else "fft"
-    return ("direct" if (_pk.pallas_available()
-                         and _pk.pallas2d_compiled_allowed()
-                         and k0 * k1 <= _pk.PALLAS_2D_MAX_KERNEL_AREA)
+    return ("direct" if _CONV2D_FAMILY.gate("direct", k0=int(k0),
+                                            k1=int(k1))
             else "fft")
 
 
 def _use_pallas_direct2d(x_shape, k0: int, k1: int) -> bool:
-    """Route the direct form through the 2D Pallas shifted-MAC kernel:
-    small-area kernels on TPU, image + output within the VMEM tile
-    budget.  No minimum batch (one image fills the VPU tile).  Tests
-    monkeypatch this gate to exercise the kernel on CPU.
+    """Route the direct form through the 2D Pallas shifted-MAC kernel —
+    thin delegate into the ``convolve2d`` candidate table: rejection
+    memory outranks everything (a demoted shape's second call skips
+    the doomed route without re-raising), an armed fault plan opens
+    the gate so the full demote path runs on CPU CI, then the kernel
+    gates (small-area kernels on TPU, image + output within the VMEM
+    tile budget; no minimum batch).  Tests monkeypatch this gate to
+    exercise the kernel on CPU.
 
     Default-ON since round 5: the compiled kernel passed its full
     hardware bisect (``tools/repro_pallas2d.py``, ledger in repo-root
     ``repro_pallas2d.json``) and measured 7-56x over the FFT route on
     this gated domain (table at :func:`select_algorithm2d`);
     ``VELES_SIMD_DISABLE_PALLAS2D=1`` is the opt-out."""
-    n0, n1 = x_shape[-2:]
-    n0e, n1e = n0 + 2 * (k0 - 1), n1 + 2 * (k1 - 1)
-    out_elems = (n0 + k0 - 1) * (n1 + k1 - 1)
-    # the rejection memory outranks everything — including an armed
-    # fault plan, so a demoted shape's second call skips the doomed
-    # route without re-raising (the remember half of the policy)
-    if _oom_key(x_shape, k0, k1) in _PALLAS2D_OOM_REJECTED:
-        return False
-    if faults.armed("convolve2d.direct_pallas"):
-        # a planned injection at this site opens the gate so the full
-        # demote path runs on CPU CI (runtime/faults.py harness)
-        return True
-    return (_pk.pallas_available()
-            and _pk.pallas2d_compiled_allowed()
-            and k0 * k1 <= _pk.PALLAS_2D_MAX_KERNEL_AREA
-            and _pk.fits_vmem2d(n0e * n1e, out_elems, k0 * k1))
+    rows = int(np.prod(x_shape[:-2])) if len(x_shape) > 2 else 1
+    return _CONV2D_FAMILY.route_allowed(
+        "direct", rows=rows, n0=int(x_shape[-2]),
+        n1=int(x_shape[-1]), k0=int(k0), k1=int(k1))
 
 
 @functools.partial(obs.instrumented_jit, op="convolve2d",
@@ -261,11 +294,29 @@ def _run2d_oracle(x, h, reverse):
     return convolve2d_na(x, h)
 
 
+def _conv2d_runners(x, h, k0, k1, reverse):
+    """Route name -> zero-arg core call, the ONE home of the 2-D
+    candidate call expressions: dispatch, the demotion fallback, and
+    the measured autotuner's probes all run these same thunks, so a
+    probe can never measure a different computation than dispatch
+    executes.  ``direct`` is the Pallas kernel (what the ``direct``
+    table entry gates on TPU); ``direct_mxu`` is the XLA conv the
+    kernel demotes to when the caller asked for direct explicitly."""
+    m0 = next_highest_power_of_2(x.shape[-2] + k0 - 1)
+    m1 = next_highest_power_of_2(x.shape[-1] + k1 - 1)
+    return {
+        "direct": lambda: _conv2d_direct_pallas(x, h, reverse=reverse),
+        "direct_mxu": lambda: _conv2d_direct(x, h, reverse=reverse),
+        "fft": lambda: _conv2d_fft(x, h, m0, m1, reverse=reverse),
+    }
+
+
 def _run2d_xla(x, h, reverse, algorithm, auto):
     """XLA side of :func:`_run2d` (factored out so the dispatch span
     covers route selection, demotion, and the executable call)."""
     k0, k1 = np.shape(h)[-2:]
     x, h = jnp.asarray(x), jnp.asarray(h)
+    runners = _conv2d_runners(x, h, k0, k1, reverse)
     if algorithm == "direct":
         use_pallas = _use_pallas_direct2d(x.shape, k0, k1)
         if use_pallas and isinstance(x, jax.core.Tracer):
@@ -300,23 +351,53 @@ def _run2d_xla(x, h, reverse, algorithm, auto):
                     auto=bool(auto))
                 if auto:
                     algorithm = "fft"
+        if (use_pallas and auto
+                and not isinstance(x, jax.core.Tracer)
+                and routing.autotune_mode() != "off"):
+            # measured autotune (engine): probe the Pallas kernel vs
+            # the batched-fft route once per geometry class.  geom
+            # carries the EXACT image dims (a probe vmem-OOM must
+            # feed the rejection cache under _oom_key's demote key);
+            # the tune CLASS pow2-buckets rows/n0/n1 so a service
+            # with churning image shapes shares a finite set of
+            # classes instead of probing — and rewriting the pack —
+            # per distinct crop (kernel dims stay exact: the gates
+            # compare them exactly)
+            rows = int(np.prod(x.shape[:-2])) if x.ndim > 2 else 1
+            chosen = _CONV2D_FAMILY.select(
+                eligible=["direct", "fft"], runners=runners,
+                probe_operand=x,
+                tune_geom={
+                    "rows": routing.pow2_bucket(rows),
+                    "n0": routing.pow2_bucket(int(x.shape[-2])),
+                    "n1": routing.pow2_bucket(int(x.shape[-1])),
+                    "k0": int(k0), "k1": int(k1)},
+                rows=rows, n0=int(x.shape[-2]), n1=int(x.shape[-1]),
+                k0=int(k0), k1=int(k1))
+            if chosen == "fft":
+                # the flip away from select_algorithm2d's static
+                # choice must be attributable from the artifact (the
+                # dispatch span above still says algo='direct') —
+                # same discipline as the traced-model demotion below
+                obs.record_decision(
+                    "convolve2d", "autotune_fft", rows=rows,
+                    n0=int(x.shape[-2]), n1=int(x.shape[-1]),
+                    k0=int(k0), k1=int(k1),
+                    mode=routing.autotune_mode())
+                algorithm, use_pallas = "fft", False
         if use_pallas:
             def _demoted():
                 # re-route as the gate would have: auto falls to the
                 # measured-winner fft, an explicit "direct" request
                 # stays direct (the XLA conv the caller asked for)
-                if auto:
-                    m0 = next_highest_power_of_2(x.shape[-2] + k0 - 1)
-                    m1 = next_highest_power_of_2(x.shape[-1] + k1 - 1)
-                    return _conv2d_fft(x, h, m0, m1, reverse=reverse)
-                return _conv2d_direct(x, h, reverse=reverse)
+                return runners["fft" if auto else "direct_mxu"]()
 
             # Mosaic scoped-vmem OOM only — the shared engine
             # remembers the shape class and falls back; any other
             # error propagates (runtime/faults.py)
             return faults.demote_and_remember(
                 "convolve2d.direct_pallas",
-                lambda: _conv2d_direct_pallas(x, h, reverse=reverse),
+                runners["direct"],
                 _demoted,
                 cache=_PALLAS2D_OOM_REJECTED,
                 key=_oom_key(x.shape, k0, k1),
@@ -324,10 +405,8 @@ def _run2d_xla(x, h, reverse, algorithm, auto):
                 fallback_route="fft" if auto else "direct_mxu",
                 counter="pallas2d_demotion")
         if algorithm == "direct":
-            return _conv2d_direct(x, h, reverse=reverse)
-    m0 = next_highest_power_of_2(x.shape[-2] + k0 - 1)
-    m1 = next_highest_power_of_2(x.shape[-1] + k1 - 1)
-    return _conv2d_fft(x, h, m0, m1, reverse=reverse)
+            return runners["direct_mxu"]()
+    return runners["fft"]()
 
 
 _BOUNDARY_PAD = {"fill": "constant", "wrap": "wrap", "symm": "symmetric"}

@@ -49,7 +49,6 @@ from __future__ import annotations
 
 import collections
 import functools
-import os
 import threading
 
 import jax
@@ -58,7 +57,7 @@ import numpy as np
 
 from veles.simd_tpu import obs
 from veles.simd_tpu.ops import pallas_kernels as _pk
-from veles.simd_tpu.runtime import faults
+from veles.simd_tpu.runtime import faults, routing
 from veles.simd_tpu.utils.config import resolve_simd
 # complex host<->device moves MUST go through to_device/to_host: the
 # axon relay cannot transfer complex buffers in either direction and one
@@ -100,9 +99,12 @@ def dft_matmul_allowed() -> bool:
     """May implicit routing use the matmul-DFT routes (stft/istft
     ``rdft_matmul``, hilbert/cwt ``matmul_dft``)?  True unless
     explicitly disabled — the family-wide escape hatch mirroring
-    ``VELES_SIMD_DISABLE_PALLAS_OS`` for the fused conv kernel."""
-    return os.environ.get(_DFT_MATMUL_ENV, "0").strip().lower() not in (
-        "1", "true", "yes", "on")
+    ``VELES_SIMD_DISABLE_PALLAS_OS`` for the fused conv kernel.
+
+    The gate itself lives on the route tables' ``disable_env``; this
+    public query delegates to the same engine check so the two can
+    never drift."""
+    return not routing.env_truthy(_DFT_MATMUL_ENV)
 
 
 # Host-side constants used to be rebuilt per call (the analytic
@@ -392,56 +394,134 @@ faults.register_rejection_cache(
     _STFT_PALLAS_MAXSIZE)
 
 
+# The spectral candidate-route tables (the unified engine,
+# runtime/routing.py): priority order IS the static selection order,
+# predicates are the single home of the route constants, the fused
+# kernel's rejection cache + injection site ride the table so the
+# demote-and-remember policy and the fault harness see one source of
+# truth, and the measured autotuner (VELES_SIMD_AUTOTUNE=on) probes
+# exactly these candidates.
+_STFT_FAMILY = routing.family("stft", (
+    routing.Route(
+        "pallas_fused",
+        predicate=lambda frame_length, hop, frames=0, **_: (
+            _pk.pallas_available() and _pk.stft_pallas_allowed()
+            and frame_length % hop == 0 and hop % 128 == 0
+            and frame_length // hop >= 2
+            and frames >= _pk.PALLAS_STFT_MIN_FRAMES
+            and _pk.fits_vmem_stft(frame_length, hop)),
+        fault_site="spectral.stft_pallas",
+        rejection_cache=lambda: _STFT_PALLAS_REJECTED,
+        rejection_key=lambda frame_length, hop, **_: (frame_length,
+                                                      hop),
+        roofline={"kind": "stft"},
+        doc="fused framing+window+DFT Mosaic kernel; x streamed "
+            "through VMEM once, overlap carried between grid steps"),
+    routing.Route(
+        "rdft_matmul",
+        predicate=lambda frame_length, **_:
+            frame_length <= AUTO_DFT_MATMUL_MAX_FRAME,
+        disable_env=_DFT_MATMUL_ENV,
+        roofline={"kind": "stft"},
+        doc="precomputed real-DFT basis matmul on the MXU (window "
+            "folded in, basis LRU-cached per geometry)"),
+    routing.Route(
+        "xla_fft",
+        roofline={"kind": "stft"},
+        doc="XLA FFT lowering — the long-frame terminal fallback"),
+))
+
+_ISTFT_FAMILY = routing.family("istft", (
+    routing.Route(
+        "rdft_matmul",
+        predicate=lambda frame_length, **_:
+            frame_length <= AUTO_DFT_MATMUL_MAX_FRAME,
+        disable_env=_DFT_MATMUL_ENV,
+        doc="inverse-basis matmul feeding the shared overlap-add"),
+    routing.Route("xla_fft", doc="XLA irfft + overlap-add"),
+))
+
+_HILBERT_FAMILY = routing.family("hilbert", (
+    routing.Route(
+        "matmul_dft",
+        predicate=lambda n, **_: n <= HILBERT_MATMUL_MAX_N,
+        disable_env=_DFT_MATMUL_ENV,
+        doc="dense circulant analytic-signal operator as two MXU "
+            "matmuls (no complex transfers through the relay)"),
+    routing.Route("xla_fft", doc="fft -> multiplier -> ifft"),
+))
+
+_CWT_FAMILY = routing.family("morlet_cwt", (
+    routing.Route(
+        "matmul_dft",
+        predicate=lambda n, **_: n <= CWT_MATMUL_MAX_N,
+        disable_env=_DFT_MATMUL_ENV,
+        doc="positive-frequency DFT basis pair as dense MXU matmuls"),
+    routing.Route("xla_fft", doc="batched fft -> bank -> ifft"),
+))
+
+
 def _use_matmul_dft(frame_length: int) -> bool:
     """Route a spectral transform through the precomputed real-DFT
-    basis matmul: the MXU-native formulation for the frame sizes STFT
-    actually uses (XLA's TPU FFT leaves the MXU idle; arXiv:2002.03260
-    and TINA both compute the DFT as dense matmul there).  Long frames
-    stay on the FFT — past :data:`AUTO_DFT_MATMUL_MAX_FRAME` the
-    basis residency and the L^2 MAC growth lose to L log L.  Opt out
-    family-wide with ``VELES_SIMD_DISABLE_DFT_MATMUL``."""
-    return (dft_matmul_allowed()
-            and int(frame_length) <= AUTO_DFT_MATMUL_MAX_FRAME)
+    basis matmul — the MXU-native formulation for the frame sizes STFT
+    actually uses (arXiv:2002.03260, TINA).  Thin delegate into the
+    ``stft`` candidate table (runtime/routing.py), where the
+    ``AUTO_DFT_MATMUL_MAX_FRAME`` bound and the
+    ``VELES_SIMD_DISABLE_DFT_MATMUL`` opt-out live."""
+    return _STFT_FAMILY.gate("rdft_matmul",
+                             frame_length=int(frame_length))
 
 
 def _use_pallas_stft(frame_length: int, hop: int, frames: int) -> bool:
     """Route STFT through the fused Pallas kernel
-    (:func:`~veles.simd_tpu.ops.pallas_kernels.stft_pallas`): the
-    rdft-matmul route still materializes its ``[frames, frame_length]``
-    operand — ``frame_length/hop`` copies of x through HBM — while the
-    fused kernel streams x through VMEM once with the overlap carried
-    between grid steps.  Compiled Mosaic only (the interpreter would be
-    a slowdown), dividing 128-multiple hops (the kernel's block
-    contract), enough frames to amortize dispatch, resident basis
-    within the VMEM budget, opt-out via
-    ``VELES_SIMD_DISABLE_STFT_PALLAS``, and never a (frame, hop) class
-    that already OOMed Mosaic's scoped stack.  Tests monkeypatch this
-    gate to exercise the kernel on CPU."""
-    L, s = int(frame_length), int(hop)
-    # rejection memory outranks everything — including an armed fault
-    # plan, so a demoted class's next call skips the doomed route
-    # without re-raising
-    if (L, s) in _STFT_PALLAS_REJECTED:
-        return False
-    if faults.armed("spectral.stft_pallas"):
-        # a planned injection opens the gate so the selector really
-        # picks the kernel and the demote path runs on CPU CI
-        return True
-    return (_pk.pallas_available() and _pk.stft_pallas_allowed()
-            and L % s == 0 and s % 128 == 0 and L // s >= 2
-            and int(frames) >= _pk.PALLAS_STFT_MIN_FRAMES
-            and _pk.fits_vmem_stft(L, s))
+    (:func:`~veles.simd_tpu.ops.pallas_kernels.stft_pallas`).  Thin
+    delegate into the ``stft`` candidate table: rejection memory
+    outranks everything (a demoted (frame, hop) class skips the doomed
+    route without re-raising), an armed fault plan opens the gate so
+    the demote path runs on CPU CI, then the kernel's geometry gates
+    (dividing 128-multiple hop, enough frames, VMEM residency) and the
+    ``VELES_SIMD_DISABLE_STFT_PALLAS`` opt-out decide."""
+    return _STFT_FAMILY.route_allowed(
+        "pallas_fused", frame_length=int(frame_length), hop=int(hop),
+        frames=int(frames))
 
 
 def _select_stft_route(frame_length: int, hop: int, frames: int) -> str:
-    """The stft route decision, in priority order (single home — the
-    public entry point, ``batched.batched_stft``, and bench all ask
-    here)."""
-    if _use_pallas_stft(frame_length, hop, frames):
-        return "pallas_fused"
-    if _use_matmul_dft(frame_length):
-        return "rdft_matmul"
-    return "xla_fft"
+    """The STATIC stft route decision, in table priority order (single
+    home — the public entry point, ``batched.batched_stft``, and bench
+    all ask here; the autotuner treats it as the cold-start prior)."""
+    return _STFT_FAMILY.static_select(
+        frame_length=int(frame_length), hop=int(hop),
+        frames=int(frames))
+
+
+def _stft_tune_class(frame_length: int, hop: int, frames: int,
+                     rows: int) -> dict:
+    """The stft tune-cache geometry CLASS (shared by :func:`stft` and
+    ``batched.batched_stft`` so one pack entry steers both): frames
+    bucketed at the pallas gate's threshold — the only
+    frames-dependence any route has — so variable-length signals at
+    one (frame, hop) share one entry; rows pow2-bucketed because the
+    matmul-vs-fft crossover shifts with batch."""
+    return {"frame_length": int(frame_length), "hop": int(hop),
+            "rows": routing.pow2_bucket(int(rows)),
+            "frames_class": (_pk.PALLAS_STFT_MIN_FRAMES
+                             if frames >= _pk.PALLAS_STFT_MIN_FRAMES
+                             else 0)}
+
+
+def _stft_route_for(frame_length: int, hop: int, frames: int,
+                    rows: int) -> str:
+    """Engine-selected stft route WITHOUT probing: honors a tune-cache
+    winner (autotune on/readonly) over the static prior.  The batched
+    entry point asks here — it compiles its own handle, so it consults
+    the pack but never probes (the non-batched runners it would time
+    are not what it dispatches)."""
+    return _STFT_FAMILY.select(
+        eligible=_STFT_FAMILY.eligible(
+            frame_length=int(frame_length), hop=int(hop),
+            frames=int(frames)),
+        **_stft_tune_class(frame_length, hop, frames, rows))
 
 
 def _device_basis(kind, length, window, build_host):
@@ -533,8 +613,31 @@ def stft(x, frame_length: int, hop: int, window=None, simd=None,
             raise ValueError(
                 f"route must be one of {sorted(_STFT_ROUTES)}, "
                 f"got {route!r}")
-        chosen = route if forced else _select_stft_route(
-            frame_length, hop, frames)
+        if forced:
+            chosen = route
+        else:
+            # probe thunks call the route runners as FORCED routes
+            # (vmem-OOM during a probe is remembered + skipped, never
+            # silently rerouted); the engine invokes the factory only
+            # when the measured mode will really probe, and refuses
+            # under an outer trace (probe_operand check).
+            # Eligibility uses the true frame count; the tune-cache
+            # geometry CLASS (_stft_tune_class, shared with
+            # batched_stft) buckets frames and rows so shape churn
+            # shares finite entries instead of probing per length
+            rows = (int(np.prod(x_np.shape[:-1]))
+                    if len(x_np.shape) > 1 else 1)
+            chosen = _STFT_FAMILY.select(
+                eligible=_STFT_FAMILY.eligible(
+                    frame_length=int(frame_length), hop=int(hop),
+                    frames=int(frames)),
+                runners=lambda: {
+                    name: (lambda fn=fn: fn(x_np, window,
+                                            frame_length, hop,
+                                            forced=True))
+                    for name, fn in _STFT_ROUTES.items()},
+                probe_operand=x_np,
+                **_stft_tune_class(frame_length, hop, frames, rows))
         path = _framing_path(frame_length, hop)
         obs.record_decision(
             "stft_route", chosen, n=n, frame_length=int(frame_length),
@@ -688,9 +791,24 @@ def istft(spec, n: int, frame_length: int, hop: int, window=None,
             raise ValueError(
                 f"route must be one of {sorted(_ISTFT_ROUTES)}, "
                 f"got {route!r}")
-        chosen = route if forced else (
-            "rdft_matmul" if _use_matmul_dft(frame_length)
-            else "xla_fft")
+        if forced:
+            chosen = route
+        else:
+            # no istft route depends on the frame count, so the
+            # tune-cache geometry class is (frame_length, hop) plus
+            # the pow2-bucketed batch (the matmul-vs-fft crossover
+            # shifts with rows, like stft/hilbert)
+            rows = (int(np.prod(spec_np.shape[:-2]))
+                    if len(spec_np.shape) > 2 else 1)
+            chosen = _ISTFT_FAMILY.select(
+                runners=lambda: {
+                    name: (lambda fn=fn: fn(spec, window, env_inv, n,
+                                            frame_length, hop,
+                                            forced=True))
+                    for name, fn in _ISTFT_ROUTES.items()},
+                probe_operand=spec_np,
+                frame_length=int(frame_length), hop=int(hop),
+                rows=routing.pow2_bucket(rows))
         # the adjoint decomposition: framing gather <-> overlap-add
         # scatter, framing reshape <-> per-phase reshape adds
         path = ("scatter" if _framing_path(frame_length, hop) == "gather"
@@ -793,6 +911,26 @@ def _hilbert_matmul(x, basis):
     return jax.lax.complex(re, im)
 
 
+def _run_hilbert_matmul(x):
+    n = np.shape(x)[-1]
+    basis = _cached_device(
+        ("hilbert_matmul", int(n)),
+        lambda: jnp.asarray(_hilbert_basis(n)))
+    return _hilbert_matmul(jnp.asarray(x, jnp.float32), basis)
+
+
+def _run_hilbert_xla(x):
+    n = np.shape(x)[-1]
+    mult = _cached_device(
+        ("analytic_mult", int(n)),
+        lambda: jnp.asarray(_analytic_multiplier(n)))
+    return _hilbert_xla(jnp.asarray(x, jnp.float32), mult)
+
+
+_HILBERT_ROUTES = {"matmul_dft": _run_hilbert_matmul,
+                   "xla_fft": _run_hilbert_xla}
+
+
 def hilbert(x, simd=None, route=None):
     """Analytic signal ``x + i * H[x]`` (complex64 [..., n]).
 
@@ -807,26 +945,31 @@ def hilbert(x, simd=None, route=None):
         raise ValueError("empty signal")
     if resolve_simd(simd, op="hilbert"):
         forced = route is not None
-        if forced and route not in ("matmul_dft", "xla_fft"):
+        if forced and route not in _HILBERT_ROUTES:
             raise ValueError(
                 f"route must be 'matmul_dft' or 'xla_fft', got "
                 f"{route!r}")
-        chosen = route if forced else (
-            "matmul_dft" if dft_matmul_allowed()
-            and n <= HILBERT_MATMUL_MAX_N else "xla_fft")
+        if forced:
+            chosen = route
+        else:
+            rows = int(np.prod(np.shape(x)[:-1])) \
+                if np.ndim(x) > 1 else 1
+            # eligibility needs the exact n (the <= MATMUL_MAX_N
+            # predicate); the tune CLASS pow2-buckets it so a
+            # length-churning service shares finite entries instead
+            # of probing — and rewriting the pack — per distinct n
+            chosen = _HILBERT_FAMILY.select(
+                runners=lambda: {
+                    name: (lambda fn=fn: fn(x))
+                    for name, fn in _HILBERT_ROUTES.items()},
+                probe_operand=x, n=int(n),
+                rows=routing.pow2_bucket(rows),
+                tune_geom={"n": routing.pow2_bucket(int(n)),
+                           "rows": routing.pow2_bucket(rows)})
         obs.record_decision("hilbert_route", chosen, n=int(n),
                             forced=forced)
         with obs.span("hilbert.dispatch", route=chosen):
-            if chosen == "matmul_dft":
-                basis = _cached_device(
-                    ("hilbert_matmul", int(n)),
-                    lambda: jnp.asarray(_hilbert_basis(n)))
-                return _hilbert_matmul(jnp.asarray(x, jnp.float32),
-                                       basis)
-            mult = _cached_device(
-                ("analytic_mult", int(n)),
-                lambda: jnp.asarray(_analytic_multiplier(n)))
-            return _hilbert_xla(jnp.asarray(x, jnp.float32), mult)
+            return _HILBERT_ROUTES[chosen](x)
     return hilbert_na(x).astype(np.complex64)
 
 
@@ -911,6 +1054,25 @@ def _cwt_matmul(x, fwd, hat, ic, is_):
     return jax.lax.complex(out_re, out_im)
 
 
+def _run_cwt_matmul(x, hat):
+    n = np.shape(x)[-1]
+    fwd, ic, is_ = _cached_device(
+        ("cwt_matmul", int(n)),
+        lambda: tuple(jnp.asarray(a) for a in _cwt_basis(n)))
+    K = ic.shape[0]
+    hatp = np.ascontiguousarray(hat[:, 1:1 + K]).astype(np.float32)
+    return _cwt_matmul(jnp.asarray(x, jnp.float32), fwd,
+                       jnp.asarray(hatp), ic, is_)
+
+
+def _run_cwt_xla(x, hat):
+    return _cwt_xla(jnp.asarray(x, jnp.float32),
+                    to_device(hat, jnp.complex64))
+
+
+_CWT_ROUTES = {"matmul_dft": _run_cwt_matmul, "xla_fft": _run_cwt_xla}
+
+
 def morlet_cwt(x, scales, w0: float = 6.0, simd=None, route=None):
     """Continuous wavelet transform with the analytic Morlet wavelet.
 
@@ -931,28 +1093,34 @@ def morlet_cwt(x, scales, w0: float = 6.0, simd=None, route=None):
     hat = _morlet_hat(scales, n, w0)
     if resolve_simd(simd, op="morlet_cwt"):
         forced = route is not None
-        if forced and route not in ("matmul_dft", "xla_fft"):
+        if forced and route not in _CWT_ROUTES:
             raise ValueError(
                 f"route must be 'matmul_dft' or 'xla_fft', got "
                 f"{route!r}")
-        chosen = route if forced else (
-            "matmul_dft" if dft_matmul_allowed()
-            and n <= CWT_MATMUL_MAX_N else "xla_fft")
+        if forced:
+            chosen = route
+        else:
+            # the scale count keys the tune class too (pow2-bucketed:
+            # scale-churning callers share finite classes): matmul_dft
+            # is dominated by the dense [scales, bins] hat matmul
+            # while xla_fft batches over the scales axis, so the
+            # crossover moves with len(scales)
+            # exact n for the eligibility predicate; pow2-bucketed
+            # into the tune class (like hilbert) so length churn
+            # shares finite cache entries
+            chosen = _CWT_FAMILY.select(
+                runners=lambda: {
+                    name: (lambda fn=fn: fn(x, hat))
+                    for name, fn in _CWT_ROUTES.items()},
+                probe_operand=x, n=int(n),
+                scales=routing.pow2_bucket(len(scales)),
+                tune_geom={
+                    "n": routing.pow2_bucket(int(n)),
+                    "scales": routing.pow2_bucket(len(scales))})
         obs.record_decision("morlet_cwt_route", chosen, n=int(n),
                             scales=len(scales), forced=forced)
         with obs.span("morlet_cwt.dispatch", route=chosen):
-            if chosen == "matmul_dft":
-                fwd, ic, is_ = _cached_device(
-                    ("cwt_matmul", int(n)),
-                    lambda: tuple(jnp.asarray(a)
-                                  for a in _cwt_basis(n)))
-                K = ic.shape[0]
-                hatp = np.ascontiguousarray(
-                    hat[:, 1:1 + K]).astype(np.float32)
-                return _cwt_matmul(jnp.asarray(x, jnp.float32),
-                                   fwd, jnp.asarray(hatp), ic, is_)
-            return _cwt_xla(jnp.asarray(x, jnp.float32),
-                            to_device(hat, jnp.complex64))
+            return _CWT_ROUTES[chosen](x, hat)
     return morlet_cwt_na(x, scales, w0).astype(np.complex64)
 
 

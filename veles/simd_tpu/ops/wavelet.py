@@ -70,6 +70,7 @@ from veles.simd_tpu.ops import pallas_kernels as _pk
 from veles.simd_tpu.ops.wavelet_coeffs import (
     WaveletType, qmf_highpass, scaling_coefficients, supported_orders,
     validate_order)
+from veles.simd_tpu.runtime import routing
 from veles.simd_tpu.utils.config import resolve_simd
 
 __all__ = [
@@ -169,22 +170,43 @@ def _filter_bank(x, hi, lo, ext, stride, dilation, out_len):
     return out[..., 0, :], out[..., 1, :]
 
 
-def _use_pallas(src_shape, order, dilation, stride) -> bool:
-    """Route batched transforms through the hand-written Mosaic kernel.
+# The wavelet candidate table (runtime/routing.py).  The Pallas
+# shifted-MAC kernel reads each sample once where the XLA conv
+# lowering reads it ``order`` times — measured 3.6x on the BASELINE
+# config-5 workload (512x4096 daub8, 12.1 -> 43.2 GSamples/s on v5e).
+# It needs enough batch rows to fill VPU sublanes and a signal short
+# enough that one row fits the kernel's VMEM tile budget;
+# single-signal and extreme-length calls stay on the XLA conv path.
+# VELES_SIMD_DISABLE_PALLAS_WAVELET is the family's env opt-out —
+# route parity with the conv/spectral families, which had escape
+# hatches from day one.
+_WAVELET_DISABLE_ENV = "VELES_SIMD_DISABLE_PALLAS_WAVELET"
 
-    The Pallas shifted-MAC kernel (:mod:`ops.pallas_kernels`) reads each
-    sample once where the XLA conv lowering reads it ``order`` times —
-    measured 3.6x on the BASELINE config-5 workload (512x4096 daub8,
-    12.1 -> 43.2 GSamples/s on v5e).  It needs enough batch rows to fill
-    VPU sublanes and a signal short enough that one row fits the kernel's
-    VMEM tile budget; single-signal and extreme-length calls stay on the
-    XLA conv path.  Tests monkeypatch this gate to exercise the kernel
-    in interpret mode on CPU.
-    """
+_WAVELET_FAMILY = routing.family("wavelet", (
+    routing.Route(
+        "pallas",
+        predicate=lambda rows, n, order, dilation, stride, **_:
+            _pk.should_route(rows, (n + order * dilation)
+                             + 2 * (n // stride)),
+        disable_env=_WAVELET_DISABLE_ENV,
+        doc="VPU shifted-MAC Mosaic kernel (filter bank, one read "
+            "per sample)"),
+    routing.Route(
+        "xla_conv",
+        doc="2-channel strided/dilated lax.conv_general_dilated"),
+))
+
+
+def _use_pallas(src_shape, order, dilation, stride) -> bool:
+    """Route batched transforms through the hand-written Mosaic
+    kernel — thin delegate into the ``wavelet`` candidate table, where
+    the VPU row/VMEM gates and the ``VELES_SIMD_DISABLE_PALLAS_WAVELET``
+    opt-out live.  Tests monkeypatch this gate to exercise the kernel
+    in interpret mode on CPU."""
     rows = int(np.prod(src_shape[:-1])) if len(src_shape) > 1 else 1
-    n = src_shape[-1]
-    row_elems = (n + order * dilation) + 2 * (n // stride)  # x_ext + hi+lo
-    return _pk.should_route(rows, row_elems)
+    return _WAVELET_FAMILY.gate(
+        "pallas", rows=rows, n=int(src_shape[-1]), order=int(order),
+        dilation=int(dilation), stride=int(stride))
 
 
 @functools.partial(obs.instrumented_jit,
@@ -249,55 +271,108 @@ def stationary_wavelet_apply_na(type, order, level, ext, src):
 # public dispatching API
 # --------------------------------------------------------------------------
 
-def wavelet_apply(type, order, ext, src, simd=None):
+def _wavelet_runners(src, type, order, ext, stride, dilation, out_len):
+    """Route name -> zero-arg core call, the ONE home of the candidate
+    call expressions: dispatch runs ``runners[chosen]()`` and the
+    measured autotuner probes the same thunks (forced semantics), so
+    the probe can never measure a different computation than dispatch
+    executes."""
+    def run_pallas():
+        return _filter_bank_pallas(src, WaveletType(type), int(order),
+                                   ExtensionType(ext), stride,
+                                   dilation, out_len)
+
+    def run_xla():
+        hi, lo = _filters(type, order)
+        return _filter_bank(src, jnp.asarray(hi), jnp.asarray(lo),
+                            ExtensionType(ext), stride, dilation,
+                            out_len)
+
+    return {"pallas": run_pallas, "xla_conv": run_xla}
+
+
+def _select_wavelet_route(src_shape, order, dilation, stride,
+                          route=None, runners=None, src=None):
+    """Shared DWT/SWT route choice: a forced ``route`` is validated
+    and pinned (forced routes re-raise on failure — they never
+    silently degrade, mirroring ``faults.guarded``'s forced
+    semantics); otherwise the (monkeypatchable) gate builds the
+    candidate list and the engine selects — static table order, or
+    the measured/cached winner under ``VELES_SIMD_AUTOTUNE``
+    (``runners`` is the callers' :func:`_wavelet_runners` table — the
+    same thunks dispatch runs — handed to the engine for the measured
+    mode; ``src`` is the engine's traced-operand check)."""
+    forced = route is not None
+    if forced:
+        if route not in _WAVELET_FAMILY.names():
+            raise ValueError(
+                f"route must be one of "
+                f"{sorted(_WAVELET_FAMILY.names())}, got {route!r}")
+        return route, True
+    eligible = (["pallas", "xla_conv"]
+                if _use_pallas(src_shape, order, dilation, stride)
+                else ["xla_conv"])
+    rows = int(np.prod(src_shape[:-1])) if len(src_shape) > 1 else 1
+    # rows/n pow2-bucketed (finite tune classes under batch/length
+    # churn); order/dilation/stride — the filter design — key exactly
+    chosen = _WAVELET_FAMILY.select(
+        eligible=eligible, runners=runners, probe_operand=src,
+        rows=routing.pow2_bucket(rows),
+        n=routing.pow2_bucket(int(src_shape[-1])), order=int(order),
+        dilation=int(dilation), stride=int(stride))
+    return chosen, False
+
+
+def wavelet_apply(type, order, ext, src, simd=None, route=None):
     """Single DWT analysis step (``wavelet_apply``,
     ``inc/simd/wavelet.h:80-97``): returns ``(desthi, destlo)`` of length
-    ``length/2`` each."""
+    ``length/2`` each.
+
+    ``route`` forces ``pallas`` (the Mosaic filter-bank kernel) or
+    ``xla_conv`` (None auto-selects through the ``wavelet`` candidate
+    table); a forced route re-raises on failure — it never silently
+    degrades to the other implementation."""
     if not resolve_simd(simd, op="wavelet_apply"):
         return wavelet_apply_na(type, order, ext, src)
     src = jnp.asarray(src)
     _check_apply_args(type, order, src.shape[-1])
-    use_pk = _use_pallas(src.shape, int(order), 1, 2)
+    runners = _wavelet_runners(src, type, order, ext, 2, 1,
+                               src.shape[-1] // 2)
+    chosen, forced = _select_wavelet_route(
+        src.shape, int(order), 1, 2, route, runners, src)
     obs.record_decision(
-        "wavelet_apply", "pallas" if use_pk else "xla_conv",
+        "wavelet_apply", chosen,
         family=WaveletType(type).value, order=int(order),
-        ext=ExtensionType(ext).value, length=int(src.shape[-1]))
-    with obs.span("wavelet_apply.dispatch",
-                  route="pallas" if use_pk else "xla_conv"):
-        if use_pk:
-            return _filter_bank_pallas(src, WaveletType(type),
-                                       int(order), ExtensionType(ext),
-                                       2, 1, src.shape[-1] // 2)
-        hi, lo = _filters(type, order)
-        return _filter_bank(src, jnp.asarray(hi), jnp.asarray(lo),
-                            ExtensionType(ext), 2, 1,
-                            src.shape[-1] // 2)
+        ext=ExtensionType(ext).value, length=int(src.shape[-1]),
+        forced=forced)
+    with obs.span("wavelet_apply.dispatch", route=chosen):
+        return runners[chosen]()
 
 
-def stationary_wavelet_apply(type, order, level, ext, src, simd=None):
+def stationary_wavelet_apply(type, order, level, ext, src, simd=None,
+                             route=None):
     """Single SWT (à-trous) step at ``level`` ≥ 1
     (``stationary_wavelet_apply``, ``inc/simd/wavelet.h:119-139``):
-    returns ``(desthi, destlo)`` of length ``length`` each."""
+    returns ``(desthi, destlo)`` of length ``length`` each.
+
+    ``route`` forces ``pallas`` / ``xla_conv`` like
+    :func:`wavelet_apply` (forced routes re-raise, never degrade)."""
     if not resolve_simd(simd, op="stationary_wavelet_apply"):
         return stationary_wavelet_apply_na(type, order, level, ext, src)
     src = jnp.asarray(src)
     _check_apply_args(type, order, src.shape[-1])
     if level < 1:
         raise ValueError("level must be >= 1")
-    use_pk = _use_pallas(src.shape, int(order), 1 << (level - 1), 1)
+    runners = _wavelet_runners(src, type, order, ext, 1,
+                               1 << (level - 1), src.shape[-1])
+    chosen, forced = _select_wavelet_route(
+        src.shape, int(order), 1 << (level - 1), 1, route, runners, src)
     obs.record_decision(
-        "stationary_wavelet_apply", "pallas" if use_pk else "xla_conv",
+        "stationary_wavelet_apply", chosen,
         family=WaveletType(type).value, order=int(order),
         level=int(level), ext=ExtensionType(ext).value,
-        length=int(src.shape[-1]))
-    if use_pk:
-        return _filter_bank_pallas(src, WaveletType(type), int(order),
-                                   ExtensionType(ext), 1, 1 << (level - 1),
-                                   src.shape[-1])
-    hi, lo = _filters(type, order)
-    return _filter_bank(src, jnp.asarray(hi), jnp.asarray(lo),
-                        ExtensionType(ext), 1, 1 << (level - 1),
-                        src.shape[-1])
+        length=int(src.shape[-1]), forced=forced)
+    return runners[chosen]()
 
 
 # -- fused multi-level cascade --------------------------------------------
@@ -368,7 +443,7 @@ def _cascade_plan(gs, g_lo, levels):
     return tuple(plans), taps, chans
 
 
-def _use_fused_cascade(src_shape, order, ext, levels) -> bool:
+def _fused_cascade_gate(rows, n, order, ext, levels, **_):
     # DEMOTED round 5 (measured): on TPU v5e hardware the fused pass
     # LOSES to the level loop — 14,765 vs 17,384 Msamples/s (daub8 L3,
     # 512x4096, idle-host chained timing, 2026-07-31; reproduced twice).
@@ -383,10 +458,9 @@ def _use_fused_cascade(src_shape, order, ext, levels) -> bool:
                                                    "on"):
         return False
     levels = int(levels)
-    if (ExtensionType(ext) is not ExtensionType.PERIODIC
+    if (ext != ExtensionType.PERIODIC.value
             or not 2 <= levels <= _FUSED_MAX_LEVELS):
         return False
-    n = src_shape[-1]
     if n % (1 << levels):
         return False
     reach = (order - 1) * ((1 << levels) - 1)
@@ -398,9 +472,31 @@ def _use_fused_cascade(src_shape, order, ext, levels) -> bool:
     n_macs += (order - 1) * ((1 << levels) - 1) + 1
     if n_macs > _FUSED_MAX_MACS:
         return False
-    rows = int(np.prod(src_shape[:-1])) if len(src_shape) > 1 else 1
     row_elems = (n + reach + (1 << levels)) + 2 * n
     return _pk.should_route(rows, row_elems)
+
+
+# the cascade's own two-candidate table: the fused one-HBM-pass kernel
+# is OPT-IN (it measured slower — the gate note above), the level loop
+# is the terminal fallback and measured winner
+_CASCADE_FAMILY = routing.family("wavelet.cascade", (
+    routing.Route("fused_cascade", predicate=_fused_cascade_gate,
+                  doc="whole PERIODIC DWT cascade in one Pallas pass "
+                      "(opt-in: VELES_SIMD_FORCE_FUSED_CASCADE)"),
+    routing.Route("level_loop",
+                  doc="one filter-bank pass per level — the measured "
+                      "winner on v5e"),
+))
+
+
+def _use_fused_cascade(src_shape, order, ext, levels) -> bool:
+    """Thin delegate into the ``wavelet.cascade`` candidate table
+    (gate note at :func:`_fused_cascade_gate`)."""
+    rows = int(np.prod(src_shape[:-1])) if len(src_shape) > 1 else 1
+    return _CASCADE_FAMILY.gate(
+        "fused_cascade", rows=rows, n=int(src_shape[-1]),
+        order=int(order), ext=ExtensionType(ext).value,
+        levels=int(levels))
 
 
 @functools.partial(obs.instrumented_jit,

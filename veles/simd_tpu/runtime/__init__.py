@@ -2,13 +2,16 @@
 
 The ops layer owns *what* to compute (route tables, selectors,
 oracles); this package owns the runtime policies every op family
-shares.  First resident: :mod:`~veles.simd_tpu.runtime.faults`, the
-fault-policy engine — one demote-and-remember implementation for
-Mosaic compile rejections, bounded retry-with-backoff for transient
-device faults, and the deterministic fault-injection harness that
-exercises both on CPU CI.
+shares.  Residents: :mod:`~veles.simd_tpu.runtime.faults`, the fault-policy
+engine — one demote-and-remember implementation for Mosaic compile
+rejections, bounded retry-with-backoff for transient device faults,
+and the deterministic fault-injection harness that exercises both on
+CPU CI — and :mod:`~veles.simd_tpu.runtime.routing`, the unified
+routing engine: declarative candidate-route tables, the shared
+selector, and the measured autotuner with its persistent tune cache.
 """
 
 from veles.simd_tpu.runtime import faults
+from veles.simd_tpu.runtime import routing
 
-__all__ = ["faults"]
+__all__ = ["faults", "routing"]

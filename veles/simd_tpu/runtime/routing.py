@@ -1,0 +1,903 @@
+"""Unified routing engine: candidate tables, selection, autotuning.
+
+The paper's signature feature is per-op automatic best-algorithm
+selection.  Before this module the reproduction carried hand-written
+copies of that machinery in every routed op family — ``convolve``'s
+``_use_pallas_os``/``_use_pallas_direct``, ``wavelet``'s
+``_use_pallas``, ``spectral``'s ``_use_matmul_dft``/
+``_use_pallas_stft`` — each with magic constants (k<=2047 taps,
+frame<=4096, hop%128==0, ...) that are guesses about one TPU
+generation, and PR 4 measured a 25% analytical-vs-measured roofline
+disagreement on ``os_matmul``: direct evidence the static model
+mispredicts.  TINA (arXiv:2408.16551) frames exactly this
+map-to-accelerator-primitive choice as the performance-critical step.
+This module is the ONE home of the shared pattern:
+
+* **declarative candidate tables** — each op family declares a
+  :func:`family` of :class:`Route` entries in priority order:
+  predicate (the geometry gate, where the route constants live),
+  opt-out env var, fault-injection site, rejection cache for the
+  demote-and-remember policy (:mod:`veles.simd_tpu.runtime.faults`),
+  and optional roofline constants for bench attribution.  The per-file
+  selector functions in ``ops/`` are thin delegates into these tables
+  (``tools/lint.py``'s routing rule keeps it that way);
+
+* **the selector** — :meth:`Family.select`: rejection memory outranks
+  everything (a demoted geometry skips the doomed route without
+  re-raising), an armed fault plan opens the gate (so injection tests
+  really select the doomed route on CPU), the env opt-out closes it,
+  the predicate decides the rest; first eligible route in table order
+  wins.  Dispatch itself (span, ``faults.guarded``,
+  ``faults.demote_and_remember``) stays at the ops dispatch layer
+  where the telemetry contracts pin it;
+
+* **measured autotuning** — ``VELES_SIMD_AUTOTUNE=off|on|readonly``
+  (default off).  With ``on``, the first encounter of a geometry class
+  with >=2 eligible candidates probes each eligible route with a short
+  chained-dispatch timer (the probe thunks call the
+  ``obs.instrumented_jit`` cores directly, so the first probe per
+  geometry also performs the AOT cost/memory harvest), picks the
+  measured winner, records an ``autotune`` decision event with
+  per-route timings, and persists the decision in the tune cache.
+  ``readonly`` consults the cache but never probes (production
+  processes ship a pre-warmed pack, ``tools/autotune_pack.py`` /
+  ``make autotune-pack``, and never pay exploration); the static
+  table order remains the cold-start prior in every mode;
+
+* **a persistent tune cache** — ``VELES_SIMD_AUTOTUNE_CACHE=path``:
+  version-stamped JSON, written atomically (the shared
+  temp+``os.replace`` writer), loaded lazily, corrupt files and
+  version mismatches ignored-but-counted, registered in
+  ``obs.caches()`` as ``autotune_cache`` so hit/miss/store traffic is
+  one snapshot away.
+
+The probe timer is injectable (:func:`set_probe_timer` /
+:func:`probe_timer`) so the measured-winner path runs deterministically
+on CPU CI; the default timer is a warmup call plus a short chained
+loop blocked once at the end (the same discipline as
+``utils/benchmark.device_time_chained``, without its sweep machinery).
+
+Like :mod:`~veles.simd_tpu.runtime.faults`, this module imports
+neither jax nor numpy at module scope; jax is reached only inside the
+default probe's block helper.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import threading
+import time
+
+from veles.simd_tpu import obs
+from veles.simd_tpu.obs.atomic import atomic_write_text as _atomic_write
+from veles.simd_tpu.runtime import faults
+
+__all__ = [
+    "Route", "Family", "family", "families", "get_family",
+    "autotune_mode", "autotune_mode_override",
+    "AUTOTUNE_ENV", "AUTOTUNE_CACHE_ENV",
+    "AUTOTUNE_ITERS_ENV", "AUTOTUNE_MODES", "DEFAULT_PROBE_ITERS",
+    "TUNE_CACHE_VERSION", "TUNE_CACHE_MAX_ENTRIES", "TuneCache",
+    "tune_cache", "set_cache_path", "private_tune_cache",
+    "tune_key_str", "pow2_bucket", "device_kind", "env_truthy",
+    "set_probe_timer", "probe_timer",
+]
+
+AUTOTUNE_ENV = "VELES_SIMD_AUTOTUNE"
+AUTOTUNE_CACHE_ENV = "VELES_SIMD_AUTOTUNE_CACHE"
+AUTOTUNE_ITERS_ENV = "VELES_SIMD_AUTOTUNE_ITERS"
+
+AUTOTUNE_MODES = ("off", "on", "readonly")
+
+# tune-cache schema version: entries written by a different layout are
+# ignored wholesale (counted in the cache stats) — a pack from an older
+# build must never silently steer a newer selector
+TUNE_CACHE_VERSION = 1
+
+# chained probe length (per candidate, after one warmup/compile call);
+# short on purpose — exploration cost is paid once per geometry class
+# and the decision persists
+DEFAULT_PROBE_ITERS = 8
+
+# tune-cache entry bound: a geometry-churning service must not grow
+# the cache (and its write-through file) without limit — the entries
+# with the OLDEST measurement timestamp are evicted on store (the
+# per-entry "unix" stamp, not dict insertion order: a save/reload
+# cycle serializes sorted and would otherwise turn eviction
+# alphabetical); an evicted class just pays one more probe if it
+# returns
+TUNE_CACHE_MAX_ENTRIES = 1024
+
+# how long a transiently-unloadable pack (local device unknown: the
+# backend hasn't initialized yet) waits before the next load attempt —
+# long enough that a dispatch loop isn't re-parsing the file per call,
+# short enough that the backend-up transition is caught promptly
+LOAD_RETRY_S = 1.0
+
+
+def _evict_oldest(entries: dict) -> None:
+    """Drop entries beyond the bound, oldest measurement first
+    (missing stamps — hand-authored packs — count as oldest)."""
+    while len(entries) > TUNE_CACHE_MAX_ENTRIES:
+        entries.pop(min(entries,
+                        key=lambda k: entries[k].get("unix", 0.0)))
+
+
+_device_kind_cached: str | None = None
+
+
+def device_kind() -> str:
+    """The accelerator the process is measuring on (e.g. ``TPU v5
+    lite``, ``cpu``), stamped into every tune-cache file: the module's
+    own premise is that route winners are device-specific (the static
+    constants 'are guesses about one TPU generation'), so a pack
+    measured on one device must not silently steer another —
+    mismatches degrade to empty like a version mismatch."""
+    global _device_kind_cached
+    if _device_kind_cached is None:
+        try:
+            import jax
+            _device_kind_cached = str(jax.devices()[0].device_kind)
+        except Exception:  # noqa: BLE001 — no backend: still routable
+            # NOT cached: a failed probe may be transient (backend not
+            # yet initialized), and pinning "unknown" for the process
+            # lifetime would reject every device-stamped pack as a
+            # device_mismatch — and stamp "unknown" into saves
+            return "unknown"
+    return _device_kind_cached
+
+
+# thread-local mode override: a supervised worker (bench stages) that
+# may be ABANDONED mid-run must never flip routing for the whole
+# process — an env mutation in an abandoned thread leaks forever,
+# while a thread-local dies with the thread
+_tls = threading.local()
+
+
+def autotune_mode() -> str:
+    """The active autotune mode (``$VELES_SIMD_AUTOTUNE``, or a
+    thread-scoped :func:`autotune_mode_override`): ``off`` (static
+    table order — the default and the cold-start prior), ``on``
+    (measure unseen geometry classes, persist winners), or
+    ``readonly`` (consult the tune cache, never probe).  Unknown
+    values read as ``off`` — a typo'd env var must not change routing
+    or crash a service."""
+    override = getattr(_tls, "mode", None)
+    raw = (override if override is not None
+           else os.environ.get(AUTOTUNE_ENV, "off")).strip().lower()
+    return raw if raw in AUTOTUNE_MODES else "off"
+
+
+@contextlib.contextmanager
+def autotune_mode_override(mode: str):
+    """Scoped, THREAD-LOCAL mode override — the supervised-worker
+    idiom (``bench.py``'s autotuned-headline stage): if the thread is
+    abandoned by a watchdog before the scope exits, the override dies
+    with the thread instead of leaking into the process env."""
+    if mode not in AUTOTUNE_MODES:
+        raise ValueError(f"mode must be one of {AUTOTUNE_MODES}, "
+                         f"got {mode!r}")
+    prev = getattr(_tls, "mode", None)
+    _tls.mode = mode
+    try:
+        yield
+    finally:
+        _tls.mode = prev
+
+
+def _probe_iters() -> int:
+    raw = os.environ.get(AUTOTUNE_ITERS_ENV, "").strip()
+    try:
+        n = int(raw) if raw else DEFAULT_PROBE_ITERS
+    except ValueError:
+        return DEFAULT_PROBE_ITERS
+    return n if n >= 1 else DEFAULT_PROBE_ITERS
+
+
+# ---------------------------------------------------------------------------
+# probe timer (injectable — CPU CI runs a deterministic fake)
+# ---------------------------------------------------------------------------
+
+def _block(out) -> None:
+    """Block until ``out`` is ready (jax arrays / pytrees); silently a
+    no-op for host values or jax-free processes — the probe then times
+    eager completion, which is still a valid relative signal."""
+    try:
+        import jax
+
+        jax.block_until_ready(out)
+    except Exception:  # noqa: BLE001 — best-effort sync only
+        pass
+
+
+def _default_probe(thunk, route_name: str) -> float:
+    """Seconds per dispatch of ``thunk`` (zero-arg candidate runner).
+
+    One warmup call (compile + AOT harvest land here), then two
+    async-dispatch bursts of different lengths, each blocked once at
+    the end, and the MARGINAL time between them — the same
+    fixed-cost-cancelling discipline as
+    ``utils/benchmark.device_time_chained``: on a relay-attached
+    device the round trip (~66 ms, ~2.6 ms jitter — measured, see the
+    chained timer's docstring) would otherwise dominate a short burst
+    and rank candidates by transport noise.  The generic zero-arg
+    runner contract precludes an on-device fori_loop chain, so the
+    burst difference is the best fixed-cost canceller available here;
+    winners that matter more than one probe's noise budget should
+    come from a pack built by the sweep tools' chained timers."""
+    del route_name
+    _block(thunk())
+    lo = 2
+    hi = lo + max(_probe_iters(), 1)
+
+    def burst(k):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(k):
+            out = thunk()
+        _block(out)
+        return time.perf_counter() - t0
+
+    t_lo = burst(lo)
+    t_hi = burst(hi)
+    return max((t_hi - t_lo) / (hi - lo), 1e-9)
+
+
+_probe_lock = threading.Lock()
+_PROBE_TIMER = _default_probe
+
+
+def set_probe_timer(fn=None) -> None:
+    """Replace the probe timer (``fn(thunk, route_name) -> seconds``);
+    ``None`` restores the default.  Tests inject a deterministic timer
+    here so the measured-winner path runs on CPU CI without real
+    timing flakiness."""
+    global _PROBE_TIMER
+    with _probe_lock:
+        _PROBE_TIMER = fn if fn is not None else _default_probe
+
+
+@contextlib.contextmanager
+def probe_timer(fn):
+    """Scoped :func:`set_probe_timer` — the test-suite idiom."""
+    with _probe_lock:
+        prev = _PROBE_TIMER
+    set_probe_timer(fn)
+    try:
+        yield
+    finally:
+        set_probe_timer(prev if prev is not _default_probe else None)
+
+
+# ---------------------------------------------------------------------------
+# the persistent tune cache
+# ---------------------------------------------------------------------------
+
+def pow2_bucket(v: int) -> int:
+    """Geometry-class bucketing: the next power of two >= ``v``.
+
+    Dimensions that vary per call but shift the route winner only
+    gradually (signal length, batch rows) are bucketed before they
+    key the tune cache, so a length-churning service shares a finite
+    set of classes instead of probing — and growing the cache — per
+    distinct length.  Dimensions the gates compare exactly (filter
+    taps, frame/hop, rejection-cache keys) stay exact."""
+    v = int(v)
+    if v <= 1:
+        return v
+    return 1 << (v - 1).bit_length()
+
+
+def tune_key_str(fam: str, geom: dict) -> str:
+    """Canonical geometry-class key: ``family|k=v,k=v`` over the sorted
+    geometry fields.  The single format the online tuner, the sweep
+    tools, and the pre-warmed pack share."""
+    body = ",".join(f"{k}={geom[k]}" for k in sorted(geom))
+    return f"{fam}|{body}"
+
+
+class TuneCache:
+    """Version-stamped persistent map: geometry-class key -> measured
+    winner (+ per-route timings and provenance).
+
+    Disk format (JSON, atomically written)::
+
+        {"version": 1, "device": "TPU v5 lite",
+         "entries": {"stft|frame_length=512,hop=128,...":
+                     {"route": "pallas_fused",
+                      "timings_us": {"pallas_fused": 41, ...},
+                      "source": "measured", "unix": ...}, ...}}
+
+    A corrupt file, a version mismatch, or a ``device`` stamp from a
+    DIFFERENT accelerator loads as EMPTY (counted in ``load_errors`` /
+    ``version_mismatch`` / ``device_mismatch`` — visible in
+    ``obs.caches()['autotune_cache']``): a bad pack must degrade to
+    the static prior, never crash dispatch or steer it blindly, and
+    winners measured on one device must never silently steer another
+    (a missing stamp — a hand-authored pack — is accepted).
+    """
+
+    def __init__(self, path: str | None = None):
+        self._lock = threading.Lock()
+        # serializes save()'s read-merge-write as a unit: _lock alone
+        # only covers building the payload, and two stores could then
+        # land their writes in the opposite order — the older snapshot
+        # replacing the newer one (lost update)
+        self._save_lock = threading.Lock()
+        self._path = path
+        self._entries: dict[str, dict] = {}
+        self._loaded = path is None
+        self._stats = {"hits": 0, "misses": 0, "stores": 0,
+                       "evictions": 0, "load_errors": 0,
+                       "version_mismatch": 0, "device_mismatch": 0,
+                       "persist_errors": 0, "save_refused": 0}
+        self._next_load_retry = 0.0
+
+    @property
+    def path(self) -> str | None:
+        return self._path
+
+    @staticmethod
+    def _read_file(path: str) -> "dict | str":
+        """Validated entries from ``path``, or the rejection reason
+        (``'missing'`` / ``'load_errors'`` / ``'version_mismatch'`` /
+        ``'device_mismatch'`` — the stat counter to bump)."""
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except FileNotFoundError:
+            return "missing"
+        except Exception:  # noqa: BLE001 — corrupt cache degrades
+            return "load_errors"
+        if not isinstance(data, dict) or \
+                data.get("version") != TUNE_CACHE_VERSION:
+            return "version_mismatch"
+        stamp = data.get("device")
+        if stamp is not None and stamp != device_kind():
+            return "device_mismatch"
+        entries = data.get("entries")
+        if not isinstance(entries, dict):
+            return "load_errors"
+        return {str(key): dict(entry)
+                for key, entry in entries.items()
+                if isinstance(entry, dict)
+                and isinstance(entry.get("route"), str)}
+
+    def _ensure_loaded_locked(self) -> None:
+        if self._loaded:
+            return
+        if time.time() < self._next_load_retry:
+            return
+        loaded = self._read_file(self._path)
+        if loaded == "device_mismatch" and device_kind() == "unknown":
+            # the LOCAL device is transiently unknowable (backend not
+            # yet initialized — e.g. an early telemetry snapshot
+            # touched the cache): don't pin the rejection for the
+            # process lifetime, but don't re-read the file on every
+            # touch either — retry on an interval.  NOT counted as a
+            # device_mismatch: the load is deferred, not rejected —
+            # the terminal read after backend-up does the counting
+            # (a deferred-then-accepted pack must report zero)
+            self._next_load_retry = time.time() + LOAD_RETRY_S
+            return
+        self._loaded = True
+        if isinstance(loaded, dict):
+            self._entries.update(loaded)
+        elif loaded != "missing":
+            self._stats[loaded] += 1
+
+    def lookup(self, fam: str, geom: dict) -> str | None:
+        """The cached winner route for a geometry class, or None.
+        Counts a hit/miss either way."""
+        key = tune_key_str(fam, geom)
+        with self._lock:
+            self._ensure_loaded_locked()
+            entry = self._entries.get(key)
+            if entry is None:
+                self._stats["misses"] += 1
+                return None
+            self._stats["hits"] += 1
+            return entry["route"]
+
+    def entry(self, fam: str, geom: dict) -> dict | None:
+        """Full cached record (route + timings + provenance), no
+        hit/miss accounting — introspection and tests."""
+        key = tune_key_str(fam, geom)
+        with self._lock:
+            self._ensure_loaded_locked()
+            entry = self._entries.get(key)
+            return dict(entry) if entry is not None else None
+
+    def store(self, fam: str, geom: dict, route: str,
+              timings_us: dict | None = None,
+              source: str = "measured") -> str:
+        """Record a winner and write through to disk when a path is
+        bound.  Returns the entry key."""
+        key = tune_key_str(fam, geom)
+        entry = {"route": str(route), "source": str(source),
+                 "unix": time.time()}
+        if timings_us:
+            entry["timings_us"] = {str(k): (round(float(v), 1)
+                                            if v is not None else None)
+                                   for k, v in timings_us.items()}
+        with self._lock:
+            self._ensure_loaded_locked()
+            self._entries.pop(key, None)
+            self._entries[key] = entry       # fresh "unix" = recency
+            self._stats["stores"] += 1
+            before = len(self._entries)
+            _evict_oldest(self._entries)
+            self._stats["evictions"] += before - len(self._entries)
+        self.save()
+        return key
+
+    def save(self, path: str | None = None) -> str | None:
+        """Atomically persist to ``path`` (default: the bound path;
+        None with no bound path is a no-op).  The current disk state
+        is re-read and MERGED under this cache's entries first: two
+        autotune=on workers sharing one cache path each hold a private
+        in-memory view, and a full-snapshot write would silently drop
+        the other worker's probed winners (atomic_write prevents torn
+        files, not lost updates).  A valid pack stamped for another
+        device or schema version is never overwritten (save_refused) —
+        load-side mismatch degrades to empty, save-side destruction
+        would be permanent.  Persistence failures are counted, never
+        raised — routing must outlive a read-only filesystem."""
+        path = path or self._path
+        if path is None:
+            return None
+        with self._save_lock:
+            with self._lock:
+                self._ensure_loaded_locked()
+                on_disk = self._read_file(path)
+                if on_disk in ("version_mismatch", "device_mismatch"):
+                    # a VALID pack for another device or schema: load
+                    # degrades to empty, but overwriting would
+                    # permanently destroy an operator's measured
+                    # winners (a CPU plumbing run must not clobber
+                    # the TPU pack it was pointed at) — refuse
+                    self._stats["save_refused"] += 1
+                    return None
+                merged = on_disk if isinstance(on_disk, dict) else {}
+                merged.update(self._entries)
+                _evict_oldest(merged)
+                payload = {"version": TUNE_CACHE_VERSION,
+                           "device": device_kind(),
+                           "entries": merged}
+            try:
+                return _atomic_write(path,
+                                     json.dumps(payload, indent=1,
+                                                sort_keys=True))
+            except Exception:  # noqa: BLE001
+                with self._lock:
+                    self._stats["persist_errors"] += 1
+                return None
+
+    def entries(self) -> dict:
+        with self._lock:
+            self._ensure_loaded_locked()
+            return {k: dict(v) for k, v in self._entries.items()}
+
+    def info(self) -> dict:
+        """obs.caches() provider payload."""
+        with self._lock:
+            self._ensure_loaded_locked()
+            return {"size": len(self._entries),
+                    "capacity": TUNE_CACHE_MAX_ENTRIES,
+                    "path": self._path, "version": TUNE_CACHE_VERSION,
+                    "mode": autotune_mode(), **self._stats}
+
+
+_cache_lock = threading.Lock()
+_cache_override: str | None = None     # set_cache_path() programmatic
+_cache_src: object = None              # path the singleton was built for
+_cache_obj: TuneCache | None = None
+_NO_PATH = object()
+
+
+def set_cache_path(path: str | None) -> None:
+    """Programmatic tune-cache path override (None restores the
+    ``$VELES_SIMD_AUTOTUNE_CACHE`` lookup).  The next :func:`tune_cache`
+    call rebuilds the singleton."""
+    global _cache_override, _cache_src, _cache_obj
+    with _cache_lock:
+        _cache_override = path
+        _cache_src = _NO_PATH      # force rebuild on next lookup
+        _cache_obj = None
+
+
+def tune_cache() -> TuneCache:
+    """The process tune cache, rebuilt when the bound path changes
+    (env var edits in tests, :func:`set_cache_path`).  A thread-scoped
+    :func:`private_tune_cache` takes precedence."""
+    global _cache_src, _cache_obj
+    private = getattr(_tls, "cache", None)
+    if private is not None:
+        return private
+    path = _cache_override
+    if path is None:
+        path = os.environ.get(AUTOTUNE_CACHE_ENV, "").strip() or None
+    with _cache_lock:
+        if _cache_obj is None or path != _cache_src:
+            _cache_src = path
+            _cache_obj = TuneCache(path)
+        return _cache_obj
+
+
+@contextlib.contextmanager
+def private_tune_cache(path: str | None = None):
+    """Scoped, THREAD-LOCAL tune cache (default in-memory): inside
+    the scope, this thread's lookups/stores go to a private
+    :class:`TuneCache` instead of the process one — so a measuring
+    stage (``bench.py``'s autotuned-headline row) can explore without
+    reading from or WRITING INTO a production pack the operator bound
+    via ``$VELES_SIMD_AUTOTUNE_CACHE``.  Thread-local like
+    :func:`autotune_mode_override`: an abandoned worker's private
+    cache dies with the thread.  Yields the private cache."""
+    prev = getattr(_tls, "cache", None)
+    cache = TuneCache(path)
+    _tls.cache = cache
+    try:
+        yield cache
+    finally:
+        _tls.cache = prev
+
+
+obs.register_cache("autotune_cache", lambda: tune_cache().info())
+
+
+# ---------------------------------------------------------------------------
+# routes and families
+# ---------------------------------------------------------------------------
+
+def _is_traced(operand) -> bool:
+    """Is ``operand`` a jax tracer?  (Lazy import — this module stays
+    jax-free until a probe decision actually needs the check.)"""
+    if operand is None:
+        return False
+    try:
+        import jax
+
+        return isinstance(operand, jax.core.Tracer)
+    except Exception:  # noqa: BLE001 — jax-free process: nothing traces
+        return False
+
+
+def env_truthy(name: str) -> bool:
+    """Is the escape-hatch env var ``name`` set truthy?  The single
+    parser behind every route's ``disable_env`` gate — the ops'
+    public ``*_allowed`` queries delegate here so they can never
+    drift from what the tables actually check."""
+    return os.environ.get(name, "0").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+@dataclasses.dataclass(frozen=True)
+class Route:
+    """One candidate in a family's table.
+
+    ``predicate(**geom) -> bool`` is the geometry gate — the single
+    home of the route's constants (None = unconditionally eligible,
+    the table's terminal fallback).  ``disable_env`` names a truthy
+    env var that closes the gate family-wide.  ``fault_site`` is the
+    injection-plan site whose armed state opens the gate
+    (:func:`veles.simd_tpu.runtime.faults.armed`) so CPU CI really
+    selects the doomed route.  ``rejection_cache`` is a ZERO-ARG
+    GETTER returning the bounded rejection set the demote-and-remember
+    policy feeds (a getter, not the set: tests substitute plain sets
+    through the owning module's global); ``rejection_key(**geom)``
+    derives the remembered key.  ``roofline`` carries per-route
+    useful-FLOP constants for bench attribution; ``doc`` one line for
+    humans and generated docs.
+    """
+
+    name: str
+    predicate: object = None
+    disable_env: str | None = None
+    fault_site: str | None = None
+    rejection_cache: object = None
+    rejection_key: object = None
+    roofline: dict | None = None
+    doc: str = ""
+
+    def rejected(self, geom: dict) -> bool:
+        if self.rejection_cache is None or self.rejection_key is None:
+            return False
+        try:
+            cache = self.rejection_cache()
+            return self.rejection_key(**geom) in cache
+        except Exception:  # noqa: BLE001 — a bad key never blocks
+            return False
+
+    def gate(self, geom: dict) -> bool:
+        """Env opt-out + predicate only (no rejection memory, no armed
+        fault plan) — the historical ``_use_*`` pure-gate semantics."""
+        if self.disable_env and env_truthy(self.disable_env):
+            return False
+        if self.predicate is None:
+            return True
+        return bool(self.predicate(**geom))
+
+    def allowed(self, geom: dict) -> bool:
+        """Full eligibility: rejection memory outranks everything
+        (a demoted geometry skips the route without re-raising), an
+        armed fault plan opens the gate, then env + predicate."""
+        if self.rejected(geom):
+            return False
+        if self.fault_site and faults.armed(self.fault_site):
+            return True
+        return self.gate(geom)
+
+
+class Family:
+    """One op family's candidate table + selection policy.
+
+    Construct via :func:`family` (which also registers the table for
+    introspection).  Routes are in PRIORITY order: static selection is
+    the first eligible route — exactly the hand-written if/elif
+    ladders this engine replaced, now data.
+    """
+
+    def __init__(self, name: str, routes, *, decision_op=None):
+        self.name = str(name)
+        self._routes: dict[str, Route] = {}
+        for r in routes:
+            if r.name in self._routes:
+                raise ValueError(f"duplicate route {r.name!r} in "
+                                 f"family {name!r}")
+            self._routes[r.name] = r
+        if not self._routes:
+            raise ValueError(f"family {name!r} has no routes")
+        self.decision_op = decision_op or f"{self.name}_route"
+
+    # -- table introspection ------------------------------------------------
+
+    def names(self) -> tuple:
+        return tuple(self._routes)
+
+    def route(self, name: str) -> Route:
+        try:
+            return self._routes[name]
+        except KeyError:
+            raise ValueError(
+                f"route must be one of {sorted(self._routes)}, "
+                f"got {name!r}") from None
+
+    def describe(self) -> dict:
+        """JSON-native table summary (tools, docs, tests)."""
+        return {"family": self.name,
+                "routes": [{"name": r.name,
+                            "disable_env": r.disable_env,
+                            "fault_site": r.fault_site,
+                            "has_rejection_cache":
+                                r.rejection_cache is not None,
+                            "doc": r.doc}
+                           for r in self._routes.values()]}
+
+    # -- eligibility --------------------------------------------------------
+
+    def gate(self, name: str, **geom) -> bool:
+        """Pure geometry gate of one route (env + predicate) — what
+        the per-file ``_use_*`` selectors used to compute."""
+        return self.route(name).gate(geom)
+
+    def route_allowed(self, name: str, **geom) -> bool:
+        """Full eligibility of one route (rejection memory, armed
+        fault plan, env, predicate)."""
+        return self.route(name).allowed(geom)
+
+    def eligible(self, **geom) -> list:
+        """Eligible route names in table (priority) order.  Never
+        empty: when every gate refuses, the last route — the table's
+        terminal fallback — is returned alone, mirroring the
+        hand-written ladders' unconditional else branch."""
+        names = [n for n, r in self._routes.items() if r.allowed(geom)]
+        if not names:
+            names = [tuple(self._routes)[-1]]
+        return names
+
+    def static_select(self, **geom) -> str:
+        """First eligible route in table order — the cold-start prior
+        and the ``VELES_SIMD_AUTOTUNE=off`` behavior.  (Demotion picks
+        its fallback via each route's explicit ``fallback_route``
+        string in ``faults.demote_and_remember``, not here.)"""
+        return self.eligible(**geom)[0]
+
+    # -- selection (static prior + measured autotune) -----------------------
+
+    def select(self, eligible=None, runners=None, probe_operand=None,
+               tune_geom=None, **geom) -> str:
+        """Pick the route to dispatch.
+
+        ``eligible`` (optional) is a priority-ordered candidate list
+        the caller already computed — the ops dispatch layers pass
+        their (test-monkeypatchable) gate results through here so the
+        engine never disagrees with them; None computes eligibility
+        from the table.  ``runners`` maps route name -> zero-arg probe
+        thunk (the instrumented core, called directly — a forced
+        route), or is a ZERO-ARG FACTORY returning that dict — the
+        factory is only invoked when the measured mode will actually
+        probe, so callers pass it unconditionally.  ``probe_operand``
+        is a representative operand the engine tracer-checks: under
+        an outer jit trace probing is refused wholesale (tracer
+        "timings" are trace-construction time, not device time — and
+        a winner measured that way must never persist).  Without
+        runners the measured mode cannot probe and behaves like
+        ``readonly``.
+
+        ``tune_geom`` (optional) is the geometry CLASS that keys the
+        tune cache when it must differ from ``geom``: a family whose
+        rejection-cache key needs exact dims (convolve2d — the demote
+        entries are keyed by exact image shape) passes the exact dims
+        as ``geom`` and a :func:`pow2_bucket`-ed copy here, so shape
+        churn shares a finite set of tune classes instead of probing
+        — and rewriting the pack — per distinct shape.  Defaults to
+        ``geom`` (most families bucket their churning dims before the
+        call because their rejection keys don't need them exact).
+
+        Modes (``$VELES_SIMD_AUTOTUNE``): ``off`` -> static prior;
+        ``readonly`` -> cached winner if present and still eligible,
+        else static; ``on`` -> cached winner, else probe the eligible
+        candidates, persist and return the measured winner.
+        """
+        if eligible is None:
+            eligible = self.eligible(**geom)
+        if not eligible:
+            eligible = [tuple(self._routes)[-1]]
+        static = eligible[0]
+        mode = autotune_mode()
+        if mode == "off" or len(eligible) < 2:
+            return static
+        if tune_geom is None:
+            tune_geom = geom
+        for name in eligible:
+            r = self._routes.get(name)
+            if r is not None and r.fault_site \
+                    and faults.armed(r.fault_site):
+                # an ARMED injection plan must really dispatch the
+                # doomed route (that is the plan's whole contract —
+                # the gate it opened put the route at its table
+                # priority): a cached winner consulted first would
+                # bypass it and leave the demote path unexercised
+                return static
+        cache = tune_cache()
+        cached = cache.lookup(self.name, tune_geom)
+        if cached is not None and cached in eligible:
+            obs.count("autotune_cache_hit", family=self.name)
+            return cached
+        if cached is not None:
+            # a cached winner whose route is no longer eligible
+            # (demoted, env-disabled) must not be dispatched — and its
+            # entry must not be overwritten by a probe of only the
+            # surviving candidates: the ineligibility may be temporary
+            # (one debug session's env opt-out), and the write-through
+            # store would poison an operator's pack for after the
+            # route comes back.  Dispatch the static prior, keep the
+            # entry for when its route is eligible again.
+            obs.count("autotune_cache_stale", family=self.name)
+            return static
+        if mode != "on" or runners is None or _is_traced(probe_operand):
+            return static
+        if callable(runners):
+            runners = runners()
+        if not runners:
+            return static
+        return self._measure(eligible, runners, static, geom, tune_geom)
+
+    def _measure(self, eligible, runners, static: str, geom,
+                 tune_geom=None) -> str:
+        """Probe the eligible candidates, pick the winner, persist."""
+        with _probe_lock:
+            probe = _PROBE_TIMER
+        timings_us: dict[str, float | None] = {}
+        inconclusive = False
+        for name in eligible:
+            thunk = runners.get(name)
+            if thunk is None:
+                continue
+            attempt = 0
+            while True:
+                try:
+                    timings_us[name] = probe(thunk, name) * 1e6
+                    break
+                except Exception as e:  # noqa: BLE001 — probes explore
+                    # transient faults (device lost, timeout) get the
+                    # same bounded retry dispatch gets (runtime/faults)
+                    if (faults.is_transient(e)
+                            and attempt < faults.fault_retries()):
+                        obs.count("autotune_probe_retry",
+                                  family=self.name, route=name)
+                        time.sleep(faults.backoff_delay(attempt))
+                        attempt += 1
+                        continue
+                    timings_us[name] = None
+                    if faults.is_transient(e):
+                        # retries exhausted on a transient fault: the
+                        # round is INCONCLUSIVE — persisting whichever
+                        # candidate survived would launder one device
+                        # hiccup into a permanent routing decision (a
+                        # pack entry readonly processes then obey)
+                        inconclusive = True
+                        obs.count("autotune_probe_transient",
+                                  family=self.name, route=name)
+                        break
+                    # a candidate that cannot run is skipped; a Mosaic
+                    # vmem compile OOM is additionally remembered so
+                    # the route's gate refuses the geometry from now
+                    # on (the same demote-and-remember policy dispatch
+                    # applies)
+                    route = self._routes.get(name)
+                    if (route is not None
+                            and faults.is_mosaic_vmem_oom(e)
+                            and route.rejection_cache is not None
+                            and route.rejection_key is not None):
+                        try:
+                            route.rejection_cache().add(
+                                route.rejection_key(**geom))
+                        except Exception:  # noqa: BLE001
+                            pass
+                    obs.count("autotune_probe_error", family=self.name,
+                              route=name)
+                    break
+            if inconclusive:
+                # every result is discarded below — probing the
+                # remaining candidates would only burn device time on
+                # an already-flaky device
+                break
+        measured = {n: t for n, t in timings_us.items()
+                    if t is not None}
+        if not measured:
+            return static
+        if inconclusive:
+            # nothing stored: the next encounter of this geometry
+            # class re-probes with every candidate answering
+            obs.count("autotune_inconclusive", family=self.name)
+            return static
+        winner = min(measured, key=measured.get)
+        key = tune_cache().store(
+            self.name, geom if tune_geom is None else tune_geom,
+            winner, timings_us=timings_us, source="measured")
+        obs.count("autotune_measured", family=self.name)
+        obs.record_decision(
+            "autotune", winner, family=self.name, key=key,
+            static=static,
+            timings=",".join(
+                f"{n}={timings_us[n]:.1f}us"
+                if timings_us[n] is not None else f"{n}=failed"
+                for n in timings_us),
+            probes=len(measured))
+        return winner
+
+
+_families_lock = threading.Lock()
+_FAMILIES: dict[str, Family] = {}
+
+
+def family(name: str, routes, *, decision_op=None) -> Family:
+    """Declare (and register) one op family's candidate-route table.
+    Re-declaring a name replaces the registration — module reloads in
+    tests must not error."""
+    fam = Family(name, routes, decision_op=decision_op)
+    with _families_lock:
+        _FAMILIES[fam.name] = fam
+    return fam
+
+
+def families() -> dict:
+    """Name -> :class:`Family` snapshot of every registered table
+    (tools/autotune_pack.py and the docs walk this)."""
+    with _families_lock:
+        return dict(_FAMILIES)
+
+
+def get_family(name: str) -> Family:
+    with _families_lock:
+        try:
+            return _FAMILIES[name]
+        except KeyError:
+            raise ValueError(
+                f"unknown route family {name!r} "
+                f"(registered: {sorted(_FAMILIES)})") from None
