@@ -5,7 +5,8 @@
 
 PYTHON ?= python
 
-.PHONY: all tests tests-quick benchmarks bench bench-regress cshim cshim-check \
+.PHONY: all tests tests-quick benchmarks bench bench-regress \
+        bench-multichip cshim cshim-check \
         wavelet-tables lint docs obs-report autotune-pack install \
         install-hooks clean
 
@@ -31,6 +32,14 @@ bench:
 # gate after `make bench`.  Knobs: tools/bench_regress.py --help
 bench-regress:
 	$(PYTHON) tools/bench_regress.py
+
+# the MULTICHIP bench family: pod-scale Fourier rows (sharded_rfft
+# matmul-DFT vs local FFT, sharded_stft above the matmul cutoff) on a
+# device mesh, written to MULTICHIP_DETAILS.json with per-route
+# roofline % and per-stage all_to_all ICI bytes.  Gate with
+# `python tools/bench_regress.py --details MULTICHIP_DETAILS.json`.
+bench-multichip:
+	$(PYTHON) tools/bench_multichip.py
 
 cshim:
 	$(MAKE) -C csrc all

@@ -382,3 +382,91 @@ def test_real_compute_modules_have_no_inline_fault_handlers():
             errs = lint.fault_handler_errors(
                 ast.parse(path.read_text()), rel)
             assert errs == [], errs
+
+
+# --------------------------------------------------------------------------
+# the dispatch rule extended to parallel/fourier.py (PR 8): sharded
+# route runners may reach the resource axis through the module-level
+# `_instrumented` shard_map wrapper (transitively), and the sharded
+# selectors must delegate to a routing.family-bound table like every
+# other compute module
+# --------------------------------------------------------------------------
+
+PARALLEL_GOOD = '''
+import functools
+from veles.simd_tpu import obs
+
+
+def _instrumented(op, run_fn):
+    return obs.instrumented_jit(run_fn, op=op, route="shard_map")
+
+
+def _ct_sharded(v):
+    def _run(x):
+        return x
+    return _instrumented("sharded_rfft", _run)(v)
+
+
+def _run_matmul(x, mesh):
+    return _ct_sharded(x)
+
+
+_RFFT_ROUTES = {"sharded_matmul_dft": _run_matmul}
+
+
+def sharded_rfft(x, mesh, route):
+    with obs.span("sharded_rfft.dispatch", route=route):
+        return _RFFT_ROUTES[route](x, mesh)
+'''
+
+PARALLEL_BAD_RUNNER = '''
+from veles.simd_tpu import obs
+
+
+def _run_matmul(x, mesh):
+    return x + 1
+
+
+_RFFT_ROUTES = {"sharded_matmul_dft": _run_matmul}
+
+
+def sharded_rfft(x, mesh, route):
+    with obs.span("sharded_rfft.dispatch", route=route):
+        return _RFFT_ROUTES[route](x, mesh)
+'''
+
+
+def test_parallel_runner_via_instrumented_wrapper_passes():
+    """A runner reaching obs.instrumented_jit TRANSITIVELY through the
+    parallel `_instrumented` shard_map wrapper satisfies the dispatch
+    rule (the resource axis sees the compile)."""
+    assert _errors(PARALLEL_GOOD) == []
+
+
+def test_parallel_runner_without_instrumented_core_flagged():
+    errs = _errors(PARALLEL_BAD_RUNNER)
+    assert any("instrumented_jit" in e for e in errs)
+
+
+def test_dispatch_rule_covers_parallel_fourier():
+    """The rule is WIRED for parallel/fourier.py (not just spectral)
+    and the real module is clean."""
+    assert ("veles/simd_tpu/parallel/fourier.py"
+            in lint._DISPATCH_RULE_FILES)
+    src = (REPO / "veles/simd_tpu/parallel/fourier.py").read_text()
+    assert lint.spectral_dispatch_errors(
+        ast.parse(src), "veles/simd_tpu/parallel/fourier.py") == []
+
+
+ROUTING_BAD_SHARDED_SELECT = '''
+def select_frame_route(frame_length):
+    return "rdft_matmul" if frame_length <= 4096 else "xla_fft"
+'''
+
+
+def test_routing_rule_flags_hand_rolled_sharded_selector():
+    """A public `select_*` sharded selector with inline constants (no
+    family table) is a lint failure — the parallel/ extension of the
+    routing rule."""
+    errs = _routing_errors(ROUTING_BAD_SHARDED_SELECT)
+    assert any("select_frame_route" in e for e in errs)

@@ -1,0 +1,399 @@
+"""Pod-scale Fourier (PR 8): the Cooley-Tukey sharded DFT on the
+virtual 8-device CPU mesh.
+
+Parity discipline: ``sharded_rfft``/``sharded_dft``/``sharded_irfft``
+must match the NumPy float64 oracles across N1*N2 splits (square,
+non-square, odd-factor), dtypes f32/c64, and round-trip; route
+selection must be provably ENGINE-driven (decision events + tune-cache
+introspection, the test_routing stft pattern) and mesh-keyed (a winner
+measured on one topology never steers another).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from veles.simd_tpu import obs
+from veles.simd_tpu import parallel as par
+from veles.simd_tpu.ops import spectral as sp
+from veles.simd_tpu.parallel import fourier as fr
+from veles.simd_tpu.runtime import routing
+from veles.simd_tpu.utils.platform import to_host
+
+RNG = np.random.RandomState(83)
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return par.make_mesh({"sp": 8})
+
+
+@pytest.fixture
+def fresh_cache(tmp_path, monkeypatch):
+    path = str(tmp_path / "tune.json")
+    monkeypatch.setenv(routing.AUTOTUNE_CACHE_ENV, path)
+    routing.set_cache_path(None)
+    yield path
+    routing.set_cache_path(None)
+
+
+def _rel(got, want):
+    return np.max(np.abs(got - want)) / max(1e-30,
+                                            np.max(np.abs(want)))
+
+
+# ---------------------------------------------------------------------------
+# factorization helper
+# ---------------------------------------------------------------------------
+
+class TestCtFactor:
+    def test_balanced_split(self):
+        assert sp.ct_factor(4096) == (64, 64)
+        n1, n2 = sp.ct_factor(12288)
+        assert n1 * n2 == 12288 and n1 >= n2
+
+    def test_prime_has_no_split(self):
+        assert sp.ct_factor(13) is None
+        assert sp.ct_factor(4099) is None       # prime > cutoff
+
+    def test_multiple_constraint(self):
+        n1, n2 = sp.ct_factor(960, multiple=8)
+        assert n1 % 8 == 0 and n2 % 8 == 0 and n1 * n2 == 960
+        # 24 = 8 * 3: no split has BOTH factors divisible by 8
+        assert sp.ct_factor(24, multiple=8) is None
+
+    def test_max_factor_bound(self):
+        assert sp.ct_factor(1 << 26) is None    # 8192 * 8192 minimum
+
+
+# ---------------------------------------------------------------------------
+# parity: the acceptance suite (forced factorized route vs the NumPy
+# oracle; <= 1e-4 rel err everywhere)
+# ---------------------------------------------------------------------------
+
+class TestFactorizedParity:
+    # square, non-square, and odd-factor splits, all with both
+    # factors divisible by the 8-way mesh
+    @pytest.mark.parametrize("n", [512, 1024, 960, 1536, 4096])
+    def test_sharded_rfft_matches_numpy(self, mesh8, n):
+        x = RNG.randn(n).astype(np.float32)
+        got = to_host(fr.sharded_rfft(x, mesh8,
+                                      route="sharded_matmul_dft"))
+        want = np.fft.rfft(x.astype(np.float64))
+        assert got.shape == (n // 2 + 1,)
+        assert _rel(got, want) <= 1e-4
+
+    def test_sharded_rfft_batched(self, mesh8):
+        x = RNG.randn(3, 1024).astype(np.float32)
+        got = to_host(fr.sharded_rfft(x, mesh8,
+                                      route="sharded_matmul_dft"))
+        want = np.fft.rfft(x.astype(np.float64))
+        assert _rel(got, want) <= 1e-4
+
+    def test_sharded_dft_complex64(self, mesh8):
+        x = (RNG.randn(512) + 1j * RNG.randn(512)).astype(np.complex64)
+        got = to_host(fr.sharded_dft(x, mesh8,
+                                     route="sharded_matmul_dft"))
+        want = np.fft.fft(x.astype(np.complex128))
+        assert _rel(got, want) <= 1e-4
+
+    def test_sharded_dft_real_input(self, mesh8):
+        x = RNG.randn(960).astype(np.float32)
+        got = to_host(fr.sharded_dft(x, mesh8,
+                                     route="sharded_matmul_dft"))
+        assert _rel(got, np.fft.fft(x.astype(np.float64))) <= 1e-4
+
+    @pytest.mark.parametrize("n", [512, 960])
+    def test_roundtrip_irfft_rfft_is_identity(self, mesh8, n):
+        x = RNG.randn(n).astype(np.float32)
+        spec = to_host(fr.sharded_rfft(x, mesh8,
+                                       route="sharded_matmul_dft"))
+        rec = np.asarray(fr.sharded_irfft(
+            spec.astype(np.complex64), n, mesh8,
+            route="sharded_matmul_dft"))
+        assert rec.shape == (n,)
+        assert np.max(np.abs(rec - x)) <= 1e-4 * max(
+            1.0, np.max(np.abs(x)))
+
+    def test_local_fft_route_parity(self, mesh8):
+        x = RNG.randn(1000).astype(np.float32)   # no mesh-div split
+        got = to_host(fr.sharded_rfft(x, mesh8, route="local_fft"))
+        assert _rel(got, np.fft.rfft(x.astype(np.float64))) <= 1e-4
+
+    def test_forced_matmul_without_split_raises(self, mesh8):
+        with pytest.raises(ValueError, match="Cooley-Tukey"):
+            fr.sharded_rfft(RNG.randn(1000).astype(np.float32),
+                            mesh8, route="sharded_matmul_dft")
+
+    def test_irfft_bin_count_checked(self, mesh8):
+        with pytest.raises(ValueError, match="bins"):
+            fr.sharded_irfft(np.zeros(10, np.complex64), 512, mesh8)
+
+    def test_unknown_route_raises(self, mesh8):
+        with pytest.raises(ValueError, match="route"):
+            fr.sharded_rfft(RNG.randn(512).astype(np.float32),
+                            mesh8, route="bogus")
+
+
+@pytest.mark.slow
+class TestFactorizedParityLarge:
+    @pytest.mark.parametrize("n", [12288, 1 << 17])
+    def test_large_n_parity(self, mesh8, n):
+        x = RNG.randn(n).astype(np.float32)
+        got = to_host(fr.sharded_rfft(x, mesh8,
+                                      route="sharded_matmul_dft"))
+        assert _rel(got, np.fft.rfft(x.astype(np.float64))) <= 1e-4
+
+    def test_large_n_auto_selects_matmul(self, mesh8):
+        """At pod-scale N the ICI-aware static predicate itself picks
+        the factorized route — no forcing, no tuner."""
+        n = 1 << 17
+        obs.enable()
+        obs.reset()
+        try:
+            x = RNG.randn(n).astype(np.float32)
+            got = to_host(fr.sharded_rfft(x, mesh8))
+            ev = [e for e in obs.events()
+                  if e["op"] == "sharded_rfft"][-1]
+            assert ev["decision"] == "sharded_matmul_dft"
+            assert ev["ici_bytes"] > 0 and ev["a2a"] == 2
+            assert ev["roofline"] == "dft_matmul"
+            assert _rel(got,
+                        np.fft.rfft(x.astype(np.float64))) <= 1e-4
+        finally:
+            obs.disable()
+            obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# routing: static prior, opt-out, and the engine-driven acceptance
+# (decision events + tune-cache introspection on the mesh-keyed class)
+# ---------------------------------------------------------------------------
+
+class TestFourierRouting:
+    def test_static_prior_is_mesh_and_size_aware(self):
+        assert fr._select_fourier_route(
+            "rfft", 1 << 18, 8, 1, 512, 512) == "sharded_matmul_dft"
+        # too small: collective latency can't pay for itself
+        assert fr._select_fourier_route(
+            "rfft", 1024, 8, 1, 32, 32) == "local_fft"
+        # no factorization (prime)
+        assert fr._select_fourier_route(
+            "rfft", 1 << 18, 8, 1, 0, 0) == "local_fft"
+        # single chip: nothing to shard over
+        assert fr._select_fourier_route(
+            "rfft", 1 << 18, 1, 1, 512, 512) == "local_fft"
+
+    def test_env_opt_out(self, monkeypatch):
+        monkeypatch.setenv(fr.SHARDED_DFT_ENV, "1")
+        assert fr._select_fourier_route(
+            "rfft", 1 << 18, 8, 1, 512, 512) == "local_fft"
+
+    def test_predicate_respects_ici_bandwidth(self, monkeypatch):
+        """The selector really consults the ICI term: strangling the
+        modeled interconnect flips the decision to local_fft (the
+        mesh-awareness acceptance, without hardware)."""
+        geom = dict(n=1 << 18, n_shards=8, rows=1, n1=512, n2=512)
+        assert fr._matmul_dft_viable(**geom)
+        monkeypatch.setenv("VELES_SIMD_ICI_BW_GBPS", "0.0001")
+        assert not fr._matmul_dft_viable(**geom)
+
+    def test_engine_driven_selection_persisted_mesh_keyed(
+            self, mesh8, fresh_cache, monkeypatch):
+        """ACCEPTANCE: with VELES_SIMD_AUTOTUNE=on the measured winner
+        is selected, recorded as a decision event, persisted under a
+        MESH-KEYED tune class with the topology stamp, and served
+        without re-probing — and a different mesh shape does NOT
+        consult that winner."""
+        monkeypatch.setenv(routing.AUTOTUNE_ENV, "on")
+        n = 4096       # matmul predicate holds; both routes eligible
+        x = RNG.randn(n).astype(np.float32)
+        probes = []
+
+        def timer(thunk, name):
+            probes.append(name)
+            thunk()
+            # steer AGAINST the static prior so the selection is
+            # provably measured, not the table order
+            return {"sharded_matmul_dft": 9.0, "local_fft": 1.0}[name]
+
+        obs.enable()
+        obs.reset()
+        try:
+            with routing.probe_timer(timer):
+                to_host(fr.sharded_rfft(x, mesh8))
+            assert set(probes) == {"sharded_matmul_dft", "local_fft"}
+            ev = [e for e in obs.events()
+                  if e["op"] == "sharded_rfft"][-1]
+            assert ev["decision"] == "local_fft"
+            tune_ev = [e for e in obs.events()
+                       if e["op"] == "autotune"][-1]
+            assert tune_ev["family"] == "parallel.fourier"
+            assert tune_ev["static"] == "sharded_matmul_dft"
+            # the persisted class is mesh-keyed AND mesh-stamped
+            token = routing.mesh_class(mesh8, "sp")
+            entries = routing.tune_cache().entries()
+            keys = [k for k in entries
+                    if k.startswith("parallel.fourier|")]
+            assert len(keys) == 1
+            assert f"mesh={token}" in keys[0]
+            assert entries[keys[0]]["mesh"] == token
+            # second dispatch: cached winner, zero probes
+            before = len(probes)
+            with routing.probe_timer(timer):
+                to_host(fr.sharded_rfft(x, mesh8))
+            assert len(probes) == before
+            assert obs.counter_value("autotune_cache_hit",
+                                     family="parallel.fourier") >= 1
+            # a 4-device mesh is a DIFFERENT class: the 8-chip winner
+            # is not consulted (fresh probe round, new entry)
+            mesh4 = par.make_mesh({"sp": 4},
+                                  devices=jax.devices()[:4])
+            with routing.probe_timer(timer):
+                to_host(fr.sharded_rfft(x, mesh4))
+            assert len(probes) > before
+            keys4 = [k for k in routing.tune_cache().entries()
+                     if k.startswith("parallel.fourier|")]
+            assert len(keys4) == 2
+        finally:
+            obs.disable()
+            obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# the local frame-transform family (sharded stft/istft/welch bodies)
+# ---------------------------------------------------------------------------
+
+class TestFrameRoutes:
+    def test_frame_route_ladder(self, monkeypatch):
+        assert fr.select_frame_route(512) == "rdft_matmul"
+        assert fr.select_frame_route(6144) == "ct_matmul"
+        assert fr.select_frame_route(4099) == "xla_fft"  # prime
+        monkeypatch.setenv(sp._DFT_MATMUL_ENV, "1")
+        assert fr.select_frame_route(512) == "xla_fft"
+
+    def test_sharded_stft_records_local_route(self, mesh8):
+        obs.enable()
+        obs.reset()
+        try:
+            x = RNG.randn(8 * 256).astype(np.float32)
+            got = to_host(par.sharded_stft(x, 64, 16, mesh8))
+            ev = [e for e in obs.events()
+                  if e["op"] == "sharded_stft_local"][-1]
+            assert ev["decision"] == "rdft_matmul"
+            want = sp.stft_na(x, 64, 16)
+            assert _rel(got, want) <= 1e-4
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def test_sharded_stft_above_cutoff_rides_ct(self, mesh8):
+        """frame > AUTO_DFT_MATMUL_MAX_FRAME: the local transform is
+        the Cooley-Tukey matmul, and parity holds."""
+        fl, hop = 6144, 1536
+        n = 8 * fl
+        assert fr.select_frame_route(fl) == "ct_matmul"
+        obs.enable()
+        obs.reset()
+        try:
+            x = RNG.randn(n).astype(np.float32)
+            got = to_host(par.sharded_stft(x, fl, hop, mesh8))
+            ev = [e for e in obs.events()
+                  if e["op"] == "sharded_stft_local"][-1]
+            assert ev["decision"] == "ct_matmul"
+            want = sp.stft_na(x, fl, hop)
+            assert _rel(got, want) <= 1e-4
+            # synthesis side: the ct inverse closes the round trip
+            rec = np.asarray(par.sharded_istft(
+                want.astype(np.complex64), n, fl, hop, mesh8))
+            ev = [e for e in obs.events()
+                  if e["op"] == "sharded_istft_local"][-1]
+            assert ev["decision"] == "ct_matmul"
+            wrec = sp.istft_na(want, n, fl, hop)
+            assert _rel(rec, wrec) <= 1e-3
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def test_sharded_welch_records_local_route(self, mesh8):
+        obs.enable()
+        obs.reset()
+        try:
+            x = RNG.randn(8 * 256).astype(np.float32)
+            _, p = par.sharded_welch(x, mesh8, nperseg=64,
+                                     noverlap=48)
+            ev = [e for e in obs.events()
+                  if e["op"] == "sharded_welch_local"][-1]
+            assert ev["decision"] == "rdft_matmul"
+            _, pw = sp.welch_na(x, nperseg=64, noverlap=48)
+            assert _rel(np.asarray(p), pw) <= 1e-4
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def test_sharded_istft_rdft_roundtrip(self, mesh8):
+        x = RNG.randn(8 * 256).astype(np.float32)
+        fl, hop = 64, 16
+        spec = sp.stft_na(x, fl, hop).astype(np.complex64)
+        rec = np.asarray(par.sharded_istft(spec, len(x), fl, hop,
+                                           mesh8))
+        wrec = sp.istft_na(spec, len(x), fl, hop)
+        assert _rel(rec, wrec) <= 1e-4
+
+
+# ---------------------------------------------------------------------------
+# morlet_cwt rides the factorized matmul DFT above the dense cutoff
+# ---------------------------------------------------------------------------
+
+class TestCwtCtRoute:
+    def test_ct_route_selected_above_dense_cutoff(self):
+        assert sp._CWT_FAMILY.static_select(
+            n=sp.CWT_MATMUL_MAX_N * 2) == "ct_matmul"
+        assert sp._CWT_FAMILY.static_select(n=512) == "matmul_dft"
+
+    def test_ct_route_parity(self):
+        x = RNG.randn(2048).astype(np.float32)
+        scales = [4.0, 8.0, 16.0]
+        got = to_host(sp.morlet_cwt(x, scales, simd=True,
+                                    route="ct_matmul"))
+        want = sp.morlet_cwt_na(x, scales)
+        assert _rel(got, want) <= 1e-4
+
+    def test_auto_route_records_decision(self):
+        obs.enable()
+        obs.reset()
+        try:
+            x = RNG.randn(2048).astype(np.float32)
+            to_host(sp.morlet_cwt(x, [4.0], simd=True))
+            ev = [e for e in obs.events()
+                  if e["op"] == "morlet_cwt_route"][-1]
+            assert ev["decision"] == "ct_matmul"
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def test_forced_ct_without_split_raises(self):
+        """Forcing ct_matmul on an unfactorizable length is the clear
+        ValueError every forced dispatcher raises, not a TypeError
+        out of the factor unpack."""
+        x = RNG.randn(1031).astype(np.float32)   # prime
+        with pytest.raises(ValueError, match="Cooley-Tukey"):
+            sp.morlet_cwt(x, [4.0], simd=True, route="ct_matmul")
+
+
+class TestProgramCache:
+    def test_ct_program_reused_across_dispatches(self, mesh8):
+        """Repeat dispatches of one CT class reuse ONE shard_map
+        program (the compiled-handle discipline): without this the
+        autotuner's probe bursts charge the matmul candidate
+        per-iteration re-tracing the local_fft core never pays."""
+        x = RNG.randn(1024).astype(np.float32)
+        fr.sharded_rfft(x, mesh8, route="sharded_matmul_dft")
+        before = dict(fr._program_stats)
+        fr.sharded_rfft(x, mesh8, route="sharded_matmul_dft")
+        fr.sharded_rfft(x, mesh8, route="sharded_matmul_dft")
+        after = dict(fr._program_stats)
+        assert after["misses"] == before["misses"]
+        assert after["hits"] >= before["hits"] + 2
+        assert "fourier_program_lru" in obs.caches()

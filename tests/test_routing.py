@@ -1106,3 +1106,94 @@ class TestAutotunedDispatch:
         monkeypatch.setenv(routing.AUTOTUNE_ENV, "off")
         assert sp._stft_route_for(512, 128, frames, 4) == \
             "rdft_matmul"
+
+
+# ---------------------------------------------------------------------------
+# mesh-keyed tune classes (PR 8): the topology stamp — a 4-chip winner
+# must never steer an 8-chip dispatch
+# ---------------------------------------------------------------------------
+
+class _FakeMesh:
+    def __init__(self, shape):
+        self.shape = shape
+        self.axis_names = tuple(shape)
+
+
+class TestMeshStamp:
+    def test_mesh_class_token(self):
+        m = _FakeMesh({"dp": 2, "sp": 4})
+        assert routing.mesh_class(m) == "dp2xsp4"
+        assert routing.mesh_class(m, "sp") == "dp2xsp4@sp"
+
+    def test_mesh_token_separates_tune_keys(self):
+        g4 = {"op": "rfft", "n": 4096,
+              "mesh": routing.mesh_class(_FakeMesh({"sp": 4}), "sp")}
+        g8 = {"op": "rfft", "n": 4096,
+              "mesh": routing.mesh_class(_FakeMesh({"sp": 8}), "sp")}
+        assert routing.tune_key_str("parallel.fourier", g4) != \
+            routing.tune_key_str("parallel.fourier", g8)
+
+    def test_lookup_distrusts_other_topology_stamp(self, tmp_path):
+        """An entry stamped for another mesh is consulted-not-trusted:
+        counted as mesh_mismatch, served as a miss (the hand-authored
+        pack case where the key itself lacks the mesh token)."""
+        cache = routing.TuneCache(str(tmp_path / "t.json"))
+        cache.store("parallel.fourier", {"n": 4096}, "sharded_matmul_dft",
+                    mesh="sp4@sp")
+        assert cache.lookup("parallel.fourier", {"n": 4096},
+                            mesh="sp4@sp") == "sharded_matmul_dft"
+        assert cache.lookup("parallel.fourier", {"n": 4096},
+                            mesh="sp8@sp") is None
+        info = cache.info()
+        assert info["mesh_mismatch"] == 1
+        # unstamped entries stay accepted (like an unstamped device)
+        cache.store("parallel.fourier", {"n": 512}, "local_fft")
+        assert cache.lookup("parallel.fourier", {"n": 512},
+                            mesh="sp8@sp") == "local_fft"
+
+    def test_store_refuses_cross_mesh_overwrite(self, tmp_path):
+        """A store that would replace an entry stamped for a DIFFERENT
+        topology is refused and counted (mesh_refused) — the save-side
+        twin of save_refused: clobbering another mesh's measured
+        winner would be permanent."""
+        cache = routing.TuneCache(str(tmp_path / "t.json"))
+        cache.store("parallel.fourier", {"n": 4096},
+                    "sharded_matmul_dft", mesh="sp8@sp")
+        cache.store("parallel.fourier", {"n": 4096}, "local_fft",
+                    mesh="sp4@sp")
+        assert cache.info()["mesh_refused"] == 1
+        assert cache.entry("parallel.fourier",
+                           {"n": 4096})["route"] == "sharded_matmul_dft"
+        # same-mesh re-store still updates (fresh measurements win)
+        cache.store("parallel.fourier", {"n": 4096}, "local_fft",
+                    mesh="sp8@sp")
+        assert cache.entry("parallel.fourier",
+                           {"n": 4096})["route"] == "local_fft"
+
+    def test_select_threads_mesh_stamp_through(self, fresh_cache,
+                                               autotune_on):
+        """Family.select(mesh=...) stamps the measured winner's entry
+        and distrusts a cached winner stamped for another mesh."""
+        fam = routing.Family("probe_mesh", (
+            routing.Route("a", predicate=lambda n, **_: True),
+            routing.Route("b"),
+        ))
+        with routing.probe_timer(_fake_timer({"a": 9.0, "b": 1.0})):
+            got = fam.select(runners={"a": lambda: 1, "b": lambda: 1},
+                             mesh="sp8@sp", n=1)
+        assert got == "b"
+        entry = routing.tune_cache().entry("probe_mesh", {"n": 1})
+        assert entry["mesh"] == "sp8@sp"
+        # a different topology refuses the stamped winner: probes anew
+        probes = []
+
+        def counting(thunk, name):
+            probes.append(name)
+            thunk()
+            return {"a": 1.0, "b": 9.0}[name]
+
+        with routing.probe_timer(counting):
+            got4 = fam.select(runners={"a": lambda: 1,
+                                       "b": lambda: 1},
+                              mesh="sp4@sp", n=1)
+        assert probes and got4 == "a"

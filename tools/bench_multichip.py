@@ -1,0 +1,262 @@
+#!/usr/bin/env python
+"""MULTICHIP bench family: the pod-scale Fourier rows.
+
+Measures the ``parallel/fourier.py`` routes on a device mesh —
+``sharded_rfft`` (factorized matmul-DFT vs the local-FFT fallback) and
+``sharded_stft`` at an above-cutoff frame size (the Cooley-Tukey local
+frame transform vs raw ``jnp.fft``) — and writes
+``MULTICHIP_DETAILS.json``: one row per metric with the per-route
+roofline %, the per-stage ``all_to_all`` ICI byte counts, and the
+decision events behind each number, plus a tail entry with the mesh
+shape.  The row format matches ``BENCH_DETAILS.json``, so
+``tools/bench_regress.py --details MULTICHIP_DETAILS.json`` gates the
+trajectory with the same machinery (the ``sharded`` rows ship
+``DEFAULT_NOISE`` thresholds there).
+
+On hosts with fewer devices than requested, a virtual CPU mesh is
+provisioned (``utils.platform.cpu_devices``, the ``dryrun_multichip``
+discipline) — the numbers then validate plumbing, not ICI.
+
+Run:  python tools/bench_multichip.py [--devices 8] [--quick]
+      [--out MULTICHIP_DETAILS.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from veles.simd_tpu import obs
+from veles.simd_tpu.utils.benchmark import (
+    a2a_ici_bytes, device_time, dft_matmul_roofline, host_time,
+    rfft_flops, mxu_f32_bound_tflops, stft_roofline)
+
+
+def _decisions(ops) -> list:
+    """Last event per distinct (op, decision, forced) — a raw tail
+    would be N repeats of whichever route was timed last, evicting
+    the selected route's event (the one carrying ``ici_bytes``)."""
+    last = {}
+    for e in obs.events():
+        if e.get("op") in ops:
+            last[(e.get("op"), e.get("decision"),
+                  e.get("forced"))] = e
+    return [{k: v for k, v in e.items() if v is not None}
+            for e in last.values()]
+
+
+def _fft_roofline(samples_per_s: float, n: int) -> dict:
+    """Local-FFT twin of :func:`dft_matmul_roofline` (split-radix
+    useful-FLOP count against the same MXU bound, so the two routes'
+    %s are comparable on one scale)."""
+    bound = mxu_f32_bound_tflops()
+    eff = rfft_flops(n) / n * samples_per_s / 1e12
+    return {"tflops_effective": eff, "roofline_bound_tflops": bound,
+            "pct_of_roofline": 100.0 * eff / bound,
+            "precision": "highest"}
+
+
+def bench_sharded_rfft(mesh, axis, n, rows_out):
+    """Row 1: sharded_rfft, matmul-DFT vs local FFT on the same
+    geometry.  ``value`` is the ENGINE-SELECTED route's throughput;
+    ``baseline`` the forced local_fft one, so ``vs_baseline`` is the
+    realized pod-scale speedup."""
+    import jax.numpy as jnp
+
+    from veles.simd_tpu.ops import spectral as sp
+    from veles.simd_tpu.parallel import fourier as fr
+
+    s = mesh.shape[axis]
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(n).astype(np.float32))
+    factor = sp.ct_factor(n, multiple=s)
+    sel = fr._select_fourier_route(
+        "rfft", n, s, 1, *(factor or (0, 0)))
+
+    # correctness first: the selected route against the NumPy oracle
+    from veles.simd_tpu.utils.platform import to_host
+    want = np.fft.rfft(np.asarray(x).astype(np.float64))
+    got = to_host(fr.sharded_rfft(x, mesh, axis=axis, route=sel))
+    rel = np.max(np.abs(got - want)) / np.max(np.abs(want))
+    if rel > 1e-4:
+        raise RuntimeError(f"sharded_rfft {sel} rel err {rel:.2e}")
+    print(f"MULTICHIP-CHECK sharded_rfft[{sel}] n={n}: ok "
+          f"(rel {rel:.1e})", file=sys.stderr)
+
+    obs.reset()
+    times = {}
+    for route in dict.fromkeys([sel, "sharded_matmul_dft",
+                                "local_fft"]):
+        if route == "sharded_matmul_dft" and not factor:
+            continue
+        times[route] = device_time(lambda r=route: jnp.abs(
+            fr.sharded_rfft(x, mesh, axis=axis, route=r)).mean())
+    t_base = host_time(
+        lambda: np.fft.rfft(np.asarray(x, np.float64)), repeats=2)
+    decisions = _decisions({"sharded_rfft", "autotune"})
+
+    bytes_a2a = a2a_ici_bytes(n, 8, s)
+    roofs = {}
+    for route, t in times.items():
+        if not np.isfinite(t):
+            continue
+        if route == "sharded_matmul_dft":
+            roofs[route] = dft_matmul_roofline(n / t, *factor)
+        else:
+            roofs[route] = _fft_roofline(n / t, n)
+    row = {
+        "metric": f"sharded rfft {n // 1024}k x{s}",
+        "unit": "Msamples/s",
+        "value": n / times[sel] / 1e6,
+        "baseline": n / times["local_fft"] / 1e6,
+        "vs_baseline": times["local_fft"] / times[sel],
+        "route": sel,
+        "cpu_oracle_msamples_per_s": n / t_base / 1e6,
+        "roofline_routes": roofs,
+        "ici": {"a2a_per_dispatch": 2 if factor else 0,
+                "bytes_per_a2a": bytes_a2a,
+                "total_ici_bytes": 2 * bytes_a2a if factor else 0,
+                "n1": factor[0] if factor else 0,
+                "n2": factor[1] if factor else 0},
+        "decisions": decisions[-8:],
+    }
+    rows_out.append(row)
+    print(f"MULTICHIP sharded_rfft[{sel}]: "
+          f"{row['value']:.1f} Ms/s vs local_fft "
+          f"{row['baseline']:.1f} Ms/s ({row['vs_baseline']:.2f}x), "
+          f"{2 * bytes_a2a / 1e6:.1f} MB ICI/dispatch",
+          file=sys.stderr)
+
+
+def bench_sharded_stft_above_cutoff(mesh, axis, n, frame, hop,
+                                    rows_out):
+    """Row 2: sharded_stft at a frame size past the single-chip matmul
+    cutoff — the local per-frame transform is the engine-selected
+    Cooley-Tukey matmul (``ct_matmul``); baseline forces the raw
+    ``jnp.fft`` body via the family opt-out env."""
+    import jax.numpy as jnp
+
+    from veles.simd_tpu import parallel as par
+    from veles.simd_tpu.ops import spectral as sp
+    from veles.simd_tpu.parallel import fourier as fr
+
+    s = mesh.shape[axis]
+    rng = np.random.RandomState(8)
+    x = jnp.asarray(rng.randn(n).astype(np.float32))
+    sel = fr.select_frame_route(frame)
+    frames = sp.frame_count(n, frame, hop)
+
+    obs.reset()
+
+    def run():
+        return jnp.abs(par.sharded_stft(x, frame, hop, mesh,
+                                        axis=axis)).mean()
+
+    t_sel = device_time(run)
+    decisions = _decisions({"sharded_stft_local", "sharded_stft"})
+    env = sp._DFT_MATMUL_ENV
+    prev = os.environ.get(env)
+    os.environ[env] = "1"
+    try:
+        fft_route = fr.select_frame_route(frame)
+        t_fft = device_time(run)
+    finally:
+        if prev is None:
+            os.environ.pop(env, None)
+        else:
+            os.environ[env] = prev
+
+    roofs = {}
+    if np.isfinite(t_sel):
+        if sel == "ct_matmul":
+            n1, n2 = sp.ct_factor(frame)
+            roofs[sel] = dft_matmul_roofline(frames * frame / t_sel,
+                                             n1, n2)
+        else:
+            roofs[sel] = stft_roofline(frames / t_sel, frame,
+                                       route=sel)
+    if np.isfinite(t_fft):
+        roofs[fft_route] = _fft_roofline(frames * frame / t_fft,
+                                         frame)
+    row = {
+        "metric": f"sharded stft {frame}/{hop} x{s} above-cutoff",
+        "unit": "Msamples/s",
+        "value": n / t_sel / 1e6,
+        "baseline": n / t_fft / 1e6,
+        "vs_baseline": t_fft / t_sel,
+        "route": sel,
+        "roofline_routes": roofs,
+        # the sharded STFT's collective is the halo ppermute, not an
+        # all_to_all: the ICI entry records that the frame transform
+        # itself is collective-free (frames are shard-local)
+        "ici": {"a2a_per_dispatch": 0, "bytes_per_a2a": 0,
+                "halo_bytes": 4 * (frame - hop) * s},
+        "decisions": decisions[-8:],
+    }
+    rows_out.append(row)
+    print(f"MULTICHIP sharded_stft[{sel}] frame={frame}: "
+          f"{row['value']:.1f} Ms/s vs {fft_route} "
+          f"{row['baseline']:.1f} Ms/s ({row['vs_baseline']:.2f}x)",
+          file=sys.stderr)
+
+
+def run_bench(n_devices: int, out_path: str, quick: bool) -> int:
+    import jax
+
+    from veles.simd_tpu import parallel as par
+    from veles.simd_tpu.utils.platform import cpu_devices
+
+    obs.enable()
+    obs.reset()
+    rows: list = []
+    with cpu_devices(n_devices) as devices:
+        mesh = par.make_mesh({"sp": len(devices)}, devices=devices)
+        s = len(devices)
+        n_rfft = (1 << 14) if quick else (1 << 18)
+        bench_sharded_rfft(mesh, "sp", n_rfft, rows)
+        frame = 8192
+        hop = 2048
+        n_stft = max(s * 16384, frame * 2) if quick else s * 65536
+        bench_sharded_stft_above_cutoff(mesh, "sp", n_stft, frame,
+                                        hop, rows)
+        tail = {"n_devices": s,
+                "mesh": {k: int(v) for k, v in mesh.shape.items()},
+                "device": str(devices[0])}
+    for r in rows:
+        # unresolved timers yield NaN; null the numbers (strict JSON)
+        # and flag the row, the BENCH_DETAILS discipline
+        if not all(isinstance(r.get(k), (int, float))
+                   and np.isfinite(r[k])
+                   for k in ("value", "baseline", "vs_baseline")):
+            r["flagged"] = "unresolved measurement"
+            for k in ("value", "baseline", "vs_baseline"):
+                if isinstance(r.get(k), float) \
+                        and not np.isfinite(r[k]):
+                    r[k] = None
+    with open(out_path, "w") as f:
+        json.dump(rows + [tail], f, indent=2, allow_nan=False)
+    print(f"wrote {out_path} ({len(rows)} rows)", file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--out", default="MULTICHIP_DETAILS.json")
+    ap.add_argument("--quick", action="store_true",
+                    help="small shapes (CI smoke)")
+    args = ap.parse_args(argv)
+    from veles.simd_tpu.utils.platform import maybe_override_platform
+
+    maybe_override_platform()
+    return run_bench(args.devices, args.out, args.quick)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,12 @@ DEFAULT_NOISE = [
     # throughput measured in the same stage (not the CPU oracle), and
     # both sides carry probe/chained-timing noise
     ("autotuned", 0.15),
+    # the MULTICHIP family (tools/bench_multichip.py --details
+    # MULTICHIP_DETAILS.json): collective-heavy device-time rows whose
+    # jitter includes ICI/host contention on shared pods; the
+    # above-cutoff stft row divides two burst measurements
+    ("sharded rfft", 0.25),
+    ("sharded stft", 0.30),
 ]
 
 

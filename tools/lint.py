@@ -242,7 +242,9 @@ def fault_handler_errors(tree, fname) -> list:
 # (``import ... as rt`` cannot dodge it).
 
 _ROUTING_MOD = "veles.simd_tpu.runtime.routing"
-_SELECTOR_PREFIXES = ("_use_", "_select_", "select_algorithm")
+# "select_" covers the sharded selectors in parallel/ (public
+# select_frame_route-style names) as well as ops/'s select_algorithm*
+_SELECTOR_PREFIXES = ("_use_", "_select_", "select_")
 
 
 def _routing_aliases(tree) -> tuple:
@@ -337,18 +339,22 @@ def routing_selector_errors(tree, fname) -> list:
     return errors
 
 
-# --- spectral route-dispatch rule ------------------------------------------
+# --- route-dispatch rule (spectral + parallel/fourier) ---------------------
 # ops/spectral.py's route tables (``_STFT_ROUTES`` / ``_ISTFT_ROUTES``)
-# are the template the next routed op family copies.  Two structural
-# invariants the obs layer depends on are pinned here: every
-# route-table entry resolves to a module-level runner whose body
-# reaches an ``obs.instrumented_jit``-compiled core (directly, or via
-# the pallas kernel module whose cores are instrumented in place) —
-# a route compiled any other way is invisible to the resource axis —
-# and every public dispatcher that indexes a route table does so
-# inside a ``with obs.span(...)`` scope, so the time axis sees it.
+# are the template the next routed op family copies — and
+# parallel/fourier.py IS that next family (the pod-scale DFT routes).
+# Two structural invariants the obs layer depends on are pinned here:
+# every route-table entry resolves to a module-level runner whose body
+# reaches an ``obs.instrumented_jit``-compiled core (directly, via the
+# pallas kernel module whose cores are instrumented in place, or
+# transitively through module-level helpers — the ``_instrumented``
+# shard_map wrapper convention in parallel/) — a route compiled any
+# other way is invisible to the resource axis — and every public
+# dispatcher that indexes a route table does so inside a ``with
+# obs.span(...)`` scope, so the time axis sees it.
 
-_SPECTRAL_RULE_FILE = "veles/simd_tpu/ops/spectral.py"
+_DISPATCH_RULE_FILES = ("veles/simd_tpu/ops/spectral.py",
+                        "veles/simd_tpu/parallel/fourier.py")
 
 
 def _is_instrumented_decorator(dec) -> bool:
@@ -378,6 +384,26 @@ def spectral_dispatch_errors(tree, fname) -> list:
             if any(_is_instrumented_decorator(d)
                    for d in node.decorator_list):
                 instrumented.add(node.name)
+            elif any(isinstance(n, ast.Attribute)
+                     and n.attr == "instrumented_jit"
+                     for n in ast.walk(node)):
+                # a helper that CALLS obs.instrumented_jit in its body
+                # (the parallel/ ``_instrumented`` shard_map wrapper)
+                instrumented.add(node.name)
+    # transitive closure: a runner that reaches an instrumented core
+    # through a module-level helper chain (_run_x -> _ct_sharded ->
+    # _instrumented) still lands in the resource axis
+    changed = True
+    while changed:
+        changed = False
+        for name, fn in funcs.items():
+            if name in instrumented:
+                continue
+            names = {n.id for n in ast.walk(fn)
+                     if isinstance(n, ast.Name)}
+            if names & instrumented:
+                instrumented.add(name)
+                changed = True
     tables = {
         node.targets[0].id: node
         for node in tree.body
@@ -463,7 +489,7 @@ def compute_module_lint(files) -> int:
             print(f"{f}:{e.lineno}: syntax error: {e.msg}")
             failures += 1
             continue
-        if rel == _SPECTRAL_RULE_FILE:
+        if rel in _DISPATCH_RULE_FILES:
             for msg in spectral_dispatch_errors(tree, str(f)):
                 print(msg)
                 failures += 1

@@ -71,7 +71,9 @@ __all__ = [
     "detrend", "detrend_na", "welch", "welch_na", "periodogram",
     "periodogram_na", "csd", "csd_na", "coherence", "coherence_na",
     "czt", "czt_na", "zoom_fft", "lombscargle",
-    "lombscargle_na",
+    "lombscargle_na", "ct_factor", "ct_apply", "ct_basis_parts",
+    "ct_basis_device", "dft_basis_parts", "twiddle_parts",
+    "hermitian_extend",
 ]
 
 
@@ -364,6 +366,176 @@ def _rdft_inv_basis(frame_length: int, window) -> np.ndarray:
     return _cached_host(key, build)
 
 
+# ---------------------------------------------------------------------------
+# Cooley-Tukey factorized matmul DFT (the pod-scale Fourier building
+# blocks: per-factor DFT bases + twiddles, shared by the local
+# ``ct_matmul`` routes here and the sharded stages in
+# ``parallel/fourier.py`` — arXiv:2002.03260's formulation)
+# ---------------------------------------------------------------------------
+
+
+def ct_factor(n: int, max_factor: int | None = None,
+              multiple: int = 1):
+    """Balanced Cooley-Tukey split ``n = n1 * n2`` with both factors
+    ``<= max_factor`` (default :data:`AUTO_DFT_MATMUL_MAX_FRAME`, the
+    basis-residency bound) and both divisible by ``multiple`` (the
+    sharded stages need each factor to split over the mesh axis for
+    the ``all_to_all`` transposes).  Returns ``(n1, n2)`` with
+    ``n1 >= n2`` minimizing ``max(n1, n2)``, or ``None`` when no such
+    factorization exists (prime ``n``, or ``n`` too large for the
+    factor bound)."""
+    n = int(n)
+    if max_factor is None:
+        max_factor = AUTO_DFT_MATMUL_MAX_FRAME
+    multiple = max(1, int(multiple))
+    if n < 4:
+        return None
+    best = None
+    d = 1
+    while d * d <= n:
+        if n % d == 0:
+            for n2 in (d, n // d):
+                n1 = n // n2
+                if n1 < n2:
+                    continue
+                if n1 > max_factor or n2 < 2:
+                    continue
+                if n1 % multiple or n2 % multiple:
+                    continue
+                if best is None or n1 < best[0]:
+                    best = (n1, n2)
+        d += 1
+    return best
+
+
+def dft_basis_parts(n: int):
+    """Host-cached ``(cos, sin)`` float32 ``[n, n]`` pair of the dense
+    DFT basis angles ``2 pi j k / n`` — the forward basis is
+    ``cos - i sin``, the inverse ``(cos + i sin) / n``; keeping the
+    parts REAL means no complex buffer ever crosses the host/device
+    boundary (the axon relay cannot transfer complex either way)."""
+    n = int(n)
+
+    def build():
+        j = np.arange(n, dtype=np.float64)
+        ang = 2.0 * np.pi * np.outer(j, j) / n
+        return (np.cos(ang).astype(np.float32),
+                np.sin(ang).astype(np.float32))
+
+    return _cached_host(("dft_parts", n), build)
+
+
+def twiddle_parts(n1: int, n2: int):
+    """Host-cached ``(cos, sin)`` float32 ``[n2, n1]`` twiddle grid
+    ``2 pi k2 n1_idx / (n1 n2)`` — the inter-stage factor of the
+    ``n = n1 * n2`` Cooley-Tukey factorization (row = stage-1 output
+    index, column = the other factor's index)."""
+    n1, n2 = int(n1), int(n2)
+
+    def build():
+        ang = (2.0 * np.pi / (n1 * n2)
+               * np.outer(np.arange(n2, dtype=np.float64),
+                          np.arange(n1, dtype=np.float64)))
+        return (np.cos(ang).astype(np.float32),
+                np.sin(ang).astype(np.float32))
+
+    return _cached_host(("twiddle", n1, n2), build)
+
+
+def ct_basis_parts(n1: int, n2: int):
+    """The full 6-tuple of float32 constants one ``n = n1 * n2``
+    factorized DFT needs: ``(cos2, sin2, cos1, sin1, twc, tws)`` —
+    stage bases ``[n2, n2]`` / ``[n1, n1]`` plus the ``[n2, n1]``
+    twiddle grid.  Serves forward AND inverse (the inverse swaps the
+    stage roles and flips the sign — :func:`ct_apply`)."""
+    c2, s2 = dft_basis_parts(n2)
+    c1, s1 = dft_basis_parts(n1)
+    twc, tws = twiddle_parts(n1, n2)
+    return c2, s2, c1, s1, twc, tws
+
+
+def ct_basis_device(n1: int, n2: int):
+    """Device-cached upload of :func:`ct_basis_parts` (same dedup
+    discipline as the rdft bases: host LRU for construction, device
+    LRU for the upload)."""
+    key = ("ct_basis", int(n1), int(n2))
+    return _cached_device(
+        key, lambda: tuple(jnp.asarray(a)
+                           for a in ct_basis_parts(n1, n2)))
+
+
+def _ct_stage(vre, vim, cos, sin, sign, axis_spec):
+    """One DFT stage as real matmuls: contract ``vre/vim`` with the
+    ``cos + i * sign * sin`` basis along the axis named by
+    ``axis_spec`` (an einsum triple).  ``vim=None`` means real input
+    (stage 1 of a forward rfft: two matmuls instead of four)."""
+    hi = jax.lax.Precision.HIGHEST
+    e = functools.partial(jnp.einsum, axis_spec, precision=hi)
+    if vim is None:
+        return e(vre, cos), sign * e(vre, sin)
+    return (e(vre, cos) - sign * e(vim, sin),
+            sign * e(vre, sin) + e(vim, cos))
+
+
+def ct_apply(x, n1: int, n2: int, parts, inverse: bool = False):
+    """Traceable length-``n1*n2`` Cooley-Tukey DFT along the LAST axis
+    as two dense MXU matmul stages + a twiddle multiply — the
+    single-chip form of the pod-scale factorization (no collectives;
+    the sharded twin lives in ``parallel/fourier.py``).  ``x`` real or
+    complex; ``parts`` from :func:`ct_basis_device` (or host parts
+    uploaded by the caller).  Returns ``(re, im)`` float32 arrays —
+    callers build complex64 (or take ``re`` for an inverse of a
+    Hermitian spectrum) themselves, so no complex constant is ever
+    materialized on the host side."""
+    c2, s2, c1, s1, twc, tws = parts
+    n1, n2 = int(n1), int(n2)
+    sign = 1.0 if inverse else -1.0
+    if jnp.iscomplexobj(x):
+        xre, xim = jnp.real(x), jnp.imag(x)
+    else:
+        xre, xim = x, None
+    if inverse:
+        # inverse = the same pipeline with stage roles swapped
+        # (input viewed [n1, n2], stage 1 over the n1 axis) and the
+        # twiddle grid transposed; 1/n fold applied at the end
+        ga, gb = n1, n2
+        ca, sa, cb, sb = c1, s1, c2, s2
+        twc_g, tws_g = twc.T, tws.T
+    else:
+        ga, gb = n2, n1
+        ca, sa, cb, sb = c2, s2, c1, s1
+        twc_g, tws_g = twc, tws
+    vre = xre.reshape(xre.shape[:-1] + (ga, gb))
+    vim = xim.reshape(xim.shape[:-1] + (ga, gb)) if xim is not None \
+        else None
+    # stage 1: length-ga DFT down the -2 axis
+    yre, yim = _ct_stage(vre, vim, ca, sa, sign, "...gf,gh->...hf")
+    # twiddle: elementwise [ga, gb] grid
+    tre, tim = twc_g, sign * tws_g
+    zre = yre * tre - yim * tim
+    zim = yre * tim + yim * tre
+    # stage 2: length-gb DFT along the last axis
+    wre, wim = _ct_stage(zre, zim, cb, sb, sign, "...hf,fk->...hk")
+    # natural order: out[k_b * ga + k_a] = w[k_a, k_b]
+    wre = jnp.swapaxes(wre, -1, -2).reshape(xre.shape[:-1]
+                                            + (ga * gb,))
+    wim = jnp.swapaxes(wim, -1, -2).reshape(xre.shape[:-1]
+                                            + (ga * gb,))
+    if inverse:
+        scale = np.float32(1.0 / (n1 * n2))
+        return wre * scale, wim * scale
+    return wre, wim
+
+
+def hermitian_extend(spec, n: int):
+    """Full length-``n`` spectrum from the one-sided ``n//2 + 1`` bins
+    of a real signal (``X[k] = conj(X[n-k])``) — the irfft front half
+    the ct_matmul inverse routes share."""
+    bins = n // 2 + 1
+    tail = jnp.conj(spec[..., 1:n - bins + 1])[..., ::-1]
+    return jnp.concatenate([spec, tail], axis=-1)
+
+
 @functools.partial(obs.instrumented_jit, op="stft", route="xla_fft",
                    static_argnames=("frame_length", "hop"))
 def _stft_xla(x, window, frame_length, hop):
@@ -457,6 +629,15 @@ _CWT_FAMILY = routing.family("morlet_cwt", (
         predicate=lambda n, **_: n <= CWT_MATMUL_MAX_N,
         disable_env=_DFT_MATMUL_ENV,
         doc="positive-frequency DFT basis pair as dense MXU matmuls"),
+    routing.Route(
+        "ct_matmul",
+        predicate=lambda n, **_: (n > CWT_MATMUL_MAX_N
+                                  and ct_factor(n) is not None),
+        disable_env=_DFT_MATMUL_ENV,
+        doc="Cooley-Tukey factorized matmul DFT (two per-factor MXU "
+            "stages + twiddle) — the pod-scale formulation's "
+            "single-chip form, for transform sizes past the dense "
+            "basis-residency cutoff"),
     routing.Route("xla_fft", doc="batched fft -> bank -> ifft"),
 ))
 
@@ -1070,7 +1251,30 @@ def _run_cwt_xla(x, hat):
                     to_device(hat, jnp.complex64))
 
 
-_CWT_ROUTES = {"matmul_dft": _run_cwt_matmul, "xla_fft": _run_cwt_xla}
+@functools.partial(obs.instrumented_jit, op="morlet_cwt",
+                   route="ct_matmul",
+                   static_argnames=("n1", "n2"))
+def _cwt_ct(x, hat, c2, s2, c1, s1, twc, tws, n1, n2):
+    parts = (c2, s2, c1, s1, twc, tws)
+    fre, fim = ct_apply(x, n1, n2, parts)
+    spec = jax.lax.complex(fre, fim)
+    prod = spec[..., None, :] * hat          # hat real [S, n]
+    re, im = ct_apply(prod, n1, n2, parts, inverse=True)
+    return jax.lax.complex(re, im)
+
+
+def _run_cwt_ct(x, hat):
+    n = np.shape(x)[-1]
+    n1, n2 = ct_factor(n)
+    parts = ct_basis_device(n1, n2)
+    return _cwt_ct(jnp.asarray(x, jnp.float32),
+                   jnp.asarray(np.asarray(hat, np.float32)),
+                   *parts, n1, n2)
+
+
+_CWT_ROUTES = {"matmul_dft": _run_cwt_matmul,
+               "ct_matmul": _run_cwt_ct,
+               "xla_fft": _run_cwt_xla}
 
 
 def morlet_cwt(x, scales, w0: float = 6.0, simd=None, route=None):
@@ -1081,9 +1285,11 @@ def morlet_cwt(x, scales, w0: float = 6.0, simd=None, route=None):
     whole scale bank is one batched ``fft -> multiply -> ifft``; the
     ``[S, n]`` wavelet bank is a host-side constant.  Short signals
     (``n <= CWT_MATMUL_MAX_N``) route through the positive-frequency
-    DFT basis pair as dense MXU matmuls (``matmul_dft``) — which also
-    moves no complex buffers through the relay; ``route`` forces
-    either path.
+    DFT basis pair as dense MXU matmuls (``matmul_dft``); longer
+    factorizable ``n`` ride the Cooley-Tukey factorized matmul DFT
+    (``ct_matmul``, two per-factor MXU stages + twiddle — the
+    pod-scale formulation's single-chip form).  Neither moves complex
+    buffers through the relay; ``route`` forces any path.
     """
     scales = np.atleast_1d(np.asarray(scales, np.float64))
     if scales.ndim != 1 or len(scales) == 0 or np.any(scales <= 0):
@@ -1095,8 +1301,12 @@ def morlet_cwt(x, scales, w0: float = 6.0, simd=None, route=None):
         forced = route is not None
         if forced and route not in _CWT_ROUTES:
             raise ValueError(
-                f"route must be 'matmul_dft' or 'xla_fft', got "
-                f"{route!r}")
+                f"route must be one of {sorted(_CWT_ROUTES)}, "
+                f"got {route!r}")
+        if forced and route == "ct_matmul" and ct_factor(n) is None:
+            raise ValueError(
+                f"n={n} has no Cooley-Tukey split with both factors "
+                f"<= {AUTO_DFT_MATMUL_MAX_FRAME}")
         if forced:
             chosen = route
         else:

@@ -66,6 +66,14 @@ scale across ICI — XLA collectives instead of any message-passing runtime.
 * :func:`sharded_swt_apply2d` / :func:`sharded_wavelet_packet_transform2d`
   — the all-to-all transpose family extended to the undecimated 2D SWT
   and the 2D quad-tree packets (device-resident end to end).
+* :func:`sharded_dft` / :func:`sharded_rfft` / :func:`sharded_irfft` —
+  **pod-scale Fourier** (:mod:`~veles.simd_tpu.parallel.fourier`): the
+  Cooley-Tukey ``N = N1*N2`` factorization as per-factor DFT-basis
+  matmuls on the MXU with tiled ``all_to_all`` transposes between
+  stages (arXiv:2002.03260), mesh-aware route selection (ICI bytes in
+  the selector and the decision events) against the local-FFT
+  fallback; the sharded STFT/ISTFT/Welch bodies ride the same
+  engine's ``parallel.frame_dft`` table for their local transforms.
 * :func:`sharded_matmul` — **tensor-parallel** GEMM: contracting dimension
   sharded (zero-padded to the axis size), partials combined with ``psum``
   over ICI.
@@ -83,6 +91,8 @@ identical code lays the collectives onto ICI.
 """
 
 from veles.simd_tpu.parallel import distributed
+from veles.simd_tpu.parallel.fourier import (
+    sharded_dft, sharded_irfft, sharded_rfft)
 from veles.simd_tpu.parallel.mesh import default_mesh, make_mesh
 from veles.simd_tpu.parallel.ops import (
     data_parallel, halo_exchange_left, halo_exchange_right,
@@ -116,5 +126,6 @@ __all__ = ["make_mesh", "default_mesh", "sharded_convolve",
            "sharded_stft", "sharded_istft", "sharded_sosfilt",
            "sharded_welch", "sharded_resample_poly",
            "sharded_normalize2d",
+           "sharded_dft", "sharded_rfft", "sharded_irfft",
            "data_parallel", "halo_exchange_left", "halo_exchange_right",
            "distributed"]

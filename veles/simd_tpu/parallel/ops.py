@@ -969,6 +969,7 @@ def sharded_stft(x, frame_length: int, hop: int, mesh: Mesh,
     are sliced off the sharded result before returning.
     """
     from veles.simd_tpu.ops import spectral as sp
+    from veles.simd_tpu.parallel import fourier as _fr
 
     x = jnp.asarray(x, jnp.float32)
     n = x.shape[-1]
@@ -978,7 +979,17 @@ def sharded_stft(x, frame_length: int, hop: int, mesh: Mesh,
         "sharded_stft", "right_halo", n_shards=int(n_shards), axis=axis,
         n=int(n), frame_length=int(frame_length), hop=int(hop),
         block=int(block), halo=int(halo))
-    window = jnp.asarray(sp._resolve_window(window, frame_length))
+    window_np = sp._resolve_window(window, frame_length)
+    # the LOCAL per-frame transform goes through the engine's
+    # parallel.frame_dft table (never raw jnp.fft): the rdft-basis
+    # matmul within the single-chip cutoff, the Cooley-Tukey
+    # factorized matmul above it, xla_fft terminal — recorded either
+    # way so the executed formulation is artifact-attributable
+    local_route = _fr.select_frame_route(frame_length)
+    obs.record_decision(
+        "sharded_stft_local", local_route, n_shards=int(n_shards),
+        frame_length=int(frame_length), hop=int(hop))
+    frame_fn = _fr.frame_rfft_fn(local_route, frame_length, window_np)
     # per-shard framing layout == the single-chip layout on block + halo
     # samples (frame_count(block + halo, fl, hop) == block // hop)
     frames_local = sp.frame_count(block + halo, frame_length, hop)
@@ -994,8 +1005,7 @@ def sharded_stft(x, frame_length: int, hop: int, mesh: Mesh,
         # dividing hops, sp._take_frames); slice to the uniform
         # per-shard frame count the layout math above established
         frames = sp._take_frames(x_ext, frame_length, hop)
-        frames = frames[..., :frames_local, :] * window
-        return jnp.fft.rfft(frames, axis=-1)
+        return frame_fn(frames[..., :frames_local, :])
 
     with obs.span("sharded_stft.dispatch", n_shards=int(n_shards)):
         out = _instrumented("sharded_stft", _run)(x)
@@ -1016,10 +1026,19 @@ def sharded_istft(spec, n: int, frame_length: int, hop: int, mesh: Mesh,
     :func:`veles.simd_tpu.ops.spectral.istft`.
     """
     from veles.simd_tpu.ops import spectral as sp
+    from veles.simd_tpu.parallel import fourier as _fr
 
     n_shards = mesh.shape[axis]
     block, halo = _check_stft_sharding(n, frame_length, hop, n_shards)
     window_np = sp._resolve_window(window, frame_length)
+    # engine-selected local synthesis transform (inverse rdft basis
+    # within the cutoff / Cooley-Tukey above it / xla irfft terminal)
+    local_route = _fr.select_frame_route(frame_length)
+    obs.record_decision(
+        "sharded_istft_local", local_route, n_shards=int(n_shards),
+        frame_length=int(frame_length), hop=int(hop))
+    frame_fn = _fr.frame_irfft_fn(local_route, frame_length,
+                                  window_np)
     spec = jnp.asarray(spec, jnp.complex64)
     frames_total = sp.frame_count(n, frame_length, hop)
     if spec.shape[-2:] != (frames_total, frame_length // 2 + 1):
@@ -1034,15 +1053,13 @@ def sharded_istft(spec, n: int, frame_length: int, hop: int, mesh: Mesh,
     if pad_frames:
         spec = jnp.pad(spec, [(0, 0)] * (spec.ndim - 2)
                        + [(0, pad_frames), (0, 0)])
-    window_j = jnp.asarray(window_np)
     in_spec = P(*([None] * (spec.ndim - 2) + [axis, None]))
     out_spec = P(*([None] * (spec.ndim - 2) + [axis]))
 
     @functools.partial(shard_map, mesh=mesh,
                        in_specs=in_spec, out_specs=out_spec)
     def _run(spec_local):
-        frames = jnp.fft.irfft(spec_local, frame_length,
-                               axis=-1) * window_j
+        frames = frame_fn(spec_local)
         # the decomposed overlap-add (sp._overlap_add, 52x over the
         # .at[].add scatter on dividing hops) on the local block+halo
         buf = sp._overlap_add(frames, block + halo, frame_length, hop)
@@ -1163,6 +1180,7 @@ def sharded_welch(x, mesh: Mesh, axis: str = "sp", fs: float = 1.0,
     Returns ``(freqs, Pxx)`` with ``Pxx`` replicated over the mesh.
     """
     from veles.simd_tpu.ops import spectral as sp
+    from veles.simd_tpu.parallel import fourier as _fr
 
     x = jnp.asarray(x, jnp.float32)
     n = x.shape[-1]
@@ -1172,11 +1190,18 @@ def sharded_welch(x, mesh: Mesh, axis: str = "sp", fs: float = 1.0,
     block, halo = _check_stft_sharding(n, nperseg_c, hop, n_shards)
     frames_total = sp.frame_count(n, nperseg_c, hop)
     frames_per_shard = block // hop
+    # engine-selected local segment transform (parallel.frame_dft,
+    # window folded into the basis on the rdft route)
+    local_route = _fr.select_frame_route(nperseg_c)
+    obs.record_decision(
+        "sharded_welch_local", local_route, n_shards=int(n_shards),
+        nperseg=int(nperseg_c), hop=int(hop))
+    frame_fn = _fr.frame_rfft_fn(
+        local_route, nperseg_c, np.asarray(window_np, np.float32))
     scale_mult = jnp.asarray(
         sp._onesided_scale(nperseg_c, fs, window_np, "density"),
         jnp.float32)
     freqs = np.fft.rfftfreq(nperseg_c, 1.0 / fs)
-    window_j = jnp.asarray(window_np, jnp.float32)
     in_spec = P(*([None] * (x.ndim - 1) + [axis]))
     out_spec = P(*([None] * (x.ndim - 1) + [None]))
 
@@ -1188,7 +1213,7 @@ def sharded_welch(x, mesh: Mesh, axis: str = "sp", fs: float = 1.0,
         segs = sp._take_frames(x_ext, nperseg_c,
                                hop)[..., :frames_per_shard, :]
         segs = segs - jnp.mean(segs, axis=-1, keepdims=True)
-        fx = jnp.fft.rfft(segs * window_j, axis=-1)
+        fx = frame_fn(segs)
         # mask the trailing frames that overhang the global signal end
         # (they exist only so every shard has a uniform frame count)
         gidx = (jax.lax.axis_index(axis) * frames_per_shard

@@ -82,8 +82,8 @@ __all__ = [
     "AUTOTUNE_ITERS_ENV", "AUTOTUNE_MODES", "DEFAULT_PROBE_ITERS",
     "TUNE_CACHE_VERSION", "TUNE_CACHE_MAX_ENTRIES", "TuneCache",
     "tune_cache", "set_cache_path", "private_tune_cache",
-    "tune_key_str", "pow2_bucket", "device_kind", "env_truthy",
-    "set_probe_timer", "probe_timer",
+    "tune_key_str", "pow2_bucket", "mesh_class", "device_kind",
+    "env_truthy", "set_probe_timer", "probe_timer",
 ]
 
 AUTOTUNE_ENV = "VELES_SIMD_AUTOTUNE"
@@ -291,6 +291,22 @@ def pow2_bucket(v: int) -> int:
     return 1 << (v - 1).bit_length()
 
 
+def mesh_class(mesh, axis: str | None = None) -> str:
+    """Canonical ``(mesh_shape, axis_names)`` token for a
+    ``jax.sharding.Mesh`` (duck-typed: anything with ``.shape`` as a
+    name->size mapping), e.g. ``"dp2xsp4@sp"`` — the collective axis
+    appended when given.
+
+    The ``parallel/`` families put this in their tune-class geometry
+    AND stamp it into every tune-cache entry: a route winner measured
+    on a 4-chip mesh moves different ICI bytes per ``all_to_all`` than
+    the same geometry on 8 chips, so a pack built on one topology must
+    never silently steer another (the device-stamp argument, one level
+    up)."""
+    body = "x".join(f"{k}{int(v)}" for k, v in dict(mesh.shape).items())
+    return f"{body}@{axis}" if axis else body
+
+
 def tune_key_str(fam: str, geom: dict) -> str:
     """Canonical geometry-class key: ``family|k=v,k=v`` over the sorted
     geometry fields.  The single format the online tuner, the sweep
@@ -333,7 +349,8 @@ class TuneCache:
         self._stats = {"hits": 0, "misses": 0, "stores": 0,
                        "evictions": 0, "load_errors": 0,
                        "version_mismatch": 0, "device_mismatch": 0,
-                       "persist_errors": 0, "save_refused": 0}
+                       "persist_errors": 0, "save_refused": 0,
+                       "mesh_mismatch": 0, "mesh_refused": 0}
         self._next_load_retry = 0.0
 
     @property
@@ -389,14 +406,29 @@ class TuneCache:
         elif loaded != "missing":
             self._stats[loaded] += 1
 
-    def lookup(self, fam: str, geom: dict) -> str | None:
+    def lookup(self, fam: str, geom: dict,
+               mesh: str | None = None) -> str | None:
         """The cached winner route for a geometry class, or None.
-        Counts a hit/miss either way."""
+        Counts a hit/miss either way.
+
+        ``mesh`` (a :func:`mesh_class` token, for ``parallel/``
+        families) is checked against the entry's mesh stamp: an entry
+        measured on a DIFFERENT topology is consulted-not-trusted —
+        counted as ``mesh_mismatch`` and treated as a miss, so a
+        4-chip winner never steers an 8-chip dispatch even when the
+        geometry key itself failed to capture the mesh (hand-authored
+        packs).  An unstamped entry is accepted, like an unstamped
+        device."""
         key = tune_key_str(fam, geom)
         with self._lock:
             self._ensure_loaded_locked()
             entry = self._entries.get(key)
             if entry is None:
+                self._stats["misses"] += 1
+                return None
+            stamp = entry.get("mesh")
+            if mesh is not None and stamp is not None and stamp != mesh:
+                self._stats["mesh_mismatch"] += 1
                 self._stats["misses"] += 1
                 return None
             self._stats["hits"] += 1
@@ -413,18 +445,35 @@ class TuneCache:
 
     def store(self, fam: str, geom: dict, route: str,
               timings_us: dict | None = None,
-              source: str = "measured") -> str:
+              source: str = "measured",
+              mesh: str | None = None) -> str:
         """Record a winner and write through to disk when a path is
-        bound.  Returns the entry key."""
+        bound.  Returns the entry key.
+
+        ``mesh`` stamps the entry with the topology it was measured on
+        (:func:`mesh_class`).  A store that would REPLACE an entry
+        stamped for a different topology is refused and counted
+        (``mesh_refused``, the save-side twin of ``save_refused``):
+        the collision means the geometry key failed to separate the
+        topologies (a hand-authored pack), and clobbering the other
+        mesh's measured winner would be permanent."""
         key = tune_key_str(fam, geom)
         entry = {"route": str(route), "source": str(source),
                  "unix": time.time()}
+        if mesh is not None:
+            entry["mesh"] = str(mesh)
         if timings_us:
             entry["timings_us"] = {str(k): (round(float(v), 1)
                                             if v is not None else None)
                                    for k, v in timings_us.items()}
         with self._lock:
             self._ensure_loaded_locked()
+            existing = self._entries.get(key)
+            if (existing is not None and mesh is not None
+                    and existing.get("mesh") is not None
+                    and existing["mesh"] != mesh):
+                self._stats["mesh_refused"] += 1
+                return key
             self._entries.pop(key, None)
             self._entries[key] = entry       # fresh "unix" = recency
             self._stats["stores"] += 1
@@ -709,7 +758,7 @@ class Family:
     # -- selection (static prior + measured autotune) -----------------------
 
     def select(self, eligible=None, runners=None, probe_operand=None,
-               tune_geom=None, **geom) -> str:
+               tune_geom=None, mesh=None, **geom) -> str:
         """Pick the route to dispatch.
 
         ``eligible`` (optional) is a priority-ordered candidate list
@@ -727,6 +776,12 @@ class Family:
         a winner measured that way must never persist).  Without
         runners the measured mode cannot probe and behaves like
         ``readonly``.
+
+        ``mesh`` (optional, a :func:`mesh_class` token) is the
+        topology stamp for ``parallel/`` families: lookups distrust
+        entries stamped for another topology (``mesh_mismatch``) and
+        the measured winner is stored with the stamp — belt and
+        suspenders next to putting the token in the tune class itself.
 
         ``tune_geom`` (optional) is the geometry CLASS that keys the
         tune cache when it must differ from ``geom``: a family whose
@@ -764,7 +819,7 @@ class Family:
                 # bypass it and leave the demote path unexercised
                 return static
         cache = tune_cache()
-        cached = cache.lookup(self.name, tune_geom)
+        cached = cache.lookup(self.name, tune_geom, mesh=mesh)
         if cached is not None and cached in eligible:
             obs.count("autotune_cache_hit", family=self.name)
             return cached
@@ -785,10 +840,11 @@ class Family:
             runners = runners()
         if not runners:
             return static
-        return self._measure(eligible, runners, static, geom, tune_geom)
+        return self._measure(eligible, runners, static, geom,
+                             tune_geom, mesh=mesh)
 
     def _measure(self, eligible, runners, static: str, geom,
-                 tune_geom=None) -> str:
+                 tune_geom=None, mesh=None) -> str:
         """Probe the eligible candidates, pick the winner, persist."""
         with _probe_lock:
             probe = _PROBE_TIMER
@@ -859,7 +915,8 @@ class Family:
         winner = min(measured, key=measured.get)
         key = tune_cache().store(
             self.name, geom if tune_geom is None else tune_geom,
-            winner, timings_us=timings_us, source="measured")
+            winner, timings_us=timings_us, source="measured",
+            mesh=mesh)
         obs.count("autotune_measured", family=self.name)
         obs.record_decision(
             "autotune", winner, family=self.name, key=key,

@@ -32,7 +32,10 @@ __all__ = ["device_time", "device_time_chained", "host_time",
            "conv_roofline", "stft_roofline", "rfft_flops",
            "analytical_roofline",
            "roofline_disagreement_pct", "hbm_bw_gbps",
+           "ici_bw_gbps", "xla_fft_eff_gflops", "a2a_ici_bytes",
+           "ct_dft_flops", "dft_matmul_roofline",
            "MXU_PEAK_TFLOPS_BF16", "MXU_F32_PASSES", "HBM_BW_GBPS",
+           "ICI_BW_GBPS", "XLA_FFT_EFF_GFLOPS",
            "ROOFLINE_DISAGREEMENT_WARN_PCT"]
 
 
@@ -75,6 +78,72 @@ def mxu_f32_bound_tflops(precision: str = "highest") -> float:
 def hbm_bw_gbps() -> float:
     """HBM bandwidth in GB/s (env-overridable hardware constant)."""
     return float(os.environ.get("VELES_SIMD_HBM_BW_GBPS", HBM_BW_GBPS))
+
+
+# effective per-device ICI all-to-all bandwidth (GB/s): what one chip
+# can stream into the interconnect during a tiled ``all_to_all``, the
+# denominator of the sharded-DFT selector's transfer-cost term.
+# Public v5e per-link figures are higher; this is the conservative
+# *achieved* figure a 1D ring realizes. Override with
+# $VELES_SIMD_ICI_BW_GBPS on other topologies.
+ICI_BW_GBPS = 45.0
+
+# effective single-chip throughput of XLA's 1D FFT lowering in useful
+# GFLOP/s (split-radix op count / wall time) — the local-FFT side of
+# the sharded-DFT cost model.  XLA's TPU FFT leaves the MXU idle
+# (arXiv:2002.03260), so this is far below the matmul bound; override
+# with $VELES_SIMD_FFT_EFF_GFLOPS after measuring a new backend.
+XLA_FFT_EFF_GFLOPS = 180.0
+
+
+def ici_bw_gbps() -> float:
+    """Per-device effective ICI all-to-all bandwidth in GB/s
+    (env-overridable hardware constant)."""
+    return float(os.environ.get("VELES_SIMD_ICI_BW_GBPS", ICI_BW_GBPS))
+
+
+def xla_fft_eff_gflops() -> float:
+    """Effective useful-GFLOP/s of the local XLA FFT route
+    (env-overridable measured constant)."""
+    return float(os.environ.get("VELES_SIMD_FFT_EFF_GFLOPS",
+                                XLA_FFT_EFF_GFLOPS))
+
+
+def a2a_ici_bytes(n_elems: int, itemsize: int, n_shards: int) -> int:
+    """Bytes that actually cross ICI in ONE tiled ``all_to_all`` of a
+    global ``n_elems``-element array over ``n_shards`` devices: each
+    device keeps 1/S of its shard and ships the rest, so the global
+    payload is ``elems * itemsize * (S - 1) / S``.  The single
+    accounting the sharded-DFT selector, its decision events, and the
+    MULTICHIP bench rows share."""
+    if n_shards <= 1:
+        return 0
+    return int(n_elems) * int(itemsize) * (n_shards - 1) // n_shards
+
+
+def ct_dft_flops(n: int, n1: int, n2: int) -> float:
+    """Useful-FLOP count of one length-``n = n1*n2`` Cooley-Tukey
+    factorized matmul DFT: two dense per-factor stages (a length-n2
+    DFT for each of n1 columns and vice versa, 8 real FLOPs per
+    complex MAC) plus the twiddle multiply (6 FLOPs/sample) — the
+    ``sharded_matmul_dft`` route's hand constant next to
+    :func:`rfft_flops` for the FFT route."""
+    return 8.0 * float(n) * (int(n1) + int(n2)) + 6.0 * float(n)
+
+
+def dft_matmul_roofline(samples_per_s: float, n1: int, n2: int,
+                        precision: str = "highest") -> dict:
+    """Roofline attribution of a factorized matmul-DFT sample rate
+    against the f32 MXU bound — same dict shape as
+    :func:`conv_roofline` so bench rows embed it verbatim."""
+    n = int(n1) * int(n2)
+    bound = mxu_f32_bound_tflops(precision)
+    eff = ct_dft_flops(n, n1, n2) / n * samples_per_s / 1e12
+    return {"tflops_effective": eff,
+            "roofline_bound_tflops": bound,
+            "pct_of_roofline": 100.0 * eff / bound,
+            "flops_per_sample": ct_dft_flops(n, n1, n2) / n,
+            "precision": precision}
 
 
 def analytical_roofline(flops: float, t_seconds: float,
