@@ -6,7 +6,7 @@
 PYTHON ?= python
 
 .PHONY: all tests tests-quick benchmarks bench bench-regress \
-        bench-multichip cshim cshim-check \
+        bench-multichip bench-serve serve-smoke cshim cshim-check \
         wavelet-tables lint docs obs-report autotune-pack install \
         install-hooks clean
 
@@ -40,6 +40,19 @@ bench-regress:
 # `python tools/bench_regress.py --details MULTICHIP_DETAILS.json`.
 bench-multichip:
 	$(PYTHON) tools/bench_multichip.py
+
+# the SERVE bench family: loadgen traffic (Poisson + bursts, mixed
+# tenants/shapes) through the serving layer, written to
+# SERVE_DETAILS.json (throughput + inverse-p99 rows; rc=1 on any
+# lost/double-answered request).  Gate with
+# `python tools/bench_regress.py --details SERVE_DETAILS.json`.
+bench-serve:
+	$(PYTHON) tools/loadgen.py --details SERVE_DETAILS.json
+
+# seconds-long CPU sanity run of the serving layer (accounting +
+# oracle parity gate); the chaos variant arms VELES_SIMD_FAULT_PLAN
+serve-smoke:
+	VELES_SIMD_PLATFORM=cpu $(PYTHON) tools/loadgen.py --smoke
 
 cshim:
 	$(MAKE) -C csrc all

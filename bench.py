@@ -590,6 +590,56 @@ def bench_batched_stft(rng):
             "baseline": samples / t_s / 1e6}
 
 
+def bench_serve(rng):
+    """Config 11: the serving layer's coalescing win — loadgen traffic
+    (flat-out arrivals, mixed tenants/shapes) through a Server vs the
+    same requests dispatched one-by-one through the single-signal ops.
+    vs_baseline IS the serve-vs-sequential ratio: the numerator pays
+    batching + padding + queueing, the denominator pays per-request
+    dispatch, the regime ROADMAP item 1 exists for."""
+    from tools import loadgen
+    from veles.simd_tpu import serve
+
+    schedule = loadgen.build_schedule(rng, 160, rate_hz=0.0,
+                                      burst_every=0, burst_size=0)
+    # warm every (op, bucket) compile outside the measured window, and
+    # prove the accounting while at it
+    with serve.Server(max_batch=8, max_wait_ms=2.0, workers=2) as srv:
+        warm = loadgen.run_load(srv, schedule, result_timeout=600.0)
+        if warm["lost"] or warm["double_answered"]:
+            raise RuntimeError(f"serve accounting failed: {warm}")
+        t0 = time.perf_counter()
+        report = loadgen.run_load(srv, schedule, result_timeout=600.0)
+        t_serve = time.perf_counter() - t0
+    done = report["ok"] + report["degraded"]
+
+    # sequential baseline: the same requests through the single-call
+    # path (simd=True, no coalescing), timed after its own warmup
+    from veles.simd_tpu.ops import iir, resample as rs, spectral as sp
+
+    def one(req):
+        p = req.params
+        if req.op == "sosfilt":
+            return iir.sosfilt(p["sos"], req.x[None, :], simd=True)
+        if req.op == "lfilter":
+            return iir.lfilter(p["b"], p["a"], req.x[None, :],
+                               simd=True)
+        if req.op == "resample_poly":
+            return rs.resample_poly(req.x, p["up"], p["down"],
+                                    simd=True)
+        return sp.stft(req.x, p["frame_length"], p["hop"], simd=True)
+
+    for _, req in schedule:
+        one(req)                       # warm the per-request compiles
+    t0 = time.perf_counter()
+    for _, req in schedule:
+        np.asarray(one(req))           # sync per request, like serve
+    t_single = time.perf_counter() - t0
+    return {"metric": "serve loadgen 160req mixed",
+            "unit": "req/s", "value": done / t_serve,
+            "baseline": len(schedule) / t_single}
+
+
 def _warm_device(seconds: float = 1.0):
     """Ramp device clocks with a sustained chained GEMM before the first
     timed config (the first sustained workload in a process has been
@@ -943,7 +993,7 @@ def main():
         configs = (bench_elementwise, bench_mathfun, bench_sgemm,
                    bench_dwt, bench_stft, bench_istft_roundtrip,
                    bench_spectrogram, bench_batched_stft,
-                   bench_autotuned_headline)
+                   bench_serve, bench_autotuned_headline)
         for i, fn in enumerate(configs):
             # a failed/skipped config never reaches flush()'s reset — drop
             # its events here so they can't masquerade as the next config's

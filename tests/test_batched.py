@@ -146,3 +146,114 @@ def test_donate_is_optin_and_keys_the_handle():
     # on CPU _donate_argnums(True) == (): same key, one handle; on a
     # TPU run the donation tuple differs and a second handle appears
     assert info["size"] in (1, 2)
+
+
+class TestEmptyBatchContract:
+    # the serving layer's batcher relies on a CLEAR error for B=0
+    # instead of an opaque XLA shape failure deep in the compiled core
+    def test_sosfilt_empty_batch(self):
+        sos = iir.butterworth(2, 0.2, "lowpass")
+        with pytest.raises(ValueError, match="empty batch"):
+            batched.batched_sosfilt(sos, np.empty((0, 64), np.float32))
+
+    def test_lfilter_empty_batch(self):
+        with pytest.raises(ValueError, match="empty batch"):
+            batched.batched_lfilter([1.0], [1.0, -0.5],
+                                    np.empty((0, 64), np.float32))
+
+    def test_resample_empty_batch(self):
+        with pytest.raises(ValueError, match="empty batch"):
+            batched.batched_resample_poly(
+                np.empty((0, 64), np.float32), 3, 2)
+
+    def test_stft_empty_batch(self):
+        with pytest.raises(ValueError, match="empty batch"):
+            batched.batched_stft(np.empty((0, 256), np.float32),
+                                 128, 64)
+
+    def test_empty_leading_dim_also_caught(self):
+        sos = iir.butterworth(2, 0.2, "lowpass")
+        with pytest.raises(ValueError, match="empty batch"):
+            batched.batched_sosfilt(
+                sos, np.empty((0, 4, 64), np.float32))
+
+    def test_oracle_path_same_contract(self):
+        sos = iir.butterworth(2, 0.2, "lowpass")
+        with pytest.raises(ValueError, match="empty batch"):
+            batched.batched_sosfilt(
+                sos, np.empty((0, 64), np.float32), simd=False)
+
+
+class TestHandleRace:
+    def test_concurrent_same_key_builds_once(self):
+        # regression: before the per-key build lock two threads could
+        # both miss, both trace, and the duplicate insert could evict
+        # a live neighbor.  A slow builder makes the old race
+        # deterministic: every thread piles into the build window.
+        import threading
+        import time as _time
+
+        builds = []
+        results = []
+        start = threading.Barrier(6)
+
+        def builder():
+            builds.append(threading.get_ident())
+            _time.sleep(0.05)          # hold the build window open
+            return lambda *a: "built"
+
+        def worker():
+            start.wait()
+            results.append(
+                batched._get_handle(("race", "same-key"), builder))
+
+        threads = [threading.Thread(target=worker) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(builds) == 1          # exactly one construction
+        assert len({id(h) for h in results}) == 1   # one shared handle
+        info = batched.handle_cache_info()
+        assert info["misses"] == 1 and info["hits"] == 5
+        assert not batched._build_locks   # build-lock map drained
+
+    def test_distinct_keys_build_concurrently(self):
+        # the per-key locks must serialize only same-key builds: two
+        # different keys' slow builds overlap in wall time
+        import threading
+        import time as _time
+
+        windows = {}
+
+        def make_builder(tag):
+            def builder():
+                t0 = _time.perf_counter()
+                _time.sleep(0.05)
+                windows[tag] = (t0, _time.perf_counter())
+                return lambda *a: tag
+            return builder
+
+        threads = [
+            threading.Thread(
+                target=lambda tag=tag: batched._get_handle(
+                    ("race2", tag), make_builder(tag)))
+            for tag in ("a", "b")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        (a0, a1), (b0, b1) = windows["a"], windows["b"]
+        assert a0 < b1 and b0 < a1     # the build windows overlapped
+
+    def test_failed_build_releases_the_key(self):
+        def bad_builder():
+            raise RuntimeError("trace failed")
+
+        with pytest.raises(RuntimeError, match="trace failed"):
+            batched._get_handle(("race3", "key"), bad_builder)
+        assert not batched._build_locks
+        # the key is retryable: a later good builder succeeds
+        h = batched._get_handle(("race3", "key"),
+                                lambda: (lambda *a: "ok"))
+        assert h("x") == "ok"

@@ -470,3 +470,162 @@ def test_routing_rule_flags_hand_rolled_sharded_selector():
     routing rule."""
     errs = _routing_errors(ROUTING_BAD_SHARDED_SELECT)
     assert any("select_frame_route" in e for e in errs)
+
+
+# --- the serving-layer rule (PR 9) -----------------------------------------
+
+SERVE_GOOD = '''
+from veles.simd_tpu import obs
+from veles.simd_tpu.ops import batched
+from veles.simd_tpu.runtime import faults
+
+
+def _device_call(xs, params):
+    return batched.batched_sosfilt(params["sos"], xs, simd=True)
+
+
+def _oracle_call(xs, params):
+    return batched.batched_sosfilt(params["sos"], xs, simd=False)
+
+
+def dispatch(xs, params):
+    def thunk():
+        return _device_call(xs, params)
+
+    with obs.span("serve.dispatch"):
+        return faults.guarded(
+            "serve.dispatch", thunk,
+            fallback=lambda: _oracle_call(xs, params))
+'''
+
+SERVE_BARE_DISPATCH = '''
+from veles.simd_tpu import obs
+from veles.simd_tpu.ops import batched
+
+
+def dispatch(xs, sos):
+    with obs.span("serve.dispatch"):
+        return batched.batched_sosfilt(sos, xs, simd=True)
+'''
+
+SERVE_RAW_TIME = '''
+import time
+
+from veles.simd_tpu import obs
+
+
+def deadline():
+    return time.monotonic() + 0.002
+'''
+
+SERVE_NO_OBS = '''
+from veles.simd_tpu.ops import batched
+from veles.simd_tpu.runtime import faults
+
+
+def dispatch(xs, sos):
+    def thunk():
+        return batched.batched_sosfilt(sos, xs, simd=True)
+
+    return faults.guarded("serve.dispatch", thunk)
+'''
+
+SERVE_ALIAS_DODGE = '''
+import time as _clock
+
+from veles.simd_tpu import obs
+from veles.simd_tpu.ops import batched as _b
+from veles.simd_tpu.runtime import faults
+
+
+def dispatch(xs, sos):
+    _ = _clock.monotonic()
+    with obs.span("serve.dispatch"):
+        return _b.batched_sosfilt(sos, xs, simd=True)
+'''
+
+
+def _serve_errs(src):
+    return lint.serve_layer_errors(ast.parse(src), "mod.py")
+
+
+def test_serve_rule_passes_guarded_module():
+    assert _serve_errs(SERVE_GOOD) == []
+
+
+def test_serve_rule_flags_bare_dispatch():
+    errs = _serve_errs(SERVE_BARE_DISPATCH)
+    assert any("faults.guarded" in e for e in errs)
+
+
+def test_serve_rule_flags_raw_time():
+    errs = _serve_errs(SERVE_RAW_TIME)
+    assert any("faults.monotonic" in e for e in errs)
+
+
+def test_serve_rule_requires_obs_recording():
+    errs = _serve_errs(SERVE_NO_OBS)
+    assert any("unobservable" in e for e in errs)
+
+
+def test_serve_rule_tracks_aliases():
+    errs = _serve_errs(SERVE_ALIAS_DODGE)
+    assert any("time import" in e for e in errs)
+    assert any("faults.guarded" in e for e in errs)
+
+
+def test_serve_rule_exempts_oracle_paths():
+    src = SERVE_GOOD + '''
+
+def degraded_answer(xs, params):
+    with obs.span("serve.degraded"):
+        return _oracle_call(xs, params)
+'''
+    assert _serve_errs(src) == []
+
+
+def test_real_serve_modules_pass_serve_rule():
+    serve_dir = REPO / "veles" / "simd_tpu" / "serve"
+    files = sorted(serve_dir.glob("*.py"))
+    assert files, "serve package missing?"
+    for f in files:
+        tree = ast.parse(f.read_text(), str(f))
+        assert lint.serve_layer_errors(tree, str(f)) == [], f
+
+
+SERVE_DOTTED_DODGE = '''
+from veles.simd_tpu import obs, ops
+
+
+def dispatch(xs, sos):
+    with obs.span("serve.dispatch"):
+        return ops.batched.batched_sosfilt(sos, xs, simd=True)
+'''
+
+SERVE_ROOT_DODGE = '''
+import veles.simd_tpu.ops
+
+from veles.simd_tpu import obs
+
+
+def dispatch(xs, sos):
+    with obs.span("serve.dispatch"):
+        return veles.simd_tpu.ops.batched.batched_sosfilt(
+            sos, xs, simd=True)
+'''
+
+
+def test_serve_rule_flags_dotted_package_dodge():
+    for src in (SERVE_DOTTED_DODGE, SERVE_ROOT_DODGE):
+        errs = _serve_errs(src)
+        assert any("faults.guarded" in e for e in errs), src
+
+
+def test_serve_rule_ignores_cache_introspection():
+    src = SERVE_GOOD + '''
+
+def peek():
+    obs.count("serve_peek")
+    return batched.handle_cache_info()
+'''
+    assert _serve_errs(src) == []

@@ -1,0 +1,407 @@
+"""The serving layer (``veles/simd_tpu/serve/``).
+
+Covers the four robustness pillars end to end on the virtual CPU mesh:
+deadline batching (coalescing + bounded wait), admission control
+(typed ``Overloaded``, per-tenant and global bounds, backpressure),
+the fault-driven health machine (injected device loss -> bounded retry
+-> DEGRADED oracle serving with parity -> probed recovery), and the
+concurrency contract (no request lost, none double-answered).  The
+chaos runs are driven by ``VELES_SIMD_FAULT_PLAN`` through the
+``serve.dispatch`` / ``serve.admission`` injection sites — CPU CI, no
+monkeypatching — with ``tools/loadgen.py`` as the traffic source for
+the full overload + device-loss gate.
+"""
+
+import sys
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+import loadgen  # noqa: E402
+from veles.simd_tpu import obs, serve  # noqa: E402
+from veles.simd_tpu.ops import iir  # noqa: E402
+from veles.simd_tpu.ops import resample as rs  # noqa: E402
+from veles.simd_tpu.ops import spectral as sp  # noqa: E402
+from veles.simd_tpu.runtime import faults  # noqa: E402
+
+RNG = np.random.RandomState(42)
+SOS = iir.butterworth(4, 0.25, "lowpass")
+
+
+@pytest.fixture
+def telemetry(monkeypatch):
+    """Telemetry on, zero retry backoff (deterministic), clean plans
+    and metrics before/after."""
+    monkeypatch.setenv("VELES_SIMD_FAULT_BACKOFF", "0")
+    obs.enable(compile_listeners=False)
+    obs.reset()
+    faults.reset_fault_history()
+    yield
+    obs.disable()
+    obs.reset()
+    faults.reset_fault_history()
+    faults.set_fault_plan(None)
+
+
+def _rel(got, want):
+    got = np.asarray(got, np.complex128)
+    want = np.asarray(want, np.complex128)
+    scale = float(np.max(np.abs(want))) or 1.0
+    return float(np.max(np.abs(got - want)) / scale)
+
+
+def _signal(n):
+    return RNG.randn(n).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# request validation + ticket contract
+# ---------------------------------------------------------------------------
+
+class TestSubmitContract:
+    def test_unsupported_op_raises(self):
+        srv = serve.Server()
+        with pytest.raises(ValueError, match="unsupported op"):
+            srv.submit(serve.Request("fft9000", _signal(64)))
+
+    def test_non_1d_signal_raises(self):
+        srv = serve.Server()
+        with pytest.raises(ValueError, match="1-D"):
+            srv.submit(serve.Request("sosfilt", np.zeros((2, 64)),
+                                     {"sos": SOS}))
+
+    def test_stft_shorter_than_frame_raises(self):
+        srv = serve.Server()
+        with pytest.raises(ValueError):
+            srv.submit(serve.Request(
+                "stft", _signal(64),
+                {"frame_length": 128, "hop": 64}))
+
+    def test_unstarted_server_times_out_not_loses(self):
+        srv = serve.Server(max_wait_ms=1.0)
+        t = srv.submit(serve.Request("sosfilt", _signal(128),
+                                     {"sos": SOS}))
+        with pytest.raises(TimeoutError):
+            t.result(timeout=0.05)
+        assert not t.done()
+
+    def test_submit_after_stop_raises(self):
+        srv = serve.Server()
+        srv.start()
+        srv.stop()
+        with pytest.raises(serve.ServerClosed):
+            srv.submit(serve.Request("sosfilt", _signal(128),
+                                     {"sos": SOS}))
+
+
+# ---------------------------------------------------------------------------
+# batching policy: coalescing + the deadline bound
+# ---------------------------------------------------------------------------
+
+class TestBatchingPolicy:
+    def test_same_class_requests_coalesce(self):
+        with serve.Server(max_batch=4, max_wait_ms=60.0,
+                          workers=1) as srv:
+            xs = [_signal(500) for _ in range(4)]
+            ts = [srv.submit(serve.Request("sosfilt", x,
+                                           {"sos": SOS}))
+                  for x in xs]
+            outs = [t.result(timeout=120.0) for t in ts]
+        assert srv.stats()["counts"]["batches"] == 1
+        for x, y in zip(xs, outs):
+            assert _rel(y, iir.sosfilt_na(SOS, x[None, :])[0]) < 2e-4
+
+    def test_deadline_answers_partial_batch(self):
+        # one lone request in a 64-wide batch must still be answered:
+        # the max_wait deadline fires, not the full-batch trigger
+        with serve.Server(max_batch=64, max_wait_ms=20.0,
+                          workers=1) as srv:
+            t = srv.submit(serve.Request("sosfilt", _signal(256),
+                                         {"sos": SOS}))
+            y = t.result(timeout=120.0)
+        assert t.status == "ok"
+        assert y.shape == (256,)
+        # observed wait = deadline + dispatch (compile included on the
+        # first call); it must be bounded, not a full-batch starve
+        assert t.wait_s is not None and t.wait_s < 60.0
+
+    def test_distinct_shape_classes_do_not_mix(self):
+        with serve.Server(max_batch=8, max_wait_ms=5.0,
+                          workers=1) as srv:
+            a = _signal(500)    # pow2 bucket 512
+            b = _signal(900)    # pow2 bucket 1024
+            ta = srv.submit(serve.Request("sosfilt", a, {"sos": SOS}))
+            tb = srv.submit(serve.Request("sosfilt", b, {"sos": SOS}))
+            ya, yb = (ta.result(timeout=120.0),
+                      tb.result(timeout=120.0))
+        assert srv.stats()["counts"]["batches"] == 2
+        assert ya.shape == (500,) and yb.shape == (900,)
+        assert _rel(ya, iir.sosfilt_na(SOS, a[None, :])[0]) < 2e-4
+        assert _rel(yb, iir.sosfilt_na(SOS, b[None, :])[0]) < 2e-4
+
+    def test_bucket_padding_is_exact_for_every_op(self):
+        # non-pow2 lengths exercise the pad-to-bucket + slice-back
+        # path against the unpadded single-call oracle
+        n = 777
+        x = _signal(n)
+        cases = [
+            ("sosfilt", {"sos": SOS},
+             lambda: iir.sosfilt_na(SOS, x[None, :])[0]),
+            ("lfilter", {"b": [0.2, 0.3, 0.1], "a": [1.0, -0.4]},
+             lambda: iir.lfilter_na([0.2, 0.3, 0.1], [1.0, -0.4],
+                                    x[None, :])[0]),
+            ("resample_poly", {"up": 3, "down": 2},
+             lambda: rs.resample_poly_na(x, 3, 2)),
+            ("stft", {"frame_length": 128, "hop": 64},
+             lambda: sp.stft_na(x, 128, 64)),
+        ]
+        with serve.Server(max_batch=4, max_wait_ms=5.0) as srv:
+            for op, params, oracle in cases:
+                t = srv.submit(serve.Request(op, x, params))
+                assert _rel(t.result(timeout=300.0), oracle()) < 2e-3
+
+
+# ---------------------------------------------------------------------------
+# admission control + backpressure
+# ---------------------------------------------------------------------------
+
+class TestAdmission:
+    def test_bounds_and_typed_overloaded(self):
+        ac = serve.AdmissionController(max_depth=3,
+                                       max_tenant_depth=2)
+        ac.admit("a")
+        ac.admit("a")
+        with pytest.raises(serve.Overloaded) as ei:
+            ac.admit("a")
+        assert ei.value.scope == "tenant"
+        assert faults.is_overload(ei.value)
+        ac.admit("b")
+        with pytest.raises(serve.Overloaded) as ei:
+            ac.admit("c")
+        assert ei.value.scope == "global"
+        ac.release("a")
+        ac.admit("c")           # freed slot readmits
+        snap = ac.snapshot()
+        assert snap["depth"] == 3 and snap["shed"] == 2
+
+    def test_backpressure_blocks_until_release(self):
+        ac = serve.AdmissionController(max_depth=1,
+                                       max_tenant_depth=1)
+        ac.admit("a")
+        done = threading.Event()
+
+        def blocked():
+            ac.admit("a", block=True, timeout=30.0)
+            done.set()
+
+        t = threading.Thread(target=blocked)
+        t.start()
+        assert not done.wait(0.05)      # genuinely parked
+        ac.release("a")
+        assert done.wait(5.0)           # woke and admitted
+        t.join()
+
+    def test_backpressure_deadline_expires_typed(self):
+        ac = serve.AdmissionController(max_depth=1,
+                                       max_tenant_depth=1)
+        ac.admit("a")
+        with pytest.raises(serve.Overloaded) as ei:
+            ac.admit("a", block=True, timeout=0.05)
+        assert ei.value.scope == "deadline"
+
+    def test_injected_overload_sheds_deterministically(self,
+                                                       telemetry):
+        with faults.fault_plan("serve.admission:overload:2"):
+            with serve.Server(max_batch=2, max_wait_ms=5.0) as srv:
+                ts = [srv.submit(serve.Request(
+                    "sosfilt", _signal(256), {"sos": SOS}))
+                    for _ in range(4)]
+                statuses = []
+                for t in ts:
+                    try:
+                        t.result(timeout=120.0)
+                        statuses.append(t.status)
+                    except serve.Overloaded as e:
+                        assert e.scope == "injected"
+                        statuses.append(t.status)
+        assert statuses[:2] == ["shed", "shed"]
+        assert statuses[2:] == ["ok", "ok"]
+        assert obs.counter_value("serve_shed", tenant="default",
+                                 scope="injected") == 2
+
+
+# ---------------------------------------------------------------------------
+# fault-driven health machine
+# ---------------------------------------------------------------------------
+
+class TestHealthMachine:
+    def test_degrade_parity_then_probed_recovery(self, telemetry):
+        # 3 injected device losses = 1 guarded dispatch's full budget
+        # (retries default 2) -> trip.  probe_every=2: batch 2 serves
+        # oracle, batch 3 probes (plan empty) and recovers.
+        with faults.fault_plan("serve.dispatch:device_lost:3"):
+            with serve.Server(max_batch=1, max_wait_ms=2.0,
+                              workers=1, probe_every=2) as srv:
+                xs = [_signal(256) for _ in range(3)]
+                outs, statuses = [], []
+                for x in xs:
+                    t = srv.submit(serve.Request("sosfilt", x,
+                                                 {"sos": SOS}))
+                    outs.append(t.result(timeout=120.0))
+                    statuses.append(t.status)
+                health = srv.stats()["health"]
+        assert statuses == ["degraded", "degraded", "ok"]
+        # DEGRADED answers are the oracle's, so parity is exact-ish
+        for x, y in zip(xs, outs):
+            assert _rel(y, iir.sosfilt_na(SOS, x[None, :])[0]) < 2e-4
+        assert health["state"] == serve.HEALTHY
+        assert health["trips"] == 1 and health["recoveries"] == 1
+        assert obs.counter_value("fault_exhausted",
+                                 site="serve.dispatch",
+                                 kind="device_lost") == 1
+        assert obs.counter_value("serve_recovered",
+                                 site="serve.dispatch") == 1
+        decisions = [(e["op"], e["decision"]) for e in obs.events()]
+        assert ("serve_health", "degrade") in decisions
+        assert ("serve_health", "recover") in decisions
+
+    def test_probe_failure_stays_degraded(self, telemetry):
+        # enough injections to also eat the first probe (zero-retry):
+        # 3 (trip) + 1 (probe) = 4; with probe_every=1 every degraded
+        # batch probes, so batch 2 probes-and-fails, batch 3 recovers
+        with faults.fault_plan("serve.dispatch:device_lost:4"):
+            with serve.Server(max_batch=1, max_wait_ms=2.0,
+                              workers=1, probe_every=1) as srv:
+                statuses = []
+                for _ in range(3):
+                    t = srv.submit(serve.Request(
+                        "sosfilt", _signal(256), {"sos": SOS}))
+                    t.result(timeout=120.0)
+                    statuses.append(t.status)
+                health = srv.stats()["health"]
+        assert statuses == ["degraded", "degraded", "ok"]
+        assert health["trips"] == 2          # initial + failed probe
+        assert health["recoveries"] == 1
+        assert health["probes"] == 2
+
+
+# ---------------------------------------------------------------------------
+# concurrency: no request lost, none double-answered
+# ---------------------------------------------------------------------------
+
+class TestConcurrency:
+    def test_producer_threads_mixed_classes(self, telemetry):
+        n_threads, per_thread = 6, 12
+        lengths = (256, 500)
+        with serve.Server(max_batch=8, max_wait_ms=5.0,
+                          workers=2, queue_depth=4096,
+                          tenant_depth=4096) as srv:
+            all_tickets = [[] for _ in range(n_threads)]
+            payloads = [[] for _ in range(n_threads)]
+
+            def producer(slot):
+                rng = np.random.RandomState(slot)
+                for i in range(per_thread):
+                    x = rng.randn(
+                        lengths[i % len(lengths)]).astype(np.float32)
+                    t = srv.submit(serve.Request(
+                        "sosfilt", x, {"sos": SOS},
+                        tenant=f"t{slot}"))
+                    payloads[slot].append(x)
+                    all_tickets[slot].append(t)
+
+            threads = [threading.Thread(target=producer, args=(s,))
+                       for s in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            flat = [(x, tk) for xs, tks in zip(payloads, all_tickets)
+                    for x, tk in zip(xs, tks)]
+            outs = [(x, tk, tk.result(timeout=300.0))
+                    for x, tk in flat]
+        # every request answered exactly once, none lost
+        assert len(outs) == n_threads * per_thread
+        assert all(tk.done() for _, tk, _ in outs)
+        assert obs.counter_value("serve_double_answer") == 0
+        assert srv.stats()["counts"]["completed"] == len(outs)
+        # deadline batching bounded every observed wait
+        assert all(tk.wait_s is not None and tk.wait_s < 120.0
+                   for _, tk, _ in outs)
+        # spot parity across producers
+        for x, _, y in outs[:: len(outs) // 8 or 1]:
+            assert _rel(y, iir.sosfilt_na(SOS, x[None, :])[0]) < 2e-4
+        # admission fully drained back to zero
+        assert srv.stats()["admission"]["depth"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the chaos gate: loadgen overload + device loss, full accounting
+# ---------------------------------------------------------------------------
+
+class TestChaosGate:
+    def test_overload_and_device_loss_full_accounting(self,
+                                                      telemetry):
+        rng = np.random.RandomState(7)
+        schedule = loadgen.build_schedule(rng, 48, rate_hz=0.0,
+                                          burst_every=0, burst_size=0)
+        plan = ("serve.dispatch:device_lost:3,"
+                "serve.admission:overload:4")
+        with faults.fault_plan(plan):
+            with serve.Server(max_batch=4, max_wait_ms=5.0,
+                              workers=2, probe_every=2) as srv:
+                report = loadgen.run_load(srv, schedule, verify=10,
+                                          result_timeout=300.0,
+                                          rng=rng)
+                health = srv.stats()["health"]
+        # zero lost, zero double-answered, typed sheds, parity holds
+        assert report["lost"] == 0
+        assert report["double_answered"] == 0
+        assert report["parity_failures"] == 0
+        assert report["shed"] == 4
+        assert report["degraded"] >= 1
+        assert (report["ok"] + report["degraded"]
+                + report["shed"] == report["requests"])
+        # DEGRADED -> recovered
+        assert health["trips"] >= 1 and health["recoveries"] >= 1
+        assert health["state"] == serve.HEALTHY
+        # the obs snapshot carries the whole story: shed/retry/degrade
+        # counters and p99 span quantiles for the serve spans
+        snap = obs.snapshot()
+        counters = {(c["name"], tuple(sorted(c["labels"].items()))):
+                    c["value"] for c in snap["counters"]}
+        total = {}
+        for (name, _), v in counters.items():
+            total[name] = total.get(name, 0) + v
+        assert total.get("serve_shed", 0) == 4
+        assert total.get("fault_retry", 0) >= 1
+        assert total.get("fault_degraded", 0) >= 1
+        assert total.get("serve_degraded", 0) >= 1
+        assert total.get("serve_recovered", 0) >= 1
+        qs = obs.quantiles("span.serve.dispatch", phase="steady")
+        assert qs is not None and qs["p99"] is not None
+        assert any(h["name"] == "serve.request_latency"
+                   for h in snap["histograms"])
+
+
+# ---------------------------------------------------------------------------
+# loadgen bench-row surface (what `make bench-serve` gates on)
+# ---------------------------------------------------------------------------
+
+def test_loadgen_bench_rows_shape(telemetry):
+    report = {"throughput_rps": 123.4, "wait_p99_s": 0.02}
+    rows = loadgen.bench_rows(report)
+    metrics = [r["metric"] for r in rows]
+    assert "serve throughput" in metrics
+    assert "serve p99 inverse latency" in metrics
+    for r in rows:
+        assert set(r) >= {"metric", "value", "unit"}
+    inv = next(r for r in rows
+               if r["metric"] == "serve p99 inverse latency")
+    assert inv["value"] == 50.0

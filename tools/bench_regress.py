@@ -86,6 +86,12 @@ DEFAULT_NOISE = [
     # above-cutoff stft row divides two burst measurements
     ("sharded rfft", 0.25),
     ("sharded stft", 0.30),
+    # the serve family (bench.py config + tools/loadgen.py --details
+    # SERVE_DETAILS.json): wall-clock req/s through a threaded server
+    # — queueing + host scheduling jitter on top of device jitter, and
+    # the inverse-p99 row is a single order statistic
+    ("serve", 0.35),
+    ("serve p99", 0.40),
 ]
 
 

@@ -468,6 +468,214 @@ def spectral_dispatch_errors(tree, fname) -> list:
     return errors
 
 
+# --- serving-layer rule -----------------------------------------------------
+# The serve/ package (PR 9) is the request path in front of the op
+# families; its robustness contract is structural and this rule keeps
+# it that way:
+#
+# * every dispatch into veles.simd_tpu.ops.batched must happen inside
+#   a thunk handed to faults.guarded (the transient-fault policy) —
+#   a bare batched call is a dispatch that cannot retry, degrade, or
+#   trip the health machine.  The NumPy oracle path (an explicit
+#   ``simd=False`` keyword, or a ``*_na`` twin) is exempt: it cannot
+#   fault, and DEGRADED mode calls it outside the guard by design;
+# * a serve module that dispatches ops must record via obs (span/
+#   count/gauge/observe/record_decision) — a silent serving loop is
+#   an unobservable one;
+# * no ``time`` import at all: deadline arithmetic reads
+#   faults.monotonic (one shared clock) and latency belongs to
+#   obs.span/observe.
+#
+# Alias-tracked like the other rules (``import ... as`` cannot dodge
+# it); "inside a guarded thunk" is computed transitively, like the
+# dispatch rule's instrumented-core closure.
+
+_SERVE_RULE_DIR = "veles/simd_tpu/serve"
+_BATCHED_MOD = "veles.simd_tpu.ops.batched"
+_SERVE_OBS_HELPERS = {"span", "count", "gauge", "observe",
+                      "record_decision", "quantiles"}
+
+
+def _serve_aliases(tree) -> tuple:
+    """``(batched_aliases, batched_names, ops_pkg_aliases,
+    faults_aliases, guarded_names, obs_aliases)`` — the names this
+    module binds to the batched-ops module, to functions imported FROM
+    it, to any package the batched module is reachable from by dotted
+    access (``ops.batched...`` / ``veles.simd_tpu.ops.batched...``),
+    to the fault engine, to ``faults.guarded`` itself, and to the obs
+    facade."""
+    batched_mods, batched_names, ops_pkgs = set(), set(), set()
+    faults_mods, guarded_names, obs_names = set(), set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "veles.simd_tpu.ops":
+                for a in node.names:
+                    if a.name == "batched":
+                        batched_mods.add(a.asname or a.name)
+            elif node.module == _BATCHED_MOD:
+                for a in node.names:
+                    batched_names.add(a.asname or a.name)
+            elif node.module in ("veles", "veles.simd_tpu"):
+                for a in node.names:
+                    if a.name in ("ops", "simd_tpu"):
+                        ops_pkgs.add(a.asname or a.name)
+            elif node.module == "veles.simd_tpu.runtime":
+                for a in node.names:
+                    if a.name == "faults":
+                        faults_mods.add(a.asname or a.name)
+            elif node.module == "veles.simd_tpu.runtime.faults":
+                for a in node.names:
+                    if a.name == "guarded":
+                        guarded_names.add(a.asname or a.name)
+            if node.module == "veles.simd_tpu":
+                for a in node.names:
+                    if a.name == "obs":
+                        obs_names.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == _BATCHED_MOD and a.asname:
+                    batched_mods.add(a.asname)
+                elif a.name == "veles.simd_tpu.runtime.faults" \
+                        and a.asname:
+                    faults_mods.add(a.asname)
+                elif a.name.startswith("veles"):
+                    # `import veles.simd_tpu.ops [as o]`: the bound
+                    # root ("veles" or the asname) reaches batched by
+                    # dotted access — track it so chains cannot dodge
+                    ops_pkgs.add(a.asname or "veles")
+    return (batched_mods, batched_names, ops_pkgs, faults_mods,
+            guarded_names, obs_names)
+
+
+def _dotted_chain(node) -> str | None:
+    """``a.b.c`` attribute chains as a dotted string (None when the
+    chain's root is not a plain name)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _guarded_regions(tree, faults_mods, guarded_names) -> set:
+    """ids of AST nodes lexically inside a ``faults.guarded(...)``
+    call's arguments, or inside a function transitively reachable
+    (by name reference) from one."""
+    funcs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.setdefault(node.name, node)
+    inside: set = set()
+    guarded_fns: set = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        is_guarded = (
+            (isinstance(f, ast.Attribute) and f.attr == "guarded"
+             and isinstance(f.value, ast.Name)
+             and f.value.id in faults_mods)
+            or (isinstance(f, ast.Name) and f.id in guarded_names))
+        if not is_guarded:
+            continue
+        for arg in list(node.args) + [kw.value for kw in
+                                      node.keywords]:
+            for w in ast.walk(arg):
+                inside.add(id(w))
+                if isinstance(w, ast.Name) and w.id in funcs:
+                    guarded_fns.add(w.id)
+    # transitive closure: a function referenced from a guarded region
+    # is itself guarded (thunk -> _device_call -> batched.*)
+    changed = True
+    while changed:
+        changed = False
+        for name in list(guarded_fns):
+            fn = funcs[name]
+            for w in ast.walk(fn):
+                inside.add(id(w))
+                if (isinstance(w, ast.Name) and w.id in funcs
+                        and w.id not in guarded_fns):
+                    guarded_fns.add(w.id)
+                    changed = True
+    return inside
+
+
+def serve_layer_errors(tree, fname) -> list:
+    """The rule body on a parsed module (separated so tests can feed
+    synthetic sources).  Returns human-readable error strings."""
+    errors = []
+    (batched_mods, batched_names, ops_pkgs, faults_mods,
+     guarded_names, obs_names) = _serve_aliases(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time" or a.name.startswith("time."):
+                    errors.append(
+                        f"{fname}:{node.lineno}: raw time import in a "
+                        "serve module — deadlines read "
+                        "faults.monotonic, latency belongs to "
+                        "obs.span/observe")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            errors.append(
+                f"{fname}:{node.lineno}: raw time import in a serve "
+                "module — deadlines read faults.monotonic, latency "
+                "belongs to obs.span/observe")
+    guarded = _guarded_regions(tree, faults_mods, guarded_names)
+    dispatches = 0
+    records = 0
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id in obs_names
+                and f.attr in _SERVE_OBS_HELPERS):
+            records += 1
+            continue
+        is_batched = False
+        if isinstance(f, ast.Name) and f.id in batched_names:
+            is_batched, attr = True, f.id
+        elif isinstance(f, ast.Attribute):
+            chain = _dotted_chain(f)
+            if chain is not None:
+                head, *rest = chain.split(".")
+                # batched.fn, ops.batched.fn, simd_tpu.ops.batched.fn,
+                # veles.simd_tpu.ops.batched.fn — any tracked root
+                # whose chain routes through the batched module
+                is_batched = (
+                    (head in batched_mods and len(rest) == 1)
+                    or (head in ops_pkgs and len(rest) >= 2
+                        and rest[-2] == "batched"))
+                attr = rest[-1] if rest else head
+        if not is_batched:
+            continue
+        if not attr.startswith("batched_"):
+            continue  # introspection (handle_cache_info, ...), not
+            # a dispatch entry point — nothing to guard
+        if attr.endswith("_na"):
+            continue  # the oracle twin cannot fault
+        if any(kw.arg == "simd"
+               and isinstance(kw.value, ast.Constant)
+               and kw.value.value is False for kw in node.keywords):
+            continue  # explicit oracle route
+        dispatches += 1
+        if id(node) not in guarded:
+            errors.append(
+                f"{fname}:{node.lineno}: bare batched-op dispatch in "
+                "a serve module — device dispatch must run inside a "
+                "faults.guarded thunk (retry/degrade/health policy)")
+    if dispatches and not records:
+        errors.append(
+            f"{fname}: serve module dispatches ops but never records "
+            "via obs (span/count/gauge/observe/record_decision) — an "
+            "unobservable serving loop")
+    return errors
+
+
 def compute_module_lint(files) -> int:
     """The ops/parallel project rules, one parse per file: telemetry
     only through the approved helpers (keeps instrumentation out of
@@ -479,7 +687,8 @@ def compute_module_lint(files) -> int:
             rel = f.resolve().relative_to(ROOT).as_posix()
         except ValueError:
             continue
-        if not rel.startswith(_OBS_RULE_DIRS):
+        in_serve = rel.startswith(_SERVE_RULE_DIR)
+        if not rel.startswith(_OBS_RULE_DIRS) and not in_serve:
             continue
         try:
             tree = ast.parse(f.read_text(), str(f))
@@ -488,6 +697,14 @@ def compute_module_lint(files) -> int:
             # crashing the whole lint run with a raw traceback
             print(f"{f}:{e.lineno}: syntax error: {e.msg}")
             failures += 1
+            continue
+        if in_serve:
+            # the serving layer has its own structural contract (and
+            # a different approved-obs surface), so it takes the
+            # serve rule INSTEAD of the compute-module rules
+            for msg in serve_layer_errors(tree, str(f)):
+                print(msg)
+                failures += 1
             continue
         if rel in _DISPATCH_RULE_FILES:
             for msg in spectral_dispatch_errors(tree, str(f)):

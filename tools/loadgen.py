@@ -1,0 +1,284 @@
+#!/usr/bin/env python
+"""Load generator + chaos harness for the serving layer.
+
+Drives :class:`veles.simd_tpu.serve.Server` with Poisson (optionally
+bursty) arrivals over a mixed op/shape/tenant traffic matrix and
+accounts for every request: answered-ok, answered-degraded, shed
+(typed Overloaded), errored, LOST (never answered — always a bug), and
+double-answered (the ticket layer raises + counts; always a bug).
+
+Three consumers:
+
+* **tests** (``tests/test_serve.py``) import :func:`build_schedule` /
+  :func:`run_load` as the overload + device-loss chaos harness — with
+  ``VELES_SIMD_FAULT_PLAN`` armed the whole shed/retry/degrade/recover
+  story runs deterministically on CPU CI;
+* **`make serve-smoke`** — a seconds-long CPU sanity run (rc=1 on any
+  lost/double-answered request or parity failure);
+* **`make bench-serve`** — the serve bench family: writes
+  ``SERVE_DETAILS.json`` rows (throughput + inverse-p99, both
+  higher-is-better so the regression gate's floor logic applies
+  unchanged) gated via ``python tools/bench_regress.py --details
+  SERVE_DETAILS.json``.
+
+Usage::
+
+    python tools/loadgen.py --smoke
+    python tools/loadgen.py --requests 400 --rate 800 --burst-every 50 \\
+        --burst-size 20 --details SERVE_DETAILS.json
+    VELES_SIMD_FAULT_PLAN=serve.dispatch:device_lost:3 \\
+        python tools/loadgen.py --smoke   # chaos on
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from veles.simd_tpu import obs  # noqa: E402
+from veles.simd_tpu import serve  # noqa: E402
+
+# the traffic matrix: (op, params factory, signal lengths) — short
+# mixed signals, the dispatch-bound regime serving exists for.  Length
+# spread inside one op lands in 2-3 pow2 buckets, so the run exercises
+# bucketing, not just batching.
+_SOS = None
+
+
+def _sos():
+    global _SOS
+    if _SOS is None:
+        from veles.simd_tpu.ops import iir
+
+        _SOS = iir.butterworth(4, 0.25, "lowpass")
+    return _SOS
+
+
+def _mix():
+    return [
+        ("sosfilt", lambda: {"sos": _sos()}, (384, 500, 777, 1024)),
+        ("lfilter", lambda: {"b": [0.2, 0.3, 0.1],
+                             "a": [1.0, -0.4, 0.1]}, (256, 640)),
+        ("resample_poly", lambda: {"up": 3, "down": 2}, (300, 512)),
+        ("stft", lambda: {"frame_length": 128, "hop": 64},
+         (512, 1000)),
+    ]
+
+
+DEFAULT_TENANTS = ("alice", "bob", "carol")
+
+
+def build_schedule(rng, n_requests: int, rate_hz: float,
+                   burst_every: int = 0, burst_size: int = 0,
+                   tenants=DEFAULT_TENANTS) -> list:
+    """``[(gap_seconds, Request), ...]`` — exponential inter-arrival
+    gaps at ``rate_hz`` (0 = no pacing, submit as fast as possible),
+    with a ``burst_size`` zero-gap burst every ``burst_every``-th
+    arrival (the overload trigger)."""
+    mix = _mix()
+    schedule = []
+    for i in range(n_requests):
+        op, params, lengths = mix[rng.randint(len(mix))]
+        n = int(lengths[rng.randint(len(lengths))])
+        x = rng.randn(n).astype(np.float32)
+        req = serve.Request(op, x, params(),
+                            tenant=tenants[rng.randint(len(tenants))])
+        gap = float(rng.exponential(1.0 / rate_hz)) if rate_hz > 0 \
+            else 0.0
+        if burst_every and burst_size and i and i % burst_every == 0:
+            gap = 0.0
+        schedule.append((gap, req))
+        if burst_every and burst_size and i and i % burst_every == 0:
+            for _ in range(burst_size):
+                op2, params2, lengths2 = mix[rng.randint(len(mix))]
+                n2 = int(lengths2[rng.randint(len(lengths2))])
+                schedule.append((0.0, serve.Request(
+                    op2, rng.randn(n2).astype(np.float32), params2(),
+                    tenant=tenants[rng.randint(len(tenants))])))
+    return schedule
+
+
+def _oracle_answer(req: serve.Request):
+    from veles.simd_tpu.serve.server import _oracle_call
+
+    xs = np.asarray(req.x, np.float32)[None, :]
+    return np.asarray(_oracle_call(req.op, xs, _canonical(req)))[0]
+
+
+def _canonical(req: serve.Request) -> dict:
+    from veles.simd_tpu.serve.server import _OPS
+
+    validate, _ = _OPS[req.op]
+    params, _ = validate(req.params, int(np.shape(req.x)[0]))
+    return params
+
+
+def run_load(server, schedule, *, block: bool = False,
+             block_timeout: float | None = 1.0,
+             result_timeout: float = 120.0,
+             verify: int = 0, rng=None) -> dict:
+    """Submit ``schedule`` against ``server``, wait for every ticket,
+    and return the accounting report (see module docstring for the
+    categories).  ``verify=k`` parity-checks ``k`` randomly sampled
+    answered requests against the NumPy oracle (DEGRADED answers ARE
+    the oracle, so they must match exactly-ish too)."""
+    t0 = time.perf_counter()
+    pairs = []
+    for gap, req in schedule:
+        if gap > 0:
+            time.sleep(gap)
+        pairs.append((req, server.submit(req, block=block,
+                                         timeout=block_timeout)))
+    submitted_s = time.perf_counter() - t0
+    report = {"requests": len(pairs), "ok": 0, "degraded": 0,
+              "shed": 0, "closed": 0, "errors": 0, "lost": 0,
+              "double_answered": 0, "parity_failures": 0,
+              "submit_wall_s": submitted_s}
+    answered = []
+    waits = []
+    for req, ticket in pairs:
+        try:
+            value = ticket.result(timeout=result_timeout)
+        except TimeoutError:
+            report["lost"] += 1
+            continue
+        except serve.Overloaded:
+            report["shed"] += 1
+            continue
+        except serve.ServerClosed:
+            report["closed"] += 1
+            continue
+        except Exception:  # noqa: BLE001 — typed per-request error
+            report["errors"] += 1
+            continue
+        report["degraded" if ticket.degraded else "ok"] += 1
+        answered.append((req, value))
+        if ticket.wait_s is not None:
+            waits.append(ticket.wait_s)
+    report["wall_s"] = time.perf_counter() - t0
+    report["double_answered"] = obs.counter_value(
+        "serve_double_answer") if obs.enabled() else 0
+    if waits:
+        ws = np.sort(np.asarray(waits))
+        report["wait_p50_s"] = float(ws[int(0.50 * (len(ws) - 1))])
+        report["wait_p99_s"] = float(ws[int(0.99 * (len(ws) - 1))])
+        report["wait_max_s"] = float(ws[-1])
+    done = report["ok"] + report["degraded"]
+    report["throughput_rps"] = (done / report["wall_s"]
+                                if report["wall_s"] > 0 else 0.0)
+    if verify and answered:
+        rng = rng or np.random.RandomState(0)
+        idx = rng.choice(len(answered), min(verify, len(answered)),
+                         replace=False)
+        for i in idx:
+            req, got = answered[int(i)]
+            want = _oracle_answer(req)
+            scale = float(np.max(np.abs(want))) or 1.0
+            err = float(np.max(np.abs(np.asarray(got) - want))
+                        / scale)
+            if err > 2e-3:
+                report["parity_failures"] += 1
+    return report
+
+
+def bench_rows(report: dict) -> list:
+    """SERVE_DETAILS.json rows for ``tools/bench_regress.py`` — both
+    higher-is-better (the gate's floor logic assumes throughput rows),
+    so p99 latency is emitted as its inverse."""
+    rows = [{
+        "metric": "serve throughput",
+        "value": round(report["throughput_rps"], 2),
+        "unit": "req/s",
+        "vs_baseline": None,
+    }]
+    if report.get("wait_p99_s"):
+        rows.append({
+            "metric": "serve p99 inverse latency",
+            "value": round(1.0 / report["wait_p99_s"], 2),
+            "unit": "1/s",
+            "vs_baseline": None,
+        })
+    if obs.enabled():
+        snap = obs.snapshot()
+        rows.append({"metric": "serve batches",
+                     "value": float(sum(
+                         c["value"] for c in snap["counters"]
+                         if c["name"] == "serve_batches")),
+                     "unit": "batches", "vs_baseline": None,
+                     "telemetry": {"counters": {
+                         c["name"]: c["value"]
+                         for c in snap["counters"]
+                         if c["name"].startswith(("serve_",
+                                                  "fault_"))}}})
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--requests", type=int, default=300)
+    ap.add_argument("--rate", type=float, default=500.0,
+                    help="Poisson arrival rate, Hz (0 = flat out)")
+    ap.add_argument("--burst-every", type=int, default=40)
+    ap.add_argument("--burst-size", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--max-wait-ms", type=float, default=None)
+    ap.add_argument("--queue-depth", type=int, default=None)
+    ap.add_argument("--tenant-depth", type=int, default=None)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--block", action="store_true",
+                    help="backpressure submits instead of shedding")
+    ap.add_argument("--verify", type=int, default=16,
+                    help="oracle parity sample size (0 = off)")
+    ap.add_argument("--details", default=None,
+                    help="write bench rows here (SERVE_DETAILS.json)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny run, gate on lost/double/parity")
+    args = ap.parse_args(argv)
+
+    from veles.simd_tpu.utils.platform import maybe_override_platform
+
+    maybe_override_platform()
+    obs.enable()
+    obs.reset()
+    if args.smoke:
+        args.requests = min(args.requests, 80)
+        args.rate = 0.0
+    rng = np.random.RandomState(args.seed)
+    schedule = build_schedule(rng, args.requests, args.rate,
+                              args.burst_every, args.burst_size)
+    server = serve.Server(max_batch=args.max_batch,
+                          max_wait_ms=args.max_wait_ms,
+                          queue_depth=args.queue_depth,
+                          tenant_depth=args.tenant_depth,
+                          workers=args.workers)
+    with server:
+        report = run_load(server, schedule, block=args.block,
+                          verify=args.verify, rng=rng)
+        report["health"] = server.stats()["health"]
+    report["dispatch_quantiles"] = obs.quantiles(
+        "span.serve.dispatch", phase="steady")
+    print(json.dumps(report, indent=2, default=str))
+    if args.details:
+        with open(args.details, "w") as f:
+            json.dump(bench_rows(report), f, indent=2)
+        print(f"loadgen: wrote {args.details}", file=sys.stderr)
+    bad = (report["lost"] or report["double_answered"]
+           or report["parity_failures"])
+    if bad:
+        print(f"loadgen: FAILED accounting (lost={report['lost']} "
+              f"double={report['double_answered']} "
+              f"parity={report['parity_failures']})", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

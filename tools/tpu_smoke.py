@@ -515,6 +515,34 @@ def _check_pallas1d(rng):
     return max(errs), 5e-4
 
 
+def _check_serve(rng):
+    """The serving layer end to end on the actual device: a small
+    Server coalescing mixed sosfilt/stft traffic into batched guarded
+    dispatches, answers parity-checked against the per-request NumPy
+    oracle (so bucketing's pad-and-slice is validated on hardware, not
+    just the virtual CPU mesh)."""
+    from veles.simd_tpu import serve
+    from veles.simd_tpu.ops import iir, spectral as sp
+
+    sos = iir.butterworth(4, 0.25, "lowpass")
+    errs = []
+    with serve.Server(max_batch=4, max_wait_ms=10.0,
+                      workers=2) as srv:
+        xs = [rng.randn(n).astype(np.float32)
+              for n in (300, 500, 500, 777)]
+        ts = [srv.submit(serve.Request("sosfilt", x, {"sos": sos}))
+              for x in xs]
+        for x, t in zip(xs, ts):
+            errs.append(_rel_err(t.result(timeout=120.0),
+                                 iir.sosfilt_na(sos, x[None, :])[0]))
+        xq = rng.randn(1024).astype(np.float32)
+        tq = srv.submit(serve.Request(
+            "stft", xq, {"frame_length": 128, "hop": 64}))
+        errs.append(_rel_err(tq.result(timeout=120.0),
+                             sp.stft_na(xq, 128, 64)))
+    return max(errs), 2e-3
+
+
 def _check_pallas2d(rng):
     """The 2D shifted-MAC Mosaic kernel (convolve2d direct route on TPU).
 
@@ -656,6 +684,7 @@ FAMILIES = [
     ("detect_peaks", _check_detect_peaks),
     ("pallas1d", _check_pallas1d),
     ("parallel", _check_parallel),
+    ("serve", _check_serve),
     ("pallas2d", _check_pallas2d),  # wedge suspect: keep last (see check)
 ]
 

@@ -16,7 +16,12 @@ SSE/AVX/NEON SIMD library; see /root/reference) designed TPU-first:
 * every dispatch-time decision (algorithm selection, XLA-vs-oracle routing,
   compiles/cache traffic) is observable through the opt-in runtime telemetry
   package :mod:`veles.simd_tpu.obs` (``obs.enable()`` or
-  ``VELES_SIMD_TELEMETRY=1``), with zero effect on traced programs.
+  ``VELES_SIMD_TELEMETRY=1``), with zero effect on traced programs,
+* heavy heterogeneous traffic rides the serving layer
+  :mod:`veles.simd_tpu.serve` — shape-class bucketing, deadline batching,
+  per-tenant admission control with typed overload sheds, and a
+  fault-degrading HEALTHY/DEGRADED health machine over the
+  :mod:`veles.simd_tpu.runtime.faults` guarded-dispatch policy.
 
 Public API (mirrors the reference's header surface,
 ``/root/reference/inc/simd/``):

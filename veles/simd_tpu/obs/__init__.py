@@ -100,7 +100,7 @@ from veles.simd_tpu.obs.spans import SpanTracer
 __all__ = [
     "enable", "disable", "enabled", "configure",
     "count", "gauge", "observe", "record_decision", "span",
-    "counter_value", "events", "snapshot", "reset",
+    "counter_value", "quantiles", "events", "snapshot", "reset",
     "to_json", "to_prometheus", "report", "save", "load",
     "save_trace", "trace_events",
     "install_compile_listeners",
@@ -248,6 +248,25 @@ def record_decision(op: str, decision: str, **fields) -> None:
 def counter_value(name: str, **labels) -> int:
     """Current value of one counter (0 if never incremented)."""
     return _registry.counter_value(name, **labels)
+
+
+def quantiles(name: str, qs=(0.5, 0.95, 0.99), **labels):
+    """Quantile estimates for one live histogram, or None if it has
+    never been observed: ``{"p50": s, "p95": s, "p99": s}`` with
+    Prometheus ``histogram_quantile`` semantics (upper bucket bound —
+    see :func:`veles.simd_tpu.obs.export.histogram_quantile`).
+
+    ``name`` is the histogram name (``"span.serve.dispatch"``,
+    ``"serve.request_latency"``, ...); ``labels`` must match the
+    recorded label set exactly (a span histogram carries
+    ``phase="warmup"|"steady"``).  The serving layer's ``stats()`` and
+    ``tools/loadgen.py`` read their p99 gates through this instead of
+    re-deriving quantiles from raw samples."""
+    want = {str(k): str(v) for k, v in labels.items()}
+    for h in _registry.snapshot()["histograms"]:
+        if h["name"] == name and h["labels"] == want:
+            return _export.histogram_quantiles(h, qs)
+    return None
 
 
 def events() -> list:

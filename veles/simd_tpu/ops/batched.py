@@ -72,6 +72,11 @@ BATCHED_CACHE_MAXSIZE = 64
 _cache: "collections.OrderedDict[tuple, BatchedHandle]" = \
     collections.OrderedDict()
 _cache_lock = threading.Lock()
+# per-key construction locks: concurrent misses on the SAME key build
+# once (the serving layer fans submit() threads into these ops, so the
+# old build-outside-the-lock race would trace duplicate programs and
+# evict live handles); entries are dropped once the build finishes
+_build_locks: dict = {}
 _cache_stats = {"hits": 0, "misses": 0, "evictions": 0}
 # unified cache introspection: handle_cache_info already has the
 # size/capacity/hits/misses/evictions shape obs.caches() wants
@@ -105,7 +110,17 @@ def _get_handle(key, builder) -> BatchedHandle:
     makes the jitted callable on a miss.  Hits/misses/evictions are
     counted under ``batched_handle_cache`` and a decision event is
     recorded per compile (so a shape-churning caller shows up in the
-    obs report as a stream of misses, not silence)."""
+    obs report as a stream of misses, not silence).
+
+    Construction is race-free: concurrent callers of the same key
+    serialize on a per-key build lock (builds of DIFFERENT keys still
+    overlap — tracing can be slow, so the global map lock is never
+    held across ``builder()``), exactly one thread builds, and the
+    losers return the winner's handle as a hit.  Before the serving
+    layer this was best-effort ("one handle wins the insert"): two
+    threads could trace the same program twice and double-bump the
+    LRU, evicting a live neighbor.
+    """
     with _cache_lock:
         handle = _cache.get(key)
         if handle is not None:
@@ -113,23 +128,44 @@ def _get_handle(key, builder) -> BatchedHandle:
             _cache_stats["hits"] += 1
             obs.count("batched_handle_cache", op=key[0], event="hit")
             return handle
-        _cache_stats["misses"] += 1
-    # build outside the lock (tracing can be slow); worst case two
-    # threads race the same key and one handle wins the insert
-    fn = builder()
-    handle = BatchedHandle(key, fn)
-    obs.count("batched_handle_cache", op=key[0], event="miss")
-    obs.record_decision("batched", key[0], key=repr(key[1:]))
-    with _cache_lock:
-        existing = _cache.get(key)
-        if existing is not None:
-            return existing
-        _cache[key] = handle
-        while len(_cache) > BATCHED_CACHE_MAXSIZE:
-            _cache.popitem(last=False)
-            _cache_stats["evictions"] += 1
-            obs.count("batched_handle_cache", op=key[0],
-                      event="eviction")
+        build_lock = _build_locks.setdefault(key, threading.Lock())
+    with build_lock:
+        with _cache_lock:
+            handle = _cache.get(key)
+            if handle is not None:
+                # another caller finished the build while we waited:
+                # a cache hit from this thread's point of view
+                _cache.move_to_end(key)
+                _cache_stats["hits"] += 1
+                obs.count("batched_handle_cache", op=key[0],
+                          event="hit")
+                return handle
+            _cache_stats["misses"] += 1
+        try:
+            fn = builder()
+        except BaseException:
+            # a failed build must not leave the key permanently locked
+            # (the next caller gets a fresh shot); dropping the entry
+            # is safe — a waiter holding this lock object re-checks
+            # the cache under _cache_lock and misses cleanly
+            with _cache_lock:
+                _build_locks.pop(key, None)
+            raise
+        handle = BatchedHandle(key, fn)
+        obs.count("batched_handle_cache", op=key[0], event="miss")
+        obs.record_decision("batched", key[0], key=repr(key[1:]))
+        with _cache_lock:
+            _cache[key] = handle
+            # drop the build-lock entry ATOMICALLY with the insert: a
+            # pop before the handle lands would open a window where a
+            # fresh caller mints a new lock and traces the same
+            # program twice (the exact race this lock exists to close)
+            _build_locks.pop(key, None)
+            while len(_cache) > BATCHED_CACHE_MAXSIZE:
+                _cache.popitem(last=False)
+                _cache_stats["evictions"] += 1
+                obs.count("batched_handle_cache", op=key[0],
+                          event="eviction")
     return handle
 
 
@@ -146,6 +182,7 @@ def clear_handle_cache() -> None:
     rolling new geometry sets can also use it as a coarse reset)."""
     with _cache_lock:
         _cache.clear()
+        _build_locks.clear()
         for k in _cache_stats:
             _cache_stats[k] = 0
 
@@ -169,6 +206,14 @@ def _as_batch2d(x):
     n = shape[-1]
     if n == 0:
         raise ValueError("empty signal")
+    if 0 in shape[:-1]:
+        # a zero-row batch would otherwise surface as an opaque XLA
+        # shape error deep in the compiled core; the serving layer's
+        # batcher relies on this contract (it never dispatches B=0,
+        # and a bug that tries must fail loudly, not cryptically)
+        raise ValueError(
+            f"empty batch (B=0): batched ops need at least one "
+            f"signal, got shape {shape}")
     return shape[:-1], n
 
 

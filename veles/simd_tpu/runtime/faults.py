@@ -36,12 +36,16 @@ module is the one shared layer, three pieces:
 
 * **deterministic fault injection** (:func:`inject` /
   ``VELES_SIMD_FAULT_PLAN``) — ``site:kind:count,...`` raises
-  synthetic faults (``vmem_oom`` / ``device_lost`` / ``timeout``)
-  whose messages match the real classifiers at named engine sites, so
-  every demotion and retry path runs on CPU CI without hardware or
-  monkeypatching.  :func:`armed` lets route *gates* open for a
-  planned site, so the doomed route is actually selected and the
-  whole demote path executes end to end.
+  synthetic faults (``vmem_oom`` / ``device_lost`` / ``timeout`` /
+  ``overload``) whose messages match the real classifiers at named
+  engine sites, so every demotion and retry path runs on CPU CI
+  without hardware or monkeypatching.  :func:`armed` lets route
+  *gates* open for a planned site, so the doomed route is actually
+  selected and the whole demote path executes end to end.  The
+  serving layer (:mod:`veles.simd_tpu.serve`) adds two sites:
+  ``serve.dispatch`` (batch dispatch, guarded — device-lost/timeout
+  kinds drive retry → DEGRADED) and ``serve.admission`` (the
+  ``overload`` kind forces the typed shed path).
 
 ``bench.py`` stage supervision and ``tools/tpu_smoke.py`` ride the
 same classifiers (per-stage retry + fault record instead of
@@ -63,7 +67,8 @@ from veles.simd_tpu import obs
 
 __all__ = [
     "is_mosaic_vmem_oom", "is_device_lost", "is_timeout", "is_transient",
-    "InjectedFault", "FaultTimeout", "make_fault",
+    "is_overload",
+    "InjectedFault", "FaultTimeout", "make_fault", "monotonic",
     "inject", "armed", "set_fault_plan", "fault_plan", "plan_snapshot",
     "demote_and_remember", "guarded", "register_rejection_cache",
     "fault_retries", "fault_backoff", "fault_deadline", "backoff_delay",
@@ -141,9 +146,33 @@ def is_timeout(e: BaseException) -> bool:
 
 def is_transient(e: BaseException) -> bool:
     """Worth retrying?  Device losses and timeouts are; compile
-    rejections (:func:`is_mosaic_vmem_oom`) and ordinary bugs are
-    not."""
+    rejections (:func:`is_mosaic_vmem_oom`), admission overloads
+    (:func:`is_overload` — retrying into a full queue is how retry
+    storms start), and ordinary bugs are not."""
     return is_device_lost(e) or is_timeout(e)
+
+
+_OVERLOAD_MARKERS = (
+    "resource_exhausted", "queue full",
+)
+
+
+def is_overload(e: BaseException) -> bool:
+    """An admission-capacity rejection (the serving layer's typed shed
+    path, or an injected ``overload`` fault at ``serve.admission``).
+    Deliberately NOT transient: the caller gets a typed answer now
+    instead of a queued timeout later."""
+    msg = str(e).lower()
+    return any(m in msg for m in _OVERLOAD_MARKERS)
+
+
+def monotonic() -> float:
+    """The engine's deadline clock (monotonic seconds).  The serving
+    layer's batching deadlines and backpressure timeouts read THIS
+    instead of ``time.*`` — ``tools/lint.py`` bans raw clock reads
+    under ``serve/`` so latency measurement stays on ``obs.span`` and
+    deadline arithmetic stays on one shared clock."""
+    return time.monotonic()
 
 
 def _fault_kind(e: BaseException) -> str:
@@ -166,7 +195,7 @@ class FaultTimeout(RuntimeError):
     its deadline (classified transient by :func:`is_timeout`)."""
 
 
-FAULT_KINDS = ("vmem_oom", "device_lost", "timeout")
+FAULT_KINDS = ("vmem_oom", "device_lost", "timeout", "overload")
 
 _FAULT_MESSAGES = {
     "vmem_oom": ("Ran out of memory in memory space vmem while "
@@ -175,6 +204,12 @@ _FAULT_MESSAGES = {
     "device_lost": "UNAVAILABLE: device unreachable (injected at %s)",
     "timeout": ("DEADLINE_EXCEEDED: dispatch deadline overrun "
                 "(injected at %s)"),
+    # the serve chaos kind: forces the admission controller's typed
+    # shed path deterministically (classified by is_overload, never
+    # retried) so overload behavior runs on CPU CI without having to
+    # race a queue full
+    "overload": ("RESOURCE_EXHAUSTED: admission queue full (injected "
+                 "at %s)"),
 }
 
 

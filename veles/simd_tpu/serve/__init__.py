@@ -1,0 +1,64 @@
+"""veles.simd_tpu.serve — the resilient request path in front of the ops.
+
+The "millions of users" front half (ROADMAP item 1): every op in this
+library is a one-shot call, which at short-signal sizes is
+dispatch-bound by design — the throughput form of heterogeneous
+traffic is *coalesced* dispatch.  This package is the serving loop
+that does the coalescing and, more importantly, keeps answering when
+the traffic or the hardware misbehaves:
+
+* :class:`~veles.simd_tpu.serve.server.Server` — submit
+  :class:`~veles.simd_tpu.serve.server.Request`\\ s
+  (op + signal + params + tenant), get
+  :class:`~veles.simd_tpu.serve.server.Ticket`\\ s; requests are
+  bucketed by shape class, zero-padded to power-of-two buckets, and
+  dispatched as batches through the
+  :mod:`veles.simd_tpu.ops.batched` compiled-handle LRU;
+* :mod:`~veles.simd_tpu.serve.batcher` — the dynamic-batching policy:
+  a bucket dispatches when full (``max_batch``) or when its oldest
+  request hits the latency deadline (``max_wait``), whichever fires
+  first;
+* :mod:`~veles.simd_tpu.serve.admission` — bounded global/per-tenant
+  queue depth; over-limit submits are answered *immediately* with a
+  typed :class:`~veles.simd_tpu.serve.admission.Overloaded` (never
+  queued to time out), or block-with-deadline when the caller opts
+  into backpressure;
+* :mod:`~veles.simd_tpu.serve.health` — the HEALTHY/DEGRADED state
+  machine over :func:`veles.simd_tpu.runtime.faults.guarded`
+  dispatch: transient device faults retry, persistent ones degrade
+  the server to the NumPy oracle (parity-correct answers, flight
+  recorder armed) while zero-retry probes hunt for recovery.
+
+Knobs (constructor args override the environment):
+``VELES_SIMD_SERVE_MAX_BATCH``, ``VELES_SIMD_SERVE_MAX_WAIT_MS``,
+``VELES_SIMD_SERVE_QUEUE_DEPTH``, ``VELES_SIMD_SERVE_TENANT_DEPTH``.
+Chaos: ``VELES_SIMD_FAULT_PLAN`` sites ``serve.dispatch``
+(device_lost/timeout -> retry/degrade) and ``serve.admission``
+(overload -> deterministic shed).  ``tools/loadgen.py`` drives all of
+it (Poisson + burst arrivals, mixed tenants) as the chaos harness and
+the ``make bench-serve`` family.
+"""
+
+from veles.simd_tpu.serve.admission import (DEFAULT_QUEUE_DEPTH,
+                                            DEFAULT_TENANT_DEPTH,
+                                            QUEUE_DEPTH_ENV,
+                                            TENANT_DEPTH_ENV,
+                                            AdmissionController,
+                                            Overloaded)
+from veles.simd_tpu.serve.batcher import (DEFAULT_MAX_BATCH,
+                                          DEFAULT_MAX_WAIT_MS,
+                                          MAX_BATCH_ENV, MAX_WAIT_ENV,
+                                          Batcher, bucket_length)
+from veles.simd_tpu.serve.health import (DEGRADED, HEALTHY,
+                                         HealthMonitor)
+from veles.simd_tpu.serve.server import (SUPPORTED_OPS, Request,
+                                         Server, ServerClosed, Ticket)
+
+__all__ = [
+    "Server", "Request", "Ticket", "ServerClosed", "Overloaded",
+    "AdmissionController", "Batcher", "HealthMonitor",
+    "bucket_length", "SUPPORTED_OPS", "HEALTHY", "DEGRADED",
+    "MAX_BATCH_ENV", "MAX_WAIT_ENV", "QUEUE_DEPTH_ENV",
+    "TENANT_DEPTH_ENV", "DEFAULT_MAX_BATCH", "DEFAULT_MAX_WAIT_MS",
+    "DEFAULT_QUEUE_DEPTH", "DEFAULT_TENANT_DEPTH",
+]

@@ -1,0 +1,193 @@
+"""Deadline batcher: shape-class buckets, max_batch/max_wait coalescing.
+
+The dynamic-batching policy every serving stack converges on (TINA's
+keep-the-MXU-busy discipline, arXiv:2408.16551, applied to the request
+path): queued requests are grouped by **shape class** — the (op,
+params, :func:`bucket_length`) triple that keys one compiled handle in
+:mod:`veles.simd_tpu.ops.batched` — and a group is dispatched when
+EITHER
+
+* it holds ``max_batch`` requests (the batch is full — waiting longer
+  buys nothing), OR
+* its oldest request has waited ``max_wait`` seconds (the latency
+  deadline — waiting longer costs p99).
+
+``close()`` makes every queued request immediately ready (drain), and
+:meth:`next_batch` returns None only when the batcher is closed AND
+empty — the worker-loop exit condition, so no request can be left
+behind in a bucket.
+
+Signals inside a class are zero-padded to the class's pow-of-two
+bucket length (:func:`veles.simd_tpu.runtime.routing.pow2_bucket`) —
+exactly the boundary padding the ops already apply implicitly, so the
+sliced-back outputs are the unpadded answers — which keeps the live
+set of compiled programs logarithmic in the length spread instead of
+linear in distinct lengths.
+
+All deadline arithmetic reads
+:func:`veles.simd_tpu.runtime.faults.monotonic` (the serve lint rule
+bans raw ``time.*`` here); waits park on one condition variable, so an
+idle batcher costs nothing.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+
+from veles.simd_tpu.runtime import faults, routing
+
+__all__ = [
+    "Batcher", "bucket_length",
+    "MAX_BATCH_ENV", "MAX_WAIT_ENV",
+    "DEFAULT_MAX_BATCH", "DEFAULT_MAX_WAIT_MS", "env_policy",
+]
+
+MAX_BATCH_ENV = "VELES_SIMD_SERVE_MAX_BATCH"
+MAX_WAIT_ENV = "VELES_SIMD_SERVE_MAX_WAIT_MS"
+
+# max_batch 8 fills a handle-LRU geometry without starving mixed
+# traffic; 2 ms max_wait trades ~one dispatch round trip of added
+# latency for up-to-8x fewer dispatches.  Both env-tunable.
+DEFAULT_MAX_BATCH = 8
+DEFAULT_MAX_WAIT_MS = 2.0
+
+# minimum condition wait: a sub-ms residual deadline must not spin
+_MIN_WAIT_S = 0.0005
+
+
+def _env_pos(name: str, default, cast):
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        value = cast(raw)
+    except ValueError:
+        return default
+    return value if value > 0 else default
+
+
+def env_policy() -> tuple:
+    """``(max_batch, max_wait_s)`` from the environment
+    (``$VELES_SIMD_SERVE_MAX_BATCH`` / ``_MAX_WAIT_MS``), falling back
+    to the defaults."""
+    return (_env_pos(MAX_BATCH_ENV, DEFAULT_MAX_BATCH, int),
+            _env_pos(MAX_WAIT_ENV, DEFAULT_MAX_WAIT_MS, float) / 1e3)
+
+
+def bucket_length(n: int) -> int:
+    """The padded signal length of ``n``'s shape class (next power of
+    two — the same classing the autotune cache uses, so a serve bucket
+    and a tune-cache geometry class coincide)."""
+    return routing.pow2_bucket(int(n))
+
+
+class Batcher:
+    """Bucketed FIFO queues + the deadline policy behind one condition.
+
+    Items are opaque to the batcher except for one attribute: ``enq``,
+    the :func:`faults.monotonic` enqueue stamp the deadline is measured
+    from (the server's pending-request record carries it).
+    """
+
+    def __init__(self, max_batch: int | None = None,
+                 max_wait_s: float | None = None):
+        env_b, env_w = env_policy()
+        self.max_batch = int(max_batch) if max_batch else env_b
+        self.max_wait_s = (float(max_wait_s) if max_wait_s is not None
+                           else env_w)
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if self.max_wait_s < 0:
+            raise ValueError("max_wait_s must be >= 0")
+        self._cond = threading.Condition()
+        self._buckets: "collections.OrderedDict[object, collections.deque]" \
+            = collections.OrderedDict()
+        self._closed = False
+
+    # -- producer side -----------------------------------------------------
+
+    def put(self, key, item) -> None:
+        """Queue ``item`` under shape-class ``key``; wakes a worker.
+        Raises RuntimeError once closed (the server translates that
+        into a typed shutdown answer)."""
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            q = self._buckets.get(key)
+            if q is None:
+                q = self._buckets[key] = collections.deque()
+            q.append(item)
+            self._cond.notify()
+
+    def close(self) -> None:
+        """Stop accepting; every queued request becomes immediately
+        ready (drain) and workers unblock."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    # -- worker side -------------------------------------------------------
+
+    def _ready_key(self, now: float):
+        """The ready bucket with the oldest head (fairness), or None.
+        Ready = full, past its head's deadline, or draining."""
+        best, best_enq = None, None
+        for key, q in self._buckets.items():
+            head_enq = q[0].enq
+            ready = (self._closed or len(q) >= self.max_batch
+                     or now - head_enq >= self.max_wait_s)
+            if ready and (best is None or head_enq < best_enq):
+                best, best_enq = key, head_enq
+        return best
+
+    def _next_deadline(self, now: float) -> float | None:
+        """Seconds until the earliest head deadline (None = no queued
+        work, wait for a put)."""
+        soonest = None
+        for q in self._buckets.values():
+            remaining = q[0].enq + self.max_wait_s - now
+            if soonest is None or remaining < soonest:
+                soonest = remaining
+        return soonest
+
+    def next_batch(self):
+        """Block until one shape class is ready; returns ``(key,
+        [items...])`` (FIFO within the class, at most ``max_batch``),
+        or None when closed and fully drained."""
+        with self._cond:
+            while True:
+                now = faults.monotonic()
+                key = self._ready_key(now)
+                if key is not None:
+                    q = self._buckets[key]
+                    take = min(self.max_batch, len(q))
+                    batch = [q.popleft() for _ in range(take)]
+                    if not q:
+                        del self._buckets[key]
+                    return key, batch
+                if self._closed and not self._buckets:
+                    return None
+                wait = self._next_deadline(now)
+                if wait is not None:
+                    wait = max(wait, _MIN_WAIT_S)
+                self._cond.wait(wait)
+
+    # -- introspection -----------------------------------------------------
+
+    def pending(self) -> int:
+        """Requests currently queued across every shape class."""
+        with self._cond:
+            return sum(len(q) for q in self._buckets.values())
+
+    def snapshot(self) -> dict:
+        """JSON-native view: policy knobs + per-class queue lengths."""
+        with self._cond:
+            return {"max_batch": self.max_batch,
+                    "max_wait_s": self.max_wait_s,
+                    "closed": self._closed,
+                    "pending": sum(len(q)
+                                   for q in self._buckets.values()),
+                    "classes": {repr(k): len(q)
+                                for k, q in self._buckets.items()}}

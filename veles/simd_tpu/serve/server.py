@@ -1,0 +1,522 @@
+"""The serving loop: heterogeneous requests -> batched guarded dispatch.
+
+:class:`Server` is the production request path in front of the op
+families — the composition of every robustness layer the runtime
+already has, plus the one loop none of them provided:
+
+* **shape-class bucketing** — a request's ``(op, params,
+  pow2-bucketed length)`` picks a bucket; signals are zero-padded to
+  the bucket length (the ops' own implicit boundary padding, so the
+  sliced-back outputs are exact) and batches are row-padded to a power
+  of two, so the whole traffic mix shares a logarithmic set of
+  compiled handles in the :mod:`veles.simd_tpu.ops.batched` LRU;
+* **deadline batching** — :class:`~veles.simd_tpu.serve.batcher.
+  Batcher` dispatches a bucket when it is full (``max_batch``) or its
+  oldest request has waited ``max_wait`` (whichever fires first);
+* **admission control + backpressure** — :class:`~veles.simd_tpu.
+  serve.admission.AdmissionController` bounds global and per-tenant
+  queue depth; over-limit submits get a typed
+  :class:`~veles.simd_tpu.serve.admission.Overloaded` *immediately*
+  (``submit(block=True, timeout=...)`` opts into block-with-deadline
+  backpressure instead);
+* **guarded dispatch + health machine** — every device batch runs
+  under :func:`veles.simd_tpu.runtime.faults.guarded` at the
+  ``serve.dispatch`` site (bounded jittered retry on transient
+  faults; flight recorder on exhaustion).  Retry exhaustion trips the
+  :class:`~veles.simd_tpu.serve.health.HealthMonitor` into DEGRADED —
+  batches are answered by the NumPy oracle, every ``probe_every``-th
+  batch probes the device with a zero-retry budget, and the first
+  probe that lands flips back to HEALTHY;
+* **observability** — ``serve.dispatch`` spans (p50/p95/p99 via the
+  obs histograms), ``serve.request_latency`` / ``serve.batch_fill``
+  histograms, queue-depth gauges, and shed/degrade/probe counters,
+  all in ``obs.to_prometheus()``.
+
+Usage::
+
+    from veles.simd_tpu import serve
+
+    with serve.Server(max_batch=8, max_wait_ms=2.0) as srv:
+        t = srv.submit(serve.Request("sosfilt", x, {"sos": sos},
+                                     tenant="alice"))
+        y = t.result(timeout=5.0)       # raises Overloaded if shed
+
+Supported ops (``SUPPORTED_OPS``): ``resample_poly`` (params
+``up``/``down``), ``sosfilt`` (``sos``), ``lfilter`` (``b``/``a``),
+``stft`` (``frame_length``/``hop``).  Each answers with the same
+numerics as its single-call twin; DEGRADED-mode answers are the NumPy
+oracle's (parity-tested, flagged ``degraded`` on the ticket).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+import numpy as np
+
+from veles.simd_tpu import obs
+from veles.simd_tpu.ops import batched
+from veles.simd_tpu.ops import iir as _iir
+from veles.simd_tpu.ops import resample as _rs
+from veles.simd_tpu.ops import spectral as _sp
+from veles.simd_tpu.runtime import faults
+from veles.simd_tpu.serve.admission import (AdmissionController,
+                                            Overloaded)
+from veles.simd_tpu.serve.batcher import Batcher, bucket_length
+from veles.simd_tpu.serve.health import (DEFAULT_PROBE_EVERY,
+                                         HealthMonitor)
+
+__all__ = ["Request", "Ticket", "Server", "ServerClosed",
+           "SUPPORTED_OPS", "DEFAULT_WORKERS"]
+
+# two workers overlap one batch's host-side padding/slicing with the
+# previous batch's device wait without oversubscribing dispatch
+DEFAULT_WORKERS = 2
+
+
+class ServerClosed(RuntimeError):
+    """The server stopped before this request could be answered (or a
+    submit raced :meth:`Server.stop`)."""
+
+
+@dataclasses.dataclass
+class Request:
+    """One unit of traffic: op name + 1-D float signal + op params +
+    tenant id (the admission-control identity)."""
+
+    op: str
+    x: object
+    params: dict = dataclasses.field(default_factory=dict)
+    tenant: str = "default"
+
+
+class Ticket:
+    """The caller's handle on one submitted request.
+
+    Completed exactly once by the server (a second completion attempt
+    raises and bumps ``serve_double_answer`` — the concurrency suite's
+    invariant).  ``status`` is one of ``pending`` / ``ok`` /
+    ``degraded`` (oracle-served while DEGRADED) / ``shed`` (typed
+    :class:`Overloaded`) / ``closed`` / ``error``.
+    """
+
+    __slots__ = ("op", "tenant", "status", "wait_s", "_event",
+                 "_value", "_error", "_lock")
+
+    def __init__(self, op: str, tenant: str):
+        self.op = op
+        self.tenant = tenant
+        self.status = "pending"
+        self.wait_s = None
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+        self._lock = threading.Lock()
+
+    def _complete(self, *, value=None, error=None, status="ok",
+                  wait_s=None) -> None:
+        with self._lock:
+            if self._event.is_set():
+                obs.count("serve_double_answer", op=self.op)
+                raise RuntimeError(
+                    f"ticket for {self.op!r} completed twice "
+                    f"(was {self.status!r}, second {status!r})")
+            self._value = value
+            self._error = error
+            self.status = status
+            self.wait_s = wait_s
+            self._event.set()
+
+    def done(self) -> bool:
+        """Answered (any status but ``pending``)?"""
+        return self._event.is_set()
+
+    @property
+    def degraded(self) -> bool:
+        """Was the answer served by the oracle in DEGRADED mode?"""
+        return self.status == "degraded"
+
+    def result(self, timeout: float | None = None):
+        """Block for the answer.  Returns the output array (``ok`` /
+        ``degraded``); raises the typed error for ``shed`` /
+        ``closed`` / ``error``; raises TimeoutError if unanswered
+        within ``timeout``."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.op!r} unanswered after {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class _Pending:
+    """One queued request inside the server (batcher item: ``enq`` is
+    the deadline stamp; ``released`` guards the admission slot against
+    double release when a batch fails midway)."""
+
+    __slots__ = ("ticket", "x", "n", "params", "enq", "released")
+
+    def __init__(self, ticket, x, n, params, enq):
+        self.ticket = ticket
+        self.x = x
+        self.n = n
+        self.params = params
+        self.enq = enq
+        self.released = False
+
+
+# ---------------------------------------------------------------------------
+# op adapters: validation, shape-class keys, output slicing
+# ---------------------------------------------------------------------------
+
+
+def _validate_resample(params: dict, n: int) -> tuple:
+    up, down = int(params.get("up", 1)), int(params.get("down", 1))
+    if up < 1 or down < 1:
+        raise ValueError(f"up and down must be >= 1, got {up}, {down}")
+    return {"up": up, "down": down}, (up, down)
+
+
+def _slice_resample(row, n: int, params: dict):
+    return row[: _rs.resample_length(n, params["up"], params["down"])]
+
+
+def _validate_sosfilt(params: dict, n: int) -> tuple:
+    sos = _iir._check_sos(params.get("sos"))
+    key = tuple(tuple(float(v) for v in r) for r in np.asarray(sos))
+    return {"sos": np.asarray(sos)}, key
+
+
+def _validate_lfilter(params: dict, n: int) -> tuple:
+    b, a = _iir._normalize_ba(params.get("b"), params.get("a"))
+    bk = tuple(float(v) for v in b)
+    ak = tuple(float(v) for v in a)
+    return {"b": np.asarray(b), "a": np.asarray(a)}, (bk, ak)
+
+
+def _slice_rows(row, n: int, params: dict):
+    return row[:n]
+
+
+def _validate_stft(params: dict, n: int) -> tuple:
+    fl = int(params.get("frame_length", 0))
+    hop = int(params.get("hop", max(1, fl // 2)))
+    _sp._check_stft_args(n, fl, hop)
+    return {"frame_length": fl, "hop": hop}, (fl, hop)
+
+
+def _slice_stft(row, n: int, params: dict):
+    return row[: _sp.frame_count(n, params["frame_length"],
+                                 params["hop"])]
+
+
+# op -> (validate(params, n) -> (canonical_params, param_key),
+#        slice(out_row, n, params) -> unpadded answer)
+_OPS = {
+    "resample_poly": (_validate_resample, _slice_resample),
+    "sosfilt": (_validate_sosfilt, _slice_rows),
+    "lfilter": (_validate_lfilter, _slice_rows),
+    "stft": (_validate_stft, _slice_stft),
+}
+
+SUPPORTED_OPS = tuple(sorted(_OPS))
+
+
+def _device_call(op: str, xs, params: dict, donate: bool):
+    """The device dispatch for one padded batch — always invoked
+    inside a ``faults.guarded`` thunk (lint-enforced), so transient
+    faults ride the retry/degrade policy."""
+    if op == "resample_poly":
+        return batched.batched_resample_poly(
+            xs, params["up"], params["down"], simd=True, donate=donate)
+    if op == "sosfilt":
+        return batched.batched_sosfilt(params["sos"], xs, simd=True,
+                                       donate=donate)
+    if op == "lfilter":
+        return batched.batched_lfilter(params["b"], params["a"], xs,
+                                       simd=True, donate=donate)
+    if op == "stft":
+        return batched.batched_stft(xs, params["frame_length"],
+                                    params["hop"], simd=True)
+    raise ValueError(f"unsupported op {op!r}")
+
+
+def _oracle_call(op: str, xs, params: dict):
+    """The NumPy oracle twin of :func:`_device_call` (``simd=False``)
+    — the DEGRADED-mode answer path; cannot fault."""
+    if op == "resample_poly":
+        return batched.batched_resample_poly(
+            xs, params["up"], params["down"], simd=False)
+    if op == "sosfilt":
+        return batched.batched_sosfilt(params["sos"], xs, simd=False)
+    if op == "lfilter":
+        return batched.batched_lfilter(params["b"], params["a"], xs,
+                                       simd=False)
+    if op == "stft":
+        return batched.batched_stft(xs, params["frame_length"],
+                                    params["hop"], simd=False)
+    raise ValueError(f"unsupported op {op!r}")
+
+
+# ---------------------------------------------------------------------------
+# the server
+# ---------------------------------------------------------------------------
+
+
+class Server:
+    """Deadline-batched, admission-controlled, fault-tolerant serving
+    loop over the batched op families (module docstring has the full
+    story).  Use as a context manager, or :meth:`start` /
+    :meth:`stop` explicitly."""
+
+    def __init__(self, *, max_batch: int | None = None,
+                 max_wait_ms: float | None = None,
+                 queue_depth: int | None = None,
+                 tenant_depth: int | None = None,
+                 workers: int = DEFAULT_WORKERS,
+                 probe_every: int = DEFAULT_PROBE_EVERY,
+                 donate: bool = False):
+        max_wait_s = (None if max_wait_ms is None
+                      else float(max_wait_ms) / 1e3)
+        self._batcher = Batcher(max_batch, max_wait_s)
+        self._admission = AdmissionController(queue_depth,
+                                              tenant_depth)
+        self._health = HealthMonitor(probe_every)
+        self.workers = int(workers)
+        if self.workers < 1:
+            raise ValueError("workers must be >= 1")
+        self.donate = bool(donate)
+        self._threads: list = []
+        self._stats_lock = threading.Lock()
+        self._stats = {"submitted": 0, "completed": 0, "shed": 0,
+                       "degraded_answers": 0, "errors": 0,
+                       "batches": 0, "batched_requests": 0}
+        self._started = False
+        self._stopped = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Server":
+        """Spawn the worker pool (idempotent)."""
+        if self._stopped:
+            raise ServerClosed("server already stopped")
+        if self._started:
+            return self
+        self._started = True
+        for i in range(self.workers):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"veles-serve-worker-{i}")
+            t.start()
+            self._threads.append(t)
+        obs.gauge("serve_healthy", 1.0)
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Close the intake and join the workers.  ``drain=True``
+        (default) answers everything already queued first;
+        ``drain=False`` fails queued requests with
+        :class:`ServerClosed`."""
+        self._stopped = True
+        if not drain:
+            # workers see _abandoned and complete without dispatching
+            self._abandoned = True
+        self._batcher.close()
+        for t in self._threads:
+            t.join()
+        self._threads = []
+
+    _abandoned = False
+
+    def __enter__(self) -> "Server":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.stop(drain=exc_type is None)
+        return False
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(self, request: Request | None = None, *,
+               op: str | None = None, x=None, params: dict | None = None,
+               tenant: str = "default", block: bool = False,
+               timeout: float | None = None) -> Ticket:
+        """Queue one request; returns its :class:`Ticket`.
+
+        Admission rejections complete the ticket immediately with a
+        typed :class:`Overloaded` (``status="shed"``) — pass
+        ``block=True`` (+ ``timeout``) for backpressure instead of
+        shedding.  Malformed requests raise ValueError synchronously
+        (a caller bug, not traffic)."""
+        if request is None:
+            request = Request(op=op, x=x, params=params or {},
+                              tenant=tenant)
+        if request.op not in _OPS:
+            raise ValueError(
+                f"unsupported op {request.op!r} "
+                f"(supported: {', '.join(SUPPORTED_OPS)})")
+        xarr = np.asarray(request.x, np.float32)
+        if xarr.ndim != 1 or xarr.shape[0] == 0:
+            raise ValueError(
+                f"requests carry one 1-D signal, got shape "
+                f"{xarr.shape}")
+        n = int(xarr.shape[0])
+        validate, _ = _OPS[request.op]
+        cparams, param_key = validate(request.params, n)
+        if self._stopped:
+            raise ServerClosed("server is stopped")
+        ticket = Ticket(request.op, request.tenant)
+        try:
+            self._admission.admit(request.tenant, block=block,
+                                  timeout=timeout)
+        except Overloaded as e:
+            with self._stats_lock:
+                self._stats["shed"] += 1
+            ticket._complete(error=e, status="shed")
+            return ticket
+        pend = _Pending(ticket, xarr, n, cparams,
+                        faults.monotonic())
+        key = (request.op, param_key, bucket_length(n))
+        try:
+            self._batcher.put(key, pend)
+        except RuntimeError:
+            # raced stop(): hand the slot back and answer typed
+            self._admission.release(request.tenant)
+            ticket._complete(error=ServerClosed("server is stopped"),
+                             status="closed")
+            return ticket
+        with self._stats_lock:
+            self._stats["submitted"] += 1
+        obs.count("serve_submitted", op=request.op,
+                  tenant=request.tenant)
+        return ticket
+
+    # -- the worker loop ---------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            got = self._batcher.next_batch()
+            if got is None:
+                return
+            key, batch = got
+            try:
+                self._run_batch(key, batch)
+            except Exception as e:  # noqa: BLE001 — never lose a batch
+                # a non-transient dispatch bug must answer every
+                # ticket (typed), release admission, and keep the
+                # worker alive for the next batch
+                obs.count("serve_batch_error", op=key[0])
+                errored = 0
+                for p in batch:
+                    if not p.ticket.done():
+                        p.ticket._complete(error=e, status="error")
+                        errored += 1
+                    self._release(p)
+                # rows answered before the exception already counted
+                # themselves as completed; only the ones THIS handler
+                # failed are errors — submitted/completed/errors must
+                # reconcile with ticket outcomes
+                with self._stats_lock:
+                    self._stats["errors"] += errored
+
+    def _release(self, pend: _Pending) -> None:
+        """Free ``pend``'s admission slot exactly once."""
+        if not pend.released:
+            pend.released = True
+            self._admission.release(pend.ticket.tenant)
+
+    def _run_batch(self, key, batch) -> None:
+        op, _, nb = key
+        if self._abandoned:
+            for p in batch:
+                p.ticket._complete(
+                    error=ServerClosed("server stopped before "
+                                       "dispatch"),
+                    status="closed")
+                self._release(p)
+            return
+        rows = len(batch)
+        # row-pad to the power-of-two class so occupancy churn shares
+        # compiled handles instead of minting one per batch size
+        rpad = bucket_length(rows)
+        xs = np.zeros((rpad, nb), np.float32)
+        for i, p in enumerate(batch):
+            xs[i, :p.n] = p.x
+        params = batch[0].params
+        with obs.span("serve.dispatch", op=op, rows=rpad, n=nb):
+            ys, degraded = self._dispatch(op, xs, params)
+        ys = np.asarray(ys)
+        now = faults.monotonic()
+        _, slicer = _OPS[op]
+        status = "degraded" if degraded else "ok"
+        for i, p in enumerate(batch):
+            wait = now - p.enq
+            obs.observe("serve.request_latency", wait, op=op)
+            p.ticket._complete(value=slicer(ys[i], p.n, p.params),
+                               status=status, wait_s=wait)
+            self._release(p)
+            obs.count("serve_completed", op=op, status=status)
+            # per-row, not bulk-at-the-end: a slicer failure midway
+            # must leave the tally matching the tickets actually
+            # answered (the worker's handler counts the rest as
+            # errors)
+            with self._stats_lock:
+                self._stats["completed"] += 1
+                if degraded:
+                    self._stats["degraded_answers"] += 1
+        obs.observe("serve.batch_fill",
+                    rows / self._batcher.max_batch, op=op)
+        obs.count("serve_batches", op=op)
+        with self._stats_lock:
+            self._stats["batches"] += 1
+            self._stats["batched_requests"] += rows
+
+    def _dispatch(self, op: str, xs, params: dict) -> tuple:
+        """One batch through the health machine + fault policy;
+        returns ``(outputs, degraded)``."""
+        probe = False
+        if self._health.degraded:
+            probe = self._health.note_degraded_batch()
+            if not probe:
+                obs.count("serve_degraded_batch", op=op)
+                return _oracle_call(op, xs, params), True
+        box = {"tripped": False}
+        donate = self.donate
+
+        def thunk():
+            return _device_call(op, xs, params, donate)
+
+        def fallback():
+            box["tripped"] = True
+            self._health.trip("serve.dispatch")
+            obs.count("serve_degraded_batch", op=op)
+            return _oracle_call(op, xs, params)
+
+        ys = faults.guarded("serve.dispatch", thunk,
+                            fallback=fallback, fallback_name="oracle",
+                            retries=(0 if probe else None))
+        if not box["tripped"] and probe:
+            self._health.recover("serve.dispatch")
+        return ys, box["tripped"]
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def health(self) -> str:
+        """Current health state (``healthy`` / ``degraded``)."""
+        return self._health.state
+
+    def stats(self) -> dict:
+        """JSON-native snapshot: request tallies, admission depths,
+        batcher state, health machine, and (telemetry on) the
+        steady-state p50/p95/p99 of the ``serve.dispatch`` span."""
+        with self._stats_lock:
+            counts = dict(self._stats)
+        return {
+            "counts": counts,
+            "admission": self._admission.snapshot(),
+            "batcher": self._batcher.snapshot(),
+            "health": self._health.snapshot(),
+            "dispatch_quantiles": obs.quantiles(
+                "span.serve.dispatch", phase="steady"),
+        }
