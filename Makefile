@@ -6,9 +6,9 @@
 PYTHON ?= python
 
 .PHONY: all tests tests-quick benchmarks bench bench-regress \
-        bench-multichip bench-serve serve-smoke cshim cshim-check \
-        wavelet-tables lint docs obs-report autotune-pack install \
-        install-hooks clean
+        bench-multichip bench-serve serve-smoke chaos-smoke cshim \
+        cshim-check wavelet-tables lint docs obs-report autotune-pack \
+        install install-hooks clean
 
 all: cshim
 
@@ -53,6 +53,16 @@ bench-serve:
 # oracle parity gate); the chaos variant arms VELES_SIMD_FAULT_PLAN
 serve-smoke:
 	VELES_SIMD_PLATFORM=cpu $(PYTHON) tools/loadgen.py --smoke
+
+# the scripted chaos campaign on CPU: overload -> mid-campaign device
+# loss (one poisoned serve class + the sharded mesh) -> recovery,
+# gating on zero lost / zero double-answered requests, typed errors
+# only, bounded deadline misses, breaker open->half-open->closed, and
+# mesh_degrade + recovery (tools/chaos.py; CHAOS_DETAILS.json rows
+# gate via `python tools/bench_regress.py --details CHAOS_DETAILS.json`)
+chaos-smoke:
+	VELES_SIMD_PLATFORM=cpu VELES_SIMD_FAULT_BACKOFF=0 \
+		$(PYTHON) tools/chaos.py --smoke
 
 cshim:
 	$(MAKE) -C csrc all

@@ -1,0 +1,362 @@
+"""Per-class circuit breakers (``veles/simd_tpu/runtime/breaker.py``).
+
+Unit coverage of the closed -> open -> half-open machine (sliding
+window, call-counted probe cadence, transition decision events and
+gauges), the :func:`faults.guarded` outcome wiring, the serve layer's
+per-shape-class gating (a poisoned class goes straight-to-oracle with
+zero retries while siblings dispatch normally — the PR's breaker
+efficacy criterion), and the parallel layer's mesh-loss degradation
+(``mesh_degrade`` to the single-chip twin, breaker-gated, probed
+recovery).  All injection-driven on the virtual CPU mesh — no
+monkeypatching.
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from veles.simd_tpu import obs, serve  # noqa: E402
+from veles.simd_tpu.ops import iir  # noqa: E402
+from veles.simd_tpu.runtime import breaker, faults  # noqa: E402
+
+RNG = np.random.RandomState(77)
+SOS = iir.butterworth(4, 0.25, "lowpass")
+
+
+@pytest.fixture
+def telemetry(monkeypatch):
+    """Telemetry on, zero backoff, fresh breaker registry and plans."""
+    monkeypatch.setenv("VELES_SIMD_FAULT_BACKOFF", "0")
+    obs.enable(compile_listeners=False)
+    obs.reset()
+    breaker.reset()
+    faults.reset_fault_history()
+    yield
+    obs.disable()
+    obs.reset()
+    breaker.reset()
+    faults.reset_fault_history()
+    faults.set_fault_plan(None)
+
+
+# ---------------------------------------------------------------------------
+# the state machine
+# ---------------------------------------------------------------------------
+
+class TestBreakerMachine:
+    def test_opens_at_failure_rate(self, telemetry):
+        br = breaker.Breaker("s", "k", window=4, threshold=0.5,
+                            min_events=2, probe_every=4)
+        assert br.admit() == breaker.CLOSED
+        br.failure()
+        assert br.state == breaker.CLOSED     # 1 < min_events
+        br.failure()
+        assert br.state == breaker.OPEN       # 2/2 >= 0.5
+
+    def test_successes_keep_it_closed(self, telemetry):
+        br = breaker.Breaker("s", "k", window=4, threshold=0.5,
+                            min_events=2, probe_every=4)
+        for _ in range(3):
+            br.success()
+        br.failure()
+        assert br.state == breaker.CLOSED     # 1/4 < 0.5
+        br.failure()
+        assert br.state == breaker.OPEN       # 2/4 window... rate 0.5
+
+    def test_probe_cadence_and_short_circuit(self, telemetry):
+        br = breaker.Breaker("s", "k", window=4, threshold=0.5,
+                            min_events=2, probe_every=3)
+        br.failure()
+        br.failure()
+        verdicts = [br.admit() for _ in range(6)]
+        assert verdicts == ["open", "open", "probe",
+                            "open", "open", "probe"]
+        assert br.state == breaker.HALF_OPEN
+
+    def test_probe_success_closes_and_clears(self, telemetry):
+        br = breaker.Breaker("s", "k", window=4, threshold=0.5,
+                            min_events=2, probe_every=1)
+        br.failure()
+        br.failure()
+        assert br.admit() == "probe"
+        br.success()
+        assert br.state == breaker.CLOSED
+        # the window was cleared: one new failure must not re-open
+        br.failure()
+        assert br.state == breaker.CLOSED
+
+    def test_probe_failure_reopens(self, telemetry):
+        br = breaker.Breaker("s", "k", window=4, threshold=0.5,
+                            min_events=2, probe_every=1)
+        br.failure()
+        br.failure()
+        assert br.admit() == "probe"
+        br.failure()
+        assert br.state == breaker.OPEN
+
+    def test_transitions_are_decision_events_and_gauges(self,
+                                                        telemetry):
+        br = breaker.Breaker("site.x", "cls", window=4, threshold=0.5,
+                            min_events=2, probe_every=1)
+        br.failure()
+        br.failure()
+        br.admit()
+        br.success()
+        decisions = [(e["decision"], e["previous"]) for e in
+                     obs.events() if e["op"] == "breaker_transition"]
+        assert decisions == [("open", "closed"),
+                             ("half_open", "open"),
+                             ("closed", "half_open")]
+        prom = obs.to_prometheus()
+        assert "veles_simd_breaker_state" in prom
+        assert "veles_simd_breaker_open_total" in prom
+
+    def test_registry_and_caches_introspection(self, telemetry):
+        br = breaker.breaker_for("site.y", ("op", 512))
+        assert breaker.breaker_for("site.y", ("op", 512)) is br
+        assert breaker.lookup("site.y", ("op", 512)) is br
+        assert breaker.lookup("site.y", ("op", 1024)) is None
+        br.failure()
+        br.failure()
+        snap = breaker.snapshot()
+        assert any(i["state"] == breaker.OPEN for i in snap)
+        caches = obs.caches()
+        assert caches["runtime.breakers"]["states"]["open"] >= 1
+
+    def test_env_policy(self, telemetry, monkeypatch):
+        monkeypatch.setenv(breaker.BREAKER_WINDOW_ENV, "16")
+        monkeypatch.setenv(breaker.BREAKER_THRESHOLD_ENV, "0.75")
+        monkeypatch.setenv(breaker.BREAKER_MIN_EVENTS_ENV, "4")
+        monkeypatch.setenv(breaker.BREAKER_PROBE_EVERY_ENV, "7")
+        br = breaker.Breaker("s")
+        assert (br.window_size, br.threshold, br.min_events,
+                br.probe_every) == (16, 0.75, 4, 7)
+
+
+# ---------------------------------------------------------------------------
+# guarded() outcome wiring
+# ---------------------------------------------------------------------------
+
+class TestGuardedWiring:
+    def test_exhaustion_marks_failure_success_marks_success(
+            self, telemetry):
+        br = breaker.Breaker("gw", None, window=4, threshold=0.5,
+                            min_events=2, probe_every=4)
+        with faults.fault_plan("gw:device_lost:6"):
+            for _ in range(2):
+                out = faults.guarded("gw", lambda: "dev",
+                                     fallback=lambda: "oracle",
+                                     breaker=br)
+                assert out == "oracle"
+        assert br.state == breaker.OPEN
+        out = faults.guarded("gw", lambda: "dev",
+                             fallback=lambda: "oracle", retries=0,
+                             breaker=br)
+        assert out == "dev"
+        assert br.state == breaker.CLOSED
+
+    def test_overload_storm_cannot_trip_breaker_or_flightrec(
+            self, telemetry, tmp_path, monkeypatch):
+        """A shed is a policy outcome, not a fault: typed overloads
+        must not count as retries, breaker failures, or flight-
+        recorder triggers."""
+        monkeypatch.setenv("VELES_SIMD_FLIGHT_DIR", str(tmp_path))
+        br = breaker.Breaker("ov", None, window=4, threshold=0.25,
+                            min_events=1, probe_every=4)
+        with faults.fault_plan("ov:overload:10"):
+            for _ in range(10):
+                with pytest.raises(faults.InjectedFault) as ei:
+                    faults.guarded("ov", lambda: "dev",
+                                   fallback=lambda: "oracle",
+                                   breaker=br)
+                assert faults.is_overload(ei.value)
+        assert br.state == breaker.CLOSED
+        assert br.info()["failures"] == 0
+        assert obs.counter_value("fault_retry", site="ov") == 0
+        assert obs.counter_value("fault_exhausted", site="ov") == 0
+        assert list(tmp_path.iterdir()) == []   # no bundle written
+        assert faults.fault_history() == []
+
+
+# ---------------------------------------------------------------------------
+# serve: per-class isolation (the breaker-efficacy criterion)
+# ---------------------------------------------------------------------------
+
+class TestServePerClass:
+    def test_poisoned_class_goes_straight_to_oracle(
+            self, telemetry, monkeypatch):
+        """Persistent fault on ONE shape class: after the breaker
+        opens, steady-state dispatches to that class record ZERO retry
+        attempts (straight-to-fallback) while the sibling class keeps
+        answering ``ok`` — and the class recovers through a half-open
+        probe once the fault clears."""
+        monkeypatch.setenv(breaker.BREAKER_PROBE_EVERY_ENV, "2")
+        lfp = {"b": [0.2, 0.3, 0.1], "a": [1.0, -0.4]}
+
+        def one(srv, op, params):
+            t = srv.submit(serve.Request(
+                op, RNG.randn(256).astype(np.float32), params))
+            t.result(timeout=120.0)
+            return t.status
+
+        with serve.Server(max_batch=1, max_wait_ms=2.0, workers=1,
+                          probe_every=1) as srv:
+            with faults.fault_plan(
+                    "serve.dispatch@sosfilt:device_lost:9999"):
+                statuses = []
+                for _ in range(6):
+                    statuses.append(one(srv, "sosfilt",
+                                        {"sos": SOS}))
+                    statuses.append(one(srv, "lfilter", lfp))
+                # the poisoned class is answered (degraded) every
+                # time; the sibling recovers to ok via health probes
+                assert all(s == "degraded"
+                           for s in statuses[0::2])
+                assert statuses[-1] == "ok"
+                poisoned = [b for b in srv.stats()["breakers"]
+                            if "sosfilt" in b["key"]]
+                assert poisoned and poisoned[0]["state"] \
+                    == breaker.OPEN
+                sibling = [b for b in srv.stats()["breakers"]
+                           if "lfilter" in b["key"]]
+                assert sibling and sibling[0]["state"] \
+                    == breaker.CLOSED
+                # steady state: more poisoned-class traffic burns
+                # ZERO retries (straight-to-fallback)
+                retries_before = obs.counter_value(
+                    "fault_retry", site="serve.dispatch")
+                for _ in range(4):
+                    assert one(srv, "sosfilt",
+                               {"sos": SOS}) == "degraded"
+                    assert one(srv, "lfilter", lfp) == "ok"
+                assert obs.counter_value(
+                    "fault_retry",
+                    site="serve.dispatch") == retries_before
+                assert obs.counter_value(
+                    "serve_breaker_shed", op="sosfilt") >= 1
+            # fault cleared: the half-open probe re-closes the class
+            statuses = [one(srv, "sosfilt", {"sos": SOS})
+                        for _ in range(6)]
+            assert statuses[-1] == "ok"
+            poisoned = [b for b in srv.stats()["breakers"]
+                        if "sosfilt" in b["key"]]
+            assert poisoned[0]["state"] == breaker.CLOSED
+
+    def test_breaker_answers_stay_parity_correct(self, telemetry):
+        x = RNG.randn(300).astype(np.float32)
+        with serve.Server(max_batch=1, max_wait_ms=2.0, workers=1,
+                          probe_every=1) as srv:
+            with faults.fault_plan(
+                    "serve.dispatch@sosfilt:device_lost:9999"):
+                for _ in range(5):
+                    t = srv.submit(serve.Request("sosfilt", x,
+                                                 {"sos": SOS}))
+                    y = t.result(timeout=120.0)
+                    want = iir.sosfilt_na(SOS, x[None, :])[0]
+                    scale = float(np.max(np.abs(want))) or 1.0
+                    assert float(np.max(np.abs(y - want))
+                                 / scale) < 2e-4
+
+
+# ---------------------------------------------------------------------------
+# parallel: mesh-loss degradation (breaker-gated single-chip twin)
+# ---------------------------------------------------------------------------
+
+class TestMeshDegrade:
+    def test_matmul_degrades_and_recovers(self, telemetry,
+                                          monkeypatch):
+        monkeypatch.setenv(breaker.BREAKER_PROBE_EVERY_ENV, "2")
+        from veles.simd_tpu import parallel as par
+
+        mesh = par.make_mesh({"sp": 8})
+        a = RNG.randn(16, 64).astype(np.float32)
+        b = RNG.randn(64, 8).astype(np.float32)
+        want = a.astype(np.float64) @ b.astype(np.float64)
+
+        def check():
+            got = np.asarray(par.sharded_matmul(a, b, mesh,
+                                                axis="sp"))
+            np.testing.assert_allclose(got, want, atol=1e-3)
+
+        check()     # healthy sharded dispatch
+        with faults.fault_plan(
+                "parallel.sharded_matmul:device_lost:9999"):
+            for _ in range(5):
+                check()     # answered by the single-chip twin
+            br = breaker.lookup("parallel.dispatch",
+                                ("sharded_matmul", "sp8@sp"))
+            assert br is not None and br.state != breaker.CLOSED
+            assert obs.counter_value("mesh_degrade",
+                                     op="sharded_matmul") >= 2
+            events = [e for e in obs.events()
+                      if e["op"] == "mesh_degrade"]
+            assert events and events[0]["mesh"] == "sp8@sp"
+            # steady state: the open breaker pays no retry latency
+            retries = obs.counter_value(
+                "fault_retry", site="parallel.sharded_matmul")
+            check()
+            assert obs.counter_value(
+                "fault_retry",
+                site="parallel.sharded_matmul") == retries
+        # plan cleared: cadence probe re-enables sharded dispatch
+        for _ in range(4):
+            check()
+        assert br.state == breaker.CLOSED
+
+    def test_sharded_stft_degrades_to_single_chip(self, telemetry):
+        from veles.simd_tpu import parallel as par
+        from veles.simd_tpu.ops import spectral as sp
+
+        mesh = par.make_mesh({"sp": 8})
+        x = RNG.randn(2048).astype(np.float32)
+        with faults.fault_plan("parallel.sharded_stft:device_lost:3"):
+            got = np.asarray(par.sharded_stft(x, 256, 128, mesh))
+        want = np.asarray(sp.stft(x, 256, 128))
+        assert got.shape == want.shape
+        scale = float(np.max(np.abs(want))) or 1.0
+        assert float(np.max(np.abs(got - want)) / scale) < 2e-3
+        assert obs.counter_value("mesh_degrade",
+                                 op="sharded_stft") == 1
+
+
+# ---------------------------------------------------------------------------
+# ops: the single-chip guarded dispatchers are breaker-gated too
+# ---------------------------------------------------------------------------
+
+class TestOpsDispatchBreaker:
+    def test_convolve_class_opens_and_stops_retrying(self, telemetry):
+        from veles.simd_tpu.ops import convolve as cv
+
+        x = RNG.randn(2048).astype(np.float32)
+        h = RNG.randn(33).astype(np.float32)
+        want = np.convolve(x.astype(np.float64),
+                           h.astype(np.float64)).astype(np.float32)
+        with faults.fault_plan("convolve.dispatch:device_lost:9999"):
+            for _ in range(4):
+                got = np.asarray(cv.convolve(x, h))
+                np.testing.assert_allclose(
+                    got, want, atol=1e-3 * np.abs(want).max())
+            opened = [b for b in breaker.snapshot()
+                      if b["site"] == "convolve.dispatch"]
+            assert opened and opened[0]["state"] != breaker.CLOSED
+            # steady state: straight to the oracle, zero retries
+            retries = obs.counter_value("fault_retry",
+                                        site="convolve.dispatch")
+            np.asarray(cv.convolve(x, h))
+            assert obs.counter_value(
+                "fault_retry", site="convolve.dispatch") == retries
+            assert obs.counter_value(
+                "fault_breaker_short_circuit",
+                site="convolve.dispatch") >= 1
+        # a different shape class is untouched
+        x2 = RNG.randn(256).astype(np.float32)
+        got = np.asarray(cv.convolve(x2, h))
+        want2 = np.convolve(x2.astype(np.float64),
+                            h.astype(np.float64)).astype(np.float32)
+        np.testing.assert_allclose(got, want2,
+                                   atol=1e-3 * np.abs(want2).max())

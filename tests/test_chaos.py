@@ -1,0 +1,142 @@
+"""The chaos-campaign runner (``tools/chaos.py``, ``make chaos-smoke``).
+
+Runs the scripted four-phase campaign in-process on the virtual CPU
+mesh and asserts the gate: rc=0, every invariant true, and
+``CHAOS_DETAILS.json`` holding BENCH_DETAILS-format rows plus the
+decision-event / Prometheus evidence tail — then feeds the details
+file through ``tools/bench_regress.py`` to prove the chaos family
+rides the regression gate like any bench family.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+import bench_regress  # noqa: E402
+import chaos  # noqa: E402
+from veles.simd_tpu import obs  # noqa: E402
+from veles.simd_tpu.runtime import breaker, faults  # noqa: E402
+
+# the campaign drives a threaded server + sharded mesh calls — one
+# multi-second run, details asserted by several tests below
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+@pytest.fixture(scope="module")
+def campaign(tmp_path_factory):
+    details = tmp_path_factory.mktemp("chaos") / "CHAOS_DETAILS.json"
+    import os
+
+    prev_backoff = os.environ.get("VELES_SIMD_FAULT_BACKOFF")
+    os.environ["VELES_SIMD_FAULT_BACKOFF"] = "0"
+    try:
+        rc = chaos.main(["--smoke", "--details", str(details)])
+    finally:
+        if prev_backoff is None:
+            os.environ.pop("VELES_SIMD_FAULT_BACKOFF", None)
+        else:
+            os.environ["VELES_SIMD_FAULT_BACKOFF"] = prev_backoff
+        obs.disable()
+        obs.reset()
+        breaker.reset()
+        faults.set_fault_plan(None)
+        faults.reset_fault_history()
+    entries = json.loads(details.read_text())
+    return rc, details, entries
+
+
+def test_campaign_green(campaign):
+    rc, _, _ = campaign
+    assert rc == 0
+
+
+def test_every_invariant_holds(campaign):
+    _, _, entries = campaign
+    tail = entries[-1]
+    assert "chaos_invariants" in tail
+    bad = {k: v for k, v in tail["chaos_invariants"].items() if not v}
+    assert bad == {}
+    # the named acceptance invariants are all present
+    for key in ("zero_lost", "zero_double_answered",
+                "zero_untyped_errors", "deadline_misses_bounded",
+                "breaker_cycle", "zero_retry_steady_state",
+                "mesh_degrade_observed",
+                "health_degraded_then_healthy"):
+        assert key in tail["chaos_invariants"]
+
+
+def test_details_rows_are_bench_format(campaign):
+    _, details, entries = campaign
+    rows = [e for e in entries if "metric" in e]
+    metrics = {r["metric"] for r in rows}
+    assert "chaos campaign throughput" in metrics
+    assert "chaos deadline hit rate" in metrics
+    for r in rows:
+        assert set(r) >= {"metric", "value", "unit"}
+    # the mesh_loss row is stamped as measured under an active phase
+    phase_rows = [r for r in rows if r.get("chaos_phase")]
+    assert phase_rows and phase_rows[0]["chaos_phase"] == "mesh_loss"
+    # and bench_regress can load + gate the file (rc 0, fresh history)
+    loaded, _ = bench_regress.load_run(str(details))
+    assert len(loaded) == len(rows)
+    history = details.parent / "CHAOS_HISTORY.jsonl"
+    rc = bench_regress.main(["--details", str(details),
+                             "--history", str(history)])
+    assert rc == 0
+
+
+def test_evidence_tail_carries_the_story(campaign):
+    _, _, entries = campaign
+    tail = entries[-1]
+    transitions = [e["decision"]
+                   for e in tail["breaker_transitions"]]
+    assert {"open", "half_open", "closed"} <= set(transitions)
+    assert tail["mesh_degrade_events"]
+    assert all(e["mesh"] for e in tail["mesh_degrade_events"])
+    assert {"degrade", "recover"} <= {
+        e["decision"] for e in tail["serve_health_events"]}
+    assert tail["fault_phases"][:4] == ["baseline", "overload",
+                                        "mesh_loss", "recovery"]
+    assert any("veles_simd_breaker_" in line
+               for line in tail["prometheus_breaker_lines"])
+    assert tail["retry_attempts_steady_state"] == 0
+
+
+def test_chaos_phase_rows_are_degraded_not_gated(tmp_path):
+    """A chaos-phase row below its floor is DEGRADED-not-gated (and
+    excluded from future baselines), exactly like a fault-carrying
+    bench row."""
+    history = tmp_path / "H.jsonl"
+    details = tmp_path / "D.json"
+    good = [{"metric": "chaos mesh_loss throughput", "value": 100.0,
+             "unit": "req/s", "chaos_phase": "mesh_loss"}]
+    details.write_text(json.dumps(good))
+    for _ in range(3):
+        assert bench_regress.main(["--details", str(details),
+                                   "--history", str(history)]) == 0
+    bad = [{"metric": "chaos mesh_loss throughput", "value": 10.0,
+            "unit": "req/s", "chaos_phase": "mesh_loss"}]
+    details.write_text(json.dumps(bad))
+    rc = bench_regress.main(["--details", str(details),
+                             "--history", str(history)])
+    assert rc == 0      # degraded, not gated
+    records = [json.loads(line)
+               for line in history.read_text().splitlines()]
+    assert records[-1]["fault_degraded"] == \
+        ["chaos mesh_loss throughput"]
+    # the degraded record never becomes baseline
+    base, n = bench_regress.trailing_baseline(
+        records, "chaos mesh_loss throughput", 5)
+    assert base == 100.0
+    # an UNSTAMPED row that dips the same way still gates (rc=1)
+    details.write_text(json.dumps(
+        [{"metric": "chaos mesh_loss throughput", "value": 10.0,
+          "unit": "req/s"}]))
+    assert bench_regress.main(["--details", str(details),
+                               "--history", str(history)]) == 1

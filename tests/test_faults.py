@@ -692,3 +692,122 @@ def test_require_reachable_device_records_probes(telemetry,
     bundle = flightrec.build_bundle("test")
     assert [p["ok"] for p in bundle["device_probes"]] == [False, True]
     platform.reset_probe_history()
+
+
+# --------------------------------------------------------------------------
+# deadline budgets, subsite injection, phase schedules (PR 10)
+# --------------------------------------------------------------------------
+
+class TestBudgetClipping:
+    def test_budget_clips_the_retry_loop(self, telemetry):
+        """A fault storm with a huge retry allowance must still answer
+        within the caller's budget + one backoff quantum — the retry
+        loop never runs past the request deadline."""
+        backoff = 0.02
+        budget = 0.1
+        t0 = faults.monotonic()
+        with faults.fault_plan("clip:device_lost:10000"):
+            out = faults.guarded("clip", lambda: "dev",
+                                 fallback=lambda: "oracle",
+                                 retries=10000, backoff=backoff,
+                                 budget_s=budget)
+        elapsed = faults.monotonic() - t0
+        assert out == "oracle"
+        # budget + one max backoff quantum of slack (jittered exp
+        # backoff doubles, so the last scheduled-but-skipped delay is
+        # bounded by the budget itself) + scheduling slop
+        assert elapsed < budget + 0.5
+        assert obs.counter_value("fault_budget_clipped",
+                                 site="clip") == 1
+        degrade = [e for e in obs.events()
+                   if e["op"] == "fault_policy"
+                   and e["decision"] == "degrade"]
+        assert degrade and degrade[-1]["budget_clipped"] is True
+
+    def test_no_budget_keeps_full_retry_ladder(self, telemetry):
+        with faults.fault_plan("clip2:device_lost:2"):
+            out = faults.guarded("clip2", lambda: "dev",
+                                 fallback=lambda: "oracle",
+                                 retries=5)
+        assert out == "dev"     # 2 injections absorbed by retries
+        assert obs.counter_value("fault_retry", site="clip2") == 2
+
+
+class TestSubsiteInjection:
+    def test_subsite_plan_only_fires_for_matching_subsite(
+            self, telemetry):
+        with faults.fault_plan("sub@stft:device_lost:9999"):
+            # other subsites and the bare site are untouched
+            assert faults.guarded("sub", lambda: "ok",
+                                  subsite="sosfilt") == "ok"
+            assert faults.guarded("sub", lambda: "ok") == "ok"
+            # the poisoned subsite degrades
+            out = faults.guarded("sub", lambda: "dev",
+                                 fallback=lambda: "oracle",
+                                 subsite="stft")
+            assert out == "oracle"
+
+
+class TestPhaseSchedules:
+    def test_parse_phase_plan(self):
+        phases = faults.parse_phase_plan(
+            "baseline=;overload=a:overload:4,b:timeout:2;"
+            "c:device_lost:1;recovery=;")
+        assert phases == [
+            ("baseline", None),
+            ("overload", "a:overload:4,b:timeout:2"),
+            ("phase2", "c:device_lost:1"),
+            ("recovery", None),
+        ]
+
+    def test_parse_rejects_bad_phase_body(self):
+        with pytest.raises(ValueError):
+            faults.parse_phase_plan("p=a:nosuchkind:1;q=")
+
+    def test_schedule_advances_and_records(self, telemetry):
+        faults.set_fault_plan("p1=s:overload:2;p2=;p3=s:timeout:1")
+        assert faults.current_phase() == "p1"
+        assert faults.plan_snapshot() == {
+            "s": {"kind": "overload", "remaining": 2}}
+        assert faults.advance_phase() == "p2"
+        assert faults.plan_snapshot() == {}     # explicit clear
+        assert faults.advance_phase() == "p3"
+        assert faults.armed("s", "timeout")
+        assert faults.advance_phase() is None   # exhausted
+        assert faults.current_phase() is None
+        assert faults.plan_snapshot() == {}
+        labels = [e["decision"] for e in obs.events()
+                  if e["op"] == "fault_phase"]
+        assert labels == ["p1", "p2", "p3", "done"]
+
+    def test_empty_phase_masks_env_plan(self, telemetry, monkeypatch):
+        monkeypatch.setenv(faults.FAULT_PLAN_ENV, "s:timeout:5")
+        faults.set_fault_plan("quiet=;storm=s:device_lost:1")
+        # the explicit empty phase must NOT fall through to the env
+        assert not faults.armed("s")
+        faults.advance_phase()
+        assert faults.armed("s", "device_lost")
+
+    def test_env_phase_schedule_activates_first_phase(
+            self, telemetry, monkeypatch):
+        monkeypatch.setenv(faults.FAULT_PLAN_ENV,
+                           "w=s:overload:3;x=s:timeout:1")
+        faults.set_fault_plan(None)
+        assert faults.plan_snapshot() == {
+            "s": {"kind": "overload", "remaining": 3}}
+
+    def test_advance_without_schedule_raises(self, telemetry):
+        faults.set_fault_plan("plain:timeout:1")
+        with pytest.raises(RuntimeError, match="no phase schedule"):
+            faults.advance_phase()
+        faults.set_fault_plan(None)
+        with pytest.raises(RuntimeError, match="no phase schedule"):
+            faults.advance_phase()
+
+    def test_fault_plan_ctx_restores_schedule(self, telemetry):
+        faults.set_fault_plan("p1=s:overload:2;p2=")
+        faults.advance_phase()
+        with faults.fault_plan("other:timeout:1"):
+            assert faults.current_phase() is None
+            assert faults.armed("other", "timeout")
+        assert faults.current_phase() == "p2"

@@ -629,3 +629,155 @@ def peek():
     return batched.handle_cache_info()
 '''
     assert _serve_errs(src) == []
+
+
+# ---------------------------------------------------------------------------
+# the sharded-dispatch rule (PR 10): instrumented shard_map programs in
+# parallel/ops.py must dispatch inside faults.guarded thunks
+# ---------------------------------------------------------------------------
+
+def _parallel_errs(src):
+    return lint.parallel_guard_errors(ast.parse(src), "mod.py")
+
+
+PGUARD_GOOD = '''
+from veles.simd_tpu import obs
+from veles.simd_tpu.runtime import faults
+
+
+def _instrumented(op, run_fn):
+    return obs.instrumented_jit(run_fn, op=op, route="shard_map")
+
+
+def _sharded_guard(op, thunk, fallback, mesh, axis):
+    return faults.guarded(f"parallel.{op}", thunk, fallback=fallback)
+
+
+def sharded_thing(x, mesh, axis="sp"):
+    def _run(x_local):
+        return x_local
+
+    jfn = _instrumented("sharded_thing", _run)
+    return _sharded_guard("sharded_thing", lambda: jfn(x),
+                          lambda: x, mesh, axis)
+'''
+
+PGUARD_BARE = '''
+from veles.simd_tpu import obs
+from veles.simd_tpu.runtime import faults
+
+
+def _instrumented(op, run_fn):
+    return obs.instrumented_jit(run_fn, op=op, route="shard_map")
+
+
+def sharded_thing(x, mesh, axis="sp"):
+    def _run(x_local):
+        return x_local
+
+    return _instrumented("sharded_thing", _run)(x)
+'''
+
+PGUARD_HANDLE_DODGE = '''
+from veles.simd_tpu import obs
+from veles.simd_tpu.runtime import faults
+
+
+def _instrumented(op, run_fn):
+    return obs.instrumented_jit(run_fn, op=op, route="shard_map")
+
+
+def sharded_thing(x, mesh, axis="sp"):
+    def _run(x_local):
+        return x_local
+
+    jfn = _instrumented("sharded_thing", _run)
+    return jfn(x)
+'''
+
+PGUARD_DIRECT_JIT = '''
+from veles.simd_tpu import obs
+
+
+def sharded_thing(x):
+    def _run(x_local):
+        return x_local
+
+    return obs.instrumented_jit(_run, op="t", route="shard_map")(x)
+'''
+
+PGUARD_GUARDED_DIRECT = '''
+from veles.simd_tpu import obs
+from veles.simd_tpu.runtime import faults
+
+
+def _instrumented(op, run_fn):
+    return obs.instrumented_jit(run_fn, op=op, route="shard_map")
+
+
+def sharded_thing(x, mesh, axis="sp"):
+    def _run(x_local):
+        return x_local
+
+    jfn = _instrumented("sharded_thing", _run)
+    return faults.guarded("parallel.sharded_thing", lambda: jfn(x),
+                          fallback=lambda: x)
+'''
+
+
+def test_parallel_guard_rule_passes_wrapper_convention():
+    assert _parallel_errs(PGUARD_GOOD) == []
+
+
+def test_parallel_guard_rule_passes_direct_guarded():
+    assert _parallel_errs(PGUARD_GUARDED_DIRECT) == []
+
+
+def test_parallel_guard_rule_flags_bare_dispatch():
+    errs = _parallel_errs(PGUARD_BARE)
+    assert any("faults.guarded" in e for e in errs)
+
+
+def test_parallel_guard_rule_flags_bound_handle_dodge():
+    errs = _parallel_errs(PGUARD_HANDLE_DODGE)
+    assert any("faults.guarded" in e for e in errs)
+
+
+def test_parallel_guard_rule_flags_direct_instrumented_jit():
+    errs = _parallel_errs(PGUARD_DIRECT_JIT)
+    assert any("faults.guarded" in e for e in errs)
+
+
+def test_real_parallel_ops_passes_guard_rule():
+    f = REPO / "veles" / "simd_tpu" / "parallel" / "ops.py"
+    tree = ast.parse(f.read_text(), str(f))
+    assert lint.parallel_guard_errors(tree, str(f)) == []
+
+
+PGUARD_BREAKER_GUARDED = '''
+from veles.simd_tpu import obs
+from veles.simd_tpu.runtime import faults
+
+
+def _instrumented(op, run_fn):
+    return obs.instrumented_jit(run_fn, op=op, route="shard_map")
+
+
+def _sharded_guard(op, thunk, fallback, mesh, axis):
+    return faults.breaker_guarded(f"parallel.{op}", (op,), thunk,
+                                  fallback=fallback,
+                                  breaker_site="parallel.dispatch")
+
+
+def sharded_thing(x, mesh, axis="sp"):
+    def _run(x_local):
+        return x_local
+
+    jfn = _instrumented("sharded_thing", _run)
+    return _sharded_guard("sharded_thing", lambda: jfn(x),
+                          lambda: x, mesh, axis)
+'''
+
+
+def test_parallel_guard_rule_accepts_breaker_guarded():
+    assert _parallel_errs(PGUARD_BREAKER_GUARDED) == []

@@ -405,3 +405,112 @@ def test_loadgen_bench_rows_shape(telemetry):
     inv = next(r for r in rows
                if r["metric"] == "serve p99 inverse latency")
     assert inv["value"] == 50.0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end request deadlines (PR 10)
+# ---------------------------------------------------------------------------
+
+class TestDeadlines:
+    def test_expired_request_is_shed_typed_before_dispatch(
+            self, telemetry):
+        """A queued request whose deadline passes is answered with a
+        typed DeadlineExceeded and its batch never dispatches."""
+        with serve.Server(max_batch=8, max_wait_ms=500.0,
+                          workers=1) as srv:
+            t = srv.submit(serve.Request("sosfilt", _signal(256),
+                                         {"sos": SOS}),
+                           deadline_ms=15.0)
+            with pytest.raises(serve.DeadlineExceeded) as ei:
+                t.result(timeout=30.0)
+            batches = srv.stats()["counts"]["batches"]
+        assert t.status == "expired"
+        # the typed answer classifies as a timeout for callers using
+        # the engine's string classifiers across process boundaries
+        assert faults.is_timeout(ei.value)
+        assert batches == 0     # stale work never reached the device
+        assert obs.counter_value("serve_deadline_miss", op="sosfilt",
+                                 tenant="default") == 1
+        assert srv.stats()["counts"]["expired"] == 1
+        assert srv.stats()["admission"]["depth"] == 0
+
+    def test_head_of_line_expiry_does_not_wedge_bucket(
+            self, telemetry):
+        """An expired head must be shed and readiness re-evaluated:
+        the surviving request is answered on ITS constraints, not
+        dispatched early with stale work and not starved behind it."""
+        with serve.Server(max_batch=8, max_wait_ms=150.0,
+                          workers=1) as srv:
+            t1 = srv.submit(serve.Request("sosfilt", _signal(256),
+                                          {"sos": SOS}),
+                            deadline_ms=10.0)
+            t2 = srv.submit(serve.Request("sosfilt", _signal(256),
+                                          {"sos": SOS}),
+                            deadline_ms=5000.0)
+            with pytest.raises(serve.DeadlineExceeded):
+                t1.result(timeout=30.0)
+            y2 = t2.result(timeout=120.0)
+        assert t1.status == "expired"
+        assert t2.status == "ok"
+        assert y2.shape == (256,)
+        assert srv.stats()["counts"]["batches"] == 1
+
+    def test_fully_expired_bucket_dispatches_nothing(self, telemetry):
+        with serve.Server(max_batch=8, max_wait_ms=300.0,
+                          workers=1) as srv:
+            ts = [srv.submit(serve.Request("sosfilt", _signal(256),
+                                           {"sos": SOS}),
+                             deadline_ms=10.0) for _ in range(4)]
+            for t in ts:
+                with pytest.raises(serve.DeadlineExceeded):
+                    t.result(timeout=30.0)
+            assert srv.stats()["counts"]["batches"] == 0
+            assert srv.stats()["counts"]["expired"] == 4
+
+    def test_env_default_deadline(self, telemetry, monkeypatch):
+        monkeypatch.setenv(serve.DEADLINE_ENV, "15")
+        assert serve.env_deadline_ms() == 15.0
+        with serve.Server(max_batch=8, max_wait_ms=500.0,
+                          workers=1) as srv:
+            t = srv.submit(serve.Request("sosfilt", _signal(256),
+                                         {"sos": SOS}))
+            with pytest.raises(serve.DeadlineExceeded):
+                t.result(timeout=30.0)
+        assert t.status == "expired"
+
+    def test_deadline_under_fault_storm_is_answered_in_budget(
+            self, telemetry, monkeypatch):
+        """The acceptance criterion: a short-deadline request
+        submitted into a transient-fault storm with a huge retry
+        allowance is answered (typed/degraded) within deadline + one
+        backoff quantum — the guarded retry loop is clipped to the
+        request budget."""
+        monkeypatch.setenv("VELES_SIMD_FAULT_BACKOFF", "0.02")
+        monkeypatch.setenv("VELES_SIMD_FAULT_RETRIES", "10000")
+        with faults.fault_plan("serve.dispatch:device_lost:100000"):
+            with serve.Server(max_batch=1, max_wait_ms=2.0,
+                              workers=1) as srv:
+                t0 = faults.monotonic()
+                t = srv.submit(serve.Request("sosfilt", _signal(256),
+                                             {"sos": SOS}),
+                               deadline_ms=150.0)
+                y = t.result(timeout=30.0)
+                elapsed = faults.monotonic() - t0
+        assert t.status == "degraded"       # oracle answer, typed
+        assert y.shape == (256,)
+        # 150 ms budget + one backoff quantum + dispatch slop; without
+        # clipping the 10000-retry ladder would run for minutes
+        assert elapsed < 2.0
+        assert obs.counter_value("fault_budget_clipped",
+                                 site="serve.dispatch") == 1
+
+    def test_deadline_slack_histogram_flows(self, telemetry):
+        with serve.Server(max_batch=1, max_wait_ms=2.0,
+                          workers=1) as srv:
+            t = srv.submit(serve.Request("sosfilt", _signal(256),
+                                         {"sos": SOS}),
+                           deadline_ms=60000.0)
+            t.result(timeout=120.0)
+        snap = obs.snapshot()
+        assert any(h["name"] == "serve.deadline_slack"
+                   for h in snap["histograms"])

@@ -92,6 +92,14 @@ DEFAULT_NOISE = [
     # the inverse-p99 row is a single order statistic
     ("serve", 0.35),
     ("serve p99", 0.40),
+    # the chaos family (tools/chaos.py --details CHAOS_DETAILS.json):
+    # wall-clock throughput of a seconds-long scripted campaign whose
+    # phases deliberately inject faults — the noisiest rows we gate —
+    # and the deadline/fairness ratio rows, which are order statistics
+    # of small per-phase samples
+    ("chaos", 0.50),
+    ("deadline hit rate", 0.25),
+    ("tenant fairness", 0.40),
 ]
 
 
@@ -231,11 +239,14 @@ def compare(rows: list, history: list, window: int, default_thr: float,
     Returns ``(regressions, fault_degraded, report_lines)``.
     ``regressions`` gates (rc=1); ``fault_degraded`` names rows that
     fell below their floor while the run carried recorded transient
-    faults (row-embedded ``fault_*`` counters or run-level
-    stage-fault/probe records) — those are REPORTED but not gated
-    (the r05 host-contention story: a relay hiccup is not a code
-    regression), and :func:`trailing_baseline` excludes them from
-    future medians so a degraded run cannot launder the baseline."""
+    faults (row-embedded ``fault_*`` counters, run-level
+    stage-fault/probe records, or a ``chaos_phase`` stamp — a row
+    measured while a scripted chaos phase was actively injecting
+    faults is fault-carrying by construction) — those are REPORTED
+    but not gated (the r05 host-contention story: a relay hiccup is
+    not a code regression), and :func:`trailing_baseline` excludes
+    them from future medians so a degraded run cannot launder the
+    baseline."""
     regressions = []
     fault_degraded = []
     lines = []
@@ -246,6 +257,8 @@ def compare(rows: list, history: list, window: int, default_thr: float,
         baseline, n = trailing_baseline(history, metric, window)
         thr = row_threshold(metric, default_thr, overrides)
         faults_n = row_fault_count(r) + run_faults
+        if r.get("chaos_phase"):
+            faults_n += 1
         if value is None:
             verdict = "UNRESOLVED (null value; not gated)"
         elif baseline is None:

@@ -1,0 +1,452 @@
+#!/usr/bin/env python
+"""Scripted chaos-campaign runner: the resilience triad, proven end to end.
+
+Drives a live :class:`veles.simd_tpu.serve.Server` (via
+``tools/loadgen.py`` traffic) *and* sharded ``parallel/`` dispatches
+through a deterministic phase schedule of injected faults
+(``VELES_SIMD_FAULT_PLAN`` phase syntax, ``label=entries;...``,
+stepped with :func:`veles.simd_tpu.runtime.faults.advance_phase`):
+
+1. **baseline** — no faults; traffic + sharded calls establish the
+   healthy numbers;
+2. **overload** — injected admission overloads force the typed shed
+   path under burst traffic;
+3. **mesh_loss** — a persistent ``device_lost`` poisons ONE serve
+   shape class (``serve.dispatch@sosfilt``) and the whole sharded
+   matmul mesh (``parallel.sharded_matmul``): the per-class breaker
+   opens after the retry ladder is paid twice, the health machine
+   trips DEGRADED and recovers on a healthy-class probe, and sharded
+   dispatch degrades to the single-chip twin (``mesh_degrade``);
+4. **recovery** — injection cleared; half-open breaker probes re-close
+   both breakers and the server finishes HEALTHY.
+
+Invariants asserted (rc=1 on any failure):
+
+* zero lost / zero double-answered requests, answers parity-checked;
+* only *typed* errors reach clients (``Overloaded`` /
+  ``DeadlineExceeded``; untyped per-request errors are a bug);
+* deadline misses bounded (every request carries ``--deadline-ms``);
+* the poisoned class's breaker walks closed -> open -> half_open ->
+  closed, and its steady-state open segment records ZERO retry
+  attempts (straight-to-fallback) while other classes keep answering;
+* ``mesh_degrade`` recorded with mesh geometry; sharded dispatch
+  re-enabled after recovery;
+* serve health walks DEGRADED -> HEALTHY.
+
+The evidence — decision events, breaker/fault/serve counters, and the
+``veles_simd_breaker_*``/``veles_simd_mesh_*`` Prometheus lines — is
+embedded in ``CHAOS_DETAILS.json`` alongside ``BENCH_DETAILS``-format
+metric rows, so ``python tools/bench_regress.py --details
+CHAOS_DETAILS.json`` gates the campaign like any bench family (rows
+stamped ``chaos_phase`` are DEGRADED-not-gated when they dip).
+
+Usage::
+
+    python tools/chaos.py --smoke            # make chaos-smoke
+    python tools/chaos.py --details CHAOS_DETAILS.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, os.path.dirname(__file__))
+
+import loadgen  # noqa: E402
+from veles.simd_tpu import obs  # noqa: E402
+from veles.simd_tpu import serve  # noqa: E402
+from veles.simd_tpu.runtime import breaker, faults  # noqa: E402
+
+MESH_AXIS = "sp"
+
+# the poisoned shape class: one op, one length — a single serve bucket,
+# so its breaker sees every failure (determinism over realism here;
+# the mixed loadgen traffic supplies the realism)
+POISON_OP = "sosfilt"
+POISON_LEN = 512
+
+PHASE_SPEC = (
+    "baseline=;"
+    "overload=serve.admission:overload:{overloads};"
+    "mesh_loss=serve.dispatch@{poison}:device_lost:9999,"
+    "parallel.sharded_matmul:device_lost:9999;"
+    "recovery="
+)
+
+
+def _poison_requests(rng, n: int, deadline_ms) -> list:
+    """``n`` identical-class requests for the poisoned bucket."""
+    from veles.simd_tpu.ops import iir
+
+    sos = iir.butterworth(4, 0.25, "lowpass")
+    return [(0.0, serve.Request(
+        POISON_OP, rng.randn(POISON_LEN).astype(np.float32),
+        {"sos": sos}, tenant="chaos",
+        deadline_ms=deadline_ms)) for _ in range(n)]
+
+
+def _run_serial(server, items, timeout: float) -> dict:
+    """Submit ``items`` one at a time, waiting for each answer — every
+    request is its own batch, so breaker/health cadences tick once per
+    request (the determinism the campaign's counting arguments
+    need)."""
+    return _merge_reports([
+        loadgen.run_load(server, [item], result_timeout=timeout)
+        for item in items])
+
+
+def _merge_reports(reports: list) -> dict:
+    """Sum the accounting categories across phase reports."""
+    total: dict = {}
+    for rep in reports:
+        for k in ("requests", "ok", "degraded", "shed", "closed",
+                  "errors", "lost", "deadline_miss",
+                  "parity_failures"):
+            total[k] = total.get(k, 0) + rep.get(k, 0)
+    total["double_answered"] = (obs.counter_value(
+        "serve_double_answer") if obs.enabled() else 0)
+    return total
+
+
+def _counter_total(name: str) -> int:
+    """Sum of one counter across every label set."""
+    snap = obs.snapshot()
+    return sum(c["value"] for c in snap["counters"]
+               if c["name"] == name)
+
+
+def _decisions(op: str) -> list:
+    return [e for e in obs.events() if e["op"] == op]
+
+
+def _mesh_calls(mesh, n_calls: int, a, b, want) -> int:
+    """``n_calls`` sharded matmuls, each answer checked against the
+    host oracle regardless of which path (mesh or single-chip twin)
+    served it.  Returns the number of wrong answers."""
+    from veles.simd_tpu import parallel as par
+
+    bad = 0
+    for _ in range(n_calls):
+        got = np.asarray(par.sharded_matmul(a, b, mesh,
+                                            axis=MESH_AXIS))
+        scale = float(np.max(np.abs(want))) or 1.0
+        if float(np.max(np.abs(got - want)) / scale) > 2e-3:
+            bad += 1
+    return bad
+
+
+def run_campaign(args) -> tuple:
+    """Execute the four-phase campaign; returns ``(invariants, rows,
+    evidence)`` — all JSON-native."""
+    from veles.simd_tpu import parallel as par
+
+    rng = np.random.RandomState(args.seed)
+    mesh = par.make_mesh({MESH_AXIS: args.mesh_devices})
+    a = rng.randn(32, 64).astype(np.float32)
+    b = rng.randn(64, 16).astype(np.float32)
+    want = a.astype(np.float64) @ b.astype(np.float64)
+
+    spec = PHASE_SPEC.format(overloads=args.overloads,
+                             poison=POISON_OP)
+    faults.set_fault_plan(spec)
+    phase_reports: dict = {}
+    mesh_bad = 0
+    retry_steady = None
+    try:
+        server = serve.Server(max_batch=4, max_wait_ms=5.0,
+                              workers=args.workers, probe_every=2)
+        with server:
+            # -- phase 1: baseline ------------------------------------
+            t0 = time.perf_counter()
+            sched = loadgen.build_schedule(
+                rng, args.requests, rate_hz=0.0,
+                deadline_ms=args.deadline_ms)
+            phase_reports["baseline"] = loadgen.run_load(
+                server, sched, verify=args.verify, rng=rng,
+                result_timeout=args.result_timeout)
+            mesh_bad += _mesh_calls(mesh, 1, a, b, want)
+            phase_reports["baseline"]["phase_wall_s"] = \
+                time.perf_counter() - t0
+
+            # -- phase 2: overload ------------------------------------
+            assert faults.advance_phase() == "overload"
+            t0 = time.perf_counter()
+            sched = loadgen.build_schedule(
+                rng, args.requests, rate_hz=0.0, burst_every=8,
+                burst_size=4, deadline_ms=args.deadline_ms)
+            phase_reports["overload"] = loadgen.run_load(
+                server, sched, verify=args.verify, rng=rng,
+                result_timeout=args.result_timeout)
+            phase_reports["overload"]["phase_wall_s"] = \
+                time.perf_counter() - t0
+
+            # -- phase 3: mesh_loss -----------------------------------
+            assert faults.advance_phase() == "mesh_loss"
+            t0 = time.perf_counter()
+            # warm-up: enough poisoned-class dispatches to pay the
+            # retry ladder twice and open the class breaker
+            warm = _run_serial(
+                server, _poison_requests(rng, 4, args.deadline_ms),
+                args.result_timeout)
+            # steady state: the open breaker must answer straight from
+            # the oracle — zero retry attempts on the poisoned class
+            retries_before = _counter_total("fault_retry")
+            steady = _run_serial(
+                server,
+                _poison_requests(rng, args.steady, args.deadline_ms),
+                args.result_timeout)
+            retry_steady = _counter_total("fault_retry") \
+                - retries_before
+            # sibling classes keep flowing while the class is poisoned
+            mixed = loadgen.run_load(
+                server, loadgen.build_schedule(
+                    rng, args.requests, rate_hz=0.0,
+                    deadline_ms=args.deadline_ms),
+                verify=args.verify, rng=rng,
+                result_timeout=args.result_timeout)
+            mesh_bad += _mesh_calls(mesh, args.mesh_loss_calls,
+                                    a, b, want)
+            rep = _merge_reports([warm, steady, mixed])
+            rep["phase_wall_s"] = time.perf_counter() - t0
+            rep["throughput_rps"] = (
+                (rep["ok"] + rep["degraded"]) / rep["phase_wall_s"]
+                if rep["phase_wall_s"] > 0 else 0.0)
+            phase_reports["mesh_loss"] = rep
+
+            # -- phase 4: recovery ------------------------------------
+            assert faults.advance_phase() == "recovery"
+            t0 = time.perf_counter()
+            rec_poison = _run_serial(
+                server,
+                _poison_requests(rng, args.recovery_calls,
+                                 args.deadline_ms),
+                args.result_timeout)
+            rec_mixed = loadgen.run_load(
+                server, loadgen.build_schedule(
+                    rng, args.requests, rate_hz=0.0,
+                    deadline_ms=args.deadline_ms),
+                verify=args.verify, rng=rng,
+                result_timeout=args.result_timeout)
+            mesh_bad += _mesh_calls(mesh, args.recovery_calls,
+                                    a, b, want)
+            rep = _merge_reports([rec_poison, rec_mixed])
+            rep["phase_wall_s"] = time.perf_counter() - t0
+            rep["throughput_rps"] = (
+                (rep["ok"] + rep["degraded"]) / rep["phase_wall_s"]
+                if rep["phase_wall_s"] > 0 else 0.0)
+            phase_reports["recovery"] = rep
+            stats = server.stats()
+            health = stats["health"]
+            breakers = stats["breakers"]
+    finally:
+        faults.set_fault_plan(None)
+
+    total = _merge_reports(list(phase_reports.values()))
+
+    # -- invariants ---------------------------------------------------
+    def _cycle_ok(seq: list) -> bool:
+        """closed -> open -> half_open -> closed, in order."""
+        try:
+            i = seq.index("open")
+            j = seq.index("half_open", i)
+            seq.index("closed", j)
+            return True
+        except ValueError:
+            return False
+
+    poison_tag = f", {POISON_LEN})"
+    poison_transitions = [
+        e["decision"] for e in _decisions("breaker_transition")
+        if e.get("site") == "serve.dispatch"
+        and POISON_OP in e.get("key", "")
+        and e.get("key", "").endswith(poison_tag)]
+    mesh_transitions = [
+        e["decision"] for e in _decisions("breaker_transition")
+        if e.get("site") == "parallel.dispatch"]
+    serve_events = [e["decision"] for e in _decisions("serve_health")]
+    mesh_events = _decisions("mesh_degrade")
+    poison_breaker = next(
+        (i for i in breakers if POISON_OP in i["key"]
+         and i["key"].endswith(poison_tag)), None)
+    mesh_breaker = breaker.lookup(
+        "parallel.dispatch",
+        ("sharded_matmul", f"{MESH_AXIS}{args.mesh_devices}"
+                           f"@{MESH_AXIS}"))
+    answered = total["ok"] + total["degraded"]
+    invariants = {
+        "zero_lost": total["lost"] == 0,
+        "zero_double_answered": total["double_answered"] == 0,
+        "zero_untyped_errors": total["errors"] == 0,
+        "parity_clean": (total["parity_failures"] == 0
+                         and mesh_bad == 0),
+        "sheds_typed": phase_reports["overload"]["shed"]
+        == args.overloads,
+        "deadline_misses_bounded": total["deadline_miss"]
+        <= max(1, int(args.max_miss_frac * total["requests"])),
+        "breaker_cycle": _cycle_ok(poison_transitions),
+        "breaker_closed_at_end": (
+            poison_breaker is not None
+            and poison_breaker["state"] == breaker.CLOSED),
+        "zero_retry_steady_state": retry_steady == 0,
+        "mesh_degrade_observed": (
+            len(mesh_events) >= 1
+            and all(e.get("mesh") for e in mesh_events)),
+        "mesh_breaker_cycle": _cycle_ok(mesh_transitions),
+        "mesh_breaker_closed_at_end": (
+            mesh_breaker is not None
+            and mesh_breaker.state == breaker.CLOSED),
+        "health_degraded_then_healthy": (
+            "degrade" in serve_events and "recover" in serve_events
+            and health["state"] == serve.HEALTHY),
+        "answers_accounted": (answered + total["shed"]
+                              + total["deadline_miss"]
+                              + total["closed"] + total["errors"]
+                              == total["requests"]),
+    }
+
+    # -- CHAOS_DETAILS rows + evidence tail ---------------------------
+    wall = sum(r["phase_wall_s"] for r in phase_reports.values())
+    rows = [
+        {"metric": "chaos requests answered", "value": float(answered),
+         "unit": "req", "vs_baseline": None},
+        {"metric": "chaos campaign throughput",
+         "value": round(total["requests"] / wall, 2) if wall else 0.0,
+         "unit": "req/s", "vs_baseline": None},
+        {"metric": "chaos deadline hit rate",
+         "value": round(answered / (answered + total["deadline_miss"]),
+                        4) if answered + total["deadline_miss"]
+         else 1.0,
+         "unit": "fraction", "vs_baseline": None},
+    ]
+    for label in ("mesh_loss", "recovery"):
+        rows.append({
+            "metric": f"chaos {label} throughput",
+            "value": round(
+                phase_reports[label].get("throughput_rps", 0.0), 2),
+            "unit": "req/s", "vs_baseline": None,
+            # rows measured with injection active are
+            # DEGRADED-not-gated by bench_regress
+            **({"chaos_phase": label} if label != "recovery"
+               else {}),
+        })
+    snap = obs.snapshot()
+    counters = {}
+    for c in snap["counters"]:
+        if c["name"].startswith(("serve_", "fault_", "breaker_",
+                                 "mesh_")):
+            counters[c["name"]] = counters.get(c["name"], 0) \
+                + c["value"]
+    rows.append({
+        "metric": "chaos breaker short circuits",
+        "value": float(counters.get("breaker_short_circuit", 0)),
+        "unit": "calls", "vs_baseline": None,
+        "telemetry": {"counters": counters},
+    })
+    prom = [line for line in obs.to_prometheus(snap).splitlines()
+            if "breaker_" in line or "mesh_" in line
+            or "deadline" in line]
+    evidence = {
+        "chaos_invariants": invariants,
+        "phase_reports": {k: {kk: vv for kk, vv in v.items()
+                              if not isinstance(vv, np.ndarray)}
+                          for k, v in phase_reports.items()},
+        "fault_phases": [e["decision"]
+                         for e in _decisions("fault_phase")],
+        "breaker_transitions": _decisions("breaker_transition"),
+        "mesh_degrade_events": mesh_events[:8],
+        "serve_health_events": _decisions("serve_health"),
+        "prometheus_breaker_lines": prom,
+        "retry_attempts_steady_state": retry_steady,
+    }
+    return invariants, rows, evidence
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--requests", type=int, default=48,
+                    help="mixed-traffic requests per phase slice")
+    ap.add_argument("--steady", type=int, default=12,
+                    help="poisoned-class requests in the steady "
+                         "(breaker-open) segment")
+    ap.add_argument("--recovery-calls", type=int, default=8)
+    ap.add_argument("--mesh-loss-calls", type=int, default=4)
+    ap.add_argument("--mesh-devices", type=int, default=8)
+    ap.add_argument("--overloads", type=int, default=6,
+                    help="injected admission overloads in phase 2")
+    ap.add_argument("--deadline-ms", type=float, default=30000.0,
+                    help="end-to-end deadline stamped on every "
+                         "request (generous: only real stalls miss)")
+    ap.add_argument("--max-miss-frac", type=float, default=0.25,
+                    help="deadline misses allowed, as a fraction of "
+                         "total requests")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--verify", type=int, default=8)
+    ap.add_argument("--result-timeout", type=float, default=300.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--details", default="CHAOS_DETAILS.json",
+                    help="write BENCH_DETAILS-format rows + evidence "
+                         "here")
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-long CPU campaign (the CI gate)")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.requests = min(args.requests, 24)
+        args.steady = min(args.steady, 8)
+        args.verify = min(args.verify, 4)
+
+    # the sharded phase needs the virtual CPU mesh (the pin must win
+    # the race to backend init); in-process callers (tests) already
+    # pinned it, in which case the failed re-pin is fine as long as
+    # enough devices exist
+    import jax
+
+    from veles.simd_tpu.utils.platform import pin_cpu
+
+    try:
+        pin_cpu(args.mesh_devices)
+    except RuntimeError:
+        if len(jax.devices()) < args.mesh_devices:
+            raise
+
+    obs.enable()
+    obs.reset()
+    breaker.reset()
+    faults.reset_fault_history()
+    # a tight half-open cadence keeps the recovery phase's counting
+    # argument exact: a closed-at-end breaker within the scripted
+    # number of calls (restored after the campaign)
+    prev_cadence = os.environ.get(breaker.BREAKER_PROBE_EVERY_ENV)
+    os.environ[breaker.BREAKER_PROBE_EVERY_ENV] = "2"
+    try:
+        invariants, rows, evidence = run_campaign(args)
+    finally:
+        if prev_cadence is None:
+            os.environ.pop(breaker.BREAKER_PROBE_EVERY_ENV, None)
+        else:
+            os.environ[breaker.BREAKER_PROBE_EVERY_ENV] = prev_cadence
+
+    print(json.dumps({"invariants": invariants,
+                      "rows": rows}, indent=2, default=str))
+    if args.details:
+        with open(args.details, "w") as f:
+            json.dump(rows + [evidence], f, indent=2, default=str)
+        print(f"chaos: wrote {args.details}", file=sys.stderr)
+    failed = sorted(k for k, ok in invariants.items() if not ok)
+    if failed:
+        print(f"chaos: FAILED invariants: {', '.join(failed)}",
+              file=sys.stderr)
+        return 1
+    print("chaos: campaign green — all invariants hold",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -676,6 +676,158 @@ def serve_layer_errors(tree, fname) -> list:
     return errors
 
 
+# --- sharded-dispatch rule (parallel/ops.py) --------------------------------
+# PR 10 wrapped every instrumented shard_map dispatch in parallel/ops.py
+# in the fault policy (faults.guarded thunks with a single-chip degrade
+# path, breaker-gated).  This rule keeps the discipline — the same one
+# serve/ and parallel/fourier.py's _dispatch already obey: INVOKING an
+# obs.instrumented_jit-compiled sharded program (directly, e.g.
+# ``_instrumented(op, _run)(x)``, or through a bound name, e.g.
+# ``jfn = _instrumented(op, _run); jfn(x)``) outside a faults.guarded
+# region is a lint failure — a dispatch that cannot retry, degrade to
+# the single-chip twin, or trip a breaker.  Alias-tracked like the
+# serve rule, and "inside a guarded region" includes arguments handed
+# to any module-level wrapper whose body reaches faults.guarded (the
+# ``_sharded_guard`` convention), computed transitively.
+
+_PARALLEL_GUARD_FILES = ("veles/simd_tpu/parallel/ops.py",)
+
+
+# the fault-policy entry points whose call arguments form a guarded
+# region (breaker_guarded is guarded behind the class's breaker)
+_GUARD_ENTRY_POINTS = {"guarded", "breaker_guarded"}
+
+
+def _faults_aliases(tree) -> tuple:
+    """``(faults_module_aliases, guarded_fn_names)`` — names bound to
+    the fault engine module and to its guard entry points
+    (``faults.guarded`` / ``faults.breaker_guarded``) directly."""
+    mods, guarded_names = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "veles.simd_tpu.runtime":
+                for a in node.names:
+                    if a.name == "faults":
+                        mods.add(a.asname or a.name)
+            elif node.module == "veles.simd_tpu.runtime.faults":
+                for a in node.names:
+                    if a.name in _GUARD_ENTRY_POINTS:
+                        guarded_names.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "veles.simd_tpu.runtime.faults" \
+                        and a.asname:
+                    mods.add(a.asname)
+    return mods, guarded_names
+
+
+def parallel_guard_errors(tree, fname) -> list:
+    """The rule body on a parsed module (separated so tests can feed
+    synthetic sources).  Returns human-readable error strings."""
+    errors = []
+    faults_mods, guarded_names = _faults_aliases(tree)
+    funcs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.setdefault(node.name, node)
+
+    def _is_guarded_call(node) -> bool:
+        f = node.func
+        return ((isinstance(f, ast.Attribute)
+                 and f.attr in _GUARD_ENTRY_POINTS
+                 and isinstance(f.value, ast.Name)
+                 and f.value.id in faults_mods)
+                or (isinstance(f, ast.Name) and f.id in guarded_names))
+
+    # guard wrappers: module-level functions whose body reaches a
+    # faults.guarded call (directly or through another wrapper)
+    guard_wrappers: set = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, fn in funcs.items():
+            if name in guard_wrappers:
+                continue
+            for w in ast.walk(fn):
+                if isinstance(w, ast.Call) and (
+                        _is_guarded_call(w)
+                        or (isinstance(w.func, ast.Name)
+                            and w.func.id in guard_wrappers)):
+                    guard_wrappers.add(name)
+                    changed = True
+                    break
+
+    # guarded regions: arguments of faults.guarded / guard-wrapper
+    # calls, plus bodies of functions referenced from one (the serve
+    # rule's transitive closure)
+    inside: set = set()
+    guarded_fns: set = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not (_is_guarded_call(node)
+                or (isinstance(node.func, ast.Name)
+                    and node.func.id in guard_wrappers)):
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for w in ast.walk(arg):
+                inside.add(id(w))
+                if isinstance(w, ast.Name) and w.id in funcs:
+                    guarded_fns.add(w.id)
+    changed = True
+    while changed:
+        changed = False
+        for name in list(guarded_fns):
+            for w in ast.walk(funcs[name]):
+                inside.add(id(w))
+                if (isinstance(w, ast.Name) and w.id in funcs
+                        and w.id not in guarded_fns):
+                    guarded_fns.add(w.id)
+                    changed = True
+
+    # instrumented factories: _instrumented-style helpers (body calls
+    # obs.instrumented_jit) and direct obs.instrumented_jit chains;
+    # names bound from a factory call are dispatchable handles
+    factories = {
+        name for name, fn in funcs.items()
+        if any(isinstance(w, ast.Attribute)
+               and w.attr == "instrumented_jit"
+               for w in ast.walk(fn))}
+
+    def _is_factory_call(call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Name) and f.id in factories:
+            return True
+        return (isinstance(f, ast.Attribute)
+                and f.attr == "instrumented_jit")
+
+    handles = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and _is_factory_call(node.value)):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    handles.add(t.id)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        is_dispatch = (
+            (isinstance(f, ast.Call) and _is_factory_call(f))
+            or (isinstance(f, ast.Name) and f.id in handles))
+        if not is_dispatch:
+            continue
+        if id(node) not in inside:
+            errors.append(
+                f"{fname}:{node.lineno}: sharded dispatch outside a "
+                "faults.guarded thunk — instrumented shard_map "
+                "programs must dispatch through the fault policy "
+                "(retry / single-chip degrade / breaker gate)")
+    return errors
+
+
 def compute_module_lint(files) -> int:
     """The ops/parallel project rules, one parse per file: telemetry
     only through the approved helpers (keeps instrumentation out of
@@ -708,6 +860,10 @@ def compute_module_lint(files) -> int:
             continue
         if rel in _DISPATCH_RULE_FILES:
             for msg in spectral_dispatch_errors(tree, str(f)):
+                print(msg)
+                failures += 1
+        if rel in _PARALLEL_GUARD_FILES:
+            for msg in parallel_guard_errors(tree, str(f)):
                 print(msg)
                 failures += 1
         for msg in fault_handler_errors(tree, str(f)):

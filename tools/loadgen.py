@@ -77,11 +77,13 @@ DEFAULT_TENANTS = ("alice", "bob", "carol")
 
 def build_schedule(rng, n_requests: int, rate_hz: float,
                    burst_every: int = 0, burst_size: int = 0,
-                   tenants=DEFAULT_TENANTS) -> list:
+                   tenants=DEFAULT_TENANTS,
+                   deadline_ms: float | None = None) -> list:
     """``[(gap_seconds, Request), ...]`` — exponential inter-arrival
     gaps at ``rate_hz`` (0 = no pacing, submit as fast as possible),
     with a ``burst_size`` zero-gap burst every ``burst_every``-th
-    arrival (the overload trigger)."""
+    arrival (the overload trigger).  ``deadline_ms`` stamps every
+    request with an end-to-end deadline (None = server default)."""
     mix = _mix()
     schedule = []
     for i in range(n_requests):
@@ -89,7 +91,8 @@ def build_schedule(rng, n_requests: int, rate_hz: float,
         n = int(lengths[rng.randint(len(lengths))])
         x = rng.randn(n).astype(np.float32)
         req = serve.Request(op, x, params(),
-                            tenant=tenants[rng.randint(len(tenants))])
+                            tenant=tenants[rng.randint(len(tenants))],
+                            deadline_ms=deadline_ms)
         gap = float(rng.exponential(1.0 / rate_hz)) if rate_hz > 0 \
             else 0.0
         if burst_every and burst_size and i and i % burst_every == 0:
@@ -101,7 +104,8 @@ def build_schedule(rng, n_requests: int, rate_hz: float,
                 n2 = int(lengths2[rng.randint(len(lengths2))])
                 schedule.append((0.0, serve.Request(
                     op2, rng.randn(n2).astype(np.float32), params2(),
-                    tenant=tenants[rng.randint(len(tenants))])))
+                    tenant=tenants[rng.randint(len(tenants))],
+                    deadline_ms=deadline_ms)))
     return schedule
 
 
@@ -139,11 +143,16 @@ def run_load(server, schedule, *, block: bool = False,
     submitted_s = time.perf_counter() - t0
     report = {"requests": len(pairs), "ok": 0, "degraded": 0,
               "shed": 0, "closed": 0, "errors": 0, "lost": 0,
+              "deadline_miss": 0,
               "double_answered": 0, "parity_failures": 0,
               "submit_wall_s": submitted_s}
     answered = []
     waits = []
+    tenant_submitted: dict = {}
+    tenant_answered: dict = {}
     for req, ticket in pairs:
+        tenant_submitted[req.tenant] = \
+            tenant_submitted.get(req.tenant, 0) + 1
         try:
             value = ticket.result(timeout=result_timeout)
         except TimeoutError:
@@ -152,6 +161,9 @@ def run_load(server, schedule, *, block: bool = False,
         except serve.Overloaded:
             report["shed"] += 1
             continue
+        except serve.DeadlineExceeded:
+            report["deadline_miss"] += 1
+            continue
         except serve.ServerClosed:
             report["closed"] += 1
             continue
@@ -159,10 +171,27 @@ def run_load(server, schedule, *, block: bool = False,
             report["errors"] += 1
             continue
         report["degraded" if ticket.degraded else "ok"] += 1
+        tenant_answered[req.tenant] = \
+            tenant_answered.get(req.tenant, 0) + 1
         answered.append((req, value))
         if ticket.wait_s is not None:
             waits.append(ticket.wait_s)
     report["wall_s"] = time.perf_counter() - t0
+    # per-tenant fairness under overload: the max/min ANSWERED RATIO
+    # (answered[t] / submitted[t] — raw counts would read random
+    # arrival imbalance as unfairness) across tenants.  max/min is
+    # the human form (1.0 = perfectly fair, a starved tenant pushes
+    # it toward infinity, reported None when one tenant got nothing);
+    # min/max in [0, 1] is the bench-gate form — higher is better,
+    # so the regression gate's floor logic applies unchanged.
+    report["tenant_submitted"] = dict(sorted(tenant_submitted.items()))
+    report["tenant_answered"] = dict(sorted(tenant_answered.items()))
+    if len(tenant_submitted) > 1:
+        ratios = [tenant_answered.get(t, 0) / n
+                  for t, n in tenant_submitted.items() if n]
+        lo, hi = min(ratios), max(ratios)
+        report["fairness_max_min"] = (hi / lo if lo else None)
+        report["fairness_min_max"] = (lo / hi if hi else 0.0)
     report["double_answered"] = obs.counter_value(
         "serve_double_answer") if obs.enabled() else 0
     if waits:
@@ -205,6 +234,22 @@ def bench_rows(report: dict) -> list:
             "unit": "1/s",
             "vs_baseline": None,
         })
+    if report.get("fairness_min_max") is not None:
+        rows.append({
+            "metric": "serve tenant fairness",
+            "value": round(report["fairness_min_max"], 4),
+            "unit": "min/max answered ratio",
+            "vs_baseline": None,
+        })
+    answered = report.get("ok", 0) + report.get("degraded", 0)
+    misses = report.get("deadline_miss", 0)
+    if answered + misses:
+        rows.append({
+            "metric": "serve deadline hit rate",
+            "value": round(answered / (answered + misses), 4),
+            "unit": "fraction",
+            "vs_baseline": None,
+        })
     if obs.enabled():
         snap = obs.snapshot()
         rows.append({"metric": "serve batches",
@@ -215,8 +260,9 @@ def bench_rows(report: dict) -> list:
                      "telemetry": {"counters": {
                          c["name"]: c["value"]
                          for c in snap["counters"]
-                         if c["name"].startswith(("serve_",
-                                                  "fault_"))}}})
+                         if c["name"].startswith(("serve_", "fault_",
+                                                  "breaker_",
+                                                  "mesh_"))}}})
     return rows
 
 
@@ -233,6 +279,9 @@ def main(argv=None) -> int:
     ap.add_argument("--queue-depth", type=int, default=None)
     ap.add_argument("--tenant-depth", type=int, default=None)
     ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="end-to-end deadline stamped on every "
+                         "request (default: server default)")
     ap.add_argument("--block", action="store_true",
                     help="backpressure submits instead of shedding")
     ap.add_argument("--verify", type=int, default=16,
@@ -253,7 +302,8 @@ def main(argv=None) -> int:
         args.rate = 0.0
     rng = np.random.RandomState(args.seed)
     schedule = build_schedule(rng, args.requests, args.rate,
-                              args.burst_every, args.burst_size)
+                              args.burst_every, args.burst_size,
+                              deadline_ms=args.deadline_ms)
     server = serve.Server(max_batch=args.max_batch,
                           max_wait_ms=args.max_wait_ms,
                           queue_depth=args.queue_depth,

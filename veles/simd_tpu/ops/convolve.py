@@ -609,14 +609,21 @@ def _run(handle: ConvolutionHandle, x, h, simd=None):
         # host-side span around the whole XLA dispatch: route choice +
         # executable call.  Python-only (no jax ops), so the traced
         # program is untouched — test_obs.py pins jaxpr identity.
-        # faults.guarded applies the transient-fault policy (bounded
-        # retry on device-lost/timeout, then graceful degradation to
-        # the NumPy oracle) around the whole XLA side.
+        # faults.breaker_guarded applies the transient-fault policy
+        # (bounded retry on device-lost/timeout, then graceful
+        # degradation to the NumPy oracle) around the whole XLA side,
+        # behind the shape class's circuit breaker — a persistently
+        # failing class answers straight from the oracle instead of
+        # paying the retry ladder per call (churning x_length is
+        # pow2-bucketed so classes stay finite; h_length gates routes
+        # exactly, so it keys exactly)
         with obs.span("convolve.dispatch",
                       algo=handle.algorithm.value,
                       os_matmul=handle.os_matmul):
-            return faults.guarded(
+            return faults.breaker_guarded(
                 "convolve.dispatch",
+                (handle.algorithm.value, handle.h_length,
+                 routing.pow2_bucket(handle.x_length)),
                 lambda: _run_xla(handle, x, h),
                 fallback=lambda: _run_oracle(handle, x, h))
     return _run_oracle(handle, x, h)

@@ -276,9 +276,13 @@ def _run2d(x, h, reverse, algorithm, simd):
                       auto=auto):
             # transient device faults (device-lost/timeout): bounded
             # retry, then degrade to the float64 oracle — the shared
-            # fault policy (runtime/faults.py)
-            return faults.guarded(
+            # fault policy (runtime/faults.py), behind the shape
+            # class's circuit breaker (image dims pow2-bucketed,
+            # kernel dims exact — the tune-class convention)
+            return faults.breaker_guarded(
                 "convolve2d.dispatch",
+                (algorithm, np.shape(h),
+                 tuple(routing.pow2_bucket(d) for d in np.shape(x))),
                 lambda: _run2d_xla(x, h, reverse, algorithm, auto),
                 fallback=lambda: _run2d_oracle(x, h, reverse))
     return _run2d_oracle(x, h, reverse)

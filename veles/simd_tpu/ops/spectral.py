@@ -833,12 +833,16 @@ def stft(x, frame_length: int, hop: int, window=None, simd=None,
             # supported inputs, same as the pre-route code).  The
             # transient-fault policy (bounded retry on device-lost/
             # timeout, then graceful degradation to the float64
-            # oracle) wraps the whole route call.  A FORCED route gets
-            # the retries but never the oracle fallback — a caller who
-            # pinned a route (bench per-route rows) must never
-            # silently get another implementation's numbers.
-            return faults.guarded(
+            # oracle) wraps the whole route call, behind the shape
+            # class's circuit breaker (frame/hop gate routes exactly,
+            # so they key exactly).  A FORCED route gets the retries
+            # but never the oracle fallback — a caller who pinned a
+            # route (bench per-route rows) must never silently get
+            # another implementation's numbers; with its breaker open
+            # it dispatches as a zero-retry trial.
+            return faults.breaker_guarded(
                 "stft.dispatch",
+                (chosen, int(frame_length), int(hop)),
                 lambda: _STFT_ROUTES[chosen](x_np, window,
                                              frame_length, hop,
                                              forced=forced),
@@ -1004,9 +1008,11 @@ def istft(spec, n: int, frame_length: int, hop: int, window=None,
             "istft", path, n=int(n), frame_length=int(frame_length),
             hop=int(hop))
         with obs.span("istft.dispatch", route=chosen, path=path):
-            # forced routes retry but never degrade (see stft)
-            return faults.guarded(
+            # forced routes retry but never degrade (see stft);
+            # breaker-gated per (route, frame, hop) class like stft
+            return faults.breaker_guarded(
                 "istft.dispatch",
+                (chosen, int(frame_length), int(hop)),
                 lambda: _ISTFT_ROUTES[chosen](spec, window, env_inv,
                                               n, frame_length, hop,
                                               forced=forced),

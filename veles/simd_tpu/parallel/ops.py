@@ -7,6 +7,19 @@ sequence axis with a ``ppermute`` halo exchange; the GEMM column loop
 (``src/matrix.c:200-226``) becomes a contracting-dim-sharded
 ``dot_general`` + ``psum``.  Everything here is pure SPMD: one jitted
 program, XLA inserts the collectives, ICI carries them.
+
+**Mesh-loss degradation**: every instrumented sharded dispatch runs
+through :func:`_sharded_guard` — the transient-fault policy
+(:func:`veles.simd_tpu.runtime.faults.guarded`) with a degrade path to
+the op's single-chip ``ops/`` twin on device loss (recorded as a
+``mesh_degrade`` decision event with the mesh geometry), gated by a
+per-``(op, mesh-class)`` circuit breaker
+(:mod:`veles.simd_tpu.runtime.breaker`) so a dead mesh answers via the
+twin immediately instead of paying the retry ladder per call, with
+call-counted half-open probes that re-enable sharded dispatch when the
+mesh comes back.  ``tools/lint.py`` enforces the discipline: an
+instrumented sharded dispatch outside a ``faults.guarded`` thunk is a
+lint failure.
 """
 
 from __future__ import annotations
@@ -24,6 +37,7 @@ except ImportError:  # jax < 0.5 keeps shard_map in experimental
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from veles.simd_tpu import obs
+from veles.simd_tpu.runtime import faults, routing
 
 
 def _axis_size(axis_name) -> int:
@@ -74,6 +88,34 @@ def _instrumented(op: str, run_fn):
     ``ops/batched.py`` — a structural refactor of every closure's
     captures, deliberately left for its own PR."""
     return obs.instrumented_jit(run_fn, op=op, route="shard_map")
+
+
+def _sharded_guard(op: str, thunk, fallback, mesh: Mesh, axis: str):
+    """One sharded dispatch under the mesh-loss policy.
+
+    ``thunk`` runs the instrumented shard_map program (including any
+    output slicing); ``fallback`` computes the SAME answer on the
+    single-chip ``ops/`` twin.  The dispatch is breaker-gated per
+    ``(op, mesh-class)``: transient mesh faults ride the bounded
+    retry of :func:`veles.simd_tpu.runtime.faults.guarded` and
+    degrade to the twin (a ``mesh_degrade`` decision event carrying
+    the mesh geometry); once the class's breaker opens, calls go
+    straight to the twin — a dead mesh costs zero retry latency —
+    and every ``probe_every``-th call probes the mesh with a
+    zero-retry budget, re-enabling sharded dispatch on the first
+    success."""
+    site = f"parallel.{op}"
+    geom = routing.mesh_class(mesh, axis)
+
+    def degrade():
+        obs.count("mesh_degrade", op=op)
+        obs.record_decision("mesh_degrade", op, site=site, mesh=geom,
+                            fallback="single_chip")
+        return fallback()
+
+    return faults.breaker_guarded(
+        site, (op, geom), thunk, fallback=degrade,
+        fallback_name="single_chip", breaker_site="parallel.dispatch")
 
 
 def halo_exchange_left(x_local, halo_len: int, axis_name: str,
@@ -179,8 +221,14 @@ def sharded_convolve(x, h, mesh: Mesh, axis: str = "sp"):
             x_ext = jnp.concatenate([halo, x_local], axis=-1)
             return _local_block_conv(x_ext, h_full)
 
-        return _instrumented("sharded_convolve",
-                             _run)(x_pad, h)[..., :out_len]
+        from veles.simd_tpu.ops import convolve as cv
+
+        jfn = _instrumented("sharded_convolve", _run)
+        return _sharded_guard(
+            "sharded_convolve",
+            lambda: jfn(x_pad, h)[..., :out_len],
+            lambda: cv.convolve_simd(x, h),
+            mesh, axis)
 
 
 def sharded_convolve_ring(x, h, mesh: Mesh, axis: str = "sp",
@@ -258,11 +306,17 @@ def sharded_convolve_ring(x, h, mesh: Mesh, axis: str = "sp",
                 block = jax.lax.ppermute(block, axis, perm)
         return y
 
-    out = _instrumented("sharded_convolve_ring",
-                        _run)(x_pad, h_pp)[..., :out_len]
-    if batch_pad:
-        out = out[:x.shape[0]]
-    return out
+    from veles.simd_tpu.ops import convolve as cv
+
+    def _ring_thunk():
+        out = _instrumented("sharded_convolve_ring",
+                            _run)(x_pad, h_pp)[..., :out_len]
+        if batch_pad:
+            out = out[:x.shape[0]]
+        return out
+
+    return _sharded_guard("sharded_convolve_ring", _ring_thunk,
+                          lambda: cv.convolve_simd(x, h), mesh, axis)
 
 
 def _ring_block_conv(block, seg):
@@ -333,8 +387,14 @@ def sharded_convolve_batch(x, h, mesh: Mesh, batch_axis: str = "dp",
         x_ext = jnp.concatenate([halo, x_local], axis=-1)
         return _local_block_conv(x_ext, h_full)
 
-    return _instrumented("sharded_convolve_batch",
-                         _run)(x_pad, h)[:batch, :out_len]
+    from veles.simd_tpu.ops import convolve as cv
+
+    jfn = _instrumented("sharded_convolve_batch", _run)
+    return _sharded_guard(
+        "sharded_convolve_batch",
+        lambda: jfn(x_pad, h)[:batch, :out_len],
+        lambda: cv.convolve_simd(x, h),
+        mesh, seq_axis)
 
 
 def sharded_convolve2d(x, h, mesh: Mesh, axes=("dp", "sp")):
@@ -404,8 +464,12 @@ def sharded_convolve2d(x, h, mesh: Mesh, axes=("dp", "sp")):
             full, (k0 - 1, k1 - 1),
             (k0 - 1 + x_local.shape[-2], k1 - 1 + x_local.shape[-1]))
 
-    return _instrumented("sharded_convolve2d",
-                         _run)(x_pad, h)[:out0, :out1]
+    jfn = _instrumented("sharded_convolve2d", _run)
+    return _sharded_guard(
+        "sharded_convolve2d",
+        lambda: jfn(x_pad, h)[:out0, :out1],
+        lambda: cv2.convolve2d(x, h),
+        mesh, a1)
 
 
 def sharded_convolve2d_ring(x, h, mesh: Mesh, axes=("dp", "sp")):
@@ -472,8 +536,14 @@ def sharded_convolve2d_ring(x, h, mesh: Mesh, axes=("dp", "sp")):
                 row = jax.lax.ppermute(row, a0, perm0)
         return y
 
-    return _instrumented("sharded_convolve2d_ring",
-                         _run)(x_pad, h_pp)[:out0, :out1]
+    from veles.simd_tpu.ops import convolve2d as cv2
+
+    jfn = _instrumented("sharded_convolve2d_ring", _run)
+    return _sharded_guard(
+        "sharded_convolve2d_ring",
+        lambda: jfn(x_pad, h_pp)[:out0, :out1],
+        lambda: cv2.convolve2d(x, h),
+        mesh, a1)
 
 
 def _ring_tile_conv2d(tile, seg):
@@ -912,6 +982,7 @@ def sharded_matmul(a, b, mesh: Mesh, axis: str = "tp"):
         axis=axis, m=int(a.shape[-2]), k=int(a.shape[-1]),
         n=int(b.shape[-1]))
     with obs.span("sharded_matmul.dispatch", n_shards=int(shards)):
+        a0, b0 = a, b
         rem = a.shape[-1] % shards
         if rem:
             pad = shards - rem
@@ -928,7 +999,14 @@ def sharded_matmul(a, b, mesh: Mesh, axis: str = "tp"):
                               precision=jax.lax.Precision.HIGHEST)
             return jax.lax.psum(partial, axis)
 
-        return _instrumented("sharded_matmul", _run)(a, b)
+        from veles.simd_tpu.ops import matrix as mx
+
+        jfn = _instrumented("sharded_matmul", _run)
+        return _sharded_guard(
+            "sharded_matmul",
+            lambda: jfn(a, b),
+            lambda: mx.matrix_multiply(a0, b0),
+            mesh, axis)
 
 
 def _check_stft_sharding(n, frame_length, hop, n_shards):
@@ -1008,8 +1086,13 @@ def sharded_stft(x, frame_length: int, hop: int, mesh: Mesh,
         return frame_fn(frames[..., :frames_local, :])
 
     with obs.span("sharded_stft.dispatch", n_shards=int(n_shards)):
-        out = _instrumented("sharded_stft", _run)(x)
-    return out[..., :sp.frame_count(n, frame_length, hop), :]
+        fc = sp.frame_count(n, frame_length, hop)
+        jfn = _instrumented("sharded_stft", _run)
+        return _sharded_guard(
+            "sharded_stft",
+            lambda: jfn(x)[..., :fc, :],
+            lambda: sp.stft(x, frame_length, hop, window=window),
+            mesh, axis)
 
 
 def sharded_istft(spec, n: int, frame_length: int, hop: int, mesh: Mesh,
@@ -1040,6 +1123,7 @@ def sharded_istft(spec, n: int, frame_length: int, hop: int, mesh: Mesh,
     frame_fn = _fr.frame_irfft_fn(local_route, frame_length,
                                   window_np)
     spec = jnp.asarray(spec, jnp.complex64)
+    spec0 = spec
     frames_total = sp.frame_count(n, frame_length, hop)
     if spec.shape[-2:] != (frames_total, frame_length // 2 + 1):
         raise ValueError(
@@ -1070,10 +1154,14 @@ def sharded_istft(spec, n: int, frame_length: int, hop: int, mesh: Mesh,
         head = buf[..., :halo] + recv
         return jnp.concatenate([head, buf[..., halo:block]], axis=-1)
 
-    out = _instrumented("sharded_istft", _run)(spec)
     env_inv = jnp.asarray(
         sp._env_inv(n, frame_length, hop, window_np).astype(np.float32))
-    return out * env_inv
+    jfn = _instrumented("sharded_istft", _run)
+    return _sharded_guard(
+        "sharded_istft",
+        lambda: jfn(spec) * env_inv,
+        lambda: sp.istft(spec0, n, frame_length, hop, window=window),
+        mesh, axis)
 
 
 def sharded_sosfilt(sos, x, mesh: Mesh, axis: str = "sp"):
@@ -1164,7 +1252,12 @@ def sharded_sosfilt(sos, x, mesh: Mesh, axis: str = "sp"):
             cur = _section(cur, sec)
         return cur
 
-    return _instrumented("sharded_sosfilt", _run)(x)
+    jfn = _instrumented("sharded_sosfilt", _run)
+    return _sharded_guard(
+        "sharded_sosfilt",
+        lambda: jfn(x),
+        lambda: _iir.sosfilt(sos, x),
+        mesh, axis)
 
 
 def sharded_welch(x, mesh: Mesh, axis: str = "sp", fs: float = 1.0,
@@ -1223,8 +1316,14 @@ def sharded_welch(x, mesh: Mesh, axis: str = "sp", fs: float = 1.0,
                         axis=-2)
         return jax.lax.psum(local, axis) / frames_total
 
-    return freqs, _instrumented("sharded_welch",
-                                _run)(x) * scale_mult
+    jfn = _instrumented("sharded_welch", _run)
+    pxx = _sharded_guard(
+        "sharded_welch",
+        lambda: jfn(x) * scale_mult,
+        lambda: sp.welch(x, fs=fs, nperseg=nperseg,
+                         noverlap=noverlap, window=window)[1],
+        mesh, axis)
+    return freqs, pxx
 
 
 def sharded_resample_poly(x, up: int, down: int, mesh: Mesh,
@@ -1291,7 +1390,12 @@ def sharded_resample_poly(x, up: int, down: int, mesh: Mesh,
         return _rs._resample_conv(x_ext, taps_j, up, down, out_block,
                                   pad=(p_lo, p_hi))
 
-    return _instrumented("sharded_resample_poly", _run)(x)
+    jfn = _instrumented("sharded_resample_poly", _run)
+    return _sharded_guard(
+        "sharded_resample_poly",
+        lambda: jfn(x),
+        lambda: _rs.resample_poly(x, up, down, taps=taps),
+        mesh, axis)
 
 
 def sharded_swt_apply2d(type, order, level, ext, img, mesh: Mesh,
@@ -1596,7 +1700,14 @@ def sharded_normalize2d(src, mesh: Mesh, axis: str = "sp"):
         out = (v - mn) / diff - 1.0
         return jnp.where(mx == mn, jnp.zeros_like(out), out)
 
-    return _instrumented("sharded_normalize2d", _run)(srcj)[:h]
+    from veles.simd_tpu.ops import normalize as _nm
+
+    jfn = _instrumented("sharded_normalize2d", _run)
+    return _sharded_guard(
+        "sharded_normalize2d",
+        lambda: jfn(srcj)[:h],
+        lambda: _nm.normalize2D(src),
+        mesh, axis)
 
 
 def data_parallel(fn, mesh: Mesh, axis: str = "dp"):
@@ -1619,6 +1730,10 @@ def data_parallel(fn, mesh: Mesh, axis: str = "dp"):
         spec = P(axis, *([None] * (batch.ndim - 1)))
         batch = jax.device_put(batch, NamedSharding(mesh, spec))
         with mesh:
-            return jfn(batch, *args, **kwargs)
+            # guarded (bounded retry on transient mesh faults); no
+            # single-chip fallback exists for a user-supplied fn, so
+            # exhaustion re-raises typed
+            return faults.guarded("parallel.data_parallel",
+                                  lambda: jfn(batch, *args, **kwargs))
 
     return wrapper

@@ -4,14 +4,20 @@ The ops layer owns *what* to compute (route tables, selectors,
 oracles); this package owns the runtime policies every op family
 shares.  Residents: :mod:`~veles.simd_tpu.runtime.faults`, the fault-policy
 engine — one demote-and-remember implementation for Mosaic compile
-rejections, bounded retry-with-backoff for transient device faults,
+rejections, bounded retry-with-backoff for transient device faults
+(deadline-budget-clipped when the caller threads a request budget in),
 and the deterministic fault-injection harness that exercises both on
-CPU CI — and :mod:`~veles.simd_tpu.runtime.routing`, the unified
-routing engine: declarative candidate-route tables, the shared
-selector, and the measured autotuner with its persistent tune cache.
+CPU CI — :mod:`~veles.simd_tpu.runtime.breaker`, the per-``(site,
+shape-class)`` circuit breakers that send persistently-failing
+buckets straight to their fallback instead of burning the retry
+ladder per call — and :mod:`~veles.simd_tpu.runtime.routing`, the
+unified routing engine: declarative candidate-route tables, the
+shared selector, and the measured autotuner with its persistent tune
+cache.
 """
 
+from veles.simd_tpu.runtime import breaker
 from veles.simd_tpu.runtime import faults
 from veles.simd_tpu.runtime import routing
 
-__all__ = ["faults", "routing"]
+__all__ = ["breaker", "faults", "routing"]

@@ -45,7 +45,23 @@ module is the one shared layer, three pieces:
   serving layer (:mod:`veles.simd_tpu.serve`) adds two sites:
   ``serve.dispatch`` (batch dispatch, guarded — device-lost/timeout
   kinds drive retry → DEGRADED) and ``serve.admission`` (the
-  ``overload`` kind forces the typed shed path).
+  ``overload`` kind forces the typed shed path).  A guarded site may
+  carry a *subsite* (``site@subsite`` plan entries — e.g.
+  ``serve.dispatch@stft``), so a chaos plan can poison ONE shape
+  class while its siblings stay healthy.  A plan may also be a
+  **phase schedule** — ``label=entries;label=entries;...`` — the
+  chaos-campaign form (:mod:`tools.chaos`): :func:`set_fault_plan`
+  activates the first phase, :func:`advance_phase` steps through the
+  rest (an empty body clears injection for that phase), and every
+  step is a ``fault_phase`` decision event.
+
+Two policy layers compose around :func:`guarded`: a per-request
+deadline budget (``budget_s`` — the serving layer threads each
+request's remaining end-to-end budget in, so the retry/backoff loop is
+clipped to what the caller can still use) and the per-class circuit
+breakers (:mod:`veles.simd_tpu.runtime.breaker` — the caller admits
+through the breaker and passes it in; ``guarded`` records the
+success/failure outcomes, never counting typed overloads).
 
 ``bench.py`` stage supervision and ``tools/tpu_smoke.py`` ride the
 same classifiers (per-stage retry + fault record instead of
@@ -70,7 +86,9 @@ __all__ = [
     "is_overload",
     "InjectedFault", "FaultTimeout", "make_fault", "monotonic",
     "inject", "armed", "set_fault_plan", "fault_plan", "plan_snapshot",
-    "demote_and_remember", "guarded", "register_rejection_cache",
+    "parse_phase_plan", "advance_phase", "current_phase",
+    "demote_and_remember", "guarded", "breaker_guarded",
+    "register_rejection_cache",
     "fault_retries", "fault_backoff", "fault_deadline", "backoff_delay",
     "fault_history", "reset_fault_history",
     "FAULT_PLAN_ENV", "FAULT_RETRIES_ENV", "FAULT_BACKOFF_ENV",
@@ -226,6 +244,42 @@ _plan_lock = threading.Lock()
 _plan_override: str | None = None       # set_fault_plan() programmatic
 _plan_src: str | None = None            # spec the cache was parsed from
 _plan_cache: dict | None = None         # {site: [kind, remaining]}
+_phase_list: list | None = None         # [(label, body|None), ...]
+_phase_idx: int = 0
+
+
+def _is_phased(spec: str) -> bool:
+    """Phase-schedule syntax?  Plain plans are ``site:kind:count,...``
+    and never contain ``;`` or ``=``; a phase schedule is
+    ``label=entries;label=entries;...``."""
+    return ";" in spec or "=" in spec
+
+
+def parse_phase_plan(spec: str) -> list:
+    """``label=entries;label=entries;...`` ->
+    ``[(label, entries_or_None), ...]`` — the chaos-campaign phase
+    schedule.  ``entries`` is an ordinary plan body
+    (``site:kind:count,...``, validated eagerly); an EMPTY body
+    (``recovery=``) means *no injection* during that phase — the
+    clear/recovery step of a scripted campaign.  Labels are optional
+    (``phaseN`` is minted); empty segments (a trailing ``;``) are
+    skipped."""
+    phases = []
+    for i, part in enumerate(spec.split(";")):
+        part = part.strip()
+        if not part:
+            continue
+        head, sep, rest = part.partition("=")
+        if sep:
+            label, body = head.strip(), rest.strip()
+        else:
+            label, body = "", part
+        if body:
+            _parse_plan(body)       # validate eagerly
+        phases.append((label or f"phase{i}", body or None))
+    if not phases:
+        raise ValueError(f"phase plan {spec!r} holds no phases")
+    return phases
 
 
 def _parse_plan(spec: str) -> dict:
@@ -255,7 +309,10 @@ def _parse_plan(spec: str) -> dict:
 
 def _active_plan() -> dict | None:
     """The live plan (reparsed when the env var or override changed;
-    None when no plan is set — the zero-cost steady state)."""
+    None when no plan is set — the zero-cost steady state).  An
+    env-supplied phase schedule activates its FIRST phase; stepping
+    through the rest is :func:`advance_phase` (which requires the
+    schedule to have gone through :func:`set_fault_plan`)."""
     global _plan_src, _plan_cache
     spec = _plan_override
     if spec is None:
@@ -263,31 +320,99 @@ def _active_plan() -> dict | None:
     with _plan_lock:
         if spec != _plan_src:
             _plan_src = spec
-            _plan_cache = _parse_plan(spec) if spec else None
+            body = spec
+            if spec and _is_phased(spec):
+                body = parse_phase_plan(spec)[0][1]
+            _plan_cache = _parse_plan(body) if body else None
         return _plan_cache
 
 
 def set_fault_plan(spec: str | None) -> None:
     """Programmatic plan override (None restores the env lookup).
-    Validates eagerly so a bad spec fails at the set, not mid-run."""
+    Validates eagerly so a bad spec fails at the set, not mid-run.
+    A phase schedule (``label=entries;...``) activates its first
+    phase and arms :func:`advance_phase`; any other set clears the
+    schedule."""
     global _plan_override, _plan_src, _plan_cache
+    global _phase_list, _phase_idx
+    phases = None
     if spec is not None:
-        _parse_plan(spec)
+        if _is_phased(spec):
+            phases = parse_phase_plan(spec)
+        else:
+            _parse_plan(spec)
     with _plan_lock:
-        _plan_override = spec
+        _phase_list = phases
+        _phase_idx = 0
+        if phases is not None:
+            # "" (not None) when the phase body is empty: an explicit
+            # no-injection phase must not fall through to the env plan
+            _plan_override = phases[0][1] or ""
+        else:
+            _plan_override = spec
         _plan_src = None        # force reparse on next lookup
         _plan_cache = None
+    if phases is not None:
+        obs.record_decision("fault_phase", phases[0][0], index=0,
+                            plan=phases[0][1] or "")
+
+
+def advance_phase() -> str | None:
+    """Step the active phase schedule to its next phase (the scripted
+    chaos-campaign tick).  Returns the new phase's label, or None when
+    the schedule is exhausted (injection cleared).  Each step records
+    a ``fault_phase`` decision event.  Raises RuntimeError when no
+    phase schedule is active."""
+    global _plan_override, _plan_src, _plan_cache, _phase_idx
+    with _plan_lock:
+        phases = _phase_list
+        if phases is None:
+            raise RuntimeError(
+                "no phase schedule active — set_fault_plan with "
+                "'label=entries;label=entries;...' first")
+        _phase_idx += 1
+        idx = _phase_idx
+        if idx < len(phases):
+            label, body = phases[idx]
+        else:
+            label, body = None, None
+        _plan_override = body or ""
+        _plan_src = None
+        _plan_cache = None
+    obs.record_decision("fault_phase", label or "done", index=idx,
+                        plan=body or "")
+    return label
+
+
+def current_phase() -> str | None:
+    """The active phase's label (None when no schedule is active or
+    the schedule is exhausted)."""
+    with _plan_lock:
+        if _phase_list is None or _phase_idx >= len(_phase_list):
+            return None
+        return _phase_list[_phase_idx][0]
 
 
 @contextlib.contextmanager
 def fault_plan(spec: str):
-    """Scoped :func:`set_fault_plan` — the test-suite idiom."""
-    prev = _plan_override
+    """Scoped :func:`set_fault_plan` — the test-suite idiom.  Restores
+    the previous plan AND phase schedule (if any) on exit."""
+    global _plan_override, _plan_src, _plan_cache
+    global _phase_list, _phase_idx
+    with _plan_lock:
+        prev_override = _plan_override
+        prev_phases = _phase_list
+        prev_idx = _phase_idx
     set_fault_plan(spec)
     try:
         yield
     finally:
-        set_fault_plan(prev)
+        with _plan_lock:
+            _plan_override = prev_override
+            _phase_list = prev_phases
+            _phase_idx = prev_idx
+            _plan_src = None
+            _plan_cache = None
 
 
 def armed(site: str, kind: str | None = None) -> bool:
@@ -359,8 +484,15 @@ def fault_history() -> list:
 
 
 def reset_fault_history() -> None:
+    """Clear the retained fault records AND the per-class circuit
+    breakers — the one-call engine reset every fault-injection test
+    fixture uses (a breaker opened by one scenario's exhaustions must
+    not short-circuit the next scenario's dispatches)."""
     with _history_lock:
         _FAULT_HISTORY.clear()
+    from veles.simd_tpu.runtime import breaker as _breaker
+
+    _breaker.reset()
 
 
 def _arm_flightrec(site: str, exc: BaseException) -> str | None:
@@ -523,20 +655,40 @@ def _call_with_deadline(thunk, deadline: float, site: str):
 
 def guarded(site: str, thunk, *, fallback=None, retries: int | None = None,
             backoff: float | None = None, deadline: float | None = None,
-            fallback_name: str = "oracle"):
+            fallback_name: str = "oracle", budget_s: float | None = None,
+            breaker=None, subsite: str | None = None):
     """Dispatch ``thunk()`` under the transient-fault policy.
 
     Composes *around* the ``obs.instrumented_jit``-compiled cores at
     the Python dispatch layer (inside the dispatch span, outside the
     traced program — jaxprs are untouched).  Per attempt the injection
-    plan fires first (:func:`inject` at ``site``), then the call runs
-    under the optional watchdog ``deadline``.  A transient fault
-    (:func:`is_transient`) is retried up to ``retries`` times with
-    jittered exponential ``backoff``; on exhaustion the flight
-    recorder is armed with the fault history and the call degrades to
-    ``fallback()`` (typically the op's NumPy oracle twin — correct
-    output beats no output) or re-raises when no fallback exists.
-    Non-transient exceptions propagate immediately.
+    plan fires first (:func:`inject` at ``site``, then at
+    ``site@subsite`` when a ``subsite`` — e.g. the op of a serve batch
+    — is given, so a chaos plan can poison one class of a shared
+    site), then the call runs under the optional watchdog
+    ``deadline``.  A transient fault (:func:`is_transient`) is retried
+    up to ``retries`` times with jittered exponential ``backoff``; on
+    exhaustion the flight recorder is armed with the fault history and
+    the call degrades to ``fallback()`` (typically the op's NumPy
+    oracle twin — correct output beats no output) or re-raises when no
+    fallback exists.  Non-transient exceptions propagate immediately —
+    and typed admission sheds (:func:`is_overload`) propagate before
+    ANY accounting: a shed is a policy outcome, not a fault, so it
+    must neither burn retries, nor arm the flight recorder, nor count
+    against a breaker.
+
+    ``budget_s`` clips the whole retry loop to the caller's remaining
+    end-to-end budget (the serving layer threads each request's
+    deadline in): a retry whose backoff would overrun the budget is
+    skipped and the call degrades immediately (``fault_budget_clipped``
+    counter, ``budget_clipped`` decision field) — a request can no
+    longer exceed its deadline inside the retry loop.
+
+    ``breaker`` is an optional
+    :class:`veles.simd_tpu.runtime.breaker.Breaker` the caller already
+    admitted through: ``guarded`` records the outcome (success, or
+    failure on retry exhaustion) so the breaker's sliding window sees
+    exactly the dispatches that reached the device.
 
     ``retries`` / ``backoff`` / ``deadline`` default to the env knobs
     (``VELES_SIMD_FAULT_RETRIES`` / ``_BACKOFF`` / ``_DEADLINE``).
@@ -547,38 +699,92 @@ def guarded(site: str, thunk, *, fallback=None, retries: int | None = None,
         backoff = fault_backoff()
     if deadline is None:
         deadline = fault_deadline()
+    t0 = monotonic() if budget_s is not None else 0.0
     attempt = 0
     while True:
         try:
             inject(site)
-            return _call_with_deadline(thunk, deadline, site)
+            if subsite is not None:
+                inject(f"{site}@{subsite}")
+            result = _call_with_deadline(thunk, deadline, site)
         except Exception as e:
+            if is_overload(e):
+                # typed shed: a policy outcome, not a fault — no
+                # retry, no breaker mark, no flight recorder
+                raise
             if not is_transient(e):
                 raise
             kind = _fault_kind(e)
             obs.count("fault_transient", site=site, kind=kind)
-            if attempt < retries:
+            delay = backoff_delay(attempt, backoff)
+            within_budget = (budget_s is None
+                             or monotonic() - t0 + delay <= budget_s)
+            if attempt < retries and within_budget:
                 _note_fault(site, kind, "retry", attempt + 1, e)
                 obs.count("fault_retry", site=site)
                 obs.record_decision(
                     "fault_policy", "retry", site=site, kind=kind,
                     attempt=attempt + 1, retries=retries)
-                delay = backoff_delay(attempt, backoff)
                 if delay > 0:
                     time.sleep(delay)
                 attempt += 1
                 continue
+            clipped = attempt < retries and not within_budget
+            if clipped:
+                obs.count("fault_budget_clipped", site=site)
             _note_fault(site, kind, "exhausted", attempt, e)
             obs.count("fault_exhausted", site=site, kind=kind)
+            if breaker is not None:
+                breaker.failure()
             bundle = _arm_flightrec(site, e)
             obs.record_decision(
                 "fault_policy",
                 "degrade" if fallback is not None else "exhausted",
                 site=site, kind=kind, retries=retries,
-                flight_bundle=bundle,
+                flight_bundle=bundle, budget_clipped=clipped,
                 fallback=fallback_name if fallback is not None
                 else None)
             if fallback is None:
                 raise
             obs.count("fault_degraded", site=site, to=fallback_name)
             return fallback()
+        else:
+            if breaker is not None:
+                breaker.success()
+            return result
+
+
+def breaker_guarded(site: str, key, thunk, *, fallback=None,
+                    fallback_name: str = "oracle",
+                    breaker_site: str | None = None, **kwargs):
+    """:func:`guarded` behind the ``(site, key)`` circuit breaker —
+    the standard composition for a dispatch site whose shape classes
+    can fail independently (the ``ops/`` guarded dispatchers, the
+    sharded ``parallel/`` sites; ``serve/`` hand-rolls the same steps
+    to interleave its health machine).
+
+    The class's breaker (minted at ``breaker_site`` or ``site``) is
+    admitted first: **open** answers straight from ``fallback()``
+    (``fault_breaker_short_circuit`` counter + ``short_circuit``
+    decision — zero retry latency for a known-bad class), a half-open
+    **probe** (and an open class with no fallback, e.g. a forced
+    route) dispatches with a zero-retry budget, and **closed** runs
+    the full policy.  Outcomes flow back into the breaker through
+    :func:`guarded`'s ``breaker=`` wiring.  Remaining ``kwargs``
+    (``budget_s``, ``subsite``, ``backoff``, ...) pass through."""
+    from veles.simd_tpu.runtime import breaker as _breaker
+
+    br = _breaker.breaker_for(breaker_site or site, key)
+    verdict = br.admit()
+    if verdict == _breaker.OPEN:
+        if fallback is not None:
+            obs.count("fault_breaker_short_circuit", site=site)
+            obs.record_decision(
+                "fault_policy", "short_circuit", site=site,
+                key=repr(key), fallback=fallback_name)
+            return fallback()
+        verdict = "probe"   # no fallback to shed to: zero-retry trial
+    if verdict != _breaker.CLOSED:
+        kwargs["retries"] = 0
+    return guarded(site, thunk, fallback=fallback,
+                   fallback_name=fallback_name, breaker=br, **kwargs)

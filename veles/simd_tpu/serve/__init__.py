@@ -27,16 +27,30 @@ the traffic or the hardware misbehaves:
   machine over :func:`veles.simd_tpu.runtime.faults.guarded`
   dispatch: transient device faults retry, persistent ones degrade
   the server to the NumPy oracle (parity-correct answers, flight
-  recorder armed) while zero-retry probes hunt for recovery.
+  recorder armed) while zero-retry probes hunt for recovery;
+* **end-to-end deadlines + per-class breakers** —
+  ``submit(deadline_ms=...)`` (default
+  ``VELES_SIMD_SERVE_DEADLINE_MS``) bounds a request's total time in
+  the system: expired requests shed with a typed
+  :class:`~veles.simd_tpu.serve.server.DeadlineExceeded` before
+  dispatch, and the remaining budget clips the guarded retry loop.
+  Each shape class dispatches through its own circuit breaker
+  (:mod:`veles.simd_tpu.runtime.breaker`): a persistently-failing
+  class goes straight to the oracle without burning retries while
+  sibling classes dispatch normally.
 
 Knobs (constructor args override the environment):
 ``VELES_SIMD_SERVE_MAX_BATCH``, ``VELES_SIMD_SERVE_MAX_WAIT_MS``,
-``VELES_SIMD_SERVE_QUEUE_DEPTH``, ``VELES_SIMD_SERVE_TENANT_DEPTH``.
-Chaos: ``VELES_SIMD_FAULT_PLAN`` sites ``serve.dispatch``
-(device_lost/timeout -> retry/degrade) and ``serve.admission``
-(overload -> deterministic shed).  ``tools/loadgen.py`` drives all of
-it (Poisson + burst arrivals, mixed tenants) as the chaos harness and
-the ``make bench-serve`` family.
+``VELES_SIMD_SERVE_QUEUE_DEPTH``, ``VELES_SIMD_SERVE_TENANT_DEPTH``,
+``VELES_SIMD_SERVE_DEADLINE_MS``, plus the breaker window/threshold
+knobs (``VELES_SIMD_BREAKER_*``).  Chaos: ``VELES_SIMD_FAULT_PLAN``
+sites ``serve.dispatch`` (device_lost/timeout -> retry/degrade;
+``serve.dispatch@<op>`` poisons one op's classes) and
+``serve.admission`` (overload -> deterministic shed), with
+``label=entries;...`` phase schedules for scripted campaigns.
+``tools/loadgen.py`` drives all of it (Poisson + burst arrivals,
+mixed tenants) as the traffic source; ``tools/chaos.py`` (``make
+chaos-smoke``) is the scripted chaos-campaign gate.
 """
 
 from veles.simd_tpu.serve.admission import (DEFAULT_QUEUE_DEPTH,
@@ -51,14 +65,18 @@ from veles.simd_tpu.serve.batcher import (DEFAULT_MAX_BATCH,
                                           Batcher, bucket_length)
 from veles.simd_tpu.serve.health import (DEGRADED, HEALTHY,
                                          HealthMonitor)
-from veles.simd_tpu.serve.server import (SUPPORTED_OPS, Request,
-                                         Server, ServerClosed, Ticket)
+from veles.simd_tpu.serve.server import (DEADLINE_ENV, SUPPORTED_OPS,
+                                         DeadlineExceeded, Request,
+                                         Server, ServerClosed, Ticket,
+                                         env_deadline_ms)
 
 __all__ = [
     "Server", "Request", "Ticket", "ServerClosed", "Overloaded",
-    "AdmissionController", "Batcher", "HealthMonitor",
-    "bucket_length", "SUPPORTED_OPS", "HEALTHY", "DEGRADED",
+    "DeadlineExceeded", "AdmissionController", "Batcher",
+    "HealthMonitor", "bucket_length", "env_deadline_ms",
+    "SUPPORTED_OPS", "HEALTHY", "DEGRADED",
     "MAX_BATCH_ENV", "MAX_WAIT_ENV", "QUEUE_DEPTH_ENV",
-    "TENANT_DEPTH_ENV", "DEFAULT_MAX_BATCH", "DEFAULT_MAX_WAIT_MS",
+    "TENANT_DEPTH_ENV", "DEADLINE_ENV",
+    "DEFAULT_MAX_BATCH", "DEFAULT_MAX_WAIT_MS",
     "DEFAULT_QUEUE_DEPTH", "DEFAULT_TENANT_DEPTH",
 ]

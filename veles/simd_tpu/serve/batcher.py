@@ -12,6 +12,17 @@ EITHER
 * its oldest request has waited ``max_wait`` seconds (the latency
   deadline — waiting longer costs p99).
 
+Items may additionally carry an **end-to-end request deadline**
+(``deadline`` attribute, a :func:`faults.monotonic` stamp; None = no
+deadline): an item whose deadline passed while queued is *expired
+stale work* — dispatching it would waste device time answering a
+caller who already gave up.  :meth:`next_batch` sheds expired items
+to the ``on_expired`` callback (the server answers them with a typed
+``DeadlineExceeded``) *before* forming a batch, re-evaluating bucket
+readiness afterwards — an expired head neither dispatches stale work
+nor wedges its bucket, and the condition wait wakes at the earliest
+head deadline (request or batching) so expiry is noticed promptly.
+
 ``close()`` makes every queued request immediately ready (drain), and
 :meth:`next_batch` returns None only when the batcher is closed AND
 empty — the worker-loop exit condition, so no request can be left
@@ -86,13 +97,20 @@ def bucket_length(n: int) -> int:
 class Batcher:
     """Bucketed FIFO queues + the deadline policy behind one condition.
 
-    Items are opaque to the batcher except for one attribute: ``enq``,
-    the :func:`faults.monotonic` enqueue stamp the deadline is measured
-    from (the server's pending-request record carries it).
+    Items are opaque to the batcher except for two attributes:
+    ``enq``, the :func:`faults.monotonic` enqueue stamp the batching
+    deadline is measured from, and (optionally) ``deadline``, the
+    request's absolute end-to-end deadline on the same clock (None =
+    none) — the server's pending-request record carries both.
+    ``on_expired`` receives lists of expired items as
+    :meth:`next_batch` sheds them (called with the batcher lock held;
+    it must answer tickets/release admission, never call back into
+    the batcher).
     """
 
     def __init__(self, max_batch: int | None = None,
-                 max_wait_s: float | None = None):
+                 max_wait_s: float | None = None,
+                 on_expired=None):
         env_b, env_w = env_policy()
         self.max_batch = int(max_batch) if max_batch else env_b
         self.max_wait_s = (float(max_wait_s) if max_wait_s is not None
@@ -101,10 +119,14 @@ class Batcher:
             raise ValueError("max_batch must be >= 1")
         if self.max_wait_s < 0:
             raise ValueError("max_wait_s must be >= 0")
+        self._on_expired = on_expired
         self._cond = threading.Condition()
         self._buckets: "collections.OrderedDict[object, collections.deque]" \
             = collections.OrderedDict()
         self._closed = False
+        # any queued item carrying a request deadline?  Deadline-free
+        # traffic must not pay the expiry sweep per wakeup.
+        self._deadlines_queued = 0
 
     # -- producer side -----------------------------------------------------
 
@@ -119,6 +141,8 @@ class Batcher:
             if q is None:
                 q = self._buckets[key] = collections.deque()
             q.append(item)
+            if getattr(item, "deadline", None) is not None:
+                self._deadlines_queued += 1
             self._cond.notify()
 
     def close(self) -> None:
@@ -129,6 +153,30 @@ class Batcher:
             self._cond.notify_all()
 
     # -- worker side -------------------------------------------------------
+
+    @staticmethod
+    def _expired(item, now: float) -> bool:
+        dl = getattr(item, "deadline", None)
+        return dl is not None and now >= dl
+
+    def _shed_expired(self, now: float) -> list:
+        """Pop every already-expired item (head-of-line AND mid-bucket
+        — a batch must never carry stale work) under the lock; empty
+        buckets vanish so readiness re-evaluates cleanly."""
+        expired = []
+        for key in list(self._buckets):
+            q = self._buckets[key]
+            live = collections.deque()
+            for it in q:
+                (expired if self._expired(it, now)
+                 else live).append(it)
+            if len(live) != len(q):
+                if live:
+                    self._buckets[key] = live
+                else:
+                    del self._buckets[key]
+        self._deadlines_queued -= len(expired)
+        return expired
 
     def _ready_key(self, now: float):
         """The ready bucket with the oldest head (fairness), or None.
@@ -143,11 +191,19 @@ class Batcher:
         return best
 
     def _next_deadline(self, now: float) -> float | None:
-        """Seconds until the earliest head deadline (None = no queued
-        work, wait for a put)."""
+        """Seconds until the earliest deadline — a head's batching
+        wait, or ANY queued item's request deadline (a mid-bucket
+        request can expire before every head's wait, and its typed
+        answer must not stall until the next put); None = no queued
+        work, wait for a put."""
         soonest = None
         for q in self._buckets.values():
             remaining = q[0].enq + self.max_wait_s - now
+            if self._deadlines_queued:
+                for it in q:
+                    dl = getattr(it, "deadline", None)
+                    if dl is not None:
+                        remaining = min(remaining, dl - now)
             if soonest is None or remaining < soonest:
                 soonest = remaining
         return soonest
@@ -155,10 +211,15 @@ class Batcher:
     def next_batch(self):
         """Block until one shape class is ready; returns ``(key,
         [items...])`` (FIFO within the class, at most ``max_batch``),
-        or None when closed and fully drained."""
+        or None when closed and fully drained.  Expired items are shed
+        to ``on_expired`` first — never returned in a batch."""
         with self._cond:
             while True:
                 now = faults.monotonic()
+                if self._deadlines_queued:
+                    expired = self._shed_expired(now)
+                    if expired and self._on_expired is not None:
+                        self._on_expired(expired)
                 key = self._ready_key(now)
                 if key is not None:
                     q = self._buckets[key]
@@ -166,6 +227,9 @@ class Batcher:
                     batch = [q.popleft() for _ in range(take)]
                     if not q:
                         del self._buckets[key]
+                    self._deadlines_queued -= sum(
+                        1 for it in batch
+                        if getattr(it, "deadline", None) is not None)
                     return key, batch
                 if self._closed and not self._buckets:
                     return None

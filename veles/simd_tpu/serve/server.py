@@ -13,6 +13,23 @@ already has, plus the one loop none of them provided:
 * **deadline batching** — :class:`~veles.simd_tpu.serve.batcher.
   Batcher` dispatches a bucket when it is full (``max_batch``) or its
   oldest request has waited ``max_wait`` (whichever fires first);
+* **end-to-end request deadlines** — ``submit(deadline_ms=...)``
+  stamps an absolute monotonic deadline at admission (default from
+  ``VELES_SIMD_SERVE_DEADLINE_MS``; 0/unset = none); a request whose
+  deadline passes while queued is shed *before* dispatch with a typed
+  :class:`DeadlineExceeded` (``status="expired"`` — stale work never
+  reaches the device), and a dispatched batch's remaining budget
+  flows into :func:`faults.guarded` so the transient-retry loop is
+  clipped to what the requests can still use.  Misses are
+  ``serve_deadline_miss`` counters; the pre-dispatch slack lands in
+  the ``serve.deadline_slack`` histogram;
+* **per-class circuit breakers** — every shape class dispatches
+  through its own :class:`veles.simd_tpu.runtime.breaker.Breaker`
+  (key: the batch's shape-class triple).  A class that keeps
+  exhausting its retries opens its breaker and goes *straight* to the
+  oracle (no retry ladder, no global health trip) while sibling
+  classes dispatch normally; half-open probes re-close it when the
+  class recovers;
 * **admission control + backpressure** — :class:`~veles.simd_tpu.
   serve.admission.AdmissionController` bounds global and per-tenant
   queue depth; over-limit submits get a typed
@@ -51,6 +68,7 @@ oracle's (parity-tested, flagged ``degraded`` on the ticket).
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 
 import numpy as np
@@ -60,6 +78,7 @@ from veles.simd_tpu.ops import batched
 from veles.simd_tpu.ops import iir as _iir
 from veles.simd_tpu.ops import resample as _rs
 from veles.simd_tpu.ops import spectral as _sp
+from veles.simd_tpu.runtime import breaker as _breaker
 from veles.simd_tpu.runtime import faults
 from veles.simd_tpu.serve.admission import (AdmissionController,
                                             Overloaded)
@@ -68,11 +87,28 @@ from veles.simd_tpu.serve.health import (DEFAULT_PROBE_EVERY,
                                          HealthMonitor)
 
 __all__ = ["Request", "Ticket", "Server", "ServerClosed",
-           "SUPPORTED_OPS", "DEFAULT_WORKERS"]
+           "DeadlineExceeded", "SUPPORTED_OPS", "DEFAULT_WORKERS",
+           "DEADLINE_ENV", "env_deadline_ms"]
 
 # two workers overlap one batch's host-side padding/slicing with the
 # previous batch's device wait without oversubscribing dispatch
 DEFAULT_WORKERS = 2
+
+DEADLINE_ENV = "VELES_SIMD_SERVE_DEADLINE_MS"
+
+
+def env_deadline_ms() -> float | None:
+    """The default end-to-end request deadline in milliseconds
+    (``$VELES_SIMD_SERVE_DEADLINE_MS``; unset/0/negative = no
+    deadline)."""
+    raw = os.environ.get(DEADLINE_ENV, "").strip()
+    if not raw:
+        return None
+    try:
+        value = float(raw)
+    except ValueError:
+        return None
+    return value if value > 0 else None
 
 
 class ServerClosed(RuntimeError):
@@ -80,15 +116,27 @@ class ServerClosed(RuntimeError):
     submit raced :meth:`Server.stop`)."""
 
 
+class DeadlineExceeded(RuntimeError):
+    """Typed answer for a request whose end-to-end deadline passed
+    while it was queued (``status="expired"``): the work was shed
+    BEFORE dispatch — a caller who already gave up must not cost
+    device time.  Never raised for dispatched requests: once a batch
+    is in flight its remaining budget clips the retry loop instead
+    (:func:`veles.simd_tpu.runtime.faults.guarded` ``budget_s``)."""
+
+
 @dataclasses.dataclass
 class Request:
     """One unit of traffic: op name + 1-D float signal + op params +
-    tenant id (the admission-control identity)."""
+    tenant id (the admission-control identity) + optional end-to-end
+    deadline in milliseconds (None = the ``VELES_SIMD_SERVE_DEADLINE_MS``
+    default; the deadline is stamped absolute at admission)."""
 
     op: str
     x: object
     params: dict = dataclasses.field(default_factory=dict)
     tenant: str = "default"
+    deadline_ms: float | None = None
 
 
 class Ticket:
@@ -97,8 +145,10 @@ class Ticket:
     Completed exactly once by the server (a second completion attempt
     raises and bumps ``serve_double_answer`` — the concurrency suite's
     invariant).  ``status`` is one of ``pending`` / ``ok`` /
-    ``degraded`` (oracle-served while DEGRADED) / ``shed`` (typed
-    :class:`Overloaded`) / ``closed`` / ``error``.
+    ``degraded`` (oracle-served while DEGRADED or behind an open
+    breaker) / ``shed`` (typed :class:`Overloaded`) / ``expired``
+    (typed :class:`DeadlineExceeded` — the end-to-end deadline passed
+    before dispatch) / ``closed`` / ``error``.
     """
 
     __slots__ = ("op", "tenant", "status", "wait_s", "_event",
@@ -152,17 +202,20 @@ class Ticket:
 
 class _Pending:
     """One queued request inside the server (batcher item: ``enq`` is
-    the deadline stamp; ``released`` guards the admission slot against
-    double release when a batch fails midway)."""
+    the batching-deadline stamp, ``deadline`` the absolute end-to-end
+    request deadline or None; ``released`` guards the admission slot
+    against double release when a batch fails midway)."""
 
-    __slots__ = ("ticket", "x", "n", "params", "enq", "released")
+    __slots__ = ("ticket", "x", "n", "params", "enq", "deadline",
+                 "released")
 
-    def __init__(self, ticket, x, n, params, enq):
+    def __init__(self, ticket, x, n, params, enq, deadline=None):
         self.ticket = ticket
         self.x = x
         self.n = n
         self.params = params
         self.enq = enq
+        self.deadline = deadline
         self.released = False
 
 
@@ -279,7 +332,8 @@ class Server:
                  donate: bool = False):
         max_wait_s = (None if max_wait_ms is None
                       else float(max_wait_ms) / 1e3)
-        self._batcher = Batcher(max_batch, max_wait_s)
+        self._batcher = Batcher(max_batch, max_wait_s,
+                                on_expired=self._expire_items)
         self._admission = AdmissionController(queue_depth,
                                               tenant_depth)
         self._health = HealthMonitor(probe_every)
@@ -291,6 +345,7 @@ class Server:
         self._stats_lock = threading.Lock()
         self._stats = {"submitted": 0, "completed": 0, "shed": 0,
                        "degraded_answers": 0, "errors": 0,
+                       "expired": 0, "breaker_shed": 0,
                        "batches": 0, "batched_requests": 0}
         self._started = False
         self._stopped = False
@@ -340,17 +395,25 @@ class Server:
     def submit(self, request: Request | None = None, *,
                op: str | None = None, x=None, params: dict | None = None,
                tenant: str = "default", block: bool = False,
-               timeout: float | None = None) -> Ticket:
+               timeout: float | None = None,
+               deadline_ms: float | None = None) -> Ticket:
         """Queue one request; returns its :class:`Ticket`.
 
         Admission rejections complete the ticket immediately with a
         typed :class:`Overloaded` (``status="shed"``) — pass
         ``block=True`` (+ ``timeout``) for backpressure instead of
-        shedding.  Malformed requests raise ValueError synchronously
-        (a caller bug, not traffic)."""
+        shedding.  ``deadline_ms`` (or ``request.deadline_ms``, or the
+        ``VELES_SIMD_SERVE_DEADLINE_MS`` default) stamps an absolute
+        end-to-end deadline at admission: the request is answered
+        within it or shed with a typed :class:`DeadlineExceeded`
+        before dispatch.  Malformed requests raise ValueError
+        synchronously (a caller bug, not traffic)."""
         if request is None:
             request = Request(op=op, x=x, params=params or {},
-                              tenant=tenant)
+                              tenant=tenant, deadline_ms=deadline_ms)
+        elif deadline_ms is not None:
+            request = dataclasses.replace(request,
+                                          deadline_ms=deadline_ms)
         if request.op not in _OPS:
             raise ValueError(
                 f"unsupported op {request.op!r} "
@@ -374,8 +437,14 @@ class Server:
                 self._stats["shed"] += 1
             ticket._complete(error=e, status="shed")
             return ticket
-        pend = _Pending(ticket, xarr, n, cparams,
-                        faults.monotonic())
+        now = faults.monotonic()
+        dl_ms = request.deadline_ms
+        if dl_ms is None:
+            dl_ms = env_deadline_ms()
+        deadline = (now + float(dl_ms) / 1e3
+                    if dl_ms is not None and dl_ms > 0 else None)
+        pend = _Pending(ticket, xarr, n, cparams, now,
+                        deadline=deadline)
         key = (request.op, param_key, bucket_length(n))
         try:
             self._batcher.put(key, pend)
@@ -425,6 +494,28 @@ class Server:
             pend.released = True
             self._admission.release(pend.ticket.tenant)
 
+    def _expire_items(self, items) -> None:
+        """Answer expired requests with a typed
+        :class:`DeadlineExceeded` (the batcher's ``on_expired`` path
+        and the pre-dispatch sweep) — stale work never dispatches."""
+        now = faults.monotonic()
+        for p in items:
+            if p.ticket.done():
+                continue
+            late_ms = (now - p.deadline) * 1e3 \
+                if p.deadline is not None else 0.0
+            p.ticket._complete(
+                error=DeadlineExceeded(
+                    f"DEADLINE_EXCEEDED: request {p.ticket.op!r} "
+                    f"missed its end-to-end deadline by "
+                    f"{late_ms:.1f} ms before dispatch"),
+                status="expired")
+            self._release(p)
+            obs.count("serve_deadline_miss", op=p.ticket.op,
+                      tenant=p.ticket.tenant)
+            with self._stats_lock:
+                self._stats["expired"] += 1
+
     def _run_batch(self, key, batch) -> None:
         op, _, nb = key
         if self._abandoned:
@@ -435,6 +526,26 @@ class Server:
                     status="closed")
                 self._release(p)
             return
+        # last line of defense against stale work: anything that
+        # expired between the batcher's shed sweep and here is
+        # answered typed, never dispatched — and the survivors'
+        # remaining budget clips the guarded retry loop below
+        now = faults.monotonic()
+        expired = [p for p in batch
+                   if p.deadline is not None and now >= p.deadline]
+        if expired:
+            self._expire_items(expired)
+            batch = [p for p in batch
+                     if p.deadline is None or now < p.deadline]
+            if not batch:
+                return
+        budget_s = None
+        for p in batch:
+            if p.deadline is not None:
+                slack = p.deadline - now
+                obs.observe("serve.deadline_slack", slack, op=op)
+                if budget_s is None or slack < budget_s:
+                    budget_s = slack
         rows = len(batch)
         # row-pad to the power-of-two class so occupancy churn shares
         # compiled handles instead of minting one per batch size
@@ -444,7 +555,8 @@ class Server:
             xs[i, :p.n] = p.x
         params = batch[0].params
         with obs.span("serve.dispatch", op=op, rows=rpad, n=nb):
-            ys, degraded = self._dispatch(op, xs, params)
+            ys, degraded = self._dispatch(op, key, xs, params,
+                                          budget_s)
         ys = np.asarray(ys)
         now = faults.monotonic()
         _, slicer = _OPS[op]
@@ -471,15 +583,36 @@ class Server:
             self._stats["batches"] += 1
             self._stats["batched_requests"] += rows
 
-    def _dispatch(self, op: str, xs, params: dict) -> tuple:
-        """One batch through the health machine + fault policy;
-        returns ``(outputs, degraded)``."""
+    def _dispatch(self, op: str, key, xs, params: dict,
+                  budget_s: float | None = None) -> tuple:
+        """One batch through the health machine + the shape class's
+        circuit breaker + the fault policy; returns ``(outputs,
+        degraded)``.
+
+        The breaker (keyed by the batch's shape class) composes
+        *under* the health machine: an open breaker answers ITS class
+        via the oracle without touching global health — one poisoned
+        class must not drag healthy siblings onto the oracle — and
+        only a fresh failure on a closed breaker trips the global
+        DEGRADED mode.  Breaker probe failures reopen the breaker
+        silently (the class was already known-bad)."""
         probe = False
         if self._health.degraded:
             probe = self._health.note_degraded_batch()
             if not probe:
                 obs.count("serve_degraded_batch", op=op)
                 return _oracle_call(op, xs, params), True
+        br = _breaker.breaker_for("serve.dispatch", key)
+        # a health-machine probe batch outranks the breaker's
+        # short-circuit (a one-class server would otherwise stay
+        # DEGRADED until the breaker's own cadence probed)
+        verdict = br.admit(force_probe=probe)
+        if verdict == _breaker.OPEN:
+            obs.count("serve_breaker_shed", op=op)
+            obs.count("serve_degraded_batch", op=op)
+            with self._stats_lock:
+                self._stats["breaker_shed"] += 1
+            return _oracle_call(op, xs, params), True
         box = {"tripped": False}
         donate = self.donate
 
@@ -488,13 +621,17 @@ class Server:
 
         def fallback():
             box["tripped"] = True
-            self._health.trip("serve.dispatch")
+            if verdict == _breaker.CLOSED:
+                self._health.trip("serve.dispatch")
             obs.count("serve_degraded_batch", op=op)
             return _oracle_call(op, xs, params)
 
+        zero_retry = probe or verdict != _breaker.CLOSED
         ys = faults.guarded("serve.dispatch", thunk,
                             fallback=fallback, fallback_name="oracle",
-                            retries=(0 if probe else None))
+                            retries=(0 if zero_retry else None),
+                            budget_s=budget_s, breaker=br,
+                            subsite=op)
         if not box["tripped"] and probe:
             self._health.recover("serve.dispatch")
         return ys, box["tripped"]
@@ -508,8 +645,9 @@ class Server:
 
     def stats(self) -> dict:
         """JSON-native snapshot: request tallies, admission depths,
-        batcher state, health machine, and (telemetry on) the
-        steady-state p50/p95/p99 of the ``serve.dispatch`` span."""
+        batcher state, health machine, the per-shape-class circuit
+        breakers, and (telemetry on) the steady-state p50/p95/p99 of
+        the ``serve.dispatch`` span."""
         with self._stats_lock:
             counts = dict(self._stats)
         return {
@@ -517,6 +655,8 @@ class Server:
             "admission": self._admission.snapshot(),
             "batcher": self._batcher.snapshot(),
             "health": self._health.snapshot(),
+            "breakers": [b for b in _breaker.snapshot()
+                         if b["site"] == "serve.dispatch"],
             "dispatch_quantiles": obs.quantiles(
                 "span.serve.dispatch", phase="steady"),
         }
