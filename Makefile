@@ -50,14 +50,17 @@ bench-serve:
 	$(PYTHON) tools/loadgen.py --details SERVE_DETAILS.json
 
 # seconds-long CPU sanity run of the serving layer (accounting +
-# oracle parity gate); the chaos variant arms VELES_SIMD_FAULT_PLAN
+# oracle parity gate, including pipeline-invocation streams with
+# state threading); the chaos variant arms VELES_SIMD_FAULT_PLAN
 serve-smoke:
 	VELES_SIMD_PLATFORM=cpu $(PYTHON) tools/loadgen.py --smoke
 
-# the scripted chaos campaign on CPU: overload -> mid-campaign device
-# loss (one poisoned serve class + the sharded mesh) -> recovery,
-# gating on zero lost / zero double-answered requests, typed errors
-# only, bounded deadline misses, breaker open->half-open->closed, and
+# the scripted chaos campaign on CPU: overload -> poisoned served
+# PIPELINE class (its breaker opens while plain ops stay ok) ->
+# mid-campaign device loss (one poisoned serve class + the sharded
+# mesh) -> recovery, gating on zero lost / zero double-answered
+# requests, typed errors only, bounded deadline misses, breaker
+# open->half-open->closed (serve, pipeline, and mesh breakers), and
 # mesh_degrade + recovery (tools/chaos.py; CHAOS_DETAILS.json rows
 # gate via `python tools/bench_regress.py --details CHAOS_DETAILS.json`)
 chaos-smoke:

@@ -101,7 +101,8 @@ def test_main_records_skips_in_json_tail(monkeypatch, tmp_path, capsys):
                         lambda rng: quick(rng, "sgemm"))
     for name in ("bench_stft", "bench_istft_roundtrip",
                  "bench_spectrogram", "bench_batched_stft",
-                 "bench_serve", "bench_autotuned_headline"):
+                 "bench_serve", "bench_pipeline",
+                 "bench_pipeline_p99", "bench_autotuned_headline"):
         monkeypatch.setattr(bench, name,
                             lambda rng, name=name: quick(rng, name))
 
@@ -138,7 +139,9 @@ def test_main_records_skips_in_json_tail(monkeypatch, tmp_path, capsys):
     assert metrics == ["elementwise", "mathfun", "sgemm",
                        "bench_stft", "bench_istft_roundtrip",
                        "bench_spectrogram", "bench_batched_stft",
-                       "bench_serve", "bench_autotuned_headline"]
+                       "bench_serve", "bench_pipeline",
+                       "bench_pipeline_p99",
+                       "bench_autotuned_headline"]
     tail = details[-1]
     assert "skipped_stages" in tail
     stages = [s["stage"] for s in tail["skipped_stages"]]
@@ -168,7 +171,8 @@ def _run_main_with_headline(monkeypatch, tmp_path, vs_baseline):
     for name in ("bench_elementwise", "bench_mathfun", "bench_sgemm",
                  "bench_dwt", "bench_stft", "bench_istft_roundtrip",
                  "bench_spectrogram", "bench_batched_stft",
-                 "bench_serve", "bench_autotuned_headline"):
+                 "bench_serve", "bench_pipeline",
+                 "bench_pipeline_p99", "bench_autotuned_headline"):
         def mk(name):
             def cfg(rng):
                 return {"metric": name, "unit": "u", "value": 2.0,

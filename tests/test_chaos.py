@@ -67,6 +67,10 @@ def test_every_invariant_holds(campaign):
                 "zero_untyped_errors", "deadline_misses_bounded",
                 "breaker_cycle", "zero_retry_steady_state",
                 "mesh_degrade_observed",
+                "pipeline_breaker_cycle",
+                "pipeline_breaker_closed_at_end",
+                "pipeline_degraded_then_served",
+                "plain_ok_during_pipeline_poison",
                 "health_degraded_then_healthy"):
         assert key in tail["chaos_invariants"]
 
@@ -101,8 +105,13 @@ def test_evidence_tail_carries_the_story(campaign):
     assert all(e["mesh"] for e in tail["mesh_degrade_events"])
     assert {"degrade", "recover"} <= {
         e["decision"] for e in tail["serve_health_events"]}
-    assert tail["fault_phases"][:4] == ["baseline", "overload",
+    assert tail["fault_phases"][:5] == ["baseline", "overload",
+                                        "pipeline_poison",
                                         "mesh_loss", "recovery"]
+    # the poisoned pipeline class's breaker cycled too
+    assert {"open", "half_open", "closed"} <= set(
+        tail["pipeline_breaker_transitions"])
+    assert tail["plain_degraded_during_pipeline_poison"] == 0
     assert any("veles_simd_breaker_" in line
                for line in tail["prometheus_breaker_lines"])
     assert tail["retry_attempts_steady_state"] == 0

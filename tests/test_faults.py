@@ -608,7 +608,9 @@ class TestBenchStageRetry:
         for name in ("bench_mathfun", "bench_sgemm", "bench_dwt",
                      "bench_stft", "bench_istft_roundtrip",
                      "bench_spectrogram", "bench_batched_stft",
-                     "bench_serve", "bench_autotuned_headline"):
+                     "bench_serve", "bench_pipeline",
+                     "bench_pipeline_p99",
+                     "bench_autotuned_headline"):
             def mk(name):
                 def cfg(rng):
                     return {"metric": name, "unit": "u", "value": 2.0,

@@ -781,3 +781,179 @@ def sharded_thing(x, mesh, axis="sp"):
 
 def test_parallel_guard_rule_accepts_breaker_guarded():
     assert _parallel_errs(PGUARD_BREAKER_GUARDED) == []
+
+
+# --- the pipeline rule (stage routing + guarded compiled step) -------------
+
+def _pipe_route_errs(src):
+    return lint.pipeline_route_errors(ast.parse(src), "<mem>")
+
+
+def _pipe_guard_errs(src):
+    return lint.pipeline_guard_errors(ast.parse(src), "<mem>")
+
+
+PIPE_ROUTE_GOOD_HOOK = '''
+from veles.simd_tpu.ops import convolve as _cv
+from veles.simd_tpu.runtime import routing
+
+
+class _FirStage:
+    def resolve(self, tune_stamp):
+        self.route = _cv.select_stream_route(
+            1024, 33, tune_geom=tune_stamp({"h_length": 33}))
+        return self.route
+'''
+
+PIPE_ROUTE_GOOD_ENGINE = '''
+from veles.simd_tpu.runtime import routing
+
+
+class _Stage:
+    def resolve(self, tune_stamp):
+        fam = routing.get_family("stft")
+        self.route = fam.select(frame_length=256, hop=64, frames=8)
+        return self.route
+'''
+
+PIPE_ROUTE_TRIVIAL = '''
+class _Stage:
+    def resolve(self, tune_stamp):
+        return None
+'''
+
+PIPE_ROUTE_HAND_ROLLED = '''
+class _Stage:
+    def resolve(self, tune_stamp):
+        # a hand-written ladder: no family table consulted
+        self.route = "fast" if self.k <= 2047 else "slow"
+        return self.route
+'''
+
+PIPE_ROUTE_DECOY_MODULE = '''
+import math as _cv
+
+
+class _Stage:
+    def resolve(self, tune_stamp):
+        # select_-named attr on a NON-ops module must not satisfy
+        self.route = _cv.select_stream_route(1024, 33)
+        return self.route
+'''
+
+
+def test_pipeline_route_rule_accepts_ops_hook():
+    assert _pipe_route_errs(PIPE_ROUTE_GOOD_HOOK) == []
+
+
+def test_pipeline_route_rule_accepts_engine_direct():
+    assert _pipe_route_errs(PIPE_ROUTE_GOOD_ENGINE) == []
+
+
+def test_pipeline_route_rule_skips_trivial_resolve():
+    assert _pipe_route_errs(PIPE_ROUTE_TRIVIAL) == []
+
+
+def test_pipeline_route_rule_flags_hand_rolled_ladder():
+    errs = _pipe_route_errs(PIPE_ROUTE_HAND_ROLLED)
+    assert any("routing.family" in e for e in errs)
+
+
+def test_pipeline_route_rule_flags_non_ops_decoy():
+    errs = _pipe_route_errs(PIPE_ROUTE_DECOY_MODULE)
+    assert any("routing.family" in e for e in errs)
+
+
+PIPE_GUARD_GOOD = '''
+from veles.simd_tpu import obs
+from veles.simd_tpu.runtime import faults
+
+
+class Compiled:
+    def __init__(self, fn):
+        self._step = obs.instrumented_jit(fn, op="pipeline")
+
+    def _run_fused(self, block, state):
+        return self._step(block, state)
+
+    def process(self, block, state):
+        return faults.breaker_guarded(
+            "pipeline.dispatch", ("p", 512),
+            lambda: self._run_fused(block, state),
+            fallback=lambda: (block, state))
+'''
+
+PIPE_GUARD_BARE = '''
+from veles.simd_tpu import obs
+
+
+class Compiled:
+    def __init__(self, fn):
+        self._step = obs.instrumented_jit(fn, op="pipeline")
+
+    def process(self, block, state):
+        return self._step(block, state)
+'''
+
+PIPE_GUARD_UNREFERENCED_METHOD = '''
+from veles.simd_tpu import obs
+from veles.simd_tpu.runtime import faults
+
+
+class Compiled:
+    def __init__(self, fn):
+        self._step = obs.instrumented_jit(fn, op="pipeline")
+
+    def _run_fused(self, block, state):
+        return self._step(block, state)
+
+    def process(self, block, state):
+        # the guard never references _run_fused: the step dispatch
+        # inside it is unguarded
+        return faults.breaker_guarded(
+            "pipeline.dispatch", ("p", 512),
+            lambda: (block, state),
+            fallback=lambda: (block, state))
+
+    def sneak(self, block, state):
+        return self._run_fused(block, state)
+'''
+
+PIPE_GUARD_ALIAS_DODGE = '''
+from veles.simd_tpu import obs as telemetry
+
+
+class Compiled:
+    def __init__(self, fn):
+        self.step = telemetry.instrumented_jit(fn, op="pipeline")
+
+    def process(self, block, state):
+        return self.step(block, state)
+'''
+
+
+def test_pipeline_guard_rule_passes_guarded_step():
+    assert _pipe_guard_errs(PIPE_GUARD_GOOD) == []
+
+
+def test_pipeline_guard_rule_flags_bare_step():
+    errs = _pipe_guard_errs(PIPE_GUARD_BARE)
+    assert any("breaker_guarded" in e for e in errs)
+
+
+def test_pipeline_guard_rule_flags_unreferenced_method():
+    errs = _pipe_guard_errs(PIPE_GUARD_UNREFERENCED_METHOD)
+    assert any("breaker_guarded" in e for e in errs)
+
+
+def test_pipeline_guard_rule_tracks_obs_alias():
+    errs = _pipe_guard_errs(PIPE_GUARD_ALIAS_DODGE)
+    assert any("breaker_guarded" in e for e in errs)
+
+
+def test_real_pipeline_modules_pass_pipeline_rules():
+    pkg = REPO / "veles" / "simd_tpu" / "pipeline"
+    for f in sorted(pkg.glob("*.py")):
+        tree = ast.parse(f.read_text(), str(f))
+        assert lint.pipeline_route_errors(tree, str(f)) == []
+        assert lint.pipeline_guard_errors(tree, str(f)) == []

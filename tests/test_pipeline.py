@@ -1,0 +1,552 @@
+"""The pipeline compiler (``veles/simd_tpu/pipeline/``).
+
+The three contracts the tentpole makes:
+
+* **streaming-state correctness** — block-streamed output of the
+  fused step matches the ONE-SHOT whole-signal oracle bit-for-block
+  across block sizes, including boundaries straddling IIR ``zi``
+  state, the FIR/overlap-save halo, STFT frame overlap, and resampler
+  history — and across a mid-stream injected fault at
+  ``pipeline.dispatch`` (the degraded block comes from the
+  stage-by-stage oracle twin with exact state threading);
+* **one dispatch per block** — the fused step is ONE
+  ``obs.instrumented_jit`` program: exactly one compiled executable,
+  one ``pipeline.dispatch`` span per block, one ``(op="pipeline")``
+  resource entry, and NO per-stage op entries during steady-state
+  streaming;
+* **pipelines serve as first-class units** — registered pipelines
+  batch through the deadline batcher with per-pipeline-class breakers
+  (a poisoned pipeline class degrades while plain-op traffic stays
+  "ok"), and state threads exactly through served invocations.
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+from veles.simd_tpu import obs, pipeline as pl, serve  # noqa: E402
+from veles.simd_tpu.ops import detect_peaks as dp  # noqa: E402
+from veles.simd_tpu.ops import iir  # noqa: E402
+from veles.simd_tpu.ops import resample as rs  # noqa: E402
+from veles.simd_tpu.runtime import breaker, faults  # noqa: E402
+
+RNG = np.random.RandomState(11)
+SOS = iir.butterworth(4, 0.25, "lowpass")
+
+
+@pytest.fixture
+def telemetry(monkeypatch):
+    monkeypatch.setenv("VELES_SIMD_FAULT_BACKOFF", "0")
+    obs.enable(compile_listeners=False)
+    obs.reset()
+    faults.reset_fault_history()
+    yield
+    obs.disable()
+    obs.reset()
+    faults.reset_fault_history()
+    faults.set_fault_plan(None)
+
+
+@pytest.fixture(autouse=True)
+def _clean_breakers():
+    faults.reset_fault_history()
+    yield
+    faults.reset_fault_history()
+    faults.set_fault_plan(None)
+
+
+def _rel(got, want):
+    got = np.asarray(got, np.complex128)
+    want = np.asarray(want, np.complex128)
+    scale = float(np.max(np.abs(want))) or 1.0
+    return float(np.max(np.abs(got - want)) / scale)
+
+
+def _sensor_chain(name="sensor"):
+    """The acceptance chain: resampler history + IIR zi + STFT
+    overlap all carried (every boundary regime the satellite names)."""
+    return pl.Pipeline(
+        [pl.resample_poly(2, 1), pl.sosfilt(SOS), pl.stft(256, 64),
+         pl.power()], name=name)
+
+
+def _fir_chain(h_len=1031, name="firline"):
+    h = np.random.RandomState(3).randn(h_len).astype(np.float32)
+    return pl.Pipeline([pl.fir(h)], name=name)
+
+
+# ---------------------------------------------------------------------------
+# chain declaration / compile-time validation
+# ---------------------------------------------------------------------------
+
+class TestDeclaration:
+    def test_empty_chain_rejected(self):
+        with pytest.raises(ValueError, match="at least one stage"):
+            pl.Pipeline([])
+
+    def test_terminal_stage_must_be_last(self):
+        with pytest.raises(ValueError, match="terminal"):
+            pl.Pipeline([pl.detect_peaks(max_peaks=4),
+                         pl.sosfilt(SOS)])
+
+    def test_duplicate_stage_names_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            pl.Pipeline([pl.sosfilt(SOS), pl.sosfilt(SOS)])
+
+    def test_block_must_divide_resample_rate(self):
+        with pytest.raises(ValueError, match="divisible"):
+            pl.Pipeline([pl.resample_poly(3, 2)]).compile(511)
+
+    def test_stft_block_must_align_to_hop(self):
+        with pytest.raises(ValueError, match="hop"):
+            pl.Pipeline([pl.stft(256, 64)]).compile(100)
+
+    def test_savgol_rejected_in_samples_mode(self):
+        with pytest.raises(ValueError, match="per-row"):
+            pl.Pipeline([pl.savgol(7, 2)]).compile(512)
+
+    def test_stream_stage_cannot_follow_rows(self):
+        with pytest.raises(ValueError, match="samples"):
+            pl.Pipeline([pl.welch(nperseg=128),
+                         pl.sosfilt(SOS)]).compile(512)
+
+    def test_wrong_block_length_rejected_at_process(self):
+        cp = pl.Pipeline([pl.sosfilt(SOS)]).compile(256)
+        with pytest.raises(ValueError, match="block length"):
+            cp.process(np.zeros(128, np.float32))
+
+    def test_recompiling_pipeline_never_corrupts_earlier(self):
+        # compile() takes private stage copies: a second compile at
+        # another block size must not rewrite the first's geometry
+        chain = pl.Pipeline([pl.resample_poly(2, 4)],
+                            name="recompile")
+        cp1 = chain.compile(128, name="rc128")
+        x = RNG.randn(256).astype(np.float32)
+        want = cp1.oracle(x)
+        cp2 = chain.compile(64, name="rc64")
+        got, _ = cp1.stream(x)
+        assert cp1.block_len == 128 and cp2.block_len == 64
+        assert got.shape == want.shape == (128,)
+        assert _rel(got, want) <= 1e-5
+
+    def test_describe_and_routes(self):
+        cp = _sensor_chain().compile(512)
+        d = cp.describe()
+        assert d["block_len"] == 512
+        assert [s["stage"] for s in d["stages"]] == [
+            "resample_poly", "sosfilt", "stft", "power"]
+        assert cp.routes()["stft"] in ("rdft_matmul", "xla_fft")
+        assert "pipeline_compile" in {
+            e["op"] for e in obs.events()} or not obs.enabled()
+
+
+# ---------------------------------------------------------------------------
+# streaming-state correctness: streamed == one-shot oracle
+# ---------------------------------------------------------------------------
+
+class TestStreamingParity:
+    # 320 straddles nothing evenly; 512/1024 exercise pow2 blocks —
+    # ≥3 block sizes per the acceptance criterion
+    SENSOR_BLOCKS = (320, 512, 1024)
+
+    @pytest.mark.parametrize("block", SENSOR_BLOCKS)
+    def test_sensor_chain_matches_oracle(self, block):
+        cp = _sensor_chain().compile(block)
+        x = RNG.randn(5 * block).astype(np.float32)
+        got, _ = cp.stream(x)
+        want = cp.oracle(x)
+        assert got.shape == np.asarray(want).shape
+        assert _rel(got, want) <= 1e-5
+
+    @pytest.mark.parametrize("block", (256, 512))
+    def test_fir_halo_straddles_blocks(self, block):
+        # h - 1 = 1030 halo > one whole 256-block: the hardest
+        # carry regime (state longer than the block)
+        cp = _fir_chain().compile(block)
+        x = RNG.randn(6 * block).astype(np.float32)
+        got, _ = cp.stream(x)
+        assert _rel(got, cp.oracle(x)) <= 1e-5
+
+    def test_fir_matches_causal_convolution(self):
+        h = np.random.RandomState(4).randn(63).astype(np.float32)
+        cp = pl.Pipeline([pl.fir(h)], name="fircheck").compile(128)
+        x = RNG.randn(1024).astype(np.float32)
+        got, _ = cp.stream(x)
+        from veles.simd_tpu.ops import convolve as cv
+
+        want = np.asarray(cv.convolve_na(
+            x.astype(np.float64), h.astype(np.float64)))[:1024]
+        assert _rel(got, want) <= 1e-5
+
+    @pytest.mark.parametrize("up,down", [(2, 1), (1, 2), (3, 2),
+                                         (160, 147)])
+    def test_resample_streaming_grid(self, up, down):
+        block = 147 * 4 if down == 147 else 588
+        cp = pl.Pipeline([pl.resample_poly(up, down)],
+                         name=f"rs{up}_{down}").compile(block)
+        x = RNG.randn(4 * block).astype(np.float32)
+        got, _ = cp.stream(x)
+        want = cp.oracle(x)
+        assert got.shape == want.shape == (4 * block * up // down,)
+        assert _rel(got, want) <= 1e-5
+
+    def test_resample_preroll_aligns_with_one_shot(self):
+        cp = pl.Pipeline([pl.resample_poly(2, 1)],
+                         name="rsalign").compile(512)
+        x = RNG.randn(4096).astype(np.float32)
+        got, _ = cp.stream(x)
+        lat = cp.describe()["stages"][0]["latency"]
+        one = np.asarray(rs.resample_poly_na(
+            x.astype(np.float64), 2, 1))
+        assert lat > 0
+        assert _rel(got[lat:], one[:len(got) - lat]) <= 1e-5
+
+    def test_sosfilt_zi_straddles_blocks(self):
+        cp = pl.Pipeline([pl.sosfilt(SOS)], name="sosline")
+        x = RNG.randn(2048).astype(np.float32)
+        outs = [cp.compile(b, name=f"sos{b}").stream(x)[0]
+                for b in (128, 256, 2048)]
+        want = np.asarray(iir.sosfilt_na(SOS, x.astype(np.float64)))
+        for got in outs:
+            assert _rel(got, want) <= 1e-5
+
+    def test_stft_overlap_straddles_blocks(self):
+        chain = pl.Pipeline([pl.stft(256, 64)], name="stftline")
+        x = RNG.randn(4096).astype(np.float32)
+        ref = None
+        for b in (64, 256, 1024):
+            got, _ = chain.compile(b, name=f"stft{b}").stream(x)
+            if ref is None:
+                ref = got
+            assert got.shape == ref.shape
+            assert _rel(got, ref) <= 1e-5
+        from veles.simd_tpu.ops import spectral as sp
+
+        want = sp.stft_stream_oracle(x, 256, 64)
+        assert _rel(ref, want) <= 1e-5
+
+    def test_medfilt_welch_peaks_chain(self):
+        chain = pl.Pipeline(
+            [pl.medfilt(5), pl.detrend("linear"), pl.sosfilt(SOS),
+             pl.welch(fs=2000.0, nperseg=256), pl.power_db(),
+             pl.savgol(7, 2), pl.detect_peaks(max_peaks=16)],
+            name="monitor")
+        cp = chain.compile(1024)
+        x = RNG.randn(4096).astype(np.float32)
+        outs, _ = cp.stream(x)
+        pos, vals, count = cp.oracle(x)
+        g_pos, g_vals, g_count = outs
+        np.testing.assert_array_equal(g_pos, pos)
+        np.testing.assert_array_equal(
+            np.asarray(g_count), np.asarray(count))
+        assert _rel(g_vals[g_pos >= 0], vals[pos >= 0]) <= 1e-4
+
+    def test_batched_streams_independent(self):
+        cp = _sensor_chain("batched").compile(512)
+        x = RNG.randn(3, 2048).astype(np.float32)
+        got, _ = cp.stream(x)
+        for r in range(3):
+            want = cp.oracle(x[r])
+            assert _rel(got[r], want) <= 1e-5
+
+    def test_state_roundtrips_through_numpy(self):
+        # a served state does a device->numpy->device round trip per
+        # invocation (and a batch-marshal round trip); parity must
+        # survive it
+        cp = _sensor_chain("roundtrip").compile(512)
+        x = RNG.randn(2048).astype(np.float32)
+        state = None
+        outs = []
+        for i in range(4):
+            out, state = cp.process(x[i * 512:(i + 1) * 512], state)
+            batched = cp.batch_states([_np_state(state)], 2)
+            state = cp.state_rows(batched, 1)[0]
+            outs.append(out)
+        got = cp.assemble(outs)
+        assert _rel(got, cp.oracle(x)) <= 1e-5
+
+
+def _np_state(node):
+    if isinstance(node, tuple):
+        return tuple(_np_state(t) for t in node)
+    return np.asarray(node)
+
+
+# ---------------------------------------------------------------------------
+# ONE dispatch per block (the fusion proof)
+# ---------------------------------------------------------------------------
+
+class TestOneDispatch:
+    def test_single_program_single_span_per_block(self, telemetry):
+        cp = _sensor_chain("fuseproof").compile(512)
+        x = RNG.randn(8 * 512).astype(np.float32)
+        blocks = [x[i:i + 512] for i in range(0, len(x), 512)]
+        state = cp.init_state()
+        out, state = cp.process(blocks[0], state)   # compile here
+        np.asarray(out)
+        size_after_warmup = cp.compile_cache_size()
+        obs.reset()
+        for b in blocks[1:]:
+            out, state = cp.process(b, state)
+        np.asarray(out)
+        # no recompiles in steady state: still ONE executable
+        assert size_after_warmup == 1
+        assert cp.compile_cache_size() == 1
+        # exactly one dispatch span per block, all fused
+        spans = [e for e in obs.trace_events()
+                 if e.get("name") == "pipeline.dispatch"]
+        assert len(spans) == len(blocks) - 1
+        # steady-state streaming harvests at most the one fused
+        # program — NO per-stage op entries appear (the stages run
+        # inside it, never as their own dispatches)
+        assert {(r["op"], r["route"]) for r in obs.resources()} <= {
+            ("pipeline", "fuseproof")}
+
+    def test_resources_single_pipeline_entry(self, telemetry):
+        cp = _sensor_chain("resproof").compile(512)
+        x = RNG.randn(1024).astype(np.float32)
+        cp.stream(x)
+        entries = {(r["op"], r["route"]) for r in obs.resources()}
+        assert entries == {("pipeline", "resproof")}
+
+    def test_unfused_dispatches_per_stage(self, telemetry):
+        cp = _sensor_chain("unfused").compile(512)
+        x = RNG.randn(1024).astype(np.float32)
+        cp.stream(x, fused=False)
+        ops = {r["op"] for r in obs.resources()}
+        assert ops == {"pipeline_stage"}
+        routes = {r["route"] for r in obs.resources()}
+        assert len(routes) == 4      # one compiled program per stage
+
+    def test_step_jaxpr_identical_with_telemetry_on_and_off(self):
+        # the obs contract extends to the fused step: telemetry
+        # on/off never changes the one traced program
+        import jax
+
+        cp = pl.Pipeline([pl.sosfilt(SOS), pl.power()],
+                         name="jaxprline").compile(256)
+        x = np.zeros(256, np.float32)
+        state = cp.init_state()
+        j_off = str(jax.make_jaxpr(cp._step.fn)(x, state))
+        obs.enable(compile_listeners=False)
+        try:
+            j_on = str(jax.make_jaxpr(cp._step.fn)(x, state))
+        finally:
+            obs.disable()
+        assert j_off == j_on
+
+
+# ---------------------------------------------------------------------------
+# fault injection at pipeline.dispatch
+# ---------------------------------------------------------------------------
+
+class TestFaults:
+    def test_mid_stream_fault_degrades_one_block(self, telemetry):
+        cp = _sensor_chain("faultline").compile(512)
+        x = RNG.randn(6 * 512).astype(np.float32)
+        blocks = [x[i:i + 512] for i in range(0, len(x), 512)]
+        state = cp.init_state()
+        outs = []
+        for i, b in enumerate(blocks):
+            if i == 2:
+                with faults.fault_plan(
+                        "pipeline.dispatch:device_lost:99"):
+                    out, state = cp.process(b, state)
+            else:
+                out, state = cp.process(b, state)
+            outs.append(out)
+        got = cp.assemble(outs)
+        # the degraded block came from the oracle twin with exact
+        # state threading: whole-stream parity still holds
+        assert _rel(got, cp.oracle(x)) <= 1e-5
+        assert obs.counter_value("fault_degraded",
+                                 site="pipeline.dispatch",
+                                 to="oracle") == 1
+
+    def test_transient_fault_retries_then_succeeds(self, telemetry):
+        cp = pl.Pipeline([pl.sosfilt(SOS)],
+                         name="retryline").compile(256)
+        x = RNG.randn(256).astype(np.float32)
+        cp.process(x)               # warm
+        with faults.fault_plan("pipeline.dispatch:device_lost:1"):
+            out, _ = cp.process(x)
+        assert obs.counter_value("fault_retry",
+                                 site="pipeline.dispatch") == 1
+        assert obs.counter_value("fault_degraded",
+                                 site="pipeline.dispatch",
+                                 to="oracle") == 0
+
+    def test_persistent_fault_opens_pipeline_breaker(self, telemetry):
+        cp = pl.Pipeline([pl.sosfilt(SOS)],
+                         name="poisonline").compile(256)
+        x = RNG.randn(256).astype(np.float32)
+        state = None
+        with faults.fault_plan("pipeline.dispatch:device_lost:9999"):
+            for _ in range(6):
+                out, state = cp.process(x, state)
+        br = breaker.lookup("pipeline.dispatch", ("poisonline", 256))
+        assert br is not None and br.state == breaker.OPEN
+        # open breaker short-circuits: zero retries in steady state
+        before = obs.counter_value("fault_retry",
+                                   site="pipeline.dispatch")
+        with faults.fault_plan("pipeline.dispatch:device_lost:9999"):
+            out, state = cp.process(x, state)
+        assert obs.counter_value(
+            "fault_retry", site="pipeline.dispatch") == before
+
+    def test_subsite_poisons_one_pipeline_only(self, telemetry):
+        cp_a = pl.Pipeline([pl.sosfilt(SOS)], name="pa").compile(256)
+        cp_b = pl.Pipeline([pl.sosfilt(SOS)], name="pb").compile(256)
+        x = RNG.randn(256).astype(np.float32)
+        cp_a.process(x)
+        cp_b.process(x)
+        with faults.fault_plan(
+                "pipeline.dispatch@pa:device_lost:9999"):
+            for _ in range(4):
+                cp_a.process(x)
+            cp_b.process(x)
+        assert obs.counter_value("fault_degraded",
+                                 site="pipeline.dispatch",
+                                 to="oracle") >= 1
+        br_b = breaker.lookup("pipeline.dispatch", ("pb", 256))
+        assert br_b is None or br_b.state == breaker.CLOSED
+
+
+# ---------------------------------------------------------------------------
+# pipelines as first-class served units
+# ---------------------------------------------------------------------------
+
+class TestServing:
+    def test_register_validates(self):
+        srv = serve.Server()
+        with pytest.raises(TypeError, match="CompiledPipeline"):
+            srv.register_pipeline("x", object())
+        cp = pl.Pipeline([pl.sosfilt(SOS)], name="ok").compile(256)
+        with pytest.raises(ValueError, match="bad pipeline name"):
+            srv.register_pipeline("a:b", cp)
+        assert srv.register_pipeline("ok", cp) == "pipeline:ok"
+
+    def test_unregistered_pipeline_op_rejected(self):
+        with serve.Server() as srv:
+            with pytest.raises(ValueError, match="unregistered"):
+                srv.submit(op="pipeline:nope",
+                           x=np.zeros(256, np.float32))
+
+    def test_wrong_block_length_rejected(self):
+        srv = serve.Server()
+        cp = pl.Pipeline([pl.sosfilt(SOS)], name="blk").compile(256)
+        op = srv.register_pipeline("blk", cp)
+        with srv:
+            with pytest.raises(ValueError, match="block"):
+                srv.submit(op=op, x=np.zeros(128, np.float32))
+
+    def test_malformed_state_rejected_at_submit(self):
+        # a bad state must fail ITS caller synchronously — inside the
+        # worker it would error every co-batched stream untyped
+        cp = pl.Pipeline([pl.sosfilt(SOS)],
+                         name="valid8").compile(256)
+        srv = serve.Server()
+        op = srv.register_pipeline("valid8", cp)
+        x = np.zeros(256, np.float32)
+        with srv:
+            with pytest.raises(ValueError, match="shape"):
+                srv.submit(op=op, x=x, params={
+                    "state": (np.zeros((3, 2), np.float32),)})
+            with pytest.raises(ValueError, match="tuple"):
+                srv.submit(op=op, x=x,
+                           params={"state": np.zeros(4, np.float32)})
+
+    def test_served_stream_matches_oracle(self, telemetry):
+        cp = _sensor_chain("served").compile(512)
+        x = RNG.randn(6 * 512).astype(np.float32)
+        with serve.Server(max_batch=4, max_wait_ms=1.0,
+                          workers=2) as srv:
+            op = srv.register_pipeline("served", cp)
+            state, outs = None, []
+            for i in range(6):
+                t = srv.submit(op=op, x=x[i * 512:(i + 1) * 512],
+                               params={"state": state})
+                y, state = t.result(timeout=60.0)
+                assert t.status == "ok"
+                outs.append(y)
+        got = cp.assemble(outs)
+        assert _rel(got, cp.oracle(x)) <= 1e-5
+
+    def test_batched_streams_share_one_dispatch(self, telemetry):
+        cp = pl.Pipeline([pl.sosfilt(SOS)],
+                         name="batchserve").compile(256)
+        sigs = {k: RNG.randn(1024).astype(np.float32)
+                for k in ("s0", "s1", "s2")}
+        with serve.Server(max_batch=8, max_wait_ms=20.0,
+                          workers=1) as srv:
+            op = srv.register_pipeline("batchserve", cp)
+            states = {k: None for k in sigs}
+            outs = {k: [] for k in sigs}
+            for i in range(4):
+                tickets = {k: srv.submit(
+                    op=op, x=sig[i * 256:(i + 1) * 256],
+                    params={"state": states[k]}, tenant=k)
+                    for k, sig in sigs.items()}
+                for k, t in tickets.items():
+                    y, st = t.result(timeout=60.0)
+                    outs[k].append(y)
+                    states[k] = st
+        for k, sig in sigs.items():
+            got = cp.assemble(outs[k])
+            assert _rel(got, cp.oracle(sig)) <= 1e-5
+        # coalescing happened: fewer batches than requests
+        batches = obs.counter_value("serve_batches",
+                                    op="pipeline:batchserve")
+        assert 0 < batches <= 8
+
+    def test_poisoned_pipeline_class_degrades_alone(self, telemetry):
+        cp = pl.Pipeline([pl.sosfilt(SOS)],
+                         name="chaospipe").compile(256)
+        x = RNG.randn(256).astype(np.float32)
+        with serve.Server(max_batch=2, max_wait_ms=1.0,
+                          workers=1) as srv:
+            op = srv.register_pipeline("chaospipe", cp)
+            # warm both classes
+            srv.submit(op=op, x=x,
+                       params={"state": None}).result(timeout=60.0)
+            srv.submit(op="sosfilt", x=x,
+                       params={"sos": SOS}).result(timeout=60.0)
+            with faults.fault_plan(
+                    "pipeline.dispatch@chaospipe:device_lost:9999"):
+                degraded = 0
+                for _ in range(5):
+                    t = srv.submit(op=op, x=x,
+                                   params={"state": None})
+                    t.result(timeout=60.0)
+                    degraded += int(t.status == "degraded")
+                assert degraded == 5       # answered, degraded, typed
+                t2 = srv.submit(op="sosfilt", x=x,
+                                params={"sos": SOS})
+                t2.result(timeout=60.0)
+                assert t2.status == "ok"   # sibling class untouched
+            br = breaker.lookup("pipeline.dispatch",
+                                ("chaospipe", 256))
+            assert br is not None and br.state == breaker.OPEN
+            assert srv.stats()["counts"]["degraded_answers"] >= 1
+
+    def test_loadgen_pipeline_streams_accounting(self, telemetry):
+        import loadgen
+
+        compiled = loadgen.build_pipeline("lgline")
+        with serve.Server(max_batch=4, max_wait_ms=1.0,
+                          workers=2) as srv:
+            op = srv.register_pipeline("lgline", compiled)
+            rep = loadgen.run_pipeline_streams(
+                srv, op, compiled, np.random.RandomState(0),
+                streams=2, blocks=3)
+        assert rep["requests"] == 6
+        assert rep["ok"] == 6
+        assert rep["lost"] == 0
+        assert rep["double_answered"] == 0
+        assert rep["parity_failures"] == 0

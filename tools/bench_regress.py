@@ -100,6 +100,12 @@ DEFAULT_NOISE = [
     ("chaos", 0.50),
     ("deadline hit rate", 0.25),
     ("tenant fairness", 0.40),
+    # the pipeline family (bench.py configs 12/13): wall-clock blocks/s
+    # through the fused sensor chain vs its stage-by-stage twin — host
+    # dispatch + device jitter on both sides — and the inverse-p99 row
+    # is a single order statistic of a small per-block sample
+    ("pipeline sensor chain", 0.30),
+    ("pipeline sensor chain p99", 0.45),
 ]
 
 

@@ -11,18 +11,25 @@ stepped with :func:`veles.simd_tpu.runtime.faults.advance_phase`):
    healthy numbers;
 2. **overload** — injected admission overloads force the typed shed
    path under burst traffic;
-3. **mesh_loss** — a persistent ``device_lost`` poisons ONE serve
+3. **pipeline_poison** — a persistent ``device_lost`` poisons ONE
+   served PIPELINE class (``pipeline.dispatch@chaosline``): its
+   per-pipeline-class breaker opens, its invocation streams keep
+   answering (degraded, state threading exact — parity still holds),
+   and PLAIN-op traffic in the same phase stays entirely "ok";
+4. **mesh_loss** — a persistent ``device_lost`` poisons ONE serve
    shape class (``serve.dispatch@sosfilt``) and the whole sharded
    matmul mesh (``parallel.sharded_matmul``): the per-class breaker
    opens after the retry ladder is paid twice, the health machine
    trips DEGRADED and recovers on a healthy-class probe, and sharded
    dispatch degrades to the single-chip twin (``mesh_degrade``);
-4. **recovery** — injection cleared; half-open breaker probes re-close
-   both breakers and the server finishes HEALTHY.
+5. **recovery** — injection cleared; half-open breaker probes re-close
+   every breaker and the server finishes HEALTHY.
 
 Invariants asserted (rc=1 on any failure):
 
-* zero lost / zero double-answered requests, answers parity-checked;
+* zero lost / zero double-answered requests, answers parity-checked
+  (pipeline streams included — degraded blocks must not corrupt the
+  carried state);
 * only *typed* errors reach clients (``Overloaded`` /
   ``DeadlineExceeded``; untyped per-request errors are a bug);
 * deadline misses bounded (every request carries ``--deadline-ms``);
@@ -31,6 +38,8 @@ Invariants asserted (rc=1 on any failure):
   attempts (straight-to-fallback) while other classes keep answering;
 * ``mesh_degrade`` recorded with mesh geometry; sharded dispatch
   re-enabled after recovery;
+* the poisoned PIPELINE class's breaker cycles and re-closes while
+  plain-op traffic in that phase records zero degraded answers;
 * serve health walks DEGRADED -> HEALTHY.
 
 The evidence — decision events, breaker/fault/serve counters, and the
@@ -72,9 +81,13 @@ MESH_AXIS = "sp"
 POISON_OP = "sosfilt"
 POISON_LEN = 512
 
+# the poisoned served-pipeline class (loadgen's small compiled chain)
+PIPE_NAME = "chaosline"
+
 PHASE_SPEC = (
     "baseline=;"
     "overload=serve.admission:overload:{overloads};"
+    "pipeline_poison=pipeline.dispatch@{pipe}:device_lost:9999;"
     "mesh_loss=serve.dispatch@{poison}:device_lost:9999,"
     "parallel.sharded_matmul:device_lost:9999;"
     "recovery="
@@ -154,23 +167,32 @@ def run_campaign(args) -> tuple:
     want = a.astype(np.float64) @ b.astype(np.float64)
 
     spec = PHASE_SPEC.format(overloads=args.overloads,
-                             poison=POISON_OP)
+                             poison=POISON_OP, pipe=PIPE_NAME)
     faults.set_fault_plan(spec)
     phase_reports: dict = {}
     mesh_bad = 0
     retry_steady = None
+    plain_degraded_during_pipe = None
     try:
         server = serve.Server(max_batch=4, max_wait_ms=5.0,
                               workers=args.workers, probe_every=2)
+        compiled = loadgen.build_pipeline(PIPE_NAME)
         with server:
+            pipe_op = server.register_pipeline(PIPE_NAME, compiled)
             # -- phase 1: baseline ------------------------------------
             t0 = time.perf_counter()
             sched = loadgen.build_schedule(
                 rng, args.requests, rate_hz=0.0,
                 deadline_ms=args.deadline_ms)
-            phase_reports["baseline"] = loadgen.run_load(
+            base_load = loadgen.run_load(
                 server, sched, verify=args.verify, rng=rng,
                 result_timeout=args.result_timeout)
+            base_pipe = loadgen.run_pipeline_streams(
+                server, pipe_op, compiled, rng, streams=2, blocks=3,
+                deadline_ms=args.deadline_ms,
+                result_timeout=args.result_timeout)
+            phase_reports["baseline"] = _merge_reports(
+                [base_load, base_pipe])
             mesh_bad += _mesh_calls(mesh, 1, a, b, want)
             phase_reports["baseline"]["phase_wall_s"] = \
                 time.perf_counter() - t0
@@ -187,7 +209,37 @@ def run_campaign(args) -> tuple:
             phase_reports["overload"]["phase_wall_s"] = \
                 time.perf_counter() - t0
 
-            # -- phase 3: mesh_loss -----------------------------------
+            # -- phase 3: pipeline_poison -----------------------------
+            assert faults.advance_phase() == "pipeline_poison"
+            t0 = time.perf_counter()
+            # the poisoned pipeline class keeps answering — degraded,
+            # through its OWN breaker, with exact state threading
+            # (single-invocation batches so the breaker cadence ticks
+            # once per block)
+            pipe_poisoned = loadgen.run_pipeline_streams(
+                server, pipe_op, compiled, rng, streams=1,
+                blocks=max(4, args.steady),
+                deadline_ms=args.deadline_ms,
+                result_timeout=args.result_timeout)
+            # plain-op traffic through the SAME server must be
+            # untouched: zero degraded answers while the pipeline
+            # class is poisoned
+            mixed_pp = loadgen.run_load(
+                server, loadgen.build_schedule(
+                    rng, args.requests, rate_hz=0.0,
+                    deadline_ms=args.deadline_ms),
+                verify=args.verify, rng=rng,
+                result_timeout=args.result_timeout)
+            plain_degraded_during_pipe = mixed_pp["degraded"]
+            rep = _merge_reports([pipe_poisoned, mixed_pp])
+            rep["phase_wall_s"] = time.perf_counter() - t0
+            rep["throughput_rps"] = (
+                (rep["ok"] + rep["degraded"]) / rep["phase_wall_s"]
+                if rep["phase_wall_s"] > 0 else 0.0)
+            rep["pipeline_degraded"] = pipe_poisoned["degraded"]
+            phase_reports["pipeline_poison"] = rep
+
+            # -- phase 4: mesh_loss -----------------------------------
             assert faults.advance_phase() == "mesh_loss"
             t0 = time.perf_counter()
             # warm-up: enough poisoned-class dispatches to pay the
@@ -220,7 +272,7 @@ def run_campaign(args) -> tuple:
                 if rep["phase_wall_s"] > 0 else 0.0)
             phase_reports["mesh_loss"] = rep
 
-            # -- phase 4: recovery ------------------------------------
+            # -- phase 5: recovery ------------------------------------
             assert faults.advance_phase() == "recovery"
             t0 = time.perf_counter()
             rec_poison = _run_serial(
@@ -228,6 +280,11 @@ def run_campaign(args) -> tuple:
                 _poison_requests(rng, args.recovery_calls,
                                  args.deadline_ms),
                 args.result_timeout)
+            rec_pipe = loadgen.run_pipeline_streams(
+                server, pipe_op, compiled, rng, streams=1,
+                blocks=max(4, args.recovery_calls),
+                deadline_ms=args.deadline_ms,
+                result_timeout=args.result_timeout)
             rec_mixed = loadgen.run_load(
                 server, loadgen.build_schedule(
                     rng, args.requests, rate_hz=0.0,
@@ -236,7 +293,7 @@ def run_campaign(args) -> tuple:
                 result_timeout=args.result_timeout)
             mesh_bad += _mesh_calls(mesh, args.recovery_calls,
                                     a, b, want)
-            rep = _merge_reports([rec_poison, rec_mixed])
+            rep = _merge_reports([rec_poison, rec_pipe, rec_mixed])
             rep["phase_wall_s"] = time.perf_counter() - t0
             rep["throughput_rps"] = (
                 (rep["ok"] + rep["degraded"]) / rep["phase_wall_s"]
@@ -279,6 +336,12 @@ def run_campaign(args) -> tuple:
         "parallel.dispatch",
         ("sharded_matmul", f"{MESH_AXIS}{args.mesh_devices}"
                            f"@{MESH_AXIS}"))
+    pipe_transitions = [
+        e["decision"] for e in _decisions("breaker_transition")
+        if e.get("site") == "pipeline.dispatch"
+        and PIPE_NAME in e.get("key", "")]
+    pipe_breaker = breaker.lookup(
+        "pipeline.dispatch", (PIPE_NAME, compiled.block_len))
     answered = total["ok"] + total["degraded"]
     invariants = {
         "zero_lost": total["lost"] == 0,
@@ -302,6 +365,15 @@ def run_campaign(args) -> tuple:
         "mesh_breaker_closed_at_end": (
             mesh_breaker is not None
             and mesh_breaker.state == breaker.CLOSED),
+        "pipeline_breaker_cycle": _cycle_ok(pipe_transitions),
+        "pipeline_breaker_closed_at_end": (
+            pipe_breaker is not None
+            and pipe_breaker.state == breaker.CLOSED),
+        "pipeline_degraded_then_served": (
+            phase_reports["pipeline_poison"]["pipeline_degraded"]
+            >= 1),
+        "plain_ok_during_pipeline_poison":
+            plain_degraded_during_pipe == 0,
         "health_degraded_then_healthy": (
             "degrade" in serve_events and "recover" in serve_events
             and health["state"] == serve.HEALTHY),
@@ -325,7 +397,7 @@ def run_campaign(args) -> tuple:
          else 1.0,
          "unit": "fraction", "vs_baseline": None},
     ]
-    for label in ("mesh_loss", "recovery"):
+    for label in ("mesh_loss", "pipeline_poison", "recovery"):
         rows.append({
             "metric": f"chaos {label} throughput",
             "value": round(
@@ -364,6 +436,9 @@ def run_campaign(args) -> tuple:
         "serve_health_events": _decisions("serve_health"),
         "prometheus_breaker_lines": prom,
         "retry_attempts_steady_state": retry_steady,
+        "plain_degraded_during_pipeline_poison":
+            plain_degraded_during_pipe,
+        "pipeline_breaker_transitions": pipe_transitions,
     }
     return invariants, rows, evidence
 

@@ -45,6 +45,9 @@ MODULES = [
     "veles.simd_tpu.parallel.ops",
     "veles.simd_tpu.parallel.fourier",
     "veles.simd_tpu.parallel.distributed",
+    "veles.simd_tpu.pipeline",
+    "veles.simd_tpu.pipeline.stages",
+    "veles.simd_tpu.pipeline.compiler",
     "veles.simd_tpu.serve",
     "veles.simd_tpu.serve.server",
     "veles.simd_tpu.serve.batcher",

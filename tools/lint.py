@@ -828,6 +828,189 @@ def parallel_guard_errors(tree, fname) -> list:
     return errors
 
 
+# --- pipeline rule ----------------------------------------------------------
+# The pipeline compiler (veles/simd_tpu/pipeline/) fuses op chains into
+# one instrumented step; two structural invariants keep it honest:
+#
+# * stage KERNEL RESOLUTION must go through a routing.family-bound
+#   selector — either an ops state-export hook named ``select_*``
+#   reached through a ``veles.simd_tpu.ops`` module alias (those hooks
+#   are themselves pinned to family tables by the ops routing rule),
+#   or the routing engine directly (``<alias>.family``/``get_family``
+#   or a family-bound table name).  A ``resolve`` method that picks a
+#   kernel any other way re-creates the hand-rolled ladders PR 7
+#   deleted;
+# * the COMPILED STEP — any handle bound from an
+#   ``obs.instrumented_jit(...)`` call (``self._step = ...``, list
+#   comprehensions included) — may be INVOKED only inside a
+#   ``faults.guarded``/``faults.breaker_guarded`` region, computed
+#   transitively through functions/methods referenced (by name OR
+#   attribute) from a guard call's arguments.  A bare step invocation
+#   is a dispatch that cannot retry, degrade to the stage-by-stage
+#   oracle twin, or trip the pipeline class's breaker.
+#
+# Alias-tracked like every other rule (``import ... as`` cannot dodge
+# it); matches the serve/parallel guard discipline.
+
+_PIPELINE_RULE_DIR = "veles/simd_tpu/pipeline"
+
+
+def _ops_module_aliases(tree) -> set:
+    """Names bound to ``veles.simd_tpu.ops`` submodules (the state-
+    export hook modules a stage resolves through)."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "veles.simd_tpu.ops":
+                for a in node.names:
+                    names.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith("veles.simd_tpu.ops.") \
+                        and a.asname:
+                    names.add(a.asname)
+    return names
+
+
+def pipeline_route_errors(tree, fname) -> list:
+    """The stage-resolution half of the pipeline rule (separated so
+    tests can feed synthetic sources)."""
+    errors = []
+    ops_mods = _ops_module_aliases(tree)
+    modules, family_fns = _routing_aliases(tree)
+    families = _family_table_names(tree, modules, family_fns)
+    table_names = family_fns | families
+
+    def resolves_via_engine(fn) -> bool:
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call):
+                f = n.func
+                if (isinstance(f, ast.Attribute)
+                        and f.attr.startswith("select_")
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id in ops_mods):
+                    return True
+                if (isinstance(f, ast.Attribute)
+                        and f.attr in ("family", "get_family")
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id in modules):
+                    return True
+            if isinstance(n, ast.Name) and n.id in table_names:
+                return True
+        return False
+
+    def trivial(fn) -> bool:
+        """``resolve`` that only returns None/a constant — the
+        single-kernel stage default, nothing to police."""
+        body = [n for n in fn.body
+                if not isinstance(n, ast.Expr)
+                or not isinstance(n.value, ast.Constant)]
+        return all(isinstance(n, ast.Return)
+                   and (n.value is None
+                        or isinstance(n.value, ast.Constant))
+                   for n in body)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if node.name != "resolve":
+            continue
+        if trivial(node) or resolves_via_engine(node):
+            continue
+        errors.append(
+            f"{fname}:{node.lineno}: pipeline stage resolve() picks "
+            "a kernel without consulting the routing engine — stage "
+            "dispatch must go through a routing.family-bound "
+            "selector (an ops select_* hook or "
+            "routing.family/get_family)")
+    return errors
+
+
+def pipeline_guard_errors(tree, fname) -> list:
+    """The guarded-step half of the pipeline rule (separated so tests
+    can feed synthetic sources)."""
+    errors = []
+    faults_mods, guarded_names = _faults_aliases(tree)
+    funcs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.setdefault(node.name, node)
+
+    def _is_guarded_call(node) -> bool:
+        f = node.func
+        return ((isinstance(f, ast.Attribute)
+                 and f.attr in _GUARD_ENTRY_POINTS
+                 and isinstance(f.value, ast.Name)
+                 and f.value.id in faults_mods)
+                or (isinstance(f, ast.Name) and f.id in guarded_names))
+
+    # handles: names/attributes assigned from expressions that reach
+    # an obs.instrumented_jit call (direct call, list/dict
+    # comprehension of calls, ...)
+    handles = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        reaches = any(isinstance(w, ast.Attribute)
+                      and w.attr == "instrumented_jit"
+                      for w in ast.walk(node.value))
+        if not reaches:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                handles.add(t.id)
+            elif isinstance(t, ast.Attribute):
+                handles.add(t.attr)
+
+    # guarded regions: the arguments of guard calls, plus the bodies
+    # of functions/methods referenced from one (by Name or Attribute),
+    # transitively
+    inside: set = set()
+    guarded_fns: set = set()
+
+    def _mark(subtree):
+        for w in ast.walk(subtree):
+            inside.add(id(w))
+            if isinstance(w, ast.Name) and w.id in funcs:
+                guarded_fns.add(w.id)
+            elif isinstance(w, ast.Attribute) and w.attr in funcs:
+                guarded_fns.add(w.attr)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_guarded_call(node):
+            for arg in list(node.args) + [kw.value
+                                          for kw in node.keywords]:
+                _mark(arg)
+    changed = True
+    seen: set = set()
+    while changed:
+        changed = False
+        for name in list(guarded_fns):
+            if name in seen:
+                continue
+            seen.add(name)
+            _mark(funcs[name])
+            changed = True
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        is_dispatch = ((isinstance(f, ast.Name) and f.id in handles)
+                       or (isinstance(f, ast.Attribute)
+                           and f.attr in handles))
+        if not is_dispatch:
+            continue
+        if id(node) not in inside:
+            errors.append(
+                f"{fname}:{node.lineno}: compiled pipeline step "
+                "invoked outside a faults.guarded/breaker_guarded "
+                "region — the fused step must dispatch through the "
+                "fault policy (retry / oracle-twin degrade / "
+                "per-pipeline-class breaker)")
+    return errors
+
+
 def compute_module_lint(files) -> int:
     """The ops/parallel project rules, one parse per file: telemetry
     only through the approved helpers (keeps instrumentation out of
@@ -840,7 +1023,9 @@ def compute_module_lint(files) -> int:
         except ValueError:
             continue
         in_serve = rel.startswith(_SERVE_RULE_DIR)
-        if not rel.startswith(_OBS_RULE_DIRS) and not in_serve:
+        in_pipeline = rel.startswith(_PIPELINE_RULE_DIR)
+        if not rel.startswith(_OBS_RULE_DIRS) and not in_serve \
+                and not in_pipeline:
             continue
         try:
             tree = ast.parse(f.read_text(), str(f))
@@ -858,6 +1043,15 @@ def compute_module_lint(files) -> int:
                 print(msg)
                 failures += 1
             continue
+        if in_pipeline:
+            # the pipeline package takes its own structural contract
+            # IN ADDITION to the generic compute-module rules below
+            for msg in pipeline_route_errors(tree, str(f)):
+                print(msg)
+                failures += 1
+            for msg in pipeline_guard_errors(tree, str(f)):
+                print(msg)
+                failures += 1
         if rel in _DISPATCH_RULE_FILES:
             for msg in spectral_dispatch_errors(tree, str(f)):
                 print(msg)

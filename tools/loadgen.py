@@ -74,6 +74,100 @@ def _mix():
 
 DEFAULT_TENANTS = ("alice", "bob", "carol")
 
+# the pipeline-invocation traffic leg: a small compiled chain served
+# as a first-class unit (op "pipeline:<name>"), each stream threading
+# its carried state through consecutive invocations
+PIPELINE_NAME = "loadline"
+PIPELINE_BLOCK = 256
+
+
+def build_pipeline(name: str = PIPELINE_NAME,
+                   block: int = PIPELINE_BLOCK):
+    """A small compiled pipeline for the serving legs: IIR conditioning
+    into a causal FIR — two carried states (zi + halo), cheap enough
+    for the CPU smoke."""
+    from veles.simd_tpu import pipeline as pl
+    from veles.simd_tpu.ops import iir
+
+    sos = iir.butterworth(4, 0.2, "lowpass")
+    rng = np.random.RandomState(7)
+    h = rng.randn(17).astype(np.float32) / 4.0
+    chain = pl.Pipeline([pl.sosfilt(sos, name="condition"),
+                         pl.fir(h, name="shape")], name=name)
+    return chain.compile(block)
+
+
+def run_pipeline_streams(server, op: str, compiled, rng, *,
+                         streams: int = 2, blocks: int = 4,
+                         deadline_ms: float | None = None,
+                         result_timeout: float = 120.0,
+                         verify: bool = True) -> dict:
+    """Drive ``streams`` independent pipeline streams through the
+    server, ``blocks`` invocations each, threading every answer's
+    carried state into the stream's next invocation (the
+    pipeline-serving contract).  Same accounting categories as
+    :func:`run_load`; ``verify`` parity-checks each surviving stream's
+    concatenated output against the compiled chain's one-shot oracle
+    (state threading through the SERVER must be exact — degraded
+    blocks included)."""
+    nb = compiled.block_len
+    report = {"requests": 0, "ok": 0, "degraded": 0, "shed": 0,
+              "closed": 0, "errors": 0, "lost": 0, "deadline_miss": 0,
+              "parity_failures": 0, "double_answered": 0}
+    sigs = {i: rng.randn(blocks * nb).astype(np.float32)
+            for i in range(streams)}
+    states = {i: None for i in range(streams)}
+    outs: dict = {i: [] for i in range(streams)}
+    alive = set(range(streams))
+    for b in range(blocks):
+        tickets = {}
+        for i in sorted(alive):
+            tickets[i] = server.submit(
+                op=op, x=sigs[i][b * nb:(b + 1) * nb],
+                params={"state": states[i]}, tenant=f"pstream{i}",
+                deadline_ms=deadline_ms)
+        report["requests"] += len(tickets)
+        for i, t in tickets.items():
+            try:
+                value = t.result(timeout=result_timeout)
+            except TimeoutError:
+                report["lost"] += 1
+                alive.discard(i)
+                continue
+            except serve.Overloaded:
+                report["shed"] += 1
+                alive.discard(i)
+                continue
+            except serve.DeadlineExceeded:
+                report["deadline_miss"] += 1
+                alive.discard(i)
+                continue
+            except serve.ServerClosed:
+                report["closed"] += 1
+                alive.discard(i)
+                continue
+            except Exception:  # noqa: BLE001 — typed per-request
+                report["errors"] += 1
+                alive.discard(i)
+                continue
+            y, new_state = value
+            outs[i].append(y)
+            states[i] = new_state
+            report["degraded" if t.degraded else "ok"] += 1
+    if verify:
+        for i in sorted(alive):
+            done = len(outs[i])
+            if not done:
+                continue
+            got = compiled.assemble(outs[i])
+            want = compiled.oracle(sigs[i][: done * nb])
+            scale = float(np.max(np.abs(want))) or 1.0
+            if float(np.max(np.abs(got - want)) / scale) > 2e-3:
+                report["parity_failures"] += 1
+    report["double_answered"] = obs.counter_value(
+        "serve_double_answer") if obs.enabled() else 0
+    return report
+
 
 def build_schedule(rng, n_requests: int, rate_hz: float,
                    burst_every: int = 0, burst_size: int = 0,
@@ -290,6 +384,11 @@ def main(argv=None) -> int:
                     help="write bench rows here (SERVE_DETAILS.json)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny run, gate on lost/double/parity")
+    ap.add_argument("--pipeline-streams", type=int, default=None,
+                    help="pipeline-invocation streams to serve "
+                         "(default: 2 in --smoke, else 0)")
+    ap.add_argument("--pipeline-blocks", type=int, default=4,
+                    help="invocations per pipeline stream")
     args = ap.parse_args(argv)
 
     from veles.simd_tpu.utils.platform import maybe_override_platform
@@ -309,9 +408,26 @@ def main(argv=None) -> int:
                           queue_depth=args.queue_depth,
                           tenant_depth=args.tenant_depth,
                           workers=args.workers)
+    pipeline_streams = args.pipeline_streams
+    if pipeline_streams is None:
+        pipeline_streams = 2 if args.smoke else 0
     with server:
         report = run_load(server, schedule, block=args.block,
                           verify=args.verify, rng=rng)
+        if pipeline_streams > 0:
+            compiled = build_pipeline()
+            op = server.register_pipeline(PIPELINE_NAME, compiled)
+            prep = run_pipeline_streams(
+                server, op, compiled, rng,
+                streams=pipeline_streams,
+                blocks=args.pipeline_blocks,
+                deadline_ms=args.deadline_ms)
+            report["pipeline"] = prep
+            # the global accounting gates cover the pipeline leg too
+            for k in ("lost", "parity_failures"):
+                report[k] += prep[k]
+            report["double_answered"] = max(report["double_answered"],
+                                            prep["double_answered"])
         report["health"] = server.stats()["health"]
     report["dispatch_quantiles"] = obs.quantiles(
         "span.serve.dispatch", phase="steady")

@@ -70,6 +70,8 @@ __all__ = [
     "fftconvolve", "oaconvolve",
     "overlap_save_block_length", "tpu_block_length", "select_algorithm",
     "os_precision", "StreamingConvolution",
+    "streaming_carry_len", "select_stream_route", "causal_stream_block",
+    "causal_stream_block_na",
 ]
 
 
@@ -882,6 +884,70 @@ def oaconvolve(x, h, mode: str = "full", simd=None):
 # --------------------------------------------------------------------------
 # streaming convolution — NEW capability beyond the reference
 # --------------------------------------------------------------------------
+
+def streaming_carry_len(h_length: int) -> int:
+    """Input-history samples a causal streaming FIR must carry between
+    blocks (the overlap-save halo): ``h_length - 1``.  The pipeline
+    compiler's state-sizing hook."""
+    return max(int(h_length) - 1, 0)
+
+
+def select_stream_route(x_length: int, h_length: int,
+                        tune_geom: dict | None = None) -> str:
+    """Compile-time route for one causal streaming-FIR block — the
+    pipeline compiler's hook into the ``convolve`` candidate table
+    (autotuned winners and the tune cache steer the fused step too;
+    ``tune_geom`` lets the caller stamp its own tune class, e.g. the
+    pipeline-stage class).  Consults, never probes: the fused step is
+    compiled once and the per-route thunks the autotuner would time
+    are not what the pipeline dispatches."""
+    return _ALGO_FAMILY.select(
+        x_length=int(x_length), h_length=int(h_length),
+        tune_geom=tune_geom)
+
+
+def causal_stream_block(x_ext, h, route: str, reverse: bool = False):
+    """TRACEABLE causal-FIR block over a halo-extended signal — the
+    pipeline compiler's state-export hook.
+
+    ``x_ext[..., carry + b]`` is the previous block's ``h_length - 1``
+    trailing input samples (zero-seeded at stream start — exactly the
+    one-shot convolution's left zero pad) followed by the new block;
+    returns the ``b`` causal outputs ``y[t] = sum_k h[k] x[t - k]``
+    for the block's samples.  ``route`` comes from
+    :func:`select_stream_route`; all three algorithm routes run the
+    same ``obs.instrumented_jit`` cores dispatch uses, so they inline
+    into a fused outer jit with no extra dispatch (the Pallas direct
+    kernel is excluded — the fused step must stay outer-jit-safe on
+    every backend).
+    """
+    k = int(h.shape[-1])
+    n = int(x_ext.shape[-1])
+    if route == "fft":
+        full = _conv_fft(x_ext, h, _fft_length(n, k), reverse=reverse)
+    elif route == "overlap_save" and k <= AUTO_OS_MATMUL_MAX_H:
+        full = _conv_os_matmul(x_ext, h, overlap_save_step(k),
+                               reverse=reverse,
+                               precision=os_precision())
+    else:
+        # brute_force — and the terminal fallback for an overlap-save
+        # selection whose very long filter has no matmul step
+        full = _conv_direct(x_ext, h, reverse=reverse)
+    return full[..., k - 1:n]
+
+
+def causal_stream_block_na(x_ext, h, reverse: bool = False):
+    """NumPy float64 oracle twin of :func:`causal_stream_block` (the
+    pipeline's stage-by-stage degradation path)."""
+    x_ext = np.asarray(x_ext, np.float64)
+    h = np.asarray(h, np.float64)
+    if reverse:
+        h = h[..., ::-1]
+    k = h.shape[-1]
+    n = x_ext.shape[-1]
+    full = convolve_na(x_ext, h)
+    return full[..., k - 1:n]
+
 
 class StreamingConvolution:
     """Chunked streaming convolution with carried overlap state.

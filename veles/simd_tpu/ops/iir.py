@@ -55,7 +55,8 @@ __all__ = [
     "sosfilt_na",
     "sosfiltfilt", "sosfiltfilt_na", "lfilter", "lfilter_na",
     "sos_frequency_response", "frequency_response", "sosfilt_zi",
-    "lfilter_zi", "StreamingSosfilt",
+    "lfilter_zi", "StreamingSosfilt", "sos_stream_step",
+    "sos_stream_step_na",
 ]
 
 
@@ -1035,6 +1036,31 @@ def sosfilt_na(sos, x, zi=None, return_zf=False):
     if return_zf:
         return y, zf
     return y
+
+
+def sos_stream_step(x, sos, zi):
+    """TRACEABLE one-block SOS cascade step — the pipeline compiler's
+    state-export hook (:mod:`veles.simd_tpu.pipeline`).
+
+    ``x[..., b]`` (``b >= 2``) runs through the associative-scan
+    cascade with incoming DF2T state ``zi[..., n_sections, 2]``;
+    returns ``(y, zf)`` with ``zf`` the exit states in the same
+    convention — thread them into the next block's call and the
+    concatenated outputs equal the one-shot cascade to f32 round-off.
+    ``sos`` must be a HOST array (it becomes trace-time constants);
+    ``x``/``zi`` may be tracers, so a fused outer jit can inline this
+    step next to other stages with no extra dispatch.
+    """
+    sos = _check_sos(sos)
+    sos_rows = np.asarray(sos, np.float32)
+    zi_rows = [zi[..., i, :] for i in range(len(sos_rows))]
+    return _sos_scan(x, sos_rows, zi_rows, want_zf=True)
+
+
+def sos_stream_step_na(x, sos, zi):
+    """NumPy float64 oracle twin of :func:`sos_stream_step` (the
+    pipeline's stage-by-stage degradation path): returns ``(y, zf)``."""
+    return sosfilt_na(sos, x, zi=zi, return_zf=True)
 
 
 class StreamingSosfilt:

@@ -40,6 +40,8 @@ __all__ = [
     "upfirdn_na", "upsample",
     "decimate", "resample_fourier", "resample_fourier_na",
     "resample_length",
+    "resample_stream_plan", "resample_stream_step",
+    "resample_stream_step_na", "resample_stream_oracle",
 ]
 
 
@@ -254,6 +256,114 @@ def upfirdn_na(h, x, up: int = 1, down: int = 1):
     full convolve, stride)."""
     h = np.asarray(h, np.float64)
     return _zero_stuff_convolve(x, h, int(up))[..., ::int(down)]
+
+
+# --------------------------------------------------------------------------
+# streaming resample — the pipeline compiler's state-export hooks
+# --------------------------------------------------------------------------
+
+def resample_stream_plan(up: int, down: int, block: int,
+                         taps=None) -> dict:
+    """Host-side streaming plan for a rational resampler stage.
+
+    The one-shot :func:`resample_poly` samples the zero-stuffed full
+    convolution ``full`` on the centered grid ``full[pad + j*down]``
+    (``pad = (taps - 1) // 2``).  Streaming in fixed ``block``-sample
+    input chunks with an ``hist``-sample input-history carry
+    (zero-seeded — one-shot's left zero pad), each chunk determines
+    exactly ``out_block = block * up / down`` NEW grid samples, but
+    the centered filter looks ``pad`` stuffed samples ahead, so the
+    stream runs ``preroll = pad // down`` output samples EARLY: the
+    emitted grid starts at ``full[pad - preroll*down]`` — the filter's
+    left transient — and streamed output ``m`` equals one-shot output
+    ``m - preroll`` from ``m = preroll`` on.  Returns the plan dict
+    (``up/down/taps/hist/out_block/preroll/pad`` — ``pad`` is the
+    dilated-domain (left, right) override for the shared
+    :func:`resample_stream_step` conv, derived so the step's output
+    window lands exactly on the emitted grid slice).
+
+    Requires ``block * up % down == 0`` (a fixed-shape step needs a
+    constant per-block emission) and a block at least as long as the
+    carried history (the halo must be fully refreshed every step).
+    """
+    up, down, taps = _normalize_resample_args(int(block), up, down,
+                                              taps)
+    if up == 1 and down == 1:
+        raise ValueError("1/1 rate needs no resample stage")
+    block = int(block)
+    if (block * up) % down != 0:
+        raise ValueError(
+            f"block {block} * up {up} must be divisible by down "
+            f"{down} (fixed-shape streaming needs a constant "
+            "per-block emission)")
+    k = len(taps)
+    pad_c = (k - 1) // 2
+    hist = -(-(k - 1) // up)          # ceil: halo covers the filter
+    if block < hist:
+        raise ValueError(
+            f"block {block} shorter than the {hist}-sample carry for "
+            f"{k} taps (choose a larger block or shorter filter)")
+    out_block = block * up // down
+    preroll = pad_c // down
+    # emitted grid within the halo-extended chunk's full convolution:
+    # first sample at full_ext[r_start], stepping by down (constant
+    # for every block — the halo shifts with the stream)
+    r_start = pad_c + hist * up - preroll * down
+    pad_l = k - 1 - r_start
+    dilated_ext = (hist + block - 1) * up + 1
+    pad_r = max(0, (out_block - 1) * down + k - pad_l - dilated_ext)
+    return {"up": up, "down": down, "taps": taps, "hist": hist,
+            "out_block": out_block, "preroll": preroll,
+            "pad": (pad_l, pad_r)}
+
+
+def resample_stream_step(x_ext, taps, plan: dict):
+    """TRACEABLE one-block resample step: ``x_ext[..., hist + block]``
+    (carry + new chunk) -> ``[..., out_block]`` on the streaming grid
+    of :func:`resample_stream_plan`.  Runs the same single
+    dilated/strided ``obs.instrumented_jit`` correlation core as
+    :func:`resample_poly`, so it inlines into a fused outer jit."""
+    return _resample_conv(x_ext, taps, plan["up"], plan["down"],
+                          plan["out_block"], pad=plan["pad"])
+
+
+def resample_stream_step_na(x_ext, plan: dict):
+    """NumPy float64 oracle twin of :func:`resample_stream_step`
+    (the pipeline's stage-by-stage degradation path): the same
+    emitted-grid slice of the zero-stuffed full convolution, derived
+    from the SAME plan — the grid math lives here, next to the pad
+    derivation it mirrors, so the pair cannot drift apart."""
+    x_ext = np.asarray(x_ext, np.float64)
+    full = _zero_stuff_convolve(x_ext, plan["taps"], plan["up"])
+    r_start = len(plan["taps"]) - 1 - plan["pad"][0]
+    need = r_start + (plan["out_block"] - 1) * plan["down"] + 1
+    if need > full.shape[-1]:
+        wpad = ([(0, 0)] * (full.ndim - 1)
+                + [(0, need - full.shape[-1])])
+        full = np.pad(full, wpad)
+    return full[..., r_start::plan["down"]][..., :plan["out_block"]]
+
+
+def resample_stream_oracle(x, plan: dict):
+    """NumPy float64 one-shot oracle of the STREAMING grid: what
+    chunked :func:`resample_stream_step` calls emit over the whole
+    signal, computed whole-signal (the pipeline parity reference and
+    the stage-by-stage degradation path)."""
+    x = np.asarray(x, np.float64)
+    up, down = plan["up"], plan["down"]
+    n = x.shape[-1]
+    if (n * up) % down != 0:
+        raise ValueError("signal length must be whole blocks")
+    total = n * up // down
+    k = len(plan["taps"])
+    pad_c = (k - 1) // 2
+    start = pad_c - plan["preroll"] * down
+    full = _zero_stuff_convolve(x, plan["taps"], up)
+    need = start + (total - 1) * down + 1
+    if need > full.shape[-1]:
+        wpad = [(0, 0)] * (full.ndim - 1) + [(0, need - full.shape[-1])]
+        full = np.pad(full, wpad)
+    return full[..., start::down][..., :total]
 
 
 def upsample(x, factor: int, taps=None, simd=None):

@@ -74,6 +74,8 @@ __all__ = [
     "lombscargle_na", "ct_factor", "ct_apply", "ct_basis_parts",
     "ct_basis_device", "dft_basis_parts", "twiddle_parts",
     "hermitian_extend",
+    "stft_stream_carry", "select_stft_stream_route",
+    "stft_stream_step", "stft_stream_oracle",
 ]
 
 
@@ -768,6 +770,68 @@ def _run_stft_pallas(x, window, frame_length, hop, forced=False):
 _STFT_ROUTES = {"xla_fft": _run_stft_xla,
                 "rdft_matmul": _run_stft_rdft,
                 "pallas_fused": _run_stft_pallas}
+
+
+# -- streaming STFT hooks (the pipeline compiler's state-export API) --------
+
+def stft_stream_carry(frame_length: int, hop: int) -> int:
+    """Input-history samples a streaming STFT carries between blocks:
+    ``frame_length - hop`` (the inter-frame overlap).  Zero-seeded at
+    stream start, so the stream computes the STFT of the zero-prefixed
+    signal: streamed frame ``f`` equals one-shot frame
+    ``f - (frame_length/hop - 1)`` once real samples fill the carry.
+    Requires ``hop | frame_length`` and ``hop | block`` (a fixed-shape
+    step needs a constant ``block/hop`` frames per block)."""
+    frame_length, hop = int(frame_length), int(hop)
+    _check_stft_args(frame_length, frame_length, hop)
+    if frame_length % hop != 0:
+        raise ValueError(
+            f"streaming STFT needs hop {hop} dividing frame_length "
+            f"{frame_length} (frame-aligned carry)")
+    return frame_length - hop
+
+
+def select_stft_stream_route(frame_length: int, hop: int, frames: int,
+                             tune_geom: dict | None = None) -> str:
+    """Compile-time route for the streaming STFT stage — the pipeline
+    compiler's hook into the ``stft`` candidate table.  Eligibility is
+    restricted to the outer-jit-safe routes (``rdft_matmul`` /
+    ``xla_fft``): the fused Pallas kernel carries its own grid-step
+    state, which cannot thread through a fused pipeline step.
+    Consults the tune cache, never probes."""
+    eligible = [name for name in _STFT_FAMILY.eligible(
+        frame_length=int(frame_length), hop=int(hop),
+        frames=int(frames)) if name != "pallas_fused"]
+    return _STFT_FAMILY.select(
+        eligible=eligible or ["xla_fft"], tune_geom=tune_geom,
+        frame_length=int(frame_length), hop=int(hop),
+        frames=int(frames))
+
+
+def stft_stream_step(x_ext, frame_length: int, hop: int, window,
+                     route: str):
+    """TRACEABLE one-block STFT step: ``x_ext[..., (L - hop) + block]``
+    (carry + new chunk) -> complex64 ``[..., block/hop, L//2 + 1]``.
+    Runs the same ``obs.instrumented_jit`` route cores one-shot
+    :func:`stft` dispatches, so it inlines into a fused outer jit."""
+    if route == "rdft_matmul":
+        basis = _device_basis(
+            "rdft_fwd", frame_length, window,
+            lambda: _rdft_basis(frame_length, window))
+        return _stft_rdft(x_ext, basis, frame_length, hop)
+    return _stft_xla(x_ext, jnp.asarray(window, jnp.float32),
+                     frame_length, hop)
+
+
+def stft_stream_oracle(x, frame_length: int, hop: int, window=None):
+    """NumPy float64 one-shot oracle of the STREAMING frame grid (the
+    zero-prefixed signal's STFT) — the pipeline parity reference and
+    stage-by-stage degradation path."""
+    x = np.asarray(x, np.float64)
+    carry = stft_stream_carry(frame_length, hop)
+    pre = np.zeros(x.shape[:-1] + (carry,), np.float64)
+    return stft_na(np.concatenate([pre, x], axis=-1), frame_length,
+                   hop, window)
 
 
 def stft(x, frame_length: int, hop: int, window=None, simd=None,

@@ -1,0 +1,42 @@
+"""veles.simd_tpu.pipeline — op chains compiled into one dispatch.
+
+The paper's library is a bag of one-shot SIMD routines, but its real
+deployments (matched filters, vibration monitoring, biosignals) run
+*chains* of those routines over unbounded streams.  This package makes
+the chain the unit of compilation and serving:
+
+* **declare** a chain from stage descriptors
+  (:mod:`~veles.simd_tpu.pipeline.stages`):
+  ``Pipeline([resample_poly(2, 1), sosfilt(sos), stft(256, 64),
+  power()])``;
+* **compile** it (:mod:`~veles.simd_tpu.pipeline.compiler`) into ONE
+  block-processing ``obs.instrumented_jit`` step — every stage's
+  carried state (IIR ``zi``, FIR/overlap-save halo, STFT frame
+  overlap, resampler history) threaded explicitly through the step as
+  a pytree, stage kernels resolved through the existing
+  ``routing.family`` tables at compile time;
+* **dispatch** each block under ``faults.breaker_guarded`` at
+  ``pipeline.dispatch`` with a per-pipeline-class breaker and
+  graceful degradation to the stage-by-stage NumPy oracle twin;
+* **serve** it: ``serve.Server.register_pipeline(name, compiled)``
+  makes pipeline invocations (block + carried state) first-class
+  requests through the deadline batcher, admission control, and
+  per-pipeline-class breakers.
+"""
+
+from veles.simd_tpu.pipeline.compiler import (PIPELINE_SITE,
+                                              CompiledPipeline,
+                                              Pipeline)
+from veles.simd_tpu.pipeline.stages import (Stage, correlate,
+                                            detect_peaks, detrend,
+                                            fir, matched_filter,
+                                            medfilt, power, power_db,
+                                            resample_poly, savgol,
+                                            sosfilt, stft, welch)
+
+__all__ = [
+    "Pipeline", "CompiledPipeline", "PIPELINE_SITE", "Stage",
+    "fir", "correlate", "matched_filter", "sosfilt", "resample_poly",
+    "medfilt", "detrend", "stft", "power", "power_db", "welch",
+    "savgol", "detect_peaks",
+]

@@ -1,0 +1,422 @@
+"""The pipeline compiler: an op chain fused into ONE dispatch per block.
+
+``examples/sensor_pipeline.py``'s six-stage chain used to run as six
+separate dispatches with six HBM round-trips per block; TINA
+(arXiv:2408.16551) frames whole-algorithm-to-accelerator mapping — not
+per-op routing — as where the wins live, and arXiv:1810.09868's
+whole-program TPU compilation is the model.  :class:`Pipeline` holds a
+declarative stage chain (:mod:`veles.simd_tpu.pipeline.stages`);
+:meth:`Pipeline.compile` validates the geometry once, resolves every
+routed stage's kernel through the EXISTING ``routing.family`` tables
+(autotuned winners and rejection caches steer the fused step; tune
+classes are stamped :func:`~veles.simd_tpu.runtime.routing.\
+pipeline_tune_geom`), and builds one ``obs.instrumented_jit`` step —
+``(block, state) -> (out, state')`` with EVERY stage's carried state
+(IIR ``zi``, FIR halo, STFT frame overlap, resampler history)
+threaded explicitly through the step as a pytree.
+
+The compiled step dispatches under
+:func:`veles.simd_tpu.runtime.faults.breaker_guarded` at the
+``pipeline.dispatch`` site with a per-pipeline-class breaker:
+transient device faults retry, exhaustion degrades THAT BLOCK to the
+stage-by-stage NumPy oracle twin (identical streaming semantics, so
+the stream continues with exact state and block-streamed output still
+matches the one-shot oracle), and a persistently failing pipeline
+class short-circuits straight to the oracle without dragging sibling
+classes down.
+
+Parity contract (``tests/test_pipeline.py``): for any block
+decomposition, ``stream(x)`` equals :meth:`CompiledPipeline.oracle`
+on the whole signal — including block boundaries straddling IIR
+state, overlap-save halo, STFT overlap, and resampler history, and
+across a mid-stream injected fault at ``pipeline.dispatch``.
+
+Usage::
+
+    from veles.simd_tpu import pipeline as pl
+
+    chain = pl.Pipeline([pl.resample_poly(2, 1), pl.sosfilt(sos),
+                         pl.stft(256, 64), pl.power()],
+                        name="sensor")
+    cp = chain.compile(block_len=1024)
+    state = cp.init_state()
+    for block in blocks:
+        out, state = cp.process(block, state)   # ONE dispatch each
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+from veles.simd_tpu import obs
+from veles.simd_tpu.runtime import faults, routing
+from veles.simd_tpu.pipeline.stages import MODES, Stage
+
+__all__ = ["Pipeline", "CompiledPipeline", "PIPELINE_SITE"]
+
+# the fused step's fault-policy site: VELES_SIMD_FAULT_PLAN entries
+# (`pipeline.dispatch:device_lost:1`) exercise retry/degrade on CPU CI
+PIPELINE_SITE = "pipeline.dispatch"
+
+
+def _tree_map(fn, tree):
+    """Structure-preserving map over the nested tuple/list state pytree
+    (host-side — no jax import for the NumPy paths)."""
+    if isinstance(tree, (tuple, list)):
+        return tuple(_tree_map(fn, t) for t in tree)
+    return fn(tree)
+
+
+def _cast_out(leaf):
+    """Oracle float64/complex128 outputs -> the device dtypes, so a
+    degraded block is shape- and dtype-compatible with the fused ones."""
+    a = np.asarray(leaf)
+    if a.dtype == np.float64:
+        return a.astype(np.float32)
+    if a.dtype == np.complex128:
+        return a.astype(np.complex64)
+    return a
+
+
+class Pipeline:
+    """A declarative op chain: ordered :class:`~veles.simd_tpu.\
+pipeline.stages.Stage` descriptors, not yet bound to a block size.
+    :meth:`compile` produces the runnable :class:`CompiledPipeline`."""
+
+    def __init__(self, stages, name: str = "pipeline"):
+        stages = list(stages)
+        if not stages:
+            raise ValueError("a pipeline needs at least one stage")
+        for st in stages:
+            if not isinstance(st, Stage):
+                raise TypeError(f"not a pipeline stage: {st!r}")
+        for st in stages[:-1]:
+            if st.terminal:
+                raise ValueError(
+                    f"terminal stage {st.name!r} must come last")
+        names = [st.name for st in stages]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate stage names: {names}")
+        self.stages = stages
+        self.name = str(name)
+
+    def compile(self, block_len: int, name: str | None = None
+                ) -> "CompiledPipeline":
+        """Validate the chain against ``block_len``, resolve every
+        routed stage's kernel, and build the fused step."""
+        return CompiledPipeline(self, int(block_len),
+                                name=name or self.name)
+
+
+class CompiledPipeline:
+    """One chain bound to one block size: a single fused
+    ``obs.instrumented_jit`` step plus the stage-by-stage oracle twin
+    (see the module docstring for the full story)."""
+
+    def __init__(self, pipeline: Pipeline, block_len: int,
+                 name: str):
+        if block_len < 1:
+            raise ValueError("block_len must be positive")
+        self.name = str(name)
+        self.block_len = int(block_len)
+        # PRIVATE stage copies: plan()/resolve() write block geometry
+        # and routes into the stage objects, and a Pipeline may be
+        # compiled at several block sizes — sharing the descriptors
+        # would let the second compile silently corrupt the first
+        self._stages = copy.deepcopy(pipeline.stages)
+        # geometry pass: thread (block, mode) through the chain
+        block, mode = self.block_len, "samples"
+        self._links = []
+        for st in self._stages:
+            block, mode = st.plan(block, mode)
+            if mode not in MODES:
+                raise ValueError(f"stage {st.name!r} returned unknown "
+                                 f"mode {mode!r}")
+            self._links.append({"stage": st.name, "block_out": block,
+                                "mode": mode})
+        self.out_block = block
+        self.mode = mode
+        self.terminal_tree = self._stages[-1].terminal
+        # route pass: every routed stage resolves through its
+        # routing.family table NOW (compile time), with the tune class
+        # stamped as pipeline-compiled
+        for st in self._stages:
+            route = st.resolve(routing.pipeline_tune_geom)
+            if route is not None:
+                obs.record_decision(
+                    "pipeline_stage_route", route, pipeline=self.name,
+                    stage=st.name, family=st.family)
+        obs.record_decision(
+            "pipeline_compile", self.name, block=self.block_len,
+            out_block=self.out_block, mode=self.mode,
+            stages=",".join(st.name for st in self._stages),
+            routes=",".join(f"{st.name}={st.route}"
+                            for st in self._stages
+                            if st.route is not None))
+
+        stages = self._stages
+
+        def _step(x, states):
+            new_states = []
+            y = x
+            for st, s in zip(stages, states):
+                y, s2 = st.apply(y, s)
+                new_states.append(s2)
+            return y, tuple(new_states)
+
+        # THE fused step: one compiled program, one dispatch per block
+        self._step = obs.instrumented_jit(_step, op="pipeline",
+                                          route=self.name)
+        # the honest-comparison twin: the SAME stage kernels, one
+        # dispatch per stage per block (what the chain cost before
+        # fusing) — built lazily, only the bench/examples pay for it
+        self._stage_jits = None
+
+    # -- state --------------------------------------------------------------
+
+    def init_state(self, batch_shape: tuple = ()) -> tuple:
+        """Zero-seeded carried state for a fresh stream (optionally
+        batched: one independent stream per leading row)."""
+        return tuple(st.init_state(tuple(batch_shape))
+                     for st in self._stages)
+
+    # -- the block step -----------------------------------------------------
+
+    def _to_device_state(self, state):
+        import jax.numpy as jnp
+
+        return _tree_map(lambda a: jnp.asarray(a, jnp.float32), state)
+
+    def _run_fused(self, block, state):
+        import jax.numpy as jnp
+
+        return self._step(jnp.asarray(block, jnp.float32),
+                          self._to_device_state(state))
+
+    def _run_unfused(self, block, state):
+        """Per-stage dispatch of the SAME kernels (the pre-fusion
+        cost model): one jit call per stage per block."""
+        import jax.numpy as jnp
+
+        if self._stage_jits is None:
+            self._stage_jits = [
+                obs.instrumented_jit(st.apply, op="pipeline_stage",
+                                     route=f"{self.name}:{st.name}")
+                for st in self._stages]
+        y = jnp.asarray(block, jnp.float32)
+        state = self._to_device_state(state)
+        new_states = []
+        for st, jfn, s in zip(self._stages, self._stage_jits, state):
+            y, s2 = jfn(y, s)
+            new_states.append(s2)
+        return y, tuple(new_states)
+
+    def oracle_step(self, block, state):
+        """One block through the stage-by-stage NumPy oracle twin —
+        the degradation target (identical streaming semantics, exact
+        state threading, cannot fault)."""
+        y = np.asarray(block, np.float64)
+        new_states = []
+        for st, s in zip(self._stages, state):
+            y, s2 = st.apply_na(y, s)
+            new_states.append(s2)
+        return _tree_map(_cast_out, y), tuple(new_states)
+
+    def process(self, block, state=None, fused: bool = True):
+        """Feed one block (``[..., block_len]``); returns ``(out,
+        state')``.  The fused path is ONE ``instrumented_jit``
+        dispatch under the pipeline class's circuit breaker at
+        ``pipeline.dispatch``; transient faults retry then degrade
+        THIS block to the oracle twin and the stream continues with
+        exact state.  ``fused=False`` dispatches stage-by-stage (the
+        honest pre-fusion baseline) through the same fault policy."""
+        if np.shape(block)[-1] != self.block_len:
+            raise ValueError(
+                f"block length {np.shape(block)[-1]} != compiled "
+                f"{self.block_len}")
+        if state is None:
+            state = self.init_state(np.shape(block)[:-1])
+        with obs.span("pipeline.dispatch", pipeline=self.name,
+                      fused=bool(fused)):
+            return faults.breaker_guarded(
+                PIPELINE_SITE, (self.name, self.block_len),
+                (lambda: self._run_fused(block, state)) if fused
+                else (lambda: self._run_unfused(block, state)),
+                fallback=lambda: self.oracle_step(block, state),
+                fallback_name="oracle", subsite=self.name)
+
+    def serve_step(self, block, state, budget_s: float | None = None):
+        """One (possibly row-batched) block for the SERVING layer:
+        the same per-pipeline-class breaker + guarded dispatch as
+        :meth:`process`, with the batch's remaining deadline budget
+        threaded in, returning ``(out, state', degraded)`` so the
+        server can label oracle-served tickets.  The breaker key is
+        the pipeline class — ``serve.dispatch`` traffic and direct
+        :meth:`process` callers share one breaker, and a chaos plan
+        poisons the class via the ``pipeline.dispatch@<name>``
+        subsite."""
+        box = {"deg": False}
+
+        def fallback():
+            box["deg"] = True
+            return self.oracle_step(block, state)
+
+        with obs.span("pipeline.dispatch", pipeline=self.name,
+                      served=True):
+            out, new_state = faults.breaker_guarded(
+                PIPELINE_SITE, (self.name, self.block_len),
+                lambda: self._run_fused(block, state),
+                fallback=fallback, fallback_name="oracle",
+                subsite=self.name, budget_s=budget_s)
+        return out, new_state, box["deg"]
+
+    # -- serving-layer state marshalling ------------------------------------
+
+    def check_state(self, state) -> None:
+        """Validate a caller-supplied carried state against this
+        pipeline's structure and per-stream leaf shapes — the serving
+        layer's SUBMIT-time gate: a malformed state (saved from a
+        different pipeline or block size) must fail its own caller
+        synchronously with ValueError, never surface inside the
+        worker where it would error every co-batched stream."""
+        ref = self.init_state(())
+
+        def walk(r, s, path):
+            where = "/".join(path) or "state"
+            if isinstance(r, tuple):
+                if not isinstance(s, (tuple, list)) or len(s) != len(r):
+                    raise ValueError(
+                        f"pipeline {self.name!r} state at {where}: "
+                        f"expected a {len(r)}-element tuple, got "
+                        f"{type(s).__name__}")
+                for i, (ri, si) in enumerate(zip(r, s)):
+                    walk(ri, si, path + [str(i)])
+                return
+            try:
+                shape = tuple(np.shape(s))
+            except Exception:
+                raise ValueError(
+                    f"pipeline {self.name!r} state at {where}: not "
+                    "an array") from None
+            want = tuple(np.shape(r))
+            if shape != want:
+                raise ValueError(
+                    f"pipeline {self.name!r} state at {where}: shape "
+                    f"{shape} != expected {want} (state from another "
+                    "pipeline or block size?)")
+
+        walk(ref, state, [])
+
+    def batch_states(self, row_states, rows: int) -> tuple:
+        """Stack per-stream states into one ``rows``-row batched state
+        (the serve batcher's marshalling): ``row_states[i]`` is stream
+        ``i``'s carried state or None (fresh stream); missing rows and
+        pad rows stay zero-seeded."""
+        base = self.init_state((int(rows),))
+
+        def fill(base_node, idx, state_node):
+            if isinstance(base_node, tuple):
+                for b, s in zip(base_node, state_node):
+                    fill(b, idx, s)
+            else:
+                base_node[idx] = np.asarray(state_node)
+
+        for i, rs in enumerate(row_states):
+            if rs is not None:
+                fill(base, i, rs)
+        return base
+
+    def state_rows(self, state, count: int) -> list:
+        """Split a batched state back into ``count`` per-stream
+        states (NumPy) — the serve batcher's un-marshalling."""
+        state = _tree_map(np.asarray, state)
+        return [_tree_map(lambda a, i=i: a[i], state)
+                for i in range(count)]
+
+    def out_rows(self, out, count: int) -> list:
+        """Split a batched step output into ``count`` per-stream
+        outputs (arrays, or per-leaf for a terminal pytree stage)."""
+        if self.terminal_tree:
+            out = _tree_map(np.asarray, out)
+            return [_tree_map(lambda a, i=i: a[i], out)
+                    for i in range(count)]
+        out = np.asarray(out)
+        return [out[i] for i in range(count)]
+
+    # -- whole-signal helpers ----------------------------------------------
+
+    def _split(self, x):
+        n = np.shape(x)[-1]
+        if n % self.block_len != 0 or n == 0:
+            raise ValueError(
+                f"signal length {n} is not whole blocks of "
+                f"{self.block_len}")
+        return [x[..., i:i + self.block_len]
+                for i in range(0, n, self.block_len)]
+
+    def assemble(self, outs):
+        """Per-block outputs -> the whole-stream array, per the chain
+        mode: ``samples``/``frames`` concatenate (last / frames axis),
+        ``rows`` stack a new block axis.  Terminal pytree stages
+        (detect_peaks) assemble per leaf on a new block axis."""
+        if self.terminal_tree:
+            leaves = zip(*outs)
+            return tuple(np.stack([np.asarray(v) for v in leaf])
+                         for leaf in leaves)
+        outs = [np.asarray(o) for o in outs]
+        if self.mode == "samples":
+            return np.concatenate(outs, axis=-1)
+        if self.mode == "frames":
+            return np.concatenate(outs, axis=-2)
+        return np.stack(outs, axis=-2)
+
+    def stream(self, x, state=None, fused: bool = True):
+        """Block the whole signal, thread state through
+        :meth:`process`, and :meth:`assemble` — the test/bench
+        convenience.  Returns ``(assembled, final_state)``."""
+        if state is None:
+            state = self.init_state(np.shape(x)[:-1])
+        outs = []
+        for block in self._split(x):
+            out, state = self.process(block, state, fused=fused)
+            outs.append(out)
+        return self.assemble(outs), state
+
+    def oracle(self, x):
+        """ONE-SHOT whole-signal oracle of the streamed chain: each
+        stage's closed-form streaming semantics evaluated over the
+        full signal in NumPy float64 (no blocking, no state) — what
+        any block decomposition of :meth:`stream` must reproduce."""
+        y = np.asarray(x, np.float64)
+        block, mode = self.block_len, "samples"
+        for st, link in zip(self._stages, self._links):
+            y = st.oracle(y, block, mode)
+            block, mode = link["block_out"], link["mode"]
+        return y if self.terminal_tree else np.asarray(y)
+
+    # -- introspection ------------------------------------------------------
+
+    def describe(self) -> dict:
+        """JSON-native chain summary (stages, routes, per-stage
+        latencies, block geometry)."""
+        return {"pipeline": self.name, "block_len": self.block_len,
+                "out_block": self.out_block, "mode": self.mode,
+                "stages": [dict(st.describe(), **{
+                    k: v for k, v in link.items() if k != "stage"})
+                    for st, link in zip(self._stages, self._links)]}
+
+    def routes(self) -> dict:
+        """Stage name -> resolved route (routed stages only)."""
+        return {st.name: st.route for st in self._stages
+                if st.route is not None}
+
+    def compile_cache_size(self) -> int | None:
+        """Number of compiled executables behind the fused step (the
+        one-dispatch-per-block test gate); None when the jax version
+        does not expose it."""
+        try:
+            return int(self._step._jfn._cache_size())
+        except Exception:  # noqa: BLE001 — introspection only
+            return None

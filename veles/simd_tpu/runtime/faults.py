@@ -45,9 +45,14 @@ module is the one shared layer, three pieces:
   serving layer (:mod:`veles.simd_tpu.serve`) adds two sites:
   ``serve.dispatch`` (batch dispatch, guarded — device-lost/timeout
   kinds drive retry → DEGRADED) and ``serve.admission`` (the
-  ``overload`` kind forces the typed shed path).  A guarded site may
+  ``overload`` kind forces the typed shed path); the pipeline
+  compiler (:mod:`veles.simd_tpu.pipeline`) adds ``pipeline.dispatch``
+  (the fused block step, behind a per-pipeline-class breaker —
+  exhaustion degrades one block to the stage-by-stage oracle twin and
+  the stream continues with exact state).  A guarded site may
   carry a *subsite* (``site@subsite`` plan entries — e.g.
-  ``serve.dispatch@stft``), so a chaos plan can poison ONE shape
+  ``serve.dispatch@stft``, or ``serve.dispatch@pipeline:sensor`` for
+  a served pipeline class), so a chaos plan can poison ONE shape
   class while its siblings stay healthy.  A plan may also be a
   **phase schedule** — ``label=entries;label=entries;...`` — the
   chaos-campaign form (:mod:`tools.chaos`): :func:`set_fault_plan`

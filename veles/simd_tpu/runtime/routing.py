@@ -84,6 +84,7 @@ __all__ = [
     "tune_cache", "set_cache_path", "private_tune_cache",
     "tune_key_str", "pow2_bucket", "mesh_class", "device_kind",
     "env_truthy", "set_probe_timer", "probe_timer",
+    "pipeline_tune_geom",
 ]
 
 AUTOTUNE_ENV = "VELES_SIMD_AUTOTUNE"
@@ -305,6 +306,16 @@ def mesh_class(mesh, axis: str | None = None) -> str:
     up)."""
     body = "x".join(f"{k}{int(v)}" for k, v in dict(mesh.shape).items())
     return f"{body}@{axis}" if axis else body
+
+
+def pipeline_tune_geom(geom: dict) -> dict:
+    """Stamp a tune-class geometry as PIPELINE-compiled (``ctx=
+    "pipeline"``): a route winner measured for a standalone dispatch
+    amortizes per-call dispatch overhead the fused pipeline step never
+    pays, so pipeline-compiled selections key their own tune classes —
+    one stamp helper so the compiler and the pack tools can never
+    drift on the spelling."""
+    return {"ctx": "pipeline", **dict(geom)}
 
 
 def tune_key_str(fam: str, geom: dict) -> str:

@@ -63,6 +63,20 @@ Supported ops (``SUPPORTED_OPS``): ``resample_poly`` (params
 ``stft`` (``frame_length``/``hop``).  Each answers with the same
 numerics as its single-call twin; DEGRADED-mode answers are the NumPy
 oracle's (parity-tested, flagged ``degraded`` on the ticket).
+
+**Pipelines are first-class tenants too**: a compiled pipeline
+(:mod:`veles.simd_tpu.pipeline`) registered via
+:meth:`Server.register_pipeline` serves under op
+``"pipeline:<name>"`` — each request is one *pipeline invocation*
+(one block plus the stream's carried state in ``params["state"]``;
+the ticket's value is ``(out, new_state)``, threaded by the caller
+into the next invocation).  Invocations ride the SAME admission
+control, deadline batcher, and end-to-end deadlines as plain ops;
+dispatch is one fused step per batch through the pipeline's OWN
+per-pipeline-class breaker at ``pipeline.dispatch`` (a chaos plan
+poisons one class via ``pipeline.dispatch@<name>`` while plain-op
+traffic and sibling pipelines stay healthy), degrading to the
+stage-by-stage oracle twin with exact state continuity.
 """
 
 from __future__ import annotations
@@ -341,6 +355,7 @@ class Server:
         if self.workers < 1:
             raise ValueError("workers must be >= 1")
         self.donate = bool(donate)
+        self._pipelines: dict = {}
         self._threads: list = []
         self._stats_lock = threading.Lock()
         self._stats = {"submitted": 0, "completed": 0, "shed": 0,
@@ -383,6 +398,32 @@ class Server:
 
     _abandoned = False
 
+    def register_pipeline(self, name: str, compiled) -> str:
+        """Register a compiled pipeline
+        (:class:`veles.simd_tpu.pipeline.CompiledPipeline`) as a
+        servable unit; returns its op string ``"pipeline:<name>"``.
+        Requests under that op carry one ``compiled.block_len``-sample
+        block plus the stream's carried state (``params["state"]``,
+        None for a fresh stream) and are answered with ``(out,
+        new_state)``."""
+        from veles.simd_tpu.pipeline import CompiledPipeline
+
+        if not isinstance(compiled, CompiledPipeline):
+            raise TypeError("register_pipeline needs a "
+                            "CompiledPipeline (Pipeline.compile(...))")
+        name = str(name)
+        if not name or ":" in name:
+            raise ValueError(f"bad pipeline name {name!r}")
+        self._pipelines[name] = compiled
+        obs.record_decision("serve_pipeline", "registered",
+                            pipeline=name,
+                            block=compiled.block_len)
+        return f"pipeline:{name}"
+
+    def pipeline(self, name: str):
+        """The registered compiled pipeline, or KeyError."""
+        return self._pipelines[name]
+
     def __enter__(self) -> "Server":
         return self.start()
 
@@ -414,7 +455,15 @@ class Server:
         elif deadline_ms is not None:
             request = dataclasses.replace(request,
                                           deadline_ms=deadline_ms)
-        if request.op not in _OPS:
+        pipe = None
+        if request.op.startswith("pipeline:"):
+            pipe = self._pipelines.get(request.op.split(":", 1)[1])
+            if pipe is None:
+                raise ValueError(
+                    f"unregistered pipeline op {request.op!r} "
+                    f"(registered: "
+                    f"{sorted(self._pipelines) or 'none'})")
+        elif request.op not in _OPS:
             raise ValueError(
                 f"unsupported op {request.op!r} "
                 f"(supported: {', '.join(SUPPORTED_OPS)})")
@@ -424,8 +473,23 @@ class Server:
                 f"requests carry one 1-D signal, got shape "
                 f"{xarr.shape}")
         n = int(xarr.shape[0])
-        validate, _ = _OPS[request.op]
-        cparams, param_key = validate(request.params, n)
+        if pipe is not None:
+            if n != pipe.block_len:
+                raise ValueError(
+                    f"pipeline {request.op!r} invocations carry "
+                    f"exactly one {pipe.block_len}-sample block, "
+                    f"got {n}")
+            # the stream's carried state rides the params (None =
+            # fresh stream); validated NOW so a malformed state fails
+            # its own caller synchronously, never a co-batched stream
+            state = request.params.get("state")
+            if state is not None:
+                pipe.check_state(state)
+            cparams = {"state": state}
+            param_key = ()
+        else:
+            validate, _ = _OPS[request.op]
+            cparams, param_key = validate(request.params, n)
         if self._stopped:
             raise ServerClosed("server is stopped")
         ticket = Ticket(request.op, request.tenant)
@@ -445,7 +509,10 @@ class Server:
                     if dl_ms is not None and dl_ms > 0 else None)
         pend = _Pending(ticket, xarr, n, cparams, now,
                         deadline=deadline)
-        key = (request.op, param_key, bucket_length(n))
+        # a pipeline's block length IS its shape class (every
+        # invocation carries exactly one block — no pad-to-bucket)
+        key = (request.op, param_key,
+               n if pipe is not None else bucket_length(n))
         try:
             self._batcher.put(key, pend)
         except RuntimeError:
@@ -546,6 +613,9 @@ class Server:
                 obs.observe("serve.deadline_slack", slack, op=op)
                 if budget_s is None or slack < budget_s:
                     budget_s = slack
+        if op.startswith("pipeline:"):
+            self._run_pipeline_batch(op, batch, nb, budget_s)
+            return
         rows = len(batch)
         # row-pad to the power-of-two class so occupancy churn shares
         # compiled handles instead of minting one per batch size
@@ -558,20 +628,29 @@ class Server:
             ys, degraded = self._dispatch(op, key, xs, params,
                                           budget_s)
         ys = np.asarray(ys)
-        now = faults.monotonic()
         _, slicer = _OPS[op]
+        self._finish_batch(
+            op, batch,
+            lambda i, p: slicer(ys[i], p.n, p.params), degraded)
+
+    def _finish_batch(self, op: str, batch, value_for,
+                      degraded: bool) -> None:
+        """Complete every ticket + the shared batch accounting — ONE
+        home for the plain-op and pipeline batch paths.  ``value_for
+        (i, pending)`` builds row ``i``'s answer; it is called
+        per-row, not bulk-at-the-end, so a value-build failure midway
+        leaves the tally matching the tickets actually answered (the
+        worker's handler counts the rest as errors)."""
+        now = faults.monotonic()
         status = "degraded" if degraded else "ok"
+        rows = len(batch)
         for i, p in enumerate(batch):
             wait = now - p.enq
             obs.observe("serve.request_latency", wait, op=op)
-            p.ticket._complete(value=slicer(ys[i], p.n, p.params),
-                               status=status, wait_s=wait)
+            p.ticket._complete(value=value_for(i, p), status=status,
+                               wait_s=wait)
             self._release(p)
             obs.count("serve_completed", op=op, status=status)
-            # per-row, not bulk-at-the-end: a slicer failure midway
-            # must leave the tally matching the tickets actually
-            # answered (the worker's handler counts the rest as
-            # errors)
             with self._stats_lock:
                 self._stats["completed"] += 1
                 if degraded:
@@ -582,6 +661,34 @@ class Server:
         with self._stats_lock:
             self._stats["batches"] += 1
             self._stats["batched_requests"] += rows
+
+    def _run_pipeline_batch(self, op: str, batch, nb: int,
+                            budget_s: float | None) -> None:
+        """One batch of PIPELINE invocations: stack blocks + carried
+        states into one fused step dispatch through the pipeline's
+        own per-class breaker (``pipeline.dispatch``), then hand each
+        stream back its ``(out, new_state)``.  Rides the same
+        admission/deadline machinery as plain ops; degradation is the
+        stage-by-stage oracle twin, so a degraded block keeps the
+        stream's state exact."""
+        compiled = self._pipelines[op.split(":", 1)[1]]
+        rows = len(batch)
+        rpad = bucket_length(rows)
+        xs = np.zeros((rpad, nb), np.float32)
+        for i, p in enumerate(batch):
+            xs[i] = p.x
+        states = compiled.batch_states(
+            [p.params.get("state") for p in batch], rpad)
+        with obs.span("serve.dispatch", op=op, rows=rpad, n=nb):
+            out, new_state, degraded = compiled.serve_step(
+                xs, states, budget_s=budget_s)
+        if degraded:
+            obs.count("serve_degraded_batch", op=op)
+        outs = compiled.out_rows(out, rows)
+        state_rows = compiled.state_rows(new_state, rows)
+        self._finish_batch(
+            op, batch, lambda i, p: (outs[i], state_rows[i]),
+            degraded)
 
     def _dispatch(self, op: str, key, xs, params: dict,
                   budget_s: float | None = None) -> tuple:
@@ -656,7 +763,9 @@ class Server:
             "batcher": self._batcher.snapshot(),
             "health": self._health.snapshot(),
             "breakers": [b for b in _breaker.snapshot()
-                         if b["site"] == "serve.dispatch"],
+                         if b["site"] in ("serve.dispatch",
+                                          "pipeline.dispatch")],
+            "pipelines": sorted(self._pipelines),
             "dispatch_quantiles": obs.quantiles(
                 "span.serve.dispatch", phase="steady"),
         }
