@@ -7,8 +7,8 @@ PYTHON ?= python
 
 .PHONY: all tests tests-quick benchmarks bench bench-regress \
         bench-multichip bench-serve serve-smoke chaos-smoke cshim \
-        cshim-check wavelet-tables lint docs obs-report autotune-pack \
-        install install-hooks clean
+        cshim-check wavelet-tables lint docs obs-report obs-dash \
+        autotune-pack install install-hooks clean
 
 all: cshim
 
@@ -87,6 +87,12 @@ docs:
 SNAPSHOT ?= BENCH_DETAILS.json
 obs-report:
 	$(PYTHON) tools/obs_report.py $(SNAPSHOT)
+
+# live dashboard against a serving process's scrape endpoint
+# (obs/http.py, armed via VELES_SIMD_OBS_PORT or Server(obs_port=...));
+# override with OBS_PORT=9100 or pass --url via tools/obs_dash.py
+obs-dash:
+	$(PYTHON) tools/obs_dash.py $(if $(OBS_PORT),--port $(OBS_PORT),)
 
 # build the pre-warmed autotune pack: measure every routed family's
 # candidates on THIS device and persist the winners so production

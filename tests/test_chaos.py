@@ -71,8 +71,29 @@ def test_every_invariant_holds(campaign):
                 "pipeline_breaker_closed_at_end",
                 "pipeline_degraded_then_served",
                 "plain_ok_during_pipeline_poison",
-                "health_degraded_then_healthy"):
+                "health_degraded_then_healthy",
+                # the request axis (obs v4)
+                "zero_orphaned_traces",
+                "trace_phases_sum_to_total",
+                "degraded_tickets_have_degrade_edge",
+                "scrape_live_mid_campaign",
+                "slo_gauges_exported"):
         assert key in tail["chaos_invariants"]
+
+
+def test_request_axis_evidence_in_tail(campaign):
+    """The campaign's evidence tail carries the request-axis story:
+    the mid-campaign scrape served all three routes, traces were
+    checked in volume, and per-tenant SLO accounts accumulated."""
+    _, _, entries = campaign
+    tail = entries[-1]
+    scrape = tail["scrape_mid_campaign"]
+    assert scrape["ok"] == 3 and scrape["failed"] == 0
+    assert set(scrape["routes"]) == {"/metrics", "/healthz",
+                                     "/debug/requests"}
+    axis = tail["request_axis"]
+    assert axis["finished"] > 0 and axis["open"] == 0
+    assert tail["slo"]["accounts"]
 
 
 def test_details_rows_are_bench_format(campaign):

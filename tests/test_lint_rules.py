@@ -632,6 +632,97 @@ def peek():
 
 
 # ---------------------------------------------------------------------------
+# the request-trace rule (obs v4): terminal request accounting in
+# serve//pipeline/ must flow through the request-trace API — a
+# hand-rolled obs.count/observe of the terminal metrics drifts
+# ---------------------------------------------------------------------------
+
+TRACE_HAND_ROLLED_COUNT = '''
+from veles.simd_tpu import obs
+
+
+def finish(op, status):
+    obs.count("serve_completed", op=op, status=status)
+'''
+
+TRACE_HAND_ROLLED_OBSERVE = '''
+from veles.simd_tpu import obs
+
+
+def finish(op, wait):
+    obs.observe("serve.request_latency", wait, op=op)
+'''
+
+TRACE_HAND_ROLLED_MISS = '''
+from veles.simd_tpu import obs
+
+
+def expire(op, tenant):
+    obs.count("serve_deadline_miss", op=op, tenant=tenant)
+'''
+
+TRACE_ALIAS_DODGE = '''
+from veles.simd_tpu import obs as _o
+
+
+def finish(op, status):
+    _o.count("serve_completed", op=op, status=status)
+'''
+
+TRACE_CLEAN = '''
+from veles.simd_tpu import obs
+
+
+def submit(op, tenant):
+    trace = obs.request_trace(op, tenant=tenant)
+    obs.count("serve_submitted", op=op, tenant=tenant)
+    return trace
+
+
+def finish(trace, status):
+    trace.finish(status)
+'''
+
+
+def _trace_errs(src):
+    return lint.request_trace_errors(ast.parse(src), "mod.py")
+
+
+def test_request_trace_rule_flags_terminal_count():
+    errs = _trace_errs(TRACE_HAND_ROLLED_COUNT)
+    assert any("request-trace API" in e for e in errs)
+
+
+def test_request_trace_rule_flags_terminal_observe():
+    errs = _trace_errs(TRACE_HAND_ROLLED_OBSERVE)
+    assert any("serve.request_latency" in e for e in errs)
+
+
+def test_request_trace_rule_flags_deadline_miss_count():
+    errs = _trace_errs(TRACE_HAND_ROLLED_MISS)
+    assert any("serve_deadline_miss" in e for e in errs)
+
+
+def test_request_trace_rule_tracks_obs_alias():
+    errs = _trace_errs(TRACE_ALIAS_DODGE)
+    assert any("request-trace API" in e for e in errs)
+
+
+def test_request_trace_rule_passes_trace_api_and_nonterminal():
+    assert _trace_errs(TRACE_CLEAN) == []
+
+
+def test_real_serve_and_pipeline_pass_request_trace_rule():
+    for pkg in ("serve", "pipeline"):
+        pkg_dir = REPO / "veles" / "simd_tpu" / pkg
+        files = sorted(pkg_dir.glob("*.py"))
+        assert files, f"{pkg} package missing?"
+        for f in files:
+            tree = ast.parse(f.read_text(), str(f))
+            assert lint.request_trace_errors(tree, str(f)) == [], f
+
+
+# ---------------------------------------------------------------------------
 # the sharded-dispatch rule (PR 10): instrumented shard_map programs in
 # parallel/ops.py must dispatch inside faults.guarded thunks
 # ---------------------------------------------------------------------------

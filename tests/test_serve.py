@@ -394,6 +394,41 @@ class TestChaosGate:
 # loadgen bench-row surface (what `make bench-serve` gates on)
 # ---------------------------------------------------------------------------
 
+def test_latency_histogram_has_no_survivorship_bias(telemetry):
+    """obs v4 satellite: shed and expired requests land in
+    serve.request_latency with their own status labels — p99 can no
+    longer understate tail pain by only counting batch-completed
+    requests."""
+    with serve.Server(max_batch=8, max_wait_ms=200.0, workers=1,
+                      queue_depth=64) as srv:
+        ok = srv.submit(serve.Request("sosfilt", _signal(256),
+                                      {"sos": SOS}))
+        ok.result(timeout=30.0)
+        expired = srv.submit(serve.Request("sosfilt", _signal(256),
+                                           {"sos": SOS}),
+                             deadline_ms=1e-4)
+        with pytest.raises(serve.DeadlineExceeded):
+            expired.result(timeout=30.0)
+    with faults.fault_plan("serve.admission:overload:1"):
+        with serve.Server(max_batch=8, max_wait_ms=1.0,
+                          workers=1) as srv:
+            shed = srv.submit(serve.Request("sosfilt", _signal(256),
+                                            {"sos": SOS}))
+            assert shed.status == "shed"
+    by_status = {h["labels"]["status"]: h["count"]
+                 for h in obs.snapshot()["histograms"]
+                 if h["name"] == "serve.request_latency"
+                 and h["labels"].get("op") == "sosfilt"}
+    assert by_status.get("ok", 0) >= 1
+    assert by_status.get("expired", 0) == 1
+    assert by_status.get("shed", 0) == 1
+    # the counter twin carries the same status axis
+    assert obs.counter_value("serve_completed", op="sosfilt",
+                             status="expired") == 1
+    assert obs.counter_value("serve_completed", op="sosfilt",
+                             status="shed") == 1
+
+
 def test_loadgen_bench_rows_shape(telemetry):
     report = {"throughput_rps": 123.4, "wait_p99_s": 0.02}
     rows = loadgen.bench_rows(report)

@@ -92,6 +92,13 @@ DEFAULT_NOISE = [
     # the inverse-p99 row is a single order statistic
     ("serve", 0.35),
     ("serve p99", 0.40),
+    # the tracing-overhead row is a throughput RATIO near 1.0 (traced
+    # over untraced loadgen runs): the 5% threshold IS the obs-v4
+    # overhead budget — request tracing + the scrape endpoint must
+    # stay under 5% of serving throughput (narrower than the raw
+    # serve rows because dividing the two runs cancels shared host
+    # jitter)
+    ("tracing overhead", 0.05),
     # the chaos family (tools/chaos.py --details CHAOS_DETAILS.json):
     # wall-clock throughput of a seconds-long scripted campaign whose
     # phases deliberately inject faults — the noisiest rows we gate —

@@ -40,7 +40,14 @@ Invariants asserted (rc=1 on any failure):
   re-enabled after recovery;
 * the poisoned PIPELINE class's breaker cycles and re-closes while
   plain-op traffic in that phase records zero degraded answers;
-* serve health walks DEGRADED -> HEALTHY.
+* serve health walks DEGRADED -> HEALTHY;
+* the REQUEST AXIS stays complete under fire (obs v4): every completed
+  ticket in every phase carries a causal trace whose terminal status
+  matches the ticket and whose phase latencies sum to its total, every
+  degraded ticket carries a ``degraded`` edge, per-tenant SLO burn
+  gauges are exported, and the live scrape endpoint
+  (``/metrics`` + ``/healthz`` + ``/debug/requests``) answers
+  MID-CAMPAIGN with a poisoned class and injection active.
 
 The evidence — decision events, breaker/fault/serve counters, and the
 ``veles_simd_breaker_*``/``veles_simd_mesh_*`` Prometheus lines — is
@@ -116,12 +123,13 @@ def _run_serial(server, items, timeout: float) -> dict:
 
 
 def _merge_reports(reports: list) -> dict:
-    """Sum the accounting categories across phase reports."""
+    """Sum the accounting categories across phase reports (request
+    outcomes AND the request-axis trace-completeness categories)."""
     total: dict = {}
     for rep in reports:
         for k in ("requests", "ok", "degraded", "shed", "closed",
                   "errors", "lost", "deadline_miss",
-                  "parity_failures"):
+                  "parity_failures") + loadgen.TRACE_KEYS:
             total[k] = total.get(k, 0) + rep.get(k, 0)
     total["double_answered"] = (obs.counter_value(
         "serve_double_answer") if obs.enabled() else 0)
@@ -173,10 +181,18 @@ def run_campaign(args) -> tuple:
     mesh_bad = 0
     retry_steady = None
     plain_degraded_during_pipe = None
+    scrape_mid = None
     try:
+        # endpoint armed on an ephemeral port: the campaign proves it
+        # serves live data MID-CAMPAIGN, faults active
         server = serve.Server(max_batch=4, max_wait_ms=5.0,
-                              workers=args.workers, probe_every=2)
+                              workers=args.workers, probe_every=2,
+                              obs_port=0)
         compiled = loadgen.build_pipeline(PIPE_NAME)
+        # per-tenant SLOs so burn-rate gauges export under chaos (the
+        # campaign gates that the gauges EXIST, not a latency number)
+        for tenant in loadgen.DEFAULT_TENANTS + ("chaos",):
+            obs.slo(tenant, target_ms=60000.0, hit_rate=0.99)
         with server:
             pipe_op = server.register_pipeline(PIPE_NAME, compiled)
             # -- phase 1: baseline ------------------------------------
@@ -263,6 +279,9 @@ def run_campaign(args) -> tuple:
                     deadline_ms=args.deadline_ms),
                 verify=args.verify, rng=rng,
                 result_timeout=args.result_timeout)
+            # the live-endpoint proof, at the campaign's worst moment:
+            # a poisoned class, an open breaker, injection active
+            scrape_mid = loadgen.scrape_endpoint(server.obs_port)
             mesh_bad += _mesh_calls(mesh, args.mesh_loss_calls,
                                     a, b, want)
             rep = _merge_reports([warm, steady, mixed])
@@ -381,6 +400,23 @@ def run_campaign(args) -> tuple:
                               + total["deadline_miss"]
                               + total["closed"] + total["errors"]
                               == total["requests"]),
+        # the request axis (obs v4): every completed ticket across
+        # every phase carried a complete causal chain...
+        "zero_orphaned_traces": (total["trace_checked"] > 0
+                                 and total["trace_orphans"] == 0),
+        # ...whose phase latencies sum to its total...
+        "trace_phases_sum_to_total": total["trace_phase_err"] == 0,
+        # ...and every degraded ticket carries a degrade edge
+        "degraded_tickets_have_degrade_edge":
+            total["trace_degraded_missing_edge"] == 0,
+        # the scrape endpoint served all three routes mid-campaign
+        "scrape_live_mid_campaign": (
+            scrape_mid is not None and scrape_mid["ok"] == 3
+            and scrape_mid["failed"] == 0),
+        # per-tenant SLO burn gauges exported under chaos
+        "slo_gauges_exported": any(
+            g["name"] == "slo_burn_rate"
+            for g in obs.snapshot()["gauges"]),
     }
 
     # -- CHAOS_DETAILS rows + evidence tail ---------------------------
@@ -439,6 +475,9 @@ def run_campaign(args) -> tuple:
         "plain_degraded_during_pipeline_poison":
             plain_degraded_during_pipe,
         "pipeline_breaker_transitions": pipe_transitions,
+        "scrape_mid_campaign": scrape_mid,
+        "request_axis": obs.request_summary(),
+        "slo": obs.slo_snapshot(),
     }
     return invariants, rows, evidence
 

@@ -64,6 +64,8 @@ MODULES = [
     "veles.simd_tpu.obs",
     "veles.simd_tpu.obs.spans",
     "veles.simd_tpu.obs.resources",
+    "veles.simd_tpu.obs.requests",
+    "veles.simd_tpu.obs.http",
     "veles.simd_tpu.obs.flightrec",
     "veles.simd_tpu.cshim",
     # the chaos-campaign runner is a tool, not a library module, but

@@ -31,7 +31,19 @@ import sys
 import threading
 
 __all__ = ["start", "merge", "executable_lines", "table",
-           "aggregate_pct"]
+           "aggregate_pct", "DEFAULT_FLOORS"]
+
+# the gated scopes: repo-relative directory -> minimum aggregate line
+# coverage % (consumed by tools/run_tests.py; CLI flags override).
+# obs/ is pure host-side Python (untested lines there are plain
+# negligence — VERDICT item 6); serve/ is the production request path
+# whose failure handling is exactly the code that only runs when
+# things go wrong, so untraced lines there are untested outage
+# behavior.
+DEFAULT_FLOORS = {
+    "veles/simd_tpu/obs": 60.0,
+    "veles/simd_tpu/serve": 60.0,
+}
 
 
 def start(prefix: str, out_path: str) -> None:

@@ -493,7 +493,9 @@ def spectral_dispatch_errors(tree, fname) -> list:
 _SERVE_RULE_DIR = "veles/simd_tpu/serve"
 _BATCHED_MOD = "veles.simd_tpu.ops.batched"
 _SERVE_OBS_HELPERS = {"span", "count", "gauge", "observe",
-                      "record_decision", "quantiles"}
+                      "record_decision", "quantiles",
+                      "request_trace", "request_summary",
+                      "slo_snapshot"}
 
 
 def _serve_aliases(tree) -> tuple:
@@ -673,6 +675,52 @@ def serve_layer_errors(tree, fname) -> list:
             f"{fname}: serve module dispatches ops but never records "
             "via obs (span/count/gauge/observe/record_decision) — an "
             "unobservable serving loop")
+    return errors
+
+
+# --- request-trace rule (serve/ + pipeline/) --------------------------------
+# obs v4 moved terminal request accounting into the request-trace API
+# (veles/simd_tpu/obs/requests.py): Ticket._complete -> trace.finish
+# is the ONE place that records serve.request_latency{op, status},
+# serve_completed, and serve_deadline_miss — so every terminal outcome
+# (answered, degraded, shed, expired, closed, error) lands in the same
+# latency distribution with a complete causal chain attached.  This
+# rule keeps a second, hand-rolled accounting path from reappearing in
+# serve//pipeline/: an obs.count/obs.observe call naming one of the
+# terminal metrics directly is a lint failure — counters minted beside
+# the trace drift from it (the pre-v4 survivorship bias was exactly
+# such a drift: batch-completed requests counted, shed/expired ones
+# invisible).  Alias-tracked like every other rule.
+
+_TERMINAL_METRICS = {"serve_completed", "serve_deadline_miss",
+                     "serve.request_latency"}
+
+
+def request_trace_errors(tree, fname) -> list:
+    """The rule body on a parsed module (separated so tests can feed
+    synthetic sources).  Returns human-readable error strings."""
+    errors = []
+    obs_names = _serve_aliases(tree)[5]
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in ("count", "observe")
+                and isinstance(f.value, ast.Name)
+                and f.value.id in obs_names):
+            continue
+        name_arg = node.args[0] if node.args else None
+        if (isinstance(name_arg, ast.Constant)
+                and name_arg.value in _TERMINAL_METRICS):
+            errors.append(
+                f"{fname}:{node.lineno}: hand-rolled terminal request "
+                f"accounting (obs.{f.attr}({name_arg.value!r}, ...)) "
+                "in a serve/pipeline module — terminal outcomes flow "
+                "through the request-trace API "
+                "(Ticket._complete -> trace.finish, "
+                "veles/simd_tpu/obs/requests.py), which owns these "
+                "metrics and cannot drift from the trace")
     return errors
 
 
@@ -1042,6 +1090,9 @@ def compute_module_lint(files) -> int:
             for msg in serve_layer_errors(tree, str(f)):
                 print(msg)
                 failures += 1
+            for msg in request_trace_errors(tree, str(f)):
+                print(msg)
+                failures += 1
             continue
         if in_pipeline:
             # the pipeline package takes its own structural contract
@@ -1050,6 +1101,9 @@ def compute_module_lint(files) -> int:
                 print(msg)
                 failures += 1
             for msg in pipeline_guard_errors(tree, str(f)):
+                print(msg)
+                failures += 1
+            for msg in request_trace_errors(tree, str(f)):
                 print(msg)
                 failures += 1
         if rel in _DISPATCH_RULE_FILES:

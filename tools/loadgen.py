@@ -7,6 +7,20 @@ accounts for every request: answered-ok, answered-degraded, shed
 (typed Overloaded), errored, LOST (never answered — always a bug), and
 double-answered (the ticket layer raises + counts; always a bug).
 
+With telemetry on it ALSO gates the request axis (obs v4): every
+completed ticket must carry a complete causal trace whose terminal
+status matches the ticket, whose events are monotonic, and whose
+phase latencies (queue wait / batch wait / device) sum to its total
+within :data:`TRACE_TOL_S`; every degraded ticket must carry a
+``degraded`` edge.  Violations land in the report
+(``trace_orphans`` / ``trace_phase_err`` /
+``trace_degraded_missing_edge``) and fail the run like a lost
+request.  Each run with the scrape endpoint armed also hits
+``/metrics`` + ``/healthz`` + ``/debug/requests`` on the live server
+— the endpoint must serve under load — and ``--details`` mode adds a
+tracing-overhead row (traced/untraced throughput, gated <5% via
+``bench_regress``).
+
 Three consumers:
 
 * **tests** (``tests/test_serve.py``) import :func:`build_schedule` /
@@ -74,6 +88,88 @@ def _mix():
 
 DEFAULT_TENANTS = ("alice", "bob", "carol")
 
+# phase latencies must sum to the trace total within this (the ISSUE
+# contract; in practice the phases are derived from the same event
+# stamps, so the sum is exact and any slack here is pure safety)
+TRACE_TOL_S = 1e-3
+
+# the trace-completeness accounting categories (merged across phase
+# reports by tools/chaos.py like the request categories)
+TRACE_KEYS = ("trace_checked", "trace_orphans", "trace_phase_err",
+              "trace_degraded_missing_edge")
+
+
+def trace_failures(ticket) -> dict:
+    """Request-axis completeness check for one COMPLETED ticket:
+    ``trace_orphans`` (no trace, no terminal edge, or a terminal
+    status disagreeing with the ticket — the causal chain never
+    closed), ``trace_phase_err`` (phases do not sum to the total
+    within :data:`TRACE_TOL_S`, or event times are non-monotonic),
+    and ``trace_degraded_missing_edge`` (a degraded answer without a
+    ``degraded`` edge).  All zero when telemetry is off (the shared
+    null trace has nothing to check)."""
+    out = dict.fromkeys(TRACE_KEYS, 0)
+    tr = getattr(ticket, "trace", None)
+    if tr is None or tr.rid < 0:
+        return out      # telemetry off: no request axis to gate
+    out["trace_checked"] = 1
+    phases = tr.phases()
+    if tr.status != ticket.status or not phases:
+        out["trace_orphans"] = 1
+        return out
+    drift = abs(phases["queue_wait_s"] + phases["batch_wait_s"]
+                + phases["device_s"] - phases["total_s"])
+    stamps = [e["t_s"] for e in tr.events()]
+    if drift > TRACE_TOL_S or stamps != sorted(stamps):
+        out["trace_phase_err"] = 1
+    if ticket.status == "degraded" and not any(
+            e["event"] == "degraded" for e in tr.events()):
+        out["trace_degraded_missing_edge"] = 1
+    return out
+
+
+def _account_traces(report: dict, tickets) -> None:
+    """Fold per-ticket trace checks into ``report`` (completed
+    tickets only — a LOST ticket is already its own failure)."""
+    for k in TRACE_KEYS:
+        report.setdefault(k, 0)
+    for t in tickets:
+        if not t.done():
+            continue
+        for k, v in trace_failures(t).items():
+            report[k] += v
+
+
+def scrape_endpoint(port: int | None) -> dict:
+    """Hit the live scrape endpoint once (all three routes) and
+    report per-route success — the serves-under-load proof every
+    loadgen run performs while the server is hot."""
+    import urllib.error
+    import urllib.request
+
+    out = {"port": port, "ok": 0, "failed": 0, "routes": {}}
+    if port is None:
+        return out
+    for path in ("/metrics", "/healthz", "/debug/requests"):
+        url = f"http://127.0.0.1:{port}{path}"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                status, body = r.status, r.read()
+        except urllib.error.HTTPError as e:
+            # a non-2xx answer IS an answer: /healthz returns 503
+            # while the health machine is DEGRADED — exactly when the
+            # chaos campaign scrapes — and urlopen surfaces that as
+            # HTTPError, not as a response
+            status, body = e.code, e.read()
+        except Exception as e:  # noqa: BLE001 — reported, gated below
+            out["routes"][path] = f"error: {e!r}"
+            out["failed"] += 1
+            continue
+        good = status in (200, 503) and bool(body)
+        out["routes"][path] = f"{status} ({len(body)} bytes)"
+        out["ok" if good else "failed"] += 1
+    return out
+
 # the pipeline-invocation traffic leg: a small compiled chain served
 # as a first-class unit (op "pipeline:<name>"), each stream threading
 # its carried state through consecutive invocations
@@ -119,6 +215,7 @@ def run_pipeline_streams(server, op: str, compiled, rng, *,
     states = {i: None for i in range(streams)}
     outs: dict = {i: [] for i in range(streams)}
     alive = set(range(streams))
+    all_tickets = []
     for b in range(blocks):
         tickets = {}
         for i in sorted(alive):
@@ -127,6 +224,7 @@ def run_pipeline_streams(server, op: str, compiled, rng, *,
                 params={"state": states[i]}, tenant=f"pstream{i}",
                 deadline_ms=deadline_ms)
         report["requests"] += len(tickets)
+        all_tickets.extend(tickets.values())
         for i, t in tickets.items():
             try:
                 value = t.result(timeout=result_timeout)
@@ -164,6 +262,7 @@ def run_pipeline_streams(server, op: str, compiled, rng, *,
             scale = float(np.max(np.abs(want))) or 1.0
             if float(np.max(np.abs(got - want)) / scale) > 2e-3:
                 report["parity_failures"] += 1
+    _account_traces(report, all_tickets)
     report["double_answered"] = obs.counter_value(
         "serve_double_answer") if obs.enabled() else 0
     return report
@@ -271,6 +370,7 @@ def run_load(server, schedule, *, block: bool = False,
         if ticket.wait_s is not None:
             waits.append(ticket.wait_s)
     report["wall_s"] = time.perf_counter() - t0
+    _account_traces(report, [t for _, t in pairs])
     # per-tenant fairness under overload: the max/min ANSWERED RATIO
     # (answered[t] / submitted[t] — raw counts would read random
     # arrival imbalance as unfairness) across tenants.  max/min is
@@ -360,6 +460,81 @@ def bench_rows(report: dict) -> list:
     return rows
 
 
+def _overhead_schedule(n: int, rng) -> list:
+    """A SINGLE shape class (sosfilt @ 512), so the probe compiles
+    exactly one handle: the mixed-traffic matrix's random row-padding
+    classes compile lazily mid-measurement (seconds per XLA compile on
+    CPU), which would drown a <5% per-request effect in warmup
+    asymmetry."""
+    return [(0.0, serve.Request("sosfilt",
+                                rng.randn(512).astype(np.float32),
+                                {"sos": _sos()}, tenant="bench"))
+            for _ in range(n)]
+
+
+def overhead_row(args, rng) -> dict:
+    """The tracing-overhead bench row: one warmed shape class at
+    ``max_batch=1`` (every request its own batch — the dispatch-bound
+    regime where per-request tracing cost is largest, i.e. the honest
+    worst case) through ONE live server, telemetry enabled
+    throughout, alternating mini-bursts with the REQUEST AXIS armed
+    vs disarmed (``obs.configure(request_axis=...)`` — exactly the
+    obs-v4 delta: trace minting, lifecycle edges, terminal
+    accounting, SLO updates, exemplar retention; the scrape endpoint
+    stays armed on both sides, idle listeners are free) and pooling
+    each mode's wall time.  The fine interleave cancels host drift
+    that run-sized A/B pairs cannot (r05's lesson: wall-clock
+    throughput on a shared host swings 2x in seconds).  Value =
+    pooled traced/untraced throughput (1.0 = the request axis is
+    free); ``bench_regress`` gates the row at 5% noise
+    (``DEFAULT_NOISE``) — the obs-v4 overhead budget."""
+    n = int(args.overhead_requests)
+    bursts = 10
+    m = max(10, n // (bursts // 2))
+    wall = {True: 0.0, False: 0.0}
+    done = {True: 0, False: 0}
+    try:
+        obs.enable()
+        srv = serve.Server(max_batch=1, max_wait_ms=0.5,
+                           workers=args.workers,
+                           queue_depth=max(1024, m),
+                           tenant_depth=max(1024, m), obs_port=0)
+        with srv:
+            # warm BOTH modes: the first bursts compile the handle,
+            # pay the one-time per-(op, route) cost_analysis harvest,
+            # and allocate the first span/histogram classes — all
+            # one-offs, none of them the steady-state cost this row
+            # budgets
+            for warm in (False, True):
+                obs.configure(request_axis=warm)
+                run_load(srv, _overhead_schedule(m, rng), verify=0)
+            for k in range(bursts):
+                traced = bool(k % 2)
+                obs.configure(request_axis=traced)
+                rep = run_load(srv, _overhead_schedule(m, rng),
+                               verify=0)
+                wall[traced] += rep["wall_s"]
+                done[traced] += rep["ok"] + rep["degraded"]
+            scrape_endpoint(srv.obs_port)
+    finally:
+        obs.configure(request_axis=True)
+    rates = {mode: (done[mode] / wall[mode] if wall[mode] else None)
+             for mode in (True, False)}
+    ratio = (rates[True] / rates[False]
+             if rates[True] and rates[False] else None)
+    return {"metric": "serve tracing overhead",
+            "value": round(ratio, 4) if ratio is not None else None,
+            "unit": "traced/untraced throughput",
+            "vs_baseline": None,
+            "telemetry": {
+                "traced_rps": (round(rates[True], 1)
+                               if rates[True] else None),
+                "untraced_rps": (round(rates[False], 1)
+                                 if rates[False] else None),
+                "bursts": bursts, "burst_requests": m,
+            }}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--requests", type=int, default=300)
@@ -389,6 +564,12 @@ def main(argv=None) -> int:
                          "(default: 2 in --smoke, else 0)")
     ap.add_argument("--pipeline-blocks", type=int, default=4,
                     help="invocations per pipeline stream")
+    ap.add_argument("--obs-port", type=int, default=0,
+                    help="scrape-endpoint port (0 = ephemeral, -1 = "
+                         "disarmed)")
+    ap.add_argument("--overhead-requests", type=int, default=600,
+                    help="requests per side of the tracing-overhead "
+                         "probe in --details mode (0 = skip)")
     args = ap.parse_args(argv)
 
     from veles.simd_tpu.utils.platform import maybe_override_platform
@@ -407,13 +588,22 @@ def main(argv=None) -> int:
                           max_wait_ms=args.max_wait_ms,
                           queue_depth=args.queue_depth,
                           tenant_depth=args.tenant_depth,
-                          workers=args.workers)
+                          workers=args.workers,
+                          obs_port=args.obs_port)
+    # per-tenant SLOs so the burn-rate gauges export under load (a
+    # generous latency target: the gate is that the accounting runs,
+    # not that a CPU smoke hits production latencies)
+    for tenant in DEFAULT_TENANTS:
+        obs.slo(tenant, target_ms=30000.0, hit_rate=0.99)
     pipeline_streams = args.pipeline_streams
     if pipeline_streams is None:
         pipeline_streams = 2 if args.smoke else 0
     with server:
         report = run_load(server, schedule, block=args.block,
                           verify=args.verify, rng=rng)
+        # the endpoint must serve while the server is hot — one hit
+        # of all three routes per run
+        report["scrape"] = scrape_endpoint(server.obs_port)
         if pipeline_streams > 0:
             compiled = build_pipeline()
             op = server.register_pipeline(PIPELINE_NAME, compiled)
@@ -424,24 +614,41 @@ def main(argv=None) -> int:
                 deadline_ms=args.deadline_ms)
             report["pipeline"] = prep
             # the global accounting gates cover the pipeline leg too
-            for k in ("lost", "parity_failures"):
+            for k in ("lost", "parity_failures", "trace_orphans",
+                      "trace_phase_err",
+                      "trace_degraded_missing_edge"):
                 report[k] += prep[k]
             report["double_answered"] = max(report["double_answered"],
                                             prep["double_answered"])
         report["health"] = server.stats()["health"]
+        report["slo"] = obs.slo_snapshot()
     report["dispatch_quantiles"] = obs.quantiles(
         "span.serve.dispatch", phase="steady")
+    rows = None
+    if args.details:
+        rows = bench_rows(report)
+        if args.overhead_requests > 0:
+            rows.append(overhead_row(args, rng))
     print(json.dumps(report, indent=2, default=str))
     if args.details:
         with open(args.details, "w") as f:
-            json.dump(bench_rows(report), f, indent=2)
+            json.dump(rows, f, indent=2)
         print(f"loadgen: wrote {args.details}", file=sys.stderr)
     bad = (report["lost"] or report["double_answered"]
-           or report["parity_failures"])
+           or report["parity_failures"] or report["trace_orphans"]
+           or report["trace_phase_err"]
+           or report["trace_degraded_missing_edge"]
+           or report["scrape"]["failed"])
     if bad:
         print(f"loadgen: FAILED accounting (lost={report['lost']} "
               f"double={report['double_answered']} "
-              f"parity={report['parity_failures']})", file=sys.stderr)
+              f"parity={report['parity_failures']} "
+              f"trace_orphans={report['trace_orphans']} "
+              f"trace_phase_err={report['trace_phase_err']} "
+              f"degraded_missing_edge="
+              f"{report['trace_degraded_missing_edge']} "
+              f"scrape_failed={report['scrape']['failed']})",
+              file=sys.stderr)
         return 1
     return 0
 

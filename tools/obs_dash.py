@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Live dashboard for a serving process's scrape endpoint.
+
+The terminal twin of ``tools/obs_report.py``: where obs_report renders
+a SAVED snapshot, this hits a LIVE endpoint
+(:mod:`veles.simd_tpu.obs.http`, armed via ``$VELES_SIMD_OBS_PORT`` or
+``serve.Server(obs_port=...)``) and renders one compact screen from
+its three routes:
+
+* ``/healthz`` — health state (the HTTP code alone says
+  healthy/degraded), breaker registry, admission depths, batcher
+  classes;
+* ``/metrics`` — the serving counters/gauges that matter at a glance
+  (submitted/completed by status, sheds, deadline misses, queue
+  depths, SLO burn rates), parsed from the Prometheus text;
+* ``/debug/requests`` — the request axis: per-status tallies, the
+  slowest-per-op exemplars with their phase decomposition, recent
+  degraded traces.
+
+One shot by default; ``--watch N`` redraws every N seconds until
+interrupted.  rc=1 when the endpoint is unreachable — the dashboard
+doubles as a liveness probe in scripts.
+
+Usage::
+
+    python tools/obs_dash.py --port 9100
+    python tools/obs_dash.py --url http://127.0.0.1:9100 --watch 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from veles.simd_tpu.obs import export  # noqa: E402
+
+
+def fetch(url: str, timeout: float = 10.0) -> tuple:
+    """``(status_code, body_text)`` — HTTP errors are still answers
+    (503 from /healthz means DEGRADED, not unreachable)."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode("utf-8", "replace")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8", "replace")
+
+
+def _fmt_s(v) -> str:
+    return "-" if v is None else "%.1e" % v
+
+
+def _metric_lines(prom: str) -> list:
+    """The at-a-glance serving rows out of a /metrics scrape."""
+    parsed = export.parse_prometheus(prom)
+    rows = []
+    for (name, labels), value in sorted(parsed.items()):
+        short = name.replace(export.PROMETHEUS_PREFIX, "")
+        if not short.startswith(("serve_submitted", "serve_completed",
+                                 "serve_shed", "serve_deadline_miss",
+                                 "serve_queue_depth",
+                                 "serve_degraded_batch",
+                                 "slo_burn_rate", "slo_hit_rate")):
+            continue
+        if short.endswith(("_bucket", "_sum", "_count")):
+            continue
+        lab = ",".join("%s=%s" % kv for kv in labels)
+        rows.append("  %-52s %12g"
+                    % (short + ("{%s}" % lab if lab else ""), value))
+    return rows
+
+
+def render(base_url: str) -> tuple:
+    """One dashboard frame; returns ``(text, reachable)``."""
+    lines = [f"== obs dash @ {base_url} =="]
+    try:
+        code, health = fetch(base_url + "/healthz")
+    except Exception as e:  # noqa: BLE001 — unreachable is the answer
+        return (f"{lines[0]}\nendpoint unreachable: {e!r}\n", False)
+    state = "HEALTHY" if code == 200 else \
+        ("DEGRADED" if code == 503 else f"HTTP {code}")
+    lines.append(f"health: {state}")
+    try:
+        h = json.loads(health)
+        counts = h.get("counts", {})
+        if counts:
+            lines.append("  " + "  ".join(
+                "%s=%s" % kv for kv in sorted(counts.items())))
+        for b in h.get("breakers", []):
+            lines.append("  breaker %-44s %s"
+                         % (b.get("key"), b.get("state")))
+        adm = h.get("admission", {})
+        if adm:
+            lines.append("  queue %s/%s  tenants %s"
+                         % (adm.get("depth"), adm.get("max_depth"),
+                            adm.get("tenants")))
+    except ValueError:
+        lines.append("  (unparseable /healthz body)")
+    # the server can die between fetches (that is what a liveness
+    # probe is for): any later-route failure degrades to the same
+    # graceful unreachable answer instead of a traceback
+    try:
+        _, prom = fetch(base_url + "/metrics")
+        _, reqs = fetch(base_url + "/debug/requests")
+    except Exception as e:  # noqa: BLE001 — unreachable is the answer
+        lines.append(f"endpoint lost mid-scrape: {e!r}")
+        return "\n".join(lines) + "\n", False
+    rows = _metric_lines(prom)
+    if rows:
+        lines.append("metrics:")
+        lines += rows
+    try:
+        r = json.loads(reqs)
+        summary = r.get("summary", {})
+        lines.append("requests: " + "  ".join(
+            "%s=%s" % kv for kv in sorted(summary.items())
+            if kv[0] != "by_status"))
+        for status, n in sorted(
+                (summary.get("by_status") or {}).items()):
+            lines.append(f"  {status}={n}")
+        slowest = r.get("slowest_by_op", {})
+        if slowest:
+            lines.append("slowest by op (phases, s):")
+            for op, tr in sorted(slowest.items()):
+                p = tr.get("phases", {})
+                lines.append(
+                    "  %-16s rid=%-6s total=%s queue=%s batch=%s "
+                    "device=%s" % (
+                        op, tr.get("rid"), _fmt_s(p.get("total_s")),
+                        _fmt_s(p.get("queue_wait_s")),
+                        _fmt_s(p.get("batch_wait_s")),
+                        _fmt_s(p.get("device_s"))))
+        degraded = r.get("degraded", [])
+        if degraded:
+            lines.append(f"degraded exemplars ({len(degraded)}):")
+            for tr in degraded[-5:]:
+                lines.append(
+                    "  rid=%-6s %-14s tenant=%-10s events=%s" % (
+                        tr.get("rid"), tr.get("op"), tr.get("tenant"),
+                        ">".join(e.get("event", "?")
+                                 for e in tr.get("events", []))))
+    except ValueError:
+        lines.append("  (unparseable /debug/requests body)")
+    return "\n".join(lines) + "\n", True
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--url", default=None,
+                    help="endpoint base url (overrides --port)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="localhost port (default: "
+                         "$VELES_SIMD_OBS_PORT)")
+    ap.add_argument("--watch", type=float, default=0.0,
+                    help="redraw every N seconds (0 = one shot)")
+    args = ap.parse_args(argv)
+    base = args.url
+    if base is None:
+        port = args.port
+        if port is None:
+            from veles.simd_tpu.obs import http as obs_http
+
+            port = obs_http.env_port()
+        if port is None:
+            print("obs_dash: no endpoint (--url/--port/"
+                  "$VELES_SIMD_OBS_PORT)", file=sys.stderr)
+            return 2
+        base = f"http://127.0.0.1:{port}"
+    base = base.rstrip("/")
+    while True:
+        text, reachable = render(base)
+        sys.stdout.write(text)
+        sys.stdout.flush()
+        if not reachable:
+            return 1
+        if args.watch <= 0:
+            return 0
+        time.sleep(args.watch)
+        sys.stdout.write("\n")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

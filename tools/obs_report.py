@@ -6,10 +6,15 @@ Reads a JSON snapshot — either one written by ``obs.save(path)`` or a
 compact per-config telemetry dict) — and renders the human table the
 live ``obs.report()`` call would print, followed by a dispatch-latency
 section: per-op p50/p95/p99 from the ``span.*`` histograms, warmup
-(first call, incl. trace+compile) separated from steady-state.
-``--prometheus`` converts a full snapshot to the Prometheus text
-exposition format instead, so a file captured on a TPU host can be
-pushed through a gateway later.
+(first call, incl. trace+compile) separated from steady-state, and a
+Serving section when the snapshot carries ``serve_*`` metrics:
+queue/tenant depths, per-status outcome tallies with shed and
+deadline-miss rates, per-(op, status) request-latency quantiles,
+degraded-batch counts, latest breaker states, and the request-axis +
+per-tenant SLO summaries (BENCH_DETAILS mode gets the per-config
+``serve_*`` counter block).  ``--prometheus`` converts a full snapshot
+to the Prometheus text exposition format instead, so a file captured
+on a TPU host can be pushed through a gateway later.
 
 Usage:  python tools/obs_report.py SNAPSHOT.json
         python tools/obs_report.py --prometheus SNAPSHOT.json
@@ -60,6 +65,71 @@ def _latency_section(snap) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _serving_section(snap) -> str:
+    """The serving layer's story (obs v4): depths, outcome tallies
+    with shed/miss rates, per-(op, status) request-latency quantiles,
+    breaker states, and the request-axis + per-tenant SLO summaries."""
+    s = export.serving_summary(snap)
+    if s is None:
+        return ""
+    lines = ["", "serving:"]
+    if s["gauges"]:
+        lines.append("  " + "  ".join(
+            "%s=%g" % kv for kv in sorted(s["gauges"].items())
+            if not kv[0].startswith("slo_")))
+    outcome = "  ".join("%s=%d" % kv
+                        for kv in sorted(s["by_status"].items()))
+    lines.append("  submitted=%d  %s" % (s["submitted"], outcome))
+    rate = "-" if s["shed_rate"] is None else \
+        "%.1f%%" % (100 * s["shed_rate"])
+    mrate = "-" if s["deadline_miss_rate"] is None else \
+        "%.1f%%" % (100 * s["deadline_miss_rate"])
+    lines.append("  shed=%d (%s)  deadline_misses=%d (%s)  "
+                 "degraded_batches=%d  breaker_shed=%d"
+                 % (s["shed"], rate, s["deadline_misses"], mrate,
+                    s["degraded_batches"], s["breaker_shed"]))
+    if s["latency"]:
+        lines.append("  request latency by op/status (s):")
+        for key, q in s["latency"].items():
+            lines.append(
+                "    %-28s n=%-6d p50=%s p95=%s p99=%s"
+                % (key, q["count"], _fmt_s(q.get("p50")),
+                   _fmt_s(q.get("p95")), _fmt_s(q.get("p99"))))
+    if s["breaker_states"]:
+        lines.append("  breaker states (latest transition):")
+        for key, state in s["breaker_states"].items():
+            lines.append("    %-48s %s" % (key, state))
+    req = s.get("requests")
+    if req:
+        lines.append(
+            "  request axis: started=%s finished=%s open=%s  %s"
+            % (req.get("started"), req.get("finished"),
+               req.get("open"),
+               " ".join("%s=%s" % kv for kv in sorted(
+                   (req.get("by_status") or {}).items()))))
+    slo = s.get("slo") or {}
+    for tenant, acct in sorted((slo.get("accounts") or {}).items()):
+        lines.append(
+            "  slo %-12s requests=%-6d hit_rate=%s burn=%s%s"
+            % (tenant, acct.get("requests", 0),
+               acct.get("hit_rate_observed"), acct.get("burn_rate"),
+               "  BREACHED" if acct.get("breached") else ""))
+    return "\n".join(lines) + "\n"
+
+
+def _bench_serving_lines(counters: dict, indent="  ") -> list:
+    """The BENCH_DETAILS-mode serving block: a per-config tally of
+    the ``serve_*`` counters the telemetry dict embeds."""
+    serve = {k: v for k, v in sorted(counters.items())
+             if k.startswith(("serve_", "slo_"))}
+    if not serve:
+        return []
+    lines = [indent + "serving counters:"]
+    for k, v in serve.items():
+        lines.append("%s  %-56s %8d" % (indent, k, v))
+    return lines
+
+
 def _roofline_lines(roof, indent="  ") -> list:
     """Measured vs analytical roofline % for one bench entry."""
     if not roof:
@@ -99,6 +169,7 @@ def _render_bench_details(entries) -> str:
                          tel.get("events_dropped")))
         for k, v in sorted(tel.get("counters", {}).items()):
             lines.append("  %-60s %8d" % (k, v))
+        lines += _bench_serving_lines(tel.get("counters", {}))
         for d in tel.get("decisions", []):
             extras = ", ".join(
                 "%s=%s" % (k, v) for k, v in d.items()
@@ -143,6 +214,7 @@ def main(argv=None) -> int:
         return 0
     sys.stdout.write(export.report(data, max_events=50))
     sys.stdout.write(_latency_section(data))
+    sys.stdout.write(_serving_section(data))
     return 0
 
 

@@ -11,14 +11,16 @@ report, junit XML, accumulated ``tests.log``, and a failure gate — plus
 line coverage: each suite runs under the stdlib tracer in
 ``tools/linecov.py`` (the container has neither ``coverage`` nor
 ``pytest-cov``), the merged per-module table lands in ``tests.log``, and
-the aggregate over ``veles/simd_tpu/obs/`` is gated by a floor (the
+the aggregates over ``veles/simd_tpu/obs/`` and ``veles/simd_tpu/
+serve/`` are gated by floors (``linecov.DEFAULT_FLOORS``: the
 telemetry layer is pure host-side Python, so untested lines there are
-plain negligence — VERDICT item 6, scoped to the obs package).
-``--no-coverage`` restores the untraced (faster) run; the floor is then
-skipped.
+plain negligence — VERDICT item 6 — and the serving layer's failure
+handling is exactly the code that only runs during outages, so
+untraced lines there are untested outage behavior).  ``--no-coverage``
+restores the untraced (faster) run; the floors are then skipped.
 
 Run:  python tools/run_tests.py [--timeout 300] [--no-coverage]
-      python tools/run_tests.py --cov-floor-obs 75
+      python tools/run_tests.py --cov-floor-obs 75 --cov-floor-serve 70
 """
 
 import argparse
@@ -43,9 +45,15 @@ def main():
     ap.add_argument("--no-coverage", action="store_true",
                     help="skip the line tracer (faster; no table, no "
                     "floor)")
-    ap.add_argument("--cov-floor-obs", type=float, default=60.0,
+    ap.add_argument("--cov-floor-obs", type=float,
+                    default=linecov.DEFAULT_FLOORS["veles/simd_tpu/obs"],
                     help="minimum aggregate line coverage %% for "
                     "veles/simd_tpu/obs/ (0 disables)")
+    ap.add_argument(
+        "--cov-floor-serve", type=float,
+        default=linecov.DEFAULT_FLOORS["veles/simd_tpu/serve"],
+        help="minimum aggregate line coverage %% for "
+        "veles/simd_tpu/serve/ (0 disables)")
     args = ap.parse_args()
     coverage = not args.no_coverage
     timeout = args.timeout * (2 if coverage else 1)
@@ -114,18 +122,20 @@ def main():
             table = linecov.table(merged, REPO, scope="veles")
             log.write("\n=== line coverage (tools/linecov.py) ===\n")
             log.write(table)
-            obs_pct = linecov.aggregate_pct(
-                merged, REPO, scope=os.path.join("veles", "simd_tpu",
-                                                 "obs"))
-            floor_line = (f"veles/simd_tpu/obs/ aggregate: "
-                          f"{obs_pct:.1f}% (floor "
-                          f"{args.cov_floor_obs:.0f}%)")
-            print(floor_line)
-            log.write(floor_line + "\n")
-            if args.cov_floor_obs > 0 and obs_pct < args.cov_floor_obs:
-                print("obs coverage below floor — failing the run")
-                log.write("[FAILED] obs coverage floor\n")
-                rc = 1
+            for scope, floor in (("obs", args.cov_floor_obs),
+                                 ("serve", args.cov_floor_serve)):
+                pct = linecov.aggregate_pct(
+                    merged, REPO, scope=os.path.join(
+                        "veles", "simd_tpu", scope))
+                floor_line = (f"veles/simd_tpu/{scope}/ aggregate: "
+                              f"{pct:.1f}% (floor {floor:.0f}%)")
+                print(floor_line)
+                log.write(floor_line + "\n")
+                if floor > 0 and pct < floor:
+                    print(f"{scope} coverage below floor — failing "
+                          "the run")
+                    log.write(f"[FAILED] {scope} coverage floor\n")
+                    rc = 1
             for f in cov_files:
                 if os.path.exists(f):
                     os.unlink(f)

@@ -30,6 +30,20 @@ is the accounting layer for every dispatch-time decision:
   per ``(op, route)``, derives arithmetic intensity and an attainable
   roofline %, and snapshots every memoized compile cache through
   :func:`caches`;
+* **request traces + SLOs — the request axis** —
+  :mod:`~veles.simd_tpu.obs.requests`: every ``serve.Server.submit``
+  mints a :func:`request_trace` carried across threads on the pending
+  record; lifecycle edges (admitted / bucketed / batch-formed /
+  dispatched / retried / degraded / terminal) build a causal chain
+  whose phase decomposition (queue wait / batch wait / device) lands
+  in bounded per-(op, tenant) histograms, with slowest-per-op and
+  degraded exemplars retained as full traces and per-tenant SLO
+  accounting (:func:`slo`: burn-rate gauges, breach decision events);
+* **a live scrape endpoint** — :mod:`~veles.simd_tpu.obs.http`: a
+  stdlib ``http.server`` serving ``/metrics`` (Prometheus text),
+  ``/healthz`` (server health + breakers, 503 while degraded), and
+  ``/debug/requests`` (recent traces + exemplars + SLO accounts);
+  armed by ``serve.Server.start`` via ``$VELES_SIMD_OBS_PORT``;
 * **a crash flight recorder** — :mod:`~veles.simd_tpu.obs.flightrec`:
   an exception escaping a top-level dispatch span (or an explicit
   :func:`dump_debug_bundle` call) atomically writes config, platform,
@@ -86,12 +100,14 @@ import os
 from veles.simd_tpu.obs import compile as _compile
 from veles.simd_tpu.obs import export as _export
 from veles.simd_tpu.obs import flightrec as _flightrec
+from veles.simd_tpu.obs import requests as _requests_mod
 from veles.simd_tpu.obs import resources as _resources
 from veles.simd_tpu.obs import spans as _spans_mod
 from veles.simd_tpu.obs.atomic import atomic_write_text as _atomic_write
 from veles.simd_tpu.obs.events import EventLog
 from veles.simd_tpu.obs.lru import LRUSet
 from veles.simd_tpu.obs.registry import MetricsRegistry
+from veles.simd_tpu.obs.requests import RequestTrace, RequestTracer
 from veles.simd_tpu.obs.resources import (InstrumentedJit,
                                           instrumented_jit,
                                           register_cache)
@@ -103,11 +119,13 @@ __all__ = [
     "counter_value", "quantiles", "events", "snapshot", "reset",
     "to_json", "to_prometheus", "report", "save", "load",
     "save_trace", "trace_events",
+    "request_trace", "slo", "slo_snapshot", "request_snapshot",
+    "request_summary",
     "install_compile_listeners",
     "instrumented_jit", "resources", "caches", "register_cache",
     "dump_debug_bundle",
     "MetricsRegistry", "EventLog", "SpanTracer", "InstrumentedJit",
-    "LRUSet",
+    "RequestTrace", "RequestTracer", "LRUSet",
 ]
 
 _TRUTHY = ("1", "true", "yes", "on")
@@ -116,6 +134,29 @@ _registry = MetricsRegistry()
 _events = EventLog()
 _spans = SpanTracer(_registry.observe)
 _spans.on_crash = _flightrec.maybe_record_crash
+
+
+def _requests_decision(op: str, decision: str, **fields) -> None:
+    """Decision sink for the request tracer (SLO breach events) —
+    bound to the CURRENT event log through the module global, so
+    ``configure(max_events=...)`` swaps are honored."""
+    _events.record(op, decision, **fields)
+    _registry.count("decisions", op=op, decision=decision)
+
+
+def _requests_breach(tenant: str, burn: float) -> None:
+    """Flight-recorder arm for SLO breaches: one budgeted bundle per
+    crossing, with the request exemplars embedded (the bundle builder
+    reads them through the facade)."""
+    _flightrec.maybe_record(f"slo_breach:{tenant}", None)
+
+
+_requests = RequestTracer(_registry, decision=_requests_decision,
+                          on_breach=_requests_breach)
+# request tracing armed while telemetry is on?  configure(
+# request_axis=False) disarms the tracer alone — metrics/spans keep
+# recording (the tracer's load-shedding knob)
+_request_axis = True
 _enabled = os.environ.get("VELES_SIMD_TELEMETRY",
                           "0").strip().lower() in _TRUTHY
 if _enabled:
@@ -162,14 +203,28 @@ def disable() -> None:
 
 def configure(max_events: int | None = None,
               max_spans: int | None = None,
-              flight_dir: str | None = None) -> None:
+              flight_dir: str | None = None,
+              max_traces: int | None = None,
+              max_exemplars: int | None = None,
+              request_axis: bool | None = None) -> None:
     """Adjust telemetry limits.  ``max_events`` replaces the decision
     log with a fresh bound (history is cleared — resizing a ring buffer
     in place would silently reorder it); ``max_spans`` does the same
     for the span trace buffer.  ``flight_dir`` overrides
     ``$VELES_SIMD_FLIGHT_DIR`` as the crash-bundle destination (pass
-    ``""`` to restore the environment lookup)."""
-    global _events, _spans
+    ``""`` to restore the environment lookup).  ``max_traces`` /
+    ``max_exemplars`` re-bound the request-axis retention rings
+    (:mod:`veles.simd_tpu.obs.requests`; the trace default also reads
+    ``$VELES_SIMD_OBS_MAX_TRACES``).  ``request_axis=False`` disarms
+    request tracing (every :func:`request_trace` returns the shared
+    null trace) while counters/gauges/spans keep recording — the
+    tracer's load-shedding knob, and the off side of the ``serve
+    tracing overhead`` bench row's A/B.  NB: the terminal request
+    metrics (``serve.request_latency{op, status}``,
+    ``serve_completed``, ``serve_deadline_miss``) ride the trace's
+    terminal edge by design (one accounting home, lint-enforced), so
+    disarming the axis pauses them too."""
+    global _events, _spans, _request_axis
     if max_events is not None:
         _events = EventLog(max_events)
     if max_spans is not None:
@@ -177,6 +232,11 @@ def configure(max_events: int | None = None,
         _spans.on_crash = _flightrec.maybe_record_crash
     if flight_dir is not None:
         _flightrec.configure_flight_dir(flight_dir or None)
+    if max_traces is not None or max_exemplars is not None:
+        _requests.configure(max_traces=max_traces,
+                            max_exemplars=max_exemplars)
+    if request_axis is not None:
+        _request_axis = bool(request_axis)
 
 
 def install_compile_listeners() -> bool:
@@ -226,6 +286,60 @@ def span(name: str, **attrs):
     if not _enabled:
         return _spans_mod.NULL_SPAN
     return _spans.span(name, **attrs)
+
+
+def request_trace(op: str, tenant: str = "default", *,
+                  shape_class=None, deadline_s=None):
+    """Mint one request-axis trace (:class:`~veles.simd_tpu.obs.
+    requests.RequestTrace`) — the serving layer calls this per
+    ``Server.submit`` and carries the trace on the pending record
+    across threads; every lifecycle edge appends via
+    ``trace.event(...)`` and exactly one terminal ``trace.finish
+    (status)`` records the phase histograms, the
+    ``serve.request_latency{op, status}`` sample, SLO accounting, and
+    exemplar retention.  While telemetry is off this returns the
+    shared :data:`~veles.simd_tpu.obs.requests.NULL_REQUEST` after one
+    flag check — every edge on it is a no-op (likewise while the
+    request axis alone is disarmed via ``configure(
+    request_axis=False)``)."""
+    if not _enabled or not _request_axis:
+        return _requests_mod.NULL_REQUEST
+    return _requests.start(op, tenant, shape_class=shape_class,
+                           deadline_s=deadline_s)
+
+
+def slo(tenant: str, target_ms: float,
+        hit_rate: float = _requests_mod.DEFAULT_SLO_HIT_RATE) -> dict:
+    """Register ``tenant``'s SLO: answered within ``target_ms``
+    end-to-end at ``hit_rate`` (shed/expired/errored requests are
+    misses).  Terminal request traces update the tenant's account and
+    export ``slo_hit_rate`` / ``slo_burn_rate`` gauges; the first
+    crossing into burn > 1 records an ``slo``/``breach`` decision
+    event and arms a flight-recorder bundle.  Unregistered tenants
+    fall back to ``$VELES_SIMD_SLO_MS`` / ``$VELES_SIMD_SLO_HIT_RATE``
+    when set."""
+    return _requests.set_slo(tenant, target_ms, hit_rate)
+
+
+def slo_snapshot() -> dict:
+    """Per-tenant SLO state: registered targets, live accounts
+    (requests/good/deadline misses), observed hit rate, burn rate."""
+    return _requests.slo_snapshot()
+
+
+def request_summary() -> dict:
+    """Compact request-axis tally (started/finished/open, per-status
+    counts, retention sizes) — the form embedded in
+    :func:`snapshot`."""
+    return _requests.summary()
+
+
+def request_snapshot(recent: int = 50) -> dict:
+    """The full request axis for the live endpoint and flight
+    bundles: the last ``recent`` completed traces, slowest-per-op and
+    degraded exemplars (full causal event chains), and the SLO
+    accounts."""
+    return _requests.traces_snapshot(recent)
 
 
 def record_decision(op: str, decision: str, **fields) -> None:
@@ -286,6 +400,8 @@ def snapshot() -> dict:
     snap["spans_dropped"] = _spans.dropped
     snap["resources"] = _resources.resources_snapshot()
     snap["caches"] = _resources.caches_snapshot()
+    snap["requests"] = _requests.summary()
+    snap["slo"] = _requests.slo_snapshot()
     snap["enabled"] = _enabled
     return snap
 
@@ -324,12 +440,14 @@ def dump_debug_bundle(path: str | None = None,
 
 
 def reset() -> None:
-    """Clear all metrics, events, spans, and harvested resources; the
-    enabled flag is untouched."""
+    """Clear all metrics, events, spans, request traces, and harvested
+    resources; the enabled flag is untouched (and request ids keep
+    rising — a reset never mints duplicate rids)."""
     _registry.reset()
     _events.reset()
     _spans.reset()
     _resources.reset()
+    _requests.reset()
 
 
 def to_json(snap: dict | None = None, indent: int | None = 2) -> str:

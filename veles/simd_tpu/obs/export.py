@@ -21,8 +21,8 @@ import re
 
 __all__ = ["to_json", "from_json", "to_prometheus", "parse_prometheus",
            "report", "flatten_counters", "histogram_quantile",
-           "histogram_quantiles", "span_summary", "render_resources",
-           "render_caches", "PROMETHEUS_PREFIX"]
+           "histogram_quantiles", "span_summary", "serving_summary",
+           "render_resources", "render_caches", "PROMETHEUS_PREFIX"]
 
 PROMETHEUS_PREFIX = "veles_simd_"
 
@@ -225,6 +225,79 @@ def span_summary(snapshot: dict) -> dict:
             "p99_s": qs["p99"],
         }
     return out
+
+
+def serving_summary(snapshot: dict) -> dict | None:
+    """The serving layer's story out of one snapshot (the Serving
+    section of ``tools/obs_report.py``): queue/tenant depth gauges,
+    per-status completion tallies with shed and deadline-miss rates,
+    per-(op, status) request-latency quantiles, degraded-batch and
+    breaker-shed counts, the latest per-class breaker states (from the
+    retained ``breaker_transition`` decision events), and the
+    request-axis + SLO summaries when the snapshot carries them.
+    Returns None when the snapshot holds no ``serve_*`` metrics."""
+    counters: dict = {}
+    for c in snapshot.get("counters", []):
+        name = c["name"]
+        if name.startswith(("serve_", "fault_", "breaker_", "slo_")):
+            counters.setdefault(name, {"total": 0, "by_label": {}})
+            counters[name]["total"] += c["value"]
+            key = ",".join("%s=%s" % kv
+                           for kv in sorted(c["labels"].items()))
+            counters[name]["by_label"][key] = c["value"]
+    if not any(n.startswith("serve_") for n in counters):
+        return None
+    gauges = {}
+    for g in snapshot.get("gauges", []):
+        if g["name"].startswith(("serve_", "slo_")):
+            key = g["name"]
+            if g["labels"]:
+                key += "{" + ",".join(
+                    "%s=%s" % kv
+                    for kv in sorted(g["labels"].items())) + "}"
+            gauges[key] = g["value"]
+    latency = {}
+    for h in snapshot.get("histograms", []):
+        if h["name"] != "serve.request_latency":
+            continue
+        op = h["labels"].get("op", "?")
+        status = h["labels"].get("status", "all")
+        latency[(op, status)] = {"count": h["count"],
+                                 **histogram_quantiles(h)}
+    submitted = counters.get("serve_submitted", {}).get("total", 0)
+    completed = counters.get("serve_completed", {"by_label": {}})
+    by_status: dict = {}
+    for key, v in completed["by_label"].items():
+        for part in key.split(","):
+            if part.startswith("status="):
+                status = part.split("=", 1)[1]
+                by_status[status] = by_status.get(status, 0) + v
+    shed = counters.get("serve_shed", {}).get("total", 0)
+    misses = counters.get("serve_deadline_miss", {}).get("total", 0)
+    breakers = {}
+    for e in snapshot.get("events", []):
+        if e.get("op") == "breaker_transition":
+            breakers[(e.get("site"), e.get("key"))] = e.get("decision")
+    return {
+        "gauges": gauges,
+        "submitted": submitted,
+        "by_status": dict(sorted(by_status.items())),
+        "shed": shed,
+        "shed_rate": shed / submitted if submitted else None,
+        "deadline_misses": misses,
+        "deadline_miss_rate": (misses / submitted
+                               if submitted else None),
+        "degraded_batches": counters.get(
+            "serve_degraded_batch", {}).get("total", 0),
+        "breaker_shed": counters.get(
+            "serve_breaker_shed", {}).get("total", 0),
+        "latency": {"%s/%s" % k: v
+                    for k, v in sorted(latency.items())},
+        "breaker_states": {"%s %s" % k: v
+                           for k, v in sorted(breakers.items())},
+        "requests": snapshot.get("requests"),
+        "slo": snapshot.get("slo"),
+    }
 
 
 def flatten_counters(snapshot: dict) -> dict:

@@ -16,6 +16,11 @@ of it to disk as one JSON bundle:
   must never replace the original error with its own.
 * **on demand** — :func:`dump_debug_bundle` writes the same bundle any
   time (a health endpoint, a stuck-state investigation).
+* **on SLO breach** — the request tracer
+  (:mod:`veles.simd_tpu.obs.requests`) routes a tenant's first
+  crossing into burn > 1 through the same budgeted
+  :func:`maybe_record` gate (reason ``slo_breach:<tenant>``), so the
+  bundle lands WITH the request exemplars that explain the breach.
 
 The bundle carries: schema/reason/exception, library config, platform
 and device info, environment knobs, the full telemetry snapshot
@@ -162,6 +167,10 @@ def build_bundle(reason: str, exc: BaseException | None = None) -> dict:
         "env": _env_info(),
         "snapshot": obs.snapshot(),
         "trace_events": obs.trace_events(),
+        # the request axis: recent causal chains + slowest/degraded
+        # exemplars + SLO accounts — the per-request story a crash or
+        # SLO breach needs (obs/requests.py)
+        "request_traces": obs.request_snapshot(),
         "fault_history": _fault_info(),
         "device_probes": _probe_info(),
     }

@@ -1,0 +1,177 @@
+"""Live scrape endpoint: the obs layer, served over stdlib HTTP.
+
+Until now every export was a file (``obs.save``, flight bundles,
+``BENCH_DETAILS.json``) — fine for post-mortems, blind for a *running*
+service.  This module is the live surface, three read-only routes on a
+daemon-threaded stdlib ``http.server`` (no new dependencies, same rule
+as the rest of the tooling):
+
+* ``GET /metrics`` — ``obs.to_prometheus()`` verbatim (counters,
+  gauges incl. the per-tenant ``slo_*`` family, histograms incl.
+  ``serve.request_latency{op, status}`` and the ``request.*`` phase
+  family, resources, caches) — point a Prometheus scraper at it;
+* ``GET /healthz`` — JSON: endpoint liveness plus whatever the owning
+  process registered as its health provider (the serving layer wires
+  ``Server.stats()`` in: health machine state, breaker registry,
+  admission depths, batcher classes).  Status 200 while the provider
+  reports ``healthy`` (or no provider is registered), 503 once it
+  reports ``degraded`` — load balancers can act on the code alone;
+* ``GET /debug/requests`` — JSON: the request axis
+  (:mod:`veles.simd_tpu.obs.requests`): recent completed traces,
+  slowest-per-op and degraded exemplars, and the per-tenant SLO
+  accounts.
+
+Arming: :meth:`veles.simd_tpu.serve.Server.start` reads
+``$VELES_SIMD_OBS_PORT`` (or its ``obs_port=`` argument; port 0 binds
+an ephemeral port — the test idiom) and owns the endpoint's lifetime;
+any other process can call :func:`start` directly.  The endpoint binds
+localhost only — it serves operators on the host, not the internet;
+put a real reverse proxy in front for anything wider.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import threading
+
+__all__ = ["ObsEndpoint", "start", "env_port", "OBS_PORT_ENV",
+           "BIND_HOST"]
+
+OBS_PORT_ENV = "VELES_SIMD_OBS_PORT"
+BIND_HOST = "127.0.0.1"
+
+
+def env_port() -> int | None:
+    """The scrape-endpoint port from ``$VELES_SIMD_OBS_PORT`` (unset /
+    empty / malformed / negative = None = endpoint disarmed; 0 = bind
+    an ephemeral port)."""
+    raw = os.environ.get(OBS_PORT_ENV, "").strip()
+    if not raw:
+        return None
+    try:
+        port = int(raw)
+    except ValueError:
+        return None
+    return port if port >= 0 else None
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    """The three read-only routes.  Every handler is exception-proofed
+    into a 500 — a scrape must never kill the serving process, and a
+    half-written response must never wedge the scraper."""
+
+    # the endpoint belongs to telemetry; its access log does not get
+    # to spam the serving process's stderr
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        try:
+            path = self.path.split("?", 1)[0]
+            if path == "/metrics":
+                from veles.simd_tpu import obs
+
+                self._send(200, obs.to_prometheus(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                body, code = self.server.owner.healthz()
+                self._send(code, json.dumps(body, indent=2,
+                                            default=str),
+                           "application/json")
+            elif path == "/debug/requests":
+                from veles.simd_tpu import obs
+
+                self._send(200, json.dumps(obs.request_snapshot(),
+                                           indent=2, default=str),
+                           "application/json")
+            else:
+                self._send(404, json.dumps(
+                    {"error": "unknown path",
+                     "routes": ["/metrics", "/healthz",
+                                "/debug/requests"]}),
+                    "application/json")
+        except BrokenPipeError:
+            pass        # scraper hung up mid-response: its problem
+        except Exception as e:  # noqa: BLE001 — a scrape never kills
+            try:
+                self._send(500, json.dumps({"error": repr(e)}),
+                           "application/json")
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class _Server(http.server.ThreadingHTTPServer):
+    daemon_threads = True
+    # restarting a serving process on the same port must not wait out
+    # TIME_WAIT
+    allow_reuse_address = True
+
+
+class ObsEndpoint:
+    """One armed scrape endpoint: the bound port, the serving daemon
+    thread, and :meth:`stop`.  ``health`` is an optional zero-arg
+    callable returning a JSON-native dict for ``/healthz`` (the
+    serving layer passes its ``stats``)."""
+
+    def __init__(self, port: int, health=None):
+        self._health = health
+        self._httpd = _Server((BIND_HOST, int(port)), _Handler)
+        self._httpd.owner = self
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"veles-obs-http-{self.port}")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{BIND_HOST}:{self.port}"
+
+    def healthz(self) -> tuple:
+        """``(body, http_code)`` for ``/healthz``: 503 once the health
+        provider reports a degraded state, 200 otherwise."""
+        body = {"endpoint": "ok", "port": self.port}
+        code = 200
+        if self._health is not None:
+            try:
+                provided = self._health()
+            except Exception as e:  # noqa: BLE001 — report, not crash
+                return ({**body, "provider_error": repr(e)}, 500)
+            body.update(provided if isinstance(provided, dict)
+                        else {"health": provided})
+            state = body.get("health")
+            if isinstance(state, dict):
+                state = state.get("state")
+            if state == "degraded":
+                code = 503
+        return body, code
+
+    def stop(self) -> None:
+        """Shut the listener down and join the serving thread."""
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+    def __repr__(self):
+        return f"ObsEndpoint(port={self.port})"
+
+
+def start(port: int | None = None, health=None) -> ObsEndpoint | None:
+    """Arm the endpoint on ``port`` (None = ``$VELES_SIMD_OBS_PORT``;
+    still None = disarmed, returns None; 0 = ephemeral).  Returns the
+    live :class:`ObsEndpoint` — the caller owns :meth:`stop`."""
+    if port is None:
+        port = env_port()
+    if port is None:
+        return None
+    return ObsEndpoint(int(port), health=health)

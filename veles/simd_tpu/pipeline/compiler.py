@@ -247,7 +247,8 @@ class CompiledPipeline:
                 fallback=lambda: self.oracle_step(block, state),
                 fallback_name="oracle", subsite=self.name)
 
-    def serve_step(self, block, state, budget_s: float | None = None):
+    def serve_step(self, block, state, budget_s: float | None = None,
+                   on_fault=None):
         """One (possibly row-batched) block for the SERVING layer:
         the same per-pipeline-class breaker + guarded dispatch as
         :meth:`process`, with the batch's remaining deadline budget
@@ -256,7 +257,10 @@ class CompiledPipeline:
         the pipeline class — ``serve.dispatch`` traffic and direct
         :meth:`process` callers share one breaker, and a chaos plan
         poisons the class via the ``pipeline.dispatch@<name>``
-        subsite."""
+        subsite.  ``on_fault`` is the request-axis observer the server
+        threads in (:func:`veles.simd_tpu.runtime.faults.guarded`):
+        every retry/degrade of the fused step lands as a ``retried`` /
+        ``degraded`` edge on each co-batched invocation's trace."""
         box = {"deg": False}
 
         def fallback():
@@ -269,7 +273,8 @@ class CompiledPipeline:
                 PIPELINE_SITE, (self.name, self.block_len),
                 lambda: self._run_fused(block, state),
                 fallback=fallback, fallback_name="oracle",
-                subsite=self.name, budget_s=budget_s)
+                subsite=self.name, budget_s=budget_s,
+                on_fault=on_fault)
         return out, new_state, box["deg"]
 
     # -- serving-layer state marshalling ------------------------------------

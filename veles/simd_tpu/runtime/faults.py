@@ -661,7 +661,7 @@ def _call_with_deadline(thunk, deadline: float, site: str):
 def guarded(site: str, thunk, *, fallback=None, retries: int | None = None,
             backoff: float | None = None, deadline: float | None = None,
             fallback_name: str = "oracle", budget_s: float | None = None,
-            breaker=None, subsite: str | None = None):
+            breaker=None, subsite: str | None = None, on_fault=None):
     """Dispatch ``thunk()`` under the transient-fault policy.
 
     Composes *around* the ``obs.instrumented_jit``-compiled cores at
@@ -695,9 +695,27 @@ def guarded(site: str, thunk, *, fallback=None, retries: int | None = None,
     failure on retry exhaustion) so the breaker's sliding window sees
     exactly the dispatches that reached the device.
 
+    ``on_fault`` is an optional per-caller fault observer — the
+    request-axis hook (:mod:`veles.simd_tpu.obs.requests`): called
+    best-effort (exceptions swallowed — an observer must never change
+    the policy's answer) as ``on_fault("retry", kind, attempt)`` per
+    retry, ``on_fault("degrade", kind, attempt)`` when the call
+    degrades to its fallback, and ``on_fault("exhausted", kind,
+    attempt)`` when it re-raises.  The serving layer and the pipeline
+    compiler thread a callback here that appends ``retried`` /
+    ``degraded`` edges to every request trace in the dispatched batch.
+
     ``retries`` / ``backoff`` / ``deadline`` default to the env knobs
     (``VELES_SIMD_FAULT_RETRIES`` / ``_BACKOFF`` / ``_DEADLINE``).
     """
+
+    def _observe_fault(action: str, kind: str, attempt: int) -> None:
+        if on_fault is None:
+            return
+        try:
+            on_fault(action, kind, attempt)
+        except Exception:  # noqa: BLE001 — observers never change policy
+            pass
     if retries is None:
         retries = fault_retries()
     if backoff is None:
@@ -730,6 +748,7 @@ def guarded(site: str, thunk, *, fallback=None, retries: int | None = None,
                 obs.record_decision(
                     "fault_policy", "retry", site=site, kind=kind,
                     attempt=attempt + 1, retries=retries)
+                _observe_fault("retry", kind, attempt + 1)
                 if delay > 0:
                     time.sleep(delay)
                 attempt += 1
@@ -750,8 +769,10 @@ def guarded(site: str, thunk, *, fallback=None, retries: int | None = None,
                 fallback=fallback_name if fallback is not None
                 else None)
             if fallback is None:
+                _observe_fault("exhausted", kind, attempt)
                 raise
             obs.count("fault_degraded", site=site, to=fallback_name)
+            _observe_fault("degrade", kind, attempt)
             return fallback()
         else:
             if breaker is not None:
@@ -787,6 +808,12 @@ def breaker_guarded(site: str, key, thunk, *, fallback=None,
             obs.record_decision(
                 "fault_policy", "short_circuit", site=site,
                 key=repr(key), fallback=fallback_name)
+            on_fault = kwargs.get("on_fault")
+            if on_fault is not None:
+                try:    # observer only — never changes the answer
+                    on_fault("degrade", "breaker_open", 0)
+                except Exception:  # noqa: BLE001
+                    pass
             return fallback()
         verdict = "probe"   # no fallback to shed to: zero-retry trial
     if verdict != _breaker.CLOSED:

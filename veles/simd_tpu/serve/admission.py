@@ -140,8 +140,11 @@ class AdmissionController:
         return None
 
     def admit(self, tenant: str = "default", *, block: bool = False,
-              timeout: float | None = None) -> None:
-        """Reserve one queue slot for ``tenant``.
+              timeout: float | None = None) -> tuple:
+        """Reserve one queue slot for ``tenant``; returns the depths
+        at entry — ``(global_depth, tenant_depth)`` INCLUDING this
+        request — which the server stamps into the request trace's
+        ``admitted`` edge (the queue pressure a request walked into).
 
         Raises :class:`Overloaded` immediately when a bound is hit and
         ``block`` is False; with ``block=True`` waits up to ``timeout``
@@ -165,7 +168,7 @@ class AdmissionController:
             while True:
                 refused = self._try_reserve(tenant)
                 if refused is None:
-                    return
+                    return self._total, self._depths.get(tenant, 0)
                 if not block:
                     break
                 remaining = None

@@ -133,7 +133,11 @@ class Batcher:
     def put(self, key, item) -> None:
         """Queue ``item`` under shape-class ``key``; wakes a worker.
         Raises RuntimeError once closed (the server translates that
-        into a typed shutdown answer)."""
+        into a typed shutdown answer).  NB for trace-minded callers:
+        any request-trace edge for the enqueue must be recorded
+        BEFORE calling this — once the item is queued a worker may
+        form its batch concurrently, and a post-put edge would race
+        the worker's ``batch_formed`` edge out of causal order."""
         with self._cond:
             if self._closed:
                 raise RuntimeError("batcher is closed")

@@ -119,9 +119,15 @@ class HealthMonitor:
         return True
 
     def snapshot(self) -> dict:
-        """JSON-native view: state + transition/probe tallies."""
+        """JSON-native view: state + transition/probe tallies (also
+        the ``health`` block of the live ``/healthz`` scrape route —
+        ``obs/http.py`` answers 503 from the ``state`` field while
+        DEGRADED, so a load balancer needs no JSON parsing).
+        ``degraded_batches`` counts batches served since the LAST
+        trip — the current outage's oracle-served tally."""
         with self._lock:
             return {"state": self._state, "trips": self._trips,
                     "recoveries": self._recoveries,
                     "probes": self._probes,
+                    "degraded_batches": self._degraded_batches,
                     "probe_every": self.probe_every}

@@ -45,9 +45,21 @@ already has, plus the one loop none of them provided:
   batch probes the device with a zero-retry budget, and the first
   probe that lands flips back to HEALTHY;
 * **observability** — ``serve.dispatch`` spans (p50/p95/p99 via the
-  obs histograms), ``serve.request_latency`` / ``serve.batch_fill``
-  histograms, queue-depth gauges, and shed/degrade/probe counters,
-  all in ``obs.to_prometheus()``.
+  obs histograms), ``serve.request_latency{op, status}`` /
+  ``serve.batch_fill`` histograms, queue-depth gauges, and
+  shed/degrade/probe counters, all in ``obs.to_prometheus()``;
+* **the request axis** — every submit mints an
+  ``obs.request_trace`` carried on the ticket across threads: the
+  causal chain admitted (queue/tenant depth) -> bucketed ->
+  batch-formed (batch id, co-batched count, padding rows) ->
+  dispatched (route, breaker state) -> retried/degraded -> exactly
+  one terminal edge, closed by ``Ticket._complete`` for EVERY
+  outcome (answered, shed, expired, closed, error) so phase
+  latencies (queue wait / batch wait / device) always sum to the
+  total; per-tenant SLO accounting rides the terminal edges
+  (``obs.slo``), and ``start()`` arms the live scrape endpoint
+  (``/metrics`` + ``/healthz`` + ``/debug/requests``) via
+  ``$VELES_SIMD_OBS_PORT`` or ``obs_port=`` (0 = ephemeral).
 
 Usage::
 
@@ -88,6 +100,7 @@ import threading
 import numpy as np
 
 from veles.simd_tpu import obs
+from veles.simd_tpu.obs import http as obs_http
 from veles.simd_tpu.ops import batched
 from veles.simd_tpu.ops import iir as _iir
 from veles.simd_tpu.ops import resample as _rs
@@ -165,14 +178,19 @@ class Ticket:
     before dispatch) / ``closed`` / ``error``.
     """
 
-    __slots__ = ("op", "tenant", "status", "wait_s", "_event",
-                 "_value", "_error", "_lock")
+    __slots__ = ("op", "tenant", "status", "wait_s", "trace",
+                 "_event", "_value", "_error", "_lock")
 
     def __init__(self, op: str, tenant: str):
         self.op = op
         self.tenant = tenant
         self.status = "pending"
         self.wait_s = None
+        # the request-axis trace (obs.request_trace; the shared no-op
+        # while telemetry is off) — attached at submit, carried across
+        # threads with the ticket, finished HERE so every terminal
+        # outcome closes its causal chain through one funnel
+        self.trace = None
         self._event = threading.Event()
         self._value = None
         self._error = None
@@ -181,7 +199,7 @@ class Ticket:
     def _complete(self, *, value=None, error=None, status="ok",
                   wait_s=None) -> None:
         with self._lock:
-            if self._event.is_set():
+            if self.status != "pending":
                 obs.count("serve_double_answer", op=self.op)
                 raise RuntimeError(
                     f"ticket for {self.op!r} completed twice "
@@ -190,7 +208,15 @@ class Ticket:
             self._error = error
             self.status = status
             self.wait_s = wait_s
-            self._event.set()
+        # terminal edge outside the ticket lock (the tracer takes its
+        # own locks) but BEFORE the wakeup: a waiter that observes a
+        # done ticket must observe a closed trace — ONE funnel for
+        # every status, so a ticket can never answer without closing
+        # its causal chain (the completeness invariant loadgen and the
+        # chaos campaign gate)
+        if self.trace is not None:
+            self.trace.finish(status)
+        self._event.set()
 
     def done(self) -> bool:
         """Answered (any status but ``pending``)?"""
@@ -343,7 +369,8 @@ class Server:
                  tenant_depth: int | None = None,
                  workers: int = DEFAULT_WORKERS,
                  probe_every: int = DEFAULT_PROBE_EVERY,
-                 donate: bool = False):
+                 donate: bool = False,
+                 obs_port: int | None = None):
         max_wait_s = (None if max_wait_ms is None
                       else float(max_wait_ms) / 1e3)
         self._batcher = Batcher(max_batch, max_wait_s,
@@ -355,9 +382,17 @@ class Server:
         if self.workers < 1:
             raise ValueError("workers must be >= 1")
         self.donate = bool(donate)
+        # the live scrape endpoint (obs/http.py): obs_port= here
+        # (0 = ephemeral — the test idiom; negative = explicitly
+        # disarmed even when the env var is set), or None to defer to
+        # $VELES_SIMD_OBS_PORT at start() (unset = disarmed);
+        # .obs_port holds the bound port
+        self._obs_port_arg = obs_port
+        self._endpoint = None
         self._pipelines: dict = {}
         self._threads: list = []
         self._stats_lock = threading.Lock()
+        self._batch_seq = 0
         self._stats = {"submitted": 0, "completed": 0, "shed": 0,
                        "degraded_answers": 0, "errors": 0,
                        "expired": 0, "breaker_shed": 0,
@@ -368,19 +403,40 @@ class Server:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "Server":
-        """Spawn the worker pool (idempotent)."""
+        """Spawn the worker pool and (when armed via ``obs_port=`` or
+        ``$VELES_SIMD_OBS_PORT``; a negative ``obs_port=`` disarms
+        even with the env var set) the live scrape endpoint
+        (idempotent)."""
         if self._stopped:
             raise ServerClosed("server already stopped")
         if self._started:
             return self
+        # the endpoint arms FIRST, before anything else starts: a bind
+        # failure (port in use) must raise out of a server with no
+        # workers running and _started still False — never a
+        # half-started server the idempotence guard would then treat
+        # as fully started
+        if self._obs_port_arg is not None and self._obs_port_arg < 0:
+            self._endpoint = None       # explicit disarm beats env
+        else:
+            self._endpoint = obs_http.start(self._obs_port_arg,
+                                            health=self.stats)
         self._started = True
         for i in range(self.workers):
             t = threading.Thread(target=self._worker, daemon=True,
                                  name=f"veles-serve-worker-{i}")
             t.start()
             self._threads.append(t)
+        if self._endpoint is not None:
+            obs.record_decision("serve_obs_endpoint", "armed",
+                                port=self._endpoint.port)
         obs.gauge("serve_healthy", 1.0)
         return self
+
+    @property
+    def obs_port(self) -> int | None:
+        """The scrape endpoint's bound port (None while disarmed)."""
+        return self._endpoint.port if self._endpoint else None
 
     def stop(self, drain: bool = True) -> None:
         """Close the intake and join the workers.  ``drain=True``
@@ -395,6 +451,9 @@ class Server:
         for t in self._threads:
             t.join()
         self._threads = []
+        if self._endpoint is not None:
+            self._endpoint.stop()
+            self._endpoint = None
 
     _abandoned = False
 
@@ -493,26 +552,39 @@ class Server:
         if self._stopped:
             raise ServerClosed("server is stopped")
         ticket = Ticket(request.op, request.tenant)
+        dl_ms = request.deadline_ms
+        if dl_ms is None:
+            dl_ms = env_deadline_ms()
+        has_deadline = dl_ms is not None and dl_ms > 0
+        # a pipeline's block length IS its shape class (every
+        # invocation carries exactly one block — no pad-to-bucket)
+        nb = n if pipe is not None else bucket_length(n)
+        key = (request.op, param_key, nb)
+        # the request axis: minted BEFORE admission so a shed request
+        # still closes a causal chain; carried across threads on the
+        # ticket, finished by Ticket._complete whatever the outcome
+        ticket.trace = obs.request_trace(
+            request.op, tenant=request.tenant, shape_class=nb,
+            deadline_s=(float(dl_ms) / 1e3 if has_deadline else None))
         try:
-            self._admission.admit(request.tenant, block=block,
-                                  timeout=timeout)
+            depth, tenant_depth = self._admission.admit(
+                request.tenant, block=block, timeout=timeout)
         except Overloaded as e:
             with self._stats_lock:
                 self._stats["shed"] += 1
             ticket._complete(error=e, status="shed")
             return ticket
+        ticket.trace.event("admitted", depth=depth,
+                           tenant_depth=tenant_depth)
         now = faults.monotonic()
-        dl_ms = request.deadline_ms
-        if dl_ms is None:
-            dl_ms = env_deadline_ms()
-        deadline = (now + float(dl_ms) / 1e3
-                    if dl_ms is not None and dl_ms > 0 else None)
+        deadline = now + float(dl_ms) / 1e3 if has_deadline else None
         pend = _Pending(ticket, xarr, n, cparams, now,
                         deadline=deadline)
-        # a pipeline's block length IS its shape class (every
-        # invocation carries exactly one block — no pad-to-bucket)
-        key = (request.op, param_key,
-               n if pipe is not None else bucket_length(n))
+        # the bucketed edge is recorded BEFORE the put: the moment the
+        # item is in the batcher a worker may form the batch, and its
+        # batch_formed edge must never precede this one (the traces'
+        # causal-order invariant)
+        ticket.trace.event("bucketed", bucket=nb)
         try:
             self._batcher.put(key, pend)
         except RuntimeError:
@@ -571,6 +643,10 @@ class Server:
                 continue
             late_ms = (now - p.deadline) * 1e3 \
                 if p.deadline is not None else 0.0
+            # the terminal trace edge (and the serve_deadline_miss /
+            # serve_completed counters) flow through Ticket._complete
+            # -> trace.finish — the request-trace API owns terminal
+            # accounting (tools/lint.py request-trace rule)
             p.ticket._complete(
                 error=DeadlineExceeded(
                     f"DEADLINE_EXCEEDED: request {p.ticket.op!r} "
@@ -578,8 +654,6 @@ class Server:
                     f"{late_ms:.1f} ms before dispatch"),
                 status="expired")
             self._release(p)
-            obs.count("serve_deadline_miss", op=p.ticket.op,
-                      tenant=p.ticket.tenant)
             with self._stats_lock:
                 self._stats["expired"] += 1
 
@@ -620,18 +694,33 @@ class Server:
         # row-pad to the power-of-two class so occupancy churn shares
         # compiled handles instead of minting one per batch size
         rpad = bucket_length(rows)
+        self._note_batch_formed(batch, rpad)
         xs = np.zeros((rpad, nb), np.float32)
         for i, p in enumerate(batch):
             xs[i, :p.n] = p.x
         params = batch[0].params
         with obs.span("serve.dispatch", op=op, rows=rpad, n=nb):
-            ys, degraded = self._dispatch(op, key, xs, params,
-                                          budget_s)
+            ys, degraded = self._dispatch(
+                op, key, xs, params, budget_s,
+                traces=[p.ticket.trace for p in batch])
         ys = np.asarray(ys)
         _, slicer = _OPS[op]
         self._finish_batch(
             op, batch,
             lambda i, p: slicer(ys[i], p.n, p.params), degraded)
+
+    def _note_batch_formed(self, batch, rpad: int) -> None:
+        """The ``batch_formed`` trace edge for every co-batched
+        request: shared batch id, co-batched count, and the padding
+        rows the pow2 row class added."""
+        with self._stats_lock:
+            bid = self._batch_seq
+            self._batch_seq += 1
+        rows = len(batch)
+        for p in batch:
+            p.ticket.trace.event("batch_formed", batch=bid,
+                                 co_batched=rows,
+                                 padding_rows=rpad - rows)
 
     def _finish_batch(self, op: str, batch, value_for,
                       degraded: bool) -> None:
@@ -646,11 +735,13 @@ class Server:
         rows = len(batch)
         for i, p in enumerate(batch):
             wait = now - p.enq
-            obs.observe("serve.request_latency", wait, op=op)
+            # the serve.request_latency{op, status} sample and the
+            # serve_completed counter flow through Ticket._complete ->
+            # trace.finish — one terminal-accounting home, every
+            # status included (the survivorship-bias fix)
             p.ticket._complete(value=value_for(i, p), status=status,
                                wait_s=wait)
             self._release(p)
-            obs.count("serve_completed", op=op, status=status)
             with self._stats_lock:
                 self._stats["completed"] += 1
                 if degraded:
@@ -674,27 +765,60 @@ class Server:
         compiled = self._pipelines[op.split(":", 1)[1]]
         rows = len(batch)
         rpad = bucket_length(rows)
+        self._note_batch_formed(batch, rpad)
         xs = np.zeros((rpad, nb), np.float32)
         for i, p in enumerate(batch):
             xs[i] = p.x
         states = compiled.batch_states(
             [p.params.get("state") for p in batch], rpad)
+        traces = [p.ticket.trace for p in batch]
+        for tr in traces:
+            tr.event("dispatched", route="pipeline",
+                     breaker="composed")
         with obs.span("serve.dispatch", op=op, rows=rpad, n=nb):
             out, new_state, degraded = compiled.serve_step(
-                xs, states, budget_s=budget_s)
+                xs, states, budget_s=budget_s,
+                on_fault=self._batch_fault_hook(traces))
         if degraded:
             obs.count("serve_degraded_batch", op=op)
+            for tr in traces:
+                # belt and braces: the on_fault hook records the
+                # guarded degrade; a degraded batch whose edge was
+                # somehow skipped must still carry one (the chaos
+                # invariant: every degraded ticket has a degrade edge)
+                if not any(e["event"] == "degraded"
+                           for e in tr.events()):
+                    tr.event("degraded", to="oracle",
+                             reason="pipeline")
         outs = compiled.out_rows(out, rows)
         state_rows = compiled.state_rows(new_state, rows)
         self._finish_batch(
             op, batch, lambda i, p: (outs[i], state_rows[i]),
             degraded)
 
+    @staticmethod
+    def _batch_fault_hook(traces):
+        """The ``faults.guarded`` fault observer for one batch: every
+        retry/degrade of the shared dispatch is an edge on EVERY
+        co-batched request's trace (the fate of a batch is the fate of
+        each request riding it)."""
+        def on_fault(action: str, kind: str, attempt: int) -> None:
+            for tr in traces:
+                if action == "retry":
+                    tr.event("retried", kind=kind, attempt=attempt)
+                else:
+                    tr.event("degraded", to="oracle", reason=kind)
+        return on_fault
+
     def _dispatch(self, op: str, key, xs, params: dict,
-                  budget_s: float | None = None) -> tuple:
+                  budget_s: float | None = None,
+                  traces=()) -> tuple:
         """One batch through the health machine + the shape class's
         circuit breaker + the fault policy; returns ``(outputs,
-        degraded)``.
+        degraded)``.  ``traces`` are the co-batched requests' traces:
+        the chosen route + breaker state land as each one's
+        ``dispatched`` edge, and retry/degrade outcomes append through
+        :meth:`_batch_fault_hook`.
 
         The breaker (keyed by the batch's shape class) composes
         *under* the health machine: an open breaker answers ITS class
@@ -708,6 +832,11 @@ class Server:
             probe = self._health.note_degraded_batch()
             if not probe:
                 obs.count("serve_degraded_batch", op=op)
+                for tr in traces:
+                    tr.event("dispatched", route="oracle",
+                             breaker="bypassed", health="degraded")
+                    tr.event("degraded", to="oracle",
+                             reason="health_degraded")
                 return _oracle_call(op, xs, params), True
         br = _breaker.breaker_for("serve.dispatch", key)
         # a health-machine probe batch outranks the breaker's
@@ -719,7 +848,14 @@ class Server:
             obs.count("serve_degraded_batch", op=op)
             with self._stats_lock:
                 self._stats["breaker_shed"] += 1
+            for tr in traces:
+                tr.event("dispatched", route="oracle", breaker="open")
+                tr.event("degraded", to="oracle",
+                         reason="breaker_open")
             return _oracle_call(op, xs, params), True
+        for tr in traces:
+            tr.event("dispatched", route="device", breaker=verdict,
+                     probe=probe)
         box = {"tripped": False}
         donate = self.donate
 
@@ -738,7 +874,8 @@ class Server:
                             fallback=fallback, fallback_name="oracle",
                             retries=(0 if zero_retry else None),
                             budget_s=budget_s, breaker=br,
-                            subsite=op)
+                            subsite=op,
+                            on_fault=self._batch_fault_hook(traces))
         if not box["tripped"] and probe:
             self._health.recover("serve.dispatch")
         return ys, box["tripped"]
@@ -753,8 +890,11 @@ class Server:
     def stats(self) -> dict:
         """JSON-native snapshot: request tallies, admission depths,
         batcher state, health machine, the per-shape-class circuit
-        breakers, and (telemetry on) the steady-state p50/p95/p99 of
-        the ``serve.dispatch`` span."""
+        breakers, the request-axis summary + per-tenant SLO accounts,
+        and (telemetry on) the steady-state p50/p95/p99 of the
+        ``serve.dispatch`` span.  Also the ``/healthz`` body of the
+        live scrape endpoint (obs/http.py answers 503 from the
+        ``health.state`` field while DEGRADED)."""
         with self._stats_lock:
             counts = dict(self._stats)
         return {
@@ -766,6 +906,9 @@ class Server:
                          if b["site"] in ("serve.dispatch",
                                           "pipeline.dispatch")],
             "pipelines": sorted(self._pipelines),
+            "requests": obs.request_summary(),
+            "slo": obs.slo_snapshot(),
+            "obs_port": self.obs_port,
             "dispatch_quantiles": obs.quantiles(
                 "span.serve.dispatch", phase="steady"),
         }
