@@ -6,9 +6,9 @@
 PYTHON ?= python
 
 .PHONY: all tests tests-quick benchmarks bench bench-regress \
-        bench-multichip bench-serve serve-smoke chaos-smoke cshim \
-        cshim-check wavelet-tables lint docs obs-report obs-dash \
-        autotune-pack install install-hooks clean
+        bench-multichip bench-serve serve-smoke chaos-smoke \
+        chaos-replicas cshim cshim-check wavelet-tables lint docs \
+        obs-report obs-dash autotune-pack install install-hooks clean
 
 all: cshim
 
@@ -66,6 +66,19 @@ serve-smoke:
 chaos-smoke:
 	VELES_SIMD_PLATFORM=cpu VELES_SIMD_FAULT_BACKOFF=0 \
 		$(PYTHON) tools/chaos.py --smoke
+
+# the REPLICATED chaos campaign on CPU: 3 in-process server replicas
+# behind the breaker-aware front router — one replica killed abruptly
+# (no drain) mid-traffic with its queued work failing over onto
+# survivors (original deadlines carried), then another drained
+# gracefully — gating zero lost / zero double-answered requests across
+# the GROUP, terminal traces on the killed replica's requests,
+# survivor absorption, and a live router-level /healthz throughout
+# (tools/chaos.py --replicas; REPLICA_DETAILS.json rows gate via
+# `python tools/bench_regress.py --details REPLICA_DETAILS.json`)
+chaos-replicas:
+	VELES_SIMD_PLATFORM=cpu VELES_SIMD_FAULT_BACKOFF=0 \
+		$(PYTHON) tools/chaos.py --replicas --smoke
 
 cshim:
 	$(MAKE) -C csrc all

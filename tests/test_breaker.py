@@ -12,6 +12,7 @@ monkeypatching.
 """
 
 import sys
+import threading
 from pathlib import Path
 
 import numpy as np
@@ -136,6 +137,48 @@ class TestBreakerMachine:
         br = breaker.Breaker("s")
         assert (br.window_size, br.threshold, br.min_events,
                 br.probe_every) == (16, 0.75, 4, 7)
+
+    def test_probe_cadence_exact_under_concurrent_dispatchers(
+            self, telemetry):
+        """PR 13 satellite: when many dispatcher threads race the
+        half-open call counter, EXACTLY one probe per cadence window
+        is admitted — the counter increments under the breaker lock,
+        so N racing admits on a not-closed breaker yield exactly
+        floor(N / probe_every) probe verdicts, never a thundering
+        herd of trials and never a starved window."""
+        for probe_every in (3, 4):
+            br = breaker.Breaker("race.site", f"cls{probe_every}",
+                                 window=4, threshold=0.5,
+                                 min_events=2,
+                                 probe_every=probe_every)
+            br.failure()
+            br.failure()
+            assert br.state == breaker.OPEN
+            n_threads, verdicts = 24, []
+            lock = threading.Lock()
+            barrier = threading.Barrier(n_threads)
+
+            def racer():
+                barrier.wait()
+                v = br.admit()      # no outcome recorded: the pure
+                with lock:          # cadence question
+                    verdicts.append(v)
+
+            threads = [threading.Thread(target=racer)
+                       for _ in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            probes = verdicts.count("probe")
+            assert probes == n_threads // probe_every
+            assert verdicts.count(breaker.OPEN) \
+                == n_threads - probes
+            assert br.info()["probes"] == probes
+            # the cadence keeps counting across rounds: the next
+            # window's worth of admits yields exactly one more probe
+            more = [br.admit() for _ in range(probe_every)]
+            assert more.count("probe") == 1
 
 
 # ---------------------------------------------------------------------------

@@ -170,3 +170,95 @@ def test_chaos_phase_rows_are_degraded_not_gated(tmp_path):
           "unit": "req/s"}]))
     assert bench_regress.main(["--details", str(details),
                                "--history", str(history)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# the replicated campaign (tools/chaos.py --replicas, make chaos-replicas)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def replica_campaign(tmp_path_factory):
+    """One in-process run of the 2-phase replica campaign: kill one
+    replica abruptly mid-traffic, drain another gracefully, details
+    asserted by the tests below."""
+    details = tmp_path_factory.mktemp("chaos") / "REPLICA_DETAILS.json"
+    import os
+
+    prev_backoff = os.environ.get("VELES_SIMD_FAULT_BACKOFF")
+    os.environ["VELES_SIMD_FAULT_BACKOFF"] = "0"
+    try:
+        rc = chaos.main(["--replicas", "--smoke",
+                         "--details", str(details)])
+    finally:
+        if prev_backoff is None:
+            os.environ.pop("VELES_SIMD_FAULT_BACKOFF", None)
+        else:
+            os.environ["VELES_SIMD_FAULT_BACKOFF"] = prev_backoff
+        obs.disable()
+        obs.reset()
+        breaker.reset()
+        faults.set_fault_plan(None)
+        faults.reset_fault_history()
+    entries = json.loads(details.read_text())
+    return rc, details, entries
+
+
+def test_replica_campaign_green(replica_campaign):
+    rc, _, _ = replica_campaign
+    assert rc == 0
+
+
+def test_replica_invariants_hold(replica_campaign):
+    _, _, entries = replica_campaign
+    tail = entries[-1]
+    bad = {k: v for k, v in tail["replica_invariants"].items()
+           if not v}
+    assert bad == {}
+    # the acceptance invariants are all present by name
+    for key in ("zero_lost", "zero_double_answered",
+                "failover_observed", "failover_deadlines_carried",
+                "killed_replica_traces_terminal",
+                "killed_replica_frozen", "survivors_absorb_traffic",
+                "drain_graceful", "group_healthz_live",
+                "group_healthz_200", "zero_orphaned_traces"):
+        assert key in tail["replica_invariants"]
+
+
+def test_replica_rows_gate_via_bench_regress(replica_campaign):
+    _, details, entries = replica_campaign
+    rows = [e for e in entries if "metric" in e]
+    metrics = {r["metric"] for r in rows}
+    assert "replica failover throughput" in metrics
+    assert "replica drain throughput" in metrics
+    # kill/drain waves are chaos_phase-stamped (fault-carrying rows:
+    # DEGRADED-not-gated on a dip)
+    stamps = {r["metric"]: r.get("chaos_phase") for r in rows}
+    assert stamps["replica failover throughput"] == "replica_kill"
+    assert stamps["replica drain throughput"] == "replica_drain"
+    history = details.parent / "REPLICA_HISTORY.jsonl"
+    rc = bench_regress.main(["--details", str(details),
+                             "--history", str(history)])
+    assert rc == 0
+
+
+def test_replica_evidence_carries_the_story(replica_campaign):
+    _, _, entries = replica_campaign
+    tail = entries[-1]
+    lifecycle = [(e["decision"], e.get("replica"))
+                 for e in tail["replica_lifecycle_events"]]
+    assert ("kill", "r0") in lifecycle
+    assert ("drain", "r1") in lifecycle
+    assert ("dead", "r1") in lifecycle
+    assert tail["router_failover_events"]
+    # the killed replica's answers froze; the survivors moved
+    assert tail["answered_final"].get("r0", 0) \
+        == tail["answered_after_kill"].get("r0", 0)
+    assert sum(tail["answered_final"].values()) \
+        > sum(tail["answered_after_kill"].values())
+    # the router-level endpoint answered 200 on /healthz at every
+    # checkpoint (before, between, after the failures)
+    for label in ("baseline", "after_kill", "after_drain"):
+        scrape = tail["scrapes"][label]
+        assert scrape["ok"] == 3 and scrape["failed"] == 0
+        assert scrape["routes"]["/healthz"].startswith("200")

@@ -1,0 +1,446 @@
+"""Replica-group serving (``veles/simd_tpu/serve/cluster.py``).
+
+Covers the replica layer the way test_serve.py covers one server:
+group lifecycle (start/stop, kill, drain, heartbeat wedge
+auto-drain), breaker-aware placement scoring (depth, per-shape-class
+open-breaker deprioritization, DEGRADED penalty, round-robin
+control), failover semantics (a killed replica's queued work
+re-routed with the ORIGINAL deadline carried, typed placement
+failure, shed failover via the injection plan, dedup), the group
+aggregation ``/healthz`` endpoint, and the subprocess spawn mode
+(marked slow: each child pays a JAX import).  All deterministic on
+CPU — lifecycle faults are driven through the group's own kill/drain
+API and the ``cluster.heartbeat@<rid>`` injection site.
+"""
+
+import json
+import sys
+import threading
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from veles.simd_tpu import obs, serve  # noqa: E402
+from veles.simd_tpu.ops import iir  # noqa: E402
+from veles.simd_tpu.runtime import breaker, faults  # noqa: E402
+from veles.simd_tpu.serve import cluster  # noqa: E402
+
+RNG = np.random.RandomState(31)
+SOS = iir.butterworth(4, 0.25, "lowpass")
+
+
+@pytest.fixture
+def telemetry(monkeypatch):
+    """Telemetry on, zero backoff, fresh registries before/after."""
+    monkeypatch.setenv("VELES_SIMD_FAULT_BACKOFF", "0")
+    obs.enable(compile_listeners=False)
+    obs.reset()
+    breaker.reset()
+    faults.reset_fault_history()
+    yield
+    obs.disable()
+    obs.reset()
+    breaker.reset()
+    faults.reset_fault_history()
+    faults.set_fault_plan(None)
+
+
+def _signal(n=512):
+    return RNG.randn(n).astype(np.float32)
+
+
+def _sos_request(deadline_ms=None):
+    return serve.Request("sosfilt", _signal(), {"sos": SOS},
+                         tenant="t", deadline_ms=deadline_ms)
+
+
+def _wait_until(pred, timeout_s=5.0):
+    deadline = faults.monotonic() + timeout_s
+    while faults.monotonic() < deadline:
+        if pred():
+            return True
+        threading.Event().wait(0.02)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# group lifecycle
+# ---------------------------------------------------------------------------
+
+class TestGroupLifecycle:
+    def test_start_stop_and_stats_shape(self, telemetry):
+        with cluster.ReplicaGroup(2, max_wait_ms=2.0,
+                                  obs_port=-1) as group:
+            assert group.alive() == 2
+            snap = group.stats()
+            assert snap["health"]["state"] == "healthy"
+            assert [r["rid"] for r in snap["replicas"]] \
+                == ["r0", "r1"]
+            assert all(r["state"] == cluster.UP
+                       for r in snap["replicas"])
+        assert group.alive() == 0
+
+    def test_kill_is_abrupt_and_recorded(self, telemetry):
+        with cluster.ReplicaGroup(2, max_wait_ms=2.0,
+                                  obs_port=-1) as group:
+            group.kill("r0")
+            assert group.alive() == 1
+            assert group.replica("r0").state == cluster.DEAD
+            events = [(e["decision"], e.get("replica"))
+                      for e in obs.events()
+                      if e["op"] == "replica_lifecycle"]
+            assert ("kill", "r0") in events
+
+    def test_drain_answers_queued_work_then_removes(self, telemetry):
+        # a long batching wait keeps the work queued when drain fires
+        with cluster.ReplicaGroup(2, max_batch=32, max_wait_ms=500.0,
+                                  obs_port=-1) as group:
+            router = cluster.FrontRouter(group)
+            tickets = [router.submit(_sos_request())
+                       for _ in range(6)]
+            group.drain("r0")
+            # graceful: every queued request is ANSWERED (drain beats
+            # the 500 ms batching wait by closing the batcher), none
+            # failed over, and the replica is gone afterwards
+            for t in tickets:
+                np.asarray(t.result(timeout=60.0))
+                assert t.status == "ok"
+            assert group.replica("r0").state == cluster.DEAD
+            assert group.alive() == 1
+
+    def test_heartbeat_wedge_auto_drains(self, telemetry):
+        faults.set_fault_plan("cluster.heartbeat@r1:device_lost:99")
+        try:
+            with cluster.ReplicaGroup(2, max_wait_ms=2.0,
+                                      heartbeat_ms=15,
+                                      miss_limit=2,
+                                      obs_port=-1) as group:
+                assert _wait_until(
+                    lambda: group.replica("r1").state
+                    != cluster.UP), "wedged replica never drained"
+                assert _wait_until(
+                    lambda: group.replica("r1").state
+                    == cluster.DEAD)
+                wedged = [e for e in obs.events()
+                          if e["op"] == "replica_lifecycle"
+                          and e["decision"] == "wedged"]
+                assert wedged and wedged[0]["replica"] == "r1"
+                # the healthy replica still serves
+                router = cluster.FrontRouter(group)
+                t = router.submit(_sos_request())
+                np.asarray(t.result(timeout=60.0))
+                assert t.replica == "r0"
+        finally:
+            faults.set_fault_plan(None)
+
+    def test_healthy_heartbeats_recorded(self, telemetry):
+        with cluster.ReplicaGroup(2, max_wait_ms=2.0,
+                                  heartbeat_ms=15,
+                                  obs_port=-1) as group:
+            assert _wait_until(
+                lambda: all(r.last_beat is not None
+                            for r in group.replicas))
+            assert all(r.misses == 0 for r in group.replicas)
+
+
+# ---------------------------------------------------------------------------
+# the aggregation endpoint
+# ---------------------------------------------------------------------------
+
+class TestGroupEndpoint:
+    def test_healthz_aggregates_and_survives_a_kill(self, telemetry):
+        with cluster.ReplicaGroup(2, max_wait_ms=2.0,
+                                  obs_port=0) as group:
+            url = f"http://127.0.0.1:{group.obs_port}/healthz"
+            body = json.loads(urllib.request.urlopen(
+                url, timeout=5).read())
+            assert body["alive"] == 2
+            assert body["health"]["state"] == "healthy"
+            group.kill("r0")
+            # one replica down: the GROUP is still healthy (200)
+            body = json.loads(urllib.request.urlopen(
+                url, timeout=5).read())
+            assert body["alive"] == 1
+            assert body["health"]["state"] == "healthy"
+
+    def test_healthz_503_when_group_is_gone(self, telemetry):
+        with cluster.ReplicaGroup(2, max_wait_ms=2.0,
+                                  obs_port=0) as group:
+            group.kill("r0")
+            group.kill("r1")
+            url = f"http://127.0.0.1:{group.obs_port}/healthz"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url, timeout=5)
+            assert ei.value.code == 503
+
+    def test_replica_servers_do_not_arm_endpoints(self, telemetry,
+                                                  monkeypatch):
+        # even with the env var set, in-process replicas stay
+        # disarmed — ONE aggregation endpoint per group (otherwise N
+        # replicas race one port: the EndpointUnavailable story)
+        monkeypatch.setenv("VELES_SIMD_OBS_PORT", "0")
+        with cluster.ReplicaGroup(2, max_wait_ms=2.0,
+                                  obs_port=0) as group:
+            assert group.obs_port is not None
+            for r in group.replicas:
+                assert r.server.obs_port is None
+
+
+# ---------------------------------------------------------------------------
+# placement scoring
+# ---------------------------------------------------------------------------
+
+class TestPlacement:
+    def test_least_loaded_prefers_shallow_queue(self, telemetry):
+        with cluster.ReplicaGroup(2, max_wait_ms=2.0,
+                                  obs_port=-1) as group:
+            router = cluster.FrontRouter(group)
+            key = ("sosfilt", (), 512)
+            # artificially deepen r0's admitted queue
+            for _ in range(5):
+                group.replica("r0").server._admission.admit("x")
+            assert router.score(group.replica("r0"), key) \
+                > router.score(group.replica("r1"), key)
+            assert router._pick(key, set()).rid == "r1"
+            for _ in range(5):
+                group.replica("r0").server._admission.release("x")
+
+    def test_open_breaker_deprioritizes_class_not_replica(
+            self, telemetry):
+        with cluster.ReplicaGroup(2, max_wait_ms=2.0,
+                                  obs_port=-1) as group:
+            router = cluster.FrontRouter(group)
+            key = ("sosfilt", (), 512)
+            other = ("stft", (), 512)
+            r0 = group.replica("r0")
+            br = breaker.breaker_for(
+                "serve.dispatch", r0.server.breaker_key(key))
+            br.failure()
+            br.failure()
+            assert br.state == breaker.OPEN
+            # the poisoned class avoids r0...
+            assert router._pick(key, set()).rid == "r1"
+            # ...but a different shape class still scores r0 clean
+            # (per shape class, not a global blacklist)
+            assert router.score(r0, other) \
+                < cluster.BREAKER_OPEN_PENALTY
+
+    def test_degraded_replica_deprioritized_not_blacklisted(
+            self, telemetry):
+        with cluster.ReplicaGroup(2, max_wait_ms=2.0,
+                                  obs_port=-1) as group:
+            router = cluster.FrontRouter(group)
+            key = ("sosfilt", (), 512)
+            group.replica("r0").server._health.trip("serve.dispatch")
+            assert router._pick(key, set()).rid == "r1"
+            # sole survivor degraded: still takes traffic
+            group.kill("r1")
+            assert router._pick(key, set()).rid == "r0"
+
+    def test_round_robin_policy_rotates(self, telemetry):
+        with cluster.ReplicaGroup(3, max_wait_ms=2.0,
+                                  obs_port=-1) as group:
+            router = cluster.FrontRouter(group,
+                                         policy="round_robin")
+            key = ("sosfilt", (), 512)
+            picks = [router._pick(key, set()).rid for _ in range(6)]
+            assert picks == ["r0", "r1", "r2"] * 2
+
+    def test_env_policy_and_validation(self, telemetry, monkeypatch):
+        monkeypatch.setenv(cluster.ROUTER_POLICY_ENV, "round_robin")
+        with cluster.ReplicaGroup(2, max_wait_ms=2.0,
+                                  obs_port=-1) as group:
+            assert cluster.FrontRouter(group).policy == "round_robin"
+            with pytest.raises(ValueError, match="policy"):
+                cluster.FrontRouter(group, policy="coin_flip")
+
+    def test_env_replica_count(self, monkeypatch):
+        monkeypatch.setenv(cluster.REPLICAS_ENV, "3")
+        group = cluster.ReplicaGroup(max_wait_ms=2.0, obs_port=-1)
+        assert len(group.replicas) == 3
+        monkeypatch.setenv(cluster.REPLICAS_ENV, "bogus")
+        assert len(cluster.ReplicaGroup(
+            max_wait_ms=2.0, obs_port=-1).replicas) \
+            == cluster.DEFAULT_REPLICAS
+
+
+# ---------------------------------------------------------------------------
+# routed answers + failover
+# ---------------------------------------------------------------------------
+
+class TestRouterAnswers:
+    def test_routed_answer_matches_oracle(self, telemetry):
+        from veles.simd_tpu.ops import batched
+
+        with cluster.ReplicaGroup(2, max_wait_ms=2.0,
+                                  obs_port=-1) as group:
+            router = cluster.FrontRouter(group)
+            x = _signal()
+            t = router.submit(op="sosfilt", x=x,
+                              params={"sos": SOS})
+            got = np.asarray(t.result(timeout=60.0))
+            want = np.asarray(batched.batched_sosfilt(
+                SOS, x[None, :], simd=False))[0]
+            np.testing.assert_allclose(got, want, rtol=2e-3,
+                                       atol=2e-3)
+            assert t.status == "ok" and t.replica in ("r0", "r1")
+
+    def test_kill_fails_over_queued_work_with_deadline_carried(
+            self, telemetry):
+        with cluster.ReplicaGroup(2, max_batch=32,
+                                  max_wait_ms=300.0,
+                                  obs_port=-1) as group:
+            router = cluster.FrontRouter(group)
+            tickets = [router.submit(_sos_request(
+                deadline_ms=30000.0)) for _ in range(8)]
+            group.kill("r0")
+            for t in tickets:
+                np.asarray(t.result(timeout=60.0))
+                assert t.status == "ok"
+                assert t.replica == "r1"
+            failed_over = [t for t in tickets if t.failovers]
+            assert failed_over, "kill caught no queued work"
+            for t in failed_over:
+                # the re-submission carried the ORIGINAL deadline's
+                # remaining budget — stamps only ever shrink
+                assert len(t.deadlines_ms) >= 2
+                assert t.deadlines_ms[-1] <= t.deadlines_ms[0]
+                assert t.deadlines_ms[-1] > 0
+                # and the dead replica's ticket closed its causal
+                # chain before the re-route
+                assert t.prior_traces
+                assert all(tr.status == "closed"
+                           for tr in t.prior_traces)
+            st = router.stats()
+            assert st["failovers"] >= len(failed_over)
+            assert st["answered_by_replica"].get("r0", 0) \
+                + st["answered_by_replica"]["r1"] == 8
+
+    def test_injected_shed_fails_over_to_sibling(self, telemetry):
+        # one planned admission overload: the first replica sheds,
+        # the router retries the sibling — deterministic, no queue
+        # racing
+        with cluster.ReplicaGroup(2, max_wait_ms=2.0,
+                                  obs_port=-1) as group:
+            router = cluster.FrontRouter(group)
+            with faults.fault_plan("serve.admission:overload:1"):
+                t = router.submit(_sos_request())
+                np.asarray(t.result(timeout=60.0))
+            assert t.status == "ok"
+            assert t.failovers == 1
+            assert router.stats()["failovers"] == 1
+
+    def test_no_replica_available_is_typed_shed(self, telemetry):
+        with cluster.ReplicaGroup(2, max_wait_ms=2.0,
+                                  obs_port=-1) as group:
+            router = cluster.FrontRouter(group)
+            group.kill("r0")
+            group.kill("r1")
+            t = router.submit(_sos_request())
+            with pytest.raises(serve.Overloaded) as ei:
+                t.result(timeout=5.0)
+            assert ei.value.scope == "cluster"
+            assert t.status == "shed"
+
+    def test_expired_request_not_failed_over(self, telemetry):
+        # a request whose own deadline passed answers expired — the
+        # router must not burn failover budget on it
+        with cluster.ReplicaGroup(2, max_batch=32,
+                                  max_wait_ms=50.0,
+                                  obs_port=-1) as group:
+            router = cluster.FrontRouter(group)
+            t = router.submit(_sos_request(deadline_ms=0.002))
+            with pytest.raises(serve.DeadlineExceeded):
+                t.result(timeout=30.0)
+            assert t.status == "expired"
+            assert t.failovers == 0
+
+    def test_router_ticket_dedups(self, telemetry):
+        with cluster.ReplicaGroup(1, max_wait_ms=2.0,
+                                  obs_port=-1) as group:
+            router = cluster.FrontRouter(group)
+            t = router.submit(_sos_request())
+            np.asarray(t.result(timeout=60.0))
+            # a late duplicate completion is dropped and counted,
+            # never surfaced — the zero-double-answer backstop
+            assert not t._complete(value=None, status="ok")
+            assert obs.counter_value("router_dedup",
+                                     op="sosfilt") == 1
+
+    def test_pipeline_ops_route_through_group(self, telemetry):
+        sys.path.insert(0, str(REPO / "tools"))
+        import loadgen
+
+        compiled = loadgen.build_pipeline("clusterline")
+        with cluster.ReplicaGroup(2, max_wait_ms=2.0,
+                                  obs_port=-1) as group:
+            op = group.register_pipeline("clusterline", compiled)
+            router = cluster.FrontRouter(group)
+            x = RNG.randn(compiled.block_len).astype(np.float32)
+            t = router.submit(op=op, x=x, params={"state": None})
+            out, state = t.result(timeout=60.0)
+            assert np.asarray(out).shape[0] >= 1
+            assert state is not None
+
+    def test_validation_raises_synchronously(self, telemetry):
+        with cluster.ReplicaGroup(1, max_wait_ms=2.0,
+                                  obs_port=-1) as group:
+            router = cluster.FrontRouter(group)
+            with pytest.raises(ValueError, match="unsupported op"):
+                router.submit(op="fft9000", x=_signal())
+            with pytest.raises(ValueError, match="1-D"):
+                router.submit(op="sosfilt", x=np.zeros((2, 8)),
+                              params={"sos": SOS})
+
+
+# ---------------------------------------------------------------------------
+# subprocess spawn mode (the multi-host topology proof)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestSubprocessMode:
+    def test_subprocess_replica_serves_health_and_metrics(
+            self, telemetry, monkeypatch):
+        monkeypatch.setenv("VELES_SIMD_PLATFORM", "cpu")
+        with cluster.ReplicaGroup(1, spawn="subprocess",
+                                  heartbeat_ms=200, max_batch=3,
+                                  obs_port=-1) as group:
+            r = group.replica("r0")
+            assert r.port is not None
+            body = r.ping()
+            assert body.get("endpoint") == "ok"
+            # the operator's server policy reached the child — not a
+            # silently default-configured replica
+            assert body["batcher"]["max_batch"] == 3
+            metrics = urllib.request.urlopen(
+                f"http://127.0.0.1:{r.port}/metrics",
+                timeout=10).read()
+            assert metrics          # prometheus text, non-empty
+            # the router refuses a subprocess group with a typed,
+            # actionable error (placement needs the RPC layer)
+            with pytest.raises(ValueError, match="subprocess"):
+                cluster.FrontRouter(group)
+
+    def test_subprocess_kill_and_group_health(self, telemetry,
+                                              monkeypatch):
+        monkeypatch.setenv("VELES_SIMD_PLATFORM", "cpu")
+        with cluster.ReplicaGroup(1, spawn="subprocess",
+                                  heartbeat_ms=200,
+                                  obs_port=-1) as group:
+            group.kill("r0")
+            assert group.replica("r0").proc.poll() is not None
+            assert group.stats()["health"]["state"] == "degraded"
+
+    def test_subprocess_replica_refuses_disarmed_endpoint(self):
+        # a subprocess replica's /healthz IS its heartbeat surface —
+        # a disarmed endpoint must refuse at start, typed, not wedge
+        # the spawn handshake
+        r = cluster.Replica("rx", spawn="subprocess",
+                            server_kwargs={"obs_port": -1})
+        with pytest.raises(ValueError, match="obs_port"):
+            r.start()

@@ -632,6 +632,65 @@ def peek():
 
 
 # ---------------------------------------------------------------------------
+# the cluster router rule (serve/cluster.py, PR 13)
+# ---------------------------------------------------------------------------
+
+CLUSTER_GOOD = '''
+class Router:
+    def _submit_to_replica(self, replica, request, ctx):
+        remaining = self._remaining(ctx)
+        return replica.server.submit(request, deadline_ms=remaining)
+
+    def _place(self, replica, request, ctx):
+        return self._submit_to_replica(replica, request, ctx)
+'''
+
+CLUSTER_BYPASS = '''
+class Router:
+    def _submit_to_replica(self, replica, request, ctx):
+        return replica.server.submit(request)
+
+    def _failover(self, replica, request):
+        # fresh-deadline drift: submits around the funnel
+        return replica.server.submit(request, deadline_ms=1000.0)
+'''
+
+CLUSTER_HELPER_BYPASS = '''
+def quick_place(group, request):
+    return group.replicas[0].server.submit(request)
+'''
+
+
+def _cluster_errs(src):
+    return lint.cluster_router_errors(ast.parse(src), "mod.py")
+
+
+def test_cluster_rule_passes_funnelled_router():
+    assert _cluster_errs(CLUSTER_GOOD) == []
+
+
+def test_cluster_rule_flags_submit_outside_funnel():
+    errs = _cluster_errs(CLUSTER_BYPASS)
+    assert len(errs) == 1
+    assert "_submit_to_replica" in errs[0]
+
+
+def test_cluster_rule_flags_module_level_helper():
+    errs = _cluster_errs(CLUSTER_HELPER_BYPASS)
+    assert len(errs) == 1
+
+
+def test_real_cluster_module_passes_cluster_rule():
+    f = REPO / "veles" / "simd_tpu" / "serve" / "cluster.py"
+    tree = ast.parse(f.read_text(), str(f))
+    assert lint.cluster_router_errors(tree, str(f)) == []
+    # and the generic serve rules hold for it too (no raw time,
+    # request-trace terminal metrics banned)
+    assert lint.serve_layer_errors(tree, str(f)) == []
+    assert lint.request_trace_errors(tree, str(f)) == []
+
+
+# ---------------------------------------------------------------------------
 # the request-trace rule (obs v4): terminal request accounting in
 # serve//pipeline/ must flow through the request-trace API — a
 # hand-rolled obs.count/observe of the terminal metrics drifts

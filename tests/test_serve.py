@@ -429,6 +429,81 @@ def test_latency_histogram_has_no_survivorship_bias(telemetry):
                              status="shed") == 1
 
 
+def test_stop_nodrain_closes_traces_with_terminal_edge(telemetry):
+    """PR 13 satellite: ``stop(drain=False)`` abandons queued work —
+    but every abandoned ticket must still answer typed (``closed``),
+    close its request trace with a terminal edge, and land in
+    ``serve.request_latency{status=closed}``, so the
+    ``zero_orphaned_traces`` invariant holds outside chaos campaigns
+    too."""
+    with_worker = serve.Server(max_batch=32, max_wait_ms=60000.0,
+                               workers=1)
+    with_worker.start()
+    tickets = [with_worker.submit(serve.Request(
+        "sosfilt", _signal(256), {"sos": SOS})) for _ in range(3)]
+    with_worker.stop(drain=False)
+    for t in tickets:
+        assert t.status == "closed"
+        with pytest.raises(serve.ServerClosed):
+            t.result(timeout=1.0)
+        assert t.trace.status == "closed"       # terminal edge
+        assert t.trace.events()[-1]["event"] in ("closed", "error")
+    by_status = {h["labels"]["status"]: h["count"]
+                 for h in obs.snapshot()["histograms"]
+                 if h["name"] == "serve.request_latency"
+                 and h["labels"].get("op") == "sosfilt"}
+    assert by_status.get("closed", 0) == 3
+    # admission slots released: the queue is genuinely empty
+    assert with_worker._admission.depth() == 0
+
+
+def test_stop_nodrain_unstarted_server_loses_nothing(telemetry):
+    """The regression that motivated the satellite: a server stopped
+    before (or without) ``start()`` has NO worker to answer the
+    abandoned queue — the stop path itself must sweep it, or the
+    tickets hang forever with open traces."""
+    srv = serve.Server(max_wait_ms=1.0)
+    tickets = [srv.submit(serve.Request(
+        "sosfilt", _signal(128), {"sos": SOS})) for _ in range(4)]
+    srv.stop(drain=False)
+    for t in tickets:
+        assert t.done() and t.status == "closed"
+        assert t.trace.status == "closed"
+    assert srv._admission.depth() == 0
+    # drain=True on an unstarted server must sweep too (nobody will
+    # ever answer): typed closed, not a hang
+    srv2 = serve.Server(max_wait_ms=1.0)
+    t2 = srv2.submit(serve.Request("sosfilt", _signal(128),
+                                   {"sos": SOS}))
+    srv2.stop(drain=True)
+    assert t2.done() and t2.status == "closed"
+
+
+def test_obs_port_conflict_raises_typed_at_start(telemetry):
+    """PR 13 satellite: two servers arming one port must fail at
+    ``start()`` with a typed, actionable error — not die later in the
+    serving thread — and leave the loser fully un-started."""
+    from veles.simd_tpu.obs import http as obs_http
+
+    first = serve.Server(max_wait_ms=1.0, obs_port=0).start()
+    try:
+        second = serve.Server(max_wait_ms=1.0,
+                              obs_port=first.obs_port)
+        with pytest.raises(obs_http.EndpointUnavailable) as ei:
+            second.start()
+        assert ei.value.port == first.obs_port
+        assert "obs_port=0" in str(ei.value)    # actionable
+        assert not second._started
+        assert second._threads == []
+        # the loser recovers on a free port
+        second._obs_port_arg = 0
+        second.start()
+        assert second.obs_port not in (None, first.obs_port)
+        second.stop()
+    finally:
+        first.stop()
+
+
 def test_loadgen_bench_rows_shape(telemetry):
     report = {"throughput_rps": 123.4, "wait_p99_s": 0.02}
     rows = loadgen.bench_rows(report)

@@ -107,6 +107,14 @@ DEFAULT_NOISE = [
     ("chaos", 0.50),
     ("deadline hit rate", 0.25),
     ("tenant fairness", 0.40),
+    # the replicated campaign (tools/chaos.py --replicas --details
+    # REPLICA_DETAILS.json): wall-clock req/s of waves that
+    # deliberately kill / drain a replica mid-measurement — the
+    # failover wave carries an abrupt kill (throughput dips with the
+    # kill's timing), the drain wave a graceful removal; both are
+    # chaos_phase-stamped so dips report DEGRADED-not-gated anyway
+    ("replica failover", 0.50),
+    ("replica drain", 0.50),
     # the pipeline family (bench.py configs 12/13): wall-clock blocks/s
     # through the fused sensor chain vs its stage-by-stage twin — host
     # dispatch + device jitter on both sides — and the inverse-p99 row

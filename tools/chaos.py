@@ -68,6 +68,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -482,6 +483,212 @@ def run_campaign(args) -> tuple:
     return invariants, rows, evidence
 
 
+# ---------------------------------------------------------------------------
+# the replicated campaign (make chaos-replicas): kill one, drain one
+# ---------------------------------------------------------------------------
+
+# merged accounting keys specific to routed traffic (run_load only
+# emits them when they fire, so merge with .get defaults)
+_ROUTER_KEYS = ("failovers", "failover_deadline_checked",
+                "failover_deadline_violations", "prior_trace_checked",
+                "prior_trace_orphans")
+
+
+def _merge_router(reports: list) -> dict:
+    total = _merge_reports(reports)
+    for rep in reports:
+        for k in _ROUTER_KEYS:
+            total[k] = total.get(k, 0) + rep.get(k, 0)
+    return total
+
+
+def run_replica_campaign(args) -> tuple:
+    """The 2-phase replica-kill campaign over a 3-replica group behind
+    the front router: (1) kill one replica abruptly — no drain —
+    MID-TRAFFIC (its queued work must fail over, deadlines carried);
+    (2) drain another gracefully mid-traffic (answered, then removed)
+    while the router-level ``/healthz`` answers throughout.  Returns
+    ``(invariants, rows, evidence)``."""
+    from veles.simd_tpu.serve import cluster
+
+    rng = np.random.RandomState(args.seed)
+    # max_wait large enough that the mid-traffic kill catches queued
+    # work (the failover path must actually fire), max_batch above the
+    # wave size so batches wait rather than dispatch instantly
+    group = cluster.ReplicaGroup(3, max_batch=32, max_wait_ms=150.0,
+                                 workers=args.workers,
+                                 heartbeat_ms=40.0, obs_port=0)
+    router = cluster.FrontRouter(group)
+    scrapes: dict = {}
+    phase_reports: dict = {}
+    with group:
+        # -- warmup: compile the traffic mix's handles so the kill
+        # wave measures routing, not XLA compiles
+        warm = loadgen.run_load(
+            router, loadgen.build_schedule(
+                rng, 8, rate_hz=0.0, deadline_ms=args.deadline_ms),
+            verify=0, rng=rng, result_timeout=args.result_timeout)
+        scrapes["baseline"] = loadgen.scrape_endpoint(group.obs_port)
+        # wait until every replica has beaten at least once
+        deadline = faults.monotonic() + 2.0
+        while faults.monotonic() < deadline and not all(
+                r.last_beat is not None for r in group.replicas):
+            threading.Event().wait(0.02)
+        beats_seen = all(r.last_beat is not None
+                         for r in group.replicas)
+
+        # -- phase 1: abrupt kill, no drain, mid-traffic ------------
+        t0 = time.perf_counter()
+        rep_kill = loadgen.run_load(
+            router, loadgen.build_schedule(
+                rng, args.requests, rate_hz=0.0,
+                deadline_ms=args.deadline_ms),
+            verify=args.verify, rng=rng,
+            result_timeout=args.result_timeout,
+            mid_hook=lambda: group.kill("r0"))
+        rep_kill["phase_wall_s"] = time.perf_counter() - t0
+        rep_kill["throughput_rps"] = (
+            (rep_kill["ok"] + rep_kill["degraded"])
+            / rep_kill["phase_wall_s"]
+            if rep_kill["phase_wall_s"] > 0 else 0.0)
+        phase_reports["replica_kill"] = rep_kill
+        scrapes["after_kill"] = loadgen.scrape_endpoint(
+            group.obs_port)
+        answered_after_kill = dict(
+            router.stats()["answered_by_replica"])
+
+        # -- phase 2: graceful drain, mid-traffic -------------------
+        t0 = time.perf_counter()
+        rep_drain = loadgen.run_load(
+            router, loadgen.build_schedule(
+                rng, args.requests, rate_hz=0.0,
+                deadline_ms=args.deadline_ms),
+            verify=args.verify, rng=rng,
+            result_timeout=args.result_timeout,
+            mid_hook=lambda: group.drain("r1"))
+        rep_drain["phase_wall_s"] = time.perf_counter() - t0
+        rep_drain["throughput_rps"] = (
+            (rep_drain["ok"] + rep_drain["degraded"])
+            / rep_drain["phase_wall_s"]
+            if rep_drain["phase_wall_s"] > 0 else 0.0)
+        phase_reports["replica_drain"] = rep_drain
+        scrapes["after_drain"] = loadgen.scrape_endpoint(
+            group.obs_port)
+        rstats = router.stats()
+        answered_final = dict(rstats["answered_by_replica"])
+        group_stats = group.stats()
+
+    total = _merge_router([warm, rep_kill, rep_drain])
+    answered = total["ok"] + total["degraded"]
+    drain_delta_survivors = (
+        sum(answered_final.get(r, 0) for r in ("r1", "r2"))
+        - sum(answered_after_kill.get(r, 0) for r in ("r1", "r2")))
+    healthz_200 = {
+        label: s["routes"].get("/healthz", "").startswith("200")
+        for label, s in scrapes.items()}
+    lifecycle = [
+        (e["decision"], e.get("replica"))
+        for e in _decisions("replica_lifecycle")]
+    invariants = {
+        "zero_lost": total["lost"] == 0,
+        "zero_double_answered": (
+            total["double_answered"] == 0
+            and _counter_total("router_dedup") == 0),
+        "zero_untyped_errors": total["errors"] == 0,
+        "parity_clean": total["parity_failures"] == 0,
+        # the kill actually orphaned queued work and the router
+        # re-routed every bit of it onto survivors
+        "failover_observed": total["failovers"] >= 1,
+        # every re-submission carried the ORIGINAL deadline's
+        # remaining budget — never a fresh stamp
+        "failover_deadlines_carried": (
+            total["failover_deadline_checked"] >= 1
+            and total["failover_deadline_violations"] == 0),
+        # the killed replica's requests all reached a terminal edge
+        # before re-routing — no orphaned causal chains
+        "killed_replica_traces_terminal": (
+            total["prior_trace_checked"] >= 1
+            and total["prior_trace_orphans"] == 0),
+        # the dead replica answers nothing after its kill; the
+        # survivors absorb the whole drain-phase wave
+        "killed_replica_frozen": (
+            answered_final.get("r0", 0)
+            == answered_after_kill.get("r0", 0)),
+        "survivors_absorb_traffic": (
+            drain_delta_survivors
+            == rep_drain["ok"] + rep_drain["degraded"]
+            and rep_drain["ok"] + rep_drain["degraded"] >= 1),
+        # graceful drain loses nothing and leaves exactly one
+        # survivor taking traffic
+        "drain_graceful": (group_stats["alive"] == 1
+                           and ("drain", "r1") in lifecycle
+                           and ("dead", "r1") in lifecycle),
+        "kill_recorded": ("kill", "r0") in lifecycle,
+        "heartbeats_observed": beats_seen,
+        # the router-level aggregation endpoint answered all three
+        # routes — 200 on /healthz — before, between, and after the
+        # failures (one replica always remained healthy)
+        "group_healthz_live": all(
+            s["ok"] == 3 and s["failed"] == 0
+            for s in scrapes.values()),
+        "group_healthz_200": all(healthz_200.values()),
+        # the request axis stays complete across the group
+        "zero_orphaned_traces": (total["trace_checked"] > 0
+                                 and total["trace_orphans"] == 0),
+        "trace_phases_sum_to_total": total["trace_phase_err"] == 0,
+        "answers_accounted": (
+            answered + total["shed"] + total["deadline_miss"]
+            + total["closed"] + total["errors"]
+            == total["requests"]),
+    }
+
+    rows = [
+        {"metric": "replica campaign answered",
+         "value": float(answered), "unit": "req",
+         "vs_baseline": None},
+        {"metric": "replica failover throughput",
+         "value": round(rep_kill["throughput_rps"], 2),
+         "unit": "req/s", "vs_baseline": None,
+         # measured while a replica dies mid-wave: fault-carrying,
+         # DEGRADED-not-gated on a dip
+         "chaos_phase": "replica_kill"},
+        {"metric": "replica drain throughput",
+         "value": round(rep_drain["throughput_rps"], 2),
+         "unit": "req/s", "vs_baseline": None,
+         "chaos_phase": "replica_drain"},
+    ]
+    snap = obs.snapshot()
+    counters = {}
+    for c in snap["counters"]:
+        if c["name"].startswith(("router_", "replica_", "serve_")):
+            counters[c["name"]] = counters.get(c["name"], 0) \
+                + c["value"]
+    rows.append({
+        "metric": "replica failovers",
+        "value": float(total["failovers"]), "unit": "requests",
+        "vs_baseline": None, "chaos_phase": "replica_kill",
+        "telemetry": {"counters": counters},
+    })
+    evidence = {
+        "replica_invariants": invariants,
+        "phase_reports": {k: {kk: vv for kk, vv in v.items()
+                              if not isinstance(vv, np.ndarray)}
+                          for k, v in phase_reports.items()},
+        "router": {k: rstats[k] for k in
+                   ("policy", "max_failovers", "placed_by_replica",
+                    "answered_by_replica", "failovers",
+                    "placement_failures")},
+        "answered_after_kill": answered_after_kill,
+        "answered_final": answered_final,
+        "replica_lifecycle_events":
+            _decisions("replica_lifecycle"),
+        "router_failover_events": _decisions("router_failover"),
+        "scrapes": scrapes,
+        "group": group_stats,
+    }
+    return invariants, rows, evidence
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--requests", type=int, default=48,
@@ -504,47 +711,64 @@ def main(argv=None) -> int:
     ap.add_argument("--verify", type=int, default=8)
     ap.add_argument("--result-timeout", type=float, default=300.0)
     ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--details", default="CHAOS_DETAILS.json",
+    ap.add_argument("--details", default=None,
                     help="write BENCH_DETAILS-format rows + evidence "
-                         "here")
+                         "here (default CHAOS_DETAILS.json, or "
+                         "REPLICA_DETAILS.json with --replicas)")
     ap.add_argument("--smoke", action="store_true",
                     help="seconds-long CPU campaign (the CI gate)")
+    ap.add_argument("--replicas", action="store_true",
+                    help="run the 2-phase REPLICATED campaign "
+                         "instead (make chaos-replicas): kill one "
+                         "replica abruptly mid-traffic, drain "
+                         "another gracefully, gate group-wide "
+                         "zero-lost/failover/healthz invariants")
     args = ap.parse_args(argv)
+    if args.details is None:
+        args.details = ("REPLICA_DETAILS.json" if args.replicas
+                        else "CHAOS_DETAILS.json")
     if args.smoke:
         args.requests = min(args.requests, 24)
         args.steady = min(args.steady, 8)
         args.verify = min(args.verify, 4)
 
-    # the sharded phase needs the virtual CPU mesh (the pin must win
-    # the race to backend init); in-process callers (tests) already
-    # pinned it, in which case the failed re-pin is fine as long as
-    # enough devices exist
-    import jax
+    if not args.replicas:
+        # the sharded phase needs the virtual CPU mesh (the pin must
+        # win the race to backend init); in-process callers (tests)
+        # already pinned it, in which case the failed re-pin is fine
+        # as long as enough devices exist
+        import jax
 
-    from veles.simd_tpu.utils.platform import pin_cpu
+        from veles.simd_tpu.utils.platform import pin_cpu
 
-    try:
-        pin_cpu(args.mesh_devices)
-    except RuntimeError:
-        if len(jax.devices()) < args.mesh_devices:
-            raise
+        try:
+            pin_cpu(args.mesh_devices)
+        except RuntimeError:
+            if len(jax.devices()) < args.mesh_devices:
+                raise
 
     obs.enable()
     obs.reset()
     breaker.reset()
     faults.reset_fault_history()
-    # a tight half-open cadence keeps the recovery phase's counting
-    # argument exact: a closed-at-end breaker within the scripted
-    # number of calls (restored after the campaign)
-    prev_cadence = os.environ.get(breaker.BREAKER_PROBE_EVERY_ENV)
-    os.environ[breaker.BREAKER_PROBE_EVERY_ENV] = "2"
-    try:
-        invariants, rows, evidence = run_campaign(args)
-    finally:
-        if prev_cadence is None:
-            os.environ.pop(breaker.BREAKER_PROBE_EVERY_ENV, None)
-        else:
-            os.environ[breaker.BREAKER_PROBE_EVERY_ENV] = prev_cadence
+    if args.replicas:
+        invariants, rows, evidence = run_replica_campaign(args)
+    else:
+        # a tight half-open cadence keeps the recovery phase's
+        # counting argument exact: a closed-at-end breaker within the
+        # scripted number of calls (restored after the campaign)
+        prev_cadence = os.environ.get(
+            breaker.BREAKER_PROBE_EVERY_ENV)
+        os.environ[breaker.BREAKER_PROBE_EVERY_ENV] = "2"
+        try:
+            invariants, rows, evidence = run_campaign(args)
+        finally:
+            if prev_cadence is None:
+                os.environ.pop(breaker.BREAKER_PROBE_EVERY_ENV,
+                               None)
+            else:
+                os.environ[breaker.BREAKER_PROBE_EVERY_ENV] = \
+                    prev_cadence
 
     print(json.dumps({"invariants": invariants,
                       "rows": rows}, indent=2, default=str))

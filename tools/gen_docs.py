@@ -53,6 +53,7 @@ MODULES = [
     "veles.simd_tpu.serve.batcher",
     "veles.simd_tpu.serve.admission",
     "veles.simd_tpu.serve.health",
+    "veles.simd_tpu.serve.cluster",
     "veles.simd_tpu.utils.config",
     "veles.simd_tpu.utils.memory",
     "veles.simd_tpu.utils.benchmark",

@@ -724,6 +724,50 @@ def request_trace_errors(tree, fname) -> list:
     return errors
 
 
+# --- cluster router rule (serve/cluster.py) ---------------------------------
+# The front router (PR 13) places requests onto replica servers; its
+# robustness contract lives in ONE funnel: ``_submit_to_replica`` is
+# the only call site allowed to submit into a replica, because that is
+# where the carried-deadline arithmetic (failover re-submissions get
+# the ORIGINAL deadline's remaining budget, never a fresh stamp) and
+# the typed placement-failure handling live.  A second submission path
+# — initial placement, failover, a helper someone adds later — that
+# bypasses the funnel silently re-stamps deadlines and loses the
+# placement-failure retry, exactly the drift this rule forbids: any
+# ``<expr>.submit(...)`` call in serve/cluster.py outside the funnel's
+# body is a lint failure.  (The generic serve rules — no raw time
+# imports, request-trace terminal metrics banned, guarded batched
+# dispatch — apply to cluster.py as to every serve module.)
+
+_CLUSTER_RULE_FILE = "veles/simd_tpu/serve/cluster.py"
+_CLUSTER_FUNNEL = "_submit_to_replica"
+
+
+def cluster_router_errors(tree, fname) -> list:
+    """The rule body on a parsed module (separated so tests can feed
+    synthetic sources).  Returns human-readable error strings."""
+    errors = []
+    funnel_nodes: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == _CLUSTER_FUNNEL:
+            funnel_nodes.update(id(w) for w in ast.walk(node))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "submit"):
+            continue
+        if id(node) not in funnel_nodes:
+            errors.append(
+                f"{fname}:{node.lineno}: replica submission outside "
+                f"the {_CLUSTER_FUNNEL} funnel — router dispatch must "
+                "go through the one guarded path that carries the "
+                "original request deadline and handles typed "
+                "placement failure")
+    return errors
+
+
 # --- sharded-dispatch rule (parallel/ops.py) --------------------------------
 # PR 10 wrapped every instrumented shard_map dispatch in parallel/ops.py
 # in the fault policy (faults.guarded thunks with a single-chip degrade
@@ -1093,6 +1137,12 @@ def compute_module_lint(files) -> int:
             for msg in request_trace_errors(tree, str(f)):
                 print(msg)
                 failures += 1
+            if rel == _CLUSTER_RULE_FILE:
+                # the front router additionally funnels every replica
+                # submission through its one guarded path
+                for msg in cluster_router_errors(tree, str(f)):
+                    print(msg)
+                    failures += 1
             continue
         if in_pipeline:
             # the pipeline package takes its own structural contract

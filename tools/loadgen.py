@@ -320,19 +320,31 @@ def _canonical(req: serve.Request) -> dict:
 def run_load(server, schedule, *, block: bool = False,
              block_timeout: float | None = 1.0,
              result_timeout: float = 120.0,
-             verify: int = 0, rng=None) -> dict:
+             verify: int = 0, rng=None,
+             mid_hook=None, mid_hook_after: int | None = None) -> dict:
     """Submit ``schedule`` against ``server``, wait for every ticket,
     and return the accounting report (see module docstring for the
     categories).  ``verify=k`` parity-checks ``k`` randomly sampled
     answered requests against the NumPy oracle (DEGRADED answers ARE
-    the oracle, so they must match exactly-ish too)."""
+    the oracle, so they must match exactly-ish too).  ``server`` is
+    anything with the submit/ticket contract — a ``serve.Server`` or
+    a ``serve.cluster.FrontRouter``.  ``mid_hook`` is called once,
+    MID-TRAFFIC, after ``mid_hook_after`` submissions (default:
+    halfway) — the replicated chaos campaign's replica kill/drain
+    trigger, fired while work is genuinely queued."""
     t0 = time.perf_counter()
+    if mid_hook is not None and mid_hook_after is None:
+        mid_hook_after = len(schedule) // 2
     pairs = []
-    for gap, req in schedule:
+    for i, (gap, req) in enumerate(schedule):
         if gap > 0:
             time.sleep(gap)
+        if mid_hook is not None and i == mid_hook_after:
+            mid_hook()
         pairs.append((req, server.submit(req, block=block,
                                          timeout=block_timeout)))
+    if mid_hook is not None and mid_hook_after >= len(schedule):
+        mid_hook()
     submitted_s = time.perf_counter() - t0
     report = {"requests": len(pairs), "ok": 0, "degraded": 0,
               "shed": 0, "closed": 0, "errors": 0, "lost": 0,
@@ -366,6 +378,37 @@ def run_load(server, schedule, *, block: bool = False,
         report["degraded" if ticket.degraded else "ok"] += 1
         tenant_answered[req.tenant] = \
             tenant_answered.get(req.tenant, 0) + 1
+        rid = getattr(ticket, "replica", None)
+        if rid is not None:     # routed traffic: per-replica tallies
+            by_rep = report.setdefault("replica_answered", {})
+            by_rep[rid] = by_rep.get(rid, 0) + 1
+            if getattr(ticket, "failovers", 0):
+                report["failovers"] = report.get("failovers", 0) \
+                    + ticket.failovers
+                # the carried-deadline proof: every re-submission's
+                # stamp must be the ORIGINAL deadline's remaining
+                # budget — the per-attempt stamps may only shrink
+                dls = [d for d in getattr(ticket, "deadlines_ms", ())
+                       if d is not None]
+                if len(dls) >= 2:
+                    report["failover_deadline_checked"] = \
+                        report.get("failover_deadline_checked", 0) + 1
+                    if any(later > earlier + 1e-6 for earlier, later
+                           in zip(dls, dls[1:])):
+                        report["failover_deadline_violations"] = \
+                            report.get("failover_deadline_violations",
+                                       0) + 1
+                # the dead replica's tickets all reached a terminal
+                # edge before the failover re-route (no orphaned
+                # causal chains on a killed replica)
+                for tr in getattr(ticket, "prior_traces", ()):
+                    if tr is None or tr.rid < 0:
+                        continue
+                    report["prior_trace_checked"] = \
+                        report.get("prior_trace_checked", 0) + 1
+                    if tr.status is None:
+                        report["prior_trace_orphans"] = \
+                            report.get("prior_trace_orphans", 0) + 1
         answered.append((req, value))
         if ticket.wait_s is not None:
             waits.append(ticket.wait_s)
@@ -567,6 +610,12 @@ def main(argv=None) -> int:
     ap.add_argument("--obs-port", type=int, default=0,
                     help="scrape-endpoint port (0 = ephemeral, -1 = "
                          "disarmed)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="serve through a FrontRouter over N "
+                         "in-process replicas (1 = single server; "
+                         "0 = $VELES_SIMD_REPLICAS, default 2; "
+                         "per-replica answered counts land in the "
+                         "report)")
     ap.add_argument("--overhead-requests", type=int, default=600,
                     help="requests per side of the tracing-overhead "
                          "probe in --details mode (0 = skip)")
@@ -584,12 +633,28 @@ def main(argv=None) -> int:
     schedule = build_schedule(rng, args.requests, args.rate,
                               args.burst_every, args.burst_size,
                               deadline_ms=args.deadline_ms)
-    server = serve.Server(max_batch=args.max_batch,
-                          max_wait_ms=args.max_wait_ms,
-                          queue_depth=args.queue_depth,
-                          tenant_depth=args.tenant_depth,
-                          workers=args.workers,
-                          obs_port=args.obs_port)
+    group = None
+    if args.replicas != 1:
+        # the replica-group front: N in-process servers behind the
+        # breaker-aware router, ONE aggregation scrape endpoint
+        # (--replicas 0 defers to $VELES_SIMD_REPLICAS); the
+        # pipeline leg registers on every replica through the group
+        group = serve.ReplicaGroup(args.replicas
+                                   if args.replicas > 1 else None,
+                                   max_batch=args.max_batch,
+                                   max_wait_ms=args.max_wait_ms,
+                                   queue_depth=args.queue_depth,
+                                   tenant_depth=args.tenant_depth,
+                                   workers=args.workers,
+                                   obs_port=args.obs_port)
+        server = serve.FrontRouter(group)
+    else:
+        server = serve.Server(max_batch=args.max_batch,
+                              max_wait_ms=args.max_wait_ms,
+                              queue_depth=args.queue_depth,
+                              tenant_depth=args.tenant_depth,
+                              workers=args.workers,
+                              obs_port=args.obs_port)
     # per-tenant SLOs so the burn-rate gauges export under load (a
     # generous latency target: the gate is that the accounting runs,
     # not that a CPU smoke hits production latencies)
@@ -597,16 +662,26 @@ def main(argv=None) -> int:
         obs.slo(tenant, target_ms=30000.0, hit_rate=0.99)
     pipeline_streams = args.pipeline_streams
     if pipeline_streams is None:
-        pipeline_streams = 2 if args.smoke else 0
-    with server:
+        pipeline_streams = 2 if args.smoke and group is None else 0
+    with (group if group is not None else server):
         report = run_load(server, schedule, block=args.block,
                           verify=args.verify, rng=rng)
+        if group is not None:
+            rstats = server.stats()
+            report["router"] = {
+                k: rstats[k]
+                for k in ("policy", "placed_by_replica",
+                          "answered_by_replica", "failovers",
+                          "placement_failures")}
         # the endpoint must serve while the server is hot — one hit
         # of all three routes per run
         report["scrape"] = scrape_endpoint(server.obs_port)
         if pipeline_streams > 0:
             compiled = build_pipeline()
-            op = server.register_pipeline(PIPELINE_NAME, compiled)
+            op = (group.register_pipeline(PIPELINE_NAME, compiled)
+                  if group is not None
+                  else server.register_pipeline(PIPELINE_NAME,
+                                                compiled))
             prep = run_pipeline_streams(
                 server, op, compiled, rng,
                 streams=pipeline_streams,

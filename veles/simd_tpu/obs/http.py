@@ -36,8 +36,8 @@ import json
 import os
 import threading
 
-__all__ = ["ObsEndpoint", "start", "env_port", "OBS_PORT_ENV",
-           "BIND_HOST"]
+__all__ = ["ObsEndpoint", "EndpointUnavailable", "start", "env_port",
+           "OBS_PORT_ENV", "BIND_HOST"]
 
 OBS_PORT_ENV = "VELES_SIMD_OBS_PORT"
 BIND_HOST = "127.0.0.1"
@@ -55,6 +55,25 @@ def env_port() -> int | None:
     except ValueError:
         return None
     return port if port >= 0 else None
+
+
+class EndpointUnavailable(OSError):
+    """The scrape endpoint could not bind its port — typed and
+    actionable, raised OUT of :meth:`veles.simd_tpu.serve.Server.
+    start` (and :class:`ObsEndpoint`) instead of dying later in the
+    serving thread.  An :class:`OSError` subclass: existing callers
+    that handled the raw bind error keep working, new ones get the
+    typed form.  The usual cause is another process (or another
+    replica in this one) already holding the port: with N replicas
+    each able to arm an endpoint, a fixed ``$VELES_SIMD_OBS_PORT`` is
+    a collision waiting to happen — use port 0 (ephemeral) per
+    endpoint, or arm exactly one aggregation endpoint (the
+    ``serve.cluster.ReplicaGroup`` pattern).  ``port`` carries the
+    refused port number."""
+
+    def __init__(self, message: str, *, port: int | None = None):
+        super().__init__(message)
+        self.port = port
 
 
 class _Handler(http.server.BaseHTTPRequestHandler):
@@ -125,7 +144,19 @@ class ObsEndpoint:
 
     def __init__(self, port: int, health=None):
         self._health = health
-        self._httpd = _Server((BIND_HOST, int(port)), _Handler)
+        try:
+            self._httpd = _Server((BIND_HOST, int(port)), _Handler)
+        except OSError as e:
+            # EADDRINUSE and friends: surface a typed, actionable
+            # error at arm time (Server.start) — never an opaque
+            # OSError out of a server that half-started
+            raise EndpointUnavailable(
+                f"obs scrape endpoint could not bind "
+                f"{BIND_HOST}:{int(port)} ({e.strerror or e}) — the "
+                f"port is likely held by another process or replica; "
+                f"use obs_port=0 for an ephemeral port, pick a free "
+                f"one, or disarm with a negative obs_port / unset "
+                f"${OBS_PORT_ENV}", port=int(port)) from e
         self._httpd.owner = self
         self.port = int(self._httpd.server_address[1])
         self._thread = threading.Thread(

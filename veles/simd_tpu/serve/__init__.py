@@ -28,6 +28,15 @@ the traffic or the hardware misbehaves:
   dispatch: transient device faults retry, persistent ones degrade
   the server to the NumPy oracle (parity-correct answers, flight
   recorder armed) while zero-retry probes hunt for recovery;
+* :mod:`~veles.simd_tpu.serve.cluster` — the replica layer above one
+  server: a :class:`~veles.simd_tpu.serve.cluster.ReplicaGroup` of N
+  named replicas (independent per-replica breakers/health,
+  heartbeat-driven wedge detection, graceful drain vs abrupt kill,
+  one aggregation ``/healthz``) behind a breaker-aware
+  :class:`~veles.simd_tpu.serve.cluster.FrontRouter` — least-loaded
+  placement per shape class, failover re-submission with the
+  original deadline carried, group-wide zero-double-answer dedup
+  (``make chaos-replicas`` is the scripted proof);
 * **end-to-end deadlines + per-class breakers** —
   ``submit(deadline_ms=...)`` (default
   ``VELES_SIMD_SERVE_DEADLINE_MS``) bounds a request's total time in
@@ -65,6 +74,12 @@ from veles.simd_tpu.serve.batcher import (DEFAULT_MAX_BATCH,
                                           Batcher, bucket_length)
 from veles.simd_tpu.serve.health import (DEGRADED, HEALTHY,
                                          HealthMonitor)
+from veles.simd_tpu.serve.cluster import (HEARTBEAT_MS_ENV,
+                                          REPLICAS_ENV,
+                                          ROUTER_POLICY_ENV,
+                                          FrontRouter,
+                                          NoReplicaAvailable,
+                                          ReplicaGroup, RouterTicket)
 from veles.simd_tpu.serve.server import (DEADLINE_ENV, SUPPORTED_OPS,
                                          DeadlineExceeded, Request,
                                          Server, ServerClosed, Ticket,
@@ -75,8 +90,11 @@ __all__ = [
     "DeadlineExceeded", "AdmissionController", "Batcher",
     "HealthMonitor", "bucket_length", "env_deadline_ms",
     "SUPPORTED_OPS", "HEALTHY", "DEGRADED",
+    "ReplicaGroup", "FrontRouter", "RouterTicket",
+    "NoReplicaAvailable",
     "MAX_BATCH_ENV", "MAX_WAIT_ENV", "QUEUE_DEPTH_ENV",
-    "TENANT_DEPTH_ENV", "DEADLINE_ENV",
+    "TENANT_DEPTH_ENV", "DEADLINE_ENV", "REPLICAS_ENV",
+    "ROUTER_POLICY_ENV", "HEARTBEAT_MS_ENV",
     "DEFAULT_MAX_BATCH", "DEFAULT_MAX_WAIT_MS",
     "DEFAULT_QUEUE_DEPTH", "DEFAULT_TENANT_DEPTH",
 ]

@@ -1,0 +1,1045 @@
+"""Replica-group serving: N servers behind a breaker-aware front router.
+
+One :class:`~veles.simd_tpu.serve.server.Server` is one process on one
+host mesh — one health machine, one admission bound, one ceiling.
+This module is the layer that removes the ceiling (ROADMAP item 3, the
+"millions of users" shape): a :class:`ReplicaGroup` managing N server
+replicas, and a :class:`FrontRouter` placing each submitted request on
+one of them, built so the *service* survives losing a whole replica
+the way PRs 9-10 proved a single server survives losing a device:
+
+* **placement** — :meth:`FrontRouter.submit` scores every live
+  replica for the request's shape class and places on the cheapest:
+  admitted queue depth (:meth:`Server.depth`) is the base load signal,
+  a DEGRADED health machine adds a large penalty, and an OPEN circuit
+  breaker *for that shape class* (the replica-keyed
+  ``serve.dispatch`` breaker) adds a class-local penalty — an open
+  breaker or degraded replica is **deprioritized per shape class, not
+  blacklisted globally** (its other classes, and last-resort traffic,
+  still flow).  ``VELES_SIMD_ROUTER_POLICY=round_robin`` swaps the
+  scoring for a rotation (the A/B control);
+* **failover** — every backend ticket carries a completion hook: a
+  replica that dies with the request queued (``status="closed"``) or
+  sheds it (``status="shed"``) triggers re-submission onto a
+  surviving replica with the *original* end-to-end deadline carried
+  over (the absolute deadline is stamped once at router admission;
+  every re-submission gets the remaining budget, never a fresh one)
+  and a shared failover budget (``max_failovers`` across ALL
+  attempts, not per replica).  The router ticket is deduped by its
+  router rid — it completes exactly once, so the group-wide
+  zero-double-answer accounting holds even if a late duplicate
+  completion ever raced (counted ``router_dedup``, never surfaced);
+* **draining** — :meth:`ReplicaGroup.drain` is graceful removal:
+  intake stops (the router skips DRAINING replicas), in-flight and
+  queued work is answered by the replica itself, and only then is the
+  replica DEAD — zero lost requests by construction.
+  :meth:`ReplicaGroup.kill` is the abrupt form (no drain): queued
+  work is answered ``closed`` and *re-routed by the failover hook*
+  onto survivors;
+* **heartbeats** — the group heartbeats every replica on a fixed
+  cadence (``VELES_SIMD_HEARTBEAT_MS``); ``miss_limit`` consecutive
+  missed beats mark the replica wedged and auto-drain it without
+  operator action (``replica_lifecycle``/``wedged`` decision event).
+  The ``cluster.heartbeat@<rid>`` injection site makes a wedge
+  deterministic on CPU CI (``VELES_SIMD_FAULT_PLAN``);
+* **aggregation endpoint** — :meth:`ReplicaGroup.start` arms ONE
+  router-level scrape endpoint (``obs_port=`` / ``$VELES_SIMD_OBS_PORT``;
+  per-replica endpoints stay disarmed in thread mode): ``/healthz``
+  answers 200 while at least one replica is up and healthy, 503 once
+  none is — the load-balancer contract, live through kills and drains
+  (the replicated chaos campaign gates exactly that).
+
+**Spawn modes.** ``spawn="thread"`` (default) runs replicas as
+in-process servers — the CI topology, and the only one the router can
+place requests on today.  ``spawn="subprocess"`` runs each replica as
+a child process (``python -m veles.simd_tpu.serve.cluster``) that
+arms its own ``/healthz`` + ``/metrics`` endpoint and reports its
+port; the group heartbeats it over HTTP — the same group/heartbeat/
+drain topology against process-isolated replicas, so the layer
+generalizes to real multi-host serving (the RPC submission path is
+the ROADMAP's multi-host item; :class:`FrontRouter` raises a typed
+error on a subprocess group rather than pretending).
+
+Usage::
+
+    from veles.simd_tpu.serve import cluster
+
+    with cluster.ReplicaGroup(3, max_batch=8, obs_port=0) as group:
+        router = cluster.FrontRouter(group)
+        t = router.submit(op="sosfilt", x=x, params={"sos": sos})
+        y = t.result(timeout=5.0)
+        group.kill("r0")        # abrupt: queued work fails over
+        group.drain("r1")       # graceful: answered, then removed
+
+Chaos: ``make chaos-replicas`` (``tools/chaos.py --replicas``) runs
+the scripted replica-kill campaign — one replica killed without drain
+and one drained gracefully mid-traffic, gated on zero lost / zero
+double-answered requests across the group, carried failover
+deadlines, survivor absorption, terminal traces on the killed
+replica's requests, and a live group ``/healthz`` throughout.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import subprocess
+import sys
+import threading
+
+from veles.simd_tpu import obs
+from veles.simd_tpu.obs import http as obs_http
+from veles.simd_tpu.runtime import breaker as _breaker
+from veles.simd_tpu.runtime import faults
+from veles.simd_tpu.serve.admission import Overloaded
+from veles.simd_tpu.serve.server import (DeadlineExceeded, Request,
+                                         Server, ServerClosed,
+                                         classify_request,
+                                         env_deadline_ms)
+
+__all__ = [
+    "Replica", "ReplicaGroup", "FrontRouter", "RouterTicket",
+    "NoReplicaAvailable", "UP", "DRAINING", "DEAD",
+    "REPLICAS_ENV", "ROUTER_POLICY_ENV", "HEARTBEAT_MS_ENV",
+    "DEFAULT_REPLICAS", "DEFAULT_HEARTBEAT_MS", "DEFAULT_MISS_LIMIT",
+    "ROUTER_POLICIES", "env_replicas", "env_router_policy",
+    "env_heartbeat_s",
+]
+
+REPLICAS_ENV = "VELES_SIMD_REPLICAS"
+ROUTER_POLICY_ENV = "VELES_SIMD_ROUTER_POLICY"
+HEARTBEAT_MS_ENV = "VELES_SIMD_HEARTBEAT_MS"
+
+# two replicas is the smallest group with a failover story; the env
+# default exists for tooling (loadgen --replicas 0 -> env -> 2)
+DEFAULT_REPLICAS = 2
+# 100 ms heartbeats notice a wedged replica in ~miss_limit/10 s while
+# costing ~10 lock-cheap pings/s per replica
+DEFAULT_HEARTBEAT_MS = 100.0
+DEFAULT_MISS_LIMIT = 3
+
+LEAST_LOADED = "least_loaded"
+ROUND_ROBIN = "round_robin"
+ROUTER_POLICIES = (LEAST_LOADED, ROUND_ROBIN)
+
+# replica lifecycle states
+UP = "up"
+DRAINING = "draining"
+DEAD = "dead"
+
+# scoring: depth is O(queue); the penalties must dominate any sane
+# queue depth so a healthy replica always outranks a degraded one for
+# the class, while a lone degraded survivor still takes traffic
+# (deprioritized, not blacklisted)
+BREAKER_OPEN_PENALTY = 1e3
+DEGRADED_PENALTY = 1e6
+
+
+def env_replicas() -> int:
+    """Group size from ``$VELES_SIMD_REPLICAS`` (default 2)."""
+    raw = os.environ.get(REPLICAS_ENV, "").strip()
+    if not raw:
+        return DEFAULT_REPLICAS
+    try:
+        value = int(raw)
+    except ValueError:
+        return DEFAULT_REPLICAS
+    return value if value >= 1 else DEFAULT_REPLICAS
+
+
+def env_router_policy() -> str:
+    """Placement policy from ``$VELES_SIMD_ROUTER_POLICY``
+    (``least_loaded`` default / ``round_robin``)."""
+    raw = os.environ.get(ROUTER_POLICY_ENV, "").strip().lower()
+    return raw if raw in ROUTER_POLICIES else LEAST_LOADED
+
+
+def env_heartbeat_s() -> float:
+    """Heartbeat interval in seconds from ``$VELES_SIMD_HEARTBEAT_MS``
+    (default 100 ms)."""
+    raw = os.environ.get(HEARTBEAT_MS_ENV, "").strip()
+    if not raw:
+        return DEFAULT_HEARTBEAT_MS / 1e3
+    try:
+        value = float(raw)
+    except ValueError:
+        return DEFAULT_HEARTBEAT_MS / 1e3
+    return (value if value > 0 else DEFAULT_HEARTBEAT_MS) / 1e3
+
+
+class NoReplicaAvailable(Overloaded):
+    """Typed router rejection: no live replica could take the request
+    (none up, or the failover budget died with the last candidate).
+    An :class:`~veles.simd_tpu.serve.admission.Overloaded` subclass —
+    group exhaustion is admission exhaustion at cluster scope, and
+    every consumer that already handles typed sheds handles this."""
+
+    def __init__(self, message: str, *, tenant: str = "default"):
+        super().__init__(message, tenant=tenant, scope="cluster")
+
+
+def _call_with_timeout(fn, timeout_s: float):
+    """Run ``fn()`` under the fault engine's dispatch watchdog
+    (:func:`faults._call_with_deadline` — ONE home for the
+    abandoned-daemon-thread containment), translating its typed
+    :class:`faults.FaultTimeout` into the stdlib TimeoutError the
+    spawn-handshake caller classifies on.  Used for the one-shot
+    subprocess port handshake; steady-state heartbeats run on
+    persistent prober threads instead (no per-call thread churn)."""
+    try:
+        return faults._call_with_deadline(fn, timeout_s,
+                                          "cluster.spawn")
+    except faults.FaultTimeout as e:
+        raise TimeoutError(str(e)) from e
+
+
+class Replica:
+    """One managed server replica: identity (``rid``), lifecycle
+    state, heartbeat bookkeeping, and the spawn-mode-specific start /
+    ping / stop plumbing.  Thread mode holds a live in-process
+    :class:`Server` (named ``rid``, so its breakers/health are
+    replica-keyed); subprocess mode holds a child process plus the
+    port of its ``/healthz``+``/metrics`` endpoint."""
+
+    def __init__(self, rid: str, *, spawn: str = "thread",
+                 server_kwargs: dict | None = None):
+        self.rid = str(rid)
+        self.spawn = spawn
+        self.state = UP
+        self.misses = 0
+        self.last_beat = None
+        # last health state a ping observed ("healthy"/"degraded";
+        # None = never pinged) — the subprocess aggregation signal,
+        # since the group cannot read a child's health machine
+        # in-process
+        self.last_health = None
+        self.server: Server | None = None
+        self.proc = None
+        self.port = None
+        self._kwargs = dict(server_kwargs or {})
+        if spawn == "thread":
+            # per-replica endpoints stay disarmed: the group owns ONE
+            # aggregation endpoint (N in-process replicas arming N
+            # ports from one env var is the EndpointUnavailable story)
+            self._kwargs.setdefault("obs_port", -1)
+            self.server = Server(name=self.rid, **self._kwargs)
+        elif spawn != "subprocess":
+            raise ValueError(
+                f"spawn must be 'thread' or 'subprocess', got "
+                f"{spawn!r}")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, spawn_timeout_s: float = 60.0) -> None:
+        if self.spawn == "thread":
+            self.server.start()
+            return
+        port_arg = int(self._kwargs.get("obs_port") or 0)
+        if port_arg < 0:
+            raise ValueError(
+                "subprocess replicas need a scrape endpoint (their "
+                "/healthz IS the heartbeat surface) — obs_port must "
+                "be >= 0 (0 = ephemeral), not disarmed")
+        # -c instead of -m: the serve package imports this module at
+        # init, and runpy warns on re-executing an already-imported
+        # submodule in the child
+        cmd = [sys.executable, "-c",
+               "import sys; "
+               "from veles.simd_tpu.serve.cluster import _replica_main; "
+               "sys.exit(_replica_main(sys.argv[1:]))",
+               "--obs-port", str(port_arg)]
+        # forward the server policy knobs the child's Server takes —
+        # a subprocess replica must run the operator's batching/worker
+        # policy, not silent defaults
+        for flag, key in (("--max-batch", "max_batch"),
+                          ("--max-wait-ms", "max_wait_ms"),
+                          ("--workers", "workers")):
+            value = self._kwargs.get(key)
+            if value is not None:
+                cmd += [flag, str(value)]
+        self.proc = subprocess.Popen(
+            cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            text=True)
+        # the child prints one JSON line with its bound endpoint port
+        # once its server is up; anything else on stdout is skipped.
+        # Each readline runs under the remaining-deadline watchdog —
+        # a child that wedges before reporting (and never closes
+        # stdout) must raise, not hang group.start() forever.
+        deadline = faults.monotonic() + spawn_timeout_s
+        while True:
+            remaining = deadline - faults.monotonic()
+            if remaining <= 0:
+                self.proc.kill()
+                raise TimeoutError(
+                    f"replica {self.rid} subprocess did not report "
+                    f"its endpoint port within {spawn_timeout_s}s")
+            try:
+                line = _call_with_timeout(self.proc.stdout.readline,
+                                          remaining)
+            except TimeoutError:
+                self.proc.kill()
+                raise TimeoutError(
+                    f"replica {self.rid} subprocess did not report "
+                    f"its endpoint port within {spawn_timeout_s}s")
+            if not line:
+                raise RuntimeError(
+                    f"replica {self.rid} subprocess exited before "
+                    f"reporting its endpoint port "
+                    f"(rc={self.proc.poll()})")
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(msg, dict) \
+                    and msg.get("port") is not None:
+                self.port = int(msg["port"])
+                return
+
+    def ping(self) -> dict:
+        """One heartbeat: the ``cluster.heartbeat@<rid>`` injection
+        site fires first (deterministic wedge simulation), then the
+        replica's health surface is read — in-process stats in thread
+        mode, ``GET /healthz`` in subprocess mode (200 *and* 503 are
+        beats: a degraded replica is alive).  Any exception is a
+        missed beat."""
+        faults.inject(f"cluster.heartbeat@{self.rid}")
+        if self.spawn == "thread":
+            self.last_health = self.server.health
+            return {"state": self.last_health,
+                    "depth": self.server.depth()}
+        import urllib.error
+        import urllib.request
+
+        url = f"http://{obs_http.BIND_HOST}:{self.port}/healthz"
+        code = 200
+        try:
+            with urllib.request.urlopen(url, timeout=5) as r:
+                body = r.read()
+        except urllib.error.HTTPError as e:
+            if e.code != 503:
+                raise
+            code, body = e.code, e.read()   # degraded but alive
+        parsed = json.loads(body)
+        health = parsed.get("health")
+        if isinstance(health, dict):
+            health = health.get("state")
+        self.last_health = ("degraded" if code == 503
+                            else health or "healthy")
+        return parsed
+
+    def kill(self) -> None:
+        """Abrupt stop: no drain — queued work answers ``closed`` (and
+        the front router's failover hook re-routes it)."""
+        if self.spawn == "thread":
+            self.server.stop(drain=False)
+        elif self.proc is not None:
+            self.proc.kill()
+            self.proc.wait()
+
+    def drain_stop(self) -> None:
+        """Graceful stop: queued and in-flight work is answered by
+        this replica before it exits."""
+        if self.spawn == "thread":
+            self.server.stop(drain=True)
+        elif self.proc is not None:
+            try:        # closing stdin asks the child to drain + exit
+                self.proc.stdin.close()
+            except OSError:
+                pass
+            try:
+                self.proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                self.proc.terminate()
+                self.proc.wait()
+
+    def snapshot(self) -> dict:
+        """JSON-native view for the group's aggregation endpoint."""
+        info = {"rid": self.rid, "state": self.state,
+                "spawn": self.spawn, "misses": self.misses,
+                "last_beat": self.last_beat}
+        if self.spawn == "thread" and self.state != DEAD:
+            info["health"] = self.server.health
+            info["depth"] = self.server.depth()
+            info["counts"] = self.server.stats()["counts"]
+        elif self.spawn == "subprocess":
+            # the last ping's observation, not a live read: an
+            # unresponsive child keeps its last-known state until the
+            # heartbeat machinery drains it
+            info["health"] = self.last_health or "healthy"
+            info["port"] = self.port
+            if self.proc is not None:
+                info["returncode"] = self.proc.poll()
+        return info
+
+
+class ReplicaGroup:
+    """N managed replicas + the heartbeat loop + the aggregation
+    endpoint (module docstring has the full story).  ``replicas`` is a
+    count (default ``$VELES_SIMD_REPLICAS``); remaining keyword
+    arguments are passed to every replica's :class:`Server` in thread
+    mode.  Use as a context manager, or :meth:`start`/:meth:`stop`."""
+
+    def __init__(self, replicas: int | None = None, *,
+                 spawn: str = "thread",
+                 heartbeat_ms: float | None = None,
+                 miss_limit: int = DEFAULT_MISS_LIMIT,
+                 obs_port: int | None = None,
+                 **server_kwargs):
+        n = int(replicas) if replicas else env_replicas()
+        if n < 1:
+            raise ValueError("a replica group needs >= 1 replica")
+        self.spawn = spawn
+        self.heartbeat_s = (float(heartbeat_ms) / 1e3
+                            if heartbeat_ms else env_heartbeat_s())
+        self.miss_limit = int(miss_limit)
+        if self.miss_limit < 1:
+            raise ValueError("miss_limit must be >= 1")
+        self.replicas = [
+            Replica(f"r{i}", spawn=spawn, server_kwargs=server_kwargs)
+            for i in range(n)]
+        self._by_rid = {r.rid: r for r in self.replicas}
+        self._lock = threading.Lock()
+        self._obs_port_arg = obs_port
+        self._endpoint = None
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
+        self._probers: list = []
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ReplicaGroup":
+        """Start every replica, the heartbeat loop, and (when armed)
+        the router-level aggregation endpoint (idempotent)."""
+        if self._started:
+            return self
+        # the endpoint arms first — same contract as Server.start: a
+        # bind failure (EndpointUnavailable) leaves nothing running
+        if self._obs_port_arg is not None and self._obs_port_arg < 0:
+            self._endpoint = None
+        else:
+            self._endpoint = obs_http.start(self._obs_port_arg,
+                                            health=self.stats)
+        try:
+            for r in self.replicas:
+                r.start()
+        except BaseException:
+            for r in self.replicas:
+                try:
+                    r.kill()
+                except Exception:  # noqa: BLE001 — best-effort unwind
+                    pass
+            if self._endpoint is not None:
+                self._endpoint.stop()
+                self._endpoint = None
+            raise
+        self._started = True
+        for r in self.replicas:
+            t = threading.Thread(target=self._probe_replica,
+                                 args=(r,), daemon=True,
+                                 name=f"veles-replica-probe-{r.rid}")
+            t.start()
+            self._probers.append(t)
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True,
+            name="veles-replica-heartbeat")
+        self._hb_thread.start()
+        obs.gauge("replica_alive", float(self.alive()))
+        obs.record_decision("replica_lifecycle", "group_start",
+                            replicas=len(self.replicas),
+                            spawn=self.spawn)
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop the heartbeat loop and every live replica (drained or
+        abruptly), then the aggregation endpoint."""
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5.0)
+            self._hb_thread = None
+        for t in self._probers:
+            # a prober wedged inside a replica's ping cannot be
+            # joined — it is daemon-contained, not waited on
+            t.join(timeout=1.0)
+        self._probers = []
+        for r in self.replicas:
+            with self._lock:
+                if r.state == DEAD:
+                    continue
+                r.state = DEAD
+            if drain:
+                r.drain_stop()
+            else:
+                r.kill()
+        obs.gauge("replica_alive", 0.0)
+        if self._endpoint is not None:
+            self._endpoint.stop()
+            self._endpoint = None
+
+    def __enter__(self) -> "ReplicaGroup":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.stop(drain=exc_type is None)
+        return False
+
+    # -- membership --------------------------------------------------------
+
+    def replica(self, rid: str) -> Replica:
+        """The replica named ``rid`` (KeyError otherwise)."""
+        return self._by_rid[rid]
+
+    def alive(self) -> int:
+        """Replicas currently accepting placements (state UP)."""
+        with self._lock:
+            return sum(1 for r in self.replicas if r.state == UP)
+
+    def live_replicas(self) -> list:
+        """The placeable replicas (state UP), in id order."""
+        with self._lock:
+            return [r for r in self.replicas if r.state == UP]
+
+    def kill(self, rid: str) -> None:
+        """Abrupt removal, no drain: the replica is un-placeable
+        immediately, its queued-but-unanswered work answers ``closed``
+        and is re-routed by the router's failover hook.  The scripted
+        campaign's mid-traffic kill."""
+        r = self._by_rid[rid]
+        with self._lock:
+            if r.state == DEAD:
+                return
+            r.state = DEAD
+        obs.record_decision("replica_lifecycle", "kill", replica=rid)
+        obs.count("replica_killed", replica=rid)
+        r.kill()
+        obs.gauge("replica_alive", float(self.alive()))
+
+    def drain(self, rid: str, reason: str = "operator") -> None:
+        """Graceful removal: stop intake (the router skips DRAINING
+        replicas), answer everything queued and in flight, then mark
+        DEAD.  Subsequent traffic redistributes to the survivors."""
+        r = self._by_rid[rid]
+        with self._lock:
+            if r.state != UP:
+                return
+            r.state = DRAINING
+        obs.record_decision("replica_lifecycle", "drain", replica=rid,
+                            reason=reason)
+        obs.count("replica_drained", replica=rid)
+        r.drain_stop()
+        with self._lock:
+            r.state = DEAD
+        obs.record_decision("replica_lifecycle", "dead", replica=rid,
+                            reason=reason)
+        obs.gauge("replica_alive", float(self.alive()))
+
+    def register_pipeline(self, name: str, compiled) -> str:
+        """Register a compiled pipeline on EVERY thread-mode replica
+        (the group twin of :meth:`Server.register_pipeline`); returns
+        the op string."""
+        if self.spawn != "thread":
+            raise ValueError(
+                "pipeline registration needs in-process replicas "
+                "(spawn='thread'); subprocess replicas own their own "
+                "registrations")
+        op = None
+        for r in self.replicas:
+            op = r.server.register_pipeline(name, compiled)
+        return op
+
+    # -- heartbeats --------------------------------------------------------
+    #
+    # One PERSISTENT prober thread per replica (no per-ping watchdog
+    # threads — a 100 ms cadence over N replicas would otherwise mint
+    # 10*N threads/s steady-state): the prober pings on the cadence,
+    # stamping last_beat / counting misses; a ping that RAISES (the
+    # injected-wedge form) counts a miss immediately, and a ping that
+    # BLOCKS wedges only its own prober — the monitor loop notices
+    # the stale last_beat and triggers the same auto-drain, so a
+    # truly wedged replica is contained by exactly one abandoned
+    # thread, never an accumulating pile.
+
+    def _mark_wedged(self, r: Replica, reason: str) -> None:
+        obs.record_decision("replica_lifecycle", "wedged",
+                            replica=r.rid, misses=r.misses,
+                            error=reason[:200])
+        # auto-drain off-thread: state flips to DRAINING inside
+        # drain() immediately (intake stops), while a truly wedged
+        # stop can block only its own daemon thread
+        threading.Thread(target=self.drain, args=(r.rid, "wedged"),
+                         daemon=True,
+                         name=f"veles-replica-drain-{r.rid}").start()
+
+    def _probe_replica(self, r: Replica) -> None:
+        while r.state == UP and not self._hb_stop.is_set():
+            try:
+                r.ping()
+            except Exception as e:  # noqa: BLE001 — any = miss
+                r.misses += 1
+                obs.count("replica_heartbeat_miss", replica=r.rid)
+                if r.misses >= self.miss_limit and r.state == UP:
+                    self._mark_wedged(r, str(e))
+                    return
+            else:
+                r.misses = 0
+                r.last_beat = faults.monotonic()
+            self._hb_stop.wait(self.heartbeat_s)
+
+    def _heartbeat_loop(self) -> None:
+        """The staleness monitor: a prober whose ping BLOCKS can't
+        count its own misses — this loop watches last_beat age and
+        drains a replica whose beats stopped arriving.  The floor is
+        seconds-scale on purpose: a CPU-bound XLA compile holds the
+        GIL long enough to starve a perfectly healthy prober for
+        hundreds of milliseconds, and a starved prober must never
+        read as a wedged replica (a ping that RAISES is the fast
+        path — the prober counts those misses itself on the
+        heartbeat cadence)."""
+        stale_s = max(self.miss_limit * self.heartbeat_s, 5.0)
+        started = faults.monotonic()
+        while not self._hb_stop.wait(self.heartbeat_s):
+            now = faults.monotonic()
+            for r in self.replicas:
+                if r.state != UP:
+                    continue
+                ref = r.last_beat if r.last_beat is not None \
+                    else started
+                if now - ref > stale_s:
+                    r.misses = max(r.misses, self.miss_limit)
+                    obs.count("replica_heartbeat_miss",
+                              replica=r.rid)
+                    self._mark_wedged(
+                        r, f"no heartbeat for {now - ref:.2f}s "
+                           f"(stale after {stale_s:.2f}s)")
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def obs_port(self) -> int | None:
+        """The aggregation endpoint's bound port (None = disarmed)."""
+        return self._endpoint.port if self._endpoint else None
+
+    def stats(self) -> dict:
+        """JSON-native aggregate: per-replica snapshots plus the
+        group ``health`` block the scrape endpoint's ``/healthz``
+        answers from — ``healthy`` while at least one replica is up
+        and healthy (503 only once the whole group is gone), so the
+        router-level endpoint stays live through single-replica kills
+        and drains."""
+        snaps = [r.snapshot() for r in self.replicas]
+        up_healthy = sum(
+            1 for s in snaps
+            if s["state"] == UP and s.get("health", "healthy")
+            != "degraded")
+        return {
+            "replicas": snaps,
+            "alive": self.alive(),
+            "spawn": self.spawn,
+            "heartbeat_s": self.heartbeat_s,
+            "miss_limit": self.miss_limit,
+            "health": {"state": "healthy" if up_healthy
+                       else "degraded",
+                       "up_healthy": up_healthy},
+            "obs_port": self.obs_port,
+        }
+
+
+class RouterTicket:
+    """The caller's handle on one routed request — the
+    :class:`~veles.simd_tpu.serve.server.Ticket` contract (``result``
+    / ``done`` / ``status`` / ``degraded`` / ``trace`` / ``wait_s``),
+    completed exactly once by the router whatever the backend story
+    (dedup by router rid: a late duplicate completion is counted
+    ``router_dedup`` and dropped, so group-wide zero-double-answer
+    accounting holds).  ``replica`` is the replica that answered,
+    ``failovers`` how many re-submissions it took, ``prior_traces``
+    the terminal traces of the attempts that died under the request
+    (the killed-replica evidence the chaos campaign gates), and
+    ``deadlines_ms`` the deadline stamped on each attempt — the
+    carried-deadline proof: entries only ever shrink."""
+
+    __slots__ = ("rid", "op", "tenant", "status", "wait_s", "trace",
+                 "replica", "failovers", "prior_traces",
+                 "deadlines_ms", "_event", "_value", "_error",
+                 "_lock")
+
+    def __init__(self, rid: int, op: str, tenant: str):
+        self.rid = rid
+        self.op = op
+        self.tenant = tenant
+        self.status = "pending"
+        self.wait_s = None
+        self.trace = None
+        self.replica = None
+        self.failovers = 0
+        self.prior_traces: list = []
+        self.deadlines_ms: list = []
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+        self._lock = threading.Lock()
+
+    def _complete(self, *, value=None, error=None, status="ok",
+                  wait_s=None, trace=None, replica=None) -> bool:
+        with self._lock:
+            if self.status != "pending":
+                obs.count("router_dedup", op=self.op)
+                return False
+            self._value = value
+            self._error = error
+            self.status = status
+            self.wait_s = wait_s
+            if trace is not None:
+                self.trace = trace
+            if self.trace is not None \
+                    and getattr(self.trace, "status", None) \
+                    not in (None, status):
+                # a dead-end completion (router-side expiry, group
+                # exhaustion) whose retained trace closed under a
+                # DIFFERENT status: the trace belongs to a failed
+                # attempt, not this answer — retain it as evidence,
+                # never as the ticket's own chain (a status-mismatched
+                # trace would read as an orphan to the completeness
+                # gates).  Identity-guarded: the failover path may
+                # have filed this same attempt already.
+                if all(tr is not self.trace
+                       for tr in self.prior_traces):
+                    self.prior_traces.append(self.trace)
+                self.trace = None
+            self.replica = replica
+        self._event.set()
+        return True
+
+    def done(self) -> bool:
+        """Answered (any status but ``pending``)?"""
+        return self._event.is_set()
+
+    @property
+    def degraded(self) -> bool:
+        """Was the answer served by a replica's oracle path?"""
+        return self.status == "degraded"
+
+    def result(self, timeout: float | None = None):
+        """Block for the answer; same contract as
+        :meth:`veles.simd_tpu.serve.server.Ticket.result`."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"routed request {self.op!r} unanswered after "
+                f"{timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class FrontRouter:
+    """Breaker-aware placement + failover over a thread-mode
+    :class:`ReplicaGroup` (module docstring has the semantics).
+
+    ``policy`` is ``least_loaded`` (default;
+    ``$VELES_SIMD_ROUTER_POLICY``) or ``round_robin``;
+    ``max_failovers`` is the shared re-submission budget per request
+    (default: one attempt per additional replica)."""
+
+    def __init__(self, group: ReplicaGroup, *,
+                 policy: str | None = None,
+                 max_failovers: int | None = None):
+        if group.spawn != "thread":
+            raise ValueError(
+                "FrontRouter places requests on in-process replicas "
+                "(spawn='thread'); a subprocess group only exposes "
+                "health/metrics today — multi-host request placement "
+                "is the ROADMAP's RPC item")
+        self.group = group
+        self.policy = policy or env_router_policy()
+        if self.policy not in ROUTER_POLICIES:
+            raise ValueError(
+                f"unknown router policy {self.policy!r} "
+                f"(known: {', '.join(ROUTER_POLICIES)})")
+        self.max_failovers = (
+            int(max_failovers) if max_failovers is not None
+            else max(1, len(group.replicas) - 1))
+        self._lock = threading.Lock()
+        self._rids = itertools.count()
+        self._rr = itertools.count()
+        self._placed: dict = {}
+        self._answered: dict = {}
+        self._failovers = 0
+        self._placement_failures = 0
+
+    # -- scoring -----------------------------------------------------------
+
+    def score(self, replica: Replica, key) -> float:
+        """Placement cost of ``replica`` for shape class ``key``:
+        admitted depth, plus the DEGRADED-health penalty, plus the
+        open-breaker penalty when THIS class's breaker on THIS
+        replica is open (per shape class — an open sosfilt breaker
+        does not deprioritize the replica's stft traffic)."""
+        server = replica.server
+        s = float(server.depth())
+        if server.health == "degraded":
+            s += DEGRADED_PENALTY
+        br = _breaker.lookup("serve.dispatch",
+                             server.breaker_key(key))
+        if br is not None and br.state == _breaker.OPEN:
+            s += BREAKER_OPEN_PENALTY
+        return s
+
+    def _pick(self, key, exclude) -> Replica | None:
+        alive = self.group.live_replicas()
+        if not alive:
+            return None
+        fresh = [r for r in alive if r.rid not in exclude]
+        # every survivor already tried: the failover budget (not the
+        # exclusion set) is the retry bound — re-trying a survivor
+        # beats failing a placeable request
+        candidates = fresh or alive
+        if self.policy == ROUND_ROBIN:
+            return candidates[next(self._rr) % len(candidates)]
+        return min(candidates, key=lambda r: (self.score(r, key),
+                                              r.rid))
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(self, request: Request | None = None, *,
+               op: str | None = None, x=None,
+               params: dict | None = None, tenant: str = "default",
+               block: bool = False, timeout: float | None = None,
+               deadline_ms: float | None = None) -> RouterTicket:
+        """Place one request on the group; returns its
+        :class:`RouterTicket`.  Same call shape as
+        :meth:`Server.submit`.  The end-to-end deadline (argument,
+        request field, or the ``VELES_SIMD_SERVE_DEADLINE_MS``
+        default) is resolved ONCE here to an absolute stamp; every
+        placement and failover re-submission carries the remaining
+        budget of that one deadline."""
+        if request is None:
+            request = Request(op=op, x=x, params=params or {},
+                              tenant=tenant)
+        key = self._shape_class(request)
+        dl_ms = deadline_ms
+        if dl_ms is None:
+            dl_ms = request.deadline_ms
+        if dl_ms is None:
+            dl_ms = env_deadline_ms()
+        has_deadline = dl_ms is not None and dl_ms > 0
+        ticket = RouterTicket(next(self._rids), request.op,
+                              request.tenant)
+        ctx = {
+            "deadline": (faults.monotonic() + float(dl_ms) / 1e3
+                         if has_deadline else None),
+            "attempts": 0,
+            "tried": set(),
+            "block": block,
+            "timeout": timeout,
+        }
+        self._place(ticket, request, key, ctx)
+        return ticket
+
+    def _shape_class(self, request: Request) -> tuple:
+        """The request's shape-class triple — derived by the SAME
+        helper the replica's submit uses (:func:`veles.simd_tpu.
+        serve.server.classify_request`), so scoring reads exactly the
+        breaker the dispatch will consult.  Validation errors raise
+        synchronously, exactly like a direct submit."""
+        return classify_request(request.op, request.x,
+                                request.params)[3]
+
+    # -- placement + failover ----------------------------------------------
+
+    def _place(self, ticket: RouterTicket, request: Request, key,
+               ctx) -> None:
+        """Place (or re-place) one request: pick a survivor, submit
+        through the guarded funnel, arm the failover hook.  Placement
+        failure (a replica racing death) retries the next candidate;
+        group exhaustion answers typed."""
+        # bounded by construction: each pass either returns or burns
+        # one placement-failure credit (a replica can only race death
+        # once per request, but the explicit bound keeps a bookkeeping
+        # bug from ever spinning here)
+        credits = len(self.group.replicas) + self.max_failovers + 1
+        while True:
+            credits -= 1
+            if ticket.done():
+                return
+            if credits < 0:
+                ticket._complete(
+                    error=NoReplicaAvailable(
+                        f"RESOURCE_EXHAUSTED: placement retries "
+                        f"exhausted for {request.op!r}",
+                        tenant=request.tenant),
+                    status="shed" if ticket.trace is None
+                    else "closed")
+                return
+            if ctx["deadline"] is not None \
+                    and faults.monotonic() >= ctx["deadline"]:
+                ticket._complete(
+                    error=DeadlineExceeded(
+                        f"DEADLINE_EXCEEDED: routed request "
+                        f"{request.op!r} exhausted its end-to-end "
+                        f"deadline before a replica answered"),
+                    status="expired")
+                return
+            target = self._pick(key, ctx["tried"])
+            if target is None:
+                ticket._complete(
+                    error=NoReplicaAvailable(
+                        f"RESOURCE_EXHAUSTED: no live replica for "
+                        f"{request.op!r} "
+                        f"(group alive={self.group.alive()})",
+                        tenant=request.tenant),
+                    status="shed" if ticket.trace is None
+                    else "closed")
+                return
+            try:
+                backend = self._submit_to_replica(target, request,
+                                                  ctx)
+            except ServerClosed:
+                # raced a kill/drain between pick and submit: typed
+                # placement failure, try the next survivor
+                ctx["tried"].add(target.rid)
+                with self._lock:
+                    self._placement_failures += 1
+                obs.count("router_placement_failure",
+                          replica=target.rid)
+                continue
+            with self._lock:
+                self._placed[target.rid] = \
+                    self._placed.get(target.rid, 0) + 1
+            obs.count("router_placed", replica=target.rid,
+                      policy=self.policy)
+            ticket.trace = backend.trace
+            backend.add_done_callback(
+                lambda t, r=target: self._on_backend(
+                    ticket, request, key, ctx, r, t))
+            return
+
+    def _submit_to_replica(self, replica: Replica, request: Request,
+                           ctx):
+        """THE guarded dispatch funnel: the only call site allowed to
+        submit into a replica (lint-enforced — tools/lint.py cluster
+        router rule), so every placement path shares the
+        carried-deadline arithmetic and the typed placement-failure
+        handling around it."""
+        remaining_ms = None
+        if ctx["deadline"] is not None:
+            remaining_ms = max(
+                0.001, (ctx["deadline"] - faults.monotonic()) * 1e3)
+        ctx.setdefault("stamps", []).append(remaining_ms)
+        return replica.server.submit(
+            request, block=ctx["block"], timeout=ctx["timeout"],
+            deadline_ms=remaining_ms)
+
+    def _on_backend(self, ticket: RouterTicket, request: Request,
+                    key, ctx, replica: Replica, backend) -> None:
+        """One backend ticket went terminal: answer the router
+        ticket, or fail the request over to a survivor."""
+        status = backend.status
+        if status in ("ok", "degraded"):
+            if ticket._complete(
+                    value=backend._value, status=status,
+                    wait_s=backend.wait_s, trace=backend.trace,
+                    replica=replica.rid):
+                with self._lock:
+                    self._answered[replica.rid] = \
+                        self._answered.get(replica.rid, 0) + 1
+            return
+        if status == "expired":
+            # the request's OWN deadline — failing over cannot help
+            ticket._complete(error=backend._error, status="expired",
+                             trace=backend.trace,
+                             replica=replica.rid)
+            return
+        if status in ("closed", "shed") \
+                and ctx["attempts"] < self.max_failovers:
+            # the replica died under the request (closed) or shed it
+            # (overload): re-route onto a survivor, original deadline
+            # and the SHARED failover budget carried over
+            ctx["attempts"] += 1
+            ctx["tried"].add(replica.rid)
+            ticket.failovers = ctx["attempts"]
+            ticket.prior_traces.append(backend.trace)
+            ticket.deadlines_ms = list(ctx.get("stamps", []))
+            with self._lock:
+                self._failovers += 1
+            obs.count("router_failover", replica=replica.rid,
+                      reason=status)
+            obs.record_decision("router_failover", status,
+                                replica=replica.rid,
+                                request_op=request.op,
+                                attempt=ctx["attempts"])
+            self._place(ticket, request, key, ctx)
+            ticket.deadlines_ms = list(ctx.get("stamps", []))
+            return
+        # terminal without recourse: propagate the typed error
+        ticket._complete(error=backend._error, status=status,
+                         trace=backend.trace, replica=replica.rid)
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def obs_port(self) -> int | None:
+        """The group aggregation endpoint's port (scrape target)."""
+        return self.group.obs_port
+
+    def stats(self) -> dict:
+        """JSON-native router view: per-replica placement/answer
+        tallies, failover/dedup/placement-failure counts, and the
+        group aggregate (so a router handle quacks like a server for
+        health-minded consumers)."""
+        with self._lock:
+            placed = dict(sorted(self._placed.items()))
+            answered = dict(sorted(self._answered.items()))
+            failovers = self._failovers
+            placement_failures = self._placement_failures
+        group = self.group.stats()
+        return {
+            "policy": self.policy,
+            "max_failovers": self.max_failovers,
+            "placed_by_replica": placed,
+            "answered_by_replica": answered,
+            "failovers": failovers,
+            "placement_failures": placement_failures,
+            "group": group,
+            "health": group["health"],
+        }
+
+
+# ---------------------------------------------------------------------------
+# subprocess replica entry point (python -m veles.simd_tpu.serve.cluster)
+# ---------------------------------------------------------------------------
+
+
+def _replica_main(argv=None) -> int:
+    """Run ONE replica server in this process: arm its scrape
+    endpoint, report the bound port as a JSON line on stdout, serve
+    until stdin closes (the parent's graceful-drain signal), then
+    drain and exit.  The ``spawn='subprocess'`` child body."""
+    import argparse
+
+    ap = argparse.ArgumentParser(description=_replica_main.__doc__)
+    ap.add_argument("--obs-port", type=int, default=0)
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--max-wait-ms", type=float, default=None)
+    ap.add_argument("--workers", type=int, default=None)
+    args = ap.parse_args(argv)
+    obs.enable()
+    kwargs = {}
+    if args.workers:
+        kwargs["workers"] = args.workers
+    srv = Server(max_batch=args.max_batch,
+                 max_wait_ms=args.max_wait_ms,
+                 obs_port=args.obs_port, **kwargs)
+    srv.start()
+    print(json.dumps({"port": srv.obs_port, "pid": os.getpid()}),
+          flush=True)
+    try:
+        sys.stdin.read()        # parked until the parent lets go
+    except Exception:  # noqa: BLE001 — any stdin failure = shutdown
+        pass
+    srv.stop(drain=True)
+    return 0
+
+
+if __name__ == "__main__":      # pragma: no cover — subprocess body
+    sys.exit(_replica_main())

@@ -50,7 +50,12 @@ class HealthMonitor:
     worker pool (trips and recoveries from any worker serialize
     here)."""
 
-    def __init__(self, probe_every: int = DEFAULT_PROBE_EVERY):
+    def __init__(self, probe_every: int = DEFAULT_PROBE_EVERY,
+                 name: str | None = None):
+        # ``name`` is the owning replica's identity (serve/cluster.py):
+        # with N health machines in one process, transition decision
+        # events must say WHOSE device died
+        self.name = None if name is None else str(name)
         self.probe_every = int(probe_every)
         if self.probe_every < 1:
             raise ValueError("probe_every must be >= 1")
@@ -84,9 +89,11 @@ class HealthMonitor:
                 self._degraded_batches = 0
         if transition:
             obs.count("serve_degraded", site=site)
-            obs.gauge("serve_healthy", 0.0)
+            obs.gauge("serve_healthy", 0.0,
+                      **({"replica": self.name} if self.name else {}))
             obs.record_decision(
                 "serve_health", "degrade", site=site,
+                replica=self.name,
                 error=(str(error)[:200] if error is not None
                        else None))
         return transition
@@ -114,8 +121,10 @@ class HealthMonitor:
             self._state = HEALTHY
             self._recoveries += 1
         obs.count("serve_recovered", site=site)
-        obs.gauge("serve_healthy", 1.0)
-        obs.record_decision("serve_health", "recover", site=site)
+        obs.gauge("serve_healthy", 1.0,
+                  **({"replica": self.name} if self.name else {}))
+        obs.record_decision("serve_health", "recover", site=site,
+                            replica=self.name)
         return True
 
     def snapshot(self) -> dict:

@@ -115,7 +115,7 @@ from veles.simd_tpu.serve.health import (DEFAULT_PROBE_EVERY,
 
 __all__ = ["Request", "Ticket", "Server", "ServerClosed",
            "DeadlineExceeded", "SUPPORTED_OPS", "DEFAULT_WORKERS",
-           "DEADLINE_ENV", "env_deadline_ms"]
+           "DEADLINE_ENV", "env_deadline_ms", "classify_request"]
 
 # two workers overlap one batch's host-side padding/slicing with the
 # previous batch's device wait without oversubscribing dispatch
@@ -179,7 +179,7 @@ class Ticket:
     """
 
     __slots__ = ("op", "tenant", "status", "wait_s", "trace",
-                 "_event", "_value", "_error", "_lock")
+                 "_event", "_value", "_error", "_lock", "_cbs")
 
     def __init__(self, op: str, tenant: str):
         self.op = op
@@ -195,6 +195,7 @@ class Ticket:
         self._value = None
         self._error = None
         self._lock = threading.Lock()
+        self._cbs: list = []
 
     def _complete(self, *, value=None, error=None, status="ok",
                   wait_s=None) -> None:
@@ -208,6 +209,7 @@ class Ticket:
             self._error = error
             self.status = status
             self.wait_s = wait_s
+            cbs, self._cbs = self._cbs, []
         # terminal edge outside the ticket lock (the tracer takes its
         # own locks) but BEFORE the wakeup: a waiter that observes a
         # done ticket must observe a closed trace — ONE funnel for
@@ -217,6 +219,31 @@ class Ticket:
         if self.trace is not None:
             self.trace.finish(status)
         self._event.set()
+        # completion hooks AFTER the wakeup, outside every lock: the
+        # front router's failover path re-submits from here, and a
+        # re-submission must never run under this ticket's lock (or
+        # before a blocked waiter could observe the terminal status)
+        for cb in cbs:
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 — observers never raise
+                obs.count("serve_callback_error", op=self.op)
+
+    def add_done_callback(self, cb) -> None:
+        """Run ``cb(ticket)`` once the ticket is terminal (any
+        status).  Fires immediately — on the calling thread — when the
+        ticket is already done; otherwise on the completing thread,
+        after waiters are woken.  The front router's failover hook."""
+        with self._lock:
+            if self.status == "pending":
+                self._cbs.append(cb)
+                return
+        # already terminal — but _complete may still be between its
+        # lock release and trace.finish/_event.set on the completing
+        # thread; wait for the event so the callback (like any waiter)
+        # observes a closed trace
+        self._event.wait()
+        cb(self)
 
     def done(self) -> bool:
         """Answered (any status but ``pending``)?"""
@@ -316,6 +343,34 @@ _OPS = {
 SUPPORTED_OPS = tuple(sorted(_OPS))
 
 
+def classify_request(op: str, x, params: dict):
+    """Shared shape-class derivation — the ONE home of the ``(op,
+    param-key, bucket)`` triple that keys a batch's compiled handle
+    AND its circuit breaker, used by :meth:`Server.submit` and by the
+    front router's placement scoring (which must read exactly the key
+    the replica's dispatch will breaker on, or per-class
+    deprioritization silently stops matching).  Returns ``(xarr, n,
+    canonical_params, key)``; ``canonical_params`` is None for
+    pipeline ops (the server builds the state-carrying params
+    itself, and a pipeline invocation's block length IS its class —
+    no pad-to-bucket).  Malformed requests raise ValueError."""
+    xarr = np.asarray(x, np.float32)
+    if xarr.ndim != 1 or xarr.shape[0] == 0:
+        raise ValueError(
+            f"requests carry one 1-D signal, got shape "
+            f"{xarr.shape}")
+    n = int(xarr.shape[0])
+    if op.startswith("pipeline:"):
+        return xarr, n, None, (op, (), n)
+    if op not in _OPS:
+        raise ValueError(
+            f"unsupported op {op!r} "
+            f"(supported: {', '.join(SUPPORTED_OPS)})")
+    validate, _ = _OPS[op]
+    cparams, param_key = validate(params, n)
+    return xarr, n, cparams, (op, param_key, bucket_length(n))
+
+
 def _device_call(op: str, xs, params: dict, donate: bool):
     """The device dispatch for one padded batch — always invoked
     inside a ``faults.guarded`` thunk (lint-enforced), so transient
@@ -370,14 +425,22 @@ class Server:
                  workers: int = DEFAULT_WORKERS,
                  probe_every: int = DEFAULT_PROBE_EVERY,
                  donate: bool = False,
-                 obs_port: int | None = None):
+                 obs_port: int | None = None,
+                 name: str | None = None):
+        # ``name`` is the replica identity (serve/cluster.py): a named
+        # server's breakers are keyed (name, *shape-class) so N
+        # in-process replicas keep INDEPENDENT per-class breakers in
+        # the shared registry — the front router's per-replica
+        # deprioritization signal.  Unnamed (single-server) keys are
+        # unchanged.
+        self.name = None if name is None else str(name)
         max_wait_s = (None if max_wait_ms is None
                       else float(max_wait_ms) / 1e3)
         self._batcher = Batcher(max_batch, max_wait_s,
                                 on_expired=self._expire_items)
         self._admission = AdmissionController(queue_depth,
                                               tenant_depth)
-        self._health = HealthMonitor(probe_every)
+        self._health = HealthMonitor(probe_every, name=self.name)
         self.workers = int(workers)
         if self.workers < 1:
             raise ValueError("workers must be >= 1")
@@ -430,7 +493,12 @@ class Server:
         if self._endpoint is not None:
             obs.record_decision("serve_obs_endpoint", "armed",
                                 port=self._endpoint.port)
-        obs.gauge("serve_healthy", 1.0)
+        # same label shape as the health machine's trip/recover
+        # updates: a named replica's gauge series must be the one its
+        # degrade flips, or a dashboard watching it never sees the
+        # transition
+        obs.gauge("serve_healthy", 1.0,
+                  **({"replica": self.name} if self.name else {}))
         return self
 
     @property
@@ -442,7 +510,10 @@ class Server:
         """Close the intake and join the workers.  ``drain=True``
         (default) answers everything already queued first;
         ``drain=False`` fails queued requests with
-        :class:`ServerClosed`."""
+        :class:`ServerClosed` — *answered typed, never abandoned*:
+        every ticket still completes (closing its request trace with a
+        terminal edge, so ``zero_orphaned_traces`` holds outside chaos
+        campaigns too) and its admission slot is released."""
         self._stopped = True
         if not drain:
             # workers see _abandoned and complete without dispatching
@@ -451,6 +522,22 @@ class Server:
         for t in self._threads:
             t.join()
         self._threads = []
+        # the abandonment sweep: anything STILL queued after the
+        # workers exit (a server stopped before start(), or a worker
+        # that died mid-outage) must close its causal chain — a queued
+        # ticket the stop path forgets is a lost request and an
+        # orphaned trace, the exact invariants the accounting gates
+        while True:
+            got = self._batcher.next_batch()
+            if got is None:
+                break
+            for p in got[1]:
+                if not p.ticket.done():
+                    p.ticket._complete(
+                        error=ServerClosed(
+                            "server stopped before dispatch"),
+                        status="closed")
+                self._release(p)
         if self._endpoint is not None:
             self._endpoint.stop()
             self._endpoint = None
@@ -522,16 +609,8 @@ class Server:
                     f"unregistered pipeline op {request.op!r} "
                     f"(registered: "
                     f"{sorted(self._pipelines) or 'none'})")
-        elif request.op not in _OPS:
-            raise ValueError(
-                f"unsupported op {request.op!r} "
-                f"(supported: {', '.join(SUPPORTED_OPS)})")
-        xarr = np.asarray(request.x, np.float32)
-        if xarr.ndim != 1 or xarr.shape[0] == 0:
-            raise ValueError(
-                f"requests carry one 1-D signal, got shape "
-                f"{xarr.shape}")
-        n = int(xarr.shape[0])
+        xarr, n, cparams, key = classify_request(
+            request.op, request.x, request.params)
         if pipe is not None:
             if n != pipe.block_len:
                 raise ValueError(
@@ -545,10 +624,6 @@ class Server:
             if state is not None:
                 pipe.check_state(state)
             cparams = {"state": state}
-            param_key = ()
-        else:
-            validate, _ = _OPS[request.op]
-            cparams, param_key = validate(request.params, n)
         if self._stopped:
             raise ServerClosed("server is stopped")
         ticket = Ticket(request.op, request.tenant)
@@ -556,10 +631,7 @@ class Server:
         if dl_ms is None:
             dl_ms = env_deadline_ms()
         has_deadline = dl_ms is not None and dl_ms > 0
-        # a pipeline's block length IS its shape class (every
-        # invocation carries exactly one block — no pad-to-bucket)
-        nb = n if pipe is not None else bucket_length(n)
-        key = (request.op, param_key, nb)
+        nb = key[2]
         # the request axis: minted BEFORE admission so a shed request
         # still closes a causal chain; carried across threads on the
         # ticket, finished by Ticket._complete whatever the outcome
@@ -838,7 +910,8 @@ class Server:
                     tr.event("degraded", to="oracle",
                              reason="health_degraded")
                 return _oracle_call(op, xs, params), True
-        br = _breaker.breaker_for("serve.dispatch", key)
+        br = _breaker.breaker_for("serve.dispatch",
+                                  self.breaker_key(key))
         # a health-machine probe batch outranks the breaker's
         # short-circuit (a one-class server would otherwise stay
         # DEGRADED until the breaker's own cadence probed)
@@ -881,6 +954,20 @@ class Server:
         return ys, box["tripped"]
 
     # -- introspection -----------------------------------------------------
+
+    def breaker_key(self, key) -> tuple:
+        """The registry key of this server's breaker for shape class
+        ``key``: the class triple itself, prefixed with the server's
+        replica ``name`` when one was given — N named in-process
+        replicas share the process-global breaker registry, so the
+        name is what keeps their per-class breakers independent (and
+        lets the front router read ONE replica's state)."""
+        return key if self.name is None else (self.name,) + tuple(key)
+
+    def depth(self) -> int:
+        """Requests currently admitted (queued or in flight) — the
+        front router's least-loaded placement signal."""
+        return self._admission.depth()
 
     @property
     def health(self) -> str:
