@@ -167,7 +167,7 @@ def bench_sgemm(rng):
     a, b = jnp.asarray(a_np), jnp.asarray(b_np)
 
     def step(v):  # rms-normalized so 256 chained GEMMs don't blow up
-        return rms_normalize(mx._matmul(v, b))
+        return rms_normalize(mx._matmul_p(v, b))
 
     t = device_time_chained(step, a)
     flops = 2 * n ** 3
@@ -342,6 +342,158 @@ def bench_autotuned_headline(rng):
                   "geometry — probe noise or a stale winner; rerun "
                   "and inspect the autotune decisions in "
                   "BENCH_DETAILS.json", file=sys.stderr)
+    return out
+
+
+def _precision_err_gate(got, want, precision, label):
+    """Accuracy gate before any precision row is timed: the row's
+    number is meaningless if the route left its error budget
+    (runtime/precision.py ERROR_BUDGETS)."""
+    from veles.simd_tpu.runtime import precision as prx
+
+    # no dtype coercion: got may be complex (the stft row)
+    rel = float(np.max(np.abs(np.asarray(got) - want))
+                / max(1e-30, np.max(np.abs(want))))
+    budget = prx.ERROR_BUDGETS[precision]
+    if rel > budget:
+        raise RuntimeError(
+            f"{label} {precision} rel err {rel:.2e} > budget "
+            f"{budget:.0e}")
+    print(f"TPU-CHECK {label} [{precision}]: ok (rel err {rel:.1e})",
+          file=sys.stderr)
+
+
+def bench_precision_gemm(rng):
+    """Config 14: gemm 2048 at bf16_comp vs the fp32 route — the
+    precision-routes headline (ISSUE 14 acceptance: >=2x at <=1e-4
+    rel err on real MXU hardware; on CPU the row only proves
+    plumbing).  vs_baseline IS the comp-vs-fp32 speedup, and each
+    side's roofline divides by ITS OWN per-precision MXU bound
+    (utils/benchmark.py MXU_F32_PASSES) so bf16_comp is never
+    flattered against the 6-pass f32 ceiling."""
+    import jax.numpy as jnp
+
+    from veles.simd_tpu.ops import matrix as mx
+    from veles.simd_tpu.utils.benchmark import gemm_roofline
+
+    n = 2048
+    a_np = rng.randn(n, n).astype(np.float32)
+    b_np = rng.randn(n, n).astype(np.float32)
+    a, b = jnp.asarray(a_np), jnp.asarray(b_np)
+    want = np.asarray(a_np, np.float64) @ np.asarray(b_np, np.float64)
+    _precision_err_gate(mx._matmul_p(a, b, precision="bf16_comp"),
+                        want, "bf16_comp", "gemm-2048")
+
+    def make_step(precision):
+        def step(v):
+            return rms_normalize(mx._matmul_p(v, b,
+                                              precision=precision))
+        return step
+
+    t_fp32 = device_time_chained(make_step("highest"), a)
+    t_comp = device_time_chained(make_step("bf16_comp"), a)
+    flops = 2 * n ** 3
+    out = {"metric": "gemm 2048 bf16_comp", "unit": "GFLOP/s",
+           "value": flops / t_comp / 1e9,
+           "baseline": flops / t_fp32 / 1e9}
+    if np.isfinite(t_comp) and np.isfinite(t_fp32):
+        roofs = {"bf16_comp": gemm_roofline(flops, t_comp,
+                                            "bf16_comp"),
+                 "highest": gemm_roofline(flops, t_fp32, "highest")}
+        out["roofline_precisions"] = roofs
+        print(f"GEMM-PRECISION 2048: bf16_comp "
+              f"{flops / t_comp / 1e9:.0f} GFLOP/s "
+              f"({roofs['bf16_comp']['pct_of_roofline']:.0f}% of its "
+              f"3-pass bound) vs fp32 {flops / t_fp32 / 1e9:.0f} "
+              f"({roofs['highest']['pct_of_roofline']:.0f}% of the "
+              f"6-pass bound) — {t_fp32 / t_comp:.2f}x",
+              file=sys.stderr)
+    return out
+
+
+def bench_precision_convolve(rng):
+    """Config 15: the headline overlap-save geometry (1M x 2047) on
+    the xla_matmul_bf16_comp route vs the highest-precision block
+    matmul — the matmul-bound row the >=2x acceptance names."""
+    import jax.numpy as jnp
+
+    from veles.simd_tpu.ops import convolve as cv
+
+    n, k = 1 << 20, 2047
+    x_np = rng.randn(n).astype(np.float32)
+    h_np = rng.randn(k).astype(np.float32)
+    x, h = jnp.asarray(x_np), jnp.asarray(h_np)
+    step_len = cv.overlap_save_step(k)
+    want = np.convolve(np.asarray(x_np[: 1 << 16], np.float64),
+                       np.asarray(h_np, np.float64))
+    got = cv._conv_os_matmul(jnp.asarray(x_np[: 1 << 16]), h,
+                             step_len, precision="bf16_comp")
+    _precision_err_gate(got, want, "bf16_comp", "convolve-os")
+
+    def make_step(precision):
+        def step(v):
+            y = cv._conv_os_matmul(v, h, step_len,
+                                   precision=precision)
+            return v + 1e-30 * y[..., :n]
+        return step
+
+    t_hi = device_time_chained(make_step("highest"), x)
+    t_comp = device_time_chained(make_step("bf16_comp"), x)
+    out = {"metric": "convolve 1M x 2047 bf16_comp",
+           "unit": "Msamples/s",
+           "value": n / t_comp / 1e6, "baseline": n / t_hi / 1e6}
+    if np.isfinite(t_comp) and np.isfinite(t_hi):
+        out["roofline_precisions"] = {
+            "bf16_comp": conv_roofline(n / t_comp, k, "bf16_comp"),
+            "highest": conv_roofline(n / t_hi, k, "highest")}
+        print(f"CONV-PRECISION 1Mx2047: bf16_comp "
+              f"{n / t_comp / 1e6:.0f} Ms/s vs highest "
+              f"{n / t_hi / 1e6:.0f} Ms/s ({t_hi / t_comp:.2f}x)",
+              file=sys.stderr)
+    return out
+
+
+def bench_precision_stft(rng):
+    """Config 16: STFT 16k x 512/128 (batch 64) on the
+    rdft_matmul_bf16_comp route vs rdft_matmul — the spectral
+    matmul-bound row of the precision acceptance."""
+    import jax.numpy as jnp
+
+    from veles.simd_tpu.ops import spectral as sp
+
+    batch, n, fl, hop = 64, 1 << 14, 512, 128
+    x_np = rng.randn(batch, n).astype(np.float32)
+    xd = jnp.asarray(x_np)
+    want = sp.stft_na(x_np[:2], fl, hop)
+    got = np.asarray(sp.stft(xd[:2], fl, hop, simd=True,
+                             route="rdft_matmul_bf16_comp"))
+    _precision_err_gate(got, want, "bf16_comp", "stft-rdft")
+
+    def make_step(route):
+        def step(v):
+            s = sp.stft(v, fl, hop, simd=True, route=route)
+            return v + 1e-30 * jnp.abs(s).mean()
+        return step
+
+    t_hi = device_time_chained(make_step("rdft_matmul"), xd)
+    t_comp = device_time_chained(make_step("rdft_matmul_bf16_comp"),
+                                 xd)
+    samples = batch * n
+    frames = sp.frame_count(n, fl, hop)
+    out = {"metric": "stft 16k x 512 bf16_comp",
+           "unit": "Msamples/s",
+           "value": samples / t_comp / 1e6,
+           "baseline": samples / t_hi / 1e6}
+    if np.isfinite(t_comp) and np.isfinite(t_hi):
+        out["roofline_precisions"] = {
+            "bf16_comp": stft_roofline(batch * frames / t_comp, fl,
+                                       precision="bf16_comp"),
+            "highest": stft_roofline(batch * frames / t_hi, fl,
+                                     precision="highest")}
+        print(f"STFT-PRECISION 16kx512/128: bf16_comp "
+              f"{samples / t_comp / 1e6:.0f} Ms/s vs highest "
+              f"{samples / t_hi / 1e6:.0f} Ms/s "
+              f"({t_hi / t_comp:.2f}x)", file=sys.stderr)
     return out
 
 
@@ -1092,7 +1244,8 @@ def main():
                    bench_dwt, bench_stft, bench_istft_roundtrip,
                    bench_spectrogram, bench_batched_stft,
                    bench_serve, bench_pipeline, bench_pipeline_p99,
-                   bench_autotuned_headline)
+                   bench_autotuned_headline, bench_precision_gemm,
+                   bench_precision_convolve, bench_precision_stft)
         for i, fn in enumerate(configs):
             # a failed/skipped config never reaches flush()'s reset — drop
             # its events here so they can't masquerade as the next config's

@@ -102,7 +102,9 @@ def test_main_records_skips_in_json_tail(monkeypatch, tmp_path, capsys):
     for name in ("bench_stft", "bench_istft_roundtrip",
                  "bench_spectrogram", "bench_batched_stft",
                  "bench_serve", "bench_pipeline",
-                 "bench_pipeline_p99", "bench_autotuned_headline"):
+                 "bench_pipeline_p99", "bench_autotuned_headline",
+                 "bench_precision_gemm", "bench_precision_convolve",
+                 "bench_precision_stft"):
         monkeypatch.setattr(bench, name,
                             lambda rng, name=name: quick(rng, name))
 
@@ -141,7 +143,10 @@ def test_main_records_skips_in_json_tail(monkeypatch, tmp_path, capsys):
                        "bench_spectrogram", "bench_batched_stft",
                        "bench_serve", "bench_pipeline",
                        "bench_pipeline_p99",
-                       "bench_autotuned_headline"]
+                       "bench_autotuned_headline",
+                       "bench_precision_gemm",
+                       "bench_precision_convolve",
+                       "bench_precision_stft"]
     tail = details[-1]
     assert "skipped_stages" in tail
     stages = [s["stage"] for s in tail["skipped_stages"]]
@@ -172,7 +177,9 @@ def _run_main_with_headline(monkeypatch, tmp_path, vs_baseline):
                  "bench_dwt", "bench_stft", "bench_istft_roundtrip",
                  "bench_spectrogram", "bench_batched_stft",
                  "bench_serve", "bench_pipeline",
-                 "bench_pipeline_p99", "bench_autotuned_headline"):
+                 "bench_pipeline_p99", "bench_autotuned_headline",
+                 "bench_precision_gemm", "bench_precision_convolve",
+                 "bench_precision_stft"):
         def mk(name):
             def cfg(rng):
                 return {"metric": name, "unit": "u", "value": 2.0,

@@ -610,7 +610,10 @@ class TestBenchStageRetry:
                      "bench_spectrogram", "bench_batched_stft",
                      "bench_serve", "bench_pipeline",
                      "bench_pipeline_p99",
-                     "bench_autotuned_headline"):
+                     "bench_autotuned_headline",
+                     "bench_precision_gemm",
+                     "bench_precision_convolve",
+                     "bench_precision_stft"):
             def mk(name):
                 def cfg(rng):
                     return {"metric": name, "unit": "u", "value": 2.0,

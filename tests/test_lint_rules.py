@@ -1107,3 +1107,116 @@ def test_real_pipeline_modules_pass_pipeline_rules():
         tree = ast.parse(f.read_text(), str(f))
         assert lint.pipeline_route_errors(tree, str(f)) == []
         assert lint.pipeline_guard_errors(tree, str(f)) == []
+
+
+# ---------------------------------------------------------------------------
+# precision-literal rule (the bf16_comp PR): raw jax.lax.Precision /
+# preferred_element_type literals are forbidden in ops//parallel
+# compute cores — precision belongs to runtime/precision.py
+# ---------------------------------------------------------------------------
+
+PRECISION_GOOD = '''
+import jax.numpy as jnp
+from veles.simd_tpu.runtime import precision as prx
+
+
+def _core(a, b):
+    return prx.p_einsum("ij,jk->ik", a, b, precision="bf16_comp")
+
+
+def _core2(a, b):
+    return jnp.matmul(a, b, precision=prx.HIGHEST)
+'''
+
+PRECISION_RAW_LITERAL = '''
+import jax
+import jax.numpy as jnp
+
+
+def _core(a, b):
+    return jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
+'''
+
+PRECISION_LAX_ALIAS = '''
+from jax import lax as _l
+import jax.numpy as jnp
+
+
+def _core(a, b):
+    return jnp.matmul(a, b, precision=_l.Precision.HIGH)
+'''
+
+PRECISION_FROM_IMPORT = '''
+from jax.lax import Precision as _P
+import jax.numpy as jnp
+
+
+def _core(a, b):
+    return jnp.matmul(a, b, precision=_P.HIGHEST)
+'''
+
+PRECISION_PET_KWARG = '''
+import jax.numpy as jnp
+
+
+def _core(a, b):
+    return jnp.matmul(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
+'''
+
+
+def _precision_errs(src):
+    return lint.precision_literal_errors(ast.parse(src), "mod.py")
+
+
+def test_precision_rule_passes_layer_usage():
+    assert _precision_errs(PRECISION_GOOD) == []
+
+
+def test_precision_rule_flags_raw_literal():
+    errs = _precision_errs(PRECISION_RAW_LITERAL)
+    assert any("jax.lax.Precision" in e for e in errs)
+
+
+def test_precision_rule_tracks_lax_alias():
+    errs = _precision_errs(PRECISION_LAX_ALIAS)
+    assert any("Precision" in e for e in errs)
+
+
+def test_precision_rule_tracks_from_import():
+    errs = _precision_errs(PRECISION_FROM_IMPORT)
+    assert any("Precision" in e for e in errs)
+
+
+def test_precision_rule_flags_preferred_element_type():
+    errs = _precision_errs(PRECISION_PET_KWARG)
+    assert any("preferred_element_type" in e for e in errs)
+
+
+def test_real_compute_modules_pass_precision_rule():
+    """Acceptance gate: zero raw precision literals left in ops/ or
+    parallel/ outside the exempt Mosaic kernel module — every
+    contraction's precision flows through runtime/precision.py."""
+    for sub in ("ops", "parallel"):
+        for path in sorted((REPO / "veles/simd_tpu" / sub).glob("*.py")):
+            rel = path.relative_to(REPO).as_posix()
+            if rel in lint._PRECISION_RULE_EXEMPT:
+                continue
+            errs = lint.precision_literal_errors(
+                ast.parse(path.read_text()), rel)
+            assert errs == [], errs
+
+
+PRECISION_BARE_JAX_LAX = '''
+import jax.lax
+import jax.numpy as jnp
+
+
+def _core(a, b):
+    return jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
+'''
+
+
+def test_precision_rule_flags_bare_jax_lax_import():
+    errs = _precision_errs(PRECISION_BARE_JAX_LAX)
+    assert any("Precision" in e for e in errs)

@@ -215,15 +215,18 @@ class TestFourierRouting:
             probes.append(name)
             thunk()
             # steer AGAINST the static prior so the selection is
-            # provably measured, not the table order
-            return {"sharded_matmul_dft": 9.0, "local_fft": 1.0}[name]
+            # provably measured, not the table order (the bf16_comp
+            # precision candidate rides along, slower than both)
+            return {"sharded_matmul_dft": 9.0, "local_fft": 1.0,
+                    "sharded_matmul_dft_bf16_comp": 12.0}[name]
 
         obs.enable()
         obs.reset()
         try:
             with routing.probe_timer(timer):
                 to_host(fr.sharded_rfft(x, mesh8))
-            assert set(probes) == {"sharded_matmul_dft", "local_fft"}
+            assert {"sharded_matmul_dft",
+                    "local_fft"} <= set(probes)
             ev = [e for e in obs.events()
                   if e["op"] == "sharded_rfft"][-1]
             assert ev["decision"] == "local_fft"

@@ -1,0 +1,502 @@
+"""The compensated-precision layer (runtime/precision.py) and its
+routes: per-(route, precision) error-budget parity vs the float64
+NumPy oracles, the adversarial bf16_comp-beats-bf16 gate, engine
+eligibility/refusal (int8 opt-in, bf16 forced-only,
+VELES_SIMD_DISABLE_BF16_COMP), the fast= deprecation shim, and the
+end-to-end autotune gate — the measured tuner crowning a PRECISION
+winner per geometry with decision-event + tune-cache introspection
+proof (the test_routing stft pattern)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from veles.simd_tpu import obs
+from veles.simd_tpu.ops import convolve as cv
+from veles.simd_tpu.ops import matrix as mx
+from veles.simd_tpu.ops import spectral as sp
+from veles.simd_tpu.runtime import precision as prx
+from veles.simd_tpu.runtime import routing
+from veles.simd_tpu.utils import benchmark as bm
+
+RNG = np.random.RandomState(59)
+
+BUDGET = prx.ERROR_BUDGETS["bf16_comp"]
+
+
+def _rel(got, want):
+    """Max-normalized relative error — the tune tools' metric.
+    ``got`` may be real or complex; the difference promotes to
+    ``want``'s float64/complex128."""
+    return float(np.max(np.abs(np.asarray(got) - want))
+                 / max(1e-30, np.max(np.abs(want))))
+
+
+def _adversarial(shape, rng):
+    """Large-dynamic-range operand: randn scaled by per-element
+    powers of ten across six decades — the input that exposes plain
+    bf16's mantissa loss."""
+    return (rng.randn(*shape)
+            * 10.0 ** rng.uniform(-3, 3, shape)).astype(np.float32)
+
+
+@pytest.fixture
+def fresh_cache(tmp_path, monkeypatch):
+    path = str(tmp_path / "tune.json")
+    monkeypatch.setenv(routing.AUTOTUNE_CACHE_ENV, path)
+    routing.set_cache_path(None)
+    yield path
+    routing.set_cache_path(None)
+
+
+@pytest.fixture
+def autotune_on(monkeypatch):
+    monkeypatch.setenv(routing.AUTOTUNE_ENV, "on")
+    yield
+    routing.set_cache_path(None)
+
+
+def _fake_timer(table):
+    def timer(thunk, name):
+        thunk()
+        if name not in table:
+            raise RuntimeError(f"no timing for {name}")
+        return table[name]
+    return timer
+
+
+# ---------------------------------------------------------------------------
+# the layer's primitives
+# ---------------------------------------------------------------------------
+
+class TestPrimitives:
+    def test_split_reconstructs(self):
+        x = jnp.asarray(RNG.randn(256).astype(np.float32))
+        hi, lo = prx.split_bf16(x)
+        rec = hi.astype(jnp.float32) + lo.astype(jnp.float32)
+        # two bf16 mantissas stack to ~16 bits: ~2^-17 relative
+        assert _rel(rec, np.asarray(x, np.float64)) < 5e-5
+
+    @pytest.mark.parametrize("precision,budget", [
+        ("highest", prx.ERROR_BUDGETS["highest"]),
+        ("bf16_comp", prx.ERROR_BUDGETS["bf16_comp"]),
+        ("bf16", prx.ERROR_BUDGETS["bf16"]),
+        ("int8", prx.ERROR_BUDGETS["int8"]),
+    ])
+    def test_einsum_within_budget(self, precision, budget):
+        a = RNG.randn(128, 256).astype(np.float32)
+        b = RNG.randn(256, 64).astype(np.float32)
+        want = np.asarray(a, np.float64) @ np.asarray(b, np.float64)
+        got = prx.p_einsum("ij,jk->ik", jnp.asarray(a),
+                           jnp.asarray(b), precision=precision)
+        assert _rel(got, want) <= budget, precision
+
+    def test_bf16_comp_beats_bf16_10x_adversarial(self):
+        """The satellite gate: on a large-dynamic-range input the
+        compensated route's error is >= 10x smaller than plain
+        bf16's (measured ~460x on the randn-decades input)."""
+        a = _adversarial((256, 256), RNG)
+        b = _adversarial((256, 256), RNG)
+        want = np.asarray(a, np.float64) @ np.asarray(b, np.float64)
+        err_bf16 = _rel(prx.p_matmul(jnp.asarray(a), jnp.asarray(b),
+                                     precision="bf16"), want)
+        err_comp = _rel(prx.p_matmul(jnp.asarray(a), jnp.asarray(b),
+                                     precision="bf16_comp"), want)
+        assert err_comp * 10 <= err_bf16, (err_comp, err_bf16)
+        assert err_comp <= BUDGET
+
+    def test_eligibility_policy(self, monkeypatch):
+        assert prx.precision_allowed("highest")
+        assert prx.precision_allowed("bf16_comp")
+        assert not prx.precision_allowed("bf16")   # forced-only
+        assert not prx.precision_allowed("int8")   # opt-in
+        monkeypatch.setenv(prx.INT8_ENV, "1")
+        assert prx.precision_allowed("int8")
+        monkeypatch.setenv(prx.BF16_COMP_ENV, "1")
+        assert not prx.precision_allowed("bf16_comp")
+
+    def test_unknown_precision_rejected(self):
+        with pytest.raises(ValueError):
+            prx.p_matmul(jnp.zeros((2, 2)), jnp.zeros((2, 2)),
+                         precision="fp64")
+
+    def test_route_name_round_trip(self):
+        assert prx.comp_route("rdft_matmul") == \
+            "rdft_matmul_bf16_comp"
+        assert prx.base_route("rdft_matmul_bf16_comp") == \
+            "rdft_matmul"
+        assert prx.base_route("xla_fft") == "xla_fft"
+
+
+# ---------------------------------------------------------------------------
+# per-(route, precision) parity vs the float64 oracles
+# ---------------------------------------------------------------------------
+
+class TestGemmRoutes:
+    def test_bf16_comp_within_budget(self):
+        a = RNG.randn(256, 512).astype(np.float32)
+        b = RNG.randn(512, 128).astype(np.float32)
+        want = np.asarray(a, np.float64) @ np.asarray(b, np.float64)
+        got = mx.matrix_multiply(a, b, simd=True,
+                                 precision="bf16_comp")
+        assert _rel(got, want) <= BUDGET
+
+    def test_transposed_bf16_comp_within_budget(self):
+        a = RNG.randn(128, 512).astype(np.float32)
+        bt = RNG.randn(64, 512).astype(np.float32)
+        want = np.einsum("ij,kj->ik", np.asarray(a, np.float64),
+                         np.asarray(bt, np.float64))
+        got = mx.matrix_multiply_transposed(a, bt, simd=True,
+                                            precision="bf16_comp")
+        assert _rel(got, want) <= BUDGET
+
+    def test_gemv_precision_forced(self):
+        m = RNG.randn(300, 256).astype(np.float32)
+        v = RNG.randn(256).astype(np.float32)
+        want = np.asarray(m, np.float64) @ np.asarray(v, np.float64)
+        got = mx.matrix_vector_multiply(m, v, simd=True,
+                                        precision="bf16_comp")
+        assert _rel(got, want) <= BUDGET
+
+    def test_forced_int8_loose_budget(self):
+        """int8 is forceable without the env opt-in; its error sits
+        inside its own (loose) budget on unit-scale input."""
+        a = RNG.randn(128, 128).astype(np.float32)
+        b = RNG.randn(128, 128).astype(np.float32)
+        want = np.asarray(a, np.float64) @ np.asarray(b, np.float64)
+        got = mx.matrix_multiply(a, b, simd=True, precision="int8")
+        assert _rel(got, want) <= prx.ERROR_BUDGETS["int8"]
+
+    def test_fast_shim_maps_to_bf16_route(self):
+        """The deprecation shim: fast=True -> the bf16 route, with a
+        DeprecationWarning and a matrix_precision_route decision
+        event — the last precision choice outside the engine gone."""
+        a = RNG.randn(64, 64).astype(np.float32)
+        b = RNG.randn(64, 64).astype(np.float32)
+        obs.enable()
+        obs.reset()
+        try:
+            with pytest.warns(DeprecationWarning):
+                got = mx.matrix_multiply(a, b, simd=True, fast=True)
+            ev = [e for e in obs.events()
+                  if e["op"] == "matrix_precision_route"][-1]
+            assert ev["decision"] == "bf16"
+            assert ev["forced"]
+            want = np.asarray(mx.matrix_multiply(
+                a, b, simd=True, precision="bf16"))
+            np.testing.assert_allclose(np.asarray(got), want)
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def test_engine_default_is_fp32(self):
+        """With autotune off the static prior stays the
+        oracle-parity fp32 route — precision candidates never change
+        the default."""
+        a = RNG.randn(64, 64).astype(np.float32)
+        b = RNG.randn(64, 64).astype(np.float32)
+        obs.enable()
+        obs.reset()
+        try:
+            mx.matrix_multiply(a, b, simd=True)
+            ev = [e for e in obs.events()
+                  if e["op"] == "matrix_precision_route"][-1]
+            assert ev["decision"] == "fp32"
+            assert not ev["forced"]
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def test_bad_precision_rejected(self):
+        a = np.zeros((4, 4), np.float32)
+        with pytest.raises(ValueError):
+            mx.matrix_multiply(a, a, simd=True, precision="fp16")
+
+    def test_family_registered(self):
+        fams = routing.families()
+        assert "matrix.gemm" in fams
+        assert set(fams["matrix.gemm"].names()) == {
+            "fp32", "bf16_comp", "int8", "bf16"}
+
+
+class TestSpectralRoutes:
+    def test_stft_istft_round_trip_within_budget(self):
+        x = RNG.randn(8192).astype(np.float32)
+        spec = sp.stft(x, 512, 128, simd=True,
+                       route="rdft_matmul_bf16_comp")
+        want = sp.stft_na(x, 512, 128)
+        assert _rel(np.asarray(spec), want) <= BUDGET
+        rec = sp.istft(np.asarray(spec), 8192, 512, 128, simd=True,
+                       route="rdft_matmul_bf16_comp")
+        interior = slice(512, -512)
+        assert _rel(np.asarray(rec)[interior],
+                    np.asarray(x, np.float64)[interior]) <= BUDGET
+
+    def test_hilbert_within_budget(self):
+        x = RNG.randn(512).astype(np.float32)
+        got = sp.hilbert(x, simd=True, route="matmul_dft_bf16_comp")
+        want = sp.hilbert_na(x)
+        assert _rel(got, want) <= BUDGET
+
+    def test_cwt_within_budget(self):
+        x = RNG.randn(512).astype(np.float32)
+        scales = [2.0, 4.0, 8.0]
+        got = sp.morlet_cwt(x, scales, simd=True,
+                            route="matmul_dft_bf16_comp")
+        want = sp.morlet_cwt_na(x, scales)
+        assert _rel(got, want) <= BUDGET
+
+    def test_disable_env_closes_comp_gates(self, monkeypatch):
+        assert sp._STFT_FAMILY.gate("rdft_matmul_bf16_comp",
+                                    frame_length=512, hop=128,
+                                    frames=100)
+        monkeypatch.setenv(prx.BF16_COMP_ENV, "1")
+        for fam, geom in (
+                (sp._STFT_FAMILY,
+                 {"frame_length": 512, "hop": 128, "frames": 100}),
+                (sp._ISTFT_FAMILY, {"frame_length": 512, "hop": 128}),
+                (sp._HILBERT_FAMILY, {"n": 512}),
+                (sp._CWT_FAMILY, {"n": 512})):
+            comp = [r for r in fam.names() if r.endswith("bf16_comp")]
+            assert comp and not fam.gate(comp[0], **geom), fam.name
+
+    def test_static_priors_unchanged(self):
+        """The comp candidates sit after the terminal fallback: the
+        static selection (autotune off) never picks them."""
+        assert sp._select_stft_route(512, 128, 100) == "rdft_matmul"
+        assert sp._STFT_FAMILY.static_select(
+            frame_length=8192, hop=1024, frames=10) == "xla_fft"
+
+
+class TestConvolveRoutes:
+    def test_os_matmul_bf16_comp_within_budget(self):
+        x = RNG.randn(1 << 15).astype(np.float32)
+        h = RNG.randn(511).astype(np.float32)
+        want = np.convolve(np.asarray(x, np.float64),
+                           np.asarray(h, np.float64))
+        got = cv._conv_os_matmul(jnp.asarray(x), jnp.asarray(h),
+                                 cv.overlap_save_step(511),
+                                 precision="bf16_comp")
+        assert _rel(got, want) <= BUDGET
+
+    def test_comp_beats_bf16_on_adversarial_signal(self):
+        x = _adversarial((1 << 14,), RNG)
+        h = RNG.randn(127).astype(np.float32)
+        want = np.convolve(np.asarray(x, np.float64),
+                           np.asarray(h, np.float64))
+        step = cv.overlap_save_step(127)
+        err_bf16 = _rel(cv._conv_os_matmul(
+            jnp.asarray(x), jnp.asarray(h), step,
+            precision="bf16"), want)
+        err_comp = _rel(cv._conv_os_matmul(
+            jnp.asarray(x), jnp.asarray(h), step,
+            precision="bf16_comp"), want)
+        assert err_comp * 10 <= err_bf16, (err_comp, err_bf16)
+        assert err_comp <= BUDGET
+
+    def test_comp_route_in_family_and_eligible(self):
+        fam = routing.get_family("convolve.os")
+        assert "xla_matmul_bf16_comp" in fam.names()
+        assert fam.gate("xla_matmul_bf16_comp", h_length=511)
+
+    def test_dispatched_comp_route_records_decision(
+            self, fresh_cache, monkeypatch):
+        """A tune-cache winner steers the real dispatch onto the comp
+        route, and the convolve_os_route decision event attributes
+        it (readonly mode: consult, never probe)."""
+        n, k = 1 << 15, 511
+        handle = cv.convolve_overlap_save_initialize(n, k)
+        routing.tune_cache().store(
+            "convolve.os",
+            {"rows": 1, "x_length": routing.pow2_bucket(n),
+             "h_length": k, "step": handle.step,
+             "precision": cv.os_precision()},
+            "xla_matmul_bf16_comp", source="test")
+        x = RNG.randn(n).astype(np.float32)
+        h = RNG.randn(k).astype(np.float32)
+        monkeypatch.setenv(routing.AUTOTUNE_ENV, "readonly")
+        obs.enable()
+        obs.reset()
+        try:
+            got = cv.convolve_overlap_save(handle, jnp.asarray(x),
+                                           jnp.asarray(h), simd=True)
+            ev = [e for e in obs.events()
+                  if e["op"] == "convolve_os_route"][-1]
+            assert ev["decision"] == "xla_matmul_bf16_comp"
+            want = np.convolve(np.asarray(x, np.float64),
+                               np.asarray(h, np.float64))
+            assert _rel(got, want) <= BUDGET
+        finally:
+            obs.disable()
+            obs.reset()
+
+
+@pytest.mark.parametrize("n", [4096])
+class TestShardedRoutes:
+    def test_sharded_rfft_bf16_comp_within_budget(self, n):
+        from veles.simd_tpu import parallel as par
+        from veles.simd_tpu.parallel import fourier as fr
+        from veles.simd_tpu.utils.platform import to_host
+
+        mesh = par.make_mesh({"sp": 8})
+        x = RNG.randn(n).astype(np.float32)
+        want = np.fft.rfft(np.asarray(x, np.float64))
+        obs.enable()
+        obs.reset()
+        try:
+            got = to_host(fr.sharded_rfft(
+                x, mesh, route="sharded_matmul_dft_bf16_comp"))
+            assert _rel(got, want) <= BUDGET
+            ev = [e for e in obs.events()
+                  if e["op"] == "sharded_rfft"][-1]
+            assert ev["decision"] == "sharded_matmul_dft_bf16_comp"
+            assert ev["precision"] == "bf16_comp"
+            assert ev["ici_bytes"] > 0
+            # the model's payload width: the comp route ships the
+            # exact f32 pair (a lossy bf16 payload fails the budget
+            # — A2A_PAYLOAD_BYTES doc)
+            assert ev["ici_bytes"] == fr.a2a_ici_bytes(
+                n, fr.A2A_PAYLOAD_BYTES["bf16_comp"], 8)
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def test_sharded_irfft_round_trip(self, n):
+        from veles.simd_tpu import parallel as par
+        from veles.simd_tpu.parallel import fourier as fr
+        from veles.simd_tpu.utils.platform import to_host
+
+        mesh = par.make_mesh({"sp": 8})
+        x = RNG.randn(n).astype(np.float32)
+        spec = np.fft.rfft(np.asarray(x, np.float64)).astype(
+            np.complex64)
+        got = to_host(fr.sharded_irfft(
+            spec, n, mesh, route="sharded_matmul_dft_bf16_comp"))
+        assert _rel(got, np.asarray(x, np.float64)) <= BUDGET
+
+
+# ---------------------------------------------------------------------------
+# the autotuner crowns a precision winner per geometry (decision event
+# + tune-cache introspection, the test_routing end-to-end pattern)
+# ---------------------------------------------------------------------------
+
+class TestAutotunedPrecision:
+    def test_gemm_precision_winner_selected_persisted_reloaded(
+            self, fresh_cache, autotune_on):
+        a = RNG.randn(96, 96).astype(np.float32)
+        b = RNG.randn(96, 96).astype(np.float32)
+        timer = _fake_timer({"fp32": 5.0, "bf16_comp": 1.0,
+                             "int8": 9.0, "bf16": 9.0})
+        obs.enable()
+        obs.reset()
+        try:
+            with routing.probe_timer(timer):
+                mx.matrix_multiply(a, b, simd=True)
+            route_ev = [e for e in obs.events()
+                        if e["op"] == "matrix_precision_route"][-1]
+            assert route_ev["decision"] == "bf16_comp"
+            tune_ev = [e for e in obs.events()
+                       if e["op"] == "autotune"][-1]
+            assert tune_ev["family"] == "matrix.gemm"
+            assert tune_ev["decision"] == "bf16_comp"
+            assert tune_ev["static"] == "fp32"
+            # persisted under the gemm geometry class...
+            data = json.load(open(fresh_cache))
+            keys = [k for k in data["entries"]
+                    if k.startswith("matrix.gemm|")]
+            assert keys
+            assert data["entries"][keys[0]]["route"] == "bf16_comp"
+            # ...and a fresh cache object (= new process) serves the
+            # winner with NO probing
+            routing.set_cache_path(None)
+            obs.reset()
+            with routing.probe_timer(_fake_timer({})):
+                mx.matrix_multiply(a, b, simd=True)
+            route_ev = [e for e in obs.events()
+                        if e["op"] == "matrix_precision_route"][-1]
+            assert route_ev["decision"] == "bf16_comp"
+            assert not [e for e in obs.events()
+                        if e["op"] == "autotune"]
+            assert obs.counter_value("autotune_cache_hit",
+                                     family="matrix.gemm") >= 1
+        finally:
+            obs.disable()
+            obs.reset()
+
+    def test_convolve_os_precision_winner(self, fresh_cache,
+                                          autotune_on):
+        """The os family's comp candidate wins its probe round and
+        the winner steers the next dispatch of the same class."""
+        n, k = 1 << 15, 511
+        x = RNG.randn(n).astype(np.float32)
+        h = RNG.randn(k).astype(np.float32)
+        handle = cv.convolve_overlap_save_initialize(n, k)
+        timer = _fake_timer({"xla_matmul": 5.0,
+                             "xla_matmul_bf16_comp": 1.0,
+                             "pallas_fused": 9.0})
+        obs.enable()
+        obs.reset()
+        try:
+            with routing.probe_timer(timer):
+                cv.convolve_overlap_save(handle, jnp.asarray(x),
+                                         jnp.asarray(h), simd=True)
+            ev = [e for e in obs.events()
+                  if e["op"] == "convolve_os_route"][-1]
+            assert ev["decision"] == "xla_matmul_bf16_comp"
+            entry = routing.tune_cache().entry(
+                "convolve.os",
+                {"rows": 1, "x_length": routing.pow2_bucket(n),
+                 "h_length": k, "step": handle.step,
+                 "precision": cv.os_precision()})
+            assert entry is not None
+            assert entry["route"] == "xla_matmul_bf16_comp"
+            assert entry["source"] == "measured"
+        finally:
+            obs.disable()
+            obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# per-precision roofline honesty (utils/benchmark.py)
+# ---------------------------------------------------------------------------
+
+class TestRooflineConstants:
+    def test_per_precision_bounds(self):
+        peak = bm.mxu_peak_tflops()
+        assert bm.mxu_f32_bound_tflops("highest") == peak / 6
+        assert bm.mxu_f32_bound_tflops("bf16_comp") == peak / 3
+        assert bm.mxu_f32_bound_tflops("bf16") == peak
+        assert bm.mxu_f32_bound_tflops("int8") == \
+            bm.mxu_int8_peak_tops()
+        with pytest.raises(ValueError):
+            bm.mxu_f32_bound_tflops("fp64")
+
+    def test_gemm_roofline_uses_own_ceiling(self):
+        r32 = bm.gemm_roofline(1e12, 1.0, "highest")
+        rc = bm.gemm_roofline(1e12, 1.0, "bf16_comp")
+        assert rc["roofline_bound_tflops"] == \
+            2 * r32["roofline_bound_tflops"]
+        assert rc["pct_of_roofline"] == pytest.approx(
+            r32["pct_of_roofline"] / 2)
+
+    def test_conv_roofline_accepts_comp(self):
+        roof = bm.conv_roofline(1e9, 2047, "bf16_comp")
+        assert roof["precision"] == "bf16_comp"
+
+
+# ---------------------------------------------------------------------------
+# docs contract (the test_routing env-documentation pattern)
+# ---------------------------------------------------------------------------
+
+class TestDocs:
+    def test_envs_and_section_documented(self):
+        import os
+        guide = open(os.path.join(os.path.dirname(__file__),
+                                  os.pardir, "docs",
+                                  "GUIDE.md")).read()
+        assert "VELES_SIMD_DISABLE_BF16_COMP" in guide
+        assert "VELES_SIMD_ENABLE_INT8" in guide
+        assert "Precision routes" in guide

@@ -17,8 +17,20 @@ The hand-sweep tools (``tools/tune_overlap_save.py``,
 ``--cache`` flag), so a manual sweep and the online tuner build one
 artifact.
 
+Since the bf16_comp PR the drive covers the PRECISION routes too: the
+``matrix.gemm`` family geometries are driven alongside the others,
+and every family's ``*_bf16_comp`` candidates are probed by the same
+measured mode (they are ordinary routes in the tables —
+``runtime/precision.py``).  ``--precisions`` narrows the candidate
+set via the layer's env gates: a list without ``bf16_comp`` sets
+``VELES_SIMD_DISABLE_BF16_COMP=1`` for the drive, a list with
+``int8`` sets ``VELES_SIMD_ENABLE_INT8=1`` — so an operator can build
+a classic-precision-only pack (or an int8-exploring one) without
+touching the environment by hand.
+
 Run:  python tools/autotune_pack.py [--out autotune_pack.json]
-      [--quick]   (or ``make autotune-pack``)
+      [--quick] [--precisions highest,bf16_comp]
+      (or ``make autotune-pack``)
       VELES_SIMD_PLATFORM=cpu ... validates plumbing; measure winners
       on the real chip before shipping a pack.
 """
@@ -44,10 +56,19 @@ def _drive(quick: bool) -> None:
 
     from veles.simd_tpu.ops import convolve as cv
     from veles.simd_tpu.ops import convolve2d as cv2
+    from veles.simd_tpu.ops import matrix as mx
     from veles.simd_tpu.ops import spectral as sp
     from veles.simd_tpu.ops import wavelet as wv
 
     rng = np.random.RandomState(7)
+
+    # matrix.gemm: the precision family — the engine probes
+    # fp32/bf16_comp (and int8 when enabled) per geometry class
+    for nm in ([1024] if quick else [512, 1024, 2048]):
+        a = jnp.asarray(rng.randn(nm, nm).astype(np.float32))
+        b = jnp.asarray(rng.randn(nm, nm).astype(np.float32))
+        np.asarray(mx.matrix_multiply(a, b, simd=True))
+        print(f"  matrix.gemm {nm}x{nm}: done", flush=True)
 
     # convolve overlap-save: the headline geometry first, then the
     # medium-filter classes the suite exercises
@@ -103,9 +124,33 @@ def main():
                              "autotune_pack.json)")
     parser.add_argument("--quick", action="store_true",
                         help="headline geometries only")
+    parser.add_argument(
+        "--precisions", default="highest,bf16_comp",
+        help="precision candidates the drive may explore "
+             "(comma-separated; omit bf16_comp to build a "
+             "classic-precision pack, add int8 to let the opt-in "
+             "route compete)")
     args = parser.parse_args()
     os.environ["VELES_SIMD_AUTOTUNE"] = "on"
     maybe_override_platform()
+
+    # validate AFTER the platform pin (prx pulls jax at import) but
+    # before the env gates act: a typo'd precision must error, not
+    # silently build a pack missing the routes the operator asked for
+    from veles.simd_tpu.runtime import precision as prx
+
+    precisions = {p.strip() for p in args.precisions.split(",")
+                  if p.strip()}
+    for p in precisions:
+        if p not in prx.PRECISIONS:
+            parser.error(f"unknown precision {p!r} (choose from "
+                         f"{sorted(prx.PRECISIONS)})")
+    # the env gates are read live at route-gate time, so setting them
+    # here (post-platform-pin) still steers the whole drive
+    if "bf16_comp" not in precisions:
+        os.environ["VELES_SIMD_DISABLE_BF16_COMP"] = "1"
+    if "int8" in precisions:
+        os.environ["VELES_SIMD_ENABLE_INT8"] = "1"
 
     from veles.simd_tpu import obs
     from veles.simd_tpu.runtime import routing

@@ -133,6 +133,50 @@ def bench_sharded_rfft(mesh, axis, n, rows_out):
           f"{2 * bytes_a2a / 1e6:.1f} MB ICI/dispatch",
           file=sys.stderr)
 
+    # the precision row: the factorized pipeline at bf16_comp
+    # (split/compensated stage matmuls, split-bf16 a2a payload) vs the
+    # highest-precision one on the same geometry — error-budget-gated
+    # before timing, per-precision roofline in the row
+    if not factor:
+        return
+    from veles.simd_tpu.runtime import precision as prx
+
+    comp = "sharded_matmul_dft_bf16_comp"
+    got = to_host(fr.sharded_rfft(x, mesh, axis=axis, route=comp))
+    rel = np.max(np.abs(got - want)) / np.max(np.abs(want))
+    if rel > prx.ERROR_BUDGETS["bf16_comp"]:
+        raise RuntimeError(
+            f"sharded_rfft {comp} rel err {rel:.2e} > "
+            f"{prx.ERROR_BUDGETS['bf16_comp']:.0e}")
+    print(f"MULTICHIP-CHECK sharded_rfft[{comp}] n={n}: ok "
+          f"(rel {rel:.1e})", file=sys.stderr)
+    t_comp = device_time(lambda: jnp.abs(
+        fr.sharded_rfft(x, mesh, axis=axis, route=comp)).mean())
+    t_hi = times.get("sharded_matmul_dft")
+    if t_hi is None or not (np.isfinite(t_comp)
+                            and np.isfinite(t_hi)):
+        return
+    comp_row = {
+        "metric": f"sharded rfft bf16_comp {n // 1024}k x{s}",
+        "unit": "Msamples/s",
+        "value": n / t_comp / 1e6,
+        "baseline": n / t_hi / 1e6,
+        "vs_baseline": t_hi / t_comp,
+        "route": comp,
+        "roofline_precisions": {
+            "bf16_comp": dft_matmul_roofline(
+                n / t_comp, *factor, precision="bf16_comp"),
+            "highest": roofs.get("sharded_matmul_dft")},
+        "ici": {"a2a_per_dispatch": 2,
+                "bytes_per_a2a": a2a_ici_bytes(
+                    n, fr.A2A_PAYLOAD_BYTES["bf16_comp"], s)},
+    }
+    rows_out.append(comp_row)
+    print(f"MULTICHIP sharded_rfft[{comp}]: "
+          f"{comp_row['value']:.1f} Ms/s vs highest "
+          f"{comp_row['baseline']:.1f} Ms/s "
+          f"({comp_row['vs_baseline']:.2f}x)", file=sys.stderr)
+
 
 def bench_sharded_stft_above_cutoff(mesh, axis, n, frame, hop,
                                     rows_out):

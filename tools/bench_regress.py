@@ -115,6 +115,17 @@ DEFAULT_NOISE = [
     # chaos_phase-stamped so dips report DEGRADED-not-gated anyway
     ("replica failover", 0.50),
     ("replica drain", 0.50),
+    # the precision-route family (bench.py configs 14-16 + the
+    # multichip bf16_comp row): device-time rows whose baseline is
+    # the SAME geometry on the fp32/highest route measured in the
+    # same stage — both sides carry chained-timer jitter, and the
+    # gemm row's 2048 GEMM resolves fast enough that its marginal is
+    # the noisiest of the three.  These defaults make the rows gate
+    # from their first clean run.
+    ("gemm 2048 bf16_comp", 0.20),
+    ("convolve 1M x 2047 bf16_comp", 0.12),
+    ("stft 16k x 512 bf16_comp", 0.15),
+    ("sharded rfft bf16_comp", 0.25),
     # the pipeline family (bench.py configs 12/13): wall-clock blocks/s
     # through the fused sensor chain vs its stage-by-stage twin — host
     # dispatch + device jitter on both sides — and the inverse-p99 row

@@ -192,10 +192,10 @@ def main():
                                   for s in (0, 248, 496, 744)))
 
     benchmark("gemm 300x256x1000",
-              lambda v: _fold(mx._matmul(v, bd)), ad,
+              lambda v: _fold(mx._matmul_p(v, bd)), ad,
               lambda: mx.matrix_multiply_novec(a, b), flops=flops_ref)
     benchmark("gemm 300x256x1000 transposed-B",
-              lambda v: _fold(mx._matmul_t(v, btd)), ad,
+              lambda v: _fold(mx._matmul_t_p(v, btd)), ad,
               lambda: mx.matrix_multiply_transposed_novec(a, b.T),
               flops=flops_ref)
 
@@ -213,10 +213,11 @@ def main():
             repeats=1) * (n / 256)
         iters = 64 if n >= 2048 else 256
         t32 = device_time_chained(
-            lambda v: _rms_normalize(mx._matmul(v, bnd)), and_, iters=iters)
+            lambda v: _rms_normalize(mx._matmul_p(v, bnd)), and_, iters=iters)
         tf = device_time_chained(
-            lambda v: _rms_normalize(mx._matmul(v, bnd, fast=True)), and_,
-            iters=iters)
+            lambda v: _rms_normalize(
+                mx._matmul_p(v, bnd, precision="bf16")),
+            and_, iters=iters)
         print(f"[gemm {n} f32/HIGHEST] {flops / t32 / 1e9:.0f} GFLOP/s | "
               f"[bf16 fast] {flops / tf / 1e9:.0f} GFLOP/s | "
               f"cpu-oracle ~{flops / t_base / 1e9:.0f} GFLOP/s", flush=True)
@@ -226,9 +227,9 @@ def main():
     abd, bbd = jnp.asarray(ab), jnp.asarray(bb)
     bflops = 2 * 64 * 512 ** 3
     tb = device_time_chained(
-        lambda v: _rms_normalize(mx._matmul(v, bbd)), abd, iters=64)
+        lambda v: _rms_normalize(mx._matmul_p(v, bbd)), abd, iters=64)
     tbf = device_time_chained(
-        lambda v: _rms_normalize(mx._matmul(v, bbd, fast=True)), abd,
+        lambda v: _rms_normalize(mx._matmul_p(v, bbd, precision="bf16")), abd,
         iters=64)
     print(f"[gemm batched 64x512^3 f32] {bflops / tb / 1e9:.0f} GFLOP/s | "
           f"[bf16 fast] {bflops / tbf / 1e9:.0f} GFLOP/s", flush=True)

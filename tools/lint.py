@@ -228,6 +228,85 @@ def fault_handler_errors(tree, fname) -> list:
     return errors
 
 
+# --- precision-literal rule -------------------------------------------------
+# The compensated-precision layer (veles/simd_tpu/runtime/precision.py,
+# the bf16_comp/int8 PR) is the ONE home of raw MXU-precision choices:
+# compute cores reach it through prx.HIGHEST / prx.p_einsum /
+# prx.p_matmul / prx.p_dot, so every contraction's precision is a
+# route the engine can select and the parity suites can budget.  This
+# rule keeps a stray literal from reappearing in ops//parallel: a
+# ``jax.lax.Precision`` reference (alias-tracked — ``import jax as
+# j``, ``from jax import lax as l``, ``from jax.lax import Precision
+# as P`` all count, like the jit/time rules) or a
+# ``preferred_element_type=`` keyword is a lint failure.
+# ops/pallas_kernels.py is exempt: Mosaic kernel bodies pin their own
+# accumulator dtype as part of the kernel contract, and the kernels'
+# precision knob is validated/converted in place.
+
+_PRECISION_RULE_EXEMPT = ("veles/simd_tpu/ops/pallas_kernels.py",)
+
+
+def precision_literal_errors(tree, fname) -> list:
+    """The rule body on a parsed module (separated so tests can feed
+    synthetic sources).  Returns human-readable error strings."""
+    errors = []
+    jax_aliases, lax_aliases, precision_names = set(), set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax":
+                    jax_aliases.add(a.asname or "jax")
+                elif a.name == "jax.lax":
+                    if a.asname:
+                        lax_aliases.add(a.asname)
+                    else:
+                        # bare `import jax.lax` binds the NAME jax —
+                        # jax.lax.Precision then matches the via-jax
+                        # attribute chain
+                        jax_aliases.add("jax")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "lax":
+                        lax_aliases.add(a.asname or a.name)
+            elif node.module == "jax.lax":
+                for a in node.names:
+                    if a.name == "Precision":
+                        precision_names.add(a.asname or a.name)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr == "Precision":
+            v = node.value
+            direct_lax = (isinstance(v, ast.Name)
+                          and v.id in lax_aliases)
+            via_jax = (isinstance(v, ast.Attribute) and v.attr == "lax"
+                       and isinstance(v.value, ast.Name)
+                       and v.value.id in jax_aliases)
+            if direct_lax or via_jax:
+                errors.append(
+                    f"{fname}:{node.lineno}: raw jax.lax.Precision "
+                    "literal in a compute module — precision is a "
+                    "routed decision; go through the precision layer "
+                    "(runtime/precision.py: prx.HIGHEST / "
+                    "prx.p_einsum)")
+        elif (isinstance(node, ast.Name)
+                and node.id in precision_names
+                and isinstance(node.ctx, ast.Load)):
+            errors.append(
+                f"{fname}:{node.lineno}: raw Precision literal "
+                "(imported from jax.lax) in a compute module — go "
+                "through the precision layer (runtime/precision.py)")
+        elif isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg == "preferred_element_type":
+                    errors.append(
+                        f"{fname}:{node.lineno}: raw "
+                        "preferred_element_type= in a compute module "
+                        "— accumulator dtype belongs to the precision "
+                        "layer (runtime/precision.py p_einsum/"
+                        "p_matmul/p_dot)")
+    return errors
+
+
 # --- routing-engine rule ----------------------------------------------------
 # PR 7 moved every hand-rolled route selector (convolve._use_pallas_os,
 # wavelet._use_pallas, spectral._use_matmul_dft, ...) into declarative
@@ -1170,6 +1249,10 @@ def compute_module_lint(files) -> int:
         for msg in routing_selector_errors(tree, str(f)):
             print(msg)
             failures += 1
+        if rel not in _PRECISION_RULE_EXEMPT:
+            for msg in precision_literal_errors(tree, str(f)):
+                print(msg)
+                failures += 1
         aliases = set()
         time_aliases = set()
         jax_aliases = set()

@@ -25,8 +25,19 @@ under the ``convolve2d`` family's geometry key with
 artifact.  XLA-direct wins (never observed) are printed but not
 emitted: auto-routing must never select the crash-prone im2col path.
 
+Since the bf16_comp PR the sweep carries a ``--precisions`` axis
+(default ``highest``): the direct-MXU im2col candidate is timed once
+per swept precision — XLA's knobs and the compensated
+``bf16_comp``/``bf16`` schemes (``runtime/precision.py`` ``p_conv``)
+— each accuracy-gated against the float64 oracle in its own table
+row.  Tune-cache entries are emitted from the ``highest`` round only:
+the 2D family's auto routes (pallas ``direct`` / ``fft``) carry no
+precision variants, so precision-keyed 2D entries would never be
+consulted.
+
 Run:  python tools/tune_conv2d.py [--quick]
           [--cache autotune_pack.json]
+          [--precisions highest,bf16_comp]
       VELES_SIMD_PLATFORM=cpu ... validates plumbing only — the
       crossover is an MXU-vs-FFT decision, measure on the real chip.
 """
@@ -59,6 +70,12 @@ def main():
              "pow2-buckets the batch (and image dims) into the tune "
              "class, so a pack serves every batch in a swept bucket "
              "— sweep the buckets production runs land in")
+    parser.add_argument(
+        "--precisions", default="highest",
+        help="comma-separated precisions the direct-MXU candidate is "
+             "timed at (XLA knobs and the precision-layer schemes, "
+             "e.g. highest,bf16_comp); each gets its own "
+             "accuracy-gated table row")
     args = parser.parse_args()
     maybe_override_platform()
 
@@ -66,8 +83,15 @@ def main():
     import jax.numpy as jnp
 
     from veles.simd_tpu.ops import convolve2d as cv2
+    from veles.simd_tpu.runtime import precision as prx
     from veles.simd_tpu.runtime import routing
     from veles.simd_tpu.utils.benchmark import device_time_chained
+
+    precisions = [p for p in args.precisions.split(",") if p.strip()]
+    for p in precisions:
+        if p not in prx.PRECISIONS:
+            parser.error(f"unknown precision {p!r} (choose from "
+                         f"{sorted(prx.PRECISIONS)})")
     from veles.simd_tpu.utils.memory import next_highest_power_of_2 as np2
 
     cache = routing.TuneCache(args.cache) if args.cache else None
@@ -95,8 +119,10 @@ def main():
 
     def run(kind, x, h):
         k0, k1 = h.shape
-        if kind == "direct":
-            return cv2._conv2d_direct(x, h)
+        if kind.startswith("direct"):
+            # "direct" or "direct@<precision>" (the --precisions axis)
+            _, _, p = kind.partition("@")
+            return cv2._conv2d_direct(x, h, precision=p or None)
         if kind == "pallas":
             return cv2._conv2d_direct_pallas(x, h)
         m0 = np2(x.shape[-2] + k0 - 1)
@@ -115,7 +141,8 @@ def main():
             h = jnp.asarray(h_np)
             want = cv2.convolve2d_na(x_np, h_np)  # f64 internally
             scale = np.max(np.abs(want))
-            cands = ["direct", "fft"]
+            cands = [("direct" if p == "highest" else f"direct@{p}")
+                     for p in precisions] + ["fft"]
             # CRASH GUARD (round-5 windows, thrice-observed): the XLA
             # im2col direct conv CRASHES the TPU worker ("kernel
             # fault") at large MAC volumes — measured crash cells
@@ -125,7 +152,8 @@ def main():
             # either above the measured safe volume.
             if (rows * (n0 + k0 - 1) * (n1 + k1 - 1) * k0 * k1
                     > 350_000_000):
-                cands.remove("direct")
+                cands = [c for c in cands
+                         if not c.startswith("direct")]
             if cv2._use_pallas_direct2d(x.shape, k0, k1):
                 cands.append("pallas")
             best = (float("inf"), None)

@@ -21,8 +21,17 @@ artifact; point ``--cache`` at the same file ``tools/autotune_pack.py``
 writes (default: ``$VELES_SIMD_AUTOTUNE_CACHE`` when set, else no
 emission).
 
+Since the bf16_comp PR the sweep carries a ``--precisions`` axis
+(default ``highest,high,bf16_comp``): every swept precision — XLA's
+f32-emulation knobs AND the compensated-precision routes
+(``runtime/precision.py``) — gets its own step table, its own
+accuracy gate against the per-precision error budget, and its own
+precision-keyed tune-cache entries, so a pre-warmed pack covers the
+``xla_matmul_bf16_comp`` route alongside the classic ones.
+
 Run:  python tools/tune_overlap_save.py [--quick] [--n 1048576]
           [--cache autotune_pack.json]
+          [--precisions highest,high,bf16_comp]
       VELES_SIMD_PLATFORM=cpu ... works but only validates plumbing —
       step size is an MXU tiling decision, so tune on the real chip.
 """
@@ -38,8 +47,17 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 from veles.simd_tpu.utils.platform import maybe_override_platform  # noqa: E402
 
 # steps whose rel. error exceeds this never become winners — matches the
-# TPU smoke gate for convolve (tools/tpu_smoke.py)
+# TPU smoke gate for convolve (tools/tpu_smoke.py).  Precisions with a
+# TIGHTER budget (runtime/precision.py ERROR_BUDGETS) gate at their
+# own bound via _err_gate(); looser ones (bf16/int8, forced-only)
+# still gate here.
 ERR_GATE = 1e-4
+
+
+def _err_gate(precision: str) -> float:
+    from veles.simd_tpu.runtime import precision as prx
+
+    return min(ERR_GATE, prx.ERROR_BUDGETS.get(precision, ERR_GATE))
 
 
 def main():
@@ -51,6 +69,12 @@ def main():
         default=os.environ.get("VELES_SIMD_AUTOTUNE_CACHE") or None,
         help="tune-cache file to emit route winners into (default: "
              "$VELES_SIMD_AUTOTUNE_CACHE; omit to print tables only)")
+    parser.add_argument(
+        "--precisions", default="highest,high,bf16_comp",
+        help="comma-separated precision sweep axis (XLA knobs "
+             "highest/high/default and the precision-layer routes "
+             "bf16_comp/bf16/int8); each emits precision-keyed "
+             "tune-cache entries")
     args = parser.parse_args()
     maybe_override_platform()
     quick = args.quick
@@ -60,6 +84,7 @@ def main():
     import jax.numpy as jnp
 
     from veles.simd_tpu.ops import convolve as cv
+    from veles.simd_tpu.runtime import precision as prx
     from veles.simd_tpu.runtime import routing
     from veles.simd_tpu.utils.benchmark import device_time_chained
 
@@ -72,7 +97,12 @@ def main():
 
     ks = (127, 2047) if quick else (127, 511, 2047, 8191)
     steps = (256, 512, 1024, 2048)
-    precisions = ("highest", "high")
+    precisions = tuple(p for p in args.precisions.split(",")
+                       if p.strip())
+    for p in precisions:
+        if p not in prx.PRECISIONS:
+            parser.error(f"unknown precision {p!r} (choose from "
+                         f"{sorted(prx.PRECISIONS)})")
     winners = {}
     for k in ks:
         h_np = rng.randn(k).astype(np.float32)
@@ -92,28 +122,34 @@ def main():
                     return v + 1e-30 * y[..., :n]
 
                 t = device_time_chained(stp, x, iters=64, repeats=2)
-                gated = " (fails accuracy gate)" if err > ERR_GATE else ""
+                gate = _err_gate(prec)
+                gated = " (fails accuracy gate)" if err > gate else ""
                 print(f"k={k:5d} prec={prec:8s} step={step:5d}: "
                       f"{t * 1e3:7.3f} ms  {n / t / 1e6:7.0f} Ms/s  "
                       f"rel_err={err:.1e}{gated}", flush=True)
-                if err <= ERR_GATE and t < best[0]:
+                if err <= gate and t < best[0]:
                     best = (t, step)
             winners[(k, prec)] = best[1]
             cur = cv.overlap_save_step(k)
             print(f"  -> k={k} {prec}: best step {best[1]} "
                   f"(overlap_save_step gives {cur})", flush=True)
 
-        # route-level sweep -> tune-cache entry: time the engine's
+        # route-level sweep -> tune-cache entries: time the engine's
         # convolve.os candidates at the engine's own step and store
-        # the accuracy-gated winner in the shared autotune format
+        # the accuracy-gated winner in the shared autotune format —
+        # one entry PER BASE PRECISION in the sweep (the tune class
+        # keys Config.conv_precision, so a conv_precision='high'
+        # service never consults a 'highest'-measured winner), with
+        # the xla_matmul_bf16_comp precision route riding every
+        # probe round it was swept in.
         if cache is None:
             continue
         step = cv.overlap_save_step(k)
-        timings_us = {}
 
-        def probe(run, want=want, scale=scale):
+        def probe(run, precision, want=want, scale=scale):
             got = np.asarray(run(x), np.float64)
-            if float(np.max(np.abs(got - want)) / scale) > ERR_GATE:
+            err = float(np.max(np.abs(got - want)) / scale)
+            if err > _err_gate(precision):
                 return None
 
             def stp(v):
@@ -125,32 +161,41 @@ def main():
             # min() comparison against it is False) nor a JSON token
             return t * 1e6 if np.isfinite(t) else None
 
-        timings_us["xla_matmul"] = probe(
-            lambda v: cv._conv_os_matmul(v, h, step,
-                                         precision="highest"))
-        if cv._use_pallas_os(k):
-            try:
-                timings_us["pallas_fused"] = probe(
-                    lambda v: cv._conv_os_pallas(v, h,
-                                                 precision="highest"))
-            except Exception as e:  # noqa: BLE001 — sweep explores
-                print(f"  pallas_fused probe failed: "
-                      f"{str(e)[:60]}", flush=True)
-                timings_us["pallas_fused"] = None
-        measured = {r: t for r, t in timings_us.items()
-                    if t is not None}
-        if measured:
+        base_precs = [p for p in precisions
+                      if p in prx.JAX_PRECISIONS] or ["highest"]
+        for base in base_precs:
+            timings_us = {}
+            timings_us["xla_matmul"] = probe(
+                lambda v, base=base: cv._conv_os_matmul(
+                    v, h, step, precision=base), base)
+            if "bf16_comp" in precisions:
+                timings_us["xla_matmul_bf16_comp"] = probe(
+                    lambda v: cv._conv_os_matmul(
+                        v, h, step, precision="bf16_comp"),
+                    "bf16_comp")
+            if cv._use_pallas_os(k):
+                try:
+                    timings_us["pallas_fused"] = probe(
+                        lambda v, base=base: cv._conv_os_pallas(
+                            v, h, precision=base), base)
+                except Exception as e:  # noqa: BLE001 — sweep explores
+                    print(f"  pallas_fused probe failed: "
+                          f"{str(e)[:60]}", flush=True)
+                    timings_us["pallas_fused"] = None
+            measured = {r: t for r, t in timings_us.items()
+                        if t is not None}
+            if not measured:
+                continue
             winner = min(measured, key=measured.get)
             # keys match dispatch exactly: rows=1 (the sweep times
             # single signals — batched classes need an online probe),
-            # x_length pow2-bucketed, and precision="highest" since
-            # the probes above pin it — a conv_precision='high'
-            # service never consults a 'highest'-measured winner
+            # x_length pow2-bucketed, precision = the base knob the
+            # dispatching service would resolve via os_precision()
             key = cache.store(
                 "convolve.os",
                 {"rows": 1, "x_length": routing.pow2_bucket(n),
                  "h_length": k, "step": step,
-                 "precision": "highest"},
+                 "precision": base},
                 winner, timings_us=timings_us, source="sweep")
             print(f"  -> cache entry {key} = {winner}", flush=True)
     print("winners:", winners)

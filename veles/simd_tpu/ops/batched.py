@@ -57,6 +57,7 @@ import numpy as np
 
 from veles.simd_tpu import obs
 from veles.simd_tpu.utils.config import on_tpu, resolve_simd
+from veles.simd_tpu.runtime import precision as prx
 
 __all__ = [
     "BatchedHandle", "batched_resample_poly", "batched_sosfilt",
@@ -391,13 +392,20 @@ def batched_stft(x, frame_length: int, hop: int, window=None,
     bins = frame_length // 2 + 1
     key = ("stft", rows, n, frame_length, hop, route)
 
+    rdft_routes = ("rdft_matmul", "rdft_matmul_bf16_comp")
+
     def build():
-        if route == "rdft_matmul":
+        if route in rdft_routes:
+            # a pack winner may be the bf16_comp precision variant
+            # (runtime/precision.py): same basis matmul, compensated
+            # contraction
+            p = ("bf16_comp" if route == "rdft_matmul_bf16_comp"
+                 else "highest")
+
             def run(xb, basis):
                 fr = sp._take_frames(xb, frame_length, hop)
-                out = jnp.einsum(
-                    "...fl,lb->...fb", fr, basis,
-                    precision=jax.lax.Precision.HIGHEST)
+                out = prx.p_einsum("...fl,lb->...fb", fr, basis,
+                                   precision=p)
                 return jax.lax.complex(out[..., :bins],
                                        out[..., bins:])
         else:
@@ -414,6 +422,6 @@ def batched_stft(x, frame_length: int, hop: int, window=None,
         operand = (sp._device_basis(
             "rdft_fwd", frame_length, window,
             lambda: sp._rdft_basis(frame_length, window))
-            if route == "rdft_matmul" else jnp.asarray(window))
+            if route in rdft_routes else jnp.asarray(window))
         out = handle(x2d, operand)
     return out.reshape(batch_shape + (frames, bins))

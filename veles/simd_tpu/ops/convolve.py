@@ -56,6 +56,7 @@ import numpy as np
 from veles.simd_tpu import obs
 from veles.simd_tpu.ops import pallas_kernels as _pk
 from veles.simd_tpu.runtime import faults, routing
+from veles.simd_tpu.runtime import precision as prx
 from veles.simd_tpu.utils.config import get_config, resolve_simd
 from veles.simd_tpu.utils.memory import (
     next_highest_power_of_2, zeropadding_length)
@@ -295,7 +296,7 @@ def _conv_direct(x, h, reverse=False):
     rhs = kernel.reshape((1, 1, k)).astype(jnp.float32)      # [O=1, I=1, W]
     out = jax.lax.conv_general_dilated(
         lhs, rhs, window_strides=(1,), padding=[(k - 1, k - 1)],
-        precision=jax.lax.Precision.HIGHEST)
+        precision=prx.HIGHEST)
     return out.reshape(batch_shape + (n + k - 1,))
 
 
@@ -358,6 +359,19 @@ _OS_FAMILY = routing.family("convolve.os", (
         "xla_matmul",
         roofline={"kind": "conv"},
         doc="MXU block matmul over gather-free shifted frames"),
+    # precision-variant candidates sit AFTER the terminal fallback:
+    # the static prior (autotune off) never changes, but the measured
+    # autotuner probes them like any candidate and a tune-cache winner
+    # steers dispatch — precision as just another route the engine
+    # selects and defends empirically (runtime/precision.py)
+    routing.Route(
+        "xla_matmul_bf16_comp",
+        predicate=lambda **_: prx.precision_allowed("bf16_comp"),
+        disable_env=prx.BF16_COMP_ENV,
+        roofline={"kind": "conv"},
+        doc="the block matmul at bf16_comp: split/compensated bf16 "
+            "accumulation, ~fp32 accuracy at 3 MXU passes instead of "
+            "highest's 6 (VELES_SIMD_DISABLE_BF16_COMP opts out)"),
 ))
 
 
@@ -381,8 +395,12 @@ def _conv_os_pallas(x, h, reverse=False, precision=None):
     ``PALLAS_OS_STEP`` — its redundancy/tiling trade-off differs from
     the XLA path's, see the constant's note)."""
     kernel = jnp.flip(h, axis=-1) if reverse else h
-    return _pk.overlap_save_pallas(x, kernel,
-                                   precision=precision or "highest")
+    # the Mosaic kernel contracts at XLA's own knobs only — a
+    # compensated-precision config falls back to "highest" here (the
+    # comp variant is the XLA block matmul's route, not the kernel's)
+    if precision not in prx.JAX_PRECISIONS:
+        precision = "highest"
+    return _pk.overlap_save_pallas(x, kernel, precision=precision)
 
 
 @functools.partial(obs.instrumented_jit, op="convolve",
@@ -449,9 +467,12 @@ def _conv_os_matmul(x, h, step, reverse=False, precision=None):
     MT = jnp.tile(w, s)[: s * (k + s)].reshape(s, k + s)[:, : s + k - 1]
     # public callers resolve Config.conv_precision via os_precision()
     # before the jit cache key forms (reading config here would bake a
-    # stale value); a direct call omitting precision gets plain "highest"
-    y = jnp.einsum("...ba,ta->...bt", frames, MT,
-                   precision=precision or "highest")
+    # stale value); a direct call omitting precision gets plain
+    # "highest".  The precision layer also serves the compensated
+    # names ("bf16_comp" — the xla_matmul_bf16_comp route — and
+    # forced "bf16"/"int8").
+    y = prx.p_einsum("...ba,ta->...bt", frames, MT,
+                     precision=precision or "highest")
     y = y.reshape(y.shape[:-2] + (n_blocks * s,))
     return y[..., :out_len].astype(jnp.float32)
 
@@ -668,21 +689,32 @@ def _run_xla(handle: ConvolutionHandle, x, h):
             "xla_matmul": lambda: _conv_os_matmul(
                 x, h, handle.step, reverse=handle.reverse,
                 precision=os_precision()),
+            "xla_matmul_bf16_comp": lambda: _conv_os_matmul(
+                x, h, handle.step, reverse=handle.reverse,
+                precision="bf16_comp"),
         }
 
-        def _os_matmul():
+        def _os_matmul(route="xla_matmul"):
+            # default route keeps this a valid zero-arg demotion
+            # fallback for the pallas path below
             obs.record_decision(
-                "convolve_os_route", "xla_matmul",
+                "convolve_os_route", route,
                 x_length=handle.x_length, h_length=handle.h_length,
                 step=handle.step)
-            with obs.span("convolve.os_route", route="xla_matmul"):
-                return runners["xla_matmul"]()
+            with obs.span("convolve.os_route", route=route):
+                return runners[route]()
 
         pallas_ok = ((_use_pallas_os(handle.h_length)
                       or faults.armed("convolve.os_pallas"))
                      and handle.h_length not in _PALLAS_OS_REJECTED)
         eligible = (["pallas_fused", "xla_matmul"] if pallas_ok
                     else ["xla_matmul"])
+        if _OS_FAMILY.gate("xla_matmul_bf16_comp",
+                           h_length=handle.h_length):
+            # the compensated-precision candidate: never the static
+            # prior (it sits after the terminal route), but the
+            # measured autotuner may crown it per geometry class
+            eligible.append("xla_matmul_bf16_comp")
         # rows/x_length are pow2-bucketed (finite tune classes under
         # batch/length churn; rows matters — the pallas-vs-matmul
         # crossover shifts with batch: per-row VMEM halo vs
@@ -726,7 +758,7 @@ def _run_xla(handle: ConvolutionHandle, x, h):
                 cache=_PALLAS_OS_REJECTED, key=handle.h_length,
                 route="pallas_fused", fallback_route="xla_matmul",
                 counter="pallas_os_demotion")
-        return _os_matmul()
+        return _os_matmul(chosen)
     return _conv_overlap_save(x, h, handle.block_length,
                               reverse=handle.reverse)
 

@@ -34,6 +34,7 @@ import numpy as np
 from veles.simd_tpu import obs
 from veles.simd_tpu.ops import pallas_kernels as _pk
 from veles.simd_tpu.runtime import faults, routing
+from veles.simd_tpu.runtime import precision as prx
 from veles.simd_tpu.utils.config import resolve_simd
 from veles.simd_tpu.utils.memory import next_highest_power_of_2
 
@@ -175,17 +176,18 @@ def _conv2d_direct_pallas(x, h, reverse=False):
 
 @functools.partial(obs.instrumented_jit, op="convolve2d",
                    route="direct_mxu",
-                   static_argnames=("reverse",))
-def _conv2d_direct(x, h, reverse=False):
+                   static_argnames=("reverse", "precision"))
+def _conv2d_direct(x, h, reverse=False, precision=None):
     n0, n1 = x.shape[-2:]
     k0, k1 = h.shape[-2:]
     kernel = h if reverse else jnp.flip(h, axis=(-2, -1))
     lhs = x.reshape((-1, 1, n0, n1)).astype(jnp.float32)
     rhs = kernel.reshape((1, 1, k0, k1)).astype(jnp.float32)
-    out = jax.lax.conv_general_dilated(
-        lhs, rhs, window_strides=(1, 1),
-        padding=[(k0 - 1, k0 - 1), (k1 - 1, k1 - 1)],
-        precision=jax.lax.Precision.HIGHEST)
+    # precision rides the layer (tools/tune_conv2d.py's --precisions
+    # axis forces it; auto dispatch stays at "highest")
+    out = prx.p_conv(
+        lhs, rhs, precision or "highest", window_strides=(1, 1),
+        padding=[(k0 - 1, k0 - 1), (k1 - 1, k1 - 1)])
     return out.reshape(x.shape[:-2] + (n0 + k0 - 1, n1 + k1 - 1))
 
 

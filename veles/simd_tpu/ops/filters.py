@@ -45,6 +45,7 @@ import numpy as np
 
 from veles.simd_tpu import obs
 from veles.simd_tpu.utils.config import resolve_simd
+from veles.simd_tpu.runtime import precision as prx
 
 __all__ = [
     "medfilt", "medfilt_na", "medfilt2d", "medfilt2d_na", "order_filter",
@@ -357,7 +358,7 @@ def savgol_filter(x, window_length: int, polyorder: int, deriv: int = 0,
         rhs = t[None, None, :]  # lax conv = correlation (no flip)
         out = jax.lax.conv_general_dilated(
             lhs, rhs, window_strides=(1,), padding="VALID",
-            precision=jax.lax.Precision.HIGHEST)
+            precision=prx.HIGHEST)
         out = out.reshape(xj.shape[:-1] + (n,))
         if mode == "interp":
             head, tail = _savgol_edge_fits(

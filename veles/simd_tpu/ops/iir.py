@@ -46,6 +46,7 @@ import numpy as np
 
 from veles.simd_tpu import obs
 from veles.simd_tpu.utils.config import resolve_simd
+from veles.simd_tpu.runtime import precision as prx
 
 __all__ = [
     "butterworth", "cheby1", "cheby2", "bessel", "ellip", "iirnotch",
@@ -891,7 +892,7 @@ def _affine_combine(e1, e2):
     """
     a1, b1 = e1
     a2, b2 = e2
-    hi = jax.lax.Precision.HIGHEST
+    hi = prx.HIGHEST
     return (jnp.einsum("...ij,...jk->...ik", a2, a1, precision=hi),
             jnp.einsum("...ij,...j->...i", a2, b1, precision=hi) + b2)
 

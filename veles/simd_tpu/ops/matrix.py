@@ -1,4 +1,4 @@
-"""BLAS L1/L2/L3 subset on the MXU.
+"""BLAS L1/L2/L3 subset on the MXU, with engine-selected precisions.
 
 TPU-native rebuild of ``/root/reference/inc/simd/matrix.h`` +
 ``/root/reference/src/matrix.c``.  The reference's AVX GEMM copies each B
@@ -19,25 +19,39 @@ the C API passed explicitly):
   swapped contracting dims, not a 10%-faster special case.
 * ``matrix_vector_multiply(m, v)`` — BLAS-L2 gemv (BASELINE.md config 3).
 
-Precision: f32 inputs contract with ``precision='highest'`` by default so the
-oracle cross-validation tolerance (``tests/matrix.cc:94-98`` ASSERT_NEAR 0.1)
-holds; pass ``fast=True`` to run bf16-in/f32-accumulate at full MXU rate.
+Precision is an engine-selected ROUTE (the ``matrix.gemm`` candidate
+table, :mod:`veles.simd_tpu.runtime.routing` +
+:mod:`veles.simd_tpu.runtime.precision`): the static prior is ``fp32``
+(``precision='highest'``, the oracle-parity contract —
+``tests/matrix.cc:94-98`` ASSERT_NEAR 0.1 holds with orders of
+magnitude to spare), and the measured autotuner may pick the
+``bf16_comp`` split/compensated route (3 bf16 MXU passes, ~5e-6 rel
+err — inside every oracle gate at half the 6-pass cost) or, when the
+operator opts in via ``VELES_SIMD_ENABLE_INT8``, the scaled ``int8``
+route.  ``precision=`` forces any route; the historical ``fast=True``
+flag is a deprecation shim for ``precision="bf16"`` (1-pass bf16 —
+full MXU rate, fails the oracle budget, forced-only) and every
+resolution is recorded as a ``matrix_precision_route`` decision event,
+so the last precision choice outside the engine is gone.
 """
 
 from __future__ import annotations
 
 import functools
+import warnings
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from veles.simd_tpu import obs
+from veles.simd_tpu.runtime import precision as prx
+from veles.simd_tpu.runtime import routing
 from veles.simd_tpu.utils.config import get_config, resolve_simd
 
 __all__ = [
     "matrix_add", "matrix_sub", "matrix_multiply",
     "matrix_multiply_transposed", "matrix_vector_multiply",
+    "GEMM_PRECISIONS",
 ]
 
 
@@ -51,28 +65,130 @@ def _sub(a, b):
     return a - b
 
 
-@functools.partial(obs.instrumented_jit, static_argnames=("fast",))
-def _matmul(a, b, fast=False):
-    if fast:
-        return jnp.matmul(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
-                          preferred_element_type=jnp.float32)
-    return jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
+@functools.partial(obs.instrumented_jit, op="matrix", route="gemm",
+                   static_argnames=("precision",))
+def _matmul_p(a, b, precision="highest"):
+    return prx.p_matmul(a, b, precision=precision)
 
 
-@functools.partial(obs.instrumented_jit, static_argnames=("fast",))
-def _matmul_t(a, bt, fast=False):
+@functools.partial(obs.instrumented_jit, op="matrix", route="gemm_t",
+                   static_argnames=("precision",))
+def _matmul_t_p(a, bt, precision="highest"):
     # batched "[..., h1, w] @ [..., h2, w]^T" — contract the last dims
-    if fast:
-        return jnp.einsum("...ij,...kj->...ik",
-                          a.astype(jnp.bfloat16), bt.astype(jnp.bfloat16),
-                          preferred_element_type=jnp.float32)
-    return jnp.einsum("...ij,...kj->...ik", a, bt,
-                      precision=jax.lax.Precision.HIGHEST)
+    return prx.p_einsum("...ij,...kj->...ik", a, bt,
+                        precision=precision)
 
 
-@obs.instrumented_jit
-def _matvec(m, v):
-    return jnp.dot(m, v, precision=jax.lax.Precision.HIGHEST)
+@functools.partial(obs.instrumented_jit, op="matrix", route="gemv",
+                   static_argnames=("precision",))
+def _matvec_p(m, v, precision="highest"):
+    return prx.p_dot(m, v, precision=precision)
+
+
+# ---- the precision candidate table ----------------------------------------
+# Route name -> the precision the cores contract at.  Table order IS
+# the static prior: fp32 first (oracle parity, the library contract),
+# the error-budget-gated bf16_comp and the opt-in int8 after it as
+# autotuner candidates, forced-only bf16 last (its predicate always
+# refuses — the fast= shim's target, never engine-selected because its
+# ~2.4e-3 rel err fails every oracle budget).
+GEMM_PRECISIONS = {
+    "fp32": "highest",
+    "bf16_comp": "bf16_comp",
+    "int8": "int8",
+    "bf16": "bf16",
+}
+
+_GEMM_FAMILY = routing.family("matrix.gemm", (
+    routing.Route(
+        "fp32",
+        roofline={"kind": "gemm"},
+        doc="precision='highest' (6-pass bf16 = full f32) — the "
+            "oracle-parity static prior"),
+    routing.Route(
+        "bf16_comp",
+        predicate=lambda **_: prx.precision_allowed("bf16_comp"),
+        disable_env=prx.BF16_COMP_ENV,
+        roofline={"kind": "gemm"},
+        doc="split/compensated bf16 (3 MXU passes, ~5e-6 rel err — "
+            "inside the 1e-4 budget at half the fp32 cost); "
+            "VELES_SIMD_DISABLE_BF16_COMP opts out"),
+    routing.Route(
+        "int8",
+        predicate=lambda **_: prx.precision_allowed("int8"),
+        roofline={"kind": "gemm"},
+        doc="dynamically scaled symmetric int8 (int32 accumulate, "
+            "~1.6e-2 rel err) — refused unless VELES_SIMD_ENABLE_INT8"),
+    routing.Route(
+        "bf16",
+        predicate=lambda **_: False,
+        roofline={"kind": "gemm"},
+        doc="plain 1-pass bf16 — forced-only (the fast=True shim): "
+            "fails every oracle error budget, never engine-selected"),
+))
+
+
+def _select_gemm_route(core, a, b, geom: dict) -> str:
+    """Engine-selected precision route for one GEMM-shaped dispatch:
+    static prior ``fp32``, tune-cache winner or measured probe under
+    ``VELES_SIMD_AUTOTUNE`` — exactly how the algorithm families pick
+    routes, with precision as the candidate axis."""
+    runners = lambda: {  # noqa: E731 — jit-thunk factory idiom
+        name: (lambda p=p: core(a, b, precision=p))
+        for name, p in GEMM_PRECISIONS.items()
+        if name == "fp32" or _GEMM_FAMILY.route_allowed(name, **geom)}
+    return _GEMM_FAMILY.select(runners=runners, probe_operand=a,
+                               **geom)
+
+
+def _resolve_precision_route(precision, fast: bool) -> str | None:
+    """The forced-route half of the shim: an explicit ``precision=``
+    names a route (or a raw precision string); ``fast=True`` is the
+    deprecated spelling of ``precision='bf16'``.  None = engine."""
+    if fast and precision is None:
+        # stacklevel 4: _resolve_precision_route <- _gemm_dispatch <-
+        # matrix_multiply[_transposed] <- the caller's line
+        warnings.warn(
+            "matrix_multiply(fast=True) is deprecated: pass "
+            "precision='bf16' (or let the engine pick — bf16_comp "
+            "recovers fp32-class accuracy at the fast rate)",
+            DeprecationWarning, stacklevel=4)
+        precision = "bf16"
+    if precision is None:
+        return None
+    if precision == "highest":
+        precision = "fp32"
+    if precision not in GEMM_PRECISIONS:
+        raise ValueError(
+            f"precision must be one of "
+            f"{sorted(GEMM_PRECISIONS) + ['highest']}, got "
+            f"{precision!r}")
+    return precision
+
+
+def _gemm_dispatch(core, a, b, geom: dict, precision, fast: bool):
+    """Shared route resolution + decision event + in-span dispatch for
+    the two GEMM entry points."""
+    forced = _resolve_precision_route(precision, fast)
+    chosen = forced if forced is not None \
+        else _select_gemm_route(core, a, b, geom)
+    obs.record_decision(
+        "matrix_precision_route", chosen, forced=forced is not None,
+        **geom)
+    with obs.span("matrix.dispatch", route=chosen):
+        return core(a, b, precision=GEMM_PRECISIONS[chosen])
+
+
+def _gemm_tune_class(a, b, t: int) -> dict:
+    """The ``matrix.gemm`` tune-cache geometry class: every dim
+    pow2-bucketed (shape churn shares finite classes), plus the
+    transposed-B flag — the crossovers shift with all three dims."""
+    rows = int(np.prod(a.shape[:-2])) if a.ndim > 2 else 1
+    return {"h1": routing.pow2_bucket(int(a.shape[-2])),
+            "w1": routing.pow2_bucket(int(a.shape[-1])),
+            "w2": routing.pow2_bucket(int(b.shape[-2] if t
+                                          else b.shape[-1])),
+            "rows": routing.pow2_bucket(rows), "t": int(t)}
 
 
 # ---- NumPy oracle twins (reference *_novec, src/matrix.c:37-80) ----------
@@ -124,9 +240,15 @@ def matrix_sub(m1, m2, simd=None):
     return matrix_sub_novec(m1, m2)
 
 
-def matrix_multiply(m1, m2, simd=None, fast=False):
+def matrix_multiply(m1, m2, simd=None, fast=False, precision=None):
     """``res[h1, w2] = m1[h1, w1] · m2[h2, w2]``, requires ``w1 == h2``
-    (``matrix.h:71`` precondition, asserted at ``src/matrix.c:257-261``)."""
+    (``matrix.h:71`` precondition, asserted at ``src/matrix.c:257-261``).
+
+    ``precision`` forces a route of the ``matrix.gemm`` table
+    (``fp32``/``bf16_comp``/``int8``/``bf16``); ``None`` lets the
+    engine pick (static prior ``fp32``; the measured autotuner may
+    select a faster in-budget precision per geometry class).
+    ``fast=True`` is a deprecation shim for ``precision="bf16"``."""
     m1 = jnp.asarray(m1) if resolve_simd(simd, op="matrix") else np.asarray(m1)
     m2 = jnp.asarray(m2) if resolve_simd(simd, op="matrix") else np.asarray(m2)
     _check_2d("matrix_multiply", m1, m2)
@@ -134,13 +256,17 @@ def matrix_multiply(m1, m2, simd=None, fast=False):
         raise ValueError(
             f"matrix_multiply: w1 ({m1.shape[-1]}) != h2 ({m2.shape[-2]})")
     if resolve_simd(simd, op="matrix"):
-        return _matmul(m1, m2, fast=fast)
+        return _gemm_dispatch(_matmul_p, m1, m2,
+                              _gemm_tune_class(m1, m2, t=0),
+                              precision, fast)
     return matrix_multiply_novec(m1, m2)
 
 
-def matrix_multiply_transposed(m1, m2t, simd=None, fast=False):
+def matrix_multiply_transposed(m1, m2t, simd=None, fast=False,
+                               precision=None):
     """``res[h1, h2] = m1[h1, w1] · m2t[h2, w2=w1]^T``, requires ``w1 == w2``
-    (``matrix.h:87`` precondition)."""
+    (``matrix.h:87`` precondition).  ``precision``/``fast`` as in
+    :func:`matrix_multiply`."""
     use = resolve_simd(simd, op="matrix")
     m1 = jnp.asarray(m1) if use else np.asarray(m1)
     m2t = jnp.asarray(m2t) if use else np.asarray(m2t)
@@ -150,12 +276,19 @@ def matrix_multiply_transposed(m1, m2t, simd=None, fast=False):
             f"matrix_multiply_transposed: w1 ({m1.shape[-1]}) != "
             f"w2 ({m2t.shape[-1]})")
     if resolve_simd(simd, op="matrix"):
-        return _matmul_t(m1, m2t, fast=fast)
+        return _gemm_dispatch(_matmul_t_p, m1, m2t,
+                              _gemm_tune_class(m1, m2t, t=1),
+                              precision, fast)
     return matrix_multiply_transposed_novec(m1, m2t)
 
 
-def matrix_vector_multiply(m, v, simd=None):
-    """BLAS-L2 gemv: ``res[h] = m[h, w] · v[w]``."""
+def matrix_vector_multiply(m, v, simd=None, precision=None):
+    """BLAS-L2 gemv: ``res[h] = m[h, w] · v[w]``.  ``precision``
+    forces a named precision (the gemv is bandwidth-bound, so it is
+    not autotuned — fp32 is the default; forcing rides the same
+    precision layer as the GEMM routes)."""
     if resolve_simd(simd, op="matrix"):
-        return _matvec(jnp.asarray(m), jnp.asarray(v))
+        route = _resolve_precision_route(precision, fast=False)
+        return _matvec_p(jnp.asarray(m), jnp.asarray(v),
+                         precision=GEMM_PRECISIONS[route or "fp32"])
     return matrix_vector_multiply_novec(m, v)

@@ -34,6 +34,7 @@ import numpy as np
 
 from veles.simd_tpu import obs
 from veles.simd_tpu.utils.config import on_tpu, resolve_simd
+from veles.simd_tpu.runtime import precision as prx
 
 __all__ = [
     "design_lowpass", "resample_poly", "resample_poly_na", "upfirdn",
@@ -142,7 +143,7 @@ def _resample_conv(x, taps, up, down, out_len, pad=None):
         lhs_dil = (up,)
     out = jax.lax.conv_general_dilated(
         lhs, rhs, window_strides=(down,), padding=[pad],
-        lhs_dilation=lhs_dil, precision=jax.lax.Precision.HIGHEST)
+        lhs_dilation=lhs_dil, precision=prx.HIGHEST)
     return out.reshape(x.shape[:-1] + (out.shape[-1],))[..., :out_len]
 
 

@@ -58,6 +58,7 @@ import numpy as np
 from veles.simd_tpu import obs
 from veles.simd_tpu.ops import pallas_kernels as _pk
 from veles.simd_tpu.runtime import faults, routing
+from veles.simd_tpu.runtime import precision as prx
 from veles.simd_tpu.utils.config import resolve_simd
 # complex host<->device moves MUST go through to_device/to_host: the
 # axon relay cannot transfer complex buffers in either direction and one
@@ -471,7 +472,7 @@ def _ct_stage(vre, vim, cos, sin, sign, axis_spec):
     ``cos + i * sign * sin`` basis along the axis named by
     ``axis_spec`` (an einsum triple).  ``vim=None`` means real input
     (stage 1 of a forward rfft: two matmuls instead of four)."""
-    hi = jax.lax.Precision.HIGHEST
+    hi = prx.HIGHEST
     e = functools.partial(jnp.einsum, axis_spec, precision=hi)
     if vim is None:
         return e(vre, cos), sign * e(vre, sin)
@@ -545,15 +546,29 @@ def _stft_xla(x, window, frame_length, hop):
     return jnp.fft.rfft(frames * window, axis=-1)
 
 
+def _stft_rdft_body(x, basis, frame_length, hop, precision):
+    """Shared traceable body of the rdft routes — the precision knob
+    is the ONLY difference between ``rdft_matmul`` and its
+    ``bf16_comp`` variant (runtime/precision.py)."""
+    frames = _take_frames(x, frame_length, hop)
+    out = prx.p_einsum("...fl,lb->...fb", frames, basis,
+                       precision=precision)
+    bins = frame_length // 2 + 1
+    return jax.lax.complex(out[..., :bins], out[..., bins:])
+
+
 @functools.partial(obs.instrumented_jit, op="stft",
                    route="rdft_matmul",
                    static_argnames=("frame_length", "hop"))
 def _stft_rdft(x, basis, frame_length, hop):
-    frames = _take_frames(x, frame_length, hop)
-    out = jnp.einsum("...fl,lb->...fb", frames, basis,
-                     precision=jax.lax.Precision.HIGHEST)
-    bins = frame_length // 2 + 1
-    return jax.lax.complex(out[..., :bins], out[..., bins:])
+    return _stft_rdft_body(x, basis, frame_length, hop, "highest")
+
+
+@functools.partial(obs.instrumented_jit, op="stft",
+                   route="rdft_matmul_bf16_comp",
+                   static_argnames=("frame_length", "hop"))
+def _stft_rdft_comp(x, basis, frame_length, hop):
+    return _stft_rdft_body(x, basis, frame_length, hop, "bf16_comp")
 
 
 # (frame_length, hop) classes whose fused-STFT compile OOMed Mosaic's
@@ -603,6 +618,22 @@ _STFT_FAMILY = routing.family("stft", (
         "xla_fft",
         roofline={"kind": "stft"},
         doc="XLA FFT lowering — the long-frame terminal fallback"),
+    # precision-variant candidate AFTER the terminal fallback: the
+    # static prior never changes, the measured autotuner probes it
+    # like any candidate and a tune-cache winner steers dispatch
+    # (runtime/precision.py; the same pattern across every
+    # matmul-heavy family)
+    routing.Route(
+        "rdft_matmul_bf16_comp",
+        predicate=lambda frame_length, **_: (
+            frame_length <= AUTO_DFT_MATMUL_MAX_FRAME
+            and dft_matmul_allowed()
+            and prx.precision_allowed("bf16_comp")),
+        disable_env=prx.BF16_COMP_ENV,
+        roofline={"kind": "stft"},
+        doc="the basis matmul at bf16_comp: split/compensated bf16 "
+            "accumulation, ~fp32 accuracy at 3 MXU passes "
+            "(VELES_SIMD_DISABLE_BF16_COMP opts out)"),
 ))
 
 _ISTFT_FAMILY = routing.family("istft", (
@@ -613,6 +644,15 @@ _ISTFT_FAMILY = routing.family("istft", (
         disable_env=_DFT_MATMUL_ENV,
         doc="inverse-basis matmul feeding the shared overlap-add"),
     routing.Route("xla_fft", doc="XLA irfft + overlap-add"),
+    routing.Route(
+        "rdft_matmul_bf16_comp",
+        predicate=lambda frame_length, **_: (
+            frame_length <= AUTO_DFT_MATMUL_MAX_FRAME
+            and dft_matmul_allowed()
+            and prx.precision_allowed("bf16_comp")),
+        disable_env=prx.BF16_COMP_ENV,
+        doc="inverse-basis matmul at bf16_comp (split/compensated "
+            "accumulation)"),
 ))
 
 _HILBERT_FAMILY = routing.family("hilbert", (
@@ -623,6 +663,14 @@ _HILBERT_FAMILY = routing.family("hilbert", (
         doc="dense circulant analytic-signal operator as two MXU "
             "matmuls (no complex transfers through the relay)"),
     routing.Route("xla_fft", doc="fft -> multiplier -> ifft"),
+    routing.Route(
+        "matmul_dft_bf16_comp",
+        predicate=lambda n, **_: (
+            n <= HILBERT_MATMUL_MAX_N and dft_matmul_allowed()
+            and prx.precision_allowed("bf16_comp")),
+        disable_env=prx.BF16_COMP_ENV,
+        doc="the circulant operator at bf16_comp (split/compensated "
+            "accumulation)"),
 ))
 
 _CWT_FAMILY = routing.family("morlet_cwt", (
@@ -641,6 +689,14 @@ _CWT_FAMILY = routing.family("morlet_cwt", (
             "single-chip form, for transform sizes past the dense "
             "basis-residency cutoff"),
     routing.Route("xla_fft", doc="batched fft -> bank -> ifft"),
+    routing.Route(
+        "matmul_dft_bf16_comp",
+        predicate=lambda n, **_: (
+            n <= CWT_MATMUL_MAX_N and dft_matmul_allowed()
+            and prx.precision_allowed("bf16_comp")),
+        disable_env=prx.BF16_COMP_ENV,
+        doc="the positive-frequency basis pair at bf16_comp "
+            "(split/compensated accumulation)"),
 ))
 
 
@@ -729,6 +785,14 @@ def _run_stft_rdft(x, window, frame_length, hop, forced=False):
                       frame_length, hop)
 
 
+def _run_stft_rdft_comp(x, window, frame_length, hop, forced=False):
+    del forced
+    basis = _device_basis("rdft_fwd", frame_length, window,
+                          lambda: _rdft_basis(frame_length, window))
+    return _stft_rdft_comp(jnp.asarray(x, jnp.float32), basis,
+                           frame_length, hop)
+
+
 def _stft_pallas_basis(frame_length, hop, window):
     window = np.asarray(window, np.float32)
     key = ("stft_pallas", int(frame_length), int(hop), window.tobytes())
@@ -769,6 +833,7 @@ def _run_stft_pallas(x, window, frame_length, hop, forced=False):
 
 _STFT_ROUTES = {"xla_fft": _run_stft_xla,
                 "rdft_matmul": _run_stft_rdft,
+                "rdft_matmul_bf16_comp": _run_stft_rdft_comp,
                 "pallas_fused": _run_stft_pallas}
 
 
@@ -814,11 +879,13 @@ def stft_stream_step(x_ext, frame_length: int, hop: int, window,
     (carry + new chunk) -> complex64 ``[..., block/hop, L//2 + 1]``.
     Runs the same ``obs.instrumented_jit`` route cores one-shot
     :func:`stft` dispatches, so it inlines into a fused outer jit."""
-    if route == "rdft_matmul":
+    if route in ("rdft_matmul", "rdft_matmul_bf16_comp"):
         basis = _device_basis(
             "rdft_fwd", frame_length, window,
             lambda: _rdft_basis(frame_length, window))
-        return _stft_rdft(x_ext, basis, frame_length, hop)
+        core = (_stft_rdft_comp if route == "rdft_matmul_bf16_comp"
+                else _stft_rdft)
+        return core(x_ext, basis, frame_length, hop)
     return _stft_xla(x_ext, jnp.asarray(window, jnp.float32),
                      frame_length, hop)
 
@@ -976,14 +1043,28 @@ def _istft_xla(spec, window, env_inv, n, frame_length, hop):
     return _overlap_add(frames, n, frame_length, hop) * env_inv
 
 
+def _istft_rdft_body(spec, inv_basis, env_inv, n, frame_length, hop,
+                     precision):
+    parts = jnp.concatenate([jnp.real(spec), jnp.imag(spec)], axis=-1)
+    frames = prx.p_einsum("...fb,bl->...fl", parts, inv_basis,
+                          precision=precision)
+    return _overlap_add(frames, n, frame_length, hop) * env_inv
+
+
 @functools.partial(obs.instrumented_jit, op="istft",
                    route="rdft_matmul",
                    static_argnames=("n", "frame_length", "hop"))
 def _istft_rdft(spec, inv_basis, env_inv, n, frame_length, hop):
-    parts = jnp.concatenate([jnp.real(spec), jnp.imag(spec)], axis=-1)
-    frames = jnp.einsum("...fb,bl->...fl", parts, inv_basis,
-                        precision=jax.lax.Precision.HIGHEST)
-    return _overlap_add(frames, n, frame_length, hop) * env_inv
+    return _istft_rdft_body(spec, inv_basis, env_inv, n,
+                            frame_length, hop, "highest")
+
+
+@functools.partial(obs.instrumented_jit, op="istft",
+                   route="rdft_matmul_bf16_comp",
+                   static_argnames=("n", "frame_length", "hop"))
+def _istft_rdft_comp(spec, inv_basis, env_inv, n, frame_length, hop):
+    return _istft_rdft_body(spec, inv_basis, env_inv, n,
+                            frame_length, hop, "bf16_comp")
 
 
 def _run_istft_xla(spec, window, env_inv, n, frame_length, hop,
@@ -1005,8 +1086,20 @@ def _run_istft_rdft(spec, window, env_inv, n, frame_length, hop,
                        n, frame_length, hop)
 
 
+def _run_istft_rdft_comp(spec, window, env_inv, n, frame_length, hop,
+                         forced=False):
+    del forced
+    inv_basis = _device_basis(
+        "rdft_inv", frame_length, window,
+        lambda: _rdft_inv_basis(frame_length, window))
+    return _istft_rdft_comp(to_device(spec, jnp.complex64),
+                            inv_basis, jnp.asarray(env_inv),
+                            n, frame_length, hop)
+
+
 _ISTFT_ROUTES = {"xla_fft": _run_istft_xla,
-                 "rdft_matmul": _run_istft_rdft}
+                 "rdft_matmul": _run_istft_rdft,
+                 "rdft_matmul_bf16_comp": _run_istft_rdft_comp}
 
 
 def istft(spec, n: int, frame_length: int, hop: int, window=None,
@@ -1152,14 +1245,24 @@ def _hilbert_xla(x, mult):
     return jnp.fft.ifft(jnp.fft.fft(x, axis=-1) * mult, axis=-1)
 
 
+def _hilbert_matmul_body(x, basis, precision):
+    re = prx.p_einsum("...n,nm->...m", x, basis[0],
+                      precision=precision)
+    im = prx.p_einsum("...n,nm->...m", x, basis[1],
+                      precision=precision)
+    return jax.lax.complex(re, im)
+
+
 @functools.partial(obs.instrumented_jit, op="hilbert",
                    route="matmul_dft")
 def _hilbert_matmul(x, basis):
-    re = jnp.einsum("...n,nm->...m", x, basis[0],
-                    precision=jax.lax.Precision.HIGHEST)
-    im = jnp.einsum("...n,nm->...m", x, basis[1],
-                    precision=jax.lax.Precision.HIGHEST)
-    return jax.lax.complex(re, im)
+    return _hilbert_matmul_body(x, basis, "highest")
+
+
+@functools.partial(obs.instrumented_jit, op="hilbert",
+                   route="matmul_dft_bf16_comp")
+def _hilbert_matmul_comp(x, basis):
+    return _hilbert_matmul_body(x, basis, "bf16_comp")
 
 
 def _run_hilbert_matmul(x):
@@ -1168,6 +1271,14 @@ def _run_hilbert_matmul(x):
         ("hilbert_matmul", int(n)),
         lambda: jnp.asarray(_hilbert_basis(n)))
     return _hilbert_matmul(jnp.asarray(x, jnp.float32), basis)
+
+
+def _run_hilbert_matmul_comp(x):
+    n = np.shape(x)[-1]
+    basis = _cached_device(
+        ("hilbert_matmul", int(n)),
+        lambda: jnp.asarray(_hilbert_basis(n)))
+    return _hilbert_matmul_comp(jnp.asarray(x, jnp.float32), basis)
 
 
 def _run_hilbert_xla(x):
@@ -1179,6 +1290,7 @@ def _run_hilbert_xla(x):
 
 
 _HILBERT_ROUTES = {"matmul_dft": _run_hilbert_matmul,
+                   "matmul_dft_bf16_comp": _run_hilbert_matmul_comp,
                    "xla_fft": _run_hilbert_xla}
 
 
@@ -1198,8 +1310,8 @@ def hilbert(x, simd=None, route=None):
         forced = route is not None
         if forced and route not in _HILBERT_ROUTES:
             raise ValueError(
-                f"route must be 'matmul_dft' or 'xla_fft', got "
-                f"{route!r}")
+                f"route must be one of {sorted(_HILBERT_ROUTES)}, "
+                f"got {route!r}")
         if forced:
             chosen = route
         else:
@@ -1290,30 +1402,48 @@ def _cwt_xla(x, hat):
     return jnp.fft.ifft(spec[..., None, :] * hat, axis=-1)
 
 
-@functools.partial(obs.instrumented_jit, op="morlet_cwt",
-                   route="matmul_dft")
-def _cwt_matmul(x, fwd, hat, ic, is_):
-    hi = jax.lax.Precision.HIGHEST
+def _cwt_matmul_body(x, fwd, hat, ic, is_, precision):
+    e = functools.partial(prx.p_einsum, precision=precision)
     K = hat.shape[-1]
-    xf = jnp.einsum("...n,nk->...k", x, fwd, precision=hi)
+    xf = e("...n,nk->...k", x, fwd)
     a = xf[..., None, :K] * hat          # [..., S, K] Re X * hat
     b = xf[..., None, K:] * hat          # [..., S, K] Im X * hat
-    out_re = (jnp.einsum("...sk,km->...sm", a, ic, precision=hi)
-              - jnp.einsum("...sk,km->...sm", b, is_, precision=hi))
-    out_im = (jnp.einsum("...sk,km->...sm", a, is_, precision=hi)
-              + jnp.einsum("...sk,km->...sm", b, ic, precision=hi))
+    out_re = (e("...sk,km->...sm", a, ic)
+              - e("...sk,km->...sm", b, is_))
+    out_im = (e("...sk,km->...sm", a, is_)
+              + e("...sk,km->...sm", b, ic))
     return jax.lax.complex(out_re, out_im)
 
 
-def _run_cwt_matmul(x, hat):
+@functools.partial(obs.instrumented_jit, op="morlet_cwt",
+                   route="matmul_dft")
+def _cwt_matmul(x, fwd, hat, ic, is_):
+    return _cwt_matmul_body(x, fwd, hat, ic, is_, "highest")
+
+
+@functools.partial(obs.instrumented_jit, op="morlet_cwt",
+                   route="matmul_dft_bf16_comp")
+def _cwt_matmul_comp(x, fwd, hat, ic, is_):
+    return _cwt_matmul_body(x, fwd, hat, ic, is_, "bf16_comp")
+
+
+def _cwt_matmul_operands(x, hat):
     n = np.shape(x)[-1]
     fwd, ic, is_ = _cached_device(
         ("cwt_matmul", int(n)),
         lambda: tuple(jnp.asarray(a) for a in _cwt_basis(n)))
     K = ic.shape[0]
     hatp = np.ascontiguousarray(hat[:, 1:1 + K]).astype(np.float32)
-    return _cwt_matmul(jnp.asarray(x, jnp.float32), fwd,
-                       jnp.asarray(hatp), ic, is_)
+    return (jnp.asarray(x, jnp.float32), fwd, jnp.asarray(hatp), ic,
+            is_)
+
+
+def _run_cwt_matmul(x, hat):
+    return _cwt_matmul(*_cwt_matmul_operands(x, hat))
+
+
+def _run_cwt_matmul_comp(x, hat):
+    return _cwt_matmul_comp(*_cwt_matmul_operands(x, hat))
 
 
 def _run_cwt_xla(x, hat):
@@ -1343,6 +1473,7 @@ def _run_cwt_ct(x, hat):
 
 
 _CWT_ROUTES = {"matmul_dft": _run_cwt_matmul,
+               "matmul_dft_bf16_comp": _run_cwt_matmul_comp,
                "ct_matmul": _run_cwt_ct,
                "xla_fft": _run_cwt_xla}
 
@@ -1442,9 +1573,9 @@ def detrend(x, type: str = "linear", simd=None,  # noqa: A002
         pinva = jnp.asarray(np.linalg.pinv(a), jnp.float32)   # [2, n]
         aj = jnp.asarray(a, jnp.float32)                       # [n, 2]
         coef = jnp.einsum("cn,...n->...c", pinva, xj,
-                          precision=jax.lax.Precision.HIGHEST)
+                          precision=prx.HIGHEST)
         return xj - jnp.einsum("nc,...c->...n", aj, coef,
-                               precision=jax.lax.Precision.HIGHEST)
+                               precision=prx.HIGHEST)
     return detrend_na(x, type).astype(np.float32)
 
 

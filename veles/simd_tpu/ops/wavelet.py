@@ -71,6 +71,7 @@ from veles.simd_tpu.ops.wavelet_coeffs import (
     WaveletType, qmf_highpass, scaling_coefficients, supported_orders,
     validate_order)
 from veles.simd_tpu.runtime import routing
+from veles.simd_tpu.runtime import precision as prx
 from veles.simd_tpu.utils.config import resolve_simd
 
 __all__ = [
@@ -164,7 +165,7 @@ def _filter_bank(x, hi, lo, ext, stride, dilation, out_len):
     rhs = jnp.stack([hi, lo]).reshape((2, 1, order))        # [O=2, I=1, W]
     out = jax.lax.conv_general_dilated(
         lhs, rhs, window_strides=(stride,), padding="VALID",
-        rhs_dilation=(dilation,), precision=jax.lax.Precision.HIGHEST)
+        rhs_dilation=(dilation,), precision=prx.HIGHEST)
     out = out[..., :out_len]                                # [N, 2, out_len]
     out = out.reshape(batch_shape + (2, out_len))
     return out[..., 0, :], out[..., 1, :]
@@ -643,7 +644,7 @@ def _synth_conv(hi_band, lo_band, fh, fl, lhs_dil, rhs_dil, out_len, xp):
             lhs.astype(jnp.float32), rhs.astype(jnp.float32),
             window_strides=(1,), padding=[(pad, pad)],
             lhs_dilation=(lhs_dil,), rhs_dilation=(rhs_dil,),
-            precision=jax.lax.Precision.HIGHEST)[:, 0]
+            precision=prx.HIGHEST)[:, 0]
     out = y[:, :out_len]
     if xp is np:
         out = out.copy()

@@ -62,6 +62,7 @@ from jax.sharding import PartitionSpec as P
 from veles.simd_tpu import obs
 from veles.simd_tpu.ops import spectral as sp
 from veles.simd_tpu.runtime import faults, routing
+from veles.simd_tpu.runtime import precision as prx
 from veles.simd_tpu.utils.benchmark import (
     a2a_ici_bytes, ct_dft_flops, ici_bw_gbps, mxu_f32_bound_tflops,
     rfft_flops, xla_fft_eff_gflops)
@@ -91,18 +92,38 @@ def _instrumented(op: str, run_fn):
 # the mesh-aware cost model + candidate table
 # ---------------------------------------------------------------------------
 
-def _modeled_costs(n, n1, n2, rows, n_shards):
+# per-precision all_to_all payload bytes per complex sample — the
+# ICI half of the cost model, precision-parameterized so a precision
+# route's transfer term is ITS OWN, not f32's.  "highest"/"high" AND
+# "bf16_comp" ship the stacked f32 (re, im) pair = 8 B/sample: a
+# naive single-bf16 payload would halve that to 4 B/sample, but its
+# 2^-9 per-element rounding lands ~1.8e-3 on the max-normalized
+# oracle metric (measured: a 64x64 CT with the inter-stage payload
+# rounded to bf16, stages exact) and FAILS the 1e-4 bf16_comp budget
+# — and a split bf16 (hi, lo) pair per part costs the same 8 B/sample
+# as f32 while adding split/recombine work and ~2^-16 rounding, so
+# the comp route ships the f32 parts untouched and banks its win on
+# the 3-vs-6-pass matmul stages.  "bf16" (forced-only, looser budget)
+# is the halved-payload variant.
+A2A_PAYLOAD_BYTES = {"highest": 8, "high": 8, "bf16_comp": 8,
+                     "bf16": 4}
+
+
+def _modeled_costs(n, n1, n2, rows, n_shards, precision="highest"):
     """``(t_matmul_s, t_local_fft_s, bytes_per_a2a)`` — the static
-    prior's two sides.  The matmul side is per-device MXU time for its
-    share of the two dense stages PLUS the per-device ICI time of the
-    two ``all_to_all`` transposes (complex payload, 8 B/sample); the
-    FFT side is the whole transform on one chip at the measured
-    effective FFT throughput.  The autotuner refines this by timing
-    the real dispatch — this model only has to be right about the
-    regime, not the margin."""
-    bytes_a2a = a2a_ici_bytes(int(rows) * int(n), 8, n_shards)
+    prior's two sides, at a named matmul precision.  The matmul side
+    is per-device MXU time for its share of the two dense stages (the
+    MXU bound at ``precision`` — 3-pass for ``bf16_comp``, 6-pass for
+    ``highest``) PLUS the per-device ICI time of the two
+    ``all_to_all`` transposes at that precision's payload width
+    (:data:`A2A_PAYLOAD_BYTES`); the FFT side is the whole transform
+    on one chip at the measured effective FFT throughput.  The
+    autotuner refines this by timing the real dispatch — this model
+    only has to be right about the regime, not the margin."""
+    bytes_a2a = a2a_ici_bytes(int(rows) * int(n),
+                              A2A_PAYLOAD_BYTES[precision], n_shards)
     t_mm = (ct_dft_flops(n, n1, n2) * rows / max(1, n_shards)
-            / (mxu_f32_bound_tflops() * 1e12)
+            / (mxu_f32_bound_tflops(precision) * 1e12)
             + 2.0 * (bytes_a2a / max(1, n_shards))
             / (ici_bw_gbps() * 1e9))
     t_fft = rfft_flops(n) * rows / (xla_fft_eff_gflops() * 1e9)
@@ -117,6 +138,20 @@ def _matmul_dft_viable(n, n_shards, rows=1, n1=0, n2=0, **_):
     if not n1 or not n2 or n_shards < 2 or n < SHARDED_DFT_MIN_N:
         return False
     t_mm, t_fft, _ = _modeled_costs(n, n1, n2, rows, n_shards)
+    return t_mm < t_fft
+
+
+def _matmul_dft_comp_viable(n, n_shards, rows=1, n1=0, n2=0, **_):
+    """The ``sharded_matmul_dft_bf16_comp`` gate: the factorized
+    pipeline must be structurally available AND the compensated
+    precision allowed; viability reuses the cost model at the comp
+    route's own bound and payload width."""
+    if not prx.precision_allowed("bf16_comp"):
+        return False
+    if not n1 or not n2 or n_shards < 2 or n < SHARDED_DFT_MIN_N:
+        return False
+    t_mm, t_fft, _ = _modeled_costs(n, n1, n2, rows, n_shards,
+                                    precision="bf16_comp")
     return t_mm < t_fft
 
 
@@ -136,6 +171,20 @@ _FOURIER_FAMILY = routing.family("parallel.fourier", (
         doc="single-chip jnp.fft on the gathered operand — the "
             "terminal fallback when the mesh or the size cannot pay "
             "for the transposes"),
+    # precision-variant candidate AFTER the terminal fallback (the
+    # cross-family convention, runtime/precision.py): never the
+    # static prior, probed and crowned per geometry by the measured
+    # autotuner
+    routing.Route(
+        "sharded_matmul_dft_bf16_comp",
+        predicate=_matmul_dft_comp_viable,
+        disable_env=prx.BF16_COMP_ENV,
+        roofline={"kind": "dft_matmul"},
+        doc="the factorized pipeline with bf16_comp stage matmuls "
+            "(split/compensated accumulation, 3 MXU passes) over the "
+            "exact f32 all_to_all payload — a lossy bf16 payload "
+            "fails the 1e-4 budget (see A2A_PAYLOAD_BYTES), so the "
+            "~2x win lives in the stages, not the wire"),
 ))
 
 
@@ -207,11 +256,15 @@ obs.register_cache("fourier_program_lru", lambda: {
 
 
 def _ct_program(op, mesh, axis, nd, real_in, complex_out, sign,
-                scale):
+                scale, precision="highest"):
     """The cached instrumented ``shard_map`` program for one CT
     dispatch class (factor sizes flow in through the operand shapes,
-    so jit handles per-shape specialization under one wrapper)."""
-    key = (op, mesh, axis, nd, real_in, complex_out, sign, scale)
+    so jit handles per-shape specialization under one wrapper).
+    ``precision`` keys the class: the bf16_comp program contracts and
+    ships different operands, so it must never share an executable
+    with the f32 one."""
+    key = (op, mesh, axis, nd, real_in, complex_out, sign, scale,
+           precision)
     with _program_lock:
         prog = _program_cache.get(key)
         if prog is not None:
@@ -220,7 +273,7 @@ def _ct_program(op, mesh, axis, nd, real_in, complex_out, sign,
             return prog
         _program_stats["misses"] += 1
     built = _build_ct_program(op, mesh, axis, nd, real_in,
-                              complex_out, sign, scale)
+                              complex_out, sign, scale, precision)
     with _program_lock:
         prog = _program_cache.setdefault(key, built)
         _program_cache.move_to_end(key)
@@ -231,18 +284,29 @@ def _ct_program(op, mesh, axis, nd, real_in, complex_out, sign,
 
 
 def _build_ct_program(op, mesh, axis, nd, real_in, complex_out, sign,
-                      scale):
+                      scale, precision="highest"):
     lead = [None] * (nd - 2)
     spec_v = P(*(lead + [None, axis]))
     spec_tw = P(None, axis)
     spec_out = P(*(lead + [axis]))
-    hi = jax.lax.Precision.HIGHEST
     sgn = np.float32(sign)
     scl = np.float32(scale) if scale is not None else None
 
     in_specs = ((spec_v,) if real_in else (spec_v, spec_v)) + \
         (P(), P(), P(), P(), spec_tw, spec_tw)
     out_specs = spec_out
+
+    def _a2a(parts, split_axis_off, concat_axis_off):
+        """ONE tiled collective over the stacked real parts — f32 at
+        EVERY precision: the comp route's win lives in the matmul
+        stages, not the wire (a lossy bf16 payload fails the 1e-4
+        budget and a split pair costs the same bytes as f32 —
+        A2A_PAYLOAD_BYTES)."""
+        st = jnp.stack(parts)
+        st = jax.lax.all_to_all(
+            st, axis, split_axis=st.ndim - split_axis_off,
+            concat_axis=st.ndim - concat_axis_off, tiled=True)
+        return tuple(st[i] for i in range(len(parts)))
 
     @functools.partial(shard_map, mesh=mesh, in_specs=in_specs,
                        out_specs=out_specs)
@@ -252,10 +316,10 @@ def _build_ct_program(op, mesh, axis, nd, real_in, complex_out, sign,
             xim = None
         else:
             xre, xim, b_ca, b_sa, b_cb, b_sb, twc_l, tws_l = args
-        e1 = functools.partial(jnp.einsum, "...gf,gh->...hf",
-                               precision=hi)
-        e2 = functools.partial(jnp.einsum, "...hf,fk->...hk",
-                               precision=hi)
+        e1 = functools.partial(prx.p_einsum, "...gf,gh->...hf",
+                               precision=precision)
+        e2 = functools.partial(prx.p_einsum, "...hf,fk->...hk",
+                               precision=precision)
         # stage 1: length-ga DFT on complete local columns (MXU)
         if xim is None:
             yre, yim = e1(xre, b_ca), sgn * e1(xre, b_sa)
@@ -267,20 +331,16 @@ def _build_ct_program(op, mesh, axis, nd, real_in, complex_out, sign,
         zre = yre * twc_l - yim * tim
         zim = yre * tim + yim * twc_l
         # all_to_all transpose #1: ga-split so stage 2 sees complete
-        # rows; stacked real pair = ONE collective, no complex payload
-        st = jnp.stack([zre, zim])
-        st = jax.lax.all_to_all(st, axis, split_axis=st.ndim - 2,
-                                concat_axis=st.ndim - 1, tiled=True)
-        zre, zim = st[0], st[1]
+        # rows; stacked real parts = ONE collective, no complex payload
+        zre, zim = _a2a((zre, zim), split_axis_off=2,
+                        concat_axis_off=1)
         # stage 2: length-gb DFT along the now-complete last axis
         wre = e2(zre, b_cb) - sgn * e2(zim, b_sb)
         wim = sgn * e2(zre, b_sb) + e2(zim, b_cb)
         # all_to_all transpose #2: back to natural contiguous
         # sharding of k = k_b * ga + g_a
-        st = jnp.stack([wre, wim])
-        st = jax.lax.all_to_all(st, axis, split_axis=st.ndim - 1,
-                                concat_axis=st.ndim - 2, tiled=True)
-        wre, wim = st[0], st[1]
+        wre, wim = _a2a((wre, wim), split_axis_off=1,
+                        concat_axis_off=2)
         wre = jnp.swapaxes(wre, -1, -2)
         wre = wre.reshape(wre.shape[:-2] + (-1,))
         if scl is not None:
@@ -297,7 +357,7 @@ def _build_ct_program(op, mesh, axis, nd, real_in, complex_out, sign,
 
 
 def _ct_sharded(op, vre, vim, mesh, axis, ga, gb, sign, scale,
-                out_kind):
+                out_kind, precision="highest"):
     """Dispatch one factorized transform: ``v`` viewed ``[..., ga,
     gb]`` with ``gb`` sharded over ``mesh[axis]``; stage 1 is the
     length-``ga`` DFT on complete local columns, stage 2 the
@@ -325,7 +385,8 @@ def _ct_sharded(op, vre, vim, mesh, axis, ga, gb, sign, scale,
     real_in = vim is None
     run = _ct_program(op, mesh, axis, vre.ndim, real_in,
                       out_kind == "complex", float(sign),
-                      None if scale is None else float(scale))
+                      None if scale is None else float(scale),
+                      precision)
     args = (vre,) if real_in else (vre, vim)
     return run(*args, ca, sa, cb, sb, twc_g, tws_g)
 
@@ -352,15 +413,21 @@ def _irfft_local_core(re, im, n):
     return jnp.fft.irfft(jax.lax.complex(re, im), n, axis=-1)
 
 
-def _run_rfft_matmul(x, mesh, axis, n1, n2, forced=False):
+def _run_rfft_matmul(x, mesh, axis, n1, n2, forced=False,
+                     precision="highest"):
     del forced
     n = n1 * n2
     vre, _ = _split_complex(x)
     vre = vre.reshape(vre.shape[:-1] + (n2, n1))
     full = _ct_sharded("sharded_rfft", vre, None, mesh, axis,
                        ga=n2, gb=n1, sign=-1.0, scale=None,
-                       out_kind="complex")
+                       out_kind="complex", precision=precision)
     return full[..., :n // 2 + 1]
+
+
+def _run_rfft_matmul_comp(x, mesh, axis, n1, n2, forced=False):
+    return _run_rfft_matmul(x, mesh, axis, n1, n2, forced=forced,
+                            precision="bf16_comp")
 
 
 def _run_rfft_local(x, mesh, axis, n1, n2, forced=False):
@@ -369,7 +436,8 @@ def _run_rfft_local(x, mesh, axis, n1, n2, forced=False):
     return _rfft_local_core(re)
 
 
-def _run_dft_matmul(x, mesh, axis, n1, n2, forced=False):
+def _run_dft_matmul(x, mesh, axis, n1, n2, forced=False,
+                    precision="highest"):
     del forced
     vre, vim = _split_complex(x)
     if vim is None:
@@ -378,7 +446,12 @@ def _run_dft_matmul(x, mesh, axis, n1, n2, forced=False):
     vim = vim.reshape(vim.shape[:-1] + (n2, n1))
     return _ct_sharded("sharded_dft", vre, vim, mesh, axis,
                        ga=n2, gb=n1, sign=-1.0, scale=None,
-                       out_kind="complex")
+                       out_kind="complex", precision=precision)
+
+
+def _run_dft_matmul_comp(x, mesh, axis, n1, n2, forced=False):
+    return _run_dft_matmul(x, mesh, axis, n1, n2, forced=forced,
+                           precision="bf16_comp")
 
 
 def _run_dft_local(x, mesh, axis, n1, n2, forced=False):
@@ -389,7 +462,8 @@ def _run_dft_local(x, mesh, axis, n1, n2, forced=False):
     return _dft_local_core(re, im)
 
 
-def _run_irfft_matmul(spec, mesh, axis, n1, n2, forced=False):
+def _run_irfft_matmul(spec, mesh, axis, n1, n2, forced=False,
+                      precision="highest"):
     del forced
     n = n1 * n2
     re, im = _split_complex(spec)
@@ -401,7 +475,12 @@ def _run_irfft_matmul(spec, mesh, axis, n1, n2, forced=False):
     fim = fim.reshape(fim.shape[:-1] + (n1, n2))
     return _ct_sharded("sharded_irfft", fre, fim, mesh, axis,
                        ga=n1, gb=n2, sign=1.0, scale=1.0 / n,
-                       out_kind="real")
+                       out_kind="real", precision=precision)
+
+
+def _run_irfft_matmul_comp(spec, mesh, axis, n1, n2, forced=False):
+    return _run_irfft_matmul(spec, mesh, axis, n1, n2, forced=forced,
+                             precision="bf16_comp")
 
 
 def _run_irfft_local(spec, mesh, axis, n1, n2, forced=False):
@@ -413,11 +492,15 @@ def _run_irfft_local(spec, mesh, axis, n1, n2, forced=False):
 
 
 _RFFT_ROUTES = {"sharded_matmul_dft": _run_rfft_matmul,
-                "local_fft": _run_rfft_local}
+                "local_fft": _run_rfft_local,
+                "sharded_matmul_dft_bf16_comp": _run_rfft_matmul_comp}
 _DFT_ROUTES = {"sharded_matmul_dft": _run_dft_matmul,
-               "local_fft": _run_dft_local}
+               "local_fft": _run_dft_local,
+               "sharded_matmul_dft_bf16_comp": _run_dft_matmul_comp}
 _IRFFT_ROUTES = {"sharded_matmul_dft": _run_irfft_matmul,
-                 "local_fft": _run_irfft_local}
+                 "local_fft": _run_irfft_local,
+                 "sharded_matmul_dft_bf16_comp":
+                     _run_irfft_matmul_comp}
 
 
 # ---------------------------------------------------------------------------
@@ -437,7 +520,8 @@ def _dispatch(op, table, operand, n, mesh, axis, route, oracle):
     if forced and route not in table:
         raise ValueError(f"route must be one of {sorted(table)}, "
                          f"got {route!r}")
-    if forced and route == "sharded_matmul_dft" and not factor:
+    if forced and route.startswith("sharded_matmul_dft") \
+            and not factor:
         raise ValueError(
             f"n={n} has no Cooley-Tukey split with both factors "
             f"divisible by {axis}={s} (and <= "
@@ -450,7 +534,8 @@ def _dispatch(op, table, operand, n, mesh, axis, route, oracle):
         runners = {name: (lambda fn=fn: fn(operand, mesh, axis,
                                            n1, n2, forced=True))
                    for name, fn in table.items()
-                   if name != "sharded_matmul_dft" or factor}
+                   if not name.startswith("sharded_matmul_dft")
+                   or factor}
         chosen = _FOURIER_FAMILY.select(
             eligible=_FOURIER_FAMILY.eligible(**geom),
             runners=lambda: runners,
@@ -458,13 +543,17 @@ def _dispatch(op, table, operand, n, mesh, axis, route, oracle):
             tune_geom=_fourier_tune_class(op, n, rows, mesh, axis),
             mesh=routing.mesh_class(mesh, axis),
             **geom)
-    is_mm = chosen == "sharded_matmul_dft"
-    _, _, bytes_a2a = _modeled_costs(n, n1, n2, rows, s)
+    is_mm = chosen.startswith("sharded_matmul_dft")
+    mm_precision = ("bf16_comp" if chosen.endswith("_bf16_comp")
+                    else "highest")
+    _, _, bytes_a2a = _modeled_costs(n, n1, n2, rows, s,
+                                     precision=mm_precision)
     obs.record_decision(
         op, chosen, n=int(n), n_shards=s, axis=axis, rows=rows,
         n1=n1 if is_mm else 0, n2=n2 if is_mm else 0,
         a2a=2 if is_mm else 0,
         ici_bytes=int(bytes_a2a) if is_mm else 0,
+        precision=mm_precision if is_mm else "highest",
         roofline=_FOURIER_FAMILY.route(chosen).roofline["kind"],
         forced=forced)
     with obs.span(f"{op}.dispatch", route=chosen, n_shards=s):
@@ -555,6 +644,15 @@ _FRAME_FAMILY = routing.family("parallel.frame_dft", (
         doc="Cooley-Tukey factorized matmul DFT for frames past the "
             "dense basis-residency cutoff"),
     routing.Route("xla_fft", doc="raw jnp.fft inside the shard"),
+    routing.Route(
+        "rdft_matmul_bf16_comp",
+        predicate=lambda frame_length, **_: (
+            frame_length <= sp.AUTO_DFT_MATMUL_MAX_FRAME
+            and sp.dft_matmul_allowed()
+            and prx.precision_allowed("bf16_comp")),
+        disable_env=prx.BF16_COMP_ENV,
+        doc="the per-shard basis matmul at bf16_comp "
+            "(split/compensated accumulation — runtime/precision.py)"),
 ))
 
 
@@ -576,13 +674,15 @@ def frame_rfft_fn(route: str, frame_length: int, window):
     L = int(frame_length)
     window = np.asarray(window, np.float32)
     bins = L // 2 + 1
-    if route == "rdft_matmul":
+    if route in ("rdft_matmul", "rdft_matmul_bf16_comp"):
         basis = sp._device_basis("rdft_fwd", L, window,
                                  lambda: sp._rdft_basis(L, window))
+        p = ("bf16_comp" if route == "rdft_matmul_bf16_comp"
+             else "highest")
 
         def fn(frames):
-            out = jnp.einsum("...fl,lb->...fb", frames, basis,
-                             precision=jax.lax.Precision.HIGHEST)
+            out = prx.p_einsum("...fl,lb->...fb", frames, basis,
+                               precision=p)
             return jax.lax.complex(out[..., :bins], out[..., bins:])
         return fn
     if route == "ct_matmul":
@@ -606,15 +706,17 @@ def frame_irfft_fn(route: str, frame_length: int, window):
     sharded ISTFT) for the given frame route."""
     L = int(frame_length)
     window = np.asarray(window, np.float32)
-    if route == "rdft_matmul":
+    if route in ("rdft_matmul", "rdft_matmul_bf16_comp"):
         inv = sp._device_basis("rdft_inv", L, window,
                                lambda: sp._rdft_inv_basis(L, window))
+        p = ("bf16_comp" if route == "rdft_matmul_bf16_comp"
+             else "highest")
 
         def fn(spec):
             parts = jnp.concatenate([jnp.real(spec), jnp.imag(spec)],
                                     axis=-1)
-            return jnp.einsum("...fb,bl->...fl", parts, inv,
-                              precision=jax.lax.Precision.HIGHEST)
+            return prx.p_einsum("...fb,bl->...fl", parts, inv,
+                                precision=p)
         return fn
     if route == "ct_matmul":
         n1, n2 = sp.ct_factor(L)

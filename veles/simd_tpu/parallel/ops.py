@@ -38,6 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from veles.simd_tpu import obs
 from veles.simd_tpu.runtime import faults, routing
+from veles.simd_tpu.runtime import precision as prx
 
 
 def _axis_size(axis_name) -> int:
@@ -340,7 +341,7 @@ def _ring_block_conv(block, seg):
         out = jax.lax.conv_general_dilated(
             lhs, rhs, window_strides=(1,),
             padding=[(blk - 1, blk - 1)],
-            precision=jax.lax.Precision.HIGHEST)
+            precision=prx.HIGHEST)
         return out.reshape(block.shape[:-1] + (blk,))
     m = next_highest_power_of_2(blk + ks - 1)
     spec = jnp.fft.rfft(block, m) * jnp.fft.rfft(seg, m)
@@ -562,7 +563,7 @@ def _ring_tile_conv2d(tile, seg):
         out = jax.lax.conv_general_dilated(
             lhs, rhs, window_strides=(1, 1),
             padding=[(b0 - 1, b0 - 1), (b1 - 1, b1 - 1)],
-            precision=jax.lax.Precision.HIGHEST)
+            precision=prx.HIGHEST)
         return out.reshape(tile.shape[:-2] + (b0, b1))
     m0 = next_highest_power_of_2(b0 + g0 - 1)
     m1 = next_highest_power_of_2(b1 + g1 - 1)
@@ -735,7 +736,7 @@ def sharded_swt(type, order, levels, x, mesh: Mesh, axis: str = "sp"):
         out = jax.lax.conv_general_dilated(
             lhs, rhs, window_strides=(1,), padding="VALID",
             rhs_dilation=(dilation,),
-            precision=jax.lax.Precision.HIGHEST)
+            precision=prx.HIGHEST)
         out = out[..., :cur.shape[-1]].reshape(
             batch_shape + (2, cur.shape[-1]))
         return out[..., 0, :], out[..., 1, :]
@@ -807,7 +808,7 @@ def sharded_swt_reconstruct(type, order, levels, coeffs, mesh: Mesh,
             lhs, rhs.astype(jnp.float32),
             window_strides=(1,), padding="VALID",
             rhs_dilation=(dilation,),
-            precision=jax.lax.Precision.HIGHEST)[:, 0]
+            precision=prx.HIGHEST)[:, 0]
         return (out / (2.0 * c2)).reshape(batch_shape + (hi_b.shape[-1],))
 
     @functools.partial(
@@ -865,7 +866,7 @@ def sharded_wavelet_apply(type, order, x, mesh: Mesh, axis: str = "sp"):
         lhs = ext.reshape((-1, 1, ext.shape[-1]))
         out = jax.lax.conv_general_dilated(
             lhs, rhs.astype(jnp.float32), window_strides=(2,),
-            padding="VALID", precision=jax.lax.Precision.HIGHEST)
+            padding="VALID", precision=prx.HIGHEST)
         out = out[..., :m_loc].reshape(batch_shape + (2, m_loc))
         return out[..., 0, :], out[..., 1, :]
 
@@ -956,7 +957,7 @@ def sharded_wavelet_reconstruct(type, order, desthi, destlo, mesh: Mesh,
         full = jax.lax.conv_general_dilated(
             lhs, rhs.astype(jnp.float32), window_strides=(1,),
             padding=[(pad, pad)], lhs_dilation=(2,),
-            precision=jax.lax.Precision.HIGHEST)[:, 0]
+            precision=prx.HIGHEST)[:, 0]
         out = jax.lax.slice_in_dim(full, 2 * halo, 2 * halo + 2 * m_loc,
                                    axis=-1)
         return (out / c2).reshape(batch_shape + (2 * m_loc,))
@@ -996,7 +997,7 @@ def sharded_matmul(a, b, mesh: Mesh, axis: str = "tp"):
             out_specs=P(None, None))
         def _run(a_local, b_local):
             partial = jnp.dot(a_local, b_local,
-                              precision=jax.lax.Precision.HIGHEST)
+                              precision=prx.HIGHEST)
             return jax.lax.psum(partial, axis)
 
         from veles.simd_tpu.ops import matrix as mx
@@ -1234,7 +1235,7 @@ def sharded_sosfilt(sos, x, mesh: Mesh, axis: str = "sp"):
         # Precision.HIGHEST on both contractions: TPU einsum defaults
         # to bf16 and the state corrections are exactly where rounding
         # becomes audible (see iir._affine_combine)
-        hi = jax.lax.Precision.HIGHEST
+        hi = prx.HIGHEST
         s_in_all = jnp.einsum("ijkl,j...l->i...k", w, gathered,
                               precision=hi)
         idx = jax.lax.axis_index(axis)
@@ -1581,10 +1582,10 @@ def sharded_savgol_filter(x, window_length: int, polyorder: int,
         rhs = taps[None, None, :]
         y = jax.lax.conv_general_dilated(
             lhs, rhs, window_strides=(1,), padding="VALID",
-            precision=jax.lax.Precision.HIGHEST)
+            precision=prx.HIGHEST)
         y = y.reshape(x_local.shape[:-1] + (block,))
         if mode == "interp":
-            hi = jax.lax.Precision.HIGHEST
+            hi = prx.HIGHEST
             head = jnp.einsum("hw,...w->...h", head_mat,
                               x_local[..., :w], precision=hi)
             tail = jnp.einsum("hw,...w->...h", tail_mat,

@@ -13,11 +13,27 @@ buckets straight to their fallback instead of burning the retry
 ladder per call — and :mod:`~veles.simd_tpu.runtime.routing`, the
 unified routing engine: declarative candidate-route tables, the
 shared selector, and the measured autotuner with its persistent tune
-cache.
+cache — plus :mod:`~veles.simd_tpu.runtime.precision`, the
+compensated-precision matmul layer (``bf16_comp``/``int8`` route
+primitives and the one home of every raw MXU-precision literal).
 """
 
 from veles.simd_tpu.runtime import breaker
 from veles.simd_tpu.runtime import faults
 from veles.simd_tpu.runtime import routing
 
-__all__ = ["breaker", "faults", "routing"]
+__all__ = ["breaker", "faults", "precision", "routing"]
+
+
+def __getattr__(name):
+    # precision imports jax at module scope; loading it lazily keeps
+    # `import veles.simd_tpu.runtime` jax-free (the faults/routing
+    # contract) for processes that never touch a compute core.
+    # importlib, not a from-import: `from <pkg> import precision`
+    # resolves through THIS hook, so a from-import here would recurse
+    if name == "precision":
+        import importlib
+
+        return importlib.import_module(
+            "veles.simd_tpu.runtime.precision")
+    raise AttributeError(name)

@@ -29,12 +29,14 @@ import numpy as np
 
 __all__ = ["device_time", "device_time_chained", "host_time",
            "rms_normalize", "mxu_peak_tflops", "mxu_f32_bound_tflops",
+           "mxu_int8_peak_tops",
            "conv_roofline", "stft_roofline", "rfft_flops",
-           "analytical_roofline",
+           "analytical_roofline", "gemm_roofline",
            "roofline_disagreement_pct", "hbm_bw_gbps",
            "ici_bw_gbps", "xla_fft_eff_gflops", "a2a_ici_bytes",
            "ct_dft_flops", "dft_matmul_roofline",
-           "MXU_PEAK_TFLOPS_BF16", "MXU_F32_PASSES", "HBM_BW_GBPS",
+           "MXU_PEAK_TFLOPS_BF16", "MXU_PEAK_TOPS_INT8",
+           "MXU_F32_PASSES", "HBM_BW_GBPS",
            "ICI_BW_GBPS", "XLA_FFT_EFF_GFLOPS",
            "ROOFLINE_DISAGREEMENT_WARN_PCT"]
 
@@ -47,9 +49,18 @@ __all__ = ["device_time", "device_time_chained", "host_time",
 # other hardware generations (the % -of-bound figures in the bench rows
 # all key off this one constant)
 MXU_PEAK_TFLOPS_BF16 = 197.0
-# f32 emulation pass counts per MXU precision knob: "highest" = 6-pass
-# bf16 (full f32), "high" = 3-pass (~1.3e-5 rel err on the conv oracle)
-MXU_F32_PASSES = {"highest": 6, "high": 3}
+# public TPU v5e int8 ceiling (TOPS) — the MXU's quantized rate is ~2x
+# its bf16 rate; override with $VELES_SIMD_MXU_PEAK_TOPS_INT8
+MXU_PEAK_TOPS_INT8 = 394.0
+# bf16 MXU pass counts per precision knob — the denominators the
+# per-precision roofline %s divide by, so a bf16_comp number is judged
+# against ITS OWN ceiling instead of flattering itself against the
+# 6-pass f32 bound: "highest" = 6-pass bf16 (full f32 emulation),
+# "high" = 3-pass (~1.3e-5 rel err on the conv oracle), "bf16_comp" =
+# 3-pass split/compensated accumulation (~5e-6 rel err,
+# runtime/precision.py), "bf16"/"default" = 1 plain pass (~2.4e-3).
+MXU_F32_PASSES = {"highest": 6, "high": 3, "bf16_comp": 3,
+                  "bf16": 1, "default": 1}
 # public TPU v5e HBM bandwidth ceiling (GB/s); override with
 # $VELES_SIMD_HBM_BW_GBPS on other hardware.  Denominator of the
 # analytical-roofline attainable-% figures (obs resource axis).
@@ -63,16 +74,28 @@ def mxu_peak_tflops() -> float:
 
 
 def mxu_f32_bound_tflops(precision: str = "highest") -> float:
-    """The f32 MXU roofline at an emulation precision: bf16 peak divided
-    by the pass count (32.8 TFLOP/s for 6-pass ``highest`` at the v5e
-    default peak — the denominator of BASELINE.md's 69% conv figure)."""
+    """The MXU roofline at a precision knob: bf16 peak divided by the
+    bf16 pass count (32.8 TFLOP/s for 6-pass ``highest`` at the v5e
+    default peak — the denominator of BASELINE.md's 69% conv figure;
+    65.7 for the 3-pass ``bf16_comp`` route, 197 for plain ``bf16``).
+    ``int8`` reads its own TOPS ceiling (:func:`mxu_int8_peak_tops`)
+    — the quantized rate is not a bf16 pass-count multiple."""
+    if precision == "int8":
+        return mxu_int8_peak_tops()
     try:
         passes = MXU_F32_PASSES[precision]
     except KeyError:
         raise ValueError(
-            f"precision must be one of {sorted(MXU_F32_PASSES)}, got "
+            f"precision must be one of "
+            f"{sorted(MXU_F32_PASSES) + ['int8']}, got "
             f"{precision!r}") from None
     return mxu_peak_tflops() / passes
+
+
+def mxu_int8_peak_tops() -> float:
+    """int8 MXU peak in TOPS (env-overridable hardware constant)."""
+    return float(os.environ.get("VELES_SIMD_MXU_PEAK_TOPS_INT8",
+                                MXU_PEAK_TOPS_INT8))
 
 
 def hbm_bw_gbps() -> float:
@@ -181,6 +204,21 @@ def roofline_disagreement_pct(measured_pct: float,
         return float("inf") if analytical_pct else 0.0
     return 100.0 * abs(analytical_pct - measured_pct) / abs(
         measured_pct)
+
+
+def gemm_roofline(flops: float, t_seconds: float,
+                  precision: str = "highest") -> dict:
+    """Roofline attribution of one GEMM: ``flops`` (the 2mnk useful
+    count) in ``t_seconds`` against the MXU bound at ``precision`` —
+    the per-precision honesty contract (a ``bf16_comp`` rate divides
+    by the 3-pass bound, never the 6-pass f32 one).  Same dict shape
+    as :func:`conv_roofline` so bench rows embed it verbatim."""
+    bound = mxu_f32_bound_tflops(precision)
+    eff = float(flops) / float(t_seconds) / 1e12
+    return {"tflops_effective": eff,
+            "roofline_bound_tflops": bound,
+            "pct_of_roofline": 100.0 * eff / bound,
+            "precision": precision}
 
 
 def conv_roofline(samples_per_s: float, h_length: int,
