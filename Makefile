@@ -8,7 +8,8 @@ PYTHON ?= python
 .PHONY: all tests tests-quick benchmarks bench bench-regress \
         bench-multichip bench-serve serve-smoke chaos-smoke \
         chaos-replicas cshim cshim-check wavelet-tables lint docs \
-        obs-report obs-dash autotune-pack install install-hooks clean
+        obs-report obs-dash autotune-pack warm-pack cold-start \
+        install install-hooks clean
 
 all: cshim
 
@@ -115,6 +116,24 @@ PACK ?= autotune_pack.json
 autotune-pack:
 	$(PYTHON) tools/autotune_pack.py --out $(PACK)
 
+# build the pre-warmed AOT ARTIFACT pack: export every serving shape
+# class's compiled executable (jax.export, stamped + sha256'd) plus
+# the persistent-XLA-cache leg into one directory a fresh process
+# preloads at serve.Server.start — zero-warmup cold start
+# (VELES_SIMD_ARTIFACTS=readonly + VELES_SIMD_ARTIFACT_DIR=pack).
+# Override with WARM_PACK=path.
+WARM_PACK ?= warm_pack
+warm-pack:
+	$(PYTHON) tools/warm_pack.py --dir $(WARM_PACK)
+
+# the cold-start bench family: process-birth -> first-request wall
+# clock of a fresh subprocess server, warm pack vs cold, written to
+# COLD_START_DETAILS.json with artifact hit/stale/miss evidence.
+# Gate with `python tools/bench_regress.py --details
+# COLD_START_DETAILS.json`.
+cold-start:
+	$(PYTHON) tools/cold_start.py
+
 # Installs the commit gate: `make tests-quick` must be green before any
 # code commit (round-4 postmortem: snapshot 8182983 landed red at HEAD).
 install-hooks:
@@ -130,4 +149,5 @@ install:
 
 clean:
 	$(MAKE) -C csrc clean
-	rm -f tests.log test_results_*.xml
+	rm -f tests.log test_results_*.xml COLD_START_DETAILS.json
+	rm -rf warm_pack

@@ -42,6 +42,7 @@ Usage:  python bench.py           # one JSON line on stdout (first!)
         python bench.py --check   # correctness smoke only, no timing
 """
 
+import argparse
 import json
 import os
 import sys
@@ -494,6 +495,40 @@ def bench_precision_stft(rng):
               f"{samples / t_comp / 1e6:.0f} Ms/s vs highest "
               f"{samples / t_hi / 1e6:.0f} Ms/s "
               f"({t_hi / t_comp:.2f}x)", file=sys.stderr)
+    return out
+
+
+def bench_cold_start(rng):
+    """Config 17: the zero-warmup acceptance number — process-birth ->
+    first-request wall clock of a fresh SUBPROCESS serving process,
+    warm artifact pack (VELES_SIMD_ARTIFACTS=readonly + preload at
+    Server.start) vs cold (artifacts off, full trace+compile per shape
+    class).  vs_baseline IS the cold/warm speedup (the >= 2x
+    acceptance bar is "warm <= 50% of cold"); the warm child's
+    artifact hit/stale/miss counters ride in the row's telemetry via
+    tools/cold_start.py, which also writes the standalone
+    COLD_START_DETAILS.json family."""
+    del rng                        # subprocess children seed themselves
+    import tempfile
+
+    from tools import cold_start as cs
+
+    with tempfile.TemporaryDirectory(prefix="veles-warmpack-") as tmp:
+        pack = os.path.join(tmp, "pack")
+        ns = argparse.Namespace(pack=pack, reuse_pack=False,
+                                timeout=600.0)
+        rows, evidence = cs.run(ns)
+    with open(cs.DEFAULT_DETAILS, "w") as f:
+        json.dump(rows + [{"cold_start_evidence": evidence}], f,
+                  indent=2)
+    out = {"metric": "cold start warm vs cold",
+           "unit": "x", "value": evidence["speedup"], "baseline": 1.0,
+           "artifact_evidence": rows[0]["telemetry"]}
+    print(f"COLD-START: cold {evidence['cold']['wall_s']:.2f}s -> "
+          f"warm {evidence['warm']['wall_s']:.2f}s "
+          f"(x{evidence['speedup']:.2f}, warm = "
+          f"{100 * evidence['warm_fraction_of_cold']:.0f}% of cold)",
+          file=sys.stderr)
     return out
 
 
@@ -1245,7 +1280,8 @@ def main():
                    bench_spectrogram, bench_batched_stft,
                    bench_serve, bench_pipeline, bench_pipeline_p99,
                    bench_autotuned_headline, bench_precision_gemm,
-                   bench_precision_convolve, bench_precision_stft)
+                   bench_precision_convolve, bench_precision_stft,
+                   bench_cold_start)
         for i, fn in enumerate(configs):
             # a failed/skipped config never reaches flush()'s reset — drop
             # its events here so they can't masquerade as the next config's

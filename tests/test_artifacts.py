@@ -1,0 +1,484 @@
+"""The AOT artifact store (veles/simd_tpu/runtime/artifacts.py).
+
+Pins the zero-warmup subsystem's contracts: round-trip parity (a
+loaded executable computes exactly what the fresh compile computes),
+stale-stamp refusal (schema / jax version / device / device-count
+mismatches are a MISS — a wrong-runtime program is never loaded),
+corrupt-file and torn-payload degradation (counters, never crashes),
+readonly-mode write refusal, the instrumented_jit load-before-compile
+counters and ``artifact`` decision events, serve preload end-to-end
+(the first request after a preload runs packed executables — zero
+persistent-cache misses), and the profiler shim's delegation with the
+``compile.cache_*`` bridge verified against a warm load.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from veles.simd_tpu import obs  # noqa: E402
+from veles.simd_tpu.runtime import artifacts as art  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _telemetry():
+    obs.enable()
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def _core(x, w):
+    # module-level, closure-free: self-identifies to the store via
+    # qualname + bytecode digest
+    return jnp.tanh(x @ w) * 2.0 + 0.5
+
+
+def _operands(n=32, m=16, k=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return (jnp.asarray(rng.randn(n, m).astype(np.float32)),
+            jnp.asarray(rng.randn(m, k).astype(np.float32)))
+
+
+def _fresh_wrapper(op="artifact_test", route="r"):
+    return obs.instrumented_jit(_core, op=op, route=route)
+
+
+def _drive_on(store_dir):
+    """One export drive: dispatch under mode=on so the store fills."""
+    x, w = _operands()
+    with art.private_artifact_store(store_dir) as st:
+        with art.artifacts_mode_override("on"):
+            y = np.asarray(_fresh_wrapper()(x, w))
+    return y, st.info()
+
+
+# ---------------------------------------------------------------------------
+# round trip + keys
+# ---------------------------------------------------------------------------
+
+
+def test_roundtrip_parity_vs_fresh_compile(tmp_path):
+    d = str(tmp_path / "pack")
+    y_fresh, info = _drive_on(d)
+    assert info["stores"] == 1 and info["misses"] == 1
+    x, w = _operands()
+    with art.private_artifact_store(d):
+        with art.artifacts_mode_override("readonly"):
+            wrapper = _fresh_wrapper()
+            y_loaded = np.asarray(wrapper(x, w))
+            st_info = art.store().info()
+    assert st_info["hits"] == 1 and st_info["stale"] == 0
+    np.testing.assert_array_equal(y_fresh, y_loaded)
+    assert obs.counter_value("artifact_hit", op="artifact_test",
+                             route="r") == 1
+    events = [e for e in obs.events() if e["op"] == "artifact"]
+    assert any(e["decision"] == "hit" for e in events)
+
+
+def test_distinct_geometries_distinct_entries(tmp_path):
+    d = str(tmp_path / "pack")
+    with art.private_artifact_store(d) as st:
+        with art.artifacts_mode_override("on"):
+            w1 = _fresh_wrapper()
+            w1(*_operands(n=32))
+            w1(*_operands(n=64))
+        assert st.info()["size"] == 2
+        assert len(st.keys()) == 2
+
+
+def test_closure_without_key_never_touches_store(tmp_path):
+    d = str(tmp_path / "pack")
+    taps = 3.0
+
+    def closed(x, w):
+        return _core(x, w) * taps
+
+    with art.private_artifact_store(d) as st:
+        with art.artifacts_mode_override("on"):
+            obs.instrumented_jit(closed, op="cl")(*_operands())
+        assert st.info()["size"] == 0
+        assert st.info()["misses"] == 0
+
+
+def test_artifact_key_separates_identical_shapes(tmp_path):
+    """Two closures baking different params over identical call
+    geometry: the explicit artifact_key (the handle-LRU discipline)
+    keeps their packed executables apart — and each loads back its
+    OWN program."""
+    d = str(tmp_path / "pack")
+
+    def make(scale):
+        def fn(x, w):
+            return _core(x, w) * scale
+        return fn
+
+    x, w = _operands()
+    with art.private_artifact_store(d) as st:
+        with art.artifacts_mode_override("on"):
+            y2 = np.asarray(obs.instrumented_jit(
+                make(2.0), op="k", artifact_key="scale=2")(x, w))
+            y5 = np.asarray(obs.instrumented_jit(
+                make(5.0), op="k", artifact_key="scale=5")(x, w))
+        assert st.info()["size"] == 2
+        with art.artifacts_mode_override("readonly"):
+            l2 = np.asarray(obs.instrumented_jit(
+                make(2.0), op="k", artifact_key="scale=2")(x, w))
+            l5 = np.asarray(obs.instrumented_jit(
+                make(5.0), op="k", artifact_key="scale=5")(x, w))
+        assert st.info()["hits"] == 2
+    np.testing.assert_array_equal(y2, l2)
+    np.testing.assert_array_equal(y5, l5)
+    assert not np.allclose(l2, l5)
+
+
+def test_static_and_donating_wrappers_excluded():
+    fn_static = obs.instrumented_jit(lambda x, n: x * n,
+                                     static_argnames=("n",))
+    assert fn_static._artifact_ident is None
+    fn_donate = obs.instrumented_jit(_core, donate_argnums=(0,),
+                                     artifact_key="k")
+    assert fn_donate._artifact_ident is None
+
+
+# ---------------------------------------------------------------------------
+# stale stamps: never loaded, always counted
+# ---------------------------------------------------------------------------
+
+
+def _edit_manifest(d, mutate):
+    path = os.path.join(d, art.MANIFEST_NAME)
+    with open(path) as f:
+        data = json.load(f)
+    mutate(data)
+    with open(path, "w") as f:
+        json.dump(data, f)
+
+
+@pytest.mark.parametrize("mutate, reason", [
+    (lambda m: m.update(schema=99), "schema"),
+    (lambda m: m.update(jax="9.9.9/9.9.9"), "jax version"),
+    (lambda m: m.update(device="TPU v99"), "device kind"),
+])
+def test_stale_manifest_stamp_is_a_miss(tmp_path, mutate, reason):
+    d = str(tmp_path / "pack")
+    _drive_on(d)
+    _edit_manifest(d, mutate)
+    x, w = _operands()
+    with art.private_artifact_store(d):
+        with art.artifacts_mode_override("readonly"):
+            y = np.asarray(_fresh_wrapper()(x, w))   # fresh compile
+        info = art.store().info()
+    assert info["hits"] == 0, reason
+    assert info["stale"] == 1, reason
+    np.testing.assert_allclose(y, np.asarray(_core(x, w)), rtol=1e-6)
+
+
+def test_stale_device_count_entry_stamp_is_a_miss(tmp_path):
+    """The per-entry device-count class (the mesh-stamp discipline):
+    an executable exported under another topology never loads."""
+    d = str(tmp_path / "pack")
+    _drive_on(d)
+
+    def mutate(m):
+        for e in m["entries"].values():
+            e["devices"] = "d999"
+
+    _edit_manifest(d, mutate)
+    x, w = _operands()
+    with art.private_artifact_store(d):
+        with art.artifacts_mode_override("readonly"):
+            np.asarray(_fresh_wrapper()(x, w))
+        info = art.store().info()
+    assert info["hits"] == 0
+    assert info["stale"] == 1
+    assert obs.counter_value("artifact_stale", op="artifact_test",
+                             route="r") == 1
+
+
+def test_stale_surfaces_in_obs_caches(tmp_path):
+    d = str(tmp_path / "pack")
+    _drive_on(d)
+    _edit_manifest(d, lambda m: m.update(device="TPU v99"))
+    with art.private_artifact_store(d):
+        with art.artifacts_mode_override("readonly"):
+            np.asarray(_fresh_wrapper()(*_operands()))
+            snap = obs.caches()["artifact_store"]
+    for key in ("path", "mode", "hits", "misses", "stale",
+                "evictions"):
+        assert key in snap
+    assert snap["stale"] == 1 and snap["mode"] == "readonly"
+
+
+# ---------------------------------------------------------------------------
+# corruption: degrade, never crash
+# ---------------------------------------------------------------------------
+
+
+def test_corrupt_manifest_degrades_to_empty(tmp_path):
+    d = str(tmp_path / "pack")
+    _drive_on(d)
+    with open(os.path.join(d, art.MANIFEST_NAME), "w") as f:
+        f.write("{ not json !!!")
+    with art.private_artifact_store(d) as st:
+        with art.artifacts_mode_override("readonly"):
+            y = np.asarray(_fresh_wrapper()(*_operands()))
+        info = st.info()
+    assert info["load_errors"] == 1 and info["hits"] == 0
+    assert np.isfinite(y).all()
+
+
+def test_torn_payload_is_a_load_error_miss(tmp_path):
+    """The atomic-write torn-file gate: a payload whose bytes do not
+    match the manifest sha256 (a torn copy, a hand edit) must never
+    deserialize."""
+    d = str(tmp_path / "pack")
+    _drive_on(d)
+    with art.private_artifact_store(d) as st:
+        (key,) = st.keys()
+        entry = st.entry(key)
+        with open(os.path.join(d, entry["file"]), "r+b") as f:
+            f.truncate(max(1, entry["size"] // 2))
+        with art.artifacts_mode_override("readonly"):
+            y = np.asarray(_fresh_wrapper()(*_operands()))
+        info = st.info()
+    assert info["load_errors"] == 1 and info["hits"] == 0
+    assert obs.counter_value("artifact_load_error",
+                             op="artifact_test", route="r") == 1
+    x, w = _operands()
+    np.testing.assert_allclose(y, np.asarray(_core(x, w)), rtol=1e-6)
+
+
+def test_missing_payload_file_is_a_miss(tmp_path):
+    d = str(tmp_path / "pack")
+    _drive_on(d)
+    with art.private_artifact_store(d) as st:
+        (key,) = st.keys()
+        os.unlink(os.path.join(d, st.entry(key)["file"]))
+        data, outcome = st.load_bytes(key)
+    assert data is None and outcome == "load_error"
+
+
+# ---------------------------------------------------------------------------
+# readonly: never writes
+# ---------------------------------------------------------------------------
+
+
+def test_readonly_mode_never_writes(tmp_path):
+    d = str(tmp_path / "pack")
+    _drive_on(d)
+    before = sorted(os.listdir(d))
+    manifest_before = open(os.path.join(d, art.MANIFEST_NAME)).read()
+    x64 = _operands(n=64)
+    with art.private_artifact_store(d) as st:
+        with art.artifacts_mode_override("readonly"):
+            _fresh_wrapper()(*x64)           # unseen geometry: a miss
+            assert not st.store_bytes("k", b"data")
+        info = st.info()
+    assert info["stores"] == 0
+    assert info["write_refused"] >= 1
+    # the directory is byte-for-byte untouched (xla_cache excluded:
+    # the persistent-compile leg is the fallback FOR the miss)
+    after = sorted(p for p in os.listdir(d)
+                   if p != art.XLA_CACHE_SUBDIR)
+    assert after == sorted(p for p in before
+                           if p != art.XLA_CACHE_SUBDIR)
+    assert open(os.path.join(d, art.MANIFEST_NAME)).read() \
+        == manifest_before
+
+
+def test_save_refuses_foreign_manifest(tmp_path):
+    """A valid pack stamped for another runtime is never overwritten
+    (the TuneCache save_refused discipline)."""
+    d = str(tmp_path / "pack")
+    os.makedirs(d)
+    foreign = {"schema": art.ARTIFACT_SCHEMA, "jax": "9.9.9/9.9.9",
+               "device": "TPU v99", "entries": {}}
+    with open(os.path.join(d, art.MANIFEST_NAME), "w") as f:
+        json.dump(foreign, f)
+    with art.private_artifact_store(d) as st:
+        with art.artifacts_mode_override("on"):
+            _fresh_wrapper()(*_operands())
+        info = st.info()
+    assert info["save_refused"] >= 1
+    with open(os.path.join(d, art.MANIFEST_NAME)) as f:
+        assert json.load(f)["device"] == "TPU v99"
+
+
+# ---------------------------------------------------------------------------
+# preload + serve end to end
+# ---------------------------------------------------------------------------
+
+
+def test_preload_compiles_every_entry(tmp_path):
+    d = str(tmp_path / "pack")
+    with art.private_artifact_store(d) as st:
+        with art.artifacts_mode_override("on"):
+            w1 = _fresh_wrapper()
+            w1(*_operands(n=32))
+            w1(*_operands(n=64))
+        with art.artifacts_mode_override("readonly"):
+            report = art.preload()
+        assert report["loaded"] == 2 and report["failed"] == 0
+        assert st.info()["runners"] == 2
+    events = [e for e in obs.events() if e["op"] == "artifact"]
+    assert any(e["decision"] == "preload" and e["loaded"] == 2
+               for e in events)
+
+
+def test_preload_off_mode_is_a_noop(tmp_path):
+    with art.private_artifact_store(str(tmp_path)):
+        report = art.preload()
+    assert report == {"loaded": 0, "failed": 0, "mode": "off",
+                      "path": str(tmp_path)}
+
+
+def test_serve_preload_first_request_zero_cache_misses(
+        tmp_path, monkeypatch):
+    """The subsystem's whole point, end to end: build a mini warm
+    pack by serving one request in ``on`` mode, then start a SECOND
+    server against the pack in ``readonly`` — its preload loads the
+    executables, the first request records an ``artifact`` hit event,
+    and the ``compile.cache_misses`` delta across that first request
+    is ZERO (nothing compiled cold).  Configured through the
+    PROCESS-GLOBAL env/dir bindings (not the thread-local overrides):
+    serve dispatch happens on worker threads, exactly as in
+    production."""
+    from veles.simd_tpu import serve
+    from veles.simd_tpu.ops import batched, iir
+
+    obs.install_compile_listeners()
+    d = str(tmp_path / "pack")
+    sos = np.asarray(iir.butterworth(4, 0.25, "lowpass"))
+    x = np.random.RandomState(3).randn(512).astype(np.float32)
+
+    def submit_one(srv):
+        return srv.submit(op="sosfilt", x=x,
+                          params={"sos": sos}).result(timeout=120.0)
+
+    art.set_artifact_dir(d)
+    try:
+        monkeypatch.setenv(art.ARTIFACTS_ENV, "on")
+        batched.clear_handle_cache()
+        with serve.Server(max_batch=2, max_wait_ms=1.0,
+                          obs_port=-1) as srv:
+            y_on = submit_one(srv)
+        obs.reset()
+        monkeypatch.setenv(art.ARTIFACTS_ENV, "readonly")
+        batched.clear_handle_cache()       # a "fresh process's" LRU
+        with serve.Server(max_batch=2, max_wait_ms=1.0,
+                          obs_port=-1) as srv:
+            assert srv.stats()["artifact_preload"]["loaded"] >= 1
+            misses_before = obs.counter_value(
+                "compile.cache_misses")
+            y_ro = submit_one(srv)
+            misses_after = obs.counter_value(
+                "compile.cache_misses")
+        info = art.store().info()
+    finally:
+        art.set_artifact_dir(None)
+    np.testing.assert_array_equal(y_on, y_ro)
+    assert info["hits"] >= 1
+    assert misses_after == misses_before, \
+        "first request after preload must not compile cold"
+    events = [e for e in obs.events() if e["op"] == "artifact"]
+    assert any(e["decision"] == "hit" for e in events)
+
+
+def test_pipeline_artifact_round_trip(tmp_path):
+    """Compiled pipelines are artifacts too: one entry per
+    (name, block_len), loaded back by a freshly-compiled chain."""
+    from veles.simd_tpu import pipeline as pl
+    from veles.simd_tpu.ops import iir
+
+    d = str(tmp_path / "pack")
+    sos = iir.butterworth(2, 0.3, "lowpass")
+
+    def build():
+        return pl.Pipeline([pl.sosfilt(sos)],
+                           name="artline").compile(256)
+
+    x = np.random.RandomState(5).randn(256).astype(np.float32)
+    with art.private_artifact_store(d) as st:
+        with art.artifacts_mode_override("on"):
+            cp = build()
+            y_on, _ = cp.process(x, cp.init_state())
+        keys = st.keys()
+        assert any("pipeline:artline:256" in k for k in keys)
+        with art.artifacts_mode_override("readonly"):
+            cp2 = build()
+            y_ro, _ = cp2.process(x, cp2.init_state())
+        assert st.info()["hits"] >= 1
+    np.testing.assert_array_equal(np.asarray(y_on),
+                                  np.asarray(y_ro))
+
+
+# ---------------------------------------------------------------------------
+# the persistent-compile-cache leg + the profiler shim
+# ---------------------------------------------------------------------------
+
+
+def test_profiler_shim_delegates_and_bridge_counts_warm_load(
+        tmp_path):
+    """``utils/profiler.enable_compilation_cache`` is a delegating
+    shim over the artifact subsystem, and the ``compile.cache_*``
+    jax.monitoring bridge sees a warm load: two jits of
+    byte-identical programs — the second backend compile must be a
+    persistent-cache HIT."""
+    from veles.simd_tpu.utils import profiler
+
+    obs.install_compile_listeners()
+    cache_dir = str(tmp_path / "xc")
+    assert profiler.enable_compilation_cache(cache_dir) == cache_dir
+    x = jnp.ones((64, 64), jnp.float32)
+    hits0 = obs.counter_value("compile.cache_hits")
+    # two distinct function objects, identical jaxprs -> identical
+    # module hash -> the second compile is a cache hit
+    np.asarray(jax.jit(lambda v: jnp.sin(v) * 3.0 + 1.0)(x))
+    np.asarray(jax.jit(lambda v: jnp.sin(v) * 3.0 + 1.0)(x))
+    assert obs.counter_value("compile.cache_hits") > hits0
+
+
+def test_mode_parsing(monkeypatch):
+    monkeypatch.setenv(art.ARTIFACTS_ENV, "readonly")
+    assert art.artifacts_mode() == "readonly"
+    monkeypatch.setenv(art.ARTIFACTS_ENV, "bogus")
+    assert art.artifacts_mode() == "off"
+    monkeypatch.delenv(art.ARTIFACTS_ENV)
+    assert art.artifacts_mode() == "off"
+    with art.artifacts_mode_override("on"):
+        assert art.artifacts_mode() == "on"
+    assert art.artifacts_mode() == "off"
+    with pytest.raises(ValueError):
+        with art.artifacts_mode_override("sideways"):
+            pass
+
+
+def test_store_eviction_bounds_entries(tmp_path, monkeypatch):
+    monkeypatch.setattr(art, "MAX_ARTIFACT_ENTRIES", 3)
+    d = str(tmp_path / "pack")
+    with art.private_artifact_store(d) as st:
+        with art.artifacts_mode_override("on"):
+            for i in range(5):
+                st.store_bytes(f"key{i}", b"payload%d" % i)
+        info = st.info()
+    assert info["size"] == 3
+    assert info["evictions"] == 2
+    # evicted payload files are gone too (best effort, same process)
+    bins = [p for p in os.listdir(d) if p.endswith(".bin")]
+    assert len(bins) == 3
+    # and the MANIFEST agrees: save()'s read-merge-write must not
+    # resurrect evicted keys as dangling file references (a fresh
+    # process would read them straight into load_errors)
+    with open(os.path.join(d, art.MANIFEST_NAME)) as f:
+        entries = json.load(f)["entries"]
+    assert sorted(entries) == ["key2", "key3", "key4"]
+    with art.private_artifact_store(d) as st2:
+        for key in sorted(entries):
+            data, outcome = st2.load_bytes(key)
+            assert outcome == "hit", (key, outcome)

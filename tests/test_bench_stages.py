@@ -104,7 +104,8 @@ def test_main_records_skips_in_json_tail(monkeypatch, tmp_path, capsys):
                  "bench_serve", "bench_pipeline",
                  "bench_pipeline_p99", "bench_autotuned_headline",
                  "bench_precision_gemm", "bench_precision_convolve",
-                 "bench_precision_stft"):
+                 "bench_precision_stft",
+                 "bench_cold_start"):
         monkeypatch.setattr(bench, name,
                             lambda rng, name=name: quick(rng, name))
 
@@ -146,7 +147,8 @@ def test_main_records_skips_in_json_tail(monkeypatch, tmp_path, capsys):
                        "bench_autotuned_headline",
                        "bench_precision_gemm",
                        "bench_precision_convolve",
-                       "bench_precision_stft"]
+                       "bench_precision_stft",
+                       "bench_cold_start"]
     tail = details[-1]
     assert "skipped_stages" in tail
     stages = [s["stage"] for s in tail["skipped_stages"]]
@@ -179,7 +181,8 @@ def _run_main_with_headline(monkeypatch, tmp_path, vs_baseline):
                  "bench_serve", "bench_pipeline",
                  "bench_pipeline_p99", "bench_autotuned_headline",
                  "bench_precision_gemm", "bench_precision_convolve",
-                 "bench_precision_stft"):
+                 "bench_precision_stft",
+                 "bench_cold_start"):
         def mk(name):
             def cfg(rng):
                 return {"metric": name, "unit": "u", "value": 2.0,

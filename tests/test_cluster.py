@@ -96,6 +96,43 @@ class TestGroupLifecycle:
                       if e["op"] == "replica_lifecycle"]
             assert ("kill", "r0") in events
 
+    def test_restart_revives_with_pipelines_and_beat(self, telemetry):
+        """Cold restart (the zero-warmup recovery path): a killed
+        replica revives under the same id, placeable and answering —
+        with the GROUP's pipeline registrations replayed, its
+        last_beat stamped (the staleness monitor must not wedge a
+        just-restarted replica), and a second restart of a live
+        replica refused typed."""
+        sys.path.insert(0, str(REPO / "tools"))
+        import loadgen
+
+        compiled = loadgen.build_pipeline("restartline")
+        with cluster.ReplicaGroup(2, max_wait_ms=2.0,
+                                  obs_port=-1) as group:
+            op = group.register_pipeline("restartline", compiled)
+            group.kill("r0")
+            assert group.alive() == 1
+            fresh = group.restart("r0")
+            assert group.alive() == 2
+            assert fresh.last_beat is not None
+            # the revived replica answers plain ops AND the replayed
+            # pipeline (a fresh Server would otherwise refuse it)
+            sos = iir.butterworth(4, 0.25, "lowpass")
+            t = fresh.server.submit(op="sosfilt", x=_signal(),
+                                    params={"sos": sos})
+            assert t.result(timeout=60.0) is not None
+            x = RNG.randn(compiled.block_len).astype(np.float32)
+            t2 = fresh.server.submit(op=op, x=x,
+                                     params={"state": None})
+            out, state = t2.result(timeout=60.0)
+            assert state is not None
+            events = [(e["decision"], e.get("replica"))
+                      for e in obs.events()
+                      if e["op"] == "replica_lifecycle"]
+            assert ("restart", "r0") in events
+            with pytest.raises(ValueError, match="not dead"):
+                group.restart("r0")
+
     def test_drain_answers_queued_work_then_removes(self, telemetry):
         # a long batching wait keeps the work queued when drain fires
         with cluster.ReplicaGroup(2, max_batch=32, max_wait_ms=500.0,

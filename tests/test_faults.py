@@ -613,7 +613,8 @@ class TestBenchStageRetry:
                      "bench_autotuned_headline",
                      "bench_precision_gemm",
                      "bench_precision_convolve",
-                     "bench_precision_stft"):
+                     "bench_precision_stft",
+                     "bench_cold_start"):
             def mk(name):
                 def cfg(rng):
                     return {"metric": name, "unit": "u", "value": 2.0,

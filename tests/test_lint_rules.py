@@ -1220,3 +1220,109 @@ def _core(a, b):
 def test_precision_rule_flags_bare_jax_lax_import():
     errs = _precision_errs(PRECISION_BARE_JAX_LAX)
     assert any("Precision" in e for e in errs)
+
+
+# --- artifact-serialization rule (zero-warmup PR) ---------------------------
+
+ARTIFACT_CLEAN = '''
+from veles.simd_tpu import obs
+
+
+def _core(x):
+    return x + 1
+
+
+def run(x):
+    with obs.span("demo.dispatch"):
+        return _core(x)
+'''
+
+ARTIFACT_RAW_EXPORT = '''
+import jax
+
+
+def pack(jfn, spec):
+    return jax.export.export(jfn)(spec)
+'''
+
+ARTIFACT_IMPORT_ALIAS = '''
+import jax.export as je
+
+
+def pack(jfn, spec):
+    return je.export(jfn)(spec)
+'''
+
+ARTIFACT_FROM_IMPORT = '''
+from jax.export import deserialize as load_exe
+
+
+def unpack(data):
+    return load_exe(data)
+'''
+
+ARTIFACT_SERIALIZE_CALL = '''
+def pack(exported):
+    return bytes(exported.serialize())
+'''
+
+ARTIFACT_DESERIALIZE_CALL = '''
+def unpack(mod, data):
+    return mod.deserialize(data)
+'''
+
+
+def _artifact_errs(src):
+    return lint.artifact_serialization_errors(ast.parse(src), "m.py")
+
+
+def test_artifact_rule_passes_clean_module():
+    assert _artifact_errs(ARTIFACT_CLEAN) == []
+
+
+def test_artifact_rule_flags_raw_jax_export():
+    errs = _artifact_errs(ARTIFACT_RAW_EXPORT)
+    assert any("jax.export" in e for e in errs)
+
+
+def test_artifact_rule_tracks_import_alias():
+    errs = _artifact_errs(ARTIFACT_IMPORT_ALIAS)
+    assert any("jax.export" in e for e in errs)
+
+
+def test_artifact_rule_tracks_from_import():
+    errs = _artifact_errs(ARTIFACT_FROM_IMPORT)
+    assert errs, "aliased deserialize import must be flagged"
+
+
+def test_artifact_rule_flags_serialize_call():
+    errs = _artifact_errs(ARTIFACT_SERIALIZE_CALL)
+    assert any(".serialize()" in e for e in errs)
+
+
+def test_artifact_rule_flags_deserialize_call():
+    errs = _artifact_errs(ARTIFACT_DESERIALIZE_CALL)
+    assert any(".deserialize()" in e for e in errs)
+
+
+def test_artifact_rule_would_catch_the_store_itself():
+    """The rule has teeth: runtime/artifacts.py — the ONE module
+    allowed to serialize (it is outside the policed directories) —
+    would trip the rule if it ever moved into them."""
+    src = (REPO / "veles/simd_tpu/runtime/artifacts.py").read_text()
+    errs = lint.artifact_serialization_errors(
+        ast.parse(src), "artifacts.py")
+    assert errs, "the store's own serialize/deserialize calls must " \
+                 "be visible to the rule"
+
+
+def test_real_modules_pass_artifact_rule():
+    """Acceptance gate: zero raw serialization calls in the policed
+    layers — every export/deserialize flows through the store."""
+    for sub in ("ops", "parallel", "serve", "pipeline"):
+        for path in sorted(
+                (REPO / "veles/simd_tpu" / sub).glob("*.py")):
+            rel = path.relative_to(REPO).as_posix()
+            errs = lint.artifact_serialization_errors(
+                ast.parse(path.read_text()), rel)
+            assert errs == [], errs

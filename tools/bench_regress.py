@@ -132,6 +132,17 @@ DEFAULT_NOISE = [
     # is a single order statistic of a small per-block sample
     ("pipeline sensor chain", 0.30),
     ("pipeline sensor chain p99", 0.45),
+    # the cold-start family (tools/cold_start.py + bench.py config 17,
+    # COLD_START_DETAILS.json): SUBPROCESS birth-to-first-request wall
+    # clocks — interpreter spawn + imports + compiles under whatever
+    # host contention the run hits — and the headline is a ratio of
+    # two of them.  Wide on purpose; the x2 acceptance bar leaves
+    # plenty of floor under a clean trajectory median.
+    ("cold start", 0.40),
+    # the cold-replica-restart phase of the replicated campaign: one
+    # single-request latency on a just-restarted replica (an order
+    # statistic of ONE sample, chaos_phase-stamped anyway)
+    ("replica restart", 0.50),
 ]
 
 

@@ -1,0 +1,260 @@
+#!/usr/bin/env python
+"""Cold-start bench: process birth -> first request, warm pack vs cold.
+
+The zero-warmup subsystem's acceptance number.  Two SUBPROCESS
+children, each a genuinely fresh process (fresh interpreter, fresh jax
+runtime, empty jit caches), both running the identical body — start a
+``serve.Server``, register the cold-start pipeline, answer one request
+per serving shape class (``tools/warm_pack.serve_param_sets``) — and
+the parent clocks each child's wall time from ``Popen`` to its
+completion report:
+
+* **cold** — ``VELES_SIMD_ARTIFACTS=off``: every class pays full
+  trace+lower+backend-compile before its first answer (what every
+  autoscaled/preempted process paid before this subsystem);
+* **warm** — ``VELES_SIMD_ARTIFACTS=readonly`` + a pack built by
+  ``tools/warm_pack.py``: ``Server.start()`` preloads the serialized
+  executables (backend compiles hit the pack's ``xla_cache``), so the
+  first request dispatches packed programs.
+
+Writes ``COLD_START_DETAILS.json`` in BENCH_DETAILS row format — the
+headline row's value is the SPEEDUP (cold wall / warm wall, higher is
+better; the ``>= 2x`` acceptance bar is ``warm <= 50% of cold``) with
+the warm child's ``artifact_hit/stale/miss`` counters and store stats
+embedded as the row's telemetry evidence.  Gate the trajectory with::
+
+    python tools/bench_regress.py --details COLD_START_DETAILS.json
+
+Run:  python tools/cold_start.py [--details COLD_START_DETAILS.json]
+      [--pack DIR] [--reuse-pack] [--min-speedup X]
+      (bench.py runs this as its cold-start config)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+DEFAULT_DETAILS = "COLD_START_DETAILS.json"
+DEFAULT_PACK = "warm_pack"
+
+
+# ---------------------------------------------------------------------------
+# the child body (--child): one fresh serving process, either mode
+# ---------------------------------------------------------------------------
+
+
+def child_main() -> int:
+    t_birth = time.perf_counter()
+    from veles.simd_tpu.utils.platform import maybe_override_platform
+
+    maybe_override_platform()
+    import numpy as np
+
+    from tools import warm_pack as wp
+    from veles.simd_tpu import obs, serve
+    from veles.simd_tpu.runtime import artifacts
+
+    obs.enable()
+    per_op = {}
+    with serve.Server(max_batch=4, max_wait_ms=1.0, workers=2,
+                      obs_port=-1) as srv:
+        pipe_op = srv.register_pipeline(wp.PIPELINE_NAME,
+                                        wp.build_pipeline())
+        t_ready = time.perf_counter()
+        rng = np.random.RandomState(7)
+        for op, n, params in wp.serve_param_sets():
+            x = rng.randn(n).astype(np.float32)
+            t0 = time.perf_counter()
+            srv.submit(op=op, x=x, params=params).result(timeout=600.0)
+            per_op[op] = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        srv.submit(op=pipe_op,
+                   x=rng.randn(wp.PIPELINE_BLOCK).astype(np.float32),
+                   params={"state": None}).result(timeout=600.0)
+        per_op["pipeline"] = time.perf_counter() - t0
+        preload = srv.stats().get("artifact_preload")
+    t_done = time.perf_counter()
+    snap = obs.snapshot()
+    counters: dict = {}
+    for c in snap["counters"]:       # sum across label sets per name
+        if c["name"].startswith(("artifact_", "compile.")):
+            counters[c["name"]] = counters.get(c["name"], 0) \
+                + c["value"]
+    report = {
+        "mode": artifacts.artifacts_mode(),
+        "birth_to_first_s": t_done - t_birth,
+        "ready_s": t_ready - t_birth,
+        "requests_s": t_done - t_ready,
+        "per_op_s": {k: round(v, 4) for k, v in per_op.items()},
+        "preload": preload,
+        "counters": counters,
+        "artifact_store": artifacts.store().info(),
+    }
+    print("COLD_START_REPORT " + json.dumps(report), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# the parent: spawn, clock, compare, write rows
+# ---------------------------------------------------------------------------
+
+
+def _run_child(extra_env: dict, timeout_s: float) -> dict:
+    """Spawn one fresh child; returns its report with the
+    parent-clocked wall time (``wall_s``: Popen -> report line — the
+    honest process-birth-to-first-request number, interpreter and
+    import time included)."""
+    env = dict(os.environ)
+    env.update({k: str(v) for k, v in extra_env.items()})
+    env.pop("VELES_SIMD_TELEMETRY", None)   # the child enables its own
+    cmd = [sys.executable, os.path.abspath(__file__), "--child"]
+    t0 = time.perf_counter()
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True,
+                            env=env,
+                            cwd=os.path.join(os.path.dirname(
+                                os.path.abspath(__file__)), os.pardir))
+    report = None
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        raise RuntimeError(
+            f"cold-start child did not report within {timeout_s}s")
+    wall = time.perf_counter() - t0
+    for line in out.splitlines():
+        if line.startswith("COLD_START_REPORT "):
+            report = json.loads(line[len("COLD_START_REPORT "):])
+    if report is None or proc.returncode != 0:
+        raise RuntimeError(
+            f"cold-start child failed (rc={proc.returncode}):\n{out}")
+    report["wall_s"] = wall
+    return report
+
+
+def build_pack(pack: str, timeout_s: float) -> None:
+    """Build the warm pack in a subprocess (a fresh process's exports,
+    like production pack builds — the parent never touches jax)."""
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "warm_pack.py"),
+           "--dir", pack, "--quick"]
+    proc = subprocess.run(cmd, timeout=timeout_s,
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"warm_pack failed (rc={proc.returncode}):\n"
+            f"{proc.stdout}\n{proc.stderr}")
+
+
+def run(args) -> tuple:
+    """Build (or reuse) the pack, clock both children, build the
+    BENCH_DETAILS-format rows.  Returns ``(rows, evidence)``."""
+    pack = os.path.abspath(args.pack)
+    if not (args.reuse_pack
+            and os.path.exists(os.path.join(pack, "MANIFEST.json"))):
+        print(f"building warm pack at {pack} ...", flush=True)
+        build_pack(pack, args.timeout)
+    print("cold child (VELES_SIMD_ARTIFACTS=off) ...", flush=True)
+    cold = _run_child({"VELES_SIMD_ARTIFACTS": "off",
+                       "VELES_SIMD_ARTIFACT_DIR": ""}, args.timeout)
+    print(f"  cold birth->first: {cold['wall_s']:.2f}s", flush=True)
+    print("warm child (VELES_SIMD_ARTIFACTS=readonly) ...", flush=True)
+    warm = _run_child({"VELES_SIMD_ARTIFACTS": "readonly",
+                       "VELES_SIMD_ARTIFACT_DIR": pack}, args.timeout)
+    print(f"  warm birth->first: {warm['wall_s']:.2f}s", flush=True)
+    speedup = cold["wall_s"] / warm["wall_s"] if warm["wall_s"] else 0.0
+    warm_counters = warm.get("counters", {})
+    evidence = {
+        "pack": pack,
+        "cold": cold,
+        "warm": warm,
+        "speedup": speedup,
+        "warm_fraction_of_cold": (warm["wall_s"] / cold["wall_s"]
+                                  if cold["wall_s"] else None),
+    }
+    # the acceptance row: speedup (higher is better), with the warm
+    # child's artifact hit/stale/miss traffic as embedded evidence —
+    # a "speedup" produced without artifact hits would be a lie the
+    # telemetry exposes
+    rows = [
+        {"metric": "cold start warm-pack speedup",
+         "value": round(speedup, 3), "unit": "x",
+         "vs_baseline": None,
+         "telemetry": {
+             "artifact_counters": {
+                 k: v for k, v in warm_counters.items()
+                 if k.startswith("artifact_")},
+             "compile_counters": {
+                 k: v for k, v in warm_counters.items()
+                 if k.startswith("compile.")},
+             "artifact_store": warm.get("artifact_store"),
+             "preload": warm.get("preload"),
+             "cold_wall_s": round(cold["wall_s"], 3),
+             "warm_wall_s": round(warm["wall_s"], 3),
+         }},
+        {"metric": "cold start warm first request",
+         "value": round(1.0 / warm["wall_s"], 4), "unit": "1/s",
+         "vs_baseline": None},
+        {"metric": "cold start cold first request",
+         "value": round(1.0 / cold["wall_s"], 4), "unit": "1/s",
+         "vs_baseline": None},
+    ]
+    return rows, evidence
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--details", default=DEFAULT_DETAILS,
+                    help=f"row output (default {DEFAULT_DETAILS})")
+    ap.add_argument("--pack", default=DEFAULT_PACK,
+                    help=f"warm-pack directory (default "
+                         f"{DEFAULT_PACK}/)")
+    ap.add_argument("--reuse-pack", action="store_true",
+                    help="skip the pack build when one exists")
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="per-subprocess budget, seconds")
+    ap.add_argument("--min-speedup", type=float, default=0.0,
+                    help="rc=1 when cold/warm falls below this "
+                         "(0 = report only; 2.0 is the acceptance "
+                         "bar: warm <= 50%% of cold)")
+    args = ap.parse_args(argv)
+    if args.child:
+        return child_main()
+    rows, evidence = run(args)
+    with open(args.details, "w") as f:
+        json.dump(rows + [{"cold_start_evidence": evidence}], f,
+                  indent=2)
+    speedup = evidence["speedup"]
+    hits = sum(v for k, v in rows[0]["telemetry"]
+               ["artifact_counters"].items()
+               if k.startswith("artifact_hit"))
+    print(f"\ncold {evidence['cold']['wall_s']:.2f}s -> warm "
+          f"{evidence['warm']['wall_s']:.2f}s  speedup x{speedup:.2f} "
+          f"(warm = {100 * evidence['warm_fraction_of_cold']:.0f}% "
+          f"of cold), {hits} artifact hits")
+    print(f"rows -> {args.details}  (gate: python "
+          f"tools/bench_regress.py --details {args.details})")
+    if hits == 0:
+        print("COLD-START-WARN: warm child recorded ZERO artifact "
+              "hits — the pack did not cover the request set",
+              file=sys.stderr)
+        return 1
+    if args.min_speedup and speedup < args.min_speedup:
+        print(f"COLD-START-FAIL: speedup x{speedup:.2f} < "
+              f"x{args.min_speedup:.2f}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

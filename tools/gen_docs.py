@@ -63,6 +63,7 @@ MODULES = [
     "veles.simd_tpu.runtime.breaker",
     "veles.simd_tpu.runtime.routing",
     "veles.simd_tpu.runtime.precision",
+    "veles.simd_tpu.runtime.artifacts",
     "veles.simd_tpu.obs",
     "veles.simd_tpu.obs.spans",
     "veles.simd_tpu.obs.resources",

@@ -307,6 +307,83 @@ def precision_literal_errors(tree, fname) -> list:
     return errors
 
 
+# --- artifact-serialization rule --------------------------------------------
+# The AOT artifact store (veles/simd_tpu/runtime/artifacts.py) is the
+# ONE home of executable serialization: its stamps (schema, jax/jaxlib
+# version, device_kind, per-entry device count, per-file sha256) are
+# what keep a serialized program from silently loading into the wrong
+# runtime, and its counters are what make a stale pack diagnosable.  A
+# raw ``jax.export`` / ``.serialize()`` / ``deserialize`` call in
+# ops//parallel//serve//pipeline bypasses every one of those
+# protections — this rule keeps serialization out of those layers,
+# alias-tracked like the precision and routing rules (``import
+# jax.export as je`` / ``from jax.export import deserialize as d``
+# cannot dodge it).
+
+_ARTIFACT_MOD = "veles.simd_tpu.runtime.artifacts"
+
+
+def artifact_serialization_errors(tree, fname) -> list:
+    """The rule body on a parsed module (separated so tests can feed
+    synthetic sources).  Returns human-readable error strings."""
+    errors = []
+    jax_aliases, export_mods, export_names = set(), set(), set()
+    go_through = ("executable serialization belongs to the artifact "
+                  "store (runtime/artifacts.py: lookup_runner / "
+                  "export_and_store), whose stamps and counters a "
+                  "raw call bypasses")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax":
+                    jax_aliases.add(a.asname or "jax")
+                elif a.name == "jax.export":
+                    errors.append(
+                        f"{fname}:{node.lineno}: raw jax.export "
+                        f"import in a compute/serving module — "
+                        f"{go_through}")
+                    if a.asname:
+                        export_mods.add(a.asname)
+                    else:
+                        jax_aliases.add("jax")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "export":
+                        errors.append(
+                            f"{fname}:{node.lineno}: raw jax.export "
+                            f"import in a compute/serving module — "
+                            f"{go_through}")
+                        export_mods.add(a.asname or a.name)
+            elif node.module == "jax.export":
+                errors.append(
+                    f"{fname}:{node.lineno}: raw jax.export import "
+                    f"in a compute/serving module — {go_through}")
+                for a in node.names:
+                    export_names.add(a.asname or a.name)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr == "export" \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in jax_aliases:
+            errors.append(
+                f"{fname}:{node.lineno}: raw jax.export access in a "
+                f"compute/serving module — {go_through}")
+        elif (isinstance(node, ast.Name)
+                and node.id in (export_mods | export_names)
+                and isinstance(node.ctx, ast.Load)):
+            errors.append(
+                f"{fname}:{node.lineno}: raw jax.export usage "
+                f"({node.id}) in a compute/serving module — "
+                f"{go_through}")
+        elif (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("serialize", "deserialize")):
+            errors.append(
+                f"{fname}:{node.lineno}: raw .{node.func.attr}() "
+                f"call in a compute/serving module — {go_through}")
+    return errors
+
+
 # --- routing-engine rule ----------------------------------------------------
 # PR 7 moved every hand-rolled route selector (convolve._use_pallas_os,
 # wavelet._use_pallas, spectral._use_matmul_dft, ...) into declarative
@@ -1222,6 +1299,9 @@ def compute_module_lint(files) -> int:
                 for msg in cluster_router_errors(tree, str(f)):
                     print(msg)
                     failures += 1
+            for msg in artifact_serialization_errors(tree, str(f)):
+                print(msg)
+                failures += 1
             continue
         if in_pipeline:
             # the pipeline package takes its own structural contract
@@ -1253,6 +1333,9 @@ def compute_module_lint(files) -> int:
             for msg in precision_literal_errors(tree, str(f)):
                 print(msg)
                 failures += 1
+        for msg in artifact_serialization_errors(tree, str(f)):
+            print(msg)
+            failures += 1
         aliases = set()
         time_aliases = set()
         jax_aliases = set()

@@ -76,6 +76,44 @@ def _metric_lines(prom: str) -> list:
     return rows
 
 
+def _compile_lines(prom: str) -> list:
+    """Warm-vs-cold compile tallies out of a /metrics scrape: how many
+    executables this process LOADED (artifact hits + persistent-cache
+    hits) vs COMPILED cold (artifact misses/stale/load errors +
+    backend compiles that missed the cache) — the zero-warmup
+    subsystem's at-a-glance scoreboard."""
+    parsed = export.parse_prometheus(prom)
+    tally: dict = {}
+    for (name, labels), value in parsed.items():
+        short = name.replace(export.PROMETHEUS_PREFIX, "")
+        if short.endswith("_total"):        # counter suffix
+            short = short[:-len("_total")]
+        if short.startswith("artifact_") or \
+                short.startswith("compile_"):
+            if short.endswith(("_bucket", "_sum", "_count")):
+                continue
+            tally[short] = tally.get(short, 0) + value
+    if not tally:
+        return []
+    # NB: artifact_preload is NOT summed into warm — every preloaded
+    # entry that later dispatches also counts an artifact_hit, and
+    # double-counting would overstate warm coverage
+    warm = (tally.get("artifact_hit", 0)
+            + tally.get("compile_cache_hits", 0))
+    cold = (tally.get("artifact_miss", 0)
+            + tally.get("artifact_stale", 0)
+            + tally.get("artifact_load_error", 0)
+            + tally.get("compile_cache_misses", 0))
+    lines = ["compiles (warm vs cold): loaded=%g cold=%g"
+             % (warm, cold)]
+    for k in sorted(tally):
+        if k.startswith("artifact_") or k in (
+                "compile_cache_hits", "compile_cache_misses",
+                "compile_backend_compile"):
+            lines.append("  %-52s %12g" % (k, tally[k]))
+    return lines
+
+
 def render(base_url: str) -> tuple:
     """One dashboard frame; returns ``(text, reachable)``."""
     lines = [f"== obs dash @ {base_url} =="]
@@ -115,6 +153,7 @@ def render(base_url: str) -> tuple:
     if rows:
         lines.append("metrics:")
         lines += rows
+    lines += _compile_lines(prom)
     try:
         r = json.loads(reqs)
         summary = r.get("summary", {})

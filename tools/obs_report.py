@@ -65,6 +65,38 @@ def _latency_section(snap) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _artifact_section(snap) -> str:
+    """The AOT artifact store (zero-warmup cold start): path, mode,
+    and hit/miss/stale/eviction traffic — rendered beside the tune
+    cache whenever the store is bound or saw traffic."""
+    caches = (snap.get("caches") or {})
+    st = caches.get("artifact_store")
+    if not isinstance(st, dict):
+        return ""
+    traffic = sum(int(st.get(k) or 0) for k in
+                  ("hits", "misses", "stale", "load_errors", "stores",
+                   "preloaded"))
+    if st.get("path") is None and not traffic:
+        return ""
+    lines = ["", "artifact store (AOT warm pack):",
+             "  path=%s  mode=%s  entries=%s/%s  runners=%s"
+             % (st.get("path"), st.get("mode"), st.get("size"),
+                st.get("capacity"), st.get("runners")),
+             "  hits=%s misses=%s stale=%s load_errors=%s stores=%s "
+             "evictions=%s preloaded=%s"
+             % tuple(st.get(k, 0) for k in
+                     ("hits", "misses", "stale", "load_errors",
+                      "stores", "evictions", "preloaded"))]
+    refused = {k: st[k] for k in ("write_refused", "save_refused",
+                                  "export_unsupported")
+               if st.get(k)}
+    if refused:
+        lines.append("  " + "  ".join("%s=%s" % kv
+                                      for kv in sorted(
+                                          refused.items())))
+    return "\n".join(lines) + "\n"
+
+
 def _serving_section(snap) -> str:
     """The serving layer's story (obs v4): depths, outcome tallies
     with shed/miss rates, per-(op, status) request-latency quantiles,
@@ -214,6 +246,7 @@ def main(argv=None) -> int:
         return 0
     sys.stdout.write(export.report(data, max_events=50))
     sys.stdout.write(_latency_section(data))
+    sys.stdout.write(_artifact_section(data))
     sys.stdout.write(_serving_section(data))
     return 0
 

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Build a pre-warmed AOT artifact pack: export, stamp, ship.
+
+The tune-cache pack (``make autotune-pack``) ships route *decisions*;
+this tool ships the *executables*.  It arms the artifact store
+(``runtime/artifacts.py``) in ``on`` mode and drives the serving shape
+classes — the batched entry points exactly as ``serve.Server``
+dispatches them (pow2 bucket lengths x pow2 row classes x the standard
+op parameter sets), plus a compiled pipeline — so every program a
+fresh serving process would trace+compile on its first requests is
+exported into the pack instead.  The routed entry points consult the
+same ``routing.family`` tables the autotuner probes, so the packed
+artifacts are the executables dispatch actually runs (an autotuned
+pack bound via ``VELES_SIMD_AUTOTUNE_CACHE`` steers which route gets
+exported, exactly as it steers live dispatch).  A final
+``artifacts.preload()`` deserializes and AOT-compiles every entry,
+which also seeds the pack's persistent-XLA-cache leg
+(``<pack>/xla_cache``) with the very modules warm processes compile —
+their backend compiles become disk reads.
+
+Ship the directory and point services at it::
+
+    VELES_SIMD_ARTIFACTS=readonly \\
+    VELES_SIMD_ARTIFACT_DIR=/etc/veles/warm_pack serve.py
+
+``serve.Server.start()`` (and subprocess replicas) then preload it so
+the first request hits steady-state p99 — ``tools/cold_start.py``
+measures the win and ``make chaos-replicas`` gates the replica-restart
+form of it.
+
+Run:  python tools/warm_pack.py [--dir warm_pack] [--quick]
+      [--rows 1,2,4,8] (or ``make warm-pack``)
+      VELES_SIMD_PLATFORM=cpu ... validates plumbing; build packs on
+      the device generation that will serve them (the store's stamps
+      refuse cross-device loads).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from veles.simd_tpu.utils.platform import maybe_override_platform  # noqa: E402
+
+# the canonical serving shape classes — ONE definition shared with
+# tools/cold_start.py so the pack covers exactly the request set the
+# cold-start bench replays: (op, signal length, params builder).
+# Lengths are already pow2 bucket sizes (serve pads to them anyway).
+DEFAULT_ROWS = (1, 2, 4, 8)
+QUICK_ROWS = (1,)
+
+# the cold-start pipeline: a small conditioning chain compiled at this
+# block size and registered under this name (the artifact key is the
+# pipeline's (name, block_len) identity)
+PIPELINE_NAME = "coldline"
+PIPELINE_BLOCK = 2048
+
+
+def serve_param_sets():
+    """``[(op, bucket_len, params), ...]`` — the serving classes the
+    pack covers and the cold-start bench replays.  Parameters are
+    deterministic (they are part of the batched handle keys, so the
+    builder and the replayer must agree bit-for-bit)."""
+    from veles.simd_tpu.ops import iir
+
+    sos = iir.butterworth(6, 0.2, "lowpass")
+    return [
+        ("sosfilt", 4096, {"sos": np.asarray(sos)}),
+        ("lfilter", 4096, {"b": [1.0, 0.5], "a": [1.0, -0.3]}),
+        ("resample_poly", 4096, {"up": 160, "down": 147}),
+        ("stft", 16384, {"frame_length": 512, "hop": 128}),
+    ]
+
+
+def build_pipeline():
+    """The cold-start pipeline chain (deterministic — same stages,
+    name, and block size in the builder and the replayer)."""
+    from veles.simd_tpu import pipeline as pl
+    from veles.simd_tpu.ops import iir
+
+    notch = iir.butterworth(4, (44 / 1000.0, 56 / 1000.0), "bandstop")
+    chain = pl.Pipeline(
+        [pl.sosfilt(notch), pl.stft(256, 64), pl.power()],
+        name=PIPELINE_NAME)
+    return chain.compile(PIPELINE_BLOCK)
+
+
+def drive(rows=DEFAULT_ROWS, include_pipeline: bool = True,
+          log=print) -> None:
+    """Dispatch every serving class once per row class — with the
+    store in ``on`` mode each compile exports itself into the pack."""
+    from veles.simd_tpu.ops import batched
+
+    for op, n, params in serve_param_sets():
+        for r in rows:
+            x = np.zeros((int(r), int(n)), np.float32)
+            if op == "sosfilt":
+                batched.batched_sosfilt(params["sos"], x, simd=True)
+            elif op == "lfilter":
+                batched.batched_lfilter(params["b"], params["a"], x,
+                                        simd=True)
+            elif op == "resample_poly":
+                batched.batched_resample_poly(
+                    x, params["up"], params["down"], simd=True)
+            elif op == "stft":
+                batched.batched_stft(x, params["frame_length"],
+                                     params["hop"], simd=True)
+        log(f"  {op} n={n} rows={list(rows)}: exported")
+    if include_pipeline:
+        cp = build_pipeline()
+        # the direct-caller geometry (one unbatched block) AND the
+        # serving geometry (row-batched block + batched state — what
+        # Server._run_pipeline_batch dispatches) — each is its own
+        # compiled program, so each is its own pack entry
+        cp.process(np.zeros(PIPELINE_BLOCK, np.float32),
+                   cp.init_state())
+        for r in rows:
+            cp.serve_step(np.zeros((int(r), PIPELINE_BLOCK),
+                                   np.float32),
+                          cp.batch_states([None] * int(r), int(r)))
+        log(f"  pipeline {PIPELINE_NAME} block={PIPELINE_BLOCK} "
+            f"rows={list(rows)}: exported")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--dir", default="warm_pack",
+                        help="artifact-pack directory to build "
+                             "(default warm_pack/)")
+    parser.add_argument("--quick", action="store_true",
+                        help="row class 1 only (the cold-start "
+                             "bench's request-at-a-time shape)")
+    parser.add_argument("--rows", default=None,
+                        help="comma-separated batch row classes "
+                             "(default 1,2,4,8)")
+    parser.add_argument("--no-pipeline", action="store_true",
+                        help="skip the pipeline entry")
+    args = parser.parse_args(argv)
+    if args.rows:
+        rows = tuple(int(v) for v in args.rows.split(",") if v.strip())
+    else:
+        rows = QUICK_ROWS if args.quick else DEFAULT_ROWS
+    maybe_override_platform()
+
+    from veles.simd_tpu import obs
+    from veles.simd_tpu.runtime import artifacts
+
+    artifacts.set_artifact_dir(args.dir)
+    obs.enable()
+    try:
+        import jax
+
+        print(f"device: {jax.devices()[0]}  pack: {args.dir}",
+              flush=True)
+        with artifacts.artifacts_mode_override("on"):
+            drive(rows, include_pipeline=not args.no_pipeline)
+            # deserialize+compile every entry NOW: proves each payload
+            # round-trips AND seeds <pack>/xla_cache with the loader
+            # modules, so a warm process's AOT compiles are disk reads
+            report = artifacts.preload()
+    finally:
+        artifacts.set_artifact_dir(None)
+    st_info = {k: v for k, v in artifacts.ArtifactStore(
+        args.dir).info().items() if k not in ("mode",)}
+    print(f"\npack {args.dir}: {st_info['size']} entries "
+          f"(schema {artifacts.ARTIFACT_SCHEMA}, "
+          f"jax {artifacts.version_stamp()}, "
+          f"device {artifacts.device_stamp()})")
+    print(f"preload check: {report['loaded']} loaded, "
+          f"{report['failed']} failed")
+    print(json.dumps(st_info, indent=1, sort_keys=True))
+    return 1 if (report["failed"] or not report["loaded"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,7 +16,7 @@ import itertools
 import os
 import threading
 
-__all__ = ["atomic_write_text"]
+__all__ = ["atomic_write_text", "atomic_write_bytes"]
 
 _TMP_SEQ = itertools.count()
 
@@ -31,11 +31,22 @@ def atomic_write_text(path: str, text: str) -> str:
     is a complete string) the only failure modes left are filesystem
     ones, and those leave the previous file intact.
     """
+    return atomic_write_bytes(path, text.encode("utf-8"))
+
+
+def atomic_write_bytes(path: str, data: bytes) -> str:
+    """Binary form of the shared writer — the artifact store's
+    serialized executables (:mod:`veles.simd_tpu.runtime.artifacts`)
+    ride the same temp+``os.replace`` discipline, so a crash mid-write
+    can never leave a torn ``.bin`` where a loader expects a complete
+    one (the loader's sha256 gate is the second line of defense).
+    :func:`atomic_write_text` delegates here: one copy of the
+    discipline, not two to keep in sync."""
     tmp = "%s.%d.%d.%d.tmp" % (path, os.getpid(),
                                threading.get_ident(), next(_TMP_SEQ))
     try:
-        with open(tmp, "w") as f:
-            f.write(text)
+        with open(tmp, "wb") as f:
+            f.write(data)
         os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):  # the write itself failed mid-flight

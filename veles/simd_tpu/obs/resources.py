@@ -19,6 +19,13 @@ bandwidth-bound.  Two pieces:
   bytes) into the resource registry keyed by ``(op, route)``.  Calls
   made under an outer trace skip the harvest (a tracer has no concrete
   buffers to lower against); telemetry off costs one flag check.
+  Being the single compile site also makes it the single LOAD site:
+  when the AOT artifact store is armed
+  (:mod:`veles.simd_tpu.runtime.artifacts`,
+  ``VELES_SIMD_ARTIFACTS=on|readonly``), the first call per geometry
+  consults the store before tracing — a hit dispatches the packed
+  executable (``artifact_hit``/``artifact`` decision event), a miss
+  in ``on`` mode exports the fresh compile back into the pack.
 
 * a **cache-introspection registry** — every memoized compile cache in
   the library (the batched handle LRU, the pallas2d OOM-rejection
@@ -102,6 +109,25 @@ def jsonify(value):
 # registered cache, so it shows up in :func:`caches_snapshot` like
 # every other compile cache)
 _ANALYZED = LRUSet(ANALYSIS_MEMO_MAXSIZE)
+
+# per-wrapper artifact-decision memo bound: one verdict (a loaded
+# runner, or "use the fresh compile") per argument geometry
+_ARTIFACT_MEMO_MAXSIZE = 256
+
+# the artifact subsystem (runtime/artifacts.py), imported lazily ONCE:
+# the obs package must stay importable without the runtime package
+# resolved, and the artifact path is one `.artifacts_mode()` attribute
+# call per dispatch once bound
+_ARTIFACTS_MOD = None
+
+
+def _artifacts():
+    global _ARTIFACTS_MOD
+    if _ARTIFACTS_MOD is None:
+        from veles.simd_tpu.runtime import artifacts as _a
+
+        _ARTIFACTS_MOD = _a
+    return _ARTIFACTS_MOD
 
 # monotonic wrapper ids keying the memo (see InstrumentedJit._token)
 _INSTANCE_SEQ = itertools.count()
@@ -321,9 +347,11 @@ class InstrumentedJit:
     """
 
     __slots__ = ("_jfn", "fn", "op", "route", "_statics_by_value",
-                 "_token", "__dict__")
+                 "_token", "_artifact_ident", "_artifact_memo",
+                 "__dict__")
 
-    def __init__(self, fn, op=None, route=None, **jit_kwargs):
+    def __init__(self, fn, op=None, route=None, artifact_key=None,
+                 **jit_kwargs):
         import functools
 
         import jax
@@ -335,6 +363,24 @@ class InstrumentedJit:
         self._statics_by_value = bool(
             jit_kwargs.get("static_argnames")
             or jit_kwargs.get("static_argnums"))
+        # the artifact-store identity of this wrapper's program, or
+        # None when the site cannot be keyed safely across processes.
+        # ``artifact_key`` is the caller's own cache key (the batched
+        # handle-LRU key, a pipeline's (name, block_len)) — REQUIRED
+        # for closures, whose baked-in parameters are invisible to any
+        # fingerprint we could take.  Module-level functions without
+        # free variables self-identify by qualname + a bytecode
+        # digest (so an edited function body invalidates its packed
+        # executables).  Donating or static-arg wrappers are excluded:
+        # donation does not survive the export round trip, and a
+        # static-baking wrapper's loaded runner would take a different
+        # call convention.  Excluded sites stay covered by the
+        # persistent-compile-cache leg.
+        self._artifact_ident = _artifact_ident(
+            fn, artifact_key, self._statics_by_value,
+            bool(jit_kwargs.get("donate_argnums")
+                 or jit_kwargs.get("donate_argnames")))
+        self._artifact_memo = {}
         # per-instance memo token: two wrappers sharing (op, route) —
         # e.g. batched builder closures baking different up/down into
         # the SAME-shaped call, or data_parallel around two user fns —
@@ -349,13 +395,72 @@ class InstrumentedJit:
             pass
 
     def __call__(self, *args, **kwargs):
-        if not _ACTIVE:
+        art = (self._artifact_ident is not None
+               and _artifacts().artifacts_mode() != "off")
+        if not _ACTIVE and not art:
             return self._jfn(*args, **kwargs)
         key = _abstract_key(args, kwargs, self._statics_by_value)
-        if key is not None and not _ANALYZED.check_and_add(
+        runner = None
+        if art and key is not None:
+            runner = self._artifact_runner(key, args, kwargs)
+        if _ACTIVE and key is not None and not _ANALYZED.check_and_add(
                 (self._token, key)):
-            self._analyze(args, kwargs, key)
+            if runner is not None:
+                # the packed executable IS the compiled program this
+                # geometry dispatches: harvest its analytics directly
+                # — re-tracing self._jfn for the AOT harvest would pay
+                # exactly the compile the artifact load just skipped
+                self._harvest_compiled(runner, key)
+            else:
+                self._analyze(args, kwargs, key)
+        if runner is not None:
+            try:
+                return runner(*args, **kwargs)
+            except Exception:  # noqa: BLE001 — a packed program that
+                # cannot execute here (layout/device drift the stamps
+                # missed) must degrade to the fresh compile, never
+                # fault dispatch
+                from veles.simd_tpu import obs as _obs
+
+                _obs.count("artifact_exec_error", op=self.op,
+                           route=self.route)
+                self._artifact_memo[key] = None
         return self._jfn(*args, **kwargs)
+
+    def _artifact_runner(self, key, args, kwargs):
+        """The load-before-compile path: first encounter of a
+        geometry consults the artifact store (hit -> dispatch runs the
+        packed executable; miss in ``on`` mode -> export this
+        wrapper's fresh compile back into the store), every outcome a
+        counter (``artifact_hit/miss/stale/load_error``) and an
+        ``artifact`` decision event.  The verdict is memoized per
+        geometry — steady state is one dict probe."""
+        memo = self._artifact_memo
+        if key in memo:
+            return memo[key]
+        from veles.simd_tpu import obs as _obs
+
+        art = _artifacts()
+        runner = None
+        if all(d[0] == "a" for d in key[1]):
+            skey = "|".join((self.op, self.route, self._artifact_ident,
+                             key[0], repr(key[1])))
+            runner, outcome = art.lookup_runner(skey)
+            _obs.count(f"artifact_{outcome}", op=self.op,
+                       route=self.route)
+            stored = None
+            if runner is None and art.artifacts_mode() == "on":
+                stored = art.export_and_store(
+                    self._jfn, skey, args, kwargs, op=self.op,
+                    route=self.route)
+            _obs.record_decision(
+                "artifact", outcome, site=self.op, route=self.route,
+                shapes=_shapes_str(key),
+                **({"stored": stored} if stored is not None else {}))
+        if len(memo) >= _ARTIFACT_MEMO_MAXSIZE:
+            memo.pop(next(iter(memo)))
+        memo[key] = runner
+        return runner
 
     def lower(self, *args, **kwargs):
         """AOT lowering passthrough (``jax.jit(fn).lower``)."""
@@ -371,6 +476,13 @@ class InstrumentedJit:
             record_resources(self.op, self.route, _shapes_str(key),
                              None, None)
             return
+        self._harvest_compiled(compiled, key)
+
+    def _harvest_compiled(self, compiled, key) -> None:
+        """Fold one already-compiled executable's analytics into the
+        registry (shared by the fresh-AOT path and the artifact-loaded
+        path — a packed runner reports the same ``cost_analysis()`` /
+        ``memory_analysis()`` surface)."""
         cost = mem = None
         try:
             ca = compiled.cost_analysis()
@@ -391,6 +503,51 @@ class InstrumentedJit:
                 f"fn={getattr(self.fn, '__name__', self.fn)!r})")
 
 
+def _artifact_ident(fn, artifact_key, statics: bool,
+                    donates: bool) -> str | None:
+    """The cross-process identity of a wrapper's program for the
+    artifact store, or None when the site cannot be keyed safely.
+
+    An explicit ``artifact_key`` (the caller's own compile-cache key)
+    always wins — it is the only safe identity for closures, whose
+    baked-in parameters (filter taps, up/down factors) produce
+    different programs from identical-looking calls.  Without one, a
+    module-level function with no free variables identifies as
+    ``module.qualname@<bytecode digest>`` — the digest ties packed
+    executables to the function BODY, so editing it invalidates them.
+    Static-baking and donating wrappers return None EVEN WITH an
+    explicit key (a loaded runner takes a different call convention
+    than a static-baking wrapper, and donation does not survive the
+    export round trip — silently dropping an opted-in memory
+    optimization would be worse than a cold compile); closures
+    without an explicit key return None too."""
+    if statics or donates:
+        return None
+    if artifact_key is not None:
+        return f"k:{artifact_key}"
+    code = getattr(fn, "__code__", None)
+    if code is None or getattr(fn, "__closure__", None):
+        return None
+    import hashlib
+
+    code_t = type(code)
+
+    def stable(c):
+        # a nested code object's repr carries a memory address —
+        # recurse into its bytecode instead, so the digest is
+        # deterministic across processes
+        if isinstance(c, code_t):
+            return ("code", c.co_code,
+                    tuple(stable(x) for x in c.co_consts))
+        return repr(c)
+
+    digest = hashlib.sha256(
+        repr((code.co_code, stable(code)[2],
+              code.co_names)).encode()).hexdigest()[:16]
+    return (f"f:{getattr(fn, '__module__', '?')}."
+            f"{getattr(fn, '__qualname__', '?')}@{digest}")
+
+
 def _shapes_str(key) -> str:
     """Compact human form of an abstract signature for snapshots."""
     parts = []
@@ -403,7 +560,8 @@ def _shapes_str(key) -> str:
     return " ".join(parts)
 
 
-def instrumented_jit(fn=None, *, op=None, route=None, **jit_kwargs):
+def instrumented_jit(fn=None, *, op=None, route=None,
+                     artifact_key=None, **jit_kwargs):
     """The library's compile site: ``jax.jit`` with resource capture.
 
     Usable exactly like ``jax.jit`` — bare decorator, decorator
@@ -412,9 +570,19 @@ def instrumented_jit(fn=None, *, op=None, route=None, **jit_kwargs):
     function's name, route "default").  All other keyword arguments
     (``static_argnames``, ``donate_argnums``, ...) pass through to
     ``jax.jit`` untouched.
+
+    ``artifact_key`` opts a CLOSURE-built site into the AOT artifact
+    store (:mod:`veles.simd_tpu.runtime.artifacts`): pass the site's
+    own compile-cache key (the batched handle-LRU key, a pipeline's
+    ``(name, block_len)``) so packed executables are keyed exactly
+    like the in-memory handles.  Module-level functions participate
+    automatically; see :class:`InstrumentedJit`.
     """
     if fn is None:
         def deco(f):
-            return InstrumentedJit(f, op=op, route=route, **jit_kwargs)
+            return InstrumentedJit(f, op=op, route=route,
+                                   artifact_key=artifact_key,
+                                   **jit_kwargs)
         return deco
-    return InstrumentedJit(fn, op=op, route=route, **jit_kwargs)
+    return InstrumentedJit(fn, op=op, route=route,
+                           artifact_key=artifact_key, **jit_kwargs)

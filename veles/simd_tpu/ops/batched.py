@@ -255,6 +255,7 @@ def batched_resample_poly(x, up: int, down: int, taps=None, simd=None,
 
         return obs.instrumented_jit(run, op="batched_resample_poly",
                                     route="batched",
+                                    artifact_key=repr(key),
                                     donate_argnums=donation)
 
     with obs.span("batched.resample_poly.dispatch"):
@@ -301,6 +302,7 @@ def batched_sosfilt(sos, x, simd=None, donate: bool = False):
 
         return obs.instrumented_jit(run, op="batched_sosfilt",
                                     route="batched",
+                                    artifact_key=repr(key),
                                     donate_argnums=donation)
 
     with obs.span("batched.sosfilt.dispatch"):
@@ -343,6 +345,7 @@ def batched_lfilter(b, a, x, simd=None, donate: bool = False):
 
         return obs.instrumented_jit(run, op="batched_lfilter",
                                     route="batched",
+                                    artifact_key=repr(key),
                                     donate_argnums=donation)
 
     with obs.span("batched.lfilter.dispatch"):
@@ -414,7 +417,8 @@ def batched_stft(x, frame_length: int, hop: int, window=None,
                 return jnp.fft.rfft(fr * w, axis=-1)
 
         return obs.instrumented_jit(run, op="batched_stft",
-                                    route=route)
+                                    route=route,
+                                    artifact_key=repr(key))
 
     with obs.span("batched.stft.dispatch"):
         handle = _get_handle(key, build)

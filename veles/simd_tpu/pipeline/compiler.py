@@ -166,9 +166,16 @@ class CompiledPipeline:
                 new_states.append(s2)
             return y, tuple(new_states)
 
-        # THE fused step: one compiled program, one dispatch per block
-        self._step = obs.instrumented_jit(_step, op="pipeline",
-                                          route=self.name)
+        # THE fused step: one compiled program, one dispatch per block.
+        # The artifact key is the pipeline's serving identity — ONE
+        # store entry per (name, block_len), so a warm pack built from
+        # the same declared chain hands a fresh process the fused
+        # executable before the first block ever traces (the stage
+        # list itself is closure state the generic fingerprint cannot
+        # see, which is exactly what the explicit key is for)
+        self._step = obs.instrumented_jit(
+            _step, op="pipeline", route=self.name,
+            artifact_key=f"pipeline:{self.name}:{self.block_len}")
         # the honest-comparison twin: the SAME stage kernels, one
         # dispatch per stage per block (what the chain cost before
         # fusing) — built lazily, only the bench/examples pay for it

@@ -15,14 +15,19 @@ unified routing engine: declarative candidate-route tables, the
 shared selector, and the measured autotuner with its persistent tune
 cache — plus :mod:`~veles.simd_tpu.runtime.precision`, the
 compensated-precision matmul layer (``bf16_comp``/``int8`` route
-primitives and the one home of every raw MXU-precision literal).
+primitives and the one home of every raw MXU-precision literal) — and
+:mod:`~veles.simd_tpu.runtime.artifacts`, the AOT artifact store:
+``jax.export``-serialized executables shipped as stamped warm packs
+(plus the persistent-XLA-cache leg), loaded before compile so a fresh
+process's first request hits steady-state latency.
 """
 
+from veles.simd_tpu.runtime import artifacts
 from veles.simd_tpu.runtime import breaker
 from veles.simd_tpu.runtime import faults
 from veles.simd_tpu.runtime import routing
 
-__all__ = ["breaker", "faults", "precision", "routing"]
+__all__ = ["artifacts", "breaker", "faults", "precision", "routing"]
 
 
 def __getattr__(name):

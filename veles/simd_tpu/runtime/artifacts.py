@@ -1,0 +1,706 @@
+"""AOT artifact store: serialized executables, shipped like tune packs.
+
+At fleet scale processes are born constantly (autoscaling, preemption
+recovery — the chaos campaigns' replica-kill phase is the rehearsal),
+and every fresh process used to pay full trace+compile per (op, route,
+geometry) before its first fast request — exactly when SLAs are
+tightest.  arXiv:1810.09868's whole-program AOT compilation to TPU is
+the model, and TINA (arXiv:2408.16551) makes the same case: the wins
+live in shipping pre-mapped accelerator programs, not re-deriving them
+at runtime.  This module extends the tune-cache pack discipline
+(version/device-stamped, atomic writes, readonly mode —
+``runtime/routing.py``) from route *decisions* to the *executables*
+themselves:
+
+* **the artifact store** — a directory of ``jax.export``-serialized
+  executables plus one ``MANIFEST.json``, keyed exactly like the
+  compiled-handle caches (op + route + the site's own cache key + the
+  call's abstract geometry) and stamped like the
+  :class:`~veles.simd_tpu.runtime.routing.TuneCache`: schema version,
+  jax/jaxlib version, ``device_kind``, per-entry device-count class.
+  Corrupt files, torn writes (per-entry sha256), and stale stamps
+  degrade to a MISS with counters — never a crash, never a silent
+  wrong-program load;
+
+* **load-before-compile** — ``obs.instrumented_jit`` (the library's
+  single compile site) consults :func:`lookup_runner` before tracing:
+  a hit deserializes the exported module and AOT-compiles it (with the
+  persistent XLA cache armed below, that backend compile is a disk
+  read), so dispatch runs the *packed* executable and the
+  ``artifact_hit/miss/stale/load_error`` counters plus an ``artifact``
+  decision event tell you which; in ``on`` mode a miss exports the
+  freshly-compiled program back into the store;
+
+* **the persistent-compile-cache leg** — sites ``jax.export`` cannot
+  serialize (donated buffers, static-arg wrappers, closures without an
+  explicit key) still skip their backend compile: arming the store
+  also arms JAX's persistent compilation cache inside the artifact
+  directory (``xla_cache/``).  :func:`enable_persistent_compile_cache`
+  is the ONE home of that configuration —
+  ``utils/profiler.enable_compilation_cache`` is now a delegating
+  shim;
+
+* **warm packs** — ``tools/warm_pack.py`` / ``make warm-pack`` drives
+  the serving shape classes (the same routing-family runner tables the
+  autotuner probes) with the store in ``on`` mode, building a bundle a
+  fresh process preloads at ``serve.Server.start()`` (and subprocess
+  replicas via ``cluster._replica_main``) so the first request hits
+  steady-state p99 — ``tools/cold_start.py`` measures exactly that.
+
+Modes (``$VELES_SIMD_ARTIFACTS``): ``off`` (default — one env check
+per dispatch), ``on`` (load, and export misses back into the store),
+``readonly`` (load only; the store NEVER writes — the production
+posture for a shipped pack).  ``$VELES_SIMD_ARTIFACT_DIR`` names the
+store directory; :func:`set_artifact_dir` is the programmatic
+override and :func:`private_artifact_store` the thread-scoped test
+idiom (mirroring ``routing.private_tune_cache``).
+
+Like :mod:`~veles.simd_tpu.runtime.routing`, this module imports
+neither jax nor numpy at module scope; jax is reached only inside the
+export/deserialize helpers, whose callers imported it long before.
+``tools/lint.py`` keeps raw ``jax.export`` / ``.serialize()`` /
+``deserialize`` calls out of ``ops/``/``parallel/``/``serve/``/
+``pipeline/`` — serialization that bypasses this module is
+serialization the stamps cannot protect.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import threading
+import time
+
+from veles.simd_tpu import obs
+from veles.simd_tpu.obs.atomic import (atomic_write_bytes,
+                                       atomic_write_text)
+
+__all__ = [
+    "ARTIFACTS_ENV", "ARTIFACT_DIR_ENV", "ARTIFACT_MODES",
+    "ARTIFACT_SCHEMA", "MANIFEST_NAME", "MAX_ARTIFACT_ENTRIES",
+    "ArtifactStore", "artifacts_mode", "artifacts_mode_override",
+    "artifact_dir", "set_artifact_dir", "store",
+    "private_artifact_store", "lookup_runner", "export_and_store",
+    "preload", "enable_persistent_compile_cache", "version_stamp",
+    "device_stamp", "devices_token",
+]
+
+ARTIFACTS_ENV = "VELES_SIMD_ARTIFACTS"
+ARTIFACT_DIR_ENV = "VELES_SIMD_ARTIFACT_DIR"
+ARTIFACT_MODES = ("off", "on", "readonly")
+
+# artifact-store schema version: a manifest written by a different
+# layout is ignored wholesale (counted as stale) — a pack from an
+# older build must never hand executables to a newer loader
+ARTIFACT_SCHEMA = 1
+
+MANIFEST_NAME = "MANIFEST.json"
+
+# the persistent-XLA-cache leg lives inside the store directory, so
+# one pack ships both the exported modules and the backend-compile
+# cache entries the loaders' AOT compiles hit
+XLA_CACHE_SUBDIR = "xla_cache"
+
+# entry bound: a geometry-churning service must not grow the pack (and
+# its directory) without limit — oldest-stamp entries are evicted on
+# store, exactly the TuneCache discipline; an evicted geometry pays
+# one more compile if it returns
+MAX_ARTIFACT_ENTRIES = 256
+
+# deserialized-and-compiled runner bound (in-memory, per store): the
+# live set a serving process dispatches through
+RUNNER_CACHE_MAX = 256
+
+
+def artifacts_mode() -> str:
+    """The active artifact-store mode (``$VELES_SIMD_ARTIFACTS``, or a
+    thread-scoped :func:`artifacts_mode_override`): ``off`` (default),
+    ``on`` (load before compile; export misses into the store), or
+    ``readonly`` (load only — the store never writes).  Unknown values
+    read as ``off``: a typo'd env var must not change dispatch or
+    crash a service."""
+    override = getattr(_tls, "mode", None)
+    raw = (override if override is not None
+           else os.environ.get(ARTIFACTS_ENV, "off")).strip().lower()
+    return raw if raw in ARTIFACT_MODES else "off"
+
+
+_tls = threading.local()
+
+
+@contextlib.contextmanager
+def artifacts_mode_override(mode: str):
+    """Scoped, THREAD-LOCAL mode override — the supervised-worker
+    idiom shared with ``routing.autotune_mode_override``: an abandoned
+    bench stage's override dies with its thread instead of leaking
+    into the process environment."""
+    if mode not in ARTIFACT_MODES:
+        raise ValueError(f"mode must be one of {ARTIFACT_MODES}, "
+                         f"got {mode!r}")
+    prev = getattr(_tls, "mode", None)
+    _tls.mode = mode
+    try:
+        yield
+    finally:
+        _tls.mode = prev
+
+
+def version_stamp() -> str:
+    """The jax/jaxlib version pair stamped into every manifest: an
+    exported module is an XLA-dialect artifact, and a pack serialized
+    by one runtime generation must never silently feed another
+    (mismatch degrades to miss, like a device mismatch)."""
+    try:
+        import jax
+        import jaxlib
+
+        return f"{jax.__version__}/{jaxlib.__version__}"
+    except Exception:  # noqa: BLE001 — jax-free process: still stampable
+        return "unknown"
+
+
+def device_stamp() -> str:
+    """The accelerator stamp (``routing.device_kind()``): executables
+    compiled for one device generation must never steer another."""
+    from veles.simd_tpu.runtime import routing
+
+    return routing.device_kind()
+
+
+def devices_token() -> str:
+    """Per-entry device-count class (``d8``, ``d1``, ...): an
+    executable exported under a forced 8-device topology must not load
+    into a single-device process (the mesh-stamp discipline, one level
+    down — ``parallel/`` programs bake the mesh into the module)."""
+    try:
+        import jax
+
+        return f"d{jax.device_count()}"
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
+# ---------------------------------------------------------------------------
+# the store
+# ---------------------------------------------------------------------------
+
+
+def _digest(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _key_file(key: str) -> str:
+    """Stable per-key filename: the key itself can be long and carries
+    shape/param text, so entries live under its sha256."""
+    return _digest(key.encode("utf-8"))[:40] + ".bin"
+
+
+class ArtifactStore:
+    """One artifact directory: serialized executables + MANIFEST.json.
+
+    Manifest format (JSON, atomically written)::
+
+        {"schema": 1, "jax": "0.4.37/0.4.36", "device": "cpu",
+         "entries": {"<key>": {"file": "<sha>.bin", "sha256": "...",
+                               "size": 12345, "unix": ...,
+                               "op": "...", "route": "...",
+                               "devices": "d1"}, ...}}
+
+    A corrupt manifest, a schema/jax/device stamp from a different
+    runtime, a per-entry device-count mismatch, a missing or
+    digest-mismatched ``.bin`` (torn write) — every one degrades to a
+    MISS with its counter bumped (``stale`` / ``load_errors``), never
+    a crash and never a silently-wrong executable.  ``readonly`` mode
+    never writes (``write_refused`` counts the refusals); ``save``
+    additionally refuses to overwrite a VALID manifest stamped for
+    another runtime (``save_refused`` — the TuneCache discipline:
+    load-side mismatch degrades, save-side destruction is permanent).
+    """
+
+    def __init__(self, path: str | None):
+        self._lock = threading.Lock()
+        self._save_lock = threading.Lock()
+        self._path = path
+        self._entries: dict[str, dict] = {}
+        self._loaded = path is None
+        # keys evicted by THIS store (their payload files unlinked):
+        # save()'s read-merge-write must not resurrect them from the
+        # on-disk manifest as dangling file references
+        self._evicted_keys: set = set()
+        self._runners: dict[str, object] = {}
+        self._stats = {"hits": 0, "misses": 0, "stale": 0,
+                       "load_errors": 0, "stores": 0, "evictions": 0,
+                       "persist_errors": 0, "save_refused": 0,
+                       "write_refused": 0, "export_unsupported": 0,
+                       "preloaded": 0}
+
+    @property
+    def path(self) -> str | None:
+        return self._path
+
+    def _manifest_path(self) -> str:
+        return os.path.join(self._path, MANIFEST_NAME)
+
+    def _read_manifest(self) -> "dict | str":
+        """Validated entries, or the rejection reason (the stat to
+        bump: ``'missing'`` / ``'load_errors'`` / ``'stale'``)."""
+        try:
+            with open(self._manifest_path()) as f:
+                data = json.load(f)
+        except FileNotFoundError:
+            return "missing"
+        except Exception:  # noqa: BLE001 — corrupt manifest degrades
+            return "load_errors"
+        if not isinstance(data, dict) or \
+                data.get("schema") != ARTIFACT_SCHEMA:
+            return "stale"
+        stamp = data.get("jax")
+        if stamp is not None and stamp != version_stamp():
+            return "stale"
+        dev = data.get("device")
+        if dev is not None and dev != device_stamp():
+            return "stale"
+        entries = data.get("entries")
+        if not isinstance(entries, dict):
+            return "load_errors"
+        return {str(k): dict(v) for k, v in entries.items()
+                if isinstance(v, dict)
+                and isinstance(v.get("file"), str)}
+
+    def _ensure_loaded_locked(self) -> None:
+        if self._loaded:
+            return
+        self._loaded = True
+        loaded = self._read_manifest()
+        if isinstance(loaded, dict):
+            self._entries.update(loaded)
+        elif loaded != "missing":
+            self._stats[loaded] += 1
+
+    # -- reads ---------------------------------------------------------------
+
+    def load_bytes(self, key: str) -> "tuple[bytes | None, str]":
+        """``(data, outcome)`` for one key: outcome is ``hit`` /
+        ``miss`` / ``stale`` (per-entry device-count mismatch) /
+        ``load_error`` (missing/torn/digest-mismatched file).  Every
+        non-hit is a miss to the caller — the counters are the
+        diagnosis."""
+        with self._lock:
+            self._ensure_loaded_locked()
+            entry = self._entries.get(key)
+            if entry is None:
+                self._stats["misses"] += 1
+                return None, "miss"
+            stamp = entry.get("devices")
+            if stamp is not None and stamp != devices_token():
+                self._stats["stale"] += 1
+                self._stats["misses"] += 1
+                return None, "stale"
+            fname = entry["file"]
+            want = entry.get("sha256")
+        try:
+            with open(os.path.join(self._path, fname), "rb") as f:
+                data = f.read()
+        except Exception:  # noqa: BLE001 — a vanished file is a miss
+            with self._lock:
+                self._stats["load_errors"] += 1
+                self._stats["misses"] += 1
+            return None, "load_error"
+        if want is not None and _digest(data) != want:
+            # torn or tampered payload: the atomic writer makes this
+            # near-impossible for our own writes, but a pack rsynced
+            # mid-build (or hand-edited) must degrade, not deserialize
+            with self._lock:
+                self._stats["load_errors"] += 1
+                self._stats["misses"] += 1
+            return None, "load_error"
+        with self._lock:
+            self._stats["hits"] += 1
+        return data, "hit"
+
+    def keys(self) -> list:
+        with self._lock:
+            self._ensure_loaded_locked()
+            return sorted(self._entries)
+
+    def entry(self, key: str) -> dict | None:
+        with self._lock:
+            self._ensure_loaded_locked()
+            e = self._entries.get(key)
+            return dict(e) if e is not None else None
+
+    # -- runners -------------------------------------------------------------
+
+    def runner(self, key: str):
+        """The cached deserialized+compiled runner for ``key``, or
+        None (no hit/miss accounting — :func:`lookup_runner` owns
+        that)."""
+        with self._lock:
+            return self._runners.get(key)
+
+    def put_runner(self, key: str, runner) -> None:
+        with self._lock:
+            if len(self._runners) >= RUNNER_CACHE_MAX:
+                self._runners.pop(next(iter(self._runners)))
+            self._runners[key] = runner
+
+    # -- writes --------------------------------------------------------------
+
+    def store_bytes(self, key: str, data: bytes, *, op: str = "",
+                    route: str = "") -> bool:
+        """Persist one serialized executable under ``key``; returns
+        True when it landed.  Refused (counted, never raised) in
+        readonly mode, with no bound directory, or when persistence
+        fails — dispatch must outlive a read-only filesystem."""
+        if self._path is None:
+            return False
+        if artifacts_mode() == "readonly":
+            with self._lock:
+                self._stats["write_refused"] += 1
+            return False
+        data = bytes(data)
+        fname = _key_file(key)
+        entry = {"file": fname, "sha256": _digest(data),
+                 "size": len(data), "unix": time.time(),
+                 "op": str(op), "route": str(route),
+                 "devices": devices_token()}
+        try:
+            os.makedirs(self._path, exist_ok=True)
+            atomic_write_bytes(os.path.join(self._path, fname), data)
+        except Exception:  # noqa: BLE001
+            with self._lock:
+                self._stats["persist_errors"] += 1
+            return False
+        with self._lock:
+            self._entries.pop(key, None)
+            self._entries[key] = entry
+            self._evicted_keys.discard(key)
+            self._stats["stores"] += 1
+            evicted = []
+            while len(self._entries) > MAX_ARTIFACT_ENTRIES:
+                oldest = min(self._entries,
+                             key=lambda k: self._entries[k].get(
+                                 "unix", 0.0))
+                evicted.append(self._entries.pop(oldest))
+                self._evicted_keys.add(oldest)
+                self._stats["evictions"] += 1
+        for e in evicted:
+            try:        # best effort — the manifest is the truth
+                os.unlink(os.path.join(self._path, e["file"]))
+            except OSError:
+                pass
+        return self.save()
+
+    def save(self) -> bool:
+        """Atomically persist the manifest (read-merge-write under a
+        save lock, like ``TuneCache.save``: two ``on``-mode workers
+        sharing one pack must not lose each other's exports).  A VALID
+        manifest stamped for another runtime is never overwritten
+        (``save_refused``)."""
+        if self._path is None or artifacts_mode() == "readonly":
+            return False
+        with self._save_lock:
+            with self._lock:
+                self._ensure_loaded_locked()
+                on_disk = self._read_manifest()
+                if on_disk == "stale":
+                    self._stats["save_refused"] += 1
+                    return False
+                merged = on_disk if isinstance(on_disk, dict) else {}
+                # keys this store evicted (payloads unlinked) must not
+                # be resurrected from the previous on-disk manifest as
+                # dangling references — a fresh process's preload would
+                # read them straight into load_errors
+                for key in self._evicted_keys:
+                    merged.pop(key, None)
+                merged.update(self._entries)
+                # another worker's entries can still push the merged
+                # view past the bound: drop oldest-stamp entries like
+                # store_bytes does (files left for that worker's own
+                # manifest view; a later save converges)
+                while len(merged) > MAX_ARTIFACT_ENTRIES:
+                    merged.pop(min(merged,
+                                   key=lambda k: merged[k].get(
+                                       "unix", 0.0)))
+                payload = {"schema": ARTIFACT_SCHEMA,
+                           "jax": version_stamp(),
+                           "device": device_stamp(),
+                           "entries": merged}
+            try:
+                os.makedirs(self._path, exist_ok=True)
+                atomic_write_text(self._manifest_path(),
+                                  json.dumps(payload, indent=1,
+                                             sort_keys=True))
+                return True
+            except Exception:  # noqa: BLE001
+                with self._lock:
+                    self._stats["persist_errors"] += 1
+                return False
+
+    # -- introspection -------------------------------------------------------
+
+    def info(self) -> dict:
+        """``obs.caches()`` provider payload — path, mode, and the
+        hit/miss/stale/eviction traffic, beside the tune cache."""
+        with self._lock:
+            self._ensure_loaded_locked()
+            return {"size": len(self._entries),
+                    "capacity": MAX_ARTIFACT_ENTRIES,
+                    "path": self._path, "mode": artifacts_mode(),
+                    "schema": ARTIFACT_SCHEMA,
+                    "runners": len(self._runners), **self._stats}
+
+
+# ---------------------------------------------------------------------------
+# the process store singleton (rebuilt when the bound dir changes)
+# ---------------------------------------------------------------------------
+
+_store_lock = threading.Lock()
+_dir_override: str | None = None
+_store_src: object = None
+_store_obj: ArtifactStore | None = None
+_NO_PATH = object()
+
+
+def artifact_dir() -> str | None:
+    """The bound artifact directory (programmatic override first, then
+    ``$VELES_SIMD_ARTIFACT_DIR``), or None."""
+    if _dir_override is not None:
+        return _dir_override
+    return os.environ.get(ARTIFACT_DIR_ENV, "").strip() or None
+
+
+def set_artifact_dir(path: str | None) -> None:
+    """Programmatic artifact-dir override (None restores the env
+    lookup).  The next :func:`store` call rebuilds the singleton."""
+    global _dir_override, _store_src, _store_obj
+    with _store_lock:
+        _dir_override = path
+        _store_src = _NO_PATH
+        _store_obj = None
+
+
+def store() -> ArtifactStore:
+    """The process artifact store, rebuilt when the bound directory
+    changes.  A thread-scoped :func:`private_artifact_store` takes
+    precedence (the test/bench isolation idiom)."""
+    global _store_src, _store_obj
+    private = getattr(_tls, "store", None)
+    if private is not None:
+        return private
+    path = artifact_dir()
+    with _store_lock:
+        if _store_obj is None or path != _store_src:
+            _store_src = path
+            _store_obj = ArtifactStore(path)
+        return _store_obj
+
+
+@contextlib.contextmanager
+def private_artifact_store(path: str | None = None):
+    """Scoped, THREAD-LOCAL artifact store: inside the scope this
+    thread's lookups/exports go to a private store instead of the
+    process one — a measuring stage can exercise the artifact path
+    without reading from or writing into an operator-bound pack.
+    Yields the private store."""
+    prev = getattr(_tls, "store", None)
+    st = ArtifactStore(path)
+    _tls.store = st
+    try:
+        yield st
+    finally:
+        _tls.store = prev
+
+
+obs.register_cache("artifact_store", lambda: store().info())
+
+
+# ---------------------------------------------------------------------------
+# the persistent-XLA-cache leg (ONE home; utils/profiler delegates here)
+# ---------------------------------------------------------------------------
+
+_COMPILE_CACHE_ENV = "VELES_SIMD_CACHE_DIR"
+
+
+def enable_persistent_compile_cache(cache_dir: str | None = None
+                                    ) -> str:
+    """Persist compiled executables across processes (JAX's persistent
+    compilation cache).  Returns the directory in use.
+
+    ``cache_dir`` defaults to ``$VELES_SIMD_CACHE_DIR`` or
+    ``~/.cache/veles_simd_tpu``.  Safe to call more than once; applies
+    to every jit/pallas compile after the call (already-compiled
+    in-memory executables are unaffected).  This is the single home of
+    persistent-compile configuration — the historical entry point
+    ``utils/profiler.enable_compilation_cache`` is a delegating shim —
+    and arming the artifact store points it at ``<store>/xla_cache``
+    so one pack ships both legs.  With telemetry enabled, hit/miss
+    traffic lands in the ``compile.cache_*`` counters via the
+    ``jax.monitoring`` bridge (:mod:`veles.simd_tpu.obs.compile`).
+    """
+    import jax
+
+    cache_dir = (cache_dir or os.environ.get(_COMPILE_CACHE_ENV)
+                 or os.path.expanduser("~/.cache/veles_simd_tpu"))
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # cache every compile: the default min-entry-size/min-compile-time
+    # heuristics skip exactly the small executables that dominate this
+    # library's dispatch surface
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    try:
+        # without this the CPU backend (the test platform) never writes
+        # entries at all — the cache silently stays empty
+        jax.config.update("jax_persistent_cache_enable_xla_caches",
+                          "all")
+    except AttributeError:  # older jax without the knob
+        pass
+    try:
+        # jax pins its cache object at the FIRST compile: a process
+        # that already jitted anything silently ignores a later
+        # cache-dir config unless the cache is re-initialized.
+        # Private API, so best-effort.
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 — enabling later compiles still
+        pass           # works on jax versions without reset_cache
+    return cache_dir
+
+
+_armed_for: str | None = None
+_arm_lock = threading.Lock()
+
+
+def _ensure_armed(st: ArtifactStore) -> None:
+    """Arm the persistent-XLA-cache leg inside the store directory,
+    once per bound path — every loader's AOT compile and every
+    export-unsupported site's backend compile then hits (or seeds)
+    the pack's ``xla_cache/``."""
+    global _armed_for
+    if st.path is None:
+        return
+    with _arm_lock:
+        if _armed_for == st.path:
+            return
+        try:
+            enable_persistent_compile_cache(
+                os.path.join(st.path, XLA_CACHE_SUBDIR))
+            _armed_for = st.path
+        except Exception:  # noqa: BLE001 — the export leg still works
+            pass
+
+
+# ---------------------------------------------------------------------------
+# export / load (the only serialize/deserialize sites in the library)
+# ---------------------------------------------------------------------------
+
+
+def _specs_for(args, kwargs):
+    """ShapeDtypeStruct mirror of a concrete call — every leaf must be
+    array-like (the caller pre-checked)."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+        (args, dict(kwargs)))
+
+
+def export_and_store(jfn, key: str, args, kwargs, *, op: str = "",
+                     route: str = "") -> str:
+    """Serialize ``jfn`` (a jitted callable) at this call's geometry
+    into the store under ``key``.  Returns the outcome: ``stored`` /
+    ``refused`` (readonly / unbound dir) / ``unsupported`` (this
+    program cannot round-trip through ``jax.export`` — counted, and
+    the site stays covered by the persistent-compile-cache leg).
+    Never raises."""
+    st = store()
+    if st.path is None or artifacts_mode() != "on":
+        return "refused"
+    _ensure_armed(st)
+    try:
+        import jax.export
+
+        spec_args, spec_kwargs = _specs_for(args, kwargs)
+        exported = jax.export.export(jfn)(*spec_args, **spec_kwargs)
+        data = bytes(exported.serialize())
+    except Exception:  # noqa: BLE001 — unsupported programs degrade
+        with st._lock:
+            st._stats["export_unsupported"] += 1
+        return "unsupported"
+    return "stored" if st.store_bytes(key, data, op=op, route=route) \
+        else "refused"
+
+
+def _build_runner(data: bytes):
+    """Deserialize one artifact and AOT-compile it: the returned
+    runner is called with the original ``(*args, **kwargs)`` (the
+    exported in_tree IS that calling convention).  With the XLA cache
+    armed the backend compile here is a disk read."""
+    import jax
+    import jax.export
+
+    exported = jax.export.deserialize(bytearray(data))
+    sds = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+           for a in exported.in_avals]
+    spec_args, spec_kwargs = jax.tree_util.tree_unflatten(
+        exported.in_tree, sds)
+    return jax.jit(exported.call).lower(
+        *spec_args, **spec_kwargs).compile()
+
+
+def lookup_runner(key: str) -> tuple:
+    """``(runner, outcome)`` for one key: the load-before-compile
+    entry point ``obs.instrumented_jit`` consults.  Outcomes: ``hit``
+    (runner ready), ``miss``, ``stale``, ``load_error``.  A payload
+    that deserializes or compiles badly is a ``load_error`` — the
+    caller falls back to its own trace+compile.  Never raises."""
+    st = store()
+    if st.path is None:
+        return None, "miss"
+    runner = st.runner(key)
+    if runner is not None:
+        with st._lock:
+            st._stats["hits"] += 1
+        return runner, "hit"
+    _ensure_armed(st)
+    data, outcome = st.load_bytes(key)
+    if data is None:
+        return None, outcome
+    try:
+        runner = _build_runner(data)
+    except Exception:  # noqa: BLE001 — a bad payload must not crash
+        with st._lock:
+            st._stats["load_errors"] += 1
+        return None, "load_error"
+    st.put_runner(key, runner)
+    return runner, "hit"
+
+
+def preload(keys=None) -> dict:
+    """Deserialize and AOT-compile every store entry (or just
+    ``keys``) NOW — the serve-start warmup that moves compile cost out
+    of the first request's critical path.  Returns ``{"loaded": n,
+    "failed": m, "mode": ..., "path": ...}``; failures are counted,
+    never raised (a torn pack must not stop a server from starting
+    cold)."""
+    st = store()
+    out = {"loaded": 0, "failed": 0, "mode": artifacts_mode(),
+           "path": st.path}
+    if artifacts_mode() == "off" or st.path is None:
+        return out
+    for key in (st.keys() if keys is None else keys):
+        runner, outcome = lookup_runner(key)
+        if runner is not None:
+            out["loaded"] += 1
+            with st._lock:
+                st._stats["preloaded"] += 1
+        else:
+            out["failed"] += 1
+    obs.count("artifact_preload", out["loaded"])
+    obs.record_decision("artifact", "preload", loaded=out["loaded"],
+                        failed=out["failed"], path=str(st.path))
+    return out

@@ -100,7 +100,7 @@ from veles.simd_tpu.serve.server import (DeadlineExceeded, Request,
 
 __all__ = [
     "Replica", "ReplicaGroup", "FrontRouter", "RouterTicket",
-    "NoReplicaAvailable", "UP", "DRAINING", "DEAD",
+    "NoReplicaAvailable", "UP", "DRAINING", "DEAD", "RESTARTING",
     "REPLICAS_ENV", "ROUTER_POLICY_ENV", "HEARTBEAT_MS_ENV",
     "DEFAULT_REPLICAS", "DEFAULT_HEARTBEAT_MS", "DEFAULT_MISS_LIMIT",
     "ROUTER_POLICIES", "env_replicas", "env_router_policy",
@@ -127,6 +127,8 @@ ROUTER_POLICIES = (LEAST_LOADED, ROUND_ROBIN)
 UP = "up"
 DRAINING = "draining"
 DEAD = "dead"
+# transient restart() guard state: not placeable, not re-restartable
+RESTARTING = "restarting"
 
 # scoring: depth is O(queue); the penalties must dominate any sane
 # queue depth so a healthy replica always outranks a degraded one for
@@ -395,6 +397,12 @@ class ReplicaGroup:
         self.miss_limit = int(miss_limit)
         if self.miss_limit < 1:
             raise ValueError("miss_limit must be >= 1")
+        self._server_kwargs = dict(server_kwargs)
+        # pipelines registered through the GROUP, replayed onto a
+        # restarted replica (a fresh Server has no registrations —
+        # without the replay, the router would place pipeline traffic
+        # onto a replica that answers "unregistered pipeline")
+        self._group_pipelines: dict = {}
         self.replicas = [
             Replica(f"r{i}", spawn=spawn, server_kwargs=server_kwargs)
             for i in range(n)]
@@ -534,10 +542,70 @@ class ReplicaGroup:
                             reason=reason)
         obs.gauge("replica_alive", float(self.alive()))
 
+    def restart(self, rid: str) -> Replica:
+        """Cold-restart a DEAD replica under the same id: a FRESH
+        :class:`Replica` (new Server / new subprocess, the group's
+        original server kwargs) replaces the dead record, starts —
+        which preloads the warm artifact pack when the store is armed
+        (``Server.start``) — and rejoins heartbeating and placement.
+        This is the autoscaling/preemption-recovery moment the
+        zero-warmup subsystem exists for, and the chaos campaign's
+        cold-replica-restart phase gates exactly this path: the
+        restarted replica's FIRST request must land within budget of
+        the survivors' steady state.  Restarting a live replica is a
+        ValueError (kill or drain it first)."""
+        with self._lock:
+            old = self._by_rid[rid]
+            if old.state != DEAD:
+                # also closes the concurrent-restart race: the first
+                # caller flips the record to RESTARTING under this
+                # lock, so a second restart() of the same rid raises
+                # instead of starting a twin Server nothing would
+                # ever stop
+                raise ValueError(
+                    f"replica {rid!r} is {old.state!r}, not dead — "
+                    "kill() or drain() it before restart()")
+            old.state = RESTARTING
+        try:
+            fresh = Replica(rid, spawn=self.spawn,
+                            server_kwargs=self._server_kwargs)
+            fresh.start()
+            if self.spawn == "thread":
+                # a fresh Server has no pipeline registrations —
+                # replay the group's so pipeline traffic placed here
+                # keeps answering
+                for name, compiled in self._group_pipelines.items():
+                    fresh.server.register_pipeline(name, compiled)
+        except BaseException:
+            with self._lock:
+                old.state = DEAD     # a failed restart stays dead
+            raise
+        # treat the successful start as the first beat: the staleness
+        # monitor otherwise judges last_beat=None against the GROUP
+        # start time and would wedge-drain a replica restarted any
+        # real interval later, before its prober's first ping lands
+        fresh.last_beat = faults.monotonic()
+        with self._lock:
+            self._by_rid[rid] = fresh
+            self.replicas = [fresh if r.rid == rid else r
+                             for r in self.replicas]
+        if self._started:
+            t = threading.Thread(target=self._probe_replica,
+                                 args=(fresh,), daemon=True,
+                                 name=f"veles-replica-probe-{rid}")
+            t.start()
+            self._probers.append(t)
+        obs.record_decision("replica_lifecycle", "restart",
+                            replica=rid)
+        obs.count("replica_restarted", replica=rid)
+        obs.gauge("replica_alive", float(self.alive()))
+        return fresh
+
     def register_pipeline(self, name: str, compiled) -> str:
         """Register a compiled pipeline on EVERY thread-mode replica
         (the group twin of :meth:`Server.register_pipeline`); returns
-        the op string."""
+        the op string.  Recorded group-side too, so a replica revived
+        by :meth:`restart` gets the same registrations replayed."""
         if self.spawn != "thread":
             raise ValueError(
                 "pipeline registration needs in-process replicas "
@@ -546,6 +614,7 @@ class ReplicaGroup:
         op = None
         for r in self.replicas:
             op = r.server.register_pipeline(name, compiled)
+        self._group_pipelines[str(name)] = compiled
         return op
 
     # -- heartbeats --------------------------------------------------------
@@ -1030,9 +1099,16 @@ def _replica_main(argv=None) -> int:
     srv = Server(max_batch=args.max_batch,
                  max_wait_ms=args.max_wait_ms,
                  obs_port=args.obs_port, **kwargs)
+    # start() preloads the warm artifact pack when the store is armed
+    # (the child inherits VELES_SIMD_ARTIFACTS/_ARTIFACT_DIR from the
+    # group's environment), so a subprocess replica reports its port —
+    # and starts heartbeating — only once its executables are ready:
+    # the first request a failover lands here hits steady-state p99
     srv.start()
-    print(json.dumps({"port": srv.obs_port, "pid": os.getpid()}),
-          flush=True)
+    ready = {"port": srv.obs_port, "pid": os.getpid()}
+    if srv._preload is not None:
+        ready["artifact_preload"] = srv._preload
+    print(json.dumps(ready), flush=True)
     try:
         sys.stdin.read()        # parked until the parent lets go
     except Exception:  # noqa: BLE001 — any stdin failure = shutdown

@@ -59,7 +59,16 @@ already has, plus the one loop none of them provided:
   total; per-tenant SLO accounting rides the terminal edges
   (``obs.slo``), and ``start()`` arms the live scrape endpoint
   (``/metrics`` + ``/healthz`` + ``/debug/requests``) via
-  ``$VELES_SIMD_OBS_PORT`` or ``obs_port=`` (0 = ephemeral).
+  ``$VELES_SIMD_OBS_PORT`` or ``obs_port=`` (0 = ephemeral);
+* **zero-warmup cold start** — with the AOT artifact store armed
+  (``VELES_SIMD_ARTIFACTS=on|readonly`` +
+  ``VELES_SIMD_ARTIFACT_DIR=pack``, see
+  :mod:`veles.simd_tpu.runtime.artifacts`), :meth:`Server.start`
+  preloads the warm pack — every serialized executable deserialized
+  and AOT-compiled before the first request is admitted — so a
+  freshly-born process (autoscaling, preemption recovery, a replica
+  restart) answers its first request at steady-state p99 instead of
+  paying trace+compile under the tightest deadline it will ever see.
 
 Usage::
 
@@ -105,6 +114,7 @@ from veles.simd_tpu.ops import batched
 from veles.simd_tpu.ops import iir as _iir
 from veles.simd_tpu.ops import resample as _rs
 from veles.simd_tpu.ops import spectral as _sp
+from veles.simd_tpu.runtime import artifacts as _artifacts
 from veles.simd_tpu.runtime import breaker as _breaker
 from veles.simd_tpu.runtime import faults
 from veles.simd_tpu.serve.admission import (AdmissionController,
@@ -462,6 +472,9 @@ class Server:
                        "batches": 0, "batched_requests": 0}
         self._started = False
         self._stopped = False
+        # the warm-pack preload report ({"loaded": n, ...}) once
+        # start() ran with the artifact store armed; None otherwise
+        self._preload = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -485,6 +498,20 @@ class Server:
             self._endpoint = obs_http.start(self._obs_port_arg,
                                             health=self.stats)
         self._started = True
+        # zero-warmup cold start: with the artifact store armed
+        # (VELES_SIMD_ARTIFACTS=on|readonly), deserialize and
+        # AOT-compile the warm pack's executables NOW — before the
+        # first request is admitted — so the first dispatch per shape
+        # class runs a packed program at steady-state latency instead
+        # of paying trace+compile under a live deadline.  Best effort
+        # by contract: a torn or stale pack degrades to miss counters
+        # and the server still starts cold.
+        if _artifacts.artifacts_mode() != "off":
+            try:
+                self._preload = _artifacts.preload()
+            except Exception:  # noqa: BLE001 — never block startup
+                obs.count("artifact_preload_error")
+                self._preload = None
         for i in range(self.workers):
             t = threading.Thread(target=self._worker, daemon=True,
                                  name=f"veles-serve-worker-{i}")
@@ -995,6 +1022,7 @@ class Server:
             "pipelines": sorted(self._pipelines),
             "requests": obs.request_summary(),
             "slo": obs.slo_snapshot(),
+            "artifact_preload": self._preload,
             "obs_port": self.obs_port,
             "dispatch_quantiles": obs.quantiles(
                 "span.serve.dispatch", phase="steady"),

@@ -30,10 +30,14 @@ its only persistent state is in-memory FFT plans
   process re-loads compiled XLA/Mosaic binaries from disk instead of
   recompiling (first compiles cost 10-40 s through a remote-relay
   backend, so this is the difference between instant and minute-scale
-  warmup for repeat workloads).  With telemetry enabled
-  (``obs.enable()``), cache hit/miss counts and retrieval times show up
-  in the ``compile.*`` metrics via the ``jax.monitoring`` bridge
-  (:mod:`veles.simd_tpu.obs.compile`).
+  warmup for repeat workloads).  Now a DELEGATING SHIM: the
+  configuration's one home is :func:`veles.simd_tpu.runtime.artifacts.\
+enable_persistent_compile_cache`, the fallback leg of the AOT
+  artifact store (``jax.export``-serialized executables shipped as
+  warm packs — the zero-warmup cold-start subsystem).  With telemetry
+  enabled (``obs.enable()``), cache hit/miss counts and retrieval
+  times show up in the ``compile.*`` metrics via the
+  ``jax.monitoring`` bridge (:mod:`veles.simd_tpu.obs.compile`).
 
 Wall-clock timing belongs to :mod:`veles.simd_tpu.utils.benchmark`
 (``device_time_chained``); this module is for *where the time goes*, not
@@ -43,7 +47,6 @@ how much there is nor what was decided.
 from __future__ import annotations
 
 import contextlib
-import os
 
 __all__ = ["trace", "annotate", "enable_compilation_cache"]
 
@@ -89,37 +92,21 @@ def annotate(name: str):
 def enable_compilation_cache(cache_dir: str | None = None) -> str:
     """Persist compiled executables across processes.
 
-    ``cache_dir`` defaults to ``$VELES_SIMD_CACHE_DIR`` or
-    ``~/.cache/veles_simd_tpu``.  Returns the directory in use.  Safe to
-    call more than once; applies to every jit/pallas compile after the
-    call (already-compiled in-memory executables are unaffected).
+    DEPRECATED SHIM: persistent-compile configuration now has ONE home
+    in the AOT artifact subsystem —
+    :func:`veles.simd_tpu.runtime.artifacts.\
+enable_persistent_compile_cache` — which this delegates to unchanged
+    (``cache_dir`` still defaults to ``$VELES_SIMD_CACHE_DIR`` or
+    ``~/.cache/veles_simd_tpu``; returns the directory in use; safe to
+    call more than once).  The artifact store arms the same machinery
+    at ``<store>/xla_cache`` when ``VELES_SIMD_ARTIFACTS`` is on, so
+    one warm pack ships serialized executables AND backend-compile
+    cache entries; call the artifacts entry point directly in new
+    code.  With telemetry enabled (``obs.enable()``), cache hit/miss
+    counts and retrieval times show up in the ``compile.*`` metrics
+    via the ``jax.monitoring`` bridge
+    (:mod:`veles.simd_tpu.obs.compile`).
     """
-    import jax
+    from veles.simd_tpu.runtime import artifacts
 
-    cache_dir = (cache_dir or os.environ.get("VELES_SIMD_CACHE_DIR")
-                 or os.path.expanduser("~/.cache/veles_simd_tpu"))
-    os.makedirs(cache_dir, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    # cache every compile: the default min-entry-size/min-compile-time
-    # heuristics skip exactly the small executables that dominate this
-    # library's dispatch surface
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-    try:
-        # without this the CPU backend (the test platform) never writes
-        # entries at all — the cache silently stays empty
-        jax.config.update("jax_persistent_cache_enable_xla_caches",
-                          "all")
-    except AttributeError:  # older jax without the knob
-        pass
-    try:
-        # jax pins its cache object at the FIRST compile: a process
-        # that already jitted anything (observed: one profiler.trace
-        # session) silently ignores a later cache-dir config unless
-        # the cache is re-initialized.  Private API, so best-effort.
-        from jax._src import compilation_cache as _cc
-
-        _cc.reset_cache()
-    except Exception:  # noqa: BLE001 — enabling later compiles still
-        pass           # works on jax versions without reset_cache
-    return cache_dir
+    return artifacts.enable_persistent_compile_cache(cache_dir)
