@@ -436,6 +436,121 @@ class TestRouterAnswers:
 
 
 # ---------------------------------------------------------------------------
+# the fleet axis (obs v5): collector, signals, /signals route
+# ---------------------------------------------------------------------------
+
+class TestFleetAxis:
+    def test_collector_feeds_signals_and_route(self, telemetry):
+        with cluster.ReplicaGroup(2, max_batch=4, max_wait_ms=5.0,
+                                  obs_port=0,
+                                  fleet_tick_ms=20.0) as group:
+            router = cluster.FrontRouter(group)
+            tickets = [router.submit(_sos_request())
+                       for _ in range(6)]
+            for t in tickets:
+                np.asarray(t.result(timeout=60.0))
+            assert _wait_until(
+                lambda: obs.fleet_series().ticks >= 3), \
+                "collector never ticked"
+            sig = obs.signals()
+            assert sig.tick_s == pytest.approx(0.02)
+            assert sig.health.get("r0") == "healthy"
+            assert sig.health.get("r1") == "healthy"
+            # every sampled replica carries a bounded staleness and a
+            # depth reading; goodput came from real padded batches
+            assert all(age < 1.0 for age in sig.staleness_s.values())
+            assert set(sig.queue_depth) == {"r0", "r1"}
+            assert sig.goodput_overall is not None
+            assert 0.0 < sig.goodput_overall <= 1.0
+            assert sig.padding_waste == pytest.approx(
+                1.0 - sig.goodput_overall)
+            # the same bundle over HTTP: /signals on the router's
+            # aggregation endpoint
+            url = f"http://127.0.0.1:{group.obs_port}/signals"
+            body = json.loads(urllib.request.urlopen(
+                url, timeout=5).read())
+            assert body["health"].keys() == sig.health.keys()
+            assert body["window"] == sig.window
+            assert "series" in body and "r0" in body["series"]
+            collector = group._collector_thread
+        # stopping the group joins and clears the collector thread
+        assert group._collector_thread is None
+        assert not collector.is_alive()
+
+    def test_kill_becomes_visible_in_signals(self, telemetry):
+        with cluster.ReplicaGroup(2, max_wait_ms=5.0, obs_port=-1,
+                                  fleet_tick_ms=20.0) as group:
+            assert _wait_until(
+                lambda: obs.signals().health.get("r0") == "healthy")
+            group.kill("r0")
+            # the autoscaler read path notices within a few ticks
+            assert _wait_until(
+                lambda: obs.signals().health.get("r0") == "down"), \
+                "kill never became visible in obs.signals()"
+            assert obs.signals().health.get("r1") == "healthy"
+
+    def test_subprocess_stale_scrape_is_counted_not_fatal(
+            self, telemetry):
+        # a subprocess-mode replica whose /metrics endpoint is gone
+        # (child died, port refused): the funnel counts staleness and
+        # moves on — never an exception out of the sweep.  Faked with
+        # a dead port so the test skips the slow subprocess spawn.
+        import socket
+        import types
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        with cluster.ReplicaGroup(1, max_wait_ms=5.0, obs_port=-1,
+                                  fleet_tick_ms=20.0) as group:
+            group.replicas.append(types.SimpleNamespace(
+                rid="rsub", state=cluster.UP, spawn="subprocess",
+                port=dead_port, last_health=None))
+            group._collect_fleet_sample()     # must not raise
+            assert obs.counter_value("fleet_scrape_stale",
+                                     replica="rsub") >= 1
+            sig = obs.signals()
+            # sampled as up (the heartbeat machinery owns liveness)
+            # but yielding nothing beyond the up bit — and counted
+            assert sig.scrape_stale.get("rsub", 0) >= 1
+            assert sig.health.get("rsub") in ("healthy", "stale")
+            group.replicas.pop()
+
+    def test_router_ticket_stitches_across_failover(self, telemetry):
+        # a killed replica's queued work fails over; the surviving
+        # ticket must stitch into ONE fleet trace with both replicas'
+        # edges and the carried deadline visible
+        faults.set_fault_plan(None)
+        with cluster.ReplicaGroup(2, max_batch=32,
+                                  max_wait_ms=300.0, obs_port=-1,
+                                  fleet_tick_ms=20.0) as group:
+            router = cluster.FrontRouter(group)
+            tickets = [router.submit(_sos_request(deadline_ms=30000.0))
+                       for _ in range(8)]
+            group.kill(tickets[0].replica
+                       if tickets[0].replica else "r0")
+            failed_over = None
+            for t in tickets:
+                t.result(timeout=60.0)
+                if t.failovers and t.prior_traces:
+                    failed_over = t
+            assert failed_over is not None, "no ticket failed over"
+            doc = obs.stitch_fleet_trace(failed_over)
+            meta = doc["otherData"]
+            assert meta["attempts"] >= 2
+            assert len(set(meta["replicas"])) >= 2
+            dls = [d for d in meta["deadlines_ms"] if d is not None]
+            assert len(dls) >= 2
+            assert all(b <= a + 1e-6 for a, b in zip(dls, dls[1:]))
+            tids = {e["tid"] for e in doc["traceEvents"]
+                    if e["ph"] == "i" and e["name"] != "failover_hop"}
+            assert tids >= set(range(1, meta["attempts"] + 1))
+            assert any(e["name"] == "failover_hop"
+                       for e in doc["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
 # subprocess spawn mode (the multi-host topology proof)
 # ---------------------------------------------------------------------------
 

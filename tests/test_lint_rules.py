@@ -691,6 +691,105 @@ def test_real_cluster_module_passes_cluster_rule():
 
 
 # ---------------------------------------------------------------------------
+# the fleet funnel rule (obs v5): serve code reads cross-replica
+# metrics ONLY through the collector funnel / obs.signals() —
+# ad-hoc scraping beside it forks the fleet's view
+# ---------------------------------------------------------------------------
+
+FLEET_GOOD = '''
+from veles.simd_tpu import obs
+from veles.simd_tpu.obs import export as obs_export
+
+
+class Group:
+    def _collect_fleet_sample(self):
+        store = obs.fleet_series()
+        parsed = obs_export.parse_prometheus(self._scrape("r0"))
+        obs.fleet_record("r0", "completed", sum(parsed.values()),
+                         t_s=0.0)
+        store.tick()
+
+    def autoscale_input(self):
+        # the read side of the contract stays legal everywhere
+        return obs.signals()
+'''
+
+FLEET_SCRAPE_BYPASS = '''
+from veles.simd_tpu import obs
+from veles.simd_tpu.obs import export as obs_export
+
+
+class Group:
+    def _collect_fleet_sample(self):
+        obs.fleet_record("r0", "up", 1.0, t_s=0.0)
+
+    def _peek(self, body):
+        # ad-hoc scrape beside the funnel: a second reader with a
+        # second cadence
+        return obs_export.parse_prometheus(body)
+'''
+
+FLEET_STORE_BYPASS = '''
+from veles.simd_tpu import obs as telemetry
+
+
+def route_score():
+    return telemetry.fleet_series().value("r0", "depth")
+'''
+
+FLEET_SNAPSHOT_BYPASS = '''
+from veles.simd_tpu import obs
+
+
+def router_peek():
+    return obs.snapshot()["counters"]
+'''
+
+FLEET_IMPORT_ALIAS_BYPASS = '''
+from veles.simd_tpu.obs.export import parse_prometheus as pp
+
+
+def sneak(body):
+    return pp(body)
+'''
+
+
+def _fleet_errs(src):
+    return lint.fleet_funnel_errors(ast.parse(src), "mod.py")
+
+
+def test_fleet_rule_passes_funnelled_collector():
+    assert _fleet_errs(FLEET_GOOD) == []
+
+
+def test_fleet_rule_flags_scrape_outside_funnel():
+    errs = _fleet_errs(FLEET_SCRAPE_BYPASS)
+    assert len(errs) == 1
+    assert "_collect_fleet_sample" in errs[0]
+    assert "parse_prometheus" in errs[0]
+
+
+def test_fleet_rule_flags_store_and_snapshot_reads():
+    for src in (FLEET_STORE_BYPASS, FLEET_SNAPSHOT_BYPASS):
+        errs = _fleet_errs(src)
+        assert len(errs) == 1, src
+        assert "_collect_fleet_sample" in errs[0]
+
+
+def test_fleet_rule_tracks_import_alias():
+    errs = _fleet_errs(FLEET_IMPORT_ALIAS_BYPASS)
+    assert len(errs) == 1
+    assert "pp(...)" in errs[0]
+
+
+def test_real_serve_modules_pass_fleet_rule():
+    serve_dir = REPO / "veles" / "simd_tpu" / "serve"
+    for f in sorted(serve_dir.glob("*.py")):
+        tree = ast.parse(f.read_text(), str(f))
+        assert lint.fleet_funnel_errors(tree, str(f)) == [], f.name
+
+
+# ---------------------------------------------------------------------------
 # the request-trace rule (obs v4): terminal request accounting in
 # serve//pipeline/ must flow through the request-trace API — a
 # hand-rolled obs.count/observe of the terminal metrics drifts

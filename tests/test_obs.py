@@ -1055,3 +1055,215 @@ def test_crash_bundle_write_failure_releases_budget(telemetry,
     finally:
         obs.configure(flight_dir="")
         flightrec._reset_auto_count()
+
+
+# --------------------------------------------------------------------------
+# the fleet axis (obs v5): time series, typed signals, trace stitching
+# --------------------------------------------------------------------------
+
+from veles.simd_tpu.obs import timeseries as ts  # noqa: E402
+
+
+def test_histogram_quantile_all_overflow(telemetry):
+    # every sample above the top finite bucket: the quantile clamps to
+    # the HIGHEST finite bound (30.0 for DEFAULT_BUCKETS) rather than
+    # inventing a value inside +Inf — the honest answer a bounded
+    # ladder can give, and the one obs.signals consumers must expect
+    from veles.simd_tpu.obs.registry import DEFAULT_BUCKETS
+
+    for _ in range(7):
+        obs.observe("overflow_only", 1e6)
+    h = [h_ for h_ in obs.snapshot()["histograms"]
+         if h_["name"] == "overflow_only"][0]
+    assert h["buckets"]["+Inf"] == 7
+    assert all(h["buckets"][repr(b)] == 0 for b in DEFAULT_BUCKETS)
+    top = max(DEFAULT_BUCKETS)
+    for q in (0.5, 0.95, 0.99):
+        assert obs_export.histogram_quantile(h, q) == \
+            pytest.approx(top)
+
+
+class TestFleetSeries:
+    def test_ring_is_bounded_and_derivatives_window(self):
+        fs = ts.FleetSeries(window=4)
+        for i in range(10):
+            fs.record("r0", "depth", float(i), t_s=float(i))
+        assert len(fs.samples("r0", "depth")) == 4
+        # the window holds the LAST 4 samples: 6..9
+        assert fs.value("r0", "depth") == 9.0
+        assert fs.delta("r0", "depth") == pytest.approx(3.0)
+        assert fs.rate("r0", "depth") == pytest.approx(1.0)
+        with pytest.raises(ValueError):
+            ts.FleetSeries(window=1)
+
+    def test_derivative_functions_on_short_series(self):
+        assert ts.delta([]) is None
+        assert ts.rate([(1.0, 5.0)]) is None
+        assert ts.rate([(1.0, 5.0), (1.0, 9.0)]) is None  # dt == 0
+        assert ts.ewma([]) is None
+        assert ts.ewma([(0.0, 2.0), (1.0, 4.0)], alpha=1.0) == 4.0
+
+    def test_flap_counting(self):
+        samples = [(0.0, 1.0), (1.0, 0.0), (2.0, 0.0), (3.0, 1.0),
+                   (4.0, 1.0), (5.0, 0.0)]
+        assert ts.flaps(samples) == 3
+        assert ts.flaps([(0.0, 1.0)] * 5) == 0
+
+    def test_staleness_tracks_newest_sample(self):
+        fs = ts.FleetSeries(window=8)
+        fs.record("r0", "up", 1.0, t_s=10.0)
+        fs.record("r0", "depth", 2.0, t_s=12.0)
+        assert fs.staleness_s("r0", now=15.0) == pytest.approx(3.0)
+        assert fs.staleness_s("missing", now=15.0) is None
+
+    def test_env_knobs_fall_back_on_malformed(self, monkeypatch):
+        monkeypatch.setenv(ts.FLEET_TICK_MS_ENV, "not-a-number")
+        monkeypatch.setenv(ts.FLEET_WINDOW_ENV, "-3")
+        assert ts.env_tick_s() == ts.DEFAULT_TICK_MS / 1e3
+        assert ts.env_window() == ts.DEFAULT_WINDOW
+        monkeypatch.setenv(ts.FLEET_TICK_MS_ENV, "250")
+        monkeypatch.setenv(ts.FLEET_WINDOW_ENV, "16")
+        assert ts.env_tick_s() == pytest.approx(0.25)
+        assert ts.env_window() == 16
+
+
+class TestFleetSignals:
+    def test_facade_records_and_snapshot_embeds_fleet(self, telemetry):
+        obs.fleet_record("r0", "depth", 3.0, t_s=1.0)
+        obs.fleet_record("r0", "depth", 5.0, t_s=2.0)
+        obs.fleet_series().tick()
+        snap = obs.snapshot()
+        assert snap["fleet"]["ticks"] == 1
+        assert snap["fleet"]["series"]["r0"]["depth"][-1] == [2.0, 5.0]
+        obs.reset()
+        assert obs.snapshot()["fleet"]["series"] == {}
+
+    def test_fleet_record_is_noop_while_disabled(self):
+        obs.disable()
+        obs.reset()
+        obs.fleet_record("r0", "depth", 1.0, t_s=0.0)
+        assert obs.fleet_series().samples("r0", "depth") == []
+
+    def test_signals_typed_bundle_from_sources(self, telemetry):
+        store = obs.fleet_series()
+        store.tick_s = 0.05
+        now = 100.0
+        for t in (now - 0.2, now - 0.1, now):
+            obs.fleet_record("r0", "up", 1.0, t_s=t)
+            obs.fleet_record("r0", "healthy", 1.0, t_s=t)
+            obs.fleet_record("r0", "depth", 2.0, t_s=t)
+            obs.fleet_record("r1", "up", 0.0, t_s=t)
+            store.tick()
+        obs.fleet_record("r0", "breaker_open", 1.0, t_s=now)
+        obs.gauge("serve.goodput", 0.9, op="sosfilt", bucket=512)
+        obs.count("serve_useful_rows", 90, op="sosfilt", bucket=512)
+        obs.count("serve_dispatched_rows", 100, op="sosfilt",
+                  bucket=512)
+        obs.count("fleet_scrape_stale", replica="r9")
+        sig = ts.FleetSignals.from_sources(
+            store, obs.snapshot(), obs.slo_snapshot(), now=now)
+        assert sig.health["r0"] == "healthy"
+        assert sig.health["r1"] == "down"
+        assert sig.queue_depth["r0"] == 2.0
+        assert sig.breaker_open["r0"] == 1.0
+        assert sig.goodput_overall == pytest.approx(0.9)
+        assert list(sig.goodput.values()) == [pytest.approx(0.9)]
+        assert sig.scrape_stale == {"r9": 1}
+        assert sig.staleness_s["r0"] == pytest.approx(0.0)
+        d = sig.to_dict()
+        assert d["health"]["r1"] == "down"
+        assert "series" in d
+        # kwargs are checked: a typo'd signal name is a TypeError,
+        # not a silently-absorbed attribute
+        with pytest.raises(TypeError):
+            ts.FleetSignals(not_a_signal=1)
+
+    def test_signals_health_goes_stale_without_samples(self, telemetry):
+        store = obs.fleet_series()
+        store.tick_s = 0.05
+        obs.fleet_record("r0", "up", 1.0, t_s=0.0)
+        obs.fleet_record("r0", "healthy", 1.0, t_s=0.0)
+        store.tick()
+        # newest sample is 10 s old on a 50 ms tick: stale, not healthy
+        sig = ts.FleetSignals.from_sources(
+            store, obs.snapshot(), obs.slo_snapshot(), now=10.0)
+        assert sig.health["r0"] == "stale"
+        assert sig.staleness_s["r0"] == pytest.approx(10.0)
+
+
+class _FakeTrace:
+    def __init__(self, t0, rid, op, status, deadline_s, events):
+        self._t0 = t0
+        self.rid = rid
+        self.op = op
+        self.status = status
+        self.deadline_s = deadline_s
+        self._events = events
+
+    def events(self):
+        return list(self._events)
+
+
+class _FakeTicket:
+    rid = 7
+    op = "sosfilt"
+    status = "ok"
+    failovers = 1
+    replica = "r2"
+
+    def __init__(self):
+        self.prior_traces = [_FakeTrace(
+            100.0, 7, "sosfilt", "failover", 0.5,
+            [{"event": "submitted", "t_s": 0.0},
+             {"event": "failover", "t_s": 0.01, "to": "r2"}])]
+        self.trace = _FakeTrace(
+            100.012, 7, "sosfilt", "ok", 0.488,
+            [{"event": "submitted", "t_s": 0.0},
+             {"event": "completed", "t_s": 0.02}])
+        self.attempt_replicas = ["r0", "r2"]
+        self.deadlines_ms = [500.0, 488.0]
+
+
+class TestStitchFleetTrace:
+    def test_two_attempt_stitch(self):
+        doc = ts.stitch_fleet_trace(_FakeTicket())
+        meta = doc["otherData"]
+        assert meta["fleet"] is True
+        assert meta["attempts"] == 2
+        assert meta["replicas"] == ["r0", "r2"]
+        # the carried-deadline proof rides along, only ever shrinking
+        assert meta["deadlines_ms"] == [500.0, 488.0]
+        evs = doc["traceEvents"]
+        # one complete (X) span per attempt, on its own track
+        spans = [e for e in evs if e["ph"] == "X"]
+        assert [e["tid"] for e in spans] == [1, 2]
+        assert spans[0]["args"]["replica"] == "r0"
+        assert spans[1]["args"]["replica"] == "r2"
+        # attempts align on the shared monotonic clock: the second
+        # track starts 12 ms after the first
+        assert spans[1]["ts"] - spans[0]["ts"] == \
+            pytest.approx(0.012e6)
+        # exactly one failover hop, at the dead attempt's terminal
+        # edge, naming both sides
+        hops = [e for e in evs if e["name"] == "failover_hop"]
+        assert len(hops) == 1
+        assert hops[0]["tid"] == 1
+        assert hops[0]["args"]["from_replica"] == "r0"
+        assert hops[0]["args"]["to_replica"] == "r2"
+        # every lifecycle edge of both attempts is visible
+        names = {(e["tid"], e["name"]) for e in evs
+                 if e["ph"] == "i" and e["name"] != "failover_hop"}
+        assert (1, "submitted") in names and (1, "failover") in names
+        assert (2, "submitted") in names and (2, "completed") in names
+
+    def test_save_trace_fleet_writes_stitched_doc(self, telemetry,
+                                                  tmp_path):
+        path = tmp_path / "fleet.json"
+        obs.save_trace(str(path), fleet=_FakeTicket())
+        doc = json.loads(path.read_text())
+        assert doc["otherData"]["fleet"] is True
+        assert doc["otherData"]["attempts"] == 2
+        # an already-stitched dict is written verbatim
+        obs.save_trace(str(path), fleet={"traceEvents": [],
+                                         "otherData": {"fleet": True}})
+        assert json.loads(path.read_text())["traceEvents"] == []

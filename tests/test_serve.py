@@ -166,6 +166,52 @@ class TestBatchingPolicy:
                 t = srv.submit(serve.Request(op, x, params))
                 assert _rel(t.result(timeout=300.0), oracle()) < 2e-3
 
+    def test_padding_rows_counted_without_request_axis(self,
+                                                       telemetry):
+        # goodput accounting is a METRIC-axis write: it must record
+        # even with the request axis disarmed (the low-overhead
+        # production posture) — 3 coalesced rows pad to a pow2 batch
+        # of 4, so one padding row, goodput 0.75
+        obs.configure(request_axis=False)
+        try:
+            with serve.Server(max_batch=4, max_wait_ms=60.0,
+                              workers=1) as srv:
+                xs = [_signal(500) for _ in range(3)]
+                ts = [srv.submit(serve.Request("sosfilt", x,
+                                               {"sos": SOS}))
+                      for x in xs]
+                for t in ts:
+                    t.result(timeout=120.0)
+                good = srv.goodput()
+                stats = srv.stats()
+        finally:
+            obs.configure(request_axis=True)
+        snap = obs.snapshot()
+
+        def counter(name):
+            return sum(c["value"] for c in snap["counters"]
+                       if c["name"] == name
+                       and c["labels"].get("op") == "sosfilt"
+                       and c["labels"].get("bucket") == "512")
+
+        assert counter("serve_padding_rows") == 1
+        assert counter("serve_useful_rows") == 3
+        assert counter("serve_dispatched_rows") == 4
+        gauges = {g["name"]: g["value"] for g in snap["gauges"]
+                  if g["labels"].get("op") == "sosfilt"
+                  and g["labels"].get("bucket") == "512"}
+        assert gauges["serve.goodput"] == pytest.approx(0.75)
+        assert gauges["serve.padding_waste"] == pytest.approx(0.25)
+        # the server-side roll-up agrees, per class and overall
+        assert good["sosfilt|512"]["useful_rows"] == 3
+        assert good["sosfilt|512"]["dispatched_rows"] == 4
+        assert good["sosfilt|512"]["goodput"] == pytest.approx(0.75)
+        assert good["overall"]["goodput"] == pytest.approx(0.75)
+        assert stats["goodput"]["overall"]["goodput"] == \
+            pytest.approx(0.75)
+        assert srv.counts()["useful_rows"] == 3
+        assert srv.counts()["dispatched_rows"] == 4
+
 
 # ---------------------------------------------------------------------------
 # admission control + backpressure

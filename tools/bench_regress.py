@@ -143,6 +143,21 @@ DEFAULT_NOISE = [
     # single-request latency on a just-restarted replica (an order
     # statistic of ONE sample, chaos_phase-stamped anyway)
     ("replica restart", 0.50),
+    # the fleet-axis family (obs v5).  "serve goodput" is a useful/
+    # dispatched row RATIO in (0, 1] — mostly deterministic for a
+    # fixed request matrix, but batch formation (and therefore pow2
+    # row padding) shifts with worker/timer scheduling; "fleet signal
+    # lag" is the inverse of one kill-to-signals-visible wall-clock
+    # measurement on the collector tick cadence (an order statistic
+    # of one sample, chaos_phase-stamped anyway); the campaign's
+    # goodput twin rides the same chaos waves
+    ("serve goodput", 0.20),
+    ("fleet signal lag", 0.50),
+    ("replica campaign goodput", 0.25),
+    # the collector-armed twin of "serve tracing overhead": the same
+    # A/B throughput ratio near 1.0, measured while the fleet
+    # collector sweeps in the background — same 5% budget
+    ("fleet tracing overhead", 0.05),
 ]
 
 

@@ -511,8 +511,13 @@ def run_replica_campaign(args) -> tuple:
     RESTART the killed replica (``ReplicaGroup.restart`` — the
     preemption-recovery moment the zero-warmup artifact subsystem
     serves) and assert its first request lands within budget of a
-    survivor's steady state.  Returns ``(invariants, rows,
-    evidence)``."""
+    survivor's steady state.  The fleet axis (obs v5) is gated
+    alongside: the kill must become visible through ``obs.signals()``
+    within bounded collector ticks, a failed-over request must stitch
+    into one cross-replica fleet trace with the original deadline
+    carried, campaign goodput must be a sane fraction, and the
+    tracing-overhead budget must hold with the collector armed.
+    Returns ``(invariants, rows, evidence)``."""
     from veles.simd_tpu.serve import cluster
 
     rng = np.random.RandomState(args.seed)
@@ -521,10 +526,41 @@ def run_replica_campaign(args) -> tuple:
     # wave size so batches wait rather than dispatch instantly
     group = cluster.ReplicaGroup(3, max_batch=32, max_wait_ms=150.0,
                                  workers=args.workers,
-                                 heartbeat_ms=40.0, obs_port=0)
+                                 heartbeat_ms=40.0, obs_port=0,
+                                 # a tight collector cadence so the
+                                 # kill-visibility gate below measures
+                                 # ticks, not seconds
+                                 fleet_tick_ms=25.0)
     router = cluster.FrontRouter(group)
     scrapes: dict = {}
     phase_reports: dict = {}
+
+    # -- fleet-signal kill visibility (obs v5) ----------------------
+    # the autoscaler contract in action: after the abrupt kill, r0
+    # must read non-healthy in obs.signals() within a bounded number
+    # of collector ticks.  The mid_hook stamps the kill, a watcher
+    # thread polls the signals facade (the SAME read path an
+    # autoscaler would use — not the group's internals) until the
+    # state flips.
+    kill_vis = {"t_kill": None, "t_visible": None}
+    watcher: list = []
+
+    def _watch_kill_visibility():
+        deadline = faults.monotonic() + 60 * group.fleet_tick_s + 5.0
+        while faults.monotonic() < deadline:
+            sig = obs.signals()
+            if sig.health.get("r0") not in (None, "healthy"):
+                kill_vis["t_visible"] = faults.monotonic()
+                return
+            threading.Event().wait(group.fleet_tick_s / 5.0)
+
+    def _kill_r0():
+        kill_vis["t_kill"] = faults.monotonic()
+        group.kill("r0")
+        w = threading.Thread(target=_watch_kill_visibility,
+                             daemon=True)
+        w.start()
+        watcher.append(w)
     with group:
         # -- warmup: compile the traffic mix's handles so the kill
         # wave measures routing, not XLA compiles
@@ -542,6 +578,7 @@ def run_replica_campaign(args) -> tuple:
                          for r in group.replicas)
 
         # -- phase 1: abrupt kill, no drain, mid-traffic ------------
+        kill_tickets: list = []
         t0 = time.perf_counter()
         rep_kill = loadgen.run_load(
             router, loadgen.build_schedule(
@@ -549,7 +586,7 @@ def run_replica_campaign(args) -> tuple:
                 deadline_ms=args.deadline_ms),
             verify=args.verify, rng=rng,
             result_timeout=args.result_timeout,
-            mid_hook=lambda: group.kill("r0"))
+            mid_hook=_kill_r0, ticket_sink=kill_tickets)
         rep_kill["phase_wall_s"] = time.perf_counter() - t0
         rep_kill["throughput_rps"] = (
             (rep_kill["ok"] + rep_kill["degraded"])
@@ -560,6 +597,20 @@ def run_replica_campaign(args) -> tuple:
             group.obs_port)
         answered_after_kill = dict(
             router.stats()["answered_by_replica"])
+        if watcher:
+            watcher[0].join(timeout=60 * group.fleet_tick_s + 10.0)
+        fleet_lag_s = (
+            kill_vis["t_visible"] - kill_vis["t_kill"]
+            if kill_vis["t_visible"] is not None
+            and kill_vis["t_kill"] is not None else None)
+        # fish ONE failed-over ticket out of the kill wave and stitch
+        # its cross-replica story into a single fleet trace
+        stitched = None
+        for t in kill_tickets:
+            if getattr(t, "failovers", 0) \
+                    and getattr(t, "prior_traces", None):
+                stitched = obs.stitch_fleet_trace(t)
+                break
 
         # -- phase 2: graceful drain, mid-traffic -------------------
         t0 = time.perf_counter()
@@ -608,6 +659,22 @@ def run_replica_campaign(args) -> tuple:
         lat_restart = time.perf_counter() - t0
         restart_status = restart_ticket.status
 
+        # -- fleet tracing overhead (collector armed) ---------------
+        # the <5% request-axis overhead budget, re-measured while the
+        # fleet collector sweeps the (still-started) group in the
+        # background — the v5 axis must not buy its time series with
+        # request latency.  Same A/B interleave as loadgen's row,
+        # renamed so bench_regress tracks it as its own series (it
+        # still matches the existing "tracing overhead" 5% noise
+        # entry by substring).
+        ov_args = argparse.Namespace(
+            overhead_requests=(80 if args.smoke else 300),
+            workers=args.workers)
+        fleet_overhead = loadgen.overhead_row(ov_args, rng)
+        fleet_overhead["metric"] = "fleet tracing overhead"
+        fleet_overhead.setdefault("telemetry", {})[
+            "collector_armed"] = True
+
     total = _merge_router([warm, rep_kill, rep_drain])
     answered = total["ok"] + total["degraded"]
     drain_delta_survivors = (
@@ -627,6 +694,26 @@ def run_replica_campaign(args) -> tuple:
     # by seconds; in the thread-mode campaign it bounds the restart
     # plumbing (see the phase-3 note above).
     restart_budget_s = max(0.5, 25.0 * lat_survivor)
+    # fleet goodput: useful rows / dispatched rows across the whole
+    # campaign, straight from the _finish_batch counters — a sane
+    # value is a fraction in (0, 1] (pow2 padding means < 1 whenever
+    # any batch padded; == 1 when every row was useful)
+    useful_rows = _counter_total("serve_useful_rows")
+    dispatched_rows = _counter_total("serve_dispatched_rows")
+    campaign_goodput = (useful_rows / dispatched_rows
+                        if dispatched_rows else None)
+    fleet_lag_ticks = (fleet_lag_s / group.fleet_tick_s
+                       if fleet_lag_s is not None else None)
+    stitch_meta = (stitched or {}).get("otherData", {})
+    stitch_events = (stitched or {}).get("traceEvents", [])
+    # both replicas' edges visible: every attempt track carries at
+    # least one lifecycle instant event, and ≥2 distinct replicas
+    # appear on the attempt list
+    stitch_tids = {e.get("tid") for e in stitch_events
+                   if e.get("ph") == "i"
+                   and e.get("name") != "failover_hop"}
+    stitch_dls = [d for d in stitch_meta.get("deadlines_ms", ())
+                  if d is not None]
     invariants = {
         "zero_lost": total["lost"] == 0,
         "zero_double_answered": (
@@ -684,6 +771,39 @@ def run_replica_campaign(args) -> tuple:
             answered + total["shed"] + total["deadline_miss"]
             + total["closed"] + total["errors"]
             == total["requests"]),
+        # -- fleet axis (obs v5) --------------------------------
+        # the kill became visible through obs.signals() — the
+        # autoscaler read path, not group internals — within a
+        # bounded number of collector ticks (generous 60-tick CI
+        # bound; typically 1-2 ticks of 25 ms)
+        "fleet_kill_visible": (
+            fleet_lag_ticks is not None and fleet_lag_ticks <= 60.0),
+        # one failed-over request stitched into ONE fleet trace:
+        # ≥2 attempts on ≥2 distinct replicas, every attempt track
+        # carrying lifecycle edges
+        "fleet_trace_stitched": (
+            stitched is not None
+            and stitch_meta.get("attempts", 0) >= 2
+            and len(set(stitch_meta.get("replicas", ()))) >= 2
+            and stitch_tids >= set(
+                range(1, stitch_meta.get("attempts", 0) + 1))),
+        # the stitched per-attempt deadline stamps only ever shrink —
+        # the carried-deadline proof, readable off the fleet trace
+        "fleet_trace_deadline_carried": (
+            len(stitch_dls) >= 2
+            and all(later <= earlier + 1e-6 for earlier, later
+                    in zip(stitch_dls, stitch_dls[1:]))),
+        # goodput is a sane fraction: some rows dispatched, useful
+        # never exceeds dispatched
+        "fleet_goodput_sane": (
+            campaign_goodput is not None
+            and 0.0 < campaign_goodput <= 1.0),
+        # the request axis stays affordable with the collector
+        # sweeping (loose in-campaign floor; the tight 5% gate is
+        # bench_regress's, via the "tracing overhead" noise entry)
+        "fleet_tracing_overhead_ok": (
+            fleet_overhead["value"] is not None
+            and fleet_overhead["value"] >= 0.80),
     }
 
     rows = [
@@ -722,6 +842,25 @@ def run_replica_campaign(args) -> tuple:
         "vs_baseline": None, "chaos_phase": "replica_kill",
         "telemetry": {"counters": counters},
     })
+    if fleet_lag_s:
+        rows.append({
+            # higher-is-better form (1/lag) so the gate's floor logic
+            # applies; one kill-to-visible wall-clock sample on the
+            # collector cadence
+            "metric": "fleet signal lag",
+            "value": round(1.0 / fleet_lag_s, 3), "unit": "1/s",
+            "vs_baseline": None, "chaos_phase": "replica_kill",
+            "telemetry": {"lag_s": round(fleet_lag_s, 4),
+                          "lag_ticks": round(fleet_lag_ticks, 2),
+                          "tick_s": group.fleet_tick_s}})
+    if campaign_goodput is not None:
+        rows.append({
+            "metric": "replica campaign goodput",
+            "value": round(campaign_goodput, 4),
+            "unit": "useful/dispatched rows", "vs_baseline": None,
+            "telemetry": {"useful_rows": useful_rows,
+                          "dispatched_rows": dispatched_rows}})
+    rows.append(fleet_overhead)
     evidence = {
         "replica_invariants": invariants,
         "restart": {"first_request_s": lat_restart,
@@ -742,6 +881,13 @@ def run_replica_campaign(args) -> tuple:
         "router_failover_events": _decisions("router_failover"),
         "scrapes": scrapes,
         "group": group_stats,
+        "fleet": {
+            "tick_s": group.fleet_tick_s,
+            "kill_visible_lag_s": fleet_lag_s,
+            "kill_visible_lag_ticks": fleet_lag_ticks,
+            "goodput": campaign_goodput,
+            "stitched_trace": stitch_meta,
+        },
     }
     return invariants, rows, evidence
 

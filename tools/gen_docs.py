@@ -68,6 +68,7 @@ MODULES = [
     "veles.simd_tpu.obs.spans",
     "veles.simd_tpu.obs.resources",
     "veles.simd_tpu.obs.requests",
+    "veles.simd_tpu.obs.timeseries",
     "veles.simd_tpu.obs.http",
     "veles.simd_tpu.obs.flightrec",
     "veles.simd_tpu.cshim",

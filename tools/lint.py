@@ -651,7 +651,7 @@ _BATCHED_MOD = "veles.simd_tpu.ops.batched"
 _SERVE_OBS_HELPERS = {"span", "count", "gauge", "observe",
                       "record_decision", "quantiles",
                       "request_trace", "request_summary",
-                      "slo_snapshot"}
+                      "slo_snapshot", "fleet_record", "signals"}
 
 
 def _serve_aliases(tree) -> tuple:
@@ -921,6 +921,86 @@ def cluster_router_errors(tree, fname) -> list:
                 "go through the one guarded path that carries the "
                 "original request deadline and handles typed "
                 "placement failure")
+    return errors
+
+
+# --- fleet funnel rule (serve/) ---------------------------------------------
+# PR 16's fleet axis (obs v5) has the same one-funnel shape as the
+# router rule above: ``ReplicaGroup._collect_fleet_sample`` is the ONE
+# place serve-layer code may read cross-replica metrics — it owns the
+# tick cadence, the stale-scrape accounting (a dead subprocess replica
+# becomes a counted ``fleet_scrape_stale``, never an exception), and
+# the write into ``obs.fleet_series()``.  Ad-hoc scraping beside it —
+# a helper that calls ``obs.export.parse_prometheus`` on a replica's
+# /metrics body, or walks ``obs.snapshot()`` / ``obs.to_prometheus()``
+# / ``obs.fleet_series()`` from router code — forks the fleet's view:
+# two readers with two cadences disagree about staleness, and the
+# autoscaler contract (``obs.signals()``) silently stops being the
+# single source of truth.  So in every serve module, OUTSIDE the
+# funnel's body, these are lint failures:
+#
+# * any ``<expr>.parse_prometheus(...)`` call, and any call of a name
+#   imported from ``veles.simd_tpu.obs.export`` as parse_prometheus
+#   (alias-tracked);
+# * ``obs.snapshot(...)`` / ``obs.to_prometheus(...)`` /
+#   ``obs.fleet_series(...)`` through any alias of the obs facade.
+#
+# ``obs.signals()`` itself stays legal everywhere — it IS the funnel's
+# product, the read side of the contract.
+
+_FLEET_FUNNEL = "_collect_fleet_sample"
+_FLEET_READ_HELPERS = {"snapshot", "to_prometheus", "fleet_series"}
+_OBS_EXPORT_MOD = "veles.simd_tpu.obs.export"
+
+
+def _export_parse_aliases(tree) -> set:
+    """Names this module binds to ``obs.export.parse_prometheus``
+    directly (``from veles.simd_tpu.obs.export import parse_prometheus
+    [as p]``)."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) \
+                and node.module == _OBS_EXPORT_MOD:
+            for a in node.names:
+                if a.name == "parse_prometheus":
+                    names.add(a.asname or a.name)
+    return names
+
+
+def fleet_funnel_errors(tree, fname) -> list:
+    """The rule body on a parsed module (separated so tests can feed
+    synthetic sources).  Returns human-readable error strings."""
+    errors = []
+    obs_names = _serve_aliases(tree)[5]
+    parse_names = _export_parse_aliases(tree)
+    funnel_nodes: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == _FLEET_FUNNEL:
+            funnel_nodes.update(id(w) for w in ast.walk(node))
+
+    def _flag(node, what):
+        errors.append(
+            f"{fname}:{node.lineno}: cross-replica metrics read "
+            f"({what}) outside the {_FLEET_FUNNEL} funnel — serve-"
+            "layer code reads fleet state through the collector/"
+            "obs.signals() contract, the one path that owns tick "
+            "cadence and stale-scrape accounting")
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) \
+                or id(node) in funnel_nodes:
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "parse_prometheus":
+                _flag(node, f"{_dotted_chain(f) or '...'}(...)")
+            elif (f.attr in _FLEET_READ_HELPERS
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in obs_names):
+                _flag(node, f"{f.value.id}.{f.attr}(...)")
+        elif isinstance(f, ast.Name) and f.id in parse_names:
+            _flag(node, f"{f.id}(...)")
     return errors
 
 
@@ -1291,6 +1371,12 @@ def compute_module_lint(files) -> int:
                 print(msg)
                 failures += 1
             for msg in request_trace_errors(tree, str(f)):
+                print(msg)
+                failures += 1
+            # fleet reads funnel through ONE collector path in every
+            # serve module (obs v5 — cluster.py owns the funnel, the
+            # rest of serve/ must not scrape beside it)
+            for msg in fleet_funnel_errors(tree, str(f)):
                 print(msg)
                 failures += 1
             if rel == _CLUSTER_RULE_FILE:

@@ -321,7 +321,8 @@ def run_load(server, schedule, *, block: bool = False,
              block_timeout: float | None = 1.0,
              result_timeout: float = 120.0,
              verify: int = 0, rng=None,
-             mid_hook=None, mid_hook_after: int | None = None) -> dict:
+             mid_hook=None, mid_hook_after: int | None = None,
+             ticket_sink: list | None = None) -> dict:
     """Submit ``schedule`` against ``server``, wait for every ticket,
     and return the accounting report (see module docstring for the
     categories).  ``verify=k`` parity-checks ``k`` randomly sampled
@@ -331,7 +332,10 @@ def run_load(server, schedule, *, block: bool = False,
     a ``serve.cluster.FrontRouter``.  ``mid_hook`` is called once,
     MID-TRAFFIC, after ``mid_hook_after`` submissions (default:
     halfway) — the replicated chaos campaign's replica kill/drain
-    trigger, fired while work is genuinely queued."""
+    trigger, fired while work is genuinely queued.  ``ticket_sink``
+    (a caller-owned list) collects every settled ticket — how the
+    chaos campaign fishes a failed-over ``RouterTicket`` out of the
+    traffic for ``obs.stitch_fleet_trace``."""
     t0 = time.perf_counter()
     if mid_hook is not None and mid_hook_after is None:
         mid_hook_after = len(schedule) // 2
@@ -413,6 +417,8 @@ def run_load(server, schedule, *, block: bool = False,
         if ticket.wait_s is not None:
             waits.append(ticket.wait_s)
     report["wall_s"] = time.perf_counter() - t0
+    if ticket_sink is not None:
+        ticket_sink.extend(t for _, t in pairs)
     _account_traces(report, [t for _, t in pairs])
     # per-tenant fairness under overload: the max/min ANSWERED RATIO
     # (answered[t] / submitted[t] — raw counts would read random
@@ -489,6 +495,23 @@ def bench_rows(report: dict) -> list:
         })
     if obs.enabled():
         snap = obs.snapshot()
+        # serve goodput: useful rows ÷ dispatched rows, straight from
+        # the _finish_batch counters — the fraction of MXU row-work
+        # that served a request instead of pow2 padding (ROADMAP item
+        # 3's padding-waste baseline, now a gated bench row)
+        useful = sum(c["value"] for c in snap["counters"]
+                     if c["name"] == "serve_useful_rows")
+        dispatched = sum(c["value"] for c in snap["counters"]
+                         if c["name"] == "serve_dispatched_rows")
+        if dispatched:
+            rows.append({
+                "metric": "serve goodput",
+                "value": round(useful / dispatched, 4),
+                "unit": "useful/dispatched rows",
+                "vs_baseline": None,
+                "telemetry": {"useful_rows": useful,
+                              "dispatched_rows": dispatched},
+            })
         rows.append({"metric": "serve batches",
                      "value": float(sum(
                          c["value"] for c in snap["counters"]

@@ -17,14 +17,23 @@ its three routes:
   slowest-per-op exemplars with their phase decomposition, recent
   degraded traces.
 
+``--fleet`` switches to the fleet axis: one screen rendered from
+``/signals`` (the typed ``obs.signals()`` bundle the ``ReplicaGroup``
+collector feeds) — per-replica health/staleness/queue-depth/breaker
+rows, goodput by shape class, SLO burn + velocity, and a unicode
+sparkline over the last-N windowed samples of each per-replica series.
+Point it at the ROUTER's aggregation endpoint; a lone server answers
+with an empty fleet.
+
 One shot by default; ``--watch N`` redraws every N seconds until
-interrupted.  rc=1 when the endpoint is unreachable — the dashboard
-doubles as a liveness probe in scripts.
+interrupted (``--fleet`` included).  rc=1 when the endpoint is
+unreachable — the dashboard doubles as a liveness probe in scripts.
 
 Usage::
 
     python tools/obs_dash.py --port 9100
     python tools/obs_dash.py --url http://127.0.0.1:9100 --watch 2
+    python tools/obs_dash.py --port 9100 --fleet --watch 1
 """
 
 from __future__ import annotations
@@ -114,6 +123,87 @@ def _compile_lines(prom: str) -> list:
     return lines
 
 
+# eight levels is what a terminal cell resolves; the ramp is the
+# conventional one every sparkline tool uses
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width: int = 32) -> str:
+    """The last ``width`` samples as a unicode sparkline.  Scaled to
+    the rendered window's own min..max (a flat series renders as all-
+    low, which reads correctly as 'nothing moving')."""
+    vals = [float(v) for v in values][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK[0] * len(vals)
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1,
+                   int((v - lo) / span * len(_SPARK)))]
+        for v in vals)
+
+
+def render_fleet(base_url: str) -> tuple:
+    """One fleet-axis frame from ``/signals``; ``(text, reachable)``."""
+    lines = [f"== fleet dash @ {base_url} =="]
+    try:
+        code, body = fetch(base_url + "/signals")
+    except Exception as e:  # noqa: BLE001 — unreachable is the answer
+        return (f"{lines[0]}\nendpoint unreachable: {e!r}\n", False)
+    if code != 200:
+        lines.append(f"/signals answered HTTP {code}")
+        return "\n".join(lines) + "\n", True
+    try:
+        sig = json.loads(body)
+    except ValueError:
+        return f"{lines[0]}\n  (unparseable /signals body)\n", True
+    lines.append(
+        "ticks=%s  tick=%.3gs  window=%s  queue_total=%s" % (
+            sig.get("ticks"), sig.get("tick_s") or 0.0,
+            sig.get("window"), sig.get("queue_depth_total")))
+    burn = sig.get("slo_burn") or {}
+    vel = sig.get("slo_burn_velocity") or {}
+    for tenant in sorted(burn):
+        lines.append("  slo burn %-20s %10.4g  velocity %s" % (
+            tenant, burn[tenant], _fmt_s(vel.get(tenant))))
+    health = sig.get("health") or {}
+    stale = sig.get("staleness_s") or {}
+    depth = sig.get("queue_depth") or {}
+    b_open = sig.get("breaker_open") or {}
+    b_flaps = sig.get("breaker_flaps") or {}
+    scrape = sig.get("scrape_stale") or {}
+    if health:
+        lines.append("replicas:")
+    for rid in sorted(health):
+        lines.append(
+            "  %-8s %-9s stale=%-8s depth=%-6s breaker_open=%-3s "
+            "flaps=%-3s scrape_stale=%s" % (
+                rid, health[rid], _fmt_s(stale.get(rid)),
+                depth.get(rid, "-"), b_open.get(rid, 0),
+                b_flaps.get(rid, 0), scrape.get(rid, 0)))
+    good = sig.get("goodput") or {}
+    overall = sig.get("goodput_overall")
+    if good or overall is not None:
+        lines.append("goodput (useful rows / dispatched rows):")
+        if overall is not None:
+            lines.append("  %-40s %8.4f" % ("overall", overall))
+        for key in sorted(good):
+            lines.append("  %-40s %8.4f" % (key, good[key]))
+    series = sig.get("series") or {}
+    if series:
+        lines.append("series (last-N window):")
+    for rid in sorted(series):
+        for name in sorted(series[rid]):
+            samples = series[rid][name] or []
+            vals = [s[1] for s in samples]
+            lines.append("  %-8s %-16s %10s  %s" % (
+                rid, name, "%g" % vals[-1] if vals else "-",
+                sparkline(vals)))
+    return "\n".join(lines) + "\n", True
+
+
 def render(base_url: str) -> tuple:
     """One dashboard frame; returns ``(text, reachable)``."""
     lines = [f"== obs dash @ {base_url} =="]
@@ -198,6 +288,10 @@ def main(argv=None) -> int:
                          "$VELES_SIMD_OBS_PORT)")
     ap.add_argument("--watch", type=float, default=0.0,
                     help="redraw every N seconds (0 = one shot)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="render the fleet axis from /signals "
+                         "(point at the router's aggregation "
+                         "endpoint)")
     args = ap.parse_args(argv)
     base = args.url
     if base is None:
@@ -212,8 +306,9 @@ def main(argv=None) -> int:
             return 2
         base = f"http://127.0.0.1:{port}"
     base = base.rstrip("/")
+    frame = render_fleet if args.fleet else render
     while True:
-        text, reachable = render(base)
+        text, reachable = frame(base)
         sys.stdout.write(text)
         sys.stdout.flush()
         if not reachable:

@@ -12,7 +12,9 @@ queue/tenant depths, per-status outcome tallies with shed and
 deadline-miss rates, per-(op, status) request-latency quantiles,
 degraded-batch counts, latest breaker states, and the request-axis +
 per-tenant SLO summaries (BENCH_DETAILS mode gets the per-config
-``serve_*`` counter block).  ``--prometheus`` converts a full snapshot
+``serve_*`` counter block), and a Fleet section when the snapshot
+carries the fleet axis (obs v5: the ``ReplicaGroup`` collector's
+per-replica windowed series — last value, delta, flap count).  ``--prometheus`` converts a full snapshot
 to the Prometheus text exposition format instead, so a file captured
 on a TPU host can be pushed through a gateway later.
 
@@ -149,6 +151,34 @@ def _serving_section(snap) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _fleet_section(snap) -> str:
+    """The fleet axis (obs v5): per-replica windowed series captured
+    by the ``ReplicaGroup`` collector — last value, windowed delta,
+    and flap count per (replica, series), plus the tick/window
+    bookkeeping.  Rendered whenever the snapshot carries a non-empty
+    ``fleet`` block (``obs.snapshot()`` embeds it; pre-v5 snapshots
+    simply lack the key)."""
+    fleet = snap.get("fleet")
+    if not isinstance(fleet, dict) or not fleet.get("series"):
+        return ""
+    lines = ["", "fleet (windowed series, %s ticks @ %ss, window %s):"
+             % (fleet.get("ticks"), fleet.get("tick_s"),
+                fleet.get("window"))]
+    for rid in sorted(fleet["series"]):
+        for name in sorted(fleet["series"][rid]):
+            samples = fleet["series"][rid][name] or []
+            vals = [s[1] for s in samples]
+            delta = vals[-1] - vals[0] if len(vals) >= 2 else None
+            flaps = sum(1 for a, b in zip(vals, vals[1:])
+                        if abs(b - a) > 1e-9)
+            lines.append(
+                "  %-10s %-24s last=%-10g n=%-5d delta=%s flaps=%d"
+                % (rid, name, vals[-1] if vals else float("nan"),
+                   len(vals),
+                   "-" if delta is None else "%g" % delta, flaps))
+    return "\n".join(lines) + "\n"
+
+
 def _bench_serving_lines(counters: dict, indent="  ") -> list:
     """The BENCH_DETAILS-mode serving block: a per-config tally of
     the ``serve_*`` counters the telemetry dict embeds."""
@@ -248,6 +278,7 @@ def main(argv=None) -> int:
     sys.stdout.write(_latency_section(data))
     sys.stdout.write(_artifact_section(data))
     sys.stdout.write(_serving_section(data))
+    sys.stdout.write(_fleet_section(data))
     return 0
 
 

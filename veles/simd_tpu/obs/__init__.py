@@ -41,9 +41,22 @@ is the accounting layer for every dispatch-time decision:
   accounting (:func:`slo`: burn-rate gauges, breach decision events);
 * **a live scrape endpoint** — :mod:`~veles.simd_tpu.obs.http`: a
   stdlib ``http.server`` serving ``/metrics`` (Prometheus text),
-  ``/healthz`` (server health + breakers, 503 while degraded), and
-  ``/debug/requests`` (recent traces + exemplars + SLO accounts);
+  ``/healthz`` (server health + breakers, 503 while degraded),
+  ``/debug/requests`` (recent traces + exemplars + SLO accounts), and
+  ``/signals`` (the typed fleet-signal bundle);
   armed by ``serve.Server.start`` via ``$VELES_SIMD_OBS_PORT``;
+* **fleet time series + typed signals — the fleet axis** —
+  :mod:`~veles.simd_tpu.obs.timeseries`: bounded per-(replica,
+  series) sample rings with windowed derivatives (rates, deltas,
+  EWMA) and flap counting, fed by the
+  :class:`~veles.simd_tpu.serve.cluster.ReplicaGroup` collector
+  thread; :func:`signals` assembles the typed
+  :class:`~veles.simd_tpu.obs.timeseries.FleetSignals` bundle (slo
+  burn + velocity, queue depths, breaker open/flaps, goodput,
+  per-replica health/staleness) — the autoscaler input contract —
+  and :func:`stitch_fleet_trace` merges a failed-over request's
+  per-replica traces into one Perfetto-loadable fleet trace
+  (``save_trace(path, fleet=ticket)``);
 * **a crash flight recorder** — :mod:`~veles.simd_tpu.obs.flightrec`:
   an exception escaping a top-level dispatch span (or an explicit
   :func:`dump_debug_bundle` call) atomically writes config, platform,
@@ -96,6 +109,7 @@ separate layers.
 from __future__ import annotations
 
 import os
+import time
 
 from veles.simd_tpu.obs import compile as _compile
 from veles.simd_tpu.obs import export as _export
@@ -103,6 +117,7 @@ from veles.simd_tpu.obs import flightrec as _flightrec
 from veles.simd_tpu.obs import requests as _requests_mod
 from veles.simd_tpu.obs import resources as _resources
 from veles.simd_tpu.obs import spans as _spans_mod
+from veles.simd_tpu.obs import timeseries as _timeseries
 from veles.simd_tpu.obs.atomic import atomic_write_text as _atomic_write
 from veles.simd_tpu.obs.events import EventLog
 from veles.simd_tpu.obs.lru import LRUSet
@@ -112,6 +127,8 @@ from veles.simd_tpu.obs.resources import (InstrumentedJit,
                                           instrumented_jit,
                                           register_cache)
 from veles.simd_tpu.obs.spans import SpanTracer
+from veles.simd_tpu.obs.timeseries import (FleetSeries, FleetSignals,
+                                           stitch_fleet_trace)
 
 __all__ = [
     "enable", "disable", "enabled", "configure",
@@ -121,16 +138,19 @@ __all__ = [
     "save_trace", "trace_events",
     "request_trace", "slo", "slo_snapshot", "request_snapshot",
     "request_summary",
+    "signals", "fleet_record", "fleet_series", "stitch_fleet_trace",
     "install_compile_listeners",
     "instrumented_jit", "resources", "caches", "register_cache",
     "dump_debug_bundle",
     "MetricsRegistry", "EventLog", "SpanTracer", "InstrumentedJit",
     "RequestTrace", "RequestTracer", "LRUSet",
+    "FleetSeries", "FleetSignals",
 ]
 
 _TRUTHY = ("1", "true", "yes", "on")
 
 _registry = MetricsRegistry()
+_fleet = _timeseries.FleetSeries()
 _events = EventLog()
 _spans = SpanTracer(_registry.observe)
 _spans.on_crash = _flightrec.maybe_record_crash
@@ -342,6 +362,39 @@ def request_snapshot(recent: int = 50) -> dict:
     return _requests.traces_snapshot(recent)
 
 
+def fleet_series() -> _timeseries.FleetSeries:
+    """The live fleet store (obs v5): bounded per-(replica, series)
+    sample rings.  The :class:`veles.simd_tpu.serve.cluster.
+    ReplicaGroup` collector thread writes it via :func:`fleet_record`;
+    read it through :func:`signals` (the typed contract) or this
+    handle (tests, tooling)."""
+    return _fleet
+
+
+def fleet_record(replica: str, series: str, value: float,
+                 t_s: float) -> None:
+    """Record one fleet-axis sample (no-op while disabled) — the
+    collector's write funnel: ``(replica, series)`` names the ring,
+    ``t_s`` is the sweep's shared monotonic stamp."""
+    if not _enabled:
+        return
+    _fleet.record(replica, series, value, t_s)
+
+
+def signals() -> _timeseries.FleetSignals:
+    """One consistent read of the fleet axis: the typed
+    :class:`~veles.simd_tpu.obs.timeseries.FleetSignals` bundle
+    (slo burn + velocity, queue depths, breaker open/flap counts,
+    goodput per shape class, per-replica health/staleness) — the
+    documented autoscaler input contract, also served as ``/signals``
+    on the scrape endpoint and rendered by ``tools/obs_dash.py
+    --fleet``.  Built from the fleet store, the metrics registry, and
+    the SLO accounts; cheap enough to poll on the collector cadence."""
+    return _timeseries.FleetSignals.from_sources(
+        _fleet, _registry.snapshot(), _requests.slo_snapshot(),
+        now=time.monotonic())
+
+
 def record_decision(op: str, decision: str, **fields) -> None:
     """Log one dispatch decision (no-op while disabled).
 
@@ -402,6 +455,7 @@ def snapshot() -> dict:
     snap["caches"] = _resources.caches_snapshot()
     snap["requests"] = _requests.summary()
     snap["slo"] = _requests.slo_snapshot()
+    snap["fleet"] = _fleet.snapshot()
     snap["enabled"] = _enabled
     return snap
 
@@ -448,6 +502,7 @@ def reset() -> None:
     _spans.reset()
     _resources.reset()
     _requests.reset()
+    _fleet.reset()
 
 
 def to_json(snap: dict | None = None, indent: int | None = 2) -> str:
@@ -473,13 +528,24 @@ def save(path: str, snap: dict | None = None) -> str:
                                        else snapshot()))
 
 
-def save_trace(path: str) -> str:
-    """Atomically write the retained spans as Chrome trace-event JSON.
+def save_trace(path: str, fleet=None) -> str:
+    """Atomically write Chrome trace-event JSON.
 
-    The file loads directly in Perfetto (https://ui.perfetto.dev) or
-    ``chrome://tracing``: one complete ("X") event per span, per-thread
-    tracks, warmup/steady phase and the span's attributes under
-    ``args``.  Returns ``path``."""
+    Default: the retained spans — one complete ("X") event per span,
+    per-thread tracks, warmup/steady phase and the span's attributes
+    under ``args``.  With ``fleet=`` (a failed-over
+    :class:`~veles.simd_tpu.serve.cluster.RouterTicket`, or an
+    already-stitched dict from :func:`stitch_fleet_trace`): the
+    cross-replica fleet trace instead — every attempt's edges on its
+    own track with failover hops and carried deadlines visible.
+    Either way the file loads directly in Perfetto
+    (https://ui.perfetto.dev) or ``chrome://tracing``.  Returns
+    ``path``."""
+    if fleet is not None:
+        stitched = fleet if isinstance(fleet, dict) \
+            else _timeseries.stitch_fleet_trace(fleet)
+        return _atomic_write(
+            path, _export.to_json(stitched, indent=None))
     return _atomic_write(
         path, _export.to_json(_spans.to_chrome_trace(), indent=None))
 

@@ -19,7 +19,15 @@ as the rest of the tooling):
 * ``GET /debug/requests`` — JSON: the request axis
   (:mod:`veles.simd_tpu.obs.requests`): recent completed traces,
   slowest-per-op and degraded exemplars, and the per-tenant SLO
-  accounts.
+  accounts;
+* ``GET /signals`` — JSON: the fleet axis
+  (:mod:`veles.simd_tpu.obs.timeseries`): the typed
+  ``obs.signals()`` bundle — slo burn + velocity, queue depths,
+  breaker open/flap counts, goodput, per-replica health/staleness,
+  plus the raw windowed series tails (``tools/obs_dash.py --fleet``
+  sparklines from exactly this body).  Meaningful on the router
+  aggregation endpoint (the ``ReplicaGroup`` collector feeds the
+  store); on a lone server it answers with an empty fleet.
 
 Arming: :meth:`veles.simd_tpu.serve.Server.start` reads
 ``$VELES_SIMD_OBS_PORT`` (or its ``obs_port=`` argument; port 0 binds
@@ -113,11 +121,17 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 self._send(200, json.dumps(obs.request_snapshot(),
                                            indent=2, default=str),
                            "application/json")
+            elif path == "/signals":
+                from veles.simd_tpu import obs
+
+                self._send(200, json.dumps(obs.signals().to_dict(),
+                                           indent=2, default=str),
+                           "application/json")
             else:
                 self._send(404, json.dumps(
                     {"error": "unknown path",
                      "routes": ["/metrics", "/healthz",
-                                "/debug/requests"]}),
+                                "/debug/requests", "/signals"]}),
                     "application/json")
         except BrokenPipeError:
             pass        # scraper hung up mid-response: its problem

@@ -47,7 +47,20 @@ the way PRs 9-10 proved a single server survives losing a device:
   per-replica endpoints stay disarmed in thread mode): ``/healthz``
   answers 200 while at least one replica is up and healthy, 503 once
   none is — the load-balancer contract, live through kills and drains
-  (the replicated chaos campaign gates exactly that).
+  (the replicated chaos campaign gates exactly that);
+* **fleet collector — the obs v5 feed** — a collector thread sweeps
+  the group every ``$VELES_SIMD_FLEET_TICK_MS`` (default 100 ms):
+  in-process replicas are sampled directly (depth / health /
+  completed counts / open breakers), subprocess replicas are scraped
+  over their existing ``/metrics`` endpoints (a failed scrape is a
+  counted ``fleet_scrape_stale``, never a crash), and every sample
+  lands in the bounded fleet store
+  (:mod:`veles.simd_tpu.obs.timeseries`, window
+  ``$VELES_SIMD_FLEET_WINDOW``).  ``obs.signals()`` reads the typed
+  bundle back out; the aggregation endpoint serves it as
+  ``/signals``.  ``_collect_fleet_sample`` is THE cross-replica
+  metrics funnel (lint-enforced): serve/cluster code never scrapes
+  registries ad hoc.
 
 **Spawn modes.** ``spawn="thread"`` (default) runs replicas as
 in-process servers — the CI topology, and the only one the router can
@@ -89,7 +102,9 @@ import sys
 import threading
 
 from veles.simd_tpu import obs
+from veles.simd_tpu.obs import export as obs_export
 from veles.simd_tpu.obs import http as obs_http
+from veles.simd_tpu.obs import timeseries as _timeseries
 from veles.simd_tpu.runtime import breaker as _breaker
 from veles.simd_tpu.runtime import faults
 from veles.simd_tpu.serve.admission import Overloaded
@@ -387,6 +402,7 @@ class ReplicaGroup:
                  heartbeat_ms: float | None = None,
                  miss_limit: int = DEFAULT_MISS_LIMIT,
                  obs_port: int | None = None,
+                 fleet_tick_ms: float | None = None,
                  **server_kwargs):
         n = int(replicas) if replicas else env_replicas()
         if n < 1:
@@ -413,6 +429,12 @@ class ReplicaGroup:
         self._hb_stop = threading.Event()
         self._hb_thread = None
         self._probers: list = []
+        # fleet collector (obs v5): cadence from fleet_tick_ms= or
+        # $VELES_SIMD_FLEET_TICK_MS; the thread starts in start()
+        self.fleet_tick_s = (float(fleet_tick_ms) / 1e3
+                             if fleet_tick_ms
+                             else _timeseries.env_tick_s())
+        self._collector_thread = None
         self._started = False
 
     # -- lifecycle ---------------------------------------------------------
@@ -453,6 +475,10 @@ class ReplicaGroup:
             target=self._heartbeat_loop, daemon=True,
             name="veles-replica-heartbeat")
         self._hb_thread.start()
+        self._collector_thread = threading.Thread(
+            target=self._collector_loop, daemon=True,
+            name="veles-fleet-collector")
+        self._collector_thread.start()
         obs.gauge("replica_alive", float(self.alive()))
         obs.record_decision("replica_lifecycle", "group_start",
                             replicas=len(self.replicas),
@@ -466,6 +492,9 @@ class ReplicaGroup:
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=5.0)
             self._hb_thread = None
+        if self._collector_thread is not None:
+            self._collector_thread.join(timeout=5.0)
+            self._collector_thread = None
         for t in self._probers:
             # a prober wedged inside a replica's ping cannot be
             # joined — it is daemon-contained, not waited on
@@ -682,6 +711,101 @@ class ReplicaGroup:
                         r, f"no heartbeat for {now - ref:.2f}s "
                            f"(stale after {stale_s:.2f}s)")
 
+    # -- fleet collector (obs v5) ------------------------------------------
+    #
+    # One daemon thread sweeping the whole group on the fleet tick:
+    # strictly additive telemetry — a sweep never mutates replica
+    # state, never blocks intake, and never raises out of its loop.
+    # Thread-mode replicas are sampled in-process (lock-cheap depth /
+    # health / count reads); subprocess replicas are scraped over
+    # their own /metrics endpoints, where a dead or wedged child is a
+    # COUNTED fleet_scrape_stale and a widening staleness_s in the
+    # signals, never an exception (the child's liveness verdict
+    # belongs to the heartbeat machinery, not the collector).
+
+    def _collector_loop(self) -> None:
+        while not self._hb_stop.wait(self.fleet_tick_s):
+            try:
+                self._collect_fleet_sample()
+            except Exception:  # noqa: BLE001 — sampling never kills
+                obs.count("fleet_collector_error")
+
+    def _collect_fleet_sample(self) -> None:
+        """THE cross-replica metrics funnel (lint-enforced —
+        tools/lint.py fleet funnel rule): the only place serve/cluster
+        code may read another replica's metrics (in-process reads,
+        ``/metrics`` scrapes, registry walks).  Everything it learns
+        lands in the fleet store via ``obs.fleet_record``; consumers
+        read it back through the typed ``obs.signals()`` facade."""
+        now = faults.monotonic()
+        store = obs.fleet_series()
+        store.tick_s = self.fleet_tick_s
+        breakers = None
+        total_depth = 0.0
+        for r in self.replicas:
+            obs.fleet_record(r.rid, "up",
+                             1.0 if r.state == UP else 0.0, t_s=now)
+            if r.state != UP:
+                continue
+            if r.spawn == "thread":
+                depth = float(r.server.depth())
+                counts = r.server.counts()
+                obs.fleet_record(r.rid, "depth", depth, t_s=now)
+                obs.fleet_record(
+                    r.rid, "healthy",
+                    1.0 if r.server.health == "healthy" else 0.0,
+                    t_s=now)
+                obs.fleet_record(r.rid, "completed",
+                                 float(counts["completed"]), t_s=now)
+                obs.fleet_record(r.rid, "shed",
+                                 float(counts["shed"]), t_s=now)
+                total_depth += depth
+                if breakers is None:    # one registry walk per sweep
+                    breakers = _breaker.snapshot()
+                opens = sum(
+                    1 for b in breakers
+                    if b["site"] in ("serve.dispatch",
+                                     "pipeline.dispatch")
+                    and b["state"] == _breaker.OPEN
+                    and b["key"].startswith(f"('{r.rid}'"))
+                obs.fleet_record(r.rid, "breaker_open",
+                                 float(opens), t_s=now)
+            else:
+                import urllib.request
+
+                url = (f"http://{obs_http.BIND_HOST}:{r.port}"
+                       f"/metrics")
+                try:
+                    with urllib.request.urlopen(
+                            url, timeout=max(1.0,
+                                             2 * self.fleet_tick_s)
+                            ) as resp:
+                        text = resp.read().decode("utf-8")
+                    parsed = obs_export.parse_prometheus(text)
+                except Exception:  # noqa: BLE001 — counted staleness
+                    obs.count("fleet_scrape_stale", replica=r.rid)
+                    continue
+                completed = sum(
+                    v for (name, _), v in parsed.items()
+                    if name == "veles_simd_serve_completed_total")
+                obs.fleet_record(r.rid, "completed", completed,
+                                 t_s=now)
+                obs.fleet_record(r.rid, "scraped_series",
+                                 float(len(parsed)), t_s=now)
+                obs.fleet_record(
+                    r.rid, "healthy",
+                    0.0 if r.last_health == "degraded" else 1.0,
+                    t_s=now)
+        obs.fleet_record("_fleet", "queue_depth_total", total_depth,
+                         t_s=now)
+        for tenant, acct in sorted(
+                (obs.slo_snapshot().get("accounts") or {}).items()):
+            burn = acct.get("burn_rate")
+            if burn is not None:
+                obs.fleet_record("_fleet", f"slo_burn:{tenant}",
+                                 float(burn), t_s=now)
+        store.tick()
+
     # -- introspection -----------------------------------------------------
 
     @property
@@ -730,8 +854,8 @@ class RouterTicket:
 
     __slots__ = ("rid", "op", "tenant", "status", "wait_s", "trace",
                  "replica", "failovers", "prior_traces",
-                 "deadlines_ms", "_event", "_value", "_error",
-                 "_lock")
+                 "deadlines_ms", "attempt_replicas", "_event",
+                 "_value", "_error", "_lock")
 
     def __init__(self, rid: int, op: str, tenant: str):
         self.rid = rid
@@ -744,6 +868,10 @@ class RouterTicket:
         self.failovers = 0
         self.prior_traces: list = []
         self.deadlines_ms: list = []
+        # the replica each attempt was placed on, in attempt order —
+        # what lets obs.stitch_fleet_trace name every track of the
+        # stitched fleet trace
+        self.attempt_replicas: list = []
         self._event = threading.Event()
         self._value = None
         self._error = None
@@ -977,6 +1105,7 @@ class FrontRouter:
                     self._placed.get(target.rid, 0) + 1
             obs.count("router_placed", replica=target.rid,
                       policy=self.policy)
+            ticket.attempt_replicas.append(target.rid)
             ticket.trace = backend.trace
             backend.add_done_callback(
                 lambda t, r=target: self._on_backend(

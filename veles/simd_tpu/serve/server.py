@@ -469,7 +469,13 @@ class Server:
         self._stats = {"submitted": 0, "completed": 0, "shed": 0,
                        "degraded_answers": 0, "errors": 0,
                        "expired": 0, "breaker_shed": 0,
-                       "batches": 0, "batched_requests": 0}
+                       "batches": 0, "batched_requests": 0,
+                       "useful_rows": 0, "dispatched_rows": 0}
+        # cumulative (useful, dispatched) row tallies per (op, shape
+        # class) — the goodput denominators behind the serve.goodput /
+        # serve.padding_waste gauges (obs v5, ROADMAP item 3's
+        # padding-waste baseline)
+        self._goodput: dict = {}
         self._started = False
         self._stopped = False
         # the warm-pack preload report ({"loaded": n, ...}) once
@@ -806,7 +812,8 @@ class Server:
         _, slicer = _OPS[op]
         self._finish_batch(
             op, batch,
-            lambda i, p: slicer(ys[i], p.n, p.params), degraded)
+            lambda i, p: slicer(ys[i], p.n, p.params), degraded,
+            rpad=rpad, nb=nb)
 
     def _note_batch_formed(self, batch, rpad: int) -> None:
         """The ``batch_formed`` trace edge for every co-batched
@@ -822,13 +829,23 @@ class Server:
                                  padding_rows=rpad - rows)
 
     def _finish_batch(self, op: str, batch, value_for,
-                      degraded: bool) -> None:
+                      degraded: bool, *, rpad: int | None = None,
+                      nb=None) -> None:
         """Complete every ticket + the shared batch accounting — ONE
         home for the plain-op and pipeline batch paths.  ``value_for
         (i, pending)`` builds row ``i``'s answer; it is called
         per-row, not bulk-at-the-end, so a value-build failure midway
         leaves the tally matching the tickets actually answered (the
-        worker's handler counts the rest as errors)."""
+        worker's handler counts the rest as errors).  ``rpad`` (the
+        pow2-padded row count actually dispatched) and ``nb`` (the
+        shape class) feed the goodput accounting: the
+        ``serve_padding_rows`` / ``serve_useful_rows`` /
+        ``serve_dispatched_rows`` counters and the cumulative
+        ``serve.goodput`` / ``serve.padding_waste`` gauges per (op,
+        shape class).  These are metric-axis writes, NOT request-axis
+        ones — they keep recording under ``configure(
+        request_axis=False)``, so padding waste stays visible with
+        tracing load-shed."""
         now = faults.monotonic()
         status = "degraded" if degraded else "ok"
         rows = len(batch)
@@ -851,6 +868,24 @@ class Server:
         with self._stats_lock:
             self._stats["batches"] += 1
             self._stats["batched_requests"] += rows
+        if rpad is not None and rpad > 0:
+            # the shape-class label is ``bucket`` (the pow2 class the
+            # request length padded to) — NOT ``n``, which collides
+            # with obs.count's increment parameter
+            obs.count("serve_padding_rows", rpad - rows,
+                      op=op, bucket=nb)
+            obs.count("serve_useful_rows", rows, op=op, bucket=nb)
+            obs.count("serve_dispatched_rows", rpad, op=op, bucket=nb)
+            with self._stats_lock:
+                tally = self._goodput.setdefault((op, nb), [0, 0])
+                tally[0] += rows
+                tally[1] += rpad
+                goodput = tally[0] / tally[1]
+                self._stats["useful_rows"] += rows
+                self._stats["dispatched_rows"] += rpad
+            obs.gauge("serve.goodput", goodput, op=op, bucket=nb)
+            obs.gauge("serve.padding_waste", 1.0 - goodput,
+                      op=op, bucket=nb)
 
     def _run_pipeline_batch(self, op: str, batch, nb: int,
                             budget_s: float | None) -> None:
@@ -893,7 +928,7 @@ class Server:
         state_rows = compiled.state_rows(new_state, rows)
         self._finish_batch(
             op, batch, lambda i, p: (outs[i], state_rows[i]),
-            degraded)
+            degraded, rpad=rpad, nb=nb)
 
     @staticmethod
     def _batch_fault_hook(traces):
@@ -996,6 +1031,32 @@ class Server:
         front router's least-loaded placement signal."""
         return self._admission.depth()
 
+    def counts(self) -> dict:
+        """Cheap copy of the raw request tallies (one lock, no
+        registry walk) — the fleet collector's per-tick read; the
+        full story lives in :meth:`stats`."""
+        with self._stats_lock:
+            return dict(self._stats)
+
+    def goodput(self) -> dict:
+        """Cumulative batch-occupancy efficiency per (op, shape
+        class): ``{"op|class": {"useful_rows", "dispatched_rows",
+        "goodput"}}`` plus an ``"overall"`` roll-up (None goodput =
+        no batch dispatched yet).  Useful rows are real request rows;
+        dispatched rows include the pow2 row padding — the gap IS
+        ROADMAP item 3's padding waste, measured."""
+        with self._stats_lock:
+            per = {
+                f"{op}|{nb}": {"useful_rows": u, "dispatched_rows": d,
+                               "goodput": (u / d) if d else None}
+                for (op, nb), (u, d) in sorted(self._goodput.items())}
+            useful = self._stats["useful_rows"]
+            dispatched = self._stats["dispatched_rows"]
+        per["overall"] = {
+            "useful_rows": useful, "dispatched_rows": dispatched,
+            "goodput": (useful / dispatched) if dispatched else None}
+        return per
+
     @property
     def health(self) -> str:
         """Current health state (``healthy`` / ``degraded``)."""
@@ -1022,6 +1083,7 @@ class Server:
             "pipelines": sorted(self._pipelines),
             "requests": obs.request_summary(),
             "slo": obs.slo_snapshot(),
+            "goodput": self.goodput(),
             "artifact_preload": self._preload,
             "obs_port": self.obs_port,
             "dispatch_quantiles": obs.quantiles(
