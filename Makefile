@@ -6,10 +6,10 @@
 PYTHON ?= python
 
 .PHONY: all tests tests-quick benchmarks bench bench-regress \
-        bench-multichip bench-serve serve-smoke chaos-smoke \
-        chaos-replicas cshim cshim-check wavelet-tables lint docs \
-        obs-report obs-dash autotune-pack warm-pack cold-start \
-        install install-hooks clean
+        bench-multichip bench-serve bench-goodput serve-smoke \
+        chaos-smoke chaos-replicas cshim cshim-check wavelet-tables \
+        lint docs obs-report obs-dash autotune-pack warm-pack \
+        cold-start install install-hooks clean
 
 all: cshim
 
@@ -49,6 +49,18 @@ bench-multichip:
 # `python tools/bench_regress.py --details SERVE_DETAILS.json`.
 bench-serve:
 	$(PYTHON) tools/loadgen.py --details SERVE_DETAILS.json
+
+# the GOODPUT bench family: the saturation A/B campaign — one
+# heavy-tailed mixed-shape schedule served flat-out with continuous
+# batching + ragged packing OFF (the padding-waste baseline) then ON,
+# written to GOODPUT_DETAILS.json (sample goodput, waste-recovery
+# multiple, inverse-p99; rc=1 unless the measured padding waste
+# recovers >= 2x with p99 held).  Gate with
+# `python tools/bench_regress.py --details GOODPUT_DETAILS.json`.
+bench-goodput:
+	VELES_SIMD_PLATFORM=cpu $(PYTHON) tools/loadgen.py --saturation \
+		--details GOODPUT_DETAILS.json
+	$(PYTHON) tools/bench_regress.py --details GOODPUT_DETAILS.json
 
 # seconds-long CPU sanity run of the serving layer (accounting +
 # oracle parity gate, including pipeline-invocation streams with

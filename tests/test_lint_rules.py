@@ -1425,3 +1425,136 @@ def test_real_modules_pass_artifact_rule():
             errs = lint.artifact_serialization_errors(
                 ast.parse(path.read_text()), rel)
             assert errs == [], errs
+
+
+# --- segment-packing rule (PR 17) ------------------------------------------
+
+SEGMENT_GOOD = '''
+from veles.simd_tpu.runtime import faults, routing
+
+_SEG_FAMILY = routing.family("segments", (
+    routing.Route("stft_pack",
+                  predicate=lambda op, **_: op == "stft"),
+    routing.Route("convolve_pack"),
+))
+
+
+def _select_pack_route(op):
+    return _SEG_FAMILY.static_select(op=str(op))
+
+
+def packed_stft(segments, frame_length, hop):
+    route = _select_pack_route("stft")
+    def device():
+        return route
+    def salvage():
+        return None
+    return faults.breaker_guarded("segments.dispatch", "k", device,
+                                  fallback=salvage,
+                                  fallback_name="per_segment")
+'''
+
+SEGMENT_NO_BREAKER = '''
+from veles.simd_tpu.runtime import routing
+
+_SEG_FAMILY = routing.family("segments", (
+    routing.Route("stft_pack"),
+))
+
+
+def packed_stft(segments, frame_length, hop):
+    route = _SEG_FAMILY.static_select(op="stft")
+    return [route for _ in segments]
+'''
+
+SEGMENT_PLAIN_GUARD_ONLY = '''
+from veles.simd_tpu.runtime import faults, routing
+
+_SEG_FAMILY = routing.family("segments", (
+    routing.Route("stft_pack"),
+))
+
+
+def packed_stft(segments, frame_length, hop):
+    route = _SEG_FAMILY.static_select(op="stft")
+    def device():
+        return route
+    return faults.guarded("segments.dispatch", device, fallback=None)
+'''
+
+SEGMENT_NO_TABLE = '''
+from veles.simd_tpu.runtime import faults
+
+
+def packed_stft(segments, frame_length, hop):
+    def device():
+        return [s for s in segments]
+    def salvage():
+        return None
+    return faults.breaker_guarded("segments.dispatch", "k", device,
+                                  fallback=salvage)
+'''
+
+SEGMENT_ALIAS_DODGE = '''
+import veles.simd_tpu.runtime.faults as flt
+from veles.simd_tpu.runtime import routing
+
+_SEG_FAMILY = routing.family("segments", (
+    routing.Route("stft_pack"),
+))
+
+
+def _dispatch(device, salvage):
+    return flt.breaker_guarded("segments.dispatch", "k", device,
+                               fallback=salvage)
+
+
+def packed_stft(segments, frame_length, hop):
+    route = _SEG_FAMILY.static_select(op="stft")
+    def device():
+        return route
+    def salvage():
+        return None
+    return _dispatch(device, salvage)
+'''
+
+
+def _segment_errs(src):
+    return lint.segment_dispatch_errors(ast.parse(src), "segments.py")
+
+
+def test_segment_rule_passes_table_and_breaker():
+    assert _segment_errs(SEGMENT_GOOD) == []
+
+
+def test_segment_rule_flags_unguarded_pack():
+    errs = _segment_errs(SEGMENT_NO_BREAKER)
+    assert any("breaker_guarded" in e for e in errs)
+
+
+def test_segment_rule_plain_guarded_is_not_enough():
+    """``faults.guarded`` has no per-class breaker — a packed dispatch
+    must go through ``breaker_guarded`` specifically."""
+    errs = _segment_errs(SEGMENT_PLAIN_GUARD_ONLY)
+    assert any("breaker_guarded" in e for e in errs)
+
+
+def test_segment_rule_flags_hand_rolled_packing():
+    errs = _segment_errs(SEGMENT_NO_TABLE)
+    assert any("routing-family" in e for e in errs)
+
+
+def test_segment_rule_tracks_aliases_and_helpers():
+    """``import ... as`` plus a module-level dispatch helper must
+    still satisfy the rule (transitive closure, alias-tracked)."""
+    assert _segment_errs(SEGMENT_ALIAS_DODGE) == []
+
+
+def test_real_segments_module_passes_segment_rule():
+    """Acceptance gate: ops/segments.py itself satisfies its own
+    contract — packed entry points route through the family table and
+    the breaker-guarded fault policy."""
+    src = (REPO / "veles/simd_tpu/ops/segments.py").read_text()
+    errs = lint.segment_dispatch_errors(
+        ast.parse(src), "veles/simd_tpu/ops/segments.py")
+    assert errs == [], errs

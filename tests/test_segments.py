@@ -1,0 +1,203 @@
+"""Ragged segment packing (PR 17): packed-vs-unpacked bit-parity
+across mixed-length mixes, the packing-plan geometry, and the
+per-segment fault-isolation contract (one poisoned segment degrades
+its own ticket, never its co-packed neighbors)."""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from veles.simd_tpu import obs  # noqa: E402
+from veles.simd_tpu.ops import convolve as cv  # noqa: E402
+from veles.simd_tpu.ops import segments as seg  # noqa: E402
+from veles.simd_tpu.ops import spectral as sp  # noqa: E402
+from veles.simd_tpu.runtime import faults, routing  # noqa: E402
+
+RNG = np.random.RandomState(1234)
+
+# >= 3 mixed-length mixes (the ISSUE's parity bar): short-heavy,
+# straddling pow2 bucket edges, and a heavy-tail mix where one long
+# segment forces the packed width up
+STFT_MIXES = (
+    (128, 131, 200, 256),
+    (513, 128, 257, 130, 384),
+    (1200, 128, 150, 128, 200, 777),
+)
+CONV_MIXES = (
+    (64, 100, 31),
+    (513, 64, 257, 130),
+    (1200, 64, 150, 48, 777),
+)
+
+
+@pytest.fixture
+def clean_faults(monkeypatch):
+    monkeypatch.setenv("VELES_SIMD_FAULT_BACKOFF", "0")
+    faults.reset_fault_history()
+    faults.set_fault_plan(None)
+    yield
+    faults.reset_fault_history()
+    faults.set_fault_plan(None)
+
+
+def _segs(lengths):
+    return [RNG.randn(n).astype(np.float32) for n in lengths]
+
+
+# --- plan geometry ----------------------------------------------------------
+
+def test_stft_stride_is_hop_aligned():
+    assert seg.stft_stride(128, 64) == 128
+    assert seg.stft_stride(130, 64) == 192
+    assert seg.stft_stride(1, 64) == 64
+
+
+def test_convolve_stride_includes_guard_gap():
+    assert seg.convolve_stride(100, 17) == 116
+    assert seg.convolve_stride(1, 1) == 1
+
+
+def test_plan_pack_defaults_width_to_pow2_of_largest():
+    width, rows, placements = seg.plan_pack([200, 100, 700])
+    assert width == routing.pow2_bucket(700) == 1024
+    assert rows >= 1
+    assert len(placements) == 3
+
+
+def test_plan_pack_placements_are_disjoint_and_in_bounds():
+    strides = [200, 100, 700, 513, 64, 300, 128]
+    width, rows, placements = seg.plan_pack(strides)
+    spans = sorted((row, off, off + s)
+                   for (row, off), s in zip(placements, strides))
+    for (r1, a1, b1), (r2, a2, b2) in zip(spans, spans[1:]):
+        assert b1 <= width and b2 <= width
+        if r1 == r2:
+            assert b1 <= a2, "segments overlap within a row"
+    assert rows == len({r for r, _, _ in spans})
+
+
+def test_plan_pack_ffd_fills_gaps():
+    # arrival order long-after-short would need 3 rows under plain
+    # first-fit; largest-first backfills into 2
+    width, rows, _ = seg.plan_pack([600, 600, 400, 400], width=1024)
+    assert rows == 2
+
+
+def test_plan_pack_rejects_bad_strides():
+    with pytest.raises(ValueError):
+        seg.plan_pack([0, 10])
+    with pytest.raises(ValueError):
+        seg.plan_pack([10, 2000], width=1024)
+
+
+def test_plan_pack_is_deterministic():
+    strides = [200, 100, 700, 513, 64, 300]
+    assert seg.plan_pack(strides) == seg.plan_pack(strides)
+
+
+# --- packed vs unpacked bit-parity ------------------------------------------
+
+@pytest.mark.parametrize("lengths", STFT_MIXES)
+def test_packed_stft_bit_equal_per_segment(lengths, clean_faults):
+    segs = _segs(lengths)
+    outs, degraded = seg.packed_stft(segs, 128, 64, simd=True)
+    assert degraded == [False] * len(segs)
+    for out, s in zip(outs, segs):
+        want = sp.stft(s, 128, 64)
+        assert out.shape == np.asarray(want).shape
+        assert np.array_equal(out, want)
+
+
+@pytest.mark.parametrize("lengths", CONV_MIXES)
+def test_packed_convolve_bit_equal_per_segment(lengths, clean_faults):
+    segs = _segs(lengths)
+    h = RNG.randn(17).astype(np.float32)
+    outs, degraded = seg.packed_convolve(segs, h, simd=True)
+    assert degraded == [False] * len(segs)
+    for out, s in zip(outs, segs):
+        # pin the direct algorithm: the packed route IS direct-form
+        # (FFT convolution is global over the row and can never be
+        # segment-masked), and the autotuner may pick FFT for long
+        # unpacked signals
+        handle = cv.convolve_initialize(
+            s.shape[0], 17,
+            algorithm=cv.ConvolutionAlgorithm.BRUTE_FORCE)
+        want = cv.convolve(handle, s, h)
+        cv.convolve_finalize(handle)
+        assert np.array_equal(out, want)
+
+
+def test_packed_stft_oracle_twin_matches(clean_faults):
+    segs = _segs((200, 128, 300))
+    device, _ = seg.packed_stft(segs, 128, 64, simd=True)
+    oracle, _ = seg.packed_stft(segs, 128, 64, simd=False)
+    for d, o in zip(device, oracle):
+        assert np.allclose(d, o, atol=1e-4)
+
+
+def test_packed_rejects_malformed_segments():
+    with pytest.raises(ValueError):
+        seg.packed_stft([np.zeros((2, 2), np.float32)], 128, 64)
+    with pytest.raises(ValueError):
+        seg.packed_convolve([], np.ones(3, np.float32))
+
+
+# --- fault isolation --------------------------------------------------------
+
+def test_one_poisoned_segment_degrades_only_its_ticket(clean_faults):
+    """The packed dispatch exhausts its retries, salvage re-dispatches
+    per segment, and ONLY the poisoned segment lands on its oracle —
+    co-packed neighbors still get device answers."""
+    segs = _segs((200, 128, 300))
+    faults.set_fault_plan(
+        "segments.dispatch@stft:device_lost:3,"
+        "segments.segment@1:device_lost:1")
+    outs, degraded = seg.packed_stft(segs, 128, 64, simd=True)
+    assert degraded == [False, True, False]
+    for out, s in zip(outs, segs):
+        assert np.allclose(out, sp.stft(s, 128, 64), atol=1e-4)
+
+
+def test_fault_free_salvage_flags_nobody(clean_faults):
+    """A packed-dispatch fault without a poisoned segment salvages
+    every ticket on the device: zero degraded flags."""
+    segs = _segs((200, 128))
+    faults.set_fault_plan("segments.dispatch@convolve:device_lost:3")
+    h = RNG.randn(9).astype(np.float32)
+    outs, degraded = seg.packed_convolve(segs, h, simd=True)
+    assert degraded == [False, False]
+    for out, s in zip(outs, segs):
+        handle = cv.convolve_initialize(
+            s.shape[0], 9,
+            algorithm=cv.ConvolutionAlgorithm.BRUTE_FORCE)
+        want = cv.convolve(handle, s, h)
+        cv.convolve_finalize(handle)
+        assert np.array_equal(out, want)
+
+
+def test_packed_dispatch_site_carries_breaker_key(clean_faults):
+    """The serving layer namespaces the packed breaker per shape
+    class; the key must reach the segments.dispatch site."""
+    from veles.simd_tpu.runtime import breaker as brk
+    segs = _segs((200, 128))
+    seg.packed_stft(segs, 128, 64, simd=True, key="r0|stft|ragged")
+    assert brk.lookup("segments.dispatch", "r0|stft|ragged") is not None
+
+
+def test_packed_dispatch_emits_goodput_spans(clean_faults):
+    obs.enable(compile_listeners=False)
+    obs.reset()
+    try:
+        segs = _segs((200, 128, 300))
+        seg.packed_stft(segs, 128, 64, simd=True)
+        snap = obs.snapshot()
+        names = {h["name"] for h in snap["histograms"]}
+        assert "span.segments.pack.dispatch" in names
+    finally:
+        obs.disable()
+        obs.reset()

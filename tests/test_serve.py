@@ -670,3 +670,155 @@ class TestDeadlines:
         snap = obs.snapshot()
         assert any(h["name"] == "serve.deadline_slack"
                    for h in snap["histograms"])
+
+
+# ---------------------------------------------------------------------------
+# continuous batching + ragged segment packing (PR 17)
+# ---------------------------------------------------------------------------
+
+class TestRaggedServe:
+    def test_ragged_classing_is_env_gated(self, monkeypatch):
+        params = {"frame_length": 128, "hop": 64}
+        monkeypatch.setenv(serve.server.RAGGED_ENV, "0")
+        *_, key = serve.server.classify_request(
+            "stft", _signal(300), params)
+        assert key == ("stft", (128, 64), 512)
+        monkeypatch.setenv(serve.server.RAGGED_ENV, "1")
+        *_, key = serve.server.classify_request(
+            "stft", _signal(300), params)
+        assert key == ("stft", (128, 64), "ragged")
+        # heavy-tail requests keep their plain bucket: one long signal
+        # must not inflate the packed width of co-packed short ones
+        n_long = serve.server.ragged_max() + 1
+        *_, key = serve.server.classify_request(
+            "stft", _signal(n_long), params)
+        assert key[-1] == serve.server.bucket_length(n_long)
+        # non-stft ops never co-pack (IIR state threads along the row)
+        *_, key = serve.server.classify_request(
+            "sosfilt", _signal(300), {"sos": SOS})
+        assert key[-1] == 512
+
+    def test_ragged_parity_and_sample_accounting(self, telemetry,
+                                                 monkeypatch):
+        monkeypatch.setenv(serve.server.RAGGED_ENV, "1")
+        lens = (200, 128, 513, 300)
+        xs = [_signal(n) for n in lens]
+        srv = serve.Server(max_batch=8, max_wait_ms=20.0, workers=1)
+        # submit before start so ALL requests land in ONE ragged batch
+        ts = [srv.submit(serve.Request(
+            "stft", x, {"frame_length": 128, "hop": 64}))
+            for x in xs]
+        with srv:
+            for t, x in zip(ts, xs):
+                got = t.result(timeout=120.0)
+                assert _rel(got, sp.stft_na(x, 128, 64)) < 2e-3
+                assert t.status == "ok"
+        snap = obs.snapshot()
+
+        def counter(name):
+            return sum(c["value"] for c in snap["counters"]
+                       if c["name"] == name
+                       and c["labels"].get("bucket") == "ragged")
+
+        from veles.simd_tpu.ops import segments as _seg
+        strides = [_seg.stft_stride(n, 64) for n in lens]
+        width, rows, _ = _seg.plan_pack(strides)
+        assert counter("serve_useful_samples") == sum(lens)
+        assert counter("serve_dispatched_samples") == rows * width
+        assert counter("serve_useful_rows") == rows
+        assert counter("serve_dispatched_rows") == rows
+        good = srv.goodput()
+        ragged_keys = [k for k in good if k.endswith("|ragged")]
+        assert ragged_keys, good
+        gp = good[ragged_keys[0]]
+        assert 0.0 < gp["sample_goodput"] <= 1.0
+        assert gp["useful_samples"] == sum(lens)
+
+    def test_ragged_fault_degrades_one_ticket_only(self, telemetry,
+                                                   monkeypatch):
+        monkeypatch.setenv(serve.server.RAGGED_ENV, "1")
+        xs = [_signal(n) for n in (200, 128, 300)]
+        faults.set_fault_plan(
+            "segments.dispatch@stft:device_lost:3,"
+            "segments.segment@1:device_lost:1")
+        srv = serve.Server(max_batch=8, max_wait_ms=20.0, workers=1)
+        ts = [srv.submit(serve.Request(
+            "stft", x, {"frame_length": 128, "hop": 64}))
+            for x in xs]
+        with srv:
+            vals = [t.result(timeout=120.0) for t in ts]
+        # the poisoned segment degrades to its oracle; its co-packed
+        # neighbors keep device answers and OK tickets
+        assert [t.status for t in ts] == ["ok", "degraded", "ok"]
+        for v, x in zip(vals, xs):
+            assert _rel(v, sp.stft_na(x, 128, 64)) < 2e-3
+        ev = [e["event"] for e in ts[1].trace.events()]
+        assert "degraded" in ev
+
+    def test_refill_rides_expiry_freed_slots(self, telemetry,
+                                             monkeypatch):
+        """An expired request swept out of a taken batch frees a row
+        slot below the pow2 class; continuous batching refills it from
+        the queue at dispatch time — the refilled ticket gets its own
+        tagged batch_formed edge and every ticket answers exactly
+        once.  The take->dispatch window is driven by hand (the worker
+        loop hits it only under racy timing): take the full batch via
+        the batcher, let one member's deadline lapse, queue the rider,
+        then run the dispatch path directly."""
+        monkeypatch.setenv(serve.server.CONTINUOUS_ENV, "1")
+        srv = serve.Server(max_batch=4, max_wait_ms=20.0, workers=1)
+        doomed = srv.submit(serve.Request(
+            "sosfilt", _signal(400), {"sos": SOS}), deadline_ms=60.0)
+        live = [srv.submit(serve.Request(
+            "sosfilt", _signal(400), {"sos": SOS})) for _ in range(3)]
+        # the class is full (4/4) -> instantly ready; doomed is still
+        # live at take so the batcher does NOT shed it
+        key, batch = srv._batcher.next_batch()
+        assert len(batch) == 4
+        rider = srv.submit(serve.Request(
+            "sosfilt", _signal(400), {"sos": SOS}))
+        import time as _time
+        _time.sleep(0.2)  # doomed's deadline lapses post-take
+        srv._run_batch(key, batch)
+        with pytest.raises(serve.DeadlineExceeded):
+            doomed.result(timeout=5.0)
+        for t in live + [rider]:
+            t.result(timeout=5.0)
+            assert t.status == "ok"
+        # the rider refilled the slot the expired request freed
+        formed = [e for e in rider.trace.events()
+                  if e["event"] == "batch_formed"]
+        assert formed and formed[0].get("refilled") is True
+        assert srv.stats()["counts"]["refilled_rows"] == 1
+        # zero lost / zero double-answered: every ticket terminal once
+        for t in [doomed] + live + [rider]:
+            assert t.done()
+        srv.stop()
+
+    def test_refill_disabled_leaves_queue_untouched(self, telemetry,
+                                                    monkeypatch):
+        """Same freed-slot window with the flag off: the rider stays
+        queued through the dispatch, then answers in its own later
+        batch with an untagged batch_formed edge."""
+        monkeypatch.setenv(serve.server.CONTINUOUS_ENV, "0")
+        srv = serve.Server(max_batch=4, max_wait_ms=20.0, workers=1)
+        live = [srv.submit(serve.Request(
+            "sosfilt", _signal(400), {"sos": SOS})) for _ in range(3)]
+        # 3/4: ready only once max_wait lapses, so the take is short
+        key, batch = srv._batcher.next_batch()
+        assert len(batch) == 3
+        rider = srv.submit(serve.Request(
+            "sosfilt", _signal(400), {"sos": SOS}))
+        srv._run_batch(key, batch)
+        for t in live:
+            t.result(timeout=5.0)
+            assert t.status == "ok"
+        assert srv.stats()["counts"]["refilled_rows"] == 0
+        assert not rider.done()
+        # the worker pool answers the rider via its own batch
+        with srv:
+            rider.result(timeout=120.0)
+            assert rider.status == "ok"
+        formed = [e for e in rider.trace.events()
+                  if e["event"] == "batch_formed"]
+        assert formed and not formed[0].get("refilled")

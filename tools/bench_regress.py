@@ -158,6 +158,17 @@ DEFAULT_NOISE = [
     # A/B throughput ratio near 1.0, measured while the fleet
     # collector sweeps in the background — same 5% budget
     ("fleet tracing overhead", 0.05),
+    # the goodput-at-saturation family (tools/loadgen.py --saturation,
+    # GOODPUT_DETAILS.json): "goodput saturation" is the after-side
+    # useful/dispatched SAMPLE ratio — near-deterministic for a fixed
+    # seed, but batch formation (and therefore the packed plans and
+    # refill opportunities) shifts with worker/timer scheduling;
+    # "goodput p99" is a single order statistic of a saturated
+    # wall-clock run; "goodput recovery" divides two waste
+    # measurements, compounding both sides' scheduling jitter
+    ("goodput saturation", 0.15),
+    ("goodput p99", 0.40),
+    ("goodput recovery", 0.30),
 ]
 
 
@@ -224,6 +235,12 @@ def rows_to_record(rows: list, source: str, regressed: list = (),
                 "vs_baseline": r.get("vs_baseline"),
                 **({"faults": row_fault_count(r)}
                    if row_fault_count(r) else {}),
+                # recovered-padding evidence (the goodput family):
+                # waste before/after + refill counts ride into the
+                # trajectory so a recovery regression is diagnosable
+                # from the history alone
+                **({"recovered": r["recovered"]}
+                   if r.get("recovered") else {}),
             } for r in rows
         },
     }

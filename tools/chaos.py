@@ -503,6 +503,34 @@ def _merge_router(reports: list) -> dict:
 
 
 def run_replica_campaign(args) -> tuple:
+    """Arm the goodput-at-saturation features, then run the replica
+    campaign body: the kill/drain/restart invariants (zero lost, zero
+    double-answered, every trace terminal) must hold WITH continuous
+    batching refilling freed row slots and ragged packing co-packing
+    the mix's short stft requests — the chaos gate for both features
+    (the mix's stft lengths sit under the ragged cap, so the packed
+    dispatch path really runs)."""
+    from veles.simd_tpu.serve import server as serve_server
+
+    armed = {serve_server.CONTINUOUS_ENV: "1",
+             serve_server.RAGGED_ENV: "1"}
+    prior = {k: os.environ.get(k) for k in armed}
+
+    def _restore():
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    os.environ.update(armed)
+    try:
+        return _replica_campaign_body(args, _restore)
+    finally:
+        _restore()
+
+
+def _replica_campaign_body(args, restore_features=lambda: None) -> tuple:
     """The 3-phase replica-kill campaign over a 3-replica group behind
     the front router: (1) kill one replica abruptly — no drain —
     MID-TRAFFIC (its queued work must fail over, deadlines carried);
@@ -667,6 +695,12 @@ def run_replica_campaign(args) -> tuple:
         # renamed so bench_regress tracks it as its own series (it
         # still matches the existing "tracing overhead" 5% noise
         # entry by substring).
+        # the overhead row must measure the SAME flag configuration
+        # as loadgen's gated "tracing overhead" series — the traffic
+        # phases above ran with continuous batching + ragged packing
+        # armed; disarm back to the caller's flags before measuring
+        # (idempotent: the wrapper's finally restores again)
+        restore_features()
         ov_args = argparse.Namespace(
             overhead_requests=(80 if args.smoke else 300),
             workers=args.workers)

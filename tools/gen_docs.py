@@ -37,6 +37,7 @@ MODULES = [
     "veles.simd_tpu.ops.resample",
     "veles.simd_tpu.ops.iir",
     "veles.simd_tpu.ops.batched",
+    "veles.simd_tpu.ops.segments",
     "veles.simd_tpu.ops.filters",
     "veles.simd_tpu.ops.waveforms",
     "veles.simd_tpu.ops.detect_peaks",

@@ -1156,6 +1156,99 @@ def parallel_guard_errors(tree, fname) -> list:
     return errors
 
 
+# --- segment-packing rule ---------------------------------------------------
+# Ragged segment packing (ops/segments.py) concatenates several
+# requests into one dispatch — which makes its entry points the ONE
+# place where a fault or a routing decision fans out across many
+# tickets.  Two structural invariants pin that blast radius:
+#
+# * every ``packed_*`` entry point must dispatch through
+#   ``faults.breaker_guarded`` (directly or transitively through
+#   module-level helpers) — the packed fallback is per-segment
+#   salvage, and a packed dispatch outside the breaker would let one
+#   poisoned segment fail a whole co-packed batch with no degrade
+#   path;
+# * every ``packed_*`` entry point must consult the segments
+#   routing-family candidate table (a ``routing.family``-bound name,
+#   reached directly or through a ``_select_*`` helper) — packing
+#   geometry (hop alignment vs guard gaps) is a route property, and
+#   hand-rolling it at a call site re-creates the ladders the routing
+#   engine replaced.
+#
+# Alias-tracked like every other rule; testable on synthetic sources
+# via ``segment_dispatch_errors``.
+
+_SEGMENT_RULE_FILES = ("veles/simd_tpu/ops/segments.py",)
+_SEGMENT_ENTRY_PREFIX = "packed_"
+
+
+def segment_dispatch_errors(tree, fname) -> list:
+    """The rule body on a parsed module (separated so tests can feed
+    synthetic sources).  Returns human-readable error strings."""
+    errors = []
+    faults_mods, guarded_names = _faults_aliases(tree)
+    routing_mods, family_fns = _routing_aliases(tree)
+    tables = _family_table_names(tree, routing_mods, family_fns)
+    table_names = tables | family_fns
+    funcs = {node.name: node for node in tree.body
+             if isinstance(node, ast.FunctionDef)}
+
+    def _is_breaker_call(node) -> bool:
+        f = node.func
+        return ((isinstance(f, ast.Attribute)
+                 and f.attr == "breaker_guarded"
+                 and isinstance(f.value, ast.Name)
+                 and f.value.id in faults_mods)
+                or (isinstance(f, ast.Name) and f.id in guarded_names
+                    and f.id.endswith("breaker_guarded")))
+
+    def _reaches(fn, hit, seen=None) -> bool:
+        """Does ``fn``'s body satisfy ``hit``, following references to
+        other module-level functions transitively?"""
+        seen = set() if seen is None else seen
+        if fn.name in seen:
+            return False
+        seen.add(fn.name)
+        for w in ast.walk(fn):
+            if hit(w):
+                return True
+            if (isinstance(w, ast.Name) and w.id in funcs
+                    and w.id not in seen
+                    and _reaches(funcs[w.id], hit, seen)):
+                return True
+        return False
+
+    def _consults_table(w) -> bool:
+        if isinstance(w, ast.Name) and w.id in table_names:
+            return True
+        return (isinstance(w, ast.Attribute)
+                and isinstance(w.value, ast.Name)
+                and w.value.id in routing_mods
+                and w.attr in ("family", "get_family"))
+
+    for node in tree.body:
+        if not (isinstance(node, ast.FunctionDef)
+                and node.name.startswith(_SEGMENT_ENTRY_PREFIX)):
+            continue
+        if not _reaches(node, lambda w: isinstance(w, ast.Call)
+                        and _is_breaker_call(w)):
+            errors.append(
+                f"{fname}:{node.lineno}: packed entry point "
+                f"{node.name} does not dispatch through "
+                "faults.breaker_guarded — a segment-masked dispatch "
+                "fans one fault across every co-packed ticket, so it "
+                "must ride the breaker/fault policy (with per-segment "
+                "salvage as the fallback)")
+        if not _reaches(node, _consults_table):
+            errors.append(
+                f"{fname}:{node.lineno}: packed entry point "
+                f"{node.name} does not consult the segments "
+                "routing-family table — packing geometry is a route "
+                "property (routing.family candidate table), not a "
+                "call-site decision")
+    return errors
+
+
 # --- pipeline rule ----------------------------------------------------------
 # The pipeline compiler (veles/simd_tpu/pipeline/) fuses op chains into
 # one instrumented step; two structural invariants keep it honest:
@@ -1407,6 +1500,10 @@ def compute_module_lint(files) -> int:
                 failures += 1
         if rel in _PARALLEL_GUARD_FILES:
             for msg in parallel_guard_errors(tree, str(f)):
+                print(msg)
+                failures += 1
+        if rel in _SEGMENT_RULE_FILES:
+            for msg in segment_dispatch_errors(tree, str(f)):
                 print(msg)
                 failures += 1
         for msg in fault_handler_errors(tree, str(f)):

@@ -33,7 +33,14 @@ Three consumers:
   ``SERVE_DETAILS.json`` rows (throughput + inverse-p99, both
   higher-is-better so the regression gate's floor logic applies
   unchanged) gated via ``python tools/bench_regress.py --details
-  SERVE_DETAILS.json``.
+  SERVE_DETAILS.json``;
+* **`make bench-goodput`** — the goodput-at-saturation A/B
+  (``--saturation``): the same heavy-tailed mixed-shape schedule
+  served flat-out twice — continuous batching + ragged packing OFF
+  (the padding-waste baseline) then ON — writing
+  ``GOODPUT_DETAILS.json`` rows (sample goodput, waste-recovery
+  multiple, inverse-p99) and failing unless the measured padding
+  waste recovers >= 2x with p99 held.
 
 Usage::
 
@@ -526,6 +533,236 @@ def bench_rows(report: dict) -> list:
     return rows
 
 
+# the saturation campaign's stft geometry: one param class, so with
+# ragged packing ON every stft request lands in ONE shape class and
+# co-packs; lengths are heavy-tailed (Pareto) so pow2 bucket padding
+# is the dominant waste the campaign measures
+SATURATION_FRAME = 128
+SATURATION_HOP = 64
+SATURATION_MAX_LEN = 2800
+
+# the in-run acceptance bars of --saturation (the bench trajectory
+# gates the finer-grained per-row noise via bench_regress): the
+# after-side must recover at least 2x of the before-side's measured
+# padding waste, and its p99 must stay within this slack of the
+# before-side's (an order statistic on a shared CPU host needs slack;
+# the "goodput p99" history row tracks the trajectory)
+RECOVERY_MIN = 2.0
+P99_SLACK = 2.0
+
+
+def saturation_schedule(rng, n_requests: int,
+                        tenants=DEFAULT_TENANTS) -> list:
+    """The mixed-shape saturation campaign's traffic: every gap is 0
+    (arrivals pinned above capacity — the queue is never empty, so
+    batching/packing, not arrival luck, decides goodput), stft-heavy
+    (75%) with heavy-tailed Pareto lengths in ONE param class (the
+    ragged-packable regime), the rest sosfilt at a near-bucket-full
+    length (IIR state threads along the row, so sosfilt can never
+    pack — keeping its own padding small isolates the measurement to
+    the waste the features CAN recover, while its rows still exercise
+    continuous refill)."""
+    schedule = []
+    for _ in range(n_requests):
+        tenant = tenants[rng.randint(len(tenants))]
+        if rng.rand() < 0.75:
+            n = int(SATURATION_FRAME * (1.0 + rng.pareto(1.5)))
+            n = max(SATURATION_FRAME, min(n, SATURATION_MAX_LEN))
+            req = serve.Request(
+                "stft", rng.randn(n).astype(np.float32),
+                {"frame_length": SATURATION_FRAME,
+                 "hop": SATURATION_HOP}, tenant=tenant)
+        else:
+            req = serve.Request(
+                "sosfilt", rng.randn(1000).astype(np.float32),
+                {"sos": _sos()}, tenant=tenant)
+        schedule.append((0.0, req))
+    return schedule
+
+
+def _sum_counters(snap: dict) -> dict:
+    """Counter totals by name (summed across label sets)."""
+    totals: dict = {}
+    for c in snap["counters"]:
+        totals[c["name"]] = totals.get(c["name"], 0) + c["value"]
+    return totals
+
+
+def _class_goodput(snap: dict) -> dict:
+    """Per shape class (``op|bucket``) useful vs dispatched sample
+    totals — the scoreboard's per-class axis.  Classes re-bucket
+    between the A/B sides (packing folds short stft classes into one
+    ``stft|ragged`` class), which is itself part of the story."""
+    by: dict = {}
+    for c in snap["counters"]:
+        if c["name"] not in ("serve_useful_samples",
+                             "serve_dispatched_samples"):
+            continue
+        lab = c.get("labels") or {}
+        key = "%s|%s" % (lab.get("op", "?"), lab.get("bucket", "?"))
+        d = by.setdefault(key, {"useful_samples": 0,
+                                "dispatched_samples": 0})
+        d["useful_samples" if c["name"] == "serve_useful_samples"
+          else "dispatched_samples"] += c["value"]
+    for d in by.values():
+        d["sample_goodput"] = (
+            round(d["useful_samples"] / d["dispatched_samples"], 4)
+            if d["dispatched_samples"] else None)
+    return by
+
+
+def saturation_campaign(args, rng) -> tuple:
+    """The goodput-at-saturation A/B: the SAME heavy-tailed schedule
+    (same seed) served twice at saturation — ``before`` with
+    continuous batching + ragged packing OFF (the PR 16 padding-waste
+    baseline), ``after`` with both ON — measuring useful-samples ÷
+    dispatched-samples from the serve counters.  Each side warms its
+    compile classes with one identical pre-pass, then measures from a
+    clean registry, so XLA compile spikes land in neither side's p99.
+    Returns ``(report, rows, failed)``; ``failed`` trips on the
+    accounting gates (lost/double/parity/trace), a padding-waste
+    recovery below :data:`RECOVERY_MIN`, or an after-side p99 beyond
+    :data:`P99_SLACK` of the before-side."""
+    from veles.simd_tpu.serve import server as _srvmod
+
+    report: dict = {"mode": "saturation",
+                    "requests": int(args.requests)}
+    sides: dict = {}
+    saved = {env: os.environ.get(env)
+             for env in (_srvmod.CONTINUOUS_ENV, _srvmod.RAGGED_ENV)}
+    try:
+        for side, flag in (("before", "0"), ("after", "1")):
+            os.environ[_srvmod.CONTINUOUS_ENV] = flag
+            os.environ[_srvmod.RAGGED_ENV] = flag
+            warm = saturation_schedule(
+                np.random.RandomState(args.seed), args.requests)
+            sched = saturation_schedule(
+                np.random.RandomState(args.seed), args.requests)
+            depth = max(args.queue_depth or 0,
+                        args.requests + 64)
+            # wide row class by default: the more requests a dispatch
+            # carries, the more short segments co-pack per row and the
+            # thinner the packed plan's last-row slack (both sides run
+            # the same ceiling, so the A/B stays apples-to-apples)
+            mb = args.max_batch or 32
+            # a slightly longer collection window than the serve
+            # default: at saturation it lets every batch actually
+            # reach the row class, which stabilizes BOTH sides'
+            # batch compositions run-to-run (the A/B's variance
+            # lives in racy partial batches hitting pow2 row pads)
+            mw = 5.0 if args.max_wait_ms is None else args.max_wait_ms
+            srv = serve.Server(max_batch=mb,
+                               max_wait_ms=mw,
+                               queue_depth=depth,
+                               tenant_depth=max(args.tenant_depth
+                                                or 0, depth),
+                               workers=args.workers, obs_port=-1)
+            with srv:
+                run_load(srv, warm, verify=0)
+                obs.reset()
+                rep = run_load(srv, sched, verify=args.verify,
+                               rng=rng)
+                snap = obs.snapshot()
+                counters = _sum_counters(snap)
+                by_class = _class_goodput(snap)
+            useful = counters.get("serve_useful_samples", 0)
+            dispatched = counters.get("serve_dispatched_samples", 0)
+            u_rows = counters.get("serve_useful_rows", 0)
+            d_rows = counters.get("serve_dispatched_rows", 0)
+            sides[side] = {
+                "continuous": flag == "1", "ragged": flag == "1",
+                "sample_goodput": (useful / dispatched
+                                   if dispatched else None),
+                "useful_samples": useful,
+                "dispatched_samples": dispatched,
+                "row_goodput": (u_rows / d_rows if d_rows else None),
+                "refilled_rows": counters.get("serve_refilled_rows",
+                                              0),
+                "by_class": by_class,
+                "p99_s": rep.get("wait_p99_s"),
+                "report": rep,
+            }
+    finally:
+        for env, val in saved.items():
+            if val is None:
+                os.environ.pop(env, None)
+            else:
+                os.environ[env] = val
+    report.update(sides)
+    before, after = sides["before"], sides["after"]
+    waste_b = (1.0 - before["sample_goodput"]
+               if before["sample_goodput"] is not None else None)
+    waste_a = (1.0 - after["sample_goodput"]
+               if after["sample_goodput"] is not None else None)
+    recovery = (waste_b / waste_a
+                if waste_b and waste_a and waste_a > 0 else None)
+    report["padding_waste_before"] = waste_b
+    report["padding_waste_after"] = waste_a
+    report["waste_recovery_x"] = recovery
+    def _cls_waste(side_classes, key):
+        d = side_classes.get(key)
+        if not d or d.get("sample_goodput") is None:
+            return None
+        return round(1.0 - d["sample_goodput"], 4)
+
+    classes = sorted(set(before["by_class"]) | set(after["by_class"]))
+    evidence = {"waste_before": (round(waste_b, 4)
+                                 if waste_b is not None else None),
+                "waste_after": (round(waste_a, 4)
+                                if waste_a is not None else None),
+                "refilled_rows": after["refilled_rows"],
+                "useful_samples": after["useful_samples"],
+                "dispatched_samples": after["dispatched_samples"],
+                # per shape class: a class absent on one side re-
+                # bucketed (ragged folds the short stft pow2 classes
+                # into stft|ragged) — None on that side, by design
+                "by_class": {k: {"waste_before":
+                                 _cls_waste(before["by_class"], k),
+                                 "waste_after":
+                                 _cls_waste(after["by_class"], k)}
+                             for k in classes}}
+    rows = [{
+        "metric": "goodput saturation",
+        "value": (round(after["sample_goodput"], 4)
+                  if after["sample_goodput"] is not None else None),
+        "unit": "useful/dispatched samples",
+        "vs_baseline": (round(before["sample_goodput"], 4)
+                        if before["sample_goodput"] is not None
+                        else None),
+        "recovered": evidence,
+    }, {
+        "metric": "goodput recovery",
+        "value": (round(recovery, 2) if recovery is not None
+                  else None),
+        "unit": "x padding waste recovered",
+        "vs_baseline": RECOVERY_MIN,
+        "recovered": evidence,
+    }]
+    if after["p99_s"]:
+        rows.append({
+            "metric": "goodput p99 inverse latency",
+            "value": round(1.0 / after["p99_s"], 2),
+            "unit": "1/s",
+            "vs_baseline": (round(1.0 / before["p99_s"], 2)
+                            if before["p99_s"] else None),
+        })
+    bad_side = any(
+        s["report"]["lost"] or s["report"]["double_answered"]
+        or s["report"]["parity_failures"]
+        or s["report"]["trace_orphans"]
+        or s["report"]["trace_phase_err"]
+        or s["report"]["trace_degraded_missing_edge"]
+        for s in sides.values())
+    recovery_failed = recovery is None or recovery < RECOVERY_MIN
+    p99_failed = bool(before["p99_s"] and after["p99_s"]
+                      and after["p99_s"]
+                      > before["p99_s"] * P99_SLACK)
+    report["gates"] = {"accounting": not bad_side,
+                       "recovery": not recovery_failed,
+                       "p99": not p99_failed}
+    return report, rows, bad_side or recovery_failed or p99_failed
+
+
 def _overhead_schedule(n: int, rng) -> list:
     """A SINGLE shape class (sosfilt @ 512), so the probe compiles
     exactly one handle: the mixed-traffic matrix's random row-padding
@@ -574,13 +811,26 @@ def overhead_row(args, rng) -> dict:
             for warm in (False, True):
                 obs.configure(request_axis=warm)
                 run_load(srv, _overhead_schedule(m, rng), verify=0)
-            for k in range(bursts):
-                traced = bool(k % 2)
-                obs.configure(request_axis=traced)
-                rep = run_load(srv, _overhead_schedule(m, rng),
-                               verify=0)
-                wall[traced] += rep["wall_s"]
-                done[traced] += rep["ok"] + rep["degraded"]
+            # fence the collector out of the bursts: late in a long
+            # process (a chaos campaign, a full test run) the heap
+            # carries hundreds of MB of live compile caches, and one
+            # gen-2 sweep landing inside a ~tens-of-ms burst skews
+            # that mode's pooled wall time far more than the <5%
+            # effect being measured — collect now, then keep
+            # automatic collection off for the measured window
+            import gc
+            gc.collect()
+            gc.disable()
+            try:
+                for k in range(bursts):
+                    traced = bool(k % 2)
+                    obs.configure(request_axis=traced)
+                    rep = run_load(srv, _overhead_schedule(m, rng),
+                                   verify=0)
+                    wall[traced] += rep["wall_s"]
+                    done[traced] += rep["ok"] + rep["degraded"]
+            finally:
+                gc.enable()
             scrape_endpoint(srv.obs_port)
     finally:
         obs.configure(request_axis=True)
@@ -625,6 +875,14 @@ def main(argv=None) -> int:
                     help="write bench rows here (SERVE_DETAILS.json)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny run, gate on lost/double/parity")
+    ap.add_argument("--saturation", action="store_true",
+                    help="goodput-at-saturation A/B campaign: the "
+                         "same heavy-tailed mixed-shape schedule "
+                         "served with continuous batching + ragged "
+                         "packing off (padding-waste baseline) then "
+                         "on; writes GOODPUT_DETAILS rows; rc=1 "
+                         "unless the padding-waste recovery reaches "
+                         f"{RECOVERY_MIN}x with p99 held")
     ap.add_argument("--pipeline-streams", type=int, default=None,
                     help="pipeline-invocation streams to serve "
                          "(default: 2 in --smoke, else 0)")
@@ -649,6 +907,19 @@ def main(argv=None) -> int:
     maybe_override_platform()
     obs.enable()
     obs.reset()
+    if args.saturation:
+        rng = np.random.RandomState(args.seed)
+        report, rows, failed = saturation_campaign(args, rng)
+        print(json.dumps(report, indent=2, default=str))
+        details = args.details or "GOODPUT_DETAILS.json"
+        with open(details, "w") as f:
+            json.dump(rows, f, indent=2)
+        print(f"loadgen: wrote {details}", file=sys.stderr)
+        if failed:
+            print(f"loadgen: saturation gates FAILED "
+                  f"{report['gates']}", file=sys.stderr)
+            return 1
+        return 0
     if args.smoke:
         args.requests = min(args.requests, 80)
         args.rate = 0.0

@@ -75,6 +75,7 @@ def _metric_lines(prom: str) -> list:
                                  "serve_shed", "serve_deadline_miss",
                                  "serve_queue_depth",
                                  "serve_degraded_batch",
+                                 "serve_refilled",
                                  "slo_burn_rate", "slo_hit_rate")):
             continue
         if short.endswith(("_bucket", "_sum", "_count")):
@@ -120,6 +121,46 @@ def _compile_lines(prom: str) -> list:
                 "compile_cache_hits", "compile_cache_misses",
                 "compile_backend_compile"):
             lines.append("  %-52s %12g" % (k, tally[k]))
+    return lines
+
+
+def _goodput_lines(prom: str) -> list:
+    """The live goodput-recovery scoreboard out of a /metrics scrape:
+    per shape class, useful vs dispatched SAMPLES and the padding
+    waste between them — the footprint continuous batching + ragged
+    packing exist to recover (``serve_refilled_rows`` in the metrics
+    block above tallies the refill half)."""
+    parsed = export.parse_prometheus(prom)
+    per: dict = {}
+    for (name, labels), value in parsed.items():
+        short = name.replace(export.PROMETHEUS_PREFIX, "")
+        if short.endswith("_total"):        # counter suffix
+            short = short[:-len("_total")]
+        if short not in ("serve_useful_samples",
+                         "serve_dispatched_samples"):
+            continue
+        lab = dict(labels)
+        key = "%s|%s" % (lab.get("op", "?"), lab.get("bucket", "?"))
+        d = per.setdefault(key, [0.0, 0.0])
+        d[0 if short == "serve_useful_samples" else 1] += value
+    if not per:
+        return []
+    lines = ["goodput by shape class (useful/dispatched samples):"]
+    tot_u = tot_d = 0.0
+    for key in sorted(per):
+        u, d = per[key]
+        tot_u += u
+        tot_d += d
+        gp = u / d if d else None
+        lines.append(
+            "  %-28s useful=%-10g dispatched=%-10g goodput=%-7s "
+            "waste=%s" % (
+                key, u, d,
+                "-" if gp is None else "%.4f" % gp,
+                "-" if gp is None else "%.1f%%" % (100 * (1 - gp))))
+    if tot_d:
+        lines.append("  %-28s goodput=%.4f waste=%.1f%%" % (
+            "overall", tot_u / tot_d, 100 * (1 - tot_u / tot_d)))
     return lines
 
 
@@ -183,12 +224,25 @@ def render_fleet(base_url: str) -> tuple:
                 rid, health[rid], _fmt_s(stale.get(rid)),
                 depth.get(rid, "-"), b_open.get(rid, 0),
                 b_flaps.get(rid, 0), scrape.get(rid, 0)))
+    occ = sig.get("occupancy") or {}
+    if occ:
+        # the padding-aware placement signal: rows already queued in
+        # a replica's forming batches — the router's occupancy bonus
+        # steers same-class work here so batches fill instead of pad
+        lines.append("open-batch occupancy (rows in forming "
+                     "batches):")
+        for rid in sorted(occ):
+            lines.append("  %-8s %g" % (rid, occ[rid]))
     good = sig.get("goodput") or {}
     overall = sig.get("goodput_overall")
     if good or overall is not None:
         lines.append("goodput (useful rows / dispatched rows):")
         if overall is not None:
-            lines.append("  %-40s %8.4f" % ("overall", overall))
+            waste = sig.get("padding_waste")
+            lines.append("  %-40s %8.4f%s" % (
+                "overall", overall,
+                "" if waste is None
+                else "  (padding waste %.1f%%)" % (100 * waste)))
         for key in sorted(good):
             lines.append("  %-40s %8.4f" % (key, good[key]))
     series = sig.get("series") or {}
@@ -244,6 +298,7 @@ def render(base_url: str) -> tuple:
         lines.append("metrics:")
         lines += rows
     lines += _compile_lines(prom)
+    lines += _goodput_lines(prom)
     try:
         r = json.loads(reqs)
         summary = r.get("summary", {})

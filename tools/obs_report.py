@@ -14,9 +14,12 @@ degraded-batch counts, latest breaker states, and the request-axis +
 per-tenant SLO summaries (BENCH_DETAILS mode gets the per-config
 ``serve_*`` counter block), and a Fleet section when the snapshot
 carries the fleet axis (obs v5: the ``ReplicaGroup`` collector's
-per-replica windowed series — last value, delta, flap count).  ``--prometheus`` converts a full snapshot
-to the Prometheus text exposition format instead, so a file captured
-on a TPU host can be pushed through a gateway later.
+per-replica windowed series — last value, delta, flap count), and a
+goodput-recovery scoreboard for BENCH_DETAILS entries carrying
+``recovered`` evidence (``GOODPUT_DETAILS.json``: padding waste
+before/after per shape class).  ``--prometheus`` converts a full
+snapshot to the Prometheus text exposition format instead, so a file
+captured on a TPU host can be pushed through a gateway later.
 
 Usage:  python tools/obs_report.py SNAPSHOT.json
         python tools/obs_report.py --prometheus SNAPSHOT.json
@@ -192,6 +195,41 @@ def _bench_serving_lines(counters: dict, indent="  ") -> list:
     return lines
 
 
+def _recovered_lines(rec, indent="  ") -> list:
+    """The goodput-recovery scoreboard for one bench entry carrying
+    ``recovered`` evidence (the saturation A/B in
+    ``GOODPUT_DETAILS.json``): dispatched-footprint waste before vs
+    after continuous batching + ragged packing, the refilled-row
+    tally, and the per-shape-class waste table.  A class blank on one
+    side re-bucketed (packing folds the short stft pow2 classes into
+    ``stft|ragged``) — the fold IS the mechanism, so it renders
+    as-is rather than being papered over."""
+    if not isinstance(rec, dict):
+        return []
+
+    def pct(v):
+        return "-" if v is None else "%.1f%%" % (100.0 * v)
+
+    lines = ["%sgoodput recovery scoreboard:" % indent,
+             "%s  padding waste %s -> %s  refilled_rows=%s  "
+             "useful=%s dispatched=%s"
+             % (indent, pct(rec.get("waste_before")),
+                pct(rec.get("waste_after")),
+                rec.get("refilled_rows"),
+                rec.get("useful_samples"),
+                rec.get("dispatched_samples"))]
+    by = rec.get("by_class") or {}
+    if by:
+        lines.append("%s  waste by shape class (before -> after):"
+                     % indent)
+        for key in sorted(by):
+            w = by[key]
+            lines.append("%s    %-28s %8s -> %8s"
+                         % (indent, key, pct(w.get("waste_before")),
+                            pct(w.get("waste_after"))))
+    return lines
+
+
 def _roofline_lines(roof, indent="  ") -> list:
     """Measured vs analytical roofline % for one bench entry."""
     if not roof:
@@ -221,6 +259,7 @@ def _render_bench_details(entries) -> str:
         tel = e.get("telemetry")
         lines.append("=== %s ===" % e.get("metric", "(unnamed config)"))
         lines += _roofline_lines(e.get("roofline"))
+        lines += _recovered_lines(e.get("recovered"))
         if tel is None:
             lines.append("  (no telemetry recorded)")
             continue

@@ -258,6 +258,7 @@ class FleetSignals:
     ``slo_burn_velocity`` {tenant: d(burn)/dt over the window, 1/s}
     ``queue_depth``       {replica: newest admitted depth}
     ``queue_depth_total`` summed fleet queue depth
+    ``occupancy``         {replica: rows queued in forming batches}
     ``breaker_open``      {replica: newest open-breaker count}
     ``breaker_flaps``     {replica: open-count changes over the window}
     ``goodput``           {"op|class": useful/dispatched rows gauge}
@@ -271,9 +272,10 @@ class FleetSignals:
 
     __slots__ = ("at_s", "ticks", "tick_s", "window", "slo_burn",
                  "slo_burn_velocity", "queue_depth",
-                 "queue_depth_total", "breaker_open", "breaker_flaps",
-                 "goodput", "goodput_overall", "padding_waste",
-                 "health", "staleness_s", "scrape_stale", "series")
+                 "queue_depth_total", "occupancy", "breaker_open",
+                 "breaker_flaps", "goodput", "goodput_overall",
+                 "padding_waste", "health", "staleness_s",
+                 "scrape_stale", "series")
 
     def __init__(self, **kw):
         missing = [n for n in self.__slots__ if n not in kw]
@@ -305,6 +307,7 @@ class FleetSignals:
                     velocity[series.split(":", 1)[1]] = v
         replicas = [r for r in fleet.replicas() if r != "_fleet"]
         depth = {}
+        occupancy = {}
         b_open = {}
         b_flaps = {}
         health = {}
@@ -315,6 +318,9 @@ class FleetSignals:
             d = fleet.value(r, "depth")
             if d is not None:
                 depth[r] = d
+            occ = fleet.value(r, "occupancy")
+            if occ is not None:
+                occupancy[r] = occ
             bo = fleet.value(r, "breaker_open")
             if bo is not None:
                 b_open[r] = int(bo)
@@ -358,6 +364,7 @@ class FleetSignals:
             window=fleet.window, slo_burn=burn,
             slo_burn_velocity=velocity, queue_depth=depth,
             queue_depth_total=sum(depth.values()),
+            occupancy=occupancy,
             breaker_open=b_open, breaker_flaps=b_flaps,
             goodput=goodput, goodput_overall=overall,
             padding_waste=(None if overall is None

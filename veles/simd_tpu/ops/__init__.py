@@ -26,6 +26,9 @@ Each module mirrors one reference header (SURVEY.md §2):
 * :mod:`.waveforms`    — chirps, square/sawtooth, Gaussian pulses as
   fused elementwise generators (beyond-reference)
 * :mod:`.detect_peaks` — 1D local-extrema detection
+* :mod:`.segments`     — ragged segment packing: variable-length
+  signals concatenated along the sample axis into shared rows, one
+  dispatch, bit-equal per-segment slices back out (beyond-reference)
 
 Every public op takes the reference-compatible ``simd=`` flag: truthy (the
 default) runs the jitted XLA path; falsy runs the NumPy oracle twin, keeping

@@ -23,6 +23,14 @@ readiness afterwards — an expired head neither dispatches stale work
 nor wedges its bucket, and the condition wait wakes at the earliest
 head deadline (request or batching) so expiry is noticed promptly.
 
+Workers may additionally **refill** a just-formed batch's free row
+slots from the same shape class's queue (:meth:`take_refill`) — the
+continuous-batching path: a request taken this way dispatches
+immediately on a batch that was leaving anyway instead of zero-padding
+riding in its place.  :meth:`depth_for` exposes the per-class queue
+depth (open-batch occupancy) that the cluster's padding-aware
+placement score reads.
+
 ``close()`` makes every queued request immediately ready (drain), and
 :meth:`next_batch` returns None only when the batcher is closed AND
 empty — the worker-loop exit condition, so no request can be left
@@ -242,7 +250,52 @@ class Batcher:
                     wait = max(wait, _MIN_WAIT_S)
                 self._cond.wait(wait)
 
+    def take_refill(self, key, limit: int, now: float | None = None) -> list:
+        """Continuous-batching refill: pop up to ``limit`` queued items
+        of shape class ``key`` *right now*, without waiting for the
+        class to become ready.  A worker that just formed a batch whose
+        row count sits below its pow-of-two row class calls this to
+        fill the otherwise-zero-padded row slots — the refilled
+        requests ride a dispatch that was happening anyway, so they
+        skip their remaining batching wait entirely (the Orca-style
+        slot-refill trick applied at dispatch grain).
+
+        Expired items encountered while refilling are shed to
+        ``on_expired`` exactly as :meth:`next_batch` would shed them
+        (with the lock held) — a refill must never smuggle stale work
+        onto the device.  Returns the taken items in FIFO order
+        (possibly empty)."""
+        if limit <= 0:
+            return []
+        with self._cond:
+            q = self._buckets.get(key)
+            if not q:
+                return []
+            if now is None:
+                now = faults.monotonic()
+            taken, expired = [], []
+            while q and len(taken) < limit:
+                it = q.popleft()
+                (expired if self._expired(it, now) else taken).append(it)
+            if not q:
+                del self._buckets[key]
+            self._deadlines_queued -= sum(
+                1 for it in taken + expired
+                if getattr(it, "deadline", None) is not None)
+            if expired and self._on_expired is not None:
+                self._on_expired(expired)
+            return taken
+
     # -- introspection -----------------------------------------------------
+
+    def depth_for(self, key) -> int:
+        """Requests currently queued under one shape class — the
+        **open-batch occupancy** the cluster's padding-aware placement
+        reads: a nonzero depth means a dispatch here would complete a
+        forming batch rather than open a fresh one."""
+        with self._cond:
+            q = self._buckets.get(key)
+            return len(q) if q else 0
 
     def pending(self) -> int:
         """Requests currently queued across every shape class."""

@@ -16,7 +16,11 @@ the way PRs 9-10 proved a single server survives losing a device:
   ``serve.dispatch`` breaker) adds a class-local penalty — an open
   breaker or degraded replica is **deprioritized per shape class, not
   blacklisted globally** (its other classes, and last-resort traffic,
-  still flow).  ``VELES_SIMD_ROUTER_POLICY=round_robin`` swaps the
+  still flow).  Padding-aware placement subtracts an **occupancy
+  bonus** (``$VELES_SIMD_ROUTER_OCCUPANCY_WEIGHT``) for a replica
+  whose batcher already holds a forming batch of the request's shape
+  class — the request completes that batch instead of opening one
+  that will pad.  ``VELES_SIMD_ROUTER_POLICY=round_robin`` swaps the
   scoring for a rotation (the A/B control);
 * **failover** — every backend ticket carries a completion hook: a
   replica that dies with the request queued (``status="closed"``) or
@@ -117,14 +121,17 @@ __all__ = [
     "Replica", "ReplicaGroup", "FrontRouter", "RouterTicket",
     "NoReplicaAvailable", "UP", "DRAINING", "DEAD", "RESTARTING",
     "REPLICAS_ENV", "ROUTER_POLICY_ENV", "HEARTBEAT_MS_ENV",
+    "OCCUPANCY_WEIGHT_ENV",
     "DEFAULT_REPLICAS", "DEFAULT_HEARTBEAT_MS", "DEFAULT_MISS_LIMIT",
+    "DEFAULT_OCCUPANCY_WEIGHT",
     "ROUTER_POLICIES", "env_replicas", "env_router_policy",
-    "env_heartbeat_s",
+    "env_heartbeat_s", "env_occupancy_weight",
 ]
 
 REPLICAS_ENV = "VELES_SIMD_REPLICAS"
 ROUTER_POLICY_ENV = "VELES_SIMD_ROUTER_POLICY"
 HEARTBEAT_MS_ENV = "VELES_SIMD_HEARTBEAT_MS"
+OCCUPANCY_WEIGHT_ENV = "VELES_SIMD_ROUTER_OCCUPANCY_WEIGHT"
 
 # two replicas is the smallest group with a failover story; the env
 # default exists for tooling (loadgen --replicas 0 -> env -> 2)
@@ -151,6 +158,13 @@ RESTARTING = "restarting"
 # (deprioritized, not blacklisted)
 BREAKER_OPEN_PENALTY = 1e3
 DEGRADED_PENALTY = 1e6
+
+# padding-aware placement: a replica with a FORMING batch of the
+# request's shape class gets a bonus (the request completes that
+# batch — riding a padding slot — instead of opening a fresh one
+# that will pad).  The term is bounded strictly below 1 request of
+# depth so it only breaks near-ties, never outranks real load.
+DEFAULT_OCCUPANCY_WEIGHT = 0.5
 
 
 def env_replicas() -> int:
@@ -183,6 +197,20 @@ def env_heartbeat_s() -> float:
     except ValueError:
         return DEFAULT_HEARTBEAT_MS / 1e3
     return (value if value > 0 else DEFAULT_HEARTBEAT_MS) / 1e3
+
+
+def env_occupancy_weight() -> float:
+    """Occupancy-bonus weight for the padding-aware placement term
+    from ``$VELES_SIMD_ROUTER_OCCUPANCY_WEIGHT`` (default 0.5;
+    0 disables the term; negative / malformed falls back)."""
+    raw = os.environ.get(OCCUPANCY_WEIGHT_ENV, "").strip()
+    if not raw:
+        return DEFAULT_OCCUPANCY_WEIGHT
+    try:
+        value = float(raw)
+    except ValueError:
+        return DEFAULT_OCCUPANCY_WEIGHT
+    return value if value >= 0 else DEFAULT_OCCUPANCY_WEIGHT
 
 
 class NoReplicaAvailable(Overloaded):
@@ -751,6 +779,13 @@ class ReplicaGroup:
                 depth = float(r.server.depth())
                 counts = r.server.counts()
                 obs.fleet_record(r.rid, "depth", depth, t_s=now)
+                # open-batch occupancy: rows queued in forming
+                # batches — the padding-aware placement signal,
+                # exported so dashboards/autoscalers see where
+                # batches are forming across the fleet
+                obs.fleet_record(r.rid, "occupancy",
+                                 float(r.server.occupancy()),
+                                 t_s=now)
                 obs.fleet_record(
                     r.rid, "healthy",
                     1.0 if r.server.health == "healthy" else 0.0,
@@ -940,7 +975,8 @@ class FrontRouter:
 
     def __init__(self, group: ReplicaGroup, *,
                  policy: str | None = None,
-                 max_failovers: int | None = None):
+                 max_failovers: int | None = None,
+                 occupancy_weight: float | None = None):
         if group.spawn != "thread":
             raise ValueError(
                 "FrontRouter places requests on in-process replicas "
@@ -956,6 +992,9 @@ class FrontRouter:
         self.max_failovers = (
             int(max_failovers) if max_failovers is not None
             else max(1, len(group.replicas) - 1))
+        self.occupancy_weight = (
+            float(occupancy_weight) if occupancy_weight is not None
+            else env_occupancy_weight())
         self._lock = threading.Lock()
         self._rids = itertools.count()
         self._rr = itertools.count()
@@ -971,15 +1010,34 @@ class FrontRouter:
         admitted depth, plus the DEGRADED-health penalty, plus the
         open-breaker penalty when THIS class's breaker on THIS
         replica is open (per shape class — an open sosfilt breaker
-        does not deprioritize the replica's stft traffic)."""
+        does not deprioritize the replica's stft traffic), minus the
+        padding-aware **occupancy bonus**: a replica whose batcher
+        already holds a forming batch of this class scores lower (the
+        new request completes that batch, riding a row slot that
+        would otherwise dispatch as zero padding).  The bonus is
+        ``occupancy_weight * min(occ, max_batch-1)/max_batch`` —
+        bounded strictly below one queued request at the default
+        weight, so occupancy breaks near-ties but never outranks real
+        load (or either penalty)."""
         server = replica.server
         s = float(server.depth())
         if server.health == "degraded":
             s += DEGRADED_PENALTY
-        br = _breaker.lookup("serve.dispatch",
-                             server.breaker_key(key))
+        # ragged classes carry their breaker on the packed-dispatch
+        # site (the per-segment salvage lives inside it); plain
+        # classes on the serve dispatch — score must read the breaker
+        # the dispatch will actually consult
+        site = ("segments.dispatch"
+                if isinstance(key, tuple) and key
+                and key[-1] == "ragged" else "serve.dispatch")
+        br = _breaker.lookup(site, server.breaker_key(key))
         if br is not None and br.state == _breaker.OPEN:
             s += BREAKER_OPEN_PENALTY
+        if self.occupancy_weight:
+            occ = server.open_occupancy(key)
+            if occ > 0:
+                mb = max(1, server.max_batch)
+                s -= self.occupancy_weight * min(occ, mb - 1) / mb
         return s
 
     def _pick(self, key, exclude) -> Replica | None:

@@ -13,6 +13,17 @@ already has, plus the one loop none of them provided:
 * **deadline batching** — :class:`~veles.simd_tpu.serve.batcher.
   Batcher` dispatches a bucket when it is full (``max_batch``) or its
   oldest request has waited ``max_wait`` (whichever fires first);
+* **continuous batching** — an under-full batch tops its pow2 row
+  class up from its own queue at dispatch time
+  (``VELES_SIMD_SERVE_CONTINUOUS``, default on): refilled requests
+  ride row slots that were dispatching as zero padding anyway,
+  tagged ``refilled`` on their ``batch_formed`` trace edge;
+* **ragged segment packing** — with ``VELES_SIMD_SERVE_RAGGED`` on,
+  stft requests classify into one sample-axis-packed "ragged" class
+  per (op, params): variable lengths co-pack into shared rows
+  (:mod:`veles.simd_tpu.ops.segments`) behind a ``segments.dispatch``
+  breaker whose fallback is per-segment salvage — one poisoned
+  segment degrades its own ticket, never co-packed neighbors;
 * **end-to-end request deadlines** — ``submit(deadline_ms=...)``
   stamps an absolute monotonic deadline at admission (default from
   ``VELES_SIMD_SERVE_DEADLINE_MS``; 0/unset = none); a request whose
@@ -113,6 +124,7 @@ from veles.simd_tpu.obs import http as obs_http
 from veles.simd_tpu.ops import batched
 from veles.simd_tpu.ops import iir as _iir
 from veles.simd_tpu.ops import resample as _rs
+from veles.simd_tpu.ops import segments as _segments
 from veles.simd_tpu.ops import spectral as _sp
 from veles.simd_tpu.runtime import artifacts as _artifacts
 from veles.simd_tpu.runtime import breaker as _breaker
@@ -125,13 +137,72 @@ from veles.simd_tpu.serve.health import (DEFAULT_PROBE_EVERY,
 
 __all__ = ["Request", "Ticket", "Server", "ServerClosed",
            "DeadlineExceeded", "SUPPORTED_OPS", "DEFAULT_WORKERS",
-           "DEADLINE_ENV", "env_deadline_ms", "classify_request"]
+           "DEADLINE_ENV", "env_deadline_ms", "classify_request",
+           "CONTINUOUS_ENV", "RAGGED_ENV", "RAGGED_MAX_ENV",
+           "continuous_enabled", "ragged_enabled", "ragged_max"]
 
 # two workers overlap one batch's host-side padding/slicing with the
 # previous batch's device wait without oversubscribing dispatch
 DEFAULT_WORKERS = 2
 
 DEADLINE_ENV = "VELES_SIMD_SERVE_DEADLINE_MS"
+
+# continuous batching (Orca-style slot refill at dispatch grain): a
+# worker that just formed an under-full batch tops its pow2 row class
+# up from the same shape class's queue, so requests ride padding slots
+# that were dispatching anyway.  Default ON; set =0/off to disable.
+CONTINUOUS_ENV = "VELES_SIMD_SERVE_CONTINUOUS"
+
+# ragged segment packing (ops/segments.py): stft requests classify
+# into one per-(op, params) "ragged" shape class and co-pack along the
+# sample axis instead of zero-padding each to its pow2 bucket.
+# Default OFF (opt-in; flips the stft shape classing).
+RAGGED_ENV = "VELES_SIMD_SERVE_RAGGED"
+
+# requests longer than this many samples keep their plain pow2 bucket
+# even with ragged on: the packed width is the pow2 bucket of the
+# LARGEST co-packed stride, and a packed plan's tail row quantizes to
+# that width — one heavy-tail request in an under-full batch can cost
+# more slack than its own plain bucket would have (measured: letting
+# 2800-sample requests co-pack at width 4096 LOWERED saturation
+# goodput 0.84 -> 0.78).  2048 keeps the width a mid-size batch of
+# short segments reliably backfills.
+RAGGED_MAX_ENV = "VELES_SIMD_SERVE_RAGGED_MAX"
+DEFAULT_RAGGED_MAX = 2048
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    raw = os.environ.get(name, "").strip().lower()
+    if not raw:
+        return default
+    return raw not in ("0", "off", "false", "no")
+
+
+def continuous_enabled() -> bool:
+    """Is continuous batching (dispatch-time slot refill) on?
+    (``$VELES_SIMD_SERVE_CONTINUOUS``; default on)."""
+    return _env_flag(CONTINUOUS_ENV, True)
+
+
+def ragged_enabled() -> bool:
+    """Is ragged segment packing for stft on?
+    (``$VELES_SIMD_SERVE_RAGGED``; default off)."""
+    return _env_flag(RAGGED_ENV, False)
+
+
+def ragged_max() -> int:
+    """Longest request (samples) that still co-packs into the ragged
+    class (``$VELES_SIMD_SERVE_RAGGED_MAX``; default 1024, malformed
+    or non-positive values fall back)."""
+    raw = os.environ.get(RAGGED_MAX_ENV, "").strip()
+    if raw:
+        try:
+            v = int(raw)
+        except ValueError:
+            return DEFAULT_RAGGED_MAX
+        if v > 0:
+            return v
+    return DEFAULT_RAGGED_MAX
 
 
 def env_deadline_ms() -> float | None:
@@ -284,7 +355,7 @@ class _Pending:
     against double release when a batch fails midway)."""
 
     __slots__ = ("ticket", "x", "n", "params", "enq", "deadline",
-                 "released")
+                 "released", "refilled")
 
     def __init__(self, ticket, x, n, params, enq, deadline=None):
         self.ticket = ticket
@@ -294,6 +365,11 @@ class _Pending:
         self.enq = enq
         self.deadline = deadline
         self.released = False
+        # taken by the continuous-batching refill (dispatch-time slot
+        # fill) rather than by batch formation — tagged on the
+        # batch_formed trace edge so phase accounting can tell a
+        # refilled row from a founding one
+        self.refilled = False
 
 
 # ---------------------------------------------------------------------------
@@ -378,6 +454,13 @@ def classify_request(op: str, x, params: dict):
             f"(supported: {', '.join(SUPPORTED_OPS)})")
     validate, _ = _OPS[op]
     cparams, param_key = validate(params, n)
+    if op == "stft" and ragged_enabled() and n <= ragged_max():
+        # one sample-axis-packed class per (op, params): variable
+        # SHORT lengths co-pack into shared rows (ops/segments.py)
+        # instead of each padding to its own pow2 bucket, so the
+        # bucket slot of the key is the literal class tag "ragged";
+        # longer requests fall through to plain bucket classing
+        return xarr, n, cparams, (op, param_key, "ragged")
     return xarr, n, cparams, (op, param_key, bucket_length(n))
 
 
@@ -470,12 +553,19 @@ class Server:
                        "degraded_answers": 0, "errors": 0,
                        "expired": 0, "breaker_shed": 0,
                        "batches": 0, "batched_requests": 0,
-                       "useful_rows": 0, "dispatched_rows": 0}
+                       "useful_rows": 0, "dispatched_rows": 0,
+                       "refilled_rows": 0,
+                       "useful_samples": 0, "dispatched_samples": 0}
         # cumulative (useful, dispatched) row tallies per (op, shape
         # class) — the goodput denominators behind the serve.goodput /
         # serve.padding_waste gauges (obs v5, ROADMAP item 3's
         # padding-waste baseline)
         self._goodput: dict = {}
+        # the sample-axis twin: (useful, dispatched) SAMPLE tallies
+        # per (op, shape class) — rows miss the waste *inside* a row
+        # (a 513-sample request in a 1024 bucket is half padding), so
+        # the goodput bench family gates on samples, not rows
+        self._goodput_samples: dict = {}
         self._started = False
         self._stopped = False
         # the warm-pack preload report ({"loaded": n, ...}) once
@@ -785,6 +875,7 @@ class Server:
                      if p.deadline is None or now < p.deadline]
             if not batch:
                 return
+        batch = self._refill(key, batch, now)
         budget_s = None
         for p in batch:
             if p.deadline is not None:
@@ -794,6 +885,9 @@ class Server:
                     budget_s = slack
         if op.startswith("pipeline:"):
             self._run_pipeline_batch(op, batch, nb, budget_s)
+            return
+        if nb == "ragged":
+            self._run_ragged_batch(op, key, batch, budget_s)
             return
         rows = len(batch)
         # row-pad to the power-of-two class so occupancy churn shares
@@ -815,52 +909,105 @@ class Server:
             lambda i, p: slicer(ys[i], p.n, p.params), degraded,
             rpad=rpad, nb=nb)
 
-    def _note_batch_formed(self, batch, rpad: int) -> None:
+    def _refill(self, key, batch, now: float):
+        """Continuous batching: top an under-full batch up from its
+        own shape class's queue at dispatch time.  The batch is
+        row-padded to its pow2 class anyway — every slot below
+        ``bucket_length(rows)`` was about to dispatch as a zero row,
+        so a queued same-class request riding it costs nothing and
+        skips its remaining batching wait (an Orca-style slot refill
+        at fused-dispatch grain: the op families dispatch whole
+        batches, so the refill point is batch formation, not
+        mid-flight row completion).  Refilled rows keep their own
+        trace chain — ``batch_formed`` tags them ``refilled`` and
+        they share the batch's ``dispatched``/terminal edges, so
+        phases still sum."""
+        if not continuous_enabled():
+            return batch
+        op, _, nb = key
+        free = min(bucket_length(len(batch)),
+                   self._batcher.max_batch) - len(batch)
+        if free <= 0:
+            return batch
+        taken = self._batcher.take_refill(key, free, now)
+        if not taken:
+            return batch
+        for p in taken:
+            p.refilled = True
+        obs.count("serve_refilled_rows", len(taken), op=op, bucket=nb)
+        with self._stats_lock:
+            self._stats["refilled_rows"] += len(taken)
+        return batch + taken
+
+    def _note_batch_formed(self, batch, rpad: int,
+                           rows_used: int | None = None) -> None:
         """The ``batch_formed`` trace edge for every co-batched
         request: shared batch id, co-batched count, and the padding
-        rows the pow2 row class added."""
+        rows the pow2 row class added.  ``rows_used`` overrides the
+        used-row count when it differs from the request count (the
+        ragged path packs several requests per row).  A row taken by
+        the continuous-batching refill carries ``refilled=True`` —
+        its edge is its own (phase sums stay exact), the tag is how
+        the trace tells a slot-refilled row from a founding one."""
         with self._stats_lock:
             bid = self._batch_seq
             self._batch_seq += 1
         rows = len(batch)
+        used = rows if rows_used is None else rows_used
         for p in batch:
             p.ticket.trace.event("batch_formed", batch=bid,
                                  co_batched=rows,
-                                 padding_rows=rpad - rows)
+                                 padding_rows=rpad - used,
+                                 **({"refilled": True} if p.refilled
+                                    else {}))
 
     def _finish_batch(self, op: str, batch, value_for,
-                      degraded: bool, *, rpad: int | None = None,
-                      nb=None) -> None:
+                      degraded, *, rpad: int | None = None,
+                      nb=None, useful_rows: int | None = None,
+                      useful_samples: int | None = None,
+                      dispatched_samples: int | None = None) -> None:
         """Complete every ticket + the shared batch accounting — ONE
-        home for the plain-op and pipeline batch paths.  ``value_for
-        (i, pending)`` builds row ``i``'s answer; it is called
-        per-row, not bulk-at-the-end, so a value-build failure midway
-        leaves the tally matching the tickets actually answered (the
-        worker's handler counts the rest as errors).  ``rpad`` (the
-        pow2-padded row count actually dispatched) and ``nb`` (the
-        shape class) feed the goodput accounting: the
+        home for the plain-op, pipeline, and ragged batch paths.
+        ``value_for(i, pending)`` builds row ``i``'s answer; it is
+        called per-row, not bulk-at-the-end, so a value-build failure
+        midway leaves the tally matching the tickets actually
+        answered (the worker's handler counts the rest as errors).
+        ``degraded`` is a bool for whole-batch fates or a per-request
+        flag sequence (the ragged path's per-segment fault isolation:
+        one poisoned segment degrades its own ticket only).  ``rpad``
+        (the pow2-padded row count actually dispatched) and ``nb``
+        (the shape class) feed the goodput accounting: the
         ``serve_padding_rows`` / ``serve_useful_rows`` /
         ``serve_dispatched_rows`` counters and the cumulative
         ``serve.goodput`` / ``serve.padding_waste`` gauges per (op,
-        shape class).  These are metric-axis writes, NOT request-axis
-        ones — they keep recording under ``configure(
-        request_axis=False)``, so padding waste stays visible with
-        tracing load-shed."""
+        shape class) — plus the SAMPLE-axis twins
+        (``serve_useful_samples`` / ``serve_dispatched_samples``,
+        ``serve.sample_goodput`` / ``serve.sample_waste``), which see
+        the waste *inside* a row that row counts miss (bucket padding
+        along the signal axis — what ragged packing recovers).
+        ``useful_samples``/``dispatched_samples`` override the
+        derived fixed-bucket arithmetic for packed dispatches.  These
+        are metric-axis writes, NOT request-axis ones — they keep
+        recording under ``configure(request_axis=False)``, so padding
+        waste stays visible with tracing load-shed."""
         now = faults.monotonic()
-        status = "degraded" if degraded else "ok"
         rows = len(batch)
+        flags = (list(degraded)
+                 if isinstance(degraded, (list, tuple))
+                 else [bool(degraded)] * rows)
         for i, p in enumerate(batch):
             wait = now - p.enq
             # the serve.request_latency{op, status} sample and the
             # serve_completed counter flow through Ticket._complete ->
             # trace.finish — one terminal-accounting home, every
             # status included (the survivorship-bias fix)
-            p.ticket._complete(value=value_for(i, p), status=status,
-                               wait_s=wait)
+            p.ticket._complete(
+                value=value_for(i, p),
+                status="degraded" if flags[i] else "ok", wait_s=wait)
             self._release(p)
             with self._stats_lock:
                 self._stats["completed"] += 1
-                if degraded:
+                if flags[i]:
                     self._stats["degraded_answers"] += 1
         obs.observe("serve.batch_fill",
                     rows / self._batcher.max_batch, op=op)
@@ -871,21 +1018,48 @@ class Server:
         if rpad is not None and rpad > 0:
             # the shape-class label is ``bucket`` (the pow2 class the
             # request length padded to) — NOT ``n``, which collides
-            # with obs.count's increment parameter
-            obs.count("serve_padding_rows", rpad - rows,
+            # with obs.count's increment parameter.  ``useful_rows``
+            # overrides the request count when requests and rows
+            # differ (the ragged path packs several requests per row;
+            # its row efficiency is used-rows over pow2-padded rows)
+            ur = rows if useful_rows is None else useful_rows
+            obs.count("serve_padding_rows", rpad - ur,
                       op=op, bucket=nb)
-            obs.count("serve_useful_rows", rows, op=op, bucket=nb)
+            obs.count("serve_useful_rows", ur, op=op, bucket=nb)
             obs.count("serve_dispatched_rows", rpad, op=op, bucket=nb)
             with self._stats_lock:
                 tally = self._goodput.setdefault((op, nb), [0, 0])
-                tally[0] += rows
+                tally[0] += ur
                 tally[1] += rpad
                 goodput = tally[0] / tally[1]
-                self._stats["useful_rows"] += rows
+                self._stats["useful_rows"] += ur
                 self._stats["dispatched_rows"] += rpad
             obs.gauge("serve.goodput", goodput, op=op, bucket=nb)
             obs.gauge("serve.padding_waste", 1.0 - goodput,
                       op=op, bucket=nb)
+            if useful_samples is None and isinstance(nb, int):
+                # fixed-bucket dispatch: every row is nb samples wide,
+                # the useful part is each request's true length
+                useful_samples = sum(p.n for p in batch)
+                dispatched_samples = rpad * nb
+            if useful_samples is not None and dispatched_samples:
+                obs.count("serve_useful_samples", useful_samples,
+                          op=op, bucket=nb)
+                obs.count("serve_dispatched_samples",
+                          dispatched_samples, op=op, bucket=nb)
+                with self._stats_lock:
+                    st = self._goodput_samples.setdefault(
+                        (op, nb), [0, 0])
+                    st[0] += useful_samples
+                    st[1] += dispatched_samples
+                    sample_goodput = st[0] / st[1]
+                    self._stats["useful_samples"] += useful_samples
+                    self._stats["dispatched_samples"] += \
+                        dispatched_samples
+                obs.gauge("serve.sample_goodput", sample_goodput,
+                          op=op, bucket=nb)
+                obs.gauge("serve.sample_waste", 1.0 - sample_goodput,
+                          op=op, bucket=nb)
 
     def _run_pipeline_batch(self, op: str, batch, nb: int,
                             budget_s: float | None) -> None:
@@ -929,6 +1103,77 @@ class Server:
         self._finish_batch(
             op, batch, lambda i, p: (outs[i], state_rows[i]),
             degraded, rpad=rpad, nb=nb)
+
+    def _run_ragged_batch(self, op: str, key, batch,
+                          budget_s: float | None) -> None:
+        """One batch of a RAGGED shape class (``VELES_SIMD_SERVE_RAGGED``
+        — stft today): variable-length requests co-pack along the
+        sample axis into shared rows (:mod:`veles.simd_tpu.ops.
+        segments`) instead of each zero-padding to its own pow2
+        bucket, so the dispatched-sample denominator shrinks to the
+        packed plan's footprint.  Fault policy lives INSIDE the packed
+        dispatch: ``segments.dispatch`` carries this replica's
+        shape-class breaker (``breaker_key`` — NOT ``serve.dispatch``,
+        the packed fallback is per-segment salvage rather than a
+        whole-batch oracle), and one poisoned segment degrades only
+        its own ticket.  The global health machine is still honored:
+        a DEGRADED server answers ragged batches from the per-segment
+        oracle too, and ragged probes feed the same trip/recover
+        edges."""
+        rows = len(batch)
+        params = batch[0].params
+        fl, hop = params["frame_length"], params["hop"]
+        traces = [p.ticket.trace for p in batch]
+        segs = [p.x for p in batch]
+        # the packed plan is deterministic — recompute it here for the
+        # goodput denominators (EXACT rows the plan needs times the
+        # common packed width: packing's whole point is a truthful
+        # dispatched footprint, so no pow2 row padding here)
+        strides = [_segments.stft_stride(p.n, hop) for p in batch]
+        width, packed_rows, _ = _segments.plan_pack(strides)
+        rpad = packed_rows
+        self._note_batch_formed(batch, rpad, rows_used=packed_rows)
+        probe = False
+        if self._health.degraded:
+            probe = self._health.note_degraded_batch()
+            if not probe:
+                obs.count("serve_degraded_batch", op=op)
+                for tr in traces:
+                    tr.event("dispatched", route="oracle",
+                             breaker="bypassed", health="degraded")
+                    tr.event("degraded", to="oracle",
+                             reason="health_degraded")
+                outs, _ = _segments.packed_stft(segs, fl, hop,
+                                                simd=False)
+                self._finish_batch(
+                    op, batch, lambda i, p: outs[i], True,
+                    rpad=rpad, nb="ragged", useful_rows=packed_rows,
+                    useful_samples=sum(p.n for p in batch),
+                    dispatched_samples=rpad * width)
+                return
+        for tr in traces:
+            tr.event("dispatched", route="ragged",
+                     breaker="segments", probe=probe)
+        with obs.span("serve.dispatch", op=op, rows=rpad, n=width,
+                      route="ragged"):
+            outs, flags = _segments.packed_stft(
+                segs, fl, hop, simd=True,
+                key=self.breaker_key(key), budget_s=budget_s,
+                on_fault=self._batch_fault_hook(traces))
+        if any(flags):
+            obs.count("serve_degraded_batch", op=op)
+        if probe:
+            # mirror _dispatch's probe outcome wiring so a ragged-only
+            # server still recovers (or re-trips) its health machine
+            if any(flags):
+                self._health.trip("serve.dispatch")
+            else:
+                self._health.recover("serve.dispatch")
+        self._finish_batch(
+            op, batch, lambda i, p: outs[i], flags,
+            rpad=rpad, nb="ragged", useful_rows=packed_rows,
+            useful_samples=sum(p.n for p in batch),
+            dispatched_samples=rpad * width)
 
     @staticmethod
     def _batch_fault_hook(traces):
@@ -1031,6 +1276,27 @@ class Server:
         front router's least-loaded placement signal."""
         return self._admission.depth()
 
+    def open_occupancy(self, key) -> int:
+        """Requests currently queued in shape class ``key``'s bucket
+        — the front router's padding-aware placement signal: a
+        replica with a forming batch of this class completes it (the
+        new request rides a padding slot), one without opens a fresh
+        batch that will pad.  Reads the batcher's per-class queue
+        depth; 0 when no batch of this class is forming."""
+        return self._batcher.depth_for(key)
+
+    def occupancy(self) -> int:
+        """Total rows queued in forming batches across every shape
+        class (the fleet collector's per-replica ``occupancy``
+        series)."""
+        return self._batcher.pending()
+
+    @property
+    def max_batch(self) -> int:
+        """The batcher's row-class ceiling (scales the router's
+        occupancy score term)."""
+        return self._batcher.max_batch
+
     def counts(self) -> dict:
         """Cheap copy of the raw request tallies (one lock, no
         registry walk) — the fleet collector's per-tick read; the
@@ -1049,12 +1315,23 @@ class Server:
             per = {
                 f"{op}|{nb}": {"useful_rows": u, "dispatched_rows": d,
                                "goodput": (u / d) if d else None}
-                for (op, nb), (u, d) in sorted(self._goodput.items())}
+                for (op, nb), (u, d) in sorted(
+                    self._goodput.items(), key=lambda kv: (
+                        kv[0][0], str(kv[0][1])))}
+            for (op, nb), (u, d) in self._goodput_samples.items():
+                entry = per.setdefault(f"{op}|{nb}", {})
+                entry["useful_samples"] = u
+                entry["dispatched_samples"] = d
+                entry["sample_goodput"] = (u / d) if d else None
             useful = self._stats["useful_rows"]
             dispatched = self._stats["dispatched_rows"]
+            su = self._stats["useful_samples"]
+            sd = self._stats["dispatched_samples"]
         per["overall"] = {
             "useful_rows": useful, "dispatched_rows": dispatched,
-            "goodput": (useful / dispatched) if dispatched else None}
+            "goodput": (useful / dispatched) if dispatched else None,
+            "useful_samples": su, "dispatched_samples": sd,
+            "sample_goodput": (su / sd) if sd else None}
         return per
 
     @property
