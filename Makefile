@@ -8,7 +8,8 @@ PYTHON ?= python
 .PHONY: all tests tests-quick benchmarks bench bench-regress \
         bench-multichip bench-serve bench-goodput serve-smoke \
         chaos-smoke chaos-replicas cshim cshim-check wavelet-tables \
-        lint docs obs-report obs-dash autotune-pack warm-pack \
+        lint docs obs-report obs-dash obs-query autotune-pack \
+        warm-pack \
         cold-start install install-hooks clean
 
 all: cshim
@@ -119,6 +120,15 @@ obs-report:
 # override with OBS_PORT=9100 or pass --url via tools/obs_dash.py
 obs-dash:
 	$(PYTHON) tools/obs_dash.py $(if $(OBS_PORT),--port $(OBS_PORT),)
+
+# offline postmortem queries over a durable journal pack (obs v6,
+# written by any process running with $VELES_SIMD_JOURNAL_DIR set):
+# merged fleet timeline, per-rid/replica/site/time filters, incident
+# postmortems, Chrome-trace export.  Override with
+# JOURNAL=path/to/pack and QUERY='--postmortem all' etc.
+JOURNAL ?= journal_pack
+obs-query:
+	$(PYTHON) tools/obs_query.py $(JOURNAL) $(QUERY)
 
 # build the pre-warmed autotune pack: measure every routed family's
 # candidates on THIS device and persist the winners so production

@@ -1,0 +1,405 @@
+"""History-axis tests (obs v6): the durable journal + incident engine.
+
+Contracts pinned here:
+
+* journal records are schema-stamped with both clocks, pid, and the
+  writer's replica identity, with the event payload isolated under
+  ``data`` (a lifecycle event's ``replica=`` subject never clobbers
+  the identity stamp), and the journal runs independently of the
+  telemetry enable flag;
+* a torn tail (replica killed mid-write) is counted, never fatal —
+  every parseable record is recovered;
+* segments rotate at the size bound and the writer prunes its own
+  oldest segments to hold the total-disk budget, never the current
+  segment and never another pid's files;
+* concurrent dispatch threads racing through the facade interleave
+  LINES, never bytes — every record parses, none are lost;
+* a subprocess replica inheriting the armed env journals to its own
+  per-pid file in the shared pack, and ``read_pack`` merges the fleet
+  timeline;
+* incident hysteresis: an alternating flap storm never opens; a
+  sustained storm opens exactly ONE incident; it closes only after
+  the full quiet period, and a re-fire resets the quiet counter;
+* the ``/signals`` / ``/debug/requests`` / ``/incidents`` bodies are
+  schema-stamped and carry the history-axis fields.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from veles.simd_tpu import obs, serve  # noqa: E402
+from veles.simd_tpu.obs import http as obs_http  # noqa: E402
+from veles.simd_tpu.obs import incidents as obs_incidents  # noqa: E402
+from veles.simd_tpu.obs import journal as obs_journal  # noqa: E402
+from veles.simd_tpu.obs import timeseries as obs_ts  # noqa: E402
+from veles.simd_tpu.ops import iir  # noqa: E402
+
+RNG = np.random.RandomState(11)
+SOS = iir.butterworth(4, 0.25, "lowpass")
+
+
+@pytest.fixture
+def pack(tmp_path, monkeypatch):
+    """A fresh armed journal pack, fully disarmed afterwards."""
+    monkeypatch.delenv(obs_journal.JOURNAL_DIR_ENV, raising=False)
+    obs_journal._reset_for_tests()
+    obs_incidents._reset_for_tests()
+    obs.configure(journal_dir=str(tmp_path))
+    yield str(tmp_path)
+    obs.configure(journal_dir="")
+    obs_journal._reset_for_tests()
+    obs_incidents._reset_for_tests()
+    obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# record schema / arming
+# ---------------------------------------------------------------------------
+
+class TestJournalRecords:
+    def test_stamped_with_payload_isolated(self, pack):
+        obs_journal.set_replica("writer-a")
+        obs.record_decision("replica_lifecycle", "kill", replica="r0")
+        records, skipped = obs_journal.read_pack(pack)
+        assert skipped == 0 and len(records) == 1
+        r = records[0]
+        assert r["schema"] == obs_journal.SCHEMA
+        assert r["kind"] == "decision"
+        assert r["op"] == "replica_lifecycle"
+        assert r["decision"] == "kill"
+        assert r["pid"] == os.getpid()
+        assert r["seq"] == 1
+        assert r["t_mono"] > 0 and r["t_wall"] > 0
+        # the event's subject lands under data; the writer identity
+        # stamp survives beside it
+        assert r["replica"] == "writer-a"
+        assert r["data"]["replica"] == "r0"
+
+    def test_journal_independent_of_telemetry_enable(self, pack):
+        obs.disable()
+        obs.record_decision("breaker_transition", "open",
+                            site="serve.dispatch")
+        records, _ = obs_journal.read_pack(pack)
+        assert [r["decision"] for r in records] == ["open"]
+
+    def test_disarmed_by_default(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(obs_journal.JOURNAL_DIR_ENV, raising=False)
+        obs_journal._reset_for_tests()
+        obs.configure(journal_dir="")
+        assert not obs_journal.armed()
+        assert obs_journal.emit_decision("x", "y", {}) is False
+        assert obs.journal_stats()["armed"] is False
+        assert obs.journal_cursor() is None
+
+    def test_env_arms(self, tmp_path, monkeypatch):
+        obs_journal._reset_for_tests()
+        obs.configure(journal_dir="")
+        monkeypatch.setenv(obs_journal.JOURNAL_DIR_ENV, str(tmp_path))
+        try:
+            assert obs_journal.armed()
+            obs_journal.emit("chaos_phase", {"phase": "baseline"})
+            records, _ = obs_journal.read_pack(str(tmp_path))
+            assert records[0]["kind"] == "chaos_phase"
+            assert records[0]["data"]["phase"] == "baseline"
+        finally:
+            monkeypatch.delenv(obs_journal.JOURNAL_DIR_ENV)
+            obs_journal._reset_for_tests()
+
+    def test_write_failure_is_counted_drop(self, tmp_path, monkeypatch):
+        blocker = tmp_path / "not-a-dir"
+        blocker.write_text("occupied")
+        obs_journal._reset_for_tests()
+        obs.configure(journal_dir=str(blocker))
+        try:
+            assert obs_journal.emit("decision", {}) is False
+            assert obs.journal_stats()["dropped"] == 1
+        finally:
+            obs.configure(journal_dir="")
+            obs_journal._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# torn tails / rotation / disk budget
+# ---------------------------------------------------------------------------
+
+class TestJournalDurability:
+    def test_torn_tail_counted_not_fatal(self, pack):
+        for i in range(5):
+            obs_journal.emit("decision", {"i": i})
+        current = os.path.join(pack, obs.journal_cursor()["file"])
+        with open(current, "ab") as f:
+            f.write(b'{"schema": "veles-simd-journal-v1", "tru')
+        records, skipped = obs_journal.read_file(current)
+        assert len(records) == 5
+        assert skipped == 1
+        assert [r["data"]["i"] for r in records] == list(range(5))
+
+    def test_rotation_under_total_disk_bound(self, tmp_path):
+        w = obs_journal.JournalWriter(str(tmp_path), max_bytes=512,
+                                      max_total_bytes=2048)
+        payload = {"filler": "x" * 64}
+        for _ in range(200):
+            assert w.append({"kind": "decision", "data": payload})
+        stats = w.stats()
+        assert stats["rotations"] > 0
+        assert stats["pruned"] > 0
+        assert stats["dropped"] == 0
+        own = [tmp_path / n for n in os.listdir(tmp_path)]
+        total = sum(p.stat().st_size for p in own)
+        # prune runs at rotation: between rotations the pack can
+        # overshoot by at most one segment
+        assert total <= 2048 + 512
+        # the current segment is never pruned
+        assert os.path.basename(w.current_file) in \
+            {p.name for p in own}
+        w.close()
+        # every surviving record still parses
+        records, skipped = obs_journal.read_pack(str(tmp_path))
+        assert skipped == 0 and len(records) > 0
+
+    def test_reconfigured_writer_never_clobbers_own_past(self, pack):
+        obs_journal.emit("decision", {"run": 1})
+        first = obs.journal_cursor()["segment"]
+        # disarm and re-arm the same pack: the fresh writer must
+        # continue PAST its old segment, not overwrite it
+        obs.configure(journal_dir="")
+        obs.configure(journal_dir=pack)
+        obs_journal.emit("decision", {"run": 2})
+        assert obs.journal_cursor()["segment"] > first
+        records, _ = obs_journal.read_pack(pack)
+        assert [r["data"]["run"] for r in records] == [1, 2]
+
+    def test_concurrent_writers_interleave_lines(self, pack):
+        threads, per = 8, 50
+        barrier = threading.Barrier(threads)
+
+        def race(tid):
+            barrier.wait()
+            for i in range(per):
+                obs_journal.emit_decision(
+                    "fault_policy", "retry",
+                    {"tid": tid, "i": i, "pad": "y" * 32})
+
+        ts = [threading.Thread(target=race, args=(t,))
+              for t in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        records, skipped = obs_journal.read_pack(pack)
+        assert skipped == 0
+        assert len(records) == threads * per
+        # per-process seq is a total order with no duplicates
+        seqs = sorted(r["seq"] for r in records)
+        assert seqs == list(range(1, threads * per + 1))
+
+    def test_subprocess_replica_journals_own_file(self, pack):
+        obs_journal.set_replica("router")
+        obs_journal.emit_decision("replica_lifecycle", "kill",
+                                  {"replica": "r9"})
+        child = (
+            "from veles.simd_tpu.obs import journal\n"
+            "journal.set_replica('child-r9')\n"
+            "journal.emit_decision('serve_lifecycle', 'start',"
+            " {'workers': 1})\n"
+            "journal.emit_decision('serve_lifecycle', 'stop',"
+            " {'drain': True})\n"
+        )
+        env = dict(os.environ)
+        env[obs_journal.JOURNAL_DIR_ENV] = pack
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        subprocess.run([sys.executable, "-c", child], check=True,
+                       env=env, cwd=str(REPO), timeout=120)
+        files = obs_journal.discover(pack)
+        pids = {int(os.path.basename(f).split("-")[1]) for f in files}
+        assert os.getpid() in pids and len(pids) == 2
+        records, skipped = obs_journal.read_pack(pack)
+        assert skipped == 0
+        by_replica = {r["replica"] for r in records}
+        assert by_replica == {"router", "child-r9"}
+        child_ops = [r["op"] for r in records
+                     if r["replica"] == "child-r9"]
+        assert child_ops == ["serve_lifecycle", "serve_lifecycle"]
+
+
+# ---------------------------------------------------------------------------
+# incident hysteresis
+# ---------------------------------------------------------------------------
+
+class _FakeSignals:
+    """Duck-typed FleetSignals: only what the rules read."""
+
+    def __init__(self, at_s, flaps=0, health="healthy"):
+        self.at_s = at_s
+        self.slo_burn = {}
+        self.slo_burn_velocity = {}
+        self.breaker_flaps = {"r0": flaps}
+        self.breaker_open = {}
+        self.goodput_overall = 1.0
+        self.health = {"r0": health}
+        self.queue_depth_total = 0.0
+
+
+class TestIncidentHysteresis:
+    def _engine(self):
+        return obs_incidents.IncidentEngine(open_ticks=2,
+                                            close_ticks=5, flaps=4)
+
+    def test_alternating_flaps_never_open(self):
+        eng = self._engine()
+        for t in range(20):
+            eng.tick(_FakeSignals(float(t), flaps=8 if t % 2 else 0))
+        assert eng.open_incidents() == []
+        assert eng.incidents() == []
+
+    def test_storm_opens_exactly_one(self, pack):
+        eng = self._engine()
+        for t in range(10):
+            eng.tick(_FakeSignals(float(t), flaps=9))
+        open_now = eng.open_incidents()
+        assert len(open_now) == 1
+        inc = open_now[0]
+        assert inc.rule == "breaker_flap"
+        assert inc.state == "open"
+        assert inc.trigger["replicas"] == {"r0": 9}
+        # the open tick was the SECOND firing tick, and the storm
+        # kept riding the one incident instead of minting more
+        assert inc.ticks_firing == 9
+        # the open edge snapshotted where the journal was
+        assert inc.journal_cursor is None or \
+            "file" in inc.journal_cursor
+
+    def test_close_only_after_full_quiet_period(self):
+        eng = self._engine()
+        t = 0
+        for _ in range(3):
+            eng.tick(_FakeSignals(float(t), flaps=9))
+            t += 1
+        for _ in range(4):      # one short of close_ticks
+            eng.tick(_FakeSignals(float(t), flaps=0))
+            t += 1
+        assert len(eng.open_incidents()) == 1
+        eng.tick(_FakeSignals(float(t), flaps=0))
+        assert eng.open_incidents() == []
+        closed = eng.incidents()
+        assert len(closed) == 1
+        assert closed[0].state == "closed"
+        assert closed[0].close_reason == "quiet_period"
+
+    def test_refire_resets_quiet_counter(self):
+        eng = self._engine()
+        t = 0
+        for _ in range(2):
+            eng.tick(_FakeSignals(float(t), flaps=9))
+            t += 1
+        for _ in range(4):
+            eng.tick(_FakeSignals(float(t), flaps=0))
+            t += 1
+        # a single re-fire mid-quiet: the quiet clock starts over
+        eng.tick(_FakeSignals(float(t), flaps=9))
+        t += 1
+        for _ in range(4):
+            eng.tick(_FakeSignals(float(t), flaps=0))
+            t += 1
+        assert len(eng.open_incidents()) == 1
+        eng.tick(_FakeSignals(float(t), flaps=0))
+        assert eng.open_incidents() == []
+
+    def test_edges_journaled_durably(self, pack):
+        eng = self._engine()
+        t = 0
+        for _ in range(3):
+            eng.tick(_FakeSignals(float(t), health="down"))
+            t += 1
+        for _ in range(5):
+            eng.tick(_FakeSignals(float(t), health="healthy"))
+            t += 1
+        records, _ = obs_journal.read_pack(pack)
+        edges = [(r["decision"], r["data"]["rule"]) for r in records
+                 if r["op"] == "incident"]
+        assert ("open", "replica_down") in edges
+        assert ("close", "replica_down") in edges
+        opens = [r for r in records if r["op"] == "incident"
+                 and r["decision"] == "open"]
+        closes = [r for r in records if r["op"] == "incident"
+                  and r["decision"] == "close"]
+        assert opens[0]["data"]["id"] == closes[0]["data"]["id"]
+        assert closes[0]["data"]["reason"] == "quiet_period"
+
+
+# ---------------------------------------------------------------------------
+# schema stamps / the /incidents route / signals fields
+# ---------------------------------------------------------------------------
+
+def _get(url, timeout=10.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class TestHistorySurfaces:
+    def test_signals_carry_incidents_and_journal(self, pack):
+        obs.enable()
+        obs_journal.emit("decision", {"seed": True})
+        sig = obs.signals()
+        assert sig.incidents == []
+        assert sig.journal["armed"] is True
+        assert sig.journal["records"] >= 1
+        body = sig.to_dict()
+        assert body["schema"] == obs_ts.SIGNALS_SCHEMA
+        assert "incidents" in body and "journal" in body
+
+    def test_snapshot_carries_history_keys(self, pack):
+        obs.enable()
+        snap = obs.snapshot()
+        assert snap["journal"]["armed"] is True
+        assert snap["incidents"]["schema"] == obs_incidents.SCHEMA
+
+    def test_routes_schema_stamped(self, pack):
+        obs.enable()
+        with serve.Server(max_batch=2, max_wait_ms=1.0, workers=1,
+                          obs_port=0) as srv:
+            srv.submit(serve.Request(
+                "sosfilt", RNG.randn(500).astype(np.float64),
+                {"sos": SOS})).result(timeout=60.0)
+            base = f"http://127.0.0.1:{srv.obs_port}"
+            code, body = _get(base + "/signals")
+            assert code == 200
+            assert json.loads(body)["schema"] == obs_ts.SIGNALS_SCHEMA
+            code, body = _get(base + "/debug/requests")
+            assert code == 200
+            assert json.loads(body)["schema"] == \
+                obs_http.REQUESTS_SCHEMA
+            code, body = _get(base + "/incidents")
+            assert code == 200
+            inc = json.loads(body)
+            assert inc["schema"] == obs_incidents.SCHEMA
+            assert inc["open"] == 0 and inc["incidents"] == []
+        obs.disable()
+
+    def test_flight_bundle_embeds_journal_tail(self, pack,
+                                               tmp_path_factory):
+        from veles.simd_tpu.obs import flightrec
+        obs.enable()
+        obs_journal.emit_decision("serve_health", "degraded",
+                                  {"site": "serve.dispatch"})
+        bundle = flightrec.build_bundle("test", None)
+        info = bundle["journal"]
+        assert info["cursor"]["records"] >= 1
+        assert info["tail"][-1]["op"] == "serve_health"
+        assert info["stats"]["armed"] is True
+        obs.disable()

@@ -1558,3 +1558,125 @@ def test_real_segments_module_passes_segment_rule():
     errs = lint.segment_dispatch_errors(
         ast.parse(src), "veles/simd_tpu/ops/segments.py")
     assert errs == [], errs
+
+
+# ---------------------------------------------------------------------------
+# the journal funnel rule (obs v6): serve/runtime/pipeline code never
+# opens journal files raw or mints its own JournalWriter — the
+# obs.journal facade owns line-atomicity, rotation, and the disk
+# budget
+# ---------------------------------------------------------------------------
+
+JOURNAL_GOOD = '''
+import os
+
+from veles.simd_tpu import obs
+from veles.simd_tpu.obs import journal as obs_journal
+
+
+def emit(op, decision):
+    # the funnel: record_decision is journal-tapped when armed
+    obs.record_decision(op, decision, site="serve.dispatch")
+
+
+def where():
+    # reading the facade's state is legal; only raw writes are not
+    return obs_journal.journal_dir(), obs.journal_cursor()
+
+
+def unrelated(path):
+    # plain file IO on non-journal paths stays untouched
+    with open(path) as f:
+        return f.read()
+'''
+
+JOURNAL_RAW_OPEN_ENV = '''
+import json
+import os
+
+
+def sneak_append(record):
+    d = os.environ.get("VELES_SIMD_JOURNAL_DIR")
+    path = os.path.join(d, "journal-0-000000.jsonl")
+    with open(path, "ab") as f:
+        f.write(json.dumps(record).encode())
+'''
+
+JOURNAL_RAW_OPEN_ALIAS = '''
+import os
+
+from veles.simd_tpu.obs import journal as obs_journal
+
+
+def peek():
+    pack = obs_journal.journal_dir()
+    target = os.path.join(pack, "latest")
+    return open(target, "rb").read()
+'''
+
+JOURNAL_WRITER_MINT = '''
+from veles.simd_tpu.obs import journal as obs_journal
+
+
+def second_writer(tmp):
+    return obs_journal.JournalWriter(tmp)
+'''
+
+JOURNAL_WRITER_MINT_IMPORTED = '''
+from veles.simd_tpu.obs.journal import JournalWriter as JW
+
+
+def second_writer(tmp):
+    return JW(tmp)
+'''
+
+JOURNAL_LITERAL_PATH = '''
+import io
+
+
+def tail(n):
+    return io.open("/var/run/journal-12-000003.jsonl", "rb").read()
+'''
+
+
+def _journal_errs(src):
+    return lint.journal_funnel_errors(ast.parse(src), "mod.py")
+
+
+def test_journal_rule_passes_funnelled_module():
+    assert _journal_errs(JOURNAL_GOOD) == []
+
+
+def test_journal_rule_flags_env_derived_open():
+    errs = _journal_errs(JOURNAL_RAW_OPEN_ENV)
+    assert len(errs) == 1
+    assert "obs" in errs[0] and "journal" in errs[0]
+
+
+def test_journal_rule_tracks_alias_taint():
+    # pack = journal_dir(); target = join(pack, ...); open(target)
+    # — taint propagated through both assignments
+    errs = _journal_errs(JOURNAL_RAW_OPEN_ALIAS)
+    assert len(errs) == 1
+    assert "raw open()" in errs[0]
+
+
+def test_journal_rule_flags_writer_mint():
+    for src in (JOURNAL_WRITER_MINT, JOURNAL_WRITER_MINT_IMPORTED):
+        errs = _journal_errs(src)
+        assert len(errs) == 1, src
+        assert "JournalWriter" in errs[0]
+
+
+def test_journal_rule_flags_literal_journal_path():
+    errs = _journal_errs(JOURNAL_LITERAL_PATH)
+    assert len(errs) == 1
+
+
+def test_real_modules_pass_journal_rule():
+    for pkg in ("serve", "runtime", "pipeline"):
+        pkg_dir = REPO / "veles" / "simd_tpu" / pkg
+        for f in sorted(pkg_dir.glob("*.py")):
+            tree = ast.parse(f.read_text(), str(f))
+            assert lint.journal_funnel_errors(tree, str(f)) == [], \
+                f.name

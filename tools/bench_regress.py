@@ -158,6 +158,10 @@ DEFAULT_NOISE = [
     # A/B throughput ratio near 1.0, measured while the fleet
     # collector sweeps in the background — same 5% budget
     ("fleet tracing overhead", 0.05),
+    # the history-axis twin (obs v6): armed/disarmed throughput
+    # ratio with the durable event journal toggled — appending every
+    # decision to disk must also stay under the 5% budget
+    ("journal overhead", 0.05),
     # the goodput-at-saturation family (tools/loadgen.py --saturation,
     # GOODPUT_DETAILS.json): "goodput saturation" is the after-side
     # useful/dispatched SAMPLE ratio — near-deterministic for a fixed

@@ -68,6 +68,7 @@ import argparse
 import json
 import os
 import sys
+import tempfile
 import threading
 import time
 
@@ -77,8 +78,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 sys.path.insert(0, os.path.dirname(__file__))
 
 import loadgen  # noqa: E402
+import obs_query  # noqa: E402
 from veles.simd_tpu import obs  # noqa: E402
 from veles.simd_tpu import serve  # noqa: E402
+from veles.simd_tpu.obs import incidents as obs_incidents  # noqa: E402
+from veles.simd_tpu.obs import journal as obs_journal  # noqa: E402
 from veles.simd_tpu.runtime import breaker, faults  # noqa: E402
 
 MESH_AXIS = "sp"
@@ -509,11 +513,20 @@ def run_replica_campaign(args) -> tuple:
     batching refilling freed row slots and ragged packing co-packing
     the mix's short stft requests — the chaos gate for both features
     (the mix's stft lengths sit under the ragged cap, so the packed
-    dispatch path really runs)."""
+    dispatch path really runs).  The history axis (obs v6) is armed
+    alongside: the whole campaign journals to a fresh pack directory
+    and ticks the incident engine on a tight cadence, so the body can
+    gate postmortem reconstruction purely from the on-disk journal
+    after the replicas are gone."""
     from veles.simd_tpu.serve import server as serve_server
 
+    journal_pack = tempfile.mkdtemp(prefix="veles-chaos-journal-")
     armed = {serve_server.CONTINUOUS_ENV: "1",
-             serve_server.RAGGED_ENV: "1"}
+             serve_server.RAGGED_ENV: "1",
+             obs_journal.JOURNAL_DIR_ENV: journal_pack,
+             # fast incident cadence so open (2 firing ticks) and
+             # close (5 quiet ticks) both land inside a smoke run
+             obs_incidents.TICK_MS_ENV: "50"}
     prior = {k: os.environ.get(k) for k in armed}
 
     def _restore():
@@ -525,12 +538,13 @@ def run_replica_campaign(args) -> tuple:
 
     os.environ.update(armed)
     try:
-        return _replica_campaign_body(args, _restore)
+        return _replica_campaign_body(args, _restore, journal_pack)
     finally:
         _restore()
 
 
-def _replica_campaign_body(args, restore_features=lambda: None) -> tuple:
+def _replica_campaign_body(args, restore_features=lambda: None,
+                           journal_pack=None) -> tuple:
     """The 3-phase replica-kill campaign over a 3-replica group behind
     the front router: (1) kill one replica abruptly — no drain —
     MID-TRAFFIC (its queued work must fail over, deadlines carried);
@@ -687,6 +701,35 @@ def _replica_campaign_body(args, restore_features=lambda: None) -> tuple:
         lat_restart = time.perf_counter() - t0
         restart_status = restart_ticket.status
 
+        # -- history axis (obs v6): breaker cycle + incident close --
+        # one deterministic breaker cycle through the REAL Breaker
+        # event seam (open -> half_open -> closed) so the journal
+        # pack holds a complete breaker story to reconstruct — the
+        # replica mix is healthy traffic, so no breaker trips
+        # naturally in this campaign
+        jbr = breaker.Breaker("serve.chaos", key="journal_cycle",
+                              window=4, threshold=0.5, min_events=2,
+                              probe_every=1)
+        jbr.failure()
+        jbr.failure()           # failure_rate -> open
+        jbr.admit()             # probe cadence -> half_open
+        jbr.success()           # probe_success -> closed
+        # revive the drained replica too: with the whole fleet
+        # healthy again the replica_down incident the kill opened can
+        # CLOSE through the engine's quiet-period hysteresis while
+        # the journal is still armed
+        group.restart("r1")
+        incident_deadline = faults.monotonic() + 30.0
+        incident_closed_live = False
+        while faults.monotonic() < incident_deadline:
+            isnap = obs.incidents_snapshot()
+            if any(i["rule"] == "replica_down"
+                   and i["state"] == "closed"
+                   for i in isnap.get("incidents", ())):
+                incident_closed_live = True
+                break
+            threading.Event().wait(0.05)
+
         # -- fleet tracing overhead (collector armed) ---------------
         # the <5% request-axis overhead budget, re-measured while the
         # fleet collector sweeps the (still-started) group in the
@@ -708,6 +751,13 @@ def _replica_campaign_body(args, restore_features=lambda: None) -> tuple:
         fleet_overhead["metric"] = "fleet tracing overhead"
         fleet_overhead.setdefault("telemetry", {})[
             "collector_armed"] = True
+        # -- journal-armed overhead (obs v6) ------------------------
+        # same A/B interleave, toggling the durable journal instead
+        # of the request axis: appending every decision to disk must
+        # not buy history with request latency (loose in-campaign
+        # floor here; the tight 5% gate is bench_regress's, via the
+        # "journal overhead" noise entry)
+        journal_overhead = loadgen.journal_overhead_row(ov_args, rng)
 
     total = _merge_router([warm, rep_kill, rep_drain])
     answered = total["ok"] + total["degraded"]
@@ -720,6 +770,26 @@ def _replica_campaign_body(args, restore_features=lambda: None) -> tuple:
     lifecycle = [
         (e["decision"], e.get("replica"))
         for e in _decisions("replica_lifecycle")]
+    # -- postmortem reconstruction (obs v6) -------------------------
+    # the group is stopped and (in subprocess mode) its replicas are
+    # DEAD — everything below must come back from the on-disk journal
+    # pack ALONE, through the same reader tools/obs_query.py uses.
+    # In-memory obs state is deliberately not consulted.
+    j_records, j_skipped = obs_journal.read_pack(journal_pack) \
+        if journal_pack else ([], 0)
+    j_files = [os.path.basename(p)
+               for p in obs_journal.discover(journal_pack)] \
+        if journal_pack else []
+    j_decisions = [r for r in j_records if r.get("kind") == "decision"]
+    j_lifecycle = [
+        (r.get("decision"), (r.get("data") or {}).get("replica"))
+        for r in j_decisions if r.get("op") == "replica_lifecycle"]
+    j_breaker_edges = [
+        r.get("decision") for r in j_decisions
+        if r.get("op") == "breaker_transition"]
+    j_incidents = obs_query.incidents_from(j_records)
+    j_replica_down = [i for i in j_incidents
+                      if i["rule"] == "replica_down"]
     # the restart budget: the revived replica's first request must
     # land within a generous multiple of the survivor's single-request
     # latency (plus an absolute floor for host-scheduling jitter —
@@ -838,6 +908,41 @@ def _replica_campaign_body(args, restore_features=lambda: None) -> tuple:
         "fleet_tracing_overhead_ok": (
             fleet_overhead["value"] is not None
             and fleet_overhead["value"] >= 0.80),
+        # -- history axis (obs v6) ------------------------------
+        # every parseable journal line recovered, no torn lines in
+        # a cleanly-flushed pack, and at least one file per writer
+        "journal_pack_readable": (
+            len(j_files) >= 1 and j_skipped == 0
+            and len(j_records) >= 1),
+        # the kill/drain/restart story reconstructed purely from
+        # disk — including BOTH revivals — matching what the live
+        # decision log saw
+        "journal_lifecycle_recovered": (
+            ("kill", "r0") in j_lifecycle
+            and ("drain", "r1") in j_lifecycle
+            and ("dead", "r1") in j_lifecycle
+            and ("restart", "r0") in j_lifecycle
+            and ("restart", "r1") in j_lifecycle),
+        # the scripted breaker cycle came back whole from disk:
+        # open, half_open and re-closed edges all journaled
+        "journal_breaker_cycle_recovered": (
+            {"open", "half_open", "closed"}
+            <= set(j_breaker_edges)),
+        # the kill window's replica_down incident was OPENED by the
+        # engine's hysteresis and CLOSED after the revived fleet's
+        # quiet period — both edges reconstructed from disk alone
+        "journal_incident_reconstructed": any(
+            i["open"] is not None and i["close"] is not None
+            for i in j_replica_down),
+        # the same closure was visible live through /incidents
+        # before the group stopped (diagnosis aid: separates an
+        # engine problem from a journaling problem)
+        "incident_closed_live": incident_closed_live,
+        # journaling every decision stays affordable (loose floor;
+        # the 5% gate is bench_regress's "journal overhead" entry)
+        "journal_overhead_ok": (
+            journal_overhead["value"] is not None
+            and journal_overhead["value"] >= 0.80),
     }
 
     rows = [
@@ -895,6 +1000,7 @@ def _replica_campaign_body(args, restore_features=lambda: None) -> tuple:
             "telemetry": {"useful_rows": useful_rows,
                           "dispatched_rows": dispatched_rows}})
     rows.append(fleet_overhead)
+    rows.append(journal_overhead)
     evidence = {
         "replica_invariants": invariants,
         "restart": {"first_request_s": lat_restart,
@@ -921,6 +1027,19 @@ def _replica_campaign_body(args, restore_features=lambda: None) -> tuple:
             "kill_visible_lag_ticks": fleet_lag_ticks,
             "goodput": campaign_goodput,
             "stitched_trace": stitch_meta,
+        },
+        "journal": {
+            "pack": journal_pack,
+            "files": j_files,
+            "records": len(j_records),
+            "skipped": j_skipped,
+            "lifecycle": j_lifecycle,
+            "breaker_edges": j_breaker_edges,
+            "incidents": [
+                {"id": i["id"], "rule": i["rule"],
+                 "opened": i["open"] is not None,
+                 "closed": i["close"] is not None}
+                for i in j_incidents],
         },
     }
     return invariants, rows, evidence

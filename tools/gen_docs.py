@@ -72,10 +72,15 @@ MODULES = [
     "veles.simd_tpu.obs.timeseries",
     "veles.simd_tpu.obs.http",
     "veles.simd_tpu.obs.flightrec",
+    "veles.simd_tpu.obs.journal",
+    "veles.simd_tpu.obs.incidents",
     "veles.simd_tpu.cshim",
     # the chaos-campaign runner is a tool, not a library module, but
     # its phase script and invariant gate are user-facing API surface
     "tools.chaos",
+    # likewise the offline journal-pack query tool: its filter and
+    # postmortem functions are the history axis's read-side API
+    "tools.obs_query",
 ]
 
 

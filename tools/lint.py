@@ -1004,6 +1004,143 @@ def fleet_funnel_errors(tree, fname) -> list:
     return errors
 
 
+# --- journal funnel rule (obs v6) -------------------------------------------
+# The durable event journal has ONE writer implementation
+# (``obs/journal.py`` behind the ``obs`` facade): it owns line-atomic
+# appends, size-bounded rotation, the total-disk budget, and the
+# counted-not-fatal drop discipline.  A serve/runtime/pipeline module
+# that opens a journal file directly — a raw ``open()`` on a path
+# derived from ``$VELES_SIMD_JOURNAL_DIR`` / ``journal.journal_dir()``,
+# a literal ``journal-*.jsonl`` path, or a hand-minted
+# ``journal.JournalWriter`` — forks the history: two writers interleave
+# torn lines, double-count the disk budget, and rotate out each
+# other's segments.  So in serve/, runtime/ and pipeline/ these are
+# lint failures (alias-tracked, taint propagated through local
+# assignments):
+#
+# * ``open()`` / ``io.open`` / ``os.open`` / ``os.fdopen`` / a
+#   ``.open(...)`` method call whose path argument (or receiver) is
+#   journal-derived;
+# * constructing ``journal.JournalWriter(...)`` (or the name imported
+#   from ``veles.simd_tpu.obs.journal``) outside obs/ itself.
+#
+# History flows through ``obs.record_decision`` (journal-tapped) and
+# the module facade (``obs.journal_*`` / ``obs.configure``); reading a
+# pack back goes through ``journal.read_pack`` / ``tools/obs_query.py``.
+
+_JOURNAL_MOD = "veles.simd_tpu.obs.journal"
+_JOURNAL_DIR_ENV = "VELES_SIMD_JOURNAL_DIR"
+_RUNTIME_RULE_DIR = "veles/simd_tpu/runtime"
+_OPEN_CHAINS = {"io.open", "os.open", "os.fdopen"}
+
+
+def _journal_aliases(tree) -> tuple:
+    """``(journal_module_names, journal_dir_fn_names, writer_names)``
+    — names this module binds to the journal module, its
+    ``journal_dir`` accessor, and the ``JournalWriter`` class."""
+    mod_names, dir_fns, writer_names = set(), set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "veles.simd_tpu.obs":
+                for a in node.names:
+                    if a.name == "journal":
+                        mod_names.add(a.asname or a.name)
+            elif node.module == _JOURNAL_MOD:
+                for a in node.names:
+                    if a.name == "journal_dir":
+                        dir_fns.add(a.asname or a.name)
+                    elif a.name == "JournalWriter":
+                        writer_names.add(a.asname or a.name)
+    return mod_names, dir_fns, writer_names
+
+
+def journal_funnel_errors(tree, fname) -> list:
+    """The rule body on a parsed module (separated so tests can feed
+    synthetic sources).  Returns human-readable error strings."""
+    errors = []
+    mod_names, dir_fns, writer_names = _journal_aliases(tree)
+    tainted: set = set()
+
+    def _derived(node) -> bool:
+        """Does this expression reach journal-path state?"""
+        for w in ast.walk(node):
+            if isinstance(w, ast.Name) and w.id in tainted:
+                return True
+            if isinstance(w, ast.Constant) and isinstance(w.value, str):
+                low = w.value.lower()
+                if _JOURNAL_DIR_ENV in w.value or \
+                        ("journal" in low and ".jsonl" in low):
+                    return True
+            if isinstance(w, ast.Call):
+                f = w.func
+                if isinstance(f, ast.Name) and f.id in dir_fns:
+                    return True
+                if isinstance(f, ast.Attribute) \
+                        and f.attr == "journal_dir" \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id in mod_names:
+                    return True
+        return False
+
+    # taint propagation through straight-line assignments: a fixpoint
+    # over the module's Assign targets (``d = journal.journal_dir();
+    # p = os.path.join(d, name); open(p)`` is still an error)
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not _derived(node.value):
+                continue
+            for tgt in node.targets:
+                for t in ast.walk(tgt):
+                    if isinstance(t, ast.Name) \
+                            and t.id not in tainted:
+                        tainted.add(t.id)
+                        changed = True
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in writer_names:
+            errors.append(
+                f"{fname}:{node.lineno}: JournalWriter minted outside "
+                "the obs.journal facade — one process gets ONE "
+                "journal writer (it owns rotation, the disk budget, "
+                "and line-atomicity); arm it via obs.configure("
+                "journal_dir=...) / $VELES_SIMD_JOURNAL_DIR")
+            continue
+        if isinstance(f, ast.Attribute) and f.attr == "JournalWriter" \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id in mod_names:
+            errors.append(
+                f"{fname}:{node.lineno}: JournalWriter minted outside "
+                "the obs.journal facade — one process gets ONE "
+                "journal writer (it owns rotation, the disk budget, "
+                "and line-atomicity); arm it via obs.configure("
+                "journal_dir=...) / $VELES_SIMD_JOURNAL_DIR")
+            continue
+        is_open = (isinstance(f, ast.Name) and f.id == "open") \
+            or (_dotted_chain(f) in _OPEN_CHAINS) \
+            or (isinstance(f, ast.Attribute) and f.attr == "open")
+        if not is_open:
+            continue
+        receiver_derived = isinstance(f, ast.Attribute) \
+            and _derived(f.value)
+        if receiver_derived or any(_derived(a) for a in node.args) \
+                or any(_derived(kw.value) for kw in node.keywords):
+            errors.append(
+                f"{fname}:{node.lineno}: raw open() on a journal "
+                "path — journal writes funnel through the obs."
+                "journal facade (obs.record_decision is journal-"
+                "tapped; the writer owns line-atomic appends, "
+                "rotation, and the total-disk budget), and reads go "
+                "through journal.read_pack / tools/obs_query.py")
+    return errors
+
+
 # --- sharded-dispatch rule (parallel/ops.py) --------------------------------
 # PR 10 wrapped every instrumented shard_map dispatch in parallel/ops.py
 # in the fault policy (faults.guarded thunks with a single-chip degrade
@@ -1445,8 +1582,9 @@ def compute_module_lint(files) -> int:
             continue
         in_serve = rel.startswith(_SERVE_RULE_DIR)
         in_pipeline = rel.startswith(_PIPELINE_RULE_DIR)
+        in_runtime = rel.startswith(_RUNTIME_RULE_DIR)
         if not rel.startswith(_OBS_RULE_DIRS) and not in_serve \
-                and not in_pipeline:
+                and not in_pipeline and not in_runtime:
             continue
         try:
             tree = ast.parse(f.read_text(), str(f))
@@ -1455,6 +1593,17 @@ def compute_module_lint(files) -> int:
             # crashing the whole lint run with a raw traceback
             print(f"{f}:{e.lineno}: syntax error: {e.msg}")
             failures += 1
+            continue
+        if in_serve or in_pipeline or in_runtime:
+            # history writes funnel through the obs.journal facade in
+            # every layer that emits decision events (obs v6)
+            for msg in journal_funnel_errors(tree, str(f)):
+                print(msg)
+                failures += 1
+        if in_runtime and not in_serve and not in_pipeline:
+            # runtime/ modules take ONLY the journal-funnel rule —
+            # the fault/breaker machinery has its own telemetry idiom
+            # the compute-module rules were never written against
             continue
         if in_serve:
             # the serving layer has its own structural contract (and

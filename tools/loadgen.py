@@ -56,7 +56,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -851,6 +853,87 @@ def overhead_row(args, rng) -> dict:
             }}
 
 
+def journal_overhead_row(args, rng) -> dict:
+    """The journal-overhead bench row (obs v6): the same fine A/B
+    interleave as :func:`overhead_row` — one warmed shape class at
+    ``max_batch=1``, telemetry AND the request axis armed on both
+    sides — but the toggled variable is the durable event journal
+    (``obs.configure(journal_dir=...)`` to a throwaway pack vs
+    disarmed).  Healthy steady-state traffic emits no decision events
+    (the journal is an EVENT journal, not a request log), so each
+    timed burst also drives one ``obs.record_decision`` per request
+    through the real funnel — the worst-case event rate the history
+    axis budgets (a breaker/fault/lifecycle edge for every request).
+    The armed side pays the full obs-v6 cost for each: stamping, JSON
+    encoding, the locked line-atomic append + flush.  Value = pooled
+    armed/disarmed throughput (1.0 = history is free);
+    ``bench_regress`` gates the row at 5% noise via its "journal
+    overhead" entry — the same contract as the tracing-overhead
+    row."""
+    n = int(args.overhead_requests)
+    bursts = 10
+    m = max(10, n // (bursts // 2))
+    wall = {True: 0.0, False: 0.0}
+    done = {True: 0, False: 0}
+    pack = tempfile.mkdtemp(prefix="veles-journal-ab-")
+    journal_stats = None
+
+    def _burst(mode):
+        t0 = time.perf_counter()
+        rep = run_load(srv, _overhead_schedule(m, rng), verify=0)
+        for i in range(m):
+            obs.record_decision("journal_probe", "tick", seq=i)
+        wall[mode] += time.perf_counter() - t0
+        done[mode] += rep["ok"] + rep["degraded"]
+
+    try:
+        obs.enable()
+        srv = serve.Server(max_batch=1, max_wait_ms=0.5,
+                           workers=args.workers,
+                           queue_depth=max(1024, m),
+                           tenant_depth=max(1024, m), obs_port=0)
+        with srv:
+            # warm both modes (handle compile, first segment open)
+            for warm in (False, True):
+                obs.configure(journal_dir=pack if warm else "")
+                _burst(warm)
+            wall = {True: 0.0, False: 0.0}
+            done = {True: 0, False: 0}
+            import gc
+            gc.collect()
+            gc.disable()       # same collector fence as overhead_row
+            try:
+                for k in range(bursts):
+                    armed = bool(k % 2)
+                    obs.configure(journal_dir=pack if armed else "")
+                    _burst(armed)
+            finally:
+                gc.enable()
+            journal_stats = obs.journal_stats()
+    finally:
+        obs.configure(journal_dir="")
+        shutil.rmtree(pack, ignore_errors=True)
+    rates = {mode: (done[mode] / wall[mode] if wall[mode] else None)
+             for mode in (True, False)}
+    ratio = (rates[True] / rates[False]
+             if rates[True] and rates[False] else None)
+    telemetry = {
+        "armed_rps": (round(rates[True], 1)
+                      if rates[True] else None),
+        "disarmed_rps": (round(rates[False], 1)
+                         if rates[False] else None),
+        "bursts": bursts, "burst_requests": m,
+    }
+    if journal_stats:
+        telemetry["journal_records"] = journal_stats.get("records")
+        telemetry["journal_dropped"] = journal_stats.get("dropped")
+    return {"metric": "journal overhead",
+            "value": round(ratio, 4) if ratio is not None else None,
+            "unit": "armed/disarmed throughput",
+            "vs_baseline": None,
+            "telemetry": telemetry}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--requests", type=int, default=300)
@@ -998,6 +1081,7 @@ def main(argv=None) -> int:
         rows = bench_rows(report)
         if args.overhead_requests > 0:
             rows.append(overhead_row(args, rng))
+            rows.append(journal_overhead_row(args, rng))
     print(json.dumps(report, indent=2, default=str))
     if args.details:
         with open(args.details, "w") as f:

@@ -245,6 +245,26 @@ def render_fleet(base_url: str) -> tuple:
                 else "  (padding waste %.1f%%)" % (100 * waste)))
         for key in sorted(good):
             lines.append("  %-40s %8.4f" % (key, good[key]))
+    # -- the history axis (obs v6): open incidents + journal health --
+    incidents = sig.get("incidents") or []
+    if incidents:
+        lines.append("open incidents:")
+        for inc in incidents:
+            lines.append(
+                "  %-16s %-20s firing=%-4s opened %s" % (
+                    inc.get("id"), inc.get("rule"),
+                    inc.get("ticks_firing"),
+                    _fmt_s(inc.get("opened_t_mono"))))
+    journal = sig.get("journal") or {}
+    if journal.get("armed"):
+        lines.append(
+            "journal: armed  records=%-8s dropped=%-4s "
+            "rotations=%-3s lag=%s" % (
+                journal.get("records"), journal.get("dropped"),
+                journal.get("rotations"),
+                _fmt_s(journal.get("lag_s"))))
+    else:
+        lines.append("journal: disarmed")
     series = sig.get("series") or {}
     if series:
         lines.append("series (last-N window):")

@@ -182,6 +182,46 @@ def _fleet_section(snap) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _history_section(snap) -> str:
+    """The history axis (obs v6): durable-journal health and the
+    incident ledger.  Rendered whenever the snapshot carries a
+    ``journal`` or ``incidents`` block (``obs.snapshot()`` embeds
+    both; pre-v6 snapshots simply lack the keys)."""
+    journal = snap.get("journal")
+    incidents = snap.get("incidents")
+    if not isinstance(journal, dict) \
+            and not isinstance(incidents, dict):
+        return ""
+    lines = ["", "history (obs v6):"]
+    if isinstance(journal, dict):
+        if journal.get("armed"):
+            lines.append(
+                "  journal armed @ %s" % journal.get("dir"))
+            lines.append(
+                "    records=%s dropped=%s rotations=%s pruned=%s "
+                "lag_s=%s" % (
+                    journal.get("records"), journal.get("dropped"),
+                    journal.get("rotations"), journal.get("pruned"),
+                    round(journal["lag_s"], 3)
+                    if isinstance(journal.get("lag_s"), float)
+                    else journal.get("lag_s")))
+        else:
+            lines.append("  journal disarmed "
+                         "($VELES_SIMD_JOURNAL_DIR unset)")
+    if isinstance(incidents, dict):
+        lines.append("  incidents: %s open / %s closed over %s ticks"
+                     % (incidents.get("open"),
+                        incidents.get("closed"),
+                        incidents.get("ticks")))
+        for inc in incidents.get("incidents") or []:
+            lines.append(
+                "    %-16s %-20s %-7s firing=%-4s close=%s" % (
+                    inc.get("id"), inc.get("rule"),
+                    inc.get("state"), inc.get("ticks_firing"),
+                    inc.get("close_reason") or "-"))
+    return "\n".join(lines) + "\n"
+
+
 def _bench_serving_lines(counters: dict, indent="  ") -> list:
     """The BENCH_DETAILS-mode serving block: a per-config tally of
     the ``serve_*`` counters the telemetry dict embeds."""
@@ -318,6 +358,7 @@ def main(argv=None) -> int:
     sys.stdout.write(_artifact_section(data))
     sys.stdout.write(_serving_section(data))
     sys.stdout.write(_fleet_section(data))
+    sys.stdout.write(_history_section(data))
     return 0
 
 

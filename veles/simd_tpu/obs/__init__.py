@@ -114,6 +114,8 @@ import time
 from veles.simd_tpu.obs import compile as _compile
 from veles.simd_tpu.obs import export as _export
 from veles.simd_tpu.obs import flightrec as _flightrec
+from veles.simd_tpu.obs import incidents as _incidents
+from veles.simd_tpu.obs import journal as _journal
 from veles.simd_tpu.obs import requests as _requests_mod
 from veles.simd_tpu.obs import resources as _resources
 from veles.simd_tpu.obs import spans as _spans_mod
@@ -139,6 +141,8 @@ __all__ = [
     "request_trace", "slo", "slo_snapshot", "request_snapshot",
     "request_summary",
     "signals", "fleet_record", "fleet_series", "stitch_fleet_trace",
+    "journal_stats", "journal_cursor", "journal_tail",
+    "incidents_snapshot",
     "install_compile_listeners",
     "instrumented_jit", "resources", "caches", "register_cache",
     "dump_debug_bundle",
@@ -160,6 +164,8 @@ def _requests_decision(op: str, decision: str, **fields) -> None:
     """Decision sink for the request tracer (SLO breach events) —
     bound to the CURRENT event log through the module global, so
     ``configure(max_events=...)`` swaps are honored."""
+    if _journal.armed():
+        _journal.emit_decision(op, decision, fields)
     _events.record(op, decision, **fields)
     _registry.count("decisions", op=op, decision=decision)
 
@@ -226,7 +232,8 @@ def configure(max_events: int | None = None,
               flight_dir: str | None = None,
               max_traces: int | None = None,
               max_exemplars: int | None = None,
-              request_axis: bool | None = None) -> None:
+              request_axis: bool | None = None,
+              journal_dir: str | None = None) -> None:
     """Adjust telemetry limits.  ``max_events`` replaces the decision
     log with a fresh bound (history is cleared — resizing a ring buffer
     in place would silently reorder it); ``max_spans`` does the same
@@ -243,7 +250,10 @@ def configure(max_events: int | None = None,
     metrics (``serve.request_latency{op, status}``,
     ``serve_completed``, ``serve_deadline_miss``) ride the trace's
     terminal edge by design (one accounting home, lint-enforced), so
-    disarming the axis pauses them too."""
+    disarming the axis pauses them too.  ``journal_dir`` overrides
+    ``$VELES_SIMD_JOURNAL_DIR`` as the durable event-journal pack
+    (:mod:`veles.simd_tpu.obs.journal`; pass ``""`` to restore the
+    environment lookup)."""
     global _events, _spans, _request_axis
     if max_events is not None:
         _events = EventLog(max_events)
@@ -257,6 +267,8 @@ def configure(max_events: int | None = None,
                             max_exemplars=max_exemplars)
     if request_axis is not None:
         _request_axis = bool(request_axis)
+    if journal_dir is not None:
+        _journal.configure_dir(journal_dir or None)
 
 
 def install_compile_listeners() -> bool:
@@ -389,10 +401,42 @@ def signals() -> _timeseries.FleetSignals:
     documented autoscaler input contract, also served as ``/signals``
     on the scrape endpoint and rendered by ``tools/obs_dash.py
     --fleet``.  Built from the fleet store, the metrics registry, and
-    the SLO accounts; cheap enough to poll on the collector cadence."""
+    the SLO accounts; cheap enough to poll on the collector cadence.
+    Since obs v6 the bundle also carries the history axis: the open
+    incidents (:mod:`veles.simd_tpu.obs.incidents`) and journal
+    health (armed/records/dropped/``lag_s``)."""
+    now = time.monotonic()
     return _timeseries.FleetSignals.from_sources(
         _fleet, _registry.snapshot(), _requests.slo_snapshot(),
-        now=time.monotonic())
+        now=now, incidents=_incidents.open_incidents(),
+        journal=_journal.stats(now))
+
+
+def journal_stats() -> dict:
+    """History-axis health (:mod:`veles.simd_tpu.obs.journal`): armed
+    flag, pack dir, record/drop/rotation/prune counts, and ``lag_s``
+    since the last durable record."""
+    return _journal.stats()
+
+
+def journal_cursor() -> dict | None:
+    """Where the durable journal is NOW (file/offset/record count;
+    None while disarmed) — what incidents and flight bundles snapshot
+    so a postmortem can seek straight to the moment."""
+    return _journal.cursor()
+
+
+def journal_tail(n: int = _journal.TAIL_KEEP) -> list:
+    """The last ``n`` journal records from the in-memory tail (empty
+    while disarmed)."""
+    return _journal.tail(n)
+
+
+def incidents_snapshot() -> dict:
+    """The incident engine's JSON-native state — the ``/incidents``
+    route body (:mod:`veles.simd_tpu.obs.incidents`): schema stamp,
+    tick count, open/closed tallies, and the typed incident records."""
+    return _incidents.snapshot()
 
 
 def record_decision(op: str, decision: str, **fields) -> None:
@@ -403,7 +447,16 @@ def record_decision(op: str, decision: str, **fields) -> None:
     geometry that explains it (lengths, block sizes, shard counts).
     Also bumps the ``decisions`` counter labeled by (op, decision) so
     aggregates survive event-log wraparound.
+
+    With the history axis armed (``$VELES_SIMD_JOURNAL_DIR`` /
+    ``configure(journal_dir=...)``), every event is ALSO appended to
+    the durable journal — independent of :func:`enabled`, because the
+    journal's whole point is surviving processes whose in-memory
+    telemetry never existed (subprocess replicas arm it by inherited
+    env alone).
     """
+    if _journal.armed():
+        _journal.emit_decision(op, decision, fields)
     if not _enabled:
         return
     _events.record(op, decision, **fields)
@@ -456,6 +509,8 @@ def snapshot() -> dict:
     snap["requests"] = _requests.summary()
     snap["slo"] = _requests.slo_snapshot()
     snap["fleet"] = _fleet.snapshot()
+    snap["journal"] = _journal.stats()
+    snap["incidents"] = _incidents.snapshot()
     snap["enabled"] = _enabled
     return snap
 

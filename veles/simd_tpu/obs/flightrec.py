@@ -152,6 +152,19 @@ def _probe_info() -> list:
         return []
 
 
+def _journal_info() -> dict:
+    """The durable journal's cursor, stats, and in-memory tail
+    (:mod:`veles.simd_tpu.obs.journal`) at bundle time.  Lazy +
+    exception-proof like every other section."""
+    try:
+        from veles.simd_tpu.obs import journal
+
+        return {"cursor": journal.cursor(), "stats": journal.stats(),
+                "tail": journal.tail()}
+    except Exception as e:  # noqa: BLE001
+        return {"error": repr(e)}
+
+
 def build_bundle(reason: str, exc: BaseException | None = None) -> dict:
     """Assemble the bundle dict (separated from writing for tests and
     in-process consumers)."""
@@ -173,6 +186,11 @@ def build_bundle(reason: str, exc: BaseException | None = None) -> dict:
         "request_traces": obs.request_snapshot(),
         "fault_history": _fault_info(),
         "device_probes": _probe_info(),
+        # the history axis (obs v6): where the durable journal was at
+        # bundle time plus its in-memory tail — the bundle stays
+        # self-diagnosing even after the on-disk journal rotates past
+        # the incident it explains
+        "journal": _journal_info(),
     }
     if exc is not None:
         bundle["exception"] = {

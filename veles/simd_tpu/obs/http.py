@@ -2,7 +2,7 @@
 
 Until now every export was a file (``obs.save``, flight bundles,
 ``BENCH_DETAILS.json``) — fine for post-mortems, blind for a *running*
-service.  This module is the live surface, three read-only routes on a
+service.  This module is the live surface: read-only routes on a
 daemon-threaded stdlib ``http.server`` (no new dependencies, same rule
 as the rest of the tooling):
 
@@ -27,7 +27,15 @@ as the rest of the tooling):
   plus the raw windowed series tails (``tools/obs_dash.py --fleet``
   sparklines from exactly this body).  Meaningful on the router
   aggregation endpoint (the ``ReplicaGroup`` collector feeds the
-  store); on a lone server it answers with an empty fleet.
+  store); on a lone server it answers with an empty fleet;
+* ``GET /incidents`` — JSON: the incident engine's typed open→closed
+  records (:mod:`veles.simd_tpu.obs.incidents`) — which rule fired,
+  the trigger detail, the journal cursor and flight bundle captured
+  at open, and the close reason once quiet.
+
+The JSON routes are schema-stamped (``veles-simd-signals-v2``,
+``veles-simd-requests-v1``, ``veles-simd-incidents-v1``) so a
+dashboard can detect contract drift instead of mis-parsing.
 
 Arming: :meth:`veles.simd_tpu.serve.Server.start` reads
 ``$VELES_SIMD_OBS_PORT`` (or its ``obs_port=`` argument; port 0 binds
@@ -45,10 +53,14 @@ import os
 import threading
 
 __all__ = ["ObsEndpoint", "EndpointUnavailable", "start", "env_port",
-           "OBS_PORT_ENV", "BIND_HOST"]
+           "OBS_PORT_ENV", "BIND_HOST", "REQUESTS_SCHEMA"]
 
 OBS_PORT_ENV = "VELES_SIMD_OBS_PORT"
 BIND_HOST = "127.0.0.1"
+# the /debug/requests contract version (the /signals and /incidents
+# stamps live with their producers: timeseries.SIGNALS_SCHEMA,
+# incidents.SCHEMA) — dashboards check these instead of guessing
+REQUESTS_SCHEMA = "veles-simd-requests-v1"
 
 
 def env_port() -> int | None:
@@ -85,7 +97,7 @@ class EndpointUnavailable(OSError):
 
 
 class _Handler(http.server.BaseHTTPRequestHandler):
-    """The three read-only routes.  Every handler is exception-proofed
+    """The read-only routes.  Every handler is exception-proofed
     into a 500 — a scrape must never kill the serving process, and a
     half-written response must never wedge the scraper."""
 
@@ -118,8 +130,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             elif path == "/debug/requests":
                 from veles.simd_tpu import obs
 
-                self._send(200, json.dumps(obs.request_snapshot(),
-                                           indent=2, default=str),
+                body = {"schema": REQUESTS_SCHEMA}
+                body.update(obs.request_snapshot())
+                self._send(200, json.dumps(body, indent=2,
+                                           default=str),
                            "application/json")
             elif path == "/signals":
                 from veles.simd_tpu import obs
@@ -127,11 +141,18 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 self._send(200, json.dumps(obs.signals().to_dict(),
                                            indent=2, default=str),
                            "application/json")
+            elif path == "/incidents":
+                from veles.simd_tpu import obs
+
+                self._send(200, json.dumps(obs.incidents_snapshot(),
+                                           indent=2, default=str),
+                           "application/json")
             else:
                 self._send(404, json.dumps(
                     {"error": "unknown path",
                      "routes": ["/metrics", "/healthz",
-                                "/debug/requests", "/signals"]}),
+                                "/debug/requests", "/signals",
+                                "/incidents"]}),
                     "application/json")
         except BrokenPipeError:
             pass        # scraper hung up mid-response: its problem

@@ -45,9 +45,14 @@ __all__ = [
     "FleetSeries", "FleetSignals", "stitch_fleet_trace",
     "rate", "delta", "ewma", "flaps",
     "FLEET_TICK_MS_ENV", "FLEET_WINDOW_ENV",
-    "DEFAULT_TICK_MS", "DEFAULT_WINDOW",
+    "DEFAULT_TICK_MS", "DEFAULT_WINDOW", "SIGNALS_SCHEMA",
     "env_tick_s", "env_window",
 ]
+
+# the /signals contract version: bumped whenever FleetSignals gains,
+# loses, or re-types a field, so dashboards can detect drift instead
+# of mis-parsing (obs v6 added incidents + journal)
+SIGNALS_SCHEMA = "veles-simd-signals-v2"
 
 FLEET_TICK_MS_ENV = "VELES_SIMD_FLEET_TICK_MS"
 FLEET_WINDOW_ENV = "VELES_SIMD_FLEET_WINDOW"
@@ -267,6 +272,8 @@ class FleetSignals:
     ``health``            {replica: healthy|degraded|down|stale|unknown}
     ``staleness_s``       {replica: age of its newest sample}
     ``scrape_stale``      {replica: failed-scrape count (subprocess mode)}
+    ``incidents``         open incidents (obs v6 incident engine)
+    ``journal``           journal health: armed/records/dropped/lag_s
     ===================== ==================================================
     """
 
@@ -275,7 +282,7 @@ class FleetSignals:
                  "queue_depth_total", "occupancy", "breaker_open",
                  "breaker_flaps", "goodput", "goodput_overall",
                  "padding_waste", "health", "staleness_s",
-                 "scrape_stale", "series")
+                 "scrape_stale", "incidents", "journal", "series")
 
     def __init__(self, **kw):
         missing = [n for n in self.__slots__ if n not in kw]
@@ -288,12 +295,17 @@ class FleetSignals:
 
     @classmethod
     def from_sources(cls, fleet: FleetSeries, registry_snapshot: dict,
-                     slo_snapshot: dict, now: float) -> "FleetSignals":
+                     slo_snapshot: dict, now: float,
+                     incidents: list | None = None,
+                     journal: dict | None = None) -> "FleetSignals":
         """Assemble one consistent bundle from the live sources: the
         fleet store (windowed series), a registry snapshot (goodput
         gauges + scrape-staleness counters), and the SLO accounts
         (current burn; velocity comes from the store's windowed
-        ``slo_burn:<tenant>`` series)."""
+        ``slo_burn:<tenant>`` series).  ``incidents`` / ``journal``
+        are the history axis' contributions (``obs.signals()`` passes
+        the open-incident list and journal health; callers wiring the
+        sources by hand may omit them)."""
         burn: dict = {}
         for tenant, acct in sorted(
                 (slo_snapshot.get("accounts") or {}).items()):
@@ -371,13 +383,20 @@ class FleetSignals:
                            else 1.0 - overall),
             health=health, staleness_s=stale,
             scrape_stale=scrape_stale,
+            incidents=list(incidents or []),
+            journal=dict(journal or {"armed": False}),
             series=fleet.snapshot()["series"])
 
     def to_dict(self) -> dict:
         """JSON-native form — the ``/signals`` route body (includes
         the raw windowed ``series`` tails so dashboards can sparkline
-        without keeping client-side history)."""
-        return {name: getattr(self, name) for name in self.__slots__}
+        without keeping client-side history), stamped with
+        :data:`SIGNALS_SCHEMA` so consumers can detect contract
+        drift."""
+        body = {"schema": SIGNALS_SCHEMA}
+        body.update((name, getattr(self, name))
+                    for name in self.__slots__)
+        return body
 
     def __repr__(self):
         return ("FleetSignals(replicas=%d, ticks=%d, burn=%s, "

@@ -169,11 +169,16 @@ class Breaker:
         self._state = new_state
         obs.gauge("breaker_state", _STATE_GAUGE[new_state],
                   site=self.site, key=self._key_label())
+        # lifetime opens/probes ride every transition: the journal
+        # (obs v6) makes these events durable, and a postmortem
+        # counting breaker *cycles* needs the cumulative context each
+        # edge was recorded against, not just the edge itself
         obs.record_decision(
             "breaker_transition", new_state, site=self.site,
             key=self._key_label(), previous=old, reason=reason,
             failures=sum(1 for ok in self._window if not ok),
-            window=len(self._window))
+            window=len(self._window),
+            opens=self._opens, probes=self._probes)
 
     # -- the caller contract -----------------------------------------------
 

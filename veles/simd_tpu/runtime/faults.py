@@ -747,7 +747,8 @@ def guarded(site: str, thunk, *, fallback=None, retries: int | None = None,
                 obs.count("fault_retry", site=site)
                 obs.record_decision(
                     "fault_policy", "retry", site=site, kind=kind,
-                    attempt=attempt + 1, retries=retries)
+                    attempt=attempt + 1, retries=retries,
+                    delay_s=delay)
                 _observe_fault("retry", kind, attempt + 1)
                 if delay > 0:
                     time.sleep(delay)
@@ -761,10 +762,15 @@ def guarded(site: str, thunk, *, fallback=None, retries: int | None = None,
             if breaker is not None:
                 breaker.failure()
             bundle = _arm_flightrec(site, e)
+            # attempt count + backoff delay travel on the durable
+            # record (obs v6 journal): a postmortem reading only the
+            # journal must see how hard the policy fought before it
+            # gave the request away
             obs.record_decision(
                 "fault_policy",
                 "degrade" if fallback is not None else "exhausted",
-                site=site, kind=kind, retries=retries,
+                site=site, kind=kind, attempt=attempt,
+                retries=retries,
                 flight_bundle=bundle, budget_clipped=clipped,
                 fallback=fallback_name if fallback is not None
                 else None)

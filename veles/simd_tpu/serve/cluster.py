@@ -108,6 +108,8 @@ import threading
 from veles.simd_tpu import obs
 from veles.simd_tpu.obs import export as obs_export
 from veles.simd_tpu.obs import http as obs_http
+from veles.simd_tpu.obs import incidents as obs_incidents
+from veles.simd_tpu.obs import journal as obs_journal
 from veles.simd_tpu.obs import timeseries as _timeseries
 from veles.simd_tpu.runtime import breaker as _breaker
 from veles.simd_tpu.runtime import faults
@@ -293,7 +295,12 @@ class Replica:
                "import sys; "
                "from veles.simd_tpu.serve.cluster import _replica_main; "
                "sys.exit(_replica_main(sys.argv[1:]))",
-               "--obs-port", str(port_arg)]
+               "--obs-port", str(port_arg),
+               # the child stamps this identity into its own journal
+               # file (it inherits $VELES_SIMD_JOURNAL_DIR and writes
+               # journal-<childpid>-*.jsonl in the shared pack), so
+               # obs_query can attribute its records after it is dead
+               "--name", self.rid]
         # forward the server policy knobs the child's Server takes —
         # a subprocess replica must run the operator's batching/worker
         # policy, not silent defaults
@@ -507,6 +514,11 @@ class ReplicaGroup:
             target=self._collector_loop, daemon=True,
             name="veles-fleet-collector")
         self._collector_thread.start()
+        # the incident engine rides the collector: it ticks over
+        # obs.signals() (which the collector feeds) and serves
+        # /incidents on this group's aggregation endpoint; open/close
+        # edges flow through record_decision — the journal funnel
+        obs_incidents.start()
         obs.gauge("replica_alive", float(self.alive()))
         obs.record_decision("replica_lifecycle", "group_start",
                             replicas=len(self.replicas),
@@ -516,6 +528,7 @@ class ReplicaGroup:
     def stop(self, drain: bool = True) -> None:
         """Stop the heartbeat loop and every live replica (drained or
         abruptly), then the aggregation endpoint."""
+        obs_incidents.stop()
         self._hb_stop.set()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=5.0)
@@ -1278,8 +1291,13 @@ def _replica_main(argv=None) -> int:
     ap.add_argument("--max-batch", type=int, default=None)
     ap.add_argument("--max-wait-ms", type=float, default=None)
     ap.add_argument("--workers", type=int, default=None)
+    ap.add_argument("--name", default=None)
     args = ap.parse_args(argv)
     obs.enable()
+    # history axis: every record this process journals carries its
+    # replica identity (the pack is shared; the pid alone names the
+    # file, the replica names the story)
+    obs_journal.set_replica(args.name or f"pid-{os.getpid()}")
     kwargs = {}
     if args.workers:
         kwargs["workers"] = args.workers

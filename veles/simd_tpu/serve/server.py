@@ -616,6 +616,15 @@ class Server:
         if self._endpoint is not None:
             obs.record_decision("serve_obs_endpoint", "armed",
                                 port=self._endpoint.port)
+        # lifecycle edge — journaled when the history axis is armed,
+        # so a subprocess replica's own journal file opens with its
+        # birth (and obs_query can bracket its story)
+        obs.record_decision("serve_lifecycle", "start",
+                            workers=self.workers,
+                            max_batch=self.max_batch,
+                            obs_port=self.obs_port,
+                            **({"replica": self.name}
+                               if self.name else {}))
         # same label shape as the health machine's trip/recover
         # updates: a named replica's gauge series must be the one its
         # degrade flips, or a dashboard watching it never sees the
@@ -664,6 +673,12 @@ class Server:
         if self._endpoint is not None:
             self._endpoint.stop()
             self._endpoint = None
+        # the matching lifecycle edge: a drained stop and an abrupt
+        # one read differently in a postmortem
+        obs.record_decision("serve_lifecycle", "stop",
+                            drain=bool(drain),
+                            **({"replica": self.name}
+                               if self.name else {}))
 
     _abandoned = False
 
