@@ -317,6 +317,75 @@ class TestIncidentHysteresis:
         eng.tick(_FakeSignals(float(t), flaps=0))
         assert eng.open_incidents() == []
 
+    def test_open_with_flight_recorder_does_not_deadlock(
+            self, pack, tmp_path):
+        """Regression: opening an incident arms a flight bundle whose
+        body embeds obs.snapshot() -> incidents.snapshot(), which
+        reads the PROCESS engine back.  With the flight dir armed,
+        the open path must not tick while holding the engine lock —
+        that deadlocked the ticker (and wedged every /signals and
+        /incidents read) permanently."""
+        from veles.simd_tpu.obs import flightrec
+
+        fdir = tmp_path / "flight"
+        flightrec.configure_flight_dir(str(fdir))
+        flightrec._reset_auto_count()
+        eng = obs_incidents.engine()    # the process-wide engine
+        try:
+            done = threading.Event()
+
+            def _drive():
+                for t in range(eng.open_ticks + 1):
+                    eng.tick(_FakeSignals(float(t), health="down"))
+                done.set()
+
+            worker = threading.Thread(target=_drive, daemon=True)
+            worker.start()
+            assert done.wait(timeout=30.0), \
+                "incident open deadlocked against its own engine lock"
+            open_now = eng.open_incidents()
+            assert len(open_now) == 1
+            inc = open_now[0]
+            # the bundle was written, and — because the engine lock is
+            # released during capture — it embeds the open incident
+            assert inc.bundle is not None
+            body = json.loads(Path(inc.bundle).read_text())
+            embedded = body["snapshot"]["incidents"]["incidents"]
+            assert any(i["id"] == inc.id for i in embedded)
+        finally:
+            flightrec.configure_flight_dir(None)
+            flightrec._reset_auto_count()
+
+    def test_module_reset_clears_ledger(self, pack):
+        """A new journal epoch (chaos arming a fresh pack) resets the
+        process engine: closed incidents from an earlier epoch must
+        not satisfy a later campaign's close-wait, and leftover
+        streaks must not skew its hysteresis."""
+        eng = obs_incidents.engine()
+        t = 0
+        for _ in range(3):
+            eng.tick(_FakeSignals(float(t), health="down"))
+            t += 1
+        for _ in range(6):
+            eng.tick(_FakeSignals(float(t)))
+            t += 1
+        assert len(eng.incidents()) == 1        # closed, in the ledger
+        eng.tick(_FakeSignals(float(t), health="down"))
+        obs_incidents.reset()
+        assert eng.incidents() == []
+        assert eng._streak["replica_down"] == 0
+
+    def test_start_stop_reference_counted(self, pack):
+        """Two holders (two ReplicaGroups in one process) — one
+        stop() must not halt the other's incident detection."""
+        eng = obs_incidents.start(interval_s=0.02)
+        obs_incidents.start(interval_s=0.02)
+        assert eng._thread is not None and eng._thread.is_alive()
+        obs_incidents.stop()            # first holder releases
+        assert eng._thread is not None and eng._thread.is_alive()
+        obs_incidents.stop()            # last holder releases
+        assert eng._thread is None or not eng._thread.is_alive()
+
     def test_edges_journaled_durably(self, pack):
         eng = self._engine()
         t = 0

@@ -537,6 +537,13 @@ def run_replica_campaign(args) -> tuple:
                 os.environ[k] = v
 
     os.environ.update(armed)
+    # the process-wide incident engine may carry another epoch's state
+    # (an earlier campaign or test in this process): a stale CLOSED
+    # replica_down incident would satisfy the campaign's close-wait
+    # instantly — before its own incident closes into the armed
+    # journal — and leftover streaks skew the hysteresis.  The fresh
+    # pack's incident story starts from a clean ledger.
+    obs_incidents.reset()
     try:
         return _replica_campaign_body(args, _restore, journal_pack)
     finally:

@@ -46,7 +46,7 @@ import time
 
 __all__ = [
     "SCHEMA", "Incident", "IncidentEngine", "RULES",
-    "engine", "start", "stop", "snapshot", "open_incidents",
+    "engine", "start", "stop", "reset", "snapshot", "open_incidents",
     "OPEN_TICKS_ENV", "CLOSE_TICKS_ENV", "TICK_MS_ENV",
     "BURN_ENV", "FLAPS_ENV", "GOODPUT_ENV", "QUEUE_VELOCITY_ENV",
     "DEFAULT_OPEN_TICKS", "DEFAULT_CLOSE_TICKS", "DEFAULT_TICK_MS",
@@ -154,9 +154,9 @@ class IncidentEngine:
                  flaps: int | None = None,
                  goodput: float | None = None,
                  queue_velocity: float | None = None):
-        self.open_ticks = int(open_ticks) if open_ticks \
+        self.open_ticks = int(open_ticks) if open_ticks is not None \
             else _env_int(OPEN_TICKS_ENV, DEFAULT_OPEN_TICKS)
-        self.close_ticks = int(close_ticks) if close_ticks \
+        self.close_ticks = int(close_ticks) if close_ticks is not None \
             else _env_int(CLOSE_TICKS_ENV, DEFAULT_CLOSE_TICKS)
         self.burn = burn if burn is not None \
             else _env_float(BURN_ENV, DEFAULT_BURN)
@@ -245,7 +245,7 @@ class IncidentEngine:
             "replica_down": self._rule_replica_down,
             "queue_runaway": self._rule_queue_runaway,
         }
-        changed = []
+        changed = []        # [(incident, edge, emit-detail)]
         with self._lock:
             self.ticks += 1
             for rule in RULES:
@@ -261,29 +261,39 @@ class IncidentEngine:
                         open_inc.ticks_firing += 1
                         open_inc.last_detail = detail
                     elif self._streak[rule] >= self.open_ticks:
-                        changed.append(self._open_incident(rule, detail))
+                        inc = self._open_incident(rule, detail)
+                        changed.append((inc, "open", detail))
                 else:
                     self._streak[rule] = 0
                     if open_inc is not None:
                         self._quiet[rule] += 1
                         if self._quiet[rule] >= self.close_ticks:
-                            changed.append(self._close_incident(rule))
-        return changed
+                            inc = self._close_incident(rule)
+                            changed.append(
+                                (inc, "close",
+                                 {"reason": inc.close_reason,
+                                  "open_s": inc.closed_t_mono
+                                  - inc.opened_t_mono}))
+        # Evidence capture and edge emission run OUTSIDE the engine
+        # lock: maybe_record's bundle embeds obs.snapshot(), which
+        # reads this engine back through incidents.snapshot() (holding
+        # the lock here would deadlock on the first open), and both
+        # the bundle and the journal append touch disk — a stalled
+        # write must never block concurrent signals()/snapshot()
+        # readers on the lock.
+        for inc, edge, detail in changed:
+            if edge == "open":
+                self._capture_evidence(inc)
+            self._emit(inc, edge, detail)
+        return [inc for inc, _, _ in changed]
 
     def _open_incident(self, rule: str, detail: dict) -> Incident:
-        from veles.simd_tpu.obs import flightrec, journal
-
+        """Mint the open incident — lock held, state mutation only;
+        evidence capture happens lock-free in :meth:`tick`."""
         self._seq += 1
         iid = "inc-%d-%d" % (os.getpid(), self._seq)
-        cur = bundle = None
-        try:
-            cur = journal.cursor()
-            bundle = flightrec.maybe_record(f"incident:{rule}", None)
-        except Exception:  # noqa: BLE001 — evidence capture is best
-            pass           # effort; the incident itself must open
-        inc = Incident(iid, rule, detail, cur, bundle)
+        inc = Incident(iid, rule, detail, None, None)
         self._open[rule] = inc
-        self._emit(inc, "open", detail)
         return inc
 
     def _close_incident(self, rule: str) -> Incident:
@@ -296,16 +306,29 @@ class IncidentEngine:
         self._closed.append(inc)
         if len(self._closed) > MAX_INCIDENTS:
             del self._closed[0]
-        self._emit(inc, "close", {"reason": inc.close_reason,
-                                  "open_s": inc.closed_t_mono
-                                  - inc.opened_t_mono})
         return inc
+
+    @staticmethod
+    def _capture_evidence(inc: Incident) -> None:
+        """Snapshot the journal cursor and arm a budgeted flight
+        bundle for a just-opened incident.  Must be called WITHOUT
+        the engine lock — the bundle embeds obs.snapshot(), which
+        reads this engine back."""
+        try:
+            from veles.simd_tpu.obs import flightrec, journal
+
+            inc.journal_cursor = journal.cursor()
+            inc.bundle = flightrec.maybe_record(
+                f"incident:{inc.rule}", None)
+        except Exception:  # noqa: BLE001 — evidence capture is best
+            pass           # effort; the incident itself must open
 
     @staticmethod
     def _emit(inc: Incident, edge: str, detail: dict) -> None:
         """One ``incident``/``open|close`` decision event per edge —
         ``obs.record_decision`` is the journal funnel, so the edge is
-        durable when the journal is armed."""
+        durable when the journal is armed.  Called without the engine
+        lock (the journal append is a disk write)."""
         try:
             from veles.simd_tpu import obs
 
@@ -320,6 +343,13 @@ class IncidentEngine:
         with self._lock:
             return [self._open[r] for r in RULES if r in self._open]
 
+    def open_snapshots(self) -> list:
+        """Open incidents as dicts, built while holding the lock so a
+        reader never sees a half-mutated incident."""
+        with self._lock:
+            return [self._open[r].to_dict() for r in RULES
+                    if r in self._open]
+
     def incidents(self) -> list:
         """Closed then open, oldest first."""
         with self._lock:
@@ -327,9 +357,16 @@ class IncidentEngine:
                                          if r in self._open]
 
     def snapshot(self) -> dict:
-        """JSON-native form — the ``/incidents`` route body."""
-        items = [i.to_dict() for i in self.incidents()]
-        return {"schema": SCHEMA, "ticks": self.ticks,
+        """JSON-native form — the ``/incidents`` route body.  The
+        dicts are built while holding the lock: the ticker mutates
+        state/closed_* in place, and a lock-free ``to_dict`` could
+        serve ``state='closed'`` with ``closed_t_wall`` still None."""
+        with self._lock:
+            ticks = self.ticks
+            items = [i.to_dict() for i in
+                     list(self._closed) + [self._open[r] for r in RULES
+                                           if r in self._open]]
+        return {"schema": SCHEMA, "ticks": ticks,
                 "open": sum(1 for i in items if i["state"] == "open"),
                 "closed": sum(1 for i in items
                               if i["state"] == "closed"),
@@ -381,6 +418,7 @@ class IncidentEngine:
 
 _engine: IncidentEngine | None = None
 _engine_lock = threading.Lock()
+_starters = 0   # live start() holds; stop() halts the ticker at zero
 
 
 def engine() -> IncidentEngine:
@@ -394,17 +432,42 @@ def engine() -> IncidentEngine:
 
 def start(interval_s: float | None = None) -> IncidentEngine:
     """Arm the process engine's ticker (the ReplicaGroup collector
-    calls this on start); returns the engine."""
+    calls this on start); returns the engine.  Starts are reference-
+    counted: every ``start()`` must be paired with one ``stop()``,
+    and the ticker only halts when the last starter releases — two
+    ReplicaGroups in one process can't silence each other."""
+    global _starters
+    with _engine_lock:
+        _starters += 1
     e = engine()
     e.start(interval_s)
     return e
 
 
 def stop() -> None:
-    """Stop the process engine's ticker (open incidents are kept)."""
-    e = _engine
+    """Release one ``start()`` hold; the process ticker stops only
+    when the last holder releases (open incidents are kept)."""
+    global _starters
+    with _engine_lock:
+        if _starters > 0:
+            _starters -= 1
+        if _starters > 0:
+            return
+        e = _engine
     if e is not None:
         e.stop()
+
+
+def reset() -> None:
+    """Clear the process engine's incident ledger and rule state
+    (streaks, quiet counters, open and closed incidents) without
+    touching the ticker or its start() holders.  A new journal epoch
+    — a chaos campaign arming a fresh pack — calls this so the pack's
+    incident story starts clean instead of inheriting another epoch's
+    closed incidents and half-built streaks."""
+    e = _engine
+    if e is not None:
+        e.reset()
 
 
 def open_incidents() -> list:
@@ -413,7 +476,7 @@ def open_incidents() -> list:
     e = _engine
     if e is None:
         return []
-    return [i.to_dict() for i in e.open_incidents()]
+    return e.open_snapshots()
 
 
 def snapshot() -> dict:
@@ -427,8 +490,9 @@ def snapshot() -> dict:
 
 
 def _reset_for_tests() -> None:
-    global _engine
+    global _engine, _starters
     with _engine_lock:
+        _starters = 0
         if _engine is not None:
             _engine.stop()
             _engine = None

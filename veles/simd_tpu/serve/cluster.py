@@ -471,6 +471,7 @@ class ReplicaGroup:
                              else _timeseries.env_tick_s())
         self._collector_thread = None
         self._started = False
+        self._incidents_hold = False
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -517,8 +518,11 @@ class ReplicaGroup:
         # the incident engine rides the collector: it ticks over
         # obs.signals() (which the collector feeds) and serves
         # /incidents on this group's aggregation endpoint; open/close
-        # edges flow through record_decision — the journal funnel
+        # edges flow through record_decision — the journal funnel.
+        # Starts are reference-counted, so this group only holds (and
+        # later releases) its own share of the process-wide ticker.
         obs_incidents.start()
+        self._incidents_hold = True
         obs.gauge("replica_alive", float(self.alive()))
         obs.record_decision("replica_lifecycle", "group_start",
                             replicas=len(self.replicas),
@@ -528,7 +532,9 @@ class ReplicaGroup:
     def stop(self, drain: bool = True) -> None:
         """Stop the heartbeat loop and every live replica (drained or
         abruptly), then the aggregation endpoint."""
-        obs_incidents.stop()
+        if self._incidents_hold:
+            self._incidents_hold = False
+            obs_incidents.stop()
         self._hb_stop.set()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=5.0)
