@@ -7,7 +7,8 @@ PYTHON ?= python
 
 .PHONY: all tests tests-quick benchmarks bench bench-regress \
         bench-multichip bench-serve bench-goodput serve-smoke \
-        chaos-smoke chaos-replicas cshim cshim-check wavelet-tables \
+        chaos-smoke chaos-replicas chaos-scale cshim cshim-check \
+        wavelet-tables \
         lint docs obs-report obs-dash obs-query autotune-pack \
         warm-pack \
         cold-start install install-hooks clean
@@ -93,6 +94,20 @@ chaos-smoke:
 chaos-replicas:
 	VELES_SIMD_PLATFORM=cpu VELES_SIMD_FAULT_BACKOFF=0 \
 		$(PYTHON) tools/chaos.py --replicas --smoke
+
+# the CONTROL-AXIS chaos campaign on CPU (obs v7): a ~10x diurnal
+# traffic ramp over a scaler-armed ReplicaGroup — the SLO-driven
+# autoscaler must spawn under the peak's queue backlog, retire back to
+# min through the sustained-idle window, keep p99 + SLO hit rate in
+# budget, hold replica-seconds within a factor of the oracle schedule,
+# produce ZERO actions under a synthetic flap-storm, and leave a
+# journal pack from which the whole incident -> action -> effect chain
+# reconstructs offline (tools/chaos.py --scale; SCALE_DETAILS.json
+# rows gate via `python tools/bench_regress.py --details
+# SCALE_DETAILS.json`)
+chaos-scale:
+	VELES_SIMD_PLATFORM=cpu VELES_SIMD_FAULT_BACKOFF=0 \
+		$(PYTHON) tools/chaos.py --scale --smoke
 
 cshim:
 	$(MAKE) -C csrc all

@@ -235,6 +235,95 @@ class TestJournalDurability:
 
 
 # ---------------------------------------------------------------------------
+# dead-pid segment reclamation (the group owner's prune_foreign sweep)
+# ---------------------------------------------------------------------------
+
+def _dead_pid() -> int:
+    """A pid that is definitely gone: a just-exited child."""
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    return p.pid
+
+
+def _plant_segment(pack, pid, seq, size, mtime=None) -> Path:
+    path = Path(pack) / ("journal-%d-%06d.jsonl" % (pid, seq))
+    path.write_bytes(b"x" * size)
+    if mtime is not None:
+        os.utime(path, (mtime, mtime))
+    return path
+
+
+class TestPruneForeign:
+    def test_reclaims_dead_pid_oldest_first(self, pack):
+        obs_journal.emit("decision", {"mine": True})
+        own = os.path.basename(obs.journal_cursor()["file"])
+        dead = _dead_pid()
+        old = _plant_segment(pack, dead, 1, 600, mtime=1_000)
+        new = _plant_segment(pack, dead, 2, 600, mtime=2_000)
+        # budget admits ONE of the two dead segments: only the
+        # oldest-by-mtime goes
+        own_size = (Path(pack) / own).stat().st_size
+        pruned = obs_journal.prune_foreign(
+            directory=pack, max_total_bytes=own_size + 700)
+        assert pruned == 1
+        assert not old.exists() and new.exists()
+        assert (Path(pack) / own).exists()
+        assert obs.journal_stats()["pruned_foreign"] == 1
+
+    def test_under_budget_is_a_noop(self, pack):
+        dead = _dead_pid()
+        seg = _plant_segment(pack, dead, 1, 100)
+        assert obs_journal.prune_foreign(
+            directory=pack, max_total_bytes=1 << 20) == 0
+        assert seg.exists()
+        assert obs.journal_stats()["pruned_foreign"] == 0
+
+    def test_live_pids_are_protected(self, pack):
+        """Neither an explicitly-protected pid, a signal-0-alive pid,
+        nor this process's own files are ever reclaimed — even when
+        the pack stays over budget because of them."""
+        child = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(60)"])
+        try:
+            probed = _plant_segment(pack, child.pid, 1, 500)
+            listed = _plant_segment(pack, _dead_pid(), 1, 500)
+            mine = _plant_segment(pack, os.getpid(), 7, 500)
+            pruned = obs_journal.prune_foreign(
+                directory=pack, max_total_bytes=1,
+                live_pids=[listed.name.split("-")[1]])
+            assert pruned == 0
+            assert probed.exists() and listed.exists() \
+                and mine.exists()
+        finally:
+            child.kill()
+            child.wait()
+
+    def test_collector_sweep_prunes_and_counts(self, pack):
+        """The ReplicaGroup collector's every-64th sweep reclaims
+        dead-pid segments and bumps the journal_pruned_foreign
+        counter."""
+        from veles.simd_tpu.serve import cluster
+
+        obs.enable(compile_listeners=False)
+        dead = _dead_pid()
+        try:
+            with cluster.ReplicaGroup(1, max_wait_ms=2.0,
+                                      obs_port=-1) as group:
+                budget = obs_journal._env_int(
+                    obs_journal.MAX_TOTAL_BYTES_ENV,
+                    obs_journal.DEFAULT_MAX_TOTAL_BYTES)
+                doomed = _plant_segment(pack, dead, 1, budget + 1024)
+                group._sweeps = 63
+                group._collect_fleet_sample()
+                assert not doomed.exists()
+                assert obs.counter_value(
+                    "journal_pruned_foreign") >= 1
+        finally:
+            obs.disable()
+            obs.reset()
+
+
+# ---------------------------------------------------------------------------
 # incident hysteresis
 # ---------------------------------------------------------------------------
 

@@ -1680,3 +1680,130 @@ def test_real_modules_pass_journal_rule():
             tree = ast.parse(f.read_text(), str(f))
             assert lint.journal_funnel_errors(tree, str(f)) == [], \
                 f.name
+
+
+# ---------------------------------------------------------------------------
+# the control-axis rule (obs v7): serve/scaler.py reads fleet state
+# ONLY through obs.signals() and acts ONLY through ReplicaGroup verbs
+# — a second unrecorded view (scrapes, obs side-doors, direct Server
+# access) breaks the "every decision is explainable from its journaled
+# input vector" claim
+# ---------------------------------------------------------------------------
+
+SCALER_GOOD = '''
+from veles.simd_tpu import obs
+
+
+class Engine:
+    def tick(self):
+        sig = obs.signals()
+        if sig.queue_depth_total > 8 * self.group.alive():
+            rid = self.group.spawn_replica().rid
+            obs.record_decision("scaler", "scale_up", replica=rid)
+            obs.count("scaler_action", action="scale_up")
+        for r in self.group.live_replicas():
+            pass
+        self.group.retire("r1", reason="scaler")
+        self.group.restart("r0")
+'''
+
+SCALER_SCRAPE_IMPORT = '''
+import urllib.request
+
+from veles.simd_tpu import obs
+
+
+def peek(url):
+    with urllib.request.urlopen(url) as resp:
+        return resp.read()
+'''
+
+SCALER_PARSE_PROMETHEUS = '''
+from veles.simd_tpu.obs import export as obs_export
+
+
+def second_view(body):
+    return obs_export.parse_prometheus(body)
+'''
+
+SCALER_SERVER_ATTR = '''
+class Engine:
+    def depth(self):
+        # reaching through the replica to its Server bypasses the
+        # group verbs' locking
+        return sum(r.server.depth()
+                   for r in self.group.live_replicas())
+'''
+
+SCALER_SUBMIT = '''
+class Engine:
+    def probe(self, req):
+        return self.router.submit(req)
+'''
+
+SCALER_OBS_SIDE_DOOR = '''
+from veles.simd_tpu import obs as telemetry
+
+
+class Engine:
+    def tick(self):
+        # alias-tracked: a snapshot() read is a second, unrecorded
+        # view of the fleet
+        return telemetry.snapshot()["counters"]
+'''
+
+SCALER_BAD_VERB = '''
+class Engine:
+    def panic(self):
+        self.group.stop(drain=False)
+'''
+
+
+def _scaler_errs(src):
+    return lint.scaler_control_errors(ast.parse(src), "mod.py")
+
+
+def test_scaler_rule_passes_contract_shaped_engine():
+    assert _scaler_errs(SCALER_GOOD) == []
+
+
+def test_scaler_rule_flags_scrape_imports():
+    errs = _scaler_errs(SCALER_SCRAPE_IMPORT)
+    assert len(errs) == 1
+    assert "urllib" in errs[0] and "signals" in errs[0]
+
+
+def test_scaler_rule_flags_parse_prometheus():
+    errs = _scaler_errs(SCALER_PARSE_PROMETHEUS)
+    assert len(errs) == 1
+    assert "parse_prometheus" in errs[0]
+
+
+def test_scaler_rule_flags_direct_server_access():
+    errs = _scaler_errs(SCALER_SERVER_ATTR)
+    assert len(errs) == 1
+    assert ".server" in errs[0]
+
+
+def test_scaler_rule_flags_request_submission():
+    errs = _scaler_errs(SCALER_SUBMIT)
+    assert len(errs) == 1
+    assert "submit" in errs[0]
+
+
+def test_scaler_rule_flags_obs_side_door_reads():
+    errs = _scaler_errs(SCALER_OBS_SIDE_DOOR)
+    assert len(errs) == 1
+    assert "telemetry.snapshot" in errs[0]
+
+
+def test_scaler_rule_flags_unapproved_group_verb():
+    errs = _scaler_errs(SCALER_BAD_VERB)
+    assert len(errs) == 1
+    assert "self.group.stop" in errs[0]
+
+
+def test_real_scaler_module_passes_control_rule():
+    f = REPO / "veles" / "simd_tpu" / "serve" / "scaler.py"
+    tree = ast.parse(f.read_text(), str(f))
+    assert lint.scaler_control_errors(tree, str(f)) == []

@@ -1160,8 +1160,10 @@ class TestFleetSignals:
         obs.count("serve_dispatched_rows", 100, op="sosfilt",
                   bucket=512)
         obs.count("fleet_scrape_stale", replica="r9")
+        obs.fleet_record("r0", "birth_age_s", 12.5, t_s=now)
         sig = ts.FleetSignals.from_sources(
-            store, obs.snapshot(), obs.slo_snapshot(), now=now)
+            store, obs.snapshot(), obs.slo_snapshot(), now=now,
+            scaler={"armed": True, "ticks": 7, "actions": {}})
         assert sig.health["r0"] == "healthy"
         assert sig.health["r1"] == "down"
         assert sig.queue_depth["r0"] == 2.0
@@ -1170,8 +1172,19 @@ class TestFleetSignals:
         assert list(sig.goodput.values()) == [pytest.approx(0.9)]
         assert sig.scrape_stale == {"r9": 1}
         assert sig.staleness_s["r0"] == pytest.approx(0.0)
+        # obs v7: membership counts derived from health when no
+        # collector replica_count_* series exists (hand-wired store),
+        # per-replica birth ages, and the scaler summary pass-through
+        assert sig.replica_count == {"up": 1, "draining": 0,
+                                     "down": 1}
+        assert sig.birth_age_s["r0"] == pytest.approx(12.5)
+        assert sig.scaler["armed"] is True
+        assert sig.scaler["ticks"] == 7
         d = sig.to_dict()
+        assert d["schema"] == ts.SIGNALS_SCHEMA == \
+            "veles-simd-signals-v3"
         assert d["health"]["r1"] == "down"
+        assert d["replica_count"]["up"] == 1
         assert "series" in d
         # kwargs are checked: a typo'd signal name is a TypeError,
         # not a silently-absorbed attribute

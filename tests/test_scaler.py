@@ -1,0 +1,502 @@
+"""The control axis (obs v7): the SLO-driven autoscaler
+(``veles/simd_tpu/serve/scaler.py``).
+
+Everything here is deterministic — the engine's clock is the signals
+bundle's own ``at_s`` stamp, so hysteresis, cooldown, and the
+sustained-idle window are driven by a scripted fake clock with ZERO
+sleeps.  Contracts pinned:
+
+* every rule fires on its own signal shape (replica_down, slo_burn,
+  burn_velocity, queue_velocity, queue_depth, idle) and the priority
+  order is replace > scale_up > scale_down;
+* hysteresis: below ``up_ticks``/``down_ticks`` consecutive firing
+  ticks the decision is a typed ``hysteresis_pending`` no-op, and a
+  non-winning action's streak resets;
+* cooldown after EVERY action, min/max bounds, and the scale-down
+  victim (least queue depth, ties to the newest rid) — all typed
+  no-ops, never silent;
+* verb failures demote to typed no-ops (``replace_pending`` on the
+  ValueError "not DEAD yet", ``spawn_failed``/``retire_failed`` on a
+  blown-up verb) and a replaced-by-retire rid is never resurrected;
+* a breaker flap-storm produces ZERO actions;
+* the decision record carries the full input vector + the triggering
+  incident id, lands in the bounded tail, the schema-stamped
+  snapshot, and (when armed) the durable journal;
+* env knob parsing, the module-level registry the ``/scaler`` route
+  serves, and the ReplicaGroup arm/disarm lifecycle.
+"""
+
+import sys
+import threading
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from veles.simd_tpu import obs  # noqa: E402
+from veles.simd_tpu.obs import journal as obs_journal  # noqa: E402
+from veles.simd_tpu.runtime import breaker, faults  # noqa: E402
+from veles.simd_tpu.serve import cluster  # noqa: E402
+from veles.simd_tpu.serve import scaler  # noqa: E402
+
+
+@pytest.fixture
+def telemetry(monkeypatch):
+    """Telemetry on, zero backoff, fresh registries before/after."""
+    monkeypatch.setenv("VELES_SIMD_FAULT_BACKOFF", "0")
+    obs.enable(compile_listeners=False)
+    obs.reset()
+    breaker.reset()
+    faults.reset_fault_history()
+    scaler._reset_for_tests()
+    yield
+    scaler._reset_for_tests()
+    obs.disable()
+    obs.reset()
+    breaker.reset()
+    faults.reset_fault_history()
+
+
+class FakeReplica:
+    def __init__(self, rid):
+        self.rid = rid
+
+
+class FakeGroup:
+    """Just enough ReplicaGroup surface for the engine's verbs, with
+    scriptable failure modes."""
+
+    def __init__(self, n=1, restart_exc=None, spawn_exc=None,
+                 retire_exc=None):
+        self.rids = [f"r{i}" for i in range(n)]
+        self._next = n
+        self.calls = []
+        self.restart_exc = restart_exc
+        self.spawn_exc = spawn_exc
+        self.retire_exc = retire_exc
+
+    def alive(self):
+        return len(self.rids)
+
+    def live_replicas(self):
+        return [FakeReplica(r) for r in self.rids]
+
+    def spawn_replica(self):
+        self.calls.append(("spawn",))
+        if self.spawn_exc is not None:
+            raise self.spawn_exc
+        rid = f"r{self._next}"
+        self._next += 1
+        self.rids.append(rid)
+        return FakeReplica(rid)
+
+    def retire(self, rid, reason="scale_down"):
+        self.calls.append(("retire", rid, reason))
+        if self.retire_exc is not None:
+            raise self.retire_exc
+        self.rids.remove(rid)
+
+    def restart(self, rid):
+        self.calls.append(("restart", rid))
+        if self.restart_exc is not None:
+            raise self.restart_exc
+        return FakeReplica(rid)
+
+
+def _sig(t, *, burn=0.0, bvel=0.0, depth=0.0, per_replica=None,
+         flaps=0, goodput=1.0, health=None, incidents=()):
+    """A FleetSignals-shaped bundle with a scripted clock."""
+    return SimpleNamespace(
+        at_s=float(t),
+        slo_burn={"carol": float(burn)} if burn else {},
+        slo_burn_velocity={"carol": float(bvel)} if bvel else {},
+        queue_depth=dict(per_replica or {}),
+        queue_depth_total=float(depth),
+        breaker_flaps={"serve": int(flaps)} if flaps else {},
+        goodput_overall=float(goodput),
+        health=dict(health or {}),
+        incidents=list(incidents),
+    )
+
+
+def _engine(group=None, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("cooldown_s", 1.0)
+    kw.setdefault("up_ticks", 2)
+    kw.setdefault("down_ticks", 3)
+    return scaler.ScalerEngine(group or FakeGroup(2), **kw)
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+class TestRules:
+    def test_slo_burn_scales_up(self, telemetry):
+        g = FakeGroup(1)
+        eng = _engine(g)
+        assert eng.tick(_sig(0.0, burn=3.0))["reason"] \
+            == "hysteresis_pending"
+        rec = eng.tick(_sig(0.1, burn=3.0))
+        assert rec["action"] == "scale_up"
+        assert rec["rule"] == "slo_burn"
+        assert rec["replica"] == "r1"
+        assert g.alive() == 2
+
+    def test_burn_velocity_needs_warm_burn(self, telemetry):
+        eng = _engine(FakeGroup(1))
+        # rising velocity over COLD burn: not a firing rule (a noisy
+        # derivative alone must not spawn); depth keeps idle quiet
+        rec = eng.tick(_sig(0.0, burn=0.1, bvel=2.0, depth=5))
+        assert rec["action"] is None and rec["rule"] is None
+        assert rec["reason"] == "idle"
+        # same velocity with burn already warm fires
+        eng2 = _engine(FakeGroup(1))
+        eng2.tick(_sig(0.0, burn=0.6, bvel=2.0, depth=5))
+        rec = eng2.tick(_sig(0.1, burn=0.6, bvel=2.0, depth=5))
+        assert rec["action"] == "scale_up"
+        assert rec["rule"] == "burn_velocity"
+
+    def test_queue_velocity_from_depth_slope(self, telemetry):
+        eng = _engine(FakeGroup(1), queue_velocity=10.0,
+                      depth_high=1e9)
+        eng.tick(_sig(0.0, depth=5))
+        # 45 queued in 1s = 45/s > 10/s threshold, two ticks in a row
+        eng.tick(_sig(1.0, depth=50))
+        rec = eng.tick(_sig(2.0, depth=95))
+        assert rec["action"] == "scale_up"
+        assert rec["rule"] == "queue_velocity"
+        assert rec["inputs"]["queue_velocity"] == pytest.approx(45.0)
+
+    def test_queue_depth_per_replica(self, telemetry):
+        g = FakeGroup(2)
+        eng = _engine(g, depth_high=8.0, queue_velocity=1e9)
+        eng.tick(_sig(0.0, depth=20))  # 10/replica > 8
+        rec = eng.tick(_sig(0.1, depth=20))
+        assert rec["action"] == "scale_up"
+        assert rec["rule"] == "queue_depth"
+        # 2 replicas at depth 14 = 7/replica: under threshold
+        eng2 = _engine(FakeGroup(2), depth_high=8.0,
+                       queue_velocity=1e9)
+        eng2.tick(_sig(0.0, depth=14))
+        rec = eng2.tick(_sig(0.1, depth=14))
+        assert rec["action"] is None
+
+    def test_idle_scales_down_after_window(self, telemetry):
+        g = FakeGroup(3)
+        eng = _engine(g, down_ticks=3)
+        for i in range(2):
+            rec = eng.tick(_sig(i * 0.1, depth=0))
+            assert rec["action"] is None
+            assert rec["reason"] == "hysteresis_pending"
+        rec = eng.tick(_sig(0.2, depth=0))
+        assert rec["action"] == "scale_down"
+        assert rec["rule"] == "idle"
+        assert g.alive() == 2
+
+    def test_replace_fires_on_down_health(self, telemetry):
+        g = FakeGroup(2)
+        eng = _engine(g)
+        eng.tick(_sig(0.0, health={"r0": "down", "r1": "up"}))
+        rec = eng.tick(_sig(0.1, health={"r0": "down", "r1": "up"}))
+        assert rec["action"] == "replace"
+        assert rec["rule"] == "replica_down"
+        assert rec["replica"] == "r0"
+        assert ("restart", "r0") in g.calls
+
+    def test_replace_wins_priority_over_scale_up(self, telemetry):
+        g = FakeGroup(2)
+        eng = _engine(g)
+        s = _sig(0.0, burn=5.0, health={"r1": "stale"})
+        eng.tick(s)
+        rec = eng.tick(_sig(0.1, burn=5.0,
+                            health={"r1": "stale"}))
+        assert rec["action"] == "replace"
+        assert rec["replica"] == "r1"
+
+    def test_replace_never_resurrects_a_retired_rid(self, telemetry):
+        g = FakeGroup(3)
+        eng = _engine(g, down_ticks=1)
+        rec = eng.tick(_sig(0.0, depth=0))
+        assert rec["action"] == "scale_down"
+        retired = rec["replica"]
+        assert retired in eng.snapshot()["retired"]
+        # the drained replica's heartbeat goes stale as it dies — the
+        # replace rule must not flap it back up (depth keeps the idle
+        # rule quiet so NO rule fires here)
+        rec = eng.tick(_sig(5.0, depth=5, health={retired: "down"}))
+        assert rec["action"] is None and rec["rule"] is None
+        assert ("restart", retired) not in g.calls
+
+
+# ---------------------------------------------------------------------------
+# hysteresis / cooldown / bounds / victim
+# ---------------------------------------------------------------------------
+
+class TestStability:
+    def test_hysteresis_pending_carries_streak(self, telemetry):
+        eng = _engine(FakeGroup(1), up_ticks=3)
+        rec = eng.tick(_sig(1.0, burn=3.0))
+        assert rec["reason"] == "hysteresis_pending"
+        assert rec["streak"] == 1 and rec["pending_s"] == 0.0
+        rec = eng.tick(_sig(1.5, burn=3.0))
+        assert rec["streak"] == 2
+        assert rec["pending_s"] == pytest.approx(0.5)
+
+    def test_streak_resets_when_winner_changes(self, telemetry):
+        eng = _engine(FakeGroup(1), up_ticks=2)
+        eng.tick(_sig(0.0, burn=3.0))          # scale_up streak 1
+        eng.tick(_sig(0.1))                    # idle: streak resets
+        rec = eng.tick(_sig(0.2, burn=3.0))    # back to streak 1
+        assert rec["reason"] == "hysteresis_pending"
+        assert rec["streak"] == 1
+
+    def test_cooldown_after_action(self, telemetry):
+        g = FakeGroup(1)
+        eng = _engine(g, cooldown_s=2.0)
+        eng.tick(_sig(0.0, burn=3.0))
+        assert eng.tick(_sig(0.1, burn=3.0))["action"] == "scale_up"
+        # rule still fires + full hysteresis, but inside the window
+        eng.tick(_sig(0.2, burn=3.0))
+        rec = eng.tick(_sig(0.3, burn=3.0))
+        assert rec["action"] is None
+        assert rec["reason"] == "cooldown"
+        assert g.alive() == 2
+        # past the window (streak already built through the cooldown
+        # ticks) it acts again
+        assert eng.tick(_sig(2.2, burn=3.0))["action"] == "scale_up"
+        assert g.alive() == 3
+
+    def test_max_bound_is_typed(self, telemetry):
+        eng = _engine(FakeGroup(4), max_replicas=4)
+        eng.tick(_sig(0.0, burn=3.0))
+        rec = eng.tick(_sig(0.1, burn=3.0))
+        assert rec["action"] is None
+        assert rec["reason"] == "at_bound"
+
+    def test_min_bound_is_typed(self, telemetry):
+        eng = _engine(FakeGroup(1), min_replicas=1, down_ticks=2)
+        eng.tick(_sig(0.0))
+        rec = eng.tick(_sig(0.1))
+        assert rec["action"] is None
+        assert rec["reason"] == "at_bound"
+
+    def test_victim_is_least_loaded_ties_to_newest(self, telemetry):
+        g = FakeGroup(3)
+        eng = _engine(g, down_ticks=1)
+        # r1 carries depth: victim is the least-loaded of r0/r2, and
+        # the depth tie between them breaks to the NEWEST (r2)
+        rec = eng.tick(_sig(0.0, depth=0.5,
+                            per_replica={"r1": 0.5}))
+        assert rec["action"] == "scale_down"
+        assert rec["replica"] == "r2"
+        assert g.rids == ["r0", "r1"]
+
+    def test_flap_storm_yields_zero_actions(self, telemetry):
+        g = FakeGroup(2)
+        eng = _engine(g, up_ticks=2, down_ticks=100)
+        for i in range(40):
+            hot = i % 2 == 0
+            eng.tick(_sig(i * 0.05,
+                          burn=5.0 if hot else 0.0,
+                          flaps=12 if hot else 0,
+                          goodput=0.3 if hot else 1.0))
+        snap = eng.snapshot()
+        assert snap["actions"] == {}
+        assert g.calls == []
+        # every tick flips the winner (hot = scale_up, cold = idle
+        # scale_down), so no streak ever builds: every single one of
+        # the 40 decisions is a typed hysteresis_pending no-op
+        assert set(snap["noops"]) <= set(scaler.NOOP_REASONS)
+        assert snap["noops"]["hysteresis_pending"] == 40
+
+
+# ---------------------------------------------------------------------------
+# verb failures demote to typed no-ops
+# ---------------------------------------------------------------------------
+
+class TestVerbFailures:
+    def test_restart_not_dead_yet_is_replace_pending(self, telemetry):
+        g = FakeGroup(2, restart_exc=ValueError("r0 is not DEAD"))
+        eng = _engine(g)
+        eng.tick(_sig(0.0, health={"r0": "stale"}))
+        rec = eng.tick(_sig(0.1, health={"r0": "stale"}))
+        assert rec["action"] is None
+        assert rec["reason"] == "replace_pending"
+        assert "error" not in rec
+
+    def test_spawn_blowup_is_spawn_failed(self, telemetry):
+        g = FakeGroup(1, spawn_exc=RuntimeError("no slots"))
+        eng = _engine(g)
+        eng.tick(_sig(0.0, burn=3.0))
+        rec = eng.tick(_sig(0.1, burn=3.0))
+        assert rec["action"] is None
+        assert rec["reason"] == "spawn_failed"
+        assert "no slots" in rec["error"]
+
+    def test_retire_blowup_is_retire_failed(self, telemetry):
+        g = FakeGroup(2, retire_exc=RuntimeError("draining"))
+        eng = _engine(g, down_ticks=1)
+        rec = eng.tick(_sig(0.0))
+        assert rec["action"] is None
+        assert rec["reason"] == "retire_failed"
+
+
+# ---------------------------------------------------------------------------
+# decision records / snapshot / journal
+# ---------------------------------------------------------------------------
+
+class TestDecisionRecords:
+    def test_record_carries_full_input_vector(self, telemetry):
+        eng = _engine(FakeGroup(2))
+        rec = eng.tick(_sig(1.0, burn=0.4, bvel=0.1, depth=3,
+                            flaps=2, goodput=0.9))
+        for k in ("t", "action", "rule", "reason", "replica",
+                  "incident_id", "pending_s", "streak", "inputs"):
+            assert k in rec
+        inp = rec["inputs"]
+        assert inp["burn_max"] == pytest.approx(0.4)
+        assert inp["burn_velocity_max"] == pytest.approx(0.1)
+        assert inp["queue_depth_total"] == 3
+        assert inp["breaker_flaps_max"] == 2
+        assert inp["goodput"] == pytest.approx(0.9)
+        assert inp["alive"] == 2
+        assert (inp["min"], inp["max"]) == (1, 4)
+
+    def test_incident_affinity_links_the_open_incident(self,
+                                                       telemetry):
+        eng = _engine(FakeGroup(1))
+        incs = [{"rule": "slo_burn", "id": "inc-7-1"},
+                {"rule": "goodput_collapse", "id": "inc-7-2"}]
+        eng.tick(_sig(0.0, burn=3.0, incidents=incs))
+        rec = eng.tick(_sig(0.1, burn=3.0, incidents=incs))
+        assert rec["action"] == "scale_up"
+        assert rec["incident_id"] == "inc-7-1"
+
+    def test_decision_events_reach_obs(self, telemetry):
+        eng = _engine(FakeGroup(1))
+        eng.tick(_sig(0.0, burn=3.0))
+        eng.tick(_sig(0.1, burn=3.0))
+        evs = [e for e in obs.events() if e["op"] == "scaler"]
+        assert [e["decision"] for e in evs] == ["noop", "scale_up"]
+        assert evs[0]["reason"] == "hysteresis_pending"
+        assert evs[1]["rule"] == "slo_burn"
+        assert "inputs" in evs[1]
+        assert obs.counter_value("scaler_action", action="scale_up",
+                                 rule="slo_burn") == 1
+
+    def test_snapshot_shape_and_bounded_tail(self, telemetry):
+        eng = _engine(FakeGroup(1))
+        for i in range(scaler.MAX_DECISIONS + 10):
+            eng.tick(_sig(i * 0.1))
+        snap = eng.snapshot()
+        assert snap["schema"] == scaler.SCHEMA
+        assert snap["armed"] is True and snap["running"] is False
+        assert snap["ticks"] == scaler.MAX_DECISIONS + 10
+        assert len(snap["decisions"]) == scaler.MAX_DECISIONS
+        assert snap["replicas"] == {"min": 1, "max": 4, "alive": 1}
+        assert snap["config"]["up_ticks"] == 2
+        assert snap["noops"]["at_bound"] > 0
+
+    def test_decisions_are_journal_durable(self, telemetry, tmp_path):
+        obs_journal._reset_for_tests()
+        obs.configure(journal_dir=str(tmp_path))
+        try:
+            eng = _engine(FakeGroup(1))
+            eng.tick(_sig(0.0, burn=3.0))
+            eng.tick(_sig(0.1, burn=3.0))
+            records, skipped = obs_journal.read_pack(str(tmp_path))
+        finally:
+            obs.configure(journal_dir="")
+            obs_journal._reset_for_tests()
+        assert skipped == 0
+        sc = [r for r in records if r["op"] == "scaler"]
+        assert [r["decision"] for r in sc] == ["noop", "scale_up"]
+        assert sc[1]["data"]["rule"] == "slo_burn"
+        assert sc[1]["data"]["inputs"]["burn_max"] \
+            == pytest.approx(3.0)
+
+
+# ---------------------------------------------------------------------------
+# env knobs / registry / lifecycle
+# ---------------------------------------------------------------------------
+
+class TestKnobsAndRegistry:
+    def test_env_parsing_falls_back_on_garbage(self, monkeypatch):
+        monkeypatch.setenv(scaler.BURN_ENV, "not-a-float")
+        monkeypatch.setenv(scaler.MAX_ENV, "-3")
+        monkeypatch.setenv(scaler.UP_TICKS_ENV, "5")
+        eng = scaler.ScalerEngine(FakeGroup(1))
+        assert eng.burn == scaler.DEFAULT_BURN
+        assert eng.max_replicas == scaler.DEFAULT_MAX
+        assert eng.up_ticks == 5
+
+    def test_armed_by_env_truthy_forms(self, monkeypatch):
+        for raw, want in [("1", True), ("true", True), ("YES", True),
+                          (" on ", True), ("0", False), ("", False),
+                          ("off", False)]:
+            monkeypatch.setenv(scaler.ARM_ENV, raw)
+            assert scaler.armed_by_env() is want
+        monkeypatch.delenv(scaler.ARM_ENV)
+        assert scaler.armed_by_env() is False
+
+    def test_disarmed_shell_is_schema_stamped(self, telemetry):
+        snap = scaler.snapshot()
+        assert snap["schema"] == scaler.SCHEMA
+        assert snap["armed"] is False
+        assert snap["decisions"] == []
+        assert scaler.summary()["armed"] is False
+        assert scaler.armed() is False
+        assert obs.scaler_snapshot()["armed"] is False
+
+    def test_registry_serves_the_registered_engine(self, telemetry):
+        eng = _engine(FakeGroup(1))
+        scaler._register(eng)
+        eng.tick(_sig(0.0, burn=3.0))
+        assert scaler.armed() is True
+        assert scaler.engine() is eng
+        assert scaler.snapshot()["ticks"] == 1
+        assert obs.scaler_snapshot()["ticks"] == 1
+        assert obs.snapshot()["scaler"]["ticks"] == 1
+        scaler._unregister(eng)
+        assert scaler.engine() is None
+
+    def test_start_stop_thread_lifecycle(self, telemetry):
+        eng = _engine(FakeGroup(1))
+        eng.start(interval_s=30.0)   # ticks on its own clock; we only
+        try:                         # probe the thread lifecycle here
+            assert eng.snapshot()["running"] is True
+            names = [t.name for t in threading.enumerate()]
+            assert "veles-serve-scaler" in names
+            eng.start(interval_s=30.0)   # idempotent
+        finally:
+            eng.stop()
+        assert eng.snapshot()["running"] is False
+        names = [t.name for t in threading.enumerate()]
+        assert "veles-serve-scaler" not in names
+
+    def test_group_arms_and_disarms_the_scaler(self, telemetry):
+        """ReplicaGroup(scaler=True) registers the engine for the
+        /scaler route and the stats surface; stop() disarms it."""
+        with cluster.ReplicaGroup(
+                1, max_wait_ms=2.0, obs_port=-1, scaler=True,
+                scaler_tick_ms=60_000.0,
+                scaler_kwargs=dict(min_replicas=1, max_replicas=2),
+        ) as group:
+            assert scaler.armed() is True
+            assert scaler.engine().group is group
+            st = group.stats()["scaler"]
+            assert st["armed"] is True and st["running"] is True
+        assert scaler.armed() is False
+        assert scaler.snapshot()["armed"] is False
+
+    def test_group_default_is_disarmed(self, telemetry, monkeypatch):
+        monkeypatch.delenv(scaler.ARM_ENV, raising=False)
+        with cluster.ReplicaGroup(1, max_wait_ms=2.0,
+                                  obs_port=-1) as group:
+            assert scaler.armed() is False
+            assert group.stats()["scaler"] is None

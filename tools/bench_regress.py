@@ -173,6 +173,18 @@ DEFAULT_NOISE = [
     ("goodput saturation", 0.15),
     ("goodput p99", 0.40),
     ("goodput recovery", 0.30),
+    # the control-axis family (obs v7, tools/chaos.py --scale,
+    # SCALE_DETAILS.json): "scale p99 under ramp" is the inverse of a
+    # single order statistic measured across a deliberately-unpaced
+    # ~10x burst (chaos_phase-stamped anyway); "scale replica-seconds
+    # vs oracle" divides an integral of sampled alive-counts by a
+    # schedule built from one measured capacity number — scheduling
+    # jitter on both sides; "scale decision lag" is the inverse of
+    # one peak-to-first-spawn wall-clock sample on the 30 ms control
+    # cadence
+    ("scale p99 under ramp", 0.45),
+    ("scale replica-seconds", 0.30),
+    ("scale decision lag", 0.50),
 ]
 
 

@@ -55,6 +55,7 @@ MODULES = [
     "veles.simd_tpu.serve.admission",
     "veles.simd_tpu.serve.health",
     "veles.simd_tpu.serve.cluster",
+    "veles.simd_tpu.serve.scaler",
     "veles.simd_tpu.utils.config",
     "veles.simd_tpu.utils.memory",
     "veles.simd_tpu.utils.benchmark",

@@ -42,7 +42,10 @@ __all__ = ["start", "merge", "executable_lines", "table",
 # behavior.
 DEFAULT_FLOORS = {
     "veles/simd_tpu/obs": 60.0,
-    "veles/simd_tpu/serve": 60.0,
+    # bumped with the control axis (obs v7): serve/ gained scaler.py
+    # at ~95% suite coverage, so the aggregate floor can hold a
+    # little higher without flaking (subset lower bound: 84%)
+    "veles/simd_tpu/serve": 62.0,
 }
 
 

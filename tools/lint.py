@@ -924,6 +924,99 @@ def cluster_router_errors(tree, fname) -> list:
     return errors
 
 
+# --- control axis rule (serve/scaler.py, obs v7) ----------------------------
+# The autoscaler's whole claim is that every scaling decision is
+# explainable from its journaled input vector — which is only true if
+# the inputs it ACTS on are exactly the inputs it RECORDS.  So the
+# scaler reads cross-replica state through ONE contract
+# (``obs.signals()``) and acts through ONE surface (the ReplicaGroup
+# verbs).  In serve/scaler.py these are lint failures:
+#
+# * importing scrape machinery (``urllib`` / ``http`` / ``socket``) or
+#   calling ``parse_prometheus`` — a scaler that scrapes /metrics has
+#   a second, unrecorded view of the fleet;
+# * calling obs facade helpers beyond ``signals`` /
+#   ``record_decision`` / ``count`` / ``gauge`` (alias-tracked) — in
+#   particular ``obs.snapshot()`` / ``obs.fleet_series()`` side-door
+#   reads that bypass the typed contract;
+# * touching a ``.server`` attribute or calling ``.submit(...)`` —
+#   direct Server mutation bypasses the group verbs' locking and
+#   lifecycle accounting;
+# * calling a ``self.group.<verb>`` outside the approved verb set
+#   (spawn_replica / retire / restart / drain / kill / alive /
+#   live_replicas) — an unapproved verb is an action the decision
+#   event never explains.
+
+_SCALER_RULE_FILE = "veles/simd_tpu/serve/scaler.py"
+_SCALER_OBS_ALLOWED = {"signals", "record_decision", "count", "gauge"}
+_SCALER_GROUP_VERBS = {"spawn_replica", "retire", "restart", "drain",
+                       "kill", "alive", "live_replicas"}
+_SCALER_BANNED_IMPORTS = {"urllib", "http", "socket", "requests"}
+
+
+def scaler_control_errors(tree, fname) -> list:
+    """The rule body on a parsed module (separated so tests can feed
+    synthetic sources).  Returns human-readable error strings."""
+    errors = []
+    obs_names = _serve_aliases(tree)[5]
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            mods = [a.name for a in node.names] \
+                if isinstance(node, ast.Import) \
+                else ([node.module] if node.module else [])
+            for m in mods:
+                if m.split(".")[0] in _SCALER_BANNED_IMPORTS:
+                    errors.append(
+                        f"{fname}:{node.lineno}: scrape machinery "
+                        f"import ({m}) in the scaler — the control "
+                        "loop reads fleet state only through the "
+                        "typed obs.signals() contract, never raw "
+                        "/metrics")
+            continue
+        if isinstance(node, ast.Attribute) and node.attr == "server":
+            errors.append(
+                f"{fname}:{node.lineno}: direct Server access "
+                "(.server) in the scaler — act only through the "
+                "ReplicaGroup verbs, which own locking and lifecycle "
+                "accounting")
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        chain = _dotted_chain(f)
+        if f.attr == "parse_prometheus":
+            errors.append(
+                f"{fname}:{node.lineno}: scrape parsing "
+                f"({chain or '...'}(...)) in the scaler — read "
+                "obs.signals() instead")
+        elif f.attr == "submit":
+            errors.append(
+                f"{fname}:{node.lineno}: request submission "
+                f"({chain or '...'}(...)) in the scaler — the "
+                "control loop never dispatches work")
+        elif isinstance(f.value, ast.Name) \
+                and f.value.id in obs_names \
+                and f.attr not in _SCALER_OBS_ALLOWED:
+            errors.append(
+                f"{fname}:{node.lineno}: obs read outside the "
+                f"control-axis surface ({f.value.id}.{f.attr}(...)) "
+                "— the scaler may call only obs.signals / "
+                "record_decision / count / gauge, so its recorded "
+                "input vector IS its whole view of the fleet")
+        elif chain is not None and chain.startswith("self.group.") \
+                and chain.count(".") == 2 \
+                and f.attr not in _SCALER_GROUP_VERBS:
+            errors.append(
+                f"{fname}:{node.lineno}: unapproved group call "
+                f"({chain}(...)) in the scaler — actions go through "
+                "the ReplicaGroup verb set "
+                f"({', '.join(sorted(_SCALER_GROUP_VERBS))}) so "
+                "every action is a journaled lifecycle edge")
+    return errors
+
+
 # --- fleet funnel rule (serve/) ---------------------------------------------
 # PR 16's fleet axis (obs v5) has the same one-funnel shape as the
 # router rule above: ``ReplicaGroup._collect_fleet_sample`` is the ONE
@@ -1625,6 +1718,12 @@ def compute_module_lint(files) -> int:
                 # the front router additionally funnels every replica
                 # submission through its one guarded path
                 for msg in cluster_router_errors(tree, str(f)):
+                    print(msg)
+                    failures += 1
+            if rel == _SCALER_RULE_FILE:
+                # the control loop reads only obs.signals() and acts
+                # only through the ReplicaGroup verbs (obs v7)
+                for msg in scaler_control_errors(tree, str(f)):
                     print(msg)
                     failures += 1
             for msg in artifact_serialization_errors(tree, str(f)):

@@ -311,6 +311,24 @@ def build_schedule(rng, n_requests: int, rate_hz: float,
     return schedule
 
 
+def build_ramp_schedule(rng, phases, tenants=DEFAULT_TENANTS,
+                        deadline_ms: float | None = None) -> list:
+    """A multi-phase (diurnal) schedule: ``phases`` is
+    ``[(duration_s, rate_hz), ...]`` and each phase contributes
+    ``duration_s * rate_hz`` arrivals with exponential inter-arrival
+    gaps at its own rate — ``[(10, 5), (10, 50), (10, 5)]`` is the
+    ~10x ramp-up-and-back the autoscale chaos campaign drives while
+    the group resizes itself.  Same request mix, tenants, and
+    ``(gap_seconds, Request)`` contract as :func:`build_schedule`."""
+    schedule = []
+    for duration_s, rate_hz in phases:
+        n = max(1, int(round(float(duration_s) * float(rate_hz))))
+        schedule.extend(build_schedule(
+            rng, n, float(rate_hz), tenants=tenants,
+            deadline_ms=deadline_ms))
+    return schedule
+
+
 def _oracle_answer(req: serve.Request):
     from veles.simd_tpu.serve.server import _oracle_call
 

@@ -22,8 +22,11 @@ its three routes:
 collector feeds) — per-replica health/staleness/queue-depth/breaker
 rows, goodput by shape class, SLO burn + velocity, and a unicode
 sparkline over the last-N windowed samples of each per-replica series.
-Point it at the ROUTER's aggregation endpoint; a lone server answers
-with an empty fleet.
+When the process runs an armed autoscaler the frame adds the control
+axis from ``/scaler`` (obs v7): tick count, alive vs bounds, cooldown,
+per-action/no-op tallies, and the last few decisions.  Point it at the
+ROUTER's aggregation endpoint; a lone server answers with an empty
+fleet.
 
 One shot by default; ``--watch N`` redraws every N seconds until
 interrupted (``--fleet`` included).  rc=1 when the endpoint is
@@ -265,6 +268,42 @@ def render_fleet(base_url: str) -> tuple:
                 _fmt_s(journal.get("lag_s"))))
     else:
         lines.append("journal: disarmed")
+    # -- the control axis (obs v7): the autoscaler's own route ------
+    # (a pre-v7 endpoint 404s here — render nothing rather than die)
+    try:
+        s_code, s_body = fetch(base_url + "/scaler")
+        scaler = json.loads(s_body) if s_code == 200 else None
+    except Exception:  # noqa: BLE001 — optional route
+        scaler = None
+    if scaler and scaler.get("armed"):
+        rep = scaler.get("replicas") or {}
+        lines.append(
+            "scaler: armed  ticks=%-7s alive=%s [%s..%s]  "
+            "cooldown=%ss" % (
+                scaler.get("ticks"), rep.get("alive"),
+                rep.get("min"), rep.get("max"),
+                "%g" % scaler.get("cooldown_remaining_s", 0.0)))
+        acts = scaler.get("actions") or {}
+        noops = scaler.get("noops") or {}
+        if acts or noops:
+            lines.append("  actions " + " ".join(
+                "%s=%s" % kv for kv in sorted(acts.items())) +
+                "  noops " + " ".join(
+                "%s=%s" % kv for kv in sorted(noops.items())))
+        last = scaler.get("last_action")
+        if last:
+            lines.append(
+                "  last action %-10s rule=%-14s replica=%-6s "
+                "incident=%s" % (
+                    last.get("action"), last.get("rule"),
+                    last.get("replica"), last.get("incident_id")))
+        for d in (scaler.get("decisions") or [])[-5:]:
+            lines.append(
+                "  tick %-10s %-10s rule=%-14s reason=%s" % (
+                    "%g" % d.get("t", 0.0), d.get("action") or "-",
+                    d.get("rule") or "-", d.get("reason")))
+    elif scaler is not None:
+        lines.append("scaler: disarmed")
     series = sig.get("series") or {}
     if series:
         lines.append("series (last-N window):")

@@ -21,7 +21,11 @@ replicas included — into one wall-clock-ordered fleet timeline, then:
 * ``--postmortem [ID|all]`` — renders each incident's story purely
   from on-disk records: the trigger detail at open, every breaker
   transition / replica lifecycle edge / fault-policy step that landed
-  while it was open, and the close reason.
+  while it was open, the close reason — and, since obs v7, the
+  control axis: scaler decisions that carried this incident's id are
+  rendered as an action timeline plus the signal deltas across the
+  effect window, so a scale-up that closed an ``slo_burn`` incident
+  reads as one causal incident → action → effect story.
 
 Filters compose: ``--rid`` / ``--replica`` / ``--site`` / ``--op`` /
 ``--kind`` / ``--since`` / ``--until`` (wall-clock seconds) /
@@ -119,10 +123,57 @@ def incidents_from(records: list) -> list:
     return done + list(opened.values())
 
 
+def scaler_actions(records: list, incident_id) -> list:
+    """Journaled scaler *actions* (noop ticks excluded) whose decision
+    event carried this incident's id — the control-axis half of the
+    incident's story."""
+    out = []
+    for r in records:
+        if r.get("kind") != "decision" or r.get("op") != "scaler":
+            continue
+        if r.get("decision") in (None, "noop"):
+            continue
+        if (r.get("data") or {}).get("incident_id") == incident_id:
+            out.append(r)
+    return out
+
+
+# the input-vector keys whose before→after deltas summarize whether a
+# scaling action actually MOVED the signals it fired on
+EFFECT_KEYS = ("burn_max", "queue_depth_total", "queue_velocity",
+               "alive", "goodput")
+
+
+def scaler_effect(records: list, actions: list, t_end: float) -> list:
+    """The effect window: the input vector the first linked action saw
+    vs the last journaled scaler tick at/before the incident's close
+    (every scaler decision event — noops included — carries the full
+    inputs, so the journal alone answers "did it work?").  Returns
+    ``[(key, before, after), ...]`` for :data:`EFFECT_KEYS`."""
+    if not actions:
+        return []
+    before = (actions[0].get("data") or {}).get("inputs") or {}
+    after = None
+    for r in records:
+        if r.get("kind") != "decision" or r.get("op") != "scaler":
+            continue
+        inputs = (r.get("data") or {}).get("inputs")
+        if not inputs:
+            continue
+        if r.get("t_wall", 0.0) <= t_end \
+                and r.get("t_wall", 0.0) >= actions[-1].get("t_wall",
+                                                            0.0):
+            after = inputs
+    if after is None:
+        after = (actions[-1].get("data") or {}).get("inputs") or {}
+    return [(k, before.get(k), after.get(k)) for k in EFFECT_KEYS]
+
+
 def postmortem(records: list, incident: dict) -> str:
     """One incident's story from the pack: trigger, the
-    breaker/lifecycle/fault activity inside its open window, close
-    reason."""
+    breaker/lifecycle/fault activity inside its open window, the
+    linked scaler action timeline + effect-window signal deltas
+    (obs v7), close reason."""
     o, c = incident["open"], incident["close"]
     t0 = o.get("t_wall", 0.0)
     t1 = c.get("t_wall") if c else max(
@@ -141,6 +192,22 @@ def postmortem(records: list, incident: dict) -> str:
     lines.append(f"  activity during ({len(activity)} records):")
     for r in activity:
         lines.append("    " + _record_line(r, base_wall=t0))
+    acts = scaler_actions(records, incident["id"])
+    if acts:
+        lines.append(f"  scaler actions linked ({len(acts)}):")
+        for r in acts:
+            d = r.get("data") or {}
+            lines.append(
+                f"    +{r.get('t_wall', 0.0) - t0:7.3f}s  "
+                f"{r.get('decision')}  rule={d.get('rule')}  "
+                f"replica={d.get('replica')}")
+        effect = scaler_effect(records, acts, t1)
+        if effect:
+            lines.append("  effect window (signals across the "
+                         "action(s)):")
+            for key, before, after in effect:
+                lines.append(f"    {key:<20} "
+                             f"{_num(before)} -> {_num(after)}")
     if c is not None:
         lines.append(f"  closed  {_stamp(t1)}  "
                      f"reason={(c.get('data') or {}).get('reason')}  "
@@ -148,6 +215,14 @@ def postmortem(records: list, incident: dict) -> str:
     else:
         lines.append("  still open when the journal ended")
     return "\n".join(lines)
+
+
+def _num(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.3f}"
+    return str(v)
 
 
 # -- Chrome-trace export -----------------------------------------------------

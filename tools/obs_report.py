@@ -14,7 +14,9 @@ degraded-batch counts, latest breaker states, and the request-axis +
 per-tenant SLO summaries (BENCH_DETAILS mode gets the per-config
 ``serve_*`` counter block), and a Fleet section when the snapshot
 carries the fleet axis (obs v5: the ``ReplicaGroup`` collector's
-per-replica windowed series — last value, delta, flap count), and a
+per-replica windowed series — last value, delta, flap count), a
+Control section when it carries the obs v7 ``scaler`` block (the
+autoscaler's action/no-op tallies and decision tail), and a
 goodput-recovery scoreboard for BENCH_DETAILS entries carrying
 ``recovered`` evidence (``GOODPUT_DETAILS.json``: padding waste
 before/after per shape class).  ``--prometheus`` converts a full
@@ -222,6 +224,53 @@ def _history_section(snap) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _control_section(snap) -> str:
+    """The control axis (obs v7): the autoscaler block
+    ``obs.snapshot()`` embeds — armed/running state, bounds, action
+    and typed-no-op tallies, the last committed action, and the tail
+    of the decision ledger.  Pre-v7 snapshots simply lack the key."""
+    scaler = snap.get("scaler")
+    if not isinstance(scaler, dict):
+        return ""
+    lines = ["", "control (obs v7):"]
+    if not scaler.get("armed"):
+        lines.append("  scaler disarmed (ReplicaGroup(scaler=True) "
+                     "or $VELES_SIMD_SCALER arms it)")
+        return "\n".join(lines) + "\n"
+    rep = scaler.get("replicas") or {}
+    lines.append(
+        "  scaler armed  running=%s  ticks=%s  alive=%s in "
+        "[%s..%s]  cooldown_remaining=%ss" % (
+            scaler.get("running"), scaler.get("ticks"),
+            rep.get("alive"), rep.get("min"), rep.get("max"),
+            scaler.get("cooldown_remaining_s")))
+    acts = scaler.get("actions") or {}
+    noops = scaler.get("noops") or {}
+    if acts:
+        lines.append("  actions: " + "  ".join(
+            "%s=%s" % kv for kv in sorted(acts.items())))
+    if noops:
+        lines.append("  no-ops:  " + "  ".join(
+            "%s=%s" % kv for kv in sorted(noops.items())))
+    last = scaler.get("last_action")
+    if last:
+        lines.append(
+            "  last action: %s rule=%s replica=%s incident=%s" % (
+                last.get("action"), last.get("rule"),
+                last.get("replica"), last.get("incident_id")))
+    tail = scaler.get("decisions") or []
+    if tail:
+        lines.append("  decisions (last %d):" % min(len(tail), 8))
+        for d in tail[-8:]:
+            lines.append(
+                "    t=%-12s %-10s rule=%-14s reason=%-18s "
+                "replica=%s" % (
+                    "%g" % (d.get("t") or 0.0),
+                    d.get("action") or "-", d.get("rule") or "-",
+                    d.get("reason"), d.get("replica") or "-"))
+    return "\n".join(lines) + "\n"
+
+
 def _bench_serving_lines(counters: dict, indent="  ") -> list:
     """The BENCH_DETAILS-mode serving block: a per-config tally of
     the ``serve_*`` counters the telemetry dict embeds."""
@@ -359,6 +408,7 @@ def main(argv=None) -> int:
     sys.stdout.write(_serving_section(data))
     sys.stdout.write(_fleet_section(data))
     sys.stdout.write(_history_section(data))
+    sys.stdout.write(_control_section(data))
     return 0
 
 

@@ -109,6 +109,7 @@ separate layers.
 from __future__ import annotations
 
 import os
+import sys
 import time
 
 from veles.simd_tpu.obs import compile as _compile
@@ -142,7 +143,7 @@ __all__ = [
     "request_summary",
     "signals", "fleet_record", "fleet_series", "stitch_fleet_trace",
     "journal_stats", "journal_cursor", "journal_tail",
-    "incidents_snapshot",
+    "incidents_snapshot", "scaler_snapshot",
     "install_compile_listeners",
     "instrumented_jit", "resources", "caches", "register_cache",
     "dump_debug_bundle",
@@ -404,12 +405,13 @@ def signals() -> _timeseries.FleetSignals:
     the SLO accounts; cheap enough to poll on the collector cadence.
     Since obs v6 the bundle also carries the history axis: the open
     incidents (:mod:`veles.simd_tpu.obs.incidents`) and journal
-    health (armed/records/dropped/``lag_s``)."""
+    health (armed/records/dropped/``lag_s``); since obs v7, the
+    control axis summary (:func:`scaler_snapshot`'s compact form)."""
     now = time.monotonic()
     return _timeseries.FleetSignals.from_sources(
         _fleet, _registry.snapshot(), _requests.slo_snapshot(),
         now=now, incidents=_incidents.open_incidents(),
-        journal=_journal.stats(now))
+        journal=_journal.stats(now), scaler=_scaler_summary())
 
 
 def journal_stats() -> dict:
@@ -437,6 +439,48 @@ def incidents_snapshot() -> dict:
     route body (:mod:`veles.simd_tpu.obs.incidents`): schema stamp,
     tick count, open/closed tallies, and the typed incident records."""
     return _incidents.snapshot()
+
+
+# mirrored from veles.simd_tpu.serve.scaler.SCHEMA — the obs layer
+# must stay importable without serve (layering, lint-enforced), so the
+# disarmed /scaler shell stamps the schema from this literal
+_SCALER_SCHEMA = "veles-simd-scaler-v1"
+
+
+def _scaler_module():
+    """The serve-layer scaler module IF something already imported it —
+    obs never imports serve (layering), so control-axis state is read
+    through ``sys.modules`` or not at all."""
+    return sys.modules.get("veles.simd_tpu.serve.scaler")
+
+
+def scaler_snapshot() -> dict:
+    """The control axis (obs v7) — the ``/scaler`` route body: the
+    registered :class:`veles.simd_tpu.serve.scaler.ScalerEngine`'s
+    schema-stamped state (tick count, per-action streaks, cooldown,
+    bounds, recent decisions), or the disarmed shell when no serve
+    layer / no armed scaler is in this process."""
+    mod = _scaler_module()
+    if mod is not None:
+        try:
+            return mod.snapshot()
+        except Exception:  # noqa: BLE001 — a wedged engine must not
+            pass  # take down the scrape endpoint
+    return {"schema": _SCALER_SCHEMA, "armed": False, "running": False,
+            "ticks": 0, "actions": {}, "noops": {},
+            "last_action": None, "decisions": []}
+
+
+def _scaler_summary() -> dict:
+    """The compact control-axis summary embedded in :func:`signals`."""
+    mod = _scaler_module()
+    if mod is not None:
+        try:
+            return mod.summary()
+        except Exception:  # noqa: BLE001
+            pass
+    return {"armed": False, "running": False, "ticks": 0,
+            "actions": {}, "last_action": None}
 
 
 def record_decision(op: str, decision: str, **fields) -> None:
@@ -511,6 +555,7 @@ def snapshot() -> dict:
     snap["fleet"] = _fleet.snapshot()
     snap["journal"] = _journal.stats()
     snap["incidents"] = _incidents.snapshot()
+    snap["scaler"] = scaler_snapshot()
     snap["enabled"] = _enabled
     return snap
 

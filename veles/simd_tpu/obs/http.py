@@ -31,11 +31,17 @@ as the rest of the tooling):
 * ``GET /incidents`` — JSON: the incident engine's typed open→closed
   records (:mod:`veles.simd_tpu.obs.incidents`) — which rule fired,
   the trigger detail, the journal cursor and flight bundle captured
-  at open, and the close reason once quiet.
+  at open, and the close reason once quiet;
+* ``GET /scaler`` — JSON: the control axis
+  (:func:`veles.simd_tpu.obs.scaler_snapshot`): the registered
+  autoscaler engine's state — tick count, per-action streaks,
+  cooldown, bounds, and the recent decision records with their full
+  input vectors — or the disarmed shell when no scaler runs here.
 
-The JSON routes are schema-stamped (``veles-simd-signals-v2``,
-``veles-simd-requests-v1``, ``veles-simd-incidents-v1``) so a
-dashboard can detect contract drift instead of mis-parsing.
+The JSON routes are schema-stamped (``veles-simd-signals-v3``,
+``veles-simd-requests-v1``, ``veles-simd-incidents-v1``,
+``veles-simd-scaler-v1``) so a dashboard can detect contract drift
+instead of mis-parsing.
 
 Arming: :meth:`veles.simd_tpu.serve.Server.start` reads
 ``$VELES_SIMD_OBS_PORT`` (or its ``obs_port=`` argument; port 0 binds
@@ -147,12 +153,18 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 self._send(200, json.dumps(obs.incidents_snapshot(),
                                            indent=2, default=str),
                            "application/json")
+            elif path == "/scaler":
+                from veles.simd_tpu import obs
+
+                self._send(200, json.dumps(obs.scaler_snapshot(),
+                                           indent=2, default=str),
+                           "application/json")
             else:
                 self._send(404, json.dumps(
                     {"error": "unknown path",
                      "routes": ["/metrics", "/healthz",
                                 "/debug/requests", "/signals",
-                                "/incidents"]}),
+                                "/incidents", "/scaler"]}),
                     "application/json")
         except BrokenPipeError:
             pass        # scraper hung up mid-response: its problem

@@ -61,6 +61,7 @@ __all__ = [
     "JournalWriter",
     "armed", "journal_dir", "configure_dir", "set_replica", "replica",
     "emit", "emit_decision", "cursor", "tail", "stats",
+    "prune_foreign",
     "discover", "read_file", "read_pack",
 ]
 
@@ -264,6 +265,7 @@ _lock = threading.Lock()
 _configured_dir: str | None = None
 _replica: str | None = None
 _writer: JournalWriter | None = None
+_pruned_foreign = 0
 
 
 def journal_dir() -> str | None:
@@ -371,7 +373,7 @@ def stats(now: float | None = None) -> dict:
     w = _writer
     out = {"armed": armed(), "dir": journal_dir(),
            "records": 0, "dropped": 0, "rotations": 0, "pruned": 0,
-           "lag_s": None}
+           "pruned_foreign": _pruned_foreign, "lag_s": None}
     if w is None:
         return out
     s = w.stats()
@@ -384,13 +386,97 @@ def stats(now: float | None = None) -> dict:
     return out
 
 
+def _pid_alive(pid: int) -> bool:
+    """Liveness probe without touching the process: signal 0.  A pid we
+    cannot signal for *permission* reasons exists (someone else's
+    process in a shared pack) — treat it as alive; only a confirmed
+    ``ProcessLookupError`` counts as dead."""
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except (PermissionError, OSError):
+        return True
+    return True
+
+
+def prune_foreign(directory: str | None = None,
+                  max_total_bytes: int | None = None,
+                  live_pids=()) -> int:
+    """Reclaim dead-pid segments from a shared journal pack.
+
+    Per-writer rotation prunes own-pid files only, so segments from
+    killed subprocess replicas strand on disk forever.  The group
+    owner (the fleet collector thread) calls this to delete dead
+    writers' segments oldest-first (by mtime) until the *pack* total
+    is back under the ``$VELES_SIMD_JOURNAL_MAX_TOTAL_BYTES`` budget.
+    Never touches this process's own files, any pid in ``live_pids``,
+    or any pid that answers a signal-0 probe.  Returns the number of
+    files unlinked (also counted in ``stats()['pruned_foreign']``).
+    Never raises."""
+    global _pruned_foreign
+    try:
+        d = directory if directory is not None else journal_dir()
+        if d is None:
+            return 0
+        budget = int(max_total_bytes) if max_total_bytes \
+            else _env_int(MAX_TOTAL_BYTES_ENV, DEFAULT_MAX_TOTAL_BYTES)
+        protected = {os.getpid()}
+        protected.update(int(p) for p in live_pids)
+        entries = []  # (mtime, path, size, pid)
+        total = 0
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return 0
+        for name in names:
+            m = _FILE_RE.match(name)
+            if not m:
+                continue
+            path = os.path.join(d, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            total += st.st_size
+            pid = int(m.group(1))
+            if pid in protected:
+                continue
+            entries.append((st.st_mtime, path, st.st_size, pid))
+        if total <= budget:
+            return 0
+        alive_cache: dict = {}
+        entries.sort()
+        pruned = 0
+        for _, path, size, pid in entries:
+            if total <= budget:
+                break
+            if pid not in alive_cache:
+                alive_cache[pid] = _pid_alive(pid)
+            if alive_cache[pid]:
+                continue
+            try:
+                os.unlink(path)
+            except OSError:
+                continue
+            total -= size
+            pruned += 1
+        if pruned:
+            with _lock:
+                _pruned_foreign += pruned
+        return pruned
+    except Exception:  # noqa: BLE001 — reclamation never takes down
+        return 0  # the collector thread that calls it
+
+
 def _reset_for_tests() -> None:
     """Close and forget the process writer (files stay on disk)."""
-    global _writer, _replica
+    global _writer, _replica, _pruned_foreign
     with _lock:
         if _writer is not None:
             _writer.close()
             _writer = None
+        _pruned_foreign = 0
     _replica = None
 
 

@@ -51,8 +51,9 @@ __all__ = [
 
 # the /signals contract version: bumped whenever FleetSignals gains,
 # loses, or re-types a field, so dashboards can detect drift instead
-# of mis-parsing (obs v6 added incidents + journal)
-SIGNALS_SCHEMA = "veles-simd-signals-v2"
+# of mis-parsing (obs v6 added incidents + journal; obs v7 added
+# replica_count + birth_age_s + scaler)
+SIGNALS_SCHEMA = "veles-simd-signals-v3"
 
 FLEET_TICK_MS_ENV = "VELES_SIMD_FLEET_TICK_MS"
 FLEET_WINDOW_ENV = "VELES_SIMD_FLEET_WINDOW"
@@ -184,6 +185,19 @@ class FleetSeries:
             self._rings.clear()
             self.ticks = 0
 
+    def forget(self, replica: str) -> int:
+        """Drop every ring belonging to ``replica`` (returns how many
+        were dropped).  The collector calls this for replicas that
+        left group membership (``ReplicaGroup.retire``) — without it,
+        a retired replica's aging samples would read as a "stale"
+        replica in the signals forever."""
+        replica = str(replica)
+        with self._lock:
+            doomed = [k for k in self._rings if k[0] == replica]
+            for k in doomed:
+                del self._rings[k]
+            return len(doomed)
+
     # -- reads -------------------------------------------------------------
 
     def samples(self, replica: str, series: str) -> list:
@@ -272,8 +286,12 @@ class FleetSignals:
     ``health``            {replica: healthy|degraded|down|stale|unknown}
     ``staleness_s``       {replica: age of its newest sample}
     ``scrape_stale``      {replica: failed-scrape count (subprocess mode)}
+    ``replica_count``     {"up"/"draining"/"down": group membership now}
+    ``birth_age_s``       {replica: seconds since its Replica was born}
     ``incidents``         open incidents (obs v6 incident engine)
     ``journal``           journal health: armed/records/dropped/lag_s
+    ``scaler``            control-axis summary (obs v7): armed/ticks/
+                          actions/last_action
     ===================== ==================================================
     """
 
@@ -282,7 +300,8 @@ class FleetSignals:
                  "queue_depth_total", "occupancy", "breaker_open",
                  "breaker_flaps", "goodput", "goodput_overall",
                  "padding_waste", "health", "staleness_s",
-                 "scrape_stale", "incidents", "journal", "series")
+                 "scrape_stale", "replica_count", "birth_age_s",
+                 "incidents", "journal", "scaler", "series")
 
     def __init__(self, **kw):
         missing = [n for n in self.__slots__ if n not in kw]
@@ -297,15 +316,17 @@ class FleetSignals:
     def from_sources(cls, fleet: FleetSeries, registry_snapshot: dict,
                      slo_snapshot: dict, now: float,
                      incidents: list | None = None,
-                     journal: dict | None = None) -> "FleetSignals":
+                     journal: dict | None = None,
+                     scaler: dict | None = None) -> "FleetSignals":
         """Assemble one consistent bundle from the live sources: the
         fleet store (windowed series), a registry snapshot (goodput
         gauges + scrape-staleness counters), and the SLO accounts
         (current burn; velocity comes from the store's windowed
-        ``slo_burn:<tenant>`` series).  ``incidents`` / ``journal``
-        are the history axis' contributions (``obs.signals()`` passes
-        the open-incident list and journal health; callers wiring the
-        sources by hand may omit them)."""
+        ``slo_burn:<tenant>`` series).  ``incidents`` / ``journal`` /
+        ``scaler`` are the history and control axes' contributions
+        (``obs.signals()`` passes the open-incident list, journal
+        health, and the scaler summary; callers wiring the sources by
+        hand may omit them)."""
         burn: dict = {}
         for tenant, acct in sorted(
                 (slo_snapshot.get("accounts") or {}).items()):
@@ -324,6 +345,7 @@ class FleetSignals:
         b_flaps = {}
         health = {}
         stale = {}
+        ages = {}
         tick_s = fleet.tick_s
         stale_after = (STALE_TICKS * tick_s) if tick_s else None
         for r in replicas:
@@ -340,6 +362,9 @@ class FleetSignals:
             age = fleet.staleness_s(r, now)
             if age is not None:
                 stale[r] = age
+            born = fleet.value(r, "birth_age_s")
+            if born is not None:
+                ages[r] = born
             up = fleet.value(r, "up")
             healthy = fleet.value(r, "healthy")
             if up is None and healthy is None:
@@ -371,6 +396,22 @@ class FleetSignals:
                 scrape_stale[rid] = scrape_stale.get(rid, 0) \
                     + c["value"]
         overall = (useful / dispatched) if dispatched else None
+        # group membership: the collector's replica_count_* series
+        # when present (a started ReplicaGroup), else derived from
+        # the per-replica health map (hand-wired stores, tests)
+        counts = {}
+        for state in ("up", "draining", "down"):
+            v = fleet.value("_fleet", f"replica_count_{state}")
+            if v is not None:
+                counts[state] = int(v)
+        if not counts:
+            counts = {
+                "up": sum(1 for h in health.values()
+                          if h not in ("down", "unknown")),
+                "draining": 0,
+                "down": sum(1 for h in health.values()
+                            if h == "down"),
+            }
         return cls(
             at_s=now, ticks=fleet.ticks, tick_s=tick_s,
             window=fleet.window, slo_burn=burn,
@@ -383,8 +424,10 @@ class FleetSignals:
                            else 1.0 - overall),
             health=health, staleness_s=stale,
             scrape_stale=scrape_stale,
+            replica_count=counts, birth_age_s=ages,
             incidents=list(incidents or []),
             journal=dict(journal or {"armed": False}),
+            scaler=dict(scaler or {"armed": False}),
             series=fleet.snapshot()["series"])
 
     def to_dict(self) -> dict:

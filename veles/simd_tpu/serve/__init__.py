@@ -37,6 +37,14 @@ the traffic or the hardware misbehaves:
   placement per shape class, failover re-submission with the
   original deadline carried, group-wide zero-double-answer dedup
   (``make chaos-replicas`` is the scripted proof);
+* :mod:`~veles.simd_tpu.serve.scaler` — the control axis (obs v7): an
+  SLO-driven autoscaler on the group (``ReplicaGroup(scaler=True)``
+  or ``VELES_SIMD_SCALER=1``) that reads only the typed
+  ``obs.signals()`` contract, acts only through the group verbs
+  (spawn/retire/restart) under hysteresis + cooldown + min/max
+  bounds, and emits every decision — action or typed no-op — as a
+  journaled ``scaler`` event (``make chaos-scale`` is the scripted
+  proof);
 * **end-to-end deadlines + per-class breakers** —
   ``submit(deadline_ms=...)`` (default
   ``VELES_SIMD_SERVE_DEADLINE_MS``) bounds a request's total time in
@@ -80,6 +88,10 @@ from veles.simd_tpu.serve.cluster import (HEARTBEAT_MS_ENV,
                                           FrontRouter,
                                           NoReplicaAvailable,
                                           ReplicaGroup, RouterTicket)
+from veles.simd_tpu.serve.scaler import ARM_ENV as SCALER_ARM_ENV
+from veles.simd_tpu.serve.scaler import \
+    TICK_MS_ENV as SCALER_TICK_MS_ENV
+from veles.simd_tpu.serve.scaler import ScalerEngine
 from veles.simd_tpu.serve.server import (DEADLINE_ENV, SUPPORTED_OPS,
                                          DeadlineExceeded, Request,
                                          Server, ServerClosed, Ticket,
@@ -91,7 +103,8 @@ __all__ = [
     "HealthMonitor", "bucket_length", "env_deadline_ms",
     "SUPPORTED_OPS", "HEALTHY", "DEGRADED",
     "ReplicaGroup", "FrontRouter", "RouterTicket",
-    "NoReplicaAvailable",
+    "NoReplicaAvailable", "ScalerEngine",
+    "SCALER_ARM_ENV", "SCALER_TICK_MS_ENV",
     "MAX_BATCH_ENV", "MAX_WAIT_ENV", "QUEUE_DEPTH_ENV",
     "TENANT_DEPTH_ENV", "DEADLINE_ENV", "REPLICAS_ENV",
     "ROUTER_POLICY_ENV", "HEARTBEAT_MS_ENV",

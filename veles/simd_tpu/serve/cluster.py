@@ -64,7 +64,17 @@ the way PRs 9-10 proved a single server survives losing a device:
   bundle back out; the aggregation endpoint serves it as
   ``/signals``.  ``_collect_fleet_sample`` is THE cross-replica
   metrics funnel (lint-enforced): serve/cluster code never scrapes
-  registries ad hoc.
+  registries ad hoc;
+* **autoscaler — the obs v7 control axis** — when armed
+  (``scaler=True`` / ``$VELES_SIMD_SCALER``), the group starts a
+  :class:`~veles.simd_tpu.serve.scaler.ScalerEngine` alongside the
+  collector: it reads ``obs.signals()`` on its own cadence and acts
+  back through the group's verbs — :meth:`spawn_replica` under
+  rising SLO burn or queue velocity, :meth:`retire` of the
+  least-loaded replica after a sustained idle window,
+  :meth:`restart` of down/stale replicas — every tick a journaled
+  ``scaler`` decision event (``make chaos-scale`` is the scripted
+  proof).
 
 **Spawn modes.** ``spawn="thread"`` (default) runs replicas as
 in-process servers — the CI topology, and the only one the router can
@@ -113,6 +123,7 @@ from veles.simd_tpu.obs import journal as obs_journal
 from veles.simd_tpu.obs import timeseries as _timeseries
 from veles.simd_tpu.runtime import breaker as _breaker
 from veles.simd_tpu.runtime import faults
+from veles.simd_tpu.serve import scaler as _scaler
 from veles.simd_tpu.serve.admission import Overloaded
 from veles.simd_tpu.serve.server import (DeadlineExceeded, Request,
                                          Server, ServerClosed,
@@ -256,6 +267,9 @@ class Replica:
         self.state = UP
         self.misses = 0
         self.last_beat = None
+        # birth stamp: the fleet collector exports age as the
+        # per-replica ``birth_age_s`` series (scaler/dashboard input)
+        self.born = faults.monotonic()
         # last health state a ping observed ("healthy"/"degraded";
         # None = never pinged) — the subprocess aggregation signal,
         # since the group cannot read a child's health machine
@@ -438,6 +452,9 @@ class ReplicaGroup:
                  miss_limit: int = DEFAULT_MISS_LIMIT,
                  obs_port: int | None = None,
                  fleet_tick_ms: float | None = None,
+                 scaler: bool | None = None,
+                 scaler_tick_ms: float | None = None,
+                 scaler_kwargs: dict | None = None,
                  **server_kwargs):
         n = int(replicas) if replicas else env_replicas()
         if n < 1:
@@ -472,6 +489,19 @@ class ReplicaGroup:
         self._collector_thread = None
         self._started = False
         self._incidents_hold = False
+        # control axis (obs v7): the SLO-driven autoscaler, OFF by
+        # default (an idle test group must not get scale-down-drained
+        # under the test's feet) — armed by scaler=True or a truthy
+        # $VELES_SIMD_SCALER; started/stopped with the group
+        self._scaler_armed = (bool(scaler) if scaler is not None
+                              else _scaler.armed_by_env())
+        self._scaler_tick_s = (float(scaler_tick_ms) / 1e3
+                               if scaler_tick_ms else None)
+        self._scaler_kwargs = dict(scaler_kwargs or {})
+        self._scaler_engine = None
+        # spawn_replica() mints r<next>: never reuse a live/dead rid
+        self._next_rid = n
+        self._sweeps = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -523,6 +553,15 @@ class ReplicaGroup:
         # later releases) its own share of the process-wide ticker.
         obs_incidents.start()
         self._incidents_hold = True
+        # the control axis rides the same feed: the scaler ticks over
+        # obs.signals() and acts back through THIS group's verbs; its
+        # engine registers module-level so /scaler and
+        # obs.scaler_snapshot() serve it
+        if self._scaler_armed:
+            self._scaler_engine = _scaler.ScalerEngine(
+                self, **self._scaler_kwargs)
+            _scaler._register(self._scaler_engine)
+            self._scaler_engine.start(self._scaler_tick_s)
         obs.gauge("replica_alive", float(self.alive()))
         obs.record_decision("replica_lifecycle", "group_start",
                             replicas=len(self.replicas),
@@ -532,6 +571,12 @@ class ReplicaGroup:
     def stop(self, drain: bool = True) -> None:
         """Stop the heartbeat loop and every live replica (drained or
         abruptly), then the aggregation endpoint."""
+        # the scaler stops FIRST: no verb may fire into a group that
+        # is tearing down
+        if self._scaler_engine is not None:
+            self._scaler_engine.stop()
+            _scaler._unregister(self._scaler_engine)
+            self._scaler_engine = None
         if self._incidents_hold:
             self._incidents_hold = False
             obs_incidents.stop()
@@ -677,6 +722,61 @@ class ReplicaGroup:
         obs.gauge("replica_alive", float(self.alive()))
         return fresh
 
+    def spawn_replica(self) -> Replica:
+        """Grow the group by one: a FRESH replica under a never-used
+        id (``r<next>``) starts — preloading the warm artifact pack
+        when the store is armed, the ~23%-of-cold birth the scaler's
+        scale-up counts on — gets the group's pipeline registrations
+        replayed, and joins heartbeating and placement.  The scaler's
+        scale-up verb; also an operator verb in its own right."""
+        if not self._started:
+            raise ValueError(
+                "spawn_replica() needs a started group (the probers "
+                "and collector it joins only run after start())")
+        with self._lock:
+            rid = f"r{self._next_rid}"
+            self._next_rid += 1
+        fresh = Replica(rid, spawn=self.spawn,
+                        server_kwargs=self._server_kwargs)
+        fresh.start()
+        if self.spawn == "thread":
+            for name, compiled in self._group_pipelines.items():
+                fresh.server.register_pipeline(name, compiled)
+        # the successful start is the first beat (same staleness
+        # rationale as restart())
+        fresh.last_beat = faults.monotonic()
+        with self._lock:
+            self.replicas = self.replicas + [fresh]
+            self._by_rid[rid] = fresh
+        t = threading.Thread(target=self._probe_replica,
+                             args=(fresh,), daemon=True,
+                             name=f"veles-replica-probe-{rid}")
+        t.start()
+        self._probers.append(t)
+        obs.record_decision("replica_lifecycle", "spawn",
+                            replica=rid)
+        obs.count("replica_spawned", replica=rid)
+        obs.gauge("replica_alive", float(self.alive()))
+        return fresh
+
+    def retire(self, rid: str, reason: str = "scale_down") -> None:
+        """Shrink the group by one: gracefully :meth:`drain` ``rid``
+        (zero lost by construction), then REMOVE it from membership —
+        unlike a plain drain, the record does not linger as a DEAD
+        replica, so the fleet collector stops sampling it (and
+        forgets its series) and the incident engine's ``replica_down``
+        rule does not fire forever on an intentional scale-down.  The
+        scaler's scale-down verb."""
+        self.drain(rid, reason=reason)
+        with self._lock:
+            self._by_rid.pop(rid, None)
+            self.replicas = [x for x in self.replicas
+                             if x.rid != rid]
+        obs.record_decision("replica_lifecycle", "retire",
+                            replica=rid, reason=reason)
+        obs.count("replica_retired", replica=rid)
+        obs.gauge("replica_alive", float(self.alive()))
+
     def register_pipeline(self, name: str, compiled) -> str:
         """Register a compiled pipeline on EVERY thread-mode replica
         (the group twin of :meth:`Server.register_pipeline`); returns
@@ -789,9 +889,17 @@ class ReplicaGroup:
         store.tick_s = self.fleet_tick_s
         breakers = None
         total_depth = 0.0
-        for r in self.replicas:
+        with self._lock:
+            # membership can move under the sweep now (spawn_replica
+            # / retire): sample a consistent snapshot
+            replicas = list(self.replicas)
+        for r in replicas:
             obs.fleet_record(r.rid, "up",
                              1.0 if r.state == UP else 0.0, t_s=now)
+            born = getattr(r, "born", None)
+            if born is not None:
+                obs.fleet_record(r.rid, "birth_age_s",
+                                 max(0.0, now - born), t_s=now)
             if r.state != UP:
                 continue
             if r.spawn == "thread":
@@ -852,13 +960,40 @@ class ReplicaGroup:
                     t_s=now)
         obs.fleet_record("_fleet", "queue_depth_total", total_depth,
                          t_s=now)
+        # replica-count series (scaler + dashboard input): how many
+        # members sit in each lifecycle bucket right now
+        obs.fleet_record("_fleet", "replica_count_up", float(
+            sum(1 for r in replicas if r.state == UP)), t_s=now)
+        obs.fleet_record("_fleet", "replica_count_draining", float(
+            sum(1 for r in replicas if r.state == DRAINING)), t_s=now)
+        obs.fleet_record("_fleet", "replica_count_down", float(
+            sum(1 for r in replicas
+                if r.state in (DEAD, RESTARTING))), t_s=now)
         for tenant, acct in sorted(
                 (obs.slo_snapshot().get("accounts") or {}).items()):
             burn = acct.get("burn_rate")
             if burn is not None:
                 obs.fleet_record("_fleet", f"slo_burn:{tenant}",
                                  float(burn), t_s=now)
+        # a retired replica leaves membership — drop its rings, or
+        # its aging samples read as a "stale" replica forever
+        known = {r.rid for r in replicas} | {"_fleet"}
+        for ghost in store.replicas():
+            if ghost not in known:
+                store.forget(ghost)
         store.tick()
+        # the group owner reclaims journal segments from dead pids
+        # (killed subprocess replicas, previous campaign epochs):
+        # rotation's own-pid pruning never touches them, so the pack
+        # would otherwise outgrow its total-disk budget forever.
+        # Every ~64 sweeps (~6 s at the default tick) is plenty.
+        self._sweeps += 1
+        if self._sweeps % 64 == 0 and obs_journal.armed():
+            live = [r.proc.pid for r in replicas
+                    if r.proc is not None and r.proc.poll() is None]
+            pruned = obs_journal.prune_foreign(live_pids=live)
+            if pruned:
+                obs.count("journal_pruned_foreign", pruned)
 
     # -- introspection -----------------------------------------------------
 
@@ -879,6 +1014,7 @@ class ReplicaGroup:
             1 for s in snaps
             if s["state"] == UP and s.get("health", "healthy")
             != "degraded")
+        eng = self._scaler_engine
         return {
             "replicas": snaps,
             "alive": self.alive(),
@@ -889,6 +1025,7 @@ class ReplicaGroup:
                        else "degraded",
                        "up_healthy": up_healthy},
             "obs_port": self.obs_port,
+            "scaler": eng.summary() if eng is not None else None,
         }
 
 
