@@ -6,8 +6,10 @@
 PYTHON ?= python
 
 .PHONY: all tests tests-quick benchmarks bench bench-regress \
-        bench-multichip bench-serve bench-goodput serve-smoke \
-        chaos-smoke chaos-replicas chaos-scale cshim cshim-check \
+        bench-multichip bench-serve bench-goodput bench-rpc \
+        serve-smoke \
+        chaos-smoke chaos-replicas chaos-replicas-rpc chaos-scale \
+        cshim cshim-check \
         wavelet-tables \
         lint docs obs-report obs-dash obs-query autotune-pack \
         warm-pack \
@@ -64,6 +66,17 @@ bench-goodput:
 		--details GOODPUT_DETAILS.json
 	$(PYTHON) tools/bench_regress.py --details GOODPUT_DETAILS.json
 
+# the RPC bench family: identical loadgen traffic through an
+# in-process 2-replica group vs a spawn="subprocess" group over the
+# RPC data plane (serve/rpc.py), written to RPC_DETAILS.json
+# (subprocess/thread throughput ratio + inverse added-p50 rows; rc=1
+# if the wire adds more than the p50 budget or any request fails).
+# Gate with `python tools/bench_regress.py --details RPC_DETAILS.json`.
+bench-rpc:
+	VELES_SIMD_PLATFORM=cpu $(PYTHON) tools/loadgen.py --rpc-overhead \
+		--details RPC_DETAILS.json
+	$(PYTHON) tools/bench_regress.py --details RPC_DETAILS.json
+
 # seconds-long CPU sanity run of the serving layer (accounting +
 # oracle parity gate, including pipeline-invocation streams with
 # state threading); the chaos variant arms VELES_SIMD_FAULT_PLAN
@@ -94,6 +107,18 @@ chaos-smoke:
 chaos-replicas:
 	VELES_SIMD_PLATFORM=cpu VELES_SIMD_FAULT_BACKOFF=0 \
 		$(PYTHON) tools/chaos.py --replicas --smoke
+
+# the same replicated campaign over the RPC DATA PLANE: three child
+# processes behind the front router (serve/rpc.py pooled connections),
+# the abrupt kill a real SIGKILL mid-traffic — zero lost / zero
+# double-answered, failover deadlines carried, and the lifecycle
+# reconstructable from the journal pack must all hold across the wire
+# (tools/chaos.py --replicas --spawn subprocess; spawn-suffixed rows in
+# REPLICA_RPC_DETAILS.json gate via `python tools/bench_regress.py
+# --details REPLICA_RPC_DETAILS.json`)
+chaos-replicas-rpc:
+	VELES_SIMD_PLATFORM=cpu VELES_SIMD_FAULT_BACKOFF=0 \
+		$(PYTHON) tools/chaos.py --replicas --spawn subprocess --smoke
 
 # the CONTROL-AXIS chaos campaign on CPU (obs v7): a ~10x diurnal
 # traffic ramp over a scaler-armed ReplicaGroup — the SLO-driven

@@ -573,10 +573,22 @@ class TestSubprocessMode:
                 f"http://127.0.0.1:{r.port}/metrics",
                 timeout=10).read()
             assert metrics          # prometheus text, non-empty
-            # the router refuses a subprocess group with a typed,
-            # actionable error (placement needs the RPC layer)
-            with pytest.raises(ValueError, match="subprocess"):
-                cluster.FrontRouter(group)
+            # PR 20: the refusal is gone — the router places onto the
+            # child over the RPC data plane and the answer matches
+            # the local oracle
+            from veles.simd_tpu.ops import batched
+
+            router = cluster.FrontRouter(group)
+            x = _signal()
+            t = router.submit(serve.Request(
+                "sosfilt", x, {"sos": SOS}, tenant="t",
+                deadline_ms=60000.0))
+            got = np.asarray(t.result(timeout=60.0))
+            want = np.asarray(batched.batched_sosfilt(
+                SOS, x[None, :], simd=False))[0]
+            np.testing.assert_allclose(got, want, rtol=2e-3,
+                                       atol=2e-3)
+            assert t.status == "ok" and t.replica == "r0"
 
     def test_subprocess_kill_and_group_health(self, telemetry,
                                               monkeypatch):

@@ -1807,3 +1807,142 @@ def test_real_scaler_module_passes_control_rule():
     f = REPO / "veles" / "simd_tpu" / "serve" / "scaler.py"
     tree = ast.parse(f.read_text(), str(f))
     assert lint.scaler_control_errors(tree, str(f)) == []
+
+
+# ---------------------------------------------------------------------------
+# the rpc transport rule (PR 20): serve/rpc.py is the ONE serve module
+# allowed to open request-carrying transport toward a replica — any
+# http.client/socket import or body-carrying urllib submission in the
+# rest of serve/ re-invents the wire schema, the deadline re-stamp,
+# and the typed-error mapping, wrong.  GET scrapes stay legal.
+# ---------------------------------------------------------------------------
+
+RPC_GOOD_SCRAPE = '''
+def probe(url):
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.status
+    except urllib.error.HTTPError as e:
+        return e.code
+'''
+
+RPC_HTTP_CLIENT_IMPORT = '''
+import http.client as hc
+
+
+def side_channel(host, port, body):
+    conn = hc.HTTPConnection(host, port)
+    conn.request("POST", "/submit", body)
+    return conn.getresponse().read()
+'''
+
+RPC_SOCKET_IMPORT = '''
+from socket import create_connection
+
+
+def side_channel(host, port, frame):
+    with create_connection((host, port)) as s:
+        s.sendall(frame)
+'''
+
+RPC_URLOPEN_DATA_KWARG = '''
+import urllib.request
+
+
+def side_channel(url, frame):
+    with urllib.request.urlopen(url, data=frame) as r:
+        return r.read()
+'''
+
+RPC_URLOPEN_DATA_POSITIONAL = '''
+from urllib.request import urlopen as _open
+
+
+def side_channel(url, frame):
+    with _open(url, frame) as r:
+        return r.read()
+'''
+
+RPC_REQUEST_POST = '''
+from urllib import request as _rq
+
+
+def side_channel(url, frame):
+    req = _rq.Request(url, data=frame, method="POST")
+    with _rq.urlopen(req) as r:
+        return r.read()
+'''
+
+RPC_REQUEST_GET_STAYS_LEGAL = '''
+from urllib.request import Request, urlopen
+
+
+def scrape(url):
+    with urlopen(Request(url, method="GET"), timeout=5) as r:
+        return r.read()
+'''
+
+
+def _rpc_errs(src):
+    return lint.rpc_transport_errors(ast.parse(src), "mod.py")
+
+
+def test_rpc_rule_passes_get_scrape():
+    assert _rpc_errs(RPC_GOOD_SCRAPE) == []
+
+
+def test_rpc_rule_passes_explicit_get_request():
+    assert _rpc_errs(RPC_REQUEST_GET_STAYS_LEGAL) == []
+
+
+def test_rpc_rule_flags_http_client_import_alias():
+    errs = _rpc_errs(RPC_HTTP_CLIENT_IMPORT)
+    assert len(errs) == 1
+    assert "http.client" in errs[0] and "rpc.py" in errs[0]
+
+
+def test_rpc_rule_flags_socket_from_import():
+    errs = _rpc_errs(RPC_SOCKET_IMPORT)
+    assert len(errs) == 1
+    assert "socket" in errs[0]
+
+
+def test_rpc_rule_flags_urlopen_data_kwarg():
+    errs = _rpc_errs(RPC_URLOPEN_DATA_KWARG)
+    assert len(errs) == 1
+    assert "urllib.request.urlopen" in errs[0]
+
+
+def test_rpc_rule_flags_urlopen_positional_body_via_alias():
+    errs = _rpc_errs(RPC_URLOPEN_DATA_POSITIONAL)
+    assert len(errs) == 1
+    assert "_open" in errs[0]
+
+
+def test_rpc_rule_flags_post_request_via_module_alias():
+    # the Request carrying the body is the flagged call; the urlopen
+    # that ships it takes a pre-built object, not a data argument
+    errs = _rpc_errs(RPC_REQUEST_POST)
+    assert len(errs) == 1
+    assert "_rq.Request" in errs[0]
+
+
+def test_rpc_rule_would_catch_the_client_itself():
+    """serve/rpc.py is exempt by dispatch, not by rule — prove the
+    rule fires on its transport imports when applied."""
+    f = REPO / "veles" / "simd_tpu" / "serve" / "rpc.py"
+    tree = ast.parse(f.read_text(), str(f))
+    errs = lint.rpc_transport_errors(tree, str(f))
+    assert any("http.client" in e for e in errs)
+    assert any("(socket)" in e for e in errs)
+
+
+def test_real_serve_modules_pass_rpc_rule():
+    serve_dir = REPO / "veles" / "simd_tpu" / "serve"
+    for f in sorted(serve_dir.glob("*.py")):
+        if f.name == "rpc.py":
+            continue
+        tree = ast.parse(f.read_text(), str(f))
+        assert lint.rpc_transport_errors(tree, str(f)) == [], f.name

@@ -1182,7 +1182,7 @@ class TestFleetSignals:
         assert sig.scaler["ticks"] == 7
         d = sig.to_dict()
         assert d["schema"] == ts.SIGNALS_SCHEMA == \
-            "veles-simd-signals-v3"
+            "veles-simd-signals-v4"
         assert d["health"]["r1"] == "down"
         assert d["replica_count"]["up"] == 1
         assert "series" in d

@@ -1,0 +1,512 @@
+"""RPC data plane (``veles/simd_tpu/serve/rpc.py``, PR 20).
+
+Pins the wire contract the FrontRouter's subprocess placement rides:
+
+* npy-framed codec round-trips bytes-exact (dtype + shape + payload,
+  tuple/map trees, numpy scalars) — never base64-JSON;
+* the typed-error table crosses the boundary losslessly BOTH
+  directions (``overloaded``/``deadline``/``closed``/``bad_request``/
+  ``error``, cluster-scope shed round-tripping as NoReplicaAvailable);
+* EVERY malformation — wrong magic, truncation, schema drift, garbage
+  — answers typed (ValueError -> ``bad_request`` server-side, a
+  ``closed`` ticket client-side), never a hang;
+* a deadline that expires while the exchange is in flight answers
+  ``expired`` (DeadlineExceeded), not a transport story;
+* :class:`RpcClient` against a live in-process Server's armed
+  endpoint matches the local oracle and reuses pooled connections;
+* (slow) the real subprocess wire: a child's ``POST /submit`` serves
+  plain ops and answers unregistered pipelines typed.
+"""
+
+import io
+import json
+import struct
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from veles.simd_tpu import obs, serve  # noqa: E402
+from veles.simd_tpu.obs import http as obs_http  # noqa: E402
+from veles.simd_tpu.ops import batched, iir  # noqa: E402
+from veles.simd_tpu.runtime import breaker, faults  # noqa: E402
+from veles.simd_tpu.serve import cluster, rpc  # noqa: E402
+from veles.simd_tpu.serve.admission import Overloaded  # noqa: E402
+from veles.simd_tpu.serve.server import (  # noqa: E402
+    DeadlineExceeded, ServerClosed)
+
+RNG = np.random.RandomState(47)
+SOS = iir.butterworth(4, 0.25, "lowpass")
+
+
+@pytest.fixture
+def telemetry(monkeypatch):
+    monkeypatch.setenv("VELES_SIMD_FAULT_BACKOFF", "0")
+    obs.enable(compile_listeners=False)
+    obs.reset()
+    breaker.reset()
+    faults.reset_fault_history()
+    yield
+    obs.disable()
+    obs.reset()
+    breaker.reset()
+    faults.reset_fault_history()
+    faults.set_fault_plan(None)
+
+
+def _signal(n=512):
+    return RNG.randn(n).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# wire codec: bytes-exact npy framing
+# ---------------------------------------------------------------------------
+
+class TestWireCodec:
+    def test_request_roundtrip_bytes_exact(self):
+        x = _signal(300)
+        params = {
+            "sos": SOS,
+            "gain": np.float64(0.5),
+            "taps": np.arange(7, dtype=np.int32),
+            "label": "hp",
+            "flag": True,
+            "nothing": None,
+            "nested": {"win": np.hanning(16).astype(np.float32)},
+        }
+        body = rpc.pack_request(
+            "sosfilt", x, params, tenant="acme",
+            deadline_ms=1234.5, block=True, timeout=2.0)
+        req = rpc.unpack_request(body)
+        assert req["op"] == "sosfilt"
+        assert req["tenant"] == "acme"
+        assert req["deadline_ms"] == 1234.5
+        assert req["block"] is True
+        assert req["timeout"] == 2.0
+        assert req["x"].dtype == np.float32
+        np.testing.assert_array_equal(req["x"], x)
+        got = req["params"]
+        assert got["sos"].dtype == SOS.dtype
+        np.testing.assert_array_equal(got["sos"], SOS)
+        # np.float64 subclasses float, so it rides the JSON header
+        # value-exact (JSON numbers ARE doubles); true numpy scalars
+        # like the int32 taps below ride npy blobs
+        assert got["gain"] == 0.5
+        assert got["taps"].dtype == np.int32
+        np.testing.assert_array_equal(got["taps"],
+                                      np.arange(7, dtype=np.int32))
+        assert got["label"] == "hp" and got["flag"] is True
+        assert got["nothing"] is None
+        assert got["nested"]["win"].dtype == np.float32
+        np.testing.assert_array_equal(
+            got["nested"]["win"], np.hanning(16).astype(np.float32))
+
+    def test_response_roundtrip_pipeline_state_tree(self):
+        # a pipeline answer is an (out, state) tuple tree — tuples,
+        # maps with non-string keys, and arrays must all survive
+        out = _signal(128).astype(np.complex64)
+        state = {("carry", 0): _signal(8),
+                 "__weird__": np.int64(3)}
+        body = rpc.pack_response(
+            status="ok", value=(out, state), wait_s=0.012,
+            events=[{"event": "enqueue", "t": 0.0}], replica="r1")
+        resp = rpc.unpack_response(body)
+        assert resp["status"] == "ok"
+        assert resp["wait_s"] == 0.012
+        assert resp["replica"] == "r1"
+        assert resp["events"] == [{"event": "enqueue", "t": 0.0}]
+        got_out, got_state = resp["value"]
+        assert isinstance(resp["value"], tuple)
+        assert got_out.dtype == np.complex64
+        np.testing.assert_array_equal(got_out, out)
+        np.testing.assert_array_equal(got_state[("carry", 0)],
+                                      state[("carry", 0)])
+        assert got_state["__weird__"] == np.int64(3)
+
+    def test_unsupported_value_raises_valueerror(self):
+        with pytest.raises(ValueError, match="cannot encode"):
+            rpc.pack_request("sosfilt", _signal(),
+                             {"fn": object()})
+
+    def test_malformations_raise_valueerror_never_hang(self):
+        good = rpc.pack_request("sosfilt", _signal(16),
+                                {"sos": SOS})
+        cases = {
+            "empty": b"",
+            "short": b"VS",
+            "wrong magic": b"NOTRPC" + good[6:],
+            "truncated header": good[:12],
+            "truncated blobs": good[:-10],
+            "trailing bytes": good + b"xx",
+            "not bytes": None,
+        }
+        for label, data in cases.items():
+            with pytest.raises(ValueError):
+                rpc.unpack_frame(data)
+
+    def test_header_must_be_json_with_schema(self):
+        bad_json = rpc.MAGIC + struct.pack(">I", 4) + b"!!!!"
+        with pytest.raises(ValueError, match="not JSON"):
+            rpc.unpack_frame(bad_json)
+        hdr = json.dumps({"schema": "veles-simd-rpc-v0",
+                          "blobs": []}).encode()
+        drift = rpc.MAGIC + struct.pack(">I", len(hdr)) + hdr
+        with pytest.raises(ValueError, match="schema mismatch"):
+            rpc.unpack_frame(drift)
+
+    def test_blob_rot_raises_valueerror(self):
+        # valid header, blob bytes that are not npy
+        hdr = json.dumps({"schema": rpc.WIRE_SCHEMA,
+                          "blobs": [4]}).encode()
+        frame = rpc.MAGIC + struct.pack(">I", len(hdr)) + hdr \
+            + b"rot!"
+        with pytest.raises(ValueError, match="blob unparseable"):
+            rpc.unpack_frame(frame)
+
+    def test_pickle_payloads_refused(self):
+        # an object-dtype npy blob needs allow_pickle — the decoder
+        # must refuse it typed, not execute it
+        buf = io.BytesIO()
+        np.save(buf, np.array([{"a": 1}], dtype=object),
+                allow_pickle=True)
+        blob = buf.getvalue()
+        hdr = json.dumps({"schema": rpc.WIRE_SCHEMA,
+                          "blobs": [len(blob)]}).encode()
+        frame = rpc.MAGIC + struct.pack(">I", len(hdr)) + hdr + blob
+        with pytest.raises(ValueError, match="blob unparseable"):
+            rpc.unpack_frame(frame)
+
+
+# ---------------------------------------------------------------------------
+# the typed-error table, pinned both directions
+# ---------------------------------------------------------------------------
+
+class TestErrorTable:
+    def test_encode_direction(self):
+        table = [
+            (Overloaded("shed", tenant="t", scope="tenant"),
+             "overloaded"),
+            (DeadlineExceeded("late"), "deadline"),
+            (ServerClosed("gone"), "closed"),
+            (ValueError("bad shape"), "bad_request"),
+            (RuntimeError("boom"), "error"),
+            (KeyError("misc"), "error"),
+        ]
+        for exc, kind in table:
+            assert rpc.encode_error(exc)["kind"] == kind, exc
+        assert set(k for _, k in table) == set(rpc.ERROR_KINDS)
+
+    def test_decode_direction(self):
+        table = [
+            ("overloaded", Overloaded),
+            ("deadline", DeadlineExceeded),
+            ("closed", ServerClosed),
+            ("bad_request", ValueError),
+            ("error", RuntimeError),
+        ]
+        for kind, typ in table:
+            got = rpc.decode_error({"kind": kind, "message": "m"})
+            assert type(got) is typ, kind
+            assert "m" in str(got)
+
+    def test_overloaded_carries_tenant_and_scope(self):
+        info = rpc.encode_error(
+            Overloaded("shed", tenant="acme", scope="tenant"))
+        assert info["tenant"] == "acme" and info["scope"] == "tenant"
+        back = rpc.decode_error(info)
+        assert back.tenant == "acme" and back.scope == "tenant"
+
+    def test_cluster_scope_roundtrips_as_no_replica(self):
+        info = rpc.encode_error(cluster.NoReplicaAvailable(
+            "all shedding", tenant="acme"))
+        assert info["kind"] == "overloaded"
+        assert info["scope"] == "cluster"
+        back = rpc.decode_error(info)
+        assert isinstance(back, cluster.NoReplicaAvailable)
+        assert back.tenant == "acme"
+
+    def test_unknown_kind_and_garbage_decode_as_runtimeerror(self):
+        assert isinstance(
+            rpc.decode_error({"kind": "v2-novelty", "message": "x"}),
+            RuntimeError)
+        assert isinstance(rpc.decode_error("not a dict"),
+                          RuntimeError)
+
+
+# ---------------------------------------------------------------------------
+# serve_submit against a live Server (the child-side funnel)
+# ---------------------------------------------------------------------------
+
+class TestServeSubmit:
+    def test_ok_roundtrip_matches_oracle(self, telemetry):
+        x = _signal()
+        with serve.Server(max_batch=8, max_wait_ms=2.0,
+                          name="rsub") as srv:
+            code, payload = rpc.serve_submit(
+                srv, rpc.pack_request("sosfilt", x, {"sos": SOS},
+                                      deadline_ms=60000.0))
+        assert code == 200
+        resp = rpc.unpack_response(payload)
+        assert resp["status"] == "ok"
+        assert resp["replica"] == "rsub"
+        assert resp["events"], "child trace events must ride along"
+        want = np.asarray(batched.batched_sosfilt(
+            SOS, x[None, :], simd=False))[0]
+        np.testing.assert_allclose(np.asarray(resp["value"]), want,
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_malformed_body_answers_400_bad_request(self, telemetry):
+        with serve.Server(max_wait_ms=1.0) as srv:
+            code, payload = rpc.serve_submit(srv, b"garbage bytes")
+        assert code == 400
+        resp = rpc.unpack_response(payload)
+        assert resp["status"] == "error"
+        assert resp["error"]["kind"] == "bad_request"
+
+    def test_unknown_op_answers_typed_bad_request(self, telemetry):
+        with serve.Server(max_wait_ms=1.0) as srv:
+            code, payload = rpc.serve_submit(
+                srv, rpc.pack_request("no_such_op", _signal(), {}))
+        assert code == 200
+        resp = rpc.unpack_response(payload)
+        assert resp["status"] == "error"
+        assert resp["error"]["kind"] == "bad_request"
+
+    def test_closed_server_answers_closed(self, telemetry):
+        srv = serve.Server(max_wait_ms=1.0)
+        srv.start()
+        srv.stop()
+        code, payload = rpc.serve_submit(
+            srv, rpc.pack_request("sosfilt", _signal(),
+                                  {"sos": SOS}))
+        assert code == 200
+        resp = rpc.unpack_response(payload)
+        assert resp["status"] == "closed"
+        assert resp["error"]["kind"] == "closed"
+
+
+# ---------------------------------------------------------------------------
+# RpcClient over a live in-process endpoint (real sockets, fast)
+# ---------------------------------------------------------------------------
+
+class _Wire:
+    """A Server whose armed obs endpoint serves POST /submit — the
+    same wiring a subprocess child arms, without the child."""
+
+    def __init__(self, **server_kwargs):
+        self.server = serve.Server(**server_kwargs)
+        self.server.start()
+        self.endpoint = obs_http.ObsEndpoint(
+            0, submit=lambda body: rpc.serve_submit(self.server,
+                                                    body))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.endpoint.stop()
+        self.server.stop()
+        return False
+
+
+class TestRpcClient:
+    def test_roundtrip_matches_oracle_and_pools_connections(
+            self, telemetry):
+        with _Wire(max_batch=8, max_wait_ms=2.0, name="rw") as w:
+            client = rpc.RpcClient("127.0.0.1", w.endpoint.port,
+                                   replica="rw", conns=2)
+            try:
+                x = _signal()
+                tickets = [client.submit(op="sosfilt", x=x,
+                                         params={"sos": SOS},
+                                         deadline_ms=60000.0)
+                           for _ in range(8)]
+                want = np.asarray(batched.batched_sosfilt(
+                    SOS, x[None, :], simd=False))[0]
+                for t in tickets:
+                    np.testing.assert_allclose(
+                        np.asarray(t.result(timeout=60.0)), want,
+                        rtol=2e-3, atol=2e-3)
+                    assert t.status == "ok"
+                    assert t.remote == "rw"
+                stats = client.stats()
+                assert stats["sends"] == 8
+                assert stats["in_flight"] == 0
+                # 8 sends over 2 pooled connections: at least 6 reuse
+                assert stats["reused"] >= 6
+                assert stats["reuse_ratio"] >= 0.75
+                assert stats["transport_errors"] == 0
+            finally:
+                client.close()
+
+    def test_remote_trace_absorbed_for_stitching(self, telemetry):
+        with _Wire(max_wait_ms=2.0, name="rw") as w:
+            client = rpc.RpcClient("127.0.0.1", w.endpoint.port,
+                                   replica="rw", conns=1)
+            try:
+                t = client.submit(op="sosfilt", x=_signal(),
+                                  params={"sos": SOS},
+                                  deadline_ms=60000.0)
+                t.result(timeout=60.0)
+                names = [e.get("event") for e in t.trace.events()]
+                assert "rpc_submit" in names
+                assert "rpc_sent" in names
+                # the child's lifecycle events crossed the wire,
+                # tagged with the answering replica's identity
+                assert any(e.get("event") == "dispatched"
+                           and e.get("replica") == "rw"
+                           for e in t.trace.events())
+            finally:
+                client.close()
+
+    def test_refused_connection_answers_closed(self, telemetry):
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        client = rpc.RpcClient("127.0.0.1", dead_port,
+                               replica="rdead", conns=1,
+                               timeout_s=5.0)
+        try:
+            t = client.submit(op="sosfilt", x=_signal(),
+                              params={"sos": SOS})
+            with pytest.raises(ServerClosed):
+                t.result(timeout=30.0)
+            assert t.status == "closed"
+            assert client.stats()["transport_errors"] >= 1
+        finally:
+            client.close()
+
+    def test_garbage_reply_answers_closed_never_hangs(
+            self, telemetry):
+        class Garbage(BaseHTTPRequestHandler):
+            def do_POST(self):
+                self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                body = b"these are not the frames"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = HTTPServer(("127.0.0.1", 0), Garbage)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        client = rpc.RpcClient("127.0.0.1", httpd.server_port,
+                               replica="rgarb", conns=1)
+        try:
+            t = client.submit(op="sosfilt", x=_signal(),
+                              params={"sos": SOS})
+            with pytest.raises(ServerClosed):
+                t.result(timeout=30.0)
+            assert t.status == "closed"
+            assert client.stats()["bad_replies"] >= 1
+        finally:
+            client.close()
+            httpd.shutdown()
+
+    def test_deadline_expired_in_flight_answers_expired(
+            self, telemetry):
+        # the peer stalls past the request's deadline then drops the
+        # connection: the caller gave up already, so the ticket reads
+        # DEADLINE_EXCEEDED — the transport story is noise
+        class Stall(BaseHTTPRequestHandler):
+            def do_POST(self):
+                self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                time.sleep(0.5)
+                self.connection.close()
+
+            def log_message(self, *a):
+                pass
+
+        httpd = HTTPServer(("127.0.0.1", 0), Stall)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        client = rpc.RpcClient("127.0.0.1", httpd.server_port,
+                               replica="rstall", conns=1)
+        try:
+            t = client.submit(op="sosfilt", x=_signal(),
+                              params={"sos": SOS}, deadline_ms=50.0)
+            with pytest.raises(DeadlineExceeded):
+                t.result(timeout=30.0)
+            assert t.status == "expired"
+        finally:
+            client.close()
+            httpd.shutdown()
+
+    def test_close_answers_queued_and_refuses_new(self, telemetry):
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        client = rpc.RpcClient("127.0.0.1", dead_port,
+                               replica="rclose", conns=1)
+        client.close()
+        with pytest.raises(ServerClosed):
+            client.submit(op="sosfilt", x=_signal(),
+                          params={"sos": SOS})
+
+    def test_malformed_request_raises_before_any_bytes(
+            self, telemetry):
+        client = rpc.RpcClient("127.0.0.1", 1, replica="rx",
+                               conns=1)
+        try:
+            with pytest.raises(ValueError, match="unsupported op"):
+                client.submit(op="no_such_op", x=_signal(),
+                              params={})
+            assert client.stats()["submitted"] == 0
+        finally:
+            client.close()
+
+
+# ---------------------------------------------------------------------------
+# the real subprocess wire (slow: the child pays a JAX import)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestSubprocessWire:
+    def test_child_serves_ops_and_answers_pipelines_typed(
+            self, telemetry, monkeypatch):
+        monkeypatch.setenv("VELES_SIMD_PLATFORM", "cpu")
+        with cluster.ReplicaGroup(1, spawn="subprocess",
+                                  heartbeat_ms=200,
+                                  obs_port=-1) as group:
+            r = group.replica("r0")
+            assert r.rpc is not None
+            x = _signal()
+            t = r.rpc.submit(op="sosfilt", x=x,
+                             params={"sos": SOS},
+                             deadline_ms=60000.0)
+            got = np.asarray(t.result(timeout=60.0))
+            want = np.asarray(batched.batched_sosfilt(
+                SOS, x[None, :], simd=False))[0]
+            np.testing.assert_allclose(got, want, rtol=2e-3,
+                                       atol=2e-3)
+            assert t.remote == "r0"
+            # an unregistered pipeline passes local classification
+            # (registration is the CHILD's) and answers bad_request
+            # across the wire — typed, never a hang
+            t2 = r.rpc.submit(op="pipeline:never_registered", x=x,
+                              params={}, deadline_ms=30000.0)
+            with pytest.raises(ValueError):
+                t2.result(timeout=30.0)
+            assert t2.status == "error"
+            # the fleet collector exports the client's health
+            stats = r.rpc.stats()
+            assert stats["sends"] >= 2
+            assert stats["in_flight"] == 0

@@ -17,6 +17,7 @@ from __future__ import annotations
 import importlib
 import inspect
 import os
+import re
 import sys
 from pathlib import Path
 
@@ -55,6 +56,7 @@ MODULES = [
     "veles.simd_tpu.serve.admission",
     "veles.simd_tpu.serve.health",
     "veles.simd_tpu.serve.cluster",
+    "veles.simd_tpu.serve.rpc",
     "veles.simd_tpu.serve.scaler",
     "veles.simd_tpu.utils.config",
     "veles.simd_tpu.utils.memory",
@@ -129,7 +131,10 @@ def render_module(modname: str) -> str:
     if constants:
         lines += ["## Constants", ""]
         for name, obj in constants:
-            rep = repr(obj)
+            # reprs of functions/objects embed per-process addresses;
+            # strip them so the committed doc is deterministic and the
+            # test_docs freshness gate can compare byte-for-byte
+            rep = re.sub(r" at 0x[0-9a-f]+", "", repr(obj))
             if len(rep) > 120:
                 rep = rep[:117] + "..."
             lines += [f"- **`{name}`** = `{rep}`"]

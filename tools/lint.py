@@ -924,6 +924,129 @@ def cluster_router_errors(tree, fname) -> list:
     return errors
 
 
+# --- rpc transport rule (serve/) --------------------------------------------
+# The RPC data plane (PR 20) has the same one-funnel shape as the
+# router rule above, one level down the stack: serve/rpc.py is the ONE
+# serve module allowed to open request-carrying transport to a replica
+# — it owns the wire schema (binary npy framing, never base64-JSON),
+# the deadline re-stamp (absolute deadlines become remaining budget on
+# the wire), the typed-error mapping, and the pooled keep-alive
+# connections.  A second transport path — an http.client connection, a
+# raw socket, a urllib POST someone adds later — silently re-invents
+# all four, wrong.  So in every serve module EXCEPT serve/rpc.py these
+# are lint failures:
+#
+# * importing ``http`` / ``http.client`` / ``socket`` under any alias
+#   (``import http.client as hc`` cannot dodge it);
+# * a body-carrying urllib submission: ``urlopen(...)`` with a data
+#   argument, or ``Request(...)`` with data= / a non-GET method=
+#   (alias-tracked through ``urllib.request`` module aliases and
+#   from-imports).
+#
+# Plain GET ``urlopen`` stays legal — that is the health/metrics
+# scrape idiom (cluster.py's probe loop and fleet collector), a read,
+# not a submission.
+
+_RPC_RULE_FILE = "veles/simd_tpu/serve/rpc.py"
+_RPC_BANNED_IMPORTS = {"http", "socket"}
+
+
+def _urllib_request_aliases(tree) -> tuple:
+    """(dotted prefixes bound to the urllib.request module, names
+    bound to urlopen, names bound to Request) — what the body-carrying
+    check below resolves call sites through."""
+    mods = set()
+    urlopen_names = set()
+    request_names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "urllib.request":
+                    mods.add(a.asname or a.name)
+                elif a.name == "urllib":
+                    mods.add((a.asname or a.name) + ".request")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "urllib.request":
+                for a in node.names:
+                    if a.name == "urlopen":
+                        urlopen_names.add(a.asname or a.name)
+                    elif a.name == "Request":
+                        request_names.add(a.asname or a.name)
+            elif node.module == "urllib":
+                for a in node.names:
+                    if a.name == "request":
+                        mods.add(a.asname or a.name)
+    return mods, urlopen_names, request_names
+
+
+def rpc_transport_errors(tree, fname) -> list:
+    """The rule body on a parsed module (separated so tests can feed
+    synthetic sources).  Returns human-readable error strings."""
+    errors = []
+    mods, urlopen_names, request_names = _urllib_request_aliases(tree)
+
+    def _carries_body(node, data_pos) -> bool:
+        """A call that ships a request body: a positional/keyword data
+        argument that is not literally None, or a method= that is not
+        a GET/HEAD string literal."""
+        if len(node.args) > data_pos:
+            arg = node.args[data_pos]
+            if not (isinstance(arg, ast.Constant)
+                    and arg.value is None):
+                return True
+        for kw in node.keywords:
+            if kw.arg == "data" and not (
+                    isinstance(kw.value, ast.Constant)
+                    and kw.value.value is None):
+                return True
+            if kw.arg == "method":
+                v = kw.value
+                if not (isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)
+                        and v.value.upper() in ("GET", "HEAD")):
+                    return True
+        return False
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            names = [a.name for a in node.names] \
+                if isinstance(node, ast.Import) \
+                else ([node.module] if node.module else [])
+            for m in names:
+                if m.split(".")[0] in _RPC_BANNED_IMPORTS:
+                    errors.append(
+                        f"{fname}:{node.lineno}: raw transport import "
+                        f"({m}) in a serve module — replica "
+                        "submissions ride the serve/rpc.py data plane "
+                        "(RpcClient), the one path that carries the "
+                        "deadline re-stamp, the typed-error mapping, "
+                        "and the binary wire schema")
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        chain = _dotted_chain(f)
+        is_urlopen = (
+            (isinstance(f, ast.Name) and f.id in urlopen_names)
+            or (chain is not None
+                and any(chain == m + ".urlopen" for m in mods)))
+        is_request = (
+            (isinstance(f, ast.Name) and f.id in request_names)
+            or (chain is not None
+                and any(chain == m + ".Request" for m in mods)))
+        if (is_urlopen or is_request) and _carries_body(node, 1):
+            shown = chain or (
+                f.id if isinstance(f, ast.Name) else "...")
+            errors.append(
+                f"{fname}:{node.lineno}: body-carrying urllib "
+                f"submission ({shown}"
+                "(...)) in a serve module — requests go to replicas "
+                "through the serve/rpc.py data plane (RpcClient), "
+                "never hand-rolled HTTP; GET scrapes of /healthz and "
+                "/metrics are the only legal urllib use here")
+    return errors
+
+
 # --- control axis rule (serve/scaler.py, obs v7) ----------------------------
 # The autoscaler's whole claim is that every scaling decision is
 # explainable from its journaled input vector — which is only true if
@@ -1714,6 +1837,13 @@ def compute_module_lint(files) -> int:
             for msg in fleet_funnel_errors(tree, str(f)):
                 print(msg)
                 failures += 1
+            # request-carrying transport funnels through the RPC data
+            # plane — serve/rpc.py is the one serve module allowed to
+            # open sockets toward a replica (PR 20)
+            if rel != _RPC_RULE_FILE:
+                for msg in rpc_transport_errors(tree, str(f)):
+                    print(msg)
+                    failures += 1
             if rel == _CLUSTER_RULE_FILE:
                 # the front router additionally funnels every replica
                 # submission through its one guarded path

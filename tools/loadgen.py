@@ -40,7 +40,16 @@ Three consumers:
   (the padding-waste baseline) then ON — writing
   ``GOODPUT_DETAILS.json`` rows (sample goodput, waste-recovery
   multiple, inverse-p99) and failing unless the measured padding
-  waste recovers >= 2x with p99 held.
+  waste recovers >= 2x with p99 held;
+* **`make bench-rpc`** — the RPC data-plane A/B (``--rpc-overhead``):
+  the same closed-loop traffic through a 2-replica in-process group
+  and an identical ``spawn="subprocess"`` group served over
+  :mod:`veles.simd_tpu.serve.rpc`, writing ``RPC_DETAILS.json`` rows
+  (subprocess/thread throughput ratio, inverse added-p50) and
+  failing if any request fails or the wire adds more than the p50
+  budget.  ``--replicas N --spawn subprocess`` also runs any normal
+  load (mixed ops + pipeline streams + deadlines + tenants) through
+  an RPC-served group.
 
 Usage::
 
@@ -200,6 +209,26 @@ def build_pipeline(name: str = PIPELINE_NAME,
     chain = pl.Pipeline([pl.sosfilt(sos, name="condition"),
                          pl.fir(h, name="shape")], name=name)
     return chain.compile(block)
+
+
+def pipeline_spec(name: str = PIPELINE_NAME,
+                  block: int = PIPELINE_BLOCK) -> dict:
+    """The declarative twin of :func:`build_pipeline` — the same
+    deterministic chain as a ``pipeline_from_spec`` dict, the form
+    that crosses a process boundary (``ReplicaGroup(...,
+    pipeline_specs=[...])`` hands it to subprocess children).  Built
+    from the same seeds, so the local compiled chain stays a valid
+    parity oracle for answers a child served."""
+    from veles.simd_tpu.ops import iir
+
+    sos = np.asarray(iir.butterworth(4, 0.2, "lowpass"))
+    rng = np.random.RandomState(7)
+    h = rng.randn(17).astype(np.float32) / 4.0
+    return {"name": name, "block": block,
+            "stages": [{"stage": "sosfilt", "name": "condition",
+                        "sos": sos.tolist()},
+                       {"stage": "fir", "name": "shape",
+                        "h": h.tolist()}]}
 
 
 def run_pipeline_streams(server, op: str, compiled, rng, *,
@@ -952,6 +981,136 @@ def journal_overhead_row(args, rng) -> dict:
             "telemetry": telemetry}
 
 
+# the rpc-overhead campaign's in-run acceptance bar: the p50 latency
+# the wire ADDS over an identical in-process group must stay inside
+# this budget (overridable with --rpc-p50-budget-ms).  Generous for a
+# loopback hop on purpose: a shared CPU CI host pays scheduler noise
+# on both sides, and the budget guards against a broken data plane
+# (seconds — a stalled pool, per-request reconnects), not against
+# microseconds of framing; the gated bench rows track the fine
+# trajectory via bench_regress.
+RPC_P50_BUDGET_MS = 75.0
+# client-side in-flight window of the throughput phase: deep enough
+# that RTT overlaps device time across the pool (the perf headline),
+# shallow enough that neither side's admission queue sheds
+RPC_WINDOW = 32
+
+
+def _closed_loop(router, schedule, window: int,
+                 timeout: float = 120.0) -> dict:
+    """Drive ``schedule`` closed-loop with at most ``window`` requests
+    in flight: submit a window, stamp each ticket's CLIENT-OBSERVED
+    latency (submit -> result, transport included — ``wait_s`` is the
+    server's own clock and would hide the wire), then the next.
+    ``window=1`` is the sequential latency probe; a deep window is
+    the throughput phase.  Returns wall time, completed count, and
+    the client latency list; any non-ok answer is a counted
+    failure (this is a clean-path probe — sheds or errors mean the
+    probe itself is mis-sized)."""
+    lat = []
+    failed = 0
+    done = 0
+    t0 = time.perf_counter()
+    for start in range(0, len(schedule), window):
+        chunk = schedule[start:start + window]
+        pairs = [(time.perf_counter(), router.submit(req))
+                 for _, req in chunk]
+        for ts, tk in pairs:
+            try:
+                tk.result(timeout=timeout)
+            except Exception:  # noqa: BLE001 — counted, gates the row
+                failed += 1
+                continue
+            lat.append(time.perf_counter() - ts)
+            done += 1
+    return {"wall_s": time.perf_counter() - t0, "completed": done,
+            "failed": failed, "latencies_s": lat}
+
+
+def rpc_campaign(args, rng) -> tuple:
+    """The RPC-overhead A/B (``--rpc-overhead``): the same
+    single-shape-class traffic served closed-loop through a
+    2-replica ``FrontRouter`` twice — ``spawn="thread"`` (in-process
+    submits, the baseline) and ``spawn="subprocess"`` (every request
+    over the pooled-keep-alive RPC data plane) — measuring what the
+    wire costs.  Two phases per side, after a warm pass that pays the
+    XLA compiles: a windowed throughput phase (RTT must overlap
+    device time across the connection pool) and a sequential
+    client-timed latency probe (the per-request added cost, transport
+    included).  Returns ``(report, rows, failed)``: the ``rpc
+    overhead`` row is the subprocess/thread throughput ratio and the
+    ``rpc added p50`` row the inverse added-p50 (both
+    higher-is-better for ``bench_regress``'s floor logic); ``failed``
+    trips when any request fails on either side or the added p50
+    blows the :data:`RPC_P50_BUDGET_MS` budget."""
+    n = int(args.requests)
+    probes = 80
+    sides: dict = {}
+    for spawn in ("thread", "subprocess"):
+        group = serve.ReplicaGroup(
+            2, spawn=spawn, max_batch=args.max_batch or 8,
+            max_wait_ms=args.max_wait_ms, workers=args.workers,
+            obs_port=-1)
+        router = serve.FrontRouter(group)
+        with group:
+            # warm: compile the probe's one handle on every replica
+            _closed_loop(router,
+                         _overhead_schedule(4 * RPC_WINDOW, rng),
+                         RPC_WINDOW)
+            thr = _closed_loop(router, _overhead_schedule(n, rng),
+                               RPC_WINDOW)
+            seq = _closed_loop(router,
+                               _overhead_schedule(probes, rng), 1)
+        ls = np.sort(np.asarray(seq["latencies_s"] or [0.0]))
+        sides[spawn] = {
+            "spawn": spawn,
+            "throughput_rps": (thr["completed"] / thr["wall_s"]
+                               if thr["wall_s"] > 0 else 0.0),
+            "p50_s": float(ls[len(ls) // 2]),
+            "completed": thr["completed"] + seq["completed"],
+            "failed": thr["failed"] + seq["failed"],
+        }
+    thread, sub = sides["thread"], sides["subprocess"]
+    ratio = (sub["throughput_rps"] / thread["throughput_rps"]
+             if thread["throughput_rps"] else None)
+    added_ms = max(0.0, (sub["p50_s"] - thread["p50_s"]) * 1e3)
+    budget_ms = float(args.rpc_p50_budget_ms)
+    report = {"mode": "rpc_overhead", "requests": n,
+              "window": RPC_WINDOW, "sides": sides,
+              "throughput_ratio": ratio,
+              "added_p50_ms": round(added_ms, 3),
+              "p50_budget_ms": budget_ms}
+    telemetry = {
+        "thread_rps": round(thread["throughput_rps"], 1),
+        "subprocess_rps": round(sub["throughput_rps"], 1),
+        "thread_p50_ms": round(thread["p50_s"] * 1e3, 3),
+        "subprocess_p50_ms": round(sub["p50_s"] * 1e3, 3),
+        "added_p50_ms": round(added_ms, 3),
+        "window": RPC_WINDOW, "requests": n, "spawn": "a/b",
+    }
+    rows = [{
+        "metric": "rpc overhead",
+        "value": round(ratio, 4) if ratio is not None else None,
+        "unit": "subprocess/thread throughput",
+        "vs_baseline": None,
+        "telemetry": telemetry,
+    }, {
+        # inverse added-p50 so higher is better (same convention as
+        # the p99 rows); the 0.05 ms floor keeps a same-or-faster
+        # subprocess side from minting an unrepeatable huge value
+        "metric": "rpc added p50",
+        "value": round(1.0 / max(added_ms, 0.05), 4),
+        "unit": "1/ms",
+        "vs_baseline": None,
+        "telemetry": telemetry,
+    }]
+    probe_failed = any(s["failed"] for s in sides.values())
+    budget_failed = added_ms > budget_ms
+    report["gates"] = {"clean": not probe_failed,
+                       "p50_budget": not budget_failed}
+    return report, rows, probe_failed or budget_failed
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--requests", type=int, default=300)
@@ -994,10 +1153,26 @@ def main(argv=None) -> int:
                          "disarmed)")
     ap.add_argument("--replicas", type=int, default=1,
                     help="serve through a FrontRouter over N "
-                         "in-process replicas (1 = single server; "
+                         "replicas (1 = single server; "
                          "0 = $VELES_SIMD_REPLICAS, default 2; "
                          "per-replica answered counts land in the "
                          "report)")
+    ap.add_argument("--spawn", choices=("thread", "subprocess"),
+                    default="thread",
+                    help="replica spawn mode for --replicas runs: "
+                         "in-process servers, or child processes "
+                         "served over the RPC data plane")
+    ap.add_argument("--rpc-overhead", action="store_true",
+                    help="RPC-overhead A/B campaign: the same "
+                         "closed-loop traffic through an in-process "
+                         "group then an identical subprocess group; "
+                         "writes RPC_DETAILS rows; rc=1 on any "
+                         "failed request or an added p50 over the "
+                         "budget")
+    ap.add_argument("--rpc-p50-budget-ms", type=float,
+                    default=RPC_P50_BUDGET_MS,
+                    help="--rpc-overhead hard gate: max p50 latency "
+                         "the wire may add over in-process")
     ap.add_argument("--overhead-requests", type=int, default=600,
                     help="requests per side of the tracing-overhead "
                          "probe in --details mode (0 = skip)")
@@ -1021,6 +1196,19 @@ def main(argv=None) -> int:
                   f"{report['gates']}", file=sys.stderr)
             return 1
         return 0
+    if args.rpc_overhead:
+        rng = np.random.RandomState(args.seed)
+        report, rows, failed = rpc_campaign(args, rng)
+        print(json.dumps(report, indent=2, default=str))
+        details = args.details or "RPC_DETAILS.json"
+        with open(details, "w") as f:
+            json.dump(rows, f, indent=2)
+        print(f"loadgen: wrote {details}", file=sys.stderr)
+        if failed:
+            print(f"loadgen: rpc gates FAILED {report['gates']}",
+                  file=sys.stderr)
+            return 1
+        return 0
     if args.smoke:
         args.requests = min(args.requests, 80)
         args.rate = 0.0
@@ -1028,20 +1216,31 @@ def main(argv=None) -> int:
     schedule = build_schedule(rng, args.requests, args.rate,
                               args.burst_every, args.burst_size,
                               deadline_ms=args.deadline_ms)
+    pipeline_streams = args.pipeline_streams
+    if pipeline_streams is None:
+        pipeline_streams = 2 if args.smoke and args.replicas == 1 \
+            else 0
     group = None
     if args.replicas != 1:
-        # the replica-group front: N in-process servers behind the
-        # breaker-aware router, ONE aggregation scrape endpoint
-        # (--replicas 0 defers to $VELES_SIMD_REPLICAS); the
-        # pipeline leg registers on every replica through the group
+        # the replica-group front: N servers behind the breaker-aware
+        # router, ONE aggregation scrape endpoint (--replicas 0
+        # defers to $VELES_SIMD_REPLICAS); the pipeline leg registers
+        # on every replica through the group — declaratively
+        # (pipeline_specs) for subprocess replicas, whose children
+        # rebuild and register the chain before taking traffic
+        specs = ([pipeline_spec()]
+                 if args.spawn == "subprocess" and pipeline_streams
+                 else None)
         group = serve.ReplicaGroup(args.replicas
                                    if args.replicas > 1 else None,
+                                   spawn=args.spawn,
                                    max_batch=args.max_batch,
                                    max_wait_ms=args.max_wait_ms,
                                    queue_depth=args.queue_depth,
                                    tenant_depth=args.tenant_depth,
                                    workers=args.workers,
-                                   obs_port=args.obs_port)
+                                   obs_port=args.obs_port,
+                                   pipeline_specs=specs)
         server = serve.FrontRouter(group)
     else:
         server = serve.Server(max_batch=args.max_batch,
@@ -1055,9 +1254,6 @@ def main(argv=None) -> int:
     # not that a CPU smoke hits production latencies)
     for tenant in DEFAULT_TENANTS:
         obs.slo(tenant, target_ms=30000.0, hit_rate=0.99)
-    pipeline_streams = args.pipeline_streams
-    if pipeline_streams is None:
-        pipeline_streams = 2 if args.smoke and group is None else 0
     with (group if group is not None else server):
         report = run_load(server, schedule, block=args.block,
                           verify=args.verify, rng=rng)
@@ -1073,10 +1269,16 @@ def main(argv=None) -> int:
         report["scrape"] = scrape_endpoint(server.obs_port)
         if pipeline_streams > 0:
             compiled = build_pipeline()
-            op = (group.register_pipeline(PIPELINE_NAME, compiled)
-                  if group is not None
-                  else server.register_pipeline(PIPELINE_NAME,
-                                                compiled))
+            if group is not None and args.spawn == "subprocess":
+                # the children already registered the declarative
+                # twin of this chain at start; the local compile is
+                # the parity oracle
+                op = f"pipeline:{PIPELINE_NAME}"
+            elif group is not None:
+                op = group.register_pipeline(PIPELINE_NAME, compiled)
+            else:
+                op = server.register_pipeline(PIPELINE_NAME,
+                                              compiled)
             prep = run_pipeline_streams(
                 server, op, compiled, rng,
                 streams=pipeline_streams,

@@ -20,7 +20,9 @@ its three routes:
 ``--fleet`` switches to the fleet axis: one screen rendered from
 ``/signals`` (the typed ``obs.signals()`` bundle the ``ReplicaGroup``
 collector feeds) — per-replica health/staleness/queue-depth/breaker
-rows, goodput by shape class, SLO burn + velocity, and a unicode
+rows, the RPC data plane's per-replica in-flight / connection-reuse /
+transport-error block (subprocess groups), goodput by shape class,
+SLO burn + velocity, and a unicode
 sparkline over the last-N windowed samples of each per-replica series.
 When the process runs an armed autoscaler the frame adds the control
 axis from ``/scaler`` (obs v7): tick count, alive vs bounds, cooldown,
@@ -227,6 +229,21 @@ def render_fleet(base_url: str) -> tuple:
                 rid, health[rid], _fmt_s(stale.get(rid)),
                 depth.get(rid, "-"), b_open.get(rid, 0),
                 b_flaps.get(rid, 0), scrape.get(rid, 0)))
+    rpc = sig.get("rpc") or {}
+    if rpc:
+        # the RPC data plane (subprocess groups): what the router's
+        # pooled client sees per replica — alongside scrape staleness,
+        # this is the "is the wire healthy" read
+        lines.append("rpc data plane:")
+        for rid in sorted(rpc):
+            row = rpc[rid] or {}
+            ratio = row.get("reuse_ratio")
+            lines.append(
+                "  %-8s in_flight=%-5s conn_reuse=%-8s "
+                "transport_errors=%s" % (
+                    rid, row.get("in_flight", "-"),
+                    "-" if ratio is None else "%.2f" % ratio,
+                    row.get("transport_errors", 0)))
     occ = sig.get("occupancy") or {}
     if occ:
         # the padding-aware placement signal: rows already queued in

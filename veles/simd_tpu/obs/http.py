@@ -36,9 +36,16 @@ as the rest of the tooling):
   (:func:`veles.simd_tpu.obs.scaler_snapshot`): the registered
   autoscaler engine's state — tick count, per-action streaks,
   cooldown, bounds, and the recent decision records with their full
-  input vectors — or the disarmed shell when no scaler runs here.
+  input vectors — or the disarmed shell when no scaler runs here;
+* ``POST /submit`` — the one WRITE route, armed only when the owning
+  process registered a submit handler (the serving layer binds
+  :func:`veles.simd_tpu.serve.rpc.serve_submit`): binary npy-framed
+  request in, binary npy-framed response out — the RPC data plane a
+  ``spawn="subprocess"`` replica serves router traffic over.  The
+  endpoint speaks HTTP/1.1 so the router's pooled connections
+  persist across requests.
 
-The JSON routes are schema-stamped (``veles-simd-signals-v3``,
+The JSON routes are schema-stamped (``veles-simd-signals-v4``,
 ``veles-simd-requests-v1``, ``veles-simd-incidents-v1``,
 ``veles-simd-scaler-v1``) so a dashboard can detect contract drift
 instead of mis-parsing.
@@ -103,22 +110,62 @@ class EndpointUnavailable(OSError):
 
 
 class _Handler(http.server.BaseHTTPRequestHandler):
-    """The read-only routes.  Every handler is exception-proofed
-    into a 500 — a scrape must never kill the serving process, and a
-    half-written response must never wedge the scraper."""
+    """The read-only routes plus the one write route (``POST
+    /submit``, armed only when the owner registered a submit
+    handler).  Every handler is exception-proofed into a 500 — a
+    scrape must never kill the serving process, and a half-written
+    response must never wedge the scraper."""
+
+    # HTTP/1.1 so the RPC data plane's pooled connections actually
+    # persist (HTTP/1.0 closes after every exchange); every response
+    # below sends Content-Length, which 1.1 keep-alive requires
+    protocol_version = "HTTP/1.1"
+
+    # headers and body leave as separate writes; without TCP_NODELAY
+    # that is a Nagle + delayed-ACK stall (~40ms) on EVERY rpc
+    # exchange — latency the data plane cannot afford
+    disable_nagle_algorithm = True
 
     # the endpoint belongs to telemetry; its access log does not get
     # to spam the serving process's stderr
     def log_message(self, fmt, *args):  # noqa: A003
         pass
 
-    def _send(self, code: int, body: str, ctype: str) -> None:
-        data = body.encode("utf-8")
+    def _send_bytes(self, code: int, data: bytes,
+                    ctype: str) -> None:
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        self._send_bytes(code, body.encode("utf-8"), ctype)
+
+    def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        try:
+            path = self.path.split("?", 1)[0]
+            submit = self.server.owner.submit_handler
+            if path != "/submit" or submit is None:
+                self._send(404, json.dumps(
+                    {"error": "unknown path",
+                     "routes": (["/submit"] if submit is not None
+                                else [])}),
+                    "application/json")
+                return
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length > 0 else b""
+            code, payload = submit(body)
+            self._send_bytes(int(code), payload,
+                             "application/octet-stream")
+        except BrokenPipeError:
+            pass        # client hung up mid-response: its problem
+        except Exception as e:  # noqa: BLE001 — a request never kills
+            try:
+                self._send(500, json.dumps({"error": repr(e)}),
+                           "application/json")
+            except Exception:  # noqa: BLE001
+                pass
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
         try:
@@ -187,10 +234,15 @@ class ObsEndpoint:
     """One armed scrape endpoint: the bound port, the serving daemon
     thread, and :meth:`stop`.  ``health`` is an optional zero-arg
     callable returning a JSON-native dict for ``/healthz`` (the
-    serving layer passes its ``stats``)."""
+    serving layer passes its ``stats``).  ``submit`` is an optional
+    ``(body_bytes) -> (http_code, response_bytes)`` callable arming
+    the ``POST /submit`` RPC route (the serving layer passes
+    ``serve.rpc.serve_submit`` bound to its server; None leaves the
+    endpoint read-only)."""
 
-    def __init__(self, port: int, health=None):
+    def __init__(self, port: int, health=None, submit=None):
         self._health = health
+        self.submit_handler = submit
         try:
             self._httpd = _Server((BIND_HOST, int(port)), _Handler)
         except OSError as e:
@@ -244,12 +296,15 @@ class ObsEndpoint:
         return f"ObsEndpoint(port={self.port})"
 
 
-def start(port: int | None = None, health=None) -> ObsEndpoint | None:
+def start(port: int | None = None, health=None,
+          submit=None) -> ObsEndpoint | None:
     """Arm the endpoint on ``port`` (None = ``$VELES_SIMD_OBS_PORT``;
-    still None = disarmed, returns None; 0 = ephemeral).  Returns the
-    live :class:`ObsEndpoint` — the caller owns :meth:`stop`."""
+    still None = disarmed, returns None; 0 = ephemeral).  ``submit``
+    arms the ``POST /submit`` RPC route (see :class:`ObsEndpoint`).
+    Returns the live :class:`ObsEndpoint` — the caller owns
+    :meth:`stop`."""
     if port is None:
         port = env_port()
     if port is None:
         return None
-    return ObsEndpoint(int(port), health=health)
+    return ObsEndpoint(int(port), health=health, submit=submit)

@@ -139,6 +139,9 @@ class _NullRequestTrace:
     def finish(self, status: str, **fields) -> None:
         pass
 
+    def absorb_remote(self, events, replica=None) -> None:
+        pass
+
     def events(self) -> list:
         return []
 
@@ -208,6 +211,39 @@ class RequestTrace:
             self._events.append({"event": terminal, "t_s": t,
                                  "status": str(status), **fields})
         self._tracer._finished(self)
+
+    def absorb_remote(self, events, replica=None) -> None:
+        """Splice another process's trace events into this chain (the
+        RPC client calls this with the replica-side events its reply
+        carried, BEFORE completing the ticket).  Remote terminal
+        edges are dropped — this trace closes through its own
+        :meth:`finish`, exactly once — and lifecycle edges keep their
+        names (``batch_formed``/``dispatched`` stay real phase
+        anchors) plus a ``replica`` tag marking the process boundary.
+
+        Remote stamps are re-anchored so the LAST absorbed edge lands
+        at the splice instant on this trace's clock: monotonic clocks
+        do not cross process boundaries, but the whole remote chain
+        finished before the reply arrived, so ordering (and the
+        phases-sum-to-total invariant) holds by construction.  No-op
+        once terminal or for an empty event list."""
+        terminal_names = set(TERMINAL_STATUSES.values())
+        remote = [dict(e) for e in events
+                  if isinstance(e, dict)
+                  and e.get("event") not in terminal_names
+                  and isinstance(e.get("t_s"), (int, float))]
+        if not remote:
+            return
+        now = time.perf_counter() - self._t0
+        offset = now - max(e["t_s"] for e in remote)
+        with self._lock:
+            if self.status is not None:
+                return
+            for e in remote:
+                e["t_s"] = max(0.0, offset + float(e["t_s"]))
+                if replica is not None:
+                    e.setdefault("replica", replica)
+                self._events.append(e)
 
     # -- reads ---------------------------------------------------------------
 

@@ -53,7 +53,7 @@ __all__ = [
 # loses, or re-types a field, so dashboards can detect drift instead
 # of mis-parsing (obs v6 added incidents + journal; obs v7 added
 # replica_count + birth_age_s + scaler)
-SIGNALS_SCHEMA = "veles-simd-signals-v3"
+SIGNALS_SCHEMA = "veles-simd-signals-v4"
 
 FLEET_TICK_MS_ENV = "VELES_SIMD_FLEET_TICK_MS"
 FLEET_WINDOW_ENV = "VELES_SIMD_FLEET_WINDOW"
@@ -288,6 +288,10 @@ class FleetSignals:
     ``scrape_stale``      {replica: failed-scrape count (subprocess mode)}
     ``replica_count``     {"up"/"draining"/"down": group membership now}
     ``birth_age_s``       {replica: seconds since its Replica was born}
+    ``rpc``               {replica: {in_flight, reuse_ratio,
+                          transport_errors}} — the RPC data plane's
+                          health per subprocess replica (empty for
+                          thread-mode groups)
     ``incidents``         open incidents (obs v6 incident engine)
     ``journal``           journal health: armed/records/dropped/lag_s
     ``scaler``            control-axis summary (obs v7): armed/ticks/
@@ -301,7 +305,7 @@ class FleetSignals:
                  "breaker_flaps", "goodput", "goodput_overall",
                  "padding_waste", "health", "staleness_s",
                  "scrape_stale", "replica_count", "birth_age_s",
-                 "incidents", "journal", "scaler", "series")
+                 "rpc", "incidents", "journal", "scaler", "series")
 
     def __init__(self, **kw):
         missing = [n for n in self.__slots__ if n not in kw]
@@ -348,10 +352,20 @@ class FleetSignals:
         ages = {}
         tick_s = fleet.tick_s
         stale_after = (STALE_TICKS * tick_s) if tick_s else None
+        rpc = {}
         for r in replicas:
             d = fleet.value(r, "depth")
             if d is not None:
                 depth[r] = d
+            inflight = fleet.value(r, "rpc_in_flight")
+            if inflight is not None:
+                rpc[r] = {
+                    "in_flight": int(inflight),
+                    "reuse_ratio": fleet.value(r, "rpc_reuse_ratio"),
+                    "transport_errors": int(
+                        fleet.value(r, "rpc_transport_errors")
+                        or 0),
+                }
             occ = fleet.value(r, "occupancy")
             if occ is not None:
                 occupancy[r] = occ
@@ -424,7 +438,7 @@ class FleetSignals:
                            else 1.0 - overall),
             health=health, staleness_s=stale,
             scrape_stale=scrape_stale,
-            replica_count=counts, birth_age_s=ages,
+            replica_count=counts, birth_age_s=ages, rpc=rpc,
             incidents=list(incidents or []),
             journal=dict(journal or {"armed": False}),
             scaler=dict(scaler or {"armed": False}),

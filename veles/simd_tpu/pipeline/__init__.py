@@ -21,8 +21,15 @@ the chain the unit of compilation and serving:
 * **serve** it: ``serve.Server.register_pipeline(name, compiled)``
   makes pipeline invocations (block + carried state) first-class
   requests through the deadline batcher, admission control, and
-  per-pipeline-class breakers.
+  per-pipeline-class breakers;
+* **ship** it: :func:`pipeline_from_spec` rebuilds a compiled chain
+  from a pure-JSON spec (``{"name", "block", "stages": [{"stage":
+  "sosfilt", "sos": [...]}, ...]}``) — how ``spawn="subprocess"``
+  replicas receive their pipelines over the ``_replica_main`` command
+  line and register them before serving RPC traffic.
 """
+
+import numpy as _np
 
 from veles.simd_tpu.pipeline.compiler import (PIPELINE_SITE,
                                               CompiledPipeline,
@@ -38,5 +45,65 @@ __all__ = [
     "Pipeline", "CompiledPipeline", "PIPELINE_SITE", "Stage",
     "fir", "correlate", "matched_filter", "sosfilt", "resample_poly",
     "medfilt", "detrend", "stft", "power", "power_db", "welch",
-    "savgol", "detect_peaks",
+    "savgol", "detect_peaks", "pipeline_from_spec", "SPEC_FACTORIES",
 ]
+
+# the declarative surface: spec {"stage": <key>} resolves through this
+# table, so a spec can only name the public stage factories
+SPEC_FACTORIES = {
+    "fir": fir, "correlate": correlate,
+    "matched_filter": matched_filter, "sosfilt": sosfilt,
+    "resample_poly": resample_poly, "medfilt": medfilt,
+    "detrend": detrend, "stft": stft, "power": power,
+    "power_db": power_db, "welch": welch, "savgol": savgol,
+    "detect_peaks": detect_peaks,
+}
+
+
+def pipeline_from_spec(spec: dict) -> CompiledPipeline:
+    """Compile a chain from a pure-JSON declarative spec.
+
+    ``spec`` is ``{"name": str, "block": int, "stages": [{"stage":
+    factory_key, **kwargs}, ...]}`` where ``factory_key`` names an
+    entry of :data:`SPEC_FACTORIES` and the remaining keys are that
+    factory's keyword arguments (list-valued kwargs — filter taps,
+    SOS rows, windows — become float64 arrays).  This is the form a
+    pipeline crosses a process boundary in: the parent serializes the
+    spec, the ``serve.cluster._replica_main`` child rebuilds and
+    registers the compiled chain before taking traffic.  Malformed
+    specs raise ``ValueError`` (typed, never a half-built chain)."""
+    if not isinstance(spec, dict):
+        raise ValueError(f"pipeline spec must be a dict, got "
+                         f"{type(spec).__name__}")
+    name = spec.get("name")
+    if not isinstance(name, str) or not name:
+        raise ValueError("pipeline spec needs a non-empty 'name'")
+    try:
+        block = int(spec.get("block"))
+    except (TypeError, ValueError):
+        raise ValueError(f"pipeline spec {name!r} needs an integer "
+                         f"'block'") from None
+    raw_stages = spec.get("stages")
+    if not isinstance(raw_stages, (list, tuple)) or not raw_stages:
+        raise ValueError(f"pipeline spec {name!r} needs a non-empty "
+                         f"'stages' list")
+    stages = []
+    for i, entry in enumerate(raw_stages):
+        if not isinstance(entry, dict) or "stage" not in entry:
+            raise ValueError(f"pipeline spec {name!r} stage #{i} must "
+                             f"be a dict with a 'stage' key")
+        key = entry["stage"]
+        factory = SPEC_FACTORIES.get(key)
+        if factory is None:
+            raise ValueError(
+                f"pipeline spec {name!r} stage #{i}: unknown stage "
+                f"{key!r} (known: {sorted(SPEC_FACTORIES)})")
+        kwargs = {k: (_np.asarray(v, dtype=_np.float64)
+                      if isinstance(v, (list, tuple)) else v)
+                  for k, v in entry.items() if k != "stage"}
+        try:
+            stages.append(factory(**kwargs))
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"pipeline spec {name!r} stage #{i} "
+                             f"({key}): {e}") from e
+    return Pipeline(stages, name=name).compile(block)

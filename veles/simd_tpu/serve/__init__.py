@@ -37,6 +37,16 @@ the traffic or the hardware misbehaves:
   placement per shape class, failover re-submission with the
   original deadline carried, group-wide zero-double-answer dedup
   (``make chaos-replicas`` is the scripted proof);
+* :mod:`~veles.simd_tpu.serve.rpc` — the RPC data plane (PR 20):
+  ``spawn="subprocess"`` replicas grow a ``POST /submit`` route on
+  their obs endpoint serving the full request surface, and the
+  router submits through a pooled persistent-connection
+  :class:`~veles.simd_tpu.serve.rpc.RpcClient` — binary npy framing
+  (never base64-JSON), deadlines re-stamped as remaining budget on
+  the wire, the typed-error surface crossing losslessly, transport
+  failures answering as ``closed`` tickets the failover hook
+  re-routes (``make chaos-replicas-rpc`` is the scripted proof, ``make
+  bench-rpc`` the gated overhead bench);
 * :mod:`~veles.simd_tpu.serve.scaler` — the control axis (obs v7): an
   SLO-driven autoscaler on the group (``ReplicaGroup(scaler=True)``
   or ``VELES_SIMD_SCALER=1``) that reads only the typed
@@ -88,6 +98,8 @@ from veles.simd_tpu.serve.cluster import (HEARTBEAT_MS_ENV,
                                           FrontRouter,
                                           NoReplicaAvailable,
                                           ReplicaGroup, RouterTicket)
+from veles.simd_tpu.serve.rpc import (RPC_CONNS_ENV, RPC_TIMEOUT_ENV,
+                                      RpcClient, RpcTicket)
 from veles.simd_tpu.serve.scaler import ARM_ENV as SCALER_ARM_ENV
 from veles.simd_tpu.serve.scaler import \
     TICK_MS_ENV as SCALER_TICK_MS_ENV
@@ -104,6 +116,8 @@ __all__ = [
     "SUPPORTED_OPS", "HEALTHY", "DEGRADED",
     "ReplicaGroup", "FrontRouter", "RouterTicket",
     "NoReplicaAvailable", "ScalerEngine",
+    "RpcClient", "RpcTicket",
+    "RPC_CONNS_ENV", "RPC_TIMEOUT_ENV",
     "SCALER_ARM_ENV", "SCALER_TICK_MS_ENV",
     "MAX_BATCH_ENV", "MAX_WAIT_ENV", "QUEUE_DEPTH_ENV",
     "TENANT_DEPTH_ENV", "DEADLINE_ENV", "REPLICAS_ENV",

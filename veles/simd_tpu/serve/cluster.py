@@ -77,15 +77,24 @@ the way PRs 9-10 proved a single server survives losing a device:
   proof).
 
 **Spawn modes.** ``spawn="thread"`` (default) runs replicas as
-in-process servers — the CI topology, and the only one the router can
-place requests on today.  ``spawn="subprocess"`` runs each replica as
-a child process (``python -m veles.simd_tpu.serve.cluster``) that
-arms its own ``/healthz`` + ``/metrics`` endpoint and reports its
-port; the group heartbeats it over HTTP — the same group/heartbeat/
-drain topology against process-isolated replicas, so the layer
-generalizes to real multi-host serving (the RPC submission path is
-the ROADMAP's multi-host item; :class:`FrontRouter` raises a typed
-error on a subprocess group rather than pretending).
+in-process servers — the CI topology.  ``spawn="subprocess"`` runs
+each replica as a child process (``python -m
+veles.simd_tpu.serve.cluster``) that arms its own ``/healthz`` +
+``/metrics`` + ``POST /submit`` endpoint and reports its port; the
+group heartbeats it over HTTP, and the :class:`FrontRouter` places
+requests on it over the RPC data plane
+(:mod:`veles.simd_tpu.serve.rpc`): each subprocess replica carries a
+pooled persistent-connection :class:`~veles.simd_tpu.serve.rpc.
+RpcClient`, requests cross the wire in binary npy framing with the
+remaining deadline budget re-stamped per attempt, and the typed
+errors (``Overloaded`` / ``DeadlineExceeded`` / ``ServerClosed`` /
+shed) map losslessly back — so failover, shed, and carried-deadline
+semantics are identical to the in-process path and both spawn modes
+flow through the same ``_submit_to_replica`` funnel.  Pipelines
+cross the process boundary declaratively: pass ``pipeline_specs=``
+(:func:`veles.simd_tpu.pipeline.pipeline_from_spec` specs) to the
+group and each child rebuilds, compiles, and registers them before
+reporting ready.
 
 Usage::
 
@@ -123,6 +132,7 @@ from veles.simd_tpu.obs import journal as obs_journal
 from veles.simd_tpu.obs import timeseries as _timeseries
 from veles.simd_tpu.runtime import breaker as _breaker
 from veles.simd_tpu.runtime import faults
+from veles.simd_tpu.serve import rpc as _rpc
 from veles.simd_tpu.serve import scaler as _scaler
 from veles.simd_tpu.serve.admission import Overloaded
 from veles.simd_tpu.serve.server import (DeadlineExceeded, Request,
@@ -257,11 +267,17 @@ class Replica:
     state, heartbeat bookkeeping, and the spawn-mode-specific start /
     ping / stop plumbing.  Thread mode holds a live in-process
     :class:`Server` (named ``rid``, so its breakers/health are
-    replica-keyed); subprocess mode holds a child process plus the
-    port of its ``/healthz``+``/metrics`` endpoint."""
+    replica-keyed); subprocess mode holds a child process, the port
+    of its ``/healthz``+``/metrics``+``/submit`` endpoint, and the
+    pooled :class:`~veles.simd_tpu.serve.rpc.RpcClient` the router
+    places requests through.  ``pipeline_specs`` (declarative
+    :func:`~veles.simd_tpu.pipeline.pipeline_from_spec` dicts) are
+    forwarded to a subprocess child, which registers the compiled
+    chains before reporting ready."""
 
     def __init__(self, rid: str, *, spawn: str = "thread",
-                 server_kwargs: dict | None = None):
+                 server_kwargs: dict | None = None,
+                 pipeline_specs: list | None = None):
         self.rid = str(rid)
         self.spawn = spawn
         self.state = UP
@@ -278,6 +294,12 @@ class Replica:
         self.server: Server | None = None
         self.proc = None
         self.port = None
+        # the RPC data plane handle (subprocess mode only): armed in
+        # start() once the child reports its port, closed after the
+        # child is gone so in-flight answers drain first
+        self.rpc: _rpc.RpcClient | None = None
+        self._pipeline_specs = [dict(s) for s in
+                                (pipeline_specs or [])]
         self._kwargs = dict(server_kwargs or {})
         if spawn == "thread":
             # per-replica endpoints stay disarmed: the group owns ONE
@@ -324,6 +346,12 @@ class Replica:
             value = self._kwargs.get(key)
             if value is not None:
                 cmd += [flag, str(value)]
+        # pipelines cross the process boundary declaratively: the
+        # child rebuilds + registers each spec before reporting ready,
+        # so the router never places pipeline traffic on a replica
+        # that would answer "unregistered pipeline"
+        for spec in self._pipeline_specs:
+            cmd += ["--pipeline-spec", json.dumps(spec)]
         self.proc = subprocess.Popen(
             cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             text=True)
@@ -360,6 +388,11 @@ class Replica:
             if isinstance(msg, dict) \
                     and msg.get("port") is not None:
                 self.port = int(msg["port"])
+                # arm the data plane: pooled keep-alive connections
+                # into the child's POST /submit route
+                self.rpc = _rpc.RpcClient(obs_http.BIND_HOST,
+                                          self.port,
+                                          replica=self.rid)
                 return
 
     def ping(self) -> dict:
@@ -402,6 +435,11 @@ class Replica:
         elif self.proc is not None:
             self.proc.kill()
             self.proc.wait()
+            if self.rpc is not None:
+                # after the child is gone: every in-flight RPC hits a
+                # dead socket and answers typed "closed" — the
+                # failover hook re-routes exactly as in thread mode
+                self.rpc.close()
 
     def drain_stop(self) -> None:
         """Graceful stop: queued and in-flight work is answered by
@@ -418,6 +456,11 @@ class Replica:
             except subprocess.TimeoutExpired:
                 self.proc.terminate()
                 self.proc.wait()
+            if self.rpc is not None:
+                # the child drained before exiting, so its answers
+                # are already on the wire; close() lets the sender
+                # threads finish them, then typed-closes stragglers
+                self.rpc.close()
 
     def snapshot(self) -> dict:
         """JSON-native view for the group's aggregation endpoint."""
@@ -436,6 +479,10 @@ class Replica:
             info["port"] = self.port
             if self.proc is not None:
                 info["returncode"] = self.proc.poll()
+            if self.rpc is not None:
+                # the data-plane health block obs_dash --fleet reads:
+                # in-flight, connection-reuse ratio, transport errors
+                info["rpc"] = self.rpc.stats()
         return info
 
 
@@ -455,6 +502,7 @@ class ReplicaGroup:
                  scaler: bool | None = None,
                  scaler_tick_ms: float | None = None,
                  scaler_kwargs: dict | None = None,
+                 pipeline_specs: list | None = None,
                  **server_kwargs):
         n = int(replicas) if replicas else env_replicas()
         if n < 1:
@@ -471,8 +519,16 @@ class ReplicaGroup:
         # without the replay, the router would place pipeline traffic
         # onto a replica that answers "unregistered pipeline")
         self._group_pipelines: dict = {}
+        # declarative pipeline specs (pipeline_from_spec dicts): the
+        # ONE pipeline spelling that survives a process boundary —
+        # thread mode compiles + registers them at start(); subprocess
+        # children rebuild them from their command line
+        self._pipeline_specs = [dict(s) for s in
+                                (pipeline_specs or [])]
         self.replicas = [
-            Replica(f"r{i}", spawn=spawn, server_kwargs=server_kwargs)
+            Replica(f"r{i}", spawn=spawn,
+                    server_kwargs=server_kwargs,
+                    pipeline_specs=self._pipeline_specs)
             for i in range(n)]
         self._by_rid = {r.rid: r for r in self.replicas}
         self._lock = threading.Lock()
@@ -531,6 +587,16 @@ class ReplicaGroup:
                 self._endpoint = None
             raise
         self._started = True
+        if self.spawn == "thread" and self._pipeline_specs:
+            # subprocess children registered their specs before
+            # reporting ready; thread replicas compile + register the
+            # same specs here, so both spawn modes answer the same
+            # pipeline surface
+            from veles.simd_tpu import pipeline as _pl
+
+            for spec in self._pipeline_specs:
+                self.register_pipeline(spec["name"],
+                                       _pl.pipeline_from_spec(spec))
         for r in self.replicas:
             t = threading.Thread(target=self._probe_replica,
                                  args=(r,), daemon=True,
@@ -689,7 +755,8 @@ class ReplicaGroup:
             old.state = RESTARTING
         try:
             fresh = Replica(rid, spawn=self.spawn,
-                            server_kwargs=self._server_kwargs)
+                            server_kwargs=self._server_kwargs,
+                            pipeline_specs=self._pipeline_specs)
             fresh.start()
             if self.spawn == "thread":
                 # a fresh Server has no pipeline registrations —
@@ -737,7 +804,8 @@ class ReplicaGroup:
             rid = f"r{self._next_rid}"
             self._next_rid += 1
         fresh = Replica(rid, spawn=self.spawn,
-                        server_kwargs=self._server_kwargs)
+                        server_kwargs=self._server_kwargs,
+                        pipeline_specs=self._pipeline_specs)
         fresh.start()
         if self.spawn == "thread":
             for name, compiled in self._group_pipelines.items():
@@ -784,9 +852,11 @@ class ReplicaGroup:
         by :meth:`restart` gets the same registrations replayed."""
         if self.spawn != "thread":
             raise ValueError(
-                "pipeline registration needs in-process replicas "
-                "(spawn='thread'); subprocess replicas own their own "
-                "registrations")
+                "a compiled pipeline cannot cross a process boundary "
+                "— pass pipeline_specs= (declarative "
+                "pipeline_from_spec dicts) to the group instead; "
+                "subprocess replicas rebuild and register them "
+                "before taking traffic")
         op = None
         for r in self.replicas:
             op = r.server.register_pipeline(name, compiled)
@@ -935,6 +1005,22 @@ class ReplicaGroup:
             else:
                 import urllib.request
 
+                rpc = getattr(r, "rpc", None)
+                if rpc is not None:
+                    # the data plane's own health, sampled from the
+                    # parent-side client (no scrape needed): what
+                    # obs_dash --fleet shows next to scrape staleness
+                    rstats = rpc.stats()
+                    obs.fleet_record(r.rid, "rpc_in_flight",
+                                     float(rstats["in_flight"]),
+                                     t_s=now)
+                    obs.fleet_record(
+                        r.rid, "rpc_reuse_ratio",
+                        float(rstats["reuse_ratio"] or 0.0),
+                        t_s=now)
+                    obs.fleet_record(
+                        r.rid, "rpc_transport_errors",
+                        float(rstats["transport_errors"]), t_s=now)
                 url = (f"http://{obs_http.BIND_HOST}:{r.port}"
                        f"/metrics")
                 try:
@@ -1121,8 +1207,13 @@ class RouterTicket:
 
 
 class FrontRouter:
-    """Breaker-aware placement + failover over a thread-mode
-    :class:`ReplicaGroup` (module docstring has the semantics).
+    """Breaker-aware placement + failover over a
+    :class:`ReplicaGroup` — thread-mode replicas through in-process
+    submits, subprocess replicas through their pooled
+    :class:`~veles.simd_tpu.serve.rpc.RpcClient` data plane, both
+    through the same ``_submit_to_replica`` funnel so the failover /
+    shed / carried-deadline semantics are identical (module
+    docstring has the full story).
 
     ``policy`` is ``least_loaded`` (default;
     ``$VELES_SIMD_ROUTER_POLICY``) or ``round_robin``;
@@ -1133,12 +1224,6 @@ class FrontRouter:
                  policy: str | None = None,
                  max_failovers: int | None = None,
                  occupancy_weight: float | None = None):
-        if group.spawn != "thread":
-            raise ValueError(
-                "FrontRouter places requests on in-process replicas "
-                "(spawn='thread'); a subprocess group only exposes "
-                "health/metrics today — multi-host request placement "
-                "is the ROADMAP's RPC item")
         self.group = group
         self.policy = policy or env_router_policy()
         if self.policy not in ROUTER_POLICIES:
@@ -1174,7 +1259,23 @@ class FrontRouter:
         ``occupancy_weight * min(occ, max_batch-1)/max_batch`` —
         bounded strictly below one queued request at the default
         weight, so occupancy breaks near-ties but never outranks real
-        load (or either penalty)."""
+        load (or either penalty).
+
+        A SUBPROCESS replica scores on what the parent can see
+        without a round trip: the RPC client's in-flight count is
+        the depth signal (requests submitted, not yet answered —
+        the same O(queue) magnitude), and the last heartbeat's
+        health observation stands in for the health machine.  Its
+        breaker and batch-occupancy terms live in the child and are
+        not scored — depth dominates placement in practice, and a
+        child's dispatch failures still surface as shed/degraded
+        answers the failover hook acts on."""
+        if replica.spawn != "thread":
+            s = (float(replica.rpc.in_flight())
+                 if replica.rpc is not None else 0.0)
+            if replica.last_health == "degraded":
+                s += DEGRADED_PENALTY
+            return s
         server = replica.server
         s = float(server.depth())
         if server.health == "degraded":
@@ -1338,7 +1439,17 @@ class FrontRouter:
             remaining_ms = max(
                 0.001, (ctx["deadline"] - faults.monotonic()) * 1e3)
         ctx.setdefault("stamps", []).append(remaining_ms)
-        return replica.server.submit(
+        if replica.spawn == "thread":
+            return replica.server.submit(
+                request, block=ctx["block"], timeout=ctx["timeout"],
+                deadline_ms=remaining_ms)
+        if replica.rpc is None:
+            # racing the replica's own start/stop window: typed
+            # placement failure, same as submitting into a closed
+            # server — the caller tries the next survivor
+            raise ServerClosed(
+                f"replica {replica.rid} has no armed RPC data plane")
+        return replica.rpc.submit(
             request, block=ctx["block"], timeout=ctx["timeout"],
             deadline_ms=remaining_ms)
 
@@ -1435,6 +1546,11 @@ def _replica_main(argv=None) -> int:
     ap.add_argument("--max-wait-ms", type=float, default=None)
     ap.add_argument("--workers", type=int, default=None)
     ap.add_argument("--name", default=None)
+    ap.add_argument("--pipeline-spec", action="append", default=[],
+                    help="declarative pipeline_from_spec JSON; "
+                         "repeatable — each is compiled and "
+                         "registered before the replica reports "
+                         "ready")
     args = ap.parse_args(argv)
     obs.enable()
     # history axis: every record this process journals carries its
@@ -1447,6 +1563,16 @@ def _replica_main(argv=None) -> int:
     srv = Server(max_batch=args.max_batch,
                  max_wait_ms=args.max_wait_ms,
                  obs_port=args.obs_port, **kwargs)
+    if args.pipeline_spec:
+        from veles.simd_tpu import pipeline as _pl
+
+        # registration precedes start(): by the time the port is on
+        # stdout (and the router starts placing), every pipeline the
+        # group promised answers here
+        for raw in args.pipeline_spec:
+            spec = json.loads(raw)
+            srv.register_pipeline(spec["name"],
+                                  _pl.pipeline_from_spec(spec))
     # start() preloads the warm artifact pack when the store is armed
     # (the child inherits VELES_SIMD_ARTIFACTS/_ARTIFACT_DIR from the
     # group's environment), so a subprocess replica reports its port —

@@ -592,7 +592,8 @@ class Server:
             self._endpoint = None       # explicit disarm beats env
         else:
             self._endpoint = obs_http.start(self._obs_port_arg,
-                                            health=self.stats)
+                                            health=self.stats,
+                                            submit=self._rpc_submit)
         self._started = True
         # zero-warmup cold start: with the artifact store armed
         # (VELES_SIMD_ARTIFACTS=on|readonly), deserialize and
@@ -637,6 +638,16 @@ class Server:
     def obs_port(self) -> int | None:
         """The scrape endpoint's bound port (None while disarmed)."""
         return self._endpoint.port if self._endpoint else None
+
+    def _rpc_submit(self, body: bytes) -> tuple:
+        """The endpoint's ``POST /submit`` handler: one npy-framed
+        request body in, ``(http_code, response_bytes)`` out — the
+        RPC data plane (:func:`veles.simd_tpu.serve.rpc.serve_submit`
+        owns the wire contract; imported lazily, the rpc module
+        imports this one)."""
+        from veles.simd_tpu.serve import rpc
+
+        return rpc.serve_submit(self, body)
 
     def stop(self, drain: bool = True) -> None:
         """Close the intake and join the workers.  ``drain=True``
